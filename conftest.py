@@ -1,0 +1,22 @@
+"""Root conftest: force an 8-device virtual CPU mesh for the test suite.
+
+Mirrors the reference's "fake multi-node" strategy (4 JVMs on loopback,
+see SURVEY.md §4.1 / multiNodeUtils.sh) with JAX's
+--xla_force_host_platform_device_count. The axon sitecustomize pins
+JAX_PLATFORMS=axon (one real TPU chip); tests override to CPU so sharding
+semantics are exercised on 8 virtual devices.
+
+Set H2O3_TPU_TEST_PLATFORM=tpu to run the suite on the real chip instead.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+if os.environ.get("H2O3_TPU_TEST_PLATFORM", "cpu") == "cpu":
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,46 @@
+"""h2o3_tpu — a TPU-native distributed ML platform with H2O-3's capabilities.
+
+The reference (usefulalgorithm/h2o-3) is a JVM cluster holding a distributed
+K/V store of columnar frame chunks, computed over with MRTask map/reduce
+(see /root/repo/SURVEY.md). This package is the TPU-first re-design:
+
+- the JVM cloud / Paxos / RPC / DKV collapse into single-controller JAX over a
+  ``jax.sharding.Mesh`` (axes ``('data', 'model')``);
+- Frame/Vec/Chunk become columnar containers over row-sharded ``jax.Array``s;
+- MRTask's binary-tree map/reduce becomes ``shard_map`` + XLA collectives
+  (``psum``/``all_gather``/``reduce_scatter``) over ICI;
+- the native XGBoost ``gpu_hist`` path becomes a JAX/pallas histogram tree
+  builder whose per-node grad/hess histograms all-reduce over ICI.
+
+Public surface mirrors the h2o python client (reference h2o-py/h2o/h2o.py).
+"""
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.vec import Vec
+from h2o3_tpu.ingest.parse import import_file, parse_setup, upload_numpy
+from h2o3_tpu.parallel.mesh import current_mesh, set_mesh, make_mesh
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Frame",
+    "Vec",
+    "import_file",
+    "parse_setup",
+    "upload_numpy",
+    "current_mesh",
+    "set_mesh",
+    "make_mesh",
+    "init",
+]
+
+
+def init(n_data=None, n_model=1):
+    """Initialise the runtime: build the global device mesh.
+
+    Replaces the reference's cluster boot (water/H2O.java:2328 main →
+    Paxos cloud formation): there is no membership protocol — the mesh is
+    the cloud.
+    """
+    set_mesh(make_mesh(n_data=n_data, n_model=n_model))
+    return current_mesh()

@@ -1,0 +1,4 @@
+from h2o3_tpu.frame.vec import Vec
+from h2o3_tpu.frame.frame import Frame
+
+__all__ = ["Vec", "Frame"]

@@ -1,0 +1,186 @@
+"""Frame — a named collection of Vecs (columnar distributed table).
+
+Reference: water/fvec/Frame.java:65 — ordered column names over Vec keys in
+the DKV, with cluster-wide lock semantics (water/Lockable.java:25). Here a
+Frame is a host-side object holding row-sharded device columns; locking
+disappears (single controller), lifecycle is Python GC + the registry used
+by the REST layer.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.vec import T_ENUM, T_INT, T_REAL, T_STR, T_TIME, Vec
+from h2o3_tpu.parallel.mesh import current_mesh
+
+
+class Frame:
+    def __init__(self, names: Sequence[str], vecs: Sequence[Vec], key: Optional[str] = None):
+        assert len(names) == len(vecs)
+        nrows = {v.nrow for v in vecs}
+        if len(nrows) > 1:
+            raise ValueError(f"column lengths differ: {nrows}")
+        self._names: List[str] = list(names)
+        self._vecs: List[Vec] = list(vecs)
+        self.key = key
+
+    # ---------------- construction ----------------
+
+    @staticmethod
+    def from_numpy(data: Union[np.ndarray, Dict[str, np.ndarray]],
+                   names: Optional[Sequence[str]] = None, mesh=None) -> "Frame":
+        mesh = mesh or current_mesh()
+        if isinstance(data, dict):
+            names = list(data.keys())
+            cols = [np.asarray(c) for c in data.values()]
+        else:
+            data = np.asarray(data)
+            if data.ndim == 1:
+                data = data[:, None]
+            cols = [data[:, i] for i in range(data.shape[1])]
+            if names is None:
+                names = [f"C{i + 1}" for i in range(len(cols))]
+        return Frame(list(names), [Vec.from_numpy(c, mesh=mesh) for c in cols])
+
+    # ---------------- shape / access ----------------
+
+    @property
+    def nrow(self) -> int:
+        return self._vecs[0].nrow if self._vecs else 0
+
+    @property
+    def ncol(self) -> int:
+        return len(self._vecs)
+
+    @property
+    def names(self) -> List[str]:
+        return list(self._names)
+
+    @property
+    def vecs(self) -> List[Vec]:
+        return list(self._vecs)
+
+    @property
+    def types(self) -> Dict[str, str]:
+        return {n: v.type for n, v in zip(self._names, self._vecs)}
+
+    def vec(self, name_or_idx: Union[str, int]) -> Vec:
+        if isinstance(name_or_idx, int):
+            return self._vecs[name_or_idx]
+        return self._vecs[self._names.index(name_or_idx)]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._names
+
+    def __getitem__(self, sel) -> "Frame":
+        if isinstance(sel, str):
+            return Frame([sel], [self.vec(sel)])
+        if isinstance(sel, (list, tuple)) and all(isinstance(s, str) for s in sel):
+            return Frame(list(sel), [self.vec(s) for s in sel])
+        if isinstance(sel, (slice, np.ndarray)):
+            return self.rows(sel)
+        raise TypeError(f"unsupported selector {sel!r}")
+
+    def __setitem__(self, name: str, vec: Vec):
+        if isinstance(vec, Frame):
+            assert vec.ncol == 1
+            vec = vec.vec(0)
+        if name in self._names:
+            self._vecs[self._names.index(name)] = vec
+        else:
+            self._names.append(name)
+            self._vecs.append(vec)
+
+    def drop(self, names: Union[str, Iterable[str]]) -> "Frame":
+        if isinstance(names, str):
+            names = [names]
+        drop = set(names)
+        keep = [(n, v) for n, v in zip(self._names, self._vecs) if n not in drop]
+        return Frame([n for n, _ in keep], [v for _, v in keep])
+
+    def cbind(self, other: "Frame") -> "Frame":
+        return Frame(self._names + other._names, self._vecs + other._vecs)
+
+    def rename(self, mapping: Dict[str, str]) -> "Frame":
+        return Frame([mapping.get(n, n) for n in self._names], self._vecs)
+
+    # ---------------- row selection ----------------
+
+    def rows(self, sel) -> "Frame":
+        """Row subset by slice or host boolean/index array. Gather happens
+        host-side then re-shards (the reference materialises subset frames
+        with a deep-slice MRTask; a host gather keeps it simple — device
+        gather is a later optimisation)."""
+        idx = np.arange(self.nrow)[sel] if isinstance(sel, slice) else np.asarray(sel)
+        if idx.dtype == bool:
+            idx = np.nonzero(idx)[0]
+        new_vecs = []
+        for v in self._vecs:
+            if v.type == T_STR:
+                new_vecs.append(Vec.from_numpy(v.host_data[idx], vtype=T_STR))
+            else:
+                raw = v.to_numpy()[idx]
+                new_vecs.append(Vec.from_numpy(raw, vtype=v.type, domain=v.domain))
+        return Frame(self.names, new_vecs)
+
+    def head(self, n: int = 10) -> "Frame":
+        return self.rows(slice(0, min(n, self.nrow)))
+
+    def split_frame(self, ratios: Sequence[float], seed: int = -1) -> List["Frame"]:
+        """Random split (reference: hex/splitframe/ShuffleSplitFrame) —
+        per-row uniform draw against cumulative ratios."""
+        rng = np.random.default_rng(None if seed in (-1, None) else seed)
+        u = rng.random(self.nrow)
+        cuts = np.cumsum(list(ratios))
+        if len(cuts) == 0 or cuts[-1] < 1.0 - 1e-9:
+            cuts = np.append(cuts, 1.0)
+        assign = np.searchsorted(cuts, u, side="right")
+        return [self.rows(assign == i) for i in range(len(cuts))]
+
+    # ---------------- materialisation ----------------
+
+    def to_numpy(self) -> np.ndarray:
+        """Dense float matrix of numeric view (enum → codes, NA → NaN)."""
+        cols = []
+        for v in self._vecs:
+            if v.type == T_STR:
+                cols.append(np.full(self.nrow, np.nan, dtype=np.float32))
+            else:
+                raw = v.to_numpy().astype(np.float64)
+                if v.type == T_ENUM:
+                    raw = np.where(raw < 0, np.nan, raw)
+                cols.append(raw)
+        return np.stack(cols, axis=1) if cols else np.empty((self.nrow, 0))
+
+    def to_dict(self) -> Dict[str, np.ndarray]:
+        return {n: v.to_numpy() for n, v in zip(self._names, self._vecs)}
+
+    def as_matrix(self, names: Optional[Sequence[str]] = None):
+        """Device float32 [padded_rows, ncol] matrix (enum codes as floats,
+        NA→NaN) — the dense hand-off into model builders. String columns
+        become all-NaN (no device representation)."""
+        names = names or self._names
+        cols = []
+        plen = None
+        for n in names:
+            v = self.vec(n)
+            if v.type == T_STR:
+                cols.append(None)
+            else:
+                cols.append(v.as_float())
+                plen = cols[-1].shape[0]
+        if plen is None:
+            raise ValueError("as_matrix needs at least one non-string column")
+        cols = [jnp.full(plen, jnp.nan, dtype=jnp.float32) if c is None else c
+                for c in cols]
+        return jnp.stack(cols, axis=1)
+
+    def summary(self) -> Dict[str, dict]:
+        return {n: v.rollups() for n, v in zip(self._names, self._vecs)}
+
+    def __repr__(self):
+        return f"<Frame {self.key or ''} {self.nrow}x{self.ncol} {self.types}>"

@@ -1,0 +1,76 @@
+"""RollupStats — lazy per-column statistics, one fused jitted reduction.
+
+Reference: water/fvec/RollupStats.java:17 computes min/max/mean/sigma/
+nzCnt/NA-count (+ histogram & percentiles) as an MRTask over chunks with a
+cluster CAS to dedupe computation. Here it is a single XLA reduction over
+the sharded column; GSPMD inserts the cross-device psum automatically.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _rollup_kernel(data, nrow):
+    n = data.shape[0]
+    valid = (jnp.arange(n) < nrow) & ~jnp.isnan(data)
+    x = jnp.where(valid, data, 0.0)
+    cnt = valid.sum()
+    fcnt = jnp.maximum(cnt, 1).astype(jnp.float32)
+    s = x.sum()
+    mean = s / fcnt
+    # two-pass sigma for stability (the reference uses streaming Welford
+    # merges up the reduce tree; two fused passes are cheaper on TPU)
+    var = jnp.where(valid, (data - mean) ** 2, 0.0).sum() / jnp.maximum(fcnt - 1.0, 1.0)
+    mn = jnp.where(valid, data, jnp.inf).min()
+    mx = jnp.where(valid, data, -jnp.inf).max()
+    nz = (valid & (data != 0.0)).sum()
+    pinf = (valid & jnp.isposinf(data)).sum()
+    ninf = (valid & jnp.isneginf(data)).sum()
+    return cnt, s, mean, jnp.sqrt(var), mn, mx, nz, pinf, ninf
+
+
+def compute_rollups(vec) -> dict:
+    from h2o3_tpu.frame.vec import T_ENUM, T_STR
+
+    if vec.type == T_STR:
+        isna = np.array([v is None or v == "" for v in vec.host_data])
+        return {"na_count": int(isna.sum()), "rows": vec.nrow, "mean": np.nan,
+                "sigma": np.nan, "min": np.nan, "max": np.nan, "nz_count": int((~isna).sum()),
+                "pinfs": 0, "ninfs": 0, "is_const": False}
+    data = vec.as_float()
+    cnt, s, mean, sigma, mn, mx, nz, pinf, ninf = [
+        np.asarray(v) for v in _rollup_kernel(data, vec.nrow)]
+    cnt = int(cnt)
+    out = {
+        "rows": vec.nrow,
+        "na_count": vec.nrow - cnt,
+        "mean": float(mean) if cnt else np.nan,
+        "sigma": float(sigma) if cnt > 1 else 0.0 if cnt else np.nan,
+        "min": float(mn) if cnt else np.nan,
+        "max": float(mx) if cnt else np.nan,
+        "nz_count": int(nz),
+        "pinfs": int(pinf),
+        "ninfs": int(ninf),
+    }
+    out["is_const"] = cnt > 0 and out["min"] == out["max"]
+    if vec.type == T_ENUM:
+        out["cardinality"] = vec.cardinality
+    return out
+
+
+@jax.jit
+def _quantile_kernel(data, probs):
+    return jnp.nanquantile(data, probs)
+
+
+def compute_percentiles(vec, probs) -> np.ndarray:
+    """Exact quantiles via device sort (the reference iteratively refines a
+    distributed histogram — hex/quantile/Quantile.java:87 — an on-device
+    global sort is simpler and exact at TPU memory scales)."""
+    data = vec.as_float()
+    return np.asarray(_quantile_kernel(data, jnp.asarray(probs, dtype=jnp.float32)))

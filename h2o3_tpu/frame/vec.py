@@ -1,0 +1,225 @@
+"""Vec — one column of a distributed Frame.
+
+Reference: water/fvec/Vec.java:157 — a Vec is a named column whose rows are
+split into compressed Chunks stored in the DKV, with an ESPC row layout and
+lazily-computed RollupStats. TPU re-design:
+
+- the ~20 chunk compressor subtypes (water/fvec/C*.java, chosen by
+  NewChunk.compress()) collapse into dtype choice on a single padded,
+  row-sharded ``jax.Array`` — XLA wants flat dense typed buffers, not
+  per-chunk byte-packing;
+- the ESPC layout (water/fvec/Vec.java:163-171) becomes an even row
+  partition over the mesh 'data' axis (static shapes for XLA), padded at
+  the tail; validity is derived from ``row_index < nrow`` plus NA
+  sentinels;
+- types mirror Vec.T_* (water/fvec/Vec.java:207-212): real/int/enum/time/
+  str. Enum domains are host-side tuples (the reference's String[] domain).
+
+NA encoding: NaN for float data, -1 for enum codes. Time is stored on
+device as float32 epoch-seconds (exact int64 millis kept host-side when
+available). Strings are host-only (no device representation — same as the
+reference, which never computes on strings distributedly except via Rapids
+string ops, which we run host-side).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.parallel.mesh import current_mesh, data_sharding, padded_len
+
+T_REAL = "real"
+T_INT = "int"
+T_ENUM = "enum"
+T_TIME = "time"
+T_STR = "string"
+
+ENUM_NA = -1
+
+# reference default percentiles: water/fvec/Vec.java PERCENTILES
+PERCENTILES = (0.001, 0.01, 0.1, 0.25, 1.0 / 3.0, 0.5, 2.0 / 3.0, 0.75, 0.9, 0.99, 0.999)
+
+
+class Vec:
+    def __init__(self, data, nrow: int, vtype: str = T_REAL,
+                 domain: Optional[Sequence[str]] = None, host_data=None):
+        self.data = data            # padded, row-sharded jax.Array (None for str vecs)
+        self.nrow = int(nrow)
+        self.type = vtype
+        self.domain = tuple(domain) if domain is not None else None
+        self.host_data = host_data  # numpy: exact values for str/time
+        self._rollups = None
+
+    # ---------------- construction ----------------
+
+    TIME_NA = np.iinfo(np.int64).min  # host sentinel for missing timestamps
+
+    @staticmethod
+    def from_numpy(arr: np.ndarray, vtype: Optional[str] = None,
+                   domain: Optional[Sequence[str]] = None, mesh=None) -> "Vec":
+        mesh = mesh or current_mesh()
+        arr = np.asarray(arr)
+        explicit = vtype is not None
+        if vtype is None:
+            if arr.dtype.kind in "OUS":
+                return Vec._from_strings(arr, mesh)
+            vtype = T_INT if arr.dtype.kind in "iub" else T_REAL
+        nrow = len(arr)
+        if vtype == T_STR:
+            return Vec(None, nrow, T_STR, host_data=np.asarray(arr, dtype=object))
+        if vtype == T_ENUM:
+            codes = np.asarray(arr, dtype=np.int32)
+            dev = _pad_and_put(codes, nrow, np.int32(ENUM_NA), mesh)
+            return Vec(dev, nrow, T_ENUM, domain=domain)
+        if vtype == T_TIME:
+            host = np.asarray(arr, dtype=np.int64)
+            sec = np.where(host == Vec.TIME_NA, np.nan, host / 1000.0).astype(np.float32)
+            dev = _pad_and_put(sec, nrow, np.float32(np.nan), mesh)
+            return Vec(dev, nrow, T_TIME, host_data=host)
+        f64 = np.asarray(arr, dtype=np.float64)
+        f = f64.astype(np.float32)
+        if not explicit and vtype == T_INT and not _is_integral(f64):
+            vtype = T_REAL
+        dev = _pad_and_put(f, nrow, np.float32(np.nan), mesh)
+        # float32 mantissa is 24 bits: large ints (IDs, counts) would be
+        # silently rounded on device, so keep an exact float64 host copy
+        # (the reference keeps exact long chunks — water/fvec/C8Chunk)
+        host = None
+        if vtype == T_INT:
+            finite = f64[np.isfinite(f64)]
+            if finite.size and np.abs(finite).max() > (1 << 24):
+                host = f64
+        return Vec(dev, nrow, vtype, host_data=host)
+
+    @staticmethod
+    def _from_strings(arr: np.ndarray, mesh) -> "Vec":
+        """String column → enum (codes + domain), mirroring the parser's
+        categorical handling (water/parser/ParseDataset.java PackedDomains)."""
+        arr = np.asarray(arr, dtype=object)
+        isna = np.array([x is None or (isinstance(x, float) and np.isnan(x)) or x == ""
+                         for x in arr])
+        vals = np.array(["" if m else str(v) for v, m in zip(arr, isna)])
+        domain = np.unique(vals[~isna]) if (~isna).any() else np.array([], dtype=str)
+        codes = np.searchsorted(domain, vals).astype(np.int32)
+        codes[isna] = ENUM_NA
+        dev = _pad_and_put(codes, len(arr), np.int32(ENUM_NA), mesh)
+        return Vec(dev, len(arr), T_ENUM, domain=[str(d) for d in domain])
+
+    @staticmethod
+    def constant(value: float, nrow: int, mesh=None) -> "Vec":
+        return Vec.from_numpy(np.full(nrow, value, dtype=np.float32), mesh=mesh)
+
+    # ---------------- properties ----------------
+
+    def __len__(self) -> int:
+        return self.nrow
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.type in (T_REAL, T_INT)
+
+    @property
+    def is_categorical(self) -> bool:
+        return self.type == T_ENUM
+
+    @property
+    def cardinality(self) -> int:
+        return len(self.domain) if self.domain is not None else -1
+
+    def valid_mask(self):
+        """Device bool mask of real (non-pad, non-NA) rows."""
+        if self.data is None:
+            raise ValueError("string Vec has no device representation")
+        n = self.data.shape[0]
+        inrange = jnp.arange(n) < self.nrow
+        if self.type == T_ENUM:
+            return inrange & (self.data >= 0)
+        return inrange & ~jnp.isnan(self.data)
+
+    def as_float(self):
+        """Device float32 view with NA→NaN (enums become their codes)."""
+        if self.data is None:
+            raise ValueError("string Vec has no device representation; "
+                             "drop or re-type string columns before compute")
+        if self.type == T_ENUM:
+            return jnp.where(self.data >= 0, self.data.astype(jnp.float32), jnp.nan)
+        return self.data
+
+    # ---------------- rollups ----------------
+
+    def rollups(self) -> dict:
+        """Lazy cached per-column stats — the RollupStats contract
+        (water/fvec/RollupStats.java:7-16): computed on first ask, cached,
+        invalidated on write. The reference races a DKV CAS to pick the
+        computing node; single-controller JAX just computes once here."""
+        if self._rollups is None:
+            from h2o3_tpu.frame.rollups import compute_rollups
+            self._rollups = compute_rollups(self)
+        return self._rollups
+
+    def invalidate_rollups(self):
+        self._rollups = None
+
+    def mean(self):
+        return self.rollups()["mean"]
+
+    def sigma(self):
+        return self.rollups()["sigma"]
+
+    def min(self):
+        return self.rollups()["min"]
+
+    def max(self):
+        return self.rollups()["max"]
+
+    def na_count(self):
+        return self.rollups()["na_count"]
+
+    def percentiles(self, probs=PERCENTILES):
+        from h2o3_tpu.frame.rollups import compute_percentiles
+        return compute_percentiles(self, probs)
+
+    # ---------------- materialisation ----------------
+
+    def to_numpy(self) -> np.ndarray:
+        """Unpadded host copy. Enum → int codes (use .domain to decode);
+        time → int64 millis; str → object array."""
+        if self.type == T_STR:
+            return self.host_data.copy()
+        if self.host_data is not None:
+            if self.type == T_TIME:
+                return self.host_data.copy()
+            # exact wide-int copy, NA as NaN (float64 holds ints to 2^53)
+            return self.host_data.copy()
+        out = np.asarray(jax.device_get(self.data))[: self.nrow]
+        return out
+
+    def to_strings(self) -> np.ndarray:
+        """Decoded object array (enum codes → labels)."""
+        if self.type == T_STR:
+            return self.host_data.copy()
+        raw = self.to_numpy()
+        if self.type == T_ENUM:
+            dom = np.array(list(self.domain) + [None], dtype=object)
+            return dom[np.where(raw < 0, len(self.domain), raw)]
+        return raw.astype(object)
+
+    def with_data(self, new_data, vtype=None, domain=None) -> "Vec":
+        v = Vec(new_data, self.nrow, vtype or self.type,
+                domain if domain is not None else self.domain)
+        return v
+
+
+def _is_integral(f: np.ndarray) -> bool:
+    finite = f[np.isfinite(f)]
+    return bool(finite.size == 0 or np.all(finite == np.round(finite)))
+
+
+def _pad_and_put(arr: np.ndarray, nrow: int, fill, mesh):
+    plen = padded_len(nrow, mesh)
+    if plen != nrow:
+        arr = np.concatenate([arr, np.full(plen - nrow, fill, dtype=arr.dtype)])
+    return jax.device_put(arr, data_sharding(mesh))

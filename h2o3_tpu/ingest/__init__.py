@@ -1,0 +1,3 @@
+from h2o3_tpu.ingest.parse import import_file, parse_setup, parse, upload_numpy
+
+__all__ = ["import_file", "parse_setup", "parse", "upload_numpy"]

@@ -1,0 +1,257 @@
+"""Two-phase distributed parse: guess, then parse.
+
+Reference: water/parser/ParseSetup.java guesses separator/header/types from
+sampled chunks; water/parser/ParseDataset.java:127 forkParseDataset runs a
+MultiFileParseTask MRTask over raw-byte chunks, each node streaming its
+chunks through CsvParser into per-column NewChunks, then unions categorical
+domains across nodes and assembles the Frame.
+
+TPU re-design: parsing is host work (TPUs don't parse bytes); each host
+reads its byte ranges, tokenises to typed numpy columns, unions enum
+domains, and the columns are device_put row-sharded. The two-phase
+guess-then-parse contract and the type system are preserved. A C++
+tokeniser can slot under ``_parse_csv_text`` later without changing the
+interface.
+"""
+from __future__ import annotations
+
+import csv
+import io
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.vec import ENUM_NA, T_ENUM, T_INT, T_REAL, T_STR, T_TIME, Vec
+
+DEFAULT_NA_STRINGS = {"", "NA", "N/A", "na", "NaN", "nan", "null", "NULL", "None", "?"}
+_SEP_CANDIDATES = [",", "\t", ";", "|", " "]
+# max enum cardinality before a column falls back to string
+# (reference: Categorical.MAX_CATEGORICAL_COUNT ~ 10M; we cap lower since
+# domains are host-side python lists)
+MAX_ENUM_CARDINALITY = 1_000_000
+
+
+@dataclass
+class ParseSetup:
+    separator: str = ","
+    header: bool = True
+    column_names: List[str] = field(default_factory=list)
+    column_types: List[str] = field(default_factory=list)
+    na_strings: set = field(default_factory=lambda: set(DEFAULT_NA_STRINGS))
+    skipped_columns: List[int] = field(default_factory=list)
+    quotechar: str = '"'
+
+
+def _is_number(tok: str) -> bool:
+    try:
+        float(tok)
+        return True
+    except ValueError:
+        return False
+
+
+def _is_int(tok: str) -> bool:
+    try:
+        f = float(tok)
+        return f == int(f) and "e" not in tok.lower() and "." not in tok
+    except (ValueError, OverflowError):
+        return False
+
+
+def _looks_time(tok: str) -> bool:
+    if len(tok) < 8 or tok[4:5] != "-":
+        return False
+    try:
+        np.datetime64(tok)
+        return True
+    except ValueError:
+        return False
+
+
+def _read_head(path: str, nbytes: int = 1 << 16) -> str:
+    with open(path, "rb") as f:
+        raw = f.read(nbytes)
+    txt = raw.decode("utf-8", errors="replace")
+    # drop a possibly-truncated last line
+    if len(raw) == nbytes and "\n" in txt:
+        txt = txt[: txt.rfind("\n")]
+    return txt
+
+
+def guess_separator(sample: str) -> str:
+    lines = [l for l in sample.splitlines() if l.strip()][:50]
+    best, best_score = ",", -1
+    for sep in _SEP_CANDIDATES:
+        counts = [len(next(csv.reader([l], delimiter=sep, quotechar='"'))) for l in lines]
+        if not counts:
+            continue
+        ncol = max(set(counts), key=counts.count)
+        consistent = sum(c == ncol for c in counts)
+        score = consistent * 1000 + ncol
+        if ncol > 1 and score > best_score:
+            best, best_score = sep, score
+    return best
+
+
+def _guess_col_type(tokens: List[str], na_strings) -> str:
+    vals = [t for t in tokens if t.strip() not in na_strings]
+    if not vals:
+        return T_REAL
+    if all(_is_number(v) for v in vals):
+        return T_INT if all(_is_int(v) for v in vals) else T_REAL
+    if all(_looks_time(v) for v in vals):
+        return T_TIME
+    return T_ENUM
+
+
+def parse_setup(paths: Union[str, Sequence[str]], separator: Optional[str] = None,
+                header: Optional[bool] = None, column_names: Optional[Sequence[str]] = None,
+                column_types: Optional[Sequence[str]] = None,
+                na_strings: Optional[Sequence[str]] = None) -> ParseSetup:
+    """Phase 1 — sample and guess (reference: ParseSetup.guessSetup)."""
+    if isinstance(paths, str):
+        paths = [paths]
+    sample = _read_head(paths[0])
+    sep = separator or guess_separator(sample)
+    nas = set(na_strings) if na_strings is not None else set(DEFAULT_NA_STRINGS)
+    rows = list(csv.reader(io.StringIO(sample), delimiter=sep, quotechar='"'))
+    rows = [r for r in rows if r]
+    if not rows:
+        raise ValueError(f"empty file: {paths[0]}")
+    first = rows[0]
+    if header is None:
+        # header iff some column's first cell is a bare string while the
+        # body of that column is numeric or time-typed
+        def tok_class(tok):
+            t = tok.strip()
+            if t in nas:
+                return None
+            if _is_number(t):
+                return "num"
+            if _looks_time(t):
+                return "time"
+            return "str"
+
+        data_rows = rows[1:50]
+        header = False
+        for i, c in enumerate(first):
+            if tok_class(c) != "str":
+                continue
+            body = [tok_class(r[i]) for r in data_rows if i < len(r)]
+            body = [b for b in body if b is not None]
+            if body and all(b in ("num", "time") for b in body):
+                header = True
+                break
+        if not data_rows:
+            header = all(not _is_number(c) for c in first)
+    ncol = len(first)
+    names = (list(first) if header else [f"C{i + 1}" for i in range(ncol)])
+    if column_names:
+        names = list(column_names)
+    body = rows[1:] if header else rows
+    body = body[:1000]
+    types = []
+    for i in range(ncol):
+        toks = [r[i] for r in body if i < len(r)]
+        types.append(_guess_col_type(toks, nas))
+    if column_types:
+        for i, t in enumerate(column_types):
+            if t:
+                types[i] = {"numeric": T_REAL, "categorical": T_ENUM, "factor": T_ENUM,
+                            "string": T_STR, "time": T_TIME, "int": T_INT,
+                            "real": T_REAL, "enum": T_ENUM}.get(t, t)
+    return ParseSetup(separator=sep, header=bool(header), column_names=names,
+                      column_types=types, na_strings=nas)
+
+
+def _parse_csv_text(text: str, setup: ParseSetup, skip_header: bool):
+    """Tokenise one file's text into per-column python lists."""
+    reader = csv.reader(io.StringIO(text), delimiter=setup.separator,
+                        quotechar=setup.quotechar)
+    rows = [r for r in reader if r]
+    if skip_header and rows:
+        rows = rows[1:]
+    ncol = len(setup.column_names)
+    cols = [[None] * len(rows) for _ in range(ncol)]
+    nas = setup.na_strings
+    for ri, r in enumerate(rows):
+        for ci in range(ncol):
+            tok = r[ci].strip() if ci < len(r) else ""
+            cols[ci][ri] = None if tok in nas else tok
+    return cols
+
+
+def _column_to_vec(tokens: List[Optional[str]], vtype: str, mesh=None) -> Vec:
+    n = len(tokens)
+    if vtype in (T_REAL, T_INT):
+        arr = np.full(n, np.nan, dtype=np.float64)
+        for i, t in enumerate(tokens):
+            if t is not None:
+                try:
+                    arr[i] = float(t)
+                except ValueError:
+                    pass  # stray non-numeric in a numeric column → NA
+        return Vec.from_numpy(arr, vtype=vtype, mesh=mesh)
+    if vtype == T_TIME:
+        ms = np.full(n, Vec.TIME_NA, dtype=np.int64)
+        for i, t in enumerate(tokens):
+            if t is not None:
+                try:
+                    ms[i] = np.datetime64(t, "ms").astype(np.int64)
+                except ValueError:
+                    pass
+        return Vec.from_numpy(ms, vtype=T_TIME, mesh=mesh)
+    if vtype == T_STR:
+        return Vec.from_numpy(np.array(tokens, dtype=object), vtype=T_STR, mesh=mesh)
+    # enum: union domain then encode (reference: PackedDomains union across nodes)
+    vals = sorted({t for t in tokens if t is not None})
+    if len(vals) > MAX_ENUM_CARDINALITY:
+        return Vec.from_numpy(np.array(tokens, dtype=object), vtype=T_STR, mesh=mesh)
+    lut = {v: i for i, v in enumerate(vals)}
+    codes = np.fromiter((ENUM_NA if t is None else lut[t] for t in tokens),
+                        dtype=np.int32, count=n)
+    return Vec.from_numpy(codes, vtype=T_ENUM, domain=vals, mesh=mesh)
+
+
+def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
+          mesh=None, key: Optional[str] = None) -> Frame:
+    """Phase 2 — full parse into a row-sharded Frame."""
+    if isinstance(paths, str):
+        paths = [paths]
+    setup = setup or parse_setup(paths)
+    all_cols = None
+    for p in paths:
+        with open(p, "rb") as f:
+            text = f.read().decode("utf-8", errors="replace")
+        cols = _parse_csv_text(text, setup, skip_header=setup.header)
+        if all_cols is None:
+            all_cols = cols
+        else:
+            for c, extra in zip(all_cols, cols):
+                c.extend(extra)
+    skipped = set(setup.skipped_columns)
+    names, vecs = [], []
+    for i, (col, t) in enumerate(zip(all_cols, setup.column_types)):
+        if i in skipped:
+            continue
+        names.append(setup.column_names[i])
+        vecs.append(_column_to_vec(col, t, mesh=mesh))
+    return Frame(names, vecs, key=key or os.path.basename(paths[0]))
+
+
+def import_file(path: Union[str, Sequence[str]], destination_frame: Optional[str] = None,
+                header: Optional[bool] = None, sep: Optional[str] = None,
+                col_names: Optional[Sequence[str]] = None,
+                col_types: Optional[Sequence[str]] = None,
+                na_strings: Optional[Sequence[str]] = None, mesh=None) -> Frame:
+    """One-shot import (mirrors h2o.import_file, h2o-py/h2o/h2o.py)."""
+    setup = parse_setup(path, separator=sep, header=header, column_names=col_names,
+                        column_types=col_types, na_strings=na_strings)
+    return parse(path, setup, mesh=mesh, key=destination_frame)
+
+
+def upload_numpy(data, names=None, mesh=None) -> Frame:
+    return Frame.from_numpy(data, names=names, mesh=mesh)

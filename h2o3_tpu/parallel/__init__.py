@@ -1,0 +1,18 @@
+from h2o3_tpu.parallel.mesh import (
+    current_mesh,
+    data_sharding,
+    make_mesh,
+    replicated_sharding,
+    set_mesh,
+)
+from h2o3_tpu.parallel.map_reduce import map_reduce, map_cols
+
+__all__ = [
+    "current_mesh",
+    "data_sharding",
+    "make_mesh",
+    "replicated_sharding",
+    "set_mesh",
+    "map_reduce",
+    "map_cols",
+]

@@ -1,0 +1,75 @@
+"""The compute combinator — TPU replacement for MRTask.
+
+The reference expresses *all* distributed compute as
+``new MRTask(){ map(Chunk); reduce(T) }.doAll(frame)`` — a binary tree
+fan-out over nodes then ForkJoin threads, with pairwise reduction back up
+(water/MRTask.java:65, :695, :871-926). Here the same contract is one
+``shard_map``: ``map_fn`` runs per data-shard (the "chunk"), and the
+reduction is an XLA collective over the ICI mesh axis instead of a
+serialize-and-merge tree.
+
+Two shapes, mirroring MRTask's two uses:
+- ``map_reduce``  — map + associative reduce to a replicated result
+  (MRTask with a ``reduce()``);
+- ``map_cols``    — elementwise map producing new row-sharded columns
+  (MRTask with NewChunk outputs → outputFrame).
+
+Most algorithm code does NOT need these: plain jnp ops under ``jit`` on
+sharded arrays auto-partition via GSPMD. The combinator exists for cases
+where the collective placement should be explicit (histograms, Gram
+accumulation) and as the parity point with the reference's one-primitive
+compute model.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from h2o3_tpu.parallel.mesh import DATA_AXIS, current_mesh
+
+_REDUCERS = {
+    "sum": jax.lax.psum,
+    "max": jax.lax.pmax,
+    "min": jax.lax.pmin,
+}
+
+
+def map_reduce(map_fn, arrays, reduce_op="sum", mesh=None, donate=False):
+    """Run ``map_fn`` over each data shard of ``arrays`` (a pytree of arrays
+    sharded along their leading axis) and reduce the per-shard results with
+    a collective. Result is replicated across devices.
+
+    ``map_fn(shard_pytree) -> partial_pytree`` must return per-shard partial
+    aggregates (e.g. a local histogram, a local (Gram, gradient) pair).
+    """
+    mesh = mesh or current_mesh()
+    reducer = _REDUCERS[reduce_op] if isinstance(reduce_op, str) else reduce_op
+
+    def wrapped(shards):
+        out = map_fn(shards)
+        return jax.tree.map(lambda x: reducer(x, DATA_AXIS), out)
+
+    f = jax.shard_map(
+        wrapped,
+        mesh=mesh,
+        in_specs=jax.tree.map(lambda _: P(DATA_AXIS), arrays),
+        out_specs=P(),
+    )
+    return jax.jit(f, donate_argnums=(0,) if donate else ())(arrays)
+
+
+def map_cols(map_fn, arrays, out_specs=None, mesh=None):
+    """Elementwise map over data shards producing new row-sharded outputs —
+    the NewChunk/outputFrame analog (water/MRTask.java:257-299 map overloads
+    writing NewChunks)."""
+    mesh = mesh or current_mesh()
+    f = jax.shard_map(
+        map_fn,
+        mesh=mesh,
+        in_specs=jax.tree.map(lambda _: P(DATA_AXIS), arrays),
+        out_specs=out_specs if out_specs is not None else P(DATA_AXIS),
+    )
+    return jax.jit(f)(arrays)

@@ -1,0 +1,78 @@
+"""Device mesh management — the TPU replacement for the reference's "cloud".
+
+The reference forms a cluster of JVMs via gossip heartbeats and a consensus
+protocol (water/Paxos.java:27, water/H2O.java:1974 CLOUD membership). In
+single-controller JAX none of that exists: the set of devices is known at
+process start and never changes. The mesh has two axes:
+
+- ``data``  — rows are sharded here (the analog of chunks round-robin'd
+  across nodes, water/Key.java:117-138);
+- ``model`` — features / parameters shard here for wide problems (the
+  reference never shards the wide axis — SURVEY.md §5 long-context note —
+  this is where the TPU design goes beyond it).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_MESH: Mesh | None = None
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(n_data: int | None = None, n_model: int = 1, devices=None) -> Mesh:
+    """Build a ('data', 'model') mesh over the available devices."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = len(devices)
+    if n_data is None:
+        n_data = max(1, n // n_model)
+    if n_data * n_model > n:
+        raise ValueError(
+            f"mesh shape ({n_data},{n_model}) needs {n_data * n_model} devices, have {n}"
+        )
+    dev_array = np.array(devices[: n_data * n_model]).reshape(n_data, n_model)
+    return Mesh(dev_array, (DATA_AXIS, MODEL_AXIS))
+
+
+def set_mesh(mesh: Mesh) -> None:
+    global _MESH
+    _MESH = mesh
+
+
+def current_mesh() -> Mesh:
+    """The global mesh, lazily created over all devices (pure data axis)."""
+    global _MESH
+    if _MESH is None:
+        _MESH = make_mesh()
+    return _MESH
+
+
+def n_data_shards(mesh: Mesh | None = None) -> int:
+    mesh = mesh or current_mesh()
+    return mesh.shape[DATA_AXIS]
+
+
+def data_sharding(mesh: Mesh | None = None) -> NamedSharding:
+    """Sharding for row-partitioned 1-D/2-D arrays (rows on 'data')."""
+    mesh = mesh or current_mesh()
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh | None = None) -> NamedSharding:
+    mesh = mesh or current_mesh()
+    return NamedSharding(mesh, P())
+
+
+def padded_len(nrow: int, mesh: Mesh | None = None, multiple: int = 8) -> int:
+    """Rows are padded so every data shard has the same length (static shapes
+    for XLA) and each shard length is a multiple of ``multiple`` (TPU sublane
+    alignment). Replaces the reference's variable-size ESPC chunk layout
+    (water/fvec/Vec.java:163-171) with an even partition."""
+    nd = n_data_shards(mesh)
+    q = multiple * nd
+    return max(q, int(math.ceil(nrow / q)) * q)
