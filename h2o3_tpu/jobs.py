@@ -1,0 +1,95 @@
+"""Job — async work units with progress/cancel, polled by clients.
+
+Reference: water/Job.java:24 — DKV-stored job objects with _work/_worked
+progress, JobStatus, cancellation, exceptions, polled via GET /3/Jobs.
+Here: a host-side registry of Job objects; training runs on a worker
+thread so REST/interactive polling stays responsive (device work is
+dispatched asynchronously by JAX anyway).
+"""
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+import uuid
+from typing import Any, Callable, Dict, Optional
+
+RUNNING = "RUNNING"
+DONE = "DONE"
+FAILED = "FAILED"
+CANCELLED = "CANCELLED"
+
+_REGISTRY: Dict[str, "Job"] = {}
+_LOCK = threading.Lock()
+
+
+class Job:
+    def __init__(self, description: str, work: float = 1.0, key: Optional[str] = None):
+        self.key = key or f"$job_{uuid.uuid4().hex[:12]}"
+        self.description = description
+        self.status = RUNNING
+        self._work = float(work)
+        self._worked = 0.0
+        self.start_time = time.time()
+        self.end_time: Optional[float] = None
+        self.exception: Optional[str] = None
+        self.result: Any = None
+        self._cancel_requested = False
+        self._thread: Optional[threading.Thread] = None
+        with _LOCK:
+            _REGISTRY[self.key] = self
+
+    # -- progress -------------------------------------------------------
+    @property
+    def progress(self) -> float:
+        if self.status in (DONE,):
+            return 1.0
+        return min(self._worked / self._work, 1.0) if self._work else 0.0
+
+    def update(self, worked: float):
+        self._worked += worked
+
+    def set_progress(self, frac: float):
+        self._worked = frac * self._work
+
+    # -- lifecycle ------------------------------------------------------
+    def run(self, fn: Callable[["Job"], Any], background: bool = False) -> "Job":
+        def body():
+            try:
+                self.result = fn(self)
+                self.status = DONE if not self._cancel_requested else CANCELLED
+            except Exception:
+                self.status = FAILED
+                self.exception = traceback.format_exc()
+            finally:
+                self.end_time = time.time()
+        if background:
+            self._thread = threading.Thread(target=body, daemon=True)
+            self._thread.start()
+        else:
+            body()
+        return self
+
+    def join(self, timeout: Optional[float] = None):
+        if self._thread:
+            self._thread.join(timeout)
+        if self.status == FAILED:
+            raise RuntimeError(f"Job {self.key} failed:\n{self.exception}")
+        return self.result
+
+    def cancel(self):
+        self._cancel_requested = True
+
+    @property
+    def cancel_requested(self) -> bool:
+        return self._cancel_requested
+
+
+def get_job(key: str) -> Optional[Job]:
+    with _LOCK:
+        return _REGISTRY.get(key)
+
+
+def list_jobs():
+    with _LOCK:
+        return list(_REGISTRY.values())

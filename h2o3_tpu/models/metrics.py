@@ -1,0 +1,231 @@
+"""ModelMetrics family — device-computed, host-materialised.
+
+Reference: hex/ModelMetrics.java and subclasses (~30 classes), AUC via
+hex/AUC2.java (400-bin threshold sketch), confusion matrices, gains/lift.
+TPU design: metrics are one jitted pass over the (sharded) prediction and
+actual arrays; AUC uses an exact full device sort instead of AUC2's
+histogram approximation (a 10M-row sort is cheap on-chip, and exactness
+makes golden tests tighter than the reference's).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------- regression
+
+@jax.jit
+def _regression_kernel(pred, actual, w):
+    tot = w.sum()
+    err = actual - pred
+    mse = (w * err * err).sum() / tot
+    mae = (w * jnp.abs(err)).sum() / tot
+    both_pos = (actual >= 0) & (pred >= 0)
+    sle = jnp.where(both_pos, (jnp.log1p(pred) - jnp.log1p(actual)) ** 2, 0.0)
+    rmsle_ok = both_pos.all()
+    rmsle = jnp.sqrt((w * sle).sum() / tot)
+    mean_a = (w * actual).sum() / tot
+    ss_tot = (w * (actual - mean_a) ** 2).sum()
+    r2 = 1.0 - (w * err * err).sum() / jnp.maximum(ss_tot, 1e-30)
+    return mse, mae, rmsle, rmsle_ok, r2, mean_a
+
+
+@dataclass
+class ModelMetricsRegression:
+    mse: float
+    rmse: float
+    mae: float
+    rmsle: float
+    r2: float
+    mean_residual_deviance: float
+    nobs: int
+
+    def to_dict(self) -> Dict:
+        return {"MSE": self.mse, "RMSE": self.rmse, "mae": self.mae,
+                "rmsle": self.rmsle, "r2": self.r2,
+                "mean_residual_deviance": self.mean_residual_deviance,
+                "nobs": self.nobs}
+
+
+def make_regression_metrics(pred, actual, weights=None, deviance=None) -> ModelMetricsRegression:
+    pred = jnp.asarray(pred, dtype=jnp.float32)
+    actual = jnp.asarray(actual, dtype=jnp.float32)
+    w = jnp.ones_like(actual) if weights is None else jnp.asarray(weights, jnp.float32)
+    mse, mae, rmsle, rmsle_ok, r2, _ = [np.asarray(v) for v in
+                                        _regression_kernel(pred, actual, w)]
+    mse = float(mse)
+    return ModelMetricsRegression(
+        mse=mse, rmse=float(np.sqrt(mse)), mae=float(mae),
+        rmsle=float(rmsle) if bool(rmsle_ok) else float("nan"), r2=float(r2),
+        mean_residual_deviance=float(deviance) if deviance is not None else mse,
+        nobs=int(pred.shape[0]))
+
+
+# ------------------------------------------------------------------ binomial
+
+@jax.jit
+def _binary_curve_kernel(score, y, w):
+    """Sorted threshold sweep → cumulative TP/FP at unique-score boundaries.
+
+    Exact AUC semantics under ties: per-score-group aggregation (the chord
+    rule), matching sklearn's roc_auc and the reference's intent (AUC2
+    approximates with 400 bins; we are exact)."""
+    order = jnp.argsort(-score)
+    s = score[order]
+    yw = (w * y)[order]
+    nw = (w * (1.0 - y))[order]
+    tp = jnp.cumsum(yw)
+    fp = jnp.cumsum(nw)
+    # group boundary = last element of a run of equal scores
+    is_boundary = jnp.concatenate([s[1:] != s[:-1], jnp.array([True])])
+    P = tp[-1]
+    N = fp[-1]
+    # trapezoid between consecutive boundaries (chord rule over tied runs):
+    # for each boundary, find the previous boundary via a prefix-max scan
+    idx = jnp.arange(s.shape[0])
+    idxf = jnp.where(is_boundary, idx, -1)
+    prevb = jax.lax.associative_scan(jnp.maximum, idxf)           # last boundary ≤ i
+    prevb = jnp.concatenate([jnp.array([-1]), prevb[:-1]])        # last boundary < i
+    has_prev = prevb >= 0
+    tp_prev = jnp.where(has_prev, tp[prevb], 0.0)
+    fp_prev = jnp.where(has_prev, fp[prevb], 0.0)
+    seg = jnp.where(is_boundary, (fp - fp_prev) * (tp + tp_prev) * 0.5, 0.0)
+    auc = seg.sum() / jnp.maximum(P * N, 1e-30)
+    # PR curve: step-wise interpolation on the recall axis at boundaries
+    prec = tp / jnp.maximum(tp + fp, 1e-30)
+    rec = tp / jnp.maximum(P, 1e-30)
+    rec_prev = tp_prev / jnp.maximum(P, 1e-30)
+    aucpr = jnp.where(is_boundary, (rec - rec_prev) * prec, 0.0).sum()
+    return order, tp, fp, is_boundary, auc, aucpr, P, N
+
+
+@jax.jit
+def _logloss_kernel(p, y, w):
+    eps = 1e-15
+    p = jnp.clip(p, eps, 1.0 - eps)
+    ll = -(w * (y * jnp.log(p) + (1.0 - y) * jnp.log1p(-p))).sum() / w.sum()
+    return ll
+
+
+@dataclass
+class ModelMetricsBinomial:
+    auc: float
+    aucpr: float
+    logloss: float
+    mse: float
+    rmse: float
+    gini: float
+    mean_per_class_error: float
+    r2: float
+    f1_threshold: float
+    max_f1: float
+    confusion_matrix: np.ndarray  # [[tn, fp], [fn, tp]] at max-F1 threshold
+    accuracy: float
+    nobs: int
+    thresholds_and_metric_scores: Optional[dict] = None
+
+    def to_dict(self) -> Dict:
+        return {"AUC": self.auc, "pr_auc": self.aucpr, "logloss": self.logloss,
+                "MSE": self.mse, "RMSE": self.rmse, "Gini": self.gini,
+                "mean_per_class_error": self.mean_per_class_error, "r2": self.r2,
+                "max_f1": self.max_f1, "f1_threshold": self.f1_threshold,
+                "cm": self.confusion_matrix.tolist(), "accuracy": self.accuracy,
+                "nobs": self.nobs}
+
+
+def make_binomial_metrics(prob, actual, weights=None) -> ModelMetricsBinomial:
+    """prob = P(class 1); actual ∈ {0,1}."""
+    prob = jnp.asarray(prob, dtype=jnp.float32)
+    y = jnp.asarray(actual, dtype=jnp.float32)
+    w = jnp.ones_like(y) if weights is None else jnp.asarray(weights, jnp.float32)
+    order, tp, fp, is_b, auc, aucpr, P, N = _binary_curve_kernel(prob, y, w)
+    auc = float(np.asarray(auc))
+    aucpr = float(np.asarray(aucpr))
+    ll = float(np.asarray(_logloss_kernel(prob, y, w)))
+    reg = _regression_kernel(prob, y, w)
+    mse = float(np.asarray(reg[0]))
+    r2 = float(np.asarray(reg[4]))
+    # host: max-F1 threshold + confusion matrix there
+    tp_h = np.asarray(tp); fp_h = np.asarray(fp); isb_h = np.asarray(is_b)
+    s_h = np.asarray(prob)[np.asarray(order)]
+    Pf = float(np.asarray(P)); Nf = float(np.asarray(N))
+    tpb = tp_h[isb_h]; fpb = fp_h[isb_h]; sb = s_h[isb_h]
+    fnb = Pf - tpb; tnb = Nf - fpb
+    prec = tpb / np.maximum(tpb + fpb, 1e-30)
+    rec = tpb / max(Pf, 1e-30)
+    f1 = 2 * prec * rec / np.maximum(prec + rec, 1e-30)
+    bi = int(np.argmax(f1))
+    cm = np.array([[tnb[bi], fpb[bi]], [fnb[bi], tpb[bi]]])
+    per_class_err = 0.5 * (fpb[bi] / max(Nf, 1e-30) + fnb[bi] / max(Pf, 1e-30))
+    acc = (tpb[bi] + tnb[bi]) / max(Pf + Nf, 1e-30)
+    return ModelMetricsBinomial(
+        auc=auc, aucpr=aucpr, logloss=ll, mse=mse, rmse=float(np.sqrt(mse)),
+        gini=2 * auc - 1, mean_per_class_error=float(per_class_err), r2=r2,
+        f1_threshold=float(sb[bi]), max_f1=float(f1[bi]), confusion_matrix=cm,
+        accuracy=float(acc), nobs=int(prob.shape[0]))
+
+
+# --------------------------------------------------------------- multinomial
+
+@jax.jit
+def _multinomial_kernel(probs, y, w):
+    eps = 1e-15
+    rows = probs.shape[0]
+    py = probs[jnp.arange(rows), y]
+    ll = -(w * jnp.log(jnp.clip(py, eps, 1.0))).sum() / w.sum()
+    pred = jnp.argmax(probs, axis=1)
+    err = (w * (pred != y)).sum() / w.sum()
+    K = probs.shape[1]
+    cm = jnp.zeros((K, K), dtype=jnp.float32).at[y, pred].add(w)
+    return ll, err, cm, pred
+
+
+@dataclass
+class ModelMetricsMultinomial:
+    logloss: float
+    mse: float
+    rmse: float
+    mean_per_class_error: float
+    error: float
+    confusion_matrix: np.ndarray
+    hit_ratios: np.ndarray
+    nobs: int
+
+    def to_dict(self) -> Dict:
+        return {"logloss": self.logloss, "MSE": self.mse, "RMSE": self.rmse,
+                "mean_per_class_error": self.mean_per_class_error,
+                "error": self.error, "cm": self.confusion_matrix.tolist(),
+                "hit_ratios": self.hit_ratios.tolist(), "nobs": self.nobs}
+
+
+def make_multinomial_metrics(probs, actual, weights=None) -> ModelMetricsMultinomial:
+    probs = jnp.asarray(probs, dtype=jnp.float32)
+    y = jnp.asarray(actual, dtype=jnp.int32)
+    w = (jnp.ones(probs.shape[0], jnp.float32) if weights is None
+         else jnp.asarray(weights, jnp.float32))
+    ll, err, cm, _ = _multinomial_kernel(probs, y, w)
+    cm = np.asarray(cm)
+    K = cm.shape[0]
+    row_tot = cm.sum(axis=1)
+    per_class = np.where(row_tot > 0, 1.0 - np.diag(cm) / np.maximum(row_tot, 1e-30), 0.0)
+    present = row_tot > 0
+    mpce = float(per_class[present].mean()) if present.any() else 0.0
+    # MSE on 1-vs-all probabilities (reference semantics: 1 - p_actual)
+    rows = probs.shape[0]
+    py = np.asarray(probs)[np.arange(rows), np.asarray(y)]
+    wh = np.asarray(w)
+    mse = float((wh * (1.0 - py) ** 2).sum() / wh.sum())
+    # hit ratio @k
+    ranks = np.asarray(jnp.argsort(-probs, axis=1))
+    hits = ranks == np.asarray(y)[:, None]
+    hr = np.cumsum(hits.mean(axis=0))[: min(K, 10)]
+    return ModelMetricsMultinomial(
+        logloss=float(np.asarray(ll)), mse=mse, rmse=float(np.sqrt(mse)),
+        mean_per_class_error=mpce, error=float(np.asarray(err)),
+        confusion_matrix=cm, hit_ratios=hr, nobs=int(probs.shape[0]))

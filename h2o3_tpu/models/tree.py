@@ -1,0 +1,238 @@
+"""Shared histogram-tree machinery (GBM / DRF / IF / XGBoost-compat).
+
+Reference: hex/tree/ — SharedTree.java:229 driver, per-level histogram
+MRTask (ScoreBuildHistogram2.java:121-301 two-stage private-then-merge
+accumulate), DHistogram (w,wY,wYY) bins merged up the reduce tree
+(DHistogram.java:432), split finding on the reduced histograms
+(DTree.java), CompressedTree storage.
+
+TPU re-design (SURVEY.md §7.3):
+- trees are complete binary arrays of static depth (XLA needs static
+  shapes): node k's children are 2k+1 / 2k+2; rows carry an int32 node id
+  and are re-routed by vectorized gathers each level — no mutable 'nids'
+  column;
+- per-level histograms come from the one-hot-matmul / scatter kernels in
+  ops/histogram.py, all-reduced over ICI ('data' axis psum) instead of the
+  MRTask tree / Rabit ring;
+- split finding = masked cumsum + argmax over [nodes, features, bins, 2
+  NA-directions] entirely on device (the reference scans bins per leaf on
+  the driver);
+- Newton (g, h) gains; NA gets a dedicated bin with learned direction
+  (DHistogram.wNA semantics).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30
+
+
+@dataclass(frozen=True)
+class TreeConfig:
+    max_depth: int
+    n_bins: int            # real bins B; NA bin index = B
+    n_features: int
+    min_rows: float = 10.0
+    min_split_improvement: float = 1e-5
+    reg_lambda: float = 0.0
+    hist_method: str = "auto"
+
+    @property
+    def n_nodes(self) -> int:
+        return 2 ** (self.max_depth + 1) - 1
+
+
+def _find_splits(hist, cfg: TreeConfig, col_mask):
+    """Best split per node from [N, F, B+1, 3] histograms.
+
+    Returns (gain, feat, bin, na_left, g_tot, h_tot, w_tot) per node."""
+    B = cfg.n_bins
+    lam = cfg.reg_lambda
+    g = hist[..., 0]
+    h = hist[..., 1]
+    w = hist[..., 2]
+    g_na, h_na, w_na = g[..., B], h[..., B], w[..., B]
+    cg = jnp.cumsum(g[..., :B], axis=-1)
+    ch = jnp.cumsum(h[..., :B], axis=-1)
+    cw = jnp.cumsum(w[..., :B], axis=-1)
+    g_tot = cg[..., -1] + g_na
+    h_tot = ch[..., -1] + h_na
+    w_tot = cw[..., -1] + w_na
+    # candidate split t in 1..B-1: left = bins < t (+ NA if na_left)
+    gl0, hl0, wl0 = cg[..., :-1], ch[..., :-1], cw[..., :-1]
+
+    def gains(gl, hl, wl):
+        gr = g_tot[..., None] - gl
+        hr = h_tot[..., None] - hl
+        wr = w_tot[..., None] - wl
+        parent = g_tot ** 2 / (h_tot + lam + 1e-12)
+        gain = (gl ** 2 / (hl + lam + 1e-12) + gr ** 2 / (hr + lam + 1e-12)
+                - parent[..., None])
+        ok = (wl >= cfg.min_rows) & (wr >= cfg.min_rows)
+        return jnp.where(ok, gain, NEG_INF)
+
+    gains_nr = gains(gl0, hl0, wl0)                                  # NA right
+    gains_nl = gains(gl0 + g_na[..., None], hl0 + h_na[..., None],
+                     wl0 + w_na[..., None])                          # NA left
+    all_gains = jnp.stack([gains_nr, gains_nl], axis=-1)             # [N,F,B-1,2]
+    all_gains = jnp.where(col_mask[None, :, None, None], all_gains, NEG_INF)
+    N, F = all_gains.shape[0], all_gains.shape[1]
+    flat = all_gains.reshape(N, -1)
+    best = jnp.argmax(flat, axis=1)
+    best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+    per_f = (B - 1) * 2
+    feat = best // per_f
+    rem = best % per_f
+    bin_idx = rem // 2 + 1          # split t in 1..B-1
+    na_left = (rem % 2) == 1
+    # f=0 slice of per-feature totals == node totals
+    return (best_gain, feat.astype(jnp.int32), bin_idx.astype(jnp.int32),
+            na_left, g_tot[:, 0], h_tot[:, 0], w_tot[:, 0])
+
+
+def grow_tree(codes, g, h, w, cfg: TreeConfig, col_mask, axis_name=None):
+    """Build one tree. All args are device arrays (codes [rows,F] int,
+    g/h/w [rows] float32, already weight-multiplied); returns tree arrays
+    of length M = 2^(D+1)-1 plus per-row final node ids.
+
+    Runs under jit; the level loop is unrolled (static depth). Under plain
+    jit on sharded inputs GSPMD inserts the histogram all-reduce; under
+    shard_map pass ``axis_name='data'`` for explicit psums (this is the
+    Rabit-allreduce replacement point)."""
+    from h2o3_tpu.ops.histogram import build_histograms
+
+    D = cfg.max_depth
+    M = cfg.n_nodes
+    B1 = cfg.n_bins + 1
+    rows, F = codes.shape
+
+    feat = jnp.full(M, -1, jnp.int32)
+    split_bin = jnp.zeros(M, jnp.int32)
+    na_left = jnp.zeros(M, bool)
+    is_split = jnp.zeros(M, bool)
+    value = jnp.zeros(M, jnp.float32)
+    gain_arr = jnp.zeros(M, jnp.float32)
+    node_w = jnp.zeros(M, jnp.float32)
+
+    nid = jnp.zeros(rows, jnp.int32)
+    for d in range(D):
+        base = 2 ** d - 1
+        N = 2 ** d
+        local = nid - base
+        in_level = (local >= 0) & (local < N)
+        lw = jnp.where(in_level, w, 0.0)
+        lg = jnp.where(in_level, g, 0.0)
+        lh = jnp.where(in_level, h, 0.0)
+        lid = jnp.clip(local, 0, N - 1)
+        hist = build_histograms(codes, lid, lg, lh, lw, N, B1, cfg.hist_method)
+        if axis_name is not None:
+            hist = jax.lax.psum(hist, axis_name)
+        bg, bf, bb, bnl, gt, ht, wt = _find_splits(hist, cfg, col_mask)
+        can = (bg > jnp.maximum(cfg.min_split_improvement, 0.0)) & (wt > 0)
+        idx = base + jnp.arange(N)
+        feat = feat.at[idx].set(jnp.where(can, bf, -1))
+        split_bin = split_bin.at[idx].set(bb)
+        na_left = na_left.at[idx].set(bnl)
+        is_split = is_split.at[idx].set(can)
+        value = value.at[idx].set(-gt / (ht + cfg.reg_lambda + 1e-12))
+        gain_arr = gain_arr.at[idx].set(jnp.where(can, bg, 0.0))
+        node_w = node_w.at[idx].set(wt)
+        # route rows: only rows whose current node is at this level AND split
+        node_feat = bf[lid]
+        node_bin = bb[lid]
+        node_nal = bnl[lid]
+        node_can = can[lid]
+        c = jnp.take_along_axis(codes, node_feat[:, None].astype(jnp.int32),
+                                axis=1)[:, 0].astype(jnp.int32)
+        is_na = c == cfg.n_bins
+        go_right = jnp.where(is_na, ~node_nal, c >= node_bin)
+        child = 2 * nid + 1 + go_right.astype(jnp.int32)
+        nid = jnp.where(in_level & node_can, child, nid)
+
+    # deepest level: leaf values from segment totals (scatter — once/tree)
+    baseD = 2 ** D - 1
+    localD = nid - baseD
+    inD = (localD >= 0) & (localD < 2 ** D)
+    lidD = jnp.clip(localD, 0, 2 ** D - 1)
+    gD = jnp.zeros(2 ** D, jnp.float32).at[lidD].add(jnp.where(inD, g, 0.0))
+    hD = jnp.zeros(2 ** D, jnp.float32).at[lidD].add(jnp.where(inD, h, 0.0))
+    wD = jnp.zeros(2 ** D, jnp.float32).at[lidD].add(jnp.where(inD, w, 0.0))
+    if axis_name is not None:
+        gD = jax.lax.psum(gD, axis_name)
+        hD = jax.lax.psum(hD, axis_name)
+        wD = jax.lax.psum(wD, axis_name)
+    idxD = baseD + jnp.arange(2 ** D)
+    value = value.at[idxD].set(-gD / (hD + cfg.reg_lambda + 1e-12))
+    node_w = node_w.at[idxD].set(wD)
+
+    tree = {"feat": feat, "split_bin": split_bin, "na_left": na_left,
+            "is_split": is_split, "value": value, "gain": gain_arr,
+            "node_w": node_w}
+    return tree, nid
+
+
+def predict_binned(codes, tree, max_depth: int, na_bin: int):
+    """Training-time prediction on the binned matrix (leaf lookup)."""
+    rows = codes.shape[0]
+    nid = jnp.zeros(rows, jnp.int32)
+    for _ in range(max_depth):
+        f = tree["feat"][nid]
+        s = tree["is_split"][nid]
+        b = tree["split_bin"][nid]
+        nl = tree["na_left"][nid]
+        c = jnp.take_along_axis(codes, jnp.maximum(f, 0)[:, None], axis=1)[:, 0]
+        c = c.astype(jnp.int32)
+        is_na = c == na_bin
+        go_right = jnp.where(is_na, ~nl, c >= b)
+        nid = jnp.where(s, 2 * nid + 1 + go_right.astype(jnp.int32), nid)
+    return tree["value"][nid], nid
+
+
+def predict_raw_stacked(X, feat, thr, na_left, is_split, value, max_depth: int):
+    """Scoring-time prediction on raw features for a stack of T trees.
+
+    feat/thr/... are [T, M]; X is [rows, F] float32 with NaN=NA.
+    Returns [rows, T] per-tree contributions; caller sums/weights.
+    The descent is T*D gathers — the score0 analog (hex/Model.java:2304,
+    GBM: walk CompressedTrees) vectorized over rows and trees."""
+    rows = X.shape[0]
+
+    def one_tree(carry, t):
+        nid = jnp.zeros(rows, jnp.int32)
+        for _ in range(max_depth):
+            f = feat[t][nid]
+            s = is_split[t][nid]
+            th = thr[t][nid]
+            nl = na_left[t][nid]
+            x = jnp.take_along_axis(X, jnp.maximum(f, 0)[:, None], axis=1)[:, 0]
+            go_right = jnp.where(jnp.isnan(x), ~nl, x >= th)
+            nid = jnp.where(s, 2 * nid + 1 + go_right.astype(jnp.int32), nid)
+        return carry, value[t][nid]
+
+    _, contribs = jax.lax.scan(one_tree, None, jnp.arange(feat.shape[0]))
+    return contribs.T  # [rows, T]
+
+
+def bins_to_thresholds(tree_split_bin: np.ndarray, tree_feat: np.ndarray,
+                       edges: List[np.ndarray]) -> np.ndarray:
+    """Convert bin-space splits to raw-value thresholds for scoring:
+    left ⇔ code < t ⇔ raw < edges[feat][t-1]."""
+    M = tree_split_bin.shape[0]
+    thr = np.zeros(M, dtype=np.float32)
+    for m in range(M):
+        f = tree_feat[m]
+        if f < 0:
+            continue
+        e = edges[f]
+        t = tree_split_bin[m]
+        if len(e) == 0:
+            thr[m] = np.inf
+        else:
+            thr[m] = e[min(t - 1, len(e) - 1)]
+    return thr

@@ -1,0 +1,127 @@
+"""Global quantile binning — feature values → small int bin codes.
+
+Reference: the tree algos bin features per-node with DHistogram
+(hex/tree/DHistogram.java:48; QuantilesGlobal/UniformAdaptive histogram
+types in GBM), and the vendored XGBoost's ``tree_method=hist`` builds a
+global quantile sketch once. The TPU design follows the global-sketch
+shape: one pass computes per-feature quantile edges, a second digitises
+every value into a uint8/int16 code. All later tree work touches only the
+code matrix — int codes stream through HBM at 1-2 bytes/value and feed the
+MXU one-hot histogram kernel (SURVEY.md §7.3).
+
+Layout: codes[rows, F] with values in [0, n_bins_f); the NA bin is a
+dedicated last index ``n_bins`` shared across features (uniform shape for
+XLA). Split "bin t" means: left ⇔ code < t ⇔ raw < edges[t-1].
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class BinnedMatrix:
+    codes: jax.Array           # [padded_rows, F] int dtype; NA bin = n_bins
+    n_bins: int                # bins per feature excluding the NA bin
+    edges: List[np.ndarray]    # per-feature raw-value split edges (len <= n_bins-1)
+    names: List[str]
+    is_categorical: List[bool]
+    nrow: int
+
+    @property
+    def n_features(self) -> int:
+        return self.codes.shape[1]
+
+    @property
+    def na_bin(self) -> int:
+        return self.n_bins
+
+
+def quantile_edges(col: np.ndarray, nbins: int) -> np.ndarray:
+    """Unique quantile cut points for one numeric feature (host-side; the
+    sketch is O(sample) — full exact quantiles are fine at these scales)."""
+    vals = col[np.isfinite(col)]
+    if vals.size == 0:
+        return np.empty(0, dtype=np.float32)
+    qs = np.quantile(vals, np.linspace(0.0, 1.0, nbins + 1)[1:-1])
+    return np.unique(qs.astype(np.float32))
+
+
+def uniform_edges(col: np.ndarray, nbins: int) -> np.ndarray:
+    """Equal-width cut points (histogram_type='uniform_adaptive' analog:
+    the reference re-adapts ranges per tree level; a global uniform grid is
+    the static-shape equivalent)."""
+    vals = col[np.isfinite(col)]
+    if vals.size == 0:
+        return np.empty(0, dtype=np.float32)
+    lo, hi = float(vals.min()), float(vals.max())
+    if lo == hi:
+        return np.empty(0, dtype=np.float32)
+    return np.linspace(lo, hi, nbins + 1)[1:-1].astype(np.float32)
+
+
+def bin_matrix(X, names: Sequence[str], is_cat: Sequence[bool], nrow: int,
+               nbins: int = 255, nbins_cats: int = 1024,
+               histogram_type: str = "quantiles_global") -> BinnedMatrix:
+    """Digitise a dense [padded_rows, F] float matrix (NaN = NA) into codes.
+
+    Categorical columns with cardinality <= nbins use identity binning
+    (code = category id), mirroring nbins_cats group-per-category splits
+    (hex/tree/DHistogram nbins_cats); larger cardinalities fall back to
+    quantile grouping of the code space.
+    """
+    X_host = np.asarray(X, dtype=np.float32)
+    F = X_host.shape[1]
+    edge_fn = (uniform_edges if histogram_type in ("uniform_adaptive", "uniform")
+               else quantile_edges)
+    edges: List[np.ndarray] = []
+    for f in range(F):
+        col = X_host[:nrow, f]
+        if is_cat[f]:
+            card = int(np.nanmax(col)) + 1 if np.isfinite(col).any() else 1
+            if card <= nbins:
+                e = (np.arange(1, card, dtype=np.float32) - 0.5)
+            else:
+                e = quantile_edges(col, nbins)
+        else:
+            e = edge_fn(col, nbins)
+        edges.append(e[: nbins - 1])
+    codes = digitize_with_edges(X, edges, nbins)
+    return BinnedMatrix(codes=codes, n_bins=nbins, edges=edges, names=list(names),
+                        is_categorical=list(is_cat), nrow=nrow)
+
+
+@jax.jit
+def _searchsorted_cols(emat, x):
+    # vmap over features: edges [F, E], x [rows, F] → codes [rows, F]
+    return jax.vmap(lambda e, c: jnp.searchsorted(e, c, side="right"),
+                    in_axes=(0, 1), out_axes=1)(emat, x)
+
+
+def _digitize(x, emat, nbins, dtype):
+    codes = _searchsorted_cols(emat, x)
+    codes = jnp.where(jnp.isnan(x), nbins, codes)
+    return codes.astype(dtype)
+
+
+def digitize_with_edges(X, edges: List[np.ndarray], nbins: int) -> jax.Array:
+    """Digitise a new matrix with previously-computed edges (validation /
+    scoring frames share the training sketch, like XGBoost's global hist)."""
+    F = len(edges)
+    max_e = max((len(e) for e in edges), default=0)
+    emat = np.full((F, max(max_e, 1)), np.inf, dtype=np.float32)
+    for f, e in enumerate(edges):
+        emat[f, : len(e)] = e
+    dtype = jnp.uint8 if nbins < 256 else jnp.int32
+    return _digitize(jnp.asarray(X, dtype=jnp.float32), jnp.asarray(emat),
+                     nbins, dtype)
+
+
+def split_threshold(bm: BinnedMatrix, feature: int, bin_idx: int) -> float:
+    """Raw-value threshold for 'left ⇔ code < bin_idx'."""
+    e = bm.edges[feature]
+    return float(e[min(bin_idx, len(e)) - 1])

@@ -1,0 +1,90 @@
+"""Per-(node, feature, bin) gradient histograms — the make-or-break kernel.
+
+Reference hot loop: hex/tree/ScoreBuildHistogram2.java:121-301 — per row,
+look up the row's leaf, then for every column bump (w, wY, wYY) in a
+thread-private DHistogram bin array; private copies merge node-locally,
+then elementwise-add up the MRTask reduce tree (DHistogram.java:432).
+XGBoost's gpu_hist does the same with atomics + a Rabit allreduce.
+
+TPUs have no fast random scatter, so the TPU-native formulation is a
+matmul: one-hot encode each row's (node, bin) pair and contract with the
+per-row (g, h, w) on the MXU — ``hist = onehot^T @ ghw`` per feature
+(SURVEY.md §7.3 angle). Cross-device reduction is a single ``psum`` over
+the 'data' mesh axis (replacing the serialize-and-merge tree / Rabit ring).
+
+Two code paths:
+- 'matmul'  — lax.scan over features of a [rows, n_nodes*(B+1)] one-hot
+  matmul; MXU-bound, the TPU default;
+- 'scatter' — XLA scatter-add; wins on CPU and for very small shapes.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.parallel.mesh import DATA_AXIS
+
+
+def _hist_scatter(codes, node_ids, g, h, w, n_nodes, n_bins1):
+    """[n_nodes, F, B+1, 3] via scatter-add."""
+    rows, F = codes.shape
+    flat = (node_ids[:, None] * F + jnp.arange(F)[None, :]) * n_bins1 + codes
+    out = jnp.zeros((n_nodes * F * n_bins1, 3), dtype=jnp.float32)
+    out = out.at[flat, 0].add(g[:, None])
+    out = out.at[flat, 1].add(h[:, None])
+    out = out.at[flat, 2].add(w[:, None])
+    return out.reshape(n_nodes, F, n_bins1, 3)
+
+
+def _hist_matmul(codes, node_ids, g, h, w, n_nodes, n_bins1):
+    """[n_nodes, F, B+1, 3] via one-hot matmul on the MXU."""
+    rows, F = codes.shape
+    ghw = jnp.stack([g, h, w], axis=1)  # [rows, 3]
+    base = node_ids * n_bins1           # [rows]
+    nb = n_nodes * n_bins1
+
+    def one_feature(_, f):
+        idx = base + codes[:, f]
+        onehot = (idx[:, None] == jnp.arange(nb)[None, :]).astype(jnp.float32)
+        part = jax.lax.dot_general(
+            onehot, ghw, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [nb, 3]
+        return _, part
+
+    _, hists = jax.lax.scan(one_feature, None, jnp.arange(F))
+    # hists: [F, nb, 3] → [n_nodes, F, B+1, 3]
+    return hists.reshape(F, n_nodes, n_bins1, 3).transpose(1, 0, 2, 3)
+
+
+def build_histograms(codes, node_ids, g, h, w, n_nodes: int, n_bins1: int,
+                     method: str = "auto"):
+    """Local (per-shard or single-device) histogram build. Caller is
+    responsible for the cross-device psum when run under shard_map."""
+    if method == "auto":
+        method = "matmul" if jax.default_backend() == "tpu" else "scatter"
+    fn = _hist_matmul if method == "matmul" else _hist_scatter
+    return fn(codes, node_ids.astype(jnp.int32), g, h, w, n_nodes, n_bins1)
+
+
+def build_histograms_sharded(codes, node_ids, g, h, w, n_nodes: int,
+                             n_bins1: int, mesh, method: str = "auto"):
+    """Distributed histogram: per-shard build + ICI all-reduce.
+
+    This is the TPU replacement for XGBoost's Rabit histogram allreduce
+    (hex/tree/xgboost/rabit/RabitTrackerH2O.java bootstraps the ring; here
+    it's one lax.psum over the 'data' axis).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def local(c, nid, gg, hh, ww):
+        hist = build_histograms(c, nid, gg, hh, ww, n_nodes, n_bins1, method)
+        return jax.lax.psum(hist, DATA_AXIS)
+
+    f = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P())
+    return f(codes, node_ids, g, h, w)

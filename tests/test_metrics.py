@@ -1,0 +1,67 @@
+"""Metrics golden tests vs sklearn (the reference asserts metric bounds in
+h2o-test-accuracy; we can be tighter: exact cross-checks)."""
+import numpy as np
+import pytest
+from sklearn import metrics as skm
+
+from h2o3_tpu.models.metrics import (make_binomial_metrics,
+                                     make_multinomial_metrics,
+                                     make_regression_metrics)
+
+
+def test_regression_metrics_match_sklearn():
+    rng = np.random.default_rng(0)
+    y = rng.normal(10, 3, 2000)
+    p = y + rng.normal(0, 1, 2000)
+    m = make_regression_metrics(p, y)
+    assert m.mse == pytest.approx(skm.mean_squared_error(y, p), rel=1e-4)
+    assert m.mae == pytest.approx(skm.mean_absolute_error(y, p), rel=1e-4)
+    assert m.r2 == pytest.approx(skm.r2_score(y, p), rel=1e-3)
+
+
+def test_auc_matches_sklearn_with_ties():
+    rng = np.random.default_rng(1)
+    y = (rng.random(5000) < 0.3).astype(float)
+    # coarse scores → many ties
+    p = np.round(rng.random(5000) * 0.5 + y * 0.3, 2)
+    m = make_binomial_metrics(p, y)
+    assert m.auc == pytest.approx(skm.roc_auc_score(y, p), abs=1e-5)
+    assert m.logloss == pytest.approx(skm.log_loss(y, np.clip(p, 1e-15, 1 - 1e-15)),
+                                      rel=1e-4)
+    assert m.gini == pytest.approx(2 * m.auc - 1)
+
+
+def test_auc_weighted():
+    rng = np.random.default_rng(2)
+    y = (rng.random(1000) < 0.4).astype(float)
+    p = rng.random(1000)
+    w = rng.integers(1, 5, 1000).astype(float)
+    m = make_binomial_metrics(p, y, w)
+    assert m.auc == pytest.approx(skm.roc_auc_score(y, p, sample_weight=w), abs=1e-5)
+
+
+def test_binomial_confusion_and_f1():
+    y = np.array([0, 0, 1, 1, 1, 0, 1, 0])
+    p = np.array([0.1, 0.4, 0.35, 0.8, 0.9, 0.2, 0.7, 0.6])
+    m = make_binomial_metrics(p, y)
+    # best F1 threshold must reproduce sklearn's best over the PR curve
+    prec, rec, thr = skm.precision_recall_curve(y, p)
+    f1 = 2 * prec * rec / np.maximum(prec + rec, 1e-30)
+    assert m.max_f1 == pytest.approx(np.nanmax(f1), abs=1e-6)
+    tn, fp, fn, tp = m.confusion_matrix.ravel()
+    assert tn + fp + fn + tp == 8
+
+
+def test_multinomial_metrics():
+    rng = np.random.default_rng(3)
+    K, n = 4, 3000
+    y = rng.integers(0, K, n)
+    logits = rng.normal(0, 1, (n, K))
+    logits[np.arange(n), y] += 1.5
+    p = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+    m = make_multinomial_metrics(p, y)
+    assert m.logloss == pytest.approx(skm.log_loss(y, p), rel=1e-4)
+    assert m.error == pytest.approx(1 - skm.accuracy_score(y, p.argmax(1)), abs=1e-6)
+    np.testing.assert_allclose(m.confusion_matrix,
+                               skm.confusion_matrix(y, p.argmax(1)), atol=0.5)
+    assert m.hit_ratios[0] == pytest.approx(skm.accuracy_score(y, p.argmax(1)), abs=1e-6)
