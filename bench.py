@@ -1,0 +1,83 @@
+"""Headline benchmark: GBM/XGBoost-hist training throughput, rows/sec/chip.
+
+North star (BASELINE.json): HIGGS-shaped binomial boosting — the reference
+runs it through xgboost4j's gpu_hist (C++/CUDA + Rabit); here it's the JAX
+histogram tree builder on one TPU chip. Throughput = rows × trees / boost
+loop seconds (setup/binning excluded, matching how xgboost benchmarks
+count ingest separately).
+
+vs_baseline divides by a nominal A100 gpu_hist figure on the same shape
+(~25M rows/sec — published gpu_hist HIGGS numbers land around 20-30M
+rows·trees/sec); BASELINE.md records that the reference publishes no
+in-tree number, so this constant is the stand-in until a measured A100
+run replaces it.
+
+Prints exactly one JSON line on stdout.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+ROWS = int(os.environ.get("H2O3_BENCH_ROWS", 1_000_000))
+TREES = int(os.environ.get("H2O3_BENCH_TREES", 20))
+DEPTH = int(os.environ.get("H2O3_BENCH_DEPTH", 6))
+NBINS = int(os.environ.get("H2O3_BENCH_NBINS", 254))
+A100_GPU_HIST_ROWS_PER_SEC = 25e6
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    import jax
+
+    log(f"devices: {jax.devices()}  backend: {jax.default_backend()}")
+    rng = np.random.default_rng(42)
+    F = 28  # HIGGS feature count
+    X = rng.normal(size=(ROWS, F)).astype(np.float32)
+    logit = (X[:, 0] * 1.5 - X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
+             + 0.3 * np.sin(3 * X[:, 4]))
+    y = (rng.random(ROWS) < 1.0 / (1.0 + np.exp(-logit))).astype(np.int32)
+    cols = {f"f{i}": X[:, i] for i in range(F)}
+    cols["label"] = y.astype(np.float32)
+    fr = h2o.Frame.from_numpy(cols)
+    log(f"frame: {ROWS}x{F + 1}")
+
+    common = dict(max_depth=DEPTH, learn_rate=0.1, nbins=NBINS,
+                  distribution="bernoulli", seed=7, score_tree_interval=0,
+                  stopping_rounds=0, min_rows=1.0)
+    # warmup: compile the chunked tree scan at the exact shapes/chunk the
+    # measured run uses (chunk length is a static scan parameter)
+    warm = H2OGradientBoostingEstimator(ntrees=TREES, **common)
+    warm.train(y="label", training_frame=fr)
+    log(f"warmup done; warm loop {warm.model.output['training_loop_seconds']:.2f}s")
+
+    gbm = H2OGradientBoostingEstimator(ntrees=TREES, **common)
+    t0 = time.time()
+    gbm.train(y="label", training_frame=fr)
+    total = time.time() - t0
+    loop_s = gbm.model.output["training_loop_seconds"]
+    built = gbm.model.ntrees_built
+    rows_per_sec = ROWS * built / loop_s
+    auc = gbm.model.training_metrics.auc
+    log(f"trees={built} loop={loop_s:.2f}s total={total:.2f}s "
+        f"rows/sec/chip={rows_per_sec:,.0f} AUC={auc:.4f}")
+
+    print(json.dumps({
+        "metric": "gbm_hist_training_throughput",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/sec/chip",
+        "vs_baseline": round(rows_per_sec / A100_GPU_HIST_ROWS_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

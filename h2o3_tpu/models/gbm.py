@@ -26,7 +26,7 @@ from h2o3_tpu.models.model_base import (Model, ModelBuilder, ScoreKeeper,
                                         TrainingSpec, compute_metrics)
 from h2o3_tpu.models.tree import (TreeConfig, bins_to_thresholds, grow_tree,
                                   predict_binned, predict_raw_stacked)
-from h2o3_tpu.ops.binning import bin_matrix, digitize_with_edges
+from h2o3_tpu.ops.binning import bin_matrix, digitize_with_edges, make_codes_view
 
 GBM_DEFAULTS: Dict = dict(
     ntrees=50, max_depth=5, min_rows=10.0, learn_rate=0.1,
@@ -98,41 +98,66 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         merged.update(params)
         super().__init__(**merged)
 
-    # -- the per-tree jitted step --------------------------------------
+    # -- the chunked jitted training step ------------------------------
+    #
+    # ``chunk`` trees are built inside ONE jit via lax.scan: per-call
+    # dispatch overhead (which dominates through remote relays) amortises,
+    # and margins/trees stay on device between trees. The reference
+    # dispatches one MRTask per level per tree (SharedTree.java:566-635) —
+    # here a whole chunk of trees is a single XLA program.
 
     @staticmethod
     @partial(jax.jit, static_argnames=("cfg", "K", "dist_name", "tweedie_power",
-                                       "sample_rate", "col_rate", "na_bin"))
-    def _tree_step(codes, margin, y, w, key, lr, cfg, K, dist_name,
-                   tweedie_power, sample_rate, col_rate, na_bin):
+                                       "sample_rate", "col_rate", "na_bin",
+                                       "chunk", "anneal", "has_valid"))
+    def _train_chunk(codes, margin, y, w, vcodes, vmargin, base_key, lr0,
+                     start_idx, cfg, K, dist_name, tweedie_power,
+                     sample_rate, col_rate, na_bin, chunk, anneal, has_valid):
         F = codes.shape[1]
-        key_r, key_c = jax.random.split(key)
-        wt = w
-        if sample_rate < 1.0:
-            wt = w * (jax.random.uniform(key_r, w.shape) < sample_rate)
-        col_mask = jnp.ones(F, bool)
-        if col_rate < 1.0:
-            col_mask = jax.random.uniform(key_c, (F,)) < col_rate
-        trees = []
-        if K == 1:
-            dist = get_distribution(dist_name, tweedie_power)
-            g, h = dist.grad_hess(margin, y)
-            tree, _ = grow_tree(codes, g * wt, h * wt, wt, cfg, col_mask)
-            contrib, _ = predict_binned(codes, tree, cfg.max_depth, na_bin)
-            margin = margin + lr * contrib
-            trees.append(tree)
-        else:
-            p = jax.nn.softmax(margin, axis=1)
-            for k in range(K):
-                yk = (y == k).astype(jnp.float32)
-                gk = (p[:, k] - yk)
-                hk = jnp.maximum(p[:, k] * (1.0 - p[:, k]), 1e-9)
-                tree, _ = grow_tree(codes, gk * wt, hk * wt, wt, cfg, col_mask)
-                contrib, _ = predict_binned(codes, tree, cfg.max_depth, na_bin)
-                margin = margin.at[:, k].add(lr * contrib)
+
+        def one_tree(carry, i):
+            margin, vmargin, lr = carry
+            key = jax.random.fold_in(base_key, start_idx + i)
+            key_r, key_c = jax.random.split(key)
+            wt = w
+            if sample_rate < 1.0:
+                wt = w * (jax.random.uniform(key_r, w.shape) < sample_rate)
+            col_mask = jnp.ones(F, bool)
+            if col_rate < 1.0:
+                col_mask = jax.random.uniform(key_c, (F,)) < col_rate
+            trees = []
+            if K == 1:
+                dist = get_distribution(dist_name, tweedie_power)
+                g, h = dist.grad_hess(margin, y)
+                tree, nid = grow_tree(codes, g * wt, h * wt, wt, cfg, col_mask)
+                # grow_tree already routed every row to its leaf — reuse
+                # nid instead of re-walking the tree (saves ~250ms/tree@1M)
+                margin = margin + lr * tree["value"][nid]
+                if has_valid:
+                    vc, _ = predict_binned(vcodes, tree, cfg.max_depth, na_bin)
+                    vmargin = vmargin + lr * vc
                 trees.append(tree)
-        stacked = {kk: jnp.stack([t[kk] for t in trees]) for kk in trees[0]}
-        return margin, stacked
+            else:
+                p = jax.nn.softmax(margin, axis=1)
+                for k in range(K):
+                    yk = (y == k).astype(jnp.float32)
+                    gk = (p[:, k] - yk)
+                    hk = jnp.maximum(p[:, k] * (1.0 - p[:, k]), 1e-9)
+                    tree, nid = grow_tree(codes, gk * wt, hk * wt, wt, cfg,
+                                          col_mask)
+                    margin = margin.at[:, k].add(lr * tree["value"][nid])
+                    if has_valid:
+                        vc, _ = predict_binned(vcodes, tree, cfg.max_depth,
+                                               na_bin)
+                        vmargin = vmargin.at[:, k].add(lr * vc)
+                    trees.append(tree)
+            stacked = {kk: jnp.stack([t[kk] for t in trees])
+                       for kk in trees[0]}
+            return (margin, vmargin, lr * anneal), stacked
+
+        (margin, vmargin, _), chunk_trees = jax.lax.scan(
+            one_tree, (margin, vmargin, lr0), jnp.arange(chunk))
+        return margin, vmargin, chunk_trees
 
     # -- driver ---------------------------------------------------------
 
@@ -185,44 +210,51 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                              p.get("stopping_tolerance", 1e-3), task)
         interval = max(int(p.get("score_tree_interval", 5) or 5), 1)
         # validation margin tracked with train edges
-        vcodes = None
-        if valid_spec is not None:
-            vcodes = digitize_with_edges(valid_spec.X, bm.edges, bm.n_bins)
+        has_valid = valid_spec is not None
+        if has_valid:
+            vcodes = make_codes_view(
+                digitize_with_edges(valid_spec.X, bm.edges, bm.n_bins))
             vmargin = (jnp.full(valid_spec.X.shape[0], f0, jnp.float32) if K == 1
                        else jnp.broadcast_to(f0, (valid_spec.X.shape[0], K)).astype(jnp.float32))
+        else:  # small dummies (untraced branches, but args need shapes)
+            vcodes = make_codes_view(jnp.zeros((8, bm.n_features),
+                                               bm.codes.dtype))
+            vmargin = (jnp.zeros(8, jnp.float32) if K == 1
+                       else jnp.zeros((8, K), jnp.float32))
 
+        chunk = interval if keeper.rounds > 0 else min(ntrees, 50)
         all_trees = []
         built = 0
-        for t in range(ntrees):
-            key, sub = jax.random.split(key)
-            margin, stacked = self._tree_step(
-                bm.codes, margin, yf, w, sub, jnp.float32(lr), cfg, K,
-                dist_name, float(p["tweedie_power"]),
-                float(p["sample_rate"]), col_rate, bm.na_bin)
-            all_trees.append(jax.device_get(stacked))
-            if vcodes is not None:
-                for k in range(K if K > 1 else 1):
-                    tr_k = {kk: jnp.asarray(stacked[kk][k]) for kk in stacked}
-                    c, _ = predict_binned(vcodes, tr_k, cfg.max_depth, bm.na_bin)
-                    vmargin = (vmargin + lr * c if K == 1
-                               else vmargin.at[:, k].add(lr * c))
-            built += 1
-            lr *= anneal
+        jax.block_until_ready(margin)
+        t_loop0 = time.time()
+        while built < ntrees:
+            c = min(chunk, ntrees - built)
+            margin, vmargin, chunk_trees = self._train_chunk(
+                bm.codes, margin, yf, w, vcodes, vmargin, key,
+                jnp.float32(lr), built, cfg, K, dist_name,
+                float(p["tweedie_power"]), float(p["sample_rate"]), col_rate,
+                bm.na_bin, c, anneal, has_valid)
+            all_trees.append(chunk_trees)  # stays on device until finalize
+            built += c
+            lr *= anneal ** c
             job.set_progress(0.5 * built / ntrees)
             if job.cancel_requested:
                 break
-            if keeper.rounds > 0 and built % interval == 0:
-                sc_spec = valid_spec if valid_spec is not None else spec
-                sc_margin = vmargin if vcodes is not None else margin
+            if keeper.rounds > 0:
+                sc_spec = valid_spec if has_valid else spec
+                sc_margin = vmargin if has_valid else margin
                 entry = self._score_entry(sc_margin, sc_spec, dist, K, built,
                                           want_auc=keeper.metric == "auc")
                 keeper.record(entry)
                 if keeper.should_stop():
                     break
 
+        jax.block_until_ready(margin)
+        t_loop = time.time() - t_loop0
         model = self._finalize(spec, valid_spec, dist_name, f0, all_trees, bm,
                                cfg, K, built, margin,
-                               vmargin if vcodes is not None else None, keeper)
+                               vmargin if has_valid else None, keeper)
+        model.output["training_loop_seconds"] = t_loop
         return model
 
     def _score_entry(self, margin, sc_spec, dist, K, built,
@@ -254,12 +286,14 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                   K, built, margin, vmargin, keeper) -> GBMModel:
         M = cfg.n_nodes
         T = built * max(K, 1)
-        feat = np.concatenate([t["feat"].reshape(-1, M) for t in all_trees])
-        sbin = np.concatenate([t["split_bin"].reshape(-1, M) for t in all_trees])
-        nal = np.concatenate([t["na_left"].reshape(-1, M) for t in all_trees])
-        spl = np.concatenate([t["is_split"].reshape(-1, M) for t in all_trees])
-        val = np.concatenate([t["value"].reshape(-1, M) for t in all_trees])
-        gains = np.concatenate([t["gain"].reshape(-1, M) for t in all_trees])
+        host = [{k: np.asarray(jax.device_get(v)) for k, v in t.items()}
+                for t in all_trees]
+        feat = np.concatenate([t["feat"].reshape(-1, M) for t in host])
+        sbin = np.concatenate([t["split_bin"].reshape(-1, M) for t in host])
+        nal = np.concatenate([t["na_left"].reshape(-1, M) for t in host])
+        spl = np.concatenate([t["is_split"].reshape(-1, M) for t in host])
+        val = np.concatenate([t["value"].reshape(-1, M) for t in host])
+        gains = np.concatenate([t["gain"].reshape(-1, M) for t in host])
         lr0 = float(self.params["learn_rate"])
         anneal = float(self.params["learn_rate_annealing"])
         lrs = lr0 * anneal ** np.repeat(np.arange(built), max(K, 1))

@@ -105,12 +105,14 @@ def grow_tree(codes, g, h, w, cfg: TreeConfig, col_mask, axis_name=None):
     jit on sharded inputs GSPMD inserts the histogram all-reduce; under
     shard_map pass ``axis_name='data'`` for explicit psums (this is the
     Rabit-allreduce replacement point)."""
+    from h2o3_tpu.ops.binning import CodesView
     from h2o3_tpu.ops.histogram import build_histograms
 
+    rm = codes.rm if isinstance(codes, CodesView) else codes
     D = cfg.max_depth
     M = cfg.n_nodes
     B1 = cfg.n_bins + 1
-    rows, F = codes.shape
+    rows, F = rm.shape
 
     feat = jnp.full(M, -1, jnp.int32)
     split_bin = jnp.zeros(M, jnp.int32)
@@ -121,18 +123,40 @@ def grow_tree(codes, g, h, w, cfg: TreeConfig, col_mask, axis_name=None):
     node_w = jnp.zeros(M, jnp.float32)
 
     nid = jnp.zeros(rows, jnp.int32)
+    prev_hist = None
     for d in range(D):
         base = 2 ** d - 1
         N = 2 ** d
         local = nid - base
         in_level = (local >= 0) & (local < N)
-        lw = jnp.where(in_level, w, 0.0)
-        lg = jnp.where(in_level, g, 0.0)
-        lh = jnp.where(in_level, h, 0.0)
         lid = jnp.clip(local, 0, N - 1)
-        hist = build_histograms(codes, lid, lg, lh, lw, N, B1, cfg.hist_method)
-        if axis_name is not None:
-            hist = jax.lax.psum(hist, axis_name)
+        if prev_hist is None:
+            lw = jnp.where(in_level, w, 0.0)
+            lg = jnp.where(in_level, g, 0.0)
+            lh = jnp.where(in_level, h, 0.0)
+            hist = build_histograms(codes, lid, lg, lh, lw, N, B1,
+                                    cfg.hist_method)
+            if axis_name is not None:
+                hist = jax.lax.psum(hist, axis_name)
+        else:
+            # sibling subtraction: build only LEFT children (even local
+            # ids), right = parent − left (halves the histogram FLOPs —
+            # the reference plays the same trick per DHistogram pair).
+            # Children of non-split parents get phantom mass but are
+            # unreachable by routing, so never read.
+            is_left = in_level & (local % 2 == 0)
+            lw = jnp.where(is_left, w, 0.0)
+            lg = jnp.where(is_left, g, 0.0)
+            lh = jnp.where(is_left, h, 0.0)
+            pslot = jnp.clip(local // 2, 0, N // 2 - 1)
+            hist_l = build_histograms(codes, pslot, lg, lh, lw, N // 2, B1,
+                                      cfg.hist_method)
+            if axis_name is not None:
+                hist_l = jax.lax.psum(hist_l, axis_name)
+            hist_r = prev_hist - hist_l
+            hist = jnp.stack([hist_l, hist_r], axis=1).reshape(
+                N, F, B1, 3)
+        prev_hist = hist
         bg, bf, bb, bnl, gt, ht, wt = _find_splits(hist, cfg, col_mask)
         can = (bg > jnp.maximum(cfg.min_split_improvement, 0.0)) & (wt > 0)
         idx = base + jnp.arange(N)
@@ -143,26 +167,30 @@ def grow_tree(codes, g, h, w, cfg: TreeConfig, col_mask, axis_name=None):
         value = value.at[idx].set(-gt / (ht + cfg.reg_lambda + 1e-12))
         gain_arr = gain_arr.at[idx].set(jnp.where(can, bg, 0.0))
         node_w = node_w.at[idx].set(wt)
-        # route rows: only rows whose current node is at this level AND split
-        node_feat = bf[lid]
-        node_bin = bb[lid]
-        node_nal = bnl[lid]
-        node_can = can[lid]
-        c = jnp.take_along_axis(codes, node_feat[:, None].astype(jnp.int32),
+        # route rows: only rows whose current node is at this level AND
+        # split. Per-node routing data is packed into ONE word so each row
+        # does a single small-table gather (4 separate gathers cost ~8ms
+        # per level at 1M rows on TPU)
+        word = (bf | (bb << 16) | (bnl.astype(jnp.int32) << 26)
+                | (can.astype(jnp.int32) << 27))      # feat:16 bin:10 flags:2
+        rw = word[lid]
+        node_feat = rw & 0xFFFF
+        node_bin = (rw >> 16) & 0x3FF
+        node_nal = ((rw >> 26) & 1).astype(bool)
+        node_can = ((rw >> 27) & 1).astype(bool)
+        c = jnp.take_along_axis(rm, node_feat[:, None].astype(jnp.int32),
                                 axis=1)[:, 0].astype(jnp.int32)
         is_na = c == cfg.n_bins
         go_right = jnp.where(is_na, ~node_nal, c >= node_bin)
         child = 2 * nid + 1 + go_right.astype(jnp.int32)
         nid = jnp.where(in_level & node_can, child, nid)
 
-    # deepest level: leaf values from segment totals (scatter — once/tree)
+    # deepest level: leaf values from segment totals
     baseD = 2 ** D - 1
     localD = nid - baseD
     inD = (localD >= 0) & (localD < 2 ** D)
     lidD = jnp.clip(localD, 0, 2 ** D - 1)
-    gD = jnp.zeros(2 ** D, jnp.float32).at[lidD].add(jnp.where(inD, g, 0.0))
-    hD = jnp.zeros(2 ** D, jnp.float32).at[lidD].add(jnp.where(inD, h, 0.0))
-    wD = jnp.zeros(2 ** D, jnp.float32).at[lidD].add(jnp.where(inD, w, 0.0))
+    gD, hD, wD = _segment_totals(lidD, inD, g, h, w, 2 ** D)
     if axis_name is not None:
         gD = jax.lax.psum(gD, axis_name)
         hD = jax.lax.psum(hD, axis_name)
@@ -177,16 +205,137 @@ def grow_tree(codes, g, h, w, cfg: TreeConfig, col_mask, axis_name=None):
     return tree, nid
 
 
+def grow_tree_spmd(codes, g, h, w, cfg: TreeConfig, col_mask,
+                   data_axis: str = "data", model_axis: str = "model"):
+    """Fully-sharded tree build for multi-chip meshes: rows over the
+    'data' axis AND features over the 'model' axis.
+
+    Runs inside shard_map with in_specs codes=P(data, model), g/h/w/
+    col_mask sharded accordingly. Per level:
+      1. each shard builds histograms for its (row-block × feature-block);
+      2. psum over the data axis → complete histograms for local features
+         (the ICI all-reduce replacing Rabit / the MRTask reduce tree);
+      3. local split finding, then an all_gather + argmax over the model
+         axis picks the global best split per node (features never move);
+      4. row routing: the model-shard owning the winning feature computes
+         the children for its nodes; a psum over the model axis broadcasts
+         the routing to all feature shards (rows are replicated across the
+         model axis, so this is a small [rows] exchange).
+
+    The reference has no feature-axis sharding at all (SURVEY.md §5) —
+    every JVM node holds all columns of its rows; this is where the TPU
+    design scales wider data than the reference can.
+    """
+    from h2o3_tpu.ops.histogram import build_histograms
+
+    D = cfg.max_depth
+    M = cfg.n_nodes
+    B1 = cfg.n_bins + 1
+    rows, F_loc = codes.shape
+    midx = jax.lax.axis_index(model_axis)
+    n_model = jax.lax.axis_size(model_axis)
+
+    feat = jnp.full(M, -1, jnp.int32)
+    split_bin = jnp.zeros(M, jnp.int32)
+    na_left = jnp.zeros(M, bool)
+    is_split = jnp.zeros(M, bool)
+    value = jnp.zeros(M, jnp.float32)
+
+    nid = jnp.zeros(rows, jnp.int32)
+    for d in range(D):
+        base = 2 ** d - 1
+        N = 2 ** d
+        local = nid - base
+        in_level = (local >= 0) & (local < N)
+        lw = jnp.where(in_level, w, 0.0)
+        lg = jnp.where(in_level, g, 0.0)
+        lh = jnp.where(in_level, h, 0.0)
+        lid = jnp.clip(local, 0, N - 1)
+        hist = build_histograms(codes, lid, lg, lh, lw, N, B1, cfg.hist_method)
+        hist = jax.lax.psum(hist, data_axis)
+        bg, bf, bb, bnl, gt, ht, wt = _find_splits(hist, cfg, col_mask)
+        # global best over the model axis
+        cand = jnp.stack([bg, (midx * F_loc + bf).astype(jnp.float32),
+                          bb.astype(jnp.float32), bnl.astype(jnp.float32)], 1)
+        allc = jax.lax.all_gather(cand, model_axis)          # [n_model, N, 4]
+        winner = jnp.argmax(allc[:, :, 0], axis=0)           # [N]
+        sel = jnp.take_along_axis(allc, winner[None, :, None], axis=0)[0]
+        gbg, gbf, gbb, gbnl = sel[:, 0], sel[:, 1].astype(jnp.int32), \
+            sel[:, 2].astype(jnp.int32), sel[:, 3] > 0.5
+        can = (gbg > jnp.maximum(cfg.min_split_improvement, 0.0)) & (wt > 0)
+        idx = base + jnp.arange(N)
+        feat = feat.at[idx].set(jnp.where(can, gbf, -1))
+        split_bin = split_bin.at[idx].set(gbb)
+        na_left = na_left.at[idx].set(gbnl)
+        is_split = is_split.at[idx].set(can)
+        value = value.at[idx].set(-gt / (ht + cfg.reg_lambda + 1e-12))
+        # routing: owner shard of each node's feature computes children
+        node_feat_g = gbf[lid]
+        owner = node_feat_g // F_loc
+        node_feat_l = node_feat_g % F_loc
+        node_bin = gbb[lid]
+        node_nal = gbnl[lid]
+        node_can = can[lid]
+        c = jnp.take_along_axis(codes, node_feat_l[:, None], axis=1)[:, 0]
+        c = c.astype(jnp.int32)
+        is_na = c == cfg.n_bins
+        go_right = jnp.where(is_na, ~node_nal, c >= node_bin)
+        child = 2 * nid + 1 + go_right.astype(jnp.int32)
+        mine = (owner == midx) & in_level & node_can
+        routed = jnp.where(mine, child, 0)
+        routed = jax.lax.psum(routed, model_axis)
+        nid = jnp.where(in_level & node_can, routed, nid)
+
+    baseD = 2 ** D - 1
+    localD = nid - baseD
+    inD = (localD >= 0) & (localD < 2 ** D)
+    lidD = jnp.clip(localD, 0, 2 ** D - 1)
+    gD = jnp.zeros(2 ** D, jnp.float32).at[lidD].add(jnp.where(inD, g, 0.0))
+    hD = jnp.zeros(2 ** D, jnp.float32).at[lidD].add(jnp.where(inD, h, 0.0))
+    gD = jax.lax.psum(gD, data_axis)
+    hD = jax.lax.psum(hD, data_axis)
+    idxD = baseD + jnp.arange(2 ** D)
+    value = value.at[idxD].set(-gD / (hD + cfg.reg_lambda + 1e-12))
+
+    tree = {"feat": feat, "split_bin": split_bin, "na_left": na_left,
+            "is_split": is_split, "value": value}
+    return tree, nid
+
+
+def _segment_totals(lid, valid, g, h, w, n_seg: int):
+    """Per-node (g,h,w) sums. One-hot matmul for small node counts (TPU
+    scatter-add costs ~20ms/1M rows; the matmul is <1ms), scatter beyond."""
+    if n_seg <= 256:
+        oh = (lid[:, None] == jnp.arange(n_seg)[None, :]).astype(jnp.float32)
+        ghw = jnp.stack([jnp.where(valid, g, 0.0), jnp.where(valid, h, 0.0),
+                         jnp.where(valid, w, 0.0)], axis=1)
+        tot = jax.lax.dot_general(oh, ghw, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        return tot[:, 0], tot[:, 1], tot[:, 2]
+    gD = jnp.zeros(n_seg, jnp.float32).at[lid].add(jnp.where(valid, g, 0.0))
+    hD = jnp.zeros(n_seg, jnp.float32).at[lid].add(jnp.where(valid, h, 0.0))
+    wD = jnp.zeros(n_seg, jnp.float32).at[lid].add(jnp.where(valid, w, 0.0))
+    return gD, hD, wD
+
+
 def predict_binned(codes, tree, max_depth: int, na_bin: int):
-    """Training-time prediction on the binned matrix (leaf lookup)."""
-    rows = codes.shape[0]
+    """Prediction on a binned matrix (leaf lookup); one packed-word gather
+    per level (see grow_tree routing)."""
+    from h2o3_tpu.ops.binning import CodesView
+    rm = codes.rm if isinstance(codes, CodesView) else codes
+    rows = rm.shape[0]
+    word = (jnp.maximum(tree["feat"], 0)
+            | (tree["split_bin"] << 16)
+            | (tree["na_left"].astype(jnp.int32) << 26)
+            | (tree["is_split"].astype(jnp.int32) << 27))
     nid = jnp.zeros(rows, jnp.int32)
     for _ in range(max_depth):
-        f = tree["feat"][nid]
-        s = tree["is_split"][nid]
-        b = tree["split_bin"][nid]
-        nl = tree["na_left"][nid]
-        c = jnp.take_along_axis(codes, jnp.maximum(f, 0)[:, None], axis=1)[:, 0]
+        rw = word[nid]
+        f = rw & 0xFFFF
+        b = (rw >> 16) & 0x3FF
+        nl = ((rw >> 26) & 1).astype(bool)
+        s = ((rw >> 27) & 1).astype(bool)
+        c = jnp.take_along_axis(rm, f[:, None], axis=1)[:, 0]
         c = c.astype(jnp.int32)
         is_na = c == na_bin
         go_right = jnp.where(is_na, ~nl, c >= b)

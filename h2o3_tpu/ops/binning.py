@@ -16,16 +16,33 @@ XLA). Split "bin t" means: left ⇔ code < t ⇔ raw < edges[t-1].
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional, Sequence
+from typing import List, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 
+class CodesView(NamedTuple):
+    """Bin codes in both layouts. ``rm`` [rows, F] (compact, for routing/
+    predict gathers); ``t`` [Fp, rows_p] int32 (transposed + padded, the
+    pallas histogram kernel operand — transposing once here instead of per
+    level saves ~40ms/level at 1M rows). ``t`` may be None off-TPU."""
+    rm: jax.Array
+    t: Optional[jax.Array]
+
+    @property
+    def shape(self):
+        return self.rm.shape
+
+    @property
+    def dtype(self):
+        return self.rm.dtype
+
+
 @dataclass
 class BinnedMatrix:
-    codes: jax.Array           # [padded_rows, F] int dtype; NA bin = n_bins
+    codes: CodesView           # NA bin = n_bins
     n_bins: int                # bins per feature excluding the NA bin
     edges: List[np.ndarray]    # per-feature raw-value split edges (len <= n_bins-1)
     names: List[str]
@@ -34,7 +51,7 @@ class BinnedMatrix:
 
     @property
     def n_features(self) -> int:
-        return self.codes.shape[1]
+        return self.codes.rm.shape[1]
 
     @property
     def na_bin(self) -> int:
@@ -90,9 +107,22 @@ def bin_matrix(X, names: Sequence[str], is_cat: Sequence[bool], nrow: int,
         else:
             e = edge_fn(col, nbins)
         edges.append(e[: nbins - 1])
-    codes = digitize_with_edges(X, edges, nbins)
+    codes = make_codes_view(digitize_with_edges(X, edges, nbins))
     return BinnedMatrix(codes=codes, n_bins=nbins, edges=edges, names=list(names),
                         is_categorical=list(is_cat), nrow=nrow)
+
+
+def make_codes_view(codes_rm, tile: int = 2048) -> CodesView:
+    """Build both layouts; the transposed int32 copy only on TPU (it only
+    serves the pallas kernel)."""
+    if jax.default_backend() != "tpu":
+        return CodesView(rm=codes_rm, t=None)
+    from h2o3_tpu.ops.hist_pallas import FBLK
+    rows, F = codes_rm.shape
+    pad_r = (-rows) % tile
+    pad_f = (-F) % FBLK
+    t = jnp.pad(codes_rm.astype(jnp.int32).T, ((0, pad_f), (0, pad_r)))
+    return CodesView(rm=codes_rm, t=t)
 
 
 @jax.jit

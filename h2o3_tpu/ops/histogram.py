@@ -62,11 +62,25 @@ def _hist_matmul(codes, node_ids, g, h, w, n_nodes, n_bins1):
 def build_histograms(codes, node_ids, g, h, w, n_nodes: int, n_bins1: int,
                      method: str = "auto"):
     """Local (per-shard or single-device) histogram build. Caller is
-    responsible for the cross-device psum when run under shard_map."""
+    responsible for the cross-device psum when run under shard_map.
+
+    Methods: 'pallas' (fused VMEM one-hot matmul, ~13x the XLA matmul on
+    v5e — see ops/hist_pallas.py), 'matmul' (XLA one-hot dot), 'scatter'
+    (XLA scatter-add; CPU default), 'auto'.
+
+    ``codes`` may be a plain [rows, F] int array or a binning.CodesView
+    (whose pre-transposed layout feeds the pallas kernel directly)."""
+    from h2o3_tpu.ops.binning import CodesView
+    rm = codes.rm if isinstance(codes, CodesView) else codes
+    codes_t = codes.t if isinstance(codes, CodesView) else None
     if method == "auto":
-        method = "matmul" if jax.default_backend() == "tpu" else "scatter"
+        method = "pallas" if jax.default_backend() == "tpu" else "scatter"
+    if method == "pallas":
+        from h2o3_tpu.ops.hist_pallas import hist_pallas_from_rowmajor
+        return hist_pallas_from_rowmajor(rm, node_ids, g, h, w, n_nodes,
+                                         n_bins1, codes_t=codes_t)
     fn = _hist_matmul if method == "matmul" else _hist_scatter
-    return fn(codes, node_ids.astype(jnp.int32), g, h, w, n_nodes, n_bins1)
+    return fn(rm, node_ids.astype(jnp.int32), g, h, w, n_nodes, n_bins1)
 
 
 def build_histograms_sharded(codes, node_ids, g, h, w, n_nodes: int,
