@@ -62,19 +62,22 @@ class GBMModel(Model):
         self._is_split = jnp.asarray(trees_host["is_split"])
         self._value = jnp.asarray(trees_host["value"])
 
-    def _margin_matrix(self, X):
+    def _margin_matrix(self, X, offset=None):
         contribs = predict_raw_stacked(X, self._feat, self._thr, self._na_left,
                                        self._is_split, self._value,
                                        self.max_depth)
         K = self._K
         if K == 1:
-            return jnp.asarray(self.f0) + contribs.sum(axis=1)
+            margin = jnp.asarray(self.f0) + contribs.sum(axis=1)
+            if offset is not None:
+                margin = margin + offset
+            return margin
         T = self.ntrees_built
         per_class = contribs.reshape(X.shape[0], T, K).sum(axis=1)
         return jnp.asarray(self.f0)[None, :] + per_class
 
-    def _predict_matrix(self, X):
-        margin = self._margin_matrix(X)
+    def _predict_matrix(self, X, offset=None):
+        margin = self._margin_matrix(X, offset=offset)
         if self.nclasses <= 1:
             return get_distribution(self.dist_name,
                                     self.params.get("tweedie_power", 1.5)
@@ -190,10 +193,18 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         y, w = spec.y, spec.w
         padded = spec.X.shape[0]
         dist = get_distribution(dist_name, p["tweedie_power"]) if K == 1 else None
+        if spec.offset is not None and K > 1:
+            raise NotImplementedError(
+                "offset_column is not supported for multinomial GBM "
+                "(matching hex/tree/gbm/GBM.java offset restrictions)")
         if K == 1:
             yf = y.astype(jnp.float32)
             f0 = dist.init_f0(yf, w)
             margin = jnp.full(padded, f0, jnp.float32)
+            if spec.offset is not None:
+                # offset enters the margin, not the trees: f = f0 + offset + Σ lr·tree
+                # (reference GBM honors offsets in every distribution's margin)
+                margin = margin + spec.offset
         else:
             pri = jnp.maximum(
                 jnp.zeros(K, jnp.float32).at[y].add(w) / w.sum(), 1e-9)
@@ -216,6 +227,8 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                 digitize_with_edges(valid_spec.X, bm.edges, bm.n_bins))
             vmargin = (jnp.full(valid_spec.X.shape[0], f0, jnp.float32) if K == 1
                        else jnp.broadcast_to(f0, (valid_spec.X.shape[0], K)).astype(jnp.float32))
+            if K == 1 and valid_spec.offset is not None:
+                vmargin = vmargin + valid_spec.offset
         else:  # small dummies (untraced branches, but args need shapes)
             vcodes = make_codes_view(jnp.zeros((8, bm.n_features),
                                                bm.codes.dtype))

@@ -179,9 +179,12 @@ class ScoreKeeper:
             return False
         recent = np.mean(scores[-k:])
         prev = np.mean(scores[-2 * k:-k])
+        # relative-improvement test with |prev| scaling — robust to metrics
+        # that cross zero (the old sign trick inverted the band there)
+        margin = self.tol * abs(prev)
         if less_is_better:
-            return recent > prev * (1.0 - self.tol * np.sign(prev))
-        return recent < prev * (1.0 + self.tol * np.sign(prev))
+            return recent >= prev - margin
+        return recent <= prev + margin
 
 
 class Model:
@@ -207,17 +210,32 @@ class Model:
 
     # -- scoring --------------------------------------------------------
 
-    def _predict_matrix(self, X):
+    def _predict_matrix(self, X, offset=None):
         """Return margin/score array: [padded] for regression,
         [padded, K] class probabilities for classification."""
         raise NotImplementedError
+
+    def _frame_offset(self, frame: Frame):
+        """Offset vector for scoring. An offset-trained model requires the
+        offset column at scoring time (adaptTestForTrain raises in the
+        reference, hex/Model.java) — silently dropping it would shift every
+        prediction."""
+        oc = self.params.get("offset_column")
+        if not oc:
+            return None
+        if oc not in frame:
+            raise ValueError(
+                f"model was trained with offset_column='{oc}' but the "
+                f"scoring frame does not contain it")
+        ov = frame.vec(oc).as_float()
+        return jnp.where(jnp.isnan(ov), 0.0, ov)
 
     def predict(self, frame: Frame) -> Frame:
         """Bulk scoring → prediction Frame (BigScore analog). Output
         schema mirrors the reference: regression → 'predict'; classif →
         'predict' + one prob column per class."""
         X = adapt_test_matrix(self, frame)
-        out = self._predict_matrix(X)
+        out = self._predict_matrix(X, offset=self._frame_offset(frame))
         nrow = frame.nrow
         if self.nclasses <= 1:
             pv = np.asarray(jax.device_get(out))[:nrow]
@@ -233,7 +251,7 @@ class Model:
         if frame is None:
             return self.training_metrics
         X = adapt_test_matrix(self, frame)
-        out = self._predict_matrix(X)
+        out = self._predict_matrix(X, offset=self._frame_offset(frame))
         nrow = frame.nrow
         if self.nclasses > 1:
             # remap the test response through the TRAINING domain — a fresh
@@ -245,6 +263,33 @@ class Model:
             return compute_metrics(out_h, y, w, self.nclasses, self.response_domain)
         spec_like = build_training_spec(frame, self.response, classification=False)
         return compute_metrics(out, spec_like.y, spec_like.w, 1)
+
+    # -- convenience accessors (h2o-py parity) -------------------------
+
+    def _metric(self, name, valid=False):
+        m = self.validation_metrics if valid else self.training_metrics
+        return getattr(m, name, None)
+
+    def auc(self, valid=False):
+        return self._metric("auc", valid)
+
+    def logloss(self, valid=False):
+        return self._metric("logloss", valid)
+
+    def rmse(self, valid=False):
+        return self._metric("rmse", valid)
+
+    def mse(self, valid=False):
+        return self._metric("mse", valid)
+
+    def mae(self, valid=False):
+        return self._metric("mae", valid)
+
+    def r2(self, valid=False):
+        return self._metric("r2", valid)
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.key} {self.params.get('model_id', '')}>"
 
 
 def response_codes_in_domain(frame: Frame, response: str, domain):
@@ -263,27 +308,6 @@ def response_codes_in_domain(frame: Frame, response: str, domain):
                      dtype=np.int32)
     w = (codes >= 0).astype(np.float32)
     return np.maximum(codes, 0), w
-
-    # -- convenience accessors (h2o-py parity) -------------------------
-
-    def auc(self, valid=False):
-        m = self.validation_metrics if valid else self.training_metrics
-        return getattr(m, "auc", None)
-
-    def logloss(self, valid=False):
-        m = self.validation_metrics if valid else self.training_metrics
-        return getattr(m, "logloss", None)
-
-    def rmse(self, valid=False):
-        m = self.validation_metrics if valid else self.training_metrics
-        return getattr(m, "rmse", None)
-
-    def mse(self, valid=False):
-        m = self.validation_metrics if valid else self.training_metrics
-        return getattr(m, "mse", None)
-
-    def __repr__(self):
-        return f"<{type(self).__name__} {self.key} {self.params.get('model_id', '')}>"
 
 
 def compute_metrics(scores, y, w, nclasses, response_domain=None,
@@ -394,7 +418,8 @@ class ModelBuilder:
             sub.train(x=x, y=y, training_frame=tr)
             fm = sub.model
             X_te = adapt_test_matrix(fm, te)
-            out = np.asarray(jax.device_get(fm._predict_matrix(X_te)))[: te.nrow]
+            out = np.asarray(jax.device_get(
+                fm._predict_matrix(X_te, offset=fm._frame_offset(te))))[: te.nrow]
             holdout[mask] = out
             fold_models.append(fm)
             job.set_progress(0.5 + 0.5 * (i + 1) / len(fold_ids))

@@ -32,6 +32,17 @@ import numpy as np
 
 NEG_INF = -1e30
 
+# packed routing word layout: feat[0:14) | bin[14:28) | na_left[28] | split[29]
+# (14 bits each caps features and bins at 16383 — asserted in TreeConfig and
+# binning.bin_matrix; bins can exceed 10 bits when nbins_cats grows the
+# shared bin count for high-cardinality categoricals)
+FEAT_BITS = 14
+FEAT_MASK = (1 << FEAT_BITS) - 1
+BIN_SHIFT = FEAT_BITS
+BIN_MASK = (1 << 14) - 1
+NA_SHIFT = 28
+SPLIT_SHIFT = 29
+
 
 @dataclass(frozen=True)
 class TreeConfig:
@@ -46,6 +57,10 @@ class TreeConfig:
     @property
     def n_nodes(self) -> int:
         return 2 ** (self.max_depth + 1) - 1
+
+    def __post_init__(self):
+        assert self.n_features <= FEAT_MASK, self.n_features
+        assert self.n_bins < BIN_MASK, self.n_bins
 
 
 def _find_splits(hist, cfg: TreeConfig, col_mask):
@@ -171,13 +186,13 @@ def grow_tree(codes, g, h, w, cfg: TreeConfig, col_mask, axis_name=None):
         # split. Per-node routing data is packed into ONE word so each row
         # does a single small-table gather (4 separate gathers cost ~8ms
         # per level at 1M rows on TPU)
-        word = (bf | (bb << 16) | (bnl.astype(jnp.int32) << 26)
-                | (can.astype(jnp.int32) << 27))      # feat:16 bin:10 flags:2
+        word = (bf | (bb << BIN_SHIFT) | (bnl.astype(jnp.int32) << NA_SHIFT)
+                | (can.astype(jnp.int32) << SPLIT_SHIFT))
         rw = word[lid]
-        node_feat = rw & 0xFFFF
-        node_bin = (rw >> 16) & 0x3FF
-        node_nal = ((rw >> 26) & 1).astype(bool)
-        node_can = ((rw >> 27) & 1).astype(bool)
+        node_feat = rw & FEAT_MASK
+        node_bin = (rw >> BIN_SHIFT) & BIN_MASK
+        node_nal = ((rw >> NA_SHIFT) & 1).astype(bool)
+        node_can = ((rw >> SPLIT_SHIFT) & 1).astype(bool)
         c = jnp.take_along_axis(rm, node_feat[:, None].astype(jnp.int32),
                                 axis=1)[:, 0].astype(jnp.int32)
         is_na = c == cfg.n_bins
@@ -325,16 +340,16 @@ def predict_binned(codes, tree, max_depth: int, na_bin: int):
     rm = codes.rm if isinstance(codes, CodesView) else codes
     rows = rm.shape[0]
     word = (jnp.maximum(tree["feat"], 0)
-            | (tree["split_bin"] << 16)
-            | (tree["na_left"].astype(jnp.int32) << 26)
-            | (tree["is_split"].astype(jnp.int32) << 27))
+            | (tree["split_bin"] << BIN_SHIFT)
+            | (tree["na_left"].astype(jnp.int32) << NA_SHIFT)
+            | (tree["is_split"].astype(jnp.int32) << SPLIT_SHIFT))
     nid = jnp.zeros(rows, jnp.int32)
     for _ in range(max_depth):
         rw = word[nid]
-        f = rw & 0xFFFF
-        b = (rw >> 16) & 0x3FF
-        nl = ((rw >> 26) & 1).astype(bool)
-        s = ((rw >> 27) & 1).astype(bool)
+        f = rw & FEAT_MASK
+        b = (rw >> BIN_SHIFT) & BIN_MASK
+        nl = ((rw >> NA_SHIFT) & 1).astype(bool)
+        s = ((rw >> SPLIT_SHIFT) & 1).astype(bool)
         c = jnp.take_along_axis(rm, f[:, None], axis=1)[:, 0]
         c = c.astype(jnp.int32)
         is_na = c == na_bin
@@ -380,8 +395,12 @@ def bins_to_thresholds(tree_split_bin: np.ndarray, tree_feat: np.ndarray,
             continue
         e = edges[f]
         t = tree_split_bin[m]
-        if len(e) == 0:
+        if len(e) == 0 or t - 1 >= len(e):
+            # t > E is reachable when a feature has fewer unique edges than
+            # nbins: all non-NA rows go left, only NA can go right. Clamping
+            # to e[-1] (the old behaviour) misrouted rows >= e[-1] into the
+            # NA branch at scoring time.
             thr[m] = np.inf
         else:
-            thr[m] = e[min(t - 1, len(e) - 1)]
+            thr[m] = e[t - 1]
     return thr

@@ -86,10 +86,13 @@ def bin_matrix(X, names: Sequence[str], is_cat: Sequence[bool], nrow: int,
                histogram_type: str = "quantiles_global") -> BinnedMatrix:
     """Digitise a dense [padded_rows, F] float matrix (NaN = NA) into codes.
 
-    Categorical columns with cardinality <= nbins use identity binning
-    (code = category id), mirroring nbins_cats group-per-category splits
-    (hex/tree/DHistogram nbins_cats); larger cardinalities fall back to
-    quantile grouping of the code space.
+    Categorical columns with cardinality <= nbins_cats use identity binning
+    (code = category id) — group-per-category splits, the reference's
+    nbins_cats semantics (hex/tree/DHistogram nbins_cats=1024). When a
+    categorical needs more bins than ``nbins``, the matrix-wide bin count
+    grows to fit it (histograms are [*, F, B+1, *] with one shared B;
+    numeric features simply leave the extra bins empty). Cardinalities
+    beyond nbins_cats fall back to quantile grouping of the code space.
     """
     X_host = np.asarray(X, dtype=np.float32)
     F = X_host.shape[1]
@@ -100,28 +103,58 @@ def bin_matrix(X, names: Sequence[str], is_cat: Sequence[bool], nrow: int,
         col = X_host[:nrow, f]
         if is_cat[f]:
             card = int(np.nanmax(col)) + 1 if np.isfinite(col).any() else 1
-            if card <= nbins:
+            if card <= nbins_cats:
                 e = (np.arange(1, card, dtype=np.float32) - 0.5)
             else:
-                e = quantile_edges(col, nbins)
+                e = quantile_edges(col, nbins_cats)
         else:
             e = edge_fn(col, nbins)
-        edges.append(e[: nbins - 1])
-    codes = make_codes_view(digitize_with_edges(X, edges, nbins))
-    return BinnedMatrix(codes=codes, n_bins=nbins, edges=edges, names=list(names),
-                        is_categorical=list(is_cat), nrow=nrow)
+            e = e[: nbins - 1]
+        edges.append(e)
+    # shared bin count = the widest feature's need (>= nbins only if a
+    # categorical demands group-per-category resolution). Capped by the
+    # 14-bit packed-word routing field (models/tree.py BIN_BITS).
+    n_bins_eff = max(nbins, max((len(e) + 1 for e in edges), default=2))
+    if n_bins_eff > 16382:
+        raise ValueError(
+            f"effective bin count {n_bins_eff} exceeds the 14-bit routing "
+            f"limit; lower nbins_cats (reference default is 1024)")
+    codes = make_codes_view(digitize_with_edges(X, edges, n_bins_eff))
+    return BinnedMatrix(codes=codes, n_bins=n_bins_eff, edges=edges,
+                        names=list(names), is_categorical=list(is_cat),
+                        nrow=nrow)
 
 
-def make_codes_view(codes_rm, tile: int = 2048) -> CodesView:
+def make_codes_view(codes_rm, tile: int = 2048, mesh=None) -> CodesView:
     """Build both layouts; the transposed int32 copy only on TPU (it only
-    serves the pallas kernel)."""
+    serves the pallas kernel). Both layouts are sharded over the mesh
+    'data' axis (rows): rm as [rows@data, F]; t as [Fp, rows_p@data],
+    transposed and tile-padded PER SHARD (shard i's t columns are shard
+    i's rm rows — a global end-pad would misalign the row sets)."""
+    from h2o3_tpu.parallel.mesh import current_mesh, n_data_shards
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = mesh or current_mesh()
+    nd = n_data_shards(mesh)
+    rows, F = codes_rm.shape
+    if rows % nd == 0:
+        codes_rm = jax.device_put(codes_rm, NamedSharding(mesh, P("data")))
     if jax.default_backend() != "tpu":
         return CodesView(rm=codes_rm, t=None)
     from h2o3_tpu.ops.hist_pallas import FBLK
-    rows, F = codes_rm.shape
-    pad_r = (-rows) % tile
-    pad_f = (-F) % FBLK
-    t = jnp.pad(codes_rm.astype(jnp.int32).T, ((0, pad_f), (0, pad_r)))
+
+    def build_t(rm_local):
+        rows_l = rm_local.shape[0]
+        pad_r = (-rows_l) % tile
+        pad_f = (-F) % FBLK
+        return jnp.pad(rm_local.astype(jnp.int32).T, ((0, pad_f), (0, pad_r)))
+
+    if rows % nd == 0 and nd > 1:
+        t = jax.jit(jax.shard_map(build_t, mesh=mesh, in_specs=P("data"),
+                                  out_specs=P(None, "data")))(codes_rm)
+    else:
+        t = build_t(codes_rm)
+        t = jax.device_put(t, NamedSharding(mesh, P(None, "data")))
     return CodesView(rm=codes_rm, t=t)
 
 
@@ -152,6 +185,10 @@ def digitize_with_edges(X, edges: List[np.ndarray], nbins: int) -> jax.Array:
 
 
 def split_threshold(bm: BinnedMatrix, feature: int, bin_idx: int) -> float:
-    """Raw-value threshold for 'left ⇔ code < bin_idx'."""
+    """Raw-value threshold for 'left ⇔ code < bin_idx'. A split bin beyond
+    the edge list means 'all non-NA left' → +inf (see
+    models.tree.bins_to_thresholds)."""
     e = bm.edges[feature]
-    return float(e[min(bin_idx, len(e)) - 1])
+    if len(e) == 0 or bin_idx - 1 >= len(e):
+        return float("inf")
+    return float(e[bin_idx - 1])

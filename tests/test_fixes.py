@@ -1,0 +1,164 @@
+"""Regression tests for round-1 defects (VERDICT.md Weak / ADVICE.md).
+
+- bins_to_thresholds overflow → +inf (all-non-NA-left splits must not
+  route max-value rows into the NA branch at scoring time);
+- Model convenience accessors exist and delegate from the builder;
+- nbins_cats: group-per-category binning for mid-cardinality enums;
+- offset_column threads into GBM margins (train + score);
+- pallas histogram kernel parity vs the scatter reference (interpret mode).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+from h2o3_tpu.models.tree import bins_to_thresholds
+from h2o3_tpu.ops.binning import bin_matrix, split_threshold
+from h2o3_tpu.ops.histogram import _hist_scatter
+from h2o3_tpu.ops.hist_pallas import hist_pallas_from_rowmajor
+
+
+def test_bins_to_thresholds_overflow_is_inf():
+    # feature 0 has 2 edges; a split at t=3 (beyond the edges) must send all
+    # non-NA rows left (threshold +inf), not clamp to the last edge
+    edges = [np.array([0.5, 1.5], dtype=np.float32)]
+    feat = np.array([0, 0, 0], dtype=np.int32)
+    sbin = np.array([1, 2, 3], dtype=np.int32)
+    thr = bins_to_thresholds(sbin, feat, edges)
+    assert thr[0] == np.float32(0.5)
+    assert thr[1] == np.float32(1.5)
+    assert thr[2] == np.inf
+
+
+def test_split_threshold_overflow_is_inf():
+    class BM:
+        edges = [np.array([0.5], dtype=np.float32)]
+    assert split_threshold(BM, 0, 1) == 0.5
+    assert split_threshold(BM, 0, 2) == np.inf
+
+
+def test_train_vs_repredict_with_na_low_cardinality():
+    """NA-informative low-cardinality feature: predict() on the training
+    frame must reproduce the training metrics (the round-1 clamp bug gave
+    logloss 0.665 vs 0.632 here)."""
+    rng = np.random.default_rng(3)
+    n = 4000
+    x = rng.integers(0, 3, n).astype(np.float32)      # few unique values
+    x[rng.random(n) < 0.3] = np.nan                    # NA informative
+    p = np.where(np.isnan(x), 0.8, np.where(x >= 2, 0.7, 0.2))
+    y = (rng.random(n) < p).astype(np.int32)
+    fr = h2o.Frame.from_numpy({"x": x, "noise": rng.normal(size=n).astype(np.float32),
+                               "y": y.astype(np.float32)})
+    gbm = H2OGradientBoostingEstimator(ntrees=20, max_depth=3, nbins=20,
+                                       distribution="bernoulli", seed=1,
+                                       min_rows=5.0)
+    gbm.train(y="y", training_frame=fr)
+    pred = gbm.model.predict(fr)
+    p1 = pred.vec("p1").to_numpy()
+    eps = 1e-15
+    ll = -np.mean(y * np.log(np.clip(p1, eps, 1)) +
+                  (1 - y) * np.log(np.clip(1 - p1, eps, 1)))
+    train_ll = gbm.model.training_metrics.logloss
+    assert abs(ll - train_ll) < 1e-3, (ll, train_ll)
+
+
+def test_model_accessors_exist():
+    rng = np.random.default_rng(0)
+    n = 500
+    x = rng.normal(size=n).astype(np.float32)
+    y = (x + rng.normal(size=n) * 0.5 > 0).astype(np.int32)
+    fr = h2o.Frame.from_numpy({"x": x, "y": y.astype(np.float32)})
+    gbm = H2OGradientBoostingEstimator(ntrees=5, max_depth=3,
+                                       distribution="bernoulli", seed=1)
+    gbm.train(y="y", training_frame=fr)
+    m = gbm.model
+    assert hasattr(type(m), "auc") and callable(m.auc)
+    assert 0.5 < m.auc() <= 1.0
+    assert m.logloss() > 0
+    # builder delegates to the model (h2o-py style)
+    assert gbm.auc() == m.auc()
+    assert "GBMModel" in repr(m)
+
+
+def test_nbins_cats_identity_binning():
+    rng = np.random.default_rng(1)
+    n = 2000
+    codes = rng.integers(0, 30, n)  # cardinality 30 > nbins 20
+    X = codes[:, None].astype(np.float32)
+    bm = bin_matrix(X, ["c"], [True], n, nbins=20, nbins_cats=1024)
+    # group-per-category: 30 bins, 29 half-step edges
+    assert bm.n_bins == 30
+    assert len(bm.edges[0]) == 29
+    got = np.asarray(jax.device_get(bm.codes.rm))[:n, 0]
+    assert (got == codes).all()
+    # beyond nbins_cats → quantile grouping, bounded bins
+    big = rng.integers(0, 5000, n)[:, None].astype(np.float32)
+    bm2 = bin_matrix(big, ["c"], [True], n, nbins=20, nbins_cats=64)
+    assert bm2.n_bins <= 64
+
+
+def test_offset_column_honored():
+    rng = np.random.default_rng(2)
+    n = 3000
+    x = rng.normal(size=n).astype(np.float32)
+    off = np.where(rng.random(n) < 0.5, 5.0, -5.0).astype(np.float32)
+    y = (2.0 * x + off + rng.normal(size=n) * 0.1).astype(np.float32)
+    fr = h2o.Frame.from_numpy({"x": x, "off": off, "y": y})
+    gbm = H2OGradientBoostingEstimator(ntrees=40, max_depth=4,
+                                       distribution="gaussian", seed=1,
+                                       offset_column="off", min_rows=5.0)
+    gbm.train(y="y", training_frame=fr)
+    pred = gbm.model.predict(fr).vec("predict").to_numpy()
+    resid = float(np.mean((pred - y) ** 2))
+    # without the offset in the margin the offset variance (~25) dominates
+    assert resid < 2.0, resid
+    # training metrics must reflect the offset margin too
+    assert gbm.model.training_metrics.mse < 2.0
+
+
+def test_offset_multinomial_raises():
+    rng = np.random.default_rng(4)
+    n = 300
+    fr = h2o.Frame.from_numpy({
+        "x": rng.normal(size=n).astype(np.float32),
+        "off": np.ones(n, np.float32),
+        "y": rng.integers(0, 3, n).astype(np.float32)})
+    gbm = H2OGradientBoostingEstimator(ntrees=2, distribution="multinomial",
+                                       offset_column="off")
+    with pytest.raises(Exception):
+        gbm.train(y="y", training_frame=fr)
+        if gbm.job.status == "FAILED":
+            raise RuntimeError(gbm.job.exception)
+
+
+@pytest.mark.parametrize("rows,F,n_nodes,nbins1", [
+    (1000, 5, 4, 17),    # padded rows (1000→1024) + padded features (5→8)
+    (512, 8, 1, 33),     # exact tile fit, single node
+])
+def test_pallas_interpret_parity(rows, F, n_nodes, nbins1):
+    """The flagship pallas kernel vs the scatter reference, including the
+    NA bin (= nbins1-1) and row/feature padding (ADVICE low / VERDICT Weak
+    #4: the kernel previously had zero test coverage)."""
+    rng = np.random.default_rng(7)
+    codes = rng.integers(0, nbins1, (rows, F)).astype(np.int32)  # incl. NA bin
+    nid = rng.integers(0, n_nodes, rows).astype(np.int32)
+    g = rng.normal(size=rows).astype(np.float32)
+    h = rng.random(rows).astype(np.float32)
+    w = (rng.random(rows) < 0.9).astype(np.float32)
+    ref = _hist_scatter(jnp.asarray(codes), jnp.asarray(nid), jnp.asarray(g),
+                        jnp.asarray(h), jnp.asarray(w), n_nodes, nbins1)
+    got = hist_pallas_from_rowmajor(
+        jnp.asarray(codes), jnp.asarray(nid), jnp.asarray(g), jnp.asarray(h),
+        jnp.asarray(w), n_nodes, nbins1, tile=256, mxu_dtype=jnp.float32,
+        interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5,
+                               atol=1e-5)
+    # bf16 one-hots are exact; (g,h,w) round to bf16 before f32 accumulate
+    got_bf = hist_pallas_from_rowmajor(
+        jnp.asarray(codes), jnp.asarray(nid), jnp.asarray(g), jnp.asarray(h),
+        jnp.asarray(w), n_nodes, nbins1, tile=256, mxu_dtype=jnp.bfloat16,
+        interpret=True)
+    np.testing.assert_allclose(np.asarray(got_bf), np.asarray(ref), rtol=2e-2,
+                               atol=2e-2)
