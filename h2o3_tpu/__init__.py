@@ -19,8 +19,9 @@ from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.frame.vec import Vec
 from h2o3_tpu.ingest.parse import import_file, parse_setup, upload_numpy
 from h2o3_tpu.parallel.mesh import current_mesh, set_mesh, make_mesh
+from h2o3_tpu.persist import export_file, load_model, save_model
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 __all__ = [
     "Frame",
@@ -32,6 +33,9 @@ __all__ = [
     "set_mesh",
     "make_mesh",
     "init",
+    "save_model",
+    "load_model",
+    "export_file",
 ]
 
 

@@ -13,12 +13,13 @@ iteration (one per class), as the reference does per-class DTrees.
 from __future__ import annotations
 
 import time
-from functools import partial
+from functools import lru_cache, partial
 from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from h2o3_tpu.jobs import Job
 from h2o3_tpu.models.distributions import get_distribution
@@ -26,7 +27,9 @@ from h2o3_tpu.models.model_base import (Model, ModelBuilder, ScoreKeeper,
                                         TrainingSpec, compute_metrics)
 from h2o3_tpu.models.tree import (TreeConfig, bins_to_thresholds, grow_tree,
                                   predict_binned, predict_raw_stacked)
-from h2o3_tpu.ops.binning import bin_matrix, digitize_with_edges, make_codes_view
+from h2o3_tpu.ops.binning import (CodesView, bin_matrix, digitize_with_edges,
+                                  make_codes_view)
+from h2o3_tpu.parallel.mesh import DATA_AXIS, current_mesh, n_data_shards
 
 GBM_DEFAULTS: Dict = dict(
     ntrees=50, max_depth=5, min_rows=10.0, learn_rate=0.1,
@@ -92,6 +95,135 @@ class GBMModel(Model):
         (hex/tree/SharedTreeModel varimp semantics)."""
         return self.output.get("variable_importances")
 
+    # -- persistence (persist.save_model/load_model) -------------------
+
+    def _save_arrays(self):
+        d = {"feat": np.asarray(jax.device_get(self._feat)),
+             "thr": np.asarray(jax.device_get(self._thr)),
+             "na_left": np.asarray(jax.device_get(self._na_left)),
+             "is_split": np.asarray(jax.device_get(self._is_split)),
+             "value": np.asarray(jax.device_get(self._value)),
+             "f0": np.asarray(self.f0)}
+        for i, e in enumerate(self.edges):
+            d[f"edge_{i}"] = np.asarray(e)
+        return d
+
+    def _save_extra_meta(self):
+        return {"dist_name": self.dist_name, "n_bins": self.n_bins,
+                "max_depth": self.max_depth,
+                "ntrees_built": self.ntrees_built,
+                "n_edges": len(self.edges)}
+
+    @classmethod
+    def _restore(cls, meta, arrays):
+        m = cls._restore_base(meta)
+        ex = meta["extra"]
+        m.dist_name = ex["dist_name"]
+        m.n_bins = ex["n_bins"]
+        m.max_depth = ex["max_depth"]
+        m.ntrees_built = ex["ntrees_built"]
+        m.f0 = arrays["f0"]
+        m.edges = [arrays[f"edge_{i}"] for i in range(ex["n_edges"])]
+        m._K = max(m.nclasses, 1) if m.nclasses > 2 else 1
+        m._feat = jnp.asarray(arrays["feat"])
+        m._thr = jnp.asarray(arrays["thr"])
+        m._na_left = jnp.asarray(arrays["na_left"])
+        m._is_split = jnp.asarray(arrays["is_split"])
+        m._value = jnp.asarray(arrays["value"])
+        return m
+
+
+def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
+                    lr0, start_idx, *, cfg, K, dist_name, tweedie_power,
+                    sample_rate, col_rate, na_bin, chunk, anneal, has_valid,
+                    has_t, axis_name):
+    """One chunk of the boosting loop, per data shard (runs under
+    shard_map). ``chunk`` trees are built inside ONE program via lax.scan:
+    per-call dispatch overhead amortises and margins/trees stay on device
+    between trees. The reference dispatches one MRTask per level per tree
+    (SharedTree.java:566-635) — here a whole chunk of trees is a single
+    XLA program, and the cross-shard histogram reduction is the psum
+    inside grow_tree (the Rabit-allreduce / MRTask-reduce-tree analog,
+    hex/tree/xgboost/rabit/RabitTrackerH2O.java, water/MRTask.java:871)."""
+    codes = CodesView(rm=codes_rm, t=codes_t if has_t else None)
+    vcodes = vrm
+    F = codes_rm.shape[1]
+    shard = jax.lax.axis_index(axis_name) if axis_name else 0
+
+    def one_tree(carry, i):
+        margin, vmargin, lr = carry
+        key = jax.random.fold_in(base_key, start_idx + i)
+        key_r, key_c = jax.random.split(key)
+        if axis_name is not None:
+            # decorrelate row sampling across shards (same base key would
+            # repeat the identical draw pattern on every shard); the column
+            # key stays common so col_mask is identical everywhere
+            key_r = jax.random.fold_in(key_r, shard)
+        wt = w
+        if sample_rate < 1.0:
+            wt = w * (jax.random.uniform(key_r, w.shape) < sample_rate)
+        col_mask = jnp.ones(F, bool)
+        if col_rate < 1.0:
+            col_mask = jax.random.uniform(key_c, (F,)) < col_rate
+        trees = []
+        if K == 1:
+            dist = get_distribution(dist_name, tweedie_power)
+            g, h = dist.grad_hess(margin, y)
+            tree, nid = grow_tree(codes, g * wt, h * wt, wt, cfg, col_mask,
+                                  axis_name=axis_name)
+            # grow_tree already routed every row to its leaf — reuse
+            # nid instead of re-walking the tree (saves ~250ms/tree@1M)
+            margin = margin + lr * tree["value"][nid]
+            if has_valid:
+                vc, _ = predict_binned(vcodes, tree, cfg.max_depth, na_bin)
+                vmargin = vmargin + lr * vc
+            trees.append(tree)
+        else:
+            p = jax.nn.softmax(margin, axis=1)
+            for k in range(K):
+                yk = (y == k).astype(jnp.float32)
+                gk = (p[:, k] - yk)
+                hk = jnp.maximum(p[:, k] * (1.0 - p[:, k]), 1e-9)
+                tree, nid = grow_tree(codes, gk * wt, hk * wt, wt, cfg,
+                                      col_mask, axis_name=axis_name)
+                margin = margin.at[:, k].add(lr * tree["value"][nid])
+                if has_valid:
+                    vc, _ = predict_binned(vcodes, tree, cfg.max_depth,
+                                           na_bin)
+                    vmargin = vmargin.at[:, k].add(lr * vc)
+                trees.append(tree)
+        stacked = {kk: jnp.stack([t[kk] for t in trees])
+                   for kk in trees[0]}
+        return (margin, vmargin, lr * anneal), stacked
+
+    (margin, vmargin, _), chunk_trees = jax.lax.scan(
+        one_tree, (margin, vmargin, lr0), jnp.arange(chunk))
+    return margin, vmargin, chunk_trees
+
+
+@lru_cache(maxsize=128)
+def _compiled_chunk(mesh, cfg, K, dist_name, tweedie_power, sample_rate,
+                    col_rate, na_bin, chunk, anneal, has_valid, has_t):
+    """Build + cache the sharded jitted chunk step for a given mesh/config.
+
+    Rows ride the mesh 'data' axis; tree arrays come back replicated (every
+    shard computes identical splits from the psum'd histograms — the same
+    redundancy the reference's per-node DTree split scan has)."""
+    body = partial(_gbm_chunk_body, cfg=cfg, K=K, dist_name=dist_name,
+                   tweedie_power=tweedie_power, sample_rate=sample_rate,
+                   col_rate=col_rate, na_bin=na_bin, chunk=chunk,
+                   anneal=anneal, has_valid=has_valid, has_t=has_t,
+                   axis_name=DATA_AXIS)
+    in_specs = (P(DATA_AXIS),                              # codes_rm
+                P(None, DATA_AXIS) if has_t else P(DATA_AXIS),  # codes_t/dummy
+                P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),  # margin, y, w
+                P(DATA_AXIS), P(DATA_AXIS),                # vrm, vmargin
+                P(), P(), P())                             # key, lr0, start
+    out_specs = (P(DATA_AXIS), P(DATA_AXIS), P())
+    f = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=False)
+    return jax.jit(f)
+
 
 class H2OGradientBoostingEstimator(ModelBuilder):
     algo = "gbm"
@@ -100,67 +232,6 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         merged = dict(GBM_DEFAULTS)
         merged.update(params)
         super().__init__(**merged)
-
-    # -- the chunked jitted training step ------------------------------
-    #
-    # ``chunk`` trees are built inside ONE jit via lax.scan: per-call
-    # dispatch overhead (which dominates through remote relays) amortises,
-    # and margins/trees stay on device between trees. The reference
-    # dispatches one MRTask per level per tree (SharedTree.java:566-635) —
-    # here a whole chunk of trees is a single XLA program.
-
-    @staticmethod
-    @partial(jax.jit, static_argnames=("cfg", "K", "dist_name", "tweedie_power",
-                                       "sample_rate", "col_rate", "na_bin",
-                                       "chunk", "anneal", "has_valid"))
-    def _train_chunk(codes, margin, y, w, vcodes, vmargin, base_key, lr0,
-                     start_idx, cfg, K, dist_name, tweedie_power,
-                     sample_rate, col_rate, na_bin, chunk, anneal, has_valid):
-        F = codes.shape[1]
-
-        def one_tree(carry, i):
-            margin, vmargin, lr = carry
-            key = jax.random.fold_in(base_key, start_idx + i)
-            key_r, key_c = jax.random.split(key)
-            wt = w
-            if sample_rate < 1.0:
-                wt = w * (jax.random.uniform(key_r, w.shape) < sample_rate)
-            col_mask = jnp.ones(F, bool)
-            if col_rate < 1.0:
-                col_mask = jax.random.uniform(key_c, (F,)) < col_rate
-            trees = []
-            if K == 1:
-                dist = get_distribution(dist_name, tweedie_power)
-                g, h = dist.grad_hess(margin, y)
-                tree, nid = grow_tree(codes, g * wt, h * wt, wt, cfg, col_mask)
-                # grow_tree already routed every row to its leaf — reuse
-                # nid instead of re-walking the tree (saves ~250ms/tree@1M)
-                margin = margin + lr * tree["value"][nid]
-                if has_valid:
-                    vc, _ = predict_binned(vcodes, tree, cfg.max_depth, na_bin)
-                    vmargin = vmargin + lr * vc
-                trees.append(tree)
-            else:
-                p = jax.nn.softmax(margin, axis=1)
-                for k in range(K):
-                    yk = (y == k).astype(jnp.float32)
-                    gk = (p[:, k] - yk)
-                    hk = jnp.maximum(p[:, k] * (1.0 - p[:, k]), 1e-9)
-                    tree, nid = grow_tree(codes, gk * wt, hk * wt, wt, cfg,
-                                          col_mask)
-                    margin = margin.at[:, k].add(lr * tree["value"][nid])
-                    if has_valid:
-                        vc, _ = predict_binned(vcodes, tree, cfg.max_depth,
-                                               na_bin)
-                        vmargin = vmargin.at[:, k].add(lr * vc)
-                    trees.append(tree)
-            stacked = {kk: jnp.stack([t[kk] for t in trees])
-                       for kk in trees[0]}
-            return (margin, vmargin, lr * anneal), stacked
-
-        (margin, vmargin, _), chunk_trees = jax.lax.scan(
-            one_tree, (margin, vmargin, lr0), jnp.arange(chunk))
-        return margin, vmargin, chunk_trees
 
     # -- driver ---------------------------------------------------------
 
@@ -197,66 +268,98 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             raise NotImplementedError(
                 "offset_column is not supported for multinomial GBM "
                 "(matching hex/tree/gbm/GBM.java offset restrictions)")
+        prior = self._resolve_checkpoint(dist_name, spec)
         if K == 1:
             yf = y.astype(jnp.float32)
-            f0 = dist.init_f0(yf, w)
-            margin = jnp.full(padded, f0, jnp.float32)
+            if prior is not None:
+                f0 = jnp.asarray(prior.f0)
+                margin = prior._margin_matrix(spec.X).astype(jnp.float32)
+            else:
+                f0 = dist.init_f0(yf, w)
+                margin = jnp.full(padded, f0, jnp.float32)
             if spec.offset is not None:
                 # offset enters the margin, not the trees: f = f0 + offset + Σ lr·tree
                 # (reference GBM honors offsets in every distribution's margin)
                 margin = margin + spec.offset
         else:
-            pri = jnp.maximum(
-                jnp.zeros(K, jnp.float32).at[y].add(w) / w.sum(), 1e-9)
-            f0 = jnp.log(pri)
-            margin = jnp.broadcast_to(f0, (padded, K)).astype(jnp.float32)
+            if prior is not None:
+                f0 = jnp.asarray(prior.f0)
+                margin = prior._margin_matrix(spec.X).astype(jnp.float32)
+            else:
+                pri = jnp.maximum(
+                    jnp.zeros(K, jnp.float32).at[y].add(w) / w.sum(), 1e-9)
+                f0 = jnp.log(pri)
+                margin = jnp.broadcast_to(f0, (padded, K)).astype(jnp.float32)
             yf = y
         seed = int(p.get("seed", -1) or -1)
         key = jax.random.PRNGKey(seed if seed != -1 else int(time.time() * 1e3) % (2**31))
         ntrees = int(p["ntrees"])
+        start_trees = prior.ntrees_built if prior is not None else 0
+        ntrees_new = ntrees - start_trees
         lr = float(p["learn_rate"])
         anneal = float(p["learn_rate_annealing"])
+        lr *= anneal ** start_trees
         col_rate = float(p["col_sample_rate"]) * float(p["col_sample_rate_per_tree"])
         keeper = ScoreKeeper(p.get("stopping_rounds", 0), p.get("stopping_metric"),
                              p.get("stopping_tolerance", 1e-3), task)
         interval = max(int(p.get("score_tree_interval", 5) or 5), 1)
         # validation margin tracked with train edges
+        mesh = current_mesh()
+        nd = n_data_shards(mesh)
+        if bm.codes.rm.shape[0] % nd != 0:
+            raise ValueError(
+                f"padded row count {bm.codes.rm.shape[0]} is not divisible by "
+                f"the {nd}-shard data axis — the training frame was built "
+                f"under a different mesh; rebuild it after h2o3_tpu.init()")
         has_valid = valid_spec is not None
         if has_valid:
+            if valid_spec.X.shape[0] % nd != 0:
+                raise ValueError(
+                    f"validation frame padded rows {valid_spec.X.shape[0]} "
+                    f"not divisible by the {nd}-shard data axis — rebuild it "
+                    f"after h2o3_tpu.init()")
             vcodes = make_codes_view(
                 digitize_with_edges(valid_spec.X, bm.edges, bm.n_bins))
-            vmargin = (jnp.full(valid_spec.X.shape[0], f0, jnp.float32) if K == 1
-                       else jnp.broadcast_to(f0, (valid_spec.X.shape[0], K)).astype(jnp.float32))
+            if prior is not None:
+                vmargin = prior._margin_matrix(valid_spec.X).astype(jnp.float32)
+            else:
+                vmargin = (jnp.full(valid_spec.X.shape[0], f0, jnp.float32) if K == 1
+                           else jnp.broadcast_to(f0, (valid_spec.X.shape[0], K)).astype(jnp.float32))
             if K == 1 and valid_spec.offset is not None:
                 vmargin = vmargin + valid_spec.offset
         else:  # small dummies (untraced branches, but args need shapes)
-            vcodes = make_codes_view(jnp.zeros((8, bm.n_features),
+            vcodes = make_codes_view(jnp.zeros((8 * nd, bm.n_features),
                                                bm.codes.dtype))
-            vmargin = (jnp.zeros(8, jnp.float32) if K == 1
-                       else jnp.zeros((8, K), jnp.float32))
+            vmargin = (jnp.zeros(8 * nd, jnp.float32) if K == 1
+                       else jnp.zeros((8 * nd, K), jnp.float32))
 
-        chunk = interval if keeper.rounds > 0 else min(ntrees, 50)
+        chunk = interval if keeper.rounds > 0 else min(ntrees_new, 50)
+        has_t = bm.codes.t is not None
+        codes_t_arg = bm.codes.t if has_t else bm.codes.rm  # ignored dummy
         all_trees = []
         built = 0
         jax.block_until_ready(margin)
         t_loop0 = time.time()
-        while built < ntrees:
-            c = min(chunk, ntrees - built)
-            margin, vmargin, chunk_trees = self._train_chunk(
-                bm.codes, margin, yf, w, vcodes, vmargin, key,
-                jnp.float32(lr), built, cfg, K, dist_name,
-                float(p["tweedie_power"]), float(p["sample_rate"]), col_rate,
-                bm.na_bin, c, anneal, has_valid)
+        while built < ntrees_new:
+            c = min(chunk, ntrees_new - built)
+            step = _compiled_chunk(mesh, cfg, K, dist_name,
+                                   float(p["tweedie_power"]),
+                                   float(p["sample_rate"]), col_rate,
+                                   bm.na_bin, c, anneal, has_valid, has_t)
+            margin, vmargin, chunk_trees = step(
+                bm.codes.rm, codes_t_arg, margin, yf, w, vcodes.rm, vmargin,
+                key, jnp.float32(lr), jnp.int32(start_trees + built))
             all_trees.append(chunk_trees)  # stays on device until finalize
             built += c
             lr *= anneal ** c
-            job.set_progress(0.5 * built / ntrees)
+            job.set_progress(0.5 * built / ntrees_new)
             if job.cancel_requested:
                 break
             if keeper.rounds > 0:
                 sc_spec = valid_spec if has_valid else spec
                 sc_margin = vmargin if has_valid else margin
-                entry = self._score_entry(sc_margin, sc_spec, dist, K, built,
+                entry = self._score_entry(sc_margin, sc_spec, dist, K,
+                                          start_trees + built,
                                           want_auc=keeper.metric == "auc")
                 keeper.record(entry)
                 if keeper.should_stop():
@@ -266,9 +369,40 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         t_loop = time.time() - t_loop0
         model = self._finalize(spec, valid_spec, dist_name, f0, all_trees, bm,
                                cfg, K, built, margin,
-                               vmargin if has_valid else None, keeper)
+                               vmargin if has_valid else None, keeper,
+                               tree_offset=start_trees, prior=prior)
         model.output["training_loop_seconds"] = t_loop
         return model
+
+    def _resolve_checkpoint(self, dist_name: str, spec: TrainingSpec):
+        """Continue-training support (hex/Model.java:487 _checkpoint): the
+        checkpoint model's trees seed the margin; ntrees is the TOTAL tree
+        count, so training builds ntrees - prior.ntrees_built new trees."""
+        ckpt = self.params.get("checkpoint")
+        if not ckpt:
+            return None
+        if isinstance(ckpt, GBMModel):
+            prior = ckpt
+        else:
+            from h2o3_tpu.persist import load_model
+            prior = load_model(ckpt)
+        if prior.dist_name != dist_name:
+            raise ValueError(
+                f"checkpoint distribution '{prior.dist_name}' != "
+                f"'{dist_name}' (checkpoint params must match — "
+                f"hex/ModelBuilder checkpoint contract)")
+        if prior.max_depth != int(self.params["max_depth"]):
+            raise ValueError("checkpoint max_depth differs")
+        if int(self.params["ntrees"]) <= prior.ntrees_built:
+            raise ValueError(
+                f"ntrees ({self.params['ntrees']}) must exceed the "
+                f"checkpoint's ntrees_built ({prior.ntrees_built})")
+        if list(prior.feature_names) != list(spec.names):
+            raise ValueError(
+                f"checkpoint feature set {prior.feature_names} differs from "
+                f"the training spec's {spec.names} — the prior trees' feature "
+                f"indices would address the wrong columns")
+        return prior
 
     def _score_entry(self, margin, sc_spec, dist, K, built,
                      want_auc: bool = False) -> Dict:
@@ -296,7 +430,8 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         return {"ntrees": built, "logloss": ll, "deviance": ll}
 
     def _finalize(self, spec, valid_spec, dist_name, f0, all_trees, bm, cfg,
-                  K, built, margin, vmargin, keeper) -> GBMModel:
+                  K, built, margin, vmargin, keeper, tree_offset=0,
+                  prior=None) -> GBMModel:
         M = cfg.n_nodes
         T = built * max(K, 1)
         host = [{k: np.asarray(jax.device_get(v)) for k, v in t.items()}
@@ -309,20 +444,40 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         gains = np.concatenate([t["gain"].reshape(-1, M) for t in host])
         lr0 = float(self.params["learn_rate"])
         anneal = float(self.params["learn_rate_annealing"])
-        lrs = lr0 * anneal ** np.repeat(np.arange(built), max(K, 1))
+        lrs = lr0 * anneal ** np.repeat(
+            np.arange(tree_offset, tree_offset + built), max(K, 1))
         val_scaled = val * lrs[:, None]
         thr = np.stack([bins_to_thresholds(sbin[i], feat[i], bm.edges)
                         for i in range(T)])
         trees_host = {"feat": feat, "thr": thr, "na_left": nal,
                       "is_split": spl, "value": val_scaled}
+        if prior is not None:
+            # checkpoint continuation: prepend the prior model's trees
+            # (already lr-scaled) in (tree, class) order
+            trees_host = {
+                "feat": np.concatenate([np.asarray(prior._feat), feat]),
+                "thr": np.concatenate([np.asarray(prior._thr), thr]),
+                "na_left": np.concatenate([np.asarray(prior._na_left), nal]),
+                "is_split": np.concatenate([np.asarray(prior._is_split), spl]),
+                "value": np.concatenate([np.asarray(prior._value), val_scaled]),
+            }
         f0_host = np.asarray(jax.device_get(f0))
         model = GBMModel(f"{self.algo}_{id(self) & 0xffffff:x}", self.params,
                          spec, dist_name, f0_host, trees_host, bm.edges,
-                         bm.n_bins, cfg.max_depth, built, spec.nclasses)
-        # variable importances from split gains
+                         bm.n_bins, cfg.max_depth, tree_offset + built,
+                         spec.nclasses)
+        # variable importances from split gains (merged with the prior's on
+        # checkpoint continuation)
         vi = np.zeros(len(spec.names))
         live = feat >= 0
         np.add.at(vi, feat[live], gains[live])
+        if prior is not None:
+            pv = prior.output.get("variable_importances")
+            if pv:
+                lut = {n: i for i, n in enumerate(spec.names)}
+                for n, g in zip(pv["variable"], pv["relative_importance"]):
+                    if n in lut:
+                        vi[lut[n]] += g
         order = np.argsort(-vi)
         rel = vi / vi.max() if vi.max() > 0 else vi
         model.output["variable_importances"] = {
@@ -351,3 +506,8 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         mu = dist.predict(margin)
         dev = float(jax.device_get(dist.deviance(spec.w, spec.y.astype(jnp.float32), mu)))
         return compute_metrics(mu, spec.y, spec.w, 1, deviance=dev)
+
+
+from h2o3_tpu.persist import register_model_class  # noqa: E402
+
+register_model_class("gbm", GBMModel)

@@ -264,6 +264,43 @@ class Model:
         spec_like = build_training_spec(frame, self.response, classification=False)
         return compute_metrics(out, spec_like.y, spec_like.w, 1)
 
+    # -- persistence hooks (persist.save_model/load_model) -------------
+
+    def _save_arrays(self) -> Dict[str, np.ndarray]:
+        """Per-algo tensors to persist (trees, coefficients, weights…)."""
+        return {}
+
+    def _save_extra_meta(self) -> Dict[str, Any]:
+        """Per-algo JSON metadata to persist."""
+        return {}
+
+    @classmethod
+    def _restore_base(cls, meta) -> "Model":
+        """Rebuild the base Model state from artifact metadata (subclass
+        _restore() fills algo-specific fields)."""
+        m = cls.__new__(cls)
+        m.key = meta["key"]
+        m.params = dict(meta["params"] or {})
+        m.feature_names = list(meta["feature_names"])
+        m.feature_is_cat = list(meta["feature_is_cat"])
+        m.cat_domains = {k: tuple(v) for k, v in
+                         (meta.get("cat_domains") or {}).items()}
+        m.response = meta["response"]
+        rd = meta.get("response_domain")
+        m.response_domain = tuple(rd) if rd else None
+        m.nclasses = meta["nclasses"]
+        m.output = dict(meta.get("output") or {})
+        m.training_metrics = None
+        m.validation_metrics = None
+        m.cross_validation_metrics = None
+        m.scoring_history = []
+        m.run_time = 0.0
+        return m
+
+    @classmethod
+    def _restore(cls, meta, arrays) -> "Model":
+        raise NotImplementedError(f"{cls.__name__} does not support load yet")
+
     # -- convenience accessors (h2o-py parity) -------------------------
 
     def _metric(self, name, valid=False):

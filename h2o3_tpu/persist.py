@@ -1,0 +1,197 @@
+"""Model persistence — h2o.save_model / h2o.load_model + frame export.
+
+Reference: water/persist/PersistManager.java (URI-scheme-dispatched
+backends: file/NFS/S3/GCS/HDFS/HTTP), binary model save/load wired to
+h2o.save_model/load_model (h2o-py/h2o/h2o.py), and Model.Parameters
+_checkpoint continue-training (hex/Model.java:487).
+
+TPU re-design: a model artifact is a single pickle-free zip —
+``meta.json`` (params, feature/domain metadata, metrics) +
+``arrays.npz`` (numpy tensors) — written by per-algo hooks
+(Model._save_arrays/_save_extra_meta/_restore). The reference's woven
+Icer serializers (water/Weaver.java) collapse into this explicit
+JSON+npz contract; only ``file://`` paths are implemented (S3/GCS would
+dispatch here the same way PersistManager does).
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import zipfile
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+FORMAT_VERSION = 1
+
+# algo → model class, filled lazily to avoid import cycles
+_MODEL_CLASSES: Dict[str, Any] = {}
+
+
+def register_model_class(algo: str, cls) -> None:
+    _MODEL_CLASSES[algo] = cls
+
+
+def _model_class(algo: str):
+    if not _MODEL_CLASSES:
+        # import the algo modules once; each registers its model class
+        from h2o3_tpu.models import gbm  # noqa: F401
+        try:
+            from h2o3_tpu.models import drf  # noqa: F401
+        except ImportError:
+            pass
+        try:
+            from h2o3_tpu.models import glm  # noqa: F401
+        except ImportError:
+            pass
+        try:
+            from h2o3_tpu.models import deeplearning  # noqa: F401
+        except ImportError:
+            pass
+        try:
+            from h2o3_tpu.models import kmeans, pca  # noqa: F401
+        except ImportError:
+            pass
+    if algo not in _MODEL_CLASSES:
+        raise ValueError(f"no registered model class for algo '{algo}'")
+    return _MODEL_CLASSES[algo]
+
+
+def _json_safe(obj):
+    """Recursively convert to JSON-serializable python (numpy → lists,
+    unknown objects dropped)."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    if isinstance(obj, dict):
+        return {str(k): _json_safe(v) for k, v in obj.items()
+                if _is_safe(v)}
+    return None
+
+
+def _is_safe(v) -> bool:
+    return isinstance(v, (type(None), bool, int, float, str, list, tuple,
+                          dict, np.ndarray, np.integer, np.floating))
+
+
+def _metrics_to_meta(m) -> Optional[Dict]:
+    if m is None:
+        return None
+    from h2o3_tpu.models import metrics as mm
+    kind = {mm.ModelMetricsRegression: "regression",
+            mm.ModelMetricsBinomial: "binomial",
+            mm.ModelMetricsMultinomial: "multinomial"}.get(type(m))
+    if kind is None:
+        return None
+    import dataclasses
+    return {"kind": kind,
+            "fields": _json_safe(dataclasses.asdict(m))}
+
+
+def _metrics_from_meta(meta: Optional[Dict]):
+    if meta is None:
+        return None
+    from h2o3_tpu.models import metrics as mm
+    cls = {"regression": mm.ModelMetricsRegression,
+           "binomial": mm.ModelMetricsBinomial,
+           "multinomial": mm.ModelMetricsMultinomial}[meta["kind"]]
+    f = dict(meta["fields"])
+    for k in ("confusion_matrix", "hit_ratios"):
+        if k in f and f[k] is not None:
+            f[k] = np.asarray(f[k])
+    import dataclasses
+    names = {fl.name for fl in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in f.items() if k in names})
+
+
+def save_model(model, path: str = ".", force: bool = False,
+               filename: Optional[str] = None) -> str:
+    """Write a model artifact; returns the artifact path (h2o.save_model
+    signature)."""
+    if os.path.isdir(path) or not os.path.splitext(path)[1]:
+        os.makedirs(path, exist_ok=True)
+        out = os.path.join(path, filename or model.key)
+    else:
+        out = path
+    if os.path.exists(out) and not force:
+        raise FileExistsError(f"{out} exists (pass force=True to overwrite)")
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "algo": model.algo,
+        "key": model.key,
+        "params": _json_safe(model.params),
+        "feature_names": model.feature_names,
+        "feature_is_cat": model.feature_is_cat,
+        "cat_domains": {k: list(v) for k, v in model.cat_domains.items()},
+        "response": model.response,
+        "response_domain": (list(model.response_domain)
+                            if model.response_domain else None),
+        "nclasses": model.nclasses,
+        "output": _json_safe(model.output),
+        "scoring_history": _json_safe(model.scoring_history),
+        "training_metrics": _metrics_to_meta(model.training_metrics),
+        "validation_metrics": _metrics_to_meta(model.validation_metrics),
+        "cross_validation_metrics": _metrics_to_meta(
+            model.cross_validation_metrics),
+        "extra": _json_safe(model._save_extra_meta()),
+    }
+    arrays = {k: np.asarray(v) for k, v in model._save_arrays().items()}
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    with zipfile.ZipFile(out, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("meta.json", json.dumps(meta))
+        zf.writestr("arrays.npz", buf.getvalue())
+    return out
+
+
+def load_model(path: str):
+    """Read a model artifact back into a live Model (h2o.load_model)."""
+    with zipfile.ZipFile(path, "r") as zf:
+        meta = json.loads(zf.read("meta.json"))
+        arrays = dict(np.load(io.BytesIO(zf.read("arrays.npz"))))
+    if meta.get("format_version", 0) > FORMAT_VERSION:
+        raise ValueError(f"artifact format {meta['format_version']} is newer "
+                         f"than this build ({FORMAT_VERSION})")
+    cls = _model_class(meta["algo"])
+    model = cls._restore(meta, arrays)
+    model.training_metrics = _metrics_from_meta(meta.get("training_metrics"))
+    model.validation_metrics = _metrics_from_meta(
+        meta.get("validation_metrics"))
+    model.cross_validation_metrics = _metrics_from_meta(
+        meta.get("cross_validation_metrics"))
+    model.scoring_history = meta.get("scoring_history") or []
+    return model
+
+
+def export_file(frame, path: str, force: bool = False, sep: str = ",") -> str:
+    """Frame → CSV on disk (h2o.export_file; reference
+    water/api/FramesHandler export + persist layer)."""
+    if os.path.exists(path) and not force:
+        raise FileExistsError(f"{path} exists (pass force=True to overwrite)")
+    cols = [v.to_strings() if v.type == "enum" or v.type == "string"
+            else v.to_numpy() for v in frame.vecs]
+    with open(path, "w") as f:
+        f.write(sep.join(f'"{n}"' for n in frame.names) + "\n")
+        for i in range(frame.nrow):
+            cells = []
+            for c in cols:
+                x = c[i]
+                if x is None or (isinstance(x, (float, np.floating))
+                                 and np.isnan(x)):
+                    cells.append("")
+                elif isinstance(x, str):
+                    cells.append(f'"{x}"')
+                elif isinstance(x, (float, np.floating)):
+                    cells.append(repr(float(x)))
+                else:
+                    cells.append(str(x))
+            f.write(sep.join(cells) + "\n")
+    return path

@@ -1,0 +1,134 @@
+"""Multi-chip GBM through the PRODUCT path (VERDICT.md Weak #2): the
+shipped H2OGradientBoostingEstimator must train across the mesh and
+produce the same model as a single-device run.
+
+Reference contract: Rabit allreduce inside the training loop
+(hex/tree/xgboost/rabit/RabitTrackerH2O.java) / MRTask reduce tree
+(water/MRTask.java:871-926) — here the psum inside grow_tree, reached via
+the estimator's shard_mapped chunk step."""
+import jax
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+from h2o3_tpu.parallel.mesh import current_mesh, make_mesh, set_mesh
+
+
+def _train(mesh, X, y, **params):
+    old = current_mesh()
+    set_mesh(mesh)
+    try:
+        cols = {f"f{i}": X[:, i] for i in range(X.shape[1])}
+        cols["y"] = y
+        fr = h2o.Frame.from_numpy(cols)
+        gbm = H2OGradientBoostingEstimator(seed=7, **params)
+        gbm.train(y="y", training_frame=fr)
+        pred = gbm.model.predict(fr)
+        return gbm.model, pred
+    finally:
+        set_mesh(old)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_estimator_mesh_first_tree_exact():
+    """First tree from the initial margin, balanced y (so f0=0 and the
+    bernoulli (g,h) are dyadic → psum is order-independent): the (4,2)-mesh
+    estimator must reproduce the single-device tree BIT-FOR-BIT."""
+    rng = np.random.default_rng(11)
+    n, F = 2048, 6
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    X[rng.random((n, F)) < 0.05] = np.nan
+    y = ((X[:, 0] > 0) ^ (np.nan_to_num(X[:, 1]) > 0.3)).astype(np.float32)
+    assert y.mean() == 0.5 or True  # balance not required to be exact; f0
+    # dyadicity only matters when it is — force balance by trimming:
+    idx1 = np.nonzero(y == 1)[0]
+    idx0 = np.nonzero(y == 0)[0]
+    k = min(len(idx0), len(idx1), 1000)
+    sel = np.sort(np.concatenate([idx0[:k], idx1[:k]]))
+    X, y = X[sel], y[sel]
+    params = dict(ntrees=1, max_depth=4, nbins=16, distribution="bernoulli",
+                  min_rows=2.0, sample_rate=1.0, score_tree_interval=0,
+                  stopping_rounds=0)
+
+    m1, _ = _train(make_mesh(n_data=1, n_model=1,
+                             devices=jax.devices()[:1]), X, y, **params)
+    m8, _ = _train(make_mesh(n_data=4, n_model=2), X, y, **params)
+
+    np.testing.assert_array_equal(np.asarray(m1._feat), np.asarray(m8._feat))
+    np.testing.assert_array_equal(np.asarray(m1._is_split),
+                                  np.asarray(m8._is_split))
+    np.testing.assert_array_equal(np.asarray(m1._thr), np.asarray(m8._thr))
+    np.testing.assert_allclose(np.asarray(m1._value), np.asarray(m8._value),
+                               rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_estimator_mesh_full_run_parity():
+    """Full boosting run: per-shard psum reduce order differs from the
+    single-device sum in the last ulp, so deep-tree splits near the gain
+    threshold may flip (the reference tolerates the same MRTask float
+    nondeterminism — SURVEY.md §7.3). The MODEL must agree: predictions
+    close, metrics near-identical, and the vast majority of split nodes
+    identical."""
+    rng = np.random.default_rng(11)
+    n, F = 2048, 6
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    X[rng.random((n, F)) < 0.05] = np.nan
+    y = ((X[:, 0] > 0) ^ (np.nan_to_num(X[:, 1]) > 0.3)).astype(np.float32)
+    params = dict(ntrees=7, max_depth=4, nbins=16, distribution="bernoulli",
+                  min_rows=2.0, sample_rate=1.0, score_tree_interval=0,
+                  stopping_rounds=0)
+
+    m1, p1 = _train(make_mesh(n_data=1, n_model=1,
+                              devices=jax.devices()[:1]), X, y, **params)
+    m8, p8 = _train(make_mesh(n_data=4, n_model=2), X, y, **params)
+
+    same_feat = (np.asarray(m1._feat) == np.asarray(m8._feat)).mean()
+    assert same_feat > 0.9, same_feat
+    np.testing.assert_allclose(p1.vec("p1").to_numpy(), p8.vec("p1").to_numpy(),
+                               atol=0.03)
+    assert abs(m1.training_metrics.auc - m8.training_metrics.auc) < 2e-3
+    assert abs(m1.training_metrics.logloss - m8.training_metrics.logloss) < 2e-3
+    assert m8.training_metrics.auc > 0.9
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_estimator_mesh_sampled_run():
+    """Row/column sampling across shards (shard-decorrelated RNG): not
+    bit-identical to single-device, but must train a good model."""
+    rng = np.random.default_rng(12)
+    n, F = 4096, 8
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] + 0.2 * rng.normal(size=n) > 0
+         ).astype(np.float32)
+    m, _ = _train(make_mesh(n_data=8, n_model=1), X, y,
+                  ntrees=20, max_depth=4, nbins=32, distribution="bernoulli",
+                  sample_rate=0.7, col_sample_rate=0.8, min_rows=2.0)
+    assert m.training_metrics.auc > 0.85
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_estimator_mesh_multinomial():
+    """Enum-response multinomial through the sharded estimator path."""
+    rng = np.random.default_rng(13)
+    n = 2048
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
+    old = current_mesh()
+    set_mesh(make_mesh(n_data=8, n_model=1))
+    try:
+        cols = {f"f{i}": X[:, i] for i in range(4)}
+        cols["y"] = np.array([f"c{c}" for c in y], dtype=object)
+        fr = h2o.Frame.from_numpy(cols)
+        gbm = H2OGradientBoostingEstimator(seed=7, ntrees=5, max_depth=3,
+                                           distribution="multinomial",
+                                           min_rows=2.0)
+        gbm.train(y="y", training_frame=fr)
+        m = gbm.model
+        pred = m.predict(fr)
+        assert pred.vec("predict").domain == ("c0", "c1", "c2")
+        assert {"pc0", "pc1", "pc2"} <= set(pred.names)
+    finally:
+        set_mesh(old)
+    assert m.training_metrics.logloss < 0.7

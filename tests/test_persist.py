@@ -1,0 +1,136 @@
+"""Model persistence (persist.py): save/load round-trip equality and
+_checkpoint continue-training (reference: water/persist/PersistManager.java,
+hex/Model.java:487 _checkpoint, h2o.save_model/load_model)."""
+import os
+
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+
+def _make_frame(n=2000, seed=21):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    X[rng.random((n, 5)) < 0.05] = np.nan
+    y = ((X[:, 0] > 0) ^ (np.nan_to_num(X[:, 1]) > 0.2)).astype(np.float32)
+    cols = {f"f{i}": X[:, i] for i in range(5)}
+    cols["cls"] = np.array([f"c{int(v)}" for v in y], dtype=object)
+    return h2o.Frame.from_numpy(cols)
+
+
+def test_save_load_roundtrip_binomial(tmp_path):
+    fr = _make_frame()
+    gbm = H2OGradientBoostingEstimator(ntrees=10, max_depth=4, seed=3,
+                                       min_rows=5.0)
+    gbm.train(y="cls", training_frame=fr)
+    m = gbm.model
+    path = h2o.save_model(m, str(tmp_path))
+    assert os.path.exists(path)
+    m2 = h2o.load_model(path)
+    # predictions identical
+    p1 = m.predict(fr)
+    p2 = m2.predict(fr)
+    np.testing.assert_array_equal(p1.vec("pc1").to_numpy(),
+                                  p2.vec("pc1").to_numpy())
+    np.testing.assert_array_equal(p1.vec("predict").to_numpy(),
+                                  p2.vec("predict").to_numpy())
+    # metadata survives
+    assert m2.response_domain == m.response_domain
+    assert m2.training_metrics.auc == pytest.approx(m.training_metrics.auc)
+    assert m2.auc() == pytest.approx(m.auc())
+    assert m2.output["variable_importances"]["variable"] == \
+        m.output["variable_importances"]["variable"]
+    # scoring a fresh metrics pass must work from the loaded model
+    perf = m2.model_performance(fr)
+    assert perf.auc == pytest.approx(m.training_metrics.auc, abs=1e-6)
+
+
+def test_save_load_regression_multinomial(tmp_path):
+    rng = np.random.default_rng(8)
+    n = 1500
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    # regression
+    fr = h2o.Frame.from_numpy({"a": X[:, 0], "b": X[:, 1],
+                               "y": (2 * X[:, 0] - X[:, 1]).astype(np.float32)})
+    g = H2OGradientBoostingEstimator(ntrees=8, max_depth=3, seed=1)
+    g.train(y="y", training_frame=fr)
+    p = h2o.save_model(g.model, str(tmp_path), filename="reg")
+    m2 = h2o.load_model(p)
+    np.testing.assert_array_equal(g.model.predict(fr).vec("predict").to_numpy(),
+                                  m2.predict(fr).vec("predict").to_numpy())
+    # multinomial
+    yk = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
+    fr3 = h2o.Frame.from_numpy({"a": X[:, 0], "b": X[:, 1], "c": X[:, 2],
+                                "y": np.array([f"k{v}" for v in yk], dtype=object)})
+    g3 = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=1,
+                                      distribution="multinomial")
+    g3.train(y="y", training_frame=fr3)
+    p3 = h2o.save_model(g3.model, str(tmp_path), filename="multi")
+    m3 = h2o.load_model(p3)
+    np.testing.assert_array_equal(
+        g3.model.predict(fr3).vec("predict").to_numpy(),
+        m3.predict(fr3).vec("predict").to_numpy())
+
+
+def test_checkpoint_continuation(tmp_path):
+    fr = _make_frame(seed=22)
+    base = H2OGradientBoostingEstimator(ntrees=8, max_depth=3, seed=5,
+                                        min_rows=5.0, score_tree_interval=0)
+    base.train(y="cls", training_frame=fr)
+    path = h2o.save_model(base.model, str(tmp_path))
+
+    # continue from the saved artifact to 20 total trees
+    cont = H2OGradientBoostingEstimator(ntrees=20, max_depth=3, seed=5,
+                                        min_rows=5.0, score_tree_interval=0,
+                                        checkpoint=path)
+    cont.train(y="cls", training_frame=fr)
+    assert cont.model.ntrees_built == 20
+
+    # a fresh 20-tree run on the same seed should closely agree (binned vs
+    # raw-threshold margins reorder float sums → tolerance, not equality)
+    fresh = H2OGradientBoostingEstimator(ntrees=20, max_depth=3, seed=5,
+                                         min_rows=5.0, score_tree_interval=0)
+    fresh.train(y="cls", training_frame=fr)
+    pc = cont.model.predict(fr).vec("pc1").to_numpy()
+    pf = fresh.model.predict(fr).vec("pc1").to_numpy()
+    np.testing.assert_allclose(pc, pf, atol=0.02)
+    assert abs(cont.model.training_metrics.auc -
+               fresh.model.training_metrics.auc) < 5e-3
+    # continuation must actually improve on the base model
+    assert cont.model.training_metrics.logloss < \
+        base.model.training_metrics.logloss
+
+
+def test_checkpoint_validation_errors(tmp_path):
+    fr = _make_frame(seed=23)
+    base = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=5)
+    base.train(y="cls", training_frame=fr)
+    # ntrees must exceed the checkpoint's (train() propagates via Job.join)
+    c1 = H2OGradientBoostingEstimator(ntrees=5, max_depth=3,
+                                      checkpoint=base.model)
+    with pytest.raises(RuntimeError, match="must exceed"):
+        c1.train(y="cls", training_frame=fr)
+    # max_depth must match
+    c2 = H2OGradientBoostingEstimator(ntrees=10, max_depth=4,
+                                      checkpoint=base.model)
+    with pytest.raises(RuntimeError, match="max_depth"):
+        c2.train(y="cls", training_frame=fr)
+    # feature set must match
+    fr2 = fr.drop("f4")
+    c3 = H2OGradientBoostingEstimator(ntrees=10, max_depth=3,
+                                      checkpoint=base.model)
+    with pytest.raises(RuntimeError, match="feature set"):
+        c3.train(y="cls", training_frame=fr2)
+
+
+def test_export_file(tmp_path):
+    fr = _make_frame(n=50)
+    path = str(tmp_path / "out.csv")
+    h2o.export_file(fr, path)
+    back = h2o.import_file(path)
+    assert back.nrow == 50
+    assert back.names == fr.names
+    np.testing.assert_allclose(back.vec("f0").to_numpy(),
+                               fr.vec("f0").to_numpy(), rtol=1e-6)
