@@ -1,0 +1,331 @@
+"""DRF — distributed random forest on the shared histogram tree machinery.
+
+Reference: hex/tree/drf/DRF.java:30 over hex/tree/SharedTree.java — per-node
+mtries feature subsets, row sampling per tree (default 0.632), OOB
+("out-of-bag") scoring reported as the training metrics, class-probability
+leaves (each tree's leaf stores the weighted class fraction / mean
+response, not a boosting step).
+
+TPU re-design: trees are independent, so a whole chunk builds inside one
+shard_mapped lax.scan (like GBM's chunk step, models/gbm.py) with the
+histogram psum over the 'data' mesh axis; mtries is a per-node random
+feature mask drawn inside grow_tree (models/tree.py). Leaf values come
+from the same Newton formula with (g, h) = (-y·w, w) ⇒ leaf = weighted
+mean of the (indicator) response — the variance-reduction criterion.
+Static-shape note: trees are complete binary arrays, so max_depth is
+capped at 16 (the reference default is 20, practically limited by
+min_rows; histograms at depth d need 2^(d-1)·F·(B+1)·3 floats).
+"""
+from __future__ import annotations
+
+import time
+from functools import lru_cache, partial
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from h2o3_tpu.jobs import Job
+from h2o3_tpu.models.model_base import (Model, ModelBuilder, ScoreKeeper,
+                                        TrainingSpec, compute_metrics)
+from h2o3_tpu.models.tree import (TreeConfig, bins_to_thresholds, grow_tree,
+                                  predict_raw_stacked)
+from h2o3_tpu.ops.binning import CodesView, bin_matrix, make_codes_view
+from h2o3_tpu.parallel.mesh import DATA_AXIS, current_mesh, n_data_shards
+from h2o3_tpu.persist import register_model_class
+
+MAX_DEPTH_CAP = 16
+
+DRF_DEFAULTS: Dict = dict(
+    ntrees=50, max_depth=16, min_rows=1.0, nbins=20, nbins_cats=1024,
+    mtries=-1, sample_rate=0.632, col_sample_rate_per_tree=1.0,
+    min_split_improvement=1e-5, seed=-1, histogram_type="quantiles_global",
+    score_tree_interval=0, stopping_rounds=0, stopping_metric="auto",
+    stopping_tolerance=1e-3, hist_kernel="auto", reg_lambda=0.0,
+)
+
+
+class DRFModel(Model):
+    algo = "drf"
+
+    def __init__(self, key, params, spec, trees_host, edges, n_bins,
+                 max_depth, ntrees_built, nclasses):
+        super().__init__(key, params, spec)
+        self.edges = edges
+        self.n_bins = n_bins
+        self.max_depth = max_depth
+        self.ntrees_built = ntrees_built
+        self._K = max(nclasses, 1) if nclasses > 2 else 1
+        self._feat = jnp.asarray(trees_host["feat"])
+        self._thr = jnp.asarray(trees_host["thr"])
+        self._na_left = jnp.asarray(trees_host["na_left"])
+        self._is_split = jnp.asarray(trees_host["is_split"])
+        self._value = jnp.asarray(trees_host["value"])
+
+    def _predict_matrix(self, X, offset=None):
+        contribs = predict_raw_stacked(X, self._feat, self._thr, self._na_left,
+                                       self._is_split, self._value,
+                                       self.max_depth)
+        T = self.ntrees_built
+        if self.nclasses <= 1:
+            return contribs.mean(axis=1)
+        if self.nclasses == 2:
+            p1 = jnp.clip(contribs.mean(axis=1), 0.0, 1.0)
+            return jnp.stack([1.0 - p1, p1], axis=1)
+        per_class = jnp.clip(
+            contribs.reshape(X.shape[0], T, self._K).mean(axis=1), 0.0, 1.0)
+        return per_class / jnp.maximum(per_class.sum(axis=1, keepdims=True),
+                                       1e-12)
+
+    def varimp(self, use_pandas=False):
+        return self.output.get("variable_importances")
+
+    # -- persistence ----------------------------------------------------
+
+    def _save_arrays(self):
+        d = {"feat": np.asarray(jax.device_get(self._feat)),
+             "thr": np.asarray(jax.device_get(self._thr)),
+             "na_left": np.asarray(jax.device_get(self._na_left)),
+             "is_split": np.asarray(jax.device_get(self._is_split)),
+             "value": np.asarray(jax.device_get(self._value))}
+        for i, e in enumerate(self.edges):
+            d[f"edge_{i}"] = np.asarray(e)
+        return d
+
+    def _save_extra_meta(self):
+        return {"n_bins": self.n_bins, "max_depth": self.max_depth,
+                "ntrees_built": self.ntrees_built,
+                "n_edges": len(self.edges)}
+
+    @classmethod
+    def _restore(cls, meta, arrays):
+        m = cls._restore_base(meta)
+        ex = meta["extra"]
+        m.n_bins = ex["n_bins"]
+        m.max_depth = ex["max_depth"]
+        m.ntrees_built = ex["ntrees_built"]
+        m.edges = [arrays[f"edge_{i}"] for i in range(ex["n_edges"])]
+        m._K = max(m.nclasses, 1) if m.nclasses > 2 else 1
+        m._feat = jnp.asarray(arrays["feat"])
+        m._thr = jnp.asarray(arrays["thr"])
+        m._na_left = jnp.asarray(arrays["na_left"])
+        m._is_split = jnp.asarray(arrays["is_split"])
+        m._value = jnp.asarray(arrays["value"])
+        return m
+
+
+def _drf_chunk_body(codes_rm, codes_t, y, w, oob_num, oob_cnt, base_key,
+                    start_idx, *, cfg, K, sample_rate, col_rate, chunk,
+                    has_t, axis_name):
+    """A chunk of independent forest trees per data shard; OOB sums ride
+    the scan carry (reference: DRF's OOB rows are scored by the trees that
+    did not sample them — hex/tree/drf/DRF.java OOB machinery)."""
+    codes = CodesView(rm=codes_rm, t=codes_t if has_t else None)
+    F = codes_rm.shape[1]
+    shard = jax.lax.axis_index(axis_name) if axis_name else 0
+
+    def one_tree(carry, i):
+        oob_num, oob_cnt = carry
+        key = jax.random.fold_in(base_key, start_idx + i)
+        key_r, key_c, key_m = jax.random.split(key, 3)
+        key_r = jax.random.fold_in(key_r, shard)
+        sampled = jax.random.uniform(key_r, w.shape) < sample_rate
+        wt = w * sampled
+        col_mask = jnp.ones(F, bool)
+        if col_rate < 1.0:
+            col_mask = jax.random.uniform(key_c, (F,)) < col_rate
+        live_oob = (w > 0) & ~sampled
+        trees = []
+        if K == 1:
+            yf = y.astype(jnp.float32)
+            tree, nid = grow_tree(codes, -(yf * wt), wt, wt, cfg, col_mask,
+                                  axis_name=axis_name, key=key_m)
+            pred = tree["value"][nid]
+            oob_num = oob_num + jnp.where(live_oob, pred, 0.0)
+            oob_cnt = oob_cnt + live_oob.astype(jnp.float32)
+            trees.append(tree)
+        else:
+            preds = []
+            for k in range(K):
+                yk = (y == k).astype(jnp.float32)
+                tree, nid = grow_tree(codes, -(yk * wt), wt, wt, cfg,
+                                      col_mask, axis_name=axis_name,
+                                      key=jax.random.fold_in(key_m, k))
+                preds.append(tree["value"][nid])
+                trees.append(tree)
+            pk = jnp.stack(preds, axis=1)
+            oob_num = oob_num + jnp.where(live_oob[:, None], pk, 0.0)
+            oob_cnt = oob_cnt + live_oob.astype(jnp.float32)
+        stacked = {kk: jnp.stack([t[kk] for t in trees]) for kk in trees[0]}
+        return (oob_num, oob_cnt), stacked
+
+    (oob_num, oob_cnt), chunk_trees = jax.lax.scan(
+        one_tree, (oob_num, oob_cnt), jnp.arange(chunk))
+    return oob_num, oob_cnt, chunk_trees
+
+
+@lru_cache(maxsize=128)
+def _compiled_drf_chunk(mesh, cfg, K, sample_rate, col_rate, chunk, has_t):
+    body = partial(_drf_chunk_body, cfg=cfg, K=K, sample_rate=sample_rate,
+                   col_rate=col_rate, chunk=chunk, has_t=has_t,
+                   axis_name=DATA_AXIS)
+    in_specs = (P(DATA_AXIS),
+                P(None, DATA_AXIS) if has_t else P(DATA_AXIS),
+                P(DATA_AXIS), P(DATA_AXIS),
+                P(DATA_AXIS), P(DATA_AXIS),
+                P(), P())
+    out_specs = (P(DATA_AXIS), P(DATA_AXIS), P())
+    f = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=False)
+    return jax.jit(f)
+
+
+class H2ORandomForestEstimator(ModelBuilder):
+    algo = "drf"
+
+    def __init__(self, **params):
+        merged = dict(DRF_DEFAULTS)
+        merged.update(params)
+        super().__init__(**merged)
+
+    def _train_impl(self, spec: TrainingSpec, valid_spec, job: Job) -> DRFModel:
+        p = self.params
+        if spec.offset is not None:
+            raise NotImplementedError("DRF does not support offset_column "
+                                      "(matching hex/tree/drf/DRF.java)")
+        K = spec.nclasses if spec.nclasses > 2 else 1
+        depth = int(p["max_depth"])
+        if depth > MAX_DEPTH_CAP:
+            raise ValueError(
+                f"max_depth {depth} exceeds the static-tree cap "
+                f"{MAX_DEPTH_CAP} (complete-binary-array trees; the "
+                f"reference's default 20 relies on dynamic node allocation)")
+        nbins = int(p["nbins"])
+        bm = bin_matrix(np.asarray(jax.device_get(spec.X)), spec.names,
+                        spec.is_cat, spec.nrow, nbins=max(nbins, 2),
+                        nbins_cats=int(p["nbins_cats"]),
+                        histogram_type=p.get("histogram_type",
+                                             "quantiles_global"))
+        mtries = int(p.get("mtries", -1) or -1)
+        F = bm.n_features
+        if mtries <= 0:
+            # reference defaults: sqrt(p) classification, p/3 regression
+            mtries = (max(1, int(np.sqrt(F))) if spec.nclasses > 1
+                      else max(1, F // 3))
+        cfg = TreeConfig(max_depth=depth, n_bins=bm.n_bins, n_features=F,
+                         min_rows=float(p["min_rows"]),
+                         min_split_improvement=float(p["min_split_improvement"]),
+                         reg_lambda=float(p.get("reg_lambda", 0.0)),
+                         mtries=min(mtries, F),
+                         hist_method=p.get("hist_kernel", "auto"))
+        mesh = current_mesh()
+        nd = n_data_shards(mesh)
+        padded = spec.X.shape[0]
+        if padded % nd != 0:
+            raise ValueError(f"padded rows {padded} not divisible by the "
+                             f"{nd}-shard data axis")
+        seed = int(p.get("seed", -1) or -1)
+        key = jax.random.PRNGKey(seed if seed != -1
+                                 else int(time.time() * 1e3) % (2 ** 31))
+        ntrees = int(p["ntrees"])
+        sample_rate = float(p["sample_rate"])
+        col_rate = float(p.get("col_sample_rate_per_tree", 1.0))
+        has_t = bm.codes.t is not None
+        codes_t_arg = bm.codes.t if has_t else bm.codes.rm
+        oob_num = (jnp.zeros(padded, jnp.float32) if K == 1
+                   else jnp.zeros((padded, K), jnp.float32))
+        oob_cnt = jnp.zeros(padded, jnp.float32)
+        y = spec.y if K > 1 else spec.y
+        all_trees = []
+        built = 0
+        chunk = min(ntrees, 25)
+        t0 = time.time()
+        while built < ntrees:
+            c = min(chunk, ntrees - built)
+            step = _compiled_drf_chunk(mesh, cfg, K, sample_rate, col_rate,
+                                       c, has_t)
+            oob_num, oob_cnt, chunk_trees = step(
+                bm.codes.rm, codes_t_arg, y, spec.w, oob_num, oob_cnt, key,
+                jnp.int32(built))
+            all_trees.append(chunk_trees)
+            built += c
+            job.set_progress(built / ntrees)
+            if job.cancel_requested:
+                break
+        jax.block_until_ready(oob_cnt)
+        t_loop = time.time() - t0
+
+        model = self._finalize(spec, bm, cfg, K, built, all_trees)
+        model.output["training_loop_seconds"] = t_loop
+        # OOB metrics as training metrics (reference DRF semantics:
+        # "training" numbers are out-of-bag when sample_rate < 1)
+        self._oob_metrics(model, spec, K, oob_num, oob_cnt)
+        if valid_spec is not None:
+            from h2o3_tpu.models.model_base import adapt_test_matrix
+            out = model._predict_matrix(valid_spec.X)
+            model.validation_metrics = compute_metrics(
+                out, valid_spec.y, valid_spec.w, spec.nclasses,
+                spec.response_domain)
+        return model
+
+    def _oob_metrics(self, model, spec, K, oob_num, oob_cnt):
+        cnt = np.asarray(jax.device_get(oob_cnt))
+        num = np.asarray(jax.device_get(oob_num))
+        w = np.asarray(jax.device_get(spec.w))
+        y = np.asarray(jax.device_get(spec.y))
+        live = (cnt > 0) & (w > 0)
+        if not live.any():
+            return
+        if K == 1:
+            pred = num[live] / cnt[live]
+            if spec.nclasses == 2:
+                p1 = np.clip(pred, 0.0, 1.0)
+                probs = np.stack([1 - p1, p1], axis=1)
+                model.training_metrics = compute_metrics(
+                    probs, y[live], w[live], 2, spec.response_domain)
+            else:
+                model.training_metrics = compute_metrics(
+                    pred, y[live], w[live], 1)
+        else:
+            pk = np.clip(num[live] / cnt[live][:, None], 0.0, 1.0)
+            pk = pk / np.maximum(pk.sum(axis=1, keepdims=True), 1e-12)
+            model.training_metrics = compute_metrics(
+                pk, y[live], w[live], K, spec.response_domain)
+        model.output["oob_metrics"] = True
+
+    def _finalize(self, spec, bm, cfg, K, built, all_trees) -> DRFModel:
+        M = cfg.n_nodes
+        T = built * max(K, 1)
+        host = [{k: np.asarray(jax.device_get(v)) for k, v in t.items()}
+                for t in all_trees]
+        feat = np.concatenate([t["feat"].reshape(-1, M) for t in host])
+        sbin = np.concatenate([t["split_bin"].reshape(-1, M) for t in host])
+        nal = np.concatenate([t["na_left"].reshape(-1, M) for t in host])
+        spl = np.concatenate([t["is_split"].reshape(-1, M) for t in host])
+        val = np.concatenate([t["value"].reshape(-1, M) for t in host])
+        gains = np.concatenate([t["gain"].reshape(-1, M) for t in host])
+        thr = np.stack([bins_to_thresholds(sbin[i], feat[i], bm.edges)
+                        for i in range(T)])
+        trees_host = {"feat": feat, "thr": thr, "na_left": nal,
+                      "is_split": spl, "value": val}
+        model = DRFModel(f"{self.algo}_{id(self) & 0xffffff:x}", self.params,
+                         spec, trees_host, bm.edges, bm.n_bins, cfg.max_depth,
+                         built, spec.nclasses)
+        vi = np.zeros(len(spec.names))
+        live = feat >= 0
+        np.add.at(vi, feat[live], gains[live])
+        order = np.argsort(-vi)
+        rel = vi / vi.max() if vi.max() > 0 else vi
+        model.output["variable_importances"] = {
+            "variable": [spec.names[i] for i in order],
+            "relative_importance": vi[order].tolist(),
+            "scaled_importance": rel[order].tolist(),
+            "percentage": (vi[order] / vi.sum() if vi.sum() > 0
+                           else vi[order]).tolist(),
+        }
+        return model
+
+
+register_model_class("drf", DRFModel)

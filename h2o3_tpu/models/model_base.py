@@ -109,6 +109,31 @@ def build_training_spec(frame: Frame, y: str, x: Optional[Sequence[str]] = None,
                         offset=offset)
 
 
+def build_unsupervised_spec(frame: Frame, x: Optional[Sequence[str]] = None,
+                            ignored_columns: Optional[Sequence[str]] = None,
+                            weights_column: Optional[str] = None) -> TrainingSpec:
+    """Spec for unsupervised builders (IsolationForest, KMeans, PCA…):
+    no response column, y is a dummy zero vector."""
+    excluded = set(ignored_columns or ())
+    if weights_column:
+        excluded.add(weights_column)
+    names = list(x) if x else [n for n in frame.names if n not in excluded]
+    names = [n for n in names if frame.vec(n).type != T_STR]
+    X = frame.as_matrix(names)
+    padded = X.shape[0]
+    row_ok = jnp.arange(padded) < frame.nrow
+    w = jnp.where(row_ok, 1.0, 0.0).astype(jnp.float32)
+    if weights_column:
+        wv = frame.vec(weights_column).as_float()
+        w = w * jnp.where(jnp.isnan(wv), 0.0, wv)
+    return TrainingSpec(
+        X=X, y=jnp.zeros(padded, jnp.float32), w=w, names=names,
+        is_cat=[frame.vec(n).type == T_ENUM for n in names],
+        cat_domains={n: frame.vec(n).domain for n in names
+                     if frame.vec(n).type == T_ENUM},
+        nrow=frame.nrow, response=None, response_domain=None, nclasses=1)
+
+
 def adapt_test_matrix(model: "Model", frame: Frame):
     """adaptTestForTrain (hex/Model.java): reorder columns to training
     order, remap enum codes through the training domain (unseen → NA),
@@ -368,6 +393,7 @@ class ModelBuilder:
     """Base trainer with the reference's train/CV orchestration shape."""
 
     algo = "base"
+    supervised = True
     model_count = 0
 
     def __init__(self, **params):
@@ -386,8 +412,9 @@ class ModelBuilder:
         y = y or self.params.get("response_column")
         training_frame = training_frame if training_frame is not None else \
             self.params.get("training_frame")
-        if training_frame is None or y is None:
-            raise ValueError("train() needs training_frame and y")
+        if training_frame is None or (y is None and self.supervised):
+            raise ValueError("train() needs training_frame"
+                             + (" and y" if self.supervised else ""))
         t0 = time.time()
         spec = self._make_spec(training_frame, y, x)
         valid_spec = None
@@ -412,6 +439,11 @@ class ModelBuilder:
         return self
 
     def _make_spec(self, frame, y, x):
+        if not self.supervised:
+            return build_unsupervised_spec(
+                frame, x,
+                ignored_columns=self.params.get("ignored_columns"),
+                weights_column=self.params.get("weights_column"))
         classification = None
         dist = (self.params.get("distribution") or "").lower()
         if dist in ("bernoulli", "binomial", "multinomial"):

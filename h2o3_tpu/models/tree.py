@@ -52,6 +52,9 @@ class TreeConfig:
     min_rows: float = 10.0
     min_split_improvement: float = 1e-5
     reg_lambda: float = 0.0
+    reg_alpha: float = 0.0   # L1 on leaf values (xgboost semantics)
+    mtries: int = 0          # >0: random feature subset PER NODE per level
+                             # (DRF mtries, hex/tree/drf/DRF.java)
     hist_method: str = "auto"
 
     @property
@@ -63,12 +66,28 @@ class TreeConfig:
         assert self.n_bins < BIN_MASK, self.n_bins
 
 
+def _leaf_score2(g, h, cfg: TreeConfig):
+    """Squared score T(g)²/(h+λ) with the xgboost L1 soft-threshold T."""
+    lam = cfg.reg_lambda
+    if cfg.reg_alpha:
+        g = jnp.sign(g) * jnp.maximum(jnp.abs(g) - cfg.reg_alpha, 0.0)
+    return g ** 2 / (h + lam + 1e-12)
+
+
+def _leaf_value(g, h, cfg: TreeConfig):
+    lam = cfg.reg_lambda
+    if cfg.reg_alpha:
+        g = jnp.sign(g) * jnp.maximum(jnp.abs(g) - cfg.reg_alpha, 0.0)
+    return -g / (h + lam + 1e-12)
+
+
 def _find_splits(hist, cfg: TreeConfig, col_mask):
     """Best split per node from [N, F, B+1, 3] histograms.
 
-    Returns (gain, feat, bin, na_left, g_tot, h_tot, w_tot) per node."""
+    ``col_mask`` is [F] (per-tree column sampling) or [N, F] (per-node
+    mtries subsets). Returns (gain, feat, bin, na_left, g_tot, h_tot,
+    w_tot) per node."""
     B = cfg.n_bins
-    lam = cfg.reg_lambda
     g = hist[..., 0]
     h = hist[..., 1]
     w = hist[..., 2]
@@ -86,8 +105,8 @@ def _find_splits(hist, cfg: TreeConfig, col_mask):
         gr = g_tot[..., None] - gl
         hr = h_tot[..., None] - hl
         wr = w_tot[..., None] - wl
-        parent = g_tot ** 2 / (h_tot + lam + 1e-12)
-        gain = (gl ** 2 / (hl + lam + 1e-12) + gr ** 2 / (hr + lam + 1e-12)
+        parent = _leaf_score2(g_tot, h_tot, cfg)
+        gain = (_leaf_score2(gl, hl, cfg) + _leaf_score2(gr, hr, cfg)
                 - parent[..., None])
         ok = (wl >= cfg.min_rows) & (wr >= cfg.min_rows)
         return jnp.where(ok, gain, NEG_INF)
@@ -96,7 +115,8 @@ def _find_splits(hist, cfg: TreeConfig, col_mask):
     gains_nl = gains(gl0 + g_na[..., None], hl0 + h_na[..., None],
                      wl0 + w_na[..., None])                          # NA left
     all_gains = jnp.stack([gains_nr, gains_nl], axis=-1)             # [N,F,B-1,2]
-    all_gains = jnp.where(col_mask[None, :, None, None], all_gains, NEG_INF)
+    cm = col_mask if col_mask.ndim == 2 else col_mask[None, :]
+    all_gains = jnp.where(cm[:, :, None, None], all_gains, NEG_INF)
     N, F = all_gains.shape[0], all_gains.shape[1]
     flat = all_gains.reshape(N, -1)
     best = jnp.argmax(flat, axis=1)
@@ -111,7 +131,8 @@ def _find_splits(hist, cfg: TreeConfig, col_mask):
             na_left, g_tot[:, 0], h_tot[:, 0], w_tot[:, 0])
 
 
-def grow_tree(codes, g, h, w, cfg: TreeConfig, col_mask, axis_name=None):
+def grow_tree(codes, g, h, w, cfg: TreeConfig, col_mask, axis_name=None,
+              key=None):
     """Build one tree. All args are device arrays (codes [rows,F] int,
     g/h/w [rows] float32, already weight-multiplied); returns tree arrays
     of length M = 2^(D+1)-1 plus per-row final node ids.
@@ -119,7 +140,11 @@ def grow_tree(codes, g, h, w, cfg: TreeConfig, col_mask, axis_name=None):
     Runs under jit; the level loop is unrolled (static depth). Under plain
     jit on sharded inputs GSPMD inserts the histogram all-reduce; under
     shard_map pass ``axis_name='data'`` for explicit psums (this is the
-    Rabit-allreduce replacement point)."""
+    Rabit-allreduce replacement point).
+
+    ``cfg.mtries > 0`` draws a fresh random feature subset per NODE per
+    level from ``key`` (DRF mtries semantics, hex/tree/drf/DRF.java —
+    the key must be identical across shards so splits agree)."""
     from h2o3_tpu.ops.binning import CodesView
     from h2o3_tpu.ops.histogram import build_histograms
 
@@ -172,14 +197,20 @@ def grow_tree(codes, g, h, w, cfg: TreeConfig, col_mask, axis_name=None):
             hist = jnp.stack([hist_l, hist_r], axis=1).reshape(
                 N, F, B1, 3)
         prev_hist = hist
-        bg, bf, bb, bnl, gt, ht, wt = _find_splits(hist, cfg, col_mask)
+        level_mask = col_mask
+        if cfg.mtries > 0 and key is not None:
+            u = jax.random.uniform(jax.random.fold_in(key, d), (N, F))
+            u = jnp.where(col_mask[None, :], u, 2.0)  # excluded cols last
+            kth = jnp.sort(u, axis=1)[:, min(cfg.mtries, F) - 1]
+            level_mask = (u <= kth[:, None]) & col_mask[None, :]
+        bg, bf, bb, bnl, gt, ht, wt = _find_splits(hist, cfg, level_mask)
         can = (bg > jnp.maximum(cfg.min_split_improvement, 0.0)) & (wt > 0)
         idx = base + jnp.arange(N)
         feat = feat.at[idx].set(jnp.where(can, bf, -1))
         split_bin = split_bin.at[idx].set(bb)
         na_left = na_left.at[idx].set(bnl)
         is_split = is_split.at[idx].set(can)
-        value = value.at[idx].set(-gt / (ht + cfg.reg_lambda + 1e-12))
+        value = value.at[idx].set(_leaf_value(gt, ht, cfg))
         gain_arr = gain_arr.at[idx].set(jnp.where(can, bg, 0.0))
         node_w = node_w.at[idx].set(wt)
         # route rows: only rows whose current node is at this level AND
@@ -211,7 +242,7 @@ def grow_tree(codes, g, h, w, cfg: TreeConfig, col_mask, axis_name=None):
         hD = jax.lax.psum(hD, axis_name)
         wD = jax.lax.psum(wD, axis_name)
     idxD = baseD + jnp.arange(2 ** D)
-    value = value.at[idxD].set(-gD / (hD + cfg.reg_lambda + 1e-12))
+    value = value.at[idxD].set(_leaf_value(gD, hD, cfg))
     node_w = node_w.at[idxD].set(wD)
 
     tree = {"feat": feat, "split_bin": split_bin, "na_left": na_left,
