@@ -8,13 +8,20 @@ XGBoost's gpu_hist does the same with atomics + a Rabit allreduce.
 
 TPUs have no fast random scatter, so the TPU-native formulation is a
 matmul: one-hot encode each row's (node, bin) pair and contract with the
-per-row (g, h, w) on the MXU — ``hist = onehot^T @ ghw`` per feature
-(SURVEY.md §7.3 angle). Cross-device reduction is a single ``psum`` over
-the 'data' mesh axis (replacing the serialize-and-merge tree / Rabit ring).
+per-row (g, h, w) on the MXU (SURVEY.md §7.3 angle). Cross-device
+reduction is a single ``psum`` over the 'data' mesh axis (replacing the
+serialize-and-merge tree / Rabit ring).
 
-Two code paths:
-- 'matmul'  — lax.scan over features of a [rows, n_nodes*(B+1)] one-hot
-  matmul; MXU-bound, the TPU default;
+Contract: ``build_histograms(codes, seg_ids, ghw, n_nodes, n_bins1)``
+returns a ``(g_hist, h_hist, w_hist)`` triple, each [n_nodes, F', B']
+float32 with F' >= F and B' >= n_bins1 (the pallas path returns its
+padded widths; trailing features/bins are zero). Rows whose seg_id is
+outside [0, n_nodes) are excluded — callers route dead rows out-of-band
+instead of multiplying weights by masks.
+
+Three code paths:
+- 'pallas'  — fused VMEM one-hot matmul (ops/hist_pallas.py); TPU default;
+- 'matmul'  — lax.scan over features of an XLA one-hot matmul;
 - 'scatter' — XLA scatter-add; wins on CPU and for very small shapes.
 """
 from __future__ import annotations
@@ -28,45 +35,46 @@ import numpy as np
 from h2o3_tpu.parallel.mesh import DATA_AXIS
 
 
-def _hist_scatter(codes, node_ids, g, h, w, n_nodes, n_bins1):
-    """[n_nodes, F, B+1, 3] via scatter-add."""
+def _hist_scatter3(codes, seg, ghw, n_nodes, n_bins1):
+    """Triple of [n_nodes, F, B1] via scatter-add (CPU path)."""
     rows, F = codes.shape
-    flat = (node_ids[:, None] * F + jnp.arange(F)[None, :]) * n_bins1 + codes
+    valid = (seg >= 0) & (seg < n_nodes)
+    s = jnp.clip(seg, 0, n_nodes - 1)
+    flat = (s[:, None] * F + jnp.arange(F)) * n_bins1 + codes.astype(jnp.int32)
     out = jnp.zeros((n_nodes * F * n_bins1, 3), dtype=jnp.float32)
-    out = out.at[flat, 0].add(g[:, None])
-    out = out.at[flat, 1].add(h[:, None])
-    out = out.at[flat, 2].add(w[:, None])
-    return out.reshape(n_nodes, F, n_bins1, 3)
+    vw = jnp.where(valid, 1.0, 0.0)
+    out = out.at[flat, 0].add((ghw[0] * vw)[:, None])
+    out = out.at[flat, 1].add((ghw[1] * vw)[:, None])
+    out = out.at[flat, 2].add((ghw[2] * vw)[:, None])
+    h = out.reshape(n_nodes, F, n_bins1, 3)
+    return h[..., 0], h[..., 1], h[..., 2]
 
 
-def _hist_matmul(codes, node_ids, g, h, w, n_nodes, n_bins1):
-    """[n_nodes, F, B+1, 3] via one-hot matmul on the MXU."""
+def _hist_matmul3(codes, seg, ghw, n_nodes, n_bins1):
+    """Triple of [n_nodes, F, B1] via one-hot matmul (XLA fallback)."""
     rows, F = codes.shape
-    ghw = jnp.stack([g, h, w], axis=1)  # [rows, 3]
-    base = node_ids * n_bins1           # [rows]
+    ghw_t = ghw.T                        # [rows, 3]
+    base = seg * n_bins1                 # [rows]; OOB seg → no one-hot match
     nb = n_nodes * n_bins1
 
     def one_feature(_, f):
-        idx = base + codes[:, f]
+        idx = base + codes[:, f].astype(jnp.int32)
         onehot = (idx[:, None] == jnp.arange(nb)[None, :]).astype(jnp.float32)
         part = jax.lax.dot_general(
-            onehot, ghw, (((0,), (0,)), ((), ())),
+            onehot, ghw_t, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)  # [nb, 3]
         return _, part
 
     _, hists = jax.lax.scan(one_feature, None, jnp.arange(F))
-    # hists: [F, nb, 3] → [n_nodes, F, B+1, 3]
-    return hists.reshape(F, n_nodes, n_bins1, 3).transpose(1, 0, 2, 3)
+    h = hists.reshape(F, n_nodes, n_bins1, 3).transpose(1, 0, 2, 3)
+    return h[..., 0], h[..., 1], h[..., 2]
 
 
-def build_histograms(codes, node_ids, g, h, w, n_nodes: int, n_bins1: int,
+def build_histograms(codes, seg_ids, ghw, n_nodes: int, n_bins1: int,
                      method: str = "auto"):
-    """Local (per-shard or single-device) histogram build. Caller is
-    responsible for the cross-device psum when run under shard_map.
-
-    Methods: 'pallas' (fused VMEM one-hot matmul, ~13x the XLA matmul on
-    v5e — see ops/hist_pallas.py), 'matmul' (XLA one-hot dot), 'scatter'
-    (XLA scatter-add; CPU default), 'auto'.
+    """Local (per-shard or single-device) histogram build; see module
+    docstring for the (g,h,w) triple contract. Caller is responsible for
+    the cross-device psum when run under shard_map.
 
     ``codes`` may be a plain [rows, F] int array or a binning.CodesView
     (whose pre-transposed layout feeds the pallas kernel directly)."""
@@ -75,15 +83,25 @@ def build_histograms(codes, node_ids, g, h, w, n_nodes: int, n_bins1: int,
     codes_t = codes.t if isinstance(codes, CodesView) else None
     if method == "auto":
         method = "pallas" if jax.default_backend() == "tpu" else "scatter"
+    seg = seg_ids.astype(jnp.int32)
     if method == "pallas":
-        from h2o3_tpu.ops.hist_pallas import hist_pallas_from_rowmajor
-        return hist_pallas_from_rowmajor(rm, node_ids, g, h, w, n_nodes,
-                                         n_bins1, codes_t=codes_t)
-    fn = _hist_matmul if method == "matmul" else _hist_scatter
-    return fn(rm, node_ids.astype(jnp.int32), g, h, w, n_nodes, n_bins1)
+        from h2o3_tpu.ops.hist_pallas import FBLK, TILE, hist_pallas3
+        if codes_t is None:
+            rows, F = rm.shape
+            pad_r = (-rows) % TILE
+            pad_f = (-F) % FBLK
+            codes_t = jnp.pad(rm.astype(jnp.int32).T,
+                              ((0, pad_f), (0, pad_r)))
+        rows_p = codes_t.shape[1]
+        if rows_p != seg.shape[0]:
+            seg = jnp.pad(seg, (0, rows_p - seg.shape[0]), constant_values=-1)
+            ghw = jnp.pad(ghw, ((0, 0), (0, rows_p - ghw.shape[1])))
+        return hist_pallas3(codes_t, seg, ghw, n_nodes, n_bins1)
+    fn = _hist_matmul3 if method == "matmul" else _hist_scatter3
+    return fn(rm, seg, ghw, n_nodes, n_bins1)
 
 
-def build_histograms_sharded(codes, node_ids, g, h, w, n_nodes: int,
+def build_histograms_sharded(codes, seg_ids, ghw, n_nodes: int,
                              n_bins1: int, mesh, method: str = "auto"):
     """Distributed histogram: per-shard build + ICI all-reduce.
 
@@ -93,12 +111,12 @@ def build_histograms_sharded(codes, node_ids, g, h, w, n_nodes: int,
     """
     from jax.sharding import PartitionSpec as P
 
-    def local(c, nid, gg, hh, ww):
-        hist = build_histograms(c, nid, gg, hh, ww, n_nodes, n_bins1, method)
-        return jax.lax.psum(hist, DATA_AXIS)
+    def local(c, s, gh):
+        trip = build_histograms(c, s, gh, n_nodes, n_bins1, method)
+        return jax.lax.psum(trip, DATA_AXIS)
 
     f = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=P())
-    return f(codes, node_ids, g, h, w)
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(None, DATA_AXIS)),
+        out_specs=(P(), P(), P()))
+    return f(codes, seg_ids, ghw)

@@ -1,0 +1,79 @@
+"""Phase-level profiling of the GBM bench (not shipped; perf diagnosis)."""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import numpy as np
+
+ROWS = int(os.environ.get("H2O3_BENCH_ROWS", 1_000_000))
+
+import jax
+import jax.numpy as jnp
+
+print(f"devices: {jax.devices()} backend: {jax.default_backend()}", file=sys.stderr)
+
+t0 = time.time()
+import h2o3_tpu as h2o
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+from h2o3_tpu.ops.binning import bin_matrix
+print(f"import+init: {time.time()-t0:.2f}s", file=sys.stderr)
+
+rng = np.random.default_rng(42)
+F = 28
+X = rng.normal(size=(ROWS, F)).astype(np.float32)
+logit = (X[:, 0] * 1.5 - X[:, 1] + 0.5 * X[:, 2] * X[:, 3] + 0.3 * np.sin(3 * X[:, 4]))
+y = (rng.random(ROWS) < 1.0 / (1.0 + np.exp(-logit))).astype(np.int32)
+cols = {f"f{i}": X[:, i] for i in range(F)}
+cols["label"] = y.astype(np.float32)
+
+t0 = time.time()
+fr = h2o.Frame.from_numpy(cols)
+print(f"frame build: {time.time()-t0:.2f}s", file=sys.stderr)
+
+common = dict(max_depth=6, learn_rate=0.1, nbins=254, distribution="bernoulli",
+              seed=7, score_tree_interval=0, stopping_rounds=0, min_rows=1.0)
+
+# instrument: monkeypatch bin_matrix and finalize timing
+import h2o3_tpu.models.gbm as gbm_mod
+orig_bin = gbm_mod.bin_matrix
+def timed_bin(*a, **k):
+    t = time.time()
+    r = orig_bin(*a, **k)
+    jax.block_until_ready(r.codes.rm)
+    print(f"  bin_matrix: {time.time()-t:.2f}s", file=sys.stderr)
+    return r
+gbm_mod.bin_matrix = timed_bin
+
+orig_fin = H2OGradientBoostingEstimator._finalize
+def timed_fin(self, *a, **k):
+    t = time.time()
+    r = orig_fin(self, *a, **k)
+    print(f"  finalize: {time.time()-t:.2f}s", file=sys.stderr)
+    return r
+H2OGradientBoostingEstimator._finalize = timed_fin
+
+for run in ("warm", "measured"):
+    gbm = H2OGradientBoostingEstimator(ntrees=20, **common)
+    t0 = time.time()
+    gbm.train(y="label", training_frame=fr)
+    total = time.time() - t0
+    loop = gbm.model.output["training_loop_seconds"]
+    print(f"{run}: total={total:.2f}s loop={loop:.2f}s other={total-loop:.2f}s",
+          file=sys.stderr)
+
+# microbench the pallas hist kernel per level shape
+from h2o3_tpu.ops.hist_pallas import hist_pallas
+rows_p = ((ROWS + 2047) // 2048) * 2048
+F_p = ((F + 7) // 8) * 8
+codes_t = jnp.asarray(rng.integers(0, 254, size=(F_p, rows_p), dtype=np.int32))
+ghw = jnp.asarray(rng.normal(size=(3, rows_p)).astype(np.float32))
+for N in (1, 2, 4, 8, 16, 32):
+    nid = jnp.asarray(rng.integers(0, N, size=(1, rows_p), dtype=np.int32))
+    f = jax.jit(lambda ct, ni, gh: hist_pallas(ct, ni, gh, N, 255))
+    r = f(codes_t, nid, ghw); jax.block_until_ready(r)
+    t0 = time.time()
+    for _ in range(5):
+        r = f(codes_t, nid, ghw)
+    jax.block_until_ready(r)
+    dt = (time.time() - t0) / 5
+    flops = 2 * F_p * rows_p * 256 * 3 * N
+    print(f"hist N={N:3d}: {dt*1000:8.2f} ms  ({flops/dt/1e12:.1f} TFLOP/s)",
+          file=sys.stderr)

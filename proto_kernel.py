@@ -1,0 +1,100 @@
+"""Prototype: flipped-operand pallas histogram kernel (perf exploration)."""
+import functools, sys, time
+import jax, jax.numpy as jnp, numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel2(codes_ref, nid_ref, ghw_ref, out_ref, acc_ref, *,
+             n_nodes, n_bins_p, tile, n_row_tiles, mxu_dtype, fblk):
+    r = pl.program_id(1)
+
+    @pl.when(r == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    nid = nid_ref[0, :]                                    # [tile]
+    nodes_t = jax.lax.broadcasted_iota(jnp.int32, (n_nodes, tile), 0)
+    node_oh_t = (nodes_t == nid[None, :]).astype(mxu_dtype)   # [N, tile]
+    R_t = jnp.concatenate(
+        [node_oh_t * ghw_ref[k, :][None, :].astype(mxu_dtype) for k in range(3)],
+        axis=0)                                            # [3N, tile]
+    bins = jax.lax.broadcasted_iota(jnp.int32, (tile, n_bins_p), 1)
+    for fi in range(fblk):
+        c = codes_ref[fi, :]
+        bin_oh = (bins == c[:, None]).astype(mxu_dtype)    # [tile, Bp]
+        acc_ref[fi] += jax.lax.dot_general(
+            R_t, bin_oh, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [3N, Bp]
+
+    @pl.when(r == n_row_tiles - 1)
+    def _flush():
+        out_ref[...] = acc_ref[...]
+
+
+def hist_v2(codes_t, nid, ghw, n_nodes, n_bins1, tile=2048, fblk=8,
+            mxu_dtype=jnp.bfloat16):
+    F, rows = codes_t.shape
+    assert rows % tile == 0 and F % fblk == 0
+    n_row_tiles = rows // tile
+    n_bins_p = int(np.ceil(n_bins1 / 128) * 128)
+    kern = functools.partial(_kernel2, n_nodes=n_nodes, n_bins_p=n_bins_p,
+                             tile=tile, n_row_tiles=n_row_tiles,
+                             mxu_dtype=mxu_dtype, fblk=fblk)
+    out = pl.pallas_call(
+        kern,
+        grid=(F // fblk, n_row_tiles),
+        in_specs=[
+            pl.BlockSpec((fblk, tile), lambda f, r: (f, r)),
+            pl.BlockSpec((1, tile), lambda f, r: (0, r)),
+            pl.BlockSpec((3, tile), lambda f, r: (0, r)),
+        ],
+        out_specs=pl.BlockSpec((fblk, 3 * n_nodes, n_bins_p),
+                               lambda f, r: (f, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F, 3 * n_nodes, n_bins_p), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((fblk, 3 * n_nodes, n_bins_p), jnp.float32)],
+    )(codes_t, nid, ghw)
+    # [F, 3N, Bp] -> [N, F, B1, 3]
+    hist = out.reshape(F, 3, n_nodes, n_bins_p).transpose(2, 0, 3, 1)
+    return hist[:, :, :n_bins1, :]
+
+
+def main():
+    rng = np.random.default_rng(0)
+    ROWS = 1_001_472  # 489 * 2048
+    F = 32
+    codes_t = jnp.asarray(rng.integers(0, 254, size=(F, ROWS), dtype=np.int32))
+    ghw = jnp.asarray(rng.normal(size=(3, ROWS)).astype(np.float32))
+
+    # correctness vs v1
+    from h2o3_tpu.ops.hist_pallas import hist_pallas
+    nid8 = jnp.asarray(rng.integers(0, 8, size=(1, ROWS), dtype=np.int32))
+    a = hist_pallas(codes_t, nid8, ghw, 8, 255)
+    b = hist_v2(codes_t, nid8, ghw, 8, 255)
+    err = float(jnp.max(jnp.abs(a - b)))
+    print(f"max |v1-v2| = {err:.4f} (rel {err/float(jnp.max(jnp.abs(a))):.2e})",
+          file=sys.stderr)
+
+    for tile, fblk in [(2048, 8), (2048, 16), (4096, 8), (4096, 16),
+                       (8192, 8), (8192, 16), (8192, 32)]:
+        line = f"tile={tile} fblk={fblk}: "
+        for N in (1, 2, 4, 8, 16, 32):
+            nid = jnp.asarray(rng.integers(0, N, size=(1, ROWS), dtype=np.int32))
+            try:
+                f = jax.jit(lambda ct, ni, gh, N=N, t=tile, fb=fblk:
+                            hist_v2(ct, ni, gh, N, 255, tile=t, fblk=fb))
+                r = f(codes_t, nid, ghw); jax.block_until_ready(r)
+                t0 = time.time()
+                for _ in range(5):
+                    r = f(codes_t, nid, ghw)
+                jax.block_until_ready(r)
+                dt = (time.time() - t0) / 5
+                line += f" N{N}:{dt*1000:6.2f}ms"
+            except Exception as e:
+                line += f" N{N}:FAIL({type(e).__name__})"
+        print(line, file=sys.stderr)
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, ".")
+    main()

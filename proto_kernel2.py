@@ -1,0 +1,95 @@
+"""Isolate the pallas hist kernel bottleneck: compare / matmul / grid overhead."""
+import functools, sys, time
+import jax, jax.numpy as jnp, numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def make_kernel(variant, n_nodes, n_bins_p, tile, n_row_tiles, mxu_dtype, fblk):
+    def kern(codes_ref, nid_ref, ghw_ref, out_ref, acc_ref):
+        r = pl.program_id(1)
+
+        @pl.when(r == 0)
+        def _init():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        nid = nid_ref[0, :]
+        nodes_t = jax.lax.broadcasted_iota(jnp.int32, (n_nodes, tile), 0)
+        node_oh_t = (nodes_t == nid[None, :]).astype(mxu_dtype)
+        R_t = jnp.concatenate(
+            [node_oh_t * ghw_ref[k, :][None, :].astype(mxu_dtype)
+             for k in range(3)], axis=0)                       # [3N, tile]
+        bins = jax.lax.broadcasted_iota(jnp.int32, (tile, n_bins_p), 1)
+        for fi in range(fblk):
+            c = codes_ref[fi, :]
+            if variant == "nocompare":
+                bin_oh = (bins + c[:, None]).astype(mxu_dtype)
+            else:
+                bin_oh = (bins == c[:, None]).astype(mxu_dtype)
+            if variant == "nomatmul":
+                acc_ref[fi, 0, :] += jnp.sum(bin_oh, axis=0)
+            else:
+                acc_ref[fi] += jax.lax.dot_general(
+                    R_t, bin_oh, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+
+        @pl.when(r == n_row_tiles - 1)
+        def _flush():
+            out_ref[...] = acc_ref[...]
+    return kern
+
+
+def hist_var(codes_t, nid, ghw, n_nodes, n_bins1, variant="full",
+             tile=2048, fblk=8, mxu_dtype=jnp.bfloat16):
+    F, rows = codes_t.shape
+    assert rows % tile == 0 and F % fblk == 0, (rows, tile, F, fblk)
+    n_row_tiles = rows // tile
+    n_bins_p = int(np.ceil(n_bins1 / 128) * 128)
+    kern = make_kernel(variant, n_nodes, n_bins_p, tile, n_row_tiles,
+                       mxu_dtype, fblk)
+    out = pl.pallas_call(
+        kern,
+        grid=(F // fblk, n_row_tiles),
+        in_specs=[
+            pl.BlockSpec((fblk, tile), lambda f, r: (f, r)),
+            pl.BlockSpec((1, tile), lambda f, r: (0, r)),
+            pl.BlockSpec((3, tile), lambda f, r: (0, r)),
+        ],
+        out_specs=pl.BlockSpec((fblk, 3 * n_nodes, n_bins_p),
+                               lambda f, r: (f, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F, 3 * n_nodes, n_bins_p), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((fblk, 3 * n_nodes, n_bins_p), jnp.float32)],
+    )(codes_t, nid, ghw)
+    return out
+
+
+def bench(label, fn, *args):
+    f = jax.jit(fn)
+    r = f(*args); jax.block_until_ready(r)
+    t0 = time.time()
+    for _ in range(5):
+        r = f(*args)
+    jax.block_until_ready(r)
+    print(f"{label}: {(time.time()-t0)/5*1000:7.2f} ms", file=sys.stderr)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    ROWS = 61 * 16384  # 999424, divisible by 2048/4096/8192/16384
+    F = 32
+    codes_t = jnp.asarray(rng.integers(0, 254, size=(F, ROWS), dtype=np.int32))
+    ghw = jnp.asarray(rng.normal(size=(3, ROWS)).astype(np.float32))
+    N = 8
+    nid = jnp.asarray(rng.integers(0, N, size=(1, ROWS), dtype=np.int32))
+
+    for variant in ("full", "nocompare", "nomatmul"):
+        bench(f"{variant:10s} t2048 f8 ",
+              lambda ct, ni, gh, v=variant: hist_var(ct, ni, gh, N, 255, v), codes_t, nid, ghw)
+    for tile, fblk in [(2048, 32), (4096, 8), (8192, 8), (8192, 32)]:
+        bench(f"full       t{tile} f{fblk}",
+              lambda ct, ni, gh, t=tile, fb=fblk: hist_var(ct, ni, gh, N, 255, "full", t, fb),
+              codes_t, nid, ghw)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""True device-time kernel measurement: K calls inside one jitted program."""
+import functools, sys, time
+import jax, jax.numpy as jnp, numpy as np
+from proto_kernel2 import hist_var
+from h2o3_tpu.ops.hist_pallas import hist_pallas
+
+K_CALLS = 20
+
+
+def timeit(label, make_fn, *args):
+    f = jax.jit(make_fn)
+    r = f(*args); jax.block_until_ready(r)
+    t0 = time.time()
+    r = f(*args)
+    jax.block_until_ready(r)
+    dt = time.time() - t0
+    print(f"{label}: {dt/K_CALLS*1000:7.2f} ms/call  ({dt*1000:.0f} ms total)",
+          file=sys.stderr)
+    return dt / K_CALLS
+
+
+def main():
+    rng = np.random.default_rng(0)
+    ROWS = 122 * 8192  # 999424
+    F = 32
+    codes_t = jnp.asarray(rng.integers(0, 254, size=(F, ROWS), dtype=np.int32))
+    ghw = jnp.asarray(rng.normal(size=(3, ROWS)).astype(np.float32))
+    N = 8
+    nid0 = jnp.asarray(rng.integers(0, N, size=(ROWS,), dtype=np.int32))
+
+    def many(kernel_fn):
+        def prog(ct, ni, gh):
+            acc = 0.0
+            for i in range(K_CALLS):
+                nid_i = ((ni + i) % N)[None, :]
+                acc = acc + jnp.sum(kernel_fn(ct, nid_i, gh))
+            return acc
+        return prog
+
+    timeit("v1 full    t2048 f8  N8", many(lambda ct, ni, gh: hist_pallas(ct, ni, gh, N, 255)), codes_t, nid0, ghw)
+    for variant in ("full", "nocompare", "nomatmul"):
+        timeit(f"v2 {variant:9s} t2048 f8  N8",
+               many(lambda ct, ni, gh, v=variant: hist_var(ct, ni, gh, N, 255, v)),
+               codes_t, nid0, ghw)
+    for tile, fblk in [(2048, 32), (4096, 16), (8192, 8), (8192, 32)]:
+        timeit(f"v2 full      t{tile} f{fblk} N8",
+               many(lambda ct, ni, gh, t=tile, fb=fblk: hist_var(ct, ni, gh, N, 255, "full", t, fb)),
+               codes_t, nid0, ghw)
+    for N2 in (1, 16):
+        timeit(f"v2 full      t2048 f8  N{N2}",
+               many(lambda ct, ni, gh, n=N2: hist_var(ct, ni, gh, n, 255)),
+               codes_t, nid0, ghw)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,126 @@
+"""Wide-matmul + bf16-compare pallas hist variants."""
+import functools, sys, time
+import jax, jax.numpy as jnp, numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def make_wide(n_nodes, n_bins_p, tile, n_row_tiles, mxu_dtype, fblk,
+              bf16_cmp):
+    FB = fblk * n_bins_p
+
+    def kern(codes_ref, nid_ref, ghw_ref, out_ref, acc_ref):
+        r = pl.program_id(1)
+
+        @pl.when(r == 0)
+        def _init():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        nid = nid_ref[0, :]
+        nodes_t = jax.lax.broadcasted_iota(jnp.int32, (n_nodes, tile), 0)
+        node_oh_t = (nodes_t == nid[None, :]).astype(mxu_dtype)
+        R_t = jnp.concatenate(
+            [node_oh_t * ghw_ref[k, :][None, :].astype(mxu_dtype)
+             for k in range(3)], axis=0)                     # [3N, tile]
+        # one-hot for ALL fblk features at once: [tile, fblk*Bp]
+        if bf16_cmp:
+            bins = jax.lax.broadcasted_iota(
+                jnp.float32, (tile, FB), 1) % n_bins_p
+            c_all = jnp.concatenate(
+                [codes_ref[fi, :].astype(jnp.float32)[:, None]
+                 * jnp.ones((1, n_bins_p), jnp.float32) for fi in range(fblk)],
+                axis=1)
+            oh = (bins == c_all).astype(mxu_dtype)
+        else:
+            bins = jax.lax.broadcasted_iota(jnp.int32, (tile, FB), 1) % n_bins_p
+            c_all = jnp.concatenate(
+                [jnp.broadcast_to(codes_ref[fi, :][:, None], (tile, n_bins_p))
+                 for fi in range(fblk)], axis=1)
+            oh = (bins == c_all).astype(mxu_dtype)
+        acc_ref[...] += jax.lax.dot_general(
+            R_t, oh, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [3N, fblk*Bp]
+
+        @pl.when(r == n_row_tiles - 1)
+        def _flush():
+            out_ref[0] = acc_ref[...]
+    return kern
+
+
+def hist_wide(codes_t, nid, ghw, n_nodes, n_bins1, tile=2048, fblk=8,
+              mxu_dtype=jnp.bfloat16, bf16_cmp=False):
+    F, rows = codes_t.shape
+    assert rows % tile == 0 and F % fblk == 0
+    n_row_tiles = rows // tile
+    n_bins_p = int(np.ceil(n_bins1 / 128) * 128)
+    kern = make_wide(n_nodes, n_bins_p, tile, n_row_tiles, mxu_dtype, fblk,
+                     bf16_cmp)
+    out = pl.pallas_call(
+        kern,
+        grid=(F // fblk, n_row_tiles),
+        in_specs=[
+            pl.BlockSpec((fblk, tile), lambda f, r: (f, r)),
+            pl.BlockSpec((1, tile), lambda f, r: (0, r)),
+            pl.BlockSpec((3, tile), lambda f, r: (0, r)),
+        ],
+        out_specs=pl.BlockSpec((1, 3 * n_nodes, fblk * n_bins_p),
+                               lambda f, r: (f, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F // fblk, 3 * n_nodes,
+                                        fblk * n_bins_p), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((3 * n_nodes, fblk * n_bins_p),
+                                   jnp.float32)],
+    )(codes_t, nid, ghw)
+    return out
+
+
+def run(label, kfn, K, codes_t, nid0, ghw0, N):
+    def prog(ct, ni, gh):
+        acc = jnp.float32(0)
+        for i in range(K):
+            acc = acc + jnp.sum(kfn(ct, ni, gh + acc * 1e-20))
+        return acc
+    f = jax.jit(prog)
+    x = float(f(codes_t, nid0, jnp.asarray(ghw0)))
+    ts = []
+    for trial in range(3):
+        gh = jnp.asarray(ghw0 + np.float32(trial + 1))
+        t0 = time.time(); x = float(f(codes_t, nid0, gh)); ts.append(time.time() - t0)
+    print(f"{label} K={K}: {min(ts)*1000:8.1f} ms total", file=sys.stderr)
+    return min(ts)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    ROWS = 122 * 8192
+    F = 32
+    codes_t = jnp.asarray(rng.integers(0, 254, size=(F, ROWS), dtype=np.int32))
+    ghw0 = np.ascontiguousarray(rng.normal(size=(3, ROWS)).astype(np.float32))
+    N = 8
+    nid0 = jnp.asarray(rng.integers(0, N, size=(1, ROWS), dtype=np.int32))
+
+    # correctness vs v2
+    from proto_kernel2 import hist_var
+    ghw = jnp.asarray(ghw0)
+    ref = hist_var(codes_t, nid0, ghw, N, 255)           # [F, 3N, Bp]
+    got = hist_wide(codes_t, nid0, ghw, N, 255)          # [F/8, 3N, 8*Bp]
+    got_r = got.reshape(F // 8, 3 * N, 8, 256).transpose(0, 2, 1, 3).reshape(F, 3 * N, 256)
+    err = float(jnp.max(jnp.abs(ref - got_r)))
+    print(f"wide vs v2 max err: {err}", file=sys.stderr)
+
+    for fblk in (8, 16, 32):
+        for bf16c in (False,):
+            for tile in (2048, 4096):
+                base = run(f"wide f{fblk} t{tile} bf16c={int(bf16c)}",
+                           lambda ct, ni, gh, fb=fblk, t=tile, b=bf16c:
+                           hist_wide(ct, ni, gh, N, 255, tile=t, fblk=fb, bf16_cmp=b),
+                           1, codes_t, nid0, ghw0, N)
+                full = run(f"wide f{fblk} t{tile} bf16c={int(bf16c)}",
+                           lambda ct, ni, gh, fb=fblk, t=tile, b=bf16c:
+                           hist_wide(ct, ni, gh, N, 255, tile=t, fblk=fb, bf16_cmp=b),
+                           21, codes_t, nid0, ghw0, N)
+                print(f"  -> marginal {((full-base)/20)*1000:6.2f} ms/call",
+                      file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

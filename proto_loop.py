@@ -1,0 +1,101 @@
+"""Decompose grow_tree per-tree cost at 1M rows (serial-dep timing)."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, numpy as np
+from h2o3_tpu.models.tree import TreeConfig, grow_tree, _find_splits
+from h2o3_tpu.ops.binning import CodesView
+from h2o3_tpu.ops.histogram import build_histograms
+
+rng = np.random.default_rng(0)
+ROWS = 489 * 2048
+F = 28
+Fp = 32
+cfg = TreeConfig(max_depth=6, n_bins=255, n_features=F, min_rows=1.0)
+
+rm = jnp.asarray(rng.integers(0, 254, size=(ROWS, F), dtype=np.int32).astype(np.uint8))
+ct = jnp.asarray(
+    np.pad(rng.integers(0, 254, size=(F, ROWS), dtype=np.int32), ((0, Fp - F), (0, 0))))
+codes = CodesView(rm=rm, t=ct)
+g0 = np.ascontiguousarray(rng.normal(size=ROWS).astype(np.float32))
+h0 = np.abs(rng.normal(size=ROWS)).astype(np.float32)
+w0 = np.ones(ROWS, np.float32)
+col_mask = jnp.ones(F, bool)
+
+
+def timeit(label, prog, *args, K=None):
+    f = jax.jit(prog)
+    x = f(*args); jax.block_until_ready(x)
+    ts = []
+    for t in range(2):
+        a2 = (jnp.asarray(g0 + np.float32(t + 1)),) + args[1:]
+        t0 = time.time(); x = f(*a2); jax.block_until_ready(x)
+        ts.append(time.time() - t0)
+    print(f"{label}: {min(ts)*1000:8.1f} ms", file=sys.stderr)
+
+
+gj, hj, wj = jnp.asarray(g0), jnp.asarray(h0), jnp.asarray(w0)
+
+# (a) full grow_tree x10
+def full10(g, h, w):
+    acc = jnp.float32(0)
+    for i in range(10):
+        tree, nid = grow_tree(codes, g + acc * 1e-20, h, w, cfg, col_mask)
+        acc = acc + tree["value"].sum() + nid.sum() * 1e-9
+    return acc
+timeit("grow_tree x10           ", full10, gj, hj, wj)
+
+# (b) hists only: 6 levels (sibling pattern N=1,1,2,4,8,16) x10
+def hists10(g, h, w):
+    acc = jnp.float32(0)
+    nid = (jnp.arange(ROWS) % 64).astype(jnp.int32)
+    for i in range(10):
+        for N in (1, 1, 2, 4, 8, 16):
+            hist = build_histograms(codes, nid % N, g + acc * 1e-20, h, w, N, 256)
+            acc = acc + hist.sum()
+    return acc
+timeit("hist 6 levels x10       ", hists10, gj, hj, wj)
+
+# (c) routing only: 6 levels of the gather+update x10
+def route10(g, h, w):
+    acc = jnp.float32(0)
+    for i in range(10):
+        nid = jnp.zeros(ROWS, jnp.int32)
+        for d in range(6):
+            N = 2 ** d
+            word = (jnp.arange(N, dtype=jnp.int32) % F) | (128 << 14) | (1 << 29)
+            rw = word[jnp.clip(nid - (N - 1), 0, N - 1)]
+            node_feat = rw & ((1 << 14) - 1)
+            node_bin = (rw >> 14) & ((1 << 14) - 1)
+            c = jnp.take_along_axis(rm, node_feat[:, None], axis=1)[:, 0].astype(jnp.int32)
+            go_right = (c >= node_bin) | (g + acc * 1e-20 > 1e30)
+            nid = 2 * nid + 1 + go_right.astype(jnp.int32)
+        acc = acc + nid.sum() * 1e-9
+    return acc
+timeit("routing 6 levels x10    ", route10, gj, hj, wj)
+
+# (d) split finding on hists x10 (levels N=1..32)
+def splits10(g, h, w):
+    acc = jnp.float32(0)
+    for i in range(10):
+        for N in (1, 2, 4, 8, 16, 32):
+            hist = jnp.ones((N, F, 256, 3), jnp.float32) * (1 + acc * 1e-20)
+            bg, bf, bb, bnl, gt, ht, wt = _find_splits(hist, cfg, col_mask)
+            acc = acc + bg.sum() + gt.sum()
+    return acc
+timeit("find_splits 6 levels x10", splits10, gj, hj, wj)
+
+# (e) the where-masking of g/h/w per level x10
+def mask10(g, h, w):
+    acc = jnp.float32(0)
+    nid = (jnp.arange(ROWS) % 64).astype(jnp.int32)
+    for i in range(10):
+        for d in range(6):
+            N = 2 ** d
+            local = nid - (N - 1)
+            in_level = (local >= 0) & (local < N)
+            lw = jnp.where(in_level, w, 0.0)
+            lg = jnp.where(in_level, g + acc * 1e-20, 0.0)
+            lh = jnp.where(in_level, h, 0.0)
+            acc = acc + lg.sum() + lh.sum() + lw.sum()
+    return acc
+timeit("mask ghw 6 levels x10   ", mask10, gj, hj, wj)

@@ -1,0 +1,58 @@
+"""Routing gather variants at 1M rows."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, numpy as np
+
+rng = np.random.default_rng(0)
+ROWS = 489 * 2048
+F = 28
+rm8 = jnp.asarray(rng.integers(0, 254, size=(ROWS, F), dtype=np.int32).astype(np.uint8))
+rm32 = rm8.astype(jnp.int32)
+g0 = np.ascontiguousarray(rng.normal(size=ROWS).astype(np.float32))
+
+
+def timeit(label, prog, *args):
+    f = jax.jit(prog)
+    x = f(jnp.asarray(g0), *args); jax.block_until_ready(x)
+    ts = []
+    for t in range(2):
+        t0 = time.time(); x = f(jnp.asarray(g0 + np.float32(t + 1)), *args)
+        jax.block_until_ready(x); ts.append(time.time() - t0)
+    print(f"{label}: {min(ts)*1000:8.1f} ms  (/60 = {min(ts)/60*1000:.2f} ms/level)",
+          file=sys.stderr)
+
+
+def mk(variant, rm):
+    def prog(g):
+        acc = jnp.float32(0)
+        nid = jnp.zeros(ROWS, jnp.int32)
+        for i in range(10):           # 10 trees x 6 levels
+            for d in range(6):
+                N = 2 ** d
+                word = ((jnp.arange(N, dtype=jnp.int32) * 7919) % F
+                        | (128 << 14) | (1 << 29))
+                lid = jnp.clip(nid - (N - 1), 0, N - 1)
+                rw = word[lid]
+                node_feat = rw & ((1 << 14) - 1)
+                node_bin = (rw >> 14) & ((1 << 14) - 1)
+                if variant == "take":
+                    c = jnp.take_along_axis(rm, node_feat[:, None],
+                                            axis=1)[:, 0].astype(jnp.int32)
+                elif variant == "onehot_sum":
+                    oh = node_feat[:, None] == jnp.arange(F, dtype=jnp.int32)[None, :]
+                    c = jnp.sum(jnp.where(oh, rm.astype(jnp.int32), 0), axis=1)
+                elif variant == "switch_sel":
+                    c = jnp.zeros(ROWS, jnp.int32)
+                    for f in range(F):
+                        c = jnp.where(node_feat == f, rm[:, f].astype(jnp.int32), c)
+                go_right = (c >= node_bin) | (g + acc * 1e-20 > 1e30)
+                nid = jnp.where(nid * 0 + 1 > 0, 2 * nid + 1 + go_right.astype(jnp.int32), nid)
+                nid = jnp.where(nid >= 2 ** (d + 1) - 1 + 2 ** (d + 1), 0, nid)
+            acc = acc + nid.sum() * 1e-9
+        return acc
+    return prog
+
+
+for v in ("take", "onehot_sum", "switch_sel"):
+    timeit(f"{v:11s} u8 ", mk(v, rm8))
+    timeit(f"{v:11s} i32", mk(v, rm32))
