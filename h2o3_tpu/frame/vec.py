@@ -139,6 +139,36 @@ class Vec:
             return inrange & (self.data >= 0)
         return inrange & ~jnp.isnan(self.data)
 
+    def asfactor(self) -> "Vec":
+        """Numeric → categorical conversion (h2o-py ``vec.asfactor()``;
+        water/rapids/ast/prims/operators/AstAsFactor semantics): distinct
+        finite values become the sorted domain, NA stays NA."""
+        if self.type == T_ENUM:
+            return self
+        if self.type == T_STR:
+            return Vec._from_strings(self.host_data, current_mesh())
+        raw = self.to_numpy()
+        finite = np.isfinite(raw)
+        vals = np.unique(raw[finite])
+        domain = tuple(str(int(v)) if float(v).is_integer() else str(v)
+                       for v in vals)
+        codes = np.searchsorted(vals, raw).astype(np.int32)
+        codes[~finite] = ENUM_NA
+        return Vec.from_numpy(codes, vtype=T_ENUM, domain=domain)
+
+    def asnumeric(self) -> "Vec":
+        """Categorical → numeric (h2o-py ``vec.asnumeric()``): domain labels
+        parse back to numbers when possible, else the codes are used."""
+        if self.type != T_ENUM:
+            return self
+        codes = self.to_numpy()
+        try:
+            lut = np.array([float(d) for d in self.domain], dtype=np.float32)
+            out = np.where(codes >= 0, lut[np.maximum(codes, 0)], np.nan)
+        except (ValueError, TypeError):
+            out = np.where(codes >= 0, codes.astype(np.float32), np.nan)
+        return Vec.from_numpy(out.astype(np.float32))
+
     def as_float(self):
         """Device float32 view with NA→NaN (enums become their codes)."""
         if self.data is None:

@@ -60,14 +60,14 @@ for run in ("warm", "measured"):
           file=sys.stderr)
 
 # microbench the pallas hist kernel per level shape
-from h2o3_tpu.ops.hist_pallas import hist_pallas
+from h2o3_tpu.ops.hist_pallas import hist_pallas3
 rows_p = ((ROWS + 2047) // 2048) * 2048
 F_p = ((F + 7) // 8) * 8
 codes_t = jnp.asarray(rng.integers(0, 254, size=(F_p, rows_p), dtype=np.int32))
 ghw = jnp.asarray(rng.normal(size=(3, rows_p)).astype(np.float32))
 for N in (1, 2, 4, 8, 16, 32):
-    nid = jnp.asarray(rng.integers(0, N, size=(1, rows_p), dtype=np.int32))
-    f = jax.jit(lambda ct, ni, gh: hist_pallas(ct, ni, gh, N, 255))
+    nid = jnp.asarray(rng.integers(0, N, size=(rows_p,), dtype=np.int32))
+    f = jax.jit(lambda ct, ni, gh: hist_pallas3(ct, ni, gh, N, 255))
     r = f(codes_t, nid, ghw); jax.block_until_ready(r)
     t0 = time.time()
     for _ in range(5):

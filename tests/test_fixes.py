@@ -16,7 +16,7 @@ import h2o3_tpu as h2o
 from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
 from h2o3_tpu.models.tree import bins_to_thresholds
 from h2o3_tpu.ops.binning import bin_matrix, split_threshold
-from h2o3_tpu.ops.histogram import _hist_scatter
+from h2o3_tpu.ops.histogram import _hist_scatter3
 from h2o3_tpu.ops.hist_pallas import hist_pallas_from_rowmajor
 
 
@@ -147,8 +147,9 @@ def test_pallas_interpret_parity(rows, F, n_nodes, nbins1):
     g = rng.normal(size=rows).astype(np.float32)
     h = rng.random(rows).astype(np.float32)
     w = (rng.random(rows) < 0.9).astype(np.float32)
-    ref = _hist_scatter(jnp.asarray(codes), jnp.asarray(nid), jnp.asarray(g),
-                        jnp.asarray(h), jnp.asarray(w), n_nodes, nbins1)
+    ghw = jnp.stack([jnp.asarray(g), jnp.asarray(h), jnp.asarray(w)])
+    ref = jnp.stack(_hist_scatter3(jnp.asarray(codes), jnp.asarray(nid),
+                                   ghw, n_nodes, nbins1), axis=-1)
     got = hist_pallas_from_rowmajor(
         jnp.asarray(codes), jnp.asarray(nid), jnp.asarray(g), jnp.asarray(h),
         jnp.asarray(w), n_nodes, nbins1, tile=256, mxu_dtype=jnp.float32,

@@ -65,12 +65,14 @@ def test_sharded_histogram_matches_local(tree_problem):
     from h2o3_tpu.ops.histogram import build_histograms, build_histograms_sharded
     nid = jnp.asarray(np.random.default_rng(0).integers(0, 4, codes.shape[0]),
                       jnp.int32)
-    local = build_histograms(codes, nid, g, h, w, 4, cfg.n_bins + 1, "scatter")
+    ghw = jnp.stack([g, h, w])
+    local = build_histograms(codes, nid, ghw, 4, cfg.n_bins + 1, "scatter")
     mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
-    shard = build_histograms_sharded(codes, nid, g, h, w, 4, cfg.n_bins + 1,
+    shard = build_histograms_sharded(codes, nid, ghw, 4, cfg.n_bins + 1,
                                      mesh, "scatter")
-    np.testing.assert_allclose(np.asarray(local), np.asarray(shard),
-                               rtol=1e-5, atol=1e-5)
+    for a, b in zip(local, shard):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
 
 
 def test_matmul_and_scatter_kernels_agree(tree_problem):
@@ -78,7 +80,9 @@ def test_matmul_and_scatter_kernels_agree(tree_problem):
     from h2o3_tpu.ops.histogram import build_histograms
     nid = jnp.asarray(np.random.default_rng(1).integers(0, 8, codes.shape[0]),
                       jnp.int32)
-    a = build_histograms(codes, nid, g, h, w, 8, cfg.n_bins + 1, "scatter")
-    b = build_histograms(codes, nid, g, h, w, 8, cfg.n_bins + 1, "matmul")
-    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
-                               atol=1e-4)
+    ghw = jnp.stack([g, h, w])
+    a = build_histograms(codes, nid, ghw, 8, cfg.n_bins + 1, "scatter")
+    b = build_histograms(codes, nid, ghw, 8, cfg.n_bins + 1, "matmul")
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=1e-4,
+                                   atol=1e-4)
