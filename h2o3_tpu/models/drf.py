@@ -39,7 +39,11 @@ from h2o3_tpu.persist import register_model_class
 MAX_DEPTH_CAP = 16
 
 DRF_DEFAULTS: Dict = dict(
-    ntrees=50, max_depth=16, min_rows=1.0, nbins=20, nbins_cats=1024,
+    # default depth 10, not the reference's 20: trees are complete binary
+    # arrays (static shapes for XLA), so depth-d histograms/compile cost
+    # scale with 2^d; the reference's deep default relies on dynamic node
+    # allocation (hex/tree/DTree.java) and min_rows pruning
+    ntrees=50, max_depth=10, min_rows=1.0, nbins=20, nbins_cats=1024,
     mtries=-1, sample_rate=0.632, col_sample_rate_per_tree=1.0,
     min_split_improvement=1e-5, seed=-1, histogram_type="quantiles_global",
     score_tree_interval=0, stopping_rounds=0, stopping_metric="auto",
@@ -263,7 +267,8 @@ class H2ORandomForestEstimator(ModelBuilder):
         # "training" numbers are out-of-bag when sample_rate < 1)
         self._oob_metrics(model, spec, K, oob_num, oob_cnt)
         if valid_spec is not None:
-            from h2o3_tpu.models.model_base import adapt_test_matrix
+            # valid_spec is already adapted to the training domains
+            # (build_validation_spec in ModelBuilder.train)
             out = model._predict_matrix(valid_spec.X)
             model.validation_metrics = compute_metrics(
                 out, valid_spec.y, valid_spec.w, spec.nclasses,
@@ -277,6 +282,13 @@ class H2ORandomForestEstimator(ModelBuilder):
         y = np.asarray(jax.device_get(spec.y))
         live = (cnt > 0) & (w > 0)
         if not live.any():
+            # no OOB rows (sample_rate == 1.0): fall back to in-bag scoring
+            # so training_metrics is never silently None (the reference
+            # still reports training metrics when OOB is unavailable)
+            out = model._predict_matrix(spec.X)
+            model.training_metrics = compute_metrics(
+                out, spec.y, spec.w, spec.nclasses, spec.response_domain)
+            model.output["oob_metrics"] = False
             return
         if K == 1:
             pred = num[live] / cnt[live]

@@ -35,7 +35,8 @@ GBM_DEFAULTS: Dict = dict(
     ntrees=50, max_depth=5, min_rows=10.0, learn_rate=0.1,
     learn_rate_annealing=1.0, sample_rate=1.0, col_sample_rate=1.0,
     col_sample_rate_per_tree=1.0, nbins=20, nbins_cats=1024,
-    distribution="auto", tweedie_power=1.5, min_split_improvement=1e-5,
+    distribution="auto", tweedie_power=1.5, quantile_alpha=0.5,
+    huber_alpha=0.9, min_split_improvement=1e-5,
     seed=-1, stopping_rounds=0, stopping_metric="auto",
     stopping_tolerance=1e-3, score_tree_interval=5, reg_lambda=0.0,
     max_abs_leafnode_pred=1e30, histogram_type="quantiles_global",
@@ -134,9 +135,9 @@ class GBMModel(Model):
 
 
 def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
-                    lr0, start_idx, *, cfg, K, dist_name, tweedie_power,
-                    sample_rate, col_rate, na_bin, chunk, anneal, has_valid,
-                    has_t, axis_name):
+                    lr0, hdelta, start_idx, *, cfg, K, dist_name,
+                    tweedie_power, quantile_alpha, sample_rate, col_rate,
+                    na_bin, chunk, anneal, has_valid, has_t, axis_name):
     """One chunk of the boosting loop, per data shard (runs under
     shard_map). ``chunk`` trees are built inside ONE program via lax.scan:
     per-call dispatch overhead amortises and margins/trees stay on device
@@ -167,7 +168,10 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
             col_mask = jax.random.uniform(key_c, (F,)) < col_rate
         trees = []
         if K == 1:
-            dist = get_distribution(dist_name, tweedie_power)
+            # hdelta rides as a traced scalar so data-derived huber deltas
+            # don't fragment the compile cache
+            dist = get_distribution(dist_name, tweedie_power, quantile_alpha,
+                                    hdelta)
             g, h = dist.grad_hess(margin, y)
             tree, nid = grow_tree(codes, g * wt, h * wt, wt, cfg, col_mask,
                                   axis_name=axis_name)
@@ -202,15 +206,17 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
 
 
 @lru_cache(maxsize=128)
-def _compiled_chunk(mesh, cfg, K, dist_name, tweedie_power, sample_rate,
-                    col_rate, na_bin, chunk, anneal, has_valid, has_t):
+def _compiled_chunk(mesh, cfg, K, dist_name, tweedie_power, quantile_alpha,
+                    sample_rate, col_rate, na_bin, chunk, anneal, has_valid,
+                    has_t):
     """Build + cache the sharded jitted chunk step for a given mesh/config.
 
     Rows ride the mesh 'data' axis; tree arrays come back replicated (every
     shard computes identical splits from the psum'd histograms — the same
     redundancy the reference's per-node DTree split scan has)."""
     body = partial(_gbm_chunk_body, cfg=cfg, K=K, dist_name=dist_name,
-                   tweedie_power=tweedie_power, sample_rate=sample_rate,
+                   tweedie_power=tweedie_power, quantile_alpha=quantile_alpha,
+                   sample_rate=sample_rate,
                    col_rate=col_rate, na_bin=na_bin, chunk=chunk,
                    anneal=anneal, has_valid=has_valid, has_t=has_t,
                    axis_name=DATA_AXIS)
@@ -218,7 +224,7 @@ def _compiled_chunk(mesh, cfg, K, dist_name, tweedie_power, sample_rate,
                 P(None, DATA_AXIS) if has_t else P(DATA_AXIS),  # codes_t/dummy
                 P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),  # margin, y, w
                 P(DATA_AXIS), P(DATA_AXIS),                # vrm, vmargin
-                P(), P(), P())                             # key, lr0, start
+                P(), P(), P(), P())                        # key, lr0, hdelta, start
     out_specs = (P(DATA_AXIS), P(DATA_AXIS), P())
     f = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, check_vma=False)
@@ -263,19 +269,41 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                          hist_method=p.get("hist_kernel", "auto"))
         y, w = spec.y, spec.w
         padded = spec.X.shape[0]
-        dist = get_distribution(dist_name, p["tweedie_power"]) if K == 1 else None
         if spec.offset is not None and K > 1:
             raise NotImplementedError(
                 "offset_column is not supported for multinomial GBM "
                 "(matching hex/tree/gbm/GBM.java offset restrictions)")
         prior = self._resolve_checkpoint(dist_name, spec)
+        huber_delta = 1.0
+        if K == 1 and dist_name == "huber":
+            # transition point = huber_alpha w-quantile of |resid - init|
+            # on the OFFSET-ADJUSTED scale (the reference re-estimates per
+            # scoring round; computed once here; w-weighted so pad/NA/
+            # zero-weight rows can't skew it)
+            from h2o3_tpu.models.distributions import (weighted_median,
+                                                       weighted_quantile)
+            yf0 = y.astype(jnp.float32)
+            if spec.offset is not None:
+                yf0 = yf0 - spec.offset
+            med = weighted_median(yf0, w)
+            huber_delta = float(jax.device_get(weighted_quantile(
+                jnp.abs(yf0 - med), w, float(p.get("huber_alpha", 0.9)))))
+            huber_delta = max(huber_delta, 1e-10)
+        dist = (self._dist(dist_name, huber_delta) if K == 1 else None)
         if K == 1:
             yf = y.astype(jnp.float32)
             if prior is not None:
                 f0 = jnp.asarray(prior.f0)
                 margin = prior._margin_matrix(spec.X).astype(jnp.float32)
             else:
-                f0 = dist.init_f0(yf, w)
+                if spec.offset is not None:
+                    # initial value on the offset-adjusted scale, not the
+                    # marginal init — early trees shouldn't spend capacity
+                    # correcting a biased intercept
+                    from h2o3_tpu.models.distributions import offset_adjusted_f0
+                    f0 = offset_adjusted_f0(dist, yf, w, spec.offset)
+                else:
+                    f0 = dist.init_f0(yf, w)
                 margin = jnp.full(padded, f0, jnp.float32)
             if spec.offset is not None:
                 # offset enters the margin, not the trees: f = f0 + offset + Σ lr·tree
@@ -344,11 +372,13 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             c = min(chunk, ntrees_new - built)
             step = _compiled_chunk(mesh, cfg, K, dist_name,
                                    float(p["tweedie_power"]),
+                                   float(p.get("quantile_alpha", 0.5)),
                                    float(p["sample_rate"]), col_rate,
                                    bm.na_bin, c, anneal, has_valid, has_t)
             margin, vmargin, chunk_trees = step(
                 bm.codes.rm, codes_t_arg, margin, yf, w, vcodes.rm, vmargin,
-                key, jnp.float32(lr), jnp.int32(start_trees + built))
+                key, jnp.float32(lr), jnp.float32(huber_delta),
+                jnp.int32(start_trees + built))
             all_trees.append(chunk_trees)  # stays on device until finalize
             built += c
             lr *= anneal ** c
@@ -370,9 +400,16 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         model = self._finalize(spec, valid_spec, dist_name, f0, all_trees, bm,
                                cfg, K, built, margin,
                                vmargin if has_valid else None, keeper,
-                               tree_offset=start_trees, prior=prior)
+                               tree_offset=start_trees, prior=prior,
+                               dist=dist)
         model.output["training_loop_seconds"] = t_loop
         return model
+
+    def _dist(self, dist_name: str, huber_delta: float = 1.0):
+        return get_distribution(dist_name,
+                                float(self.params.get("tweedie_power", 1.5)),
+                                float(self.params.get("quantile_alpha", 0.5)),
+                                huber_delta)
 
     def _resolve_checkpoint(self, dist_name: str, spec: TrainingSpec):
         """Continue-training support (hex/Model.java:487 _checkpoint): the
@@ -402,6 +439,27 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                 f"checkpoint feature set {prior.feature_names} differs from "
                 f"the training spec's {spec.names} — the prior trees' feature "
                 f"indices would address the wrong columns")
+        # response/domain compatibility (SharedTree/ModelBuilder checkpoint
+        # contract): a different class count would silently corrupt the
+        # margin columns under jit's clamped indexing; different categorical
+        # domains would misroute the prior trees' enum-code thresholds
+        if prior.nclasses != spec.nclasses:
+            raise ValueError(
+                f"checkpoint has {prior.nclasses} response classes but the "
+                f"training frame has {spec.nclasses}")
+        prd = tuple(prior.response_domain) if prior.response_domain else None
+        srd = tuple(spec.response_domain) if spec.response_domain else None
+        if prd != srd:
+            raise ValueError(
+                f"checkpoint response domain {prior.response_domain} differs "
+                f"from the training frame's {spec.response_domain}")
+        # normalize to tuples: domains loaded from disk round-trip as lists
+        pcd = {k: tuple(v) for k, v in prior.cat_domains.items()}
+        scd = {k: tuple(v) for k, v in spec.cat_domains.items()}
+        if pcd != scd:
+            raise ValueError(
+                "checkpoint categorical domains differ from the training "
+                "frame's — prior trees' enum-code splits would misroute")
         return prior
 
     def _score_entry(self, margin, sc_spec, dist, K, built,
@@ -431,7 +489,7 @@ class H2OGradientBoostingEstimator(ModelBuilder):
 
     def _finalize(self, spec, valid_spec, dist_name, f0, all_trees, bm, cfg,
                   K, built, margin, vmargin, keeper, tree_offset=0,
-                  prior=None) -> GBMModel:
+                  prior=None, dist=None) -> GBMModel:
         M = cfg.n_nodes
         T = built * max(K, 1)
         host = [{k: np.asarray(jax.device_get(v)) for k, v in t.items()}
@@ -488,13 +546,14 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         }
         model.scoring_history = keeper.history
         # final metrics from the training margin (exact, no re-predict)
-        model.training_metrics = self._metrics_from_margin(margin, spec, dist_name, K)
+        model.training_metrics = self._metrics_from_margin(
+            margin, spec, dist_name, K, dist=dist)
         if vmargin is not None:
             model.validation_metrics = self._metrics_from_margin(
-                vmargin, valid_spec, dist_name, K)
+                vmargin, valid_spec, dist_name, K, dist=dist)
         return model
 
-    def _metrics_from_margin(self, margin, spec, dist_name, K):
+    def _metrics_from_margin(self, margin, spec, dist_name, K, dist=None):
         if spec.nclasses == 2:
             p1 = 1.0 / (1.0 + jnp.exp(-margin))
             probs = jnp.stack([1.0 - p1, p1], axis=1)
@@ -502,7 +561,7 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         if K > 1:
             probs = jax.nn.softmax(margin, axis=1)
             return compute_metrics(probs, spec.y, spec.w, K, spec.response_domain)
-        dist = get_distribution(dist_name, self.params.get("tweedie_power", 1.5))
+        dist = dist if dist is not None else self._dist(dist_name)
         mu = dist.predict(margin)
         dev = float(jax.device_get(dist.deviance(spec.w, spec.y.astype(jnp.float32), mu)))
         return compute_metrics(mu, spec.y, spec.w, 1, deviance=dev)

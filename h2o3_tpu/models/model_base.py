@@ -68,14 +68,9 @@ def build_training_spec(frame: Frame, y: str, x: Optional[Sequence[str]] = None,
     if classification is None:
         classification = rvec.type == T_ENUM
     if classification and rvec.type != T_ENUM:
-        # numeric 0/1 response used as classification → derive domain
-        raw = rvec.to_numpy()
-        vals = np.unique(raw)
-        vals = vals[np.isfinite(vals)]
-        domain = tuple(str(int(v)) if v == int(v) else str(v) for v in vals)
-        codes = np.searchsorted(vals, raw)
-        codes[~np.isfinite(raw)] = -1  # NaN response → NA, not a phantom class
-        rvec = Vec.from_numpy(codes.astype(np.int32), vtype=T_ENUM, domain=domain)
+        # numeric response used as classification → derive domain
+        # (Vec.asfactor: unique finite values → sorted domain, NaN → NA)
+        rvec = rvec.asfactor()
     X = frame.as_matrix(names)
     is_cat = [frame.vec(n).type == T_ENUM for n in names]
     cat_domains = {n: frame.vec(n).domain for n in names
@@ -138,15 +133,75 @@ def adapt_test_matrix(model: "Model", frame: Frame):
     """adaptTestForTrain (hex/Model.java): reorder columns to training
     order, remap enum codes through the training domain (unseen → NA),
     missing columns → all-NA."""
+    return _adapt_matrix(frame, model.feature_names, model.feature_is_cat,
+                         model.cat_domains)
+
+
+def build_validation_spec(frame: Frame, train_spec: TrainingSpec,
+                          weights_column=None, offset_column=None) -> TrainingSpec:
+    """Validation/test spec ADAPTED to a training spec: columns in training
+    order, enum codes remapped through the TRAINING domains (unseen → NA),
+    response codes mapped through the training response domain. Building a
+    fresh spec from the validation frame's own domains silently misroutes
+    enum splits and class indices (adaptTestForTrain, hex/Model.java)."""
+    X = _adapt_matrix(frame, train_spec.names, train_spec.is_cat,
+                      train_spec.cat_domains)
+    padded = X.shape[0]
+    nrow = frame.nrow
+    row_ok = np.arange(padded) < nrow
+    if train_spec.response is None:
+        return TrainingSpec(
+            X=X, y=jnp.zeros(padded, jnp.float32),
+            w=jnp.asarray(row_ok.astype(np.float32)),
+            names=train_spec.names, is_cat=train_spec.is_cat,
+            cat_domains=train_spec.cat_domains, nrow=nrow, response=None,
+            response_domain=None, nclasses=1)
+    if train_spec.nclasses > 1:
+        codes, wr = response_codes_in_domain(frame, train_spec.response,
+                                             train_spec.response_domain)
+        y_dev = jnp.asarray(np.pad(codes, (0, padded - len(codes))))
+        w = np.zeros(padded, np.float32)
+        w[:nrow] = wr
+    else:
+        yf = np.asarray(jax.device_get(frame.vec(train_spec.response).as_float()))
+        resp_ok = np.isfinite(yf) & row_ok
+        y_dev = jnp.asarray(np.where(resp_ok, yf, 0.0).astype(np.float32))
+        w = resp_ok.astype(np.float32)
+    if weights_column:
+        if weights_column not in frame:
+            raise ValueError(
+                f"validation frame lacks weights_column '{weights_column}'")
+        wv = np.asarray(jax.device_get(frame.vec(weights_column).as_float()))
+        w = w * np.where(np.isnan(wv), 0.0, wv)
+    w = jnp.asarray(w)
+    offset = None
+    if offset_column:
+        # an offset-trained model requires the offset at validation time —
+        # silently dropping it would shift every margin (hex/Model.java
+        # adaptTestForTrain raises)
+        if offset_column not in frame:
+            raise ValueError(
+                f"validation frame lacks offset_column '{offset_column}'")
+        ov = frame.vec(offset_column).as_float()
+        offset = jnp.where(jnp.isnan(ov), 0.0, ov)
+    return TrainingSpec(X=X, y=y_dev, w=w, names=train_spec.names,
+                        is_cat=train_spec.is_cat,
+                        cat_domains=train_spec.cat_domains, nrow=nrow,
+                        response=train_spec.response,
+                        response_domain=train_spec.response_domain,
+                        nclasses=train_spec.nclasses, offset=offset)
+
+
+def _adapt_matrix(frame: Frame, feature_names, feature_is_cat, cat_domains):
     cols = []
     padded = None
-    for n, is_cat in zip(model.feature_names, model.feature_is_cat):
+    for n, is_cat in zip(feature_names, feature_is_cat):
         if n not in frame:
             cols.append(None)
             continue
         v = frame.vec(n)
         if is_cat and v.type == T_ENUM:
-            train_dom = model.cat_domains.get(n)
+            train_dom = cat_domains.get(n)
             if train_dom and v.domain != train_dom:
                 lut = {lab: i for i, lab in enumerate(train_dom)}
                 remap = np.array([lut.get(lab, -1) for lab in v.domain] + [-1],
@@ -419,7 +474,12 @@ class ModelBuilder:
         spec = self._make_spec(training_frame, y, x)
         valid_spec = None
         if validation_frame is not None:
-            valid_spec = self._make_spec(validation_frame, y, x)
+            # ADAPT the validation frame to the training spec (domain
+            # remap) rather than building a fresh spec from its own domains
+            valid_spec = build_validation_spec(
+                validation_frame, spec,
+                weights_column=self.params.get("weights_column"),
+                offset_column=self.params.get("offset_column"))
         job = Job(f"{self.algo} training", work=1.0)
 
         def body(job):

@@ -178,8 +178,12 @@ def export_file(frame, path: str, force: bool = False, sep: str = ",") -> str:
         raise FileExistsError(f"{path} exists (pass force=True to overwrite)")
     cols = [v.to_strings() if v.type == "enum" or v.type == "string"
             else v.to_numpy() for v in frame.vecs]
+    def q(s: str) -> str:
+        # RFC 4180: embedded quotes double up inside a quoted cell
+        return '"' + s.replace('"', '""') + '"'
+
     with open(path, "w") as f:
-        f.write(sep.join(f'"{n}"' for n in frame.names) + "\n")
+        f.write(sep.join(q(n) for n in frame.names) + "\n")
         for i in range(frame.nrow):
             cells = []
             for c in cols:
@@ -188,7 +192,7 @@ def export_file(frame, path: str, force: bool = False, sep: str = ",") -> str:
                                  and np.isnan(x)):
                     cells.append("")
                 elif isinstance(x, str):
-                    cells.append(f'"{x}"')
+                    cells.append(q(x))
                 elif isinstance(x, (float, np.floating)):
                     cells.append(repr(float(x)))
                 else:

@@ -1,0 +1,181 @@
+"""Regression tests for the round-2 ADVICE.md findings.
+
+- checkpoint compatibility must also check nclasses / response_domain /
+  cat_domains (medium: silent margin corruption under jit's clamped
+  indexing);
+- DRF with sample_rate=1.0 (no OOB rows) falls back to in-bag training
+  metrics instead of leaving them None;
+- validation frames are adapted through the TRAINING domains (enum code
+  remap) rather than their own;
+- GBM with offset computes f0 on the offset-adjusted scale (Newton);
+- export_file escapes embedded quotes per RFC 4180;
+- weighted-median Laplace init; quantile / huber families train.
+"""
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.models.drf import H2ORandomForestEstimator
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+
+def _train_frame(n=600, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=n).astype(np.float32)
+    y = (rng.random(n) < 1 / (1 + np.exp(-2 * x))).astype(np.int32)
+    return h2o.Frame.from_numpy({"x": x, "y": y.astype(np.float32)})
+
+
+def test_checkpoint_nclasses_mismatch_raises():
+    fr2 = _train_frame()
+    base = H2OGradientBoostingEstimator(ntrees=5, max_depth=3,
+                                        distribution="bernoulli", seed=1)
+    base.train(y="y", training_frame=fr2)
+    rng = np.random.default_rng(1)
+    n = 600
+    fr3 = h2o.Frame.from_numpy({
+        "x": rng.normal(size=n).astype(np.float32),
+        "y": rng.integers(0, 3, n).astype(np.float32)})
+    cont = H2OGradientBoostingEstimator(ntrees=10, max_depth=3,
+                                        distribution="multinomial",
+                                        checkpoint=base.model)
+    with pytest.raises(RuntimeError, match="distribution|classes"):
+        cont.train(y="y", training_frame=fr3)
+
+
+def test_checkpoint_domain_mismatch_raises():
+    rng = np.random.default_rng(2)
+    n = 600
+
+    def make(levels):
+        cat = rng.integers(0, len(levels), n)
+        x = rng.normal(size=n).astype(np.float32)
+        y = (rng.random(n) < np.where(cat == 0, 0.8, 0.2)).astype(np.float32)
+        fr = h2o.Frame.from_numpy({"c": np.array([levels[i] for i in cat]),
+                                   "x": x, "y": y})
+        return fr
+
+    fr_a = make(["a", "b", "c"])
+    fr_b = make(["b", "c", "d"])   # different enum domain
+    base = H2OGradientBoostingEstimator(ntrees=5, max_depth=3,
+                                        distribution="bernoulli", seed=1)
+    base.train(y="y", training_frame=fr_a)
+    cont = H2OGradientBoostingEstimator(ntrees=10, max_depth=3,
+                                        distribution="bernoulli",
+                                        checkpoint=base.model)
+    with pytest.raises(RuntimeError, match="categorical domains"):
+        cont.train(y="y", training_frame=fr_b)
+
+
+def test_drf_no_oob_falls_back_to_inbag():
+    fr = _train_frame()
+    fr["y"] = fr.vec("y").asfactor()   # binomial DRF
+    drf = H2ORandomForestEstimator(ntrees=5, max_depth=4, sample_rate=1.0,
+                                   seed=3)
+    drf.train(y="y", training_frame=fr)
+    assert drf.model.training_metrics is not None
+    assert drf.model.output.get("oob_metrics") is False
+    assert drf.model.auc() is not None
+
+
+def test_validation_frame_enum_domain_remap():
+    """A validation frame whose enum levels arrive in a different order
+    must score identically to one with the training order."""
+    rng = np.random.default_rng(5)
+    n = 800
+    lv = ["lo", "mid", "hi"]
+    cat = rng.integers(0, 3, n)
+    y = (rng.random(n) < np.where(cat == 2, 0.85, 0.15)).astype(np.float32)
+    labels = np.array([lv[i] for i in cat])
+    fr = h2o.Frame.from_numpy({"c": labels, "y": y})
+    # validation frame: same rows, but the enum domain EXPLICITLY reordered
+    # — codes built against this domain are wrong unless remapped through
+    # the training domain
+    from h2o3_tpu.frame.vec import T_ENUM, Vec
+    train_dom = fr.vec("c").domain
+    reordered = tuple(reversed(train_dom))
+    lut = {lab: i for i, lab in enumerate(reordered)}
+    codes_v = np.array([lut[l] for l in labels], dtype=np.int32)
+    fr_v = h2o.Frame(["c", "y"],
+                     [Vec.from_numpy(codes_v, vtype=T_ENUM, domain=reordered),
+                      fr.vec("y")])
+    assert fr_v.vec("c").domain != train_dom
+    gbm = H2OGradientBoostingEstimator(ntrees=10, max_depth=3,
+                                       distribution="bernoulli", seed=1)
+    gbm.train(y="y", training_frame=fr, validation_frame=fr_v)
+    vm = gbm.model.validation_metrics
+    # the validation rows are a permutation of the training rows, so
+    # validation logloss must equal training logloss
+    tm = gbm.model.training_metrics
+    assert abs(vm.logloss - tm.logloss) < 1e-5
+
+
+def test_gbm_offset_aware_f0():
+    """With a constant response and a known offset, f0 must absorb the
+    offset exactly (gaussian: f0 = weighted mean of y - offset)."""
+    rng = np.random.default_rng(6)
+    n = 500
+    off = rng.normal(size=n).astype(np.float32) * 3.0
+    y = (off + 2.0).astype(np.float32)   # y - offset ≡ 2
+    fr = h2o.Frame.from_numpy({"x": rng.normal(size=n).astype(np.float32),
+                               "off": off, "y": y})
+    gbm = H2OGradientBoostingEstimator(ntrees=1, max_depth=2,
+                                       distribution="gaussian",
+                                       offset_column="off", seed=1)
+    gbm.train(y="y", training_frame=fr)
+    assert abs(float(np.asarray(gbm.model.f0)) - 2.0) < 1e-3
+
+
+def test_export_file_escapes_quotes(tmp_path):
+    vals = np.array(['plain', 'has "quote"', 'comma, inside'])
+    fr = h2o.Frame.from_numpy({"s": vals,
+                               "v": np.arange(3).astype(np.float32)})
+    path = str(tmp_path / "q.csv")
+    h2o.export_file(fr, path)
+    back = h2o.import_file(path)
+    assert back.nrow == 3
+    got = list(back.vec("s").to_strings())
+    assert got == list(vals), got
+
+
+def test_quantile_distribution_trains():
+    rng = np.random.default_rng(7)
+    n = 2000
+    x = rng.normal(size=n).astype(np.float32)
+    y = (2 * x + rng.standard_exponential(n)).astype(np.float32)
+    fr = h2o.Frame.from_numpy({"x": x, "y": y})
+    gbm = H2OGradientBoostingEstimator(ntrees=40, max_depth=3,
+                                       distribution="quantile",
+                                       quantile_alpha=0.8, seed=1,
+                                       learn_rate=0.2, min_rows=5.0)
+    gbm.train(y="y", training_frame=fr)
+    pred = gbm.model.predict(fr).vec("predict").to_numpy()
+    cover = float(np.mean(y <= pred))
+    assert 0.7 < cover < 0.9, cover   # ~alpha of rows under the prediction
+
+
+def test_huber_distribution_trains():
+    rng = np.random.default_rng(8)
+    n = 2000
+    x = rng.normal(size=n).astype(np.float32)
+    y = (3 * x).astype(np.float32)
+    y[:40] += 100.0  # gross outliers — huber should shrug them off
+    fr = h2o.Frame.from_numpy({"x": x, "y": y})
+    gbm = H2OGradientBoostingEstimator(ntrees=50, max_depth=3,
+                                       distribution="huber", seed=1,
+                                       learn_rate=0.2, min_rows=5.0)
+    gbm.train(y="y", training_frame=fr)
+    pred = gbm.model.predict(fr).vec("predict").to_numpy()
+    clean = np.arange(n) >= 40
+    mse_clean = float(np.mean((pred[clean] - y[clean]) ** 2))
+    assert mse_clean < 1.0, mse_clean
+
+
+def test_weighted_median_laplace():
+    from h2o3_tpu.models.distributions import get_distribution
+    import jax.numpy as jnp
+    d = get_distribution("laplace")
+    y = jnp.asarray(np.array([0.0, 1.0, 10.0], np.float32))
+    w = jnp.asarray(np.array([1.0, 1.0, 5.0], np.float32))
+    # cumulative weights 1,2,7; half-total 3.5 → the 10.0 element
+    assert float(d.init_f0(y, w)) == 10.0
