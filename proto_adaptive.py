@@ -1,0 +1,253 @@
+"""Prototype: fused route+bin+histogram pallas kernel with per-node
+adaptive uniform bins (H2O DHistogram UniformAdaptive semantics).
+
+Per level d, one kernel call over row tiles:
+  1. route: nid' = child(nid) using the PREVIOUS level's split tables
+     (feat/thr/na_left/can per node) — table lookups via one-hot matmul,
+     split-feature select via compare-accumulate over F lanes;
+  2. bin: b = isnan(x) ? W-1 : clip((x - lo[n,f]) * inv[n,f], 0, W-2)
+     with per-(node, feature) ranges — lookups again via one-hot matmul;
+  3. hist: acc[(k,n), (f,b)] += ghw[k] via node-onehot × bin-onehot MXU
+     contraction.
+
+Outputs: histogram triple + updated nid. No precomputed codes, no
+transposed copy, no per-level XLA routing pass.
+"""
+import functools, sys, time
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+W = 64          # per-feature histogram lanes: bins 0..W-2 real, W-1 = NA
+TILE = 2048
+
+
+def _kernel(x_ref, nid_ref, ghw_ref, feat_ref, thr_ref, nal_ref, can_ref,
+            lo_ref, inv_ref, nid_out, hist_out, acc_ref, *,
+            n_prev: int, n_nodes: int, F: int, tile: int, n_row_tiles: int,
+            level_base: int, mxu_dtype=jnp.bfloat16):
+    r = pl.program_id(0)
+
+    @pl.when(r == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[...]                                   # [tile, F] f32
+    nid = nid_ref[0, :]                              # [tile] i32 (global ids)
+    # ---- route through the previous level's splits -------------------
+    prev_base = level_base - n_prev if n_prev > 0 else 0
+    if n_prev > 0:
+        lid_p = nid - prev_base                      # local id in prev level
+        onp = (jax.lax.broadcasted_iota(jnp.int32, (n_prev, tile), 0)
+               == lid_p[None, :]).astype(jnp.float32)   # [n_prev, tile]
+        # per-row split data via one-hot matmul (exact for ints < 2^24)
+        def lut(tbl_ref):
+            # HIGHEST precision: a bf16-rounded threshold flips routing for
+            # rows near the split boundary
+            t = tbl_ref[0, :n_prev].astype(jnp.float32)  # [n_prev]
+            return jax.lax.dot_general(
+                t[None, :], onp, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)[0]  # [tile]
+        f_r = lut(feat_ref)                          # split feature (f32)
+        t_r = lut(thr_ref)                           # raw threshold
+        nl_r = lut(nal_ref)                          # NA-left flag
+        cn_r = lut(can_ref)                          # is-split flag
+        # x[r, feat_r]: compare-accumulate over F (no dynamic gather);
+        # f_r is an exact int-valued float (one-hot matmul of ints < 2^24)
+        fi = jax.lax.broadcasted_iota(jnp.int32, (tile, F), 1)
+        f_i = f_r.astype(jnp.int32)
+        xsel = jnp.sum(jnp.where(fi == f_i[:, None], x, 0.0), axis=1)
+        # all-float select (bool-branch select_n lowers to an i8→i1
+        # truncation Mosaic rejects)
+        is_na = jnp.isnan(xsel)
+        gr_f = jnp.where(is_na, 1.0 - nl_r,
+                         (xsel >= t_r).astype(jnp.float32))
+        in_prev = (lid_p >= 0) & (lid_p < n_prev)
+        child = 2 * nid + 1 + gr_f.astype(jnp.int32)
+        nid = jnp.where(in_prev & (cn_r > 0.5), child, nid)
+    nid_out[0, :] = nid
+    # ---- per-(node, feature) ranges ----------------------------------
+    lid = nid - level_base
+    in_lvl = (lid >= 0) & (lid < n_nodes)
+    lidc = jnp.where(in_lvl, lid, 0)
+    onh = (jax.lax.broadcasted_iota(jnp.int32, (n_nodes, tile), 0)
+           == lidc[None, :])
+    onh_f = onh.astype(jnp.float32) * in_lvl.astype(jnp.float32)[None, :]
+    # lo/inv per row: [tile, F] = onh^T @ lo (contraction over n; exact f32
+    # so bin boundaries match the host/split-side threshold arithmetic)
+    lo_r = jax.lax.dot_general(onh_f, lo_ref[...], (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32,
+                               precision=jax.lax.Precision.HIGHEST)
+    inv_r = jax.lax.dot_general(onh_f, inv_ref[...], (((0,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                                precision=jax.lax.Precision.HIGHEST)
+    bin_f = jnp.clip((x - lo_r) * inv_r, 0.0, float(W - 2))
+    bin_i = jnp.where(jnp.isnan(x), W - 1, bin_f.astype(jnp.int32))  # [tile,F]
+    # ---- one-hot over W lanes per feature, contract on MXU -----------
+    b_all = jnp.concatenate(
+        [jnp.broadcast_to(bin_i[:, fi:fi + 1], (tile, W)) for fi in range(F)],
+        axis=1)                                               # [tile, F*W]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (tile, F * W), 1)
+    oh = ((lane % W) == b_all).astype(mxu_dtype)
+    ghw = ghw_ref[...]                        # [3, tile]
+    left = jnp.concatenate(
+        [onh_f.astype(mxu_dtype) * ghw[k, :][None, :].astype(mxu_dtype)
+         for k in range(3)], axis=0)          # [3N, tile]
+    acc_ref[...] += jax.lax.dot_general(
+        left, oh, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=(jax.lax.Precision.HIGHEST if mxu_dtype == jnp.float32
+                   else jax.lax.Precision.DEFAULT))   # [3N, F*W]
+
+    @pl.when(r == n_row_tiles - 1)
+    def _flush():
+        hist_out[...] = acc_ref[...]
+
+
+def level_kernel(x, nid, ghw, tables_prev, lo, inv, n_prev, n_nodes,
+                 level_base, tile=TILE, interpret=False,
+                 mxu_dtype=jnp.bfloat16):
+    """x [rows, F] f32 (NaN=NA), nid [rows] i32, ghw [3, rows] f32,
+    tables_prev = (feat, thr, nal, can) each [n_prev] f32/i32,
+    lo/inv [n_nodes, F] f32 → (nid', hist [3N, F*W])."""
+    rows, F = x.shape
+    assert rows % tile == 0
+    n_row_tiles = rows // tile
+    feat, thr, nal, can = tables_prev
+    np1 = max(n_prev, 1)
+    kern = functools.partial(_kernel, n_prev=n_prev, n_nodes=n_nodes, F=F,
+                             tile=tile, n_row_tiles=n_row_tiles,
+                             level_base=level_base, mxu_dtype=mxu_dtype)
+    nid2, hist = pl.pallas_call(
+        kern,
+        grid=(n_row_tiles,),
+        in_specs=[
+            pl.BlockSpec((tile, F), lambda r: (r, 0)),       # x
+            pl.BlockSpec((1, tile), lambda r: (0, r)),       # nid
+            pl.BlockSpec((3, tile), lambda r: (0, r)),       # ghw
+            pl.BlockSpec((1, np1), lambda r: (0, 0)),        # feat
+            pl.BlockSpec((1, np1), lambda r: (0, 0)),        # thr
+            pl.BlockSpec((1, np1), lambda r: (0, 0)),        # nal
+            pl.BlockSpec((1, np1), lambda r: (0, 0)),        # can
+            pl.BlockSpec((n_nodes, F), lambda r: (0, 0)),    # lo
+            pl.BlockSpec((n_nodes, F), lambda r: (0, 0)),    # inv
+        ],
+        out_specs=[
+            pl.BlockSpec((1, tile), lambda r: (0, r)),               # nid'
+            pl.BlockSpec((3 * n_nodes, F * W), lambda r: (0, 0)),    # hist
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, rows), jnp.int32),
+            jax.ShapeDtypeStruct((3 * n_nodes, F * W), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((3 * n_nodes, F * W), jnp.float32)],
+        interpret=interpret,
+    )(x, nid[None, :], ghw, feat[None, :], thr[None, :], nal[None, :],
+      can[None, :], lo, inv)
+    return nid2[0], hist.reshape(3, n_nodes, F, W)
+
+
+def ref_level(x, nid, ghw, tables_prev, lo, inv, n_prev, n_nodes, level_base):
+    """Numpy reference of the same level."""
+    x = np.asarray(x); nid = np.asarray(nid).copy(); ghw = np.asarray(ghw)
+    feat, thr, nal, can = [np.asarray(t) for t in tables_prev]
+    rows, F = x.shape
+    if n_prev > 0:
+        prev_base = level_base - n_prev
+        lid_p = nid - prev_base
+        inp = (lid_p >= 0) & (lid_p < n_prev)
+        for r in range(rows):
+            if not inp[r] or can[lid_p[r]] < 0.5:
+                continue
+            f = int(feat[lid_p[r]])
+            xv = x[r, f]
+            if np.isnan(xv):
+                gr = nal[lid_p[r]] < 0.5
+            else:
+                gr = xv >= thr[lid_p[r]]
+            nid[r] = 2 * nid[r] + 1 + int(gr)
+    hist = np.zeros((3, n_nodes, F, W), np.float32)
+    lid = nid - level_base
+    inl = (lid >= 0) & (lid < n_nodes)
+    for r in range(rows):
+        if not inl[r]:
+            continue
+        n = lid[r]
+        for f in range(F):
+            xv = x[r, f]
+            if np.isnan(xv):
+                b = W - 1
+            else:
+                b = int(np.clip((xv - lo[n, f]) * inv[n, f], 0, W - 2))
+            hist[:, n, f, b] += ghw[:, r]
+    return nid, hist
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+    mode = sys.argv[2] if len(sys.argv) > 2 else "check"
+    F = 32
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(rows, F)).astype(np.float32)
+    x[rng.random((rows, F)) < 0.05] = np.nan
+    ghw = rng.normal(size=(3, rows)).astype(np.float32)
+    if mode == "check":
+        # level 2: n_prev=2, n_nodes=4, with some dead rows
+        n_prev, n_nodes, base = 2, 4, 3
+        nid = rng.integers(0, 3, rows).astype(np.int32)  # ids 0..2 (some dead)
+        nid[nid == 0] = 1
+        feat = rng.integers(0, F, 2).astype(np.int32)
+        thr = rng.normal(size=2).astype(np.float32)
+        nal = (rng.random(2) < 0.5).astype(np.float32)
+        can = np.array([1.0, 1.0], np.float32)
+        lo = (rng.normal(size=(n_nodes, F)) * 0.1 - 1.0).astype(np.float32)
+        inv = np.full((n_nodes, F), (W - 2) / 2.0, np.float32)
+        tabs = (jnp.asarray(feat, jnp.float32), jnp.asarray(thr),
+                jnp.asarray(nal), jnp.asarray(can))
+        nid2, hist = level_kernel(jnp.asarray(x), jnp.asarray(nid),
+                                  jnp.asarray(ghw), tabs, jnp.asarray(lo),
+                                  jnp.asarray(inv), n_prev, n_nodes, base,
+                                  tile=256, interpret=True,
+                                  mxu_dtype=jnp.float32)
+        rn, rh = ref_level(x, nid, ghw, (feat, thr, nal, can), lo, inv,
+                           n_prev, n_nodes, base)
+        np.testing.assert_array_equal(np.asarray(nid2), rn)
+        np.testing.assert_allclose(np.asarray(hist), rh, rtol=1e-5, atol=1e-4)
+        print("parity OK (f32 exact)")
+    else:
+        REP = 10
+        xs = jnp.asarray(x)
+        nid = jnp.zeros(rows, jnp.int32)
+        ghws = jnp.asarray(ghw)
+        for n_nodes, n_prev, base in ((1, 0, 0), (8, 4, 7), (32, 16, 31)):
+            feat = jnp.zeros(max(n_prev, 1), jnp.float32)
+            thr = jnp.zeros(max(n_prev, 1), jnp.float32)
+            nal = jnp.zeros(max(n_prev, 1), jnp.float32)
+            can = jnp.zeros(max(n_prev, 1), jnp.float32)
+            lo = jnp.full((n_nodes, F), -3.0)
+            inv = jnp.full((n_nodes, F), (W - 2) / 6.0)
+            nz = jnp.zeros(rows, jnp.int32) + (base if base else 0)
+
+            @jax.jit
+            def run(x, nid, ghw, lo, inv, f, t, a, c):
+                def it(i, acc):
+                    nid2, h = level_kernel(x, nid + i * 0, ghw,
+                                           (f, t, a, c), lo, inv,
+                                           n_prev, n_nodes, base)
+                    return acc + h[0, 0, 0, 0] + nid2[0].astype(jnp.float32)
+                return jax.lax.fori_loop(0, REP, it, jnp.float32(0))
+
+            s = float(run(xs, nz, ghws, lo, inv, feat, thr, nal, can))
+            t0 = time.time()
+            s = float(run(xs, nz, ghws, lo, inv, feat, thr, nal, can))
+            dt = (time.time() - t0) / REP
+            gb = rows * F * 4 / 1e9
+            print(f"N={n_nodes:3d}: {dt*1e3:8.2f} ms/level "
+                  f"({gb/dt:.0f} GB/s eff)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""Microbenchmarks deciding the r3 histogram kernel design (not shipped).
+
+Questions:
+1. How fast is a row-gather (partition permutation) on [10M, F] uint8/int32?
+2. How fast is lax.sort at 10M with payloads?
+3. Per-step cost of the current kernel vs tile size.
+4. Cost of a partition-permutation computed with cumsums.
+"""
+import sys, time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+ROWS = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
+F = 32
+
+def t(fn, *a, n=5):
+    r = fn(*a); jax.block_until_ready(r)
+    t0 = time.time()
+    for _ in range(n):
+        r = fn(*a)
+    jax.block_until_ready(r)
+    return (time.time() - t0) / n
+
+rng = np.random.default_rng(0)
+rows_p = ((ROWS + 2047) // 2048) * 2048
+codes8 = jnp.asarray(rng.integers(0, 255, (rows_p, F), dtype=np.uint8))
+codes32 = codes8.astype(jnp.int32)
+g = jnp.asarray(rng.normal(size=rows_p).astype(np.float32))
+
+# partition-like permutation: rows split into 64 segments, each stably
+# partitioned by a random bit (what one level of routing produces)
+seg = rng.integers(0, 64, rows_p)
+bit = rng.random(rows_p) < 0.5
+order = np.lexsort((bit, seg))
+perm = jnp.asarray(order.astype(np.int32))
+
+take_rows8 = jax.jit(lambda c, p: jnp.take(c, p, axis=0))
+take_rows32 = jax.jit(lambda c, p: jnp.take(c, p, axis=0))
+take_1d = jax.jit(lambda v, p: jnp.take(v, p))
+print(f"rows={rows_p}")
+dt = t(take_rows8, codes8, perm)
+print(f"take rows uint8 [R,{F}]: {dt*1e3:8.2f} ms  ({codes8.size/dt/1e9:.0f} GB/s)")
+dt = t(take_rows32, codes32, perm)
+print(f"take rows int32 [R,{F}]: {dt*1e3:8.2f} ms  ({codes32.size*4/dt/1e9:.0f} GB/s)")
+dt = t(take_1d, g, perm)
+print(f"take 1d f32 [R]:        {dt*1e3:8.2f} ms  ({g.size*4/dt/1e9:.0f} GB/s)")
+
+# sort with payload
+keys = jnp.asarray(rng.integers(0, 64, rows_p, dtype=np.int32))
+sort2 = jax.jit(lambda k, v: jax.lax.sort((k, v), num_keys=1))
+dt = t(sort2, keys, g)
+print(f"lax.sort 1 payload:     {dt*1e3:8.2f} ms")
+
+# partition permutation arithmetic (cumsum-based stable partition):
+# pos = seg_base + (left ? rank_left : nleft_seg + rank_right)
+def partition_perm(seg_sorted_sizes, go_left, seg_id):
+    # rows already segment-contiguous; go_left [R] bool, seg_id [R] int32
+    il = jnp.cumsum(go_left.astype(jnp.int32))          # inclusive
+    ir = jnp.cumsum((~go_left).astype(jnp.int32))
+    seg_start = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                 jnp.cumsum(seg_sorted_sizes)[:-1]])
+    il0 = jnp.take(il, seg_start) - jnp.take(go_left.astype(jnp.int32), seg_start)
+    ir0 = jnp.take(ir, seg_start) - jnp.take((~go_left).astype(jnp.int32), seg_start)
+    nleft = jax.ops.segment_sum(go_left.astype(jnp.int32), seg_id, 64)
+    base = jnp.take(seg_start, seg_id)
+    rl = il - jnp.take(il0, seg_id) - 1
+    rr = ir - jnp.take(ir0, seg_id) - 1
+    pos = base + jnp.where(go_left, rl, jnp.take(nleft, seg_id) + rr)
+    return pos
+
+sizes = jnp.asarray(np.bincount(np.sort(seg), minlength=64).astype(np.int32))
+segs_sorted = jnp.asarray(np.sort(seg).astype(np.int32))
+gl = jnp.asarray(bit)
+pp = jax.jit(partition_perm)
+dt = t(pp, sizes, gl, segs_sorted)
+print(f"partition_perm cumsums: {dt*1e3:8.2f} ms")
+
+# scatter rows via inverse perm (alternative to gather)
+inv = jnp.asarray(np.argsort(order).astype(np.int32))
+scat8 = jax.jit(lambda c, p: jnp.zeros_like(c).at[p].set(c))
+dt = t(scat8, codes8, inv)
+print(f"scatter rows uint8:     {dt*1e3:8.2f} ms  ({codes8.size/dt/1e9:.0f} GB/s)")
