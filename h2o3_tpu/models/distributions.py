@@ -50,7 +50,7 @@ class Bernoulli(Distribution):
     def predict(self, f):
         return jax_sigmoid(f)
     def deviance(self, w, y, mu):
-        eps = 1e-15
+        eps = 1e-7  # f32-safe: 1-1e-15 rounds to 1.0f -> log1p(-1) = -inf
         mu = jnp.clip(mu, eps, 1 - eps)
         return -2.0 * (w * (y * jnp.log(mu) + (1 - y) * jnp.log1p(-mu))).sum() / w.sum()
 

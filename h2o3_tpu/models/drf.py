@@ -31,7 +31,7 @@ from h2o3_tpu.jobs import Job
 from h2o3_tpu.models.model_base import (Model, ModelBuilder, ScoreKeeper,
                                         TrainingSpec, compute_metrics)
 from h2o3_tpu.models.tree import (TreeConfig, bins_to_thresholds, grow_tree,
-                                  predict_raw_stacked)
+                                  grow_tree_adaptive, predict_raw_stacked)
 from h2o3_tpu.ops.binning import CodesView, bin_matrix, make_codes_view
 from h2o3_tpu.parallel.mesh import DATA_AXIS, current_mesh, n_data_shards
 from h2o3_tpu.persist import register_model_class
@@ -45,7 +45,7 @@ DRF_DEFAULTS: Dict = dict(
     # allocation (hex/tree/DTree.java) and min_rows pruning
     ntrees=50, max_depth=10, min_rows=1.0, nbins=20, nbins_cats=1024,
     mtries=-1, sample_rate=0.632, col_sample_rate_per_tree=1.0,
-    min_split_improvement=1e-5, seed=-1, histogram_type="quantiles_global",
+    min_split_improvement=1e-5, seed=-1, histogram_type="uniform_adaptive",
     score_tree_interval=0, stopping_rounds=0, stopping_metric="auto",
     stopping_tolerance=1e-3, hist_kernel="auto", reg_lambda=0.0,
 )
@@ -121,14 +121,22 @@ class DRFModel(Model):
 
 
 def _drf_chunk_body(codes_rm, codes_t, y, w, oob_num, oob_cnt, base_key,
-                    start_idx, *, cfg, K, sample_rate, col_rate, chunk,
-                    has_t, axis_name):
+                    root_lo, root_hi, nb_f, start_idx, *, cfg, K, sample_rate,
+                    col_rate, chunk, has_t, adaptive, axis_name):
     """A chunk of independent forest trees per data shard; OOB sums ride
     the scan carry (reference: DRF's OOB rows are scored by the trees that
     did not sample them — hex/tree/drf/DRF.java OOB machinery)."""
     codes = CodesView(rm=codes_rm, t=codes_t if has_t else None)
     F = codes_rm.shape[1]
     shard = jax.lax.axis_index(axis_name) if axis_name else 0
+
+    def build(gv, hv, wt, col_mask, key_m):
+        if adaptive:
+            return grow_tree_adaptive(codes_rm, gv, hv, wt, cfg, col_mask,
+                                      root_lo, root_hi, axis_name=axis_name,
+                                      key=key_m, nb_f=nb_f)
+        return grow_tree(codes, gv, hv, wt, cfg, col_mask,
+                         axis_name=axis_name, key=key_m)
 
     def one_tree(carry, i):
         oob_num, oob_cnt = carry
@@ -144,8 +152,7 @@ def _drf_chunk_body(codes_rm, codes_t, y, w, oob_num, oob_cnt, base_key,
         trees = []
         if K == 1:
             yf = y.astype(jnp.float32)
-            tree, nid = grow_tree(codes, -(yf * wt), wt, wt, cfg, col_mask,
-                                  axis_name=axis_name, key=key_m)
+            tree, nid = build(-(yf * wt), wt, wt, col_mask, key_m)
             pred = tree["value"][nid]
             oob_num = oob_num + jnp.where(live_oob, pred, 0.0)
             oob_cnt = oob_cnt + live_oob.astype(jnp.float32)
@@ -154,9 +161,8 @@ def _drf_chunk_body(codes_rm, codes_t, y, w, oob_num, oob_cnt, base_key,
             preds = []
             for k in range(K):
                 yk = (y == k).astype(jnp.float32)
-                tree, nid = grow_tree(codes, -(yk * wt), wt, wt, cfg,
-                                      col_mask, axis_name=axis_name,
-                                      key=jax.random.fold_in(key_m, k))
+                tree, nid = build(-(yk * wt), wt, wt, col_mask,
+                                  jax.random.fold_in(key_m, k))
                 preds.append(tree["value"][nid])
                 trees.append(tree)
             pk = jnp.stack(preds, axis=1)
@@ -171,15 +177,16 @@ def _drf_chunk_body(codes_rm, codes_t, y, w, oob_num, oob_cnt, base_key,
 
 
 @lru_cache(maxsize=128)
-def _compiled_drf_chunk(mesh, cfg, K, sample_rate, col_rate, chunk, has_t):
+def _compiled_drf_chunk(mesh, cfg, K, sample_rate, col_rate, chunk, has_t,
+                        adaptive):
     body = partial(_drf_chunk_body, cfg=cfg, K=K, sample_rate=sample_rate,
                    col_rate=col_rate, chunk=chunk, has_t=has_t,
-                   axis_name=DATA_AXIS)
+                   adaptive=adaptive, axis_name=DATA_AXIS)
     in_specs = (P(DATA_AXIS),
                 P(None, DATA_AXIS) if has_t else P(DATA_AXIS),
                 P(DATA_AXIS), P(DATA_AXIS),
                 P(DATA_AXIS), P(DATA_AXIS),
-                P(), P())
+                P(), P(), P(), P(), P())
     out_specs = (P(DATA_AXIS), P(DATA_AXIS), P())
     f = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, check_vma=False)
@@ -207,23 +214,44 @@ class H2ORandomForestEstimator(ModelBuilder):
                 f"{MAX_DEPTH_CAP} (complete-binary-array trees; the "
                 f"reference's default 20 relies on dynamic node allocation)")
         nbins = int(p["nbins"])
-        bm = bin_matrix(np.asarray(jax.device_get(spec.X)), spec.names,
-                        spec.is_cat, spec.nrow, nbins=max(nbins, 2),
-                        nbins_cats=int(p["nbins_cats"]),
-                        histogram_type=p.get("histogram_type",
-                                             "quantiles_global"))
+        hist_type = (p.get("histogram_type") or "uniform_adaptive").lower()
+        adaptive = hist_type in ("uniform_adaptive", "uniform", "auto",
+                                 "round_robin") and nbins <= 254
         mtries = int(p.get("mtries", -1) or -1)
-        F = bm.n_features
+        F = spec.n_features
         if mtries <= 0:
             # reference defaults: sqrt(p) classification, p/3 regression
             mtries = (max(1, int(np.sqrt(F))) if spec.nclasses > 1
                       else max(1, F // 3))
-        cfg = TreeConfig(max_depth=depth, n_bins=bm.n_bins, n_features=F,
-                         min_rows=float(p["min_rows"]),
-                         min_split_improvement=float(p["min_split_improvement"]),
-                         reg_lambda=float(p.get("reg_lambda", 0.0)),
-                         mtries=min(mtries, F),
-                         hist_method=p.get("hist_kernel", "auto"))
+        if adaptive:
+            bm = None
+            from h2o3_tpu.models.gbm import adaptive_nbins_eff
+            cfg = TreeConfig(max_depth=depth,
+                             n_bins=max(adaptive_nbins_eff(
+                                 spec, nbins, int(p["nbins_cats"])), 2),
+                             n_features=F, min_rows=float(p["min_rows"]),
+                             min_split_improvement=float(p["min_split_improvement"]),
+                             reg_lambda=float(p.get("reg_lambda", 0.0)),
+                             mtries=min(mtries, F),
+                             hist_method=p.get("hist_kernel", "auto"))
+            from h2o3_tpu.models.gbm import _adaptive_root_ranges
+            root_lo, root_hi, nb_f = _adaptive_root_ranges(
+                spec, nbins, int(p.get("nbins_cats", 1024)))
+        else:
+            bm = bin_matrix(np.asarray(jax.device_get(spec.X)), spec.names,
+                            spec.is_cat, spec.nrow, nbins=max(nbins, 2),
+                            nbins_cats=int(p["nbins_cats"]),
+                            histogram_type=hist_type)
+            cfg = TreeConfig(max_depth=depth, n_bins=bm.n_bins,
+                             n_features=bm.n_features,
+                             min_rows=float(p["min_rows"]),
+                             min_split_improvement=float(p["min_split_improvement"]),
+                             reg_lambda=float(p.get("reg_lambda", 0.0)),
+                             mtries=min(mtries, bm.n_features),
+                             hist_method=p.get("hist_kernel", "auto"))
+            root_lo = jnp.zeros(cfg.n_features, jnp.float32)
+            root_hi = jnp.zeros(cfg.n_features, jnp.float32)
+            nb_f = jnp.zeros(cfg.n_features, jnp.float32)
         mesh = current_mesh()
         nd = n_data_shards(mesh)
         padded = spec.X.shape[0]
@@ -236,8 +264,9 @@ class H2ORandomForestEstimator(ModelBuilder):
         ntrees = int(p["ntrees"])
         sample_rate = float(p["sample_rate"])
         col_rate = float(p.get("col_sample_rate_per_tree", 1.0))
-        has_t = bm.codes.t is not None
-        codes_t_arg = bm.codes.t if has_t else bm.codes.rm
+        Xtr = spec.X if adaptive else bm.codes.rm
+        has_t = (not adaptive) and bm.codes.t is not None
+        codes_t_arg = bm.codes.t if has_t else Xtr
         oob_num = (jnp.zeros(padded, jnp.float32) if K == 1
                    else jnp.zeros((padded, K), jnp.float32))
         oob_cnt = jnp.zeros(padded, jnp.float32)
@@ -249,10 +278,10 @@ class H2ORandomForestEstimator(ModelBuilder):
         while built < ntrees:
             c = min(chunk, ntrees - built)
             step = _compiled_drf_chunk(mesh, cfg, K, sample_rate, col_rate,
-                                       c, has_t)
+                                       c, has_t, adaptive)
             oob_num, oob_cnt, chunk_trees = step(
-                bm.codes.rm, codes_t_arg, y, spec.w, oob_num, oob_cnt, key,
-                jnp.int32(built))
+                Xtr, codes_t_arg, y, spec.w, oob_num, oob_cnt, key,
+                root_lo, root_hi, nb_f, jnp.int32(built))
             all_trees.append(chunk_trees)
             built += c
             job.set_progress(built / ntrees)
@@ -313,18 +342,24 @@ class H2ORandomForestEstimator(ModelBuilder):
         host = [{k: np.asarray(jax.device_get(v)) for k, v in t.items()}
                 for t in all_trees]
         feat = np.concatenate([t["feat"].reshape(-1, M) for t in host])
-        sbin = np.concatenate([t["split_bin"].reshape(-1, M) for t in host])
         nal = np.concatenate([t["na_left"].reshape(-1, M) for t in host])
         spl = np.concatenate([t["is_split"].reshape(-1, M) for t in host])
         val = np.concatenate([t["value"].reshape(-1, M) for t in host])
         gains = np.concatenate([t["gain"].reshape(-1, M) for t in host])
-        thr = np.stack([bins_to_thresholds(sbin[i], feat[i], bm.edges)
-                        for i in range(T)])
+        if "thr" in host[0]:
+            thr = np.concatenate([t["thr"].reshape(-1, M) for t in host])
+        else:
+            sbin = np.concatenate([t["split_bin"].reshape(-1, M)
+                                   for t in host])
+            thr = np.stack([bins_to_thresholds(sbin[i], feat[i], bm.edges)
+                            for i in range(T)])
         trees_host = {"feat": feat, "thr": thr, "na_left": nal,
                       "is_split": spl, "value": val}
         model = DRFModel(f"{self.algo}_{id(self) & 0xffffff:x}", self.params,
-                         spec, trees_host, bm.edges, bm.n_bins, cfg.max_depth,
-                         built, spec.nclasses)
+                         spec, trees_host,
+                         bm.edges if bm is not None else [],
+                         bm.n_bins if bm is not None else cfg.n_bins,
+                         cfg.max_depth, built, spec.nclasses)
         vi = np.zeros(len(spec.names))
         live = feat >= 0
         np.add.at(vi, feat[live], gains[live])

@@ -26,7 +26,8 @@ from h2o3_tpu.models.distributions import get_distribution
 from h2o3_tpu.models.model_base import (Model, ModelBuilder, ScoreKeeper,
                                         TrainingSpec, compute_metrics)
 from h2o3_tpu.models.tree import (TreeConfig, bins_to_thresholds, grow_tree,
-                                  predict_binned, predict_raw_stacked)
+                                  grow_tree_adaptive, predict_binned,
+                                  predict_raw_stacked, predict_raw_tree)
 from h2o3_tpu.ops.binning import (CodesView, bin_matrix, digitize_with_edges,
                                   make_codes_view)
 from h2o3_tpu.parallel.mesh import DATA_AXIS, current_mesh, n_data_shards
@@ -39,11 +40,41 @@ GBM_DEFAULTS: Dict = dict(
     huber_alpha=0.9, min_split_improvement=1e-5,
     seed=-1, stopping_rounds=0, stopping_metric="auto",
     stopping_tolerance=1e-3, score_tree_interval=5, reg_lambda=0.0,
-    max_abs_leafnode_pred=1e30, histogram_type="quantiles_global",
+    # uniform_adaptive = the reference's default (hex/tree/DHistogram.java
+    # UniformAdaptive): per-node re-binned uniform histograms via the fused
+    # adaptive kernel; quantiles_global = global-sketch binned codes
+    # (XGBoost tree_method=hist semantics)
+    max_abs_leafnode_pred=1e30, histogram_type="uniform_adaptive",
     # TPU-specific: which histogram kernel ('auto' = matmul on TPU,
     # scatter on CPU); see ops/histogram.py
     hist_kernel="auto",
 )
+
+
+def _adaptive_root_ranges(spec, nbins: int, nbins_cats: int):
+    """Root bin setup for the adaptive path: per-feature finite ranges
+    (±inf masked BEFORE the min/max so one infinite cell can't zero a
+    feature's range) and per-feature bin counts. Enums get nb = their code
+    span so identity binning reproduces exact per-level splits up to the
+    kernel's lane budget; beyond that, ordinal grouping refined by
+    narrowing (the nbins_cats analog, hex/tree/DHistogram nbins_cats)."""
+    Xf = jnp.where(jnp.isfinite(spec.X), spec.X, jnp.nan)
+    root_lo = jnp.nan_to_num(jnp.nanmin(Xf, axis=0), nan=0.0)
+    root_hi = jnp.nan_to_num(jnp.nanmax(Xf, axis=0), nan=0.0)
+    cat = jnp.asarray(np.asarray(spec.is_cat, dtype=bool))
+    span = jnp.maximum(root_hi - root_lo, 1.0)
+    nb_f = jnp.where(cat, jnp.minimum(span, float(nbins_cats)),
+                     float(nbins)).astype(jnp.float32)
+    return root_lo, root_hi, nb_f
+
+
+def adaptive_nbins_eff(spec, nbins: int, nbins_cats: int) -> int:
+    """Effective bin count sizing the kernel's lane width W: enums want
+    identity bins (card-1), capped by nbins_cats and the 254-lane max."""
+    cards = [len(spec.cat_domains.get(n, ())) for n, c in
+             zip(spec.names, spec.is_cat) if c]
+    max_card = max(cards, default=0)
+    return max(nbins, min(max(max_card - 1, 0), nbins_cats, 254))
 
 
 class GBMModel(Model):
@@ -135,21 +166,40 @@ class GBMModel(Model):
 
 
 def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
-                    lr0, hdelta, start_idx, *, cfg, K, dist_name,
-                    tweedie_power, quantile_alpha, sample_rate, col_rate,
-                    na_bin, chunk, anneal, has_valid, has_t, axis_name):
+                    lr0, hdelta, root_lo, root_hi, nb_f, start_idx, *, cfg, K,
+                    dist_name, tweedie_power, quantile_alpha, sample_rate,
+                    col_rate, na_bin, chunk, anneal, has_valid, has_t,
+                    adaptive, axis_name):
     """One chunk of the boosting loop, per data shard (runs under
     shard_map). ``chunk`` trees are built inside ONE program via lax.scan:
     per-call dispatch overhead amortises and margins/trees stay on device
     between trees. The reference dispatches one MRTask per level per tree
     (SharedTree.java:566-635) — here a whole chunk of trees is a single
     XLA program, and the cross-shard histogram reduction is the psum
-    inside grow_tree (the Rabit-allreduce / MRTask-reduce-tree analog,
-    hex/tree/xgboost/rabit/RabitTrackerH2O.java, water/MRTask.java:871)."""
+    inside the tree grower (the Rabit-allreduce / MRTask-reduce-tree
+    analog, hex/tree/xgboost/rabit/RabitTrackerH2O.java,
+    water/MRTask.java:871).
+
+    ``adaptive`` selects the fused per-node-adaptive-bins kernel over raw
+    features (codes_rm then carries raw X); otherwise the global-sketch
+    binned-codes path."""
     codes = CodesView(rm=codes_rm, t=codes_t if has_t else None)
     vcodes = vrm
     F = codes_rm.shape[1]
     shard = jax.lax.axis_index(axis_name) if axis_name else 0
+
+    def build(gv, hv, wt, col_mask):
+        if adaptive:
+            return grow_tree_adaptive(codes_rm, gv, hv, wt, cfg, col_mask,
+                                      root_lo, root_hi, axis_name=axis_name,
+                                      nb_f=nb_f)
+        return grow_tree(codes, gv, hv, wt, cfg, col_mask,
+                         axis_name=axis_name)
+
+    def valid_contrib(tree):
+        if adaptive:
+            return predict_raw_tree(vrm, tree, cfg.max_depth)[0]
+        return predict_binned(vcodes, tree, cfg.max_depth, na_bin)[0]
 
     def one_tree(carry, i):
         margin, vmargin, lr = carry
@@ -173,14 +223,12 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
             dist = get_distribution(dist_name, tweedie_power, quantile_alpha,
                                     hdelta)
             g, h = dist.grad_hess(margin, y)
-            tree, nid = grow_tree(codes, g * wt, h * wt, wt, cfg, col_mask,
-                                  axis_name=axis_name)
-            # grow_tree already routed every row to its leaf — reuse
+            tree, nid = build(g * wt, h * wt, wt, col_mask)
+            # the grower already routed every row to its leaf — reuse
             # nid instead of re-walking the tree (saves ~250ms/tree@1M)
             margin = margin + lr * tree["value"][nid]
             if has_valid:
-                vc, _ = predict_binned(vcodes, tree, cfg.max_depth, na_bin)
-                vmargin = vmargin + lr * vc
+                vmargin = vmargin + lr * valid_contrib(tree)
             trees.append(tree)
         else:
             p = jax.nn.softmax(margin, axis=1)
@@ -188,13 +236,10 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
                 yk = (y == k).astype(jnp.float32)
                 gk = (p[:, k] - yk)
                 hk = jnp.maximum(p[:, k] * (1.0 - p[:, k]), 1e-9)
-                tree, nid = grow_tree(codes, gk * wt, hk * wt, wt, cfg,
-                                      col_mask, axis_name=axis_name)
+                tree, nid = build(gk * wt, hk * wt, wt, col_mask)
                 margin = margin.at[:, k].add(lr * tree["value"][nid])
                 if has_valid:
-                    vc, _ = predict_binned(vcodes, tree, cfg.max_depth,
-                                           na_bin)
-                    vmargin = vmargin.at[:, k].add(lr * vc)
+                    vmargin = vmargin.at[:, k].add(lr * valid_contrib(tree))
                 trees.append(tree)
         stacked = {kk: jnp.stack([t[kk] for t in trees])
                    for kk in trees[0]}
@@ -208,7 +253,7 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
 @lru_cache(maxsize=128)
 def _compiled_chunk(mesh, cfg, K, dist_name, tweedie_power, quantile_alpha,
                     sample_rate, col_rate, na_bin, chunk, anneal, has_valid,
-                    has_t):
+                    has_t, adaptive):
     """Build + cache the sharded jitted chunk step for a given mesh/config.
 
     Rows ride the mesh 'data' axis; tree arrays come back replicated (every
@@ -219,12 +264,12 @@ def _compiled_chunk(mesh, cfg, K, dist_name, tweedie_power, quantile_alpha,
                    sample_rate=sample_rate,
                    col_rate=col_rate, na_bin=na_bin, chunk=chunk,
                    anneal=anneal, has_valid=has_valid, has_t=has_t,
-                   axis_name=DATA_AXIS)
-    in_specs = (P(DATA_AXIS),                              # codes_rm
+                   adaptive=adaptive, axis_name=DATA_AXIS)
+    in_specs = (P(DATA_AXIS),                              # codes_rm / raw X
                 P(None, DATA_AXIS) if has_t else P(DATA_AXIS),  # codes_t/dummy
                 P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),  # margin, y, w
                 P(DATA_AXIS), P(DATA_AXIS),                # vrm, vmargin
-                P(), P(), P(), P())                        # key, lr0, hdelta, start
+                P(), P(), P(), P(), P(), P(), P())  # key, lr0, hdelta, root_lo/hi, nb_f, start
     out_specs = (P(DATA_AXIS), P(DATA_AXIS), P())
     f = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, check_vma=False)
@@ -258,15 +303,38 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         task = ("binomial" if spec.nclasses == 2
                 else "multinomial" if K > 1 else "regression")
         nbins = int(p["nbins"])
-        bm = bin_matrix(np.asarray(jax.device_get(spec.X)), spec.names,
-                        spec.is_cat, spec.nrow, nbins=max(nbins, 2),
-                        nbins_cats=int(p["nbins_cats"]),
-                        histogram_type=p.get("histogram_type", "quantiles_global"))
-        cfg = TreeConfig(max_depth=int(p["max_depth"]), n_bins=bm.n_bins,
-                         n_features=bm.n_features, min_rows=float(p["min_rows"]),
-                         min_split_improvement=float(p["min_split_improvement"]),
-                         reg_lambda=float(p.get("reg_lambda", 0.0)),
-                         hist_method=p.get("hist_kernel", "auto"))
+        hist_type = (p.get("histogram_type") or "uniform_adaptive").lower()
+        # uniform_adaptive (reference default) runs the fused per-node
+        # adaptive kernel on raw features; the global-sketch path handles
+        # quantiles_global and nbins beyond the adaptive kernel's 254 cap
+        adaptive = hist_type in ("uniform_adaptive", "uniform", "auto",
+                                 "round_robin") and nbins <= 254
+        if adaptive:
+            bm = None
+            cfg = TreeConfig(max_depth=int(p["max_depth"]),
+                             n_bins=max(adaptive_nbins_eff(
+                                 spec, nbins, int(p["nbins_cats"])), 2),
+                             n_features=spec.n_features,
+                             min_rows=float(p["min_rows"]),
+                             min_split_improvement=float(p["min_split_improvement"]),
+                             reg_lambda=float(p.get("reg_lambda", 0.0)),
+                             hist_method=p.get("hist_kernel", "auto"))
+            root_lo, root_hi, nb_f = _adaptive_root_ranges(
+                spec, nbins, int(p.get("nbins_cats", 1024)))
+        else:
+            bm = bin_matrix(np.asarray(jax.device_get(spec.X)), spec.names,
+                            spec.is_cat, spec.nrow, nbins=max(nbins, 2),
+                            nbins_cats=int(p["nbins_cats"]),
+                            histogram_type=hist_type)
+            cfg = TreeConfig(max_depth=int(p["max_depth"]), n_bins=bm.n_bins,
+                             n_features=bm.n_features,
+                             min_rows=float(p["min_rows"]),
+                             min_split_improvement=float(p["min_split_improvement"]),
+                             reg_lambda=float(p.get("reg_lambda", 0.0)),
+                             hist_method=p.get("hist_kernel", "auto"))
+            root_lo = jnp.zeros(cfg.n_features, jnp.float32)
+            root_hi = jnp.zeros(cfg.n_features, jnp.float32)
+            nb_f = jnp.zeros(cfg.n_features, jnp.float32)
         y, w = spec.y, spec.w
         padded = spec.X.shape[0]
         if spec.offset is not None and K > 1:
@@ -334,9 +402,10 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         # validation margin tracked with train edges
         mesh = current_mesh()
         nd = n_data_shards(mesh)
-        if bm.codes.rm.shape[0] % nd != 0:
+        Xtr = spec.X if adaptive else bm.codes.rm
+        if Xtr.shape[0] % nd != 0:
             raise ValueError(
-                f"padded row count {bm.codes.rm.shape[0]} is not divisible by "
+                f"padded row count {Xtr.shape[0]} is not divisible by "
                 f"the {nd}-shard data axis — the training frame was built "
                 f"under a different mesh; rebuild it after h2o3_tpu.init()")
         has_valid = valid_spec is not None
@@ -346,8 +415,11 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                     f"validation frame padded rows {valid_spec.X.shape[0]} "
                     f"not divisible by the {nd}-shard data axis — rebuild it "
                     f"after h2o3_tpu.init()")
-            vcodes = make_codes_view(
-                digitize_with_edges(valid_spec.X, bm.edges, bm.n_bins))
+            if adaptive:
+                vtrain = valid_spec.X
+            else:
+                vtrain = make_codes_view(digitize_with_edges(
+                    valid_spec.X, bm.edges, bm.n_bins)).rm
             if prior is not None:
                 vmargin = prior._margin_matrix(valid_spec.X).astype(jnp.float32)
             else:
@@ -356,14 +428,15 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             if K == 1 and valid_spec.offset is not None:
                 vmargin = vmargin + valid_spec.offset
         else:  # small dummies (untraced branches, but args need shapes)
-            vcodes = make_codes_view(jnp.zeros((8 * nd, bm.n_features),
-                                               bm.codes.dtype))
+            vtrain = jnp.zeros((8 * nd, cfg.n_features),
+                               Xtr.dtype if adaptive else bm.codes.dtype)
             vmargin = (jnp.zeros(8 * nd, jnp.float32) if K == 1
                        else jnp.zeros((8 * nd, K), jnp.float32))
 
         chunk = interval if keeper.rounds > 0 else min(ntrees_new, 50)
-        has_t = bm.codes.t is not None
-        codes_t_arg = bm.codes.t if has_t else bm.codes.rm  # ignored dummy
+        has_t = (not adaptive) and bm.codes.t is not None
+        codes_t_arg = bm.codes.t if has_t else Xtr  # ignored dummy otherwise
+        na_bin = 0 if adaptive else bm.na_bin
         all_trees = []
         built = 0
         jax.block_until_ready(margin)
@@ -374,11 +447,12 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                                    float(p["tweedie_power"]),
                                    float(p.get("quantile_alpha", 0.5)),
                                    float(p["sample_rate"]), col_rate,
-                                   bm.na_bin, c, anneal, has_valid, has_t)
+                                   na_bin, c, anneal, has_valid, has_t,
+                                   adaptive)
             margin, vmargin, chunk_trees = step(
-                bm.codes.rm, codes_t_arg, margin, yf, w, vcodes.rm, vmargin,
+                Xtr, codes_t_arg, margin, yf, w, vtrain, vmargin,
                 key, jnp.float32(lr), jnp.float32(huber_delta),
-                jnp.int32(start_trees + built))
+                root_lo, root_hi, nb_f, jnp.int32(start_trees + built))
             all_trees.append(chunk_trees)  # stays on device until finalize
             built += c
             lr *= anneal ** c
@@ -482,7 +556,7 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                     entry["auc"] = float(jax.device_get(auc))
             return entry
         probs = jax.nn.softmax(margin, axis=1)
-        eps = 1e-15
+        eps = 1e-7  # f32-safe: 1-1e-15 rounds to 1.0f -> log1p(-1) = -inf
         py = jnp.clip(probs[jnp.arange(probs.shape[0]), y], eps, 1.0)
         ll = float(jax.device_get(-(w * jnp.log(py)).sum() / w.sum()))
         return {"ntrees": built, "logloss": ll, "deviance": ll}
@@ -495,7 +569,6 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         host = [{k: np.asarray(jax.device_get(v)) for k, v in t.items()}
                 for t in all_trees]
         feat = np.concatenate([t["feat"].reshape(-1, M) for t in host])
-        sbin = np.concatenate([t["split_bin"].reshape(-1, M) for t in host])
         nal = np.concatenate([t["na_left"].reshape(-1, M) for t in host])
         spl = np.concatenate([t["is_split"].reshape(-1, M) for t in host])
         val = np.concatenate([t["value"].reshape(-1, M) for t in host])
@@ -505,8 +578,13 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         lrs = lr0 * anneal ** np.repeat(
             np.arange(tree_offset, tree_offset + built), max(K, 1))
         val_scaled = val * lrs[:, None]
-        thr = np.stack([bins_to_thresholds(sbin[i], feat[i], bm.edges)
-                        for i in range(T)])
+        if "thr" in host[0]:
+            # adaptive path: raw thresholds straight from the grower
+            thr = np.concatenate([t["thr"].reshape(-1, M) for t in host])
+        else:
+            sbin = np.concatenate([t["split_bin"].reshape(-1, M) for t in host])
+            thr = np.stack([bins_to_thresholds(sbin[i], feat[i], bm.edges)
+                            for i in range(T)])
         trees_host = {"feat": feat, "thr": thr, "na_left": nal,
                       "is_split": spl, "value": val_scaled}
         if prior is not None:
@@ -521,8 +599,10 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             }
         f0_host = np.asarray(jax.device_get(f0))
         model = GBMModel(f"{self.algo}_{id(self) & 0xffffff:x}", self.params,
-                         spec, dist_name, f0_host, trees_host, bm.edges,
-                         bm.n_bins, cfg.max_depth, tree_offset + built,
+                         spec, dist_name, f0_host, trees_host,
+                         bm.edges if bm is not None else [],
+                         bm.n_bins if bm is not None else cfg.n_bins,
+                         cfg.max_depth, tree_offset + built,
                          spec.nclasses)
         # variable importances from split gains (merged with the prior's on
         # checkpoint continuation)

@@ -107,7 +107,7 @@ def _binary_curve_kernel(score, y, w):
 
 @jax.jit
 def _logloss_kernel(p, y, w):
-    eps = 1e-15
+    eps = 1e-7  # f32-safe: 1-1e-15 rounds to 1.0f -> log1p(-1) = -inf
     p = jnp.clip(p, eps, 1.0 - eps)
     ll = -(w * (y * jnp.log(p) + (1.0 - y) * jnp.log1p(-p))).sum() / w.sum()
     return ll
@@ -175,7 +175,7 @@ def make_binomial_metrics(prob, actual, weights=None) -> ModelMetricsBinomial:
 
 @jax.jit
 def _multinomial_kernel(probs, y, w):
-    eps = 1e-15
+    eps = 1e-7  # f32-safe: 1-1e-15 rounds to 1.0f -> log1p(-1) = -inf
     rows = probs.shape[0]
     py = probs[jnp.arange(rows), y]
     ll = -(w * jnp.log(jnp.clip(py, eps, 1.0))).sum() / w.sum()

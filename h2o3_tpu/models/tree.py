@@ -255,6 +255,153 @@ def grow_tree(codes, g, h, w, cfg: TreeConfig, col_mask, axis_name=None,
     return tree, nid
 
 
+def grow_tree_adaptive(X, g, h, w, cfg: TreeConfig, col_mask, root_lo,
+                       root_hi, axis_name=None, key=None, nb_f=None):
+    """Build one tree with PER-NODE ADAPTIVE uniform bins on raw features
+    (H2O's default histogram_type=UniformAdaptive, hex/tree/DHistogram.java
+    _min/_maxEx per-node re-binning) via the fused route+bin+histogram
+    kernel (ops/hist_adaptive.py).
+
+    X is [rows, F] float32 with NaN=NA (enum codes as floats — identity
+    uniform bins reproduce ordinal enum splits). root_lo/root_hi are [F]
+    global finite min/max (computed once per training run). Returns a
+    tree dict with RAW split thresholds (``thr``) — no bin→threshold
+    conversion at finalize, and training-time routing (x >= thr inside
+    the kernel) is bit-identical to scoring-time walks.
+
+    Child ranges narrow by the parent's split point on the split feature
+    (exact) and by the parent's occupied-bin span elsewhere (within one
+    bin width) — the static-shape analog of DHistogram's per-child
+    min/max re-measurement.
+
+    ``nb_f`` ([F] float, optional) gives PER-FEATURE bin counts: enums get
+    nb = their root span so identity binning reproduces exact per-level
+    splits up to W-1 categories (beyond that, ordinal grouping refined by
+    narrowing — the nbins_cats analog)."""
+    from h2o3_tpu.ops.hist_adaptive import (adaptive_level, leaf_totals,
+                                            pick_W)
+    from dataclasses import replace as dc_replace
+
+    D = cfg.max_depth
+    M = cfg.n_nodes
+    rows, F = X.shape
+    W = pick_W(cfg.n_bins)
+    # hist_kernel param: pallas/scatter honored; 'matmul' (a global-path
+    # kernel name) degrades to scatter here
+    method = (cfg.hist_method if cfg.hist_method in ("pallas", "scatter")
+              else "scatter" if cfg.hist_method == "matmul" else "auto")
+    if nb_f is None:
+        nb_f = jnp.full(F, float(min(cfg.n_bins, W - 2)), jnp.float32)
+    else:
+        nb_f = jnp.minimum(nb_f.astype(jnp.float32), float(W - 2))
+    find_cfg = dc_replace(cfg, n_bins=W - 1)  # NA lane at W-1 for _find_splits
+
+    feat = jnp.full(M, -1, jnp.int32)
+    thr_arr = jnp.zeros(M, jnp.float32)
+    na_left = jnp.zeros(M, bool)
+    is_split = jnp.zeros(M, bool)
+    value = jnp.zeros(M, jnp.float32)
+    gain_arr = jnp.zeros(M, jnp.float32)
+    node_w = jnp.zeros(M, jnp.float32)
+
+    ghw = jnp.stack([g, h, w]).astype(jnp.float32)
+    nid = jnp.zeros(rows, jnp.int32)
+    # per-(node, feature) ranges for the current level
+    lo_d = jnp.broadcast_to(root_lo[None, :], (1, F)).astype(jnp.float32)
+    hi_d = jnp.broadcast_to(root_hi[None, :], (1, F)).astype(jnp.float32)
+    # previous level's split tables (root has none)
+    zeros1 = jnp.zeros(1, jnp.float32)
+    tables = (zeros1, zeros1, zeros1, zeros1)
+
+    for d in range(D):
+        N = 2 ** d
+        base = N - 1
+        span = jnp.maximum(hi_d - lo_d, 0.0)
+        inv_d = jnp.where(span > 0,
+                          nb_f[None, :] / jnp.where(span > 0, span, 1.0), 0.0)
+        nid, hist = adaptive_level(X, nid, ghw, tables, lo_d, inv_d,
+                                   N // 2 if d else 0, N, base, W, method)
+        if axis_name is not None:
+            hist = jax.lax.psum(hist, axis_name)
+        trip = (hist[0], hist[1], hist[2])
+        level_mask = col_mask
+        if cfg.mtries > 0 and key is not None:
+            u = jax.random.uniform(jax.random.fold_in(key, d), (N, F))
+            u = jnp.where(col_mask[None, :], u, 2.0)
+            kth = jnp.sort(u, axis=1)[:, min(cfg.mtries, F) - 1]
+            level_mask = (u <= kth[:, None]) & col_mask[None, :]
+        bg, bf, bb, bnl, gt, ht, wt = _find_splits(trip, find_cfg, level_mask)
+        can = (bg > jnp.maximum(cfg.min_split_improvement, 0.0)) & (wt > 0)
+        nidx = jnp.arange(N)
+        lo_sel = lo_d[nidx, bf]
+        inv_sel = inv_d[nidx, bf]
+        # raw threshold: left ⇔ bin < t ⇔ x < lo + t/inv
+        thr = jnp.where(inv_sel > 0,
+                        lo_sel + bb.astype(jnp.float32) / jnp.maximum(inv_sel, 1e-30),
+                        jnp.inf)
+        idx = base + nidx
+        feat = feat.at[idx].set(jnp.where(can, bf, -1))
+        thr_arr = thr_arr.at[idx].set(thr)
+        na_left = na_left.at[idx].set(bnl)
+        is_split = is_split.at[idx].set(can)
+        value = value.at[idx].set(_leaf_value(gt, ht, cfg))
+        gain_arr = gain_arr.at[idx].set(jnp.where(can, bg, 0.0))
+        node_w = node_w.at[idx].set(wt)
+        # next level's routing tables
+        tables = (jnp.maximum(bf, 0).astype(jnp.float32), thr,
+                  bnl.astype(jnp.float32), can.astype(jnp.float32))
+        # next level's ranges: occupied-span narrowing + split-point cut
+        whist = hist[2][..., :W - 1]                  # [N, F, W-1] real bins
+        occ = whist > 0
+        first = jnp.argmax(occ, axis=-1)              # [N, F]
+        last = (W - 2) - jnp.argmax(occ[..., ::-1], axis=-1)
+        width = jnp.where(inv_d > 0, 1.0 / jnp.maximum(inv_d, 1e-30), 0.0)
+        lo_n = lo_d + first.astype(jnp.float32) * width
+        hi_n = jnp.minimum(lo_d + (last + 1).astype(jnp.float32) * width, hi_d)
+        any_occ = occ.any(axis=-1)
+        lo_n = jnp.where(any_occ, lo_n, lo_d)
+        hi_n = jnp.where(any_occ, hi_n, hi_d)
+        fsel = (jnp.arange(F)[None, :] == bf[:, None]) & can[:, None]
+        lo_left, hi_left = lo_n, jnp.where(fsel, jnp.minimum(thr[:, None], hi_n), hi_n)
+        lo_right, hi_right = jnp.where(fsel, jnp.maximum(thr[:, None], lo_n), lo_n), hi_n
+        lo_d = jnp.stack([lo_left, lo_right], axis=1).reshape(2 * N, F)
+        hi_d = jnp.stack([hi_left, hi_right], axis=1).reshape(2 * N, F)
+
+    # deepest level: route into the leaves and take exact f32 (g,h,w)
+    # totals (dedicated kernel — no bin histogram, no bf16 rounding)
+    ND = 2 ** D
+    baseD = ND - 1
+    nid, totD = leaf_totals(X, nid, ghw, tables, ND // 2, ND, baseD, method)
+    if axis_name is not None:
+        totD = jax.lax.psum(totD, axis_name)
+    gD, hD, wD = totD[0], totD[1], totD[2]
+    idxD = baseD + jnp.arange(ND)
+    value = value.at[idxD].set(_leaf_value(gD, hD, cfg))
+    node_w = node_w.at[idxD].set(wD)
+
+    tree = {"feat": feat, "thr": thr_arr, "na_left": na_left,
+            "is_split": is_split, "value": value, "gain": gain_arr,
+            "node_w": node_w}
+    return tree, nid
+
+
+def predict_raw_tree(X, tree, max_depth: int):
+    """Walk ONE tree (dict of [M] arrays with raw ``thr``) over raw
+    features; used for validation-margin updates inside the training
+    chunk. Returns (leaf values [rows], nid)."""
+    rows = X.shape[0]
+    nid = jnp.zeros(rows, jnp.int32)
+    for _ in range(max_depth):
+        f = jnp.maximum(tree["feat"], 0)[nid]
+        s = tree["is_split"][nid]
+        th = tree["thr"][nid]
+        nl = tree["na_left"][nid]
+        xv = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
+        go_right = jnp.where(jnp.isnan(xv), ~nl, xv >= th)
+        nid = jnp.where(s, 2 * nid + 1 + go_right.astype(jnp.int32), nid)
+    return tree["value"][nid], nid
+
+
 def grow_tree_spmd(codes, g, h, w, cfg: TreeConfig, col_mask,
                    data_axis: str = "data", model_axis: str = "model"):
     """Fully-sharded tree build for multi-chip meshes: rows over the

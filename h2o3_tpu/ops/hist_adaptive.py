@@ -1,0 +1,381 @@
+"""Fused route + bin + histogram level kernel with per-node ADAPTIVE
+uniform bins — the r3 flagship tree kernel.
+
+Reference semantics: hex/tree/DHistogram.java — H2O's default
+``histogram_type=UniformAdaptive`` re-bins every feature PER NODE over
+the node's value range with ``nbins`` uniform bins, refining resolution
+as the tree descends (DHistogram.java:48 ``_min/_maxEx`` per node;
+ScoreBuildHistogram2.java:121-301 builds (w, wY, wYY) per bin). This is
+unlike XGBoost's global 256-bin sketch: after d levels a feature's
+effective resolution is ~nbins·2^d.
+
+TPU re-design (one pallas kernel call per tree level):
+  1. ROUTE: each row steps through the previous level's split tables
+     (feat/thr/na_left/can per node). Table lookups are one-hot matmuls
+     at HIGHEST precision (no vector gathers on TPU); the split-feature
+     value is selected by compare-accumulate over the F lanes.
+  2. BIN:  b = isnan(x) ? W-1 : clip((x - lo[n,f]) * inv[n,f], 0, W-2)
+     with per-(node, feature) range tables — again via one-hot matmul.
+  3. HIST: acc[(k,n), (f,b)] += ghw[k,r] as a node-onehot × bin-onehot
+     MXU contraction, accumulated in VMEM across row tiles.
+
+The cross-shard reduction (MRTask reduce tree / Rabit ring analog,
+water/MRTask.java:871, hex/tree/xgboost/rabit/RabitTrackerH2O.java) is a
+single ``lax.psum`` of the returned histogram by the caller.
+
+Deviation from the reference, documented: child ranges are derived from
+the parent's split point (split feature — exact) and the parent's
+occupied-bin range (other features — within one bin width), instead of
+re-measuring exact per-child min/max; and routing compares raw
+``x >= thr`` so training-time routing is bit-identical to scoring-time
+tree walks.
+
+W (bin lanes per feature) is static per compile: 64 / 128 / 256 covering
+nbins ≤ 62 / 126 / 254; the last lane is the NA bin.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE = 2048
+
+
+def _kernel(x_ref, nid_ref, ghw_ref, feat_ref, thr_ref, nal_ref, can_ref,
+            lo_ref, inv_ref, nid_out, hist_out, acc_ref, *, n_prev: int,
+            n_nodes: int, F: int, W: int, tile: int, n_row_tiles: int,
+            level_base: int, mxu_dtype):
+    r = pl.program_id(0)
+
+    @pl.when(r == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[...]                                   # [tile, F] f32
+    nid = nid_ref[0, :]                              # [tile] i32 global ids
+    HI = jax.lax.Precision.HIGHEST
+
+    if n_prev > 0:
+        prev_base = level_base - n_prev
+        lid_p = nid - prev_base
+        onp = (jax.lax.broadcasted_iota(jnp.int32, (n_prev, tile), 0)
+               == lid_p[None, :]).astype(jnp.float32)
+
+        def lut(tbl_ref):
+            # HIGHEST precision: a bf16-rounded threshold flips routing
+            # for rows near the split boundary
+            t = tbl_ref[0, :n_prev].astype(jnp.float32)
+            return jax.lax.dot_general(
+                t[None, :], onp, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32, precision=HI)[0]
+
+        f_r = lut(feat_ref)
+        t_r = lut(thr_ref)
+        nl_r = lut(nal_ref)
+        cn_r = lut(can_ref)
+        # x[r, feat_r] via compare-accumulate (f_r is an exact int-valued
+        # float: one-hot matmul of ints < 2^24)
+        fi = jax.lax.broadcasted_iota(jnp.int32, (tile, F), 1)
+        xsel = jnp.sum(jnp.where(fi == f_r.astype(jnp.int32)[:, None],
+                                 x, 0.0), axis=1)
+        # float selects only: bool-branch select_n lowers to an i8→i1
+        # truncation Mosaic rejects
+        gr_f = jnp.where(jnp.isnan(xsel), 1.0 - nl_r,
+                         (xsel >= t_r).astype(jnp.float32))
+        in_prev = (lid_p >= 0) & (lid_p < n_prev)
+        child = 2 * nid + 1 + gr_f.astype(jnp.int32)
+        nid = jnp.where(in_prev & (cn_r > 0.5), child, nid)
+    nid_out[0, :] = nid
+
+    lid = nid - level_base
+    in_lvl = (lid >= 0) & (lid < n_nodes)
+    lidc = jnp.where(in_lvl, lid, 0)
+    onh = (jax.lax.broadcasted_iota(jnp.int32, (n_nodes, tile), 0)
+           == lidc[None, :])
+    onh_f = onh.astype(jnp.float32) * in_lvl.astype(jnp.float32)[None, :]
+    # per-row ranges [tile, F] = onhᵀ @ lo (exact f32 so bin boundaries
+    # match the split-side threshold arithmetic)
+    lo_r = jax.lax.dot_general(onh_f, lo_ref[...], (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32,
+                               precision=HI)
+    inv_r = jax.lax.dot_general(onh_f, inv_ref[...], (((0,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                                precision=HI)
+    bin_f = jnp.clip((x - lo_r) * inv_r, 0.0, float(W - 2))
+    bin_i = jnp.where(jnp.isnan(x), W - 1, bin_f.astype(jnp.int32))
+    b_all = jnp.concatenate(
+        [jnp.broadcast_to(bin_i[:, f:f + 1], (tile, W)) for f in range(F)],
+        axis=1)                                           # [tile, F*W]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (tile, F * W), 1)
+    oh = ((lane % W) == b_all).astype(mxu_dtype)
+    ghw = ghw_ref[...]
+    left = jnp.concatenate(
+        [onh_f.astype(mxu_dtype) * ghw[k, :][None, :].astype(mxu_dtype)
+         for k in range(3)], axis=0)                      # [3N, tile]
+    acc_ref[...] += jax.lax.dot_general(
+        left, oh, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=(HI if mxu_dtype == jnp.float32
+                   else jax.lax.Precision.DEFAULT))       # [3N, F*W]
+
+    @pl.when(r == n_row_tiles - 1)
+    def _flush():
+        hist_out[...] = acc_ref[...]
+
+
+def adaptive_level_tpu(x, nid, ghw, tables, lo, inv, n_prev: int,
+                       n_nodes: int, level_base: int, W: int,
+                       tile: int = TILE, interpret: bool = False,
+                       mxu_dtype=jnp.bfloat16):
+    """One tree level on one shard. x [rows, F] f32 (NaN=NA; rows % tile
+    == 0), nid [rows] i32, ghw [3, rows] f32, tables = (feat, thr,
+    na_left, can) each [max(n_prev,1)] f32, lo/inv [n_nodes, F] f32.
+    Returns (nid' [rows] i32, hist [3, n_nodes, F, W] f32 — caller psums
+    across shards)."""
+    rows, F = x.shape
+    assert rows % tile == 0, (rows, tile)
+    n_row_tiles = rows // tile
+    feat, thr, nal, can = tables
+    np1 = max(n_prev, 1)
+    kern = functools.partial(_kernel, n_prev=n_prev, n_nodes=n_nodes, F=F,
+                             W=W, tile=tile, n_row_tiles=n_row_tiles,
+                             level_base=level_base, mxu_dtype=mxu_dtype)
+    nid2, hist = pl.pallas_call(
+        kern,
+        grid=(n_row_tiles,),
+        in_specs=[
+            pl.BlockSpec((tile, F), lambda r: (r, 0)),
+            pl.BlockSpec((1, tile), lambda r: (0, r)),
+            pl.BlockSpec((3, tile), lambda r: (0, r)),
+            pl.BlockSpec((1, np1), lambda r: (0, 0)),
+            pl.BlockSpec((1, np1), lambda r: (0, 0)),
+            pl.BlockSpec((1, np1), lambda r: (0, 0)),
+            pl.BlockSpec((1, np1), lambda r: (0, 0)),
+            pl.BlockSpec((n_nodes, F), lambda r: (0, 0)),
+            pl.BlockSpec((n_nodes, F), lambda r: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, tile), lambda r: (0, r)),
+            pl.BlockSpec((3 * n_nodes, F * W), lambda r: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, rows), jnp.int32),
+            jax.ShapeDtypeStruct((3 * n_nodes, F * W), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((3 * n_nodes, F * W), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 3 * n_nodes * F * W * rows,
+            bytes_accessed=rows * F * 4 + rows * 16, transcendentals=0),
+        interpret=interpret,
+    )(x, nid[None, :], ghw, feat[None, :], thr[None, :], nal[None, :],
+      can[None, :], lo, inv)
+    return nid2[0], hist.reshape(3, n_nodes, F, W)
+
+
+def adaptive_level_xla(x, nid, ghw, tables, lo, inv, n_prev: int,
+                       n_nodes: int, level_base: int, W: int):
+    """Pure-XLA reference/CPU path with identical semantics (scatter-add
+    histogram). Used off-TPU and by parity tests."""
+    rows, F = x.shape
+    feat, thr, nal, can = tables
+    if n_prev > 0:
+        prev_base = level_base - n_prev
+        lid_p = jnp.clip(nid - prev_base, 0, n_prev - 1)
+        in_prev = (nid >= prev_base) & (nid < prev_base + n_prev)
+        f_r = feat[lid_p].astype(jnp.int32)
+        t_r = thr[lid_p]
+        nl_r = nal[lid_p]
+        cn_r = can[lid_p]
+        xsel = jnp.take_along_axis(x, f_r[:, None], axis=1)[:, 0]
+        go_right = jnp.where(jnp.isnan(xsel), nl_r < 0.5, xsel >= t_r)
+        child = 2 * nid + 1 + go_right.astype(jnp.int32)
+        nid = jnp.where(in_prev & (cn_r > 0.5), child, nid)
+    lid = nid - level_base
+    in_lvl = (lid >= 0) & (lid < n_nodes)
+    lidc = jnp.where(in_lvl, lid, 0)
+    lo_r = lo[lidc]                                   # [rows, F]
+    inv_r = inv[lidc]
+    bin_f = jnp.clip((x - lo_r) * inv_r, 0.0, float(W - 2))
+    bin_i = jnp.where(jnp.isnan(x), W - 1, bin_f.astype(jnp.int32))
+    flat = (lidc[:, None] * F + jnp.arange(F)[None, :]) * W + bin_i
+    vw = jnp.where(in_lvl, 1.0, 0.0)
+    out = jnp.zeros((n_nodes * F * W, 3), jnp.float32)
+    out = out.at[flat.reshape(-1), :].add(
+        (ghw.T * vw[:, None])[:, None, :].repeat(F, axis=1).reshape(-1, 3))
+    hist = out.reshape(n_nodes, F, W, 3)
+    return nid, jnp.moveaxis(hist, -1, 0)
+
+
+def adaptive_level(x, nid, ghw, tables, lo, inv, n_prev: int, n_nodes: int,
+                   level_base: int, W: int, method: str = "auto"):
+    """Dispatch: pallas on TPU (padding rows to the tile size), scatter-XLA
+    elsewhere."""
+    if method == "auto":
+        method = "pallas" if jax.default_backend() == "tpu" else "scatter"
+    if method == "pallas":
+        rows = x.shape[0]
+        pad = (-rows) % TILE
+        if pad:
+            # pad rows: NaN features (NA bin) with zero ghw mass — they
+            # route but contribute nothing
+            x = jnp.pad(x, ((0, pad), (0, 0)), constant_values=jnp.nan)
+            nid = jnp.pad(nid, (0, pad))
+            ghw = jnp.pad(ghw, ((0, 0), (0, pad)))
+        nid2, hist = adaptive_level_tpu(x, nid, ghw, tables, lo, inv, n_prev,
+                                        n_nodes, level_base, W)
+        return nid2[:rows], hist
+    return adaptive_level_xla(x, nid, ghw, tables, lo, inv, n_prev,
+                              n_nodes, level_base, W)
+
+
+def pick_W(nbins: int) -> int:
+    """Smallest supported lane width for nbins real bins (+1 NA lane)."""
+    for w in (64, 128, 256):
+        if nbins <= w - 2:
+            return w
+    raise ValueError(f"nbins {nbins} exceeds the adaptive kernel's 254-bin "
+                     f"cap; use histogram_type='quantiles_global'")
+
+
+def _totals_kernel(x_ref, nid_ref, ghw_ref, feat_ref, thr_ref, nal_ref,
+                   can_ref, nid_out, tot_out, acc_ref, *, n_prev: int,
+                   n_nodes: int, F: int, tile: int, n_row_tiles: int,
+                   level_base: int):
+    """Route one level then accumulate exact f32 (g,h,w) sums per node —
+    the deepest-level leaf statistics (no bin histogram, no bf16)."""
+    r = pl.program_id(0)
+
+    @pl.when(r == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[...]
+    nid = nid_ref[0, :]
+    HI = jax.lax.Precision.HIGHEST
+    if n_prev > 0:
+        prev_base = level_base - n_prev
+        lid_p = nid - prev_base
+        onp = (jax.lax.broadcasted_iota(jnp.int32, (n_prev, tile), 0)
+               == lid_p[None, :]).astype(jnp.float32)
+
+        def lut(tbl_ref):
+            t = tbl_ref[0, :n_prev].astype(jnp.float32)
+            return jax.lax.dot_general(
+                t[None, :], onp, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32, precision=HI)[0]
+
+        f_r = lut(feat_ref)
+        t_r = lut(thr_ref)
+        nl_r = lut(nal_ref)
+        cn_r = lut(can_ref)
+        fi = jax.lax.broadcasted_iota(jnp.int32, (tile, F), 1)
+        xsel = jnp.sum(jnp.where(fi == f_r.astype(jnp.int32)[:, None],
+                                 x, 0.0), axis=1)
+        gr_f = jnp.where(jnp.isnan(xsel), 1.0 - nl_r,
+                         (xsel >= t_r).astype(jnp.float32))
+        in_prev = (lid_p >= 0) & (lid_p < n_prev)
+        child = 2 * nid + 1 + gr_f.astype(jnp.int32)
+        nid = jnp.where(in_prev & (cn_r > 0.5), child, nid)
+    nid_out[0, :] = nid
+    lid = nid - level_base
+    in_lvl = (lid >= 0) & (lid < n_nodes)
+    lidc = jnp.where(in_lvl, lid, 0)
+    onh = (jax.lax.broadcasted_iota(jnp.int32, (n_nodes, tile), 0)
+           == lidc[None, :])
+    onh_f = onh.astype(jnp.float32) * in_lvl.astype(jnp.float32)[None, :]
+    ghw = ghw_ref[...]
+    left = jnp.concatenate([onh_f * ghw[k, :][None, :] for k in range(3)],
+                           axis=0)                       # [3N, tile] f32
+    # all 128 lanes carry the same sum (single-lane stores are awkward in
+    # Mosaic); the caller reads lane 0
+    acc_ref[...] += jnp.broadcast_to(
+        jnp.sum(left, axis=1, keepdims=True), acc_ref.shape)
+
+    @pl.when(r == n_row_tiles - 1)
+    def _flush():
+        tot_out[...] = acc_ref[...]
+
+
+def leaf_totals_tpu(x, nid, ghw, tables, n_prev: int, n_nodes: int,
+                    level_base: int, tile: int = TILE,
+                    interpret: bool = False):
+    """Final-level route + exact per-leaf (g,h,w) totals.
+    Returns (nid', totals [3, n_nodes])."""
+    rows, F = x.shape
+    assert rows % tile == 0
+    n_row_tiles = rows // tile
+    feat, thr, nal, can = tables
+    np1 = max(n_prev, 1)
+    kern = functools.partial(_totals_kernel, n_prev=n_prev, n_nodes=n_nodes,
+                             F=F, tile=tile, n_row_tiles=n_row_tiles,
+                             level_base=level_base)
+    nid2, tot = pl.pallas_call(
+        kern,
+        grid=(n_row_tiles,),
+        in_specs=[
+            pl.BlockSpec((tile, F), lambda r: (r, 0)),
+            pl.BlockSpec((1, tile), lambda r: (0, r)),
+            pl.BlockSpec((3, tile), lambda r: (0, r)),
+            pl.BlockSpec((1, np1), lambda r: (0, 0)),
+            pl.BlockSpec((1, np1), lambda r: (0, 0)),
+            pl.BlockSpec((1, np1), lambda r: (0, 0)),
+            pl.BlockSpec((1, np1), lambda r: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, tile), lambda r: (0, r)),
+            pl.BlockSpec((3 * n_nodes, 128), lambda r: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, rows), jnp.int32),
+            jax.ShapeDtypeStruct((3 * n_nodes, 128), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((3 * n_nodes, 128), jnp.float32)],
+        interpret=interpret,
+    )(x, nid[None, :], ghw, feat[None, :], thr[None, :], nal[None, :],
+      can[None, :])
+    return nid2[0], tot[:, 0].reshape(3, n_nodes)
+
+
+def leaf_totals_xla(x, nid, ghw, tables, n_prev: int, n_nodes: int,
+                    level_base: int):
+    rows, F = x.shape
+    feat, thr, nal, can = tables
+    if n_prev > 0:
+        prev_base = level_base - n_prev
+        lid_p = jnp.clip(nid - prev_base, 0, n_prev - 1)
+        in_prev = (nid >= prev_base) & (nid < prev_base + n_prev)
+        f_r = feat[lid_p].astype(jnp.int32)
+        xsel = jnp.take_along_axis(x, f_r[:, None], axis=1)[:, 0]
+        go_right = jnp.where(jnp.isnan(xsel), nal[lid_p] < 0.5,
+                             xsel >= thr[lid_p])
+        child = 2 * nid + 1 + go_right.astype(jnp.int32)
+        nid = jnp.where(in_prev & (can[lid_p] > 0.5), child, nid)
+    lid = nid - level_base
+    in_lvl = (lid >= 0) & (lid < n_nodes)
+    lidc = jnp.where(in_lvl, lid, 0)
+    vw = jnp.where(in_lvl, 1.0, 0.0)
+    tot = jnp.zeros((n_nodes, 3), jnp.float32).at[lidc].add(
+        (ghw * vw[None, :]).T)
+    return nid, tot.T
+
+
+def leaf_totals(x, nid, ghw, tables, n_prev: int, n_nodes: int,
+                level_base: int, method: str = "auto"):
+    if method == "auto":
+        method = "pallas" if jax.default_backend() == "tpu" else "scatter"
+    if method == "pallas":
+        rows = x.shape[0]
+        pad = (-rows) % TILE
+        if pad:
+            x = jnp.pad(x, ((0, pad), (0, 0)), constant_values=jnp.nan)
+            nid = jnp.pad(nid, (0, pad))
+            ghw = jnp.pad(ghw, ((0, 0), (0, pad)))
+        nid2, tot = leaf_totals_tpu(x, nid, ghw, tables, n_prev, n_nodes,
+                                    level_base)
+        return nid2[:rows], tot
+    return leaf_totals_xla(x, nid, ghw, tables, n_prev, n_nodes, level_base)

@@ -117,8 +117,10 @@ def test_gbm_na_handling_and_enum_features():
 def test_gbm_validation_and_early_stopping():
     fr = _make_regression(n=3000, seed=11)
     tr, va = fr.split_frame([0.7], seed=1)
+    # tolerance 5e-2: adaptive histograms keep finding ~1%/round of real
+    # validation improvement for hundreds of trees on this synthetic task
     gbm = H2OGradientBoostingEstimator(ntrees=200, max_depth=3, learn_rate=0.3,
-                                       stopping_rounds=2, stopping_tolerance=1e-3,
+                                       stopping_rounds=2, stopping_tolerance=5e-2,
                                        score_tree_interval=5, seed=3)
     gbm.train(y="y", training_frame=tr, validation_frame=va)
     assert gbm.model.ntrees_built < 200
