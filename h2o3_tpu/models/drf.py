@@ -30,7 +30,9 @@ from jax.sharding import PartitionSpec as P
 from h2o3_tpu.jobs import Job
 from h2o3_tpu.models.model_base import (Model, ModelBuilder, ScoreKeeper,
                                         TrainingSpec, compute_metrics)
-from h2o3_tpu.models.tree import (TreeConfig, bins_to_thresholds, grow_tree,
+from h2o3_tpu.models.tree import (TreeConfig, adaptive_feasible,
+                                  adaptive_setup,
+                                  bins_to_thresholds, grow_tree,
                                   grow_tree_adaptive, predict_raw_stacked)
 from h2o3_tpu.ops.binning import CodesView, bin_matrix, make_codes_view
 from h2o3_tpu.parallel.mesh import DATA_AXIS, current_mesh, n_data_shards
@@ -215,8 +217,9 @@ class H2ORandomForestEstimator(ModelBuilder):
                 f"reference's default 20 relies on dynamic node allocation)")
         nbins = int(p["nbins"])
         hist_type = (p.get("histogram_type") or "uniform_adaptive").lower()
-        adaptive = hist_type in ("uniform_adaptive", "uniform", "auto",
-                                 "round_robin") and nbins <= 254
+        adaptive = (hist_type in ("uniform_adaptive", "uniform", "auto",
+                                  "round_robin")
+                    and adaptive_feasible(spec, p, depth))
         mtries = int(p.get("mtries", -1) or -1)
         F = spec.n_features
         if mtries <= 0:
@@ -225,18 +228,8 @@ class H2ORandomForestEstimator(ModelBuilder):
                       else max(1, F // 3))
         if adaptive:
             bm = None
-            from h2o3_tpu.models.gbm import adaptive_nbins_eff
-            cfg = TreeConfig(max_depth=depth,
-                             n_bins=max(adaptive_nbins_eff(
-                                 spec, nbins, int(p["nbins_cats"])), 2),
-                             n_features=F, min_rows=float(p["min_rows"]),
-                             min_split_improvement=float(p["min_split_improvement"]),
-                             reg_lambda=float(p.get("reg_lambda", 0.0)),
-                             mtries=min(mtries, F),
-                             hist_method=p.get("hist_kernel", "auto"))
-            from h2o3_tpu.models.gbm import _adaptive_root_ranges
-            root_lo, root_hi, nb_f = _adaptive_root_ranges(
-                spec, nbins, int(p.get("nbins_cats", 1024)))
+            cfg, root_lo, root_hi, nb_f = adaptive_setup(
+                spec, p, depth, mtries=min(mtries, F))
         else:
             bm = bin_matrix(np.asarray(jax.device_get(spec.X)), spec.names,
                             spec.is_cat, spec.nrow, nbins=max(nbins, 2),

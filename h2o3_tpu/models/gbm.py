@@ -25,7 +25,9 @@ from h2o3_tpu.jobs import Job
 from h2o3_tpu.models.distributions import get_distribution
 from h2o3_tpu.models.model_base import (Model, ModelBuilder, ScoreKeeper,
                                         TrainingSpec, compute_metrics)
-from h2o3_tpu.models.tree import (TreeConfig, bins_to_thresholds, grow_tree,
+from h2o3_tpu.models.tree import (TreeConfig, adaptive_feasible,
+                                  adaptive_setup,
+                                  bins_to_thresholds, grow_tree,
                                   grow_tree_adaptive, predict_binned,
                                   predict_raw_stacked, predict_raw_tree)
 from h2o3_tpu.ops.binning import (CodesView, bin_matrix, digitize_with_edges,
@@ -49,32 +51,6 @@ GBM_DEFAULTS: Dict = dict(
     # scatter on CPU); see ops/histogram.py
     hist_kernel="auto",
 )
-
-
-def _adaptive_root_ranges(spec, nbins: int, nbins_cats: int):
-    """Root bin setup for the adaptive path: per-feature finite ranges
-    (±inf masked BEFORE the min/max so one infinite cell can't zero a
-    feature's range) and per-feature bin counts. Enums get nb = their code
-    span so identity binning reproduces exact per-level splits up to the
-    kernel's lane budget; beyond that, ordinal grouping refined by
-    narrowing (the nbins_cats analog, hex/tree/DHistogram nbins_cats)."""
-    Xf = jnp.where(jnp.isfinite(spec.X), spec.X, jnp.nan)
-    root_lo = jnp.nan_to_num(jnp.nanmin(Xf, axis=0), nan=0.0)
-    root_hi = jnp.nan_to_num(jnp.nanmax(Xf, axis=0), nan=0.0)
-    cat = jnp.asarray(np.asarray(spec.is_cat, dtype=bool))
-    span = jnp.maximum(root_hi - root_lo, 1.0)
-    nb_f = jnp.where(cat, jnp.minimum(span, float(nbins_cats)),
-                     float(nbins)).astype(jnp.float32)
-    return root_lo, root_hi, nb_f
-
-
-def adaptive_nbins_eff(spec, nbins: int, nbins_cats: int) -> int:
-    """Effective bin count sizing the kernel's lane width W: enums want
-    identity bins (card-1), capped by nbins_cats and the 254-lane max."""
-    cards = [len(spec.cat_domains.get(n, ())) for n, c in
-             zip(spec.names, spec.is_cat) if c]
-    max_card = max(cards, default=0)
-    return max(nbins, min(max(max_card - 1, 0), nbins_cats, 254))
 
 
 class GBMModel(Model):
@@ -307,20 +283,13 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         # uniform_adaptive (reference default) runs the fused per-node
         # adaptive kernel on raw features; the global-sketch path handles
         # quantiles_global and nbins beyond the adaptive kernel's 254 cap
-        adaptive = hist_type in ("uniform_adaptive", "uniform", "auto",
-                                 "round_robin") and nbins <= 254
+        adaptive = (hist_type in ("uniform_adaptive", "uniform", "auto",
+                                  "round_robin")
+                    and adaptive_feasible(spec, p, int(p["max_depth"])))
         if adaptive:
             bm = None
-            cfg = TreeConfig(max_depth=int(p["max_depth"]),
-                             n_bins=max(adaptive_nbins_eff(
-                                 spec, nbins, int(p["nbins_cats"])), 2),
-                             n_features=spec.n_features,
-                             min_rows=float(p["min_rows"]),
-                             min_split_improvement=float(p["min_split_improvement"]),
-                             reg_lambda=float(p.get("reg_lambda", 0.0)),
-                             hist_method=p.get("hist_kernel", "auto"))
-            root_lo, root_hi, nb_f = _adaptive_root_ranges(
-                spec, nbins, int(p.get("nbins_cats", 1024)))
+            cfg, root_lo, root_hi, nb_f = adaptive_setup(
+                spec, p, int(p["max_depth"]))
         else:
             bm = bin_matrix(np.asarray(jax.device_get(spec.X)), spec.names,
                             spec.is_cat, spec.nrow, nbins=max(nbins, 2),

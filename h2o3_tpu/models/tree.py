@@ -255,6 +255,57 @@ def grow_tree(codes, g, h, w, cfg: TreeConfig, col_mask, axis_name=None,
     return tree, nid
 
 
+def adaptive_feasible(spec, params, max_depth: int) -> bool:
+    """Whether the fused adaptive kernel's deepest level fits VMEM
+    (scratch + output block both hold [3·2^(D-1), F·W] f32; ~128MB/core
+    on v5e, gated conservatively at 96MB). Beyond this the global-sketch
+    path takes over (it tiles features and uses sibling subtraction)."""
+    from h2o3_tpu.ops.hist_adaptive import pick_W
+    nbins = int(params["nbins"])
+    if nbins > 254:
+        return False
+    cards = [len(spec.cat_domains.get(n, ())) for n, c in
+             zip(spec.names, spec.is_cat) if c]
+    max_card = max(cards, default=0)
+    n_bins_eff = max(nbins, min(max(max_card - 1, 0),
+                                int(params.get("nbins_cats", 1024)), 254), 2)
+    W = pick_W(n_bins_eff)
+    n_deep = 2 ** max(max_depth - 1, 0)
+    level_bytes = 2 * 3 * n_deep * spec.n_features * W * 4
+    return level_bytes <= 96 * 2 ** 20
+
+
+def adaptive_setup(spec, params, max_depth: int, mtries: int = 0):
+    """Shared GBM/DRF setup for the adaptive path: TreeConfig sized so
+    enums get identity bins (card-1 real bins, capped by nbins_cats and
+    the 254-lane max), per-feature finite root ranges (±inf masked BEFORE
+    the min/max so one infinite cell can't zero a feature's range) and
+    per-feature bin counts nb_f (the nbins_cats analog,
+    hex/tree/DHistogram nbins_cats)."""
+    p = params
+    nbins = int(p["nbins"])
+    nbins_cats = int(p.get("nbins_cats", 1024))
+    cards = [len(spec.cat_domains.get(n, ())) for n, c in
+             zip(spec.names, spec.is_cat) if c]
+    max_card = max(cards, default=0)
+    n_bins_eff = max(nbins, min(max(max_card - 1, 0), nbins_cats, 254), 2)
+    cfg = TreeConfig(max_depth=max_depth, n_bins=n_bins_eff,
+                     n_features=spec.n_features,
+                     min_rows=float(p["min_rows"]),
+                     min_split_improvement=float(p["min_split_improvement"]),
+                     reg_lambda=float(p.get("reg_lambda", 0.0)),
+                     mtries=mtries,
+                     hist_method=p.get("hist_kernel", "auto"))
+    Xf = jnp.where(jnp.isfinite(spec.X), spec.X, jnp.nan)
+    root_lo = jnp.nan_to_num(jnp.nanmin(Xf, axis=0), nan=0.0)
+    root_hi = jnp.nan_to_num(jnp.nanmax(Xf, axis=0), nan=0.0)
+    cat = jnp.asarray(np.asarray(spec.is_cat, dtype=bool))
+    span = jnp.maximum(root_hi - root_lo, 1.0)
+    nb_f = jnp.where(cat, jnp.minimum(span, float(nbins_cats)),
+                     float(nbins)).astype(jnp.float32)
+    return cfg, root_lo, root_hi, nb_f
+
+
 def grow_tree_adaptive(X, g, h, w, cfg: TreeConfig, col_mask, root_lo,
                        root_hi, axis_name=None, key=None, nb_f=None):
     """Build one tree with PER-NODE ADAPTIVE uniform bins on raw features
@@ -335,10 +386,12 @@ def grow_tree_adaptive(X, g, h, w, cfg: TreeConfig, col_mask, root_lo,
         nidx = jnp.arange(N)
         lo_sel = lo_d[nidx, bf]
         inv_sel = inv_d[nidx, bf]
-        # raw threshold: left ⇔ bin < t ⇔ x < lo + t/inv
-        thr = jnp.where(inv_sel > 0,
-                        lo_sel + bb.astype(jnp.float32) / jnp.maximum(inv_sel, 1e-30),
-                        jnp.inf)
+        # raw threshold: left ⇔ bin < t ⇔ x < lo + t/inv. Non-split nodes
+        # get 0.0, NOT inf: the kernel's one-hot LUT matmul would turn
+        # inf·0 into NaN and poison every row's threshold at that level
+        thr = jnp.where(can & (inv_sel > 0),
+                        lo_sel + bb.astype(jnp.float32)
+                        / jnp.maximum(inv_sel, 1e-30), 0.0)
         idx = base + nidx
         feat = feat.at[idx].set(jnp.where(can, bf, -1))
         thr_arr = thr_arr.at[idx].set(thr)

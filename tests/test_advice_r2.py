@@ -179,3 +179,46 @@ def test_weighted_median_laplace():
     w = jnp.asarray(np.array([1.0, 1.0, 5.0], np.float32))
     # cumulative weights 1,2,7; half-total 3.5 → the 10.0 element
     assert float(d.init_f0(y, w)) == 10.0
+
+
+def test_adaptive_thr_tables_finite_with_constant_feature():
+    """Unsplittable nodes must store finite thresholds: inf in the
+    routing tables becomes inf*0=NaN inside the kernel's one-hot LUT
+    matmul on TPU, silently misrouting every row at that level."""
+    rng = np.random.default_rng(21)
+    n = 1000
+    const = np.zeros(n, np.float32)          # constant -> zero span
+    x = rng.normal(size=n).astype(np.float32)
+    y = (rng.random(n) < 1 / (1 + np.exp(-2 * x))).astype(np.float32)
+    fr = h2o.Frame.from_numpy({"const": const, "x": x, "y": y})
+    gbm = H2OGradientBoostingEstimator(ntrees=5, max_depth=4,
+                                       distribution="bernoulli", seed=1)
+    gbm.train(y="y", training_frame=fr)
+    thr = np.asarray(gbm.model._thr)
+    assert np.isfinite(thr).all(), "non-finite thresholds in tree tables"
+    assert gbm.model.training_metrics.auc > 0.8
+
+
+def test_glm_lambda_search_selects_by_validation():
+    """With a validation frame, lambda_search must pick the submodel by
+    validation deviance (training deviance always favors the smallest
+    lambda on the warm-started path)."""
+    from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+    rng = np.random.default_rng(23)
+    n, F = 120, 40                           # overfit-prone: wide + noisy
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    y = (X[:, 0] + 2.0 * rng.normal(size=n)).astype(np.float32)
+    Xv = rng.normal(size=(4 * n, F)).astype(np.float32)
+    yv = (Xv[:, 0] + 2.0 * rng.normal(size=4 * n)).astype(np.float32)
+    tr = h2o.Frame.from_numpy({**{f"x{i}": X[:, i] for i in range(F)}, "y": y})
+    va = h2o.Frame.from_numpy({**{f"x{i}": Xv[:, i] for i in range(F)},
+                               "y": yv})
+    glm = H2OGeneralizedLinearEstimator(family="gaussian", alpha=1.0,
+                                        lambda_search=True, nlambdas=20)
+    glm.train(y="y", training_frame=tr, validation_frame=va)
+    path = glm.model.output["lambda_path"]
+    assert all("validation_deviance" in s for s in path)
+    lams = [s["lambda"] for s in path]
+    # chosen lambda should NOT be the smallest (which overfits here)
+    assert glm.model.lambda_best > min(lams), (glm.model.lambda_best,
+                                               min(lams))
