@@ -166,7 +166,9 @@ def _cd_elastic_net(G, b, beta0, lam_l1, lam_l2, pen_mask, n_sweeps: int,
         bj = jnp.sign(rho) * jnp.maximum(jnp.abs(rho) - l1, 0.0)
         bj = bj / (diag[j] + lam_l2 * pen_mask[j] + 1e-12)
         if non_negative:
-            bj = jnp.maximum(bj, 0.0)
+            # bound applies to feature coefficients only, not the
+            # intercept (pen_mask 0)
+            bj = jnp.where(pen_mask[j] > 0, jnp.maximum(bj, 0.0), bj)
         delta = bj - beta[j]
         Gb = Gb + G[:, j] * delta
         beta = beta.at[j].set(bj)
@@ -220,6 +222,26 @@ def expand_design(spec: TrainingSpec, impute_means=None):
     return Xe, names, means
 
 
+def expand_scoring_matrix(model, X):
+    """Expand a raw adapt_test_matrix output with a model's training-time
+    design (enum indicator blocks + mean imputation). Shared by GLM and
+    DeepLearning (any model carrying feature_names/feature_is_cat/
+    cat_domains/impute_means)."""
+    cols = []
+    for i, (n, is_cat) in enumerate(zip(model.feature_names,
+                                        model.feature_is_cat)):
+        x = X[:, i]
+        if is_cat:
+            card = len(model.cat_domains.get(n, ()))
+            codes = jnp.where(jnp.isnan(x), -1, x).astype(jnp.int32)
+            for lvl in range(1, card):
+                cols.append((codes == lvl).astype(jnp.float32))
+        else:
+            m = model.impute_means.get(n, 0.0)
+            cols.append(jnp.where(jnp.isnan(x), m, x))
+    return jnp.stack(cols, axis=1) if cols else jnp.zeros((X.shape[0], 0))
+
+
 # ---------------- model -------------------------------------------------
 
 class GLMModel(Model):
@@ -245,26 +267,8 @@ class GLMModel(Model):
         d.update({n: float(b) for n, b in zip(self.exp_names, self.beta)})
         return d
 
-    def _expand_matrix(self, X):
-        """Expand a raw adapt_test_matrix output with the training
-        expansion (enum indicator blocks + mean imputation)."""
-        cols = []
-        j = 0
-        for i, (n, is_cat) in enumerate(zip(self.feature_names,
-                                            self.feature_is_cat)):
-            x = X[:, i]
-            if is_cat:
-                card = len(self.cat_domains.get(n, ()))
-                codes = jnp.where(jnp.isnan(x), -1, x).astype(jnp.int32)
-                for lvl in range(1, card):
-                    cols.append((codes == lvl).astype(jnp.float32))
-            else:
-                m = self.impute_means.get(n, 0.0)
-                cols.append(jnp.where(jnp.isnan(x), m, x))
-        return jnp.stack(cols, axis=1) if cols else jnp.zeros((X.shape[0], 0))
-
     def _predict_matrix(self, X, offset=None):
-        Xe = self._expand_matrix(X)
+        Xe = expand_scoring_matrix(self, X)
         eta = Xe @ jnp.asarray(self.beta) + self.intercept_value
         if offset is not None:
             eta = eta + offset
@@ -426,7 +430,7 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
                 else:
                     nb = _cholesky_solve(G, b, lam2, pen_mask)
                     if non_neg:
-                        nb = jnp.maximum(nb, 0.0)
+                        nb = jnp.where(pen_mask > 0, jnp.maximum(nb, 0.0), nb)
                 return nb
             return irls_step
 
@@ -495,7 +499,8 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
             beta_raw = beta_s[:Fe]
             icpt = (float(jax.device_get(beta_s[Fe])) if fit_intercept
                     else 0.0)
-        rank = int(jax.device_get((jnp.abs(beta_s[:Fe]) > 1e-10).sum())) + 1
+        rank = (int(jax.device_get((jnp.abs(beta_s[:Fe]) > 1e-10).sum()))
+                + (1 if fit_intercept else 0))
 
         model = GLMModel(f"glm_{id(self) & 0xffffff:x}", self.params, spec,
                          family, np.asarray(jax.device_get(beta_raw)), icpt,

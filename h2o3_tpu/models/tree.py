@@ -255,21 +255,26 @@ def grow_tree(codes, g, h, w, cfg: TreeConfig, col_mask, axis_name=None,
     return tree, nid
 
 
+def _adaptive_n_bins_eff(spec, params) -> int:
+    """Effective bin count sizing the kernel's lane width W: enums want
+    identity bins (card-1), capped by nbins_cats and the 254-lane max."""
+    nbins = int(params["nbins"])
+    cards = [len(spec.cat_domains.get(n, ())) for n, c in
+             zip(spec.names, spec.is_cat) if c]
+    max_card = max(cards, default=0)
+    return max(nbins, min(max(max_card - 1, 0),
+                          int(params.get("nbins_cats", 1024)), 254), 2)
+
+
 def adaptive_feasible(spec, params, max_depth: int) -> bool:
     """Whether the fused adaptive kernel's deepest level fits VMEM
     (scratch + output block both hold [3·2^(D-1), F·W] f32; ~128MB/core
     on v5e, gated conservatively at 96MB). Beyond this the global-sketch
     path takes over (it tiles features and uses sibling subtraction)."""
     from h2o3_tpu.ops.hist_adaptive import pick_W
-    nbins = int(params["nbins"])
-    if nbins > 254:
+    if int(params["nbins"]) > 254:
         return False
-    cards = [len(spec.cat_domains.get(n, ())) for n, c in
-             zip(spec.names, spec.is_cat) if c]
-    max_card = max(cards, default=0)
-    n_bins_eff = max(nbins, min(max(max_card - 1, 0),
-                                int(params.get("nbins_cats", 1024)), 254), 2)
-    W = pick_W(n_bins_eff)
+    W = pick_W(_adaptive_n_bins_eff(spec, params))
     n_deep = 2 ** max(max_depth - 1, 0)
     level_bytes = 2 * 3 * n_deep * spec.n_features * W * 4
     return level_bytes <= 96 * 2 ** 20
@@ -285,11 +290,8 @@ def adaptive_setup(spec, params, max_depth: int, mtries: int = 0):
     p = params
     nbins = int(p["nbins"])
     nbins_cats = int(p.get("nbins_cats", 1024))
-    cards = [len(spec.cat_domains.get(n, ())) for n, c in
-             zip(spec.names, spec.is_cat) if c]
-    max_card = max(cards, default=0)
-    n_bins_eff = max(nbins, min(max(max_card - 1, 0), nbins_cats, 254), 2)
-    cfg = TreeConfig(max_depth=max_depth, n_bins=n_bins_eff,
+    cfg = TreeConfig(max_depth=max_depth,
+                     n_bins=_adaptive_n_bins_eff(spec, p),
                      n_features=spec.n_features,
                      min_rows=float(p["min_rows"]),
                      min_split_improvement=float(p["min_split_improvement"]),
@@ -386,12 +388,17 @@ def grow_tree_adaptive(X, g, h, w, cfg: TreeConfig, col_mask, root_lo,
         nidx = jnp.arange(N)
         lo_sel = lo_d[nidx, bf]
         inv_sel = inv_d[nidx, bf]
-        # raw threshold: left ⇔ bin < t ⇔ x < lo + t/inv. Non-split nodes
-        # get 0.0, NOT inf: the kernel's one-hot LUT matmul would turn
-        # inf·0 into NaN and poison every row's threshold at that level
-        thr = jnp.where(can & (inv_sel > 0),
-                        lo_sel + bb.astype(jnp.float32)
-                        / jnp.maximum(inv_sel, 1e-30), 0.0)
+        # raw threshold: left ⇔ bin < t ⇔ x < lo + t/inv. Never store inf
+        # (the kernel's one-hot LUT matmul turns inf·0 into NaN and
+        # poisons every row's threshold at that level): a zero-span split
+        # (NA-vs-finite on a constant feature) uses a huge FINITE value so
+        # all finite rows still route left; non-split nodes get 0.0.
+        BIG = jnp.float32(3.0e38)
+        thr = jnp.where(can,
+                        jnp.where(inv_sel > 0,
+                                  lo_sel + bb.astype(jnp.float32)
+                                  / jnp.maximum(inv_sel, 1e-30), BIG),
+                        0.0)
         idx = base + nidx
         feat = feat.at[idx].set(jnp.where(can, bf, -1))
         thr_arr = thr_arr.at[idx].set(thr)

@@ -1,0 +1,126 @@
+"""DeepLearning MLP tests — classification/regression quality, dropout,
+optimizer variants, save/load (reference: hex/deeplearning test style)."""
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
+
+
+def _blobs(n=3000, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = np.array([[0, 0], [4, 4], [-4, 4]])
+    y = rng.integers(0, 3, n)
+    X = centers[y] + rng.normal(size=(n, 2))
+    labels = np.array(["a", "b", "c"], dtype=object)[y]
+    return h2o.Frame.from_numpy({"x1": X[:, 0], "x2": X[:, 1],
+                                 "y": labels}), y
+
+
+def test_dl_multinomial_blobs():
+    fr, y = _blobs()
+    dl = H2ODeepLearningEstimator(hidden=[32, 32], epochs=20, seed=1,
+                                  mini_batch_size=128)
+    dl.train(y="y", training_frame=fr)
+    m = dl.model.training_metrics
+    assert m.error < 0.05, m.to_dict()
+    pf = dl.model.predict(fr)
+    assert pf.names == ["predict", "pa", "pb", "pc"]
+    probs = np.stack([pf.vec(c).to_numpy() for c in ("pa", "pb", "pc")], 1)
+    np.testing.assert_allclose(probs.sum(1), 1.0, atol=1e-5)
+
+
+def test_dl_nonlinear_regression_beats_linear():
+    rng = np.random.default_rng(3)
+    n = 4000
+    x1 = rng.uniform(-2, 2, n).astype(np.float32)
+    x2 = rng.uniform(-2, 2, n).astype(np.float32)
+    y = (np.sin(2 * x1) * 2 + x2 ** 2 + 0.05 * rng.normal(size=n)).astype(np.float32)
+    fr = h2o.Frame.from_numpy({"x1": x1, "x2": x2, "y": y})
+    dl = H2ODeepLearningEstimator(hidden=[64, 64], epochs=40, seed=1,
+                                  mini_batch_size=256)
+    dl.train(y="y", training_frame=fr)
+    r2 = dl.model.training_metrics.r2
+    assert r2 > 0.95, r2   # a linear fit tops out ~0.55 here
+
+
+def test_dl_binomial_auc_and_validation():
+    rng = np.random.default_rng(5)
+    n = 4000
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    logit = 2 * X[:, 0] - X[:, 1] + X[:, 2] * X[:, 3]
+    yv = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(int)
+    cols = {f"x{i}": X[:, i] for i in range(4)}
+    cols["y"] = np.array(["n", "p"], dtype=object)[yv]
+    fr = h2o.Frame.from_numpy(cols)
+    tr, va = fr.split_frame([0.8], seed=1)
+    dl = H2ODeepLearningEstimator(hidden=[32, 32], epochs=25, seed=2,
+                                  mini_batch_size=128)
+    dl.train(y="y", training_frame=tr, validation_frame=va)
+    assert dl.model.training_metrics.auc > 0.85
+    assert dl.model.validation_metrics.auc > 0.8
+
+
+def test_dl_momentum_sgd_path():
+    """adaptive_rate=False exercises the momentum/annealing optimizer."""
+    fr, y = _blobs(n=1500, seed=7)
+    dl = H2ODeepLearningEstimator(hidden=[32], epochs=30, seed=1,
+                                  adaptive_rate=False, rate=0.05,
+                                  momentum_start=0.5, momentum_stable=0.9,
+                                  momentum_ramp=1e4, mini_batch_size=128)
+    dl.train(y="y", training_frame=fr)
+    assert dl.model.training_metrics.error < 0.05
+
+
+def test_dl_dropout_trains():
+    fr, y = _blobs(n=1500, seed=9)
+    dl = H2ODeepLearningEstimator(hidden=[64], epochs=25, seed=1,
+                                  activation="rectifier_with_dropout",
+                                  input_dropout_ratio=0.1,
+                                  hidden_dropout_ratios=[0.3],
+                                  mini_batch_size=128)
+    dl.train(y="y", training_frame=fr)
+    assert dl.model.training_metrics.error < 0.1
+
+
+def test_dl_enum_features_and_save_load(tmp_path):
+    rng = np.random.default_rng(11)
+    n = 2000
+    lv = np.array(["u", "v", "w"])
+    cat = rng.integers(0, 3, n)
+    x = rng.normal(size=n).astype(np.float32)
+    x[rng.random(n) < 0.1] = np.nan           # mean-imputed
+    y = (np.nan_to_num(x) + np.array([0.0, 2.0, -2.0])[cat]
+         + 0.1 * rng.normal(size=n)).astype(np.float32)
+    fr = h2o.Frame.from_numpy({"c": lv[cat], "x": x, "y": y})
+    dl = H2ODeepLearningEstimator(hidden=[32, 32], epochs=30, seed=1,
+                                  mini_batch_size=128)
+    dl.train(y="y", training_frame=fr)
+    assert dl.model.training_metrics.r2 > 0.9
+    pred = dl.model.predict(fr).vec("predict").to_numpy()
+    p = h2o.save_model(dl.model, str(tmp_path), filename="dl")
+    m2 = h2o.load_model(p)
+    pred2 = m2.predict(fr).vec("predict").to_numpy()
+    np.testing.assert_allclose(pred, pred2, rtol=1e-6)
+
+
+def test_dl_early_stopping():
+    fr, y = _blobs(n=2000, seed=13)
+    dl = H2ODeepLearningEstimator(hidden=[32], epochs=100, seed=1,
+                                  stopping_rounds=2, stopping_tolerance=0.05,
+                                  mini_batch_size=128)
+    dl.train(y="y", training_frame=fr)
+    assert dl.model.output["epochs_trained"] < 100
+
+
+def test_dl_small_frame_smaller_than_batch():
+    """Frames smaller than mini_batch_size must train (batch clamps)."""
+    rng = np.random.default_rng(17)
+    n = 100
+    x = rng.normal(size=n).astype(np.float32)
+    y = (2 * x + 0.1 * rng.normal(size=n)).astype(np.float32)
+    fr = h2o.Frame.from_numpy({"x": x, "y": y})
+    dl = H2ODeepLearningEstimator(hidden=[8], epochs=120, seed=1,
+                                  mini_batch_size=256)
+    dl.train(y="y", training_frame=fr)
+    assert dl.model.training_metrics.r2 > 0.8

@@ -163,3 +163,18 @@ def test_glm_weights_respected():
                                         Lambda=0.0, weights_column="w")
     glm.train(y="y", training_frame=fr)
     assert abs(glm.model.coef()["x"] - 2.0) < 0.02
+
+
+def test_glm_non_negative_leaves_intercept_free():
+    rng = np.random.default_rng(19)
+    n = 1000
+    x = rng.normal(size=n).astype(np.float32)
+    y = (1.5 * x - 3.0 + 0.05 * rng.normal(size=n)).astype(np.float32)
+    fr = h2o.Frame.from_numpy({"x": x, "y": y})
+    glm = H2OGeneralizedLinearEstimator(family="gaussian", alpha=0.0,
+                                        Lambda=0.0, non_negative=True,
+                                        standardize=False)
+    glm.train(y="y", training_frame=fr)
+    coef = glm.model.coef()
+    assert coef["x"] >= 0.0
+    assert abs(coef["Intercept"] + 3.0) < 0.02, coef  # negative, unclamped
