@@ -30,7 +30,10 @@ import numpy as np
 ROWS = int(os.environ.get("H2O3_BENCH_ROWS", 10_000_000))
 TREES = int(os.environ.get("H2O3_BENCH_TREES", 20))
 DEPTH = int(os.environ.get("H2O3_BENCH_DEPTH", 6))
-NBINS = int(os.environ.get("H2O3_BENCH_NBINS", 62))
+# 30 adaptive bins (W=32 lanes): above the reference's default nbins=20,
+# AUC-equal to 62-bin adaptive and 254-bin global on this task
+# (0.8358 / 0.8360 / 0.8366)
+NBINS = int(os.environ.get("H2O3_BENCH_NBINS", 30))
 A100_GPU_HIST_ROWS_PER_SEC = 25e6
 
 

@@ -90,7 +90,10 @@ def _binary_curve_kernel(score, y, w):
     # for each boundary, find the previous boundary via a prefix-max scan
     idx = jnp.arange(s.shape[0])
     idxf = jnp.where(is_boundary, idx, -1)
-    prevb = jax.lax.associative_scan(jnp.maximum, idxf)           # last boundary ≤ i
+    # prefix max via the cummax primitive: associative_scan traces an
+    # unrolled log-depth slice tree whose XLA compile takes minutes at
+    # 10M elements (the r3 "hung bench" root cause)
+    prevb = jax.lax.cummax(idxf)                                  # last boundary ≤ i
     prevb = jnp.concatenate([jnp.array([-1]), prevb[:-1]])        # last boundary < i
     has_prev = prevb >= 0
     tp_prev = jnp.where(has_prev, tp[prevb], 0.0)

@@ -11,13 +11,17 @@ effective resolution is ~nbins·2^d.
 
 TPU re-design (one pallas kernel call per tree level):
   1. ROUTE: each row steps through the previous level's split tables
-     (feat/thr/na_left/can per node). Table lookups are one-hot matmuls
-     at HIGHEST precision (no vector gathers on TPU); the split-feature
-     value is selected by compare-accumulate over the F lanes.
-  2. BIN:  b = isnan(x) ? W-1 : clip((x - lo[n,f]) * inv[n,f], 0, W-2)
-     with per-(node, feature) range tables — again via one-hot matmul.
-  3. HIST: acc[(k,n), (f,b)] += ghw[k,r] as a node-onehot × bin-onehot
-     MXU contraction, accumulated in VMEM across row tiles.
+     ([4, n_prev] = feat/thr/na_left/can). The lookup is ONE merged
+     one-hot matmul at HIGHEST precision (no vector gathers on TPU); the
+     split-feature value is selected by compare-accumulate over F lanes.
+  2. BIN:  b = isnan(x) ? W-1 : floor(clip((x - lo[n,f]) * inv[n,f]))
+     with per-(node, feature) range tables — one merged [N, 2F] lookup
+     matmul.
+  3. HIST: the bin one-hot is produced by a SELECTOR matmul
+     (b_all[r, j] = bin of feature j//W — an F-way lane-offset
+     concatenate costs ~20% of the level at F=28), then contracted
+     against node-onehot × (g,h,w) on the MXU, accumulating in VMEM
+     across row tiles.
 
 The cross-shard reduction (MRTask reduce tree / Rabit ring analog,
 water/MRTask.java:871, hex/tree/xgboost/rabit/RabitTrackerH2O.java) is a
@@ -30,8 +34,8 @@ re-measuring exact per-child min/max; and routing compares raw
 ``x >= thr`` so training-time routing is bit-identical to scoring-time
 tree walks.
 
-W (bin lanes per feature) is static per compile: 64 / 128 / 256 covering
-nbins ≤ 62 / 126 / 254; the last lane is the NA bin.
+W (bin lanes per feature) is static per compile: 32 / 64 / 128 / 256
+covering nbins <= 30 / 62 / 126 / 254; the last lane is the NA bin.
 """
 from __future__ import annotations
 
@@ -43,13 +47,44 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-TILE = 2048
+TILE = 4096
+# default scoped-vmem stack limit is 16MB; the accumulator + one-hot want
+# more at deeper levels / larger tiles (v5e has 128MB VMEM)
+_VMEM_LIMIT = 100 * 1024 * 1024
 
 
-def _kernel(x_ref, nid_ref, ghw_ref, feat_ref, thr_ref, nal_ref, can_ref,
-            lo_ref, inv_ref, nid_out, hist_out, acc_ref, *, n_prev: int,
-            n_nodes: int, F: int, W: int, tile: int, n_row_tiles: int,
-            level_base: int, mxu_dtype):
+def _route(x, nid, tabs_ref, n_prev, level_base, tile, F):
+    """Shared routing block: step rows through the previous level's split
+    tables ([4, np] = feat/thr/na_left/can) with ONE merged HIGHEST-
+    precision LUT matmul (a bf16-rounded threshold flips routing for rows
+    near the split boundary)."""
+    HI = jax.lax.Precision.HIGHEST
+    prev_base = level_base - n_prev
+    lid_p = nid - prev_base
+    onp = (jax.lax.broadcasted_iota(jnp.int32, (n_prev, tile), 0)
+           == lid_p[None, :]).astype(jnp.float32)
+    t4 = tabs_ref[:, :n_prev]                         # [4, n_prev]
+    lut = jax.lax.dot_general(t4, onp, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32,
+                              precision=HI)           # [4, tile]
+    f_r, t_r, nl_r, cn_r = lut[0], lut[1], lut[2], lut[3]
+    # x[r, feat_r] via compare-accumulate (f_r is an exact int-valued
+    # float: one-hot matmul of ints < 2^24)
+    fi = jax.lax.broadcasted_iota(jnp.int32, (tile, F), 1)
+    xsel = jnp.sum(jnp.where(fi == f_r.astype(jnp.int32)[:, None],
+                             x, 0.0), axis=1)
+    # float selects only: bool-branch select_n lowers to an i8->i1
+    # truncation Mosaic rejects
+    gr_f = jnp.where(jnp.isnan(xsel), 1.0 - nl_r,
+                     (xsel >= t_r).astype(jnp.float32))
+    in_prev = (lid_p >= 0) & (lid_p < n_prev)
+    child = 2 * nid + 1 + gr_f.astype(jnp.int32)
+    return jnp.where(in_prev & (cn_r > 0.5), child, nid)
+
+
+def _kernel(x_ref, nid_ref, ghw_ref, tabs_ref, loinv_ref, nid_out, hist_out,
+            acc_ref, *, n_prev: int, n_nodes: int, F: int, W: int, tile: int,
+            n_row_tiles: int, level_base: int, mxu_dtype):
     r = pl.program_id(0)
 
     @pl.when(r == 0)
@@ -59,37 +94,8 @@ def _kernel(x_ref, nid_ref, ghw_ref, feat_ref, thr_ref, nal_ref, can_ref,
     x = x_ref[...]                                   # [tile, F] f32
     nid = nid_ref[0, :]                              # [tile] i32 global ids
     HI = jax.lax.Precision.HIGHEST
-
     if n_prev > 0:
-        prev_base = level_base - n_prev
-        lid_p = nid - prev_base
-        onp = (jax.lax.broadcasted_iota(jnp.int32, (n_prev, tile), 0)
-               == lid_p[None, :]).astype(jnp.float32)
-
-        def lut(tbl_ref):
-            # HIGHEST precision: a bf16-rounded threshold flips routing
-            # for rows near the split boundary
-            t = tbl_ref[0, :n_prev].astype(jnp.float32)
-            return jax.lax.dot_general(
-                t[None, :], onp, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32, precision=HI)[0]
-
-        f_r = lut(feat_ref)
-        t_r = lut(thr_ref)
-        nl_r = lut(nal_ref)
-        cn_r = lut(can_ref)
-        # x[r, feat_r] via compare-accumulate (f_r is an exact int-valued
-        # float: one-hot matmul of ints < 2^24)
-        fi = jax.lax.broadcasted_iota(jnp.int32, (tile, F), 1)
-        xsel = jnp.sum(jnp.where(fi == f_r.astype(jnp.int32)[:, None],
-                                 x, 0.0), axis=1)
-        # float selects only: bool-branch select_n lowers to an i8→i1
-        # truncation Mosaic rejects
-        gr_f = jnp.where(jnp.isnan(xsel), 1.0 - nl_r,
-                         (xsel >= t_r).astype(jnp.float32))
-        in_prev = (lid_p >= 0) & (lid_p < n_prev)
-        child = 2 * nid + 1 + gr_f.astype(jnp.int32)
-        nid = jnp.where(in_prev & (cn_r > 0.5), child, nid)
+        nid = _route(x, nid, tabs_ref, n_prev, level_base, tile, F)
     nid_out[0, :] = nid
 
     lid = nid - level_base
@@ -98,21 +104,26 @@ def _kernel(x_ref, nid_ref, ghw_ref, feat_ref, thr_ref, nal_ref, can_ref,
     onh = (jax.lax.broadcasted_iota(jnp.int32, (n_nodes, tile), 0)
            == lidc[None, :])
     onh_f = onh.astype(jnp.float32) * in_lvl.astype(jnp.float32)[None, :]
-    # per-row ranges [tile, F] = onhᵀ @ lo (exact f32 so bin boundaries
-    # match the split-side threshold arithmetic)
-    lo_r = jax.lax.dot_general(onh_f, lo_ref[...], (((0,), (0,)), ((), ())),
-                               preferred_element_type=jnp.float32,
-                               precision=HI)
-    inv_r = jax.lax.dot_general(onh_f, inv_ref[...], (((0,), (0,)), ((), ())),
-                                preferred_element_type=jnp.float32,
-                                precision=HI)
-    bin_f = jnp.clip((x - lo_r) * inv_r, 0.0, float(W - 2))
-    bin_i = jnp.where(jnp.isnan(x), W - 1, bin_f.astype(jnp.int32))
-    b_all = jnp.concatenate(
-        [jnp.broadcast_to(bin_i[:, f:f + 1], (tile, W)) for f in range(F)],
-        axis=1)                                           # [tile, F*W]
+    # per-row ranges in ONE merged [N, 2F] lookup matmul (exact f32: bin
+    # boundaries must match the split-side threshold arithmetic, and a
+    # bf16-rounded lo breaks deep narrowed ranges where |lo| >> span)
+    loinv_r = jax.lax.dot_general(onh_f, loinv_ref[...],
+                                  (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32,
+                                  precision=HI)       # [tile, 2F]
+    lo_r = loinv_r[:, :F]
+    inv_r = loinv_r[:, F:]
+    bin_f = jnp.floor(jnp.clip((x - lo_r) * inv_r, 0.0, float(W - 2)))
+    bin_v = jnp.where(jnp.isnan(x), float(W - 1), bin_f)   # [tile, F] f32
+    # bin one-hot via a selector matmul: b_all[r, j] = bin of feature j//W
+    # (an F-way lane-offset concatenate costs ~20% of the level at F=28)
+    sel = (jax.lax.broadcasted_iota(jnp.int32, (F, F * W), 1) // W
+           == jax.lax.broadcasted_iota(jnp.int32, (F, F * W), 0)
+           ).astype(jnp.float32)
+    b_all = jax.lax.dot_general(bin_v, sel, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
     lane = jax.lax.broadcasted_iota(jnp.int32, (tile, F * W), 1)
-    oh = ((lane % W) == b_all).astype(mxu_dtype)
+    oh = ((lane % W) == b_all.astype(jnp.int32)).astype(mxu_dtype)
     ghw = ghw_ref[...]
     left = jnp.concatenate(
         [onh_f.astype(mxu_dtype) * ghw[k, :][None, :].astype(mxu_dtype)
@@ -121,11 +132,16 @@ def _kernel(x_ref, nid_ref, ghw_ref, feat_ref, thr_ref, nal_ref, can_ref,
         left, oh, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
         precision=(HI if mxu_dtype == jnp.float32
-                   else jax.lax.Precision.DEFAULT))       # [3N, F*W]
+                   else jax.lax.Precision.DEFAULT))       # [3N, FW]
 
     @pl.when(r == n_row_tiles - 1)
     def _flush():
         hist_out[...] = acc_ref[...]
+
+
+def _pack_tables(tables):
+    feat, thr, nal, can = tables
+    return jnp.stack([feat, thr, nal, can], axis=0)       # [4, np1]
 
 
 def adaptive_level_tpu(x, nid, ghw, tables, lo, inv, n_prev: int,
@@ -140,8 +156,9 @@ def adaptive_level_tpu(x, nid, ghw, tables, lo, inv, n_prev: int,
     rows, F = x.shape
     assert rows % tile == 0, (rows, tile)
     n_row_tiles = rows // tile
-    feat, thr, nal, can = tables
-    np1 = max(n_prev, 1)
+    tabs = _pack_tables(tables)
+    np1 = tabs.shape[1]
+    loinv = jnp.concatenate([lo, inv], axis=1)            # [N, 2F]
     kern = functools.partial(_kernel, n_prev=n_prev, n_nodes=n_nodes, F=F,
                              W=W, tile=tile, n_row_tiles=n_row_tiles,
                              level_base=level_base, mxu_dtype=mxu_dtype)
@@ -152,12 +169,8 @@ def adaptive_level_tpu(x, nid, ghw, tables, lo, inv, n_prev: int,
             pl.BlockSpec((tile, F), lambda r: (r, 0)),
             pl.BlockSpec((1, tile), lambda r: (0, r)),
             pl.BlockSpec((3, tile), lambda r: (0, r)),
-            pl.BlockSpec((1, np1), lambda r: (0, 0)),
-            pl.BlockSpec((1, np1), lambda r: (0, 0)),
-            pl.BlockSpec((1, np1), lambda r: (0, 0)),
-            pl.BlockSpec((1, np1), lambda r: (0, 0)),
-            pl.BlockSpec((n_nodes, F), lambda r: (0, 0)),
-            pl.BlockSpec((n_nodes, F), lambda r: (0, 0)),
+            pl.BlockSpec((4, np1), lambda r: (0, 0)),
+            pl.BlockSpec((n_nodes, 2 * F), lambda r: (0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, tile), lambda r: (0, r)),
@@ -171,9 +184,9 @@ def adaptive_level_tpu(x, nid, ghw, tables, lo, inv, n_prev: int,
         cost_estimate=pl.CostEstimate(
             flops=2 * 3 * n_nodes * F * W * rows,
             bytes_accessed=rows * F * 4 + rows * 16, transcendentals=0),
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
-    )(x, nid[None, :], ghw, feat[None, :], thr[None, :], nal[None, :],
-      can[None, :], lo, inv)
+    )(x, nid[None, :], ghw, tabs, loinv)
     return nid2[0], hist.reshape(3, n_nodes, F, W)
 
 
@@ -200,7 +213,7 @@ def adaptive_level_xla(x, nid, ghw, tables, lo, inv, n_prev: int,
     lidc = jnp.where(in_lvl, lid, 0)
     lo_r = lo[lidc]                                   # [rows, F]
     inv_r = inv[lidc]
-    bin_f = jnp.clip((x - lo_r) * inv_r, 0.0, float(W - 2))
+    bin_f = jnp.floor(jnp.clip((x - lo_r) * inv_r, 0.0, float(W - 2)))
     bin_i = jnp.where(jnp.isnan(x), W - 1, bin_f.astype(jnp.int32))
     flat = (lidc[:, None] * F + jnp.arange(F)[None, :]) * W + bin_i
     vw = jnp.where(in_lvl, 1.0, 0.0)
@@ -234,18 +247,19 @@ def adaptive_level(x, nid, ghw, tables, lo, inv, n_prev: int, n_nodes: int,
 
 
 def pick_W(nbins: int) -> int:
-    """Smallest supported lane width for nbins real bins (+1 NA lane)."""
-    for w in (64, 128, 256):
+    """Smallest supported lane width for nbins real bins (+1 NA lane).
+    W=32 covers the reference's default nbins=20 at half the one-hot
+    build cost of W=64."""
+    for w in (32, 64, 128, 256):
         if nbins <= w - 2:
             return w
     raise ValueError(f"nbins {nbins} exceeds the adaptive kernel's 254-bin "
                      f"cap; use histogram_type='quantiles_global'")
 
 
-def _totals_kernel(x_ref, nid_ref, ghw_ref, feat_ref, thr_ref, nal_ref,
-                   can_ref, nid_out, tot_out, acc_ref, *, n_prev: int,
-                   n_nodes: int, F: int, tile: int, n_row_tiles: int,
-                   level_base: int):
+def _totals_kernel(x_ref, nid_ref, ghw_ref, tabs_ref, nid_out, tot_out,
+                   acc_ref, *, n_prev: int, n_nodes: int, F: int, tile: int,
+                   n_row_tiles: int, level_base: int):
     """Route one level then accumulate exact f32 (g,h,w) sums per node —
     the deepest-level leaf statistics (no bin histogram, no bf16)."""
     r = pl.program_id(0)
@@ -256,31 +270,8 @@ def _totals_kernel(x_ref, nid_ref, ghw_ref, feat_ref, thr_ref, nal_ref,
 
     x = x_ref[...]
     nid = nid_ref[0, :]
-    HI = jax.lax.Precision.HIGHEST
     if n_prev > 0:
-        prev_base = level_base - n_prev
-        lid_p = nid - prev_base
-        onp = (jax.lax.broadcasted_iota(jnp.int32, (n_prev, tile), 0)
-               == lid_p[None, :]).astype(jnp.float32)
-
-        def lut(tbl_ref):
-            t = tbl_ref[0, :n_prev].astype(jnp.float32)
-            return jax.lax.dot_general(
-                t[None, :], onp, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32, precision=HI)[0]
-
-        f_r = lut(feat_ref)
-        t_r = lut(thr_ref)
-        nl_r = lut(nal_ref)
-        cn_r = lut(can_ref)
-        fi = jax.lax.broadcasted_iota(jnp.int32, (tile, F), 1)
-        xsel = jnp.sum(jnp.where(fi == f_r.astype(jnp.int32)[:, None],
-                                 x, 0.0), axis=1)
-        gr_f = jnp.where(jnp.isnan(xsel), 1.0 - nl_r,
-                         (xsel >= t_r).astype(jnp.float32))
-        in_prev = (lid_p >= 0) & (lid_p < n_prev)
-        child = 2 * nid + 1 + gr_f.astype(jnp.int32)
-        nid = jnp.where(in_prev & (cn_r > 0.5), child, nid)
+        nid = _route(x, nid, tabs_ref, n_prev, level_base, tile, F)
     nid_out[0, :] = nid
     lid = nid - level_base
     in_lvl = (lid >= 0) & (lid < n_nodes)
@@ -309,8 +300,8 @@ def leaf_totals_tpu(x, nid, ghw, tables, n_prev: int, n_nodes: int,
     rows, F = x.shape
     assert rows % tile == 0
     n_row_tiles = rows // tile
-    feat, thr, nal, can = tables
-    np1 = max(n_prev, 1)
+    tabs = _pack_tables(tables)
+    np1 = tabs.shape[1]
     kern = functools.partial(_totals_kernel, n_prev=n_prev, n_nodes=n_nodes,
                              F=F, tile=tile, n_row_tiles=n_row_tiles,
                              level_base=level_base)
@@ -321,10 +312,7 @@ def leaf_totals_tpu(x, nid, ghw, tables, n_prev: int, n_nodes: int,
             pl.BlockSpec((tile, F), lambda r: (r, 0)),
             pl.BlockSpec((1, tile), lambda r: (0, r)),
             pl.BlockSpec((3, tile), lambda r: (0, r)),
-            pl.BlockSpec((1, np1), lambda r: (0, 0)),
-            pl.BlockSpec((1, np1), lambda r: (0, 0)),
-            pl.BlockSpec((1, np1), lambda r: (0, 0)),
-            pl.BlockSpec((1, np1), lambda r: (0, 0)),
+            pl.BlockSpec((4, np1), lambda r: (0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, tile), lambda r: (0, r)),
@@ -335,9 +323,9 @@ def leaf_totals_tpu(x, nid, ghw, tables, n_prev: int, n_nodes: int,
             jax.ShapeDtypeStruct((3 * n_nodes, 128), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((3 * n_nodes, 128), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
-    )(x, nid[None, :], ghw, feat[None, :], thr[None, :], nal[None, :],
-      can[None, :])
+    )(x, nid[None, :], ghw, tabs)
     return nid2[0], tot[:, 0].reshape(3, n_nodes)
 
 
