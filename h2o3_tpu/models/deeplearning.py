@@ -31,7 +31,9 @@ import numpy as np
 from h2o3_tpu.jobs import Job
 from h2o3_tpu.models.glm import expand_design
 from h2o3_tpu.models.model_base import (Model, ModelBuilder, ScoreKeeper,
-                                        TrainingSpec, compute_metrics)
+                                        TrainingSpec, compute_metrics,
+                                        pack_impute_means,
+                                        unpack_impute_means)
 from h2o3_tpu.persist import register_model_class
 
 DL_DEFAULTS: Dict = dict(
@@ -139,9 +141,7 @@ class DeepLearningModel(Model):
 
     def _save_arrays(self):
         d = {"xm": self.xm, "xs": self.xs,
-             "impute_keys": np.array(list(self.impute_means.keys())),
-             "impute_vals": np.array(list(self.impute_means.values()),
-                                     dtype=np.float64)}
+             **pack_impute_means(self.impute_means)}
         for i, layer in enumerate(self.net):
             d[f"W{i}"] = np.asarray(jax.device_get(layer["W"]))
             d[f"b{i}"] = np.asarray(jax.device_get(layer["b"]))
@@ -163,8 +163,7 @@ class DeepLearningModel(Model):
         m.activation = ex["activation"]
         m.xm = arrays["xm"]
         m.xs = arrays["xs"]
-        m.impute_means = {k: float(v) for k, v in
-                          zip(arrays["impute_keys"], arrays["impute_vals"])}
+        m.impute_means = unpack_impute_means(arrays)
         m.net = [{"W": jnp.asarray(arrays[f"W{i}"]),
                   "b": jnp.asarray(arrays[f"b{i}"])}
                  for i in range(ex["n_layers"])]

@@ -300,6 +300,7 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                              min_rows=float(p["min_rows"]),
                              min_split_improvement=float(p["min_split_improvement"]),
                              reg_lambda=float(p.get("reg_lambda", 0.0)),
+                             reg_alpha=float(p.get("reg_alpha", 0.0)),
                              hist_method=p.get("hist_kernel", "auto"))
             root_lo = jnp.zeros(cfg.n_features, jnp.float32)
             root_hi = jnp.zeros(cfg.n_features, jnp.float32)

@@ -27,7 +27,8 @@ import numpy as np
 
 from h2o3_tpu.jobs import Job
 from h2o3_tpu.models.model_base import (Model, ModelBuilder, TrainingSpec,
-                                        compute_metrics)
+                                        compute_metrics, pack_impute_means,
+                                        unpack_impute_means)
 from h2o3_tpu.persist import register_model_class
 
 GLM_DEFAULTS: Dict = dict(
@@ -191,14 +192,17 @@ def _cholesky_solve(G, b, lam_l2, pen_mask):
 
 # ---------------- expansion + standardization --------------------------
 
-def expand_design(spec: TrainingSpec, impute_means=None):
+def expand_design(spec: TrainingSpec, impute_means=None,
+                  use_all_levels: bool = False):
     """DataInfo analog: enum columns → one-hot indicator blocks (all
-    levels except the first, useAllFactorLevels=False default), numerics
-    mean-imputed for NAs. Returns (Xe [padded, Fe] device, names, and the
-    per-column imputation means for scoring reuse)."""
+    levels except the first unless ``use_all_levels``,
+    useAllFactorLevels=False default), numerics mean-imputed for NAs.
+    Returns (Xe [padded, Fe] device, names, and the per-column imputation
+    means for scoring reuse)."""
     cols = []
     names: List[str] = []
     means = {} if impute_means is None else impute_means
+    first = 0 if use_all_levels else 1
     for i, (n, is_cat) in enumerate(zip(spec.names, spec.is_cat)):
         x = spec.X[:, i]
         if is_cat:
@@ -206,7 +210,7 @@ def expand_design(spec: TrainingSpec, impute_means=None):
                 jnp.nanmax(jnp.where(jnp.isnan(x), 0.0, x))) + 1
             dom = spec.cat_domains.get(n) or tuple(str(k) for k in range(card))
             codes = jnp.where(jnp.isnan(x), -1, x).astype(jnp.int32)
-            for lvl in range(1, card):
+            for lvl in range(first, card):
                 cols.append((codes == lvl).astype(jnp.float32))
                 names.append(f"{n}.{dom[lvl]}")
         else:
@@ -224,17 +228,19 @@ def expand_design(spec: TrainingSpec, impute_means=None):
 
 def expand_scoring_matrix(model, X):
     """Expand a raw adapt_test_matrix output with a model's training-time
-    design (enum indicator blocks + mean imputation). Shared by GLM and
-    DeepLearning (any model carrying feature_names/feature_is_cat/
-    cat_domains/impute_means)."""
+    design (enum indicator blocks + mean imputation). Shared by GLM/
+    DeepLearning/KMeans/PCA (any model carrying feature_names/
+    feature_is_cat/cat_domains/impute_means, plus an optional
+    use_all_levels flag)."""
     cols = []
+    first = 0 if getattr(model, "use_all_levels", False) else 1
     for i, (n, is_cat) in enumerate(zip(model.feature_names,
                                         model.feature_is_cat)):
         x = X[:, i]
         if is_cat:
             card = len(model.cat_domains.get(n, ()))
             codes = jnp.where(jnp.isnan(x), -1, x).astype(jnp.int32)
-            for lvl in range(1, card):
+            for lvl in range(first, card):
                 cols.append((codes == lvl).astype(jnp.float32))
         else:
             m = model.impute_means.get(n, 0.0)
@@ -282,9 +288,7 @@ class GLMModel(Model):
 
     def _save_arrays(self):
         return {"beta": self.beta,
-                "impute_keys": np.array(list(self.impute_means.keys())),
-                "impute_vals": np.array(list(self.impute_means.values()),
-                                        dtype=np.float64)}
+                **pack_impute_means(self.impute_means)}
 
     def _save_extra_meta(self):
         return {"family": self.family, "intercept": self.intercept_value,
@@ -306,8 +310,7 @@ class GLMModel(Model):
         m.nobs = ex["nobs"]
         m.rank = ex["rank"]
         m.beta = arrays["beta"]
-        m.impute_means = {k: float(v) for k, v in
-                          zip(arrays["impute_keys"], arrays["impute_vals"])}
+        m.impute_means = unpack_impute_means(arrays)
         return m
 
 

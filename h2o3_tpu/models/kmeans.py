@@ -1,0 +1,214 @@
+"""KMeans — Lloyd iterations as distance matmuls on the MXU.
+
+Reference: hex/kmeans/KMeans.java:26 — k-means|| initialization
+(Sampler), Lloyd iterations as one MRTask pass per iteration
+(LloydsIterationTask :731, one pass per iteration :343), standardization,
+categorical one-hot expansion.
+
+TPU re-design: the per-row nearest-center search is a single
+[rows, F] x [F, K] matmul per iteration (||x-c||² = ||x||² - 2x·c +
+||c||²) + argmin; per-cluster sums are a one-hot matmul (segment-sum on
+the MXU). Under a mesh rows shard over 'data' and the cluster sums psum
+— the MRTask reduce analog. k-means|| init is replaced by k-means++ on a
+device-sampled subset (same spirit: spread the seeds, O(K) passes)."""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.jobs import Job
+from h2o3_tpu.models.glm import expand_design, expand_scoring_matrix
+from h2o3_tpu.models.model_base import (Model, ModelBuilder, TrainingSpec,
+                                        pack_impute_means,
+                                        unpack_impute_means)
+from h2o3_tpu.persist import register_model_class
+
+KMEANS_DEFAULTS: Dict = dict(
+    k=3, max_iterations=10, standardize=True, init="plus_plus", seed=-1,
+)
+
+
+def _dists2(X, C):
+    """Squared distances [rows, K] via the MXU (no [rows, K, F] blowup)."""
+    xn = (X * X).sum(1, keepdims=True)
+    cn = (C * C).sum(1)[None, :]
+    return jnp.maximum(xn - 2.0 * (X @ C.T) + cn, 0.0)
+
+
+@jax.jit
+def _lloyd_step(X, w, C):
+    d2 = _dists2(X, C)
+    assign = jnp.argmin(d2, axis=1)
+    K = C.shape[0]
+    oh = (assign[:, None] == jnp.arange(K)[None, :]).astype(jnp.float32)
+    oh = oh * w[:, None]
+    sums = jax.lax.dot_general(oh, X, (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)  # [K, F]
+    cnt = oh.sum(0)
+    newC = jnp.where(cnt[:, None] > 0, sums / jnp.maximum(cnt[:, None], 1e-12),
+                     C)
+    wcss = (w * jnp.take_along_axis(d2, assign[:, None], axis=1)[:, 0]).sum()
+    return newC, assign, cnt, wcss
+
+
+def _kmeans_pp_init(X, w, k, key, sample=8192):
+    """k-means++ on a device sample (replaces k-means|| — same goal of
+    spread seeds without K full passes over all rows)."""
+    rows = X.shape[0]
+    key, ks = jax.random.split(key)
+    probs = w / jnp.maximum(w.sum(), 1e-12)
+    idx = jax.random.choice(ks, rows, (min(sample, rows),), p=probs)
+    S = X[idx]
+    key, k0 = jax.random.split(key)
+    first = jax.random.randint(k0, (), 0, S.shape[0])
+    C = jnp.zeros((k, X.shape[1]), jnp.float32).at[0].set(S[first])
+
+    def add_center(i, state):
+        C, key = state
+        d2 = _dists2(S, C)
+        # distance to the nearest chosen center (unchosen rows are zeros
+        # at C[0]... mask by taking min over the first i centers)
+        mask = jnp.arange(C.shape[0])[None, :] < i
+        d2m = jnp.where(mask, d2, jnp.inf).min(axis=1)
+        key, kc = jax.random.split(key)
+        p = d2m / jnp.maximum(d2m.sum(), 1e-12)
+        nxt = jax.random.choice(kc, S.shape[0], (), p=p)
+        return C.at[i].set(S[nxt]), key
+
+    C, _ = jax.lax.fori_loop(1, k, add_center, (C, key))
+    return C
+
+
+class KMeansModel(Model):
+    algo = "kmeans"
+    supervised = False
+
+    def __init__(self, key, params, spec, centers_std, centers_raw, xm, xs,
+                 exp_names, impute_means, wcss, sizes, iters):
+        super().__init__(key, params, spec)
+        self.centers_std = np.asarray(centers_std)
+        self.centers_raw = np.asarray(centers_raw)
+        self.xm = np.asarray(xm)
+        self.xs = np.asarray(xs)
+        self.exp_names = list(exp_names)
+        self.impute_means = {k: float(v) for k, v in impute_means.items()}
+        self.tot_withinss = wcss
+        self.cluster_sizes = list(sizes)
+        self.iterations = iters
+
+    def centers(self):
+        """Raw-space cluster centers (h2o .centers())."""
+        return self.centers_raw
+
+    def _predict_matrix(self, X, offset=None):
+        Xe = expand_scoring_matrix(self, X)
+        Xs = (Xe - jnp.asarray(self.xm)[None, :]) / jnp.asarray(self.xs)[None, :]
+        d2 = _dists2(Xs, jnp.asarray(self.centers_std))
+        return jnp.argmin(d2, axis=1).astype(jnp.float32)
+
+    def predict(self, frame):
+        from h2o3_tpu.frame.frame import Frame
+        from h2o3_tpu.frame.vec import Vec
+        from h2o3_tpu.models.model_base import adapt_test_matrix
+        X = adapt_test_matrix(self, frame)
+        out = np.asarray(jax.device_get(self._predict_matrix(X)))[: frame.nrow]
+        return Frame(["predict"], [Vec.from_numpy(out.astype(np.int32))])
+
+    # -- persistence ----------------------------------------------------
+
+    def _save_arrays(self):
+        return {"centers_std": self.centers_std,
+                "centers_raw": self.centers_raw, "xm": self.xm,
+                "xs": self.xs,
+                **pack_impute_means(self.impute_means),
+                "sizes": np.asarray(self.cluster_sizes)}
+
+    def _save_extra_meta(self):
+        return {"exp_names": self.exp_names,
+                "tot_withinss": self.tot_withinss,
+                "iterations": self.iterations}
+
+    @classmethod
+    def _restore(cls, meta, arrays):
+        m = cls._restore_base(meta)
+        ex = meta["extra"]
+        m.exp_names = list(ex["exp_names"])
+        m.tot_withinss = ex["tot_withinss"]
+        m.iterations = ex["iterations"]
+        m.centers_std = arrays["centers_std"]
+        m.centers_raw = arrays["centers_raw"]
+        m.xm = arrays["xm"]
+        m.xs = arrays["xs"]
+        m.cluster_sizes = list(arrays["sizes"])
+        m.impute_means = unpack_impute_means(arrays)
+        return m
+
+
+class H2OKMeansEstimator(ModelBuilder):
+    algo = "kmeans"
+    supervised = False
+
+    def __init__(self, **params):
+        merged = dict(KMEANS_DEFAULTS)
+        merged.update(params)
+        super().__init__(**merged)
+
+    def _train_impl(self, spec: TrainingSpec, valid_spec, job: Job):
+        p = self.params
+        if p.get("estimate_k"):
+            raise NotImplementedError(
+                "estimate_k is not implemented (hex/kmeans estimate_k)")
+        k = int(p.get("k", 3))
+        Xe, exp_names, means = expand_design(spec)
+        w = spec.w
+        if bool(p.get("standardize", True)):
+            wsum = w.sum()
+            xm = (Xe * w[:, None]).sum(0) / wsum
+            xv = (w[:, None] * (Xe - xm[None, :]) ** 2).sum(0) / wsum
+            xs = jnp.sqrt(jnp.maximum(xv, 1e-12))
+        else:
+            xm = jnp.zeros(Xe.shape[1], jnp.float32)
+            xs = jnp.ones(Xe.shape[1], jnp.float32)
+        Xs = ((Xe - xm[None, :]) / xs[None, :]) * (w > 0)[:, None]
+        seed = int(p.get("seed", -1) or -1)
+        key = jax.random.PRNGKey(seed if seed != -1
+                                 else int(time.time() * 1e3) % (2 ** 31))
+        if p.get("init", "plus_plus") in ("random",):
+            idx = jax.random.choice(key, Xs.shape[0], (k,), replace=False,
+                                    p=w / jnp.maximum(w.sum(), 1e-12))
+            C = Xs[idx]
+        else:
+            C = _kmeans_pp_init(Xs, w, k, key)
+        max_iter = max(int(p.get("max_iterations", 10)), 1)
+        wcss = np.inf
+        it = 0
+        for it in range(max_iter):
+            C, assign, cnt, new_wcss = _lloyd_step(Xs, w, C)
+            new_wcss = float(jax.device_get(new_wcss))
+            job.set_progress((it + 1) / max_iter)
+            if abs(wcss - new_wcss) < 1e-6 * max(abs(wcss), 1.0):
+                wcss = new_wcss
+                break
+            wcss = new_wcss
+            if job.cancel_requested:
+                break
+        cnt_h = np.asarray(jax.device_get(cnt))
+        C_h = np.asarray(jax.device_get(C))
+        C_raw = C_h * np.asarray(jax.device_get(xs))[None, :] \
+            + np.asarray(jax.device_get(xm))[None, :]
+        model = KMeansModel(f"kmeans_{id(self) & 0xffffff:x}", self.params,
+                            spec, C_h, C_raw, jax.device_get(xm),
+                            jax.device_get(xs), exp_names,
+                            {k_: float(jax.device_get(v))
+                             for k_, v in means.items()},
+                            wcss, cnt_h.tolist(), it + 1)
+        model.output["tot_withinss"] = wcss
+        model.output["cluster_sizes"] = cnt_h.tolist()
+        return model
+
+
+register_model_class("kmeans", KMeansModel)

@@ -409,6 +409,18 @@ class Model:
         return f"<{type(self).__name__} {self.key} {self.params.get('model_id', '')}>"
 
 
+def pack_impute_means(means) -> Dict[str, np.ndarray]:
+    """npz-safe encoding of the {column: imputation mean} dict shared by
+    the expanded-design models (GLM/DL/KMeans/PCA)."""
+    return {"impute_keys": np.array(list(means.keys())),
+            "impute_vals": np.array(list(means.values()), dtype=np.float64)}
+
+
+def unpack_impute_means(arrays) -> Dict[str, float]:
+    return {str(k): float(v) for k, v in
+            zip(arrays["impute_keys"], arrays["impute_vals"])}
+
+
 def response_codes_in_domain(frame: Frame, response: str, domain):
     """Test-frame response codes mapped through a training domain
     (labels unseen in training → NA/zero-weight)."""

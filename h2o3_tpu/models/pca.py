@@ -1,0 +1,159 @@
+"""PCA — principal components via the Gram matrix + on-device eigh.
+
+Reference: hex/pca/PCA.java:41 — methods GramSVD (distributed Gram then
+JAMA/MTJ eigensolver on the driver), Power, Randomized, GLRM. DataInfo
+handles expansion/standardization.
+
+TPU re-design: the Gram is ONE MXU matmul over the row-sharded design
+(GSPMD psums across shards — the GramTask reduce, hex/gram/Gram.java:1017)
+and the eigendecomposition runs on device with jnp.linalg.eigh — no
+driver-side JAMA. Covers GramSVD semantics; Power/Randomized collapse
+into the same path (eigh of an F x F matrix is cheap at any F the dense
+design supports)."""
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.jobs import Job
+from h2o3_tpu.models.glm import expand_design, expand_scoring_matrix
+from h2o3_tpu.models.model_base import (Model, ModelBuilder, TrainingSpec,
+                                        pack_impute_means,
+                                        unpack_impute_means)
+from h2o3_tpu.persist import register_model_class
+
+PCA_DEFAULTS: Dict = dict(
+    k=2, transform="standardize", pca_method="gram_s_v_d", seed=-1,
+    use_all_factor_levels=False, max_iterations=1000,
+)
+
+
+class PCAModel(Model):
+    algo = "pca"
+    supervised = False
+
+    def __init__(self, key, params, spec, eigvec, eigval, xm, xs, exp_names,
+                 impute_means, importance):
+        super().__init__(key, params, spec)
+        self.eigvec = np.asarray(eigvec)    # [Fe, k] columns = components
+        self.eigval = np.asarray(eigval)    # [k] variances
+        self.xm = np.asarray(xm)
+        self.xs = np.asarray(xs)
+        self.exp_names = list(exp_names)
+        self.impute_means = {k_: float(v) for k_, v in impute_means.items()}
+        self.importance = importance
+        self.use_all_levels = bool(params.get("use_all_factor_levels", False))
+
+    def rotation(self):
+        """Loadings table (h2o .rotation()): {exp_name: [k loadings]}."""
+        return {n: self.eigvec[i].tolist()
+                for i, n in enumerate(self.exp_names)}
+
+    def _predict_matrix(self, X, offset=None):
+        Xe = expand_scoring_matrix(self, X)
+        Xs = (Xe - jnp.asarray(self.xm)[None, :]) / jnp.asarray(self.xs)[None, :]
+        return Xs @ jnp.asarray(self.eigvec)
+
+    def predict(self, frame):
+        """Project onto the principal components (scores frame PC1..PCk)."""
+        from h2o3_tpu.frame.frame import Frame
+        from h2o3_tpu.frame.vec import Vec
+        from h2o3_tpu.models.model_base import adapt_test_matrix
+        X = adapt_test_matrix(self, frame)
+        S = np.asarray(jax.device_get(self._predict_matrix(X)))[: frame.nrow]
+        k = S.shape[1]
+        return Frame([f"PC{i + 1}" for i in range(k)],
+                     [Vec.from_numpy(S[:, i]) for i in range(k)])
+
+    transform = predict  # h2o-py calls model.transform(frame) too
+
+    # -- persistence ----------------------------------------------------
+
+    def _save_arrays(self):
+        return {"eigvec": self.eigvec, "eigval": self.eigval, "xm": self.xm,
+                "xs": self.xs,
+                **pack_impute_means(self.impute_means)}
+
+    def _save_extra_meta(self):
+        return {"exp_names": self.exp_names, "importance": self.importance}
+
+    @classmethod
+    def _restore(cls, meta, arrays):
+        m = cls._restore_base(meta)
+        ex = meta["extra"]
+        m.exp_names = list(ex["exp_names"])
+        m.importance = ex["importance"]
+        m.eigvec = arrays["eigvec"]
+        m.eigval = arrays["eigval"]
+        m.xm = arrays["xm"]
+        m.xs = arrays["xs"]
+        m.impute_means = unpack_impute_means(arrays)
+        m.use_all_levels = bool((meta.get("params") or {}).get(
+            "use_all_factor_levels", False))
+        return m
+
+
+class H2OPrincipalComponentAnalysisEstimator(ModelBuilder):
+    algo = "pca"
+    supervised = False
+
+    def __init__(self, **params):
+        merged = dict(PCA_DEFAULTS)
+        merged.update(params)
+        super().__init__(**merged)
+
+    def _train_impl(self, spec: TrainingSpec, valid_spec, job: Job):
+        p = self.params
+        k = int(p.get("k", 2))
+        use_all = bool(p.get("use_all_factor_levels", False))
+        Xe, exp_names, means = expand_design(spec, use_all_levels=use_all)
+        Fe = Xe.shape[1]
+        k = min(k, Fe)
+        w = spec.w
+        wsum = w.sum()
+        xm = (Xe * w[:, None]).sum(0) / wsum
+        transform = (p.get("transform") or "standardize").lower()
+        if transform in ("standardize",):
+            xv = (w[:, None] * (Xe - xm[None, :]) ** 2).sum(0) / wsum
+            xs = jnp.sqrt(jnp.maximum(xv, 1e-12))
+        elif transform in ("demean", "center"):
+            xs = jnp.ones(Fe, jnp.float32)
+        elif transform in ("none",):
+            xm = jnp.zeros(Fe, jnp.float32)
+            xs = jnp.ones(Fe, jnp.float32)
+        else:
+            raise ValueError(f"unsupported transform '{transform}'")
+        Xs = ((Xe - xm[None, :]) / xs[None, :]) * (w > 0)[:, None]
+        # Gram: one sharded MXU matmul + implicit psum (GramTask analog)
+        G = jax.lax.dot_general(Xs, Xs * w[:, None], (((0,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32) / wsum
+        vals, vecs = jnp.linalg.eigh(G)            # ascending
+        order = jnp.argsort(-vals)
+        vals = jnp.maximum(vals[order][:k], 0.0)
+        vecs = vecs[:, order][:, :k]
+        job.set_progress(1.0)
+        vals_h = np.asarray(jax.device_get(vals))
+        vecs_h = np.asarray(jax.device_get(vecs))
+        tot = float(np.asarray(jax.device_get(jnp.trace(G))))
+        sdev = np.sqrt(vals_h)
+        prop = vals_h / max(tot, 1e-30)
+        importance = {
+            "sdev": sdev.tolist(),
+            "proportion_of_variance": prop.tolist(),
+            "cumulative_proportion": np.cumsum(prop).tolist(),
+        }
+        model = PCAModel(f"pca_{id(self) & 0xffffff:x}", self.params, spec,
+                         vecs_h, vals_h, jax.device_get(xm),
+                         jax.device_get(xs), exp_names,
+                         {k_: float(jax.device_get(v))
+                          for k_, v in means.items()}, importance)
+        model.output["importance"] = importance
+        model.output["eigenvectors"] = model.rotation()
+        return model
+
+
+register_model_class("pca", PCAModel)

@@ -296,6 +296,7 @@ def adaptive_setup(spec, params, max_depth: int, mtries: int = 0):
                      min_rows=float(p["min_rows"]),
                      min_split_improvement=float(p["min_split_improvement"]),
                      reg_lambda=float(p.get("reg_lambda", 0.0)),
+                     reg_alpha=float(p.get("reg_alpha", 0.0)),
                      mtries=mtries,
                      hist_method=p.get("hist_kernel", "auto"))
     Xf = jnp.where(jnp.isfinite(spec.X), spec.X, jnp.nan)
@@ -524,7 +525,7 @@ def grow_tree_spmd(codes, g, h, w, cfg: TreeConfig, col_mask,
         split_bin = split_bin.at[idx].set(gbb)
         na_left = na_left.at[idx].set(gbnl)
         is_split = is_split.at[idx].set(can)
-        value = value.at[idx].set(-gt / (ht + cfg.reg_lambda + 1e-12))
+        value = value.at[idx].set(_leaf_value(gt, ht, cfg))
         # routing: owner shard of each node's feature computes children
         node_feat_g = gbf[lid]
         owner = node_feat_g // F_loc
@@ -551,7 +552,7 @@ def grow_tree_spmd(codes, g, h, w, cfg: TreeConfig, col_mask,
     gD = jax.lax.psum(gD, data_axis)
     hD = jax.lax.psum(hD, data_axis)
     idxD = baseD + jnp.arange(2 ** D)
-    value = value.at[idxD].set(-gD / (hD + cfg.reg_lambda + 1e-12))
+    value = value.at[idxD].set(_leaf_value(gD, hD, cfg))
 
     tree = {"feat": feat, "split_bin": split_bin, "na_left": na_left,
             "is_split": is_split, "value": value}

@@ -36,23 +36,8 @@ def register_model_class(algo: str, cls) -> None:
 def _model_class(algo: str):
     if not _MODEL_CLASSES:
         # import the algo modules once; each registers its model class
-        from h2o3_tpu.models import gbm  # noqa: F401
-        try:
-            from h2o3_tpu.models import drf  # noqa: F401
-        except ImportError:
-            pass
-        try:
-            from h2o3_tpu.models import glm  # noqa: F401
-        except ImportError:
-            pass
-        try:
-            from h2o3_tpu.models import deeplearning  # noqa: F401
-        except ImportError:
-            pass
-        try:
-            from h2o3_tpu.models import kmeans, pca  # noqa: F401
-        except ImportError:
-            pass
+        from h2o3_tpu.models import (deeplearning, drf, gbm, glm,  # noqa: F401
+                                     kmeans, pca)
     if algo not in _MODEL_CLASSES:
         raise ValueError(f"no registered model class for algo '{algo}'")
     return _MODEL_CLASSES[algo]
