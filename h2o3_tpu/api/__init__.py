@@ -1,0 +1,14 @@
+"""REST API — the h2o-py/R/Flow wire surface.
+
+Reference: water/api/RequestServer.java:38 (routing),
+water/api/RegisterV3Api.java (~128 endpoints), water/api/schemas3/ (the
+versioned JSON shapes), served by embedded Jetty at :54321.
+
+TPU re-design: a stdlib ThreadingHTTPServer on the controller host (no
+Jetty, no servlet stack) routing to plain-function handlers; the schema
+layer is direct JSON emission matching the schemas3 field names the
+clients read. Training runs as background Jobs polled via /3/Jobs.
+"""
+from h2o3_tpu.api.server import H2OApiServer, start_server
+
+__all__ = ["H2OApiServer", "start_server"]

@@ -1,0 +1,246 @@
+"""JSON emitters matching the reference's schemas3 wire shapes.
+
+Reference: water/api/schemas3/ — CloudV3, JobV3, FrameV3/FramesV3,
+ModelsV3, ModelMetrics*V3, ParseSetupV3, ParseV3, ImportFilesV3,
+RapidsSchemaV3. Only the fields the Python/R clients actually read are
+emitted (h2o-py/h2o/backend/connection.py, frame.py, estimator_base.py);
+extra fields are additive later."""
+from __future__ import annotations
+
+import math
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+_START_MS = int(time.time() * 1000)
+
+
+def keyref(name: Optional[str], ktype: str = "Key<Keyed>") -> Optional[Dict]:
+    if name is None:
+        return None
+    return {"name": name, "type": ktype, "URL": None}
+
+
+def cloud_v3() -> Dict:
+    import jax
+    from h2o3_tpu.parallel.mesh import current_mesh
+    mesh = current_mesh()
+    n_dev = int(np.prod(list(mesh.shape.values()))) if mesh else 1
+    return {
+        "__meta": {"schema_version": 3, "schema_name": "CloudV3",
+                   "schema_type": "Iced"},
+        "version": "3.46.0.tpu",
+        "branch_name": "tpu-native",
+        "build_number": "0",
+        "build_age": "0 days",
+        "build_too_old": False,
+        "node_idx": 0,
+        "cloud_name": "h2o3-tpu",
+        "cloud_size": 1,
+        "cloud_uptime_millis": int(time.time() * 1000) - _START_MS,
+        "cloud_internal_timezone": "UTC",
+        "cloud_healthy": True,
+        "bad_nodes": 0,
+        "consensus": True,
+        "locked": True,
+        "is_client": False,
+        "nodes": [{
+            "h2o": "127.0.0.1:54321", "ip_port": "127.0.0.1:54321",
+            "healthy": True, "last_ping": int(time.time() * 1000),
+            "num_cpus": 1, "cpus_allowed": 1,
+            "gflops": None, "mem_bw": None,
+            "tpu_devices": [str(d) for d in jax.devices()],
+        }],
+        "internal_security_enabled": False,
+        "web_ip": "127.0.0.1",
+    }
+
+
+def job_v3(job, dest_key: Optional[str] = None, dest_type: str = "Key<Model>") -> Dict:
+    from h2o3_tpu import jobs as jobs_mod
+    status_map = {jobs_mod.RUNNING: "RUNNING", jobs_mod.DONE: "DONE",
+                  jobs_mod.FAILED: "FAILED", jobs_mod.CANCELLED: "CANCELLED"}
+    msec = int(((job.end_time or time.time()) - job.start_time) * 1000)
+    return {
+        "__meta": {"schema_version": 3, "schema_name": "JobV3",
+                   "schema_type": "Job"},
+        "key": keyref(job.key, "Key<Job>"),
+        "description": job.description,
+        "status": status_map.get(job.status, str(job.status)),
+        "progress": float(job.progress),
+        "progress_msg": "Running" if job.status == jobs_mod.RUNNING else "Done",
+        "start_time": int(job.start_time * 1000),
+        "msec": msec,
+        "dest": keyref(dest_key, dest_type),
+        "warnings": [],
+        "exception": job.exception,
+        "stacktrace": job.exception,
+        "ready_for_view": job.status == jobs_mod.DONE,
+        "auto_recoverable": False,
+    }
+
+
+def _col_v3(name: str, vec, preview_rows: int) -> Dict:
+    from h2o3_tpu.frame.vec import T_ENUM, T_INT, T_REAL, T_STR, T_TIME
+    r = vec.rollups() if vec.type not in (T_STR,) else {}
+    tmap = {T_INT: "int", T_REAL: "real", T_ENUM: "enum", T_STR: "string",
+            T_TIME: "time"}
+    if vec.type == T_STR:
+        data = None
+        strs = [s for s in vec.to_strings()[:preview_rows]]
+    elif vec.type == T_ENUM:
+        codes = np.asarray(vec.to_numpy()[:preview_rows])
+        data = [None if not np.isfinite(c) else float(c) for c in codes]
+        strs = None
+    else:
+        vals = np.asarray(vec.to_numpy()[:preview_rows], dtype=np.float64)
+        data = [None if not np.isfinite(v) else float(v) for v in vals]
+        strs = None
+
+    def fin(x):
+        if x is None:
+            return None
+        x = float(x)
+        return x if math.isfinite(x) else None
+
+    return {
+        "__meta": {"schema_version": 3, "schema_name": "ColV3",
+                   "schema_type": "Vec"},
+        "label": name,
+        "type": tmap.get(vec.type, "real"),
+        "missing_count": int(r.get("na_count", 0)),
+        "zero_count": int(r.get("nzero", 0)) if "nzero" in r else 0,
+        "positive_infinity_count": 0,
+        "negative_infinity_count": 0,
+        "mins": [fin(r.get("min"))] if r else [],
+        "maxs": [fin(r.get("max"))] if r else [],
+        "mean": fin(r.get("mean")) if r else None,
+        "sigma": fin(r.get("sigma")) if r else None,
+        "percentiles": (list(map(fin, r["percentiles"]))
+                        if r.get("percentiles") is not None else None),
+        "domain": list(vec.domain) if vec.domain else None,
+        "domain_cardinality": len(vec.domain) if vec.domain else 0,
+        "data": data,
+        "string_data": strs,
+        "precision": -1,
+        "histogram_bins": None,
+        "histogram_base": 0,
+        "histogram_stride": 0,
+    }
+
+
+def frame_v3(frame, key: str, row_count: int = 10,
+             column_count: Optional[int] = None) -> Dict:
+    ncols = frame.ncol if column_count in (None, 0, -1) else min(
+        column_count, frame.ncol)
+    preview = min(row_count, frame.nrow)
+    return {
+        "__meta": {"schema_version": 3, "schema_name": "FrameV3",
+                   "schema_type": "Frame"},
+        "frame_id": keyref(key, "Key<Frame>"),
+        "rows": frame.nrow,
+        "row_count": preview,
+        "row_offset": 0,
+        "column_count": ncols,
+        "column_offset": 0,
+        "total_column_count": frame.ncol,
+        "byte_size": int(frame.nrow) * frame.ncol * 4,
+        "is_text": False,
+        "num_columns": frame.ncol,
+        "default_percentiles": [0.01, 0.1, 0.25, 0.333, 0.5, 0.667, 0.75,
+                                0.9, 0.99],
+        "columns": [_col_v3(n, frame.vec(n), preview)
+                    for n in frame.names[:ncols]],
+        "compatible_models": [],
+        "chunk_summary": None,
+        "distribution_summary": None,
+    }
+
+
+def frames_v3(entries: List) -> Dict:
+    return {
+        "__meta": {"schema_version": 3, "schema_name": "FramesV3",
+                   "schema_type": "Frames"},
+        "frames": entries,
+    }
+
+
+def _metrics_v3(m, kind_hint: str) -> Optional[Dict]:
+    if m is None:
+        return None
+    d = {"__meta": {"schema_version": 3,
+                    "schema_name": "ModelMetrics%sV3" % kind_hint,
+                    "schema_type": "ModelMetrics"}}
+    for f in ("mse", "rmse", "mae", "rmsle", "r2", "logloss", "auc",
+              "aucpr", "mean_per_class_error", "mean_residual_deviance",
+              "error", "nobs"):
+        v = getattr(m, f, None)
+        if v is not None:
+            d[f] = None if (isinstance(v, float) and not math.isfinite(v)) else v
+    cm = getattr(m, "confusion_matrix", None)
+    if cm is not None:
+        d["cm"] = {"table": np.asarray(cm).tolist()}
+    return d
+
+
+def model_v3(model, key: str) -> Dict:
+    kind = ("Binomial" if model.nclasses == 2 else
+            "Multinomial" if model.nclasses > 2 else "Regression")
+    out: Dict[str, Any] = {
+        "model_category": kind.replace("Regression", "Regression"),
+        "training_metrics": _metrics_v3(model.training_metrics, kind),
+        "validation_metrics": _metrics_v3(model.validation_metrics, kind),
+        "cross_validation_metrics": _metrics_v3(
+            model.cross_validation_metrics, kind),
+        "scoring_history": model.scoring_history,
+        "run_time": int(model.run_time * 1000),
+        "help": {},
+    }
+    vi = model.output.get("variable_importances")
+    if vi:
+        out["variable_importances"] = {
+            "name": "Variable Importances",
+            "columns": [{"name": "variable"}, {"name": "relative_importance"},
+                        {"name": "scaled_importance"}, {"name": "percentage"}],
+            "data": [vi["variable"], vi["relative_importance"],
+                     vi["scaled_importance"], vi["percentage"]],
+        }
+    for k, v in model.output.items():
+        if k not in out and isinstance(v, (int, float, str, bool, list, dict,
+                                           type(None))):
+            out[k] = v
+    coef = getattr(model, "coef", None)
+    if callable(coef):
+        try:
+            out["coefficients_table"] = {
+                "name": "Coefficients", "data": [list(coef().keys()),
+                                                 list(coef().values())]}
+        except Exception:
+            pass
+    return {
+        "__meta": {"schema_version": 3, "schema_name": "ModelSchemaV3",
+                   "schema_type": "Model"},
+        "model_id": keyref(key, "Key<Model>"),
+        "algo": model.algo,
+        "algo_full_name": model.algo.upper(),
+        "response_column_name": model.response,
+        "data_frame": None,
+        "timestamp": int(time.time() * 1000),
+        "have_pojo": False,
+        "have_mojo": False,
+        "parameters": [
+            {"name": k, "actual_value": v, "default_value": None,
+             "label": k, "type": type(v).__name__}
+            for k, v in model.params.items()
+            if isinstance(v, (int, float, str, bool, list, type(None)))],
+        "output": out,
+    }
+
+
+def models_v3(entries: List) -> Dict:
+    return {
+        "__meta": {"schema_version": 3, "schema_name": "ModelsV3",
+                   "schema_type": "Models"},
+        "models": entries,
+    }

@@ -1,0 +1,522 @@
+"""REST server + routing — the RequestServer analog.
+
+Reference: water/api/RequestServer.java:38 (route table, versioned
+paths), water/api/ModelBuilderHandler.java (schema fill → trainModel),
+water/api/RapidsHandler.java, ParseHandler/ParseSetupHandler,
+FramesHandler, ModelsHandler, JobsHandler; Jetty at :54321.
+
+TPU re-design: one stdlib ThreadingHTTPServer; routes are (method,
+pattern) pairs dispatching to plain functions; training runs as
+background Jobs (h2o3_tpu.jobs) the client polls via GET /3/Jobs/{key}
+exactly like h2o-py's H2OJob.poll. Parameter coercion replaces the
+reflection-driven Schema.fillFromParms: form values arrive as strings
+and are json/number/bool-coerced against the estimator defaults."""
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+import threading
+import urllib.parse
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from h2o3_tpu import dkv
+from h2o3_tpu.api import schemas
+from h2o3_tpu.jobs import Job, get_job
+
+_ROUTES: List[Tuple[str, re.Pattern, Callable]] = []
+
+
+def route(method: str, pattern: str):
+    rx = re.compile("^" + re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern) + "$")
+
+    def deco(fn):
+        _ROUTES.append((method, rx, fn))
+        return fn
+    return deco
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, msg: str):
+        super().__init__(msg)
+        self.status = status
+
+
+# ---------------- algo registry ---------------------------------------
+
+def _builders() -> Dict[str, Any]:
+    from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
+    from h2o3_tpu.models.drf import H2ORandomForestEstimator
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+    from h2o3_tpu.models.kmeans import H2OKMeansEstimator
+    from h2o3_tpu.models.pca import H2OPrincipalComponentAnalysisEstimator
+    from h2o3_tpu.models.xgboost import H2OXGBoostEstimator
+    return {"gbm": H2OGradientBoostingEstimator,
+            "drf": H2ORandomForestEstimator,
+            "glm": H2OGeneralizedLinearEstimator,
+            "deeplearning": H2ODeepLearningEstimator,
+            "kmeans": H2OKMeansEstimator,
+            "pca": H2OPrincipalComponentAnalysisEstimator,
+            "xgboost": H2OXGBoostEstimator}
+
+
+def _coerce(v: str) -> Any:
+    """Schema.fillFromParms analog: h2o-py sends everything as strings."""
+    if not isinstance(v, str):
+        return v
+    s = v.strip()
+    if s.lower() in ("true", "false"):
+        return s.lower() == "true"
+    if s.lower() in ("null", "none", ""):
+        return None
+    if s.startswith("[") or s.startswith("{"):
+        try:
+            return json.loads(s)
+        except json.JSONDecodeError:
+            pass
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    return s
+
+
+# ---------------- handlers --------------------------------------------
+
+@route("GET", "/3/Cloud")
+@route("HEAD", "/3/Cloud")
+def _cloud(params, body):
+    return schemas.cloud_v3()
+
+
+@route("GET", "/3/About")
+def _about(params, body):
+    return {"entries": [{"name": "Build project version",
+                         "value": "3.46.0.tpu"}]}
+
+
+@route("POST", "/4/sessions")
+def _new_session(params, body):
+    sid = "_sid_" + uuid.uuid4().hex[:10]
+    dkv.put(sid, "session", {"frames": []})
+    return {"session_key": sid, "name": sid}
+
+
+@route("DELETE", "/4/sessions/{sid}")
+def _end_session(params, body, sid):
+    dkv.remove(sid)
+    return {"session_key": sid}
+
+
+@route("POST", "/3/ImportFiles")
+def _import_files(params, body):
+    path = params.get("path")
+    if not path or not os.path.exists(path):
+        raise ApiError(404, f"path not found: {path}")
+    key = "nfs://" + path.lstrip("/")
+    dkv.put(key, "rawfile", path)
+    return {"__meta": {"schema_version": 3, "schema_name": "ImportFilesV3"},
+            "path": path, "files": [path], "destination_frames": [key],
+            "fails": [], "dels": []}
+
+
+@route("POST", "/3/PostFile")
+def _post_file(params, body):
+    """h2o.upload_file: multipart body → temp file → raw key."""
+    fname = params.get("filename", "upload.csv")
+    data = body if isinstance(body, (bytes, bytearray)) else b""
+    # strip a multipart envelope if present
+    if data.startswith(b"--"):
+        try:
+            head, rest = data.split(b"\r\n\r\n", 1)
+            boundary = data.split(b"\r\n", 1)[0]
+            data = rest.rsplit(b"\r\n" + boundary, 1)[0]
+        except ValueError:
+            pass
+    tmp = os.path.join(tempfile.gettempdir(),
+                       f"h2o_upload_{uuid.uuid4().hex[:8]}_"
+                       f"{os.path.basename(fname)}")
+    with open(tmp, "wb") as f:
+        f.write(data)
+    key = "nfs://" + tmp.lstrip("/")
+    dkv.put(key, "rawfile", tmp)
+    return {"destination_frame": key, "total_bytes": len(data)}
+
+
+def _raw_paths(source_frames) -> List[str]:
+    if isinstance(source_frames, str):
+        source_frames = [source_frames]
+    paths = []
+    for sf in source_frames:
+        name = sf["name"] if isinstance(sf, dict) else sf
+        ent = dkv.get_opt(name)
+        if ent and ent[0] == "rawfile":
+            paths.append(ent[1])
+        elif os.path.exists(str(name)):
+            paths.append(str(name))
+        else:
+            raise ApiError(404, f"source frame not found: {name}")
+    return paths
+
+
+@route("POST", "/3/ParseSetup")
+def _parse_setup(params, body):
+    from h2o3_tpu.ingest.parse import parse_setup
+    src = _coerce(params.get("source_frames", "[]"))
+    paths = _raw_paths(src)
+    sep = params.get("separator")
+    if sep and str(sep).isdigit():
+        sep = chr(int(sep))
+    setup = parse_setup(paths[0], separator=sep)
+    dest = os.path.basename(paths[0]).replace(".csv", "") + ".hex"
+    return {
+        "__meta": {"schema_version": 3, "schema_name": "ParseSetupV3"},
+        "source_frames": [schemas.keyref(p if isinstance(p, str) else p["name"])
+                          for p in (src if isinstance(src, list) else [src])],
+        "parse_type": "CSV",
+        "separator": ord(setup.separator),
+        "single_quotes": False,
+        "check_header": 1 if setup.header else -1,
+        "number_columns": len(setup.column_names),
+        "column_names": list(setup.column_names),
+        "column_types": [t.capitalize() for t in setup.column_types],
+        "na_strings": None,
+        "destination_frame": dest,
+        "chunk_size": 4194304,
+        "total_filtered_column_count": len(setup.column_names),
+    }
+
+
+@route("POST", "/3/Parse")
+def _parse(params, body):
+    from h2o3_tpu.ingest.parse import parse, parse_setup
+    src = _coerce(params.get("source_frames", "[]"))
+    paths = _raw_paths(src)
+    dest = params.get("destination_frame") or (
+        os.path.basename(paths[0]) + ".hex")
+    col_names = _coerce(params.get("column_names")) or None
+    col_types = _coerce(params.get("column_types")) or None
+    if col_types:
+        col_types = [str(t).lower() for t in col_types]
+    sep = params.get("separator")
+    if sep and str(sep).isdigit():
+        sep = chr(int(sep))
+    chk = params.get("check_header")
+    header = None if chk in (None, "0") else (str(chk) == "1")
+
+    job = Job(f"Parse {paths[0]}", key=None)
+
+    def body_fn(j):
+        setup = parse_setup(paths, separator=sep, header=header,
+                            column_names=col_names, column_types=col_types)
+        fr = parse(paths, setup, key=dest)
+        dkv.put(dest, "frame", fr)
+        return fr
+
+    job.run(body_fn, background=True)
+    return {"__meta": {"schema_version": 3, "schema_name": "ParseV3"},
+            "job": schemas.job_v3(job, dest, "Key<Frame>"),
+            "destination_frame": schemas.keyref(dest, "Key<Frame>")}
+
+
+@route("GET", "/3/Jobs/{key}")
+def _get_job(params, body, key):
+    job = get_job(key)
+    if job is None:
+        raise ApiError(404, f"job not found: {key}")
+    dest = getattr(job, "dest_key", None)
+    return {"__meta": {"schema_version": 3, "schema_name": "JobsV3"},
+            "jobs": [schemas.job_v3(job, dest)]}
+
+
+@route("POST", "/3/Jobs/{key}/cancel")
+def _cancel_job(params, body, key):
+    job = get_job(key)
+    if job is None:
+        raise ApiError(404, f"job not found: {key}")
+    job.cancel()
+    return {"jobs": [schemas.job_v3(job, getattr(job, "dest_key", None))]}
+
+
+@route("GET", "/3/Frames/{key}")
+def _get_frame(params, body, key):
+    fr = dkv.get(key, "frame")
+    rc = int(params.get("row_count", 10) or 10)
+    cc = int(params.get("column_count", -1) or -1)
+    return schemas.frames_v3([schemas.frame_v3(fr, key, rc, cc)])
+
+
+@route("GET", "/3/Frames/{key}/summary")
+def _frame_summary(params, body, key):
+    fr = dkv.get(key, "frame")
+    return schemas.frames_v3([schemas.frame_v3(fr, key, 0)])
+
+
+@route("GET", "/3/Frames")
+def _list_frames(params, body):
+    return schemas.frames_v3(
+        [schemas.frame_v3(dkv.get(k, "frame"), k, 0)
+         for k in dkv.keys("frame")])
+
+
+@route("DELETE", "/3/Frames/{key}")
+def _del_frame(params, body, key):
+    dkv.remove(key)
+    return {}
+
+
+@route("DELETE", "/3/DKV/{key}")
+def _del_key(params, body, key):
+    dkv.remove(key)
+    return {}
+
+
+@route("DELETE", "/3/DKV")
+def _del_keys(params, body):
+    retained = set(_coerce(params.get("retained_keys", "[]")) or [])
+    for k in list(dkv.keys()):
+        if k not in retained:
+            dkv.remove(k)
+    return {}
+
+
+@route("GET", "/3/Models")
+def _list_models(params, body):
+    return schemas.models_v3(
+        [schemas.model_v3(dkv.get(k, "model"), k)
+         for k in dkv.keys("model")])
+
+
+@route("GET", "/3/Models/{key}")
+def _get_model(params, body, key):
+    m = dkv.get(key, "model")
+    return schemas.models_v3([schemas.model_v3(m, key)])
+
+
+@route("DELETE", "/3/Models/{key}")
+def _del_model(params, body, key):
+    dkv.remove(key)
+    return {}
+
+
+@route("POST", "/3/ModelBuilders/{algo}")
+def _train(params, body, algo):
+    builders = _builders()
+    if algo not in builders:
+        raise ApiError(404, f"unknown algorithm '{algo}'; have "
+                            f"{sorted(builders)}")
+    parms = {k: _coerce(v) for k, v in params.items()}
+    train_key = parms.pop("training_frame", None)
+    if isinstance(train_key, dict):
+        train_key = train_key.get("name")
+    if not train_key:
+        raise ApiError(400, "training_frame is required")
+    frame = dkv.get(str(train_key), "frame")
+    valid = None
+    vk = parms.pop("validation_frame", None)
+    if vk:
+        valid = dkv.get(str(vk if not isinstance(vk, dict) else vk["name"]),
+                        "frame")
+    y = parms.pop("response_column", None)
+    ignored = parms.pop("ignored_columns", None)
+    model_id = parms.pop("model_id", None) or dkv.unique_key(f"{algo}_model")
+    parms = {k: v for k, v in parms.items() if v is not None}
+    if ignored:
+        parms["ignored_columns"] = ignored
+    est = builders[algo](**parms)
+
+    job = Job(f"{algo} Model Build")
+    job.dest_key = model_id
+
+    def body_fn(j):
+        est.train(y=y, training_frame=frame, validation_frame=valid)
+        if est.job.status == "FAILED":
+            raise RuntimeError(est.job.exception)
+        model = est.model
+        model.key = model_id
+        dkv.put(model_id, "model", model)
+        return model
+
+    job.run(body_fn, background=True)
+    return {
+        "__meta": {"schema_version": 3,
+                   "schema_name": "%sV3" % algo.upper()},
+        "job": schemas.job_v3(job, model_id),
+        "algo": algo,
+        "messages": [],
+        "error_count": 0,
+        "parameters": [{"name": k, "actual_value": v}
+                       for k, v in est.params.items()
+                       if isinstance(v, (int, float, str, bool, list,
+                                         type(None)))],
+        "__http_status": 200,
+    }
+
+
+@route("POST", "/3/Predictions/models/{model}/frames/{frame}")
+@route("POST", "/4/Predictions/models/{model}/frames/{frame}")
+def _predict(params, body, model, frame):
+    m = dkv.get(model, "model")
+    fr = dkv.get(frame, "frame")
+    dest = params.get("predictions_frame") or dkv.unique_key("prediction")
+    pred = m.predict(fr)
+    dkv.put(dest, "frame", pred)
+    perf = None
+    try:
+        mm = m.model_performance(fr)
+        perf = schemas._metrics_v3(
+            mm, "Binomial" if m.nclasses == 2 else
+            "Multinomial" if m.nclasses > 2 else "Regression")
+    except Exception:
+        perf = None
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "ModelMetricsListSchemaV3"},
+            "model_metrics": [perf] if perf else [],
+            "predictions_frame": schemas.keyref(dest, "Key<Frame>")}
+
+
+@route("POST", "/3/LogAndEcho")
+def _log_echo(params, body):
+    return {"message": params.get("message", "")}
+
+
+@route("GET", "/3/Metadata/endpoints")
+def _endpoints(params, body):
+    return {"routes": [{"http_method": m, "url_pattern": rx.pattern}
+                       for m, rx, _ in _ROUTES]}
+
+
+@route("POST", "/99/Rapids")
+def _rapids(params, body):
+    from h2o3_tpu.rapids import exec_rapids
+    ast = params.get("ast", "")
+    session = params.get("session_id")
+    return exec_rapids(ast, session)
+
+
+# ---------------- HTTP plumbing ----------------------------------------
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "h2o3-tpu/3.46"
+
+    def log_message(self, fmt, *args):  # quiet by default
+        if os.environ.get("H2O3_API_LOG"):
+            super().log_message(fmt, *args)
+
+    def _dispatch(self, method):
+        parsed = urllib.parse.urlparse(self.path)
+        path = parsed.path
+        params = {k: v[0] for k, v in
+                  urllib.parse.parse_qs(parsed.query).items()}
+        body = b""
+        clen = int(self.headers.get("Content-Length") or 0)
+        if clen:
+            body = self.rfile.read(clen)
+        ctype = self.headers.get("Content-Type", "")
+        if body and "application/x-www-form-urlencoded" in ctype:
+            params.update({k: v[0] for k, v in
+                           urllib.parse.parse_qs(body.decode()).items()})
+        elif body and "application/json" in ctype:
+            try:
+                params.update(json.loads(body.decode()))
+            except json.JSONDecodeError:
+                pass
+        for m, rx, fn in _ROUTES:
+            if m != method:
+                continue
+            match = rx.match(path)
+            if match:
+                try:
+                    groups = {k: urllib.parse.unquote(v)
+                              for k, v in match.groupdict().items()}
+                    out = fn(params, body, **groups)
+                    status = out.pop("__http_status", 200) if isinstance(
+                        out, dict) else 200
+                    self._reply(status, out)
+                except ApiError as e:
+                    self._reply(e.status, {
+                        "__meta": {"schema_name": "H2OErrorV3"},
+                        "http_status": e.status, "msg": str(e),
+                        "dev_msg": str(e), "exception_msg": str(e),
+                        "exception_type": "ApiError", "values": {},
+                        "stacktrace": []})
+                except Exception as e:  # noqa: BLE001 — wire boundary
+                    import traceback
+                    self._reply(500, {
+                        "__meta": {"schema_name": "H2OErrorV3"},
+                        "http_status": 500, "msg": str(e),
+                        "dev_msg": str(e), "exception_msg": str(e),
+                        "exception_type": type(e).__name__, "values": {},
+                        "stacktrace": traceback.format_exc().split("\n")})
+                return
+        self._reply(404, {"__meta": {"schema_name": "H2OErrorV3"},
+                          "http_status": 404,
+                          "msg": f"no route for {method} {path}",
+                          "exception_type": "NotFound", "values": {},
+                          "stacktrace": []})
+
+    def _reply(self, status, obj):
+        data = json.dumps(obj, default=_json_default).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        if self.command != "HEAD":
+            self.wfile.write(data)
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_DELETE(self):
+        self._dispatch("DELETE")
+
+    def do_HEAD(self):
+        self._dispatch("HEAD")
+
+
+def _json_default(o):
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        v = float(o)
+        return v if np.isfinite(v) else None
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    return str(o)
+
+
+class H2OApiServer:
+    """Embedded API server (the h2o.jar web server analog)."""
+
+    def __init__(self, port: int = 54321, host: str = "127.0.0.1"):
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.port = self.httpd.server_address[1]
+        self.host = host
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def start_server(port: int = 54321, host: str = "127.0.0.1") -> H2OApiServer:
+    return H2OApiServer(port=port, host=host).start()

@@ -1,0 +1,60 @@
+"""Keyed object store — the DKV's single-controller residue.
+
+Reference: water/DKV.java:52 + water/Key.java — a cluster-coherent
+distributed K/V store with home-node hashing, caching and invalidation.
+Under single-controller JAX none of that machinery survives (SURVEY §5
+"the DKV's locality/coherence role collapses"): device data already lives
+in sharded jax.Arrays, so what remains is a thread-safe host-side map of
+key → {frame, model, job} used by the REST layer and clients to address
+objects by name (the /3/Frames/{key}, /3/Models/{key}, DELETE /3/DKV
+surface)."""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+_LOCK = threading.RLock()
+_STORE: Dict[str, Tuple[str, Any]] = {}
+_COUNTER = itertools.count(1)
+
+
+def put(key: str, kind: str, obj: Any) -> str:
+    with _LOCK:
+        _STORE[key] = (kind, obj)
+    return key
+
+
+def get(key: str, kind: Optional[str] = None) -> Any:
+    with _LOCK:
+        ent = _STORE.get(key)
+    if ent is None:
+        raise KeyError(f"key '{key}' not found in the store")
+    if kind is not None and ent[0] != kind:
+        raise KeyError(f"key '{key}' holds a {ent[0]}, not a {kind}")
+    return ent[1]
+
+
+def get_opt(key: str) -> Optional[Tuple[str, Any]]:
+    with _LOCK:
+        return _STORE.get(key)
+
+
+def remove(key: str) -> bool:
+    with _LOCK:
+        return _STORE.pop(key, None) is not None
+
+
+def keys(kind: Optional[str] = None) -> Iterable[str]:
+    with _LOCK:
+        return [k for k, (t, _) in _STORE.items()
+                if kind is None or t == kind]
+
+
+def clear() -> None:
+    with _LOCK:
+        _STORE.clear()
+
+
+def unique_key(prefix: str) -> str:
+    return f"{prefix}_{next(_COUNTER)}"

@@ -320,9 +320,10 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
     def __init__(self, **params):
         merged = dict(GLM_DEFAULTS)
         merged.update(params)
-        # h2o-py spells it lambda_ / Lambda
-        if "lambda_" in merged:
-            merged["Lambda"] = merged.pop("lambda_")
+        # h2o-py spells it lambda_ in python and "lambda" on the wire
+        for alias in ("lambda_", "lambda"):
+            if alias in merged:
+                merged["Lambda"] = merged.pop(alias)
         super().__init__(**merged)
 
     def _resolve_family(self, spec) -> str:

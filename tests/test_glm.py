@@ -178,3 +178,10 @@ def test_glm_non_negative_leaves_intercept_free():
     coef = glm.model.coef()
     assert coef["x"] >= 0.0
     assert abs(coef["Intercept"] + 3.0) < 0.02, coef  # negative, unclamped
+
+
+def test_glm_wire_spelled_lambda():
+    """REST sends the penalty as 'lambda' — it must reach Lambda."""
+    glm = H2OGeneralizedLinearEstimator(**{"family": "gaussian",
+                                           "alpha": 0.0, "lambda": 0.25})
+    assert glm.params["Lambda"] == 0.25

@@ -1,0 +1,194 @@
+"""REST API tests — the exact call sequence h2o-py's happy path makes
+(h2o-py/h2o/backend/connection.py handshake, h2o.py import/parse,
+estimator_base.py train/poll/fetch, frame.py Rapids), driven with
+urllib against a live server on an ephemeral port."""
+import json
+import os
+import time
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu import dkv
+from h2o3_tpu.api import start_server
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = start_server(port=0)   # ephemeral
+    yield srv
+    srv.stop()
+    dkv.clear()
+
+
+def _req(server, method, path, data=None):
+    url = f"http://127.0.0.1:{server.port}{path}"
+    body = None
+    headers = {}
+    if data is not None:
+        body = urllib.parse.urlencode(
+            {k: (json.dumps(v) if isinstance(v, (list, dict)) else v)
+             for k, v in data.items()}).encode()
+        headers["Content-Type"] = "application/x-www-form-urlencoded"
+    req = urllib.request.Request(url, data=body, method=method,
+                                 headers=headers)
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _poll(server, job_key, timeout=120):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        j = _req(server, "GET", f"/3/Jobs/{urllib.parse.quote(job_key)}")
+        job = j["jobs"][0]
+        if job["status"] in ("DONE", "FAILED", "CANCELLED"):
+            return job
+        time.sleep(0.2)
+    raise TimeoutError(job_key)
+
+
+@pytest.fixture(scope="module")
+def csv_path(tmp_path_factory):
+    rng = np.random.default_rng(0)
+    n = 600
+    p = tmp_path_factory.mktemp("data") / "airlineish.csv"
+    with open(p, "w") as f:
+        f.write("dist,carrier,delayed\n")
+        for i in range(n):
+            carrier = ["AA", "UA", "DL"][rng.integers(0, 3)]
+            dist = rng.uniform(100, 2000)
+            dep = (rng.random() < (0.7 if carrier == "AA" else 0.3))
+            f.write(f"{dist:.1f},{carrier},{'YES' if dep else 'NO'}\n")
+    return str(p)
+
+
+def test_cloud_handshake(server):
+    cloud = _req(server, "GET", "/3/Cloud")
+    assert cloud["cloud_healthy"] is True
+    assert cloud["cloud_size"] == 1
+    assert cloud["version"].startswith("3.")
+
+
+def test_session_lifecycle(server):
+    s = _req(server, "POST", "/4/sessions")
+    sid = s["session_key"]
+    assert sid.startswith("_sid_")
+    _req(server, "DELETE", f"/4/sessions/{sid}")
+
+
+def test_import_parse_train_predict_flow(server, csv_path):
+    # 1. import
+    imp = _req(server, "POST", "/3/ImportFiles",
+               {"path": csv_path})
+    raw_key = imp["destination_frames"][0]
+    # 2. parse setup
+    setup = _req(server, "POST", "/3/ParseSetup",
+                 {"source_frames": [raw_key]})
+    assert setup["number_columns"] == 3
+    assert setup["column_names"] == ["dist", "carrier", "delayed"]
+    # 3. parse
+    parse = _req(server, "POST", "/3/Parse", {
+        "source_frames": [raw_key],
+        "destination_frame": "air.hex",
+        "column_names": setup["column_names"],
+        "column_types": setup["column_types"],
+        "check_header": setup["check_header"],
+    })
+    job = _poll(server, parse["job"]["key"]["name"])
+    assert job["status"] == "DONE", job
+    # 4. frame summary
+    fr = _req(server, "GET", "/3/Frames/air.hex")["frames"][0]
+    assert fr["rows"] == 600
+    cols = {c["label"]: c for c in fr["columns"]}
+    assert cols["carrier"]["type"] == "enum"
+    assert set(cols["carrier"]["domain"]) == {"AA", "UA", "DL"}
+    assert cols["dist"]["mean"] is not None
+    # 5. train GBM (estimator_base.py:187 shape)
+    tr = _req(server, "POST", "/3/ModelBuilders/gbm", {
+        "training_frame": "air.hex",
+        "response_column": "delayed",
+        "ntrees": 10, "max_depth": 3, "seed": 1,
+        "distribution": "bernoulli",
+    })
+    assert tr["error_count"] == 0
+    jkey = tr["job"]["key"]["name"]
+    mkey = tr["job"]["dest"]["name"]
+    job = _poll(server, jkey)
+    assert job["status"] == "DONE", job.get("exception")
+    # 6. fetch model
+    mj = _req(server, "GET", f"/3/Models/{mkey}")["models"][0]
+    assert mj["algo"] == "gbm"
+    auc = mj["output"]["training_metrics"]["auc"]
+    assert auc > 0.7, mj["output"]["training_metrics"]
+    # 7. predictions
+    pr = _req(server, "POST",
+              f"/3/Predictions/models/{mkey}/frames/air.hex", {})
+    pkey = pr["predictions_frame"]["name"]
+    pf = _req(server, "GET", f"/3/Frames/{pkey}")["frames"][0]
+    labels = [c["label"] for c in pf["columns"]]
+    assert labels[0] == "predict"
+    assert "pYES" in labels and "pNO" in labels
+
+
+def test_rest_glm_and_kmeans(server, csv_path):
+    if dkv.get_opt("air.hex") is None:
+        pytest.skip("parse flow test must run first")
+    tr = _req(server, "POST", "/3/ModelBuilders/glm", {
+        "training_frame": "air.hex", "response_column": "delayed",
+        "family": "binomial", "alpha": 0.0, "lambda": 0.0})
+    job = _poll(server, tr["job"]["key"]["name"])
+    assert job["status"] == "DONE", job.get("exception")
+    km = _req(server, "POST", "/3/ModelBuilders/kmeans", {
+        "training_frame": "air.hex", "k": 3,
+        "ignored_columns": ["delayed"]})
+    job = _poll(server, km["job"]["key"]["name"])
+    assert job["status"] == "DONE", job.get("exception")
+    models = _req(server, "GET", "/3/Models")["models"]
+    assert len(models) >= 2
+
+
+def test_rest_rapids_and_dkv(server, csv_path):
+    if dkv.get_opt("air.hex") is None:
+        pytest.skip("parse flow test must run first")
+    r = _req(server, "POST", "/99/Rapids",
+             {"ast": "(mean (cols_py air.hex 'dist') True)",
+              "session_id": "_sid_t"})
+    assert 100 < r["scalar"] < 2000
+    r = _req(server, "POST", "/99/Rapids",
+             {"ast": "(tmp= py_9 (rows air.hex (> (cols_py air.hex 'dist')"
+                     " 1000)))"})
+    sub = _req(server, "GET", "/3/Frames/py_9")["frames"][0]
+    assert 0 < sub["rows"] < 600
+    _req(server, "DELETE", "/3/DKV/py_9")
+    with pytest.raises(urllib.error.HTTPError):
+        _req(server, "GET", "/3/Frames/py_9")
+
+
+def test_rest_error_shape(server):
+    try:
+        _req(server, "GET", "/3/Frames/definitely_missing")
+        assert False, "expected 500/404"
+    except urllib.error.HTTPError as e:
+        err = json.loads(e.read().decode())
+        assert "msg" in err and "stacktrace" in err
+
+
+def test_rest_upload_file(server, tmp_path):
+    p = tmp_path / "tiny.csv"
+    p.write_text("a,b\n1,2\n3,4\n")
+    data = p.read_bytes()
+    url = f"http://127.0.0.1:{server.port}/3/PostFile?filename=tiny.csv"
+    req = urllib.request.Request(url, data=data, method="POST",
+                                 headers={"Content-Type":
+                                          "application/octet-stream"})
+    with urllib.request.urlopen(req) as resp:
+        out = json.loads(resp.read().decode())
+    raw = out["destination_frame"]
+    parse = _req(server, "POST", "/3/Parse", {
+        "source_frames": [raw], "destination_frame": "tiny.hex"})
+    _poll(server, parse["job"]["key"]["name"])
+    fr = _req(server, "GET", "/3/Frames/tiny.hex")["frames"][0]
+    assert fr["rows"] == 2
