@@ -90,8 +90,11 @@ def _col_v3(name: str, vec, preview_rows: int) -> Dict:
         data = None
         strs = [s for s in vec.to_strings()[:preview_rows]]
     elif vec.type == T_ENUM:
+        # enum NA is code -1 (ENUM_NA), which IS finite — emit None so
+        # clients don't render domain[-1] (the last level) for NA cells
         codes = np.asarray(vec.to_numpy()[:preview_rows])
-        data = [None if not np.isfinite(c) else float(c) for c in codes]
+        data = [None if (not np.isfinite(c) or c < 0) else float(c)
+                for c in codes]
         strs = None
     else:
         vals = np.asarray(vec.to_numpy()[:preview_rows], dtype=np.float64)
@@ -110,15 +113,17 @@ def _col_v3(name: str, vec, preview_rows: int) -> Dict:
         "label": name,
         "type": tmap.get(vec.type, "real"),
         "missing_count": int(r.get("na_count", 0)),
-        "zero_count": int(r.get("nzero", 0)) if "nzero" in r else 0,
-        "positive_infinity_count": 0,
-        "negative_infinity_count": 0,
+        # nz_count counts NON-ZERO entries; zero_count = rows − NA − nz
+        "zero_count": (int(r["rows"] - r["na_count"] - r["nz_count"])
+                       if "nz_count" in r else 0),
+        "positive_infinity_count": int(r.get("pinfs", 0)),
+        "negative_infinity_count": int(r.get("ninfs", 0)),
         "mins": [fin(r.get("min"))] if r else [],
         "maxs": [fin(r.get("max"))] if r else [],
         "mean": fin(r.get("mean")) if r else None,
         "sigma": fin(r.get("sigma")) if r else None,
-        "percentiles": (list(map(fin, r["percentiles"]))
-                        if r.get("percentiles") is not None else None),
+        "percentiles": (list(map(fin, vec.percentiles()))
+                        if r and vec.type not in (T_ENUM,) else None),
         "domain": list(vec.domain) if vec.domain else None,
         "domain_cardinality": len(vec.domain) if vec.domain else 0,
         "data": data,
@@ -188,7 +193,7 @@ def model_v3(model, key: str) -> Dict:
     kind = ("Binomial" if model.nclasses == 2 else
             "Multinomial" if model.nclasses > 2 else "Regression")
     out: Dict[str, Any] = {
-        "model_category": kind.replace("Regression", "Regression"),
+        "model_category": kind,
         "training_metrics": _metrics_v3(model.training_metrics, kind),
         "validation_metrics": _metrics_v3(model.validation_metrics, kind),
         "cross_validation_metrics": _metrics_v3(
@@ -210,12 +215,13 @@ def model_v3(model, key: str) -> Dict:
         if k not in out and isinstance(v, (int, float, str, bool, list, dict,
                                            type(None))):
             out[k] = v
-    coef = getattr(model, "coef", None)
-    if callable(coef):
+    coef_fn = getattr(model, "coef", None)
+    if callable(coef_fn):
         try:
+            coefs = coef_fn()
             out["coefficients_table"] = {
-                "name": "Coefficients", "data": [list(coef().keys()),
-                                                 list(coef().values())]}
+                "name": "Coefficients", "data": [list(coefs.keys()),
+                                                 list(coefs.values())]}
         except Exception:
             pass
     return {

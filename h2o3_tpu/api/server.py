@@ -315,7 +315,15 @@ def _train(params, body, algo):
     if algo not in builders:
         raise ApiError(404, f"unknown algorithm '{algo}'; have "
                             f"{sorted(builders)}")
+    # key-like and name-like params stay raw strings — _coerce would turn
+    # model_id="123" into an int DKV key and response_column="none" to None
+    raw_keep = {k: params[k] for k in ("model_id", "training_frame",
+                                       "validation_frame",
+                                       "response_column", "fold_column",
+                                       "weights_column", "offset_column")
+                if k in params}
     parms = {k: _coerce(v) for k, v in params.items()}
+    parms.update(raw_keep)
     train_key = parms.pop("training_frame", None)
     if isinstance(train_key, dict):
         train_key = train_key.get("name")
@@ -419,18 +427,25 @@ class _Handler(BaseHTTPRequestHandler):
         params = {k: v[0] for k, v in
                   urllib.parse.parse_qs(parsed.query).items()}
         body = b""
-        clen = int(self.headers.get("Content-Length") or 0)
-        if clen:
-            body = self.rfile.read(clen)
-        ctype = self.headers.get("Content-Type", "")
-        if body and "application/x-www-form-urlencoded" in ctype:
-            params.update({k: v[0] for k, v in
-                           urllib.parse.parse_qs(body.decode()).items()})
-        elif body and "application/json" in ctype:
-            try:
-                params.update(json.loads(body.decode()))
-            except json.JSONDecodeError:
-                pass
+        try:
+            clen = int(self.headers.get("Content-Length") or 0)
+            if clen:
+                body = self.rfile.read(clen)
+            ctype = self.headers.get("Content-Type", "")
+            if body and "application/x-www-form-urlencoded" in ctype:
+                params.update({k: v[0] for k, v in
+                               urllib.parse.parse_qs(body.decode()).items()})
+            elif body and "application/json" in ctype:
+                try:
+                    params.update(json.loads(body.decode()))
+                except json.JSONDecodeError:
+                    pass
+        except Exception as e:  # malformed body → JSON error, not a reset
+            self._reply(400, {"__meta": {"schema_name": "H2OErrorV3"},
+                              "http_status": 400, "msg": str(e),
+                              "exception_type": type(e).__name__,
+                              "values": {}, "stacktrace": []})
+            return
         for m, rx, fn in _ROUTES:
             if m != method:
                 continue
@@ -520,3 +535,12 @@ class H2OApiServer:
 
 def start_server(port: int = 54321, host: str = "127.0.0.1") -> H2OApiServer:
     return H2OApiServer(port=port, host=host).start()
+
+
+@route("GET", "/3/Logs/download")
+@route("GET", "/3/Logs")
+def _logs(params, body):
+    from h2o3_tpu.log import buffered_lines
+    return {"__meta": {"schema_version": 3, "schema_name": "LogsV3"},
+            "log": "\n".join(buffered_lines(int(params.get("n", 1000)
+                                                or 1000)))}

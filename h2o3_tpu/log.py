@@ -1,0 +1,98 @@
+"""Structured logging + per-phase timing.
+
+Reference: water/util/Log.java (leveled log4j-backed logging, per-node
+files, buffered pre-init, served at /3/Logs) and MRTask's MRProfile
+(water/MRTask.java:190-194,321 — per-phase timings surfaced with the
+task).
+
+TPU re-design: one stdlib logger with an in-memory ring buffer (the
+/3/Logs source — there is one controller process, no per-node files) and
+a ``Profile`` that accumulates named phase durations; builders attach it
+to ``model.output['profile']`` so timings travel with the model the way
+MRProfile travels with the task."""
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import sys
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+_BUFFER = collections.deque(maxlen=10000)
+_BUF_LOCK = threading.Lock()
+
+
+class _RingHandler(logging.Handler):
+    def emit(self, record):
+        with _BUF_LOCK:
+            _BUFFER.append(self.format(record))
+
+
+def _build_logger() -> logging.Logger:
+    lg = logging.getLogger("h2o3_tpu")
+    if lg.handlers:
+        return lg
+    level = os.environ.get("H2O3_LOG_LEVEL", "INFO").upper()
+    lg.setLevel(getattr(logging, level, logging.INFO))
+    fmt = logging.Formatter(
+        "%(asctime)s.%(msecs)03d %(levelname)-5s %(name)s: %(message)s",
+        datefmt="%H:%M:%S")
+    ring = _RingHandler()
+    ring.setFormatter(fmt)
+    lg.addHandler(ring)
+    if os.environ.get("H2O3_LOG_STDERR", "1") != "0":
+        sh = logging.StreamHandler(sys.stderr)
+        sh.setFormatter(fmt)
+        lg.addHandler(sh)
+    lg.propagate = False
+    return lg
+
+
+logger = _build_logger()
+debug = logger.debug
+info = logger.info
+warn = logger.warning
+error = logger.error
+
+
+def buffered_lines(n: int = 1000) -> List[str]:
+    """Recent log lines (the /3/Logs source)."""
+    with _BUF_LOCK:
+        return list(_BUFFER)[-n:]
+
+
+class Profile:
+    """Per-phase wall-time accumulator (MRProfile analog). Phases may
+    repeat; durations accumulate. Not thread-safe by design — one Profile
+    per training driver, like one MRProfile per MRTask."""
+
+    def __init__(self):
+        self.phases: Dict[str, float] = {}
+        self._order: List[str] = []
+
+    @contextmanager
+    def phase(self, name: str):
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            dt = time.time() - t0
+            if name not in self.phases:
+                self._order.append(name)
+            self.phases[name] = self.phases.get(name, 0.0) + dt
+
+    def add(self, name: str, seconds: float):
+        if name not in self.phases:
+            self._order.append(name)
+        self.phases[name] = self.phases.get(name, 0.0) + seconds
+
+    def to_dict(self) -> Dict[str, float]:
+        return {k: round(self.phases[k], 4) for k in self._order}
+
+    def summary(self) -> str:
+        total = sum(self.phases.values())
+        parts = [f"{k}={self.phases[k]:.2f}s" for k in self._order]
+        return f"total={total:.2f}s " + " ".join(parts)

@@ -1,0 +1,176 @@
+"""StackedEnsemble — a metalearner over base models' CV holdout preds.
+
+Reference: hex/ensemble/StackedEnsemble.java:38 — collects the base
+models' cross-validation holdout predictions into a level-one frame and
+trains a metalearner (default GLM) on it; scoring runs the base models
+then the metalearner.
+
+TPU re-design: the level-one matrix is assembled from the holdout
+predictions each builder already keeps on device (ModelBuilder CV stores
+``cross_validation_holdout_predictions``, model_base.py), and the
+metalearner is the existing GLM (MXU Gram IRLS) or any registered
+builder. Scoring is a batched chain: base `_predict_matrix`s →
+metalearner `_predict_matrix` — no per-row dispatch."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu import dkv
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.vec import T_ENUM, Vec
+from h2o3_tpu.jobs import Job
+from h2o3_tpu.models.model_base import (Model, ModelBuilder, TrainingSpec,
+                                        adapt_test_matrix, compute_metrics)
+from h2o3_tpu.persist import register_model_class
+
+SE_DEFAULTS: Dict = dict(
+    base_models=None, metalearner_algorithm="auto",
+    metalearner_params=None, seed=-1,
+)
+
+
+def _base_level_one_cols(model, X_or_holdout, is_holdout: bool):
+    """Level-one features from one base model: p(class1) for binomial,
+    K probability columns for multinomial, the prediction for
+    regression (StackedEnsemble.java levelOneFrame assembly)."""
+    if is_holdout:
+        out = np.asarray(X_or_holdout)
+    else:
+        out = np.asarray(jax.device_get(model._predict_matrix(X_or_holdout)))
+    if model.nclasses == 2:
+        return [out[:, 1]]
+    if model.nclasses > 2:
+        return [out[:, k] for k in range(model.nclasses)]
+    return [out]
+
+
+class StackedEnsembleModel(Model):
+    algo = "stackedensemble"
+
+    def __init__(self, key, params, spec, base_models, meta_model):
+        super().__init__(key, params, spec)
+        self.base_models = list(base_models)
+        self.meta_model = meta_model
+        self.ntrees_built = 0
+
+    def _predict_matrix(self, X, offset=None):
+        cols = []
+        for bm in self.base_models:
+            # base models may have trained on a column subset/order —
+            # remap by name from the ensemble's feature order
+            idx = [self.feature_names.index(n) for n in bm.feature_names]
+            Xb = X[:, jnp.asarray(idx)] if idx != list(
+                range(len(self.feature_names))) else X
+            cols.extend(_base_level_one_cols(bm, Xb, is_holdout=False))
+        Z = np.stack(cols, axis=1).astype(np.float32)
+        return self.meta_model._predict_matrix(jnp.asarray(Z))
+
+    # persistence: save base model keys only (reference SE also keeps
+    # references; the bundle export is future work)
+    def _save_extra_meta(self):
+        return {"n_base": len(self.base_models)}
+
+
+def _level_one_frame(base_models, y_codes, w, nrow, response_domain):
+    cols: List[np.ndarray] = []
+    names: List[str] = []
+    for bi, bm in enumerate(base_models):
+        hold = bm.output.get("cross_validation_holdout_predictions")
+        if hold is None:
+            raise ValueError(
+                f"base model {bm.key} has no cross-validation holdout "
+                f"predictions — train base models with nfolds >= 2 "
+                f"(StackedEnsemble requires CV holdouts)")
+        parts = _base_level_one_cols(bm, hold, is_holdout=True)
+        for k, c in enumerate(parts):
+            cols.append(np.asarray(c, dtype=np.float32)[:nrow])
+            names.append(f"m{bi}_p{k}")
+    data = {n: c for n, c in zip(names, cols)}
+    if response_domain:
+        data["__response"] = np.asarray(
+            [response_domain[int(c)] for c in y_codes[:nrow]], dtype=object)
+    else:
+        data["__response"] = np.asarray(y_codes[:nrow], dtype=np.float32)
+    fr = Frame(list(data.keys()),
+               [Vec.from_numpy(v) for v in data.values()])
+    return fr, names
+
+
+class H2OStackedEnsembleEstimator(ModelBuilder):
+    algo = "stackedensemble"
+
+    def __init__(self, **params):
+        merged = dict(SE_DEFAULTS)
+        merged.update(params)
+        super().__init__(**merged)
+
+    def _resolve_base_models(self):
+        out = []
+        for b in self.params.get("base_models") or []:
+            if isinstance(b, str):
+                out.append(dkv.get(b, "model"))
+            elif isinstance(b, Model):
+                out.append(b)
+            elif hasattr(b, "model") and b.model is not None:
+                out.append(b.model)
+            else:
+                raise ValueError(f"bad base model reference: {b!r}")
+        if len(out) < 2:
+            raise ValueError("StackedEnsemble needs >= 2 base models")
+        return out
+
+    def _train_impl(self, spec: TrainingSpec, valid_spec, job: Job):
+        base = self._resolve_base_models()
+        nrow = spec.nrow
+        y_host = np.asarray(jax.device_get(spec.y))
+        w_host = np.asarray(jax.device_get(spec.w))
+        l1fr, znames = _level_one_frame(base, y_host, w_host, nrow,
+                                        spec.response_domain)
+        algo = (self.params.get("metalearner_algorithm") or "auto").lower()
+        mp = dict(self.params.get("metalearner_params") or {})
+        if algo in ("auto", "glm"):
+            from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+            mp.setdefault("family",
+                          "binomial" if spec.nclasses == 2 else "gaussian")
+            mp.setdefault("alpha", 0.0)
+            mp.setdefault("Lambda", 1e-5)
+            mp.setdefault("non_negative", True)   # reference AUTO metalearner
+            meta_est = H2OGeneralizedLinearEstimator(**mp)
+        elif algo == "gbm":
+            from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+            meta_est = H2OGradientBoostingEstimator(**mp)
+        elif algo == "drf":
+            from h2o3_tpu.models.drf import H2ORandomForestEstimator
+            meta_est = H2ORandomForestEstimator(**mp)
+        elif algo == "deeplearning":
+            from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
+            meta_est = H2ODeepLearningEstimator(**mp)
+        else:
+            raise ValueError(f"unsupported metalearner '{algo}'")
+        if spec.nclasses > 2:
+            raise NotImplementedError(
+                "multinomial StackedEnsemble needs a multinomial "
+                "metalearner (GLM multinomial pending)")
+        meta_est.train(x=znames, y="__response", training_frame=l1fr)
+        if meta_est.job.status == "FAILED":
+            raise RuntimeError(meta_est.job.exception)
+        meta = meta_est.model
+        model = StackedEnsembleModel(
+            f"se_{id(self) & 0xffffff:x}", self.params, spec, base, meta)
+        # training metrics: metalearner predictions over the level-one frame
+        out = model._predict_matrix(spec.X)
+        model.training_metrics = compute_metrics(
+            out, spec.y, spec.w, spec.nclasses, spec.response_domain)
+        if valid_spec is not None:
+            vout = model._predict_matrix(valid_spec.X)
+            model.validation_metrics = compute_metrics(
+                vout, valid_spec.y, valid_spec.w, spec.nclasses,
+                spec.response_domain)
+        return model
+
+
+register_model_class("stackedensemble", StackedEnsembleModel)

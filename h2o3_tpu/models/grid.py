@@ -1,0 +1,135 @@
+"""Grid search — hyperparameter space walkers over any ModelBuilder.
+
+Reference: hex/grid/GridSearch.java (job orchestration, parallelism),
+hex/grid/HyperSpaceWalker.java:409 (CartesianWalker), :511
+(RandomDiscreteValueWalker: seeded sampling, max_models /
+max_runtime_secs budgets), hex/leaderboard/Leaderboard.java (metric
+ranking).
+
+TPU re-design: grid points build sequentially on the controller (each
+model already saturates the chip — the reference's `parallelism` knob
+multiplexes JVM threads over CPU cores, which has no analog when one
+model owns the MXU); the walker/budget/leaderboard logic is pure
+orchestration, kept shape-compatible with h2o-py's H2OGridSearch."""
+from __future__ import annotations
+
+import itertools
+import random
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from h2o3_tpu import dkv
+
+_LESS_IS_BETTER = {"logloss", "mse", "rmse", "mae", "rmsle",
+                   "mean_residual_deviance", "deviance", "error",
+                   "mean_per_class_error"}
+
+
+def _metric_of(model, name: str):
+    m = model.training_metrics
+    if model.cross_validation_metrics is not None:
+        m = model.cross_validation_metrics
+    elif model.validation_metrics is not None:
+        m = model.validation_metrics
+    return getattr(m, name, None)
+
+
+def _default_metric(model) -> str:
+    if model.nclasses == 2:
+        return "auc"
+    if model.nclasses > 2:
+        return "logloss"
+    return "mse"
+
+
+class H2OGridSearch:
+    """h2o-py H2OGridSearch shape: walk hyper_params over a builder."""
+
+    def __init__(self, model, hyper_params: Dict[str, Sequence],
+                 grid_id: Optional[str] = None,
+                 search_criteria: Optional[Dict] = None):
+        self.model_template = model
+        self.hyper_params = {k: list(v) for k, v in hyper_params.items()}
+        self.grid_id = grid_id or dkv.unique_key("grid")
+        self.search_criteria = dict(search_criteria or {})
+        self.models: List = []
+        self.failures: List[Dict] = []
+
+    # -- walkers (HyperSpaceWalker.java:409 / :511) ---------------------
+
+    def _combos(self):
+        keys = list(self.hyper_params)
+        spaces = [self.hyper_params[k] for k in keys]
+        strategy = (self.search_criteria.get("strategy")
+                    or "Cartesian").lower()
+        all_pts = [dict(zip(keys, vals))
+                   for vals in itertools.product(*spaces)]
+        if strategy in ("cartesian",):
+            return all_pts
+        if strategy in ("randomdiscrete", "random_discrete"):
+            seed = self.search_criteria.get("seed", -1)
+            rng = random.Random(None if seed in (-1, None) else seed)
+            rng.shuffle(all_pts)
+            return all_pts
+        raise ValueError(f"unknown search strategy '{strategy}'")
+
+    def train(self, x=None, y=None, training_frame=None,
+              validation_frame=None, **train_kw):
+        max_models = int(self.search_criteria.get("max_models", 0) or 0)
+        max_secs = float(self.search_criteria.get("max_runtime_secs", 0)
+                         or 0)
+        t0 = time.time()
+        base_params = dict(self.model_template.params)
+        cls = type(self.model_template)
+        for i, combo in enumerate(self._combos()):
+            if max_models and len(self.models) >= max_models:
+                break
+            if max_secs and time.time() - t0 > max_secs:
+                break
+            params = dict(base_params)
+            params.update(combo)
+            est = cls(**params)
+            try:
+                est.train(x=x, y=y, training_frame=training_frame,
+                          validation_frame=validation_frame, **train_kw)
+                model = est.model
+                model.key = f"{self.grid_id}_model_{i}"
+                model.output["grid_hyper_params"] = combo
+                dkv.put(model.key, "model", model)
+                self.models.append(model)
+            except Exception as e:  # noqa: BLE001 — grid keeps walking
+                self.failures.append({"params": combo, "error": str(e)})
+        dkv.put(self.grid_id, "grid", self)
+        return self
+
+    # -- leaderboard (hex/leaderboard/Leaderboard.java) ------------------
+
+    def get_grid(self, sort_by: Optional[str] = None,
+                 decreasing: Optional[bool] = None) -> "H2OGridSearch":
+        if not self.models:
+            return self
+        metric = sort_by or _default_metric(self.models[0])
+        if decreasing is None:
+            decreasing = metric not in _LESS_IS_BETTER
+        self.models.sort(
+            key=lambda m: (_metric_of(m, metric) is None,
+                           _metric_of(m, metric) or 0.0),
+            reverse=decreasing)
+        return self
+
+    @property
+    def model_ids(self) -> List[str]:
+        return [m.key for m in self.models]
+
+    def leaderboard(self, sort_by: Optional[str] = None) -> List[Dict]:
+        self.get_grid(sort_by)
+        metric = sort_by or _default_metric(self.models[0])
+        return [{"model_id": m.key, metric: _metric_of(m, metric),
+                 **m.output.get("grid_hyper_params", {})}
+                for m in self.models]
+
+    def __getitem__(self, i):
+        return self.models[i]
+
+    def __len__(self):
+        return len(self.models)

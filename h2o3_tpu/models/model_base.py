@@ -482,26 +482,35 @@ class ModelBuilder:
         if training_frame is None or (y is None and self.supervised):
             raise ValueError("train() needs training_frame"
                              + (" and y" if self.supervised else ""))
+        from h2o3_tpu.log import Profile, info
         t0 = time.time()
-        spec = self._make_spec(training_frame, y, x)
-        valid_spec = None
-        if validation_frame is not None:
-            # ADAPT the validation frame to the training spec (domain
-            # remap) rather than building a fresh spec from its own domains
-            valid_spec = build_validation_spec(
-                validation_frame, spec,
-                weights_column=self.params.get("weights_column"),
-                offset_column=self.params.get("offset_column"))
+        prof = Profile()
+        with prof.phase("spec"):
+            spec = self._make_spec(training_frame, y, x)
+            valid_spec = None
+            if validation_frame is not None:
+                # ADAPT the validation frame to the training spec (domain
+                # remap), not a fresh spec from its own domains
+                valid_spec = build_validation_spec(
+                    validation_frame, spec,
+                    weights_column=self.params.get("weights_column"),
+                    offset_column=self.params.get("offset_column"))
         job = Job(f"{self.algo} training", work=1.0)
+        info("%s train start: %d rows, %d features", self.algo, spec.nrow,
+             spec.n_features)
 
         def body(job):
             nfolds = int(self.params.get("nfolds", 0) or 0)
             fold_column = self.params.get("fold_column")
-            model = self._train_impl(spec, valid_spec, job)
+            with prof.phase("train"):
+                model = self._train_impl(spec, valid_spec, job)
             model.run_time = time.time() - t0
             if nfolds > 1 or fold_column:
-                self._cross_validate(model, training_frame, y, x, spec, job,
-                                     nfolds, fold_column)
+                with prof.phase("cv"):
+                    self._cross_validate(model, training_frame, y, x, spec,
+                                         job, nfolds, fold_column)
+            model.output["profile"] = prof.to_dict()
+            info("%s train done: %s", self.algo, prof.summary())
             return model
 
         job.run(body, background=background)

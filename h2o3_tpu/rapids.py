@@ -53,7 +53,11 @@ class Slice:
         self.step = int(step)
 
     def resolve(self, n: int) -> np.ndarray:
-        count = n - self.start if math.isnan(self.count) else int(self.count)
+        if math.isnan(self.count):
+            # open-ended: count is in ELEMENTS, not rows spanned
+            count = -(-(n - self.start) // self.step)
+        else:
+            count = int(self.count)
         return np.arange(self.start, self.start + count * self.step,
                          self.step)
 
@@ -311,12 +315,8 @@ def merge(left: Frame, right: Frame, by_left: Sequence[str],
 
 
 def _coalesce_vec(primary: Vec, fallback: Vec, use_fallback: np.ndarray) -> Vec:
-    if primary.type == T_ENUM or fallback.type == T_ENUM:
-        a = np.asarray(primary.to_strings()[: primary.nrow], dtype=object)
-        b = np.asarray(fallback.to_strings()[: fallback.nrow], dtype=object)
-        out = np.where(use_fallback, b, a)
-        return Vec.from_numpy(out)
-    if primary.type == T_STR:
+    label_like = (T_ENUM, T_STR)
+    if primary.type in label_like or fallback.type in label_like:
         a = np.asarray(primary.to_strings()[: primary.nrow], dtype=object)
         b = np.asarray(fallback.to_strings()[: fallback.nrow], dtype=object)
         return Vec.from_numpy(np.where(use_fallback, b, a))
@@ -544,9 +544,14 @@ def _apply(op: str, args, env: Env):
     if op == "unique":
         fr = ev(0)
         nrow = fr.nrow
-        vals = np.unique(np.asarray(fr.vec(0).to_numpy()[:nrow]))
-        return Frame([fr.names[0]],
-                     [Vec.from_numpy(vals.astype(np.float32))])
+        v = fr.vec(0)
+        if v.type in (T_ENUM, T_STR):
+            labs = [s for s in v.to_strings()[:nrow] if s is not None]
+            vals = np.unique(np.asarray(labs, dtype=object))
+            return Frame([fr.names[0]], [Vec.from_numpy(vals)])
+        vals = np.unique(np.asarray(v.to_numpy()[:nrow], dtype=np.float64))
+        vals = vals[np.isfinite(vals)]
+        return Frame([fr.names[0]], [Vec.from_numpy(vals)])
     if op == "colnames=":
         fr = ev(0)
         sel = ev(1)

@@ -1,0 +1,96 @@
+"""Grid search + StackedEnsemble tests (VERDICT r3 task #9 done-criteria:
+grid over GBM depth/lr with leaderboard-ordered results; SE beats its
+best base model on a golden task)."""
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu import dkv
+from h2o3_tpu.models.drf import H2ORandomForestEstimator
+from h2o3_tpu.models.ensemble import H2OStackedEnsembleEstimator
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+from h2o3_tpu.models.grid import H2OGridSearch
+
+
+def _task(n=2500, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    logit = (1.5 * X[:, 0] - X[:, 1] + 0.8 * X[:, 2] * X[:, 3]
+             + 0.4 * np.sin(2 * X[:, 4]))
+    yv = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(int)
+    cols = {f"x{i}": X[:, i] for i in range(5)}
+    cols["y"] = np.array(["n", "p"], dtype=object)[yv]
+    return h2o.Frame.from_numpy(cols)
+
+
+def test_grid_cartesian_leaderboard():
+    fr = _task(n=1500)
+    grid = H2OGridSearch(
+        H2OGradientBoostingEstimator(ntrees=15, seed=1),
+        hyper_params={"max_depth": [2, 4], "learn_rate": [0.05, 0.3]})
+    grid.train(y="y", training_frame=fr)
+    assert len(grid.models) == 4
+    grid.get_grid(sort_by="auc")
+    aucs = [m.training_metrics.auc for m in grid.models]
+    assert aucs == sorted(aucs, reverse=True)
+    lb = grid.leaderboard("auc")
+    assert lb[0]["auc"] >= lb[-1]["auc"]
+    assert "max_depth" in lb[0] and "learn_rate" in lb[0]
+    # models addressable via the store
+    assert dkv.get(grid.model_ids[0], "model") is grid.models[0]
+
+
+def test_grid_random_discrete_budget():
+    fr = _task(n=1000, seed=3)
+    grid = H2OGridSearch(
+        H2OGradientBoostingEstimator(ntrees=5, seed=1),
+        hyper_params={"max_depth": [2, 3, 4, 5], "learn_rate": [0.1, 0.2,
+                                                               0.3]},
+        search_criteria={"strategy": "RandomDiscrete", "max_models": 3,
+                         "seed": 42})
+    grid.train(y="y", training_frame=fr)
+    assert len(grid.models) == 3
+
+
+def test_grid_survives_failures():
+    fr = _task(n=600, seed=5)
+    grid = H2OGridSearch(
+        H2OGradientBoostingEstimator(ntrees=3, seed=1),
+        hyper_params={"max_depth": [3], "distribution": ["bernoulli",
+                                                         "not_a_dist"]})
+    grid.train(y="y", training_frame=fr)
+    assert len(grid.models) == 1
+    assert len(grid.failures) == 1
+
+
+def test_stacked_ensemble_beats_best_base():
+    fr = _task(n=3000, seed=7)
+    gbm = H2OGradientBoostingEstimator(ntrees=25, max_depth=3, nfolds=3,
+                                       seed=1)
+    gbm.train(y="y", training_frame=fr)
+    drf = H2ORandomForestEstimator(ntrees=25, max_depth=6, nfolds=3, seed=1)
+    drf.train(y="y", training_frame=fr)
+    se = H2OStackedEnsembleEstimator(base_models=[gbm.model, drf.model])
+    se.train(y="y", training_frame=fr)
+    se_auc = se.model.training_metrics.auc
+    base_best = max(gbm.model.cross_validation_metrics.auc,
+                    drf.model.cross_validation_metrics.auc)
+    # SE should at least match the best base's CV AUC on this task
+    assert se_auc >= base_best - 0.01, (se_auc, base_best)
+    # scoring chain works on a fresh frame
+    te = _task(n=500, seed=11)
+    pred = se.model.predict(te)
+    assert pred.names == ["predict", "pn", "pp"]
+    probs = pred.vec("pp").to_numpy()
+    assert np.all((probs >= 0) & (probs <= 1))
+
+
+def test_stacked_ensemble_requires_cv():
+    fr = _task(n=600, seed=9)
+    g1 = H2OGradientBoostingEstimator(ntrees=3, seed=1)
+    g1.train(y="y", training_frame=fr)
+    g2 = H2OGradientBoostingEstimator(ntrees=3, seed=2)
+    g2.train(y="y", training_frame=fr)
+    se = H2OStackedEnsembleEstimator(base_models=[g1.model, g2.model])
+    with pytest.raises(RuntimeError, match="holdout"):
+        se.train(y="y", training_frame=fr)
