@@ -1,0 +1,248 @@
+"""AutoML — budgeted model-and-ensemble search over the builder zoo.
+
+Reference: h2o-automl/src/main/java/ai/h2o/automl/AutoML.java:49 (driver
+loop, work planning :420, execution plan :403), ModelingStepsRegistry /
+ModelingStep (the pluggable step SPI), the default plan in
+modeling/{XGBoost,GBM,GLM,DRF,DeepLearning,StackedEnsemble}StepsProvider
+(XGB defaults + grids, GBM defaults + grids, DRF + XRT, GLM, DL grids,
+two stacked ensembles: best-of-family and all), leaderboard ranked by CV
+metric, events/EventLog.java (audit trail).
+
+TPU re-design: pure orchestration over the existing estimators — each
+step trains with nfolds CV (holdouts kept for the ensembles) on the
+chip; budgets (max_models / max_runtime_secs) gate between steps exactly
+like WorkAllocations. The step plan mirrors the reference's default
+sequence at reduced grid sizes (each model saturates the chip, so fewer,
+better-budgeted points beat the reference's thread-parallel sprawl)."""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from h2o3_tpu import dkv
+from h2o3_tpu.log import info
+
+_LESS_IS_BETTER = {"logloss", "mse", "rmse", "mae", "rmsle",
+                   "mean_residual_deviance", "deviance", "error",
+                   "mean_per_class_error"}
+
+
+def _default_steps(nclasses: int) -> List[Dict]:
+    """The reference's default execution plan (StepDefinition defaults),
+    sized for sequential single-chip execution."""
+    clf = nclasses > 1
+    steps: List[Dict] = [
+        {"algo": "xgboost", "id": "XGBoost_def_1",
+         "params": {"ntrees": 50, "max_depth": 8, "eta": 0.3,
+                    "subsample": 0.8, "colsample_bytree": 0.8}},
+        {"algo": "gbm", "id": "GBM_def_1",
+         "params": {"ntrees": 50, "max_depth": 6, "learn_rate": 0.1,
+                    "sample_rate": 0.8, "col_sample_rate": 0.8}},
+        {"algo": "gbm", "id": "GBM_def_2",
+         "params": {"ntrees": 50, "max_depth": 3, "learn_rate": 0.1}},
+        {"algo": "drf", "id": "DRF_def_1",
+         "params": {"ntrees": 50, "max_depth": 10}},
+        {"algo": "glm", "id": "GLM_def_1",
+         "params": ({"family": "binomial"} if nclasses == 2 else {})
+         | {"alpha": 0.5, "lambda_search": True, "nlambdas": 10}},
+        {"algo": "drf", "id": "XRT_def_1",           # extremely-random analog
+         "params": {"ntrees": 50, "max_depth": 10, "mtries": 1}},
+        {"algo": "deeplearning", "id": "DL_def_1",
+         "params": {"hidden": [64, 64], "epochs": 15}},
+        {"algo": "gbm", "id": "GBM_grid_1",
+         "grid": {"max_depth": [4, 8], "learn_rate": [0.05, 0.2]},
+         "params": {"ntrees": 40}},
+    ]
+    if nclasses > 2:
+        # GLM/SE multinomial pending — drop them from the plan
+        steps = [s for s in steps if s["algo"] != "glm"]
+    return steps
+
+
+class H2OAutoML:
+    """h2o-py H2OAutoML surface: train(...) then .leaderboard / .leader."""
+
+    def __init__(self, max_models: Optional[int] = None,
+                 max_runtime_secs: Optional[float] = None,
+                 max_runtime_secs_per_model: Optional[float] = None,
+                 nfolds: int = 3, seed: int = -1,
+                 sort_metric: Optional[str] = None,
+                 include_algos: Optional[Sequence[str]] = None,
+                 exclude_algos: Optional[Sequence[str]] = None,
+                 project_name: Optional[str] = None, **_ignored):
+        if not max_models and not max_runtime_secs:
+            max_runtime_secs = 3600.0
+        self.max_models = max_models
+        self.max_runtime_secs = max_runtime_secs
+        self.nfolds = int(nfolds)
+        self.seed = seed
+        self.sort_metric = sort_metric
+        self.include_algos = ([a.lower() for a in include_algos]
+                              if include_algos else None)
+        self.exclude_algos = ([a.lower() for a in exclude_algos]
+                              if exclude_algos else None)
+        self.project_name = project_name or dkv.unique_key("automl")
+        self.models: List = []
+        self.event_log: List[Dict] = []
+        self._leader = None
+
+    # -- events (ai/h2o/automl/events/EventLog.java) --------------------
+
+    def _log(self, stage: str, msg: str):
+        self.event_log.append({"timestamp": time.time(), "stage": stage,
+                               "message": msg})
+        info("automl[%s] %s: %s", self.project_name, stage, msg)
+
+    def _algo_allowed(self, algo: str) -> bool:
+        if self.include_algos is not None:
+            return (algo in self.include_algos
+                    or (algo == "drf" and "xrt" in self.include_algos))
+        if self.exclude_algos is not None:
+            return algo not in self.exclude_algos
+        return True
+
+    def _budget_left(self, t0: float) -> bool:
+        if self.max_models and len(self.models) >= self.max_models:
+            return False
+        if self.max_runtime_secs and time.time() - t0 > self.max_runtime_secs:
+            return False
+        return True
+
+    # -- driver (AutoML.java:403-457 plan execution) --------------------
+
+    def train(self, x=None, y=None, training_frame=None,
+              validation_frame=None, leaderboard_frame=None):
+        from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
+        from h2o3_tpu.models.drf import H2ORandomForestEstimator
+        from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+        from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+        from h2o3_tpu.models.grid import H2OGridSearch
+        from h2o3_tpu.models.xgboost import H2OXGBoostEstimator
+        builders = {"xgboost": H2OXGBoostEstimator,
+                    "gbm": H2OGradientBoostingEstimator,
+                    "drf": H2ORandomForestEstimator,
+                    "glm": H2OGeneralizedLinearEstimator,
+                    "deeplearning": H2ODeepLearningEstimator}
+        rvec = training_frame.vec(y)
+        nclasses = rvec.cardinality if rvec.type == "enum" else 1
+        t0 = time.time()
+        self._log("init", f"AutoML build started: y={y}, "
+                          f"nfolds={self.nfolds}")
+        for step in _default_steps(nclasses):
+            if not self._budget_left(t0):
+                self._log("budget", "model/time budget exhausted")
+                break
+            algo = step["algo"]
+            if not self._algo_allowed(algo):
+                continue
+            params = dict(step.get("params") or {})
+            params.setdefault("seed", self.seed)
+            params["nfolds"] = self.nfolds
+            try:
+                if "grid" in step:
+                    grid = H2OGridSearch(
+                        builders[algo](**params), step["grid"],
+                        search_criteria={
+                            "strategy": "RandomDiscrete",
+                            "max_models": (self.max_models
+                                           - len(self.models)
+                                           if self.max_models else 0),
+                            "max_runtime_secs": (
+                                self.max_runtime_secs
+                                - (time.time() - t0)
+                                if self.max_runtime_secs else 0),
+                            "seed": self.seed})
+                    grid.train(x=x, y=y, training_frame=training_frame,
+                               validation_frame=validation_frame)
+                    for m in grid.models:
+                        self._register(m, f"{step['id']}_{len(self.models)}")
+                else:
+                    est = builders[algo](**params)
+                    est.train(x=x, y=y, training_frame=training_frame,
+                              validation_frame=validation_frame)
+                    self._register(est.model, step["id"])
+                self._log("model", f"built {step['id']}")
+            except Exception as e:  # noqa: BLE001 — plan keeps going
+                self._log("skip", f"{step['id']} failed: {e}")
+        # stacked ensembles (best-of-family + all), binomial/regression
+        if nclasses <= 2 and len(self.models) >= 2:
+            self._build_ensembles(x, y, training_frame)
+        self._rank()
+        self._log("done", f"AutoML build done: {len(self.models)} models, "
+                          f"leader={self.leader.key if self.leader else None}")
+        return self
+
+    def _register(self, model, step_id: str):
+        model.key = f"{self.project_name}_{step_id}"
+        model.output["automl_step"] = step_id
+        dkv.put(model.key, "model", model)
+        self.models.append(model)
+
+    def _build_ensembles(self, x, y, training_frame):
+        from h2o3_tpu.models.ensemble import H2OStackedEnsembleEstimator
+        with_cv = [m for m in self.models
+                   if m.output.get("cross_validation_holdout_predictions")
+                   is not None]
+        if len(with_cv) < 2:
+            return
+        self._rank()
+        best_of_family: List = []
+        seen = set()
+        for m in self.models:
+            if m in with_cv and m.algo not in seen:
+                best_of_family.append(m)
+                seen.add(m.algo)
+        for name, base in (("BestOfFamily", best_of_family), ("AllModels",
+                                                              with_cv)):
+            if len(base) < 2:
+                continue
+            try:
+                se = H2OStackedEnsembleEstimator(base_models=base)
+                se.train(x=x, y=y, training_frame=training_frame)
+                self._register(se.model, f"StackedEnsemble_{name}")
+                self._log("ensemble", f"built StackedEnsemble_{name} over "
+                                      f"{len(base)} base models")
+            except Exception as e:  # noqa: BLE001
+                self._log("skip", f"StackedEnsemble_{name} failed: {e}")
+
+    # -- leaderboard ----------------------------------------------------
+
+    def _metric_name(self) -> str:
+        if self.sort_metric:
+            return self.sort_metric.lower()
+        m = self.models[0]
+        if m.nclasses == 2:
+            return "auc"
+        if m.nclasses > 2:
+            return "logloss"
+        return "mean_residual_deviance"
+
+    def _metric_of(self, model, name):
+        m = (model.cross_validation_metrics or model.validation_metrics
+             or model.training_metrics)
+        return getattr(m, name, None)
+
+    def _rank(self):
+        if not self.models:
+            return
+        metric = self._metric_name()
+        rev = metric not in _LESS_IS_BETTER
+        self.models.sort(key=lambda m: (self._metric_of(m, metric) is None,
+                                        self._metric_of(m, metric) or 0.0),
+                         reverse=rev)
+        self._leader = self.models[0] if self.models else None
+
+    @property
+    def leader(self):
+        return self._leader
+
+    @property
+    def leaderboard(self) -> List[Dict]:
+        metric = self._metric_name()
+        return [{"model_id": m.key, metric: self._metric_of(m, metric)}
+                for m in self.models]
+
+    def predict(self, frame):
+        return self.leader.predict(frame)

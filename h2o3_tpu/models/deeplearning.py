@@ -44,6 +44,11 @@ DL_DEFAULTS: Dict = dict(
     input_dropout_ratio=0.0, hidden_dropout_ratios=None,
     l1=0.0, l2=0.0, max_w2=1e30,
     loss="auto", distribution="auto", standardize=True,
+    # per-epoch reshuffling costs a full gather of the design matrix each
+    # epoch; the reference's Hogwild pass doesn't shuffle at all
+    # (DeepLearningTask streams rows in storage order), so default to one
+    # up-front permutation
+    shuffle_training_data=False,
     # TPU batch size: the reference's mini_batch_size default 1 feeds the
     # per-row Hogwild loop; a batched MXU step wants hundreds of rows
     mini_batch_size=256,
@@ -103,6 +108,105 @@ def _loss_fn(out, y, w, task, dist_name):
     else:  # gaussian
         per = 0.5 * (mu - y) ** 2
     return (w * per).sum() / jnp.maximum(w.sum(), 1e-12)
+
+
+def _init_opt(net, adaptive: bool):
+    def zeros_like_params(params):
+        return [{k: jnp.zeros_like(v) for k, v in layer.items()}
+                for layer in params]
+    return ((zeros_like_params(net), zeros_like_params(net)) if adaptive
+            else (zeros_like_params(net),))
+
+
+from functools import lru_cache  # noqa: E402
+
+
+@lru_cache(maxsize=64)
+def _compiled_epoch(sizes, act_name, task, dist_name, l1, l2, in_drop,
+                    hid_drops, use_dropout, adaptive, rho, eps, rate0,
+                    annealing, mom_start, mom_ramp, mom_stable, batch,
+                    n_batches, use_rows, padded, shuffle):
+    """Build + cache the jitted epoch for a static config. Data rides as
+    ARGUMENTS: a closure over the design matrix bakes it into the program
+    as a constant (~90s XLA compile at MNIST shape), and a fresh closure
+    per estimator re-pays the compile every train."""
+    act = _ACTS[act_name]
+
+    def loss(params, xb, yb, wb, dkey):
+        out = _forward(params, xb, act,
+                       drop_key=dkey if use_dropout else None,
+                       in_drop=in_drop, hid_drops=list(hid_drops))
+        l = _loss_fn(out, yb, wb, task, dist_name)
+        if l2 > 0:
+            l = l + l2 * sum((layer["W"] ** 2).sum() for layer in params)
+        if l1 > 0:
+            l = l + l1 * sum(jnp.abs(layer["W"]).sum() for layer in params)
+        return l
+
+    grad_fn = jax.value_and_grad(loss)
+
+    def sgd_update(params, opt, grads, samples):
+        if adaptive:
+            # ADADELTA (hex/deeplearning adaptive_rate default)
+            Eg, Ed = opt
+            new_p, nEg, nEd = [], [], []
+            for layer, g, eg, ed in zip(params, grads, Eg, Ed):
+                upd, neg, ned = {}, {}, {}
+                for k in ("W", "b"):
+                    eg2 = rho * eg[k] + (1 - rho) * g[k] ** 2
+                    delta = (-jnp.sqrt(ed[k] + eps)
+                             / jnp.sqrt(eg2 + eps) * g[k])
+                    ned[k] = rho * ed[k] + (1 - rho) * delta ** 2
+                    neg[k] = eg2
+                    upd[k] = layer[k] + delta
+                new_p.append(upd)
+                nEg.append(neg)
+                nEd.append(ned)
+            return new_p, (nEg, nEd)
+        # momentum SGD with annealing + ramp
+        vel, = opt
+        lr = rate0 / (1.0 + annealing * samples)
+        mom = jnp.where(samples < mom_ramp,
+                        mom_start + (mom_stable - mom_start)
+                        * samples / mom_ramp, mom_stable)
+        new_p, nv = [], []
+        for layer, g, v in zip(params, grads, vel):
+            upd, uv = {}, {}
+            for k in ("W", "b"):
+                uv[k] = mom * v[k] - lr * g[k]
+                upd[k] = layer[k] + uv[k]
+            new_p.append(upd)
+            nv.append(uv)
+        return new_p, (nv,)
+
+    @jax.jit
+    def run_epoch(params, opt, samples, ekey, Xs, y, w):
+        pkey, dkey = jax.random.split(ekey)
+        if shuffle:
+            perm = jax.random.permutation(pkey, padded)
+            Xp = Xs[perm][:use_rows]
+            yp = y[perm][:use_rows]
+            wp = w[perm][:use_rows]
+        else:
+            Xp = Xs[:use_rows]
+            yp = y[:use_rows]
+            wp = w[:use_rows]
+
+        def one_batch(carry, i):
+            params, opt, samples = carry
+            xb = jax.lax.dynamic_slice_in_dim(Xp, i * batch, batch)
+            yb = jax.lax.dynamic_slice_in_dim(yp, i * batch, batch)
+            wb = jax.lax.dynamic_slice_in_dim(wp, i * batch, batch)
+            bkey = jax.random.fold_in(dkey, i)
+            l, grads = grad_fn(params, xb, yb, wb, bkey)
+            params, opt = sgd_update(params, opt, grads, samples)
+            return (params, opt, samples + batch), l
+
+        (params, opt, samples), losses = jax.lax.scan(
+            one_batch, (params, opt, samples), jnp.arange(n_batches))
+        return params, opt, samples, losses.mean()
+
+    return run_epoch
 
 
 class DeepLearningModel(Model):
@@ -242,83 +346,20 @@ class H2ODeepLearningEstimator(ModelBuilder):
         hid_drops = [float(d) for d in hid_drops]
         use_dropout = in_drop > 0 or any(d > 0 for d in hid_drops)
 
-        def loss(params, xb, yb, wb, dkey):
-            out = _forward(params, xb, act,
-                           drop_key=dkey if use_dropout else None,
-                           in_drop=in_drop, hid_drops=hid_drops)
-            l = _loss_fn(out, yb, wb, task, dist_name)
-            if l2 > 0:
-                l = l + l2 * sum((layer["W"] ** 2).sum() for layer in params)
-            if l1 > 0:
-                l = l + l1 * sum(jnp.abs(layer["W"]).sum()
-                                 for layer in params)
-            return l
+        opt0 = _init_opt(net, adaptive)
+        shuffle = bool(p.get("shuffle_training_data", False))
+        run_epoch = _compiled_epoch(
+            tuple(sizes), act_name, task, dist_name, l1, l2, in_drop,
+            tuple(hid_drops), use_dropout, adaptive, rho, eps, rate0,
+            annealing, mom_start, mom_ramp, mom_stable, batch, n_batches,
+            use_rows, padded, shuffle)
 
-        grad_fn = jax.value_and_grad(loss)
-
-        def sgd_update(params, opt, grads, samples):
-            if adaptive:
-                # ADADELTA (hex/deeplearning adaptive_rate default)
-                Eg, Ed = opt
-                new_p, nEg, nEd = [], [], []
-                for layer, g, eg, ed in zip(params, grads, Eg, Ed):
-                    upd = {}
-                    neg, ned = {}, {}
-                    for k in ("W", "b"):
-                        eg2 = rho * eg[k] + (1 - rho) * g[k] ** 2
-                        delta = -jnp.sqrt(ed[k] + eps) / jnp.sqrt(eg2 + eps) * g[k]
-                        ned[k] = rho * ed[k] + (1 - rho) * delta ** 2
-                        neg[k] = eg2
-                        upd[k] = layer[k] + delta
-                    new_p.append(upd)
-                    nEg.append(neg)
-                    nEd.append(ned)
-                return new_p, (nEg, nEd)
-            # momentum SGD with annealing + ramp
-            vel, = opt
-            lr = rate0 / (1.0 + annealing * samples)
-            mom = jnp.where(samples < mom_ramp,
-                            mom_start + (mom_stable - mom_start)
-                            * samples / mom_ramp, mom_stable)
-            new_p, nv = [], []
-            for layer, g, v in zip(params, grads, vel):
-                upd, uv = {}, {}
-                for k in ("W", "b"):
-                    uv[k] = mom * v[k] - lr * g[k]
-                    upd[k] = layer[k] + uv[k]
-                new_p.append(upd)
-                nv.append(uv)
-            return new_p, (nv,)
-
-        def zeros_like_params(params):
-            return [{k: jnp.zeros_like(v) for k, v in layer.items()}
-                    for layer in params]
-
-        opt0 = ((zeros_like_params(net), zeros_like_params(net))
-                if adaptive else (zeros_like_params(net),))
-
-        @jax.jit
-        def run_epoch(params, opt, samples, ekey):
-            pkey, dkey = jax.random.split(ekey)
-            perm = jax.random.permutation(pkey, padded)
-            Xp = Xs[perm][:use_rows]
-            yp = y[perm][:use_rows]
-            wp = w[perm][:use_rows]
-
-            def one_batch(carry, i):
-                params, opt, samples = carry
-                xb = jax.lax.dynamic_slice_in_dim(Xp, i * batch, batch)
-                yb = jax.lax.dynamic_slice_in_dim(yp, i * batch, batch)
-                wb = jax.lax.dynamic_slice_in_dim(wp, i * batch, batch)
-                bkey = jax.random.fold_in(dkey, i)
-                l, grads = grad_fn(params, xb, yb, wb, bkey)
-                params, opt = sgd_update(params, opt, grads, samples)
-                return (params, opt, samples + batch), l
-
-            (params, opt, samples), losses = jax.lax.scan(
-                one_batch, (params, opt, samples), jnp.arange(n_batches))
-            return params, opt, samples, losses.mean()
-
+        if not shuffle:
+            key, pk = jax.random.split(key)
+            perm0 = jax.random.permutation(pk, padded)
+            Xs = Xs[perm0]
+            y = y[perm0]
+            w = w[perm0]
         keeper = ScoreKeeper(p.get("stopping_rounds", 0),
                              p.get("stopping_metric"),
                              p.get("stopping_tolerance", 1e-3),
@@ -331,7 +372,8 @@ class H2ODeepLearningEstimator(ModelBuilder):
         history = []
         for e in range(n_epochs):
             key, ekey = jax.random.split(key)
-            net, opt0, samples, mloss = run_epoch(net, opt0, samples, ekey)
+            net, opt0, samples, mloss = run_epoch(net, opt0, samples, ekey,
+                                                  Xs, y, w)
             job.set_progress((e + 1) / n_epochs)
             if keeper.rounds > 0 or e == n_epochs - 1:
                 entry = self._score(net, act, Xs, y, w, valid_spec, task,

@@ -1,0 +1,59 @@
+"""AutoML tests — budgeted plan execution, leaderboard, ensembles
+(reference: ai/h2o/automl/AutoML.java driver + leaderboard)."""
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.automl import H2OAutoML
+
+
+def _task(n=1200, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    logit = 1.5 * X[:, 0] - X[:, 1] + 0.6 * X[:, 2] * X[:, 3]
+    yv = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(int)
+    cols = {f"x{i}": X[:, i] for i in range(4)}
+    cols["y"] = np.array(["n", "p"], dtype=object)[yv]
+    return h2o.Frame.from_numpy(cols)
+
+
+def test_automl_binomial_with_budget():
+    fr = _task()
+    aml = H2OAutoML(max_models=4, nfolds=2, seed=1,
+                    include_algos=["gbm", "glm", "drf"])
+    aml.train(y="y", training_frame=fr)
+    # base models capped at 4; ensembles added on top
+    base = [m for m in aml.models if m.algo != "stackedensemble"]
+    assert 1 <= len(base) <= 4
+    lb = aml.leaderboard
+    assert lb[0]["auc"] is not None
+    aucs = [e["auc"] for e in lb]
+    assert aucs == sorted(aucs, reverse=True)
+    assert aml.leader is aml.models[0]
+    assert aml.leader.training_metrics.auc > 0.7
+    # ensembles built when >= 2 CV base models exist
+    algos = {m.algo for m in aml.models}
+    assert "stackedensemble" in algos
+    # event log recorded the run
+    stages = {e["stage"] for e in aml.event_log}
+    assert "init" in stages and "done" in stages
+    # leader predicts
+    pred = aml.predict(fr)
+    assert pred.nrow == fr.nrow
+
+
+def test_automl_exclude_algos_and_regression():
+    rng = np.random.default_rng(3)
+    n = 800
+    x = rng.normal(size=n).astype(np.float32)
+    fr = h2o.Frame.from_numpy({
+        "x": x, "y": (2 * x + 0.1 * rng.normal(size=n)).astype(np.float32)})
+    aml = H2OAutoML(max_models=3, nfolds=2, seed=1,
+                    exclude_algos=["deeplearning", "xgboost"])
+    aml.train(y="y", training_frame=fr)
+    assert all(m.algo not in ("deeplearning", "xgboost")
+               for m in aml.models)
+    metric = aml._metric_name()
+    assert metric == "mean_residual_deviance"
+    vals = [e[metric] for e in aml.leaderboard]
+    assert vals == sorted(vals)   # less is better, ascending
