@@ -50,20 +50,17 @@ class ApiError(Exception):
 # ---------------- algo registry ---------------------------------------
 
 def _builders() -> Dict[str, Any]:
-    from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
-    from h2o3_tpu.models.drf import H2ORandomForestEstimator
-    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
-    from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
-    from h2o3_tpu.models.kmeans import H2OKMeansEstimator
-    from h2o3_tpu.models.pca import H2OPrincipalComponentAnalysisEstimator
-    from h2o3_tpu.models.xgboost import H2OXGBoostEstimator
-    return {"gbm": H2OGradientBoostingEstimator,
-            "drf": H2ORandomForestEstimator,
-            "glm": H2OGeneralizedLinearEstimator,
-            "deeplearning": H2ODeepLearningEstimator,
-            "kmeans": H2OKMeansEstimator,
-            "pca": H2OPrincipalComponentAnalysisEstimator,
-            "xgboost": H2OXGBoostEstimator}
+    from h2o3_tpu import estimators as est
+    return {"gbm": est.H2OGradientBoostingEstimator,
+            "drf": est.H2ORandomForestEstimator,
+            "glm": est.H2OGeneralizedLinearEstimator,
+            "deeplearning": est.H2ODeepLearningEstimator,
+            "kmeans": est.H2OKMeansEstimator,
+            "pca": est.H2OPrincipalComponentAnalysisEstimator,
+            "xgboost": est.H2OXGBoostEstimator,
+            "isolationforest": est.H2OIsolationForestEstimator,
+            "naivebayes": est.H2ONaiveBayesEstimator,
+            "stackedensemble": est.H2OStackedEnsembleEstimator}
 
 
 def _coerce(v: str) -> Any:

@@ -24,9 +24,7 @@ import numpy as np
 from h2o3_tpu import dkv
 from h2o3_tpu.log import info
 
-_LESS_IS_BETTER = {"logloss", "mse", "rmse", "mae", "rmsle",
-                   "mean_residual_deviance", "deviance", "error",
-                   "mean_per_class_error"}
+from h2o3_tpu.models.grid import _LESS_IS_BETTER, sort_models
 
 
 def _default_steps(nclasses: int) -> List[Dict]:
@@ -76,6 +74,7 @@ class H2OAutoML:
             max_runtime_secs = 3600.0
         self.max_models = max_models
         self.max_runtime_secs = max_runtime_secs
+        self.max_runtime_secs_per_model = max_runtime_secs_per_model
         self.nfolds = int(nfolds)
         self.seed = seed
         self.sort_metric = sort_metric
@@ -160,9 +159,9 @@ class H2OAutoML:
                         self._register(m, f"{step['id']}_{len(self.models)}")
                 else:
                     est = builders[algo](**params)
-                    est.train(x=x, y=y, training_frame=training_frame,
-                              validation_frame=validation_frame)
-                    self._register(est.model, step["id"])
+                    model = self._train_budgeted(
+                        est, x, y, training_frame, validation_frame)
+                    self._register(model, step["id"])
                 self._log("model", f"built {step['id']}")
             except Exception as e:  # noqa: BLE001 — plan keeps going
                 self._log("skip", f"{step['id']} failed: {e}")
@@ -174,9 +173,34 @@ class H2OAutoML:
                           f"leader={self.leader.key if self.leader else None}")
         return self
 
+    def _train_budgeted(self, est, x, y, training_frame, validation_frame):
+        """Train one step, cancelling at max_runtime_secs_per_model (the
+        WorkAllocations per-step budget)."""
+        cap = self.max_runtime_secs_per_model
+        if not cap:
+            est.train(x=x, y=y, training_frame=training_frame,
+                      validation_frame=validation_frame)
+            if est.job.status == "FAILED":
+                raise RuntimeError(est.job.exception)
+            return est.model
+        est.train(x=x, y=y, training_frame=training_frame,
+                  validation_frame=validation_frame, background=True)
+        t0 = time.time()
+        while est.job.status == "RUNNING":
+            if time.time() - t0 > cap:
+                est.job.cancel()
+            time.sleep(0.2)
+        model = est.job.join()
+        if est.job.status == "FAILED":
+            raise RuntimeError(est.job.exception)
+        return model
+
     def _register(self, model, step_id: str):
         model.key = f"{self.project_name}_{step_id}"
         model.output["automl_step"] = step_id
+        # family tag distinguishes xgboost from gbm (the XGBoost estimator
+        # produces a GBMModel whose .algo is 'gbm')
+        model.output["automl_family"] = step_id.split("_")[0].lower()
         dkv.put(model.key, "model", model)
         self.models.append(model)
 
@@ -191,9 +215,10 @@ class H2OAutoML:
         best_of_family: List = []
         seen = set()
         for m in self.models:
-            if m in with_cv and m.algo not in seen:
+            fam = m.output.get("automl_family", m.algo)
+            if m in with_cv and fam not in seen:
                 best_of_family.append(m)
-                seen.add(m.algo)
+                seen.add(fam)
         for name, base in (("BestOfFamily", best_of_family), ("AllModels",
                                                               with_cv)):
             if len(base) < 2:
@@ -212,6 +237,8 @@ class H2OAutoML:
     def _metric_name(self) -> str:
         if self.sort_metric:
             return self.sort_metric.lower()
+        if not self.models:
+            return "auc"
         m = self.models[0]
         if m.nclasses == 2:
             return "auc"
@@ -220,18 +247,14 @@ class H2OAutoML:
         return "mean_residual_deviance"
 
     def _metric_of(self, model, name):
-        m = (model.cross_validation_metrics or model.validation_metrics
-             or model.training_metrics)
-        return getattr(m, name, None)
+        from h2o3_tpu.models.grid import _metric_of
+        return _metric_of(model, name)
 
     def _rank(self):
         if not self.models:
             return
         metric = self._metric_name()
-        rev = metric not in _LESS_IS_BETTER
-        self.models.sort(key=lambda m: (self._metric_of(m, metric) is None,
-                                        self._metric_of(m, metric) or 0.0),
-                         reverse=rev)
+        sort_models(self.models, metric, metric not in _LESS_IS_BETTER)
         self._leader = self.models[0] if self.models else None
 
     @property
@@ -245,4 +268,7 @@ class H2OAutoML:
                 for m in self.models]
 
     def predict(self, frame):
+        if self.leader is None:
+            raise RuntimeError("AutoML built no models (all steps failed "
+                               "or were excluded) — see .event_log")
         return self.leader.predict(frame)

@@ -180,7 +180,7 @@ def _compiled_epoch(sizes, act_name, task, dist_name, l1, l2, in_drop,
         return new_p, (nv,)
 
     @jax.jit
-    def run_epoch(params, opt, samples, ekey, Xs, y, w):
+    def run_epoch(params, opt, samples, ekey, Xs, y, w, shift):
         pkey, dkey = jax.random.split(ekey)
         if shuffle:
             perm = jax.random.permutation(pkey, padded)
@@ -188,9 +188,12 @@ def _compiled_epoch(sizes, act_name, task, dist_name, l1, l2, in_drop,
             yp = y[perm][:use_rows]
             wp = w[perm][:use_rows]
         else:
-            Xp = Xs[:use_rows]
-            yp = y[:use_rows]
-            wp = w[:use_rows]
+            # rotate the start offset per epoch so the dropped tail
+            # (padded - use_rows rows) cycles instead of permanently
+            # excluding the same rows
+            Xp = jnp.roll(Xs, shift, axis=0)[:use_rows]
+            yp = jnp.roll(y, shift)[:use_rows]
+            wp = jnp.roll(w, shift)[:use_rows]
 
         def one_batch(carry, i):
             params, opt, samples = carry
@@ -372,8 +375,9 @@ class H2ODeepLearningEstimator(ModelBuilder):
         history = []
         for e in range(n_epochs):
             key, ekey = jax.random.split(key)
-            net, opt0, samples, mloss = run_epoch(net, opt0, samples, ekey,
-                                                  Xs, y, w)
+            net, opt0, samples, mloss = run_epoch(
+                net, opt0, samples, ekey, Xs, y, w,
+                jnp.int32((e * batch) % max(padded, 1)))
             job.set_progress((e + 1) / n_epochs)
             if keeper.rounds > 0 or e == n_epochs - 1:
                 entry = self._score(net, act, Xs, y, w, valid_spec, task,

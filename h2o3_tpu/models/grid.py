@@ -34,6 +34,17 @@ def _metric_of(model, name: str):
     return getattr(m, name, None)
 
 
+def sort_models(models, metric: str, decreasing: bool):
+    """None-metric models LAST regardless of direction (a reversed sort
+    would otherwise float them to the top for more-is-better metrics)."""
+    def key(m):
+        v = _metric_of(m, metric)
+        if v is None:
+            return (1, 0.0)
+        return (0, -v if decreasing else v)
+    models.sort(key=key)
+
+
 def _default_metric(model) -> str:
     if model.nclasses == 2:
         return "auc"
@@ -111,10 +122,7 @@ class H2OGridSearch:
         metric = sort_by or _default_metric(self.models[0])
         if decreasing is None:
             decreasing = metric not in _LESS_IS_BETTER
-        self.models.sort(
-            key=lambda m: (_metric_of(m, metric) is None,
-                           _metric_of(m, metric) or 0.0),
-            reverse=decreasing)
+        sort_models(self.models, metric, decreasing)
         return self
 
     @property

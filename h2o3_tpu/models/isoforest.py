@@ -1,0 +1,232 @@
+"""Isolation Forest — anomaly detection by random-split isolation trees.
+
+Reference: hex/tree/isofor/IsolationForest.java:33 — trees of RANDOM
+(feature, threshold) splits over per-tree row subsamples; anomaly score
+from the average path length normalized by c(n) = 2·H(n−1) − 2(n−1)/n
+(the expected BST path length).
+
+TPU re-design: no histograms at all — a level-synchronous build where
+each level draws a random feature and a random threshold uniformly
+inside each node's CURRENT value box (tracked exactly from the split
+points, like the adaptive GBM kernel's range narrowing), then routes
+rows with one gather. The whole forest builds inside one jitted scan;
+trees are complete binary arrays like the rest of the tree stack."""
+from __future__ import annotations
+
+import time
+from functools import lru_cache, partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.jobs import Job
+from h2o3_tpu.models.model_base import Model, ModelBuilder, TrainingSpec
+from h2o3_tpu.persist import register_model_class
+
+IF_DEFAULTS: Dict = dict(
+    ntrees=50, sample_size=256, max_depth=8, seed=-1,
+)
+
+
+def _avg_path(n):
+    """c(n): expected unsuccessful-search path length in a BST."""
+    n = jnp.maximum(n, 2.0)
+    H = jnp.log(n - 1.0) + 0.5772156649
+    return 2.0 * H - 2.0 * (n - 1.0) / n
+
+
+def _grow_random_tree(X, in_sample, lo0, hi0, depth, key):
+    """One isolation tree: returns (feat[M], thr[M], is_split[M]) with
+    M = 2^(depth+1) - 1 (splits at internal nodes only where >1 sampled
+    row remains)."""
+    rows, F = X.shape
+    M = 2 ** (depth + 1) - 1
+    feat = jnp.zeros(M, jnp.int32)
+    thr = jnp.zeros(M, jnp.float32)
+    is_split = jnp.zeros(M, bool)
+    nid = jnp.zeros(rows, jnp.int32)
+    lo = jnp.broadcast_to(lo0[None, :], (1, F))
+    hi = jnp.broadcast_to(hi0[None, :], (1, F))
+    for d in range(depth):
+        N = 2 ** d
+        base = N - 1
+        key, kf, kt = jax.random.split(key, 3)
+        f_sel = jax.random.randint(kf, (N,), 0, F)
+        u = jax.random.uniform(kt, (N,))
+        lo_f = jnp.take_along_axis(lo, f_sel[:, None], axis=1)[:, 0]
+        hi_f = jnp.take_along_axis(hi, f_sel[:, None], axis=1)[:, 0]
+        t_sel = lo_f + u * (hi_f - lo_f)
+        # only split nodes holding >= 2 sampled rows
+        local = nid - base
+        in_lvl = (local >= 0) & (local < N) & in_sample
+        lid = jnp.clip(local, 0, N - 1)
+        cnt = jnp.zeros(N, jnp.float32).at[lid].add(
+            jnp.where(in_lvl, 1.0, 0.0))
+        can = (cnt >= 2) & (hi_f > lo_f)
+        idx = base + jnp.arange(N)
+        feat = feat.at[idx].set(f_sel)
+        thr = thr.at[idx].set(t_sel)
+        is_split = is_split.at[idx].set(can)
+        # route
+        xf = jnp.take_along_axis(X, f_sel[lid][:, None], axis=1)[:, 0]
+        go_right = jnp.where(jnp.isnan(xf), False, xf >= t_sel[lid])
+        child = 2 * nid + 1 + go_right.astype(jnp.int32)
+        route = (local >= 0) & (local < N) & can[lid]
+        nid = jnp.where(route, child, nid)
+        # children boxes: split feature's range cut at the threshold
+        fsel_oh = (jnp.arange(F)[None, :] == f_sel[:, None])
+        lo_l, hi_l = lo, jnp.where(fsel_oh, jnp.minimum(t_sel[:, None], hi),
+                                   hi)
+        lo_r, hi_r = jnp.where(fsel_oh, jnp.maximum(t_sel[:, None], lo),
+                               lo), hi
+        lo = jnp.stack([lo_l, lo_r], axis=1).reshape(2 * N, F)
+        hi = jnp.stack([hi_l, hi_r], axis=1).reshape(2 * N, F)
+    return {"feat": feat, "thr": thr, "is_split": is_split}
+
+
+def _path_lengths(X, feat, thr, is_split, depth):
+    """Per-row path length through one tree (depth of the reached leaf)."""
+    rows = X.shape[0]
+    nid = jnp.zeros(rows, jnp.int32)
+    length = jnp.zeros(rows, jnp.float32)
+    for _ in range(depth):
+        f = feat[nid]
+        s = is_split[nid]
+        t = thr[nid]
+        xf = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
+        go_right = jnp.where(jnp.isnan(xf), False, xf >= t)
+        nid = jnp.where(s, 2 * nid + 1 + go_right.astype(jnp.int32), nid)
+        length = length + s.astype(jnp.float32)
+    return length
+
+
+class IsolationForestModel(Model):
+    algo = "isolationforest"
+    supervised = False
+
+    def __init__(self, key, params, spec, trees, depth, sample_size,
+                 min_len, max_len):
+        super().__init__(key, params, spec)
+        self._feat = jnp.asarray(trees["feat"])       # [T, M]
+        self._thr = jnp.asarray(trees["thr"])
+        self._is_split = jnp.asarray(trees["is_split"])
+        self.max_depth = depth
+        self.sample_size = sample_size
+        self.min_path_length = min_len
+        self.max_path_length = max_len
+
+    def _mean_length(self, X):
+        T = self._feat.shape[0]
+
+        def one(carry, t):
+            return carry, _path_lengths(X, self._feat[t], self._thr[t],
+                                        self._is_split[t], self.max_depth)
+
+        _, L = jax.lax.scan(one, None, jnp.arange(T))
+        return L.mean(axis=0)
+
+    def _predict_matrix(self, X, offset=None):
+        ml = self._mean_length(X)
+        # s(x) = 2^(-E[h(x)]/c(n)) — the standard isolation-forest score
+        # (outliers near 1); min/max path lengths stay in output for the
+        # reference's range-normalized variant
+        c = _avg_path(jnp.float32(self.sample_size))
+        return jnp.exp2(-ml / c)
+
+    def predict(self, frame):
+        from h2o3_tpu.frame.frame import Frame
+        from h2o3_tpu.frame.vec import Vec
+        from h2o3_tpu.models.model_base import adapt_test_matrix
+        X = adapt_test_matrix(self, frame)
+        score = np.asarray(jax.device_get(
+            self._predict_matrix(X)))[: frame.nrow]
+        ml = np.asarray(jax.device_get(self._mean_length(X)))[: frame.nrow]
+        return Frame(["predict", "mean_length"],
+                     [Vec.from_numpy(score.astype(np.float32)),
+                      Vec.from_numpy(ml.astype(np.float32))])
+
+    def _save_arrays(self):
+        return {"feat": np.asarray(jax.device_get(self._feat)),
+                "thr": np.asarray(jax.device_get(self._thr)),
+                "is_split": np.asarray(jax.device_get(self._is_split))}
+
+    def _save_extra_meta(self):
+        return {"max_depth": self.max_depth,
+                "sample_size": self.sample_size,
+                "min_path_length": self.min_path_length,
+                "max_path_length": self.max_path_length}
+
+    @classmethod
+    def _restore(cls, meta, arrays):
+        m = cls._restore_base(meta)
+        ex = meta["extra"]
+        m.max_depth = ex["max_depth"]
+        m.sample_size = ex["sample_size"]
+        m.min_path_length = ex["min_path_length"]
+        m.max_path_length = ex["max_path_length"]
+        m._feat = jnp.asarray(arrays["feat"])
+        m._thr = jnp.asarray(arrays["thr"])
+        m._is_split = jnp.asarray(arrays["is_split"])
+        return m
+
+
+class H2OIsolationForestEstimator(ModelBuilder):
+    algo = "isolationforest"
+    supervised = False
+
+    def __init__(self, **params):
+        merged = dict(IF_DEFAULTS)
+        merged.update(params)
+        super().__init__(**merged)
+
+    def _train_impl(self, spec: TrainingSpec, valid_spec, job: Job):
+        p = self.params
+        ntrees = int(p.get("ntrees", 50))
+        depth = int(p.get("max_depth", 8))
+        sample_size = int(p.get("sample_size", 256))
+        X = spec.X
+        w = spec.w
+        rows = X.shape[0]
+        Xf = jnp.where(jnp.isfinite(X), X, jnp.nan)
+        lo0 = jnp.nan_to_num(jnp.nanmin(Xf, axis=0), nan=0.0)
+        hi0 = jnp.nan_to_num(jnp.nanmax(Xf, axis=0), nan=0.0)
+        seed = int(p.get("seed", -1) or -1)
+        key = jax.random.PRNGKey(seed if seed != -1
+                                 else int(time.time() * 1e3) % (2 ** 31))
+
+        @jax.jit
+        def build_forest(key, X, w, lo0, hi0):
+            def one_tree(carry, i):
+                k = jax.random.fold_in(key, i)
+                k1, k2 = jax.random.split(k)
+                # per-tree subsample without replacement ~ top-k of
+                # uniform draws among live rows
+                u = jax.random.uniform(k1, (rows,))
+                u = jnp.where(w > 0, u, 2.0)
+                kth = jnp.sort(u)[jnp.minimum(sample_size, rows) - 1]
+                in_sample = (u <= kth) & (w > 0)
+                tree = _grow_random_tree(X, in_sample, lo0, hi0, depth, k2)
+                return carry, tree
+
+            _, trees = jax.lax.scan(one_tree, None, jnp.arange(ntrees))
+            return trees
+
+        trees = build_forest(key, X, w, lo0, hi0)
+        trees_host = {k: np.asarray(jax.device_get(v))
+                      for k, v in trees.items()}
+        model = IsolationForestModel(
+            f"if_{id(self) & 0xffffff:x}", self.params, spec, trees_host,
+            depth, sample_size, 0.0, 0.0)
+        # normalize scores by the TRAINING path-length range
+        ml = np.asarray(jax.device_get(model._mean_length(X)))
+        live = np.asarray(jax.device_get(w)) > 0
+        model.min_path_length = float(ml[live].min())
+        model.max_path_length = float(ml[live].max())
+        model.output["min_path_length"] = model.min_path_length
+        model.output["max_path_length"] = model.max_path_length
+        return model
+
+
+register_model_class("isolationforest", IsolationForestModel)

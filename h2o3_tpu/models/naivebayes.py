@@ -1,0 +1,157 @@
+"""Naive Bayes — class-conditional counting in one device pass.
+
+Reference: hex/naivebayes/NaiveBayes.java:26 — a single counting MRTask
+accumulates per-class counts for enum levels and per-class mean/variance
+for numerics; laplace smoothing; scoring multiplies log-likelihoods.
+
+TPU re-design: the counting pass is one one-hot matmul per column group
+(class-onehot × feature statistics contract on the MXU; GSPMD psums
+across shards) — the single-MRTask structure maps to a single fused jit."""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.jobs import Job
+from h2o3_tpu.models.model_base import (Model, ModelBuilder, TrainingSpec,
+                                        compute_metrics)
+from h2o3_tpu.persist import register_model_class
+
+NB_DEFAULTS: Dict = dict(laplace=0.0, min_sdev=0.001, eps_sdev=0.0)
+
+
+class NaiveBayesModel(Model):
+    algo = "naivebayes"
+
+    def __init__(self, key, params, spec, priors, num_mean, num_sd,
+                 cat_probs):
+        super().__init__(key, params, spec)
+        self.priors = np.asarray(priors)            # [K]
+        self.num_mean = num_mean                    # [K, Fnum]
+        self.num_sd = num_sd                        # [K, Fnum]
+        self.cat_probs = cat_probs                  # {col: [K, card]}
+
+    def _predict_matrix(self, X, offset=None):
+        K = len(self.priors)
+        logp = jnp.log(jnp.asarray(self.priors))[None, :]
+        logp = jnp.broadcast_to(logp, (X.shape[0], K))
+        num_i = 0
+        for i, (n, is_cat) in enumerate(zip(self.feature_names,
+                                            self.feature_is_cat)):
+            x = X[:, i]
+            ok = ~jnp.isnan(x)
+            if is_cat:
+                P = jnp.asarray(self.cat_probs[n])          # [K, card]
+                card = P.shape[1]
+                c = jnp.clip(jnp.where(ok, x, 0).astype(jnp.int32), 0,
+                             card - 1)
+                ll = jnp.log(jnp.maximum(P[:, c].T, 1e-30))  # [rows, K]
+            else:
+                mu = jnp.asarray(self.num_mean)[:, num_i][None, :]
+                sd = jnp.asarray(self.num_sd)[:, num_i][None, :]
+                ll = (-0.5 * jnp.log(2 * jnp.pi * sd * sd)
+                      - 0.5 * ((x[:, None] - mu) / sd) ** 2)
+                num_i += 1
+            logp = logp + jnp.where(ok[:, None], ll, 0.0)
+        return jax.nn.softmax(logp, axis=1)
+
+    def _save_arrays(self):
+        d = {"priors": self.priors,
+             "num_mean": np.asarray(self.num_mean),
+             "num_sd": np.asarray(self.num_sd)}
+        for n, P in self.cat_probs.items():
+            d[f"cat_{n}"] = np.asarray(P)
+        return d
+
+    def _save_extra_meta(self):
+        return {"cat_cols": list(self.cat_probs)}
+
+    @classmethod
+    def _restore(cls, meta, arrays):
+        m = cls._restore_base(meta)
+        m.priors = arrays["priors"]
+        m.num_mean = arrays["num_mean"]
+        m.num_sd = arrays["num_sd"]
+        m.cat_probs = {n: arrays[f"cat_{n}"]
+                       for n in meta["extra"]["cat_cols"]}
+        return m
+
+
+class H2ONaiveBayesEstimator(ModelBuilder):
+    algo = "naivebayes"
+
+    def __init__(self, **params):
+        merged = dict(NB_DEFAULTS)
+        merged.update(params)
+        super().__init__(**merged)
+
+    def _train_impl(self, spec: TrainingSpec, valid_spec, job: Job):
+        if spec.nclasses < 2:
+            raise ValueError("NaiveBayes requires a categorical response")
+        p = self.params
+        laplace = float(p.get("laplace", 0.0))
+        min_sdev = float(p.get("min_sdev", 0.001))
+        eps_sdev = float(p.get("eps_sdev", 0.0))
+        K = spec.nclasses
+        y = spec.y
+        w = spec.w
+        X = spec.X
+        yoh = ((y[:, None] == jnp.arange(K)[None, :]).astype(jnp.float32)
+               * w[:, None])                                     # [rows, K]
+        cls_w = yoh.sum(0)                                       # [K]
+        priors = np.asarray(jax.device_get(cls_w / cls_w.sum()))
+        num_idx = [i for i, c in enumerate(spec.is_cat) if not c]
+        num_mean = np.zeros((K, len(num_idx)), np.float32)
+        num_sd = np.ones((K, len(num_idx)), np.float32)
+        if num_idx:
+            Xn = X[:, jnp.asarray(num_idx)]
+            okn = ~jnp.isnan(Xn)
+            Xz = jnp.where(okn, Xn, 0.0)
+            # per-class weighted moments via one MXU contraction each
+            cw = jax.lax.dot_general(yoh, okn.astype(jnp.float32) ,
+                                     (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            s1 = jax.lax.dot_general(yoh, Xz, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            s2 = jax.lax.dot_general(yoh, Xz * Xz, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            cw = jnp.maximum(cw, 1e-9)
+            mu = s1 / cw
+            sd = jnp.sqrt(jnp.maximum(s2 / cw - mu * mu, 0.0))
+            # eps_sdev: sdevs at/below the threshold are REPLACED by
+            # min_sdev; min_sdev floors the rest (reference NB params)
+            sd = jnp.where(sd <= eps_sdev, min_sdev,
+                           jnp.maximum(sd, min_sdev))
+            num_mean = np.asarray(jax.device_get(mu))
+            num_sd = np.asarray(jax.device_get(sd))
+        cat_probs: Dict[str, np.ndarray] = {}
+        for i, (n, is_cat) in enumerate(zip(spec.names, spec.is_cat)):
+            if not is_cat:
+                continue
+            card = len(spec.cat_domains.get(n, ())) or 1
+            x = X[:, i]
+            ok = ~jnp.isnan(x)
+            c = jnp.clip(jnp.where(ok, x, 0).astype(jnp.int32), 0, card - 1)
+            coh = ((c[:, None] == jnp.arange(card)[None, :])
+                   .astype(jnp.float32) * ok[:, None].astype(jnp.float32))
+            cnt = jax.lax.dot_general(yoh, coh, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+            cnt = cnt + laplace
+            P = cnt / jnp.maximum(cnt.sum(1, keepdims=True), 1e-30)
+            cat_probs[n] = np.asarray(jax.device_get(P))
+        model = NaiveBayesModel(f"nb_{id(self) & 0xffffff:x}", self.params,
+                                spec, priors, num_mean, num_sd, cat_probs)
+        out = model._predict_matrix(X)
+        model.training_metrics = compute_metrics(out, y, w, K,
+                                                 spec.response_domain)
+        if valid_spec is not None:
+            vout = model._predict_matrix(valid_spec.X)
+            model.validation_metrics = compute_metrics(
+                vout, valid_spec.y, valid_spec.w, K, spec.response_domain)
+        return model
+
+
+register_model_class("naivebayes", NaiveBayesModel)

@@ -1,0 +1,78 @@
+"""IsolationForest + NaiveBayes tests (reference: hex/tree/isofor,
+hex/naivebayes test style)."""
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.models.isoforest import H2OIsolationForestEstimator
+from h2o3_tpu.models.naivebayes import H2ONaiveBayesEstimator
+
+
+def test_isolation_forest_ranks_outliers():
+    rng = np.random.default_rng(0)
+    n = 2000
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    X[:20] = X[:20] * 0.2 + 8.0          # far cluster of outliers
+    fr = h2o.Frame.from_numpy({f"x{i}": X[:, i] for i in range(4)})
+    iso = H2OIsolationForestEstimator(ntrees=60, sample_size=256,
+                                      max_depth=8, seed=1)
+    iso.train(training_frame=fr)
+    pred = iso.model.predict(fr)
+    assert pred.names == ["predict", "mean_length"]
+    score = pred.vec("predict").to_numpy()
+    # the planted outliers should dominate the top anomaly scores
+    top = np.argsort(-score)[:30]
+    hits = np.sum(top < 20)
+    assert hits >= 15, hits
+    # outliers isolate in fewer splits than inliers
+    ml = pred.vec("mean_length").to_numpy()
+    assert ml[:20].mean() < ml[20:].mean()
+
+
+def test_isolation_forest_save_load(tmp_path):
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(400, 3)).astype(np.float32)
+    fr = h2o.Frame.from_numpy({f"x{i}": X[:, i] for i in range(3)})
+    iso = H2OIsolationForestEstimator(ntrees=10, max_depth=6, seed=1)
+    iso.train(training_frame=fr)
+    p = h2o.save_model(iso.model, str(tmp_path), filename="iso")
+    m2 = h2o.load_model(p)
+    s1 = iso.model.predict(fr).vec("predict").to_numpy()
+    s2 = m2.predict(fr).vec("predict").to_numpy()
+    np.testing.assert_allclose(s1, s2, rtol=1e-6)
+
+
+def test_naive_bayes_vs_sklearn():
+    from sklearn.naive_bayes import GaussianNB
+    rng = np.random.default_rng(5)
+    n = 3000
+    y = rng.integers(0, 3, n)
+    centers = np.array([[0, 0], [3, 1], [-2, 2]])
+    X = (centers[y] + rng.normal(size=(n, 2))).astype(np.float32)
+    labels = np.array(["a", "b", "c"], dtype=object)[y]
+    fr = h2o.Frame.from_numpy({"x1": X[:, 0], "x2": X[:, 1], "y": labels})
+    nb = H2ONaiveBayesEstimator()
+    nb.train(y="y", training_frame=fr)
+    acc_ours = 1 - nb.model.training_metrics.error
+    sk = GaussianNB().fit(X, y)
+    acc_sk = sk.score(X, y)
+    assert abs(acc_ours - acc_sk) < 0.02, (acc_ours, acc_sk)
+    probs = nb.model.predict(fr)
+    assert probs.names == ["predict", "pa", "pb", "pc"]
+
+
+def test_naive_bayes_categorical_features_laplace():
+    rng = np.random.default_rng(7)
+    n = 2000
+    lv = np.array(["u", "v", "w"])
+    cat = rng.integers(0, 3, n)
+    yv = (rng.random(n) < np.where(cat == 0, 0.9, 0.2)).astype(int)
+    fr = h2o.Frame.from_numpy({
+        "c": lv[cat],
+        "y": np.array(["no", "yes"], dtype=object)[yv]})
+    nb = H2ONaiveBayesEstimator(laplace=1.0)
+    nb.train(y="y", training_frame=fr)
+    assert nb.model.training_metrics.auc > 0.75
+    # conditional table rows are probability distributions
+    P = nb.model.cat_probs["c"]
+    np.testing.assert_allclose(P.sum(axis=1), 1.0, atol=1e-5)
