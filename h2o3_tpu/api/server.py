@@ -59,6 +59,11 @@ def _builders() -> Dict[str, Any]:
             "pca": est.H2OPrincipalComponentAnalysisEstimator,
             "xgboost": est.H2OXGBoostEstimator,
             "isolationforest": est.H2OIsolationForestEstimator,
+            "extendedisolationforest":
+                est.H2OExtendedIsolationForestEstimator,
+            "isotonicregression": est.H2OIsotonicRegressionEstimator,
+            "svd": est.H2OSingularValueDecompositionEstimator,
+            "aggregator": est.H2OAggregatorEstimator,
             "naivebayes": est.H2ONaiveBayesEstimator,
             "stackedensemble": est.H2OStackedEnsembleEstimator}
 

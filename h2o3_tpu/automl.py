@@ -178,10 +178,9 @@ class H2OAutoML:
         WorkAllocations per-step budget)."""
         cap = self.max_runtime_secs_per_model
         if not cap:
+            # train(background=False) joins internally and raises on FAILED
             est.train(x=x, y=y, training_frame=training_frame,
                       validation_frame=validation_frame)
-            if est.job.status == "FAILED":
-                raise RuntimeError(est.job.exception)
             return est.model
         est.train(x=x, y=y, training_frame=training_frame,
                   validation_frame=validation_frame, background=True)
@@ -190,10 +189,7 @@ class H2OAutoML:
             if time.time() - t0 > cap:
                 est.job.cancel()
             time.sleep(0.2)
-        model = est.job.join()
-        if est.job.status == "FAILED":
-            raise RuntimeError(est.job.exception)
-        return model
+        return est.job.join()  # raises on FAILED
 
     def _register(self, model, step_id: str):
         model.key = f"{self.project_name}_{step_id}"

@@ -1,21 +1,28 @@
 """Estimator namespace mirroring h2o-py's ``h2o.estimators`` imports
 (h2o-py/h2o/estimators/__init__.py — generated there by h2o-bindings;
 hand-maintained here)."""
+from h2o3_tpu.models.aggregator import H2OAggregatorEstimator
 from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
 from h2o3_tpu.models.drf import H2ORandomForestEstimator
 from h2o3_tpu.models.ensemble import H2OStackedEnsembleEstimator
 from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
 from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
 from h2o3_tpu.models.isoforest import H2OIsolationForestEstimator
+from h2o3_tpu.models.isoforextended import \
+    H2OExtendedIsolationForestEstimator
+from h2o3_tpu.models.isotonic import H2OIsotonicRegressionEstimator
 from h2o3_tpu.models.kmeans import H2OKMeansEstimator
 from h2o3_tpu.models.naivebayes import H2ONaiveBayesEstimator
 from h2o3_tpu.models.pca import H2OPrincipalComponentAnalysisEstimator
+from h2o3_tpu.models.svd import H2OSingularValueDecompositionEstimator
 from h2o3_tpu.models.xgboost import H2OXGBoostEstimator
 
 __all__ = [
-    "H2ODeepLearningEstimator", "H2ORandomForestEstimator",
-    "H2OStackedEnsembleEstimator", "H2OGradientBoostingEstimator",
-    "H2OGeneralizedLinearEstimator", "H2OIsolationForestEstimator",
-    "H2OKMeansEstimator", "H2ONaiveBayesEstimator",
-    "H2OPrincipalComponentAnalysisEstimator", "H2OXGBoostEstimator",
+    "H2OAggregatorEstimator", "H2ODeepLearningEstimator",
+    "H2ORandomForestEstimator", "H2OStackedEnsembleEstimator",
+    "H2OGradientBoostingEstimator", "H2OGeneralizedLinearEstimator",
+    "H2OIsolationForestEstimator", "H2OExtendedIsolationForestEstimator",
+    "H2OIsotonicRegressionEstimator", "H2OKMeansEstimator",
+    "H2ONaiveBayesEstimator", "H2OPrincipalComponentAnalysisEstimator",
+    "H2OSingularValueDecompositionEstimator", "H2OXGBoostEstimator",
 ]

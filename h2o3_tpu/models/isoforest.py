@@ -140,9 +140,10 @@ class IsolationForestModel(Model):
         from h2o3_tpu.frame.vec import Vec
         from h2o3_tpu.models.model_base import adapt_test_matrix
         X = adapt_test_matrix(self, frame)
-        score = np.asarray(jax.device_get(
-            self._predict_matrix(X)))[: frame.nrow]
+        # one forest traversal: score derives from the same mean lengths
         ml = np.asarray(jax.device_get(self._mean_length(X)))[: frame.nrow]
+        c = float(np.asarray(_avg_path(jnp.float32(self.sample_size))))
+        score = np.exp2(-ml / c)
         return Frame(["predict", "mean_length"],
                      [Vec.from_numpy(score.astype(np.float32)),
                       Vec.from_numpy(ml.astype(np.float32))])
@@ -226,6 +227,10 @@ class H2OIsolationForestEstimator(ModelBuilder):
         model.max_path_length = float(ml[live].max())
         model.output["min_path_length"] = model.min_path_length
         model.output["max_path_length"] = model.max_path_length
+        from h2o3_tpu.models.metrics import make_anomaly_metrics
+        c = float(np.asarray(_avg_path(jnp.float32(sample_size))))
+        model.training_metrics = make_anomaly_metrics(
+            np.exp2(-ml[live] / c), ml[live] / max(depth, 1))
         return model
 
 

@@ -1,0 +1,214 @@
+"""Extended Isolation Forest — oblique random-hyperplane isolation trees.
+
+Reference: hex/tree/isoforextended/ExtendedIsolationForest.java:27 — each
+split draws a random normal vector n (extension_level+1 non-zero
+components) and an intercept point p uniform inside the node's data
+bounding box; a row goes left when (x - p)·n < 0. Anomaly score is the
+isolation-forest 2^(-E[h]/c(n)) normalization.
+
+TPU re-design: level-synchronous growth like isoforest.py, but the
+per-node data bounding boxes are EXACT, computed per level with one
+scatter-min/max over the sampled rows (segment reduce → the MRTask
+reduction), and routing is a batched (rows × F)·(F) contraction per
+level — all inside one jitted lax.scan over trees."""
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.jobs import Job
+from h2o3_tpu.models.isoforest import _avg_path
+from h2o3_tpu.models.model_base import Model, ModelBuilder, TrainingSpec
+from h2o3_tpu.persist import register_model_class
+
+EIF_DEFAULTS: Dict = dict(
+    ntrees=100, sample_size=256, extension_level=0, seed=-1,
+)
+
+_BIG = 3.0e38
+
+
+def _grow_ext_tree(X, in_sample, depth, extension_level, key):
+    """One extended isolation tree: per-node hyperplane (normal[M,F],
+    point[M,F]) with M = 2^(depth+1)-1 slots."""
+    rows, F = X.shape
+    M = 2 ** (depth + 1) - 1
+    normal = jnp.zeros((M, F), jnp.float32)
+    point = jnp.zeros((M, F), jnp.float32)
+    is_split = jnp.zeros(M, bool)
+    nid = jnp.zeros(rows, jnp.int32)
+    Xs = jnp.nan_to_num(X, nan=0.0)
+    for d in range(depth):
+        N = 2 ** d
+        base = N - 1
+        local = nid - base
+        in_lvl = (local >= 0) & (local < N) & in_sample
+        lid = jnp.clip(local, 0, N - 1)
+        # exact per-node bounding box: scatter-min/max of sampled rows
+        xin = jnp.where(in_lvl[:, None], Xs, _BIG)
+        node_min = jnp.full((N, F), _BIG, jnp.float32).at[lid].min(xin)
+        xax = jnp.where(in_lvl[:, None], Xs, -_BIG)
+        node_max = jnp.full((N, F), -_BIG, jnp.float32).at[lid].max(xax)
+        cnt = jnp.zeros(N, jnp.float32).at[lid].add(
+            jnp.where(in_lvl, 1.0, 0.0))
+        key, kn, kp, kz = jax.random.split(key, 4)
+        nvec = jax.random.normal(kn, (N, F))
+        # extension_level e: keep e+1 random coordinates per node
+        # (e = F-1 → fully oblique; e = 0 → axis-parallel = classic IF)
+        keep = min(extension_level + 1, F)
+        if keep < F:
+            z = jax.random.uniform(kz, (N, F))
+            kth = jnp.sort(z, axis=1)[:, keep - 1][:, None]
+            nvec = jnp.where(z <= kth, nvec, 0.0)
+        u = jax.random.uniform(kp, (N, F))
+        p = node_min + u * jnp.maximum(node_max - node_min, 0.0)
+        can = (cnt >= 2) & (node_max > node_min).any(axis=1)
+        idx = base + jnp.arange(N)
+        normal = normal.at[idx].set(nvec)
+        point = point.at[idx].set(p)
+        is_split = is_split.at[idx].set(can)
+        proj = ((Xs - p[lid]) * nvec[lid]).sum(axis=1)
+        go_right = proj >= 0.0
+        child = 2 * nid + 1 + go_right.astype(jnp.int32)
+        route = (local >= 0) & (local < N) & can[lid]
+        nid = jnp.where(route, child, nid)
+    return {"normal": normal, "point": point, "is_split": is_split}
+
+
+def _ext_path_lengths(X, normal, point, is_split, depth):
+    rows = X.shape[0]
+    Xs = jnp.nan_to_num(X, nan=0.0)
+    nid = jnp.zeros(rows, jnp.int32)
+    length = jnp.zeros(rows, jnp.float32)
+    for _ in range(depth):
+        s = is_split[nid]
+        proj = ((Xs - point[nid]) * normal[nid]).sum(axis=1)
+        go_right = proj >= 0.0
+        nid = jnp.where(s, 2 * nid + 1 + go_right.astype(jnp.int32), nid)
+        length = length + s.astype(jnp.float32)
+    return length
+
+
+class ExtendedIsolationForestModel(Model):
+    algo = "extendedisolationforest"
+    supervised = False
+
+    def __init__(self, key, params, spec, trees, depth, sample_size):
+        super().__init__(key, params, spec)
+        self._normal = jnp.asarray(trees["normal"])     # [T, M, F]
+        self._point = jnp.asarray(trees["point"])
+        self._is_split = jnp.asarray(trees["is_split"])
+        self.max_depth = depth
+        self.sample_size = sample_size
+
+    def _mean_length(self, X):
+        T = self._normal.shape[0]
+
+        def one(carry, t):
+            return carry, _ext_path_lengths(
+                X, self._normal[t], self._point[t], self._is_split[t],
+                self.max_depth)
+
+        _, L = jax.lax.scan(one, None, jnp.arange(T))
+        return L.mean(axis=0)
+
+    def _predict_matrix(self, X, offset=None):
+        ml = self._mean_length(X)
+        c = _avg_path(jnp.float32(self.sample_size))
+        return jnp.exp2(-ml / c)
+
+    def predict(self, frame):
+        from h2o3_tpu.frame.frame import Frame
+        from h2o3_tpu.frame.vec import Vec
+        from h2o3_tpu.models.model_base import adapt_test_matrix
+        X = adapt_test_matrix(self, frame)
+        # one forest traversal: score derives from the same mean lengths
+        ml = np.asarray(jax.device_get(self._mean_length(X)))[: frame.nrow]
+        c = float(np.asarray(_avg_path(jnp.float32(self.sample_size))))
+        score = np.exp2(-ml / c)
+        return Frame(["anomaly_score", "mean_length"],
+                     [Vec.from_numpy(score.astype(np.float32)),
+                      Vec.from_numpy(ml.astype(np.float32))])
+
+    def _save_arrays(self):
+        return {"normal": np.asarray(jax.device_get(self._normal)),
+                "point": np.asarray(jax.device_get(self._point)),
+                "is_split": np.asarray(jax.device_get(self._is_split))}
+
+    def _save_extra_meta(self):
+        return {"max_depth": self.max_depth,
+                "sample_size": self.sample_size}
+
+    @classmethod
+    def _restore(cls, meta, arrays):
+        m = cls._restore_base(meta)
+        ex = meta["extra"]
+        m.max_depth = ex["max_depth"]
+        m.sample_size = ex["sample_size"]
+        m._normal = jnp.asarray(arrays["normal"])
+        m._point = jnp.asarray(arrays["point"])
+        m._is_split = jnp.asarray(arrays["is_split"])
+        return m
+
+
+class H2OExtendedIsolationForestEstimator(ModelBuilder):
+    algo = "extendedisolationforest"
+    supervised = False
+
+    def __init__(self, **params):
+        merged = dict(EIF_DEFAULTS)
+        merged.update(params)
+        super().__init__(**merged)
+
+    def _train_impl(self, spec: TrainingSpec, valid_spec, job: Job):
+        p = self.params
+        ntrees = int(p.get("ntrees", 100))
+        sample_size = int(p.get("sample_size", 256))
+        ext = int(p.get("extension_level", 0))
+        # reference grows to ceil(log2(sample_size)) (iTree height limit)
+        depth = max(1, int(np.ceil(np.log2(max(sample_size, 2)))))
+        X = spec.X
+        w = spec.w
+        rows, F = X.shape
+        if not 0 <= ext <= F - 1:
+            raise ValueError(
+                f"extension_level must be in [0, {F - 1}], got {ext}")
+        seed = int(p.get("seed", -1) or -1)
+        key = jax.random.PRNGKey(seed if seed != -1
+                                 else int(time.time() * 1e3) % (2 ** 31))
+
+        @jax.jit
+        def build_forest(key, X, w):
+            def one_tree(carry, i):
+                k = jax.random.fold_in(key, i)
+                k1, k2 = jax.random.split(k)
+                u = jax.random.uniform(k1, (rows,))
+                u = jnp.where(w > 0, u, 2.0)
+                kth = jnp.sort(u)[jnp.minimum(sample_size, rows) - 1]
+                in_sample = (u <= kth) & (w > 0)
+                tree = _grow_ext_tree(X, in_sample, depth, ext, k2)
+                return carry, tree
+
+            _, trees = jax.lax.scan(one_tree, None, jnp.arange(ntrees))
+            return trees
+
+        trees = build_forest(key, X, w)
+        trees_host = {k: np.asarray(jax.device_get(v))
+                      for k, v in trees.items()}
+        model = ExtendedIsolationForestModel(
+            f"eif_{id(self) & 0xffffff:x}", self.params, spec, trees_host,
+            depth, sample_size)
+        from h2o3_tpu.models.metrics import make_anomaly_metrics
+        ml = np.asarray(jax.device_get(model._mean_length(X)))
+        c = float(np.asarray(_avg_path(jnp.float32(sample_size))))
+        live = np.asarray(jax.device_get(w)) > 0
+        model.training_metrics = make_anomaly_metrics(
+            np.exp2(-ml[live] / c), ml[live] / max(depth, 1))
+        return model
+
+
+register_model_class("extendedisolationforest", ExtendedIsolationForestModel)

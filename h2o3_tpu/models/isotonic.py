@@ -1,0 +1,130 @@
+"""Isotonic regression — weighted pool-adjacent-violators.
+
+Reference: hex/isotonic/IsotonicRegression.java:14 — distributed PAV over
+(feature, response, weight) triples; the model keeps the fitted threshold
+knots and predicts by linear interpolation with out-of-range clipping
+(hex/genmodel/algos/isotonic scoring semantics).
+
+TPU re-design: the data-sized work (sort by x, per-unique-x weighted
+aggregation) is one device sort + segment-sum; the PAV merge itself runs
+on the collapsed unique-x knots on host (knot count ≪ rows — same shape
+as the reference's driver-side final merge of per-chunk PAV results)."""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.jobs import Job
+from h2o3_tpu.models.model_base import (Model, ModelBuilder, TrainingSpec,
+                                        compute_metrics)
+from h2o3_tpu.persist import register_model_class
+
+ISO_DEFAULTS: Dict = dict(out_of_bounds="clip")
+
+
+@jax.jit
+def _sorted_aggregate(x, y, w):
+    """Sort by x; return sorted x, w·y, w (segment collapse happens host
+    side on the boundary mask to keep shapes static)."""
+    order = jnp.argsort(x)
+    xs = x[order]
+    return xs, (w * y)[order], w[order]
+
+
+def _pav(x, wy, w):
+    """Weighted PAV on pre-aggregated unique-x knots (host, O(n) stack)."""
+    n = len(x)
+    # block stack: level value = wy/w, merged while decreasing
+    bx0 = np.empty(n); bx1 = np.empty(n)
+    bwy = np.empty(n); bw = np.empty(n)
+    top = 0
+    for i in range(n):
+        bx0[top] = x[i]; bx1[top] = x[i]
+        bwy[top] = wy[i]; bw[top] = w[i]
+        top += 1
+        while top > 1 and (bwy[top - 2] * bw[top - 1]
+                           >= bwy[top - 1] * bw[top - 2]):
+            bwy[top - 2] += bwy[top - 1]
+            bw[top - 2] += bw[top - 1]
+            bx1[top - 2] = bx1[top - 1]
+            top -= 1
+    vals = bwy[:top] / bw[:top]
+    # knots: each block contributes its [x0, x1] endpoints at its value
+    tx, ty = [], []
+    for i in range(top):
+        tx.append(bx0[i]); ty.append(vals[i])
+        if bx1[i] != bx0[i]:
+            tx.append(bx1[i]); ty.append(vals[i])
+    return np.asarray(tx), np.asarray(ty)
+
+
+class IsotonicRegressionModel(Model):
+    algo = "isotonicregression"
+
+    def __init__(self, key, params, spec, tx, ty):
+        super().__init__(key, params, spec)
+        self.thresholds_x = np.asarray(tx)
+        self.thresholds_y = np.asarray(ty)
+
+    def _predict_matrix(self, X, offset=None):
+        x = X[:, 0]
+        tx = jnp.asarray(self.thresholds_x)
+        ty = jnp.asarray(self.thresholds_y)
+        pred = jnp.interp(x, tx, ty)  # interp clips outside the range
+        if self.params.get("out_of_bounds") == "na":
+            pred = jnp.where((x < tx[0]) | (x > tx[-1]), jnp.nan, pred)
+        return pred
+
+    def _save_arrays(self):
+        return {"tx": self.thresholds_x, "ty": self.thresholds_y}
+
+    @classmethod
+    def _restore(cls, meta, arrays):
+        m = cls._restore_base(meta)
+        m.thresholds_x = arrays["tx"]
+        m.thresholds_y = arrays["ty"]
+        return m
+
+
+class H2OIsotonicRegressionEstimator(ModelBuilder):
+    algo = "isotonicregression"
+
+    def __init__(self, **params):
+        merged = dict(ISO_DEFAULTS)
+        merged.update(params)
+        super().__init__(**merged)
+
+    def _train_impl(self, spec: TrainingSpec, valid_spec, job: Job):
+        if spec.n_features != 1:
+            raise ValueError("Isotonic regression expects exactly one "
+                             "feature column")
+        x = spec.X[:, 0]
+        live = (spec.w > 0) & ~jnp.isnan(x) & ~jnp.isnan(spec.y)
+        w = jnp.where(live, spec.w, 0.0)
+        xs, wys, ws = _sorted_aggregate(
+            jnp.where(live, x, jnp.inf), spec.y, w)
+        xs = np.asarray(jax.device_get(xs))
+        wys = np.asarray(jax.device_get(wys))
+        ws = np.asarray(jax.device_get(ws))
+        keep = np.isfinite(xs) & (ws > 0)
+        xs, wys, ws = xs[keep], wys[keep], ws[keep]
+        if len(xs) == 0:
+            raise ValueError("no usable rows for isotonic regression")
+        # collapse equal-x runs before PAV
+        ux, inv = np.unique(xs, return_inverse=True)
+        uwy = np.bincount(inv, weights=wys)
+        uw = np.bincount(inv, weights=ws)
+        tx, ty = _pav(ux, uwy, uw)
+        model = IsotonicRegressionModel(
+            f"iso_{id(self) & 0xffffff:x}", self.params, spec, tx, ty)
+        pred = model._predict_matrix(spec.X)
+        model.training_metrics = compute_metrics(pred, spec.y, spec.w, 1)
+        model.output["thresholds_x"] = tx.tolist()
+        model.output["thresholds_y"] = ty.tolist()
+        return model
+
+
+register_model_class("isotonicregression", IsotonicRegressionModel)

@@ -142,6 +142,94 @@ class ModelMetricsBinomial:
                 "nobs": self.nobs}
 
 
+def _threshold_columns(thr, tp, fp, P, N):
+    """Per-threshold metric columns (hex/AUC2.java ThresholdCriterion set).
+
+    tp/fp are cumulative weighted counts predicting positive at score >= thr."""
+    fn = P - tp
+    tn = N - fp
+    tot = max(P + N, 1e-30)
+    precision = tp / np.maximum(tp + fp, 1e-30)
+    recall = tp / max(P, 1e-30)                       # tpr
+    specificity = tn / max(N, 1e-30)                  # tnr
+    fpr = fp / max(N, 1e-30)
+    fnr = fn / max(P, 1e-30)
+    accuracy = (tp + tn) / tot
+    f1 = 2 * precision * recall / np.maximum(precision + recall, 1e-30)
+    f2 = 5 * precision * recall / np.maximum(4 * precision + recall, 1e-30)
+    f0point5 = (1.25 * precision * recall
+                / np.maximum(0.25 * precision + recall, 1e-30))
+    mcc_den = np.sqrt(np.maximum(
+        (tp + fp) * (tp + fn) * (tn + fp) * (tn + fn), 1e-30))
+    mcc = (tp * tn - fp * fn) / mcc_den
+    min_pca = np.minimum(recall, specificity)
+    mean_pca = 0.5 * (recall + specificity)
+    return {
+        "threshold": thr, "f1": f1, "f2": f2, "f0point5": f0point5,
+        "accuracy": accuracy, "precision": precision, "recall": recall,
+        "specificity": specificity, "absolute_mcc": np.abs(mcc),
+        "min_per_class_accuracy": min_pca,
+        "mean_per_class_accuracy": mean_pca,
+        "tns": tn, "fns": fn, "fps": fp, "tps": tp,
+        "tnr": specificity, "fnr": fnr, "fpr": fpr, "tpr": recall,
+    }
+
+
+_MAX_CRITERIA = ["f1", "f2", "f0point5", "accuracy", "precision", "recall",
+                 "specificity", "absolute_mcc", "min_per_class_accuracy",
+                 "mean_per_class_accuracy"]
+
+
+def make_gains_lift(prob, actual, weights=None, groups=16) -> Optional[dict]:
+    """Gains/lift table — hex/GainsLift.java semantics: sort by score desc,
+    split into `groups` weight-quantile bins, report response rate / lift /
+    cumulative capture & gain per bin, plus the Kolmogorov-Smirnov stat."""
+    s = np.asarray(prob, dtype=np.float64)
+    y = np.asarray(actual, dtype=np.float64)
+    w = np.ones_like(y) if weights is None else np.asarray(weights, np.float64)
+    order = np.argsort(-s, kind="stable")
+    yw = (y * w)[order]
+    wo = w[order]
+    W = wo.sum()
+    P = yw.sum()
+    if P <= 0 or P >= W:
+        return None  # single-class: table undefined (reference skips it too)
+    cw = np.cumsum(wo)
+    cy = np.cumsum(yw)
+    # bin edges at weight quantiles (last row index with cw <= k*W/groups)
+    edges = np.searchsorted(cw, W * np.arange(1, groups + 1) / groups,
+                            side="left")
+    edges = np.minimum(edges, len(cw) - 1)
+    edges = np.unique(edges)
+    cum_w = cw[edges]
+    cum_y = cy[edges]
+    lo_w = np.concatenate([[0.0], cum_w[:-1]])
+    lo_y = np.concatenate([[0.0], cum_y[:-1]])
+    grp_w = cum_w - lo_w
+    grp_y = cum_y - lo_y
+    overall_rate = P / W
+    response_rate = grp_y / np.maximum(grp_w, 1e-30)
+    lift = response_rate / overall_rate
+    cum_rate = cum_y / np.maximum(cum_w, 1e-30)
+    cum_lift = cum_rate / overall_rate
+    capture = grp_y / P
+    cum_capture = cum_y / P
+    gain = 100.0 * (lift - 1.0)
+    cum_gain = 100.0 * (cum_lift - 1.0)
+    ks = np.max(np.abs(cy / P - (cw - cy) / (W - P)))
+    return {
+        "cumulative_data_fraction": (cum_w / W).tolist(),
+        "lower_threshold": np.asarray(s[order][edges]).tolist(),
+        "lift": lift.tolist(), "cumulative_lift": cum_lift.tolist(),
+        "response_rate": response_rate.tolist(),
+        "cumulative_response_rate": cum_rate.tolist(),
+        "capture_rate": capture.tolist(),
+        "cumulative_capture_rate": cum_capture.tolist(),
+        "gain": gain.tolist(), "cumulative_gain": cum_gain.tolist(),
+        "kolmogorov_smirnov": float(ks),
+    }
+
+
 def make_binomial_metrics(prob, actual, weights=None) -> ModelMetricsBinomial:
     """prob = P(class 1); actual ∈ {0,1}."""
     prob = jnp.asarray(prob, dtype=jnp.float32)
@@ -167,11 +255,35 @@ def make_binomial_metrics(prob, actual, weights=None) -> ModelMetricsBinomial:
     cm = np.array([[tnb[bi], fpb[bi]], [fnb[bi], tpb[bi]]])
     per_class_err = 0.5 * (fpb[bi] / max(Nf, 1e-30) + fnb[bi] / max(Pf, 1e-30))
     acc = (tpb[bi] + tnb[bi]) / max(Pf + Nf, 1e-30)
+    # thresholds_and_metric_scores: AUC2 caps the sweep at ~400 thresholds;
+    # subsample boundaries evenly on the sorted-score axis to match.
+    n_b = len(sb)
+    if n_b > 400:
+        keep = np.unique(np.round(np.linspace(0, n_b - 1, 400)).astype(int))
+    else:
+        keep = np.arange(n_b)
+    table = _threshold_columns(sb[keep], tpb[keep], fpb[keep], Pf, Nf)
+    table = {k: np.asarray(v).tolist() for k, v in table.items()}
+    # max_criteria over the FULL-resolution sweep (exact, tighter than AUC2);
+    # idx points into the (possibly subsampled) table above — the nearest
+    # kept row — matching the reference contract that idx indexes the table
+    full = _threshold_columns(sb, tpb, fpb, Pf, Nf)
+    max_crit = {}
+    for c in _MAX_CRITERIA:
+        i = int(np.argmax(full[c]))
+        ti = int(np.searchsorted(keep, i))
+        ti = min(ti, len(keep) - 1)
+        max_crit[c] = {"threshold": float(sb[i]), "value": float(full[c][i]),
+                       "idx": ti}
+    table["max_criteria_and_metric_scores"] = max_crit
+    table["gains_lift"] = make_gains_lift(np.asarray(prob), np.asarray(y),
+                                          np.asarray(w))
     return ModelMetricsBinomial(
         auc=auc, aucpr=aucpr, logloss=ll, mse=mse, rmse=float(np.sqrt(mse)),
         gini=2 * auc - 1, mean_per_class_error=float(per_class_err), r2=r2,
         f1_threshold=float(sb[bi]), max_f1=float(f1[bi]), confusion_matrix=cm,
-        accuracy=float(acc), nobs=int(prob.shape[0]))
+        accuracy=float(acc), nobs=int(prob.shape[0]),
+        thresholds_and_metric_scores=table)
 
 
 # --------------------------------------------------------------- multinomial
@@ -199,12 +311,54 @@ class ModelMetricsMultinomial:
     confusion_matrix: np.ndarray
     hit_ratios: np.ndarray
     nobs: int
+    auc: Optional[float] = None          # macro one-vs-rest (MultinomialAUC)
+    aucpr: Optional[float] = None
+    auc_table: Optional[dict] = None     # per-class OVR auc/aucpr + averages
 
     def to_dict(self) -> Dict:
         return {"logloss": self.logloss, "MSE": self.mse, "RMSE": self.rmse,
                 "mean_per_class_error": self.mean_per_class_error,
                 "error": self.error, "cm": self.confusion_matrix.tolist(),
-                "hit_ratios": self.hit_ratios.tolist(), "nobs": self.nobs}
+                "hit_ratios": self.hit_ratios.tolist(), "nobs": self.nobs,
+                "AUC": self.auc, "pr_auc": self.aucpr}
+
+
+def multinomial_auc_table(probs, y, w, max_classes=20) -> Optional[dict]:
+    """One-vs-rest AUC per class + macro/weighted averages.
+
+    Reference: hex/MultinomialAUC.java (default OVR). Skipped above
+    `max_classes` (the reference gates this behind auc_type for memory;
+    here it is K device sorts, cheap but pointless for huge K)."""
+    K = probs.shape[1]
+    if K > max_classes:
+        return None
+    per_auc, per_pr, prevalence = [], [], []
+    wn = np.asarray(w, np.float64)
+    wtot = wn.sum()
+    for k in range(K):
+        yk = (np.asarray(y) == k).astype(np.float32)
+        wk = (wn * yk).sum()
+        if wk <= 0 or wk >= wtot:  # weighted degenerate: OVR AUC undefined
+            per_auc.append(float("nan")); per_pr.append(float("nan"))
+            prevalence.append(float((wn * yk).sum()))
+            continue
+        _, _, _, _, auc_k, pr_k, _, _ = _binary_curve_kernel(
+            jnp.asarray(probs[:, k]), jnp.asarray(yk), jnp.asarray(w))
+        per_auc.append(float(np.asarray(auc_k)))
+        per_pr.append(float(np.asarray(pr_k)))
+        prevalence.append(float((wn * yk).sum()))
+    pa = np.asarray(per_auc); pp = np.asarray(per_pr)
+    pv = np.asarray(prevalence); pv = pv / max(pv.sum(), 1e-30)
+    ok = ~np.isnan(pa)
+    macro = float(pa[ok].mean()) if ok.any() else float("nan")
+    weighted = float((pa[ok] * pv[ok]).sum() / max(pv[ok].sum(), 1e-30)) \
+        if ok.any() else float("nan")
+    macro_pr = float(pp[ok].mean()) if ok.any() else float("nan")
+    weighted_pr = float((pp[ok] * pv[ok]).sum() / max(pv[ok].sum(), 1e-30)) \
+        if ok.any() else float("nan")
+    return {"per_class_auc": per_auc, "per_class_aucpr": per_pr,
+            "macro_auc": macro, "weighted_auc": weighted,
+            "macro_aucpr": macro_pr, "weighted_aucpr": weighted_pr}
 
 
 def make_multinomial_metrics(probs, actual, weights=None) -> ModelMetricsMultinomial:
@@ -228,7 +382,35 @@ def make_multinomial_metrics(probs, actual, weights=None) -> ModelMetricsMultino
     ranks = np.asarray(jnp.argsort(-probs, axis=1))
     hits = ranks == np.asarray(y)[:, None]
     hr = np.cumsum(hits.mean(axis=0))[: min(K, 10)]
+    auct = multinomial_auc_table(np.asarray(probs), np.asarray(y),
+                                 np.asarray(w))
     return ModelMetricsMultinomial(
         logloss=float(np.asarray(ll)), mse=mse, rmse=float(np.sqrt(mse)),
         mean_per_class_error=mpce, error=float(np.asarray(err)),
-        confusion_matrix=cm, hit_ratios=hr, nobs=int(probs.shape[0]))
+        confusion_matrix=cm, hit_ratios=hr, nobs=int(probs.shape[0]),
+        auc=None if auct is None else auct["macro_auc"],
+        aucpr=None if auct is None else auct["macro_aucpr"],
+        auc_table=auct)
+
+
+# ------------------------------------------------------------------- anomaly
+
+@dataclass
+class ModelMetricsAnomaly:
+    """hex/ModelMetricsAnomaly.java — score summary for IsolationForest."""
+    mean_score: float
+    mean_normalized_score: float
+    nobs: int
+
+    def to_dict(self) -> Dict:
+        return {"mean_score": self.mean_score,
+                "mean_normalized_score": self.mean_normalized_score,
+                "nobs": self.nobs}
+
+
+def make_anomaly_metrics(score, normalized_score) -> ModelMetricsAnomaly:
+    s = np.asarray(score, np.float64)
+    ns = np.asarray(normalized_score, np.float64)
+    return ModelMetricsAnomaly(mean_score=float(s.mean()),
+                               mean_normalized_score=float(ns.mean()),
+                               nobs=int(s.shape[0]))

@@ -1,0 +1,139 @@
+"""SVD — singular value decomposition of the (expanded) design matrix.
+
+Reference: hex/svd/SVD.java:46 — GramSVD (distributed Gram then driver
+eigensolver), Power iteration, Randomized subspace; outputs v (right
+singular vectors), d (singular values), optional u frame.
+
+TPU re-design: the Gram is one sharded MXU matmul (the GramTask reduce)
+and eigh runs on device — power/randomized methods collapse into the
+same path (an F×F eigh is cheap at any dense F we support). U = X·V/d is
+one more matmul, computed lazily by predict()/u()."""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.jobs import Job
+from h2o3_tpu.models.glm import expand_design, expand_scoring_matrix
+from h2o3_tpu.models.model_base import (Model, ModelBuilder, TrainingSpec,
+                                        pack_impute_means,
+                                        unpack_impute_means)
+from h2o3_tpu.persist import register_model_class
+
+SVD_DEFAULTS: Dict = dict(
+    nv=1, transform="none", svd_method="gram_s_v_d", seed=-1,
+    use_all_factor_levels=True, keep_u=True, max_iterations=1000,
+)
+
+
+class SVDModel(Model):
+    algo = "svd"
+    supervised = False
+
+    def __init__(self, key, params, spec, v, d, xm, xs, exp_names,
+                 impute_means):
+        super().__init__(key, params, spec)
+        self.v = np.asarray(v)            # [Fe, nv] right singular vectors
+        self.d = np.asarray(d)            # [nv] singular values
+        self._xm = np.asarray(xm)
+        self._xs = np.asarray(xs)
+        self.expanded_names = list(exp_names)
+        self.impute_means = dict(impute_means)
+        self.use_all_levels = bool(params.get("use_all_factor_levels", True))
+
+    def _predict_matrix(self, X, offset=None):
+        Xe = expand_scoring_matrix(self, X)
+        Xs = (Xe - jnp.asarray(self._xm)[None, :]) / \
+            jnp.asarray(self._xs)[None, :]
+        # u rows: X·V / d
+        return (Xs @ jnp.asarray(self.v)) / jnp.maximum(
+            jnp.asarray(self.d)[None, :], 1e-30)
+
+    def predict(self, frame):
+        from h2o3_tpu.frame.frame import Frame
+        from h2o3_tpu.frame.vec import Vec
+        from h2o3_tpu.models.model_base import adapt_test_matrix
+        X = adapt_test_matrix(self, frame)
+        U = np.asarray(jax.device_get(self._predict_matrix(X)))[: frame.nrow]
+        names = [f"u{i}" for i in range(U.shape[1])]
+        return Frame(names, [Vec.from_numpy(U[:, i].astype(np.float32))
+                             for i in range(U.shape[1])])
+
+    def _save_arrays(self):
+        d = {"v": self.v, "d": self.d, "xm": self._xm, "xs": self._xs}
+        d.update(pack_impute_means(self.impute_means))
+        return d
+
+    def _save_extra_meta(self):
+        return {"expanded_names": self.expanded_names}
+
+    @classmethod
+    def _restore(cls, meta, arrays):
+        m = cls._restore_base(meta)
+        m.v = arrays["v"]
+        m.d = arrays["d"]
+        m._xm = arrays["xm"]
+        m._xs = arrays["xs"]
+        m.expanded_names = meta["extra"]["expanded_names"]
+        m.impute_means = unpack_impute_means(arrays)
+        m.use_all_levels = bool(m.params.get("use_all_factor_levels", True))
+        return m
+
+
+class H2OSingularValueDecompositionEstimator(ModelBuilder):
+    algo = "svd"
+    supervised = False
+
+    def __init__(self, **params):
+        merged = dict(SVD_DEFAULTS)
+        merged.update(params)
+        super().__init__(**merged)
+
+    def _train_impl(self, spec: TrainingSpec, valid_spec, job: Job):
+        p = self.params
+        use_all = bool(p.get("use_all_factor_levels", True))
+        Xe, exp_names, means = expand_design(spec, use_all_levels=use_all)
+        Fe = Xe.shape[1]
+        nv = min(int(p.get("nv", 1)), Fe)
+        w = spec.w
+        wsum = w.sum()
+        transform = (p.get("transform") or "none").lower()
+        xm = (Xe * w[:, None]).sum(0) / wsum
+        if transform == "standardize":
+            xv = (w[:, None] * (Xe - xm[None, :]) ** 2).sum(0) / wsum
+            xs = jnp.sqrt(jnp.maximum(xv, 1e-12))
+        elif transform in ("demean", "center"):
+            xs = jnp.ones(Fe, jnp.float32)
+        elif transform in ("descale", "scale"):
+            xv = (w[:, None] * (Xe - xm[None, :]) ** 2).sum(0) / wsum
+            xs = jnp.sqrt(jnp.maximum(xv, 1e-12))
+            xm = jnp.zeros(Fe, jnp.float32)
+        else:  # none
+            xm = jnp.zeros(Fe, jnp.float32)
+            xs = jnp.ones(Fe, jnp.float32)
+        Xs = ((Xe - xm[None, :]) / xs[None, :]) * (w > 0)[:, None]
+        # Gram of the weighted design (unnormalized — hex/svd semantics:
+        # d are singular values of X itself, not of X/sqrt(n))
+        G = jax.lax.dot_general(Xs, Xs * w[:, None], (((0,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        vals, vecs = jnp.linalg.eigh(G)
+        order = jnp.argsort(-vals)
+        vals = jnp.maximum(vals[order][:nv], 0.0)
+        vecs = vecs[:, order][:, :nv]
+        d = jnp.sqrt(vals)
+        job.set_progress(1.0)
+        model = SVDModel(f"svd_{id(self) & 0xffffff:x}", self.params, spec,
+                         jax.device_get(vecs), jax.device_get(d),
+                         jax.device_get(xm), jax.device_get(xs), exp_names,
+                         {k_: float(jax.device_get(v))
+                          for k_, v in means.items()})
+        model.output["v"] = model.v.tolist()
+        model.output["d"] = model.d.tolist()
+        model.output["names_expanded"] = exp_names
+        return model
+
+
+register_model_class("svd", SVDModel)

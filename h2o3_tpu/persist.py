@@ -36,9 +36,10 @@ def register_model_class(algo: str, cls) -> None:
 def _model_class(algo: str):
     if not _MODEL_CLASSES:
         # import the algo modules once; each registers its model class
-        from h2o3_tpu.models import (deeplearning, drf, ensemble,  # noqa: F401
-                                     gbm, glm, isoforest, kmeans,
-                                     naivebayes, pca)
+        from h2o3_tpu.models import (aggregator, deeplearning,  # noqa: F401
+                                     drf, ensemble, gbm, glm, isoforest,
+                                     isoforextended, isotonic, kmeans,
+                                     naivebayes, pca, svd)
     if algo not in _MODEL_CLASSES:
         raise ValueError(f"no registered model class for algo '{algo}'")
     return _MODEL_CLASSES[algo]

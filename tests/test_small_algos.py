@@ -1,0 +1,186 @@
+"""ExtendedIsolationForest, Isotonic, SVD, Aggregator tests + expanded
+metrics tables (reference test style: hex/tree/isoforextended, hex/isotonic,
+hex/svd, hex/aggregator unit tests; AUC2/GainsLift golden checks)."""
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.models.aggregator import H2OAggregatorEstimator
+from h2o3_tpu.models.isoforextended import \
+    H2OExtendedIsolationForestEstimator
+from h2o3_tpu.models.isotonic import H2OIsotonicRegressionEstimator
+from h2o3_tpu.models.svd import H2OSingularValueDecompositionEstimator
+
+
+def test_extended_isolation_forest_ranks_outliers():
+    rng = np.random.default_rng(0)
+    n = 1500
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    X[:15] = X[:15] * 0.2 + 7.0
+    fr = h2o.Frame.from_numpy({f"x{i}": X[:, i] for i in range(4)})
+    eif = H2OExtendedIsolationForestEstimator(
+        ntrees=60, sample_size=128, extension_level=3, seed=1)
+    eif.train(training_frame=fr)
+    pred = eif.model.predict(fr)
+    score = pred.vec("anomaly_score").to_numpy()
+    top = np.argsort(-score)[:25]
+    assert np.sum(top < 15) >= 12
+    assert eif.model.training_metrics.mean_score > 0
+
+
+def test_extended_isolation_forest_save_load(tmp_path):
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(300, 3)).astype(np.float32)
+    fr = h2o.Frame.from_numpy({f"x{i}": X[:, i] for i in range(3)})
+    eif = H2OExtendedIsolationForestEstimator(ntrees=8, sample_size=64,
+                                              extension_level=1, seed=1)
+    eif.train(training_frame=fr)
+    p = h2o.save_model(eif.model, str(tmp_path), filename="eif")
+    m2 = h2o.load_model(p)
+    s1 = eif.model.predict(fr).vec("anomaly_score").to_numpy()
+    s2 = m2.predict(fr).vec("anomaly_score").to_numpy()
+    np.testing.assert_allclose(s1, s2, rtol=1e-6)
+
+
+def test_isotonic_matches_sklearn():
+    from sklearn.isotonic import IsotonicRegression
+    rng = np.random.default_rng(7)
+    n = 2000
+    x = rng.uniform(-3, 3, n).astype(np.float64)
+    y = (np.tanh(x) + rng.normal(scale=0.3, size=n)).astype(np.float64)
+    fr = h2o.Frame.from_numpy({"x": x, "y": y})
+    iso = H2OIsotonicRegressionEstimator()
+    iso.train(y="y", x=["x"], training_frame=fr)
+    ours = iso.model.predict(fr).vec("predict").to_numpy()
+    sk = IsotonicRegression(out_of_bounds="clip").fit(x, y)
+    theirs = sk.predict(x)
+    np.testing.assert_allclose(ours, theirs, atol=1e-4)
+
+
+def test_isotonic_weighted():
+    from sklearn.isotonic import IsotonicRegression
+    rng = np.random.default_rng(9)
+    n = 500
+    x = rng.uniform(0, 1, n)
+    y = x + rng.normal(scale=0.2, size=n)
+    w = rng.uniform(0.5, 2.0, n)
+    fr = h2o.Frame.from_numpy({"x": x, "y": y, "w": w})
+    iso = H2OIsotonicRegressionEstimator(weights_column="w")
+    iso.train(y="y", x=["x"], training_frame=fr)
+    ours = iso.model.predict(fr).vec("predict").to_numpy()
+    sk = IsotonicRegression(out_of_bounds="clip").fit(x, y, sample_weight=w)
+    np.testing.assert_allclose(ours, sk.predict(x), atol=1e-4)
+
+
+def test_svd_matches_numpy():
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(500, 6)).astype(np.float64)
+    X[:, 3] = X[:, 0] * 2 + X[:, 1]          # rank structure
+    fr = h2o.Frame.from_numpy({f"x{i}": X[:, i] for i in range(6)})
+    svd = H2OSingularValueDecompositionEstimator(nv=4, transform="none")
+    svd.train(training_frame=fr)
+    _, s, vt = np.linalg.svd(X, full_matrices=False)
+    np.testing.assert_allclose(svd.model.d, s[:4], rtol=2e-3)
+    # right singular vectors match up to sign
+    for j in range(4):
+        dot = abs(np.dot(svd.model.v[:, j], vt[j]))
+        assert dot > 0.99, (j, dot)
+    # u columns orthonormal-ish
+    U = svd.model.predict(fr).to_numpy()
+    G = U.T @ U
+    np.testing.assert_allclose(G, np.eye(4), atol=5e-2)
+
+
+def test_svd_save_load(tmp_path):
+    rng = np.random.default_rng(13)
+    X = rng.normal(size=(200, 4)).astype(np.float32)
+    fr = h2o.Frame.from_numpy({f"x{i}": X[:, i] for i in range(4)})
+    svd = H2OSingularValueDecompositionEstimator(nv=2)
+    svd.train(training_frame=fr)
+    p = h2o.save_model(svd.model, str(tmp_path), filename="svd")
+    m2 = h2o.load_model(p)
+    np.testing.assert_allclose(m2.d, svd.model.d, rtol=1e-6)
+    u1 = svd.model.predict(fr).to_numpy()
+    u2 = m2.predict(fr).to_numpy()
+    np.testing.assert_allclose(u1, u2, rtol=1e-5)
+
+
+def test_aggregator_reduces_and_counts():
+    rng = np.random.default_rng(17)
+    n = 5000
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    fr = h2o.Frame.from_numpy({f"x{i}": X[:, i] for i in range(3)})
+    agg = H2OAggregatorEstimator(target_num_exemplars=100,
+                                 rel_tol_num_exemplars=0.5, seed=1)
+    agg.train(training_frame=fr)
+    m = agg.model
+    k = len(m.exemplar_idx)
+    assert 10 <= k < n
+    assert m.counts.sum() == n
+    out = m.aggregated_frame(fr)
+    assert out.nrow == k
+    assert out.names[-1] == "counts"
+
+
+# ------------------------- metrics tables (thresholds, gains/lift, mAUC)
+
+def test_threshold_table_and_max_criteria():
+    from h2o3_tpu.models.metrics import make_binomial_metrics
+    rng = np.random.default_rng(23)
+    n = 3000
+    y = rng.integers(0, 2, n)
+    p = np.clip(0.7 * y + 0.3 * rng.uniform(size=n), 0, 1)
+    mm = make_binomial_metrics(p.astype(np.float32), y.astype(np.float32))
+    t = mm.thresholds_and_metric_scores
+    assert t is not None
+    assert len(t["threshold"]) <= 400
+    for col in ("f1", "accuracy", "precision", "recall", "tps", "fps",
+                "tnr", "fpr"):
+        assert len(t[col]) == len(t["threshold"])
+    mc = t["max_criteria_and_metric_scores"]
+    assert mc["f1"]["value"] == pytest.approx(mm.max_f1, abs=1e-6)
+    # accuracy at its max threshold must beat base rate
+    assert mc["accuracy"]["value"] >= max(y.mean(), 1 - y.mean())
+
+
+def test_gains_lift_golden():
+    from h2o3_tpu.models.metrics import make_gains_lift
+    # perfectly separating score → first groups capture all positives
+    n = 1600
+    y = np.zeros(n); y[:100] = 1
+    s = np.linspace(1, 0, n)          # descending score, positives first
+    gl = make_gains_lift(s, y, groups=16)
+    assert gl is not None
+    # 100 positives within the first 100 rows = first group of 100 rows
+    assert gl["cumulative_capture_rate"][0] == pytest.approx(1.0)
+    assert gl["lift"][0] == pytest.approx(16.0, rel=1e-6)
+    assert gl["kolmogorov_smirnov"] == pytest.approx(1.0, abs=1e-9)
+    # sklearn-checkable overall response rate
+    assert gl["cumulative_response_rate"][-1] == pytest.approx(100 / n)
+
+
+def test_multinomial_auc_macro():
+    from h2o3_tpu.models.metrics import make_multinomial_metrics
+    from sklearn.metrics import roc_auc_score
+    rng = np.random.default_rng(29)
+    n, K = 2000, 3
+    y = rng.integers(0, K, n)
+    logits = rng.normal(size=(n, K)) + 2.0 * np.eye(K)[y]
+    probs = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+    mm = make_multinomial_metrics(probs.astype(np.float32), y)
+    assert mm.auc is not None
+    sk = roc_auc_score(y, probs, multi_class="ovr", average="macro")
+    assert mm.auc == pytest.approx(sk, abs=1e-3)
+
+
+def test_svd_categorical_predict_roundtrip():
+    rng = np.random.default_rng(31)
+    n = 300
+    cats = np.array(["a", "b", "c"], dtype=object)[rng.integers(0, 3, n)]
+    fr = h2o.Frame.from_numpy({
+        "x0": rng.normal(size=n), "c": cats, "x1": rng.normal(size=n)})
+    svd = H2OSingularValueDecompositionEstimator(nv=2)
+    svd.train(training_frame=fr)
+    U = svd.model.predict(fr).to_numpy()   # use_all_factor_levels expansion
+    assert U.shape == (n, 2)
+    assert np.isfinite(U).all()
