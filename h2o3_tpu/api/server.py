@@ -65,6 +65,10 @@ def _builders() -> Dict[str, Any]:
             "svd": est.H2OSingularValueDecompositionEstimator,
             "aggregator": est.H2OAggregatorEstimator,
             "naivebayes": est.H2ONaiveBayesEstimator,
+            "gam": est.H2OGeneralizedAdditiveEstimator,
+            "anovaglm": est.H2OANOVAGLMEstimator,
+            "modelselection": est.H2OModelSelectionEstimator,
+            "rulefit": est.H2ORuleFitEstimator,
             "stackedensemble": est.H2OStackedEnsembleEstimator}
 
 

@@ -2,7 +2,11 @@
 (h2o-py/h2o/estimators/__init__.py — generated there by h2o-bindings;
 hand-maintained here)."""
 from h2o3_tpu.models.aggregator import H2OAggregatorEstimator
+from h2o3_tpu.models.anovaglm import H2OANOVAGLMEstimator
 from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
+from h2o3_tpu.models.gam import H2OGeneralizedAdditiveEstimator
+from h2o3_tpu.models.modelselection import H2OModelSelectionEstimator
+from h2o3_tpu.models.rulefit import H2ORuleFitEstimator
 from h2o3_tpu.models.drf import H2ORandomForestEstimator
 from h2o3_tpu.models.ensemble import H2OStackedEnsembleEstimator
 from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
@@ -18,7 +22,9 @@ from h2o3_tpu.models.svd import H2OSingularValueDecompositionEstimator
 from h2o3_tpu.models.xgboost import H2OXGBoostEstimator
 
 __all__ = [
-    "H2OAggregatorEstimator", "H2ODeepLearningEstimator",
+    "H2OAggregatorEstimator", "H2OANOVAGLMEstimator",
+    "H2OGeneralizedAdditiveEstimator", "H2OModelSelectionEstimator",
+    "H2ORuleFitEstimator", "H2ODeepLearningEstimator",
     "H2ORandomForestEstimator", "H2OStackedEnsembleEstimator",
     "H2OGradientBoostingEstimator", "H2OGeneralizedLinearEstimator",
     "H2OIsolationForestEstimator", "H2OExtendedIsolationForestEstimator",

@@ -37,6 +37,23 @@ def _block_dists(B, E):
         B, E, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
 
 
+_PAD_COORD = 1.0e6  # standardized data is O(10); dist² to a pad row ≈ F·1e12
+
+
+def _padded_dists(B, E_np):
+    """_block_dists with the exemplar matrix padded to the next power of
+    two so XLA compiles O(log target) kernels instead of one per admitted
+    exemplar count. Pad rows sit at a far-away finite point; callers
+    slice the result back to the real count."""
+    k = E_np.shape[0]
+    cap = 1 << max(0, (k - 1).bit_length())
+    if cap > k:
+        pad = np.full((cap - k, E_np.shape[1]), _PAD_COORD, E_np.dtype)
+        E_np = np.concatenate([E_np, pad], axis=0)
+    D = _block_dists(B, jnp.asarray(E_np))
+    return D[:, :k]
+
+
 class AggregatorModel(Model):
     algo = "aggregator"
     supervised = False
@@ -111,8 +128,8 @@ class H2OAggregatorEstimator(ModelBuilder):
                 if Ed is None:
                     mind = np.full(len(idx), np.inf, np.float32)
                 else:
-                    D = np.asarray(jax.device_get(_block_dists(
-                        jnp.asarray(B), jnp.asarray(Ed))))
+                    D = np.asarray(jax.device_get(_padded_dists(
+                        jnp.asarray(B), Ed)))
                     mind = D.min(axis=1)
                 far = np.flatnonzero(mind > delta)
                 # greedy within-block admission among far rows: the matmul
@@ -141,11 +158,12 @@ class H2OAggregatorEstimator(ModelBuilder):
                 delta *= 0.5
         ex_arr = np.asarray(ex, int)
         # final assignment pass: every row to its nearest exemplar
-        E = jnp.asarray(Xh[ex_arr])
+        E = Xh[ex_arr]
         counts = np.zeros(len(ex_arr), np.int64)
         for s in range(0, n, block):
-            D = _block_dists(jnp.asarray(Xh[s: s + block]), E)
-            a = np.asarray(jax.device_get(jnp.argmin(D, axis=1)))
+            D = np.asarray(jax.device_get(_padded_dists(
+                jnp.asarray(Xh[s: s + block]), E)))
+            a = D.argmin(axis=1)
             np.add.at(counts, a, 1)
         job.set_progress(1.0)
         model = AggregatorModel(

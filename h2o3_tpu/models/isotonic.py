@@ -121,7 +121,9 @@ class H2OIsotonicRegressionEstimator(ModelBuilder):
         model = IsotonicRegressionModel(
             f"iso_{id(self) & 0xffffff:x}", self.params, spec, tx, ty)
         pred = model._predict_matrix(spec.X)
-        model.training_metrics = compute_metrics(pred, spec.y, spec.w, 1)
+        # metrics on the NaN-filtered weights: rows with missing x score
+        # NaN and must not poison MSE/R2
+        model.training_metrics = compute_metrics(pred, spec.y, w, 1)
         model.output["thresholds_x"] = tx.tolist()
         model.output["thresholds_y"] = ty.tolist()
         return model

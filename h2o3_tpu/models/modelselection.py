@@ -1,0 +1,203 @@
+"""ModelSelection — best-subset GLM search (maxr / forward / backward).
+
+Reference: hex/modelselection/ModelSelection.java:24 — modes maxr,
+maxrsweep, forward, backward over GLM; reports the best predictor subset
+per model size with R²/deviance, using sweep operators on the Gram.
+
+TPU re-design: every candidate fit is one MXU Gram + Cholesky solve
+(gaussian: exact in one IRLS step), so greedy search over subsets is a
+sequence of cheap device solves on a SHARED design — the data is
+expanded and standardized once per refit by the GLM path. maxrsweep
+collapses into maxr (same result, the sweep is an implementation detail
+of the JVM)."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from h2o3_tpu.jobs import Job
+from h2o3_tpu.models.glm import GLM_DEFAULTS, H2OGeneralizedLinearEstimator
+from h2o3_tpu.models.model_base import Model, ModelBuilder
+from h2o3_tpu.persist import (model_from_meta, model_to_meta,
+                              register_model_class)
+
+MS_DEFAULTS: Dict = dict(
+    mode="maxr", max_predictor_number=1, min_predictor_number=1,
+    intercept=True, family="auto",
+)
+
+
+class ModelSelectionModel(Model):
+    algo = "modelselection"
+
+    def __init__(self, key, params, spec, best_model, results):
+        super().__init__(key, params, spec)
+        self.best_model = best_model
+        self.results = results          # per-size rows
+
+    def predict(self, frame):
+        return self.best_model.predict(frame)
+
+    def _predict_matrix(self, X, offset=None):
+        return self.best_model._predict_matrix(X, offset=offset)
+
+    def result(self):
+        return self.results
+
+    def coef(self):
+        return self.best_model.coef()
+
+    def _save_arrays(self):
+        return {f"inner__{k}": v
+                for k, v in self.best_model._save_arrays().items()}
+
+    def _save_extra_meta(self):
+        return {"inner_meta": model_to_meta(self.best_model),
+                "results": self.results}
+
+    @classmethod
+    def _restore(cls, meta, arrays):
+        m = cls._restore_base(meta)
+        ex = meta["extra"]
+        inner_arrays = {k[len("inner__"):]: v for k, v in arrays.items()
+                        if k.startswith("inner__")}
+        m.best_model = model_from_meta(ex["inner_meta"], inner_arrays)
+        m.results = ex["results"]
+        return m
+
+
+class H2OModelSelectionEstimator(ModelBuilder):
+    algo = "modelselection"
+
+    def __init__(self, **params):
+        merged = dict(GLM_DEFAULTS)
+        merged.update(MS_DEFAULTS)
+        merged.update(params)
+        for alias in ("lambda_", "lambda"):
+            if alias in merged:
+                merged["Lambda"] = merged.pop(alias)
+        super().__init__(**merged)
+
+    def _fit(self, cols: List[str], y, frame) -> Model:
+        p = {k: v for k, v in self.params.items() if k not in MS_DEFAULTS}
+        p.setdefault("Lambda", [0.0])
+        est = H2OGeneralizedLinearEstimator(**p)
+        est.train(x=cols, y=y, training_frame=frame)
+        return est.model
+
+    @staticmethod
+    def _crit(model: Model) -> float:
+        """Selection criterion: residual deviance (lower = better) —
+        equals (1-R²)·TSS for gaussian, matches the reference's R² order."""
+        return model.residual_deviance
+
+    def train(self, x=None, y=None, training_frame=None,
+              validation_frame=None, **kw):
+        p = self.params
+        y = y or p.get("response_column")
+        if training_frame is None or y is None:
+            raise ValueError("ModelSelection needs training_frame and y")
+        special = {y, p.get("weights_column"), p.get("offset_column")}
+        preds = list(x) if x else [n for n in training_frame.names
+                                   if n not in special]
+        mode = (p.get("mode") or "maxr").lower()
+        max_k = min(int(p.get("max_predictor_number", 1)), len(preds))
+        min_k = max(1, int(p.get("min_predictor_number", 1)))
+        job = Job("modelselection", work=float(max_k))
+
+        def body(job):
+            results = []
+            fitted: Dict[Tuple[str, ...], Model] = {}
+
+            def fit(cols: List[str]) -> Model:
+                key = tuple(sorted(cols))
+                if key not in fitted:
+                    fitted[key] = self._fit(list(key), y, training_frame)
+                return fitted[key]
+
+            if mode in ("maxr", "maxrsweep", "forward"):
+                chosen: List[str] = []
+                for k in range(1, max_k + 1):
+                    # greedy add
+                    cands = [c for c in preds if c not in chosen]
+                    scored = [(self._crit(fit(chosen + [c])), c)
+                              for c in cands]
+                    _, addc = min(scored)
+                    chosen = chosen + [addc]
+                    if mode in ("maxr", "maxrsweep") and len(chosen) > 1:
+                        # replacement sweeps until no swap improves
+                        improved = True
+                        guard = 0
+                        while improved and guard < 10:
+                            improved = False
+                            guard += 1
+                            best_c = self._crit(fit(chosen))
+                            for out_c in list(chosen):
+                                for in_c in [c for c in preds
+                                             if c not in chosen]:
+                                    trial = [c for c in chosen
+                                             if c != out_c] + [in_c]
+                                    if self._crit(fit(trial)) < best_c - 1e-10:
+                                        chosen = trial
+                                        best_c = self._crit(fit(trial))
+                                        improved = True
+                    m = fit(chosen)
+                    results.append(self._row(k, chosen, m))
+                    job.update(1.0)
+            elif mode == "backward":
+                chosen = list(preds)
+                m = fit(chosen)
+                results.append(self._row(len(chosen), chosen, m))
+                while len(chosen) > min_k:
+                    scored = [(self._crit(fit([c for c in chosen
+                                               if c != drop])), drop)
+                              for drop in chosen]
+                    _, dropc = min(scored)
+                    chosen = [c for c in chosen if c != dropc]
+                    m = fit(chosen)
+                    results.append(self._row(len(chosen), chosen, m))
+                    job.update(1.0)
+                results.reverse()
+            else:
+                raise ValueError(f"unsupported mode '{mode}'")
+            best = min(results, key=lambda r: r["deviance"])
+            best_model = fitted[tuple(sorted(best["predictors"]))]
+            model = ModelSelectionModel(
+                f"ms_{id(self) & 0xffffff:x}", self.params,
+                _spec_of(best_model), best_model, results)
+            model.training_metrics = best_model.training_metrics
+            model.output["results"] = results
+            model.output["best_predictors"] = best["predictors"]
+            return model
+
+        job.run(body)
+        self.model = job.join()
+        self.job = job
+        from h2o3_tpu import dkv
+        dkv.put(self.model.key, "model", self.model)
+        return self
+
+    @staticmethod
+    def _row(k: int, chosen: List[str], m: Model) -> Dict:
+        r2 = getattr(m.training_metrics, "r2", None)
+        return {"size": k, "predictors": list(chosen),
+                "deviance": m.residual_deviance,
+                "r2": r2, "coefficients": m.coef()}
+
+    def _train_impl(self, spec, valid_spec, job: Job):
+        raise RuntimeError("ModelSelection overrides train() directly")
+
+
+def _spec_of(model: Model):
+    class _S:
+        names = model.feature_names
+        is_cat = model.feature_is_cat
+        cat_domains = model.cat_domains
+        response = model.response
+        response_domain = model.response_domain
+        nclasses = model.nclasses
+    return _S()
+
+
+register_model_class("modelselection", ModelSelectionModel)

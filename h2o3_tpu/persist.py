@@ -36,10 +36,11 @@ def register_model_class(algo: str, cls) -> None:
 def _model_class(algo: str):
     if not _MODEL_CLASSES:
         # import the algo modules once; each registers its model class
-        from h2o3_tpu.models import (aggregator, deeplearning,  # noqa: F401
-                                     drf, ensemble, gbm, glm, isoforest,
-                                     isoforextended, isotonic, kmeans,
-                                     naivebayes, pca, svd)
+        from h2o3_tpu.models import (aggregator, anovaglm,  # noqa: F401
+                                     deeplearning, drf, ensemble, gam, gbm,
+                                     glm, isoforest, isoforextended,
+                                     isotonic, kmeans, modelselection,
+                                     naivebayes, pca, rulefit, svd)
     if algo not in _MODEL_CLASSES:
         raise ValueError(f"no registered model class for algo '{algo}'")
     return _MODEL_CLASSES[algo]
@@ -75,7 +76,8 @@ def _metrics_to_meta(m) -> Optional[Dict]:
     from h2o3_tpu.models import metrics as mm
     kind = {mm.ModelMetricsRegression: "regression",
             mm.ModelMetricsBinomial: "binomial",
-            mm.ModelMetricsMultinomial: "multinomial"}.get(type(m))
+            mm.ModelMetricsMultinomial: "multinomial",
+            mm.ModelMetricsAnomaly: "anomaly"}.get(type(m))
     if kind is None:
         return None
     import dataclasses
@@ -89,7 +91,8 @@ def _metrics_from_meta(meta: Optional[Dict]):
     from h2o3_tpu.models import metrics as mm
     cls = {"regression": mm.ModelMetricsRegression,
            "binomial": mm.ModelMetricsBinomial,
-           "multinomial": mm.ModelMetricsMultinomial}[meta["kind"]]
+           "multinomial": mm.ModelMetricsMultinomial,
+           "anomaly": mm.ModelMetricsAnomaly}[meta["kind"]]
     f = dict(meta["fields"])
     for k in ("confusion_matrix", "hit_ratios"):
         if k in f and f[k] is not None:
@@ -99,18 +102,10 @@ def _metrics_from_meta(meta: Optional[Dict]):
     return cls(**{k: v for k, v in f.items() if k in names})
 
 
-def save_model(model, path: str = ".", force: bool = False,
-               filename: Optional[str] = None) -> str:
-    """Write a model artifact; returns the artifact path (h2o.save_model
-    signature)."""
-    if os.path.isdir(path) or not os.path.splitext(path)[1]:
-        os.makedirs(path, exist_ok=True)
-        out = os.path.join(path, filename or model.key)
-    else:
-        out = path
-    if os.path.exists(out) and not force:
-        raise FileExistsError(f"{out} exists (pass force=True to overwrite)")
-    meta = {
+def model_to_meta(model) -> Dict:
+    """Model → JSON-safe metadata dict (shared by save_model and nested
+    wrapper models like StackedEnsemble/GAM/RuleFit)."""
+    return {
         "format_version": FORMAT_VERSION,
         "algo": model.algo,
         "key": model.key,
@@ -130,6 +125,33 @@ def save_model(model, path: str = ".", force: bool = False,
             model.cross_validation_metrics),
         "extra": _json_safe(model._save_extra_meta()),
     }
+
+
+def model_from_meta(meta: Dict, arrays: Dict):
+    """Inverse of model_to_meta + _save_arrays: rebuild a live Model."""
+    cls = _model_class(meta["algo"])
+    model = cls._restore(meta, arrays)
+    model.training_metrics = _metrics_from_meta(meta.get("training_metrics"))
+    model.validation_metrics = _metrics_from_meta(
+        meta.get("validation_metrics"))
+    model.cross_validation_metrics = _metrics_from_meta(
+        meta.get("cross_validation_metrics"))
+    model.scoring_history = meta.get("scoring_history") or []
+    return model
+
+
+def save_model(model, path: str = ".", force: bool = False,
+               filename: Optional[str] = None) -> str:
+    """Write a model artifact; returns the artifact path (h2o.save_model
+    signature)."""
+    if os.path.isdir(path) or not os.path.splitext(path)[1]:
+        os.makedirs(path, exist_ok=True)
+        out = os.path.join(path, filename or model.key)
+    else:
+        out = path
+    if os.path.exists(out) and not force:
+        raise FileExistsError(f"{out} exists (pass force=True to overwrite)")
+    meta = model_to_meta(model)
     arrays = {k: np.asarray(v) for k, v in model._save_arrays().items()}
     buf = io.BytesIO()
     np.savez(buf, **arrays)
@@ -147,15 +169,7 @@ def load_model(path: str):
     if meta.get("format_version", 0) > FORMAT_VERSION:
         raise ValueError(f"artifact format {meta['format_version']} is newer "
                          f"than this build ({FORMAT_VERSION})")
-    cls = _model_class(meta["algo"])
-    model = cls._restore(meta, arrays)
-    model.training_metrics = _metrics_from_meta(meta.get("training_metrics"))
-    model.validation_metrics = _metrics_from_meta(
-        meta.get("validation_metrics"))
-    model.cross_validation_metrics = _metrics_from_meta(
-        meta.get("cross_validation_metrics"))
-    model.scoring_history = meta.get("scoring_history") or []
-    return model
+    return model_from_meta(meta, arrays)
 
 
 def export_file(frame, path: str, force: bool = False, sep: str = ",") -> str:

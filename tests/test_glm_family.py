@@ -1,0 +1,131 @@
+"""GAM, ANOVA-GLM, ModelSelection, RuleFit tests (reference: hex/gam,
+hex/anovaglm, hex/modelselection, hex/rulefit test style)."""
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.models.anovaglm import H2OANOVAGLMEstimator
+from h2o3_tpu.models.gam import H2OGeneralizedAdditiveEstimator
+from h2o3_tpu.models.modelselection import H2OModelSelectionEstimator
+from h2o3_tpu.models.rulefit import H2ORuleFitEstimator
+
+
+def _smooth_frame(n=2000, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(-3, 3, n)
+    z = rng.normal(size=n)
+    y = np.sin(x) + 0.5 * z + rng.normal(scale=0.2, size=n)
+    return h2o.Frame.from_numpy({"x": x, "z": z, "y": y}), x, z, y
+
+
+def test_gam_beats_linear_on_smooth_signal():
+    fr, x, z, y = _smooth_frame()
+    from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+    lin = H2OGeneralizedLinearEstimator(Lambda=[0.0])
+    lin.train(y="y", x=["x", "z"], training_frame=fr)
+    gam = H2OGeneralizedAdditiveEstimator(gam_columns=["x"], num_knots=8)
+    gam.train(y="y", x=["x", "z"], training_frame=fr)
+    assert gam.model.rmse() < lin.model.rmse() * 0.8, (
+        gam.model.rmse(), lin.model.rmse())
+    # prediction shape + determinism
+    p1 = gam.model.predict(fr).vec("predict").to_numpy()
+    p2 = gam.model.predict(fr).vec("predict").to_numpy()
+    np.testing.assert_allclose(p1, p2)
+
+
+def test_gam_save_load(tmp_path):
+    fr, *_ = _smooth_frame(n=500, seed=2)
+    gam = H2OGeneralizedAdditiveEstimator(gam_columns=["x"], num_knots=6)
+    gam.train(y="y", x=["x", "z"], training_frame=fr)
+    p = h2o.save_model(gam.model, str(tmp_path), filename="gam")
+    m2 = h2o.load_model(p)
+    p1 = gam.model.predict(fr).vec("predict").to_numpy()
+    p2 = m2.predict(fr).vec("predict").to_numpy()
+    np.testing.assert_allclose(p1, p2, rtol=1e-5)
+
+
+def test_anovaglm_identifies_significant_terms():
+    rng = np.random.default_rng(5)
+    n = 1500
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    noise = rng.normal(size=n)          # irrelevant predictor
+    y = 2.0 * x1 + 0.0 * x2 + rng.normal(scale=0.5, size=n)
+    fr = h2o.Frame.from_numpy({"x1": x1, "noise": noise, "y": y})
+    an = H2OANOVAGLMEstimator(highest_interaction_term=1)
+    an.train(y="y", x=["x1", "noise"], training_frame=fr)
+    table = {r["term"]: r for r in an.model.anova_table}
+    assert table["x1"]["p_value"] < 1e-6
+    assert table["noise"]["p_value"] > 0.01
+    # interaction term appears when requested
+    an2 = H2OANOVAGLMEstimator(highest_interaction_term=2)
+    an2.train(y="y", x=["x1", "noise"], training_frame=fr)
+    assert any(":" in r["term"] for r in an2.model.anova_table)
+
+
+def test_modelselection_maxr_finds_true_predictors():
+    rng = np.random.default_rng(7)
+    n = 1000
+    X = rng.normal(size=(n, 5))
+    y = 3 * X[:, 0] - 2 * X[:, 2] + rng.normal(scale=0.3, size=n)
+    fr = h2o.Frame.from_numpy(
+        {**{f"x{i}": X[:, i] for i in range(5)}, "y": y})
+    ms = H2OModelSelectionEstimator(mode="maxr", max_predictor_number=3)
+    ms.train(y="y", training_frame=fr)
+    res = ms.model.result()
+    assert len(res) == 3
+    assert res[0]["predictors"] == ["x0"]           # strongest first
+    assert set(res[1]["predictors"]) == {"x0", "x2"}
+    # r2 increases with size
+    assert res[0]["r2"] < res[1]["r2"] <= res[2]["r2"] + 1e-9
+
+
+def test_modelselection_backward():
+    rng = np.random.default_rng(9)
+    n = 800
+    X = rng.normal(size=(n, 4))
+    y = X[:, 1] * 2 + rng.normal(scale=0.3, size=n)
+    fr = h2o.Frame.from_numpy(
+        {**{f"x{i}": X[:, i] for i in range(4)}, "y": y})
+    ms = H2OModelSelectionEstimator(mode="backward", min_predictor_number=1)
+    ms.train(y="y", training_frame=fr)
+    res = ms.model.result()
+    assert res[0]["predictors"] == ["x1"]           # survives to size 1
+
+
+def test_rulefit_binomial():
+    rng = np.random.default_rng(11)
+    n = 2000
+    X = rng.normal(size=(n, 4))
+    # axis-aligned boxes → ideal for rules
+    label = ((X[:, 0] > 0.5) & (X[:, 1] < 0)) | (X[:, 2] > 1.0)
+    yl = np.where(label, "yes", "no").astype(object)
+    fr = h2o.Frame.from_numpy(
+        {**{f"x{i}": X[:, i] for i in range(4)}, "y": yl})
+    rf = H2ORuleFitEstimator(max_rule_length=3, rule_generation_ntrees=20,
+                             seed=1)
+    rf.train(y="y", training_frame=fr)
+    assert rf.model.auc() > 0.95
+    imp = rf.model.rule_importance()
+    assert len(imp) >= 1
+    pred = rf.model.predict(fr)
+    assert pred.names[0] == "predict"
+
+
+def test_rulefit_regression_and_save_load(tmp_path):
+    rng = np.random.default_rng(13)
+    n = 1200
+    X = rng.normal(size=(n, 3))
+    y = np.where(X[:, 0] > 0, 3.0, -1.0) + 0.5 * X[:, 1] \
+        + rng.normal(scale=0.3, size=n)
+    fr = h2o.Frame.from_numpy(
+        {**{f"x{i}": X[:, i] for i in range(3)}, "y": y})
+    rf = H2ORuleFitEstimator(max_rule_length=2, rule_generation_ntrees=16,
+                             seed=1)
+    rf.train(y="y", training_frame=fr)
+    assert rf.model.r2() > 0.7
+    p = h2o.save_model(rf.model, str(tmp_path), filename="rf")
+    m2 = h2o.load_model(p)
+    p1 = rf.model.predict(fr).vec("predict").to_numpy()
+    p2 = m2.predict(fr).vec("predict").to_numpy()
+    np.testing.assert_allclose(p1, p2, rtol=1e-5)

@@ -184,3 +184,29 @@ def test_svd_categorical_predict_roundtrip():
     U = svd.model.predict(fr).to_numpy()   # use_all_factor_levels expansion
     assert U.shape == (n, 2)
     assert np.isfinite(U).all()
+
+
+def test_isotonic_nan_feature_does_not_poison_metrics():
+    rng = np.random.default_rng(33)
+    x = rng.uniform(0, 1, 50)
+    y = x + rng.normal(scale=0.1, size=50)
+    x[3] = np.nan
+    fr = h2o.Frame.from_numpy({"x": x, "y": y})
+    iso = H2OIsotonicRegressionEstimator()
+    iso.train(y="y", x=["x"], training_frame=fr)
+    assert np.isfinite(iso.model.training_metrics.mse)
+
+
+def test_anomaly_metrics_survive_save_load(tmp_path):
+    from h2o3_tpu.models.isoforest import H2OIsolationForestEstimator
+    rng = np.random.default_rng(37)
+    X = rng.normal(size=(300, 3)).astype(np.float32)
+    fr = h2o.Frame.from_numpy({f"x{i}": X[:, i] for i in range(3)})
+    iso = H2OIsolationForestEstimator(ntrees=8, max_depth=5, seed=1)
+    iso.train(training_frame=fr)
+    assert iso.model.training_metrics is not None
+    p = h2o.save_model(iso.model, str(tmp_path), filename="iso")
+    m2 = h2o.load_model(p)
+    assert m2.training_metrics is not None
+    assert m2.training_metrics.mean_score == pytest.approx(
+        iso.model.training_metrics.mean_score)
