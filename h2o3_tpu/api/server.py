@@ -67,6 +67,10 @@ def _builders() -> Dict[str, Any]:
             "naivebayes": est.H2ONaiveBayesEstimator,
             "gam": est.H2OGeneralizedAdditiveEstimator,
             "anovaglm": est.H2OANOVAGLMEstimator,
+            "coxph": est.H2OCoxProportionalHazardsEstimator,
+            "psvm": est.H2OSupportVectorMachineEstimator,
+            "upliftdrf": est.H2OUpliftRandomForestEstimator,
+            "word2vec": est.H2OWord2vecEstimator,
             "modelselection": est.H2OModelSelectionEstimator,
             "rulefit": est.H2ORuleFitEstimator,
             "stackedensemble": est.H2OStackedEnsembleEstimator}

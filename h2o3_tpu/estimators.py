@@ -3,6 +3,10 @@
 hand-maintained here)."""
 from h2o3_tpu.models.aggregator import H2OAggregatorEstimator
 from h2o3_tpu.models.anovaglm import H2OANOVAGLMEstimator
+from h2o3_tpu.models.coxph import H2OCoxProportionalHazardsEstimator
+from h2o3_tpu.models.psvm import H2OSupportVectorMachineEstimator
+from h2o3_tpu.models.uplift import H2OUpliftRandomForestEstimator
+from h2o3_tpu.models.word2vec import H2OWord2vecEstimator
 from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
 from h2o3_tpu.models.gam import H2OGeneralizedAdditiveEstimator
 from h2o3_tpu.models.modelselection import H2OModelSelectionEstimator
@@ -23,6 +27,9 @@ from h2o3_tpu.models.xgboost import H2OXGBoostEstimator
 
 __all__ = [
     "H2OAggregatorEstimator", "H2OANOVAGLMEstimator",
+    "H2OCoxProportionalHazardsEstimator",
+    "H2OSupportVectorMachineEstimator",
+    "H2OUpliftRandomForestEstimator", "H2OWord2vecEstimator",
     "H2OGeneralizedAdditiveEstimator", "H2OModelSelectionEstimator",
     "H2ORuleFitEstimator", "H2ODeepLearningEstimator",
     "H2ORandomForestEstimator", "H2OStackedEnsembleEstimator",

@@ -26,7 +26,7 @@ from h2o3_tpu.persist import (model_from_meta, model_to_meta,
                               register_model_class)
 
 ANOVA_DEFAULTS: Dict = dict(
-    highest_interaction_term=2, type=3, family="auto",
+    highest_interaction_term=2, type=3,
 )
 
 
@@ -128,9 +128,15 @@ class H2OANOVAGLMEstimator(ModelBuilder):
             rows = []
             for ti, (tname, tcols) in enumerate(terms.items()):
                 reduced_cols = [c for c in all_cols if c not in tcols]
-                red = self._glm(reduced_cols, y, frame, preds)
-                df_t = max(full.rank - red.rank, 1)
-                ss = max(red.residual_deviance - dev_full, 0.0)
+                if reduced_cols:
+                    red = self._glm(reduced_cols, y, frame, preds)
+                    red_dev, red_rank = red.residual_deviance, red.rank
+                else:
+                    # single-term model: the reduced fit is the null
+                    # (intercept-only) model — x=[] would mean "all cols"
+                    red_dev, red_rank = full.null_deviance, 1
+                df_t = max(full.rank - red_rank, 1)
+                ss = max(red_dev - dev_full, 0.0)
                 if family == "gaussian":
                     msr = ss / df_t
                     mse = dev_full / max(df_resid, 1)

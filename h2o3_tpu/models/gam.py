@@ -177,7 +177,9 @@ class H2OGeneralizedAdditiveEstimator(ModelBuilder):
         glm_params = {k_: v for k_, v in p.items()
                       if k_ not in GAM_DEFAULTS}
         # default smoothing: ridge on the spline block via elastic net
-        if not glm_params.get("Lambda") and not glm_params.get(
+        # (only when lambda is genuinely UNSET — an explicit 0 means the
+        # user asked for an unpenalized fit)
+        if glm_params.get("Lambda") is None and not glm_params.get(
                 "lambda_search"):
             glm_params["Lambda"] = [1e-4]
             glm_params.setdefault("alpha", 0.0)

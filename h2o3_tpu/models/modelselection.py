@@ -24,7 +24,6 @@ from h2o3_tpu.persist import (model_from_meta, model_to_meta,
 
 MS_DEFAULTS: Dict = dict(
     mode="maxr", max_predictor_number=1, min_predictor_number=1,
-    intercept=True, family="auto",
 )
 
 
@@ -126,22 +125,25 @@ class H2OModelSelectionEstimator(ModelBuilder):
                     _, addc = min(scored)
                     chosen = chosen + [addc]
                     if mode in ("maxr", "maxrsweep") and len(chosen) > 1:
-                        # replacement sweeps until no swap improves
-                        improved = True
-                        guard = 0
-                        while improved and guard < 10:
-                            improved = False
-                            guard += 1
+                        # replacement sweeps: apply the BEST single swap,
+                        # restart the scan, stop when none improves (the
+                        # candidate lists must rebuild after every accepted
+                        # swap or trials drift to a different subset size)
+                        for _ in range(10):
                             best_c = self._crit(fit(chosen))
-                            for out_c in list(chosen):
-                                for in_c in [c for c in preds
-                                             if c not in chosen]:
+                            best_swap = None
+                            for out_c in chosen:
+                                for in_c in (c for c in preds
+                                             if c not in chosen):
                                     trial = [c for c in chosen
                                              if c != out_c] + [in_c]
-                                    if self._crit(fit(trial)) < best_c - 1e-10:
-                                        chosen = trial
-                                        best_c = self._crit(fit(trial))
-                                        improved = True
+                                    cr = self._crit(fit(trial))
+                                    if cr < best_c - 1e-10:
+                                        best_c = cr
+                                        best_swap = trial
+                            if best_swap is None:
+                                break
+                            chosen = best_swap
                     m = fit(chosen)
                     results.append(self._row(k, chosen, m))
                     job.update(1.0)

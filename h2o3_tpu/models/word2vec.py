@@ -1,0 +1,263 @@
+"""Word2Vec — skip-gram word embeddings.
+
+Reference: hex/word2vec/Word2Vec.java:15 — skip-gram with hierarchical
+softmax; per-node Hogwild training with cross-node weight averaging
+(WordVectorTrainer). Input is one string column, sentences separated by
+NA rows; API: find_synonyms, transform(words, aggregate_method).
+
+TPU re-design: skip-gram with NEGATIVE SAMPLING instead of hierarchical
+softmax — HS walks a per-word Huffman path (sequential, scalar); negative
+sampling turns each step into dense [batch, k+1, D] contractions that
+batch onto the MXU, and is the standard accuracy-equivalent choice. The
+update is synchronous minibatch SGD (replaces Hogwild+averaging): grads
+of gathered rows scatter-add into the embedding tables inside one jit."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.vec import Vec
+from h2o3_tpu.jobs import Job
+from h2o3_tpu.models.model_base import Model, ModelBuilder
+from h2o3_tpu.persist import register_model_class
+
+W2V_DEFAULTS: Dict = dict(
+    vec_size=100, window_size=5, epochs=5, min_word_freq=5,
+    init_learning_rate=0.025, sent_sample_rate=1e-3, negative=5, seed=-1,
+)
+
+
+@jax.jit
+def _sgd_step(Win, Wout, center, context, negs, lr):
+    """One skip-gram negative-sampling minibatch: returns updated tables.
+
+    center [B], context [B], negs [B, K]; loss = -log σ(u·v)
+    - Σ log σ(-u_n·v). Grad of the gathers scatter-adds back (JAX turns
+    take-grad into segment-sum)."""
+    def loss_fn(Win, Wout):
+        v = Win[center]                        # [B, D]
+        u = Wout[context]                      # [B, D]
+        un = Wout[negs]                        # [B, K, D]
+        pos = jax.nn.log_sigmoid((v * u).sum(-1))
+        neg = jax.nn.log_sigmoid(-(un * v[:, None, :]).sum(-1)).sum(-1)
+        return -(pos + neg).mean()
+
+    g_in, g_out = jax.grad(loss_fn, argnums=(0, 1))(Win, Wout)
+    return Win - lr * g_in, Wout - lr * g_out
+
+
+class Word2VecModel(Model):
+    algo = "word2vec"
+    supervised = False
+
+    def __init__(self, key, params, spec, vocab: List[str], vectors):
+        super().__init__(key, params, spec)
+        self.vocab = list(vocab)
+        self.vectors = np.asarray(vectors)          # [V, D]
+        self._index = {w: i for i, w in enumerate(self.vocab)}
+
+    def find_synonyms(self, word: str, count: int = 20) -> Dict[str, float]:
+        if word not in self._index:
+            return {}
+        V = self.vectors
+        q = V[self._index[word]]
+        norms = np.linalg.norm(V, axis=1) * max(np.linalg.norm(q), 1e-30)
+        sims = (V @ q) / np.maximum(norms, 1e-30)
+        order = np.argsort(-sims)
+        out = {}
+        for i in order:
+            w = self.vocab[i]
+            if w == word:
+                continue
+            out[w] = float(sims[i])
+            if len(out) >= count:
+                break
+        return out
+
+    def transform(self, words_frame: Frame,
+                  aggregate_method: str = "none") -> Frame:
+        """Map a words column to embeddings; 'average' pools rows per
+        NA-separated sentence (h2o.transform_word2vec semantics)."""
+        v = words_frame.vecs[0]
+        words = v.to_strings()
+        D = self.vectors.shape[1]
+        E = np.zeros((len(words), D), np.float32)
+        hit = np.zeros(len(words), bool)
+        for i, w in enumerate(words):
+            j = self._index.get(w)
+            if j is not None:
+                E[i] = self.vectors[j]
+                hit[i] = True
+        if aggregate_method == "average":
+            rows = []
+            acc = np.zeros(D, np.float32)
+            cnt = 0
+            pending = False        # tokens seen since the last separator
+            for i, w in enumerate(words):
+                if w is None or w == "":
+                    rows.append(acc / cnt if cnt else np.full(D, np.nan))
+                    acc = np.zeros(D, np.float32); cnt = 0
+                    pending = False
+                else:
+                    pending = True
+                    if hit[i]:
+                        acc += E[i]; cnt += 1
+            if pending:            # no trailing separator: close last sent
+                rows.append(acc / cnt if cnt else np.full(D, np.nan))
+            E = np.stack(rows)
+        else:
+            E[~hit] = np.nan
+        names = [f"C{i + 1}" for i in range(D)]
+        return Frame(names, [Vec.from_numpy(E[:, i]) for i in range(D)])
+
+    def _predict_matrix(self, X, offset=None):
+        raise NotImplementedError("Word2Vec scores via transform()")
+
+    def _save_arrays(self):
+        return {"vectors": self.vectors}
+
+    def _save_extra_meta(self):
+        return {"vocab": self.vocab}
+
+    @classmethod
+    def _restore(cls, meta, arrays):
+        m = cls._restore_base(meta)
+        m.vocab = list(meta["extra"]["vocab"])
+        m.vectors = arrays["vectors"]
+        m._index = {w: i for i, w in enumerate(m.vocab)}
+        return m
+
+
+class H2OWord2vecEstimator(ModelBuilder):
+    algo = "word2vec"
+    supervised = False
+
+    def __init__(self, **params):
+        merged = dict(W2V_DEFAULTS)
+        merged.update(params)
+        super().__init__(**merged)
+
+    def train(self, x=None, y=None, training_frame=None,
+              validation_frame=None, **kw):
+        p = self.params
+        if training_frame is None:
+            raise ValueError("Word2Vec needs a training_frame (one words "
+                             "column, sentences separated by NA)")
+        words = training_frame.vecs[0].to_strings()
+        job = Job("word2vec", work=float(max(int(p.get("epochs", 5)), 1)))
+
+        def body(job):
+            return self._fit(words, job)
+
+        job.run(body)
+        self.model = job.join()
+        self.job = job
+        from h2o3_tpu import dkv
+        dkv.put(self.model.key, "model", self.model)
+        return self
+
+    def _fit(self, words: List[Optional[str]], job: Job) -> Word2VecModel:
+        p = self.params
+        D = int(p.get("vec_size", 100))
+        win = int(p.get("window_size", 5))
+        epochs = int(p.get("epochs", 5))
+        min_freq = int(p.get("min_word_freq", 5))
+        K = int(p.get("negative", 5))
+        lr0 = float(p.get("init_learning_rate", 0.025))
+        seed = int(p.get("seed", -1) or -1)
+        rng = np.random.default_rng(None if seed == -1 else seed)
+        # vocab
+        freq: Dict[str, int] = {}
+        for w in words:
+            if w:
+                freq[w] = freq.get(w, 0) + 1
+        vocab = sorted([w for w, c in freq.items() if c >= min_freq],
+                       key=lambda w: -freq[w])
+        if not vocab:
+            raise ValueError(f"no words reach min_word_freq={min_freq}")
+        index = {w: i for i, w in enumerate(vocab)}
+        V = len(vocab)
+        # sentences → id sequences (NA separates)
+        sents: List[List[int]] = [[]]
+        for w in words:
+            if not w:
+                if sents[-1]:
+                    sents.append([])
+            elif w in index:
+                sents[-1].append(index[w])
+        sents = [s for s in sents if len(s) >= 2]
+        counts = np.asarray([freq[w] for w in vocab], np.float64)
+        # negative-sampling table: unigram^0.75 (word2vec standard)
+        neg_p = counts ** 0.75
+        neg_p /= neg_p.sum()
+        # frequent-word subsampling threshold (sent_sample_rate)
+        samp = float(p.get("sent_sample_rate", 1e-3))
+        total = counts.sum()
+        keep_p = np.minimum(
+            1.0, np.sqrt(samp * total / np.maximum(counts, 1)) +
+            samp * total / np.maximum(counts, 1)) if samp > 0 else \
+            np.ones(V)
+        key = jax.random.PRNGKey(rng.integers(2 ** 31))
+        k1, _ = jax.random.split(key)
+        scale = 0.5 / D
+        Win = jax.random.uniform(k1, (V, D), jnp.float32, -scale, scale)
+        Wout = jnp.zeros((V, D), jnp.float32)
+        batch = 8192
+        for ep in range(epochs):
+            centers, contexts = [], []
+            for s in sents:
+                ids = np.asarray(s)
+                if samp > 0:
+                    ids = ids[rng.random(len(ids)) < keep_p[ids]]
+                for i in range(len(ids)):
+                    b = rng.integers(1, win + 1)
+                    lo, hi = max(0, i - b), min(len(ids), i + b + 1)
+                    for j in range(lo, hi):
+                        if j != i:
+                            centers.append(ids[i])
+                            contexts.append(ids[j])
+            if not centers:
+                continue
+            c = np.asarray(centers, np.int32)
+            t = np.asarray(contexts, np.int32)
+            perm = rng.permutation(len(c))
+            c, t = c[perm], t[perm]
+            lr = lr0 * max(1.0 - ep / max(epochs, 1), 0.1)
+            # pad the tail batch so one compiled step shape serves all
+            n = len(c)
+            pad = (-n) % batch
+            if pad:
+                c = np.concatenate([c, c[:pad]])
+                t = np.concatenate([t, t[:pad]])
+            negs = rng.choice(V, size=(len(c), K), p=neg_p).astype(np.int32)
+            for s0 in range(0, len(c), batch):
+                Win, Wout = _sgd_step(
+                    Win, Wout, jnp.asarray(c[s0:s0 + batch]),
+                    jnp.asarray(t[s0:s0 + batch]),
+                    jnp.asarray(negs[s0:s0 + batch]), jnp.float32(lr))
+            job.update(1.0)
+        model = Word2VecModel(f"w2v_{id(self) & 0xffffff:x}", self.params,
+                              _W2VSpec(), vocab,
+                              np.asarray(jax.device_get(Win)))
+        model.output["vocab_size"] = V
+        model.output["vec_size"] = D
+        return model
+
+    def _train_impl(self, spec, valid_spec, job: Job):
+        raise RuntimeError("Word2Vec overrides train() directly")
+
+
+class _W2VSpec:
+    names: List[str] = []
+    is_cat: List[bool] = []
+    cat_domains: Dict[str, tuple] = {}
+    response = None
+    response_domain = None
+    nclasses = 1
+
+
+register_model_class("word2vec", Word2VecModel)

@@ -37,10 +37,11 @@ def _model_class(algo: str):
     if not _MODEL_CLASSES:
         # import the algo modules once; each registers its model class
         from h2o3_tpu.models import (aggregator, anovaglm,  # noqa: F401
-                                     deeplearning, drf, ensemble, gam, gbm,
-                                     glm, isoforest, isoforextended,
-                                     isotonic, kmeans, modelselection,
-                                     naivebayes, pca, rulefit, svd)
+                                     coxph, deeplearning, drf, ensemble,
+                                     gam, gbm, glm, isoforest,
+                                     isoforextended, isotonic, kmeans,
+                                     modelselection, naivebayes, pca, psvm,
+                                     rulefit, svd, uplift, word2vec)
     if algo not in _MODEL_CLASSES:
         raise ValueError(f"no registered model class for algo '{algo}'")
     return _MODEL_CLASSES[algo]

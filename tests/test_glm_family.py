@@ -129,3 +129,30 @@ def test_rulefit_regression_and_save_load(tmp_path):
     p1 = rf.model.predict(fr).vec("predict").to_numpy()
     p2 = m2.predict(fr).vec("predict").to_numpy()
     np.testing.assert_allclose(p1, p2, rtol=1e-5)
+
+
+def test_anovaglm_single_term_uses_null_model():
+    rng = np.random.default_rng(15)
+    n = 600
+    x1 = rng.normal(size=n)
+    y = 2.0 * x1 + rng.normal(scale=0.5, size=n)
+    fr = h2o.Frame.from_numpy({"x1": x1, "y": y})
+    an = H2OANOVAGLMEstimator(highest_interaction_term=1)
+    an.train(y="y", x=["x1"], training_frame=fr)
+    # the reduced model is the null model, so a strong predictor must be
+    # hugely significant (the empty-x bug reported p=1.0 here)
+    assert an.model.anova_table[0]["p_value"] < 1e-10
+
+
+def test_modelselection_sizes_are_exact():
+    rng = np.random.default_rng(17)
+    n = 500
+    X = rng.normal(size=(n, 6))
+    y = X[:, 0] + X[:, 1] + X[:, 2] + rng.normal(scale=0.2, size=n)
+    fr = h2o.Frame.from_numpy(
+        {**{f"x{i}": X[:, i] for i in range(6)}, "y": y})
+    ms = H2OModelSelectionEstimator(mode="maxr", max_predictor_number=4)
+    ms.train(y="y", training_frame=fr)
+    for r in ms.model.result():
+        assert len(r["predictors"]) == r["size"]
+        assert len(set(r["predictors"])) == r["size"]  # no duplicates

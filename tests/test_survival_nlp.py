@@ -1,0 +1,200 @@
+"""CoxPH, Word2Vec, PSVM, UpliftDRF tests (reference: hex/coxph,
+hex/word2vec, hex/psvm, hex/tree/uplift test style)."""
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.models.coxph import H2OCoxProportionalHazardsEstimator
+from h2o3_tpu.models.psvm import H2OSupportVectorMachineEstimator
+from h2o3_tpu.models.uplift import H2OUpliftRandomForestEstimator
+from h2o3_tpu.models.word2vec import H2OWord2vecEstimator
+
+
+def _survival_frame(n=1500, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    haz = np.exp(0.8 * x1 - 0.5 * x2)
+    t = rng.exponential(1.0 / haz)
+    cens = rng.exponential(2.0, n)
+    time = np.minimum(t, cens)
+    event = (t <= cens).astype(np.float64)
+    return (h2o.Frame.from_numpy({"x1": x1, "x2": x2, "stop": time,
+                                  "event": event}),
+            np.stack([x1, x2], 1), time, event)
+
+
+def test_coxph_matches_partial_likelihood_optimum():
+    from scipy.optimize import minimize
+    fr, X, time, event = _survival_frame()
+    cox = H2OCoxProportionalHazardsEstimator(stop_column="stop",
+                                             event_column="event")
+    cox.train(x=["x1", "x2"], training_frame=fr)
+    ours = np.array([cox.model.coef()["x1"], cox.model.coef()["x2"]])
+
+    order = np.argsort(-time)
+    Xs, ev, tt = X[order], event[order], time[order]
+
+    def negll(b):
+        eta = Xs @ b
+        r = np.exp(eta)
+        S0 = np.cumsum(r)
+        last = np.zeros(len(tt), int)
+        j = len(tt) - 1
+        for i in range(len(tt) - 1, -1, -1):
+            if i < len(tt) - 1 and tt[i] != tt[i + 1]:
+                j = i
+            last[i] = j
+        return -(ev * (eta - np.log(S0[last]))).sum()
+
+    res = minimize(negll, np.zeros(2), method="BFGS")
+    np.testing.assert_allclose(ours, res.x, atol=5e-3)
+    assert cox.model.output["concordance"] > 0.65
+
+
+def test_coxph_ties_and_save_load(tmp_path):
+    rng = np.random.default_rng(3)
+    n = 400
+    x = rng.normal(size=n)
+    # integer times → heavy ties
+    time = rng.integers(1, 10, n).astype(np.float64)
+    event = rng.integers(0, 2, n).astype(np.float64)
+    fr = h2o.Frame.from_numpy({"x": x, "stop": time, "event": event})
+    cox = H2OCoxProportionalHazardsEstimator(stop_column="stop",
+                                             event_column="event")
+    cox.train(x=["x"], training_frame=fr)
+    assert np.isfinite(cox.model.coef()["x"])
+    p = h2o.save_model(cox.model, str(tmp_path), filename="cox")
+    m2 = h2o.load_model(p)
+    assert m2.coef() == cox.model.coef()
+
+
+def test_word2vec_synonyms_and_transform():
+    # tiny corpus with two clear topics
+    rng = np.random.default_rng(5)
+    topics = [["cat", "dog", "pet", "fur"], ["car", "road", "drive",
+                                             "wheel"]]
+    words = []
+    for _ in range(400):
+        t = topics[rng.integers(0, 2)]
+        sent = [t[i] for i in rng.integers(0, 4, 6)]
+        words.extend(sent)
+        words.append(None)                  # sentence separator
+    arr = np.asarray(words, dtype=object)
+    fr = h2o.Frame.from_numpy({"words": arr})
+    w2v = H2OWord2vecEstimator(vec_size=16, window_size=3, epochs=10,
+                               min_word_freq=2, seed=1)
+    w2v.train(training_frame=fr)
+    syn = w2v.model.find_synonyms("cat", 3)
+    assert len(syn) == 3
+    # same-topic words rank above cross-topic words
+    assert any(w in syn for w in ("dog", "pet", "fur")), syn
+    emb = w2v.model.transform(fr)
+    assert emb.ncol == 16
+    assert emb.nrow == fr.nrow
+
+
+def test_word2vec_save_load(tmp_path):
+    arr = np.asarray((["a", "b", "c", None] * 50), dtype=object)
+    fr = h2o.Frame.from_numpy({"words": arr})
+    w2v = H2OWord2vecEstimator(vec_size=8, epochs=2, min_word_freq=2,
+                               seed=1)
+    w2v.train(training_frame=fr)
+    p = h2o.save_model(w2v.model, str(tmp_path), filename="w2v")
+    m2 = h2o.load_model(p)
+    np.testing.assert_allclose(m2.vectors, w2v.model.vectors)
+    assert m2.vocab == w2v.model.vocab
+
+
+def test_psvm_rbf_nonlinear():
+    from sklearn.datasets import make_circles
+    X, y = make_circles(n_samples=1200, noise=0.08, factor=0.4,
+                        random_state=0)
+    lbl = np.where(y == 1, "in", "out").astype(object)
+    fr = h2o.Frame.from_numpy({"x0": X[:, 0], "x1": X[:, 1], "y": lbl})
+    svm = H2OSupportVectorMachineEstimator(gamma=2.0, hyper_param=1.0,
+                                           max_iterations=400, seed=1)
+    svm.train(y="y", training_frame=fr)
+    mm = svm.model.training_metrics
+    assert mm.auc > 0.97, mm.auc
+    # linear kernel cannot separate circles
+    lin = H2OSupportVectorMachineEstimator(kernel_type="linear",
+                                           max_iterations=200)
+    lin.train(y="y", training_frame=fr)
+    assert lin.model.training_metrics.auc < 0.7
+
+
+def test_upliftdrf_recovers_heterogeneous_effect():
+    rng = np.random.default_rng(7)
+    n = 4000
+    x = rng.normal(size=(n, 3))
+    treat = rng.integers(0, 2, n)
+    # uplift only when x0 > 0: treatment lifts response rate 0.2 → 0.6
+    base = 0.2
+    lift = np.where(x[:, 0] > 0, 0.4, 0.0)
+    p = base + treat * lift
+    y = (rng.random(n) < p).astype(int)
+    yl = np.where(y == 1, "yes", "no").astype(object)
+    tl = np.where(treat == 1, "treatment", "control").astype(object)
+    fr = h2o.Frame.from_numpy({"x0": x[:, 0], "x1": x[:, 1],
+                               "x2": x[:, 2], "treat": tl, "y": yl})
+    up = H2OUpliftRandomForestEstimator(
+        treatment_column="treat", ntrees=20, max_depth=5, seed=1,
+        uplift_metric="kl")
+    up.train(y="y", x=["x0", "x1", "x2", "treat"], training_frame=fr)
+    pred = up.model.predict(fr)
+    assert pred.names == ["uplift_predict", "p_y1_ct1", "p_y1_ct0"]
+    u = pred.vec("uplift_predict").to_numpy()
+    # predicted uplift must separate the true-uplift halves
+    assert u[x[:, 0] > 0].mean() > u[x[:, 0] <= 0].mean() + 0.15
+    assert abs(u[x[:, 0] > 0].mean() - 0.4) < 0.15
+
+
+def test_upliftdrf_save_load(tmp_path):
+    rng = np.random.default_rng(9)
+    n = 600
+    x = rng.normal(size=(n, 2))
+    treat = rng.integers(0, 2, n)
+    y = (rng.random(n) < 0.3 + 0.2 * treat * (x[:, 0] > 0)).astype(int)
+    fr = h2o.Frame.from_numpy({
+        "x0": x[:, 0], "x1": x[:, 1],
+        "treat": np.where(treat == 1, "t", "c").astype(object),
+        "y": np.where(y == 1, "y", "n").astype(object)})
+    up = H2OUpliftRandomForestEstimator(treatment_column="treat",
+                                        ntrees=5, max_depth=4, seed=1)
+    up.train(y="y", training_frame=fr)
+    p = h2o.save_model(up.model, str(tmp_path), filename="up")
+    m2 = h2o.load_model(p)
+    u1 = up.model.predict(fr).vec("uplift_predict").to_numpy()
+    u2 = m2.predict(fr).vec("uplift_predict").to_numpy()
+    np.testing.assert_allclose(u1, u2, rtol=1e-6)
+
+
+def test_word2vec_transform_trailing_separator_row_count():
+    arr = np.asarray(["a", "b", None, "b", "a", None] * 30, dtype=object)
+    fr = h2o.Frame.from_numpy({"words": arr})
+    w2v = H2OWord2vecEstimator(vec_size=4, epochs=1, min_word_freq=2,
+                               seed=1)
+    w2v.train(training_frame=fr)
+    emb = w2v.model.transform(fr, aggregate_method="average")
+    # 60 sentences, all closed by separators → exactly 60 rows
+    assert emb.nrow == 60
+
+
+def test_upliftdrf_handles_nas():
+    rng = np.random.default_rng(11)
+    n = 800
+    x = rng.normal(size=(n, 2))
+    x[rng.random(n) < 0.3, 0] = np.nan
+    treat = rng.integers(0, 2, n)
+    y = (rng.random(n) < 0.3 + 0.3 * treat).astype(int)
+    fr = h2o.Frame.from_numpy({
+        "x0": x[:, 0], "x1": x[:, 1],
+        "treat": np.where(treat == 1, "t", "c").astype(object),
+        "y": np.where(y == 1, "y", "n").astype(object)})
+    up = H2OUpliftRandomForestEstimator(treatment_column="treat",
+                                        ntrees=5, max_depth=4, seed=1)
+    up.train(y="y", training_frame=fr)
+    u = up.model.predict(fr).vec("uplift_predict").to_numpy()
+    assert np.isfinite(u).all()
+    assert abs(u.mean() - 0.3) < 0.15   # homogeneous true uplift 0.3
