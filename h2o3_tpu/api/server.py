@@ -71,6 +71,10 @@ def _builders() -> Dict[str, Any]:
             "psvm": est.H2OSupportVectorMachineEstimator,
             "upliftdrf": est.H2OUpliftRandomForestEstimator,
             "word2vec": est.H2OWord2vecEstimator,
+            "targetencoder": est.H2OTargetEncoderEstimator,
+            "infogram": est.H2OInfogram,
+            "grep": est.H2OGrepEstimator,
+            "generic": est.H2OGenericEstimator,
             "modelselection": est.H2OModelSelectionEstimator,
             "rulefit": est.H2ORuleFitEstimator,
             "stackedensemble": est.H2OStackedEnsembleEstimator}
@@ -338,8 +342,12 @@ def _train(params, body, algo):
     if isinstance(train_key, dict):
         train_key = train_key.get("name")
     if not train_key:
-        raise ApiError(400, "training_frame is required")
-    frame = dkv.get(str(train_key), "frame")
+        # Generic imports an artifact — the only builder with no frame
+        if algo != "generic":
+            raise ApiError(400, "training_frame is required")
+        frame = None
+    else:
+        frame = dkv.get(str(train_key), "frame")
     valid = None
     vk = parms.pop("validation_frame", None)
     if vk:

@@ -4,6 +4,10 @@ hand-maintained here)."""
 from h2o3_tpu.models.aggregator import H2OAggregatorEstimator
 from h2o3_tpu.models.anovaglm import H2OANOVAGLMEstimator
 from h2o3_tpu.models.coxph import H2OCoxProportionalHazardsEstimator
+from h2o3_tpu.models.infogram import H2OInfogram
+from h2o3_tpu.models.misc_models import (H2OGenericEstimator,
+                                         H2OGrepEstimator)
+from h2o3_tpu.models.targetencoder import H2OTargetEncoderEstimator
 from h2o3_tpu.models.psvm import H2OSupportVectorMachineEstimator
 from h2o3_tpu.models.uplift import H2OUpliftRandomForestEstimator
 from h2o3_tpu.models.word2vec import H2OWord2vecEstimator
@@ -27,7 +31,9 @@ from h2o3_tpu.models.xgboost import H2OXGBoostEstimator
 
 __all__ = [
     "H2OAggregatorEstimator", "H2OANOVAGLMEstimator",
-    "H2OCoxProportionalHazardsEstimator",
+    "H2OCoxProportionalHazardsEstimator", "H2OInfogram",
+    "H2OGenericEstimator", "H2OGrepEstimator",
+    "H2OTargetEncoderEstimator",
     "H2OSupportVectorMachineEstimator",
     "H2OUpliftRandomForestEstimator", "H2OWord2vecEstimator",
     "H2OGeneralizedAdditiveEstimator", "H2OModelSelectionEstimator",

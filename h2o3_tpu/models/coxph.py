@@ -47,12 +47,12 @@ def _tie_spans(ts):
 
 
 @jax.jit
-def _cox_pass(Xs, ts, event, w, beta):
+def _cox_pass(Xs, ts, event, w, beta, off):
     """One Newton iteration's (loglik, gradient, Hessian) on rows sorted
     by stop time DESCENDING. Risk set of an event at time t = all rows
     with t_j >= t, i.e. the prefix through the END of t's tie run."""
     firstpos, lastpos = _tie_spans(ts)
-    eta = Xs @ beta
+    eta = Xs @ beta + off
     r = w * jnp.exp(eta)                       # [n]
     S0 = jnp.cumsum(r)[lastpos]                # tie-closed prefix Σe^η
     S1 = jnp.cumsum(r[:, None] * Xs, axis=0)[lastpos]
@@ -135,10 +135,9 @@ class H2OCoxProportionalHazardsEstimator(ModelBuilder):
     def train(self, x=None, y=None, training_frame=None,
               validation_frame=None, **kw):
         # h2o-py: train(x=covariates, event_column=..., stop_column=...);
-        # y aliases the event column
-        if y is not None and not self.params.get("event_column"):
-            self.params["event_column"] = y
-        ev = self.params.get("event_column")
+        # y aliases the event column PER CALL (no params mutation — a
+        # later train(y=...) must not silently reuse an old column)
+        ev = y if y is not None else self.params.get("event_column")
         if ev is None:
             raise ValueError("CoxPH needs event_column (or y)")
         stop_col = self.params.get("stop_column")
@@ -186,9 +185,11 @@ class H2OCoxProportionalHazardsEstimator(ModelBuilder):
         Xc = (Xs - xm[None, :]) * (ws > 0)[:, None]
         beta = jnp.full(Fe, float(p.get("init", 0.0)), jnp.float32)
         max_iter = int(p.get("max_iterations", 20))
+        off = (jnp.zeros_like(ws) if spec.offset is None
+               else jnp.nan_to_num(spec.offset, nan=0.0)[order])
         loglik = None
         for it in range(max_iter):
-            ll, g, H = _cox_pass(Xc, ts, evs, ws, beta)
+            ll, g, H = _cox_pass(Xc, ts, evs, ws, beta, off)
             ridge = 1e-6 * jnp.eye(Fe)
             step = jnp.linalg.solve(H + ridge, g)
             nb = beta + step
@@ -201,7 +202,7 @@ class H2OCoxProportionalHazardsEstimator(ModelBuilder):
         nevents = float(jax.device_get(evs.sum()))
         # Breslow baseline cumulative hazard at event times
         firstpos, lastpos = _tie_spans(ts)
-        eta = Xc @ beta
+        eta = Xc @ beta + off
         r = ws * jnp.exp(eta)
         S0 = jnp.maximum(jnp.cumsum(r)[lastpos], 1e-30)
         dl = evs / S0

@@ -1,0 +1,140 @@
+"""Grep + Generic — the two utility model builders.
+
+Reference: hex/grep/Grep.java:19 (regex scan over a ByteVec — the
+reference's demo of a raw-bytes MRTask) and hex/generic/Generic.java
+(import a saved model artifact as a first-class Model)."""
+from __future__ import annotations
+
+import re
+from typing import Dict, List
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.vec import Vec
+from h2o3_tpu.jobs import Job
+from h2o3_tpu.models.model_base import Model, ModelBuilder
+from h2o3_tpu.persist import load_model, register_model_class
+
+GREP_DEFAULTS: Dict = dict(regex=None)
+
+
+class GrepModel(Model):
+    algo = "grep"
+    supervised = False
+
+    def __init__(self, key, params, spec, matches):
+        super().__init__(key, params, spec)
+        self.matches = matches        # list of (row, offset, text)
+
+    def _predict_matrix(self, X, offset=None):
+        raise NotImplementedError("Grep reports matches at train time")
+
+    def matches_frame(self) -> Frame:
+        rows = np.asarray([m[0] for m in self.matches], np.float64)
+        offs = np.asarray([m[1] for m in self.matches], np.float64)
+        txt = np.asarray([m[2] for m in self.matches], dtype=object)
+        return Frame(["row", "offset", "match"],
+                     [Vec.from_numpy(rows), Vec.from_numpy(offs),
+                      Vec.from_numpy(txt)])
+
+    def _save_extra_meta(self):
+        return {"matches": [[int(r), int(o), t]
+                            for r, o, t in self.matches]}
+
+    @classmethod
+    def _restore(cls, meta, arrays):
+        m = cls._restore_base(meta)
+        m.matches = [tuple(x) for x in meta["extra"]["matches"]]
+        return m
+
+
+class H2OGrepEstimator(ModelBuilder):
+    algo = "grep"
+    supervised = False
+
+    def __init__(self, **params):
+        merged = dict(GREP_DEFAULTS)
+        merged.update(params)
+        super().__init__(**merged)
+
+    def train(self, x=None, y=None, training_frame=None,
+              validation_frame=None, **kw):
+        rx = self.params.get("regex")
+        if not rx:
+            raise ValueError("Grep needs a regex")
+        if training_frame is None:
+            raise ValueError("Grep needs a training_frame")
+        pat = re.compile(rx)
+        job = Job("grep", work=float(training_frame.ncol))
+
+        def body(job):
+            matches: List = []
+            for v in training_frame.vecs:
+                if v.type not in ("string", "enum"):
+                    job.update(1.0)
+                    continue
+                for i, s in enumerate(v.to_strings()):
+                    if not s:
+                        continue
+                    for mt in pat.finditer(s):
+                        matches.append((i, mt.start(), mt.group()))
+                job.update(1.0)
+            model = GrepModel(f"grep_{id(self) & 0xffffff:x}", self.params,
+                              _GrepSpec(), matches)
+            model.output["matches"] = [m[2] for m in matches]
+            model.output["n_matches"] = len(matches)
+            return model
+
+        job.run(body)
+        self.model = job.join()
+        self.job = job
+        return self
+
+    def _train_impl(self, spec, valid_spec, job: Job):
+        raise RuntimeError("Grep overrides train() directly")
+
+
+class _GrepSpec:
+    names: List[str] = []
+    is_cat: List[bool] = []
+    cat_domains: Dict[str, tuple] = {}
+    response = None
+    response_domain = None
+    nclasses = 1
+
+
+class H2OGenericEstimator(ModelBuilder):
+    """Import a saved artifact as a first-class model
+    (hex/generic/Generic.java — MOJO import; here: our zip artifact)."""
+    algo = "generic"
+    supervised = False
+
+    def __init__(self, **params):
+        super().__init__(**params)
+
+    def train(self, x=None, y=None, training_frame=None,
+              validation_frame=None, **kw):
+        path = self.params.get("path") or self.params.get("model_key")
+        if not path:
+            raise ValueError("Generic needs path= to a saved model "
+                             "artifact")
+        job = Job("generic import", work=1.0)
+
+        def body(job):
+            model = load_model(path)
+            model.output["generic_source"] = path
+            return model
+
+        job.run(body)
+        self.model = job.join()
+        self.job = job
+        from h2o3_tpu import dkv
+        dkv.put(self.model.key, "model", self.model)
+        return self
+
+    def _train_impl(self, spec, valid_spec, job: Job):
+        raise RuntimeError("Generic overrides train() directly")
+
+
+register_model_class("grep", GrepModel)

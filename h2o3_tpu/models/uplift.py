@@ -221,6 +221,7 @@ class H2OUpliftRandomForestEstimator(ModelBuilder):
                      (("feat", np.int32), ("thr", np.float32),
                       ("is_split", bool), ("pt", np.float32),
                       ("pc", np.float32))}
+        built = 0
         step = jax.jit(_level_step,
                        static_argnames=("base", "N", "B", "metric",
                                         "min_rows"))
@@ -299,9 +300,13 @@ class H2OUpliftRandomForestEstimator(ModelBuilder):
             all_trees["is_split"][t] = is_split
             all_trees["pt"][t] = pt_leaf
             all_trees["pc"][t] = pc_leaf
-            job.set_progress((t + 1) / ntrees)
+            built = t + 1
+            job.set_progress(built / ntrees)
             if job.cancel_requested:
                 break
+        # keep only the trees actually built (cancel mid-run must not
+        # average in preallocated zero trees)
+        all_trees = {k: v[:built] for k, v in all_trees.items()}
         sub_spec = TrainingSpec(
             X=Xf, y=spec.y, w=w, offset=None, names=names, is_cat=is_cat,
             cat_domains={k: v for k, v in spec.cat_domains.items()

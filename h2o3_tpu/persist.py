@@ -40,8 +40,10 @@ def _model_class(algo: str):
                                      coxph, deeplearning, drf, ensemble,
                                      gam, gbm, glm, isoforest,
                                      isoforextended, isotonic, kmeans,
+                                     infogram, misc_models,
                                      modelselection, naivebayes, pca, psvm,
-                                     rulefit, svd, uplift, word2vec)
+                                     rulefit, svd, targetencoder, uplift,
+                                     word2vec)
     if algo not in _MODEL_CLASSES:
         raise ValueError(f"no registered model class for algo '{algo}'")
     return _MODEL_CLASSES[algo]

@@ -1,0 +1,167 @@
+"""TargetEncoder, Infogram, Grep, Generic tests (reference:
+h2o-extensions/target-encoder, h2o-admissibleml, hex/grep, hex/generic
+test style)."""
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.models.infogram import H2OInfogram
+from h2o3_tpu.models.misc_models import (H2OGenericEstimator,
+                                         H2OGrepEstimator)
+from h2o3_tpu.models.targetencoder import H2OTargetEncoderEstimator
+
+
+def _te_frame(n=3000, seed=0):
+    rng = np.random.default_rng(seed)
+    levels = np.array(["a", "b", "c", "d"], dtype=object)
+    c = rng.integers(0, 4, n)
+    rates = np.array([0.1, 0.4, 0.7, 0.9])
+    y = (rng.random(n) < rates[c]).astype(float)
+    return (h2o.Frame.from_numpy({"cat": levels[c],
+                                  "num": rng.normal(size=n), "y": y}),
+            c, rates, y)
+
+
+def test_target_encoder_means_and_blending():
+    fr, c, rates, y = _te_frame()
+    te = H2OTargetEncoderEstimator(blending=False,
+                                   data_leakage_handling="none", noise=0)
+    te.train(x=["cat"], y="y", training_frame=fr)
+    out = te.model.transform(fr)
+    assert "cat_te" in out.names
+    enc = out.vec("cat_te").to_numpy()
+    # per-level encoding equals the level's empirical target mean
+    for lvl in range(4):
+        emp = y[c == lvl].mean()
+        assert enc[c == lvl][0] == pytest.approx(emp, abs=1e-5)
+    # blending pulls rare levels toward the prior
+    te_b = H2OTargetEncoderEstimator(blending=True, inflection_point=5000,
+                                     smoothing=1, noise=0)
+    te_b.train(x=["cat"], y="y", training_frame=fr)
+    enc_b = te_b.model.transform(fr).vec("cat_te").to_numpy()
+    prior = y.mean()
+    for lvl in range(4):
+        raw = y[c == lvl].mean()
+        got = enc_b[c == lvl][0]
+        # with inflection >> n, lambda ~ 0 → encoding ≈ prior
+        assert abs(got - prior) < abs(raw - prior) + 1e-9
+
+
+def test_target_encoder_loo_excludes_own_row():
+    fr, c, rates, y = _te_frame(n=500, seed=3)
+    te = H2OTargetEncoderEstimator(blending=False,
+                                   data_leakage_handling="leave_one_out",
+                                   noise=0)
+    te.train(x=["cat"], y="y", training_frame=fr)
+    enc = te.model.transform(fr, as_training=True).vec("cat_te").to_numpy()
+    lvl = 0
+    idx = np.flatnonzero(c == lvl)
+    i = idx[0]
+    expect = (y[idx].sum() - y[i]) / (len(idx) - 1)
+    assert enc[i] == pytest.approx(expect, abs=1e-5)
+    # scoring transform (as_training=False) uses full stats
+    enc_score = te.model.transform(fr).vec("cat_te").to_numpy()
+    assert enc_score[i] == pytest.approx(y[idx].mean(), abs=1e-5)
+
+
+def test_target_encoder_save_load_and_unseen_level(tmp_path):
+    fr, *_ = _te_frame(n=400, seed=5)
+    te = H2OTargetEncoderEstimator(noise=0)
+    te.train(x=["cat"], y="y", training_frame=fr)
+    p = h2o.save_model(te.model, str(tmp_path), filename="te")
+    m2 = h2o.load_model(p)
+    # unseen level → prior
+    fr2 = h2o.Frame.from_numpy(
+        {"cat": np.asarray(["zzz", "a"], dtype=object),
+         "num": np.zeros(2), "y": np.zeros(2)})
+    enc = m2.transform(fr2).vec("cat_te").to_numpy()
+    assert enc[0] == pytest.approx(m2.prior, abs=1e-4)
+
+
+def test_infogram_separates_relevant_features():
+    rng = np.random.default_rng(7)
+    n = 1500
+    strong = rng.normal(size=n)
+    weak = rng.normal(size=n)
+    noise = rng.normal(size=n)
+    y = (strong + 0.2 * weak + rng.normal(scale=0.3, size=n) > 0)
+    fr = h2o.Frame.from_numpy({
+        "strong": strong, "weak": weak, "noise": noise,
+        "y": np.where(y, "yes", "no").astype(object)})
+    ig = H2OInfogram(cmi_ntrees=8, cmi_max_depth=3, seed=1)
+    ig.train(y="y", training_frame=fr)
+    t = {r["column"]: r for r in ig.model.infogram_table}
+    assert t["strong"]["cmi"] > t["noise"]["cmi"]
+    assert t["strong"]["relevance"] > t["noise"]["relevance"]
+    assert "strong" in ig.model.get_admissible_features()
+
+
+def test_grep_finds_matches():
+    arr = np.asarray(["error: disk full", "ok", "fatal error at 3",
+                      None, "clean"], dtype=object)
+    fr = h2o.Frame.from_numpy({"log": arr})
+    g = H2OGrepEstimator(regex=r"error")
+    g.train(training_frame=fr)
+    assert g.model.output["n_matches"] == 2
+    mf = g.model.matches_frame()
+    assert mf.nrow == 2
+    assert set(mf.vec("row").to_numpy().astype(int)) == {0, 2}
+
+
+def test_generic_imports_saved_model(tmp_path):
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    rng = np.random.default_rng(9)
+    n = 400
+    X = rng.normal(size=(n, 3))
+    y = X[:, 0] * 2 + rng.normal(scale=0.3, size=n)
+    fr = h2o.Frame.from_numpy(
+        {**{f"x{i}": X[:, i] for i in range(3)}, "y": y})
+    gbm = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=1)
+    gbm.train(y="y", training_frame=fr)
+    p = h2o.save_model(gbm.model, str(tmp_path), filename="m")
+    gen = H2OGenericEstimator(path=p)
+    gen.train()
+    p1 = gbm.model.predict(fr).vec("predict").to_numpy()
+    p2 = gen.model.predict(fr).vec("predict").to_numpy()
+    np.testing.assert_allclose(p1, p2, rtol=1e-6)
+
+
+def test_target_encoder_weighted_loo():
+    rng = np.random.default_rng(11)
+    n = 300
+    levels = np.array(["a", "b"], dtype=object)
+    c = rng.integers(0, 2, n)
+    y = rng.random(n)
+    w = np.full(n, 2.0)
+    fr = h2o.Frame.from_numpy({"cat": levels[c], "y": y, "w": w})
+    te = H2OTargetEncoderEstimator(blending=False, noise=0,
+                                   data_leakage_handling="leave_one_out",
+                                   weights_column="w")
+    te.train(x=["cat"], y="y", training_frame=fr)
+    enc = te.model.transform(fr, as_training=True).vec("cat_te").to_numpy()
+    lvl_rows = np.flatnonzero(c == 0)
+    i = lvl_rows[0]
+    # with uniform weight 2: (2*sum - 2*y_i)/(2*n - 2) = leave-one-out mean
+    expect = (y[lvl_rows].sum() - y[i]) / (len(lvl_rows) - 1)
+    assert enc[i] == pytest.approx(expect, abs=1e-5)
+
+
+def test_upliftdrf_cancel_safe_tree_count():
+    # indirectly verify the built-trees slice: ntrees=1 model averages
+    # exactly one tree, not a padded array
+    from h2o3_tpu.models.uplift import H2OUpliftRandomForestEstimator
+    rng = np.random.default_rng(13)
+    n = 400
+    x = rng.normal(size=(n, 2))
+    treat = rng.integers(0, 2, n)
+    y = (rng.random(n) < 0.4 + 0.3 * treat).astype(int)
+    fr = h2o.Frame.from_numpy({
+        "x0": x[:, 0], "x1": x[:, 1],
+        "treat": np.where(treat == 1, "t", "c").astype(object),
+        "y": np.where(y == 1, "y", "n").astype(object)})
+    up = H2OUpliftRandomForestEstimator(treatment_column="treat",
+                                        ntrees=3, max_depth=3, seed=1)
+    up.train(y="y", training_frame=fr)
+    assert up.model._feat.shape[0] == 3
+    u = up.model.predict(fr).vec("uplift_predict").to_numpy()
+    assert abs(u.mean() - 0.3) < 0.15
