@@ -19,6 +19,8 @@ from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.frame.vec import Vec
 from h2o3_tpu.ingest.parse import import_file, parse_setup, upload_numpy
 from h2o3_tpu.parallel.mesh import current_mesh, set_mesh, make_mesh
+from h2o3_tpu.mojo import import_mojo
+from h2o3_tpu.mojo import export_mojo as download_mojo
 from h2o3_tpu.persist import export_file, load_model, save_model
 
 __version__ = "0.2.0"
@@ -36,6 +38,8 @@ __all__ = [
     "save_model",
     "load_model",
     "export_file",
+    "download_mojo",
+    "import_mojo",
 ]
 
 

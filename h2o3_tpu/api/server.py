@@ -66,6 +66,7 @@ def _builders() -> Dict[str, Any]:
             "aggregator": est.H2OAggregatorEstimator,
             "naivebayes": est.H2ONaiveBayesEstimator,
             "gam": est.H2OGeneralizedAdditiveEstimator,
+            "glrm": est.H2OGeneralizedLowRankEstimator,
             "anovaglm": est.H2OANOVAGLMEstimator,
             "coxph": est.H2OCoxProportionalHazardsEstimator,
             "psvm": est.H2OSupportVectorMachineEstimator,
@@ -334,7 +335,8 @@ def _train(params, body, algo):
     raw_keep = {k: params[k] for k in ("model_id", "training_frame",
                                        "validation_frame",
                                        "response_column", "fold_column",
-                                       "weights_column", "offset_column")
+                                       "weights_column", "offset_column",
+                                       "regex", "path")
                 if k in params}
     parms = {k: _coerce(v) for k, v in params.items()}
     parms.update(raw_keep)

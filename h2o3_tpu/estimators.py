@@ -13,6 +13,7 @@ from h2o3_tpu.models.uplift import H2OUpliftRandomForestEstimator
 from h2o3_tpu.models.word2vec import H2OWord2vecEstimator
 from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
 from h2o3_tpu.models.gam import H2OGeneralizedAdditiveEstimator
+from h2o3_tpu.models.glrm import H2OGeneralizedLowRankEstimator
 from h2o3_tpu.models.modelselection import H2OModelSelectionEstimator
 from h2o3_tpu.models.rulefit import H2ORuleFitEstimator
 from h2o3_tpu.models.drf import H2ORandomForestEstimator
@@ -36,7 +37,8 @@ __all__ = [
     "H2OTargetEncoderEstimator",
     "H2OSupportVectorMachineEstimator",
     "H2OUpliftRandomForestEstimator", "H2OWord2vecEstimator",
-    "H2OGeneralizedAdditiveEstimator", "H2OModelSelectionEstimator",
+    "H2OGeneralizedAdditiveEstimator", "H2OGeneralizedLowRankEstimator",
+    "H2OModelSelectionEstimator",
     "H2ORuleFitEstimator", "H2ODeepLearningEstimator",
     "H2ORandomForestEstimator", "H2OStackedEnsembleEstimator",
     "H2OGradientBoostingEstimator", "H2OGeneralizedLinearEstimator",

@@ -1,0 +1,261 @@
+"""GLRM — generalized low-rank model A ≈ X·Y.
+
+Reference: hex/glrm/GLRM.java:52 — alternating minimization with a
+loss/regularizer zoo: the X update runs as an MRTask over rows, Y
+updates on the driver; missing cells are simply excluded from the loss
+(GLRM's headline use: imputation / compression of mixed frames).
+
+TPU re-design: X [rows, k] is row-sharded with the frame, Y [k, Fe]
+replicated; each alternating step is a masked dense matmul pair
+(residual = mask·(XY − A); grad_X = r·Yᵀ, grad_Y = Xᵀ·r — both MXU
+contractions with GSPMD psums over the row shards), followed by an
+elementwise proximal map (quadratic / L1-shrink / non-negative
+projection). The whole alternation runs inside one jitted lax.scan."""
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.jobs import Job
+from h2o3_tpu.models.glm import expand_design, expand_scoring_matrix
+from h2o3_tpu.models.model_base import (Model, ModelBuilder, TrainingSpec,
+                                        pack_impute_means,
+                                        unpack_impute_means)
+from h2o3_tpu.persist import register_model_class
+
+GLRM_DEFAULTS: Dict = dict(
+    k=1, loss="quadratic", regularization_x="none",
+    regularization_y="none", gamma_x=0.0, gamma_y=0.0,
+    max_iterations=100, init="svd", transform="none", seed=-1,
+)
+
+
+def _prox(M, reg: str, step_gamma):
+    if reg == "quadratic":
+        return M / (1.0 + 2.0 * step_gamma)
+    if reg in ("l1", "one_sparse"):
+        return jnp.sign(M) * jnp.maximum(jnp.abs(M) - step_gamma, 0.0)
+    if reg in ("non_negative", "nonnegative"):
+        return jnp.maximum(M, 0.0)
+    return M
+
+
+@partial(jax.jit, static_argnames=("iters", "reg_x", "reg_y"))
+def _alternate(A, mask, X0, Y0, gamma_x, gamma_y, iters: int,
+               reg_x: str, reg_y: str):
+    """Masked alternating proximal gradient; returns (X, Y, objective)."""
+
+    def step(carry, _):
+        X, Y = carry
+        # X update: prox gradient with the EXACT per-row Lipschitz
+        # constant λmax(YYᵀ) — a k×k eigh, cheap at any rank
+        Ly = jnp.maximum(jnp.linalg.eigvalsh(Y @ Y.T)[-1], 1e-8)
+        R = mask * (X @ Y - A)
+        X = _prox(X - (R @ Y.T) / Ly, reg_x, gamma_x / Ly)
+        # Y update: λmax(XᵀX)
+        Lx = jnp.maximum(jnp.linalg.eigvalsh(X.T @ X)[-1], 1e-8)
+        R = mask * (X @ Y - A)
+        Y = _prox(Y - (X.T @ R) / Lx, reg_y, gamma_y / Lx)
+        return (X, Y), None
+
+    (X, Y), _ = jax.lax.scan(step, (X0, Y0), None, length=iters)
+    R = mask * (X @ Y - A)
+    obj = (R * R).sum()
+    return X, Y, obj
+
+
+class GLRMModel(Model):
+    algo = "glrm"
+    supervised = False
+
+    def __init__(self, key, params, spec, Y, xm, xs, exp_names,
+                 impute_means, objective):
+        super().__init__(key, params, spec)
+        self.archetypes_y = np.asarray(Y)        # [k, Fe]
+        self._xm = np.asarray(xm)
+        self._xs = np.asarray(xs)
+        self.exp_names = list(exp_names)
+        self.impute_means = dict(impute_means)
+        self.objective = float(objective)
+        self.use_all_levels = False
+
+    def _solve_x(self, Xe, mask, iters: int = 30):
+        """Project new rows onto the fixed archetypes (the reference's
+        scoring-side X solve)."""
+        k = self.archetypes_y.shape[0]
+        Y = jnp.asarray(self.archetypes_y)
+        X = jnp.zeros((Xe.shape[0], k), jnp.float32)
+        p = self.params
+        gx = jnp.float32(p.get("gamma_x", 0.0))
+        reg_x = (p.get("regularization_x") or "none").lower()
+        Ly = jnp.maximum(jnp.linalg.eigvalsh(Y @ Y.T)[-1], 1e-8)
+        for _ in range(iters):
+            R = mask * (X @ Y - Xe)
+            X = _prox(X - (R @ Y.T) / Ly, reg_x, gx / Ly)
+        return X
+
+    def _scale(self, Xe):
+        return (Xe - jnp.asarray(self._xm)[None]) / \
+            jnp.asarray(self._xs)[None]
+
+    def _expanded_mask(self, Xraw):
+        """Observed-cell mask in expanded-column space, from the RAW
+        feature matrix (expand_scoring_matrix mean-imputes NAs, so the
+        mask must be derived before expansion or every hole would score
+        as an observed mean)."""
+        cols = []
+        for i, (n, is_cat) in enumerate(zip(self.feature_names,
+                                            self.feature_is_cat)):
+            isna = jnp.isnan(Xraw[:, i])
+            # EXACTLY expand_design's column count: card-1 indicators per
+            # enum (0 for a single-level enum), 1 per numeric
+            reps = (len(self.cat_domains.get(n, ())) - 1 if is_cat else 1)
+            if reps > 0:
+                cols.extend([~isna] * reps)
+        return jnp.stack(cols, axis=1).astype(jnp.float32)
+
+    def predict(self, frame):
+        """Reconstruction of the input columns (reconstructed frame —
+        'reconstruct_train' semantics)."""
+        from h2o3_tpu.frame.frame import Frame
+        from h2o3_tpu.frame.vec import Vec
+        from h2o3_tpu.models.model_base import adapt_test_matrix
+        Xraw = adapt_test_matrix(self, frame)
+        Xe = expand_scoring_matrix(self, Xraw)
+        mask = self._expanded_mask(Xraw)
+        Xs = jnp.nan_to_num(self._scale(Xe), nan=0.0) * mask
+        X = self._solve_x(Xs, mask)
+        recon = X @ jnp.asarray(self.archetypes_y)
+        recon = recon * jnp.asarray(self._xs)[None] + \
+            jnp.asarray(self._xm)[None]
+        R = np.asarray(jax.device_get(recon))[: frame.nrow]
+        names = [f"reconstr_{n}" for n in self.exp_names]
+        return Frame(names, [Vec.from_numpy(R[:, i].astype(np.float32))
+                             for i in range(R.shape[1])])
+
+    def transform_frame(self, frame):
+        """Row archetype weights X for new rows (x() factor output)."""
+        from h2o3_tpu.frame.frame import Frame
+        from h2o3_tpu.frame.vec import Vec
+        from h2o3_tpu.models.model_base import adapt_test_matrix
+        Xraw = adapt_test_matrix(self, frame)
+        Xe = expand_scoring_matrix(self, Xraw)
+        mask = self._expanded_mask(Xraw)
+        Xs = jnp.nan_to_num(self._scale(Xe), nan=0.0) * mask
+        X = self._solve_x(Xs, mask)
+        Xh = np.asarray(jax.device_get(X))[: frame.nrow]
+        return Frame([f"Arch{i + 1}" for i in range(Xh.shape[1])],
+                     [Vec.from_numpy(Xh[:, i].astype(np.float32))
+                      for i in range(Xh.shape[1])])
+
+    def _predict_matrix(self, X, offset=None):
+        raise NotImplementedError("GLRM scores via predict(frame)")
+
+    def _save_arrays(self):
+        return {"Y": self.archetypes_y, "xm": self._xm, "xs": self._xs,
+                **pack_impute_means(self.impute_means)}
+
+    def _save_extra_meta(self):
+        return {"exp_names": self.exp_names, "objective": self.objective}
+
+    @classmethod
+    def _restore(cls, meta, arrays):
+        m = cls._restore_base(meta)
+        m.archetypes_y = arrays["Y"]
+        m._xm = arrays["xm"]
+        m._xs = arrays["xs"]
+        m.exp_names = list(meta["extra"]["exp_names"])
+        m.objective = meta["extra"]["objective"]
+        m.impute_means = unpack_impute_means(arrays)
+        m.use_all_levels = False
+        return m
+
+
+class H2OGeneralizedLowRankEstimator(ModelBuilder):
+    algo = "glrm"
+    supervised = False
+
+    def __init__(self, **params):
+        merged = dict(GLRM_DEFAULTS)
+        merged.update(params)
+        super().__init__(**merged)
+
+    def _train_impl(self, spec: TrainingSpec, valid_spec, job: Job):
+        p = self.params
+        k = int(p.get("k", 1))
+        # NA-preserving expansion: expand_design mean-imputes numerics,
+        # but GLRM must EXCLUDE missing cells from the loss — rebuild
+        # the NA mask from the raw spec
+        Xe, exp_names, means = expand_design(spec)
+        Fe = Xe.shape[1]
+        k = min(k, Fe)
+        w = spec.w
+        live = (w > 0)
+        # mask: per expanded column, NA where the source column was NA
+        na_cols = []
+        for i, (n, is_cat) in enumerate(zip(spec.names, spec.is_cat)):
+            x = spec.X[:, i]
+            if is_cat:
+                card = len(spec.cat_domains.get(n, ())) or int(
+                    jax.device_get(jnp.nanmax(jnp.where(
+                        jnp.isnan(x), 0.0, x)))) + 1
+                reps = card - 1   # expand_design emits card-1 indicators
+            else:
+                reps = 1
+            if reps > 0:
+                na_cols.extend([jnp.isnan(x)] * reps)
+        na = jnp.stack(na_cols, axis=1)
+        mask = ((~na) & live[:, None]).astype(jnp.float32)
+        transform = (p.get("transform") or "none").lower()
+        wsum = jnp.maximum((mask.sum(0)), 1e-12)
+        if transform in ("standardize", "demean", "center"):
+            xm = (Xe * mask).sum(0) / wsum
+        else:
+            xm = jnp.zeros(Fe, jnp.float32)
+        if transform == "standardize":
+            xv = (mask * (Xe - xm[None]) ** 2).sum(0) / wsum
+            xs = jnp.sqrt(jnp.maximum(xv, 1e-12))
+        else:
+            xs = jnp.ones(Fe, jnp.float32)
+        A = ((Xe - xm[None]) / xs[None]) * mask
+        seed = int(p.get("seed", -1) or -1)
+        key = jax.random.PRNGKey(seed if seed != -1
+                                 else int(time.time() * 1e3) % (2 ** 31))
+        init = (p.get("init") or "svd").lower()
+        if init in ("svd", "power"):
+            G = jax.lax.dot_general(A, A, (((0,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            vals, vecs = jnp.linalg.eigh(G)
+            order = jnp.argsort(-vals)
+            Y0 = vecs[:, order][:, :k].T * jnp.sqrt(
+                jnp.maximum(vals[order][:k], 0.0))[:, None]
+            X0 = jnp.zeros((A.shape[0], k), jnp.float32)
+        else:
+            k1, k2 = jax.random.split(key)
+            Y0 = jax.random.normal(k1, (k, Fe)) * 0.1
+            X0 = jax.random.normal(k2, (A.shape[0], k)) * 0.1
+        iters = int(p.get("max_iterations", 100))
+        X, Y, obj = _alternate(
+            A, mask, X0, Y0, jnp.float32(p.get("gamma_x", 0.0)),
+            jnp.float32(p.get("gamma_y", 0.0)), iters,
+            (p.get("regularization_x") or "none").lower(),
+            (p.get("regularization_y") or "none").lower())
+        job.set_progress(1.0)
+        model = GLRMModel(
+            f"glrm_{id(self) & 0xffffff:x}", self.params, spec,
+            jax.device_get(Y), jax.device_get(xm), jax.device_get(xs),
+            exp_names, {k_: float(jax.device_get(v))
+                        for k_, v in means.items()},
+            float(jax.device_get(obj)))
+        model.output["objective"] = model.objective
+        model.output["archetypes"] = model.archetypes_y.tolist()
+        model.output["iterations"] = iters
+        return model
+
+
+register_model_class("glrm", GLRMModel)

@@ -122,8 +122,15 @@ class H2OGenericEstimator(ModelBuilder):
         job = Job("generic import", work=1.0)
 
         def body(job):
-            model = load_model(path)
-            model.output["generic_source"] = path
+            import zipfile
+            with zipfile.ZipFile(path) as zf:
+                is_mojo = "model.ini" in zf.namelist()
+            if is_mojo:
+                from h2o3_tpu.mojo import import_mojo
+                model = import_mojo(path)
+            else:
+                model = load_model(path)
+                model.output["generic_source"] = path
             return model
 
         job.run(body)

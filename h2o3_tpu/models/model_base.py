@@ -387,6 +387,14 @@ class Model:
         m = self.validation_metrics if valid else self.training_metrics
         return getattr(m, name, None)
 
+    def download_mojo(self, path: str = ".", get_genmodel_jar: bool = False):
+        """Export as an h2o-genmodel-readable MOJO zip (tree models)."""
+        import os
+        from h2o3_tpu.mojo import export_mojo
+        if os.path.isdir(path):
+            path = os.path.join(path, f"{self.key}.zip")
+        return export_mojo(self, path)
+
     def auc(self, valid=False):
         return self._metric("auc", valid)
 

@@ -77,20 +77,15 @@ class TargetEncoderModel(Model):
         wc = p.get("weights_column")
         wrow = (frame.vec(wc).asnumeric().to_numpy()
                 if as_training and wc and wc in frame else None)
+        # one shared domain remap for all encoded columns (the
+        # adaptTestForTrain path — no per-column hand-rolled LUTs)
+        from h2o3_tpu.models.model_base import adapt_test_matrix
+        import jax as _jax
+        Xadapt = np.asarray(_jax.device_get(adapt_test_matrix(self, frame)))
         for col in self.encodings:
-            if col not in frame:
+            if col not in frame or col not in self.feature_names:
                 continue
-            v = frame.vec(col)
-            dom = self.cat_domains.get(col, ())
-            # map the frame's levels through the TRAINING domain
-            codes = np.asarray(v.to_numpy())
-            if v.is_categorical and tuple(v.domain or ()) != tuple(dom):
-                remap = {lvl: i for i, lvl in enumerate(dom)}
-                src = v.domain or ()
-                lut = np.asarray([remap.get(l, -1) for l in src] + [-1])
-                codes = lut[np.where(np.isnan(codes), len(src),
-                                     codes).astype(int)].astype(float)
-                codes = np.where(codes < 0, np.nan, codes)
+            codes = Xadapt[: frame.nrow, self.feature_names.index(col)]
             s, n = self.encodings[col]
             card = len(s)
             c = np.where(np.isnan(codes), card, codes).astype(int)
@@ -101,7 +96,11 @@ class TargetEncoderModel(Model):
             row_n = n_ext[c]
             if as_training and y is not None:
                 yv = np.nan_to_num(y, nan=self.prior)
-                wv = wrow if wrow is not None else np.ones_like(yv)
+                wv = (wrow.copy() if wrow is not None
+                      else np.ones_like(yv))
+                # rows the TRAINING stats excluded (NaN response) must
+                # not be subtracted back out
+                wv[np.isnan(y)] = 0.0
                 if handling in ("leave_one_out", "loo"):
                     row_s = row_s - wv * yv
                     row_n = row_n - wv
@@ -157,6 +156,12 @@ class H2OTargetEncoderEstimator(ModelBuilder):
         merged = dict(TE_DEFAULTS)
         merged.update(params)
         super().__init__(**merged)
+
+    def _cross_validate(self, model, frame, y, x, spec, job, nfolds,
+                        fold_column):
+        """fold_column selects the kfold ENCODING folds — the encoder is
+        not a predictive model, generic CV does not apply."""
+        return None
 
     def _train_impl(self, spec: TrainingSpec, valid_spec, job: Job):
         y = spec.y.astype(jnp.float32)

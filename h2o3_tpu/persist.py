@@ -38,7 +38,7 @@ def _model_class(algo: str):
         # import the algo modules once; each registers its model class
         from h2o3_tpu.models import (aggregator, anovaglm,  # noqa: F401
                                      coxph, deeplearning, drf, ensemble,
-                                     gam, gbm, glm, isoforest,
+                                     gam, gbm, glm, glrm, isoforest,
                                      isoforextended, isotonic, kmeans,
                                      infogram, misc_models,
                                      modelselection, naivebayes, pca, psvm,

@@ -1,0 +1,193 @@
+"""MOJO export/import round-trip tests.
+
+The export writer and the reader/scorer in h2o3_tpu/mojo.py are
+independent implementations of the reference wire format
+(hex/genmodel/algos/tree/SharedTreeMojoModel.scoreTree + ModelMojoReader
+model.ini contract), so in-process round-trip parity is meaningful
+evidence the bytes are genmodel-readable (the reference's MOJO parity
+test strategy, testdir_javapredict)."""
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.models.drf import H2ORandomForestEstimator
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+from h2o3_tpu.mojo import import_mojo, read_mojo
+
+
+def _frame(nclass, n=800, seed=0, with_cat=True):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 3))
+    data = {f"x{i}": X[:, i] for i in range(3)}
+    if with_cat:
+        data["c"] = np.array(["u", "v", "w"], dtype=object)[
+            rng.integers(0, 3, n)]
+        shift = np.where(data["c"] == "w", 1.5, 0.0)
+    else:
+        shift = 0.0
+    score = X[:, 0] * 2 + X[:, 1] + shift + rng.normal(scale=0.3, size=n)
+    if nclass == 1:
+        data["y"] = score
+    elif nclass == 2:
+        data["y"] = np.where(score > 0, "yes", "no").astype(object)
+    else:
+        data["y"] = np.array(["a", "b", "c"], dtype=object)[
+            np.clip(np.digitize(score, [-1, 1]), 0, 2)]
+    return h2o.Frame.from_numpy(data)
+
+
+@pytest.mark.parametrize("nclass", [1, 2, 3])
+def test_gbm_mojo_roundtrip(nclass, tmp_path):
+    fr = _frame(nclass, seed=nclass)
+    gbm = H2OGradientBoostingEstimator(ntrees=8, max_depth=4, seed=1)
+    gbm.train(y="y", training_frame=fr)
+    path = str(tmp_path / "m.zip")
+    gbm.model.download_mojo(path)
+    mm = import_mojo(path)
+    ours = gbm.model.predict(fr)
+    theirs = mm.predict(fr)
+    if nclass == 1:
+        np.testing.assert_allclose(
+            theirs.vec("predict").to_numpy(),
+            ours.vec("predict").to_numpy(), rtol=1e-4, atol=1e-5)
+    else:
+        for d in gbm.model.response_domain:
+            np.testing.assert_allclose(
+                theirs.vec(f"p{d}").to_numpy(),
+                ours.vec(f"p{d}").to_numpy(), rtol=1e-3, atol=1e-5)
+
+
+@pytest.mark.parametrize("nclass", [1, 2])
+def test_drf_mojo_roundtrip(nclass, tmp_path):
+    fr = _frame(nclass, n=600, seed=10 + nclass)
+    drf = H2ORandomForestEstimator(ntrees=6, max_depth=5, seed=1)
+    drf.train(y="y", training_frame=fr)
+    path = str(tmp_path / "m.zip")
+    drf.model.download_mojo(path)
+    mm = import_mojo(path)
+    ours = drf.model.predict(fr)
+    theirs = mm.predict(fr)
+    if nclass == 1:
+        np.testing.assert_allclose(
+            theirs.vec("predict").to_numpy(),
+            ours.vec("predict").to_numpy(), rtol=1e-4, atol=1e-5)
+    else:
+        d = drf.model.response_domain[1]
+        np.testing.assert_allclose(
+            theirs.vec(f"p{d}").to_numpy(),
+            ours.vec(f"p{d}").to_numpy(), rtol=1e-3, atol=1e-5)
+
+
+def test_mojo_handles_nas(tmp_path):
+    rng = np.random.default_rng(3)
+    n = 500
+    x = rng.normal(size=n)
+    x[rng.random(n) < 0.3] = np.nan
+    y = np.where(np.nan_to_num(x, nan=-1) > 0, "t", "f").astype(object)
+    fr = h2o.Frame.from_numpy({"x": x, "z": rng.normal(size=n), "y": y})
+    gbm = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=1)
+    gbm.train(y="y", training_frame=fr)
+    path = str(tmp_path / "m.zip")
+    gbm.model.download_mojo(path)
+    mm = import_mojo(path)
+    p1 = gbm.model.predict(fr).vec("pt").to_numpy()
+    p2 = mm.predict(fr).vec("pt").to_numpy()
+    np.testing.assert_allclose(p2, p1, rtol=1e-3, atol=1e-5)
+
+
+def test_mojo_ini_contract(tmp_path):
+    """Structural checks against the ModelMojoReader contract."""
+    fr = _frame(2, n=300, seed=7)
+    gbm = H2OGradientBoostingEstimator(ntrees=3, max_depth=3, seed=1)
+    gbm.train(y="y", training_frame=fr)
+    path = str(tmp_path / "m.zip")
+    gbm.model.download_mojo(path)
+    mm = read_mojo(path)
+    info = mm.info
+    # keys readAll() dereferences unconditionally
+    for k in ("supervised", "uuid", "algo", "category", "n_features",
+              "n_classes", "balance_classes", "default_threshold",
+              "mojo_version", "n_columns", "n_trees",
+              "n_trees_per_class", "_genmodel_encoding",
+              "distribution", "init_f", "link_function"):
+        assert k in info, k
+    assert info["category"] == "Binomial"
+    assert float(info["mojo_version"]) == 1.40
+    assert int(info["n_columns"]) == len(mm.columns)
+    # trees + aux blobs exist for every (class, group)
+    import zipfile
+    with zipfile.ZipFile(path) as zf:
+        names = set(zf.namelist())
+    for t in range(int(info["n_trees"])):
+        assert f"trees/t00_{t:03d}.bin" in names
+        assert f"trees/t00_{t:03d}_aux.bin" in names
+        # aux record size must be a multiple of 40 bytes (AuxInfo.SIZE)
+        with zipfile.ZipFile(path) as zf:
+            assert len(zf.read(f"trees/t00_{t:03d}_aux.bin")) % 40 == 0
+    # response domain file present and correct
+    assert mm.domains[-1] == list(gbm.model.response_domain)
+
+
+def test_generic_imports_mojo(tmp_path):
+    from h2o3_tpu.models.misc_models import H2OGenericEstimator
+    fr = _frame(1, n=300, seed=9, with_cat=False)
+    gbm = H2OGradientBoostingEstimator(ntrees=4, max_depth=3, seed=1)
+    gbm.train(y="y", training_frame=fr)
+    path = str(tmp_path / "m.zip")
+    gbm.model.download_mojo(path)
+    gen = H2OGenericEstimator(path=path)
+    gen.train()
+    p1 = gbm.model.predict(fr).vec("predict").to_numpy()
+    p2 = gen.model.predict(fr).vec("predict").to_numpy()
+    np.testing.assert_allclose(p2, p1, rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------------------------------- GLRM
+
+def test_glrm_recovers_low_rank_and_imputes():
+    from h2o3_tpu.models.glrm import H2OGeneralizedLowRankEstimator
+    rng = np.random.default_rng(21)
+    n, F, k = 600, 8, 3
+    Xtrue = rng.normal(size=(n, k)) @ rng.normal(size=(k, F))
+    A = Xtrue + rng.normal(scale=0.05, size=(n, F))
+    Am = A.copy()
+    holes = rng.random((n, F)) < 0.15
+    Am[holes] = np.nan
+    fr = h2o.Frame.from_numpy({f"x{i}": Am[:, i] for i in range(F)})
+    glrm = H2OGeneralizedLowRankEstimator(k=k, max_iterations=300, seed=1)
+    glrm.train(training_frame=fr)
+    rec = glrm.model.predict(fr).to_numpy()
+    # imputed cells should approximate the true low-rank values
+    err_holes = np.abs(rec[holes] - Xtrue[holes]).mean()
+    base = np.abs(Xtrue[holes]).mean()
+    assert err_holes < 0.35 * base, (err_holes, base)
+    # archetype factor output has k columns
+    Xf = glrm.model.transform_frame(fr)
+    assert Xf.ncol == k
+
+
+def test_glrm_save_load(tmp_path):
+    from h2o3_tpu.models.glrm import H2OGeneralizedLowRankEstimator
+    rng = np.random.default_rng(23)
+    A = rng.normal(size=(200, 4))
+    fr = h2o.Frame.from_numpy({f"x{i}": A[:, i] for i in range(4)})
+    glrm = H2OGeneralizedLowRankEstimator(k=2, max_iterations=50, seed=1)
+    glrm.train(training_frame=fr)
+    p = h2o.save_model(glrm.model, str(tmp_path), filename="glrm")
+    m2 = h2o.load_model(p)
+    r1 = glrm.model.predict(fr).to_numpy()
+    r2 = m2.predict(fr).to_numpy()
+    np.testing.assert_allclose(r1, r2, rtol=1e-5)
+
+
+def test_glrm_single_level_categorical():
+    from h2o3_tpu.models.glrm import H2OGeneralizedLowRankEstimator
+    rng = np.random.default_rng(29)
+    n = 150
+    fr = h2o.Frame.from_numpy({
+        "x0": rng.normal(size=n), "x1": rng.normal(size=n),
+        "const": np.asarray(["only"] * n, dtype=object)})
+    glrm = H2OGeneralizedLowRankEstimator(k=2, max_iterations=30, seed=1)
+    glrm.train(training_frame=fr)                        # must not crash
+    rec = glrm.model.predict(fr).to_numpy()
+    assert rec.shape == (n, 2)      # const enum contributes 0 columns

@@ -165,3 +165,23 @@ def test_upliftdrf_cancel_safe_tree_count():
     assert up.model._feat.shape[0] == 3
     u = up.model.predict(fr).vec("uplift_predict").to_numpy()
     assert abs(u.mean() - 0.3) < 0.15
+
+
+def test_target_encoder_kfold_with_fold_column():
+    rng = np.random.default_rng(15)
+    n = 600
+    levels = np.array(["a", "b", "c"], dtype=object)
+    c = rng.integers(0, 3, n)
+    y = rng.random(n) + 0.3 * c
+    fold = rng.integers(0, 3, n).astype(float)
+    fr = h2o.Frame.from_numpy({"cat": levels[c], "y": y, "fold": fold})
+    te = H2OTargetEncoderEstimator(blending=False, noise=0,
+                                   data_leakage_handling="kfold",
+                                   fold_column="fold")
+    te.train(x=["cat"], y="y", training_frame=fr)      # must not raise
+    enc = te.model.transform(fr, as_training=True).vec("cat_te").to_numpy()
+    # a row's encoding excludes its own fold: check one cell exactly
+    lvl, f = 0, 0
+    m_out = (c == lvl) & (fold != f)
+    i = np.flatnonzero((c == lvl) & (fold == f))[0]
+    assert enc[i] == pytest.approx(y[m_out].mean(), abs=1e-5)
