@@ -216,22 +216,74 @@ def _column_to_vec(tokens: List[Optional[str]], vtype: str, mesh=None) -> Vec:
     return Vec.from_numpy(codes, vtype=T_ENUM, domain=vals, mesh=mesh)
 
 
+_PARALLEL_PARSE_BYTES = 16 << 20   # byte-range fan-out above 16 MB
+
+
+def _byte_ranges(path: str, n_chunks: int) -> List[tuple]:
+    """Split a file into newline-aligned byte ranges (the reference
+    parses raw-byte chunks, water/parser/ParseDataset.java:623)."""
+    size = os.path.getsize(path)
+    bounds = [0]
+    with open(path, "rb") as f:
+        for i in range(1, n_chunks):
+            target = size * i // n_chunks
+            f.seek(target)
+            f.readline()                 # advance to the next newline
+            bounds.append(min(f.tell(), size))
+    bounds.append(size)
+    return [(bounds[i], bounds[i + 1]) for i in range(n_chunks)
+            if bounds[i + 1] > bounds[i]]
+
+
+def _parse_range(path: str, start: int, end: int, setup: ParseSetup,
+                 skip_header: bool):
+    with open(path, "rb") as f:
+        f.seek(start)
+        text = f.read(end - start).decode("utf-8", errors="replace")
+    return _parse_csv_text(text, setup, skip_header=skip_header)
+
+
 def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
           mesh=None, key: Optional[str] = None) -> Frame:
-    """Phase 2 — full parse into a row-sharded Frame."""
+    """Phase 2 — full parse into a row-sharded Frame. Large files are
+    tokenised in parallel over newline-aligned byte ranges (the
+    MultiFileParseTask fan-out, ParseDataset.java:623; processes stand
+    in for nodes since CPython tokenisation doesn't share the GIL)."""
     if isinstance(paths, str):
         paths = [paths]
     setup = setup or parse_setup(paths)
     all_cols = None
-    for p in paths:
-        with open(p, "rb") as f:
-            text = f.read().decode("utf-8", errors="replace")
-        cols = _parse_csv_text(text, setup, skip_header=setup.header)
+
+    def merge(cols):
+        nonlocal all_cols
         if all_cols is None:
             all_cols = cols
         else:
             for c, extra in zip(all_cols, cols):
                 c.extend(extra)
+
+    for p in paths:
+        size = os.path.getsize(p)
+        if size >= _PARALLEL_PARSE_BYTES:
+            import concurrent.futures as cf
+            import multiprocessing as mp
+            n_chunks = min(os.cpu_count() or 4, 16)
+            ranges = _byte_ranges(p, n_chunks)
+            # spawn, not fork: this process is multithreaded (JAX/XLA),
+            # and forking while another thread holds an XLA mutex
+            # deadlocks the child
+            ctx = mp.get_context("spawn")
+            with cf.ProcessPoolExecutor(max_workers=len(ranges),
+                                        mp_context=ctx) as ex:
+                futs = [ex.submit(_parse_range, p, s, e, setup,
+                                  setup.header and s == 0)
+                        for (s, e) in ranges]
+                for fu in futs:
+                    merge(fu.result())
+        else:
+            with open(p, "rb") as f:
+                text = f.read().decode("utf-8", errors="replace")
+            merge(_parse_csv_text(text, setup, skip_header=setup.header))
     skipped = set(setup.skipped_columns)
     names, vecs = [], []
     for i, (col, t) in enumerate(zip(all_cols, setup.column_types)):
@@ -247,10 +299,50 @@ def import_file(path: Union[str, Sequence[str]], destination_frame: Optional[str
                 col_names: Optional[Sequence[str]] = None,
                 col_types: Optional[Sequence[str]] = None,
                 na_strings: Optional[Sequence[str]] = None, mesh=None) -> Frame:
-    """One-shot import (mirrors h2o.import_file, h2o-py/h2o/h2o.py)."""
+    """One-shot import (mirrors h2o.import_file, h2o-py/h2o/h2o.py).
+    Dispatches on URI scheme (persist layer) and file format
+    (ParserProvider SPI analog): csv/arff/svmlight/parquet/orc + gated
+    avro/xls."""
+    from h2o3_tpu.ingest.formats import FORMAT_PARSERS, sniff_format
+    from h2o3_tpu.ingest.persist_uri import localize
+    if isinstance(path, str):
+        path = localize(path)
+        first = path
+    else:
+        path = [localize(p) for p in path]
+        first = path[0]
+    fmt = sniff_format(first)
+    if fmt != "csv":
+        paths = [path] if isinstance(path, str) else list(path)
+        frames = [FORMAT_PARSERS[fmt](p, mesh=mesh,
+                                      key=destination_frame)
+                  for p in paths]
+        fr = frames[0]
+        for extra in frames[1:]:
+            fr = _rbind(fr, extra, mesh)
+        if destination_frame:
+            fr.key = destination_frame
+        return fr
     setup = parse_setup(path, separator=sep, header=header, column_names=col_names,
                         column_types=col_types, na_strings=na_strings)
     return parse(path, setup, mesh=mesh, key=destination_frame)
+
+
+def _rbind(a: Frame, b: Frame, mesh=None) -> Frame:
+    if a.names != b.names:
+        raise ValueError("multi-file import needs identical schemas")
+    data = {}
+    for n in a.names:
+        va, vb = a.vec(n), b.vec(n)
+        if (va.type == T_ENUM or vb.type == T_ENUM
+                or va.type == T_STR or vb.type == T_STR):
+            data[n] = np.concatenate([np.asarray(va.to_strings(),
+                                                 dtype=object),
+                                      np.asarray(vb.to_strings(),
+                                                 dtype=object)])
+        else:
+            data[n] = np.concatenate([va.to_numpy(), vb.to_numpy()])
+    return Frame.from_numpy(data, mesh=mesh)
 
 
 def upload_numpy(data, names=None, mesh=None) -> Frame:

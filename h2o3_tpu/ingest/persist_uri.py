@@ -1,0 +1,77 @@
+"""URI-scheme-dispatched persist backends.
+
+Reference: water/persist/PersistManager.java routes file/NFS/S3/GCS/
+HDFS/HTTP by URI scheme (backends in h2o-persist-{s3,gcs,hdfs,http}).
+
+TPU re-design: ingest always funnels through `localize(uri)` — remote
+objects download to a local cache file, then the format parsers run on
+the local copy (per-host byte-range reads). S3/GCS are gated on their
+optional SDKs; http(s) uses the standard library. The seam matches the
+reference's Persist.importFiles contract."""
+from __future__ import annotations
+
+import hashlib
+import os
+import tempfile
+import urllib.parse
+import urllib.request
+
+_CACHE_DIR = os.path.join(tempfile.gettempdir(), "h2o3_tpu_persist")
+
+
+def _cache_path(uri: str) -> str:
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    h = hashlib.sha1(uri.encode()).hexdigest()[:16]
+    base = os.path.basename(urllib.parse.urlparse(uri).path) or "object"
+    return os.path.join(_CACHE_DIR, f"{h}_{base}")
+
+
+def localize(uri: str) -> str:
+    """Return a local filesystem path for `uri`, downloading if remote."""
+    scheme = urllib.parse.urlparse(uri).scheme.lower()
+    if scheme in ("", "file"):
+        return uri[len("file://"):] if uri.startswith("file://") else uri
+    if scheme in ("http", "https"):
+        out = _cache_path(uri)
+        if not os.path.exists(out):
+            # download to a temp name, rename atomically — a partial
+            # download must never poison the cache
+            tmp = out + ".part"
+            urllib.request.urlretrieve(uri, tmp)
+            os.replace(tmp, out)
+        return out
+    if scheme == "s3":
+        try:
+            import boto3
+        except ImportError as e:
+            raise NotImplementedError(
+                "s3:// import needs the optional 'boto3' package "
+                "(h2o-persist-s3 analog is gated on it)") from e
+        out = _cache_path(uri)
+        if not os.path.exists(out):
+            p = urllib.parse.urlparse(uri)
+            tmp = out + ".part"
+            boto3.client("s3").download_file(p.netloc, p.path.lstrip("/"),
+                                             tmp)
+            os.replace(tmp, out)
+        return out
+    if scheme == "gs":
+        try:
+            from google.cloud import storage
+        except ImportError as e:
+            raise NotImplementedError(
+                "gs:// import needs the optional 'google-cloud-storage' "
+                "package (h2o-persist-gcs analog is gated on it)") from e
+        out = _cache_path(uri)
+        if not os.path.exists(out):
+            p = urllib.parse.urlparse(uri)
+            tmp = out + ".part"
+            storage.Client().bucket(p.netloc).blob(
+                p.path.lstrip("/")).download_to_filename(tmp)
+            os.replace(tmp, out)
+        return out
+    if scheme == "hdfs":
+        raise NotImplementedError(
+            "hdfs:// import needs a pyarrow HadoopFileSystem environment "
+            "(h2o-persist-hdfs analog; mount or copy the file locally)")
+    raise ValueError(f"unsupported URI scheme '{scheme}' in {uri}")

@@ -414,14 +414,41 @@ def import_mojo(path: str):
     feat_names = mm.columns[:n_feat]
 
     class _MojoFrameModel:
+        """Duck-typed Model over MOJO bytes — carries the attributes the
+        REST schema layer and keyed store dereference (training_metrics,
+        output, scoring_history, run_time, params)."""
         algo = f"mojo_{mm.algo}"
         key = f"mojo_{abs(hash(path)) & 0xffffff:x}"
         nclasses = mm.n_classes
         feature_names = feat_names
+        feature_is_cat = [mm.domains[j] is not None
+                          for j in range(n_feat)]
+        cat_domains = {feat_names[j]: tuple(mm.domains[j])
+                       for j in range(n_feat) if mm.domains[j]}
+        response = (mm.columns[n_feat] if n_feat < len(mm.columns)
+                    else None)
         response_domain = (tuple(mm.domains[n_feat])
                            if n_feat < len(mm.columns)
                            and mm.domains[n_feat] else None)
         mojo = mm
+
+        def __init__(self):
+            self.params = {"path": path}
+            self.output = {"mojo_source": path,
+                           "algo": mm.algo}
+            self.training_metrics = None
+            self.validation_metrics = None
+            self.cross_validation_metrics = None
+            self.scoring_history = []
+            self.run_time = 0.0
+
+        def model_performance(self, frame=None):
+            return self.training_metrics
+
+        def _save_arrays(self):
+            raise NotImplementedError(
+                "an imported MOJO re-exports as-is: copy the original "
+                "zip instead of save_model")
 
         def predict(self, frame: Frame) -> Frame:
             rows = frame.nrow
