@@ -564,3 +564,11 @@ def _logs(params, body):
     return {"__meta": {"schema_version": 3, "schema_name": "LogsV3"},
             "log": "\n".join(buffered_lines(int(params.get("n", 1000)
                                                 or 1000)))}
+
+
+@route("GET", "/3/Timeline")
+def _timeline(params, body):
+    """water/TimeLine.java ring-buffer snapshot (/3/Timeline)."""
+    from h2o3_tpu.log import timeline_events
+    return {"__meta": {"schema_version": 3, "schema_name": "TimelineV3"},
+            "events": timeline_events(int(params.get("n", 2048) or 2048))}

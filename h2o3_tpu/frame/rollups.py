@@ -74,3 +74,55 @@ def compute_percentiles(vec, probs) -> np.ndarray:
     global sort is simpler and exact at TPU memory scales)."""
     data = vec.as_float()
     return np.asarray(_quantile_kernel(data, jnp.asarray(probs, dtype=jnp.float32)))
+
+
+@jax.jit
+def _weighted_quantile_kernel(data, w, probs):
+    """Weighted type-7-style quantiles: sort, interpolate on the
+    cumulative-weight axis (hex/quantile/Quantile.java weighted path).
+    NaN data or NaN/zero weights are excluded from the curve."""
+    order = jnp.argsort(data)          # NaN sorts last
+    d = data[order]
+    ws = jnp.where(jnp.isnan(w), 0.0, w)[order]
+    valid = ~jnp.isnan(d)
+    ws = jnp.where(valid, ws, 0.0)
+    cw = jnp.cumsum(ws)
+    tot = cw[-1]
+    # replace NaN tail values with the LAST valid value so interp's
+    # upper endpoint is finite (their weight is 0 — position unchanged)
+    last_valid_idx = jnp.argmax(jnp.where(valid, jnp.arange(d.shape[0]),
+                                          -1))
+    d = jnp.where(valid, d, d[last_valid_idx])
+    # position of each sorted point on the (0, 1] cumulative-weight axis,
+    # centered per observation (matches numpy for unit weights)
+    pos = (cw - 0.5 * ws) / jnp.maximum(tot, 1e-30)
+    return jnp.interp(probs, pos, d)
+
+
+def weighted_quantile(vec_or_array, probs, weights=None) -> np.ndarray:
+    """Weighted quantiles of a Vec or array; NaN data rows are ignored."""
+    data = (vec_or_array.as_float() if hasattr(vec_or_array, "as_float")
+            else jnp.asarray(np.asarray(vec_or_array), jnp.float32))
+    if weights is None:
+        w = jnp.ones_like(data)
+    elif hasattr(weights, "as_float"):
+        w = weights.as_float()
+        w = jnp.where(jnp.isnan(w), 0.0, w)
+    else:
+        w = jnp.asarray(np.asarray(weights), jnp.float32)
+    # NaN data sorts last; weights zeroed in-kernel
+    return np.asarray(_weighted_quantile_kernel(
+        data, w, jnp.asarray(probs, jnp.float32)))
+
+
+def stratified_quantile(vec, probs, strata_vec) -> dict:
+    """Per-stratum quantiles (hex/quantile stratified mode): one device
+    pass per stratum with the stratum mask as weights."""
+    sv = strata_vec.as_float()
+    vals = np.unique(np.asarray(sv)[~np.isnan(np.asarray(sv))])
+    out = {}
+    for v in vals:
+        mask = (sv == float(v)).astype(jnp.float32)
+        out[float(v)] = np.asarray(_weighted_quantile_kernel(
+            vec.as_float(), mask, jnp.asarray(probs, jnp.float32)))
+    return out

@@ -101,9 +101,29 @@ def parse_arff(path: str, mesh=None, key: Optional[str] = None) -> Frame:
     vecs = []
     for i in range(ncol):
         col = cols[i]
-        if kinds[i] == "numeric" or kinds[i] == "date":
+        if kinds[i] == "numeric":
             arr = np.asarray([np.nan if t is None else float(t)
                               for t in col])
+            vecs.append(Vec.from_numpy(arr, mesh=mesh))
+        elif kinds[i] == "date":
+            # epoch millis (Vec T_TIME convention); unparseable → NA
+            from datetime import datetime
+
+            def _epoch(t):
+                if t is None:
+                    return np.nan
+                for fmt in ("%Y-%m-%d %H:%M:%S", "%Y-%m-%d",
+                            "%m/%d/%Y", "%Y-%m-%dT%H:%M:%S"):
+                    try:
+                        return datetime.strptime(t, fmt).timestamp() * 1e3
+                    except ValueError:
+                        continue
+                try:
+                    return float(t)
+                except ValueError:
+                    return np.nan
+
+            arr = np.asarray([_epoch(t) for t in col])
             vecs.append(Vec.from_numpy(arr, mesh=mesh))
         elif kinds[i] == "nominal":
             dom = domains[i]
@@ -139,6 +159,8 @@ def parse_svmlight(path: str, mesh=None,
             d: Dict[int, float] = {}
             for p in parts[1:]:
                 k, _, v = p.partition(":")
+                if k == "qid":     # optional ranking-group token
+                    continue
                 idx = int(k)
                 if idx < 1:
                     raise ValueError(

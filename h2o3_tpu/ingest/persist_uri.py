@@ -26,6 +26,20 @@ def _cache_path(uri: str) -> str:
     return os.path.join(_CACHE_DIR, f"{h}_{base}")
 
 
+def _fill_cache(out: str, download_to) -> None:
+    """Download via a PROCESS-UNIQUE temp file then rename atomically:
+    a partial or concurrently-interleaved download must never land at
+    the final cache path."""
+    fd, tmp = tempfile.mkstemp(dir=_CACHE_DIR, suffix=".part")
+    os.close(fd)
+    try:
+        download_to(tmp)
+        os.replace(tmp, out)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
 def localize(uri: str) -> str:
     """Return a local filesystem path for `uri`, downloading if remote."""
     scheme = urllib.parse.urlparse(uri).scheme.lower()
@@ -34,11 +48,8 @@ def localize(uri: str) -> str:
     if scheme in ("http", "https"):
         out = _cache_path(uri)
         if not os.path.exists(out):
-            # download to a temp name, rename atomically — a partial
-            # download must never poison the cache
-            tmp = out + ".part"
-            urllib.request.urlretrieve(uri, tmp)
-            os.replace(tmp, out)
+            _fill_cache(out, lambda tmp: urllib.request.urlretrieve(
+                uri, tmp))
         return out
     if scheme == "s3":
         try:
@@ -50,10 +61,8 @@ def localize(uri: str) -> str:
         out = _cache_path(uri)
         if not os.path.exists(out):
             p = urllib.parse.urlparse(uri)
-            tmp = out + ".part"
-            boto3.client("s3").download_file(p.netloc, p.path.lstrip("/"),
-                                             tmp)
-            os.replace(tmp, out)
+            _fill_cache(out, lambda tmp: boto3.client("s3").download_file(
+                p.netloc, p.path.lstrip("/"), tmp))
         return out
     if scheme == "gs":
         try:
@@ -65,10 +74,9 @@ def localize(uri: str) -> str:
         out = _cache_path(uri)
         if not os.path.exists(out):
             p = urllib.parse.urlparse(uri)
-            tmp = out + ".part"
-            storage.Client().bucket(p.netloc).blob(
-                p.path.lstrip("/")).download_to_filename(tmp)
-            os.replace(tmp, out)
+            _fill_cache(out, lambda tmp: storage.Client().bucket(
+                p.netloc).blob(p.path.lstrip("/")).download_to_filename(
+                tmp))
         return out
     if scheme == "hdfs":
         raise NotImplementedError(

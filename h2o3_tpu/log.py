@@ -64,6 +64,32 @@ def buffered_lines(n: int = 1000) -> List[str]:
         return list(_BUFFER)[-n:]
 
 
+# ---------------------------------------------------------------- timeline
+
+# water/TimeLine.java: a lock-free per-node ring buffer of runtime events
+# snapshotted at /3/Timeline. Here: a bounded deque of (ts, kind, detail)
+# fed by training drivers / REST handlers; thread-safe via one lock (the
+# single-controller design has no per-node rings to merge).
+_TIMELINE: "deque" = None  # type: ignore[assignment]
+_TL_LOCK = threading.Lock()
+_TL_CAP = 2048
+
+
+def timeline_record(kind: str, detail: str) -> None:
+    global _TIMELINE
+    with _TL_LOCK:
+        if _TIMELINE is None:
+            from collections import deque
+            _TIMELINE = deque(maxlen=_TL_CAP)
+        _TIMELINE.append({"ts": time.time(), "kind": kind,
+                          "detail": detail})
+
+
+def timeline_events(n: int = 2048) -> List[Dict]:
+    with _TL_LOCK:
+        return list(_TIMELINE or [])[-n:]
+
+
 class Profile:
     """Per-phase wall-time accumulator (MRProfile analog). Phases may
     repeat; durations accumulate. Not thread-safe by design — one Profile

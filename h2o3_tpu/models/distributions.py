@@ -191,10 +191,34 @@ _FAMILIES = {
 }
 
 
+# user-defined families (water/udf CDistributionFunc analog): register a
+# Distribution subclass/instance under a name, then train with
+# distribution="custom:<name>" (or pass the instance via the builder's
+# custom_distribution_func param)
+_CUSTOM: dict = {}
+
+
+def register_custom_distribution(name: str, dist) -> None:
+    """Register a UDF distribution. `dist` implements the Distribution
+    contract (init_f0/grad_hess/predict/deviance) with jnp math — it is
+    traced into the jitted training step like the built-ins."""
+    _CUSTOM[name.lower()] = dist
+
+
 def get_distribution(name: str, tweedie_power: float = 1.5,
                      quantile_alpha: float = 0.5,
                      huber_delta: float = 1.0) -> Distribution:
+    if isinstance(name, Distribution):
+        return name
     name = (name or "gaussian").lower()
+    if name.startswith("custom"):
+        key = name.split(":", 1)[1] if ":" in name else name
+        if key in _CUSTOM:
+            d = _CUSTOM[key]
+            return d() if isinstance(d, type) else d
+        raise ValueError(
+            f"custom distribution '{key}' is not registered "
+            f"(register_custom_distribution); have {sorted(_CUSTOM)}")
     if name == "tweedie":
         return Tweedie(tweedie_power)
     if name == "quantile":

@@ -450,6 +450,12 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         return model
 
     def _dist(self, dist_name: str, huber_delta: float = 1.0):
+        if str(dist_name).lower().startswith("custom"):
+            # UDF family (water/udf CDistributionFunc): an instance on
+            # custom_distribution_func wins over the registry lookup
+            cdf = self.params.get("custom_distribution_func")
+            if cdf is not None and not isinstance(cdf, str):
+                return get_distribution(cdf)
         return get_distribution(dist_name,
                                 float(self.params.get("tweedie_power", 1.5)),
                                 float(self.params.get("quantile_alpha", 0.5)),

@@ -14,6 +14,8 @@ orchestration, kept shape-compatible with h2o-py's H2OGridSearch."""
 from __future__ import annotations
 
 import itertools
+import json
+import os
 import random
 import time
 from typing import Any, Dict, List, Optional, Sequence
@@ -58,11 +60,13 @@ class H2OGridSearch:
 
     def __init__(self, model, hyper_params: Dict[str, Sequence],
                  grid_id: Optional[str] = None,
-                 search_criteria: Optional[Dict] = None):
+                 search_criteria: Optional[Dict] = None,
+                 recovery_dir: Optional[str] = None):
         self.model_template = model
         self.hyper_params = {k: list(v) for k, v in hyper_params.items()}
         self.grid_id = grid_id or dkv.unique_key("grid")
         self.search_criteria = dict(search_criteria or {})
+        self.recovery_dir = recovery_dir
         self.models: List = []
         self.failures: List[Dict] = []
 
@@ -92,11 +96,35 @@ class H2OGridSearch:
         t0 = time.time()
         base_params = dict(self.model_template.params)
         cls = type(self.model_template)
+        # auto-recovery (hex/faulttolerance/Recovery.java + the
+        # -auto_recovery_dir flag): completed grid points persist as
+        # artifacts + a manifest; a restarted grid resumes from it
+        done: Dict[str, str] = {}
+        if self.recovery_dir:
+            os.makedirs(self.recovery_dir, exist_ok=True)
+            manifest = os.path.join(self.recovery_dir,
+                                    f"{self.grid_id}.json")
+            if os.path.exists(manifest):
+                try:
+                    with open(manifest) as f:
+                        done = json.load(f).get("completed", {})
+                except (json.JSONDecodeError, OSError):
+                    done = {}  # crashed mid-write — retrain everything
         for i, combo in enumerate(self._combos()):
             if max_models and len(self.models) >= max_models:
                 break
             if max_secs and time.time() - t0 > max_secs:
                 break
+            ckey = json.dumps(combo, sort_keys=True, default=str)
+            if ckey in done:
+                from h2o3_tpu.persist import load_model
+                try:
+                    model = load_model(done[ckey])
+                    self.models.append(model)
+                    dkv.put(model.key, "model", model)
+                    continue
+                except Exception:
+                    pass  # stale artifact — retrain the point
             params = dict(base_params)
             params.update(combo)
             est = cls(**params)
@@ -108,6 +136,19 @@ class H2OGridSearch:
                 model.output["grid_hyper_params"] = combo
                 dkv.put(model.key, "model", model)
                 self.models.append(model)
+                if self.recovery_dir:
+                    from h2o3_tpu.persist import save_model
+                    art = save_model(model, self.recovery_dir,
+                                     force=True, filename=model.key)
+                    done[ckey] = art
+                    # atomic manifest write: a crash mid-dump must not
+                    # leave a truncated file that blocks the resume
+                    mpath = os.path.join(self.recovery_dir,
+                                         f"{self.grid_id}.json")
+                    tmp = mpath + ".part"
+                    with open(tmp, "w") as f:
+                        json.dump({"completed": done}, f)
+                    os.replace(tmp, mpath)
             except Exception as e:  # noqa: BLE001 — grid keeps walking
                 self.failures.append({"params": combo, "error": str(e)})
         dkv.put(self.grid_id, "grid", self)

@@ -490,9 +490,10 @@ class ModelBuilder:
         if training_frame is None or (y is None and self.supervised):
             raise ValueError("train() needs training_frame"
                              + (" and y" if self.supervised else ""))
-        from h2o3_tpu.log import Profile, info
+        from h2o3_tpu.log import Profile, info, timeline_record
         t0 = time.time()
         prof = Profile()
+        timeline_record("train_start", f"{self.algo}")
         with prof.phase("spec"):
             spec = self._make_spec(training_frame, y, x)
             valid_spec = None
@@ -513,12 +514,26 @@ class ModelBuilder:
             with prof.phase("train"):
                 model = self._train_impl(spec, valid_spec, job)
             model.run_time = time.time() - t0
+            # UDF metric (water/udf CMetricFunc analog): a callable
+            # (pred, y, w) -> float evaluated on the training data
+            cmf = self.params.get("custom_metric_func")
+            if callable(cmf):
+                pred = np.asarray(jax.device_get(
+                    model._predict_matrix(spec.X)))
+                yh = np.asarray(jax.device_get(spec.y))
+                wh = np.asarray(jax.device_get(spec.w))
+                live = wh > 0
+                model.output["custom_metric"] = {
+                    "name": getattr(cmf, "__name__", "custom"),
+                    "value": float(cmf(pred[live], yh[live], wh[live]))}
             if nfolds > 1 or fold_column:
                 with prof.phase("cv"):
                     self._cross_validate(model, training_frame, y, x, spec,
                                          job, nfolds, fold_column)
             model.output["profile"] = prof.to_dict()
             info("%s train done: %s", self.algo, prof.summary())
+            timeline_record("train_done",
+                            f"{self.algo} {prof.summary()}")
             return model
 
         job.run(body, background=background)
@@ -566,21 +581,41 @@ class ModelBuilder:
             fold_ids = np.arange(nfolds)
         K = self.nclasses_of(model)
         holdout = np.full((nrow, K) if K > 1 else (nrow,), np.nan, dtype=np.float32)
-        fold_models = []
-        for i, fid in enumerate(fold_ids):
+
+        def one_fold(fid):
             mask = fold == fid
             tr = frame.rows(~mask)
             te = frame.rows(mask)
             sub = type(self)(**{k: v for k, v in self.params.items()
-                                if k not in ("nfolds", "fold_column")})
+                                if k not in ("nfolds", "fold_column",
+                                             "parallelism")})
             sub.train(x=x, y=y, training_frame=tr)
             fm = sub.model
             X_te = adapt_test_matrix(fm, te)
             out = np.asarray(jax.device_get(
                 fm._predict_matrix(X_te, offset=fm._frame_offset(te))))[: te.nrow]
-            holdout[mask] = out
-            fold_models.append(fm)
-            job.set_progress(0.5 + 0.5 * (i + 1) / len(fold_ids))
+            return mask, out, fm
+
+        par = int(self.params.get("parallelism", 1) or 1)
+        fold_models = []
+        if par > 1:
+            # CVModelBuilder parallel fold building (hex/CVModelBuilder,
+            # ModelBuilderHelper.trainModelsParallel): threads overlap
+            # host orchestration and XLA compiles (GIL released)
+            import concurrent.futures as cf
+            with cf.ThreadPoolExecutor(max_workers=par) as ex:
+                futs = [ex.submit(one_fold, fid) for fid in fold_ids]
+                for i, fu in enumerate(futs):
+                    mask, out, fm = fu.result()
+                    holdout[mask] = out
+                    fold_models.append(fm)
+                    job.set_progress(0.5 + 0.5 * (i + 1) / len(fold_ids))
+        else:
+            for i, fid in enumerate(fold_ids):
+                mask, out, fm = one_fold(fid)
+                holdout[mask] = out
+                fold_models.append(fm)
+                job.set_progress(0.5 + 0.5 * (i + 1) / len(fold_ids))
         # aggregate CV metrics from pooled holdout predictions
         cv_spec = build_training_spec(frame, y, x,
                                       classification=model.nclasses > 1)

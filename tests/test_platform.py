@@ -1,0 +1,155 @@
+"""Platform features: parallel CV, segments, weighted quantile, UDFs,
+grid recovery, timeline (reference: hex/CVModelBuilder, hex/segments,
+hex/quantile weighted, water/udf, hex/faulttolerance/Recovery,
+water/TimeLine)."""
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+
+
+def _reg_frame(n=800, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 3))
+    y = X[:, 0] * 2 + rng.normal(scale=0.3, size=n)
+    return h2o.Frame.from_numpy(
+        {**{f"x{i}": X[:, i] for i in range(3)}, "y": y})
+
+
+def test_parallel_cv_matches_sequential():
+    fr = _reg_frame()
+    seq = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=1,
+                                       nfolds=3, fold_assignment="modulo")
+    seq.train(y="y", training_frame=fr)
+    par = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=1,
+                                       nfolds=3, fold_assignment="modulo",
+                                       parallelism=3)
+    par.train(y="y", training_frame=fr)
+    assert seq.model.cross_validation_metrics.mse == pytest.approx(
+        par.model.cross_validation_metrics.mse, rel=1e-5)
+
+
+def test_train_segments():
+    from h2o3_tpu.segments import train_segments
+    rng = np.random.default_rng(3)
+    n = 900
+    seg = np.array(["A", "B", "C"], dtype=object)[rng.integers(0, 3, n)]
+    x = rng.normal(size=n)
+    slope = np.where(seg == "A", 1.0, np.where(seg == "B", 2.0, -1.0))
+    y = slope * x + rng.normal(scale=0.1, size=n)
+    fr = h2o.Frame.from_numpy({"seg": seg, "x": x, "y": y})
+    sm = train_segments(
+        lambda: H2OGeneralizedLinearEstimator(Lambda=[0.0]),
+        segment_columns=["seg"], y="y", training_frame=fr)
+    assert len(sm) == 3
+    coefs = {r["segment"]["seg"]: r["model"].coef()["x"] for r in sm}
+    assert coefs["A"] == pytest.approx(1.0, abs=0.1)
+    assert coefs["B"] == pytest.approx(2.0, abs=0.1)
+    assert coefs["C"] == pytest.approx(-1.0, abs=0.1)
+
+
+def test_weighted_quantile():
+    from h2o3_tpu.frame.rollups import weighted_quantile
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=4000)
+    # unit weights ≈ numpy quantile
+    q = weighted_quantile(x, [0.1, 0.5, 0.9])
+    np.testing.assert_allclose(
+        q, np.quantile(x, [0.1, 0.5, 0.9]), atol=0.02)
+    # integer weights ≈ repetition
+    w = rng.integers(1, 4, len(x)).astype(float)
+    q_w = weighted_quantile(x, [0.25, 0.75], weights=w)
+    rep = np.repeat(x, w.astype(int))
+    np.testing.assert_allclose(q_w, np.quantile(rep, [0.25, 0.75]),
+                               atol=0.02)
+
+
+def test_custom_distribution_and_metric():
+    import jax.numpy as jnp
+    from h2o3_tpu.models.distributions import (Distribution,
+                                               register_custom_distribution)
+
+    class Cauchyish(Distribution):
+        """UDF family: pseudo-huber-flavoured robust loss."""
+        name = "cauchyish"
+
+        def init_f0(self, y, w):
+            return (w * y).sum() / w.sum()
+
+        def grad_hess(self, f, y):
+            r = f - y
+            return r / (1 + r * r), jnp.ones_like(f)
+
+        def predict(self, f):
+            return f
+
+        def deviance(self, w, y, mu):
+            return (w * jnp.log1p((y - mu) ** 2)).sum() / w.sum()
+
+    register_custom_distribution("cauchyish", Cauchyish)
+    fr = _reg_frame(seed=7)
+
+    def mape(pred, y, w):
+        return float(np.mean(np.abs(pred - y)))
+
+    gbm = H2OGradientBoostingEstimator(
+        ntrees=40, max_depth=3, seed=1, distribution="custom:cauchyish",
+        custom_metric_func=mape)
+    gbm.train(y="y", training_frame=fr)
+    assert gbm.model.r2() > 0.5   # robust loss underfits vs L2; wiring is the point
+    cm = gbm.model.output["custom_metric"]
+    assert cm["name"] == "mape" and cm["value"] < 1.0
+
+
+def test_grid_recovery_resume(tmp_path):
+    from h2o3_tpu.models.grid import H2OGridSearch
+    fr = _reg_frame(seed=9)
+    rec = str(tmp_path / "recovery")
+    g1 = H2OGridSearch(H2OGradientBoostingEstimator(ntrees=4, seed=1),
+                       {"max_depth": [2, 3]}, grid_id="g1",
+                       recovery_dir=rec)
+    g1.train(y="y", training_frame=fr)
+    assert len(g1.models) == 2
+    import os
+    assert os.path.exists(os.path.join(rec, "g1.json"))
+    # a fresh grid over the same space resumes from artifacts: models
+    # load instead of retraining (keys preserved from the manifest)
+    g2 = H2OGridSearch(H2OGradientBoostingEstimator(ntrees=4, seed=1),
+                       {"max_depth": [2, 3]}, grid_id="g1",
+                       recovery_dir=rec)
+    g2.train(y="y", training_frame=fr)
+    assert len(g2.models) == 2
+    m1 = {m.output.get("grid_hyper_params", {}).get("max_depth"):
+          m.predict(fr).vec("predict").to_numpy() for m in g1.models}
+    m2 = {m.output.get("grid_hyper_params", {}).get("max_depth"):
+          m.predict(fr).vec("predict").to_numpy() for m in g2.models}
+    for k in m1:
+        np.testing.assert_allclose(m1[k], m2[k], rtol=1e-6)
+
+
+def test_timeline_records_training():
+    from h2o3_tpu.log import timeline_events
+    before = len(timeline_events())
+    fr = _reg_frame(seed=11, n=200)
+    gbm = H2OGradientBoostingEstimator(ntrees=2, max_depth=2, seed=1)
+    gbm.train(y="y", training_frame=fr)
+    ev = timeline_events()
+    assert len(ev) >= before + 2
+    kinds = [e["kind"] for e in ev[-10:]]
+    assert "train_start" in kinds and "train_done" in kinds
+
+
+def test_weighted_quantile_nan_handling():
+    from h2o3_tpu.frame.rollups import weighted_quantile
+    x = np.concatenate([np.arange(100.0), [np.nan] * 5])
+    q = weighted_quantile(x, [0.5, 0.99, 1.0])
+    assert np.isfinite(q).all()
+    np.testing.assert_allclose(q[0], 49.5, atol=1.0)
+    np.testing.assert_allclose(q[2], 99.0, atol=1e-6)
+    # NaN weights are excluded, not propagated
+    w = np.ones(105)
+    w[3] = np.nan
+    q2 = weighted_quantile(x, [0.5], weights=w)
+    assert np.isfinite(q2).all()
