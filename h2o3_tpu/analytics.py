@@ -1,0 +1,201 @@
+"""Misc analytics — partial dependence, frame synthesis, tabulation.
+
+Reference: h2o-core hex/* misc analytics (SURVEY §2.2): PartialDependence
+(water/api + hex/PartialDependence), CreateFrame/FrameCreator (random
+frame synthesis), Tabulate (2-D grouped aggregation), plus h2o-py's
+varimp-driven explain helpers.
+
+TPU re-design: partial dependence batches the whole grid as one stacked
+scoring pass (grid × rows rides the device in blocks instead of the
+reference's per-bin MRTask); tabulate is two scatter-adds."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.vec import T_ENUM, Vec
+
+
+def partial_dependence(model, frame: Frame, cols: Sequence[str],
+                       nbins: int = 20,
+                       row_cap: int = 5000) -> Dict[str, Dict]:
+    """Per-column partial dependence: mean prediction over the data with
+    the column clamped to each grid value (hex/PartialDependence)."""
+    from h2o3_tpu.models.model_base import adapt_test_matrix
+    import jax.numpy as jnp
+    rng = np.random.default_rng(0)
+    X = np.asarray(jax.device_get(adapt_test_matrix(model, frame)))
+    X = X[: frame.nrow]
+    if len(X) > row_cap:
+        X = X[rng.choice(len(X), row_cap, replace=False)]
+    out: Dict[str, Dict] = {}
+    for col in cols:
+        if col not in model.feature_names:
+            raise ValueError(f"'{col}' is not a model feature")
+        j = model.feature_names.index(col)
+        is_cat = model.feature_is_cat[j]
+        if is_cat:
+            dom = model.cat_domains.get(col, ())
+            grid = np.arange(len(dom), dtype=np.float64)
+            labels = list(dom)
+        else:
+            v = X[:, j]
+            v = v[~np.isnan(v)]
+            grid = np.quantile(v, np.linspace(0.025, 0.975, nbins))
+            grid = np.unique(grid)
+            labels = grid.tolist()
+        means, stds = [], []
+        for g in grid:
+            Xg = X.copy()
+            Xg[:, j] = g
+            pred = np.asarray(jax.device_get(
+                model._predict_matrix(jnp.asarray(Xg))))
+            if pred.ndim == 2:          # classification → p(last class)
+                pred = pred[:, -1]
+            means.append(float(pred.mean()))
+            stds.append(float(pred.std()))
+        out[col] = {"grid": labels, "mean_response": means,
+                    "stddev_response": stds}
+    return out
+
+
+def create_frame(rows: int = 10000, cols: int = 10,
+                 categorical_fraction: float = 0.2,
+                 integer_fraction: float = 0.2,
+                 binary_fraction: float = 0.1,
+                 missing_fraction: float = 0.0,
+                 factors: int = 5, real_range: float = 100.0,
+                 integer_range: int = 100, seed: int = -1,
+                 has_response: bool = False, mesh=None) -> Frame:
+    """Random frame synthesis (water/rapids CreateFrame/FrameCreator)."""
+    rng = np.random.default_rng(None if seed in (-1, None) else seed)
+    n_cat = int(round(cols * categorical_fraction))
+    n_int = int(round(cols * integer_fraction))
+    n_bin = int(round(cols * binary_fraction))
+    n_real = max(cols - n_cat - n_int - n_bin, 0)
+    names: List[str] = []
+    vecs: List[Vec] = []
+
+    def miss(arr, enum=False):
+        if missing_fraction > 0:
+            m = rng.random(rows) < missing_fraction
+            if enum:
+                arr = np.where(m, -1, arr)
+            else:
+                arr = np.where(m, np.nan, arr)
+        return arr
+
+    ci = 1
+    for _ in range(n_real):
+        names.append(f"C{ci}"); ci += 1
+        vecs.append(Vec.from_numpy(
+            miss(rng.uniform(-real_range, real_range, rows)), mesh=mesh))
+    for _ in range(n_int):
+        names.append(f"C{ci}"); ci += 1
+        vecs.append(Vec.from_numpy(
+            miss(rng.integers(-integer_range, integer_range,
+                              rows).astype(np.float64)), mesh=mesh))
+    for _ in range(n_bin):
+        names.append(f"C{ci}"); ci += 1
+        vecs.append(Vec.from_numpy(
+            miss(rng.integers(0, 2, rows).astype(np.float64)), mesh=mesh))
+    for _ in range(n_cat):
+        names.append(f"C{ci}"); ci += 1
+        dom = tuple(f"{names[-1]}.l{k}" for k in range(factors))
+        codes = rng.integers(0, factors, rows).astype(np.int32)
+        codes = miss(codes, enum=True).astype(np.int32)
+        vecs.append(Vec.from_numpy(codes, vtype=T_ENUM,
+                                   domain=dom, mesh=mesh))
+    if has_response:
+        names.append("response")
+        vecs.append(Vec.from_numpy(rng.normal(size=rows), mesh=mesh))
+    return Frame(names, vecs)
+
+
+def tabulate(frame: Frame, x: str, y: str, nbins_x: int = 20,
+             nbins_y: int = 20) -> Dict:
+    """2-D histogram + per-x-bin y means (hex/Tabulate)."""
+    import jax.numpy as jnp
+    vx = frame.vec(x)
+    vy = frame.vec(y)
+
+    def codes_of(v, nbins):
+        if v.is_categorical:
+            c = np.asarray(jax.device_get(v.as_float()))[: frame.nrow]
+            labels = list(v.domain)
+            return np.where(np.isnan(c), -1, c).astype(int), labels
+        d = v.to_numpy()
+        ok = ~np.isnan(d)
+        edges = np.quantile(d[ok], np.linspace(0, 1, nbins + 1)[1:-1]) \
+            if ok.any() else np.array([])
+        edges = np.unique(edges)
+        c = np.where(ok, np.searchsorted(edges, d), -1)
+        labels = ([f"<= {e:.4g}" for e in edges] + ["> last"]
+                  if len(edges) else ["all"])
+        return c.astype(int), labels
+
+    cx, lx = codes_of(vx, nbins_x)
+    cy, ly = codes_of(vy, nbins_y)
+    nx, ny = len(lx), len(ly)
+    ok = (cx >= 0) & (cy >= 0)
+    counts = np.zeros((nx, ny), np.int64)
+    np.add.at(counts, (cx[ok], cy[ok]), 1)
+    # per-x-bin mean of y (numeric y only)
+    means = None
+    if not vy.is_categorical:
+        yv = vy.to_numpy()
+        s = np.zeros(nx); c = np.zeros(nx)
+        okx = (cx >= 0) & ~np.isnan(yv)
+        np.add.at(s, cx[okx], yv[okx])
+        np.add.at(c, cx[okx], 1)
+        means = np.where(c > 0, s / np.maximum(c, 1), np.nan).tolist()
+    return {"x_labels": lx, "y_labels": ly,
+            "counts": counts.tolist(), "mean_y_per_x": means}
+
+
+def feature_interaction(model, frame: Frame, max_pairs: int = 10) -> List:
+    """Pairwise H-statistic-flavoured interaction screen
+    (hex/FeatureInteraction, FriedmanPopescusH): variance of the joint
+    partial dependence not explained by the additive marginals."""
+    import itertools
+    vi = model.output.get("variable_importances") or {}
+    top = (vi.get("variable") or list(model.feature_names))[:5]
+    pd1 = partial_dependence(model, frame, top, nbins=8)
+    rows = []
+    from h2o3_tpu.models.model_base import adapt_test_matrix
+    import jax.numpy as jnp
+    X = np.asarray(jax.device_get(
+        adapt_test_matrix(model, frame)))[: frame.nrow]
+    if len(X) > 2000:
+        X = X[np.random.default_rng(0).choice(len(X), 2000, replace=False)]
+    for a, b in itertools.islice(itertools.combinations(top, 2), max_pairs):
+        ja, jb = model.feature_names.index(a), model.feature_names.index(b)
+        ga = pd1[a]["grid"][:6]
+        gb = pd1[b]["grid"][:6]
+        if model.feature_is_cat[ja]:
+            ga = list(range(len(ga)))
+        if model.feature_is_cat[jb]:
+            gb = list(range(len(gb)))
+        joint = np.zeros((len(ga), len(gb)))
+        for i, va in enumerate(ga):
+            for j2, vb in enumerate(gb):
+                Xg = X.copy()
+                Xg[:, ja] = va
+                Xg[:, jb] = vb
+                pred = np.asarray(jax.device_get(
+                    model._predict_matrix(jnp.asarray(Xg))))
+                if pred.ndim == 2:
+                    pred = pred[:, -1]
+                joint[i, j2] = pred.mean()
+        # H²: fraction of joint PD variance beyond the additive parts
+        ma = joint.mean(axis=1, keepdims=True)
+        mb = joint.mean(axis=0, keepdims=True)
+        additive = ma + mb - joint.mean()
+        denom = max(joint.var(), 1e-30)
+        h2 = float(((joint - additive) ** 2).mean() / denom)
+        rows.append({"pair": (a, b), "h_squared": h2})
+    rows.sort(key=lambda r: -r["h_squared"])
+    return rows

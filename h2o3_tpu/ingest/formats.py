@@ -106,8 +106,10 @@ def parse_arff(path: str, mesh=None, key: Optional[str] = None) -> Frame:
                               for t in col])
             vecs.append(Vec.from_numpy(arr, mesh=mesh))
         elif kinds[i] == "date":
-            # epoch millis (Vec T_TIME convention); unparseable → NA
-            from datetime import datetime
+            # epoch millis in UTC (machine-independent; naive
+            # .timestamp() would shift with the host timezone);
+            # numeric tokens are taken as epoch millis already
+            from datetime import datetime, timezone
 
             def _epoch(t):
                 if t is None:
@@ -115,7 +117,9 @@ def parse_arff(path: str, mesh=None, key: Optional[str] = None) -> Frame:
                 for fmt in ("%Y-%m-%d %H:%M:%S", "%Y-%m-%d",
                             "%m/%d/%Y", "%Y-%m-%dT%H:%M:%S"):
                     try:
-                        return datetime.strptime(t, fmt).timestamp() * 1e3
+                        dt = datetime.strptime(t, fmt).replace(
+                            tzinfo=timezone.utc)
+                        return dt.timestamp() * 1e3
                     except ValueError:
                         continue
                 try:

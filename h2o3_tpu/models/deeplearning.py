@@ -52,6 +52,7 @@ DL_DEFAULTS: Dict = dict(
     # TPU batch size: the reference's mini_batch_size default 1 feeds the
     # per-row Hogwild loop; a batched MXU step wants hundreds of rows
     mini_batch_size=256,
+    autoencoder=False,
     seed=-1, stopping_rounds=0, stopping_metric="auto",
     stopping_tolerance=1e-3, score_interval=1,
 )
@@ -96,6 +97,10 @@ def _forward(params, x, act, drop_key=None, in_drop=0.0, hid_drops=None):
 
 
 def _loss_fn(out, y, w, task, dist_name):
+    if task == "autoencoder":
+        # reconstruction MSE over the standardized inputs (y = Xs batch)
+        per = 0.5 * ((out - y) ** 2).sum(axis=1)
+        return (w * per).sum() / jnp.maximum(w.sum(), 1e-12)
     if task == "classification":
         logp = jax.nn.log_softmax(out, axis=1)
         ll = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
@@ -192,7 +197,7 @@ def _compiled_epoch(sizes, act_name, task, dist_name, l1, l2, in_drop,
             # (padded - use_rows rows) cycles instead of permanently
             # excluding the same rows
             Xp = jnp.roll(Xs, shift, axis=0)[:use_rows]
-            yp = jnp.roll(y, shift)[:use_rows]
+            yp = jnp.roll(y, shift, axis=0)[:use_rows]
             wp = jnp.roll(w, shift)[:use_rows]
 
         def one_batch(carry, i):
@@ -234,6 +239,8 @@ class DeepLearningModel(Model):
         Xs = (Xe - jnp.asarray(self.xm)[None, :]) / jnp.asarray(self.xs)[None, :]
         act = _ACTS[self.activation]
         out = _forward(self.net, Xs, act)
+        if self.task == "autoencoder":
+            return out                    # standardized reconstruction
         if self.task == "classification":
             probs = jax.nn.softmax(out, axis=1)
             return probs
@@ -243,6 +250,46 @@ class DeepLearningModel(Model):
         if offset is not None:
             mu = mu + offset
         return mu
+
+    def predict(self, frame):
+        if self.task != "autoencoder":
+            return super().predict(frame)
+        # autoencoder: reconstruction in ORIGINAL units, one column per
+        # expanded feature (h2o predict on an autoencoder model)
+        from h2o3_tpu.frame.frame import Frame
+        from h2o3_tpu.frame.vec import Vec
+        from h2o3_tpu.models.model_base import adapt_test_matrix
+        X = adapt_test_matrix(self, frame)
+        out = self._predict_matrix(X)
+        recon = out * jnp.asarray(self.xs)[None, :] + \
+            jnp.asarray(self.xm)[None, :]
+        R = np.asarray(jax.device_get(recon))[: frame.nrow]
+        names = [f"reconstr_{n}" for n in self.exp_names]
+        return Frame(names, [Vec.from_numpy(R[:, i].astype(np.float32))
+                             for i in range(R.shape[1])])
+
+    def anomaly(self, frame, per_feature: bool = False):
+        """Per-row reconstruction MSE in standardized space
+        (h2o.anomaly / ModelMetricsAutoEncoder scoring)."""
+        from h2o3_tpu.frame.frame import Frame
+        from h2o3_tpu.frame.vec import Vec
+        from h2o3_tpu.models.glm import expand_scoring_matrix
+        from h2o3_tpu.models.model_base import adapt_test_matrix
+        X = adapt_test_matrix(self, frame)
+        Xe = expand_scoring_matrix(self, X)
+        Xs = (Xe - jnp.asarray(self.xm)[None, :]) / \
+            jnp.asarray(self.xs)[None, :]
+        out = _forward(self.net, Xs, _ACTS[self.activation])
+        err = (out - Xs) ** 2
+        if per_feature:
+            E = np.asarray(jax.device_get(err))[: frame.nrow]
+            names = [f"reconstr_{n}.SE" for n in self.exp_names]
+            return Frame(names,
+                         [Vec.from_numpy(E[:, i].astype(np.float32))
+                          for i in range(E.shape[1])])
+        mse = np.asarray(jax.device_get(err.mean(axis=1)))[: frame.nrow]
+        return Frame(["Reconstruction.MSE"],
+                     [Vec.from_numpy(mse.astype(np.float32))])
 
     # -- persistence ----------------------------------------------------
 
@@ -284,10 +331,14 @@ class H2ODeepLearningEstimator(ModelBuilder):
         merged = dict(DL_DEFAULTS)
         merged.update(params)
         super().__init__(**merged)
+        # autoencoder mode is unsupervised: train() must not demand y
+        self.supervised = not bool(merged.get("autoencoder"))
 
     def _train_impl(self, spec: TrainingSpec, valid_spec, job: Job):
         p = self.params
-        task = "classification" if spec.nclasses > 1 else "regression"
+        autoenc = bool(p.get("autoencoder"))
+        task = ("autoencoder" if autoenc else
+                "classification" if spec.nclasses > 1 else "regression")
         dist_name = (p.get("distribution") or "auto").lower()
         if dist_name in ("auto", ""):
             dist_name = ("multinomial" if spec.nclasses > 2 else
@@ -309,9 +360,15 @@ class H2ODeepLearningEstimator(ModelBuilder):
             xm = jnp.zeros_like(xm)
             xs = jnp.ones_like(xs)
         Xs = (Xe - xm[None, :]) / xs[None, :]
-        y = (spec.y.astype(jnp.int32) if task == "classification"
-             else spec.y.astype(jnp.float32))
-        n_out = spec.nclasses if task == "classification" else 1
+        if task == "autoencoder":
+            # the network reconstructs its own standardized inputs
+            # (hex/deeplearning autoencoder mode)
+            y = Xs
+            n_out = Fe
+        else:
+            y = (spec.y.astype(jnp.int32) if task == "classification"
+                 else spec.y.astype(jnp.float32))
+            n_out = spec.nclasses if task == "classification" else 1
         hidden = [int(h) for h in (p.get("hidden") or (200, 200))]
         sizes = [Fe] + hidden + [n_out]
         seed = int(p.get("seed", -1) or -1)
@@ -400,6 +457,32 @@ class H2ODeepLearningEstimator(ModelBuilder):
         model.scoring_history = history
         model.output["training_loop_seconds"] = t_loop
         model.output["epochs_trained"] = e + 1
+        if task == "autoencoder":
+            # reconstruction error metrics (hex/ModelMetricsAutoEncoder:
+            # MSE over all reconstructed cells)
+            from h2o3_tpu.models.metrics import make_regression_metrics
+
+            def recon_metrics(Xs_in, w_in):
+                out_ = _forward(net, Xs_in, act)
+                per_row = np.asarray(jax.device_get(
+                    ((out_ - Xs_in) ** 2).mean(axis=1)))
+                wh = np.asarray(jax.device_get(w_in))
+                live = wh > 0
+                mm = make_regression_metrics(
+                    per_row[live], np.zeros(live.sum(), np.float32),
+                    wh[live])
+                return mm, float((per_row[live] * wh[live]).sum()
+                                 / max(wh[live].sum(), 1e-30))
+
+            model.training_metrics, mse = recon_metrics(Xs, w)
+            model.output["reconstruction_mse"] = mse
+            if valid_spec is not None:
+                vXe, _, _ = expand_design(valid_spec, impute_means=means)
+                vXs = (vXe - xm[None, :]) / xs[None, :]
+                model.validation_metrics, vmse = recon_metrics(
+                    vXs, valid_spec.w)
+                model.output["validation_reconstruction_mse"] = vmse
+            return model
         out = model._predict_matrix(spec.X)
         model.training_metrics = compute_metrics(out, spec.y, w,
                                                  spec.nclasses,
@@ -414,6 +497,11 @@ class H2ODeepLearningEstimator(ModelBuilder):
     def _score(self, net, act, Xs, y, w, valid_spec, task, dist_name, xm,
                xs, means, exp_names, spec, epoch):
         out = _forward(net, Xs, act)
+        if task == "autoencoder":
+            mse = float(jax.device_get(
+                (w * ((out - y) ** 2).mean(axis=1)).sum() / w.sum()))
+            return {"epoch": epoch, "mse": mse,
+                    "rmse": float(np.sqrt(mse)), "deviance": mse}
         if task == "classification":
             logp = jax.nn.log_softmax(out, axis=1)
             ll = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]

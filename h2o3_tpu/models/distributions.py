@@ -210,6 +210,8 @@ def get_distribution(name: str, tweedie_power: float = 1.5,
                      huber_delta: float = 1.0) -> Distribution:
     if isinstance(name, Distribution):
         return name
+    if isinstance(name, type) and issubclass(name, Distribution):
+        return name()
     name = (name or "gaussian").lower()
     if name.startswith("custom"):
         key = name.split(":", 1)[1] if ":" in name else name

@@ -69,10 +69,43 @@ class StackedEnsembleModel(Model):
         Z = np.stack(cols, axis=1).astype(np.float32)
         return self.meta_model._predict_matrix(jnp.asarray(Z))
 
-    # persistence: save base model keys only (reference SE also keeps
-    # references; the bundle export is future work)
+    # persistence: the whole ensemble bundles into ONE artifact — each
+    # base model and the metalearner nest via model_to_meta/
+    # model_from_meta (the reference exports SE MOJOs the same way:
+    # base models embedded)
+    def _save_arrays(self):
+        from h2o3_tpu.persist import model_to_meta  # noqa: F401
+        d = {}
+        for i, bm in enumerate(self.base_models):
+            for k, v in bm._save_arrays().items():
+                d[f"base{i}__{k}"] = v
+        for k, v in self.meta_model._save_arrays().items():
+            d[f"meta__{k}"] = v
+        return d
+
     def _save_extra_meta(self):
-        return {"n_base": len(self.base_models)}
+        from h2o3_tpu.persist import model_to_meta
+        return {"n_base": len(self.base_models),
+                "base_metas": [model_to_meta(bm)
+                               for bm in self.base_models],
+                "meta_meta": model_to_meta(self.meta_model)}
+
+    @classmethod
+    def _restore(cls, meta, arrays):
+        from h2o3_tpu.persist import model_from_meta
+        m = cls._restore_base(meta)
+        ex = meta["extra"]
+        m.base_models = []
+        for i, bm_meta in enumerate(ex["base_metas"]):
+            pre = f"base{i}__"
+            sub = {k[len(pre):]: v for k, v in arrays.items()
+                   if k.startswith(pre)}
+            m.base_models.append(model_from_meta(bm_meta, sub))
+        sub = {k[len("meta__"):]: v for k, v in arrays.items()
+               if k.startswith("meta__")}
+        m.meta_model = model_from_meta(ex["meta_meta"], sub)
+        m.ntrees_built = 0
+        return m
 
 
 def _level_one_frame(base_models, y_codes, w, nrow, response_domain):

@@ -258,8 +258,10 @@ class GLMModel(Model):
                  nobs, rank):
         super().__init__(key, params, spec)
         self.family = family
-        self.beta = np.asarray(beta)           # raw-scale, [Fe]
-        self.intercept_value = float(intercept_val)
+        self.beta = np.asarray(beta)           # raw-scale, [Fe] or [Fe, K]
+        self.intercept_value = (np.asarray(intercept_val)
+                                if np.ndim(intercept_val) else
+                                float(intercept_val))
         self.exp_names = list(exp_names)
         self.impute_means = {k: float(v) for k, v in impute_means.items()}
         self.lambda_best = lambda_best
@@ -269,12 +271,29 @@ class GLMModel(Model):
         self.rank = rank
 
     def coef(self) -> Dict[str, float]:
+        if self.family == "multinomial":
+            # per-class coefficient maps keyed by response level
+            dom = self.response_domain or tuple(
+                str(k) for k in range(self.nclasses))
+            out: Dict[str, Dict[str, float]] = {}
+            for k, lbl in enumerate(dom):
+                d = {"Intercept": float(self.intercept_value[k])}
+                d.update({n: float(self.beta[j, k])
+                          for j, n in enumerate(self.exp_names)})
+                out[str(lbl)] = d
+            return out
         d = {"Intercept": self.intercept_value}
         d.update({n: float(b) for n, b in zip(self.exp_names, self.beta)})
         return d
 
     def _predict_matrix(self, X, offset=None):
         Xe = expand_scoring_matrix(self, X)
+        if self.family == "multinomial":
+            eta = Xe @ jnp.asarray(self.beta) + \
+                jnp.asarray(self.intercept_value)[None, :]
+            if offset is not None:
+                eta = eta + offset[:, None]
+            return jax.nn.softmax(eta, axis=1)
         eta = Xe @ jnp.asarray(self.beta) + self.intercept_value
         if offset is not None:
             eta = eta + offset
@@ -291,7 +310,10 @@ class GLMModel(Model):
                 **pack_impute_means(self.impute_means)}
 
     def _save_extra_meta(self):
-        return {"family": self.family, "intercept": self.intercept_value,
+        icpt = (self.intercept_value.tolist()
+                if isinstance(self.intercept_value, np.ndarray)
+                else self.intercept_value)
+        return {"family": self.family, "intercept": icpt,
                 "exp_names": self.exp_names, "lambda_best": self.lambda_best,
                 "null_deviance": self.null_deviance,
                 "residual_deviance": self.residual_deviance,
@@ -302,7 +324,9 @@ class GLMModel(Model):
         m = cls._restore_base(meta)
         ex = meta["extra"]
         m.family = ex["family"]
-        m.intercept_value = ex["intercept"]
+        m.intercept_value = (np.asarray(ex["intercept"])
+                             if isinstance(ex["intercept"], list)
+                             else ex["intercept"])
         m.exp_names = list(ex["exp_names"])
         m.lambda_best = ex["lambda_best"]
         m.null_deviance = ex["null_deviance"]
@@ -332,15 +356,15 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
             if spec.nclasses == 2:
                 return "binomial"
             if spec.nclasses > 2:
-                raise NotImplementedError(
-                    "multinomial GLM is not implemented yet (hex/glm "
-                    "multinomial); encode one-vs-rest manually")
+                return "multinomial"
             return "gaussian"
         return fam
 
     def _train_impl(self, spec: TrainingSpec, valid_spec, job: Job) -> GLMModel:
         p = self.params
         family = self._resolve_family(spec)
+        if family == "multinomial":
+            return self._train_multinomial(spec, valid_spec, job)
         if family not in _FAMILIES:
             raise ValueError(f"unsupported family '{family}'; have "
                              f"{sorted(_FAMILIES)}")
@@ -524,6 +548,129 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
             model.validation_metrics = compute_metrics(
                 vout, valid_spec.y, valid_spec.w, spec.nclasses,
                 spec.response_domain)
+        return model
+
+    def _train_multinomial(self, spec: TrainingSpec, valid_spec,
+                           job: Job) -> GLMModel:
+        """Multinomial softmax GLM — class-cyclic IRLS.
+
+        hex/glm multinomial solves the softmax likelihood with IRLSM on
+        a per-class block-diagonal Hessian (GLMTask multinomial path):
+        each pass updates class k's coefficients from the weighted Gram
+        Xᵀdiag(w·p_k(1−p_k))X — one MXU matmul + Cholesky per class.
+        Elastic net applies per class via the same CD kernel."""
+        p = self.params
+        K = spec.nclasses
+        if spec.offset is not None:
+            raise NotImplementedError(
+                "offset_column is not supported for multinomial GLM "
+                "(the class-cyclic IRLS path has no offset term yet)")
+        if p.get("lambda_search"):
+            raise NotImplementedError(
+                "lambda_search is not supported for multinomial GLM — "
+                "pass an explicit Lambda")
+        fit_intercept = bool(p.get("intercept", True))
+        y = spec.y.astype(jnp.int32)
+        w = spec.w
+        Xe, exp_names, means = expand_design(spec)
+        Fe = Xe.shape[1]
+        nobs = float(jax.device_get(w.sum()))
+        standardize = bool(p.get("standardize", True)) and fit_intercept
+        wsum = w.sum()
+        xm = (Xe * w[:, None]).sum(0) / wsum
+        xv = (w[:, None] * (Xe - xm[None, :]) ** 2).sum(0) / wsum
+        xs = jnp.sqrt(jnp.maximum(xv, 1e-12))
+        if standardize:
+            Xs = (Xe - xm[None, :]) * (1.0 / xs)[None, :] * (w > 0)[:, None]
+        else:
+            Xs = Xe * (w > 0)[:, None]
+        if fit_intercept:
+            Xs = jnp.concatenate([Xs, (w > 0).astype(jnp.float32)[:, None]],
+                                 axis=1)
+            pen_mask = jnp.concatenate([jnp.ones(Fe), jnp.zeros(1)])
+        else:
+            pen_mask = jnp.ones(Fe)
+        ncoef = Xs.shape[1]
+        Y1 = jax.nn.one_hot(y, K) * (w > 0)[:, None]
+        alpha = p.get("alpha")
+        alpha = 0.5 if alpha is None else (
+            alpha[0] if isinstance(alpha, (list, tuple)) else float(alpha))
+        lam_param = p.get("Lambda")
+        if isinstance(lam_param, (list, tuple)):
+            lam = float(lam_param[0]) if lam_param else 0.0
+        else:
+            lam = float(lam_param) if lam_param is not None else 0.0
+        lam1 = jnp.float32(lam * alpha * nobs)
+        lam2 = jnp.float32(lam * (1 - alpha) * nobs)
+        max_iter = int(p.get("max_iterations", 50))
+        beta_eps = float(p.get("beta_epsilon", 1e-5))
+        use_cd = lam > 0 and alpha > 0
+
+        @jax.jit
+        def class_pass(B):
+            """One cyclic sweep over classes; returns updated B."""
+            def one_class(k, B):
+                eta = Xs @ B
+                P = jax.nn.softmax(eta, axis=1)
+                pk = P[:, k]
+                yk = Y1[:, k]
+                w_irls = w * pk * (1.0 - pk)
+                z = eta[:, k] + (yk - pk) / jnp.maximum(
+                    pk * (1.0 - pk), 1e-5)
+                G, b = _gram_kernel(Xs, w_irls, z)
+                if use_cd:
+                    nb = _cd_elastic_net(G, b, B[:, k], lam1, lam2,
+                                         pen_mask, n_sweeps=10)
+                else:
+                    nb = _cholesky_solve(G, b, lam2, pen_mask)
+                return B.at[:, k].set(nb)
+
+            return jax.lax.fori_loop(0, K, one_class, B)
+
+        B = jnp.zeros((ncoef, K), jnp.float32)
+        for it in range(max_iter):
+            nB = class_pass(B)
+            delta = float(jax.device_get(jnp.max(jnp.abs(nB - B))))
+            B = nB
+            job.set_progress((it + 1) / max_iter)
+            if delta < beta_eps:
+                break
+        # deviance bookkeeping
+        eta = Xs @ B
+        P = jax.nn.softmax(eta, axis=1)
+        py = jnp.clip((P * Y1).sum(1), 1e-12, 1.0)
+        res_dev = float(jax.device_get(
+            -2.0 * (w * jnp.where(w > 0, jnp.log(py), 0.0)).sum()))
+        prior = (Y1 * w[:, None]).sum(0) / jnp.maximum(wsum, 1e-30)
+        null_dev = float(jax.device_get(
+            -2.0 * (w * jnp.where(
+                w > 0, jnp.log(jnp.clip(prior[y], 1e-12, 1.0)),
+                0.0)).sum()))
+        # destandardize per class
+        if standardize:
+            beta_raw = B[:Fe, :] / xs[:, None]
+            icpt = B[Fe, :] - (B[:Fe, :] * (xm / xs)[:, None]).sum(0)
+        else:
+            beta_raw = B[:Fe, :]
+            icpt = B[Fe, :] if fit_intercept else jnp.zeros(K)
+        rank = int(jax.device_get(
+            (jnp.abs(B[:Fe, :]) > 1e-10).sum())) + (K if fit_intercept
+                                                    else 0)
+        model = GLMModel(f"glm_{id(self) & 0xffffff:x}", self.params, spec,
+                         "multinomial",
+                         np.asarray(jax.device_get(beta_raw)),
+                         np.asarray(jax.device_get(icpt)), exp_names,
+                         {k_: float(jax.device_get(v))
+                          for k_, v in means.items()},
+                         lam, null_dev, res_dev, nobs, rank)
+        model.output["coefficients"] = model.coef()
+        out = model._predict_matrix(spec.X)
+        model.training_metrics = compute_metrics(
+            out, spec.y, w, K, spec.response_domain)
+        if valid_spec is not None:
+            vout = model._predict_matrix(valid_spec.X)
+            model.validation_metrics = compute_metrics(
+                vout, valid_spec.y, valid_spec.w, K, spec.response_domain)
         return model
 
 

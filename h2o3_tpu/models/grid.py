@@ -100,6 +100,12 @@ class H2OGridSearch:
         # -auto_recovery_dir flag): completed grid points persist as
         # artifacts + a manifest; a restarted grid resumes from it
         done: Dict[str, str] = {}
+        # fingerprint of the non-hyper base config: a resume against a
+        # CHANGED base estimator must retrain, not load stale artifacts
+        base_fp = json.dumps(
+            {k: v for k, v in sorted(base_params.items())
+             if not callable(v)},
+            sort_keys=True, default=str)
         if self.recovery_dir:
             os.makedirs(self.recovery_dir, exist_ok=True)
             manifest = os.path.join(self.recovery_dir,
@@ -107,7 +113,9 @@ class H2OGridSearch:
             if os.path.exists(manifest):
                 try:
                     with open(manifest) as f:
-                        done = json.load(f).get("completed", {})
+                        m = json.load(f)
+                    if m.get("base") == base_fp:
+                        done = m.get("completed", {})
                 except (json.JSONDecodeError, OSError):
                     done = {}  # crashed mid-write — retrain everything
         for i, combo in enumerate(self._combos()):
@@ -147,7 +155,7 @@ class H2OGridSearch:
                                          f"{self.grid_id}.json")
                     tmp = mpath + ".part"
                     with open(tmp, "w") as f:
-                        json.dump({"completed": done}, f)
+                        json.dump({"base": base_fp, "completed": done}, f)
                     os.replace(tmp, mpath)
             except Exception as e:  # noqa: BLE001 — grid keeps walking
                 self.failures.append({"params": combo, "error": str(e)})

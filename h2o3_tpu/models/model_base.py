@@ -517,7 +517,9 @@ class ModelBuilder:
             # UDF metric (water/udf CMetricFunc analog): a callable
             # (pred, y, w) -> float evaluated on the training data
             cmf = self.params.get("custom_metric_func")
-            if callable(cmf):
+            # unsupervised specs carry a dummy zero y — a metric on it
+            # would be meaningless (and wrappers may not even score)
+            if callable(cmf) and spec.response is not None:
                 pred = np.asarray(jax.device_get(
                     model._predict_matrix(spec.X)))
                 yh = np.asarray(jax.device_get(spec.y))

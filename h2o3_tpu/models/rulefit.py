@@ -116,6 +116,15 @@ class RuleFitModel(Model):
 
     def rule_importance(self):
         coefs = self.inner.coef()
+        if self.inner.nclasses > 2:
+            # multinomial: per-class coefficient maps — rank rules by the
+            # largest |coefficient| across classes
+            agg = {}
+            for cls_map in coefs.values():
+                for n, v in cls_map.items():
+                    if abs(v) > abs(agg.get(n, 0.0)):
+                        agg[n] = v
+            coefs = agg
         rows = []
         for i, rn in enumerate(self.inner.feature_names):
             c = coefs.get(rn, 0.0)
@@ -163,10 +172,6 @@ class H2ORuleFitEstimator(ModelBuilder):
 
     def _train_impl(self, spec, valid_spec, job: Job):
         from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
-        if spec.nclasses > 2:
-            raise NotImplementedError(
-                "rulefit supports regression and binomial classification "
-                "(multinomial GLM is not implemented)")
         p = self.params
         model_type = (p.get("model_type") or "rules_and_linear").lower()
         min_d = max(1, int(p.get("min_rule_length", 1)))
@@ -279,10 +284,16 @@ class H2ORuleFitEstimator(ModelBuilder):
         data["__w"] = wvals.astype(np.float32)
         glm_frame = Frame(list(data.keys()),
                           [Vec.from_numpy(v) for v in data.values()])
-        glm = H2OGeneralizedLinearEstimator(
-            alpha=1.0, lambda_search=True, nlambdas=30,
-            family="binomial" if spec.nclasses == 2 else "gaussian",
-            weights_column="__w")
+        if spec.nclasses > 2:
+            # multinomial path takes a single lambda (no search)
+            glm = H2OGeneralizedLinearEstimator(
+                alpha=1.0, Lambda=[1e-3], family="multinomial",
+                weights_column="__w")
+        else:
+            glm = H2OGeneralizedLinearEstimator(
+                alpha=1.0, lambda_search=True, nlambdas=30,
+                family="binomial" if spec.nclasses == 2 else "gaussian",
+                weights_column="__w")
         glm.train(y="__response", x=names, training_frame=glm_frame)
         inner = glm.model
         model = RuleFitModel(

@@ -51,7 +51,14 @@ def train_segments(builder_factory, segment_columns: Sequence[str],
             cols.append(np.asarray(v.to_strings(), dtype=object))
         else:
             cols.append(v.to_numpy())
-    keys = list(zip(*cols))
+
+    def seg_key(vals):
+        # NaN != NaN would make every NA row its own segment; collapse
+        # all NAs of a column to one None-keyed segment
+        return tuple(None if (isinstance(x, float) and np.isnan(x))
+                     else x for x in vals)
+
+    keys = [seg_key(k) for k in zip(*cols)]
     uniq = []
     seen = set()
     for k in keys:
@@ -68,7 +75,13 @@ def train_segments(builder_factory, segment_columns: Sequence[str],
     def one(seg):
         mask = np.ones(training_frame.nrow, bool)
         for c_arr, v in zip(cols, seg):
-            mask &= (c_arr == v)
+            if v is None:
+                mask &= np.asarray(
+                    [isinstance(x, float) and np.isnan(x)
+                     for x in c_arr]) if c_arr.dtype == object else \
+                    np.isnan(c_arr.astype(float))
+            else:
+                mask &= (c_arr == v)
         sub = training_frame.rows(mask).drop(list(segment_columns))
         row = {"segment": dict(zip(segment_columns, seg)),
                "nrow": int(mask.sum()), "model": None,

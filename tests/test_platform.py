@@ -153,3 +153,87 @@ def test_weighted_quantile_nan_handling():
     w[3] = np.nan
     q2 = weighted_quantile(x, [0.5], weights=w)
     assert np.isfinite(q2).all()
+
+
+def test_multinomial_glm_vs_sklearn():
+    from sklearn.linear_model import LogisticRegression
+    rng = np.random.default_rng(21)
+    n, K = 2000, 3
+    X = rng.normal(size=(n, 4))
+    W = rng.normal(size=(4, K)) * 1.5
+    y = (X @ W + rng.normal(scale=0.5, size=(n, K))).argmax(1)
+    lbl = np.array(["a", "b", "c"], dtype=object)[y]
+    fr = h2o.Frame.from_numpy(
+        {**{f"x{i}": X[:, i] for i in range(4)}, "y": lbl})
+    glm = H2OGeneralizedLinearEstimator(Lambda=[0.0], max_iterations=100)
+    glm.train(y="y", training_frame=fr)
+    P = np.stack([glm.model.predict(fr).vec(f"p{c}").to_numpy()
+                  for c in ("a", "b", "c")], 1)
+    sk = LogisticRegression(penalty=None, max_iter=2000).fit(X, y)
+    assert np.abs(P - sk.predict_proba(X)).max() < 5e-3
+    coefs = glm.model.coef()
+    assert set(coefs) == {"a", "b", "c"}
+
+
+def test_multinomial_glm_save_load(tmp_path):
+    rng = np.random.default_rng(23)
+    n = 500
+    X = rng.normal(size=(n, 2))
+    y = np.array(["p", "q", "r"], dtype=object)[
+        np.clip(np.digitize(X[:, 0], [-0.5, 0.5]), 0, 2)]
+    fr = h2o.Frame.from_numpy({"x0": X[:, 0], "x1": X[:, 1], "y": y})
+    glm = H2OGeneralizedLinearEstimator(Lambda=[0.0])
+    glm.train(y="y", training_frame=fr)
+    p = h2o.save_model(glm.model, str(tmp_path), filename="mglm")
+    m2 = h2o.load_model(p)
+    p1 = glm.model.predict(fr).vec("pp").to_numpy()
+    p2 = m2.predict(fr).vec("pp").to_numpy()
+    np.testing.assert_allclose(p1, p2, rtol=1e-6)
+
+
+def test_partial_dependence_monotone_feature():
+    from h2o3_tpu.analytics import partial_dependence
+    fr = _reg_frame(seed=31)
+    gbm = H2OGradientBoostingEstimator(ntrees=20, max_depth=3, seed=1)
+    gbm.train(y="y", training_frame=fr)
+    pd = partial_dependence(gbm.model, fr, ["x0", "x1"], nbins=10)
+    m = np.asarray(pd["x0"]["mean_response"])
+    # y = 2*x0 + noise → PD along x0 rises strongly
+    assert m[-1] - m[0] > 2.0
+    m1 = np.asarray(pd["x1"]["mean_response"])
+    assert (m1.max() - m1.min()) < (m.max() - m.min()) * 0.5
+
+
+def test_create_frame_and_tabulate():
+    from h2o3_tpu.analytics import create_frame, tabulate
+    fr = create_frame(rows=1000, cols=8, categorical_fraction=0.25,
+                      missing_fraction=0.05, seed=1, has_response=True)
+    assert fr.nrow == 1000
+    assert fr.ncol == 9
+    types = set(fr.types.values())
+    assert "enum" in types and "real" in types
+    t = tabulate(fr, fr.names[0], "response", nbins_x=5)
+    assert sum(sum(r) for r in t["counts"]) <= 1000
+    assert len(t["mean_y_per_x"]) == len(t["x_labels"])
+
+
+def test_deeplearning_autoencoder_detects_anomalies():
+    from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
+    rng = np.random.default_rng(41)
+    n = 1200
+    # inliers on a 2-D manifold inside 5-D space
+    z = rng.normal(size=(n, 2))
+    W = rng.normal(size=(2, 5))
+    X = z @ W + rng.normal(scale=0.05, size=(n, 5))
+    X[:15] = rng.uniform(-6, 6, size=(15, 5))    # off-manifold outliers
+    fr = h2o.Frame.from_numpy({f"x{i}": X[:, i] for i in range(5)})
+    ae = H2ODeepLearningEstimator(autoencoder=True, hidden=[2],
+                                  epochs=60, seed=1, activation="tanh")
+    ae.train(training_frame=fr)                  # no y needed
+    an = ae.model.anomaly(fr).vec("Reconstruction.MSE").to_numpy()
+    top = np.argsort(-an)[:20]
+    assert np.sum(top < 15) >= 10, np.sum(top < 15)
+    rec = ae.model.predict(fr)
+    assert rec.ncol == 5
+    assert rec.names[0] == "reconstr_x0"
+    assert ae.model.output["reconstruction_mse"] < 1.0

@@ -1,0 +1,71 @@
+"""SQL import (JDBC analog) + StackedEnsemble bundle persistence."""
+import sqlite3
+
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.ingest.sql import import_sql_table
+
+
+def _make_db(path, n=500):
+    con = sqlite3.connect(path)
+    cur = con.cursor()
+    cur.execute("CREATE TABLE t (id INTEGER, x REAL, label TEXT)")
+    rng = np.random.default_rng(0)
+    rows = [(i, float(rng.normal()), ("a" if i % 3 else "b"))
+            for i in range(n)]
+    cur.executemany("INSERT INTO t VALUES (?,?,?)", rows)
+    con.commit()
+    con.close()
+    return rows
+
+
+def test_import_sql_table_key_ranges(tmp_path):
+    db = str(tmp_path / "t.db")
+    rows = _make_db(db)
+    fr = import_sql_table(lambda: sqlite3.connect(db), "t",
+                          key_column="id", fetch_chunks=4)
+    assert fr.nrow == len(rows)
+    assert fr.names == ["id", "x", "label"]
+    got = fr.vec("x").to_numpy()
+    want = np.asarray([r[1] for r in rows])
+    # ranges may arrive out of order — compare as multisets keyed by id
+    order = np.argsort(fr.vec("id").to_numpy())
+    np.testing.assert_allclose(got[order], want, rtol=1e-6)
+    assert fr.vec("label").is_categorical or \
+        fr.vec("label").type in ("enum", "string")
+
+
+def test_import_sql_table_offset_mode(tmp_path):
+    db = str(tmp_path / "t2.db")
+    rows = _make_db(db, n=97)
+    fr = import_sql_table(lambda: sqlite3.connect(db), "t",
+                          fetch_chunks=3)
+    assert fr.nrow == 97
+
+
+def test_stacked_ensemble_save_load(tmp_path):
+    from h2o3_tpu.models.drf import H2ORandomForestEstimator
+    from h2o3_tpu.models.ensemble import H2OStackedEnsembleEstimator
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    rng = np.random.default_rng(1)
+    n = 600
+    X = rng.normal(size=(n, 3))
+    y = np.where(X[:, 0] + 0.5 * X[:, 1] + rng.normal(
+        scale=0.4, size=n) > 0, "y", "n").astype(object)
+    fr = h2o.Frame.from_numpy(
+        {**{f"x{i}": X[:, i] for i in range(3)}, "y": y})
+    gbm = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=1,
+                                       nfolds=3, fold_assignment="modulo")
+    gbm.train(y="y", training_frame=fr)
+    drf = H2ORandomForestEstimator(ntrees=5, max_depth=4, seed=1,
+                                   nfolds=3, fold_assignment="modulo")
+    drf.train(y="y", training_frame=fr)
+    se = H2OStackedEnsembleEstimator(base_models=[gbm.model, drf.model])
+    se.train(y="y", training_frame=fr)
+    p = h2o.save_model(se.model, str(tmp_path), filename="se")
+    m2 = h2o.load_model(p)
+    p1 = se.model.predict(fr).vec("py").to_numpy()
+    p2 = m2.predict(fr).vec("py").to_numpy()
+    np.testing.assert_allclose(p1, p2, rtol=1e-5)
