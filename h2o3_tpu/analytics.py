@@ -163,7 +163,6 @@ def feature_interaction(model, frame: Frame, max_pairs: int = 10) -> List:
     import itertools
     vi = model.output.get("variable_importances") or {}
     top = (vi.get("variable") or list(model.feature_names))[:5]
-    pd1 = partial_dependence(model, frame, top, nbins=8)
     rows = []
     from h2o3_tpu.models.model_base import adapt_test_matrix
     import jax.numpy as jnp
@@ -171,14 +170,22 @@ def feature_interaction(model, frame: Frame, max_pairs: int = 10) -> List:
         adapt_test_matrix(model, frame)))[: frame.nrow]
     if len(X) > 2000:
         X = X[np.random.default_rng(0).choice(len(X), 2000, replace=False)]
+
+    def grid_of(col):
+        # grid values straight from the data quantiles / enum codes —
+        # no scoring pass needed just to enumerate grid points
+        j = model.feature_names.index(col)
+        if model.feature_is_cat[j]:
+            return list(range(len(model.cat_domains.get(col, ()))))[:6]
+        v = X[:, j]
+        v = v[~np.isnan(v)]
+        return np.unique(np.quantile(
+            v, np.linspace(0.05, 0.95, 6))).tolist()
+
     for a, b in itertools.islice(itertools.combinations(top, 2), max_pairs):
         ja, jb = model.feature_names.index(a), model.feature_names.index(b)
-        ga = pd1[a]["grid"][:6]
-        gb = pd1[b]["grid"][:6]
-        if model.feature_is_cat[ja]:
-            ga = list(range(len(ga)))
-        if model.feature_is_cat[jb]:
-            gb = list(range(len(gb)))
+        ga = grid_of(a)
+        gb = grid_of(b)
         joint = np.zeros((len(ga), len(gb)))
         for i, va in enumerate(ga):
             for j2, vb in enumerate(gb):

@@ -39,6 +39,10 @@ def import_sql_table(connection_factory: Callable, table: str,
             cur.execute(f"SELECT MIN({key_column}), MAX({key_column}) "
                         f"FROM {table}")
             lo, hi = cur.fetchone()
+            if lo is None or hi is None:
+                # empty table or all-NULL keys: single full fetch
+                key_column = None
+        if key_column:
             lo, hi = int(lo), int(hi)
             span = max((hi - lo + 1) // max(fetch_chunks, 1), 1)
             s = lo

@@ -460,7 +460,7 @@ class H2ODeepLearningEstimator(ModelBuilder):
         if task == "autoencoder":
             # reconstruction error metrics (hex/ModelMetricsAutoEncoder:
             # MSE over all reconstructed cells)
-            from h2o3_tpu.models.metrics import make_regression_metrics
+            from h2o3_tpu.models.metrics import ModelMetricsRegression
 
             def recon_metrics(Xs_in, w_in):
                 out_ = _forward(net, Xs_in, act)
@@ -468,11 +468,17 @@ class H2ODeepLearningEstimator(ModelBuilder):
                     ((out_ - Xs_in) ** 2).mean(axis=1)))
                 wh = np.asarray(jax.device_get(w_in))
                 live = wh > 0
-                mm = make_regression_metrics(
-                    per_row[live], np.zeros(live.sum(), np.float32),
-                    wh[live])
-                return mm, float((per_row[live] * wh[live]).sum()
-                                 / max(wh[live].sum(), 1e-30))
+                mse = float((per_row[live] * wh[live]).sum()
+                            / max(wh[live].sum(), 1e-30))
+                # MSE IS the reconstruction error — do not route per-row
+                # MSEs through the regression maker (that would square
+                # them again); ModelMetricsAutoEncoder reports the mean
+                mm = ModelMetricsRegression(
+                    mse=mse, rmse=float(np.sqrt(mse)),
+                    mae=float("nan"), rmsle=float("nan"),
+                    r2=float("nan"), mean_residual_deviance=mse,
+                    nobs=int(live.sum()))
+                return mm, mse
 
             model.training_metrics, mse = recon_metrics(Xs, w)
             model.output["reconstruction_mse"] = mse

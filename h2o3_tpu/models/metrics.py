@@ -70,42 +70,77 @@ def make_regression_metrics(pred, actual, weights=None, deviance=None) -> ModelM
 # ------------------------------------------------------------------ binomial
 
 @jax.jit
-def _binary_curve_kernel(score, y, w):
-    """Sorted threshold sweep → cumulative TP/FP at unique-score boundaries.
-
-    Exact AUC semantics under ties: per-score-group aggregation (the chord
-    rule), matching sklearn's roc_auc and the reference's intent (AUC2
-    approximates with 400 bins; we are exact)."""
+def _sorted_sweep_kernel(score, y, w):
+    """Device sort + cumulative TP/FP (small-n exact path). Boundary and
+    chord-rule logic runs host-side in numpy: every scan-flavoured XLA
+    primitive tried here (associative_scan, cummax, searchsorted) costs
+    minutes of COMPILE time at 10M elements, while argsort+cumsum
+    compile in ~2s — so the device does only those two."""
     order = jnp.argsort(-score)
     s = score[order]
-    yw = (w * y)[order]
-    nw = (w * (1.0 - y))[order]
-    tp = jnp.cumsum(yw)
-    fp = jnp.cumsum(nw)
-    # group boundary = last element of a run of equal scores
-    is_boundary = jnp.concatenate([s[1:] != s[:-1], jnp.array([True])])
-    P = tp[-1]
-    N = fp[-1]
-    # trapezoid between consecutive boundaries (chord rule over tied runs):
-    # for each boundary, find the previous boundary via a prefix-max scan
-    idx = jnp.arange(s.shape[0])
-    idxf = jnp.where(is_boundary, idx, -1)
-    # prefix max via the cummax primitive: associative_scan traces an
-    # unrolled log-depth slice tree whose XLA compile takes minutes at
-    # 10M elements (the r3 "hung bench" root cause)
-    prevb = jax.lax.cummax(idxf)                                  # last boundary ≤ i
-    prevb = jnp.concatenate([jnp.array([-1]), prevb[:-1]])        # last boundary < i
-    has_prev = prevb >= 0
-    tp_prev = jnp.where(has_prev, tp[prevb], 0.0)
-    fp_prev = jnp.where(has_prev, fp[prevb], 0.0)
-    seg = jnp.where(is_boundary, (fp - fp_prev) * (tp + tp_prev) * 0.5, 0.0)
-    auc = seg.sum() / jnp.maximum(P * N, 1e-30)
-    # PR curve: step-wise interpolation on the recall axis at boundaries
-    prec = tp / jnp.maximum(tp + fp, 1e-30)
-    rec = tp / jnp.maximum(P, 1e-30)
-    rec_prev = tp_prev / jnp.maximum(P, 1e-30)
-    aucpr = jnp.where(is_boundary, (rec - rec_prev) * prec, 0.0).sum()
-    return order, tp, fp, is_boundary, auc, aucpr, P, N
+    tp = jnp.cumsum((w * y)[order])
+    fp = jnp.cumsum((w * (1.0 - y))[order])
+    return s, tp, fp
+
+
+_AUC_BIN_BITS = 17
+_AUC_BINS = 1 << _AUC_BIN_BITS
+
+# above this row count the curve switches from the exact sorted sweep to
+# the 2^17-bucket histogram sketch (no O(n) host transfer either way)
+_EXACT_SWEEP_ROWS = 200_000
+
+
+@jax.jit
+def _binned_curve_kernel(score, y, w):
+    """Large-n curve summary: order-preserving float32-bit bucketisation
+    into 2^17 bins + scatter-add histograms (the AUC2 sketch idea,
+    hex/AUC2.java's 400 bins, at 300× finer resolution). Only scatter,
+    elementwise bit math, and a 2^17 cumsum — everything compiles fast
+    and nothing O(n) ever reaches the host."""
+    s32 = score.astype(jnp.float32)
+    bits = jax.lax.bitcast_convert_type(s32, jnp.uint32)
+    # standard float radix trick: flip all bits for negatives, set the
+    # sign bit for positives → unsigned keys in score order
+    key = jnp.where((bits >> 31) == 1, ~bits,
+                    bits | jnp.uint32(0x80000000))
+    b = (key >> (32 - _AUC_BIN_BITS)).astype(jnp.int32)
+    hp = jnp.zeros(_AUC_BINS, jnp.float32).at[b].add(w * y)
+    hn = jnp.zeros(_AUC_BINS, jnp.float32).at[b].add(w * (1.0 - y))
+    smax = jnp.full(_AUC_BINS, -jnp.inf, jnp.float32).at[b].max(s32)
+    return hp, hn, smax
+
+
+def _binary_curve(prob, y, w):
+    """(sb, tpb, fpb, P, N, auc, aucpr): score thresholds (descending)
+    with cumulative weighted TP/FP at tie-run boundaries, plus the
+    chord-rule AUC and step-interpolated PR AUC. Exact for small n;
+    quantised to 2^17 order-preserving buckets above _EXACT_SWEEP_ROWS."""
+    n = int(prob.shape[0])
+    if n <= _EXACT_SWEEP_ROWS:
+        s, tp, fp = (np.asarray(v) for v in
+                     _sorted_sweep_kernel(prob, y, w))
+        is_b = np.concatenate([s[1:] != s[:-1], [True]])
+        sb, tpb, fpb = s[is_b], tp[is_b], fp[is_b]
+    else:
+        hp, hn, smax = (np.asarray(v) for v in
+                        _binned_curve_kernel(prob, y, w))
+        occ = np.isfinite(smax) & ((hp > 0) | (hn > 0))
+        # descending score order
+        sb = smax[occ][::-1]
+        tpb = np.cumsum(hp[occ][::-1])
+        fpb = np.cumsum(hn[occ][::-1])
+    P = float(tpb[-1]) if len(tpb) else 0.0
+    N = float(fpb[-1]) if len(fpb) else 0.0
+    tp_prev = np.concatenate([[0.0], tpb[:-1]])
+    fp_prev = np.concatenate([[0.0], fpb[:-1]])
+    auc = float(((fpb - fp_prev) * (tpb + tp_prev)).sum()
+                * 0.5 / max(P * N, 1e-30))
+    prec = tpb / np.maximum(tpb + fpb, 1e-30)
+    rec = tpb / max(P, 1e-30)
+    rec_prev = tp_prev / max(P, 1e-30)
+    aucpr = float(((rec - rec_prev) * prec).sum())
+    return sb, tpb, fpb, P, N, auc, aucpr
 
 
 @jax.jit
@@ -235,18 +270,12 @@ def make_binomial_metrics(prob, actual, weights=None) -> ModelMetricsBinomial:
     prob = jnp.asarray(prob, dtype=jnp.float32)
     y = jnp.asarray(actual, dtype=jnp.float32)
     w = jnp.ones_like(y) if weights is None else jnp.asarray(weights, jnp.float32)
-    order, tp, fp, is_b, auc, aucpr, P, N = _binary_curve_kernel(prob, y, w)
-    auc = float(np.asarray(auc))
-    aucpr = float(np.asarray(aucpr))
+    n = int(prob.shape[0])
+    sb, tpb, fpb, Pf, Nf, auc, aucpr = _binary_curve(prob, y, w)
     ll = float(np.asarray(_logloss_kernel(prob, y, w)))
     reg = _regression_kernel(prob, y, w)
     mse = float(np.asarray(reg[0]))
     r2 = float(np.asarray(reg[4]))
-    # host: max-F1 threshold + confusion matrix there
-    tp_h = np.asarray(tp); fp_h = np.asarray(fp); isb_h = np.asarray(is_b)
-    s_h = np.asarray(prob)[np.asarray(order)]
-    Pf = float(np.asarray(P)); Nf = float(np.asarray(N))
-    tpb = tp_h[isb_h]; fpb = fp_h[isb_h]; sb = s_h[isb_h]
     fnb = Pf - tpb; tnb = Nf - fpb
     prec = tpb / np.maximum(tpb + fpb, 1e-30)
     rec = tpb / max(Pf, 1e-30)
@@ -264,9 +293,10 @@ def make_binomial_metrics(prob, actual, weights=None) -> ModelMetricsBinomial:
         keep = np.arange(n_b)
     table = _threshold_columns(sb[keep], tpb[keep], fpb[keep], Pf, Nf)
     table = {k: np.asarray(v).tolist() for k, v in table.items()}
-    # max_criteria over the FULL-resolution sweep (exact, tighter than AUC2);
-    # idx points into the (possibly subsampled) table above — the nearest
-    # kept row — matching the reference contract that idx indexes the table
+    # max_criteria over the FULL boundary sweep (exact below
+    # _EXACT_SWEEP_ROWS, 2^17-bucket resolution above — either way far
+    # tighter than AUC2's 400 bins); idx points at the nearest KEPT table
+    # row, matching the reference contract that idx indexes the table
     full = _threshold_columns(sb, tpb, fpb, Pf, Nf)
     max_crit = {}
     for c in _MAX_CRITERIA:
@@ -276,14 +306,47 @@ def make_binomial_metrics(prob, actual, weights=None) -> ModelMetricsBinomial:
         max_crit[c] = {"threshold": float(sb[i]), "value": float(full[c][i]),
                        "idx": ti}
     table["max_criteria_and_metric_scores"] = max_crit
-    table["gains_lift"] = make_gains_lift(np.asarray(prob), np.asarray(y),
-                                          np.asarray(w))
+    table["gains_lift"] = _gains_lift_from_curve(sb, tpb, fpb, Pf, Nf)
     return ModelMetricsBinomial(
         auc=auc, aucpr=aucpr, logloss=ll, mse=mse, rmse=float(np.sqrt(mse)),
         gini=2 * auc - 1, mean_per_class_error=float(per_class_err), r2=r2,
         f1_threshold=float(sb[bi]), max_f1=float(f1[bi]), confusion_matrix=cm,
-        accuracy=float(acc), nobs=int(prob.shape[0]),
+        accuracy=float(acc), nobs=n,
         thresholds_and_metric_scores=table)
+
+
+def _gains_lift_from_curve(sb, tpb, fpb, Pf, Nf, groups: int = 16):
+    """Gains/lift from the boundary curve (cum weight = tp+fp): same
+    semantics as make_gains_lift without re-sorting the raw scores."""
+    W = Pf + Nf
+    if not (0.0 < Pf < W) or len(sb) == 0:
+        return None
+    cum_w = tpb + fpb
+    edges = np.searchsorted(cum_w, W * np.arange(1, groups + 1) / groups,
+                            side="left")
+    edges = np.unique(np.minimum(edges, len(cum_w) - 1))
+    cw = cum_w[edges]
+    cy = tpb[edges]
+    lo_w = np.concatenate([[0.0], cw[:-1]])
+    lo_y = np.concatenate([[0.0], cy[:-1]])
+    grp_w = np.maximum(cw - lo_w, 1e-30)
+    grp_y = cy - lo_y
+    rate = Pf / W
+    return {
+        "cumulative_data_fraction": (cw / W).tolist(),
+        "lower_threshold": np.asarray(sb)[edges].tolist(),
+        "lift": (grp_y / grp_w / rate).tolist(),
+        "cumulative_lift": (cy / np.maximum(cw, 1e-30) / rate).tolist(),
+        "response_rate": (grp_y / grp_w).tolist(),
+        "cumulative_response_rate": (cy / np.maximum(cw, 1e-30)).tolist(),
+        "capture_rate": (grp_y / Pf).tolist(),
+        "cumulative_capture_rate": (cy / Pf).tolist(),
+        "gain": (100.0 * (grp_y / grp_w / rate - 1.0)).tolist(),
+        "cumulative_gain": (100.0 * (cy / np.maximum(cw, 1e-30)
+                                     / rate - 1.0)).tolist(),
+        "kolmogorov_smirnov": float(np.max(np.abs(
+            tpb / max(Pf, 1e-30) - fpb / max(Nf, 1e-30)))),
+    }
 
 
 # --------------------------------------------------------------- multinomial
@@ -342,10 +405,10 @@ def multinomial_auc_table(probs, y, w, max_classes=20) -> Optional[dict]:
             per_auc.append(float("nan")); per_pr.append(float("nan"))
             prevalence.append(float((wn * yk).sum()))
             continue
-        _, _, _, _, auc_k, pr_k, _, _ = _binary_curve_kernel(
+        _, _, _, _, _, auc_k, pr_k = _binary_curve(
             jnp.asarray(probs[:, k]), jnp.asarray(yk), jnp.asarray(w))
-        per_auc.append(float(np.asarray(auc_k)))
-        per_pr.append(float(np.asarray(pr_k)))
+        per_auc.append(float(auc_k))
+        per_pr.append(float(pr_k))
         prevalence.append(float((wn * yk).sum()))
     pa = np.asarray(per_auc); pp = np.asarray(per_pr)
     pv = np.asarray(prevalence); pv = pv / max(pv.sum(), 1e-30)

@@ -54,8 +54,9 @@ def train_segments(builder_factory, segment_columns: Sequence[str],
 
     def seg_key(vals):
         # NaN != NaN would make every NA row its own segment; collapse
-        # all NAs of a column to one None-keyed segment
-        return tuple(None if (isinstance(x, float) and np.isnan(x))
+        # all NAs (float NaN or enum/string None) to one None segment
+        return tuple(None if (x is None or (isinstance(x, float)
+                                            and np.isnan(x)))
                      else x for x in vals)
 
     keys = [seg_key(k) for k in zip(*cols)]
@@ -76,10 +77,10 @@ def train_segments(builder_factory, segment_columns: Sequence[str],
         mask = np.ones(training_frame.nrow, bool)
         for c_arr, v in zip(cols, seg):
             if v is None:
-                mask &= np.asarray(
-                    [isinstance(x, float) and np.isnan(x)
-                     for x in c_arr]) if c_arr.dtype == object else \
-                    np.isnan(c_arr.astype(float))
+                if c_arr.dtype == object:   # enum/string NA = None
+                    mask &= np.asarray([x is None for x in c_arr])
+                else:
+                    mask &= np.isnan(c_arr.astype(float))
             else:
                 mask &= (c_arr == v)
         sub = training_frame.rows(mask).drop(list(segment_columns))

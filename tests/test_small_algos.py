@@ -210,3 +210,21 @@ def test_anomaly_metrics_survive_save_load(tmp_path):
     assert m2.training_metrics is not None
     assert m2.training_metrics.mean_score == pytest.approx(
         iso.model.training_metrics.mean_score)
+
+
+def test_binned_auc_path_matches_sklearn_at_scale():
+    # n > _EXACT_SWEEP_ROWS exercises the 2^17-bucket histogram sketch
+    from sklearn.metrics import roc_auc_score
+    from h2o3_tpu.models.metrics import make_binomial_metrics
+    rng = np.random.default_rng(47)
+    n = 300_000
+    y = rng.integers(0, 2, n).astype(np.float32)
+    p = np.clip(0.35 * y + rng.normal(0.3, 0.25, n), 0, 1).astype(
+        np.float32)
+    mm = make_binomial_metrics(p, y)
+    sk = roc_auc_score(y, p)
+    assert mm.auc == pytest.approx(sk, abs=2e-4)
+    t = mm.thresholds_and_metric_scores
+    assert len(t["threshold"]) <= 400
+    assert t["gains_lift"] is not None
+    assert 1.0 <= t["gains_lift"]["lift"][0] < 3.0
