@@ -176,9 +176,12 @@ def feature_interaction(model, frame: Frame, max_pairs: int = 10) -> List:
         # no scoring pass needed just to enumerate grid points
         j = model.feature_names.index(col)
         if model.feature_is_cat[j]:
-            return list(range(len(model.cat_domains.get(col, ()))))[:6]
+            card = len(model.cat_domains.get(col, ()))
+            return list(range(max(card, 1)))[:6]
         v = X[:, j]
         v = v[~np.isnan(v)]
+        if len(v) == 0:          # all-NA sample: single neutral point
+            return [0.0]
         return np.unique(np.quantile(
             v, np.linspace(0.05, 0.95, 6))).tolist()
 

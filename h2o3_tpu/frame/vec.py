@@ -84,13 +84,16 @@ class Vec:
         if not explicit and vtype == T_INT and not _is_integral(f64):
             vtype = T_REAL
         dev = _pad_and_put(f, nrow, np.float32(np.nan), mesh)
-        # float32 mantissa is 24 bits: large ints (IDs, counts) would be
-        # silently rounded on device, so keep an exact float64 host copy
-        # (the reference keeps exact long chunks — water/fvec/C8Chunk)
+        # float32 mantissa is 24 bits: large ints (IDs, counts, epoch
+        # millis that arrive as REAL) would be silently rounded on
+        # device, so keep an exact float64 host copy whenever the values
+        # are integral and exceed the mantissa (the reference keeps
+        # exact long chunks — water/fvec/C8Chunk). Order matters: the
+        # cheap max check gates the O(n) integrality scan
         host = None
-        if vtype == T_INT:
-            finite = f64[np.isfinite(f64)]
-            if finite.size and np.abs(finite).max() > (1 << 24):
+        finite = f64[np.isfinite(f64)]
+        if finite.size and np.abs(finite).max() > (1 << 24):
+            if vtype == T_INT or _is_integral(f64):
                 host = f64
         return Vec(dev, nrow, vtype, host_data=host)
 

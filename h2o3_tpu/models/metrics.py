@@ -3,9 +3,11 @@
 Reference: hex/ModelMetrics.java and subclasses (~30 classes), AUC via
 hex/AUC2.java (400-bin threshold sketch), confusion matrices, gains/lift.
 TPU design: metrics are one jitted pass over the (sharded) prediction and
-actual arrays; AUC uses an exact full device sort instead of AUC2's
-histogram approximation (a 10M-row sort is cheap on-chip, and exactness
-makes golden tests tighter than the reference's).
+actual arrays. The AUC curve is EXACT (device sort + host chord rule)
+up to _EXACT_SWEEP_ROWS rows; above that it switches to an
+order-preserving 2^17-bucket histogram sketch — 300x finer than AUC2's
+400 bins but no longer bit-exact (golden tests at large n should allow
+~1e-4 AUC tolerance).
 """
 from __future__ import annotations
 

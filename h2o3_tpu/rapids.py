@@ -569,6 +569,154 @@ def _apply(op: str, args, env: Env):
         fr = ev(0)
         return Frame(list(fr.names),
                      [fr.vec(n).asnumeric() for n in fr.names])
+    # ---- string prims (water/rapids/ast/prims/string) ------------------
+    if op in ("tolower", "toupper", "trim", "nchar"):
+        fr = ev(0)
+        v = fr.vec(0)
+        ss = list(v.to_strings()[: fr.nrow])
+        if op == "nchar":
+            arr = np.asarray([np.nan if s is None else float(len(s))
+                              for s in ss])
+            return Frame([fr.names[0]], [Vec.from_numpy(arr)])
+        f = {"tolower": str.lower, "toupper": str.upper,
+             "trim": str.strip}[op]
+        out = np.asarray([None if s is None else f(s) for s in ss],
+                         dtype=object)
+        return Frame([fr.names[0]], [Vec.from_numpy(out)])
+    if op in ("replacefirst", "replaceall", "sub", "gsub"):
+        # reference arg order is FRAME-first: (replaceall x pattern
+        # replacement ignore_case) — h2o-py H2OFrame.gsub emits
+        # ExprNode("replaceall", self, pattern, replacement, ...)
+        import re as _re
+        fr, pat, rep = ev(0), ev(1), ev(2)
+        ignore = bool(_eval(args[3], env)) if len(args) > 3 else False
+        rx = _re.compile(pat, _re.IGNORECASE if ignore else 0)
+        count = 1 if op in ("sub", "replacefirst") else 0
+        ss = list(fr.vec(0).to_strings()[: fr.nrow])
+        out = np.asarray([None if s is None else rx.sub(rep, s, count)
+                          for s in ss], dtype=object)
+        return Frame([fr.names[0]], [Vec.from_numpy(out)])
+    if op == "substring":
+        fr, start = ev(0), int(ev(1))
+        end = int(ev(2)) if len(args) > 2 else None
+        ss = list(fr.vec(0).to_strings()[: fr.nrow])
+        out = np.asarray([None if s is None else s[start:end]
+                          for s in ss], dtype=object)
+        return Frame([fr.names[0]], [Vec.from_numpy(out)])
+    # ---- time prims (ast/prims/time; values = epoch millis) ------------
+    if op in ("year", "month", "day", "hour", "minute", "second",
+              "dayOfWeek", "week"):
+        fr = ev(0)
+        v0 = fr.vec(0)
+        ms = np.asarray(v0.to_numpy()[: fr.nrow], np.float64)
+        # T_TIME NAs arrive as the int64-min sentinel, which IS finite
+        # in float — mask it explicitly alongside NaN
+        ok = np.isfinite(ms) & (np.abs(ms) < 4e17)  # |ms| < year ~14000
+        dt = ms[ok].astype("datetime64[ms]")
+        y = dt.astype("datetime64[Y]")
+        mth = dt.astype("datetime64[M]")
+        dd = dt.astype("datetime64[D]")
+        if op == "week":
+            # ISO week-of-weekyear (reference AstWeek getWeekOfWeekyear):
+            # the ISO week of a date equals the ordinal week of its
+            # Thursday within the Thursday's calendar year
+            day_i = dd.astype(int)
+            dow = (day_i + 3) % 7                      # Mon=0
+            thursday = (day_i - dow + 3).astype("datetime64[D]")
+            ty = thursday.astype("datetime64[Y]")
+            vals = ((thursday - ty.astype("datetime64[D]")).astype(int)
+                    // 7 + 1)
+        else:
+            vals = {
+                "year": y.astype(int) + 1970,
+                "month": (mth - y.astype("datetime64[M]")).astype(int) + 1,
+                "day": (dd - mth.astype("datetime64[D]")).astype(int) + 1,
+                "hour": (dt.astype("datetime64[h]")
+                         - dd.astype("datetime64[h]")).astype(int),
+                "minute": (dt.astype("datetime64[m]").astype(int) % 60),
+                "second": (dt.astype("datetime64[s]").astype(int) % 60),
+                # reference domain Mon=0 (AstDayOfWeek); epoch day 0 = Thu
+                "dayOfWeek": (dd.astype(int) + 3) % 7,
+            }[op]
+        out = np.full(len(ms), np.nan)
+        out[ok] = vals.astype(np.float64)
+        return Frame([fr.names[0]], [Vec.from_numpy(out)])
+    # ---- misc prims ----------------------------------------------------
+    if op == "table":
+        fr = ev(0)
+        v = fr.vec(0)
+        if v.type in (T_ENUM, T_STR):
+            labs = [s for s in v.to_strings()[: fr.nrow] if s is not None]
+            vals, cnt = np.unique(np.asarray(labs, dtype=object),
+                                  return_counts=True)
+            return Frame([fr.names[0], "Count"],
+                         [Vec.from_numpy(vals),
+                          Vec.from_numpy(cnt.astype(np.float64))])
+        d = np.asarray(v.to_numpy()[: fr.nrow], np.float64)
+        vals, cnt = np.unique(d[np.isfinite(d)], return_counts=True)
+        return Frame([fr.names[0], "Count"],
+                     [Vec.from_numpy(vals),
+                      Vec.from_numpy(cnt.astype(np.float64))])
+    if op == "cor":
+        a, b = ev(0), ev(1)
+        x = np.asarray(a.vec(0).to_numpy()[: a.nrow], np.float64)
+        yv = np.asarray(b.vec(0).to_numpy()[: b.nrow], np.float64)
+        ok = np.isfinite(x) & np.isfinite(yv)
+        return float(np.corrcoef(x[ok], yv[ok])[0, 1])
+    if op in ("round", "signif"):
+        fr = ev(0)
+        digits = int(ev(1)) if len(args) > 1 else 0
+        def rnd(col):
+            if op == "round":
+                return np.round(col, digits)
+            with np.errstate(all="ignore"):
+                mag = np.where(col != 0, np.floor(np.log10(np.abs(col))),
+                               0)
+                f = 10.0 ** (digits - 1 - mag)
+                return np.round(col * f) / f
+        return Frame(list(fr.names),
+                     [Vec.from_numpy(rnd(np.asarray(
+                         fr.vec(n).to_numpy()[: fr.nrow], np.float64)))
+                      for n in fr.names])
+    if op in ("cumsum", "cumprod", "cummin", "cummax"):
+        fr = ev(0)
+        f = {"cumsum": np.cumsum, "cumprod": np.cumprod,
+             "cummin": np.minimum.accumulate,
+             "cummax": np.maximum.accumulate}[op]
+        return Frame(list(fr.names),
+                     [Vec.from_numpy(f(np.asarray(
+                         fr.vec(n).to_numpy()[: fr.nrow], np.float64)))
+                      for n in fr.names])
+    if op == "which":
+        fr = ev(0)
+        d = np.asarray(fr.vec(0).to_numpy()[: fr.nrow])
+        return Frame(["C1"],
+                     [Vec.from_numpy(np.flatnonzero(
+                         np.nan_to_num(d) != 0).astype(np.float64))])
+    if op == "na.omit":
+        fr = ev(0)
+        keep = np.ones(fr.nrow, bool)
+        for n in fr.names:
+            v = fr.vec(n)
+            if v.type in (T_ENUM, T_STR):
+                keep &= np.asarray(
+                    [s is not None for s in v.to_strings()[: fr.nrow]])
+            else:
+                keep &= np.isfinite(np.asarray(
+                    v.to_numpy()[: fr.nrow], np.float64))
+        return _take_frame(fr, np.flatnonzero(keep))
+    if op == "scale":
+        fr = ev(0)
+        center = bool(_eval(args[1], env)) if len(args) > 1 else True
+        scale_ = bool(_eval(args[2], env)) if len(args) > 2 else True
+        vecs = []
+        for n in fr.names:
+            d = np.asarray(fr.vec(n).to_numpy()[: fr.nrow], np.float64)
+            ok = np.isfinite(d)
+            m = d[ok].mean() if center and ok.any() else 0.0
+            s = d[ok].std(ddof=1) if scale_ and ok.sum() > 1 else 1.0
+            vecs.append(Vec.from_numpy((d - m) / (s or 1.0)))
+        return Frame(list(fr.names), vecs)
     raise ValueError(f"unsupported rapids op '{op}'")
 
 

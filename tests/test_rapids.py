@@ -174,3 +174,66 @@ def test_outer_merge_keeps_right_keys():
     wrow = labels.index("w")
     assert np.isnan(out.vec("a").to_numpy()[wrow])
     assert out.vec("b").to_numpy()[wrow] == 30.0
+
+
+def test_rapids_string_time_misc_prims():
+    import numpy as np
+    from h2o3_tpu import dkv
+    from h2o3_tpu.rapids import exec_rapids
+    import h2o3_tpu as h2o
+    # string ops
+    fr = h2o.Frame.from_numpy({"s": np.asarray(
+        [" Apple ", "BANANA", None], dtype=object)})
+    dkv.put("sfr", "frame", fr)
+    out = exec_rapids('(tmp= o1 (tolower (cols_py sfr "s")))')
+    got = dkv.get("o1", "frame").vec(0).to_strings()
+    assert got[0] == " apple " and got[2] is None
+    exec_rapids('(tmp= o2 (trim (cols_py sfr "s")))')
+    assert dkv.get("o2", "frame").vec(0).to_strings()[0] == "Apple"
+    exec_rapids('(tmp= o3 (nchar (cols_py sfr "s")))')
+    assert dkv.get("o3", "frame").vec(0).to_numpy()[1] == 6
+    exec_rapids('(tmp= o4 (replaceall (tolower (cols_py sfr "s")) "a" "_" 0))')
+    assert dkv.get("o4", "frame").vec(0).to_strings()[1] == "b_n_n_"
+    # time ops: 2021-03-04 05:06:07 UTC
+    import datetime as dtm
+    ms = dtm.datetime(2021, 3, 4, 5, 6, 7,
+                      tzinfo=dtm.timezone.utc).timestamp() * 1e3
+    tfr = h2o.Frame.from_numpy({"t": np.asarray([ms])})
+    dkv.put("tfr", "frame", tfr)
+    for op, want in (("year", 2021), ("month", 3), ("day", 4),
+                     ("hour", 5), ("minute", 6), ("second", 7),
+                     ("dayOfWeek", 3)):       # 2021-03-04 is a Thursday
+        exec_rapids(f'(tmp= tt (%s tfr))' % op)
+        assert dkv.get("tt", "frame").vec(0).to_numpy()[0] == want, op
+    # table + cumsum + which + na.omit + scale + round + cor
+    nfr = h2o.Frame.from_numpy({"x": np.asarray([1.0, 2.0, np.nan, 2.0])})
+    dkv.put("nfr", "frame", nfr)
+    exec_rapids('(tmp= tb (table nfr))')
+    tb = dkv.get("tb", "frame")
+    assert list(tb.vec("Count").to_numpy()) == [1.0, 2.0]
+    exec_rapids('(tmp= no (na.omit nfr))')
+    assert dkv.get("no", "frame").nrow == 3
+    exec_rapids('(tmp= cs (cumsum (na.omit nfr)))')
+    assert list(dkv.get("cs", "frame").vec(0).to_numpy()) == [1, 3, 5]
+    r = exec_rapids('(cor (cols_py (na.omit nfr) "x") (cols_py (na.omit nfr) "x"))')
+    assert abs(r["scalar"] - 1.0) < 1e-9
+
+
+def test_rapids_iso_week_and_time_na():
+    import datetime as dtm
+    import numpy as np
+    import h2o3_tpu as h2o
+    from h2o3_tpu import dkv
+    from h2o3_tpu.rapids import exec_rapids
+    # 2021-01-01 is ISO week 53 of 2020; 2021-01-04 (Mon) is week 1
+    days = [dtm.datetime(2021, 1, 1, tzinfo=dtm.timezone.utc),
+            dtm.datetime(2021, 1, 4, tzinfo=dtm.timezone.utc),
+            dtm.datetime(2021, 7, 1, tzinfo=dtm.timezone.utc)]
+    ms = np.asarray([d.timestamp() * 1e3 for d in days] + [np.nan])
+    fr = h2o.Frame.from_numpy({"t": ms})
+    dkv.put("wfr", "frame", fr)
+    exec_rapids('(tmp= wk (week wfr))')
+    got = dkv.get("wk", "frame").vec(0).to_numpy()
+    want = [d.isocalendar()[1] for d in days]
+    assert list(got[:3]) == want, (got, want)
+    assert np.isnan(got[3])
