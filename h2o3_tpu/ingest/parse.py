@@ -216,6 +216,47 @@ def _column_to_vec(tokens: List[Optional[str]], vtype: str, mesh=None) -> Vec:
     return Vec.from_numpy(codes, vtype=T_ENUM, domain=vals, mesh=mesh)
 
 
+def _native_token_columns(data: bytes, setup: ParseSetup,
+                          skip_header: bool):
+    """Native-tokenizer fast path: C++ scans the bytes once
+    (h2o3_tpu/native/fast_csv.cpp — the CsvParser hot loop), numeric
+    columns come back pre-parsed, and Python touches only the cells of
+    enum/string/time columns. Returns token-column compatible output: a list
+    with a numpy float64 array per numeric column and a list of
+    Optional[str] per other column — or None to use the Python path."""
+    from h2o3_tpu.native import parse_bytes
+    out = parse_bytes(data, setup.separator)
+    if out is None:
+        return None
+    starts, lens, vals, ok = out
+    r0 = 1 if skip_header else 0
+    ncols = vals.shape[1]
+    if ncols != len(setup.column_types):
+        return None
+    na = setup.na_strings if setup.na_strings is not None else \
+        DEFAULT_NA_STRINGS
+    cols = []
+    for j, vt in enumerate(setup.column_types):
+        if vt in (T_REAL, T_INT):
+            # pre-parsed doubles; non-numeric tokens (NA strings or
+            # strays) are already NaN — identical to _column_to_vec
+            cols.append(vals[r0:, j].copy())
+        else:
+            s = starts[r0:, j]
+            ln = lens[r0:, j]
+            o = ok[r0:, j]
+            toks: List[Optional[str]] = []
+            for i in range(len(s)):
+                if o[i] == 2:
+                    toks.append(None)
+                    continue
+                t = data[s[i]: s[i] + ln[i]].decode("utf-8",
+                                                    errors="replace")
+                toks.append(None if t in na else t)
+            cols.append(toks)
+    return cols
+
+
 _PARALLEL_PARSE_BYTES = 16 << 20   # byte-range fan-out above 16 MB
 
 

@@ -1,0 +1,108 @@
+// Native CSV tokenizer — the hot byte-scanning loop of ingest.
+//
+// Reference role: water/parser/CsvParser.java streams raw-byte chunks
+// into NewChunks inside MultiFileParseTask (ParseDataset.java:623); the
+// tokenizer is the CPU-bound inner loop of every import. Here the same
+// loop is C++ behind a C ABI (ctypes binding in h2o3_tpu/native/
+// __init__.py), emitting per-cell byte offsets plus eagerly-parsed
+// doubles; Python only touches the (rare) non-numeric cells.
+//
+// Scope: separator-delimited rows, '\n' / '\r\n' terminators, no
+// embedded quotes (the binding routes quoted files to the Python
+// fallback — RFC 4180 escapes stay in one place).
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+
+extern "C" {
+
+// First pass: count rows and columns. Returns row count (data rows,
+// including a header row if present — the caller decides), sets *ncols
+// from the first row. Returns -1 if rows have inconsistent widths
+// (caller falls back to the tolerant Python parser).
+long long csv_shape(const char* buf, long long len, char sep,
+                    long long* ncols_out) {
+    long long rows = 0, ncols = 0, cols = 1;
+    bool any = false;
+    for (long long i = 0; i < len; ++i) {
+        char c = buf[i];
+        if (c == '\n') {
+            if (any || cols > 1) {
+                if (ncols == 0) ncols = cols;
+                else if (cols != ncols) return -1;
+                ++rows;
+            }
+            cols = 1; any = false;
+        } else if (c == sep) {
+            ++cols;
+        } else if (c != '\r') {
+            any = true;
+        }
+    }
+    if (any || cols > 1) {              // last line without newline
+        if (ncols == 0) ncols = cols;
+        else if (cols != ncols) return -1;
+        ++rows;
+    }
+    *ncols_out = ncols;
+    return rows;
+}
+
+// Second pass: per-cell start offsets + lengths (whitespace-trimmed)
+// and an eager strtod parse (NaN when the cell is not fully numeric;
+// ok[i]=0 marks those cells so the caller can distinguish NA strings
+// from genuine text). Arrays are caller-allocated with rows*ncols
+// entries. Returns rows actually filled.
+long long csv_parse(const char* buf, long long len, char sep,
+                    long long rows, long long ncols,
+                    long long* starts, int* lens, double* vals,
+                    unsigned char* ok) {
+    long long r = 0, cidx = 0;
+    long long cell_start = 0;
+    bool any = false;
+    auto close_cell = [&](long long end) {
+        long long s = cell_start, e = end;
+        while (s < e && (buf[s] == ' ' || buf[s] == '\t')) ++s;
+        while (e > s && (buf[e - 1] == ' ' || buf[e - 1] == '\t'
+                         || buf[e - 1] == '\r')) --e;
+        long long idx = r * ncols + cidx;
+        if (idx >= rows * ncols) return;
+        starts[idx] = s;
+        lens[idx] = (int)(e - s);
+        if (e > s) {
+            char tmp[64];
+            long long n = e - s;
+            if (n < 63) {
+                memcpy(tmp, buf + s, n);
+                tmp[n] = 0;
+                char* endp = nullptr;
+                double v = strtod(tmp, &endp);
+                if (endp == tmp + n) { vals[idx] = v; ok[idx] = 1; }
+                else { vals[idx] = NAN; ok[idx] = 0; }
+            } else { vals[idx] = NAN; ok[idx] = 0; }
+        } else { vals[idx] = NAN; ok[idx] = 2; }   // empty cell
+    };
+    for (long long i = 0; i < len && r < rows; ++i) {
+        char c = buf[i];
+        if (c == '\n') {
+            if (any || cidx > 0) {
+                close_cell(i);
+                ++r;
+            }
+            cidx = 0; cell_start = i + 1; any = false;
+        } else if (c == sep) {
+            close_cell(i);
+            ++cidx; cell_start = i + 1;
+        } else if (c != '\r') {
+            any = true;
+        }
+    }
+    if ((any || cidx > 0) && r < rows) {
+        close_cell(len);
+        ++r;
+    }
+    return r;
+}
+
+}  // extern "C"
