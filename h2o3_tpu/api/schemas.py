@@ -171,46 +171,152 @@ def frames_v3(entries: List) -> Dict:
     }
 
 
-def _metrics_v3(m, kind_hint: str) -> Optional[Dict]:
+def twodim(name: str, col_names: List[str], data_cols: List[List],
+           col_types: Optional[List[str]] = None,
+           description: str = "") -> Dict:
+    """TwoDimTableV3 wire shape (water/api/schemas3/TwoDimTableV3) —
+    data is COLUMN-major; h2o-py H2OTwoDimTable.make consumes columns[]
+    name/type/format and raw data."""
+    if col_types is None:
+        col_types = ["double"] * len(col_names)
+    fmt = {"double": "%.5f", "float": "%.5f", "int": "%d", "long": "%d",
+           "string": "%s"}
+    return {
+        "__meta": {"schema_version": 3, "schema_name": "TwoDimTableV3",
+                   "schema_type": "TwoDimTable"},
+        "name": name, "description": description,
+        "columns": [{"__meta": {"schema_name": "ColumnSpecsBase"},
+                     "name": n, "type": t, "format": fmt.get(t, "%s"),
+                     "description": n}
+                    for n, t in zip(col_names, col_types)],
+        "rowcount": len(data_cols[0]) if data_cols else 0,
+        "data": [[_fin_or_none(v) if isinstance(v, float) else v
+                  for v in col] for col in data_cols],
+    }
+
+
+def _fin_or_none(v):
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return v
+    return f if math.isfinite(f) else None
+
+
+def _cm_table(cm: np.ndarray, domain: Optional[List[str]]) -> Dict:
+    """ConfusionMatrixV3: {table: TwoDimTable} with per-class rows,
+    Error and Rate columns (hex/ConfusionMatrix.java toTable)."""
+    cm = np.asarray(cm, dtype=np.float64)
+    k = cm.shape[0]
+    labels = ([str(d) for d in domain] if domain and len(domain) == k
+              else [str(i) for i in range(k)])
+    rows_tot = cm.sum(axis=1)
+    err = np.where(rows_tot > 0, 1.0 - np.diag(cm) / np.maximum(rows_tot, 1),
+                   0.0)
+    cols = [list(cm[:, j]) + [float(cm[:, j].sum())] for j in range(k)]
+    err_col = list(err) + [float(1.0 - np.trace(cm) / max(cm.sum(), 1))]
+    rate_col = [f"{int(rows_tot[i] - cm[i, i]):,} / {int(rows_tot[i]):,}"
+                for i in range(k)]
+    rate_col.append(f"{int(cm.sum() - np.trace(cm)):,} / {int(cm.sum()):,}")
+    table = twodim("Confusion Matrix", labels + ["Error", "Rate"],
+                   cols + [err_col, rate_col],
+                   ["long"] * k + ["double", "string"])
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "ConfusionMatrixV3",
+                       "schema_type": "ConfusionMatrix"},
+            "table": table}
+
+
+def _metrics_v3(m, kind_hint: str, domain: Optional[List[str]] = None,
+                algo: str = "", frame_key: Optional[str] = None,
+                model_key: Optional[str] = None) -> Optional[Dict]:
+    """ModelMetrics*V3 with the REFERENCE's field names (AUC, pr_auc,
+    Gini, MSE, RMSE — capitalization matters: h2o-py metrics_base.py
+    reads _metric_json['AUC'] etc.)."""
     if m is None:
         return None
     d = {"__meta": {"schema_version": 3,
                     "schema_name": "ModelMetrics%sV3" % kind_hint,
-                    "schema_type": "ModelMetrics"}}
-    for f in ("mse", "rmse", "mae", "rmsle", "r2", "logloss", "auc",
-              "aucpr", "mean_per_class_error", "mean_residual_deviance",
-              "error", "nobs"):
-        v = getattr(m, f, None)
-        if v is not None:
-            d[f] = None if (isinstance(v, float) and not math.isfinite(v)) else v
+                    "schema_type": "ModelMetrics%s" % kind_hint},
+         "model_category": kind_hint,
+         "description": None,
+         "scoring_time": int(time.time() * 1000),
+         "frame": keyref(frame_key, "Key<Frame>") if frame_key else None,
+         "model": keyref(model_key, "Key<Model>") if model_key else None}
+    td = m.to_dict() if hasattr(m, "to_dict") else {}
+    for k, v in td.items():
+        if k == "cm":
+            continue
+        if isinstance(v, float):
+            d[k] = None if not math.isfinite(v) else v
+        else:
+            d[k] = v
     cm = getattr(m, "confusion_matrix", None)
     if cm is not None:
-        d["cm"] = {"table": np.asarray(cm).tolist()}
+        d["cm"] = _cm_table(cm, domain)
+    thr = getattr(m, "thresholds_and_metric_scores", None)
+    if thr:
+        thr = dict(thr)
+        max_crit = thr.pop("max_criteria_and_metric_scores", None)
+        gl = thr.pop("gains_lift", None)
+        names = list(thr.keys())
+        d["thresholds_and_metric_scores"] = twodim(
+            "Metrics for Thresholds", names,
+            [list(np.asarray(thr[n], dtype=np.float64)) for n in names])
+        if max_crit:
+            crits = ["max " + c for c in max_crit]
+            d["max_criteria_and_metric_scores"] = twodim(
+                "Maximum Metrics", ["metric", "threshold", "value", "idx"],
+                [crits,
+                 [float(v["threshold"]) for v in max_crit.values()],
+                 [float(v["value"]) for v in max_crit.values()],
+                 [int(v["idx"]) for v in max_crit.values()]],
+                ["string", "double", "double", "long"])
+        if isinstance(gl, dict) and gl:
+            names = [n for n in gl if isinstance(gl[n], (list, np.ndarray))]
+            nr = len(gl[names[0]]) if names else 0
+            cols = [list(np.asarray(gl[n]).tolist()) for n in names]
+            for n in gl:           # scalar stats (KS) broadcast per row
+                if not isinstance(gl[n], (list, np.ndarray)):
+                    names.append(n)
+                    cols.append([_fin_or_none(gl[n])] * nr)
+            d["gains_lift_table"] = twodim("Gains/Lift Table", names, cols)
+    ht = getattr(m, "hit_ratios", None)
+    if ht is not None:
+        hr = np.asarray(ht, dtype=np.float64)
+        d["hit_ratio_table"] = twodim(
+            "Top-K Hit Ratios", ["k", "hit_ratio"],
+            [list(range(1, len(hr) + 1)), list(hr)], ["long", "double"])
     return d
 
 
 def model_v3(model, key: str) -> Dict:
     kind = ("Binomial" if model.nclasses == 2 else
             "Multinomial" if model.nclasses > 2 else "Regression")
+    dom = list(getattr(model, "response_domain", None) or []) or None
     out: Dict[str, Any] = {
         "model_category": kind,
-        "training_metrics": _metrics_v3(model.training_metrics, kind),
-        "validation_metrics": _metrics_v3(model.validation_metrics, kind),
+        "training_metrics": _metrics_v3(model.training_metrics, kind,
+                                        domain=dom, model_key=key),
+        "validation_metrics": _metrics_v3(model.validation_metrics, kind,
+                                          domain=dom, model_key=key),
         "cross_validation_metrics": _metrics_v3(
-            model.cross_validation_metrics, kind),
+            model.cross_validation_metrics, kind, domain=dom, model_key=key),
         "scoring_history": model.scoring_history,
         "run_time": int(model.run_time * 1000),
         "help": {},
     }
     vi = model.output.get("variable_importances")
     if vi:
-        out["variable_importances"] = {
-            "name": "Variable Importances",
-            "columns": [{"name": "variable"}, {"name": "relative_importance"},
-                        {"name": "scaled_importance"}, {"name": "percentage"}],
-            "data": [vi["variable"], vi["relative_importance"],
-                     vi["scaled_importance"], vi["percentage"]],
-        }
+        out["variable_importances"] = twodim(
+            "Variable Importances",
+            ["variable", "relative_importance", "scaled_importance",
+             "percentage"],
+            [list(vi["variable"]),
+             [float(v) for v in vi["relative_importance"]],
+             [float(v) for v in vi["scaled_importance"]],
+             [float(v) for v in vi["percentage"]]],
+            ["string", "double", "double", "double"])
     for k, v in model.output.items():
         if k not in out and isinstance(v, (int, float, str, bool, list, dict,
                                            type(None))):
@@ -219,9 +325,29 @@ def model_v3(model, key: str) -> Dict:
     if callable(coef_fn):
         try:
             coefs = coef_fn()
-            out["coefficients_table"] = {
-                "name": "Coefficients", "data": [list(coefs.keys()),
-                                                 list(coefs.values())]}
+            norm_fn = getattr(model, "coef_norm", None)
+            norm = norm_fn() if callable(norm_fn) else coefs
+            # GlmV3 coefficients_table shape (hex/schemas/GLMModelV3) —
+            # h2o-py coef()/coef_norm() zip tbl["names"] against
+            # tbl["coefficients"]/["standardized_coefficients"]
+            names_c = list(coefs.keys())
+            cols = [names_c, [float(v) for v in coefs.values()],
+                    [float(norm.get(k, v)) if isinstance(norm, dict)
+                     else float(v) for k, v in coefs.items()]]
+            headers = ["names", "coefficients", "standardized_coefficients"]
+            types = ["string", "double", "double"]
+            pv = model.output.get("p_values")
+            if pv:     # compute_p_values=True: GLM coef table gains cols
+                for field, label in (("std_errs", "std_error"),
+                                     ("z_values", "z_value"),
+                                     ("p_values", "p_value")):
+                    src = model.output[field]
+                    cols.append([float(src.get(n, float("nan")))
+                                 for n in names_c])
+                    headers.append(label)
+                    types.append("double")
+            out["coefficients_table"] = twodim("Coefficients", headers,
+                                               cols, types)
         except Exception:
             pass
     return {

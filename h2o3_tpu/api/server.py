@@ -145,6 +145,34 @@ def _import_files(params, body):
             "fails": [], "dels": []}
 
 
+def _bracket_list(v) -> List[str]:
+    """h2o-py stringifies list params as '[a,b,c]' WITHOUT quotes
+    (connection.py helpers) — json.loads can't touch them."""
+    if isinstance(v, list):
+        return [str(x) for x in v]
+    s = str(v or "").strip()
+    if s.startswith("[") and s.endswith("]"):
+        s = s[1:-1]
+    return [p.strip().strip('"') for p in s.split(",") if p.strip()]
+
+
+@route("POST", "/3/ImportFilesMulti")
+def _import_files_multi(params, body):
+    paths = _bracket_list(params.get("paths"))
+    dests, fails = [], []
+    for path in paths:
+        if not os.path.exists(path):
+            fails.append(path)
+            continue
+        key = "nfs://" + path.lstrip("/")
+        dkv.put(key, "rawfile", path)
+        dests.append(key)
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "ImportFilesMultiV3"},
+            "paths": paths, "files": [p for p in paths if os.path.exists(p)],
+            "destination_frames": dests, "fails": fails, "dels": []}
+
+
 @route("POST", "/3/PostFile")
 def _post_file(params, body):
     """h2o.upload_file: multipart body → temp file → raw key."""
@@ -391,26 +419,125 @@ def _train(params, body, algo):
     }
 
 
-@route("POST", "/3/Predictions/models/{model}/frames/{frame}")
-@route("POST", "/4/Predictions/models/{model}/frames/{frame}")
-def _predict(params, body, model, frame):
+def _kind_of(m) -> str:
+    return ("Binomial" if m.nclasses == 2 else
+            "Multinomial" if m.nclasses > 2 else "Regression")
+
+
+def _start_predict_job(model, frame, dest=None):
     m = dkv.get(model, "model")
     fr = dkv.get(frame, "frame")
-    dest = params.get("predictions_frame") or dkv.unique_key("prediction")
-    pred = m.predict(fr)
-    dkv.put(dest, "frame", pred)
+    dest = dest or dkv.unique_key("prediction")
+    job = Job(f"prediction {model} on {frame}")
+    job.dest_key = dest
+    job.dest_type = "Key<Frame>"
+
+    def body_fn(j):
+        pred = m.predict(fr)
+        dkv.put(dest, "frame", pred)
+        return pred
+
+    job.run(body_fn, background=True)
+    return m, fr, dest, job
+
+
+@route("POST", "/4/Predictions/models/{model}/frames/{frame}")
+def _predict_async(params, body, model, frame):
+    """Async bulk scoring: the reference returns a BARE JobV3
+    (water/api/RegisterV3Api.java:363 → ModelMetricsHandler.predictAsync
+    :467); h2o-py wraps it in H2OJob, polls, then fetches the dest frame.
+    Returning a ModelMetricsListSchemaV3 here instead breaks the client:
+    H2OResponse dispatches any schema starting with 'ModelMetrics' to a
+    metrics object and H2OJob.__init__ chokes on it."""
+    m, fr, dest, job = _start_predict_job(
+        model, frame, params.get("predictions_frame"))
+    return schemas.job_v3(job, dest, "Key<Frame>")
+
+
+@route("POST", "/3/Predictions/models/{model}/frames/{frame}")
+def _predict(params, body, model, frame):
+    """Sync scoring + metrics (hex/Model.java:1919 score → BigScore)."""
+    m, fr, dest, job = _start_predict_job(
+        model, frame, params.get("predictions_frame"))
+    job.join()
     perf = None
     try:
         mm = m.model_performance(fr)
-        perf = schemas._metrics_v3(
-            mm, "Binomial" if m.nclasses == 2 else
-            "Multinomial" if m.nclasses > 2 else "Regression")
+        perf = schemas._metrics_v3(mm, _kind_of(m),
+                                   domain=list(m.response_domain or []) or None,
+                                   frame_key=frame, model_key=model)
     except Exception:
         perf = None
     return {"__meta": {"schema_version": 3,
                        "schema_name": "ModelMetricsListSchemaV3"},
             "model_metrics": [perf] if perf else [],
+            "job": schemas.job_v3(job, dest, "Key<Frame>"),
             "predictions_frame": schemas.keyref(dest, "Key<Frame>")}
+
+
+@route("POST", "/3/ModelMetrics/models/{model}/frames/{frame}")
+def _model_metrics_score(params, body, model, frame):
+    """ModelMetricsHandler.score (water/api/ModelMetricsHandler.java:288):
+    score the frame with the model, return fresh metrics (h2o-py
+    model_performance)."""
+    m = dkv.get(model, "model")
+    fr = dkv.get(frame, "frame")
+    mm = m.model_performance(fr)
+    perf = schemas._metrics_v3(mm, _kind_of(m),
+                               domain=list(m.response_domain or []) or None,
+                               frame_key=frame, model_key=model)
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "ModelMetricsListSchemaV3"},
+            "model_metrics": [perf] if perf else []}
+
+
+@route("GET", "/3/ModelMetrics/models/{model}")
+def _model_metrics_list(params, body, model):
+    m = dkv.get(model, "model")
+    out = []
+    for mm in (m.training_metrics, m.validation_metrics,
+               m.cross_validation_metrics):
+        if mm is not None:
+            out.append(schemas._metrics_v3(
+                mm, _kind_of(m),
+                domain=list(m.response_domain or []) or None,
+                model_key=model))
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "ModelMetricsListSchemaV3"},
+            "model_metrics": out}
+
+
+@route("GET", "/99/Models.bin/{model}")
+def _save_model_bin(params, body, model):
+    """h2o.save_model → GET /99/Models.bin/{id}?dir=...&force=...
+    (water/api/ModelsHandler importModel/exportModel pair)."""
+    from h2o3_tpu.persist import save_model
+    m = dkv.get(model, "model")
+    path = params.get("dir") or model
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    if os.path.exists(path) and str(params.get("force", "")
+                                    ).lower() != "true":
+        raise ApiError(409, f"{path} exists; use force=True")
+    out = save_model(m, path=os.path.dirname(path) or ".",
+                     force=True, filename=os.path.basename(path))
+    return {"__meta": {"schema_version": 3, "schema_name": "ModelExportV3"},
+            "dir": out}
+
+
+@route("POST", "/99/Models.bin/{model}")
+@route("POST", "/99/Models.bin/")
+def _load_model_bin(params, body, model=""):
+    from h2o3_tpu.persist import load_model
+    path = params.get("dir")
+    if not path or not os.path.exists(path):
+        raise ApiError(404, f"model artifact not found: {path}")
+    m = load_model(path)
+    key = m.key or dkv.unique_key("model")
+    dkv.put(key, "model", m)
+    return {"__meta": {"schema_version": 3, "schema_name": "ModelsV3"},
+            "models": [{"model_id": schemas.keyref(key, "Key<Model>")}]}
 
 
 @route("POST", "/3/LogAndEcho")
@@ -418,10 +545,55 @@ def _log_echo(params, body):
     return {"message": params.get("message", "")}
 
 
+@route("GET", "/3/DownloadDataset")
+@route("GET", "/3/DownloadDataset.bin")
+def _download_dataset(params, body):
+    """Frame → CSV stream (water/api/DownloadDataHandler); h2o-py
+    as_data_frame/get_frame_data parse this client-side."""
+    from h2o3_tpu.persist import export_file
+    key = params.get("frame_id")
+    if isinstance(key, dict):
+        key = key.get("name")
+    fr = dkv.get(str(key), "frame")
+    tmp = os.path.join(tempfile.gettempdir(),
+                       f"h2o_dl_{uuid.uuid4().hex[:8]}.csv")
+    try:
+        export_file(fr, tmp, force=True)
+        with open(tmp, "rb") as f:
+            data = f.read()
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return {"__raw": data, "__content_type": "text/csv"}
+
+
 @route("GET", "/3/Metadata/endpoints")
 def _endpoints(params, body):
     return {"routes": [{"http_method": m, "url_pattern": rx.pattern}
                        for m, rx, _ in _ROUTES]}
+
+
+_ERROR_FIELDS = ["timestamp", "error_url", "msg", "dev_msg", "http_status",
+                 "values", "exception_type", "exception_msg", "stacktrace"]
+
+
+@route("GET", "/3/Metadata/schemas/{name}")
+def _schema_meta(params, body, name):
+    """Schema metadata (water/api/MetadataHandler fetchSchemaMetadata) —
+    h2o-py defines H2OCluster/H2OErrorV3 properties from the field list
+    at connect time (h2o-py/h2o/schemas/schema.py:29)."""
+    if name == "CloudV3":
+        keys = [k for k in schemas.cloud_v3() if k != "__meta"]
+    elif name == "H2OErrorV3":
+        keys = list(_ERROR_FIELDS)
+    elif name == "H2OModelBuilderErrorV3":
+        keys = _ERROR_FIELDS + ["parameters", "messages", "error_count"]
+    else:
+        keys = []
+    fields = [{"name": k, "help": k, "type": "string", "is_schema": False,
+               "schema_name": None} for k in keys]
+    return {"__meta": {"schema_version": 3, "schema_name": "MetadataV3"},
+            "schemas": [{"name": name, "fields": fields}], "routes": []}
 
 
 @route("POST", "/99/Rapids")
@@ -475,6 +647,11 @@ class _Handler(BaseHTTPRequestHandler):
                     groups = {k: urllib.parse.unquote(v)
                               for k, v in match.groupdict().items()}
                     out = fn(params, body, **groups)
+                    if isinstance(out, dict) and "__raw" in out:
+                        self._reply_raw(200, out["__raw"],
+                                        out.get("__content_type",
+                                                "application/octet-stream"))
+                        return
                     status = out.pop("__http_status", 200) if isinstance(
                         out, dict) else 200
                     self._reply(status, out)
@@ -499,6 +676,14 @@ class _Handler(BaseHTTPRequestHandler):
                           "msg": f"no route for {method} {path}",
                           "exception_type": "NotFound", "values": {},
                           "stacktrace": []})
+
+    def _reply_raw(self, status, data: bytes, ctype: str):
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        if self.command != "HEAD":
+            self.wfile.write(data)
 
     def _reply(self, status, obj):
         data = json.dumps(obj, default=_json_default).encode()

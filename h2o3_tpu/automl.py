@@ -1,63 +1,210 @@
 """AutoML — budgeted model-and-ensemble search over the builder zoo.
 
 Reference: h2o-automl/src/main/java/ai/h2o/automl/AutoML.java:49 (driver
-loop, work planning :420, execution plan :403), ModelingStepsRegistry /
-ModelingStep (the pluggable step SPI), the default plan in
-modeling/{XGBoost,GBM,GLM,DRF,DeepLearning,StackedEnsemble}StepsProvider
-(XGB defaults + grids, GBM defaults + grids, DRF + XRT, GLM, DL grids,
-two stacked ensembles: best-of-family and all), leaderboard ranked by CV
-metric, events/EventLog.java (audit trail).
+loop, work planning :420, execution plan :403, exploitation ratio
+:346,457), ModelingStepsRegistry.java / ModelingStep.java /
+StepDefinition.java (the pluggable step SPI), the default plan in
+modeling/{XGBoost,GBM,GLM,DRF,DeepLearning,StackedEnsemble}StepsProvider,
+hex/leaderboard/Leaderboard.java (single-metric-source ranked table with
+extension columns), preprocessing/TargetEncoding.java (optional TE step),
+events/EventLog.java (audit trail).
 
 TPU re-design: pure orchestration over the existing estimators — each
 step trains with nfolds CV (holdouts kept for the ensembles) on the
 chip; budgets (max_models / max_runtime_secs) gate between steps exactly
-like WorkAllocations. The step plan mirrors the reference's default
-sequence at reduced grid sizes (each model saturates the chip, so fewer,
-better-budgeted points beat the reference's thread-parallel sprawl)."""
+like WorkAllocations. The plan is DATA (StepDefinition dicts from
+registered providers), not code: callers can pass ``modeling_plan`` or
+register new providers via ``register_modeling_steps`` — the
+ModelingStepsRegistry SPI."""
 from __future__ import annotations
 
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from h2o3_tpu import dkv
 from h2o3_tpu.log import info
 
-from h2o3_tpu.models.grid import _LESS_IS_BETTER, sort_models
+from h2o3_tpu.models.grid import _LESS_IS_BETTER
 
 
-def _default_steps(nclasses: int) -> List[Dict]:
-    """The reference's default execution plan (StepDefinition defaults),
-    sized for sequential single-chip execution."""
-    clf = nclasses > 1
-    steps: List[Dict] = [
+# ---------------- step provider registry (ModelingStepsRegistry SPI) ----
+
+# provider name -> fn(ctx) -> list of StepDefinition dicts
+# ctx carries nclasses / nfolds / seed so providers can adapt the family
+_STEP_PROVIDERS: Dict[str, Callable[[Dict], List[Dict]]] = {}
+
+
+def register_modeling_steps(name: str, fn: Callable[[Dict], List[Dict]]):
+    """Register a step provider (ai/h2o/automl/ModelingStepsRegistry
+    service loading; StepDefinition alias semantics). ``fn(ctx)`` returns
+    StepDefinition dicts: {"algo", "id", "params"} or {"algo", "id",
+    "grid", "params"}."""
+    _STEP_PROVIDERS[name.lower()] = fn
+    return fn
+
+
+def _xgboost_steps(ctx):
+    return [
         {"algo": "xgboost", "id": "XGBoost_def_1",
          "params": {"ntrees": 50, "max_depth": 8, "eta": 0.3,
                     "subsample": 0.8, "colsample_bytree": 0.8}},
+    ]
+
+
+def _gbm_steps(ctx):
+    return [
         {"algo": "gbm", "id": "GBM_def_1",
          "params": {"ntrees": 50, "max_depth": 6, "learn_rate": 0.1,
                     "sample_rate": 0.8, "col_sample_rate": 0.8}},
         {"algo": "gbm", "id": "GBM_def_2",
          "params": {"ntrees": 50, "max_depth": 3, "learn_rate": 0.1}},
-        {"algo": "drf", "id": "DRF_def_1",
-         "params": {"ntrees": 50, "max_depth": 10}},
-        {"algo": "glm", "id": "GLM_def_1",
-         "params": ({"family": "binomial"} if nclasses == 2 else {})
-         | {"alpha": 0.5, "lambda_search": True, "nlambdas": 10}},
-        {"algo": "drf", "id": "XRT_def_1",           # extremely-random analog
-         "params": {"ntrees": 50, "max_depth": 10, "mtries": 1}},
-        {"algo": "deeplearning", "id": "DL_def_1",
-         "params": {"hidden": [64, 64], "epochs": 15}},
+    ]
+
+
+def _gbm_grid_steps(ctx):
+    return [
         {"algo": "gbm", "id": "GBM_grid_1",
          "grid": {"max_depth": [4, 8], "learn_rate": [0.05, 0.2]},
          "params": {"ntrees": 40}},
     ]
-    if nclasses > 2:
-        # GLM/SE multinomial pending — drop them from the plan
-        steps = [s for s in steps if s["algo"] != "glm"]
-    return steps
 
+
+def _drf_steps(ctx):
+    return [
+        {"algo": "drf", "id": "DRF_def_1",
+         "params": {"ntrees": 50, "max_depth": 10}},
+        {"algo": "drf", "id": "XRT_def_1",      # extremely-random analog
+         "params": {"ntrees": 50, "max_depth": 10, "mtries": 1}},
+    ]
+
+
+def _glm_steps(ctx):
+    fam = ("binomial" if ctx["nclasses"] == 2 else
+           "multinomial" if ctx["nclasses"] > 2 else "gaussian")
+    params = {"family": fam, "alpha": 0.5, "lambda_search": True,
+              "nlambdas": 10}
+    if ctx["nclasses"] > 2:
+        # multinomial lambda path is one fit per lambda; keep it tight
+        params = {"family": fam, "alpha": 0.0, "Lambda": 1e-4}
+    return [{"algo": "glm", "id": "GLM_def_1", "params": params}]
+
+
+def _deeplearning_steps(ctx):
+    return [
+        {"algo": "deeplearning", "id": "DL_def_1",
+         "params": {"hidden": [64, 64], "epochs": 15}},
+    ]
+
+
+register_modeling_steps("xgboost", _xgboost_steps)
+register_modeling_steps("gbm", _gbm_steps)
+register_modeling_steps("gbm_grids", _gbm_grid_steps)
+register_modeling_steps("drf", _drf_steps)
+register_modeling_steps("glm", _glm_steps)
+register_modeling_steps("deeplearning", _deeplearning_steps)
+
+# the default execution plan IS data (StepDefinition list — the reference
+# default: XGB defaults, GBM defaults, DRF/XRT, GLM, DL, grids, SEs)
+DEFAULT_MODELING_PLAN: List[str] = [
+    "xgboost", "gbm", "drf", "glm", "deeplearning", "gbm_grids",
+]
+
+
+# ---------------- leaderboard (hex/leaderboard/Leaderboard.java) --------
+
+class Leaderboard:
+    """Metric-ranked model table with extension columns.
+
+    Ranking uses ONE metric source for every row — cross-validation
+    metrics when every model has them, else the leaderboard frame, else
+    validation, else training — never a mix (Leaderboard.java sort-metric
+    consistency: models scored on different data must not be compared)."""
+
+    EXTENSIONS = ("training_time_ms", "algo")
+
+    def __init__(self, models: Sequence, metric: str,
+                 leaderboard_frame=None):
+        self.metric = metric
+        self.source = None
+        self.rows: List[Dict] = []
+        self._models = list(models)
+        self._frame = leaderboard_frame
+        self._build()
+
+    def _metrics_obj(self, m, source: str):
+        if source == "xval":
+            return m.cross_validation_metrics
+        if source == "leaderboard":
+            return m.model_performance(self._frame)
+        if source == "valid":
+            return m.validation_metrics
+        return m.training_metrics
+
+    def _pick_source(self) -> str:
+        if self._frame is not None:
+            return "leaderboard"
+        if all(m.cross_validation_metrics is not None
+               for m in self._models):
+            return "xval"
+        if all(m.validation_metrics is not None for m in self._models):
+            return "valid"
+        return "train"
+
+    def _build(self):
+        if not self._models:
+            return
+        self.source = self._pick_source()
+        vals = []
+        for m in self._models:
+            mm = self._metrics_obj(m, self.source)
+            v = getattr(mm, self.metric, None)
+            if v is None and self.metric == "mean_residual_deviance":
+                v = getattr(mm, "mse", None)
+            vals.append(float("nan") if v is None else float(v))
+        order = np.argsort([v if self.metric in _LESS_IS_BETTER else -v
+                            for v in vals], kind="stable")
+        self._models = [self._models[i] for i in order]
+        for m, v in zip(self._models, [vals[i] for i in order]):
+            row = {"model_id": m.key, self.metric: v,
+                   "algo": m.output.get("automl_family", m.algo),
+                   "training_time_ms": int(m.run_time * 1000),
+                   "metric_source": self.source}
+            self.rows.append(row)
+
+    @property
+    def models(self) -> List:
+        return self._models
+
+    # sequence-of-row-dicts surface (legacy callers iterate/index)
+    def __iter__(self):
+        return iter(self.rows)
+
+    def __len__(self):
+        return len(self.rows)
+
+    def __getitem__(self, i):
+        return self.rows[i]
+
+    def to_frame(self):
+        from h2o3_tpu.frame.frame import Frame
+        from h2o3_tpu.frame.vec import T_STR, Vec
+        if not self.rows:
+            return Frame([], [])
+        return Frame(
+            ["model_id", self.metric, "algo", "training_time_ms"],
+            [Vec.from_numpy(np.asarray([r["model_id"] for r in self.rows],
+                                       dtype=object), vtype=T_STR),
+             Vec.from_numpy(np.asarray([r[self.metric] for r in self.rows],
+                                       dtype=np.float64)),
+             Vec.from_numpy(np.asarray([r["algo"] for r in self.rows],
+                                       dtype=object), vtype=T_STR),
+             Vec.from_numpy(np.asarray([r["training_time_ms"]
+                                        for r in self.rows]))])
+
+
+# ---------------- driver ------------------------------------------------
 
 class H2OAutoML:
     """h2o-py H2OAutoML surface: train(...) then .leaderboard / .leader."""
@@ -69,7 +216,11 @@ class H2OAutoML:
                  sort_metric: Optional[str] = None,
                  include_algos: Optional[Sequence[str]] = None,
                  exclude_algos: Optional[Sequence[str]] = None,
-                 project_name: Optional[str] = None, **_ignored):
+                 project_name: Optional[str] = None,
+                 modeling_plan: Optional[Sequence] = None,
+                 exploitation_ratio: float = -1.0,
+                 preprocessing: Optional[Sequence[str]] = None,
+                 **_ignored):
         if not max_models and not max_runtime_secs:
             max_runtime_secs = 3600.0
         self.max_models = max_models
@@ -83,9 +234,14 @@ class H2OAutoML:
         self.exclude_algos = ([a.lower() for a in exclude_algos]
                               if exclude_algos else None)
         self.project_name = project_name or dkv.unique_key("automl")
+        self.modeling_plan = list(modeling_plan or DEFAULT_MODELING_PLAN)
+        self.exploitation_ratio = float(exploitation_ratio)
+        self.preprocessing = [str(s).lower() for s in (preprocessing or [])]
         self.models: List = []
         self.event_log: List[Dict] = []
-        self._leader = None
+        self._leaderboard: Optional[Leaderboard] = None
+        self._leaderboard_frame = None
+        self._te_model = None
 
     # -- events (ai/h2o/automl/events/EventLog.java) --------------------
 
@@ -109,29 +265,95 @@ class H2OAutoML:
             return False
         return True
 
+    # -- preprocessing (ai/h2o/automl/preprocessing/TargetEncoding.java) -
+
+    def _apply_target_encoding(self, x, y, training_frame):
+        """Optional TE step: encode high-cardinality categoricals with
+        KFold strategy; returns (x', frame') with encoded columns swapped
+        in for tree/linear steps (TargetEncoding.java encodeAllColumns)."""
+        from h2o3_tpu.models.targetencoder import H2OTargetEncoderEstimator
+        names = x or [n for n in training_frame.names if n != y]
+        cats = [n for n in names
+                if training_frame.vec(n).type == "enum"
+                and training_frame.vec(n).cardinality > 10]
+        if not cats:
+            return x, training_frame
+        # leave-one-out leakage handling: needs no fold column and keeps
+        # each row's own target out of its encoding (TargetEncoding.java
+        # uses the AutoML fold column with kfold; LOO is the fold-free
+        # equivalent)
+        te = H2OTargetEncoderEstimator(
+            data_leakage_handling="leave_one_out", seed=self.seed)
+        te.train(x=cats, y=y, training_frame=training_frame)
+        enc = te.model.transform(training_frame, as_training=True)
+        self._te_model = te.model
+        new_x = [n for n in names if n not in cats] + \
+            [f"{c}_te" for c in cats if f"{c}_te" in enc.names]
+        self._log("preprocessing",
+                  f"target-encoded {len(cats)} high-cardinality columns")
+        return new_x, enc
+
     # -- driver (AutoML.java:403-457 plan execution) --------------------
 
-    def train(self, x=None, y=None, training_frame=None,
-              validation_frame=None, leaderboard_frame=None):
+    def _builders(self):
         from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
         from h2o3_tpu.models.drf import H2ORandomForestEstimator
         from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
         from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
-        from h2o3_tpu.models.grid import H2OGridSearch
         from h2o3_tpu.models.xgboost import H2OXGBoostEstimator
-        builders = {"xgboost": H2OXGBoostEstimator,
-                    "gbm": H2OGradientBoostingEstimator,
-                    "drf": H2ORandomForestEstimator,
-                    "glm": H2OGeneralizedLinearEstimator,
-                    "deeplearning": H2ODeepLearningEstimator}
+        return {"xgboost": H2OXGBoostEstimator,
+                "gbm": H2OGradientBoostingEstimator,
+                "drf": H2ORandomForestEstimator,
+                "glm": H2OGeneralizedLinearEstimator,
+                "deeplearning": H2ODeepLearningEstimator}
+
+    def _plan_steps(self, ctx) -> List[Dict]:
+        """Resolve the modeling plan (names or inline StepDefinitions)
+        through the provider registry — StepDefinition/alias semantics."""
+        steps: List[Dict] = []
+        for entry in self.modeling_plan:
+            if isinstance(entry, dict) and "algo" in entry:
+                steps.append(entry)          # inline StepDefinition
+                continue
+            name = str(entry).lower()
+            provider = _STEP_PROVIDERS.get(name)
+            if provider is None:
+                self._log("plan", f"unknown step provider '{name}' skipped")
+                continue
+            steps.extend(provider(ctx))
+        return steps
+
+    def train(self, x=None, y=None, training_frame=None,
+              validation_frame=None, leaderboard_frame=None):
+        builders = self._builders()
         rvec = training_frame.vec(y)
         nclasses = rvec.cardinality if rvec.type == "enum" else 1
         t0 = time.time()
+        self._leaderboard_frame = leaderboard_frame
         self._log("init", f"AutoML build started: y={y}, "
                           f"nfolds={self.nfolds}")
-        for step in _default_steps(nclasses):
+        if "target_encoding" in self.preprocessing:
+            try:
+                x, training_frame = self._apply_target_encoding(
+                    x, y, training_frame)
+            except Exception as e:  # noqa: BLE001
+                self._log("skip", f"target encoding failed: {e}")
+        ctx = {"nclasses": nclasses, "nfolds": self.nfolds,
+               "seed": self.seed}
+        # exploitation budget carve-out (AutoML.java:346,457): a slice of
+        # the time budget reserved for fine-tuning the exploration leader
+        exploit_secs = 0.0
+        explore_deadline = None
+        if self.exploitation_ratio > 0 and self.max_runtime_secs:
+            exploit_secs = self.exploitation_ratio * self.max_runtime_secs
+            explore_deadline = t0 + self.max_runtime_secs - exploit_secs
+        for step in self._plan_steps(ctx):
             if not self._budget_left(t0):
                 self._log("budget", "model/time budget exhausted")
+                break
+            if explore_deadline and time.time() > explore_deadline:
+                self._log("budget", "exploration budget exhausted "
+                                    "(exploitation reserve)")
                 break
             algo = step["algo"]
             if not self._algo_allowed(algo):
@@ -141,6 +363,7 @@ class H2OAutoML:
             params["nfolds"] = self.nfolds
             try:
                 if "grid" in step:
+                    from h2o3_tpu.models.grid import H2OGridSearch
                     grid = H2OGridSearch(
                         builders[algo](**params), step["grid"],
                         search_criteria={
@@ -165,13 +388,42 @@ class H2OAutoML:
                 self._log("model", f"built {step['id']}")
             except Exception as e:  # noqa: BLE001 — plan keeps going
                 self._log("skip", f"{step['id']} failed: {e}")
-        # stacked ensembles (best-of-family + all), binomial/regression
-        if nclasses <= 2 and len(self.models) >= 2:
+        if self.exploitation_ratio > 0 and self.models:
+            self._exploitation(x, y, training_frame, validation_frame, t0)
+        # stacked ensembles (best-of-family + all)
+        if nclasses >= 1 and len(self.models) >= 2:
             self._build_ensembles(x, y, training_frame)
-        self._rank()
+        self._rank(final=True)
         self._log("done", f"AutoML build done: {len(self.models)} models, "
                           f"leader={self.leader.key if self.leader else None}")
         return self
+
+    def _exploitation(self, x, y, training_frame, validation_frame, t0):
+        """Exploitation phase (AutoML.java exploitation steps): retrain
+        the best tree model with more trees + a finer learning rate on
+        the remaining budget."""
+        self._rank()
+        leader = next((m for m in self.models
+                       if m.output.get("automl_family") in
+                       ("gbm", "xgboost", "drf", "xrt")), None)
+        if leader is None or not self._budget_left(t0):
+            return
+        params = {k: v for k, v in leader.params.items()
+                  if k in ("max_depth", "sample_rate", "col_sample_rate",
+                           "min_rows", "nbins")}
+        params.update({"ntrees": int(leader.params.get("ntrees", 50) * 2),
+                       "learn_rate":
+                           float(leader.params.get("learn_rate", 0.1)) / 2,
+                       "seed": self.seed, "nfolds": self.nfolds})
+        try:
+            from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+            est = H2OGradientBoostingEstimator(**params)
+            model = self._train_budgeted(est, x, y, training_frame,
+                                         validation_frame)
+            self._register(model, "GBM_lr_annealing")
+            self._log("exploitation", "built GBM_lr_annealing from leader")
+        except Exception as e:  # noqa: BLE001
+            self._log("skip", f"exploitation failed: {e}")
 
     def _train_budgeted(self, est, x, y, training_frame, validation_frame):
         """Train one step, cancelling at max_runtime_secs_per_model (the
@@ -242,29 +494,30 @@ class H2OAutoML:
             return "logloss"
         return "mean_residual_deviance"
 
-    def _metric_of(self, model, name):
-        from h2o3_tpu.models.grid import _metric_of
-        return _metric_of(model, name)
-
-    def _rank(self):
-        if not self.models:
-            return
-        metric = self._metric_name()
-        sort_models(self.models, metric, metric not in _LESS_IS_BETTER)
-        self._leader = self.models[0] if self.models else None
+    def _rank(self, final: bool = False):
+        """Intermediate ranks (exploitation / ensemble ordering) use the
+        cheap CV/valid/train source; only the FINAL rank scores the
+        leaderboard frame — scoring every model on it once, not once per
+        _rank call."""
+        self._leaderboard = Leaderboard(
+            self.models, self._metric_name(),
+            self._leaderboard_frame if final else None)
+        self.models = self._leaderboard.models
 
     @property
     def leader(self):
-        return self._leader
+        return self.models[0] if self.models else None
 
     @property
-    def leaderboard(self) -> List[Dict]:
-        metric = self._metric_name()
-        return [{"model_id": m.key, metric: self._metric_of(m, metric)}
-                for m in self.models]
+    def leaderboard(self) -> Leaderboard:
+        if self._leaderboard is None:
+            self._rank(final=True)
+        return self._leaderboard
 
     def predict(self, frame):
         if self.leader is None:
             raise RuntimeError("AutoML built no models (all steps failed "
                                "or were excluded) — see .event_log")
+        if self._te_model is not None:
+            frame = self._te_model.transform(frame)
         return self.leader.predict(frame)

@@ -168,10 +168,12 @@ class H2OStackedEnsembleEstimator(ModelBuilder):
         if algo in ("auto", "glm"):
             from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
             mp.setdefault("family",
-                          "binomial" if spec.nclasses == 2 else "gaussian")
+                          "binomial" if spec.nclasses == 2 else
+                          "multinomial" if spec.nclasses > 2 else "gaussian")
             mp.setdefault("alpha", 0.0)
             mp.setdefault("Lambda", 1e-5)
-            mp.setdefault("non_negative", True)   # reference AUTO metalearner
+            if spec.nclasses <= 2:
+                mp.setdefault("non_negative", True)  # reference AUTO metalearner
             meta_est = H2OGeneralizedLinearEstimator(**mp)
         elif algo == "gbm":
             from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
@@ -184,10 +186,6 @@ class H2OStackedEnsembleEstimator(ModelBuilder):
             meta_est = H2ODeepLearningEstimator(**mp)
         else:
             raise ValueError(f"unsupported metalearner '{algo}'")
-        if spec.nclasses > 2:
-            raise NotImplementedError(
-                "multinomial StackedEnsemble needs a multinomial "
-                "metalearner (GLM multinomial pending)")
         meta_est.train(x=znames, y="__response", training_frame=l1fr)
         if meta_est.job.status == "FAILED":
             raise RuntimeError(meta_est.job.exception)

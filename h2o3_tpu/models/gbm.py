@@ -47,6 +47,10 @@ GBM_DEFAULTS: Dict = dict(
     # adaptive kernel; quantiles_global = global-sketch binned codes
     # (XGBoost tree_method=hist semantics)
     max_abs_leafnode_pred=1e30, histogram_type="uniform_adaptive",
+    # monotone_constraints: {col: +1/-1} (hex/tree/DTree Constraints);
+    # interaction_constraints: [[col,...],...] feature groups allowed to
+    # interact on a branch (GlobalInteractionConstraints)
+    monotone_constraints=None, interaction_constraints=None,
     # TPU-specific: which histogram kernel ('auto' = matmul on TPU,
     # scatter on CPU); see ops/histogram.py
     hist_kernel="auto",
@@ -142,10 +146,11 @@ class GBMModel(Model):
 
 
 def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
-                    lr0, hdelta, root_lo, root_hi, nb_f, start_idx, *, cfg, K,
+                    lr0, hdelta, root_lo, root_hi, nb_f, mono, sets,
+                    start_idx, *, cfg, K,
                     dist_name, tweedie_power, quantile_alpha, sample_rate,
                     col_rate, na_bin, chunk, anneal, has_valid, has_t,
-                    adaptive, axis_name):
+                    adaptive, has_mono, has_sets, axis_name):
     """One chunk of the boosting loop, per data shard (runs under
     shard_map). ``chunk`` trees are built inside ONE program via lax.scan:
     per-call dispatch overhead amortises and margins/trees stay on device
@@ -164,13 +169,17 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
     F = codes_rm.shape[1]
     shard = jax.lax.axis_index(axis_name) if axis_name else 0
 
-    def build(gv, hv, wt, col_mask):
+    mono_a = mono if has_mono else None
+    sets_a = sets if has_sets else None
+
+    def build(gv, hv, wt, col_mask, key=None):
         if adaptive:
             return grow_tree_adaptive(codes_rm, gv, hv, wt, cfg, col_mask,
                                       root_lo, root_hi, axis_name=axis_name,
-                                      nb_f=nb_f)
+                                      nb_f=nb_f, mono=mono_a, sets=sets_a,
+                                      key=key)
         return grow_tree(codes, gv, hv, wt, cfg, col_mask,
-                         axis_name=axis_name)
+                         axis_name=axis_name, mono=mono_a, sets=sets_a)
 
     def valid_contrib(tree):
         if adaptive:
@@ -199,7 +208,7 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
             dist = get_distribution(dist_name, tweedie_power, quantile_alpha,
                                     hdelta)
             g, h = dist.grad_hess(margin, y)
-            tree, nid = build(g * wt, h * wt, wt, col_mask)
+            tree, nid = build(g * wt, h * wt, wt, col_mask, key=key)
             # the grower already routed every row to its leaf — reuse
             # nid instead of re-walking the tree (saves ~250ms/tree@1M)
             margin = margin + lr * tree["value"][nid]
@@ -212,7 +221,7 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
                 yk = (y == k).astype(jnp.float32)
                 gk = (p[:, k] - yk)
                 hk = jnp.maximum(p[:, k] * (1.0 - p[:, k]), 1e-9)
-                tree, nid = build(gk * wt, hk * wt, wt, col_mask)
+                tree, nid = build(gk * wt, hk * wt, wt, col_mask, key=key)
                 margin = margin.at[:, k].add(lr * tree["value"][nid])
                 if has_valid:
                     vmargin = vmargin.at[:, k].add(lr * valid_contrib(tree))
@@ -229,7 +238,7 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
 @lru_cache(maxsize=128)
 def _compiled_chunk(mesh, cfg, K, dist_name, tweedie_power, quantile_alpha,
                     sample_rate, col_rate, na_bin, chunk, anneal, has_valid,
-                    has_t, adaptive):
+                    has_t, adaptive, has_mono=False, has_sets=False):
     """Build + cache the sharded jitted chunk step for a given mesh/config.
 
     Rows ride the mesh 'data' axis; tree arrays come back replicated (every
@@ -240,12 +249,14 @@ def _compiled_chunk(mesh, cfg, K, dist_name, tweedie_power, quantile_alpha,
                    sample_rate=sample_rate,
                    col_rate=col_rate, na_bin=na_bin, chunk=chunk,
                    anneal=anneal, has_valid=has_valid, has_t=has_t,
-                   adaptive=adaptive, axis_name=DATA_AXIS)
+                   adaptive=adaptive, has_mono=has_mono, has_sets=has_sets,
+                   axis_name=DATA_AXIS)
     in_specs = (P(DATA_AXIS),                              # codes_rm / raw X
                 P(None, DATA_AXIS) if has_t else P(DATA_AXIS),  # codes_t/dummy
                 P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),  # margin, y, w
                 P(DATA_AXIS), P(DATA_AXIS),                # vrm, vmargin
-                P(), P(), P(), P(), P(), P(), P())  # key, lr0, hdelta, root_lo/hi, nb_f, start
+                P(), P(), P(), P(), P(), P(),       # key, lr0, hdelta, lo/hi, nb_f
+                P(), P(), P())                      # mono, sets, start
     out_specs = (P(DATA_AXIS), P(DATA_AXIS), P())
     f = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, check_vma=False)
@@ -284,7 +295,7 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         # adaptive kernel on raw features; the global-sketch path handles
         # quantiles_global and nbins beyond the adaptive kernel's 254 cap
         adaptive = (hist_type in ("uniform_adaptive", "uniform", "auto",
-                                  "round_robin")
+                                  "round_robin", "random")
                     and adaptive_feasible(spec, p, int(p["max_depth"])))
         if adaptive:
             bm = None
@@ -407,6 +418,39 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         has_t = (not adaptive) and bm.codes.t is not None
         codes_t_arg = bm.codes.t if has_t else Xtr  # ignored dummy otherwise
         na_bin = 0 if adaptive else bm.na_bin
+        # monotone constraints ({col: ±1}, hex/tree/DTree Constraints) and
+        # interaction constraints ([[col,...],...], per-branch feature
+        # allowance) ride as traced arrays through the chunk step
+        mc = p.get("monotone_constraints") or {}
+        has_mono = bool(mc)
+        mono_arr = jnp.zeros(cfg.n_features, jnp.int32)
+        if has_mono:
+            mono_host = np.zeros(cfg.n_features, np.int32)
+            for cname, direction in dict(mc).items():
+                if cname not in spec.names:
+                    raise ValueError(
+                        f"monotone_constraints column '{cname}' is not a "
+                        f"training feature {list(spec.names)}")
+                if spec.is_cat[spec.names.index(cname)]:
+                    raise ValueError(
+                        f"monotone constraint on categorical column "
+                        f"'{cname}' is not supported (reference restricts "
+                        f"constraints to numeric columns)")
+                mono_host[spec.names.index(cname)] = int(direction)
+            mono_arr = jnp.asarray(mono_host)
+        ic = p.get("interaction_constraints") or None
+        has_sets = bool(ic)
+        sets_arr = jnp.ones((1, cfg.n_features), bool)
+        if has_sets:
+            sets_host = np.zeros((len(ic), cfg.n_features), bool)
+            for si, group in enumerate(ic):
+                for cname in group:
+                    if cname not in spec.names:
+                        raise ValueError(
+                            f"interaction_constraints column '{cname}' is "
+                            f"not a training feature")
+                    sets_host[si, spec.names.index(cname)] = True
+            sets_arr = jnp.asarray(sets_host)
         all_trees = []
         built = 0
         jax.block_until_ready(margin)
@@ -418,11 +462,12 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                                    float(p.get("quantile_alpha", 0.5)),
                                    float(p["sample_rate"]), col_rate,
                                    na_bin, c, anneal, has_valid, has_t,
-                                   adaptive)
+                                   adaptive, has_mono, has_sets)
             margin, vmargin, chunk_trees = step(
                 Xtr, codes_t_arg, margin, yf, w, vtrain, vmargin,
                 key, jnp.float32(lr), jnp.float32(huber_delta),
-                root_lo, root_hi, nb_f, jnp.int32(start_trees + built))
+                root_lo, root_hi, nb_f, mono_arr, sets_arr,
+                jnp.int32(start_trees + built))
             all_trees.append(chunk_trees)  # stays on device until finalize
             built += c
             lr *= anneal ** c

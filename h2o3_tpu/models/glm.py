@@ -183,6 +183,99 @@ def _cd_elastic_net(G, b, beta0, lam_l1, lam_l2, pen_mask, n_sweeps: int,
     return beta
 
 
+def _lbfgs_minimize(vg_fn, beta0, max_iter: int = 200, tol: float = 1e-7,
+                    m: int = 10):
+    """Jitted L-BFGS (two-loop recursion + Armijo backtracking), the
+    hex/optimization/L_BFGS.java analog. ``vg_fn`` returns (f, grad);
+    everything runs in one lax.while_loop on device — history ring
+    buffers are fixed [m, P] arrays so shapes stay static.
+
+    Reference: hex/optimization/L_BFGS.java (solve at :116, ginfo history
+    :250); the reference evaluates gradients with a distributed MRTask —
+    here the gradient is a GSPMD-sharded matvec, so the same code path
+    scales over the ('data','model') mesh for wide designs."""
+    P = beta0.shape[0]
+
+    def two_loop(g, S, Y, rho, k):
+        q = g
+        alphas = jnp.zeros(m, jnp.float32)
+
+        def bl1(i, qa):
+            q, al = qa
+            idx = (k - 1 - i) % m
+            valid = (i < jnp.minimum(k, m)).astype(jnp.float32)
+            a = valid * rho[idx] * (S[idx] @ q)
+            return q - a * Y[idx], al.at[i].set(a)
+
+        q, alphas = jax.lax.fori_loop(0, m, bl1, (q, alphas))
+        il = (k - 1) % m
+        sy = S[il] @ Y[il]
+        yy = Y[il] @ Y[il]
+        gamma = jnp.where(k > 0, sy / jnp.maximum(yy, 1e-20), 1.0)
+        r = jnp.maximum(gamma, 1e-8) * q
+
+        def bl2(i, r):
+            j = m - 1 - i
+            idx = (k - 1 - j) % m
+            valid = (j < jnp.minimum(k, m)).astype(jnp.float32)
+            b = valid * rho[idx] * (Y[idx] @ r)
+            return r + valid * S[idx] * (alphas[j] - b)
+
+        return jax.lax.fori_loop(0, m, bl2, r)
+
+    def linesearch(beta, f, g, d):
+        gtd = g @ d
+
+        def cond(st):
+            t, fn, tries, ok = st
+            return (~ok) & (tries < 24)
+
+        def body(st):
+            t, fn, tries, ok = st
+            fn2, _ = vg_fn(beta + t * d)
+            ok2 = fn2 <= f + 1e-4 * t * gtd
+            return (jnp.where(ok2, t, t * 0.5), jnp.where(ok2, fn2, fn),
+                    tries + 1, ok2)
+
+        t, fn, tries, ok = jax.lax.while_loop(
+            cond, body, (jnp.float32(1.0), f, 0, False))
+        return jnp.where(ok, t, 0.0)
+
+    f0, g0 = vg_fn(beta0)
+    state = (0, beta0, f0, g0, jnp.zeros((m, P), jnp.float32),
+             jnp.zeros((m, P), jnp.float32), jnp.zeros(m, jnp.float32),
+             0, False)
+
+    def cond(st):
+        it, beta, f, g, S, Y, rho, k, done = st
+        return (~done) & (it < max_iter)
+
+    def body(st):
+        it, beta, f, g, S, Y, rho, k, done = st
+        d = -two_loop(g, S, Y, rho, k)
+        # safeguard: fall back to steepest descent on non-descent dirs
+        d = jnp.where(g @ d < 0, d, -g)
+        t = linesearch(beta, f, g, d)
+        beta2 = beta + t * d
+        f2, g2 = vg_fn(beta2)
+        s = beta2 - beta
+        yv = g2 - g
+        sy = s @ yv
+        upd = sy > 1e-12
+        idx = k % m
+        S2 = jnp.where(upd, S.at[idx].set(s), S)
+        Y2 = jnp.where(upd, Y.at[idx].set(yv), Y)
+        rho2 = jnp.where(upd, rho.at[idx].set(1.0 / jnp.maximum(sy, 1e-20)),
+                         rho)
+        k2 = jnp.where(upd, k + 1, k)
+        gmax = jnp.max(jnp.abs(g2))
+        done2 = (gmax < tol) | (t == 0.0)
+        return (it + 1, beta2, f2, g2, S2, Y2, rho2, k2, done2)
+
+    out = jax.lax.while_loop(cond, body, state)
+    return out[1], out[2], out[0]
+
+
 def _cholesky_solve(G, b, lam_l2, pen_mask):
     """Ridge/no-penalty exact solve (hex/gram/Gram.java:452 cholesky)."""
     A = G + jnp.diag(lam_l2 * pen_mask + 1e-8)
@@ -285,6 +378,19 @@ class GLMModel(Model):
         d = {"Intercept": self.intercept_value}
         d.update({n: float(b) for n, b in zip(self.exp_names, self.beta)})
         return d
+
+    def coef_with_p_values(self) -> Dict[str, Dict[str, float]]:
+        """Std errors / z / p per coefficient (requires
+        compute_p_values=True at train; hex/glm/GLMModel computePValues)."""
+        pv = self.output.get("p_values")
+        if not pv:
+            raise ValueError(
+                "p-values were not computed — train with "
+                "compute_p_values=True (and no L1 penalty)")
+        return {"coefficients": self.coef(),
+                "std_errs": self.output["std_errs"],
+                "z_values": self.output["z_values"],
+                "p_values": pv}
 
     def _predict_matrix(self, X, offset=None):
         Xe = expand_scoring_matrix(self, X)
@@ -403,11 +509,12 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
         ncoef = Xs.shape[1]
 
         alpha = p.get("alpha")
-        alpha = 0.5 if alpha is None else (
-            alpha[0] if isinstance(alpha, (list, tuple)) else float(alpha))
+        if isinstance(alpha, (list, tuple)):
+            alpha = alpha[0] if alpha else None
+        alpha = 0.5 if alpha is None else float(alpha)
         lam_param = p.get("Lambda")
         if isinstance(lam_param, (list, tuple)):
-            lambdas = [float(v) for v in lam_param]
+            lambdas = [float(v) for v in lam_param] or None
         elif lam_param is not None:
             lambdas = [float(lam_param)]
         else:
@@ -429,15 +536,81 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
                 g0 = Xs[:, :Fe].T @ (w * (y - mu))
                 lmax = float(jax.device_get(
                     jnp.max(jnp.abs(g0)))) / max(nobs * max(alpha, 1e-3), 1e-12)
-                nl = int(p.get("nlambdas", 30))
-                lmin = float(p.get("lambda_min_ratio", 1e-4)) * lmax
+                nl = int(p.get("nlambdas", 30) or 30)
+                if nl <= 0:
+                    nl = 30
+                lmr = float(p.get("lambda_min_ratio", 1e-4) or 1e-4)
+                if lmr <= 0:
+                    lmr = 1e-4
+                lmin = lmr * lmax
                 lambdas = list(np.geomspace(lmax, lmin, nl))
             else:
                 lambdas = [0.0]
 
-        max_iter = int(p.get("max_iterations", 50))
+        # the wire clients send -1 sentinels for "auto" numerics
+        # (GLMParameters defaults) — fall back to our defaults
+        max_iter = int(p.get("max_iterations", 50) or 50)
+        if max_iter <= 0:
+            max_iter = 50
         beta_eps = float(p.get("beta_epsilon", 1e-5))
         non_neg = bool(p.get("non_negative", False))
+        solver = (str(p.get("solver") or "auto")
+                  ).upper().replace("-", "_")
+        use_lbfgs = solver in ("L_BFGS", "LBFGS")
+        if use_lbfgs and alpha > 0 and any(l > 0 for l in lambdas):
+            raise ValueError(
+                "L1 penalty (alpha > 0 with lambda > 0) is not supported "
+                "by solver L_BFGS (hex/glm/GLM.java:979 forces alpha=0 for "
+                "L-BFGS); use IRLSM or COORDINATE_DESCENT")
+        compute_pv = bool(p.get("compute_p_values", False))
+        if compute_pv and (alpha > 0 and any(l > 0 for l in lambdas)):
+            raise ValueError(
+                "p-values cannot be computed with an L1 penalty "
+                "(hex/glm/GLM.java compute_p_values restrictions)")
+
+        # L-BFGS objective: mean penalized negative log-likelihood on the
+        # standardized design — gradients are ONE sharded matvec pair, so
+        # the same code path covers the wide ('model'-axis sharded) case
+        # (SURVEY §7.1.7: Criteo-wide GLM)
+        def _nll_mean(bs):
+            eta_i = Xs @ bs
+            if offset is not None:
+                eta_i = eta_i + offset
+            if family == "binomial":
+                per = jax.nn.softplus(eta_i) - y * eta_i
+            elif family == "poisson":
+                per = jnp.exp(eta_i) - y * eta_i
+            elif family == "gamma":
+                per = y * jnp.exp(-eta_i) + eta_i
+            else:
+                per = 0.5 * (y - eta_i) ** 2
+            return (w * per).sum() / nobs
+
+        if use_lbfgs and ncoef >= 1024:
+            # WIDE path (SURVEY §7.1.7): shard the design over BOTH mesh
+            # axes — rows on 'data', features on 'model'. The L-BFGS
+            # gradient is a matvec pair (Xs @ β, Xsᵀ r); GSPMD partials
+            # them per shard and inserts the psums, so features never
+            # gather on one device (the reference cannot shard features
+            # at all — every JVM node holds all columns, SURVEY §5).
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from h2o3_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS,
+                                                current_mesh)
+            mesh = current_mesh()
+            if mesh is not None and mesh.shape.get(MODEL_AXIS, 1) > 1:
+                pad_f = (-ncoef) % mesh.shape[MODEL_AXIS]
+                if pad_f == 0 and Xs.shape[0] % mesh.shape[DATA_AXIS] == 0:
+                    Xs = jax.device_put(
+                        Xs, NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS)))
+
+        @jax.jit
+        def lbfgs_fit(beta_init, lam2_unit):
+            def obj(bs):
+                return (_nll_mean(bs)
+                        + 0.5 * lam2_unit * ((bs * pen_mask) ** 2).sum())
+            return _lbfgs_minimize(jax.value_and_grad(obj), beta_init,
+                                   max_iter=max(max_iter * 6, 300),
+                                   tol=float(p.get("gradient_epsilon", 1e-6)))
 
         def _make_step(use_cd: bool):
             @jax.jit
@@ -487,18 +660,23 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
         best = None
         submodels = []
         for li, lam in enumerate(lambdas):
-            use_cd = alpha > 0 and lam > 0
-            irls_step = step_cd if use_cd else step_chol
-            lam1 = jnp.float32(lam * alpha * nobs)
-            lam2 = jnp.float32(lam * (1 - alpha) * nobs)
-            for it in range(max_iter):
-                nb = irls_step(beta_s, lam1, lam2)
-                delta = float(jax.device_get(jnp.max(jnp.abs(nb - beta_s))))
-                beta_s = nb
-                if delta < beta_eps:
-                    break
-                if family == "gaussian" and not use_cd:
-                    break  # weighted least squares: one solve is exact
+            if use_lbfgs:
+                beta_s, _fv, _its = lbfgs_fit(
+                    beta_s, jnp.float32(lam * (1 - alpha)))
+            else:
+                use_cd = alpha > 0 and lam > 0
+                irls_step = step_cd if use_cd else step_chol
+                lam1 = jnp.float32(lam * alpha * nobs)
+                lam2 = jnp.float32(lam * (1 - alpha) * nobs)
+                for it in range(max_iter):
+                    nb = irls_step(beta_s, lam1, lam2)
+                    delta = float(jax.device_get(
+                        jnp.max(jnp.abs(nb - beta_s))))
+                    beta_s = nb
+                    if delta < beta_eps:
+                        break
+                    if family == "gaussian" and not use_cd:
+                        break  # weighted least squares: one solve is exact
             eta_f = Xs @ beta_s + (0.0 if offset is None else offset)
             dev = float(jax.device_get(fam.deviance(w, y, fam.linkinv(eta_f))))
             sel_dev = dev
@@ -537,6 +715,48 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
                          lam_best, null_dev, res_dev, nobs, rank)
         model.output["lambda_path"] = submodels
         model.output["coefficients"] = model.coef()
+        if compute_pv:
+            # standard errors / z / p from the unpenalized observed
+            # information on the RAW design at the fitted coefficients
+            # (hex/glm/GLMModel computePValues: cov = inv(X'WX)·φ̂)
+            Xr = jnp.concatenate([Xe, jnp.ones((Xe.shape[0], 1),
+                                               jnp.float32)], axis=1)
+            beta_full = jnp.concatenate(
+                [jnp.asarray(beta_raw), jnp.asarray([icpt], jnp.float32)])
+            eta_r = Xr @ beta_full
+            if offset is not None:
+                eta_r = eta_r + offset
+            mu_r = fam.linkinv(eta_r)
+            dmu_r = fam.mu_eta(eta_r)
+            var_r = fam.variance(mu_r)
+            wi = w * dmu_r * dmu_r / jnp.maximum(var_r, 1e-12)
+            Gr = (Xr * wi[:, None]).T @ Xr
+            df = max(nobs - rank, 1.0)
+            if family == "gaussian":
+                dispersion = res_dev / df
+            elif family == "gamma":
+                # Pearson dispersion estimate
+                pearson = float(jax.device_get(
+                    (w * (y - mu_r) ** 2 / jnp.maximum(var_r, 1e-12)).sum()))
+                dispersion = pearson / df
+            else:
+                dispersion = 1.0
+            cov = np.asarray(jax.device_get(
+                jnp.linalg.pinv(Gr + 1e-8 * jnp.eye(Gr.shape[0])))) * dispersion
+            se = np.sqrt(np.maximum(np.diag(cov), 0.0))
+            coefs_full = np.concatenate(
+                [np.asarray(jax.device_get(beta_raw)), [icpt]])
+            zval = np.where(se > 0, coefs_full / np.maximum(se, 1e-300), 0.0)
+            from scipy import stats as _st
+            if family == "gaussian":
+                pval = 2.0 * _st.t.sf(np.abs(zval), df=max(df, 1.0))
+            else:
+                pval = 2.0 * _st.norm.sf(np.abs(zval))
+            names_pv = list(exp_names) + ["Intercept"]
+            model.output["std_errs"] = dict(zip(names_pv, se.tolist()))
+            model.output["z_values"] = dict(zip(names_pv, zval.tolist()))
+            model.output["p_values"] = dict(zip(names_pv, pval.tolist()))
+            model.output["dispersion"] = float(dispersion)
         # training metrics
         out = model._predict_matrix(spec.X, offset=offset)
         model.training_metrics = compute_metrics(

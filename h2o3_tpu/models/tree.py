@@ -56,6 +56,10 @@ class TreeConfig:
     mtries: int = 0          # >0: random feature subset PER NODE per level
                              # (DRF mtries, hex/tree/drf/DRF.java)
     hist_method: str = "auto"
+    # histogram_type=random (hex/tree/DHistogram.java HistogramType.Random):
+    # randomize the adaptive grid's phase per tree/feature so split points
+    # land at random offsets within a bin width
+    random_grid: bool = False
 
     @property
     def n_nodes(self) -> int:
@@ -81,14 +85,20 @@ def _leaf_value(g, h, cfg: TreeConfig):
     return -g / (h + lam + 1e-12)
 
 
-def _find_splits(trip, cfg: TreeConfig, col_mask):
+def _find_splits(trip, cfg: TreeConfig, col_mask, mono=None):
     """Best split per node from a (g, h, w) histogram triple, each
     [N, F', B'] with F' >= n_features and B' >= n_bins+1 (the pallas
     kernel's padded layout; trailing features/bins are zero).
 
     ``col_mask`` is [F] (per-tree column sampling) or [N, F] (per-node
-    mtries subsets). Returns (gain, feat, bin, na_left, g_tot, h_tot,
-    w_tot) per node."""
+    mtries subsets). ``mono`` ([F] int, -1/0/+1) enforces monotone
+    constraints: a candidate split on feature f with mono[f]=c is invalid
+    unless c·(left child value) <= c·(right child value) — the same
+    pruning hex/tree/DTree.java applies via Constraints.
+
+    Returns (gain, feat, bin, na_left, g_tot, h_tot, w_tot, vl, vr) per
+    node, where vl/vr are the SELECTED split's unclipped child values
+    (used by callers to propagate monotone bounds)."""
     B = cfg.n_bins
     F = cfg.n_features
     g = trip[0][:, :F, :]
@@ -112,6 +122,11 @@ def _find_splits(trip, cfg: TreeConfig, col_mask):
         gain = (_leaf_score2(gl, hl, cfg) + _leaf_score2(gr, hr, cfg)
                 - parent[..., None])
         ok = (wl >= cfg.min_rows) & (wr >= cfg.min_rows)
+        if mono is not None:
+            c = mono.astype(jnp.float32)[None, :, None]      # [1,F,1]
+            vl = _leaf_value(gl, hl, cfg)
+            vr = _leaf_value(gr, hr, cfg)
+            ok = ok & ((c == 0) | (c * (vr - vl) >= 0))
         return jnp.where(ok, gain, NEG_INF)
 
     gains_nr = gains(gl0, hl0, wl0)                                  # NA right
@@ -129,13 +144,58 @@ def _find_splits(trip, cfg: TreeConfig, col_mask):
     rem = best % per_f
     bin_idx = rem // 2 + 1          # split t in 1..B-1
     na_left = (rem % 2) == 1
+    # selected split's child (g, h) for bound propagation
+    nidx = jnp.arange(N)
+    t_sel = bin_idx - 1
+    gl_s = gl0[nidx, feat, t_sel]
+    hl_s = hl0[nidx, feat, t_sel]
+    gl_s = gl_s + jnp.where(na_left, g_na[nidx, feat], 0.0)
+    hl_s = hl_s + jnp.where(na_left, h_na[nidx, feat], 0.0)
+    gt_s = g_tot[nidx, 0]
+    ht_s = h_tot[nidx, 0]
+    vl_sel = _leaf_value(gl_s, hl_s, cfg)
+    vr_sel = _leaf_value(gt_s - gl_s, ht_s - hl_s, cfg)
     # f=0 slice of per-feature totals == node totals
     return (best_gain, feat.astype(jnp.int32), bin_idx.astype(jnp.int32),
-            na_left, g_tot[:, 0], h_tot[:, 0], w_tot[:, 0])
+            na_left, g_tot[:, 0], h_tot[:, 0], w_tot[:, 0], vl_sel, vr_sel)
+
+
+BIGV = jnp.float32(1e30)
+
+
+def _child_bounds(lo_b, hi_b, vl, vr, mono_dir, can):
+    """Monotone bound propagation (hex/tree/DTree Constraints): a split
+    on a constrained feature bounds both subtrees at the midpoint of the
+    (clipped) child values; unconstrained splits inherit the parent's
+    bounds. Returns interleaved [2N] (lo, hi) for the children level."""
+    vl_c = jnp.clip(vl, lo_b, hi_b)
+    vr_c = jnp.clip(vr, lo_b, hi_b)
+    mid = 0.5 * (vl_c + vr_c)
+    up = can & (mono_dir > 0)      # left <= right
+    dn = can & (mono_dir < 0)
+    lo_left = jnp.where(dn, mid, lo_b)
+    hi_left = jnp.where(up, mid, hi_b)
+    lo_right = jnp.where(up, mid, lo_b)
+    hi_right = jnp.where(dn, mid, hi_b)
+    lo2 = jnp.stack([lo_left, lo_right], 1).reshape(-1)
+    hi2 = jnp.stack([hi_left, hi_right], 1).reshape(-1)
+    return lo2, hi2
+
+
+def _next_allowed(allowed, sets, bf, can):
+    """Interaction-constraint propagation: children may only split on
+    features sharing an interaction set with the parent's split feature
+    (intersected with the parent's own allowance — path semantics).
+    ``allowed`` [N, F] bool, ``sets`` [S, F] bool. (hex/tree
+    interaction_constraints / GlobalInteractionConstraints)."""
+    contains = sets[:, bf].T                     # [N, S]: sets with feat
+    union = (contains.astype(jnp.float32) @ sets.astype(jnp.float32)) > 0
+    child = jnp.where(can[:, None], allowed & union, allowed)
+    return jnp.repeat(child, 2, axis=0)          # both children alike
 
 
 def grow_tree(codes, g, h, w, cfg: TreeConfig, col_mask, axis_name=None,
-              key=None):
+              key=None, mono=None, sets=None):
     """Build one tree. All args are device arrays (codes [rows,F] int,
     g/h/w [rows] float32, already weight-multiplied); returns tree arrays
     of length M = 2^(D+1)-1 plus per-row final node ids.
@@ -172,6 +232,9 @@ def grow_tree(codes, g, h, w, cfg: TreeConfig, col_mask, axis_name=None,
 
     nid = jnp.zeros(rows, jnp.int32)
     prev_hist = None
+    lo_b = jnp.full(1, -BIGV)
+    hi_b = jnp.full(1, BIGV)
+    allowed = (jnp.ones((1, F), bool) if sets is not None else None)
     for d in range(D):
         base = 2 ** d - 1
         N = 2 ** d
@@ -207,16 +270,28 @@ def grow_tree(codes, g, h, w, cfg: TreeConfig, col_mask, axis_name=None,
             u = jnp.where(col_mask[None, :], u, 2.0)  # excluded cols last
             kth = jnp.sort(u, axis=1)[:, min(cfg.mtries, F) - 1]
             level_mask = (u <= kth[:, None]) & col_mask[None, :]
-        bg, bf, bb, bnl, gt, ht, wt = _find_splits(hist, cfg, level_mask)
+        if allowed is not None:
+            lm2 = level_mask if level_mask.ndim == 2 else level_mask[None, :]
+            level_mask = lm2 & allowed
+        bg, bf, bb, bnl, gt, ht, wt, vl_s, vr_s = _find_splits(
+            hist, cfg, level_mask, mono=mono)
         can = (bg > jnp.maximum(cfg.min_split_improvement, 0.0)) & (wt > 0)
         idx = base + jnp.arange(N)
         feat = feat.at[idx].set(jnp.where(can, bf, -1))
         split_bin = split_bin.at[idx].set(bb)
         na_left = na_left.at[idx].set(bnl)
         is_split = is_split.at[idx].set(can)
-        value = value.at[idx].set(_leaf_value(gt, ht, cfg))
+        value = value.at[idx].set(
+            jnp.clip(_leaf_value(gt, ht, cfg), lo_b, hi_b))
         gain_arr = gain_arr.at[idx].set(jnp.where(can, bg, 0.0))
         node_w = node_w.at[idx].set(wt)
+        if mono is not None:
+            lo_b, hi_b = _child_bounds(lo_b, hi_b, vl_s, vr_s, mono[bf], can)
+        else:
+            lo_b = jnp.repeat(lo_b, 2)
+            hi_b = jnp.repeat(hi_b, 2)
+        if allowed is not None:
+            allowed = _next_allowed(allowed, sets, bf, can)
         # route rows: only rows whose current node is at this level AND
         # split. Per-node routing data is packed into ONE word so each row
         # does a single small-table gather (4 separate gathers cost ~8ms
@@ -246,7 +321,8 @@ def grow_tree(codes, g, h, w, cfg: TreeConfig, col_mask, axis_name=None,
         hD = jax.lax.psum(hD, axis_name)
         wD = jax.lax.psum(wD, axis_name)
     idxD = baseD + jnp.arange(2 ** D)
-    value = value.at[idxD].set(_leaf_value(gD, hD, cfg))
+    value = value.at[idxD].set(
+        jnp.clip(_leaf_value(gD, hD, cfg), lo_b, hi_b))
     node_w = node_w.at[idxD].set(wD)
 
     tree = {"feat": feat, "split_bin": split_bin, "na_left": na_left,
@@ -298,7 +374,9 @@ def adaptive_setup(spec, params, max_depth: int, mtries: int = 0):
                      reg_lambda=float(p.get("reg_lambda", 0.0)),
                      reg_alpha=float(p.get("reg_alpha", 0.0)),
                      mtries=mtries,
-                     hist_method=p.get("hist_kernel", "auto"))
+                     hist_method=p.get("hist_kernel", "auto"),
+                     random_grid=(str(p.get("histogram_type", "")).lower()
+                                  == "random"))
     Xf = jnp.where(jnp.isfinite(spec.X), spec.X, jnp.nan)
     root_lo = jnp.nan_to_num(jnp.nanmin(Xf, axis=0), nan=0.0)
     root_hi = jnp.nan_to_num(jnp.nanmax(Xf, axis=0), nan=0.0)
@@ -310,7 +388,8 @@ def adaptive_setup(spec, params, max_depth: int, mtries: int = 0):
 
 
 def grow_tree_adaptive(X, g, h, w, cfg: TreeConfig, col_mask, root_lo,
-                       root_hi, axis_name=None, key=None, nb_f=None):
+                       root_hi, axis_name=None, key=None, nb_f=None,
+                       mono=None, sets=None):
     """Build one tree with PER-NODE ADAPTIVE uniform bins on raw features
     (H2O's default histogram_type=UniformAdaptive, hex/tree/DHistogram.java
     _min/_maxEx per-node re-binning) via the fused route+bin+histogram
@@ -366,10 +445,24 @@ def grow_tree_adaptive(X, g, h, w, cfg: TreeConfig, col_mask, root_lo,
     # previous level's split tables (root has none)
     zeros1 = jnp.zeros(1, jnp.float32)
     tables = (zeros1, zeros1, zeros1, zeros1)
+    lo_b = jnp.full(1, -BIGV)          # monotone value bounds per node
+    hi_b = jnp.full(1, BIGV)
+    allowed = (jnp.ones((1, F), bool) if sets is not None else None)
+
+    # histogram_type=random: per-(tree, feature) grid phase offset in
+    # [0, 1) bin widths (key differs per tree → split points randomized
+    # the way DHistogram.Random randomizes its bin boundaries)
+    phase = None
+    if cfg.random_grid and key is not None:
+        phase = jax.random.uniform(jax.random.fold_in(key, 7919), (F,))
 
     for d in range(D):
         N = 2 ** d
         base = N - 1
+        if phase is not None:
+            width0 = jnp.maximum(hi_d - lo_d, 0.0) / jnp.maximum(
+                nb_f[None, :], 1.0)
+            lo_d = lo_d - phase[None, :] * width0
         span = jnp.maximum(hi_d - lo_d, 0.0)
         inv_d = jnp.where(span > 0,
                           nb_f[None, :] / jnp.where(span > 0, span, 1.0), 0.0)
@@ -384,7 +477,11 @@ def grow_tree_adaptive(X, g, h, w, cfg: TreeConfig, col_mask, root_lo,
             u = jnp.where(col_mask[None, :], u, 2.0)
             kth = jnp.sort(u, axis=1)[:, min(cfg.mtries, F) - 1]
             level_mask = (u <= kth[:, None]) & col_mask[None, :]
-        bg, bf, bb, bnl, gt, ht, wt = _find_splits(trip, find_cfg, level_mask)
+        if allowed is not None:
+            lm2 = level_mask if level_mask.ndim == 2 else level_mask[None, :]
+            level_mask = lm2 & allowed
+        bg, bf, bb, bnl, gt, ht, wt, vl_s, vr_s = _find_splits(
+            trip, find_cfg, level_mask, mono=mono)
         can = (bg > jnp.maximum(cfg.min_split_improvement, 0.0)) & (wt > 0)
         nidx = jnp.arange(N)
         lo_sel = lo_d[nidx, bf]
@@ -405,9 +502,17 @@ def grow_tree_adaptive(X, g, h, w, cfg: TreeConfig, col_mask, root_lo,
         thr_arr = thr_arr.at[idx].set(thr)
         na_left = na_left.at[idx].set(bnl)
         is_split = is_split.at[idx].set(can)
-        value = value.at[idx].set(_leaf_value(gt, ht, cfg))
+        value = value.at[idx].set(
+            jnp.clip(_leaf_value(gt, ht, cfg), lo_b, hi_b))
         gain_arr = gain_arr.at[idx].set(jnp.where(can, bg, 0.0))
         node_w = node_w.at[idx].set(wt)
+        if mono is not None:
+            lo_b, hi_b = _child_bounds(lo_b, hi_b, vl_s, vr_s, mono[bf], can)
+        else:
+            lo_b = jnp.repeat(lo_b, 2)
+            hi_b = jnp.repeat(hi_b, 2)
+        if allowed is not None:
+            allowed = _next_allowed(allowed, sets, bf, can)
         # next level's routing tables
         tables = (jnp.maximum(bf, 0).astype(jnp.float32), thr,
                   bnl.astype(jnp.float32), can.astype(jnp.float32))
@@ -437,7 +542,8 @@ def grow_tree_adaptive(X, g, h, w, cfg: TreeConfig, col_mask, root_lo,
         totD = jax.lax.psum(totD, axis_name)
     gD, hD, wD = totD[0], totD[1], totD[2]
     idxD = baseD + jnp.arange(ND)
-    value = value.at[idxD].set(_leaf_value(gD, hD, cfg))
+    value = value.at[idxD].set(
+        jnp.clip(_leaf_value(gD, hD, cfg), lo_b, hi_b))
     node_w = node_w.at[idxD].set(wD)
 
     tree = {"feat": feat, "thr": thr_arr, "na_left": na_left,
@@ -510,7 +616,8 @@ def grow_tree_spmd(codes, g, h, w, cfg: TreeConfig, col_mask,
         seg = jnp.where(in_level, local, -1)
         hist = build_histograms(codes, seg, ghw, N, B1, cfg.hist_method)
         hist = jax.lax.psum(hist, data_axis)
-        bg, bf, bb, bnl, gt, ht, wt = _find_splits(hist, cfg, col_mask)
+        bg, bf, bb, bnl, gt, ht, wt, _vl, _vr = _find_splits(hist, cfg,
+                                                             col_mask)
         # global best over the model axis
         cand = jnp.stack([bg, (midx * F_loc + bf).astype(jnp.float32),
                           bb.astype(jnp.float32), bnl.astype(jnp.float32)], 1)

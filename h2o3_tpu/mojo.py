@@ -131,11 +131,23 @@ _CATEGORY = {1: "Regression", 2: "Binomial"}
 
 
 def export_mojo(model, path: str) -> str:
-    """Write a GBM/DRF model as an h2o-genmodel-readable MOJO zip."""
+    """Write a model as an h2o-genmodel-readable MOJO zip. Trees carry
+    the v1.40 wire format; GLM/KMeans/DeepLearning write their readers'
+    kv formats (h2o3_tpu/genmodel.py)."""
     import jax
     algo = model.algo
+    if algo == "glm":
+        from h2o3_tpu.genmodel import export_mojo_glm
+        return export_mojo_glm(model, path)
+    if algo == "kmeans":
+        from h2o3_tpu.genmodel import export_mojo_kmeans
+        return export_mojo_kmeans(model, path)
+    if algo == "deeplearning":
+        from h2o3_tpu.genmodel import export_mojo_deeplearning
+        return export_mojo_deeplearning(model, path)
     if algo not in ("gbm", "drf"):
-        raise ValueError(f"MOJO export supports gbm/drf (got '{algo}')")
+        raise ValueError(f"MOJO export supports gbm/drf/glm/kmeans/"
+                         f"deeplearning (got '{algo}')")
     feat = np.asarray(jax.device_get(model._feat))
     thr = np.asarray(jax.device_get(model._thr))
     nal = np.asarray(jax.device_get(model._na_left))
@@ -400,6 +412,16 @@ def read_mojo(path: str) -> MojoModel:
                 nm = f"trees/t{k:02d}_{t:03d}.bin"
                 if nm in names:
                     trees[(k, t)] = zf.read(nm)
+    algo = info.get("algo", "")
+    if algo in ("glm", "kmeans", "deeplearning"):
+        from h2o3_tpu.genmodel import (DeepLearningMojoScorer,
+                                       GlmMojoScorer, KMeansMojoScorer)
+        resp = columns[-1] if info.get("supervised") == "true" else None
+        scorer_cls = {"glm": GlmMojoScorer, "kmeans": KMeansMojoScorer,
+                      "deeplearning": DeepLearningMojoScorer}[algo]
+        s = scorer_cls(info, columns, domains, resp)
+        s.info = info
+        return s
     return MojoModel(info, columns, domains, trees)
 
 

@@ -47,26 +47,63 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-TILE = 4096
+import os as _os
+
+TILE = int(_os.environ.get("H2O3_HIST_TILE", 4096))
 # default scoped-vmem stack limit is 16MB; the accumulator + one-hot want
 # more at deeper levels / larger tiles (v5e has 128MB VMEM)
 _VMEM_LIMIT = 100 * 1024 * 1024
 
 
+_SPLIT_S1 = 256.0        # 2^8  — exact bf16 scaling
+_SPLIT_S2 = 65536.0      # 2^16
+
+
+def _split3_bf16(t: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
+    """Exact 3-term bf16 decomposition of an f32 array, concatenated along
+    ``axis``: t == hi + mid/2^8 + lo/2^16 bit-for-bit (8+8+8 mantissa bits
+    >= f32's 24; the residual after two splits has <= 8 significant bits so
+    the third term is exact). A one-hot matmul against the concatenated
+    bf16 table then reproduces the f32 lookup EXACTLY with one 1-pass bf16
+    MXU product per term — ~6x cheaper than a HIGHEST (f32 6-pass) matmul.
+
+    The mid/lo terms are PRE-SCALED by 2^8 / 2^16 (exact power-of-two
+    bf16 ops) and the kernel multiplies the partial results back down
+    before summing. The residuals are computed with lax.reduce_precision,
+    NOT astype(bf16).astype(f32): under jit, XLA's default
+    --xla_allow_excess_precision legally elides f32->bf16->f32 round
+    trips, which would zero the residuals and collapse every table entry
+    to its bf16 rounding (observed on v5e: t_r == bf16(thr), flipping
+    routing for rows within a bf16 ulp of a split threshold)."""
+    t = t.astype(jnp.float32)
+    hi_v = jax.lax.reduce_precision(t, 8, 7)          # bf16-valued f32
+    r1 = (t - hi_v) * _SPLIT_S1
+    mid_v = jax.lax.reduce_precision(r1, 8, 7)
+    lo_v = (r1 - mid_v) * _SPLIT_S1                   # exact in bf16 already
+    return jnp.concatenate([hi_v.astype(jnp.bfloat16),
+                            mid_v.astype(jnp.bfloat16),
+                            lo_v.astype(jnp.bfloat16)], axis=axis)
+
+
+def _unsplit3(p_hi, p_mid, p_lo):
+    """Recombine partial one-hot lookups of a _split3_bf16 table (f32)."""
+    return p_hi + (p_mid * (1.0 / _SPLIT_S1) + p_lo * (1.0 / _SPLIT_S2))
+
+
 def _route(x, nid, tabs_ref, n_prev, level_base, tile, F):
     """Shared routing block: step rows through the previous level's split
-    tables ([4, np] = feat/thr/na_left/can) with ONE merged HIGHEST-
-    precision LUT matmul (a bf16-rounded threshold flips routing for rows
-    near the split boundary)."""
-    HI = jax.lax.Precision.HIGHEST
+    tables (bf16-split [12, np] = 3 exact terms x feat/thr/na_left/can)
+    with ONE merged 1-pass bf16 LUT matmul. The one-hot RHS makes the
+    3-term reconstruction exact (see _split3_bf16) — a plain bf16-rounded
+    threshold WOULD flip routing for rows near the split boundary."""
     prev_base = level_base - n_prev
     lid_p = nid - prev_base
     onp = (jax.lax.broadcasted_iota(jnp.int32, (n_prev, tile), 0)
-           == lid_p[None, :]).astype(jnp.float32)
-    t4 = tabs_ref[:, :n_prev]                         # [4, n_prev]
-    lut = jax.lax.dot_general(t4, onp, (((1,), (0,)), ((), ())),
-                              preferred_element_type=jnp.float32,
-                              precision=HI)           # [4, tile]
+           == lid_p[None, :]).astype(jnp.bfloat16)
+    t12 = tabs_ref[:, :n_prev]                        # [12, n_prev] bf16
+    lut3 = jax.lax.dot_general(t12, onp, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)  # [12, tile]
+    lut = _unsplit3(lut3[0:4], lut3[4:8], lut3[8:12])  # exact f32 rebuild
     f_r, t_r, nl_r, cn_r = lut[0], lut[1], lut[2], lut[3]
     # x[r, feat_r] via compare-accumulate (f_r is an exact int-valued
     # float: one-hot matmul of ints < 2^24)
@@ -93,7 +130,6 @@ def _kernel(x_ref, nid_ref, ghw_ref, tabs_ref, loinv_ref, nid_out, hist_out,
 
     x = x_ref[...]                                   # [tile, F] f32
     nid = nid_ref[0, :]                              # [tile] i32 global ids
-    HI = jax.lax.Precision.HIGHEST
     if n_prev > 0:
         nid = _route(x, nid, tabs_ref, n_prev, level_base, tile, F)
     nid_out[0, :] = nid
@@ -104,26 +140,33 @@ def _kernel(x_ref, nid_ref, ghw_ref, tabs_ref, loinv_ref, nid_out, hist_out,
     onh = (jax.lax.broadcasted_iota(jnp.int32, (n_nodes, tile), 0)
            == lidc[None, :])
     onh_f = onh.astype(jnp.float32) * in_lvl.astype(jnp.float32)[None, :]
-    # per-row ranges in ONE merged [N, 2F] lookup matmul (exact f32: bin
-    # boundaries must match the split-side threshold arithmetic, and a
-    # bf16-rounded lo breaks deep narrowed ranges where |lo| >> span)
-    loinv_r = jax.lax.dot_general(onh_f, loinv_ref[...],
-                                  (((0,), (0,)), ((), ())),
-                                  preferred_element_type=jnp.float32,
-                                  precision=HI)       # [tile, 2F]
+    # per-row ranges in ONE merged [N, 6F] bf16-split lookup matmul. Bin
+    # boundaries must match the split-side threshold arithmetic exactly;
+    # the 3-term bf16 reconstruction against the one-hot LHS is exact
+    # (see _split3_bf16) while a rounded lo breaks deep narrowed ranges
+    # (|lo| >> span).
+    onh_b = onh_f.astype(jnp.bfloat16)
+    loinv_r3 = jax.lax.dot_general(onh_b, loinv_ref[...],
+                                   (((0,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)  # [tile, 6F]
+    loinv_r = _unsplit3(loinv_r3[:, :2 * F], loinv_r3[:, 2 * F:4 * F],
+                        loinv_r3[:, 4 * F:])
     lo_r = loinv_r[:, :F]
     inv_r = loinv_r[:, F:]
     bin_f = jnp.floor(jnp.clip((x - lo_r) * inv_r, 0.0, float(W - 2)))
     bin_v = jnp.where(jnp.isnan(x), float(W - 1), bin_f)   # [tile, F] f32
     # bin one-hot via a selector matmul: b_all[r, j] = bin of feature j//W
-    # (an F-way lane-offset concatenate costs ~20% of the level at F=28)
+    # (an F-way lane-offset concatenate costs ~20% of the level at F=28).
+    # Exact in ONE bf16 pass: bins and the 0/1 selector are integers
+    # <= 254, within bf16's exact-integer range (<= 256).
     sel = (jax.lax.broadcasted_iota(jnp.int32, (F, F * W), 1) // W
            == jax.lax.broadcasted_iota(jnp.int32, (F, F * W), 0)
-           ).astype(jnp.float32)
-    b_all = jax.lax.dot_general(bin_v, sel, (((1,), (0,)), ((), ())),
+           ).astype(jnp.bfloat16)
+    b_all = jax.lax.dot_general(bin_v.astype(jnp.bfloat16), sel,
+                                (((1,), (0,)), ((), ())),
                                 preferred_element_type=jnp.float32)
     lane = jax.lax.broadcasted_iota(jnp.int32, (tile, F * W), 1)
-    oh = ((lane % W) == b_all.astype(jnp.int32)).astype(mxu_dtype)
+    oh = ((lane % W).astype(jnp.float32) == b_all).astype(mxu_dtype)
     ghw = ghw_ref[...]
     left = jnp.concatenate(
         [onh_f.astype(mxu_dtype) * ghw[k, :][None, :].astype(mxu_dtype)
@@ -131,7 +174,7 @@ def _kernel(x_ref, nid_ref, ghw_ref, tabs_ref, loinv_ref, nid_out, hist_out,
     acc_ref[...] += jax.lax.dot_general(
         left, oh, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-        precision=(HI if mxu_dtype == jnp.float32
+        precision=(jax.lax.Precision.HIGHEST if mxu_dtype == jnp.float32
                    else jax.lax.Precision.DEFAULT))       # [3N, FW]
 
     @pl.when(r == n_row_tiles - 1)
@@ -141,7 +184,8 @@ def _kernel(x_ref, nid_ref, ghw_ref, tabs_ref, loinv_ref, nid_out, hist_out,
 
 def _pack_tables(tables):
     feat, thr, nal, can = tables
-    return jnp.stack([feat, thr, nal, can], axis=0)       # [4, np1]
+    t4 = jnp.stack([feat, thr, nal, can], axis=0)         # [4, np1] f32
+    return _split3_bf16(t4, axis=0)                       # [12, np1] bf16
 
 
 def adaptive_level_tpu(x, nid, ghw, tables, lo, inv, n_prev: int,
@@ -158,7 +202,8 @@ def adaptive_level_tpu(x, nid, ghw, tables, lo, inv, n_prev: int,
     n_row_tiles = rows // tile
     tabs = _pack_tables(tables)
     np1 = tabs.shape[1]
-    loinv = jnp.concatenate([lo, inv], axis=1)            # [N, 2F]
+    loinv = _split3_bf16(jnp.concatenate([lo, inv], axis=1),
+                         axis=1)                          # [N, 6F] bf16
     kern = functools.partial(_kernel, n_prev=n_prev, n_nodes=n_nodes, F=F,
                              W=W, tile=tile, n_row_tiles=n_row_tiles,
                              level_base=level_base, mxu_dtype=mxu_dtype)
@@ -169,8 +214,8 @@ def adaptive_level_tpu(x, nid, ghw, tables, lo, inv, n_prev: int,
             pl.BlockSpec((tile, F), lambda r: (r, 0)),
             pl.BlockSpec((1, tile), lambda r: (0, r)),
             pl.BlockSpec((3, tile), lambda r: (0, r)),
-            pl.BlockSpec((4, np1), lambda r: (0, 0)),
-            pl.BlockSpec((n_nodes, 2 * F), lambda r: (0, 0)),
+            pl.BlockSpec((12, np1), lambda r: (0, 0)),
+            pl.BlockSpec((n_nodes, 6 * F), lambda r: (0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, tile), lambda r: (0, r)),
@@ -312,7 +357,7 @@ def leaf_totals_tpu(x, nid, ghw, tables, n_prev: int, n_nodes: int,
             pl.BlockSpec((tile, F), lambda r: (r, 0)),
             pl.BlockSpec((1, tile), lambda r: (0, r)),
             pl.BlockSpec((3, tile), lambda r: (0, r)),
-            pl.BlockSpec((4, np1), lambda r: (0, 0)),
+            pl.BlockSpec((12, np1), lambda r: (0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, tile), lambda r: (0, r)),

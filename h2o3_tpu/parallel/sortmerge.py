@@ -1,0 +1,185 @@
+"""On-device distributed sort / merge primitives.
+
+Reference: the MSB radix sort-merge stack water/rapids/RadixOrder.java:20
+(per-node MSB histogram → SplitByMSBLocal shuffle → per-MSB sorts),
+water/rapids/Merge.java:27 + BinaryMerge.java (sorted-run joins).
+
+TPU re-design (SURVEY §2.5 'distributed shuffle'): keys are mapped to
+ORDER-PRESERVING unsigned bit patterns (IEEE-754 total-order trick), the
+256-way MSB partition of the reference becomes a P-way partition over
+the mesh 'data' axis chosen from a GLOBAL psum'd histogram of the top
+radix byte, rows move with ONE jax.lax.all_to_all over ICI, and each
+shard finishes with a local on-device sort. Multi-key orders compose by
+iterated stable argsorts (minor → major), the jnp analog of np.lexsort.
+
+Static-shape contract: every (src → dst) exchange lane is padded to the
+full shard length (pads carry the reserved PAD pattern, above every real
+key incl. NaN), so each shard's result is its sorted run followed by
+pads; shard runs are globally ordered. Variable-length compaction
+happens at the host boundary — the same place the reference materializes
+its sorted frame (Merge.java result assembly).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.parallel.mesh import DATA_AXIS, current_mesh, n_data_shards
+
+_PAD = jnp.uint32(0xFFFFFFFF)       # exchange padding: sorts after all
+_NAN = jnp.uint32(0xFFFFFFFE)       # NaN keys: after all reals, before PAD
+
+
+def sortable_bits(x: jnp.ndarray) -> jnp.ndarray:
+    """Map f32 values to uint32 whose unsigned order matches the float
+    total order (sign-flip trick): positives get the sign bit set,
+    negatives get all bits flipped; NaN sorts LAST (the reference sorts
+    NAs last — Merge.java NA handling)."""
+    b = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    flipped = jnp.where(b >> 31 == 0, b | jnp.uint32(0x80000000), ~b)
+    return jnp.where(jnp.isnan(x), _NAN, flipped)
+
+
+def bits_to_float(b: jnp.ndarray) -> jnp.ndarray:
+    pos = (b & jnp.uint32(0x80000000)) != 0
+    restored = jnp.where(pos, b & jnp.uint32(0x7FFFFFFF), ~b)
+    vals = jax.lax.bitcast_convert_type(restored.astype(jnp.uint32),
+                                        jnp.float32)
+    return jnp.where((b == _NAN) | (b == _PAD), jnp.nan, vals)
+
+
+def lexsort_device(keys: Sequence[jnp.ndarray],
+                   ascending: Optional[Sequence[int]] = None) -> jnp.ndarray:
+    """Device multi-key argsort: keys[0] is the PRIMARY key (sort_frame
+    column order). Stable argsorts iterate minor → major so ties keep
+    the prior order — the jnp analog of np.lexsort."""
+    n = keys[0].shape[0]
+    asc = list(ascending) if ascending is not None else [1] * len(keys)
+    order = jnp.arange(n)
+    for k, a in zip(reversed(list(keys)), reversed(asc)):
+        kb = sortable_bits(jnp.asarray(k))
+        if not a:
+            # descending, NAs still last: invert finite order only
+            kb = jnp.where(kb >= _NAN, kb, ~kb)
+        order = order[jnp.argsort(kb[order], stable=True)]
+    return order
+
+
+# ---------------- distributed radix exchange ---------------------------
+
+def _exchange_sorted(xs, payload, P: int, per: int):
+    """Shard body: globally partition by key and locally sort.
+
+    Returns (keys [P*per], payload [P*per] or None) — the shard's sorted
+    run with PAD tails. ``payload`` rides the same exchange (row ids for
+    argsort-style use)."""
+    bits = sortable_bits(xs)
+    msb = (bits >> 24).astype(jnp.int32)
+    hist = jnp.zeros(256, jnp.int32).at[msb].add(1)
+    hist = jax.lax.psum(hist, DATA_AXIS)             # global MSB histogram
+    csum = jnp.cumsum(hist)
+    total = csum[-1]
+    # shard i owns MSB values (split[i-1], split[i]]: chosen so row
+    # counts balance (RadixOrder.java MSB bucket balancing)
+    targets = (jnp.arange(1, P) * total) // P
+    split_msb = jnp.searchsorted(csum, targets, side="left")
+    dst = jnp.searchsorted(split_msb, msb, side="left").astype(jnp.int32)
+    dst = jnp.clip(dst, 0, P - 1)
+    order = jnp.argsort(dst, stable=True)
+    bits_o = bits[order]
+    dst_o = dst[order]
+    start = jnp.searchsorted(dst_o, jnp.arange(P), side="left")
+    local_pos = jnp.arange(bits_o.shape[0]) - start[dst_o]
+    send = jnp.full((P, per), _PAD)
+    send = send.at[dst_o, local_pos].set(bits_o)
+    recv = jax.lax.all_to_all(send, DATA_AXIS, split_axis=0,
+                              concat_axis=0, tiled=False).reshape(-1)
+    if payload is None:
+        return jnp.sort(recv), None
+    pay_o = payload[order]
+    spay = jnp.full((P, per), jnp.int32(-1))
+    spay = spay.at[dst_o, local_pos].set(pay_o)
+    rpay = jax.lax.all_to_all(spay, DATA_AXIS, split_axis=0,
+                              concat_axis=0, tiled=False).reshape(-1)
+    so = jnp.argsort(recv, stable=True)
+    return recv[so], rpay[so]
+
+
+def distributed_sort(x: jnp.ndarray, mesh=None) -> np.ndarray:
+    """Globally sort a (row-sharded) f32 array: ICI all_to_all radix
+    exchange + per-shard device sorts; host compacts the variable-length
+    shard runs. NaNs sort last."""
+    mesh = mesh or current_mesh()
+    P = n_data_shards(mesh)
+    n = x.shape[0]
+    if P == 1 or n % P != 0:
+        return np.asarray(jax.device_get(jnp.sort(jnp.asarray(x))))
+    per = n // P
+    from jax.sharding import PartitionSpec as Ps
+
+    fn = jax.jit(jax.shard_map(
+        partial(_exchange_sorted, payload=None, P=P, per=per),
+        mesh=mesh, in_specs=Ps(DATA_AXIS),
+        out_specs=(Ps(DATA_AXIS), None), check_vma=False))
+    keys, _ = fn(jnp.asarray(x))
+    host = np.asarray(jax.device_get(keys)).reshape(P, P * per)
+    parts = [h[h != 0xFFFFFFFF] for h in host]       # drop PAD, keep order
+    bits = np.concatenate(parts)
+    return np.asarray(jax.device_get(bits_to_float(jnp.asarray(bits))))
+
+
+def distributed_argsort(x: jnp.ndarray, mesh=None) -> np.ndarray:
+    """Global ORDER indices (stable within equal keys per shard run) via
+    the same exchange, with row ids riding as payload — what sort_frame
+    needs to gather full rows (Merge.java moves whole rows; moving ids
+    and gathering once is the single-controller shortcut)."""
+    mesh = mesh or current_mesh()
+    P = n_data_shards(mesh)
+    n = x.shape[0]
+    if P == 1 or n % P != 0:
+        kb = sortable_bits(jnp.asarray(x))
+        return np.asarray(jax.device_get(jnp.argsort(kb, stable=True)))
+    per = n // P
+    from jax.sharding import PartitionSpec as Ps
+    ids = jnp.arange(n, dtype=jnp.int32)
+
+    def body(xs, ids_s):
+        shard = jax.lax.axis_index(DATA_AXIS)
+        k, p = _exchange_sorted(xs, ids_s, P, per)
+        return k, p
+
+    fn = jax.jit(jax.shard_map(body, mesh=mesh,
+                               in_specs=(Ps(DATA_AXIS), Ps(DATA_AXIS)),
+                               out_specs=(Ps(DATA_AXIS), Ps(DATA_AXIS)),
+                               check_vma=False))
+    keys, pay = fn(jnp.asarray(x), ids)
+    kh = np.asarray(jax.device_get(keys)).reshape(P, P * per)
+    ph = np.asarray(jax.device_get(pay)).reshape(P, P * per)
+    parts = [p[k != 0xFFFFFFFF] for k, p in zip(kh, ph)]
+    return np.concatenate(parts).astype(np.int64)
+
+
+# ---------------- device merge (sorted-run join) -----------------------
+
+def join_indices_unique(left_keys, right_keys, nright: int) -> np.ndarray:
+    """Join row indices for UNIQUE right keys (the common FK join):
+    sort right once, searchsorted the left probes — both on device
+    (BinaryMerge.java's sorted-run probe without the row movement).
+    Returns ri [nl] int32, -1 where unmatched."""
+    rb = sortable_bits(jnp.asarray(right_keys))
+    lb = sortable_bits(jnp.asarray(left_keys))
+
+    @jax.jit
+    def probe(rb, lb):
+        order = jnp.argsort(rb)
+        rb_s = rb[order]
+        pos = jnp.searchsorted(rb_s, lb)
+        pos_c = jnp.clip(pos, 0, nright - 1)
+        hit = (rb_s[pos_c] == lb) & (lb != _NAN)
+        return jnp.where(hit, order[pos_c].astype(jnp.int32), -1)
+
+    return np.asarray(jax.device_get(probe(rb, lb)))

@@ -270,6 +270,35 @@ def merge(left: Frame, right: Frame, by_left: Sequence[str],
             return np.asarray(v.to_strings()[: fr.nrow], dtype=object)
         return np.asarray(v.to_numpy()[: fr.nrow])
 
+    # DEVICE fast path (BinaryMerge.java sorted-run probe): single
+    # numeric key, unique right keys, no right-outer — sort + searchsorted
+    # on device, only the index gather comes back to host
+    if (len(by_left) == 1 and not all_y
+            and left.vec(by_left[0]).type in (T_INT, T_REAL)
+            and right.vec(by_right[0]).type in (T_INT, T_REAL)
+            and getattr(left.vec(by_left[0]), "host_data", None) is None
+            and getattr(right.vec(by_right[0]), "host_data", None) is None):
+        rvals = np.asarray(right.vec(by_right[0]).to_numpy()[:nr])
+        if len(np.unique(rvals[np.isfinite(rvals)])) == np.isfinite(rvals).sum():
+            from h2o3_tpu.parallel.sortmerge import join_indices_unique
+            ri_dev = join_indices_unique(
+                left.vec(by_left[0]).as_float()[:nl],
+                right.vec(by_right[0]).as_float()[:nr], nr)
+            if all_x:
+                li_a = np.arange(nl, dtype=np.int64)
+                ri_a = ri_dev.astype(np.int64)
+            else:
+                keep = ri_dev >= 0
+                li_a = np.nonzero(keep)[0].astype(np.int64)
+                ri_a = ri_dev[keep].astype(np.int64)
+            names = list(left.names) + [n for n in right.names
+                                        if n not in by_right]
+            vecs = [_take_vec(left.vec(n), li_a, left.nrow)
+                    for n in left.names]
+            vecs += [_take_vec(right.vec(n), ri_a, right.nrow)
+                     for n in right.names if n not in by_right]
+            return Frame(names, vecs)
+
     lk = [key_col(left, n) for n in by_left]
     rk = [key_col(right, n) for n in by_right]
     lkey = list(zip(*lk)) if lk else [()] * nl
@@ -357,15 +386,40 @@ def _take_vec(v: Vec, idx: np.ndarray, nrow: int) -> Vec:
 
 def sort_frame(fr: Frame, cols: Sequence[Union[int, str]],
                ascending: Optional[Sequence[int]] = None) -> Frame:
+    """Sort (water/rapids/Merge.java sort → RadixOrder). Numeric keys
+    sort ON DEVICE: single-key multi-shard goes through the distributed
+    radix all_to_all exchange (parallel/sortmerge.py); multi-key uses
+    the device lexsort. Strings fall back to host lexsort."""
     names = [fr.names[int(c)] if isinstance(c, (int, float)) else c
              for c in cols]
     nrow = fr.nrow
     asc = list(ascending) if ascending else [1] * len(names)
-    keys = []
-    for n, a in zip(reversed(names), reversed(asc)):
-        col = np.asarray(fr.vec(n).to_numpy()[:nrow])
-        keys.append(col if a else -col)
-    order = np.lexsort(keys) if keys else np.arange(nrow)
+    numeric = all(fr.vec(n).type in (T_INT, T_REAL, "time", T_ENUM)
+                  for n in names)
+    # f32-exactness guard: keys wider than the f32 mantissa (big IDs,
+    # epoch millis) would collide in the bit-pattern sort
+    if numeric:
+        for n in names:
+            v = fr.vec(n)
+            if getattr(v, "host_data", None) is not None:
+                numeric = False
+                break
+    if numeric and names:
+        from h2o3_tpu.parallel.sortmerge import (distributed_argsort,
+                                                 lexsort_device)
+        from h2o3_tpu.parallel.mesh import current_mesh, n_data_shards
+        key_dev = [fr.vec(n).as_float()[:nrow] for n in names]
+        if len(names) == 1 and asc[0] and n_data_shards(current_mesh()) > 1:
+            order = distributed_argsort(key_dev[0])
+        else:
+            order = np.asarray(jax.device_get(
+                lexsort_device(key_dev, asc)))
+    else:
+        keys = []
+        for n, a in zip(reversed(names), reversed(asc)):
+            col = np.asarray(fr.vec(n).to_numpy()[:nrow])
+            keys.append(col if a else -col)
+        order = np.lexsort(keys) if keys else np.arange(nrow)
     return fr.rows_by_index(order) if hasattr(fr, "rows_by_index") else \
         _take_frame(fr, order)
 
@@ -432,6 +486,85 @@ def _apply(op: str, args, env: Env):
     if op == "rm":
         dkv.remove(args[0][1])
         return 1.0
+    if op == "ls":
+        # AstLs (ast/prims/misc/AstLs.java): frame of DKV keys
+        keys = sorted(dkv.keys())
+        return Frame(["key"], [Vec.from_numpy(
+            np.asarray(keys, dtype=object), vtype=T_STR)])
+    if op == ":=":
+        # AstRectangleAssign (ast/prims/assign/AstRectangleAssign.java):
+        # (:= dst src col_expr row_expr) -> new frame with the rectangle
+        # overwritten; src is a frame, scalar, or string; [] rows = all
+        dst = ev(0)
+        src = _eval(args[1], env)
+        cols = _eval(args[2], env)
+        rows = _eval(args[3], env) if len(args) > 3 else []
+        cidx = _sel_indices(cols, dst.ncol, dst.names)
+        if isinstance(rows, Frame):
+            rmask = np.asarray(rows.vec(0).to_numpy()[: dst.nrow]) != 0
+            ridx = np.nonzero(rmask)[0]
+        elif rows in ([], None):
+            ridx = None                       # all rows
+        else:
+            ridx = _sel_indices(rows, dst.nrow)
+        new_vecs = [dst.vec(i) for i in range(dst.ncol)]
+        for j, ci in enumerate(cidx):
+            ci = int(ci)
+            if isinstance(src, Frame):
+                sv = src.vec(min(j, src.ncol - 1))
+                if ridx is None:
+                    new_vecs[ci] = sv
+                    continue
+                sarr = np.asarray(sv.to_numpy(), dtype=np.float64)
+                dom = sv.domain
+            else:
+                if isinstance(src, str):
+                    old = new_vecs[ci]
+                    dom = list(old.domain or [])
+                    if src not in dom:
+                        dom.append(src)
+                    code = float(dom.index(src))
+                    sarr = np.full(dst.nrow if ridx is None else len(ridx),
+                                   code)
+                else:
+                    sarr = np.full(dst.nrow if ridx is None else len(ridx),
+                                   np.nan if src is None else float(src))
+                    dom = new_vecs[ci].domain
+            darr = np.asarray(new_vecs[ci].to_numpy(),
+                              dtype=np.float64).copy()
+            if ridx is None:
+                darr[:] = sarr[: len(darr)]
+            else:
+                darr[ridx] = (sarr[: len(ridx)] if np.ndim(sarr) else sarr)
+            if dom:
+                codes = np.where(np.isfinite(darr), darr, -1).astype(np.int32)
+                new_vecs[ci] = Vec.from_numpy(codes, vtype=T_ENUM,
+                                              domain=[str(d) for d in dom])
+            else:
+                new_vecs[ci] = Vec.from_numpy(darr)
+        return Frame(list(dst.names), new_vecs)
+    if op == "append":
+        # AstAppend: (append dst src colName)+ -> frame with new columns
+        dst = ev(0)
+        names = list(dst.names)
+        vecs = [dst.vec(i) for i in range(dst.ncol)]
+        i = 1
+        while i + 1 < len(args):
+            src = _eval(args[i], env)
+            cname = _eval(args[i + 1], env)
+            if isinstance(src, Frame):
+                v = src.vec(0)
+            else:
+                arr = np.full(dst.nrow,
+                              np.nan if src is None else float(src))
+                v = Vec.from_numpy(arr)
+            if cname in names:
+                vecs[names.index(cname)] = v
+            else:
+                names.append(str(cname))
+                vecs.append(v)
+            i += 2
+        return Frame(names, vecs)
     if op in _BINOPS:
         return _map_elementwise(_BINOPS[op], ev(0), ev(1))
     if op in _UNOPS:
@@ -455,8 +588,58 @@ def _apply(op: str, args, env: Env):
         else:
             idx = _sel_indices(sel, fr.nrow)
         return _take_frame(fr, idx)
-    if op in ("mean", "sum", "min", "max", "sd", "sdev", "median", "nrow",
-              "ncol"):
+    if op in ("mean", "median"):
+        # frame-valued reducers (water/rapids/ast/prims/reducers/AstMean.java,
+        # AstMedian.java): (op frame na_rm axis) -> [1 x ncols] frame
+        # (axis=0) or [nrows x 1] frame (axis=1); enum/string columns -> NA
+        fr = ev(0)
+        na_rm = bool(_eval(args[1], env)) if len(args) > 1 else True
+        axis = int(_eval(args[2], env) or 0) if len(args) > 2 else 0
+        fn = ((lambda x, ok: jnp.where(ok, x, 0).sum() / ok.sum())
+              if op == "mean" else (lambda x, ok: jnp.median(x[ok])))
+        if axis == 1:
+            num = [i for i in range(fr.ncol)
+                   if fr.vec(i).type in (T_INT, T_REAL)]
+            mat = np.stack([np.asarray(fr.vec(i).to_numpy(),
+                                       dtype=np.float64) for i in num])
+            ok = np.isfinite(mat)
+            if op == "mean":
+                s = np.where(ok, mat, 0).sum(axis=0)
+                c = ok.sum(axis=0)
+                vals = np.where(c > 0, s / np.maximum(c, 1), np.nan)
+            else:
+                vals = np.array([np.median(col[okc]) if okc.any() else np.nan
+                                 for col, okc in zip(mat.T, ok.T)])
+            if not na_rm:
+                vals = np.where(ok.all(axis=0), vals, np.nan)
+            return Frame([op], [Vec.from_numpy(vals.astype(np.float64))])
+        vals = []
+        for i in range(fr.ncol):
+            v = fr.vec(i)
+            if v.type not in (T_INT, T_REAL):
+                vals.append(np.nan)
+                continue
+            x = np.asarray(v.to_numpy(), dtype=np.float64)
+            ok = np.isfinite(x)
+            if not ok.any() or (not na_rm and not ok.all()):
+                vals.append(np.nan)
+            else:
+                vals.append(float(fn(jnp.asarray(x), jnp.asarray(ok))))
+        return Frame(list(fr.names),
+                     [Vec.from_numpy(np.asarray([val], dtype=np.float64))
+                      for val in vals])
+    if op == "getrow":
+        # AstGetrow: single-row frame -> row of numbers
+        fr = ev(0)
+        if fr.nrow != 1:
+            raise ValueError(f"getrow requires a 1-row frame, got {fr.nrow}")
+        out = []
+        for i in range(fr.ncol):
+            val = fr.vec(i).to_numpy()[0]
+            val = float(val)
+            out.append(None if not math.isfinite(val) else val)
+        return out
+    if op in ("sum", "min", "max", "sd", "sdev", "nrow", "ncol"):
         fr = ev(0)
         if op == "nrow":
             return float(fr.nrow)
@@ -464,12 +647,10 @@ def _apply(op: str, args, env: Env):
             return float(fr.ncol)
         na_rm = bool(_eval(args[1], env)) if len(args) > 1 else True
         fns = {
-            "mean": lambda x, ok: jnp.where(ok, x, 0).sum() / ok.sum(),
             "sum": lambda x, ok: jnp.where(ok, x, 0).sum(),
             "min": lambda x, ok: jnp.where(ok, x, jnp.inf).min(),
             "max": lambda x, ok: jnp.where(ok, x, -jnp.inf).max(),
             "sd": _sd_fn, "sdev": _sd_fn,
-            "median": lambda x, ok: jnp.median(x[ok]),
         }
         out = _reduce(fns[op], fr, na_rm)
         return out
@@ -562,6 +743,29 @@ def _apply(op: str, args, env: Env):
         for i, nm in zip(idx, names):
             new_names[int(i)] = nm
         return Frame(new_names, list(fr.vecs))
+    if op in ("is.factor", "is.numeric", "is.character", "anyfactor"):
+        # AstIsFactor/AstIsNumeric/AstIsCharacter/AstAnyFactor: per-column
+        # 0/1 flags (single value for 1-col frames)
+        fr = ev(0)
+        tests = {"is.factor": lambda v: v.type == T_ENUM,
+                 "is.numeric": lambda v: v.type in (T_INT, T_REAL),
+                 "is.character": lambda v: v.type == T_STR}
+        if op == "anyfactor":
+            return float(any(fr.vec(i).type == T_ENUM
+                             for i in range(fr.ncol)))
+        # always a list: h2o-py iterates the result (frame.py isfactor)
+        return [float(tests[op](fr.vec(i))) for i in range(fr.ncol)]
+    if op == "levels":
+        # AstLevels: domain values as a [card x ncol] string frame
+        fr = ev(0)
+        cols = []
+        maxlen = max([len(fr.vec(i).domain or []) for i in range(fr.ncol)]
+                     or [0])
+        for i in range(fr.ncol):
+            dom = list(fr.vec(i).domain or [])
+            dom += [""] * (maxlen - len(dom))
+            cols.append(Vec.from_numpy(np.asarray(dom, dtype=object)))
+        return Frame(list(fr.names), cols)
     if op == "as.factor" or op == "asfactor":
         fr = ev(0)
         return Frame(list(fr.names), [fr.vec(n).asfactor() for n in fr.names])

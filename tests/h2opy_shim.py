@@ -1,0 +1,51 @@
+"""Import the UNMODIFIED reference h2o-py client package.
+
+The reference client (h2o-py/h2o/backend/connection.py) is pure REST —
+it only needs `requests` plus the py2/3 compat package `future`, which
+is not in this image. The shim below provides the handful of names
+h2o-py pulls from `future` (all trivial on py3) WITHOUT modifying the
+reference tree; everything else is the client exactly as shipped.
+"""
+import sys
+import types
+
+H2O_PY_PATH = "/root/reference/h2o-py"
+
+
+def _mkmod(name, **attrs):
+    m = types.ModuleType(name)
+    for k, v in attrs.items():
+        setattr(m, k, v)
+    sys.modules[name] = m
+    return m
+
+
+def install():
+    if "future" not in sys.modules:
+        def with_metaclass(meta, *bases):
+            return meta("NewBase", bases or (object,), {})
+
+        fut = _mkmod("future")
+        fut.__path__ = []
+        fut.utils = _mkmod(
+            "future.utils", PY2=False, PY3=True,
+            with_metaclass=with_metaclass,
+            viewitems=lambda d: d.items(), viewkeys=lambda d: d.keys(),
+            viewvalues=lambda d: d.values())
+        fb = _mkmod("future.builtins")
+        fb.__path__ = []
+        _mkmod("future.builtins.iterators", range=range, filter=filter,
+               map=map, zip=zip)
+        _mkmod("future.builtins.misc", chr=chr, input=input, open=open,
+               next=next, round=round, super=super)
+    if H2O_PY_PATH not in sys.path:
+        sys.path.insert(0, H2O_PY_PATH)
+
+
+def import_h2o():
+    install()
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", SyntaxWarning)
+        import h2o
+    return h2o

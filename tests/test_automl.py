@@ -42,6 +42,83 @@ def test_automl_binomial_with_budget():
     assert pred.nrow == fr.nrow
 
 
+def test_step_registry_and_custom_plan():
+    """ModelingStepsRegistry SPI: the plan is data; custom providers and
+    inline StepDefinitions run through the same driver."""
+    from h2o3_tpu.automl import register_modeling_steps
+    calls = []
+
+    def my_steps(ctx):
+        calls.append(ctx["nclasses"])
+        return [{"algo": "gbm", "id": "MY_gbm_1",
+                 "params": {"ntrees": 5, "max_depth": 3}}]
+
+    register_modeling_steps("my_provider", my_steps)
+    fr = _task(n=600)
+    aml = H2OAutoML(max_models=2, nfolds=2, seed=2,
+                    modeling_plan=["my_provider",
+                                   {"algo": "drf", "id": "inline_drf",
+                                    "params": {"ntrees": 5, "max_depth": 3}}])
+    aml.train(y="y", training_frame=fr)
+    assert calls == [2]
+    steps = {m.output["automl_step"] for m in aml.models
+             if m.algo != "stackedensemble"}
+    assert "MY_gbm_1" in steps and "inline_drf" in steps
+
+
+def test_leaderboard_single_metric_source():
+    """Leaderboard refuses mixed metric sources (Leaderboard.java
+    sort-metric consistency): all rows rank on the same source."""
+    fr = _task(n=600, seed=9)
+    aml = H2OAutoML(max_models=2, nfolds=2, seed=3,
+                    include_algos=["gbm", "drf"])
+    aml.train(y="y", training_frame=fr)
+    lb = aml.leaderboard
+    sources = {r["metric_source"] for r in lb}
+    assert len(sources) == 1
+    assert lb.source in ("xval", "leaderboard", "valid", "train")
+    # leaderboard_frame forces scoring every model on that one frame
+    lb_fr = _task(n=300, seed=11)
+    aml2 = H2OAutoML(max_models=2, nfolds=2, seed=3,
+                     include_algos=["gbm", "drf"])
+    aml2.train(y="y", training_frame=fr, leaderboard_frame=lb_fr)
+    assert aml2.leaderboard.source == "leaderboard"
+    f = aml2.leaderboard.to_frame()
+    assert f.nrow == len(aml2.models)
+
+
+def test_exploitation_phase():
+    fr = _task(n=600, seed=4)
+    aml = H2OAutoML(max_runtime_secs=240, max_models=None, nfolds=2, seed=5,
+                    include_algos=["gbm"], exploitation_ratio=0.3,
+                    modeling_plan=["gbm"])
+    aml.train(y="y", training_frame=fr)
+    steps = {m.output["automl_step"] for m in aml.models}
+    assert "GBM_lr_annealing" in steps, steps
+    stages = {e["stage"] for e in aml.event_log}
+    assert "exploitation" in stages
+
+
+def test_multinomial_plan_keeps_glm_and_se():
+    """Round-3 gap closed: multinomial GLM stays in the plan and the
+    multinomial StackedEnsemble trains (was silently dropped)."""
+    rng = np.random.default_rng(6)
+    n = 900
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    W = rng.normal(size=(3, 3)).astype(np.float32) * 2
+    yv = np.argmax(X @ W + rng.gumbel(size=(n, 3)), axis=1)
+    cols = {f"x{i}": X[:, i] for i in range(3)}
+    cols["y"] = np.array(["a", "b", "c"], dtype=object)[yv]
+    fr = h2o.Frame.from_numpy(cols)
+    aml = H2OAutoML(max_models=3, nfolds=2, seed=7,
+                    include_algos=["gbm", "glm"])
+    aml.train(y="y", training_frame=fr)
+    fams = {m.output.get("automl_family") for m in aml.models}
+    assert "glm" in fams, aml.event_log
+    assert any(m.algo == "stackedensemble" for m in aml.models), \
+        [e for e in aml.event_log if e["stage"] == "skip"]
+
+
 def test_automl_exclude_algos_and_regression():
     rng = np.random.default_rng(3)
     n = 800

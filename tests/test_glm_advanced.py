@@ -1,0 +1,157 @@
+"""GLM depth: L-BFGS solver, p-values/std errors, wide sharded path,
+multinomial StackedEnsemble.
+
+Reference: hex/optimization/L_BFGS.java (solver), hex/glm/GLMModel
+computePValues (inference), SURVEY §7.1.7 wide Criteo path.
+"""
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+
+
+def _binomial_frame(n=2000, f=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    beta = np.linspace(-1.0, 1.0, f)
+    logit = X @ beta + 0.3
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+    cols = {f"x{i}": X[:, i] for i in range(f)}
+    cols["y"] = y
+    return h2o.Frame.from_numpy(cols), X, y
+
+
+def test_lbfgs_matches_irlsm():
+    fr, X, y = _binomial_frame()
+    m_ir = H2OGeneralizedLinearEstimator(family="binomial", Lambda=0.0,
+                                         solver="IRLSM")
+    m_ir.train(y="y", training_frame=fr)
+    m_lb = H2OGeneralizedLinearEstimator(family="binomial", Lambda=0.0,
+                                         solver="L_BFGS")
+    m_lb.train(y="y", training_frame=fr)
+    c_ir = m_ir.model.coef()
+    c_lb = m_lb.model.coef()
+    for k in c_ir:
+        assert abs(c_ir[k] - c_lb[k]) < 5e-3, (k, c_ir[k], c_lb[k])
+
+
+def test_lbfgs_l1_rejected():
+    fr, _, _ = _binomial_frame(n=200)
+    est = H2OGeneralizedLinearEstimator(family="binomial", Lambda=0.1,
+                                        alpha=0.5, solver="L_BFGS")
+    with pytest.raises(RuntimeError, match="L_BFGS"):
+        est.train(y="y", training_frame=fr)
+
+
+def _numpy_logistic_inference(X, y):
+    """Independent IRLS + Wald inference (textbook logistic regression)."""
+    n, f = X.shape
+    Xr = np.concatenate([X, np.ones((n, 1))], axis=1)
+    beta = np.zeros(f + 1)
+    for _ in range(60):
+        eta = Xr @ beta
+        mu = 1 / (1 + np.exp(-eta))
+        w = np.maximum(mu * (1 - mu), 1e-12)
+        z = eta + (y - mu) / w
+        G = Xr.T @ (w[:, None] * Xr)
+        beta_new = np.linalg.solve(G, Xr.T @ (w * z))
+        if np.max(np.abs(beta_new - beta)) < 1e-10:
+            beta = beta_new
+            break
+        beta = beta_new
+    cov = np.linalg.inv(G)
+    se = np.sqrt(np.diag(cov))
+    zval = beta / se
+    from scipy import stats
+    pval = 2 * stats.norm.sf(np.abs(zval))
+    return beta, se, pval
+
+
+def test_p_values_match_textbook_irls():
+    fr, X, y = _binomial_frame(n=500, f=4, seed=3)
+    est = H2OGeneralizedLinearEstimator(family="binomial", Lambda=0.0,
+                                        standardize=False,
+                                        compute_p_values=True)
+    est.train(y="y", training_frame=fr)
+    m = est.model
+    beta_np, se_np, p_np = _numpy_logistic_inference(
+        X.astype(np.float64), y.astype(np.float64))
+    names = [f"x{i}" for i in range(4)] + ["Intercept"]
+    coefs = m.coef()
+    pv = m.coef_with_p_values()
+    for i, nm in enumerate(names):
+        assert abs(coefs[nm] - beta_np[i]) < 2e-3, (nm, coefs[nm], beta_np[i])
+        assert abs(pv["std_errs"][nm] - se_np[i]) < 2e-2 * max(se_np[i], 1), \
+            (nm, pv["std_errs"][nm], se_np[i])
+        assert abs(pv["p_values"][nm] - p_np[i]) < 5e-2, \
+            (nm, pv["p_values"][nm], p_np[i])
+
+
+def test_p_values_require_no_l1():
+    fr, _, _ = _binomial_frame(n=200)
+    est = H2OGeneralizedLinearEstimator(family="binomial", Lambda=0.1,
+                                        alpha=0.5, compute_p_values=True)
+    with pytest.raises(RuntimeError, match="p-values"):
+        est.train(y="y", training_frame=fr)
+
+
+def test_lbfgs_wide_sharded():
+    """10k-feature wide problem on the (data x model) mesh: the design is
+    feature-sharded for the L-BFGS matvecs (SURVEY §7.1.7)."""
+    rng = np.random.default_rng(7)
+    n, f = 2048, 10_000
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    beta = np.zeros(f, np.float32)
+    beta[:20] = np.linspace(-1, 1, 20)
+    logit = X @ beta
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+    cols = {f"x{i}": X[:, i] for i in range(f)}
+    cols["y"] = y
+    fr = h2o.Frame.from_numpy(cols)
+    est = H2OGeneralizedLinearEstimator(family="binomial", Lambda=1e-4,
+                                        alpha=0.0, solver="L_BFGS",
+                                        standardize=False,
+                                        max_iterations=40)
+    est.train(y="y", training_frame=fr)
+    assert est.job.status == "DONE", est.job.exception
+    m = est.model
+    coefs = m.coef()
+    # signal coefficients recovered with the right sign
+    assert coefs["x0"] < -0.2 and coefs["x19"] > 0.2
+    auc = m.training_metrics.auc
+    assert auc > 0.8, auc
+
+
+def test_multinomial_stacked_ensemble():
+    rng = np.random.default_rng(5)
+    n, f, k = 1200, 5, 3
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    W = rng.normal(size=(f, k)).astype(np.float32) * 1.5
+    logits = X @ W
+    y = np.argmax(logits + rng.gumbel(size=(n, k)), axis=1)
+    cols = {f"x{i}": X[:, i] for i in range(f)}
+    cols["y"] = np.asarray([f"c{v}" for v in y], dtype=object)
+    fr = h2o.Frame.from_numpy(cols)
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    from h2o3_tpu.models.drf import H2ORandomForestEstimator
+    from h2o3_tpu.models.ensemble import H2OStackedEnsembleEstimator
+    g = H2OGradientBoostingEstimator(ntrees=8, max_depth=3, nfolds=3,
+                                     seed=1, min_rows=1.0,
+                                     keep_cross_validation_predictions=True)
+    g.train(y="y", training_frame=fr)
+    d = H2ORandomForestEstimator(ntrees=8, max_depth=3, nfolds=3, seed=2,
+                                 min_rows=1.0,
+                                 keep_cross_validation_predictions=True)
+    d.train(y="y", training_frame=fr)
+    se = H2OStackedEnsembleEstimator(base_models=[g.model, d.model])
+    se.train(y="y", training_frame=fr)
+    assert se.job.status == "DONE", se.job.exception
+    m = se.model
+    assert m.meta_model.family == "multinomial"
+    pred = m.predict(fr)
+    assert pred.ncol == 1 + k
+    lab = np.asarray([f"c{v}" for v in y])
+    got = np.asarray(pred.vec("predict").to_strings()[:n])
+    acc = (got == lab).mean()
+    assert acc > 0.6, acc

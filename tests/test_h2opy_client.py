@@ -1,0 +1,112 @@
+"""The UNMODIFIED reference h2o-py client against the live REST server.
+
+North star (SURVEY §1 L13, §7.1.6): front-ends unchanged. This test
+imports the real client package from /root/reference/h2o-py (plus a
+trivial py3 shim for its `future` dependency, h2opy_shim.py), connects
+over real HTTP, and drives the happy path the reference clients use:
+connect → import_file → parse → frame ops (Rapids) → GBM + GLM train →
+model_performance → predict → save/load → ls/remove.
+
+Reference call chain: h2o-py/h2o/backend/connection.py (request),
+h2o-py/h2o/estimators/estimator_base.py:186-200 (train → POST
+/3/ModelBuilders/{algo} + job poll), h2o-py/h2o/expr.py:259 (Rapids).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import h2opy_shim
+
+
+@pytest.fixture(scope="module")
+def client():
+    import h2o3_tpu
+    h2o3_tpu.init()
+    from h2o3_tpu.api import start_server
+    srv = start_server(port=0)
+    h2o = h2opy_shim.import_h2o()
+    h2o.connect(url=f"http://127.0.0.1:{srv.port}", verbose=False)
+    yield h2o
+    try:
+        h2o.connection().close()
+    except Exception:
+        pass
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def prostate(client):
+    data = os.path.join(h2opy_shim.H2O_PY_PATH, "h2o", "h2o_data",
+                        "prostate.csv")
+    fr = client.import_file(data)
+    fr["CAPSULE"] = fr["CAPSULE"].asfactor()
+    return fr
+
+
+def test_connect_and_cluster(client):
+    cl = client.cluster()
+    assert cl.cloud_healthy
+    assert "tpu" in cl.version
+
+
+def test_import_and_frame_ops(client, prostate):
+    fr = prostate
+    assert fr.dim == [380, 9]
+    assert fr.names[:2] == ["ID", "CAPSULE"]
+    assert abs(fr["AGE"].mean()[0] - 66.0394) < 1e-2
+    sub = fr[fr["AGE"] > 65, :]
+    assert 0 < sub.nrow < 380
+    assert fr["CAPSULE"].isfactor() == [True]
+    # as_data_frame round-trips over /3/DownloadDataset CSV
+    pdf = fr.as_data_frame(use_pandas=False)
+    assert pdf[0][0] == "ID" and len(pdf) == 381
+
+
+def test_gbm_train_perf_predict(client, prostate):
+    from h2o.estimators import H2OGradientBoostingEstimator
+    gbm = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=42)
+    gbm.train(y="CAPSULE", x=["AGE", "RACE", "PSA", "GLEASON"],
+              training_frame=prostate)
+    perf = gbm.model_performance(prostate)
+    assert perf.auc() > 0.7
+    assert perf.logloss() > 0
+    pred = gbm.predict(prostate)
+    assert pred.dim == [380, 3]
+    assert pred.names == ["predict", "p0", "p1"]
+    vi = gbm.varimp()
+    assert vi and len(vi[0]) == 4
+
+
+def test_glm_train_coef(client, prostate):
+    from h2o.estimators import H2OGeneralizedLinearEstimator
+    glm = H2OGeneralizedLinearEstimator(family="binomial", lambda_=0.0)
+    glm.train(y="CAPSULE", x=["AGE", "RACE", "PSA", "GLEASON"],
+              training_frame=prostate)
+    co = glm.coef()
+    assert set(co) == {"Intercept", "AGE", "RACE", "PSA", "GLEASON"}
+    assert co["GLEASON"] > 0.5          # known-positive effect
+    assert any(abs(v) > 1e-6 for v in co.values())
+
+
+def test_save_load_roundtrip(client, prostate, tmp_path):
+    from h2o.estimators import H2OGradientBoostingEstimator
+    gbm = H2OGradientBoostingEstimator(ntrees=3, max_depth=3, seed=1)
+    gbm.train(y="CAPSULE", x=["AGE", "PSA"], training_frame=prostate)
+    path = client.save_model(gbm, path=str(tmp_path), force=True)
+    assert os.path.exists(path)
+    loaded = client.load_model(path)
+    assert loaded.model_id
+    p1 = gbm.predict(prostate).as_data_frame(use_pandas=False)
+    p2 = loaded.predict(prostate).as_data_frame(use_pandas=False)
+    a1 = np.asarray(p1[1:], dtype=float)
+    a2 = np.asarray(p2[1:], dtype=float)
+    np.testing.assert_allclose(a1, a2, rtol=1e-5)
+
+
+def test_ls_and_remove(client, prostate):
+    keys = client.ls()
+    assert len(keys) > 0
+    tmp = prostate[["AGE"]]
+    tmp.frame_id  # materialize
+    client.remove(tmp)

@@ -34,8 +34,9 @@ def test_mean_and_arithmetic():
     fr = h2o.Frame.from_numpy({"x": np.array([1.0, 2.0, 3.0, np.nan]),
                                "y": np.array([10.0, 20.0, 30.0, 40.0])})
     _reg("fr1", fr)
-    r = exec_rapids("(mean (cols_py fr1 'x') True)")
-    assert r["scalar"] == pytest.approx(2.0)
+    # mean is frame-valued (AstMean semantics); getrow flattens it
+    r = exec_rapids("(getrow (mean (cols_py fr1 'x') True 0))")
+    assert r["scalar"][0] == pytest.approx(2.0)
     r = exec_rapids("(tmp= py_1 (+ (cols_py fr1 'y') 5))")
     out = dkv.get("py_1", "frame")
     np.testing.assert_allclose(out.vec(0).to_numpy(), [15, 25, 35, 45])

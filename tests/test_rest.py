@@ -121,11 +121,15 @@ def test_import_parse_train_predict_flow(server, csv_path):
     # 6. fetch model
     mj = _req(server, "GET", f"/3/Models/{mkey}")["models"][0]
     assert mj["algo"] == "gbm"
-    auc = mj["output"]["training_metrics"]["auc"]
+    # reference field name: ModelMetricsBinomialV3 serializes 'AUC'
+    # (h2o-py metrics_base.py reads _metric_json['AUC'])
+    auc = mj["output"]["training_metrics"]["AUC"]
     assert auc > 0.7, mj["output"]["training_metrics"]
-    # 7. predictions
+    # 7. predictions (async: response carries a pollable job, like the
+    # reference's /4 flow h2o-py wraps in H2OJob)
     pr = _req(server, "POST",
               f"/3/Predictions/models/{mkey}/frames/air.hex", {})
+    _poll(server, pr["job"]["key"]["name"])
     pkey = pr["predictions_frame"]["name"]
     pf = _req(server, "GET", f"/3/Frames/{pkey}")["frames"][0]
     labels = [c["label"] for c in pf["columns"]]
@@ -154,9 +158,9 @@ def test_rest_rapids_and_dkv(server, csv_path):
     if dkv.get_opt("air.hex") is None:
         pytest.skip("parse flow test must run first")
     r = _req(server, "POST", "/99/Rapids",
-             {"ast": "(mean (cols_py air.hex 'dist') True)",
+             {"ast": "(getrow (mean (cols_py air.hex 'dist') True 0))",
               "session_id": "_sid_t"})
-    assert 100 < r["scalar"] < 2000
+    assert 100 < r["scalar"][0] < 2000
     r = _req(server, "POST", "/99/Rapids",
              {"ast": "(tmp= py_9 (rows air.hex (> (cols_py air.hex 'dist')"
                      " 1000)))"})

@@ -1,0 +1,133 @@
+"""Monotone + interaction constraints and histogram_type variants.
+
+Reference: hex/tree/DTree.java Constraints plumbing (monotone),
+GlobalInteractionConstraints (interaction), hex/tree/DHistogram.java:48
+HistogramType.{UniformAdaptive,Random,QuantilesGlobal}.
+"""
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+
+def _mono_frame(n=4000, seed=0):
+    rng = np.random.default_rng(seed)
+    x0 = rng.uniform(-2, 2, n).astype(np.float32)
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+    # y increasing in x0 on average, but with enough noise that an
+    # unconstrained tree produces local decreases
+    y = (2 * x0 + np.sin(4 * x0) + 1.5 * x1 * x2
+         + rng.normal(scale=1.2, size=n)).astype(np.float32)
+    return h2o.Frame.from_numpy({"x0": x0, "x1": x1, "x2": x2, "y": y}), \
+        x0, x1, x2
+
+
+def _sweep_predictions(model, x1v=0.0, x2v=0.0, lo=-2, hi=2, pts=201):
+    xs = np.linspace(lo, hi, pts).astype(np.float32)
+    fr = h2o.Frame.from_numpy({
+        "x0": xs, "x1": np.full(pts, x1v, np.float32),
+        "x2": np.full(pts, x2v, np.float32)})
+    pred = model.predict(fr)
+    return xs, np.asarray(pred.vec("predict").to_numpy()[:pts])
+
+
+def test_monotone_increasing_property():
+    fr, *_ = _mono_frame()
+    est = H2OGradientBoostingEstimator(
+        ntrees=30, max_depth=4, seed=1, min_rows=2.0,
+        monotone_constraints={"x0": 1})
+    est.train(y="y", training_frame=fr)
+    for x1v, x2v in [(0.0, 0.0), (1.0, -1.0), (-0.7, 0.3)]:
+        xs, ps = _sweep_predictions(est.model, x1v, x2v)
+        diffs = np.diff(ps)
+        assert (diffs >= -1e-5).all(), \
+            f"monotone violation at x1={x1v} x2={x2v}: min diff {diffs.min()}"
+    # and the unconstrained model DOES violate (so the test has teeth)
+    est_u = H2OGradientBoostingEstimator(ntrees=30, max_depth=4, seed=1,
+                                         min_rows=2.0)
+    est_u.train(y="y", training_frame=fr)
+    viol = 0
+    for x1v, x2v in [(0.0, 0.0), (1.0, -1.0), (-0.7, 0.3)]:
+        xs, ps = _sweep_predictions(est_u.model, x1v, x2v)
+        viol += int((np.diff(ps) < -1e-5).any())
+    assert viol > 0, "noise level too low to exercise the constraint"
+
+
+def test_monotone_decreasing_property():
+    fr, *_ = _mono_frame(seed=2)
+    est = H2OGradientBoostingEstimator(
+        ntrees=20, max_depth=4, seed=3, min_rows=2.0,
+        monotone_constraints={"x0": -1})
+    est.train(y="y", training_frame=fr)
+    xs, ps = _sweep_predictions(est.model)
+    assert (np.diff(ps) <= 1e-5).all()
+
+
+def test_monotone_rejects_bad_column():
+    fr, *_ = _mono_frame(n=300)
+    est = H2OGradientBoostingEstimator(ntrees=2,
+                                       monotone_constraints={"nope": 1})
+    with pytest.raises(RuntimeError, match="monotone"):
+        est.train(y="y", training_frame=fr)
+
+
+def _tree_feature_paths(model):
+    """All root→leaf feature sets actually used, per tree."""
+    feat = np.asarray(model._feat)
+    is_split = np.asarray(model._is_split)
+    T, M = feat.shape
+    out = []
+    for t in range(T):
+        paths = []
+
+        def walk(node, used):
+            if node >= M or not is_split[t, node]:
+                if used:
+                    paths.append(frozenset(used))
+                return
+            f = int(feat[t, node])
+            walk(2 * node + 1, used | {f})
+            walk(2 * node + 2, used | {f})
+
+        walk(0, set())
+        out.append(paths)
+    return out
+
+
+def test_interaction_constraints_partition_branches():
+    rng = np.random.default_rng(4)
+    n = 3000
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + X[:, 2] * X[:, 3]
+         + 0.1 * rng.normal(size=n)).astype(np.float32)
+    fr = h2o.Frame.from_numpy({f"x{i}": X[:, i] for i in range(4)}
+                              | {"y": y})
+    est = H2OGradientBoostingEstimator(
+        ntrees=10, max_depth=4, seed=5, min_rows=2.0,
+        interaction_constraints=[["x0", "x1"], ["x2", "x3"]])
+    est.train(y="y", training_frame=fr)
+    for paths in _tree_feature_paths(est.model):
+        for used in paths:
+            assert used <= {0, 1} or used <= {2, 3}, \
+                f"branch mixes constraint groups: {sorted(used)}"
+
+
+def test_histogram_type_random_trains():
+    fr, *_ = _mono_frame(n=2000, seed=6)
+    est = H2OGradientBoostingEstimator(ntrees=10, max_depth=4, seed=7,
+                                       histogram_type="random",
+                                       min_rows=2.0)
+    est.train(y="y", training_frame=fr)
+    m = est.model.training_metrics
+    assert m.r2 > 0.3, m.r2
+    # different seeds give different split thresholds (the point of the
+    # randomized grid)
+    est2 = H2OGradientBoostingEstimator(ntrees=10, max_depth=4, seed=8,
+                                        histogram_type="random",
+                                        min_rows=2.0)
+    est2.train(y="y", training_frame=fr)
+    t1 = np.asarray(est.model._thr)
+    t2 = np.asarray(est2.model._thr)
+    assert not np.allclose(t1, t2)

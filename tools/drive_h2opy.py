@@ -1,0 +1,87 @@
+"""Drive the UNMODIFIED h2o-py client against the live REST server.
+
+The north-star integration check (SURVEY.md §1 L13, §7.1.6): the real
+client package from /root/reference/h2o-py, over real HTTP, end to end:
+connect -> import_file -> parse -> frame ops (Rapids) -> GBM + GLM train
+-> predict -> model_performance -> save/load. Run standalone for fast
+iteration; tests/test_h2opy_client.py wraps the same flow in pytest.
+"""
+import faulthandler
+import os
+import sys
+
+faulthandler.dump_traceback_later(240, repeat=True)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests"))
+
+import h2opy_shim
+
+STEP = os.environ.get("STEP", "all")
+
+
+def main():
+    import h2o3_tpu
+    h2o3_tpu.init()
+    from h2o3_tpu.api import start_server
+    srv = start_server(port=0)
+    print(f"server on {srv.port}", flush=True)
+
+    h2o = h2opy_shim.import_h2o()
+    h2o.connect(url=f"http://127.0.0.1:{srv.port}", verbose=False)
+    print("STEP connect OK", flush=True)
+
+    data = os.path.join(h2opy_shim.H2O_PY_PATH, "h2o", "h2o_data",
+                        "prostate.csv")
+    fr = h2o.import_file(data)
+    print("STEP import_file OK:", fr.dim, flush=True)
+    assert fr.dim == [380, 9], fr.dim
+
+    # frame ops -> Rapids
+    print("names:", fr.names, flush=True)
+    desc = fr.describe()
+    print("STEP describe OK", flush=True)
+    m = fr["AGE"].mean()
+    print("STEP mean OK:", m, flush=True)
+    sub = fr[fr["AGE"] > 65, :]
+    print("STEP filter OK:", sub.nrow, flush=True)
+    fr["CAPSULE"] = fr["CAPSULE"].asfactor()
+    print("STEP asfactor OK:", fr["CAPSULE"].isfactor(), flush=True)
+
+    from h2o.estimators import (H2OGradientBoostingEstimator,
+                                H2OGeneralizedLinearEstimator)
+    gbm = H2OGradientBoostingEstimator(ntrees=10, max_depth=3, seed=42)
+    gbm.train(y="CAPSULE", x=["AGE", "RACE", "PSA", "GLEASON"],
+              training_frame=fr)
+    print("STEP gbm train OK", flush=True)
+    perf = gbm.model_performance(fr)
+    print("STEP gbm perf OK auc=", perf.auc(), flush=True)
+    assert perf.auc() > 0.7
+
+    pred = gbm.predict(fr)
+    print("STEP gbm predict OK:", pred.dim, pred.names, flush=True)
+
+    glm = H2OGeneralizedLinearEstimator(family="binomial", lambda_=0.0)
+    glm.train(y="CAPSULE", x=["AGE", "RACE", "PSA", "GLEASON"],
+              training_frame=fr)
+    print("STEP glm train OK", flush=True)
+    co = glm.coef()
+    print("STEP glm coef OK:", co, flush=True)
+
+    # save / load round trip over REST
+    path = h2o.save_model(gbm, path="/tmp/h2opy_models", force=True)
+    print("STEP save_model OK:", path, flush=True)
+    loaded = h2o.load_model(path)
+    print("STEP load_model OK:", loaded.model_id, flush=True)
+
+    lb = h2o.ls()
+    print("STEP ls OK:", len(lb), flush=True)
+
+    h2o.remove(fr)
+    print("STEP remove OK", flush=True)
+    srv.stop()
+    print("ALL STEPS PASSED", flush=True)
+
+
+if __name__ == "__main__":
+    main()

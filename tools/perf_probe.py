@@ -1,0 +1,123 @@
+"""Per-level timing probe for the adaptive histogram kernel on real TPU.
+
+The axon tunnel adds ~100ms per dispatch, so each level is looped REPS
+times inside ONE jitted program (lax.fori_loop) and the per-iteration
+time is (total - overhead) / REPS.
+"""
+import sys, os, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.ops.hist_adaptive import adaptive_level_tpu, leaf_totals_tpu
+
+ROWS = int(os.environ.get("ROWS", 10_000_000))
+F = int(os.environ.get("F", 28))
+W = int(os.environ.get("W", 32))
+DEPTH = 6
+TILE = int(os.environ.get("TILE", 4096))
+REPS = int(os.environ.get("REPS", 20))
+
+
+def _sync(out):
+    # axon-tunnel block_until_ready is a no-op; device_get truly syncs
+    leaf = jax.tree_util.tree_leaves(out)[-1]
+    np.asarray(jax.device_get(leaf))
+
+
+def timed(fn, *args):
+    out = fn(*args)
+    _sync(out)
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _sync(out)
+        ts.append(time.perf_counter() - t0)
+    return min(ts), out
+
+
+def main():
+    print(f"backend: {jax.default_backend()} rows={ROWS} F={F} W={W} "
+          f"tile={TILE} reps={REPS}")
+    rows = ROWS - (ROWS % TILE) if ROWS % TILE else ROWS
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.normal(size=(rows, F)).astype(np.float32))
+    g = jnp.asarray(rng.normal(size=rows).astype(np.float32))
+    ghw = jnp.stack([g, jnp.ones(rows, jnp.float32),
+                     jnp.ones(rows, jnp.float32)])
+    nid0 = jnp.zeros(rows, jnp.int32)
+    jax.block_until_ready(X)
+
+    # measure dispatch overhead with a trivial program
+    triv = jax.jit(lambda a: a + 1)
+    t_over, _ = timed(triv, nid0)
+    print(f"dispatch overhead (trivial jit): {t_over*1000:.1f} ms")
+
+    total = 0.0
+    nid = nid0
+    for d in range(DEPTH):
+        N = 2 ** d
+        base = N - 1
+        n_prev = N // 2 if d else 0
+        npv = max(n_prev, 1)
+        if d:
+            tables = (jnp.asarray(rng.integers(0, F, npv).astype(np.float32)),
+                      jnp.zeros(npv, jnp.float32), jnp.zeros(npv, jnp.float32),
+                      jnp.ones(npv, jnp.float32))
+        else:
+            tables = (jnp.zeros(1, jnp.float32),) * 4
+        lo = jnp.full((N, F), -4.0, jnp.float32)
+        inv = jnp.full((N, F), (W - 2) / 8.0, jnp.float32)
+
+        def level_loop(X, nid, ghw, tables, lo, inv, n_prev=n_prev, N=N,
+                       base=base):
+            def body(i, carry):
+                nid_c, acc = carry
+                nid2, hist = adaptive_level_tpu(X, nid_c, ghw, tables, lo,
+                                                inv, n_prev, N, base, W,
+                                                tile=TILE)
+                # feed nid2 back (real dependence, defeats loop hoisting);
+                # compute is shape-dependent only, so timing stays valid
+                return nid2 % (2 * N), acc + hist[0, 0, 0, 0]
+            return jax.lax.fori_loop(0, REPS, body, (nid, 0.0))
+
+        f = jax.jit(level_loop)
+        t, out = timed(f, X, nid, ghw, tables, lo, inv)
+        per = (t - t_over) / REPS
+        total += per
+        print(f"level d={d} N={N:3d}: {per*1000:8.2f} ms/iter")
+        # advance nid realistically for next level
+        nid2, _ = jax.jit(lambda X, nid, ghw, tables, lo, inv:
+                          adaptive_level_tpu(X, nid, ghw, tables, lo, inv,
+                                             n_prev, N, base, W, tile=TILE)
+                          )(X, nid, ghw, tables, lo, inv)
+        nid = jnp.where(jnp.asarray(rng.random(rows) < 0.5), 2 * nid + 1,
+                        2 * nid + 2) if d == 0 else nid2
+
+    npv = 2 ** (DEPTH - 1)
+    tables = (jnp.asarray(rng.integers(0, F, npv).astype(np.float32)),
+              jnp.zeros(npv, jnp.float32), jnp.zeros(npv, jnp.float32),
+              jnp.ones(npv, jnp.float32))
+    ND = 2 ** DEPTH
+
+    def leaf_loop(X, nid, ghw, tables):
+        def body(i, carry):
+            nid_c, acc = carry
+            nid2, tot = leaf_totals_tpu(X, nid_c, ghw, tables, ND // 2, ND,
+                                        ND - 1, tile=TILE)
+            return nid2 % ND, acc + tot[0, 0]
+        return jax.lax.fori_loop(0, REPS, body, (nid, 0.0))
+
+    t, _ = timed(jax.jit(leaf_loop), X, nid, ghw, tables)
+    per = (t - t_over) / REPS
+    total += per
+    print(f"leaf_totals ND={ND}: {per*1000:8.2f} ms/iter")
+    print(f"TOTAL per tree: {total*1000:.1f} ms  "
+          f"({rows/total/1e6:.1f}M rows/s/tree-pass)")
+
+
+if __name__ == "__main__":
+    main()
