@@ -20,3 +20,12 @@ if os.environ.get("H2O3_TPU_TEST_PLATFORM", "cpu") == "cpu":
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # persistent compilation cache: the suite's wall time is dominated by
+    # re-compiling the same sharded train steps (a cold full run spends
+    # ~80% of its time in XLA); cached executables make repeat runs and
+    # re-runs of single files start warm (water/MRTask has no compile
+    # step to cache — this cost is TPU-stack-specific, so the fix is too)
+    cache_dir = os.environ.get("H2O3_TEST_JAX_CACHE",
+                               "/tmp/h2o3_jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

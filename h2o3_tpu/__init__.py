@@ -43,12 +43,38 @@ __all__ = [
 ]
 
 
-def init(n_data=None, n_model=1):
+def init(n_data=None, n_model=1, distributed=False,
+         coordinator_address=None, num_processes=None, process_id=None,
+         port=None):
     """Initialise the runtime: build the global device mesh.
 
     Replaces the reference's cluster boot (water/H2O.java:2328 main →
     Paxos cloud formation): there is no membership protocol — the mesh is
     the cloud.
+
+    ``distributed=True`` is the multi-host path (SURVEY §7.3): every host
+    runs the SAME program, ``jax.distributed.initialize`` forms the
+    process group (the cloud-formation step), the mesh spans all hosts'
+    devices, and the REST server belongs on process 0 only
+    (``is_coordinator()``). Worker loss is fatal — the reference's own
+    locked-cloud failure model (water/Paxos.java:145), recovery is
+    restart + checkpoint reload.
     """
-    set_mesh(make_mesh(n_data=n_data, n_model=n_model))
+    if distributed:
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+    mesh = make_mesh(n_data=n_data, n_model=n_model)
+    set_mesh(mesh)
+    if distributed and port and is_coordinator():
+        from h2o3_tpu.api import start_server
+        start_server(port=port)
     return current_mesh()
+
+
+def is_coordinator() -> bool:
+    """True on the REST-serving process (host 0) — the reference's
+    'node answering the web port' role (water/H2O.java boot)."""
+    import jax
+    return jax.process_index() == 0

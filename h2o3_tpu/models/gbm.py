@@ -54,6 +54,9 @@ GBM_DEFAULTS: Dict = dict(
     # TPU-specific: which histogram kernel ('auto' = matmul on TPU,
     # scatter on CPU); see ops/histogram.py
     hist_kernel="auto",
+    # MXU histogram precision: 'auto' (= bfloat16 1-pass; deviation bound
+    # in ops/hist_adaptive.py) or 'float32' (exact, ~6x hist cost)
+    histogram_precision="auto",
 )
 
 

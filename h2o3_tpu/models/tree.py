@@ -60,6 +60,10 @@ class TreeConfig:
     # randomize the adaptive grid's phase per tree/feature so split points
     # land at random offsets within a bin width
     random_grid: bool = False
+    # histogram contraction precision on the MXU: 'bfloat16' (1-pass,
+    # default — deviation bound quantified in ops/hist_adaptive.py) or
+    # 'float32' (6-pass HIGHEST, exact); 'auto' = bfloat16
+    histogram_precision: str = "auto"
 
     @property
     def n_nodes(self) -> int:
@@ -144,20 +148,25 @@ def _find_splits(trip, cfg: TreeConfig, col_mask, mono=None):
     rem = best % per_f
     bin_idx = rem // 2 + 1          # split t in 1..B-1
     na_left = (rem % 2) == 1
-    # selected split's child (g, h) for bound propagation
+    # selected split's child (g, h, w) for bound propagation and
+    # deepest-level leaf values (children of the last split level)
     nidx = jnp.arange(N)
     t_sel = bin_idx - 1
     gl_s = gl0[nidx, feat, t_sel]
     hl_s = hl0[nidx, feat, t_sel]
+    wl_s = wl0[nidx, feat, t_sel]
     gl_s = gl_s + jnp.where(na_left, g_na[nidx, feat], 0.0)
     hl_s = hl_s + jnp.where(na_left, h_na[nidx, feat], 0.0)
+    wl_s = wl_s + jnp.where(na_left, w_na[nidx, feat], 0.0)
     gt_s = g_tot[nidx, 0]
     ht_s = h_tot[nidx, 0]
     vl_sel = _leaf_value(gl_s, hl_s, cfg)
     vr_sel = _leaf_value(gt_s - gl_s, ht_s - hl_s, cfg)
+    wr_sel = w_tot[nidx, 0] - wl_s
     # f=0 slice of per-feature totals == node totals
     return (best_gain, feat.astype(jnp.int32), bin_idx.astype(jnp.int32),
-            na_left, g_tot[:, 0], h_tot[:, 0], w_tot[:, 0], vl_sel, vr_sel)
+            na_left, g_tot[:, 0], h_tot[:, 0], w_tot[:, 0], vl_sel, vr_sel,
+            wl_s, wr_sel)
 
 
 BIGV = jnp.float32(1e30)
@@ -273,7 +282,7 @@ def grow_tree(codes, g, h, w, cfg: TreeConfig, col_mask, axis_name=None,
         if allowed is not None:
             lm2 = level_mask if level_mask.ndim == 2 else level_mask[None, :]
             level_mask = lm2 & allowed
-        bg, bf, bb, bnl, gt, ht, wt, vl_s, vr_s = _find_splits(
+        bg, bf, bb, bnl, gt, ht, wt, vl_s, vr_s, _wl, _wr = _find_splits(
             hist, cfg, level_mask, mono=mono)
         can = (bg > jnp.maximum(cfg.min_split_improvement, 0.0)) & (wt > 0)
         idx = base + jnp.arange(N)
@@ -376,7 +385,9 @@ def adaptive_setup(spec, params, max_depth: int, mtries: int = 0):
                      mtries=mtries,
                      hist_method=p.get("hist_kernel", "auto"),
                      random_grid=(str(p.get("histogram_type", "")).lower()
-                                  == "random"))
+                                  == "random"),
+                     histogram_precision=str(
+                         p.get("histogram_precision", "auto")).lower())
     Xf = jnp.where(jnp.isfinite(spec.X), spec.X, jnp.nan)
     root_lo = jnp.nan_to_num(jnp.nanmin(Xf, axis=0), nan=0.0)
     root_hi = jnp.nan_to_num(jnp.nanmax(Xf, axis=0), nan=0.0)
@@ -411,8 +422,8 @@ def grow_tree_adaptive(X, g, h, w, cfg: TreeConfig, col_mask, root_lo,
     nb = their root span so identity binning reproduces exact per-level
     splits up to W-1 categories (beyond that, ordinal grouping refined by
     narrowing — the nbins_cats analog)."""
-    from h2o3_tpu.ops.hist_adaptive import (adaptive_level, leaf_totals,
-                                            pick_W)
+    from h2o3_tpu.ops.hist_adaptive import (adaptive_level, pick_W,
+                                            route_only)
     from dataclasses import replace as dc_replace
 
     D = cfg.max_depth
@@ -423,6 +434,19 @@ def grow_tree_adaptive(X, g, h, w, cfg: TreeConfig, col_mask, root_lo,
     # kernel name) degrades to scatter here
     method = (cfg.hist_method if cfg.hist_method in ("pallas", "scatter")
               else "scatter" if cfg.hist_method == "matmul" else "auto")
+    # histogram_precision='auto': exact f32 when the frame is small
+    # enough that the 1.4x hist cost is negligible, bf16 at scale.
+    # Measured bound (tools/bf16_deviation.py, 2M rows, depth 8,
+    # adversarial near-duplicate features): bf16 flips ~30% of split
+    # choices BETWEEN statistically equivalent candidates; AUC delta
+    # 2.8e-5. Deepest-level leaf values come from the same histograms,
+    # so they carry the same precision choice (exact under 'float32').
+    if cfg.histogram_precision in ("float32", "f32"):
+        mxu_dtype = jnp.float32
+    elif cfg.histogram_precision in ("bfloat16", "bf16"):
+        mxu_dtype = jnp.bfloat16
+    else:  # auto
+        mxu_dtype = jnp.float32 if X.shape[0] < (1 << 18) else jnp.bfloat16
     if nb_f is None:
         nb_f = jnp.full(F, float(min(cfg.n_bins, W - 2)), jnp.float32)
     else:
@@ -456,6 +480,15 @@ def grow_tree_adaptive(X, g, h, w, cfg: TreeConfig, col_mask, root_lo,
     if cfg.random_grid and key is not None:
         phase = jax.random.uniform(jax.random.fold_in(key, 7919), (F,))
 
+    # bandwidth-packed transpose for the pallas path: [rows, F] device
+    # layout pads F to 128 lanes (~4.6x wasted HBM reads at F=28 —
+    # measured in ops/hist_adaptive.py header); [F, rows] puts rows in
+    # lanes. XLA hoists this loop-invariant transpose out of the per-tree
+    # scan, so it costs one pass per chunk, not per level.
+    on_tpu = (method == "pallas"
+              or (method == "auto" and jax.default_backend() == "tpu"))
+    Xt = X.T if on_tpu else None
+
     for d in range(D):
         N = 2 ** d
         base = N - 1
@@ -467,7 +500,8 @@ def grow_tree_adaptive(X, g, h, w, cfg: TreeConfig, col_mask, root_lo,
         inv_d = jnp.where(span > 0,
                           nb_f[None, :] / jnp.where(span > 0, span, 1.0), 0.0)
         nid, hist = adaptive_level(X, nid, ghw, tables, lo_d, inv_d,
-                                   N // 2 if d else 0, N, base, W, method)
+                                   N // 2 if d else 0, N, base, W, method,
+                                   mxu_dtype=mxu_dtype, xt=Xt)
         if axis_name is not None:
             hist = jax.lax.psum(hist, axis_name)
         trip = (hist[0], hist[1], hist[2])
@@ -480,7 +514,7 @@ def grow_tree_adaptive(X, g, h, w, cfg: TreeConfig, col_mask, root_lo,
         if allowed is not None:
             lm2 = level_mask if level_mask.ndim == 2 else level_mask[None, :]
             level_mask = lm2 & allowed
-        bg, bf, bb, bnl, gt, ht, wt, vl_s, vr_s = _find_splits(
+        bg, bf, bb, bnl, gt, ht, wt, vl_s, vr_s, wl_s, wr_s = _find_splits(
             trip, find_cfg, level_mask, mono=mono)
         can = (bg > jnp.maximum(cfg.min_split_improvement, 0.0)) & (wt > 0)
         nidx = jnp.arange(N)
@@ -533,17 +567,33 @@ def grow_tree_adaptive(X, g, h, w, cfg: TreeConfig, col_mask, root_lo,
         lo_d = jnp.stack([lo_left, lo_right], axis=1).reshape(2 * N, F)
         hi_d = jnp.stack([hi_left, hi_right], axis=1).reshape(2 * N, F)
 
-    # deepest level: route into the leaves and take exact f32 (g,h,w)
-    # totals (dedicated kernel — no bin histogram, no bf16 rounding)
+    # deepest level: leaf values are the LAST split level's selected
+    # left/right child stats — already in the (psum'd) histograms, so the
+    # final pass only needs to ROUTE rows for the margin update (a ~3x
+    # cheaper kernel than a full level; with histogram_precision=float32
+    # these stats are exact, with bf16 they carry the documented bound)
+    if D == 0:
+        # degenerate stump: one root leaf from exact totals
+        g0 = g * (w > 0)
+        h0 = h * (w > 0)
+        gs, hs, ws = g0.sum(), h0.sum(), w.sum()
+        if axis_name is not None:
+            gs = jax.lax.psum(gs, axis_name)
+            hs = jax.lax.psum(hs, axis_name)
+            ws = jax.lax.psum(ws, axis_name)
+        value = value.at[0].set(_leaf_value(gs, hs, cfg))
+        node_w = node_w.at[0].set(ws)
+        tree = {"feat": feat, "thr": thr_arr, "na_left": na_left,
+                "is_split": is_split, "value": value, "gain": gain_arr,
+                "node_w": node_w}
+        return tree, nid
     ND = 2 ** D
     baseD = ND - 1
-    nid, totD = leaf_totals(X, nid, ghw, tables, ND // 2, ND, baseD, method)
-    if axis_name is not None:
-        totD = jax.lax.psum(totD, axis_name)
-    gD, hD, wD = totD[0], totD[1], totD[2]
+    nid = route_only(X, nid, tables, ND // 2, baseD, method, xt=Xt)
+    vD = jnp.stack([vl_s, vr_s], axis=1).reshape(ND)
+    wD = jnp.stack([wl_s, wr_s], axis=1).reshape(ND)
     idxD = baseD + jnp.arange(ND)
-    value = value.at[idxD].set(
-        jnp.clip(_leaf_value(gD, hD, cfg), lo_b, hi_b))
+    value = value.at[idxD].set(jnp.clip(vD, lo_b, hi_b))
     node_w = node_w.at[idxD].set(wD)
 
     tree = {"feat": feat, "thr": thr_arr, "na_left": na_left,
@@ -616,8 +666,8 @@ def grow_tree_spmd(codes, g, h, w, cfg: TreeConfig, col_mask,
         seg = jnp.where(in_level, local, -1)
         hist = build_histograms(codes, seg, ghw, N, B1, cfg.hist_method)
         hist = jax.lax.psum(hist, data_axis)
-        bg, bf, bb, bnl, gt, ht, wt, _vl, _vr = _find_splits(hist, cfg,
-                                                             col_mask)
+        (bg, bf, bb, bnl, gt, ht, wt,
+         _vl, _vr, _wl, _wr) = _find_splits(hist, cfg, col_mask)
         # global best over the model axis
         cand = jnp.stack([bg, (midx * F_loc + bf).astype(jnp.float32),
                           bb.astype(jnp.float32), bnl.astype(jnp.float32)], 1)

@@ -9,19 +9,29 @@ ScoreBuildHistogram2.java:121-301 builds (w, wY, wYY) per bin). This is
 unlike XGBoost's global 256-bin sketch: after d levels a feature's
 effective resolution is ~nbins·2^d.
 
-TPU re-design (one pallas kernel call per tree level):
+TPU re-design (one pallas kernel call per tree level), in the
+TRANSPOSED layout x_t [F, rows] — rows ride the 128-lane axis:
   1. ROUTE: each row steps through the previous level's split tables
-     ([4, n_prev] = feat/thr/na_left/can). The lookup is ONE merged
-     one-hot matmul at HIGHEST precision (no vector gathers on TPU); the
-     split-feature value is selected by compare-accumulate over F lanes.
+     (bf16-split [12, n_prev] = feat/thr/na_left/can, exact via
+     _split3_bf16). The lookup is ONE merged one-hot matmul; the
+     split-feature value is selected by compare-accumulate over F
+     sublanes.
   2. BIN:  b = isnan(x) ? W-1 : floor(clip((x - lo[n,f]) * inv[n,f]))
-     with per-(node, feature) range tables — one merged [N, 2F] lookup
-     matmul.
-  3. HIST: the bin one-hot is produced by a SELECTOR matmul
-     (b_all[r, j] = bin of feature j//W — an F-way lane-offset
-     concatenate costs ~20% of the level at F=28), then contracted
-     against node-onehot × (g,h,w) on the MXU, accumulating in VMEM
-     across row tiles.
+     with per-(node, feature) range tables — one merged [6F, N] lookup
+     matmul against the node one-hot.
+  3. HIST: the bin row broadcasts to [F*W, tile] with a SUBLANE repeat
+     (cheap relayout; the row-major layout needed a selector matmul
+     and a 14MB f32 intermediate here), one-hots against a sublane
+     iota, then contracts against node-onehot × (g,h,w) on the MXU
+     (lane-dim contraction both sides), accumulating in VMEM.
+
+Why transposed: a [rows, F] device array tiles F onto the 128-lane
+minor axis, so F=28 reads waste 100/128 of HBM bandwidth (measured 30
+GB/s useful vs 126 GB/s packed on v5e). [F, rows] packs rows into
+lanes; F pads only 28→32 sublanes. Layout + sublane-repeat together
+took the 10M-row bench from 21.7M to 68.1M rows/s/chip (vs_baseline
+0.87 → 2.72) at identical AUC. The row-major kernels are retained for
+parity tests.
 
 The cross-shard reduction (MRTask reduce tree / Rabit ring analog,
 water/MRTask.java:871, hex/tree/xgboost/rabit/RabitTrackerH2O.java) is a
@@ -49,7 +59,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 import os as _os
 
-TILE = int(_os.environ.get("H2O3_HIST_TILE", 4096))
+TILE = int(_os.environ.get("H2O3_HIST_TILE", 8192))
 # default scoped-vmem stack limit is 16MB; the accumulator + one-hot want
 # more at deeper levels / larger tiles (v5e has 128MB VMEM)
 _VMEM_LIMIT = 100 * 1024 * 1024
@@ -270,12 +280,28 @@ def adaptive_level_xla(x, nid, ghw, tables, lo, inv, n_prev: int,
 
 
 def adaptive_level(x, nid, ghw, tables, lo, inv, n_prev: int, n_nodes: int,
-                   level_base: int, W: int, method: str = "auto"):
+                   level_base: int, W: int, method: str = "auto",
+                   mxu_dtype=jnp.bfloat16, xt=None):
     """Dispatch: pallas on TPU (padding rows to the tile size), scatter-XLA
-    elsewhere."""
+    elsewhere. ``mxu_dtype`` picks the histogram contraction precision —
+    see the bf16 deviation bound in the module docstring. ``xt`` ([F,
+    rows], rows in LANES) selects the bandwidth-packed transposed kernel
+    (callers materialize the transpose once per tree loop)."""
     if method == "auto":
         method = "pallas" if jax.default_backend() == "tpu" else "scatter"
     if method == "pallas":
+        if xt is not None:
+            rows = xt.shape[1]
+            pad = (-rows) % TILE
+            if pad:
+                xt = jnp.pad(xt, ((0, 0), (0, pad)),
+                             constant_values=jnp.nan)
+                nid = jnp.pad(nid, (0, pad))
+                ghw = jnp.pad(ghw, ((0, 0), (0, pad)))
+            nid2, hist = adaptive_level_tpu_t(xt, nid, ghw, tables, lo, inv,
+                                              n_prev, n_nodes, level_base,
+                                              W, mxu_dtype=mxu_dtype)
+            return nid2[:rows], hist
         rows = x.shape[0]
         pad = (-rows) % TILE
         if pad:
@@ -285,7 +311,8 @@ def adaptive_level(x, nid, ghw, tables, lo, inv, n_prev: int, n_nodes: int,
             nid = jnp.pad(nid, (0, pad))
             ghw = jnp.pad(ghw, ((0, 0), (0, pad)))
         nid2, hist = adaptive_level_tpu(x, nid, ghw, tables, lo, inv, n_prev,
-                                        n_nodes, level_base, W)
+                                        n_nodes, level_base, W,
+                                        mxu_dtype=mxu_dtype)
         return nid2[:rows], hist
     return adaptive_level_xla(x, nid, ghw, tables, lo, inv, n_prev,
                               n_nodes, level_base, W)
@@ -395,6 +422,239 @@ def leaf_totals_xla(x, nid, ghw, tables, n_prev: int, n_nodes: int,
     tot = jnp.zeros((n_nodes, 3), jnp.float32).at[lidc].add(
         (ghw * vw[None, :]).T)
     return nid, tot.T
+
+
+# ---------------- TRANSPOSED-LAYOUT kernels ----------------------------
+#
+# The row-major [rows, F] layout wastes HBM bandwidth at small F: device
+# arrays tile the MINOR dim to 128 lanes, so F=28 reads move 128/28 =
+# 4.6x the useful bytes (measured: 30 GB/s useful on v5e vs 126 GB/s at
+# F=128 — tools/ probes). The transposed [F, rows] layout puts ROWS in
+# lanes (full utilization; F pads only 28→32 sublanes) and maps the
+# kernel MORE naturally: the routing/range lookups already treat rows as
+# lanes, the bin one-hot becomes [F*W, tile] vs a sublane iota, and the
+# histogram contraction contracts the lane dim on both operands.
+
+def _route_t(xt, nid, tabs_ref, n_prev, level_base, tile, F):
+    """Transposed routing: xt [F, tile] (rows in lanes)."""
+    prev_base = level_base - n_prev
+    lid_p = nid - prev_base
+    onp = (jax.lax.broadcasted_iota(jnp.int32, (n_prev, tile), 0)
+           == lid_p[None, :]).astype(jnp.bfloat16)
+    t12 = tabs_ref[:, :n_prev]
+    lut3 = jax.lax.dot_general(t12, onp, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+    lut = _unsplit3(lut3[0:4], lut3[4:8], lut3[8:12])
+    f_r, t_r, nl_r, cn_r = lut[0], lut[1], lut[2], lut[3]
+    fi = jax.lax.broadcasted_iota(jnp.int32, (F, tile), 0)
+    xsel = jnp.sum(jnp.where(fi == f_r.astype(jnp.int32)[None, :], xt, 0.0),
+                   axis=0)
+    gr_f = jnp.where(jnp.isnan(xsel), 1.0 - nl_r,
+                     (xsel >= t_r).astype(jnp.float32))
+    in_prev = (lid_p >= 0) & (lid_p < n_prev)
+    child = 2 * nid + 1 + gr_f.astype(jnp.int32)
+    return jnp.where(in_prev & (cn_r > 0.5), child, nid)
+
+
+def _kernel_t(x_ref, nid_ref, ghw_ref, tabs_ref, loinv_ref, nid_out,
+              hist_out, acc_ref, *, n_prev: int, n_nodes: int, F: int,
+              W: int, tile: int, n_row_tiles: int, level_base: int,
+              mxu_dtype):
+    r = pl.program_id(0)
+
+    @pl.when(r == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    xt = x_ref[...]                                  # [F, tile] f32
+    nid = nid_ref[0, :]
+    if n_prev > 0:
+        nid = _route_t(xt, nid, tabs_ref, n_prev, level_base, tile, F)
+    nid_out[0, :] = nid
+
+    lid = nid - level_base
+    in_lvl = (lid >= 0) & (lid < n_nodes)
+    lidc = jnp.where(in_lvl, lid, 0)
+    onh = (jax.lax.broadcasted_iota(jnp.int32, (n_nodes, tile), 0)
+           == lidc[None, :])
+    onh_f = onh.astype(jnp.float32) * in_lvl.astype(jnp.float32)[None, :]
+    onh_b = onh_f.astype(jnp.bfloat16)
+    # per-row ranges: [6F, N] @ [N, tile] -> [6F, tile] (exact 3-term
+    # bf16 split, see _split3_bf16)
+    lr3 = jax.lax.dot_general(loinv_ref[...], onh_b,
+                              (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    lr = _unsplit3(lr3[:2 * F], lr3[2 * F:4 * F], lr3[4 * F:])
+    lo_r = lr[:F]
+    inv_r = lr[F:]
+    bin_f = jnp.floor(jnp.clip((xt - lo_r) * inv_r, 0.0, float(W - 2)))
+    bin_v = jnp.where(jnp.isnan(xt), float(W - 1), bin_f)  # [F, tile]
+    # bin broadcast to [F*W, tile]: in the transposed layout this is a
+    # SUBLANE repeat (each feature row replicated W times) — a cheap
+    # Mosaic relayout, vs the row-major layout where the same broadcast
+    # needed a selector MATMUL writing a [tile, F*W] f32 intermediate
+    # (the repeat alone was worth ~1.5x end-to-end on the bench)
+    b_all = jnp.repeat(bin_v, W, axis=0)
+    brow = jax.lax.broadcasted_iota(jnp.int32, (F * W, tile), 0)
+    oh_t = ((brow % W).astype(jnp.float32) == b_all).astype(mxu_dtype)
+    ghw = ghw_ref[...]
+    left = jnp.concatenate(
+        [onh_f.astype(mxu_dtype) * ghw[k, :][None, :].astype(mxu_dtype)
+         for k in range(3)], axis=0)                      # [3N, tile]
+    # contraction over LANES on both sides: [3N, tile] x [FW, tile]^T
+    acc_ref[...] += jax.lax.dot_general(
+        left, oh_t, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=(jax.lax.Precision.HIGHEST if mxu_dtype == jnp.float32
+                   else jax.lax.Precision.DEFAULT))       # [3N, FW]
+
+    @pl.when(r == n_row_tiles - 1)
+    def _flush():
+        hist_out[...] = acc_ref[...]
+
+
+def adaptive_level_tpu_t(xt, nid, ghw, tables, lo, inv, n_prev: int,
+                         n_nodes: int, level_base: int, W: int,
+                         tile: int = TILE, interpret: bool = False,
+                         mxu_dtype=jnp.bfloat16):
+    """Transposed-layout level: xt is [F, rows] (rows % tile == 0)."""
+    F, rows = xt.shape
+    assert rows % tile == 0, (rows, tile)
+    n_row_tiles = rows // tile
+    tabs = _pack_tables(tables)
+    np1 = tabs.shape[1]
+    # loinv stored [6F, N]: 3-term split of [2F, N]
+    loinv = _split3_bf16(jnp.concatenate([lo, inv], axis=1).T, axis=0)
+    kern = functools.partial(_kernel_t, n_prev=n_prev, n_nodes=n_nodes, F=F,
+                             W=W, tile=tile, n_row_tiles=n_row_tiles,
+                             level_base=level_base, mxu_dtype=mxu_dtype)
+    nid2, hist = pl.pallas_call(
+        kern,
+        grid=(n_row_tiles,),
+        in_specs=[
+            pl.BlockSpec((F, tile), lambda r: (0, r)),
+            pl.BlockSpec((1, tile), lambda r: (0, r)),
+            pl.BlockSpec((3, tile), lambda r: (0, r)),
+            pl.BlockSpec((12, np1), lambda r: (0, 0)),
+            pl.BlockSpec((6 * F, n_nodes), lambda r: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, tile), lambda r: (0, r)),
+            pl.BlockSpec((3 * n_nodes, F * W), lambda r: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, rows), jnp.int32),
+            jax.ShapeDtypeStruct((3 * n_nodes, F * W), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((3 * n_nodes, F * W), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        interpret=interpret,
+    )(xt, nid[None, :], ghw, tabs, loinv)
+    return nid2[0], hist.reshape(3, n_nodes, F, W)
+
+
+def _route_kernel_t(x_ref, nid_ref, tabs_ref, nid_out, *, n_prev: int,
+                    level_base: int, F: int, tile: int):
+    xt = x_ref[...]
+    nid = nid_ref[0, :]
+    nid = _route_t(xt, nid, tabs_ref, n_prev, level_base, tile, F)
+    nid_out[0, :] = nid
+
+
+def route_only_tpu_t(xt, nid, tables, n_prev: int, level_base: int,
+                     tile: int = TILE, interpret: bool = False):
+    F, rows = xt.shape
+    assert rows % tile == 0
+    tabs = _pack_tables(tables)
+    np1 = tabs.shape[1]
+    kern = functools.partial(_route_kernel_t, n_prev=n_prev,
+                             level_base=level_base, F=F, tile=tile)
+    nid2 = pl.pallas_call(
+        kern,
+        grid=(rows // tile,),
+        in_specs=[
+            pl.BlockSpec((F, tile), lambda r: (0, r)),
+            pl.BlockSpec((1, tile), lambda r: (0, r)),
+            pl.BlockSpec((12, np1), lambda r: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, tile), lambda r: (0, r)),
+        out_shape=jax.ShapeDtypeStruct((1, rows), jnp.int32),
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        interpret=interpret,
+    )(xt, nid[None, :], tabs)
+    return nid2[0]
+
+
+def _route_kernel(x_ref, nid_ref, tabs_ref, nid_out, *, n_prev: int,
+                  level_base: int, F: int, tile: int):
+    """Route one level, nothing else — the deepest-level pass when leaf
+    values come from the last histogram's selected splits (no totals
+    kernel; ~3x cheaper than a full level since the whole [tile, F*W]
+    one-hot stage is skipped)."""
+    x = x_ref[...]
+    nid = nid_ref[0, :]
+    nid = _route(x, nid, tabs_ref, n_prev, level_base, tile, F)
+    nid_out[0, :] = nid
+
+
+def route_only_tpu(x, nid, tables, n_prev: int, level_base: int,
+                   tile: int = TILE, interpret: bool = False):
+    rows, F = x.shape
+    assert rows % tile == 0
+    tabs = _pack_tables(tables)
+    np1 = tabs.shape[1]
+    kern = functools.partial(_route_kernel, n_prev=n_prev,
+                             level_base=level_base, F=F, tile=tile)
+    nid2 = pl.pallas_call(
+        kern,
+        grid=(rows // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, F), lambda r: (r, 0)),
+            pl.BlockSpec((1, tile), lambda r: (0, r)),
+            pl.BlockSpec((12, np1), lambda r: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, tile), lambda r: (0, r)),
+        out_shape=jax.ShapeDtypeStruct((1, rows), jnp.int32),
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        interpret=interpret,
+    )(x, nid[None, :], tabs)
+    return nid2[0]
+
+
+def route_only_xla(x, nid, tables, n_prev: int, level_base: int):
+    feat, thr, nal, can = tables
+    prev_base = level_base - n_prev
+    lid_p = jnp.clip(nid - prev_base, 0, n_prev - 1)
+    in_prev = (nid >= prev_base) & (nid < prev_base + n_prev)
+    f_r = feat[lid_p].astype(jnp.int32)
+    xsel = jnp.take_along_axis(x, f_r[:, None], axis=1)[:, 0]
+    go_right = jnp.where(jnp.isnan(xsel), nal[lid_p] < 0.5,
+                         xsel >= thr[lid_p])
+    child = 2 * nid + 1 + go_right.astype(jnp.int32)
+    return jnp.where(in_prev & (can[lid_p] > 0.5), child, nid)
+
+
+def route_only(x, nid, tables, n_prev: int, level_base: int,
+               method: str = "auto", xt=None):
+    if method == "auto":
+        method = "pallas" if jax.default_backend() == "tpu" else "scatter"
+    if method == "pallas":
+        if xt is not None:
+            rows = xt.shape[1]
+            pad = (-rows) % TILE
+            if pad:
+                xt = jnp.pad(xt, ((0, 0), (0, pad)),
+                             constant_values=jnp.nan)
+                nid = jnp.pad(nid, (0, pad))
+            return route_only_tpu_t(xt, nid, tables, n_prev,
+                                    level_base)[:rows]
+        rows = x.shape[0]
+        pad = (-rows) % TILE
+        if pad:
+            x = jnp.pad(x, ((0, pad), (0, 0)), constant_values=jnp.nan)
+            nid = jnp.pad(nid, (0, pad))
+        return route_only_tpu(x, nid, tables, n_prev, level_base)[:rows]
+    return route_only_xla(x, nid, tables, n_prev, level_base)
 
 
 def leaf_totals(x, nid, ghw, tables, n_prev: int, n_nodes: int,

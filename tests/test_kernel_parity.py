@@ -1,0 +1,75 @@
+"""Interpret-mode parity for the TRANSPOSED pallas kernels — the default
+TPU path (ops/hist_adaptive.py _kernel_t/_route_t) checked on CPU
+against the scatter XLA reference, including NA routing, narrowed
+ranges, and the exact bf16-split table reconstruction."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from h2o3_tpu.ops.hist_adaptive import (adaptive_level_tpu_t,
+                                        adaptive_level_xla,
+                                        route_only_tpu_t, route_only_xla)
+
+
+def _inputs(rows=4096, F=7, N=4, seed=0):
+    rng = np.random.default_rng(seed)
+    Xh = rng.normal(size=(rows, F)).astype(np.float32)
+    Xh[rng.random((rows, F)) < 0.06] = np.nan
+    # narrowed-range stress: |lo| >> span
+    Xh[:, 2] = 1000.0 + 0.01 * rng.random(rows).astype(np.float32)
+    n_prev = N // 2
+    base = N - 1
+    nid = (base - n_prev + rng.integers(0, n_prev, rows)).astype(np.int32)
+    g = rng.normal(size=rows).astype(np.float32)
+    ghw = np.stack([g, np.ones(rows, np.float32), np.ones(rows, np.float32)])
+    thr = rng.normal(size=n_prev).astype(np.float32)
+    thr[0] = 1000.005                       # boundary on narrowed feature
+    tables = (jnp.asarray(rng.integers(0, F, n_prev).astype(np.float32)),
+              jnp.asarray(thr),
+              jnp.asarray((rng.random(n_prev) < 0.5).astype(np.float32)),
+              jnp.ones(n_prev, jnp.float32))
+    lo = np.tile(rng.normal(size=(1, F)).astype(np.float32) - 3, (N, 1))
+    lo[:, 2] = 1000.0
+    inv = np.full((N, F), 30 / 8.0, np.float32)
+    inv[:, 2] = 30 / 0.01
+    return (Xh, jnp.asarray(nid), jnp.asarray(ghw), tables,
+            jnp.asarray(lo), jnp.asarray(inv), n_prev, N, base)
+
+
+def test_transposed_level_parity_interpret():
+    Xh, nid, ghw, tables, lo, inv, n_prev, N, base = _inputs()
+    W = 32
+    nid_t, hist_t = adaptive_level_tpu_t(
+        jnp.asarray(Xh.T.copy()), nid, ghw, tables, lo, inv, n_prev, N,
+        base, W, tile=1024, interpret=True, mxu_dtype=jnp.float32)
+    nid_x, hist_x = adaptive_level_xla(
+        jnp.asarray(Xh), nid, ghw, tables, lo, inv, n_prev, N, base, W)
+    np.testing.assert_array_equal(np.asarray(nid_t), np.asarray(nid_x))
+    F = Xh.shape[1]
+    np.testing.assert_allclose(np.asarray(hist_t),
+                               np.asarray(hist_x)[:, :, :F, :],
+                               rtol=1e-5, atol=1e-3)
+
+
+def test_transposed_route_only_parity_interpret():
+    Xh, nid, ghw, tables, lo, inv, n_prev, N, base = _inputs(seed=5)
+    r_t = route_only_tpu_t(jnp.asarray(Xh.T.copy()), nid, tables, n_prev,
+                           base, tile=1024, interpret=True)
+    r_x = route_only_xla(jnp.asarray(Xh), nid, tables, n_prev, base)
+    np.testing.assert_array_equal(np.asarray(r_t), np.asarray(r_x))
+
+
+def test_max_depth_zero_stump():
+    """Regression: D=0 must build a single root leaf, not NameError."""
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    rng = np.random.default_rng(1)
+    fr = h2o.Frame.from_numpy({
+        "x": rng.normal(size=500).astype(np.float32),
+        "y": rng.normal(size=500).astype(np.float32)})
+    est = H2OGradientBoostingEstimator(ntrees=3, max_depth=0, min_rows=1.0)
+    est.train(y="y", training_frame=fr)
+    # all-stump model predicts a constant (the shrunken mean path)
+    pred = np.asarray(est.model.predict(fr).vec(0).to_numpy()[:500])
+    assert np.allclose(pred, pred[0])

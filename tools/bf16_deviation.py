@@ -1,0 +1,74 @@
+"""Quantify the bf16 histogram-contraction deviation (task: document a
+bound, not a comment). Trains deep GBMs twice — histogram_precision
+bfloat16 vs float32 — on adversarial near-tie data and reports split
+disagreement and AUC delta. Run on the real TPU chip.
+"""
+import os, sys, time
+sys.path.insert(0, '/root/repo')
+
+import numpy as np
+
+ROWS = int(os.environ.get("ROWS", 2_000_000))
+DEPTH = int(os.environ.get("DEPTH", 8))
+TREES = int(os.environ.get("TREES", 10))
+
+
+def main():
+    import jax
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    rng = np.random.default_rng(11)
+    F = 12
+    X = rng.normal(size=(ROWS, F)).astype(np.float32)
+    # near-tie structure: pairs of nearly identical features so split
+    # gains between them differ only in low-order bits
+    for j in range(0, F, 2):
+        X[:, j + 1] = X[:, j] + 1e-4 * rng.normal(size=ROWS).astype(np.float32)
+    logit = (X[:, 0] - X[:, 2] + 0.5 * X[:, 4] * X[:, 6]
+             + 0.3 * np.sin(2 * X[:, 8]))
+    y = (rng.random(ROWS) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+    cols = {f"f{i}": X[:, i] for i in range(F)}
+    cols["y"] = y
+    fr = h2o.Frame.from_numpy(cols)
+
+    models = {}
+    for prec in ("bfloat16", "float32"):
+        t0 = time.time()
+        est = H2OGradientBoostingEstimator(
+            ntrees=TREES, max_depth=DEPTH, learn_rate=0.1, nbins=30,
+            distribution="bernoulli", seed=3, score_tree_interval=0,
+            stopping_rounds=0, min_rows=1.0, histogram_precision=prec)
+        est.train(y="y", training_frame=fr)
+        m = est.model
+        models[prec] = m
+        print(f"{prec}: train {time.time()-t0:.1f}s "
+              f"loop {m.output['training_loop_seconds']:.2f}s "
+              f"AUC {m.training_metrics.auc:.6f}", flush=True)
+
+    mb, mf = models["bfloat16"], models["float32"]
+    fb = np.asarray(mb._feat); ff = np.asarray(mf._feat)
+    sb = np.asarray(mb._is_split); sf = np.asarray(mf._is_split)
+    tb = np.asarray(mb._thr); tf = np.asarray(mf._thr)
+    both = sb & sf
+    n_splits = int(both.sum())
+    feat_diff = int((fb[both] != ff[both]).sum())
+    thr_diff = int(((fb[both] == ff[both])
+                    & (tb[both] != tf[both])).sum())
+    auc_d = abs(mb.training_metrics.auc - mf.training_metrics.auc)
+    print(f"splits compared: {n_splits}")
+    print(f"feature disagreements: {feat_diff} "
+          f"({100*feat_diff/max(n_splits,1):.3f}%)")
+    print(f"threshold-only disagreements: {thr_diff} "
+          f"({100*thr_diff/max(n_splits,1):.3f}%)")
+    print(f"AUC delta: {auc_d:.6f}")
+    # leaf value agreement (deepest level uses exact f32 totals in both)
+    vb = np.asarray(mb._value); vf = np.asarray(mf._value)
+    same_struct = (fb == ff).all(axis=1)
+    if same_struct.any():
+        rel = np.abs(vb[same_struct] - vf[same_struct])
+        print(f"leaf |Δvalue| max over same-structure trees: {rel.max():.2e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""Multi-host SPMD worker (one PROCESS of the cloud).
+
+Usage: python tools/multihost_worker.py <process_id> <num_processes> <port>
+
+Each process owns 4 virtual CPU devices; jax.distributed.initialize forms
+the process group (the Paxos cloud-formation analog, SURVEY §7.3), the
+mesh spans all processes, and ONE shard_mapped adaptive tree build runs
+with its histogram psums crossing the process boundary. Tree outputs are
+replicated, so every process prints the same digest — the test asserts
+it.
+"""
+import os
+import sys
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+port = sys.argv[3]
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import h2o3_tpu as h2o
+
+h2o.init(distributed=True, coordinator_address=f"localhost:{port}",
+         num_processes=nproc, process_id=pid)
+assert jax.process_count() == nproc
+assert len(jax.devices()) == 4 * nproc, len(jax.devices())
+
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from h2o3_tpu.models.tree import TreeConfig, grow_tree_adaptive
+from h2o3_tpu.parallel.mesh import DATA_AXIS, current_mesh
+
+mesh = current_mesh()
+rows_global, F = 4096, 6
+rows_local = rows_global // nproc
+rng = np.random.default_rng(100 + pid)      # DIFFERENT rows per process
+Xl = rng.normal(size=(rows_local, F)).astype(np.float32)
+gl = rng.normal(size=rows_local).astype(np.float32)
+
+sh = NamedSharding(mesh, P(DATA_AXIS))
+X = jax.make_array_from_process_local_data(sh, Xl, (rows_global, F))
+g = jax.make_array_from_process_local_data(sh, gl, (rows_global,))
+ones = jax.make_array_from_process_local_data(
+    sh, np.ones(rows_local, np.float32), (rows_global,))
+
+cfg = TreeConfig(max_depth=4, n_bins=30, n_features=F, min_rows=1.0)
+root_lo = jnp.full(F, -4.0, jnp.float32)
+root_hi = jnp.full(F, 4.0, jnp.float32)
+col_mask = jnp.ones(F, bool)
+
+
+def step(X, g, h, w):
+    tree, nid = grow_tree_adaptive(X, g, h, w, cfg, col_mask, root_lo,
+                                   root_hi, axis_name=DATA_AXIS)
+    return tree
+
+
+fn = jax.jit(jax.shard_map(
+    step, mesh=mesh,
+    in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+    out_specs=P(), check_vma=False))
+tree = fn(X, g, ones, ones)
+feat = np.asarray(jax.device_get(tree["feat"]))
+val = np.asarray(jax.device_get(tree["value"]))
+digest = f"{feat.sum()}:{np.round(float(np.abs(val).sum()), 4)}"
+print(f"proc {pid}/{nproc} coordinator={h2o.is_coordinator()} "
+      f"digest={digest}", flush=True)
+print(f"DIGEST {digest}", flush=True)
