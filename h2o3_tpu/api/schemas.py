@@ -7,6 +7,7 @@ emitted (h2o-py/h2o/backend/connection.py, frame.py, estimator_base.py);
 extra fields are additive later."""
 from __future__ import annotations
 
+import json
 import math
 import time
 from typing import Any, Dict, List, Optional
@@ -317,9 +318,23 @@ def model_v3(model, key: str) -> Dict:
              [float(v) for v in vi["scaled_importance"]],
              [float(v) for v in vi["percentage"]]],
             ["string", "double", "double", "double"])
+    cvm = model.output.get("cross_validation_models")
+    if cvm:
+        # fold models ride as key references (ModelSchemaV3 output);
+        # h2o-py _resolve_model reads [{"name": ...}]
+        out["cross_validation_models"] = [
+            keyref(getattr(m, "key", None) or f"{key}_cv_{i + 1}",
+                   "Key<Model>") for i, m in enumerate(cvm)]
     for k, v in model.output.items():
-        if k not in out and isinstance(v, (int, float, str, bool, list, dict,
-                                           type(None))):
+        if k in out or k == "cross_validation_models":
+            continue
+        if isinstance(v, (int, float, str, bool, type(None))):
+            out[k] = v
+        elif isinstance(v, (list, dict)):
+            try:
+                json.dumps(v)
+            except (TypeError, ValueError):
+                continue
             out[k] = v
     coef_fn = getattr(model, "coef", None)
     if callable(coef_fn):

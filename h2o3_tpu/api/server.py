@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import sys
 import tempfile
 import threading
 import urllib.parse
@@ -258,13 +259,20 @@ def _parse(params, body):
     header = None if chk in (None, "0") else (str(chk) == "1")
 
     job = Job(f"Parse {paths[0]}", key=None)
+    # write-lock the destination against double-parses
+    # (water/Lockable.java:25 "Parser should write-lock the output Frame")
+    dkv.write_lock(dest, job.key)
 
     def body_fn(j):
-        setup = parse_setup(paths, separator=sep, header=header,
-                            column_names=col_names, column_types=col_types)
-        fr = parse(paths, setup, key=dest)
-        dkv.put(dest, "frame", fr)
-        return fr
+        try:
+            setup = parse_setup(paths, separator=sep, header=header,
+                                column_names=col_names,
+                                column_types=col_types)
+            fr = parse(paths, setup, key=dest)
+            dkv.put(dest, "frame", fr)
+            return fr
+        finally:
+            dkv.unlock_all(j.key)
 
     job.run(body_fn, background=True)
     return {"__meta": {"schema_version": 3, "schema_name": "ParseV3"},
@@ -314,12 +322,14 @@ def _list_frames(params, body):
 
 @route("DELETE", "/3/Frames/{key}")
 def _del_frame(params, body, key):
+    dkv.check_unlocked(key)    # refuse deleting a job's in-use frame
     dkv.remove(key)
     return {}
 
 
 @route("DELETE", "/3/DKV/{key}")
 def _del_key(params, body, key):
+    dkv.check_unlocked(key)
     dkv.remove(key)
     return {}
 
@@ -329,6 +339,10 @@ def _del_keys(params, body):
     retained = set(_coerce(params.get("retained_keys", "[]")) or [])
     for k in list(dkv.keys()):
         if k not in retained:
+            try:
+                dkv.check_unlocked(k)
+            except dkv.KeyLockedError:
+                continue       # bulk clear skips in-use keys
             dkv.remove(k)
     return {}
 
@@ -348,6 +362,7 @@ def _get_model(params, body, key):
 
 @route("DELETE", "/3/Models/{key}")
 def _del_model(params, body, key):
+    dkv.check_unlocked(key)
     dkv.remove(key)
     return {}
 
@@ -393,15 +408,40 @@ def _train(params, body, algo):
 
     job = Job(f"{algo} Model Build")
     job.dest_key = model_id
+    # cooperative locking (water/Lockable.java:25): inputs read-locked,
+    # output model write-locked for the build's duration — a concurrent
+    # DELETE of the training frame now fails instead of racing the job.
+    # Partial acquisition must release what it took (the job never runs,
+    # so body_fn's unlock_all would never fire).
+    try:
+        if train_key:
+            dkv.read_lock(str(train_key), job.key)
+        if vk:
+            dkv.read_lock(str(vk if not isinstance(vk, dict)
+                              else vk["name"]), job.key)
+        dkv.write_lock(model_id, job.key)
+    except dkv.KeyLockedError:
+        dkv.unlock_all(job.key)
+        job.cancel()
+        raise
 
     def body_fn(j):
-        est.train(y=y, training_frame=frame, validation_frame=valid)
-        if est.job.status == "FAILED":
-            raise RuntimeError(est.job.exception)
-        model = est.model
-        model.key = model_id
-        dkv.put(model_id, "model", model)
-        return model
+        try:
+            est.train(y=y, training_frame=frame, validation_frame=valid)
+            if est.job.status == "FAILED":
+                raise RuntimeError(est.job.exception)
+            model = est.model
+            model.key = model_id
+            # fold models get DKV keys so the advertised
+            # cross_validation_models keyrefs resolve (ModelSchemaV3)
+            for i, fm in enumerate(
+                    model.output.get("cross_validation_models") or []):
+                fm.key = f"{model_id}_cv_{i + 1}"
+                dkv.put(fm.key, "model", fm)
+            dkv.put(model_id, "model", model)
+            return model
+        finally:
+            dkv.unlock_all(j.key)
 
     job.run(body_fn, background=True)
     return {
@@ -596,6 +636,310 @@ def _schema_meta(params, body, name):
             "schemas": [{"name": name, "fields": fields}], "routes": []}
 
 
+@route("POST", "/99/Grid/{algo}")
+def _grid_build(params, body, algo):
+    """Grid search over REST (water/api/GridSearchHandler; h2o-py
+    grid_search.py:414 wraps the returned job and then fetches
+    /99/Grids/{id})."""
+    from h2o3_tpu.models.grid import H2OGridSearch
+    builders = _builders()
+    if algo not in builders:
+        raise ApiError(404, f"unknown algorithm '{algo}'")
+    raw_keep = {k: params[k] for k in ("grid_id", "model_id",
+                                       "training_frame", "validation_frame",
+                                       "response_column", "fold_column",
+                                       "weights_column", "offset_column")
+                if k in params}
+    parms = {k: _coerce(v) for k, v in params.items()}
+    parms.update(raw_keep)
+    hyper = parms.pop("hyper_parameters", None) or {}
+    if isinstance(hyper, str):
+        hyper = json.loads(hyper)
+    criteria = parms.pop("search_criteria", None) or {}
+    if isinstance(criteria, str):
+        criteria = json.loads(criteria)
+    gid = parms.pop("grid_id", None) or dkv.unique_key(f"{algo}_grid")
+    train_key = parms.pop("training_frame", None)
+    frame = dkv.get(str(train_key), "frame")
+    valid = None
+    vk = parms.pop("validation_frame", None)
+    if vk:
+        valid = dkv.get(str(vk), "frame")
+    y = parms.pop("response_column", None)
+    parms = {k: v for k, v in parms.items() if v is not None}
+    parms.pop("_rest_version", None)
+    est = builders[algo](**parms)
+    grid = H2OGridSearch(est, hyper, search_criteria=criteria or None)
+
+    job = Job(f"{algo} grid search")
+    job.dest_key = gid
+
+    def body_fn(j):
+        grid.train(y=y, training_frame=frame, validation_frame=valid)
+        for i, m in enumerate(grid.models):
+            mid = f"{gid}_model_{i}"
+            m.key = mid
+            dkv.put(mid, "model", m)
+        dkv.put(gid, "grid", grid)
+        return grid
+
+    job.run(body_fn, background=True)
+    return {"__meta": {"schema_version": 99, "schema_name": "GridSearchV99"},
+            "job": schemas.job_v3(job, gid, "Key<Grid>"),
+            "grid_id": schemas.keyref(gid, "Key<Grid>")}
+
+
+@route("GET", "/99/Grids/{gid}")
+def _grid_get(params, body, gid):
+    grid = dkv.get(gid, "grid")
+    return {"__meta": {"schema_version": 99, "schema_name": "GridSchemaV99"},
+            "grid_id": schemas.keyref(gid, "Key<Grid>"),
+            "model_ids": [schemas.keyref(m.key, "Key<Model>")
+                          for m in grid.models],
+            "hyper_names": list(grid.hyper_params.keys()),
+            "failed_params": [], "failure_details": [],
+            "failure_stack_traces": [], "failed_raw_params": [],
+            "warning_details": [],
+            "export_checkpoints_dir": None,
+            "summary_table": None, "scoring_history": None}
+
+
+@route("GET", "/99/Grids")
+def _grids_list(params, body):
+    return {"grids": [{"grid_id": schemas.keyref(k, "Key<Grid>")}
+                      for k in dkv.keys("grid")]}
+
+
+@route("GET", "/99/Models/{key}")
+def _get_model_99(params, body, key):
+    return _get_model(params, body, key)
+
+
+def _automl_tables(aml):
+    lb = aml.leaderboard
+    metric = lb.metric if lb.rows else "auc"
+    table = schemas.twodim(
+        "Leaderboard", ["model_id", metric],
+        [[r["model_id"] for r in lb.rows],
+         [r[metric] for r in lb.rows]], ["string", "double"])
+    n_ev = len(aml.event_log)
+    # EventLogEntry schema: timestamp/level/stage/message/name/value —
+    # h2o-py _fetch() slices el[el['name'] != '', ['name', 'value']]
+    ev = schemas.twodim(
+        "Event Log",
+        ["timestamp", "level", "stage", "message", "name", "value"],
+        [[str(e["timestamp"]) for e in aml.event_log],
+         ["Info"] * n_ev,
+         [e["stage"] for e in aml.event_log],
+         [e["message"] for e in aml.event_log],
+         [""] * n_ev, [""] * n_ev],
+        ["string"] * 6)
+    return table, ev
+
+
+@route("POST", "/99/AutoMLBuilder")
+def _automl_build(params, body):
+    """AutoML over REST (water/api + ai/h2o/automl; h2o-py
+    _estimator.py:668 posts {build_control, input_spec, build_models} and
+    polls the returned job)."""
+    from h2o3_tpu.automl import H2OAutoML
+    spec = params if isinstance(params, dict) else {}
+    bc = spec.get("build_control") or {}
+    ins = spec.get("input_spec") or {}
+    bm = spec.get("build_models") or {}
+    sc = bc.get("stopping_criteria") or {}
+
+    def keyname(v):
+        return v.get("name") if isinstance(v, dict) else v
+
+    project = bc.get("project_name") or dkv.unique_key("automl")
+    train_key = keyname(ins.get("training_frame"))
+    frame = dkv.get(str(train_key), "frame")
+    valid = None
+    if ins.get("validation_frame"):
+        valid = dkv.get(str(keyname(ins["validation_frame"])), "frame")
+    lb_frame = None
+    if ins.get("leaderboard_frame"):
+        lb_frame = dkv.get(str(keyname(ins["leaderboard_frame"])), "frame")
+    y = ins.get("response_column")
+    if isinstance(y, dict):
+        y = y.get("column_name")
+    ignored = ins.get("ignored_columns") or None
+    x = None
+    if ignored:
+        x = [n for n in frame.names if n not in ignored and n != y]
+    def _num(v, default):
+        # explicit 0 is a real value (seed=0 pins the RNG) — only
+        # missing/empty falls back
+        return default if v in (None, "") else v
+
+    aml = H2OAutoML(
+        max_models=sc.get("max_models"),
+        max_runtime_secs=sc.get("max_runtime_secs"),
+        max_runtime_secs_per_model=sc.get("max_runtime_secs_per_model"),
+        nfolds=bc.get("nfolds", 3),
+        seed=_num(sc.get("seed"), -1),
+        sort_metric=ins.get("sort_metric"),
+        include_algos=bm.get("include_algos"),
+        exclude_algos=bm.get("exclude_algos"),
+        project_name=project,
+        exploitation_ratio=_num(bm.get("exploitation_ratio"), -1.0))
+    dkv.put(project, "automl", aml)
+
+    job = Job(f"AutoML {project}")
+    job.dest_key = project
+
+    def body_fn(j):
+        aml.train(x=x, y=y, training_frame=frame, validation_frame=valid,
+                  leaderboard_frame=lb_frame)
+        return aml
+
+    job.run(body_fn, background=True)
+    return {"__meta": {"schema_version": 99, "schema_name": "AutoMLBuilderV99"},
+            "job": schemas.job_v3(job, project, "Key<AutoML>"),
+            "build_control": {"project_name": project}}
+
+
+@route("GET", "/99/AutoML/{project}")
+def _automl_get(params, body, project):
+    aml = dkv.get(project, "automl")
+    table, ev = _automl_tables(aml)
+    return {"__meta": {"schema_version": 99, "schema_name": "AutoMLV99"},
+            "project_name": project,
+            "leaderboard": {"models": [schemas.keyref(m.key, "Key<Model>")
+                                       for m in aml.models]},
+            "leaderboard_table": table,
+            "event_log_table": ev}
+
+
+@route("GET", "/99/Leaderboards/{project}")
+def _leaderboard_get(params, body, project):
+    aml = dkv.get(project, "automl")
+    table, _ev = _automl_tables(aml)
+    return {"__meta": {"schema_version": 99,
+                       "schema_name": "LeaderboardV99"},
+            "project_name": project, "table": table}
+
+
+@route("GET", "/3/ModelBuilders")
+def _model_builders(params, body):
+    """Algo registry (water/api/ModelBuildersHandler list)."""
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "ModelBuildersV3"},
+            "model_builders": {a: {"algo": a, "visibility": "Stable",
+                                   "algo_full_name": a.upper()}
+                               for a in sorted(_builders())}}
+
+
+@route("GET", "/3/ModelBuilders/{algo}")
+def _model_builder_meta(params, body, algo):
+    builders = _builders()
+    if algo not in builders:
+        raise ApiError(404, f"unknown algorithm '{algo}'")
+    est = builders[algo]()
+    parameters = [{"name": k, "default_value": v, "actual_value": v,
+                   "label": k, "type": type(v).__name__, "level": "critical",
+                   "values": []}
+                  for k, v in est.params.items()
+                  if isinstance(v, (int, float, str, bool, list,
+                                    type(None)))]
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "ModelBuildersV3"},
+            "model_builders": {algo: {"algo": algo,
+                                      "parameters": parameters}}}
+
+
+@route("GET", "/3/Jobs")
+def _jobs_list(params, body):
+    from h2o3_tpu.jobs import list_jobs
+    return {"__meta": {"schema_version": 3, "schema_name": "JobsV3"},
+            "jobs": [schemas.job_v3(j, getattr(j, "dest_key", None))
+                     for j in list_jobs()]}
+
+
+@route("GET", "/3/Typeahead/files")
+def _typeahead(params, body):
+    """Path completion (water/api/TypeaheadHandler)."""
+    src = params.get("src") or "/"
+    limit = int(params.get("limit", 100) or 100)
+    base = src if os.path.isdir(src) else os.path.dirname(src) or "/"
+    prefix = "" if os.path.isdir(src) else os.path.basename(src)
+    try:
+        entries = sorted(os.listdir(base))
+    except OSError:
+        entries = []
+    matches = [os.path.join(base, e) for e in entries
+               if e.startswith(prefix)][:limit]
+    return {"__meta": {"schema_version": 3, "schema_name": "TypeaheadV3"},
+            "src": src, "limit": limit, "matches": matches}
+
+
+@route("GET", "/3/Capabilities")
+@route("GET", "/3/Capabilities/Core")
+def _capabilities(params, body):
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "CapabilitiesV3"},
+            "capabilities": [{"name": a, "category": "Algos"}
+                             for a in sorted(_builders())]}
+
+
+@route("POST", "/3/SplitFrame")
+def _split_frame(params, body):
+    """water/api/SplitFrameHandler: ratios → destination frames."""
+    from h2o3_tpu.frame.frame import Frame
+    key = _coerce(params.get("dataset"))
+    if isinstance(key, dict):
+        key = key.get("name")
+    fr = dkv.get(str(key), "frame")
+    ratios = _coerce(params.get("ratios", "[0.75]")) or [0.75]
+    dests = _bracket_list(params.get("destination_frames", "")) or None
+    seed_p = params.get("seed")
+    seed = int(seed_p) if seed_p not in (None, "") else -1
+    parts = fr.split_frame(ratios=[float(r) for r in ratios], seed=seed)
+    keys = []
+    for i, p in enumerate(parts):
+        k = (dests[i] if dests and i < len(dests)
+             else dkv.unique_key("split"))
+        dkv.put(k, "frame", p)
+        keys.append(k)
+    job = Job("SplitFrame")
+    job.dest_key = keys[0] if keys else None
+    job.run(lambda j: None, background=False)
+    return {"__meta": {"schema_version": 3, "schema_name": "SplitFrameV3"},
+            "key": schemas.keyref(job.key, "Key<Job>"),
+            "job": schemas.job_v3(job, job.dest_key, "Key<Frame>"),
+            "destination_frames": [schemas.keyref(k, "Key<Frame>")
+                                   for k in keys]}
+
+
+@route("POST", "/3/GarbageCollect")
+def _gc(params, body):
+    import gc
+    gc.collect()
+    return {}
+
+
+@route("GET", "/3/JStack")
+def _jstack(params, body):
+    """Thread dumps (water/util/JStackCollectorTask → /3/JStack)."""
+    import traceback
+    frames = sys._current_frames()
+    traces = []
+    for tid, frm in frames.items():
+        traces.append({"thread_id": tid,
+                       "stack": "".join(traceback.format_stack(frm))})
+    return {"__meta": {"schema_version": 3, "schema_name": "JStackV3"},
+            "traces": [{"node": "127.0.0.1:54321",
+                        "thread_traces": traces}]}
+
+
+@route("POST", "/3/Shutdown")
+def _shutdown(params, body):
+    """Accepted but ignored: single-controller process lifetime belongs
+    to the host (the reference kills the JVM here)."""
+    return {}
+
+
 @route("POST", "/99/Rapids")
 def _rapids(params, body):
     from h2o3_tpu.rapids import exec_rapids
@@ -661,6 +1005,13 @@ class _Handler(BaseHTTPRequestHandler):
                         "http_status": e.status, "msg": str(e),
                         "dev_msg": str(e), "exception_msg": str(e),
                         "exception_type": "ApiError", "values": {},
+                        "stacktrace": []})
+                except dkv.KeyLockedError as e:
+                    self._reply(409, {
+                        "__meta": {"schema_name": "H2OErrorV3"},
+                        "http_status": 409, "msg": str(e),
+                        "dev_msg": str(e), "exception_msg": str(e),
+                        "exception_type": "KeyLockedError", "values": {},
                         "stacktrace": []})
                 except Exception as e:  # noqa: BLE001 — wire boundary
                     import traceback

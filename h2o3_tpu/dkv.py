@@ -54,7 +54,117 @@ def keys(kind: Optional[str] = None) -> Iterable[str]:
 def clear() -> None:
     with _LOCK:
         _STORE.clear()
+        _LOCKERS.clear()
 
 
 def unique_key(prefix: str) -> str:
     return f"{prefix}_{next(_COUNTER)}"
+
+
+# ---------------- cooperative key locking (water/Lockable.java:25) -----
+#
+# The reference write-locks a job's outputs and read-locks its inputs so
+# concurrent jobs cannot overwrite in-use keys (parser write-locks its
+# destination against double-parses; model builders read-lock their
+# training frames). Same cooperative contract here, minus the
+# distributed CAS: one lock table under the store mutex.
+# _LOCKERS[key] = (write_locker_job_key or None, {read_locker_job_keys}).
+
+_LOCKERS: Dict[str, Tuple[Optional[str], set]] = {}
+
+
+class KeyLockedError(RuntimeError):
+    pass
+
+
+def write_lock(key: str, job_key: Optional[str]) -> None:
+    """Exclusive lock (Lockable.write_lock): fails if ANY other job holds
+    the key (IAE in the reference)."""
+    with _LOCK:
+        w, readers = _LOCKERS.get(key, (None, set()))
+        others = (readers - {job_key}) if job_key else readers
+        if (w is not None and w != job_key) or others:
+            raise KeyLockedError(
+                f"key '{key}' is locked by {w or sorted(others)} — "
+                f"cannot write-lock for {job_key}")
+        _LOCKERS[key] = (job_key or "<nojob>", readers)
+
+
+def read_lock(key: str, job_key: Optional[str]) -> None:
+    """Shared lock (Lockable.read_lock): fails only against a WRITE
+    locker held by another job."""
+    with _LOCK:
+        w, readers = _LOCKERS.get(key, (None, set()))
+        if w is not None and w != job_key:
+            raise KeyLockedError(
+                f"key '{key}' is write-locked by {w} — cannot read-lock "
+                f"for {job_key}")
+        readers = set(readers)
+        readers.add(job_key or "<nojob>")
+        _LOCKERS[key] = (w, readers)
+
+
+def unlock(key: str, job_key: Optional[str]) -> None:
+    with _LOCK:
+        w, readers = _LOCKERS.get(key, (None, set()))
+        jk = job_key or "<nojob>"
+        readers = set(readers) - {jk}
+        if w == jk:
+            w = None
+        if w is None and not readers:
+            _LOCKERS.pop(key, None)
+        else:
+            _LOCKERS[key] = (w, readers)
+
+
+def unlock_all(job_key: Optional[str]) -> None:
+    """Job teardown: release every lock the job holds (Scope.exit /
+    Lockable unlock-on-completion)."""
+    with _LOCK:
+        for key in list(_LOCKERS):
+            unlock(key, job_key)
+
+
+def lockers_of(key: str) -> Tuple[Optional[str], set]:
+    with _LOCK:
+        w, readers = _LOCKERS.get(key, (None, set()))
+        return w, set(readers)
+
+
+def check_unlocked(key: str) -> None:
+    """Deletion guard: DELETE /3/Frames|Models|DKV refuses keys a live
+    job still holds (the reference blocks in write_lock-then-remove)."""
+    with _LOCK:
+        w, readers = _LOCKERS.get(key, (None, set()))
+        if w is not None or readers:
+            raise KeyLockedError(
+                f"key '{key}' is in use (write={w}, readers="
+                f"{sorted(readers)}) — cancel the owning job first")
+
+
+class Scope:
+    """water/Scope.java analog: track keys created inside a with-block
+    and remove the untracked ones on exit (leak policing)."""
+
+    def __init__(self):
+        self._before: set = set()
+        self._keep: set = set()
+
+    def __enter__(self):
+        with _LOCK:
+            self._before = set(_STORE)
+        return self
+
+    def track_generic(self, key: str) -> str:
+        return key        # tracked by snapshot; kept for API parity
+
+    def untrack(self, key: str) -> str:
+        self._keep.add(key)
+        return key
+
+    def __exit__(self, *exc):
+        with _LOCK:
+            created = set(_STORE) - self._before - self._keep
+        for k in created:
+            remove(k)
+        return False

@@ -33,13 +33,15 @@ GAM_DEFAULTS: Dict = dict(
 )
 
 
-def _spline_basis(x: np.ndarray, knots: np.ndarray) -> Dict[str, np.ndarray]:
-    """Truncated-power cubic basis for one smooth term. NAs are imputed
-    to the knot median (the basis is built post-imputation, matching the
-    reference's DataInfo-imputed gam columns)."""
+def _impute(x: np.ndarray, knots: np.ndarray) -> np.ndarray:
     mid = float(knots[len(knots) // 2])
-    xv = np.where(np.isnan(x), mid, x.astype(np.float64))
-    # scale to knot span for conditioning (pure reparameterization)
+    return np.where(np.isnan(x), mid, x.astype(np.float64))
+
+
+def _basis_trunc_power(x, knots):
+    """Truncated-power cubic basis (spans the same cubic-spline space as
+    the reference's CR basis)."""
+    xv = _impute(x, knots)
     span = max(float(knots[-1] - knots[0]), 1e-12)
     z = (xv - float(knots[0])) / span
     cols = {"l": z, "q": z * z, "c": z * z * z}
@@ -49,9 +51,88 @@ def _spline_basis(x: np.ndarray, knots: np.ndarray) -> Dict[str, np.ndarray]:
     return cols
 
 
+def _basis_cr(x, knots):
+    """Natural cubic regression spline basis (bs=0, the reference
+    default — hex/gam CubicRegressionSpline): R's ns() parameterization
+    N_j(x) = d_j(x) − d_{K−1}(x), d_j(x) = ((x−k_j)³₊ − (x−k_K)³₊)
+    / (k_K − k_j), plus the linear term; linear beyond the boundary
+    knots (the 'natural' constraint CR shares)."""
+    xv = _impute(x, knots)
+    k = np.asarray(knots, np.float64)
+    K = len(k)
+    span = max(float(k[-1] - k[0]), 1e-12)
+    z = (xv - k[0]) / span
+    kz = (k - k[0]) / span
+
+    def d(j):
+        return (np.maximum(z - kz[j], 0.0) ** 3
+                - np.maximum(z - kz[-1], 0.0) ** 3) / max(
+                    kz[-1] - kz[j], 1e-12)
+
+    cols = {"l": z}
+    dK1 = d(K - 2)
+    for j in range(K - 2):
+        cols[f"n{j}"] = d(j) - dK1
+    return cols
+
+
+def _bspline_design(x, knots, order=4, antideriv=False):
+    from scipy.interpolate import BSpline
+    k = np.asarray(knots, np.float64)
+    t = np.concatenate([[k[0]] * (order - 1), k, [k[-1]] * (order - 1)])
+    nb = len(t) - order
+    cols = {}
+    for j in range(nb):
+        c = np.zeros(nb)
+        c[j] = 1.0
+        sp = BSpline(t, c, order - 1, extrapolate=False)
+        if antideriv:
+            sp = sp.antiderivative()
+            total = float(sp(k[-1]))
+            v = np.asarray(sp(np.clip(x, k[0], k[-1]))) / max(total, 1e-12)
+        else:
+            v = np.nan_to_num(np.asarray(sp(np.clip(x, k[0], k[-1]))))
+        cols[f"b{j}"] = v
+    return cols
+
+
+def _basis_ms(x, knots):
+    """M-spline (normalized B-spline) basis — bs=3 (hex/gam
+    NBSplinesTypeI)."""
+    return _bspline_design(_impute(x, knots), knots, order=4,
+                           antideriv=False)
+
+
+def _basis_is(x, knots):
+    """I-spline basis (integrated M-splines) — bs=2; paired with
+    non-negative coefficients this yields MONOTONE smooths
+    (hex/gam ISplines)."""
+    return _bspline_design(_impute(x, knots), knots, order=3,
+                           antideriv=True)
+
+
+_BASES = {None: _basis_trunc_power, -1: _basis_trunc_power,
+          0: _basis_cr, 2: _basis_is, 3: _basis_ms}
+
+
+def _spline_basis(x: np.ndarray, knots: np.ndarray,
+                  bs: Optional[int] = None) -> Dict[str, np.ndarray]:
+    """Basis dispatch by the reference's ``bs`` codes (hex/gam
+    GAMModelParameters: 0=cubic regression, 2=I-spline monotone,
+    3=M-spline; thin-plate (1) is not implemented). NAs impute to the
+    knot median (DataInfo-imputed gam columns)."""
+    fn = _BASES.get(bs)
+    if fn is None:
+        raise ValueError(f"unsupported spline type bs={bs} "
+                         f"(supported: 0=cr, 2=is, 3=ms)")
+    return fn(x, knots)
+
+
 def _expand_gam_frame(frame: Frame, gam_columns: Sequence[str],
                       knots: Dict[str, np.ndarray],
-                      keep_gam_cols: bool) -> (Frame, List[str]):
+                      keep_gam_cols: bool,
+                      bs_map: Optional[Dict[str, Optional[int]]] = None,
+                      ) -> (Frame, List[str]):
     names = []
     vecs = []
     basis_names: List[str] = []
@@ -62,7 +143,8 @@ def _expand_gam_frame(frame: Frame, gam_columns: Sequence[str],
         vecs.append(frame.vec(n))
     for gc in gam_columns:
         x = frame.vec(gc).to_numpy()
-        for suffix, col in _spline_basis(x, knots[gc]).items():
+        bs_gc = (bs_map or {}).get(gc)
+        for suffix, col in _spline_basis(x, knots[gc], bs_gc).items():
             bn = f"{gc}_tp_{suffix}"
             names.append(bn)
             vecs.append(Vec.from_numpy(col.astype(np.float32)))
@@ -73,18 +155,21 @@ def _expand_gam_frame(frame: Frame, gam_columns: Sequence[str],
 class GAMModel(Model):
     algo = "gam"
 
-    def __init__(self, key, params, spec, inner, gam_columns, knots):
+    def __init__(self, key, params, spec, inner, gam_columns, knots,
+                 bs_map=None):
         super().__init__(key, params, spec)
         self.inner = inner                      # GLMModel on expanded frame
         self.gam_columns = list(gam_columns)
         self.knots = {k: np.asarray(v) for k, v in knots.items()}
+        self.bs_map = dict(bs_map or {})
 
     def coef(self):
         return self.inner.coef()
 
     def _expand(self, frame: Frame) -> Frame:
         fr, _ = _expand_gam_frame(frame, self.gam_columns, self.knots,
-                                  bool(self.params.get("keep_gam_cols")))
+                                  bool(self.params.get("keep_gam_cols")),
+                                  self.bs_map)
         return fr
 
     def predict(self, frame: Frame) -> Frame:
@@ -109,7 +194,9 @@ class GAMModel(Model):
 
     def _save_extra_meta(self):
         return {"inner_meta": model_to_meta(self.inner),
-                "gam_columns": self.gam_columns}
+                "gam_columns": self.gam_columns,
+                "bs_map": {k: (None if v is None else int(v))
+                           for k, v in self.bs_map.items()}}
 
     @classmethod
     def _restore(cls, meta, arrays):
@@ -121,6 +208,7 @@ class GAMModel(Model):
         m.gam_columns = list(ex["gam_columns"])
         m.knots = {k[len("knots__"):]: v for k, v in arrays.items()
                    if k.startswith("knots__")}
+        m.bs_map = dict(ex.get("bs_map") or {})
         return m
 
 
@@ -164,18 +252,32 @@ class H2OGeneralizedAdditiveEstimator(ModelBuilder):
             # strictly increasing knots
             kn = np.maximum.accumulate(kn + np.arange(len(kn)) * 1e-12)
             knots[gc] = kn
+        bs = p.get("bs")
+        bs_list = (list(bs) if isinstance(bs, (list, tuple))
+                   else [bs] * len(gam_cols))
+        bs_map = {gc: (None if b is None else int(b))
+                  for gc, b in zip(gam_cols, bs_list)}
         train_x, basis_names = _expand_gam_frame(
-            training_frame, gam_cols, knots, bool(p.get("keep_gam_cols")))
+            training_frame, gam_cols, knots, bool(p.get("keep_gam_cols")),
+            bs_map)
         vf = None
         if validation_frame is not None:
             vf, _ = _expand_gam_frame(validation_frame, gam_cols, knots,
-                                      bool(p.get("keep_gam_cols")))
+                                      bool(p.get("keep_gam_cols")), bs_map)
         if x is None:
             glm_x = None
         else:
             glm_x = [c for c in x if c not in gam_cols] + basis_names
         glm_params = {k_: v for k_, v in p.items()
                       if k_ not in GAM_DEFAULTS}
+        # I-spline smooths are monotone only with non-negative
+        # coefficients ON THEIR OWN BASIS BLOCK (hex/gam ISplines): the
+        # constraint rides as a per-column mask so other predictors and
+        # other smooths keep unconstrained signs
+        is_basis = [bn for bn in basis_names
+                    if bs_map.get(bn.split("_tp_")[0]) == 2]
+        if is_basis:
+            glm_params["non_negative_columns"] = is_basis
         # default smoothing: ridge on the spline block via elastic net
         # (only when lambda is genuinely UNSET — an explicit 0 means the
         # user asked for an unpenalized fit)
@@ -189,7 +291,7 @@ class H2OGeneralizedAdditiveEstimator(ModelBuilder):
         inner = inner_est.model
         model = GAMModel(f"gam_{id(self) & 0xffffff:x}", self.params,
                          _SpecShim(training_frame, y, inner), inner,
-                         gam_cols, knots)
+                         gam_cols, knots, bs_map=bs_map)
         model.training_metrics = inner.training_metrics
         model.validation_metrics = inner.validation_metrics
         model.scoring_history = inner.scoring_history

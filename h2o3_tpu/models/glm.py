@@ -151,7 +151,7 @@ def _gram_kernel(Xe, w_irls, z):
 
 
 def _cd_elastic_net(G, b, beta0, lam_l1, lam_l2, pen_mask, n_sweeps: int,
-                    non_negative: bool = False):
+                    non_negative=False, nn_mask=None):
     """Cyclic coordinate descent on ½βᵀGβ − bᵀβ + λ₁|β|₁ + ½λ₂|β|₂²
     (glmnet 'covariance updates' — hex/glm coordinate_descent analog but on
     the reduced Gram, so each sweep is O(F²) device work, no row pass).
@@ -166,7 +166,11 @@ def _cd_elastic_net(G, b, beta0, lam_l1, lam_l2, pen_mask, n_sweeps: int,
         l1 = lam_l1 * pen_mask[j]
         bj = jnp.sign(rho) * jnp.maximum(jnp.abs(rho) - l1, 0.0)
         bj = bj / (diag[j] + lam_l2 * pen_mask[j] + 1e-12)
-        if non_negative:
+        if nn_mask is not None:
+            # per-COLUMN bound (GAM I-spline terms constrain only their
+            # own basis block)
+            bj = jnp.where(nn_mask[j] > 0, jnp.maximum(bj, 0.0), bj)
+        elif non_negative:
             # bound applies to feature coefficients only, not the
             # intercept (pen_mask 0)
             bj = jnp.where(pen_mask[j] > 0, jnp.maximum(bj, 0.0), bj)
@@ -554,6 +558,16 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
             max_iter = 50
         beta_eps = float(p.get("beta_epsilon", 1e-5))
         non_neg = bool(p.get("non_negative", False))
+        # per-column non-negativity (non_negative_columns names expanded
+        # design columns, e.g. a GAM term's I-spline basis block)
+        nn_cols = p.get("non_negative_columns") or None
+        nn_mask = None
+        if nn_cols:
+            nn_host = np.zeros(ncoef, np.float32)
+            for i, nme in enumerate(exp_names):
+                if nme in nn_cols:
+                    nn_host[i] = 1.0
+            nn_mask = jnp.asarray(nn_host)
         solver = (str(p.get("solver") or "auto")
                   ).upper().replace("-", "_")
         use_lbfgs = solver in ("L_BFGS", "LBFGS")
@@ -627,10 +641,13 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
                 G, b = _gram_kernel(Xs, w_irls, z)
                 if use_cd:
                     nb = _cd_elastic_net(G, b, beta_s, lam1, lam2, pen_mask,
-                                         n_sweeps=10, non_negative=non_neg)
+                                         n_sweeps=10, non_negative=non_neg,
+                                         nn_mask=nn_mask)
                 else:
                     nb = _cholesky_solve(G, b, lam2, pen_mask)
-                    if non_neg:
+                    if nn_mask is not None:
+                        nb = jnp.where(nn_mask > 0, jnp.maximum(nb, 0.0), nb)
+                    elif non_neg:
                         nb = jnp.where(pen_mask > 0, jnp.maximum(nb, 0.0), nb)
                 return nb
             return irls_step

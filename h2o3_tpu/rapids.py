@@ -477,7 +477,9 @@ def _sel_indices(sel, n: int, names: Optional[List[str]] = None) -> np.ndarray:
 def _apply(op: str, args, env: Env):
     ev = lambda i: _eval(args[i], env)  # noqa: E731
 
-    if op == "tmp=":
+    if op == "tmp=" or op == "assign":
+        # AstTmpAssign / AstAssign: session-temp vs global assignment —
+        # one keyed store here (the DKV collapses the distinction)
         name = args[0][1]
         valr = _eval(args[1], env)
         if isinstance(valr, Frame):
@@ -486,6 +488,651 @@ def _apply(op: str, args, env: Env):
     if op == "rm":
         dkv.remove(args[0][1])
         return 1.0
+    # ---- reducers / advmath (ast/prims/{reducers,advmath}) -------------
+    if op in ("all", "any"):
+        fr = ev(0)
+        vals = [np.asarray(fr.vec(i).to_numpy()[: fr.nrow])
+                for i in range(fr.ncol)]
+        flat = np.concatenate(vals) if vals else np.zeros(0)
+        fin = flat[np.isfinite(flat)]
+        return float((fin != 0).all() if op == "all" else (fin != 0).any())
+    if op == "any.na":
+        fr = ev(0)
+        return float(any(np.isnan(np.asarray(
+            fr.vec(i).asnumeric().to_numpy()[: fr.nrow])).any()
+            if fr.vec(i).type != T_STR else
+            any(s is None for s in fr.vec(i).to_strings()[: fr.nrow])
+            for i in range(fr.ncol)))
+    if op == "naCnt":
+        fr = ev(0)
+        return [float(fr.vec(i).rollups().get("na_count", 0))
+                for i in range(fr.ncol)]
+    if op in ("sumNA", "prod.na"):
+        # na_rm=False semantics: NA poisons the result
+        fr = ev(0)
+        out = []
+        for i in range(fr.ncol):
+            x = np.asarray(fr.vec(i).to_numpy()[: fr.nrow], np.float64)
+            out.append(float(np.sum(x) if op == "sumNA" else np.prod(x)))
+        return out[0] if len(out) == 1 else out
+    if op in ("skewness", "kurtosis", "moment"):
+        fr = ev(0)
+        na_rm = bool(_eval(args[1], env)) if len(args) > 1 else False
+        out = []
+        for i in range(fr.ncol):
+            x = np.asarray(fr.vec(i).to_numpy()[: fr.nrow], np.float64)
+            ok = np.isfinite(x)
+            if not na_rm and not ok.all():
+                out.append(float("nan"))
+                continue
+            v = x[ok]
+            m = v.mean() if v.size else float("nan")
+            s = v.std(ddof=1) if v.size > 1 else float("nan")
+            k = {"skewness": 3, "kurtosis": 4, "moment": 3}[op]
+            out.append(float(((v - m) ** k).mean() / (s ** k))
+                       if v.size > 1 and s > 0 else float("nan"))
+        return out
+    if op == "entropy":
+        # per-column Shannon entropy of STRING/enum values (AstEntropy
+        # computes per-row character entropy for strings)
+        fr = ev(0)
+        out = []
+        for i in range(fr.ncol):
+            v = fr.vec(i)
+            vals = (v.to_strings()[: fr.nrow] if v.type in (T_STR, T_ENUM)
+                    else np.asarray(v.to_numpy()[: fr.nrow]).tolist())
+            ent = []
+            for s in vals:
+                s = "" if s is None else str(s)
+                if not s:
+                    ent.append(float("nan"))
+                    continue
+                _, cnt = np.unique(list(s), return_counts=True)
+                p = cnt / cnt.sum()
+                ent.append(float(-(p * np.log2(p)).sum()))
+            out.append(Vec.from_numpy(np.asarray(ent, np.float64)))
+        return Frame(list(fr.names), out)
+    if op == "quantile":
+        # (quantile fr [probs] interpolation_method weights) -> frame with
+        # 'Probs' + per-column quantile columns (AstQtile)
+        fr = ev(0)
+        probs = _eval(args[1], env)
+        probs = [float(p) for p in (probs if isinstance(probs, list)
+                                    else [probs])]
+        names = ["Probs"]
+        cols = [Vec.from_numpy(np.asarray(probs, np.float64))]
+        for i in range(fr.ncol):
+            v = fr.vec(i)
+            if v.type not in (T_INT, T_REAL):
+                continue
+            qs = [float(q) for q in np.nanquantile(
+                np.asarray(v.to_numpy()[: fr.nrow], np.float64), probs)]
+            names.append(fr.names[i] + "Quantiles")
+            cols.append(Vec.from_numpy(np.asarray(qs, np.float64)))
+        return Frame(names, cols)
+    if op == "sumaxis":
+        # (sumaxis fr na_rm axis): frame-valued sum (AstSumAxis)
+        fr = ev(0)
+        na_rm = bool(_eval(args[1], env)) if len(args) > 1 else True
+        axis = int(_eval(args[2], env) or 0) if len(args) > 2 else 0
+        num_idx = [i for i in range(fr.ncol)
+                   if fr.vec(i).type in (T_INT, T_REAL)]
+        mats = [np.asarray(fr.vec(i).to_numpy()[: fr.nrow], np.float64)
+                for i in num_idx]
+        M = np.stack(mats) if mats else np.zeros((0, fr.nrow))
+        okm = np.isfinite(M)
+        Mz = np.where(okm, M, 0.0)
+        if axis == 1:
+            s = Mz.sum(axis=0)
+            if not na_rm:
+                s = np.where(okm.all(axis=0), s, np.nan)
+            return Frame(["sum"], [Vec.from_numpy(s)])
+        s = Mz.sum(axis=1)
+        if not na_rm:
+            s = np.where(okm.all(axis=1), s, np.nan)
+        # names track the NUMERIC columns actually summed
+        return Frame([fr.names[i] for i in num_idx],
+                     [Vec.from_numpy(np.asarray([v])) for v in s])
+    if op == "which.max" or op == "which.min":
+        fr = ev(0)
+        na_rm = bool(_eval(args[1], env)) if len(args) > 1 else True
+        axis = int(_eval(args[2], env) or 0) if len(args) > 2 else 0
+        M = np.stack([np.asarray(fr.vec(i).asnumeric().to_numpy()[: fr.nrow],
+                                 np.float64) for i in range(fr.ncol)])
+        fn = np.nanargmax if op == "which.max" else np.nanargmin
+        if axis == 1:
+            vals = np.asarray([float(fn(M[:, r])) if np.isfinite(
+                M[:, r]).any() else np.nan for r in range(fr.nrow)])
+            return Frame([op], [Vec.from_numpy(vals)])
+        vals = [float(fn(M[i])) if np.isfinite(M[i]).any() else np.nan
+                for i in range(fr.ncol)]
+        return Frame(list(fr.names),
+                     [Vec.from_numpy(np.asarray([v])) for v in vals])
+    if op == "hist":
+        # (hist fr breaks): counts/breaks/mids frame (AstHist)
+        fr = ev(0)
+        breaks = _eval(args[1], env) if len(args) > 1 else 20
+        x = np.asarray(fr.vec(0).to_numpy()[: fr.nrow], np.float64)
+        x = x[np.isfinite(x)]
+        if isinstance(breaks, list):
+            edges = np.asarray(breaks, np.float64)
+        else:
+            nb = int(breaks) if not isinstance(breaks, str) else 20
+            edges = np.histogram_bin_edges(x, bins=max(nb, 1))
+        cnt, edges = np.histogram(x, bins=edges)
+        mids = 0.5 * (edges[:-1] + edges[1:])
+        pad = np.concatenate([[np.nan], mids])
+        cntp = np.concatenate([[np.nan], cnt.astype(np.float64)])
+        return Frame(
+            ["breaks", "counts", "mids_true", "mids"],
+            [Vec.from_numpy(edges.astype(np.float64)),
+             Vec.from_numpy(cntp),
+             Vec.from_numpy(pad),
+             Vec.from_numpy(pad)])
+    # ---- munging (ast/prims/mungers) ----------------------------------
+    if op == "match":
+        # (match fr table nomatch start_index): positions of values in
+        # table (AstMatch; R match semantics, 1-based by default)
+        fr = ev(0)
+        table = _eval(args[1], env)
+        nomatch = _eval(args[2], env) if len(args) > 2 else float("nan")
+        start = int(_eval(args[3], env) or 1) if len(args) > 3 else 1
+        tab = [str(t) for t in (table if isinstance(table, list)
+                                else [table])]
+        lut = {t: i + start for i, t in enumerate(dict.fromkeys(tab))}
+        v = fr.vec(0)
+        vals = (v.to_strings()[: fr.nrow] if v.type in (T_STR, T_ENUM)
+                else [str(x) for x in np.asarray(v.to_numpy()[: fr.nrow])])
+        try:
+            nm = float(nomatch)
+        except (TypeError, ValueError):
+            nm = np.nan
+        out = np.asarray([lut.get(s, nm) for s in vals], np.float64)
+        return Frame([fr.names[0]], [Vec.from_numpy(out)])
+    if op in ("relevel", "relevel.by.freq"):
+        fr = ev(0)
+        v = fr.vec(0)
+        dom = list(v.domain or [])
+        codes = np.asarray(v.to_numpy()[: fr.nrow])
+        if op == "relevel":
+            lvl = str(_eval(args[1], env))
+            if lvl not in dom:
+                raise ValueError(f"level '{lvl}' not in domain {dom}")
+            new_dom = [lvl] + [d for d in dom if d != lvl]
+        else:
+            cnt = np.bincount(
+                np.where(np.isfinite(codes) & (codes >= 0), codes,
+                         0).astype(int), minlength=len(dom))
+            order = np.argsort(-cnt, kind="stable")
+            new_dom = [dom[i] for i in order]
+        remap = {dom.index(d): i for i, d in enumerate(new_dom)}
+        new_codes = np.asarray(
+            [remap.get(int(c), -1) if np.isfinite(c) and c >= 0 else -1
+             for c in codes], np.int32)
+        return Frame([fr.names[0]],
+                     [Vec.from_numpy(new_codes, vtype=T_ENUM,
+                                     domain=new_dom)])
+    if op in ("setLevel", "setDomain", "appendLevels"):
+        fr = ev(0)
+        v = fr.vec(0)
+        dom = list(v.domain or [])
+        codes = np.asarray(v.to_numpy()[: fr.nrow])
+        if op == "setLevel":           # constant column of one level
+            lvl = str(_eval(args[1], env))
+            if lvl not in dom:
+                raise ValueError(f"level '{lvl}' not in domain {dom}")
+            new = np.full(fr.nrow, dom.index(lvl), np.int32)
+            return Frame([fr.names[0]],
+                         [Vec.from_numpy(new, vtype=T_ENUM, domain=dom)])
+        if op == "setDomain":
+            new_dom = [str(s) for s in _eval(args[2], env)] \
+                if len(args) > 2 else [str(s) for s in _eval(args[1], env)]
+            return Frame([fr.names[0]],
+                         [Vec.from_numpy(codes.astype(np.int32),
+                                         vtype=T_ENUM, domain=new_dom)])
+        extra = [str(s) for s in _eval(args[1], env)]
+        new_dom = dom + [s for s in extra if s not in dom]
+        return Frame([fr.names[0]],
+                     [Vec.from_numpy(codes.astype(np.int32), vtype=T_ENUM,
+                                     domain=new_dom)])
+    if op == "cut":
+        # (cut fr breaks labels include_lowest right digits) — AstCut /
+        # R cut(): right=True gives (a,b] intervals; include_lowest pulls
+        # values equal to the first break into the first bin
+        fr = ev(0)
+        breaks = [float(b) for b in _eval(args[1], env)]
+        labels = _eval(args[2], env) if len(args) > 2 else None
+        lowest = bool(_eval(args[3], env)) if len(args) > 3 else False
+        right = bool(_eval(args[4], env)) if len(args) > 4 else True
+        x = np.asarray(fr.vec(0).to_numpy()[: fr.nrow], np.float64)
+        idx = np.digitize(x, breaks, right=right) - 1
+        nb = len(breaks) - 1
+        if lowest:
+            # boundary value joins the FIRST bin (right=True: x == b0;
+            # right=False: x == b_last)
+            if right:
+                idx = np.where(x == breaks[0], 0, idx)
+            else:
+                idx = np.where(x == breaks[-1], nb - 1, idx)
+        valid = np.isfinite(x) & (idx >= 0) & (idx < nb)
+        if not labels or labels in ([], None):
+            lo_b, hi_b = ("(", "]") if right else ("[", ")")
+            labels = [f"{lo_b}{breaks[i]:g},{breaks[i+1]:g}{hi_b}"
+                      for i in range(nb)]
+        codes = np.where(valid, idx, -1).astype(np.int32)
+        return Frame([fr.names[0]],
+                     [Vec.from_numpy(codes, vtype=T_ENUM,
+                                     domain=[str(l) for l in labels])])
+    if op == "difflag1":
+        fr = ev(0)
+        x = np.asarray(fr.vec(0).to_numpy()[: fr.nrow], np.float64)
+        d = np.concatenate([[np.nan], np.diff(x)])
+        return Frame([fr.names[0]], [Vec.from_numpy(d)])
+    if op == "t":
+        fr = ev(0)
+        M = np.stack([np.asarray(fr.vec(i).asnumeric().to_numpy()[: fr.nrow],
+                                 np.float64) for i in range(fr.ncol)])
+        return Frame([f"C{j+1}" for j in range(M.shape[1])],
+                     [Vec.from_numpy(M[:, j]) for j in range(M.shape[1])])
+    if op == "h2o.runif":
+        fr = ev(0)
+        seed = int(_eval(args[1], env)) if len(args) > 1 else -1
+        rng = np.random.default_rng(None if seed in (-1, None) else seed)
+        return Frame(["rnd"], [Vec.from_numpy(
+            rng.random(fr.nrow).astype(np.float64))])
+    if op in ("h2o.fillna", "fillna"):
+        # (h2o.fillna fr method axis maxlen) — forward/backward fill
+        fr = ev(0)
+        meth = str(_eval(args[1], env) or "forward").lower()
+        axis = int(_eval(args[2], env) or 0) if len(args) > 2 else 0
+        maxlen = int(_eval(args[3], env) or 1) if len(args) > 3 else 1
+        if axis != 0:
+            raise ValueError(
+                "h2o.fillna axis=1 (fill across columns) is not "
+                "implemented — use axis=0")
+        vecs = []
+        for i in range(fr.ncol):
+            x = np.asarray(fr.vec(i).to_numpy()[: fr.nrow],
+                           np.float64).copy()
+            if axis == 0:
+                run = 0
+                rng_iter = (range(1, len(x)) if meth.startswith("f")
+                            else range(len(x) - 2, -1, -1))
+                step = -1 if meth.startswith("f") else 1
+                for r in rng_iter:
+                    if np.isnan(x[r]) and not np.isnan(x[r + step]):
+                        run = run + 1 if np.isnan(x[r]) else 0
+                    if np.isnan(x[r]):
+                        src = x[r + step]
+                        if not np.isnan(src):
+                            x[r] = src
+                # maxlen enforcement: re-scan limiting runs
+                if maxlen > 0:
+                    x2 = np.asarray(fr.vec(i).to_numpy()[: fr.nrow],
+                                    np.float64)
+                    filled = np.isnan(x2) & ~np.isnan(x)
+                    run = 0
+                    idxs = (range(len(x)) if meth.startswith("f")
+                            else range(len(x) - 1, -1, -1))
+                    for r in idxs:
+                        if filled[r]:
+                            run += 1
+                            if run > maxlen:
+                                x[r] = np.nan
+                        else:
+                            run = 0
+            vecs.append(Vec.from_numpy(x))
+        return Frame(list(fr.names), vecs)
+    if op == "h2o.impute":
+        # (h2o.impute fr col method combine_method gb values) — in-place
+        # imputation; returns the imputation values (AstImpute)
+        fr = ev(0)
+        col = int(_eval(args[1], env)) if len(args) > 1 else -1
+        meth = str(_eval(args[2], env) or "mean").lower()
+        targets = ([col] if col is not None and col >= 0
+                   else list(range(fr.ncol)))
+        out_vals = []
+        vecs = [fr.vec(i) for i in range(fr.ncol)]
+        for i in targets:
+            v = vecs[i]
+            if v.type == T_ENUM and meth == "mode":
+                codes = np.asarray(v.to_numpy()[: fr.nrow])
+                fin = codes[np.isfinite(codes) & (codes >= 0)].astype(int)
+                mode = int(np.bincount(fin).argmax()) if fin.size else -1
+                newc = np.where(np.isfinite(codes) & (codes >= 0), codes,
+                                mode).astype(np.int32)
+                vecs[i] = Vec.from_numpy(newc, vtype=T_ENUM,
+                                         domain=list(v.domain))
+                out_vals.append(float(mode))
+                continue
+            x = np.asarray(v.asnumeric().to_numpy()[: fr.nrow], np.float64)
+            fin = x[np.isfinite(x)]
+            val = (float(np.median(fin)) if meth == "median"
+                   else float(fin.mean())) if fin.size else 0.0
+            vecs[i] = Vec.from_numpy(np.where(np.isfinite(x), x, val))
+            out_vals.append(val)
+        newfr = Frame(list(fr.names), vecs)
+        if args and args[0][0] == "id":
+            dkv.put(args[0][1], "frame", newfr)
+        return out_vals
+    if op == "columnsByType":
+        # (columnsByType fr coltype): 0-based indices (AstColumnsByType)
+        fr = ev(0)
+        want = str(_eval(args[1], env) or "numeric").lower()
+        tests = {"numeric": lambda v: v.type in (T_INT, T_REAL),
+                 "categorical": lambda v: v.type == T_ENUM,
+                 "string": lambda v: v.type == T_STR,
+                 "time": lambda v: v.type == "time",
+                 "numeric_int": lambda v: v.type == T_INT,
+                 "numeric_real": lambda v: v.type == T_REAL,
+                 "bad": lambda v: False,
+                 "uuid": lambda v: False}
+        t = tests.get(want, tests["numeric"])
+        return [float(i) for i in range(fr.ncol) if t(fr.vec(i))]
+    if op == "filterNACols":
+        fr = ev(0)
+        frac = float(_eval(args[1], env)) if len(args) > 1 else 0.1
+        keep = []
+        for i in range(fr.ncol):
+            na = fr.vec(i).rollups().get("na_count", 0) \
+                if fr.vec(i).type != T_STR else \
+                sum(1 for s in fr.vec(i).to_strings()[: fr.nrow]
+                    if s is None)
+            if na / max(fr.nrow, 1) < frac:
+                keep.append(float(i))
+        return keep
+    if op == "dropdup":
+        # (dropdup fr cols keep) — AstDropDuplicates
+        fr = ev(0)
+        sel = _eval(args[1], env) if len(args) > 1 else None
+        keep = str(_eval(args[2], env) or "first").lower() \
+            if len(args) > 2 else "first"
+        idx_cols = (_sel_indices(sel, fr.ncol, fr.names).tolist()
+                    if sel not in (None, []) else list(range(fr.ncol)))
+        key_rows = list(zip(*[
+            (fr.vec(int(i)).to_strings()[: fr.nrow]
+             if fr.vec(int(i)).type in (T_STR, T_ENUM)
+             else np.asarray(fr.vec(int(i)).to_numpy()[: fr.nrow]).tolist())
+            for i in idx_cols]))
+        seen = {}
+        for r, k in enumerate(key_rows):
+            if k not in seen or keep == "last":
+                seen[k] = r
+        rows = sorted(seen.values())
+        return _take_frame(fr, np.asarray(rows, np.int64))
+    if op == "rank_within_groupby":
+        # (rank_within_groupby fr groupby_cols sort_cols ascending new_col
+        #  sort_cols_sorted) — AstRankWithinGroupBy
+        fr = ev(0)
+        gcols = [int(i) for i in (_eval(args[1], env) or [])]
+        scols = [int(i) for i in (_eval(args[2], env) or [])]
+        asc = _eval(args[3], env) if len(args) > 3 else []
+        new_col = str(_eval(args[4], env) or "New_Rank_column") \
+            if len(args) > 4 else "New_Rank_column"
+        gkeys = list(zip(*[np.asarray(
+            fr.vec(i).to_numpy()[: fr.nrow]).tolist() for i in gcols])) \
+            if gcols else [()] * fr.nrow
+        svals = [np.asarray(fr.vec(i).to_numpy()[: fr.nrow], np.float64)
+                 for i in scols]
+        ascl = [int(a) for a in (asc if isinstance(asc, list)
+                                 else [asc])] or [1] * len(scols)
+        order_keys = []
+        for v, a in zip(reversed(svals), reversed(ascl)):
+            order_keys.append(v if a else -v)
+        order = np.lexsort(order_keys) if order_keys else np.arange(fr.nrow)
+        rank = np.zeros(fr.nrow, np.float64)
+        counters: Dict = {}
+        for r in order:
+            k = gkeys[r]
+            counters[k] = counters.get(k, 0) + 1
+            rank[r] = counters[k]
+        names = list(fr.names) + [new_col]
+        vecs = [fr.vec(i) for i in range(fr.ncol)] + [Vec.from_numpy(rank)]
+        return Frame(names, vecs)
+    if op == "topn":
+        # (topn fr col nPercent getBottomN) — AstTopN: top/bottom n% rows
+        fr = ev(0)
+        col = int(_eval(args[1], env))
+        pct = float(_eval(args[2], env))
+        bottom = int(_eval(args[3], env) or 0) if len(args) > 3 else 0
+        x = np.asarray(fr.vec(col).to_numpy()[: fr.nrow], np.float64)
+        fin = np.nonzero(np.isfinite(x))[0]
+        n = max(int(len(fin) * pct / 100.0), 1)
+        order = fin[np.argsort(x[fin], kind="stable")]
+        pick = order[:n] if bottom else order[::-1][:n]
+        pos = np.sort(pick)
+        return Frame(["Original_Row_Indices", fr.names[col]],
+                     [Vec.from_numpy(pos.astype(np.float64)),
+                      Vec.from_numpy(x[pos])])
+    if op == "melt":
+        # (melt fr id_vars value_vars var_name value_name skipna) — AstMelt
+        fr = ev(0)
+        id_vars = [int(i) for i in (_eval(args[1], env) or [])]
+        value_vars = [int(i) for i in (_eval(args[2], env) or [])] or \
+            [i for i in range(fr.ncol) if i not in id_vars]
+        var_name = str(_eval(args[3], env) or "variable")
+        value_name = str(_eval(args[4], env) or "value")
+        skipna = bool(_eval(args[5], env)) if len(args) > 5 else False
+        n = fr.nrow
+        id_cols = {i: np.asarray(fr.vec(i).to_numpy()[:n]).repeat(1)
+                   for i in id_vars}
+        out_ids = {i: [] for i in id_vars}
+        out_var: List[str] = []
+        out_val: List[float] = []
+        for r in range(n):
+            for vv in value_vars:
+                val = float(np.asarray(fr.vec(vv).to_numpy()[r]))
+                if skipna and not np.isfinite(val):
+                    continue
+                for i in id_vars:
+                    out_ids[i].append(id_cols[i][r])
+                out_var.append(fr.names[vv])
+                out_val.append(val)
+        names = [fr.names[i] for i in id_vars] + [var_name, value_name]
+        vecs = [Vec.from_numpy(np.asarray(out_ids[i], np.float64))
+                for i in id_vars]
+        vecs.append(Vec.from_numpy(np.asarray(out_var, dtype=object),
+                                   vtype=T_STR))
+        vecs.append(Vec.from_numpy(np.asarray(out_val, np.float64)))
+        return Frame(names, vecs)
+    if op == "pivot":
+        # (pivot fr index column value) — AstPivot
+        fr = ev(0)
+        inames = [str(_eval(a, env)) for a in args[1:4]]
+        idx_c, col_c, val_c = (fr.names.index(n) for n in inames)
+        idx_v = np.asarray(fr.vec(idx_c).to_numpy()[: fr.nrow])
+        col_v = fr.vec(col_c)
+        col_s = (col_v.to_strings()[: fr.nrow]
+                 if col_v.type in (T_STR, T_ENUM) else
+                 [str(x) for x in np.asarray(col_v.to_numpy()[: fr.nrow])])
+        val_v = np.asarray(fr.vec(val_c).to_numpy()[: fr.nrow], np.float64)
+        uniq_idx = sorted(set(idx_v.tolist()))
+        uniq_col = sorted(set(col_s))
+        pos_i = {v: i for i, v in enumerate(uniq_idx)}
+        pos_c = {v: i for i, v in enumerate(uniq_col)}
+        M = np.full((len(uniq_idx), len(uniq_col)), np.nan)
+        for r in range(fr.nrow):
+            M[pos_i[idx_v[r]], pos_c[col_s[r]]] = val_v[r]
+        names = [inames[0]] + [str(c) for c in uniq_col]
+        vecs = [Vec.from_numpy(np.asarray(uniq_idx, np.float64))]
+        vecs += [Vec.from_numpy(M[:, j]) for j in range(len(uniq_col))]
+        return Frame(names, vecs)
+    if op == "kfold_column":
+        fr = ev(0)
+        k = int(_eval(args[1], env))
+        seed = int(_eval(args[2], env)) if len(args) > 2 else -1
+        rng = np.random.default_rng(None if seed in (-1, None) else seed)
+        return Frame(["fold"], [Vec.from_numpy(
+            rng.integers(0, k, fr.nrow).astype(np.float64))])
+    if op == "modulo_kfold_column":
+        fr = ev(0)
+        k = int(_eval(args[1], env))
+        return Frame(["fold"], [Vec.from_numpy(
+            (np.arange(fr.nrow) % k).astype(np.float64))])
+    if op == "stratified_kfold_column":
+        fr = ev(0)
+        k = int(_eval(args[1], env))
+        seed = int(_eval(args[2], env)) if len(args) > 2 else -1
+        rng = np.random.default_rng(None if seed in (-1, None) else seed)
+        y = np.asarray(fr.vec(0).to_numpy()[: fr.nrow])
+        fold = np.zeros(fr.nrow, np.float64)
+        for lvl in np.unique(y[np.isfinite(y)]):
+            rows = np.nonzero(y == lvl)[0]
+            perm = rng.permutation(len(rows))
+            fold[rows[perm]] = np.arange(len(rows)) % k
+        return Frame(["fold"], [Vec.from_numpy(fold)])
+    if op == "rep_len":
+        val = _eval(args[0], env)
+        length = int(_eval(args[1], env))
+        if isinstance(val, Frame):
+            x = np.asarray(val.vec(0).to_numpy()[: val.nrow], np.float64)
+            out = np.resize(x, length)
+            return Frame([val.names[0]], [Vec.from_numpy(out)])
+        return Frame(["C1"], [Vec.from_numpy(
+            np.full(length, float(val), np.float64))])
+    if op == "flatten":
+        fr = ev(0)
+        v = fr.vec(0)
+        if v.type in (T_STR, T_ENUM):
+            s = v.to_strings()[:1]
+            return s[0] if s else None
+        val = float(np.asarray(v.to_numpy()[0]))
+        return val
+    if op == "distance":
+        # (distance fr1 fr2 measure) — AstDistance: [n1, n2] matrix
+        f1, f2 = ev(0), _eval(args[1], env)
+        measure = str(_eval(args[2], env) or "l2").lower()
+        A = np.stack([np.asarray(f1.vec(i).to_numpy()[: f1.nrow],
+                                 np.float64) for i in range(f1.ncol)], 1)
+        B = np.stack([np.asarray(f2.vec(i).to_numpy()[: f2.nrow],
+                                 np.float64) for i in range(f2.ncol)], 1)
+        if measure in ("cosine", "cosine_sq"):
+            An = A / np.maximum(np.linalg.norm(A, axis=1, keepdims=True),
+                                1e-30)
+            Bn = B / np.maximum(np.linalg.norm(B, axis=1, keepdims=True),
+                                1e-30)
+            D = An @ Bn.T
+            if measure == "cosine_sq":
+                D = D * D
+        elif measure == "l1":
+            D = np.abs(A[:, None, :] - B[None, :, :]).sum(-1)
+        else:
+            D = np.sqrt(((A[:, None, :] - B[None, :, :]) ** 2).sum(-1))
+        return Frame([f"C{j+1}" for j in range(D.shape[1])],
+                     [Vec.from_numpy(D[:, j]) for j in range(D.shape[1])])
+    # ---- string prims (ast/prims/string) -------------------------------
+    if op in ("lstrip", "rstrip"):
+        fr = ev(0)
+        chars = str(_eval(args[1], env)) if len(args) > 1 else None
+        v = fr.vec(0)
+        vals = v.to_strings()[: fr.nrow]
+        fn = (lambda s: s.lstrip(chars)) if op == "lstrip" else \
+            (lambda s: s.rstrip(chars))
+        out = np.asarray([None if s is None else fn(str(s))
+                          for s in vals], dtype=object)
+        return Frame([fr.names[0]], [Vec.from_numpy(out, vtype=T_STR)])
+    if op == "strlen":
+        fr = ev(0)
+        vals = fr.vec(0).to_strings()[: fr.nrow]
+        out = np.asarray([np.nan if s is None else float(len(str(s)))
+                          for s in vals])
+        return Frame([fr.names[0]], [Vec.from_numpy(out)])
+    if op == "countmatches":
+        fr = ev(0)
+        pats = _eval(args[1], env)
+        pats = [str(p) for p in (pats if isinstance(pats, list)
+                                 else [pats])]
+        vals = fr.vec(0).to_strings()[: fr.nrow]
+        out = np.asarray([np.nan if s is None else
+                          float(sum(str(s).count(p) for p in pats))
+                          for s in vals])
+        return Frame([fr.names[0]], [Vec.from_numpy(out)])
+    if op == "num_valid_substrings":
+        fr = ev(0)
+        path = str(_eval(args[1], env))
+        with open(path) as f:
+            words = set(w.strip() for w in f if w.strip())
+        vals = fr.vec(0).to_strings()[: fr.nrow]
+
+        def count(s):
+            n = 0
+            for i in range(len(s)):
+                for j in range(i + 1, len(s) + 1):
+                    if s[i:j] in words:
+                        n += 1
+            return float(n)
+        out = np.asarray([np.nan if s is None else count(str(s))
+                          for s in vals])
+        return Frame([fr.names[0]], [Vec.from_numpy(out)])
+    if op == "strsplit":
+        fr = ev(0)
+        pat = str(_eval(args[1], env))
+        vals = fr.vec(0).to_strings()[: fr.nrow]
+        parts = [re.split(pat, str(s)) if s is not None else []
+                 for s in vals]
+        width = max((len(p) for p in parts), default=0)
+        cols = []
+        for j in range(width):
+            cols.append(np.asarray(
+                [p[j] if j < len(p) else None for p in parts],
+                dtype=object))
+        return Frame([f"C{j+1}" for j in range(width)],
+                     [Vec.from_numpy(c, vtype=T_STR) for c in cols])
+    if op == "strDistance":
+        # (strDistance fr1 fr2 measure compare_empty) — Levenshtein only
+        f1, f2 = ev(0), _eval(args[1], env)
+        a = f1.vec(0).to_strings()[: f1.nrow]
+        b = f2.vec(0).to_strings()[: f2.nrow]
+
+        def lev(s, t):
+            if s is None or t is None:
+                return np.nan
+            s, t = str(s), str(t)
+            prev = list(range(len(t) + 1))
+            for i, cs in enumerate(s, 1):
+                cur = [i]
+                for j, ct in enumerate(t, 1):
+                    cur.append(min(prev[j] + 1, cur[j - 1] + 1,
+                                   prev[j - 1] + (cs != ct)))
+                prev = cur
+            m = max(len(s), len(t))
+            return 1.0 - prev[-1] / m if m else 1.0
+        out = np.asarray([lev(s, t) for s, t in zip(a, b)])
+        return Frame(["distance"], [Vec.from_numpy(out)])
+    if op == "grep":
+        # (grep fr regex ignore_case invert output_logical) — AstGrep
+        fr = ev(0)
+        pat = str(_eval(args[1], env))
+        icase = bool(_eval(args[2], env)) if len(args) > 2 else False
+        invert = bool(_eval(args[3], env)) if len(args) > 3 else False
+        logical = bool(_eval(args[4], env)) if len(args) > 4 else False
+        rx = re.compile(pat, re.IGNORECASE if icase else 0)
+        vals = fr.vec(0).to_strings()[: fr.nrow]
+        hits = np.asarray([bool(rx.search(str(s))) if s is not None
+                           else False for s in vals])
+        if invert:
+            hits = ~hits
+        if logical:
+            return Frame(["grep"], [Vec.from_numpy(
+                hits.astype(np.float64))])
+        return Frame(["grep"], [Vec.from_numpy(
+            np.nonzero(hits)[0].astype(np.float64))])
+    if op == "as.character":
+        fr = ev(0)
+        v = fr.vec(0)
+        vals = (v.to_strings()[: fr.nrow] if v.type in (T_STR, T_ENUM)
+                else [None if not np.isfinite(x) else
+                      (str(int(x)) if float(x).is_integer() else str(x))
+                      for x in np.asarray(v.to_numpy()[: fr.nrow],
+                                          np.float64)])
+        return Frame([fr.names[0]],
+                     [Vec.from_numpy(np.asarray(vals, dtype=object),
+                                     vtype=T_STR)])
+    if op == "listTimeZones":
+        import zoneinfo
+        tz = sorted(zoneinfo.available_timezones())
+        return Frame(["Timezones"], [Vec.from_numpy(
+            np.asarray(tz, dtype=object), vtype=T_STR)])
     if op == "ls":
         # AstLs (ast/prims/misc/AstLs.java): frame of DKV keys
         keys = sorted(dkv.keys())
@@ -566,7 +1213,27 @@ def _apply(op: str, args, env: Env):
             i += 2
         return Frame(names, vecs)
     if op in _BINOPS:
-        return _map_elementwise(_BINOPS[op], ev(0), ev(1))
+        a, b = ev(0), ev(1)
+        # string/enum comparisons against a string literal compare LABELS
+        # (AstEq/AstNe string semantics) — the device path only holds
+        # numeric codes
+        if op in ("==", "!=") and (
+                (isinstance(a, Frame) and isinstance(b, str))
+                or (isinstance(b, Frame) and isinstance(a, str))):
+            fr2, lit = (a, b) if isinstance(a, Frame) else (b, a)
+            cols = []
+            for i in range(fr2.ncol):
+                v = fr2.vec(i)
+                if v.type in (T_STR, T_ENUM):
+                    vals = v.to_strings()[: fr2.nrow]
+                    eq = np.asarray([1.0 if (s is not None and str(s) == lit)
+                                     else 0.0 for s in vals])
+                else:
+                    eq = np.zeros(fr2.nrow)
+                cols.append(Vec.from_numpy(
+                    eq if op == "==" else 1.0 - eq))
+            return Frame(list(fr2.names), cols)
+        return _map_elementwise(_BINOPS[op], a, b)
     if op in _UNOPS:
         return _map_elementwise(_UNOPS[op], ev(0))
     if op == "cols_py" or op == "cols":

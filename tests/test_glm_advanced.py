@@ -105,9 +105,10 @@ def test_lbfgs_wide_sharded():
     beta = np.zeros(f, np.float32)
     beta[:20] = np.linspace(-1, 1, 20)
     logit = X @ beta
-    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+    yv = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(int)
     cols = {f"x{i}": X[:, i] for i in range(f)}
-    cols["y"] = y
+    # factor response → binomial metrics (AUC) instead of regression
+    cols["y"] = np.array(["no", "yes"], dtype=object)[yv]
     fr = h2o.Frame.from_numpy(cols)
     est = H2OGeneralizedLinearEstimator(family="binomial", Lambda=1e-4,
                                         alpha=0.0, solver="L_BFGS",

@@ -156,3 +156,34 @@ def test_modelselection_sizes_are_exact():
     for r in ms.model.result():
         assert len(r["predictors"]) == r["size"]
         assert len(set(r["predictors"])) == r["size"]  # no duplicates
+
+
+def test_gam_spline_bases():
+    """bs spline-type codes (hex/gam: 0=CR, 2=I-spline monotone,
+    3=M-spline) fit a known smooth; I-splines give a monotone smooth."""
+    import numpy as np
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.gam import H2OGeneralizedAdditiveEstimator
+    rng = np.random.default_rng(0)
+    n = 3000
+    x = rng.uniform(-2.5, 2.5, n).astype(np.float32)
+    y = (np.sin(1.5 * x) + 0.15 * rng.normal(size=n)).astype(np.float32)
+    fr = h2o.Frame.from_numpy({"x": x, "y": y})
+    for bs in (0, 3):
+        est = H2OGeneralizedAdditiveEstimator(
+            family="gaussian", gam_columns=["x"], num_knots=8, bs=[bs])
+        est.train(y="y", training_frame=fr)
+        m = est.model.model_performance(fr)
+        assert m.r2 > 0.85, (bs, m.r2)
+    # monotone target + I-splines: fitted curve is non-decreasing
+    y2 = (np.tanh(2 * x) + 0.1 * rng.normal(size=n)).astype(np.float32)
+    fr2 = h2o.Frame.from_numpy({"x": x, "y": y2})
+    est = H2OGeneralizedAdditiveEstimator(
+        family="gaussian", gam_columns=["x"], num_knots=8, bs=[2])
+    est.train(y="y", training_frame=fr2)
+    xs = np.linspace(-2.4, 2.4, 101).astype(np.float32)
+    sf = h2o.Frame.from_numpy({"x": xs})
+    ps = np.asarray(est.model.predict(sf).vec(0).to_numpy()[:101])
+    assert (np.diff(ps) >= -1e-4).all()
+    perf = est.model.model_performance(fr2)
+    assert perf.r2 > 0.8, perf.r2

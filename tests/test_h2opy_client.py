@@ -104,6 +104,35 @@ def test_save_load_roundtrip(client, prostate, tmp_path):
     np.testing.assert_allclose(a1, a2, rtol=1e-5)
 
 
+def test_grid_search_via_client(client, prostate):
+    """Real h2o-py H2OGridSearch over POST /99/Grid/{algo} +
+    GET /99/Grids/{id} (h2o-py/h2o/grid/grid_search.py:414-426)."""
+    from h2o.grid.grid_search import H2OGridSearch
+    from h2o.estimators import H2OGradientBoostingEstimator
+    grid = H2OGridSearch(H2OGradientBoostingEstimator(ntrees=3, seed=1),
+                         hyper_params={"max_depth": [2, 3]})
+    grid.train(y="CAPSULE", x=["AGE", "PSA"], training_frame=prostate)
+    assert len(grid.model_ids) == 2
+    perf = grid.models[0].model_performance(prostate)
+    assert perf.auc() > 0.5
+
+
+def test_automl_via_client(client, prostate):
+    """Real h2o-py H2OAutoML over POST /99/AutoMLBuilder +
+    GET /99/AutoML/{id} + GET /99/Leaderboards/{id}
+    (h2o-py/h2o/automl/_estimator.py:668, _base.py:315-334)."""
+    from h2o.automl import H2OAutoML
+    aml = H2OAutoML(max_models=2, nfolds=2, seed=1,
+                    include_algos=["GLM", "GBM"])
+    aml.train(y="CAPSULE", x=["AGE", "PSA", "GLEASON"],
+              training_frame=prostate)
+    assert aml.leader is not None
+    lb = aml.leaderboard
+    assert lb.nrow >= 2
+    pred = aml.leader.predict(prostate)
+    assert pred.dim == [380, 3]
+
+
 def test_ls_and_remove(client, prostate):
     keys = client.ls()
     assert len(keys) > 0

@@ -1,0 +1,161 @@
+"""Round-4 Rapids prim batch: reducers/advmath, mungers, string,
+fold-column and reshaping prims the h2o-py client can emit
+(water/rapids/ast/prims/{reducers,advmath,mungers,string,misc})."""
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu import dkv
+from h2o3_tpu.rapids import exec_rapids
+
+
+@pytest.fixture()
+def fr():
+    f = h2o.Frame.from_numpy({
+        "x": np.array([3.0, 1.0, 2.0, np.nan, 5.0]),
+        "y": np.array([10.0, 20.0, 30.0, 40.0, 50.0]),
+        "s": np.array(["  ab", "cd  ", "a b", None, "xyz"], dtype=object)})
+    dkv.put("p2", "frame", f)
+    return f
+
+
+def _frame(r):
+    return dkv.get(r["key"]["name"], "frame")
+
+
+def test_reducers(fr):
+    assert exec_rapids("(any.na p2)")["scalar"] == 1.0
+    assert exec_rapids("(naCnt p2)")["scalar"][0] == 1.0
+    assert exec_rapids("(all (> (cols_py p2 'y') 5))")["scalar"] == 1.0
+    assert exec_rapids("(any (> (cols_py p2 'y') 45))")["scalar"] == 1.0
+
+
+def test_skew_kurt():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=5000).astype(np.float64)
+    dkv.put("sk", "frame", h2o.Frame.from_numpy({"x": x}))
+    sk = exec_rapids("(skewness sk True)")["scalar"][0]
+    ku = exec_rapids("(kurtosis sk True)")["scalar"][0]
+    assert abs(sk) < 0.2
+    assert abs(ku - 3.0) < 0.3
+
+
+def test_quantile_and_hist(fr):
+    r = exec_rapids("(quantile (cols_py p2 'y') [0.0 0.5 1.0] 'interpolate' _)")
+    q = _frame(r)
+    got = np.asarray(q.vec("yQuantiles").to_numpy()[:3])
+    np.testing.assert_allclose(got, [10, 30, 50])
+    r = exec_rapids("(hist (cols_py p2 'y') 4)")
+    hf = _frame(r)
+    assert "counts" in hf.names and hf.nrow >= 4
+
+
+def test_match_relevel_cut():
+    f = h2o.Frame.from_numpy({
+        "c": np.array(["a", "b", "c", "b"], dtype=object)})
+    dkv.put("mr", "frame", f)
+    r = exec_rapids("(match (cols_py mr 'c') ['b' 'c'] _ 1)")
+    out = _frame(r)
+    vals = np.asarray(out.vec(0).to_numpy()[:4])
+    assert np.isnan(vals[0]) and vals[1] == 1 and vals[2] == 2
+    r = exec_rapids("(relevel (cols_py mr 'c') 'c')")
+    rl = _frame(r)
+    assert rl.vec(0).domain[0] == "c"
+    f2 = h2o.Frame.from_numpy({"x": np.array([0.5, 1.5, 2.5, 3.5])})
+    dkv.put("cu", "frame", f2)
+    r = exec_rapids("(cut (cols_py cu 'x') [0 1 2 3 4] [] False True 3)")
+    cf = _frame(r)
+    assert cf.vec(0).type == "enum"
+    np.testing.assert_array_equal(cf.vec(0).to_numpy()[:4], [0, 1, 2, 3])
+
+
+def test_string_prims(fr):
+    r = exec_rapids("(strlen (cols_py p2 's'))")
+    ln = np.asarray(_frame(r).vec(0).to_numpy()[:5])
+    assert ln[0] == 4 and np.isnan(ln[3])
+    r = exec_rapids("(lstrip (cols_py p2 's') ' ')")
+    assert _frame(r).vec(0).to_strings()[0] == "ab"
+    r = exec_rapids("(countmatches (cols_py p2 's') ['a'])")
+    cm = np.asarray(_frame(r).vec(0).to_numpy()[:5])
+    assert cm[0] == 1 and cm[2] == 1
+    r = exec_rapids("(grep (cols_py p2 's') 'a' False False True)")
+    g = np.asarray(_frame(r).vec(0).to_numpy()[:5])
+    np.testing.assert_array_equal(g, [1, 0, 1, 0, 0])
+    r = exec_rapids("(strsplit (cols_py p2 's') ' ')")
+    sp = _frame(r)
+    assert sp.ncol >= 2
+
+
+def test_fold_columns(fr):
+    r = exec_rapids("(kfold_column p2 3 42)")
+    f = np.asarray(_frame(r).vec(0).to_numpy()[:5])
+    assert set(f).issubset({0.0, 1.0, 2.0})
+    r = exec_rapids("(modulo_kfold_column p2 2)")
+    np.testing.assert_array_equal(_frame(r).vec(0).to_numpy()[:5],
+                                  [0, 1, 0, 1, 0])
+    r = exec_rapids("(stratified_kfold_column (cols_py p2 'y') 2 7)")
+    assert _frame(r).nrow == 5
+
+
+def test_melt_pivot():
+    f = h2o.Frame.from_numpy({"id": np.array([1.0, 2.0]),
+                              "a": np.array([10.0, 20.0]),
+                              "b": np.array([30.0, 40.0])})
+    dkv.put("mp", "frame", f)
+    r = exec_rapids("(melt mp [0] [1 2] 'variable' 'value' False)")
+    m = _frame(r)
+    assert m.nrow == 4 and set(m.names) == {"id", "variable", "value"}
+    dkv.put("mm", "frame", m)
+    r = exec_rapids("(pivot mm 'id' 'variable' 'value')")
+    p = _frame(r)
+    assert p.nrow == 2 and "a" in p.names and "b" in p.names
+    np.testing.assert_allclose(p.vec("a").to_numpy()[:2], [10, 20])
+
+
+def test_topn_rank_dropdup():
+    f = h2o.Frame.from_numpy({"g": np.array([1.0, 1.0, 2.0, 2.0, 2.0]),
+                              "v": np.array([5.0, 3.0, 9.0, 1.0, 9.0])})
+    dkv.put("tr", "frame", f)
+    r = exec_rapids("(topn tr 1 40 0)")
+    t = _frame(r)
+    assert 9.0 in np.asarray(t.vec(1).to_numpy()[: t.nrow])
+    r = exec_rapids("(rank_within_groupby tr [0] [1] [1] 'rk' 0)")
+    rk = _frame(r)
+    vals = np.asarray(rk.vec("rk").to_numpy()[:5])
+    assert vals[1] == 1.0 and vals[0] == 2.0     # within group 1: 3 < 5
+    r = exec_rapids("(dropdup tr [0] 'first')")
+    dd = _frame(r)
+    assert dd.nrow == 2
+
+
+def test_misc(fr):
+    r = exec_rapids("(t (cols_py p2 ['x' 'y']))")
+    t = _frame(r)
+    assert t.nrow == 2 and t.ncol == 5
+    r = exec_rapids("(h2o.runif p2 42)")
+    u = np.asarray(_frame(r).vec(0).to_numpy()[:5])
+    assert ((0 <= u) & (u < 1)).all()
+    r = exec_rapids("(difflag1 (cols_py p2 'y'))")
+    d = np.asarray(_frame(r).vec(0).to_numpy()[:5])
+    assert np.isnan(d[0]) and d[1] == 10.0
+    assert exec_rapids("(columnsByType p2 'numeric')")["scalar"] == [0.0, 1.0]
+    # x has 1 NA and s has 1 None out of 5 rows (20% >= 10%): only y kept
+    assert exec_rapids("(filterNACols p2 0.1)")["scalar"] == [1.0]
+    r = exec_rapids("(h2o.fillna (cols_py p2 'x') 'forward' 0 1)")
+    fl = np.asarray(_frame(r).vec(0).to_numpy()[:5])
+    assert fl[3] == 2.0
+    r = exec_rapids("(rep_len 7 4)")
+    assert _frame(r).nrow == 4
+    assert exec_rapids("(flatten (cols_py p2 'y'))")["scalar"] == 10.0
+
+
+def test_distance():
+    a = h2o.Frame.from_numpy({"x": np.array([0.0, 3.0]),
+                              "y": np.array([0.0, 4.0])})
+    b = h2o.Frame.from_numpy({"x": np.array([0.0]),
+                              "y": np.array([0.0])})
+    dkv.put("da", "frame", a)
+    dkv.put("db", "frame", b)
+    r = exec_rapids("(distance da db 'l2')")
+    d = np.asarray(_frame(r).vec(0).to_numpy()[:2])
+    np.testing.assert_allclose(d, [0.0, 5.0])
