@@ -82,23 +82,24 @@ def job_v3(job, dest_key: Optional[str] = None, dest_type: str = "Key<Model>") -
     }
 
 
-def _col_v3(name: str, vec, preview_rows: int) -> Dict:
+def _col_v3(name: str, vec, preview_rows: int, row_offset: int = 0) -> Dict:
     from h2o3_tpu.frame.vec import T_ENUM, T_INT, T_REAL, T_STR, T_TIME
     r = vec.rollups() if vec.type not in (T_STR,) else {}
     tmap = {T_INT: "int", T_REAL: "real", T_ENUM: "enum", T_STR: "string",
             T_TIME: "time"}
+    lo, hi = row_offset, row_offset + preview_rows
     if vec.type == T_STR:
         data = None
-        strs = [s for s in vec.to_strings()[:preview_rows]]
+        strs = [s for s in vec.to_strings()[lo:hi]]
     elif vec.type == T_ENUM:
         # enum NA is code -1 (ENUM_NA), which IS finite — emit None so
         # clients don't render domain[-1] (the last level) for NA cells
-        codes = np.asarray(vec.to_numpy()[:preview_rows])
+        codes = np.asarray(vec.to_numpy()[lo:hi])
         data = [None if (not np.isfinite(c) or c < 0) else float(c)
                 for c in codes]
         strs = None
     else:
-        vals = np.asarray(vec.to_numpy()[:preview_rows], dtype=np.float64)
+        vals = np.asarray(vec.to_numpy()[lo:hi], dtype=np.float64)
         data = [None if not np.isfinite(v) else float(v) for v in vals]
         strs = None
 
@@ -137,27 +138,34 @@ def _col_v3(name: str, vec, preview_rows: int) -> Dict:
 
 
 def frame_v3(frame, key: str, row_count: int = 10,
-             column_count: Optional[int] = None) -> Dict:
-    ncols = frame.ncol if column_count in (None, 0, -1) else min(
-        column_count, frame.ncol)
-    preview = min(row_count, frame.nrow)
+             column_count: Optional[int] = None, row_offset: int = 0,
+             column_offset: int = 0) -> Dict:
+    """FrameV3 with the reference's pagination contract
+    (water/api/FramesHandler row_offset/row_count/column_offset/
+    column_count windows — h2o-py pages wide/long frames this way)."""
+    row_offset = max(0, min(int(row_offset), frame.nrow))
+    column_offset = max(0, min(int(column_offset), frame.ncol))
+    ncols = (frame.ncol - column_offset if column_count in (None, 0, -1)
+             else min(column_count, frame.ncol - column_offset))
+    preview = min(row_count, frame.nrow - row_offset)
+    sel = frame.names[column_offset:column_offset + ncols]
     return {
         "__meta": {"schema_version": 3, "schema_name": "FrameV3",
                    "schema_type": "Frame"},
         "frame_id": keyref(key, "Key<Frame>"),
         "rows": frame.nrow,
         "row_count": preview,
-        "row_offset": 0,
+        "row_offset": row_offset,
         "column_count": ncols,
-        "column_offset": 0,
+        "column_offset": column_offset,
         "total_column_count": frame.ncol,
         "byte_size": int(frame.nrow) * frame.ncol * 4,
         "is_text": False,
         "num_columns": frame.ncol,
         "default_percentiles": [0.01, 0.1, 0.25, 0.333, 0.5, 0.667, 0.75,
                                 0.9, 0.99],
-        "columns": [_col_v3(n, frame.vec(n), preview)
-                    for n in frame.names[:ncols]],
+        "columns": [_col_v3(n, frame.vec(n), preview, row_offset)
+                    for n in sel],
         "compatible_models": [],
         "chunk_summary": None,
         "distribution_summary": None,

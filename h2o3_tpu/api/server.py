@@ -304,7 +304,9 @@ def _get_frame(params, body, key):
     fr = dkv.get(key, "frame")
     rc = int(params.get("row_count", 10) or 10)
     cc = int(params.get("column_count", -1) or -1)
-    return schemas.frames_v3([schemas.frame_v3(fr, key, rc, cc)])
+    ro = int(params.get("row_offset", 0) or 0)
+    co = int(params.get("column_offset", 0) or 0)
+    return schemas.frames_v3([schemas.frame_v3(fr, key, rc, cc, ro, co)])
 
 
 @route("GET", "/3/Frames/{key}/summary")
@@ -673,15 +675,29 @@ def _grid_build(params, body, algo):
 
     job = Job(f"{algo} grid search")
     job.dest_key = gid
+    # same Lockable contract as /3/ModelBuilders: inputs read-locked,
+    # output grid write-locked for the search's duration
+    try:
+        dkv.read_lock(str(train_key), job.key)
+        if vk:
+            dkv.read_lock(str(vk), job.key)
+        dkv.write_lock(gid, job.key)
+    except dkv.KeyLockedError:
+        dkv.unlock_all(job.key)
+        job.cancel()
+        raise
 
     def body_fn(j):
-        grid.train(y=y, training_frame=frame, validation_frame=valid)
-        for i, m in enumerate(grid.models):
-            mid = f"{gid}_model_{i}"
-            m.key = mid
-            dkv.put(mid, "model", m)
-        dkv.put(gid, "grid", grid)
-        return grid
+        try:
+            grid.train(y=y, training_frame=frame, validation_frame=valid)
+            for i, m in enumerate(grid.models):
+                mid = f"{gid}_model_{i}"
+                m.key = mid
+                dkv.put(mid, "model", m)
+            dkv.put(gid, "grid", grid)
+            return grid
+        finally:
+            dkv.unlock_all(j.key)
 
     job.run(body_fn, background=True)
     return {"__meta": {"schema_version": 99, "schema_name": "GridSearchV99"},
@@ -788,11 +804,24 @@ def _automl_build(params, body):
 
     job = Job(f"AutoML {project}")
     job.dest_key = project
+    try:
+        dkv.read_lock(str(train_key), job.key)
+        if ins.get("validation_frame"):
+            dkv.read_lock(str(keyname(ins["validation_frame"])), job.key)
+        if ins.get("leaderboard_frame"):
+            dkv.read_lock(str(keyname(ins["leaderboard_frame"])), job.key)
+    except dkv.KeyLockedError:
+        dkv.unlock_all(job.key)
+        job.cancel()
+        raise
 
     def body_fn(j):
-        aml.train(x=x, y=y, training_frame=frame, validation_frame=valid,
-                  leaderboard_frame=lb_frame)
-        return aml
+        try:
+            aml.train(x=x, y=y, training_frame=frame,
+                      validation_frame=valid, leaderboard_frame=lb_frame)
+            return aml
+        finally:
+            dkv.unlock_all(j.key)
 
     job.run(body_fn, background=True)
     return {"__meta": {"schema_version": 99, "schema_name": "AutoMLBuilderV99"},

@@ -461,8 +461,69 @@ def pojo_source(model, class_name: Optional[str] = None) -> str:
     return "\n".join(lines) + "\n"
 
 
+def pojo_source_glm(model, class_name: Optional[str] = None) -> str:
+    """GLM POJO (water/util/JCodeGen + GLM's POJO emit): the cats-first
+    beta layout from the MOJO writer, scored with the same skip-level-0
+    indicator logic as GlmMojoModel.glmScore0."""
+    if model.family not in ("gaussian", "binomial", "poisson", "gamma"):
+        raise ValueError(
+            f"GLM POJO export supports gaussian/binomial/poisson/gamma "
+            f"(got family='{model.family}')")
+    cls = class_name or f"glm_pojo_{abs(hash(model.key)) % 10 ** 8}"
+    beta, cat_offsets, num_means = _beta_glm_layout(model)
+    cat_idx, num_idx = _split_design(model)
+    names = model.feature_names
+    columns = [names[i] for i in cat_idx] + [names[i] for i in num_idx]
+    link = {"gaussian": "eta", "binomial": "1.0 / (1.0 + Math.exp(-eta))",
+            "poisson": "Math.exp(eta)", "gamma": "Math.exp(eta)"}[
+                model.family]
+    lines = [
+        "// Auto-generated GLM POJO (water/util/JCodeGen shape);",
+        "// beta layout matches GlmMojoModelBase (cats first, intercept",
+        "// last, level 0 of each factor dropped).",
+        f"public class {cls} {{",
+        "  public static final String[] NAMES = {"
+        + ", ".join(f'"{n}"' for n in columns) + "};",
+        "  public static final double[] BETA = {"
+        + ", ".join(repr(float(v)) for v in beta) + "};",
+        "  public static final int[] CAT_OFFSETS = {"
+        + ", ".join(str(v) for v in cat_offsets) + "};",
+        "  public static final double[] NUM_MEANS = {"
+        + ", ".join(repr(float(v)) for v in num_means) + "};",
+        f"  public static final int CATS = {len(cat_idx)};",
+        f"  public static final int NUMS = {len(num_idx)};",
+        "  public static double[] score0(double[] data, double[] preds) {",
+        "    double eta = 0.0;",
+        "    for (int i = 0; i < CATS; i++) {",
+        "      int code = Double.isNaN(data[i]) ? 0 : (int) data[i];",
+        "      if (code != 0) {",
+        "        int ival = CAT_OFFSETS[i] + code - 1;",
+        "        if (ival < CAT_OFFSETS[i + 1]) eta += BETA[ival];",
+        "      }",
+        "    }",
+        "    int noff = CATS > 0 ? CAT_OFFSETS[CATS] : 0;",
+        "    for (int i = 0; i < NUMS; i++) {",
+        "      double v = data[CATS + i];",
+        "      if (Double.isNaN(v)) v = NUM_MEANS[i];",
+        "      eta += BETA[noff + i] * v;",
+        "    }",
+        "    eta += BETA[BETA.length - 1];",
+        f"    double mu = {link};",
+    ]
+    if model.nclasses == 2:
+        lines += ["    preds[0] = mu > 0.5 ? 1 : 0;",
+                  "    preds[1] = 1.0 - mu; preds[2] = mu;"]
+    else:
+        lines += ["    preds[0] = mu;"]
+    lines += ["    return preds;", "  }", "}"]
+    return "\n".join(lines) + "\n"
+
+
 def export_pojo(model, path: str, class_name: Optional[str] = None) -> str:
-    src = pojo_source(model, class_name)
+    if getattr(model, "algo", "") == "glm":
+        src = pojo_source_glm(model, class_name)
+    else:
+        src = pojo_source(model, class_name)
     with open(path, "w") as f:
         f.write(src)
     return path

@@ -568,6 +568,10 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
                 if nme in nn_cols:
                     nn_host[i] = 1.0
             nn_mask = jnp.asarray(nn_host)
+            if non_neg:
+                # global non_negative composes with the column mask —
+                # the mask must not silently NARROW the user's constraint
+                nn_mask = jnp.maximum(nn_mask, pen_mask.astype(jnp.float32))
         solver = (str(p.get("solver") or "auto")
                   ).upper().replace("-", "_")
         use_lbfgs = solver in ("L_BFGS", "LBFGS")

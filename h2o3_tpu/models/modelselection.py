@@ -4,12 +4,14 @@ Reference: hex/modelselection/ModelSelection.java:24 — modes maxr,
 maxrsweep, forward, backward over GLM; reports the best predictor subset
 per model size with R²/deviance, using sweep operators on the Gram.
 
-TPU re-design: every candidate fit is one MXU Gram + Cholesky solve
-(gaussian: exact in one IRLS step), so greedy search over subsets is a
-sequence of cheap device solves on a SHARED design — the data is
-expanded and standardized once per refit by the GLM path. maxrsweep
-collapses into maxr (same result, the sweep is an implementation detail
-of the JVM)."""
+TPU re-design: maxr/forward/backward fit each candidate with one MXU
+Gram + Cholesky solve (gaussian: exact in one IRLS step) on a shared
+design. maxrsweep is the REAL sweep-operator mode: the augmented
+weighted Gram [[X'WX, X'Wy], [y'WX, y'Wy]] is computed ONCE on device,
+each candidate's SSE-if-added reads off the swept matrix in O(1)
+(a_yy − a_jy²/a_jj), and accepting a predictor is one O(p²) sweep — no
+per-candidate refits at all (ModelSelection.java maxrsweep, gaussian
+only like the reference)."""
 from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
@@ -66,6 +68,61 @@ class ModelSelectionModel(Model):
         return m
 
 
+def _sweep(A: np.ndarray, k: int) -> np.ndarray:
+    """The SWEEP operator on pivot k (hex/modelselection sweep-vector
+    machinery / Goodnight 1979): after sweeping pivots S of the
+    augmented Gram [[X'X, X'y], [y'X, y'y]], the bottom-right cell is
+    the SSE of regressing y on X_S and the X'y column holds β_S."""
+    d = A[k, k]
+    if abs(d) < 1e-12:
+        return A          # singular pivot: skip (collinear column)
+    B = A - np.outer(A[:, k], A[k, :]) / d
+    B[:, k] = A[:, k] / d
+    B[k, :] = A[k, :] / d
+    B[k, k] = -1.0 / d
+    return B
+
+
+def _maxrsweep_gaussian(Xe: np.ndarray, yv: np.ndarray, w: np.ndarray,
+                        names: List[str], max_k: int):
+    """Forward maxrsweep: ONE augmented weighted Gram, then each
+    candidate's SSE-if-added reads off the current swept matrix in O(1)
+    (a_yy − a_jy²/a_jj) — no per-candidate refits, the reference's
+    maxrsweep efficiency trick (hex/modelselection/ModelSelection.java
+    maxrsweep mode, gaussian only)."""
+    n, p = Xe.shape
+    ones = np.ones((n, 1))
+    Z = np.concatenate([ones, Xe, yv[:, None]], axis=1)  # [n, p+2]
+    Wz = Z * w[:, None]
+    A = Z.T @ Wz                                          # augmented Gram
+    A = _sweep(A, 0)                                      # intercept always in
+    yy = p + 1
+    chosen: List[int] = []
+    steps = []
+    for _ in range(max_k):
+        best_j, best_sse = None, None
+        for j in range(p):
+            if j in chosen:
+                continue
+            jj = A[1 + j, 1 + j]
+            if jj <= 1e-12:
+                continue
+            sse = A[yy, yy] - A[1 + j, yy] ** 2 / jj
+            if best_sse is None or sse < best_sse:
+                best_sse, best_j = sse, j
+        if best_j is None:
+            break
+        A = _sweep(A, 1 + best_j)
+        chosen.append(best_j)
+        beta = {names[j]: float(A[1 + j, yy]) for j in chosen}
+        beta["Intercept"] = float(A[0, yy])
+        steps.append({"size": len(chosen),
+                      "predictors": [names[j] for j in chosen],
+                      "sse": float(A[yy, yy]),
+                      "coefficients": beta})
+    return steps
+
+
 class H2OModelSelectionEstimator(ModelBuilder):
     algo = "modelselection"
 
@@ -115,7 +172,46 @@ class H2OModelSelectionEstimator(ModelBuilder):
                     fitted[key] = self._fit(list(key), y, training_frame)
                 return fitted[key]
 
-            if mode in ("maxr", "maxrsweep", "forward"):
+            fam = (p.get("family") or "auto").lower()
+            if mode == "maxrsweep":
+                if fam not in ("auto", "gaussian"):
+                    raise ValueError(
+                        "maxrsweep supports gaussian only (the reference's "
+                        "sweep-operator mode, ModelSelection.java)")
+                import jax as _jax
+                from h2o3_tpu.models.glm import expand_design
+                from h2o3_tpu.models.model_base import build_training_spec
+                spec = build_training_spec(
+                    training_frame, y, x=preds,
+                    weights_column=p.get("weights_column"),
+                    classification=False)
+                Xe, exp_names, _means = expand_design(spec)
+                nrow = spec.nrow
+                Xh = np.asarray(_jax.device_get(Xe),
+                                np.float64)[:nrow]
+                yh = np.asarray(_jax.device_get(spec.y),
+                                np.float64)[:nrow]
+                wh = np.asarray(_jax.device_get(spec.w),
+                                np.float64)[:nrow]
+                steps = _maxrsweep_gaussian(Xh, yh, wh, exp_names, max_k)
+                tss = float((wh * (yh - np.average(yh, weights=wh))
+                             ** 2).sum())
+                for s in steps:
+                    s["r2"] = 1.0 - s["sse"] / max(tss, 1e-30)
+                    s["deviance"] = s["sse"]
+                    results.append(s)
+                    job.update(1.0)
+                # final model: plain GLM refit on the best subset's BASE
+                # columns (expanded enum levels 'col.lvl' collapse back)
+                # — keeps the Model surface: predict/metrics/persist
+                best_sz = min(results, key=lambda r: r["deviance"])
+                base_cols = []
+                for c in best_sz["predictors"]:
+                    b = c.split(".")[0] if c.split(".")[0] in preds else c
+                    if b not in base_cols:
+                        base_cols.append(b)
+                m = fit(base_cols)
+            elif mode in ("maxr", "forward"):
                 chosen: List[str] = []
                 for k in range(1, max_k + 1):
                     # greedy add

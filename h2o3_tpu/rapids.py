@@ -508,12 +508,14 @@ def _apply(op: str, args, env: Env):
         return [float(fr.vec(i).rollups().get("na_count", 0))
                 for i in range(fr.ncol)]
     if op in ("sumNA", "prod.na"):
-        # na_rm=False semantics: NA poisons the result
+        # the NA-SKIPPING variants (AstSumNa — h2o-py emits these for
+        # skipna=True; the plain sum/prod propagate NA)
         fr = ev(0)
         out = []
         for i in range(fr.ncol):
             x = np.asarray(fr.vec(i).to_numpy()[: fr.nrow], np.float64)
-            out.append(float(np.sum(x) if op == "sumNA" else np.prod(x)))
+            out.append(float(np.nansum(x) if op == "sumNA"
+                             else np.nanprod(x)))
         return out[0] if len(out) == 1 else out
     if op in ("skewness", "kurtosis", "moment"):
         fr = ev(0)
@@ -660,9 +662,8 @@ def _apply(op: str, args, env: Env):
                 raise ValueError(f"level '{lvl}' not in domain {dom}")
             new_dom = [lvl] + [d for d in dom if d != lvl]
         else:
-            cnt = np.bincount(
-                np.where(np.isfinite(codes) & (codes >= 0), codes,
-                         0).astype(int), minlength=len(dom))
+            valid = codes[np.isfinite(codes) & (codes >= 0)].astype(int)
+            cnt = np.bincount(valid, minlength=len(dom))
             order = np.argsort(-cnt, kind="stable")
             new_dom = [dom[i] for i in order]
         remap = {dom.index(d): i for i, d in enumerate(new_dom)}
@@ -914,14 +915,18 @@ def _apply(op: str, args, env: Env):
         value_name = str(_eval(args[4], env) or "value")
         skipna = bool(_eval(args[5], env)) if len(args) > 5 else False
         n = fr.nrow
-        id_cols = {i: np.asarray(fr.vec(i).to_numpy()[:n]).repeat(1)
+        id_cols = {i: np.asarray(fr.vec(i).to_numpy()[:n])
                    for i in id_vars}
+        # hoist value columns ONCE (Vec.to_numpy copies the whole column
+        # per call — per-cell access would be O(rows² · cols))
+        val_cols = {vv: np.asarray(fr.vec(vv).to_numpy()[:n], np.float64)
+                    for vv in value_vars}
         out_ids = {i: [] for i in id_vars}
         out_var: List[str] = []
         out_val: List[float] = []
         for r in range(n):
             for vv in value_vars:
-                val = float(np.asarray(fr.vec(vv).to_numpy()[r]))
+                val = float(val_cols[vv][r])
                 if skipna and not np.isfinite(val):
                     continue
                 for i in id_vars:

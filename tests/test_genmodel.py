@@ -137,3 +137,42 @@ def test_easypredict_row_api():
     wrap2 = EasyPredictModelWrapper(scorer)
     out3 = wrap2.predict_row({"c": "hi", "x0": 1.0, "x1": 0.0})
     assert out3["label"] in ("n", "p")
+
+
+def test_glm_pojo_shape(tmp_path):
+    from h2o3_tpu.genmodel import export_pojo, pojo_source_glm
+    from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+    fr, *_ = _frame_with_cats(seed=8)
+    glm = H2OGeneralizedLinearEstimator(family="binomial", Lambda=0.0)
+    glm.train(y="y", training_frame=fr)
+    src = pojo_source_glm(glm.model, class_name="GlmPojo")
+    assert "public class GlmPojo" in src
+    assert "BETA" in src and "CAT_OFFSETS" in src
+    assert src.count("{") == src.count("}")
+    p = export_pojo(glm.model, str(tmp_path / "GlmPojo.java"),
+                    class_name="GlmPojo")
+    assert os.path.exists(p)
+
+
+def test_frames_pagination_rest():
+    """FrameV3 row/column windows (water/api/FramesHandler pagination)."""
+    import json
+    import urllib.request
+    import h2o3_tpu
+    from h2o3_tpu import dkv
+    from h2o3_tpu.api import start_server
+    h2o3_tpu.init()
+    srv = start_server(port=0)
+    fr = h2o.Frame.from_numpy(
+        {f"c{i}": np.arange(100, dtype=np.float32) + i for i in range(6)})
+    dkv.put("pagefr", "frame", fr)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/3/Frames/pagefr"
+            f"?row_count=5&row_offset=10&column_count=2&column_offset=3",
+            timeout=60) as resp:
+        fw = json.loads(resp.read())["frames"][0]
+    assert fw["row_offset"] == 10 and fw["column_offset"] == 3
+    assert [c["label"] for c in fw["columns"]] == ["c3", "c4"]
+    assert fw["columns"][0]["data"][0] == 13.0   # row 10 of c3 = 10+3
+    srv.stop()
+    dkv.remove("pagefr")

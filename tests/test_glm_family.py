@@ -187,3 +187,47 @@ def test_gam_spline_bases():
     assert (np.diff(ps) >= -1e-4).all()
     perf = est.model.model_performance(fr2)
     assert perf.r2 > 0.8, perf.r2
+
+
+def test_modelselection_maxrsweep_matches_exhaustive():
+    """maxrsweep's sweep-operator forward path finds the same subsets as
+    exhaustive least squares, with matching R² (hex/modelselection
+    maxrsweep vs maxr equivalence on orthogonal-ish designs)."""
+    import itertools
+    import numpy as np
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.modelselection import H2OModelSelectionEstimator
+    rng = np.random.default_rng(3)
+    n, p = 1500, 6
+    X = rng.normal(size=(n, p)).astype(np.float32)
+    beta = np.array([3.0, 0.0, 1.5, 0.0, -2.0, 0.1])
+    y = (X @ beta + 0.3 * rng.normal(size=n)).astype(np.float32)
+    cols = {f"x{i}": X[:, i] for i in range(p)}
+    cols["y"] = y
+    fr = h2o.Frame.from_numpy(cols)
+    est = H2OModelSelectionEstimator(mode="maxrsweep",
+                                     max_predictor_number=3)
+    est.train(y="y", training_frame=fr)
+    res = est.model.result()
+    assert [r["size"] for r in res] == [1, 2, 3]
+    # exhaustive ground truth per size via numpy lstsq
+    Xd = X.astype(np.float64)
+    yd = y.astype(np.float64)
+
+    def sse_of(idx):
+        A = np.concatenate([np.ones((n, 1)), Xd[:, list(idx)]], axis=1)
+        r = yd - A @ np.linalg.lstsq(A, yd, rcond=None)[0]
+        return float(r @ r)
+
+    for r in res:
+        k = r["size"]
+        best = min(itertools.combinations(range(p), k), key=sse_of)
+        got = tuple(sorted(int(c[1:]) for c in r["predictors"]))
+        assert got == tuple(sorted(best)), (k, got, best)
+        assert abs(r["sse"] - sse_of(best)) < 1e-3 * sse_of(best)
+    # r2 monotone nondecreasing with size
+    r2s = [r["r2"] for r in res]
+    assert r2s == sorted(r2s)
+    # the final refit model predicts
+    pred = est.model.predict(fr)
+    assert pred.nrow == n
