@@ -466,16 +466,37 @@ def _kind_of(m) -> str:
             "Multinomial" if m.nclasses > 2 else "Regression")
 
 
-def _start_predict_job(model, frame, dest=None):
+def _start_predict_job(model, frame, dest=None, options=None):
+    """Scoring job honoring hex/Model.java scoring options: plain
+    predictions, predict_contributions (TreeSHAP), leaf_node_assignment,
+    predict_staged_proba (water/api/ModelMetricsHandler.java predict)."""
     m = dkv.get(model, "model")
     fr = dkv.get(frame, "frame")
     dest = dest or dkv.unique_key("prediction")
     job = Job(f"prediction {model} on {frame}")
     job.dest_key = dest
     job.dest_type = "Key<Frame>"
+    # h2o-py serializes booleans via str() — route every option through
+    # _coerce so "False" doesn't arrive truthy
+    opts = {k: _coerce(v) for k, v in (options or {}).items()}
 
     def body_fn(j):
-        pred = m.predict(fr)
+        if opts.get("predict_contributions"):
+            of = str(opts.get("predict_contributions_output_format")
+                     or "Original").lower()
+            pred = m.predict_contributions(
+                fr, output_format=of,
+                top_n=int(opts.get("top_n") or 0),
+                bottom_n=int(opts.get("bottom_n") or 0),
+                compare_abs=bool(opts.get("compare_abs")))
+        elif opts.get("leaf_node_assignment"):
+            pred = m.predict_leaf_node_assignment(
+                fr, type=str(opts.get("leaf_node_assignment_type")
+                             or "Path"))
+        elif opts.get("predict_staged_proba"):
+            pred = m.staged_predict_proba(fr)
+        else:
+            pred = m.predict(fr)
         dkv.put(dest, "frame", pred)
         return pred
 
@@ -492,7 +513,7 @@ def _predict_async(params, body, model, frame):
     H2OResponse dispatches any schema starting with 'ModelMetrics' to a
     metrics object and H2OJob.__init__ chokes on it."""
     m, fr, dest, job = _start_predict_job(
-        model, frame, params.get("predictions_frame"))
+        model, frame, params.get("predictions_frame"), options=params)
     return schemas.job_v3(job, dest, "Key<Frame>")
 
 
@@ -500,7 +521,7 @@ def _predict_async(params, body, model, frame):
 def _predict(params, body, model, frame):
     """Sync scoring + metrics (hex/Model.java:1919 score → BigScore)."""
     m, fr, dest, job = _start_predict_job(
-        model, frame, params.get("predictions_frame"))
+        model, frame, params.get("predictions_frame"), options=params)
     job.join()
     perf = None
     try:

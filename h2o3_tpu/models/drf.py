@@ -53,7 +53,10 @@ DRF_DEFAULTS: Dict = dict(
 )
 
 
-class DRFModel(Model):
+from h2o3_tpu.models.treeshap import TreeScoringOptionsMixin  # noqa: E402
+
+
+class DRFModel(TreeScoringOptionsMixin, Model):
     algo = "drf"
 
     def __init__(self, key, params, spec, trees_host, edges, n_bins,
@@ -69,6 +72,19 @@ class DRFModel(Model):
         self._na_left = jnp.asarray(trees_host["na_left"])
         self._is_split = jnp.asarray(trees_host["is_split"])
         self._value = jnp.asarray(trees_host["value"])
+        nw = trees_host.get("node_w")
+        self._node_w = jnp.asarray(nw) if nw is not None else None
+
+    def _contrib_scale(self):
+        # forest prediction = MEAN over trees, so each tree's SHAP values
+        # scale by 1/T (contributions live in probability/response space)
+        return 1.0 / max(self.ntrees_built, 1)
+
+    def staged_predict_proba(self, frame):
+        # cumulative margins are a boosting concept; DRF trees are
+        # independent probability votes (reference restricts this to GBM)
+        raise ValueError("staged_predict_proba is not supported for DRF "
+                         "(GBM/XGBoost only, hex/Model.java)")
 
     def _predict_matrix(self, X, offset=None):
         contribs = predict_raw_stacked(X, self._feat, self._thr, self._na_left,
@@ -96,6 +112,8 @@ class DRFModel(Model):
              "na_left": np.asarray(jax.device_get(self._na_left)),
              "is_split": np.asarray(jax.device_get(self._is_split)),
              "value": np.asarray(jax.device_get(self._value))}
+        if self._node_w is not None:
+            d["node_w"] = np.asarray(jax.device_get(self._node_w))
         for i, e in enumerate(self.edges):
             d[f"edge_{i}"] = np.asarray(e)
         return d
@@ -119,6 +137,8 @@ class DRFModel(Model):
         m._na_left = jnp.asarray(arrays["na_left"])
         m._is_split = jnp.asarray(arrays["is_split"])
         m._value = jnp.asarray(arrays["value"])
+        m._node_w = (jnp.asarray(arrays["node_w"])
+                     if "node_w" in arrays else None)
         return m
 
 
@@ -346,8 +366,9 @@ class H2ORandomForestEstimator(ModelBuilder):
                                    for t in host])
             thr = np.stack([bins_to_thresholds(sbin[i], feat[i], bm.edges)
                             for i in range(T)])
+        node_w = np.concatenate([t["node_w"].reshape(-1, M) for t in host])
         trees_host = {"feat": feat, "thr": thr, "na_left": nal,
-                      "is_split": spl, "value": val}
+                      "is_split": spl, "value": val, "node_w": node_w}
         model = DRFModel(f"{self.algo}_{id(self) & 0xffffff:x}", self.params,
                          spec, trees_host,
                          bm.edges if bm is not None else [],

@@ -60,7 +60,10 @@ GBM_DEFAULTS: Dict = dict(
 )
 
 
-class GBMModel(Model):
+from h2o3_tpu.models.treeshap import TreeScoringOptionsMixin  # noqa: E402
+
+
+class GBMModel(TreeScoringOptionsMixin, Model):
     algo = "gbm"
 
     def __init__(self, key, params, spec, dist_name, f0, trees_host, edges,
@@ -79,6 +82,11 @@ class GBMModel(Model):
         self._na_left = jnp.asarray(trees_host["na_left"])
         self._is_split = jnp.asarray(trees_host["is_split"])
         self._value = jnp.asarray(trees_host["value"])
+        nw = trees_host.get("node_w")
+        self._node_w = jnp.asarray(nw) if nw is not None else None
+
+    def _contrib_f0(self) -> float:
+        return float(np.asarray(self.f0).reshape(-1)[0])
 
     def _margin_matrix(self, X, offset=None):
         contribs = predict_raw_stacked(X, self._feat, self._thr, self._na_left,
@@ -119,6 +127,8 @@ class GBMModel(Model):
              "is_split": np.asarray(jax.device_get(self._is_split)),
              "value": np.asarray(jax.device_get(self._value)),
              "f0": np.asarray(self.f0)}
+        if self._node_w is not None:
+            d["node_w"] = np.asarray(jax.device_get(self._node_w))
         for i, e in enumerate(self.edges):
             d[f"edge_{i}"] = np.asarray(e)
         return d
@@ -145,6 +155,8 @@ class GBMModel(Model):
         m._na_left = jnp.asarray(arrays["na_left"])
         m._is_split = jnp.asarray(arrays["is_split"])
         m._value = jnp.asarray(arrays["value"])
+        m._node_w = (jnp.asarray(arrays["node_w"])
+                     if "node_w" in arrays else None)
         return m
 
 
@@ -597,6 +609,7 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         spl = np.concatenate([t["is_split"].reshape(-1, M) for t in host])
         val = np.concatenate([t["value"].reshape(-1, M) for t in host])
         gains = np.concatenate([t["gain"].reshape(-1, M) for t in host])
+        node_w = np.concatenate([t["node_w"].reshape(-1, M) for t in host])
         lr0 = float(self.params["learn_rate"])
         anneal = float(self.params["learn_rate_annealing"])
         lrs = lr0 * anneal ** np.repeat(
@@ -610,7 +623,7 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             thr = np.stack([bins_to_thresholds(sbin[i], feat[i], bm.edges)
                             for i in range(T)])
         trees_host = {"feat": feat, "thr": thr, "na_left": nal,
-                      "is_split": spl, "value": val_scaled}
+                      "is_split": spl, "value": val_scaled, "node_w": node_w}
         if prior is not None:
             # checkpoint continuation: prepend the prior model's trees
             # (already lr-scaled) in (tree, class) order
@@ -620,6 +633,9 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                 "na_left": np.concatenate([np.asarray(prior._na_left), nal]),
                 "is_split": np.concatenate([np.asarray(prior._is_split), spl]),
                 "value": np.concatenate([np.asarray(prior._value), val_scaled]),
+                "node_w": (np.concatenate([np.asarray(prior._node_w), node_w])
+                           if getattr(prior, "_node_w", None) is not None
+                           else None),
             }
         f0_host = np.asarray(jax.device_get(f0))
         model = GBMModel(f"{self.algo}_{id(self) & 0xffffff:x}", self.params,

@@ -78,6 +78,31 @@ def test_gbm_train_perf_predict(client, prostate):
     assert vi and len(vi[0]) == 4
 
 
+def test_predict_contributions_via_client(client, prostate):
+    """model.predict_contributions over REST (TreeSHAP,
+    hex/genmodel/algos/tree/TreeSHAP.java; /4/Predictions
+    predict_contributions=True). Local accuracy: contributions + bias
+    == margin (logit of p1)."""
+    from h2o.estimators import H2OGradientBoostingEstimator
+    gbm = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=42)
+    cols = ["AGE", "RACE", "PSA", "GLEASON"]
+    gbm.train(y="CAPSULE", x=cols, training_frame=prostate)
+    contrib = gbm.predict_contributions(prostate)
+    assert contrib.names == cols + ["BiasTerm"]
+    mat = np.array(contrib.as_data_frame(use_pandas=False)[1:], dtype=float)
+    total = mat.sum(axis=1)
+    pred = gbm.predict(prostate)
+    p1 = np.array([r[2] for r in
+                   pred.as_data_frame(use_pandas=False)[1:]], dtype=float)
+    margin = np.log(np.clip(p1, 1e-12, 1) / np.clip(1 - p1, 1e-12, 1))
+    assert np.allclose(total, margin, atol=5e-3)
+    # leaf assignment + staged probabilities through the same route
+    leaves = gbm.predict_leaf_node_assignment(prostate, type="Path")
+    assert leaves.dim[1] == 5
+    staged = gbm.staged_predict_proba(prostate)
+    assert staged.dim == [380, 10]
+
+
 def test_glm_train_coef(client, prostate):
     from h2o.estimators import H2OGeneralizedLinearEstimator
     glm = H2OGeneralizedLinearEstimator(family="binomial", lambda_=0.0)

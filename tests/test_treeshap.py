@@ -1,0 +1,128 @@
+"""TreeSHAP contributions + scoring options.
+
+Property (hex/genmodel/algos/tree/TreeSHAP.java local-accuracy): per row,
+sum(contributions) + BiasTerm == model margin/prediction to float tol.
+"""
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+from h2o3_tpu.models.drf import H2ORandomForestEstimator
+
+
+def _frame(n=400, f=4, seed=0, classification=True, with_na=True):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    if with_na:
+        X[rng.random((n, f)) < 0.07] = np.nan
+    x2 = X[:, 2] if f > 2 else X[:, 0]
+    logit = np.nan_to_num(X[:, 0] - 0.8 * X[:, 1] + 0.5 * x2 * X[:, 0])
+    if classification:
+        y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.int32)
+    else:
+        y = (logit + 0.1 * rng.normal(size=n)).astype(np.float32)
+    cols = {f"x{i}": X[:, i] for i in range(f)}
+    if classification:
+        cols["y"] = np.array(["no", "yes"], dtype=object)[y]
+    else:
+        cols["y"] = y.astype(np.float32)
+    return h2o.Frame.from_numpy(cols), X
+
+
+def _check_local_accuracy(model, fr, X, margin_fn, tol=2e-4):
+    contrib = model.predict_contributions(fr)
+    names = contrib.names
+    assert names[-1] == "BiasTerm"
+    assert names[:-1] == [f"x{i}" for i in range(X.shape[1])]
+    mat = np.column_stack([np.asarray(contrib.vec(n).to_numpy())
+                           for n in names])
+    total = mat.sum(axis=1)
+    expect = margin_fn()
+    np.testing.assert_allclose(total, expect, atol=tol, rtol=1e-3)
+
+
+def test_gbm_binomial_contributions_sum_to_margin():
+    fr, X = _frame(classification=True)
+    gbm = H2OGradientBoostingEstimator(ntrees=12, max_depth=4, nbins=16,
+                                       seed=1, distribution="bernoulli",
+                                       score_tree_interval=0)
+    gbm.train(y="y", training_frame=fr)
+    m = gbm.model
+    pred = m.predict(fr)
+    p1 = np.asarray(pred.vec(2).to_numpy())
+
+    def margin():
+        return np.log(np.clip(p1, 1e-12, 1) / np.clip(1 - p1, 1e-12, 1))
+
+    _check_local_accuracy(m, fr, X, margin, tol=5e-3)
+
+
+def test_gbm_regression_contributions_and_depth_dupes():
+    # 2 features + depth 5 forces duplicate features on paths (the
+    # EXTEND/UNWIND merge branch)
+    fr, X = _frame(f=2, classification=False)
+    gbm = H2OGradientBoostingEstimator(ntrees=10, max_depth=5, nbins=16,
+                                       seed=3, distribution="gaussian",
+                                       score_tree_interval=0)
+    gbm.train(y="y", training_frame=fr)
+    m = gbm.model
+    pred = np.asarray(m.predict(fr).vec("predict").to_numpy())
+    _check_local_accuracy(m, fr, X, lambda: pred, tol=2e-3)
+
+
+def test_drf_contributions_probability_space():
+    fr, X = _frame(classification=True, with_na=False)
+    drf = H2ORandomForestEstimator(ntrees=8, max_depth=4, nbins=16, seed=5)
+    drf.train(y="y", training_frame=fr)
+    m = drf.model
+    p1 = np.asarray(m.predict(fr).vec(2).to_numpy())
+    _check_local_accuracy(m, fr, X, lambda: p1, tol=2e-3)
+
+
+def test_leaf_node_assignment_and_staged():
+    fr, X = _frame(classification=True)
+    gbm = H2OGradientBoostingEstimator(ntrees=6, max_depth=3, nbins=16,
+                                       seed=2, distribution="bernoulli",
+                                       score_tree_interval=0)
+    gbm.train(y="y", training_frame=fr)
+    m = gbm.model
+    paths = m.predict_leaf_node_assignment(fr, type="Path")
+    assert paths.ncol == 6
+    s = paths.vec("T1").to_numpy()[0]
+    assert isinstance(s, str) and len(s) <= 3 and set(s) <= {"L", "R"}
+    ids = m.predict_leaf_node_assignment(fr, type="Node_ID")
+    v = np.asarray(ids.vec("T1").to_numpy())
+    assert v.min() >= 0 and v.max() < 2 ** 4 - 1 + 2 ** 3  # within tree array
+    staged = m.staged_predict_proba(fr)
+    assert staged.ncol == 12
+    final_p1 = np.asarray(staged.vec("p1_T6").to_numpy())
+    p1 = np.asarray(m.predict(fr).vec(2).to_numpy())
+    np.testing.assert_allclose(final_p1, p1, atol=1e-5)
+
+
+def test_contributions_top_n():
+    fr, X = _frame(classification=False)
+    gbm = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, nbins=16,
+                                       seed=4, distribution="gaussian",
+                                       score_tree_interval=0)
+    gbm.train(y="y", training_frame=fr)
+    out = gbm.model.predict_contributions(fr, top_n=2)
+    assert out.names[:2] == ["top_feature_1", "top_value_1"]
+    v1 = np.asarray(out.vec("top_value_1").to_numpy())
+    v2 = np.asarray(out.vec("top_value_2").to_numpy())
+    assert (v1 >= v2 - 1e-6).all()
+
+
+def test_contributions_multinomial_raises():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 3)).astype(np.float32)
+    y = rng.integers(0, 3, 200)
+    cols = {f"x{i}": X[:, i] for i in range(3)}
+    cols["y"] = np.array(["a", "b", "c"], dtype=object)[y]
+    fr = h2o.Frame.from_numpy(cols)
+    gbm = H2OGradientBoostingEstimator(ntrees=3, max_depth=3, seed=1,
+                                       score_tree_interval=0)
+    gbm.train(y="y", training_frame=fr)
+    with pytest.raises(ValueError, match="binomial"):
+        gbm.model.predict_contributions(fr)

@@ -1,0 +1,180 @@
+"""Ablation timing of the TRANSPOSED adaptive level kernel (deepest
+level, N=32) — where does a level's time go?
+
+Variants knock out one phase each; delta vs full = that phase's cost.
+Feeds nid2 back between fori_loop reps so XLA can't hoist/CSE (memory:
+axon microbench pitfalls).  Run: python tools/kern_ablate_t.py
+"""
+import sys, os, time, functools
+sys.path.insert(0, '/root/repo')
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+ROWS = 10_002_432
+F, W, N = 28, 32, 32
+TILE = int(os.environ.get("TILE", 8192))
+REPS = 10
+_VM = 100 * 1024 * 1024
+
+
+def _unsplit3(p_hi, p_mid, p_lo):
+    return p_hi + (p_mid * (1 / 256.) + p_lo * (1 / 65536.))
+
+
+def make_kernel(ablate):
+    n_prev = N // 2
+    base = N - 1
+
+    def kern(x_ref, nid_ref, ghw_ref, tabs_ref, loinv_ref, nid_out,
+             hist_out, acc_ref):
+        r = pl.program_id(0)
+
+        @pl.when(r == 0)
+        def _init():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        xt = x_ref[...]                              # [F, TILE]
+        nid = nid_ref[0, :]
+        if ablate != "route":
+            prev_base = base - n_prev
+            lid_p = nid - prev_base
+            onp = (jax.lax.broadcasted_iota(jnp.int32, (n_prev, TILE), 0)
+                   == lid_p[None, :]).astype(jnp.bfloat16)
+            lut3 = jax.lax.dot_general(tabs_ref[:, :n_prev], onp,
+                                       (((1,), (0,)), ((), ())),
+                                       preferred_element_type=jnp.float32)
+            lut = _unsplit3(lut3[0:4], lut3[4:8], lut3[8:12])
+            f_r, t_r, nl_r, cn_r = lut[0], lut[1], lut[2], lut[3]
+            fi = jax.lax.broadcasted_iota(jnp.int32, (F, TILE), 0)
+            xsel = jnp.sum(jnp.where(fi == f_r.astype(jnp.int32)[None, :],
+                                     xt, 0.0), axis=0)
+            gr_f = jnp.where(jnp.isnan(xsel), 1.0 - nl_r,
+                             (xsel >= t_r).astype(jnp.float32))
+            in_prev = (lid_p >= 0) & (lid_p < n_prev)
+            child = 2 * nid + 1 + gr_f.astype(jnp.int32)
+            nid = jnp.where(in_prev & (cn_r > 0.5), child, nid)
+        nid_out[0, :] = nid
+
+        lid = nid - base
+        in_lvl = (lid >= 0) & (lid < N)
+        lidc = jnp.where(in_lvl, lid, 0)
+        onh = (jax.lax.broadcasted_iota(jnp.int32, (N, TILE), 0)
+               == lidc[None, :])
+        onh_f = onh.astype(jnp.float32) * in_lvl.astype(jnp.float32)[None, :]
+        onh_b = onh_f.astype(jnp.bfloat16)
+        if ablate != "ranges":
+            lr3 = jax.lax.dot_general(loinv_ref[...], onh_b,
+                                      (((1,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+            lr = _unsplit3(lr3[:2 * F], lr3[2 * F:4 * F], lr3[4 * F:])
+            lo_r = lr[:F]
+            inv_r = lr[F:]
+        else:
+            lo_r = jnp.zeros((F, TILE), jnp.float32) - 4.0
+            inv_r = jnp.zeros((F, TILE), jnp.float32) + 3.75
+        bin_f = jnp.floor(jnp.clip((xt - lo_r) * inv_r, 0.0, float(W - 2)))
+        bin_v = jnp.where(jnp.isnan(xt), float(W - 1), bin_f)
+        if ablate == "onehot":
+            # skip the [F*W, TILE] build: reuse a cheap broadcast of bin row
+            oh_t = jnp.broadcast_to(bin_v[:1, :], (F * W, TILE)
+                                    ).astype(jnp.bfloat16)
+        elif ablate == "repeat":
+            # keep compare, skip sublane repeat (compare vs single row)
+            brow = jax.lax.broadcasted_iota(jnp.int32, (F * W, TILE), 0)
+            oh_t = ((brow % W).astype(jnp.float32)
+                    == jnp.broadcast_to(bin_v[:1, :], (F * W, TILE))
+                    ).astype(jnp.bfloat16)
+        else:
+            b_all = jnp.repeat(bin_v, W, axis=0)
+            brow = jax.lax.broadcasted_iota(jnp.int32, (F * W, TILE), 0)
+            oh_t = ((brow % W).astype(jnp.float32) == b_all
+                    ).astype(jnp.bfloat16)
+        ghw = ghw_ref[...]
+        left = jnp.concatenate(
+            [onh_f.astype(jnp.bfloat16) * ghw[k, :][None, :
+             ].astype(jnp.bfloat16) for k in range(3)], axis=0)
+        if ablate != "matmul":
+            acc_ref[...] += jax.lax.dot_general(
+                left, oh_t, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        else:
+            acc_ref[...] += (jnp.sum(left, axis=1, keepdims=True)
+                             + jnp.sum(oh_t.astype(jnp.float32)))
+
+        @pl.when(r == REPS * 0 + (ROWS // TILE) - 1)
+        def _flush():
+            hist_out[...] = acc_ref[...]
+
+    return kern
+
+
+def run(ablate):
+    n_tiles = ROWS // TILE
+    kern = make_kernel(ablate)
+    call = pl.pallas_call(
+        kern,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((F, TILE), lambda r: (0, r)),
+            pl.BlockSpec((1, TILE), lambda r: (0, r)),
+            pl.BlockSpec((3, TILE), lambda r: (0, r)),
+            pl.BlockSpec((12, N // 2), lambda r: (0, 0)),
+            pl.BlockSpec((6 * F, N), lambda r: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, TILE), lambda r: (0, r)),
+            pl.BlockSpec((3 * N, F * W), lambda r: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, ROWS), jnp.int32),
+            jax.ShapeDtypeStruct((3 * N, F * W), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((3 * N, F * W), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VM),
+    )
+
+    rng = np.random.default_rng(0)
+    xt = jnp.asarray(rng.normal(size=(F, ROWS)).astype(np.float32))
+    nid0 = jnp.asarray(rng.integers(15, 31, ROWS).astype(np.int32))
+    ghw = jnp.asarray(rng.normal(size=(3, ROWS)).astype(np.float32))
+    tabs = jnp.asarray(rng.normal(size=(12, N // 2)).astype(np.float32)
+                       ).astype(jnp.bfloat16)
+    loinv = jnp.asarray(rng.normal(size=(6 * F, N)).astype(np.float32)
+                        ).astype(jnp.bfloat16)
+
+    @jax.jit
+    def loop(nid):
+        def body(i, carry):
+            nid, acc = carry
+            nid2, hist = call(xt, nid[None, :], ghw, tabs, loinv)
+            # feed nid back (mod to keep in prev-level range) so no CSE
+            nid = jnp.clip(nid2[0] % 16 + 15, 15, 30)
+            return nid, acc + hist[0, 0]
+        return jax.lax.fori_loop(0, REPS, body, (nid, 0.0))
+
+    out = loop(nid0)
+    jax.block_until_ready(out)
+    t0 = time.time()
+    out = loop(nid0)
+    jax.block_until_ready(out)
+    dt = (time.time() - t0) / REPS
+    return dt
+
+
+if __name__ == "__main__":
+    names = ["full", "route", "ranges", "repeat", "onehot", "matmul"]
+    if len(sys.argv) > 1:
+        names = sys.argv[1:]
+    base = None
+    for n in names:
+        dt = run(n)
+        if n == "full":
+            base = dt
+        extra = (f"  delta={1000*(base-dt):+.2f}ms"
+                 if base is not None and n != "full" else "")
+        print(f"{n:8s}: {dt*1000:7.2f} ms/level "
+              f"({ROWS/dt/1e6:7.1f} M rows/s){extra}", flush=True)
