@@ -10,11 +10,29 @@ ingest separately). AUC is printed alongside: the adaptive kernel at
 nbins=62 matches the 254-bin global sketch's AUC on this task (0.8364 vs
 0.8366) because per-node range narrowing recovers resolution with depth.
 
-vs_baseline divides by a nominal A100 gpu_hist figure on the same shape
-(~25M rows/sec — published gpu_hist HIGGS numbers land around 20-30M
-rows·trees/sec); BASELINE.md records that the reference publishes no
-in-tree number, so this constant is the stand-in until a measured A100
-run replaces it.
+The recorded run is DISK-RESIDENT by default: the HIGGS-shaped CSV is
+written once, then ingested through the real two-phase parse path
+(native C++ tokenizer fan-out, ingest/parse.py) — the measured frame
+came off disk the way the reference's benchmarks ingest theirs. Set
+H2O3_BENCH_DISK=0 for the in-memory variant (throughput is the same;
+only setup differs — the metric counts the boost loop only, matching
+how gpu_hist benchmarks report train time net of ingest).
+
+vs_baseline divides by A100_GPU_HIST_ROWS_PER_SEC = 25e6 — see
+BASELINE.md "Denominator" for exactly what that constant stands for,
+how it was chosen, and why it cannot be re-measured in this image.
+
+Kernel ceiling (documented for the perf record): the per-level pallas
+kernel is MXU-STREAMING-bound — a [3N<=128, K]x[K, F·W] contraction
+costs ceil(F·W/512)·K MXU cycles independent of the M=3N dim
+(tools/kern_mxu_probe.py: [6,8192]x[8192,896] takes 73% of the
+[126,...] time), so every level costs ~2 cycles/row and depth-6
+training has a ~72M rows/s/chip structural ceiling at W=32; the
+measured 68.6M is ~95% of it. The tested escapes — int8 fixed-point
+contraction (1.33x bare-matmul win, eaten by Mosaic's lack of i8
+select/mul forcing i32 operand builds; H2O3_HIST_I8 opt-in keeps it),
+lane-gather range lookups (Mosaic declines), tile resizing (flat) —
+are recorded in tools/ and ops/hist_adaptive.py.
 
 Prints exactly one JSON line on stdout.
 """
@@ -94,7 +112,7 @@ def main():
     import jax
 
     log(f"devices: {jax.devices()}  backend: {jax.default_backend()}")
-    if os.environ.get("H2O3_BENCH_DISK"):
+    if os.environ.get("H2O3_BENCH_DISK", "1") not in ("0", "false", ""):
         fr = _disk_frame(ROWS)
         F = fr.ncol - 1
     else:
@@ -123,6 +141,30 @@ def main():
     auc = gbm.model.training_metrics.auc
     log(f"trees={built} loop={loop_s:.2f}s total={total:.2f}s "
         f"rows/sec/chip={rows_per_sec:,.0f} AUC={auc:.4f}")
+
+    # in-CI bf16 numerics guard (driver-run, TPU only): record the bf16
+    # vs f32 split-decision parity artifact every round so a kernel
+    # numerics regression is CAUGHT, not assumed (BF16_r{N}.json)
+    if (jax.default_backend() == "tpu"
+            and os.environ.get("H2O3_BENCH_BF16_GUARD", "1") != "0"):
+        try:
+            rnd = os.environ.get("H2O3_ROUND", "05")
+            out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               f"BF16_r{rnd}.json")
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "tools"))
+            import bf16_deviation
+            # pin the guard's config explicitly — ROWS is a generic env
+            # knob shared by the tools/ probes and must not leak in
+            bf16_deviation.ROWS = int(
+                os.environ.get("H2O3_BF16_GUARD_ROWS", 2_000_000))
+            res = bf16_deviation.main()
+            with open(out, "w") as f:
+                json.dump(res, f, indent=1)
+            log(f"bf16 guard: pass={res['pass']} "
+                f"auc_delta={res['auc_delta']} -> {out}")
+        except Exception as e:  # guard must never sink the headline run
+            log(f"bf16 guard FAILED to run: {e!r}")
 
     print(json.dumps({
         "metric": "gbm_hist_training_throughput",

@@ -184,9 +184,12 @@ def _parse_csv_text(text: str, setup: ParseSetup, skip_header: bool):
     return cols
 
 
-def _column_to_vec(tokens: List[Optional[str]], vtype: str, mesh=None) -> Vec:
+def _column_to_vec(tokens, vtype: str, mesh=None) -> Vec:
     n = len(tokens)
     if vtype in (T_REAL, T_INT):
+        if isinstance(tokens, np.ndarray):
+            # native tokenizer output: already-parsed float64 (NA = NaN)
+            return Vec.from_numpy(tokens, vtype=vtype, mesh=mesh)
         arr = np.full(n, np.nan, dtype=np.float64)
         for i, t in enumerate(tokens):
             if t is not None:
@@ -284,6 +287,34 @@ def _parse_range(path: str, start: int, end: int, setup: ParseSetup,
     return _parse_csv_text(text, setup, skip_header=skip_header)
 
 
+def _na_strings_native_safe(setup: ParseSetup) -> bool:
+    """The native tokenizer maps any non-numeric token in a numeric
+    column to NaN — equivalent to the Python path ONLY when no na_string
+    is itself numeric (a numeric NA sentinel like '-999' must go through
+    the token path)."""
+    import math
+    for s in (setup.na_strings or ()):
+        try:
+            v = float(s)
+        except ValueError:
+            continue
+        if not math.isnan(v):       # "nan"/"NaN" parse to NaN == NA anyway
+            return False
+    return True
+
+
+def _parse_range_native(path: str, start: int, end: int, setup: ParseSetup,
+                        skip_header: bool):
+    """Byte-range worker on the native tokenizer (ctypes releases the
+    GIL during the C scan, so a THREAD pool parallelises it without the
+    process-spawn + pickle cost of the Python fallback). Returns per-
+    column numpy float64 arrays (numeric) / token lists, or None."""
+    with open(path, "rb") as f:
+        f.seek(start)
+        data = f.read(end - start)
+    return _native_token_columns(data, setup, skip_header=skip_header)
+
+
 def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
           mesh=None, key: Optional[str] = None) -> Frame:
     """Phase 2 — full parse into a row-sharded Frame. Large files are
@@ -293,43 +324,77 @@ def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
     if isinstance(paths, str):
         paths = [paths]
     setup = setup or parse_setup(paths)
-    all_cols = None
+    parts: Optional[List[list]] = None     # per column: list of chunks
 
     def merge(cols):
-        nonlocal all_cols
-        if all_cols is None:
-            all_cols = cols
+        nonlocal parts
+        if parts is None:
+            parts = [[c] for c in cols]
         else:
-            for c, extra in zip(all_cols, cols):
-                c.extend(extra)
+            for ps, extra in zip(parts, cols):
+                ps.append(extra)
 
+    from h2o3_tpu.native import lib as _native_lib
+    native_ok = _native_lib() is not None and _na_strings_native_safe(setup)
     for p in paths:
         size = os.path.getsize(p)
         if size >= _PARALLEL_PARSE_BYTES:
             import concurrent.futures as cf
-            import multiprocessing as mp
             n_chunks = min(os.cpu_count() or 4, 16)
             ranges = _byte_ranges(p, n_chunks)
-            # spawn, not fork: this process is multithreaded (JAX/XLA),
-            # and forking while another thread holds an XLA mutex
-            # deadlocks the child
-            ctx = mp.get_context("spawn")
-            with cf.ProcessPoolExecutor(max_workers=len(ranges),
-                                        mp_context=ctx) as ex:
-                futs = [ex.submit(_parse_range, p, s, e, setup,
-                                  setup.header and s == 0)
-                        for (s, e) in ranges]
-                for fu in futs:
-                    merge(fu.result())
+            results = [None] * len(ranges)
+            if native_ok:
+                # native tokenizer + THREADS: the ctypes call releases
+                # the GIL, so workers scan byte ranges concurrently with
+                # no process-spawn or result-pickle overhead
+                with cf.ThreadPoolExecutor(max_workers=len(ranges)) as ex:
+                    futs = [ex.submit(_parse_range_native, p, s, e, setup,
+                                      setup.header and s == 0)
+                            for (s, e) in ranges]
+                    results = [fu.result() for fu in futs]
+            if any(r is None for r in results):
+                # Python fallback in PROCESSES — spawn, not fork: this
+                # process is multithreaded (JAX/XLA), and forking while
+                # another thread holds an XLA mutex deadlocks the child
+                import multiprocessing as mp
+                ctx = mp.get_context("spawn")
+                with cf.ProcessPoolExecutor(max_workers=len(ranges),
+                                            mp_context=ctx) as ex:
+                    futs = [ex.submit(_parse_range, p, s, e, setup,
+                                      setup.header and s == 0)
+                            for (s, e) in ranges]
+                    results = [fu.result() for fu in futs]
+            for r in results:
+                merge(r)
         else:
             with open(p, "rb") as f:
-                text = f.read().decode("utf-8", errors="replace")
-            merge(_parse_csv_text(text, setup, skip_header=setup.header))
+                data = f.read()
+            cols = (_native_token_columns(data, setup,
+                                          skip_header=setup.header)
+                    if native_ok else None)
+            if cols is None:
+                cols = _parse_csv_text(data.decode("utf-8",
+                                                   errors="replace"),
+                                       setup, skip_header=setup.header)
+            merge(cols)
     skipped = set(setup.skipped_columns)
     names, vecs = [], []
-    for i, (col, t) in enumerate(zip(all_cols, setup.column_types)):
+    for i, t in enumerate(setup.column_types):
         if i in skipped:
             continue
+        ps = parts[i]
+        if all(isinstance(c, np.ndarray) for c in ps):
+            col = ps[0] if len(ps) == 1 else np.concatenate(ps)
+        else:
+            col = []
+            for c in ps:
+                if isinstance(c, np.ndarray):
+                    # repr(float(v)), not repr(v): numpy 2.x scalar repr
+                    # is 'np.float64(1.5)', which float() can't parse
+                    col.extend(None if np.isnan(v) else repr(float(v))
+                               for v in c)
+                else:
+                    col.extend(c)
         names.append(setup.column_names[i])
         vecs.append(_column_to_vec(col, t, mesh=mesh))
     return Frame(names, vecs, key=key or os.path.basename(paths[0]))

@@ -68,7 +68,30 @@ def main():
     if same_struct.any():
         rel = np.abs(vb[same_struct] - vf[same_struct])
         print(f"leaf |Δvalue| max over same-structure trees: {rel.max():.2e}")
+    return {
+        "rows": ROWS, "depth": DEPTH, "trees": TREES,
+        "splits_compared": n_splits,
+        "feature_disagreements": feat_diff,
+        "feature_disagreement_pct": round(100 * feat_diff
+                                          / max(n_splits, 1), 3),
+        "threshold_only_disagreements": thr_diff,
+        "auc_bf16": round(float(mb.training_metrics.auc), 6),
+        "auc_f32": round(float(mf.training_metrics.auc), 6),
+        "auc_delta": round(float(auc_d), 7),
+        # guard threshold: a kernel-numerics regression shows up as an
+        # AUC gap far above the measured near-tie noise floor (~3e-5)
+        "auc_delta_threshold": 1e-3,
+        "pass": bool(auc_d < 1e-3),
+    }
 
 
 if __name__ == "__main__":
-    main()
+    res = main()
+    if "--json" in sys.argv:
+        import json
+        idx = sys.argv.index("--json")
+        if idx + 1 >= len(sys.argv):
+            sys.exit("--json requires an output path")
+        with open(sys.argv[idx + 1], "w") as f:
+            json.dump(res, f, indent=1)
+    sys.exit(0 if res["pass"] else 1)

@@ -14,10 +14,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-ROWS = 10_002_432
-F, W, N = 28, 32, 32
+ROWS = int(__import__("os").environ.get("ROWS", 2_500_608))
+F, W, N = 28, 32, int(os.environ.get("N", 32))
 TILE = int(os.environ.get("TILE", 8192))
-REPS = 10
+REPS = int(os.environ.get("REPS", 40))
 _VM = 100 * 1024 * 1024
 
 
@@ -26,7 +26,7 @@ def _unsplit3(p_hi, p_mid, p_lo):
 
 
 def make_kernel(ablate):
-    n_prev = N // 2
+    n_prev = max(N // 2, 1)
     base = N - 1
 
     def kern(x_ref, nid_ref, ghw_ref, tabs_ref, loinv_ref, nid_out,
@@ -138,8 +138,12 @@ def run(ablate):
     )
 
     rng = np.random.default_rng(0)
+    import time as _t; _t0=_t.time()
     xt = jnp.asarray(rng.normal(size=(F, ROWS)).astype(np.float32))
-    nid0 = jnp.asarray(rng.integers(15, 31, ROWS).astype(np.int32))
+    jax.block_until_ready(xt); print(f"  xfer {ROWS*F*4/1e6:.0f}MB in {_t.time()-_t0:.1f}s", flush=True)
+    prev_base = (N - 1) - max(N // 2, 1)
+    nid0 = jnp.asarray(rng.integers(prev_base, prev_base + max(N // 2, 1),
+                                    ROWS).astype(np.int32))
     ghw = jnp.asarray(rng.normal(size=(3, ROWS)).astype(np.float32))
     tabs = jnp.asarray(rng.normal(size=(12, N // 2)).astype(np.float32)
                        ).astype(jnp.bfloat16)
@@ -147,20 +151,30 @@ def run(ablate):
                         ).astype(jnp.bfloat16)
 
     @jax.jit
-    def loop(nid):
+    def loop(xt, nid, ghw, tabs, loinv):
+        # arrays ride as ARGUMENTS: closing over them embeds 280MB of
+        # constants in the program, which the axon remote-compile
+        # endpoint rejects with HTTP 413
         def body(i, carry):
             nid, acc = carry
             nid2, hist = call(xt, nid[None, :], ghw, tabs, loinv)
             # feed nid back (mod to keep in prev-level range) so no CSE
-            nid = jnp.clip(nid2[0] % 16 + 15, 15, 30)
+            n_prev = max(N // 2, 1)
+            pb = (N - 1) - n_prev
+            nid = jnp.clip(nid2[0] % n_prev + pb, pb, pb + n_prev - 1)
             return nid, acc + hist[0, 0]
         return jax.lax.fori_loop(0, REPS, body, (nid, 0.0))
 
-    out = loop(nid0)
-    jax.block_until_ready(out)
+    tw = time.time()
+    out = loop(xt, nid0, ghw, tabs, loinv)
+    _ = float(jax.device_get(out[1]))      # force full execution round-trip
+    print(f"  warm(compile+run) {time.time()-tw:.1f}s", flush=True)
+    # time with DIFFERENT inputs (the warmup's output nid) — identical
+    # repeat requests can be served from a cache layer on axon
+    nid1 = out[0]
     t0 = time.time()
-    out = loop(nid0)
-    jax.block_until_ready(out)
+    out2 = loop(xt, nid1, ghw, tabs, loinv)
+    _ = float(jax.device_get(out2[1]))
     dt = (time.time() - t0) / REPS
     return dt
 

@@ -1,0 +1,84 @@
+"""Probe: does Mosaic lower a dynamic LANE gather (jnp.take along axis=1
+of a [S, N<=128] table with [tile] per-lane indices) inside a pallas
+kernel — and how fast vs the bf16-split one-hot-matmul lookup?
+
+If supported, both the route-table and range-table lookups can become
+exact f32 gathers, dropping 2 lookup matmuls + 2 three-term recombines
+per level.
+"""
+import sys, os, time, functools
+sys.path.insert(0, '/root/repo')
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+ROWS = 2_500_608
+F, N = 28, 32
+TILE = int(os.environ.get("TILE", 8192))
+REPS = 40
+
+
+def kern_gather(tab_ref, idx_ref, out_ref):
+    tab = tab_ref[...]                       # [2F, N] f32 (N in lanes)
+    idx = idx_ref[0, :]                      # [TILE] i32 in [0, N)
+    # lane gather via take_along_axis with a padded-to-TILE table:
+    # out[s, t] = tab[s, idx[t]]
+    tabp = jnp.pad(tab, ((0, 0), (0, TILE - N)))
+    idx2 = jnp.broadcast_to(idx[None, :], (2 * F, TILE))
+    out_ref[...] = jnp.take_along_axis(tabp, idx2, axis=1)
+
+
+def kern_matmul(tab_ref, idx_ref, out_ref):
+    tab = tab_ref[...]                       # [2F, N]
+    idx = idx_ref[0, :]
+    onh = (jax.lax.broadcasted_iota(jnp.int32, (N, TILE), 0)
+           == idx[None, :]).astype(jnp.bfloat16)
+    out_ref[...] = jax.lax.dot_general(
+        tab.astype(jnp.bfloat16), onh, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def run(kern, name):
+    call = pl.pallas_call(
+        kern,
+        grid=(ROWS // TILE,),
+        in_specs=[
+            pl.BlockSpec((2 * F, N), lambda r: (0, 0)),
+            pl.BlockSpec((1, TILE), lambda r: (0, r)),
+        ],
+        out_specs=pl.BlockSpec((2 * F, TILE), lambda r: (0, r)),
+        out_shape=jax.ShapeDtypeStruct((2 * F, ROWS), jnp.float32),
+    )
+    rng = np.random.default_rng(0)
+    tab = jnp.asarray(rng.normal(size=(2 * F, N)).astype(np.float32))
+    idx = jnp.asarray(rng.integers(0, N, ROWS).astype(np.int32))
+
+    @jax.jit
+    def loop(tab, idx):
+        def body(i, carry):
+            s, idx = carry
+            out = call(tab, idx[None, :])
+            idx = (idx + out[0, :].astype(jnp.int32) % 2) % N
+            return s + out[1, 0], idx
+        return jax.lax.fori_loop(0, REPS, body, (0.0, idx))
+
+    try:
+        out = loop(tab, idx)
+        _ = float(jax.device_get(out[0]))
+    except Exception as e:
+        print(f"{name}: FAILED — {str(e)[:300]}")
+        return
+    t0 = time.time()
+    out2 = loop(tab, out[1])
+    _ = float(jax.device_get(out2[0]))
+    dt = (time.time() - t0) / REPS
+    print(f"{name}: {dt*1000:.3f} ms ({ROWS/dt/1e6:.0f} M rows/s)",
+          flush=True)
+
+
+if __name__ == "__main__":
+    run(kern_gather, "lane-gather")
+    run(kern_matmul, "onehot-matmul")
