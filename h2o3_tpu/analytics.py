@@ -58,7 +58,7 @@ def partial_dependence(model, frame: Frame, cols: Sequence[str],
             means.append(float(pred.mean()))
             stds.append(float(pred.std()))
         out[col] = {"grid": labels, "mean_response": means,
-                    "stddev_response": stds}
+                    "stddev_response": stds, "n_rows": int(len(X))}
     return out
 
 
@@ -209,3 +209,65 @@ def feature_interaction(model, frame: Frame, max_pairs: int = 10) -> List:
         rows.append({"pair": (a, b), "h_squared": h2})
     rows.sort(key=lambda r: -r["h_squared"])
     return rows
+
+
+def interaction_frame(frame: Frame, factors: Sequence, pairwise: bool = False,
+                      max_factors: int = 100, min_occurrence: int = 1) -> Frame:
+    """Categorical interaction features (hex/Interaction + water/rapids
+    InteractionWrappedVec; h2o.interaction): combine the given factor
+    columns into new enum column(s) whose levels are the observed value
+    combinations, keeping the ``max_factors`` most frequent levels (the
+    rest collapse into 'other') and dropping levels seen fewer than
+    ``min_occurrence`` times."""
+    cols = [frame.names[i] if isinstance(i, int) else i for i in factors]
+    for c in cols:
+        if c not in frame.names:
+            raise ValueError(f"unknown column '{c}'")
+    pairs = ([(a, b) for i, a in enumerate(cols) for b in cols[i + 1:]]
+             if pairwise else [tuple(cols)])
+    names, vecs = [], []
+    for group in pairs:
+        labels_per_col = []
+        codes_per_col = []
+        for c in group:
+            v = frame.vec(c)
+            if v.is_categorical:
+                dom = list(v.domain)
+                codes = np.asarray(jax.device_get(v.as_float()))[: frame.nrow]
+                codes = np.where(np.isnan(codes), -1, codes).astype(int)
+                labels_per_col.append(dom)
+                codes_per_col.append(codes)
+            else:
+                d = v.to_numpy()
+                vals = sorted({x for x in d[~np.isnan(d)]})
+                lut = {x: i for i, x in enumerate(vals)}
+                codes = np.array([lut.get(x, -1) if not np.isnan(x) else -1
+                                  for x in d], dtype=int)
+                labels_per_col.append([repr(float(x)) for x in vals])
+                codes_per_col.append(codes)
+        # vectorized combo encoding: np.unique over the stacked code
+        # matrix finds observed combinations + frequencies in one pass
+        # (a per-row Python loop takes minutes at 10M rows)
+        stacked = np.stack(codes_per_col)               # [G, rows]
+        valid = (stacked >= 0).all(axis=0)
+        vcols = stacked[:, valid]
+        uniq, inverse, counts = np.unique(
+            vcols, axis=1, return_inverse=True, return_counts=True)
+        combo_codes = np.full(stacked.shape[1], -1, np.int64)
+        combo_codes[valid] = inverse
+        # rank by frequency; keep max_factors, honor min_occurrence
+        order_k = np.argsort(-counts, kind="stable")
+        keep = [int(k) for k in order_k
+                if counts[k] >= min_occurrence][:max_factors]
+        remap = {k: i for i, k in enumerate(keep)}
+        other = len(keep)
+        has_other = len(keep) < uniq.shape[1]
+        dom = ["_".join(labels_per_col[j][int(uniq[j, k])]
+                        for j in range(len(group))) for k in keep]
+        if has_other:
+            dom.append("other")
+        out = np.array([remap.get(int(c), other) if c >= 0 else -1
+                        for c in combo_codes], dtype=np.int32)
+        names.append("_".join(group))
+        vecs.append(Vec.from_numpy(out, vtype=T_ENUM, domain=tuple(dom)))
+    return Frame(names, vecs)

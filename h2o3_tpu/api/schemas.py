@@ -303,8 +303,18 @@ def model_v3(model, key: str) -> Dict:
     kind = ("Binomial" if model.nclasses == 2 else
             "Multinomial" if model.nclasses > 2 else "Regression")
     dom = list(getattr(model, "response_domain", None) or []) or None
+    # names/domains: feature columns + response last (hex/Model.Output
+    # _names/_domains; h2o-py H2OTree categorical decode reads these)
+    names_nd = list(model.feature_names) + ([model.response]
+                                            if model.response else [])
+    domains_nd = [list(model.cat_domains[n]) if n in model.cat_domains
+                  else None for n in model.feature_names]
+    if model.response:
+        domains_nd.append(dom)
     out: Dict[str, Any] = {
         "model_category": kind,
+        "names": names_nd,
+        "domains": domains_nd,
         "training_metrics": _metrics_v3(model.training_metrics, kind,
                                         domain=dom, model_key=key),
         "validation_metrics": _metrics_v3(model.validation_metrics, kind,

@@ -82,6 +82,25 @@ def _builders() -> Dict[str, Any]:
             "stackedensemble": est.H2OStackedEnsembleEstimator}
 
 
+def _strlist(v) -> list:
+    """Parse h2o-py's stringify_list output — '[AGE,PSA]' with UNQUOTED
+    items (h2o-py/h2o/utils/shared_utils.py:213) — or JSON, or an
+    actual list."""
+    if isinstance(v, list):
+        return v
+    if v is None:
+        return []
+    s = str(v).strip()
+    if s.startswith("["):
+        try:
+            return json.loads(s)
+        except json.JSONDecodeError:
+            inner = s[1:-1].strip()
+            return ([t.strip().strip('"').strip("'")
+                     for t in inner.split(",")] if inner else [])
+    return [s]
+
+
 def _coerce(v: str) -> Any:
     """Schema.fillFromParms analog: h2o-py sends everything as strings."""
     if not isinstance(v, str):
@@ -1117,6 +1136,313 @@ def _json_default(o):
     if isinstance(o, np.ndarray):
         return o.tolist()
     return str(o)
+
+
+# ------------- analytics / tooling routes (reference parity set) -------
+
+
+@route("POST", "/3/CreateFrame")
+def _create_frame_route(params, body):
+    """water/api/CreateFrameHandler → hex/createframe; h2o.create_frame."""
+    from h2o3_tpu.analytics import create_frame
+    p = {k: _coerce(v) for k, v in params.items()}
+    dest = p.pop("dest", None) or dkv.unique_key("create_frame")
+    kw = {k: p[k] for k in ("rows", "cols", "categorical_fraction",
+                            "integer_fraction", "binary_fraction",
+                            "missing_fraction", "factors", "real_range",
+                            "integer_range", "seed", "has_response")
+          if p.get(k) is not None}
+    job = Job("CreateFrame")
+    job.dest_key = dest
+    job.dest_type = "Key<Frame>"
+
+    def body_fn(j):
+        fr = create_frame(**kw)
+        fr.key = dest
+        dkv.put(dest, "frame", fr)
+        return fr
+
+    job.run(body_fn, background=True)
+    return schemas.job_v3(job, dest, "Key<Frame>")
+
+
+@route("POST", "/3/Interaction")
+def _interaction_route(params, body):
+    """hex/Interaction via water/api/InteractionHandler; h2o.interaction."""
+    from h2o3_tpu.analytics import interaction_frame
+    p = {k: _coerce(v) for k, v in params.items()}
+    fr = dkv.get(str(params.get("source_frame")), "frame")
+    factors = _strlist(params.get("factor_columns")
+                       or params.get("factors"))
+    dest = params.get("dest") or dkv.unique_key("interaction")
+    job = Job("Interaction")
+    job.dest_key = dest
+    job.dest_type = "Key<Frame>"
+
+    def body_fn(j):
+        out = interaction_frame(
+            fr, factors, pairwise=bool(p.get("pairwise")),
+            max_factors=int(p.get("max_factors") or 100),
+            min_occurrence=int(p.get("min_occurrence") or 1))
+        out.key = dest
+        dkv.put(dest, "frame", out)
+        return out
+
+    job.run(body_fn, background=True)
+    return schemas.job_v3(job, dest, "Key<Frame>")
+
+
+@route("POST", "/3/PartialDependence/")
+@route("POST", "/3/PartialDependence")
+def _pdp_build(params, body):
+    """hex/PartialDependence via water/api; h2o-py model.partial_plot."""
+    from h2o3_tpu.analytics import partial_dependence
+    p = {k: _coerce(v) for k, v in params.items()}
+    m = dkv.get(str(params.get("model_id")), "model")
+    fr = dkv.get(str(params.get("frame_id")), "frame")
+    cols = _strlist(params.get("cols"))
+    if not cols:
+        cols = [c for c in m.feature_names][:3]
+    dest = params.get("destination_key") or dkv.unique_key("pdp")
+    job = Job("PartialDependencePlot")
+    job.dest_key = dest
+    job.dest_type = "Key<PartialDependence>"
+
+    def body_fn(j):
+        res = partial_dependence(m, fr, cols,
+                                 nbins=int(p.get("nbins") or 20))
+        dkv.put(dest, "pdp", {"cols": cols, "data": res})
+        return res
+
+    job.run(body_fn, background=True)
+    return schemas.job_v3(job, dest, "Key<PartialDependence>")
+
+
+@route("GET", "/3/PartialDependence/{key}")
+def _pdp_get(params, body, key):
+    obj = dkv.get(key, "pdp")
+    tables = []
+    for col in obj["cols"]:
+        d = obj["data"][col]
+        n_avg = max(int(d.get("n_rows", 1)), 1)   # rows averaged per point
+        tables.append(schemas.twodim(
+            f"PartialDependence for '{col}'",
+            [col, "mean_response", "stddev_response", "std_error_mean_response"],
+            [d["grid"], d["mean_response"], d["stddev_response"],
+             [s / n_avg ** 0.5 for s in d["stddev_response"]]],
+            ["string", "double", "double", "double"]))
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "PartialDependenceV3"},
+            "destination_key": key,
+            "partial_dependence_data": tables}
+
+
+@route("POST", "/99/Tabulate")
+@route("GET", "/99/Tabulate")
+def _tabulate_route(params, body):
+    """hex/Tabulate (Flow's tabulate cell); h2o.tabulate."""
+    from h2o3_tpu.analytics import tabulate
+    p = {k: _coerce(v) for k, v in params.items()}
+    fr = dkv.get(str(params.get("dataset")), "frame")
+    res = tabulate(fr, str(params.get("predictor")),
+                   str(params.get("response")),
+                   nbins_x=int(p.get("nbins_predictor") or 20),
+                   nbins_y=int(p.get("nbins_response") or 20))
+    ylab = [str(v) for v in res["y_labels"]]
+    count_tbl = schemas.twodim(
+        "Tabulate counts", ["predictor"] + ylab,
+        [[str(v) for v in res["x_labels"]]]
+        + [list(r) for r in np.asarray(res["counts"]).T.tolist()],
+        ["string"] + ["double"] * len(ylab))
+    means = res.get("mean_y_per_x")
+    if means is None:       # categorical response: no per-bin mean
+        means = [float("nan")] * len(res["x_labels"])
+    resp_tbl = schemas.twodim(
+        "Tabulate response", ["predictor", "mean_response"],
+        [[str(v) for v in res["x_labels"]], means],
+        ["string", "double"])
+    return {"__meta": {"schema_version": 99, "schema_name": "TabulateV99"},
+            "count_table": count_tbl, "response_table": resp_tbl}
+
+
+@route("GET", "/3/Tree")
+def _tree_route(params, body):
+    """Tree inspection (hex/tree/TreeHandler → TreeV3; h2o-py H2OTree)."""
+    p = {k: _coerce(v) for k, v in params.items()}
+    m = dkv.get(str(params.get("model")), "model")
+    if not hasattr(m, "_feat"):
+        raise ApiError(400, f"model '{m.key}' is not tree-based")
+    tree_no = int(p.get("tree_number") or 0)
+    K = getattr(m, "_K", 1)
+    cls = p.get("tree_class")
+    cls_idx = 0
+    if K > 1 and cls is not None:
+        dom = list(m.response_domain or [])
+        if str(cls) in dom:
+            cls_idx = dom.index(str(cls))
+        else:
+            try:
+                cls_idx = int(cls)
+            except (TypeError, ValueError):
+                raise ApiError(400, f"unknown tree_class '{cls}' "
+                                    f"(domain: {dom})")
+            if not 0 <= cls_idx < K:
+                raise ApiError(400, f"tree_class index {cls_idx} out of "
+                                    f"range for {K} classes")
+    t = tree_no * K + cls_idx
+    if t >= m._feat.shape[0] or tree_no < 0:
+        raise ApiError(404, f"tree {tree_no} out of range")
+    feat = np.asarray(m._feat[t])
+    thr = np.asarray(m._thr[t])
+    nal = np.asarray(m._na_left[t])
+    spl = np.asarray(m._is_split[t])
+    val = np.asarray(m._value[t])
+    # BFS over reachable nodes of the complete array → compressed arrays
+    idx_of = {}
+    order = []
+    stack = [0]
+    while stack:
+        n = stack.pop(0)
+        idx_of[n] = len(order)
+        order.append(n)
+        if spl[n]:
+            stack += [2 * n + 1, 2 * n + 2]
+    left, right, feats, thrs, nas, preds, descs = [], [], [], [], [], [], []
+    for n in order:
+        if spl[n]:
+            left.append(idx_of[2 * n + 1])
+            right.append(idx_of[2 * n + 2])
+            fname = m.feature_names[int(feat[n])]
+            feats.append(fname)
+            thrs.append(float(thr[n]))
+            nas.append("LEFT" if nal[n] else "RIGHT")
+            descs.append(f"{fname} < {thr[n]:.6g} goes left"
+                         f" (NA {'left' if nal[n] else 'right'})")
+        else:
+            left.append(-1)
+            right.append(-1)
+            feats.append(None)
+            thrs.append("NaN")
+            nas.append(None)
+            descs.append("leaf")
+        preds.append(float(val[n]))
+    return {"__meta": {"schema_version": 3, "schema_name": "TreeV3"},
+            "model": schemas.keyref(m.key, "Key<Model>"),
+            "tree_number": tree_no,
+            "tree_class": cls if K > 1 else None,
+            "left_children": left, "right_children": right,
+            "root_node_id": 0, "descriptions": descs,
+            "thresholds": thrs, "features": feats,
+            "levels": [None] * len(order), "nas": nas,
+            "predictions": preds,
+            "tree_decision_path": None, "decision_paths": None}
+
+
+@route("GET", "/3/TargetEncoderTransform")
+def _te_transform_route(params, body):
+    """TargetEncoder transform over REST (ai/h2o/targetencoding
+    TargetEncoderHandler; h2o-py H2OTargetEncoderEstimator.transform)."""
+    p = {k: _coerce(v) for k, v in params.items()}
+    m = dkv.get(str(params.get("model")), "model")
+    fr = dkv.get(str(params.get("frame")), "frame")
+    out = m.transform(fr,
+                      as_training=bool(p.get("as_training")),
+                      noise=float(p["noise"]) if p.get("noise") not in
+                      (None, -1) else None)
+    dest = dkv.unique_key("te_transform")
+    out.key = dest
+    dkv.put(dest, "frame", out)
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "TargetEncoderTransformV3"},
+            "name": dest, "key": schemas.keyref(dest, "Key<Frame>")}
+
+
+@route("GET", "/3/Word2VecSynonyms")
+def _w2v_synonyms(params, body):
+    m = dkv.get(str(params.get("model")), "model")
+    word = str(params.get("word"))
+    count = int(_coerce(params.get("count", 20)) or 20)
+    syn = m.find_synonyms(word, count)
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "Word2VecSynonymsV3"},
+            "synonyms": list(syn.keys()), "scores": list(syn.values())}
+
+
+@route("GET", "/3/Word2VecTransform")
+def _w2v_transform(params, body):
+    m = dkv.get(str(params.get("model")), "model")
+    wf = dkv.get(str(params.get("words_frame")), "frame")
+    agg = str(params.get("aggregate_method") or "NONE")
+    out = m.transform(wf, aggregate_method=agg)
+    dest = dkv.unique_key("w2v_transform")
+    out.key = dest
+    dkv.put(dest, "frame", out)
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "Word2VecTransformV3"},
+            "vectors_frame": schemas.keyref(dest, "Key<Frame>")}
+
+
+@route("POST", "/3/Grid.bin/import")
+def _grid_import(params, body):
+    """h2o.load_grid → reload a saved grid + its models (water/api/
+    GridImportExportHandler)."""
+    from h2o3_tpu.models.grid import load_grid_artifact
+    path = str(params.get("grid_path"))
+    gid, grid, models = load_grid_artifact(path)
+    for m in models:
+        dkv.put(m.key, "model", m)
+    dkv.put(gid, "grid", grid)
+    return {"__meta": {"schema_version": 3, "schema_name": "GridKeyV3"},
+            "name": gid}
+
+
+@route("POST", "/3/Grid.bin/{gid}/export")
+def _grid_export(params, body, gid):
+    """h2o.save_grid → persist a grid + models to a directory."""
+    from h2o3_tpu.models.grid import save_grid_artifact
+    grid = dkv.get(gid, "grid")
+    d = str(params.get("grid_directory"))
+    save_grid_artifact(grid, gid, d)
+    return {"__meta": {"schema_version": 3, "schema_name": "GridKeyV3"},
+            "name": gid}
+
+
+@route("POST", "/3/Frames/{fid}/save")
+def _frame_save(params, body, fid):
+    """Binary frame export (water/api/FramesHandler.saveFrame;
+    h2o-py frame.save)."""
+    from h2o3_tpu.persist import save_frame
+    fr = dkv.get(fid, "frame")
+    d = str(params.get("dir"))
+    force = _coerce(params.get("force", "true"))
+    job = Job(f"Save frame {fid}")
+    job.dest_key = fid
+    job.dest_type = "Key<Frame>"
+
+    def body_fn(j):
+        return save_frame(fr, d, force=bool(force), key=fid)
+
+    job.run(body_fn, background=True)
+    return schemas.job_v3(job, fid, "Key<Frame>")
+
+
+@route("POST", "/3/Frames/load")
+def _frame_load(params, body):
+    """Binary frame import (FramesHandler.loadFrame; h2o.load_frame)."""
+    from h2o3_tpu.persist import load_frame
+    fid = str(params.get("frame_id"))
+    d = str(params.get("dir"))
+    job = Job(f"Load frame {fid}")
+    job.dest_key = fid
+    job.dest_type = "Key<Frame>"
+
+    def body_fn(j):
+        fr = load_frame(d, key=fid)
+        dkv.put(fid, "frame", fr)
+        return fr
+
+    job.run(body_fn, background=True)
+    return schemas.job_v3(job, fid, "Key<Frame>")
 
 
 class H2OApiServer:

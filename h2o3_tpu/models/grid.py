@@ -190,3 +190,61 @@ class H2OGridSearch:
 
     def __len__(self):
         return len(self.models)
+
+
+def save_grid_artifact(grid: "H2OGridSearch", gid: str, directory: str) -> str:
+    """h2o.save_grid analog (water/api/GridImportExportHandler +
+    Grid.exportBinary): persist the grid manifest + every model artifact
+    into ``directory``; reloadable by ``load_grid_artifact``."""
+    from h2o3_tpu.persist import save_model
+    os.makedirs(directory, exist_ok=True)
+    model_files = []
+    for m in grid.models:
+        p = save_model(m, directory, force=True, filename=f"{m.key}.zip")
+        model_files.append(os.path.basename(p))
+    est = grid.model_template
+    manifest = {
+        "grid_id": gid,
+        "algo": getattr(est, "algo", type(est).__name__),
+        "estimator_params": {k: v for k, v in est.params.items()
+                             if not callable(v)
+                             and isinstance(v, (int, float, str, bool,
+                                                list, dict, type(None)))},
+        "hyper_params": grid.hyper_params,
+        "search_criteria": grid.search_criteria,
+        "models": model_files,
+    }
+    path = os.path.join(directory, f"{gid}.grid.json")
+    with open(path, "w") as f:
+        json.dump(manifest, f, default=str)
+    return path
+
+
+def load_grid_artifact(path: str):
+    """Load a grid saved by ``save_grid_artifact``. ``path`` is either
+    the ``<gid>.grid.json`` manifest or ``<dir>/<gid>`` (h2o.load_grid
+    passes dir + grid id joined). Returns (gid, grid, models)."""
+    from h2o3_tpu.persist import load_model
+    if os.path.isdir(path):
+        cands = [f for f in os.listdir(path) if f.endswith(".grid.json")]
+        if len(cands) != 1:
+            raise ValueError(f"expected one .grid.json in {path}")
+        path = os.path.join(path, cands[0])
+    elif not path.endswith(".grid.json"):
+        d, gid = os.path.dirname(path), os.path.basename(path)
+        path = os.path.join(d, f"{gid}.grid.json")
+    with open(path) as f:
+        man = json.load(f)
+    directory = os.path.dirname(path)
+    models = [load_model(os.path.join(directory, mf))
+              for mf in man["models"]]
+    try:
+        from h2o3_tpu.api.server import _builders
+        est = _builders()[man["algo"]](**man["estimator_params"])
+    except Exception:
+        est = None
+    grid = H2OGridSearch(est, man["hyper_params"],
+                         grid_id=man["grid_id"],
+                         search_criteria=man["search_criteria"])
+    grid.models = models
+    return man["grid_id"], grid, models

@@ -203,3 +203,75 @@ def export_file(frame, path: str, force: bool = False, sep: str = ",") -> str:
                     cells.append(str(x))
             f.write(sep.join(cells) + "\n")
     return path
+
+
+# ---------------- frame binary persistence ---------------------------
+#
+# Reference: water/fvec/Frame + persist binary .hex export consumed by
+# POST /3/Frames/{id}/save and /3/Frames/load (FramesHandler.saveFrame/
+# loadFrame → water/persist/PersistManager). The TPU artifact is the
+# same JSON+npz zip contract as models: meta.json records column names/
+# types/domains, frame.npz the column data (float64 for numeric/time
+# codes, int32 enum codes, object->utf8 for strings).
+
+def save_frame(frame, directory: str, force: bool = True,
+               key: Optional[str] = None) -> str:
+    """Binary frame artifact ``<dir>/<key>.zip``; returns the path.
+    ``key`` overrides the artifact/frame key (the REST route passes the
+    DKV id the client will load back by)."""
+    from h2o3_tpu.frame.vec import T_ENUM, T_STR, T_TIME
+    key = key or frame.key
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"{key}.zip")
+    if os.path.exists(path) and not force:
+        raise FileExistsError(path)
+    meta = {"format_version": FORMAT_VERSION, "key": key,
+            "nrow": frame.nrow,
+            "names": list(frame.names),
+            "types": [v.type for v in frame.vecs],
+            "domains": [list(v.domain) if v.domain else None
+                        for v in frame.vecs]}
+    arrays = {}
+    for i, v in enumerate(frame.vecs):
+        a = v.to_numpy()
+        if v.type == T_STR:
+            # numpy 'U' arrays strip NUL chars, so the NA sentinel rides
+            # in a separate boolean mask instead of an in-band value
+            arrays[f"c{i}"] = np.array(
+                ["" if x is None else str(x) for x in a], dtype="U")
+            arrays[f"na{i}"] = np.array([x is None for x in a], bool)
+        else:
+            arrays[f"c{i}"] = np.asarray(a)
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    with zipfile.ZipFile(path + ".tmp", "w") as z:
+        z.writestr("meta.json", json.dumps(meta))
+        z.writestr("frame.npz", buf.getvalue())
+    os.replace(path + ".tmp", path)
+    return path
+
+
+def load_frame(path: str, key: Optional[str] = None):
+    """Load a binary frame artifact; ``path`` may be the zip file or the
+    directory + key via ``<dir>/<key>.zip`` convention."""
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.frame.vec import T_ENUM, T_STR, Vec
+    if key is not None and os.path.isdir(path):
+        path = os.path.join(path, f"{key}.zip")
+    with zipfile.ZipFile(path) as z:
+        meta = json.loads(z.read("meta.json"))
+        npz = np.load(io.BytesIO(z.read("frame.npz")), allow_pickle=False)
+        vecs = []
+        for i, (t, dom) in enumerate(zip(meta["types"], meta["domains"])):
+            a = npz[f"c{i}"]
+            if t == T_STR:
+                nam = (npz[f"na{i}"] if f"na{i}" in npz.files
+                       else np.zeros(len(a), bool))
+                a = np.array([None if na else x
+                              for x, na in zip(a, nam)], dtype=object)
+            if t == T_ENUM:
+                vecs.append(Vec.from_numpy(a.astype(np.int32), vtype=t,
+                                           domain=tuple(dom or ())))
+            else:
+                vecs.append(Vec.from_numpy(a, vtype=t))
+    return Frame(meta["names"], vecs, key=meta["key"])

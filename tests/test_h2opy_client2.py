@@ -1,0 +1,107 @@
+"""Round-5 breadth routes driven by the UNMODIFIED h2o-py client:
+CreateFrame, Interaction, PartialDependence, /3/Tree, grid save/load,
+frame binary save/load (water/api RegisterV3Api.java registrations)."""
+import os
+
+import numpy as np
+import pytest
+
+import h2opy_shim
+
+
+@pytest.fixture(scope="module")
+def client():
+    import h2o3_tpu
+    h2o3_tpu.init()
+    from h2o3_tpu.api import start_server
+    srv = start_server(port=0)
+    h2o = h2opy_shim.import_h2o()
+    h2o.connect(url=f"http://127.0.0.1:{srv.port}", verbose=False)
+    yield h2o
+    try:
+        h2o.connection().close()
+    except Exception:
+        pass
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def prostate(client):
+    data = os.path.join(h2opy_shim.H2O_PY_PATH, "h2o", "h2o_data",
+                        "prostate.csv")
+    fr = client.import_file(data)
+    fr["CAPSULE"] = fr["CAPSULE"].asfactor()
+    fr["RACE"] = fr["RACE"].asfactor()
+    fr["DPROS"] = fr["DPROS"].asfactor()
+    return fr
+
+
+def test_create_frame(client):
+    fr = client.create_frame(rows=200, cols=6, categorical_fraction=0.3,
+                             integer_fraction=0.3, missing_fraction=0.05,
+                             factors=4, seed=7)
+    assert fr.nrow == 200 and fr.ncol == 6
+
+
+def test_interaction(client, prostate):
+    out = client.interaction(prostate, factors=["RACE", "DPROS"],
+                             pairwise=False, max_factors=100,
+                             min_occurrence=1)
+    assert out.nrow == 380 and out.ncol == 1
+    assert out.types[out.names[0]] == "enum"
+
+
+def test_partial_dependence(client, prostate):
+    from h2o.estimators import H2OGradientBoostingEstimator
+    gbm = H2OGradientBoostingEstimator(ntrees=4, max_depth=3, seed=1)
+    gbm.train(y="CAPSULE", x=["AGE", "PSA", "GLEASON"],
+              training_frame=prostate)
+    pd = gbm.partial_plot(prostate, cols=["AGE", "PSA"], plot=False,
+                          nbins=8)
+    assert len(pd) == 2
+    tbl = pd[0].cell_values
+    assert len(tbl) >= 2 and len(tbl[0]) == 4   # grid, mean, std, stderr
+
+
+def test_tree_inspection(client, prostate):
+    from h2o.estimators import H2OGradientBoostingEstimator
+    from h2o.tree import H2OTree
+    gbm = H2OGradientBoostingEstimator(ntrees=3, max_depth=3, seed=2)
+    gbm.train(y="CAPSULE", x=["AGE", "PSA", "GLEASON"],
+              training_frame=prostate)
+    tree = H2OTree(model=gbm, tree_number=0)
+    assert len(tree.left_children) == len(tree.right_children)
+    assert len(tree.left_children) >= 3
+    # root must be a split on a real feature with a finite threshold
+    assert tree.features[0] in ("AGE", "PSA", "GLEASON")
+    assert np.isfinite(tree.thresholds[0])
+    # leaves carry predictions
+    leaves = [i for i, l in enumerate(tree.left_children) if l == -1]
+    assert leaves and all(np.isfinite(tree.predictions[i]) for i in leaves)
+
+
+def test_grid_save_load(client, prostate, tmp_path):
+    from h2o.grid.grid_search import H2OGridSearch
+    from h2o.estimators import H2OGradientBoostingEstimator
+    gs = H2OGridSearch(H2OGradientBoostingEstimator(seed=3),
+                       hyper_params={"ntrees": [2, 3]},
+                       grid_id="g_saveload")
+    gs.train(y="CAPSULE", x=["AGE", "PSA", "GLEASON"],
+             training_frame=prostate)
+    assert len(gs.model_ids) == 2
+    saved = client.save_grid(str(tmp_path), "g_saveload")
+    client.remove(gs.model_ids[0])
+    client.remove("g_saveload")
+    grid = client.load_grid(saved)
+    assert len(grid.model_ids) == 2
+    m = grid.models[0]
+    assert m.model_performance(train=True).auc() > 0.5
+
+
+def test_frame_binary_save_load(client, prostate, tmp_path):
+    fid = prostate.frame_id
+    prostate.save(str(tmp_path))
+    loaded = client.load_frame(fid, str(tmp_path))
+    assert loaded.dim == [380, 9]
+    assert abs(loaded["AGE"].mean()[0] - 66.0394) < 1e-2
+    assert loaded["RACE"].isfactor() == [True]

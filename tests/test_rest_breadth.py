@@ -1,0 +1,69 @@
+"""Direct-route coverage for round-5 breadth endpoints not reachable
+through the simple client flows: Word2VecSynonyms/Transform,
+TargetEncoderTransform, Tabulate (water/api/RegisterV3Api.java)."""
+import time
+
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu import dkv
+from h2o3_tpu.api import server as srv
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    h2o.init()
+
+
+def test_word2vec_routes():
+    from h2o3_tpu.models.word2vec import H2OWord2vecEstimator
+    from h2o3_tpu.frame.vec import T_STR, Vec
+    from h2o3_tpu.frame.frame import Frame
+    sents = ("the cat sat on the mat . the dog sat on the rug . "
+             "cat and dog play . ").split() * 40
+    words = Frame(["C1"], [Vec.from_numpy(
+        np.array(sents, dtype=object), vtype=T_STR)])
+    est = H2OWord2vecEstimator(vec_size=12, epochs=3, min_word_freq=1,
+                               seed=4)
+    est.train(training_frame=words)
+    dkv.put("w2v.model", "model", est.model)
+    r = srv._w2v_synonyms({"model": "w2v.model", "word": "cat",
+                           "count": 3}, None)
+    assert len(r["synonyms"]) >= 1 and len(r["scores"]) == len(r["synonyms"])
+    dkv.put("words.hex", "frame", words)
+    r2 = srv._w2v_transform({"model": "w2v.model",
+                             "words_frame": "words.hex",
+                             "aggregate_method": "NONE"}, None)
+    out = dkv.get(r2["vectors_frame"]["name"], "frame")
+    assert out.ncol == 12
+
+
+def test_te_transform_route():
+    from h2o3_tpu.models.targetencoder import H2OTargetEncoderEstimator
+    rng = np.random.default_rng(0)
+    cat = np.array(["a", "b", "c"], dtype=object)[
+        rng.integers(0, 3, 300)]
+    y = (rng.random(300) < 0.4).astype(np.float64)
+    fr = h2o.Frame.from_numpy({"cat": cat, "y": y})
+    est = H2OTargetEncoderEstimator(data_leakage_handling="none",
+                                    noise=0.0)
+    est.train(x=["cat"], y="y", training_frame=fr)
+    dkv.put("te.model", "model", est.model)
+    dkv.put("te.hex", "frame", fr)
+    r = srv._te_transform_route({"model": "te.model", "frame": "te.hex",
+                                 "noise": "0"}, None)
+    out = dkv.get(r["name"], "frame")
+    assert any(n.endswith("_te") for n in out.names)
+
+
+def test_tabulate_route():
+    rng = np.random.default_rng(1)
+    fr = h2o.Frame.from_numpy({"x": rng.normal(size=500),
+                               "y": rng.normal(size=500)})
+    dkv.put("tab.hex", "frame", fr)
+    r = srv._tabulate_route({"dataset": "tab.hex", "predictor": "x",
+                             "response": "y", "nbins_predictor": "10",
+                             "nbins_response": "10"}, None)
+    assert r["count_table"]["rowcount"] >= 1
+    assert r["response_table"]["rowcount"] >= 1
