@@ -266,8 +266,10 @@ def interaction_frame(frame: Frame, factors: Sequence, pairwise: bool = False,
                         for j in range(len(group))) for k in keep]
         if has_other:
             dom.append("other")
-        out = np.array([remap.get(int(c), other) if c >= 0 else -1
-                        for c in combo_codes], dtype=np.int32)
+        lut = np.full(uniq.shape[1], other, np.int32)
+        lut[np.asarray(keep, int)] = np.arange(len(keep), dtype=np.int32)
+        out = np.where(combo_codes >= 0, lut[np.maximum(combo_codes, 0)],
+                       -1).astype(np.int32)
         names.append("_".join(group))
         vecs.append(Vec.from_numpy(out, vtype=T_ENUM, domain=tuple(dom)))
     return Frame(names, vecs)

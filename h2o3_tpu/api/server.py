@@ -906,11 +906,13 @@ def _model_builder_meta(params, body, algo):
     if algo not in builders:
         raise ApiError(404, f"unknown algorithm '{algo}'")
     est = builders[algo]()
-    parameters = [{"name": k, "default_value": v, "actual_value": v,
+    parameters = [{"name": k,
+                   "default_value": list(v) if isinstance(v, tuple) else v,
+                   "actual_value": list(v) if isinstance(v, tuple) else v,
                    "label": k, "type": type(v).__name__, "level": "critical",
                    "values": []}
                   for k, v in est.params.items()
-                  if isinstance(v, (int, float, str, bool, list,
+                  if isinstance(v, (int, float, str, bool, list, tuple,
                                     type(None)))]
     return {"__meta": {"schema_version": 3,
                        "schema_name": "ModelBuildersV3"},
@@ -1146,7 +1148,8 @@ def _create_frame_route(params, body):
     """water/api/CreateFrameHandler → hex/createframe; h2o.create_frame."""
     from h2o3_tpu.analytics import create_frame
     p = {k: _coerce(v) for k, v in params.items()}
-    dest = p.pop("dest", None) or dkv.unique_key("create_frame")
+    p.pop("dest", None)
+    dest = params.get("dest") or dkv.unique_key("create_frame")
     kw = {k: p[k] for k in ("rows", "cols", "categorical_fraction",
                             "integer_fraction", "binary_fraction",
                             "missing_fraction", "factors", "real_range",
@@ -1372,7 +1375,7 @@ def _w2v_synonyms(params, body):
 def _w2v_transform(params, body):
     m = dkv.get(str(params.get("model")), "model")
     wf = dkv.get(str(params.get("words_frame")), "frame")
-    agg = str(params.get("aggregate_method") or "NONE")
+    agg = str(params.get("aggregate_method") or "NONE").lower()
     out = m.transform(wf, aggregate_method=agg)
     dest = dkv.unique_key("w2v_transform")
     out.key = dest
@@ -1401,8 +1404,10 @@ def _grid_export(params, body, gid):
     """h2o.save_grid → persist a grid + models to a directory."""
     from h2o3_tpu.models.grid import save_grid_artifact
     grid = dkv.get(gid, "grid")
-    d = str(params.get("grid_directory"))
-    save_grid_artifact(grid, gid, d)
+    d = params.get("grid_directory")
+    if not d:
+        raise ApiError(400, "grid_directory is required")
+    save_grid_artifact(grid, gid, str(d))
     return {"__meta": {"schema_version": 3, "schema_name": "GridKeyV3"},
             "name": gid}
 
@@ -1413,7 +1418,10 @@ def _frame_save(params, body, fid):
     h2o-py frame.save)."""
     from h2o3_tpu.persist import save_frame
     fr = dkv.get(fid, "frame")
-    d = str(params.get("dir"))
+    d = params.get("dir")
+    if not d:
+        raise ApiError(400, "dir is required")
+    d = str(d)
     force = _coerce(params.get("force", "true"))
     job = Job(f"Save frame {fid}")
     job.dest_key = fid
@@ -1431,7 +1439,10 @@ def _frame_load(params, body):
     """Binary frame import (FramesHandler.loadFrame; h2o.load_frame)."""
     from h2o3_tpu.persist import load_frame
     fid = str(params.get("frame_id"))
-    d = str(params.get("dir"))
+    d = params.get("dir")
+    if not d:
+        raise ApiError(400, "dir is required")
+    d = str(d)
     job = Job(f"Load frame {fid}")
     job.dest_key = fid
     job.dest_type = "Key<Frame>"

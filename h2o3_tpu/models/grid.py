@@ -206,10 +206,12 @@ def save_grid_artifact(grid: "H2OGridSearch", gid: str, directory: str) -> str:
     manifest = {
         "grid_id": gid,
         "algo": getattr(est, "algo", type(est).__name__),
-        "estimator_params": {k: v for k, v in est.params.items()
+        "estimator_params": {k: (list(v) if isinstance(v, tuple) else v)
+                             for k, v in est.params.items()
                              if not callable(v)
                              and isinstance(v, (int, float, str, bool,
-                                                list, dict, type(None)))},
+                                                list, tuple, dict,
+                                                type(None)))},
         "hyper_params": grid.hyper_params,
         "search_criteria": grid.search_criteria,
         "models": model_files,
@@ -241,7 +243,13 @@ def load_grid_artifact(path: str):
     try:
         from h2o3_tpu.api.server import _builders
         est = _builders()[man["algo"]](**man["estimator_params"])
-    except Exception:
+    except Exception as e:
+        # keep the grid loadable for inspection, but surface why the
+        # template is unusable instead of a far-away NoneType crash
+        from h2o3_tpu.log import warn
+        warn(f"load_grid_artifact: could not rebuild the template "
+             f"estimator for algo '{man.get('algo')}': {e!r}; the grid "
+             f"can be inspected but not extended via train()")
         est = None
     grid = H2OGridSearch(est, man["hyper_params"],
                          grid_id=man["grid_id"],

@@ -472,8 +472,29 @@ class ModelBuilder:
     model_count = 0
 
     def __init__(self, **params):
-        self.params = params
+        # reference-parity parameters this backend accepts but does not
+        # act on (generated by tools/gen_python.py --wire): they keep the
+        # generated-bindings/clients' full signatures working; train()
+        # warns whenever one is set away from its reference default so
+        # nothing is silently ignored
+        try:
+            from h2o3_tpu.models.compat_params import COMPAT_PARAMS
+            compat = COMPAT_PARAMS.get(self.algo, {})
+        except ImportError:
+            compat = {}
+        self._compat_defaults = compat
+        merged = {k: v for k, v in compat.items() if k not in params}
+        merged.update(params)
+        self.params = merged
         self.model: Optional[Model] = None
+
+    def _warn_compat_params(self):
+        from h2o3_tpu.log import warn
+        for k, dflt in self._compat_defaults.items():
+            if self.params.get(k) != dflt:
+                warn(f"{self.algo}: parameter '{k}' is accepted for "
+                     f"reference API compatibility but NOT implemented — "
+                     f"value {self.params[k]!r} has no effect")
 
     # per-algo: build a model from a spec
     def _train_impl(self, spec: TrainingSpec, valid_spec: Optional[TrainingSpec],
@@ -494,6 +515,7 @@ class ModelBuilder:
         t0 = time.time()
         prof = Profile()
         timeline_record("train_start", f"{self.algo}")
+        self._warn_compat_params()
         with prof.phase("spec"):
             spec = self._make_spec(training_frame, y, x)
             valid_spec = None
