@@ -23,6 +23,15 @@ def keyref(name: Optional[str], ktype: str = "Key<Keyed>") -> Optional[Dict]:
     return {"name": name, "type": ktype, "URL": None}
 
 
+def _mem_report() -> Dict:
+    from h2o3_tpu import memman
+    s = memman.manager().stats()
+    s["free_mem"] = (max(s["device_budget_bytes"]
+                         - s["device_resident_bytes"], 0)
+                     if s["device_budget_bytes"] > 0 else -1)
+    return s
+
+
 def cloud_v3() -> Dict:
     import jax
     from h2o3_tpu.parallel.mesh import current_mesh
@@ -52,6 +61,9 @@ def cloud_v3() -> Dict:
             "num_cpus": 1, "cpus_allowed": 1,
             "gflops": None, "mem_bw": None,
             "tpu_devices": [str(d) for d in jax.devices()],
+            # device-memory report (water/Cleaner.java watermarks + the
+            # free_mem field the reference's Cloud page shows)
+            **_mem_report(),
         }],
         "internal_security_enabled": False,
         "web_ip": "127.0.0.1",

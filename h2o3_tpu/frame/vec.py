@@ -46,12 +46,76 @@ PERCENTILES = (0.001, 0.01, 0.1, 0.25, 1.0 / 3.0, 0.5, 2.0 / 3.0, 0.75, 0.9, 0.9
 class Vec:
     def __init__(self, data, nrow: int, vtype: str = T_REAL,
                  domain: Optional[Sequence[str]] = None, host_data=None):
-        self.data = data            # padded, row-sharded jax.Array (None for str vecs)
+        self._dev = data            # padded, row-sharded jax.Array (None for str vecs)
+        self._spilled = None        # (padded numpy, sharding) when evicted
+        self._memblock = None
         self.nrow = int(nrow)
         self.type = vtype
         self.domain = tuple(domain) if domain is not None else None
         self.host_data = host_data  # numpy: exact values for str/time
         self._rollups = None
+        if data is not None:
+            self._register_mem()
+
+    # -- device-memory management (water/Cleaner.java swap-to-disk
+    #    analog: HBM payloads spill to host numpy under pressure and
+    #    re-materialize on next access; see h2o3_tpu/memman.py) --------
+
+    def _register_mem(self):
+        import weakref
+        from h2o3_tpu import memman
+        ref = weakref.ref(self)
+
+        def spill():
+            v = ref()
+            if v is not None:
+                v._spill()
+
+        try:
+            nbytes = int(self._dev.nbytes)
+        except (AttributeError, TypeError):
+            nbytes = self.nrow * 4
+        # allocation gate: evict LRU payloads if this one crosses the
+        # watermark (the payload itself is already on device — XLA
+        # allocated it — but the budget accounting evicts peers so the
+        # NEXT allocation has room; MemoryManager.java's malloc gate)
+        memman.manager().request(nbytes)
+        self._memblock = memman.manager().register(nbytes, spill)
+
+    def _spill(self):
+        """Move the device payload to host and release the device ref."""
+        if self._dev is None:
+            return
+        arr = np.asarray(jax.device_get(self._dev))
+        self._spilled = (arr, getattr(self._dev, "sharding", None))
+        self._dev = None
+        self._memblock = None
+
+    @property
+    def data(self):
+        if self._dev is None and self._spilled is not None:
+            from h2o3_tpu import memman
+            arr, sh = self._spilled
+            memman.manager().request(arr.nbytes)
+            try:
+                self._dev = (jax.device_put(arr, sh) if sh is not None
+                             else jnp.asarray(arr))
+            except Exception:   # mesh changed since spill: replicate
+                self._dev = jnp.asarray(arr)
+            self._spilled = None
+            self._register_mem()
+        if self._memblock is not None:
+            from h2o3_tpu import memman
+            memman.manager().touch(self._memblock)
+        return self._dev
+
+    @data.setter
+    def data(self, v):
+        self._dev = v
+        self._spilled = None
+        self._memblock = None
+        if v is not None:
+            self._register_mem()
 
     # ---------------- construction ----------------
 
@@ -227,6 +291,11 @@ class Vec:
                 return self.host_data.copy()
             # exact wide-int copy, NA as NaN (float64 holds ints to 2^53)
             return self.host_data.copy()
+        if self._dev is None and self._spilled is not None:
+            # spilled payload: serve the host copy directly instead of
+            # re-uploading to device only to download again (that would
+            # also churn the LRU in the exact memory-pressure paths)
+            return np.asarray(self._spilled[0])[: self.nrow].copy()
         out = np.asarray(jax.device_get(self.data))[: self.nrow]
         return out
 

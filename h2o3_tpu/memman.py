@@ -1,0 +1,153 @@
+"""Device-memory manager: budget, watermarks, LRU spill-to-host.
+
+Reference: water/Cleaner.java:4 (the background sweeper that swaps
+least-recently-used Values to disk when the heap crosses a watermark)
++ water/MemoryManager.java (allocation gate that blocks/frees until
+memory is available) + the /3/Cloud free_mem report.
+
+TPU re-design: HBM is the scarce tier and host RAM is the spill target
+(the reference spills heap→disk; a v5e host has ~16x the chip's HBM, so
+host RAM plays the disk role and disk would be the third tier).
+Spillable device blocks (Frame Vec payloads) register here; an
+allocation request over the HIGH watermark evicts least-recently-used
+blocks to host numpy until under the LOW watermark. Algorithms consult
+``fits_device(bytes)`` to pick dense vs streaming execution — frames
+beyond the budget stream through training in host-chunked blocks
+instead of failing allocation (SURVEY §7.1.7's Criteo-scale config).
+
+The budget defaults to the real device memory when the backend reports
+it, and can be forced with H2O3_DEVICE_BUDGET_BYTES (the tests force a
+tiny budget on the CPU mesh to exercise eviction + streaming).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from typing import Any, Callable, Dict, Optional
+
+_LOCK = threading.RLock()
+_SEQ = 0
+
+HIGH_WATERMARK = 0.90      # evict when a request would cross this
+LOW_WATERMARK = 0.70       # ...down to this (Cleaner's DESIRED analog)
+
+
+def _default_budget() -> int:
+    env = os.environ.get("H2O3_DEVICE_BUDGET_BYTES")
+    if env:
+        return int(env)
+    try:
+        import jax
+        d = jax.devices()[0]
+        stats = d.memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    return 1 << 62             # effectively unlimited (CPU backend)
+
+
+class _Block:
+    """One registered spillable device payload."""
+
+    __slots__ = ("nbytes", "spill", "last_use", "seq", "__weakref__")
+
+    def __init__(self, nbytes: int, spill: Callable[[], None]):
+        self.nbytes = nbytes
+        self.spill = spill
+        self.last_use = time.monotonic()
+        self.seq = 0
+
+
+class MemoryManager:
+    def __init__(self, budget: Optional[int] = None):
+        self.budget = budget if budget is not None else _default_budget()
+        # residency is the sum over LIVE blocks: the WeakSet drops
+        # garbage-collected payloads automatically, so no counter to
+        # keep consistent across gc/spill/free paths
+        self._blocks: "weakref.WeakSet[_Block]" = weakref.WeakSet()
+        self.spill_count = 0
+        self.spilled_bytes = 0
+
+    @property
+    def _resident(self) -> int:
+        return sum(b.nbytes for b in self._blocks)
+
+    # -- registry ------------------------------------------------------
+
+    def register(self, nbytes: int, spill: Callable[[], None]) -> _Block:
+        """Track a device-resident payload; ``spill`` must move it to
+        host and drop the device reference."""
+        with _LOCK:
+            b = _Block(int(nbytes), spill)
+            self._blocks.add(b)
+            return b
+
+    def touch(self, block: _Block) -> None:
+        block.last_use = time.monotonic()
+
+    def released(self, block: _Block) -> None:
+        """The payload left the device (spilled or freed)."""
+        with _LOCK:
+            self._blocks.discard(block)
+
+    # -- allocation gate (MemoryManager.java malloc-with-wait analog) --
+
+    def request(self, nbytes: int) -> None:
+        """Make room for an ``nbytes`` device allocation: evict LRU
+        spillable blocks while the projected residency crosses the high
+        watermark (down to the low one)."""
+        with _LOCK:
+            if self._resident + nbytes <= self.budget * HIGH_WATERMARK:
+                return
+            target = max(self.budget * LOW_WATERMARK - nbytes, 0)
+            for b in sorted(self._blocks, key=lambda b: b.last_use):
+                if self._resident <= target:
+                    break
+                try:
+                    b.spill()
+                finally:
+                    self.spill_count += 1
+                    self.spilled_bytes += b.nbytes
+                    self.released(b)
+
+    def fits_device(self, nbytes: int) -> bool:
+        """Whether a dense allocation of this size is within budget —
+        algorithms switch to host-chunked streaming when it is not."""
+        return nbytes <= self.budget * HIGH_WATERMARK
+
+    # -- reporting (/3/Cloud free_mem) ---------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with _LOCK:
+            return {
+                "device_budget_bytes": self.budget
+                if self.budget < (1 << 61) else -1,
+                "device_resident_bytes": self._resident,
+                "registered_blocks": len(self._blocks),
+                "spill_count": self.spill_count,
+                "spilled_bytes": self.spilled_bytes,
+                "high_watermark": HIGH_WATERMARK,
+                "low_watermark": LOW_WATERMARK,
+            }
+
+
+_MANAGER: Optional[MemoryManager] = None
+
+
+def manager() -> MemoryManager:
+    global _MANAGER
+    with _LOCK:
+        if _MANAGER is None:
+            _MANAGER = MemoryManager()
+        return _MANAGER
+
+
+def reset(budget: Optional[int] = None) -> MemoryManager:
+    """Tests: reinstall with an explicit budget."""
+    global _MANAGER
+    with _LOCK:
+        _MANAGER = MemoryManager(budget)
+        return _MANAGER

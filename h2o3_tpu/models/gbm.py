@@ -280,6 +280,7 @@ def _compiled_chunk(mesh, cfg, K, dist_name, tweedie_power, quantile_alpha,
 
 class H2OGradientBoostingEstimator(ModelBuilder):
     algo = "gbm"
+    supports_streaming = True
 
     def __init__(self, **params):
         merged = dict(GBM_DEFAULTS)
@@ -301,6 +302,8 @@ class H2OGradientBoostingEstimator(ModelBuilder):
     def _train_impl(self, spec: TrainingSpec, valid_spec, job: Job) -> GBMModel:
         p = self.params
         dist_name = self._resolve_distribution(spec)
+        if spec.stream:
+            return self._train_streaming(spec, valid_spec, dist_name, job)
         K = spec.nclasses if spec.nclasses > 2 else 1
         task = ("binomial" if spec.nclasses == 2
                 else "multinomial" if K > 1 else "regression")
@@ -507,6 +510,111 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                                tree_offset=start_trees, prior=prior,
                                dist=dist)
         model.output["training_loop_seconds"] = t_loop
+        return model
+
+    def _train_streaming(self, spec: TrainingSpec, valid_spec, dist_name,
+                         job: Job) -> GBMModel:
+        """Memory-pressure path: the frame exceeded the device budget, so
+        X stays on host and every tree streams row chunks through the
+        adaptive level kernels (models/tree.py
+        grow_tree_adaptive_streamed; water/Cleaner.java graceful
+        degradation — slower, but any frame that fits host RAM trains)."""
+        from h2o3_tpu import memman
+        from h2o3_tpu.models.tree import grow_tree_adaptive_streamed
+        p = self.params
+        if spec.nclasses > 2:
+            raise NotImplementedError(
+                "multinomial GBM is not supported in streaming "
+                "(memory-pressure) mode; raise H2O3_DEVICE_BUDGET_BYTES "
+                "or reduce the frame")
+        if valid_spec is not None:
+            raise NotImplementedError(
+                "validation_frame is not supported in streaming mode")
+        # options the dense path honors but this path does not: fail
+        # fast rather than silently train a different model
+        if spec.offset is not None:
+            raise NotImplementedError(
+                "offset_column is not supported in streaming mode")
+        if p.get("checkpoint"):
+            raise NotImplementedError(
+                "checkpoint continuation is not supported in streaming "
+                "mode")
+        if dist_name in ("huber", "quantile") and dist_name == "huber":
+            raise NotImplementedError(
+                "huber distribution is not supported in streaming mode "
+                "(its delta re-estimation needs the dense path)")
+        K = 1
+        cfg, root_lo, root_hi, nb_f = adaptive_setup(
+            spec, p, int(p["max_depth"]))
+        dist = self._dist(dist_name)
+        X_host = spec.X_host
+        rows = spec.nrow
+        X_host = X_host[:rows]
+        y_host = np.asarray(jax.device_get(spec.y))[:rows].astype(np.float32)
+        w_host = np.asarray(jax.device_get(spec.w))[:rows].astype(np.float32)
+        budget = memman.manager().budget
+        chunk_rows = int(max(min(budget // max(spec.n_features * 4 * 4, 1),
+                                 rows), 16384))
+        f0 = float(jax.device_get(dist.init_f0(jnp.asarray(y_host),
+                                               jnp.asarray(w_host))))
+        margin_host = np.full(rows, f0, np.float32)
+        ntrees = int(p["ntrees"])
+        lr = float(p["learn_rate"])
+        anneal = float(p.get("learn_rate_annealing", 1.0) or 1.0)
+        col_rate = (float(p.get("col_sample_rate", 1.0))
+                    * float(p.get("col_sample_rate_per_tree", 1.0)))
+        seed = int(p.get("seed", -1) or -1)
+        key = jax.random.PRNGKey(seed if seed != -1 else 0)
+        trees = []
+        t0 = time.time()
+        for t in range(ntrees):
+            tkey = jax.random.fold_in(key, t)
+            col_mask = None
+            if col_rate < 1.0:
+                col_mask = (jax.random.uniform(
+                    jax.random.fold_in(tkey, 1), (spec.n_features,))
+                    < col_rate)
+            tree, margin_host = grow_tree_adaptive_streamed(
+                X_host, y_host, margin_host, dist, lr, w_host, cfg,
+                root_lo, root_hi, nb_f, chunk_rows, key=tkey,
+                sample_rate=float(p.get("sample_rate", 1.0)),
+                col_mask=col_mask)
+            # lr-scale values like the dense finalize does
+            tree = dict(tree)
+            tree["value"] = tree["value"] * np.float32(lr)
+            trees.append(tree)
+            lr *= anneal
+            job.set_progress((t + 1) / ntrees)
+            if job.cancel_requested:
+                break
+        t_loop = time.time() - t0
+        T = len(trees)
+        trees_host = {k: np.stack([tr[k] for tr in trees]) for k in
+                      ("feat", "thr", "na_left", "is_split", "value",
+                       "node_w")}
+        model = GBMModel(f"{self.algo}_{id(self) & 0xffffff:x}", p, spec,
+                         dist_name, np.float32(f0), trees_host, [],
+                         cfg.n_bins, cfg.max_depth, T, spec.nclasses)
+        gains = np.stack([tr["gain"] for tr in trees])
+        feat = trees_host["feat"]
+        vi = np.zeros(len(spec.names))
+        live = feat >= 0
+        np.add.at(vi, feat[live], gains[live])
+        order = np.argsort(-vi)
+        rel = vi / vi.max() if vi.max() > 0 else vi
+        model.output["variable_importances"] = {
+            "variable": [spec.names[i] for i in order],
+            "relative_importance": vi[order].tolist(),
+            "scaled_importance": rel[order].tolist(),
+            "percentage": (vi[order] / vi.sum() if vi.sum() > 0
+                           else vi[order]).tolist()}
+        model.output["training_loop_seconds"] = t_loop
+        model.output["streamed"] = True
+        padded = int(spec.y.shape[0])
+        mpad = np.full(padded, f0, np.float32)
+        mpad[:rows] = margin_host       # pad rows carry w=0 in metrics
+        model.training_metrics = self._metrics_from_margin(
+            jnp.asarray(mpad), spec, dist_name, K, dist=dist)
         return model
 
     def _dist(self, dist_name: str, huber_delta: float = 1.0):

@@ -46,6 +46,9 @@ GLM_DEFAULTS: Dict = dict(
 class _Family:
     name = "gaussian"
 
+    def link(self, mu):
+        return mu
+
     def linkinv(self, eta):
         return eta
 
@@ -70,6 +73,10 @@ class _Gaussian(_Family):
 class _Binomial(_Family):
     name = "binomial"
 
+    def link(self, mu):
+        mu = jnp.clip(mu, 1e-7, 1 - 1e-7)
+        return jnp.log(mu / (1.0 - mu))
+
     def linkinv(self, eta):
         return 1.0 / (1.0 + jnp.exp(-eta))
 
@@ -93,6 +100,9 @@ class _Binomial(_Family):
 class _Poisson(_Family):
     name = "poisson"
 
+    def link(self, mu):
+        return jnp.log(jnp.maximum(mu, 1e-10))
+
     def linkinv(self, eta):
         return jnp.exp(jnp.clip(eta, -30, 30))
 
@@ -113,6 +123,9 @@ class _Poisson(_Family):
 
 class _Gamma(_Family):
     name = "gamma"
+
+    def link(self, mu):
+        return jnp.log(jnp.maximum(mu, 1e-10))
 
     def linkinv(self, eta):
         return jnp.exp(jnp.clip(eta, -30, 30))
@@ -450,6 +463,7 @@ class GLMModel(Model):
 
 class H2OGeneralizedLinearEstimator(ModelBuilder):
     algo = "glm"
+    supports_streaming = True
 
     def __init__(self, **params):
         merged = dict(GLM_DEFAULTS)
@@ -470,7 +484,171 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
             return "gaussian"
         return fam
 
+    def _train_streaming(self, spec: TrainingSpec, job: Job) -> "GLMModel":
+        """Memory-pressure IRLS: the design exceeded the device budget,
+        so each IRLS iteration streams host row-chunks, expanding +
+        standardizing per chunk and accumulating the weighted Gram and
+        RHS on device (hex/gram/Gram.java chunk-wise accumulate is the
+        same shape; here the 'chunks' are host-resident). Supports the
+        core families with ridge/no penalty; lambda search, elastic-net
+        CD and multinomial need the dense path."""
+        from dataclasses import replace as dc_replace
+        from h2o3_tpu import memman
+        p = self.params
+        family = (p.get("family") or "gaussian").lower()
+        if spec.nclasses > 2 or family == "multinomial":
+            raise NotImplementedError(
+                "multinomial GLM is not supported in streaming mode")
+        if spec.offset is not None:
+            raise NotImplementedError(
+                "offset_column is not supported in streaming mode")
+        if not bool(p.get("intercept", True)):
+            raise NotImplementedError(
+                "intercept=False is not supported in streaming mode")
+        alpha = p.get("alpha")
+        if isinstance(alpha, (list, tuple)):
+            alpha = alpha[0] if alpha else None
+        lam_set = p.get("Lambda") or p.get("lambda", 0.0)
+        if isinstance(lam_set, (list, tuple)):
+            lam_any = any(float(v) > 0 for v in lam_set)
+        else:
+            lam_any = float(lam_set or 0.0) > 0
+        # dense semantics default alpha to 0.5 when unset: an L1
+        # component with lambda>0 needs the dense CD path
+        if lam_any and (alpha is None or float(alpha) > 0):
+            raise NotImplementedError(
+                "elastic-net/lasso (alpha>0, the default when unset) is "
+                "not supported in streaming mode; set alpha=0 for ridge")
+        if p.get("lambda_search"):
+            raise NotImplementedError(
+                "lambda_search is not supported in streaming mode")
+        if family not in _FAMILIES:
+            raise NotImplementedError(
+                f"family '{family}' is not supported in streaming mode")
+        fam = _FAMILIES[family]()
+        rows = spec.nrow
+        Xh = spec.X_host[:rows]
+        yh = np.asarray(jax.device_get(spec.y))[:rows].astype(np.float32)
+        wh = np.asarray(jax.device_get(spec.w))[:rows].astype(np.float32)
+        F0 = Xh.shape[1]
+        # chunk sizing must use the EXPANDED width: one-hot blocks can
+        # dwarf the raw column count (a 2000-level enum is 2000 columns)
+        Fe_est = sum(max(len(spec.cat_domains.get(n, ())) - 1, 1)
+                     if c else 1
+                     for n, c in zip(spec.names, spec.is_cat)) or 1
+        budget = memman.manager().budget
+        chunk = int(max(min(budget // max(Fe_est * 4 * 6, 1), rows), 1024))
+        # pass 0: imputation means + expanded-design standardization
+        # stats (weighted), accumulated host-side
+        means = {n: float(np.nansum(Xh[:rows, i] * wh)
+                          / max(float((wh * ~np.isnan(Xh[:rows, i])).sum()),
+                                1e-12))
+                 for i, (n, c) in enumerate(zip(spec.names, spec.is_cat))
+                 if not c}
+
+        def chunk_spec(s, e):
+            return dc_replace(spec, X=jnp.asarray(Xh[s:e]),
+                              w=jnp.asarray(wh[s:e]), stream=False,
+                              X_host=None)
+
+        sums = sumsq = None
+        wsum = 0.0
+        exp_names = None
+        for s in range(0, rows, chunk):
+            e = min(s + chunk, rows)
+            Xe, exp_names, _ = expand_design(chunk_spec(s, e),
+                                             impute_means=means)
+            wv = jnp.asarray(wh[s:e])
+            cs = (Xe * wv[:, None]).sum(axis=0)
+            cq = (Xe * Xe * wv[:, None]).sum(axis=0)
+            sums = cs if sums is None else sums + cs
+            sumsq = cq if sumsq is None else sumsq + cq
+            wsum += float(wv.sum())
+        standardize = bool(p.get("standardize", True))
+        xm = sums / max(wsum, 1e-12)
+        xv = jnp.maximum(sumsq / max(wsum, 1e-12) - xm * xm, 1e-12)
+        xs = jnp.sqrt(xv) if standardize else jnp.ones_like(xv)
+        if not standardize:
+            xm = jnp.zeros_like(xm)
+        Fe = int(xm.shape[0])
+        ncoef = Fe + 1                       # + intercept
+        lam = float((p.get("Lambda") or [0.0])[0]
+                    if isinstance(p.get("Lambda"), (list, tuple))
+                    else (p.get("Lambda") or 0.0))
+        pen_mask = jnp.concatenate([jnp.ones(Fe), jnp.zeros(1)])
+        beta = jnp.zeros(ncoef, jnp.float32)
+        # null model intercept init
+        mu0 = float(np.sum(yh * wh) / max(wh.sum(), 1e-12))
+        beta = beta.at[-1].set(fam.link(jnp.float32(mu0)))
+        max_iter = int(p.get("max_iterations", 30) or 30)
+        for it in range(max_iter):
+            G = jnp.zeros((ncoef, ncoef), jnp.float32)
+            b = jnp.zeros(ncoef, jnp.float32)
+            for s in range(0, rows, chunk):
+                e = min(s + chunk, rows)
+                memman.manager().request((e - s) * Fe * 4)
+                Xe, _, _ = expand_design(chunk_spec(s, e),
+                                         impute_means=means)
+                Xs = (Xe - xm[None, :]) / xs[None, :]
+                Xs = jnp.concatenate(
+                    [Xs, jnp.ones((Xs.shape[0], 1), jnp.float32)], axis=1)
+                yv = jnp.asarray(yh[s:e])
+                wv = jnp.asarray(wh[s:e])
+                eta = Xs @ beta
+                mu = fam.linkinv(eta)
+                dmu = fam.mu_eta(eta)
+                var = fam.variance(mu)
+                w_irls = wv * dmu * dmu / var
+                z = eta + (yv - mu) * dmu / jnp.maximum(dmu * dmu, 1e-12)
+                Gc, bc = _gram_kernel(Xs, w_irls, z)
+                G = G + Gc
+                b = b + bc
+            nb = _cholesky_solve(G, b, lam, pen_mask)
+            delta = float(jnp.max(jnp.abs(nb - beta)))
+            beta = nb
+            job.set_progress(min(0.9, (it + 1) / max_iter))
+            if delta < float(p.get("beta_epsilon", 1e-5) or 1e-5):
+                break
+        # final pass: deviances + metrics
+        mu_host = np.zeros(rows, np.float32)
+        for s in range(0, rows, chunk):
+            e = min(s + chunk, rows)
+            Xe, _, _ = expand_design(chunk_spec(s, e), impute_means=means)
+            Xs = (Xe - xm[None, :]) / xs[None, :]
+            Xs = jnp.concatenate(
+                [Xs, jnp.ones((Xs.shape[0], 1), jnp.float32)], axis=1)
+            mu_host[s:e] = np.asarray(jax.device_get(
+                fam.linkinv(Xs @ beta)))
+        yj = jnp.asarray(yh)
+        wj = jnp.asarray(wh)
+        muj = jnp.asarray(mu_host)
+        res_dev = float(jax.device_get(fam.deviance(wj, yj, muj)))
+        null_dev = float(jax.device_get(fam.deviance(
+            wj, yj, jnp.full(rows, mu0, jnp.float32))))
+        # raw-scale coefficients
+        b_std = beta[:-1]
+        b_raw = b_std / xs
+        icpt = float(beta[-1] - jnp.sum(b_std * xm / xs))
+        model = GLMModel(f"glm_{id(self) & 0xffffff:x}", p, spec, family,
+                         np.asarray(jax.device_get(b_raw)), icpt,
+                         exp_names, means, lam, null_dev, res_dev,
+                         float(wh.sum()), int(Fe + 1))
+        model.output["streamed"] = True
+        if spec.nclasses == 2:
+            probs = np.stack([1.0 - mu_host, mu_host], axis=1)
+            model.training_metrics = compute_metrics(
+                jnp.asarray(probs), yj, wj, 2, spec.response_domain)
+        else:
+            model.training_metrics = compute_metrics(
+                muj, yj, wj, 1, deviance=res_dev / max(wh.sum(), 1e-12))
+        return model
+
     def _train_impl(self, spec: TrainingSpec, valid_spec, job: Job) -> GLMModel:
+        if spec.stream:
+            if valid_spec is not None:
+                raise NotImplementedError(
+                    "validation_frame is not supported in streaming mode")
+            return self._train_streaming(spec, job)
         p = self.params
         family = self._resolve_family(spec)
         if family == "multinomial":

@@ -44,6 +44,11 @@ class TrainingSpec:
     response_domain: Optional[tuple]
     nclasses: int                # 1 = regression
     offset: Any = None
+    # memory-pressure mode (memman.fits_device said no): X stays on HOST
+    # as float32 numpy and algorithms stream row chunks through training
+    # (water/Cleaner.java graceful-degradation analog); X above is None
+    X_host: Any = None
+    stream: bool = False
 
     @property
     def n_features(self) -> int:
@@ -71,12 +76,31 @@ def build_training_spec(frame: Frame, y: str, x: Optional[Sequence[str]] = None,
         # numeric response used as classification → derive domain
         # (Vec.asfactor: unique finite values → sorted domain, NaN → NA)
         rvec = rvec.asfactor()
-    X = frame.as_matrix(names)
+    # memory pressure gate (water/MemoryManager.java allocation gate):
+    # a design matrix beyond the device budget stays on HOST and the
+    # algorithms stream row chunks (X_host/stream mode)
+    from h2o3_tpu import memman
+    mm = memman.manager()
+    est_bytes = (frame.nrow + 256) * max(len(names), 1) * 4
+    # account for what's already resident (the frame's own Vec payloads
+    # count): as_matrix is a fresh copy ON TOP of them
+    stream = not mm.fits_device(est_bytes + mm.stats()
+                                ["device_resident_bytes"])
+    if not stream:
+        mm.request(est_bytes)    # spill LRU peers to make room
+    if stream:
+        X = None
+        X_host = _host_matrix(frame, names)
+        # y/w stay device vectors at the VEC padded length
+        padded = int(rvec.data.shape[0])
+    else:
+        X = frame.as_matrix(names)
+        X_host = None
+        padded = X.shape[0]
     is_cat = [frame.vec(n).type == T_ENUM for n in names]
     cat_domains = {n: frame.vec(n).domain for n in names
                    if frame.vec(n).type == T_ENUM}
     nrow = frame.nrow
-    padded = X.shape[0]
     row_ok = jnp.arange(padded) < nrow
     if classification:
         yd = rvec.data.astype(jnp.int32)
@@ -101,7 +125,25 @@ def build_training_spec(frame: Frame, y: str, x: Optional[Sequence[str]] = None,
     return TrainingSpec(X=X, y=y_dev, w=w, names=names, is_cat=is_cat,
                         cat_domains=cat_domains, nrow=nrow, response=y,
                         response_domain=response_domain, nclasses=nclasses,
-                        offset=offset)
+                        offset=offset, X_host=X_host, stream=stream)
+
+
+def _host_matrix(frame: Frame, names) -> np.ndarray:
+    """Host-resident float32 design (as_matrix semantics: enum codes as
+    floats, NA→NaN, string cols all-NaN) for streaming training."""
+    nrow = frame.nrow
+    out = np.empty((nrow, len(names)), np.float32)
+    for j, n in enumerate(names):
+        v = frame.vec(n)
+        if v.type == T_STR:
+            out[:, j] = np.nan
+            continue
+        a = v.to_numpy()
+        if v.type == T_ENUM:
+            a = np.where(np.asarray(a) < 0, np.nan,
+                         np.asarray(a, np.float64))
+        out[:, j] = np.asarray(a, np.float32)[:nrow]
+    return out
 
 
 def build_unsupervised_spec(frame: Frame, x: Optional[Sequence[str]] = None,
@@ -470,6 +512,9 @@ class ModelBuilder:
     algo = "base"
     supervised = True
     model_count = 0
+    # algos with a host-chunked memory-pressure path (spec.stream);
+    # others fail fast with guidance instead of crashing on spec.X=None
+    supports_streaming = False
 
     def __init__(self, **params):
         # reference-parity parameters this backend accepts but does not
@@ -518,6 +563,13 @@ class ModelBuilder:
         self._warn_compat_params()
         with prof.phase("spec"):
             spec = self._make_spec(training_frame, y, x)
+            if getattr(spec, "stream", False) and not self.supports_streaming:
+                raise NotImplementedError(
+                    f"{self.algo}: the training frame exceeds the device "
+                    f"memory budget and this algorithm has no streaming "
+                    f"(memory-pressure) path — raise "
+                    f"H2O3_DEVICE_BUDGET_BYTES, reduce the frame, or use "
+                    f"GBM/XGBoost/GLM which stream")
             valid_spec = None
             if validation_frame is not None:
                 # ADAPT the validation frame to the training spec (domain

@@ -390,9 +390,19 @@ def adaptive_setup(spec, params, max_depth: int, mtries: int = 0):
                                   == "random"),
                      histogram_precision=str(
                          p.get("histogram_precision", "auto")).lower())
-    Xf = jnp.where(jnp.isfinite(spec.X), spec.X, jnp.nan)
-    root_lo = jnp.nan_to_num(jnp.nanmin(Xf, axis=0), nan=0.0)
-    root_hi = jnp.nan_to_num(jnp.nanmax(Xf, axis=0), nan=0.0)
+    if spec.X is None:           # streaming mode: ranges from host X
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")     # all-NaN cols → 0 below
+            Xh = np.where(np.isfinite(spec.X_host), spec.X_host, np.nan)
+            root_lo = jnp.asarray(np.nan_to_num(
+                np.nanmin(Xh, axis=0), nan=0.0).astype(np.float32))
+            root_hi = jnp.asarray(np.nan_to_num(
+                np.nanmax(Xh, axis=0), nan=0.0).astype(np.float32))
+    else:
+        Xf = jnp.where(jnp.isfinite(spec.X), spec.X, jnp.nan)
+        root_lo = jnp.nan_to_num(jnp.nanmin(Xf, axis=0), nan=0.0)
+        root_hi = jnp.nan_to_num(jnp.nanmax(Xf, axis=0), nan=0.0)
     cat = jnp.asarray(np.asarray(spec.is_cat, dtype=bool))
     span = jnp.maximum(root_hi - root_lo, 1.0)
     nb_f = jnp.where(cat, jnp.minimum(span, float(nbins_cats)),
@@ -820,3 +830,180 @@ def bins_to_thresholds(tree_split_bin: np.ndarray, tree_feat: np.ndarray,
         else:
             thr[m] = e[t - 1]
     return thr
+
+
+def grow_tree_adaptive_streamed(X_host, y_host, margin_host, dist, lr,
+                                w_host, cfg: TreeConfig, root_lo, root_hi,
+                                nb_f, chunk_rows: int, key=None,
+                                sample_rate: float = 1.0,
+                                col_mask=None):
+    """Host-chunked adaptive tree build for frames beyond the device
+    budget (the memman streaming mode; water/Cleaner.java graceful
+    degradation). Semantics match grow_tree_adaptive with per-node
+    adaptive bins; rows stream through the SAME level kernels in
+    ``chunk_rows`` blocks with the per-row nid state held on host, and
+    per-level histograms accumulate across chunks (the psum analog is a
+    host-side '+').
+
+    Trades H2D bandwidth for memory: every level re-uploads each chunk,
+    so throughput degrades by roughly levels × (transfer/compute ratio)
+    — but any frame that fits HOST memory trains.
+
+    Returns (tree dict of [M] numpy arrays with raw thresholds,
+    updated margin_host)."""
+    from h2o3_tpu.ops.hist_adaptive import adaptive_level, pick_W, route_only
+
+    rows, F = X_host.shape
+    D = cfg.max_depth
+    M = cfg.n_nodes
+    W = pick_W(cfg.n_bins)
+    if nb_f is None:
+        nb_f = jnp.full(F, float(min(cfg.n_bins, W - 2)), jnp.float32)
+    else:
+        nb_f = jnp.minimum(jnp.asarray(nb_f, jnp.float32), float(W - 2))
+    find_cfg = TreeConfig(max_depth=cfg.max_depth, n_bins=W - 1,
+                          n_features=F, min_rows=cfg.min_rows,
+                          min_split_improvement=cfg.min_split_improvement,
+                          reg_lambda=cfg.reg_lambda,
+                          reg_alpha=cfg.reg_alpha)
+    if col_mask is None:
+        col_mask = jnp.ones(F, bool)
+
+    if D == 0:
+        # degenerate stump (the dense grower's D==0 branch): exact
+        # totals over chunks -> one root leaf
+        gs = hs = ws = 0.0
+        mg_all = jnp.asarray(margin_host)
+        for s in range(0, rows, chunk_rows):
+            e = min(s + chunk_rows, rows)
+            g, h = dist.grad_hess(jnp.asarray(margin_host[s:e]),
+                                  jnp.asarray(y_host[s:e]))
+            wv = jnp.asarray(w_host[s:e])
+            gs += float(jax.device_get((g * wv).sum()))
+            hs += float(jax.device_get((h * wv).sum()))
+            ws += float(jax.device_get(wv.sum()))
+        v0 = float(jax.device_get(_leaf_value(jnp.float32(gs),
+                                              jnp.float32(hs), cfg)))
+        tree = {"feat": np.full(1, -1, np.int32),
+                "thr": np.zeros(1, np.float32),
+                "na_left": np.zeros(1, bool),
+                "is_split": np.zeros(1, bool),
+                "value": np.array([v0], np.float32),
+                "gain": np.zeros(1, np.float32),
+                "node_w": np.array([ws], np.float32)}
+        margin_host += np.float32(lr * v0)
+        return tree, margin_host
+
+    nid_host = np.zeros(rows, np.int32)
+    # per-chunk (g, h, w) from the current margin (recomputed on device
+    # per chunk; the margin itself stays on host)
+    wt_host = w_host
+    if sample_rate < 1.0 and key is not None:
+        import jax.random as jrandom
+        u = np.asarray(jax.device_get(
+            jrandom.uniform(key, (rows,))))
+        wt_host = w_host * (u < sample_rate)
+
+    def ghw_chunk(s, e):
+        mg = jnp.asarray(margin_host[s:e])
+        yv = jnp.asarray(y_host[s:e])
+        g, h = dist.grad_hess(mg, yv)
+        wv = jnp.asarray(wt_host[s:e])
+        return jnp.stack([g * wv, h * wv, wv]).astype(jnp.float32)
+
+    feat = np.full(M, -1, np.int32)
+    thr_arr = np.zeros(M, np.float32)
+    na_left = np.zeros(M, bool)
+    is_split = np.zeros(M, bool)
+    value = np.zeros(M, np.float32)
+    gain_arr = np.zeros(M, np.float32)
+    node_w = np.zeros(M, np.float32)
+
+    lo_d = jnp.broadcast_to(jnp.asarray(root_lo)[None, :], (1, F)
+                            ).astype(jnp.float32)
+    hi_d = jnp.broadcast_to(jnp.asarray(root_hi)[None, :], (1, F)
+                            ).astype(jnp.float32)
+    zeros1 = jnp.zeros(1, jnp.float32)
+    tables = (zeros1, zeros1, zeros1, zeros1)
+    vl_s = vr_s = wl_s = wr_s = None
+
+    from h2o3_tpu import memman
+    for d in range(D):
+        N = 2 ** d
+        base = N - 1
+        span = jnp.maximum(hi_d - lo_d, 0.0)
+        inv_d = jnp.where(span > 0,
+                          nb_f[None, :] / jnp.where(span > 0, span, 1.0),
+                          0.0)
+        hist = None
+        for s in range(0, rows, chunk_rows):
+            e = min(s + chunk_rows, rows)
+            memman.manager().request((e - s) * F * 4)
+            Xc = jnp.asarray(X_host[s:e])
+            nidc = jnp.asarray(nid_host[s:e])
+            ghw = ghw_chunk(s, e)
+            nid2, h_c = adaptive_level(Xc, nidc, ghw, tables, lo_d, inv_d,
+                                       N // 2 if d else 0, N, base, W)
+            nid_host[s:e] = np.asarray(jax.device_get(nid2))
+            hist = h_c if hist is None else hist + h_c
+        trip = (hist[0], hist[1], hist[2])
+        bg, bf, bb, bnl, gt, ht, wt_, vl_s, vr_s, wl_s, wr_s = _find_splits(
+            trip, find_cfg, col_mask)
+        can = (bg > jnp.maximum(cfg.min_split_improvement, 0.0)) & (wt_ > 0)
+        nidx = jnp.arange(N)
+        lo_sel = lo_d[nidx, bf]
+        inv_sel = inv_d[nidx, bf]
+        BIG = jnp.float32(3.0e38)
+        thr = jnp.where(can,
+                        jnp.where(inv_sel > 0,
+                                  lo_sel + bb.astype(jnp.float32)
+                                  / jnp.maximum(inv_sel, 1e-30), BIG), 0.0)
+        idx = base + np.arange(N)
+        feat[idx] = np.asarray(jax.device_get(jnp.where(can, bf, -1)))
+        thr_arr[idx] = np.asarray(jax.device_get(thr))
+        na_left[idx] = np.asarray(jax.device_get(bnl))
+        is_split[idx] = np.asarray(jax.device_get(can))
+        value[idx] = np.asarray(jax.device_get(_leaf_value(gt, ht, cfg)))
+        gain_arr[idx] = np.asarray(jax.device_get(jnp.where(can, bg, 0.0)))
+        node_w[idx] = np.asarray(jax.device_get(wt_))
+        tables = (jnp.maximum(bf, 0).astype(jnp.float32), thr,
+                  bnl.astype(jnp.float32), can.astype(jnp.float32))
+        whist = hist[2][..., :W - 1]
+        occ = whist > 0
+        first = jnp.argmax(occ, axis=-1)
+        last = (W - 2) - jnp.argmax(occ[..., ::-1], axis=-1)
+        width = jnp.where(inv_d > 0, 1.0 / jnp.maximum(inv_d, 1e-30), 0.0)
+        lo_n = lo_d + first.astype(jnp.float32) * width
+        hi_n = jnp.minimum(lo_d + (last + 1).astype(jnp.float32) * width,
+                           hi_d)
+        any_occ = occ.any(axis=-1)
+        lo_n = jnp.where(any_occ, lo_n, lo_d)
+        hi_n = jnp.where(any_occ, hi_n, hi_d)
+        fsel = (jnp.arange(F)[None, :] == bf[:, None]) & can[:, None]
+        lo_left, hi_left = lo_n, jnp.where(
+            fsel, jnp.minimum(thr[:, None], hi_n), hi_n)
+        lo_right, hi_right = jnp.where(
+            fsel, jnp.maximum(thr[:, None], lo_n), lo_n), hi_n
+        lo_d = jnp.stack([lo_left, lo_right], axis=1).reshape(2 * N, F)
+        hi_d = jnp.stack([hi_left, hi_right], axis=1).reshape(2 * N, F)
+
+    # deepest level: route chunks, leaf values from last selected splits
+    ND = 2 ** D
+    baseD = ND - 1
+    vD = np.asarray(jax.device_get(
+        jnp.stack([vl_s, vr_s], axis=1).reshape(ND)))
+    wD = np.asarray(jax.device_get(
+        jnp.stack([wl_s, wr_s], axis=1).reshape(ND)))
+    value[baseD:] = vD
+    node_w[baseD:] = wD
+    tree = {"feat": feat, "thr": thr_arr, "na_left": na_left,
+            "is_split": is_split, "value": value, "gain": gain_arr,
+            "node_w": node_w}
+    for s in range(0, rows, chunk_rows):
+        e = min(s + chunk_rows, rows)
+        Xc = jnp.asarray(X_host[s:e])
+        nidc = jnp.asarray(nid_host[s:e])
+        nid2 = route_only(Xc, nidc, tables, ND // 2, baseD)
+        leaf = value[np.asarray(jax.device_get(nid2))]
+        margin_host[s:e] = margin_host[s:e] + lr * leaf
+    return tree, margin_host

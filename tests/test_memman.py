@@ -1,0 +1,95 @@
+"""Memory-pressure: budget, LRU spill, streaming GBM + GLM training
+(water/Cleaner.java + MemoryManager.java analogs, SURVEY §7.1.7)."""
+import os
+
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu import memman
+
+
+@pytest.fixture(autouse=True)
+def _restore_budget():
+    yield
+    memman.reset()     # back to unlimited for other tests
+
+
+def _frame(n=60_000, f=8, seed=0, classification=True):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    logit = X[:, 0] - 0.7 * X[:, 1] + 0.4 * X[:, 2]
+    cols = {f"x{i}": X[:, i] for i in range(f)}
+    if classification:
+        y = (rng.random(n) < 1 / (1 + np.exp(-logit)))
+        cols["resp"] = np.array(["n", "y"], dtype=object)[y.astype(int)]
+    else:
+        cols["resp"] = (logit + 0.2 * rng.normal(size=n)).astype(np.float32)
+    return h2o.Frame.from_numpy(cols)
+
+
+def test_lru_spill_and_rematerialize():
+    memman.reset(budget=1_000_000)      # ~1MB device budget
+    vecs = []
+    for i in range(8):
+        v = h2o.Frame.from_numpy(
+            {"c": np.arange(50_000, dtype=np.float64) + i}).vec("c")
+        vecs.append(v)
+    st = memman.manager().stats()
+    assert st["spill_count"] > 0        # early vecs were evicted
+    # spilled vec re-materializes transparently with exact values
+    first = vecs[0]
+    assert first._dev is None or True   # may or may not be the evictee
+    got = np.asarray(first.to_numpy())
+    assert got[1] == 1.0 and got[-1] == 49_999.0
+
+
+def test_streaming_gbm_trains_beyond_budget():
+    # budget ~0.5MB << 60k x 8 x 4B = 1.9MB design: forces X_host mode
+    memman.reset(budget=500_000)
+    fr = _frame(classification=True)
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    gbm = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, nbins=16,
+                                       seed=1, score_tree_interval=0)
+    gbm.train(y="resp", training_frame=fr)
+    m = gbm.model
+    assert m.output.get("streamed") is True
+    assert m.training_metrics.auc > 0.75
+    # the model predicts densely like any other tree model
+    memman.reset()
+    pred = m.predict(fr)
+    assert pred.nrow == fr.nrow
+
+
+def test_streaming_glm_matches_dense():
+    fr = _frame(n=40_000, classification=False, seed=3)
+    from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+    memman.reset()                       # dense reference fit
+    dense = H2OGeneralizedLinearEstimator(family="gaussian", Lambda=[0.0])
+    dense.train(y="resp", training_frame=fr)
+    dense_coef = dense.model.coef()
+    memman.reset(budget=400_000)         # force streaming
+    st = H2OGeneralizedLinearEstimator(family="gaussian", Lambda=[0.0])
+    st.train(y="resp", training_frame=fr)
+    assert st.model.output.get("streamed") is True
+    sc = st.model.coef()
+    for k, v in dense_coef.items():
+        assert abs(sc[k] - v) < 5e-3, (k, sc[k], v)
+
+
+def test_cloud_memory_report():
+    memman.reset(budget=123_456_789)
+    from h2o3_tpu.api import schemas
+    cloud = schemas.cloud_v3()
+    node = cloud["nodes"][0]
+    assert node.get("device_budget_bytes") == 123_456_789
+    assert "spill_count" in node
+
+
+def test_streaming_unsupported_algo_fails_fast():
+    memman.reset(budget=300_000)
+    fr = _frame(n=30_000, classification=True, seed=9)
+    from h2o3_tpu.models.drf import H2ORandomForestEstimator
+    drf = H2ORandomForestEstimator(ntrees=2, max_depth=3)
+    with pytest.raises(RuntimeError, match="streaming"):
+        drf.train(y="resp", training_frame=fr)
