@@ -701,6 +701,7 @@ def _grid_build(params, body, algo):
     if isinstance(criteria, str):
         criteria = json.loads(criteria)
     gid = parms.pop("grid_id", None) or dkv.unique_key(f"{algo}_grid")
+    par = int(parms.pop("parallelism", 1) or 1)
     train_key = parms.pop("training_frame", None)
     frame = dkv.get(str(train_key), "frame")
     valid = None
@@ -711,7 +712,8 @@ def _grid_build(params, body, algo):
     parms = {k: v for k, v in parms.items() if v is not None}
     parms.pop("_rest_version", None)
     est = builders[algo](**parms)
-    grid = H2OGridSearch(est, hyper, search_criteria=criteria or None)
+    grid = H2OGridSearch(est, hyper, search_criteria=criteria or None,
+                         parallelism=par)
 
     job = Job(f"{algo} grid search")
     job.dest_key = gid

@@ -93,21 +93,31 @@ class Vec:
 
     @property
     def data(self):
-        if self._dev is None and self._spilled is not None:
+        # lock-free fast path: capture the reference FIRST — a
+        # concurrent spill (another thread's memman.request) may null
+        # _dev after the check, but the captured device array stays
+        # valid (the spill only drops the Vec's own reference)
+        dev = self._dev
+        if dev is None and self._spilled is not None:
             from h2o3_tpu import memman
-            arr, sh = self._spilled
-            memman.manager().request(arr.nbytes)
-            try:
-                self._dev = (jax.device_put(arr, sh) if sh is not None
-                             else jnp.asarray(arr))
-            except Exception:   # mesh changed since spill: replicate
-                self._dev = jnp.asarray(arr)
-            self._spilled = None
-            self._register_mem()
-        if self._memblock is not None:
+            with memman._LOCK:           # serialize vs concurrent spills
+                dev = self._dev
+                if dev is None and self._spilled is not None:
+                    arr, sh = self._spilled
+                    memman.manager().request(arr.nbytes)
+                    try:
+                        dev = (jax.device_put(arr, sh) if sh is not None
+                               else jnp.asarray(arr))
+                    except Exception:   # mesh changed: replicate
+                        dev = jnp.asarray(arr)
+                    self._dev = dev
+                    self._spilled = None
+                    self._register_mem()
+        blk = self._memblock
+        if blk is not None:
             from h2o3_tpu import memman
-            memman.manager().touch(self._memblock)
-        return self._dev
+            memman.manager().touch(blk)
+        return dev
 
     @data.setter
     def data(self, v):

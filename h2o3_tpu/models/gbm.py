@@ -539,10 +539,14 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             raise NotImplementedError(
                 "checkpoint continuation is not supported in streaming "
                 "mode")
-        if dist_name in ("huber", "quantile") and dist_name == "huber":
+        if dist_name == "huber":
             raise NotImplementedError(
                 "huber distribution is not supported in streaming mode "
                 "(its delta re-estimation needs the dense path)")
+        if p.get("monotone_constraints") or p.get("interaction_constraints"):
+            raise NotImplementedError(
+                "monotone/interaction constraints are not supported in "
+                "streaming mode")
         K = 1
         cfg, root_lo, root_hi, nb_f = adaptive_setup(
             spec, p, int(p["max_depth"]))

@@ -603,7 +603,10 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
                 Gc, bc = _gram_kernel(Xs, w_irls, z)
                 G = G + Gc
                 b = b + bc
-            nb = _cholesky_solve(G, b, lam, pen_mask)
+            # dense-path penalty scaling: lam2 = lam * nobs against the
+            # UNNORMALIZED Gram (see the dense IRLS at lam2 = lam *
+            # (1-alpha) * nobs); alpha is 0 here by the guard above
+            nb = _cholesky_solve(G, b, lam * max(wsum, 1.0), pen_mask)
             delta = float(jnp.max(jnp.abs(nb - beta)))
             beta = nb
             job.set_progress(min(0.9, (it + 1) / max_iter))

@@ -61,12 +61,21 @@ class H2OGridSearch:
     def __init__(self, model, hyper_params: Dict[str, Sequence],
                  grid_id: Optional[str] = None,
                  search_criteria: Optional[Dict] = None,
-                 recovery_dir: Optional[str] = None):
+                 recovery_dir: Optional[str] = None,
+                 parallelism: int = 1):
         self.model_template = model
         self.hyper_params = {k: list(v) for k, v in hyper_params.items()}
         self.grid_id = grid_id or dkv.unique_key("grid")
         self.search_criteria = dict(search_criteria or {})
         self.recovery_dir = recovery_dir
+        # hex/grid/GridSearch.java `parallelism`: >1 overlaps host
+        # orchestration + XLA compile of point N+1 with device train of
+        # point N (one model rarely saturates host+device together for
+        # the small models grids sweep)
+        par = int(parallelism or 1)
+        if par <= 1:     # explicit arg wins; else consult the criteria
+            par = int(self.search_criteria.get("parallelism", 1) or 1)
+        self.parallelism = par
         self.models: List = []
         self.failures: List[Dict] = []
 
@@ -118,19 +127,19 @@ class H2OGridSearch:
                         done = m.get("completed", {})
                 except (json.JSONDecodeError, OSError):
                     done = {}  # crashed mid-write — retrain everything
-        for i, combo in enumerate(self._combos()):
-            if max_models and len(self.models) >= max_models:
-                break
-            if max_secs and time.time() - t0 > max_secs:
-                break
+        import threading
+        state_lock = threading.Lock()
+        built_count = [0]
+
+        def one_point(i, combo):
+            """Train (or reload) one grid point; returns (i, model|None,
+            failure|None)."""
             ckey = json.dumps(combo, sort_keys=True, default=str)
             if ckey in done:
                 from h2o3_tpu.persist import load_model
                 try:
                     model = load_model(done[ckey])
-                    self.models.append(model)
-                    dkv.put(model.key, "model", model)
-                    continue
+                    return i, model, None, ckey, False
                 except Exception:
                     pass  # stale artifact — retrain the point
             params = dict(base_params)
@@ -139,26 +148,77 @@ class H2OGridSearch:
             try:
                 est.train(x=x, y=y, training_frame=training_frame,
                           validation_frame=validation_frame, **train_kw)
-                model = est.model
-                model.key = f"{self.grid_id}_model_{i}"
-                model.output["grid_hyper_params"] = combo
-                dkv.put(model.key, "model", model)
-                self.models.append(model)
-                if self.recovery_dir:
-                    from h2o3_tpu.persist import save_model
-                    art = save_model(model, self.recovery_dir,
-                                     force=True, filename=model.key)
-                    done[ckey] = art
-                    # atomic manifest write: a crash mid-dump must not
-                    # leave a truncated file that blocks the resume
-                    mpath = os.path.join(self.recovery_dir,
-                                         f"{self.grid_id}.json")
-                    tmp = mpath + ".part"
-                    with open(tmp, "w") as f:
-                        json.dump({"base": base_fp, "completed": done}, f)
-                    os.replace(tmp, mpath)
+                return i, est.model, None, ckey, True
             except Exception as e:  # noqa: BLE001 — grid keeps walking
-                self.failures.append({"params": combo, "error": str(e)})
+                return i, None, {"params": combo, "error": str(e)}, ckey, \
+                    False
+
+        def record(i, combo, model, failure, ckey, fresh):
+            if failure is not None:
+                self.failures.append(failure)
+                return
+            model.key = f"{self.grid_id}_model_{i}"
+            model.output["grid_hyper_params"] = combo
+            dkv.put(model.key, "model", model)
+            self.models.append(model)
+            if self.recovery_dir and fresh:
+                from h2o3_tpu.persist import save_model
+                art = save_model(model, self.recovery_dir,
+                                 force=True, filename=model.key)
+                done[ckey] = art
+                # atomic manifest write: a crash mid-dump must not
+                # leave a truncated file that blocks the resume
+                mpath = os.path.join(self.recovery_dir,
+                                     f"{self.grid_id}.json")
+                tmp = mpath + ".part"
+                with open(tmp, "w") as f:
+                    json.dump({"base": base_fp, "completed": done}, f)
+                os.replace(tmp, mpath)
+
+        combos = list(enumerate(self._combos()))
+        if self.parallelism > 1:
+            # hex/grid/GridSearch parallelism: a worker pool walks the
+            # space; budgets are enforced at SUBMIT time per wave so
+            # max_models overshoots by at most parallelism-1 in-flight
+            # points (the reference has the same in-flight slack)
+            import concurrent.futures as cf
+            with cf.ThreadPoolExecutor(max_workers=self.parallelism) as ex:
+                pending = {}
+                ci = 0
+                while ci < len(combos) or pending:
+                    while (ci < len(combos)
+                           and len(pending) < self.parallelism):
+                        with state_lock:
+                            if ((max_models and built_count[0]
+                                 + len(pending) >= max_models)
+                                    or (max_secs
+                                        and time.time() - t0 > max_secs)):
+                                ci = len(combos)
+                                break
+                        i, combo = combos[ci]
+                        pending[ex.submit(one_point, i, combo)] = combo
+                        ci += 1
+                    if not pending:
+                        break
+                    done_futs, _ = cf.wait(
+                        list(pending), return_when=cf.FIRST_COMPLETED)
+                    for fu in done_futs:
+                        combo = pending.pop(fu)
+                        i, model, failure, ckey, fresh = fu.result()
+                        with state_lock:
+                            record(i, combo, model, failure, ckey, fresh)
+                            if model is not None:
+                                built_count[0] += 1
+            self.models.sort(
+                key=lambda m: int(m.key.rsplit("_", 1)[1]))
+        else:
+            for i, combo in combos:
+                if max_models and len(self.models) >= max_models:
+                    break
+                if max_secs and time.time() - t0 > max_secs:
+                    break
+                i2, model, failure, ckey, fresh = one_point(i, combo)
+                record(i, combo, model, failure, ckey, fresh)
         dkv.put(self.grid_id, "grid", self)
         return self
 

@@ -585,8 +585,25 @@ class ModelBuilder:
         def body(job):
             nfolds = int(self.params.get("nfolds", 0) or 0)
             fold_column = self.params.get("fold_column")
-            with prof.phase("train"):
-                model = self._train_impl(spec, valid_spec, job)
+            par = int(self.params.get("parallelism", 1) or 1)
+            cv_fut = None
+            if (nfolds > 1 or fold_column) and par > 1 and not spec.stream:
+                # concurrent CV-main (hex/ModelBuilder.java:884
+                # cv_buildModels + main build overlap): fold models start
+                # on a worker pool while the main model trains here
+                import concurrent.futures as cf
+                cv_pool = cf.ThreadPoolExecutor(max_workers=1)
+                cv_fut = cv_pool.submit(
+                    self._cv_fold_pass, training_frame, y, x, spec, job,
+                    nfolds, fold_column)
+            try:
+                with prof.phase("train"):
+                    model = self._train_impl(spec, valid_spec, job)
+            except BaseException:
+                if cv_fut is not None:    # don't orphan the fold pass
+                    cv_fut.cancel()
+                    cv_pool.shutdown(wait=False, cancel_futures=True)
+                raise
             model.run_time = time.time() - t0
             # UDF metric (water/udf CMetricFunc analog): a callable
             # (pred, y, w) -> float evaluated on the training data
@@ -604,8 +621,14 @@ class ModelBuilder:
                     "value": float(cmf(pred[live], yh[live], wh[live]))}
             if nfolds > 1 or fold_column:
                 with prof.phase("cv"):
-                    self._cross_validate(model, training_frame, y, x, spec,
-                                         job, nfolds, fold_column)
+                    if cv_fut is not None:
+                        fold_pass = cv_fut.result()
+                        cv_pool.shutdown()
+                    else:
+                        fold_pass = self._cv_fold_pass(
+                            training_frame, y, x, spec, job, nfolds,
+                            fold_column)
+                    self._attach_cv(model, training_frame, y, x, *fold_pass)
             model.output["profile"] = prof.to_dict()
             info("%s train done: %s", self.algo, prof.summary())
             timeline_record("train_done",
@@ -642,6 +665,15 @@ class ModelBuilder:
         """N-fold CV (hex/ModelBuilder.java:535-957): assign folds, train a
         model per fold on the complement, score the holdout, aggregate.
         Holdout predictions are kept for StackedEnsemble."""
+        self._attach_cv(model, frame, y, x,
+                        *self._cv_fold_pass(frame, y, x, spec, job, nfolds,
+                                            fold_column))
+
+    def _cv_fold_pass(self, frame: Frame, y: str, x, spec, job: Job,
+                      nfolds: int, fold_column: Optional[str]):
+        """Fold assignment + per-fold training/holdout scoring — the part
+        that can overlap the MAIN model's build (concurrent CV-main).
+        Returns (holdout, fold_models, fold, K)."""
         nrow = frame.nrow
         if fold_column:
             fold = frame.vec(fold_column).to_numpy().astype(int)
@@ -655,7 +687,7 @@ class ModelBuilder:
             else:
                 fold = rng.integers(0, nfolds, size=nrow)
             fold_ids = np.arange(nfolds)
-        K = self.nclasses_of(model)
+        K = spec.nclasses if spec.nclasses > 1 else 1
         holdout = np.full((nrow, K) if K > 1 else (nrow,), np.nan, dtype=np.float32)
 
         def one_fold(fid):
@@ -692,7 +724,12 @@ class ModelBuilder:
                 holdout[mask] = out
                 fold_models.append(fm)
                 job.set_progress(0.5 + 0.5 * (i + 1) / len(fold_ids))
-        # aggregate CV metrics from pooled holdout predictions
+        return holdout, fold_models, fold, K
+
+    def _attach_cv(self, model: Model, frame: Frame, y: str, x, holdout,
+                   fold_models, fold, K):
+        """Aggregate pooled-holdout CV metrics onto the main model."""
+        nrow = frame.nrow
         cv_spec = build_training_spec(frame, y, x,
                                       classification=model.nclasses > 1)
         yh = np.asarray(jax.device_get(cv_spec.y))[:nrow]
