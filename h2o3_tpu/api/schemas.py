@@ -370,6 +370,35 @@ def model_v3(model, key: str) -> Dict:
     if callable(coef_fn):
         try:
             coefs = coef_fn()
+            if coefs and isinstance(next(iter(coefs.values())), dict):
+                # multinomial: {class: {name: coef}} → per-class raw +
+                # standardized column halves (h2o-py _fillMultinomialDict
+                # slices the header in half, model_base.py:843)
+                classes = list(coefs)
+                names_c = list(next(iter(coefs.values())).keys())
+                raw_cols = [[float(coefs[c].get(n, 0.0)) for n in names_c]
+                            for c in classes]
+                tbl = twodim(
+                    "Coefficients",
+                    ["names"] + [f"coefs_class_{c}" for c in classes]
+                    + [f"std_coefs_class_{c}" for c in classes],
+                    [names_c] + raw_cols + raw_cols,
+                    ["string"] + ["double"] * (2 * len(classes)))
+                out["coefficients_table"] = tbl
+                out["coefficients_table_multinomials_with_class_names"] = tbl
+                raise StopIteration   # skip the flat-table path below
+            if model.nclasses > 2:
+                # ordinal: flat coef map; the client slices header halves
+                names_c = list(coefs.keys())
+                vals = [float(v) for v in coefs.values()]
+                tbl = twodim("Coefficients",
+                             ["names", "coefficients",
+                              "standardized_coefficients"],
+                             [names_c, vals, vals],
+                             ["string", "double", "double"])
+                out["coefficients_table"] = tbl
+                out["coefficients_table_multinomials_with_class_names"] = tbl
+                raise StopIteration
             norm_fn = getattr(model, "coef_norm", None)
             norm = norm_fn() if callable(norm_fn) else coefs
             # GlmV3 coefficients_table shape (hex/schemas/GLMModelV3) —

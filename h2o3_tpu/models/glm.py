@@ -31,6 +31,13 @@ from h2o3_tpu.models.model_base import (Model, ModelBuilder, TrainingSpec,
                                         unpack_impute_means)
 from h2o3_tpu.persist import register_model_class
 
+def _max_iter_of(p, default=50) -> int:
+    """max_iterations <= 0 means AUTO in the reference clients (h2o-py
+    sends -1): fall back to the default instead of a zero-length loop."""
+    v = int(p.get("max_iterations", default) or default)
+    return v if v > 0 else default
+
+
 GLM_DEFAULTS: Dict = dict(
     family="auto", solver="auto", alpha=None, Lambda=None,
     lambda_search=False, nlambdas=30, lambda_min_ratio=1e-4,
@@ -38,6 +45,9 @@ GLM_DEFAULTS: Dict = dict(
     beta_epsilon=1e-5, gradient_epsilon=1e-6, link="family_default",
     seed=-1, tweedie_power=1.5, non_negative=False,
     missing_values_handling="mean_imputation",
+    # round-5 closure: NB dispersion, box constraints, DataInfo
+    # interactions (hex/glm/GLMModel.java:814, hex/DataInfo.java:16)
+    theta=1e-10, beta_constraints=None, interactions=None,
 )
 
 
@@ -145,8 +155,55 @@ class _Gamma(_Family):
         return jnp.maximum((w * y).sum() / w.sum(), 1e-4)
 
 
+class _Quasibinomial(_Binomial):
+    """Quasi-likelihood binomial (hex/glm GLMModel.Family.quasibinomial):
+    the binomial working model with a numeric response not restricted to
+    {0,1} — same IRLS weights/deviance formula evaluated at real y."""
+    name = "quasibinomial"
+
+
+class _FractionalBinomial(_Binomial):
+    """Fractional logit (Family.fractionalbinomial): y in [0,1]
+    proportions under the binomial likelihood (Papke-Wooldridge)."""
+    name = "fractionalbinomial"
+
+
+class _NegativeBinomial(_Family):
+    """Family.negativebinomial with log link: Var(μ) = μ + θμ²
+    (hex/glm/GLMModel.java NB theta = inverse dispersion parameter)."""
+    name = "negativebinomial"
+
+    def __init__(self, theta: float = 1.0):
+        self.theta = max(float(theta), 1e-10)
+
+    def link(self, mu):
+        return jnp.log(jnp.maximum(mu, 1e-10))
+
+    def linkinv(self, eta):
+        return jnp.exp(jnp.clip(eta, -30, 30))
+
+    def mu_eta(self, eta):
+        return self.linkinv(eta)
+
+    def variance(self, mu):
+        return jnp.maximum(mu + self.theta * mu * mu, 1e-10)
+
+    def deviance(self, w, y, mu):
+        t = self.theta
+        mu = jnp.maximum(mu, 1e-10)
+        yl = jnp.where(y > 0, y * jnp.log(jnp.maximum(y, 1e-10) / mu), 0.0)
+        tail = (y + 1.0 / t) * jnp.log((1.0 + t * y) / (1.0 + t * mu))
+        return 2.0 * (w * (yl - tail)).sum()
+
+    def init_mu(self, y, w):
+        return jnp.maximum((w * y).sum() / w.sum(), 1e-4)
+
+
 _FAMILIES = {"gaussian": _Gaussian, "binomial": _Binomial,
-             "poisson": _Poisson, "gamma": _Gamma}
+             "poisson": _Poisson, "gamma": _Gamma,
+             "quasibinomial": _Quasibinomial,
+             "fractionalbinomial": _FractionalBinomial,
+             "negativebinomial": _NegativeBinomial}
 
 
 # ---------------- device kernels --------------------------------------
@@ -164,7 +221,7 @@ def _gram_kernel(Xe, w_irls, z):
 
 
 def _cd_elastic_net(G, b, beta0, lam_l1, lam_l2, pen_mask, n_sweeps: int,
-                    non_negative=False, nn_mask=None):
+                    non_negative=False, nn_mask=None, lo=None, hi=None):
     """Cyclic coordinate descent on ½βᵀGβ − bᵀβ + λ₁|β|₁ + ½λ₂|β|₂²
     (glmnet 'covariance updates' — hex/glm coordinate_descent analog but on
     the reduced Gram, so each sweep is O(F²) device work, no row pass).
@@ -187,6 +244,11 @@ def _cd_elastic_net(G, b, beta0, lam_l1, lam_l2, pen_mask, n_sweeps: int,
             # bound applies to feature coefficients only, not the
             # intercept (pen_mask 0)
             bj = jnp.where(pen_mask[j] > 0, jnp.maximum(bj, 0.0), bj)
+        if lo is not None:
+            # beta_constraints box bounds: coordinate-wise projection is
+            # exact for CD (hex/glm GLM.BetaConstraint; the reference
+            # enforces via ADMM — same fixed point for box constraints)
+            bj = jnp.clip(bj, lo[j], hi[j])
         delta = bj - beta[j]
         Gb = Gb + G[:, j] * delta
         beta = beta.at[j].set(bj)
@@ -302,13 +364,46 @@ def _cholesky_solve(G, b, lam_l2, pen_mask):
 
 # ---------------- expansion + standardization --------------------------
 
+def _interaction_cols(X, names, is_cat, cat_domains, means, interactions,
+                      first: int):
+    """DataInfo interaction terms (hex/DataInfo.java:16 _interactions /
+    InteractionPair): all pairwise products among ``interactions``
+    columns — num×num one product column, cat×num a per-level indicator
+    × value block, cat×cat the indicator outer block (first levels
+    dropped like the main one-hot)."""
+    import itertools
+    cols, out_names = [], []
+
+    def col_of(n):
+        i = names.index(n)
+        x = X[:, i]
+        if is_cat[i]:
+            dom = cat_domains.get(n) or ()
+            codes = jnp.where(jnp.isnan(x), -1, x).astype(jnp.int32)
+            return [( (codes == lvl).astype(jnp.float32),
+                      f"{n}.{dom[lvl]}") for lvl in range(first, len(dom))]
+        m = means.get(n, 0.0)
+        return [(jnp.where(jnp.isnan(x), m, x), n)]
+
+    for a, b in itertools.combinations(interactions, 2):
+        if a not in names or b not in names:
+            raise ValueError(f"interactions column '{a if a not in names else b}'"
+                             f" is not a training feature")
+        for ca, na in col_of(a):
+            for cb, nb in col_of(b):
+                cols.append(ca * cb)
+                out_names.append(f"{na}_{nb}")
+    return cols, out_names
+
+
 def expand_design(spec: TrainingSpec, impute_means=None,
-                  use_all_levels: bool = False):
+                  use_all_levels: bool = False, interactions=None):
     """DataInfo analog: enum columns → one-hot indicator blocks (all
     levels except the first unless ``use_all_levels``,
-    useAllFactorLevels=False default), numerics mean-imputed for NAs.
-    Returns (Xe [padded, Fe] device, names, and the per-column imputation
-    means for scoring reuse)."""
+    useAllFactorLevels=False default), numerics mean-imputed for NAs,
+    plus pairwise interaction terms among the ``interactions`` columns
+    (hex/DataInfo.java _interactions). Returns (Xe [padded, Fe] device,
+    names, and the per-column imputation means for scoring reuse)."""
     cols = []
     names: List[str] = []
     means = {} if impute_means is None else impute_means
@@ -332,6 +427,12 @@ def expand_design(spec: TrainingSpec, impute_means=None,
                 m = means.get(n, 0.0)
             cols.append(jnp.where(jnp.isnan(x), m, x))
             names.append(n)
+    if interactions:
+        icols, inames = _interaction_cols(
+            spec.X, list(spec.names), list(spec.is_cat), spec.cat_domains,
+            means, list(interactions), first)
+        cols += icols
+        names += inames
     Xe = jnp.stack(cols, axis=1) if cols else jnp.zeros((spec.X.shape[0], 0))
     return Xe, names, means
 
@@ -355,7 +456,43 @@ def expand_scoring_matrix(model, X):
         else:
             m = model.impute_means.get(n, 0.0)
             cols.append(jnp.where(jnp.isnan(x), m, x))
+    inter = (model.params or {}).get("interactions") if hasattr(
+        model, "params") else None
+    if inter:
+        icols, _ = _interaction_cols(
+            X, list(model.feature_names), list(model.feature_is_cat),
+            model.cat_domains, model.impute_means, list(inter), first)
+        cols += icols
     return jnp.stack(cols, axis=1) if cols else jnp.zeros((X.shape[0], 0))
+
+
+def _parse_beta_constraints(bc):
+    """Accept the reference's beta_constraints shapes: a Frame with
+    names/lower_bounds/upper_bounds columns (h2o-py passes a frame), a
+    list of {names, lower_bounds, upper_bounds} dicts, or a
+    {name: (lo, hi)} mapping. Returns [(name, lo, hi), ...]."""
+    out = []
+    if hasattr(bc, "vec") and hasattr(bc, "names"):       # Frame
+        names = bc.vec("names").to_strings()
+        lo = (bc.vec("lower_bounds").to_numpy()
+              if "lower_bounds" in bc.names else [-np.inf] * len(names))
+        hi = (bc.vec("upper_bounds").to_numpy()
+              if "upper_bounds" in bc.names else [np.inf] * len(names))
+        for n, l, h in zip(names, lo, hi):
+            out.append((str(n),
+                        -np.inf if l is None or (isinstance(l, float)
+                                                 and np.isnan(l)) else float(l),
+                        np.inf if h is None or (isinstance(h, float)
+                                                and np.isnan(h)) else float(h)))
+    elif isinstance(bc, dict):
+        for n, (l, h) in bc.items():
+            out.append((str(n), float(l), float(h)))
+    else:                                                 # list of dicts
+        for e in bc:
+            out.append((str(e["names"]),
+                        float(e.get("lower_bounds", -np.inf)),
+                        float(e.get("upper_bounds", np.inf))))
+    return out
 
 
 # ---------------- model -------------------------------------------------
@@ -392,6 +529,12 @@ class GLMModel(Model):
                           for j, n in enumerate(self.exp_names)})
                 out[str(lbl)] = d
             return out
+        if self.family == "ordinal":
+            # per-threshold intercepts (cumulative-logit cutpoints)
+            d = {f"Intercept_{k}": float(v)
+                 for k, v in enumerate(np.atleast_1d(self.intercept_value))}
+            d.update({n: float(b) for n, b in zip(self.exp_names, self.beta)})
+            return d
         d = {"Intercept": self.intercept_value}
         d.update({n: float(b) for n, b in zip(self.exp_names, self.beta)})
         return d
@@ -411,6 +554,18 @@ class GLMModel(Model):
 
     def _predict_matrix(self, X, offset=None):
         Xe = expand_scoring_matrix(self, X)
+        if self.family == "ordinal":
+            eta = Xe @ jnp.asarray(self.beta)
+            if offset is not None:
+                eta = eta + offset
+            th = jnp.asarray(self.intercept_value)          # [K-1] ascending
+            cdf = 1.0 / (1.0 + jnp.exp(-(th[None, :] - eta[:, None])))
+            K = th.shape[0] + 1
+            probs = jnp.concatenate(
+                [cdf[:, :1],
+                 cdf[:, 1:] - cdf[:, :-1],
+                 1.0 - cdf[:, -1:]], axis=1)
+            return jnp.clip(probs, 1e-9, 1.0)
         if self.family == "multinomial":
             eta = Xe @ jnp.asarray(self.beta) + \
                 jnp.asarray(self.intercept_value)[None, :]
@@ -505,6 +660,10 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
         if not bool(p.get("intercept", True)):
             raise NotImplementedError(
                 "intercept=False is not supported in streaming mode")
+        if p.get("interactions") or p.get("beta_constraints"):
+            raise NotImplementedError(
+                "interactions/beta_constraints are not supported in "
+                "streaming mode")
         alpha = p.get("alpha")
         if isinstance(alpha, (list, tuple)):
             alpha = alpha[0] if alpha else None
@@ -525,7 +684,8 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
         if family not in _FAMILIES:
             raise NotImplementedError(
                 f"family '{family}' is not supported in streaming mode")
-        fam = _FAMILIES[family]()
+        fam = (_NegativeBinomial(float(p.get("theta", 1.0) or 1.0))
+               if family == "negativebinomial" else _FAMILIES[family]())
         rows = spec.nrow
         Xh = spec.X_host[:rows]
         yh = np.asarray(jax.device_get(spec.y))[:rows].astype(np.float32)
@@ -580,7 +740,7 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
         # null model intercept init
         mu0 = float(np.sum(yh * wh) / max(wh.sum(), 1e-12))
         beta = beta.at[-1].set(fam.link(jnp.float32(mu0)))
-        max_iter = int(p.get("max_iterations", 30) or 30)
+        max_iter = _max_iter_of(p, 30)
         for it in range(max_iter):
             G = jnp.zeros((ncoef, ncoef), jnp.float32)
             b = jnp.zeros(ncoef, jnp.float32)
@@ -654,24 +814,31 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
             return self._train_streaming(spec, job)
         p = self.params
         family = self._resolve_family(spec)
+        if family == "ordinal":
+            return self._train_ordinal(spec, valid_spec, job)
         if family == "multinomial":
             return self._train_multinomial(spec, valid_spec, job)
         if family not in _FAMILIES:
             raise ValueError(f"unsupported family '{family}'; have "
                              f"{sorted(_FAMILIES)}")
         link = (p.get("link") or "family_default").lower()
-        if link not in ("family_default", "",
-                        {"gaussian": "identity", "binomial": "logit",
-                         "poisson": "log", "gamma": "log"}[family]):
+        canon = {"gaussian": "identity", "binomial": "logit",
+                 "poisson": "log", "gamma": "log",
+                 "quasibinomial": "logit", "fractionalbinomial": "logit",
+                 "negativebinomial": "log"}[family]
+        if link not in ("family_default", "", canon):
             raise NotImplementedError(
                 f"non-canonical link '{link}' for family '{family}' is not "
                 f"implemented (canonical links only)")
         fit_intercept = bool(p.get("intercept", True))
-        fam = _FAMILIES[family]()
+        fam = (_NegativeBinomial(float(p.get("theta", 1.0) or 1.0))
+               if family == "negativebinomial" else _FAMILIES[family]())
         y = spec.y.astype(jnp.float32)
         w = spec.w
         offset = spec.offset
-        Xe, exp_names, means = expand_design(spec)
+        interactions = p.get("interactions") or None
+        Xe, exp_names, means = expand_design(spec,
+                                             interactions=interactions)
         Fe = Xe.shape[1]
         nobs = float(jax.device_get(w.sum()))
 
@@ -734,7 +901,7 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
 
         # the wire clients send -1 sentinels for "auto" numerics
         # (GLMParameters defaults) — fall back to our defaults
-        max_iter = int(p.get("max_iterations", 50) or 50)
+        max_iter = _max_iter_of(p, 50)
         if max_iter <= 0:
             max_iter = 50
         beta_eps = float(p.get("beta_epsilon", 1e-5))
@@ -756,6 +923,9 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
         solver = (str(p.get("solver") or "auto")
                   ).upper().replace("-", "_")
         use_lbfgs = solver in ("L_BFGS", "LBFGS")
+        if p.get("beta_constraints") and use_lbfgs:
+            # box bounds are enforced by the projected-CD IRLS solver
+            use_lbfgs = False
         if use_lbfgs and alpha > 0 and any(l > 0 for l in lambdas):
             raise ValueError(
                 "L1 penalty (alpha > 0 with lambda > 0) is not supported "
@@ -775,14 +945,24 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
             eta_i = Xs @ bs
             if offset is not None:
                 eta_i = eta_i + offset
-            if family == "binomial":
+            if family in ("binomial", "quasibinomial",
+                          "fractionalbinomial"):
                 per = jax.nn.softplus(eta_i) - y * eta_i
             elif family == "poisson":
                 per = jnp.exp(eta_i) - y * eta_i
             elif family == "gamma":
                 per = y * jnp.exp(-eta_i) + eta_i
-            else:
+            elif family == "negativebinomial":
+                th_nb = fam.theta
+                mu_i = jnp.exp(jnp.clip(eta_i, -30, 30))
+                per = ((y + 1.0 / th_nb) * jnp.log1p(th_nb * mu_i)
+                       - y * (jnp.log(th_nb) + eta_i))
+            elif family == "gaussian":
                 per = 0.5 * (y - eta_i) ** 2
+            else:
+                raise NotImplementedError(
+                    f"solver L_BFGS has no objective for family "
+                    f"'{family}'")
             return (w * per).sum() / nobs
 
         if use_lbfgs and ncoef >= 1024:
@@ -837,8 +1017,52 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
                 return nb
             return irls_step
 
+        # beta_constraints (hex/glm GLM.BetaConstraint): per-coefficient
+        # box bounds on the RAW scale, converted to the standardized
+        # scale (b_std = b_raw * sd) and enforced by projected CD
+        bc = p.get("beta_constraints")
+        bc_lo = bc_hi = None
+        if bc:
+            bc_lo = np.full(ncoef, -np.inf, np.float32)
+            bc_hi = np.full(ncoef, np.inf, np.float32)
+            entries = _parse_beta_constraints(bc)
+            lut = {n: i for i, n in enumerate(exp_names)}
+            for nme, lob, hib in entries:
+                if nme not in lut:
+                    raise ValueError(
+                        f"beta_constraints name '{nme}' is not an expanded "
+                        f"design column {exp_names}")
+                bc_lo[lut[nme]] = lob
+                bc_hi[lut[nme]] = hib
+            if standardize:
+                xs_h = np.asarray(jax.device_get(xs))
+                bc_lo[:Fe] = bc_lo[:Fe] * xs_h
+                bc_hi[:Fe] = bc_hi[:Fe] * xs_h
+            bc_lo = jnp.asarray(bc_lo)
+            bc_hi = jnp.asarray(bc_hi)
+
+        def _make_step_bc():
+            @jax.jit
+            def irls_step(beta_s, lam1, lam2):
+                eta_i = Xs @ beta_s
+                if offset is not None:
+                    eta_i = eta_i + offset
+                mu = fam.linkinv(eta_i)
+                dmu = fam.mu_eta(eta_i)
+                var = fam.variance(mu)
+                w_irls = w * dmu * dmu / var
+                z = (eta_i - (0.0 if offset is None else offset)
+                     + (y - mu) * dmu / jnp.maximum(dmu * dmu, 1e-12))
+                G, b = _gram_kernel(Xs, w_irls, z)
+                return _cd_elastic_net(G, b, beta_s, lam1, lam2, pen_mask,
+                                       n_sweeps=10, non_negative=non_neg,
+                                       nn_mask=nn_mask, lo=bc_lo, hi=bc_hi)
+            return irls_step
+
         step_chol = _make_step(False)
         step_cd = _make_step(True) if alpha > 0 else None
+        if bc is not None and bc:
+            step_bc = _make_step_bc()
 
         # validation design for lambda selection (the reference picks the
         # path's best submodel by validation deviance when a validation
@@ -846,7 +1070,8 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
         # the smallest lambda — same as the reference without CV)
         vXs = vy = vw = voff = None
         if valid_spec is not None:
-            vXe, _, _ = expand_design(valid_spec, impute_means=means)
+            vXe, _, _ = expand_design(valid_spec, impute_means=means,
+                                      interactions=interactions)
             if standardize:
                 vXs = (vXe - xm[None, :]) * (1.0 / xs)[None, :]
             else:
@@ -868,6 +1093,10 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
             else:
                 use_cd = alpha > 0 and lam > 0
                 irls_step = step_cd if use_cd else step_chol
+                if bc:
+                    # box bounds require the projected-CD solver
+                    use_cd = True
+                    irls_step = step_bc
                 lam1 = jnp.float32(lam * alpha * nobs)
                 lam2 = jnp.float32(lam * (1 - alpha) * nobs)
                 for it in range(max_iter):
@@ -972,6 +1201,112 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
                 spec.response_domain)
         return model
 
+    def _train_ordinal(self, spec: TrainingSpec, valid_spec, job: Job):
+        """Ordinal (proportional-odds) logistic regression — the
+        reference's Family.ordinal with solver GRADIENT_DESCENT_LH
+        (hex/glm/GLMModel.java:814, GLM.java ordinal path): cumulative
+        logits P(y<=k) = sigmoid(th_k - eta), monotone thresholds via a
+        log-gap parameterization, full-batch Adam on the NLL (the GD_LH
+        analog — one jitted lax.fori_loop, no per-row Java loop)."""
+        p = self.params
+        K = spec.nclasses
+        y = spec.y.astype(jnp.int32)
+        w = spec.w
+        interactions = p.get("interactions") or None
+        Xe, exp_names, means = expand_design(spec,
+                                             interactions=interactions)
+        Fe = Xe.shape[1]
+        wsum = w.sum()
+        xm = (Xe * w[:, None]).sum(0) / jnp.maximum(wsum, 1e-12)
+        xv = (w[:, None] * (Xe - xm[None, :]) ** 2).sum(0) / \
+            jnp.maximum(wsum, 1e-12)
+        xs = jnp.sqrt(jnp.maximum(xv, 1e-12))
+        Xs = (Xe - xm[None, :]) / xs[None, :]
+        lam2 = 0.0
+        lam_in = p.get("Lambda")
+        if lam_in:
+            lam2 = float(lam_in[0] if isinstance(lam_in, (list, tuple))
+                         else lam_in)
+
+        # params: beta [Fe], th0 scalar, log-gaps [K-2]
+        def unpack(params_v):
+            beta = params_v[:Fe]
+            th0 = params_v[Fe]
+            gaps = jnp.exp(jnp.clip(params_v[Fe + 1:], -20.0, 10.0))
+            th = th0 + jnp.concatenate(
+                [jnp.zeros(1), jnp.cumsum(gaps)])           # [K-1]
+            return beta, th
+
+        # class-prior-based threshold init (cumulative logits of the
+        # marginal distribution — the reference initializes the same way)
+        cnt = jnp.zeros(K).at[y].add(w)
+        cum = jnp.cumsum(cnt)[:-1] / jnp.maximum(wsum, 1e-12)
+        cum = jnp.clip(cum, 1e-4, 1 - 1e-4)
+        th_init = jnp.log(cum / (1 - cum))
+        gaps0 = jnp.log(jnp.maximum(jnp.diff(th_init), 1e-3))
+        params0 = jnp.concatenate(
+            [jnp.zeros(Fe), th_init[:1], gaps0]).astype(jnp.float32)
+
+        def nll(params_v):
+            beta, th = unpack(params_v)
+            eta = Xs @ beta
+            cdf = jax.nn.sigmoid(th[None, :] - eta[:, None])   # [rows, K-1]
+            probs = jnp.concatenate(
+                [cdf[:, :1], cdf[:, 1:] - cdf[:, :-1],
+                 1.0 - cdf[:, -1:]], axis=1)
+            py = jnp.take_along_axis(probs, y[:, None], axis=1)[:, 0]
+            reg = 0.5 * lam2 * (beta ** 2).sum()
+            return -(w * jnp.log(jnp.clip(py, 1e-12, 1.0))).sum() \
+                / jnp.maximum(wsum, 1e-12) + reg
+
+        vg = jax.value_and_grad(nll)
+        iters = _max_iter_of(p, 50) * 20
+        lr0 = 0.05                  # Adam step for the GD_LH analog
+
+        @jax.jit
+        def fit(params_v):
+            def body(i, st):
+                pv, m, v = st
+                _, g = vg(pv)
+                m = 0.9 * m + 0.1 * g
+                v = 0.999 * v + 0.001 * g * g
+                mh = m / (1 - 0.9 ** (i + 1.0))
+                vh = v / (1 - 0.999 ** (i + 1.0))
+                pv = pv - lr0 * mh / (jnp.sqrt(vh) + 1e-8)
+                return pv, m, v
+            out, _, _ = jax.lax.fori_loop(
+                0, iters, body,
+                (params_v, jnp.zeros_like(params_v),
+                 jnp.zeros_like(params_v)))
+            return out
+
+        pv = fit(params0)
+        job.set_progress(0.9)
+        beta_s, th = unpack(pv)
+        # destandardize: thresholds absorb the mean shift
+        beta_raw = beta_s / xs
+        shift = (beta_s * xm / xs).sum()
+        th_raw = np.asarray(jax.device_get(th + shift))
+        model = GLMModel(f"glm_{id(self) & 0xffffff:x}", p, spec,
+                         "ordinal", np.asarray(jax.device_get(beta_raw)),
+                         th_raw, exp_names, {k: float(v) for k, v in
+                                             means.items()},
+                         lam2, 0.0, float(jax.device_get(
+                             nll(pv) * wsum)), float(jax.device_get(wsum)),
+                         Fe + K - 1)
+        probs = model._predict_matrix(spec.X)
+        model.training_metrics = compute_metrics(
+            np.asarray(jax.device_get(probs)), y, w, K,
+            spec.response_domain)
+        if valid_spec is not None:
+            vprobs = model._predict_matrix(valid_spec.X,
+                                           offset=valid_spec.offset)
+            model.validation_metrics = compute_metrics(
+                np.asarray(jax.device_get(vprobs)),
+                valid_spec.y.astype(jnp.int32), valid_spec.w, K,
+                spec.response_domain)
+        return model
+
     def _train_multinomial(self, spec: TrainingSpec, valid_spec,
                            job: Job) -> GLMModel:
         """Multinomial softmax GLM — class-cyclic IRLS.
@@ -987,6 +1322,9 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
             raise NotImplementedError(
                 "offset_column is not supported for multinomial GLM "
                 "(the class-cyclic IRLS path has no offset term yet)")
+        if p.get("beta_constraints"):
+            raise NotImplementedError(
+                "beta_constraints are not supported for multinomial GLM")
         if p.get("lambda_search"):
             raise NotImplementedError(
                 "lambda_search is not supported for multinomial GLM — "
@@ -994,7 +1332,8 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
         fit_intercept = bool(p.get("intercept", True))
         y = spec.y.astype(jnp.int32)
         w = spec.w
-        Xe, exp_names, means = expand_design(spec)
+        Xe, exp_names, means = expand_design(
+            spec, interactions=p.get("interactions") or None)
         Fe = Xe.shape[1]
         nobs = float(jax.device_get(w.sum()))
         standardize = bool(p.get("standardize", True)) and fit_intercept
@@ -1024,7 +1363,7 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
             lam = float(lam_param) if lam_param is not None else 0.0
         lam1 = jnp.float32(lam * alpha * nobs)
         lam2 = jnp.float32(lam * (1 - alpha) * nobs)
-        max_iter = int(p.get("max_iterations", 50))
+        max_iter = _max_iter_of(p, 50)
         beta_eps = float(p.get("beta_epsilon", 1e-5))
         use_cd = lam > 0 and alpha > 0
 

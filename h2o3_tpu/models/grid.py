@@ -72,10 +72,14 @@ class H2OGridSearch:
         # orchestration + XLA compile of point N+1 with device train of
         # point N (one model rarely saturates host+device together for
         # the small models grids sweep)
-        par = int(parallelism or 1)
-        if par <= 1:     # explicit arg wins; else consult the criteria
-            par = int(self.search_criteria.get("parallelism", 1) or 1)
-        self.parallelism = par
+        par = parallelism if parallelism is not None else 1
+        if int(par) == 1:  # explicit arg wins; else consult the criteria
+            par = self.search_criteria.get("parallelism", 1)
+        par = int(par if par is not None else 1)
+        if par == 0:
+            # reference semantics: 0 = adaptive parallelism
+            par = max(2, min((os.cpu_count() or 4) // 2, 8))
+        self.parallelism = max(par, 1)
         self.models: List = []
         self.failures: List[Dict] = []
 
@@ -127,8 +131,6 @@ class H2OGridSearch:
                         done = m.get("completed", {})
                 except (json.JSONDecodeError, OSError):
                     done = {}  # crashed mid-write — retrain everything
-        import threading
-        state_lock = threading.Lock()
         built_count = [0]
 
         def one_point(i, combo):
@@ -188,13 +190,12 @@ class H2OGridSearch:
                 while ci < len(combos) or pending:
                     while (ci < len(combos)
                            and len(pending) < self.parallelism):
-                        with state_lock:
-                            if ((max_models and built_count[0]
-                                 + len(pending) >= max_models)
-                                    or (max_secs
-                                        and time.time() - t0 > max_secs)):
-                                ci = len(combos)
-                                break
+                        if ((max_models and built_count[0]
+                             + len(pending) >= max_models)
+                                or (max_secs
+                                    and time.time() - t0 > max_secs)):
+                            ci = len(combos)
+                            break
                         i, combo = combos[ci]
                         pending[ex.submit(one_point, i, combo)] = combo
                         ci += 1
@@ -205,10 +206,9 @@ class H2OGridSearch:
                     for fu in done_futs:
                         combo = pending.pop(fu)
                         i, model, failure, ckey, fresh = fu.result()
-                        with state_lock:
-                            record(i, combo, model, failure, ckey, fresh)
-                            if model is not None:
-                                built_count[0] += 1
+                        record(i, combo, model, failure, ckey, fresh)
+                        if model is not None:
+                            built_count[0] += 1
             self.models.sort(
                 key=lambda m: int(m.key.rsplit("_", 1)[1]))
         else:
