@@ -311,6 +311,40 @@ def _metrics_v3(m, kind_hint: str, domain: Optional[List[str]] = None,
     return d
 
 
+def _scoring_history_table(model) -> Optional[Dict]:
+    """ScoringHistory as the TwoDimTable the clients consume
+    (hex/ScoreKeeper + water/api ModelSchemaV3 scoring_history;
+    h2o-py learning_curve_plot reads number_of_trees/training_* columns,
+    h2o/explanation/_explain.py:2500)."""
+    hist = model.scoring_history
+    if not hist or not isinstance(hist, list) or not isinstance(
+            hist[0], dict):
+        return None
+    step_key = next((k for k in ("ntrees", "iterations", "iteration",
+                                 "epochs") if k in hist[0]), None)
+    step_name = {"ntrees": "number_of_trees", "iteration": "iterations",
+                 None: "iterations"}.get(step_key, step_key)
+    metric_keys = [k for k in hist[0]
+                   if k != step_key and isinstance(hist[0][k],
+                                                   (int, float))]
+    # learning_curve_plot always reads training_<metric>
+    # (h2o/explanation/_explain.py:2668); when the entries were scored
+    # on a validation frame they ALSO serve as validation_<metric>
+    has_valid = model.validation_metrics is not None
+    names = ["timestamp", "duration", step_name] + \
+        ["training_" + k for k in metric_keys] + \
+        (["validation_" + k for k in metric_keys] if has_valid else [])
+    cols: List[list] = [["" for _ in hist], ["" for _ in hist],
+                        [e.get(step_key, i) for i, e in enumerate(hist)]]
+    series = [[_fin_or_none(e.get(k)) for e in hist] for k in metric_keys]
+    cols += series
+    if has_valid:
+        cols += [list(sv) for sv in series]
+    types = ["string", "string", "long"] + \
+        ["double"] * (len(metric_keys) * (2 if has_valid else 1))
+    return twodim("Scoring History", names, cols, types)
+
+
 def model_v3(model, key: str) -> Dict:
     kind = ("Binomial" if model.nclasses == 2 else
             "Multinomial" if model.nclasses > 2 else "Regression")
@@ -326,6 +360,11 @@ def model_v3(model, key: str) -> Dict:
     out: Dict[str, Any] = {
         "model_category": kind,
         "names": names_nd,
+        "original_names": names_nd,     # pre-expansion == names here
+        "column_types": [("Enum" if n in model.cat_domains else "Numeric")
+                         for n in model.feature_names]
+        + (["Enum" if model.response_domain else "Numeric"]
+           if model.response else []),
         "domains": domains_nd,
         "training_metrics": _metrics_v3(model.training_metrics, kind,
                                         domain=dom, model_key=key),
@@ -333,7 +372,7 @@ def model_v3(model, key: str) -> Dict:
                                           domain=dom, model_key=key),
         "cross_validation_metrics": _metrics_v3(
             model.cross_validation_metrics, kind, domain=dom, model_key=key),
-        "scoring_history": model.scoring_history,
+        "scoring_history": _scoring_history_table(model),
         "run_time": int(model.run_time * 1000),
         "help": {},
     }
@@ -437,9 +476,37 @@ def model_v3(model, key: str) -> Dict:
         "have_mojo": False,
         "parameters": [
             {"name": k, "actual_value": v, "default_value": None,
-             "label": k, "type": type(v).__name__}
+             "label": k, "type": type(v).__name__, "input_value": v}
             for k, v in model.params.items()
-            if isinstance(v, (int, float, str, bool, list, type(None)))],
+            if isinstance(v, (int, float, str, bool, list, type(None)))
+            and k not in ("model_id", "response_column", "training_frame",
+                          "validation_frame")
+        ] + [
+            # special params carry STRUCTURED actual_values — h2o-py's
+            # ModelBase.actual_params reads actual_value["column_name"] /
+            # ["name"] (ColSpecifierV3/KeyV3); a bare string makes the
+            # property raise and the compat metaclass then returns the
+            # raw descriptor (h2o/utils/metaclass.py:345)
+            {"name": "response_column",
+             "actual_value": {"column_name": model.response},
+             "default_value": None, "label": "response_column",
+             "type": "VecSpecifier", "input_value": None},
+            {"name": "model_id", "actual_value": {"name": key},
+             "default_value": None, "label": "model_id", "type": "Key",
+             "input_value": None},
+            {"name": "training_frame",
+             "actual_value": ({"name": str(model.params["training_frame"])}
+                              if model.params.get("training_frame")
+                              else None),
+             "default_value": None, "label": "training_frame",
+             "type": "Key", "input_value": None},
+            {"name": "validation_frame",
+             "actual_value": ({"name": str(model.params[
+                 "validation_frame"])}
+                 if model.params.get("validation_frame") else None),
+             "default_value": None, "label": "validation_frame",
+             "type": "Key", "input_value": None},
+        ],
         "output": out,
     }
 

@@ -1017,8 +1017,19 @@ def _shutdown(params, body):
 def _rapids(params, body):
     from h2o3_tpu.rapids import exec_rapids
     ast = params.get("ast", "")
+    # numpy>=2 compatibility for the UNMODIFIED client: h2o-py pins
+    # numpy<2 and str()-serializes column names; under numpy 2 a
+    # np.str_ reprs as np.str_('name') and leaks into the ast
+    ast = re.sub(r"np\.str_\('([^']*)'\)", r'"\1"', ast)
     session = params.get("session_id")
-    return exec_rapids(ast, session)
+    try:
+        return exec_rapids(ast, session)
+    except Exception as e:
+        # surface WHICH expression failed — rapids errors without the
+        # ast are undebuggable from the client side (ValueError: not
+        # every exception type reconstructs from one string)
+        raise ValueError(
+            f"{type(e).__name__}: {e} [ast: {str(ast)[:400]}]") from e
 
 
 # ---------------- HTTP plumbing ----------------------------------------

@@ -285,6 +285,10 @@ class H2OGradientBoostingEstimator(ModelBuilder):
     def __init__(self, **params):
         merged = dict(GBM_DEFAULTS)
         merged.update(params)
+        # scoring cadence: only an EXPLICIT score_tree_interval records
+        # per-interval history without early stopping (the merged
+        # default of 5 must not slow every plain run down)
+        merged["_score_interval_explicit"] = "score_tree_interval" in params
         super().__init__(**merged)
 
     # -- driver ---------------------------------------------------------
@@ -432,7 +436,14 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             vmargin = (jnp.zeros(8 * nd, jnp.float32) if K == 1
                        else jnp.zeros((8 * nd, K), jnp.float32))
 
-        chunk = interval if keeper.rounds > 0 else min(ntrees_new, 50)
+        # scoring cadence: early stopping OR an explicit
+        # score_tree_interval both record ScoreKeeper history (the
+        # reference scores every interval regardless of stopping —
+        # learning_curve_plot reads this)
+        score_each = (keeper.rounds > 0
+                      or (bool(p.get("_score_interval_explicit"))
+                          and int(p.get("score_tree_interval", 0) or 0) > 0))
+        chunk = interval if score_each else min(ntrees_new, 50)
         has_t = (not adaptive) and bm.codes.t is not None
         codes_t_arg = bm.codes.t if has_t else Xtr  # ignored dummy otherwise
         na_bin = 0 if adaptive else bm.na_bin
@@ -492,14 +503,14 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             job.set_progress(0.5 * built / ntrees_new)
             if job.cancel_requested:
                 break
-            if keeper.rounds > 0:
+            if score_each:
                 sc_spec = valid_spec if has_valid else spec
                 sc_margin = vmargin if has_valid else margin
                 entry = self._score_entry(sc_margin, sc_spec, dist, K,
                                           start_trees + built,
                                           want_auc=keeper.metric == "auc")
                 keeper.record(entry)
-                if keeper.should_stop():
+                if keeper.rounds > 0 and keeper.should_stop():
                     break
 
         jax.block_until_ready(margin)

@@ -479,3 +479,94 @@ def make_anomaly_metrics(score, normalized_score) -> ModelMetricsAnomaly:
     return ModelMetricsAnomaly(mean_score=float(s.mean()),
                                mean_normalized_score=float(ns.mean()),
                                nobs=int(s.shape[0]))
+
+
+# ---------------- uplift (hex/AUUC.java + ModelMetricsBinomialUplift) ---
+
+@dataclass
+class ModelMetricsBinomialUplift:
+    """hex/ModelMetricsBinomialUplift: the AUUC object with its
+    threshold table and the qini/lift/gain flavors
+    (hex/AUUC.java AUUCType)."""
+    auuc: float                         # default-flavor AUUC (qini)
+    auuc_normalized: float
+    qini: float                         # Qini coefficient (area - random)
+    ate: float                          # average treatment effect
+    att: float                          # ATE on the treated
+    atc: float                          # ATE on control
+    auuc_table: Optional[dict] = None   # per-bin AUUC per flavor
+    thresholds_and_metric_scores: Optional[dict] = None
+    nobs: int = 0
+
+    @property
+    def auuc_normalized_(self):
+        return self.auuc_normalized
+
+    def to_dict(self):
+        return {"AUUC": self.auuc, "auuc": self.auuc,
+                "auuc_normalized": self.auuc_normalized,
+                "qini": self.qini, "ate": self.ate, "att": self.att,
+                "atc": self.atc, "nobs": self.nobs}
+
+
+def make_uplift_metrics(uplift, y, treat, weights=None,
+                        nbins: int = 1000) -> ModelMetricsBinomialUplift:
+    """Full AUUC computation (hex/AUUC.java): rows ranked by predicted
+    uplift, cumulative uplift at ``nbins`` thresholds, three flavors:
+      qini:  cum_treat_y − cum_ctrl_y · n_t/n_c
+      lift:  cum_treat_y/n_t − cum_ctrl_y/n_c
+      gain:  lift · (n_t + n_c)
+    AUUC = mean over bins of the chosen flavor's curve; normalized
+    divides by the curve's final value (AUUC.java normalizedAUUC)."""
+    uplift = np.asarray(uplift, np.float64)
+    y = np.asarray(y, np.float64)
+    treat = np.asarray(treat, np.float64)
+    w = (np.ones_like(y) if weights is None
+         else np.asarray(weights, np.float64))
+    live = w > 0
+    uplift, y, treat, w = uplift[live], y[live], treat[live], w[live]
+    n = len(y)
+    order = np.argsort(-uplift)
+    u_s = uplift[order]
+    wt = (w * treat)[order]
+    wc = (w * (1 - treat))[order]
+    wyt = (w * y * treat)[order]
+    wyc = (w * y * (1 - treat))[order]
+    nt = np.cumsum(wt)
+    nc = np.cumsum(wc)
+    cyt = np.cumsum(wyt)
+    cyc = np.cumsum(wyc)
+    qini_c = cyt - cyc * nt / np.maximum(nc, 1e-12)
+    lift_c = cyt / np.maximum(nt, 1e-12) - cyc / np.maximum(nc, 1e-12)
+    gain_c = lift_c * (nt + nc)
+    idx = np.linspace(0, n - 1, min(nbins, n)).astype(int)
+    flavors = {"qini": qini_c, "lift": lift_c, "gain": gain_c}
+    aucs = {k: float(v[idx].mean()) for k, v in flavors.items()}
+    finals = {k: float(v[-1]) if n else 0.0 for k, v in flavors.items()}
+    norm = {k: (aucs[k] / finals[k] if abs(finals[k]) > 1e-12 else 0.0)
+            for k in flavors}
+    # random-targeting baseline for the Qini coefficient
+    rand_area = 0.5 * finals["qini"]
+    ate = (float(cyt[-1] / max(nt[-1], 1e-12)
+                 - cyc[-1] / max(nc[-1], 1e-12)) if n else 0.0)
+    # ATT/ATC: the model's PREDICTED uplift averaged over the treated /
+    # control subpopulations (distinct estimands from the outcome-based
+    # ATE above — hex/ModelMetricsBinomialUplift)
+    wt_sum = float((w * treat).sum())
+    wc_sum = float((w * (1 - treat)).sum())
+    att = (float((w * treat * uplift).sum() / max(wt_sum, 1e-12))
+           if n else 0.0)
+    atc = (float((w * (1 - treat) * uplift).sum() / max(wc_sum, 1e-12))
+           if n else 0.0)
+    tbl = {
+        "thresholds": [float(u_s[i]) for i in idx],
+        "qini": [float(qini_c[i]) for i in idx],
+        "lift": [float(lift_c[i]) for i in idx],
+        "gain": [float(gain_c[i]) for i in idx],
+        "n": [int(i + 1) for i in idx],
+    }
+    return ModelMetricsBinomialUplift(
+        auuc=aucs["qini"], auuc_normalized=norm["qini"],
+        qini=aucs["qini"] - rand_area, ate=ate, att=att, atc=atc,
+        auuc_table={"flavors": aucs, "normalized": norm},
+        thresholds_and_metric_scores=tbl, nobs=n)

@@ -320,9 +320,14 @@ class H2OUpliftRandomForestEstimator(ModelBuilder):
         u = np.asarray(jax.device_get(model._predict_matrix(Xf)))
         live = np.asarray(jax.device_get(w)) > 0
         model.output["mean_uplift_prediction"] = float(u[live].mean())
-        model.output["auuc"] = _auuc(
-            u[live], np.asarray(jax.device_get(y))[live],
-            np.asarray(jax.device_get(treat))[live])
+        # full metrics OBJECT (hex/ModelMetricsBinomialUplift + AUUC.java
+        # flavors/thresholds); the scalar output rides the same pass
+        from h2o3_tpu.models.metrics import make_uplift_metrics
+        model.training_metrics = make_uplift_metrics(
+            u, np.asarray(jax.device_get(y)),
+            np.asarray(jax.device_get(treat)),
+            weights=np.asarray(jax.device_get(w)))
+        model.output["auuc"] = model.training_metrics.auuc
         return model
 
 

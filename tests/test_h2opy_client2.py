@@ -105,3 +105,67 @@ def test_frame_binary_save_load(client, prostate, tmp_path):
     assert loaded.dim == [380, 9]
     assert abs(loaded["AGE"].mean()[0] - 66.0394) < 1e-2
     assert loaded["RACE"].isfactor() == [True]
+
+
+def test_learning_curve_and_varimp_plot(client, prostate):
+    """h2o-py explain-stack entry points against the live server:
+    learning_curve_plot (scoring-history TwoDimTable) and varimp —
+    matplotlib renders headless (h2o/explanation/_explain.py:2429)."""
+    import matplotlib
+    matplotlib.use("Agg")
+    from h2o.estimators import H2OGradientBoostingEstimator
+    gbm = H2OGradientBoostingEstimator(ntrees=10, max_depth=3, seed=3,
+                                       score_tree_interval=2,
+                                       stopping_rounds=0)
+    gbm.train(y="CAPSULE", x=["AGE", "PSA", "GLEASON"],
+              training_frame=prostate)
+    sh = gbm.scoring_history()
+    assert sh is not None
+    plot = gbm.learning_curve_plot(metric="logloss")
+    assert plot is not None
+    vi = gbm.varimp_plot(server=True)
+
+
+def test_uplift_metrics_object(client):
+    """ModelMetricsBinomialUplift through the uplift estimator
+    (hex/AUUC.java flavors)."""
+    import numpy as np
+    import h2o3_tpu
+    from h2o3_tpu.models.uplift import H2OUpliftRandomForestEstimator
+    rng = np.random.default_rng(0)
+    n = 2000
+    x = rng.normal(size=(n, 3))
+    treat = rng.integers(0, 2, n)
+    p = 0.3 + 0.2 * treat * (x[:, 0] > 0)
+    y = (rng.random(n) < p).astype(int)
+    fr = h2o3_tpu.Frame.from_numpy({
+        "x0": x[:, 0], "x1": x[:, 1], "x2": x[:, 2],
+        "treat": np.array(["0", "1"], dtype=object)[treat],
+        "y": np.array(["0", "1"], dtype=object)[y]})
+    est = H2OUpliftRandomForestEstimator(
+        ntrees=5, max_depth=3, treatment_column="treat", seed=1)
+    est.train(y="y", x=["x0", "x1", "x2"], training_frame=fr)
+    mm = est.model.training_metrics
+    assert mm.auuc > 0                # positive uplift exists by design
+    assert 0 <= mm.auuc_normalized <= 1.5
+    assert "qini" in mm.auuc_table["flavors"]
+    tbl = mm.thresholds_and_metric_scores
+    assert len(tbl["thresholds"]) == len(tbl["qini"]) > 10
+    assert mm.ate > 0.05              # true ATE = 0.1
+
+
+def test_explain_smoke(client, prostate):
+    """h2o-py model.explain() against the live server (VERDICT r4 task 7
+    done-criterion): varimp + SHAP summary + PDP panels render headless
+    from REST data (h2o/explanation/_explain.py)."""
+    import matplotlib
+    matplotlib.use("Agg")
+    from h2o.estimators import H2OGradientBoostingEstimator
+    gbm = H2OGradientBoostingEstimator(ntrees=6, max_depth=3, seed=4,
+                                       score_tree_interval=2)
+    gbm.train(y="CAPSULE", x=["AGE", "PSA", "GLEASON"],
+              training_frame=prostate)
+    exp = gbm.explain(prostate, render=False,
+                      include_explanations=["varimp", "shap_summary",
+                                            "pdp"])
+    assert exp is not None and len(exp) >= 2
