@@ -348,6 +348,11 @@ def _scoring_history_table(model) -> Optional[Dict]:
 def model_v3(model, key: str) -> Dict:
     kind = ("Binomial" if model.nclasses == 2 else
             "Multinomial" if model.nclasses > 2 else "Regression")
+    # uplift models carry ModelMetricsBinomialUplift — a distinct wire
+    # category (hex/ModelMetricsBinomialUplift; a Binomial schema with
+    # only AUUC fields would break the client's .auc()/show())
+    if type(model.training_metrics).__name__ == "ModelMetricsBinomialUplift":
+        kind = "BinomialUplift"
     dom = list(getattr(model, "response_domain", None) or []) or None
     # names/domains: feature columns + response last (hex/Model.Output
     # _names/_domains; h2o-py H2OTree categorical decode reads these)

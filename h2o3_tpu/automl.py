@@ -91,6 +91,47 @@ def _glm_steps(ctx):
     return [{"algo": "glm", "id": "GLM_def_1", "params": params}]
 
 
+def _lr_annealing_step(leader, aml):
+    params = {k: v for k, v in leader.params.items()
+              if k in ("max_depth", "sample_rate", "col_sample_rate",
+                       "min_rows", "nbins")}
+    params.update({"ntrees": int(leader.params.get("ntrees", 50) * 2),
+                   "learn_rate":
+                       float(leader.params.get("learn_rate", 0.1)) / 2})
+    return [{"id": f"{leader.output.get('automl_family', 'gbm')}"
+                   f"_lr_annealing",
+             "algo": leader.output.get("automl_family", "gbm"),
+             "params": params}]
+
+
+def _forest_deepen_step(leader, aml):
+    params = {k: v for k, v in leader.params.items()
+              if k in ("sample_rate", "mtries", "min_rows", "nbins")}
+    params.update({"ntrees": int(leader.params.get("ntrees", 50) * 2),
+                   "max_depth":
+                       int(leader.params.get("max_depth", 20)) + 4})
+    return [{"id": "drf_deepened", "algo": "drf", "params": params}]
+
+
+def _glm_refine_step(leader, aml):
+    lam = leader.params.get("Lambda") or [0.0]
+    base = float(lam[0] if isinstance(lam, (list, tuple)) else lam)
+    return [{"id": "glm_lambda_refine", "algo": "glm",
+             "params": {"family": leader.params.get("family", "auto"),
+                        "alpha": [0.5],
+                        "Lambda": [max(base / 10.0, 1e-6)]}}]
+
+
+# the exploitation PLAN IS DATA (AutoML.java:403-457 per-algo
+# exploitation steps): family → provider(leader, aml) → step dicts
+EXPLOITATION_STEPS: Dict[str, Callable] = {
+    "gbm": _lr_annealing_step,
+    "xgboost": _lr_annealing_step,
+    "drf": _forest_deepen_step,
+    "glm": _glm_refine_step,
+}
+
+
 def _deeplearning_steps(ctx):
     return [
         {"algo": "deeplearning", "id": "DL_def_1",
@@ -220,6 +261,7 @@ class H2OAutoML:
                  modeling_plan: Optional[Sequence] = None,
                  exploitation_ratio: float = -1.0,
                  preprocessing: Optional[Sequence[str]] = None,
+                 recovery_dir: Optional[str] = None,
                  **_ignored):
         if not max_models and not max_runtime_secs:
             max_runtime_secs = 3600.0
@@ -237,6 +279,10 @@ class H2OAutoML:
         self.modeling_plan = list(modeling_plan or DEFAULT_MODELING_PLAN)
         self.exploitation_ratio = float(exploitation_ratio)
         self.preprocessing = [str(s).lower() for s in (preprocessing or [])]
+        # hex/faulttolerance/Recovery.java: AutoML state persists per
+        # completed step; a restarted build with the same recovery_dir
+        # reloads finished models and resumes the plan
+        self.recovery_dir = recovery_dir
         self.models: List = []
         self.event_log: List[Dict] = []
         self._leaderboard: Optional[Leaderboard] = None
@@ -340,6 +386,7 @@ class H2OAutoML:
                 self._log("skip", f"target encoding failed: {e}")
         ctx = {"nclasses": nclasses, "nfolds": self.nfolds,
                "seed": self.seed}
+        resume = self._load_recovery()
         # exploitation budget carve-out (AutoML.java:346,457): a slice of
         # the time budget reserved for fine-tuning the exploration leader
         exploit_secs = 0.0
@@ -357,6 +404,11 @@ class H2OAutoML:
                 break
             algo = step["algo"]
             if not self._algo_allowed(algo):
+                continue
+            if step["id"] in resume.get("steps_done", []):
+                n = self._resume_step(step["id"], resume)
+                self._log("resume", f"step {step['id']}: {n} model(s) "
+                                    f"reloaded from recovery_dir")
                 continue
             params = dict(step.get("params") or {})
             params.setdefault("seed", self.seed)
@@ -386,6 +438,7 @@ class H2OAutoML:
                         est, x, y, training_frame, validation_frame)
                     self._register(model, step["id"])
                 self._log("model", f"built {step['id']}")
+                self._checkpoint_step(step["id"])
             except Exception as e:  # noqa: BLE001 — plan keeps going
                 self._log("skip", f"{step['id']} failed: {e}")
         if self.exploitation_ratio > 0 and self.models:
@@ -399,31 +452,132 @@ class H2OAutoML:
         return self
 
     def _exploitation(self, x, y, training_frame, validation_frame, t0):
-        """Exploitation phase (AutoML.java exploitation steps): retrain
-        the best tree model with more trees + a finer learning rate on
-        the remaining budget."""
+        """Exploitation phase (AutoML.java:403-457 exploitation step
+        family): the PLAN IS DATA — per-family providers in
+        EXPLOITATION_STEPS derive refinement steps from the current
+        family leader; each runs on the remaining budget."""
         self._rank()
-        leader = next((m for m in self.models
-                       if m.output.get("automl_family") in
-                       ("gbm", "xgboost", "drf", "xrt")), None)
-        if leader is None or not self._budget_left(t0):
-            return
-        params = {k: v for k, v in leader.params.items()
-                  if k in ("max_depth", "sample_rate", "col_sample_rate",
-                           "min_rows", "nbins")}
-        params.update({"ntrees": int(leader.params.get("ntrees", 50) * 2),
-                       "learn_rate":
-                           float(leader.params.get("learn_rate", 0.1)) / 2,
-                       "seed": self.seed, "nfolds": self.nfolds})
+        by_family = {}
+        for m in self.models:
+            fam = m.output.get("automl_family")
+            if fam and fam not in by_family:
+                by_family[fam] = m      # models are rank-ordered
+        builders = self._builders()
+        resume = self._load_recovery()
+        for fam, provider in EXPLOITATION_STEPS.items():
+            if not self._budget_left(t0):
+                break
+            leader = by_family.get(fam)
+            if leader is None:
+                continue
+            for step in provider(leader, self):
+                if step["id"] in resume.get("steps_done", []):
+                    n = self._resume_step(step["id"], resume)
+                    self._log("resume", f"exploitation {step['id']}: "
+                                        f"{n} model(s) reloaded")
+                    continue
+                if not self._budget_left(t0):
+                    break
+                algo = step.get("algo", fam)
+                if algo not in builders:
+                    continue
+                params = dict(step["params"])
+                params.setdefault("seed", self.seed)
+                params["nfolds"] = self.nfolds
+                try:
+                    est = builders[algo](**params)
+                    model = self._train_budgeted(
+                        est, x, y, training_frame, validation_frame)
+                    self._register(model, step["id"])
+                    self._log("exploitation", f"built {step['id']} "
+                                              f"from {fam} leader")
+                    self._checkpoint_step(step["id"])
+                except Exception as e:  # noqa: BLE001
+                    self._log("skip", f"exploitation {step['id']} "
+                                      f"failed: {e}")
+
+    # -- fault tolerance (hex/faulttolerance/Recovery.java) -------------
+
+    def _recovery_paths(self):
+        import os
+        man = os.path.join(self.recovery_dir,
+                           f"{self.project_name}.automl.json")
+        return self.recovery_dir, man
+
+    def _config_fp(self) -> str:
+        import json as _json
+        # budgets (max_models/max_runtime) are NOT identity: a resume
+        # may extend them (Recovery.java resumes with remaining budget)
+        return _json.dumps(
+            {"plan": [str(e) for e in self.modeling_plan],
+             "nfolds": self.nfolds, "seed": self.seed}, sort_keys=True)
+
+    def _load_recovery(self) -> Dict:
+        if not self.recovery_dir:
+            return {}
+        import json as _json
+        import os
+        os.makedirs(self.recovery_dir, exist_ok=True)
+        _, man = self._recovery_paths()
+        if not os.path.exists(man):
+            return {}
         try:
-            from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
-            est = H2OGradientBoostingEstimator(**params)
-            model = self._train_budgeted(est, x, y, training_frame,
-                                         validation_frame)
-            self._register(model, "GBM_lr_annealing")
-            self._log("exploitation", "built GBM_lr_annealing from leader")
-        except Exception as e:  # noqa: BLE001
-            self._log("skip", f"exploitation failed: {e}")
+            with open(man) as f:
+                state = _json.load(f)
+        except (OSError, _json.JSONDecodeError):
+            return {}
+        if state.get("config") != self._config_fp():
+            self._log("resume", "recovery state ignored: AutoML config "
+                                "changed since the saved run")
+            return {}
+        return state
+
+    def _resume_step(self, step_id: str, state: Dict) -> int:
+        from h2o3_tpu.persist import load_model
+        n = 0
+        for key, path in state.get("models", {}).items():
+            mstep = state.get("model_steps", {}).get(key, "")
+            # grid steps register per-model ids like '<step>_<n>'
+            if mstep != step_id and not mstep.startswith(step_id + "_"):
+                continue
+            try:
+                m = load_model(path)
+                m.key = key
+                dkv.put(key, "model", m)
+                self.models.append(m)
+                n += 1
+            except Exception as e:  # noqa: BLE001
+                self._log("resume", f"could not reload {key}: {e}")
+        return n
+
+    def _checkpoint_step(self, step_id: str):
+        """Persist every model of the completed step + the manifest."""
+        if not self.recovery_dir:
+            return
+        import json as _json
+        import os
+        from h2o3_tpu.persist import save_model
+        _, man = self._recovery_paths()
+        state = self._load_recovery() or {
+            "config": self._config_fp(), "steps_done": [],
+            "models": {}, "model_steps": {}}
+        for m in self.models:
+            if m.key in state["models"]:
+                continue
+            try:
+                path = save_model(m, self.recovery_dir, force=True,
+                                  filename=m.key)
+                state["models"][m.key] = path
+                state["model_steps"][m.key] = m.output.get("automl_step",
+                                                           step_id)
+            except Exception as e:  # noqa: BLE001
+                self._log("resume", f"could not persist {m.key}: {e}")
+        if step_id not in state["steps_done"]:
+            state["steps_done"].append(step_id)
+        tmp = man + ".part"
+        with open(tmp, "w") as f:
+            _json.dump(state, f)
+        os.replace(tmp, man)
 
     def _train_budgeted(self, est, x, y, training_frame, validation_frame):
         """Train one step, cancelling at max_runtime_secs_per_model (the

@@ -285,10 +285,11 @@ class H2OGradientBoostingEstimator(ModelBuilder):
     def __init__(self, **params):
         merged = dict(GBM_DEFAULTS)
         merged.update(params)
-        # scoring cadence: only an EXPLICIT score_tree_interval records
-        # per-interval history without early stopping (the merged
-        # default of 5 must not slow every plain run down)
-        merged["_score_interval_explicit"] = "score_tree_interval" in params
+        # scoring cadence: only a NON-DEFAULT score_tree_interval records
+        # per-interval history without early stopping. Compared by VALUE,
+        # not by presence: params round-trip through grid/load copies
+        # that always carry the merged default, and a private flag would
+        # leak into model.params/REST.
         super().__init__(**merged)
 
     # -- driver ---------------------------------------------------------
@@ -440,9 +441,10 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         # score_tree_interval both record ScoreKeeper history (the
         # reference scores every interval regardless of stopping —
         # learning_curve_plot reads this)
+        sti = int(p.get("score_tree_interval", 0) or 0)
         score_each = (keeper.rounds > 0
-                      or (bool(p.get("_score_interval_explicit"))
-                          and int(p.get("score_tree_interval", 0) or 0) > 0))
+                      or (sti > 0
+                          and sti != GBM_DEFAULTS["score_tree_interval"]))
         chunk = interval if score_each else min(ntrees_new, 50)
         has_t = (not adaptive) and bm.codes.t is not None
         codes_t_arg = bm.codes.t if has_t else Xtr  # ignored dummy otherwise

@@ -332,19 +332,10 @@ class H2OUpliftRandomForestEstimator(ModelBuilder):
 
 
 def _auuc(uplift, y, treat, bins: int = 1000) -> float:
-    """Area under the uplift curve (hex/AUUC.java qini flavor,
-    normalized by n)."""
-    order = np.argsort(-uplift)
-    yt = (y * treat)[order]
-    yc = (y * (1 - treat))[order]
-    nt = np.cumsum(treat[order])
-    nc = np.cumsum((1 - treat)[order])
-    cyt = np.cumsum(yt)
-    cyc = np.cumsum(yc)
-    qini = cyt - cyc * nt / np.maximum(nc, 1)
-    # sample the curve at `bins` points like the reference
-    idx = np.linspace(0, len(qini) - 1, min(bins, len(qini))).astype(int)
-    return float(qini[idx].mean())
+    """Qini-flavor AUUC — delegates to the maintained implementation
+    (h2o3_tpu/models/metrics.py make_uplift_metrics)."""
+    from h2o3_tpu.models.metrics import make_uplift_metrics
+    return make_uplift_metrics(uplift, y, treat, nbins=bins).auuc
 
 
 register_model_class("upliftdrf", UpliftRandomForestModel)

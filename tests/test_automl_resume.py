@@ -1,0 +1,74 @@
+"""AutoML fault tolerance + exploitation step family
+(hex/faulttolerance/Recovery.java; ai/h2o/automl/AutoML.java:403-457)."""
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.automl import EXPLOITATION_STEPS, H2OAutoML
+
+
+def _frame(n=600, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(x1 - x2)))).astype(int)
+    return h2o.Frame.from_numpy({
+        "x1": x1, "x2": x2,
+        "y": np.array(["n", "p"], dtype=object)[y]})
+
+
+def test_automl_resume_kill_restart(tmp_path):
+    fr = _frame()
+    a1 = H2OAutoML(max_models=2, nfolds=0, seed=7, project_name="amlrec",
+                   recovery_dir=str(tmp_path))
+    a1.train(y="y", training_frame=fr)
+    assert len(a1.models) >= 2
+    done_keys = sorted(m.key for m in a1.models)
+    # 'crash': a brand-new AutoML object with the same project/recovery
+    a2 = H2OAutoML(max_models=4, nfolds=0, seed=7, project_name="amlrec",
+                   recovery_dir=str(tmp_path))
+    a2.train(y="y", training_frame=fr)
+    resumed = [e for e in a2.event_log if e["stage"] == "resume"
+               and "reloaded" in e["message"]]
+    assert resumed, a2.event_log
+    keys2 = sorted(m.key for m in a2.models)
+    for k in done_keys:
+        assert k in keys2          # earlier work reused, not retrained
+    assert len(a2.models) >= 3
+    lb = a2.leaderboard
+    assert len(lb) >= 3
+
+
+def test_automl_resume_ignores_changed_config(tmp_path):
+    fr = _frame(seed=2)
+    a1 = H2OAutoML(max_models=1, nfolds=0, seed=3, project_name="amlcfg",
+                   recovery_dir=str(tmp_path))
+    a1.train(y="y", training_frame=fr)
+    a2 = H2OAutoML(max_models=1, nfolds=0, seed=99, project_name="amlcfg",
+                   recovery_dir=str(tmp_path))   # different seed
+    a2.train(y="y", training_frame=fr)
+    assert any("config changed" in e["message"] for e in a2.event_log
+               if e["stage"] == "resume")
+
+
+def test_exploitation_step_family_is_data():
+    assert set(EXPLOITATION_STEPS) >= {"gbm", "xgboost", "drf", "glm"}
+    # providers derive refinement steps from a leader's params
+    class FakeLeader:
+        params = {"ntrees": 10, "learn_rate": 0.2, "max_depth": 4}
+        output = {"automl_family": "gbm"}
+    steps = EXPLOITATION_STEPS["gbm"](FakeLeader(), None)
+    assert steps[0]["params"]["ntrees"] == 20
+    assert steps[0]["params"]["learn_rate"] == 0.1
+
+
+def test_exploitation_runs_per_family():
+    fr = _frame(seed=4)
+    aml = H2OAutoML(max_models=8, max_runtime_secs=120, nfolds=0, seed=5,
+                    project_name="amlexp", exploitation_ratio=0.3,
+                    modeling_plan=["gbm", "glm"],
+                    include_algos=["GBM", "GLM"])
+    aml.train(y="y", training_frame=fr)
+    steps = [m.output.get("automl_step") for m in aml.models]
+    assert any("lr_annealing" in (s or "") or "lambda_refine" in (s or "")
+               for s in steps), steps
