@@ -128,6 +128,7 @@ EXPLOITATION_STEPS: Dict[str, Callable] = {
     "gbm": _lr_annealing_step,
     "xgboost": _lr_annealing_step,
     "drf": _forest_deepen_step,
+    "xrt": _forest_deepen_step,
     "glm": _glm_refine_step,
 }
 
@@ -386,6 +387,8 @@ class H2OAutoML:
                 self._log("skip", f"target encoding failed: {e}")
         ctx = {"nclasses": nclasses, "nfolds": self.nfolds,
                "seed": self.seed}
+        self._data_fp = [y, list(training_frame.names),
+                         int(training_frame.nrow)]
         resume = self._load_recovery()
         # exploitation budget carve-out (AutoML.java:346,457): a slice of
         # the time budget reserved for fine-tuning the exploration leader
@@ -507,10 +510,13 @@ class H2OAutoML:
     def _config_fp(self) -> str:
         import json as _json
         # budgets (max_models/max_runtime) are NOT identity: a resume
-        # may extend them (Recovery.java resumes with remaining budget)
+        # may extend them (Recovery.java resumes with remaining budget).
+        # The TRAINING DATA IS identity: models from a different frame
+        # or response must never ride into the new leaderboard
         return _json.dumps(
             {"plan": [str(e) for e in self.modeling_plan],
-             "nfolds": self.nfolds, "seed": self.seed}, sort_keys=True)
+             "nfolds": self.nfolds, "seed": self.seed,
+             "data": getattr(self, "_data_fp", None)}, sort_keys=True)
 
     def _load_recovery(self) -> Dict:
         if not self.recovery_dir:

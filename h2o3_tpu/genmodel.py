@@ -537,13 +537,31 @@ class EasyPredictModelWrapper:
     values may be numbers or category LABELS; unknown categoricals map
     to NA; missing columns are NA."""
 
-    def __init__(self, model):
+    def __init__(self, model, convert_unknown_categorical_levels_to_na:
+                 bool = True, convert_invalid_numbers_to_na: bool = False,
+                 enable_contributions: bool = False,
+                 enable_leaf_assignment: bool = False):
+        """Config mirrors EasyPredictModelWrapper.Config
+        (hex/genmodel/easy/EasyPredictModelWrapper.java): unknown-level
+        handling, invalid-number handling, and contributions/leaf
+        pass-through for tree models."""
         self.model = model
         self.columns = list(getattr(model, "feature_names", None)
                             or getattr(model, "columns", []))
         self.cat_domains = dict(getattr(model, "cat_domains", {}) or {})
         self.response_domain = list(
             getattr(model, "response_domain", None) or [])
+        self.convert_unknown_categorical_levels_to_na = bool(
+            convert_unknown_categorical_levels_to_na)
+        self.convert_invalid_numbers_to_na = bool(
+            convert_invalid_numbers_to_na)
+        self.unknown_categorical_levels_seen: Dict[str, int] = {}
+        self.enable_contributions = bool(enable_contributions)
+        self.enable_leaf_assignment = bool(enable_leaf_assignment)
+        if enable_contributions and not hasattr(model,
+                                                "predict_contributions"):
+            raise ValueError("enable_contributions: this model has no "
+                             "TreeSHAP support (GBM/DRF/XGBoost only)")
 
     def _row_to_array(self, row: Dict[str, Any]) -> np.ndarray:
         out = np.full(len(self.columns), np.nan)
@@ -557,11 +575,26 @@ class EasyPredictModelWrapper:
                     try:
                         out[i] = list(dom).index(v)
                     except ValueError:
-                        out[i] = np.nan       # unseen level → NA
+                        # unseen level: NA when configured (default), else
+                        # a PredictUnknownCategoricalLevelException analog
+                        if not self.convert_unknown_categorical_levels_to_na:
+                            raise ValueError(
+                                f"unknown categorical level {v!r} for "
+                                f"column '{c}' (set convert_unknown_"
+                                f"categorical_levels_to_na=True to map "
+                                f"to NA)")
+                        self.unknown_categorical_levels_seen[c] = \
+                            self.unknown_categorical_levels_seen.get(c, 0) + 1
+                        out[i] = np.nan
                 else:
                     out[i] = float(v)
             else:
-                out[i] = float(v)
+                try:
+                    out[i] = float(v)
+                except (TypeError, ValueError):
+                    if not self.convert_invalid_numbers_to_na:
+                        raise
+                    out[i] = np.nan
         return out
 
     def predict_row(self, row: Dict[str, Any]) -> Dict[str, Any]:
@@ -584,5 +617,296 @@ class EasyPredictModelWrapper:
             probs = {(self.response_domain[k] if self.response_domain
                       else str(k)): float(p)
                      for k, p in enumerate(preds[1:])}
-            return {"label": label, "classProbabilities": probs}
-        return {"value": float(preds[0])}
+            out_d = {"label": label, "classProbabilities": probs}
+        else:
+            out_d = {"value": float(preds[0])}
+        out_d.update(self._tree_extras(arr))
+        return out_d
+
+    def _tree_extras(self, arr: np.ndarray) -> Dict[str, Any]:
+        """contributions / leafNodeAssignments pass-through (the
+        Config.setEnableContributions / setEnableLeafAssignment
+        behaviors of the reference wrapper)."""
+        extras: Dict[str, Any] = {}
+        m = self.model
+        if self.enable_contributions:
+            from h2o3_tpu.models.treeshap import tree_shap_contributions
+            phi, bias = tree_shap_contributions(
+                arr[None, :], m._feat, m._thr, m._na_left, m._is_split,
+                m._node_w, m._value, m.max_depth, len(self.columns),
+                tree_scale=m._contrib_scale())
+            extras["contributions"] = {
+                **{c: float(phi[0, i]) for i, c in enumerate(self.columns)},
+                "BiasTerm": float(bias + m._contrib_f0())}
+        if self.enable_leaf_assignment and hasattr(m, "_feat"):
+            from h2o3_tpu.models.treeshap import leaf_node_assignment
+            paths = leaf_node_assignment(arr[None, :], m._feat, m._thr,
+                                         m._na_left, m._is_split,
+                                         m.max_depth, kind="Path")
+            extras["leafNodeAssignments"] = [str(p) for p in paths[0]]
+        return extras
+
+
+# ---------------- CoxPH -------------------------------------------------
+
+def export_mojo_coxph(model, path: str) -> str:
+    """CoxPH MOJO (hex/genmodel/algos/coxph/CoxPHMojoWriter wire role:
+    coefficients over the cats-first genmodel layout + design means; no
+    JVM in this image, so parity is the reader-contract round-trip —
+    recorded limitation). The GLM layout machinery is reused: CoxPH has
+    no intercept, so the trailing layout slot carries 0."""
+    cat_idx, num_idx = _split_design(model)
+    names = model.feature_names
+    if not hasattr(model, "intercept_value"):
+        model.intercept_value = 0.0          # partial likelihood: none
+    beta, cat_offsets, num_means = _beta_glm_layout(model)
+    cols = ([names[i] for i in cat_idx] + [names[i] for i in num_idx]
+            + ([model.response] if model.response else []))
+    kv = [f"cats = {len(cat_idx)}",
+          f"cat_offsets = {_jarr(cat_offsets)}",
+          f"nums = {len(num_idx)}",
+          f"num_means = {_jarr(num_means)}",
+          f"beta = {_jarr(beta.tolist())}",
+          "use_all_factor_levels = false"]
+    ini, doms = _ini_header(model, "coxph", "CoxPH", "CoxPH", cols,
+                            "1.00", kv)
+    return _write_zip(path, ini, doms)
+
+
+class CoxPHMojoScorer:
+    """Linear predictor over the cats-first layout (the genmodel
+    CoxPHMojoModel score0 contract: preds[0] = lp, centered on the
+    numeric design means)."""
+
+    def __init__(self, kv, columns, domains, response):
+        self.columns = [c for c in columns if c != response]
+        self.cats = int(kv["cats"])
+        self.nums = int(kv["nums"])
+        self.cat_offsets = _parse_jarr(kv["cat_offsets"], int)
+        self.num_means = _parse_jarr(kv.get("num_means", "[]"), float)
+        self.beta = np.asarray(_parse_jarr(kv["beta"]))
+        self.cat_domains = domains
+        self.nclasses = 1
+
+    def score(self, row: np.ndarray) -> np.ndarray:
+        data = np.asarray(row, dtype=np.float64).copy()
+        lp = 0.0
+        for i in range(self.cats):
+            if np.isnan(data[i]):
+                continue                       # NA level: no indicator
+            code = int(data[i])
+            if code != 0:                      # level 0 dropped
+                ival = self.cat_offsets[i] + code - 1
+                if ival < self.cat_offsets[i + 1]:
+                    lp += self.beta[ival]
+        noff = self.cat_offsets[self.cats] if self.cats else 0
+        for i in range(self.nums):
+            v = data[self.cats + i]
+            if np.isnan(v):
+                v = self.num_means[i]
+            lp += self.beta[noff + i] * (v - self.num_means[i])
+        return np.array([float(lp)])
+
+
+# ---------------- Word2Vec ---------------------------------------------
+
+def export_mojo_word2vec(model, path: str) -> str:
+    """Word2Vec MOJO (hex/genmodel/algos/word2vec/WordEmbeddingModel
+    role): vocab + [V, D] embedding block."""
+    vecs = np.asarray(model.vectors, np.float32)
+    kv = [f"vec_size = {vecs.shape[1]}",
+          f"vocab_size = {len(model.vocab)}"]
+    cols = ["word"]
+    ini, doms = _ini_header(model, "word2vec", "Word2Vec", "WordEmbedding",
+                            cols, "1.00", kv)
+    blobs = {"vectors.bin": vecs.tobytes(),
+             "vocab.txt": ("\n".join(model.vocab) + "\n").encode()}
+    return _write_zip(path, ini, doms, blobs)
+
+
+class Word2VecMojoScorer:
+    def __init__(self, kv, columns, domains, response, blobs=None):
+        self.vec_size = int(kv["vec_size"])
+        vocab = (blobs or {}).get("vocab.txt", b"").decode().splitlines()
+        raw = (blobs or {}).get("vectors.bin", b"")
+        self.vectors = np.frombuffer(raw, np.float32).reshape(
+            len(vocab), self.vec_size) if vocab else np.zeros((0, 0))
+        self.index = {w: i for i, w in enumerate(vocab)}
+        self.nclasses = 1
+        self.columns = list(columns)
+        self.cat_domains = domains
+
+    def transform(self, word: str) -> np.ndarray:
+        i = self.index.get(word)
+        return (self.vectors[i] if i is not None
+                else np.full(self.vec_size, np.nan))
+
+    def score(self, row: np.ndarray) -> np.ndarray:
+        raise ValueError("word2vec MOJOs embed words (use .transform), "
+                         "they do not score rows")
+
+
+# ---------------- GLRM --------------------------------------------------
+
+def export_mojo_glrm(model, path: str) -> str:
+    """GLRM MOJO (hex/genmodel/algos/glrm/GlrmMojoWriter role):
+    archetypes + scaling; scoring solves the row's X by proximal
+    iterations like GlrmMojoModel.impute_data."""
+    Y = np.asarray(model.archetypes_y, np.float64)
+    # expansion layout (exp_names order): per raw column, either its
+    # numeric slot or its dropped-first one-hot block
+    layout = []
+    pos = {n: i for i, n in enumerate(model.exp_names)}
+    for n in model.feature_names:
+        if n in model.cat_domains:
+            dom = list(model.cat_domains[n])
+            idxs = [pos.get(f"{n}.{lvl}", -1) for lvl in dom[1:]]
+            layout.append(("cat", idxs))
+        elif n in pos:
+            layout.append(("num", [pos[n]]))
+    import json as _json
+    kv = [f"k = {Y.shape[0]}",
+          f"ncolX = {Y.shape[1]}",
+          f"exp_names = {','.join(model.exp_names)}",
+          f"xm = {_jarr(model._xm)}",
+          f"xs = {_jarr(model._xs)}"]
+    cols = list(model.feature_names)
+    ini, doms = _ini_header(model, "glrm", "GLRM",
+                            "DimReduction", cols, "1.10", kv)
+    return _write_zip(path, ini, doms,
+                      {"archetypes.bin": Y.astype(np.float64).tobytes(),
+                       "layout.json": _json.dumps(layout).encode()})
+
+
+class GlrmMojoScorer:
+    def __init__(self, kv, columns, domains, response, blobs=None):
+        import json as _json
+        self.k = int(kv["k"])
+        ncol = int(kv["ncolX"])
+        self.Y = np.frombuffer((blobs or {})["archetypes.bin"],
+                               np.float64).reshape(self.k, ncol)
+        self.xm = np.asarray(_parse_jarr(kv["xm"]))
+        self.xs = np.asarray(_parse_jarr(kv["xs"]))
+        lay = (blobs or {}).get("layout.json")
+        self.layout = _json.loads(lay.decode()) if lay else             [("num", [i]) for i in range(ncol)]
+        self.columns = list(columns)
+        self.cat_domains = domains
+        self.nclasses = 1
+
+    def _expand(self, row: np.ndarray) -> np.ndarray:
+        """Raw column-ordered row → expand_design space (dropped-first
+        one-hot per categorical, numeric passthrough)."""
+        out = np.zeros(self.Y.shape[1])
+        for ci, (kind, idxs) in enumerate(self.layout):
+            v = row[ci] if ci < len(row) else np.nan
+            if kind == "num":
+                if idxs[0] >= 0:
+                    out[idxs[0]] = 0.0 if np.isnan(v) else v
+            else:
+                if not np.isnan(v):
+                    code = int(v)
+                    if 1 <= code <= len(idxs) and idxs[code - 1] >= 0:
+                        out[idxs[code - 1]] = 1.0
+        return out
+
+    def score(self, row: np.ndarray) -> np.ndarray:
+        """Returns the row's k archetype coefficients (X row) by ridge
+        least squares against Y (GlrmMojoModel x-solve role)."""
+        a = (self._expand(np.asarray(row, np.float64)) - self.xm) \
+            / np.maximum(self.xs, 1e-12)
+        a = np.nan_to_num(a)
+        G = self.Y @ self.Y.T + 1e-6 * np.eye(self.k)
+        return np.linalg.solve(G, self.Y @ a)
+
+
+# ---------------- IsolationForest --------------------------------------
+
+def export_mojo_isofor(model, path: str) -> str:
+    """IsolationForest MOJO: the v1.40 compressed-tree format the tree
+    writer already emits (hex/genmodel/algos/isofor/IsolationForest
+    MojoModel reads trees + min/max path length)."""
+    import jax
+    from h2o3_tpu.mojo import _compress_tree
+    feat = np.asarray(jax.device_get(model._feat))
+    thr = np.asarray(jax.device_get(model._thr))
+    spl = np.asarray(jax.device_get(model._is_split))
+    T = feat.shape[0]
+    nal = np.zeros_like(spl)
+    M = feat.shape[1]
+    # leaf value = node depth (complete-array index → depth): scoring
+    # averages the reached leaves' depths into the path length
+    dv = np.floor(np.log2(np.arange(M) + 1)).astype(np.float32)
+    blobs = {}
+    for t in range(T):
+        data, aux = _compress_tree(feat[t], thr[t], nal[t], spl[t], dv)
+        blobs[f"trees/t00_{t:03d}.bin"] = data
+        blobs[f"trees/t00_{t:03d}_aux.bin"] = aux
+    kv = [f"n_trees = {T}",
+          "n_trees_per_class = 1",
+          f"min_path_length = {int(getattr(model, 'min_path_length', 0))}",
+          f"max_path_length = {int(getattr(model, 'max_path_length', 0))}"]
+    cols = list(model.feature_names)
+    ini, doms = _ini_header(model, "isofor", "Isolation Forest",
+                            "AnomalyDetection", cols, "1.40", kv)
+    return _write_zip(path, ini, doms, blobs)
+
+
+# ---------------- GAM ---------------------------------------------------
+
+def export_mojo_gam(model, path: str) -> str:
+    """GAM MOJO (hex/genmodel/algos/gam/GamMojoWriter role): the inner
+    GLM's coefficients + the spline config (knots per gam column) so a
+    reader can re-expand and score."""
+    import json as _json
+    inner = model.inner
+    beta, cat_off, means_list = _beta_glm_layout(inner)
+    kv = [f"cat_offsets = {_jarr(cat_off)}",
+          f"num_means = {_jarr(means_list)}",
+          f"family = {inner.family}",
+          f"link = family_default",
+          f"gam_columns = {','.join(model.gam_columns)}",
+          f"bs = {_jarr([int(model.bs_map.get(c) or 0) for c in model.gam_columns])}",
+          f"beta = {_jarr(beta)}",
+          f"intercept = {inner.intercept_value}",
+          f"exp_names = {','.join(inner.exp_names)}"]
+    cols = list(model.feature_names) + ([model.response]
+                                        if model.response else [])
+    ini, doms = _ini_header(model, "gam", "GAM",
+                            ("Binomial" if model.nclasses == 2
+                             else "Regression"), cols, "1.00", kv)
+    knots_blob = _json.dumps({k: list(map(float, v))
+                              for k, v in model.knots.items()}).encode()
+    return _write_zip(path, ini, doms, {"knots.json": knots_blob})
+
+
+# ---------------- StackedEnsemble --------------------------------------
+
+def export_mojo_ensemble(model, path: str) -> str:
+    """StackedEnsemble MOJO (hex/genmodel/algos/ensemble/
+    StackedEnsembleMojoWriter role): base model MOJOs nested under
+    models/ + the metalearner MOJO + the base-model order."""
+    import os as _os
+    import tempfile as _tmp
+    from h2o3_tpu.mojo import export_mojo
+    blobs = {}
+    names = []
+    with _tmp.TemporaryDirectory() as td:
+        for i, bm in enumerate(model.base_models):
+            p = _os.path.join(td, f"base_{i}.zip")
+            export_mojo(bm, p)
+            with open(p, "rb") as f:
+                blobs[f"models/base_{i}.zip"] = f.read()
+            names.append(f"base_{i}")
+        mp = _os.path.join(td, "meta.zip")
+        export_mojo(model.meta_model, mp)
+        with open(mp, "rb") as f:
+            blobs["models/metalearner.zip"] = f.read()
+    kv = [f"base_models = {','.join(names)}",
+          f"n_base_models = {len(names)}"]
+    cols = list(model.feature_names) + ([model.response]
+                                        if model.response else [])
+    ini, doms = _ini_header(model, "ensemble", "StackedEnsemble",
+                            ("Binomial" if model.nclasses == 2 else
+                             "Multinomial" if model.nclasses > 2
+                             else "Regression"), cols, "1.00", kv)
+    return _write_zip(path, ini, doms, blobs)

@@ -111,20 +111,47 @@ def _basis_is(x, knots):
                            antideriv=True)
 
 
+def _basis_tp(x, knots):
+    """Thin-plate regression spline basis — bs=1 (hex/gam
+    MatrixFrameUtils/ThinPlate* machinery: polyharmonic kernel +
+    polynomial null space). For the 1-D smooths this estimator supports
+    the TPS kernel with m=2 is eta(r) = r^3 (up to a constant), so the
+    basis is [ |x-k_1|^3 … |x-k_K|^3, x ]: K radial columns plus the
+    linear null-space term (the constant rides the GLM intercept).
+
+    Deviations from the reference, documented: columns are scaled to
+    unit sd (standardize_tp_gam_cols default semantics) instead of the
+    reference's penalty-matrix Cholesky absorption, and the smoothing
+    penalty is the GLM's ridge on the block (scale knob) like the other
+    bases here — the reference builds an explicit TPS penalty matrix
+    (scale_tp_penalty_mat)."""
+    xi = _impute(x, knots)
+    # scales derive from the KNOTS ONLY so train- and score-time bases
+    # agree exactly (a per-frame sd would shift the design between
+    # frames); |knots - k_j|^3 spans the kernel's dynamic range
+    kk = np.asarray(knots, np.float64)
+    cols = {}
+    for j, k in enumerate(kk):
+        s = max(float(np.mean(np.abs(kk - k) ** 3)), 1e-12)
+        cols[f"r{j}"] = np.abs(xi - k) ** 3 / s
+    cols["l"] = xi / max(float(kk.std()), 1e-12)
+    return cols
+
+
 _BASES = {None: _basis_trunc_power, -1: _basis_trunc_power,
-          0: _basis_cr, 2: _basis_is, 3: _basis_ms}
+          0: _basis_cr, 1: _basis_tp, 2: _basis_is, 3: _basis_ms}
 
 
 def _spline_basis(x: np.ndarray, knots: np.ndarray,
                   bs: Optional[int] = None) -> Dict[str, np.ndarray]:
     """Basis dispatch by the reference's ``bs`` codes (hex/gam
-    GAMModelParameters: 0=cubic regression, 2=I-spline monotone,
-    3=M-spline; thin-plate (1) is not implemented). NAs impute to the
+    GAMModelParameters: 0=cubic regression, 1=thin-plate,
+    2=I-spline monotone, 3=M-spline). NAs impute to the
     knot median (DataInfo-imputed gam columns)."""
     fn = _BASES.get(bs)
     if fn is None:
         raise ValueError(f"unsupported spline type bs={bs} "
-                         f"(supported: 0=cr, 2=is, 3=ms)")
+                         f"(supported: 0=cr, 1=tp, 2=is, 3=ms)")
     return fn(x, knots)
 
 

@@ -41,7 +41,7 @@ GBM_DEFAULTS: Dict = dict(
     distribution="auto", tweedie_power=1.5, quantile_alpha=0.5,
     huber_alpha=0.9, min_split_improvement=1e-5,
     seed=-1, stopping_rounds=0, stopping_metric="auto",
-    stopping_tolerance=1e-3, score_tree_interval=5, reg_lambda=0.0,
+    stopping_tolerance=1e-3, score_tree_interval=0, reg_lambda=0.0,
     # uniform_adaptive = the reference's default (hex/tree/DHistogram.java
     # UniformAdaptive): per-node re-binned uniform histograms via the fused
     # adaptive kernel; quantiles_global = global-sketch binned codes
@@ -285,11 +285,6 @@ class H2OGradientBoostingEstimator(ModelBuilder):
     def __init__(self, **params):
         merged = dict(GBM_DEFAULTS)
         merged.update(params)
-        # scoring cadence: only a NON-DEFAULT score_tree_interval records
-        # per-interval history without early stopping. Compared by VALUE,
-        # not by presence: params round-trip through grid/load copies
-        # that always carry the merged default, and a private flag would
-        # leak into model.params/REST.
         super().__init__(**merged)
 
     # -- driver ---------------------------------------------------------
@@ -441,10 +436,10 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         # score_tree_interval both record ScoreKeeper history (the
         # reference scores every interval regardless of stopping —
         # learning_curve_plot reads this)
+        # reference default score_tree_interval=0 (score only at the
+        # stopping cadence); ANY positive value is an explicit request
         sti = int(p.get("score_tree_interval", 0) or 0)
-        score_each = (keeper.rounds > 0
-                      or (sti > 0
-                          and sti != GBM_DEFAULTS["score_tree_interval"]))
+        score_each = keeper.rounds > 0 or sti > 0
         chunk = interval if score_each else min(ntrees_new, 50)
         has_t = (not adaptive) and bm.codes.t is not None
         codes_t_arg = bm.codes.t if has_t else Xtr  # ignored dummy otherwise

@@ -36,12 +36,36 @@ GLRM_DEFAULTS: Dict = dict(
 
 
 def _prox(M, reg: str, step_gamma):
-    if reg == "quadratic":
+    """Elementwise/rowwise proximal maps for the reference's regularizer
+    zoo (hex/glrm/GlrmRegularizer.java: None, Quadratic, L2, L1,
+    NonNegative, OneSparse, UnitOneSparse, Simplex)."""
+    if reg in ("quadratic", "l2"):
         return M / (1.0 + 2.0 * step_gamma)
-    if reg in ("l1", "one_sparse"):
+    if reg == "l1":
         return jnp.sign(M) * jnp.maximum(jnp.abs(M) - step_gamma, 0.0)
     if reg in ("non_negative", "nonnegative"):
         return jnp.maximum(M, 0.0)
+    if reg == "one_sparse":
+        # projection onto 1-sparse vectors per row: keep the largest-
+        # magnitude entry (GlrmRegularizer.OneSparse.project)
+        amax = jnp.max(jnp.abs(M), axis=-1, keepdims=True)
+        return jnp.where(jnp.abs(M) >= amax, M, 0.0)
+    if reg == "unit_one_sparse":
+        # 1-sparse with the surviving entry snapped to 1 (archetype
+        # membership indicator — UnitOneSparse)
+        amax = jnp.max(jnp.abs(M), axis=-1, keepdims=True)
+        return jnp.where(jnp.abs(M) >= amax, 1.0, 0.0)
+    if reg == "simplex":
+        # Euclidean projection onto the probability simplex per row
+        # (GlrmRegularizer.Simplex; Duchi et al. algorithm, vectorized)
+        k = M.shape[-1]
+        u = jnp.sort(M, axis=-1)[..., ::-1]
+        css = jnp.cumsum(u, axis=-1) - 1.0
+        idx = jnp.arange(1, k + 1)
+        cond = u - css / idx > 0
+        rho = jnp.sum(cond, axis=-1, keepdims=True)
+        theta = jnp.take_along_axis(css, rho - 1, axis=-1) / rho
+        return jnp.maximum(M - theta, 0.0)
     return M
 
 

@@ -145,9 +145,28 @@ def export_mojo(model, path: str) -> str:
     if algo == "deeplearning":
         from h2o3_tpu.genmodel import export_mojo_deeplearning
         return export_mojo_deeplearning(model, path)
+    if algo == "coxph":
+        from h2o3_tpu.genmodel import export_mojo_coxph
+        return export_mojo_coxph(model, path)
+    if algo == "word2vec":
+        from h2o3_tpu.genmodel import export_mojo_word2vec
+        return export_mojo_word2vec(model, path)
+    if algo == "glrm":
+        from h2o3_tpu.genmodel import export_mojo_glrm
+        return export_mojo_glrm(model, path)
+    if algo in ("isolationforest", "isolation_forest"):
+        from h2o3_tpu.genmodel import export_mojo_isofor
+        return export_mojo_isofor(model, path)
+    if algo == "gam":
+        from h2o3_tpu.genmodel import export_mojo_gam
+        return export_mojo_gam(model, path)
+    if algo == "stackedensemble":
+        from h2o3_tpu.genmodel import export_mojo_ensemble
+        return export_mojo_ensemble(model, path)
     if algo not in ("gbm", "drf"):
         raise ValueError(f"MOJO export supports gbm/drf/glm/kmeans/"
-                         f"deeplearning (got '{algo}')")
+                         f"deeplearning/coxph/word2vec/glrm/isofor/gam/"
+                         f"stackedensemble (got '{algo}')")
     feat = np.asarray(jax.device_get(model._feat))
     thr = np.asarray(jax.device_get(model._thr))
     nal = np.asarray(jax.device_get(model._na_left))
@@ -370,6 +389,11 @@ class MojoModel:
                 s = sums.sum()
                 return sums / s if s > 0 else sums
             return np.array([sums[0] / max(self.n_trees, 1)])
+        if self.algo == "isofor":
+            # leaf values carry node depth: preds[0] = mean path length
+            # over trees (hex/genmodel/algos/isofor scoring contract;
+            # callers normalize with min/max_path_length from the ini)
+            return np.array([sums[0] / max(self.n_trees, 1)])
         raise ValueError(f"unsupported mojo algo '{self.algo}'")
 
 
@@ -413,13 +437,24 @@ def read_mojo(path: str) -> MojoModel:
                 if nm in names:
                     trees[(k, t)] = zf.read(nm)
     algo = info.get("algo", "")
-    if algo in ("glm", "kmeans", "deeplearning"):
-        from h2o3_tpu.genmodel import (DeepLearningMojoScorer,
+    if algo in ("glm", "kmeans", "deeplearning", "coxph"):
+        from h2o3_tpu.genmodel import (CoxPHMojoScorer,
+                                       DeepLearningMojoScorer,
                                        GlmMojoScorer, KMeansMojoScorer)
         resp = columns[-1] if info.get("supervised") == "true" else None
         scorer_cls = {"glm": GlmMojoScorer, "kmeans": KMeansMojoScorer,
-                      "deeplearning": DeepLearningMojoScorer}[algo]
+                      "deeplearning": DeepLearningMojoScorer,
+                      "coxph": CoxPHMojoScorer}[algo]
         s = scorer_cls(info, columns, domains, resp)
+        s.info = info
+        return s
+    if algo in ("word2vec", "glrm"):
+        from h2o3_tpu.genmodel import GlrmMojoScorer, Word2VecMojoScorer
+        with zipfile.ZipFile(path) as zf2:
+            blobs = {n: zf2.read(n) for n in zf2.namelist()
+                     if n.endswith((".bin", ".txt"))}
+        cls2 = Word2VecMojoScorer if algo == "word2vec" else GlrmMojoScorer
+        s = cls2(info, columns, domains, None, blobs=blobs)
         s.info = info
         return s
     return MojoModel(info, columns, domains, trees)

@@ -231,3 +231,50 @@ def test_modelselection_maxrsweep_matches_exhaustive():
     # the final refit model predicts
     pred = est.model.predict(fr)
     assert pred.nrow == n
+
+
+def test_gam_thin_plate_bs1():
+    """bs=1 thin-plate smooths (hex/gam ThinPlate*): fits a nonlinear
+    signal better than a line, and scores consistently across frames."""
+    rng = np.random.default_rng(8)
+    n = 1200
+    x = rng.uniform(-3, 3, n)
+    z = rng.normal(size=n)
+    y = np.sin(1.5 * x) + 0.2 * z + 0.05 * rng.normal(size=n)
+    fr = h2o.Frame.from_numpy({"x": x, "z": z, "y": y})
+    from h2o3_tpu.models.gam import H2OGeneralizedAdditiveEstimator
+    gam = H2OGeneralizedAdditiveEstimator(gam_columns=["x"], bs=[1],
+                                          num_knots=8, family="gaussian",
+                                          Lambda=[1e-4])
+    gam.train(y="y", x=["x", "z"], training_frame=fr)
+    m = gam.model
+    mse = m.training_metrics.mse
+    assert mse < 0.05, mse           # line alone would leave ~0.45
+    # score-time expansion must match train-time (knot-derived scales)
+    pred = np.asarray(m.predict(fr).vec("predict").to_numpy())
+    assert np.corrcoef(pred, y)[0, 1] > 0.97
+
+
+def test_glrm_regularizer_zoo():
+    from h2o3_tpu.models.glrm import _prox
+    import jax.numpy as jnp
+    M = jnp.asarray([[0.4, -1.2, 0.3], [2.0, 0.1, -0.2]])
+    os_ = np.asarray(_prox(M, "one_sparse", 0.1))
+    assert (np.count_nonzero(os_, axis=1) == 1).all()
+    uo = np.asarray(_prox(M, "unit_one_sparse", 0.1))
+    assert set(np.unique(uo)) <= {0.0, 1.0}
+    assert (uo.sum(axis=1) == 1).all()
+    sx = np.asarray(_prox(M, "simplex", 0.1))
+    assert np.allclose(sx.sum(axis=1), 1.0, atol=1e-5)
+    assert (sx >= -1e-7).all()
+    # end-to-end: simplex X regularizer yields soft-clustering weights
+    rng = np.random.default_rng(3)
+    A = np.concatenate([rng.normal(0, 0.1, (60, 4)) + [2, 0, 0, 0],
+                        rng.normal(0, 0.1, (60, 4)) + [0, 2, 0, 0]])
+    fr = h2o.Frame.from_numpy({f"c{i}": A[:, i] for i in range(4)})
+    from h2o3_tpu.models.glrm import H2OGeneralizedLowRankEstimator
+    gl = H2OGeneralizedLowRankEstimator(k=2, regularization_x="simplex",
+                                        gamma_x=0.1, max_iterations=60,
+                                        seed=1)
+    gl.train(training_frame=fr)
+    assert gl.model is not None
