@@ -1,0 +1,164 @@
+# R client for the TPU-native H2O-3 rebuild.
+#
+# Reference surface: /root/reference/h2o-r/h2o-package/R (connection.R,
+# frame.R, models.R) — the subset implemented here covers the workflow
+# verbs: init/connect, importFile, frame accessors, the major trainers,
+# predict, performance. The wire contract is identical to what the
+# unmodified h2o-py client exercises in tests/test_h2opy_client.py.
+#
+# NOT RUN UNDER R IN THIS BUILD IMAGE (no R interpreter available);
+# written against the REST contract verified via the Python client and
+# curl (tests/test_h2opy_client*.py, tests/test_rest*.py).
+
+.h2o.env <- new.env(parent = emptyenv())
+
+.h2o.url <- function(path) {
+  paste0(get("base", envir = .h2o.env), path)
+}
+
+.h2o.get <- function(path, params = list()) {
+  u <- .h2o.url(path)
+  if (length(params)) {
+    q <- paste(mapply(function(k, v) {
+      paste0(curl::curl_escape(k), "=", curl::curl_escape(as.character(v)))
+    }, names(params), params), collapse = "&")
+    u <- paste0(u, "?", q)
+  }
+  jsonlite::fromJSON(rawToChar(curl::curl_fetch_memory(u)$content),
+                     simplifyVector = FALSE)
+}
+
+.h2o.post <- function(path, params = list()) {
+  h <- curl::new_handle()
+  fields <- paste(mapply(function(k, v) {
+    paste0(curl::curl_escape(k), "=", curl::curl_escape(as.character(v)))
+  }, names(params), params), collapse = "&")
+  curl::handle_setopt(h, postfields = fields)
+  curl::handle_setheaders(h,
+    "Content-Type" = "application/x-www-form-urlencoded")
+  r <- curl::curl_fetch_memory(.h2o.url(path), handle = h)
+  jsonlite::fromJSON(rawToChar(r$content), simplifyVector = FALSE)
+}
+
+#' Connect to a running cluster (the reference's h2o.init connects or
+#' launches a jar; this client connects only).
+h2o.init <- function(ip = "127.0.0.1", port = 54321, url = NULL) {
+  assign("base",
+         if (is.null(url)) sprintf("http://%s:%d", ip, port) else url,
+         envir = .h2o.env)
+  cl <- .h2o.get("/3/Cloud")
+  message(sprintf("Connected to %s (version %s)",
+                  get("base", envir = .h2o.env), cl$version))
+  invisible(cl)
+}
+
+h2o.clusterStatus <- function() .h2o.get("/3/Cloud")
+
+.h2o.poll <- function(job_key, interval = 0.3) {
+  repeat {
+    j <- .h2o.get(paste0("/3/Jobs/",
+                         utils::URLencode(job_key, reserved = TRUE)))
+    st <- j$jobs[[1]]$status
+    if (st != "RUNNING") {
+      if (st == "FAILED")
+        stop("job failed: ", j$jobs[[1]]$exception)
+      return(j$jobs[[1]])
+    }
+    Sys.sleep(interval)
+  }
+}
+
+#' Import + parse a file into a Frame; returns an H2OFrame handle.
+h2o.importFile <- function(path, destination_frame = NULL) {
+  imp <- .h2o.post("/3/ImportFiles", list(path = path))
+  src <- as.character(jsonlite::toJSON(unlist(imp$destination_frames)))
+  setup <- .h2o.post("/3/ParseSetup", list(source_frames = src))
+  dest <- if (is.null(destination_frame)) setup$destination_frame
+          else destination_frame
+  parse <- .h2o.post("/3/Parse", list(
+    source_frames = src, destination_frame = dest,
+    separator = setup$separator, check_header = setup$check_header))
+  .h2o.poll(parse$job$key$name)
+  structure(list(key = dest), class = "H2OFrame")
+}
+
+h2o.getFrame <- function(key) {
+  structure(list(key = key), class = "H2OFrame")
+}
+
+h2o.ls <- function() .h2o.get("/3/Frames")
+
+h2o.describe <- function(frame) {
+  .h2o.get(paste0("/3/Frames/",
+                  utils::URLencode(frame$key, reserved = TRUE)))
+}
+
+h2o.nrow <- function(frame) h2o.describe(frame)$frames[[1]]$rows
+
+.h2o.train <- function(algo, y, training_frame, params = list()) {
+  body <- c(list(training_frame = training_frame$key), params)
+  if (!is.null(y)) body$response_column <- y
+  r <- .h2o.post(paste0("/3/ModelBuilders/", algo), body)
+  job <- .h2o.poll(r$job$key$name)
+  structure(list(key = job$dest$name, algo = algo), class = "H2OModel")
+}
+
+h2o.gbm <- function(y, training_frame, ...)
+  .h2o.train("gbm", y, training_frame, list(...))
+h2o.randomForest <- function(y, training_frame, ...)
+  .h2o.train("drf", y, training_frame, list(...))
+h2o.glm <- function(y, training_frame, ...)
+  .h2o.train("glm", y, training_frame, list(...))
+h2o.deeplearning <- function(y, training_frame, ...)
+  .h2o.train("deeplearning", y, training_frame, list(...))
+h2o.kmeans <- function(training_frame, ...)
+  .h2o.train("kmeans", NULL, training_frame, list(...))
+h2o.xgboost <- function(y, training_frame, ...)
+  .h2o.train("xgboost", y, training_frame, list(...))
+
+h2o.getModel <- function(key) {
+  .h2o.get(paste0("/3/Models/",
+                  utils::URLencode(key, reserved = TRUE)))
+}
+
+h2o.performance <- function(model, newdata = NULL) {
+  if (is.null(newdata)) {
+    m <- h2o.getModel(model$key)
+    return(m$models[[1]]$output$training_metrics)
+  }
+  r <- .h2o.post(sprintf("/3/ModelMetrics/models/%s/frames/%s",
+                         model$key, newdata$key), list())
+  r$model_metrics[[1]]
+}
+
+h2o.predict <- function(model, newdata) {
+  r <- .h2o.post(sprintf("/3/Predictions/models/%s/frames/%s",
+                         model$key, newdata$key), list())
+  structure(list(key = r$predictions_frame$name), class = "H2OFrame")
+}
+
+h2o.auc <- function(perf) perf$AUC
+
+h2o.automl <- function(y, training_frame, max_models = 10,
+                       project_name = NULL) {
+  # /99/AutoMLBuilder takes the NESTED spec the reference clients post:
+  # {build_control, input_spec, build_models} (h2o-py _estimator.py:668;
+  # server.py _automl_build reads exactly these keys)
+  spec <- list(
+    build_control = list(
+      project_name = project_name,
+      stopping_criteria = list(max_models = max_models)),
+    input_spec = list(
+      training_frame = training_frame$key,
+      response_column = y),
+    build_models = list())
+  r <- .h2o.post("/99/AutoMLBuilder", list(
+    build_control = as.character(jsonlite::toJSON(
+      spec$build_control, auto_unbox = TRUE, null = "null")),
+    input_spec = as.character(jsonlite::toJSON(
+      spec$input_spec, auto_unbox = TRUE)),
+    build_models = "{}"))
+  .h2o.poll(r$job$key$name)
+  structure(list(project = r$build_control$project_name),
+            class = "H2OAutoML")
+}

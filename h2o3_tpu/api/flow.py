@@ -1,0 +1,171 @@
+"""Flow — the built-in web UI (h2o-web's Flow analog, minimal).
+
+Reference: /root/reference/h2o-web (Flow: a CoffeeScript notebook UI
+served by the jar at /flow/index.html). This framework has no node
+toolchain in-image, so Flow is re-implemented as ONE self-contained
+HTML+JS page speaking the same REST API the clients use: cluster
+status + memory report, frame import/parse/preview, model training
+across the registered algos, jobs, model metrics, and predictions.
+Served at / and /flow/index.html by the embedded server."""
+
+FLOW_HTML = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>H2O-3 TPU Flow</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:0;background:#f4f6f8;color:#1c2733}
+ header{background:#123047;color:#fff;padding:10px 18px;display:flex;gap:18px;align-items:baseline}
+ header h1{font-size:18px;margin:0}
+ header span{font-size:12px;opacity:.8}
+ main{display:grid;grid-template-columns:1fr 1fr;gap:14px;padding:14px}
+ section{background:#fff;border:1px solid #dde3e9;border-radius:8px;padding:12px}
+ h2{font-size:14px;margin:0 0 8px;border-bottom:1px solid #eef1f4;padding-bottom:6px}
+ table{border-collapse:collapse;font-size:12px;width:100%}
+ td,th{border:1px solid #e4e8ec;padding:3px 7px;text-align:left}
+ th{background:#f0f3f6}
+ button{background:#1c6ea4;color:#fff;border:0;border-radius:5px;padding:5px 11px;cursor:pointer;font-size:12px}
+ input,select{border:1px solid #c6ccd2;border-radius:5px;padding:4px 7px;font-size:12px}
+ pre{background:#0e1726;color:#d7e3f4;padding:8px;border-radius:6px;font-size:11px;overflow:auto;max-height:260px}
+ .row{display:flex;gap:8px;margin:6px 0;flex-wrap:wrap;align-items:center}
+ .full{grid-column:1/3}
+</style></head><body>
+<header><h1>H2O-3 TPU — Flow</h1><span id="cloud">connecting…</span></header>
+<main>
+<section><h2>Import &amp; Parse</h2>
+ <div class="row"><input id="path" size="40" placeholder="/path/to/file.csv">
+ <button onclick="importParse()">Import + Parse</button></div>
+ <div id="parseout"></div></section>
+<section><h2>Frames</h2><div class="row">
+ <button onclick="listFrames()">Refresh</button></div>
+ <div id="frames"></div></section>
+<section><h2>Train a Model</h2>
+ <div class="row">
+  <select id="algo"></select>
+  <select id="frame"></select>
+  <input id="yresp" size="10" placeholder="response">
+  <input id="mparams" size="24" placeholder='{"ntrees":20}'>
+  <button onclick="train()">Train</button></div>
+ <div id="trainout"></div></section>
+<section><h2>Models</h2><div class="row">
+ <button onclick="listModels()">Refresh</button></div>
+ <div id="models"></div></section>
+<section class="full"><h2>Inspector</h2><pre id="out">—</pre></section>
+</main>
+<script>
+const esc = s => String(s).replace(/[&<>"']/g,
+  c => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+const J = async (m, u, body) => {
+  const opt = {method: m};
+  if (body) { opt.body = new URLSearchParams(body); }
+  const r = await fetch(u, opt);
+  const t = await r.text();
+  try { return JSON.parse(t); } catch (e) { return {raw: t}; }
+};
+const show = o => document.getElementById('out').textContent =
+  JSON.stringify(o, null, 1).slice(0, 20000);
+async function cloud() {
+  const c = await J('GET', '/3/Cloud');
+  const n = (c.nodes || [{}])[0];
+  document.getElementById('cloud').textContent =
+    `${c.version} · ${(n.tpu_devices||[]).join(', ')} · spills ${n.spill_count ?? 0}`;
+}
+async function listFrames() {
+  const f = await J('GET', '/3/Frames');
+  const rows = (f.frames || []).map(fr => {
+    const k = encodeURIComponent(fr.frame_id.name);
+    return `<tr><td><a href="#" data-k="${esc(fr.frame_id.name)}"
+     onclick="inspect(decodeURIComponent('${k}'));return false">${esc(fr.frame_id.name)}</a></td>
+     <td>${fr.rows}</td><td>${fr.column_count ?? fr.total_column_count ?? ''}</td></tr>`;
+  }).join('');
+  document.getElementById('frames').innerHTML =
+    `<table><tr><th>frame</th><th>rows</th><th>cols</th></tr>${rows}</table>`;
+  const sel = document.getElementById('frame');
+  sel.innerHTML = (f.frames || []).map(fr =>
+    `<option>${esc(fr.frame_id.name)}</option>`).join('');
+}
+async function inspect(k) {
+  show(await J('GET', '/3/Frames/' + encodeURIComponent(k)));
+}
+async function importParse() {
+  const p = document.getElementById('path').value;
+  const imp = await J('POST', '/3/ImportFiles', {path: p});
+  if (!imp.destination_frames) { show(imp); return; }
+  const setup = await J('POST', '/3/ParseSetup',
+                        {source_frames: JSON.stringify(imp.destination_frames)});
+  const parse = await J('POST', '/3/Parse', {
+    source_frames: JSON.stringify(imp.destination_frames),
+    destination_frame: setup.destination_frame,
+    separator: setup.separator, check_header: setup.check_header});
+  try {
+    await poll(parse.job ? parse.job.key.name : (parse.key || {}).name);
+    document.getElementById('parseout').textContent = 'parsed ✓';
+  } catch (e) {
+    document.getElementById('parseout').textContent = 'parse FAILED: ' + e.message;
+    show(parse);
+    return;
+  }
+  listFrames();
+}
+async function poll(jid) {
+  if (!jid) throw new Error('no job key in response');
+  for (let i = 0; i < 6000; i++) {
+    const j = await J('GET', '/3/Jobs/' + encodeURIComponent(jid));
+    const jj = j.jobs ? j.jobs[0] : j;
+    const st = jj && jj.status;
+    if (st === 'FAILED' || st === 'CANCELLED')
+      throw new Error((jj.exception || st).toString().slice(0, 400));
+    if (st && st !== 'RUNNING') return j;
+    await new Promise(r => setTimeout(r, 300));
+  }
+  throw new Error('job still running after poll limit: ' + jid);
+}
+async function algos() {
+  const b = await J('GET', '/3/ModelBuilders');
+  const sel = document.getElementById('algo');
+  sel.innerHTML = Object.keys(b.model_builders || {}).map(a =>
+    `<option>${a}</option>`).join('');
+  sel.value = 'gbm';
+}
+async function train() {
+  const algo = document.getElementById('algo').value;
+  const fr = document.getElementById('frame').value;
+  const y = document.getElementById('yresp').value;
+  let extra = {};
+  try { extra = JSON.parse(document.getElementById('mparams').value || '{}'); }
+  catch (e) {
+    document.getElementById('trainout').textContent =
+      'bad params JSON: ' + e.message;
+    return;
+  }
+  const body = {training_frame: fr, response_column: y, ...extra};
+  const r = await J('POST', '/3/ModelBuilders/' + algo, body);
+  const jid = r.job ? r.job.key.name : (r.key || {}).name;
+  document.getElementById('trainout').textContent = 'training…';
+  try {
+    const j = await poll(jid);
+    document.getElementById('trainout').textContent =
+      'done: ' + esc((((j.jobs ? j.jobs[0] : j).dest) || {}).name);
+  } catch (e) {
+    document.getElementById('trainout').textContent =
+      'train FAILED: ' + e.message;
+    show(r);
+    return;
+  }
+  listModels();
+}
+async function listModels() {
+  const m = await J('GET', '/3/Models');
+  const rows = (m.models || []).map(md => {
+    const k = encodeURIComponent(md.model_id.name);
+    return `<tr><td><a href="#"
+     onclick="inspectModel(decodeURIComponent('${k}'));return false">${esc(md.model_id.name)}</a></td>
+     <td>${esc(md.algo)}</td></tr>`;
+  }).join('');
+  document.getElementById('models').innerHTML =
+    `<table><tr><th>model</th><th>algo</th></tr>${rows}</table>`;
+}
+async function inspectModel(k) {
+  show(await J('GET', '/3/Models/' + encodeURIComponent(k)));
+}
+cloud(); listFrames(); listModels(); algos();
+setInterval(cloud, 5000);
+</script></body></html>
+"""

@@ -128,6 +128,16 @@ def _coerce(v: str) -> Any:
 
 # ---------------- handlers --------------------------------------------
 
+@route("GET", "/")
+@route("GET", "/flow/index.html")
+def _flow_ui(params, body):
+    """The built-in web UI (h2o-web Flow analog — api/flow.py): one
+    self-contained page over the same REST surface the clients use."""
+    from h2o3_tpu.api.flow import FLOW_HTML
+    return {"__raw": FLOW_HTML.encode(),
+            "__content_type": "text/html; charset=utf-8"}
+
+
 @route("GET", "/3/Cloud")
 @route("HEAD", "/3/Cloud")
 def _cloud(params, body):

@@ -67,3 +67,18 @@ def test_tabulate_route():
                              "nbins_response": "10"}, None)
     assert r["count_table"]["rowcount"] >= 1
     assert r["response_table"]["rowcount"] >= 1
+
+
+def test_flow_ui_served():
+    """The built-in Flow page (api/flow.py) is served at / and
+    /flow/index.html with the REST endpoints its JS drives present."""
+    from h2o3_tpu.api import server as srv2
+    out = srv2._flow_ui({}, None)
+    html = out["__raw"].decode()
+    assert "text/html" in out["__content_type"]
+    assert "H2O-3 TPU" in html and "/3/ModelBuilders/" in html
+    # the page's fetch targets exist in the route table
+    joined = " ".join(rx.pattern for _m, rx, _f in srv2._ROUTES)
+    for ep in ("/3/Cloud", "/3/Frames", "/3/ImportFiles", "/3/ParseSetup",
+               "/3/Parse", "/3/Models", "/3/Jobs"):
+        assert ep in joined.replace("\\/", "/"), ep
