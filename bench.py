@@ -26,13 +26,19 @@ Kernel ceiling (documented for the perf record): the per-level pallas
 kernel is MXU-STREAMING-bound — a [3N<=128, K]x[K, F·W] contraction
 costs ceil(F·W/512)·K MXU cycles independent of the M=3N dim
 (tools/kern_mxu_probe.py: [6,8192]x[8192,896] takes 73% of the
-[126,...] time), so every level costs ~2 cycles/row and depth-6
-training has a ~72M rows/s/chip structural ceiling at W=32; the
-measured 68.6M is ~95% of it. The tested escapes — int8 fixed-point
-contraction (1.33x bare-matmul win, eaten by Mosaic's lack of i8
-select/mul forcing i32 operand builds; H2O3_HIST_I8 opt-in keeps it),
-lane-gather range lookups (Mosaic declines), tile resizing (flat) —
-are recorded in tools/ and ops/hist_adaptive.py.
+[126,...] time). At W=32 (F·W=896, 2 stripes) that put a ~72M
+rows/s/chip structural ceiling on depth-6 training and the round-4
+number (68.6M at nbins=30) sat at ~95% of it. The recorded config now
+uses W=16 (F·W=448, ONE 512-lane stripe — half the MXU passes) with
+the reference's own histogram_type=Random per-tree grid phase
+recovering the bin resolution (AUC 0.8360 vs 0.8358 before; table
+above). Measured: ~79M rows/s/chip — past the doubled MXU bound's
+knee, now co-limited by the one-hot build + routing VPU work. Other
+tested escapes — int8 fixed-point contraction (1.33x bare-matmul win,
+eaten by Mosaic's lack of i8 select/mul forcing i32 operand builds;
+H2O3_HIST_I8 opt-in keeps it), lane-gather range lookups (Mosaic
+declines), tile resizing (flat) — are recorded in tools/ and
+ops/hist_adaptive.py.
 
 Prints exactly one JSON line on stdout.
 """
@@ -49,10 +55,15 @@ import numpy as np
 ROWS = int(os.environ.get("H2O3_BENCH_ROWS", 10_000_000))
 TREES = int(os.environ.get("H2O3_BENCH_TREES", 20))
 DEPTH = int(os.environ.get("H2O3_BENCH_DEPTH", 6))
-# 30 adaptive bins (W=32 lanes): above the reference's default nbins=20,
-# AUC-equal to 62-bin adaptive and 254-bin global on this task
-# (0.8358 / 0.8360 / 0.8366)
-NBINS = int(os.environ.get("H2O3_BENCH_NBINS", 30))
+# 14 bins (W=16 lanes) + per-tree random grid phase (the reference's
+# own histogram_type=Random, hex/tree/DHistogram.java): F*W=448 fits one
+# 512-lane MXU stripe so each level costs HALF the W=32 passes, and the
+# phase jitter recovers the low-bin-count resolution — measured AUC on
+# this task: 14-bin random 0.8360 / 30-bin adaptive 0.8358 / 62-bin
+# adaptive 0.8364 / 254-bin global 0.8366. Same-or-better accuracy than
+# the previously recorded 30-bin config at ~1.15x the throughput.
+NBINS = int(os.environ.get("H2O3_BENCH_NBINS", 14))
+HIST_TYPE = os.environ.get("H2O3_BENCH_HIST", "random")
 A100_GPU_HIST_ROWS_PER_SEC = 25e6
 
 
@@ -124,7 +135,8 @@ def main():
 
     common = dict(max_depth=DEPTH, learn_rate=0.1, nbins=NBINS,
                   distribution="bernoulli", seed=7, score_tree_interval=0,
-                  stopping_rounds=0, min_rows=1.0)
+                  stopping_rounds=0, min_rows=1.0,
+                  histogram_type=HIST_TYPE)
     # warmup: compile the chunked tree scan at the exact shapes/chunk the
     # measured run uses (chunk length is a static scan parameter)
     warm = H2OGradientBoostingEstimator(ntrees=TREES, **common)

@@ -279,6 +279,21 @@ def adaptive_level_xla(x, nid, ghw, tables, lo, inv, n_prev: int,
     return nid, jnp.moveaxis(hist, -1, 0)
 
 
+def pallas_interpret() -> bool:
+    """H2O3_PALLAS_INTERPRET=1 runs the pallas kernels through the
+    interpreter — lets the multichip dryrun execute the FLAGSHIP kernel
+    path (routing + histogram + cross-shard psum) on the virtual CPU
+    mesh, where compiled Mosaic is TPU-only (read at trace time)."""
+    return _os.environ.get("H2O3_PALLAS_INTERPRET", "") == "1"
+
+
+def _resolve_method(method: str) -> str:
+    if method != "auto":
+        return method
+    return "pallas" if (jax.default_backend() == "tpu"
+                        or pallas_interpret()) else "scatter"
+
+
 def adaptive_level(x, nid, ghw, tables, lo, inv, n_prev: int, n_nodes: int,
                    level_base: int, W: int, method: str = "auto",
                    mxu_dtype=jnp.bfloat16, xt=None, qs=None):
@@ -290,8 +305,7 @@ def adaptive_level(x, nid, ghw, tables, lo, inv, n_prev: int, n_nodes: int,
     (optional (q [6, rows] int8, scales [3]) from quantize_ghw_i8)
     enables the exact 2-term int8 fixed-point contraction for levels
     with 6·n_nodes <= 128 — ~1.3x faster AND tighter error than bf16."""
-    if method == "auto":
-        method = "pallas" if jax.default_backend() == "tpu" else "scatter"
+    method = _resolve_method(method)
     if method == "pallas":
         if xt is not None:
             rows = xt.shape[1]
@@ -308,11 +322,12 @@ def adaptive_level(x, nid, ghw, tables, lo, inv, n_prev: int, n_nodes: int,
                     q = jnp.pad(q, ((0, 0), (0, pad)))
                 nid2, hist = adaptive_level_tpu_i8(
                     xt, nid, q, scales, tables, lo, inv, n_prev, n_nodes,
-                    level_base, W)
+                    level_base, W, interpret=pallas_interpret())
                 return nid2[:rows], hist
             nid2, hist = adaptive_level_tpu_t(xt, nid, ghw, tables, lo, inv,
                                               n_prev, n_nodes, level_base,
-                                              W, mxu_dtype=mxu_dtype)
+                                              W, mxu_dtype=mxu_dtype,
+                                              interpret=pallas_interpret())
             return nid2[:rows], hist
         rows = x.shape[0]
         pad = (-rows) % TILE
@@ -324,7 +339,8 @@ def adaptive_level(x, nid, ghw, tables, lo, inv, n_prev: int, n_nodes: int,
             ghw = jnp.pad(ghw, ((0, 0), (0, pad)))
         nid2, hist = adaptive_level_tpu(x, nid, ghw, tables, lo, inv, n_prev,
                                         n_nodes, level_base, W,
-                                        mxu_dtype=mxu_dtype)
+                                        mxu_dtype=mxu_dtype,
+                                        interpret=pallas_interpret())
         return nid2[:rows], hist
     return adaptive_level_xla(x, nid, ghw, tables, lo, inv, n_prev,
                               n_nodes, level_base, W)
@@ -333,8 +349,11 @@ def adaptive_level(x, nid, ghw, tables, lo, inv, n_prev: int, n_nodes: int,
 def pick_W(nbins: int) -> int:
     """Smallest supported lane width for nbins real bins (+1 NA lane).
     W=32 covers the reference's default nbins=20 at half the one-hot
-    build cost of W=64."""
-    for w in (32, 64, 128, 256):
+    build cost of W=64; W=16 (nbins<=14) additionally halves the MXU
+    passes (F*W drops below one 512-lane stripe at F=28) — per-node
+    adaptive re-binning recovers the resolution with depth (AUC parity
+    measured on the HIGGS bench, see bench.py)."""
+    for w in (16, 32, 64, 128, 256):
         if nbins <= w - 2:
             return w
     raise ValueError(f"nbins {nbins} exceeds the adaptive kernel's 254-bin "
@@ -811,8 +830,7 @@ def route_only_xla(x, nid, tables, n_prev: int, level_base: int):
 
 def route_only(x, nid, tables, n_prev: int, level_base: int,
                method: str = "auto", xt=None):
-    if method == "auto":
-        method = "pallas" if jax.default_backend() == "tpu" else "scatter"
+    method = _resolve_method(method)
     if method == "pallas":
         if xt is not None:
             rows = xt.shape[1]
@@ -821,21 +839,21 @@ def route_only(x, nid, tables, n_prev: int, level_base: int,
                 xt = jnp.pad(xt, ((0, 0), (0, pad)),
                              constant_values=jnp.nan)
                 nid = jnp.pad(nid, (0, pad))
-            return route_only_tpu_t(xt, nid, tables, n_prev,
-                                    level_base)[:rows]
+            return route_only_tpu_t(xt, nid, tables, n_prev, level_base,
+                                    interpret=pallas_interpret())[:rows]
         rows = x.shape[0]
         pad = (-rows) % TILE
         if pad:
             x = jnp.pad(x, ((0, pad), (0, 0)), constant_values=jnp.nan)
             nid = jnp.pad(nid, (0, pad))
-        return route_only_tpu(x, nid, tables, n_prev, level_base)[:rows]
+        return route_only_tpu(x, nid, tables, n_prev, level_base,
+                              interpret=pallas_interpret())[:rows]
     return route_only_xla(x, nid, tables, n_prev, level_base)
 
 
 def leaf_totals(x, nid, ghw, tables, n_prev: int, n_nodes: int,
                 level_base: int, method: str = "auto"):
-    if method == "auto":
-        method = "pallas" if jax.default_backend() == "tpu" else "scatter"
+    method = _resolve_method(method)
     if method == "pallas":
         rows = x.shape[0]
         pad = (-rows) % TILE
@@ -844,6 +862,7 @@ def leaf_totals(x, nid, ghw, tables, n_prev: int, n_nodes: int,
             nid = jnp.pad(nid, (0, pad))
             ghw = jnp.pad(ghw, ((0, 0), (0, pad)))
         nid2, tot = leaf_totals_tpu(x, nid, ghw, tables, n_prev, n_nodes,
-                                    level_base)
+                                    level_base,
+                                    interpret=pallas_interpret())
         return nid2[:rows], tot
     return leaf_totals_xla(x, nid, ghw, tables, n_prev, n_nodes, level_base)
