@@ -126,6 +126,46 @@ def _coerce(v: str) -> Any:
     return s
 
 
+def _coerce_typed(name: str, v: Any, defaults: dict) -> Any:
+    """Schema-typed parse (water/api/Schema.java fillFromParms semantics):
+    the declared field type — here the builder's default-value type from
+    the same registry `/3/ModelBuilders/{algo}` metadata and the bindings
+    codegen consume — drives parsing, so a string-typed parameter is
+    NEVER int/bool-mangled by guessing. Falls back to the untyped
+    ``_coerce`` only for parameters the builder doesn't declare."""
+    if not isinstance(v, str):
+        return v
+    d = defaults.get(name)
+    if name not in defaults or d is None:
+        return _coerce(v)
+    s = v.strip()
+    if isinstance(d, str):
+        # declared string: pass through verbatim (an enum value like
+        # "none" or a column named "123" must survive)
+        return s
+    if s.lower() in ("null", "none", ""):
+        return None
+    if isinstance(d, bool):
+        return s.lower() == "true" if s.lower() in ("true", "false") \
+            else _coerce(s)
+    if isinstance(d, int):
+        try:
+            f = float(s)
+            return int(f) if f == int(f) else f
+        except ValueError:
+            return _coerce(s)
+    if isinstance(d, float):
+        try:
+            return float(s)
+        except ValueError:
+            return _coerce(s)
+    if isinstance(d, (list, tuple)):
+        got = _coerce(s)
+        return list(got) if isinstance(got, (list, tuple)) else \
+            _bracket_list(s)
+    return _coerce(s)
+
+
 # ---------------- handlers --------------------------------------------
 
 @route("GET", "/")
@@ -412,7 +452,8 @@ def _train(params, body, algo):
                                        "weights_column", "offset_column",
                                        "regex", "path")
                 if k in params}
-    parms = {k: _coerce(v) for k, v in params.items()}
+    defaults = builders[algo]().params
+    parms = {k: _coerce_typed(k, v, defaults) for k, v in params.items()}
     parms.update(raw_keep)
     train_key = parms.pop("training_frame", None)
     if isinstance(train_key, dict):
@@ -702,7 +743,8 @@ def _grid_build(params, body, algo):
                                        "response_column", "fold_column",
                                        "weights_column", "offset_column")
                 if k in params}
-    parms = {k: _coerce(v) for k, v in params.items()}
+    defaults = builders[algo]().params
+    parms = {k: _coerce_typed(k, v, defaults) for k, v in params.items()}
     parms.update(raw_keep)
     hyper = parms.pop("hyper_parameters", None) or {}
     if isinstance(hyper, str):
@@ -1216,6 +1258,23 @@ def _interaction_route(params, body):
 
     job.run(body_fn, background=True)
     return schemas.job_v3(job, dest, "Key<Frame>")
+
+
+@route("POST", "/3/FriedmansPopescusH")
+def _friedman_popescu_h(params, body):
+    """Friedman-Popescu H statistic (hex/tree/FriedmanPopescusH.java,
+    water/api/schemas3/FriedmanPopescusHV3.java; h2o-py model.h())."""
+    m = dkv.get(str(params.get("model_id")), "model")
+    fr = dkv.get(str(params.get("frame")), "frame")
+    variables = _strlist(params.get("variables"))
+    if not variables:
+        raise ApiError(400, "variables is required")
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "FriedmanPopescusHV3"},
+            "model_id": {"name": params.get("model_id")},
+            "frame": {"name": params.get("frame")},
+            "variables": variables,
+            "h": m.h(fr, variables)}
 
 
 @route("POST", "/3/PartialDependence/")

@@ -319,6 +319,14 @@ class TreeScoringOptionsMixin:
                                          bottom_n, compare_abs)
         return Frame(names, [Vec.from_numpy(c) for c in cols])
 
+    def h(self, frame, variables):
+        """Friedman-Popescu H statistic of `variables` on this model
+        (hex/tree/FriedmanPopescusH.java; h2o-py model.h() via
+        POST /3/FriedmansPopescusH). 0 = additive, larger = stronger
+        interaction, NaN when spoiled by weak main effects."""
+        from h2o3_tpu.models.hstat import friedman_popescu_h
+        return friedman_popescu_h(self, frame, variables)
+
     def predict_leaf_node_assignment(self, frame, type: str = "Path"):
         """Terminal-node assignment per tree (hex/Model.java
         LeafNodeAssignment): type='Path' → 'LRLR' strings, 'Node_ID' →

@@ -1083,6 +1083,58 @@ def _apply(op: str, args, env: Env):
                 dtype=object))
         return Frame([f"C{j+1}" for j in range(width)],
                      [Vec.from_numpy(c, vtype=T_STR) for c in cols])
+    if op == "tf-idf":
+        # (tf-idf frame doc_id_idx text_idx preprocess case_sensitive) —
+        # water/rapids/ast/prims/advmath/AstTfIdf.java: tokenize on \s+
+        # when preprocess, TF = per-(doc, token) count, IDF =
+        # log((N_docs+1)/(DF+1)) (hex/tfidf/InverseDocumentFrequencyTask
+        # .java idf()), rows sorted (Token, DocID).
+        fr = ev(0)
+        doc_idx = int(_eval(args[1], env))
+        text_idx = int(_eval(args[2], env))
+        preprocess = bool(_eval(args[3], env))
+        case_sensitive = bool(_eval(args[4], env))
+        dv = fr.vec(doc_idx)
+        if dv.type == T_STR:
+            doc_ids = [str(s) for s in dv.to_strings()[: fr.nrow]]
+            doc_numeric = False
+        else:
+            doc_ids = np.asarray(dv.to_numpy()[: fr.nrow])
+            doc_numeric = True
+        texts = fr.vec(text_idx).to_strings()[: fr.nrow]
+        from collections import Counter
+        tf = Counter()
+        docs_seen = set()
+        for i in range(fr.nrow):
+            d = doc_ids[i] if not doc_numeric else float(doc_ids[i])
+            s = texts[i]
+            if s is None:
+                continue
+            docs_seen.add(d)
+            toks = re.split(r"\s+", str(s).strip()) if preprocess \
+                else [str(s)]
+            for t in toks:
+                if not t:
+                    continue
+                if not case_sensitive:
+                    t = t.lower()
+                tf[(t, d)] += 1
+        n_docs = len(docs_seen)
+        df = Counter(t for (t, _d) in tf)
+        rows = sorted(tf.items())
+        out_doc = [d for ((_t, d), _c) in rows]
+        out_tok = np.asarray([t for ((t, _d), _c) in rows], dtype=object)
+        out_tf = np.asarray([float(c) for (_td, c) in rows])
+        out_idf = np.asarray([math.log((n_docs + 1.0) / (df[t] + 1.0))
+                              for ((t, _d), _c) in rows])
+        dvec = (Vec.from_numpy(np.asarray(out_doc, np.float64))
+                if doc_numeric else
+                Vec.from_numpy(np.asarray(out_doc, dtype=object),
+                               vtype=T_STR))
+        return Frame(["DocID", "Token", "TF", "IDF", "TF-IDF"],
+                     [dvec, Vec.from_numpy(out_tok, vtype=T_STR),
+                      Vec.from_numpy(out_tf), Vec.from_numpy(out_idf),
+                      Vec.from_numpy(out_tf * out_idf)])
     if op == "strDistance":
         # (strDistance fr1 fr2 measure compare_empty) — Levenshtein only
         f1, f2 = ev(0), _eval(args[1], env)

@@ -1,0 +1,77 @@
+"""Friedman-Popescu H statistic (hex/tree/FriedmanPopescusH.java;
+h2o-py model.h() -> POST /3/FriedmansPopescusH).
+
+Property tests per the statistic's definition (Friedman & Popescu 2008
+s.8.1): H ~ 0 for a model additive in the tested pair, H substantially
+positive when the response is driven by their product, and the
+variance-ratio form stays within [0, 1] when defined."""
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+
+def _train(y, X, **kw):
+    cols = {f"x{i}": X[:, i] for i in range(X.shape[1])}
+    cols["y"] = y
+    fr = h2o.Frame.from_numpy(cols)
+    gbm = H2OGradientBoostingEstimator(
+        ntrees=30, max_depth=3, learn_rate=0.2, min_rows=5.0, seed=1,
+        distribution="gaussian", score_tree_interval=0, **kw)
+    gbm.train(y="y", training_frame=fr)
+    return gbm.model, fr
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(1500, 3)).astype(np.float32)
+    return rng, X
+
+
+def test_h_additive_near_zero(data):
+    rng, X = data
+    y = (np.sin(X[:, 0]) + 0.5 * X[:, 1]
+         + 0.05 * rng.normal(size=len(X))).astype(np.float32)
+    model, fr = _train(y, X)
+    h01 = model.h(fr, ["x0", "x1"])
+    # additive response: interaction variance share should be tiny
+    assert np.isnan(h01) or h01 < 0.15, h01
+
+
+def test_h_interaction_large(data):
+    rng, X = data
+    y = (X[:, 0] * X[:, 1]
+         + 0.05 * rng.normal(size=len(X))).astype(np.float32)
+    model, fr = _train(y, X)
+    h01 = model.h(fr, ["x0", "x1"])
+    assert 0.5 < h01 <= 1.0, h01
+    # a variable with no main or interaction effect pairs near zero
+    h02 = model.h(fr, ["x0", "x2"])
+    assert np.isnan(h02) or h02 < 0.25, h02
+
+
+def test_h_rest_roundtrip(data):
+    rng, X = data
+    y = (X[:, 0] * X[:, 1]
+         + 0.05 * rng.normal(size=len(X))).astype(np.float32)
+    model, fr = _train(y, X)
+    from h2o3_tpu import dkv
+    from h2o3_tpu.api.server import _friedman_popescu_h
+    dkv.put("hstat_m", "model", model)
+    dkv.put("hstat_f", "frame", fr)
+    out = _friedman_popescu_h({"model_id": "hstat_m", "frame": "hstat_f",
+                               "variables": '["x0","x1"]'}, None)
+    assert out["h"] > 0.5
+    assert out["variables"] == ["x0", "x1"]
+
+
+def test_h_validations(data):
+    rng, X = data
+    y = X[:, 0].astype(np.float32)
+    model, fr = _train(y, X)
+    with pytest.raises(ValueError):
+        model.h(fr, ["x0"])
+    with pytest.raises(ValueError):
+        model.h(fr, ["x0", "nope"])

@@ -1,6 +1,8 @@
 """Parser tests — mirror the reference's parser suite
 (h2o-core/src/test/java/water/parser/ParserTest*.java): separator/header/
 type guessing, NA strings, quoted fields, enum domains, multi-file."""
+import os
+
 import numpy as np
 import pytest
 
@@ -103,3 +105,40 @@ def test_skipped_columns(tmp_path):
     s.skipped_columns = [1]
     fr = parse(str(p), s)
     assert fr.names == ["a", "c"]
+
+
+def test_remote_persist_via_arrow_fs(monkeypatch):
+    """s3://gs://hdfs:// persist backends ride pyarrow.fs
+    (water/persist/PersistS3 et al. analogs): exercise the REAL
+    download-to-cache path against pyarrow's in-memory mock filesystem,
+    then parse the localized file end-to-end."""
+    from pyarrow import fs as pafs
+
+    from h2o3_tpu.ingest import persist_uri
+
+    mock = pafs._MockFileSystem()
+    mock.create_dir("bucket")
+    with mock.open_output_stream("bucket/remote.csv") as f:
+        f.write(b"a,b\n1,2\n3,4\n5,6\n")
+    monkeypatch.setattr(persist_uri, "_remote_fs",
+                        lambda uri: (mock, "bucket/remote.csv"))
+    # distinct URIs → distinct cache entries; both funnel through the
+    # mocked remote
+    for uri in ("s3://bucket/remote.csv", "gs://bucket/remote.csv"):
+        local = persist_uri.localize(uri)
+        assert os.path.exists(local)
+        fr = h2o.import_file(uri)
+        assert fr.nrow == 3 and fr.ncol == 2
+        assert fr.vec(0).to_numpy()[:3].tolist() == [1.0, 3.0, 5.0]
+
+
+def test_remote_persist_unavailable_message():
+    """hdfs without libhdfs must fail with the gated-backend error, not
+    a raw traceback (persist backends degrade with a clear message)."""
+    from h2o3_tpu.ingest import persist_uri
+    try:
+        persist_uri.localize("hdfs://namenode:8020/data.csv")
+    except NotImplementedError as e:
+        assert "hdfs" in str(e)
+    except Exception as e:  # pragma: no cover - env-dependent
+        raise AssertionError(f"expected NotImplementedError, got {e!r}")

@@ -159,3 +159,46 @@ def test_distance():
     r = exec_rapids("(distance da db 'l2')")
     d = np.asarray(_frame(r).vec(0).to_numpy()[:2])
     np.testing.assert_allclose(d, [0.0, 5.0])
+
+
+def test_tf_idf_golden():
+    """(tf-idf fr doc_id_idx text_idx preprocess case_sensitive) vs the
+    reference's golden values (h2o-py tests/testdir_algos/tf-idf/
+    pyunit_PUBDEV-6938_tf-idf.py; IDF = log((N+1)/(DF+1)),
+    hex/tfidf/InverseDocumentFrequencyTask.java)."""
+    f = h2o.Frame.from_numpy({
+        "DocID": np.array([0.0, 1.0, 2.0]),
+        "Document": np.array(["A B C", "A a a Z", "C c B C"], dtype=object)})
+    dkv.put("tfidf_in", "frame", f)
+    out = _frame(exec_rapids("(tf-idf tfidf_in 0 1 True True)"))
+    assert out.names == ["DocID", "Token", "TF", "IDF", "TF-IDF"]
+    toks = list(out.vec(1).to_strings()[: out.nrow])
+    assert toks == ["A", "A", "B", "B", "C", "C", "Z", "a", "c"]
+    np.testing.assert_allclose(out.vec(0).to_numpy()[: out.nrow],
+                               [0, 1, 0, 2, 0, 2, 1, 1, 2])
+    np.testing.assert_allclose(out.vec(2).to_numpy()[: out.nrow],
+                               [1, 1, 1, 1, 1, 2, 1, 2, 1])
+    np.testing.assert_allclose(
+        out.vec(3).to_numpy()[: out.nrow],
+        [0.28768, 0.28768, 0.28768, 0.28768, 0.28768, 0.28768,
+         0.69314, 0.69314, 0.69314], atol=1e-4)
+    np.testing.assert_allclose(
+        out.vec(4).to_numpy()[: out.nrow],
+        [0.28768, 0.28768, 0.28768, 0.28768, 0.28768, 0.57536,
+         0.69314, 1.38629, 0.69314], atol=1e-4)
+    # case-insensitive merges A/a and C/c
+    out2 = _frame(exec_rapids("(tf-idf tfidf_in 0 1 True False)"))
+    toks2 = list(out2.vec(1).to_strings()[: out2.nrow])
+    assert toks2 == ["a", "a", "b", "b", "c", "c", "z"]
+    np.testing.assert_allclose(out2.vec(2).to_numpy()[: out2.nrow],
+                               [1, 3, 1, 1, 1, 3, 1])
+    # preprocess=False consumes an already-tokenized (doc, word) frame
+    f2 = h2o.Frame.from_numpy({
+        "DocID": np.array([0.0, 0, 0, 1, 1, 1, 1, 2, 2, 2, 2]),
+        "Words": np.array(list("ABC") + ["A", "a", "a", "Z"]
+                          + ["C", "c", "B", "C"], dtype=object)})
+    dkv.put("tfidf_pre", "frame", f2)
+    out3 = _frame(exec_rapids("(tf-idf tfidf_pre 0 1 False True)"))
+    assert list(out3.vec(1).to_strings()[: out3.nrow]) == toks
+    np.testing.assert_allclose(out3.vec(2).to_numpy()[: out3.nrow],
+                               [1, 1, 1, 1, 1, 2, 1, 2, 1])

@@ -196,3 +196,29 @@ def test_rest_upload_file(server, tmp_path):
     _poll(server, parse["job"]["key"]["name"])
     fr = _req(server, "GET", "/3/Frames/tiny.hex")["frames"][0]
     assert fr["rows"] == 2
+
+
+def test_schema_typed_coercion():
+    """water/api/Schema.java fillFromParms semantics: the declared
+    (default-value) type drives the parse — a string-typed parameter is
+    never int/bool-mangled, numerics parse by type, unknowns fall back
+    to the guessing coercion."""
+    from h2o3_tpu.api.server import _coerce_typed
+    defaults = {"s": "auto", "i": 5, "f": 0.1, "b": False,
+                "lst": [], "none_d": None}
+    # declared string: numeric-looking and bool-looking values survive
+    assert _coerce_typed("s", "123", defaults) == "123"
+    assert _coerce_typed("s", "true", defaults) == "true"
+    # declared numerics/bool parse by type (int accepts "1e3" form)
+    assert _coerce_typed("i", "7", defaults) == 7
+    assert _coerce_typed("i", "1e3", defaults) == 1000
+    assert _coerce_typed("f", "0.25", defaults) == 0.25
+    assert _coerce_typed("b", "TRUE", defaults) is True
+    # declared list: bracket syntax parses
+    assert _coerce_typed("lst", '["a","b"]', defaults) == ["a", "b"]
+    # null sentinel applies to non-string types only
+    assert _coerce_typed("i", "", defaults) is None
+    assert _coerce_typed("s", "", defaults) == ""
+    # undeclared / None-default params keep the old guessing behavior
+    assert _coerce_typed("unknown", "42", defaults) == 42
+    assert _coerce_typed("none_d", "false", defaults) is False
