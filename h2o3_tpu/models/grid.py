@@ -178,18 +178,20 @@ class H2OGridSearch:
                 os.replace(tmp, mpath)
 
         combos = list(enumerate(self._combos()))
-        if self.parallelism > 1:
+        from h2o3_tpu.models.model_base import build_parallelism
+        par = build_parallelism(self.parallelism)
+        if par > 1:
             # hex/grid/GridSearch parallelism: a worker pool walks the
             # space; budgets are enforced at SUBMIT time per wave so
             # max_models overshoots by at most parallelism-1 in-flight
             # points (the reference has the same in-flight slack)
             import concurrent.futures as cf
-            with cf.ThreadPoolExecutor(max_workers=self.parallelism) as ex:
+            with cf.ThreadPoolExecutor(max_workers=par) as ex:
                 pending = {}
                 ci = 0
                 while ci < len(combos) or pending:
                     while (ci < len(combos)
-                           and len(pending) < self.parallelism):
+                           and len(pending) < par):
                         if ((max_models and built_count[0]
                              + len(pending) >= max_models)
                                 or (max_secs

@@ -13,6 +13,7 @@ building the test matrix.
 """
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
@@ -126,6 +127,20 @@ def build_training_spec(frame: Frame, y: str, x: Optional[Sequence[str]] = None,
                         cat_domains=cat_domains, nrow=nrow, response=y,
                         response_domain=response_domain, nclasses=nclasses,
                         offset=offset, X_host=X_host, stream=stream)
+
+
+def build_parallelism(par: int) -> int:
+    """Effective build-thread count for parallel CV/grid building.
+
+    H2O3_MAX_BUILD_THREADS caps every build thread pool: on the
+    virtual-device CPU test backend, many threads dispatching jitted
+    train steps concurrently across oversubscribed xdist processes can
+    abort() inside XLA — the suite pins the cap to 1 (conftest.py) and
+    the dedicated concurrency tests raise it back. Unset/0 = no cap
+    (TPU path: the device serializes dispatch, threads only overlap
+    host orchestration + compiles)."""
+    cap = int(os.environ.get("H2O3_MAX_BUILD_THREADS", "0") or 0)
+    return min(par, cap) if cap > 0 else par
 
 
 def _host_matrix(frame: Frame, names) -> np.ndarray:
@@ -585,9 +600,17 @@ class ModelBuilder:
         def body(job):
             nfolds = int(self.params.get("nfolds", 0) or 0)
             fold_column = self.params.get("fold_column")
-            par = int(self.params.get("parallelism", 1) or 1)
+            par = build_parallelism(
+                int(self.params.get("parallelism", 1) or 1))
             cv_fut = None
-            if (nfolds > 1 or fold_column) and par > 1 and not spec.stream:
+            # builders that override _cross_validate opt OUT of the
+            # generic fold machinery (TargetEncoder: fold_column selects
+            # ENCODING folds, not CV folds) — route through the override,
+            # never _cv_fold_pass directly
+            custom_cv = (type(self)._cross_validate
+                         is not ModelBuilder._cross_validate)
+            if (nfolds > 1 or fold_column) and par > 1 and not spec.stream \
+                    and not custom_cv:
                 # concurrent CV-main (hex/ModelBuilder.java:884
                 # cv_buildModels + main build overlap): fold models start
                 # on a worker pool while the main model trains here
@@ -621,14 +644,21 @@ class ModelBuilder:
                     "value": float(cmf(pred[live], yh[live], wh[live]))}
             if nfolds > 1 or fold_column:
                 with prof.phase("cv"):
-                    if cv_fut is not None:
+                    if custom_cv:
+                        self._cross_validate(model, training_frame, y, x,
+                                             spec, job, nfolds,
+                                             fold_column)
+                    elif cv_fut is not None:
                         fold_pass = cv_fut.result()
                         cv_pool.shutdown()
+                        self._attach_cv(model, training_frame, y, x,
+                                        *fold_pass)
                     else:
                         fold_pass = self._cv_fold_pass(
                             training_frame, y, x, spec, job, nfolds,
                             fold_column)
-                    self._attach_cv(model, training_frame, y, x, *fold_pass)
+                        self._attach_cv(model, training_frame, y, x,
+                                        *fold_pass)
             model.output["profile"] = prof.to_dict()
             info("%s train done: %s", self.algo, prof.summary())
             timeline_record("train_done",
@@ -704,7 +734,8 @@ class ModelBuilder:
                 fm._predict_matrix(X_te, offset=fm._frame_offset(te))))[: te.nrow]
             return mask, out, fm
 
-        par = int(self.params.get("parallelism", 1) or 1)
+        par = build_parallelism(
+            int(self.params.get("parallelism", 1) or 1))
         fold_models = []
         if par > 1:
             # CVModelBuilder parallel fold building (hex/CVModelBuilder,

@@ -97,6 +97,8 @@ def train_segments(builder_factory, segment_columns: Sequence[str],
             row["error"] = str(e)
         return row
 
+    from h2o3_tpu.models.model_base import build_parallelism
+    parallelism = build_parallelism(parallelism)
     if parallelism > 1:
         import concurrent.futures as cf
         with cf.ThreadPoolExecutor(max_workers=parallelism) as ex:

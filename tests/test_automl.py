@@ -6,6 +6,7 @@ import pytest
 import h2o3_tpu as h2o
 from h2o3_tpu.automl import H2OAutoML
 
+pytestmark = pytest.mark.slow  # heavy tier: driver runs with --runslow
 
 def _task(n=1200, seed=0):
     rng = np.random.default_rng(seed)

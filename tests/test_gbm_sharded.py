@@ -14,6 +14,7 @@ import h2o3_tpu as h2o
 from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
 from h2o3_tpu.parallel.mesh import current_mesh, make_mesh, set_mesh
 
+pytestmark = pytest.mark.slow  # heavy tier: driver runs with --runslow
 
 def _train(mesh, X, y, **params):
     old = current_mesh()

@@ -107,6 +107,7 @@ def test_frame_binary_save_load(client, prostate, tmp_path):
     assert loaded["RACE"].isfactor() == [True]
 
 
+@pytest.mark.slow
 def test_learning_curve_and_varimp_plot(client, prostate):
     """h2o-py explain-stack entry points against the live server:
     learning_curve_plot (scoring-history TwoDimTable) and varimp —
@@ -154,6 +155,7 @@ def test_uplift_metrics_object(client):
     assert mm.ate > 0.05              # true ATE = 0.1
 
 
+@pytest.mark.slow
 def test_explain_smoke(client, prostate):
     """h2o-py model.explain() against the live server (VERDICT r4 task 7
     done-criterion): varimp + SHAP summary + PDP panels render headless

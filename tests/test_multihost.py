@@ -9,8 +9,9 @@ import sys
 
 import pytest
 
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+pytestmark = pytest.mark.slow  # heavy tier: driver runs with --runslow
 
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def _free_port() -> int:
     s = socket.socket()

@@ -10,6 +10,19 @@ import h2o3_tpu as h2o
 from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
 from h2o3_tpu.models.grid import H2OGridSearch
 
+# this file exists to exercise REAL build-thread concurrency: lift the
+# suite-wide clamp (conftest.py H2O3_MAX_BUILD_THREADS=1) — inside a
+# fixture, NOT at module level: pytest imports every test module at
+# collection time, so a module-level env write would leak the un-clamp
+# into the whole suite.
+import os as _os
+
+pytestmark = pytest.mark.slow  # heavy tier: driver runs with --runslow
+
+
+@pytest.fixture(autouse=True)
+def _unclamped_build_threads(monkeypatch):
+    monkeypatch.setitem(_os.environ, "H2O3_MAX_BUILD_THREADS", "0")
 
 def _frame(n=3000, seed=0):
     rng = np.random.default_rng(seed)
@@ -69,3 +82,20 @@ def test_concurrent_cv_main():
     est2.train(y="y", training_frame=fr)
     assert abs(m.cross_validation_metrics.auc
                - est2.model.cross_validation_metrics.auc) < 1e-6
+
+
+# moved from test_platform.py: under the suite-wide thread
+# clamp this parity test would silently compare sequential to
+# sequential; here the autouse fixture lifts the clamp so the
+# CONCURRENT fold path is the one compared
+def test_parallel_cv_matches_sequential():
+    fr = _reg_frame()
+    seq = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=1,
+                                       nfolds=3, fold_assignment="modulo")
+    seq.train(y="y", training_frame=fr)
+    par = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=1,
+                                       nfolds=3, fold_assignment="modulo",
+                                       parallelism=3)
+    par.train(y="y", training_frame=fr)
+    assert seq.model.cross_validation_metrics.mse == pytest.approx(
+        par.model.cross_validation_metrics.mse, rel=1e-5)

@@ -18,19 +18,6 @@ def _reg_frame(n=800, seed=0):
         {**{f"x{i}": X[:, i] for i in range(3)}, "y": y})
 
 
-def test_parallel_cv_matches_sequential():
-    fr = _reg_frame()
-    seq = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=1,
-                                       nfolds=3, fold_assignment="modulo")
-    seq.train(y="y", training_frame=fr)
-    par = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=1,
-                                       nfolds=3, fold_assignment="modulo",
-                                       parallelism=3)
-    par.train(y="y", training_frame=fr)
-    assert seq.model.cross_validation_metrics.mse == pytest.approx(
-        par.model.cross_validation_metrics.mse, rel=1e-5)
-
-
 def test_train_segments():
     from h2o3_tpu.segments import train_segments
     rng = np.random.default_rng(3)

@@ -14,6 +14,7 @@ from h2o3_tpu.parallel.sortmerge import (distributed_argsort,
                                          lexsort_device, sortable_bits)
 import jax.numpy as jnp
 
+pytestmark = pytest.mark.slow  # heavy tier: driver runs with --runslow
 
 def test_sortable_bits_total_order():
     vals = np.array([-np.inf, -1e30, -1.5, -0.0, 0.0, 1e-30, 2.5, np.inf],

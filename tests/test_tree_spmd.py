@@ -10,6 +10,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from h2o3_tpu.models.tree import TreeConfig, grow_tree, grow_tree_spmd
 
+pytestmark = pytest.mark.slow  # heavy tier: driver runs with --runslow
 
 @pytest.fixture
 def tree_problem():
