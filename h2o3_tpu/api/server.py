@@ -1577,3 +1577,48 @@ def _timeline(params, body):
     from h2o3_tpu.log import timeline_events
     return {"__meta": {"schema_version": 3, "schema_name": "TimelineV3"},
             "events": timeline_events(int(params.get("n", 2048) or 2048))}
+
+
+@route("GET", "/3/Profiler")
+def _profiler(params, body):
+    """water/api/ProfilerHandler: aggregated stack samples per node
+    (ProfilerV3 -> ProfilerNodeV3 {node_name, timestamp, entries:
+    [{stacktrace, count}]}). One controller process here, so one node."""
+    import time as _time
+
+    from h2o3_tpu.log import stack_samples
+    depth = int(params.get("depth", 10) or 10)
+    if depth < 1:
+        raise ApiError(400, "depth must be >= 1")
+    entries = stack_samples(depth=depth)
+    return {"__meta": {"schema_version": 3, "schema_name": "ProfilerV3"},
+            "depth": depth,
+            "nodes": [{"node_name": "tpu-controller/0",
+                       "timestamp": int(_time.time() * 1000),
+                       "entries": entries}]}
+
+
+@route("POST", "/3/Profiler/trace")
+def _profiler_trace(params, body):
+    """TPU-native device tracing (no reference analog — the JVM profiler
+    cannot see the accelerator): start/stop a jax.profiler trace whose
+    artifacts load in TensorBoard/Perfetto. action=start|stop."""
+    import jax as _jax
+    action = (params.get("action") or "").lower()
+    if action == "start":
+        log_dir = params.get("log_dir") or os.path.join(
+            tempfile.gettempdir(), "h2o3_jax_trace")
+        try:
+            _jax.profiler.start_trace(log_dir)
+        except RuntimeError as e:      # double-start: already tracing
+            raise ApiError(400, f"trace already active: {e}")
+        return {"__meta": {"schema_name": "ProfilerTraceV3"},
+                "status": "started", "log_dir": log_dir}
+    if action == "stop":
+        try:
+            _jax.profiler.stop_trace()
+        except RuntimeError as e:
+            raise ApiError(400, f"no active trace: {e}")
+        return {"__meta": {"schema_name": "ProfilerTraceV3"},
+                "status": "stopped"}
+    raise ApiError(400, "action must be 'start' or 'stop'")

@@ -1,0 +1,121 @@
+"""Kubernetes pod entrypoint for multi-host clusters.
+
+Reference: h2o-k8s/ (assisted clustering: H2OKubernetesEmbeddedConfig
+resolves peers from a headless-service DNS lookup and waits for cloud
+stabilization) + h2o-helm/. TPU re-design: no peer discovery protocol —
+``jax.distributed.initialize`` IS cloud formation, and the coordinator
+address is a deterministic StatefulSet DNS name (pod ordinal 0), so the
+"lookup + stabilize" machinery collapses into env-var resolution. Every
+pod runs this module; process 0 additionally serves REST (the node
+answering the web port, water/H2O.java boot).
+
+Env contract (set by h2o-k8s/manifests or the h2o-helm chart):
+  H2O3_COORDINATOR_ADDRESS  host:port of pod 0 (headless-service DNS)
+  H2O3_NUM_PROCESSES        replica count
+  H2O3_PROCESS_ID           this pod's ordinal; derived from the
+                            StatefulSet hostname suffix when unset
+  H2O3_REST_PORT            REST port on the coordinator (default 54321)
+  H2O3_MESH_MODEL           'model' mesh axis size (default 1)
+
+Run: ``python -m h2o3_tpu.cluster_boot``
+"""
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass
+from typing import Mapping, Optional
+
+
+@dataclass
+class BootConfig:
+    coordinator_address: str
+    num_processes: int
+    process_id: int
+    rest_port: int
+    n_model: int
+
+
+def resolve_boot_config(env: Optional[Mapping[str, str]] = None,
+                        hostname: Optional[str] = None) -> BootConfig:
+    """Pure env → config resolution (unit-testable without a cluster).
+
+    The pod ordinal falls back to the trailing ``-<n>`` of the
+    StatefulSet hostname (``h2o3-2`` → 2) the way the reference's
+    assisted clustering derives identity from pod metadata."""
+    env = dict(env if env is not None else os.environ)
+    addr = env.get("H2O3_COORDINATOR_ADDRESS")
+    if not addr:
+        raise ValueError("H2O3_COORDINATOR_ADDRESS is required "
+                         "(pod-0 headless-service DNS, host:port)")
+    n = int(env.get("H2O3_NUM_PROCESSES", "1"))
+    pid_s = env.get("H2O3_PROCESS_ID")
+    if pid_s is None or pid_s == "":
+        host = hostname if hostname is not None else os.uname().nodename
+        m = re.search(r"-(\d+)$", host)
+        if not m:
+            raise ValueError(
+                f"H2O3_PROCESS_ID unset and hostname '{host}' has no "
+                f"StatefulSet ordinal suffix")
+        pid = int(m.group(1))
+    else:
+        pid = int(pid_s)
+    if not (0 <= pid < n):
+        raise ValueError(f"process_id {pid} outside [0, {n})")
+    return BootConfig(
+        coordinator_address=addr, num_processes=n, process_id=pid,
+        rest_port=int(env.get("H2O3_REST_PORT", "54321")),
+        n_model=int(env.get("H2O3_MESH_MODEL", "1")))
+
+
+def main() -> None:
+    import h2o3_tpu as h2o
+    cfg = resolve_boot_config()
+    h2o.init(distributed=True,
+             coordinator_address=cfg.coordinator_address,
+             num_processes=cfg.num_processes,
+             process_id=cfg.process_id,
+             n_model=cfg.n_model,
+             port=cfg.rest_port)
+    import jax
+    if cfg.process_id != 0:
+        # workers answer the web port too — but only with a minimal
+        # health responder so the /3/Cloud readiness probe passes on
+        # every pod (the reference's every-node-answers-the-web-port
+        # behavior; full REST stays coordinator-only by design)
+        _serve_worker_health(cfg)
+    print(f"h2o3_tpu pod {cfg.process_id}/{cfg.num_processes} up: "
+          f"{len(jax.devices())} global devices"
+          + (f", REST :{cfg.rest_port}" if cfg.process_id == 0 else ""),
+          flush=True)
+    # workers park forever; the coordinator's REST server owns the
+    # process lifetime (SIGTERM from k8s ends the pod)
+    import threading
+    threading.Event().wait()
+
+
+def _serve_worker_health(cfg: BootConfig) -> None:
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Health(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - http.server contract
+            body = json.dumps({
+                "role": "worker", "process_id": cfg.process_id,
+                "coordinator": cfg.coordinator_address}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("", cfg.rest_port), _Health)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+
+if __name__ == "__main__":
+    main()

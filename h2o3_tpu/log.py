@@ -122,3 +122,27 @@ class Profile:
         total = sum(self.phases.values())
         parts = [f"{k}={self.phases[k]:.2f}s" for k in self._order]
         return f"total={total:.2f}s " + " ".join(parts)
+
+
+def stack_samples(depth: int = 10, samples: int = 20,
+                  interval: float = 0.01) -> List[Dict]:
+    """Aggregated thread-stack samples — the water/util/JProfile analog
+    behind GET /3/Profiler (water/api/ProfilerHandler.java samples JVM
+    stacktraces per node and aggregates identical traces with counts).
+    Here: sys._current_frames() sampled `samples` times; identical
+    truncated traces aggregate; entries sort by count descending."""
+    import sys
+    import traceback
+    agg: Dict[str, int] = {}
+    me = threading.get_ident()
+    for _ in range(max(samples, 1)):
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            stack = traceback.extract_stack(frame)[-depth:]
+            text = "\n".join(
+                f"{f.filename}:{f.lineno} in {f.name}" for f in stack)
+            agg[text] = agg.get(text, 0) + 1
+        time.sleep(interval)
+    return [{"stacktrace": k, "count": v}
+            for k, v in sorted(agg.items(), key=lambda kv: -kv[1])]

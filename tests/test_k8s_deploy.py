@@ -1,0 +1,71 @@
+"""Deployment artifacts (h2o-k8s/, h2o-helm/) + cluster_boot env
+resolution — the reference's h2o-k8s assisted-clustering tests collapse
+to: manifests are valid, the env contract the manifests set resolves to
+a correct jax.distributed boot config, and pod identity derives from
+the StatefulSet ordinal."""
+import os
+
+import pytest
+import yaml
+
+from h2o3_tpu.cluster_boot import BootConfig, resolve_boot_config
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_manifests_parse_and_wire_the_env_contract():
+    docs = []
+    for f in ("statefulset.yaml", "service.yaml"):
+        with open(os.path.join(ROOT, "h2o-k8s", "manifests", f)) as fh:
+            docs.extend(d for d in yaml.safe_load_all(fh) if d)
+    kinds = sorted(d["kind"] for d in docs)
+    assert kinds == ["Service", "Service", "StatefulSet"]
+    sts = next(d for d in docs if d["kind"] == "StatefulSet")
+    spec = sts["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e.get("value") for e in spec["env"]}
+    # env contract must match what cluster_boot resolves
+    cfg = resolve_boot_config(env, hostname="h2o3-2")
+    assert cfg == BootConfig(
+        coordinator_address="h2o3-0.h2o3-headless:8476",
+        num_processes=4, process_id=2, rest_port=54321, n_model=1)
+    # coordinator DNS must target the headless service the other doc
+    # declares, and pod 0
+    headless = next(d for d in docs if d["kind"] == "Service"
+                    and d["spec"].get("clusterIP") == "None")
+    assert cfg.coordinator_address.split(":")[0].endswith(
+        headless["metadata"]["name"])
+    assert cfg.coordinator_address.startswith(
+        sts["metadata"]["name"] + "-0.")
+    # readiness = REST /3/Cloud on the rest port (reference probe)
+    probe = spec["readinessProbe"]["httpGet"]
+    assert probe["path"] == "/3/Cloud"
+
+
+def test_helm_chart_parses():
+    with open(os.path.join(ROOT, "h2o-helm", "Chart.yaml")) as fh:
+        chart = yaml.safe_load(fh)
+    assert chart["name"] == "h2o3-tpu"
+    with open(os.path.join(ROOT, "h2o-helm", "values.yaml")) as fh:
+        vals = yaml.safe_load(fh)
+    assert vals["replicas"] >= 1 and vals["restPort"]
+    # templates contain the boot env contract (rendered by helm; here we
+    # check the contract names survive in the template text)
+    t = open(os.path.join(ROOT, "h2o-helm", "templates",
+                          "statefulset.yaml")).read()
+    for name in ("H2O3_COORDINATOR_ADDRESS", "H2O3_NUM_PROCESSES",
+                 "H2O3_REST_PORT", "H2O3_MESH_MODEL"):
+        assert name in t, name
+
+
+def test_resolve_boot_config_validation():
+    with pytest.raises(ValueError, match="H2O3_COORDINATOR_ADDRESS"):
+        resolve_boot_config({}, hostname="h2o3-0")
+    base = {"H2O3_COORDINATOR_ADDRESS": "c:1", "H2O3_NUM_PROCESSES": "2"}
+    # explicit id wins over hostname ordinal
+    assert resolve_boot_config({**base, "H2O3_PROCESS_ID": "1"},
+                               hostname="h2o3-0").process_id == 1
+    with pytest.raises(ValueError, match="outside"):
+        resolve_boot_config({**base, "H2O3_PROCESS_ID": "5"},
+                            hostname="x-0")
+    with pytest.raises(ValueError, match="ordinal"):
+        resolve_boot_config(base, hostname="nodigit")

@@ -222,3 +222,42 @@ def test_schema_typed_coercion():
     # undeclared / None-default params keep the old guessing behavior
     assert _coerce_typed("unknown", "42", defaults) == 42
     assert _coerce_typed("none_d", "false", defaults) is False
+
+
+def test_profiler_endpoint(server):
+    """GET /3/Profiler (water/api/ProfilerHandler analog): aggregated
+    stack samples with the ProfilerV3 node/entries shape; POST
+    /3/Profiler/trace drives jax.profiler start/stop."""
+    import threading
+    import time as _t
+
+    stop = threading.Event()
+
+    def busy():
+        while not stop.is_set():
+            _t.sleep(0.001)
+
+    t = threading.Thread(target=busy, name="profilee", daemon=True)
+    t.start()
+    try:
+        out = _req(server, "GET", "/3/Profiler?depth=6")
+        assert out["nodes"] and out["nodes"][0]["entries"]
+        e0 = out["nodes"][0]["entries"][0]
+        assert e0["count"] >= 1 and "in " in e0["stacktrace"]
+    finally:
+        stop.set()
+    import tempfile
+    d = tempfile.mkdtemp(prefix="h2o3_trace_")
+    st = _req(server, "POST", "/3/Profiler/trace",
+              {"action": "start", "log_dir": d})
+    assert st["status"] == "started"
+    import numpy as _np
+    import h2o3_tpu as _h
+    fr2 = _h.Frame.from_numpy({"x": _np.arange(32.0)})
+    _ = fr2.vec(0).to_numpy()
+    sp = _req(server, "POST", "/3/Profiler/trace", {"action": "stop"})
+    assert sp["status"] == "stopped"
+    import os as _os
+    # a TensorBoard-layout trace landed: plugins/profile/... with files
+    assert any("plugins" in r and f for r, _d, f in _os.walk(d)), \
+        [r for r, _d, _f in _os.walk(d)]
