@@ -477,8 +477,12 @@ def model_v3(model, key: str) -> Dict:
         "response_column_name": model.response,
         "data_frame": None,
         "timestamp": int(time.time() * 1000),
-        "have_pojo": False,
-        "have_mojo": False,
+        # gate flags the client checks before download_pojo/download_mojo
+        # (h2o-py h2o.py:1397): POJO for tree + GLM codegen, MOJO for
+        # every algo with a writer registered in mojo.py/genmodel.py
+        "have_pojo": model.algo in ("gbm", "drf", "isolationforest",
+                                    "xgboost", "glm"),
+        "have_mojo": hasattr(model, "download_mojo"),
         "parameters": [
             {"name": k, "actual_value": v, "default_value": None,
              "label": k, "type": type(v).__name__, "input_value": v}
@@ -522,3 +526,16 @@ def models_v3(entries: List) -> Dict:
                    "schema_type": "Models"},
         "models": entries,
     }
+
+
+def known_schema_names():
+    """Names served by /3/Metadata/schemas (MetadataHandler.listSchemas
+    analog): scraped from this module's literal schema_name strings so
+    the list cannot drift from what handlers actually emit."""
+    import re as _re
+    src = open(__file__.rstrip("c")).read()
+    names = set(_re.findall(r'"schema_name":\s*"([A-Za-z0-9._]+)"', src))
+    from h2o3_tpu.api import server as _srv
+    ssrc = open(_srv.__file__.rstrip("c")).read()
+    names |= set(_re.findall(r'"schema_name":\s*"([A-Za-z0-9._]+)"', ssrc))
+    return sorted(names)

@@ -19,6 +19,7 @@ import re
 import sys
 import tempfile
 import threading
+import time
 import urllib.parse
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -31,6 +32,7 @@ from h2o3_tpu.api import schemas
 from h2o3_tpu.jobs import Job, get_job
 
 _ROUTES: List[Tuple[str, re.Pattern, Callable]] = []
+_START_TS = time.time()
 
 
 def route(method: str, pattern: str):
@@ -1622,3 +1624,765 @@ def _profiler_trace(params, body):
         return {"__meta": {"schema_name": "ProfilerTraceV3"},
                 "status": "stopped"}
     raise ApiError(400, "action must be 'start' or 'stop'")
+
+
+# ---------------- round-5 REST breadth batch 2 -------------------------
+# The remaining RegisterV3Api.java registrations with real machinery
+# behind them in this codebase; hive/decryption/steam are honest gates.
+
+@route("GET", "/3/Ping")
+def _ping(params, body):
+    """water/api/PingHandler: liveness + a cloud snapshot."""
+    import psutil
+    vm = psutil.virtual_memory()
+    return {"__meta": {"schema_version": 3, "schema_name": "PingV3"},
+            "cloud_uptime_millis": int(
+                (time.time() - _START_TS) * 1000),
+            "cloud_healthy": True,
+            "nodes": [{"mem": int(vm.available),
+                       "num_cpus": os.cpu_count() or 1}]}
+
+
+@route("GET", "/3/InitID")
+def _init_id(params, body):
+    """water/api/InitIDHandler: issue a session key (h2o-py uses the
+    /4/sessions flavor; R's h2o.init path hits this one)."""
+    import uuid as _uuid
+    sid = "_sid_" + _uuid.uuid4().hex[:10]
+    dkv.put(sid, "session", {"frames": []})
+    return {"__meta": {"schema_version": 3, "schema_name": "InitIDV3"},
+            "session_key": sid}
+
+
+@route("DELETE", "/3/InitID")
+def _end_init_id(params, body):
+    return {"__meta": {"schema_version": 3, "schema_name": "InitIDV3"}}
+
+
+@route("GET", "/3/CloudLock")
+def _cloud_lock(params, body):
+    """water/api/CloudLockHandler. The single-controller cloud never
+    re-forms after boot, so it is always locked-stable."""
+    return {"__meta": {"schema_version": 3, "schema_name": "CloudLockV3"},
+            "locked": True, "reason": "single-controller: cloud is "
+            "fixed at boot (no Paxos re-formation to lock against)"}
+
+
+@route("POST", "/3/UnlockKeys")
+def _unlock_keys(params, body):
+    """water/api/UnlockKeysHandler: force-release every cooperative
+    lock (admin escape hatch)."""
+    dkv.unlock_everything()
+    return {"__meta": {"schema_version": 3, "schema_name": "UnlockKeysV3"}}
+
+
+_SESSION_PROPS: Dict[str, str] = {}
+
+
+@route("GET", "/3/SessionProperties")
+def _session_props_get(params, body):
+    k = params.get("key")
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "SessionPropertyV3"},
+            "key": k, "value": _SESSION_PROPS.get(k)}
+
+
+@route("POST", "/3/SessionProperties")
+def _session_props_set(params, body):
+    k = params.get("key")
+    if not k:
+        raise ApiError(400, "key is required")
+    _SESSION_PROPS[k] = params.get("value")
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "SessionPropertyV3"},
+            "key": k, "value": _SESSION_PROPS.get(k)}
+
+
+@route("GET", "/3/Capabilities/API")
+def _capabilities_api(params, body):
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "CapabilitiesV3"},
+            "capabilities": [
+                {"name": f"{m} {rx.pattern}", "category": "API"}
+                for m, rx, _ in _ROUTES]}
+
+
+@route("GET", "/3/Metadata/schemas")
+def _metadata_schemas_list(params, body):
+    """water/api/MetadataHandler.listSchemas."""
+    from h2o3_tpu.api import schemas as _sch
+    return {"__meta": {"schema_version": 3, "schema_name": "MetadataV3"},
+            "schemas": [{"name": n, "version": 3}
+                        for n in _sch.known_schema_names()]}
+
+
+@route("GET", "/3/Metadata/endpoints/{num}")
+def _metadata_endpoint_one(params, body, num):
+    i = int(num)
+    if not (0 <= i < len(_ROUTES)):
+        raise ApiError(404, f"endpoint index {i} out of range")
+    m, rx, fn = _ROUTES[i]
+    return {"__meta": {"schema_version": 3, "schema_name": "MetadataV3"},
+            "routes": [{"http_method": m, "url_pattern": rx.pattern,
+                        "summary": (fn.__doc__ or "").strip()[:200]}]}
+
+
+@route("GET", "/3/Frames/{key}/light")
+def _frame_light(params, body, key):
+    """FramesHandler.fetchLight: schema without data pages."""
+    fr = dkv.get(key, "frame")
+    return {"__meta": {"schema_version": 3, "schema_name": "FramesV3"},
+            "frames": [schemas.frame_v3(fr, key, row_count=0)]}
+
+
+@route("GET", "/3/Frames/{key}/columns")
+def _frame_columns(params, body, key):
+    fr = dkv.get(key, "frame")
+    return {"__meta": {"schema_version": 3, "schema_name": "FramesV3"},
+            "frames": [{"frame_id": {"name": key},
+                        "columns": list(fr.names)}]}
+
+
+def _one_column_v3(fr, key, col, row_count=10, row_offset=0):
+    if col not in fr.names:
+        raise ApiError(404, f"column '{col}' not in frame '{key}'")
+    return schemas.frame_v3(fr, key, row_count=row_count,
+                            row_offset=row_offset,
+                            column_offset=fr.names.index(col),
+                            column_count=1)
+
+
+@route("GET", "/3/Frames/{key}/columns/{col}")
+def _frame_column(params, body, key, col):
+    fr = dkv.get(key, "frame")
+    return {"__meta": {"schema_version": 3, "schema_name": "FramesV3"},
+            "frames": [_one_column_v3(
+                fr, key, col,
+                row_count=int(params.get("row_count", 10) or 10),
+                row_offset=int(params.get("row_offset", 0) or 0))]}
+
+
+@route("GET", "/3/Frames/{key}/columns/{col}/summary")
+def _frame_column_summary(params, body, key, col):
+    fr = dkv.get(key, "frame")
+    return {"__meta": {"schema_version": 3, "schema_name": "FramesV3"},
+            "frames": [_one_column_v3(fr, key, col)]}
+
+
+@route("GET", "/3/Frames/{key}/columns/{col}/domain")
+def _frame_column_domain(params, body, key, col):
+    fr = dkv.get(key, "frame")
+    if col not in fr.names:
+        raise ApiError(404, f"column '{col}' not in frame '{key}'")
+    v = fr.vec(col)
+    dom = list(v.domain) if v.domain else None
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "FrameV3.ColV3"},
+            "domain": [dom] if dom else [None],
+            "map_keys": {"string": dom or []}}
+
+
+@route("POST", "/3/Frames/{key}/export")
+@route("POST", "/3/Frames/{key}/export/{path}/overwrite/{force}")
+def _frame_export(params, body, key, path=None, force=None):
+    """FramesHandler.export: write the frame as CSV at `path` (job)."""
+    from h2o3_tpu.persist import export_file
+    fr = dkv.get(key, "frame")
+    out_path = path or params.get("path")
+    if not out_path:
+        raise ApiError(400, "path is required")
+    frc = (str(force if force is not None
+               else params.get("force", "false")).lower() == "true")
+    job = Job(f"Export frame {key}")
+    job.dest_key = out_path
+
+    def body_fn(j):
+        export_file(fr, out_path, force=frc)
+    job.run(body_fn, background=True)
+    return schemas.job_v3(job, out_path)
+
+
+@route("GET", "/3/ModelMetrics")
+def _model_metrics_all(params, body):
+    """ModelMetricsHandler.list with no filter: every model's stored
+    metrics."""
+    out = []
+    for key in dkv.keys("model"):
+        m = dkv.get(key, "model")
+        for mm in (m.training_metrics, m.validation_metrics,
+                   m.cross_validation_metrics):
+            if mm is not None:
+                v3 = schemas._metrics_v3(
+                    mm, _kind_of(m),
+                    domain=list(m.response_domain or []) or None,
+                    model_key=key)
+                if v3:
+                    out.append(v3)
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "ModelMetricsListSchemaV3"},
+            "model_metrics": out}
+
+
+@route("POST", "/3/ModelMetrics/predictions_frame/{pred}/actuals_frame/{act}")
+def _make_metrics(params, body, pred, act):
+    """ModelMetricsHandler.make (h2o.make_metrics): metrics straight
+    from a predictions frame + actuals frame, no model needed."""
+    import numpy as _np
+
+    from h2o3_tpu.models.model_base import compute_metrics
+    pf = dkv.get(pred, "frame")
+    af = dkv.get(act, "frame")
+    domain = _coerce(params.get("domain", "null"))
+    dist = (params.get("distribution") or "").lower() or None
+    av = af.vec(0)
+    if av.domain or domain:
+        dom = list(domain or av.domain)
+        nclasses = len(dom)
+        if av.domain:
+            yh = _np.asarray(av.to_numpy())[: af.nrow]
+        else:
+            lut = {d: i for i, d in enumerate(dom)}
+            yh = _np.asarray(
+                [lut.get(s, -1) for s in av.to_strings()[: af.nrow]])
+    else:
+        dom = None
+        nclasses = 1
+        yh = _np.asarray(av.to_numpy())[: af.nrow]
+    # predictions frame: regression = 1 numeric col; classification =
+    # [label, p0, p1, ...] or bare probability columns
+    pcols = [pf.vec(n) for n in pf.names]
+    if nclasses > 1:
+        probs = [_np.asarray(v.to_numpy())[: pf.nrow]
+                 for v in pcols if v.domain is None]
+        if len(probs) < nclasses:
+            raise ApiError(400, f"predictions frame needs {nclasses} "
+                                f"probability columns")
+        scores = _np.stack(probs[-nclasses:], axis=1)
+    else:
+        scores = _np.asarray(pcols[0].to_numpy())[: pf.nrow]
+    w = _np.ones(len(yh), _np.float32)
+    y_in = _np.asarray(yh, _np.float64)
+    if nclasses > 1:
+        # -1 marks a label outside the domain (lut miss) — excluded;
+        # regression actuals pass through untouched (negatives are data)
+        w[y_in == -1] = 0.0
+        y_in = _np.maximum(y_in, 0)
+    mm = compute_metrics(scores, y_in, w, nclasses,
+                         response_domain=tuple(dom) if dom else None)
+    kind = ("regression" if nclasses == 1 else
+            "binomial" if nclasses == 2 else "multinomial")
+    if dist in ("bernoulli",) and nclasses == 2:
+        kind = "binomial"
+    v3 = schemas._metrics_v3(mm, kind, domain=dom,
+                             frame_key=act) or {}
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "ModelMetricsListSchemaV3"},
+            "model_metrics": v3}
+
+
+@route("GET", "/3/Models.java/{model}")
+def _pojo_download(params, body, model):
+    """ModelsHandler.fetchJavaCode: the POJO source as java text."""
+    from h2o3_tpu.genmodel import pojo_source, pojo_source_glm
+    m = dkv.get(model, "model")
+    try:
+        src = (pojo_source_glm(m) if m.algo in ("glm",)
+               else pojo_source(m))
+    except (NotImplementedError, AttributeError) as e:
+        raise ApiError(400, f"no POJO for algo '{m.algo}': {e}")
+    return {"__raw": src.encode(), "__content_type": "text/java"}
+
+
+@route("GET", "/3/Models.java/{model}/preview")
+def _pojo_preview(params, body, model):
+    out = _pojo_download(params, body, model)
+    return {"__raw": out["__raw"][:4096], "__content_type": "text/java"}
+
+
+@route("GET", "/3/Models/{model}/mojo")
+@route("GET", "/99/Models.mojo/{model}")
+def _mojo_download(params, body, model):
+    """ModelsHandler.fetchMojo: the MOJO zip bytes (h2o-py
+    model.download_mojo streams this)."""
+    m = dkv.get(model, "model")
+    with tempfile.TemporaryDirectory() as td:
+        try:
+            path = m.download_mojo(td)
+        except (NotImplementedError, AttributeError) as e:
+            raise ApiError(400, f"no MOJO for algo '{m.algo}': {e}")
+        data = open(path, "rb").read()
+    return {"__raw": data, "__content_type": "application/zip"}
+
+
+@route("POST", "/3/ParseSVMLight")
+def _parse_svmlight(params, body):
+    """ParseHandler.parseSVMLight: svmlight files → frame (job)."""
+    from h2o3_tpu.ingest.formats import parse_svmlight
+    srcs = _raw_paths(_coerce(params.get("source_frames", "[]")))
+    if not srcs:
+        raise ApiError(400, "source_frames is required")
+    dest = params.get("destination_frame") or dkv.unique_key("svmlight")
+    job = Job("ParseSVMLight")
+    job.dest_key = dest
+
+    def body_fn(j):
+        fr = parse_svmlight(srcs[0])
+        dkv.put(dest, "frame", fr)
+    job.run(body_fn, background=True)
+    return schemas.job_v3(job, dest)
+
+
+@route("GET", "/3/Find")
+def _find(params, body):
+    """water/api/FindHandler: first row >= `row` where `column`
+    matches `match` (value or NA)."""
+    import math as _math
+
+    import numpy as _np
+    key = _coerce(params.get("key"))
+    if isinstance(key, dict):
+        key = key.get("name")
+    fr = dkv.get(str(key), "frame")
+    col = params.get("column")
+    if col not in fr.names:
+        raise ApiError(404, f"column '{col}' not in frame")
+    start = int(params.get("row", 0) or 0)
+    match = params.get("match")
+    v = fr.vec(col)
+    if v.domain is not None or v.type == "str":
+        vals = [None if s is None else str(s)
+                for s in v.to_strings()[: fr.nrow]]
+        hit = next((i for i in range(start, fr.nrow)
+                    if (vals[i] is None if match in (None, "")
+                        else vals[i] == match)), -1)
+    else:
+        a = _np.asarray(v.to_numpy())[: fr.nrow]
+        if v.type == "time":
+            # int64 millis with a sentinel NA (Vec.TIME_NA), not NaN
+            from h2o3_tpu.frame.vec import Vec as _V
+            na = a == _V.TIME_NA
+            if match in (None, ""):
+                idx = _np.nonzero(na[start:])[0]
+            else:
+                idx = _np.nonzero((a[start:] == int(float(match)))
+                                  & ~na[start:])[0]
+        elif match in (None, ""):
+            idx = _np.nonzero(_np.isnan(a[start:]))[0]
+        else:
+            tgt = float(match)
+            idx = _np.nonzero(a[start:] == tgt)[0] if not _math.isnan(tgt) \
+                else _np.nonzero(_np.isnan(a[start:]))[0]
+        hit = int(idx[0]) + start if len(idx) else -1
+    if hit < 0:
+        raise ApiError(404, f"no match for '{match}' in '{col}' from "
+                            f"row {start}")
+    return {"__meta": {"schema_version": 3, "schema_name": "FindV3"},
+            "prev": -1, "next": hit}
+
+
+@route("POST", "/3/MissingInserter")
+def _missing_inserter(params, body):
+    """water/api/MissingInserterHandler: corrupt a fraction of a frame
+    to NAs in place (client test utility h2o.insert_missing_values)."""
+    import numpy as _np
+
+    from h2o3_tpu.frame.vec import Vec
+    key = _coerce(params.get("dataset"))
+    if isinstance(key, dict):
+        key = key.get("name")
+    fr = dkv.get(str(key), "frame")
+    frac = float(params.get("fraction", 0.1) or 0.1)
+    seed = int(params.get("seed", -1) or -1)
+    rng = _np.random.default_rng(None if seed == -1 else seed)
+    job = Job("MissingInserter")
+    job.dest_key = str(key)
+
+    def body_fn(j):
+        from h2o3_tpu.frame.vec import T_ENUM, T_TIME
+        for name in fr.names:
+            v = fr.vec(name)
+            if v.domain is not None:
+                codes = _np.asarray(v.to_numpy(), _np.int32)[: fr.nrow]
+                codes[rng.random(fr.nrow) < frac] = -1
+                fr[name] = Vec.from_numpy(codes, vtype=T_ENUM,
+                                          domain=v.domain)
+            elif v.type == "str":
+                continue              # reference skips string cols too
+            elif v.type == T_TIME:
+                ms = _np.asarray(v.to_numpy(), _np.int64)[: fr.nrow]
+                ms[rng.random(fr.nrow) < frac] = Vec.TIME_NA
+                fr[name] = Vec.from_numpy(ms, vtype=T_TIME)
+            else:
+                a = _np.asarray(v.to_numpy(), _np.float64)[: fr.nrow]
+                a[rng.random(fr.nrow) < frac] = _np.nan
+                fr[name] = Vec.from_numpy(a)
+        dkv.put(str(key), "frame", fr)
+    job.run(body_fn, background=True)
+    return schemas.job_v3(job, str(key))
+
+
+@route("GET", "/99/Rapids/help")
+def _rapids_help(params, body):
+    import re as _re
+
+    import h2o3_tpu.rapids as _r
+    prims = sorted(set(_re.findall(r'if op == "([^"]+)"',
+                                   open(_r.__file__).read())))
+    return {"__meta": {"schema_version": 99,
+                       "schema_name": "RapidsHelpV3"},
+            "syntax": [{"name": p} for p in prims]}
+
+
+@route("GET", "/3/KillMinus3")
+def _kill_minus3(params, body):
+    """water/api/KillMinus3Handler (kill -3 = JVM stack dump): log the
+    aggregated thread stacks, return OK."""
+    from h2o3_tpu.log import info, stack_samples
+    for e in stack_samples(depth=12, samples=1, interval=0.0):
+        info("stack x%d:\n%s", e["count"], e["stacktrace"])
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "KillMinus3V3"}}
+
+
+@route("GET", "/3/WaterMeterCpuTicks/{nodeidx}")
+def _watermeter_cpu(params, body, nodeidx):
+    """water/api/WaterMeterCpuTicksHandler: per-core cpu tick counters
+    (Flow's CPU meter polls this)."""
+    import psutil
+    per = psutil.cpu_times(percpu=True)
+    ticks = [[int(c.user * 100), int(getattr(c, "nice", 0) * 100),
+              int(c.system * 100), int(c.idle * 100)] for c in per]
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "WaterMeterCpuTicksV3"},
+            "cpu_ticks": ticks}
+
+
+@route("GET", "/3/WaterMeterIo")
+@route("GET", "/3/WaterMeterIo/{nodeidx}")
+def _watermeter_io(params, body, nodeidx=None):
+    import psutil
+    io = psutil.disk_io_counters()
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "WaterMeterIoV3"},
+            "persist_stats": [{
+                "backend": "local",
+                "store_bytes": int(getattr(io, "write_bytes", 0)),
+                "load_bytes": int(getattr(io, "read_bytes", 0))}]}
+
+
+@route("GET", "/3/NetworkTest")
+def _network_test(params, body):
+    """water/init/NetworkBench analog: a loopback TCP round-trip +
+    bandwidth microbench (single-host cloud → one matrix cell)."""
+    import socket
+    import time as _t
+    payload = os.urandom(1 << 20)
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    out = {}
+
+    def _echo():
+        conn, _ = srv.accept()
+        with conn:
+            got = 0
+            while got < len(payload):
+                b = conn.recv(1 << 16)
+                if not b:
+                    break
+                got += len(b)
+            conn.sendall(b"ok")
+    t = threading.Thread(target=_echo, daemon=True)
+    t.start()
+    cli = socket.create_connection(("127.0.0.1", port))
+    t0 = _t.time()
+    cli.sendall(payload)
+    cli.recv(2)
+    dt = _t.time() - t0
+    cli.close()
+    srv.close()
+    out["bandwidth_bytes_per_sec"] = len(payload) / max(dt, 1e-9)
+    out["microseconds_collective"] = dt * 1e6
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "NetworkTestV3"},
+            "nodes": ["tpu-controller/0"],
+            "bandwidths_bytes_per_sec": [[out["bandwidth_bytes_per_sec"]]],
+            "microseconds_collective": [out["microseconds_collective"]]}
+
+
+@route("POST", "/3/FeatureInteraction")
+def _feature_interaction_route(params, body):
+    """hex/FeatureInteraction via water/api: pairwise interaction
+    screen for a tree model (h2o-py model.feature_interaction)."""
+    from h2o3_tpu.analytics import feature_interaction
+    m = dkv.get(str(params.get("model_id")), "model")
+    fr = dkv.get(str(params.get("frame") or params.get("frame_id")
+                     or getattr(m, "training_frame_key", None)), "frame")
+    rows = feature_interaction(
+        m, fr, max_pairs=int(params.get("max_interaction_depth", 10)
+                             or 10))
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "FeatureInteractionV3"},
+            "feature_interaction": rows}
+
+
+@route("POST", "/3/SignificantRules")
+def _significant_rules(params, body):
+    """hex/rulefit SignificantRulesHandler: the nonzero-coefficient
+    rule table of a RuleFit model."""
+    m = dkv.get(str(params.get("model_id")), "model")
+    if m.algo != "rulefit":
+        raise ApiError(400, f"model '{m.key}' is {m.algo}, not rulefit")
+    imp = m.rule_importance()
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "SignificantRulesV3"},
+            "significant_rules_table": imp}
+
+
+@route("POST", "/3/Recovery/resume")
+def _recovery_resume(params, body):
+    """hex/faulttolerance/Recovery: after a crash, reload every model
+    artifact a recovery_dir holds back into the DKV (grid manifests +
+    AutoML state files both point at artifacts saved there); training
+    re-issued against the same recovery_dir then resumes from them."""
+    from h2o3_tpu.persist import load_model
+    rdir = params.get("recovery_dir")
+    if not rdir or not os.path.isdir(rdir):
+        raise ApiError(400, f"recovery_dir '{rdir}' does not exist")
+    restored = []
+    for mf in sorted(os.listdir(rdir)):
+        if not mf.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(rdir, mf)) as f:
+                manifest = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            continue
+        arts = manifest.get("completed", {})
+        if isinstance(arts, dict):
+            for art in arts.values():
+                try:
+                    model = load_model(art)
+                    dkv.put(model.key, "model", model)
+                    restored.append(model.key)
+                except Exception:      # noqa: BLE001 - partial restore
+                    continue
+    return {"__meta": {"schema_version": 3, "schema_name": "RecoveryV3"},
+            "restored_models": restored}
+
+
+@route("POST", "/99/DCTTransformer")
+def _dct_transformer(params, body):
+    """util/DCTTransformer (TabToDct): per-row 2D DCT-II of
+    [height x width x depth]-shaped rows. TPU re-design: the DCT is two
+    dense cosine-matrix matmuls (MXU) instead of a per-chunk FFT."""
+    import jax.numpy as jnp
+    import numpy as _np
+    key = _coerce(params.get("dataset"))
+    if isinstance(key, dict):
+        key = key.get("name")
+    fr = dkv.get(str(key), "frame")
+    dims = _coerce(params.get("dimensions", "[0,0,1]")) or [0, 0, 1]
+    h, w_, d = (int(dims[0]) or 1), (int(dims[1]) or 1), (int(dims[2])
+                                                          or 1)
+    if h * w_ * d != fr.ncol:
+        raise ApiError(400, f"dimensions {dims} do not multiply to "
+                            f"ncol={fr.ncol}")
+    dest = params.get("destination_frame") or dkv.unique_key("dct")
+
+    def dct_mat(n):
+        k = _np.arange(n)[:, None]
+        i = _np.arange(n)[None, :]
+        M = _np.sqrt(2.0 / n) * _np.cos(_np.pi * (2 * i + 1) * k /
+                                        (2.0 * n))
+        M[0] *= 1.0 / _np.sqrt(2.0)
+        return jnp.asarray(M, jnp.float32)
+
+    job = Job("DCTTransformer")
+    job.dest_key = dest
+
+    def body_fn(j):
+        from h2o3_tpu.frame.frame import Frame
+        from h2o3_tpu.frame.vec import Vec
+        X = jnp.asarray(_np.nan_to_num(_np.asarray(
+            fr.as_matrix()))[: fr.nrow]).reshape(fr.nrow, h, w_, d)
+        Dh, Dw = dct_mat(h), dct_mat(w_)
+        # rows x [h, w, d] -> DCT over h and w axes per depth slice
+        Y = jnp.einsum("ab,rbwd->rawd", Dh, X)
+        Z = jnp.einsum("cw,rawd->racd", Dw, Y)
+        out = _np.asarray(Z.reshape(fr.nrow, -1))
+        names = [f"C{i + 1}" for i in range(out.shape[1])]
+        dkv.put(dest, "frame", Frame(
+            names,
+            [Vec.from_numpy(out[:, i]) for i in range(out.shape[1])]))
+    job.run(body_fn, background=True)
+    return schemas.job_v3(job, dest)
+
+
+_NPS_ROOT = os.path.join(tempfile.gettempdir(), "h2o3_nps")
+
+
+def _nps_path(cat: str, name: str = None) -> str:
+    """Traversal-safe NPS path: route segments arrive URL-DECODED, so
+    '..%2F..' style names must be rejected on every verb, not just
+    POST."""
+    for part in (cat,) + ((name,) if name is not None else ()):
+        if (not part or "/" in part or "\\" in part or ".." in part
+                or os.path.isabs(part)):
+            raise ApiError(400, f"invalid category/name '{part}'")
+    return os.path.join(_NPS_ROOT, cat, *((name,) if name is not None
+                                          else ()))
+
+
+@route("GET", "/3/NodePersistentStorage/configured")
+def _nps_configured(params, body):
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "NodePersistentStorageV3"},
+            "configured": True}
+
+
+@route("GET", "/3/NodePersistentStorage/categories/{cat}/exists")
+def _nps_cat_exists(params, body, cat):
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "NodePersistentStorageV3"},
+            "exists": os.path.isdir(_nps_path(cat))}
+
+
+@route("GET",
+       "/3/NodePersistentStorage/categories/{cat}/names/{name}/exists")
+def _nps_exists(params, body, cat, name):
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "NodePersistentStorageV3"},
+            "exists": os.path.isfile(_nps_path(cat, name))}
+
+
+@route("GET", "/3/NodePersistentStorage/{cat}")
+def _nps_list(params, body, cat):
+    """water/api/NodePersistentStorageHandler (Flow stores notebooks
+    here): list entries of a category."""
+    d = _nps_path(cat)
+    entries = []
+    if os.path.isdir(d):
+        for n in sorted(os.listdir(d)):
+            p = os.path.join(d, n)
+            entries.append({"name": n, "size": os.path.getsize(p),
+                            "timestamp_millis": int(
+                                os.path.getmtime(p) * 1000)})
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "NodePersistentStorageV3"},
+            "category": cat, "entries": entries}
+
+
+@route("GET", "/3/NodePersistentStorage/{cat}/{name}")
+def _nps_get(params, body, cat, name):
+    p = _nps_path(cat, name)
+    if not os.path.isfile(p):
+        raise ApiError(404, f"no NPS entry {cat}/{name}")
+    return {"__raw": open(p, "rb").read(),
+            "__content_type": "application/octet-stream"}
+
+
+@route("POST", "/3/NodePersistentStorage/{cat}/{name}")
+def _nps_put(params, body, cat, name):
+    d = _nps_path(cat)
+    _nps_path(cat, name)
+    os.makedirs(d, exist_ok=True)
+    data = body if isinstance(body, (bytes, bytearray)) else \
+        (params.get("value") or "").encode()
+    with open(os.path.join(d, name), "wb") as f:
+        f.write(data or b"")
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "NodePersistentStorageV3"},
+            "category": cat, "name": name}
+
+
+@route("DELETE", "/3/NodePersistentStorage/{cat}/{name}")
+def _nps_delete(params, body, cat, name):
+    p = _nps_path(cat, name)
+    if os.path.isfile(p):
+        os.unlink(p)
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "NodePersistentStorageV3"}}
+
+
+@route("POST", "/99/ImportSQLTable")
+def _import_sql_table_route(params, body):
+    """water/jdbc/SQLManager route (h2o.import_sql_table): DB-API
+    import. sqlite:///path URLs work out of the box (stdlib driver);
+    other engines need their driver package installed."""
+    from h2o3_tpu.ingest.sql import import_sql_table
+    url = params.get("connection_url") or ""
+    table = params.get("table")
+    if not table:
+        raise ApiError(400, "table is required")
+    if url.startswith(("sqlite:///", "jdbc:sqlite:")):
+        if url.startswith("jdbc:"):
+            # jdbc:sqlite:/abs/path or jdbc:sqlite:rel.db — verbatim
+            dbpath = url[len("jdbc:sqlite:"):]
+        else:
+            # sqlite:///abs/path (3 slashes = absolute, SQLAlchemy form)
+            dbpath = "/" + url[len("sqlite:///"):]
+        import sqlite3
+
+        def factory():
+            return sqlite3.connect(dbpath)
+    else:
+        raise ApiError(501, f"no DB-API driver wired for '{url}' in "
+                            f"this image (sqlite:/// is built in)")
+    cols = _coerce(params.get("columns", "null"))
+    dest = params.get("destination_frame") or dkv.unique_key("sql")
+    job = Job("ImportSQLTable")
+    job.dest_key = dest
+
+    def body_fn(j):
+        fr = import_sql_table(factory, table, columns=cols or None)
+        dkv.put(dest, "frame", fr)
+    job.run(body_fn, background=True)
+    return schemas.job_v3(job, dest)
+
+
+@route("POST", "/99/Sample")
+def _sample_frame(params, body):
+    """99/Sample: uniform row sample of a frame into a new key."""
+    import numpy as _np
+    key = _coerce(params.get("dataset"))
+    if isinstance(key, dict):
+        key = key.get("name")
+    fr = dkv.get(str(key), "frame")
+    n = int(params.get("rows", 0) or 0)
+    if n <= 0 or n >= fr.nrow:
+        raise ApiError(400, f"rows must be in (0, {fr.nrow})")
+    seed = int(params.get("seed", -1) or -1)
+    rng = _np.random.default_rng(None if seed == -1 else seed)
+    sel = _np.sort(rng.choice(fr.nrow, size=n, replace=False))
+    sub = fr.rows(sel)
+    dest = params.get("destination_frame") or dkv.unique_key("sample")
+    dkv.put(dest, "frame", sub)
+    return {"__meta": {"schema_version": 99, "schema_name": "SampleV3"},
+            "destination_frame": dest, "rows": n}
+
+
+@route("POST", "/3/ImportHiveTable")
+@route("POST", "/3/SaveToHiveTable")
+def _hive_gate(params, body):
+    raise ApiError(501, "Hive import/export needs a Hive metastore + "
+                        "HDFS environment this image does not ship "
+                        "(reference: h2o-hive); use JDBC "
+                        "(/99/ImportSQLTable) or file ingest instead")
+
+
+@route("POST", "/3/DecryptionSetup")
+def _decryption_gate(params, body):
+    raise ApiError(501, "encrypted-file ingest (water/parser/"
+                        "DecryptionTool) is not wired in this build; "
+                        "decrypt files before import")
+
+
+@route("GET", "/3/h2o-genmodel.jar")
+def _genmodel_jar(params, body):
+    raise ApiError(501, "h2o-genmodel.jar is a JVM artifact this "
+                        "TPU-native build does not ship; score POJO/"
+                        "MOJO artifacts with h2o3_tpu.genmodel "
+                        "(EasyPredict) or pass get_jar=False to "
+                        "download_pojo")

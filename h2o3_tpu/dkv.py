@@ -168,3 +168,10 @@ class Scope:
         for k in created:
             remove(k)
         return False
+
+
+def unlock_everything() -> None:
+    """Admin escape hatch (water/api/UnlockKeysHandler → Lockable
+    unlock-all): drop every read and write lock regardless of holder."""
+    with _LOCK:
+        _LOCKERS.clear()

@@ -1,0 +1,336 @@
+"""Round-5 REST breadth batch 2 — the remaining RegisterV3Api.java
+registrations with real machinery behind them: Ping/InitID/CloudLock/
+UnlockKeys/SessionProperties, Metadata lists, Frames column subroutes +
+export, make_metrics from frames, POJO/MOJO downloads, ParseSVMLight,
+Find, MissingInserter, Rapids help, WaterMeter, NetworkTest,
+FeatureInteraction, SignificantRules, Recovery/resume, DCTTransformer,
+NodePersistentStorage, ImportSQLTable (sqlite), Sample, hive gates."""
+import json
+import os
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu import dkv
+from h2o3_tpu.api.server import H2OApiServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = H2OApiServer(port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _req(server, method, path, data=None, raw=False):
+    url = f"http://127.0.0.1:{server.port}{path}"
+    body = None
+    headers = {}
+    if data is not None:
+        body = urllib.parse.urlencode(
+            {k: (json.dumps(v) if isinstance(v, (list, dict)) else v)
+             for k, v in data.items()}).encode()
+        headers["Content-Type"] = "application/x-www-form-urlencoded"
+    req = urllib.request.Request(url, data=body, method=method,
+                                 headers=headers)
+    with urllib.request.urlopen(req) as resp:
+        payload = resp.read()
+    return payload if raw else json.loads(payload.decode())
+
+
+def _poll(server, job_key, timeout=60):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        j = _req(server, "GET",
+                 f"/3/Jobs/{urllib.parse.quote(job_key)}")["jobs"][0]
+        if j["status"] in ("DONE", "FAILED", "CANCELLED"):
+            assert j["status"] == "DONE", j
+            return
+        time.sleep(0.1)
+    raise TimeoutError(job_key)
+
+
+@pytest.fixture(scope="module")
+def frame():
+    rng = np.random.default_rng(2)
+    n = 300
+    fr = h2o.Frame.from_numpy({
+        "num": rng.normal(size=n),
+        "cat": np.array(["a", "b"], dtype=object)[
+            rng.integers(0, 2, n)],
+        "y": (rng.random(n) < 0.4).astype(np.float64)})
+    dkv.put("b2.hex", "frame", fr)
+    return fr
+
+
+def test_admin_misc(server):
+    assert _req(server, "GET", "/3/Ping")["cloud_healthy"] is True
+    sid = _req(server, "GET", "/3/InitID")["session_key"]
+    assert sid.startswith("_sid_")
+    assert _req(server, "GET", "/3/CloudLock")["locked"] is True
+    _req(server, "POST", "/3/UnlockKeys", {})
+    _req(server, "POST", "/3/SessionProperties",
+         {"key": "k1", "value": "v1"})
+    assert _req(server, "GET",
+                "/3/SessionProperties?key=k1")["value"] == "v1"
+    caps = _req(server, "GET", "/3/Capabilities/API")["capabilities"]
+    assert len(caps) > 80
+    schemas_l = _req(server, "GET", "/3/Metadata/schemas")["schemas"]
+    assert any(s["name"] == "FramesV3" for s in schemas_l)
+    ep0 = _req(server, "GET", "/3/Metadata/endpoints/0")["routes"][0]
+    assert ep0["url_pattern"]
+
+
+def test_frame_subroutes_and_export(server, frame, tmp_path):
+    cols = _req(server, "GET",
+                "/3/Frames/b2.hex/columns")["frames"][0]["columns"]
+    assert cols == ["num", "cat", "y"]
+    one = _req(server, "GET", "/3/Frames/b2.hex/columns/num")
+    assert one["frames"][0]["columns"][0]["label"] == "num"
+    summ = _req(server, "GET",
+                "/3/Frames/b2.hex/columns/num/summary")
+    assert "mean" in summ["frames"][0]["columns"][0]
+    dom = _req(server, "GET", "/3/Frames/b2.hex/columns/cat/domain")
+    assert dom["domain"][0] == ["a", "b"]
+    light = _req(server, "GET", "/3/Frames/b2.hex/light")
+    assert light["frames"]
+    dest = str(tmp_path / "out.csv")
+    out = _req(server, "POST", "/3/Frames/b2.hex/export",
+               {"path": dest, "force": "true"})
+    _poll(server, out["key"]["name"])
+    assert os.path.exists(dest) and open(dest).readline().count(",") == 2
+
+
+def test_make_metrics_from_frames(server, frame):
+    """h2o.make_metrics: predictions + actuals frames, no model."""
+    rng = np.random.default_rng(3)
+    n = frame.nrow
+    y = np.asarray(frame.vec("y").to_numpy())[:n]
+    p1 = np.clip(0.7 * y + 0.3 * rng.random(n), 0.001, 0.999)
+    pf = h2o.Frame.from_numpy({"p0": 1 - p1, "p1": p1})
+    af = h2o.Frame.from_numpy(
+        {"y": np.array(["no", "yes"], dtype=object)[y.astype(int)]})
+    dkv.put("b2pred", "frame", pf)
+    dkv.put("b2act", "frame", af)
+    out = _req(server, "POST",
+               "/3/ModelMetrics/predictions_frame/b2pred"
+               "/actuals_frame/b2act", {"domain": ["no", "yes"]})
+    mm = out["model_metrics"]
+    assert 0.8 < mm["AUC"] <= 1.0
+    # regression flavor
+    pf2 = h2o.Frame.from_numpy({"pred": y + 0.1 * rng.random(n)})
+    af2 = h2o.Frame.from_numpy({"act": y.astype(np.float64)})
+    dkv.put("b2pred2", "frame", pf2)
+    dkv.put("b2act2", "frame", af2)
+    out2 = _req(server, "POST",
+                "/3/ModelMetrics/predictions_frame/b2pred2"
+                "/actuals_frame/b2act2", {})
+    assert out2["model_metrics"]["MSE"] < 0.02
+    listed = _req(server, "GET", "/3/ModelMetrics")
+    assert "model_metrics" in listed
+
+
+def test_pojo_mojo_download(server, frame):
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    gbm = H2OGradientBoostingEstimator(ntrees=3, max_depth=3, seed=1)
+    gbm.train(y="y", training_frame=frame)
+    gbm.model.key = "b2_gbm"
+    dkv.put("b2_gbm", "model", gbm.model)
+    src = _req(server, "GET", "/3/Models.java/b2_gbm", raw=True)
+    assert b"class" in src and b"score0" in src
+    prev = _req(server, "GET", "/3/Models.java/b2_gbm/preview",
+                raw=True)
+    assert len(prev) <= 4096
+    mojo = _req(server, "GET", "/3/Models/b2_gbm/mojo", raw=True)
+    assert mojo[:2] == b"PK"          # zip magic
+    mojo2 = _req(server, "GET", "/99/Models.mojo/b2_gbm", raw=True)
+    assert mojo2[:2] == b"PK"
+
+
+def test_find_sample_missing_inserter(server, frame, tmp_path):
+    hit = _req(server, "GET",
+               "/3/Find?key=b2.hex&column=cat&match=b&row=0")
+    assert hit["next"] >= 0
+    with pytest.raises(urllib.error.HTTPError):
+        _req(server, "GET",
+             "/3/Find?key=b2.hex&column=cat&match=zz&row=0")
+    out = _req(server, "POST", "/99/Sample",
+               {"dataset": "b2.hex", "rows": 50, "seed": 1})
+    sub = dkv.get(out["destination_frame"], "frame")
+    assert sub.nrow == 50
+    # MissingInserter corrupts in place
+    rng = np.random.default_rng(0)
+    dkv.put("b2mi", "frame", h2o.Frame.from_numpy(
+        {"a": rng.normal(size=400)}))
+    job = _req(server, "POST", "/3/MissingInserter",
+               {"dataset": "b2mi", "fraction": 0.3, "seed": 5})
+    _poll(server, job["key"]["name"])
+    a = np.asarray(dkv.get("b2mi", "frame").vec("a").to_numpy())[:400]
+    assert 0.2 < np.isnan(a).mean() < 0.4
+
+
+def test_svmlight_and_sql(server, tmp_path):
+    p = tmp_path / "t.svm"
+    p.write_text("1 1:0.5 3:2.0\n0 2:1.5\n1 1:1.0 2:0.5 3:1.0\n")
+    out = _req(server, "POST", "/3/ParseSVMLight",
+               {"source_frames": [str(p)],
+                "destination_frame": "svm.hex"})
+    _poll(server, out["key"]["name"])
+    fr = dkv.get("svm.hex", "frame")
+    assert fr.nrow == 3 and fr.ncol == 4
+    # sqlite import
+    import sqlite3
+    db = tmp_path / "t.db"
+    con = sqlite3.connect(db)
+    con.execute("CREATE TABLE t (id INTEGER, v REAL)")
+    con.executemany("INSERT INTO t VALUES (?, ?)",
+                    [(i, i * 0.5) for i in range(20)])
+    con.commit()
+    con.close()
+    out = _req(server, "POST", "/99/ImportSQLTable",
+               {"connection_url": f"sqlite://{db}", "table": "t",
+                "destination_frame": "sql.hex"})
+    _poll(server, out["key"]["name"])
+    fr2 = dkv.get("sql.hex", "frame")
+    assert fr2.nrow == 20 and "v" in fr2.names
+
+
+def test_analytics_routes(server, frame):
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    gbm = H2OGradientBoostingEstimator(ntrees=3, max_depth=3, seed=1)
+    gbm.train(y="y", training_frame=frame)
+    gbm.model.key = "b2_fi"
+    dkv.put("b2_fi", "model", gbm.model)
+    fi = _req(server, "POST", "/3/FeatureInteraction",
+              {"model_id": "b2_fi", "frame": "b2.hex"})
+    assert "feature_interaction" in fi
+    from h2o3_tpu.models.rulefit import H2ORuleFitEstimator
+    rf = H2ORuleFitEstimator(max_num_rules=20, seed=1,
+                             max_rule_length=3)
+    rf.train(y="y", training_frame=frame)
+    rf.model.key = "b2_rf"
+    dkv.put("b2_rf", "model", rf.model)
+    sr = _req(server, "POST", "/3/SignificantRules",
+              {"model_id": "b2_rf"})
+    assert "significant_rules_table" in sr
+
+
+def test_recovery_resume(server, frame, tmp_path):
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    from h2o3_tpu.models.grid import H2OGridSearch
+    rdir = str(tmp_path / "rec")
+    grid = H2OGridSearch(
+        H2OGradientBoostingEstimator(ntrees=2, max_depth=2, seed=1),
+        hyper_params={"learn_rate": [0.1, 0.3]}, grid_id="b2grid",
+        recovery_dir=rdir)
+    grid.train(y="y", training_frame=frame)
+    # wipe DKV models, then restore from the recovery dir over REST
+    for m in grid.models:
+        dkv.remove(m.key)
+    out = _req(server, "POST", "/3/Recovery/resume",
+               {"recovery_dir": rdir})
+    assert len(out["restored_models"]) == 2
+    assert dkv.get(out["restored_models"][0], "model") is not None
+
+
+def test_dct_transformer(server):
+    import scipy.fft
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(10, 16)).astype(np.float64)
+    dkv.put("dct.hex", "frame", h2o.Frame.from_numpy(
+        {f"c{i}": X[:, i] for i in range(16)}))
+    out = _req(server, "POST", "/99/DCTTransformer",
+               {"dataset": "dct.hex", "dimensions": [4, 4, 1],
+                "destination_frame": "dct.out"})
+    _poll(server, out["key"]["name"])
+    got = dkv.get("dct.out", "frame").to_numpy()[:10]
+    want = scipy.fft.dctn(X.reshape(10, 4, 4), axes=(1, 2),
+                          norm="ortho").reshape(10, 16)
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_nps_and_watermeter(server):
+    _req(server, "POST", "/3/NodePersistentStorage/notebook/flow1",
+         {"value": "{\"cells\": []}"})
+    assert _req(server, "GET",
+                "/3/NodePersistentStorage/categories/notebook/names/"
+                "flow1/exists")["exists"] is True
+    lst = _req(server, "GET", "/3/NodePersistentStorage/notebook")
+    assert any(e["name"] == "flow1" for e in lst["entries"])
+    raw = _req(server, "GET",
+               "/3/NodePersistentStorage/notebook/flow1", raw=True)
+    assert b"cells" in raw
+    _req(server, "DELETE", "/3/NodePersistentStorage/notebook/flow1")
+    assert _req(server, "GET",
+                "/3/NodePersistentStorage/categories/notebook/names/"
+                "flow1/exists")["exists"] is False
+    ticks = _req(server, "GET", "/3/WaterMeterCpuTicks/0")["cpu_ticks"]
+    assert ticks and len(ticks[0]) == 4
+    io = _req(server, "GET", "/3/WaterMeterIo")
+    assert io["persist_stats"]
+    nt = _req(server, "GET", "/3/NetworkTest")
+    assert nt["bandwidths_bytes_per_sec"][0][0] > 1e6
+
+
+def test_hive_and_decryption_gates(server):
+    for path in ("/3/ImportHiveTable", "/3/SaveToHiveTable",
+                 "/3/DecryptionSetup"):
+        try:
+            _req(server, "POST", path, {})
+            raise AssertionError("expected 501")
+        except urllib.error.HTTPError as e:
+            assert e.code == 501
+            msg = json.loads(e.read().decode())["msg"]
+            assert "image" in msg or "not wired" in msg
+
+
+def test_killminus3_and_rapids_help(server):
+    _req(server, "GET", "/3/KillMinus3")
+    prims = _req(server, "GET", "/99/Rapids/help")["syntax"]
+    names = {p["name"] for p in prims}
+    assert {"tf-idf", "strsplit", "sort"} <= names
+
+
+def test_nps_traversal_rejected(server):
+    """URL-encoded traversal must 400 on every NPS verb (the route
+    regex matches encoded segments, then decodes — '..%2F..' arrives
+    as a '../..' name)."""
+    for verb, path in (
+            ("GET", "/3/NodePersistentStorage/notebook/..%2F..%2Fetc"
+                    "%2Fpasswd"),
+            ("GET", "/3/NodePersistentStorage/..%2F.."),
+            ("DELETE", "/3/NodePersistentStorage/notebook/%2Fetc"
+                       "%2Fpasswd"),
+            ("GET", "/3/NodePersistentStorage/categories/notebook/"
+                    "names/..%2Fx/exists")):
+        try:
+            _req(server, verb, path)
+            raise AssertionError(f"{verb} {path} should 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400, (verb, path, e.code)
+
+
+def test_light_is_real_framev3(server, frame):
+    light = _req(server, "GET", "/3/Frames/b2.hex/light")["frames"][0]
+    assert light["rows"] == frame.nrow
+    assert [c["label"] for c in light["columns"]] == ["num", "cat", "y"]
+
+
+def test_make_metrics_negative_regression(server):
+    """negative actuals are DATA in regression — no clamping, no
+    sentinel weighting."""
+    y = np.array([-2.5, -1.0, 3.0, -0.5])
+    pf = h2o.Frame.from_numpy({"pred": y + 0.1})
+    af = h2o.Frame.from_numpy({"act": y})
+    dkv.put("b2negp", "frame", pf)
+    dkv.put("b2nega", "frame", af)
+    out = _req(server, "POST",
+               "/3/ModelMetrics/predictions_frame/b2negp"
+               "/actuals_frame/b2nega", {})
+    assert abs(out["model_metrics"]["MSE"] - 0.01) < 1e-6
