@@ -2386,3 +2386,39 @@ def _genmodel_jar(params, body):
                         "MOJO artifacts with h2o3_tpu.genmodel "
                         "(EasyPredict) or pass get_jar=False to "
                         "download_pojo")
+
+
+@route("POST", "/99/Assembly")
+def _assembly_fit(params, body):
+    """water/api/AssemblyHandler.fit: replay munging steps (h2o-py
+    H2OAssembly.fit) against a frame; returns assembly + result keys."""
+    from h2o3_tpu.assembly import Assembly, parse_steps
+    steps = parse_steps(params.get("steps") or "[]")
+    fkey = str(params.get("frame"))
+    try:
+        fr = dkv.get(fkey, "frame")
+    except KeyError:
+        raise ApiError(404, f"frame '{fkey}' not found")
+    akey = dkv.unique_key("assembly")
+    asm = Assembly(akey, steps)
+    out = asm.fit(fr)
+    rkey = dkv.unique_key("assembly_result")
+    dkv.put(rkey, "frame", out)
+    dkv.put(akey, "assembly", asm)
+    return {"__meta": {"schema_version": 99, "schema_name": "AssemblyV99"},
+            "assembly": {"name": akey, "type": "Key<Assembly>"},
+            "result": {"name": rkey, "type": "Key<Frame>"}}
+
+
+@route("GET", "/99/Assembly.java/{aid}/{pojo_name}")
+def _assembly_java(params, body, aid, pojo_name):
+    """AssemblyHandler.toJava: the munging POJO source."""
+    try:
+        asm = dkv.get(aid, "assembly")
+    except KeyError:
+        raise ApiError(404, f"assembly '{aid}' not found")
+    try:
+        src = asm.to_java(pojo_name)
+    except NotImplementedError as e:
+        raise ApiError(501, str(e))
+    return {"__raw": src.encode(), "__content_type": "text/java"}

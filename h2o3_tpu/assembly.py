@@ -1,0 +1,205 @@
+"""H2OAssembly — server-side munging pipeline (fit + POJO export).
+
+Reference: water/rapids/transforms/{Transform,H2OColSelect,H2OColOp,
+H2OBinaryOp}.java + water/api/AssemblyHandler — the client
+(h2o-py/h2o/assembly.py:388) POSTs steps serialized as
+``name__Class__(ast with the frame id 'dummy')__inplace__new|names``
+and the server replays each step's Rapids ast against the live frame,
+with H2OColOp splicing the single-column result back per `inplace`
+(H2OColOp.java:48-68 transformImpl).
+
+TPU re-design: the step asts run through this repo's Rapids engine
+(device ops); the `dummy` placeholder is rewritten to a per-fit unique
+DKV key (concurrent fits must not race a shared binding). POJO export (GenMunger analog) emits Java for the transform
+subset with a closed Java form (column select, unary Math ops); ops
+outside it raise with the op named — an honest gate, not a stub.
+"""
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+from h2o3_tpu import dkv
+from h2o3_tpu.frame.frame import Frame
+
+# unary rapids op -> java Math expression template (GenMunger subset)
+_JAVA_UNARY = {
+    "cos": "Math.cos(v)", "sin": "Math.sin(v)", "tan": "Math.tan(v)",
+    "log": "Math.log(v)", "exp": "Math.exp(v)", "sqrt": "Math.sqrt(v)",
+    "abs": "Math.abs(v)", "floor": "Math.floor(v)",
+    "ceiling": "Math.ceil(v)", "cosh": "Math.cosh(v)",
+    "sinh": "Math.sinh(v)", "tanh": "Math.tanh(v)",
+}
+
+
+class AssemblyStep:
+    def __init__(self, raw: str):
+        parts = raw.split("__", 4)
+        if len(parts) != 5:
+            raise ValueError(f"malformed assembly step '{raw}'")
+        self.name, self.cls, self.ast, inplace, newc = parts
+        self.inplace = str(inplace).lower() == "true"
+        self.new_names: Optional[List[str]] = \
+            None if newc in ("|", "") else newc.split("|")
+
+    def old_col(self) -> Optional[str]:
+        """The operated-on column: first (cols_py dummy 'col') in the
+        ast (H2OColOp.java findOldName)."""
+        m = re.search(r"\(cols_py\s+dummy\s+'([^']+)'\)", self.ast) or \
+            re.search(r'\(cols_py\s+dummy\s+"([^"]+)"\)', self.ast)
+        return m.group(1) if m else None
+
+
+class Assembly:
+    def __init__(self, key: str, steps: List[AssemblyStep]):
+        self.key = key
+        self.steps = steps
+
+    def fit(self, frame: Frame) -> Frame:
+        from h2o3_tpu.rapids import exec_rapids
+        # shallow copy: steps splice columns into f, and the input frame
+        # (a live DKV key) must not be mutated through the shared object
+        f = Frame(list(frame.names), list(frame.vecs))
+        for step in self.steps:
+            # per-fit placeholder key: binding the literal 'dummy' would
+            # race concurrent fits on the threading server and clobber a
+            # user frame of that name — rewrite the ast instead
+            ph = dkv.unique_key("_asm_ph")
+            ast = re.sub(r"\bdummy\b", ph, step.ast)
+            dkv.put(ph, "frame", f)
+            try:
+                res = exec_rapids(ast)
+            finally:
+                dkv.remove(ph)
+            out = res.get("key")
+            rf = dkv.get(out["name"], "frame") if out else None
+            if out:
+                dkv.remove(out["name"])  # intermediate; f keeps the vecs
+            if rf is None:
+                raise ValueError(f"step '{step.name}' did not produce "
+                                 f"a frame")
+            if step.cls == "H2OColSelect":
+                f = rf
+                continue
+            old = step.old_col()
+            if rf.ncol > 1:
+                names = step.new_names or [
+                    _uniquify(f, old or "C", i) for i in range(rf.ncol)]
+                for i, n in enumerate(names[: rf.ncol]):
+                    f[n] = rf.vec(i)
+                if step.inplace and old in f.names:
+                    f = f.drop(old)
+            elif step.inplace:
+                f[old] = rf.vec(0)
+            else:
+                n = (step.new_names[0] if step.new_names
+                     else _uniquify(f, old or "C", 0))
+                f[n] = rf.vec(0)
+        return f
+
+    def to_java(self, class_name: str) -> str:
+        """GenMunger POJO: per-row double[] transform for the closed
+        subset (select + unary Math col ops)."""
+        body = []
+        for s in self.steps:
+            if s.cls == "H2OColSelect":
+                cols = re.findall(r"'([^']+)'", s.ast)
+                jlist = ", ".join(f'"{c}"' for c in cols)
+                body.append(f"    // step {s.name}: select {cols}")
+                body.append(f"    row = select(row, names, "
+                            f"new String[]{{{jlist}}});")
+                # row is re-indexed by keep[] — names must follow, or
+                # later column lookups hit stale positions
+                body.append(f"    names = new String[]{{{jlist}}};")
+                continue
+            op = s.ast.strip("( ").split()[0]
+            if op not in _JAVA_UNARY:
+                raise NotImplementedError(
+                    f"POJO export for op '{op}' is not in the closed "
+                    f"GenMunger subset ({sorted(_JAVA_UNARY)}); score "
+                    f"through the REST pipeline instead")
+            col = s.old_col()
+            body.append(f"    // step {s.name}: {op}({col}) "
+                        f"inplace={s.inplace}")
+            if s.inplace:
+                body.append(f"    row = unaryInplace(row, names, "
+                            f"\"{col}\", \"{op}\");")
+            else:
+                newn = (s.new_names[0] if s.new_names
+                        else f"{col}_{op}")
+                body.append(f"    row = appendUnary(row, names, "
+                            f"\"{col}\", \"{op}\");")
+                body.append(f"    names = appendName(names, "
+                            f"\"{newn}\");")
+        steps_src = "\n".join(body)
+        return _JAVA_TEMPLATE.format(cls=class_name, steps=steps_src,
+                                     ops="\n".join(
+                                         f'      case "{k}": return '
+                                         f'{v};'
+                                         for k, v in
+                                         _JAVA_UNARY.items()))
+
+
+def _uniquify(f: Frame, base: str, i: int) -> str:
+    cand = f"{base}{i}" if i else base
+    while cand in f.names:
+        cand += "0"
+    return cand
+
+
+_JAVA_TEMPLATE = """// Generated munging POJO (water/rapids/transforms GenMunger analog)
+public class {cls} {{
+  public static double[] transform(double[] row, String[] names) {{
+{steps}
+    return row;
+  }}  // names evolves locally when steps append columns
+
+  static double[] select(double[] row, String[] names, String[] keep) {{
+    double[] out = new double[keep.length];
+    for (int i = 0; i < keep.length; i++)
+      for (int j = 0; j < names.length; j++)
+        if (names[j].equals(keep[i])) out[i] = row[j];
+    return out;
+  }}
+
+  static double[] unaryInplace(double[] row, String[] names,
+                               String col, String op) {{
+    for (int j = 0; j < names.length; j++)
+      if (names[j].equals(col)) row[j] = apply(op, row[j]);
+    return row;
+  }}
+
+  static double[] appendUnary(double[] row, String[] names, String col,
+                              String op) {{
+    double v = Double.NaN;
+    for (int j = 0; j < names.length; j++)
+      if (names[j].equals(col)) v = row[j];
+    double[] out = new double[row.length + 1];
+    System.arraycopy(row, 0, out, 0, row.length);
+    out[row.length] = apply(op, v);
+    return out;
+  }}
+
+  static String[] appendName(String[] names, String n) {{
+    String[] out = new String[names.length + 1];
+    System.arraycopy(names, 0, out, 0, names.length);
+    out[names.length] = n;
+    return out;
+  }}
+
+  static double apply(String op, double v) {{
+    switch (op) {{
+{ops}
+      default: throw new IllegalArgumentException(op);
+    }}
+  }}
+}}
+"""
+
+
+def parse_steps(steps_param) -> List[AssemblyStep]:
+    import json
+    steps = steps_param
+    if isinstance(steps, str):
+        steps = json.loads(steps)
+    return [AssemblyStep(s) for s in steps]

@@ -36,14 +36,17 @@ from h2o3_tpu.frame.vec import T_ENUM, T_INT, T_REAL, T_STR, Vec
 # ---------------- tokenizer / parser -----------------------------------
 
 _TOKEN = re.compile(r"""
-    \s*(?:
+    [\s,]*(?:
         (?P<lparen>\()
       | (?P<rparen>\))
       | (?P<lbrack>\[)
       | (?P<rbrack>\])
       | (?P<string>"(?:\\.|[^"\\])*"|'(?:\\.|[^'\\])*')
-      | (?P<atom>[^\s()\[\]'"]+)
+      | (?P<atom>[^\s,()\[\]'"]+)
     )""", re.VERBOSE)
+# commas are separators (python-repr lists like ['a', 'b'] arrive from
+# the client's Assembly step serialization; bare Rapids never needs a
+# literal comma token)
 
 
 class Slice:

@@ -334,3 +334,51 @@ def test_make_metrics_negative_regression(server):
                "/3/ModelMetrics/predictions_frame/b2negp"
                "/actuals_frame/b2nega", {})
     assert abs(out["model_metrics"]["MSE"] - 0.01) < 1e-6
+
+
+def test_assembly_real_client(server):
+    """The UNMODIFIED h2o-py H2OAssembly (pipeline munging) against the
+    live server: col-select + inplace cos + countmatches, the
+    reference docstring example shape (h2o-py/h2o/assembly.py)."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import h2opy_shim
+    h2opy_shim.install()
+    sys.path.insert(0, "/root/reference/h2o-py")
+    import h2o as h2opy
+    from h2o.assembly import H2OAssembly
+    from h2o.frame import H2OFrame
+    from h2o.transforms.preprocessing import H2OColOp, H2OColSelect
+    h2opy.connect(url=f"http://127.0.0.1:{server.port}", verbose=False)
+    import pandas as pd
+    rng = np.random.default_rng(1)
+    df = pd.DataFrame({
+        "slen": rng.uniform(4, 8, 40),
+        "plen": rng.uniform(1, 6, 40),
+        "extra": rng.normal(size=40),
+        "species": ["setosa", "versicolor"] * 20})
+    fr = H2OFrame(df, column_types=["numeric", "numeric", "numeric",
+                                    "string"])
+    asm = H2OAssembly(steps=[
+        ("select", H2OColSelect(["slen", "plen", "species"])),
+        ("cos_slen", H2OColOp(op=H2OFrame.cos, col="slen",
+                              inplace=True)),
+        ("cnt_s", H2OColOp(op=H2OFrame.countmatches, col="species",
+                           inplace=False, pattern="s"))])
+    res = asm.fit(fr)
+    assert res.ncol == 4
+    got = res.as_data_frame()
+    np.testing.assert_allclose(got["slen"], np.cos(df["slen"]),
+                               atol=1e-5)
+    counts = got[got.columns[-1]].to_numpy()
+    want = df["species"].str.count("s").to_numpy()
+    np.testing.assert_array_equal(counts[: len(want)], want)
+    # POJO export for the Math-subset pipeline
+    asm2 = H2OAssembly(steps=[
+        ("select", H2OColSelect(["slen", "plen"])),
+        ("cos_slen", H2OColOp(op=H2OFrame.cos, col="slen",
+                              inplace=True))])
+    asm2.fit(fr)
+    java = _req(server, "GET",
+                f"/99/Assembly.java/{asm2.id}/MungePojo", raw=True)
+    assert b"class MungePojo" in java and b"Math.cos" in java
