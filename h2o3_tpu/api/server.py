@@ -506,6 +506,10 @@ def _train(params, body, algo):
                 raise RuntimeError(est.job.exception)
             model = est.model
             model.key = model_id
+            # frame-first metric lookups + FeatureInteraction default
+            # frame resolve through this backref
+            model.training_frame_key = str(train_key) if train_key \
+                else None
             # fold models get DKV keys so the advertised
             # cross_validation_models keyrefs resolve (ModelSchemaV3)
             for i, fm in enumerate(
@@ -2117,8 +2121,12 @@ def _feature_interaction_route(params, body):
     screen for a tree model (h2o-py model.feature_interaction)."""
     from h2o3_tpu.analytics import feature_interaction
     m = dkv.get(str(params.get("model_id")), "model")
-    fr = dkv.get(str(params.get("frame") or params.get("frame_id")
-                     or getattr(m, "training_frame_key", None)), "frame")
+    fkey = (params.get("frame") or params.get("frame_id")
+            or getattr(m, "training_frame_key", None))
+    if not fkey:
+        raise ApiError(400, "frame is required (model has no recorded "
+                            "training_frame_key)")
+    fr = dkv.get(str(fkey), "frame")
     rows = feature_interaction(
         m, fr, max_pairs=int(params.get("max_interaction_depth", 10)
                              or 10))
@@ -2422,3 +2430,218 @@ def _assembly_java(params, body, aid, pojo_name):
     except NotImplementedError as e:
         raise ApiError(501, str(e))
     return {"__raw": src.encode(), "__content_type": "text/java"}
+
+
+@route("GET", "/3/Logs/nodes/{nodeidx}/files/{name}")
+def _logs_file(params, body, nodeidx, name):
+    """water/api/LogsHandler.fetch: a node's named log. One controller
+    process here; every name view serves the in-memory ring buffer
+    (water/util/Log analog in log.py)."""
+    from h2o3_tpu.log import buffered_lines
+    return {"__meta": {"schema_version": 3, "schema_name": "LogsV3"},
+            "nodeidx": int(nodeidx), "name": name,
+            "log": "\n".join(buffered_lines(5000))}
+
+
+@route("GET", "/3/ModelBuilders/{algo}/model_id")
+def _next_model_id(params, body, algo):
+    """ModelBuildersHandler.calcModelId: a fresh unique model id."""
+    if algo not in _builders():
+        raise ApiError(404, f"unknown algorithm '{algo}'")
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "ModelIdV3"},
+            "model_id": {"name": dkv.unique_key(f"{algo}_model")}}
+
+
+@route("POST", "/3/ModelBuilders/{algo}/parameters")
+def _validate_parameters(params, body, algo):
+    """ModelBuilderHandler.validate_parameters (Flow form validation):
+    typed-coerce + construct the builder WITHOUT training; returns
+    per-field messages + error_count."""
+    builders = _builders()
+    if algo not in builders:
+        raise ApiError(404, f"unknown algorithm '{algo}'")
+    defaults = builders[algo]().params
+    messages = []
+    parms = {}
+    for k, v in params.items():
+        if k in ("_rest_version", "model_id", "training_frame",
+                 "validation_frame", "response_column"):
+            continue
+        if k not in defaults:
+            messages.append({"message_type": "WARN", "field_name": k,
+                             "message": f"unknown parameter '{k}' for "
+                                        f"algo '{algo}'"})
+            continue
+        got = _coerce_typed(k, v, defaults)
+        d = defaults.get(k)
+        # strict check: _coerce_typed falls back to guessing instead of
+        # raising, so validate the COERCED value against the declared
+        # type here (bool is an int subtype — test it first)
+        ok = True
+        if isinstance(d, bool):
+            ok = isinstance(got, bool)
+        elif isinstance(d, (int, float)):
+            ok = isinstance(got, (int, float)) \
+                and not isinstance(got, bool) or got is None
+        elif isinstance(d, (list, tuple)):
+            ok = isinstance(got, (list, tuple)) or got is None
+        if not ok:
+            messages.append({
+                "message_type": "ERRR", "field_name": k,
+                "message": f"cannot parse '{v}' as "
+                           f"{type(d).__name__} (default {d!r})"})
+        else:
+            parms[k] = got
+    if not any(m["message_type"] == "ERRR" for m in messages):
+        try:
+            builders[algo](**parms)
+        except Exception as e:  # noqa: BLE001 - surfaced as validation
+            messages.append({"message_type": "ERRR",
+                             "field_name": "_parms", "message": str(e)})
+    errs = sum(1 for m in messages if m["message_type"] == "ERRR")
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "ModelParametersSchemaV3"},
+            "messages": messages, "error_count": errs}
+
+
+@route("GET", "/3/FrameChunks/{frame_id}")
+def _frame_chunks(params, body, frame_id):
+    """water/api/FrameChunksHandler: the frame's physical distribution.
+    Chunks map to mesh-shard row ranges in this design (SURVEY §2.5:
+    rows shard over the 'data' axis; each shard is one 'chunk')."""
+    from h2o3_tpu.parallel.mesh import current_mesh
+    fr = dkv.get(frame_id, "frame")
+    mesh = current_mesh()
+    n_shards = int(mesh.shape.get("data", 1)) if mesh is not None else 1
+    per = -(-fr.nrow // max(n_shards, 1))
+    chunks = [{"chunk_id": i,
+               "row_count": max(0, min(per, fr.nrow - i * per)),
+               "node_idx": i}
+              for i in range(n_shards)]
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "FrameChunksV3"},
+            "frame_id": {"name": frame_id},
+            "chunks": [c for c in chunks if c["row_count"] > 0]}
+
+
+@route("GET", "/3/SteamMetrics")
+def _steam_metrics(params, body):
+    """water/api/SteamMetricsHandler: Enterprise Steam keepalive
+    metrics — no Steam in this deployment, report idle truthfully."""
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "SteamMetricsV3"},
+            "idle_millis": int((time.time() - _START_TS) * 1000)}
+
+
+@route("GET", "/3/Metadata/schemaclasses/{classname}")
+def _metadata_schemaclass(params, body, classname):
+    """MetadataHandler.fetchSchemaMetadataByClass — same payload as
+    /3/Metadata/schemas/{name} (one schema namespace here)."""
+    return _schema_meta(params, body, classname)
+
+
+@route("GET", "/3/ModelMetrics/frames/{frame}")
+def _metrics_by_frame(params, body, frame):
+    """ModelMetricsHandler.list filtered by frame: stored metrics for
+    every model that scored this frame (training-frame metrics here —
+    the single-controller store does not index ad-hoc scores)."""
+    try:
+        dkv.get(frame, "frame")
+    except KeyError:
+        raise ApiError(404, f"frame '{frame}' not found")
+    out = []
+    for key in dkv.keys("model"):
+        m = dkv.get(key, "model")
+        if getattr(m, "training_frame_key", None) != frame:
+            continue
+        if m.training_metrics is not None:
+            v3 = schemas._metrics_v3(
+                m.training_metrics, _kind_of(m),
+                domain=list(m.response_domain or []) or None,
+                frame_key=frame, model_key=key)
+            if v3:
+                out.append(v3)
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "ModelMetricsListSchemaV3"},
+            "model_metrics": out}
+
+
+@route("POST", "/3/ModelMetrics/frames/{frame}/models/{model}")
+def _metrics_frame_model(params, body, frame, model):
+    """Frame-first spelling of models/{model}/frames/{frame} (POST =
+    score)."""
+    return _model_metrics_score(params, body, model, frame)
+
+
+@route("GET", "/3/ModelMetrics/frames/{frame}/models/{model}")
+def _metrics_frame_model_fetch(params, body, frame, model):
+    """GET = fetch STORED metrics only (ModelMetricsHandler.fetch) —
+    no scoring pass, works on frames lacking the response column."""
+    m = dkv.get(model, "model")
+    out = []
+    for mm in (m.training_metrics, m.validation_metrics,
+               m.cross_validation_metrics):
+        if mm is not None:
+            v3 = schemas._metrics_v3(
+                mm, _kind_of(m),
+                domain=list(m.response_domain or []) or None,
+                frame_key=frame, model_key=model)
+            if v3:
+                out.append(v3)
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "ModelMetricsListSchemaV3"},
+            "model_metrics": out}
+
+
+@route("GET", "/3/Models.fetch.bin/{model}")
+def _fetch_model_bin(params, body, model):
+    """ModelsHandler.fetchBinaryModel: stream the binary artifact
+    (h2o.download_model)."""
+    from h2o3_tpu.persist import save_model
+    m = dkv.get(model, "model")
+    with tempfile.TemporaryDirectory() as td:
+        path = save_model(m, path=td, force=True, filename=model)
+        data = open(path, "rb").read()
+    return {"__raw": data, "__content_type": "application/octet-stream"}
+
+
+@route("POST", "/99/Models.upload.bin/{model}")
+@route("POST", "/99/Models.upload.bin/")
+def _upload_model_bin(params, body, model=None):
+    """ModelsHandler.uploadBinaryModel (h2o.upload_model): body bytes →
+    artifact → live model in the DKV."""
+    from h2o3_tpu.persist import load_model
+    if not body:
+        raise ApiError(400, "binary model body required")
+    # accept the client's multipart envelope too (h2o.upload_model posts
+    # a file upload): find the zip magic and strip everything before it,
+    # and the trailing boundary after the payload
+    if body[:2] != b"PK":
+        start = body.find(b"PK\x03\x04")
+        if start < 0:
+            raise ApiError(400, "no zip artifact in request body")
+        end = body.rfind(b"\r\n--")
+        body = body[start:end if end > start else len(body)]
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "upload.zip")
+        with open(p, "wb") as f:
+            f.write(body)
+        try:
+            m = load_model(p)
+        except Exception as e:  # noqa: BLE001 - bad artifact → 400
+            raise ApiError(400, f"not a model artifact: {e}")
+    if model:
+        m.key = model
+    dkv.put(m.key, "model", m)
+    return {"__meta": {"schema_version": 99, "schema_name": "ModelsV3"},
+            "models": [{"model_id": {"name": m.key}}]}
+
+
+@route("GET", "/99/Models/{key}/json")
+def _model_json(params, body, key):
+    """ModelsHandler.fetch with full output (the /99 'json' spelling
+    Flow downloads)."""
+    m = dkv.get(key, "model")
+    return {"__meta": {"schema_version": 99, "schema_name": "ModelsV3"},
+            "models": [schemas.model_v3(m, key)]}

@@ -121,6 +121,7 @@ def model_to_meta(model) -> Dict:
                             if model.response_domain else None),
         "nclasses": model.nclasses,
         "output": _json_safe(model.output),
+        "training_frame_key": getattr(model, "training_frame_key", None),
         "scoring_history": _json_safe(model.scoring_history),
         "training_metrics": _metrics_to_meta(model.training_metrics),
         "validation_metrics": _metrics_to_meta(model.validation_metrics),
@@ -140,6 +141,7 @@ def model_from_meta(meta: Dict, arrays: Dict):
     model.cross_validation_metrics = _metrics_from_meta(
         meta.get("cross_validation_metrics"))
     model.scoring_history = meta.get("scoring_history") or []
+    model.training_frame_key = meta.get("training_frame_key")
     return model
 
 

@@ -382,3 +382,57 @@ def test_assembly_real_client(server):
     java = _req(server, "GET",
                 f"/99/Assembly.java/{asm2.id}/MungePojo", raw=True)
     assert b"class MungePojo" in java and b"Math.cos" in java
+
+
+def test_registry_tail_routes(server, frame):
+    """Logs, next-model-id, validate-parameters, FrameChunks,
+    SteamMetrics."""
+    import h2o3_tpu.log as hlog
+    hlog.info("breadth2 marker line")
+    lg = _req(server, "GET", "/3/Logs/nodes/0/files/default")
+    assert "breadth2 marker line" in lg["log"]
+    mid = _req(server, "GET", "/3/ModelBuilders/gbm/model_id")
+    assert mid["model_id"]["name"].startswith("gbm_model")
+    ok = _req(server, "POST", "/3/ModelBuilders/gbm/parameters",
+              {"ntrees": "10", "learn_rate": "0.2"})
+    assert ok["error_count"] == 0
+    bad = _req(server, "POST", "/3/ModelBuilders/gbm/parameters",
+               {"ntrees": "10", "bogus_param": "1"})
+    assert any(m["field_name"] == "bogus_param" for m in bad["messages"])
+    # a type-invalid value is a hard validation ERROR, not a silent pass
+    bad2 = _req(server, "POST", "/3/ModelBuilders/gbm/parameters",
+                {"ntrees": "abc"})
+    assert bad2["error_count"] == 1, bad2
+    chunks = _req(server, "GET", "/3/FrameChunks/b2.hex")["chunks"]
+    assert sum(c["row_count"] for c in chunks) == frame.nrow
+    sm = _req(server, "GET", "/3/SteamMetrics")
+    assert sm["idle_millis"] >= 0
+
+
+def test_model_bin_roundtrip_and_frame_metrics(server, frame):
+    """fetch.bin -> upload.bin roundtrip + frame-first metric routes +
+    model json + schemaclasses alias."""
+    out = _req(server, "POST", "/3/ModelBuilders/gbm",
+               {"model_id": "b2srv_gbm", "training_frame": "b2.hex",
+                "response_column": "y", "ntrees": "3",
+                "max_depth": "3", "seed": "1"})
+    _poll(server, out["job"]["key"]["name"])
+    blob = _req(server, "GET", "/3/Models.fetch.bin/b2srv_gbm", raw=True)
+    assert blob[:2] == b"PK"
+    url = (f"http://127.0.0.1:{server.port}/99/Models.upload.bin/"
+           f"b2srv_up")
+    req = urllib.request.Request(url, data=blob, method="POST",
+                                 headers={"Content-Type":
+                                          "application/octet-stream"})
+    with urllib.request.urlopen(req) as resp:
+        up = json.loads(resp.read().decode())
+    assert up["models"][0]["model_id"]["name"] == "b2srv_up"
+    mj = _req(server, "GET", "/99/Models/b2srv_up/json")
+    assert mj["models"][0]["algo"] == "gbm"
+    fm = _req(server, "GET", "/3/ModelMetrics/frames/b2.hex")
+    assert any(True for _ in fm["model_metrics"])
+    fm2 = _req(server, "POST",
+               "/3/ModelMetrics/frames/b2.hex/models/b2srv_gbm")
+    assert fm2["model_metrics"]
+    sc = _req(server, "GET", "/3/Metadata/schemaclasses/FramesV3")
+    assert sc["__meta"]["schema_name"] == "MetadataV3"
