@@ -28,10 +28,21 @@
                      simplifyVector = FALSE)
 }
 
+.h2o.serialize <- function(v) {
+  # vector-valued params (hidden, base_models, alpha, ...) go over the
+  # wire in the server's bracket syntax "[a,b]" (api/server.py
+  # _bracket_list); scalars as plain strings
+  if (length(v) > 1)
+    paste0("[", paste(vapply(v, function(x)
+      if (is.character(x)) paste0("\"", x, "\"") else as.character(x),
+      character(1)), collapse = ","), "]")
+  else as.character(v)
+}
+
 .h2o.post <- function(path, params = list()) {
   h <- curl::new_handle()
   fields <- paste(mapply(function(k, v) {
-    paste0(curl::curl_escape(k), "=", curl::curl_escape(as.character(v)))
+    paste0(curl::curl_escape(k), "=", curl::curl_escape(.h2o.serialize(v)))
   }, names(params), params), collapse = "&")
   curl::handle_setopt(h, postfields = fields)
   curl::handle_setheaders(h,
@@ -103,18 +114,9 @@ h2o.nrow <- function(frame) h2o.describe(frame)$frames[[1]]$rows
   structure(list(key = job$dest$name, algo = algo), class = "H2OModel")
 }
 
-h2o.gbm <- function(y, training_frame, ...)
-  .h2o.train("gbm", y, training_frame, list(...))
-h2o.randomForest <- function(y, training_frame, ...)
-  .h2o.train("drf", y, training_frame, list(...))
-h2o.glm <- function(y, training_frame, ...)
-  .h2o.train("glm", y, training_frame, list(...))
-h2o.deeplearning <- function(y, training_frame, ...)
-  .h2o.train("deeplearning", y, training_frame, list(...))
-h2o.kmeans <- function(training_frame, ...)
-  .h2o.train("kmeans", NULL, training_frame, list(...))
-h2o.xgboost <- function(y, training_frame, ...)
-  .h2o.train("xgboost", y, training_frame, list(...))
+# per-algo estimator functions (h2o.gbm, h2o.glm, ...) live in
+# estimators_gen.R — generated from the live /3/ModelBuilders metadata
+# by tools/gen_R.py with the full parameter surface.
 
 h2o.getModel <- function(key) {
   .h2o.get(paste0("/3/Models/",
