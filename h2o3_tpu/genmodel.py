@@ -910,3 +910,159 @@ def export_mojo_ensemble(model, path: str) -> str:
                              "Multinomial" if model.nclasses > 2
                              else "Regression"), cols, "1.00", kv)
     return _write_zip(path, ini, doms, blobs)
+
+
+# ---------------- PCA ---------------------------------------------------
+# hex/genmodel/algos/pca/PCAMojoReader: eigenvector matrix + the same
+# standardization block the kmeans reader carries; score = projection
+# of the standardized (NA-imputed) row onto k components.
+
+def export_mojo_pca(model, path: str) -> str:
+    if len(model.exp_names) != len(model.feature_names):
+        raise NotImplementedError(
+            "PCA MOJO export requires a numeric-only design: this model "
+            "trained on an expanded (categorical) design and the MOJO "
+            "row format carries raw columns (export the scores frame, "
+            "or one-hot the frame before training)")
+    columns = list(model.feature_names)
+    ev = np.asarray(model.eigvec, np.float64)          # [Fe, k]
+    extra = [
+        "standardize = true",
+        f"pca_means = {_jarr(np.asarray(model.xm, np.float64).tolist())}",
+        f"pca_mults = {_jarr((1.0 / np.maximum(np.asarray(model.xs, np.float64), 1e-12)).tolist())}",
+        f"k = {ev.shape[1]}",
+    ] + [f"eigvec_{j} = {_jarr(ev[:, j].tolist())}"
+         for j in range(ev.shape[1])]
+    ini, doms = _ini_header(model, "pca", "Principal Components Analysis",
+                            "DimReduction", columns, "1.00", extra)
+    return _write_zip(path, ini, doms)
+
+
+class PcaMojoScorer:
+    def __init__(self, kv: Dict[str, str], columns, domains, response):
+        self.means = np.asarray(_parse_jarr(kv["pca_means"]))
+        self.mults = np.asarray(_parse_jarr(kv["pca_mults"]))
+        k = int(kv["k"])
+        self.eigvec = np.stack(
+            [np.asarray(_parse_jarr(kv[f"eigvec_{j}"]))
+             for j in range(k)], axis=1)               # [Fe, k]
+        self.nclasses = 1
+        self.columns = columns
+
+    def score(self, row: np.ndarray) -> np.ndarray:
+        x = np.asarray(row, np.float64)
+        x = np.where(np.isnan(x), self.means, x)
+        xs = (x - self.means) * self.mults
+        return xs @ self.eigvec
+
+
+# ---------------- Isotonic ----------------------------------------------
+# hex/genmodel/algos/isotonic/IsotonicRegressionMojoReader: threshold
+# knots; score = piecewise-linear interpolation clamped to [min, max].
+
+def export_mojo_isotonic(model, path: str) -> str:
+    columns = list(model.feature_names) + [model.response]
+    tx = np.asarray(model.thresholds_x, np.float64)
+    ty = np.asarray(model.thresholds_y, np.float64)
+    extra = [
+        f"thresholds_x = {_jarr(tx.tolist())}",
+        f"thresholds_y = {_jarr(ty.tolist())}",
+        f"min_x = {tx.min()}", f"max_x = {tx.max()}",
+    ]
+    ini, doms = _ini_header(model, "isotonic", "Isotonic Regression",
+                            "Regression", columns, "1.00", extra)
+    return _write_zip(path, ini, doms)
+
+
+class IsotonicMojoScorer:
+    def __init__(self, kv: Dict[str, str], columns, domains, response):
+        self.tx = np.asarray(_parse_jarr(kv["thresholds_x"]))
+        self.ty = np.asarray(_parse_jarr(kv["thresholds_y"]))
+        self.nclasses = 1
+        self.columns = columns
+
+    def score(self, row: np.ndarray) -> np.ndarray:
+        x = float(np.asarray(row, np.float64)[0])
+        if np.isnan(x):
+            return np.array([np.nan])
+        return np.array([float(np.interp(x, self.tx, self.ty))])
+
+
+# ---------------- PSVM --------------------------------------------------
+# hex/genmodel/algos/psvm/KernelSvmMojoReader: support vectors + alphas
+# + rho; score = sum_i alpha_i*y_i*K(sv_i, x) + b with the Gaussian
+# kernel. Both of this build's regimes serialize: mode=exact carries
+# the SVs, mode=rff carries the factorized (W, phase, beta) triple.
+
+def export_mojo_psvm(model, path: str) -> str:
+    if len(model.exp_names) != len(model.feature_names):
+        raise NotImplementedError(
+            "PSVM MOJO export requires a numeric-only design: this "
+            "model trained on an expanded (categorical) design and the "
+            "MOJO row format carries raw columns")
+    columns = list(model.feature_names) + [model.response]
+    extra = [
+        f"svm_b = {model.b}",
+        f"svm_means = {_jarr(np.asarray(model._xm, np.float64).tolist())}",
+        f"svm_stds = {_jarr(np.asarray(model._xs, np.float64).tolist())}",
+    ]
+    blobs: Dict[str, bytes] = {}
+    if getattr(model, "alpha_y", None) is not None:
+        extra += [f"svm_mode = exact", f"svm_gamma = {model.gamma}",
+                  f"sv_count = {model.sv_X.shape[0]}"]
+        blobs["svm/sv_x.bin"] = np.asarray(
+            model.sv_X, "<f8").tobytes()
+        blobs["svm/alpha_y.bin"] = np.asarray(
+            model.alpha_y, "<f8").tobytes()
+    else:
+        extra += ["svm_mode = rff",
+                  f"rff_rank = {model.W.shape[1] if model.W is not None else 0}"]
+        if model.W is not None:
+            blobs["svm/rff_w.bin"] = np.asarray(model.W, "<f8").tobytes()
+            blobs["svm/rff_phase.bin"] = np.asarray(
+                model.phase, "<f8").tobytes()
+        blobs["svm/beta.bin"] = np.asarray(model.beta, "<f8").tobytes()
+    ini, doms = _ini_header(model, "psvm", "Support Vector Machine",
+                            "Binomial", columns, "1.00", extra)
+    return _write_zip(path, ini, doms, blobs=blobs)
+
+
+class PsvmMojoScorer:
+    def __init__(self, kv: Dict[str, str], columns, domains, response,
+                 blobs=None):
+        self.b = float(kv["svm_b"])
+        self.means = np.asarray(_parse_jarr(kv["svm_means"]))
+        self.stds = np.asarray(_parse_jarr(kv["svm_stds"]))
+        self.mode = kv.get("svm_mode", "exact")
+        F = len(self.means)
+        if self.mode == "exact":
+            self.gamma = float(kv["svm_gamma"])
+            n = int(kv["sv_count"])
+            self.sv = np.frombuffer(
+                blobs["svm/sv_x.bin"], "<f8").reshape(n, -1)
+            self.ay = np.frombuffer(blobs["svm/alpha_y.bin"], "<f8")
+        else:
+            r = int(kv["rff_rank"])
+            self.W = (np.frombuffer(blobs["svm/rff_w.bin"],
+                                    "<f8").reshape(F, r) if r else None)
+            self.phase = (np.frombuffer(blobs["svm/rff_phase.bin"],
+                                        "<f8") if r else None)
+            self.beta = np.frombuffer(blobs["svm/beta.bin"], "<f8")
+        self.nclasses = 2
+        self.columns = columns
+
+    def score(self, row: np.ndarray) -> np.ndarray:
+        x = np.asarray(row, np.float64)
+        x = np.where(np.isnan(x), self.means, x)
+        xs = (x - self.means) / self.stds
+        if self.mode == "exact":
+            d2 = ((self.sv - xs[None, :]) ** 2).sum(1)
+            dec = float(np.exp(-self.gamma * d2) @ self.ay + self.b)
+        elif self.W is not None:
+            z = np.sqrt(2.0 / self.W.shape[1]) * np.cos(
+                xs @ self.W + self.phase)
+            dec = float(z @ self.beta + self.b)
+        else:
+            dec = float(xs @ self.beta + self.b)
+        p1 = 1.0 / (1.0 + np.exp(-2.0 * dec))
+        return np.array([1.0 if dec >= 0 else 0.0, 1.0 - p1, p1])

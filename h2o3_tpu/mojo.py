@@ -154,6 +154,15 @@ def export_mojo(model, path: str) -> str:
     if algo == "glrm":
         from h2o3_tpu.genmodel import export_mojo_glrm
         return export_mojo_glrm(model, path)
+    if algo == "pca":
+        from h2o3_tpu.genmodel import export_mojo_pca
+        return export_mojo_pca(model, path)
+    if algo in ("isotonic", "isotonicregression"):
+        from h2o3_tpu.genmodel import export_mojo_isotonic
+        return export_mojo_isotonic(model, path)
+    if algo == "psvm":
+        from h2o3_tpu.genmodel import export_mojo_psvm
+        return export_mojo_psvm(model, path)
     if algo in ("isolationforest", "isolation_forest"):
         from h2o3_tpu.genmodel import export_mojo_isofor
         return export_mojo_isofor(model, path)
@@ -437,23 +446,29 @@ def read_mojo(path: str) -> MojoModel:
                 if nm in names:
                     trees[(k, t)] = zf.read(nm)
     algo = info.get("algo", "")
-    if algo in ("glm", "kmeans", "deeplearning", "coxph"):
+    if algo in ("glm", "kmeans", "deeplearning", "coxph", "pca",
+                "isotonic"):
         from h2o3_tpu.genmodel import (CoxPHMojoScorer,
                                        DeepLearningMojoScorer,
-                                       GlmMojoScorer, KMeansMojoScorer)
+                                       GlmMojoScorer,
+                                       IsotonicMojoScorer,
+                                       KMeansMojoScorer, PcaMojoScorer)
         resp = columns[-1] if info.get("supervised") == "true" else None
         scorer_cls = {"glm": GlmMojoScorer, "kmeans": KMeansMojoScorer,
                       "deeplearning": DeepLearningMojoScorer,
-                      "coxph": CoxPHMojoScorer}[algo]
+                      "coxph": CoxPHMojoScorer, "pca": PcaMojoScorer,
+                      "isotonic": IsotonicMojoScorer}[algo]
         s = scorer_cls(info, columns, domains, resp)
         s.info = info
         return s
-    if algo in ("word2vec", "glrm"):
-        from h2o3_tpu.genmodel import GlrmMojoScorer, Word2VecMojoScorer
+    if algo in ("word2vec", "glrm", "psvm"):
+        from h2o3_tpu.genmodel import (GlrmMojoScorer, PsvmMojoScorer,
+                                       Word2VecMojoScorer)
         with zipfile.ZipFile(path) as zf2:
             blobs = {n: zf2.read(n) for n in zf2.namelist()
                      if n.endswith((".bin", ".txt"))}
-        cls2 = Word2VecMojoScorer if algo == "word2vec" else GlrmMojoScorer
+        cls2 = {"word2vec": Word2VecMojoScorer, "glrm": GlrmMojoScorer,
+                "psvm": PsvmMojoScorer}[algo]
         s = cls2(info, columns, domains, None, blobs=blobs)
         s.info = info
         return s

@@ -186,3 +186,77 @@ def test_isofor_mojo_scores(tmp_path):
     outlier = s.score(np.array([40.0, -40.0, 0.0]))[0]
     assert np.isfinite(inlier) and np.isfinite(outlier)
     assert outlier <= inlier + 1e-9
+
+
+def test_pca_mojo_roundtrip(tmp_path):
+    from h2o3_tpu.models.pca import H2OPrincipalComponentAnalysisEstimator
+    from h2o3_tpu.mojo import read_mojo
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(300, 5)).astype(np.float64)
+    X[:, 3] = X[:, 0] * 2 + 0.1 * rng.normal(size=300)
+    fr = h2o.Frame.from_numpy({f"c{i}": X[:, i] for i in range(5)})
+    pca = H2OPrincipalComponentAnalysisEstimator(k=3, seed=1)
+    pca.train(training_frame=fr)
+    p = pca.model.download_mojo(str(tmp_path))
+    scorer = read_mojo(p)
+    want = np.asarray(pca.model.predict(fr).to_numpy())[:5, :3]
+    got = np.stack([scorer.score(X[i]) for i in range(5)])
+    np.testing.assert_allclose(np.abs(got[:, :3]), np.abs(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_isotonic_mojo_roundtrip(tmp_path):
+    from h2o3_tpu.models.isotonic import H2OIsotonicRegressionEstimator
+    from h2o3_tpu.mojo import read_mojo
+    rng = np.random.default_rng(5)
+    x = np.sort(rng.uniform(0, 10, 400))
+    y = np.log1p(x) + 0.1 * rng.normal(size=400)
+    fr = h2o.Frame.from_numpy({"x": x, "y": y})
+    iso = H2OIsotonicRegressionEstimator()
+    iso.train(y="y", training_frame=fr)
+    path = iso.model.download_mojo(str(tmp_path))
+    scorer = read_mojo(path)
+    pred = np.asarray(iso.model.predict(fr).to_numpy()).ravel()[:10]
+    got = np.array([scorer.score(np.array([v]))[0] for v in x[:10]])
+    np.testing.assert_allclose(got, pred, rtol=1e-5, atol=1e-5)
+    assert np.isnan(scorer.score(np.array([np.nan]))[0])
+
+
+def test_psvm_mojo_roundtrip_exact_and_rff(tmp_path):
+    from h2o3_tpu.models.psvm import H2OSupportVectorMachineEstimator
+    from h2o3_tpu.mojo import read_mojo
+    rng = np.random.default_rng(6)
+    n = 300
+    X = rng.normal(size=(n, 3))
+    yl = np.where(X[:, 0] + X[:, 1] > 0, "p", "n").astype(object)
+    fr = h2o.Frame.from_numpy(
+        {**{f"x{i}": X[:, i] for i in range(3)}, "y": yl})
+    for extra in ({}, {"rank_ratio": 0.2}):      # exact then RFF
+        svm = H2OSupportVectorMachineEstimator(
+            gamma=0.7, hyper_param=1.0, max_iterations=120, seed=2,
+            **extra)
+        svm.train(y="y", training_frame=fr)
+        path = svm.model.download_mojo(str(tmp_path))
+        scorer = read_mojo(path)
+        dec_model = np.asarray(
+            svm.model.decision_function(np.asarray(X, np.float32)))[:20]
+        p1 = np.array([scorer.score(X[i])[2] for i in range(20)])
+        dec_scored = np.log(p1 / (1 - p1)) / 2.0
+        np.testing.assert_allclose(dec_scored, dec_model, rtol=2e-2,
+                                   atol=2e-2)
+
+
+def test_pca_psvm_mojo_categorical_refusal(tmp_path):
+    """Categorical-design PCA/PSVM models must refuse MOJO export with
+    a clear message (raw-row wire format cannot carry the expansion)
+    instead of writing a silently broken artifact."""
+    from h2o3_tpu.models.pca import H2OPrincipalComponentAnalysisEstimator
+    rng = np.random.default_rng(7)
+    fr = h2o.Frame.from_numpy({
+        "num": rng.normal(size=100),
+        "cat": np.array(["a", "b", "c"], dtype=object)[
+            rng.integers(0, 3, 100)]})
+    pca = H2OPrincipalComponentAnalysisEstimator(k=2, seed=1)
+    pca.train(training_frame=fr)
+    with pytest.raises(NotImplementedError, match="numeric-only"):
+        pca.model.download_mojo(str(tmp_path))
