@@ -31,7 +31,9 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 
-def _jarr(vals) -> str:
+def _jarr(vals, quote: bool = False) -> str:
+    if quote:
+        return "[" + ", ".join(f'"{v}"' for v in vals) + "]"
     return "[" + ", ".join(str(v) for v in vals) + "]"
 
 
@@ -1066,3 +1068,70 @@ class PsvmMojoScorer:
             dec = float(xs @ self.beta + self.b)
         p1 = 1.0 / (1.0 + np.exp(-2.0 * dec))
         return np.array([1.0 if dec >= 0 else 0.0, 1.0 - p1, p1])
+
+
+# ---------------- TargetEncoder -----------------------------------------
+# hex/genmodel/algos/targetencoder/TargetEncoderMojoReader: per-column
+# category->(numerator, denominator) tables + prior + blending knobs;
+# scoring-time transform is te = blend(sum/cnt, prior) per level (NA and
+# unseen levels fall back to the prior).
+
+def export_mojo_targetencoder(model, path: str) -> str:
+    columns = list(model.feature_names) + [model.response]
+    p = model.params
+    extra = [
+        f"te_prior = {model.prior}",
+        f"te_blending = {'true' if p.get('blending', True) else 'false'}",
+        f"te_inflection_point = {float(p.get('inflection_point', 10.0))}",
+        f"te_smoothing = {float(p.get('smoothing', 20.0))}",
+        f"te_cols = {_jarr(list(model.encodings), quote=True)}",
+    ]
+    blobs: Dict[str, bytes] = {}
+    for c, (s, n) in model.encodings.items():
+        blobs[f"te/{c}_sum.bin"] = np.asarray(s, "<f8").tobytes()
+        blobs[f"te/{c}_cnt.bin"] = np.asarray(n, "<f8").tobytes()
+    ini, doms = _ini_header(model, "targetencoder", "TargetEncoder",
+                            "TargetEncoder", columns, "1.00", extra)
+    return _write_zip(path, ini, doms, blobs=blobs)
+
+
+class TargetEncoderMojoScorer:
+    """Transforms a row's categorical codes to their blended encodings
+    (EasyPredict transformWithTargetEncoding analog)."""
+
+    def __init__(self, kv: Dict[str, str], columns, domains, response,
+                 blobs=None):
+        self.prior = float(kv["te_prior"])
+        self.blending = kv.get("te_blending", "true") == "true"
+        self.infl = float(kv.get("te_inflection_point", 10.0))
+        self.smooth = float(kv.get("te_smoothing", 20.0))
+        self.te_cols = _parse_jarr(kv["te_cols"],
+                                   typ=lambda v: v.strip('"'))
+        self.tables = {}
+        for c in self.te_cols:
+            s = np.frombuffer(blobs[f"te/{c}_sum.bin"], "<f8")
+            n = np.frombuffer(blobs[f"te/{c}_cnt.bin"], "<f8")
+            self.tables[c] = (s, n)
+        self.columns = columns
+        self.nclasses = 1
+
+    def encode(self, col: str, code: float) -> float:
+        s, n = self.tables[col]
+        if not (0 <= code < len(n)) or code != code:
+            return self.prior
+        i = int(code)
+        cnt = n[i]
+        if cnt <= 0:
+            return self.prior
+        est = s[i] / cnt
+        if not self.blending:
+            return float(est)
+        lam = 1.0 / (1.0 + np.exp((self.infl - cnt) / self.smooth))
+        return float(lam * est + (1.0 - lam) * self.prior)
+
+    def score(self, row: np.ndarray) -> np.ndarray:
+        out = []
+        for j, c in enumerate(self.te_cols):
+            idx = self.columns.index(c)
+            out.append(self.encode(c, float(row[idx])))
+        return np.asarray(out)

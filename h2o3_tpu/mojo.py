@@ -163,6 +163,9 @@ def export_mojo(model, path: str) -> str:
     if algo == "psvm":
         from h2o3_tpu.genmodel import export_mojo_psvm
         return export_mojo_psvm(model, path)
+    if algo == "targetencoder":
+        from h2o3_tpu.genmodel import export_mojo_targetencoder
+        return export_mojo_targetencoder(model, path)
     if algo in ("isolationforest", "isolation_forest"):
         from h2o3_tpu.genmodel import export_mojo_isofor
         return export_mojo_isofor(model, path)
@@ -461,14 +464,16 @@ def read_mojo(path: str) -> MojoModel:
         s = scorer_cls(info, columns, domains, resp)
         s.info = info
         return s
-    if algo in ("word2vec", "glrm", "psvm"):
+    if algo in ("word2vec", "glrm", "psvm", "targetencoder"):
         from h2o3_tpu.genmodel import (GlrmMojoScorer, PsvmMojoScorer,
+                                       TargetEncoderMojoScorer,
                                        Word2VecMojoScorer)
         with zipfile.ZipFile(path) as zf2:
             blobs = {n: zf2.read(n) for n in zf2.namelist()
                      if n.endswith((".bin", ".txt"))}
         cls2 = {"word2vec": Word2VecMojoScorer, "glrm": GlrmMojoScorer,
-                "psvm": PsvmMojoScorer}[algo]
+                "psvm": PsvmMojoScorer,
+                "targetencoder": TargetEncoderMojoScorer}[algo]
         s = cls2(info, columns, domains, None, blobs=blobs)
         s.info = info
         return s

@@ -260,3 +260,28 @@ def test_pca_psvm_mojo_categorical_refusal(tmp_path):
     pca.train(training_frame=fr)
     with pytest.raises(NotImplementedError, match="numeric-only"):
         pca.model.download_mojo(str(tmp_path))
+
+
+def test_targetencoder_mojo_roundtrip(tmp_path):
+    from h2o3_tpu.models.targetencoder import H2OTargetEncoderEstimator
+    from h2o3_tpu.mojo import read_mojo
+    rng = np.random.default_rng(8)
+    n = 400
+    lv = np.array(["a", "b", "c", "d"], dtype=object)
+    c = rng.integers(0, 4, n)
+    y = 0.2 * c + rng.normal(scale=0.1, size=n)
+    fr = h2o.Frame.from_numpy({"cat": lv[c], "y": y})
+    te = H2OTargetEncoderEstimator(blending=True, noise=0,
+                                   inflection_point=5, smoothing=10)
+    te.train(x=["cat"], y="y", training_frame=fr)
+    path = te.model.download_mojo(str(tmp_path))
+    scorer = read_mojo(path)
+    enc = te.model.transform(fr)
+    te_col = np.asarray(enc.vec("cat_te").to_numpy())[:n]
+    for code in range(4):
+        i = int(np.nonzero(c == code)[0][0])
+        got = scorer.score(np.array([float(code), np.nan]))[0]
+        assert abs(got - te_col[i]) < 1e-6, (code, got, te_col[i])
+    # unseen / NA level falls back to the prior
+    assert abs(scorer.score(np.array([np.nan, np.nan]))[0]
+               - te.model.prior) < 1e-12
