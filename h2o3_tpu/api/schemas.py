@@ -472,8 +472,12 @@ def model_v3(model, key: str) -> Dict:
         "__meta": {"schema_version": 3, "schema_name": "ModelSchemaV3",
                    "schema_type": "Model"},
         "model_id": keyref(key, "Key<Model>"),
-        "algo": model.algo,
-        "algo_full_name": model.algo.upper(),
+        # HGLM models persist under their own algo tag but are GLM on
+        # the wire (the reference builds them through the glm builder
+        # and h2o-py resolves estimator classes by this field)
+        "algo": "glm" if model.algo == "hglm" else model.algo,
+        "algo_full_name": ("GLM" if model.algo == "hglm"
+                           else model.algo.upper()),
         "response_column_name": model.response,
         "data_frame": None,
         "timestamp": int(time.time() * 1000),

@@ -33,12 +33,18 @@ import numpy as np
 
 def _jarr(vals, quote: bool = False) -> str:
     if quote:
-        return "[" + ", ".join(f'"{v}"' for v in vals) + "]"
+        # JSON-escape so names containing '"' or ',' roundtrip
+        import json
+        return "[" + ", ".join(json.dumps(str(v)) for v in vals) + "]"
     return "[" + ", ".join(str(v) for v in vals) + "]"
 
 
 def _parse_jarr(s: str, typ=float):
     s = s.strip()
+    if '"' in s:
+        # quoted string array — written JSON-escaped by _jarr
+        import json
+        return [typ(v) for v in json.loads(s)]
     if s.startswith("["):
         s = s[1:-1]
     return [typ(v.strip()) for v in s.split(",") if v.strip()]
@@ -1130,8 +1136,7 @@ class TargetEncoderMojoScorer:
         return float(lam * est + (1.0 - lam) * self.prior)
 
     def score(self, row: np.ndarray) -> np.ndarray:
-        out = []
-        for j, c in enumerate(self.te_cols):
-            idx = self.columns.index(c)
-            out.append(self.encode(c, float(row[idx])))
-        return np.asarray(out)
+        if not hasattr(self, "_col_idx"):
+            self._col_idx = [self.columns.index(c) for c in self.te_cols]
+        return np.asarray([self.encode(c, float(row[idx]))
+                           for c, idx in zip(self.te_cols, self._col_idx)])

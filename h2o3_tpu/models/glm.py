@@ -44,6 +44,9 @@ GLM_DEFAULTS: Dict = dict(
     standardize=True, intercept=True, max_iterations=50,
     beta_epsilon=1e-5, gradient_epsilon=1e-6, link="family_default",
     seed=-1, tweedie_power=1.5, non_negative=False,
+    # Family.tweedie (GLMModel.java:376-377 defaults: var power 0, link
+    # power 1 — clients set e.g. 1.5/0 for compound Poisson-gamma + log)
+    tweedie_variance_power=0.0, tweedie_link_power=1.0,
     missing_values_handling="mean_imputation",
     # round-5 closure: NB dispersion, box constraints, DataInfo
     # interactions (hex/glm/GLMModel.java:814, hex/DataInfo.java:16)
@@ -51,10 +54,15 @@ GLM_DEFAULTS: Dict = dict(
 )
 
 
-# ---------------- family link/variance providers ----------------------
+# ---------------- link functions --------------------------------------
+# hex/glm/GLMModel.java Link enum (identity/log/logit/inverse/tweedie).
+# Links are separate objects composed into families so non-canonical
+# pairs (gaussian+log, gamma+inverse, poisson+identity, …) flow through
+# the same IRLS working-response code (GLMModel.java:560-591 validates
+# the family↔link compatibility matrix reproduced in _make_family).
 
-class _Family:
-    name = "gaussian"
+class _IdentityLink:
+    name = "identity"
 
     def link(self, mu):
         return mu
@@ -66,22 +74,22 @@ class _Family:
         """dμ/dη at eta."""
         return jnp.ones_like(eta)
 
-    def variance(self, mu):
-        return jnp.ones_like(mu)
 
-    def deviance(self, w, y, mu):
-        return (w * (y - mu) ** 2).sum()
+class _LogLink(_IdentityLink):
+    name = "log"
 
-    def init_mu(self, y, w):
-        return (w * y).sum() / w.sum()
+    def link(self, mu):
+        return jnp.log(jnp.maximum(mu, 1e-10))
+
+    def linkinv(self, eta):
+        return jnp.exp(jnp.clip(eta, -30, 30))
+
+    def mu_eta(self, eta):
+        return self.linkinv(eta)
 
 
-class _Gaussian(_Family):
-    name = "gaussian"
-
-
-class _Binomial(_Family):
-    name = "binomial"
+class _LogitLink(_IdentityLink):
+    name = "logit"
 
     def link(self, mu):
         mu = jnp.clip(mu, 1e-7, 1 - 1e-7)
@@ -93,6 +101,121 @@ class _Binomial(_Family):
     def mu_eta(self, eta):
         mu = self.linkinv(eta)
         return jnp.maximum(mu * (1 - mu), 1e-10)
+
+
+class _InverseLink(_IdentityLink):
+    """η = 1/μ (the gamma canonical link in GLMModel.java:647)."""
+    name = "inverse"
+
+    @staticmethod
+    def _safe(x):
+        return jnp.where(jnp.abs(x) < 1e-10,
+                         jnp.where(x < 0, -1e-10, 1e-10), x)
+
+    def link(self, mu):
+        return 1.0 / self._safe(mu)
+
+    def linkinv(self, eta):
+        return 1.0 / self._safe(eta)
+
+    def mu_eta(self, eta):
+        e = self._safe(eta)
+        return -1.0 / (e * e)
+
+
+class _TweedieLink(_IdentityLink):
+    """Power link η = μ^q with q = tweedie_link_power; q = 0 is log
+    (GLMModel.java:690,734 tweedie link/linkInv/linkInvDeriv)."""
+    name = "tweedie"
+
+    def __init__(self, link_power: float = 1.0):
+        self.q = float(link_power)
+
+    def link(self, mu):
+        if self.q == 0.0:
+            return jnp.log(jnp.maximum(mu, 1e-10))
+        return jnp.power(jnp.maximum(mu, 1e-10), self.q)
+
+    def linkinv(self, eta):
+        if self.q == 0.0:
+            return jnp.exp(jnp.clip(eta, -30, 30))
+        return jnp.power(jnp.maximum(eta, 1e-10), 1.0 / self.q)
+
+    def mu_eta(self, eta):
+        if self.q == 0.0:
+            return self.linkinv(eta)
+        e = jnp.maximum(eta, 1e-10)
+        d = (1.0 / self.q) * jnp.power(e, 1.0 / self.q - 1.0)
+        # below the clamp μ is pinned at the floor → dμ/dη = 0: those
+        # rows must drop out of the working LS or their 1/μ^p variance
+        # weight explodes and IRLS diverges
+        return jnp.where(eta > 1e-10, d, 0.0)
+
+
+_LINKS = {"identity": _IdentityLink, "log": _LogLink, "logit": _LogitLink,
+          "inverse": _InverseLink, "tweedie": _TweedieLink}
+
+
+# ---------------- family variance/deviance providers -------------------
+
+class _Family:
+    name = "gaussian"
+    default_link = "identity"
+    valid_links = ("identity", "log", "inverse")
+
+    def __init__(self, link=None):
+        if link is None or isinstance(link, str):
+            link = _LINKS[link or self.default_link]()
+        self._link = link
+
+    @property
+    def link_name(self):
+        return self._link.name
+
+    def link(self, mu):
+        return self._link.link(mu)
+
+    def linkinv(self, eta):
+        return self._link.linkinv(eta)
+
+    def mu_eta(self, eta):
+        """dμ/dη at eta."""
+        return self._link.mu_eta(eta)
+
+    def variance(self, mu):
+        return jnp.ones_like(mu)
+
+    def clamp_mu(self, mu):
+        """Project μ back into the response domain — non-canonical links
+        (poisson+identity, gamma+inverse) can step η outside it, which
+        is where naive IRLS blows up."""
+        return mu
+
+    def deviance(self, w, y, mu):
+        return (w * (y - mu) ** 2).sum()
+
+    def init_mu(self, y, w):
+        return (w * y).sum() / w.sum()
+
+
+class _PositiveFamily(_Family):
+    """μ > 0 response domain (poisson/gamma/negbinomial/tweedie)."""
+
+    def clamp_mu(self, mu):
+        return jnp.maximum(mu, 1e-6)
+
+
+class _Gaussian(_Family):
+    name = "gaussian"
+
+
+class _Binomial(_Family):
+    name = "binomial"
+    default_link = "logit"
+    valid_links = ("logit",)
+
+    def clamp_mu(self, mu):
+        return jnp.clip(mu, 1e-7, 1 - 1e-7)
 
     def variance(self, mu):
         return jnp.maximum(mu * (1 - mu), 1e-10)
@@ -107,17 +230,10 @@ class _Binomial(_Family):
         return jnp.clip((w * y).sum() / w.sum(), 1e-4, 1 - 1e-4)
 
 
-class _Poisson(_Family):
+class _Poisson(_PositiveFamily):
     name = "poisson"
-
-    def link(self, mu):
-        return jnp.log(jnp.maximum(mu, 1e-10))
-
-    def linkinv(self, eta):
-        return jnp.exp(jnp.clip(eta, -30, 30))
-
-    def mu_eta(self, eta):
-        return self.linkinv(eta)
+    default_link = "log"
+    valid_links = ("log", "identity")
 
     def variance(self, mu):
         return jnp.maximum(mu, 1e-10)
@@ -131,17 +247,10 @@ class _Poisson(_Family):
         return jnp.maximum((w * y).sum() / w.sum(), 1e-4)
 
 
-class _Gamma(_Family):
+class _Gamma(_PositiveFamily):
     name = "gamma"
-
-    def link(self, mu):
-        return jnp.log(jnp.maximum(mu, 1e-10))
-
-    def linkinv(self, eta):
-        return jnp.exp(jnp.clip(eta, -30, 30))
-
-    def mu_eta(self, eta):
-        return self.linkinv(eta)
+    default_link = "log"
+    valid_links = ("inverse", "log", "identity")
 
     def variance(self, mu):
         return jnp.maximum(mu * mu, 1e-10)
@@ -168,22 +277,16 @@ class _FractionalBinomial(_Binomial):
     name = "fractionalbinomial"
 
 
-class _NegativeBinomial(_Family):
+class _NegativeBinomial(_PositiveFamily):
     """Family.negativebinomial with log link: Var(μ) = μ + θμ²
     (hex/glm/GLMModel.java NB theta = inverse dispersion parameter)."""
     name = "negativebinomial"
+    default_link = "log"
+    valid_links = ("log", "identity")
 
-    def __init__(self, theta: float = 1.0):
+    def __init__(self, theta: float = 1.0, link=None):
+        super().__init__(link)
         self.theta = max(float(theta), 1e-10)
-
-    def link(self, mu):
-        return jnp.log(jnp.maximum(mu, 1e-10))
-
-    def linkinv(self, eta):
-        return jnp.exp(jnp.clip(eta, -30, 30))
-
-    def mu_eta(self, eta):
-        return self.linkinv(eta)
 
     def variance(self, mu):
         return jnp.maximum(mu + self.theta * mu * mu, 1e-10)
@@ -199,11 +302,81 @@ class _NegativeBinomial(_Family):
         return jnp.maximum((w * y).sum() / w.sum(), 1e-4)
 
 
+class _Tweedie(_PositiveFamily):
+    """Family.tweedie: Var(μ) = φ·μ^p with p = tweedie_variance_power
+    (GLMModel.java:648) and the power link (tweedie_link_power).
+    Compound Poisson-gamma for 1 < p < 2: y ≥ 0 with a point mass at 0.
+    Deviance is the unit tweedie deviance with the usual p→1 / p→2
+    limits (matches GLMModel.java:765-795 tweedie deviance cases)."""
+    name = "tweedie"
+    default_link = "tweedie"
+    valid_links = ("tweedie",)
+
+    def __init__(self, var_power: float = 0.0, link_power: float = 1.0,
+                 link=None):
+        super().__init__(link if link is not None
+                         else _TweedieLink(link_power))
+        self.p = float(var_power)
+
+    def variance(self, mu):
+        return jnp.maximum(jnp.power(jnp.maximum(mu, 1e-10), self.p),
+                           1e-10)
+
+    def deviance(self, w, y, mu):
+        p = self.p
+        mu = jnp.maximum(mu, 1e-10)
+        if p == 0.0:
+            return (w * (y - mu) ** 2).sum()
+        if p == 1.0:
+            yl = jnp.where(y > 0, y * jnp.log(jnp.maximum(y, 1e-10) / mu),
+                           0.0)
+            return 2.0 * (w * (yl - (y - mu))).sum()
+        if p == 2.0:
+            r = jnp.maximum(y, 1e-10) / mu
+            return 2.0 * (w * (-jnp.log(r) + r - 1.0)).sum()
+        yp = jnp.power(jnp.maximum(y, 0.0), 2.0 - p)
+        # y^(2-p)/((1-p)(2-p)) − y·μ^(1-p)/(1-p) + μ^(2-p)/(2-p)
+        term = (yp / ((1.0 - p) * (2.0 - p))
+                - y * jnp.power(mu, 1.0 - p) / (1.0 - p)
+                + jnp.power(mu, 2.0 - p) / (2.0 - p))
+        return 2.0 * (w * term).sum()
+
+    def init_mu(self, y, w):
+        return jnp.maximum((w * y).sum() / w.sum(), 1e-4)
+
+
 _FAMILIES = {"gaussian": _Gaussian, "binomial": _Binomial,
              "poisson": _Poisson, "gamma": _Gamma,
              "quasibinomial": _Quasibinomial,
              "fractionalbinomial": _FractionalBinomial,
-             "negativebinomial": _NegativeBinomial}
+             "negativebinomial": _NegativeBinomial,
+             "tweedie": _Tweedie}
+
+
+def _make_family(family: str, p: Dict) -> _Family:
+    """Construct the family with its (validated) link from builder params
+    — the GLMParameters.validate family↔link matrix
+    (hex/glm/GLMModel.java:560-591)."""
+    link = (p.get("link") or "family_default").lower()
+    cls = _FAMILIES[family]
+    if link not in ("family_default", "") and link not in cls.valid_links:
+        raise ValueError(
+            f"Incompatible link function for selected family. Only "
+            f"{'/'.join(cls.valid_links)} allowed for family={family}. "
+            f"Got {link}")
+    if family == "tweedie":
+        # NB: 0.0 is a meaningful link power (log) — no `or` defaulting
+        twv = p.get("tweedie_variance_power")
+        twl = p.get("tweedie_link_power")
+        fam = _Tweedie(0.0 if twv is None else float(twv),
+                       1.0 if twl is None else float(twl))
+    elif family == "negativebinomial":
+        fam = _NegativeBinomial(
+            float(p.get("theta", 1.0) or 1.0),
+            link=None if link in ("family_default", "") else link)
+    else:
+        fam = cls(link=None if link in ("family_default", "") else link)
+    return fam
 
 
 # ---------------- device kernels --------------------------------------
@@ -575,7 +748,7 @@ class GLMModel(Model):
         eta = Xe @ jnp.asarray(self.beta) + self.intercept_value
         if offset is not None:
             eta = eta + offset
-        fam = _FAMILIES[self.family]()
+        fam = _make_family(self.family, self.params)
         mu = fam.linkinv(eta)
         if self.nclasses == 2:
             return jnp.stack([1.0 - mu, mu], axis=1)
@@ -613,6 +786,67 @@ class GLMModel(Model):
         m.rank = ex["rank"]
         m.beta = arrays["beta"]
         m.impute_means = unpack_impute_means(arrays)
+        return m
+
+
+class HGLMModel(GLMModel):
+    """HGLM fit: gaussian mean model + ONE gaussian random-intercept
+    component (hex/glm/GLMModel.java:390 _HGLM; validation at
+    GLMModel.java:519-546 restricts to gaussian/gaussian + identity
+    links + one categorical random column). Prediction adds the
+    per-level BLUP u to the fixed linear predictor; unseen/NA levels
+    contribute u = 0 (the random effect's prior mean)."""
+    algo = "hglm"
+
+    # extra attrs set by the trainer: rand_column, rand_domain,
+    # ubeta (np [q]), varfix, varranef
+
+    def _predict_matrix(self, X, offset=None):
+        from types import SimpleNamespace
+        ridx = self.feature_names.index(self.rand_column)
+        keep = [i for i in range(len(self.feature_names)) if i != ridx]
+        proxy = SimpleNamespace(
+            feature_names=[self.feature_names[i] for i in keep],
+            feature_is_cat=[self.feature_is_cat[i] for i in keep],
+            cat_domains=self.cat_domains,
+            impute_means=self.impute_means, params={})
+        Xe = expand_scoring_matrix(proxy, X[:, keep])
+        eta = Xe @ jnp.asarray(self.beta) + self.intercept_value
+        if offset is not None:
+            eta = eta + offset
+        u = jnp.asarray(self.ubeta, jnp.float32)
+        codes = jnp.where(jnp.isnan(X[:, ridx]), -1,
+                          X[:, ridx]).astype(jnp.int32)
+        ok = (codes >= 0) & (codes < u.shape[0])
+        uz = jnp.where(ok, u[jnp.clip(codes, 0, u.shape[0] - 1)], 0.0)
+        return eta + uz
+
+    def coef_random(self) -> Dict[str, float]:
+        """Random-effect BLUPs keyed by level (reference 'ubeta')."""
+        return {str(lvl): float(v)
+                for lvl, v in zip(self.rand_domain, self.ubeta)}
+
+    def _save_arrays(self):
+        d = super()._save_arrays()
+        d["ubeta"] = np.asarray(self.ubeta)
+        return d
+
+    def _save_extra_meta(self):
+        d = super()._save_extra_meta()
+        d.update({"rand_column": self.rand_column,
+                  "rand_domain": list(self.rand_domain),
+                  "varfix": self.varfix, "varranef": self.varranef})
+        return d
+
+    @classmethod
+    def _restore(cls, meta, arrays):
+        m = super()._restore(meta, arrays)
+        ex = meta["extra"]
+        m.rand_column = ex["rand_column"]
+        m.rand_domain = tuple(ex["rand_domain"])
+        m.varfix = ex["varfix"]
+        m.varranef = ex["varranef"]
+        m.ubeta = arrays["ubeta"]
         return m
 
 
@@ -684,8 +918,14 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
         if family not in _FAMILIES:
             raise NotImplementedError(
                 f"family '{family}' is not supported in streaming mode")
-        fam = (_NegativeBinomial(float(p.get("theta", 1.0) or 1.0))
-               if family == "negativebinomial" else _FAMILIES[family]())
+        fam = _make_family(family, p)
+        if fam.link_name != type(fam).default_link or family == "tweedie":
+            # the chunked IRLS loop has no line-search guard; without it
+            # non-canonical links can diverge to NaN silently (dense
+            # path has the halving guard)
+            raise NotImplementedError(
+                "non-canonical links and family=tweedie are not "
+                "supported in streaming (memory-pressure) mode")
         rows = spec.nrow
         Xh = spec.X_host[:rows]
         yh = np.asarray(jax.device_get(spec.y))[:rows].astype(np.float32)
@@ -755,7 +995,7 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
                 yv = jnp.asarray(yh[s:e])
                 wv = jnp.asarray(wh[s:e])
                 eta = Xs @ beta
-                mu = fam.linkinv(eta)
+                mu = fam.clamp_mu(fam.linkinv(eta))
                 dmu = fam.mu_eta(eta)
                 var = fam.variance(mu)
                 w_irls = wv * dmu * dmu / var
@@ -806,7 +1046,224 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
                 muj, yj, wj, 1, deviance=res_dev / max(wh.sum(), 1e-12))
         return model
 
+    def _train_hglm(self, spec: TrainingSpec, valid_spec,
+                    job: Job) -> "HGLMModel":
+        """HGLM (GLM.java HGLM mode / Lee & Nelder h-likelihood):
+        y = Xβ + Zu + e with u ~ N(0, σ²_u I_q) over ONE categorical
+        random-intercept column, e ~ N(0, σ²_e), identity links
+        (validation mirrors GLMModel.java:519-546).
+
+        TPU redesign: instead of the reference's per-chunk HGLM tasks,
+        each EM step is Henderson's mixed-model equations solved by a
+        Schur complement on the fixed block — Z'Z is diagonal so the
+        random block inverts elementwise and the only dense solve is
+        F×F. The Gram/group-sum reductions are one-hot matmuls (MXU)
+        over the row-sharded design. Variance components update by
+        EM-REML; the fixed point equals the directly optimized REML
+        criterion (tests/test_hglm.py golden)."""
+        from dataclasses import replace as dc_replace
+        p = self.params
+        family = self._resolve_family(spec)
+        if family not in ("gaussian",):
+            raise ValueError("HGLM only supports Gaussian distributions "
+                             "for now.")
+        link = (p.get("link") or "family_default").lower()
+        if link not in ("family_default", "", "identity"):
+            raise ValueError("HGLM only supports identity link functions "
+                             "for now.")
+        for rf in (p.get("rand_family") or []):
+            if str(rf).lower() != "gaussian":
+                raise ValueError("HGLM only supports Gaussian "
+                                 "distributions for now.")
+        for rl in (p.get("rand_link") or []):
+            if str(rl).lower() not in ("identity", "family_default"):
+                raise ValueError("HGLM only supports identity link "
+                                 "functions for now.")
+        if p.get("lambda_search"):
+            raise ValueError("HGLM does not allow lambda search.")
+        if spec.offset is not None:
+            raise NotImplementedError(
+                "offset_column is not supported with HGLM")
+        rc = p.get("random_columns")
+        if not rc:
+            raise ValueError("Need to specify the random component "
+                             "columns for HGLM.")
+        if isinstance(rc, (str, int)):
+            rc = [rc]
+        if len(rc) != 1:
+            raise ValueError("HGLM only supports ONE random component "
+                             "for now.")
+        r0 = rc[0]
+        if isinstance(r0, int) or (isinstance(r0, str) and r0.isdigit()):
+            ridx = int(r0)
+            if not (0 <= ridx < len(spec.names)):
+                raise ValueError(f"random_columns index {ridx} out of "
+                                 f"range for predictors {spec.names}")
+        else:
+            if r0 not in spec.names:
+                raise ValueError(f"random_columns '{r0}' is not a "
+                                 f"predictor column")
+            ridx = spec.names.index(r0)
+        rname = spec.names[ridx]
+        if not spec.is_cat[ridx]:
+            raise ValueError("HGLM random_columns: Must contain "
+                             "categorical columns.")
+        rdom = spec.cat_domains.get(rname) or ()
+        q = len(rdom)
+        if q < 2:
+            raise ValueError(f"random column '{rname}' needs >= 2 levels")
+
+        codes = jnp.where(jnp.isnan(spec.X[:, ridx]), -1,
+                          spec.X[:, ridx]).astype(jnp.int32)
+        keep = [i for i in range(len(spec.names)) if i != ridx]
+        fspec = dc_replace(
+            spec, X=spec.X[:, jnp.asarray(keep)],
+            names=[spec.names[i] for i in keep],
+            is_cat=[spec.is_cat[i] for i in keep])
+        Xe, exp_names, means = expand_design(fspec)
+        n_pad = Xe.shape[0]
+        Fe = Xe.shape[1]
+        Xf = jnp.concatenate([Xe, jnp.ones((n_pad, 1), jnp.float32)],
+                             axis=1)
+        pf = Fe + 1
+        y = spec.y.astype(jnp.float32)
+        # NA random-column rows carry no group info: drop them (weight 0)
+        w = spec.w * (codes >= 0)
+        nobs = float(jax.device_get(w.sum()))
+
+        # one-hot group reductions ride the MXU (q × n · n × F)
+        onehot = (codes[:, None] == jnp.arange(q)[None, :]).astype(
+            jnp.float32) * w[:, None]
+
+        @jax.jit
+        def _moments():
+            Xw = Xf * w[:, None]
+            XtX = Xw.T @ Xf
+            Xty = Xw.T @ y
+            counts = onehot.sum(axis=0)
+            Zty = onehot.T @ y
+            M = onehot.T @ Xf                       # [q, pf]
+            return XtX, Xty, counts, Zty, M
+
+        XtX, Xty, counts, Zty, M = _moments()
+
+        @jax.jit
+        def em_step(se2, su2):
+            lam = se2 / jnp.maximum(su2, 1e-12)
+            D = counts + lam
+            Md = M / D[:, None]
+            A = XtX - Md.T @ M
+            rhs = Xty - M.T @ (Zty / D)
+            beta = jnp.linalg.solve(A, rhs)
+            u = (Zty - M @ beta) / D
+            r = (y - Xf @ beta - u[jnp.clip(codes, 0, q - 1)]) * (w > 0)
+            rss = (w * r * r).sum()
+            Ainv_Mt = jnp.linalg.solve(A, Md.T)     # [pf, q]
+            tr_uu = (1.0 / D).sum() + (Md * Ainv_Mt.T).sum()
+            su2_new = ((u * u).sum() + se2 * tr_uu) / q
+            se2_new = (rss + se2 * (pf + q - lam * tr_uu)) / nobs
+            return beta, u, rss, tr_uu, su2_new, se2_new, A, D
+
+        var_y = float(jax.device_get(
+            (w * (y - (w * y).sum() / nobs) ** 2).sum() / nobs))
+        se2, su2 = var_y, max(var_y / 2, 1e-6)
+        max_iter = _max_iter_of(p, 100)
+        eta_prev = None
+        convergence = float("nan")
+        it = 0
+        converged = False
+        for it in range(max_iter):
+            beta, u, rss, tr_uu, su2_n, se2_n, A, D = em_step(
+                jnp.float32(se2), jnp.float32(su2))
+            se2_new = float(jax.device_get(se2_n))
+            su2_new = float(jax.device_get(su2_n))
+            done = (abs(se2_new - se2) < 1e-9 * (1 + se2)
+                    and abs(su2_new - su2) < 1e-9 * (1 + su2))
+            se2, su2 = max(se2_new, 1e-12), max(su2_new, 1e-12)
+            # convergence diagnostic Σ(η_i−η_prev)²/Ση² (GLM.java:569)
+            eta_i = Xf @ beta + u[jnp.clip(codes, 0, q - 1)] * (codes >= 0)
+            if eta_prev is not None:
+                convergence = float(jax.device_get(
+                    ((eta_i - eta_prev) ** 2).sum()
+                    / jnp.maximum((eta_i ** 2).sum(), 1e-12)))
+            eta_prev = eta_i
+            job.set_progress((it + 1) / max_iter)
+            if done:
+                converged = True
+                break
+        beta, u = np.asarray(jax.device_get(beta)), np.asarray(
+            jax.device_get(u))
+        rss = float(jax.device_get(rss))
+        tr_uu = float(jax.device_get(tr_uu))
+
+        # standard errors from σ²_e·C⁻¹: fixed block = A⁻¹ (Schur),
+        # random block diag = 1/D + rowwise M/D·A⁻¹·(M/D)'
+        A_h = np.asarray(jax.device_get(A))
+        D_h = np.asarray(jax.device_get(D))
+        M_h = np.asarray(jax.device_get(M))
+        Ainv = np.linalg.inv(A_h)
+        sefe = np.sqrt(np.maximum(se2 * np.diag(Ainv), 0.0))
+        Md_h = M_h / D_h[:, None]
+        cuu_diag = 1.0 / D_h + np.einsum("qf,fg,qg->q", Md_h, Ainv, Md_h)
+        sere = np.sqrt(np.maximum(se2 * cuu_diag, 0.0))
+
+        # h-likelihood family (Lee & Nelder 1996): joint loglik + the
+        # adjusted profiles; cAIC with effective dof p+q−λ·tr(C⁻¹uu)
+        uu = float(u @ u)
+        hlik = (-0.5 * nobs * np.log(2 * np.pi * se2) - rss / (2 * se2)
+                - 0.5 * q * np.log(2 * np.pi * su2) - uu / (2 * su2))
+        lam = se2 / su2
+        log_det_D = float(np.sum(np.log(D_h)))
+        sgn, log_det_A = np.linalg.slogdet(A_h)
+        # pvh: profile over u → subtract ½·log det(D/(2π σ²_e))
+        pvh = hlik - 0.5 * (log_det_D - q * np.log(2 * np.pi * se2))
+        # pbvh: profile over (β,u) jointly
+        pbvh = hlik - 0.5 * (log_det_A + log_det_D
+                             - (pf + q) * np.log(2 * np.pi * se2))
+        cond_ll = -0.5 * nobs * np.log(2 * np.pi * se2) - rss / (2 * se2)
+        pd = pf + q - lam * tr_uu
+        caic = -2.0 * cond_ll + 2.0 * pd
+        dfrefe = nobs - pd
+
+        null_dev = float(jax.device_get(
+            (w * (y - (w * y).sum() / max(nobs, 1e-12)) ** 2).sum()))
+        model = HGLMModel(f"hglm_{id(self) & 0xffffff:x}", self.params,
+                          spec, "gaussian", beta[:Fe], float(beta[Fe]),
+                          exp_names,
+                          {k: float(jax.device_get(v))
+                           for k, v in means.items()},
+                          0.0, null_dev, rss, nobs, pf)
+        model.rand_column = rname
+        model.rand_domain = tuple(str(v) for v in rdom)
+        model.ubeta = u
+        model.varfix = se2
+        model.varranef = su2
+        from h2o3_tpu.models.metrics import (
+            ModelMetricsHGLMGaussianGaussian)
+        mse = rss / max(nobs, 1e-12)
+        model.training_metrics = ModelMetricsHGLMGaussianGaussian(
+            fixef=[float(v) for v in beta],
+            ranef=[float(v) for v in u],
+            sefe=[float(v) for v in sefe],
+            sere=[float(v) for v in sere],
+            varfix=se2, varranef=[su2], hlik=float(hlik),
+            pvh=float(pvh), pbvh=float(pbvh), caic=float(caic),
+            dfrefe=float(dfrefe), converge=converged,
+            convergence=convergence, iterations=it + 1,
+            mse=float(mse), nobs=int(nobs))
+        model.output["coefficients"] = model.coef()
+        model.output["random_coefficients"] = model.coef_random()
+        model.output["varfix"] = se2
+        model.output["varranef"] = su2
+        return model
+
     def _train_impl(self, spec: TrainingSpec, valid_spec, job: Job) -> GLMModel:
+        if self.params.get("HGLM"):
+            if spec.stream:
+                raise NotImplementedError(
+                    "HGLM does not support streaming (memory-pressure) "
+                    "mode")
+            return self._train_hglm(spec, valid_spec, job)
         if spec.stream:
             if valid_spec is not None:
                 raise NotImplementedError(
@@ -821,18 +1278,23 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
         if family not in _FAMILIES:
             raise ValueError(f"unsupported family '{family}'; have "
                              f"{sorted(_FAMILIES)}")
-        link = (p.get("link") or "family_default").lower()
-        canon = {"gaussian": "identity", "binomial": "logit",
-                 "poisson": "log", "gamma": "log",
-                 "quasibinomial": "logit", "fractionalbinomial": "logit",
-                 "negativebinomial": "log"}[family]
-        if link not in ("family_default", "", canon):
-            raise NotImplementedError(
-                f"non-canonical link '{link}' for family '{family}' is not "
-                f"implemented (canonical links only)")
         fit_intercept = bool(p.get("intercept", True))
-        fam = (_NegativeBinomial(float(p.get("theta", 1.0) or 1.0))
-               if family == "negativebinomial" else _FAMILIES[family]())
+        fam = _make_family(family, p)
+        if family == "tweedie":
+            # response-domain validation (GLMModel.java tweedie checks):
+            # y < 0 never valid; y = 0 has zero density for p >= 2 and
+            # the deviance's y^(2-p) term is +inf → the fit would be
+            # silently frozen at the null model by the line-search guard
+            live = spec.w > 0
+            if bool(jax.device_get((live & (spec.y < 0)).any())):
+                raise ValueError(
+                    "family=tweedie requires a non-negative response")
+            if fam.p >= 2.0 and bool(jax.device_get(
+                    (live & (spec.y == 0)).any())):
+                raise ValueError(
+                    f"tweedie_variance_power={fam.p} requires a strictly "
+                    f"positive response (y=0 rows are only valid for "
+                    f"1 < p < 2)")
         y = spec.y.astype(jnp.float32)
         w = spec.w
         offset = spec.offset
@@ -872,20 +1334,21 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
         else:
             lambdas = None
 
-        # initial state
+        # initial state: η₀ = g(μ₀) through the model's actual link
         mu0 = fam.init_mu(y, w)
-        eta = jnp.full_like(y, jnp.log(mu0 / (1 - mu0)) if family == "binomial"
-                            else (jnp.log(mu0) if family in ("poisson", "gamma")
-                                  else mu0))
+        eta = jnp.full_like(y, fam.link(mu0))
         if offset is not None:
             eta = eta + offset
         null_dev = float(jax.device_get(fam.deviance(w, y, fam.linkinv(eta))))
 
         if lambdas is None:
             if p.get("lambda_search"):
-                # λ_max: smallest λ zeroing all penalized coefs
+                # λ_max: smallest λ zeroing all penalized coefs —
+                # score ∇ = Xᵀ(w·(y−μ)·μ'/V); for canonical links
+                # μ' == V and this reduces to Xᵀw(y−μ)
                 mu = fam.linkinv(eta)
-                g0 = Xs[:, :Fe].T @ (w * (y - mu))
+                g0 = Xs[:, :Fe].T @ (w * (y - mu) * fam.mu_eta(eta)
+                                     / fam.variance(mu))
                 lmax = float(jax.device_get(
                     jnp.max(jnp.abs(g0)))) / max(nobs * max(alpha, 1e-3), 1e-12)
                 nl = int(p.get("nlambdas", 30) or 30)
@@ -923,6 +1386,11 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
         solver = (str(p.get("solver") or "auto")
                   ).upper().replace("-", "_")
         use_lbfgs = solver in ("L_BFGS", "LBFGS")
+        if use_lbfgs and (family == "tweedie"
+                          or fam.link_name != type(fam).default_link):
+            # _nll_mean's closed-form objectives assume the canonical
+            # link; tweedie / non-canonical pairs go through IRLSM
+            use_lbfgs = False
         if p.get("beta_constraints") and use_lbfgs:
             # box bounds are enforced by the projected-CD IRLS solver
             use_lbfgs = False
@@ -997,7 +1465,7 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
                 eta_i = Xs @ beta_s
                 if offset is not None:
                     eta_i = eta_i + offset
-                mu = fam.linkinv(eta_i)
+                mu = fam.clamp_mu(fam.linkinv(eta_i))
                 dmu = fam.mu_eta(eta_i)
                 var = fam.variance(mu)
                 w_irls = w * dmu * dmu / var
@@ -1047,7 +1515,7 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
                 eta_i = Xs @ beta_s
                 if offset is not None:
                     eta_i = eta_i + offset
-                mu = fam.linkinv(eta_i)
+                mu = fam.clamp_mu(fam.linkinv(eta_i))
                 dmu = fam.mu_eta(eta_i)
                 var = fam.variance(mu)
                 w_irls = w * dmu * dmu / var
@@ -1061,6 +1529,17 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
 
         step_chol = _make_step(False)
         step_cd = _make_step(True) if alpha > 0 else None
+
+        @jax.jit
+        def _merit_kernel(bvec, l1, l2):
+            """Penalized objective for the non-canonical-link line
+            search: deviance/2 + λ₁‖β‖₁ + λ₂/2·‖β‖₂² on penalized
+            coordinates (defined once — jit caches across the λ path)."""
+            ef = Xs @ bvec + (0.0 if offset is None else offset)
+            devm = fam.deviance(w, y, fam.clamp_mu(fam.linkinv(ef)))
+            bp = bvec * pen_mask
+            return (0.5 * devm + l1 * jnp.abs(bp).sum()
+                    + 0.5 * l2 * (bp * bp).sum())
         if bc is not None and bc:
             step_bc = _make_step_bc()
 
@@ -1084,6 +1563,12 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
             voff = valid_spec.offset
 
         beta_s = jnp.zeros(ncoef, jnp.float32)
+        if fit_intercept:
+            # start at the null model β=(0,…,0,g(μ₀)) — for links like
+            # inverse, η=0 is outside the usable region and IRLS from a
+            # zero vector cannot recover (GLM.java starts from the null
+            # model the same way)
+            beta_s = beta_s.at[Fe].set(fam.link(mu0))
         best = None
         submodels = []
         for li, lam in enumerate(lambdas):
@@ -1099,15 +1584,46 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
                     irls_step = step_bc
                 lam1 = jnp.float32(lam * alpha * nobs)
                 lam2 = jnp.float32(lam * (1 - alpha) * nobs)
+                # non-canonical links (and tweedie's power pair) are not
+                # guaranteed monotone under plain IRLS — guard each step
+                # with halving on the PENALIZED objective (deviance/2 +
+                # λ₁‖β‖₁ + λ₂/2‖β‖₂² on penalized coords), the same
+                # merit hex/glm/GLM.java's IRLSM line search uses; raw
+                # deviance alone would reject legitimate shrinkage steps
+                # when warm-starting up an ascending lambda list
+                guard = (fam.link_name != type(fam).default_link
+                         or family == "tweedie")
+
+                def _merit_of(bvec):
+                    return float(jax.device_get(
+                        _merit_kernel(bvec, lam1, lam2)))
+
+                prev_mer = _merit_of(beta_s) if guard else None
                 for it in range(max_iter):
                     nb = irls_step(beta_s, lam1, lam2)
+                    if guard:
+                        mer_t = _merit_of(nb)
+                        halvings = 0
+                        while ((not np.isfinite(mer_t)
+                                or mer_t > prev_mer * (1 + 1e-8))
+                               and halvings < 8):
+                            nb = 0.5 * (nb + beta_s)
+                            mer_t = _merit_of(nb)
+                            halvings += 1
+                        if (not np.isfinite(mer_t)
+                                or mer_t > prev_mer * (1 + 1e-8)):
+                            break  # no descent direction left
+                        prev_mer = mer_t
                     delta = float(jax.device_get(
                         jnp.max(jnp.abs(nb - beta_s))))
                     beta_s = nb
                     if delta < beta_eps:
                         break
-                    if family == "gaussian" and not use_cd:
+                    if (family == "gaussian" and not use_cd
+                            and fam.link_name == "identity"):
                         break  # weighted least squares: one solve is exact
+                        # (non-identity links keep iterating — the working
+                        # response changes with η)
             eta_f = Xs @ beta_s + (0.0 if offset is None else offset)
             dev = float(jax.device_get(fam.deviance(w, y, fam.linkinv(eta_f))))
             sel_dev = dev
@@ -1165,7 +1681,7 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
             df = max(nobs - rank, 1.0)
             if family == "gaussian":
                 dispersion = res_dev / df
-            elif family == "gamma":
+            elif family in ("gamma", "tweedie"):
                 # Pearson dispersion estimate
                 pearson = float(jax.device_get(
                     (w * (y - mu_r) ** 2 / jnp.maximum(var_r, 1e-12)).sum()))
@@ -1436,3 +1952,4 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
 
 
 register_model_class("glm", GLMModel)
+register_model_class("hglm", HGLMModel)

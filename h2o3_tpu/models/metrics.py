@@ -39,6 +39,42 @@ def _regression_kernel(pred, actual, w):
 
 
 @dataclass
+class ModelMetricsHGLMGaussianGaussian:
+    """HGLM gaussian/gaussian metrics — field-for-field analog of
+    hex/ModelMetricsHGLMGaussianGaussian.java (sefe/sere per-coefficient
+    standard errors, varfix/varranef dispersion components, the
+    h-likelihood family hlik/pvh/pbvh and conditional AIC, plus the
+    Σ(ηᵢ−η₀)²/Σηᵢ² convergence ratio of GLM.java:569)."""
+    fixef: list
+    ranef: list
+    sefe: list
+    sere: list
+    varfix: float
+    varranef: list
+    hlik: float
+    pvh: float
+    pbvh: float
+    caic: float
+    dfrefe: float
+    converge: bool
+    convergence: float
+    iterations: int
+    mse: float
+    nobs: int
+
+    def to_dict(self) -> Dict:
+        return {"fixef": self.fixef, "ranef": self.ranef,
+                "sefe": self.sefe, "sere": self.sere,
+                "varfix": self.varfix, "varranef": self.varranef,
+                "hlik": self.hlik, "pvh": self.pvh, "pbvh": self.pbvh,
+                "caic": self.caic, "dfrefe": self.dfrefe,
+                "converge": self.converge,
+                "convergence": self.convergence,
+                "iterations": self.iterations,
+                "MSE": self.mse, "nobs": self.nobs}
+
+
+@dataclass
 class ModelMetricsRegression:
     mse: float
     rmse: float

@@ -1,0 +1,137 @@
+"""HGLM — gaussian mixed model with one categorical random intercept.
+
+Reference: hex/glm/GLMModel.java:390 (_HGLM) + validation at :519-546,
+hex/ModelMetricsHGLM.java fields. Golden: the EM-REML fixed point must
+match the directly optimized profile-REML criterion (scipy), which is
+also what R lme4 REML produces for this model.
+"""
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+
+
+def _simulate(seed=0, n=4000, q=30, sig_e=0.7, sig_u=1.3):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 2))
+    g = rng.integers(0, q, n)
+    u = rng.normal(0, sig_u, q)
+    y = 2.0 + 1.0 * X[:, 0] - 0.5 * X[:, 1] + u[g] \
+        + rng.normal(0, sig_e, n)
+    return X, g, u, y
+
+
+def _reml_golden(Xf, g, y, q):
+    """Directly optimized profile-REML (same criterion lme4 uses)."""
+    from scipy.optimize import minimize_scalar
+    n, pf = Xf.shape
+    XtX, Xty = Xf.T @ Xf, Xf.T @ y
+    counts = np.bincount(g, minlength=q).astype(float)
+    Zty = np.bincount(g, weights=y, minlength=q)
+    M = np.stack([np.bincount(g, weights=Xf[:, j], minlength=q)
+                  for j in range(pf)], axis=1)
+
+    def neg_reml(log_lam):
+        lam = np.exp(log_lam)
+        D = counts + lam
+        A = XtX - (M / D[:, None]).T @ M
+        b = np.linalg.solve(A, Xty - M.T @ (Zty / D))
+        u = (Zty - M @ b) / D
+        r = y - Xf @ b - u[g]
+        se2h = (r @ r + lam * u @ u) / (n - pf)
+        _, ld = np.linalg.slogdet(A)
+        return ((n - pf) * np.log(se2h) + np.sum(np.log(D))
+                - q * np.log(lam) + ld)
+
+    res = minimize_scalar(neg_reml, bounds=(-8, 8), method="bounded",
+                          options={"xatol": 1e-12})
+    lam = np.exp(res.x)
+    D = counts + lam
+    A = XtX - (M / D[:, None]).T @ M
+    b = np.linalg.solve(A, Xty - M.T @ (Zty / D))
+    u = (Zty - M @ b) / D
+    r = y - Xf @ b - u[g]
+    se2 = (r @ r + lam * u @ u) / (n - pf)
+    return b, u, se2, se2 / lam
+
+
+def test_hglm_matches_reml():
+    X, g, _, y = _simulate()
+    q = 30
+    fr = h2o.Frame.from_numpy({
+        "x1": X[:, 0], "x2": X[:, 1],
+        "grp": np.array([f"g{int(v):02d}" for v in g]),
+        "y": y})
+    glm = H2OGeneralizedLinearEstimator(
+        family="gaussian", HGLM=True, random_columns=["grp"],
+        standardize=False)
+    glm.train(y="y", training_frame=fr)
+    m = glm.model
+    Xf = np.concatenate([X, np.ones((len(y), 1))], 1)
+    b_g, u_g, se2_g, su2_g = _reml_golden(Xf, g, y, q)
+    co = m.coef()
+    assert abs(co["x1"] - b_g[0]) < 2e-3
+    assert abs(co["x2"] - b_g[1]) < 2e-3
+    assert abs(co["Intercept"] - b_g[2]) < 5e-3
+    assert abs(m.varfix - se2_g) / se2_g < 0.02
+    assert abs(m.varranef - su2_g) / su2_g < 0.02
+    # BLUPs match (grp domain is sorted g00..g29 == code order)
+    ub = np.array([m.coef_random()[f"g{k:02d}"] for k in range(q)])
+    np.testing.assert_allclose(ub, u_g, atol=5e-3)
+
+
+def test_hglm_metrics_and_predict():
+    X, g, _, y = _simulate(seed=1, n=2000, q=12)
+    fr = h2o.Frame.from_numpy({
+        "x1": X[:, 0], "x2": X[:, 1],
+        "grp": np.array([f"g{int(v):02d}" for v in g]),
+        "y": y})
+    glm = H2OGeneralizedLinearEstimator(
+        family="gaussian", HGLM=True, random_columns=["grp"])
+    glm.train(y="y", training_frame=fr)
+    m = glm.model
+    mm = m.training_metrics
+    d = mm.to_dict()
+    for k in ("fixef", "ranef", "sefe", "sere", "varfix", "varranef",
+              "hlik", "pvh", "pbvh", "caic", "dfrefe", "convergence",
+              "iterations"):
+        assert k in d
+    assert len(d["ranef"]) == 12 and len(d["sere"]) == 12
+    assert np.isfinite(d["hlik"]) and np.isfinite(d["caic"])
+    assert d["pvh"] <= d["hlik"] + 1e-6  # profiles subtract a penalty
+    # prediction includes the random effect: groups with large |u|
+    # must shift predictions accordingly
+    pred = np.asarray(m.predict(fr).vec("predict").to_numpy())
+    resid = y - pred
+    assert resid.var() < 1.2 * m.varfix
+    # save/load roundtrip keeps the BLUP table
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        p = h2o.save_model(m, td, filename="hg")
+        m2 = h2o.load_model(p)
+        pred2 = np.asarray(m2.predict(fr).vec("predict").to_numpy())
+        np.testing.assert_allclose(pred, pred2, rtol=1e-5)
+
+
+def test_hglm_validation_errors():
+    X, g, _, y = _simulate(seed=2, n=500, q=5)
+    fr = h2o.Frame.from_numpy({
+        "x1": X[:, 0],
+        "grp": np.array([f"g{int(v)}" for v in g]),
+        "y": y})
+    # no random_columns
+    glm = H2OGeneralizedLinearEstimator(family="gaussian", HGLM=True)
+    with pytest.raises((ValueError, RuntimeError),
+                       match="random component"):
+        glm.train(y="y", training_frame=fr)
+    # numeric random column rejected
+    glm2 = H2OGeneralizedLinearEstimator(
+        family="gaussian", HGLM=True, random_columns=["x1"])
+    with pytest.raises((ValueError, RuntimeError), match="categorical"):
+        glm2.train(y="y", training_frame=fr)
+    # non-gaussian family rejected
+    glm3 = H2OGeneralizedLinearEstimator(
+        family="poisson", HGLM=True, random_columns=["grp"])
+    with pytest.raises((ValueError, RuntimeError), match="Gaussian"):
+        glm3.train(y="y", training_frame=fr)
