@@ -49,6 +49,26 @@ if os.environ.get("H2O3_TPU_TEST_PLATFORM", "cpu") == "cpu":
     worker = os.environ.get("PYTEST_XDIST_WORKER")
     if worker:
         cache_dir = f"{cache_dir}_{worker}"
+    # single-writer lock: two concurrent pytest INVOCATIONS sharing the
+    # dir have produced torn cache entries that abort() every later run
+    # at deserialize time (observed as SIGABRT inside a jnp.where
+    # compile, reproducible until the dir was wiped). The second
+    # concurrent run gets a private cold dir instead.
+    try:
+        import atexit
+        import fcntl
+        os.makedirs(cache_dir, exist_ok=True)
+        _cache_lock_fd = open(os.path.join(cache_dir, ".writer_lock"),
+                              "w")
+        try:
+            fcntl.flock(_cache_lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            import shutil
+            cache_dir = f"{cache_dir}_p{os.getpid()}"
+            atexit.register(shutil.rmtree, cache_dir,
+                            ignore_errors=True)
+    except OSError:
+        pass
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     # concurrent XLA dispatch from CV/grid build threads can abort() the

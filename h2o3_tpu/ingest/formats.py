@@ -236,36 +236,212 @@ def parse_orc(path: str, mesh=None, key: Optional[str] = None) -> Frame:
                                  key=key or os.path.basename(path))
 
 
+class _AvroReader:
+    """Pure-stdlib Avro Object Container File decoder
+    (h2o-parsers/h2o-avro-parser AvroParser analog — the reference
+    flattens top-level record fields into frame columns the same way).
+    Supports null/deflate codecs and flat record schemas of primitives,
+    2-branch nullable unions, and enum fields; nested records/arrays/
+    maps raise, matching the reference parser's tabular restriction."""
+
+    MAGIC = b"Obj\x01"
+
+    def __init__(self, buf: bytes):
+        self.b = buf
+        self.pos = 0
+
+    def _long(self) -> int:
+        # zigzag varint
+        shift, acc = 0, 0
+        while True:
+            byte = self.b[self.pos]
+            self.pos += 1
+            acc |= (byte & 0x7F) << shift
+            if not byte & 0x80:
+                break
+            shift += 7
+        return (acc >> 1) ^ -(acc & 1)
+
+    def _bytes(self) -> bytes:
+        n = self._long()
+        out = self.b[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def _raw(self, n: int) -> bytes:
+        out = self.b[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def read_header(self):
+        import json
+        if self._raw(4) != self.MAGIC:
+            raise ValueError("not an Avro object container file")
+        meta = {}
+        while True:
+            n = self._long()
+            if n == 0:
+                break
+            if n < 0:          # block with byte-size prefix
+                self._long()
+                n = -n
+            for _ in range(n):
+                k = self._bytes().decode()
+                meta[k] = self._bytes()
+        self.sync = self._raw(16)
+        self.codec = meta.get("avro.codec", b"null").decode()
+        self.schema = json.loads(meta["avro.schema"].decode())
+        if self.schema.get("type") != "record":
+            raise NotImplementedError(
+                "only record-schema avro files parse to frames")
+        return self.schema
+
+    def _decode_value(self, typ):
+        if isinstance(typ, dict):
+            t = typ.get("type")
+            if t == "enum":
+                return typ["symbols"][self._long()]
+            if t in ("record", "array", "map", "fixed"):
+                raise NotImplementedError(
+                    f"nested avro type '{t}' is not tabular")
+            typ = t
+        if isinstance(typ, list):            # union
+            branch = typ[self._long()]
+            return self._decode_value(branch)
+        if typ == "null":
+            return None
+        if typ == "boolean":
+            v = self.b[self.pos]
+            self.pos += 1
+            return bool(v)
+        if typ in ("int", "long"):
+            return self._long()
+        if typ == "float":
+            import struct
+            return struct.unpack("<f", self._raw(4))[0]
+        if typ == "double":
+            import struct
+            return struct.unpack("<d", self._raw(8))[0]
+        if typ == "string":
+            return self._bytes().decode()
+        if typ == "bytes":
+            return self._bytes()
+        raise NotImplementedError(f"avro type '{typ}'")
+
+    def records(self):
+        import zlib
+        fields = self.schema["fields"]
+        while self.pos < len(self.b):
+            n_obj = self._long()
+            n_bytes = self._long()
+            block = self._raw(n_bytes)
+            if self._raw(16) != self.sync:
+                raise ValueError("avro sync marker mismatch")
+            if self.codec == "deflate":
+                block = zlib.decompress(block, -15)
+            elif self.codec != "null":
+                raise NotImplementedError(
+                    f"avro codec '{self.codec}' (null/deflate supported)")
+            sub = _AvroReader(block)
+            for _ in range(n_obj):
+                yield {f["name"]: sub._decode_value(f["type"])
+                       for f in fields}
+
+
 def parse_avro(path: str, mesh=None, key: Optional[str] = None) -> Frame:
-    try:
-        import fastavro  # noqa: F401
-    except ImportError as e:
-        raise NotImplementedError(
-            "avro ingest needs the optional 'fastavro' package, which "
-            "this image does not carry (h2o-parsers/h2o-avro-parser "
-            "analog is gated)") from e
-    import fastavro
     with open(path, "rb") as f:
-        records = list(fastavro.reader(f))
+        rd = _AvroReader(f.read())
+    try:
+        rd.read_header()
+        records = list(rd.records())
+    except (IndexError, KeyError) as e:
+        raise ValueError(f"{path}: truncated or malformed avro "
+                         f"container file") from e
     if not records:
         raise ValueError(f"{path}: empty avro file")
-    names = list(records[0].keys())
-    data = {n: np.asarray([r.get(n) for r in records]) for n in names}
-    return Frame.from_numpy(data, mesh=mesh)
+    names = [f["name"] for f in rd.schema["fields"]]
+    cols = {}
+    for n in names:
+        vals = [r.get(n) for r in records]
+        if any(isinstance(v, (str, bytes)) for v in vals):
+            cols[n] = np.asarray(
+                ["" if v is None
+                 else (v.decode("utf-8", "replace")
+                       if isinstance(v, bytes) else str(v))
+                 for v in vals])
+        else:
+            cols[n] = np.asarray([np.nan if v is None else float(v)
+                                  for v in vals])
+    return Frame.from_numpy(cols, mesh=mesh)
 
 
 def parse_xls(path: str, mesh=None, key: Optional[str] = None) -> Frame:
-    try:
-        import openpyxl  # noqa: F401
-    except ImportError as e:
+    """xlsx ingest (water/parser/XlsxParser.java analog) — xlsx is a
+    zip of XML sheets; decode sheet1 + sharedStrings with stdlib only.
+    Legacy BIFF .xls still requires the absent xlrd and stays gated."""
+    import re
+    import xml.etree.ElementTree as ET
+    import zipfile as zf
+    if path.lower().endswith(".xls"):
         raise NotImplementedError(
-            "xls(x) ingest needs the optional 'openpyxl' package, which "
-            "this image does not carry (water/parser/XlsParser.java "
-            "analog is gated)") from e
-    import pandas as pd
-    df = pd.read_excel(path)
-    return Frame.from_numpy(
-        {c: df[c].to_numpy() for c in df.columns}, mesh=mesh)
+            "legacy BIFF .xls needs the optional 'xlrd' package, which "
+            "this image does not carry; convert to .xlsx or csv")
+    ns = {"m": ("http://schemas.openxmlformats.org/spreadsheetml/2006/"
+                "main")}
+    with zf.ZipFile(path) as z:
+        shared = []
+        if "xl/sharedStrings.xml" in z.namelist():
+            root = ET.fromstring(z.read("xl/sharedStrings.xml"))
+            for si in root.findall("m:si", ns):
+                shared.append("".join(t.text or ""
+                                      for t in si.iter(
+                                          "{%s}t" % ns["m"])))
+        sheets = sorted(n for n in z.namelist()
+                        if re.match(r"xl/worksheets/sheet\d*\.xml$", n))
+        if not sheets:
+            raise ValueError(f"{path}: xlsx archive has no worksheet "
+                             f"part (xl/worksheets/sheet*.xml)")
+        root = ET.fromstring(z.read(sheets[0]))
+    rows = []
+    for row in root.iter("{%s}row" % ns["m"]):
+        cells = {}
+        seq = 0
+        for c in row.findall("m:c", ns):
+            # c/@r is optional in OOXML — position falls back to the
+            # next sequential column when the writer omits it
+            ref = c.get("r") or ""
+            mref = re.match(r"([A-Z]+)", ref)
+            if mref:
+                ci = 0
+                for ch in mref.group(1):
+                    ci = ci * 26 + (ord(ch) - 64)
+            else:
+                ci = seq + 1
+            seq = ci
+            v = c.find("m:v", ns)
+            raw = v.text if v is not None else None
+            if c.get("t") == "s" and raw is not None:
+                raw = shared[int(raw)]
+            elif c.get("t") == "inlineStr":
+                raw = "".join(t.text or "" for t in c.iter(
+                    "{%s}t" % ns["m"]))
+            cells[ci - 1] = raw
+        rows.append(cells)
+    if not rows:
+        raise ValueError(f"{path}: empty sheet")
+    ncol = max(max(r) for r in rows if r) + 1
+    header = [str(rows[0].get(i, f"C{i + 1}")) for i in range(ncol)]
+    body = rows[1:]
+    cols = {}
+    for i, name in enumerate(header):
+        vals = [r.get(i) for r in body]
+        try:
+            cols[name] = np.asarray(
+                [np.nan if v in (None, "") else float(v) for v in vals])
+        except (TypeError, ValueError):
+            cols[name] = np.asarray(["" if v is None else str(v)
+                                     for v in vals])
+    return Frame.from_numpy(cols, mesh=mesh)
 
 
 FORMAT_PARSERS = {

@@ -27,6 +27,9 @@ from h2o3_tpu.persist import (model_from_meta, model_to_meta,
 
 ANOVA_DEFAULTS: Dict = dict(
     highest_interaction_term=2, type=3,
+    # reference ANOVAGLM computes p-values by default — its ANOVA
+    # tables depend on them (h2o-py h2o/estimators/anovaglm.py:49)
+    compute_p_values=True, tweedie_link_power=1.0,
 )
 
 

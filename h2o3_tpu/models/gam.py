@@ -30,6 +30,9 @@ from h2o3_tpu.persist import (model_from_meta, model_to_meta,
 GAM_DEFAULTS: Dict = dict(
     gam_columns=None, num_knots=6, bs=None, scale=None,
     keep_gam_cols=False,
+    # reference GAM defaults tweedie_link_power to 0.0 (log), unlike
+    # GLM's 1.0 (h2o-py h2o/estimators/gam.py:59)
+    tweedie_link_power=0.0,
 )
 
 

@@ -51,6 +51,10 @@ GLM_DEFAULTS: Dict = dict(
     # round-5 closure: NB dispersion, box constraints, DataInfo
     # interactions (hex/glm/GLMModel.java:814, hex/DataInfo.java:16)
     theta=1e-10, beta_constraints=None, interactions=None,
+    compute_p_values=False,
+    # HGLM (GLMModel.java:390): gaussian mixed model, one categorical
+    # random-intercept column
+    HGLM=False, random_columns=None, rand_family=None, rand_link=None,
 )
 
 
@@ -154,6 +158,28 @@ class _TweedieLink(_IdentityLink):
 
 _LINKS = {"identity": _IdentityLink, "log": _LogLink, "logit": _LogitLink,
           "inverse": _InverseLink, "tweedie": _TweedieLink}
+
+
+def _ordinal_cdf_fns(link: str):
+    """Cumulative-link pair (cdf, inverse-cdf) for Family.ordinal —
+    GLMModel.java:589 allows ologit / oprobit / ologlog. The cdf maps
+    (θ_k − η) to P(y ≤ k); the inverse initializes thresholds from the
+    marginal class distribution."""
+    link = (link or "family_default").lower()
+    if link in ("family_default", "", "ologit"):
+        return (jax.nn.sigmoid,
+                lambda c: jnp.log(c / (1.0 - c)))
+    if link == "oprobit":
+        from jax.scipy.special import ndtri
+        from jax.scipy.stats import norm
+        return norm.cdf, ndtri
+    if link == "ologlog":
+        # complementary log-log cumulative: P = 1 − exp(−exp(z))
+        return (lambda z: 1.0 - jnp.exp(-jnp.exp(jnp.clip(z, -30, 3))),
+                lambda c: jnp.log(-jnp.log(1.0 - c)))
+    raise ValueError(
+        "Incompatible link function for selected family. Only ologit, "
+        f"oprobit or ologlog links allowed for family=ordinal. Got {link}")
 
 
 # ---------------- family variance/deviance providers -------------------
@@ -529,13 +555,44 @@ def _lbfgs_minimize(vg_fn, beta0, max_iter: int = 200, tol: float = 1e-7,
 
 
 def _cholesky_solve(G, b, lam_l2, pen_mask):
-    """Ridge/no-penalty exact solve (hex/gram/Gram.java:452 cholesky)."""
+    """Ridge/no-penalty exact solve (hex/gram/Gram.java:452 cholesky).
+    A rank-deficient Gram (p > n unpenalized, collinear columns) makes
+    the plain Cholesky produce NaN — mirror Gram.java's addDiag retry:
+    fall back to a trace-scaled diagonal jitter when the first solve is
+    non-finite (minimum-norm-ish solution instead of a NaN model)."""
     A = G + jnp.diag(lam_l2 * pen_mask + 1e-8)
     L = jnp.linalg.cholesky(A)
-    return jax.scipy.linalg.cho_solve((L, True), b)
+    x = jax.scipy.linalg.cho_solve((L, True), b)
+
+    def _retry(_):
+        # trace-scaled: eigmax <= trace, so the ridged system's
+        # condition is bounded by ~1e6 — safely factorizable in f32
+        # (a trace/F scale sat below f32 eps relative to eigmax and
+        # still factored to NaN)
+        jitter = 1e-6 * jnp.trace(G) + 1e-8
+        L2 = jnp.linalg.cholesky(A + jitter * jnp.eye(G.shape[0]))
+        return jax.scipy.linalg.cho_solve((L2, True), b)
+
+    return jax.lax.cond(jnp.all(jnp.isfinite(x)),
+                        lambda _: x, _retry, operand=None)
 
 
 # ---------------- expansion + standardization --------------------------
+
+def _batched_impute(X, names, is_cat, mean_of):
+    """ONE masked whole-matrix impute over the numeric block (shared by
+    expand_design / expand_scoring_matrix). Per-column imputes over a
+    row-sharded X would each be their own cross-device program — and a
+    per-column REDUCTION is its own all-reduce, which is how wide
+    frames starved XLA:CPU's rendezvous (see expand_design). Returns
+    (num_imp [padded, Fn] or None, {col_idx: block_pos})."""
+    num_idx = [i for i, c in enumerate(is_cat) if not c]
+    if not num_idx:
+        return None, {}
+    Xn = X[:, jnp.asarray(num_idx)]
+    mh = np.asarray([mean_of(names[i]) for i in num_idx], np.float32)
+    num_imp = jnp.where(jnp.isnan(Xn), jnp.asarray(mh)[None, :], Xn)
+    return num_imp, {i: j for j, i in enumerate(num_idx)}
 
 def _interaction_cols(X, names, is_cat, cat_domains, means, interactions,
                       first: int):
@@ -581,6 +638,31 @@ def expand_design(spec: TrainingSpec, impute_means=None,
     names: List[str] = []
     means = {} if impute_means is None else impute_means
     first = 0 if use_all_levels else 1
+    # Numeric means are ONE batched masked reduction over the whole
+    # numeric block, not a per-column nansum: each per-column reduction
+    # over the row-sharded X is its own cross-device all-reduce, and a
+    # wide frame (10k columns) would enqueue 10k tiny rendezvous
+    # collectives — observed starving XLA:CPU's 8-participant
+    # rendezvous past its 40s termination timeout (process abort) on a
+    # small host, and it is exactly the fusion TPU wants anyway.
+    if impute_means is None:
+        num_idx = [i for i, c in enumerate(spec.is_cat) if not c]
+        if num_idx:
+            Xn = spec.X[:, jnp.asarray(num_idx)]        # [padded, Fn]
+            nan_n = jnp.isnan(Xn)
+            wn = spec.w[:, None]
+            msum = jnp.where(nan_n, 0.0, Xn * wn).sum(axis=0)
+            mcnt = jnp.maximum((wn * (~nan_n)).sum(axis=0), 1e-12)
+            mh = np.asarray(jax.device_get(msum / mcnt), np.float32)
+            for j, i in enumerate(num_idx):
+                means[spec.names[i]] = float(mh[j])
+
+    def _mean_of(n):
+        # means values may be floats or device scalars
+        return float(np.asarray(jax.device_get(means.get(n, 0.0))))
+
+    num_imp, num_pos = _batched_impute(spec.X, spec.names, spec.is_cat,
+                                       _mean_of)
     for i, (n, is_cat) in enumerate(zip(spec.names, spec.is_cat)):
         x = spec.X[:, i]
         if is_cat:
@@ -592,13 +674,7 @@ def expand_design(spec: TrainingSpec, impute_means=None,
                 cols.append((codes == lvl).astype(jnp.float32))
                 names.append(f"{n}.{dom[lvl]}")
         else:
-            if impute_means is None:
-                m = jnp.nansum(x * spec.w) / jnp.maximum(
-                    (spec.w * (~jnp.isnan(x))).sum(), 1e-12)
-                means[n] = m
-            else:
-                m = means.get(n, 0.0)
-            cols.append(jnp.where(jnp.isnan(x), m, x))
+            cols.append(num_imp[:, num_pos[i]])
             names.append(n)
     if interactions:
         icols, inames = _interaction_cols(
@@ -618,6 +694,9 @@ def expand_scoring_matrix(model, X):
     use_all_levels flag)."""
     cols = []
     first = 0 if getattr(model, "use_all_levels", False) else 1
+    num_imp, num_pos = _batched_impute(
+        X, model.feature_names, model.feature_is_cat,
+        lambda n: float(model.impute_means.get(n, 0.0)))
     for i, (n, is_cat) in enumerate(zip(model.feature_names,
                                         model.feature_is_cat)):
         x = X[:, i]
@@ -627,8 +706,7 @@ def expand_scoring_matrix(model, X):
             for lvl in range(first, card):
                 cols.append((codes == lvl).astype(jnp.float32))
         else:
-            m = model.impute_means.get(n, 0.0)
-            cols.append(jnp.where(jnp.isnan(x), m, x))
+            cols.append(num_imp[:, num_pos[i]])
     inter = (model.params or {}).get("interactions") if hasattr(
         model, "params") else None
     if inter:
@@ -732,7 +810,8 @@ class GLMModel(Model):
             if offset is not None:
                 eta = eta + offset
             th = jnp.asarray(self.intercept_value)          # [K-1] ascending
-            cdf = 1.0 / (1.0 + jnp.exp(-(th[None, :] - eta[:, None])))
+            ocdf, _ = _ordinal_cdf_fns(self.params.get("link"))
+            cdf = ocdf(th[None, :] - eta[:, None])
             K = th.shape[0] + 1
             probs = jnp.concatenate(
                 [cdf[:, :1],
@@ -1753,12 +1832,15 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
                 [jnp.zeros(1), jnp.cumsum(gaps)])           # [K-1]
             return beta, th
 
-        # class-prior-based threshold init (cumulative logits of the
-        # marginal distribution — the reference initializes the same way)
+        ocdf, oicdf = _ordinal_cdf_fns(p.get("link"))
+
+        # class-prior-based threshold init (inverse cumulative link of
+        # the marginal distribution — the reference initializes the
+        # same way for its ologit path)
         cnt = jnp.zeros(K).at[y].add(w)
         cum = jnp.cumsum(cnt)[:-1] / jnp.maximum(wsum, 1e-12)
         cum = jnp.clip(cum, 1e-4, 1 - 1e-4)
-        th_init = jnp.log(cum / (1 - cum))
+        th_init = oicdf(cum)
         gaps0 = jnp.log(jnp.maximum(jnp.diff(th_init), 1e-3))
         params0 = jnp.concatenate(
             [jnp.zeros(Fe), th_init[:1], gaps0]).astype(jnp.float32)
@@ -1766,7 +1848,7 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
         def nll(params_v):
             beta, th = unpack(params_v)
             eta = Xs @ beta
-            cdf = jax.nn.sigmoid(th[None, :] - eta[:, None])   # [rows, K-1]
+            cdf = ocdf(th[None, :] - eta[:, None])             # [rows, K-1]
             probs = jnp.concatenate(
                 [cdf[:, :1], cdf[:, 1:] - cdf[:, :-1],
                  1.0 - cdf[:, -1:]], axis=1)

@@ -26,6 +26,9 @@ from h2o3_tpu.persist import (model_from_meta, model_to_meta,
 
 MS_DEFAULTS: Dict = dict(
     mode="maxr", max_predictor_number=1, min_predictor_number=1,
+    # reference ModelSelection defaults tweedie_link_power to 0.0
+    # (h2o-py h2o/estimators/model_selection.py:51)
+    tweedie_link_power=0.0,
 )
 
 

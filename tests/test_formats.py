@@ -84,10 +84,12 @@ def test_orc_roundtrip(tmp_path):
                                tbl.column("x").to_numpy(), rtol=1e-6)
 
 
-def test_avro_gated(tmp_path):
+def test_avro_truncated_rejected(tmp_path):
+    """avro is now parsed natively (tests/test_formats2.py); a magic-only
+    truncated file must fail cleanly, not crash the tokenizer."""
     p = tmp_path / "t.avro"
     p.write_bytes(b"Obj\x01")
-    with pytest.raises(NotImplementedError, match="fastavro"):
+    with pytest.raises(ValueError, match="truncated or malformed"):
         h2o.import_file(str(p))
 
 
