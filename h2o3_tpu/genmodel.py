@@ -1111,8 +1111,9 @@ class TargetEncoderMojoScorer:
         self.blending = kv.get("te_blending", "true") == "true"
         self.infl = float(kv.get("te_inflection_point", 10.0))
         self.smooth = float(kv.get("te_smoothing", 20.0))
-        self.te_cols = _parse_jarr(kv["te_cols"],
-                                   typ=lambda v: v.strip('"'))
+        # _parse_jarr JSON-decodes quoted arrays — no extra stripping,
+        # which would corrupt names that genuinely contain quotes
+        self.te_cols = _parse_jarr(kv["te_cols"], typ=str)
         self.tables = {}
         for c in self.te_cols:
             s = np.frombuffer(blobs[f"te/{c}_sum.bin"], "<f8")

@@ -51,6 +51,8 @@ GLM_DEFAULTS: Dict = dict(
     # round-5 closure: NB dispersion, box constraints, DataInfo
     # interactions (hex/glm/GLMModel.java:814, hex/DataInfo.java:16)
     theta=1e-10, beta_constraints=None, interactions=None,
+    interaction_pairs=None, plug_values=None,
+    startval=None, cold_start=False, prior=-1.0,
     compute_p_values=False,
     # HGLM (GLMModel.java:390): gaussian mixed model, one categorical
     # random-intercept column
@@ -595,12 +597,13 @@ def _batched_impute(X, names, is_cat, mean_of):
     return num_imp, {i: j for j, i in enumerate(num_idx)}
 
 def _interaction_cols(X, names, is_cat, cat_domains, means, interactions,
-                      first: int):
+                      first: int, pairs=None):
     """DataInfo interaction terms (hex/DataInfo.java:16 _interactions /
     InteractionPair): all pairwise products among ``interactions``
     columns — num×num one product column, cat×num a per-level indicator
     × value block, cat×cat the indicator outer block (first levels
-    dropped like the main one-hot)."""
+    dropped like the main one-hot). ``pairs`` gives the reference's
+    explicit interaction_pairs list instead of all-combinations."""
     import itertools
     cols, out_names = [], []
 
@@ -615,7 +618,9 @@ def _interaction_cols(X, names, is_cat, cat_domains, means, interactions,
         m = means.get(n, 0.0)
         return [(jnp.where(jnp.isnan(x), m, x), n)]
 
-    for a, b in itertools.combinations(interactions, 2):
+    pair_iter = ([tuple(pr) for pr in pairs] if pairs
+                 else itertools.combinations(interactions or (), 2))
+    for a, b in pair_iter:
         if a not in names or b not in names:
             raise ValueError(f"interactions column '{a if a not in names else b}'"
                              f" is not a training feature")
@@ -627,7 +632,8 @@ def _interaction_cols(X, names, is_cat, cat_domains, means, interactions,
 
 
 def expand_design(spec: TrainingSpec, impute_means=None,
-                  use_all_levels: bool = False, interactions=None):
+                  use_all_levels: bool = False, interactions=None,
+                  interaction_pairs=None):
     """DataInfo analog: enum columns → one-hot indicator blocks (all
     levels except the first unless ``use_all_levels``,
     useAllFactorLevels=False default), numerics mean-imputed for NAs,
@@ -676,10 +682,11 @@ def expand_design(spec: TrainingSpec, impute_means=None,
         else:
             cols.append(num_imp[:, num_pos[i]])
             names.append(n)
-    if interactions:
+    if interactions or interaction_pairs:
         icols, inames = _interaction_cols(
             spec.X, list(spec.names), list(spec.is_cat), spec.cat_domains,
-            means, list(interactions), first)
+            means, list(interactions or ()), first,
+            pairs=interaction_pairs)
         cols += icols
         names += inames
     Xe = jnp.stack(cols, axis=1) if cols else jnp.zeros((spec.X.shape[0], 0))
@@ -697,22 +704,28 @@ def expand_scoring_matrix(model, X):
     num_imp, num_pos = _batched_impute(
         X, model.feature_names, model.feature_is_cat,
         lambda n: float(model.impute_means.get(n, 0.0)))
+    cat_plugs = getattr(model, "cat_plugs", None) or {}
     for i, (n, is_cat) in enumerate(zip(model.feature_names,
                                         model.feature_is_cat)):
         x = X[:, i]
         if is_cat:
             card = len(model.cat_domains.get(n, ()))
-            codes = jnp.where(jnp.isnan(x), -1, x).astype(jnp.int32)
+            # PlugValues-trained models substitute the plug level for
+            # NA enums at scoring (hex/DataInfo PlugValues)
+            codes = jnp.where(jnp.isnan(x), float(cat_plugs.get(n, -1)),
+                              x).astype(jnp.int32)
             for lvl in range(first, card):
                 cols.append((codes == lvl).astype(jnp.float32))
         else:
             cols.append(num_imp[:, num_pos[i]])
-    inter = (model.params or {}).get("interactions") if hasattr(
-        model, "params") else None
-    if inter:
+    mp = (model.params or {}) if hasattr(model, "params") else {}
+    inter = mp.get("interactions")
+    ipairs = mp.get("interaction_pairs")
+    if inter or ipairs:
         icols, _ = _interaction_cols(
             X, list(model.feature_names), list(model.feature_is_cat),
-            model.cat_domains, model.impute_means, list(inter), first)
+            model.cat_domains, model.impute_means, list(inter or ()),
+            first, pairs=ipairs)
         cols += icols
     return jnp.stack(cols, axis=1) if cols else jnp.zeros((X.shape[0], 0))
 
@@ -844,6 +857,7 @@ class GLMModel(Model):
                 if isinstance(self.intercept_value, np.ndarray)
                 else self.intercept_value)
         return {"family": self.family, "intercept": icpt,
+                "cat_plugs": getattr(self, "cat_plugs", None),
                 "exp_names": self.exp_names, "lambda_best": self.lambda_best,
                 "null_deviance": self.null_deviance,
                 "residual_deviance": self.residual_deviance,
@@ -858,6 +872,7 @@ class GLMModel(Model):
                              if isinstance(ex["intercept"], list)
                              else ex["intercept"])
         m.exp_names = list(ex["exp_names"])
+        m.cat_plugs = ex.get("cat_plugs")
         m.lambda_best = ex["lambda_best"]
         m.null_deviance = ex["null_deviance"]
         m.residual_deviance = ex["residual_deviance"]
@@ -888,6 +903,7 @@ class HGLMModel(GLMModel):
             feature_names=[self.feature_names[i] for i in keep],
             feature_is_cat=[self.feature_is_cat[i] for i in keep],
             cat_domains=self.cat_domains,
+            cat_plugs=getattr(self, "cat_plugs", None),
             impute_means=self.impute_means, params={})
         Xe = expand_scoring_matrix(proxy, X[:, keep])
         eta = Xe @ jnp.asarray(self.beta) + self.intercept_value
@@ -1336,7 +1352,79 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
         model.output["varranef"] = su2
         return model
 
+    def _apply_mvh(self, spec: TrainingSpec):
+        """missing_values_handling (hex/DataInfo MissingValuesHandling +
+        hex/glm GLMParameters): MeanImputation (default, downstream),
+        Skip (NA rows get weight 0 — the reference drops them from the
+        task), PlugValues (substitute user-provided per-column values
+        into X up front; enum plugs are level names). Returns the
+        possibly-rewritten spec; plug values are recorded on the
+        builder so trainers pass them as the scoring impute table."""
+        from dataclasses import replace as dc_replace
+        p = self.params
+        # clients spell these MeanImputation / Skip / PlugValues; the
+        # python surface uses snake_case — normalize both
+        mvh = str(p.get("missing_values_handling")
+                  or "mean_imputation").lower().replace("_", "")
+        self._plug_num = None
+        self._cat_plugs = None
+        if mvh in ("meanimputation", ""):
+            return spec
+        if spec.stream:
+            raise NotImplementedError(
+                f"missing_values_handling={mvh} is not supported in "
+                f"streaming (memory-pressure) mode")
+        if mvh == "skip":
+            nanrow = jnp.isnan(spec.X).any(axis=1)
+            return dc_replace(spec, w=spec.w * (~nanrow))
+        if mvh != "plugvalues":
+            raise ValueError(
+                f"unknown missing_values_handling '{mvh}' (one of "
+                f"MeanImputation, Skip, PlugValues)")
+        pv = p.get("plug_values")
+        if pv is None:
+            raise ValueError(
+                "missing_values_handling=PlugValues requires a "
+                "plug_values frame")
+        # accept a Frame (1 row) or a {column: value} mapping
+        if hasattr(pv, "vec") and hasattr(pv, "names"):
+            plug = {}
+            for n in pv.names:
+                v = pv.vec(n)
+                if v.type == "enum":
+                    plug[n] = v.domain[int(np.asarray(v.to_numpy())[0])]
+                elif v.type == "string":
+                    plug[n] = v.to_strings()[0]
+                else:
+                    plug[n] = float(np.asarray(v.to_numpy())[0])
+        else:
+            plug = dict(pv)
+        self._plug_num, self._cat_plugs = {}, {}
+        Xcols = []
+        for i, n in enumerate(spec.names):
+            x = spec.X[:, i]
+            if n not in plug:
+                Xcols.append(x)
+                continue
+            val = plug[n]
+            if spec.is_cat[i]:
+                dom = spec.cat_domains.get(n) or ()
+                sval = str(val)
+                if sval not in dom:
+                    raise ValueError(
+                        f"plug_values level '{sval}' is not in the "
+                        f"domain of enum column '{n}'")
+                code = dom.index(sval)
+                self._cat_plugs[n] = code
+                Xcols.append(jnp.where(jnp.isnan(x), float(code), x))
+            else:
+                fv = float(val)
+                self._plug_num[n] = fv
+                Xcols.append(jnp.where(jnp.isnan(x), fv, x))
+        return dc_replace(spec, X=jnp.stack(Xcols, axis=1))
+
     def _train_impl(self, spec: TrainingSpec, valid_spec, job: Job) -> GLMModel:
+        spec = self._apply_mvh(spec)
         if self.params.get("HGLM"):
             if spec.stream:
                 raise NotImplementedError(
@@ -1378,8 +1466,9 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
         w = spec.w
         offset = spec.offset
         interactions = p.get("interactions") or None
-        Xe, exp_names, means = expand_design(spec,
-                                             interactions=interactions)
+        ipairs = p.get("interaction_pairs") or None
+        Xe, exp_names, means = expand_design(
+            spec, interactions=interactions, interaction_pairs=ipairs)
         Fe = Xe.shape[1]
         nobs = float(jax.device_get(w.sum()))
 
@@ -1629,7 +1718,8 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
         vXs = vy = vw = voff = None
         if valid_spec is not None:
             vXe, _, _ = expand_design(valid_spec, impute_means=means,
-                                      interactions=interactions)
+                                      interactions=interactions,
+                                      interaction_pairs=ipairs)
             if standardize:
                 vXs = (vXe - xm[None, :]) * (1.0 / xs)[None, :]
             else:
@@ -1648,9 +1738,39 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
             # zero vector cannot recover (GLM.java starts from the null
             # model the same way)
             beta_s = beta_s.at[Fe].set(fam.link(mu0))
+        sv = p.get("startval")
+        if sv is not None and len(sv):
+            # user-specified starting coefficients on the RAW scale,
+            # expanded-column order with the intercept LAST
+            # (GLM.java _startval); convert to the standardized scale
+            # (b_std = b_raw·sd, icpt_std = icpt + Σ b_raw·m)
+            sv = np.asarray(sv, np.float32)
+            want = Fe + (1 if fit_intercept else 0)
+            if sv.shape[0] != want:
+                raise ValueError(
+                    f"startval needs {want} values (expanded "
+                    f"coefficients{' + intercept' if fit_intercept else ''}"
+                    f"), got {sv.shape[0]}")
+            b0 = jnp.asarray(sv[:Fe])
+            if standardize:
+                bs0 = b0 * xs
+                beta_s = beta_s.at[:Fe].set(bs0)
+                if fit_intercept:
+                    beta_s = beta_s.at[Fe].set(
+                        jnp.float32(sv[Fe]) + (b0 * xm).sum())
+            else:
+                beta_s = beta_s.at[:Fe].set(b0)
+                if fit_intercept:
+                    beta_s = beta_s.at[Fe].set(jnp.float32(sv[Fe]))
+        beta_init0 = beta_s
+        cold_start = bool(p.get("cold_start", False))
         best = None
         submodels = []
         for li, lam in enumerate(lambdas):
+            if cold_start and li > 0:
+                # GLMParameters._cold_start: no warm-starting down the
+                # lambda path — every λ refits from the initial state
+                beta_s = beta_init0
             if use_lbfgs:
                 beta_s, _fv, _its = lbfgs_fit(
                     beta_s, jnp.float32(lam * (1 - alpha)))
@@ -1731,6 +1851,16 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
             beta_raw = beta_s[:Fe]
             icpt = (float(jax.device_get(beta_s[Fe])) if fit_intercept
                     else 0.0)
+        prior = float(p.get("prior", -1.0) or -1.0)
+        if family == "binomial" and 0.0 < prior < 1.0 and fit_intercept:
+            # rare-event sampling correction (GLM.java _iceptAdjust):
+            # shift the intercept so the average predicted probability
+            # matches the true prior instead of the sampled ȳ
+            ybar = float(jax.device_get(
+                (w * y).sum() / jnp.maximum(w.sum(), 1e-12)))
+            ybar = min(max(ybar, 1e-12), 1 - 1e-12)
+            icpt += float(np.log(prior * (1 - ybar))
+                          - np.log(ybar * (1 - prior)))
         rank = (int(jax.device_get((jnp.abs(beta_s[:Fe]) > 1e-10).sum()))
                 + (1 if fit_intercept else 0))
 
@@ -1808,8 +1938,9 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
         y = spec.y.astype(jnp.int32)
         w = spec.w
         interactions = p.get("interactions") or None
-        Xe, exp_names, means = expand_design(spec,
-                                             interactions=interactions)
+        ipairs = p.get("interaction_pairs") or None
+        Xe, exp_names, means = expand_design(
+            spec, interactions=interactions, interaction_pairs=ipairs)
         Fe = Xe.shape[1]
         wsum = w.sum()
         xm = (Xe * w[:, None]).sum(0) / jnp.maximum(wsum, 1e-12)
@@ -1931,7 +2062,8 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
         y = spec.y.astype(jnp.int32)
         w = spec.w
         Xe, exp_names, means = expand_design(
-            spec, interactions=p.get("interactions") or None)
+            spec, interactions=p.get("interactions") or None,
+            interaction_pairs=p.get("interaction_pairs") or None)
         Fe = Xe.shape[1]
         nobs = float(jax.device_get(w.sum()))
         standardize = bool(p.get("standardize", True)) and fit_intercept

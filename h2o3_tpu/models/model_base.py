@@ -622,6 +622,16 @@ class ModelBuilder:
             try:
                 with prof.phase("train"):
                     model = self._train_impl(spec, valid_spec, job)
+                # PlugValues substitutions must follow the model to
+                # scoring time: enum plugs via cat_plugs, numeric plugs
+                # MERGED over the computed means so columns the user did
+                # not plug keep real mean imputation
+                if getattr(self, "_cat_plugs", None):
+                    model.cat_plugs = dict(self._cat_plugs)
+                if (getattr(self, "_plug_num", None)
+                        and hasattr(model, "impute_means")):
+                    model.impute_means = {**model.impute_means,
+                                          **self._plug_num}
             except BaseException:
                 if cv_fut is not None:    # don't orphan the fold pass
                     cv_fut.cancel()

@@ -1,0 +1,203 @@
+"""GLM missing_values_handling modes + interaction_pairs.
+
+Reference: hex/DataInfo MissingValuesHandling (MeanImputation / Skip /
+PlugValues, hex/glm/GLMModel.java GLMParameters), InteractionPair
+(hex/DataInfo.java:16).
+"""
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+
+
+def _na_frame(seed=0, n=2000):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    y = 1.0 + 2.0 * x1 - 1.0 * x2 + 0.1 * rng.normal(size=n)
+    x1na = x1.copy()
+    x1na[::10] = np.nan
+    g = np.array(["a", "b", "c"], dtype=object)[rng.integers(0, 3, n)]
+    g[5::50] = None
+    return x1na, x2, g, y, rng
+
+
+def test_skip_drops_na_rows():
+    x1, x2, g, y, _ = _na_frame()
+    fr = h2o.Frame.from_numpy({"x1": x1, "x2": x2, "y": y})
+    glm = H2OGeneralizedLinearEstimator(
+        family="gaussian", Lambda=[0.0], alpha=0.0,
+        missing_values_handling="Skip")
+    glm.train(y="y", training_frame=fr)
+    co = glm.model.coef()
+    # complete-case fit recovers the exact generating coefficients
+    assert abs(co["x1"] - 2.0) < 0.02
+    assert abs(co["x2"] + 1.0) < 0.02
+    # vs mean imputation, which attenuates x1 (NAs pulled to the mean)
+    glm2 = H2OGeneralizedLinearEstimator(family="gaussian", Lambda=[0.0],
+                                         alpha=0.0)
+    glm2.train(y="y", training_frame=fr)
+    assert abs(glm2.model.coef()["x1"] - 2.0) > abs(co["x1"] - 2.0)
+
+
+def test_plug_values_numeric_and_enum():
+    x1, x2, g, y, rng = _na_frame(seed=1)
+    fr = h2o.Frame.from_numpy({"x1": x1, "x2": x2, "g": g, "y": y})
+    plug = h2o.Frame.from_numpy({"x1": np.array([0.25]),
+                                 "g": np.array(["b"], dtype=object)})
+    glm = H2OGeneralizedLinearEstimator(
+        family="gaussian", Lambda=[0.0], alpha=0.0,
+        missing_values_handling="PlugValues", plug_values=plug)
+    glm.train(y="y", training_frame=fr)
+    m = glm.model
+    assert m.impute_means.get("x1") == 0.25
+    assert m.cat_plugs == {"g": 1}          # domain a,b,c → b = 1
+    # scoring a frame with NAs uses the plug values, and survives a
+    # save/load roundtrip
+    p0 = np.asarray(m.predict(fr).vec("predict").to_numpy())
+    assert np.isfinite(p0).all()
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        path = h2o.save_model(m, td, filename="pv")
+        m2 = h2o.load_model(path)
+        p1 = np.asarray(m2.predict(fr).vec("predict").to_numpy())
+        np.testing.assert_allclose(p0, p1, rtol=1e-5)
+    # plugging x1 NAs with exactly 0.25 must equal training on a frame
+    # where NAs were substituted by hand
+    x1h = x1.copy()
+    x1h[np.isnan(x1h)] = 0.25
+    gh = g.copy()
+    gh[np.asarray([v is None for v in g])] = "b"
+    frh = h2o.Frame.from_numpy({"x1": x1h, "x2": x2, "g": gh, "y": y})
+    glmh = H2OGeneralizedLinearEstimator(family="gaussian", Lambda=[0.0],
+                                         alpha=0.0)
+    glmh.train(y="y", training_frame=frh)
+    for k, v in glmh.model.coef().items():
+        assert abs(m.coef()[k] - v) < 1e-4, (k, m.coef()[k], v)
+
+
+def test_plug_values_validation():
+    fr = h2o.Frame.from_numpy({"x": np.arange(64, dtype=float),
+                               "y": np.arange(64, dtype=float)})
+    glm = H2OGeneralizedLinearEstimator(
+        family="gaussian", missing_values_handling="PlugValues")
+    with pytest.raises((ValueError, RuntimeError), match="plug_values"):
+        glm.train(y="y", training_frame=fr)
+
+
+def test_interaction_pairs_explicit():
+    rng = np.random.default_rng(3)
+    n = 3000
+    a, b, c = (rng.normal(size=n) for _ in range(3))
+    y = 1.0 + 0.5 * a + 2.0 * a * b + 0.1 * rng.normal(size=n)
+    fr = h2o.Frame.from_numpy({"a": a, "b": b, "c": c, "y": y})
+    glm = H2OGeneralizedLinearEstimator(
+        family="gaussian", Lambda=[0.0], alpha=0.0,
+        interaction_pairs=[("a", "b")])
+    glm.train(y="y", training_frame=fr)
+    co = glm.model.coef()
+    assert abs(co["a_b"] - 2.0) < 0.02
+    # ONLY the requested pair is added (interactions=[a,b,c] would have
+    # added a_c and b_c too)
+    assert "a_c" not in co and "b_c" not in co
+    pred = np.asarray(glm.model.predict(fr).vec("predict").to_numpy())
+    assert np.sqrt(np.mean((pred - y) ** 2)) < 0.2
+
+
+def test_startval_and_cold_start():
+    """startval (GLM.java _startval, raw scale, intercept last) seeds
+    the solver; cold_start refits each lambda from that state."""
+    rng = np.random.default_rng(4)
+    n = 1500
+    x = rng.normal(size=n)
+    y = 0.5 + 1.5 * x + 0.1 * rng.normal(size=n)
+    fr = h2o.Frame.from_numpy({"x": x, "y": y})
+    glm = H2OGeneralizedLinearEstimator(
+        family="gaussian", Lambda=[0.0], alpha=0.0,
+        startval=[1.5, 0.5])
+    glm.train(y="y", training_frame=fr)
+    co = glm.model.coef()
+    assert abs(co["x"] - 1.5) < 0.02 and abs(co["Intercept"] - 0.5) < 0.02
+    # wrong length rejected
+    glm2 = H2OGeneralizedLinearEstimator(family="gaussian",
+                                         startval=[1.0])
+    with pytest.raises((ValueError, RuntimeError), match="startval"):
+        glm2.train(y="y", training_frame=fr)
+    # cold_start across a lambda list still fits every submodel
+    glm3 = H2OGeneralizedLinearEstimator(
+        family="gaussian", Lambda=[0.5, 0.01], alpha=0.0,
+        cold_start=True)
+    glm3.train(y="y", training_frame=fr)
+    path = glm3.model.output["lambda_path"]
+    assert len(path) == 2 and path[1]["deviance"] < path[0]["deviance"]
+
+
+def test_binomial_prior_intercept_correction():
+    """prior (GLM.java _iceptAdjust): with a downsampled-majority
+    training set, the corrected intercept reproduces the full-data
+    intercept while slopes stay untouched."""
+    rng = np.random.default_rng(5)
+    n = 20000
+    x = rng.normal(size=n)
+    pfull = 1 / (1 + np.exp(-(-2.5 + 1.0 * x)))     # ~10% positives
+    yb = (rng.random(n) < pfull).astype(int)
+    # keep all positives, 20% of negatives → oversampled positives
+    keep = (yb == 1) | (rng.random(n) < 0.2)
+    xs_, ys_ = x[keep], yb[keep]
+    prior = yb.mean()                                # true prior
+    fr = h2o.Frame.from_numpy({"x": xs_, "y": ys_.astype(float)})
+    glm = H2OGeneralizedLinearEstimator(family="binomial", Lambda=[0.0],
+                                        prior=float(prior))
+    glm.train(y="y", training_frame=fr)
+    co = glm.model.coef()
+    assert abs(co["x"] - 1.0) < 0.1
+    assert abs(co["Intercept"] + 2.5) < 0.15        # corrected back
+    # without the prior the intercept reflects the sampled base rate
+    glm0 = H2OGeneralizedLinearEstimator(family="binomial", Lambda=[0.0])
+    glm0.train(y="y", training_frame=fr)
+    assert glm0.model.coef()["Intercept"] > co["Intercept"] + 0.5
+
+
+def test_multinomial_interaction_pairs():
+    """interaction_pairs must flow through the multinomial/ordinal
+    trainers too — scoring adds the pair columns, so training without
+    them crashes on a design/beta shape mismatch."""
+    rng = np.random.default_rng(6)
+    n = 1500
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    z = x1 * x2 + 0.5 * rng.normal(size=n)
+    yc = np.digitize(z, [-0.5, 0.5])
+    fr = h2o.Frame.from_numpy(
+        {"x1": x1, "x2": x2, "y": np.array([f"k{v}" for v in yc])})
+    glm = H2OGeneralizedLinearEstimator(
+        family="multinomial", interaction_pairs=[("x1", "x2")],
+        Lambda=[0.0])
+    glm.train(y="y", training_frame=fr)
+    pred = glm.model.predict(fr)
+    P = np.stack([np.asarray(pred.vec(f"pk{k}").to_numpy())
+                  for k in range(3)], 1)
+    np.testing.assert_allclose(P.sum(1), 1.0, atol=1e-5)
+
+
+def test_plug_values_partial_coverage_keeps_means():
+    """columns NOT in plug_values keep real mean imputation (they must
+    not silently become 0-imputed)."""
+    rng = np.random.default_rng(7)
+    n = 2000
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(loc=10.0, size=n)      # mean far from 0
+    y = 1.0 + 0.5 * x1 + 0.2 * x2 + 0.05 * rng.normal(size=n)
+    x1na, x2na = x1.copy(), x2.copy()
+    x1na[::9] = np.nan
+    x2na[3::11] = np.nan
+    fr = h2o.Frame.from_numpy({"x1": x1na, "x2": x2na, "y": y})
+    plug = h2o.Frame.from_numpy({"x1": np.array([0.5])})
+    glm = H2OGeneralizedLinearEstimator(
+        family="gaussian", Lambda=[0.0], alpha=0.0,
+        missing_values_handling="PlugValues", plug_values=plug)
+    glm.train(y="y", training_frame=fr)
+    m = glm.model
+    assert m.impute_means["x1"] == 0.5
+    # x2 was not plugged: its scoring impute is the (≈10) mean, not 0
+    assert abs(m.impute_means["x2"] - np.nanmean(x2na)) < 0.1
