@@ -597,7 +597,7 @@ def _batched_impute(X, names, is_cat, mean_of):
     return num_imp, {i: j for j, i in enumerate(num_idx)}
 
 def _interaction_cols(X, names, is_cat, cat_domains, means, interactions,
-                      first: int, pairs=None):
+                      first: int, pairs=None, cat_plugs=None):
     """DataInfo interaction terms (hex/DataInfo.java:16 _interactions /
     InteractionPair): all pairwise products among ``interactions``
     columns — num×num one product column, cat×num a per-level indicator
@@ -612,7 +612,8 @@ def _interaction_cols(X, names, is_cat, cat_domains, means, interactions,
         x = X[:, i]
         if is_cat[i]:
             dom = cat_domains.get(n) or ()
-            codes = jnp.where(jnp.isnan(x), -1, x).astype(jnp.int32)
+            na_code = float((cat_plugs or {}).get(n, -1))
+            codes = jnp.where(jnp.isnan(x), na_code, x).astype(jnp.int32)
             return [( (codes == lvl).astype(jnp.float32),
                       f"{n}.{dom[lvl]}") for lvl in range(first, len(dom))]
         m = means.get(n, 0.0)
@@ -725,7 +726,7 @@ def expand_scoring_matrix(model, X):
         icols, _ = _interaction_cols(
             X, list(model.feature_names), list(model.feature_is_cat),
             model.cat_domains, model.impute_means, list(inter or ()),
-            first, pairs=ipairs)
+            first, pairs=ipairs, cat_plugs=cat_plugs)
         cols += icols
     return jnp.stack(cols, axis=1) if cols else jnp.zeros((X.shape[0], 0))
 
@@ -1425,6 +1426,10 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
 
     def _train_impl(self, spec: TrainingSpec, valid_spec, job: Job) -> GLMModel:
         spec = self._apply_mvh(spec)
+        if valid_spec is not None:
+            # the reference plugs/skips the validation frame the same
+            # way (adaptTestForTrain + MissingValuesHandling)
+            valid_spec = self._apply_mvh(valid_spec)
         if self.params.get("HGLM"):
             if spec.stream:
                 raise NotImplementedError(
@@ -1438,6 +1443,12 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
             return self._train_streaming(spec, job)
         p = self.params
         family = self._resolve_family(spec)
+        if family in ("ordinal", "multinomial"):
+            sv = p.get("startval")
+            if sv is not None and len(sv):
+                raise NotImplementedError(
+                    f"startval is not implemented for family={family} "
+                    f"(supported for the single-response families)")
         if family == "ordinal":
             return self._train_ordinal(spec, valid_spec, job)
         if family == "multinomial":
@@ -1852,6 +1863,13 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
             icpt = (float(jax.device_get(beta_s[Fe])) if fit_intercept
                     else 0.0)
         prior = float(p.get("prior", -1.0) or -1.0)
+        if prior > 0:
+            if family != "binomial":
+                raise ValueError(
+                    "prior is only supported for family=binomial "
+                    "(hex/glm GLMParameters validation)")
+            if prior >= 1.0:
+                raise ValueError(f"prior must be in (0, 1), got {prior}")
         if family == "binomial" and 0.0 < prior < 1.0 and fit_intercept:
             # rare-event sampling correction (GLM.java _iceptAdjust):
             # shift the intercept so the average predicted probability

@@ -324,6 +324,21 @@ class ScoreKeeper:
         return recent <= prev + margin
 
 
+# reference param surfaces carrying the class-balancing trio and the
+# calibration trio (h2o-py generated estimators; enforced by the
+# bindings diff in tests/test_bindings.py) — merged as REAL defaults in
+# ModelBuilder.__init__ since both features are implemented generically
+_BALANCE_DEFAULTS = dict(balance_classes=False,
+                         class_sampling_factors=None,
+                         max_after_balance_size=5.0)
+_CALIBRATION_DEFAULTS = dict(calibrate_model=False,
+                             calibration_frame=None,
+                             calibration_method="auto")
+_BALANCE_ALGOS = {"gbm", "drf", "deeplearning", "glm", "gam", "anovaglm",
+                  "infogram", "modelselection", "naivebayes", "upliftdrf"}
+_CALIBRATION_ALGOS = {"gbm", "drf", "xgboost"}
+
+
 class Model:
     """Trained artifact. Subclasses implement _predict_matrix(X)."""
 
@@ -367,6 +382,22 @@ class Model:
         ov = frame.vec(oc).as_float()
         return jnp.where(jnp.isnan(ov), 0.0, ov)
 
+    def _correct_probabilities(self, probs: np.ndarray) -> np.ndarray:
+        """balance_classes probability un-correction (hex/Model
+        correctProbabilities): p_k ∝ p̂_k · prior_k / model_dist_k, so
+        a model trained on a rebalanced distribution reports
+        probabilities calibrated to the ORIGINAL class priors."""
+        prior_d = self.output.get("prior_class_dist")
+        model_d = self.output.get("model_class_dist")
+        if not prior_d or not model_d or probs.ndim != 2 \
+                or probs.shape[1] != len(prior_d):
+            return probs
+        ratio = (np.asarray(prior_d, np.float64)
+                 / np.maximum(np.asarray(model_d, np.float64), 1e-12))
+        p = probs.astype(np.float64) * ratio[None, :]
+        return (p / np.maximum(p.sum(axis=1, keepdims=True),
+                               1e-12)).astype(probs.dtype)
+
     def predict(self, frame: Frame) -> Frame:
         """Bulk scoring → prediction Frame (BigScore analog). Output
         schema mirrors the reference: regression → 'predict'; classif →
@@ -377,11 +408,27 @@ class Model:
         if self.nclasses <= 1:
             pv = np.asarray(jax.device_get(out))[:nrow]
             return Frame(["predict"], [Vec.from_numpy(pv)])
-        probs = np.asarray(jax.device_get(out))[:nrow]
+        probs = self._correct_probabilities(
+            np.asarray(jax.device_get(out))[:nrow])
         lbl = np.argmax(probs, axis=1).astype(np.int32)
         names = ["predict"] + [f"p{d}" for d in self.response_domain]
         vecs = [Vec.from_numpy(lbl, vtype=T_ENUM, domain=self.response_domain)]
         vecs += [Vec.from_numpy(probs[:, k]) for k in range(self.nclasses)]
+        cal = self.output.get("calibration")
+        if cal and self.nclasses == 2:
+            # calibrated probability columns (CalibrationHelper
+            # postProcessPredictions appends cal_p0/cal_p1)
+            p1 = np.clip(probs[:, 1].astype(np.float64), 1e-12, 1 - 1e-12)
+            if cal["method"] == "platt":
+                q1 = 1.0 / (1.0 + np.exp(-(cal["a"] * np.log(
+                    p1 / (1 - p1)) + cal["b"])))
+            else:
+                q1 = np.interp(p1, np.asarray(cal["tx"]),
+                               np.asarray(cal["ty"]))
+            names += [f"cal_p{self.response_domain[0]}",
+                      f"cal_p{self.response_domain[1]}"]
+            vecs += [Vec.from_numpy((1.0 - q1).astype(np.float32)),
+                     Vec.from_numpy(q1.astype(np.float32))]
         return Frame(names, vecs)
 
     def model_performance(self, frame: Optional[Frame] = None):
@@ -396,7 +443,8 @@ class Model:
             # (adaptTestForTrain semantics, hex/Model.java)
             y, w = response_codes_in_domain(frame, self.response,
                                             self.response_domain)
-            out_h = np.asarray(jax.device_get(out))[:nrow]
+            out_h = self._correct_probabilities(
+                np.asarray(jax.device_get(out))[:nrow])
             return compute_metrics(out_h, y, w, self.nclasses, self.response_domain)
         spec_like = build_training_spec(frame, self.response, classification=False)
         return compute_metrics(out, spec_like.y, spec_like.w, 1)
@@ -544,6 +592,12 @@ class ModelBuilder:
             compat = {}
         self._compat_defaults = compat
         merged = {k: v for k, v in compat.items() if k not in params}
+        if self.algo in _BALANCE_ALGOS:
+            for k, v in _BALANCE_DEFAULTS.items():
+                merged.setdefault(k, v)
+        if self.algo in _CALIBRATION_ALGOS:
+            for k, v in _CALIBRATION_DEFAULTS.items():
+                merged.setdefault(k, v)
         merged.update(params)
         self.params = merged
         self.model: Optional[Model] = None
@@ -560,6 +614,136 @@ class ModelBuilder:
     def _train_impl(self, spec: TrainingSpec, valid_spec: Optional[TrainingSpec],
                     job: Job) -> Model:
         raise NotImplementedError
+
+    def _fit_calibration(self, model: "Model") -> None:
+        """calibrate_model / calibration_frame / calibration_method
+        (hex/tree/CalibrationHelper, used by GBM/DRF): fit Platt scaling
+        (Platt 1999, 1-D logistic a·logit(p)+b by Newton) or isotonic
+        regression (PAV) of the true labels on the model's predicted
+        positive-class probability over the calibration frame; scoring
+        then appends cal_p0/cal_p1 columns."""
+        p = self.params
+        if self.algo not in _CALIBRATION_ALGOS:
+            raise ValueError(
+                f"calibrate_model is not supported for {self.algo} "
+                f"(hex/tree/CalibrationHelper covers GBM/DRF/XGBoost)")
+        cf = p.get("calibration_frame")
+        if cf is None:
+            raise ValueError(
+                "calibrate_model requires a calibration_frame")
+        if isinstance(cf, str):
+            from h2o3_tpu import dkv
+            cf = dkv.get(cf, "frame")
+        if model.nclasses != 2:
+            raise ValueError("model calibration is only supported for "
+                             "binomial classification")
+        method = str(p.get("calibration_method") or "auto").lower()
+        method = method.replace("_scaling", "").replace("scaling", "") \
+                       .replace("_regression", "").replace("regression", "")
+        if method in ("auto", ""):
+            method = "platt"
+        X = adapt_test_matrix(model, cf)
+        out = model._predict_matrix(X, offset=model._frame_offset(cf))
+        probs = model._correct_probabilities(
+            np.asarray(jax.device_get(out))[:cf.nrow])
+        p1 = np.clip(probs[:, 1].astype(np.float64), 1e-12, 1 - 1e-12)
+        yc, w = response_codes_in_domain(cf, model.response,
+                                         model.response_domain)
+        yv = np.asarray(yc, np.float64)
+        wv = np.asarray(w, np.float64)
+        if method == "platt":
+            z = np.log(p1 / (1.0 - p1))
+            a, b = 1.0, 0.0
+            for _ in range(50):
+                mu = 1.0 / (1.0 + np.exp(-(a * z + b)))
+                s = np.maximum(mu * (1 - mu), 1e-12) * wv
+                g = np.array([(wv * (yv - mu) * z).sum(),
+                              (wv * (yv - mu)).sum()])
+                H = np.array([[(s * z * z).sum(), (s * z).sum()],
+                              [(s * z).sum(), s.sum()]])
+                d = np.linalg.solve(H + 1e-9 * np.eye(2), g)
+                a += d[0]
+                b += d[1]
+                if np.abs(d).max() < 1e-10:
+                    break
+            model.output["calibration"] = {"method": "platt",
+                                           "a": float(a), "b": float(b)}
+        elif method == "isotonic":
+            from h2o3_tpu.models.isotonic import _pav
+            ux, inv = np.unique(p1, return_inverse=True)
+            awy = np.bincount(inv, weights=wv * yv)
+            aw = np.bincount(inv, weights=wv)
+            tx, ty = _pav(ux, awy, aw)
+            model.output["calibration"] = {
+                "method": "isotonic",
+                "tx": [float(v) for v in tx],
+                "ty": [float(v) for v in ty]}
+        else:
+            raise ValueError(
+                f"unknown calibration_method "
+                f"'{p.get('calibration_method')}' (one of AUTO, "
+                f"PlattScaling, IsotonicRegression)")
+
+    def _apply_balance_classes(self, spec: TrainingSpec) -> TrainingSpec:
+        """balance_classes / class_sampling_factors /
+        max_after_balance_size (hex/ModelBuilder ClassSamplingMethod +
+        water/util/MRUtils.sampleFrameStratified): the reference
+        physically re-samples rows; the TPU redesign multiplies class
+        factors into the row WEIGHTS — identical in expectation for
+        every weighted learner here (tree histograms, GLM IRLS, DL
+        loss) with no data movement. The prior/model class
+        distributions are recorded so scoring can correct predicted
+        probabilities back to the prior (hex/Model correctProbabilities
+        / _priorClassDist vs _modelClassDist)."""
+        from dataclasses import replace as dc_replace
+        self._class_dists = None
+        if not self.params.get("balance_classes"):
+            return spec
+        if spec.nclasses < 2:
+            return spec
+        if self.algo == "upliftdrf":
+            raise ValueError(
+                "balance_classes is not supported for Uplift DRF "
+                "(hex/tree/uplift/UpliftDRF.java rejects it)")
+        if spec.stream:
+            raise NotImplementedError(
+                "balance_classes is not supported in streaming "
+                "(memory-pressure) mode")
+        K = spec.nclasses
+        yc = jnp.clip(spec.y.astype(jnp.int32), 0, K - 1)
+        w_eff = spec.w
+        mvh = str(self.params.get("missing_values_handling")
+                  or "").lower().replace("_", "")
+        if mvh == "skip" and spec.X is not None:
+            # Skip drops NA rows downstream (GLM _apply_mvh) — class
+            # distributions must reflect the data actually trained on
+            w_eff = spec.w * (~jnp.isnan(spec.X).any(axis=1))
+        counts = jnp.zeros(K, jnp.float32).at[yc].add(w_eff)
+        ch = np.asarray(jax.device_get(counts), np.float64)
+        total = float(ch.sum())
+        if total <= 0:
+            return spec
+        csf = self.params.get("class_sampling_factors")
+        if csf is not None and len(csf):
+            fac = np.asarray(csf, np.float64)
+            if fac.shape[0] != K:
+                raise ValueError(
+                    f"class_sampling_factors needs {K} values (one per "
+                    f"response class), got {fac.shape[0]}")
+        else:
+            # auto: uniform target — factor_k = total/(K·n_k)
+            fac = total / (K * np.maximum(ch, 1.0))
+        mabs = float(self.params.get("max_after_balance_size", 5.0)
+                     or 5.0)
+        new_total = float((ch * fac).sum())
+        if new_total > mabs * total:
+            fac *= mabs * total / new_total
+            new_total = mabs * total
+        w2 = spec.w * jnp.asarray(fac, jnp.float32)[yc]
+        self._class_dists = (
+            (ch / total).tolist(),
+            ((ch * fac) / max(new_total, 1e-12)).tolist())
+        return dc_replace(spec, w=w2)
 
     def train(self, x: Optional[Sequence[str]] = None, y: Optional[str] = None,
               training_frame: Optional[Frame] = None,
@@ -578,6 +762,7 @@ class ModelBuilder:
         self._warn_compat_params()
         with prof.phase("spec"):
             spec = self._make_spec(training_frame, y, x)
+            spec = self._apply_balance_classes(spec)
             if getattr(spec, "stream", False) and not self.supports_streaming:
                 raise NotImplementedError(
                     f"{self.algo}: the training frame exceeds the device "
@@ -632,6 +817,12 @@ class ModelBuilder:
                         and hasattr(model, "impute_means")):
                     model.impute_means = {**model.impute_means,
                                           **self._plug_num}
+                if getattr(self, "_class_dists", None):
+                    prior_d, model_d = self._class_dists
+                    model.output["prior_class_dist"] = prior_d
+                    model.output["model_class_dist"] = model_d
+                if self.params.get("calibrate_model"):
+                    self._fit_calibration(model)
             except BaseException:
                 if cv_fut is not None:    # don't orphan the fold pass
                     cv_fut.cancel()

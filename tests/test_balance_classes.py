@@ -1,0 +1,158 @@
+"""balance_classes / class_sampling_factors / max_after_balance_size.
+
+Reference: hex/ModelBuilder ClassSamplingMethod +
+water/util/MRUtils.sampleFrameStratified (physical stratified
+re-sampling) and hex/Model correctProbabilities (_priorClassDist vs
+_modelClassDist). TPU redesign: class factors multiply row WEIGHTS —
+same expectation, no data movement.
+"""
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+
+
+def _rare_frame(seed=0, n=6000, pos=0.05):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=n)
+    p = pos * np.exp(0.8 * x) / np.mean(np.exp(0.8 * x))
+    yb = (rng.random(n) < np.clip(p, 0, 1)).astype(int)
+    fr = h2o.Frame.from_numpy(
+        {"x": x, "y": np.array(["no", "yes"], dtype=object)[yb]})
+    return fr, yb
+
+
+def test_balance_classes_glm_probability_correction():
+    fr, yb = _rare_frame()
+    glm = H2OGeneralizedLinearEstimator(family="binomial", Lambda=[0.0],
+                                        balance_classes=True)
+    glm.train(y="y", training_frame=fr)
+    m = glm.model
+    pd_ = m.output["prior_class_dist"]
+    md = m.output["model_class_dist"]
+    assert abs(pd_[1] - yb.mean()) < 1e-6
+    assert abs(md[1] - 0.5) < 0.02           # auto-balance → uniform
+    # corrected probabilities calibrate back to the true prior
+    pred = m.predict(fr)
+    pyes = np.asarray(pred.vec("pyes").to_numpy())
+    assert abs(pyes.mean() - yb.mean()) < 0.02
+    # the raw (uncorrected) model would sit near 0.5
+    raw = np.asarray(m._predict_matrix(
+        __import__("h2o3_tpu.models.model_base",
+                   fromlist=["adapt_test_matrix"]).adapt_test_matrix(
+            m, fr)))[:fr.nrow, 1]
+    assert raw.mean() > 0.3
+
+
+def test_balance_classes_gbm_and_sampling_factors():
+    fr, yb = _rare_frame(seed=1)
+    gbm = H2OGradientBoostingEstimator(ntrees=10, max_depth=3, seed=1,
+                                       balance_classes=True)
+    gbm.train(y="y", training_frame=fr)
+    m = gbm.model
+    assert abs(m.output["model_class_dist"][1] - 0.5) < 0.02
+    pyes = np.asarray(m.predict(fr).vec("pyes").to_numpy())
+    assert abs(pyes.mean() - yb.mean()) < 0.05
+    # explicit factors: double the positives' weight only
+    gbm2 = H2OGradientBoostingEstimator(
+        ntrees=5, max_depth=3, seed=1, balance_classes=True,
+        class_sampling_factors=[1.0, 2.0])
+    gbm2.train(y="y", training_frame=fr)
+    md2 = gbm2.model.output["model_class_dist"]
+    pr = yb.mean()
+    want = 2 * pr / (2 * pr + (1 - pr))
+    assert abs(md2[1] - want) < 0.01
+    # wrong length rejected
+    gbm3 = H2OGradientBoostingEstimator(
+        ntrees=2, balance_classes=True, class_sampling_factors=[1.0])
+    with pytest.raises((ValueError, RuntimeError),
+                       match="class_sampling_factors"):
+        gbm3.train(y="y", training_frame=fr)
+
+
+def test_max_after_balance_size_and_roundtrip():
+    """Auto-balance reweights to uniform at CONSTANT total weight, so
+    max_after_balance_size (the reference's frame-growth memory guard,
+    MRUtils.sampleFrameStratified) never binds in auto mode — the
+    balanced distribution is uniform regardless. The cap applies to
+    explicit class_sampling_factors that grow total weight."""
+    fr, yb = _rare_frame(seed=2, pos=0.01)    # 1% positives
+    glm = H2OGeneralizedLinearEstimator(
+        family="binomial", Lambda=[0.0], balance_classes=True,
+        max_after_balance_size=1.2)
+    glm.train(y="y", training_frame=fr)
+    md = glm.model.output["model_class_dist"]
+    assert abs(md[1] - 0.5) < 0.02
+    # explicit 100x positive factor over the cap: the reference scales
+    # ALL sampling ratios down uniformly (smaller frame, same
+    # distribution) — the weight analog likewise preserves the
+    # distribution, and uniform weight scaling is statistically neutral
+    pr = float(yb.mean())
+    expect = 100 * pr / (100 * pr + (1 - pr))
+    glm2 = H2OGeneralizedLinearEstimator(
+        family="binomial", Lambda=[0.0], balance_classes=True,
+        class_sampling_factors=[1.0, 100.0], max_after_balance_size=1.2)
+    glm2.train(y="y", training_frame=fr)
+    md2 = glm2.model.output["model_class_dist"]
+    assert abs(md2[1] - expect) < 0.01
+    # roundtrip keeps the correction
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        path = h2o.save_model(glm.model, td, filename="bc")
+        m2 = h2o.load_model(path)
+        assert m2.output["prior_class_dist"] == \
+            glm.model.output["prior_class_dist"]
+        p1 = np.asarray(glm.model.predict(fr).vec("pyes").to_numpy())
+        p2 = np.asarray(m2.predict(fr).vec("pyes").to_numpy())
+        np.testing.assert_allclose(p1, p2, rtol=1e-5)
+
+
+def test_calibrate_model_platt_and_isotonic():
+    """calibrate_model (hex/tree/CalibrationHelper): Platt / isotonic
+    calibration fitted on calibration_frame, cal_p columns appended at
+    scoring; calibrated probabilities are closer to empirical rates."""
+    rng = np.random.default_rng(9)
+    n = 6000
+    x = rng.normal(size=n)
+    p = 1 / (1 + np.exp(-(0.2 + 1.5 * x)))
+    yb = (rng.random(n) < p).astype(int)
+    lab = np.array(["no", "yes"], dtype=object)[yb]
+    fr = h2o.Frame.from_numpy({"x": x[:4000], "y": lab[:4000]})
+    cal = h2o.Frame.from_numpy({"x": x[4000:], "y": lab[4000:]})
+    for method in ("PlattScaling", "IsotonicRegression"):
+        gbm = H2OGradientBoostingEstimator(
+            ntrees=20, max_depth=4, seed=1, calibrate_model=True,
+            calibration_frame=cal, calibration_method=method)
+        gbm.train(y="y", training_frame=fr)
+        m = gbm.model
+        assert "calibration" in m.output
+        pred = m.predict(cal)
+        assert "cal_pyes" in pred.names and "cal_pno" in pred.names
+        q1 = np.asarray(pred.vec("cal_pyes").to_numpy())
+        q0 = np.asarray(pred.vec("cal_pno").to_numpy())
+        np.testing.assert_allclose(q0 + q1, 1.0, atol=1e-5)
+        # calibration-frame log loss must not get worse after calibration
+        # float64 before clip: 1-1e-9 rounds back to 1.0 in float32
+        raw = np.clip(np.asarray(pred.vec("pyes").to_numpy(),
+                                 dtype=np.float64), 1e-9, 1 - 1e-9)
+        qc = np.clip(q1.astype(np.float64), 1e-9, 1 - 1e-9)
+        yv = yb[4000:]
+        ll_raw = -np.mean(yv * np.log(raw) + (1 - yv) * np.log(1 - raw))
+        ll_cal = -np.mean(yv * np.log(qc) + (1 - yv) * np.log(1 - qc))
+        assert ll_cal <= ll_raw + 0.01, (method, ll_cal, ll_raw)
+    # save/load keeps calibration
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        path = h2o.save_model(m, td, filename="calm")
+        m2 = h2o.load_model(path)
+        pred2 = m2.predict(cal)
+        np.testing.assert_allclose(
+            np.asarray(pred.vec("cal_pyes").to_numpy()),
+            np.asarray(pred2.vec("cal_pyes").to_numpy()), rtol=1e-5)
+    # validation: no calibration_frame
+    bad = H2OGradientBoostingEstimator(ntrees=2, calibrate_model=True)
+    with pytest.raises((ValueError, RuntimeError),
+                       match="calibration_frame"):
+        bad.train(y="y", training_frame=fr)

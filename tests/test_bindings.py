@@ -44,8 +44,10 @@ def test_generated_bindings_and_diff(tmp_path):
 def test_compat_param_accepted_with_warning(caplog):
     from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
     import logging
+    # build_tree_one_node is still compat-gated (balance_classes,
+    # previously used here, became a real implemented param)
     est = H2OGradientBoostingEstimator(ntrees=2, max_depth=2, seed=1,
-                                       balance_classes=True)
+                                       build_tree_one_node=True)
     rng = np.random.default_rng(1)
     fr = h2o.Frame.from_numpy({
         "x": rng.normal(size=150),
@@ -53,6 +55,6 @@ def test_compat_param_accepted_with_warning(caplog):
             rng.integers(0, 2, 150)]})
     est.train(y="y", training_frame=fr)
     from h2o3_tpu.log import buffered_lines
-    assert any("balance_classes" in ln and "NOT implemented" in ln
+    assert any("build_tree_one_node" in ln and "NOT implemented" in ln
                for ln in buffered_lines(200))
     assert est.model is not None
