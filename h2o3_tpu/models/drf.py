@@ -46,7 +46,8 @@ DRF_DEFAULTS: Dict = dict(
     # scale with 2^d; the reference's deep default relies on dynamic node
     # allocation (hex/tree/DTree.java) and min_rows pruning
     ntrees=50, max_depth=10, min_rows=1.0, nbins=20, nbins_cats=1024,
-    mtries=-1, sample_rate=0.632, col_sample_rate_per_tree=1.0,
+    mtries=-1, sample_rate=0.632, sample_rate_per_class=None,
+    col_sample_rate_per_tree=1.0, col_sample_rate_change_per_level=1.0,
     min_split_improvement=1e-5, seed=-1, histogram_type="uniform_adaptive",
     score_tree_interval=0, stopping_rounds=0, stopping_metric="auto",
     stopping_tolerance=1e-3, hist_kernel="auto", reg_lambda=0.0,
@@ -144,7 +145,8 @@ class DRFModel(TreeScoringOptionsMixin, Model):
 
 def _drf_chunk_body(codes_rm, codes_t, y, w, oob_num, oob_cnt, base_key,
                     root_lo, root_hi, nb_f, start_idx, *, cfg, K, sample_rate,
-                    col_rate, chunk, has_t, adaptive, axis_name):
+                    sample_rate_per_class, col_rate, chunk, has_t, adaptive,
+                    axis_name):
     """A chunk of independent forest trees per data shard; OOB sums ride
     the scan carry (reference: DRF's OOB rows are scored by the trees that
     did not sample them — hex/tree/drf/DRF.java OOB machinery)."""
@@ -165,7 +167,14 @@ def _drf_chunk_body(codes_rm, codes_t, y, w, oob_num, oob_cnt, base_key,
         key = jax.random.fold_in(base_key, start_idx + i)
         key_r, key_c, key_m = jax.random.split(key, 3)
         key_r = jax.random.fold_in(key_r, shard)
-        sampled = jax.random.uniform(key_r, w.shape) < sample_rate
+        if sample_rate_per_class is not None:
+            # per-class bootstrap rates (hex/tree/SharedTree.java:210)
+            srpc = jnp.asarray(sample_rate_per_class, jnp.float32)
+            thr = srpc[jnp.clip(y.astype(jnp.int32), 0,
+                                len(sample_rate_per_class) - 1)]
+            sampled = jax.random.uniform(key_r, w.shape) < thr
+        else:
+            sampled = jax.random.uniform(key_r, w.shape) < sample_rate
         wt = w * sampled
         col_mask = jnp.ones(F, bool)
         if col_rate < 1.0:
@@ -199,9 +208,11 @@ def _drf_chunk_body(codes_rm, codes_t, y, w, oob_num, oob_cnt, base_key,
 
 
 @lru_cache(maxsize=128)
-def _compiled_drf_chunk(mesh, cfg, K, sample_rate, col_rate, chunk, has_t,
+def _compiled_drf_chunk(mesh, cfg, K, sample_rate, sample_rate_per_class,
+                        col_rate, chunk, has_t,
                         adaptive):
     body = partial(_drf_chunk_body, cfg=cfg, K=K, sample_rate=sample_rate,
+                   sample_rate_per_class=sample_rate_per_class,
                    col_rate=col_rate, chunk=chunk, has_t=has_t,
                    adaptive=adaptive, axis_name=DATA_AXIS)
     in_specs = (P(DATA_AXIS),
@@ -261,6 +272,9 @@ class H2ORandomForestEstimator(ModelBuilder):
                              min_split_improvement=float(p["min_split_improvement"]),
                              reg_lambda=float(p.get("reg_lambda", 0.0)),
                              mtries=min(mtries, bm.n_features),
+                             col_rate_change=float(
+                                 p.get("col_sample_rate_change_per_level",
+                                       1.0) or 1.0),
                              hist_method=p.get("hist_kernel", "auto"))
             root_lo = jnp.zeros(cfg.n_features, jnp.float32)
             root_hi = jnp.zeros(cfg.n_features, jnp.float32)
@@ -274,6 +288,7 @@ class H2ORandomForestEstimator(ModelBuilder):
         seed = int(p.get("seed", -1) or -1)
         key = jax.random.PRNGKey(seed if seed != -1
                                  else int(time.time() * 1e3) % (2 ** 31))
+        srpc = self.validate_sample_rate_per_class(spec)
         ntrees = int(p["ntrees"])
         sample_rate = float(p["sample_rate"])
         col_rate = float(p.get("col_sample_rate_per_tree", 1.0))
@@ -290,7 +305,8 @@ class H2ORandomForestEstimator(ModelBuilder):
         t0 = time.time()
         while built < ntrees:
             c = min(chunk, ntrees - built)
-            step = _compiled_drf_chunk(mesh, cfg, K, sample_rate, col_rate,
+            step = _compiled_drf_chunk(mesh, cfg, K, sample_rate, srpc,
+                                       col_rate,
                                        c, has_t, adaptive)
             oob_num, oob_cnt, chunk_trees = step(
                 Xtr, codes_t_arg, y, spec.w, oob_num, oob_cnt, key,

@@ -36,8 +36,9 @@ from h2o3_tpu.parallel.mesh import DATA_AXIS, current_mesh, n_data_shards
 
 GBM_DEFAULTS: Dict = dict(
     ntrees=50, max_depth=5, min_rows=10.0, learn_rate=0.1,
-    learn_rate_annealing=1.0, sample_rate=1.0, col_sample_rate=1.0,
-    col_sample_rate_per_tree=1.0, nbins=20, nbins_cats=1024,
+    learn_rate_annealing=1.0, sample_rate=1.0, sample_rate_per_class=None,
+    col_sample_rate=1.0, col_sample_rate_per_tree=1.0,
+    col_sample_rate_change_per_level=1.0, nbins=20, nbins_cats=1024,
     distribution="auto", tweedie_power=1.5, quantile_alpha=0.5,
     huber_alpha=0.9, min_split_improvement=1e-5,
     seed=-1, stopping_rounds=0, stopping_metric="auto",
@@ -164,8 +165,9 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
                     lr0, hdelta, root_lo, root_hi, nb_f, mono, sets,
                     start_idx, *, cfg, K,
                     dist_name, tweedie_power, quantile_alpha, sample_rate,
-                    col_rate, na_bin, chunk, anneal, has_valid, has_t,
-                    adaptive, has_mono, has_sets, axis_name):
+                    sample_rate_per_class, col_rate, na_bin, chunk, anneal,
+                    has_valid, has_t, adaptive, has_mono, has_sets,
+                    axis_name):
     """One chunk of the boosting loop, per data shard (runs under
     shard_map). ``chunk`` trees are built inside ONE program via lax.scan:
     per-call dispatch overhead amortises and margins/trees stay on device
@@ -194,7 +196,8 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
                                       nb_f=nb_f, mono=mono_a, sets=sets_a,
                                       key=key)
         return grow_tree(codes, gv, hv, wt, cfg, col_mask,
-                         axis_name=axis_name, mono=mono_a, sets=sets_a)
+                         axis_name=axis_name, mono=mono_a, sets=sets_a,
+                         key=key)
 
     def valid_contrib(tree):
         if adaptive:
@@ -211,7 +214,15 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
             # key stays common so col_mask is identical everywhere
             key_r = jax.random.fold_in(key_r, shard)
         wt = w
-        if sample_rate < 1.0:
+        if sample_rate_per_class is not None:
+            # hex/tree/SharedTree.java:210: per-class rates override
+            # sample_rate (one rate per RESPONSE class — binomial runs
+            # with internal K=1, so index by the tuple length)
+            srpc = jnp.asarray(sample_rate_per_class, jnp.float32)
+            thr = srpc[jnp.clip(y.astype(jnp.int32), 0,
+                                len(sample_rate_per_class) - 1)]
+            wt = w * (jax.random.uniform(key_r, w.shape) < thr)
+        elif sample_rate < 1.0:
             wt = w * (jax.random.uniform(key_r, w.shape) < sample_rate)
         col_mask = jnp.ones(F, bool)
         if col_rate < 1.0:
@@ -252,8 +263,9 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
 
 @lru_cache(maxsize=128)
 def _compiled_chunk(mesh, cfg, K, dist_name, tweedie_power, quantile_alpha,
-                    sample_rate, col_rate, na_bin, chunk, anneal, has_valid,
-                    has_t, adaptive, has_mono=False, has_sets=False):
+                    sample_rate, sample_rate_per_class, col_rate, na_bin,
+                    chunk, anneal, has_valid, has_t, adaptive,
+                    has_mono=False, has_sets=False):
     """Build + cache the sharded jitted chunk step for a given mesh/config.
 
     Rows ride the mesh 'data' axis; tree arrays come back replicated (every
@@ -262,6 +274,7 @@ def _compiled_chunk(mesh, cfg, K, dist_name, tweedie_power, quantile_alpha,
     body = partial(_gbm_chunk_body, cfg=cfg, K=K, dist_name=dist_name,
                    tweedie_power=tweedie_power, quantile_alpha=quantile_alpha,
                    sample_rate=sample_rate,
+                   sample_rate_per_class=sample_rate_per_class,
                    col_rate=col_rate, na_bin=na_bin, chunk=chunk,
                    anneal=anneal, has_valid=has_valid, has_t=has_t,
                    adaptive=adaptive, has_mono=has_mono, has_sets=has_sets,
@@ -330,6 +343,9 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                              min_split_improvement=float(p["min_split_improvement"]),
                              reg_lambda=float(p.get("reg_lambda", 0.0)),
                              reg_alpha=float(p.get("reg_alpha", 0.0)),
+                             col_rate_change=float(
+                                 p.get("col_sample_rate_change_per_level",
+                                       1.0) or 1.0),
                              hist_method=p.get("hist_kernel", "auto"))
             root_lo = jnp.zeros(cfg.n_features, jnp.float32)
             root_hi = jnp.zeros(cfg.n_features, jnp.float32)
@@ -395,6 +411,11 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         anneal = float(p["learn_rate_annealing"])
         lr *= anneal ** start_trees
         col_rate = float(p["col_sample_rate"]) * float(p["col_sample_rate_per_tree"])
+        srpc = self.validate_sample_rate_per_class(spec)
+        if srpc is not None and float(p.get("sample_rate", 1.0)) < 1.0:
+            from h2o3_tpu.log import warn as _warn
+            _warn("sample_rate is ignored when sample_rate_per_class "
+                  "is specified (hex/tree/SharedTree.java:210)")
         keeper = ScoreKeeper(p.get("stopping_rounds", 0), p.get("stopping_metric"),
                              p.get("stopping_tolerance", 1e-3), task)
         interval = max(int(p.get("score_tree_interval", 5) or 5), 1)
@@ -486,9 +507,9 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             step = _compiled_chunk(mesh, cfg, K, dist_name,
                                    float(p["tweedie_power"]),
                                    float(p.get("quantile_alpha", 0.5)),
-                                   float(p["sample_rate"]), col_rate,
-                                   na_bin, c, anneal, has_valid, has_t,
-                                   adaptive, has_mono, has_sets)
+                                   float(p["sample_rate"]), srpc,
+                                   col_rate, na_bin, c, anneal, has_valid,
+                                   has_t, adaptive, has_mono, has_sets)
             margin, vmargin, chunk_trees = step(
                 Xtr, codes_t_arg, margin, yf, w, vtrain, vmargin,
                 key, jnp.float32(lr), jnp.float32(huber_delta),
@@ -547,6 +568,15 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             raise NotImplementedError(
                 "checkpoint continuation is not supported in streaming "
                 "mode")
+        if p.get("sample_rate_per_class"):
+            raise NotImplementedError(
+                "sample_rate_per_class is not supported in streaming "
+                "mode")
+        if float(p.get("col_sample_rate_change_per_level", 1.0)
+                 or 1.0) != 1.0:
+            raise NotImplementedError(
+                "col_sample_rate_change_per_level is not supported in "
+                "streaming mode")
         if dist_name == "huber":
             raise NotImplementedError(
                 "huber distribution is not supported in streaming mode "

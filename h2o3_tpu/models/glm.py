@@ -1443,6 +1443,15 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
             return self._train_streaming(spec, job)
         p = self.params
         family = self._resolve_family(spec)
+        prior = float(p.get("prior", -1.0) or -1.0)
+        if prior > 0:
+            # validated BEFORE any training (GLMParameters validation)
+            if family != "binomial":
+                raise ValueError(
+                    "prior is only supported for family=binomial "
+                    "(hex/glm GLMParameters validation)")
+            if prior >= 1.0:
+                raise ValueError(f"prior must be in (0, 1), got {prior}")
         if family in ("ordinal", "multinomial"):
             sv = p.get("startval")
             if sv is not None and len(sv):
@@ -1863,13 +1872,6 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
             icpt = (float(jax.device_get(beta_s[Fe])) if fit_intercept
                     else 0.0)
         prior = float(p.get("prior", -1.0) or -1.0)
-        if prior > 0:
-            if family != "binomial":
-                raise ValueError(
-                    "prior is only supported for family=binomial "
-                    "(hex/glm GLMParameters validation)")
-            if prior >= 1.0:
-                raise ValueError(f"prior must be in (0, 1), got {prior}")
         if family == "binomial" and 0.0 < prior < 1.0 and fit_intercept:
             # rare-event sampling correction (GLM.java _iceptAdjust):
             # shift the intercept so the average predicted probability

@@ -615,6 +615,48 @@ class ModelBuilder:
                     job: Job) -> Model:
         raise NotImplementedError
 
+    def _validate_calibration(self, spec: TrainingSpec) -> None:
+        """Pre-train parameter validation for calibrate_model — all
+        checks depend only on params + spec, so a bad combination must
+        not cost a full training run (the reference validates in
+        ModelBuilder init)."""
+        p = self.params
+        if self.algo not in _CALIBRATION_ALGOS:
+            raise ValueError(
+                f"calibrate_model is not supported for {self.algo} "
+                f"(hex/tree/CalibrationHelper covers GBM/DRF/XGBoost)")
+        if p.get("calibration_frame") is None:
+            raise ValueError(
+                "calibrate_model requires a calibration_frame")
+        if spec.nclasses != 2:
+            raise ValueError("model calibration is only supported for "
+                             "binomial classification")
+        method = str(p.get("calibration_method") or "auto").lower()
+        method = method.replace("_scaling", "").replace("scaling", "") \
+                       .replace("_regression", "").replace("regression",
+                                                           "")
+        if method not in ("auto", "", "platt", "isotonic"):
+            raise ValueError(
+                f"unknown calibration_method "
+                f"'{p.get('calibration_method')}' (one of AUTO, "
+                f"PlattScaling, IsotonicRegression)")
+
+    def validate_sample_rate_per_class(self, spec: TrainingSpec):
+        """Shared GBM/DRF sample_rate_per_class validation
+        (hex/tree/SharedTree.java:210-213): one rate per RESPONSE
+        class. Returns the normalized tuple or None."""
+        srpc = self.params.get("sample_rate_per_class")
+        if srpc is None or not len(srpc):
+            return None
+        if spec.nclasses < 2:
+            raise ValueError("sample_rate_per_class requires a "
+                             "classification response")
+        if len(srpc) != spec.nclasses:
+            raise ValueError(
+                f"sample_rate_per_class must have {spec.nclasses} "
+                f"values (one per class), got {len(srpc)}")
+        return tuple(float(v) for v in srpc)
+
     def _fit_calibration(self, model: "Model") -> None:
         """calibrate_model / calibration_frame / calibration_method
         (hex/tree/CalibrationHelper, used by GBM/DRF): fit Platt scaling
@@ -763,6 +805,8 @@ class ModelBuilder:
         with prof.phase("spec"):
             spec = self._make_spec(training_frame, y, x)
             spec = self._apply_balance_classes(spec)
+            if self.params.get("calibrate_model"):
+                self._validate_calibration(spec)
             if getattr(spec, "stream", False) and not self.supports_streaming:
                 raise NotImplementedError(
                     f"{self.algo}: the training frame exceeds the device "

@@ -57,6 +57,10 @@ class TreeConfig:
     reg_alpha: float = 0.0   # L1 on leaf values (xgboost semantics)
     mtries: int = 0          # >0: random feature subset PER NODE per level
                              # (DRF mtries, hex/tree/drf/DRF.java)
+    # col_sample_rate_change_per_level (hex/tree/DTree.java:57):
+    # effective per-level subset size = (mtries or F)·factor^depth,
+    # clamped to [1, F]
+    col_rate_change: float = 1.0
     hist_method: str = "auto"
     # histogram_type=random (hex/tree/DHistogram.java HistogramType.Random):
     # randomize the adaptive grid's phase per tree/feature so split points
@@ -205,6 +209,19 @@ def _next_allowed(allowed, sets, bf, can):
     return jnp.repeat(child, 2, axis=0)          # both children alike
 
 
+def _level_mtries(cfg: TreeConfig, d: int, F: int) -> int:
+    """Per-level column-subset size: mtries scaled by
+    col_sample_rate_change_per_level^depth (hex/tree/DTree.java:57),
+    clamped to [1, F]. 0 = use the full column set."""
+    mt_d = cfg.mtries
+    if cfg.col_rate_change != 1.0:
+        base_m = cfg.mtries if cfg.mtries > 0 else F
+        mt_d = int(min(max(1, round(base_m * cfg.col_rate_change ** d)), F))
+        if mt_d >= F and cfg.mtries <= 0:
+            mt_d = 0               # full set — no subset draw
+    return mt_d
+
+
 def grow_tree(codes, g, h, w, cfg: TreeConfig, col_mask, axis_name=None,
               key=None, mono=None, sets=None):
     """Build one tree. All args are device arrays (codes [rows,F] int,
@@ -276,10 +293,11 @@ def grow_tree(codes, g, h, w, cfg: TreeConfig, col_mask, axis_name=None,
                 for hl, hp in zip(hist_l, prev_hist))
         prev_hist = hist
         level_mask = col_mask
-        if cfg.mtries > 0 and key is not None:
+        mt_d = _level_mtries(cfg, d, F)
+        if mt_d > 0 and key is not None:
             u = jax.random.uniform(jax.random.fold_in(key, d), (N, F))
             u = jnp.where(col_mask[None, :], u, 2.0)  # excluded cols last
-            kth = jnp.sort(u, axis=1)[:, min(cfg.mtries, F) - 1]
+            kth = jnp.sort(u, axis=1)[:, min(mt_d, F) - 1]
             level_mask = (u <= kth[:, None]) & col_mask[None, :]
         if allowed is not None:
             lm2 = level_mask if level_mask.ndim == 2 else level_mask[None, :]
@@ -385,6 +403,9 @@ def adaptive_setup(spec, params, max_depth: int, mtries: int = 0):
                      reg_lambda=float(p.get("reg_lambda", 0.0)),
                      reg_alpha=float(p.get("reg_alpha", 0.0)),
                      mtries=mtries,
+                     col_rate_change=float(
+                         p.get("col_sample_rate_change_per_level", 1.0)
+                         or 1.0),
                      hist_method=p.get("hist_kernel", "auto"),
                      random_grid=(str(p.get("histogram_type", "")).lower()
                                   == "random"),
@@ -533,10 +554,11 @@ def grow_tree_adaptive(X, g, h, w, cfg: TreeConfig, col_mask, root_lo,
             hist = jax.lax.psum(hist, axis_name)
         trip = (hist[0], hist[1], hist[2])
         level_mask = col_mask
-        if cfg.mtries > 0 and key is not None:
+        mt_d = _level_mtries(cfg, d, F)
+        if mt_d > 0 and key is not None:
             u = jax.random.uniform(jax.random.fold_in(key, d), (N, F))
             u = jnp.where(col_mask[None, :], u, 2.0)
-            kth = jnp.sort(u, axis=1)[:, min(cfg.mtries, F) - 1]
+            kth = jnp.sort(u, axis=1)[:, min(mt_d, F) - 1]
             level_mask = (u <= kth[:, None]) & col_mask[None, :]
         if allowed is not None:
             lm2 = level_mask if level_mask.ndim == 2 else level_mask[None, :]

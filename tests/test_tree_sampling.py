@@ -1,0 +1,88 @@
+"""sample_rate_per_class + col_sample_rate_change_per_level.
+
+Reference: hex/tree/SharedTree.java:210 (per-class rates override
+sample_rate, one per class) and hex/tree/DTree.java:57 (effective
+per-level column subset = mtrys·factor^depth clamped to [1, ncols]).
+"""
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.models.drf import H2ORandomForestEstimator
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+
+def _frame(seed=0, n=4000):
+    rng = np.random.default_rng(seed)
+    x1, x2, x3 = (rng.normal(size=n) for _ in range(3))
+    p = 1 / (1 + np.exp(-(0.5 + 1.2 * x1 - 0.8 * x2)))
+    yb = (rng.random(n) < p).astype(int)
+    fr = h2o.Frame.from_numpy(
+        {"x1": x1, "x2": x2, "x3": x3,
+         "y": np.array(["n", "p"], dtype=object)[yb]})
+    return fr, yb
+
+
+def test_sample_rate_per_class_gbm():
+    fr, yb = _frame()
+    gbm = H2OGradientBoostingEstimator(
+        ntrees=10, max_depth=3, seed=7,
+        sample_rate_per_class=[0.3, 1.0])
+    gbm.train(y="y", training_frame=fr)
+    m = gbm.model
+    assert m.training_metrics.auc > 0.7
+    # downsampling the majority class shifts per-tree base rates up →
+    # mean predicted p above the prior (no correction requested)
+    pp = np.asarray(m.predict(fr).vec("pp").to_numpy())
+    assert pp.mean() > yb.mean()
+    # wrong length rejected
+    bad = H2OGradientBoostingEstimator(ntrees=2,
+                                       sample_rate_per_class=[0.5])
+    with pytest.raises((ValueError, RuntimeError),
+                       match="sample_rate_per_class"):
+        bad.train(y="y", training_frame=fr)
+    # regression response rejected
+    frn = h2o.Frame.from_numpy({"x": np.arange(128.0),
+                                "y": np.arange(128.0)})
+    bad2 = H2OGradientBoostingEstimator(ntrees=2,
+                                        sample_rate_per_class=[1.0])
+    with pytest.raises((ValueError, RuntimeError),
+                       match="classification"):
+        bad2.train(y="y", training_frame=frn)
+
+
+def test_sample_rate_per_class_drf():
+    fr, yb = _frame(seed=1)
+    drf = H2ORandomForestEstimator(
+        ntrees=12, max_depth=4, seed=3,
+        sample_rate_per_class=[0.4, 0.9])
+    drf.train(y="y", training_frame=fr)
+    assert drf.model.training_metrics.auc > 0.7
+
+
+def test_col_sample_rate_change_per_level():
+    fr, _ = _frame(seed=2)
+    # factor < 1: deeper levels see fewer columns; model still learns
+    gbm = H2OGradientBoostingEstimator(
+        ntrees=10, max_depth=4, seed=5,
+        col_sample_rate_change_per_level=0.5)
+    gbm.train(y="y", training_frame=fr)
+    assert gbm.model.training_metrics.auc > 0.7
+    # determinism with the same seed; differs from the unrestricted fit
+    gbm2 = H2OGradientBoostingEstimator(
+        ntrees=10, max_depth=4, seed=5,
+        col_sample_rate_change_per_level=0.5)
+    gbm2.train(y="y", training_frame=fr)
+    p1 = np.asarray(gbm.model.predict(fr).vec("pp").to_numpy())
+    p2 = np.asarray(gbm2.model.predict(fr).vec("pp").to_numpy())
+    np.testing.assert_allclose(p1, p2, rtol=1e-6)
+    full = H2OGradientBoostingEstimator(ntrees=10, max_depth=4, seed=5)
+    full.train(y="y", training_frame=fr)
+    p3 = np.asarray(full.model.predict(fr).vec("pp").to_numpy())
+    assert np.abs(p1 - p3).max() > 1e-4
+    # DRF: factor composes with mtries
+    drf = H2ORandomForestEstimator(
+        ntrees=8, max_depth=4, seed=2, mtries=2,
+        col_sample_rate_change_per_level=1.5)
+    drf.train(y="y", training_frame=fr)
+    assert drf.model.training_metrics.auc > 0.7
