@@ -53,6 +53,7 @@ GLM_DEFAULTS: Dict = dict(
     theta=1e-10, beta_constraints=None, interactions=None,
     interaction_pairs=None, plug_values=None,
     startval=None, cold_start=False, prior=-1.0,
+    max_active_predictors=-1,
     compute_p_values=False,
     # HGLM (GLMModel.java:390): gaussian mixed model, one categorical
     # random-intercept column
@@ -1859,6 +1860,16 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
                 best = (beta_s, sel_dev, float(lam), dev)
             job.set_progress((li + 1) / len(lambdas))
             if job.cancel_requested:
+                break
+            map_ = int(p.get("max_active_predictors", -1) or -1)
+            if (map_ > 0 and p.get("lambda_search")
+                    and submodels[-1]["nonzero"] > map_):
+                # hex/glm/GLM.java _max_active_predictors: stop
+                # descending the lambda path once the active set
+                # exceeds the cap (the just-fitted submodel still
+                # participates in best-selection, as in the reference).
+                # Gated to lambda_search: a user-supplied lambda list
+                # keeps its order (may ascend) and is never truncated.
                 break
 
         beta_s, _, lam_best, res_dev = best

@@ -201,3 +201,31 @@ def test_plug_values_partial_coverage_keeps_means():
     assert m.impute_means["x1"] == 0.5
     # x2 was not plugged: its scoring impute is the (≈10) mean, not 0
     assert abs(m.impute_means["x2"] - np.nanmean(x2na)) < 0.1
+
+
+def test_max_active_predictors_stops_lambda_path():
+    """max_active_predictors (hex/glm/GLM.java): the lambda path stops
+    descending once the active set exceeds the cap."""
+    rng = np.random.default_rng(8)
+    n, f = 1000, 30
+    X = rng.normal(size=(n, f))
+    beta = np.zeros(f)
+    beta[:10] = np.linspace(1, 2, 10)
+    y = X @ beta + 0.1 * rng.normal(size=n)
+    cols = {f"x{i}": X[:, i] for i in range(f)}
+    cols["y"] = y
+    fr = h2o.Frame.from_numpy(cols)
+    glm = H2OGeneralizedLinearEstimator(
+        family="gaussian", alpha=1.0, lambda_search=True, nlambdas=30,
+        max_active_predictors=5)
+    glm.train(y="y", training_frame=fr)
+    path = glm.model.output["lambda_path"]
+    # stopped early: far fewer submodels than nlambdas, and only the
+    # last one may exceed the cap
+    assert len(path) < 30
+    assert all(sm["nonzero"] <= 5 for sm in path[:-1])
+    # without the cap the path runs to completion
+    glm2 = H2OGeneralizedLinearEstimator(
+        family="gaussian", alpha=1.0, lambda_search=True, nlambdas=30)
+    glm2.train(y="y", training_frame=fr)
+    assert len(glm2.model.output["lambda_path"]) == 30
