@@ -38,6 +38,7 @@ from h2o3_tpu.persist import register_model_class
 
 DL_DEFAULTS: Dict = dict(
     hidden=(200, 200), epochs=10.0, activation="rectifier",
+    checkpoint=None, initial_weights=None, initial_biases=None,
     adaptive_rate=True, rho=0.99, epsilon=1e-8,
     rate=0.005, rate_annealing=1e-6, rate_decay=1.0,
     momentum_start=0.0, momentum_ramp=1e6, momentum_stable=0.0,
@@ -334,6 +335,93 @@ class H2ODeepLearningEstimator(ModelBuilder):
         # autoencoder mode is unsupervised: train() must not demand y
         self.supervised = not bool(merged.get("autoencoder"))
 
+    def _resolve_checkpoint(self, spec: TrainingSpec, task: str,
+                            act_name: str):
+        """checkpoint continue-training (hex/Model.java:487 _checkpoint,
+        DeepLearning restart semantics): the prior model's weights seed
+        the network and epochs continue from its state. Accepts a model
+        object, a DKV model key, or an artifact path."""
+        ckpt = self.params.get("checkpoint")
+        if not ckpt:
+            return None
+        if isinstance(ckpt, DeepLearningModel):
+            prior = ckpt
+        else:
+            from h2o3_tpu import dkv
+            got = dkv.get_opt(str(ckpt))
+            if got is not None and got[0] == "model":
+                prior = got[1]
+            else:
+                from h2o3_tpu.persist import load_model
+                prior = load_model(str(ckpt))
+        if not isinstance(prior, DeepLearningModel):
+            raise ValueError(
+                f"checkpoint '{ckpt}' is not a DeepLearning model")
+        if prior.task != task:
+            raise ValueError(f"checkpoint task '{prior.task}' != '{task}'")
+        if prior.activation != act_name:
+            raise ValueError(
+                f"checkpoint activation '{prior.activation}' != "
+                f"'{act_name}' (checkpoint topology must match)")
+        hidden = [int(h) for h in (self.params.get("hidden") or (200, 200))]
+        if list(prior.hidden) != hidden:
+            raise ValueError(
+                f"checkpoint hidden layers {prior.hidden} != {hidden}")
+        if prior.nclasses != spec.nclasses:
+            raise ValueError(
+                f"checkpoint has {prior.nclasses} response classes but "
+                f"the training frame has {spec.nclasses}")
+        prd = (tuple(prior.response_domain) if prior.response_domain
+               else None)
+        srd = tuple(spec.response_domain) if spec.response_domain else None
+        if prd != srd:
+            raise ValueError(
+                f"checkpoint response domain {prd} differs from the "
+                f"training frame's {srd} — the prior output layer's "
+                f"class columns would address swapped labels")
+        return prior
+
+    def _apply_initial_weights(self, net, sizes):
+        """initial_weights / initial_biases (hex/deeplearning
+        DeepLearningParameters): user-specified per-layer [in, out]
+        weight matrices / [out] bias vectors; None entries keep the
+        random init. Accepts numpy arrays or Frames."""
+        p = self.params
+
+        def _mat(v):
+            if hasattr(v, "as_matrix"):     # Frame
+                return np.asarray(jax.device_get(
+                    v.as_matrix(v.names)))[:v.nrow]
+            return np.asarray(v, np.float32)
+
+        for kind, idx in (("initial_weights", "W"),
+                          ("initial_biases", "b")):
+            vals = p.get(kind)
+            if not vals:
+                continue
+            if len(vals) != len(net):
+                raise ValueError(
+                    f"{kind} needs one entry per layer "
+                    f"({len(net)}), got {len(vals)}")
+            for li, v in enumerate(vals):
+                if v is None:
+                    continue
+                a = _mat(v).astype(np.float32)
+                want = ((sizes[li], sizes[li + 1]) if idx == "W"
+                        else (sizes[li + 1],))
+                if idx == "b" and a.ndim == 2 and 1 in a.shape:
+                    a = a.reshape(-1)    # single-column bias frame
+                if a.shape != want:
+                    # exact match required: a transposed weight matrix
+                    # has the right SIZE but reshaping it would scramble
+                    # the connections — reject like the reference
+                    raise ValueError(
+                        f"{kind}[{li}] has shape {a.shape}, layer "
+                        f"expects {want}")
+                net[li] = dict(net[li])
+                net[li][idx] = jnp.asarray(a)
+        return net
+
     def _train_impl(self, spec: TrainingSpec, valid_spec, job: Job):
         p = self.params
         autoenc = bool(p.get("autoencoder"))
@@ -348,17 +436,32 @@ class H2ODeepLearningEstimator(ModelBuilder):
             raise ValueError(f"unsupported activation '{act_name}'; have "
                              f"{sorted(_ACTS)} (maxout not implemented)")
         act = _ACTS[act_name]
-        Xe, exp_names, means = expand_design(spec)
+        prior = self._resolve_checkpoint(spec, task, act_name)
+        Xe, exp_names, means = expand_design(
+            spec, impute_means=(dict(prior.impute_means)
+                                if prior is not None else None))
+        if prior is not None and list(prior.exp_names) != list(exp_names):
+            raise ValueError(
+                f"checkpoint expanded design {prior.exp_names} differs "
+                f"from the training frame's {exp_names} — the prior "
+                f"weights would address the wrong inputs")
         Fe = Xe.shape[1]
         w = spec.w
         # weighted standardization
-        wsum = w.sum()
-        xm = (Xe * w[:, None]).sum(0) / wsum
-        xv = (w[:, None] * (Xe - xm[None, :]) ** 2).sum(0) / wsum
-        xs = jnp.sqrt(jnp.maximum(xv, 1e-12))
-        if not bool(p.get("standardize", True)):
-            xm = jnp.zeros_like(xm)
-            xs = jnp.ones_like(xs)
+        if prior is not None:
+            # continue in the PRIOR model's input space — its weights
+            # are only valid under its own standardization (and the
+            # fresh reduction would be discarded anyway)
+            xm = jnp.asarray(prior.xm, jnp.float32)
+            xs = jnp.asarray(prior.xs, jnp.float32)
+        else:
+            wsum = w.sum()
+            xm = (Xe * w[:, None]).sum(0) / wsum
+            xv = (w[:, None] * (Xe - xm[None, :]) ** 2).sum(0) / wsum
+            xs = jnp.sqrt(jnp.maximum(xv, 1e-12))
+            if not bool(p.get("standardize", True)):
+                xm = jnp.zeros_like(xm)
+                xs = jnp.ones_like(xs)
         Xs = (Xe - xm[None, :]) / xs[None, :]
         if task == "autoencoder":
             # the network reconstructs its own standardized inputs
@@ -375,7 +478,13 @@ class H2ODeepLearningEstimator(ModelBuilder):
         key = jax.random.PRNGKey(seed if seed != -1
                                  else int(time.time() * 1e3) % (2 ** 31))
         key, ik = jax.random.split(key)
-        net = _init_params(ik, sizes)
+        if prior is not None:
+            net = [{"W": jnp.asarray(ly["W"], jnp.float32),
+                    "b": jnp.asarray(ly["b"], jnp.float32)}
+                   for ly in prior.net]
+        else:
+            net = _init_params(ik, sizes)
+        net = self._apply_initial_weights(net, sizes)
 
         padded = Xs.shape[0]
         nrow = spec.nrow
@@ -388,6 +497,17 @@ class H2ODeepLearningEstimator(ModelBuilder):
         n_batches = padded // batch
         use_rows = n_batches * batch
         epochs = float(p.get("epochs", 10.0))
+        prior_epochs = 0.0
+        if prior is not None:
+            # epochs is the TOTAL (hex/Model checkpoint semantics, same
+            # contract as the GBM resolver's ntrees): continue for the
+            # remainder, and reject a target the prior already met
+            prior_epochs = float(prior.output.get("epochs_trained", 0.0))
+            if epochs <= prior_epochs:
+                raise ValueError(
+                    f"epochs ({epochs}) must exceed the checkpoint's "
+                    f"epochs_trained ({prior_epochs})")
+            epochs = epochs - prior_epochs
         adaptive = bool(p.get("adaptive_rate", True))
         rho = float(p.get("rho", 0.99))
         eps = float(p.get("epsilon", 1e-8))
@@ -427,7 +547,9 @@ class H2ODeepLearningEstimator(ModelBuilder):
                              "multinomial" if spec.nclasses > 2 else
                              "regression")
         n_epochs = max(int(np.ceil(epochs)), 1)
-        samples = jnp.float32(0.0)
+        # annealing/momentum ramp continue from the prior sample count
+        samples = jnp.float32(prior.output.get("training_samples", 0.0)
+                              if prior is not None else 0.0)
         t0 = time.time()
         history = []
         for e in range(n_epochs):
@@ -456,7 +578,8 @@ class H2ODeepLearningEstimator(ModelBuilder):
             act_name)
         model.scoring_history = history
         model.output["training_loop_seconds"] = t_loop
-        model.output["epochs_trained"] = e + 1
+        model.output["epochs_trained"] = prior_epochs + e + 1
+        model.output["training_samples"] = float(jax.device_get(samples))
         if task == "autoencoder":
             # reconstruction error metrics (hex/ModelMetricsAutoEncoder:
             # MSE over all reconstructed cells)

@@ -124,3 +124,59 @@ def test_dl_small_frame_smaller_than_batch():
                                   mini_batch_size=256)
     dl.train(y="y", training_frame=fr)
     assert dl.model.training_metrics.r2 > 0.8
+
+
+def test_dl_checkpoint_continue_training():
+    """checkpoint (hex/Model.java:487): the prior DL model's weights
+    seed continued training; more epochs from the checkpoint must not
+    be worse than the checkpoint itself."""
+    import h2o3_tpu as h2o
+    rng = np.random.default_rng(12)
+    n = 2000
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    y = np.sin(x1) + 0.5 * x2 + 0.05 * rng.normal(size=n)
+    fr = h2o.Frame.from_numpy({"x1": x1, "x2": x2, "y": y})
+    m1 = H2ODeepLearningEstimator(hidden=[16], epochs=3, seed=1,
+                                  mini_batch_size=64)
+    m1.train(y="y", training_frame=fr)
+    mse1 = m1.model.training_metrics.mse
+    m2 = H2ODeepLearningEstimator(hidden=[16], epochs=10, seed=1,
+                                  mini_batch_size=64,
+                                  checkpoint=m1.model)
+    m2.train(y="y", training_frame=fr)
+    mse2 = m2.model.training_metrics.mse
+    assert mse2 < mse1 * 1.05, (mse1, mse2)
+    # topology mismatch rejected
+    bad = H2ODeepLearningEstimator(hidden=[8], epochs=2,
+                                   checkpoint=m1.model)
+    with pytest.raises((ValueError, RuntimeError), match="hidden"):
+        bad.train(y="y", training_frame=fr)
+
+
+def test_dl_initial_weights_and_biases():
+    """initial_weights/initial_biases seed specific layers; with rate 0
+    and 0 epochs of movement the seeded weights are reproduced."""
+    import h2o3_tpu as h2o
+    rng = np.random.default_rng(13)
+    n = 512
+    x = rng.normal(size=(n, 3))
+    y = x @ np.array([1.0, -2.0, 0.5]) + 0.01 * rng.normal(size=n)
+    fr = h2o.Frame.from_numpy({"a": x[:, 0], "b": x[:, 1],
+                               "c": x[:, 2], "y": y})
+    W0 = rng.normal(size=(3, 4)).astype(np.float32)
+    b1 = np.ones(1, np.float32)
+    est = H2ODeepLearningEstimator(
+        hidden=[4], epochs=2, seed=2, standardize=False,
+        initial_weights=[W0, None], initial_biases=[None, b1])
+    est.train(y="y", training_frame=fr)
+    assert est.model.training_metrics is not None
+    # wrong shape rejected
+    bad = H2ODeepLearningEstimator(
+        hidden=[4], epochs=1, initial_weights=[np.zeros((2, 2)), None])
+    with pytest.raises((ValueError, RuntimeError), match="shape"):
+        bad.train(y="y", training_frame=fr)
+    # wrong layer count rejected
+    bad2 = H2ODeepLearningEstimator(
+        hidden=[4], epochs=1, initial_weights=[W0])
+    with pytest.raises((ValueError, RuntimeError), match="per layer"):
+        bad2.train(y="y", training_frame=fr)
