@@ -20,7 +20,16 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 if os.environ.get("H2O3_TPU_TEST_PLATFORM", "cpu") == "cpu":
     os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+        # the 8-participant collective rendezvous can stall >40s on this
+        # 1-core host under load (all participants share one thread
+        # pool); XLA's default 40s terminate timeout then abort()s the
+        # whole process ("only 7 of them arrived on time") — observed
+        # intermittently on the wide sharded tests. The stall resolves;
+        # give it room instead of dying.
+        + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+        + " --xla_cpu_collective_call_terminate_timeout_seconds=900"
     )
     import jax
 
