@@ -18,19 +18,61 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+def _xla_flags_supported(flags: str) -> bool:
+    """XLA abort()s the whole process on an unknown flag in XLA_FLAGS, so
+    optional flags must be probed in a throwaway subprocess first. jaxlib
+    builds differ across driver hosts (the collective-timeout flags below
+    exist on some but not this image's 0.4.37) — cache the verdict per
+    jaxlib version so the ~5s probe runs once per environment."""
+    import hashlib
+    import subprocess
+    import tempfile
+    try:
+        import jaxlib
+        ver = getattr(jaxlib, "__version__", "?")
+    except ImportError:
+        ver = "?"
+    key = hashlib.sha1(f"{ver}|{flags}".encode()).hexdigest()[:12]
+    marker = os.path.join(tempfile.gettempdir(), f"h2o3_xlaflags_{key}")
+    try:
+        with open(marker) as f:
+            return f.read().strip() == "1"
+    except OSError:
+        pass
+    env = dict(os.environ, XLA_FLAGS=flags, JAX_PLATFORMS="cpu")
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            env=env, capture_output=True, timeout=300)
+        ok = r.returncode == 0
+    except (OSError, subprocess.SubprocessError):
+        # transient (timeout under load, spawn failure): do NOT cache a
+        # permanent negative — skip the flags this run, re-probe next
+        return False
+    try:
+        with open(marker, "w") as f:
+            f.write("1" if ok else "0")
+    except OSError:
+        pass
+    return ok
+
+
 if os.environ.get("H2O3_TPU_TEST_PLATFORM", "cpu") == "cpu":
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=8"
-        # the 8-participant collective rendezvous can stall >40s on this
-        # 1-core host under load (all participants share one thread
-        # pool); XLA's default 40s terminate timeout then abort()s the
-        # whole process ("only 7 of them arrived on time") — observed
-        # intermittently on the wide sharded tests. The stall resolves;
-        # give it room instead of dying.
-        + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
-        + " --xla_cpu_collective_call_terminate_timeout_seconds=900"
-    )
+    _flags = (os.environ.get("XLA_FLAGS", "")
+              + " --xla_force_host_platform_device_count=8")
+    # the 8-participant collective rendezvous can stall >40s on this
+    # 1-core host under load (all participants share one thread
+    # pool); XLA's default 40s terminate timeout then abort()s the
+    # whole process ("only 7 of them arrived on time") — observed
+    # intermittently on the wide sharded tests. The stall resolves;
+    # give it room instead of dying. The flags only exist on newer
+    # jaxlib builds — probe before adding (unknown flags are fatal).
+    _timeout_flags = (
+        " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+        " --xla_cpu_collective_call_terminate_timeout_seconds=900")
+    if _xla_flags_supported(_flags + _timeout_flags):
+        _flags += _timeout_flags
+    os.environ["XLA_FLAGS"] = _flags
     import jax
 
     jax.config.update("jax_platforms", "cpu")

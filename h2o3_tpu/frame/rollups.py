@@ -7,15 +7,16 @@ the sharded column; GSPMD inserts the cross-device psum automatically.
 """
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 
-@partial(jax.jit, static_argnums=(1,))
+@jax.jit
 def _rollup_kernel(data, nrow):
+    # nrow is TRACED (a device scalar), not a static argnum: the padded
+    # shape is already bucketed by padded_len, so tracing nrow means one
+    # compile per padded length instead of one per distinct frame length
     n = data.shape[0]
     valid = (jnp.arange(n) < nrow) & ~jnp.isnan(data)
     x = jnp.where(valid, data, 0.0)

@@ -23,6 +23,7 @@ string ops, which we run host-side).
 """
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -153,23 +154,21 @@ class Vec:
             sec = np.where(host == Vec.TIME_NA, np.nan, host / 1000.0).astype(np.float32)
             dev = _pad_and_put(sec, nrow, np.float32(np.nan), mesh)
             return Vec(dev, nrow, T_TIME, host_data=host)
+        # wide int64 input (beyond float64's exact 2^53): the float64
+        # round-trip would silently munge values, so the exact int64
+        # array itself becomes the host copy (water/fvec/C8Chunk)
+        if (vtype == T_INT and arr.dtype.kind in "iu" and arr.size
+                and np.abs(arr, dtype=np.float64).max() >= float(1 << 53)):
+            f64 = np.asarray(arr, dtype=np.int64)
+            dev = _pad_and_put(f64.astype(np.float32), nrow,
+                               np.float32(np.nan), mesh)
+            return Vec(dev, nrow, T_INT, host_data=f64.copy())
         f64 = np.asarray(arr, dtype=np.float64)
         f = f64.astype(np.float32)
         if not explicit and vtype == T_INT and not _is_integral(f64):
             vtype = T_REAL
         dev = _pad_and_put(f, nrow, np.float32(np.nan), mesh)
-        # float32 mantissa is 24 bits: large ints (IDs, counts, epoch
-        # millis that arrive as REAL) would be silently rounded on
-        # device, so keep an exact float64 host copy whenever the values
-        # are integral and exceed the mantissa (the reference keeps
-        # exact long chunks — water/fvec/C8Chunk). Order matters: the
-        # cheap max check gates the O(n) integrality scan
-        host = None
-        finite = f64[np.isfinite(f64)]
-        if finite.size and np.abs(finite).max() > (1 << 24):
-            if vtype == T_INT or _is_integral(f64):
-                host = f64
-        return Vec(dev, nrow, vtype, host_data=host)
+        return Vec(dev, nrow, vtype, host_data=_numeric_host_copy(f64, vtype))
 
     @staticmethod
     def _from_strings(arr: np.ndarray, mesh) -> "Vec":
@@ -328,6 +327,65 @@ class Vec:
 def _is_integral(f: np.ndarray) -> bool:
     finite = f[np.isfinite(f)]
     return bool(finite.size == 0 or np.all(finite == np.round(finite)))
+
+
+def _numeric_host_copy(f64: np.ndarray, vtype: str):
+    """float32 mantissa is 24 bits: large ints (IDs, counts, epoch
+    millis that arrive as REAL) would be silently rounded on device, so
+    keep an exact float64 host copy whenever the values are integral and
+    exceed the mantissa (the reference keeps exact long chunks —
+    water/fvec/C8Chunk). Order matters: the cheap max check gates the
+    O(n) integrality scan."""
+    if f64.size:
+        import warnings
+        with np.errstate(invalid="ignore"), warnings.catch_warnings():
+            # all-NaN columns (fully-missing numerics) warn via the
+            # warnings module, which errstate does not cover
+            warnings.simplefilter("ignore", RuntimeWarning)
+            m = np.nanmax(np.abs(f64))       # one scan, no mask-copy
+        if np.isnan(m):
+            return None                      # all-NA column
+        if np.isfinite(m) and m > (1 << 24):
+            if vtype == T_INT or _is_integral(f64):
+                return f64
+        elif np.isinf(m):
+            # ±inf hid the finite max: fall back to the exact mask path
+            finite = f64[np.isfinite(f64)]
+            if finite.size and np.abs(finite).max() > (1 << 24):
+                if vtype == T_INT or _is_integral(f64):
+                    return f64
+    return None
+
+
+def batch_device_put(columns, fill, dtype, nrow: int, mesh=None):
+    """One host→device transfer for a whole dtype group of columns.
+
+    Columns land in a single padded row-sharded [plen, ncol] matrix —
+    one DMA instead of ncol — and come back as per-column device arrays
+    (on-device slices along the unsharded axis, so no resharding). The
+    ingest pipeline overlaps the (async) transfer with the host-side
+    encode of the remaining groups."""
+    mesh = mesh or current_mesh()
+    plen = padded_len(nrow, mesh)
+    mat = np.empty((plen, len(columns)), dtype=dtype)
+    if plen > nrow:
+        mat[nrow:] = fill              # only the pad tail needs filling
+
+    def _pack(j):
+        # assignment converts dtype in the same pass as the copy (a
+        # separate astype would write every column twice)
+        mat[:nrow, j] = columns[j]
+
+    if nrow * len(columns) >= (1 << 22):
+        import concurrent.futures as cf
+        with cf.ThreadPoolExecutor(
+                max_workers=min(len(columns), os.cpu_count() or 4, 8)) as ex:
+            list(ex.map(_pack, range(len(columns))))  # GIL-free memcpy
+    else:
+        for j in range(len(columns)):
+            _pack(j)
+    dev = jax.device_put(mat, data_sharding(mesh))
+    return [dev[:, j] for j in range(len(columns))]
 
 
 def _pad_and_put(arr: np.ndarray, nrow: int, fill, mesh):

@@ -1,0 +1,374 @@
+"""Chunk-local columnar encode — the streaming half of the parse pipeline.
+
+Reference: water/parser/ParseDataset.java MultiFileParseTask streams each
+raw-byte chunk through CsvParser into typed per-column NewChunks, each
+with a chunk-local categorical dictionary; ParseDataset then unions the
+domains (water/parser/PackedDomains) and a second MRTask remaps every
+chunk's codes into the global domain. This module is that contract for
+the TPU rebuild: a byte-range worker returns finished typed numpy
+columns (never global Python token lists), and ``merge_columns`` unions
+enum domains and LUT-remaps the codes.
+
+Per-cell Python loops only survive on rare fallback edges (malformed
+time tokens, wide-int re-parse); the hot paths are the native tokenizer
+(fast_csv.cpp), the native hash dictionary (csv_enum_encode), and
+vectorized numpy over the (starts, lens) offset arrays.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from h2o3_tpu.frame.vec import ENUM_NA, T_ENUM, T_INT, T_REAL, T_STR, T_TIME, Vec
+
+# max enum cardinality before a column falls back to string
+# (reference: Categorical.MAX_CATEGORICAL_COUNT ~ 10M; we cap lower since
+# domains are host-side python lists)
+MAX_ENUM_CARDINALITY = 1_000_000
+
+# |v| >= 2^53 no longer round-trips exactly through float64
+_EXACT_F64_BOUND = float(1 << 53)
+
+_SLAB = 1 << 18  # rows per token-extraction slab (bounds the index matrix)
+
+
+@dataclass
+class EncodedColumn:
+    """One column of one chunk, fully typed (the NewChunk analog).
+
+    ``data`` by vtype: real/int → float64 (NA=NaN); int with ``exact``
+    set → the float64 view plus an exact int64 shadow (values beyond
+    2^53); time → int64 epoch millis (NA=Vec.TIME_NA); enum → int32
+    codes (NA=-1) against the sorted chunk-local ``domain``; string →
+    object array of str/None."""
+    vtype: str
+    data: np.ndarray
+    domain: Optional[List[str]] = None
+    exact: Optional[np.ndarray] = None  # int64, only for wide int columns
+
+
+def _tokens_sarr(data: bytes, starts: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    """Vectorized token extraction: gather each cell's bytes into a
+    fixed-width S array (one numpy pass per slab, no per-cell Python)."""
+    n = len(starts)
+    if n == 0:
+        return np.empty(0, dtype="S1")
+    width = max(int(lens.max()), 1)
+    buf = np.frombuffer(data, dtype=np.uint8)
+    out = np.empty(n, dtype=f"S{width}")
+    span = np.arange(width, dtype=np.int64)[None, :]
+    # bound rows*width, not rows: one long cell (free-text note) must
+    # not turn the slab's index matrix into gigabytes — and up to 16
+    # worker threads run this concurrently
+    slab = max(1, min(_SLAB, (1 << 24) // width))
+    for lo in range(0, n, slab):
+        hi = min(lo + slab, n)
+        idx = starts[lo:hi, None] + span
+        np.clip(idx, 0, max(len(buf) - 1, 0), out=idx)
+        mat = np.where(span < lens[lo:hi, None], buf[idx], 0)
+        out[lo:hi] = np.ascontiguousarray(mat.astype(np.uint8)).view(
+            f"S{width}").ravel()
+    return out
+
+
+def _na_bytes(nas) -> np.ndarray:
+    vals = [s.encode("utf-8") for s in (nas or ())]
+    return np.array(vals, dtype="S") if vals else np.empty(0, dtype="S1")
+
+
+def _codes_from_labels(codes: np.ndarray, labels: List[str], nas) -> EncodedColumn:
+    """Finish a dictionary encode: NA-string labels map to the NA code,
+    the rest rank against the SORTED chunk domain (the reference sorts
+    each chunk's categorical domain before PackedDomains union)."""
+    # distinct byte tokens can collide after errors='replace' decoding —
+    # dedupe on the decoded string like the Python tokenizer would
+    keep = sorted({lab for lab in labels if lab not in nas})
+    rank = {lab: k for k, lab in enumerate(keep)}
+    if labels:
+        lut = np.fromiter(
+            (ENUM_NA if lab in nas else rank[lab] for lab in labels),
+            dtype=np.int32, count=len(labels))
+        out = lut[codes]
+    else:
+        out = np.full(len(codes), ENUM_NA, dtype=np.int32)
+    return EncodedColumn(T_ENUM, out, domain=keep)
+
+
+def _encode_enum_offsets(data: bytes, starts: np.ndarray, lens: np.ndarray,
+                         nas, max_card: int) -> Optional[EncodedColumn]:
+    """Enum column from (starts, lens): native hash dictionary when
+    available, else vectorized numpy unique. None → string fallback."""
+    from h2o3_tpu import native
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    lens = np.ascontiguousarray(lens, dtype=np.int32)
+    res = native.enum_encode(data, starts, lens,
+                             max_card + len(nas or ()) + 1)
+    if res is not None:
+        codes, uniq_rows = res
+        labels = [data[starts[r]: starts[r] + lens[r]].decode(
+            "utf-8", errors="replace") for r in uniq_rows]
+        col = _codes_from_labels(codes, labels, nas)
+        return col if len(col.domain) <= max_card else None
+    toks = _tokens_sarr(data, starts, lens)
+    uniq, inv = np.unique(toks, return_inverse=True)
+    if len(uniq) > max_card + len(nas or ()) + 1:
+        return None
+    labels = [u.decode("utf-8", errors="replace") for u in uniq]
+    col = _codes_from_labels(inv.astype(np.int32), labels, nas)
+    return col if len(col.domain) <= max_card else None
+
+
+def _decode_str_offsets(data: bytes, starts: np.ndarray,
+                        lens: np.ndarray, nas) -> np.ndarray:
+    """Object array of str (None for NA strings) from (starts, lens)."""
+    toks = _tokens_sarr(data, starts, lens)
+    isna = np.isin(toks, _na_bytes(nas))
+    try:
+        out = np.char.decode(toks, "utf-8").astype(object)
+    except UnicodeDecodeError:
+        out = np.array([t.decode("utf-8", errors="replace") for t in toks],
+                       dtype=object)
+    out[isna] = None
+    return out
+
+
+def _time_from_u(u: np.ndarray, isna: np.ndarray) -> Optional[np.ndarray]:
+    """Vectorized datetime parse of a U array → int64 millis, or None
+    when a malformed token needs the tolerant per-cell path."""
+    try:
+        u = np.where(isna, np.array("NaT", dtype="U3"), u)
+        ms = u.astype("datetime64[ms]").astype(np.int64)
+    except ValueError:
+        return None
+    return ms  # NaT → int64 min == Vec.TIME_NA
+
+
+def _time_per_cell(tokens) -> np.ndarray:
+    ms = np.full(len(tokens), Vec.TIME_NA, dtype=np.int64)
+    for i, t in enumerate(tokens):
+        if t is not None:
+            try:
+                ms[i] = np.datetime64(t, "ms").astype(np.int64)
+            except ValueError:
+                pass
+    return ms
+
+
+def _encode_time_offsets(data: bytes, starts, lens, nas) -> np.ndarray:
+    toks = _tokens_sarr(data, starts, lens)
+    isna = np.isin(toks, _na_bytes(nas))
+    try:
+        u = toks.astype("U")
+    except UnicodeDecodeError:
+        u = None
+    if u is not None:
+        ms = _time_from_u(u, isna)
+        if ms is not None:
+            return ms
+    dec = [None if isna[i] else toks[i].decode("utf-8", errors="replace")
+           for i in range(len(toks))]
+    return _time_per_cell(dec)
+
+
+def _exact_int_from_tokens(tokens) -> Optional[np.ndarray]:
+    """Exact int64 parse of a wide-int column (beyond float64's 2^53).
+    None when any cell is NA, non-integer, or outside int64 range —
+    the column then falls back to float64/real."""
+    out = np.empty(len(tokens), dtype=np.int64)
+    for i, t in enumerate(tokens):
+        if t is None:
+            return None
+        try:
+            v = int(t)
+        except ValueError:
+            return None
+        if not (-(1 << 63) <= v < (1 << 63)):
+            return None
+        out[i] = v
+    return out
+
+
+def _maybe_exact(vals: np.ndarray, vtype: str, tokens_fn) -> Optional[np.ndarray]:
+    """Wide-int detection: only when a T_INT column holds finite values
+    at/above 2^53 is the (rare) exact re-parse worth a token pass."""
+    if vtype != T_INT or vals.size == 0:
+        return None
+    finite = np.isfinite(vals)
+    if not finite.all():
+        return None  # NA/stray cells: no exact representation
+    if not np.any(np.abs(vals) >= _EXACT_F64_BOUND):
+        return None
+    return _exact_int_from_tokens(tokens_fn())
+
+
+def encode_chunk_native(data: bytes, setup, skip_header: bool
+                        ) -> Optional[List[EncodedColumn]]:
+    """Native-tokenizer chunk encode: one C scan emits offsets + eagerly
+    parsed doubles (fast_csv.cpp), then every column finishes as a typed
+    numpy array without materializing Python token lists. None → caller
+    uses the Python fallback (no toolchain, quotes, ragged rows)."""
+    from h2o3_tpu.native import parse_bytes
+    out = parse_bytes(data, setup.separator)
+    if out is None:
+        return None
+    starts, lens, vals, ok = out
+    r0 = 1 if skip_header else 0
+    if vals.shape[1] != len(setup.column_types):
+        return None
+    nas = setup.na_strings if setup.na_strings is not None else set()
+    cols: List[EncodedColumn] = []
+    for j, vt in enumerate(setup.column_types):
+        if vt in (T_REAL, T_INT):
+            v = vals[r0:, j].copy()
+            # tokens_fn only runs for all-finite wide-int columns, so
+            # every cell is numeric ASCII text
+            exact = _maybe_exact(
+                v, vt,
+                lambda j=j: np.char.decode(_tokens_sarr(
+                    data, np.ascontiguousarray(starts[r0:, j]),
+                    np.ascontiguousarray(lens[r0:, j])),
+                    "utf-8").tolist())
+            cols.append(EncodedColumn(vt, v, exact=exact))
+            continue
+        s = np.ascontiguousarray(starts[r0:, j])
+        ln = np.ascontiguousarray(lens[r0:, j])
+        if vt == T_TIME:
+            cols.append(EncodedColumn(T_TIME,
+                                      _encode_time_offsets(data, s, ln, nas)))
+        elif vt == T_ENUM:
+            col = _encode_enum_offsets(data, s, ln, nas,
+                                       MAX_ENUM_CARDINALITY)
+            if col is None:  # cardinality blowout → string column
+                col = EncodedColumn(T_STR,
+                                    _decode_str_offsets(data, s, ln, nas))
+            cols.append(col)
+        else:
+            cols.append(EncodedColumn(T_STR,
+                                      _decode_str_offsets(data, s, ln, nas)))
+    return cols
+
+
+def encode_token_column(tokens: Sequence[Optional[str]],
+                        vtype: str) -> EncodedColumn:
+    """Python-tokenizer fallback encode of one column (tokens carry None
+    for NA — the tokenizer already applied the na_strings). Still
+    vectorized where numpy can parse; per-cell loops only when a stray
+    token defeats the bulk conversion — so the fallback produces the
+    same typed chunk shape as the native path."""
+    n = len(tokens)
+    if vtype in (T_REAL, T_INT):
+        u = np.array([t if t is not None else "nan" for t in tokens],
+                     dtype="U")
+        try:
+            vals = u.astype(np.float64) if n else np.empty(0, np.float64)
+        except ValueError:
+            vals = np.full(n, np.nan, dtype=np.float64)
+            for i, t in enumerate(tokens):
+                if t is not None:
+                    try:
+                        vals[i] = float(t)
+                    except ValueError:
+                        pass  # stray non-numeric → NA
+        exact = _maybe_exact(vals, vtype, lambda: list(tokens))
+        return EncodedColumn(vtype, vals, exact=exact)
+    if vtype == T_TIME:
+        isna = np.array([t is None for t in tokens], dtype=bool)
+        u = np.array([t if t is not None else "NaT" for t in tokens],
+                     dtype="U")
+        ms = _time_from_u(u, isna) if n else np.empty(0, np.int64)
+        if ms is None:
+            ms = _time_per_cell(tokens)
+        return EncodedColumn(T_TIME, ms)
+    if vtype == T_ENUM:
+        isna = np.array([t is None for t in tokens], dtype=bool)
+        u = np.array([t if t is not None else "" for t in tokens], dtype="U")
+        uniq = np.unique(u[~isna]) if (~isna).any() else np.empty(0, "U1")
+        if len(uniq) <= MAX_ENUM_CARDINALITY:
+            codes = np.searchsorted(uniq, u).astype(np.int32)
+            codes[isna] = ENUM_NA
+            return EncodedColumn(T_ENUM, codes,
+                                 domain=[str(x) for x in uniq])
+        # cardinality blowout → string column
+    return EncodedColumn(T_STR, np.array(list(tokens), dtype=object))
+
+
+def _chunk_to_strings(col: EncodedColumn) -> np.ndarray:
+    if col.vtype == T_STR:
+        return col.data
+    dom = np.array(list(col.domain) + [None], dtype=object)
+    return dom[np.where(col.data < 0, len(col.domain), col.data)]
+
+
+def _merge_numeric(chunks: List[EncodedColumn], vtype: str) -> EncodedColumn:
+    datas = [c.data for c in chunks]
+    if vtype == T_INT and any(c.exact is not None for c in chunks):
+        exacts = []
+        for c in chunks:
+            if c.exact is not None:
+                exacts.append(c.exact)
+                continue
+            f = c.data
+            if (f.size == 0 or (np.isfinite(f).all()
+                                and np.all(f == np.round(f))
+                                and np.all(np.abs(f) < _EXACT_F64_BOUND))):
+                exacts.append(f.astype(np.int64))
+            else:
+                exacts = None
+                break
+        if exacts is not None:
+            return EncodedColumn(T_INT, np.concatenate(exacts)
+                                 if len(exacts) > 1 else exacts[0])
+        # wide ints coexist with NAs/strays: no exact representation —
+        # the column degrades to real rather than silently munging
+        vtype = T_REAL
+    return EncodedColumn(vtype, np.concatenate(datas)
+                         if len(datas) > 1 else datas[0])
+
+
+def _merge_enum(chunks: List[EncodedColumn]) -> EncodedColumn:
+    if any(c.vtype == T_STR for c in chunks):
+        return EncodedColumn(T_STR, np.concatenate(
+            [_chunk_to_strings(c) for c in chunks]))
+    union = sorted(set().union(*(c.domain for c in chunks)))
+    if len(union) > MAX_ENUM_CARDINALITY:
+        return EncodedColumn(T_STR, np.concatenate(
+            [_chunk_to_strings(c) for c in chunks]))
+    gidx = {lab: k for k, lab in enumerate(union)}
+    parts = []
+    for c in chunks:
+        if c.domain == union:
+            parts.append(c.data)  # common fast path: no remap needed
+            continue
+        # vectorized LUT remap (the PackedDomains second pass); the
+        # trailing -1 serves the NA code, which indexes it as lut[-1]
+        lut = np.fromiter((gidx[lab] for lab in c.domain), dtype=np.int32,
+                          count=len(c.domain))
+        lut = np.append(lut, np.int32(ENUM_NA))
+        parts.append(lut[c.data])
+    return EncodedColumn(T_ENUM, np.concatenate(parts)
+                         if len(parts) > 1 else parts[0], domain=union)
+
+
+def merge_columns(chunk_results: List[List[EncodedColumn]],
+                  column_types: Sequence[str]) -> List[EncodedColumn]:
+    """Union chunk-local columns into full columns: enum domains union +
+    code remap, numeric/time concatenate, wide-int exactness resolved
+    across chunks. Never round-trips values through strings."""
+    out: List[EncodedColumn] = []
+    for i, vt in enumerate(column_types):
+        chunks = [cr[i] for cr in chunk_results]
+        if vt in (T_REAL, T_INT):
+            out.append(_merge_numeric(chunks, vt))
+        elif vt == T_TIME:
+            datas = [c.data for c in chunks]
+            out.append(EncodedColumn(T_TIME, np.concatenate(datas)
+                                     if len(datas) > 1 else datas[0]))
+        elif vt == T_ENUM:
+            out.append(_merge_enum(chunks))
+        else:
+            datas = [c.data for c in chunks]
+            out.append(EncodedColumn(T_STR, np.concatenate(datas)
+                                     if len(datas) > 1 else datas[0]))
+    return out

@@ -6,32 +6,41 @@ MultiFileParseTask MRTask over raw-byte chunks, each node streaming its
 chunks through CsvParser into per-column NewChunks, then unions categorical
 domains across nodes and assembles the Frame.
 
-TPU re-design: parsing is host work (TPUs don't parse bytes); each host
-reads its byte ranges, tokenises to typed numpy columns, unions enum
-domains, and the columns are device_put row-sharded. The two-phase
-guess-then-parse contract and the type system are preserved. A C++
-tokeniser can slot under ``_parse_csv_text`` later without changing the
-interface.
+TPU re-design: parsing is host work (TPUs don't parse bytes). Phase 2 is a
+streaming, chunk-local pipeline: each byte-range worker tokenizes its
+range (native C++ scan, fast_csv.cpp) and finishes every column as a
+typed numpy array — numeric float64, time int64 millis, enum codes
+against a chunk-local dictionary (csv_enum_encode) — so no global Python
+token list ever materializes (ingest/chunk.py). The merge unions the
+chunk-local enum domains (the reference's PackedDomains contract) and
+remaps codes with a vectorized LUT; device placement batches one 2D
+host→device transfer per dtype group, overlapping the remaining host
+encode work (frame/frame.py Frame.from_typed_columns).
 """
 from __future__ import annotations
 
 import csv
 import io
 import os
+import re
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
 from h2o3_tpu.frame.frame import Frame
-from h2o3_tpu.frame.vec import ENUM_NA, T_ENUM, T_INT, T_REAL, T_STR, T_TIME, Vec
+from h2o3_tpu.frame.vec import T_ENUM, T_INT, T_REAL, T_STR, T_TIME, Vec
+from h2o3_tpu.ingest.chunk import (MAX_ENUM_CARDINALITY, EncodedColumn,
+                                   encode_chunk_native, encode_token_column,
+                                   merge_columns)
 
 DEFAULT_NA_STRINGS = {"", "NA", "N/A", "na", "NaN", "nan", "null", "NULL", "None", "?"}
 _SEP_CANDIDATES = [",", "\t", ";", "|", " "]
-# max enum cardinality before a column falls back to string
-# (reference: Categorical.MAX_CATEGORICAL_COUNT ~ 10M; we cap lower since
-# domains are host-side python lists)
-MAX_ENUM_CARDINALITY = 1_000_000
+
+# stage timings of the most recent parse() call (tools/profile_ingest.py
+# and bench.py read this to attribute ingest regressions)
+LAST_PROFILE: Dict[str, object] = {}
 
 
 @dataclass
@@ -53,12 +62,13 @@ def _is_number(tok: str) -> bool:
         return False
 
 
+_INT_RE = re.compile(r"[+-]?\d+\Z")
+
+
 def _is_int(tok: str) -> bool:
-    try:
-        f = float(tok)
-        return f == int(f) and "e" not in tok.lower() and "." not in tok
-    except (ValueError, OverflowError):
-        return False
+    # lexical, not float-round-trip: float(t) is exact only to 2^53, so
+    # a wide integer token must not be classified (or valued) through it
+    return _INT_RE.match(tok.strip()) is not None
 
 
 def _looks_time(tok: str) -> bool:
@@ -168,7 +178,8 @@ def parse_setup(paths: Union[str, Sequence[str]], separator: Optional[str] = Non
 
 
 def _parse_csv_text(text: str, setup: ParseSetup, skip_header: bool):
-    """Tokenise one file's text into per-column python lists."""
+    """Tokenise one file's text into per-column python lists (the
+    quote-correct fallback tokenizer; NA strings become None)."""
     reader = csv.reader(io.StringIO(text), delimiter=setup.separator,
                         quotechar=setup.quotechar)
     rows = [r for r in reader if r]
@@ -181,82 +192,6 @@ def _parse_csv_text(text: str, setup: ParseSetup, skip_header: bool):
         for ci in range(ncol):
             tok = r[ci].strip() if ci < len(r) else ""
             cols[ci][ri] = None if tok in nas else tok
-    return cols
-
-
-def _column_to_vec(tokens, vtype: str, mesh=None) -> Vec:
-    n = len(tokens)
-    if vtype in (T_REAL, T_INT):
-        if isinstance(tokens, np.ndarray):
-            # native tokenizer output: already-parsed float64 (NA = NaN)
-            return Vec.from_numpy(tokens, vtype=vtype, mesh=mesh)
-        arr = np.full(n, np.nan, dtype=np.float64)
-        for i, t in enumerate(tokens):
-            if t is not None:
-                try:
-                    arr[i] = float(t)
-                except ValueError:
-                    pass  # stray non-numeric in a numeric column → NA
-        return Vec.from_numpy(arr, vtype=vtype, mesh=mesh)
-    if vtype == T_TIME:
-        ms = np.full(n, Vec.TIME_NA, dtype=np.int64)
-        for i, t in enumerate(tokens):
-            if t is not None:
-                try:
-                    ms[i] = np.datetime64(t, "ms").astype(np.int64)
-                except ValueError:
-                    pass
-        return Vec.from_numpy(ms, vtype=T_TIME, mesh=mesh)
-    if vtype == T_STR:
-        return Vec.from_numpy(np.array(tokens, dtype=object), vtype=T_STR, mesh=mesh)
-    # enum: union domain then encode (reference: PackedDomains union across nodes)
-    vals = sorted({t for t in tokens if t is not None})
-    if len(vals) > MAX_ENUM_CARDINALITY:
-        return Vec.from_numpy(np.array(tokens, dtype=object), vtype=T_STR, mesh=mesh)
-    lut = {v: i for i, v in enumerate(vals)}
-    codes = np.fromiter((ENUM_NA if t is None else lut[t] for t in tokens),
-                        dtype=np.int32, count=n)
-    return Vec.from_numpy(codes, vtype=T_ENUM, domain=vals, mesh=mesh)
-
-
-def _native_token_columns(data: bytes, setup: ParseSetup,
-                          skip_header: bool):
-    """Native-tokenizer fast path: C++ scans the bytes once
-    (h2o3_tpu/native/fast_csv.cpp — the CsvParser hot loop), numeric
-    columns come back pre-parsed, and Python touches only the cells of
-    enum/string/time columns. Returns token-column compatible output: a list
-    with a numpy float64 array per numeric column and a list of
-    Optional[str] per other column — or None to use the Python path."""
-    from h2o3_tpu.native import parse_bytes
-    out = parse_bytes(data, setup.separator)
-    if out is None:
-        return None
-    starts, lens, vals, ok = out
-    r0 = 1 if skip_header else 0
-    ncols = vals.shape[1]
-    if ncols != len(setup.column_types):
-        return None
-    na = setup.na_strings if setup.na_strings is not None else \
-        DEFAULT_NA_STRINGS
-    cols = []
-    for j, vt in enumerate(setup.column_types):
-        if vt in (T_REAL, T_INT):
-            # pre-parsed doubles; non-numeric tokens (NA strings or
-            # strays) are already NaN — identical to _column_to_vec
-            cols.append(vals[r0:, j].copy())
-        else:
-            s = starts[r0:, j]
-            ln = lens[r0:, j]
-            o = ok[r0:, j]
-            toks: List[Optional[str]] = []
-            for i in range(len(s)):
-                if o[i] == 2:
-                    toks.append(None)
-                    continue
-                t = data[s[i]: s[i] + ln[i]].decode("utf-8",
-                                                    errors="replace")
-                toks.append(None if t in na else t)
-            cols.append(toks)
     return cols
 
 
@@ -279,12 +214,9 @@ def _byte_ranges(path: str, n_chunks: int) -> List[tuple]:
             if bounds[i + 1] > bounds[i]]
 
 
-def _parse_range(path: str, start: int, end: int, setup: ParseSetup,
-                 skip_header: bool):
-    with open(path, "rb") as f:
-        f.seek(start)
-        text = f.read(end - start).decode("utf-8", errors="replace")
-    return _parse_csv_text(text, setup, skip_header=skip_header)
+def _native_available() -> bool:
+    from h2o3_tpu.native import lib as _native_lib
+    return _native_lib() is not None
 
 
 def _na_strings_native_safe(setup: ParseSetup) -> bool:
@@ -303,101 +235,111 @@ def _na_strings_native_safe(setup: ParseSetup) -> bool:
     return True
 
 
-def _parse_range_native(path: str, start: int, end: int, setup: ParseSetup,
-                        skip_header: bool):
+def _encode_range_native(path: str, start: int, end: int, setup: ParseSetup,
+                         skip_header: bool):
     """Byte-range worker on the native tokenizer (ctypes releases the
-    GIL during the C scan, so a THREAD pool parallelises it without the
-    process-spawn + pickle cost of the Python fallback). Returns per-
-    column numpy float64 arrays (numeric) / token lists, or None."""
+    GIL during the C scans, so a THREAD pool runs tokenize AND the
+    numpy/native encode concurrently, with no process-spawn or pickle
+    cost). Returns finished typed columns, or None to fall back."""
     with open(path, "rb") as f:
         f.seek(start)
         data = f.read(end - start)
-    return _native_token_columns(data, setup, skip_header=skip_header)
+    return encode_chunk_native(data, setup, skip_header)
+
+
+def _encode_range_python(path: str, start: int, end: int, setup: ParseSetup,
+                         skip_header: bool):
+    """Python-tokenizer worker (quote-correct csv.reader); the encode is
+    still chunk-local and typed, so process workers pickle compact numpy
+    arrays back, never token lists."""
+    with open(path, "rb") as f:
+        f.seek(start)
+        text = f.read(end - start).decode("utf-8", errors="replace")
+    tokens = _parse_csv_text(text, setup, skip_header=skip_header)
+    return [encode_token_column(toks, vt)
+            for toks, vt in zip(tokens, setup.column_types)]
 
 
 def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
           mesh=None, key: Optional[str] = None) -> Frame:
-    """Phase 2 — full parse into a row-sharded Frame. Large files are
-    tokenised in parallel over newline-aligned byte ranges (the
-    MultiFileParseTask fan-out, ParseDataset.java:623; processes stand
-    in for nodes since CPython tokenisation doesn't share the GIL)."""
+    """Phase 2 — streaming chunk-local parse into a row-sharded Frame.
+
+    Large files fan out over newline-aligned byte ranges (the
+    MultiFileParseTask fan-out, ParseDataset.java:623); every worker
+    returns finished typed columns with chunk-local enum dictionaries,
+    the merge unions domains + LUT-remaps codes, and device placement
+    batches one 2D transfer per dtype group."""
+    import concurrent.futures as cf
     if isinstance(paths, str):
         paths = [paths]
     setup = setup or parse_setup(paths)
-    parts: Optional[List[list]] = None     # per column: list of chunks
-
-    def merge(cols):
-        nonlocal parts
-        if parts is None:
-            parts = [[c] for c in cols]
-        else:
-            for ps, extra in zip(parts, cols):
-                ps.append(extra)
-
-    from h2o3_tpu.native import lib as _native_lib
-    native_ok = _native_lib() is not None and _na_strings_native_safe(setup)
+    t0 = time.perf_counter()
+    jobs = []                      # (path, start, end, skip_header)
     for p in paths:
         size = os.path.getsize(p)
         if size >= _PARALLEL_PARSE_BYTES:
-            import concurrent.futures as cf
-            n_chunks = min(os.cpu_count() or 4, 16)
-            ranges = _byte_ranges(p, n_chunks)
-            results = [None] * len(ranges)
-            if native_ok:
-                # native tokenizer + THREADS: the ctypes call releases
-                # the GIL, so workers scan byte ranges concurrently with
-                # no process-spawn or result-pickle overhead
-                with cf.ThreadPoolExecutor(max_workers=len(ranges)) as ex:
-                    futs = [ex.submit(_parse_range_native, p, s, e, setup,
-                                      setup.header and s == 0)
-                            for (s, e) in ranges]
-                    results = [fu.result() for fu in futs]
-            if any(r is None for r in results):
-                # Python fallback in PROCESSES — spawn, not fork: this
-                # process is multithreaded (JAX/XLA), and forking while
-                # another thread holds an XLA mutex deadlocks the child
-                import multiprocessing as mp
-                ctx = mp.get_context("spawn")
-                with cf.ProcessPoolExecutor(max_workers=len(ranges),
-                                            mp_context=ctx) as ex:
-                    futs = [ex.submit(_parse_range, p, s, e, setup,
-                                      setup.header and s == 0)
-                            for (s, e) in ranges]
-                    results = [fu.result() for fu in futs]
-            for r in results:
-                merge(r)
+            ranges = _byte_ranges(p, min(os.cpu_count() or 4, 16))
+            jobs += [(p, s, e, setup.header and s == 0) for s, e in ranges]
         else:
-            with open(p, "rb") as f:
-                data = f.read()
-            cols = (_native_token_columns(data, setup,
-                                          skip_header=setup.header)
-                    if native_ok else None)
-            if cols is None:
-                cols = _parse_csv_text(data.decode("utf-8",
-                                                   errors="replace"),
-                                       setup, skip_header=setup.header)
-            merge(cols)
+            jobs.append((p, 0, size, setup.header))
+    native_ok = _native_available() and _na_strings_native_safe(setup)
+    results: List[Optional[List[EncodedColumn]]] = [None] * len(jobs)
+    if native_ok:
+        if len(jobs) == 1:
+            p, s, e, skip = jobs[0]
+            results[0] = _encode_range_native(p, s, e, setup, skip)
+        else:
+            workers = min(len(jobs), os.cpu_count() or 4, 16)
+            with cf.ThreadPoolExecutor(max_workers=workers) as ex:
+                futs = [ex.submit(_encode_range_native, p, s, e, setup, skip)
+                        for p, s, e, skip in jobs]
+                results = [fu.result() for fu in futs]
+    todo = [k for k, r in enumerate(results) if r is None]
+    if todo:
+        # fallback is FILE-scoped, not range-scoped: the two tokenizers
+        # disagree on edge tokens (>63-char numerics, unicode
+        # whitespace), so one declined range sends every range of that
+        # file through the Python tokenizer — a column must never mix
+        # tokenizers across its chunks (the equivalence contract)
+        bad_paths = {jobs[k][0] for k in todo}
+        todo = [k for k, j in enumerate(jobs) if j[0] in bad_paths]
+        total = sum(jobs[k][2] - jobs[k][1] for k in todo)
+        if len(todo) > 1 and total >= _PARALLEL_PARSE_BYTES:
+            # Python fallback in PROCESSES — spawn, not fork: this
+            # process is multithreaded (JAX/XLA), and forking while
+            # another thread holds an XLA mutex deadlocks the child
+            import multiprocessing as mp
+            ctx = mp.get_context("spawn")
+            workers = min(len(todo), os.cpu_count() or 4, 16)
+            with cf.ProcessPoolExecutor(max_workers=workers,
+                                        mp_context=ctx) as ex:
+                futs = {k: ex.submit(_encode_range_python, jobs[k][0],
+                                     jobs[k][1], jobs[k][2], setup,
+                                     jobs[k][3])
+                        for k in todo}
+                for k, fu in futs.items():
+                    results[k] = fu.result()
+        else:
+            for k in todo:
+                p, s, e, skip = jobs[k]
+                results[k] = _encode_range_python(p, s, e, setup, skip)
+    t1 = time.perf_counter()
+    merged = merge_columns(results, setup.column_types)
+    t2 = time.perf_counter()
     skipped = set(setup.skipped_columns)
-    names, vecs = [], []
-    for i, t in enumerate(setup.column_types):
-        if i in skipped:
-            continue
-        ps = parts[i]
-        if all(isinstance(c, np.ndarray) for c in ps):
-            col = ps[0] if len(ps) == 1 else np.concatenate(ps)
-        else:
-            col = []
-            for c in ps:
-                if isinstance(c, np.ndarray):
-                    # repr(float(v)), not repr(v): numpy 2.x scalar repr
-                    # is 'np.float64(1.5)', which float() can't parse
-                    col.extend(None if np.isnan(v) else repr(float(v))
-                               for v in c)
-                else:
-                    col.extend(c)
-        names.append(setup.column_names[i])
-        vecs.append(_column_to_vec(col, t, mesh=mesh))
-    return Frame(names, vecs, key=key or os.path.basename(paths[0]))
+    names = [n for i, n in enumerate(setup.column_names) if i not in skipped]
+    cols = [c for i, c in enumerate(merged) if i not in skipped]
+    fr = Frame.from_typed_columns(names, cols, mesh=mesh,
+                                  key=key or os.path.basename(paths[0]))
+    t3 = time.perf_counter()
+    # in-place so `from h2o3_tpu.ingest.parse import LAST_PROFILE` stays live
+    LAST_PROFILE.clear()
+    LAST_PROFILE.update({"rows": fr.nrow, "chunks": len(jobs),
+                         "native": bool(native_ok and not todo),
+                         "tokenize_encode_s": round(t1 - t0, 4),
+                         "merge_s": round(t2 - t1, 4),
+                         "device_put_s": round(t3 - t2, 4)})
+    return fr
 
 
 def import_file(path: Union[str, Sequence[str]], destination_frame: Optional[str] = None,
@@ -435,20 +377,55 @@ def import_file(path: Union[str, Sequence[str]], destination_frame: Optional[str
 
 
 def _rbind(a: Frame, b: Frame, mesh=None) -> Frame:
+    """Row-concatenate two frames for multi-file import. Enum columns
+    union their two domains and LUT-remap the integer codes (the
+    PackedDomains contract) instead of round-tripping every cell through
+    label strings and a full re-encode; time columns stay time."""
+    from h2o3_tpu.ingest.chunk import _merge_enum, _merge_numeric
     if a.names != b.names:
         raise ValueError("multi-file import needs identical schemas")
-    data = {}
+
+    def _num_chunk(v):
+        d = v.to_numpy()
+        if d.dtype == np.int64:     # exact wide-int host shadow
+            return EncodedColumn(T_INT, d.astype(np.float64), exact=d)
+        return EncodedColumn(v.type, d)
+
+    names, vecs = [], []
     for n in a.names:
         va, vb = a.vec(n), b.vec(n)
-        if (va.type == T_ENUM or vb.type == T_ENUM
-                or va.type == T_STR or vb.type == T_STR):
-            data[n] = np.concatenate([np.asarray(va.to_strings(),
-                                                 dtype=object),
-                                      np.asarray(vb.to_strings(),
-                                                 dtype=object)])
+        names.append(n)
+        if va.type == T_ENUM and vb.type == T_ENUM:
+            # the chunk merger IS the PackedDomains contract — same
+            # union + LUT remap (and cardinality degrade) as the parse
+            col = _merge_enum([
+                EncodedColumn(T_ENUM, v.to_numpy().astype(np.int32),
+                              domain=list(v.domain or ()))
+                for v in (va, vb)])
+            vecs.append(Vec.from_numpy(col.data, vtype=col.vtype,
+                                       domain=col.domain, mesh=mesh))
+        elif va.type == T_STR and vb.type == T_STR:
+            data = np.concatenate([va.to_strings(), vb.to_strings()])
+            vecs.append(Vec.from_numpy(np.asarray(data, dtype=object),
+                                       vtype=T_STR, mesh=mesh))
+        elif va.type == T_TIME and vb.type == T_TIME:
+            ms = np.concatenate([va.to_numpy(), vb.to_numpy()])
+            vecs.append(Vec.from_numpy(ms.astype(np.int64), vtype=T_TIME,
+                                       mesh=mesh))
+        elif va.is_numeric and vb.is_numeric:
+            vt = T_REAL if T_REAL in (va.type, vb.type) else T_INT
+            # via the chunk merger so an exact-int64 side never gets
+            # munged by a float64 concat promotion
+            col = _merge_numeric([_num_chunk(va), _num_chunk(vb)], vt)
+            vecs.append(Vec.from_numpy(col.data, vtype=col.vtype,
+                                       mesh=mesh))
         else:
-            data[n] = np.concatenate([va.to_numpy(), vb.to_numpy()])
-    return Frame.from_numpy(data, mesh=mesh)
+            # mixed types across files (one file guessed enum, the other
+            # string/numeric): degrade through labels like the reference
+            data = np.concatenate([np.asarray(va.to_strings(), dtype=object),
+                                   np.asarray(vb.to_strings(), dtype=object)])
+            vecs.append(Vec.from_numpy(data, mesh=mesh))
+    return Frame(names, vecs, key=a.key)
 
 
 def upload_numpy(data, names=None, mesh=None) -> Frame:

@@ -60,6 +60,21 @@ def lib():
                                 ctypes.POINTER(ctypes.c_int),
                                 ctypes.POINTER(ctypes.c_double),
                                 ctypes.POINTER(ctypes.c_ubyte)]
+        try:
+            # absent only in a stale .so whose mtime beat the source (the
+            # mtime check above rebuilds the normal stale case); callers
+            # probe with hasattr and fall back to the numpy encoder
+            L.csv_enum_encode.restype = ctypes.c_longlong
+            L.csv_enum_encode.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_longlong),
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.c_longlong,
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_longlong),
+                ctypes.c_longlong]
+        except AttributeError:
+            pass
         _LIB = L
         return _LIB
 
@@ -94,3 +109,31 @@ def parse_bytes(data: bytes, sep: str):
         return None
     return (starts.reshape(r, c), lens.reshape(r, c),
             vals.reshape(r, c), ok.reshape(r, c))
+
+
+def enum_encode(data: bytes, starts, lens, max_card: int):
+    """Dictionary-encode one column's tokens natively.
+
+    ``starts``/``lens`` are the column's per-cell offsets from
+    ``parse_bytes``. Returns ``(codes int32, uniq_rows int64)`` where
+    ``uniq_rows[k]`` is the row whose cell first used dictionary id
+    ``k`` — or None when the native path declines (no toolchain, old
+    .so, cardinality above ``max_card``)."""
+    import numpy as np
+    L = lib()
+    if L is None or not hasattr(L, "csv_enum_encode"):
+        return None
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    lens = np.ascontiguousarray(lens, dtype=np.int32)
+    n = len(starts)
+    codes = np.empty(n, np.int32)
+    uniq = np.empty(max(max_card, 1), np.int64)
+    card = L.csv_enum_encode(
+        data, starts.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int)), n,
+        codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        uniq.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        max_card)
+    if card < 0:
+        return None
+    return codes, uniq[:card]

@@ -14,6 +14,20 @@
 #include <cstdlib>
 #include <cstring>
 #include <cmath>
+#include <vector>
+
+namespace {
+
+inline unsigned long long fnv1a(const char* p, int n) {
+    unsigned long long h = 1469598103934665603ULL;
+    for (int i = 0; i < n; ++i) {
+        h ^= (unsigned char)p[i];
+        h *= 1099511628211ULL;
+    }
+    return h;
+}
+
+}  // namespace
 
 extern "C" {
 
@@ -103,6 +117,60 @@ long long csv_parse(const char* buf, long long len, char sep,
         ++r;
     }
     return r;
+}
+
+// Chunk-local enum dictionary encode (the NewChunk categorical path of
+// water/parser/CsvParser.java, where each chunk builds its own domain
+// before ParseDataset unions them). One column's cells arrive as
+// (starts, lens) pairs from csv_parse; tokens dictionary-encode against
+// an open-addressing hash table in first-appearance order. Outputs:
+// codes[i] = dictionary id of cell i, uniq_rows[k] = row index of the
+// first cell holding dictionary entry k (the caller decodes labels from
+// those). Returns the cardinality, or -1 when it would exceed max_card
+// (caller falls back to a string column). NA-string and empty-cell
+// handling stay in Python: they become ordinary dictionary entries the
+// caller remaps to the NA code.
+long long csv_enum_encode(const char* buf,
+                          const long long* starts, const int* lens,
+                          long long n,
+                          int* codes, long long* uniq_rows,
+                          long long max_card) {
+    long long cap = 1024;
+    std::vector<long long> table(cap, -1);
+    long long card = 0;
+    for (long long i = 0; i < n; ++i) {
+        if (card * 10 >= cap * 7) {          // load > 0.7: rehash
+            cap <<= 1;
+            table.assign(cap, -1);
+            for (long long k = 0; k < card; ++k) {
+                long long r = uniq_rows[k];
+                long long j = fnv1a(buf + starts[r], lens[r]) & (cap - 1);
+                while (table[j] >= 0) j = (j + 1) & (cap - 1);
+                table[j] = k;
+            }
+        }
+        const char* p = buf + starts[i];
+        int len = lens[i];
+        long long j = fnv1a(p, len) & (cap - 1);
+        for (;;) {
+            long long e = table[j];
+            if (e < 0) {
+                if (card >= max_card) return -1;
+                uniq_rows[card] = i;
+                table[j] = card;
+                codes[i] = (int)card;
+                ++card;
+                break;
+            }
+            long long r = uniq_rows[e];
+            if (lens[r] == len && memcmp(buf + starts[r], p, len) == 0) {
+                codes[i] = (int)e;
+                break;
+            }
+            j = (j + 1) & (cap - 1);
+        }
+    }
+    return card;
 }
 
 }  // extern "C"

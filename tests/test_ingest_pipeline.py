@@ -1,0 +1,249 @@
+"""Streaming parse-pipeline equivalence tests.
+
+The chunk-local columnar encode (ingest/chunk.py) must be invisible to
+semantics: native vs Python tokenizer and serial vs byte-range-parallel
+all produce bit-identical Frames — values, NA positions, enum domains
+and code order, time columns — on a fixture with quoted fields, NA
+sentinels, and rows straddling range boundaries (the reference's
+ParserTest equivalence discipline for MultiFileParseTask chunking).
+"""
+import importlib
+
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+
+# the package re-exports the parse() FUNCTION under the same attribute
+# name as the module — resolve the module explicitly for monkeypatching
+parse_mod = importlib.import_module("h2o3_tpu.ingest.parse")
+from h2o3_tpu.ingest.parse import _is_int, parse, parse_setup
+
+
+def _mixed_csv(nrow=200, quotes=True):
+    """Mixed-type fixture: int, real, enum, time, plus NA sentinels in
+    every column and (optionally) quoted fields with embedded commas."""
+    rng = np.random.default_rng(7)
+    lines = ["id,score,city,seen,note"]
+    cities = ["ames", "berlin", "cairo", "delhi,town" if quotes else "delhitown"]
+    for i in range(nrow):
+        idv = "NA" if i % 31 == 7 else str(i + 1)
+        score = "NaN" if i % 17 == 3 else f"{rng.normal():.6f}"
+        c = cities[int(rng.integers(0, len(cities)))]
+        city = f'"{c}"' if (quotes and "," in c) else c
+        seen = "" if i % 23 == 5 else f"2021-{1 + i % 12:02d}-{1 + i % 28:02d}"
+        note = f"n{i % 5}"
+        lines.append(f"{idv},{score},{city},{seen},{note}")
+    return "\n".join(lines) + "\n"
+
+
+def _frames_equal(a, b):
+    assert a.names == b.names
+    assert a.nrow == b.nrow
+    for n in a.names:
+        va, vb = a.vec(n), b.vec(n)
+        assert va.type == vb.type, n
+        assert va.domain == vb.domain, n
+        xa, xb = va.to_numpy(), vb.to_numpy()
+        if xa.dtype.kind == "f":
+            np.testing.assert_array_equal(np.isnan(xa), np.isnan(xb), err_msg=n)
+            np.testing.assert_array_equal(xa[~np.isnan(xa)], xb[~np.isnan(xb)],
+                                          err_msg=n)
+        else:
+            np.testing.assert_array_equal(xa, xb, err_msg=n)
+
+
+@pytest.fixture
+def mixed_file(tmp_path):
+    p = tmp_path / "mixed.csv"
+    p.write_text(_mixed_csv())
+    return str(p)
+
+
+@pytest.fixture
+def unquoted_file(tmp_path):
+    # no quotes: the native tokenizer accepts it (quoted files route to
+    # the Python tokenizer), so this fixture exercises the native path
+    p = tmp_path / "plain.csv"
+    p.write_text(_mixed_csv(quotes=False))
+    return str(p)
+
+
+def test_native_vs_python_tokenizer_identical(unquoted_file, monkeypatch):
+    setup = parse_setup(unquoted_file)
+    fr_native = parse([unquoted_file], setup)
+    if not parse_mod.LAST_PROFILE.get("native"):
+        pytest.skip("native tokenizer unavailable in this image")
+    monkeypatch.setattr(parse_mod, "_native_available", lambda: False)
+    fr_python = parse([unquoted_file], setup)
+    assert not parse_mod.LAST_PROFILE["native"]
+    _frames_equal(fr_native, fr_python)
+
+
+def test_serial_vs_parallel_identical(mixed_file, monkeypatch):
+    setup = parse_setup(mixed_file)
+    fr_serial = parse([mixed_file], setup)
+    assert parse_mod.LAST_PROFILE["chunks"] == 1
+    # force the byte-range fan-out: every file goes parallel, and rows
+    # straddle the newline-aligned range boundaries
+    monkeypatch.setattr(parse_mod, "_PARALLEL_PARSE_BYTES", 1)
+    fr_par = parse([mixed_file], setup)
+    assert parse_mod.LAST_PROFILE["chunks"] > 1
+    _frames_equal(fr_serial, fr_par)
+
+
+def test_parallel_python_fallback_identical(mixed_file, monkeypatch):
+    setup = parse_setup(mixed_file)
+    fr_serial = parse([mixed_file], setup)
+    monkeypatch.setattr(parse_mod, "_PARALLEL_PARSE_BYTES", 1)
+    monkeypatch.setattr(parse_mod, "_native_available", lambda: False)
+    fr_par = parse([mixed_file], setup)
+    assert parse_mod.LAST_PROFILE["chunks"] > 1
+    assert not parse_mod.LAST_PROFILE["native"]
+    _frames_equal(fr_serial, fr_par)
+
+
+def test_quoted_fields_and_na_sentinels(mixed_file):
+    fr = parse([mixed_file], parse_setup(mixed_file))
+    city = fr.vec("city")
+    assert city.type == "enum"
+    assert "delhi,town" in city.domain          # quoted comma survives
+    assert fr.vec("id").na_count() == sum(1 for i in range(200) if i % 31 == 7)
+    assert fr.vec("seen").type == "time"
+    assert fr.vec("seen").na_count() == sum(1 for i in range(200) if i % 23 == 5)
+
+
+def test_numeric_na_sentinel_routes_off_native(tmp_path):
+    # a numeric na_string ('-999') cannot be expressed in the native
+    # numeric fast path (any non-numeric token is already NaN there) —
+    # the parse must fall back and still honor the sentinel
+    p = tmp_path / "sentinel.csv"
+    p.write_text("a,b\n1,-999\n-999,2\n3,4\n")
+    fr = h2o.import_file(str(p), na_strings=["-999"])
+    a, b = fr.vec("a").to_numpy(), fr.vec("b").to_numpy()
+    assert np.isnan(a[1]) and np.isnan(b[0])
+    assert a[0] == 1 and b[2] == 4
+
+
+# ---------------- satellite: lexical int detection / wide ints ----------
+
+
+def test_is_int_lexical():
+    assert _is_int("12") and _is_int("-3") and _is_int(" +7 ")
+    assert not _is_int("1.5") and not _is_int("1e5") and not _is_int("x2")
+    # the float-round-trip misclassifies this as int AND munges it;
+    # lexical detection keeps it int and exact
+    assert _is_int("9007199254740993")
+
+
+@pytest.mark.parametrize("force_python", [False, True])
+def test_wide_int_exact_roundtrip(tmp_path, monkeypatch, force_python):
+    wide = (1 << 53) + 1          # not representable in float64
+    p = tmp_path / "wide.csv"
+    p.write_text("k,v\n%d,1\n%d,2\n%d,3\n" % (wide, wide + 2, -wide))
+    if force_python:
+        monkeypatch.setattr(parse_mod, "_native_available", lambda: False)
+    fr = parse([str(p)], parse_setup(str(p)))
+    k = fr.vec("k").to_numpy()
+    assert k.dtype == np.int64
+    assert list(k) == [wide, wide + 2, -wide]
+
+
+def test_wide_int_with_na_degrades_to_real(tmp_path):
+    wide = (1 << 53) + 1
+    p = tmp_path / "widena.csv"
+    p.write_text("k\n%d\nNA\n7\n" % wide)
+    fr = parse([str(p)], parse_setup(str(p)))
+    k = fr.vec("k").to_numpy()
+    assert np.isnan(k[1]) and k[2] == 7  # NA kept; no silent munge claim
+
+
+# ---------------- satellite: _rbind enum domain union -------------------
+
+
+def test_rbind_enum_union_remaps_codes(tmp_path):
+    (tmp_path / "a.csv").write_text("g,x\nred,1\nblue,2\nred,3\n")
+    (tmp_path / "b.csv").write_text("g,x\ngreen,4\nred,5\nNA,6\n")
+    fr = h2o.import_file([str(tmp_path / "a.csv"), str(tmp_path / "b.csv")])
+    g = fr.vec("g")
+    assert g.type == "enum"
+    assert g.domain == ("blue", "green", "red")
+    codes = g.to_numpy()
+    labels = [None if c < 0 else g.domain[c] for c in codes]
+    assert labels == ["red", "blue", "red", "green", "red", None]
+    np.testing.assert_allclose(fr.vec("x").to_numpy(), [1, 2, 3, 4, 5, 6])
+
+
+def test_rbind_wide_int_stays_exact(tmp_path):
+    wide = (1 << 53) + 1
+    (tmp_path / "a.csv").write_text("k\n%d\n%d\n" % (wide, wide + 2))
+    (tmp_path / "b.csv").write_text("k\n5\n6\n")
+    fr = h2o.import_file([str(tmp_path / "a.csv"), str(tmp_path / "b.csv")])
+    k = fr.vec("k").to_numpy()
+    # float64 concat promotion would munge wide ints; the merge must
+    # keep the exact int64 representation across the two files
+    assert k.dtype == np.int64
+    assert list(k) == [wide, wide + 2, 5, 6]
+
+
+def test_all_na_numeric_column(tmp_path):
+    import warnings
+    p = tmp_path / "allna.csv"
+    p.write_text("a,b\nNA,1\nNA,2\nNA,3\n")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")        # any RuntimeWarning fails
+        fr = parse([str(p)], parse_setup(str(p)))
+    assert fr.vec("a").na_count() == 3
+
+
+def test_fallback_is_file_scoped(tmp_path, monkeypatch):
+    # a quote in ONE byte range must route the WHOLE file through the
+    # Python tokenizer: the two tokenizers disagree on edge tokens
+    # (e.g. >63-char numerics, which the native scan maps to NA), so a
+    # column must never mix tokenizers across its chunks
+    long_num = "0." + "1" * 70             # parses in Python, not native
+    rows = [f"{i},plain" for i in range(2, 400)]
+    body = [f"{long_num},first"] + rows + ['9,"quoted,tail"']
+    p = tmp_path / "mix.csv"
+    p.write_text("x,s\n" + "\n".join(body) + "\n")
+    setup = parse_setup(str(p))
+    monkeypatch.setattr(parse_mod, "_PARALLEL_PARSE_BYTES", 1)
+    fr = parse([str(p)], setup)
+    assert parse_mod.LAST_PROFILE["chunks"] > 1
+    assert not parse_mod.LAST_PROFILE["native"]
+    x = fr.vec("x").to_numpy()
+    assert x[0] == pytest.approx(float(long_num))   # not munged to NA
+    assert "quoted,tail" in fr.vec("s").domain
+
+
+def test_rbind_time_stays_time(tmp_path):
+    (tmp_path / "a.csv").write_text("t\n2020-01-01\n2020-01-02\n")
+    (tmp_path / "b.csv").write_text("t\n2021-05-05\nNA\n")
+    fr = h2o.import_file([str(tmp_path / "a.csv"), str(tmp_path / "b.csv")])
+    t = fr.vec("t")
+    assert t.type == "time"
+    ms = t.to_numpy()
+    assert ms[0] == np.datetime64("2020-01-01", "ms").astype(np.int64)
+    assert ms[3] == t.TIME_NA
+    assert fr.vec("t").na_count() == 1
+
+
+# ---------------- satellite: rollup kernel recompile --------------------
+
+
+def test_rollup_no_recompile_across_nrow():
+    from h2o3_tpu.frame.rollups import _rollup_kernel
+    from h2o3_tpu.parallel.mesh import padded_len
+
+    n1, n2 = 90, 100
+    assert padded_len(n1) == padded_len(n2)  # same padding bucket
+    v1 = h2o.Vec.from_numpy(np.arange(n1, dtype=np.float32))
+    v2 = h2o.Vec.from_numpy(np.arange(n2, dtype=np.float32) * 2)
+    r1 = v1.rollups()
+    before = _rollup_kernel._cache_size()
+    r2 = v2.rollups()
+    # nrow is traced, shape unchanged — the second length must HIT
+    assert _rollup_kernel._cache_size() == before
+    assert r1["rows"] == n1 and r2["rows"] == n2
+    assert r1["mean"] == pytest.approx((n1 - 1) / 2)
+    assert r2["max"] == pytest.approx(2 * (n2 - 1))

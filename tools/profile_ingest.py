@@ -1,0 +1,125 @@
+"""Ingest stage profiler — attribute parse time to its pipeline stages.
+
+Writes a synthetic mixed-type CSV (numeric, enum, time columns with NA
+sentinels), then times the four stages of the streaming parse pipeline
+separately on one chunk — tokenize (native C scan, fast_csv.cpp),
+encode (chunk-local typed columns + enum dictionaries, ingest/chunk.py),
+domain-union merge, and the batched host→device transfer — plus the real
+end-to-end ``parse()`` (byte-range fan-out) for the wall-clock number.
+Prints ONE JSON line so a future ingest regression is attributable to a
+stage, not just "parse got slower".
+
+Env knobs: ROWS (default 2M), NCOL_NUM / NCOL_ENUM / NCOL_TIME,
+CSV (reuse an existing file instead of synthesizing).
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+ROWS = int(os.environ.get("ROWS", 2_000_000))
+NCOL_NUM = int(os.environ.get("NCOL_NUM", 6))
+NCOL_ENUM = int(os.environ.get("NCOL_ENUM", 2))
+NCOL_TIME = int(os.environ.get("NCOL_TIME", 1))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _synth_csv(path):
+    rng = np.random.default_rng(11)
+    cities = np.array(["ames", "berlin", "cairo", "delhi", "el-paso",
+                       "fargo", "galway", "hanoi"])
+    header = ([f"n{i}" for i in range(NCOL_NUM)]
+              + [f"e{i}" for i in range(NCOL_ENUM)]
+              + [f"t{i}" for i in range(NCOL_TIME)])
+    log(f"writing {path} ({ROWS} rows x {len(header)} cols) ...")
+    t0 = time.time()
+    with open(path, "w") as f:
+        f.write(",".join(header) + "\n")
+        chunk = 200_000
+        for s in range(0, ROWS, chunk):
+            e = min(s + chunk, ROWS)
+            cols = []
+            for i in range(NCOL_NUM):
+                v = np.char.mod("%.6g", rng.normal(size=e - s))
+                v[rng.random(e - s) < 0.01] = "NA"
+                cols.append(v)
+            for i in range(NCOL_ENUM):
+                cols.append(cities[rng.integers(0, len(cities), e - s)])
+            for i in range(NCOL_TIME):
+                days = rng.integers(0, 3650, e - s)
+                d = (np.datetime64("2015-01-01") + days).astype(str)
+                cols.append(d)
+            mat = np.stack(cols, axis=1)
+            block = [",".join(row) for row in mat]
+            f.write("\n".join(block) + "\n")
+    log(f"csv written in {time.time() - t0:.1f}s")
+
+
+def main():
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.ingest.chunk import encode_chunk_native, merge_columns
+    from h2o3_tpu.ingest.parse import LAST_PROFILE, parse, parse_setup
+    from h2o3_tpu.native import parse_bytes
+
+    path = os.environ.get("CSV") or os.path.join(
+        tempfile.gettempdir(), f"h2o3_profile_ingest_{ROWS}.csv")
+    if not os.path.exists(path):
+        _synth_csv(path)
+    setup = parse_setup(path)
+    with open(path, "rb") as f:
+        data = f.read()
+
+    out = {"rows": ROWS, "ncol": len(setup.column_names),
+           "bytes": len(data)}
+
+    # stage 1: tokenize — the native C scan alone (offsets + doubles)
+    t0 = time.perf_counter()
+    tok = parse_bytes(data, setup.separator)
+    t1 = time.perf_counter()
+    if tok is None:
+        out["tokenize_s"] = None
+        log("native tokenizer unavailable/declined; stage split skipped")
+    else:
+        out["tokenize_s"] = round(t1 - t0, 4)
+        # stage 2: encode — typed columns + chunk-local enum dictionaries
+        # (encode_chunk_native re-tokenizes; its own time minus stage 1
+        # is the encode share)
+        t2 = time.perf_counter()
+        cols = encode_chunk_native(data, setup, setup.header)
+        t3 = time.perf_counter()
+        out["encode_s"] = round((t3 - t2) - (t1 - t0), 4)
+        # stage 3: domain union + LUT remap across (here: one) chunks
+        t4 = time.perf_counter()
+        merged = merge_columns([cols], setup.column_types)
+        t5 = time.perf_counter()
+        out["domain_union_s"] = round(t5 - t4, 4)
+        # stage 4: batched host→device transfer (one DMA per dtype group)
+        t6 = time.perf_counter()
+        fr = Frame.from_typed_columns(setup.column_names, merged)
+        for v in fr.vecs:
+            if v.data is not None:
+                v.data.block_until_ready()
+        t7 = time.perf_counter()
+        out["device_put_s"] = round(t7 - t6, 4)
+
+    # end-to-end: the real parallel parse (fan-out + overlap), wall clock
+    t8 = time.perf_counter()
+    fr = parse([path], setup)
+    t9 = time.perf_counter()
+    out["parse_wall_s"] = round(t9 - t8, 4)
+    out["parse_rows_per_s"] = round(fr.nrow / (t9 - t8), 1)
+    out["parallel_profile"] = dict(LAST_PROFILE)
+    print(json.dumps(out))
+    return out
+
+
+if __name__ == "__main__":
+    main()
