@@ -192,7 +192,7 @@ def main():
         # chunk-local parse pipeline, ingest/parse.py): disk CSV →
         # typed sharded Frame, rows/sec of wall-clock parse time
         out["ingest_seconds"] = round(ingest_s, 1)
-        out["ingest_rows_per_sec"] = round(ROWS / ingest_s, 1)
+        out["ingest_rows_per_sec"] = round(fr.nrow / ingest_s, 1)
     print(json.dumps(out))
 
 

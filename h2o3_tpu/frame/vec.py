@@ -158,7 +158,12 @@ class Vec:
         # round-trip would silently munge values, so the exact int64
         # array itself becomes the host copy (water/fvec/C8Chunk)
         if (vtype == T_INT and arr.dtype.kind in "iu" and arr.size
-                and np.abs(arr, dtype=np.float64).max() >= float(1 << 53)):
+                and np.abs(arr, dtype=np.float64).max() >= float(1 << 53)
+                # uint64 above int64 max can't ride the exact shadow —
+                # asarray would wrap it negative; let it degrade to the
+                # approximate float64 path below instead
+                and (arr.dtype.kind == "i"
+                     or arr.max() <= np.uint64(np.iinfo(np.int64).max))):
             f64 = np.asarray(arr, dtype=np.int64)
             dev = _pad_and_put(f64.astype(np.float32), nrow,
                                np.float32(np.nan), mesh)

@@ -34,6 +34,10 @@ _EXACT_F64_BOUND = float(1 << 53)
 _SLAB = 1 << 18  # rows per token-extraction slab (bounds the index matrix)
 
 
+def _skipped_set(setup) -> frozenset:
+    return frozenset(getattr(setup, "skipped_columns", ()) or ())
+
+
 @dataclass
 class EncodedColumn:
     """One column of one chunk, fully typed (the NewChunk analog).
@@ -47,6 +51,12 @@ class EncodedColumn:
     data: np.ndarray
     domain: Optional[List[str]] = None
     exact: Optional[np.ndarray] = None  # int64, only for wide int columns
+
+
+# placeholder for a skipped column: never encoded, never merged — the
+# tokenizer still scans the cell (rows are parsed whole), but no
+# dictionary/decode/union work is spent on it
+SKIPPED = EncodedColumn(T_STR, np.empty(0, dtype=object))
 
 
 def _tokens_sarr(data: bytes, starts: np.ndarray, lens: np.ndarray) -> np.ndarray:
@@ -218,8 +228,12 @@ def encode_chunk_native(data: bytes, setup, skip_header: bool
     if vals.shape[1] != len(setup.column_types):
         return None
     nas = setup.na_strings if setup.na_strings is not None else set()
+    skipped = _skipped_set(setup)
     cols: List[EncodedColumn] = []
     for j, vt in enumerate(setup.column_types):
+        if j in skipped:
+            cols.append(SKIPPED)
+            continue
         if vt in (T_REAL, T_INT):
             v = vals[r0:, j].copy()
             # tokens_fn only runs for all-finite wide-int columns, so
@@ -352,12 +366,18 @@ def _merge_enum(chunks: List[EncodedColumn]) -> EncodedColumn:
 
 
 def merge_columns(chunk_results: List[List[EncodedColumn]],
-                  column_types: Sequence[str]) -> List[EncodedColumn]:
+                  column_types: Sequence[str],
+                  skipped: Sequence[int] = ()) -> List[Optional[EncodedColumn]]:
     """Union chunk-local columns into full columns: enum domains union +
     code remap, numeric/time concatenate, wide-int exactness resolved
-    across chunks. Never round-trips values through strings."""
-    out: List[EncodedColumn] = []
+    across chunks. Never round-trips values through strings. Columns in
+    ``skipped`` come back as None (their chunks are never touched)."""
+    skip = frozenset(skipped)
+    out: List[Optional[EncodedColumn]] = []
     for i, vt in enumerate(column_types):
+        if i in skip:
+            out.append(None)
+            continue
         chunks = [cr[i] for cr in chunk_results]
         if vt in (T_REAL, T_INT):
             out.append(_merge_numeric(chunks, vt))

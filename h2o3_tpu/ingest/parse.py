@@ -31,7 +31,8 @@ import numpy as np
 
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.frame.vec import T_ENUM, T_INT, T_REAL, T_STR, T_TIME, Vec
-from h2o3_tpu.ingest.chunk import (MAX_ENUM_CARDINALITY, EncodedColumn,
+from h2o3_tpu.ingest.chunk import (MAX_ENUM_CARDINALITY, SKIPPED,
+                                   EncodedColumn, _skipped_set,
                                    encode_chunk_native, encode_token_column,
                                    merge_columns)
 
@@ -188,8 +189,12 @@ def _parse_csv_text(text: str, setup: ParseSetup, skip_header: bool):
     ncol = len(setup.column_names)
     cols = [[None] * len(rows) for _ in range(ncol)]
     nas = setup.na_strings
+    # skipped columns keep their all-None placeholder list (alignment for
+    # the caller's zip) but never pay the per-cell strip/NA loop
+    skipped = _skipped_set(setup)
+    active = [ci for ci in range(ncol) if ci not in skipped]
     for ri, r in enumerate(rows):
-        for ci in range(ncol):
+        for ci in active:
             tok = r[ci].strip() if ci < len(r) else ""
             cols[ci][ri] = None if tok in nas else tok
     return cols
@@ -256,8 +261,9 @@ def _encode_range_python(path: str, start: int, end: int, setup: ParseSetup,
         f.seek(start)
         text = f.read(end - start).decode("utf-8", errors="replace")
     tokens = _parse_csv_text(text, setup, skip_header=skip_header)
-    return [encode_token_column(toks, vt)
-            for toks, vt in zip(tokens, setup.column_types)]
+    skipped = _skipped_set(setup)
+    return [SKIPPED if j in skipped else encode_token_column(toks, vt)
+            for j, (toks, vt) in enumerate(zip(tokens, setup.column_types))]
 
 
 def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
@@ -324,9 +330,9 @@ def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
                 p, s, e, skip = jobs[k]
                 results[k] = _encode_range_python(p, s, e, setup, skip)
     t1 = time.perf_counter()
-    merged = merge_columns(results, setup.column_types)
+    skipped = _skipped_set(setup)
+    merged = merge_columns(results, setup.column_types, skipped=skipped)
     t2 = time.perf_counter()
-    skipped = set(setup.skipped_columns)
     names = [n for i, n in enumerate(setup.column_names) if i not in skipped]
     cols = [c for i, c in enumerate(merged) if i not in skipped]
     fr = Frame.from_typed_columns(names, cols, mesh=mesh,

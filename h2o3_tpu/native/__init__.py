@@ -126,6 +126,9 @@ def enum_encode(data: bytes, starts, lens, max_card: int):
     starts = np.ascontiguousarray(starts, dtype=np.int64)
     lens = np.ascontiguousarray(lens, dtype=np.int32)
     n = len(starts)
+    # cardinality can never exceed n cells, so cap the dictionary buffer
+    # by n — max_card is ~1M (8 MB) and 16 workers run concurrently
+    max_card = min(max_card, n)
     codes = np.empty(n, np.int32)
     uniq = np.empty(max(max_card, 1), np.int64)
     card = L.csv_enum_encode(

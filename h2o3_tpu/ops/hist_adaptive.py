@@ -57,6 +57,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from h2o3_tpu.ops.pallas_compat import CompilerParams as _CompilerParams
+
 import os as _os
 
 TILE = int(_os.environ.get("H2O3_HIST_TILE", 8192))
@@ -239,7 +241,7 @@ def adaptive_level_tpu(x, nid, ghw, tables, lo, inv, n_prev: int,
         cost_estimate=pl.CostEstimate(
             flops=2 * 3 * n_nodes * F * W * rows,
             bytes_accessed=rows * F * 4 + rows * 16, transcendentals=0),
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=_CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(x, nid[None, :], ghw, tabs, loinv)
     return nid2[0], hist.reshape(3, n_nodes, F, W)
@@ -426,7 +428,7 @@ def leaf_totals_tpu(x, nid, ghw, tables, n_prev: int, n_nodes: int,
             jax.ShapeDtypeStruct((3 * n_nodes, 128), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((3 * n_nodes, 128), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=_CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(x, nid[None, :], ghw, tabs)
     return nid2[0], tot[:, 0].reshape(3, n_nodes)
@@ -598,7 +600,7 @@ def adaptive_level_tpu_i8(xt, nid, q, scales, tables, lo, inv, n_prev: int,
         ],
         scratch_shapes=[pltpu.VMEM((3 * terms * n_nodes, F * W),
                                    jnp.int32)],
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=_CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(xt, nid[None, :], q, scales[None, :], tabs, loinv)
     return nid2[0], hist.reshape(3, n_nodes, F, W)
@@ -741,7 +743,7 @@ def adaptive_level_tpu_t(xt, nid, ghw, tables, lo, inv, n_prev: int,
             jax.ShapeDtypeStruct((3 * n_nodes, F * W), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((3 * n_nodes, F * W), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=_CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(xt, nid[None, :], ghw, tabs, loinv)
     return nid2[0], hist.reshape(3, n_nodes, F, W)
@@ -773,7 +775,7 @@ def route_only_tpu_t(xt, nid, tables, n_prev: int, level_base: int,
         ],
         out_specs=pl.BlockSpec((1, tile), lambda r: (0, r)),
         out_shape=jax.ShapeDtypeStruct((1, rows), jnp.int32),
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=_CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(xt, nid[None, :], tabs)
     return nid2[0]
@@ -809,7 +811,7 @@ def route_only_tpu(x, nid, tables, n_prev: int, level_base: int,
         ],
         out_specs=pl.BlockSpec((1, tile), lambda r: (0, r)),
         out_shape=jax.ShapeDtypeStruct((1, rows), jnp.int32),
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=_CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(x, nid[None, :], tabs)
     return nid2[0]

@@ -1,0 +1,10 @@
+"""Pallas TPU API compatibility aliases.
+
+jax renamed ``pltpu.TPUCompilerParams`` to ``pltpu.CompilerParams``;
+resolve whichever spelling this jaxlib ships so kernel code works on
+both sides of the rename.
+"""
+from jax.experimental.pallas import tpu as _pltpu
+
+CompilerParams = (getattr(_pltpu, "CompilerParams", None)
+                  or _pltpu.TPUCompilerParams)

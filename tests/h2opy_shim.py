@@ -6,10 +6,18 @@ is not in this image. The shim below provides the handful of names
 h2o-py pulls from `future` (all trivial on py3) WITHOUT modifying the
 reference tree; everything else is the client exactly as shipped.
 """
+import os
 import sys
 import types
 
 H2O_PY_PATH = "/root/reference/h2o-py"
+
+
+def available() -> bool:
+    """Whether the reference h2o-py checkout exists on this host. Driver
+    containers don't all mount /root/reference; tests against the real
+    client must skip (not error) where it is absent."""
+    return os.path.isdir(os.path.join(H2O_PY_PATH, "h2o"))
 
 
 def _mkmod(name, **attrs):
@@ -43,6 +51,9 @@ def install():
 
 
 def import_h2o():
+    if not available():
+        import pytest
+        pytest.skip(f"reference h2o-py tree not present at {H2O_PY_PATH}")
     install()
     import warnings
     with warnings.catch_warnings():

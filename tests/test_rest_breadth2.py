@@ -343,6 +343,9 @@ def test_assembly_real_client(server):
     import sys
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import h2opy_shim
+    if not h2opy_shim.available():
+        pytest.skip(f"reference h2o-py tree not present at "
+                    f"{h2opy_shim.H2O_PY_PATH}")
     h2opy_shim.install()
     sys.path.insert(0, "/root/reference/h2o-py")
     import h2o as h2opy

@@ -12,6 +12,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from h2o3_tpu.ops.pallas_compat import CompilerParams as _CompilerParams
+
 ROWS = 10_002_432
 F, W, N = 28, 32, 32
 TILE = 4096
@@ -137,7 +139,7 @@ def run(ablate, X, nid0, ghw, tabs, loinv):
                 flops=2 * 3 * N * F * W * X.shape[0],
                 bytes_accessed=X.shape[0] * F * 4 + X.shape[0] * 16,
                 transcendentals=0) if os.environ.get("COST") else None),
-            compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VM),
+            compiler_params=_CompilerParams(vmem_limit_bytes=_VM),
         )(X, nid[None, :], ghw, tabs, loinv)
         return nid2[0], hist
 

@@ -14,6 +14,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from h2o3_tpu.ops.pallas_compat import CompilerParams as _CompilerParams
+
 ROWS = int(__import__("os").environ.get("ROWS", 2_500_608))
 F, W, N = 28, 32, int(os.environ.get("N", 32))
 TILE = int(os.environ.get("TILE", 8192))
@@ -134,7 +136,7 @@ def run(ablate):
             jax.ShapeDtypeStruct((3 * N, F * W), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((3 * N, F * W), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VM),
+        compiler_params=_CompilerParams(vmem_limit_bytes=_VM),
     )
 
     rng = np.random.default_rng(0)

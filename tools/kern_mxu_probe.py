@@ -10,6 +10,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from h2o3_tpu.ops.pallas_compat import CompilerParams as _CompilerParams
+
 ROWS = 2_500_608
 TILE = 8192
 FW = 896
@@ -42,7 +44,7 @@ def run(M):
         out_specs=pl.BlockSpec((M, FW), lambda r: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((M, FW), jnp.float32),
         scratch_shapes=[pltpu.VMEM((M, FW), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 2 ** 20),
     )
     rng = np.random.default_rng(0)

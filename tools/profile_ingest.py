@@ -77,7 +77,11 @@ def main():
     with open(path, "rb") as f:
         data = f.read()
 
-    out = {"rows": ROWS, "ncol": len(setup.column_names),
+    # actual row count, not the ROWS knob — CSV= may point at any file
+    nrow = (data.count(b"\n")
+            + (0 if (not data or data.endswith(b"\n")) else 1)
+            - (1 if setup.header else 0))
+    out = {"rows": nrow, "ncol": len(setup.column_names),
            "bytes": len(data)}
 
     # stage 1: tokenize — the native C scan alone (offsets + doubles)
