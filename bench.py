@@ -121,10 +121,16 @@ def _disk_frame(rows):
 
 def main():
     import h2o3_tpu as h2o
+    from h2o3_tpu.cluster_boot import setup_compilation_cache
     from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
     import jax
 
-    log(f"devices: {jax.devices()}  backend: {jax.default_backend()}")
+    # persistent XLA compile cache: the SECOND process run of this bench
+    # skips the cold spec/compile entirely (H2O3_COMPILE_CACHE_DIR knob;
+    # time_to_first_model_s below tracks the win per round)
+    cache_dir = setup_compilation_cache()
+    log(f"devices: {jax.devices()}  backend: {jax.default_backend()}  "
+        f"compile_cache: {cache_dir}")
     ingest_s = None
     if os.environ.get("H2O3_BENCH_DISK", "1") not in ("0", "false", ""):
         fr, ingest_s = _disk_frame(ROWS)
@@ -141,10 +147,18 @@ def main():
                   stopping_rounds=0, min_rows=1.0,
                   histogram_type=HIST_TYPE)
     # warmup: compile the chunked tree scan at the exact shapes/chunk the
-    # measured run uses (chunk length is a static scan parameter)
+    # measured run uses (chunk length is a static scan parameter). Its
+    # wall time IS time-to-first-model: ingest/frame excluded, spec +
+    # compile + train + metrics included — the cold-start number the
+    # persistent compile cache attacks (second process run skips the
+    # compile share)
     warm = H2OGradientBoostingEstimator(ntrees=TREES, **common)
+    t_cold0 = time.time()
     warm.train(y="label", training_frame=fr)
-    log(f"warmup done; warm loop {warm.model.output['training_loop_seconds']:.2f}s")
+    time_to_first_model = time.time() - t_cold0
+    log(f"warmup done in {time_to_first_model:.2f}s; "
+        f"warm loop {warm.model.output['training_loop_seconds']:.2f}s "
+        f"profile={warm.model.output.get('train_profile')}")
 
     gbm = H2OGradientBoostingEstimator(ntrees=TREES, **common)
     t0 = time.time()
@@ -155,7 +169,8 @@ def main():
     rows_per_sec = ROWS * built / loop_s
     auc = gbm.model.training_metrics.auc
     log(f"trees={built} loop={loop_s:.2f}s total={total:.2f}s "
-        f"rows/sec/chip={rows_per_sec:,.0f} AUC={auc:.4f}")
+        f"rows/sec/chip={rows_per_sec:,.0f} AUC={auc:.4f} "
+        f"profile={gbm.model.output.get('train_profile')}")
 
     # in-CI bf16 numerics guard (driver-run, TPU only): record the bf16
     # vs f32 split-decision parity artifact every round so a kernel
@@ -186,6 +201,12 @@ def main():
         "value": round(rows_per_sec, 1),
         "unit": "rows/sec/chip",
         "vs_baseline": round(rows_per_sec / A100_GPU_HIST_ROWS_PER_SEC, 4),
+        # cold/warm gap tracked per round: cold = first train in this
+        # process (spec+compile+train+metrics), warm = the measured
+        # second train end-to-end, loop = device boosting loop only
+        "time_to_first_model_s": round(time_to_first_model, 2),
+        "warm_train_s": round(total, 2),
+        "loop_s": round(loop_s, 2),
     }
     if ingest_s is not None:
         # ingest phase reported alongside the headline (the streaming

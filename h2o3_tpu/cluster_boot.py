@@ -16,6 +16,12 @@ Env contract (set by h2o-k8s/manifests or the h2o-helm chart):
                             StatefulSet hostname suffix when unset
   H2O3_REST_PORT            REST port on the coordinator (default 54321)
   H2O3_MESH_MODEL           'model' mesh axis size (default 1)
+  H2O3_COMPILE_CACHE_DIR    persistent XLA compilation cache directory
+                            (default ~/.cache/h2o3_tpu/xla; '0'/'off'
+                            disables). Mount a PVC here so a pod
+                            restart's time-to-first-model skips the
+                            cold train-step compile (~2 minutes at the
+                            10M-row bench shape).
 
 Run: ``python -m h2o3_tpu.cluster_boot``
 """
@@ -25,6 +31,43 @@ import os
 import re
 from dataclasses import dataclass
 from typing import Mapping, Optional
+
+
+def setup_compilation_cache(env: Optional[Mapping[str, str]] = None) -> Optional[str]:
+    """Wire JAX's persistent compilation cache so the cold train-step
+    spec/compile amortises across process restarts (the reference JVM
+    has no compile step; this cost is TPU-stack-specific and so is the
+    fix). Returns the cache dir, or None when disabled / unsupported.
+
+    Safe to call before OR after the first jax use in the process —
+    compiles after the call hit the cache. Honors an explicit
+    ``jax_compilation_cache_dir`` already set (e.g. the test conftest's
+    per-worker cache) rather than overriding it."""
+    env = dict(env if env is not None else os.environ)
+    raw = env.get("H2O3_COMPILE_CACHE_DIR")
+    raw = raw.strip() if raw is not None else None   # k8s YAML whitespace
+    if raw is not None and raw.lower() in ("0", "off", "false"):
+        return None
+    # empty-but-set (blank helm value) means unset: fall through to the
+    # default dir rather than silently disabling the cache
+    import jax
+    try:
+        if jax.config.jax_compilation_cache_dir:
+            return jax.config.jax_compilation_cache_dir
+    except AttributeError:
+        pass
+    d = raw or os.path.join(os.path.expanduser("~"), ".cache",
+                            "h2o3_tpu", "xla")
+    try:
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        # the defaults skip sub-second compiles; the chunked train step
+        # is minutes cold, so any threshold works — keep 1s to avoid
+        # churning the cache with trivial eager-op executables
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except (OSError, AttributeError, ValueError):
+        return None
+    return d
 
 
 @dataclass
@@ -70,6 +113,7 @@ def resolve_boot_config(env: Optional[Mapping[str, str]] = None,
 
 def main() -> None:
     import h2o3_tpu as h2o
+    setup_compilation_cache()
     cfg = resolve_boot_config()
     h2o.init(distributed=True,
              coordinator_address=cfg.coordinator_address,

@@ -51,49 +51,63 @@ class Frame:
                            key: Optional[str] = None) -> "Frame":
         """Assemble a Frame from fully-typed merged columns (duck-typed:
         ``.vtype``/``.data``/``.domain``, see ingest/chunk.py) with ONE
-        host→device transfer per dtype group instead of one per column.
-        The float group's (async) DMA is issued first so it overlaps the
-        host-side packing of the enum-code group — the tail of the
-        ingest pipeline's tokenize/encode/transfer overlap."""
+        host→device transfer per dtype group instead of one per column."""
+        cols = list(cols)
+        return Frame.from_typed_column_groups(
+            names, [list(enumerate(cols))], len(cols), mesh=mesh, key=key)
+
+    @staticmethod
+    def from_typed_column_groups(names: Sequence[str], groups, ncol: int,
+                                 mesh=None,
+                                 key: Optional[str] = None) -> "Frame":
+        """Streaming variant of :func:`from_typed_columns`: ``groups`` is
+        an ITERABLE of ``[(column_index, EncodedColumn-like), ...]``
+        lists. Each group's (async) host→device DMAs are issued before
+        the next group is pulled from the iterable — so a generator can
+        defer its expensive merge work (the enum domain union) until the
+        cheap groups' transfers are already in flight, overlapping DMA
+        with host-side merging (the ingest pipeline's last
+        serialization point, ROADMAP "pack+transfer" lever)."""
         from h2o3_tpu.frame.vec import (ENUM_NA, _numeric_host_copy,
                                         batch_device_put)
         mesh = mesh or current_mesh()
-        cols = list(cols)
-        nrow = len(cols[0].data) if cols else 0
-        vecs: List[Optional[Vec]] = [None] * len(cols)
-        f32_cols, f32_meta = [], []   # numeric + time ride one f32 matrix
-        i32_cols, i32_meta = [], []   # enum codes ride one i32 matrix
-        for i, c in enumerate(cols):
-            if c.vtype == T_STR:
-                vecs[i] = Vec(None, nrow, T_STR,
-                              host_data=np.asarray(c.data, dtype=object))
-            elif c.vtype == T_ENUM:
-                i32_cols.append(np.asarray(c.data, dtype=np.int32))
-                i32_meta.append((i, list(c.domain or ())))
-            elif c.vtype == T_TIME:
-                ms = np.asarray(c.data, dtype=np.int64)
-                sec = np.where(ms == Vec.TIME_NA, np.nan,
-                               ms / 1000.0).astype(np.float32)
-                f32_cols.append(sec)
-                f32_meta.append((i, T_TIME, ms))
-            else:
-                f64 = c.data
-                host = (f64 if f64.dtype == np.int64   # exact wide ints
-                        else _numeric_host_copy(f64, c.vtype))
-                # raw f64 goes straight into the pack matrix — the
-                # assignment converts to f32 in the same pass
-                f32_cols.append(f64)
-                f32_meta.append((i, c.vtype, host))
-        if f32_cols:
-            devs = batch_device_put(f32_cols, np.float32(np.nan),
-                                    np.float32, nrow, mesh)
-            for (i, vt, host), d in zip(f32_meta, devs):
-                vecs[i] = Vec(d, nrow, vt, host_data=host)
-        if i32_cols:
-            devs = batch_device_put(i32_cols, np.int32(ENUM_NA),
-                                    np.int32, nrow, mesh)
-            for (i, dom), d in zip(i32_meta, devs):
-                vecs[i] = Vec(d, nrow, T_ENUM, domain=dom)
+        vecs: List[Optional[Vec]] = [None] * ncol
+        nrow = 0
+        for group in groups:
+            f32_cols, f32_meta = [], []  # numeric + time: one f32 matrix
+            i32_cols, i32_meta = [], []  # enum codes: one i32 matrix
+            for i, c in group:
+                nrow = len(c.data)
+                if c.vtype == T_STR:
+                    vecs[i] = Vec(None, nrow, T_STR,
+                                  host_data=np.asarray(c.data, dtype=object))
+                elif c.vtype == T_ENUM:
+                    i32_cols.append(np.asarray(c.data, dtype=np.int32))
+                    i32_meta.append((i, list(c.domain or ())))
+                elif c.vtype == T_TIME:
+                    ms = np.asarray(c.data, dtype=np.int64)
+                    sec = np.where(ms == Vec.TIME_NA, np.nan,
+                                   ms / 1000.0).astype(np.float32)
+                    f32_cols.append(sec)
+                    f32_meta.append((i, T_TIME, ms))
+                else:
+                    f64 = c.data
+                    host = (f64 if f64.dtype == np.int64  # exact wide ints
+                            else _numeric_host_copy(f64, c.vtype))
+                    # raw f64 goes straight into the pack matrix — the
+                    # assignment converts to f32 in the same pass
+                    f32_cols.append(f64)
+                    f32_meta.append((i, c.vtype, host))
+            if f32_cols:
+                devs = batch_device_put(f32_cols, np.float32(np.nan),
+                                        np.float32, nrow, mesh)
+                for (i, vt, host), d in zip(f32_meta, devs):
+                    vecs[i] = Vec(d, nrow, vt, host_data=host)
+            if i32_cols:
+                devs = batch_device_put(i32_cols, np.int32(ENUM_NA),
+                                        np.int32, nrow, mesh)
+                for (i, dom), d in zip(i32_meta, devs):
+                    vecs[i] = Vec(d, nrow, T_ENUM, domain=dom)
         return Frame(list(names), vecs, key=key)
 
     # ---------------- shape / access ----------------

@@ -365,6 +365,25 @@ def _merge_enum(chunks: List[EncodedColumn]) -> EncodedColumn:
                          if len(parts) > 1 else parts[0], domain=union)
 
 
+def merge_column(chunks: List[EncodedColumn], vt: str) -> EncodedColumn:
+    """Union ONE column's chunk-local pieces (enum domain union + remap,
+    numeric/time concat, wide-int exactness resolution). Split out of
+    :func:`merge_columns` so the parse pipeline can merge dtype groups
+    independently and overlap each group's device transfer with the next
+    group's (host) merge work."""
+    if vt in (T_REAL, T_INT):
+        return _merge_numeric(chunks, vt)
+    if vt == T_TIME:
+        datas = [c.data for c in chunks]
+        return EncodedColumn(T_TIME, np.concatenate(datas)
+                             if len(datas) > 1 else datas[0])
+    if vt == T_ENUM:
+        return _merge_enum(chunks)
+    datas = [c.data for c in chunks]
+    return EncodedColumn(T_STR, np.concatenate(datas)
+                         if len(datas) > 1 else datas[0])
+
+
 def merge_columns(chunk_results: List[List[EncodedColumn]],
                   column_types: Sequence[str],
                   skipped: Sequence[int] = ()) -> List[Optional[EncodedColumn]]:
@@ -378,17 +397,5 @@ def merge_columns(chunk_results: List[List[EncodedColumn]],
         if i in skip:
             out.append(None)
             continue
-        chunks = [cr[i] for cr in chunk_results]
-        if vt in (T_REAL, T_INT):
-            out.append(_merge_numeric(chunks, vt))
-        elif vt == T_TIME:
-            datas = [c.data for c in chunks]
-            out.append(EncodedColumn(T_TIME, np.concatenate(datas)
-                                     if len(datas) > 1 else datas[0]))
-        elif vt == T_ENUM:
-            out.append(_merge_enum(chunks))
-        else:
-            datas = [c.data for c in chunks]
-            out.append(EncodedColumn(T_STR, np.concatenate(datas)
-                                     if len(datas) > 1 else datas[0]))
+        out.append(merge_column([cr[i] for cr in chunk_results], vt))
     return out

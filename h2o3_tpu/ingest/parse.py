@@ -34,7 +34,7 @@ from h2o3_tpu.frame.vec import T_ENUM, T_INT, T_REAL, T_STR, T_TIME, Vec
 from h2o3_tpu.ingest.chunk import (MAX_ENUM_CARDINALITY, SKIPPED,
                                    EncodedColumn, _skipped_set,
                                    encode_chunk_native, encode_token_column,
-                                   merge_columns)
+                                   merge_column)
 
 DEFAULT_NA_STRINGS = {"", "NA", "N/A", "na", "NaN", "nan", "null", "NULL", "None", "?"}
 _SEP_CANDIDATES = [",", "\t", ";", "|", " "]
@@ -302,13 +302,13 @@ def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
                 results = [fu.result() for fu in futs]
     todo = [k for k, r in enumerate(results) if r is None]
     if todo:
-        # fallback is FILE-scoped, not range-scoped: the two tokenizers
+        # fallback is IMPORT-scoped, not range-scoped: the two tokenizers
         # disagree on edge tokens (>63-char numerics, unicode
-        # whitespace), so one declined range sends every range of that
-        # file through the Python tokenizer — a column must never mix
-        # tokenizers across its chunks (the equivalence contract)
-        bad_paths = {jobs[k][0] for k in todo}
-        todo = [k for k, j in enumerate(jobs) if j[0] in bad_paths]
+        # whitespace), and a column's chunks span every file of a
+        # multi-file import — so one declined range sends ALL ranges
+        # through the Python tokenizer. A column must never mix
+        # tokenizers across its chunks (the equivalence contract).
+        todo = list(range(len(jobs)))
         total = sum(jobs[k][2] - jobs[k][1] for k in todo)
         if len(todo) > 1 and total >= _PARALLEL_PARSE_BYTES:
             # Python fallback in PROCESSES — spawn, not fork: this
@@ -331,20 +331,42 @@ def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
                 results[k] = _encode_range_python(p, s, e, setup, skip)
     t1 = time.perf_counter()
     skipped = _skipped_set(setup)
-    merged = merge_columns(results, setup.column_types, skipped=skipped)
-    t2 = time.perf_counter()
     names = [n for i, n in enumerate(setup.column_names) if i not in skipped]
-    cols = [c for i, c in enumerate(merged) if i not in skipped]
-    fr = Frame.from_typed_columns(names, cols, mesh=mesh,
-                                  key=key or os.path.basename(paths[0]))
+    active = [i for i in range(len(setup.column_names)) if i not in skipped]
+    pos = {orig: j for j, orig in enumerate(active)}   # filtered index
+    merge_s = [0.0]
+
+    def _merged(idx):
+        # merge one dtype group; time attributed to the merge stage even
+        # though it runs interleaved with the previous group's DMA
+        tm = time.perf_counter()
+        out = [(pos[i], merge_column([cr[i] for cr in results],
+                                     setup.column_types[i]))
+               for i in idx]
+        merge_s[0] += time.perf_counter() - tm
+        return out
+
+    def _groups():
+        # numeric/time/str first: their merge is a cheap concat, and
+        # issuing their device DMA NOW lets the transfer run underneath
+        # the enum group's domain union + LUT remap (the expensive host
+        # half of the merge) instead of after it
+        yield _merged([i for i in active
+                       if setup.column_types[i] != T_ENUM])
+        yield _merged([i for i in active
+                       if setup.column_types[i] == T_ENUM])
+
+    fr = Frame.from_typed_column_groups(
+        names, _groups(), len(active), mesh=mesh,
+        key=key or os.path.basename(paths[0]))
     t3 = time.perf_counter()
     # in-place so `from h2o3_tpu.ingest.parse import LAST_PROFILE` stays live
     LAST_PROFILE.clear()
     LAST_PROFILE.update({"rows": fr.nrow, "chunks": len(jobs),
                          "native": bool(native_ok and not todo),
                          "tokenize_encode_s": round(t1 - t0, 4),
-                         "merge_s": round(t2 - t1, 4),
-                         "device_put_s": round(t3 - t2, 4)})
+                         "merge_s": round(merge_s[0], 4),
+                         "device_put_s": round(t3 - t1 - merge_s[0], 4)})
     return fr
 
 

@@ -32,9 +32,10 @@ from h2o3_tpu.models.model_base import (Model, ModelBuilder, ScoreKeeper,
                                         TrainingSpec, compute_metrics)
 from h2o3_tpu.models.tree import (TreeConfig, adaptive_feasible,
                                   adaptive_setup,
-                                  bins_to_thresholds, grow_tree,
+                                  chunk_bucket,
+                                  collect_chunk_trees, grow_tree,
                                   grow_tree_adaptive, predict_raw_stacked)
-from h2o3_tpu.ops.binning import CodesView, bin_matrix, make_codes_view
+from h2o3_tpu.ops.binning import CodesView, bin_matrix_device, make_codes_view
 from h2o3_tpu.parallel.mesh import DATA_AXIS, current_mesh, n_data_shards
 from h2o3_tpu.persist import register_model_class
 
@@ -144,12 +145,18 @@ class DRFModel(TreeScoringOptionsMixin, Model):
 
 
 def _drf_chunk_body(codes_rm, codes_t, y, w, oob_num, oob_cnt, base_key,
-                    root_lo, root_hi, nb_f, start_idx, *, cfg, K, sample_rate,
-                    sample_rate_per_class, col_rate, chunk, has_t, adaptive,
+                    root_lo, root_hi, nb_f, start_idx, n_active, sample_rate,
+                    col_rate, *, cfg, K,
+                    sample_rate_per_class, chunk, has_t, adaptive,
                     axis_name):
     """A chunk of independent forest trees per data shard; OOB sums ride
     the scan carry (reference: DRF's OOB rows are scored by the trees that
-    did not sample them — hex/tree/drf/DRF.java OOB machinery)."""
+    did not sample them — hex/tree/drf/DRF.java OOB machinery).
+
+    ``chunk`` is a padding bucket (see gbm._gbm_chunk_body): the traced
+    ``n_active`` masks trailing trees out of the OOB sums and the driver
+    drops them at finalize; sample/col rates ride as traced scalars so
+    grid variants share one executable."""
     codes = CodesView(rm=codes_rm, t=codes_t if has_t else None)
     F = codes_rm.shape[1]
     shard = jax.lax.axis_index(axis_name) if axis_name else 0
@@ -176,10 +183,8 @@ def _drf_chunk_body(codes_rm, codes_t, y, w, oob_num, oob_cnt, base_key,
         else:
             sampled = jax.random.uniform(key_r, w.shape) < sample_rate
         wt = w * sampled
-        col_mask = jnp.ones(F, bool)
-        if col_rate < 1.0:
-            col_mask = jax.random.uniform(key_c, (F,)) < col_rate
-        live_oob = (w > 0) & ~sampled
+        col_mask = jax.random.uniform(key_c, (F,)) < col_rate
+        live_oob = (w > 0) & ~sampled & (i < n_active)
         trees = []
         if K == 1:
             yf = y.astype(jnp.float32)
@@ -208,22 +213,23 @@ def _drf_chunk_body(codes_rm, codes_t, y, w, oob_num, oob_cnt, base_key,
 
 
 @lru_cache(maxsize=128)
-def _compiled_drf_chunk(mesh, cfg, K, sample_rate, sample_rate_per_class,
-                        col_rate, chunk, has_t,
-                        adaptive):
-    body = partial(_drf_chunk_body, cfg=cfg, K=K, sample_rate=sample_rate,
+def _compiled_drf_chunk(mesh, cfg, K, sample_rate_per_class, chunk, has_t,
+                        adaptive, donate=False):
+    body = partial(_drf_chunk_body, cfg=cfg, K=K,
                    sample_rate_per_class=sample_rate_per_class,
-                   col_rate=col_rate, chunk=chunk, has_t=has_t,
+                   chunk=chunk, has_t=has_t,
                    adaptive=adaptive, axis_name=DATA_AXIS)
     in_specs = (P(DATA_AXIS),
                 P(None, DATA_AXIS) if has_t else P(DATA_AXIS),
                 P(DATA_AXIS), P(DATA_AXIS),
                 P(DATA_AXIS), P(DATA_AXIS),
-                P(), P(), P(), P(), P())
+                P(), P(), P(), P(), P(), P(), P(), P())
     out_specs = (P(DATA_AXIS), P(DATA_AXIS), P())
     f = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, check_vma=False)
-    return jax.jit(f)
+    # the OOB accumulators are write-once-per-chunk carries: donate them
+    # so the device updates in place instead of double-buffering
+    return jax.jit(f, donate_argnums=(4, 5) if donate else ())
 
 
 class H2ORandomForestEstimator(ModelBuilder):
@@ -262,10 +268,12 @@ class H2ORandomForestEstimator(ModelBuilder):
             cfg, root_lo, root_hi, nb_f = adaptive_setup(
                 spec, p, depth, mtries=min(mtries, F))
         else:
-            bm = bin_matrix(np.asarray(jax.device_get(spec.X)), spec.names,
-                            spec.is_cat, spec.nrow, nbins=max(nbins, 2),
-                            nbins_cats=int(p["nbins_cats"]),
-                            histogram_type=hist_type)
+            # device-side sketch (ops/binning.bin_matrix_device): no
+            # device_get of the full X
+            bm = bin_matrix_device(spec.X, spec.names,
+                                   spec.is_cat, spec.nrow, nbins=max(nbins, 2),
+                                   nbins_cats=int(p["nbins_cats"]),
+                                   histogram_type=hist_type)
             cfg = TreeConfig(max_depth=depth, n_bins=bm.n_bins,
                              n_features=bm.n_features,
                              min_rows=float(p["min_rows"]),
@@ -295,23 +303,34 @@ class H2ORandomForestEstimator(ModelBuilder):
         Xtr = spec.X if adaptive else bm.codes.rm
         has_t = (not adaptive) and bm.codes.t is not None
         codes_t_arg = bm.codes.t if has_t else Xtr
-        oob_num = (jnp.zeros(padded, jnp.float32) if K == 1
-                   else jnp.zeros((padded, K), jnp.float32))
-        oob_cnt = jnp.zeros(padded, jnp.float32)
-        y = spec.y if K > 1 else spec.y
-        all_trees = []
+        # data-sharded from the start so every chunk (not just the 2nd+)
+        # sees identically-sharded carry operands — one executable per
+        # bucket (see the margin pinning note in models/gbm.py)
+        from jax.sharding import NamedSharding
+        rows_sh = NamedSharding(mesh, P(DATA_AXIS))
+        oob_num = jax.device_put(
+            jnp.zeros(padded if K == 1 else (padded, K), jnp.float32),
+            rows_sh)
+        oob_cnt = jax.device_put(jnp.zeros(padded, jnp.float32), rows_sh)
+        y = spec.y
+        all_trees = []          # [(device chunk trees, n_active)]
         built = 0
         chunk = min(ntrees, 25)
+        donate = jax.default_backend() == "tpu"
+        rate_t = jnp.float32(sample_rate)
+        col_rate_t = jnp.float32(col_rate)
         t0 = time.time()
         while built < ntrees:
+            # bucket-rounded chunk lengths (models/gbm.py): ntrees
+            # variants landing in one bucket reuse the executable
             c = min(chunk, ntrees - built)
-            step = _compiled_drf_chunk(mesh, cfg, K, sample_rate, srpc,
-                                       col_rate,
-                                       c, has_t, adaptive)
+            step = _compiled_drf_chunk(mesh, cfg, K, srpc, chunk_bucket(c),
+                                       has_t, adaptive, donate)
             oob_num, oob_cnt, chunk_trees = step(
                 Xtr, codes_t_arg, y, spec.w, oob_num, oob_cnt, key,
-                root_lo, root_hi, nb_f, jnp.int32(built))
-            all_trees.append(chunk_trees)
+                root_lo, root_hi, nb_f, jnp.int32(built), jnp.int32(c),
+                rate_t, col_rate_t)
+            all_trees.append((chunk_trees, c))
             built += c
             job.set_progress(built / ntrees)
             if job.cancel_requested:
@@ -367,24 +386,15 @@ class H2ORandomForestEstimator(ModelBuilder):
 
     def _finalize(self, spec, bm, cfg, K, built, all_trees) -> DRFModel:
         M = cfg.n_nodes
-        T = built * max(K, 1)
-        host = [{k: np.asarray(jax.device_get(v)) for k, v in t.items()}
-                for t in all_trees]
-        feat = np.concatenate([t["feat"].reshape(-1, M) for t in host])
-        nal = np.concatenate([t["na_left"].reshape(-1, M) for t in host])
-        spl = np.concatenate([t["is_split"].reshape(-1, M) for t in host])
-        val = np.concatenate([t["value"].reshape(-1, M) for t in host])
-        gains = np.concatenate([t["gain"].reshape(-1, M) for t in host])
-        if "thr" in host[0]:
-            thr = np.concatenate([t["thr"].reshape(-1, M) for t in host])
-        else:
-            sbin = np.concatenate([t["split_bin"].reshape(-1, M)
-                                   for t in host])
-            thr = np.stack([bins_to_thresholds(sbin[i], feat[i], bm.edges)
-                            for i in range(T)])
-        node_w = np.concatenate([t["node_w"].reshape(-1, M) for t in host])
-        trees_host = {"feat": feat, "thr": thr, "na_left": nal,
-                      "is_split": spl, "value": val, "node_w": node_w}
+        # one pytree device_get; padding-bucket tails sliced off in the
+        # shared helper (models/tree.py collect_chunk_trees)
+        th = collect_chunk_trees(all_trees, M,
+                                 bm.edges if bm is not None else [])
+        feat = th["feat"]
+        gains = th["gain"]
+        trees_host = {"feat": feat, "thr": th["thr"],
+                      "na_left": th["na_left"], "is_split": th["is_split"],
+                      "value": th["value"], "node_w": th["node_w"]}
         model = DRFModel(f"{self.algo}_{id(self) & 0xffffff:x}", self.params,
                          spec, trees_host,
                          bm.edges if bm is not None else [],

@@ -27,11 +27,12 @@ from h2o3_tpu.models.model_base import (Model, ModelBuilder, ScoreKeeper,
                                         TrainingSpec, compute_metrics)
 from h2o3_tpu.models.tree import (TreeConfig, adaptive_feasible,
                                   adaptive_setup,
-                                  bins_to_thresholds, grow_tree,
+                                  chunk_bucket,
+                                  collect_chunk_trees, grow_tree,
                                   grow_tree_adaptive, predict_binned,
                                   predict_raw_stacked, predict_raw_tree)
-from h2o3_tpu.ops.binning import (CodesView, bin_matrix, digitize_with_edges,
-                                  make_codes_view)
+from h2o3_tpu.ops.binning import (CodesView, bin_matrix_device,
+                                  digitize_with_edges, make_codes_view)
 from h2o3_tpu.parallel.mesh import DATA_AXIS, current_mesh, n_data_shards
 
 GBM_DEFAULTS: Dict = dict(
@@ -163,9 +164,10 @@ class GBMModel(TreeScoringOptionsMixin, Model):
 
 def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
                     lr0, hdelta, root_lo, root_hi, nb_f, mono, sets,
-                    start_idx, *, cfg, K,
-                    dist_name, tweedie_power, quantile_alpha, sample_rate,
-                    sample_rate_per_class, col_rate, na_bin, chunk, anneal,
+                    start_idx, n_active, sample_rate, col_rate, anneal,
+                    *, cfg, K,
+                    dist_name, tweedie_power, quantile_alpha,
+                    sample_rate_per_class, na_bin, chunk,
                     has_valid, has_t, adaptive, has_mono, has_sets,
                     axis_name):
     """One chunk of the boosting loop, per data shard (runs under
@@ -177,6 +179,13 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
     inside the tree grower (the Rabit-allreduce / MRTask-reduce-tree
     analog, hex/tree/xgboost/rabit/RabitTrackerH2O.java,
     water/MRTask.java:871).
+
+    ``chunk`` is a PADDING BUCKET, not the exact tree count: the traced
+    ``n_active`` scalar masks trailing trees (their margin contribution
+    is zeroed; the driver drops them at finalize), so one compiled
+    executable serves every remaining-tree count in the bucket —
+    grid/AutoML variants with different ntrees reuse it. Sampling rates
+    and learn-rate annealing ride as TRACED scalars for the same reason.
 
     ``adaptive`` selects the fused per-node-adaptive-bins kernel over raw
     features (codes_rm then carries raw X); otherwise the global-sketch
@@ -206,6 +215,9 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
 
     def one_tree(carry, i):
         margin, vmargin, lr = carry
+        # padding-bucket mask: trees at i >= n_active are built but their
+        # margin contribution is zeroed (finalize drops them host-side)
+        lr_t = jnp.where(i < n_active, lr, 0.0)
         key = jax.random.fold_in(base_key, start_idx + i)
         key_r, key_c = jax.random.split(key)
         if axis_name is not None:
@@ -213,7 +225,6 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
             # repeat the identical draw pattern on every shard); the column
             # key stays common so col_mask is identical everywhere
             key_r = jax.random.fold_in(key_r, shard)
-        wt = w
         if sample_rate_per_class is not None:
             # hex/tree/SharedTree.java:210: per-class rates override
             # sample_rate (one rate per RESPONSE class — binomial runs
@@ -222,11 +233,13 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
             thr = srpc[jnp.clip(y.astype(jnp.int32), 0,
                                 len(sample_rate_per_class) - 1)]
             wt = w * (jax.random.uniform(key_r, w.shape) < thr)
-        elif sample_rate < 1.0:
+        else:
+            # always draw against the TRACED rate: uniform() < 1.0 is
+            # identically True (draws live in [0, 1)), so rate=1.0 keeps
+            # the exact unsampled weights while the executable is shared
+            # across every sample_rate value
             wt = w * (jax.random.uniform(key_r, w.shape) < sample_rate)
-        col_mask = jnp.ones(F, bool)
-        if col_rate < 1.0:
-            col_mask = jax.random.uniform(key_c, (F,)) < col_rate
+        col_mask = jax.random.uniform(key_c, (F,)) < col_rate
         trees = []
         if K == 1:
             # hdelta rides as a traced scalar so data-derived huber deltas
@@ -237,9 +250,9 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
             tree, nid = build(g * wt, h * wt, wt, col_mask, key=key)
             # the grower already routed every row to its leaf — reuse
             # nid instead of re-walking the tree (saves ~250ms/tree@1M)
-            margin = margin + lr * tree["value"][nid]
+            margin = margin + lr_t * tree["value"][nid]
             if has_valid:
-                vmargin = vmargin + lr * valid_contrib(tree)
+                vmargin = vmargin + lr_t * valid_contrib(tree)
             trees.append(tree)
         else:
             p = jax.nn.softmax(margin, axis=1)
@@ -248,9 +261,9 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
                 gk = (p[:, k] - yk)
                 hk = jnp.maximum(p[:, k] * (1.0 - p[:, k]), 1e-9)
                 tree, nid = build(gk * wt, hk * wt, wt, col_mask, key=key)
-                margin = margin.at[:, k].add(lr * tree["value"][nid])
+                margin = margin.at[:, k].add(lr_t * tree["value"][nid])
                 if has_valid:
-                    vmargin = vmargin.at[:, k].add(lr * valid_contrib(tree))
+                    vmargin = vmargin.at[:, k].add(lr_t * valid_contrib(tree))
                 trees.append(tree)
         stacked = {kk: jnp.stack([t[kk] for t in trees])
                    for kk in trees[0]}
@@ -263,20 +276,24 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
 
 @lru_cache(maxsize=128)
 def _compiled_chunk(mesh, cfg, K, dist_name, tweedie_power, quantile_alpha,
-                    sample_rate, sample_rate_per_class, col_rate, na_bin,
-                    chunk, anneal, has_valid, has_t, adaptive,
-                    has_mono=False, has_sets=False):
+                    sample_rate_per_class, na_bin, chunk, has_valid, has_t,
+                    adaptive, has_mono=False, has_sets=False, donate=False):
     """Build + cache the sharded jitted chunk step for a given mesh/config.
 
     Rows ride the mesh 'data' axis; tree arrays come back replicated (every
     shard computes identical splits from the psum'd histograms — the same
-    redundancy the reference's per-node DTree split scan has)."""
+    redundancy the reference's per-node DTree split scan has).
+
+    ``donate=True`` donates the margin/vmargin operands: each chunk's
+    margins are dead the moment the next chunk's outputs exist, so XLA
+    reuses their HBM instead of holding two generations live. The driver
+    only donates when early stopping is off (a stop rollback needs the
+    committed chunk's buffers intact)."""
     body = partial(_gbm_chunk_body, cfg=cfg, K=K, dist_name=dist_name,
                    tweedie_power=tweedie_power, quantile_alpha=quantile_alpha,
-                   sample_rate=sample_rate,
                    sample_rate_per_class=sample_rate_per_class,
-                   col_rate=col_rate, na_bin=na_bin, chunk=chunk,
-                   anneal=anneal, has_valid=has_valid, has_t=has_t,
+                   na_bin=na_bin, chunk=chunk,
+                   has_valid=has_valid, has_t=has_t,
                    adaptive=adaptive, has_mono=has_mono, has_sets=has_sets,
                    axis_name=DATA_AXIS)
     in_specs = (P(DATA_AXIS),                              # codes_rm / raw X
@@ -284,11 +301,12 @@ def _compiled_chunk(mesh, cfg, K, dist_name, tweedie_power, quantile_alpha,
                 P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),  # margin, y, w
                 P(DATA_AXIS), P(DATA_AXIS),                # vrm, vmargin
                 P(), P(), P(), P(), P(), P(),       # key, lr0, hdelta, lo/hi, nb_f
-                P(), P(), P())                      # mono, sets, start
+                P(), P(), P(),                      # mono, sets, start
+                P(), P(), P(), P())                 # n_active, rates, anneal
     out_specs = (P(DATA_AXIS), P(DATA_AXIS), P())
     f = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, check_vma=False)
-    return jax.jit(f)
+    return jax.jit(f, donate_argnums=(2, 6) if donate else ())
 
 
 class H2OGradientBoostingEstimator(ModelBuilder):
@@ -322,6 +340,7 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                 else "multinomial" if K > 1 else "regression")
         nbins = int(p["nbins"])
         hist_type = (p.get("histogram_type") or "uniform_adaptive").lower()
+        t_bin0 = time.time()
         # uniform_adaptive (reference default) runs the fused per-node
         # adaptive kernel on raw features; the global-sketch path handles
         # quantiles_global and nbins beyond the adaptive kernel's 254 cap
@@ -333,10 +352,12 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             cfg, root_lo, root_hi, nb_f = adaptive_setup(
                 spec, p, int(p["max_depth"]))
         else:
-            bm = bin_matrix(np.asarray(jax.device_get(spec.X)), spec.names,
-                            spec.is_cat, spec.nrow, nbins=max(nbins, 2),
-                            nbins_cats=int(p["nbins_cats"]),
-                            histogram_type=hist_type)
+            # device-side sketch: X never leaves HBM (the old path
+            # device_get the whole matrix just to run np.quantile on it)
+            bm = bin_matrix_device(spec.X, spec.names,
+                                   spec.is_cat, spec.nrow, nbins=max(nbins, 2),
+                                   nbins_cats=int(p["nbins_cats"]),
+                                   histogram_type=hist_type)
             cfg = TreeConfig(max_depth=int(p["max_depth"]), n_bins=bm.n_bins,
                              n_features=bm.n_features,
                              min_rows=float(p["min_rows"]),
@@ -350,6 +371,7 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             root_lo = jnp.zeros(cfg.n_features, jnp.float32)
             root_hi = jnp.zeros(cfg.n_features, jnp.float32)
             nb_f = jnp.zeros(cfg.n_features, jnp.float32)
+        t_bin = time.time() - t_bin0
         y, w = spec.y, spec.w
         padded = spec.X.shape[0]
         if spec.offset is not None and K > 1:
@@ -357,21 +379,25 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                 "offset_column is not supported for multinomial GBM "
                 "(matching hex/tree/gbm/GBM.java offset restrictions)")
         prior = self._resolve_checkpoint(dist_name, spec)
-        huber_delta = 1.0
+        huber_delta = jnp.float32(1.0)
         if K == 1 and dist_name == "huber":
             # transition point = huber_alpha w-quantile of |resid - init|
             # on the OFFSET-ADJUSTED scale (the reference re-estimates per
             # scoring round; computed once here; w-weighted so pad/NA/
-            # zero-weight rows can't skew it)
+            # zero-weight rows can't skew it). The quantile STAYS a device
+            # scalar: it feeds the chunk step as a traced operand and the
+            # distribution's jnp ops, so the old mid-train device_get was
+            # a pure pipeline stall
             from h2o3_tpu.models.distributions import (weighted_median,
                                                        weighted_quantile)
             yf0 = y.astype(jnp.float32)
             if spec.offset is not None:
                 yf0 = yf0 - spec.offset
             med = weighted_median(yf0, w)
-            huber_delta = float(jax.device_get(weighted_quantile(
-                jnp.abs(yf0 - med), w, float(p.get("huber_alpha", 0.9)))))
-            huber_delta = max(huber_delta, 1e-10)
+            huber_delta = jnp.maximum(weighted_quantile(
+                jnp.abs(yf0 - med), w,
+                float(p.get("huber_alpha", 0.9))).astype(jnp.float32),
+                jnp.float32(1e-10))
         dist = (self._dist(dist_name, huber_delta) if K == 1 else None)
         if K == 1:
             yf = y.astype(jnp.float32)
@@ -498,47 +524,116 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                             f"not a training feature")
                     sets_host[si, spec.names.index(cname)] = True
             sets_arr = jnp.asarray(sets_host)
-        all_trees = []
-        built = 0
+        # pin the margins to the data sharding BEFORE the first dispatch:
+        # freshly-built margins (jnp.full of a traced f0) are replicated,
+        # while every chunk OUTPUT is data-sharded — without this the
+        # first call of each bucket compiles a second, replicated-operand
+        # executable (visible as one stray recompile per new ntrees)
+        from jax.sharding import NamedSharding
+        rows_sh = NamedSharding(mesh, P(DATA_AXIS))
+        margin = jax.device_put(margin, rows_sh)
+        vmargin = jax.device_put(vmargin, rows_sh)
+        # buffer donation is only safe when an early stop can never force
+        # a rollback to the previous chunk's margins
+        donate = (keeper.rounds == 0
+                  and jax.default_backend() == "tpu")
+        sc_spec = valid_spec if has_valid else spec
+        want_auc = keeper.metric == "auc"
+        rate_t = jnp.float32(float(p["sample_rate"]))
+        col_rate_t = jnp.float32(col_rate)
+        anneal_t = jnp.float32(anneal)
+        all_trees = []          # [(device chunk trees, n_active)]
+        built = 0               # committed trees
+        disp = 0                # dispatched trees (committed + in flight)
+        inflight = None         # last dispatched, not yet committed chunk
+        stopped = False
         jax.block_until_ready(margin)
         t_loop0 = time.time()
-        while built < ntrees_new:
-            c = min(chunk, ntrees_new - built)
+        score_s = 0.0
+        # pipelined boosting: dispatch chunk k+1 BEFORE blocking on chunk
+        # k's score scalars, so the metric fetch overlaps device compute.
+        # With early stopping on, chunk k+1 is SPECULATIVE: a stop verdict
+        # discards it (margins roll back to chunk k's outputs), keeping
+        # the built-tree count identical to the serial loop.
+        while disp < ntrees_new and not stopped:
+            c = min(chunk, ntrees_new - disp)
+            if score_each and c == chunk:
+                # full score intervals compile at their EXACT length: an
+                # off-bucket interval (say 6) repeats every chunk, and
+                # rounding it up would pay masked trees on EVERY chunk —
+                # one compile per interval value instead
+                bucket = c
+            else:
+                # single-shot lengths (the non-scoring whole-train chunk,
+                # any final partial interval) round up to a shared bucket
+                # so grid/AutoML ntrees variants reuse the executable;
+                # masked waste is bounded by ONE chunk per train
+                bucket = chunk_bucket(c)
             step = _compiled_chunk(mesh, cfg, K, dist_name,
                                    float(p["tweedie_power"]),
                                    float(p.get("quantile_alpha", 0.5)),
-                                   float(p["sample_rate"]), srpc,
-                                   col_rate, na_bin, c, anneal, has_valid,
-                                   has_t, adaptive, has_mono, has_sets)
-            margin, vmargin, chunk_trees = step(
+                                   srpc, na_bin, bucket, has_valid,
+                                   has_t, adaptive, has_mono, has_sets,
+                                   donate)
+            nm, nv, chunk_trees = step(
                 Xtr, codes_t_arg, margin, yf, w, vtrain, vmargin,
-                key, jnp.float32(lr), jnp.float32(huber_delta),
+                key, jnp.float32(lr), huber_delta,
                 root_lo, root_hi, nb_f, mono_arr, sets_arr,
-                jnp.int32(start_trees + built))
-            all_trees.append(chunk_trees)  # stays on device until finalize
-            built += c
+                jnp.int32(start_trees + disp), jnp.int32(c),
+                rate_t, col_rate_t, anneal_t)
+            pend = None
+            if score_each:
+                pend = self._score_entry_dev(nv if has_valid else nm,
+                                             sc_spec, dist, K,
+                                             start_trees + disp + c,
+                                             want_auc=want_auc)
+            if inflight is not None:
+                # commit the previous chunk; its metric scalars land
+                # while the device crunches the chunk just dispatched
+                all_trees.append((inflight["trees"], inflight["c"]))
+                built += inflight["c"]
+                if score_each:
+                    t_s0 = time.time()
+                    keeper.record(self._score_entry_fetch(inflight["pend"]))
+                    score_s += time.time() - t_s0
+                    if keeper.rounds > 0 and keeper.should_stop():
+                        # discard the speculative dispatch: the margin/
+                        # vmargin locals still hold the COMMITTED chunk's
+                        # outputs (they are only rebound to the new
+                        # dispatch below), so breaking here is the
+                        # rollback — nm/nv are simply never used
+                        stopped = True
+                        break
+            inflight = {"trees": chunk_trees, "c": c, "pend": pend}
+            margin, vmargin = nm, nv
+            disp += c
             lr *= anneal ** c
-            job.set_progress(0.5 * built / ntrees_new)
+            # progress by DISPATCHED trees: the committed count lags one
+            # chunk behind and would sit at 0 through a one-chunk train
+            job.set_progress(0.5 * disp / ntrees_new)
             if job.cancel_requested:
                 break
+        if not stopped and inflight is not None:
+            all_trees.append((inflight["trees"], inflight["c"]))
+            built += inflight["c"]
             if score_each:
-                sc_spec = valid_spec if has_valid else spec
-                sc_margin = vmargin if has_valid else margin
-                entry = self._score_entry(sc_margin, sc_spec, dist, K,
-                                          start_trees + built,
-                                          want_auc=keeper.metric == "auc")
-                keeper.record(entry)
-                if keeper.rounds > 0 and keeper.should_stop():
-                    break
+                t_s0 = time.time()
+                keeper.record(self._score_entry_fetch(inflight["pend"]))
+                score_s += time.time() - t_s0
 
         jax.block_until_ready(margin)
         t_loop = time.time() - t_loop0
+        t_fin0 = time.time()
         model = self._finalize(spec, valid_spec, dist_name, f0, all_trees, bm,
                                cfg, K, built, margin,
                                vmargin if has_valid else None, keeper,
                                tree_offset=start_trees, prior=prior,
                                dist=dist)
         model.output["training_loop_seconds"] = t_loop
+        model.output["train_profile"] = {
+            "bin_s": round(t_bin, 4), "loop_s": round(t_loop, 4),
+            "score_s": round(score_s, 4),
+            "finalize_s": round(time.time() - t_fin0, 4)}
         return model
 
     def _train_streaming(self, spec: TrainingSpec, valid_spec, dist_name,
@@ -722,56 +817,68 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                 "frame's — prior trees' enum-code splits would misroute")
         return prior
 
-    def _score_entry(self, margin, sc_spec, dist, K, built,
-                     want_auc: bool = False) -> Dict:
+    def _score_entry_dev(self, margin, sc_spec, dist, K, built,
+                         want_auc: bool = False):
+        """Dispatch the interval-score reduction ON DEVICE and return a
+        pending entry of device scalars — the driver fetches them with
+        ``_score_entry_fetch`` only after the next chunk is in flight, so
+        the metric transfer never stalls the boosting pipeline."""
         w = sc_spec.w
         y = sc_spec.y
         if K == 1:
             mu = dist.predict(margin)
             yf = y.astype(jnp.float32)
-            dev = float(jax.device_get(dist.deviance(w, yf, mu)))
-            entry = {"ntrees": built, "deviance": dev}
-            if dist.name == "gaussian":
-                entry["mse"] = dev
-                entry["rmse"] = float(np.sqrt(max(dev, 0)))
-            if dist.name == "bernoulli":
-                entry["logloss"] = dev / 2.0
-                if want_auc:
-                    from h2o3_tpu.models.metrics import _binary_curve_kernel
-                    auc = _binary_curve_kernel(mu, yf, w)[4]
-                    entry["auc"] = float(jax.device_get(auc))
-            return entry
+            vals = {"deviance": dist.deviance(w, yf, mu)}
+            if dist.name == "bernoulli" and want_auc:
+                from h2o3_tpu.models.metrics import auc_device
+                vals["auc"] = auc_device(mu, yf, w)
+            return ("k1", dist.name, built, vals)
         probs = jax.nn.softmax(margin, axis=1)
         eps = 1e-7  # f32-safe: 1-1e-15 rounds to 1.0f -> log1p(-1) = -inf
         py = jnp.clip(probs[jnp.arange(probs.shape[0]), y], eps, 1.0)
-        ll = float(jax.device_get(-(w * jnp.log(py)).sum() / w.sum()))
-        return {"ntrees": built, "logloss": ll, "deviance": ll}
+        return ("multi", None, built,
+                {"logloss": -(w * jnp.log(py)).sum() / w.sum()})
+
+    def _score_entry_fetch(self, pend) -> Dict:
+        """Materialize a pending score entry: ONE device_get for all of
+        the interval's scalars."""
+        kind, dname, built, vals = pend
+        h = jax.device_get(vals)
+        if kind != "k1":
+            ll = float(h["logloss"])
+            return {"ntrees": built, "logloss": ll, "deviance": ll}
+        dev = float(h["deviance"])
+        entry = {"ntrees": built, "deviance": dev}
+        if dname == "gaussian":
+            entry["mse"] = dev
+            entry["rmse"] = float(np.sqrt(max(dev, 0)))
+        if dname == "bernoulli":
+            entry["logloss"] = dev / 2.0
+            if "auc" in h:
+                entry["auc"] = float(h["auc"])
+        return entry
 
     def _finalize(self, spec, valid_spec, dist_name, f0, all_trees, bm, cfg,
                   K, built, margin, vmargin, keeper, tree_offset=0,
                   prior=None, dist=None) -> GBMModel:
         M = cfg.n_nodes
-        T = built * max(K, 1)
-        host = [{k: np.asarray(jax.device_get(v)) for k, v in t.items()}
-                for t in all_trees]
-        feat = np.concatenate([t["feat"].reshape(-1, M) for t in host])
-        nal = np.concatenate([t["na_left"].reshape(-1, M) for t in host])
-        spl = np.concatenate([t["is_split"].reshape(-1, M) for t in host])
-        val = np.concatenate([t["value"].reshape(-1, M) for t in host])
-        gains = np.concatenate([t["gain"].reshape(-1, M) for t in host])
-        node_w = np.concatenate([t["node_w"].reshape(-1, M) for t in host])
+        # ONE pytree device_get for every chunk's trees, deferred to here
+        # — nothing tree-shaped crosses to the host inside the boosting
+        # loop (collect_chunk_trees slices off the padding-bucket tails)
+        th = collect_chunk_trees(all_trees, M,
+                                 bm.edges if bm is not None else [])
+        feat = th["feat"]
+        nal = th["na_left"]
+        spl = th["is_split"]
+        val = th["value"]
+        gains = th["gain"]
+        node_w = th["node_w"]
+        thr = th["thr"]
         lr0 = float(self.params["learn_rate"])
         anneal = float(self.params["learn_rate_annealing"])
         lrs = lr0 * anneal ** np.repeat(
             np.arange(tree_offset, tree_offset + built), max(K, 1))
         val_scaled = val * lrs[:, None]
-        if "thr" in host[0]:
-            # adaptive path: raw thresholds straight from the grower
-            thr = np.concatenate([t["thr"].reshape(-1, M) for t in host])
-        else:
-            sbin = np.concatenate([t["split_bin"].reshape(-1, M) for t in host])
-            thr = np.stack([bins_to_thresholds(sbin[i], feat[i], bm.edges)
-                            for i in range(T)])
         trees_host = {"feat": feat, "thr": thr, "na_left": nal,
                       "is_split": spl, "value": val_scaled, "node_w": node_w}
         if prior is not None:

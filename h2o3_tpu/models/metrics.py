@@ -149,6 +149,23 @@ def _binned_curve_kernel(score, y, w):
     return hp, hn, smax
 
 
+@jax.jit
+def auc_device(score, y, w):
+    """Scalar AUC entirely on device (the 2^17-bucket sketch + chord
+    rule; empty buckets contribute zero-width chords so no occupancy
+    filtering is needed). Used by the training loop's per-interval
+    scoring so only ONE scalar crosses to the host — the previous
+    interval-AUC path imported a kernel that no longer existed."""
+    hp, hn, _ = _binned_curve_kernel(score, y, w)
+    tp = jnp.cumsum(hp[::-1])
+    fp = jnp.cumsum(hn[::-1])
+    P, N = tp[-1], fp[-1]
+    tp_prev = jnp.concatenate([jnp.zeros(1, tp.dtype), tp[:-1]])
+    fp_prev = jnp.concatenate([jnp.zeros(1, fp.dtype), fp[:-1]])
+    return ((fp - fp_prev) * (tp + tp_prev)).sum() * 0.5 \
+        / jnp.maximum(P * N, 1e-30)
+
+
 def _binary_curve(prob, y, w):
     """(sb, tpb, fpb, P, N, auc, aucpr): score thresholds (descending)
     with cumulative weighted TP/FP at tie-run boundaries, plus the

@@ -554,19 +554,31 @@ def response_codes_in_domain(frame: Frame, response: str, domain):
 
 def compute_metrics(scores, y, w, nclasses, response_domain=None,
                     deviance=None):
-    """Dispatch to the right ModelMetrics maker, masking pad rows by w>0."""
-    wh = np.asarray(jax.device_get(w))
-    live = wh > 0
+    """Dispatch to the right ModelMetrics maker, masking pad rows by w>0.
+
+    The mask stays ON DEVICE: the old path device_get the full score
+    matrix (80MB at 10M×2) just to drop pad rows before re-uploading it
+    into the metric kernels — at bench scale that fetch dominated warm
+    train time. When every row is live (the common padded==nrow case)
+    the arrays pass through untouched; otherwise one device gather
+    compacts them. Only kernel outputs (scalars / 2^17-bin curve
+    summaries) ever cross to the host."""
+    w_d = jnp.asarray(w)
+    live = w_d > 0
+    all_live = bool(live.all())
+    scores_d = jnp.asarray(scores)
+    y_d = jnp.asarray(y)
+    if not all_live:
+        idx = jnp.nonzero(live)[0]
+        scores_d = jnp.take(scores_d, idx, axis=0)
+        y_d = jnp.take(y_d, idx, axis=0)
+        w_d = jnp.take(w_d, idx, axis=0)
     if nclasses <= 1:
-        pred = np.asarray(jax.device_get(scores))
-        yh = np.asarray(jax.device_get(y))
         return metrics_mod.make_regression_metrics(
-            pred[live], yh[live], wh[live], deviance=deviance)
-    probs = np.asarray(jax.device_get(scores))
-    yh = np.asarray(jax.device_get(y))
+            scores_d, y_d, w_d, deviance=deviance)
     if nclasses == 2:
-        return metrics_mod.make_binomial_metrics(probs[live, 1], yh[live], wh[live])
-    return metrics_mod.make_multinomial_metrics(probs[live], yh[live], wh[live])
+        return metrics_mod.make_binomial_metrics(scores_d[:, 1], y_d, w_d)
+    return metrics_mod.make_multinomial_metrics(scores_d, y_d, w_d)
 
 
 class ModelBuilder:

@@ -854,6 +854,76 @@ def bins_to_thresholds(tree_split_bin: np.ndarray, tree_feat: np.ndarray,
     return thr
 
 
+def bins_to_thresholds_stacked(split_bin: np.ndarray, feat: np.ndarray,
+                               edges: List[np.ndarray]) -> np.ndarray:
+    """Vectorized bin→raw-threshold conversion for a whole [T, M] tree
+    stack at once (the per-node Python loop in :func:`bins_to_thresholds`
+    costs ~T·M dict/branch steps at finalize; this is three numpy
+    gathers). Semantics identical: non-split nodes → 0, split bins past
+    a feature's edge list → +inf (all non-NA left)."""
+    if not edges:
+        return np.zeros_like(split_bin, dtype=np.float32)
+    emax = max((len(e) for e in edges), default=0)
+    emat = np.full((len(edges), max(emax, 1)), np.inf, dtype=np.float32)
+    elen = np.zeros(len(edges), dtype=np.int64)
+    for f, e in enumerate(edges):
+        emat[f, : len(e)] = e
+        elen[f] = len(e)
+    fidx = np.maximum(feat, 0)
+    t = split_bin.astype(np.int64)
+    over = (t - 1) >= elen[fidx]
+    thr = emat[fidx, np.clip(t - 1, 0, max(emax - 1, 0))]
+    thr = np.where(over, np.float32(np.inf), thr)
+    return np.where(feat < 0, np.float32(0.0), thr).astype(np.float32)
+
+
+# chunk-length buckets (shared GBM/DRF): single-shot chunk lengths (the
+# whole-train chunk, a final partial interval) round UP to the next
+# bucket with the tail trees masked via the traced n_active (their
+# compute is wasted and finalize drops them — bounded to ONE chunk per
+# train, ≤ ~25% of that chunk's scan; REPEATED lengths like a full
+# score interval compile exact instead, see the GBM loop). Grid/AutoML
+# ntrees variants landing in the same bucket reuse the executable (and
+# its persistent-compile-cache entry) instead of compiling one scan per
+# distinct remainder.
+CHUNK_BUCKETS = (1, 2, 3, 4, 5, 8, 10, 13, 16, 20, 25, 32, 40, 50)
+
+
+def chunk_bucket(c: int) -> int:
+    """Smallest bucket >= c."""
+    for b in CHUNK_BUCKETS:
+        if b >= c:
+            return b
+    # beyond 50 (an over-50 score_tree_interval): next multiple of 10
+    # keeps the masked-tail waste under ~20% of a chunk
+    return -(-c // 10) * 10
+
+
+def collect_chunk_trees(all_trees, M: int, edges) -> dict:
+    """Shared GBM/DRF finalize front half: ONE pytree ``device_get`` of
+    the ``[(stacked chunk trees, n_active), ...]`` list, padding-bucket
+    tail slicing, and the bin→raw-threshold conversion. Returns host
+    arrays [T_active·K, M] keyed feat/na_left/is_split/value/gain/
+    node_w/thr."""
+    host = jax.device_get([t for t, _ in all_trees])
+    acts = [n for _, n in all_trees]
+
+    def cat(kk):
+        return np.concatenate(
+            [np.asarray(t[kk])[:n].reshape(-1, M)
+             for t, n in zip(host, acts)])
+
+    out = {k: cat(k) for k in ("feat", "na_left", "is_split", "value",
+                               "gain", "node_w")}
+    if "thr" in host[0]:
+        # adaptive path: raw thresholds straight from the grower
+        out["thr"] = cat("thr")
+    else:
+        out["thr"] = bins_to_thresholds_stacked(cat("split_bin"),
+                                                out["feat"], edges)
+    return out
+
+
 def grow_tree_adaptive_streamed(X_host, y_host, margin_host, dist, lr,
                                 w_host, cfg: TreeConfig, root_lo, root_hi,
                                 nb_f, chunk_rows: int, key=None,

@@ -81,6 +81,144 @@ def uniform_edges(col: np.ndarray, nbins: int) -> np.ndarray:
     return np.linspace(lo, hi, nbins + 1)[1:-1].astype(np.float32)
 
 
+@jax.jit
+def _sketch_stats(X, nrow):
+    """Device half of the global sketch: finite-masked sort per feature
+    plus the tiny per-feature stats the host edge rules need. Pad rows
+    (index >= nrow) and ±inf are masked to NaN so they sort last and drop
+    out of the finite count — matching the host path's
+    ``col[np.isfinite(col)]`` filter."""
+    inrow = (jnp.arange(X.shape[0]) < nrow)[:, None]
+    Xf = jnp.where(inrow & jnp.isfinite(X), X.astype(jnp.float32), jnp.nan)
+    Xs = jnp.sort(Xf, axis=0)                       # finite asc, NaN last
+    nfin = jnp.sum(~jnp.isnan(Xf), axis=0).astype(jnp.int32)
+    fmax = jnp.take_along_axis(Xs, jnp.maximum(nfin - 1, 0)[None, :],
+                               axis=0)[0]
+    return Xs, nfin, Xs[0], fmax
+
+
+@jax.jit
+def _gather_rank_pairs(Xs, lo_idx, hi_idx):
+    """Pure gathers of the quantile neighbour ranks — the float64 lerp
+    happens on host so the result is bit-identical to np.quantile."""
+    a = jnp.take_along_axis(Xs, lo_idx, axis=0)
+    b = jnp.take_along_axis(Xs, hi_idx, axis=0)
+    return a, b
+
+
+def _np_quantile_lerp(a: np.ndarray, b: np.ndarray, t: np.ndarray) -> np.ndarray:
+    """numpy's _lerp on float32 neighbours with float64 t — replicated so
+    device-sketch edges match ``np.quantile(vals, qs)`` bit-for-bit
+    (verified by tests/test_train_perf.py parity tests)."""
+    diff = np.subtract(b, a)                 # float32, like numpy's _lerp
+    out = np.add(a, diff * t)                # promotes to float64
+    hi = t >= 0.5
+    if hi.any():
+        out[hi] = (b - diff * (1.0 - t))[hi]
+    return out
+
+
+def bin_matrix_device(X, names: Sequence[str], is_cat: Sequence[bool],
+                      nrow: int, nbins: int = 255, nbins_cats: int = 1024,
+                      histogram_type: str = "quantiles_global") -> BinnedMatrix:
+    """Device-side global sketch: the same edges as :func:`bin_matrix`
+    (bit-exact — parity-tested) WITHOUT a ``device_get`` of the full X.
+
+    The device sorts each feature once and the host fetches only O(F)
+    stats plus the 2·(nbins-1) quantile neighbour values per feature; the
+    float64 lerp and the unique/truncate bookkeeping stay on host where
+    they are exact and cheap. Digitisation then runs on device as usual.
+    This is the "no host round-trips" rule applied to binning itself —
+    the sketch half of XGBoost's ``tree_method=hist``.
+
+    Multi-accelerator caveat: XLA lowers the cross-shard column sort to
+    an all-gather, so every chip would need to hold the FULL [padded, F]
+    matrix (plus its sorted copy) — a frame sized for the aggregate HBM
+    of a data-sharded mesh would OOM. On any multi-shard accelerator
+    mesh this falls back to the host-side sketch (device_get +
+    np.quantile, the pre-device-sketch behavior; identical edges); the
+    CPU test mesh's virtual shards share one host RAM, so it keeps the
+    device path. A per-shard sketch merged with a psum would scale but
+    is not bit-exact — the future lever."""
+    import jax as _jax
+    from h2o3_tpu.parallel.mesh import current_mesh, n_data_shards
+    if (_jax.default_backend() != "cpu"
+            and n_data_shards(current_mesh()) > 1):
+        return bin_matrix(np.asarray(jax.device_get(X)), names, is_cat,
+                          nrow, nbins=nbins, nbins_cats=nbins_cats,
+                          histogram_type=histogram_type)
+    F = X.shape[1]
+    Xs, nfin_d, fmin_d, fmax_d = _sketch_stats(X, jnp.int32(nrow))
+    nfin, fmin, fmax = (np.asarray(jax.device_get(v))
+                        for v in (nfin_d, fmin_d, fmax_d))
+    uniform = histogram_type in ("uniform_adaptive", "uniform")
+    # per-feature quantile grids (numeric: nbins; over-wide cats:
+    # nbins_cats) — build one padded rank-index matrix for a single gather
+    qgrids: List[Optional[np.ndarray]] = [None] * F
+    for f in range(F):
+        n = int(nfin[f])
+        if n == 0:
+            continue
+        if is_cat[f]:
+            card = int(fmax[f]) + 1
+            if card <= nbins_cats:
+                continue                     # identity bins — no quantiles
+            qs = np.linspace(0.0, 1.0, nbins_cats + 1)[1:-1]
+        elif uniform:
+            continue                         # min/max only
+        else:
+            qs = np.linspace(0.0, 1.0, nbins + 1)[1:-1]
+        qgrids[f] = qs * (n - 1)             # float64 virtual indexes
+    qmax = max((len(v) for v in qgrids if v is not None), default=0)
+    quant_vals: List[Optional[np.ndarray]] = [None] * F
+    if qmax:
+        lo_idx = np.zeros((qmax, F), np.int32)
+        hi_idx = np.zeros((qmax, F), np.int32)
+        for f, virt in enumerate(qgrids):
+            if virt is None:
+                continue
+            lo_idx[: len(virt), f] = np.floor(virt).astype(np.int32)
+            hi_idx[: len(virt), f] = np.ceil(virt).astype(np.int32)
+        a, b = (np.asarray(jax.device_get(v)) for v in _gather_rank_pairs(
+            Xs, jnp.asarray(lo_idx), jnp.asarray(hi_idx)))
+        for f, virt in enumerate(qgrids):
+            if virt is None:
+                continue
+            t = virt - np.floor(virt)
+            quant_vals[f] = _np_quantile_lerp(a[: len(virt), f],
+                                              b[: len(virt), f], t)
+    del Xs  # release the sorted full-matrix copy before digitize allocates
+    edges: List[np.ndarray] = []
+    for f in range(F):
+        n = int(nfin[f])
+        if is_cat[f]:
+            card = int(fmax[f]) + 1 if n > 0 else 1
+            if card <= nbins_cats:
+                e = (np.arange(1, card, dtype=np.float32) - 0.5)
+            else:
+                e = np.unique(quant_vals[f].astype(np.float32))
+        elif n == 0:
+            e = np.empty(0, dtype=np.float32)
+        elif uniform:
+            lo, hi = float(fmin[f]), float(fmax[f])
+            e = (np.empty(0, dtype=np.float32) if lo == hi
+                 else np.linspace(lo, hi, nbins + 1)[1:-1].astype(np.float32))
+            e = e[: nbins - 1]
+        else:
+            e = np.unique(quant_vals[f].astype(np.float32))
+            e = e[: nbins - 1]
+        edges.append(e)
+    n_bins_eff = max(nbins, max((len(e) + 1 for e in edges), default=2))
+    if n_bins_eff > 16382:
+        raise ValueError(
+            f"effective bin count {n_bins_eff} exceeds the 14-bit routing "
+            f"limit; lower nbins_cats (reference default is 1024)")
+    codes = make_codes_view(digitize_with_edges(X, edges, n_bins_eff))
+    return BinnedMatrix(codes=codes, n_bins=n_bins_eff, edges=edges,
+                        names=list(names), is_categorical=list(is_cat),
+                        nrow=nrow)
+
+
 def bin_matrix(X, names: Sequence[str], is_cat: Sequence[bool], nrow: int,
                nbins: int = 255, nbins_cats: int = 1024,
                histogram_type: str = "quantiles_global") -> BinnedMatrix:

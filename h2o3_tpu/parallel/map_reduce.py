@@ -22,7 +22,7 @@ compute model.
 """
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -37,15 +37,22 @@ _REDUCERS = {
 }
 
 
-def map_reduce(map_fn, arrays, reduce_op="sum", mesh=None, donate=False):
-    """Run ``map_fn`` over each data shard of ``arrays`` (a pytree of arrays
-    sharded along their leading axis) and reduce the per-shard results with
-    a collective. Result is replicated across devices.
+@lru_cache(maxsize=32)
+def _compiled_map_reduce(map_fn, mesh, reduce_op, treedef, donate):
+    """Cache the shard_mapped+jitted combinator per (fn, mesh, structure).
 
-    ``map_fn(shard_pytree) -> partial_pytree`` must return per-shard partial
-    aggregates (e.g. a local histogram, a local (Gram, gradient) pair).
-    """
-    mesh = mesh or current_mesh()
+    Building a fresh ``jax.jit(jax.shard_map(...))`` on every call (the
+    old behavior) defeated jit's C++ fast path AND the persistent
+    compilation cache's in-memory layer: each invocation re-traced the
+    map_fn even at identical shapes — any repeat caller paid a retrace
+    per call. (Algorithm code mostly uses GSPMD-auto-partitioned jnp
+    directly; this combinator is the explicit-collective surface, so
+    the cache mainly serves external/driver callers.)
+
+    Only NAMED callables reach this cache (see ``_cacheable``): a lambda
+    rebuilt per call could never hit on identity, and caching it would
+    pin its closure until eviction — those build uncached, exactly the
+    old cost. Pass a module-level function for the caching win."""
     reducer = _REDUCERS[reduce_op] if isinstance(reduce_op, str) else reduce_op
 
     def wrapped(shards):
@@ -55,21 +62,74 @@ def map_reduce(map_fn, arrays, reduce_op="sum", mesh=None, donate=False):
     f = jax.shard_map(
         wrapped,
         mesh=mesh,
-        in_specs=jax.tree.map(lambda _: P(DATA_AXIS), arrays),
+        in_specs=jax.tree.unflatten(treedef,
+                                    [P(DATA_AXIS)] * treedef.num_leaves),
         out_specs=P(),
     )
-    return jax.jit(f, donate_argnums=(0,) if donate else ())(arrays)
+    return jax.jit(f, donate_argnums=(0,) if donate else ())
+
+
+def _cacheable(*keys) -> bool:
+    """True when every cache-key part is hashable AND every callable is
+    a plain MODULE-LEVEL function. Identity-keyed lambdas, nested defs,
+    bound methods, and per-call partials never hit the cache but would
+    pin their closures until LRU eviction — they build uncached."""
+    import types
+    for k in keys:
+        if callable(k):
+            if not isinstance(k, types.FunctionType):
+                return False
+            if k.__name__ == "<lambda>" or "<locals>" in k.__qualname__:
+                return False
+        try:
+            hash(k)
+        except TypeError:
+            return False
+    return True
+
+
+def map_reduce(map_fn, arrays, reduce_op="sum", mesh=None, donate=False):
+    """Run ``map_fn`` over each data shard of ``arrays`` (a pytree of arrays
+    sharded along their leading axis) and reduce the per-shard results with
+    a collective. Result is replicated across devices.
+
+    ``map_fn(shard_pytree) -> partial_pytree`` must return per-shard partial
+    aggregates (e.g. a local histogram, a local (Gram, gradient) pair).
+    Named ``map_fn``/``reduce_op`` callables hit the compiled-step cache;
+    lambdas and unhashables build uncached (the pre-cache behavior)."""
+    mesh = mesh or current_mesh()
+    treedef = jax.tree.structure(arrays)
+    if _cacheable(map_fn, reduce_op):
+        f = _compiled_map_reduce(map_fn, mesh, reduce_op, treedef,
+                                 bool(donate))
+    else:
+        f = _compiled_map_reduce.__wrapped__(map_fn, mesh, reduce_op,
+                                             treedef, bool(donate))
+    return f(arrays)
+
+
+@lru_cache(maxsize=32)
+def _compiled_map_cols(map_fn, mesh, out_specs, treedef):
+    f = jax.shard_map(
+        map_fn,
+        mesh=mesh,
+        in_specs=jax.tree.unflatten(treedef,
+                                    [P(DATA_AXIS)] * treedef.num_leaves),
+        out_specs=out_specs,
+    )
+    return jax.jit(f)
 
 
 def map_cols(map_fn, arrays, out_specs=None, mesh=None):
     """Elementwise map over data shards producing new row-sharded outputs —
     the NewChunk/outputFrame analog (water/MRTask.java:257-299 map overloads
-    writing NewChunks)."""
+    writing NewChunks). Named map_fns with hashable out_specs hit the
+    compiled-step cache; anything else builds uncached as before."""
     mesh = mesh or current_mesh()
-    f = jax.shard_map(
-        map_fn,
-        mesh=mesh,
-        in_specs=jax.tree.map(lambda _: P(DATA_AXIS), arrays),
-        out_specs=out_specs if out_specs is not None else P(DATA_AXIS),
-    )
-    return jax.jit(f)(arrays)
+    treedef = jax.tree.structure(arrays)
+    specs = out_specs if out_specs is not None else P(DATA_AXIS)
+    if _cacheable(map_fn, specs):
+        f = _compiled_map_cols(map_fn, mesh, specs, treedef)
+    else:
+        f = _compiled_map_cols.__wrapped__(map_fn, mesh, specs, treedef)
+    return f(arrays)
