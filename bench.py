@@ -119,6 +119,67 @@ def _disk_frame(rows):
     return fr, ingest_s
 
 
+SERVE_SINGLE_ROWS = int(os.environ.get("H2O3_BENCH_SERVE_ROWS", 300))
+SERVE_SECONDS = float(os.environ.get("H2O3_BENCH_SERVE_SECS", 3.0))
+
+
+def _serve_round(model, fr, F):
+    """Serving benchmark (ISSUE 3): deploy the trained GBM, measure
+    single-row request latency (p50/p99 through the full
+    encode→queue→device→decode path) and saturated batched throughput
+    (8 concurrent clients submitting 512-row requests)."""
+    import threading
+    from h2o3_tpu import serve
+    names = [f"f{i}" for i in range(F)]
+    take = 4096
+    cols = {n: np.asarray(fr.vec(n).to_numpy())[:take] for n in names}
+    rows = [{n: float(cols[n][i]) for n in names} for i in range(take)]
+
+    model.key = model.key or "bench_gbm"
+    dep = serve.deploy(model.key, model=model, max_batch=4096,
+                       max_delay_ms=1.0, queue_limit=65536)
+    try:
+        # warm-path sanity + first-use host lazies before timing
+        dep.predict_rows(rows[:8])
+        # single-row latency: sequential closed-loop client
+        for i in range(SERVE_SINGLE_ROWS):
+            dep.predict_rows([rows[i % take]])
+        p50 = dep.stats.percentile_ms(50)
+        p99 = dep.stats.percentile_ms(99)
+
+        # batched throughput: concurrent clients, fixed wall budget
+        stop = time.time() + SERVE_SECONDS
+        scored = [0] * 8
+
+        def client(ci):
+            i = 0
+            while time.time() < stop:
+                got = dep.predict_rows(rows[(i % 8) * 512:
+                                            (i % 8) * 512 + 512])
+                scored[ci] += len(got)
+                i += 1
+
+        t0 = time.time()
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.time() - t0
+        snap = dep.stats.snapshot()
+        return {
+            "p50_ms": round(p50, 3) if p50 is not None else None,
+            "p99_ms": round(p99, 3) if p99 is not None else None,
+            "rows_per_sec": round(sum(scored) / max(dt, 1e-9), 1),
+            "batch_occupancy": snap["mean_batch_occupancy"],
+            "stage_ms": snap["stage_ms"],
+            "single_row_requests": SERVE_SINGLE_ROWS,
+        }
+    finally:
+        serve.undeploy(model.key)
+
+
 def main():
     import h2o3_tpu as h2o
     from h2o3_tpu.cluster_boot import setup_compilation_cache
@@ -196,6 +257,17 @@ def main():
         except Exception as e:  # guard must never sink the headline run
             log(f"bf16 guard FAILED to run: {e!r}")
 
+    serve_out = None
+    if os.environ.get("H2O3_BENCH_SERVE", "1") not in ("0", "false", ""):
+        try:
+            serve_out = _serve_round(gbm.model, fr, F)
+            log(f"serve: p50={serve_out['p50_ms']}ms "
+                f"p99={serve_out['p99_ms']}ms "
+                f"{serve_out['rows_per_sec']:,.0f} rows/sec "
+                f"(occupancy {serve_out['batch_occupancy']})")
+        except Exception as e:  # serving must never sink the headline run
+            log(f"serve round FAILED to run: {e!r}")
+
     out = {
         "metric": "gbm_hist_training_throughput",
         "value": round(rows_per_sec, 1),
@@ -208,6 +280,12 @@ def main():
         "warm_train_s": round(total, 2),
         "loop_s": round(loop_s, 2),
     }
+    if serve_out is not None:
+        # online-serving round (h2o3_tpu.serve): single-row latency
+        # percentiles through the micro-batcher + saturated batched
+        # throughput for the SAME deployed model — the inference half
+        # of the training numbers above
+        out["serve"] = serve_out
     if ingest_s is not None:
         # ingest phase reported alongside the headline (the streaming
         # chunk-local parse pipeline, ingest/parse.py): disk CSV →

@@ -524,6 +524,29 @@ def model_v3(model, key: str) -> Dict:
     }
 
 
+def serve_deployment_v3(dep) -> Dict:
+    """One deployed model's serving config + warm-compile record
+    (no reference analog — h2o-3 has no online row-serving surface;
+    schema shape follows ModelsV3 conventions)."""
+    info = dep.info()
+    return {
+        "__meta": {"schema_version": 3, "schema_name": "ServeDeploymentV3",
+                   "schema_type": "ServeDeployment"},
+        "model_id": keyref(dep.key, "Key<Model>"),
+        **info,
+    }
+
+
+def serve_stats_v3(snapshot: Dict) -> Dict:
+    """GET /3/Serve/stats payload: per-model latency percentiles, stage
+    attribution, queue depth, batch occupancy and counters."""
+    return {
+        "__meta": {"schema_version": 3, "schema_name": "ServeStatsV3",
+                   "schema_type": "ServeStats"},
+        **snapshot,
+    }
+
+
 def models_v3(entries: List) -> Dict:
     return {
         "__meta": {"schema_version": 3, "schema_name": "ModelsV3",

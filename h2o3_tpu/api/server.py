@@ -614,6 +614,113 @@ def _predict(params, body, model, frame):
             "predictions_frame": schemas.keyref(dest, "Key<Frame>")}
 
 
+# ---------------- serving subsystem (h2o3_tpu.serve) -------------------
+# No reference analog: h2o-3's only online path is frame-batch predict.
+# deploy warms per-bucket compiled predict executables; rows score
+# through the micro-batching queue (ISSUE 3).
+
+
+def _serve_config_from_params(params) -> Dict[str, Any]:
+    cfg: Dict[str, Any] = {}
+    for k, cast in (("max_batch", int), ("max_delay_ms", float),
+                    ("queue_limit", int), ("timeout_ms", float)):
+        v = _coerce(params.get(k)) if params.get(k) is not None else None
+        if v is not None:
+            cfg[k] = cast(v)
+    b = _coerce(params.get("buckets")) if params.get("buckets") else None
+    if b:
+        cfg["buckets"] = [int(x) for x in
+                          (b if isinstance(b, list) else _bracket_list(b))]
+    return cfg
+
+
+@route("POST", "/3/Serve/models/{model}")
+def _serve_deploy(params, body, model):
+    """Deploy a model for low-latency row serving: pre-encode the
+    column/domain spec, warm compiled predict executables at the batch
+    buckets, start the micro-batcher. Knobs: max_batch, max_delay_ms,
+    queue_limit, timeout_ms, buckets."""
+    from h2o3_tpu import serve
+    try:
+        dep = serve.deploy(model, **_serve_config_from_params(params))
+    except KeyError as e:
+        raise ApiError(404, str(e))
+    except ValueError as e:
+        raise ApiError(400, str(e))
+    return schemas.serve_deployment_v3(dep)
+
+
+@route("DELETE", "/3/Serve/models/{model}")
+def _serve_undeploy(params, body, model):
+    from h2o3_tpu import serve
+    if not serve.undeploy(model):
+        raise ApiError(404, f"model '{model}' is not deployed")
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "ServeDeploymentV3"},
+            "model_id": schemas.keyref(model, "Key<Model>"),
+            "undeployed": True}
+
+
+@route("GET", "/3/Serve/models")
+def _serve_list(params, body):
+    from h2o3_tpu import serve
+    return {"__meta": {"schema_version": 3, "schema_name": "ServeModelsV3"},
+            "deployments": [schemas.serve_deployment_v3(d)
+                            for d in serve.deployments()]}
+
+
+@route("GET", "/3/Serve/models/{model}")
+def _serve_get(params, body, model):
+    from h2o3_tpu import serve
+    dep = serve.deployment(model)
+    if dep is None:
+        raise ApiError(404, f"model '{model}' is not deployed")
+    return schemas.serve_deployment_v3(dep)
+
+
+@route("GET", "/3/Serve/stats")
+def _serve_stats(params, body):
+    from h2o3_tpu import serve
+    return schemas.serve_stats_v3(serve.stats())
+
+
+@route("POST", "/3/Predictions/models/{model}/rows")
+def _predict_rows(params, body, model):
+    """Row-level scoring through the micro-batcher: JSON rows in
+    ({"rows": [{col: value, ...}, ...]} or a bare list), predictions +
+    per-class probabilities out. Admission control maps to HTTP:
+    queue-full / deadline-expired → 503 (retryable), not-deployed →
+    404 with deploy guidance."""
+    from h2o3_tpu import serve
+    rows = params.get("rows")
+    if rows is None and body:
+        try:
+            rows = json.loads(body.decode())
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise ApiError(400, f"request body is not JSON rows: {e}")
+    if isinstance(rows, str):
+        rows = _coerce(rows)
+    if isinstance(rows, dict):
+        rows = rows.get("rows")
+    if not isinstance(rows, list) or not all(
+            isinstance(r, dict) for r in rows):
+        raise ApiError(400, 'expected {"rows": [{column: value, ...}]}')
+    tmo = _coerce(params.get("timeout_ms")) \
+        if params.get("timeout_ms") is not None else None
+    try:
+        # explicit timeout_ms=0 means fail-fast, NOT the default
+        preds = serve.predict_rows(
+            model, rows, timeout_ms=float(tmo) if tmo is not None else None)
+    except KeyError as e:
+        raise ApiError(404, str(e))
+    except serve.ServeError as e:
+        raise ApiError(getattr(e, "http_status", 500), str(e))
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "ServePredictionsV3"},
+            "model_id": schemas.keyref(model, "Key<Model>"),
+            "predictions": preds}
+
+
 @route("POST", "/3/ModelMetrics/models/{model}/frames/{frame}")
 def _model_metrics_score(params, body, model, frame):
     """ModelMetricsHandler.score (water/api/ModelMetricsHandler.java:288):

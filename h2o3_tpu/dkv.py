@@ -104,6 +104,22 @@ def read_lock(key: str, job_key: Optional[str]) -> None:
         _LOCKERS[key] = (w, readers)
 
 
+def get_and_read_lock(key: str, kind: str, job_key: str) -> Any:
+    """Atomic fetch + shared-lock under the store mutex (the serve
+    registry's deploy path): between a plain get() and a later
+    read_lock() a concurrent DELETE /3/Models could remove the key —
+    the deployment would then serve a model the store no longer owns.
+    One critical section closes the window."""
+    with _LOCK:
+        ent = _STORE.get(key)
+        if ent is None:
+            raise KeyError(f"key '{key}' not found in the store")
+        if ent[0] != kind:
+            raise KeyError(f"key '{key}' holds a {ent[0]}, not a {kind}")
+        read_lock(key, job_key)
+        return ent[1]
+
+
 def unlock(key: str, job_key: Optional[str]) -> None:
     with _LOCK:
         w, readers = _LOCKERS.get(key, (None, set()))

@@ -539,6 +539,104 @@ def export_pojo(model, path: str, class_name: Optional[str] = None) -> str:
 
 # ---------------- EasyPredict row API ----------------------------------
 
+def build_domain_luts(columns: Sequence[str],
+                      cat_domains: Dict[str, Sequence[str]]
+                      ) -> Dict[str, Dict[str, int]]:
+    """Per-column label→code lookup tables for the categorical columns.
+    Built once per model (deploy/wrapper construction) so batch encoding
+    is O(1) per label instead of the O(|domain|) list.index scan."""
+    return {c: {str(lab): i for i, lab in enumerate(cat_domains[c])}
+            for c in columns if cat_domains.get(c)}
+
+
+def rows_to_matrix(rows: Sequence[Dict[str, Any]], columns: Sequence[str],
+                   cat_domains: Dict[str, Sequence[str]], *,
+                   convert_unknown_categorical_levels_to_na: bool = True,
+                   convert_invalid_numbers_to_na: bool = False,
+                   unknown_seen: Optional[Dict[str, int]] = None,
+                   luts: Optional[Dict[str, Dict[str, int]]] = None,
+                   out: Optional[np.ndarray] = None) -> np.ndarray:
+    """Vectorized RowData encoding: a batch of {column: value} dicts →
+    [n, F] float matrix in training column order — the
+    EasyPredictModelWrapper dict→array contract applied to whole
+    batches (the serve codec's hot path). Per column: enum labels map
+    through the training-domain LUT, unknown levels → NA (or raise,
+    per the convert_unknown flag), missing columns / None → NA.
+
+    Int-coded enum levels honor the SAME unknown-level policy as
+    string labels: a numeric code outside [0, cardinality) — or a
+    non-integral one — is an unknown level, not a silent pass-through
+    (the old single-row path forwarded any float verbatim, so an
+    out-of-domain code could route down a tree branch that training
+    never built).
+
+    ``out`` may be a caller-provided (padded) buffer with >= n rows;
+    rows past len(rows) are left untouched."""
+    n = len(rows)
+    F = len(columns)
+    if out is None:
+        out = np.full((n, F), np.nan, np.float64)
+    else:
+        out[:n, :] = np.nan
+    if luts is None:
+        luts = build_domain_luts(columns, cat_domains)
+    for j, c in enumerate(columns):
+        lut = luts.get(c)
+        if lut is None:
+            # numeric column: one-shot asarray fast path, element-wise
+            # fallback only when a value refuses to parse
+            vals = [r.get(c) for r in rows]
+            try:
+                col = np.asarray(
+                    [np.nan if v is None else v for v in vals],
+                    dtype=np.float64)
+            except (TypeError, ValueError):
+                if not convert_invalid_numbers_to_na:
+                    raise
+                col = np.full(n, np.nan, np.float64)
+                for i, v in enumerate(vals):
+                    if v is None:
+                        continue
+                    try:
+                        col[i] = float(v)
+                    except (TypeError, ValueError):
+                        pass
+            out[:n, j] = col
+            continue
+        ncat = len(lut)
+        unknown = 0
+        for i, r in enumerate(rows):
+            v = r.get(c)
+            if v is None:
+                continue
+            if isinstance(v, str):
+                code = lut.get(v, -1)
+            else:
+                try:
+                    fv = float(v)
+                except (TypeError, ValueError):
+                    code = -1
+                else:
+                    if np.isnan(fv):
+                        continue            # numeric NA → NA level
+                    code = int(fv) if (np.isfinite(fv) and fv == int(fv)
+                                       and 0 <= fv < ncat) else -1
+            if code < 0:
+                # unseen level: NA when configured (default), else a
+                # PredictUnknownCategoricalLevelException analog
+                if not convert_unknown_categorical_levels_to_na:
+                    raise ValueError(
+                        f"unknown categorical level {v!r} for column "
+                        f"'{c}' (set convert_unknown_categorical_levels"
+                        f"_to_na=True to map to NA)")
+                unknown += 1
+                continue
+            out[i, j] = code
+        if unknown and unknown_seen is not None:
+            unknown_seen[c] = unknown_seen.get(c, 0) + unknown
+    return out
+
+
 class EasyPredictModelWrapper:
     """Row-dict scoring over any of our models OR a loaded MOJO scorer —
     hex/genmodel/easy/EasyPredictModelWrapper.java's RowData contract:
@@ -564,6 +662,7 @@ class EasyPredictModelWrapper:
         self.convert_invalid_numbers_to_na = bool(
             convert_invalid_numbers_to_na)
         self.unknown_categorical_levels_seen: Dict[str, int] = {}
+        self._luts = build_domain_luts(self.columns, self.cat_domains)
         self.enable_contributions = bool(enable_contributions)
         self.enable_leaf_assignment = bool(enable_leaf_assignment)
         if enable_contributions and not hasattr(model,
@@ -572,38 +671,13 @@ class EasyPredictModelWrapper:
                              "TreeSHAP support (GBM/DRF/XGBoost only)")
 
     def _row_to_array(self, row: Dict[str, Any]) -> np.ndarray:
-        out = np.full(len(self.columns), np.nan)
-        for i, c in enumerate(self.columns):
-            if c not in row or row[c] is None:
-                continue
-            v = row[c]
-            dom = self.cat_domains.get(c)
-            if dom:
-                if isinstance(v, str):
-                    try:
-                        out[i] = list(dom).index(v)
-                    except ValueError:
-                        # unseen level: NA when configured (default), else
-                        # a PredictUnknownCategoricalLevelException analog
-                        if not self.convert_unknown_categorical_levels_to_na:
-                            raise ValueError(
-                                f"unknown categorical level {v!r} for "
-                                f"column '{c}' (set convert_unknown_"
-                                f"categorical_levels_to_na=True to map "
-                                f"to NA)")
-                        self.unknown_categorical_levels_seen[c] = \
-                            self.unknown_categorical_levels_seen.get(c, 0) + 1
-                        out[i] = np.nan
-                else:
-                    out[i] = float(v)
-            else:
-                try:
-                    out[i] = float(v)
-                except (TypeError, ValueError):
-                    if not self.convert_invalid_numbers_to_na:
-                        raise
-                    out[i] = np.nan
-        return out
+        return rows_to_matrix(
+            [row], self.columns, self.cat_domains,
+            convert_unknown_categorical_levels_to_na=self
+            .convert_unknown_categorical_levels_to_na,
+            convert_invalid_numbers_to_na=self.convert_invalid_numbers_to_na,
+            unknown_seen=self.unknown_categorical_levels_seen,
+            luts=self._luts)[0]
 
     def predict_row(self, row: Dict[str, Any]) -> Dict[str, Any]:
         arr = self._row_to_array(row)

@@ -5,9 +5,16 @@ progress, JobStatus, cancellation, exceptions, polled via GET /3/Jobs.
 Here: a host-side registry of Job objects; training runs on a worker
 thread so REST/interactive polling stays responsive (device work is
 dispatched asynchronously by JAX anyway).
+
+Long-running servers churn through thousands of jobs (every parse,
+train, predict and micro-batch admin call makes one), so the registry
+evicts terminal jobs beyond a bounded tail (H2O3_JOBS_KEEP, default
+512) — the water/Job analog stores jobs in the DKV where the cleaner
+eventually reclaims them; here eviction rides on registration.
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 import traceback
@@ -19,8 +26,26 @@ DONE = "DONE"
 FAILED = "FAILED"
 CANCELLED = "CANCELLED"
 
+_TERMINAL = (DONE, FAILED, CANCELLED)
+
 _REGISTRY: Dict[str, "Job"] = {}
 _LOCK = threading.Lock()
+
+
+def _jobs_keep() -> int:
+    try:
+        return int(os.environ.get("H2O3_JOBS_KEEP", "512") or 512)
+    except ValueError:
+        return 512
+
+
+def _evict_terminal_locked(keep: int) -> None:
+    """Drop the OLDEST terminal jobs beyond ``keep`` (insertion order —
+    dicts preserve it). Running jobs are never evicted regardless of
+    age: a poller must always be able to find its live job."""
+    terminal = [k for k, j in _REGISTRY.items() if j.status in _TERMINAL]
+    for k in terminal[: max(len(terminal) - keep, 0)]:
+        del _REGISTRY[k]
 
 
 class Job:
@@ -36,21 +61,30 @@ class Job:
         self.result: Any = None
         self._cancel_requested = False
         self._thread: Optional[threading.Thread] = None
+        # per-job mutex: _worked is read by REST pollers and bumped by
+        # the worker thread (often from several CV/fold threads at
+        # once) — `self._worked += w` is a read-modify-write that loses
+        # updates without it (water/Job.update is an AtomicLong add)
+        self._mutex = threading.Lock()
         with _LOCK:
             _REGISTRY[self.key] = self
+            _evict_terminal_locked(_jobs_keep())
 
     # -- progress -------------------------------------------------------
     @property
     def progress(self) -> float:
-        if self.status in (DONE,):
-            return 1.0
-        return min(self._worked / self._work, 1.0) if self._work else 0.0
+        with self._mutex:
+            if self.status in (DONE,):
+                return 1.0
+            return min(self._worked / self._work, 1.0) if self._work else 0.0
 
     def update(self, worked: float):
-        self._worked += worked
+        with self._mutex:
+            self._worked += worked
 
     def set_progress(self, frac: float):
-        self._worked = frac * self._work
+        with self._mutex:
+            self._worked = frac * self._work
 
     # -- lifecycle ------------------------------------------------------
     def run(self, fn: Callable[["Job"], Any], background: bool = False) -> "Job":
@@ -93,3 +127,8 @@ def get_job(key: str) -> Optional[Job]:
 def list_jobs():
     with _LOCK:
         return list(_REGISTRY.values())
+
+
+def registry_size() -> int:
+    with _LOCK:
+        return len(_REGISTRY)

@@ -431,6 +431,26 @@ class Model:
                      Vec.from_numpy(q1.astype(np.float32))]
         return Frame(names, vecs)
 
+    def deploy(self, **serve_config):
+        """Register this model with the serving subsystem
+        (h2o3_tpu.serve): pre-encodes the column/domain spec and warms
+        compiled predict executables at the batch-size buckets, then
+        rows score through the micro-batcher — see
+        POST /3/Predictions/models/{key}/rows. Returns the Deployment."""
+        from h2o3_tpu import serve
+        return serve.deploy(self.key, model=self, **serve_config)
+
+    def predict_rows(self, rows, timeout_ms=None):
+        """Score a list of {column: value} dicts through the deployed
+        micro-batching path. Deploys with defaults on first use; an
+        EXISTING deployment under this key is reused as-is — replacing
+        a live (possibly pinned, custom-configured) deployment
+        mid-traffic is deploy()'s explicit job, not a scoring
+        side-effect."""
+        from h2o3_tpu import serve
+        dep = serve.deployment(self.key) or self.deploy()
+        return dep.predict_rows(rows, timeout_ms=timeout_ms)
+
     def model_performance(self, frame: Optional[Frame] = None):
         if frame is None:
             return self.training_metrics

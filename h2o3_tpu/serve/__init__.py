@@ -1,0 +1,26 @@
+"""h2o3_tpu.serve — low-latency model serving.
+
+Micro-batched, compile-cached scoring: deploy() warms one predict
+executable per batch-size bucket so steady-state serving compiles zero
+XLA modules; a micro-batching queue coalesces concurrent row requests
+into padded device batches with admission control and per-request
+deadlines. REST surface: POST /3/Predictions/models/{m}/rows,
+/3/Serve/models, /3/Serve/stats (api/server.py).
+"""
+from h2o3_tpu.serve.batcher import (ServeBadRequestError, ServeClosedError,
+                                    ServeDeadlineError, ServeError,
+                                    ServeOverloadedError)
+from h2o3_tpu.serve.codec import RowCodec
+from h2o3_tpu.serve.registry import DEFAULT_BUCKETS, CompiledScorer
+from h2o3_tpu.serve.service import (Deployment, deploy, deployment,
+                                    deployments, predict_rows,
+                                    shutdown_all, stats, undeploy)
+from h2o3_tpu.serve.stats import ServeStats
+
+__all__ = [
+    "CompiledScorer", "DEFAULT_BUCKETS", "Deployment", "RowCodec",
+    "ServeBadRequestError", "ServeClosedError", "ServeDeadlineError",
+    "ServeError", "ServeOverloadedError", "ServeStats", "deploy",
+    "deployment", "deployments", "predict_rows", "shutdown_all", "stats",
+    "undeploy",
+]

@@ -1,0 +1,313 @@
+"""Micro-batching queue: concurrent row requests → padded device batches.
+
+Concurrent clients submit small row lists; a batcher thread coalesces
+everything that arrives within one tick (max_delay_ms, or until
+max_batch rows are waiting) into ONE padded device batch, and a
+collector thread fetches results + resolves the waiting clients. Two
+threads — not one — because JAX dispatch is asynchronous: the batcher
+encodes and dispatches batch k+1 while the collector is still blocked
+on batch k's device fetch (the pipeline analog of PR 2's speculative
+chunk dispatch). The in-flight queue is bounded (pipeline depth 2) so
+a slow device backpressures encoding instead of buffering unboundedly.
+
+Admission control (water/Job has no analog; this is standard serving
+hygiene): the pending queue is bounded in ROWS — beyond it submit()
+fails fast with ServeOverloadedError (HTTP 503) instead of growing
+latency without bound; each request carries a deadline — expired
+requests are dropped at pick-up time (never dispatched) or abandoned
+at resolve time, surfacing ServeDeadlineError.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from h2o3_tpu.serve.stats import ServeStats
+
+
+class ServeError(RuntimeError):
+    """Base class; http_status picked up by the REST layer."""
+    http_status = 500
+
+
+class ServeOverloadedError(ServeError):
+    http_status = 503
+
+
+class ServeBadRequestError(ServeError):
+    """A request's rows failed to encode (e.g. a non-numeric string in
+    a numeric column) — the client's fault, not the service's."""
+    http_status = 400
+
+
+class ServeDeadlineError(ServeError):
+    http_status = 503
+
+
+class ServeClosedError(ServeError):
+    http_status = 410
+
+
+class _Request:
+    __slots__ = ("rows", "n", "t_enqueue", "deadline", "event", "results",
+                 "error", "abandoned")
+
+    def __init__(self, rows: Sequence[Dict[str, Any]], deadline: float):
+        self.rows = rows
+        self.n = len(rows)
+        self.t_enqueue = time.perf_counter()
+        self.deadline = deadline
+        self.event = threading.Event()
+        self.results: Optional[List[Dict[str, Any]]] = None
+        self.error: Optional[BaseException] = None
+        self.abandoned = False
+
+
+class MicroBatcher:
+    def __init__(self, encode: Callable, dispatch: Callable,
+                 decode: Callable, stats: ServeStats, *,
+                 bucket_for: Callable[[int], int],
+                 max_batch: int = 512, max_delay_ms: float = 2.0,
+                 queue_limit: int = 8192,
+                 default_timeout_ms: float = 10_000.0,
+                 pipeline_depth: int = 2):
+        import queue as _q
+        self._encode = encode          # (rows, pad_to) -> np [pad, F]
+        self._dispatch = dispatch      # (X, n_active) -> device array
+        self._decode = decode          # (host scores, n) -> [dict, ...]
+        self._bucket_for = bucket_for
+        self.stats = stats
+        self.max_batch = int(max_batch)
+        self.max_delay_s = float(max_delay_ms) / 1000.0
+        self.queue_limit = int(queue_limit)
+        self.default_timeout_s = float(default_timeout_ms) / 1000.0
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._pending: deque = deque()
+        self._pending_rows = 0
+        self._closed = False
+        self._inflight: "_q.Queue" = _q.Queue(maxsize=max(pipeline_depth, 1))
+        self._batch_thread = threading.Thread(
+            target=self._batch_loop, daemon=True, name="serve-batcher")
+        self._collect_thread = threading.Thread(
+            target=self._collect_loop, daemon=True, name="serve-collector")
+        self._batch_thread.start()
+        self._collect_thread.start()
+
+    # -- client side ----------------------------------------------------
+
+    def submit(self, rows: Sequence[Dict[str, Any]],
+               timeout_ms: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Blocking scoring call for one client request. Raises
+        ServeOverloadedError when the queue is full, ServeDeadlineError
+        when the deadline expires first."""
+        if not rows:
+            return []
+        if len(rows) > self.max_batch:
+            raise ValueError(
+                f"submit() takes at most max_batch={self.max_batch} rows "
+                f"per request (got {len(rows)}); split the request")
+        timeout_s = (float(timeout_ms) / 1000.0 if timeout_ms is not None
+                     else self.default_timeout_s)
+        deadline = time.perf_counter() + timeout_s
+        req = _Request(rows, deadline)
+        with self._cv:
+            if self._closed:
+                raise ServeClosedError("deployment is shut down")
+            if self._pending_rows + req.n > self.queue_limit:
+                self.stats.record_rejected()
+                raise ServeOverloadedError(
+                    f"serving queue full ({self._pending_rows} rows "
+                    f"pending, limit {self.queue_limit}) — retry later")
+            self._pending.append(req)
+            self._pending_rows += req.n
+            self._cv.notify_all()
+        self.stats.queue_delta(req.n)
+        resolved = req.event.wait(max(deadline - time.perf_counter(), 0.0))
+        if not resolved:
+            # the batcher may be timing this request out concurrently
+            # (_take_batch's expired-in-queue branch runs under _mu and
+            # records the timeout itself) — claim under the same lock so
+            # the counter advances exactly once
+            with self._mu:
+                req.abandoned = True
+                already_counted = req.error is not None
+            if not already_counted:
+                self.stats.record_timeout()
+            self.stats.queue_delta(-req.n)
+            raise ServeDeadlineError(
+                f"request deadline ({timeout_s * 1e3:.0f} ms) expired "
+                f"before results were ready")
+        self.stats.queue_delta(-req.n)
+        if req.error is not None:
+            raise req.error
+        self.stats.record_request(
+            (time.perf_counter() - req.t_enqueue) * 1e3, req.n)
+        return req.results
+
+    # -- batcher thread -------------------------------------------------
+
+    def _take_batch(self) -> List[_Request]:
+        """Collect requests for one tick: first arrival opens a window
+        of max_delay_ms; the batch closes when the window ends or
+        max_batch rows are in hand."""
+        batch: List[_Request] = []
+        rows = 0
+        window_end = None
+        with self._cv:
+            while True:
+                while self._pending:
+                    if rows + self._pending[0].n > self.max_batch:
+                        break
+                    r = self._pending.popleft()
+                    self._pending_rows -= r.n
+                    now = time.perf_counter()
+                    if r.abandoned or now > r.deadline:
+                        # expired in queue: never dispatch it
+                        if not r.abandoned:
+                            r.error = ServeDeadlineError(
+                                "request expired in the serving queue")
+                            self.stats.record_timeout()
+                            r.event.set()
+                        continue
+                    batch.append(r)
+                    rows += r.n
+                if self._closed and not batch and not self._pending:
+                    return []
+                if rows >= self.max_batch:
+                    return batch
+                now = time.perf_counter()
+                if batch and window_end is None:
+                    window_end = now + self.max_delay_s
+                if window_end is not None:
+                    if now >= window_end:
+                        return batch
+                    self._cv.wait(window_end - now)
+                else:
+                    if self._closed:
+                        return []
+                    self._cv.wait(0.05)
+
+    def _encode_batch(self, batch: List[_Request]):
+        """Encode a coalesced batch. A row that refuses to encode (bad
+        client input) must fail ONLY its own request — innocent
+        requests sharing the tick are re-encoded without it and still
+        dispatched; the offender resolves with a 400-mappable
+        ServeBadRequestError instead of poisoning the whole batch."""
+        rows: List[Dict[str, Any]] = []
+        for r in batch:
+            rows.extend(r.rows)
+        n = sum(r.n for r in batch)
+        try:
+            return self._encode(rows, self._bucket_for(n)), batch, n
+        except Exception:
+            pass                     # isolate per request below
+        good: List[_Request] = []
+        for r in batch:
+            try:
+                self._encode(r.rows, r.n)
+                good.append(r)
+            except Exception as e:   # noqa: BLE001 — client's bad row
+                r.error = e if isinstance(e, ServeError) else \
+                    ServeBadRequestError(f"row encoding failed: {e}")
+                r.event.set()
+                self.stats.record_error()
+        if not good:
+            return None, [], 0
+        rows = []
+        for r in good:
+            rows.extend(r.rows)
+        n = sum(r.n for r in good)
+        try:
+            return self._encode(rows, self._bucket_for(n)), good, n
+        except BaseException as e:  # noqa: BLE001 — must not kill the loop
+            for r in good:
+                r.error = e
+                r.event.set()
+            self.stats.record_error()
+            return None, [], 0
+
+    def _batch_loop(self):
+        while True:
+            batch = self._take_batch()
+            if not batch:
+                if self._closed:
+                    self._inflight.put(None)    # collector sentinel
+                    return
+                continue
+            t0 = time.perf_counter()
+            X, batch, n = self._encode_batch(batch)
+            if not batch:
+                continue
+            t1 = time.perf_counter()
+            try:
+                out = self._dispatch(X, n)      # async device dispatch
+                t2 = time.perf_counter()
+            except BaseException as e:  # noqa: BLE001 — resolve waiters
+                for r in batch:
+                    r.error = e
+                    r.event.set()
+                self.stats.record_error()
+                continue
+            queue_ms = (t0 - min(r.t_enqueue for r in batch)) * 1e3
+            self._inflight.put(
+                (out, batch, n, X.shape[0],
+                 {"queue": queue_ms, "encode": (t1 - t0) * 1e3,
+                  "dispatch": (t2 - t1) * 1e3}))
+
+    # -- collector thread -----------------------------------------------
+
+    def _collect_loop(self):
+        while True:
+            item = self._inflight.get()
+            if item is None:
+                return
+            out, batch, n, padded, tms = item
+            t0 = time.perf_counter()
+            try:
+                host = np.asarray(out)          # blocks until ready
+                t1 = time.perf_counter()
+                decoded = self._decode(host, n)
+                t2 = time.perf_counter()
+            except BaseException as e:  # noqa: BLE001
+                for r in batch:
+                    r.error = e
+                    r.event.set()
+                self.stats.record_error()
+                continue
+            off = 0
+            for r in batch:
+                r.results = decoded[off: off + r.n]
+                off += r.n
+                r.event.set()
+            self.stats.record_batch(
+                n, padded,
+                {"queue": tms["queue"],
+                 "encode": tms["encode"],
+                 "device": tms["dispatch"] + (t1 - t0) * 1e3,
+                 "decode": (t2 - t1) * 1e3})
+
+    # -- lifecycle ------------------------------------------------------
+
+    @property
+    def pending_rows(self) -> int:
+        with self._mu:
+            return self._pending_rows
+
+    def close(self, timeout: float = 5.0):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._batch_thread.join(timeout)
+        self._collect_thread.join(timeout)
+        # resolve anything still queued
+        with self._cv:
+            while self._pending:
+                r = self._pending.popleft()
+                self._pending_rows -= r.n
+                r.error = ServeClosedError("deployment shut down")
+                r.event.set()

@@ -1,0 +1,80 @@
+"""Row codec: dict rows ⇄ padded device batches for the scoring service.
+
+Encode is the vectorized form of EasyPredictModelWrapper's RowData
+contract (genmodel.rows_to_matrix does the per-column work: enum-label
+LUTs, unknown-level→NA policy, missing→NA), writing straight into a
+bucket-padded float32 buffer so the batcher hands XLA one of the warm
+batch shapes. Decode mirrors Model.predict's output schema per row:
+regression → {"value"}, classification → {"label",
+"classProbabilities"} over the training response domain, with the same
+balance_classes probability un-correction the frame path applies —
+micro-batched predictions must be bit-identical to model.predict on the
+same rows.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from h2o3_tpu.genmodel import build_domain_luts, rows_to_matrix
+
+
+class RowCodec:
+    def __init__(self, model, convert_unknown_categorical_levels_to_na:
+                 bool = True):
+        self.columns = list(model.feature_names)
+        self.cat_domains = {k: tuple(v) for k, v in
+                            (model.cat_domains or {}).items()}
+        self.response_domain = list(model.response_domain or [])
+        self.nclasses = int(getattr(model, "nclasses", 1) or 1)
+        self.convert_unknown = bool(convert_unknown_categorical_levels_to_na)
+        self._luts = build_domain_luts(self.columns, self.cat_domains)
+        self.unknown_categorical_levels_seen: Dict[str, int] = {}
+        self._model = model
+
+    @property
+    def n_features(self) -> int:
+        return len(self.columns)
+
+    # -- encode ---------------------------------------------------------
+
+    def encode(self, rows: Sequence[Dict[str, Any]],
+               pad_to: Optional[int] = None) -> np.ndarray:
+        """[n rows] dicts → [pad_to or n, F] float32, NaN=NA. Pad rows
+        (beyond n) stay NaN — the scorer masks them by n_active."""
+        n = len(rows)
+        pad = int(pad_to or n)
+        if pad < n:
+            raise ValueError(f"pad_to={pad} < {n} rows")
+        out = np.full((pad, self.n_features), np.nan, np.float32)
+        rows_to_matrix(
+            rows, self.columns, self.cat_domains,
+            convert_unknown_categorical_levels_to_na=self.convert_unknown,
+            unknown_seen=self.unknown_categorical_levels_seen,
+            luts=self._luts, out=out)
+        return out
+
+    # -- decode ---------------------------------------------------------
+
+    def decode(self, scores: np.ndarray, n: int) -> List[Dict[str, Any]]:
+        """[padded(, K)] device output → n per-row prediction dicts
+        (EasyPredict AbstractPrediction shape)."""
+        scores = np.asarray(scores)[:n]
+        if self.nclasses <= 1:
+            return [{"value": float(v)} for v in scores.reshape(-1)]
+        # identical post-processing to Model.predict: probability
+        # un-correction for balance_classes, then argmax labels
+        probs = self._model._correct_probabilities(scores)
+        labels = np.argmax(probs, axis=1)
+        dom = self.response_domain or [str(k) for k in
+                                       range(self.nclasses)]
+        out = []
+        for i in range(n):
+            out.append({
+                "label": str(dom[int(labels[i])]),
+                "classProbabilities": {
+                    str(dom[k]): float(probs[i, k])
+                    for k in range(self.nclasses)},
+            })
+        return out

@@ -1,0 +1,123 @@
+"""Compile-cached device scoring: one jitted predict per batch bucket.
+
+The train path (PR 2) buys zero-recompile warm training with round-up
+chunk buckets + traced scalars; serving needs the same property on the
+REQUEST axis: every micro-batch pads to one of a fixed set of batch-size
+buckets (1/8/64/512/4096 by default) with the live-row count riding as a
+TRACED ``n_active`` scalar masking the tail — so the steady-state serve
+path compiles ZERO XLA modules no matter how request sizes mix, and
+deploy() pays the whole compile bill up front (per process; the
+persistent compile cache, cluster_boot.setup_compilation_cache, carries
+it across processes).
+
+Scoring dispatch is ASYNC: score() returns the un-fetched device array,
+so the batcher can encode batch k+1 while batch k runs on device; the
+collector thread blocks on the fetch.
+
+Models whose _predict_matrix does not trace (host-side numpy scorers)
+fall back to an unjitted batched call — same results, no compile cache.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+DEFAULT_BUCKETS: Tuple[int, ...] = (1, 8, 64, 512, 4096)
+
+
+class CompiledScorer:
+    def __init__(self, model, buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 warm: bool = True):
+        import jax
+        import jax.numpy as jnp
+        self.model = model
+        self.buckets = tuple(sorted({int(b) for b in buckets if int(b) > 0}))
+        if not self.buckets:
+            raise ValueError("at least one batch bucket is required")
+        self.n_features = len(model.feature_names)
+        self.nclasses = int(getattr(model, "nclasses", 1) or 1)
+        self.jitted = True
+        self.warm_seconds: Dict[int, float] = {}
+        # output contract probed at warm time (deploy-time validation):
+        # ndim and, for 2-D outputs, the class-axis width
+        self.out_ndim: Optional[int] = None
+        self.out_k: Optional[int] = None
+
+        def _predict(X, n_active):
+            out = jnp.asarray(model._predict_matrix(X))
+            mask = jnp.arange(X.shape[0]) < n_active
+            # pad rows are all-NA: their (garbage) scores are zeroed so
+            # nothing non-finite ever crosses the wire by accident
+            if out.ndim == 2:
+                return jnp.where(mask[:, None], out, 0.0)
+            return jnp.where(mask, out, 0.0)
+
+        self._fn = jax.jit(_predict)
+        if warm:
+            self.warm_all()
+
+    # -- warmup ---------------------------------------------------------
+
+    def warm_all(self) -> Dict[int, float]:
+        """Compile every bucket executable now (deploy-time cost); falls
+        back to the unjitted path if the model's predict does not
+        trace."""
+        import jax
+        for b in self.buckets:
+            if b in self.warm_seconds:
+                continue
+            X = np.full((b, self.n_features), np.nan, np.float32)
+            t0 = time.perf_counter()
+            try:
+                out = jax.block_until_ready(self._fn(X, 0))
+            except Exception:   # noqa: BLE001 — non-traceable model
+                self.jitted = False
+                model = self.model
+                self._fn = lambda X, n: np.asarray(
+                    model._predict_matrix(X))
+                self.warm_seconds = {bb: 0.0 for bb in self.buckets}
+                self._probe_output()
+                break
+            self.warm_seconds[b] = time.perf_counter() - t0
+            self._record_output_shape(out)
+        return self.warm_seconds
+
+    def _record_output_shape(self, out) -> None:
+        self.out_ndim = int(getattr(out, "ndim", 0) or 0)
+        self.out_k = int(out.shape[1]) if self.out_ndim == 2 else None
+
+    def _probe_output(self) -> None:
+        """One unjitted probe row so deploy can still validate the
+        output contract on the fallback path."""
+        try:
+            out = np.asarray(self._fn(
+                np.full((1, self.n_features), np.nan, np.float32), 0))
+        except Exception:   # noqa: BLE001 — leave unknown; decode guards
+            return
+        self._record_output_shape(out)
+
+    # -- scoring --------------------------------------------------------
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest warm bucket >= n (the batcher caps batches at
+        max(buckets), so every batch has one)."""
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise ValueError(f"batch of {n} rows exceeds the largest bucket "
+                         f"{self.buckets[-1]}")
+
+    @property
+    def max_batch(self) -> int:
+        return self.buckets[-1]
+
+    def score(self, X: np.ndarray, n_active: int):
+        """Dispatch one padded batch; returns the (possibly still
+        in-flight) result array — callers fetch with np.asarray."""
+        if X.shape[0] not in self.buckets and self.jitted:
+            raise ValueError(
+                f"batch shape {X.shape[0]} is not a warm bucket "
+                f"{self.buckets} — encode with pad_to=bucket_for(n)")
+        return self._fn(X, n_active)

@@ -1,0 +1,211 @@
+"""Model serving registry: deploy/undeploy/score/stats.
+
+deploy(key) pins the model in the DKV (shared read-lock, so DELETE
+/3/Models of a deployed model 409s instead of yanking weights out from
+under live traffic), pre-builds the row codec's enum LUTs, and warms
+one compiled predict executable per batch bucket — after deploy()
+returns, the steady-state scoring path compiles nothing.
+
+One Deployment per model key; re-deploying an already-deployed key with
+new knobs drains and replaces the old pipeline.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from h2o3_tpu.serve.batcher import (MicroBatcher, ServeBadRequestError,
+                                    ServeClosedError, ServeDeadlineError,
+                                    ServeError, ServeOverloadedError)
+from h2o3_tpu.serve.codec import RowCodec
+from h2o3_tpu.serve.registry import DEFAULT_BUCKETS, CompiledScorer
+from h2o3_tpu.serve.stats import ServeStats, merge_snapshots
+
+__all__ = ["deploy", "undeploy", "deployment", "deployments",
+           "predict_rows", "stats", "shutdown_all", "Deployment",
+           "ServeError", "ServeOverloadedError", "ServeDeadlineError",
+           "ServeBadRequestError", "ServeClosedError"]
+
+_DEPLOYMENTS: Dict[str, "Deployment"] = {}
+_LOCK = threading.Lock()
+
+
+class Deployment:
+    def __init__(self, key: str, model, *, max_batch: int = 512,
+                 max_delay_ms: float = 2.0, queue_limit: int = 8192,
+                 timeout_ms: float = 10_000.0,
+                 buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 warm: bool = True, pinned: bool = False):
+        if not hasattr(model, "_predict_matrix"):
+            raise ValueError(
+                f"model '{key}' has no batch predict path "
+                f"(_predict_matrix) — only trained h2o3_tpu models "
+                f"can be deployed")
+        if model.params.get("offset_column"):
+            raise ValueError(
+                "offset-trained models cannot be deployed for row "
+                "serving: rows carry no offset column")
+        buckets = tuple(sorted({int(b) for b in buckets}))
+        if max_batch > max(buckets):
+            raise ValueError(f"max_batch={max_batch} exceeds the largest "
+                             f"bucket {max(buckets)}")
+        # prune buckets bucket_for can never pick: batches cap at
+        # max_batch rows, so anything past the smallest bucket >=
+        # max_batch would only add dead warm-compile time + memory
+        cap = min(b for b in buckets if b >= max_batch)
+        buckets = tuple(b for b in buckets if b <= cap)
+        self.key = key
+        self.model = model
+        self.pinned = pinned                  # holds a DKV read-lock
+        self.created = time.time()
+        self.config = dict(max_batch=int(max_batch),
+                           max_delay_ms=float(max_delay_ms),
+                           queue_limit=int(queue_limit),
+                           timeout_ms=float(timeout_ms),
+                           buckets=list(buckets))
+        self.codec = RowCodec(model)
+        t0 = time.perf_counter()
+        self.scorer = CompiledScorer(model, buckets=buckets, warm=warm)
+        self.warm_seconds = time.perf_counter() - t0
+        # output-contract validation (warm probes recorded the shape):
+        # a classifier whose _predict_matrix yields a 1-D margin (its
+        # predict() override is the only valid scoring path, e.g.
+        # uplift) would crash decode on EVERY request — reject at
+        # deploy instead of 500ing live traffic
+        if warm and self.codec.nclasses > 1 \
+                and self.scorer.out_ndim is not None:
+            if self.scorer.out_ndim != 2 \
+                    or self.scorer.out_k != self.codec.nclasses:
+                raise ValueError(
+                    f"model '{key}' ({getattr(model, 'algo', '?')}) "
+                    f"declares {self.codec.nclasses} classes but its "
+                    f"batch predict returns "
+                    f"{self.scorer.out_ndim}-D/"
+                    f"{self.scorer.out_k}-wide output — this algo's "
+                    f"predict() override is not row-servable")
+        self.stats = ServeStats()
+        self.batcher = MicroBatcher(
+            encode=self.codec.encode, dispatch=self.scorer.score,
+            decode=self.codec.decode, stats=self.stats,
+            bucket_for=self.scorer.bucket_for, max_batch=max_batch,
+            max_delay_ms=max_delay_ms, queue_limit=queue_limit,
+            default_timeout_ms=timeout_ms)
+
+    def predict_rows(self, rows: Sequence[Dict[str, Any]],
+                     timeout_ms: Optional[float] = None
+                     ) -> List[Dict[str, Any]]:
+        """Score a list of row dicts through the micro-batcher. Requests
+        larger than max_batch are split — the slices pipeline through
+        consecutive ticks."""
+        mb = self.batcher.max_batch
+        if len(rows) <= mb:
+            return self.batcher.submit(rows, timeout_ms=timeout_ms)
+        out: List[Dict[str, Any]] = []
+        for s in range(0, len(rows), mb):
+            out.extend(self.batcher.submit(rows[s: s + mb],
+                                           timeout_ms=timeout_ms))
+        return out
+
+    def info(self) -> Dict[str, Any]:
+        return {"model": self.key,
+                "algo": getattr(self.model, "algo", "?"),
+                "nclasses": self.codec.nclasses,
+                "n_features": self.codec.n_features,
+                "compiled_buckets": list(self.scorer.buckets),
+                "jitted": self.scorer.jitted,
+                "warm_seconds": round(self.warm_seconds, 3),
+                "created": self.created,
+                **self.config}
+
+    def close(self):
+        self.batcher.close()
+
+
+def _pin_key(key: str) -> str:
+    return f"$serve_{key}"
+
+
+def deploy(model_key: str, model=None, **config) -> Deployment:
+    """Deploy a model for row serving. ``model`` may be passed directly
+    (embedded use: bench/tools); the DKV pin (shared read-lock blocking
+    DELETE /3/Models) is taken whenever the key is store-resident —
+    via lookup OR when the passed object IS the stored one (the
+    Model.deploy() Python path) — so the 409-until-undeploy contract
+    holds on every deploy spelling."""
+    from h2o3_tpu import dkv
+    # a live pinned deployment shares the $serve_<key> reader entry; a
+    # FAILED re-deploy must then leave the pin in place for it
+    existing = deployment(model_key)
+    already_pinned = existing is not None and existing.pinned
+    pinned = False
+    if model is None:
+        model = dkv.get_and_read_lock(model_key, "model", _pin_key(model_key))
+        pinned = True
+    else:
+        ent = dkv.get_opt(model_key)
+        if ent is not None and ent[0] == "model" and ent[1] is model:
+            dkv.read_lock(model_key, _pin_key(model_key))
+            pinned = True
+    try:
+        dep = Deployment(model_key, model, pinned=pinned, **config)
+    except BaseException:
+        if pinned and not already_pinned:
+            dkv.unlock(model_key, _pin_key(model_key))
+        raise
+    with _LOCK:
+        old = _DEPLOYMENTS.pop(model_key, None)
+        _DEPLOYMENTS[model_key] = dep
+    if old is not None:
+        old.close()
+        # both pinned: the shared read-lock entry is keyed by the same
+        # $serve_<key> job, so the new deployment simply inherits it
+        if old.pinned and not pinned:
+            dkv.unlock(model_key, _pin_key(model_key))
+    return dep
+
+
+def undeploy(model_key: str) -> bool:
+    from h2o3_tpu import dkv
+    with _LOCK:
+        dep = _DEPLOYMENTS.pop(model_key, None)
+    if dep is None:
+        return False
+    dep.close()
+    if dep.pinned:
+        dkv.unlock(model_key, _pin_key(model_key))
+    return True
+
+
+def deployment(model_key: str) -> Optional[Deployment]:
+    with _LOCK:
+        return _DEPLOYMENTS.get(model_key)
+
+
+def deployments() -> List[Deployment]:
+    with _LOCK:
+        return list(_DEPLOYMENTS.values())
+
+
+def predict_rows(model_key: str, rows: Sequence[Dict[str, Any]],
+                 timeout_ms: Optional[float] = None) -> List[Dict[str, Any]]:
+    dep = deployment(model_key)
+    if dep is None:
+        raise KeyError(f"model '{model_key}' is not deployed — POST "
+                       f"/3/Serve/models/{model_key} first")
+    return dep.predict_rows(rows, timeout_ms=timeout_ms)
+
+
+def stats() -> Dict[str, Any]:
+    per_model = {}
+    for dep in deployments():
+        per_model[dep.key] = {**dep.stats.snapshot(),
+                              "pending_rows": dep.batcher.pending_rows}
+    return {"models": per_model,
+            "total": merge_snapshots(list(per_model.values()))}
+
+
+def shutdown_all():
+    """Undeploy everything (test/interpreter teardown)."""
+    for dep in deployments():
+        undeploy(dep.key)

@@ -1,0 +1,130 @@
+"""Per-deployment serving telemetry: latency percentiles, stage
+attribution, queue/batch occupancy and request/error counters.
+
+The reference has no online-serving telemetry to mirror (h2o-3 scores
+frames, not request streams); the shape here follows what
+`/3/Serve/stats` needs to answer: is the path keeping its latency SLO
+(p50/p99), where does a request's time go (encode/queue/device/decode),
+and is the batcher actually coalescing (mean batch occupancy).
+
+Lock discipline: one mutex per ServeStats, every mutation is a single
+short critical section — this sits on the request hot path.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+# ring-buffer length for the latency reservoir: enough for stable p99
+# estimates over the recent window without unbounded growth
+_RESERVOIR = 4096
+
+STAGES = ("encode", "queue", "device", "decode")
+
+
+class ServeStats:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._lat_ms = np.zeros(_RESERVOIR, np.float64)
+        self._lat_n = 0            # total recorded (ring index = n % size)
+        self.requests = 0          # client-visible request count
+        self.rows = 0              # rows scored
+        self.batches = 0           # device batches dispatched
+        self.batch_rows = 0        # live rows across those batches
+        self.padded_rows = 0       # bucket-padded rows across them
+        self.errors = 0            # scoring failures surfaced to clients
+        self.timeouts = 0          # per-request deadline expiries
+        self.rejected = 0          # admission-control rejections (503)
+        self.stage_ms: Dict[str, float] = {s: 0.0 for s in STAGES}
+        self.queue_depth = 0       # rows currently admitted, not resolved
+
+    # -- mutation (hot path) -------------------------------------------
+
+    def record_request(self, latency_ms: float, rows: int):
+        with self._mu:
+            self._lat_ms[self._lat_n % _RESERVOIR] = latency_ms
+            self._lat_n += 1
+            self.requests += 1
+            self.rows += rows
+
+    def record_batch(self, live_rows: int, padded_rows: int,
+                     stage_ms: Dict[str, float]):
+        with self._mu:
+            self.batches += 1
+            self.batch_rows += live_rows
+            self.padded_rows += padded_rows
+            for s, v in stage_ms.items():
+                self.stage_ms[s] = self.stage_ms.get(s, 0.0) + v
+
+    def record_error(self):
+        with self._mu:
+            self.errors += 1
+
+    def record_timeout(self):
+        with self._mu:
+            self.timeouts += 1
+
+    def record_rejected(self):
+        with self._mu:
+            self.rejected += 1
+
+    def queue_delta(self, rows: int):
+        with self._mu:
+            self.queue_depth += rows
+
+    # -- snapshot -------------------------------------------------------
+
+    def percentiles_ms(self, qs: List[float]) -> List[Optional[float]]:
+        """All requested quantiles from ONE copy of the latency ring —
+        separate calls would sample different windows under concurrent
+        recording (a snapshot could then report p99 < p50)."""
+        with self._mu:
+            n = min(self._lat_n, _RESERVOIR)
+            window = self._lat_ms[:n].copy() if n else None
+        if window is None:
+            return [None] * len(qs)
+        return [float(np.percentile(window, q)) for q in qs]
+
+    def percentile_ms(self, q: float) -> Optional[float]:
+        return self.percentiles_ms([q])[0]
+
+    def snapshot(self) -> Dict:
+        p50, p99 = self.percentiles_ms([50, 99])
+        with self._mu:
+            occ = (self.batch_rows / self.batches) if self.batches else 0.0
+            pad_eff = (self.batch_rows / self.padded_rows) \
+                if self.padded_rows else 1.0
+            return {
+                "requests": self.requests,
+                "rows": self.rows,
+                "batches": self.batches,
+                "errors": self.errors,
+                "timeouts": self.timeouts,
+                "rejected": self.rejected,
+                "queue_depth": self.queue_depth,
+                "mean_batch_occupancy": round(occ, 3),
+                "bucket_fill": round(pad_eff, 4),
+                "p50_ms": None if p50 is None else round(p50, 3),
+                "p99_ms": None if p99 is None else round(p99, 3),
+                "stage_ms": {s: round(v, 3)
+                             for s, v in self.stage_ms.items()},
+            }
+
+
+def merge_snapshots(snaps: List[Dict]) -> Dict:
+    """Cluster-level rollup for /3/Serve/stats: counters sum; the
+    percentile fields do NOT aggregate across models (quantiles don't
+    add) and are left to the per-model entries."""
+    out = {"requests": 0, "rows": 0, "batches": 0, "errors": 0,
+           "timeouts": 0, "rejected": 0, "queue_depth": 0,
+           "stage_ms": {s: 0.0 for s in STAGES}}
+    for s in snaps:
+        for k in ("requests", "rows", "batches", "errors", "timeouts",
+                  "rejected", "queue_depth"):
+            out[k] += s.get(k, 0)
+        for st, v in (s.get("stage_ms") or {}).items():
+            out["stage_ms"][st] = out["stage_ms"].get(st, 0.0) + v
+    out["stage_ms"] = {s: round(v, 3) for s, v in out["stage_ms"].items()}
+    return out

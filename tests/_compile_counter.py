@@ -1,0 +1,29 @@
+"""Shared XLA compile-count harness (PR 2): context manager collecting
+one entry per backend compile via jax.monitoring — used by the
+zero-recompile guards in tests/test_train_perf.py (warm train path) and
+tests/test_serve.py (warm serve path)."""
+import contextlib
+
+
+@contextlib.contextmanager
+def count_compiles(out: list):
+    """Collect one entry per XLA backend compile (jax.monitoring)."""
+    import jax
+    from jax._src import monitoring as _monitoring
+
+    active = [True]
+
+    def listener(key, _dur, **_kw):
+        if active[0] and key.endswith("backend_compile_duration"):
+            out.append(key)
+
+    jax.monitoring.register_event_duration_secs_listener(listener)
+    try:
+        yield out
+    finally:
+        active[0] = False       # neutralize even if unregistering fails
+        unreg = getattr(_monitoring,
+                        "_unregister_event_duration_listener_by_callback",
+                        None)
+        if unreg is not None:   # private API — may vanish in a jax bump
+            unreg(listener)

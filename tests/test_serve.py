@@ -1,0 +1,514 @@
+"""Serving subsystem (ISSUE 3): micro-batched correctness vs
+model.predict, zero-recompile warm serve path, deadline/backpressure
+admission control, deploy/undeploy lifecycle over REST, the vectorized
+row codec's unknown-level policy, and the jobs-registry satellites."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu import dkv, serve
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+from _compile_counter import count_compiles  # noqa: E402 — shared harness
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _serve_cleanup():
+    yield
+    serve.shutdown_all()
+
+
+def _train_frame(n=2000, seed=0):
+    rng = np.random.default_rng(seed)
+    num = rng.normal(size=n).astype(np.float32)
+    num2 = rng.uniform(-2, 2, size=n).astype(np.float32)
+    carrier = rng.integers(0, 3, size=n)
+    logit = num * 1.2 - num2 + (carrier == 0) * 0.8
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit)))
+    fr = h2o.Frame.from_numpy({
+        "dist": num, "hour": num2,
+        "carrier": np.array(["AA", "UA", "DL"])[carrier],
+        "delayed": np.where(y, "YES", "NO")})
+    return fr
+
+
+def _rows_of(fr, idx):
+    rows = []
+    for i in idx:
+        rows.append({"dist": float(fr.vec("dist").to_numpy()[i]),
+                     "hour": float(fr.vec("hour").to_numpy()[i]),
+                     "carrier": fr.vec("carrier").to_strings()[i]})
+    return rows
+
+
+@pytest.fixture(scope="module")
+def gbm_model():
+    fr = _train_frame()
+    g = H2OGradientBoostingEstimator(ntrees=10, max_depth=3, seed=1,
+                                     min_rows=1.0)
+    g.train(y="delayed", training_frame=fr)
+    g.model.key = "serve_gbm"
+    return fr, g.model
+
+
+# ------------------------------------------------ correctness + parity
+
+
+def test_microbatched_predictions_bit_match_predict(gbm_model):
+    fr, model = gbm_model
+    dep = serve.deploy("serve_gbm", model=model,
+                       buckets=(1, 8, 64), max_batch=64, max_delay_ms=1.0)
+    try:
+        idx = list(range(200))
+        rows = _rows_of(fr, idx)
+        ref = model.predict(fr.rows(np.asarray(idx)))
+        ref_p = {d: np.asarray(ref.vec(f"p{d}").to_numpy())[:len(idx)]
+                 for d in model.response_domain}
+        ref_lbl = [ref.vec("predict").to_strings()[i]
+                   for i in range(len(idx))]
+
+        # N concurrent clients × M rows each through the micro-batcher
+        per = 20
+        outs = {}
+        errs = []
+
+        def client(ci):
+            try:
+                outs[ci] = dep.predict_rows(rows[ci * per: (ci + 1) * per])
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(len(rows) // per)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        preds = [p for ci in range(len(threads)) for p in outs[ci]]
+        assert len(preds) == len(idx)
+        for i, p in enumerate(preds):
+            assert p["label"] == ref_lbl[i]
+            for d in model.response_domain:
+                # acceptance bar: BIT-identical to model.predict
+                assert p["classProbabilities"][d] == float(ref_p[d][i]), \
+                    (i, d, p, float(ref_p[d][i]))
+        # the batcher actually coalesced concurrent clients
+        snap = dep.stats.snapshot()
+        assert snap["rows"] == len(idx)
+        assert snap["batches"] >= 1
+    finally:
+        serve.undeploy("serve_gbm")
+
+
+def test_warm_serve_path_zero_recompiles_mixed_batch_sizes(gbm_model):
+    fr, model = gbm_model
+    dep = serve.deploy("serve_gbm", model=model,
+                       buckets=(1, 8, 64), max_batch=64, max_delay_ms=0.5)
+    try:
+        rows = _rows_of(fr, range(64))
+        dep.predict_rows(rows[:2])   # settle any lazy first-use host work
+        events = []
+        with count_compiles(events):
+            for n in (1, 3, 8, 17, 64, 5, 1, 33):
+                got = dep.predict_rows(rows[:n])
+                assert len(got) == n
+        assert len(events) == 0, \
+            f"warm serve path compiled {len(events)} modules"
+        assert dep.scorer.jitted
+        assert set(dep.scorer.warm_seconds) == {1, 8, 64}
+    finally:
+        serve.undeploy("serve_gbm")
+
+
+def test_unknown_levels_and_missing_columns_na(gbm_model):
+    fr, model = gbm_model
+    dep = serve.deploy("serve_gbm", model=model, buckets=(1, 8),
+                       max_batch=8)
+    try:
+        # unknown carrier level + missing column both map to NA and
+        # still score (EasyPredict RowData contract)
+        out = dep.predict_rows([{"dist": 500.0, "carrier": "ZZ"},
+                                {"dist": 500.0}])
+        assert len(out) == 2
+        for p in out:
+            s = sum(p["classProbabilities"].values())
+            assert abs(s - 1.0) < 1e-6
+        assert dep.codec.unknown_categorical_levels_seen.get("carrier") == 1
+    finally:
+        serve.undeploy("serve_gbm")
+
+
+def test_bad_row_fails_only_its_own_request(gbm_model):
+    """One client's malformed row must not poison the other requests
+    coalesced into the same tick — it resolves with a 400-mappable
+    ServeBadRequestError while innocents score normally."""
+    fr, model = gbm_model
+    dep = serve.deploy("serve_gbm", model=model, buckets=(1, 8, 64),
+                       max_batch=64, max_delay_ms=30.0)
+    try:
+        good_rows = _rows_of(fr, range(3))
+        results = {}
+
+        def client(name, rows):
+            try:
+                results[name] = dep.predict_rows(rows)
+            except Exception as e:  # noqa: BLE001
+                results[name] = e
+
+        threads = [
+            threading.Thread(target=client, args=("good", good_rows)),
+            threading.Thread(target=client,
+                             args=("bad", [{"dist": "not-a-number"}])),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert isinstance(results["bad"], serve.ServeBadRequestError)
+        assert serve.ServeBadRequestError.http_status == 400
+        assert isinstance(results["good"], list) and \
+            len(results["good"]) == 3
+    finally:
+        serve.undeploy("serve_gbm")
+
+
+def test_python_deploy_pins_store_resident_model(gbm_model):
+    """Model.deploy() must take the same DKV pin as the REST path when
+    the model lives in the store — and a FAILED re-deploy must not drop
+    the live deployment's pin."""
+    fr, model = gbm_model
+    dkv.put("serve_gbm", "model", model)
+    try:
+        dep = model.deploy(buckets=(1, 8), max_batch=8)
+        with pytest.raises(dkv.KeyLockedError):
+            dkv.check_unlocked("serve_gbm")      # pinned
+        # bad re-deploy config fails WITHOUT unpinning the live one
+        with pytest.raises(ValueError, match="max_batch"):
+            serve.deploy("serve_gbm", max_batch=9999, buckets=(1, 8))
+        with pytest.raises(dkv.KeyLockedError):
+            dkv.check_unlocked("serve_gbm")      # still pinned
+        assert serve.deployment("serve_gbm") is dep
+        assert dep.predict_rows([{"dist": 1.0}])  # still serving
+        serve.undeploy("serve_gbm")
+        dkv.check_unlocked("serve_gbm")          # pin released
+    finally:
+        serve.undeploy("serve_gbm")
+        dkv.remove("serve_gbm")
+
+
+def test_deploy_rejects_one_dim_classifier_output():
+    """A model declaring K>1 classes whose batch predict yields a 1-D
+    margin (uplift-style: predict() override is the only scoring path)
+    must be rejected at deploy, not 500 on every request."""
+    class FakeUplift:
+        algo = "upliftdrf"
+        feature_names = ["a", "b"]
+        cat_domains = {}
+        response_domain = ("0", "1")
+        nclasses = 2
+        params = {}
+
+        def _predict_matrix(self, X, offset=None):
+            import jax.numpy as jnp
+            return jnp.zeros(X.shape[0])         # 1-D uplift margin
+
+    with pytest.raises(ValueError, match="not row-servable"):
+        serve.deploy("fake_uplift", model=FakeUplift(), buckets=(1, 8),
+                     max_batch=8)
+    assert serve.deployment("fake_uplift") is None
+
+
+def test_deploy_prunes_buckets_beyond_max_batch(gbm_model):
+    fr, model = gbm_model
+    dep = serve.deploy("serve_gbm", model=model, max_batch=64)
+    try:
+        # default bucket set is 1/8/64/512/4096; batches cap at 64 rows,
+        # so the unreachable 512/4096 executables are never compiled
+        assert dep.info()["compiled_buckets"] == [1, 8, 64]
+    finally:
+        serve.undeploy("serve_gbm")
+
+
+# --------------------------------------------- admission control / deadlines
+
+
+def _gated_batcher(gate, stats=None, **kw):
+    from h2o3_tpu.serve.batcher import MicroBatcher
+    from h2o3_tpu.serve.stats import ServeStats
+
+    def encode(rows, pad):
+        X = np.zeros((pad, 1), np.float32)
+        X[: len(rows), 0] = [r["x"] for r in rows]
+        return X
+
+    def dispatch(X, n):
+        gate.wait()
+        return X[:, 0] * 2.0
+
+    def decode(scores, n):
+        return [{"value": float(v)} for v in np.asarray(scores)[:n]]
+
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_delay_ms", 1.0)
+    return MicroBatcher(encode=encode, dispatch=dispatch, decode=decode,
+                        stats=stats or ServeStats(),
+                        bucket_for=lambda n: kw["max_batch"], **kw)
+
+
+def test_deadline_expiry_raises_and_counts():
+    from h2o3_tpu.serve.batcher import ServeDeadlineError
+    from h2o3_tpu.serve.stats import ServeStats
+    gate = threading.Event()          # closed: device "hangs"
+    stats = ServeStats()
+    mb = _gated_batcher(gate, stats=stats)
+    try:
+        with pytest.raises(ServeDeadlineError):
+            mb.submit([{"x": 1.0}], timeout_ms=80)
+        assert stats.snapshot()["timeouts"] == 1
+    finally:
+        gate.set()
+        mb.close()
+
+
+def test_queue_backpressure_rejects_with_503():
+    from h2o3_tpu.serve.batcher import (ServeOverloadedError,
+                                        ServeDeadlineError)
+    from h2o3_tpu.serve.stats import ServeStats
+    gate = threading.Event()          # closed: the first batch blocks
+    stats = ServeStats()
+    mb = _gated_batcher(gate, stats=stats, max_batch=2, queue_limit=4)
+    results = {}
+
+    def bg(i):
+        try:
+            results[i] = mb.submit([{"x": float(i)}, {"x": float(i)}],
+                                   timeout_ms=10_000)
+        except Exception as e:  # noqa: BLE001
+            results[i] = e
+
+    try:
+        t0 = threading.Thread(target=bg, args=(0,))
+        t0.start()
+        # wait until the batcher picked request 0 and is blocked in
+        # dispatch (pending drains to 0)
+        for _ in range(200):
+            if mb.pending_rows == 0 and stats.queue_depth >= 2:
+                break
+            time.sleep(0.005)
+        threads = [threading.Thread(target=bg, args=(i,))
+                   for i in (1, 2)]
+        for t in threads:
+            t.start()
+        for _ in range(200):           # queue now holds 4 rows (limit)
+            if mb.pending_rows == 4:
+                break
+            time.sleep(0.005)
+        assert mb.pending_rows == 4
+        with pytest.raises(ServeOverloadedError):
+            mb.submit([{"x": 9.0}], timeout_ms=1_000)
+        assert stats.snapshot()["rejected"] == 1
+        assert serve.ServeOverloadedError.http_status == 503
+        assert serve.ServeDeadlineError is ServeDeadlineError
+        gate.set()                    # release the device
+        t0.join(5)
+        for t in threads:
+            t.join(5)
+        for i in (0, 1, 2):
+            assert isinstance(results[i], list), results[i]
+            assert results[i][0]["value"] == 2.0 * i
+    finally:
+        gate.set()
+        mb.close()
+
+
+def test_batcher_coalesces_within_tick():
+    gate = threading.Event()
+    gate.set()                         # device immediate
+    from h2o3_tpu.serve.stats import ServeStats
+    stats = ServeStats()
+    mb = _gated_batcher(gate, stats=stats, max_batch=8, max_delay_ms=30.0)
+    try:
+        outs = []
+        threads = [threading.Thread(
+            target=lambda i=i: outs.append(
+                mb.submit([{"x": float(i)}])[0]["value"]))
+            for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = stats.snapshot()
+        assert sorted(outs) == [0.0, 2.0, 4.0, 6.0]
+        # 4 concurrent 1-row requests within one 30ms tick → far fewer
+        # batches than requests
+        assert snap["batches"] <= 2, snap
+        assert snap["mean_batch_occupancy"] >= 2.0, snap
+    finally:
+        mb.close()
+
+
+# ------------------------------------------------------------ REST surface
+
+
+@pytest.fixture(scope="module")
+def server(gbm_model):
+    from h2o3_tpu.api import start_server
+    fr, model = gbm_model
+    dkv.put("serve_gbm", "model", model)
+    srv = start_server(port=0)
+    yield srv
+    srv.stop()
+    serve.shutdown_all()
+    dkv.clear()
+
+
+def _req(server, method, path, data=None, raw_json=None):
+    url = f"http://127.0.0.1:{server.port}{path}"
+    body = None
+    headers = {}
+    if raw_json is not None:
+        body = json.dumps(raw_json).encode()
+        headers["Content-Type"] = "application/json"
+    elif data is not None:
+        body = urllib.parse.urlencode(
+            {k: (json.dumps(v) if isinstance(v, (list, dict)) else v)
+             for k, v in data.items()}).encode()
+        headers["Content-Type"] = "application/x-www-form-urlencoded"
+    req = urllib.request.Request(url, data=body, method=method,
+                                 headers=headers)
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read().decode())
+
+
+def test_rest_deploy_score_stats_undeploy_lifecycle(server, gbm_model):
+    fr, model = gbm_model
+    # deploy with knobs
+    dep = _req(server, "POST", "/3/Serve/models/serve_gbm",
+               data={"max_batch": 64, "max_delay_ms": 1.0,
+                     "buckets": [1, 8, 64]})
+    assert dep["model_id"]["name"] == "serve_gbm"
+    assert dep["compiled_buckets"] == [1, 8, 64]
+    # listed
+    lst = _req(server, "GET", "/3/Serve/models")
+    assert [d["model"] for d in lst["deployments"]] == ["serve_gbm"]
+    # a deployed model's DKV key is pinned: DELETE → 409
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(server, "DELETE", "/3/Models/serve_gbm")
+    assert ei.value.code == 409
+    # score rows (JSON body)
+    rows = _rows_of(fr, range(5))
+    out = _req(server, "POST", "/3/Predictions/models/serve_gbm/rows",
+               raw_json={"rows": rows})
+    assert len(out["predictions"]) == 5
+    p0 = out["predictions"][0]
+    assert p0["label"] in ("YES", "NO")
+    assert set(p0["classProbabilities"]) == {"YES", "NO"}
+    # stats surface
+    st = _req(server, "GET", "/3/Serve/stats")
+    ms = st["models"]["serve_gbm"]
+    assert ms["rows"] >= 5 and ms["requests"] >= 1
+    assert ms["p99_ms"] is not None and ms["p99_ms"] >= ms["p50_ms"]
+    assert set(ms["stage_ms"]) >= {"encode", "queue", "device", "decode"}
+    # undeploy → scoring 404s with guidance, model deletable again
+    _req(server, "DELETE", "/3/Serve/models/serve_gbm")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(server, "POST", "/3/Predictions/models/serve_gbm/rows",
+             raw_json={"rows": rows})
+    assert ei.value.code == 404
+    _req(server, "DELETE", "/3/Models/serve_gbm")
+
+
+def test_rest_deploy_unknown_model_404(server):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(server, "POST", "/3/Serve/models/not_a_model")
+    assert ei.value.code == 404
+
+
+# ------------------------------------------------- vectorized row codec
+
+
+def test_rows_to_matrix_unknown_int_codes_honor_policy():
+    from h2o3_tpu.genmodel import rows_to_matrix
+    cols = ["c", "x"]
+    doms = {"c": ("a", "b", "c")}
+    seen = {}
+    m = rows_to_matrix([{"c": "b", "x": 1.5},
+                        {"c": "zz", "x": None},        # unknown label
+                        {"c": 7, "x": 2.0},            # int code OOB
+                        {"c": 2.0, "x": "3.5"},        # valid int code
+                        {"c": 1.5, "x": 4.0}],         # non-integral code
+                       cols, doms, unknown_seen=seen)
+    assert m[0, 0] == 1.0 and m[0, 1] == 1.5
+    assert np.isnan(m[1, 0]) and np.isnan(m[1, 1])
+    assert np.isnan(m[2, 0])                 # OOB int code → NA (fixed)
+    assert m[3, 0] == 2.0 and m[3, 1] == 3.5
+    assert np.isnan(m[4, 0])                 # non-integral code → NA
+    assert seen == {"c": 3}
+    # strict mode raises on the same inputs
+    with pytest.raises(ValueError, match="unknown categorical"):
+        rows_to_matrix([{"c": 7}], cols, doms,
+                       convert_unknown_categorical_levels_to_na=False)
+
+
+def test_easypredict_row_matches_rows_to_matrix(gbm_model):
+    fr, model = gbm_model
+    from h2o3_tpu.genmodel import EasyPredictModelWrapper, rows_to_matrix
+    wrap = EasyPredictModelWrapper(model)
+    rows = _rows_of(fr, range(7))
+    rows[3]["carrier"] = "??"            # unknown level
+    del rows[5]["hour"]                  # missing column
+    batch = rows_to_matrix(rows, wrap.columns, wrap.cat_domains)
+    for i, r in enumerate(rows):
+        single = wrap._row_to_array(r)
+        assert np.array_equal(single, batch[i], equal_nan=True)
+    assert wrap.unknown_categorical_levels_seen == {"carrier": 1}
+
+
+# ------------------------------------------------------ jobs satellites
+
+
+def test_job_update_is_thread_safe():
+    from h2o3_tpu.jobs import Job
+    job = Job("race", work=10_000.0)
+    threads = [threading.Thread(
+        target=lambda: [job.update(1.0) for _ in range(1000)])
+        for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert job._worked == 8000.0         # lost updates would undershoot
+    assert abs(job.progress - 0.8) < 1e-9
+
+
+def test_job_registry_evicts_terminal_beyond_keep(monkeypatch):
+    from h2o3_tpu import jobs as jobs_mod
+    monkeypatch.setenv("H2O3_JOBS_KEEP", "5")
+    live = jobs_mod.Job("live one")      # RUNNING — never evicted
+    done = []
+    for i in range(12):
+        j = jobs_mod.Job(f"t{i}")
+        j.run(lambda _j: None)           # terminal (DONE)
+        done.append(j)
+    # the oldest terminal jobs are gone, the newest stay (eviction rides
+    # on registration, so the LAST job to finish can make it keep+1)
+    assert jobs_mod.get_job(live.key) is live
+    assert jobs_mod.get_job(done[0].key) is None
+    remaining = [j for j in done if jobs_mod.get_job(j.key) is not None]
+    assert 0 < len(remaining) <= 6
+    assert remaining[-1] is done[-1]
+    # the registry stays bounded under mass churn; running jobs survive
+    for i in range(20):
+        jobs_mod.Job(f"u{i}").run(lambda _j: None)
+    terminal = [j for j in jobs_mod.list_jobs()
+                if j.status != jobs_mod.RUNNING]
+    assert len(terminal) <= 6
+    assert jobs_mod.get_job(live.key) is live

@@ -15,37 +15,13 @@ contract.
 - the default train path never device_gets anything within 2x of the
   full X matrix (the old global-sketch path fetched all of X).
 """
-import contextlib
-
 import numpy as np
 import pytest
 
 import h2o3_tpu as h2o
 from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
 
-
-@contextlib.contextmanager
-def count_compiles(out: list):
-    """Collect one entry per XLA backend compile (jax.monitoring)."""
-    import jax
-    from jax._src import monitoring as _monitoring
-
-    active = [True]
-
-    def listener(key, _dur, **_kw):
-        if active[0] and key.endswith("backend_compile_duration"):
-            out.append(key)
-
-    jax.monitoring.register_event_duration_secs_listener(listener)
-    try:
-        yield out
-    finally:
-        active[0] = False       # neutralize even if unregistering fails
-        unreg = getattr(_monitoring,
-                        "_unregister_event_duration_listener_by_callback",
-                        None)
-        if unreg is not None:   # private API — may vanish in a jax bump
-            unreg(listener)
+from _compile_counter import count_compiles  # noqa: E402 — shared harness
 
 
 # --------------------------------------------------- device sketch parity

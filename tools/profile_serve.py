@@ -1,0 +1,117 @@
+"""Serving-path stage profiler: where does a scored row's time go?
+
+Trains a small GBM, deploys it (h2o3_tpu.serve), drives a mixed
+single-row + batched load through the micro-batcher, and prints the
+stage attribution the batcher records per batch:
+
+  encode  — dict rows → padded float32 matrix (RowCodec / rows_to_matrix)
+  queue   — first-enqueue → batch pick-up (the micro-batching tick)
+  device  — dispatch + device execution + result fetch
+  decode  — host scores → per-row prediction dicts
+
+plus deploy-time warm-compile cost per batch bucket. One JSON line on
+stdout (same contract as tools/profile_train.py / profile_ingest.py).
+
+Knobs: H2O3_SERVE_PROF_ROWS (train rows, default 50k),
+H2O3_SERVE_PROF_REQUESTS (single-row requests, default 500),
+H2O3_SERVE_PROF_BATCH (batched request size, default 512).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    import h2o3_tpu as h2o
+    from h2o3_tpu import serve
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    rows_n = int(os.environ.get("H2O3_SERVE_PROF_ROWS", 50_000))
+    n_req = int(os.environ.get("H2O3_SERVE_PROF_REQUESTS", 500))
+    bsz = int(os.environ.get("H2O3_SERVE_PROF_BATCH", 512))
+    rng = np.random.default_rng(7)
+    F = 12
+    X = rng.normal(size=(rows_n, F)).astype(np.float32)
+    y = (X[:, 0] - X[:, 1] + 0.3 * rng.normal(size=rows_n) > 0)
+    cols = {f"f{i}": X[:, i] for i in range(F)}
+    cols["label"] = np.where(y, "YES", "NO")
+    fr = h2o.Frame.from_numpy(cols)
+
+    gbm = H2OGradientBoostingEstimator(ntrees=20, max_depth=5, seed=1)
+    t0 = time.time()
+    gbm.train(y="label", training_frame=fr)
+    log(f"trained in {time.time() - t0:.1f}s")
+    model = gbm.model
+    model.key = "profile_serve_gbm"
+
+    t0 = time.time()
+    dep = serve.deploy(model.key, model=model, max_batch=4096,
+                       max_delay_ms=1.0, queue_limit=65536)
+    deploy_s = time.time() - t0
+    log(f"deployed in {deploy_s:.2f}s; per-bucket warm compile: "
+        f"{ {b: round(s, 3) for b, s in dep.scorer.warm_seconds.items()} }")
+
+    names = [f"f{i}" for i in range(F)]
+    pool = [{n: float(X[i, j]) for j, n in enumerate(names)}
+            for i in range(min(rows_n, 8192))]
+
+    # phase 1: sequential single-row requests (latency path)
+    for i in range(n_req):
+        dep.predict_rows([pool[i % len(pool)]])
+    single = dep.stats.snapshot()
+
+    # phase 2: batched requests (throughput path) — fresh stage counters
+    # come from the delta against phase 1's snapshot
+    t0 = time.time()
+    n_batches = 32
+    for i in range(n_batches):
+        dep.predict_rows(pool[:bsz])
+    batch_wall = time.time() - t0
+    total = dep.stats.snapshot()
+
+    def stage_split(snap, rows):
+        ms = snap["stage_ms"]
+        tot = sum(ms.values()) or 1.0
+        return {s: {"ms_total": round(v, 2),
+                    "share": round(v / tot, 4),
+                    "us_per_row": round(1e3 * v / max(rows, 1), 2)}
+                for s, v in ms.items()}
+
+    batch_stage = {s: total["stage_ms"][s] - single["stage_ms"][s]
+                   for s in total["stage_ms"]}
+    batch_rows = total["rows"] - single["rows"]
+    out = {
+        "metric": "serve_stage_profile",
+        "deploy_seconds": round(deploy_s, 3),
+        "warm_compile_seconds": {
+            str(b): round(s, 3)
+            for b, s in dep.scorer.warm_seconds.items()},
+        "single_row": {
+            "requests": n_req,
+            "p50_ms": single["p50_ms"], "p99_ms": single["p99_ms"],
+            "stages": stage_split(single, single["rows"]),
+        },
+        "batched": {
+            "batch_size": bsz, "batches": n_batches,
+            "rows_per_sec": round(batch_rows / max(batch_wall, 1e-9), 1),
+            "stages": {s: round(v, 2) for s, v in batch_stage.items()},
+            "us_per_row": {s: round(1e3 * v / max(batch_rows, 1), 2)
+                           for s, v in batch_stage.items()},
+        },
+        "bucket_fill": total["bucket_fill"],
+    }
+    serve.undeploy(model.key)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
