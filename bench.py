@@ -180,16 +180,45 @@ def _serve_round(model, fr, F):
         serve.undeploy(model.key)
 
 
+def _telemetry_counts():
+    """Cumulative telemetry counters (ISSUE 4): diff two calls to
+    attribute compiles / cache traffic / transfer bytes to a bench
+    phase. Peak device memory is sampled (and folded into the peak
+    gauge) at each call so the recorded peak covers the whole round."""
+    from h2o3_tpu import telemetry
+    mem = telemetry.sample_device_memory()
+    reg = telemetry.registry()
+    return {
+        "compiles": reg.value("h2o3_xla_compiles_total"),
+        "cache_hits": reg.value("h2o3_compile_cache_hits_total"),
+        "cache_misses": reg.value("h2o3_compile_cache_misses_total"),
+        "h2d_bytes": reg.value("h2o3_h2d_bytes_total"),
+        "d2h_bytes": reg.value("h2o3_d2h_bytes_total"),
+        "peak_device_bytes": mem["peak"] if mem["peak"] is not None
+        else reg.value("h2o3_device_peak_bytes"),
+    }
+
+
+def _telemetry_delta(a, b):
+    return {k: round(b[k] - a[k]) for k in
+            ("compiles", "cache_hits", "cache_misses",
+             "h2d_bytes", "d2h_bytes")}
+
+
 def main():
     import h2o3_tpu as h2o
+    from h2o3_tpu import telemetry
     from h2o3_tpu.cluster_boot import setup_compilation_cache
     from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
     import jax
 
     # persistent XLA compile cache: the SECOND process run of this bench
     # skips the cold spec/compile entirely (H2O3_COMPILE_CACHE_DIR knob;
-    # time_to_first_model_s below tracks the win per round)
+    # time_to_first_model_s below tracks the win per round).
+    # setup_compilation_cache also installs the telemetry collectors, so
+    # the compile/cache/transfer counters below see the whole round.
     cache_dir = setup_compilation_cache()
+    tel0 = _telemetry_counts()
     log(f"devices: {jax.devices()}  backend: {jax.default_backend()}  "
         f"compile_cache: {cache_dir}")
     ingest_s = None
@@ -213,10 +242,12 @@ def main():
     # compile + train + metrics included — the cold-start number the
     # persistent compile cache attacks (second process run skips the
     # compile share)
+    tel_ingest = _telemetry_counts()
     warm = H2OGradientBoostingEstimator(ntrees=TREES, **common)
     t_cold0 = time.time()
     warm.train(y="label", training_frame=fr)
     time_to_first_model = time.time() - t_cold0
+    tel_cold = _telemetry_counts()
     log(f"warmup done in {time_to_first_model:.2f}s; "
         f"warm loop {warm.model.output['training_loop_seconds']:.2f}s "
         f"profile={warm.model.output.get('train_profile')}")
@@ -225,6 +256,7 @@ def main():
     t0 = time.time()
     gbm.train(y="label", training_frame=fr)
     total = time.time() - t0
+    tel_warm = _telemetry_counts()
     loop_s = gbm.model.output["training_loop_seconds"]
     built = gbm.model.ntrees_built
     rows_per_sec = ROWS * built / loop_s
@@ -258,6 +290,7 @@ def main():
             log(f"bf16 guard FAILED to run: {e!r}")
 
     serve_out = None
+    tel_serve0 = _telemetry_counts()
     if os.environ.get("H2O3_BENCH_SERVE", "1") not in ("0", "false", ""):
         try:
             serve_out = _serve_round(gbm.model, fr, F)
@@ -280,6 +313,34 @@ def main():
         "warm_train_s": round(total, 2),
         "loop_s": round(loop_s, 2),
     }
+    # per-round telemetry (ISSUE 4): compile count and transfer volume
+    # regressions are now tracked in BENCH_*.json, not just wall time.
+    # warm_train.compiles is the headline — the zero-recompile contract.
+    # With H2O3_TELEMETRY=0 (the overhead-check mode) every counter reads
+    # 0 — record that the data is ABSENT, never a fake zero-compile pass.
+    if not telemetry.enabled():
+        out["telemetry"] = {"enabled": False}
+        log("telemetry disabled (H2O3_TELEMETRY=0): no counters recorded")
+    else:
+        tel_end = _telemetry_counts()
+        out["telemetry"] = {
+            "total": _telemetry_delta(tel0, tel_end),
+            "ingest": _telemetry_delta(tel0, tel_ingest),
+            "cold_train": _telemetry_delta(tel_ingest, tel_cold),
+            "warm_train": _telemetry_delta(tel_cold, tel_warm),
+            # a skipped/failed serve round records NO serve delta — an
+            # all-zero entry would read as a passing zero-compile round
+            "serve": (_telemetry_delta(tel_serve0, tel_end)
+                      if serve_out is not None else None),
+            "peak_device_bytes": tel_end["peak_device_bytes"],
+        }
+        serve_compiles = (out["telemetry"]["serve"] or {}).get("compiles")
+        log(f"telemetry: warm_train_compiles="
+            f"{out['telemetry']['warm_train']['compiles']} "
+            f"serve_compiles={serve_compiles} "
+            f"h2d={out['telemetry']['total']['h2d_bytes']:,} "
+            f"d2h={out['telemetry']['total']['d2h_bytes']:,} "
+            f"peak_dev={out['telemetry']['peak_device_bytes']}")
     if serve_out is not None:
         # online-serving round (h2o3_tpu.serve): single-row latency
         # percentiles through the micro-batcher + saturated batched

@@ -1655,6 +1655,10 @@ class H2OApiServer:
     """Embedded API server (the h2o.jar web server analog)."""
 
     def __init__(self, port: int = 54321, host: str = "127.0.0.1"):
+        # any process that serves REST serves /metrics — make sure the
+        # XLA compile/cache listeners are live before the first scrape
+        from h2o3_tpu import telemetry
+        telemetry.install()
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.port = self.httpd.server_address[1]
         self.host = host
@@ -1686,10 +1690,65 @@ def _logs(params, body):
 
 @route("GET", "/3/Timeline")
 def _timeline(params, body):
-    """water/TimeLine.java ring-buffer snapshot (/3/Timeline)."""
+    """water/TimeLine.java ring-buffer snapshot (/3/Timeline).
+
+    Default: the H2O event shape Flow expects — TimelineV3 has no
+    nodeidx path parameter (water/api/TimelineHandler serves the whole
+    cloud's merged ring); each event carries the EventV3 fields
+    (date/nanos/who/io_flavor/event/bytes). The ring is now fed by
+    every pipeline's finished ROOT telemetry spans (ingest.parse,
+    train.*, serve.request/batch), not just model builds.
+
+    ``?format=trace``: Chrome-trace/Perfetto JSON of the finished-span
+    ring — the accelerator-aware timeline the JVM tools never had."""
+    from h2o3_tpu import telemetry
+    if (params.get("format") or "").lower() in ("trace", "perfetto",
+                                                "chrome"):
+        limit = int(params.get("n", 0) or 0) or None
+        return {"__raw": telemetry.chrome_trace_bytes(limit),
+                "__content_type": "application/json"}
     from h2o3_tpu.log import timeline_events
+    evs = timeline_events(int(params.get("n", 2048) or 2048))
+    out = []
+    for e in evs:
+        ts = float(e.get("ts", 0.0))
+        out.append({
+            "date": time.strftime("%Y-%m-%d %H:%M:%S",
+                                  time.localtime(ts)),
+            "nanos": int(ts * 1e9),
+            "who": "tpu-controller/0",
+            "io_flavor": None,
+            "event": e.get("kind", ""),
+            "bytes": e.get("detail", ""),
+            # legacy keys kept for the built-in Flow page
+            "ts": ts, "kind": e.get("kind", ""),
+            "detail": e.get("detail", ""),
+        })
     return {"__meta": {"schema_version": 3, "schema_name": "TimelineV3"},
-            "events": timeline_events(int(params.get("n", 2048) or 2048))}
+            "now": int(time.time() * 1000), "self": "tpu-controller/0",
+            "events": out}
+
+
+@route("GET", "/metrics")
+def _metrics(params, body):
+    """Prometheus exposition of the process-wide telemetry registry
+    (text format 0.0.4) — counters/gauges/histograms from every
+    pipeline plus the XLA compile/cache/transfer collectors."""
+    from h2o3_tpu import telemetry
+    telemetry.install()
+    return {"__raw": telemetry.prometheus_text().encode(),
+            "__content_type": "text/plain; version=0.0.4; charset=utf-8"}
+
+
+@route("GET", "/3/Telemetry")
+def _telemetry_snapshot(params, body):
+    """H2O-style JSON snapshot of the same registry /metrics exports:
+    flat metric map, per-span stage aggregates, device memory, compile
+    and transfer counters."""
+    from h2o3_tpu import telemetry
+    telemetry.install()
+    return {"__meta": {"schema_version": 3, "schema_name": "TelemetryV3"},
+            **telemetry.telemetry_snapshot()}
 
 
 @route("GET", "/3/Profiler")

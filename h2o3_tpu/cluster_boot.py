@@ -44,6 +44,11 @@ def setup_compilation_cache(env: Optional[Mapping[str, str]] = None) -> Optional
     ``jax_compilation_cache_dir`` already set (e.g. the test conftest's
     per-worker cache) rather than overriding it."""
     env = dict(env if env is not None else os.environ)
+    # boot is the earliest common chokepoint every entrypoint passes
+    # through (k8s pod, bench, tools) — install the telemetry listeners
+    # here so the production compile counter sees the FIRST compile
+    from h2o3_tpu import telemetry
+    telemetry.install()
     raw = env.get("H2O3_COMPILE_CACHE_DIR")
     raw = raw.strip() if raw is not None else None   # k8s YAML whitespace
     if raw is not None and raw.lower() in ("0", "off", "false"):

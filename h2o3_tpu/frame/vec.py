@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from h2o3_tpu.parallel.mesh import current_mesh, data_sharding, padded_len
+from h2o3_tpu.telemetry import record_d2h, record_h2d
 
 T_REAL = "real"
 T_INT = "int"
@@ -88,6 +89,7 @@ class Vec:
         if self._dev is None:
             return
         arr = np.asarray(jax.device_get(self._dev))
+        record_d2h(arr.nbytes)
         self._spilled = (arr, getattr(self._dev, "sharding", None))
         self._dev = None
         self._memblock = None
@@ -310,8 +312,12 @@ class Vec:
             # re-uploading to device only to download again (that would
             # also churn the LRU in the exact memory-pressure paths)
             return np.asarray(self._spilled[0])[: self.nrow].copy()
-        out = np.asarray(jax.device_get(self.data))[: self.nrow]
-        return out
+        full = np.asarray(jax.device_get(self.data))
+        # the transfer moves the PADDED device buffer — count what
+        # actually crossed, not the sliced view (padding dominates on
+        # small sharded frames)
+        record_d2h(full.nbytes)
+        return full[: self.nrow]
 
     def to_strings(self) -> np.ndarray:
         """Decoded object array (enum codes → labels)."""
@@ -389,6 +395,7 @@ def batch_device_put(columns, fill, dtype, nrow: int, mesh=None):
     else:
         for j in range(len(columns)):
             _pack(j)
+    record_h2d(mat.nbytes)
     dev = jax.device_put(mat, data_sharding(mesh))
     return [dev[:, j] for j in range(len(columns))]
 
@@ -397,4 +404,5 @@ def _pad_and_put(arr: np.ndarray, nrow: int, fill, mesh):
     plen = padded_len(nrow, mesh)
     if plen != nrow:
         arr = np.concatenate([arr, np.full(plen - nrow, fill, dtype=arr.dtype)])
+    record_h2d(arr.nbytes)
     return jax.device_put(arr, data_sharding(mesh))

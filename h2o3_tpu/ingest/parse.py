@@ -276,98 +276,126 @@ def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
     the merge unions domains + LUT-remaps codes, and device placement
     batches one 2D transfer per dtype group."""
     import concurrent.futures as cf
+
+    from h2o3_tpu import telemetry
     if isinstance(paths, str):
         paths = [paths]
     setup = setup or parse_setup(paths)
-    t0 = time.perf_counter()
-    jobs = []                      # (path, start, end, skip_header)
-    for p in paths:
-        size = os.path.getsize(p)
-        if size >= _PARALLEL_PARSE_BYTES:
-            ranges = _byte_ranges(p, min(os.cpu_count() or 4, 16))
-            jobs += [(p, s, e, setup.header and s == 0) for s, e in ranges]
-        else:
-            jobs.append((p, 0, size, setup.header))
-    native_ok = _native_available() and _na_strings_native_safe(setup)
-    results: List[Optional[List[EncodedColumn]]] = [None] * len(jobs)
-    if native_ok:
-        if len(jobs) == 1:
-            p, s, e, skip = jobs[0]
-            results[0] = _encode_range_native(p, s, e, setup, skip)
-        else:
-            workers = min(len(jobs), os.cpu_count() or 4, 16)
-            with cf.ThreadPoolExecutor(max_workers=workers) as ex:
-                futs = [ex.submit(_encode_range_native, p, s, e, setup, skip)
-                        for p, s, e, skip in jobs]
-                results = [fu.result() for fu in futs]
-    todo = [k for k, r in enumerate(results) if r is None]
-    if todo:
-        # fallback is IMPORT-scoped, not range-scoped: the two tokenizers
-        # disagree on edge tokens (>63-char numerics, unicode
-        # whitespace), and a column's chunks span every file of a
-        # multi-file import — so one declined range sends ALL ranges
-        # through the Python tokenizer. A column must never mix
-        # tokenizers across its chunks (the equivalence contract).
-        todo = list(range(len(jobs)))
-        total = sum(jobs[k][2] - jobs[k][1] for k in todo)
-        if len(todo) > 1 and total >= _PARALLEL_PARSE_BYTES:
-            # Python fallback in PROCESSES — spawn, not fork: this
-            # process is multithreaded (JAX/XLA), and forking while
-            # another thread holds an XLA mutex deadlocks the child
-            import multiprocessing as mp
-            ctx = mp.get_context("spawn")
-            workers = min(len(todo), os.cpu_count() or 4, 16)
-            with cf.ProcessPoolExecutor(max_workers=workers,
-                                        mp_context=ctx) as ex:
-                futs = {k: ex.submit(_encode_range_python, jobs[k][0],
-                                     jobs[k][1], jobs[k][2], setup,
-                                     jobs[k][3])
-                        for k in todo}
-                for k, fu in futs.items():
-                    results[k] = fu.result()
-        else:
-            for k in todo:
-                p, s, e, skip = jobs[k]
-                results[k] = _encode_range_python(p, s, e, setup, skip)
-    t1 = time.perf_counter()
-    skipped = _skipped_set(setup)
-    names = [n for i, n in enumerate(setup.column_names) if i not in skipped]
-    active = [i for i in range(len(setup.column_names)) if i not in skipped]
-    pos = {orig: j for j, orig in enumerate(active)}   # filtered index
-    merge_s = [0.0]
+    root = telemetry.open_span("ingest.parse",
+                               path=os.path.basename(paths[0]))
+    try:
+        t_wall = time.time()
+        t0 = time.perf_counter()
+        jobs = []                      # (path, start, end, skip_header)
+        for p in paths:
+            size = os.path.getsize(p)
+            if size >= _PARALLEL_PARSE_BYTES:
+                ranges = _byte_ranges(p, min(os.cpu_count() or 4, 16))
+                jobs += [(p, s, e, setup.header and s == 0) for s, e in ranges]
+            else:
+                jobs.append((p, 0, size, setup.header))
+        native_ok = _native_available() and _na_strings_native_safe(setup)
+        results: List[Optional[List[EncodedColumn]]] = [None] * len(jobs)
+        if native_ok:
+            if len(jobs) == 1:
+                p, s, e, skip = jobs[0]
+                results[0] = _encode_range_native(p, s, e, setup, skip)
+            else:
+                workers = min(len(jobs), os.cpu_count() or 4, 16)
+                with cf.ThreadPoolExecutor(max_workers=workers) as ex:
+                    futs = [ex.submit(_encode_range_native, p, s, e, setup, skip)
+                            for p, s, e, skip in jobs]
+                    results = [fu.result() for fu in futs]
+        todo = [k for k, r in enumerate(results) if r is None]
+        if todo:
+            # fallback is IMPORT-scoped, not range-scoped: the two tokenizers
+            # disagree on edge tokens (>63-char numerics, unicode
+            # whitespace), and a column's chunks span every file of a
+            # multi-file import — so one declined range sends ALL ranges
+            # through the Python tokenizer. A column must never mix
+            # tokenizers across its chunks (the equivalence contract).
+            todo = list(range(len(jobs)))
+            total = sum(jobs[k][2] - jobs[k][1] for k in todo)
+            if len(todo) > 1 and total >= _PARALLEL_PARSE_BYTES:
+                # Python fallback in PROCESSES — spawn, not fork: this
+                # process is multithreaded (JAX/XLA), and forking while
+                # another thread holds an XLA mutex deadlocks the child
+                import multiprocessing as mp
+                ctx = mp.get_context("spawn")
+                workers = min(len(todo), os.cpu_count() or 4, 16)
+                with cf.ProcessPoolExecutor(max_workers=workers,
+                                            mp_context=ctx) as ex:
+                    futs = {k: ex.submit(_encode_range_python, jobs[k][0],
+                                         jobs[k][1], jobs[k][2], setup,
+                                         jobs[k][3])
+                            for k in todo}
+                    for k, fu in futs.items():
+                        results[k] = fu.result()
+            else:
+                for k in todo:
+                    p, s, e, skip = jobs[k]
+                    results[k] = _encode_range_python(p, s, e, setup, skip)
+        t1 = time.perf_counter()
+        # ONE clock feeds both LAST_PROFILE and the telemetry spans — the
+        # REST-reported and tool-reported stage splits cannot disagree
+        telemetry.record_span("ingest.tokenize_encode", t_wall, t1 - t0,
+                              parent=root, chunks=len(jobs))
+        skipped = _skipped_set(setup)
+        names = [n for i, n in enumerate(setup.column_names) if i not in skipped]
+        active = [i for i in range(len(setup.column_names)) if i not in skipped]
+        pos = {orig: j for j, orig in enumerate(active)}   # filtered index
+        merge_s = [0.0]
 
-    def _merged(idx):
-        # merge one dtype group; time attributed to the merge stage even
-        # though it runs interleaved with the previous group's DMA
-        tm = time.perf_counter()
-        out = [(pos[i], merge_column([cr[i] for cr in results],
-                                     setup.column_types[i]))
-               for i in idx]
-        merge_s[0] += time.perf_counter() - tm
-        return out
+        def _merged(idx):
+            # merge one dtype group; time attributed to the merge stage even
+            # though it runs interleaved with the previous group's DMA
+            tm_wall = time.time()
+            tm = time.perf_counter()
+            out = [(pos[i], merge_column([cr[i] for cr in results],
+                                         setup.column_types[i]))
+                   for i in idx]
+            dt = time.perf_counter() - tm
+            merge_s[0] += dt
+            telemetry.record_span("ingest.domain_union", tm_wall, dt,
+                                  parent=root, cols=len(idx))
+            return out
 
-    def _groups():
-        # numeric/time/str first: their merge is a cheap concat, and
-        # issuing their device DMA NOW lets the transfer run underneath
-        # the enum group's domain union + LUT remap (the expensive host
-        # half of the merge) instead of after it
-        yield _merged([i for i in active
-                       if setup.column_types[i] != T_ENUM])
-        yield _merged([i for i in active
-                       if setup.column_types[i] == T_ENUM])
+        def _groups():
+            # numeric/time/str first: their merge is a cheap concat, and
+            # issuing their device DMA NOW lets the transfer run underneath
+            # the enum group's domain union + LUT remap (the expensive host
+            # half of the merge) instead of after it
+            yield _merged([i for i in active
+                           if setup.column_types[i] != T_ENUM])
+            yield _merged([i for i in active
+                           if setup.column_types[i] == T_ENUM])
 
-    fr = Frame.from_typed_column_groups(
-        names, _groups(), len(active), mesh=mesh,
-        key=key or os.path.basename(paths[0]))
-    t3 = time.perf_counter()
-    # in-place so `from h2o3_tpu.ingest.parse import LAST_PROFILE` stays live
-    LAST_PROFILE.clear()
-    LAST_PROFILE.update({"rows": fr.nrow, "chunks": len(jobs),
-                         "native": bool(native_ok and not todo),
-                         "tokenize_encode_s": round(t1 - t0, 4),
-                         "merge_s": round(merge_s[0], 4),
-                         "device_put_s": round(t3 - t1 - merge_s[0], 4)})
-    return fr
+        t2_wall = time.time()
+        fr = Frame.from_typed_column_groups(
+            names, _groups(), len(active), mesh=mesh,
+            key=key or os.path.basename(paths[0]))
+        t3 = time.perf_counter()
+        # device_put net of the interleaved domain-union work (the union
+        # spans are children of the same root and reported separately)
+        telemetry.record_span("ingest.device_put", t2_wall,
+                              t3 - t1 - merge_s[0], parent=root)
+        if root is not None:
+            root.attrs.update(rows=fr.nrow, chunks=len(jobs))
+            root.finish()
+        # in-place so `from h2o3_tpu.ingest.parse import LAST_PROFILE` stays live
+        LAST_PROFILE.clear()
+        LAST_PROFILE.update({"rows": fr.nrow, "chunks": len(jobs),
+                             "native": bool(native_ok and not todo),
+                             "tokenize_encode_s": round(t1 - t0, 4),
+                             "merge_s": round(merge_s[0], 4),
+                             "device_put_s": round(t3 - t1 - merge_s[0], 4)})
+        return fr
+    finally:
+        # a parse that raises mid-pipeline still closes its root span,
+        # so failures show in the trace instead of vanishing
+        if root is not None and root.duration_s is None:
+            root.attrs["error"] = True
+            root.finish()
 
 
 def import_file(path: Union[str, Sequence[str]], destination_frame: Optional[str] = None,

@@ -93,27 +93,45 @@ def timeline_events(n: int = 2048) -> List[Dict]:
 class Profile:
     """Per-phase wall-time accumulator (MRProfile analog). Phases may
     repeat; durations accumulate. Not thread-safe by design — one Profile
-    per training driver, like one MRProfile per MRTask."""
+    per training driver, like one MRProfile per MRTask.
 
-    def __init__(self):
+    Telemetry: every phase also lands as a ``{prefix}{name}`` span in
+    h2o3_tpu.telemetry (same clock, one measurement) so the stage split
+    that travels with the model and the one /metrics exports are the
+    same numbers. ``parent_span`` is the training driver's root span —
+    set by ModelBuilder.train and handed across the job thread."""
+
+    def __init__(self, prefix: str = "train.", parent_span=None):
         self.phases: Dict[str, float] = {}
         self._order: List[str] = []
+        self.prefix = prefix
+        self.parent_span = parent_span
 
     @contextmanager
     def phase(self, name: str):
-        t0 = time.time()
+        from h2o3_tpu import telemetry
+        t0 = time.perf_counter()
+        # enter a REAL span (thread-local) so nested stage spans inside
+        # the phase (gbm's bin/loop/score/finalize) parent implicitly
+        cm = telemetry.span(self.prefix + name, parent=self.parent_span)
+        cm.__enter__()
         try:
             yield
         finally:
-            dt = time.time() - t0
-            if name not in self.phases:
-                self._order.append(name)
-            self.phases[name] = self.phases.get(name, 0.0) + dt
+            cm.__exit__(None, None, None)
+            self._accumulate(name, time.perf_counter() - t0)
 
-    def add(self, name: str, seconds: float):
+    def _accumulate(self, name: str, dt: float):
         if name not in self.phases:
             self._order.append(name)
-        self.phases[name] = self.phases.get(name, 0.0) + seconds
+        self.phases[name] = self.phases.get(name, 0.0) + dt
+
+    def add(self, name: str, seconds: float):
+        from h2o3_tpu import telemetry
+        telemetry.record_span(self.prefix + name,
+                              time.time() - seconds, seconds,
+                              parent=self.parent_span)
+        self._accumulate(name, seconds)
 
     def to_dict(self) -> Dict[str, float]:
         return {k: round(self.phases[k], 4) for k in self._order}

@@ -372,6 +372,10 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             root_hi = jnp.zeros(cfg.n_features, jnp.float32)
             nb_f = jnp.zeros(cfg.n_features, jnp.float32)
         t_bin = time.time() - t_bin0
+        from h2o3_tpu import telemetry
+        # same clocks feed train_profile AND the spans (parented under
+        # the Profile's train phase span via the thread-local stack)
+        telemetry.record_span("train.bin", t_bin0, t_bin)
         y, w = spec.y, spec.w
         padded = spec.X.shape[0]
         if spec.offset is not None and K > 1:
@@ -623,17 +627,23 @@ class H2OGradientBoostingEstimator(ModelBuilder):
 
         jax.block_until_ready(margin)
         t_loop = time.time() - t_loop0
+        telemetry.record_span("train.loop", t_loop0, t_loop,
+                              trees=built)
+        if score_s:
+            telemetry.record_span("train.score", t_loop0, score_s)
         t_fin0 = time.time()
         model = self._finalize(spec, valid_spec, dist_name, f0, all_trees, bm,
                                cfg, K, built, margin,
                                vmargin if has_valid else None, keeper,
                                tree_offset=start_trees, prior=prior,
                                dist=dist)
+        t_fin = time.time() - t_fin0
+        telemetry.record_span("train.finalize", t_fin0, t_fin)
         model.output["training_loop_seconds"] = t_loop
         model.output["train_profile"] = {
             "bin_s": round(t_bin, 4), "loop_s": round(t_loop, 4),
             "score_s": round(score_s, 4),
-            "finalize_s": round(time.time() - t_fin0, 4)}
+            "finalize_s": round(t_fin, 4)}
         return model
 
     def _train_streaming(self, spec: TrainingSpec, valid_spec, dist_name,

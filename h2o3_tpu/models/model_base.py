@@ -829,9 +829,14 @@ class ModelBuilder:
         if training_frame is None or (y is None and self.supervised):
             raise ValueError("train() needs training_frame"
                              + (" and y" if self.supervised else ""))
+        from h2o3_tpu import telemetry
         from h2o3_tpu.log import Profile, info, timeline_record
         t0 = time.time()
-        prof = Profile()
+        # root span for the whole build; handed EXPLICITLY to the Profile
+        # because the body below runs on the job thread (thread-local
+        # nesting does not carry across threads)
+        sp_root = telemetry.open_span(f"train.{self.algo}")
+        prof = Profile(parent_span=sp_root)
         timeline_record("train_start", f"{self.algo}")
         self._warn_compat_params()
         with prof.phase("spec"):
@@ -940,9 +945,21 @@ class ModelBuilder:
             info("%s train done: %s", self.algo, prof.summary())
             timeline_record("train_done",
                             f"{self.algo} {prof.summary()}")
+            if sp_root is not None:
+                sp_root.attrs.update(rows=spec.nrow,
+                                     features=spec.n_features)
+                sp_root.finish()
             return model
 
-        job.run(body, background=background)
+        def body_spanned(j):
+            try:
+                return body(j)
+            finally:
+                # failed/cancelled builds still close their root span
+                if sp_root is not None and sp_root.duration_s is None:
+                    sp_root.finish()
+
+        job.run(body_spanned, background=background)
         if not background:
             self.model = job.join()
         self.job = job

@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from h2o3_tpu import telemetry
 from h2o3_tpu.serve.stats import ServeStats
 
 
@@ -53,13 +54,14 @@ class ServeClosedError(ServeError):
 
 
 class _Request:
-    __slots__ = ("rows", "n", "t_enqueue", "deadline", "event", "results",
-                 "error", "abandoned")
+    __slots__ = ("rows", "n", "t_enqueue", "t_wall", "deadline", "event",
+                 "results", "error", "abandoned")
 
     def __init__(self, rows: Sequence[Dict[str, Any]], deadline: float):
         self.rows = rows
         self.n = len(rows)
         self.t_enqueue = time.perf_counter()
+        self.t_wall = time.time()
         self.deadline = deadline
         self.event = threading.Event()
         self.results: Optional[List[Dict[str, Any]]] = None
@@ -145,8 +147,11 @@ class MicroBatcher:
         self.stats.queue_delta(-req.n)
         if req.error is not None:
             raise req.error
-        self.stats.record_request(
-            (time.perf_counter() - req.t_enqueue) * 1e3, req.n)
+        lat_s = time.perf_counter() - req.t_enqueue
+        self.stats.record_request(lat_s * 1e3, req.n)
+        # root span per client request (submit→resolve wall time)
+        telemetry.record_span("serve.request", req.t_wall, lat_s,
+                              model=self.stats.model, rows=req.n)
         return req.results
 
     # -- batcher thread -------------------------------------------------
@@ -240,8 +245,21 @@ class MicroBatcher:
                     return
                 continue
             t0 = time.perf_counter()
+            t0_wall = time.time()
+            # per-batch root span: opened on THIS thread, finished by the
+            # collector — the explicit-parent handoff the span API exists
+            # for (thread-local nesting cannot cross the pipeline)
+            sp_batch = telemetry.open_span("serve.batch",
+                                           model=self.stats.model,
+                                           rows=sum(r.n for r in batch))
             X, batch, n = self._encode_batch(batch)
             if not batch:
+                # every request failed to encode: the batch still shows
+                # in the trace (with error=True) so failed bursts don't
+                # vanish from /3/Timeline while stats count the errors
+                if sp_batch is not None:
+                    sp_batch.attrs["error"] = True
+                    sp_batch.finish()
                 continue
             t1 = time.perf_counter()
             try:
@@ -252,12 +270,21 @@ class MicroBatcher:
                     r.error = e
                     r.event.set()
                 self.stats.record_error()
+                if sp_batch is not None:
+                    sp_batch.attrs["error"] = True
+                    sp_batch.finish()
                 continue
             queue_ms = (t0 - min(r.t_enqueue for r in batch)) * 1e3
+            telemetry.record_span("serve.queue",
+                                  min(r.t_wall for r in batch),
+                                  queue_ms / 1e3, parent=sp_batch)
+            telemetry.record_span("serve.encode", t0_wall, t1 - t0,
+                                  parent=sp_batch)
             self._inflight.put(
                 (out, batch, n, X.shape[0],
                  {"queue": queue_ms, "encode": (t1 - t0) * 1e3,
-                  "dispatch": (t2 - t1) * 1e3}))
+                  "dispatch": (t2 - t1) * 1e3},
+                 (sp_batch, time.time() - (t2 - t1))))
 
     # -- collector thread -----------------------------------------------
 
@@ -266,7 +293,7 @@ class MicroBatcher:
             item = self._inflight.get()
             if item is None:
                 return
-            out, batch, n, padded, tms = item
+            out, batch, n, padded, tms, (sp_batch, disp_wall) = item
             t0 = time.perf_counter()
             try:
                 host = np.asarray(out)          # blocks until ready
@@ -278,12 +305,24 @@ class MicroBatcher:
                     r.error = e
                     r.event.set()
                 self.stats.record_error()
+                if sp_batch is not None:
+                    sp_batch.attrs["error"] = True
+                    sp_batch.finish()
                 continue
             off = 0
             for r in batch:
                 r.results = decoded[off: off + r.n]
                 off += r.n
                 r.event.set()
+            device_s = tms["dispatch"] / 1e3 + (t1 - t0)
+            # children recorded on the COLLECTOR thread against the
+            # batcher thread's root — explicit parent handoff
+            telemetry.record_span("serve.device", disp_wall, device_s,
+                                  parent=sp_batch)
+            telemetry.record_span("serve.decode", time.time() - (t2 - t1),
+                                  t2 - t1, parent=sp_batch)
+            if sp_batch is not None:
+                sp_batch.finish()
             self.stats.record_batch(
                 n, padded,
                 {"queue": tms["queue"],

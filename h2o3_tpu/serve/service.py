@@ -84,7 +84,7 @@ class Deployment:
                     f"{self.scorer.out_ndim}-D/"
                     f"{self.scorer.out_k}-wide output — this algo's "
                     f"predict() override is not row-servable")
-        self.stats = ServeStats()
+        self.stats = ServeStats(model=key)
         self.batcher = MicroBatcher(
             encode=self.codec.encode, dispatch=self.scorer.score,
             decode=self.codec.decode, stats=self.stats,

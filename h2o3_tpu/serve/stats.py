@@ -1,14 +1,26 @@
-"""Per-deployment serving telemetry: latency percentiles, stage
-attribution, queue/batch occupancy and request/error counters.
+"""Per-deployment serving telemetry — registry views (ISSUE 4).
 
-The reference has no online-serving telemetry to mirror (h2o-3 scores
-frames, not request streams); the shape here follows what
-`/3/Serve/stats` needs to answer: is the path keeping its latency SLO
-(p50/p99), where does a request's time go (encode/queue/device/decode),
-and is the batcher actually coalescing (mean batch occupancy).
+The PR-3 version kept a private mutex-guarded counter set per
+deployment; those counters are now *views over the process-wide
+telemetry registry* (h2o3_tpu.telemetry): every mutation lands in
+lock-striped registry metrics labeled ``{model=<key>}``, so the same
+numbers surface identically at ``/3/Serve/stats``, ``GET /metrics``
+(Prometheus) and ``GET /3/Telemetry`` — one producer, three exports.
 
-Lock discipline: one mutex per ServeStats, every mutation is a single
-short critical section — this sits on the request hot path.
+The latency reservoir (exact p50/p99 over the recent window) stays
+local: quantiles don't reconstruct from fixed histogram buckets at the
+precision the SLO view needs. The registry additionally gets a bucketed
+``h2o3_serve_latency_ms`` histogram for Prometheus-side aggregation.
+
+When the global registry is disabled (``H2O3_TELEMETRY=0``) a
+deployment falls back to a PRIVATE always-on registry: /3/Serve/stats
+keeps answering (the bench's serve round depends on it) while nothing
+reaches the exported surface — and the disabled global registry costs
+the serve path nothing.
+
+Lock discipline: registry metrics use the striped locks; only the
+reservoir keeps a per-deployment mutex, with every critical section a
+couple of array writes.
 """
 from __future__ import annotations
 
@@ -17,62 +29,181 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from h2o3_tpu.telemetry import registry as _global_registry
+from h2o3_tpu.telemetry.registry import Registry
+
 # ring-buffer length for the latency reservoir: enough for stable p99
 # estimates over the recent window without unbounded growth
 _RESERVOIR = 4096
 
 STAGES = ("encode", "queue", "device", "decode")
 
+# serve latency histogram bounds in ms (sub-ms micro-batch ticks up to
+# deadline-scale)
+_LAT_BOUNDS_MS = (0.25, 0.5, 1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0,
+                  250.0, 1000.0, 10_000.0)
+
+
+_ANON = [0]
+_ANON_LOCK = threading.Lock()
+
 
 class ServeStats:
-    def __init__(self):
+    def __init__(self, model: str = ""):
+        if not model:
+            # anonymous stats (embedded/unit-test use) get a unique
+            # label — sharing one "?" series across instances would
+            # break the fresh-counters-per-instance contract
+            with _ANON_LOCK:
+                _ANON[0] += 1
+                model = f"_anon_{_ANON[0]}"
+        self.model = model
+        reg = _global_registry()
+        if not reg.enabled:
+            # private always-on registry: the serve stats surface must
+            # not go dark when exported telemetry is off
+            reg = Registry(enabled=True)
+        self._reg = reg
+        lab = {"model": self.model}
+        self._requests = reg.counter(
+            "h2o3_serve_requests_total", lab,
+            help="client-visible serve requests")
+        self._rows = reg.counter(
+            "h2o3_serve_rows_total", lab, help="rows scored")
+        self._batches = reg.counter(
+            "h2o3_serve_batches_total", lab,
+            help="device batches dispatched")
+        self._batch_rows = reg.counter(
+            "h2o3_serve_batch_rows_total", lab,
+            help="live rows across dispatched batches")
+        self._padded_rows = reg.counter(
+            "h2o3_serve_padded_rows_total", lab,
+            help="bucket-padded rows across dispatched batches")
+        self._errors = reg.counter(
+            "h2o3_serve_errors_total", lab,
+            help="scoring failures surfaced to clients")
+        self._timeouts = reg.counter(
+            "h2o3_serve_timeouts_total", lab,
+            help="per-request deadline expiries")
+        self._rejected = reg.counter(
+            "h2o3_serve_rejected_total", lab,
+            help="admission-control rejections (503)")
+        self._queue_depth = reg.gauge(
+            "h2o3_serve_queue_depth", lab,
+            help="rows admitted but not yet resolved")
+        self._stage_ms = {s: reg.counter(
+            "h2o3_serve_stage_ms_total", {**lab, "stage": s},
+            help="cumulative per-stage milliseconds") for s in STAGES}
+        self._latency = reg.histogram(
+            "h2o3_serve_latency_ms", lab,
+            help="request latency milliseconds", bounds=_LAT_BOUNDS_MS)
         self._mu = threading.Lock()
         self._lat_ms = np.zeros(_RESERVOIR, np.float64)
         self._lat_n = 0            # total recorded (ring index = n % size)
-        self.requests = 0          # client-visible request count
-        self.rows = 0              # rows scored
-        self.batches = 0           # device batches dispatched
-        self.batch_rows = 0        # live rows across those batches
-        self.padded_rows = 0       # bucket-padded rows across them
-        self.errors = 0            # scoring failures surfaced to clients
-        self.timeouts = 0          # per-request deadline expiries
-        self.rejected = 0          # admission-control rejections (503)
-        self.stage_ms: Dict[str, float] = {s: 0.0 for s in STAGES}
-        self.queue_depth = 0       # rows currently admitted, not resolved
+        # queue depth is an INSTANTANEOUS property of this deployment's
+        # batcher, not a monotonic series: keep the authoritative value
+        # per instance (fresh at redeploy, immune to a drained old
+        # deployment's late decrements) and mirror it to the gauge for
+        # the Prometheus export
+        self._qd = 0
+        # redeploying a key reuses the registry series (Prometheus
+        # counters are monotonic per model) — but THIS deployment's view
+        # starts fresh: snapshot/compat properties report deltas against
+        # the construction-time baseline, preserving PR-3 semantics
+        self._base = {c: c.value for c in
+                      (self._requests, self._rows, self._batches,
+                       self._batch_rows, self._padded_rows, self._errors,
+                       self._timeouts, self._rejected,
+                       *self._stage_ms.values())}
+
+    def _delta(self, c) -> float:
+        return c.value - self._base.get(c, 0.0)
 
     # -- mutation (hot path) -------------------------------------------
 
     def record_request(self, latency_ms: float, rows: int):
-        with self._mu:
-            self._lat_ms[self._lat_n % _RESERVOIR] = latency_ms
-            self._lat_n += 1
-            self.requests += 1
-            self.rows += rows
+        # reservoir honors the same enabled flag as the counters: a
+        # runtime set_enabled(False) freezes the WHOLE stats surface
+        # consistently instead of a moving p50 over frozen counters
+        if self._reg.enabled:
+            with self._mu:
+                self._lat_ms[self._lat_n % _RESERVOIR] = latency_ms
+                self._lat_n += 1
+        self._requests.inc()
+        self._rows.inc(rows)
+        self._latency.observe(latency_ms)
 
     def record_batch(self, live_rows: int, padded_rows: int,
                      stage_ms: Dict[str, float]):
-        with self._mu:
-            self.batches += 1
-            self.batch_rows += live_rows
-            self.padded_rows += padded_rows
-            for s, v in stage_ms.items():
-                self.stage_ms[s] = self.stage_ms.get(s, 0.0) + v
+        self._batches.inc()
+        self._batch_rows.inc(live_rows)
+        self._padded_rows.inc(padded_rows)
+        for s, v in stage_ms.items():
+            c = self._stage_ms.get(s)
+            if c is None:
+                c = self._stage_ms[s] = self._reg.counter(
+                    "h2o3_serve_stage_ms_total",
+                    {"model": self.model, "stage": s})
+                self._base.setdefault(c, c.value)
+            c.inc(v)
 
     def record_error(self):
-        with self._mu:
-            self.errors += 1
+        self._errors.inc()
 
     def record_timeout(self):
-        with self._mu:
-            self.timeouts += 1
+        self._timeouts.inc()
 
     def record_rejected(self):
-        with self._mu:
-            self.rejected += 1
+        self._rejected.inc()
 
     def queue_delta(self, rows: int):
         with self._mu:
-            self.queue_depth += rows
+            self._qd += rows
+            qd = self._qd
+        self._queue_depth.set(qd)
+
+    # -- compat properties (tests and callers read these as ints) ------
+
+    @property
+    def requests(self) -> int:
+        return int(self._delta(self._requests))
+
+    @property
+    def rows(self) -> int:
+        return int(self._delta(self._rows))
+
+    @property
+    def batches(self) -> int:
+        return int(self._delta(self._batches))
+
+    @property
+    def batch_rows(self) -> int:
+        return int(self._delta(self._batch_rows))
+
+    @property
+    def padded_rows(self) -> int:
+        return int(self._delta(self._padded_rows))
+
+    @property
+    def errors(self) -> int:
+        return int(self._delta(self._errors))
+
+    @property
+    def timeouts(self) -> int:
+        return int(self._delta(self._timeouts))
+
+    @property
+    def rejected(self) -> int:
+        return int(self._delta(self._rejected))
+
+    @property
+    def queue_depth(self) -> int:
+        with self._mu:
+            return self._qd
+
+    @property
+    def stage_ms(self) -> Dict[str, float]:
+        return {s: self._delta(c) for s, c in self._stage_ms.items()}
 
     # -- snapshot -------------------------------------------------------
 
@@ -92,25 +223,31 @@ class ServeStats:
 
     def snapshot(self) -> Dict:
         p50, p99 = self.percentiles_ms([50, 99])
-        with self._mu:
-            occ = (self.batch_rows / self.batches) if self.batches else 0.0
-            pad_eff = (self.batch_rows / self.padded_rows) \
-                if self.padded_rows else 1.0
-            return {
-                "requests": self.requests,
-                "rows": self.rows,
-                "batches": self.batches,
-                "errors": self.errors,
-                "timeouts": self.timeouts,
-                "rejected": self.rejected,
-                "queue_depth": self.queue_depth,
-                "mean_batch_occupancy": round(occ, 3),
-                "bucket_fill": round(pad_eff, 4),
-                "p50_ms": None if p50 is None else round(p50, 3),
-                "p99_ms": None if p99 is None else round(p99, 3),
-                "stage_ms": {s: round(v, 3)
-                             for s, v in self.stage_ms.items()},
-            }
+        # striped-lock counters have no cross-counter atomic read (the
+        # price of losing the PR-3 per-instance mutex); bound the skew
+        # instead: numerators read FIRST, denominators last, so a
+        # concurrent record_batch can only make the ratios dip, never
+        # report occupancy/fill above the truth (fill > 1.0 clamped)
+        batch_rows = self.batch_rows
+        padded = self.padded_rows
+        batches = self.batches
+        occ = (batch_rows / batches) if batches else 0.0
+        pad_eff = min((batch_rows / padded) if padded else 1.0, 1.0)
+        return {
+            "requests": self.requests,
+            "rows": self.rows,
+            "batches": batches,
+            "errors": self.errors,
+            "timeouts": self.timeouts,
+            "rejected": self.rejected,
+            "queue_depth": self.queue_depth,
+            "mean_batch_occupancy": round(occ, 3),
+            "bucket_fill": round(pad_eff, 4),
+            "p50_ms": None if p50 is None else round(p50, 3),
+            "p99_ms": None if p99 is None else round(p99, 3),
+            "stage_ms": {s: round(v, 3)
+                         for s, v in self.stage_ms.items()},
+        }
 
 
 def merge_snapshots(snaps: List[Dict]) -> Dict:

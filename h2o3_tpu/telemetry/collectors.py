@@ -1,0 +1,164 @@
+"""Device-aware collectors: the telemetry the JVM-era tools can't see.
+
+- **Compile counter** (production promotion of tests/_compile_counter.py):
+  a ``jax.monitoring`` duration listener counts every XLA backend
+  compile (``/jax/core/compile/backend_compile_duration``) into
+  ``h2o3_xla_compiles_total`` + a duration histogram — the warm-path
+  zero-compile guarantee the test harness proves is now a metric
+  production can watch.
+- **Compile-cache hit/miss**: the persistent-compile-cache events
+  (``/jax/compilation_cache/cache_hits`` / ``cache_misses``).
+- **Transfer bytes**: ``record_h2d``/``record_d2h`` counters called from
+  the frame layer's transfer choke points (``batch_device_put`` /
+  ``Vec.to_numpy`` / spill).
+- **Device memory**: a scrape-time view over ``memory_stats()`` (TPU)
+  falling back to summing ``jax.live_arrays()`` (CPU backend), plus a
+  peak gauge updated at every scrape and h2d record.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from h2o3_tpu.telemetry.registry import registry
+
+_INSTALL_LOCK = threading.Lock()
+_INSTALLED = [False]
+
+BACKEND_COMPILE_SUFFIX = "backend_compile_duration"
+CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+
+def _compiles():
+    return registry().counter(
+        "h2o3_xla_compiles_total",
+        help="XLA backend compiles in this process")
+
+
+def _cache_hits():
+    return registry().counter(
+        "h2o3_compile_cache_hits_total",
+        help="persistent compile cache hits")
+
+
+def _cache_misses():
+    return registry().counter(
+        "h2o3_compile_cache_misses_total",
+        help="persistent compile cache misses")
+
+
+def _duration_listener(key: str, dur: float, **_kw) -> None:
+    if key.endswith(BACKEND_COMPILE_SUFFIX):
+        _compiles().inc()
+        registry().histogram(
+            "h2o3_xla_compile_seconds",
+            help="XLA backend compile durations").observe(float(dur))
+
+
+def _event_listener(key: str, **_kw) -> None:
+    if key == CACHE_HIT_EVENT:
+        _cache_hits().inc()
+    elif key == CACHE_MISS_EVENT:
+        _cache_misses().inc()
+
+
+def install() -> bool:
+    """Register the jax.monitoring listeners + the device-memory view.
+    Idempotent; safe to call from cluster boot, bench, server start and
+    tests. Returns True when the listeners are (already) live."""
+    with _INSTALL_LOCK:
+        if _INSTALLED[0]:
+            return True
+        try:
+            import jax
+            jax.monitoring.register_event_duration_secs_listener(
+                _duration_listener)
+            jax.monitoring.register_event_listener(_event_listener)
+        except Exception:          # jax without monitoring: gate, don't die
+            return False
+        # touch the counters so a zero-compile process still exports them
+        _compiles(), _cache_hits(), _cache_misses()
+        registry().add_collector(_device_memory_samples)
+        _INSTALLED[0] = True
+        return True
+
+
+def installed() -> bool:
+    return _INSTALLED[0]
+
+
+# ---------------------------------------------------------------- bytes
+
+# transfer counters sit at the frame-layer choke points — hold the
+# handles instead of paying the registry creation mutex per transfer.
+# Cleared by Registry.reset() on the global registry.
+_BYTE_HANDLES: Dict[str, object] = {}
+
+
+def _byte_counter(name: str, help_: str):
+    c = _BYTE_HANDLES.get(name)
+    if c is None:
+        c = registry().counter(name, help=help_)
+        _BYTE_HANDLES[name] = c
+    return c
+
+
+def record_h2d(nbytes: int) -> None:
+    """Host→device transfer bytes (batch_device_put / _pad_and_put)."""
+    if not registry().enabled:
+        return
+    _byte_counter("h2o3_h2d_bytes_total",
+                  "host->device transfer bytes").inc(float(nbytes))
+
+
+def record_d2h(nbytes: int) -> None:
+    """Device→host fetch bytes (Vec.to_numpy / spill / device_get)."""
+    if not registry().enabled:
+        return
+    _byte_counter("h2o3_d2h_bytes_total",
+                  "device->host transfer bytes").inc(float(nbytes))
+
+
+# ---------------------------------------------------------- device memory
+
+def device_memory_bytes() -> Dict[str, Optional[float]]:
+    """Live/peak device memory. TPU backends expose memory_stats();
+    the CPU backend doesn't, so fall back to summing live jax arrays
+    (an upper-bound view of OUR allocations, good enough to trend)."""
+    live = peak = None
+    try:
+        import jax
+        stats = [d.memory_stats() for d in jax.local_devices()]
+        stats = [s for s in stats if s]
+        if stats:
+            live = float(sum(s.get("bytes_in_use", 0) for s in stats))
+            peak = float(sum(s.get("peak_bytes_in_use", 0) for s in stats))
+        else:
+            live = float(sum(getattr(a, "nbytes", 0)
+                             for a in jax.live_arrays()))
+    except Exception:
+        pass
+    return {"live": live, "peak": peak}
+
+
+def sample_device_memory() -> Dict[str, Optional[float]]:
+    """Measure device memory now and fold it into the peak gauge —
+    called at scrape time and from bench round boundaries."""
+    mem = device_memory_bytes()
+    reg = registry()
+    if reg.enabled and mem["live"] is not None:
+        g = reg.gauge("h2o3_device_peak_bytes",
+                      help="peak observed live device bytes")
+        g.set_max(mem["peak"] if mem["peak"] is not None else mem["live"])
+    return mem
+
+
+def _device_memory_samples() -> List[dict]:
+    mem = sample_device_memory()
+    out = []
+    if mem["live"] is not None:
+        out.append({"name": "h2o3_device_live_bytes", "kind": "gauge",
+                    "value": mem["live"],
+                    "help": "live device memory bytes"})
+    return out

@@ -1,0 +1,144 @@
+"""Exposition formats: Prometheus text, JSON snapshot, Chrome trace.
+
+- ``prometheus_text()`` — the ``GET /metrics`` body (text/plain;
+  version=0.0.4): HELP/TYPE headers per family, cumulative ``_bucket``
+  series with ``le`` labels for histograms.
+- ``telemetry_snapshot()`` — the ``GET /3/Telemetry`` body: flat JSON
+  metrics + span-stage aggregates + device memory.
+- ``chrome_trace()`` — the ``GET /3/Timeline?format=trace`` body: the
+  span ring as Chrome-trace "X" (complete) events; loads directly in
+  Perfetto / chrome://tracing.
+"""
+from __future__ import annotations
+
+import json
+import math
+from typing import Dict, List, Optional
+
+from h2o3_tpu.telemetry import spans
+from h2o3_tpu.telemetry.registry import registry
+
+
+def _esc(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"') \
+        .replace("\n", r"\n")
+
+
+def _labels_text(labels: Dict[str, str], extra: str = "") -> str:
+    parts = [f'{k}="{_esc(v)}"' for k, v in sorted(labels.items())]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _num(v: float) -> str:
+    if v != v:
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def prometheus_text() -> str:
+    """Prometheus exposition format 0.0.4 over every registry sample."""
+    lines: List[str] = []
+    seen_header = set()
+    for s in registry().samples():
+        name, kind, labels = s["name"], s["kind"], s["labels"]
+        if name not in seen_header:
+            seen_header.add(name)
+            if s.get("help"):
+                lines.append(f"# HELP {name} {_esc(s['help'])}")
+            lines.append(f"# TYPE {name} {kind}")
+        if kind == "histogram":
+            for le, cum in s["buckets"]:
+                le_lab = 'le="%s"' % _num(le)
+                lines.append(f"{name}_bucket"
+                             f"{_labels_text(labels, le_lab)} {cum}")
+            lines.append(f"{name}_sum{_labels_text(labels)} "
+                         f"{_num(s['sum'])}")
+            lines.append(f"{name}_count{_labels_text(labels)} {s['count']}")
+        else:
+            lines.append(f"{name}{_labels_text(labels)} {_num(s['value'])}")
+    return "\n".join(lines) + "\n"
+
+
+def _flatten(samples) -> Dict[str, object]:
+    """Samples → the flat {name{labels}: value} map (Registry.snapshot
+    shape, computed from an existing samples() pass)."""
+    flat: Dict[str, object] = {}
+    for s in samples:
+        key = s["name"]
+        if s["labels"]:
+            key += "{" + ",".join(f"{k}={v}" for k, v in
+                                  sorted(s["labels"].items())) + "}"
+        if s["kind"] == "histogram":
+            flat[key] = {"sum": round(s["sum"], 6), "count": s["count"]}
+        else:
+            flat[key] = s["value"]
+    return flat
+
+
+def telemetry_snapshot() -> Dict[str, object]:
+    """The /3/Telemetry JSON body: flat metrics + stage aggregates +
+    device memory — one H2O-style snapshot of where the time, bytes
+    and compiles went. ONE samples() pass feeds every section (each
+    pass runs the collector views, including a device-memory walk that
+    is O(live arrays) on the CPU backend)."""
+    samp = registry().samples()
+
+    def val(name, default=0.0):
+        for s in samp:
+            if s["name"] == name and not s["labels"]:
+                return s.get("value", default)
+        return default
+
+    live = val("h2o3_device_live_bytes", None)
+    peak = val("h2o3_device_peak_bytes", None)
+    return {
+        "enabled": registry().enabled,
+        "metrics": _flatten(samp),
+        "stages": spans.stage_seconds(samples=samp),
+        "device_memory": {"live": live, "peak": peak or live},
+        "compiles": val("h2o3_xla_compiles_total"),
+        "compile_cache": {
+            "hits": val("h2o3_compile_cache_hits_total"),
+            "misses": val("h2o3_compile_cache_misses_total"),
+        },
+        "h2d_bytes": val("h2o3_h2d_bytes_total"),
+        "d2h_bytes": val("h2o3_d2h_bytes_total"),
+    }
+
+
+def chrome_trace(limit: Optional[int] = None) -> Dict[str, object]:
+    """Chrome-trace JSON of the finished-span ring. Thread names become
+    Perfetto track names; parent links ride in args (flow events would
+    need begin/end pairs — complete events keep the export dead simple
+    and still render nesting by track + time containment)."""
+    evs = []
+    for sp in spans.finished_spans(limit or 0) if limit else \
+            spans.finished_spans():
+        if sp.duration_s is None:
+            continue
+        args = {"span_id": sp.span_id}
+        if sp.parent_id:
+            args["parent_id"] = sp.parent_id
+        for k, v in sp.attrs.items():
+            if isinstance(v, (int, float, str, bool)):
+                args[k] = v
+        evs.append({
+            "name": sp.name,
+            "cat": sp.name.split(".", 1)[0],
+            "ph": "X",
+            "ts": sp.t_wall * 1e6,               # µs epoch
+            "dur": sp.duration_s * 1e6,
+            "pid": 1,
+            "tid": sp.thread_id % (1 << 31),
+            "args": args,
+        })
+    return {"traceEvents": evs, "displayTimeUnit": "ms"}
+
+
+def chrome_trace_bytes(limit: Optional[int] = None) -> bytes:
+    return json.dumps(chrome_trace(limit)).encode()

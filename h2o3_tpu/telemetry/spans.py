@@ -1,0 +1,233 @@
+"""End-to-end spans: nested timing contexts with cross-thread handoff.
+
+A ``span("train.gbm.loop", job=...)`` context manager times a stage and
+records it three ways:
+
+- a per-name duration histogram in the metrics registry
+  (``h2o3_span_seconds{span=...}``) — the aggregate view the profiler
+  tools and /metrics read;
+- an entry in a bounded ring of finished spans — the raw view behind
+  ``GET /3/Timeline?format=trace`` (Chrome-trace/Perfetto export);
+- for ROOT spans (no parent), an event in the existing
+  ``log.timeline_record`` ring — so Flow's /3/Timeline finally shows
+  ingest and serve activity, not just model builds.
+
+Parentage: within a thread, nesting is implicit (a thread-local stack).
+Across threads — the micro-batcher's submit/batch/collect trio, the
+training job thread — the parent is handed off EXPLICITLY: capture
+``current_span()`` (or the ``Span`` yielded by the context manager) in
+one thread and pass it as ``span(..., parent=handle)`` or
+``record_span(..., parent=handle)`` in another. A ``Span`` handle stays
+valid after it finishes; linking to a finished parent is fine (the
+batcher's collector thread finishes children after the batch root).
+
+Pipelines that already keep wall-clock stage timers (ingest's
+LAST_PROFILE, gbm's train_profile) record those SAME intervals via
+``record_span`` — one clock feeds both the legacy dicts and the spans,
+so the REST-reported and tool-reported stage splits cannot disagree.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional
+
+from h2o3_tpu.telemetry.registry import registry
+
+_RING_CAP = 8192
+_RING: "collections.deque" = collections.deque(maxlen=_RING_CAP)
+_RING_LOCK = threading.Lock()
+_IDS = itertools.count(1)
+_TLS = threading.local()
+
+# span-duration histogram bounds: 10µs (a serve decode) … 1000s (a cold
+# AutoML build)
+_SPAN_BOUNDS = (1e-5, 1e-4, 1e-3, 0.01, 0.1, 1.0, 10.0, 100.0, 1000.0)
+
+# per-name histogram handle cache: span finish sits on the serve hot
+# path, and going through Registry._get would serialize every finishing
+# thread on the registry-wide creation mutex. A racy double-create is
+# harmless (Registry._get dedups to one instance). Cleared by
+# Registry.reset() on the global registry.
+_HIST_CACHE: Dict[str, object] = {}
+
+
+def _span_hist(name: str):
+    h = _HIST_CACHE.get(name)
+    if h is None:
+        h = registry().histogram(
+            "h2o3_span_seconds", {"span": name},
+            help="finished span durations by span name",
+            bounds=_SPAN_BOUNDS)
+        _HIST_CACHE[name] = h
+    return h
+
+
+class Span:
+    __slots__ = ("name", "attrs", "span_id", "parent_id", "thread_id",
+                 "t_wall", "t0", "duration_s")
+
+    def __init__(self, name: str, parent: Optional["Span"] = None,
+                 attrs: Optional[Dict] = None):
+        self.name = name
+        self.attrs = attrs or {}
+        self.span_id = next(_IDS)
+        self.parent_id = parent.span_id if parent is not None else 0
+        self.thread_id = threading.get_ident()
+        self.t_wall = time.time()
+        self.t0 = time.perf_counter()
+        self.duration_s: Optional[float] = None
+
+    def finish(self) -> "Span":
+        if self.duration_s is None:
+            self.duration_s = time.perf_counter() - self.t0
+            _record_finished(self)
+        return self
+
+    def __repr__(self):
+        d = f"{self.duration_s * 1e3:.2f}ms" if self.duration_s else "open"
+        return f"<Span {self.name}#{self.span_id} {d}>"
+
+
+def _stack() -> List[Span]:
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = _TLS.stack = []
+    return st
+
+
+def current_span() -> Optional[Span]:
+    """The innermost open span on THIS thread (the handoff handle)."""
+    st = getattr(_TLS, "stack", None)
+    return st[-1] if st else None
+
+
+# timeline throttle: the Flow ring is 2048 entries — at serve rates
+# (hundreds of serve.request/serve.batch roots per second) unthrottled
+# feeding would wrap it in seconds, evicting the train/ingest events the
+# endpoint exists to show. One event per span NAME per second keeps
+# serve activity visible without monopolizing the ring (the full-rate
+# record stays in the span ring for ?format=trace). Racy reads are fine:
+# worst case two threads both pass the gate and two events land.
+_TL_LAST: Dict[str, float] = {}
+_TL_MIN_INTERVAL_S = 1.0
+
+
+def _record_finished(sp: Span) -> None:
+    if not registry().enabled:
+        return
+    _span_hist(sp.name).observe(sp.duration_s)
+    with _RING_LOCK:
+        _RING.append(sp)
+    if sp.parent_id == 0:
+        # root spans feed the Flow timeline ring (train_start/train_done
+        # style events now cover ingest and serve too)
+        now = time.time()
+        if now - _TL_LAST.get(sp.name, 0.0) < _TL_MIN_INTERVAL_S:
+            return
+        _TL_LAST[sp.name] = now
+        from h2o3_tpu import log
+        extra = " ".join(f"{k}={v}" for k, v in sp.attrs.items())
+        log.timeline_record(
+            sp.name, f"{sp.duration_s * 1e3:.1f} ms"
+            + (f" {extra}" if extra else ""))
+
+
+class _SpanContext:
+    """Context manager wrapper: pushes/pops the thread-local stack so
+    nested ``span()`` calls parent implicitly."""
+    __slots__ = ("_span", "_name", "_parent", "_attrs")
+
+    def __init__(self, name, parent, attrs):
+        self._name = name
+        self._parent = parent
+        self._attrs = attrs
+        self._span: Optional[Span] = None
+
+    def __enter__(self) -> Optional[Span]:
+        if not registry().enabled:
+            return None
+        parent = self._parent if self._parent is not None \
+            else current_span()
+        sp = Span(self._name, parent, self._attrs)
+        _stack().append(sp)
+        self._span = sp
+        return sp
+
+    def __exit__(self, *exc):
+        sp = self._span
+        if sp is None:
+            return False
+        st = _stack()
+        # pop by identity — an exception may have skipped inner pops
+        while st:
+            top = st.pop()
+            if top is sp:
+                break
+            top.finish()
+        sp.finish()
+        return False
+
+
+def span(name: str, parent: Optional[Span] = None, **attrs) -> _SpanContext:
+    """``with span("ingest.parse", rows=n) as sp: ...`` — times the
+    block; nesting is implicit per thread, ``parent=`` makes it
+    explicit (cross-thread handoff)."""
+    return _SpanContext(name, parent, attrs)
+
+
+def open_span(name: str, parent: Optional[Span] = None,
+              **attrs) -> Optional[Span]:
+    """Start a span WITHOUT entering the thread-local stack — for spans
+    that end on a different thread (the batcher's per-batch root).
+    Finish with ``sp.finish()``. Returns None when telemetry is off."""
+    if not registry().enabled:
+        return None
+    return Span(name, parent, attrs)
+
+
+def record_span(name: str, start_wall: float, duration_s: float,
+                parent: Optional[Span] = None, **attrs) -> Optional[Span]:
+    """Record an already-measured interval as a finished span (one clock
+    feeding both a legacy profile dict and the span ring). ``parent``
+    defaults to the calling thread's current span."""
+    if not registry().enabled:
+        return None
+    sp = Span(name, parent if parent is not None else current_span(), attrs)
+    sp.t_wall = start_wall
+    sp.duration_s = float(duration_s)
+    _record_finished(sp)
+    return sp
+
+
+def finished_spans(n: int = _RING_CAP) -> List[Span]:
+    with _RING_LOCK:
+        return list(_RING)[-n:]
+
+
+def clear_spans() -> None:
+    """Test isolation only."""
+    with _RING_LOCK:
+        _RING.clear()
+
+
+def stage_seconds(prefix: str = "",
+                  samples: Optional[List[dict]] = None
+                  ) -> Dict[str, Dict[str, float]]:
+    """Aggregate stage totals from the span-duration histograms:
+    ``{span_name: {count, seconds}}`` — the view the profiler tools
+    read, identical by construction to what /metrics exports. Pass an
+    existing ``registry().samples()`` list to avoid a second scrape
+    (each scrape runs the collector views, incl. a device-memory
+    walk)."""
+    out: Dict[str, Dict[str, float]] = {}
+    for s in (samples if samples is not None else registry().samples()):
+        if s["name"] != "h2o3_span_seconds" or s["kind"] != "histogram":
+            continue
+        name = s["labels"].get("span", "")
+        if prefix and not name.startswith(prefix):
+            continue
+        out[name] = {"count": s["count"], "seconds": round(s["sum"], 6)}
+    return out

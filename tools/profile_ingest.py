@@ -1,11 +1,16 @@
 """Ingest stage profiler — attribute parse time to its pipeline stages.
 
 Writes a synthetic mixed-type CSV (numeric, enum, time columns with NA
-sentinels), then times the four stages of the streaming parse pipeline
-separately on one chunk — tokenize (native C scan, fast_csv.cpp),
-encode (chunk-local typed columns + enum dictionaries, ingest/chunk.py),
-domain-union merge, and the batched host→device transfer — plus the real
-end-to-end ``parse()`` (byte-range fan-out) for the wall-clock number.
+sentinels), runs the REAL end-to-end ``parse()`` (byte-range fan-out),
+and reads the stage attribution from the telemetry spans the pipeline
+itself records (h2o3_tpu.telemetry): tokenize_encode (native C scan +
+chunk-local typed encode), domain_union (enum merge + LUT remap) and
+device_put (batched host→device transfer), plus the h2d transfer-byte
+counter at the ``batch_device_put`` choke point. The tool keeps NO
+timers of its own around pipeline stages — the numbers here are the
+SAME ones ``GET /metrics`` and ``GET /3/Telemetry`` export, so the
+tool-reported and REST-reported splits cannot disagree (ISSUE 4).
+
 Prints ONE JSON line so a future ingest regression is attributable to a
 stage, not just "parse got slower".
 
@@ -64,63 +69,54 @@ def _synth_csv(path):
 
 
 def main():
-    from h2o3_tpu.frame.frame import Frame
-    from h2o3_tpu.ingest.chunk import encode_chunk_native, merge_columns
+    from h2o3_tpu import telemetry
     from h2o3_tpu.ingest.parse import LAST_PROFILE, parse, parse_setup
-    from h2o3_tpu.native import parse_bytes
 
+    telemetry.install()
+    if not telemetry.enabled():
+        log("H2O3_TELEMETRY=0: stage attribution unavailable — stage "
+            "fields will be null (re-run with telemetry enabled)")
     path = os.environ.get("CSV") or os.path.join(
         tempfile.gettempdir(), f"h2o3_profile_ingest_{ROWS}.csv")
     if not os.path.exists(path):
         _synth_csv(path)
     setup = parse_setup(path)
-    with open(path, "rb") as f:
-        data = f.read()
 
-    # actual row count, not the ROWS knob — CSV= may point at any file
-    nrow = (data.count(b"\n")
-            + (0 if (not data or data.endswith(b"\n")) else 1)
-            - (1 if setup.header else 0))
-    out = {"rows": nrow, "ncol": len(setup.column_names),
-           "bytes": len(data)}
+    # counters are cumulative — diff against the pre-run snapshot
+    h2d0 = telemetry.registry().value("h2o3_h2d_bytes_total")
+    stages0 = telemetry.stage_seconds("ingest.")
 
-    # stage 1: tokenize — the native C scan alone (offsets + doubles)
     t0 = time.perf_counter()
-    tok = parse_bytes(data, setup.separator)
-    t1 = time.perf_counter()
-    if tok is None:
-        out["tokenize_s"] = None
-        log("native tokenizer unavailable/declined; stage split skipped")
-    else:
-        out["tokenize_s"] = round(t1 - t0, 4)
-        # stage 2: encode — typed columns + chunk-local enum dictionaries
-        # (encode_chunk_native re-tokenizes; its own time minus stage 1
-        # is the encode share)
-        t2 = time.perf_counter()
-        cols = encode_chunk_native(data, setup, setup.header)
-        t3 = time.perf_counter()
-        out["encode_s"] = round((t3 - t2) - (t1 - t0), 4)
-        # stage 3: domain union + LUT remap across (here: one) chunks
-        t4 = time.perf_counter()
-        merged = merge_columns([cols], setup.column_types)
-        t5 = time.perf_counter()
-        out["domain_union_s"] = round(t5 - t4, 4)
-        # stage 4: batched host→device transfer (one DMA per dtype group)
-        t6 = time.perf_counter()
-        fr = Frame.from_typed_columns(setup.column_names, merged)
-        for v in fr.vecs:
-            if v.data is not None:
-                v.data.block_until_ready()
-        t7 = time.perf_counter()
-        out["device_put_s"] = round(t7 - t6, 4)
-
-    # end-to-end: the real parallel parse (fan-out + overlap), wall clock
-    t8 = time.perf_counter()
     fr = parse([path], setup)
-    t9 = time.perf_counter()
-    out["parse_wall_s"] = round(t9 - t8, 4)
-    out["parse_rows_per_s"] = round(fr.nrow / (t9 - t8), 1)
-    out["parallel_profile"] = dict(LAST_PROFILE)
+    wall = time.perf_counter() - t0
+
+    # ONE scrape for every stage read (each samples() pass runs the
+    # collector views, incl. an O(live arrays) device-memory walk)
+    stages1 = telemetry.stage_seconds(
+        "ingest.", samples=telemetry.registry().samples())
+
+    def stage(name):
+        tot = stages1.get(name, {})
+        pre = stages0.get(name, {})
+        # no new span observations (telemetry off) → null, never a fake
+        # "0.0s stage" datapoint
+        if tot.get("count", 0) == pre.get("count", 0):
+            return None
+        return round(tot.get("seconds", 0.0) - pre.get("seconds", 0.0), 4)
+
+    out = {"rows": fr.nrow, "ncol": fr.ncol,
+           "bytes": os.path.getsize(path),
+           "native": LAST_PROFILE.get("native"),
+           "chunks": LAST_PROFILE.get("chunks"),
+           # stage split read from the pipeline's OWN telemetry spans —
+           # identical to what GET /metrics exports for the same run
+           "tokenize_encode_s": stage("ingest.tokenize_encode"),
+           "domain_union_s": stage("ingest.domain_union"),
+           "device_put_s": stage("ingest.device_put"),
+           "h2d_bytes": round(
+               telemetry.registry().value("h2o3_h2d_bytes_total") - h2d0),
+           "parse_wall_s": round(wall, 4),
+           "parse_rows_per_s": round(fr.nrow / wall, 1)}
     print(json.dumps(out))
     return out
 

@@ -9,8 +9,14 @@ stage attribution the batcher records per batch:
   device  — dispatch + device execution + result fetch
   decode  — host scores → per-row prediction dicts
 
-plus deploy-time warm-compile cost per batch bucket. One JSON line on
-stdout (same contract as tools/profile_train.py / profile_ingest.py).
+plus deploy-time warm-compile cost per batch bucket. Stage numbers come
+from the telemetry registry (ISSUE 4): ServeStats is a view over the
+process-wide metrics the REST endpoints export, and the per-batch
+``serve.*`` spans land in the same registry — so this tool, GET
+/3/Serve/stats and GET /metrics can never disagree. The warm-path XLA
+compile count (production ``h2o3_xla_compiles_total``) is asserted-by-
+reporting: it must be 0 after deploy. One JSON line on stdout (same
+contract as tools/profile_train.py / profile_ingest.py).
 
 Knobs: H2O3_SERVE_PROF_ROWS (train rows, default 50k),
 H2O3_SERVE_PROF_REQUESTS (single-row requests, default 500),
@@ -32,8 +38,13 @@ def log(*a):
 
 def main():
     import h2o3_tpu as h2o
-    from h2o3_tpu import serve
+    from h2o3_tpu import serve, telemetry
     from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    telemetry.install()
+    if not telemetry.enabled():
+        log("H2O3_TELEMETRY=0: span/compile attribution unavailable — "
+            "those fields will be empty (stats still report)")
 
     rows_n = int(os.environ.get("H2O3_SERVE_PROF_ROWS", 50_000))
     n_req = int(os.environ.get("H2O3_SERVE_PROF_REQUESTS", 500))
@@ -63,6 +74,10 @@ def main():
     names = [f"f{i}" for i in range(F)]
     pool = [{n: float(X[i, j]) for j, n in enumerate(names)}
             for i in range(min(rows_n, 8192))]
+
+    # warm-path compile guard: everything after deploy must compile 0
+    # XLA modules — tracked by the PRODUCTION counter, not a test shim
+    compiles0 = telemetry.registry().value("h2o3_xla_compiles_total")
 
     # phase 1: sequential single-row requests (latency path)
     for i in range(n_req):
@@ -108,6 +123,11 @@ def main():
                            for s, v in batch_stage.items()},
         },
         "bucket_fill": total["bucket_fill"],
+        "warm_compiles": int(telemetry.registry().value(
+            "h2o3_xla_compiles_total") - compiles0),
+        # span-level view of the same run (counts prove every batch got
+        # stage spans; seconds match the stage_ms sums above)
+        "spans": telemetry.stage_seconds("serve."),
     }
     serve.undeploy(model.key)
     print(json.dumps(out))

@@ -14,10 +14,14 @@ JSON line attributing the warm run to its stages:
               acceptance bar is warm_total <= 2.5x loop at bench shape
 
 plus ``cold_total_s`` (time-to-first-model net of ingest) so compile-
-cache regressions are attributable. Stage numbers come from the
-driver's own instrumentation (model.output['train_profile'] /
-['profile']) — the profiler adds no timers of its own around device
-work, so there is no double-dispatch skew.
+cache regressions are attributable. Stage numbers are read from the
+telemetry spans the training driver itself records (h2o3_tpu.telemetry
+``train.*`` spans — the same data ``GET /metrics`` and /3/Telemetry
+export, so the tool- and REST-reported splits cannot disagree); the
+profiler adds no timers of its own around device work, so there is no
+double-dispatch skew. The warm run's XLA compile count (the production
+``h2o3_xla_compiles_total`` counter) is reported alongside — 0 is the
+PR-2 zero-recompile contract.
 
 Env knobs: ROWS (default 2M), NCOL (default 28 features), TREES (20),
 DEPTH (6), NBINS (14), HIST (histogram_type, default 'random' like the
@@ -75,8 +79,12 @@ def _train(fr, yname):
 
 def main():
     import jax
+    from h2o3_tpu import telemetry
     from h2o3_tpu.cluster_boot import setup_compilation_cache
-    cache = setup_compilation_cache()
+    cache = setup_compilation_cache()       # also installs telemetry
+    if not telemetry.enabled():
+        log("H2O3_TELEMETRY=0: stage/compile attribution unavailable — "
+            "those fields will be null/0 (re-run with telemetry enabled)")
     log(f"backend={jax.default_backend()} devices={len(jax.devices())} "
         f"compile_cache={cache}")
     fr, yname = _frame()
@@ -84,22 +92,41 @@ def main():
 
     model, cold_total = _train(fr, yname)
     log(f"cold train {cold_total:.2f}s "
-        f"profile={model.output.get('train_profile')}")
+        f"stages={telemetry.stage_seconds('train.')}")
+    # stage counters are cumulative: snapshot before the warm run and
+    # report the delta — the warm run's own span durations
+    stages0 = telemetry.stage_seconds("train.")
+    compiles0 = telemetry.registry().value("h2o3_xla_compiles_total")
     model, warm_total = _train(fr, yname)
+    warm_compiles = telemetry.registry().value(
+        "h2o3_xla_compiles_total") - compiles0
 
-    tp = dict(model.output.get("train_profile") or {})
-    prof = dict(model.output.get("profile") or {})
-    loop_s = tp.get("loop_s") or model.output.get("training_loop_seconds", 0)
+    # ONE scrape for every stage read (each samples() pass runs the
+    # collector views, incl. an O(live arrays) device-memory walk)
+    stages1 = telemetry.stage_seconds(
+        "train.", samples=telemetry.registry().samples())
+
+    def stage(name):
+        tot = stages1.get(name, {})
+        pre = stages0.get(name, {})
+        d = tot.get("seconds", 0.0) - pre.get("seconds", 0.0)
+        return round(d, 4) if d else None
+
+    loop_s = stage("train.loop") \
+        or model.output.get("training_loop_seconds", 0)
     out = {
         "rows": fr.nrow, "ncol": fr.ncol, "trees": model.ntrees_built,
         "depth": DEPTH, "histogram_type": HIST,
         "cold_total_s": round(cold_total, 3),
         "warm_total_s": round(warm_total, 3),
-        "spec_s": prof.get("spec"),
-        "bin_s": tp.get("bin_s"),
+        # stage split from the driver's telemetry spans (same data the
+        # REST telemetry endpoints export for this run)
+        "spec_s": stage("train.spec"),
+        "bin_s": stage("train.bin"),
         "loop_s": round(loop_s, 3),
-        "score_s": tp.get("score_s"),
-        "finalize_s": tp.get("finalize_s"),
+        "score_s": stage("train.score"),
+        "finalize_s": stage("train.finalize"),
+        "warm_compiles": int(warm_compiles),
         "warm_over_loop": round(warm_total / max(loop_s, 1e-9), 2),
         "rows_per_sec_warm": round(fr.nrow * model.ntrees_built
                                    / max(loop_s, 1e-9), 1),
