@@ -122,6 +122,65 @@ def _disk_frame(rows):
 SERVE_SINGLE_ROWS = int(os.environ.get("H2O3_BENCH_SERVE_ROWS", 300))
 SERVE_SECONDS = float(os.environ.get("H2O3_BENCH_SERVE_SECS", 3.0))
 
+# streamed-GBM transfer guard (ISSUE 5): per-tree H2D bytes of the
+# memory-pressure path must stay within this factor of the dataset's
+# device footprint — the once-per-tree upload contract, asserted per
+# round instead of eyeballed. H2O3_BENCH_STREAM_GUARD=0 skips it.
+STREAM_GUARD_MAX_RATIO = 1.1
+
+
+def _streamed_guard_round():
+    """Train a small GBM through the FORCED memory-pressure path under a
+    budget whose resident window covers the dataset, and check h2d bytes
+    per tree against the device footprint (model.output.stream_profile,
+    fed by the telemetry byte counters)."""
+    import h2o3_tpu as h2o
+    from h2o3_tpu import memman
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    rng = np.random.default_rng(11)
+    n, F, trees = 40_000, 8, 8
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    logit = X[:, 0] - 0.6 * X[:, 1]
+    cols = {f"x{i}": X[:, i] for i in range(F)}
+    cols["resp"] = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)),
+                            "y", "n")
+    x_bytes = n * F * 4
+    try:
+        # budget below frame+design (forces streaming) but with a
+        # resident window that holds the design matrix
+        memman.reset(budget=int(2.2 * x_bytes))
+        fr = h2o.Frame.from_numpy(cols)
+        gbm = H2OGradientBoostingEstimator(
+            ntrees=trees, max_depth=4, nbins=16, seed=3,
+            score_tree_interval=0, stopping_rounds=0)
+        gbm.train(y="resp", training_frame=fr)
+        m = gbm.model
+        if not m.output.get("streamed"):
+            return {"ran": False, "reason": "budget did not force "
+                    "streaming (frame layout changed?)"}
+        sp = m.output.get("stream_profile") or {}
+        per_tree = sp.get("h2d_bytes_per_tree", 0)
+        resident = sp.get("h2d_resident_bytes", 0)
+        footprint = sp.get("device_footprint_bytes", x_bytes)
+        ratio = per_tree / max(footprint, 1)
+        # both halves of the contract: steady-state per-tree traffic
+        # within budget AND the once-per-train window upload bounded
+        # (~X + y/w/margin working vectors), so a 0.0 per-tree ratio
+        # can't mask a bloated initial upload
+        ok = (ratio <= STREAM_GUARD_MAX_RATIO
+              and resident <= 2.0 * footprint)
+        return {"ran": True, "trees": sp.get("trees"),
+                "chunks": sp.get("chunks"),
+                "resident_chunks": sp.get("resident_chunks"),
+                "h2d_bytes_per_tree": round(per_tree),
+                "h2d_resident_bytes": round(resident),
+                "device_footprint_bytes": footprint,
+                "ratio": round(ratio, 4),
+                "max_ratio": STREAM_GUARD_MAX_RATIO,
+                "pass": bool(ok)}
+    finally:
+        memman.reset()
+
 
 def _serve_round(model, fr, F):
     """Serving benchmark (ISSUE 3): deploy the trained GBM, measure
@@ -257,6 +316,8 @@ def main():
     gbm.train(y="label", training_frame=fr)
     total = time.time() - t0
     tel_warm = _telemetry_counts()
+    warm_h2d_per_tree = ((tel_warm["h2d_bytes"] - tel_cold["h2d_bytes"])
+                         / max(TREES, 1))
     loop_s = gbm.model.output["training_loop_seconds"]
     built = gbm.model.ntrees_built
     rows_per_sec = ROWS * built / loop_s
@@ -313,6 +374,19 @@ def main():
         "warm_train_s": round(total, 2),
         "loop_s": round(loop_s, 2),
     }
+    # transfer-minimal pipeline metrics (ISSUE 5): the warm dense train
+    # should upload ~nothing per tree (X is device-resident); the
+    # streamed guard below asserts the memory-pressure path's
+    # once-per-tree contract
+    out["train.h2d_bytes_per_tree"] = round(warm_h2d_per_tree)
+    if os.environ.get("H2O3_BENCH_STREAM_GUARD", "1") not in ("0", "false",
+                                                              ""):
+        try:
+            guard = _streamed_guard_round()
+            out["train.streamed_h2d_guard"] = guard
+            log(f"streamed h2d guard: {guard}")
+        except Exception as e:  # guard must never sink the headline run
+            log(f"streamed h2d guard FAILED to run: {e!r}")
     # per-round telemetry (ISSUE 4): compile count and transfer volume
     # regressions are now tracked in BENCH_*.json, not just wall time.
     # warm_train.compiles is the headline — the zero-recompile contract.
@@ -353,6 +427,11 @@ def main():
         # typed sharded Frame, rows/sec of wall-clock parse time
         out["ingest_seconds"] = round(ingest_s, 1)
         out["ingest_rows_per_sec"] = round(fr.nrow / ingest_s, 1)
+        # per-chunk streamed H2D: share of device_put wall time hidden
+        # under tokenize (ingest/stream.py; None = streaming not taken)
+        from h2o3_tpu.ingest.parse import LAST_PROFILE
+        out["ingest.h2d_overlap_ratio"] = LAST_PROFILE.get(
+            "h2d_overlap_ratio")
     print(json.dumps(out))
 
 

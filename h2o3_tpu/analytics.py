@@ -15,8 +15,16 @@ from typing import Dict, List, Optional, Sequence
 import jax
 import numpy as np
 
+from h2o3_tpu import telemetry
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.frame.vec import T_ENUM, Vec
+
+
+def _fetch(x):
+    """Counted device fetch: analytics' ad-hoc device_get calls show up
+    in the d2h byte counters as pipeline="analytics" (ROADMAP gap:
+    transfer accounting beyond the frame-layer choke points)."""
+    return telemetry.device_get(x, pipeline="analytics")
 
 
 def partial_dependence(model, frame: Frame, cols: Sequence[str],
@@ -27,7 +35,7 @@ def partial_dependence(model, frame: Frame, cols: Sequence[str],
     from h2o3_tpu.models.model_base import adapt_test_matrix
     import jax.numpy as jnp
     rng = np.random.default_rng(0)
-    X = np.asarray(jax.device_get(adapt_test_matrix(model, frame)))
+    X = np.asarray(_fetch(adapt_test_matrix(model, frame)))
     X = X[: frame.nrow]
     if len(X) > row_cap:
         X = X[rng.choice(len(X), row_cap, replace=False)]
@@ -51,7 +59,7 @@ def partial_dependence(model, frame: Frame, cols: Sequence[str],
         for g in grid:
             Xg = X.copy()
             Xg[:, j] = g
-            pred = np.asarray(jax.device_get(
+            pred = np.asarray(_fetch(
                 model._predict_matrix(jnp.asarray(Xg))))
             if pred.ndim == 2:          # classification → p(last class)
                 pred = pred[:, -1]
@@ -124,7 +132,7 @@ def tabulate(frame: Frame, x: str, y: str, nbins_x: int = 20,
 
     def codes_of(v, nbins):
         if v.is_categorical:
-            c = np.asarray(jax.device_get(v.as_float()))[: frame.nrow]
+            c = np.asarray(_fetch(v.as_float()))[: frame.nrow]
             labels = list(v.domain)
             return np.where(np.isnan(c), -1, c).astype(int), labels
         d = v.to_numpy()
@@ -166,7 +174,7 @@ def feature_interaction(model, frame: Frame, max_pairs: int = 10) -> List:
     rows = []
     from h2o3_tpu.models.model_base import adapt_test_matrix
     import jax.numpy as jnp
-    X = np.asarray(jax.device_get(
+    X = np.asarray(_fetch(
         adapt_test_matrix(model, frame)))[: frame.nrow]
     if len(X) > 2000:
         X = X[np.random.default_rng(0).choice(len(X), 2000, replace=False)]
@@ -195,7 +203,7 @@ def feature_interaction(model, frame: Frame, max_pairs: int = 10) -> List:
                 Xg = X.copy()
                 Xg[:, ja] = va
                 Xg[:, jb] = vb
-                pred = np.asarray(jax.device_get(
+                pred = np.asarray(_fetch(
                     model._predict_matrix(jnp.asarray(Xg))))
                 if pred.ndim == 2:
                     pred = pred[:, -1]
@@ -233,7 +241,7 @@ def interaction_frame(frame: Frame, factors: Sequence, pairwise: bool = False,
             v = frame.vec(c)
             if v.is_categorical:
                 dom = list(v.domain)
-                codes = np.asarray(jax.device_get(v.as_float()))[: frame.nrow]
+                codes = np.asarray(_fetch(v.as_float()))[: frame.nrow]
                 codes = np.where(np.isnan(codes), -1, codes).astype(int)
                 labels_per_col.append(dom)
                 codes_per_col.append(codes)

@@ -688,9 +688,13 @@ def _serve_stats(params, body):
 def _predict_rows(params, body, model):
     """Row-level scoring through the micro-batcher: JSON rows in
     ({"rows": [{col: value, ...}, ...]} or a bare list), predictions +
-    per-class probabilities out. Admission control maps to HTTP:
-    queue-full / deadline-expired → 503 (retryable), not-deployed →
-    404 with deploy guidance."""
+    per-class probabilities out. ``?format=columnar`` returns COLUMN
+    arrays ({"columns": {"predict": [...], "p<label>": [...]}}) from
+    the batch's one vectorized decode — bit-identical values to the
+    per-row dict shape at a fraction of the decode cost for large
+    batches. Admission control maps to HTTP: queue-full /
+    deadline-expired → 503 (retryable), not-deployed → 404 with deploy
+    guidance."""
     from h2o3_tpu import serve
     rows = params.get("rows")
     if rows is None and body:
@@ -707,8 +711,21 @@ def _predict_rows(params, body, model):
         raise ApiError(400, 'expected {"rows": [{column: value, ...}]}')
     tmo = _coerce(params.get("timeout_ms")) \
         if params.get("timeout_ms") is not None else None
+    fmt = (params.get("format") or "rows").lower()
+    if fmt not in ("rows", "columnar"):
+        raise ApiError(400, f"unknown format '{fmt}' — use 'rows' or "
+                       f"'columnar'")
     try:
         # explicit timeout_ms=0 means fail-fast, NOT the default
+        if fmt == "columnar":
+            cols = serve.predict_columnar(
+                model, rows,
+                timeout_ms=float(tmo) if tmo is not None else None)
+            return {"__meta": {"schema_version": 3,
+                               "schema_name": "ServePredictionsColumnarV3"},
+                    "model_id": schemas.keyref(model, "Key<Model>"),
+                    "nrow": len(rows),
+                    "columns": cols}
         preds = serve.predict_rows(
             model, rows, timeout_ms=float(tmo) if tmo is not None else None)
     except KeyError as e:

@@ -58,8 +58,9 @@ class Frame:
 
     @staticmethod
     def from_typed_column_groups(names: Sequence[str], groups, ncol: int,
-                                 mesh=None,
-                                 key: Optional[str] = None) -> "Frame":
+                                 mesh=None, key: Optional[str] = None,
+                                 preset: Optional[Dict[int, Vec]] = None
+                                 ) -> "Frame":
         """Streaming variant of :func:`from_typed_columns`: ``groups`` is
         an ITERABLE of ``[(column_index, EncodedColumn-like), ...]``
         lists. Each group's (async) host→device DMAs are issued before
@@ -67,12 +68,21 @@ class Frame:
         defer its expensive merge work (the enum domain union) until the
         cheap groups' transfers are already in flight, overlapping DMA
         with host-side merging (the ingest pipeline's last
-        serialization point, ROADMAP "pack+transfer" lever)."""
+        serialization point, ROADMAP "pack+transfer" lever).
+
+        ``preset`` slots in columns already assembled elsewhere — the
+        per-chunk device streamer (ingest/stream.py) hands its finished
+        numeric/time Vecs over this way while enum/str columns still ride
+        the grouped host merge."""
         from h2o3_tpu.frame.vec import (ENUM_NA, _numeric_host_copy,
                                         batch_device_put)
         mesh = mesh or current_mesh()
         vecs: List[Optional[Vec]] = [None] * ncol
         nrow = 0
+        if preset:
+            for i, v in preset.items():
+                vecs[i] = v
+                nrow = v.nrow
         for group in groups:
             f32_cols, f32_meta = [], []  # numeric + time: one f32 matrix
             i32_cols, i32_meta = [], []  # enum codes: one i32 matrix

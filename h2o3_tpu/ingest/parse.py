@@ -295,18 +295,60 @@ def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
             else:
                 jobs.append((p, 0, size, setup.header))
         native_ok = _native_available() and _na_strings_native_safe(setup)
+        skipped = _skipped_set(setup)
+        active = [i for i in range(len(setup.column_names)) if i not in skipped]
+        # per-chunk H2D streaming (ROADMAP "per-CHUNK device_put" lever):
+        # numeric/time columns transfer the moment their chunk finishes
+        # tokenizing, double-buffered, and assemble device-side — the
+        # host-side full-column concat disappears for those groups
+        stream_cols = [i for i in active
+                       if setup.column_types[i] in (T_REAL, T_INT, T_TIME)]
+        # default 'auto': stream only on a single-data-shard mesh — the
+        # per-chunk puts land on ONE device and the assembly resharding
+        # would stage the whole numeric group there, defeating a wide
+        # mesh's 1/ndev-per-device layout (the grouped host-merge path
+        # uploads directly sharded). '1' forces, '0' disables.
+        stream_env = os.environ.get("H2O3_INGEST_STREAM", "auto")
+        if stream_env in ("0", "false", ""):
+            stream_ok = False
+        elif stream_env == "1":
+            stream_ok = True
+        else:
+            from h2o3_tpu.parallel.mesh import n_data_shards
+            stream_ok = n_data_shards(mesh) == 1
+        want_stream = bool(len(jobs) > 1 and stream_cols and stream_ok)
+        streamer = None
         results: List[Optional[List[EncodedColumn]]] = [None] * len(jobs)
         if native_ok:
             if len(jobs) == 1:
                 p, s, e, skip = jobs[0]
                 results[0] = _encode_range_native(p, s, e, setup, skip)
             else:
+                from h2o3_tpu.ingest.stream import ChunkDeviceStreamer
+                from h2o3_tpu.parallel.mesh import current_mesh
+                if want_stream:
+                    streamer = ChunkDeviceStreamer(
+                        stream_cols, list(setup.column_types), len(jobs),
+                        mesh or current_mesh())
                 workers = min(len(jobs), os.cpu_count() or 4, 16)
                 with cf.ThreadPoolExecutor(max_workers=workers) as ex:
-                    futs = [ex.submit(_encode_range_native, p, s, e, setup, skip)
-                            for p, s, e, skip in jobs]
-                    results = [fu.result() for fu in futs]
+                    futs = {ex.submit(_encode_range_native, p, s, e, setup,
+                                      skip): k
+                            for k, (p, s, e, skip) in enumerate(jobs)}
+                    for fu in cf.as_completed(futs):
+                        k = futs[fu]
+                        results[k] = fu.result()
+                        if streamer is not None and results[k] is not None:
+                            # chunk's DMA issued NOW, under the remaining
+                            # workers' tokenize time
+                            streamer.add(k, results[k])
         todo = [k for k, r in enumerate(results) if r is None]
+        if todo and streamer is not None:
+            # a declined range sends every range through the Python
+            # tokenizer (import-scoped fallback below) — native-encoded
+            # device chunks must not survive into the re-parse
+            streamer.discard()
+            streamer = None
         if todo:
             # fallback is IMPORT-scoped, not range-scoped: the two tokenizers
             # disagree on edge tokens (>63-char numerics, unicode
@@ -336,13 +378,15 @@ def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
                     p, s, e, skip = jobs[k]
                     results[k] = _encode_range_python(p, s, e, setup, skip)
         t1 = time.perf_counter()
-        # ONE clock feeds both LAST_PROFILE and the telemetry spans — the
-        # REST-reported and tool-reported stage splits cannot disagree
-        telemetry.record_span("ingest.tokenize_encode", t_wall, t1 - t0,
+        # the streamed transfers ran INSIDE the tokenize window — report
+        # tokenize net of that hidden transfer time so the two stages
+        # stay additive (ONE clock still feeds both LAST_PROFILE and the
+        # spans, so REST- and tool-reported splits cannot disagree)
+        hidden_put_s = streamer.add_seconds if streamer is not None else 0.0
+        telemetry.record_span("ingest.tokenize_encode", t_wall,
+                              t1 - t0 - hidden_put_s,
                               parent=root, chunks=len(jobs))
-        skipped = _skipped_set(setup)
         names = [n for i, n in enumerate(setup.column_names) if i not in skipped]
-        active = [i for i in range(len(setup.column_names)) if i not in skipped]
         pos = {orig: j for j, orig in enumerate(active)}   # filtered index
         merge_s = [0.0]
 
@@ -360,25 +404,47 @@ def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
                                   parent=root, cols=len(idx))
             return out
 
+        preset = None
+        streamed = frozenset()
+        if streamer is not None:
+            # block on the outstanding per-chunk DMAs and assemble the
+            # numeric/time columns device-side (no host full-column
+            # concat); wide-int exact columns fall back to the merge
+            vec_map = streamer.assemble()
+            streamed = frozenset(vec_map)
+            preset = {pos[i]: v for i, v in vec_map.items()}
+
         def _groups():
             # numeric/time/str first: their merge is a cheap concat, and
             # issuing their device DMA NOW lets the transfer run underneath
             # the enum group's domain union + LUT remap (the expensive host
-            # half of the merge) instead of after it
-            yield _merged([i for i in active
-                           if setup.column_types[i] != T_ENUM])
-            yield _merged([i for i in active
-                           if setup.column_types[i] == T_ENUM])
+            # half of the merge) instead of after it. Streamed columns are
+            # already on device and skip the merge entirely.
+            yield _merged([i for i in active if i not in streamed
+                           and setup.column_types[i] != T_ENUM])
+            yield _merged([i for i in active if i not in streamed
+                           and setup.column_types[i] == T_ENUM])
 
         t2_wall = time.time()
         fr = Frame.from_typed_column_groups(
             names, _groups(), len(active), mesh=mesh,
-            key=key or os.path.basename(paths[0]))
+            key=key or os.path.basename(paths[0]), preset=preset)
         t3 = time.perf_counter()
-        # device_put net of the interleaved domain-union work (the union
-        # spans are children of the same root and reported separately)
-        telemetry.record_span("ingest.device_put", t2_wall,
-                              t3 - t1 - merge_s[0], parent=root)
+        # device_put = hidden per-chunk streaming + visible assembly/group
+        # DMA, net of the interleaved domain-union work (the union spans
+        # are children of the same root and reported separately)
+        visible_put_s = t3 - t1 - merge_s[0]
+        put_total_s = hidden_put_s + visible_put_s
+        overlap = (hidden_put_s / put_total_s
+                   if streamer is not None and put_total_s > 0 else None)
+        telemetry.record_span("ingest.device_put", t2_wall, put_total_s,
+                              parent=root, hidden_s=round(hidden_put_s, 4),
+                              overlap_ratio=overlap)
+        if overlap is not None:
+            telemetry.gauge("h2o3_ingest_h2d_overlap_ratio",
+                            help="share of the ingest pack+transfer "
+                            "(device_put) stage hidden under tokenize"
+                            ).set(overlap)
         if root is not None:
             root.attrs.update(rows=fr.nrow, chunks=len(jobs))
             root.finish()
@@ -386,9 +452,13 @@ def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
         LAST_PROFILE.clear()
         LAST_PROFILE.update({"rows": fr.nrow, "chunks": len(jobs),
                              "native": bool(native_ok and not todo),
-                             "tokenize_encode_s": round(t1 - t0, 4),
+                             "streamed": streamer is not None,
+                             "tokenize_encode_s": round(t1 - t0 - hidden_put_s, 4),
                              "merge_s": round(merge_s[0], 4),
-                             "device_put_s": round(t3 - t1 - merge_s[0], 4)})
+                             "device_put_s": round(put_total_s, 4),
+                             "h2d_overlap_ratio": (round(overlap, 4)
+                                                   if overlap is not None
+                                                   else None)})
         return fr
     finally:
         # a parse that raises mid-pipeline still closes its root span,

@@ -651,9 +651,13 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         """Memory-pressure path: the frame exceeded the device budget, so
         X stays on host and every tree streams row chunks through the
         adaptive level kernels (models/tree.py
-        grow_tree_adaptive_streamed; water/Cleaner.java graceful
-        degradation — slower, but any frame that fits host RAM trains)."""
+        grow_tree_adaptive_streamed over a models/streaming.py
+        StreamedChunks pipeline: budget-sized resident window uploaded
+        once per train, overflow chunks double-buffered per level;
+        water/Cleaner.java graceful degradation — slower, but any frame
+        that fits host RAM trains)."""
         from h2o3_tpu import memman
+        from h2o3_tpu.models.streaming import StreamedChunks
         from h2o3_tpu.models.tree import grow_tree_adaptive_streamed
         p = self.params
         if spec.nclasses > 2:
@@ -704,7 +708,6 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                                  rows), 16384))
         f0 = float(jax.device_get(dist.init_f0(jnp.asarray(y_host),
                                                jnp.asarray(w_host))))
-        margin_host = np.full(rows, f0, np.float32)
         ntrees = int(p["ntrees"])
         lr = float(p["learn_rate"])
         anneal = float(p.get("learn_rate_annealing", 1.0) or 1.0)
@@ -712,6 +715,8 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                     * float(p.get("col_sample_rate_per_tree", 1.0)))
         seed = int(p.get("seed", -1) or -1)
         key = jax.random.PRNGKey(seed if seed != -1 else 0)
+        chunks = StreamedChunks(X_host, y_host, w_host, f0, chunk_rows,
+                                padded_rows=int(spec.y.shape[0]))
         trees = []
         t0 = time.time()
         for t in range(ntrees):
@@ -721,19 +726,21 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                 col_mask = (jax.random.uniform(
                     jax.random.fold_in(tkey, 1), (spec.n_features,))
                     < col_rate)
-            tree, margin_host = grow_tree_adaptive_streamed(
-                X_host, y_host, margin_host, dist, lr, w_host, cfg,
-                root_lo, root_hi, nb_f, chunk_rows, key=tkey,
+            tree = grow_tree_adaptive_streamed(
+                chunks, dist, lr, cfg, root_lo, root_hi, nb_f, key=tkey,
                 sample_rate=float(p.get("sample_rate", 1.0)),
                 col_mask=col_mask)
-            # lr-scale values like the dense finalize does
+            # lr-scale values like the dense finalize does (float64
+            # product rounded once at model construction — bit-matching
+            # `val * lrs[:, None]` in _finalize)
             tree = dict(tree)
-            tree["value"] = tree["value"] * np.float32(lr)
+            tree["value"] = tree["value"].astype(np.float64) * lr
             trees.append(tree)
             lr *= anneal
             job.set_progress((t + 1) / ntrees)
             if job.cancel_requested:
                 break
+        margin_host = chunks.gather_margin()
         t_loop = time.time() - t0
         T = len(trees)
         trees_host = {k: np.stack([tr[k] for tr in trees]) for k in
@@ -757,6 +764,21 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                            else vi[order]).tolist()}
         model.output["training_loop_seconds"] = t_loop
         model.output["streamed"] = True
+        # transfer accounting for the bench guard: h2d bytes per tree vs
+        # the dataset's device footprint (once-per-tree contract). The
+        # count is the pipeline's OWN tally (chunks.h2d_bytes), not a
+        # process-global counter delta — concurrent serve/parse traffic
+        # must not be attributed to this train
+        sp = chunks.profile()
+        sp["trees"] = T
+        # steady-state per-tree traffic: the once-per-train resident
+        # window upload is reported separately, not amortized — at
+        # ntrees=1 amortization would read ~1.6x footprint and false-
+        # fail the once-per-tree guard even though each chunk crossed
+        # the bus exactly once
+        sp["h2d_bytes_per_tree"] = (
+            (sp["h2d_bytes"] - sp["h2d_resident_bytes"]) / T) if T else 0
+        model.output["stream_profile"] = sp
         padded = int(spec.y.shape[0])
         mpad = np.full(padded, f0, np.float32)
         mpad[:rows] = margin_host       # pad rows carry w=0 in metrics

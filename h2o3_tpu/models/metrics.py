@@ -12,7 +12,6 @@ order-preserving 2^17-bucket histogram sketch — 300x finer than AUC2's
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from functools import partial
 from typing import Dict, Optional
 
 import jax
@@ -408,6 +407,12 @@ def _gains_lift_from_curve(sb, tpb, fpb, Pf, Nf, groups: int = 16):
 
 @jax.jit
 def _multinomial_kernel(probs, y, w):
+    """Full multinomial aggregate pass ON DEVICE: logloss, argmax error,
+    confusion matrix, 1-vs-all MSE and the hit-position histogram all
+    reduce to O(K²) outputs here, so finalize does ONE small device_get
+    of aggregates and the O(n·K) probability matrix never crosses to the
+    host (the old path fetched it three times: py gather, argsort ranks,
+    and the OVR AUC table)."""
     eps = 1e-7  # f32-safe: 1-1e-15 rounds to 1.0f -> log1p(-1) = -inf
     rows = probs.shape[0]
     py = probs[jnp.arange(rows), y]
@@ -416,7 +421,56 @@ def _multinomial_kernel(probs, y, w):
     err = (w * (pred != y)).sum() / w.sum()
     K = probs.shape[1]
     cm = jnp.zeros((K, K), dtype=jnp.float32).at[y, pred].add(w)
-    return ll, err, cm, pred
+    # 1-vs-all MSE (reference semantics: 1 - p_actual)
+    mse = (w * (1.0 - py) ** 2).sum() / w.sum()
+    # hit ratio @k: position of the true class in the per-row descending
+    # sort (same jnp.argsort tie-breaking the host path used), histogram
+    # over positions — the cumulative sum happens host-side on [K] floats
+    ranks = jnp.argsort(-probs, axis=1)
+    pos = jnp.argmax(ranks == y[:, None], axis=1)
+    hitpos = jnp.zeros(K, jnp.float32).at[pos].add(1.0) / rows
+    return ll, err, cm, mse, hitpos
+
+
+@jax.jit
+def _ovr_auc_kernel(probs, y, w):
+    """One-vs-rest AUC/PR-AUC per class, entirely on device: each class
+    column runs the 2^17-bucket order-preserving sketch (`auc_device`'s
+    curve) and reduces to scalars — the fetch is 3·[K] floats however
+    large n is. Empty buckets contribute zero-width chords (AUC) and
+    zero-recall steps (PR), so no occupancy filtering is needed."""
+    wtot = w.sum()
+
+    def one_class(k):
+        yk = (y == k).astype(jnp.float32)
+        hp, hn, _ = _binned_curve_kernel(probs[:, k], yk, w)
+        tp = jnp.cumsum(hp[::-1])
+        fp = jnp.cumsum(hn[::-1])
+        P, N = tp[-1], fp[-1]
+        tp_prev = jnp.concatenate([jnp.zeros(1, tp.dtype), tp[:-1]])
+        fp_prev = jnp.concatenate([jnp.zeros(1, fp.dtype), fp[:-1]])
+        auc = ((fp - fp_prev) * (tp + tp_prev)).sum() * 0.5 \
+            / jnp.maximum(P * N, 1e-30)
+        prec = tp / jnp.maximum(tp + fp, 1e-30)
+        rec = tp / jnp.maximum(P, 1e-30)
+        rec_prev = tp_prev / jnp.maximum(P, 1e-30)
+        aucpr = ((rec - rec_prev) * prec).sum()
+        # degenerate-class weight directly, NOT the bucket cumsum: for a
+        # single-class input w·yk == w elementwise, so this sum is
+        # bit-equal to wtot and the >= guard below cannot be defeated by
+        # the scatter-add's different accumulation order
+        wk = (w * yk).sum()
+        return auc, aucpr, wk
+
+    K = probs.shape[1]
+    per_auc, per_pr, prevalence = jax.vmap(one_class)(jnp.arange(K))
+    # degenerate classes (no positives / no negatives under the weights)
+    # have an undefined OVR AUC — mask to NaN on device like the host
+    # path's wk<=0 / wk>=wtot guard
+    bad = (prevalence <= 0) | (prevalence >= wtot)
+    nan = jnp.float32(jnp.nan)
+    return (jnp.where(bad, nan, per_auc), jnp.where(bad, nan, per_pr),
+            prevalence)
 
 
 @dataclass
@@ -445,28 +499,25 @@ def multinomial_auc_table(probs, y, w, max_classes=20) -> Optional[dict]:
     """One-vs-rest AUC per class + macro/weighted averages.
 
     Reference: hex/MultinomialAUC.java (default OVR). Skipped above
-    `max_classes` (the reference gates this behind auc_type for memory;
-    here it is K device sorts, cheap but pointless for huge K)."""
-    K = probs.shape[1]
+    `max_classes` (the reference gates this behind auc_type for memory).
+    Computed on device via the 2^17-bucket sketch (``_ovr_auc_kernel``)
+    so the fetch is 3·[K] scalars regardless of n — the old path pulled
+    the whole probability matrix host-side and sorted each class column;
+    sketch-vs-exact AUC deviation is bounded by the bucket quantisation
+    (~1e-4, same contract as the binomial large-n path)."""
+    probs = jnp.asarray(probs, jnp.float32)
+    K = int(probs.shape[1])
     if K > max_classes:
         return None
-    per_auc, per_pr, prevalence = [], [], []
-    wn = np.asarray(w, np.float64)
-    wtot = wn.sum()
-    for k in range(K):
-        yk = (np.asarray(y) == k).astype(np.float32)
-        wk = (wn * yk).sum()
-        if wk <= 0 or wk >= wtot:  # weighted degenerate: OVR AUC undefined
-            per_auc.append(float("nan")); per_pr.append(float("nan"))
-            prevalence.append(float((wn * yk).sum()))
-            continue
-        _, _, _, _, _, auc_k, pr_k = _binary_curve(
-            jnp.asarray(probs[:, k]), jnp.asarray(yk), jnp.asarray(w))
-        per_auc.append(float(auc_k))
-        per_pr.append(float(pr_k))
-        prevalence.append(float((wn * yk).sum()))
-    pa = np.asarray(per_auc); pp = np.asarray(per_pr)
-    pv = np.asarray(prevalence); pv = pv / max(pv.sum(), 1e-30)
+    per_auc_d, per_pr_d, prev_d = _ovr_auc_kernel(
+        probs, jnp.asarray(y, jnp.int32), jnp.asarray(w, jnp.float32))
+    from h2o3_tpu import telemetry
+    pa, pp, pv = telemetry.device_get((per_auc_d, per_pr_d, prev_d),
+                                      pipeline="train")
+    pa = np.asarray(pa, np.float64)
+    pp = np.asarray(pp, np.float64)
+    pv = np.asarray(pv, np.float64)
+    pv = pv / max(pv.sum(), 1e-30)
     ok = ~np.isnan(pa)
     macro = float(pa[ok].mean()) if ok.any() else float("nan")
     weighted = float((pa[ok] * pv[ok]).sum() / max(pv[ok].sum(), 1e-30)) \
@@ -474,37 +525,38 @@ def multinomial_auc_table(probs, y, w, max_classes=20) -> Optional[dict]:
     macro_pr = float(pp[ok].mean()) if ok.any() else float("nan")
     weighted_pr = float((pp[ok] * pv[ok]).sum() / max(pv[ok].sum(), 1e-30)) \
         if ok.any() else float("nan")
-    return {"per_class_auc": per_auc, "per_class_aucpr": per_pr,
+    return {"per_class_auc": [float(v) for v in pa],
+            "per_class_aucpr": [float(v) for v in pp],
             "macro_auc": macro, "weighted_auc": weighted,
             "macro_aucpr": macro_pr, "weighted_aucpr": weighted_pr}
 
 
 def make_multinomial_metrics(probs, actual, weights=None) -> ModelMetricsMultinomial:
+    """All aggregates computed on device; the host sees O(K²) numbers
+    (confusion matrix, hit histogram, OVR AUC scalars) in two counted
+    fetches — never the [n, K] probability matrix (transfer-budget
+    guarded in tests/test_transfer_budget.py)."""
     probs = jnp.asarray(probs, dtype=jnp.float32)
     y = jnp.asarray(actual, dtype=jnp.int32)
     w = (jnp.ones(probs.shape[0], jnp.float32) if weights is None
          else jnp.asarray(weights, jnp.float32))
-    ll, err, cm, _ = _multinomial_kernel(probs, y, w)
+    from h2o3_tpu import telemetry
+    ll, err, cm, mse, hitpos = telemetry.device_get(
+        _multinomial_kernel(probs, y, w), pipeline="train")
     cm = np.asarray(cm)
     K = cm.shape[0]
     row_tot = cm.sum(axis=1)
     per_class = np.where(row_tot > 0, 1.0 - np.diag(cm) / np.maximum(row_tot, 1e-30), 0.0)
     present = row_tot > 0
     mpce = float(per_class[present].mean()) if present.any() else 0.0
-    # MSE on 1-vs-all probabilities (reference semantics: 1 - p_actual)
-    rows = probs.shape[0]
-    py = np.asarray(probs)[np.arange(rows), np.asarray(y)]
-    wh = np.asarray(w)
-    mse = float((wh * (1.0 - py) ** 2).sum() / wh.sum())
-    # hit ratio @k
-    ranks = np.asarray(jnp.argsort(-probs, axis=1))
-    hits = ranks == np.asarray(y)[:, None]
-    hr = np.cumsum(hits.mean(axis=0))[: min(K, 10)]
-    auct = multinomial_auc_table(np.asarray(probs), np.asarray(y),
-                                 np.asarray(w))
+    mse = float(mse)
+    # hit ratio @k: cumulative share of rows whose true class ranks in
+    # the top k (host cumsum over the [K] device histogram)
+    hr = np.cumsum(np.asarray(hitpos, np.float64))[: min(K, 10)]
+    auct = multinomial_auc_table(probs, y, w)
     return ModelMetricsMultinomial(
-        logloss=float(np.asarray(ll)), mse=mse, rmse=float(np.sqrt(mse)),
-        mean_per_class_error=mpce, error=float(np.asarray(err)),
+        logloss=float(ll), mse=mse, rmse=float(np.sqrt(mse)),
+        mean_per_class_error=mpce, error=float(err),
         confusion_matrix=cm, hit_ratios=hr, nobs=int(probs.shape[0]),
         auc=None if auct is None else auct["macro_auc"],
         aucpr=None if auct is None else auct["macro_aucpr"],

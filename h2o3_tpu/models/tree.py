@@ -924,28 +924,32 @@ def collect_chunk_trees(all_trees, M: int, edges) -> dict:
     return out
 
 
-def grow_tree_adaptive_streamed(X_host, y_host, margin_host, dist, lr,
-                                w_host, cfg: TreeConfig, root_lo, root_hi,
-                                nb_f, chunk_rows: int, key=None,
+def grow_tree_adaptive_streamed(chunks, dist, lr, cfg: TreeConfig,
+                                root_lo, root_hi, nb_f, key=None,
                                 sample_rate: float = 1.0,
                                 col_mask=None):
     """Host-chunked adaptive tree build for frames beyond the device
     budget (the memman streaming mode; water/Cleaner.java graceful
     degradation). Semantics match grow_tree_adaptive with per-node
-    adaptive bins; rows stream through the SAME level kernels in
-    ``chunk_rows`` blocks with the per-row nid state held on host, and
-    per-level histograms accumulate across chunks (the psum analog is a
-    host-side '+').
+    adaptive bins; rows stream through the SAME level kernels via the
+    ``chunks`` manager (models/streaming.py StreamedChunks):
 
-    Trades H2D bandwidth for memory: every level re-uploads each chunk,
-    so throughput degrades by roughly levels × (transfer/compute ratio)
-    — but any frame that fits HOST memory trains.
+    - chunks inside the budget's RESIDENT window keep X on device for
+      the whole train — uploaded once per train, not once per level
+      (the old path re-uploaded every chunk every level);
+    - overflow chunks double-buffer: chunk k+1's upload is issued while
+      chunk k's level kernel runs;
+    - per-level histograms accumulate across chunks (the psum analog is
+      a device '+'), and resident chunks' margins update ON DEVICE with
+      the dense chunk body's f32 arithmetic — a fully-resident streamed
+      train is bit-identical to the dense grower on one chunk.
 
-    Returns (tree dict of [M] numpy arrays with raw thresholds,
-    updated margin_host)."""
+    Returns the tree dict of [M] numpy arrays with raw thresholds; the
+    updated margins live in ``chunks`` (``gather_margin()`` at the end
+    of training)."""
     from h2o3_tpu.ops.hist_adaptive import adaptive_level, pick_W, route_only
 
-    rows, F = X_host.shape
+    rows, F = chunks.rows, chunks.F
     D = cfg.max_depth
     M = cfg.n_nodes
     W = pick_W(cfg.n_bins)
@@ -953,27 +957,32 @@ def grow_tree_adaptive_streamed(X_host, y_host, margin_host, dist, lr,
         nb_f = jnp.full(F, float(min(cfg.n_bins, W - 2)), jnp.float32)
     else:
         nb_f = jnp.minimum(jnp.asarray(nb_f, jnp.float32), float(W - 2))
-    find_cfg = TreeConfig(max_depth=cfg.max_depth, n_bins=W - 1,
-                          n_features=F, min_rows=cfg.min_rows,
-                          min_split_improvement=cfg.min_split_improvement,
-                          reg_lambda=cfg.reg_lambda,
-                          reg_alpha=cfg.reg_alpha)
+    from dataclasses import replace as dc_replace
+    find_cfg = dc_replace(cfg, n_bins=W - 1)
     if col_mask is None:
         col_mask = jnp.ones(F, bool)
+    # histogram contraction precision: same rule as the dense grower,
+    # sized by the frame's PADDED row count like the dense path's
+    # X.shape[0] so the choice agrees at the 2^18 boundary
+    if cfg.histogram_precision in ("float32", "f32"):
+        mxu_dtype = jnp.float32
+    elif cfg.histogram_precision in ("bfloat16", "bf16"):
+        mxu_dtype = jnp.bfloat16
+    else:
+        mxu_dtype = (jnp.float32 if chunks.padded_rows < (1 << 18)
+                     else jnp.bfloat16)
+
+    chunks.begin_tree(key, sample_rate)
 
     if D == 0:
         # degenerate stump (the dense grower's D==0 branch): exact
         # totals over chunks -> one root leaf
         gs = hs = ws = 0.0
-        mg_all = jnp.asarray(margin_host)
-        for s in range(0, rows, chunk_rows):
-            e = min(s + chunk_rows, rows)
-            g, h = dist.grad_hess(jnp.asarray(margin_host[s:e]),
-                                  jnp.asarray(y_host[s:e]))
-            wv = jnp.asarray(w_host[s:e])
-            gs += float(jax.device_get((g * wv).sum()))
-            hs += float(jax.device_get((h * wv).sum()))
-            ws += float(jax.device_get(wv.sum()))
+        for ch in chunks.level_pass(need_x=False):
+            ghw = ch.ghw(dist)
+            gs += float(jax.device_get(ghw[0].sum()))
+            hs += float(jax.device_get(ghw[1].sum()))
+            ws += float(jax.device_get(ghw[2].sum()))
         v0 = float(jax.device_get(_leaf_value(jnp.float32(gs),
                                               jnp.float32(hs), cfg)))
         tree = {"feat": np.full(1, -1, np.int32),
@@ -983,25 +992,11 @@ def grow_tree_adaptive_streamed(X_host, y_host, margin_host, dist, lr,
                 "value": np.array([v0], np.float32),
                 "gain": np.zeros(1, np.float32),
                 "node_w": np.array([ws], np.float32)}
-        margin_host += np.float32(lr * v0)
-        return tree, margin_host
-
-    nid_host = np.zeros(rows, np.int32)
-    # per-chunk (g, h, w) from the current margin (recomputed on device
-    # per chunk; the margin itself stays on host)
-    wt_host = w_host
-    if sample_rate < 1.0 and key is not None:
-        import jax.random as jrandom
-        u = np.asarray(jax.device_get(
-            jrandom.uniform(key, (rows,))))
-        wt_host = w_host * (u < sample_rate)
-
-    def ghw_chunk(s, e):
-        mg = jnp.asarray(margin_host[s:e])
-        yv = jnp.asarray(y_host[s:e])
-        g, h = dist.grad_hess(mg, yv)
-        wv = jnp.asarray(wt_host[s:e])
-        return jnp.stack([g * wv, h * wv, wv]).astype(jnp.float32)
+        v0_dev = jnp.asarray(np.array([v0], np.float32))
+        for ch in chunks.level_pass(need_x=False):
+            ch.apply_leaf(jnp.float32(lr), v0_dev,
+                          jnp.zeros(ch.e - ch.s, jnp.int32))
+        return tree
 
     feat = np.full(M, -1, np.int32)
     thr_arr = np.zeros(M, np.float32)
@@ -1019,7 +1014,6 @@ def grow_tree_adaptive_streamed(X_host, y_host, margin_host, dist, lr,
     tables = (zeros1, zeros1, zeros1, zeros1)
     vl_s = vr_s = wl_s = wr_s = None
 
-    from h2o3_tpu import memman
     for d in range(D):
         N = 2 ** d
         base = N - 1
@@ -1028,15 +1022,12 @@ def grow_tree_adaptive_streamed(X_host, y_host, margin_host, dist, lr,
                           nb_f[None, :] / jnp.where(span > 0, span, 1.0),
                           0.0)
         hist = None
-        for s in range(0, rows, chunk_rows):
-            e = min(s + chunk_rows, rows)
-            memman.manager().request((e - s) * F * 4)
-            Xc = jnp.asarray(X_host[s:e])
-            nidc = jnp.asarray(nid_host[s:e])
-            ghw = ghw_chunk(s, e)
-            nid2, h_c = adaptive_level(Xc, nidc, ghw, tables, lo_d, inv_d,
-                                       N // 2 if d else 0, N, base, W)
-            nid_host[s:e] = np.asarray(jax.device_get(nid2))
+        for ch in chunks.level_pass():
+            ghw = ch.ghw(dist)
+            nid2, h_c = adaptive_level(ch.X, ch.nid, ghw, tables, lo_d,
+                                       inv_d, N // 2 if d else 0, N, base,
+                                       W, mxu_dtype=mxu_dtype)
+            ch.put_nid(nid2)
             hist = h_c if hist is None else hist + h_c
         trip = (hist[0], hist[1], hist[2])
         bg, bf, bb, bnl, gt, ht, wt_, vl_s, vr_s, wl_s, wr_s = _find_splits(
@@ -1082,20 +1073,20 @@ def grow_tree_adaptive_streamed(X_host, y_host, margin_host, dist, lr,
     # deepest level: route chunks, leaf values from last selected splits
     ND = 2 ** D
     baseD = ND - 1
-    vD = np.asarray(jax.device_get(
-        jnp.stack([vl_s, vr_s], axis=1).reshape(ND)))
+    vD_dev = jnp.stack([vl_s, vr_s], axis=1).reshape(ND)
     wD = np.asarray(jax.device_get(
         jnp.stack([wl_s, wr_s], axis=1).reshape(ND)))
-    value[baseD:] = vD
+    value[baseD:] = np.asarray(jax.device_get(vD_dev))
     node_w[baseD:] = wD
     tree = {"feat": feat, "thr": thr_arr, "na_left": na_left,
             "is_split": is_split, "value": value, "gain": gain_arr,
             "node_w": node_w}
-    for s in range(0, rows, chunk_rows):
-        e = min(s + chunk_rows, rows)
-        Xc = jnp.asarray(X_host[s:e])
-        nidc = jnp.asarray(nid_host[s:e])
-        nid2 = route_only(Xc, nidc, tables, ND // 2, baseD)
-        leaf = value[np.asarray(jax.device_get(nid2))]
-        margin_host[s:e] = margin_host[s:e] + lr * leaf
-    return tree, margin_host
+    # final route + margin update: one fused device pass per chunk (the
+    # deepest values stay on device — same f32 gather+FMA as the dense
+    # chunk body's `margin + lr_t * tree["value"][nid]`)
+    value_dev = jnp.asarray(value)
+    lr_t = jnp.float32(lr)
+    for ch in chunks.level_pass():
+        nid2 = route_only(ch.X, ch.nid, tables, ND // 2, baseD)
+        ch.apply_leaf(lr_t, value_dev, nid2)
+    return tree

@@ -33,6 +33,14 @@ from h2o3_tpu import dkv
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.frame.vec import T_ENUM, T_INT, T_REAL, T_STR, Vec
 
+
+def _fetch(x):
+    """Counted device fetch: Rapids' ad-hoc device_get calls land in the
+    d2h byte counters as pipeline="rapids" (ROADMAP gap: transfer
+    accounting beyond the frame-layer choke points)."""
+    from h2o3_tpu import telemetry
+    return telemetry.device_get(x, pipeline="rapids")
+
 # ---------------- tokenizer / parser -----------------------------------
 
 _TOKEN = re.compile(r"""
@@ -153,7 +161,7 @@ def _map_elementwise(op, a, b=None) -> Any:
     else:
         return op(a, b) if b is not None else op(a)
     nrow = (a if isinstance(a, Frame) else b).nrow
-    vecs = [Vec.from_numpy(np.asarray(jax.device_get(c))[:nrow]
+    vecs = [Vec.from_numpy(np.asarray(_fetch(c))[:nrow]
                            .astype(np.float32)) for c in cols]
     return Frame(names, vecs)
 
@@ -166,7 +174,7 @@ def _reduce(fn, fr: Frame, na_rm=True) -> float:
             continue
         x = v.as_float()
         ok = ~jnp.isnan(x[: fr.nrow]) if na_rm else jnp.ones(fr.nrow, bool)
-        vals.append(float(jax.device_get(fn(x[: fr.nrow], ok))))
+        vals.append(float(_fetch(fn(x[: fr.nrow], ok))))
     return vals[0] if len(vals) == 1 else vals
 
 
@@ -222,7 +230,7 @@ def group_by(fr: Frame, by: Sequence[Union[int, str]],
         if agg in ("nrow", "count"):
             cnt = jax.ops.segment_sum(jnp.ones(nrow), gid_dev, n_groups)
             out_names.append("nrow")
-            out_cols.append((np.asarray(jax.device_get(cnt)), None))
+            out_cols.append((np.asarray(_fetch(cnt)), None))
             continue
         cn = fr.names[int(col)] if isinstance(col, (int, float)) else col
         x = fr.vec(cn).as_float()[:nrow]
@@ -249,7 +257,7 @@ def group_by(fr: Frame, by: Sequence[Union[int, str]],
         else:
             raise ValueError(f"unsupported group-by aggregate '{agg}'")
         out_names.append(f"{agg}_{cn}")
-        out_cols.append((np.asarray(jax.device_get(r)), None))
+        out_cols.append((np.asarray(_fetch(r)), None))
     vecs = []
     for (vals, domain) in out_cols:
         if domain is not None:
@@ -415,7 +423,7 @@ def sort_frame(fr: Frame, cols: Sequence[Union[int, str]],
         if len(names) == 1 and asc[0] and n_data_shards(current_mesh()) > 1:
             order = distributed_argsort(key_dev[0])
         else:
-            order = np.asarray(jax.device_get(
+            order = np.asarray(_fetch(
                 lexsort_device(key_dev, asc)))
     else:
         keys = []
@@ -1446,7 +1454,7 @@ def _apply(op: str, args, env: Env):
             b = no.vec(0).as_float() if isinstance(no, Frame) else no
             out = sel3(cond.vec(0).as_float(), a, b)
             return Frame(["C1"], [Vec.from_numpy(
-                np.asarray(jax.device_get(out))[: cond.nrow]
+                np.asarray(_fetch(out))[: cond.nrow]
                 .astype(np.float32))])
         return yes if cond else no
     if op == "unique":

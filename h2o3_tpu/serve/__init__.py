@@ -13,14 +13,16 @@ from h2o3_tpu.serve.batcher import (ServeBadRequestError, ServeClosedError,
 from h2o3_tpu.serve.codec import RowCodec
 from h2o3_tpu.serve.registry import DEFAULT_BUCKETS, CompiledScorer
 from h2o3_tpu.serve.service import (Deployment, deploy, deployment,
-                                    deployments, predict_rows,
-                                    shutdown_all, stats, undeploy)
+                                    deployments, predict_columnar,
+                                    predict_rows, shutdown_all, stats,
+                                    undeploy)
 from h2o3_tpu.serve.stats import ServeStats
 
 __all__ = [
     "CompiledScorer", "DEFAULT_BUCKETS", "Deployment", "RowCodec",
     "ServeBadRequestError", "ServeClosedError", "ServeDeadlineError",
     "ServeError", "ServeOverloadedError", "ServeStats", "deploy",
-    "deployment", "deployments", "predict_rows", "shutdown_all", "stats",
+    "deployment", "deployments", "predict_columnar", "predict_rows",
+    "shutdown_all", "stats",
     "undeploy",
 ]

@@ -55,18 +55,20 @@ class ServeClosedError(ServeError):
 
 class _Request:
     __slots__ = ("rows", "n", "t_enqueue", "t_wall", "deadline", "event",
-                 "results", "error", "abandoned")
+                 "results", "error", "abandoned", "columnar")
 
-    def __init__(self, rows: Sequence[Dict[str, Any]], deadline: float):
+    def __init__(self, rows: Sequence[Dict[str, Any]], deadline: float,
+                 columnar: bool = False):
         self.rows = rows
         self.n = len(rows)
         self.t_enqueue = time.perf_counter()
         self.t_wall = time.time()
         self.deadline = deadline
         self.event = threading.Event()
-        self.results: Optional[List[Dict[str, Any]]] = None
+        self.results = None      # [dict, ...] rows or {col: [...]} columnar
         self.error: Optional[BaseException] = None
         self.abandoned = False
+        self.columnar = columnar
 
 
 class MicroBatcher:
@@ -80,7 +82,7 @@ class MicroBatcher:
         import queue as _q
         self._encode = encode          # (rows, pad_to) -> np [pad, F]
         self._dispatch = dispatch      # (X, n_active) -> device array
-        self._decode = decode          # (host scores, n) -> [dict, ...]
+        self._decode = decode          # (host scores, n) -> DecodedBatch
         self._bucket_for = bucket_for
         self.stats = stats
         self.max_batch = int(max_batch)
@@ -103,12 +105,16 @@ class MicroBatcher:
     # -- client side ----------------------------------------------------
 
     def submit(self, rows: Sequence[Dict[str, Any]],
-               timeout_ms: Optional[float] = None) -> List[Dict[str, Any]]:
+               timeout_ms: Optional[float] = None,
+               columnar: bool = False):
         """Blocking scoring call for one client request. Raises
         ServeOverloadedError when the queue is full, ServeDeadlineError
-        when the deadline expires first."""
+        when the deadline expires first. ``columnar=True`` returns
+        ``{column: [values...]}`` from the batch's vectorized decode
+        instead of per-row dicts (requests of both shapes coalesce into
+        the same device batch)."""
         if not rows:
-            return []
+            return {} if columnar else []
         if len(rows) > self.max_batch:
             raise ValueError(
                 f"submit() takes at most max_batch={self.max_batch} rows "
@@ -116,7 +122,7 @@ class MicroBatcher:
         timeout_s = (float(timeout_ms) / 1000.0 if timeout_ms is not None
                      else self.default_timeout_s)
         deadline = time.perf_counter() + timeout_s
-        req = _Request(rows, deadline)
+        req = _Request(rows, deadline, columnar=columnar)
         with self._cv:
             if self._closed:
                 raise ServeClosedError("deployment is shut down")
@@ -299,6 +305,16 @@ class MicroBatcher:
                 host = np.asarray(out)          # blocks until ready
                 t1 = time.perf_counter()
                 decoded = self._decode(host, n)
+                # per-request views over the batch-wide vectorized
+                # decode: row dicts only materialize for row-format
+                # requests (columnar requests slice arrays). Built
+                # INSIDE the decode-stage window so the stats attribute
+                # the dict cost honestly.
+                off = 0
+                for r in batch:
+                    r.results = (decoded.columns(off, r.n) if r.columnar
+                                 else decoded.rows(off, r.n))
+                    off += r.n
                 t2 = time.perf_counter()
             except BaseException as e:  # noqa: BLE001
                 for r in batch:
@@ -309,10 +325,7 @@ class MicroBatcher:
                     sp_batch.attrs["error"] = True
                     sp_batch.finish()
                 continue
-            off = 0
             for r in batch:
-                r.results = decoded[off: off + r.n]
-                off += r.n
                 r.event.set()
             device_s = tms["dispatch"] / 1e3 + (t1 - t0)
             # children recorded on the COLLECTOR thread against the

@@ -57,24 +57,72 @@ class RowCodec:
 
     # -- decode ---------------------------------------------------------
 
-    def decode(self, scores: np.ndarray, n: int) -> List[Dict[str, Any]]:
-        """[padded(, K)] device output → n per-row prediction dicts
-        (EasyPredict AbstractPrediction shape)."""
+    def decode_batch(self, scores: np.ndarray, n: int) -> "DecodedBatch":
+        """ONE vectorized pass over the batch's device output: slice off
+        the pad tail, un-correct probabilities (balance_classes) and
+        argmax labels for the WHOLE batch — per-request row dicts or
+        column arrays are then cheap views (``DecodedBatch.rows`` /
+        ``.columns``). The per-row Python dict build used to be ~30% of
+        the batched path; columnar responses skip it entirely."""
         scores = np.asarray(scores)[:n]
         if self.nclasses <= 1:
-            return [{"value": float(v)} for v in scores.reshape(-1)]
+            return DecodedBatch(self, values=scores.reshape(-1)[:n])
         # identical post-processing to Model.predict: probability
         # un-correction for balance_classes, then argmax labels
         probs = self._model._correct_probabilities(scores)
-        labels = np.argmax(probs, axis=1)
-        dom = self.response_domain or [str(k) for k in
-                                       range(self.nclasses)]
-        out = []
-        for i in range(n):
-            out.append({
-                "label": str(dom[int(labels[i])]),
-                "classProbabilities": {
-                    str(dom[k]): float(probs[i, k])
-                    for k in range(self.nclasses)},
-            })
-        return out
+        return DecodedBatch(self, probs=probs,
+                            labels=np.argmax(probs, axis=1))
+
+    def decode(self, scores: np.ndarray, n: int) -> List[Dict[str, Any]]:
+        """[padded(, K)] device output → n per-row prediction dicts
+        (EasyPredict AbstractPrediction shape)."""
+        return self.decode_batch(scores, n).rows(0, n)
+
+
+class DecodedBatch:
+    """Vectorized decode result shared by every request in one batch:
+    row-shaped and columnar views over the same arrays, so mixed-format
+    requests coalesced into one tick pay ONE probability pass."""
+    __slots__ = ("codec", "values", "probs", "labels", "_dom")
+
+    def __init__(self, codec: RowCodec, values: Optional[np.ndarray] = None,
+                 probs: Optional[np.ndarray] = None,
+                 labels: Optional[np.ndarray] = None):
+        self.codec = codec
+        self.values = values
+        self.probs = probs
+        self.labels = labels
+        self._dom = [str(d) for d in
+                     (codec.response_domain
+                      or [str(k) for k in range(codec.nclasses)])]
+
+    def rows(self, off: int, k: int) -> List[Dict[str, Any]]:
+        """Per-row prediction dicts for rows [off, off+k) — bit-identical
+        to the pre-columnar decode path."""
+        if self.values is not None:
+            return [{"value": float(v)} for v in self.values[off:off + k]]
+        dom = self._dom
+        K = len(dom)
+        probs = self.probs
+        labels = self.labels
+        return [{
+            "label": dom[int(labels[i])],
+            "classProbabilities": {dom[c]: float(probs[i, c])
+                                   for c in range(K)},
+        } for i in range(off, off + k)]
+
+    def columns(self, off: int, k: int) -> Dict[str, List]:
+        """Columnar view for rows [off, off+k): ``predict`` plus one
+        ``p<label>`` column per class (the H2O predictions-frame column
+        convention) — built from array slices, no per-row dicts."""
+        if self.values is not None:
+            return {"predict": [float(v)
+                                for v in self.values[off:off + k]]}
+        dom = self._dom
+        lab = self.labels[off:off + k]
+        cols: Dict[str, List] = {
+            "predict": [dom[int(i)] for i in lab]}
+        pr = self.probs[off:off + k]
+        for c, d in enumerate(dom):
+            cols[f"p{d}"] = pr[:, c].astype(float).tolist()
+        return cols

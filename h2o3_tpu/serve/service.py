@@ -23,7 +23,8 @@ from h2o3_tpu.serve.registry import DEFAULT_BUCKETS, CompiledScorer
 from h2o3_tpu.serve.stats import ServeStats, merge_snapshots
 
 __all__ = ["deploy", "undeploy", "deployment", "deployments",
-           "predict_rows", "stats", "shutdown_all", "Deployment",
+           "predict_rows", "predict_columnar", "stats", "shutdown_all",
+           "Deployment",
            "ServeError", "ServeOverloadedError", "ServeDeadlineError",
            "ServeBadRequestError", "ServeClosedError"]
 
@@ -87,7 +88,7 @@ class Deployment:
         self.stats = ServeStats(model=key)
         self.batcher = MicroBatcher(
             encode=self.codec.encode, dispatch=self.scorer.score,
-            decode=self.codec.decode, stats=self.stats,
+            decode=self.codec.decode_batch, stats=self.stats,
             bucket_for=self.scorer.bucket_for, max_batch=max_batch,
             max_delay_ms=max_delay_ms, queue_limit=queue_limit,
             default_timeout_ms=timeout_ms)
@@ -105,6 +106,30 @@ class Deployment:
         for s in range(0, len(rows), mb):
             out.extend(self.batcher.submit(rows[s: s + mb],
                                            timeout_ms=timeout_ms))
+        return out
+
+    def predict_columnar(self, rows: Sequence[Dict[str, Any]],
+                         timeout_ms: Optional[float] = None
+                         ) -> Dict[str, List]:
+        """Score rows and return COLUMN arrays (``{"predict": [...],
+        "p<label>": [...]}`` — the H2O predictions-frame shape) from the
+        batch's one vectorized decode instead of per-row dicts. Values
+        bit-match ``predict_rows`` on the same rows; the per-row dict
+        build (~30% of the batched path) is skipped."""
+        mb = self.batcher.max_batch
+        if len(rows) <= mb:
+            return self.batcher.submit(rows, timeout_ms=timeout_ms,
+                                       columnar=True)
+        out: Dict[str, List] = {}
+        for s in range(0, len(rows), mb):
+            part = self.batcher.submit(rows[s: s + mb],
+                                       timeout_ms=timeout_ms,
+                                       columnar=True)
+            if not out:
+                out = part
+            else:
+                for c, vals in part.items():
+                    out[c].extend(vals)
         return out
 
     def info(self) -> Dict[str, Any]:
@@ -194,6 +219,15 @@ def predict_rows(model_key: str, rows: Sequence[Dict[str, Any]],
         raise KeyError(f"model '{model_key}' is not deployed — POST "
                        f"/3/Serve/models/{model_key} first")
     return dep.predict_rows(rows, timeout_ms=timeout_ms)
+
+
+def predict_columnar(model_key: str, rows: Sequence[Dict[str, Any]],
+                     timeout_ms: Optional[float] = None) -> Dict[str, List]:
+    dep = deployment(model_key)
+    if dep is None:
+        raise KeyError(f"model '{model_key}' is not deployed — POST "
+                       f"/3/Serve/models/{model_key} first")
+    return dep.predict_columnar(rows, timeout_ms=timeout_ms)
 
 
 def stats() -> Dict[str, Any]:

@@ -13,8 +13,8 @@ profiler tools (tools/profile_*.py) and bench rounds read.
 attribute load + branch — guarded by tests/test_telemetry.py's
 ns-budget microbench).
 """
-from h2o3_tpu.telemetry.collectors import (device_memory_bytes, install,
-                                           installed, record_d2h,
+from h2o3_tpu.telemetry.collectors import (device_get, device_memory_bytes,
+                                           install, installed, record_d2h,
                                            record_h2d,
                                            sample_device_memory)
 from h2o3_tpu.telemetry.export import (chrome_trace, chrome_trace_bytes,
@@ -29,7 +29,7 @@ from h2o3_tpu.telemetry.spans import (Span, clear_spans, current_span,
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "Span",
     "chrome_trace", "chrome_trace_bytes", "clear_spans", "current_span",
-    "device_memory_bytes", "enabled", "finished_spans", "install",
+    "device_get", "device_memory_bytes", "enabled", "finished_spans", "install",
     "installed", "open_span", "prometheus_text", "record_d2h",
     "record_h2d", "record_span", "registry", "sample_device_memory",
     "set_enabled", "span", "stage_seconds", "telemetry_snapshot",

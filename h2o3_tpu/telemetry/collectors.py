@@ -95,29 +95,87 @@ def installed() -> bool:
 # Cleared by Registry.reset() on the global registry.
 _BYTE_HANDLES: Dict[str, object] = {}
 
+# pipelines a transfer can be attributed to (the label set is closed so
+# a typo'd span name can't mint unbounded label cardinality)
+_PIPELINES = frozenset(
+    {"ingest", "train", "serve", "analytics", "rapids", "frame"})
 
-def _byte_counter(name: str, help_: str):
-    c = _BYTE_HANDLES.get(name)
+
+def _byte_counter(name: str, help_: str, pipeline: Optional[str] = None):
+    key = name if pipeline is None else f"{name}|{pipeline}"
+    c = _BYTE_HANDLES.get(key)
     if c is None:
-        c = registry().counter(name, help=help_)
-        _BYTE_HANDLES[name] = c
+        labels = {"pipeline": pipeline} if pipeline is not None else None
+        c = registry().counter(name, labels, help=help_)
+        _BYTE_HANDLES[key] = c
     return c
 
 
-def record_h2d(nbytes: int) -> None:
-    """Host→device transfer bytes (batch_device_put / _pad_and_put)."""
+def _infer_pipeline() -> Optional[str]:
+    """Attribute a transfer to the pipeline whose span is open on this
+    thread (ingest.parse / train.* / serve.* roots all thread their
+    stage work), so Vec.to_numpy-style chokepoints need no plumbing."""
+    from h2o3_tpu.telemetry.spans import current_span
+    sp = current_span()
+    if sp is None:
+        return None
+    head = sp.name.split(".", 1)[0]
+    return head if head in _PIPELINES else None
+
+
+def _record_bytes(direction: str, nbytes: int,
+                  pipeline: Optional[str]) -> None:
+    help_ = f"{direction} transfer bytes"
+    _byte_counter(f"h2o3_{direction}_bytes_total", help_).inc(float(nbytes))
+    p = pipeline if pipeline in _PIPELINES else _infer_pipeline()
+    if p is not None:
+        _byte_counter(f"h2o3_{direction}_pipeline_bytes_total",
+                      f"{direction} transfer bytes by pipeline",
+                      p).inc(float(nbytes))
+
+
+def record_h2d(nbytes: int, pipeline: Optional[str] = None) -> None:
+    """Host→device transfer bytes (batch_device_put / _pad_and_put /
+    the streamed chunk uploads). ``pipeline`` attributes the bytes to
+    ingest/train/serve/analytics/rapids; when omitted, the open span on
+    the calling thread decides."""
     if not registry().enabled:
         return
-    _byte_counter("h2o3_h2d_bytes_total",
-                  "host->device transfer bytes").inc(float(nbytes))
+    _record_bytes("h2d", nbytes, pipeline)
 
 
-def record_d2h(nbytes: int) -> None:
+def record_d2h(nbytes: int, pipeline: Optional[str] = None) -> None:
     """Device→host fetch bytes (Vec.to_numpy / spill / device_get)."""
     if not registry().enabled:
         return
-    _byte_counter("h2o3_d2h_bytes_total",
-                  "device->host transfer bytes").inc(float(nbytes))
+    _record_bytes("d2h", nbytes, pipeline)
+
+
+def _tree_nbytes(host) -> int:
+    """Byte count of a fetched pytree of numpy arrays/scalars."""
+    import numpy as np
+    total = 0
+    stack = [host]
+    while stack:
+        x = stack.pop()
+        if isinstance(x, dict):
+            stack.extend(x.values())
+        elif isinstance(x, (list, tuple)):
+            stack.extend(x)
+        else:
+            total += getattr(x, "nbytes", 0) or np.asarray(x).nbytes
+    return total
+
+
+def device_get(x, pipeline: Optional[str] = None):
+    """Counted ``jax.device_get``: the d2h byte counters see ad-hoc
+    fetches (analytics/rapids), not just the frame-layer choke points.
+    Returns the host pytree unchanged."""
+    import jax
+    host = jax.device_get(x)
+    if registry().enabled:
+        record_d2h(_tree_nbytes(host), pipeline=pipeline)
+    return host
 
 
 # ---------------------------------------------------------- device memory

@@ -254,7 +254,18 @@ def _gated_batcher(gate, stats=None, **kw):
         return X[:, 0] * 2.0
 
     def decode(scores, n):
-        return [{"value": float(v)} for v in np.asarray(scores)[:n]]
+        # the batcher's decode contract is a DecodedBatch-like object:
+        # per-request row/column views over one vectorized pass
+        vals = np.asarray(scores)[:n]
+
+        class _Decoded:
+            def rows(self, off, k):
+                return [{"value": float(v)} for v in vals[off:off + k]]
+
+            def columns(self, off, k):
+                return {"value": [float(v) for v in vals[off:off + k]]}
+
+        return _Decoded()
 
     kw.setdefault("max_batch", 4)
     kw.setdefault("max_delay_ms", 1.0)
@@ -430,6 +441,89 @@ def test_rest_deploy_unknown_model_404(server):
     with pytest.raises(urllib.error.HTTPError) as ei:
         _req(server, "POST", "/3/Serve/models/not_a_model")
     assert ei.value.code == 404
+
+
+# -------------------------------------------- columnar response path
+
+
+def test_columnar_bit_matches_row_dicts(gbm_model):
+    """predict_columnar returns the same values as predict_rows from one
+    vectorized decode — 'predict' + one p<label> column per class (the
+    H2O predictions-frame column convention; ISSUE 5)."""
+    fr, model = gbm_model
+    dep = serve.deploy("serve_gbm", model=model, max_batch=128,
+                       max_delay_ms=0.5)
+    try:
+        rows = _rows_of(fr, range(160))      # spans two sub-batches
+        rd = dep.predict_rows(rows)
+        cd = dep.predict_columnar(rows)
+        assert sorted(cd) == ["pNO", "pYES", "predict"]
+        assert len(cd["predict"]) == len(rows)
+        for i in range(len(rows)):
+            assert cd["predict"][i] == rd[i]["label"]
+            assert cd["pYES"][i] == rd[i]["classProbabilities"]["YES"]
+            assert cd["pNO"][i] == rd[i]["classProbabilities"]["NO"]
+    finally:
+        serve.undeploy("serve_gbm")
+
+
+def test_columnar_and_row_requests_share_a_batch(gbm_model):
+    """Mixed-format requests coalesce into the same device batch and
+    each gets its own shape back."""
+    fr, model = gbm_model
+    dep = serve.deploy("serve_gbm", model=model, max_batch=64,
+                       max_delay_ms=20.0)
+    try:
+        rows = _rows_of(fr, range(8))
+        outs = {}
+
+        def go(fmt):
+            outs[fmt] = (dep.predict_columnar(rows) if fmt == "col"
+                         else dep.predict_rows(rows))
+
+        ts = [threading.Thread(target=go, args=(f,))
+              for f in ("col", "row")]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(outs["row"]) == 8
+        assert len(outs["col"]["predict"]) == 8
+        for i in range(8):
+            assert outs["col"]["predict"][i] == outs["row"][i]["label"]
+    finally:
+        serve.undeploy("serve_gbm")
+
+
+def test_rest_predictions_columnar_format(server, gbm_model):
+    fr, model = gbm_model
+    # the lifecycle test may have DELETEd the store entry — re-put
+    dkv.put("serve_gbm", "model", model)
+    _req(server, "POST", "/3/Serve/models/serve_gbm")
+    try:
+        rows = _rows_of(fr, range(6))
+        out = _req(server, "POST",
+                   "/3/Predictions/models/serve_gbm/rows?format=columnar",
+                   raw_json={"rows": rows})
+        assert out["__meta"]["schema_name"] == "ServePredictionsColumnarV3"
+        assert out["nrow"] == 6
+        cols = out["columns"]
+        assert sorted(cols) == ["pNO", "pYES", "predict"]
+        assert all(len(v) == 6 for v in cols.values())
+        # bit-match against the row-dict shape on the same rows
+        ref = _req(server, "POST", "/3/Predictions/models/serve_gbm/rows",
+                   raw_json={"rows": rows})["predictions"]
+        for i in range(6):
+            assert cols["predict"][i] == ref[i]["label"]
+            assert cols["pYES"][i] == ref[i]["classProbabilities"]["YES"]
+        # unknown format → 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(server, "POST",
+                 "/3/Predictions/models/serve_gbm/rows?format=bogus",
+                 raw_json={"rows": rows})
+        assert ei.value.code == 400
+    finally:
+        serve.undeploy("serve_gbm")
 
 
 # ------------------------------------------------- vectorized row codec

@@ -1,0 +1,297 @@
+"""Transfer-minimal pipelines (ISSUE 5): budgets asserted via the
+telemetry byte counters, not eyeballed — streamed per-chunk ingest
+equivalence + overlap, streamed-GBM once-per-tree uploads + dense/
+streamed bit parity, multinomial finalize without the O(n·K) host
+fetch, and pipeline-labeled transfer attribution. All CPU-backend
+safe. The two multi-second streamed-GBM trains ride the established
+slow tier (conftest: sharded-parity-class tests run with --runslow /
+-m slow), keeping the default tier inside its wall-clock budget.
+"""
+import importlib
+import os
+
+import numpy as np
+import numpy.testing as npt
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu import memman, telemetry
+
+parse_mod = importlib.import_module("h2o3_tpu.ingest.parse")
+
+
+@pytest.fixture(autouse=True)
+def _restore_budget():
+    yield
+    memman.reset()
+
+
+def _counter(name, labels=None):
+    return telemetry.registry().value(name, labels)
+
+
+# ------------------------------------------------------------ ingest
+
+
+def _mixed_csv(path, n=12_000, seed=0):
+    rng = np.random.default_rng(seed)
+    cities = ["ames", "berlin", "cairo", "delhi"]
+    with open(path, "w") as f:
+        f.write("a,b,c,t,e\n")
+        for _ in range(n):
+            a = f"{rng.normal():.6g}" if rng.random() > 0.01 else "NA"
+            b = str(int(rng.integers(-100, 100)))
+            c = f"{rng.normal() * 1e6:.6g}"
+            t = f"2020-01-{1 + int(rng.integers(0, 28)):02d}"
+            e = cities[int(rng.integers(0, 4))]
+            f.write(f"{a},{b},{c},{t},{e}\n")
+
+
+def test_parse_streamed_equivalence(tmp_path, monkeypatch):
+    """Per-chunk device-put path produces bit-identical columns (host
+    AND device views) to the host-merge path, and reports the overlap
+    ratio + ingest-labeled h2d bytes."""
+    import jax
+    path = str(tmp_path / "mixed.csv")
+    _mixed_csv(path)
+    monkeypatch.setattr(parse_mod, "_PARALLEL_PARSE_BYTES", 1 << 12)
+    # the suite's conftest forces an 8-device mesh, where auto-streaming
+    # stays off (single-shard gate) — force it for the equivalence check
+    monkeypatch.setenv("H2O3_INGEST_STREAM", "1")
+    setup = parse_mod.parse_setup(path)
+    ingest_h2d0 = _counter("h2o3_h2d_pipeline_bytes_total",
+                           {"pipeline": "ingest"})
+    fr_stream = parse_mod.parse([path], setup)
+    prof = dict(parse_mod.LAST_PROFILE)
+    assert prof["streamed"] is True
+    assert prof["chunks"] > 1
+    assert prof["h2d_overlap_ratio"] is not None
+    assert 0.0 <= prof["h2d_overlap_ratio"] <= 1.0
+    # the per-chunk puts are attributed to the ingest pipeline
+    assert _counter("h2o3_h2d_pipeline_bytes_total",
+                    {"pipeline": "ingest"}) > ingest_h2d0
+    monkeypatch.setenv("H2O3_INGEST_STREAM", "0")
+    fr_merge = parse_mod.parse([path], setup)
+    assert dict(parse_mod.LAST_PROFILE)["streamed"] is False
+    for name in fr_stream.names:
+        v1, v2 = fr_stream.vec(name), fr_merge.vec(name)
+        assert v1.type == v2.type and v1.domain == v2.domain
+        a1, a2 = v1.to_numpy(), v2.to_numpy()
+        if a1.dtype.kind == "O":
+            assert (a1 == a2).all(), name
+        else:
+            npt.assert_array_equal(a1, a2, err_msg=name)
+        if v1.data is not None:
+            npt.assert_array_equal(
+                np.asarray(jax.device_get(v1.data)),
+                np.asarray(jax.device_get(v2.data)),
+                err_msg=f"{name} device")
+
+
+def test_parse_streamed_wide_int_falls_back_exact(tmp_path, monkeypatch):
+    """Wide ints (beyond float64's 2^53) must keep their exact int64
+    merge — the streamer hands those columns back to the host path."""
+    path = str(tmp_path / "wide.csv")
+    base = (1 << 60) + 7
+    n = 4000
+    with open(path, "w") as f:
+        f.write("id,v\n")
+        for i in range(n):
+            f.write(f"{base + i},{i % 97}\n")
+    monkeypatch.setattr(parse_mod, "_PARALLEL_PARSE_BYTES", 1 << 10)
+    monkeypatch.setenv("H2O3_INGEST_STREAM", "1")
+    fr = parse_mod.parse([path], parse_mod.parse_setup(path))
+    got = fr.vec("id").to_numpy()
+    assert got.dtype == np.int64
+    assert got[0] == base and got[-1] == base + n - 1
+
+
+# ------------------------------------------------------- streamed GBM
+
+
+def _gbm_frame(n, f, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    logit = X[:, 0] - 0.7 * X[:, 1] + 0.4 * X[:, 2]
+    cols = {f"x{i}": X[:, i] for i in range(f)}
+    cols["resp"] = np.array(["n", "y"], dtype=object)[
+        (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(int)]
+    return h2o.Frame.from_numpy(cols)
+
+
+_GBM_PARAMS = dict(ntrees=3, max_depth=3, nbins=16, seed=1,
+                   score_tree_interval=0, stopping_rounds=0)
+
+
+@pytest.mark.slow
+def test_streamed_gbm_bit_parity_with_dense():
+    """A fully-resident streamed train is BIT-IDENTICAL to the dense
+    device path: same trees (feat/thr/values) and same predictions —
+    the streamed kernels, margin updates and lr scaling reproduce the
+    dense arithmetic exactly (ISSUE 5 satellite).
+
+    Pinned to a 1-data-shard mesh: the dense path reduces histograms
+    with an n-shard psum whose accumulation order differs from the
+    streamed chunk sum, so exact equality is only defined shard-free
+    (the suite's conftest forces an 8-device virtual mesh)."""
+    import jax
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    from h2o3_tpu.parallel import mesh as mesh_mod
+    old_mesh = mesh_mod.current_mesh()
+    mesh_mod.set_mesh(mesh_mod.make_mesh(n_data=1,
+                                         devices=jax.devices()[:1]))
+    try:
+        memman.reset()
+        fr = _gbm_frame(8000, 6)
+        dense = H2OGradientBoostingEstimator(**_GBM_PARAMS)
+        dense.train(y="resp", training_frame=fr)
+        assert not dense.model.output.get("streamed")
+        # budget: too small for frame+design (forces streaming), large
+        # enough that the resident window holds the whole design matrix
+        memman.reset(budget=460_000)
+        fr2 = _gbm_frame(8000, 6)
+        st = H2OGradientBoostingEstimator(**_GBM_PARAMS)
+        st.train(y="resp", training_frame=fr2)
+        assert st.model.output.get("streamed") is True
+        sp = st.model.output["stream_profile"]
+        assert sp["resident_chunks"] == sp["chunks"] == 1
+        da, sa = dense.model._save_arrays(), st.model._save_arrays()
+        for k in ("feat", "thr", "value", "na_left", "is_split"):
+            npt.assert_array_equal(da[k], sa[k], err_msg=k)
+        memman.reset()
+        pd = dense.model.predict(fr).vec("py").to_numpy()
+        ps = st.model.predict(fr).vec("py").to_numpy()
+        npt.assert_array_equal(pd, ps)
+    finally:
+        mesh_mod.set_mesh(old_mesh)
+
+
+@pytest.mark.slow
+def test_streamed_gbm_uploads_once_per_tree():
+    """Multi-chunk streamed train under a resident-window budget: h2d
+    bytes per tree stay ≤ 1.1× the dataset's device footprint (each
+    chunk crosses the bus once per TRAIN, not once per level — the old
+    path paid levels × footprint per tree)."""
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    if not telemetry.enabled():
+        pytest.skip("telemetry disabled")
+    n, f = 32_768, 8
+    x_bytes = n * f * 4
+    memman.reset(budget=int(2.2 * x_bytes))
+    fr = _gbm_frame(n, f, seed=3)
+    train_h2d0 = _counter("h2o3_h2d_pipeline_bytes_total",
+                          {"pipeline": "train"})
+    gbm = H2OGradientBoostingEstimator(**_GBM_PARAMS)
+    gbm.train(y="resp", training_frame=fr)
+    m = gbm.model
+    assert m.output.get("streamed") is True
+    sp = m.output["stream_profile"]
+    assert sp["chunks"] > 1, sp
+    assert sp["resident_chunks"] == sp["chunks"], sp
+    # steady-state per-tree traffic excludes the once-per-train window
+    # upload — which itself must stay ~one dataset footprint (X plus the
+    # y/w/margin working vectors)
+    assert sp["h2d_bytes_per_tree"] <= 1.1 * sp["device_footprint_bytes"], sp
+    assert sp["h2d_resident_bytes"] <= 1.6 * sp["device_footprint_bytes"], sp
+    assert sp["h2d_bytes"] <= (sp["h2d_resident_bytes"]
+                               + 1.1 * _GBM_PARAMS["ntrees"]
+                               * sp["device_footprint_bytes"]), sp
+    # the uploads are attributed to the train pipeline
+    assert _counter("h2o3_h2d_pipeline_bytes_total",
+                    {"pipeline": "train"}) > train_h2d0
+
+
+# ------------------------------------------------- multinomial metrics
+
+
+def _host_multinomial_reference(p, y, w):
+    """Pure-numpy reference of the pre-change host implementation."""
+    n, K = p.shape
+    py = p[np.arange(n), y]
+    ll = -(w * np.log(np.clip(py, 1e-7, 1.0))).sum() / w.sum()
+    pred = p.argmax(1)
+    err = (w * (pred != y)).sum() / w.sum()
+    cm = np.zeros((K, K))
+    np.add.at(cm, (y, pred), w)
+    mse = (w * (1.0 - py) ** 2).sum() / w.sum()
+    ranks = np.argsort(-p, axis=1, kind="stable")
+    hits = ranks == y[:, None]
+    hr = np.cumsum(hits.mean(axis=0))[: min(K, 10)]
+    return ll, err, cm, mse, hr
+
+
+def test_multinomial_finalize_no_onk_fetch():
+    """Device-side multinomial metrics: the counted d2h bytes during
+    finalize stay far below one [n, K] probability fetch, and every
+    aggregate matches the host reference."""
+    from sklearn import metrics as skm
+    from h2o3_tpu.models.metrics import make_multinomial_metrics
+    if not telemetry.enabled():
+        pytest.skip("telemetry disabled")
+    rng = np.random.default_rng(5)
+    n, K = 20_000, 4
+    y = rng.integers(0, K, n)
+    logits = rng.normal(0, 1, (n, K))
+    logits[np.arange(n), y] += 1.2
+    p = (np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+         ).astype(np.float32)
+    w = np.ones(n, np.float32)
+    d2h0 = _counter("h2o3_d2h_bytes_total")
+    m = make_multinomial_metrics(p, y, w)
+    fetched = _counter("h2o3_d2h_bytes_total") - d2h0
+    probs_bytes = n * K * 4
+    assert fetched < 0.25 * probs_bytes, (fetched, probs_bytes)
+    ll, err, cm, mse, hr = _host_multinomial_reference(
+        p.astype(np.float64), y, w.astype(np.float64))
+    assert m.logloss == pytest.approx(ll, rel=1e-4)
+    assert m.error == pytest.approx(err, abs=1e-6)
+    npt.assert_allclose(m.confusion_matrix, cm, atol=0.5)
+    assert m.mse == pytest.approx(mse, rel=1e-4)
+    npt.assert_allclose(m.hit_ratios, hr, atol=1e-5)
+    # OVR AUC via the on-device 2^17-bucket sketch: macro average within
+    # the sketch's quantisation bound of sklearn's exact computation
+    ref_auc = skm.roc_auc_score(y, p, multi_class="ovr", average="macro")
+    assert m.auc == pytest.approx(ref_auc, abs=2e-3)
+
+
+def test_multinomial_gbm_trains_with_device_metrics():
+    """End-to-end: a multinomial GBM's finalize runs on the device
+    metric kernels (hit ratios / cm / auc populated, no crash)."""
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    rng = np.random.default_rng(9)
+    n = 3000
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
+    cols = {f"x{i}": X[:, i] for i in range(4)}
+    cols["resp"] = np.array(["a", "b", "c"], dtype=object)[y]
+    fr = h2o.Frame.from_numpy(cols)
+    gbm = H2OGradientBoostingEstimator(ntrees=2, max_depth=3, seed=1)
+    gbm.train(y="resp", training_frame=fr)
+    mm = gbm.model.training_metrics
+    assert mm.confusion_matrix.shape == (3, 3)
+    assert len(mm.hit_ratios) == 3
+    assert 0.0 < mm.logloss < 1.2
+    assert mm.auc is not None and 0.5 < mm.auc <= 1.0
+
+
+# --------------------------------------------------- pipeline labels
+
+
+def test_transfer_bytes_pipeline_attribution():
+    """record_h2d/record_d2h label bytes by pipeline — explicitly or
+    inferred from the open span on the calling thread."""
+    if not telemetry.enabled():
+        pytest.skip("telemetry disabled")
+    r = telemetry.registry()
+    a0 = r.value("h2o3_d2h_pipeline_bytes_total", {"pipeline": "analytics"})
+    telemetry.record_d2h(100, pipeline="analytics")
+    assert r.value("h2o3_d2h_pipeline_bytes_total",
+                   {"pipeline": "analytics"}) == a0 + 100
+    s0 = r.value("h2o3_d2h_pipeline_bytes_total", {"pipeline": "serve"})
+    with telemetry.span("serve.decode"):
+        telemetry.record_d2h(50)
+    assert r.value("h2o3_d2h_pipeline_bytes_total",
+                   {"pipeline": "serve"}) == s0 + 50
+    t0 = r.value("h2o3_d2h_bytes_total")
+    telemetry.record_d2h(25)           # no span, no label: total only
+    assert r.value("h2o3_d2h_bytes_total") == t0 + 25

@@ -108,11 +108,16 @@ def main():
            "bytes": os.path.getsize(path),
            "native": LAST_PROFILE.get("native"),
            "chunks": LAST_PROFILE.get("chunks"),
+           "streamed": LAST_PROFILE.get("streamed"),
            # stage split read from the pipeline's OWN telemetry spans —
            # identical to what GET /metrics exports for the same run
            "tokenize_encode_s": stage("ingest.tokenize_encode"),
            "domain_union_s": stage("ingest.domain_union"),
            "device_put_s": stage("ingest.device_put"),
+           # per-chunk streamed transfer: share of device_put wall time
+           # hidden under tokenize (same number the pipeline exports as
+           # the h2o3_ingest_h2d_overlap_ratio gauge)
+           "h2d_overlap_ratio": LAST_PROFILE.get("h2d_overlap_ratio"),
            "h2d_bytes": round(
                telemetry.registry().value("h2o3_h2d_bytes_total") - h2d0),
            "parse_wall_s": round(wall, 4),

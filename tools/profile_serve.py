@@ -93,6 +93,15 @@ def main():
     batch_wall = time.time() - t0
     total = dep.stats.snapshot()
 
+    # phase 3: SAME load through the columnar response path — one
+    # vectorized decode per batch instead of per-row dicts (the decode
+    # stage delta shows the win; values bit-match the row path)
+    t0 = time.time()
+    for i in range(n_batches):
+        dep.predict_columnar(pool[:bsz])
+    col_wall = time.time() - t0
+    col_total = dep.stats.snapshot()
+
     def stage_split(snap, rows):
         ms = snap["stage_ms"]
         tot = sum(ms.values()) or 1.0
@@ -104,6 +113,9 @@ def main():
     batch_stage = {s: total["stage_ms"][s] - single["stage_ms"][s]
                    for s in total["stage_ms"]}
     batch_rows = total["rows"] - single["rows"]
+    col_stage = {s: col_total["stage_ms"][s] - total["stage_ms"][s]
+                 for s in col_total["stage_ms"]}
+    col_rows = col_total["rows"] - total["rows"]
     out = {
         "metric": "serve_stage_profile",
         "deploy_seconds": round(deploy_s, 3),
@@ -121,6 +133,18 @@ def main():
             "stages": {s: round(v, 2) for s, v in batch_stage.items()},
             "us_per_row": {s: round(1e3 * v / max(batch_rows, 1), 2)
                            for s, v in batch_stage.items()},
+        },
+        # columnar response path (?format=columnar / predict_columnar):
+        # identical encode/device work, vectorized decode — compare
+        # decode us_per_row and rows_per_sec against "batched" above
+        "batched_columnar": {
+            "batch_size": bsz, "batches": n_batches,
+            "rows_per_sec": round(col_rows / max(col_wall, 1e-9), 1),
+            "us_per_row": {s: round(1e3 * v / max(col_rows, 1), 2)
+                           for s, v in col_stage.items()},
+            "decode_speedup": round(
+                max(batch_stage.get("decode", 0.0), 1e-9)
+                / max(col_stage.get("decode", 1e-9), 1e-9), 2),
         },
         "bucket_fill": total["bucket_fill"],
         "warm_compiles": int(telemetry.registry().value(

@@ -97,9 +97,11 @@ def main():
     # report the delta — the warm run's own span durations
     stages0 = telemetry.stage_seconds("train.")
     compiles0 = telemetry.registry().value("h2o3_xla_compiles_total")
+    h2d0 = telemetry.registry().value("h2o3_h2d_bytes_total")
     model, warm_total = _train(fr, yname)
     warm_compiles = telemetry.registry().value(
         "h2o3_xla_compiles_total") - compiles0
+    warm_h2d = telemetry.registry().value("h2o3_h2d_bytes_total") - h2d0
 
     # ONE scrape for every stage read (each samples() pass runs the
     # collector views, incl. an O(live arrays) device-memory walk)
@@ -130,6 +132,14 @@ def main():
         "warm_over_loop": round(warm_total / max(loop_s, 1e-9), 2),
         "rows_per_sec_warm": round(fr.nrow * model.ntrees_built
                                    / max(loop_s, 1e-9), 1),
+        # transfer budget per tree (registry counter delta over the warm
+        # train): the dense device-resident path should sit near zero;
+        # the streamed path's once-per-tree contract shows up here and
+        # in model.output["stream_profile"]
+        "h2d_bytes_warm_train": round(warm_h2d),
+        "h2d_bytes_per_tree": round(
+            warm_h2d / max(model.ntrees_built, 1)),
+        "stream_profile": model.output.get("stream_profile"),
     }
     print(json.dumps(out))
     return out
