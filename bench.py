@@ -387,6 +387,18 @@ def main():
             log(f"streamed h2d guard: {guard}")
         except Exception as e:  # guard must never sink the headline run
             log(f"streamed h2d guard FAILED to run: {e!r}")
+    # chaos round (ISSUE 6): train+serve under injected faults, guarding
+    # the recovery machinery (retry, checkpoint resume, OOM degrade,
+    # circuit breaker) the same way transfer budgets are guarded.
+    # Runs AFTER the timed rounds so injected faults never skew them.
+    if os.environ.get("H2O3_BENCH_CHAOS", "1") not in ("0", "false", ""):
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            from chaos_sweep import run_chaos_round
+            out["resilience"] = run_chaos_round(rows=2000, log=log)
+        except Exception as e:  # must never sink the headline run
+            log(f"chaos round FAILED to run: {e!r}")
     # per-round telemetry (ISSUE 4): compile count and transfer volume
     # regressions are now tracked in BENCH_*.json, not just wall time.
     # warm_train.compiles is the headline — the zero-recompile contract.

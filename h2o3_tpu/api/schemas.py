@@ -89,6 +89,14 @@ def job_v3(job, dest_key: Optional[str] = None, dest_type: str = "Key<Model>") -
         "warnings": [],
         "exception": job.exception,
         "stacktrace": job.exception,
+        # structured failure info (ISSUE 6): class + message + the
+        # pipeline stage from the open span, so clients stop parsing
+        # stack-trace text to find out WHAT failed
+        "exception_type": getattr(job, "exception_type", None),
+        "exception_msg": getattr(job, "exception_msg", None),
+        "failed_stage": getattr(job, "failed_stage", None),
+        "stalled": bool(getattr(job, "stalled", False)),
+        "cancel_reason": getattr(job, "cancel_reason", None),
         "ready_for_view": job.status == jobs_mod.DONE,
         "auto_recoverable": False,
     }

@@ -45,9 +45,10 @@ def route(method: str, pattern: str):
 
 
 class ApiError(Exception):
-    def __init__(self, status: int, msg: str):
+    def __init__(self, status: int, msg: str, headers=None):
         super().__init__(msg)
         self.status = status
+        self.headers = dict(headers or {})   # e.g. Retry-After on 503
 
 
 # ---------------- algo registry ---------------------------------------
@@ -623,7 +624,8 @@ def _predict(params, body, model, frame):
 def _serve_config_from_params(params) -> Dict[str, Any]:
     cfg: Dict[str, Any] = {}
     for k, cast in (("max_batch", int), ("max_delay_ms", float),
-                    ("queue_limit", int), ("timeout_ms", float)):
+                    ("queue_limit", int), ("timeout_ms", float),
+                    ("circuit_failures", int), ("circuit_open_ms", float)):
         v = _coerce(params.get(k)) if params.get(k) is not None else None
         if v is not None:
             cfg[k] = cast(v)
@@ -684,6 +686,50 @@ def _serve_stats(params, body):
     return schemas.serve_stats_v3(serve.stats())
 
 
+# ---------------- fault injection admin (h2o3_tpu.faults) --------------
+# Chaos tooling surface: inspect/set/clear the deterministic fault spec
+# (same grammar as the H2O3_FAULTS env var). No reference analog.
+
+
+@route("GET", "/3/Faults")
+def _faults_get(params, body):
+    from h2o3_tpu import faults
+    return {"__meta": {"schema_version": 3, "schema_name": "FaultsV3"},
+            "spec": faults.spec(), "rules": faults.describe(),
+            "fired_total": faults.fired_total()}
+
+
+@route("POST", "/3/Faults")
+def _faults_set(params, body):
+    from h2o3_tpu import faults
+    spec = params.get("spec")
+    if spec is None and body:
+        try:
+            spec = json.loads(body.decode()).get("spec")
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            spec = body.decode(errors="replace").strip() or None
+    if not spec:
+        # a typo'd body must not silently DISARM a live chaos run —
+        # clearing is DELETE's job, setting requires a spec
+        raise ApiError(400, "POST /3/Faults requires spec=<grammar> "
+                            "(use DELETE /3/Faults to clear)")
+    try:
+        faults.configure(spec)
+    except ValueError as e:
+        raise ApiError(400, f"bad fault spec: {e}")
+    return {"__meta": {"schema_version": 3, "schema_name": "FaultsV3"},
+            "spec": faults.spec(), "rules": faults.describe(),
+            "fired_total": faults.fired_total()}
+
+
+@route("DELETE", "/3/Faults")
+def _faults_clear(params, body):
+    from h2o3_tpu import faults
+    faults.configure(None)
+    return {"__meta": {"schema_version": 3, "schema_name": "FaultsV3"},
+            "spec": None, "rules": [], "fired_total": 0}
+
+
 @route("POST", "/3/Predictions/models/{model}/rows")
 def _predict_rows(params, body, model):
     """Row-level scoring through the micro-batcher: JSON rows in
@@ -731,7 +777,14 @@ def _predict_rows(params, body, model):
     except KeyError as e:
         raise ApiError(404, str(e))
     except serve.ServeError as e:
-        raise ApiError(getattr(e, "http_status", 500), str(e))
+        headers = {}
+        ra = getattr(e, "retry_after_s", None)
+        if ra is not None:
+            # circuit-open fast 503s tell clients WHEN to come back
+            import math
+            headers["Retry-After"] = str(max(int(math.ceil(ra)), 1))
+        raise ApiError(getattr(e, "http_status", 500), str(e),
+                       headers=headers)
     return {"__meta": {"schema_version": 3,
                        "schema_name": "ServePredictionsV3"},
             "model_id": schemas.keyref(model, "Key<Model>"),
@@ -1271,7 +1324,7 @@ class _Handler(BaseHTTPRequestHandler):
                         "http_status": e.status, "msg": str(e),
                         "dev_msg": str(e), "exception_msg": str(e),
                         "exception_type": "ApiError", "values": {},
-                        "stacktrace": []})
+                        "stacktrace": []}, headers=e.headers)
                 except dkv.KeyLockedError as e:
                     self._reply(409, {
                         "__meta": {"schema_name": "H2OErrorV3"},
@@ -1302,11 +1355,13 @@ class _Handler(BaseHTTPRequestHandler):
         if self.command != "HEAD":
             self.wfile.write(data)
 
-    def _reply(self, status, obj):
+    def _reply(self, status, obj, headers=None):
         data = json.dumps(obj, default=_json_default).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         if self.command != "HEAD":
             self.wfile.write(data)

@@ -1,0 +1,260 @@
+"""Deterministic fault injection — the chaos seam for resilience tests.
+
+Reference: h2o-3 has no first-class fault injection; its robustness
+surface is the cloud runtime's heartbeats + job supervision (SURVEY
+L1/L2). This rebuild gets the production substrate those provide by
+making failure REPRODUCIBLE: seeded, countable failure points threaded
+through the transfer paths (H2D/D2H), the XLA compile/execute call
+sites, persist reads and the serve batcher's device stage, so the
+retry/degrade/circuit machinery can be asserted by tests and the chaos
+bench instead of waited for in production.
+
+Spec grammar (``H2O3_FAULTS`` env var or ``POST /3/Faults?spec=...``)::
+
+    H2O3_FAULTS="site[@pipeline]:every=N[:exc=Name][:times=M][:after=K][:key=K],..."
+
+- ``site``      — one of the instrumented points: ``h2d``, ``d2h``,
+                  ``compile``, ``execute``, ``persist`` (free-form
+                  strings; unknown sites simply never fire).
+- ``@pipeline`` — optional filter on the calling pipeline label
+                  (``ingest``/``train``/``serve``); omitted = any.
+- ``every=N``   — fire on every Nth matching check (the Nth, 2Nth, …).
+- ``exc=Name``  — exception class: ``Unavailable`` (default, transient),
+                  ``Internal``, ``DataLoss`` (transient),
+                  ``ResourceExhausted`` (device OOM — NOT retried, it
+                  triggers graceful degradation), ``Fatal`` (kills the
+                  job — the mid-train-kill probe), ``IOError``.
+- ``times=M``   — fire at most M times, then the rule is exhausted.
+- ``after=K``   — skip the first K matching checks before counting.
+- ``key=K``     — fire only for a matching object key (e.g. one serve
+                  deployment), leaving other traffic healthy.
+
+Gating idiom matches ``H2O3_TELEMETRY=0``: call sites guard with
+``if faults.ACTIVE: faults.check(...)`` — when no spec is configured
+the whole machinery is ONE module-attribute load + branch (asserted by
+tests/test_resilience.py's no-op budget guard, same method as the PR-4
+telemetry overhead guard).
+
+Every fired fault increments ``h2o3_fault_injected_total{site=...}`` so
+chaos rounds can account exactly for what they injected.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+
+# ---------------- injected exception taxonomy --------------------------
+#
+# Messages carry the grpc/XLA status-code spellings (RESOURCE_EXHAUSTED,
+# UNAVAILABLE, …) so the message-marker classifier in resilience.py
+# treats injected faults exactly like the real XlaRuntimeError ones.
+
+class InjectedFault(RuntimeError):
+    """Base for every injected failure (lets tests and the chaos bench
+    distinguish injected from organic errors)."""
+
+
+class Unavailable(InjectedFault):
+    """Transient device/transfer hiccup — retryable."""
+
+
+class Internal(InjectedFault):
+    """Transient internal runtime error — retryable."""
+
+
+class DataLoss(InjectedFault):
+    """Transient corrupted-transfer error — retryable."""
+
+
+class ResourceExhausted(InjectedFault):
+    """Device OOM — NOT retryable; triggers dense→streamed degrade."""
+
+
+class Fatal(InjectedFault):
+    """Unrecoverable failure — neither retried nor degraded (the
+    mid-train-kill probe for checkpoint/resume tests)."""
+
+
+class InjectedIOError(InjectedFault, IOError):
+    """Flaky-storage read failure — retried by the persist layer."""
+
+
+_EXC_BY_NAME = {
+    "unavailable": (Unavailable, "UNAVAILABLE: injected fault"),
+    "internal": (Internal, "INTERNAL: injected fault"),
+    "dataloss": (DataLoss, "DATA_LOSS: injected fault"),
+    "resourceexhausted": (ResourceExhausted,
+                          "RESOURCE_EXHAUSTED: injected device OOM"),
+    "oom": (ResourceExhausted, "RESOURCE_EXHAUSTED: injected device OOM"),
+    "fatal": (Fatal, "FATAL: injected kill"),
+    "ioerror": (InjectedIOError, "IO error: injected flaky storage"),
+}
+
+
+class _Rule:
+    __slots__ = ("site", "pipeline", "key", "every", "times", "after",
+                 "exc_cls", "exc_msg", "seen", "fired")
+
+    def __init__(self, site: str, pipeline: Optional[str],
+                 key: Optional[str], every: int, times: Optional[int],
+                 after: int, exc_name: str):
+        self.site = site
+        self.pipeline = pipeline
+        self.key = key
+        self.every = max(int(every), 1)
+        self.times = times          # None = unlimited
+        self.after = max(int(after), 0)
+        if exc_name.lower() not in _EXC_BY_NAME:
+            # a typo'd exc= must not silently become a different fault
+            # class — a chaos probe for OOM-degrade would then exercise
+            # the retry path and report the wrong machinery as covered
+            raise ValueError(
+                f"unknown fault exc '{exc_name}' (one of "
+                f"{sorted(_EXC_BY_NAME)})")
+        cls, msg = _EXC_BY_NAME[exc_name.lower()]
+        self.exc_cls = cls
+        self.exc_msg = msg
+        self.seen = 0               # matching checks observed
+        self.fired = 0              # faults actually raised
+
+    def matches(self, site: str, pipeline: Optional[str],
+                key: Optional[str]) -> bool:
+        if self.site != site:
+            return False
+        if self.pipeline is not None and self.pipeline != pipeline:
+            return False
+        if self.key is not None and self.key != key:
+            return False
+        return True
+
+    def should_fire(self) -> bool:
+        """Advance the deterministic counter; True when this check is a
+        firing one. Caller holds the module lock."""
+        if self.times is not None and self.fired >= self.times:
+            return False
+        self.seen += 1
+        n = self.seen - self.after
+        if n <= 0:
+            return False
+        if n % self.every != 0:
+            return False
+        self.fired += 1
+        return True
+
+    def describe(self) -> Dict[str, object]:
+        return {"site": self.site, "pipeline": self.pipeline,
+                "key": self.key, "every": self.every,
+                "times": self.times, "after": self.after,
+                "exc": self.exc_cls.__name__,
+                "seen": self.seen, "fired": self.fired}
+
+
+def _parse(spec: str) -> List[_Rule]:
+    rules: List[_Rule] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        head = fields[0]
+        pipeline = None
+        if "@" in head:
+            head, pipeline = head.split("@", 1)
+        kw = {"every": 1, "times": None, "after": 0, "exc": "unavailable",
+              "key": None}
+        for f in fields[1:]:
+            if "=" not in f:
+                raise ValueError(
+                    f"bad fault clause '{f}' in '{part}' — expected "
+                    f"key=value")
+            k, v = f.split("=", 1)
+            k = k.strip().lower()
+            if k not in kw:
+                raise ValueError(f"unknown fault option '{k}' in '{part}'")
+            kw[k] = v
+        rules.append(_Rule(
+            head.strip(), pipeline.strip() if pipeline else None,
+            kw["key"], int(kw["every"]),
+            None if kw["times"] is None else int(kw["times"]),
+            int(kw["after"]), str(kw["exc"])))
+    return rules
+
+
+# ---------------- module state -----------------------------------------
+
+# ACTIVE is the call-site gate: None when no spec is configured, so the
+# unset-path cost is one attribute load + branch (H2O3_TELEMETRY idiom).
+ACTIVE: Optional[List[_Rule]] = None
+_SPEC: Optional[str] = None
+_LOCK = threading.Lock()
+
+
+def configure(spec: Optional[str]) -> None:
+    """(Re)configure fault injection from a spec string; ``None`` or an
+    empty string disables it and restores the checked-no-op path."""
+    global ACTIVE, _SPEC
+    if not spec:
+        with _LOCK:
+            ACTIVE = None
+            _SPEC = None
+        return
+    rules = _parse(spec)            # validate BEFORE swapping in
+    with _LOCK:
+        ACTIVE = rules if rules else None
+        _SPEC = spec if rules else None
+
+
+def spec() -> Optional[str]:
+    return _SPEC
+
+
+def describe() -> List[Dict[str, object]]:
+    with _LOCK:
+        return [r.describe() for r in (ACTIVE or [])]
+
+
+def check(site: str, pipeline: Optional[str] = None,
+          key: Optional[str] = None) -> None:
+    """Raise the configured exception when a rule for this site fires.
+
+    Call sites MUST pre-gate with ``if faults.ACTIVE:`` so the unset
+    path never enters this function."""
+    rules = ACTIVE
+    if rules is None:
+        return
+    with _LOCK:
+        fire = None
+        for r in rules:
+            if r.matches(site, pipeline, key) and r.should_fire():
+                fire = r
+                break
+    if fire is None:
+        return
+    from h2o3_tpu import telemetry
+    telemetry.counter(
+        "h2o3_fault_injected_total", {"site": site},
+        help="faults raised by the injection layer").inc()
+    from h2o3_tpu.log import warn
+    warn("fault injected at %s%s: %s", site,
+         f"@{pipeline}" if pipeline else "", fire.exc_cls.__name__)
+    raise fire.exc_cls(
+        f"{fire.exc_msg} (site={site}"
+        + (f"@{pipeline}" if pipeline else "") + ")")
+
+
+def fired_total() -> int:
+    with _LOCK:
+        return sum(r.fired for r in (ACTIVE or []))
+
+
+# env configuration at import (the bench/chaos tool path); REST can
+# reconfigure at runtime via POST /3/Faults. A malformed env spec must
+# not poison `import h2o3_tpu` (every other H2O3_* knob parses
+# defensively) — warn and run without injection instead.
+try:
+    configure(os.environ.get("H2O3_FAULTS"))
+except ValueError as _e:
+    import warnings
+    warnings.warn(f"ignoring malformed H2O3_FAULTS: {_e}")

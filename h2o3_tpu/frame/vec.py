@@ -396,8 +396,16 @@ def batch_device_put(columns, fill, dtype, nrow: int, mesh=None):
         for j in range(len(columns)):
             _pack(j)
     record_h2d(mat.nbytes)
-    dev = jax.device_put(mat, data_sharding(mesh))
+    dev = _resilient_put(mat, data_sharding(mesh))
     return [dev[:, j] for j in range(len(columns))]
+
+
+def _resilient_put(arr, sharding):
+    """device_put behind the fault seam + shared transient retry: a
+    transient H2D failure (injected or organic) re-issues the DMA with
+    backoff instead of failing the whole parse/train."""
+    from h2o3_tpu.resilience import resilient_device_put
+    return resilient_device_put(arr, sharding)
 
 
 def _pad_and_put(arr: np.ndarray, nrow: int, fill, mesh):
@@ -405,4 +413,4 @@ def _pad_and_put(arr: np.ndarray, nrow: int, fill, mesh):
     if plen != nrow:
         arr = np.concatenate([arr, np.full(plen - nrow, fill, dtype=arr.dtype)])
     record_h2d(arr.nbytes)
-    return jax.device_put(arr, data_sharding(mesh))
+    return _resilient_put(arr, data_sharding(mesh))

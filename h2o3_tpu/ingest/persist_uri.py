@@ -32,15 +32,28 @@ def _cache_path(uri: str) -> str:
 def _fill_cache(out: str, download_to) -> None:
     """Download via a PROCESS-UNIQUE temp file then rename atomically:
     a partial or concurrently-interleaved download must never land at
-    the final cache path."""
-    fd, tmp = tempfile.mkstemp(dir=_CACHE_DIR, suffix=".part")
-    os.close(fd)
-    try:
-        download_to(tmp)
-        os.replace(tmp, out)
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
+    the final cache path. The whole download attempt rides the shared
+    retry/backoff helper (bounded attempts, jittered exponential
+    backoff) so a flaky remote store — a reset connection, a 5xx burst —
+    retries instead of failing the whole parse; each attempt restarts
+    from its own temp file, so a partial read never survives."""
+    from h2o3_tpu import faults
+    from h2o3_tpu.resilience import is_transient_io, retry_transient
+
+    def _attempt():
+        fd, tmp = tempfile.mkstemp(dir=_CACHE_DIR, suffix=".part")
+        os.close(fd)
+        try:
+            if faults.ACTIVE:
+                faults.check("persist", key=out)
+            download_to(tmp)
+            os.replace(tmp, out)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    retry_transient(_attempt, site="persist.localize",
+                    classify=is_transient_io, base_delay_s=0.2)
 
 
 def _remote_fs(uri: str):

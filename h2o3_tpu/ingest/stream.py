@@ -124,7 +124,10 @@ class ChunkDeviceStreamer:
                 # column needs an exact host shadow (integral > 2^24)
                 self._f64.setdefault(i, {})[chunk_idx] = f64
         self._rows[chunk_idx] = rows_c or 0
-        dev = jax.device_put(mat)
+        # a transient chunk-upload failure retries with backoff instead
+        # of failing the whole parse (the fault-matrix test drives this)
+        from h2o3_tpu.resilience import resilient_device_put
+        dev = resilient_device_put(mat, pipeline="ingest")
         telemetry.record_h2d(mat.nbytes, pipeline="ingest")
         self.h2d_bytes += mat.nbytes
         self._devs[chunk_idx] = dev

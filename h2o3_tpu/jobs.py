@@ -11,6 +11,16 @@ train, predict and micro-batch admin call makes one), so the registry
 evicts terminal jobs beyond a bounded tail (H2O3_JOBS_KEEP, default
 512) — the water/Job analog stores jobs in the DKV where the cleaner
 eventually reclaims them; here eviction rides on registration.
+
+Supervision (the SURVEY L1/L2 heartbeat analog, single-process): every
+progress update is a heartbeat; a lazily-started watchdog thread marks
+RUNNING jobs with no heartbeat for ``stall_timeout_secs`` as STALLED
+(visible on /3/Jobs and the ``h2o3_jobs_stalled`` gauge) and enforces
+``max_runtime_secs`` by requesting cancellation — the loops that poll
+``cancel_requested`` (tree chunks, streamed level passes, CV folds)
+then exit cooperatively. Failures carry STRUCTURED info (exception
+class + message + the failed pipeline stage from the innermost open
+span) alongside the raw traceback, so clients don't parse stack text.
 """
 from __future__ import annotations
 
@@ -32,11 +42,25 @@ _REGISTRY: Dict[str, "Job"] = {}
 _LOCK = threading.Lock()
 
 
+class JobCancelled(Exception):
+    """Raised inside cooperative cancellation points (streamed level
+    passes) to unwind a cancelled job's work loop cleanly."""
+
+
 def _jobs_keep() -> int:
     try:
         return int(os.environ.get("H2O3_JOBS_KEEP", "512") or 512)
     except ValueError:
         return 512
+
+
+def _stall_default() -> float:
+    """Default heartbeat-stall threshold in seconds; 0 disables stall
+    detection (the default — opt in via H2O3_JOB_STALL_SECS)."""
+    try:
+        return float(os.environ.get("H2O3_JOB_STALL_SECS", "0") or 0)
+    except ValueError:
+        return 0.0
 
 
 def _evict_terminal_locked(keep: int) -> None:
@@ -48,8 +72,74 @@ def _evict_terminal_locked(keep: int) -> None:
         del _REGISTRY[k]
 
 
+# ---------------- watchdog --------------------------------------------
+#
+# One daemon thread per process, started lazily the first time a job
+# that needs supervision (max_runtime_secs or stall detection) is
+# registered — test suites that never opt in never grow a thread.
+
+_WATCHDOG: Optional[threading.Thread] = None
+
+
+def _watch_tick() -> float:
+    try:
+        return max(float(os.environ.get("H2O3_JOB_WATCH_TICK", "1.0")
+                         or 1.0), 0.01)
+    except ValueError:
+        return 1.0
+
+
+def _ensure_watchdog() -> None:
+    global _WATCHDOG
+    with _LOCK:
+        if _WATCHDOG is not None and _WATCHDOG.is_alive():
+            return
+        _WATCHDOG = threading.Thread(target=_watch_loop, daemon=True,
+                                     name="job-watchdog")
+        _WATCHDOG.start()
+
+
+def _watch_loop() -> None:
+    from h2o3_tpu import telemetry
+    from h2o3_tpu.log import warn
+    stalled_gauge = telemetry.gauge(
+        "h2o3_jobs_stalled", help="RUNNING jobs with no recent progress "
+        "heartbeat")
+    timeout_ctr = telemetry.counter(
+        "h2o3_jobs_runtime_exceeded_total",
+        help="jobs cancelled for exceeding max_runtime_secs")
+    while True:
+        time.sleep(_watch_tick())
+        now = time.time()
+        n_stalled = 0
+        for j in list_jobs():
+            if j.status != RUNNING:
+                continue
+            if (j.max_runtime_secs and not j.cancel_requested
+                    and now - j.start_time > j.max_runtime_secs):
+                warn("job %s exceeded max_runtime_secs=%.1f — cancelling",
+                     j.key, j.max_runtime_secs)
+                timeout_ctr.inc()
+                j.cancel(reason=f"max_runtime_secs="
+                                f"{j.max_runtime_secs:g} exceeded")
+            stall = j.stall_timeout_secs
+            if stall and now - j.last_progress_time > stall:
+                if not j.stalled:
+                    j.stalled = True
+                    warn("job %s stalled: no progress for %.1fs "
+                         "(threshold %.1fs)", j.key,
+                         now - j.last_progress_time, stall)
+                n_stalled += 1
+            elif j.stalled:
+                j.stalled = False      # heartbeat resumed
+        stalled_gauge.set(n_stalled)
+
+
 class Job:
-    def __init__(self, description: str, work: float = 1.0, key: Optional[str] = None):
+    def __init__(self, description: str, work: float = 1.0,
+                 key: Optional[str] = None,
+                 max_runtime_secs: float = 0.0,
+                 stall_timeout_secs: Optional[float] = None):
         self.key = key or f"$job_{uuid.uuid4().hex[:12]}"
         self.description = description
         self.status = RUNNING
@@ -58,9 +148,22 @@ class Job:
         self.start_time = time.time()
         self.end_time: Optional[float] = None
         self.exception: Optional[str] = None
+        # structured failure info (/3/Jobs): class + message + pipeline
+        # stage, so clients don't have to parse the traceback string
+        self.exception_type: Optional[str] = None
+        self.exception_msg: Optional[str] = None
+        self.failed_stage: Optional[str] = None
         self.result: Any = None
         self._cancel_requested = False
+        self.cancel_reason: Optional[str] = None
         self._thread: Optional[threading.Thread] = None
+        # supervision state: every progress write is a heartbeat
+        self.max_runtime_secs = float(max_runtime_secs or 0.0)
+        self.stall_timeout_secs = (_stall_default()
+                                   if stall_timeout_secs is None
+                                   else float(stall_timeout_secs))
+        self.last_progress_time = self.start_time
+        self.stalled = False
         # per-job mutex: _worked is read by REST pollers and bumped by
         # the worker thread (often from several CV/fold threads at
         # once) — `self._worked += w` is a read-modify-write that loses
@@ -69,6 +172,8 @@ class Job:
         with _LOCK:
             _REGISTRY[self.key] = self
             _evict_terminal_locked(_jobs_keep())
+        if self.max_runtime_secs or self.stall_timeout_secs:
+            _ensure_watchdog()
 
     # -- progress -------------------------------------------------------
     @property
@@ -81,20 +186,44 @@ class Job:
     def update(self, worked: float):
         with self._mutex:
             self._worked += worked
+            self.last_progress_time = time.time()
+            self.stalled = False       # any progress IS the heartbeat
 
     def set_progress(self, frac: float):
         with self._mutex:
             self._worked = frac * self._work
+            self.last_progress_time = time.time()
+            self.stalled = False
 
     # -- lifecycle ------------------------------------------------------
+    def _record_failure(self, exc: BaseException) -> None:
+        self.exception = traceback.format_exc()
+        self.exception_type = type(exc).__name__
+        self.exception_msg = str(exc)
+        # failed stage = the INNERMOST span this exception unwound
+        # through on the worker thread (spans note it in __exit__;
+        # phase contexts have already popped by catch time, so
+        # current_span() alone would miss it); falls back to whatever
+        # span is still open
+        try:
+            from h2o3_tpu import telemetry
+            self.failed_stage = telemetry.last_error_span(exc)
+            if self.failed_stage is None:
+                sp = telemetry.current_span()
+                self.failed_stage = sp.name if sp is not None else None
+        except Exception:   # noqa: BLE001 — diagnostics must not mask
+            self.failed_stage = None
+
     def run(self, fn: Callable[["Job"], Any], background: bool = False) -> "Job":
         def body():
             try:
                 self.result = fn(self)
                 self.status = DONE if not self._cancel_requested else CANCELLED
-            except Exception:
+            except JobCancelled:
+                self.status = CANCELLED
+            except Exception as e:
                 self.status = FAILED
-                self.exception = traceback.format_exc()
+                self._record_failure(e)
             finally:
                 self.end_time = time.time()
         if background:
@@ -111,8 +240,10 @@ class Job:
             raise RuntimeError(f"Job {self.key} failed:\n{self.exception}")
         return self.result
 
-    def cancel(self):
+    def cancel(self, reason: Optional[str] = None):
         self._cancel_requested = True
+        if reason and not self.cancel_reason:
+            self.cancel_reason = reason
 
     @property
     def cancel_requested(self) -> bool:

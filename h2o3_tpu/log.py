@@ -117,9 +117,14 @@ class Profile:
         cm.__enter__()
         try:
             yield
-        finally:
-            cm.__exit__(None, None, None)
+        except BaseException as e:
+            # hand the exception to the span exit so the failed stage
+            # is noted (jobs.py reads it for /3/Jobs failed_stage)
+            cm.__exit__(type(e), e, e.__traceback__)
             self._accumulate(name, time.perf_counter() - t0)
+            raise
+        cm.__exit__(None, None, None)
+        self._accumulate(name, time.perf_counter() - t0)
 
     def _accumulate(self, name: str, dt: float):
         if name not in self.phases:

@@ -38,6 +38,7 @@ from h2o3_tpu.models.tree import (TreeConfig, adaptive_feasible,
 from h2o3_tpu.ops.binning import CodesView, bin_matrix_device, make_codes_view
 from h2o3_tpu.parallel.mesh import DATA_AXIS, current_mesh, n_data_shards
 from h2o3_tpu.persist import register_model_class
+from h2o3_tpu.resilience import retry_transient
 
 MAX_DEPTH_CAP = 16
 
@@ -52,6 +53,13 @@ DRF_DEFAULTS: Dict = dict(
     min_split_improvement=1e-5, seed=-1, histogram_type="uniform_adaptive",
     score_tree_interval=0, stopping_rounds=0, stopping_metric="auto",
     stopping_tolerance=1e-3, hist_kernel="auto", reg_lambda=0.0,
+    # continue-training + in-training checkpoints (formerly a
+    # compat_params warn entry): forest trees are independent, so a
+    # resumed train with the same seed rebuilds the remaining trees
+    # bit-identically; OOB accumulators ride the checkpoint as resume
+    # state so training metrics match the uninterrupted run
+    checkpoint=None, in_training_checkpoints_dir=None,
+    in_training_checkpoints_tree_interval=1,
 )
 
 
@@ -116,6 +124,15 @@ class DRFModel(TreeScoringOptionsMixin, Model):
              "value": np.asarray(jax.device_get(self._value))}
         if self._node_w is not None:
             d["node_w"] = np.asarray(jax.device_get(self._node_w))
+        # in-training checkpoint resume state: the OOB accumulators at
+        # the committed tree count, so resumed training metrics equal
+        # the uninterrupted run's
+        for attr, name in (("_resume_oob_num", "resume_oob_num"),
+                           ("_resume_oob_cnt", "resume_oob_cnt"),
+                           ("_resume_sig", "resume_sig")):
+            v = getattr(self, attr, None)
+            if v is not None:
+                d[name] = np.asarray(v)
         for i, e in enumerate(self.edges):
             d[f"edge_{i}"] = np.asarray(e)
         return d
@@ -141,6 +158,12 @@ class DRFModel(TreeScoringOptionsMixin, Model):
         m._value = jnp.asarray(arrays["value"])
         m._node_w = (jnp.asarray(arrays["node_w"])
                      if "node_w" in arrays else None)
+        m._resume_oob_num = (np.asarray(arrays["resume_oob_num"])
+                             if "resume_oob_num" in arrays else None)
+        m._resume_oob_cnt = (np.asarray(arrays["resume_oob_cnt"])
+                             if "resume_oob_cnt" in arrays else None)
+        m._resume_sig = (np.asarray(arrays["resume_sig"])
+                         if "resume_sig" in arrays else None)
         return m
 
 
@@ -298,6 +321,9 @@ class H2ORandomForestEstimator(ModelBuilder):
                                  else int(time.time() * 1e3) % (2 ** 31))
         srpc = self.validate_sample_rate_per_class(spec)
         ntrees = int(p["ntrees"])
+        prior = self._resolve_checkpoint(spec)
+        start_trees = prior.ntrees_built if prior is not None else 0
+        ntrees_new = ntrees - start_trees
         sample_rate = float(p["sample_rate"])
         col_rate = float(p.get("col_sample_rate_per_tree", 1.0))
         Xtr = spec.X if adaptive else bm.codes.rm
@@ -308,37 +334,138 @@ class H2ORandomForestEstimator(ModelBuilder):
         # bucket (see the margin pinning note in models/gbm.py)
         from jax.sharding import NamedSharding
         rows_sh = NamedSharding(mesh, P(DATA_AXIS))
-        oob_num = jax.device_put(
-            jnp.zeros(padded if K == 1 else (padded, K), jnp.float32),
-            rows_sh)
-        oob_cnt = jax.device_put(jnp.zeros(padded, jnp.float32), rows_sh)
+        # checkpoint continuation resumes the OOB accumulators saved
+        # with the prior (else new trees' OOB would be averaged from a
+        # zeroed state and training metrics would drift from the
+        # uninterrupted run)
+        from h2o3_tpu.models.gbm import _spec_signature
+        rn = getattr(prior, "_resume_oob_num", None) \
+            if prior is not None else None
+        rc = getattr(prior, "_resume_oob_cnt", None) \
+            if prior is not None else None
+        psig = getattr(prior, "_resume_sig", None) \
+            if prior is not None else None
+        # the saved OOB state belongs to a specific training frame —
+        # applying it to different data would silently skew metrics
+        sig_ok = psig is None or np.array_equal(np.asarray(psig),
+                                                _spec_signature(spec))
+        want = (padded,) if K == 1 else (padded, K)
+        if rn is not None and rc is not None and sig_ok \
+                and np.asarray(rn).shape == tuple(want):
+            oob_num = jax.device_put(jnp.asarray(rn, jnp.float32), rows_sh)
+            oob_cnt = jax.device_put(jnp.asarray(rc, jnp.float32), rows_sh)
+        else:
+            if prior is not None:
+                from h2o3_tpu.log import warn
+                warn("drf checkpoint carries no OOB resume state — "
+                     "training metrics will reflect only the new trees")
+            oob_num = jax.device_put(
+                jnp.zeros(padded if K == 1 else (padded, K), jnp.float32),
+                rows_sh)
+            oob_cnt = jax.device_put(jnp.zeros(padded, jnp.float32),
+                                     rows_sh)
         y = spec.y
         all_trees = []          # [(device chunk trees, n_active)]
         built = 0
-        chunk = min(ntrees, 25)
-        donate = jax.default_backend() == "tpu"
+        chunk = min(ntrees_new, 25)
+        ckpt_dir = p.get("in_training_checkpoints_dir")
+        ckpt_interval = max(int(
+            p.get("in_training_checkpoints_tree_interval", 1) or 1), 1)
+        ckpt_on = bool(ckpt_dir)
+        if ckpt_on:
+            chunk = max(min(chunk, ckpt_interval), 1)
+        trees_since_ckpt = 0
+        # donation is unsafe with checkpoints on: commit_ckpt
+        # device_gets the OOB accumulators, which a donated dispatch
+        # would already have consumed
+        donate = jax.default_backend() == "tpu" and not ckpt_on
         rate_t = jnp.float32(sample_rate)
         col_rate_t = jnp.float32(col_rate)
+
+        def commit_ckpt():
+            # advisory end to end: a transient fetch failure in the
+            # finalize/OOB device_gets must neither kill a healthy
+            # train nor mask the original error on the failure path
+            try:
+                m = self._finalize(spec, bm, cfg, K, built, all_trees,
+                                   prior=prior, tree_offset=start_trees)
+                m._resume_oob_num = np.asarray(jax.device_get(oob_num),
+                                               np.float32)
+                m._resume_oob_cnt = np.asarray(jax.device_get(oob_cnt),
+                                               np.float32)
+                m._resume_sig = _spec_signature(spec)
+                from h2o3_tpu.models.model_base import \
+                    persist_in_training_ckpt
+                persist_in_training_ckpt(m, self.algo, ckpt_dir)
+            except Exception as e:  # noqa: BLE001 — advisory only
+                from h2o3_tpu.log import warn
+                warn("drf: in-training checkpoint commit failed: %s", e)
+
         t0 = time.time()
-        while built < ntrees:
+        while built < ntrees_new:
             # bucket-rounded chunk lengths (models/gbm.py): ntrees
             # variants landing in one bucket reuse the executable
-            c = min(chunk, ntrees - built)
-            step = _compiled_drf_chunk(mesh, cfg, K, srpc, chunk_bucket(c),
-                                       has_t, adaptive, donate)
-            oob_num, oob_cnt, chunk_trees = step(
-                Xtr, codes_t_arg, y, spec.w, oob_num, oob_cnt, key,
-                root_lo, root_hi, nb_f, jnp.int32(built), jnp.int32(c),
-                rate_t, col_rate_t)
+            c = min(chunk, ntrees_new - built)
+
+            def _dispatch(c=c):
+                from h2o3_tpu import faults
+                if faults.ACTIVE:
+                    faults.check("compile", pipeline="train")
+                step = _compiled_drf_chunk(mesh, cfg, K, srpc,
+                                           chunk_bucket(c), has_t,
+                                           adaptive, donate)
+                if faults.ACTIVE:
+                    faults.check("execute", pipeline="train")
+                return step(
+                    Xtr, codes_t_arg, y, spec.w, oob_num, oob_cnt, key,
+                    root_lo, root_hi, nb_f,
+                    jnp.int32(start_trees + built), jnp.int32(c),
+                    rate_t, col_rate_t)
+            try:
+                # transient failures retry with backoff; donated OOB
+                # accumulators cannot be replayed (TPU path), so
+                # donation disables retry
+                oob_num, oob_cnt, chunk_trees = retry_transient(
+                    _dispatch, site="train.execute",
+                    attempts=1 if donate else 3)
+            except BaseException:
+                if ckpt_on and built > 0:
+                    # leave a resumable checkpoint at the committed
+                    # prefix before the failure propagates
+                    commit_ckpt()
+                raise
             all_trees.append((chunk_trees, c))
             built += c
-            job.set_progress(built / ntrees)
+            trees_since_ckpt += c
+            if ckpt_on and trees_since_ckpt >= ckpt_interval \
+                    and built < ntrees_new:
+                commit_ckpt()
+                trees_since_ckpt = 0
+            job.set_progress(built / ntrees_new)
             if job.cancel_requested:
                 break
         jax.block_until_ready(oob_cnt)
         t_loop = time.time() - t0
 
-        model = self._finalize(spec, bm, cfg, K, built, all_trees)
+        model = self._finalize(spec, bm, cfg, K, built, all_trees,
+                               prior=prior, tree_offset=start_trees)
+        if ckpt_on:
+            try:
+                model._resume_oob_num = np.asarray(
+                    jax.device_get(oob_num), np.float32)
+                model._resume_oob_cnt = np.asarray(
+                    jax.device_get(oob_cnt), np.float32)
+                model._resume_sig = _spec_signature(spec)
+                from h2o3_tpu.models.model_base import \
+                    persist_in_training_ckpt
+                # final=True: the durable artifact is written but the
+                # DKV '<key>_ckpt' entry is dropped — the finished
+                # model supersedes it
+                persist_in_training_ckpt(model, self.algo, ckpt_dir,
+                                         final=True)
+            except Exception as e:  # noqa: BLE001 — advisory only
+                from h2o3_tpu.log import warn
+                warn("drf: final in-training checkpoint failed: %s", e)
         model.output["training_loop_seconds"] = t_loop
         # OOB metrics as training metrics (reference DRF semantics:
         # "training" numbers are out-of-bag when sample_rate < 1)
@@ -384,7 +511,47 @@ class H2ORandomForestEstimator(ModelBuilder):
                 pk, y[live], w[live], K, spec.response_domain)
         model.output["oob_metrics"] = True
 
-    def _finalize(self, spec, bm, cfg, K, built, all_trees) -> DRFModel:
+    def _resolve_checkpoint(self, spec):
+        """Continue-training support (hex/Model.java:487 _checkpoint):
+        same compatibility contract as GBM's — the prior trees' feature
+        indices and enum-code thresholds must address the same columns
+        and domains."""
+        ckpt = self.params.get("checkpoint")
+        if not ckpt:
+            return None
+        from h2o3_tpu.models.gbm import _resolve_checkpoint_source
+        prior = _resolve_checkpoint_source(ckpt, DRFModel, "DRF")
+        if prior.max_depth != int(self.params["max_depth"]):
+            raise ValueError("checkpoint max_depth differs")
+        if int(self.params["ntrees"]) <= prior.ntrees_built:
+            raise ValueError(
+                f"ntrees ({self.params['ntrees']}) must exceed the "
+                f"checkpoint's ntrees_built ({prior.ntrees_built})")
+        if list(prior.feature_names) != list(spec.names):
+            raise ValueError(
+                f"checkpoint feature set {prior.feature_names} differs "
+                f"from the training spec's {spec.names}")
+        if prior.nclasses != spec.nclasses:
+            raise ValueError(
+                f"checkpoint has {prior.nclasses} response classes but "
+                f"the training frame has {spec.nclasses}")
+        prd = tuple(prior.response_domain) if prior.response_domain else None
+        srd = tuple(spec.response_domain) if spec.response_domain else None
+        if prd != srd:
+            raise ValueError(
+                f"checkpoint response domain {prior.response_domain} "
+                f"differs from the training frame's "
+                f"{spec.response_domain}")
+        pcd = {k: tuple(v) for k, v in prior.cat_domains.items()}
+        scd = {k: tuple(v) for k, v in spec.cat_domains.items()}
+        if pcd != scd:
+            raise ValueError(
+                "checkpoint categorical domains differ from the "
+                "training frame's")
+        return prior
+
+    def _finalize(self, spec, bm, cfg, K, built, all_trees, prior=None,
+                  tree_offset=0) -> DRFModel:
         M = cfg.n_nodes
         # one pytree device_get; padding-bucket tails sliced off in the
         # shared helper (models/tree.py collect_chunk_trees)
@@ -395,14 +562,38 @@ class H2ORandomForestEstimator(ModelBuilder):
         trees_host = {"feat": feat, "thr": th["thr"],
                       "na_left": th["na_left"], "is_split": th["is_split"],
                       "value": th["value"], "node_w": th["node_w"]}
+        if prior is not None:
+            # checkpoint continuation: prepend the prior model's trees
+            trees_host = {
+                "feat": np.concatenate([np.asarray(prior._feat), feat]),
+                "thr": np.concatenate([np.asarray(prior._thr),
+                                       th["thr"]]),
+                "na_left": np.concatenate([np.asarray(prior._na_left),
+                                           th["na_left"]]),
+                "is_split": np.concatenate([np.asarray(prior._is_split),
+                                            th["is_split"]]),
+                "value": np.concatenate([np.asarray(prior._value),
+                                         th["value"]]),
+                "node_w": (np.concatenate([np.asarray(prior._node_w),
+                                           th["node_w"]])
+                           if getattr(prior, "_node_w", None) is not None
+                           else None),
+            }
         model = DRFModel(f"{self.algo}_{id(self) & 0xffffff:x}", self.params,
                          spec, trees_host,
                          bm.edges if bm is not None else [],
                          bm.n_bins if bm is not None else cfg.n_bins,
-                         cfg.max_depth, built, spec.nclasses)
+                         cfg.max_depth, tree_offset + built, spec.nclasses)
         vi = np.zeros(len(spec.names))
         live = feat >= 0
         np.add.at(vi, feat[live], gains[live])
+        if prior is not None:
+            pv = prior.output.get("variable_importances")
+            if pv:
+                lut = {n: i for i, n in enumerate(spec.names)}
+                for n, g in zip(pv["variable"], pv["relative_importance"]):
+                    if n in lut:
+                        vi[lut[n]] += g
         order = np.argsort(-vi)
         rel = vi / vi.max() if vi.max() > 0 else vi
         model.output["variable_importances"] = {

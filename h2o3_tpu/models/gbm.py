@@ -34,6 +34,7 @@ from h2o3_tpu.models.tree import (TreeConfig, adaptive_feasible,
 from h2o3_tpu.ops.binning import (CodesView, bin_matrix_device,
                                   digitize_with_edges, make_codes_view)
 from h2o3_tpu.parallel.mesh import DATA_AXIS, current_mesh, n_data_shards
+from h2o3_tpu.resilience import retry_transient
 
 GBM_DEFAULTS: Dict = dict(
     ntrees=50, max_depth=5, min_rows=10.0, learn_rate=0.1,
@@ -44,6 +45,13 @@ GBM_DEFAULTS: Dict = dict(
     huber_alpha=0.9, min_split_improvement=1e-5,
     seed=-1, stopping_rounds=0, stopping_metric="auto",
     stopping_tolerance=1e-3, score_tree_interval=0, reg_lambda=0.0,
+    # continue-training + in-training checkpoints (hex/Model.java:487
+    # _checkpoint, hex/tree/SharedTree in_training_checkpoints_*):
+    # REAL params now (formerly compat_params warn entries) — resumed
+    # trains are bit-identical to uninterrupted ones via the saved
+    # resume margin (tests/test_resilience.py)
+    checkpoint=None, in_training_checkpoints_dir=None,
+    in_training_checkpoints_tree_interval=1,
     # uniform_adaptive = the reference's default (hex/tree/DHistogram.java
     # UniformAdaptive): per-node re-binned uniform histograms via the fused
     # adaptive kernel; quantiles_global = global-sketch binned codes
@@ -63,6 +71,46 @@ GBM_DEFAULTS: Dict = dict(
 
 
 from h2o3_tpu.models.treeshap import TreeScoringOptionsMixin  # noqa: E402
+
+
+def _spec_signature(spec) -> np.ndarray:
+    """Cheap fingerprint of the training data a resume state belongs
+    to: (nrow, Σy, Σw) as f32 device reductions — identical data gives
+    bit-equal sums, different data virtually never does. Guards
+    against applying a checkpoint's saved margin/OOB state to a
+    different frame that merely has the same shape."""
+    return np.array([float(spec.nrow),
+                     float(jax.device_get(
+                         spec.y.astype(jnp.float32).sum())),
+                     float(jax.device_get(
+                         spec.w.astype(jnp.float32).sum()))],
+                    np.float64)
+
+
+def _resolve_checkpoint_source(ckpt, model_cls, algo_label):
+    """``checkpoint=`` accepts a live model, a DKV key (the in-training
+    checkpoints land there as ``<key>_ckpt``) or an artifact path
+    (hex/Model.java _checkpoint takes a Key; h2o-py also passes model
+    objects)."""
+    if isinstance(ckpt, model_cls):
+        return ckpt
+    if isinstance(ckpt, str):
+        from h2o3_tpu import dkv
+        ent = dkv.get_opt(ckpt)
+        if ent is not None and ent[0] == "model":
+            prior = ent[1]
+        else:
+            from h2o3_tpu.persist import load_model
+            prior = load_model(ckpt)
+    else:
+        raise ValueError(
+            f"checkpoint must be a {algo_label} model, DKV key or "
+            f"artifact path, got {type(ckpt).__name__}")
+    if not isinstance(prior, model_cls):
+        raise ValueError(
+            f"checkpoint resolves to a {getattr(prior, 'algo', '?')} "
+            f"model — {algo_label} can only continue from its own kind")
+    return prior
 
 
 class GBMModel(TreeScoringOptionsMixin, Model):
@@ -131,6 +179,16 @@ class GBMModel(TreeScoringOptionsMixin, Model):
              "f0": np.asarray(self.f0)}
         if self._node_w is not None:
             d["node_w"] = np.asarray(jax.device_get(self._node_w))
+        rm = getattr(self, "_resume_margin", None)
+        if rm is not None:
+            # in-training checkpoint state: the exact f32 training
+            # margin at the committed tree count — resuming from it
+            # (instead of re-summing tree contributions) is what makes
+            # a resumed train BIT-identical to an uninterrupted one
+            d["resume_margin"] = np.asarray(rm)
+        sig = getattr(self, "_resume_sig", None)
+        if sig is not None:
+            d["resume_sig"] = np.asarray(sig)
         for i, e in enumerate(self.edges):
             d[f"edge_{i}"] = np.asarray(e)
         return d
@@ -159,6 +217,10 @@ class GBMModel(TreeScoringOptionsMixin, Model):
         m._value = jnp.asarray(arrays["value"])
         m._node_w = (jnp.asarray(arrays["node_w"])
                      if "node_w" in arrays else None)
+        m._resume_margin = (np.asarray(arrays["resume_margin"])
+                            if "resume_margin" in arrays else None)
+        m._resume_sig = (np.asarray(arrays["resume_sig"])
+                         if "resume_sig" in arrays else None)
         return m
 
 
@@ -331,10 +393,53 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         return d
 
     def _train_impl(self, spec: TrainingSpec, valid_spec, job: Job) -> GBMModel:
-        p = self.params
         dist_name = self._resolve_distribution(spec)
         if spec.stream:
             return self._train_streaming(spec, valid_spec, dist_name, job)
+        try:
+            return self._train_dense(spec, valid_spec, dist_name, job)
+        except Exception as e:   # noqa: BLE001 — classified below
+            from h2o3_tpu.resilience import is_oom
+            if not is_oom(e):
+                raise
+            return self._degrade_to_streaming(spec, valid_spec, dist_name,
+                                              job, e)
+
+    def _degrade_to_streaming(self, spec: TrainingSpec, valid_spec,
+                              dist_name, job: Job,
+                              cause: BaseException) -> GBMModel:
+        """Device OOM mid-train: degrade from the dense grower to the
+        resident-window streamed path (water/Cleaner.java graceful
+        degradation) instead of crashing the job — slower, but the
+        train COMPLETES. The design matrix is pulled back to host and
+        the streamed pipeline re-uploads only what its memman window
+        allows resident."""
+        from h2o3_tpu import telemetry
+        from h2o3_tpu.log import warn
+        warn("%s: device OOM during dense training (%s: %s) — degrading "
+             "to the streamed resident-window path", self.algo,
+             type(cause).__name__, cause)
+        telemetry.counter(
+            "h2o3_degrade_total", {"algo": self.algo},
+            help="dense→streamed graceful degradations on device OOM"
+        ).inc()
+        from dataclasses import replace as dc_replace
+        X_host = np.asarray(jax.device_get(spec.X), np.float32)
+        host_spec = dc_replace(spec, X=None, X_host=X_host, stream=True)
+        try:
+            return self._train_streaming(host_spec, valid_spec, dist_name,
+                                         job)
+        except NotImplementedError as e2:
+            # this configuration has no streamed fallback (multinomial,
+            # huber, constraints, …): surface the ORIGINAL OOM — it is
+            # the actionable failure — with the degrade refusal chained
+            warn("%s: streamed fallback unavailable (%s) — re-raising "
+                 "the device OOM", self.algo, e2)
+            raise cause from e2
+
+    def _train_dense(self, spec: TrainingSpec, valid_spec, dist_name,
+                     job: Job) -> GBMModel:
+        p = self.params
         K = spec.nclasses if spec.nclasses > 2 else 1
         task = ("binomial" if spec.nclasses == 2
                 else "multinomial" if K > 1 else "regression")
@@ -407,7 +512,8 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             yf = y.astype(jnp.float32)
             if prior is not None:
                 f0 = jnp.asarray(prior.f0)
-                margin = prior._margin_matrix(spec.X).astype(jnp.float32)
+                margin, prior_has_offset = self._prior_margin(
+                    prior, spec, padded, K)
             else:
                 if spec.offset is not None:
                     # initial value on the offset-adjusted scale, not the
@@ -418,14 +524,16 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                 else:
                     f0 = dist.init_f0(yf, w)
                 margin = jnp.full(padded, f0, jnp.float32)
-            if spec.offset is not None:
+                prior_has_offset = False
+            if spec.offset is not None and not prior_has_offset:
                 # offset enters the margin, not the trees: f = f0 + offset + Σ lr·tree
-                # (reference GBM honors offsets in every distribution's margin)
+                # (reference GBM honors offsets in every distribution's
+                # margin); a resumed margin already carries it
                 margin = margin + spec.offset
         else:
             if prior is not None:
                 f0 = jnp.asarray(prior.f0)
-                margin = prior._margin_matrix(spec.X).astype(jnp.float32)
+                margin, _ = self._prior_margin(prior, spec, padded, K)
             else:
                 pri = jnp.maximum(
                     jnp.zeros(K, jnp.float32).at[y].add(w) / w.sum(), 1e-9)
@@ -492,6 +600,30 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         sti = int(p.get("score_tree_interval", 0) or 0)
         score_each = keeper.rounds > 0 or sti > 0
         chunk = interval if score_each else min(ntrees_new, 50)
+        # in-training checkpoints: align chunk commits to the checkpoint
+        # cadence so every `tree_interval` committed trees persist a
+        # resumable state (hex/tree/SharedTree in_training_checkpoints_*)
+        ckpt_dir = p.get("in_training_checkpoints_dir")
+        ckpt_interval = max(int(
+            p.get("in_training_checkpoints_tree_interval", 1) or 1), 1)
+        ckpt_on = bool(ckpt_dir)
+        if ckpt_on and not score_each:
+            # align chunk commits to the checkpoint cadence — but NEVER
+            # when interval scoring is on: shrinking the chunk there
+            # would change the early-stopping score cadence (a silent
+            # model change); checkpoints then land at the scoring
+            # chunk's commit boundaries instead
+            chunk = max(min(chunk, ckpt_interval), 1)
+        if ckpt_on and ntrees_new / ckpt_interval > 50:
+            # each commit re-fetches every committed tree + writes a
+            # full artifact (O(T²) across the train) — loud, not silent
+            from h2o3_tpu.log import warn as _warn
+            _warn("gbm: in_training_checkpoints_tree_interval=%d means "
+                  "~%d checkpoint commits, each fetching all committed "
+                  "trees and writing a full artifact — consider a "
+                  "larger interval", ckpt_interval,
+                  int(ntrees_new / ckpt_interval))
+        trees_since_ckpt = 0
         has_t = (not adaptive) and bm.codes.t is not None
         codes_t_arg = bm.codes.t if has_t else Xtr  # ignored dummy otherwise
         na_bin = 0 if adaptive else bm.na_bin
@@ -537,9 +669,11 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         rows_sh = NamedSharding(mesh, P(DATA_AXIS))
         margin = jax.device_put(margin, rows_sh)
         vmargin = jax.device_put(vmargin, rows_sh)
-        # buffer donation is only safe when an early stop can never force
-        # a rollback to the previous chunk's margins
-        donate = (keeper.rounds == 0
+        # buffer donation is only safe when (a) an early stop can never
+        # force a rollback to the previous chunk's margins and (b) no
+        # in-training checkpoint will device_get a margin after it has
+        # been donated to the next dispatch
+        donate = (keeper.rounds == 0 and not ckpt_on
                   and jax.default_backend() == "tpu")
         sc_spec = valid_spec if has_valid else spec
         want_auc = keeper.metric == "auc"
@@ -552,6 +686,27 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         inflight = None         # last dispatched, not yet committed chunk
         stopped = False
         jax.block_until_ready(margin)
+
+        def commit_ckpt(cur_margin):
+            """Write an in-training checkpoint at the COMMITTED tree
+            count (``built`` trees; ``cur_margin`` is their margin).
+            The WHOLE commit — finalize's tree device_get included — is
+            advisory: a transient fetch failure here must neither kill
+            a healthy train nor mask the original error on the
+            failure-path commit."""
+            try:
+                m = self._finalize(spec, None, dist_name, f0, all_trees,
+                                   bm, cfg, K, built, cur_margin, None,
+                                   keeper, tree_offset=start_trees,
+                                   prior=prior, dist=dist,
+                                   with_metrics=False)
+                self._write_in_training_checkpoint(m, cur_margin,
+                                                   ckpt_dir, spec=spec)
+            except Exception as e:  # noqa: BLE001 — advisory only
+                from h2o3_tpu.log import warn
+                warn("%s: in-training checkpoint commit failed: %s",
+                     self.algo, e)
+
         t_loop0 = time.time()
         score_s = 0.0
         # pipelined boosting: dispatch chunk k+1 BEFORE blocking on chunk
@@ -573,18 +728,48 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                 # so grid/AutoML ntrees variants reuse the executable;
                 # masked waste is bounded by ONE chunk per train
                 bucket = chunk_bucket(c)
-            step = _compiled_chunk(mesh, cfg, K, dist_name,
-                                   float(p["tweedie_power"]),
-                                   float(p.get("quantile_alpha", 0.5)),
-                                   srpc, na_bin, bucket, has_valid,
-                                   has_t, adaptive, has_mono, has_sets,
-                                   donate)
-            nm, nv, chunk_trees = step(
-                Xtr, codes_t_arg, margin, yf, w, vtrain, vmargin,
-                key, jnp.float32(lr), huber_delta,
-                root_lo, root_hi, nb_f, mono_arr, sets_arr,
-                jnp.int32(start_trees + disp), jnp.int32(c),
-                rate_t, col_rate_t, anneal_t)
+            def _dispatch(bucket=bucket, c=c):
+                # compile + execute behind the fault seam: both the
+                # executable build and the chunk dispatch may fail
+                # transiently (the injected faults reproduce that)
+                from h2o3_tpu import faults
+                if faults.ACTIVE:
+                    faults.check("compile", pipeline="train")
+                step = _compiled_chunk(mesh, cfg, K, dist_name,
+                                       float(p["tweedie_power"]),
+                                       float(p.get("quantile_alpha",
+                                                   0.5)),
+                                       srpc, na_bin, bucket, has_valid,
+                                       has_t, adaptive, has_mono,
+                                       has_sets, donate)
+                if faults.ACTIVE:
+                    faults.check("execute", pipeline="train")
+                return step(
+                    Xtr, codes_t_arg, margin, yf, w, vtrain, vmargin,
+                    key, jnp.float32(lr), huber_delta,
+                    root_lo, root_hi, nb_f, mono_arr, sets_arr,
+                    jnp.int32(start_trees + disp), jnp.int32(c),
+                    rate_t, col_rate_t, anneal_t)
+            try:
+                # transient device failures retry with backoff; donated
+                # operand buffers cannot be replayed, so donation (TPU,
+                # no early stopping) disables the retry path
+                nm, nv, chunk_trees = retry_transient(
+                    _dispatch, site="train.execute",
+                    attempts=1 if donate else 3)
+            except BaseException:
+                # commit the already-computed in-flight chunk and leave
+                # a resumable checkpoint before the error propagates —
+                # a mid-train kill then resumes from the committed
+                # prefix instead of tree 0 (`margin` still holds that
+                # chunk's outputs; it is only rebound after dispatch)
+                if inflight is not None:
+                    all_trees.append((inflight["trees"], inflight["c"]))
+                    built += inflight["c"]
+                    inflight = None
+                    if ckpt_on:
+                        commit_ckpt(margin)
+                raise
             pend = None
             if score_each:
                 pend = self._score_entry_dev(nv if has_valid else nm,
@@ -596,6 +781,7 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                 # while the device crunches the chunk just dispatched
                 all_trees.append((inflight["trees"], inflight["c"]))
                 built += inflight["c"]
+                trees_since_ckpt += inflight["c"]
                 if score_each:
                     t_s0 = time.time()
                     keeper.record(self._score_entry_fetch(inflight["pend"]))
@@ -608,6 +794,9 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                         # rollback — nm/nv are simply never used
                         stopped = True
                         break
+                if ckpt_on and trees_since_ckpt >= ckpt_interval:
+                    commit_ckpt(margin)   # margin = committed chunk's
+                    trees_since_ckpt = 0
             inflight = {"trees": chunk_trees, "c": c, "pend": pend}
             margin, vmargin = nm, nv
             disp += c
@@ -620,10 +809,15 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         if not stopped and inflight is not None:
             all_trees.append((inflight["trees"], inflight["c"]))
             built += inflight["c"]
+            trees_since_ckpt += inflight["c"]
             if score_each:
                 t_s0 = time.time()
                 keeper.record(self._score_entry_fetch(inflight["pend"]))
                 score_s += time.time() - t_s0
+            if ckpt_on and trees_since_ckpt > 0:
+                # final commit covers cancellation too: a cancelled job
+                # leaves a checkpoint at its committed tree count
+                commit_ckpt(margin)
 
         jax.block_until_ready(margin)
         t_loop = time.time() - t_loop0
@@ -637,6 +831,13 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                                vmargin if has_valid else None, keeper,
                                tree_offset=start_trees, prior=prior,
                                dist=dist)
+        if ckpt_on:
+            # the finished model supersedes the in-training DKV entry —
+            # leaving it would accumulate partial-model copies (with
+            # dataset-sized resume margins) across trains and surface
+            # phantom models on GET /3/Models; disk artifacts remain
+            from h2o3_tpu import dkv
+            dkv.remove(f"{model.key}_ckpt")
         t_fin = time.time() - t_fin0
         telemetry.record_span("train.finalize", t_fin0, t_fin)
         model.output["training_loop_seconds"] = t_loop
@@ -673,6 +874,16 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         if spec.offset is not None:
             raise NotImplementedError(
                 "offset_column is not supported in streaming mode")
+        if p.get("in_training_checkpoints_dir"):
+            # the streamed path writes no in-training checkpoints yet
+            # (ROADMAP gap) — warn instead of silently dropping the
+            # user's explicit resumability request (this path is also
+            # the OOM-degrade target, where raising would defeat the
+            # degrade)
+            from h2o3_tpu.log import warn as _warn
+            _warn("gbm: in_training_checkpoints_dir is not honored in "
+                  "streaming (memory-pressure) mode — no mid-train "
+                  "checkpoints will be written")
         if p.get("checkpoint"):
             raise NotImplementedError(
                 "checkpoint continuation is not supported in streaming "
@@ -717,6 +928,12 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         key = jax.random.PRNGKey(seed if seed != -1 else 0)
         chunks = StreamedChunks(X_host, y_host, w_host, f0, chunk_rows,
                                 padded_rows=int(spec.y.shape[0]))
+        # cancel propagation into the streamed pipeline: the level
+        # passes poll this BETWEEN levels (never mid leaf-apply), so a
+        # REST cancel / watchdog max_runtime kill lands promptly even
+        # inside a deep tree's chunk uploads
+        chunks.cancel_check = lambda: job.cancel_requested
+        from h2o3_tpu.jobs import JobCancelled
         trees = []
         t0 = time.time()
         for t in range(ntrees):
@@ -726,10 +943,17 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                 col_mask = (jax.random.uniform(
                     jax.random.fold_in(tkey, 1), (spec.n_features,))
                     < col_rate)
-            tree = grow_tree_adaptive_streamed(
-                chunks, dist, lr, cfg, root_lo, root_hi, nb_f, key=tkey,
-                sample_rate=float(p.get("sample_rate", 1.0)),
-                col_mask=col_mask)
+            try:
+                tree = grow_tree_adaptive_streamed(
+                    chunks, dist, lr, cfg, root_lo, root_hi, nb_f,
+                    key=tkey,
+                    sample_rate=float(p.get("sample_rate", 1.0)),
+                    col_mask=col_mask)
+            except JobCancelled:
+                # the partial tree applied no margin updates (cancel
+                # only fires between level passes, before leaf apply) —
+                # drop it and finalize the committed trees
+                break
             # lr-scale values like the dense finalize does (float64
             # product rounded once at model construction — bit-matching
             # `val * lrs[:, None]` in _finalize)
@@ -740,6 +964,9 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             job.set_progress((t + 1) / ntrees)
             if job.cancel_requested:
                 break
+        if not trees:
+            raise JobCancelled(
+                "cancelled before the first streamed tree completed")
         margin_host = chunks.gather_margin()
         t_loop = time.time() - t0
         T = len(trees)
@@ -805,11 +1032,7 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         ckpt = self.params.get("checkpoint")
         if not ckpt:
             return None
-        if isinstance(ckpt, GBMModel):
-            prior = ckpt
-        else:
-            from h2o3_tpu.persist import load_model
-            prior = load_model(ckpt)
+        prior = _resolve_checkpoint_source(ckpt, GBMModel, "GBM")
         if prior.dist_name != dist_name:
             raise ValueError(
                 f"checkpoint distribution '{prior.dist_name}' != "
@@ -848,6 +1071,52 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                 "checkpoint categorical domains differ from the training "
                 "frame's — prior trees' enum-code splits would misroute")
         return prior
+
+    def _prior_margin(self, prior, spec, padded, K):
+        """Training margin to resume from. An in-training checkpoint
+        carries the EXACT f32 margin at its committed tree count
+        (``resume_margin``) — resuming from it reproduces the
+        uninterrupted train bit-for-bit. A plain saved model recomputes
+        the margin from its trees (correct to f32 summation order, not
+        bit-guaranteed). Returns (margin, includes_offset)."""
+        rm = getattr(prior, "_resume_margin", None)
+        if rm is not None:
+            rm = np.asarray(rm)
+            want = (padded,) if K == 1 else (padded, K)
+            sig = getattr(prior, "_resume_sig", None)
+            sig_ok = (sig is None
+                      or np.array_equal(np.asarray(sig),
+                                        _spec_signature(spec)))
+            if rm.shape == tuple(want) and sig_ok:
+                # a checkpointed margin already includes any offset the
+                # train carried — the caller must not add it again
+                return jnp.asarray(rm, jnp.float32), True
+            from h2o3_tpu.log import warn
+            if not sig_ok:
+                # continue-on-new-data: the saved margin belongs to a
+                # DIFFERENT frame — applying it would silently train
+                # against stale state; recompute from trees instead
+                warn("checkpoint resume margin belongs to different "
+                     "training data — recomputing from trees")
+            else:
+                warn("checkpoint resume margin shape %s != expected %s "
+                     "— recomputing from trees", rm.shape, want)
+        # recomputed from trees WITHOUT the offset — the caller must
+        # still add spec.offset (f = f0 + offset + Σ lr·tree)
+        return prior._margin_matrix(spec.X).astype(jnp.float32), False
+
+    def _write_in_training_checkpoint(self, model, margin, ckpt_dir,
+                                      spec=None):
+        """Persist an in-training checkpoint: the partial model + its
+        exact f32 training margin (the resume state that makes a
+        resumed train bit-identical) + a cheap data fingerprint so the
+        margin is never applied to a DIFFERENT training frame."""
+        from h2o3_tpu.models.model_base import persist_in_training_ckpt
+        model._resume_margin = np.asarray(jax.device_get(margin),
+                                          np.float32)
+        if spec is not None:
+            model._resume_sig = _spec_signature(spec)
+        return persist_in_training_ckpt(model, self.algo, ckpt_dir)
 
     def _score_entry_dev(self, margin, sc_spec, dist, K, built,
                          want_auc: bool = False):
@@ -892,7 +1161,7 @@ class H2OGradientBoostingEstimator(ModelBuilder):
 
     def _finalize(self, spec, valid_spec, dist_name, f0, all_trees, bm, cfg,
                   K, built, margin, vmargin, keeper, tree_offset=0,
-                  prior=None, dist=None) -> GBMModel:
+                  prior=None, dist=None, with_metrics=True) -> GBMModel:
         M = cfg.n_nodes
         # ONE pytree device_get for every chunk's trees, deferred to here
         # — nothing tree-shaped crosses to the host inside the boosting
@@ -954,12 +1223,15 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             "percentage": (vi[order] / vi.sum() if vi.sum() > 0 else vi[order]).tolist(),
         }
         model.scoring_history = keeper.history
-        # final metrics from the training margin (exact, no re-predict)
-        model.training_metrics = self._metrics_from_margin(
-            margin, spec, dist_name, K, dist=dist)
-        if vmargin is not None:
-            model.validation_metrics = self._metrics_from_margin(
-                vmargin, valid_spec, dist_name, K, dist=dist)
+        if with_metrics:
+            # final metrics from the training margin (exact, no
+            # re-predict); in-training checkpoints skip this — they are
+            # resume state, not reporting artifacts
+            model.training_metrics = self._metrics_from_margin(
+                margin, spec, dist_name, K, dist=dist)
+            if vmargin is not None:
+                model.validation_metrics = self._metrics_from_margin(
+                    vmargin, valid_spec, dist_name, K, dist=dist)
         return model
 
     def _metrics_from_margin(self, margin, spec, dist_name, K, dist=None):

@@ -542,6 +542,45 @@ class Model:
         return f"<{type(self).__name__} {self.key} {self.params.get('model_id', '')}>"
 
 
+def persist_in_training_ckpt(model, algo: str, ckpt_dir,
+                             final: bool = False) -> Optional[str]:
+    """Persist an in-training checkpoint model to the DKV
+    (``<key>_ckpt``) and to ``in_training_checkpoints_dir`` (one
+    artifact per committed tree count — hex/tree/SharedTree's
+    in_training_checkpoints_* contract). The caller attaches the
+    algo-specific resume state (GBM: the f32 training margin; DRF: the
+    OOB accumulators) before calling. ``final=True`` (a train that
+    COMPLETED) keeps the durable disk artifact but drops the DKV entry
+    — the finished model supersedes it, and leaving partial-model
+    copies (with dataset-sized resume margins) to accumulate in the
+    store would both leak memory and surface phantom models on
+    GET /3/Models. Failures are logged, never fatal: a checkpoint
+    write must not kill the train it protects."""
+    import os as _os
+
+    from h2o3_tpu import dkv, telemetry
+    from h2o3_tpu.persist import save_model
+    try:
+        if final:
+            dkv.remove(f"{model.key}_ckpt")
+        else:
+            dkv.put(f"{model.key}_ckpt", "model", model)
+        path = None
+        if ckpt_dir:
+            _os.makedirs(ckpt_dir, exist_ok=True)
+            path = save_model(
+                model, ckpt_dir, force=True,
+                filename=f"{model.key}_t{model.ntrees_built}.zip")
+        telemetry.counter(
+            "h2o3_ckpt_written_total", {"algo": algo},
+            help="in-training checkpoints written").inc()
+        return path
+    except Exception as e:   # noqa: BLE001 — advisory only
+        from h2o3_tpu.log import warn
+        warn("%s: in-training checkpoint write failed: %s", algo, e)
+        return None
+
+
 def pack_impute_means(means) -> Dict[str, np.ndarray]:
     """npz-safe encoding of the {column: imputation mean} dict shared by
     the expanded-design models (GLM/DL/KMeans/PCA)."""
@@ -859,7 +898,12 @@ class ModelBuilder:
                     validation_frame, spec,
                     weights_column=self.params.get("weights_column"),
                     offset_column=self.params.get("offset_column"))
-        job = Job(f"{self.algo} training", work=1.0)
+        # max_runtime_secs rides on the job so the supervision watchdog
+        # (jobs.py) enforces it by cancellation — the chunk loops poll
+        # cancel_requested and exit cooperatively
+        job = Job(f"{self.algo} training", work=1.0,
+                  max_runtime_secs=float(
+                      self.params.get("max_runtime_secs", 0) or 0))
         info("%s train start: %d rows, %d features", self.algo, spec.nrow,
              spec.n_features)
 

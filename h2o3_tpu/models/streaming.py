@@ -151,6 +151,11 @@ class StreamedChunks:
         self._wt_dev = None            # full-rows device draw (resident slices)
         self.h2d_bytes = 0
         self.h2d_resident_bytes = 0    # the once-per-train window upload
+        # cooperative cancellation (jobs.py watchdog / REST cancel): the
+        # training driver points this at job.cancel_requested so a
+        # cancel lands BETWEEN level passes — never inside the leaf-apply
+        # pass, where a partial update would corrupt chunk margins
+        self.cancel_check: Optional[callable] = None
 
     # -- residency -------------------------------------------------------
 
@@ -159,8 +164,11 @@ class StreamedChunks:
 
     def _put(self, arr: np.ndarray, resident: bool = False):
         from h2o3_tpu import memman
+        from h2o3_tpu.resilience import resilient_device_put
         memman.manager().request(arr.nbytes)
-        dev = jax.device_put(arr)
+        # transient chunk-upload failures retry with backoff — a flaky
+        # DMA must not kill a train that has resident state to protect
+        dev = resilient_device_put(arr, pipeline="train")
         _record_h2d(arr.nbytes)
         self.h2d_bytes += arr.nbytes
         if resident:
@@ -228,6 +236,12 @@ class StreamedChunks:
         depth-0 stump's (g,h,w)-only passes) skips the X staging
         entirely — those passes never read features."""
         from h2o3_tpu import memman
+        if self.cancel_check is not None and self.cancel_check():
+            # raised at pass START only: an in-progress pass (including
+            # the leaf-apply pass) always completes, keeping margins
+            # consistent across chunks
+            from h2o3_tpu.jobs import JobCancelled
+            raise JobCancelled("training cancelled between tree levels")
         pending: Dict[int, object] = {}
 
         def stage(k: int) -> None:

@@ -167,10 +167,24 @@ def save_model(model, path: str = ".", force: bool = False,
 
 
 def load_model(path: str):
-    """Read a model artifact back into a live Model (h2o.load_model)."""
-    with zipfile.ZipFile(path, "r") as zf:
-        meta = json.loads(zf.read("meta.json"))
-        arrays = dict(np.load(io.BytesIO(zf.read("arrays.npz"))))
+    """Read a model artifact back into a live Model (h2o.load_model).
+
+    Reads route through the shared retry/backoff helper (jitter, bounded
+    attempts) so flaky storage — NFS hiccups, the remote-URI cache mid-
+    refresh — retries instead of failing the caller (PersistManager's
+    reads are similarly retried by the HDFS/S3 client stacks)."""
+    from h2o3_tpu import faults
+    from h2o3_tpu.resilience import is_transient_io, retry_transient
+
+    def _read():
+        if faults.ACTIVE:
+            faults.check("persist", key=path)
+        with zipfile.ZipFile(path, "r") as zf:
+            return (json.loads(zf.read("meta.json")),
+                    dict(np.load(io.BytesIO(zf.read("arrays.npz")))))
+
+    meta, arrays = retry_transient(_read, site="persist.load_model",
+                                   classify=is_transient_io)
     if meta.get("format_version", 0) > FORMAT_VERSION:
         raise ValueError(f"artifact format {meta['format_version']} is newer "
                          f"than this build ({FORMAT_VERSION})")

@@ -1,0 +1,152 @@
+"""Shared retry/backoff + failure classification for the resilience layer.
+
+Reference: H2O-3 leans on its cloud runtime (L1/L2 heartbeats, job
+supervision, water/Job retries at the task layer) for transient-failure
+tolerance. Under single-controller JAX the equivalents are concentrated
+at a handful of seams — host↔device transfers, XLA compile/execute,
+persist reads, the serve batcher's device stage — and this module is the
+one policy those seams share:
+
+- ``is_transient``  — retryable device/transfer/storage hiccups
+  (UNAVAILABLE / INTERNAL / DATA_LOSS / ABORTED status codes, socket
+  resets, flaky-storage IO errors).
+- ``is_oom``        — RESOURCE_EXHAUSTED / device OOM: NOT retryable
+  (repeating the same allocation fails the same way); the training
+  driver degrades dense→streamed instead.
+- ``retry_transient`` — bounded exponential backoff with jitter around
+  a callable, emitting ``h2o3_retry_total{site=...}`` per retry and a
+  ``h2o3_recovery_ms`` histogram per recovered incident so recovery
+  latency is a first-class telemetry series (the chaos bench reads it).
+
+Classification is marker-based over the exception message PLUS
+isinstance checks against the injected-fault taxonomy (faults.py), so
+injected and organic failures take the same path.
+"""
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional, TypeVar
+
+from h2o3_tpu import faults
+
+T = TypeVar("T")
+
+# grpc/XLA status-code spellings surfaced by jaxlib's XlaRuntimeError,
+# plus common socket/storage phrasings from urllib/pyarrow
+_TRANSIENT_MARKERS = (
+    "UNAVAILABLE", "INTERNAL", "DATA_LOSS", "ABORTED", "CANCELLED",
+    "DEADLINE_EXCEEDED", "connection reset", "connection refused",
+    "broken pipe", "temporarily unavailable", "timed out", "EAGAIN",
+)
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "out of memory", "OOM",
+                "Resource exhausted")
+
+
+def is_oom(exc: BaseException) -> bool:
+    """Device allocation failure — degrade, don't retry."""
+    if isinstance(exc, faults.ResourceExhausted):
+        return True
+    if isinstance(exc, MemoryError):
+        return True
+    msg = str(exc)
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Retryable transient failure. OOM and injected Fatal are
+    explicitly NOT transient."""
+    if is_oom(exc) or isinstance(exc, faults.Fatal):
+        return False
+    if isinstance(exc, (faults.Unavailable, faults.Internal,
+                        faults.DataLoss, faults.InjectedIOError)):
+        return True
+    msg = str(exc)
+    return any(m in msg for m in _TRANSIENT_MARKERS)
+
+
+def is_transient_io(exc: BaseException) -> bool:
+    """Storage flavor: OSError/IOError counts as retryable (flaky remote
+    reads), on top of the generic transient markers — EXCEPT the
+    deterministic ones (missing file, permissions), which fail the same
+    way every attempt."""
+    if isinstance(exc, faults.Fatal):
+        return False
+    if isinstance(exc, (FileNotFoundError, IsADirectoryError,
+                        NotADirectoryError, PermissionError)):
+        return False
+    code = getattr(exc, "code", None)       # urllib HTTPError: 4xx is
+    if isinstance(code, int) and 400 <= code < 500:   # deterministic
+        return False
+    if isinstance(exc, (OSError, IOError)):
+        return True
+    return is_transient(exc)
+
+
+def resilient_device_put(arr, sharding=None, *, site: str = "h2d",
+                         pipeline: Optional[str] = None):
+    """``jax.device_put`` behind the ``h2d`` fault seam with the shared
+    transient retry — the one policy every H2D call site
+    (frame/vec.py grouped puts, the ingest chunk streamer, the
+    streamed-GBM uploads) goes through, so backoff/fault semantics
+    change in exactly one place."""
+    import jax
+
+    def _put():
+        if faults.ACTIVE:
+            faults.check("h2d", pipeline=pipeline)
+        if sharding is not None:
+            return jax.device_put(arr, sharding)
+        return jax.device_put(arr)
+
+    return retry_transient(
+        _put, site=site if pipeline is None else f"{pipeline}.h2d")
+
+
+def retry_transient(fn: Callable[[], T], *, site: str,
+                    attempts: int = 3, base_delay_s: float = 0.05,
+                    max_delay_s: float = 2.0,
+                    classify: Callable[[BaseException], bool] = is_transient,
+                    sleep: Callable[[float], None] = time.sleep) -> T:
+    """Call ``fn`` with bounded exponential-backoff retries on transient
+    failures. Non-transient exceptions (OOM, Fatal, client errors)
+    propagate immediately. On recovery the incident's total duration
+    lands in ``h2o3_recovery_ms{site=...}``."""
+    if attempts <= 1:
+        return fn()
+    t_first_failure: Optional[float] = None
+    last: Optional[BaseException] = None
+    for attempt in range(attempts):
+        try:
+            out = fn()
+        except BaseException as e:  # noqa: BLE001 — classified below
+            if not classify(e) or attempt == attempts - 1:
+                raise
+            last = e
+            if t_first_failure is None:
+                t_first_failure = time.perf_counter()
+            from h2o3_tpu import telemetry
+            from h2o3_tpu.log import warn
+            telemetry.counter(
+                "h2o3_retry_total", {"site": site},
+                help="transient-failure retries by call site").inc()
+            # full-jitter exponential backoff (AWS architecture blog
+            # shape): uniform in (0, base · 2^attempt], capped
+            delay = min(base_delay_s * (2 ** attempt), max_delay_s)
+            delay *= random.random() or 0.5
+            warn("%s: transient failure (%s) — retry %d/%d in %.0fms",
+                 site, type(e).__name__, attempt + 1, attempts - 1,
+                 delay * 1e3)
+            sleep(delay)
+            continue
+        if t_first_failure is not None:
+            from h2o3_tpu import telemetry
+            telemetry.histogram(
+                "h2o3_recovery_ms", {"site": site},
+                help="ms from first transient failure to recovery",
+                bounds=(1.0, 5.0, 10.0, 50.0, 100.0, 500.0, 1000.0,
+                        5000.0, 30_000.0)).observe(
+                (time.perf_counter() - t_first_failure) * 1e3)
+        return out
+    raise last  # pragma: no cover — loop always returns or raises

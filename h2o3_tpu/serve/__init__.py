@@ -7,9 +7,12 @@ into padded device batches with admission control and per-request
 deadlines. REST surface: POST /3/Predictions/models/{m}/rows,
 /3/Serve/models, /3/Serve/stats (api/server.py).
 """
-from h2o3_tpu.serve.batcher import (ServeBadRequestError, ServeClosedError,
+from h2o3_tpu.serve.batcher import (ServeBadRequestError,
+                                    ServeCircuitOpenError,
+                                    ServeClosedError,
                                     ServeDeadlineError, ServeError,
                                     ServeOverloadedError)
+from h2o3_tpu.serve.circuit import CircuitBreaker
 from h2o3_tpu.serve.codec import RowCodec
 from h2o3_tpu.serve.registry import DEFAULT_BUCKETS, CompiledScorer
 from h2o3_tpu.serve.service import (Deployment, deploy, deployment,
@@ -19,8 +22,10 @@ from h2o3_tpu.serve.service import (Deployment, deploy, deployment,
 from h2o3_tpu.serve.stats import ServeStats
 
 __all__ = [
-    "CompiledScorer", "DEFAULT_BUCKETS", "Deployment", "RowCodec",
-    "ServeBadRequestError", "ServeClosedError", "ServeDeadlineError",
+    "CircuitBreaker", "CompiledScorer", "DEFAULT_BUCKETS", "Deployment",
+    "RowCodec",
+    "ServeBadRequestError", "ServeCircuitOpenError", "ServeClosedError",
+    "ServeDeadlineError",
     "ServeError", "ServeOverloadedError", "ServeStats", "deploy",
     "deployment", "deployments", "predict_columnar", "predict_rows",
     "shutdown_all", "stats",

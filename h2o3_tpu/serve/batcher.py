@@ -49,6 +49,18 @@ class ServeDeadlineError(ServeError):
     http_status = 503
 
 
+class ServeCircuitOpenError(ServeError):
+    """The deployment's circuit breaker is open: its device stage is
+    failing consecutively, so requests fail FAST instead of queueing
+    into certain timeouts. ``retry_after_s`` feeds the HTTP
+    ``Retry-After`` header."""
+    http_status = 503
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
 class ServeClosedError(ServeError):
     http_status = 410
 
@@ -78,8 +90,9 @@ class MicroBatcher:
                  max_batch: int = 512, max_delay_ms: float = 2.0,
                  queue_limit: int = 8192,
                  default_timeout_ms: float = 10_000.0,
-                 pipeline_depth: int = 2):
+                 pipeline_depth: int = 2, breaker=None):
         import queue as _q
+        self.breaker = breaker         # serve/circuit.py CircuitBreaker
         self._encode = encode          # (rows, pad_to) -> np [pad, F]
         self._dispatch = dispatch      # (X, n_active) -> device array
         self._decode = decode          # (host scores, n) -> DecodedBatch
@@ -119,6 +132,17 @@ class MicroBatcher:
             raise ValueError(
                 f"submit() takes at most max_batch={self.max_batch} rows "
                 f"per request (got {len(rows)}); split the request")
+        if self.breaker is not None:
+            # fail FAST while the circuit is open: the device stage is
+            # known-broken, queueing would only convert this request
+            # into a slow timeout and delay coalesced innocents
+            retry_after = self.breaker.allow_request()
+            if retry_after is not None:
+                self.stats.record_rejected()
+                raise ServeCircuitOpenError(
+                    f"circuit open for '{self.stats.model}' (device "
+                    f"stage failing) — retry in {retry_after:.2f}s",
+                    retry_after_s=retry_after)
         timeout_s = (float(timeout_ms) / 1000.0 if timeout_ms is not None
                      else self.default_timeout_s)
         deadline = time.perf_counter() + timeout_s
@@ -269,13 +293,15 @@ class MicroBatcher:
                 continue
             t1 = time.perf_counter()
             try:
-                out = self._dispatch(X, n)      # async device dispatch
+                out = self._dispatch_resilient(X, n, batch)
                 t2 = time.perf_counter()
             except BaseException as e:  # noqa: BLE001 — resolve waiters
                 for r in batch:
                     r.error = e
                     r.event.set()
                 self.stats.record_error()
+                if self.breaker is not None:
+                    self.breaker.record_failure()
                 if sp_batch is not None:
                     sp_batch.attrs["error"] = True
                     sp_batch.finish()
@@ -287,22 +313,89 @@ class MicroBatcher:
             telemetry.record_span("serve.encode", t0_wall, t1 - t0,
                                   parent=sp_batch)
             self._inflight.put(
-                (out, batch, n, X.shape[0],
+                (out, batch, n, X,
                  {"queue": queue_ms, "encode": (t1 - t0) * 1e3,
                   "dispatch": (t2 - t1) * 1e3},
                  (sp_batch, time.time() - (t2 - t1))))
 
+    def _deadline_allows_retry(self, batch: List[_Request]) -> bool:
+        """A retry only makes sense if every coalesced request can
+        still meet its deadline afterwards (a conservative one-tick
+        margin)."""
+        margin = self.max_delay_s + 0.001
+        return time.perf_counter() + margin < min(r.deadline
+                                                  for r in batch)
+
+    def _dispatch_resilient(self, X, n: int, batch: List[_Request]):
+        """Device dispatch behind the fault seam with ONE transient
+        retry — a single hiccup (preempted device, transient transfer
+        error) recovers in-place; a persistent failure propagates to
+        the breaker. The retry respects the coalesced requests'
+        deadlines: if any would expire, fail now instead of burning
+        its remaining budget."""
+        from h2o3_tpu import faults
+        from h2o3_tpu.resilience import is_transient
+
+        def _once():
+            if faults.ACTIVE:
+                faults.check("execute", pipeline="serve",
+                             key=self.stats.model)
+            return self._dispatch(X, n)
+
+        try:
+            return _once()
+        except BaseException as e:  # noqa: BLE001 — classified below
+            if not is_transient(e) or not self._deadline_allows_retry(
+                    batch):
+                raise
+            self.stats.record_retry()
+            return _once()
+
     # -- collector thread -----------------------------------------------
 
     def _collect_loop(self):
+        from h2o3_tpu.resilience import is_transient
         while True:
             item = self._inflight.get()
             if item is None:
                 return
-            out, batch, n, padded, tms, (sp_batch, disp_wall) = item
+            out, batch, n, X, tms, (sp_batch, disp_wall) = item
+            padded = X.shape[0]
             t0 = time.perf_counter()
+            # DEVICE stage (the breaker's jurisdiction): fetch, with
+            # the same single-transient-retry policy as dispatch (the
+            # batch is re-dispatched from its still-live encoded
+            # matrix). Only failures HERE count against device health.
             try:
-                host = np.asarray(out)          # blocks until ready
+                try:
+                    host = np.asarray(out)      # blocks until ready
+                except BaseException as e:  # noqa: BLE001
+                    if not is_transient(e) \
+                            or not self._deadline_allows_retry(batch):
+                        raise
+                    self.stats.record_retry()
+                    host = np.asarray(self._dispatch_resilient(
+                        X, n, batch))
+            except BaseException as e:  # noqa: BLE001
+                for r in batch:
+                    r.error = e
+                    r.event.set()
+                self.stats.record_error()
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+                if sp_batch is not None:
+                    sp_batch.attrs["error"] = True
+                    sp_batch.finish()
+                continue
+            if self.breaker is not None:
+                # the device answered: close a half-open circuit /
+                # reset the counter BEFORE decode — a host-side codec
+                # bug below must not read as device sickness (the
+                # breaker contract: client/host failures never count)
+                self.breaker.record_success()
+            # HOST decode stage: failures resolve the requests with the
+            # error but leave the circuit alone
+            try:
                 t1 = time.perf_counter()
                 decoded = self._decode(host, n)
                 # per-request views over the batch-wide vectorized

@@ -16,8 +16,10 @@ import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from h2o3_tpu.serve.batcher import (MicroBatcher, ServeBadRequestError,
+                                    ServeCircuitOpenError,
                                     ServeClosedError, ServeDeadlineError,
                                     ServeError, ServeOverloadedError)
+from h2o3_tpu.serve.circuit import CircuitBreaker
 from h2o3_tpu.serve.codec import RowCodec
 from h2o3_tpu.serve.registry import DEFAULT_BUCKETS, CompiledScorer
 from h2o3_tpu.serve.stats import ServeStats, merge_snapshots
@@ -26,7 +28,8 @@ __all__ = ["deploy", "undeploy", "deployment", "deployments",
            "predict_rows", "predict_columnar", "stats", "shutdown_all",
            "Deployment",
            "ServeError", "ServeOverloadedError", "ServeDeadlineError",
-           "ServeBadRequestError", "ServeClosedError"]
+           "ServeBadRequestError", "ServeClosedError",
+           "ServeCircuitOpenError"]
 
 _DEPLOYMENTS: Dict[str, "Deployment"] = {}
 _LOCK = threading.Lock()
@@ -37,7 +40,9 @@ class Deployment:
                  max_delay_ms: float = 2.0, queue_limit: int = 8192,
                  timeout_ms: float = 10_000.0,
                  buckets: Sequence[int] = DEFAULT_BUCKETS,
-                 warm: bool = True, pinned: bool = False):
+                 warm: bool = True, pinned: bool = False,
+                 circuit_failures: int = 5,
+                 circuit_open_ms: float = 1000.0):
         if not hasattr(model, "_predict_matrix"):
             raise ValueError(
                 f"model '{key}' has no batch predict path "
@@ -64,7 +69,9 @@ class Deployment:
                            max_delay_ms=float(max_delay_ms),
                            queue_limit=int(queue_limit),
                            timeout_ms=float(timeout_ms),
-                           buckets=list(buckets))
+                           buckets=list(buckets),
+                           circuit_failures=int(circuit_failures),
+                           circuit_open_ms=float(circuit_open_ms))
         self.codec = RowCodec(model)
         t0 = time.perf_counter()
         self.scorer = CompiledScorer(model, buckets=buckets, warm=warm)
@@ -86,12 +93,17 @@ class Deployment:
                     f"{self.scorer.out_k}-wide output — this algo's "
                     f"predict() override is not row-servable")
         self.stats = ServeStats(model=key)
+        # per-deployment circuit breaker: N consecutive device-stage
+        # failures → open (fast 503 + Retry-After) → half-open probe
+        self.breaker = CircuitBreaker(
+            model=key, failure_threshold=circuit_failures,
+            open_secs=float(circuit_open_ms) / 1000.0, stats=self.stats)
         self.batcher = MicroBatcher(
             encode=self.codec.encode, dispatch=self.scorer.score,
             decode=self.codec.decode_batch, stats=self.stats,
             bucket_for=self.scorer.bucket_for, max_batch=max_batch,
             max_delay_ms=max_delay_ms, queue_limit=queue_limit,
-            default_timeout_ms=timeout_ms)
+            default_timeout_ms=timeout_ms, breaker=self.breaker)
 
     def predict_rows(self, rows: Sequence[Dict[str, Any]],
                      timeout_ms: Optional[float] = None
@@ -234,7 +246,8 @@ def stats() -> Dict[str, Any]:
     per_model = {}
     for dep in deployments():
         per_model[dep.key] = {**dep.stats.snapshot(),
-                              "pending_rows": dep.batcher.pending_rows}
+                              "pending_rows": dep.batcher.pending_rows,
+                              "circuit": dep.breaker.snapshot()}
     return {"models": per_model,
             "total": merge_snapshots(list(per_model.values()))}
 

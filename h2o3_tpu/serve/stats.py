@@ -88,6 +88,10 @@ class ServeStats:
         self._rejected = reg.counter(
             "h2o3_serve_rejected_total", lab,
             help="admission-control rejections (503)")
+        self._retries = reg.counter(
+            "h2o3_serve_retries_total", lab,
+            help="single-retry recoveries of transient device-stage "
+                 "failures")
         self._queue_depth = reg.gauge(
             "h2o3_serve_queue_depth", lab,
             help="rows admitted but not yet resolved")
@@ -113,7 +117,7 @@ class ServeStats:
         self._base = {c: c.value for c in
                       (self._requests, self._rows, self._batches,
                        self._batch_rows, self._padded_rows, self._errors,
-                       self._timeouts, self._rejected,
+                       self._timeouts, self._rejected, self._retries,
                        *self._stage_ms.values())}
 
     def _delta(self, c) -> float:
@@ -156,6 +160,9 @@ class ServeStats:
     def record_rejected(self):
         self._rejected.inc()
 
+    def record_retry(self):
+        self._retries.inc()
+
     def queue_delta(self, rows: int):
         with self._mu:
             self._qd += rows
@@ -195,6 +202,10 @@ class ServeStats:
     @property
     def rejected(self) -> int:
         return int(self._delta(self._rejected))
+
+    @property
+    def retries(self) -> int:
+        return int(self._delta(self._retries))
 
     @property
     def queue_depth(self) -> int:
@@ -240,6 +251,7 @@ class ServeStats:
             "errors": self.errors,
             "timeouts": self.timeouts,
             "rejected": self.rejected,
+            "retries": self.retries,
             "queue_depth": self.queue_depth,
             "mean_batch_occupancy": round(occ, 3),
             "bucket_fill": round(pad_eff, 4),
@@ -255,11 +267,11 @@ def merge_snapshots(snaps: List[Dict]) -> Dict:
     percentile fields do NOT aggregate across models (quantiles don't
     add) and are left to the per-model entries."""
     out = {"requests": 0, "rows": 0, "batches": 0, "errors": 0,
-           "timeouts": 0, "rejected": 0, "queue_depth": 0,
+           "timeouts": 0, "rejected": 0, "retries": 0, "queue_depth": 0,
            "stage_ms": {s: 0.0 for s in STAGES}}
     for s in snaps:
         for k in ("requests", "rows", "batches", "errors", "timeouts",
-                  "rejected", "queue_depth"):
+                  "rejected", "retries", "queue_depth"):
             out[k] += s.get(k, 0)
         for st, v in (s.get("stage_ms") or {}).items():
             out["stage_ms"][st] = out["stage_ms"].get(st, 0.0) + v

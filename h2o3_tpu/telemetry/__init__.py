@@ -23,14 +23,16 @@ from h2o3_tpu.telemetry.registry import (Counter, Gauge, Histogram,
                                          Registry, enabled, registry,
                                          set_enabled)
 from h2o3_tpu.telemetry.spans import (Span, clear_spans, current_span,
-                                      finished_spans, open_span,
+                                      finished_spans, last_error_span,
+                                      open_span,
                                       record_span, span, stage_seconds)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "Span",
     "chrome_trace", "chrome_trace_bytes", "clear_spans", "current_span",
     "device_get", "device_memory_bytes", "enabled", "finished_spans", "install",
-    "installed", "open_span", "prometheus_text", "record_d2h",
+    "installed", "last_error_span", "open_span", "prometheus_text",
+    "record_d2h",
     "record_h2d", "record_span", "registry", "sample_device_memory",
     "set_enabled", "span", "stage_seconds", "telemetry_snapshot",
 ]

@@ -104,6 +104,28 @@ def current_span() -> Optional[Span]:
     return st[-1] if st else None
 
 
+def _note_error_span(name: str, exc: BaseException) -> None:
+    """Remember the INNERMOST span a given exception unwound through:
+    the innermost context exits first, so only the first note per
+    exception identity sticks — outer spans exiting with the same
+    exception don't overwrite it. Job supervision reads this to report
+    the failed pipeline stage on /3/Jobs."""
+    cur = getattr(_TLS, "last_error", None)
+    if cur is None or cur[0] != id(exc):
+        _TLS.last_error = (id(exc), name)
+
+
+def last_error_span(exc: Optional[BaseException] = None) -> Optional[str]:
+    """Name of the innermost span the given (or most recent) exception
+    failed inside on THIS thread; None if no span saw it."""
+    cur = getattr(_TLS, "last_error", None)
+    if cur is None:
+        return None
+    if exc is not None and cur[0] != id(exc):
+        return None
+    return cur[1]
+
+
 # timeline throttle: the Flow ring is 2048 entries — at serve rates
 # (hundreds of serve.request/serve.batch roots per second) unthrottled
 # feeding would wrap it in seconds, evicting the train/ingest events the
@@ -156,10 +178,13 @@ class _SpanContext:
         self._span = sp
         return sp
 
-    def __exit__(self, *exc):
+    def __exit__(self, exc_type=None, exc_value=None, tb=None):
         sp = self._span
         if sp is None:
             return False
+        if exc_value is not None:
+            sp.attrs["error"] = True
+            _note_error_span(sp.name, exc_value)
         st = _stack()
         # pop by identity — an exception may have skipped inner pops
         while st:

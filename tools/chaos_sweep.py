@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Chaos sweep — run train + serve under injected faults and report
+recovery metrics.
+
+The robustness analog of the transfer-budget guard: instead of
+eyeballing "retries work", a chaos round drives the real pipelines
+through the deterministic fault layer (h2o3_tpu/faults.py) and emits::
+
+    resilience.recovered_total    retries that ended in success
+    resilience.recovery_p50_ms    median first-failure → recovery time
+    resilience.degraded_trains    dense→streamed OOM degradations
+    resilience.circuit_opens      serve circuit-open transitions
+    resilience.faults_injected    total faults the layer raised
+    resilience.ckpt_resume_ok     mid-train kill → checkpoint resume
+                                  produced the bit-identical model
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/chaos_sweep.py           # standalone
+    # bench.py runs the same round via run_chaos_round() unless
+    # H2O3_BENCH_CHAOS=0
+
+The sweep sizes itself small (seconds, not minutes): it guards the
+RECOVERY machinery, not throughput — BENCH_*.json keeps the speed
+story.
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _counter(reg, name, labels=None):
+    return reg.value(name, labels)
+
+
+def _recovery_p50_ms(reg):
+    """Median recovery latency across every site's h2o3_recovery_ms
+    histogram (bucket-interpolated — good enough for a guard)."""
+    samples = []
+    for s in reg.samples():
+        if s["name"] != "h2o3_recovery_ms" or s.get("kind") != "histogram":
+            continue
+        prev_le, prev_cum = 0.0, 0
+        for le, cum in s["buckets"]:
+            fresh = cum - prev_cum
+            if fresh > 0:
+                mid = prev_le + (min(le, prev_le * 2 + 10) - prev_le) / 2 \
+                    if le != float("inf") else prev_le
+                samples.extend([mid] * fresh)
+            prev_le, prev_cum = le, cum
+    return round(float(np.median(samples)), 2) if samples else None
+
+
+def run_chaos_round(rows: int = 2000, log=print) -> dict:
+    """Run the sweep with a hard guarantee that fault injection is
+    DISARMED on every exit path — bench.py swallows chaos-round
+    exceptions, and a leaked spec would corrupt everything the process
+    runs afterwards while looking organic."""
+    from h2o3_tpu import faults
+    try:
+        return _chaos_round(rows, log)
+    finally:
+        faults.configure(None)
+
+
+def _chaos_round(rows: int, log) -> dict:
+    import jax
+
+    import h2o3_tpu as h2o
+    from h2o3_tpu import dkv, faults, serve, telemetry
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator as GBM
+
+    reg = telemetry.registry()
+
+    def retries_total():
+        return sum(s["value"] for s in reg.samples()
+                   if s["name"] == "h2o3_retry_total")
+
+    def injected_total():
+        return sum(s["value"] for s in reg.samples()
+                   if s["name"] == "h2o3_fault_injected_total")
+
+    def circuit_opens():
+        return sum(s["value"] for s in reg.samples()
+                   if s["name"] == "h2o3_circuit_open_total")
+
+    r0, i0, c0 = retries_total(), injected_total(), circuit_opens()
+    d0 = _counter(reg, "h2o3_degrade_total", {"algo": "gbm"})
+
+    rng = np.random.default_rng(42)
+    cols = {f"f{i}": rng.normal(size=rows) for i in range(6)}
+    cols["y"] = (cols["f0"] * 2 - cols["f1"]
+                 + rng.normal(size=rows) * 0.1)
+    fr = h2o.Frame.from_numpy(cols)
+    kw = dict(ntrees=10, max_depth=3, seed=13, learn_rate=0.2)
+
+    # reference run (fault-free) for the bit-parity verdicts
+    ref = GBM(**kw)
+    ref.train(y="y", training_frame=fr)
+
+    def trees_equal(a, b):
+        for k in ("_feat", "_thr", "_value"):
+            ea = np.asarray(jax.device_get(getattr(a, k)))
+            eb = np.asarray(jax.device_get(getattr(b, k)))
+            if ea.shape != eb.shape or not (ea == eb).all():
+                return False
+        return True
+
+    # 1) transient h2d + execute faults: an ingest under h2d faults
+    #    parses correctly, a train under execute faults completes via
+    #    retries, bit-identical to the reference
+    faults.configure("h2d:every=2:times=2:exc=Unavailable,"
+                     "execute@train:every=1:times=2:exc=Internal")
+    fr2 = h2o.Frame.from_numpy(
+        {"a": rng.normal(size=256), "b": rng.normal(size=256)})
+    ingest_ok = bool(np.isfinite(fr2.vec("a").to_numpy()).all())
+    t_train = GBM(**kw)
+    t_train.train(y="y", training_frame=fr)
+    transient_ok = ingest_ok and trees_equal(ref.model, t_train.model)
+    faults.configure(None)
+
+    # 2) mid-train kill → checkpoint resume, bit-identical
+    ckdir = tempfile.mkdtemp(prefix="chaos_ckpt_")
+    faults.configure("execute@train:every=1:after=1:times=1:exc=Fatal")
+    killed = GBM(in_training_checkpoints_dir=ckdir,
+                 in_training_checkpoints_tree_interval=3, **kw)
+    resume_ok = False
+    try:
+        killed.train(y="y", training_frame=fr)
+    except RuntimeError:
+        pass
+    faults.configure(None)
+    ckpts = sorted(os.listdir(ckdir))
+    if ckpts:
+        resumed = GBM(checkpoint=os.path.join(ckdir, ckpts[-1]), **kw)
+        resumed.train(y="y", training_frame=fr)
+        resume_ok = trees_equal(ref.model, resumed.model)
+
+    # 3) synthetic OOM → dense degrades to the streamed path
+    faults.configure("execute@train:every=1:times=1:exc=ResourceExhausted")
+    degraded = GBM(**kw)
+    degraded.train(y="y", training_frame=fr)
+    faults.configure(None)
+    degraded_ok = bool(degraded.model.output.get("streamed"))
+
+    # 4) serve: persistently failing deployment trips the breaker and
+    #    recovers once the fault clears
+    dkv.put("chaos_model", "model", ref.model)
+    dep = serve.deploy("chaos_model", circuit_failures=2,
+                       circuit_open_ms=150, max_delay_ms=1.0)
+    row = {f"f{i}": 0.1 * i for i in range(6)}
+    faults.configure("execute@serve:key=chaos_model:every=1:exc=Internal")
+    circuit_opened = False
+    for _ in range(6):
+        try:
+            dep.predict_rows([row], timeout_ms=500)
+        except serve.ServeCircuitOpenError:
+            circuit_opened = True
+            break
+        except Exception:   # noqa: BLE001 — injected device errors
+            pass
+    faults.configure(None)
+    time.sleep(0.2)
+    served_after = None
+    try:
+        served_after = dep.predict_rows([row])[0]
+    except Exception:   # noqa: BLE001
+        pass
+    serve.undeploy("chaos_model")
+    dkv.remove("chaos_model")
+
+    out = {
+        "recovered_total": round(retries_total() - r0),
+        "recovery_p50_ms": _recovery_p50_ms(reg),
+        "degraded_trains": round(
+            _counter(reg, "h2o3_degrade_total", {"algo": "gbm"}) - d0),
+        "circuit_opens": round(circuit_opens() - c0),
+        "faults_injected": round(injected_total() - i0),
+        "transient_train_bit_identical": transient_ok,
+        "ckpt_resume_ok": resume_ok,
+        "oom_degrade_ok": degraded_ok,
+        "circuit_lifecycle_ok": bool(circuit_opened
+                                     and served_after is not None),
+    }
+    ok = all(out[k] for k in ("transient_train_bit_identical",
+                              "ckpt_resume_ok", "oom_degrade_ok",
+                              "circuit_lifecycle_ok"))
+    out["ok"] = ok
+    log(f"chaos sweep: {'PASS' if ok else 'FAIL'} {out}")
+    return out
+
+
+def main():
+    out = {"resilience": run_chaos_round(
+        log=lambda *a: print(*a, file=sys.stderr))}
+    print(json.dumps(out, indent=2))
+    sys.exit(0 if out["resilience"]["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()
