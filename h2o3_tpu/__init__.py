@@ -65,7 +65,9 @@ def init(n_data=None, n_model=1, distributed=False,
 
     Replaces the reference's cluster boot (water/H2O.java:2328 main →
     Paxos cloud formation): there is no membership protocol — the mesh is
-    the cloud.
+    the cloud. Multi-chip SPMD is the default whenever more than one
+    device is visible (``H2O3_SPMD=0`` collapses the default mesh to a
+    single device — the escape hatch).
 
     ``distributed=True`` is the multi-host path (SURVEY §7.3): every host
     runs the SAME program, ``jax.distributed.initialize`` forms the

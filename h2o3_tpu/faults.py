@@ -14,8 +14,10 @@ Spec grammar (``H2O3_FAULTS`` env var or ``POST /3/Faults?spec=...``)::
     H2O3_FAULTS="site[@pipeline]:every=N[:exc=Name][:times=M][:after=K][:key=K],..."
 
 - ``site``      — one of the instrumented points: ``h2d``, ``d2h``,
-                  ``compile``, ``execute``, ``persist`` (free-form
-                  strings; unknown sites simply never fire).
+                  ``compile``, ``execute``, ``persist``, ``collective``
+                  (the ICI histogram-psum seam — checked at the train
+                  chunk dispatch whenever the mesh has >1 data shard)
+                  (free-form strings; unknown sites simply never fire).
 - ``@pipeline`` — optional filter on the calling pipeline label
                   (``ingest``/``train``/``serve``); omitted = any.
 - ``every=N``   — fire on every Nth matching check (the Nth, 2Nth, …).

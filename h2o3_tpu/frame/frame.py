@@ -182,6 +182,29 @@ class Frame:
     def rename(self, mapping: Dict[str, str]) -> "Frame":
         return Frame([mapping.get(n, n) for n in self._names], self._vecs)
 
+    def resharded(self, mesh) -> "Frame":
+        """Rebuild this frame's device columns under a DIFFERENT mesh
+        (new row padding + data-axis layout). The multichip bench and
+        the SPMD parity tests carve sub-meshes out of the device set and
+        need the SAME logical table laid out per mesh — the reference's
+        analog is re-homing chunks after cloud membership changes.
+
+        Host-exact shadows (str/time/wide-int) are carried over; device
+        payloads make one host round-trip (resharding across different
+        paddings is a host repack anyway)."""
+        new_vecs = []
+        for v in self._vecs:
+            if v.type == T_STR:
+                new_vecs.append(Vec(None, v.nrow, T_STR,
+                                    host_data=v.host_data))
+            elif v.type == T_TIME:
+                new_vecs.append(Vec.from_numpy(v.to_numpy(), vtype=T_TIME,
+                                               mesh=mesh))
+            else:
+                new_vecs.append(Vec.from_numpy(v.to_numpy(), vtype=v.type,
+                                               domain=v.domain, mesh=mesh))
+        return Frame(self.names, new_vecs, key=self.key)
+
     # ---------------- row selection ----------------
 
     def rows(self, sel) -> "Frame":

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from h2o3_tpu.parallel.mesh import current_mesh, data_sharding, padded_len
+from h2o3_tpu.parallel.mesh import current_mesh, padded_len
 from h2o3_tpu.telemetry import record_d2h, record_h2d
 
 T_REAL = "real"
@@ -396,16 +396,18 @@ def batch_device_put(columns, fill, dtype, nrow: int, mesh=None):
         for j in range(len(columns)):
             _pack(j)
     record_h2d(mat.nbytes)
-    dev = _resilient_put(mat, data_sharding(mesh))
+    dev = _resilient_put(mat, mesh)
     return [dev[:, j] for j in range(len(columns))]
 
 
-def _resilient_put(arr, sharding):
-    """device_put behind the fault seam + shared transient retry: a
-    transient H2D failure (injected or organic) re-issues the DMA with
-    backoff instead of failing the whole parse/train."""
-    from h2o3_tpu.resilience import resilient_device_put
-    return resilient_device_put(arr, sharding)
+def _resilient_put(arr, mesh):
+    """Row-sharded placement behind the fault seam + shared transient
+    retry (resilience.resilient_shard_rows → mesh.DataParallelPartitioner):
+    a transient H2D failure (injected or organic) re-issues the DMA with
+    backoff instead of failing the whole parse/train, and a multi-process
+    mesh assembles the global array from process-local rows."""
+    from h2o3_tpu.resilience import resilient_shard_rows
+    return resilient_shard_rows(arr, mesh)
 
 
 def _pad_and_put(arr: np.ndarray, nrow: int, fill, mesh):
@@ -413,4 +415,4 @@ def _pad_and_put(arr: np.ndarray, nrow: int, fill, mesh):
     if plen != nrow:
         arr = np.concatenate([arr, np.full(plen - nrow, fill, dtype=arr.dtype)])
     record_h2d(arr.nbytes)
-    return _resilient_put(arr, data_sharding(mesh))
+    return _resilient_put(arr, mesh)

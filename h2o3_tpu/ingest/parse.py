@@ -303,19 +303,23 @@ def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
         # host-side full-column concat disappears for those groups
         stream_cols = [i for i in active
                        if setup.column_types[i] in (T_REAL, T_INT, T_TIME)]
-        # default 'auto': stream only on a single-data-shard mesh — the
-        # per-chunk puts land on ONE device and the assembly resharding
-        # would stage the whole numeric group there, defeating a wide
-        # mesh's 1/ndev-per-device layout (the grouped host-merge path
-        # uploads directly sharded). '1' forces, '0' disables.
+        # streaming engages on ANY single-process mesh: single-shard
+        # meshes use the device-concat path, multi-data-shard meshes
+        # place each chunk's put on its HOME shard device and stitch the
+        # sharded array with make_array_from_single_device_arrays
+        # (shard-aligned placement, ingest/stream.py) — no single-device
+        # staging of the numeric group. Multi-PROCESS meshes fall back
+        # to the host merge: most home devices belong to other
+        # processes, so a chunk device_put there is not addressable.
+        # '1' forces, '0' disables, 'auto' = on when single-process.
+        import jax as _jax
         stream_env = os.environ.get("H2O3_INGEST_STREAM", "auto")
         if stream_env in ("0", "false", ""):
             stream_ok = False
         elif stream_env == "1":
             stream_ok = True
         else:
-            from h2o3_tpu.parallel.mesh import n_data_shards
-            stream_ok = n_data_shards(mesh) == 1
+            stream_ok = _jax.process_count() == 1
         want_stream = bool(len(jobs) > 1 and stream_cols and stream_ok)
         streamer = None
         results: List[Optional[List[EncodedColumn]]] = [None] * len(jobs)
@@ -445,6 +449,21 @@ def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
                             help="share of the ingest pack+transfer "
                             "(device_put) stage hidden under tokenize"
                             ).set(overlap)
+        shard_stats = None
+        if streamer is not None and streamer.nd > 1:
+            # per-shard placement/overlap stats (shard-aligned streamed
+            # ingest): one labeled gauge per data shard + the aligned-row
+            # ratio (share of rows whose chunk H2D landed on its final
+            # home shard — the rest moved D2D at assembly)
+            shard_stats = streamer.shard_profile()
+            for s in shard_stats:
+                if s["overlap_ratio"] is not None:
+                    telemetry.gauge(
+                        "h2o3_ingest_h2d_overlap_ratio",
+                        {"shard": str(s["shard"])},
+                        help="per-data-shard share of the streamed chunk "
+                        "pack+transfer hidden under tokenize").set(
+                        s["overlap_ratio"])
         if root is not None:
             root.attrs.update(rows=fr.nrow, chunks=len(jobs))
             root.finish()
@@ -458,7 +477,13 @@ def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
                              "device_put_s": round(put_total_s, 4),
                              "h2d_overlap_ratio": (round(overlap, 4)
                                                    if overlap is not None
-                                                   else None)})
+                                                   else None),
+                             "h2d_shards": shard_stats,
+                             "aligned_row_ratio": (
+                                 round(streamer.aligned_row_ratio, 4)
+                                 if streamer is not None and streamer.nd > 1
+                                 and streamer.aligned_row_ratio is not None
+                                 else None)})
         return fr
     finally:
         # a parse that raises mid-pipeline still closes its root span,

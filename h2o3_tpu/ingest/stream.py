@@ -22,6 +22,18 @@ from the resolved int64, not a chunkwise f64 rounding.
 Equivalence: per-chunk f64→f32 conversion followed by device concat is
 elementwise identical to the old full-column concat + one conversion;
 tests/test_transfer_budget.py asserts the parse-equivalence.
+
+Shard-aligned placement (multi-data-shard meshes): streaming used to
+disable itself when the mesh's data axis was wider than one device —
+every chunk's put landed on device 0 and the final reshard staged the
+whole numeric group there. Now each chunk's H2D is issued to its HOME
+data-shard device (chunk order is row order for a byte-range CSV
+fan-out, so ``DataParallelPartitioner.chunk_home`` maps chunks to the
+shard that will own their rows), and assembly builds the global sharded
+array with ``jax.make_array_from_single_device_arrays`` — only the
+fragments straddling a shard boundary move device-to-device. Per-shard
+placement/overlap stats land in ``shard_profile()`` →
+``LAST_PROFILE['h2d_shards']``.
 """
 from __future__ import annotations
 
@@ -53,10 +65,21 @@ class ChunkDeviceStreamer:
 
     def __init__(self, col_ids: List[int], col_types: List[str],
                  n_chunks: int, mesh):
+        from h2o3_tpu.parallel.mesh import n_data_shards, partitioner
         self.col_ids = list(col_ids)          # original column indices
         self.col_types = col_types            # full setup.column_types
         self.n_chunks = n_chunks
         self.mesh = mesh
+        self.part = partitioner(mesh)
+        self.nd = n_data_shards(mesh)
+        self._home: Dict[int, int] = {}       # chunk_idx -> home data shard
+        # per-shard placement accounting (shard_profile)
+        self._shard_bytes = [0] * self.nd
+        self._shard_chunks = [0] * self.nd
+        self._shard_hidden_s = [0.0] * self.nd
+        self._shard_assemble_s = [0.0] * self.nd
+        self._aligned_rows = 0                # rows landing on their home
+        self._moved_rows = 0                  # boundary fragments moved D2D
         self._devs: Dict[int, object] = {}    # chunk_idx -> [rows_c, C] dev
         self._rows: Dict[int, int] = {}
         self._inflight: deque = deque()
@@ -124,19 +147,30 @@ class ChunkDeviceStreamer:
                 # column needs an exact host shadow (integral > 2^24)
                 self._f64.setdefault(i, {})[chunk_idx] = f64
         self._rows[chunk_idx] = rows_c or 0
-        # a transient chunk-upload failure retries with backoff instead
+        # shard-aligned placement: the chunk's DMA targets its HOME
+        # data-shard device (chunk order == row order for byte ranges),
+        # so on a wide mesh the upload already lands ~where the rows
+        # will live; single-shard meshes keep the default device.
+        # A transient chunk-upload failure retries with backoff instead
         # of failing the whole parse (the fault-matrix test drives this)
         from h2o3_tpu.resilience import resilient_device_put
-        dev = resilient_device_put(mat, pipeline="ingest")
+        home = self.part.chunk_home(chunk_idx, self.n_chunks)
+        self._home[chunk_idx] = home
+        target = self.part.home_device(home) if self.nd > 1 else None
+        dev = resilient_device_put(mat, target, pipeline="ingest")
         telemetry.record_h2d(mat.nbytes, pipeline="ingest")
         self.h2d_bytes += mat.nbytes
+        self._shard_bytes[home] += mat.nbytes
+        self._shard_chunks[home] += 1
         self._devs[chunk_idx] = dev
         self._inflight.append(dev)
         while len(self._inflight) > _INFLIGHT_DEPTH:
             # double-buffer bound: block on the OLDEST transfer so at
             # most _INFLIGHT_DEPTH pack matrices are pinned at once
             jax.block_until_ready(self._inflight.popleft())
-        self.add_seconds += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.add_seconds += dt
+        self._shard_hidden_s[home] += dt
 
     def discard(self) -> None:
         """Drop everything (the import-scoped Python-tokenizer fallback
@@ -171,31 +205,116 @@ class ChunkDeviceStreamer:
         full = parts[0] if len(parts) == 1 else np.concatenate(parts)
         return _numeric_host_copy(full, self.col_types[i])
 
+    def _assemble_sharded(self, nrow: int, C: int):
+        """Multi-data-shard assembly: per shard, gather the chunk
+        fragments covering its row range (chunks already live on their
+        home device — only boundary-straddling fragments move D2D),
+        concatenate ON the shard's device, then stitch the global
+        row-sharded array with ``jax.make_array_from_single_device_arrays``
+        (no single-device staging of the whole numeric group)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from h2o3_tpu.parallel.mesh import DATA_AXIS, padded_len
+        order = sorted(self._devs)
+        offs: Dict[int, int] = {}
+        off = 0
+        for k in order:
+            offs[k] = off
+            off += self._rows[k]
+        plen = padded_len(nrow, self.mesh)
+        S = plen // self.nd
+        by_dev = {}
+        for d in range(self.nd):
+            td0 = time.perf_counter()
+            dev_d = self.part.home_device(d)
+            lo, hi = d * S, (d + 1) * S
+            parts = []
+            for k in order:
+                ck_lo = offs[k]
+                ck_hi = ck_lo + self._rows[k]
+                s, e = max(lo, ck_lo), min(hi, ck_hi)
+                if s >= e:
+                    continue
+                piece = self._devs[k][s - ck_lo: e - ck_lo]
+                if self._home[k] == d:
+                    self._aligned_rows += e - s
+                else:
+                    # boundary fragment (or a home misprediction from
+                    # uneven rows-per-byte): one D2D move, not H2D
+                    self._moved_rows += e - s
+                    piece = jax.device_put(piece, dev_d)
+                parts.append(piece)
+            if hi > nrow:          # pad tail rows of the last shard(s)
+                pad = np.full((hi - max(lo, nrow), C), np.nan, np.float32)
+                parts.append(jax.device_put(pad, dev_d))
+            shard = (parts[0] if len(parts) == 1
+                     else jnp.concatenate(parts, axis=0))
+            shard = jax.device_put(shard, dev_d)   # commit
+            for dev in self.part.shard_devices(d):  # model-axis replicas
+                by_dev[dev] = (shard if dev == dev_d
+                               else jax.device_put(shard, dev))
+            self._shard_assemble_s[d] += time.perf_counter() - td0
+        self._devs.clear()
+        sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+        bufs = [by_dev[d] for d in sharding.addressable_devices]
+        return jax.make_array_from_single_device_arrays(
+            (plen, C), sharding, bufs)
+
+    def shard_profile(self) -> List[Dict[str, object]]:
+        """Per-data-shard placement stats for LAST_PROFILE /
+        the ``h2o3_ingest_h2d_overlap_ratio{shard=}`` gauges."""
+        out = []
+        for d in range(self.nd):
+            tot = self._shard_hidden_s[d] + self._shard_assemble_s[d]
+            out.append({
+                "shard": d, "chunks": self._shard_chunks[d],
+                "h2d_bytes": self._shard_bytes[d],
+                "hidden_s": round(self._shard_hidden_s[d], 4),
+                "assemble_s": round(self._shard_assemble_s[d], 4),
+                "overlap_ratio": (round(self._shard_hidden_s[d] / tot, 4)
+                                  if tot > 0 else None)})
+        return out
+
+    @property
+    def aligned_row_ratio(self) -> Optional[float]:
+        """Share of streamed rows whose chunk H2D already landed on the
+        row's final home shard (the rest moved D2D at assembly)."""
+        tot = self._aligned_rows + self._moved_rows
+        return self._aligned_rows / tot if tot else None
+
     def assemble(self) -> Dict[int, Vec]:
         """Block on outstanding DMAs, concatenate chunk matrices on
         device, pad + reshard to the mesh row layout, and return one Vec
         per streamed column (minus ``fallback_cols``)."""
         import jax
         import jax.numpy as jnp
-        from h2o3_tpu.parallel.mesh import data_sharding, padded_len
+        from h2o3_tpu.parallel.mesh import padded_len, partitioner
         assert not self._discarded
         nrow = sum(self._rows.values())
         t0 = time.perf_counter()
-        devs = [self._devs.pop(k) for k in sorted(self._devs)]
-        self._inflight.clear()
         C = len(self.col_ids)
-        full = devs[0] if len(devs) == 1 else jnp.concatenate(devs, axis=0)
-        # drop the per-chunk refs as soon as the concat is dispatched —
-        # holding them through the reshard would keep THREE copies of
-        # the numeric group live (chunks + concat + sharded) instead of
-        # two, an avoidable dataset-sized device-memory transient
-        del devs
-        plen = padded_len(nrow, self.mesh)
-        if plen > nrow:
-            full = jnp.concatenate(
-                [full, jnp.full((plen - nrow, C), jnp.nan, jnp.float32)],
-                axis=0)
-        full = jax.device_put(full, data_sharding(self.mesh))
+        if self.nd > 1:
+            full = self._assemble_sharded(nrow, C)
+            self._inflight.clear()
+        else:
+            devs = [self._devs.pop(k) for k in sorted(self._devs)]
+            self._inflight.clear()
+            full = (devs[0] if len(devs) == 1
+                    else jnp.concatenate(devs, axis=0))
+            # drop the per-chunk refs as soon as the concat is dispatched
+            # — holding them through the reshard would keep THREE copies
+            # of the numeric group live (chunks + concat + sharded)
+            # instead of two, an avoidable dataset-sized device-memory
+            # transient
+            del devs
+            plen = padded_len(nrow, self.mesh)
+            if plen > nrow:
+                full = jnp.concatenate(
+                    [full, jnp.full((plen - nrow, C), jnp.nan, jnp.float32)],
+                    axis=0)
+            full = jax.device_put(full,
+                                  partitioner(self.mesh).data_sharding)
         out: Dict[int, Vec] = {}
         for j, i in enumerate(self.col_ids):
             if i in self._exact:

@@ -36,7 +36,9 @@ from h2o3_tpu.models.tree import (TreeConfig, adaptive_feasible,
                                   collect_chunk_trees, grow_tree,
                                   grow_tree_adaptive, predict_raw_stacked)
 from h2o3_tpu.ops.binning import CodesView, bin_matrix_device, make_codes_view
-from h2o3_tpu.parallel.mesh import DATA_AXIS, current_mesh, n_data_shards
+from h2o3_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS, current_mesh,
+                                    n_data_shards, n_model_shards,
+                                    spmd_enabled)
 from h2o3_tpu.persist import register_model_class
 from h2o3_tpu.resilience import retry_transient
 
@@ -171,7 +173,7 @@ def _drf_chunk_body(codes_rm, codes_t, y, w, oob_num, oob_cnt, base_key,
                     root_lo, root_hi, nb_f, start_idx, n_active, sample_rate,
                     col_rate, *, cfg, K,
                     sample_rate_per_class, chunk, has_t, adaptive,
-                    axis_name):
+                    axis_name, model_axis=None):
     """A chunk of independent forest trees per data shard; OOB sums ride
     the scan carry (reference: DRF's OOB rows are scored by the trees that
     did not sample them — hex/tree/drf/DRF.java OOB machinery).
@@ -188,9 +190,11 @@ def _drf_chunk_body(codes_rm, codes_t, y, w, oob_num, oob_cnt, base_key,
         if adaptive:
             return grow_tree_adaptive(codes_rm, gv, hv, wt, cfg, col_mask,
                                       root_lo, root_hi, axis_name=axis_name,
-                                      key=key_m, nb_f=nb_f)
+                                      key=key_m, nb_f=nb_f,
+                                      model_axis=model_axis)
         return grow_tree(codes, gv, hv, wt, cfg, col_mask,
-                         axis_name=axis_name, key=key_m)
+                         axis_name=axis_name, key=key_m,
+                         model_axis=model_axis)
 
     def one_tree(carry, i):
         oob_num, oob_cnt = carry
@@ -238,10 +242,14 @@ def _drf_chunk_body(codes_rm, codes_t, y, w, oob_num, oob_cnt, base_key,
 @lru_cache(maxsize=128)
 def _compiled_drf_chunk(mesh, cfg, K, sample_rate_per_class, chunk, has_t,
                         adaptive, donate=False):
+    model_axis = (MODEL_AXIS
+                  if mesh.shape[MODEL_AXIS] > 1 and spmd_enabled()
+                  else None)
     body = partial(_drf_chunk_body, cfg=cfg, K=K,
                    sample_rate_per_class=sample_rate_per_class,
                    chunk=chunk, has_t=has_t,
-                   adaptive=adaptive, axis_name=DATA_AXIS)
+                   adaptive=adaptive, axis_name=DATA_AXIS,
+                   model_axis=model_axis)
     in_specs = (P(DATA_AXIS),
                 P(None, DATA_AXIS) if has_t else P(DATA_AXIS),
                 P(DATA_AXIS), P(DATA_AXIS),
@@ -416,6 +424,9 @@ class H2ORandomForestEstimator(ModelBuilder):
                                            adaptive, donate)
                 if faults.ACTIVE:
                     faults.check("execute", pipeline="train")
+                    if nd > 1:
+                        # ICI collective seam (see models/gbm.py)
+                        faults.check("collective", pipeline="train")
                 return step(
                     Xtr, codes_t_arg, y, spec.w, oob_num, oob_cnt, key,
                     root_lo, root_hi, nb_f,
@@ -467,6 +478,10 @@ class H2ORandomForestEstimator(ModelBuilder):
                 from h2o3_tpu.log import warn
                 warn("drf: final in-training checkpoint failed: %s", e)
         model.output["training_loop_seconds"] = t_loop
+        model.output["spmd"] = {
+            "n_data": nd, "n_model": n_model_shards(mesh),
+            "model_axis_split_search": bool(
+                n_model_shards(mesh) > 1 and spmd_enabled())}
         # OOB metrics as training metrics (reference DRF semantics:
         # "training" numbers are out-of-bag when sample_rate < 1)
         self._oob_metrics(model, spec, K, oob_num, oob_cnt)

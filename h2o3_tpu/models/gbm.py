@@ -33,7 +33,9 @@ from h2o3_tpu.models.tree import (TreeConfig, adaptive_feasible,
                                   predict_raw_stacked, predict_raw_tree)
 from h2o3_tpu.ops.binning import (CodesView, bin_matrix_device,
                                   digitize_with_edges, make_codes_view)
-from h2o3_tpu.parallel.mesh import DATA_AXIS, current_mesh, n_data_shards
+from h2o3_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS, current_mesh,
+                                    n_data_shards, n_model_shards,
+                                    spmd_enabled)
 from h2o3_tpu.resilience import retry_transient
 
 GBM_DEFAULTS: Dict = dict(
@@ -231,7 +233,7 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
                     dist_name, tweedie_power, quantile_alpha,
                     sample_rate_per_class, na_bin, chunk,
                     has_valid, has_t, adaptive, has_mono, has_sets,
-                    axis_name):
+                    axis_name, model_axis=None):
     """One chunk of the boosting loop, per data shard (runs under
     shard_map). ``chunk`` trees are built inside ONE program via lax.scan:
     per-call dispatch overhead amortises and margins/trees stay on device
@@ -265,10 +267,10 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
             return grow_tree_adaptive(codes_rm, gv, hv, wt, cfg, col_mask,
                                       root_lo, root_hi, axis_name=axis_name,
                                       nb_f=nb_f, mono=mono_a, sets=sets_a,
-                                      key=key)
+                                      key=key, model_axis=model_axis)
         return grow_tree(codes, gv, hv, wt, cfg, col_mask,
                          axis_name=axis_name, mono=mono_a, sets=sets_a,
-                         key=key)
+                         key=key, model_axis=model_axis)
 
     def valid_contrib(tree):
         if adaptive:
@@ -351,13 +353,19 @@ def _compiled_chunk(mesh, cfg, K, dist_name, tweedie_power, quantile_alpha,
     reuses their HBM instead of holding two generations live. The driver
     only donates when early stopping is off (a stop rollback needs the
     committed chunk's buffers intact)."""
+    # split search shards over the model axis whenever the mesh HAS one
+    # (feature blocks per shard, all_gather'd winners — tree.py
+    # _find_splits_sharded); H2O3_SPMD=0 keeps it off everywhere
+    model_axis = (MODEL_AXIS
+                  if mesh.shape[MODEL_AXIS] > 1 and spmd_enabled()
+                  else None)
     body = partial(_gbm_chunk_body, cfg=cfg, K=K, dist_name=dist_name,
                    tweedie_power=tweedie_power, quantile_alpha=quantile_alpha,
                    sample_rate_per_class=sample_rate_per_class,
                    na_bin=na_bin, chunk=chunk,
                    has_valid=has_valid, has_t=has_t,
                    adaptive=adaptive, has_mono=has_mono, has_sets=has_sets,
-                   axis_name=DATA_AXIS)
+                   axis_name=DATA_AXIS, model_axis=model_axis)
     in_specs = (P(DATA_AXIS),                              # codes_rm / raw X
                 P(None, DATA_AXIS) if has_t else P(DATA_AXIS),  # codes_t/dummy
                 P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),  # margin, y, w
@@ -744,6 +752,13 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                                        has_sets, donate)
                 if faults.ACTIVE:
                     faults.check("execute", pipeline="train")
+                    if nd > 1:
+                        # ICI collective seam: the per-level histogram
+                        # psum rides inside this dispatch on a multi-
+                        # shard mesh — a transient interconnect failure
+                        # surfaces here and retries like any other
+                        # transient execute error
+                        faults.check("collective", pipeline="train")
                 return step(
                     Xtr, codes_t_arg, margin, yf, w, vtrain, vmargin,
                     key, jnp.float32(lr), huber_delta,
@@ -845,6 +860,13 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             "bin_s": round(t_bin, 4), "loop_s": round(t_loop, 4),
             "score_s": round(score_s, 4),
             "finalize_s": round(t_fin, 4)}
+        # mesh layout this train actually ran under — the bench scaling
+        # round and the SPMD parity tests assert against it instead of
+        # inferring from env
+        model.output["spmd"] = {
+            "n_data": nd, "n_model": n_model_shards(mesh),
+            "model_axis_split_search": bool(
+                n_model_shards(mesh) > 1 and spmd_enabled())}
         return model
 
     def _train_streaming(self, spec: TrainingSpec, valid_spec, dist_name,

@@ -1109,6 +1109,11 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
             job.set_progress(min(0.9, (it + 1) / max_iter))
             if delta < float(p.get("beta_epsilon", 1e-5) or 1e-5):
                 break
+            if job.cancel_requested:
+                # watchdog max_runtime / REST cancel: keep the current
+                # beta as the partial fit instead of running out the
+                # remaining IRLS sweeps over every host chunk
+                break
         # final pass: deviances + metrics
         mu_host = np.zeros(rows, np.float32)
         for s in range(0, rows, chunk):
@@ -1287,6 +1292,8 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
             job.set_progress((it + 1) / max_iter)
             if done:
                 converged = True
+                break
+            if job.cancel_requested:
                 break
         beta, u = np.asarray(jax.device_get(beta)), np.asarray(
             jax.device_get(u))
@@ -1839,6 +1846,11 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
                     beta_s = nb
                     if delta < beta_eps:
                         break
+                    if job.cancel_requested:
+                        # poll INSIDE the IRLS loop, not just between
+                        # lambdas: a single lambda's fit can outlive the
+                        # watchdog's max_runtime_secs deadline on its own
+                        break
                     if (family == "gaussian" and not use_cd
                             and fam.link_name == "identity"):
                         break  # weighted least squares: one solve is exact
@@ -2156,6 +2168,11 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
             B = nB
             job.set_progress((it + 1) / max_iter)
             if delta < beta_eps:
+                break
+            if job.cancel_requested:
+                # cooperative watchdog/REST cancellation between class
+                # sweeps (each sweep is K full Gram builds — the longest
+                # uncancellable stretch without this poll)
                 break
         # deviance bookkeeping
         eta = Xs @ B

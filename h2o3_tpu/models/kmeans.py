@@ -187,6 +187,12 @@ class H2OKMeansEstimator(ModelBuilder):
         wcss = np.inf
         it = 0
         for it in range(max_iter):
+            if it and job.cancel_requested:
+                # poll BEFORE dispatching the next Lloyd step (watchdog
+                # max_runtime_secs cancels land here without paying one
+                # extra full iteration); the current centers are the
+                # partial model
+                break
             C, assign, cnt, new_wcss = _lloyd_step(Xs, w, C)
             new_wcss = float(jax.device_get(new_wcss))
             job.set_progress((it + 1) / max_iter)
@@ -194,8 +200,6 @@ class H2OKMeansEstimator(ModelBuilder):
                 wcss = new_wcss
                 break
             wcss = new_wcss
-            if job.cancel_requested:
-                break
         cnt_h = np.asarray(jax.device_get(cnt))
         C_h = np.asarray(jax.device_get(C))
         C_raw = C_h * np.asarray(jax.device_get(xs))[None, :] \

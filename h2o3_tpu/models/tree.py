@@ -175,6 +175,77 @@ def _find_splits(trip, cfg: TreeConfig, col_mask, mono=None):
             wl_s, wr_sel)
 
 
+def _axis_size(axis_name) -> int:
+    """Static mesh-axis size inside shard_map, across jax versions
+    (jax.lax.axis_size is missing on 0.4.x; jax.core.axis_frame returns
+    the bare size there and a frame object on newer builds)."""
+    if hasattr(jax.lax, "axis_size"):
+        return int(jax.lax.axis_size(axis_name))
+    frame = jax.core.axis_frame(axis_name)
+    return int(frame if isinstance(frame, int) else frame.size)
+
+
+def _find_splits_sharded(trip, cfg: TreeConfig, col_mask, mono=None,
+                         model_axis=None):
+    """Split search sharded over the mesh 'model' axis: each model shard
+    scans a contiguous FEATURE BLOCK of the (already data-psum'd)
+    histograms with the ordinary :func:`_find_splits`, and the global
+    best split per node is reconstructed with one small all_gather +
+    argmax over shards. Features never move — only [N, 8] candidate
+    scalars cross the ICI (the reference has no wide-axis sharding at
+    all, SURVEY.md §5; this divides the N·F·B split scan by n_model).
+
+    Tie-breaking matches the single-shard argmax EXACTLY: the local
+    flattened candidate order is feature-major and shard blocks are
+    contiguous feature ranges, so "first max wins" picks the same split
+    — sharded and unsharded trees stay bit-identical."""
+    if model_axis is None:
+        return _find_splits(trip, cfg, col_mask, mono=mono)
+    n_model = _axis_size(model_axis)
+    if n_model == 1:
+        return _find_splits(trip, cfg, col_mask, mono=mono)
+    from dataclasses import replace as dc_replace
+    B = cfg.n_bins
+    F = cfg.n_features
+    F_loc = -(-F // n_model)
+    Fp = F_loc * n_model
+    midx = jax.lax.axis_index(model_axis)
+    start = midx * F_loc
+    # node totals from the full histograms (a shard whose block is pure
+    # zero-padding has no real feature to read them from): any real
+    # feature's bins sum to the node totals — use feature 0
+    g_tot = trip[0][:, 0, : B + 1].sum(-1)
+    h_tot = trip[1][:, 0, : B + 1].sum(-1)
+    w_tot = trip[2][:, 0, : B + 1].sum(-1)
+
+    def block(x):
+        xp = jnp.pad(x[:, :F, :], ((0, 0), (0, Fp - F), (0, 0)))
+        return jax.lax.dynamic_slice_in_dim(xp, start, F_loc, axis=1)
+
+    trip_l = tuple(block(t) for t in trip)
+    cm = col_mask if col_mask.ndim == 2 else col_mask[None, :]
+    cm = jnp.pad(cm, ((0, 0), (0, Fp - F)))          # padding: never split
+    cm_l = jax.lax.dynamic_slice_in_dim(cm, start, F_loc, axis=1)
+    mono_l = None
+    if mono is not None:
+        mono_l = jax.lax.dynamic_slice_in_dim(
+            jnp.pad(mono, (0, Fp - F)), start, F_loc)
+    cfg_l = dc_replace(cfg, n_features=F_loc)
+    (bg, bf, bb, bnl, _gt, _ht, _wt, vl, vr, wl, wr) = _find_splits(
+        trip_l, cfg_l, cm_l, mono=mono_l)
+    cand = jnp.stack([bg, (start + bf).astype(jnp.float32),
+                      bb.astype(jnp.float32), bnl.astype(jnp.float32),
+                      vl, vr, wl, wr], axis=-1)      # [N, 8]
+    allc = jax.lax.all_gather(cand, model_axis)      # [n_model, N, 8]
+    winner = jnp.argmax(allc[:, :, 0], axis=0)       # first max = low shard
+    sel = jnp.take_along_axis(allc, winner[None, :, None], axis=0)[0]
+    # feature/bin indices survive the f32 ride exactly (both < 2^14)
+    return (sel[:, 0], sel[:, 1].astype(jnp.int32),
+            sel[:, 2].astype(jnp.int32), sel[:, 3] > 0.5,
+            g_tot, h_tot, w_tot, sel[:, 4], sel[:, 5], sel[:, 6],
+            sel[:, 7])
+
+
 BIGV = jnp.float32(1e30)
 
 
@@ -223,7 +294,7 @@ def _level_mtries(cfg: TreeConfig, d: int, F: int) -> int:
 
 
 def grow_tree(codes, g, h, w, cfg: TreeConfig, col_mask, axis_name=None,
-              key=None, mono=None, sets=None):
+              key=None, mono=None, sets=None, model_axis=None):
     """Build one tree. All args are device arrays (codes [rows,F] int,
     g/h/w [rows] float32, already weight-multiplied); returns tree arrays
     of length M = 2^(D+1)-1 plus per-row final node ids.
@@ -235,7 +306,11 @@ def grow_tree(codes, g, h, w, cfg: TreeConfig, col_mask, axis_name=None,
 
     ``cfg.mtries > 0`` draws a fresh random feature subset per NODE per
     level from ``key`` (DRF mtries semantics, hex/tree/drf/DRF.java —
-    the key must be identical across shards so splits agree)."""
+    the key must be identical across shards so splits agree).
+
+    ``model_axis`` shards the per-level split SEARCH over the mesh
+    'model' axis (histograms stay data-psum'd and replicated across
+    model shards; see _find_splits_sharded)."""
     from h2o3_tpu.ops.binning import CodesView
     from h2o3_tpu.ops.histogram import build_histograms
 
@@ -302,8 +377,9 @@ def grow_tree(codes, g, h, w, cfg: TreeConfig, col_mask, axis_name=None,
         if allowed is not None:
             lm2 = level_mask if level_mask.ndim == 2 else level_mask[None, :]
             level_mask = lm2 & allowed
-        bg, bf, bb, bnl, gt, ht, wt, vl_s, vr_s, _wl, _wr = _find_splits(
-            hist, cfg, level_mask, mono=mono)
+        bg, bf, bb, bnl, gt, ht, wt, vl_s, vr_s, _wl, _wr = \
+            _find_splits_sharded(hist, cfg, level_mask, mono=mono,
+                                 model_axis=model_axis)
         can = (bg > jnp.maximum(cfg.min_split_improvement, 0.0)) & (wt > 0)
         idx = base + jnp.arange(N)
         feat = feat.at[idx].set(jnp.where(can, bf, -1))
@@ -433,7 +509,7 @@ def adaptive_setup(spec, params, max_depth: int, mtries: int = 0):
 
 def grow_tree_adaptive(X, g, h, w, cfg: TreeConfig, col_mask, root_lo,
                        root_hi, axis_name=None, key=None, nb_f=None,
-                       mono=None, sets=None):
+                       mono=None, sets=None, model_axis=None):
     """Build one tree with PER-NODE ADAPTIVE uniform bins on raw features
     (H2O's default histogram_type=UniformAdaptive, hex/tree/DHistogram.java
     _min/_maxEx per-node re-binning) via the fused route+bin+histogram
@@ -563,8 +639,9 @@ def grow_tree_adaptive(X, g, h, w, cfg: TreeConfig, col_mask, root_lo,
         if allowed is not None:
             lm2 = level_mask if level_mask.ndim == 2 else level_mask[None, :]
             level_mask = lm2 & allowed
-        bg, bf, bb, bnl, gt, ht, wt, vl_s, vr_s, wl_s, wr_s = _find_splits(
-            trip, find_cfg, level_mask, mono=mono)
+        bg, bf, bb, bnl, gt, ht, wt, vl_s, vr_s, wl_s, wr_s = \
+            _find_splits_sharded(trip, find_cfg, level_mask, mono=mono,
+                                 model_axis=model_axis)
         can = (bg > jnp.maximum(cfg.min_split_improvement, 0.0)) & (wt > 0)
         nidx = jnp.arange(N)
         lo_sel = lo_d[nidx, bf]
@@ -696,7 +773,7 @@ def grow_tree_spmd(codes, g, h, w, cfg: TreeConfig, col_mask,
     B1 = cfg.n_bins + 1
     rows, F_loc = codes.shape
     midx = jax.lax.axis_index(model_axis)
-    n_model = jax.lax.axis_size(model_axis)
+    n_model = _axis_size(model_axis)
 
     feat = jnp.full(M, -1, jnp.int32)
     split_bin = jnp.zeros(M, jnp.int32)

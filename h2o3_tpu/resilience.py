@@ -104,6 +104,27 @@ def resilient_device_put(arr, sharding=None, *, site: str = "h2d",
         _put, site=site if pipeline is None else f"{pipeline}.h2d")
 
 
+def resilient_shard_rows(arr, mesh=None, *, pipeline: Optional[str] = None):
+    """Row-shard a padded host array over the mesh data axis behind the
+    same ``h2d`` fault seam + transient retry as
+    :func:`resilient_device_put`. This is the partitioner-aware spelling
+    every frame-column placement goes through — on a multi-process mesh
+    it assembles the global array from process-local rows
+    (``jax.make_array_from_process_local_data``) instead of a plain
+    ``device_put``."""
+    from h2o3_tpu.parallel.mesh import partitioner
+
+    part = partitioner(mesh)
+
+    def _put():
+        if faults.ACTIVE:
+            faults.check("h2d", pipeline=pipeline)
+        return part.shard_rows(arr)
+
+    return retry_transient(
+        _put, site="h2d" if pipeline is None else f"{pipeline}.h2d")
+
+
 def retry_transient(fn: Callable[[], T], *, site: str,
                     attempts: int = 3, base_delay_s: float = 0.05,
                     max_delay_s: float = 2.0,

@@ -138,6 +138,45 @@ def test_train_transient_fault_retries_bit_identical():
     assert "h2o3_fault_injected_total" in text
 
 
+def test_train_collective_fault_retries_on_multishard_mesh():
+    """train × collective (ISSUE 7): a transient ICI failure on the
+    per-level histogram-psum seam retries via resilience.retry_transient
+    and the model stays bit-identical to the fault-free run. The
+    ``collective`` site only arms when the mesh has >1 data shard — the
+    suite's 8-virtual-device mesh qualifies."""
+    import jax
+    from h2o3_tpu.parallel.mesh import current_mesh, n_data_shards
+    if n_data_shards(current_mesh()) < 2:
+        pytest.skip("needs a multi-data-shard mesh")
+    fr = _reg_frame(seed=5)
+    a = H2OGradientBoostingEstimator(ntrees=6, max_depth=3, seed=7)
+    a.train(y="y", training_frame=fr)
+    before = telemetry.registry().value("h2o3_retry_total",
+                                        {"site": "train.execute"})
+    faults.configure("collective@train:every=1:times=2:exc=Unavailable")
+    b = H2OGradientBoostingEstimator(ntrees=6, max_depth=3, seed=7)
+    b.train(y="y", training_frame=fr)
+    faults.configure(None)
+    _assert_trees_equal(a.model, b.model)
+    after = telemetry.registry().value("h2o3_retry_total",
+                                       {"site": "train.execute"})
+    assert after > before, "collective fault did not exercise the retry"
+    # on a SINGLE-shard mesh the collective site never fires (there is
+    # no ICI to fail): same spec, single-device mesh, zero injections
+    from h2o3_tpu.parallel.mesh import make_mesh, set_mesh
+    old = current_mesh()
+    set_mesh(make_mesh(n_data=1, devices=jax.devices()[:1]))
+    try:
+        faults.configure("collective@train:every=1:exc=Unavailable")
+        fr1 = _reg_frame(seed=5)
+        c = H2OGradientBoostingEstimator(ntrees=3, max_depth=3, seed=7)
+        c.train(y="y", training_frame=fr1)
+        assert faults.fired_total() == 0
+    finally:
+        faults.configure(None)
+        set_mesh(old)
+
+
 def test_serve_transient_fault_single_retry():
     """serve × execute: one transient device failure recovers via the
     single in-batch retry — the client never sees it and the circuit

@@ -34,10 +34,10 @@ assert jax.process_count() == nproc
 assert len(jax.devices()) == 4 * nproc, len(jax.devices())
 
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from h2o3_tpu.models.tree import TreeConfig, grow_tree_adaptive
-from h2o3_tpu.parallel.mesh import DATA_AXIS, current_mesh
+from h2o3_tpu.parallel.mesh import DATA_AXIS, current_mesh, partitioner
 
 mesh = current_mesh()
 rows_global, F = 4096, 6
@@ -46,11 +46,13 @@ rng = np.random.default_rng(100 + pid)      # DIFFERENT rows per process
 Xl = rng.normal(size=(rows_local, F)).astype(np.float32)
 gl = rng.normal(size=rows_local).astype(np.float32)
 
-sh = NamedSharding(mesh, P(DATA_AXIS))
-X = jax.make_array_from_process_local_data(sh, Xl, (rows_global, F))
-g = jax.make_array_from_process_local_data(sh, gl, (rows_global,))
-ones = jax.make_array_from_process_local_data(
-    sh, np.ones(rows_local, np.float32), (rows_global,))
+# the product partitioner's multi-process branch: global sharded arrays
+# assembled from process-local rows (the same layer frame/vec.py
+# placement rides in a multi-host cluster)
+part = partitioner(mesh)
+X = part.shard_rows(Xl, rows_global)
+g = part.shard_rows(gl, rows_global)
+ones = part.shard_rows(np.ones(rows_local, np.float32), rows_global)
 
 cfg = TreeConfig(max_depth=4, n_bins=30, n_features=F, min_rows=1.0)
 root_lo = jnp.full(F, -4.0, jnp.float32)

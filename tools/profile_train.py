@@ -27,6 +27,13 @@ Env knobs: ROWS (default 2M), NCOL (default 28 features), TREES (20),
 DEPTH (6), NBINS (14), HIST (histogram_type, default 'random' like the
 bench; set 'quantiles_global' to profile the sketch-binned path),
 CSV= (profile a real file through the ingest path instead).
+
+``--xprof-trace [DIR]`` (or XPROF_TRACE_DIR=) wraps the WARM train in a
+``jax.profiler.trace`` capture for kernel-level attribution of the
+psum/histogram loop — open the dump with xprof/tensorboard
+(``python -m xprof.server DIR`` or ``tensorboard --logdir DIR``) to see
+per-level fused-histogram kernels and the ICI all-reduce on the
+device timeline (the SNIPPETS profiling-harness pattern).
 """
 import json
 import os
@@ -77,7 +84,20 @@ def _train(fr, yname):
     return gbm.model, time.time() - t0
 
 
+def _xprof_dir():
+    """Trace-export destination from --xprof-trace [DIR] / XPROF_TRACE_DIR
+    (None = no capture)."""
+    if "--xprof-trace" in sys.argv:
+        i = sys.argv.index("--xprof-trace")
+        if i + 1 < len(sys.argv) and not sys.argv[i + 1].startswith("-"):
+            return sys.argv[i + 1]
+        return os.path.join("/tmp", f"h2o3_xprof_{int(time.time())}")
+    return os.environ.get("XPROF_TRACE_DIR") or None
+
+
 def main():
+    import contextlib
+
     import jax
     from h2o3_tpu import telemetry
     from h2o3_tpu.cluster_boot import setup_compilation_cache
@@ -98,7 +118,20 @@ def main():
     stages0 = telemetry.stage_seconds("train.")
     compiles0 = telemetry.registry().value("h2o3_xla_compiles_total")
     h2d0 = telemetry.registry().value("h2o3_h2d_bytes_total")
-    model, warm_total = _train(fr, yname)
+    trace_dir = _xprof_dir()
+    trace_cm = contextlib.nullcontext()
+    if trace_dir:
+        # kernel-level attribution of the WARM loop: the capture holds
+        # the per-level histogram kernels and (on a multi-shard mesh)
+        # the psum all-reduce on the device timeline
+        try:
+            trace_cm = jax.profiler.trace(trace_dir)
+            log(f"xprof: tracing warm train -> {trace_dir}")
+        except Exception as e:   # profiling must never sink the profile
+            log(f"xprof trace unavailable: {e!r}")
+            trace_dir = None
+    with trace_cm:
+        model, warm_total = _train(fr, yname)
     warm_compiles = telemetry.registry().value(
         "h2o3_xla_compiles_total") - compiles0
     warm_h2d = telemetry.registry().value("h2o3_h2d_bytes_total") - h2d0
@@ -140,6 +173,8 @@ def main():
         "h2d_bytes_per_tree": round(
             warm_h2d / max(model.ntrees_built, 1)),
         "stream_profile": model.output.get("stream_profile"),
+        "spmd": model.output.get("spmd"),
+        "xprof_trace_dir": trace_dir,
     }
     print(json.dumps(out))
     return out
