@@ -414,9 +414,19 @@ def main():
             if r.returncode == 0 and r.stdout.strip():
                 out["multichip"] = json.loads(
                     r.stdout.strip().splitlines()[-1])
+                # collective/straggler attribution (ISSUE 8): a scaling
+                # regression is explainable from the BENCH JSON alone —
+                # wait share says "barrier", straggler says "one slow
+                # shard", neither says "recompute the whole round"
+                out["multichip.collective_wait_share"] = \
+                    out["multichip"].get("collective_wait_share")
+                out["multichip.straggler_ratio"] = \
+                    out["multichip"].get("straggler_ratio")
                 log(f"multichip: eff_8="
                     f"{out['multichip'].get('scaling_efficiency_8')} "
-                    f"verdict={out['multichip'].get('verdict')}")
+                    f"verdict={out['multichip'].get('verdict')} "
+                    f"straggler={out['multichip.straggler_ratio']} "
+                    f"wait_share={out['multichip.collective_wait_share']}")
             else:
                 log(f"multichip round failed rc={r.returncode}: "
                     f"{r.stderr[-500:]}")

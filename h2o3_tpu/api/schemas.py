@@ -97,6 +97,9 @@ def job_v3(job, dest_key: Optional[str] = None, dest_type: str = "Key<Model>") -
         "failed_stage": getattr(job, "failed_stage", None),
         "stalled": bool(getattr(job, "stalled", False)),
         "cancel_reason": getattr(job, "cancel_reason", None),
+        # the propagated trace id (ISSUE 8): links this job's spans in
+        # /3/Timeline back to the request that started it
+        "trace_id": getattr(job, "trace_id", None),
         "ready_for_view": job.status == jobs_mod.DONE,
         "auto_recoverable": False,
     }

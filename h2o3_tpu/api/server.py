@@ -1277,6 +1277,18 @@ class _Handler(BaseHTTPRequestHandler):
             super().log_message(fmt, *args)
 
     def _dispatch(self, method):
+        from h2o3_tpu.telemetry import trace as teletrace
+        # trace propagation (ISSUE 8): accept a W3C traceparent header
+        # (or mint a fresh id), bind it to this handler thread for the
+        # whole request — every span/job the handler touches inherits
+        # it — and echo it back on the response
+        self._trace_id = teletrace.parse_traceparent(
+            self.headers.get(teletrace.TRACEPARENT_HEADER)) \
+            or teletrace.new_trace_id()
+        with teletrace.trace_context(self._trace_id):
+            self._dispatch_traced(method)
+
+    def _dispatch_traced(self, method):
         parsed = urllib.parse.urlparse(self.path)
         path = parsed.path
         params = {k: v[0] for k, v in
@@ -1347,10 +1359,19 @@ class _Handler(BaseHTTPRequestHandler):
                           "exception_type": "NotFound", "values": {},
                           "stacktrace": []})
 
+    def _trace_headers(self):
+        tid = getattr(self, "_trace_id", None)
+        if tid:
+            from h2o3_tpu.telemetry import trace as teletrace
+            self.send_header(teletrace.TRACEPARENT_HEADER,
+                             teletrace.format_traceparent(tid))
+            self.send_header("X-H2O3-Trace-Id", tid)
+
     def _reply_raw(self, status, data: bytes, ctype: str):
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        self._trace_headers()
         self.end_headers()
         if self.command != "HEAD":
             self.wfile.write(data)
@@ -1360,6 +1381,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        self._trace_headers()
         for k, v in (headers or {}).items():
             self.send_header(k, str(v))
         self.end_headers()
@@ -1801,13 +1823,31 @@ def _timeline(params, body):
             "events": out}
 
 
+def _cluster_prometheus_raw():
+    """Merged cluster scrape rendered as exposition text — the one
+    spelling behind ``/metrics?scope=cluster`` and
+    ``/3/Telemetry/cluster?format=prometheus``."""
+    from h2o3_tpu import telemetry
+    samples, _meta = telemetry.cluster_samples()
+    return {"__raw": telemetry.prometheus_text(samples=samples).encode(),
+            "__content_type": "text/plain; version=0.0.4; charset=utf-8"}
+
+
 @route("GET", "/metrics")
 def _metrics(params, body):
     """Prometheus exposition of the process-wide telemetry registry
     (text format 0.0.4) — counters/gauges/histograms from every
-    pipeline plus the XLA compile/cache/transfer collectors."""
+    pipeline plus the XLA compile/cache/transfer collectors.
+
+    ``?scope=cluster`` merges peer-process snapshots (peer list from
+    H2O3_TELEMETRY_PEERS; counters sum, histograms bucket-merge, gauges
+    get a ``process=`` label) through the SAME formatter. The default
+    scope never touches the aggregation path — single-process output is
+    bit-identical to PR 4/7."""
     from h2o3_tpu import telemetry
     telemetry.install()
+    if (params.get("scope") or "").lower() == "cluster":
+        return _cluster_prometheus_raw()
     return {"__raw": telemetry.prometheus_text().encode(),
             "__content_type": "text/plain; version=0.0.4; charset=utf-8"}
 
@@ -1821,6 +1861,35 @@ def _telemetry_snapshot(params, body):
     telemetry.install()
     return {"__meta": {"schema_version": 3, "schema_name": "TelemetryV3"},
             **telemetry.telemetry_snapshot()}
+
+
+@route("GET", "/3/Telemetry/snapshot")
+def _telemetry_process_snapshot(params, body):
+    """THIS process's registry + finished-span ring as one mergeable
+    snapshot — the wire format peers pull for the cluster aggregation
+    (telemetry/snapshot.py). ``n`` bounds the serialized span count."""
+    from h2o3_tpu import telemetry
+    telemetry.install()
+    n = int(params.get("n", 2048) or 2048)
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "TelemetrySnapshotV3"},
+            **telemetry.local_snapshot(max_spans=n)}
+
+
+@route("GET", "/3/Telemetry/cluster")
+def _telemetry_cluster(params, body):
+    """Cluster-merged telemetry: this process + every peer in
+    H2O3_TELEMETRY_PEERS (counters summed, histograms bucket-merged,
+    gauges labeled ``process=``). ``?format=prometheus`` renders the
+    merged samples as exposition text instead of the JSON map. Dead
+    peers are reported in ``peers_failed``, never fatal."""
+    from h2o3_tpu import telemetry
+    telemetry.install()
+    if (params.get("format") or "").lower() == "prometheus":
+        return _cluster_prometheus_raw()
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "TelemetryClusterV3"},
+            **telemetry.cluster_snapshot()}
 
 
 @route("GET", "/3/Profiler")

@@ -89,7 +89,7 @@ class Vec:
         if self._dev is None:
             return
         arr = np.asarray(jax.device_get(self._dev))
-        record_d2h(arr.nbytes)
+        record_d2h(arr.nbytes, fallback="frame")
         self._spilled = (arr, getattr(self._dev, "sharding", None))
         self._dev = None
         self._memblock = None
@@ -316,7 +316,7 @@ class Vec:
         # the transfer moves the PADDED device buffer — count what
         # actually crossed, not the sliced view (padding dominates on
         # small sharded frames)
-        record_d2h(full.nbytes)
+        record_d2h(full.nbytes, fallback="frame")
         return full[: self.nrow]
 
     def to_strings(self) -> np.ndarray:
@@ -395,7 +395,7 @@ def batch_device_put(columns, fill, dtype, nrow: int, mesh=None):
     else:
         for j in range(len(columns)):
             _pack(j)
-    record_h2d(mat.nbytes)
+    record_h2d(mat.nbytes, fallback="frame")
     dev = _resilient_put(mat, mesh)
     return [dev[:, j] for j in range(len(columns))]
 
@@ -414,5 +414,5 @@ def _pad_and_put(arr: np.ndarray, nrow: int, fill, mesh):
     plen = padded_len(nrow, mesh)
     if plen != nrow:
         arr = np.concatenate([arr, np.full(plen - nrow, fill, dtype=arr.dtype)])
-    record_h2d(arr.nbytes)
+    record_h2d(arr.nbytes, fallback="frame")
     return _resilient_put(arr, mesh)

@@ -215,6 +215,7 @@ class ChunkDeviceStreamer:
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
+        from h2o3_tpu import telemetry
         from h2o3_tpu.parallel.mesh import DATA_AXIS, padded_len
         order = sorted(self._devs)
         offs: Dict[int, int] = {}
@@ -241,17 +242,23 @@ class ChunkDeviceStreamer:
                     self._aligned_rows += e - s
                 else:
                     # boundary fragment (or a home misprediction from
-                    # uneven rows-per-byte): one D2D move, not H2D
+                    # uneven rows-per-byte): one D2D move, not H2D —
+                    # counted (ISSUE 8): these moves used to escape the
+                    # transfer counters, hiding a chunk-home mismap
                     self._moved_rows += e - s
+                    telemetry.record_d2d(piece.nbytes, pipeline="ingest")
                     piece = jax.device_put(piece, dev_d)
                 parts.append(piece)
             if hi > nrow:          # pad tail rows of the last shard(s)
                 pad = np.full((hi - max(lo, nrow), C), np.nan, np.float32)
+                telemetry.record_h2d(pad.nbytes, pipeline="ingest")
                 parts.append(jax.device_put(pad, dev_d))
             shard = (parts[0] if len(parts) == 1
                      else jnp.concatenate(parts, axis=0))
             shard = jax.device_put(shard, dev_d)   # commit
             for dev in self.part.shard_devices(d):  # model-axis replicas
+                if dev != dev_d:
+                    telemetry.record_d2d(shard.nbytes, pipeline="ingest")
                 by_dev[dev] = (shard if dev == dev_d
                                else jax.device_put(shard, dev))
             self._shard_assemble_s[d] += time.perf_counter() - td0

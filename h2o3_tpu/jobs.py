@@ -157,6 +157,13 @@ class Job:
         self._cancel_requested = False
         self.cancel_reason: Optional[str] = None
         self._thread: Optional[threading.Thread] = None
+        # trace propagation (ISSUE 8): capture the creating thread's
+        # bound trace id (the REST handler set it from the traceparent
+        # header) — or mint one — so a background build's spans and the
+        # /3/Jobs entry link back to the request that started it
+        from h2o3_tpu.telemetry import trace as _trace
+        self.trace_id: str = _trace.current_trace_id() or \
+            _trace.new_trace_id()
         # supervision state: every progress write is a heartbeat
         self.max_runtime_secs = float(max_runtime_secs or 0.0)
         self.stall_timeout_secs = (_stall_default()
@@ -216,8 +223,12 @@ class Job:
 
     def run(self, fn: Callable[["Job"], Any], background: bool = False) -> "Job":
         def body():
+            # re-bind the creator's trace id on the worker thread so
+            # every span the build records carries it
+            from h2o3_tpu.telemetry import trace as _trace
             try:
-                self.result = fn(self)
+                with _trace.trace_context(self.trace_id):
+                    self.result = fn(self)
                 self.status = DONE if not self._cancel_requested else CANCELLED
             except JobCancelled:
                 self.status = CANCELLED

@@ -409,6 +409,15 @@ class H2ORandomForestEstimator(ModelBuilder):
                 from h2o3_tpu.log import warn
                 warn("drf: in-training checkpoint commit failed: %s", e)
 
+        # per-shard collective/straggler observation (ISSUE 8): chunk
+        # k's output shards are watched AFTER chunk k+1 is dispatched,
+        # so the host block lands where the device is already busy
+        from h2o3_tpu.parallel.mesh import partitioner
+        from h2o3_tpu.parallel.shardstats import merge_observations
+        from h2o3_tpu import telemetry
+        partn = partitioner(mesh)
+        shard_obs = []
+        pending_obs = None            # (prev chunk_trees, t_disp)
         t0 = time.time()
         while built < ntrees_new:
             # bucket-rounded chunk lengths (models/gbm.py): ntrees
@@ -439,12 +448,19 @@ class H2ORandomForestEstimator(ModelBuilder):
                 oob_num, oob_cnt, chunk_trees = retry_transient(
                     _dispatch, site="train.execute",
                     attempts=1 if donate else 3)
+                t_disp = time.perf_counter()
             except BaseException:
                 if ckpt_on and built > 0:
                     # leave a resumable checkpoint at the committed
                     # prefix before the failure propagates
                     commit_ckpt()
                 raise
+            if pending_obs is not None:
+                shard_obs.append(partn.observe_step(
+                    pending_obs[0], pending_obs[1], algo=self.algo))
+                pending_obs = None
+            if nd > 1 and telemetry.enabled():
+                pending_obs = (chunk_trees, t_disp)
             all_trees.append((chunk_trees, c))
             built += c
             trees_since_ckpt += c
@@ -455,6 +471,11 @@ class H2ORandomForestEstimator(ModelBuilder):
             job.set_progress(built / ntrees_new)
             if job.cancel_requested:
                 break
+        if pending_obs is not None:
+            # the final chunk: the loop has nothing left to overlap, so
+            # this is the block_until_ready below, observed per shard
+            shard_obs.append(partn.observe_step(
+                pending_obs[0], pending_obs[1], algo=self.algo))
         jax.block_until_ready(oob_cnt)
         t_loop = time.time() - t0
 
@@ -482,6 +503,9 @@ class H2ORandomForestEstimator(ModelBuilder):
             "n_data": nd, "n_model": n_model_shards(mesh),
             "model_axis_split_search": bool(
                 n_model_shards(mesh) > 1 and spmd_enabled())}
+        collective = merge_observations(shard_obs)
+        if collective is not None:
+            model.output["spmd"]["collective"] = collective
         # OOB metrics as training metrics (reference DRF semantics:
         # "training" numbers are out-of-bag when sample_rate < 1)
         self._oob_metrics(model, spec, K, oob_num, oob_cnt)

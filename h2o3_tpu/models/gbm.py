@@ -35,7 +35,7 @@ from h2o3_tpu.ops.binning import (CodesView, bin_matrix_device,
                                   digitize_with_edges, make_codes_view)
 from h2o3_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS, current_mesh,
                                     n_data_shards, n_model_shards,
-                                    spmd_enabled)
+                                    partitioner, spmd_enabled)
 from h2o3_tpu.resilience import retry_transient
 
 GBM_DEFAULTS: Dict = dict(
@@ -693,6 +693,12 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         disp = 0                # dispatched trees (committed + in flight)
         inflight = None         # last dispatched, not yet committed chunk
         stopped = False
+        # per-shard collective/straggler observations (ISSUE 8): the
+        # commit point sits one chunk behind the dispatch frontier, so
+        # watching the committed chunk's output shards there costs the
+        # pipeline nothing the score fetch wasn't already paying
+        shard_obs = []
+        partn = partitioner(mesh)
         jax.block_until_ready(margin)
 
         def commit_ckpt(cur_margin):
@@ -772,6 +778,12 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                 nm, nv, chunk_trees = retry_transient(
                     _dispatch, site="train.execute",
                     attempts=1 if donate else 3)
+                # dispatch is async — this clock starts when the chunk
+                # is enqueued, not when it completes, so THIS chunk's
+                # cold-bucket compile stays out of its own step numbers;
+                # a later chunk's compile delaying the observation is
+                # caught by shardstats' staleness check instead
+                t_disp = time.perf_counter()
             except BaseException:
                 # commit the already-computed in-flight chunk and leave
                 # a resumable checkpoint before the error propagates —
@@ -797,6 +809,10 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                 all_trees.append((inflight["trees"], inflight["c"]))
                 built += inflight["c"]
                 trees_since_ckpt += inflight["c"]
+                if nd > 1 and telemetry.enabled():
+                    shard_obs.append(partn.observe_step(
+                        inflight["trees"], inflight["t_disp"],
+                        algo=self.algo))
                 if score_each:
                     t_s0 = time.time()
                     keeper.record(self._score_entry_fetch(inflight["pend"]))
@@ -812,7 +828,8 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                 if ckpt_on and trees_since_ckpt >= ckpt_interval:
                     commit_ckpt(margin)   # margin = committed chunk's
                     trees_since_ckpt = 0
-            inflight = {"trees": chunk_trees, "c": c, "pend": pend}
+            inflight = {"trees": chunk_trees, "c": c, "pend": pend,
+                        "t_disp": t_disp}
             margin, vmargin = nm, nv
             disp += c
             lr *= anneal ** c
@@ -825,6 +842,10 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             all_trees.append((inflight["trees"], inflight["c"]))
             built += inflight["c"]
             trees_since_ckpt += inflight["c"]
+            if nd > 1 and telemetry.enabled():
+                shard_obs.append(partn.observe_step(
+                    inflight["trees"], inflight["t_disp"],
+                    algo=self.algo))
             if score_each:
                 t_s0 = time.time()
                 keeper.record(self._score_entry_fetch(inflight["pend"]))
@@ -867,6 +888,12 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             "n_data": nd, "n_model": n_model_shards(mesh),
             "model_axis_split_search": bool(
                 n_model_shards(mesh) > 1 and spmd_enabled())}
+        # collective/straggler attribution for the scaling verdict
+        # (tools/multichip_bench.py reads this per point)
+        from h2o3_tpu.parallel.shardstats import merge_observations
+        collective = merge_observations(shard_obs)
+        if collective is not None:
+            model.output["spmd"]["collective"] = collective
         return model
 
     def _train_streaming(self, spec: TrainingSpec, valid_spec, dist_name,

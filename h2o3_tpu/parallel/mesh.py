@@ -213,6 +213,18 @@ class DataParallelPartitioner:
         per = padded_rows // self.n_data
         return [(d * per, (d + 1) * per) for d in range(self.n_data)]
 
+    # -- per-shard step observation (collective/straggler metrics) ------
+
+    def observe_step(self, out, t_dispatch: float, *, algo: str = "train"):
+        """Record per-shard completion/collective-wait metrics for one
+        dispatched sharded step (parallel/shardstats.py); the seam the
+        GBM/DRF chunk loops call at their commit points. No-op (None)
+        on single-shard meshes or with telemetry disabled."""
+        if self.n_data <= 1:
+            return None
+        from h2o3_tpu.parallel.shardstats import observe_sharded_step
+        return observe_sharded_step(out, t_dispatch, algo=algo)
+
 
 def partitioner(mesh: Mesh | None = None) -> DataParallelPartitioner:
     return DataParallelPartitioner(mesh or current_mesh())

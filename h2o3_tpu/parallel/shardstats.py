@@ -1,0 +1,265 @@
+"""Per-shard step timing: compute vs collective-wait attribution.
+
+The sharded GBM/DRF chunk step is bulk-synchronous: every data shard
+builds its local histograms, the per-level ``psum`` synchronizes, and
+shards that finish their local compute early WAIT at the collective for
+the slowest one. When the multichip bench's scaling verdict fails, the
+raw rows/s number cannot say whether the loss is collective latency or
+a straggling shard — this module makes that attributable.
+
+Single-controller JAX gives no per-shard timer inside the compiled
+program, so the split is HOST-OBSERVED: after a chunk is dispatched,
+each addressable output shard's readiness is watched independently and
+per-shard completion times are recorded relative to the dispatch.
+Interpretation (standard external-observer attribution):
+
+- a shard's step time approximates its compute + its share of the
+  collectives;
+- ``wait(shard) = slowest - shard`` is a lower bound on the time that
+  shard idled at the final barrier for the straggler — in a perfectly
+  balanced step every wait is ~0;
+- ``straggler_ratio = slowest / median`` is the headline imbalance
+  number (1.0 = balanced; 2.0 = the slowest shard doubles the step).
+
+Metrics (all labeled ``{algo=...}``):
+
+- ``h2o3_shard_step_ms``      histogram — per-shard completion times
+- ``h2o3_collective_wait_ms`` histogram — per-shard barrier waits
+- ``h2o3_straggler_ratio``    gauge     — slowest/median, last observed
+
+The observation itself blocks the host on the chunk's outputs, so
+callers place it where the host would block anyway (the pipelined
+loop's COMMIT point, one chunk behind the dispatch frontier) and gate
+it on ``n_data > 1``; with ``H2O3_TELEMETRY=0`` it is a checked no-op
+(one attribute load — the sharded train path stays overhead-free).
+Shards already done at the FIRST poll sweep are CENSORED — their
+elapsed times measure whatever host work delayed the observation (a
+cold next-bucket compile, a checkpoint commit), not their step — and
+are excluded from the metrics; the slowest shards are by construction
+live, so the ratio/waits over live completions stay honest lower
+bounds. A chunk with fewer than two live shards is STALE: counted
+(``chunks_stale``) but never recorded.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from h2o3_tpu.telemetry.registry import on_reset
+
+# is_ready() poll cadence: start fine (preserves completion ORDER for
+# sub-ms steps) and back off geometrically toward 1ms so a multi-second
+# chunk costs ~thousands of polls, not a sustained 20kHz host spin that
+# would steal cycles from the very compute being measured
+_POLL_SLEEP_MIN_S = 2e-5
+_POLL_SLEEP_MAX_S = 1e-3
+
+# hot-path handle cache (slow path: resolve once per algo, hold the
+# handles — registered with registry.on_reset like the span/collector
+# caches so test isolation cannot leave stale handles)
+_ALGO_HANDLES: Dict[str, tuple] = {}
+on_reset(_ALGO_HANDLES.clear)
+
+
+def _algo_handles(algo: str) -> tuple:
+    h = _ALGO_HANDLES.get(algo)
+    if h is None:
+        from h2o3_tpu import telemetry
+        lab = {"algo": algo}
+        h = _ALGO_HANDLES[algo] = (
+            telemetry.histogram(
+                "h2o3_shard_step_ms", lab,
+                help="per-shard chunk completion times (ms from "
+                     "dispatch)",
+                bounds=(0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0, 500.0,
+                        1000.0, 5000.0, 30_000.0)),
+            telemetry.histogram(
+                "h2o3_collective_wait_ms", lab,
+                help="per-shard barrier wait for the slowest shard (ms)",
+                bounds=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0,
+                        100.0, 500.0, 5000.0)),
+            telemetry.gauge(
+                "h2o3_straggler_ratio", lab,
+                help="slowest/median shard step time, last observed "
+                     "chunk"),
+        )
+    return h
+
+
+def _first_array(out):
+    """First jax.Array leaf of a pytree (the chunk step returns dicts
+    of tree arrays / margin arrays — any leaf shares the program's
+    completion profile per device)."""
+    import jax
+    for leaf in jax.tree_util.tree_leaves(out):
+        if isinstance(leaf, jax.Array):
+            return leaf
+    return None
+
+
+def _shard_ready_times(shards, t0: float):
+    """Per-shard seconds-from-dispatch until each shard's buffer was
+    ready, plus the set of CENSORED shard indices. Prefers non-blocking
+    ``is_ready()`` polling (preserves the true completion ORDER); falls
+    back to sequential blocking (exact for the slowest shard,
+    order-biased for the rest).
+
+    A shard already ready on the first poll sweep is censored: it
+    completed at some unknown point while the host was busy between
+    dispatch and observation (e.g. a cold compile of the next chunk
+    bucket), so its elapsed time measures that host work, not its
+    step."""
+    import jax
+    datas = [s.data for s in shards]
+    out: List[Optional[float]] = [None] * len(datas)
+    censored: set = set()
+    pollable = all(hasattr(d, "is_ready") for d in datas)
+    if pollable:
+        remaining = set(range(len(datas)))
+        sleep_s = _POLL_SLEEP_MIN_S
+        first_sweep = True
+        try:
+            while remaining:
+                now = time.perf_counter() - t0
+                progressed = False
+                for i in list(remaining):
+                    if datas[i].is_ready():
+                        out[i] = now
+                        remaining.discard(i)
+                        progressed = True
+                        if first_sweep:
+                            censored.add(i)
+                first_sweep = False
+                if remaining:
+                    time.sleep(sleep_s)
+                    # adaptive backoff: any completion re-arms the fine
+                    # cadence (shards often finish in a burst)
+                    sleep_s = _POLL_SLEEP_MIN_S if progressed else \
+                        min(sleep_s * 2, _POLL_SLEEP_MAX_S)
+        except Exception:
+            pollable = False
+    if not pollable:
+        censored = set()
+        for i, d in enumerate(datas):
+            if out[i] is None:
+                jax.block_until_ready(d)
+                out[i] = time.perf_counter() - t0
+    return [float(t) for t in out], censored
+
+
+def observe_sharded_step(out, t_dispatch: float, *, algo: str = "gbm"
+                         ) -> Optional[Dict[str, float]]:
+    """Record per-shard step/wait metrics for one dispatched chunk whose
+    output (array or pytree) is ``out``; ``t_dispatch`` is the
+    ``time.perf_counter()`` reading taken right after dispatch returned.
+    Returns the summary dict (also accumulated by the train drivers into
+    ``model.output['spmd']``) or None when there is nothing to observe
+    (telemetry off, single shard, host fallback arrays)."""
+    from h2o3_tpu import telemetry
+    if not telemetry.enabled():
+        return None
+    try:
+        return _observe(out, t_dispatch, algo)
+    except Exception as e:
+        # observation must NEVER sink the run: an async device error
+        # surfacing at is_ready()/block_until_ready here belongs to the
+        # train loop's own fetch/commit point (where PR-6's
+        # checkpoint-on-failure handling lives), not to telemetry —
+        # swallow, let the real failure surface there
+        import warnings
+        warnings.warn(f"shard observation skipped: {e!r}")
+        return None
+
+
+def _observe(out, t_dispatch: float, algo: str
+             ) -> Optional[Dict[str, float]]:
+    arr = _first_array(out)
+    if arr is None:
+        return None
+    try:
+        shards = list(arr.addressable_shards)
+    except Exception:
+        return None
+    if len(shards) <= 1 or len({s.device for s in shards}) <= 1:
+        return None
+    times, censored = _shard_ready_times(shards, t_dispatch)
+    n_total = len(times)
+    live = [t for i, t in enumerate(times) if i not in censored]
+    if len(live) < 2:
+        # (nearly) every shard was done before the first poll: the host
+        # work between dispatch and observation (cold compile of the
+        # next chunk bucket, a checkpoint commit) ate the window.
+        # Censored elapsed times measure that host work, and fewer than
+        # two live completions leave no order to compare — recording
+        # would write host time into the step histogram and a
+        # fabricated ~1.0 into the straggler gauge, exactly the masking
+        # this module exists to prevent. Count it, record nothing.
+        return {"n_shards": n_total, "stale": True}
+    # a partially censored step still attributes honestly over the LIVE
+    # shards: the slowest shards are by construction live (they were
+    # still running when polling started), so slowest is genuine and
+    # the ratio/waits over live completions are lower bounds on the
+    # true imbalance — only the already-finished fast tail is unknown
+    times_ms = sorted(t * 1e3 for t in live)
+    slowest = times_ms[-1]
+    n = len(times_ms)
+    median = (times_ms[n // 2] if n % 2 else
+              0.5 * (times_ms[n // 2 - 1] + times_ms[n // 2]))
+    ratio = slowest / median if median > 0 else 1.0
+    waits = [slowest - t for t in times_ms]
+    step_h, wait_h, ratio_g = _algo_handles(algo)
+    for t in times_ms:
+        step_h.observe(t)
+    for w in waits:
+        wait_h.observe(w)
+    ratio_g.set(ratio)
+    mean_wait = sum(waits) / n
+    return {
+        "n_shards": n_total,
+        "shards_censored": len(censored),
+        "slowest_ms": round(slowest, 3),
+        "median_ms": round(median, 3),
+        "straggler_ratio": round(ratio, 4),
+        "collective_wait_ms": round(mean_wait, 3),
+        # share of the step the average shard spent waiting at the
+        # barrier — the number the multichip bench surfaces next to a
+        # failed scaling verdict
+        "collective_wait_share": round(mean_wait / slowest, 4)
+        if slowest > 0 else 0.0,
+    }
+
+
+def merge_observations(obs: List[Dict[str, float]]
+                       ) -> Optional[Dict[str, float]]:
+    """Fold per-chunk observations into one per-train summary (what
+    lands in ``model.output['spmd']``): waits/steps average over
+    chunks, the straggler ratio reports the worst chunk. Stale
+    observations (step finished before the host could watch it) carry
+    no timing signal — they are counted in ``chunks_stale`` but
+    excluded from every aggregate; a train where EVERY chunk was stale
+    reports only the counts."""
+    obs = [o for o in obs if o]
+    stale = [o for o in obs if o.get("stale")]
+    obs = [o for o in obs if not o.get("stale")]
+    if not obs:
+        if not stale:
+            return None
+        return {"chunks_observed": 0, "chunks_stale": len(stale),
+                "n_shards": stale[0]["n_shards"]}
+    n = len(obs)
+    return {
+        "chunks_observed": n,
+        "chunks_stale": len(stale),
+        "n_shards": obs[0]["n_shards"],
+        "shards_censored": sum(o.get("shards_censored", 0)
+                               for o in obs),
+        "straggler_ratio": round(max(o["straggler_ratio"]
+                                     for o in obs), 4),
+        "straggler_ratio_mean": round(
+            sum(o["straggler_ratio"] for o in obs) / n, 4),
+        "collective_wait_ms": round(
+            sum(o["collective_wait_ms"] for o in obs) / n, 3),
+        "collective_wait_share": round(
+            sum(o["collective_wait_share"] for o in obs) / n, 4),
+        "slowest_ms": round(max(o["slowest_ms"] for o in obs), 3),
+    }

@@ -27,6 +27,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from h2o3_tpu import telemetry
+from h2o3_tpu.telemetry import trace as teletrace
 from h2o3_tpu.serve.stats import ServeStats
 
 
@@ -67,7 +68,7 @@ class ServeClosedError(ServeError):
 
 class _Request:
     __slots__ = ("rows", "n", "t_enqueue", "t_wall", "deadline", "event",
-                 "results", "error", "abandoned", "columnar")
+                 "results", "error", "abandoned", "columnar", "trace_id")
 
     def __init__(self, rows: Sequence[Dict[str, Any]], deadline: float,
                  columnar: bool = False):
@@ -81,6 +82,12 @@ class _Request:
         self.error: Optional[BaseException] = None
         self.abandoned = False
         self.columnar = columnar
+        # trace linkage (ISSUE 8): the submitting thread's bound trace
+        # (the REST handler set it from the traceparent header). Stays
+        # None for embedded callers — minting an id per request would
+        # put an os.urandom syscall on the µs-budget submit path for an
+        # id nothing downstream could have propagated anyway
+        self.trace_id = teletrace.current_trace_id()
 
 
 class MicroBatcher:
@@ -171,17 +178,32 @@ class MicroBatcher:
             if not already_counted:
                 self.stats.record_timeout()
             self.stats.queue_delta(-req.n)
+            # a deadline blowout is slower than every successful
+            # request — without an exemplar the slow-request list would
+            # show only benign latencies during the exact stall the
+            # operator is investigating
+            self.stats.record_failed_exemplar(
+                (time.perf_counter() - req.t_enqueue) * 1e3, req.n,
+                req.trace_id, "deadline")
             raise ServeDeadlineError(
                 f"request deadline ({timeout_s * 1e3:.0f} ms) expired "
                 f"before results were ready")
         self.stats.queue_delta(-req.n)
         if req.error is not None:
+            self.stats.record_failed_exemplar(
+                (time.perf_counter() - req.t_enqueue) * 1e3, req.n,
+                req.trace_id, type(req.error).__name__)
             raise req.error
         lat_s = time.perf_counter() - req.t_enqueue
-        self.stats.record_request(lat_s * 1e3, req.n)
-        # root span per client request (submit→resolve wall time)
-        telemetry.record_span("serve.request", req.t_wall, lat_s,
-                              model=self.stats.model, rows=req.n)
+        self.stats.record_request(lat_s * 1e3, req.n,
+                                  trace_id=req.trace_id)
+        # root span per client request (submit→resolve wall time),
+        # bound to the request's trace so the /3/Timeline entry, the
+        # stats slow-request exemplar and the client's traceparent
+        # response header all carry the SAME id
+        with teletrace.trace_context(req.trace_id):
+            telemetry.record_span("serve.request", req.t_wall, lat_s,
+                                  model=self.stats.model, rows=req.n)
         return req.results
 
     # -- batcher thread -------------------------------------------------
@@ -282,6 +304,18 @@ class MicroBatcher:
             sp_batch = telemetry.open_span("serve.batch",
                                            model=self.stats.model,
                                            rows=sum(r.n for r in batch))
+            if sp_batch is not None:
+                # the coalesced requests' trace ids ride ON the batch
+                # span (bounded — a 512-request tick must not grow an
+                # unbounded attr), and the first one becomes the span's
+                # own trace link
+                tids = [r.trace_id for r in batch if r.trace_id]
+                if tids:
+                    sp_batch.trace_id = tids[0]
+                    sp_batch.attrs["trace_ids"] = ",".join(tids[:16])
+                    if len(tids) > 16:
+                        sp_batch.attrs["trace_ids"] += \
+                            f",+{len(tids) - 16}"
             X, batch, n = self._encode_batch(batch)
             if not batch:
                 # every request failed to encode: the batch still shows

@@ -24,7 +24,9 @@ couple of array writes.
 """
 from __future__ import annotations
 
+import heapq
 import threading
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -35,6 +37,18 @@ from h2o3_tpu.telemetry.registry import Registry
 # ring-buffer length for the latency reservoir: enough for stable p99
 # estimates over the recent window without unbounded growth
 _RESERVOIR = 4096
+
+# slow-request exemplars kept per deployment: the top-k requests by
+# latency, each carrying its trace id — /3/Serve/stats exposes them so a
+# p99 spike resolves to concrete trace ids chaseable through
+# /3/Timeline (ISSUE 8)
+_SLOW_K = 10
+
+# exemplar generations also rotate on wall clock, not just reservoir
+# wrap: at low QPS 4096 requests can take DAYS, and a cold-start
+# compile-era top-k would mask every later spike until then (their
+# trace ids pointing at spans long evicted from the ring)
+_SLOW_WINDOW_S = 900.0
 
 STAGES = ("encode", "queue", "device", "decode")
 
@@ -104,6 +118,16 @@ class ServeStats:
         self._mu = threading.Lock()
         self._lat_ms = np.zeros(_RESERVOIR, np.float64)
         self._lat_n = 0            # total recorded (ring index = n % size)
+        # top-k slow-request exemplars: a min-heap of
+        # (latency_ms, seq, info) — seq breaks latency ties so the heap
+        # never compares the info dicts. Two generations: the previous
+        # reservoir window's heap is kept until the next wrap, so a
+        # spike stays scrapeable for at least one full window even at
+        # high QPS (an instant clear would wipe it before any poll)
+        self._slow: List[tuple] = []
+        self._slow_prev: List[tuple] = []
+        self._slow_seq = 0
+        self._slow_t0 = time.monotonic()   # current generation's start
         # queue depth is an INSTANTANEOUS property of this deployment's
         # batcher, not a monotonic series: keep the authoritative value
         # per instance (fresh at redeploy, immune to a drained old
@@ -125,7 +149,8 @@ class ServeStats:
 
     # -- mutation (hot path) -------------------------------------------
 
-    def record_request(self, latency_ms: float, rows: int):
+    def record_request(self, latency_ms: float, rows: int,
+                       trace_id: Optional[str] = None):
         # reservoir honors the same enabled flag as the counters: a
         # runtime set_enabled(False) freezes the WHOLE stats surface
         # consistently instead of a moving p50 over frozen counters
@@ -133,9 +158,57 @@ class ServeStats:
             with self._mu:
                 self._lat_ms[self._lat_n % _RESERVOIR] = latency_ms
                 self._lat_n += 1
+                self._note_slow_locked(self._lat_n % _RESERVOIR == 0,
+                                       latency_ms, rows, trace_id)
         self._requests.inc()
         self._rows.inc(rows)
         self._latency.observe(latency_ms)
+
+    def record_failed_exemplar(self, latency_ms: float, rows: int,
+                               trace_id: Optional[str],
+                               error: str):
+        """Failed requests (deadline blowouts, device errors) are by
+        construction among the slowest responses — exactly the ones a
+        latency investigation chases — so they enter the slow-request
+        exemplars (flagged ``error=``) WITHOUT touching the
+        success-only latency reservoir, percentile estimates or
+        request counters (those keep PR-3 semantics; failures are
+        counted by record_error/record_timeout)."""
+        if self._reg.enabled:
+            with self._mu:
+                self._note_slow_locked(False, latency_ms, rows,
+                                       trace_id, error)
+
+    def _note_slow_locked(self, wrapped: bool, latency_ms: float,
+                          rows: int, trace_id: Optional[str],
+                          error: Optional[str] = None):
+        if wrapped or \
+                time.monotonic() - self._slow_t0 >= _SLOW_WINDOW_S:
+            # age the exemplars with the reservoir window OR the wall
+            # clock, whichever wraps first: an all-time top-k would let
+            # cold-start compile latencies mask every later spike (and
+            # their trace ids point at spans long evicted from the
+            # ring). The wrap trigger is passed in by record_request
+            # (tied to reservoir advancement) so failure-only traffic
+            # cannot spuriously rotate at _lat_n == 0.
+            self._slow_prev = self._slow
+            self._slow = []
+            self._slow_t0 = time.monotonic()
+        # steady-state fast path: beyond the one monotonic read for
+        # generation aging above, requests that cannot enter the top-k
+        # allocate nothing
+        if len(self._slow) < _SLOW_K or latency_ms > self._slow[0][0]:
+            self._slow_seq += 1
+            info = {"trace_id": trace_id,
+                    "latency_ms": round(float(latency_ms), 3),
+                    "rows": int(rows), "time": time.time()}
+            if error is not None:
+                info["error"] = error
+            entry = (float(latency_ms), self._slow_seq, info)
+            if len(self._slow) < _SLOW_K:
+                heapq.heappush(self._slow, entry)
+            else:
+                heapq.heapreplace(self._slow, entry)
 
     def record_batch(self, live_rows: int, padded_rows: int,
                      stage_ms: Dict[str, float]):
@@ -232,6 +305,16 @@ class ServeStats:
     def percentile_ms(self, q: float) -> Optional[float]:
         return self.percentiles_ms([q])[0]
 
+    def slow_requests(self) -> List[Dict]:
+        """The top-k slowest requests (latency desc), each with its
+        trace id — the exemplars /3/Serve/stats exposes so a latency
+        spike resolves to concrete /3/Timeline spans."""
+        with self._mu:
+            entries = [e[2] for e in self._slow] + \
+                      [e[2] for e in self._slow_prev]
+        return sorted(entries,
+                      key=lambda e: -e["latency_ms"])[:_SLOW_K]
+
     def snapshot(self) -> Dict:
         p50, p99 = self.percentiles_ms([50, 99])
         # striped-lock counters have no cross-counter atomic read (the
@@ -259,6 +342,7 @@ class ServeStats:
             "p99_ms": None if p99 is None else round(p99, 3),
             "stage_ms": {s: round(v, 3)
                          for s, v in self.stage_ms.items()},
+            "slow_requests": self.slow_requests(),
         }
 
 

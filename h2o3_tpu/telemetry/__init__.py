@@ -14,27 +14,35 @@ attribute load + branch — guarded by tests/test_telemetry.py's
 ns-budget microbench).
 """
 from h2o3_tpu.telemetry.collectors import (device_get, device_memory_bytes,
-                                           install, installed, record_d2h,
-                                           record_h2d,
+                                           install, installed, record_d2d,
+                                           record_d2h, record_h2d,
                                            sample_device_memory)
 from h2o3_tpu.telemetry.export import (chrome_trace, chrome_trace_bytes,
                                        prometheus_text, telemetry_snapshot)
+from h2o3_tpu.telemetry.profiling import profile
 from h2o3_tpu.telemetry.registry import (Counter, Gauge, Histogram,
                                          Registry, enabled, registry,
                                          set_enabled)
+from h2o3_tpu.telemetry.snapshot import (cluster_samples, cluster_snapshot,
+                                         local_snapshot, merge_snapshots)
 from h2o3_tpu.telemetry.spans import (Span, clear_spans, current_span,
                                       finished_spans, last_error_span,
-                                      open_span,
-                                      record_span, span, stage_seconds)
+                                      open_span, record_span,
+                                      set_ring_capacity, span,
+                                      stage_seconds)
+from h2o3_tpu.telemetry import trace
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "Span",
-    "chrome_trace", "chrome_trace_bytes", "clear_spans", "current_span",
+    "chrome_trace", "chrome_trace_bytes", "clear_spans",
+    "cluster_samples", "cluster_snapshot", "current_span",
     "device_get", "device_memory_bytes", "enabled", "finished_spans", "install",
-    "installed", "last_error_span", "open_span", "prometheus_text",
-    "record_d2h",
+    "installed", "last_error_span", "local_snapshot", "merge_snapshots",
+    "open_span", "profile", "prometheus_text",
+    "record_d2d", "record_d2h",
     "record_h2d", "record_span", "registry", "sample_device_memory",
-    "set_enabled", "span", "stage_seconds", "telemetry_snapshot",
+    "set_enabled", "set_ring_capacity", "span", "stage_seconds",
+    "telemetry_snapshot", "trace",
 ]
 
 

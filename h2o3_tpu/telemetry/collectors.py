@@ -20,7 +20,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional
 
-from h2o3_tpu.telemetry.registry import registry
+from h2o3_tpu.telemetry.registry import on_reset, registry
 
 _INSTALL_LOCK = threading.Lock()
 _INSTALLED = [False]
@@ -94,6 +94,7 @@ def installed() -> bool:
 # handles instead of paying the registry creation mutex per transfer.
 # Cleared by Registry.reset() on the global registry.
 _BYTE_HANDLES: Dict[str, object] = {}
+on_reset(_BYTE_HANDLES.clear)
 
 # pipelines a transfer can be attributed to (the label set is closed so
 # a typo'd span name can't mint unbounded label cardinality)
@@ -124,31 +125,50 @@ def _infer_pipeline() -> Optional[str]:
 
 
 def _record_bytes(direction: str, nbytes: int,
-                  pipeline: Optional[str]) -> None:
+                  pipeline: Optional[str],
+                  fallback: Optional[str] = None) -> None:
     help_ = f"{direction} transfer bytes"
     _byte_counter(f"h2o3_{direction}_bytes_total", help_).inc(float(nbytes))
     p = pipeline if pipeline in _PIPELINES else _infer_pipeline()
+    if p is None and fallback in _PIPELINES:
+        # sharded frame-layer transfers issued with NO span open
+        # (Frame.resharded, ad-hoc host fetches) used to vanish from
+        # the pipeline-labeled counters (ISSUE 8) — the caller's
+        # fallback label catches them WITHOUT overriding span inference
+        p = fallback
     if p is not None:
         _byte_counter(f"h2o3_{direction}_pipeline_bytes_total",
                       f"{direction} transfer bytes by pipeline",
                       p).inc(float(nbytes))
 
 
-def record_h2d(nbytes: int, pipeline: Optional[str] = None) -> None:
+def record_h2d(nbytes: int, pipeline: Optional[str] = None,
+               fallback: Optional[str] = None) -> None:
     """Host→device transfer bytes (batch_device_put / _pad_and_put /
     the streamed chunk uploads). ``pipeline`` attributes the bytes to
     ingest/train/serve/analytics/rapids; when omitted, the open span on
-    the calling thread decides."""
+    the calling thread decides, then ``fallback``."""
     if not registry().enabled:
         return
-    _record_bytes("h2d", nbytes, pipeline)
+    _record_bytes("h2d", nbytes, pipeline, fallback)
 
 
-def record_d2h(nbytes: int, pipeline: Optional[str] = None) -> None:
+def record_d2h(nbytes: int, pipeline: Optional[str] = None,
+               fallback: Optional[str] = None) -> None:
     """Device→host fetch bytes (Vec.to_numpy / spill / device_get)."""
     if not registry().enabled:
         return
-    _record_bytes("d2h", nbytes, pipeline)
+    _record_bytes("d2h", nbytes, pipeline, fallback)
+
+
+def record_d2d(nbytes: int, pipeline: Optional[str] = None) -> None:
+    """Device→device move bytes: the stitched sharded-ingest assembly's
+    boundary-fragment moves and model-axis replica copies (ISSUE 8 —
+    these used to escape the transfer counters entirely, hiding a
+    misaligned chunk-home mapping's real cost)."""
+    if not registry().enabled:
+        return
+    _record_bytes("d2d", nbytes, pipeline)
 
 
 def _tree_nbytes(host) -> int:

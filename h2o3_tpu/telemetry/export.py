@@ -40,11 +40,14 @@ def _num(v: float) -> str:
     return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
 
 
-def prometheus_text() -> str:
-    """Prometheus exposition format 0.0.4 over every registry sample."""
+def prometheus_text(samples: Optional[List[dict]] = None) -> str:
+    """Prometheus exposition format 0.0.4 over every registry sample —
+    or over an explicit sample list (the cluster-merged view from
+    telemetry/snapshot.py renders through the same formatter, so the
+    aggregated output can never drift from the single-process one)."""
     lines: List[str] = []
     seen_header = set()
-    for s in registry().samples():
+    for s in (registry().samples() if samples is None else samples):
         name, kind, labels = s["name"], s["kind"], s["labels"]
         if name not in seen_header:
             seen_header.add(name)
@@ -124,6 +127,8 @@ def chrome_trace(limit: Optional[int] = None) -> Dict[str, object]:
         args = {"span_id": sp.span_id}
         if sp.parent_id:
             args["parent_id"] = sp.parent_id
+        if sp.trace_id:
+            args["trace_id"] = sp.trace_id
         for k, v in sp.attrs.items():
             if isinstance(v, (int, float, str, bool)):
                 args[k] = v

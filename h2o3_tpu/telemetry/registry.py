@@ -282,11 +282,24 @@ class Registry:
             self._families.clear()
             self._collectors.clear()
         if self is _REGISTRY:
-            # the hot-path handle caches hold metrics of THIS registry —
-            # stale handles would silently record into dropped objects
-            from h2o3_tpu.telemetry import collectors, spans
-            spans._HIST_CACHE.clear()
-            collectors._BYTE_HANDLES.clear()
+            # hot-path handle caches hold metrics of THIS registry —
+            # stale handles would silently record into dropped objects.
+            # Each cache registers its clear via on_reset at import
+            for fn in _RESET_HOOKS:
+                fn()
+
+
+_RESET_HOOKS: List[Callable[[], None]] = []
+
+
+def on_reset(fn: Callable[[], None]) -> Callable[[], None]:
+    """Register a callback Registry.reset() runs on the process
+    registry. Modules that cache metric HANDLES (spans, collectors,
+    parallel.shardstats) register their cache's ``.clear`` here at
+    import, so test resets cannot leave handles recording into dropped
+    metric objects — no cross-module reach-ins from reset()."""
+    _RESET_HOOKS.append(fn)
+    return fn
 
 
 def _env_enabled() -> bool:

@@ -1,0 +1,465 @@
+"""Cross-process telemetry aggregation: snapshots, merge, cluster view.
+
+PR 4's registry is deliberately process-local; PR 7 made multi-chip
+SPMD the default train path and the serving-fleet plan runs N serve
+replicas as separate processes — so the cluster debugging surface needs
+ONE merged view. The reference gets this for free from its cloud
+(every node's WaterMeter rides the heartbeat, water/H2O.java CLOUD
+membership); single-controller JAX processes share nothing, so the
+aggregation is pull-based REST:
+
+- ``local_snapshot()`` serializes THIS process's registry (raw, not
+  cumulative, histogram buckets — mergeable) + the finished-span ring
+  as one JSON-able dict; served at ``GET /3/Telemetry/snapshot``.
+- ``merge_snapshots([snap, ...])`` folds N process snapshots into one
+  registry-shaped sample list: counters/histograms SUM (same name +
+  labels; histogram buckets merge bucket-wise when the bounds agree),
+  gauges get a ``process=<id>`` label (a queue depth does not add
+  across processes — label, don't lie).
+- ``cluster_samples()`` pulls every peer's snapshot (peer list from
+  ``H2O3_TELEMETRY_PEERS="host:port,host:port"`` — the env the
+  multihost worker / replica launcher exports) and merges it with the
+  local registry; ``GET /3/Telemetry/cluster`` and
+  ``GET /metrics?scope=cluster`` render it.
+
+Single-process behavior is bit-unchanged: with no peers configured the
+cluster path short-circuits to the local samples (no HTTP, no merge
+pass), and plain ``GET /metrics`` never touches this module.
+``H2O3_TELEMETRY=0`` keeps the whole thing a checked no-op (snapshots
+report ``enabled: false`` with no samples).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+from h2o3_tpu.telemetry import spans
+from h2o3_tpu.telemetry.registry import registry
+
+SNAPSHOT_VERSION = 1
+
+def _env_peer_timeout() -> float:
+    """Peer poll budget (``H2O3_TELEMETRY_PEER_TIMEOUT`` seconds,
+    default 2.0): a dead replica must not stall the live cluster scrape
+    (Prometheus timeouts are seconds-scale). A malformed value falls
+    back instead of breaking import — telemetry loads with the app."""
+    try:
+        t = float(os.environ.get("H2O3_TELEMETRY_PEER_TIMEOUT", "2.0"))
+        return t if t > 0 else 2.0
+    except ValueError:
+        return 2.0
+
+
+PEER_TIMEOUT_S = _env_peer_timeout()
+
+# hard cap on one peer's snapshot body: real snapshots are tens of KB
+# (a few hundred metric families); anything beyond this is a
+# misconfigured peer entry pointing at a non-telemetry service
+PEER_MAX_BYTES = 16 << 20
+
+_MAX_SNAPSHOT_SPANS = 2048
+
+
+def process_identity() -> Dict[str, object]:
+    """Who this snapshot came from. jax.process_index() when the
+    distributed runtime is up (the multihost worker case), else the
+    OS pid — stable within a scrape either way."""
+    ident: Dict[str, object] = {"pid": os.getpid()}
+    try:
+        import jax
+        ident["process_index"] = int(jax.process_index())
+        ident["process_count"] = int(jax.process_count())
+    except Exception:
+        pass
+    import socket
+    try:
+        ident["host"] = socket.gethostname()
+    except OSError:
+        ident["host"] = "?"
+    return ident
+
+
+def _raw_buckets(sample: dict) -> Tuple[List[float], List[int]]:
+    """Cumulative [(le, cum), ...] → (bounds, per-bucket raw counts)
+    including the +Inf bucket — the mergeable wire shape."""
+    bounds, raw, prev = [], [], 0
+    for le, cum in sample["buckets"]:
+        if le != float("inf"):
+            bounds.append(float(le))
+        raw.append(int(cum) - prev)
+        prev = int(cum)
+    return bounds, raw
+
+
+def _cumulate(bounds: List[float], raw: List[int]) -> List[Tuple[float, int]]:
+    out, acc = [], 0
+    for b, c in zip(bounds, raw[:-1]):
+        acc += c
+        out.append((float(b), acc))
+    out.append((float("inf"), acc + (raw[-1] if raw else 0)))
+    return out
+
+
+def local_snapshot(max_spans: int = _MAX_SNAPSHOT_SPANS) -> Dict[str, object]:
+    """This process's registry + finished-span ring as one mergeable
+    JSON-able snapshot (the ``GET /3/Telemetry/snapshot`` body)."""
+    reg = registry()
+    out: Dict[str, object] = {
+        "version": SNAPSHOT_VERSION,
+        "time": time.time(),
+        "enabled": reg.enabled,
+        "process": process_identity(),
+        "samples": [],
+        "spans": [],
+    }
+    if not reg.enabled:
+        return out
+    samples = []
+    for s in reg.samples():
+        e = {"name": s["name"], "kind": s["kind"],
+             "labels": dict(s["labels"]), "help": s.get("help", "")}
+        if s["kind"] == "histogram":
+            bounds, raw = _raw_buckets(s)
+            e.update(sum=float(s["sum"]), count=int(s["count"]),
+                     bounds=bounds, bucket_counts=raw)
+        else:
+            e["value"] = float(s.get("value", 0.0))
+        samples.append(e)
+    out["samples"] = samples
+    ser = []
+    for sp in spans.finished_spans(max_spans):
+        if sp.duration_s is None:
+            continue
+        ser.append({"name": sp.name, "span_id": sp.span_id,
+                    "parent_id": sp.parent_id, "t_wall": sp.t_wall,
+                    "duration_s": sp.duration_s,
+                    "thread_id": sp.thread_id,
+                    "trace_id": sp.trace_id,
+                    "attrs": {k: v for k, v in sp.attrs.items()
+                              if isinstance(v, (int, float, str, bool))}})
+    out["spans"] = ser
+    return out
+
+
+def _proc_label(snap: dict) -> str:
+    """Human-meaningful process label for merged gauges. The jax
+    process_index only identifies anything inside a REAL multi-process
+    runtime (process_count > 1); N standalone serve replicas all report
+    index 0, so they label by pid@host instead."""
+    p = snap.get("process") or {}
+    if int(p.get("process_count", 1) or 1) > 1 and "process_index" in p:
+        return str(p["process_index"])
+    return f"{p.get('pid', '?')}@{p.get('host', '?')}"
+
+
+def merge_snapshots(snaps: List[dict]) -> List[dict]:
+    """Fold N process snapshots into one registry-shaped sample list
+    (the shape ``export.prometheus_text(samples=...)`` renders).
+
+    - counters: summed over processes per (name, labels);
+    - histograms: bucket-wise summed when every process agrees on the
+      bounds (they will — the bounds are compiled in), else kept as
+      per-process series labeled ``process=``;
+    - gauges: always labeled ``process=`` (instantaneous per-process
+      state does not add — a summed queue depth would be a lie).
+    """
+    counters: Dict[Tuple, dict] = {}
+    hists: Dict[Tuple, dict] = {}
+    gauges: List[dict] = []
+    # exposition requires every line of one metric NAME contiguous —
+    # order families by first appearance, and group every series of a
+    # family together even when a later peer contributes new label sets.
+    # A name's kind is fixed by its FIRST appearance; a peer reporting
+    # the same name under a different kind (version skew) falls back to
+    # per-process series like the histogram bound mismatch below —
+    # merging across kinds would emit duplicate/orphaned series
+    fam_order: List[Tuple[str, str]] = []      # (kind-tag, name)
+    fam_keys: Dict[str, List[Tuple]] = {}      # name -> series keys
+    fam_kind: Dict[str, str] = {}              # name -> kind-tag
+    skew: List[dict] = []                      # kind-skew fallback
+
+    # process labels must be unique per SNAPSHOT: pid collisions across
+    # hosts (or a process listed as its own peer) would otherwise emit
+    # duplicate gauge series, which is invalid exposition output
+    used_procs: Dict[str, int] = {}
+    for i, snap in enumerate(snaps):
+        proc = _proc_label(snap)
+        if used_procs.setdefault(proc, i) != i:
+            proc = f"{proc}@{i}"
+            used_procs[proc] = i
+        for s in snap.get("samples") or []:
+            labels = dict(s.get("labels") or {})
+            key = (s["name"], tuple(sorted(labels.items())))
+            kind = s.get("kind", "gauge")
+            if kind == "counter":
+                if fam_kind.setdefault(s["name"], "c") != "c":
+                    # a scalar has no legal spelling inside a histogram
+                    # family (only _bucket/_sum/_count sample names are
+                    # accepted under TYPE histogram) — drop it rather
+                    # than invalidate the whole scrape
+                    continue
+                cur = counters.get(key)
+                if cur is None:
+                    counters[key] = {"name": s["name"], "kind": "counter",
+                                     "labels": labels,
+                                     "help": s.get("help", ""),
+                                     "value": float(s.get("value", 0.0))}
+                    if s["name"] not in fam_keys:
+                        fam_order.append(("c", s["name"]))
+                    fam_keys.setdefault(s["name"], []).append(key)
+                else:
+                    cur["value"] += float(s.get("value", 0.0))
+            elif kind == "histogram":
+                bounds = tuple(s.get("bounds") or ())
+                raw = list(s.get("bucket_counts") or [])
+                if fam_kind.setdefault(s["name"], "h") != "h":
+                    # histogram into a scalar family: the suffixed
+                    # _bucket/_sum/_count lines are distinct (untyped)
+                    # sample names, so a process-labeled fallback
+                    # series renders validly
+                    skew.append({"name": s["name"], "kind": "histogram",
+                                 "labels": {**labels, "process": proc},
+                                 "help": s.get("help", ""),
+                                 "bounds": bounds, "raw": raw,
+                                 "sum": float(s.get("sum", 0.0)),
+                                 "count": int(s.get("count", 0))})
+                    continue
+                # merge is deferred to OUTPUT time: contributions per
+                # series key are collected per process, so a bound
+                # mismatch (version skew) can degrade EVERY process of
+                # that key to labeled series — eagerly merging would
+                # leave the first-seen processes' sum unlabeled,
+                # masquerading as the cluster aggregate
+                cur = hists.get(key)
+                entry = {"name": s["name"], "proc": proc,
+                         "labels": labels, "help": s.get("help", ""),
+                         "bounds": bounds, "raw": raw,
+                         "sum": float(s.get("sum", 0.0)),
+                         "count": int(s.get("count", 0))}
+                if cur is None:
+                    hists[key] = [entry]
+                    if s["name"] not in fam_keys:
+                        fam_order.append(("h", s["name"]))
+                    fam_keys.setdefault(s["name"], []).append(key)
+                else:
+                    cur.append(entry)
+            else:   # gauge / untyped: per-process, labeled
+                gauges.append({"name": s["name"], "kind": kind,
+                               "labels": {**labels, "process": proc},
+                               "help": s.get("help", ""),
+                               "value": float(s.get("value", 0.0))})
+
+    out: List[dict] = []
+    for tag, name in fam_order:
+        for key in sorted(fam_keys[name]):
+            if tag == "c":
+                out.append(counters[key])
+            else:
+                contribs = hists[key]
+                h0 = contribs[0]
+                if all(c["bounds"] == h0["bounds"]
+                       and len(c["raw"]) == len(h0["raw"])
+                       for c in contribs):
+                    out.append({"name": h0["name"], "kind": "histogram",
+                                "labels": h0["labels"],
+                                "help": h0["help"],
+                                "sum": sum(c["sum"] for c in contribs),
+                                "count": sum(c["count"]
+                                             for c in contribs),
+                                "buckets": _cumulate(
+                                    list(h0["bounds"]),
+                                    [sum(col) for col in zip(
+                                        *(c["raw"] for c in contribs))])})
+                else:
+                    # bound mismatch (version skew): EVERY contribution
+                    # becomes a per-process series — none may pose as
+                    # the cluster aggregate
+                    for c in contribs:
+                        out.append({"name": c["name"],
+                                    "kind": "histogram",
+                                    "labels": {**c["labels"],
+                                               "process": c["proc"]},
+                                    "help": c["help"],
+                                    "sum": c["sum"], "count": c["count"],
+                                    "buckets": _cumulate(list(c["bounds"]),
+                                                         c["raw"])})
+    for e in sorted(skew, key=lambda s: (s["name"],
+                                         sorted(s["labels"].items()))):
+        if e["kind"] == "histogram":
+            out.append({"name": e["name"], "kind": "histogram",
+                        "labels": e["labels"], "help": e["help"],
+                        "sum": e["sum"], "count": e["count"],
+                        "buckets": _cumulate(list(e["bounds"]), e["raw"])})
+        else:
+            out.append(e)
+    # scalar-in-histogram-family gauges are dropped at OUTPUT time (the
+    # family may register only after the gauge was scanned): a bare
+    # ``name{...} v`` line under ``# TYPE name histogram`` would fail
+    # the whole scrape in strict parsers
+    out.extend(sorted((g for g in gauges
+                       if fam_kind.get(g["name"]) != "h"),
+                      key=lambda s: (s["name"],
+                                     sorted(s["labels"].items()))))
+    # exposition requires every series of one NAME contiguous. Skewed
+    # and gauge series whose name also has a counter/histogram family
+    # were appended at the end above — regroup by name (first-appearance
+    # order, stable within a name) so kind skew degrades one metric
+    # instead of invalidating the whole scrape
+    grouped: Dict[str, List[dict]] = {}
+    order: List[str] = []
+    for e in out:
+        if e["name"] not in grouped:
+            order.append(e["name"])
+        grouped.setdefault(e["name"], []).append(e)
+    return [e for n in order for e in grouped[n]]
+
+
+# ------------------------------------------------------------- peers
+
+def peers() -> List[str]:
+    """Peer processes to pull snapshots from: ``H2O3_TELEMETRY_PEERS``
+    as comma-separated host:port entries (a replica launcher or the
+    multihost worker exports it). The list should EXCLUDE the local
+    process — a shared everyone-gets-the-same-list spelling still works
+    but double-counts local counters in this process's cluster view
+    (flagged in ``peers_self``). Empty by default — the single-process
+    aggregation path must cost nothing."""
+    raw = os.environ.get("H2O3_TELEMETRY_PEERS", "")
+    return [p.strip() for p in raw.split(",") if p.strip()]
+
+
+def fetch_peer_snapshot(peer: str,
+                        timeout: float = PEER_TIMEOUT_S,
+                        max_spans: int = 0) -> dict:
+    """One peer's ``GET /3/Telemetry/snapshot`` body (raises on any
+    network/parse failure — the caller decides how dead peers show).
+    Defaults to the SPANLESS spelling (``?n=0``): the metric merge never
+    reads spans, so a scrape must not pay the peer's span-ring
+    serialization + transfer.
+
+    The socket timeout is PER OPERATION, so the body is read in
+    single-recv slices under a wall-clock deadline (2x the per-op
+    budget) — a sick peer dribbling bytes forever gets dropped instead
+    of pinning this fetch (and its scrape thread) indefinitely. The
+    body is also SIZE-capped: a misconfigured peer entry pointing at
+    something fat and fast (a log stream, a file server) must not let
+    one scrape buffer gigabytes inside the observing process."""
+    import urllib.request   # deferred: only the cluster scrape pays it
+    url = peer if peer.startswith(("http://", "https://")) \
+        else f"http://{peer}"
+    deadline = time.monotonic() + 2.0 * timeout
+    with urllib.request.urlopen(
+            f"{url}/3/Telemetry/snapshot?n={int(max_spans)}",
+            timeout=timeout) as r:
+        chunks = []
+        total = 0
+        while True:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"peer {peer} snapshot read exceeded {2.0 * timeout}s")
+            b = r.read1(1 << 16)
+            if not b:
+                break
+            chunks.append(b)
+            total += len(b)
+            if total > PEER_MAX_BYTES:
+                raise ValueError(
+                    f"peer {peer} snapshot body exceeded "
+                    f"{PEER_MAX_BYTES} bytes — not a telemetry peer?")
+    return json.loads(b"".join(chunks).decode())
+
+
+def cluster_samples(extra_snapshots: Optional[List[dict]] = None
+                    ) -> Tuple[List[dict], Dict[str, object]]:
+    """(merged samples, meta) over the local process + every reachable
+    peer. ``extra_snapshots`` lets tests/embedded callers merge
+    snapshots they already hold without a loopback server. With no
+    peers and no extras this is exactly the local ``samples()`` pass —
+    no merge, no HTTP (the single-process fast path).
+
+    Peers are fetched CONCURRENTLY (scrape latency is bounded by the
+    slowest single peer, not the fleet size), and the merged output
+    carries scrape-health gauges (``h2o3_telemetry_processes`` /
+    ``h2o3_telemetry_peers_failed``) so a Prometheus consumer can tell
+    a partial scrape — where summed counters legitimately DIP — from a
+    counter reset."""
+    plist = peers()
+    meta: Dict[str, object] = {"processes": 1, "peers": len(plist),
+                               "peers_ok": [], "peers_failed": [],
+                               "peers_self": []}
+    if not plist and not extra_snapshots:
+        return registry().samples(), meta
+    snaps = [local_snapshot(max_spans=0)]
+    if plist:
+        import concurrent.futures as cf
+        ex = cf.ThreadPoolExecutor(max_workers=min(len(plist), 16))
+        try:
+            # dedup preserves order: a duplicated peer entry (launcher
+            # config bug) must not merge the same snapshot twice. The
+            # timeout is passed EXPLICITLY so a runtime PEER_TIMEOUT_S
+            # change reaches the socket ops and the fetch's own
+            # deadline, not just the aggregate one below
+            futs = {p: ex.submit(fetch_peer_snapshot, p, PEER_TIMEOUT_S)
+                    for p in dict.fromkeys(plist)}
+            # the urlopen timeout is PER SOCKET OPERATION — a sick peer
+            # dribbling its body a few bytes at a time never trips it.
+            # An aggregate wall-clock deadline (2x the per-op budget:
+            # connect + slow body both get headroom) keeps the whole
+            # scrape bounded, per the module contract. The pool runs at
+            # most 16 fetches at once, so past 16 peers the budget
+            # scales by the number of waves — healthy peers queued
+            # behind a full first wave must not be starved into
+            # peers_failed by a deadline they never got a slice of
+            n_waves = -(-len(futs) // 16)
+            deadline = time.monotonic() + 2.0 * PEER_TIMEOUT_S * n_waves
+            for p in futs:
+                try:
+                    snap = futs[p].result(
+                        timeout=max(0.0, deadline - time.monotonic()))
+                    # a peer that is THIS process (a launcher exporting
+                    # one shared peer list to every replica) still
+                    # merges — the test/debug self-peer spelling relies
+                    # on it — but is flagged so the double-counted
+                    # counters are diagnosable from the scrape meta
+                    if snap.get("process") == snaps[0].get("process"):
+                        meta["peers_self"].append(p)
+                    snaps.append(snap)
+                    meta["peers_ok"].append(p)
+                except Exception as e:   # dead replica: report, never sink
+                    meta["peers_failed"].append({"peer": p,
+                                                 "error": repr(e)})
+        finally:
+            # past-deadline fetch threads self-terminate (the read loop
+            # in fetch_peer_snapshot carries its own deadline) — the
+            # scrape does not wait for them
+            ex.shutdown(wait=False, cancel_futures=True)
+    snaps.extend(extra_snapshots or [])
+    meta["processes"] = len(snaps)
+    merged = merge_snapshots(snaps)
+    merged.append({"name": "h2o3_telemetry_processes", "kind": "gauge",
+                   "labels": {}, "value": float(len(snaps)),
+                   "help": "processes merged into this cluster scrape"})
+    merged.append({"name": "h2o3_telemetry_peers_failed", "kind": "gauge",
+                   "labels": {}, "value": float(len(meta["peers_failed"])),
+                   "help": "configured peers that failed this scrape "
+                           "(nonzero = partial scrape; summed counters "
+                           "may dip without a real reset)"})
+    return merged, meta
+
+
+def cluster_snapshot() -> Dict[str, object]:
+    """The ``GET /3/Telemetry/cluster`` JSON body: merged flat metric
+    map + per-process identities + pull health."""
+    from h2o3_tpu.telemetry.export import _flatten
+    samples, meta = cluster_samples()
+    return {
+        "enabled": registry().enabled,
+        "processes": meta["processes"],
+        "peers": meta["peers"],
+        "peers_ok": meta["peers_ok"],
+        "peers_failed": meta["peers_failed"],
+        "peers_self": meta["peers_self"],
+        "metrics": _flatten(samples),
+    }

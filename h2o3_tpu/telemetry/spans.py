@@ -30,15 +30,55 @@ from __future__ import annotations
 
 import collections
 import itertools
+import os
 import threading
 import time
 from typing import Dict, List, Optional
 
-from h2o3_tpu.telemetry.registry import registry
+from h2o3_tpu.telemetry.registry import on_reset, registry
+from h2o3_tpu.telemetry.trace import current_trace_id
 
-_RING_CAP = 8192
-_RING: "collections.deque" = collections.deque(maxlen=_RING_CAP)
+
+def _env_ring_cap() -> int:
+    """Finished-span ring capacity (``H2O3_SPAN_RING``, default 8192).
+    Bounded below at 16 so a typo cannot silently discard every span."""
+    try:
+        return max(int(os.environ.get("H2O3_SPAN_RING", "8192")), 16)
+    except ValueError:
+        return 8192
+
+
+_RING_CAP = _env_ring_cap()
+# eviction is EXPLICIT (no deque maxlen): a full ring pops the oldest
+# span and counts it in h2o3_spans_dropped_total, so trace loss under
+# load is a visible metric instead of a silent wraparound (PR-4 gap)
+_RING: "collections.deque" = collections.deque()
 _RING_LOCK = threading.Lock()
+_DROPPED_HANDLE: List[object] = []
+
+
+def _dropped_counter():
+    if not _DROPPED_HANDLE:
+        _DROPPED_HANDLE.append(registry().counter(
+            "h2o3_spans_dropped_total",
+            help="finished spans evicted from the full span ring "
+                 "(raise H2O3_SPAN_RING to keep more)"))
+    return _DROPPED_HANDLE[0]
+
+
+def set_ring_capacity(cap: int) -> None:
+    """Resize the finished-span ring (test/boot use; normally set once
+    via H2O3_SPAN_RING). Shrinking drops-and-counts the oldest spans."""
+    global _RING_CAP
+    cap = max(int(cap), 16)
+    dropped = 0
+    with _RING_LOCK:
+        _RING_CAP = cap
+        while len(_RING) > cap:
+            _RING.popleft()
+            dropped += 1
+    if dropped:
+        _dropped_counter().inc(dropped)
 _IDS = itertools.count(1)
 _TLS = threading.local()
 
@@ -52,6 +92,8 @@ _SPAN_BOUNDS = (1e-5, 1e-4, 1e-3, 0.01, 0.1, 1.0, 10.0, 100.0, 1000.0)
 # harmless (Registry._get dedups to one instance). Cleared by
 # Registry.reset() on the global registry.
 _HIST_CACHE: Dict[str, object] = {}
+on_reset(_HIST_CACHE.clear)
+on_reset(_DROPPED_HANDLE.clear)
 
 
 def _span_hist(name: str):
@@ -67,7 +109,7 @@ def _span_hist(name: str):
 
 class Span:
     __slots__ = ("name", "attrs", "span_id", "parent_id", "thread_id",
-                 "t_wall", "t0", "duration_s")
+                 "t_wall", "t0", "duration_s", "trace_id")
 
     def __init__(self, name: str, parent: Optional["Span"] = None,
                  attrs: Optional[Dict] = None):
@@ -79,6 +121,12 @@ class Span:
         self.t_wall = time.time()
         self.t0 = time.perf_counter()
         self.duration_s: Optional[float] = None
+        # trace linkage: the thread's bound trace id wins (the REST
+        # handler / job thread bound it), else inherit the parent's —
+        # which is how a child recorded on the batcher's collector
+        # thread keeps the submitting request's trace
+        self.trace_id: Optional[str] = current_trace_id() or (
+            parent.trace_id if parent is not None else None)
 
     def finish(self) -> "Span":
         if self.duration_s is None:
@@ -141,8 +189,14 @@ def _record_finished(sp: Span) -> None:
     if not registry().enabled:
         return
     _span_hist(sp.name).observe(sp.duration_s)
+    dropped = 0
     with _RING_LOCK:
         _RING.append(sp)
+        while len(_RING) > _RING_CAP:
+            _RING.popleft()
+            dropped += 1
+    if dropped:
+        _dropped_counter().inc(dropped)
     if sp.parent_id == 0:
         # root spans feed the Flow timeline ring (train_start/train_done
         # style events now cover ingest and serve too)
@@ -152,6 +206,8 @@ def _record_finished(sp: Span) -> None:
         _TL_LAST[sp.name] = now
         from h2o3_tpu import log
         extra = " ".join(f"{k}={v}" for k, v in sp.attrs.items())
+        if sp.trace_id:
+            extra = (extra + " " if extra else "") + f"trace={sp.trace_id}"
         log.timeline_record(
             sp.name, f"{sp.duration_s * 1e3:.1f} ms"
             + (f" {extra}" if extra else ""))
@@ -227,7 +283,14 @@ def record_span(name: str, start_wall: float, duration_s: float,
     return sp
 
 
-def finished_spans(n: int = _RING_CAP) -> List[Span]:
+def finished_spans(n: Optional[int] = None) -> List[Span]:
+    """The most recent ``n`` finished spans (default: the whole ring).
+    ``n=0`` means ZERO spans — the spanless-snapshot spelling — not
+    "everything"."""
+    if n is None:
+        n = _RING_CAP
+    if n <= 0:
+        return []
     with _RING_LOCK:
         return list(_RING)[-n:]
 

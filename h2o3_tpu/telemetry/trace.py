@@ -1,0 +1,115 @@
+"""Trace-id propagation: one id links a request across the pipeline.
+
+A trace id is a 32-hex-char string (the W3C ``traceparent`` trace-id
+field). The REST layer accepts an incoming ``traceparent`` header (or
+mints a fresh id), binds it to the handler thread, and echoes it back in
+the response — so a slow response's id can be chased through the serve
+batcher's coalesced batch span, the /3/Serve/stats slow-request
+exemplars, and the /3/Timeline span ring, all of which carry the same
+id. Background jobs capture the id of the thread that created them and
+re-bind it on the worker thread (jobs.py), so a train job's spans link
+back to the POST that started it.
+
+Binding is THREAD-LOCAL (like the span stack): ``bind(tid)`` installs,
+``unbind()`` removes, ``current_trace_id()`` reads. Spans snapshot the
+current id at creation (falling back to their parent's), which is how
+the id crosses the batcher's explicit parent handoff without any extra
+plumbing — a child recorded on the collector thread against a parent
+that carries an id inherits it.
+
+Everything here is plain thread-local string bookkeeping — it stays live
+under ``H2O3_TELEMETRY=0`` (ids cost nanoseconds and the REST echo
+contract should not silently change with the metrics knob); only the
+span/metric RECORDING of ids is gated, along with the rest of telemetry.
+"""
+from __future__ import annotations
+
+import os
+import re
+import threading
+from typing import Optional
+
+_TLS = threading.local()
+
+# W3C trace-context: version 00 is exactly four fields; HIGHER versions
+# must still parse by their first four fields (future versions may
+# append more, "-"-separated), and version ff is explicitly invalid
+_TRACEPARENT_RX = re.compile(
+    r"^(?P<version>[0-9a-f]{2})-(?P<trace_id>[0-9a-f]{32})"
+    r"-(?P<parent_id>[0-9a-f]{16})-(?P<flags>[0-9a-f]{2})"
+    r"(?P<rest>$|-.*)")
+
+TRACEPARENT_HEADER = "traceparent"
+
+
+def new_trace_id() -> str:
+    """A fresh 128-bit trace id (32 lowercase hex chars)."""
+    return os.urandom(16).hex()
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[str]:
+    """Trace id from a W3C ``traceparent`` header value; None when the
+    header is absent/malformed or carries the all-zero invalid id.
+    Future-version headers (version > 00) parse by their first four
+    fields; version ``ff`` is invalid per the spec."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RX.match(header.strip().lower())
+    if m is None:
+        return None
+    if m.group("version") == "ff":
+        return None
+    if m.group("version") == "00" and m.group("rest"):
+        return None          # version 00 is EXACTLY four fields
+    if m.group("parent_id") == "0" * 16:
+        return None          # all-zero parent-id invalidates the header
+    tid = m.group("trace_id")
+    return None if tid == "0" * 32 else tid
+
+
+def format_traceparent(trace_id: str, span_id: int = 0) -> str:
+    """A ``traceparent`` response/egress header for this trace; the
+    16-hex parent-id field carries the span id (0 → a fresh random-ish
+    nonzero filler, the field must not be all zeros)."""
+    pid = span_id & ((1 << 64) - 1)
+    if pid == 0:
+        pid = int.from_bytes(os.urandom(8), "big") or 1
+    return f"00-{trace_id}-{pid:016x}-01"
+
+
+def bind(trace_id: Optional[str]) -> Optional[str]:
+    """Bind a trace id to THIS thread (None unbinds). Returns the id."""
+    if trace_id is None:
+        _TLS.trace_id = None
+        return None
+    _TLS.trace_id = str(trace_id)
+    return _TLS.trace_id
+
+
+def unbind() -> None:
+    _TLS.trace_id = None
+
+
+def current_trace_id() -> Optional[str]:
+    """The trace id bound to this thread, or None."""
+    return getattr(_TLS, "trace_id", None)
+
+
+class trace_context:
+    """``with trace_context(tid):`` — bind for a block, restoring the
+    previous binding on exit (handler threads are pooled/reused)."""
+
+    __slots__ = ("_tid", "_prev")
+
+    def __init__(self, trace_id: Optional[str]):
+        self._tid = trace_id
+        self._prev: Optional[str] = None
+
+    def __enter__(self) -> Optional[str]:
+        self._prev = current_trace_id()
+        bind(self._tid)
+        return self._tid
+
+    def __exit__(self, *exc) -> bool:
+        bind(self._prev)
+        return False

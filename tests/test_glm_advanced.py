@@ -96,6 +96,7 @@ def test_p_values_require_no_l1():
         est.train(y="y", training_frame=fr)
 
 
+@pytest.mark.slow  # ~70s: heavy tier, driver runs with --runslow
 def test_lbfgs_wide_sharded():
     """10k-feature wide problem on the (data x model) mesh: the design is
     feature-sharded for the L-BFGS matvecs (SURVEY §7.1.7)."""

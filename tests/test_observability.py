@@ -1,0 +1,860 @@
+"""Cluster-wide observability tests (ISSUE 8).
+
+Covers the distributed telemetry plane built on PR 4's process-local
+subsystem: multi-snapshot merge correctness (counters sum, histogram
+buckets merge, gauges get process labels, Prometheus exposition stays
+parse-valid), the /3/Telemetry/cluster + /metrics?scope=cluster REST
+surface (with single-process /metrics bit-unchanged), trace-id
+propagation end-to-end (traceparent header → serve batcher →
+/3/Serve/stats slow-request exemplar → /3/Timeline batch span, all one
+id), SPMD collective/straggler metrics on the 8-virtual-device CPU
+mesh, the configurable span ring + eviction counter, the shared xprof
+profiling helper, and the overhead guards (no-peer aggregation is the
+plain local path; H2O3_TELEMETRY=0 keeps the sharded-train observation
+a checked no-op).
+"""
+import json
+import re
+import statistics
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import telemetry
+from h2o3_tpu.telemetry import snapshot as telesnap
+from h2o3_tpu.telemetry import trace as teletrace
+
+_LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*"'
+_METRIC_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    rf"(\{{{_LABEL}(,{_LABEL})*\}})?"
+    r" (NaN|[-+]?Inf|[-+]?[0-9.eE+-]+)$")
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_on():
+    was = telemetry.enabled()
+    telemetry.set_enabled(True)
+    telemetry.install()
+    yield
+    telemetry.set_enabled(was)
+
+
+# --------------------------------------------------- trace-id plumbing
+
+def test_traceparent_parse_format_roundtrip():
+    tid = teletrace.new_trace_id()
+    assert re.fullmatch(r"[0-9a-f]{32}", tid)
+    hdr = teletrace.format_traceparent(tid, span_id=0x2A)
+    assert teletrace.parse_traceparent(hdr) == tid
+    assert "000000000000002a" in hdr
+    # malformed / invalid inputs never raise
+    assert teletrace.parse_traceparent(None) is None
+    assert teletrace.parse_traceparent("nonsense") is None
+    assert teletrace.parse_traceparent("00-" + "0" * 32
+                                       + "-00000000000000ab-01") is None
+    # all-zero parent-id invalidates the whole header per the spec
+    assert teletrace.parse_traceparent(
+        f"00-{tid}-" + "0" * 16 + "-01") is None
+    # W3C version semantics: ff is invalid; a FUTURE version parses by
+    # its first four fields even with trailing fields; version 00 with
+    # trailing fields is malformed
+    base4 = f"{tid}-00000000000000ab-01"
+    assert teletrace.parse_traceparent(f"ff-{base4}") is None
+    assert teletrace.parse_traceparent(f"01-{base4}-extra") == tid
+    assert teletrace.parse_traceparent(f"00-{base4}-extra") is None
+    # bare format never emits an all-zero parent field
+    assert "-0000000000000000-" not in teletrace.format_traceparent(tid)
+
+
+def test_trace_context_binds_and_restores():
+    assert teletrace.current_trace_id() is None
+    with teletrace.trace_context("aa" * 16):
+        assert teletrace.current_trace_id() == "aa" * 16
+        with teletrace.trace_context("bb" * 16):
+            assert teletrace.current_trace_id() == "bb" * 16
+        assert teletrace.current_trace_id() == "aa" * 16
+    assert teletrace.current_trace_id() is None
+
+
+def test_spans_inherit_trace_id_across_thread_handoff():
+    """The batcher pattern: the root carries the submitting thread's
+    trace; children recorded on another thread against the explicit
+    parent inherit it."""
+    tid = teletrace.new_trace_id()
+    with teletrace.trace_context(tid):
+        root = telemetry.open_span("t.trace_root")
+    assert root.trace_id == tid
+    got = {}
+
+    def worker():
+        got["child"] = telemetry.record_span(
+            "t.trace_child", time.time(), 0.001, parent=root)
+
+    th = threading.Thread(target=worker)
+    th.start()
+    th.join()
+    root.finish()
+    assert got["child"].trace_id == tid
+
+
+def test_job_propagates_trace_to_worker_thread():
+    from h2o3_tpu.jobs import Job
+    tid = teletrace.new_trace_id()
+    seen = {}
+    with teletrace.trace_context(tid):
+        job = Job("trace probe")
+    assert job.trace_id == tid
+
+    def body(j):
+        seen["tid"] = teletrace.current_trace_id()
+        return 1
+
+    job.run(body, background=True)
+    job.join()
+    assert seen["tid"] == tid
+    # jobs created with no bound trace still get one (chaseable id)
+    j2 = Job("unbound probe")
+    assert re.fullmatch(r"[0-9a-f]{32}", j2.trace_id)
+
+
+# ------------------------------------------------ snapshot merge layer
+
+def _seeded_registry_snapshot(counter_v, gauge_v, hist_obs, pid):
+    """A synthetic process snapshot in the wire shape."""
+    return {
+        "version": 1, "time": time.time(), "enabled": True,
+        "process": {"pid": pid},
+        "samples": [
+            {"name": "obs_total", "kind": "counter",
+             "labels": {"k": "v"}, "help": "h", "value": counter_v},
+            {"name": "obs_gauge", "kind": "gauge", "labels": {},
+             "help": "", "value": gauge_v},
+            {"name": "obs_seconds", "kind": "histogram",
+             "labels": {}, "help": "",
+             "bounds": [1.0, 5.0],
+             "bucket_counts": hist_obs,
+             "sum": sum(b * c for b, c in zip((0.5, 3.0, 9.0), hist_obs)),
+             "count": sum(hist_obs)},
+        ],
+        "spans": [],
+    }
+
+
+def test_merge_counters_sum_gauges_label_histograms_bucket_merge():
+    s1 = _seeded_registry_snapshot(3.0, 7.0, [1, 2, 0], pid=111)
+    s2 = _seeded_registry_snapshot(4.0, 9.0, [0, 1, 3], pid=222)
+    merged = telesnap.merge_snapshots([s1, s2])
+    by = {}
+    for m in merged:
+        by.setdefault(m["name"], []).append(m)
+    # counters: ONE summed series
+    (c,) = by["obs_total"]
+    assert c["value"] == 7.0 and c["labels"] == {"k": "v"}
+    # histograms: bucket-wise merge, cumulative output ends at count
+    (h,) = by["obs_seconds"]
+    assert h["count"] == 7
+    assert h["buckets"][-1] == (float("inf"), 7)
+    assert h["buckets"][0] == (1.0, 1)       # 1+0 raw in first bucket
+    assert h["buckets"][1] == (5.0, 4)       # +2+1
+    # gauges: one series PER process, labeled pid@host (standalone
+    # replicas all report jax process_index 0 — pid is what identifies)
+    gs = by["obs_gauge"]
+    assert {g["labels"]["process"] for g in gs} == {"111@?", "222@?"}
+    assert sorted(g["value"] for g in gs) == [7.0, 9.0]
+
+
+def test_merge_is_valid_prometheus_exposition():
+    s1 = _seeded_registry_snapshot(1.0, 2.0, [1, 0, 0], pid=1)
+    s2 = _seeded_registry_snapshot(2.0, 3.0, [0, 1, 0], pid=2)
+    # peer-only series must not interleave families: give s2 a label
+    # set s1 lacks plus an extra family between them
+    s2["samples"].insert(1, {"name": "obs_total", "kind": "counter",
+                             "labels": {"k": "w"}, "help": "h",
+                             "value": 1.0})
+    s2["samples"].insert(2, {"name": "obs_other_total",
+                             "kind": "counter", "labels": {},
+                             "help": "", "value": 1.0})
+    text = telemetry.prometheus_text(
+        samples=telesnap.merge_snapshots([s1, s2]))
+    assert text.endswith("\n")
+    for ln in text.splitlines():
+        if ln and not ln.startswith("#"):
+            assert _METRIC_LINE.match(ln), ln
+    # every line of one metric family is CONTIGUOUS (text-format spec;
+    # strict parsers reject interleaved groups)
+    fam_seen, prev = set(), None
+    for ln in text.splitlines():
+        if not ln or ln.startswith("#"):
+            continue
+        name = re.match(r"[a-zA-Z_:][a-zA-Z0-9_:]*", ln).group(0)
+        for suf in ("_bucket", "_sum", "_count"):
+            if name.endswith(suf):
+                name = name[:-len(suf)]
+                break
+        if name != prev:
+            assert name not in fam_seen, f"family {name} interleaved"
+            fam_seen.add(name)
+            prev = name
+    # histogram cumulative contract survives the merge
+    buckets = [int(l.rsplit(" ", 1)[1]) for l in text.splitlines()
+               if l.startswith("obs_seconds_bucket")]
+    assert buckets == sorted(buckets)
+    count = int([l for l in text.splitlines()
+                 if l.startswith("obs_seconds_count")][0].rsplit(" ", 1)[1])
+    assert buckets[-1] == count
+
+
+def test_merge_kind_skew_falls_back_to_process_series():
+    # version skew: a peer reports the same NAME under a different kind.
+    # The first-seen kind keeps the merged family; the conflicting
+    # samples become per-process series (like a histogram bound
+    # mismatch) instead of duplicate/orphaned output
+    s1 = _seeded_registry_snapshot(3.0, 7.0, [1, 2, 0], pid=111)
+    s2 = _seeded_registry_snapshot(4.0, 9.0, [0, 1, 3], pid=222)
+    s2["samples"].append({"name": "obs_seconds", "kind": "counter",
+                          "labels": {}, "help": "", "value": 5.0})
+    s2["samples"].append({"name": "obs_total", "kind": "histogram",
+                          "labels": {"k": "v"}, "help": "",
+                          "bounds": [1.0], "bucket_counts": [1, 0],
+                          "sum": 0.5, "count": 1})
+    merged = telesnap.merge_snapshots([s1, s2])
+    by = {}
+    for m in merged:
+        by.setdefault(m["name"], []).append(m)
+    # the counter family still sums across processes exactly once...
+    assert sorted(m["kind"] for m in by["obs_total"]) == \
+        ["counter", "histogram"]
+    (c,) = [m for m in by["obs_total"] if m["kind"] == "counter"]
+    assert c["value"] == 7.0 and "process" not in c["labels"]
+    # ...a histogram skewed into a scalar family survives as one
+    # process-labeled series (its suffixed lines render validly)...
+    (hskew,) = [m for m in by["obs_total"] if m["kind"] == "histogram"]
+    assert hskew["labels"]["process"] == "222@?" and hskew["count"] == 1
+    # ...but a SCALAR skewed into a histogram family is dropped: a bare
+    # name line has no legal spelling under TYPE histogram
+    assert [m["kind"] for m in by["obs_seconds"]] == ["histogram"]
+    (h,) = by["obs_seconds"]
+    assert h["count"] == 7
+    # gauge-vs-counter skew: gauges are always per-process series, but
+    # the shared NAME must still render contiguously with its family —
+    # while a gauge skewed into a HISTOGRAM family is dropped even when
+    # the gauge was scanned before the family registered
+    s2["samples"].append({"name": "obs_total", "kind": "gauge",
+                          "labels": {}, "help": "", "value": 1.5})
+    s1["samples"].insert(0, {"name": "obs_seconds", "kind": "gauge",
+                             "labels": {"q": "z"}, "help": "",
+                             "value": 9.9})
+    merged = telesnap.merge_snapshots([s1, s2])
+    assert all(m["kind"] == "histogram"
+               for m in merged if m["name"] == "obs_seconds")
+    # still renders (no KeyError, no duplicate sample lines) and every
+    # metric NAME stays contiguous — kind skew degrades one metric, it
+    # must not invalidate the whole scrape
+    text = telemetry.prometheus_text(samples=merged)
+    lines = [l for l in text.splitlines() if l and not l.startswith("#")]
+    assert len(lines) == len(set(lines))
+    # only _bucket/_sum/_count sample names may appear inside the
+    # histogram family — a bare scalar line there fails strict parsers
+    assert not any(re.match(r"obs_seconds[{ ]", ln) for ln in lines)
+    fam_seen, prev = set(), None
+    for ln in lines:
+        name = re.match(r"[a-zA-Z_:][a-zA-Z0-9_:]*", ln).group(0)
+        for suf in ("_bucket", "_sum", "_count"):
+            if name.endswith(suf):
+                name = name[:-len(suf)]
+                break
+        if name != prev:
+            assert name not in fam_seen, f"family {name} interleaved"
+            fam_seen.add(name)
+            prev = name
+
+
+def test_peer_timeout_env_is_fault_tolerant(monkeypatch):
+    monkeypatch.setenv("H2O3_TELEMETRY_PEER_TIMEOUT", "2s")
+    assert telesnap._env_peer_timeout() == 2.0
+    monkeypatch.setenv("H2O3_TELEMETRY_PEER_TIMEOUT", "-1")
+    assert telesnap._env_peer_timeout() == 2.0
+    monkeypatch.setenv("H2O3_TELEMETRY_PEER_TIMEOUT", "0.25")
+    assert telesnap._env_peer_timeout() == 0.25
+
+
+def test_merge_histogram_bound_mismatch_labels_every_process():
+    """Version skew on histogram bounds: EVERY process's series must
+    come out process-labeled — an unlabeled first-seen series would
+    read as the cluster aggregate while holding one process's data."""
+    s1 = _seeded_registry_snapshot(1.0, 1.0, [1, 0, 0], pid=111)
+    s2 = _seeded_registry_snapshot(1.0, 1.0, [0, 1, 0], pid=222)
+    s3 = _seeded_registry_snapshot(1.0, 1.0, [0, 0, 1], pid=333)
+    for s in s1["samples"]:          # s1 = the old-version process
+        if s["name"] == "obs_seconds":
+            s["bounds"] = [2.0, 10.0]
+    merged = telesnap.merge_snapshots([s1, s2, s3])
+    hs = [m for m in merged if m["name"] == "obs_seconds"]
+    assert len(hs) == 3
+    assert {m["labels"].get("process") for m in hs} == \
+        {"111@?", "222@?", "333@?"}
+    # matching families still merge into one unlabeled series
+    (c,) = [m for m in merged if m["name"] == "obs_total"]
+    assert c["value"] == 3.0 and "process" not in c["labels"]
+
+
+def test_trickling_peer_cannot_stall_cluster_scrape(monkeypatch):
+    """The urlopen timeout is per socket operation — a sick peer that
+    accepts and dribbles bytes never trips it. The aggregate deadline
+    must bound the whole scrape and report the peer as failed."""
+    import socket
+    import threading
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    stop = threading.Event()
+
+    def dribble():
+        try:
+            conn, _ = srv.accept()
+            conn.recv(65536)
+            conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                         b"Content-Length: 100000\r\n\r\n")
+            while not stop.is_set():
+                try:
+                    conn.sendall(b"x")
+                except OSError:
+                    break
+                stop.wait(0.1)
+        except OSError:
+            pass
+
+    threading.Thread(target=dribble, daemon=True).start()
+    monkeypatch.setattr(telesnap, "PEER_TIMEOUT_S", 0.5)
+    monkeypatch.setenv("H2O3_TELEMETRY_PEERS", f"127.0.0.1:{port}")
+    t0 = time.perf_counter()
+    _samples, meta = telesnap.cluster_samples()
+    took = time.perf_counter() - t0
+    stop.set()
+    srv.close()
+    assert took < 5.0, took          # deadline is 2x0.5s, not unbounded
+    assert meta["peers_failed"] and not meta["peers_ok"]
+    assert meta["processes"] == 1
+
+
+def test_local_snapshot_round_trips_registry():
+    telemetry.counter("snap_probe_total").inc(5)
+    telemetry.histogram("snap_probe_seconds",
+                        bounds=(0.5, 5.0)).observe(1.0)
+    with telemetry.span("t.snap_probe"):
+        pass
+    snap = json.loads(json.dumps(telemetry.local_snapshot()))
+    names = {s["name"] for s in snap["samples"]}
+    assert {"snap_probe_total", "snap_probe_seconds"} <= names
+    h = [s for s in snap["samples"]
+         if s["name"] == "snap_probe_seconds"][0]
+    assert h["bounds"] == [0.5, 5.0]
+    assert sum(h["bucket_counts"]) == h["count"]
+    assert any(sp["name"] == "t.snap_probe" for sp in snap["spans"])
+    assert "pid" in snap["process"]
+    # merging a snapshot with itself doubles counters exactly
+    merged = telesnap.merge_snapshots([snap, snap])
+    c = [m for m in merged if m["name"] == "snap_probe_total"][0]
+    assert c["value"] == 10.0
+
+
+def test_disabled_snapshot_is_empty():
+    telemetry.set_enabled(False)
+    try:
+        snap = telemetry.local_snapshot()
+        assert snap["enabled"] is False
+        assert snap["samples"] == [] and snap["spans"] == []
+    finally:
+        telemetry.set_enabled(True)
+
+
+def test_no_peer_cluster_path_is_local_identity():
+    """Single-process overhead guard: with no peers configured the
+    cluster path returns the plain local samples (no merge pass) and
+    stays cheap."""
+    import os
+    assert not os.environ.get("H2O3_TELEMETRY_PEERS")
+    samples, meta = telemetry.cluster_samples()
+    assert meta["processes"] == 1 and meta["peers"] == 0
+    local = telemetry.registry().samples()
+    assert [s["name"] for s in samples] == [s["name"] for s in local]
+    t0 = time.perf_counter()
+    for _ in range(20):
+        telemetry.cluster_samples()
+    per_call = (time.perf_counter() - t0) / 20
+    # one registry scrape's cost, not an HTTP/merge pass
+    assert per_call < 0.25, per_call
+
+
+# ----------------------------------------------------- span ring knobs
+
+def test_span_ring_capacity_and_dropped_counter():
+    from h2o3_tpu.telemetry import spans as spans_mod
+    old_cap = spans_mod._RING_CAP
+    before = telemetry.registry().value("h2o3_spans_dropped_total")
+    try:
+        telemetry.set_ring_capacity(32)
+        for _ in range(100):
+            telemetry.record_span("t.ring_probe", time.time(), 1e-4)
+        assert len(telemetry.finished_spans()) <= 32
+        # n=0 means a SPANLESS view (the cluster-scrape spelling), not
+        # the whole ring
+        assert telemetry.finished_spans(0) == []
+        assert telemetry.local_snapshot(max_spans=0)["spans"] == []
+        dropped = telemetry.registry().value(
+            "h2o3_spans_dropped_total") - before
+        assert dropped >= 100 - 32, dropped
+    finally:
+        telemetry.set_ring_capacity(old_cap)
+
+
+def test_span_ring_env_parsing(monkeypatch):
+    from h2o3_tpu.telemetry import spans as spans_mod
+    monkeypatch.setenv("H2O3_SPAN_RING", "4096")
+    assert spans_mod._env_ring_cap() == 4096
+    monkeypatch.setenv("H2O3_SPAN_RING", "2")      # floor at 16
+    assert spans_mod._env_ring_cap() == 16
+    monkeypatch.setenv("H2O3_SPAN_RING", "junk")   # default, not a crash
+    assert spans_mod._env_ring_cap() == 8192
+
+
+# -------------------------------------------- shared profiling helper
+
+def test_profile_helper_noop_without_dir(monkeypatch):
+    from h2o3_tpu.telemetry import profiling
+    monkeypatch.delenv("XPROF_TRACE_DIR", raising=False)
+    with profiling.profile("noop", trace_dir=None) as p:
+        assert p.dir is None
+    assert profiling.last_trace_dir() is None
+
+
+def test_profile_helper_argv_and_env(monkeypatch, tmp_path):
+    from h2o3_tpu.telemetry import profiling
+    assert profiling.trace_dir_from_argv(["x", "--xprof-trace",
+                                          "/tmp/t"]) == "/tmp/t"
+    bare = profiling.trace_dir_from_argv(["x", "--xprof-trace"])
+    assert bare and bare.startswith("/tmp/")
+    monkeypatch.setenv("XPROF_TRACE_DIR", str(tmp_path))
+    assert profiling.trace_dir_from_argv(["x"]) == str(tmp_path)
+
+
+def test_profile_helper_captures_trace(tmp_path):
+    """A real (CPU-backend) jax.profiler capture through the helper —
+    degrading gracefully is allowed, but a successful capture must
+    leave artifacts in <dir>/<name>."""
+    import os
+    from h2o3_tpu.telemetry import profiling
+    import jax
+    import jax.numpy as jnp
+    with profiling.profile("unit", trace_dir=str(tmp_path)) as p:
+        jnp.ones(8).sum().block_until_ready()
+    if p.dir is not None:       # capture started: artifacts must exist
+        assert profiling.last_trace_dir() == str(tmp_path / "unit")
+        assert os.path.isdir(p.dir) and os.listdir(p.dir)
+
+
+# ------------------------------------- serve exemplars + REST round trip
+
+def _tiny_frame(n=600, f=4, seed=3):
+    import h2o3_tpu as h2o
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    cols = {f"f{i}": X[:, i] for i in range(f)}
+    cols["label"] = np.where(X[:, 0] > 0, "Y", "N")
+    return h2o.Frame.from_numpy(cols), X
+
+
+def _train_gbm(fr, **kw):
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    gbm = H2OGradientBoostingEstimator(
+        ntrees=3, max_depth=3, seed=1, min_rows=1.0,
+        score_tree_interval=0, stopping_rounds=0, **kw)
+    gbm.train(y="label", training_frame=fr)
+    return gbm.model
+
+
+def test_slow_request_exemplars_top_k():
+    from h2o3_tpu.serve.stats import ServeStats, _SLOW_K
+    st = ServeStats(model="exemplar_probe")
+    for i in range(30):
+        st.record_request(float(i), 1, trace_id=f"{i:032x}")
+    slow = st.slow_requests()
+    assert len(slow) == _SLOW_K
+    lats = [e["latency_ms"] for e in slow]
+    assert lats == sorted(lats, reverse=True)
+    assert lats[0] == 29.0 and lats[-1] == 30.0 - _SLOW_K
+    assert slow[0]["trace_id"] == f"{29:032x}"
+    assert st.snapshot()["slow_requests"] == slow
+
+
+def test_trace_id_rest_to_batcher_to_timeline(tmp_path):
+    """The e2e acceptance: a serve request's traceparent header, its
+    /3/Serve/stats slow-request exemplar, its serve.request span AND
+    its serve.batch /3/Timeline span all carry the SAME trace id."""
+    from h2o3_tpu import serve
+    from h2o3_tpu.api import server as apisrv
+    fr, X = _tiny_frame(seed=11)
+    model = _train_gbm(fr)
+    model.key = "obs_trace_gbm"
+    dep = serve.deploy(model.key, model=model, max_batch=8,
+                       max_delay_ms=0.5)
+    srv = apisrv.start_server(port=0)
+    tid = "c1" * 16
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        req = urllib.request.Request(
+            base + "/3/Predictions/models/obs_trace_gbm/rows",
+            data=json.dumps({"rows": [
+                {f"f{i}": float(X[0, i]) for i in range(4)}]}).encode(),
+            headers={"Content-Type": "application/json",
+                     "traceparent": f"00-{tid}-00000000000000ab-01"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+            assert r.headers.get("X-H2O3-Trace-Id") == tid
+            assert tid in (r.headers.get("traceparent") or "")
+        # a request with NO traceparent still gets a fresh echoed id
+        req2 = urllib.request.Request(
+            base + "/3/Predictions/models/obs_trace_gbm/rows",
+            data=json.dumps({"rows": [
+                {f"f{i}": float(X[1, i]) for i in range(4)}]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req2, timeout=30) as r:
+            fresh = r.headers.get("X-H2O3-Trace-Id")
+            assert fresh and fresh != tid
+
+        st = json.loads(urllib.request.urlopen(
+            base + "/3/Serve/stats", timeout=30).read())
+        slow = st["models"]["obs_trace_gbm"]["slow_requests"]
+        assert any(e["trace_id"] == tid for e in slow), slow
+
+        tr = json.loads(urllib.request.urlopen(
+            base + "/3/Timeline?format=trace", timeout=30).read())
+        evs = tr["traceEvents"]
+        req_spans = [e for e in evs if e["name"] == "serve.request"
+                     and e["args"].get("trace_id") == tid]
+        assert req_spans, "serve.request span lost the trace id"
+        batch_spans = [e for e in evs if e["name"] == "serve.batch"
+                       and tid in (e["args"].get("trace_ids") or "")]
+        assert batch_spans, "serve.batch span lost the trace id"
+    finally:
+        srv.stop()
+        serve.undeploy(model.key)
+
+
+def test_cluster_endpoint_merges_two_snapshots_over_rest():
+    """GET /3/Telemetry/cluster with this server listed as its own peer:
+    2 snapshots merge (counters exactly double, gauges process-labeled)
+    and the prometheus rendering of the merged view stays parse-valid.
+    Single-process /metrics output is unchanged (no process labels)."""
+    import os
+    from h2o3_tpu.api import server as apisrv
+    telemetry.counter("cluster_probe_total").inc(3)
+    telemetry.gauge("cluster_probe_gauge").set(4)
+    srv = apisrv.start_server(port=0)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        plain = urllib.request.urlopen(base + "/metrics",
+                                       timeout=30).read().decode()
+        assert 'process="' not in plain
+        local_v = telemetry.registry().value("cluster_probe_total")
+        os.environ["H2O3_TELEMETRY_PEERS"] = f"127.0.0.1:{srv.port}"
+        try:
+            cl = json.loads(urllib.request.urlopen(
+                base + "/3/Telemetry/cluster", timeout=30).read())
+            assert cl["processes"] == 2
+            assert cl["peers_ok"] == [f"127.0.0.1:{srv.port}"]
+            # the self-peer spelling merges (that is what makes this a
+            # 2-process test) but is FLAGGED: a launcher shipping one
+            # shared peer list to every replica double-counts, and the
+            # scrape meta must say so
+            assert cl["peers_self"] == [f"127.0.0.1:{srv.port}"]
+            assert cl["metrics"]["cluster_probe_total"] == 2 * local_v
+            # gauges appear per process, never summed (the self-peer's
+            # duplicate process label is disambiguated, not collapsed)
+            glabels = [k for k in cl["metrics"]
+                       if k.startswith("cluster_probe_gauge{")]
+            assert len(glabels) == 2 and all("process=" in k
+                                             for k in glabels), glabels
+            ptext = urllib.request.urlopen(
+                base + "/metrics?scope=cluster",
+                timeout=30).read().decode()
+            for ln in ptext.splitlines():
+                if ln and not ln.startswith("#"):
+                    assert _METRIC_LINE.match(ln), ln
+            assert "cluster_probe_total" in ptext
+            # scrape-health gauges ride in the merged exposition so a
+            # Prometheus consumer can tell partial scrapes from resets
+            assert cl["metrics"]["h2o3_telemetry_processes"] == 2.0
+            assert cl["metrics"]["h2o3_telemetry_peers_failed"] == 0.0
+            assert "h2o3_telemetry_processes 2" in ptext
+            # dead peers are reported, never fatal — and flagged in the
+            # health gauge
+            os.environ["H2O3_TELEMETRY_PEERS"] += ",127.0.0.1:1"
+            cl2 = json.loads(urllib.request.urlopen(
+                base + "/3/Telemetry/cluster", timeout=30).read())
+            assert cl2["peers_failed"] and cl2["processes"] == 2
+            assert cl2["metrics"]["h2o3_telemetry_peers_failed"] == 1.0
+        finally:
+            del os.environ["H2O3_TELEMETRY_PEERS"]
+    finally:
+        srv.stop()
+
+
+# ------------------------------- SPMD collective / straggler metrics
+
+def test_sharded_train_records_collective_metrics():
+    """On the 8-virtual-device CPU mesh a sharded GBM train must leave
+    the straggler gauge + collective-wait/shard-step histograms in the
+    registry and the per-train summary in model.output['spmd']."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device mesh")
+    fr, _ = _tiny_frame(n=1600, seed=13)
+    model = _train_gbm(fr)
+    spmd = model.output["spmd"]
+    assert spmd["n_data"] == len(jax.devices())
+    coll = spmd.get("collective")
+    assert coll is not None
+    assert coll["n_shards"] == spmd["n_data"]
+    assert coll["straggler_ratio"] >= 1.0
+    assert 0.0 <= coll["collective_wait_share"] <= 1.0
+    names = {s["name"] for s in telemetry.registry().samples()}
+    assert {"h2o3_straggler_ratio", "h2o3_collective_wait_ms",
+            "h2o3_shard_step_ms"} <= names
+    assert telemetry.registry().value("h2o3_straggler_ratio",
+                                      {"algo": "gbm"}) >= 1.0
+
+
+def test_disabled_telemetry_sharded_train_records_no_collective():
+    """H2O3_TELEMETRY=0: the sharded train path must not observe shard
+    readiness at all — no collective summary, no registry writes."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device mesh")
+    telemetry.set_enabled(False)
+    try:
+        fr, _ = _tiny_frame(n=800, seed=17)
+        model = _train_gbm(fr)
+        assert model.output["spmd"]["n_data"] == len(jax.devices())
+        assert "collective" not in model.output["spmd"]
+    finally:
+        telemetry.set_enabled(True)
+
+
+def test_observe_step_noop_guards():
+    """H2O3_TELEMETRY=0 keeps the sharded-path observation a checked
+    no-op (ns budget), and single-shard outputs observe nothing."""
+    import jax
+    import jax.numpy as jnp
+    from h2o3_tpu.parallel.shardstats import observe_sharded_step
+    arr = jnp.ones(8)
+    telemetry.set_enabled(False)
+    try:
+        N = 5_000
+        def per_call_ns():
+            t0 = time.perf_counter_ns()
+            for _ in range(N):
+                observe_sharded_step(arr, 0.0, algo="gbm")
+            return (time.perf_counter_ns() - t0) / N
+        ns = statistics.median(per_call_ns() for _ in range(3))
+        assert ns < 20_000, f"disabled observe not a no-op: {ns:.0f}ns"
+    finally:
+        telemetry.set_enabled(True)
+    # single-device array → nothing to observe even when enabled
+    single = jax.device_put(np.ones(8), jax.devices()[0])
+    assert observe_sharded_step(single, time.perf_counter()) is None
+    # host junk → None, not a crash
+    assert observe_sharded_step({"x": 3}, 0.0) is None
+
+
+def test_sharded_ingest_d2d_bytes_attributed():
+    """PR 7's stitched assembly: boundary D2D moves + the pad upload
+    now land in the pipeline-labeled transfer counters (ISSUE 8)."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device mesh")
+    from h2o3_tpu.ingest.stream import ChunkDeviceStreamer
+    from h2o3_tpu.ingest.chunk import EncodedColumn
+    from h2o3_tpu.frame.vec import T_REAL
+    from h2o3_tpu.parallel.mesh import current_mesh
+    mesh = current_mesh()
+    reg = telemetry.registry()
+    d2d0 = reg.value("h2o3_d2d_pipeline_bytes_total",
+                     {"pipeline": "ingest"})
+    st = ChunkDeviceStreamer([0], [T_REAL], n_chunks=3, mesh=mesh)
+    rng = np.random.default_rng(0)
+    # 3 chunks of 37 rows: chunk boundaries straddle the 8-shard row
+    # partition, forcing boundary fragments to move D2D at assembly
+    for ci in range(3):
+        st.add(ci, [EncodedColumn(T_REAL,
+                                  rng.normal(size=37).astype(np.float64))])
+    out = st.assemble()
+    assert 0 in out
+    moved = st._moved_rows
+    assert moved > 0, "expected boundary-straddling fragments"
+    d2d = reg.value("h2o3_d2d_pipeline_bytes_total",
+                    {"pipeline": "ingest"}) - d2d0
+    assert d2d >= moved * 4, (d2d, moved)   # ≥ one f32 lane per moved row
+    assert reg.value("h2o3_d2d_bytes_total") >= d2d
+
+
+def test_stale_observation_records_nothing():
+    """A chunk whose shards were all ready before the first poll (the
+    host sat in e.g. a cold compile between dispatch and observation)
+    carries no order signal: it must be reported stale, kept OUT of
+    the step/wait/straggler metrics, and excluded from the per-train
+    aggregates instead of contributing a fabricated ~1.0 ratio."""
+    import jax
+    from h2o3_tpu.parallel.mesh import partitioner
+    from h2o3_tpu.parallel.shardstats import (merge_observations,
+                                              observe_sharded_step)
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device mesh")
+    partn = partitioner()
+    arr = partn.shard_rows(np.ones((8 * 16, 3), np.float32))
+    jax.block_until_ready(arr)           # long done before the "poll"
+    reg = telemetry.registry()
+    g0 = reg.value("h2o3_straggler_ratio", {"algo": "stale_probe"})
+    obs = observe_sharded_step(arr, time.perf_counter() - 5.0,
+                               algo="stale_probe")
+    assert obs == {"n_shards": len(jax.devices()), "stale": True}
+    assert reg.value("h2o3_straggler_ratio",
+                     {"algo": "stale_probe"}) == g0
+    # merge: stale chunks counted, aggregates from fresh chunks only
+    fresh = {"n_shards": 8, "slowest_ms": 10.0, "median_ms": 5.0,
+             "straggler_ratio": 2.0, "collective_wait_ms": 4.0,
+             "collective_wait_share": 0.4}
+    merged = merge_observations([obs, fresh, None])
+    assert merged["chunks_observed"] == 1
+    assert merged["chunks_stale"] == 1
+    assert merged["straggler_ratio"] == 2.0
+    # every chunk stale → counts only, no invented ratios (n_shards
+    # stays present: test_spmd_parity indexes it whenever coll exists)
+    all_stale = merge_observations([obs, dict(obs)])
+    assert all_stale == {"chunks_observed": 0, "chunks_stale": 2,
+                         "n_shards": len(jax.devices())}
+
+
+def test_partially_censored_observation_uses_live_shards(monkeypatch):
+    """Shards already done at the first poll sweep (the host was
+    delayed, but not long enough for the WHOLE step to finish) are
+    censored: step/wait/ratio come from the live completions only, so
+    host-delay time never lands in the step histogram or drags the
+    straggler ratio toward a fabricated 1.0."""
+    import jax
+    from h2o3_tpu.parallel import shardstats
+    from h2o3_tpu.parallel.mesh import partitioner
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device mesh")
+    nd = len(jax.devices())
+    arr = partitioner().shard_rows(np.ones((nd * 16, 3), np.float32))
+    jax.block_until_ready(arr)
+    # host delay D=50ms: all but the two slowest shards were already
+    # done at the first sweep and read the identical censored D
+    fake = [0.050] * (nd - 2) + [0.060, 0.120]
+    monkeypatch.setattr(shardstats, "_shard_ready_times",
+                        lambda shards, t0: (fake, set(range(nd - 2))))
+    obs = shardstats.observe_sharded_step(arr, 0.0, algo="censor_probe")
+    assert obs["n_shards"] == nd and obs["shards_censored"] == nd - 2
+    # live shards only: slowest 120ms, median of [60, 120] = 90ms
+    assert obs["slowest_ms"] == 120.0 and obs["median_ms"] == 90.0
+    assert obs["straggler_ratio"] == round(120.0 / 90.0, 4)
+    # the censored 50ms host-delay readings never hit the histogram
+    reg = telemetry.registry()
+    sample = next(s for s in reg.samples()
+                  if s["name"] == "h2o3_shard_step_ms"
+                  and s["labels"].get("algo") == "censor_probe")
+    assert sample["count"] == 2
+    # fewer than two live completions → stale, nothing recorded
+    monkeypatch.setattr(shardstats, "_shard_ready_times",
+                        lambda shards, t0: (fake, set(range(nd - 1))))
+    assert shardstats.observe_sharded_step(
+        arr, 0.0, algo="censor_probe2") == {"n_shards": nd,
+                                            "stale": True}
+
+
+def test_slow_request_exemplars_age_on_wall_clock():
+    """Exemplar generations must rotate on wall clock too: at low QPS
+    the 4096-request reservoir wrap can take days, and a cold-start
+    compile-era top-k would otherwise mask every later spike."""
+    from h2o3_tpu.serve.stats import _SLOW_WINDOW_S, ServeStats
+    st = ServeStats(model="exemplar_age_probe")
+    st.record_request(500.0, 1, trace_id="a" * 32)   # warmup-era entry
+    # first window elapses: next request rotates it into the previous
+    # generation — still scrapeable for one full window
+    st._slow_t0 -= _SLOW_WINDOW_S + 1
+    st.record_request(1.0, 1, trace_id="b" * 32)
+    lats = {e["latency_ms"] for e in st.slow_requests()}
+    assert 500.0 in lats and 1.0 in lats
+    # second window: the warmup entry ages out entirely; a later spike
+    # smaller than it now tops the exemplars instead of being masked
+    st._slow_t0 -= _SLOW_WINDOW_S + 1
+    st.record_request(2.0, 1, trace_id="c" * 32)
+    st.record_request(150.0, 1, trace_id="d" * 32)   # the real spike
+    slow = st.slow_requests()
+    lats = [e["latency_ms"] for e in slow]
+    assert 500.0 not in lats
+    assert slow[0]["latency_ms"] == 150.0
+    assert slow[0]["trace_id"] == "d" * 32
+
+
+def test_failed_requests_enter_slow_exemplars():
+    """A deadline blowout or device error is slower than every
+    successful request — it must appear in the slow-request exemplars
+    (flagged error=) while leaving the success-only reservoir and
+    request counters untouched."""
+    from h2o3_tpu.serve.stats import ServeStats
+    st = ServeStats(model="fail_probe")
+    st.record_request(5.0, 1, trace_id="a" * 32)
+    st.record_failed_exemplar(250.0, 2, "b" * 32, "deadline")
+    slow = st.slow_requests()
+    assert slow[0]["latency_ms"] == 250.0
+    assert slow[0]["error"] == "deadline"
+    assert slow[0]["trace_id"] == "b" * 32
+    assert "error" not in slow[1]            # successes stay unflagged
+    snap = st.snapshot()
+    assert snap["requests"] == 1             # failure not double-counted
+    assert snap["p99_ms"] is not None and snap["p99_ms"] <= 5.0
+
+
+def test_fat_peer_body_is_size_capped(monkeypatch):
+    """A peer entry misconfigured to point at something fat and fast (a
+    log stream, a file server) must fail the fetch at PEER_MAX_BYTES
+    instead of buffering gigabytes inside the observing process."""
+    import socket
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    stop = threading.Event()
+    blob = b"x" * (1 << 20)
+
+    def firehose():
+        try:
+            conn, _ = srv.accept()
+            conn.recv(65536)
+            conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                         b"Content-Length: 1000000000\r\n\r\n")
+            while not stop.is_set():
+                try:
+                    conn.sendall(blob)
+                except OSError:
+                    break
+        except OSError:
+            pass
+
+    threading.Thread(target=firehose, daemon=True).start()
+    monkeypatch.setattr(telesnap, "PEER_MAX_BYTES", 4 << 20)
+    with pytest.raises(ValueError, match="exceeded"):
+        telesnap.fetch_peer_snapshot(f"127.0.0.1:{port}", timeout=5.0)
+    stop.set()
+    srv.close()
+    # and the scrape path reports it as a failed peer, never fatal
+    monkeypatch.setenv("H2O3_TELEMETRY_PEERS", f"127.0.0.1:{port}")
+    _samples, meta = telesnap.cluster_samples()
+    assert meta["peers_failed"]

@@ -77,8 +77,13 @@ def test_gbm_sharded_matches_single_device():
                        X, y, **GBM_PARAMS)
     m8, p8, _ = _train(H2OGradientBoostingEstimator,
                        make_mesh(n_data=4, n_model=2), X, y, **GBM_PARAMS)
-    assert m8.output["spmd"] == {"n_data": 4, "n_model": 2,
-                                 "model_axis_split_search": True}
+    spmd8 = dict(m8.output["spmd"])
+    # collective/straggler attribution rides along on sharded trains
+    # (ISSUE 8) — layout keys unchanged
+    coll = spmd8.pop("collective", None)
+    assert spmd8 == {"n_data": 4, "n_model": 2,
+                     "model_axis_split_search": True}
+    assert coll is None or coll["n_shards"] == 8
     assert m1.output["spmd"]["n_data"] == 1
     np.testing.assert_allclose(p1, p8, rtol=0, atol=1e-5)
     assert abs(m1.training_metrics.auc - m8.training_metrics.auc) < 2e-3

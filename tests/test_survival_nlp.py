@@ -106,6 +106,7 @@ def test_word2vec_save_load(tmp_path):
     assert m2.vocab == w2v.model.vocab
 
 
+@pytest.mark.slow  # ~40s: heavy tier, driver runs with --runslow
 def test_psvm_rbf_nonlinear():
     from sklearn.datasets import make_circles
     X, y = make_circles(n_samples=1200, noise=0.08, factor=0.4,
@@ -200,6 +201,7 @@ def test_upliftdrf_handles_nas():
     assert abs(u.mean() - 0.3) < 0.15   # homogeneous true uplift 0.3
 
 
+@pytest.mark.slow  # ~40s: heavy tier, driver runs with --runslow
 def test_psvm_exact_dual_vs_sklearn(tmp_path):
     """Exact-dual path (n <= H2O3_PSVM_EXACT_MAX): real support vectors
     + kernel scoring must track sklearn.svm.SVC on the same QP
@@ -246,6 +248,7 @@ def test_psvm_exact_dual_vs_sklearn(tmp_path):
     np.testing.assert_allclose(d1, d2, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow  # ~60s: heavy tier, driver runs with --runslow
 def test_psvm_class_weights_shift_boundary():
     """positive_weight/negative_weight (PSVM.java c_pos/c_neg) skew the
     box constraints: upweighting the positive class must not lower

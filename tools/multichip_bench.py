@@ -96,20 +96,37 @@ def main():
             assert m.output["spmd"]["n_data"] == n, m.output["spmd"]
             loop_s = m.output["training_loop_seconds"]
             rps = rows * m.ntrees_built / loop_s
+            # collective/straggler attribution (ISSUE 8): when the
+            # scaling verdict fails, these say whether the loss is a
+            # straggling shard or barrier wait — per device count
+            coll = m.output["spmd"].get("collective") or {}
             points.append({
                 "n_devices": n, "loop_s": round(loop_s, 3),
                 "warm_train_s": round(total, 3),
                 "rows_per_sec": round(rps, 1),
                 "rows_per_sec_per_chip": round(rps / n, 1),
-                "auc": round(float(m.training_metrics.auc), 4)})
+                "auc": round(float(m.training_metrics.auc), 4),
+                "straggler_ratio": coll.get("straggler_ratio"),
+                "collective_wait_share": coll.get("collective_wait_share"),
+                "collective_wait_ms": coll.get("collective_wait_ms")})
             log(f"n={n}: loop={loop_s:.2f}s rows/s={rps:,.0f} "
-                f"({rps / n:,.0f}/chip) AUC={points[-1]['auc']}")
+                f"({rps / n:,.0f}/chip) AUC={points[-1]['auc']} "
+                f"straggler={coll.get('straggler_ratio')} "
+                f"wait_share={coll.get('collective_wait_share')}")
     finally:
         set_mesh(old_mesh)
 
     out = {"metric": "multichip_gbm_scaling", "backend": backend,
            "rows": rows, "trees": trees, "depth": depth, "nbins": nbins,
            "points": points, "min_efficiency": min_eff}
+    # headline attribution from the WIDEST measured mesh — so a scaling
+    # regression is explainable from the BENCH/MULTICHIP JSON alone
+    widest = max((p for p in points
+                  if p.get("straggler_ratio") is not None),
+                 key=lambda p: p["n_devices"], default=None)
+    if widest is not None:
+        out["straggler_ratio"] = widest["straggler_ratio"]
+        out["collective_wait_share"] = widest["collective_wait_share"]
     per_chip = {p["n_devices"]: p["rows_per_sec_per_chip"] for p in points}
     if 1 in per_chip and 8 in per_chip:
         eff = per_chip[8] / per_chip[1]

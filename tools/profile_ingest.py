@@ -86,9 +86,15 @@ def main():
     h2d0 = telemetry.registry().value("h2o3_h2d_bytes_total")
     stages0 = telemetry.stage_seconds("ingest.")
 
-    t0 = time.perf_counter()
-    fr = parse([path], setup)
-    wall = time.perf_counter() - t0
+    # optional xprof capture of the parse (shared helper, SNIPPETS [1]
+    # shape): --xprof-trace [DIR] / XPROF_TRACE_DIR, else a no-op
+    from h2o3_tpu.telemetry.profiling import last_trace_dir, profile
+    with profile("ingest_parse", log=log):
+        # timed INSIDE the capture: start/stop_trace (trace
+        # serialization is hundreds of ms) must not skew the verdict
+        t0 = time.perf_counter()
+        fr = parse([path], setup)
+        wall = time.perf_counter() - t0
 
     # ONE scrape for every stage read (each samples() pass runs the
     # collector views, incl. an O(live arrays) device-memory walk)
@@ -121,7 +127,8 @@ def main():
            "h2d_bytes": round(
                telemetry.registry().value("h2o3_h2d_bytes_total") - h2d0),
            "parse_wall_s": round(wall, 4),
-           "parse_rows_per_s": round(fr.nrow / wall, 1)}
+           "parse_rows_per_s": round(fr.nrow / wall, 1),
+           "xprof_trace_dir": last_trace_dir()}
     print(json.dumps(out))
     return out
 

@@ -85,12 +85,18 @@ def main():
     single = dep.stats.snapshot()
 
     # phase 2: batched requests (throughput path) — fresh stage counters
-    # come from the delta against phase 1's snapshot
-    t0 = time.time()
+    # come from the delta against phase 1's snapshot. Optionally under
+    # an xprof capture (shared helper, --xprof-trace / XPROF_TRACE_DIR)
+    # for kernel-level attribution of the scoring dispatches
+    from h2o3_tpu.telemetry.profiling import last_trace_dir, profile
     n_batches = 32
-    for i in range(n_batches):
-        dep.predict_rows(pool[:bsz])
-    batch_wall = time.time() - t0
+    with profile("serve_batched", log=log):
+        # timed INSIDE the capture: start/stop_trace (trace
+        # serialization is hundreds of ms) must not skew the verdict
+        t0 = time.time()
+        for i in range(n_batches):
+            dep.predict_rows(pool[:bsz])
+        batch_wall = time.time() - t0
     total = dep.stats.snapshot()
 
     # phase 3: SAME load through the columnar response path — one
@@ -152,6 +158,7 @@ def main():
         # span-level view of the same run (counts prove every batch got
         # stage spans; seconds match the stage_ms sums above)
         "spans": telemetry.stage_seconds("serve."),
+        "xprof_trace_dir": last_trace_dir(),
     }
     serve.undeploy(model.key)
     print(json.dumps(out))

@@ -84,20 +84,7 @@ def _train(fr, yname):
     return gbm.model, time.time() - t0
 
 
-def _xprof_dir():
-    """Trace-export destination from --xprof-trace [DIR] / XPROF_TRACE_DIR
-    (None = no capture)."""
-    if "--xprof-trace" in sys.argv:
-        i = sys.argv.index("--xprof-trace")
-        if i + 1 < len(sys.argv) and not sys.argv[i + 1].startswith("-"):
-            return sys.argv[i + 1]
-        return os.path.join("/tmp", f"h2o3_xprof_{int(time.time())}")
-    return os.environ.get("XPROF_TRACE_DIR") or None
-
-
 def main():
-    import contextlib
-
     import jax
     from h2o3_tpu import telemetry
     from h2o3_tpu.cluster_boot import setup_compilation_cache
@@ -118,20 +105,14 @@ def main():
     stages0 = telemetry.stage_seconds("train.")
     compiles0 = telemetry.registry().value("h2o3_xla_compiles_total")
     h2d0 = telemetry.registry().value("h2o3_h2d_bytes_total")
-    trace_dir = _xprof_dir()
-    trace_cm = contextlib.nullcontext()
-    if trace_dir:
-        # kernel-level attribution of the WARM loop: the capture holds
-        # the per-level histogram kernels and (on a multi-shard mesh)
-        # the psum all-reduce on the device timeline
-        try:
-            trace_cm = jax.profiler.trace(trace_dir)
-            log(f"xprof: tracing warm train -> {trace_dir}")
-        except Exception as e:   # profiling must never sink the profile
-            log(f"xprof trace unavailable: {e!r}")
-            trace_dir = None
-    with trace_cm:
+    # kernel-level attribution of the WARM loop (shared xprof helper,
+    # telemetry/profiling.py — the capture holds the per-level histogram
+    # kernels and, on a multi-shard mesh, the psum all-reduce on the
+    # device timeline); no-op unless --xprof-trace / XPROF_TRACE_DIR
+    from h2o3_tpu.telemetry.profiling import last_trace_dir, profile
+    with profile("warm_train", log=log):
         model, warm_total = _train(fr, yname)
+    trace_dir = last_trace_dir()
     warm_compiles = telemetry.registry().value(
         "h2o3_xla_compiles_total") - compiles0
     warm_h2d = telemetry.registry().value("h2o3_h2d_bytes_total") - h2d0
