@@ -391,6 +391,10 @@ def main():
     # the recovery machinery (retry, checkpoint resume, OOM degrade,
     # circuit breaker) the same way transfer budgets are guarded.
     # Runs AFTER the timed rounds so injected faults never skew them.
+    # Since ISSUE 9 the round also SIGKILLs a worker process mid-train
+    # and asserts boot recovery resumes it bit-identically, emitting
+    # resilience.{recovered_after_restart,restart_recovery_s}
+    # (H2O3_BENCH_CHAOS_KILL=0 skips that probe).
     if os.environ.get("H2O3_BENCH_CHAOS", "1") not in ("0", "false", ""):
         try:
             sys.path.insert(0, os.path.join(os.path.dirname(

@@ -72,7 +72,9 @@ def cloud_v3() -> Dict:
 
 def job_v3(job, dest_key: Optional[str] = None, dest_type: str = "Key<Model>") -> Dict:
     from h2o3_tpu import jobs as jobs_mod
-    status_map = {jobs_mod.RUNNING: "RUNNING", jobs_mod.DONE: "DONE",
+    status_map = {jobs_mod.RUNNING: "RUNNING",
+                  jobs_mod.RECOVERING: "RECOVERING",
+                  jobs_mod.DONE: "DONE",
                   jobs_mod.FAILED: "FAILED", jobs_mod.CANCELLED: "CANCELLED"}
     msec = int(((job.end_time or time.time()) - job.start_time) * 1000)
     return {
@@ -82,7 +84,9 @@ def job_v3(job, dest_key: Optional[str] = None, dest_type: str = "Key<Model>") -
         "description": job.description,
         "status": status_map.get(job.status, str(job.status)),
         "progress": float(job.progress),
-        "progress_msg": "Running" if job.status == jobs_mod.RUNNING else "Done",
+        "progress_msg": ("Recovering" if job.status == jobs_mod.RECOVERING
+                         else "Running" if job.status == jobs_mod.RUNNING
+                         else "Done"),
         "start_time": int(job.start_time * 1000),
         "msec": msec,
         "dest": keyref(dest_key, dest_type),

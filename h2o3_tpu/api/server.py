@@ -730,6 +730,32 @@ def _faults_clear(params, body):
             "spec": None, "rules": [], "fired_total": 0}
 
 
+# ---------------- restart recovery (h2o3_tpu.recovery) ------------------
+
+
+@route("GET", "/3/Recovery")
+def _recovery_get(params, body):
+    """Restart-recovery state: the durable dir, pending manifests (with
+    their newest resumable checkpoint), and the last boot scan's report
+    — what an operator checks after a pod restart to see which trains
+    came back."""
+    from h2o3_tpu import recovery
+    manifests = []
+    if recovery.enabled():
+        # read-only scan: a monitoring poll must not quarantine corrupt
+        # manifests aside before the next BOOT's scan reports them
+        entries, corrupt = recovery.scan(quarantine=False)
+        manifests = entries
+    else:
+        corrupt = []
+    return {"__meta": {"schema_version": 3, "schema_name": "RecoveryV3"},
+            "enabled": recovery.enabled(),
+            "dir": recovery.recovery_dir(),
+            "manifests": manifests,
+            "corrupt": corrupt,
+            "last_boot": recovery.last_report()}
+
+
 @route("POST", "/3/Predictions/models/{model}/rows")
 def _predict_rows(params, body, model):
     """Row-level scoring through the micro-batcher: JSON rows in

@@ -22,6 +22,15 @@ Env contract (set by h2o-k8s/manifests or the h2o-helm chart):
                             restart's time-to-first-model skips the
                             cold train-step compile (~2 minutes at the
                             10M-row bench shape).
+  H2O3_RECOVERY_DIR         durable restart-recovery root (mount a PVC).
+                            When set, boot scans it for trains the
+                            PREVIOUS process left interrupted (crash /
+                            kill -9 / pod eviction), re-registers them
+                            as RECOVERING jobs and resumes them from
+                            their in-training checkpoints under the new
+                            process's mesh — plus age-based GC of
+                            orphaned checkpoint artifacts. Unset =
+                            checked no-op (h2o3_tpu/recovery.py).
 
 Run: ``python -m h2o3_tpu.cluster_boot``
 """
@@ -116,6 +125,25 @@ def resolve_boot_config(env: Optional[Mapping[str, str]] = None,
         n_model=int(env.get("H2O3_MESH_MODEL", "1")))
 
 
+def run_boot_recovery(wait: bool = False) -> Optional[dict]:
+    """Boot-time restart recovery (h2o3_tpu/recovery.py): rediscover
+    trains a killed predecessor process left interrupted and resume
+    them from their in-training checkpoints. Checked no-op when
+    ``H2O3_RECOVERY_DIR`` is unset — the recovery module is not even
+    imported. NEVER raises: a broken recovery dir must not wedge
+    process startup (the scan itself already isolates per-manifest
+    failures; this guard covers the rest)."""
+    if not (os.environ.get("H2O3_RECOVERY_DIR") or "").strip():
+        return None
+    try:
+        from h2o3_tpu import recovery
+        return recovery.recover_at_boot(wait=wait)
+    except Exception as e:   # noqa: BLE001 — boot must proceed
+        from h2o3_tpu.log import warn
+        warn("boot recovery failed (%s) — continuing boot without it", e)
+        return None
+
+
 def main() -> None:
     import h2o3_tpu as h2o
     setup_compilation_cache()
@@ -127,6 +155,11 @@ def main() -> None:
              n_model=cfg.n_model,
              port=cfg.rest_port)
     import jax
+    if cfg.process_id == 0:
+        # the coordinator drives training, so it owns recovery; resumes
+        # run in the background — the REST/readiness port must come up
+        # immediately, recovered models appear on /3/Models as they land
+        run_boot_recovery(wait=False)
     if cfg.process_id != 0:
         # workers answer the web port too — but only with a minimal
         # health responder so the /3/Cloud readiness probe passes on

@@ -16,7 +16,11 @@ Spec grammar (``H2O3_FAULTS`` env var or ``POST /3/Faults?spec=...``)::
 - ``site``      — one of the instrumented points: ``h2d``, ``d2h``,
                   ``compile``, ``execute``, ``persist``, ``collective``
                   (the ICI histogram-psum seam — checked at the train
-                  chunk dispatch whenever the mesh has >1 data shard)
+                  chunk dispatch whenever the mesh has >1 data shard),
+                  ``boot`` (the restart-recovery resume path — checked
+                  per manifest in recovery.recover_at_boot; an injected
+                  boot fault must WARN and continue, never wedge
+                  startup — tests/test_restart_recovery.py)
                   (free-form strings; unknown sites simply never fire).
 - ``@pipeline`` — optional filter on the calling pipeline label
                   (``ingest``/``train``/``serve``); omitted = any.

@@ -32,11 +32,16 @@ import uuid
 from typing import Any, Callable, Dict, Optional
 
 RUNNING = "RUNNING"
+# a boot-time restart-recovery resume (h2o3_tpu.recovery): semantically
+# RUNNING — supervised by the watchdog, pollable on /3/Jobs — but
+# distinguishable so clients can tell a recovered train from a fresh one
+RECOVERING = "RECOVERING"
 DONE = "DONE"
 FAILED = "FAILED"
 CANCELLED = "CANCELLED"
 
 _TERMINAL = (DONE, FAILED, CANCELLED)
+_ACTIVE = (RUNNING, RECOVERING)
 
 _REGISTRY: Dict[str, "Job"] = {}
 _LOCK = threading.Lock()
@@ -113,7 +118,7 @@ def _watch_loop() -> None:
         now = time.time()
         n_stalled = 0
         for j in list_jobs():
-            if j.status != RUNNING:
+            if j.status not in _ACTIVE:
                 continue
             if (j.max_runtime_secs and not j.cancel_requested
                     and now - j.start_time > j.max_runtime_secs):

@@ -552,11 +552,28 @@ class H2ODeepLearningEstimator(ModelBuilder):
                               if prior is not None else 0.0)
         t0 = time.time()
         history = []
+        # cancel/max_runtime polling (the last ROADMAP-listed algo
+        # without it — GLM/KMeans landed in PR 7): run_epoch dispatches
+        # ASYNCHRONOUSLY, so an unbounded loop would enqueue every
+        # remaining epoch before a watchdog cancel could land — the
+        # cooperative poll would see nothing left to skip. Poll BEFORE
+        # each dispatch and keep at most two epochs in flight by
+        # blocking on epoch e-1's loss scalar before dispatching e+1:
+        # compute still overlaps host work, but a cancel takes effect
+        # within ~one epoch instead of at the end of the train.
+        prev_loss = None
+        e = 0
         for e in range(n_epochs):
+            if job.cancel_requested:
+                e -= 1      # this epoch never dispatched
+                break
             key, ekey = jax.random.split(key)
+            if prev_loss is not None:
+                jax.block_until_ready(prev_loss)
             net, opt0, samples, mloss = run_epoch(
                 net, opt0, samples, ekey, Xs, y, w,
                 jnp.int32((e * batch) % max(padded, 1)))
+            prev_loss = mloss
             job.set_progress((e + 1) / n_epochs)
             if keeper.rounds > 0 or e == n_epochs - 1:
                 entry = self._score(net, act, Xs, y, w, valid_spec, task,

@@ -618,7 +618,7 @@ class H2ORandomForestEstimator(ModelBuilder):
                            if getattr(prior, "_node_w", None) is not None
                            else None),
             }
-        model = DRFModel(f"{self.algo}_{id(self) & 0xffffff:x}", self.params,
+        model = DRFModel(self._model_key(), self.params,
                          spec, trees_host,
                          bm.edges if bm is not None else [],
                          bm.n_bins if bm is not None else cfg.n_bins,

@@ -923,20 +923,6 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         if spec.offset is not None:
             raise NotImplementedError(
                 "offset_column is not supported in streaming mode")
-        if p.get("in_training_checkpoints_dir"):
-            # the streamed path writes no in-training checkpoints yet
-            # (ROADMAP gap) — warn instead of silently dropping the
-            # user's explicit resumability request (this path is also
-            # the OOM-degrade target, where raising would defeat the
-            # degrade)
-            from h2o3_tpu.log import warn as _warn
-            _warn("gbm: in_training_checkpoints_dir is not honored in "
-                  "streaming (memory-pressure) mode — no mid-train "
-                  "checkpoints will be written")
-        if p.get("checkpoint"):
-            raise NotImplementedError(
-                "checkpoint continuation is not supported in streaming "
-                "mode")
         if p.get("sample_rate_per_class"):
             raise NotImplementedError(
                 "sample_rate_per_class is not supported in streaming "
@@ -966,17 +952,53 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         budget = memman.manager().budget
         chunk_rows = int(max(min(budget // max(spec.n_features * 4 * 4, 1),
                                  rows), 16384))
-        f0 = float(jax.device_get(dist.init_f0(jnp.asarray(y_host),
-                                               jnp.asarray(w_host))))
+        padded = int(spec.y.shape[0])
+        # checkpoint continuation (formerly a streamed-path fail-fast,
+        # ISSUE 9 satellite): the dense resolver's full compatibility
+        # contract applies; the resume state is the saved f32 margin
+        # plus the tree cursor (start_trees), so a resumed streamed
+        # train is bit-identical to an uninterrupted one — and to the
+        # DENSE resume on fully-resident data
+        prior = self._resolve_checkpoint(dist_name, spec)
+        start_trees = prior.ntrees_built if prior is not None else 0
+        margin0 = None
+        if prior is not None:
+            f0 = float(np.asarray(prior.f0).reshape(-1)[0])
+            rm = getattr(prior, "_resume_margin", None)
+            sig = getattr(prior, "_resume_sig", None)
+            sig_ok = (sig is None
+                      or np.array_equal(np.asarray(sig),
+                                        _spec_signature(spec)))
+            if rm is not None and sig_ok \
+                    and np.asarray(rm).shape == (padded,):
+                margin0 = np.asarray(rm, np.float32)
+            else:
+                from h2o3_tpu.log import warn as _warn
+                if rm is not None and not sig_ok:
+                    _warn("checkpoint resume margin belongs to "
+                          "different training data — recomputing from "
+                          "trees")
+                # recompute chunk-wise: the whole host matrix must
+                # never upload at once on this memory-pressure path
+                margin0 = np.empty(rows, np.float32)
+                for s in range(0, rows, chunk_rows):
+                    e = min(s + chunk_rows, rows)
+                    margin0[s:e] = np.asarray(jax.device_get(
+                        prior._margin_matrix(jnp.asarray(X_host[s:e]))
+                        .astype(jnp.float32)))
+        else:
+            f0 = float(jax.device_get(dist.init_f0(jnp.asarray(y_host),
+                                                   jnp.asarray(w_host))))
         ntrees = int(p["ntrees"])
-        lr = float(p["learn_rate"])
+        ntrees_new = ntrees - start_trees
         anneal = float(p.get("learn_rate_annealing", 1.0) or 1.0)
+        lr = float(p["learn_rate"]) * anneal ** start_trees
         col_rate = (float(p.get("col_sample_rate", 1.0))
                     * float(p.get("col_sample_rate_per_tree", 1.0)))
         seed = int(p.get("seed", -1) or -1)
         key = jax.random.PRNGKey(seed if seed != -1 else 0)
         chunks = StreamedChunks(X_host, y_host, w_host, f0, chunk_rows,
-                                padded_rows=int(spec.y.shape[0]))
+                                padded_rows=padded, margin0=margin0)
         # cancel propagation into the streamed pipeline: the level
         # passes poll this BETWEEN levels (never mid leaf-apply), so a
         # REST cancel / watchdog max_runtime kill lands promptly even
@@ -984,9 +1006,100 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         chunks.cancel_check = lambda: job.cancel_requested
         from h2o3_tpu.jobs import JobCancelled
         trees = []
+
+        def build_model(trees_list):
+            """Partial/final GBMModel from the committed streamed trees
+            (prior trees prepended, dense-_finalize shape) — shared by
+            the in-training checkpoint commits and the train tail."""
+            T = len(trees_list)
+            th = {k: np.stack([tr[k] for tr in trees_list]) for k in
+                  ("feat", "thr", "na_left", "is_split", "value",
+                   "node_w")}
+            if prior is not None:
+                th = {
+                    "feat": np.concatenate(
+                        [np.asarray(prior._feat), th["feat"]]),
+                    "thr": np.concatenate(
+                        [np.asarray(prior._thr), th["thr"]]),
+                    "na_left": np.concatenate(
+                        [np.asarray(prior._na_left), th["na_left"]]),
+                    "is_split": np.concatenate(
+                        [np.asarray(prior._is_split), th["is_split"]]),
+                    "value": np.concatenate(
+                        [np.asarray(prior._value), th["value"]]),
+                    "node_w": (np.concatenate(
+                        [np.asarray(prior._node_w), th["node_w"]])
+                        if getattr(prior, "_node_w", None) is not None
+                        else None),
+                }
+            m = GBMModel(self._model_key(), p, spec,
+                         dist_name, np.float32(f0), th, [],
+                         cfg.n_bins, cfg.max_depth, start_trees + T,
+                         spec.nclasses)
+            gains = np.stack([tr["gain"] for tr in trees_list])
+            feat = np.stack([tr["feat"] for tr in trees_list])
+            vi = np.zeros(len(spec.names))
+            live = feat >= 0
+            np.add.at(vi, feat[live], gains[live])
+            if prior is not None:
+                pv = prior.output.get("variable_importances")
+                if pv:
+                    lut = {nn: i for i, nn in enumerate(spec.names)}
+                    for nn, g in zip(pv["variable"],
+                                     pv["relative_importance"]):
+                        if nn in lut:
+                            vi[lut[nn]] += g
+            order = np.argsort(-vi)
+            rel = vi / vi.max() if vi.max() > 0 else vi
+            m.output["variable_importances"] = {
+                "variable": [spec.names[i] for i in order],
+                "relative_importance": vi[order].tolist(),
+                "scaled_importance": rel[order].tolist(),
+                "percentage": (vi[order] / vi.sum() if vi.sum() > 0
+                               else vi[order]).tolist()}
+            return m
+
+        def attach_resume_state(m):
+            """The streamed resume state: the exact f32 margin at the
+            committed tree count (window-cursor = ntrees_built) + the
+            PR-6 data signature, so resumes are bit-identical and
+            never applied to a different frame."""
+            mfull = chunks.gather_margin()
+            mpad = np.full(padded, np.float32(f0), np.float32)
+            mpad[:rows] = mfull      # pad rows carry w=0 everywhere
+            m._resume_margin = mpad
+            m._resume_sig = _spec_signature(spec)
+
+        # in-training checkpoints on the resident-window path (formerly
+        # a warn-and-drop): every tree_interval committed trees persist
+        # a resumable artifact, same contract as the dense path
+        ckpt_dir = p.get("in_training_checkpoints_dir")
+        ckpt_interval = max(int(
+            p.get("in_training_checkpoints_tree_interval", 1) or 1), 1)
+        ckpt_on = bool(ckpt_dir)
+        trees_since_ckpt = 0
+
+        def commit_ckpt():
+            # advisory end to end (dense commit_ckpt contract): a
+            # checkpoint write must neither kill a healthy train nor
+            # mask the original error on the failure-path commit
+            try:
+                from h2o3_tpu.models.model_base import \
+                    persist_in_training_ckpt
+                m = build_model(trees)
+                attach_resume_state(m)
+                persist_in_training_ckpt(m, self.algo, ckpt_dir)
+            except Exception as ce:  # noqa: BLE001 — advisory only
+                from h2o3_tpu.log import warn as _warn
+                _warn("%s: streamed in-training checkpoint commit "
+                      "failed: %s", self.algo, ce)
+
         t0 = time.time()
-        for t in range(ntrees):
-            tkey = jax.random.fold_in(key, t)
+        for t in range(ntrees_new):
+            # global tree index keys the RNG (dense start_idx contract)
+            # so a resumed train draws the same samples the
+            # uninterrupted one would have
+            tkey = jax.random.fold_in(key, start_trees + t)
             col_mask = None
             if col_rate < 1.0:
                 col_mask = (jax.random.uniform(
@@ -1003,14 +1116,29 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                 # only fires between level passes, before leaf apply) —
                 # drop it and finalize the committed trees
                 break
+            except BaseException:
+                # NO failure-path commit here (unlike the dense path,
+                # whose per-chunk margin is an immutable device array
+                # rebound only at commit points): the streamed grower
+                # mutates margin_host chunk-by-chunk DURING leaf apply,
+                # so a mid-tree error leaves margins that partially
+                # include the failed tree — committing them would
+                # silently break resume bit-identity. The last interval
+                # commit is the resumable prefix.
+                raise
             # lr-scale values like the dense finalize does (float64
             # product rounded once at model construction — bit-matching
             # `val * lrs[:, None]` in _finalize)
             tree = dict(tree)
             tree["value"] = tree["value"].astype(np.float64) * lr
             trees.append(tree)
+            trees_since_ckpt += 1
             lr *= anneal
-            job.set_progress((t + 1) / ntrees)
+            if ckpt_on and trees_since_ckpt >= ckpt_interval \
+                    and len(trees) < ntrees_new:
+                commit_ckpt()
+                trees_since_ckpt = 0
+            job.set_progress((t + 1) / ntrees_new)
             if job.cancel_requested:
                 break
         if not trees:
@@ -1019,25 +1147,28 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         margin_host = chunks.gather_margin()
         t_loop = time.time() - t0
         T = len(trees)
-        trees_host = {k: np.stack([tr[k] for tr in trees]) for k in
-                      ("feat", "thr", "na_left", "is_split", "value",
-                       "node_w")}
-        model = GBMModel(f"{self.algo}_{id(self) & 0xffffff:x}", p, spec,
-                         dist_name, np.float32(f0), trees_host, [],
-                         cfg.n_bins, cfg.max_depth, T, spec.nclasses)
-        gains = np.stack([tr["gain"] for tr in trees])
-        feat = trees_host["feat"]
-        vi = np.zeros(len(spec.names))
-        live = feat >= 0
-        np.add.at(vi, feat[live], gains[live])
-        order = np.argsort(-vi)
-        rel = vi / vi.max() if vi.max() > 0 else vi
-        model.output["variable_importances"] = {
-            "variable": [spec.names[i] for i in order],
-            "relative_importance": vi[order].tolist(),
-            "scaled_importance": rel[order].tolist(),
-            "percentage": (vi[order] / vi.sum() if vi.sum() > 0
-                           else vi[order]).tolist()}
+        model = build_model(trees)
+        if ckpt_on:
+            # final commit: durable artifact kept, DKV `<key>_ckpt`
+            # dropped — the finished model supersedes it (dense/DRF
+            # final=True contract); resume state rides the artifact so
+            # continue-training stays bit-identical. The state is
+            # attached to a COPY (the dense commit_ckpt contract): the
+            # RETURNED model must not pin a dataset-sized margin in the
+            # DKV or serialize it into every later save_model
+            try:
+                import copy as _copy
+
+                from h2o3_tpu.models.model_base import \
+                    persist_in_training_ckpt
+                mfinal = _copy.copy(model)   # shares the tree arrays
+                attach_resume_state(mfinal)
+                persist_in_training_ckpt(mfinal, self.algo, ckpt_dir,
+                                         final=True)
+            except Exception as ce:  # noqa: BLE001 — advisory only
+                from h2o3_tpu.log import warn as _warn
+                _warn("%s: final streamed checkpoint failed: %s",
+                      self.algo, ce)
         model.output["training_loop_seconds"] = t_loop
         model.output["streamed"] = True
         # transfer accounting for the bench guard: h2d bytes per tree vs
@@ -1055,7 +1186,6 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         sp["h2d_bytes_per_tree"] = (
             (sp["h2d_bytes"] - sp["h2d_resident_bytes"]) / T) if T else 0
         model.output["stream_profile"] = sp
-        padded = int(spec.y.shape[0])
         mpad = np.full(padded, f0, np.float32)
         mpad[:rows] = margin_host       # pad rows carry w=0 in metrics
         model.training_metrics = self._metrics_from_margin(
@@ -1245,7 +1375,7 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                            else None),
             }
         f0_host = np.asarray(jax.device_get(f0))
-        model = GBMModel(f"{self.algo}_{id(self) & 0xffffff:x}", self.params,
+        model = GBMModel(self._model_key(), self.params,
                          spec, dist_name, f0_host, trees_host,
                          bm.edges if bm is not None else [],
                          bm.n_bins if bm is not None else cfg.n_bins,

@@ -673,6 +673,15 @@ class ModelBuilder:
         self.params = merged
         self.model: Optional[Model] = None
 
+    def _model_key(self) -> str:
+        """Key the trained model will carry. ``model_id`` wins when set
+        (the reference's Model key naming; the restart-recovery resume
+        passes the interrupted train's original key through it so the
+        resumed checkpoints land under the same artifact names);
+        otherwise the per-builder default."""
+        mid = self.params.get("model_id")
+        return str(mid) if mid else f"{self.algo}_{id(self) & 0xffffff:x}"
+
     def _warn_compat_params(self):
         from h2o3_tpu.log import warn
         for k, dflt in self._compat_defaults.items():
@@ -904,6 +913,20 @@ class ModelBuilder:
         job = Job(f"{self.algo} training", work=1.0,
                   max_runtime_secs=float(
                       self.params.get("max_runtime_secs", 0) or 0))
+        # restart recovery (ISSUE 9): a checkpointing train records a
+        # durable manifest so a killed PROCESS can rediscover and resume
+        # it at the next boot; the env gate keeps the common path one
+        # dict lookup (H2O3_TELEMETRY=0 idiom). A train resumed BY the
+        # recovery scan surfaces as RECOVERING on /3/Jobs.
+        rec_key = None
+        if os.environ.get("H2O3_RECOVERY_DIR"):
+            from h2o3_tpu import jobs as jobs_mod
+            from h2o3_tpu import recovery
+            if recovery.is_resuming():
+                job.status = jobs_mod.RECOVERING
+            if self.params.get("in_training_checkpoints_dir"):
+                rec_key = recovery.record_training(self, job,
+                                                   training_frame, y, spec)
         info("%s train start: %d rows, %d features", self.algo, spec.nrow,
              spec.n_features)
 
@@ -986,6 +1009,12 @@ class ModelBuilder:
                         self._attach_cv(model, training_frame, y, x,
                                         *fold_pass)
             model.output["profile"] = prof.to_dict()
+            if rec_key is not None:
+                # DELIBERATE completion (DONE or a cooperative cancel
+                # that finalized a partial model): the manifest's job is
+                # over — only a crash/kill leaves it for boot recovery
+                from h2o3_tpu import recovery
+                recovery.complete_training(rec_key)
             info("%s train done: %s", self.algo, prof.summary())
             timeline_record("train_done",
                             f"{self.algo} {prof.summary()}")
@@ -998,6 +1027,17 @@ class ModelBuilder:
         def body_spanned(j):
             try:
                 return body(j)
+            except BaseException as e:
+                # a cooperative cancel that unwound before finalize is
+                # still a DELIBERATE end — drop the recovery manifest
+                # so the cancelled train does not auto-resume at the
+                # next boot (crash/kill paths never reach this handler)
+                if rec_key is not None:
+                    from h2o3_tpu.jobs import JobCancelled
+                    if isinstance(e, JobCancelled):
+                        from h2o3_tpu import recovery
+                        recovery.complete_training(rec_key)
+                raise
             finally:
                 # failed/cancelled builds still close their root span
                 if sp_root is not None and sp_root.duration_s is None:

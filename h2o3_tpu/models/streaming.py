@@ -115,7 +115,8 @@ class StreamedChunks:
 
     def __init__(self, X_host: np.ndarray, y_host: np.ndarray,
                  w_host: np.ndarray, f0: float, chunk_rows: int,
-                 padded_rows: Optional[int] = None):
+                 padded_rows: Optional[int] = None,
+                 margin0: Optional[np.ndarray] = None):
         from h2o3_tpu import memman
         rows, F = X_host.shape
         # the dense grower sizes its histogram-precision auto rule by the
@@ -144,8 +145,17 @@ class StreamedChunks:
         if ro is not None and ro != "":
             self.R = max(0, min(int(ro), self.C))   # test/bench override
         self._res: Dict[int, Dict[str, object]] = {}
-        # host mirrors serve the overflow chunks (and the final gather)
-        self.margin_host = np.full(rows, np.float32(f0), np.float32)
+        # host mirrors serve the overflow chunks (and the final gather).
+        # ``margin0`` is checkpoint-resume state (the saved f32 training
+        # margin at the committed tree count): starting from it instead
+        # of the constant f0 is what makes a resumed streamed train
+        # bit-identical to an uninterrupted one (the dense path's
+        # _prior_margin contract)
+        if margin0 is not None:
+            self.margin_host = np.asarray(margin0,
+                                          np.float32)[:rows].copy()
+        else:
+            self.margin_host = np.full(rows, np.float32(f0), np.float32)
         self.nid_host = np.zeros(rows, np.int32)
         self._wt_host: Optional[np.ndarray] = None
         self._wt_dev = None            # full-rows device draw (resident slices)

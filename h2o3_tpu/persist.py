@@ -160,9 +160,14 @@ def save_model(model, path: str = ".", force: bool = False,
     arrays = {k: np.asarray(v) for k, v in model._save_arrays().items()}
     buf = io.BytesIO()
     np.savez(buf, **arrays)
-    with zipfile.ZipFile(out, "w", zipfile.ZIP_DEFLATED) as zf:
+    # tmp + rename (the save_frame contract): a kill -9 mid-write must
+    # never leave a truncated artifact under the final name — the
+    # restart-recovery scan picks the NEWEST <key>_t<n>.zip, so a
+    # half-written newest would permanently shadow the intact one below
+    with zipfile.ZipFile(out + ".tmp", "w", zipfile.ZIP_DEFLATED) as zf:
         zf.writestr("meta.json", json.dumps(meta))
         zf.writestr("arrays.npz", buf.getvalue())
+    os.replace(out + ".tmp", out)
     return out
 
 

@@ -1,0 +1,610 @@
+"""Restart-safe training recovery — the locked-cloud failure model.
+
+Reference: h2o-3's answer to node loss is the locked cloud
+(water/Paxos.java:145 — a lost member does NOT rejoin; the cluster
+restarts and reloads checkpoints from persistent store, SURVEY L1/L2).
+PR 6 built the in-process half of that story: per-tree in-training
+checkpoints whose resume state makes a continued train BIT-identical to
+an uninterrupted one. This module adds the half that survives losing
+the PROCESS itself:
+
+- **Recovery manifest** (``record_training``): every live training job
+  that writes in-training checkpoints also records a small JSON
+  manifest to a durable ``H2O3_RECOVERY_DIR`` — model key, algo,
+  params, response/feature columns, the checkpoint dir, the SPMD mesh
+  shape, the creating request's trace id — plus a one-time binary
+  artifact of the training frame (``persist.save_frame``), so a fresh
+  process can rebuild the exact training inputs. The manifest is
+  dropped when the train reaches a deliberate terminal state (DONE /
+  CANCELLED); a crash/kill leaves it behind — that IS the recovery
+  signal.
+- **Boot-time scan** (``recover_at_boot``, wired into
+  ``cluster_boot``): a fresh process lists the manifests, pairs each
+  with the NEWEST ``<key>_t<n>.zip`` artifact in its
+  ``in_training_checkpoints_dir``, re-registers a Job (status
+  ``RECOVERING``, the original trace id re-bound) and resumes the
+  train through the normal ``checkpoint=`` path — the PR 6
+  data-signature guard still applies, so a changed frame recomputes
+  margins instead of silently continuing on stale state. Resume runs
+  under the NEW process's mesh; GBM/DRF resumes are bit-identical to
+  the uninterrupted train (tests/test_restart_recovery.py).
+- **Checkpoint GC**: orphaned on-disk checkpoint artifacts (dead jobs
+  whose manifests are gone, completed trains' durable artifacts past
+  their useful life) previously accumulated forever; boot GC removes
+  entries older than ``H2O3_RECOVERY_GC_AGE_SECS`` **except** the ones
+  the recovery scan just claimed.
+
+Failure policy: everything here is advisory and loud. A corrupt
+manifest is renamed ``*.corrupt`` and WARNED about; a resume that
+raises is reported and skipped; nothing in this module may wedge
+process startup (the ``boot`` fault-injection site exercises exactly
+that contract). When ``H2O3_RECOVERY_DIR`` is unset the whole
+machinery is a checked no-op — one env lookup per call (the
+``H2O3_TELEMETRY=0`` idiom, budget-guard tested).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+MANIFEST_VERSION = 1
+
+# cap on remembered checkpoint dirs (ckpt_dirs.json): GC only scans
+# dirs a manifest once named; an unbounded list would itself be a leak
+_MAX_CKPT_DIRS = 256
+
+_CKPT_RE = re.compile(r"^(?P<key>.+)_t(?P<trees>\d+)\.zip$")
+
+# resume-context marker: ModelBuilder.train checks it to register the
+# resumed job as RECOVERING (and schemas surface it on /3/Jobs)
+_RESUME_CTX = threading.local()
+
+# last boot-recovery report (GET /3/Recovery) + live resume jobs so
+# tests/boot can join background resumes
+_LAST_REPORT: Optional[Dict[str, Any]] = None
+_LIVE_JOBS: List[Any] = []
+
+
+# ---------------- gating -----------------------------------------------
+
+def recovery_dir() -> Optional[str]:
+    """The durable recovery root, or None when the subsystem is off.
+    Read from the environment on every call so tests/ops can flip it
+    at runtime; the unset path is one dict lookup."""
+    d = os.environ.get("H2O3_RECOVERY_DIR")
+    return d.strip() or None if d is not None else None
+
+
+def enabled() -> bool:
+    return recovery_dir() is not None
+
+
+def gc_age_secs() -> float:
+    """Orphaned-checkpoint age threshold (default 7 days); malformed
+    values fall back instead of breaking boot."""
+    try:
+        v = float(os.environ.get("H2O3_RECOVERY_GC_AGE_SECS",
+                                 "604800") or 604800)
+        return v if v > 0 else 604800.0
+    except ValueError:
+        return 604800.0
+
+
+def max_resume_attempts() -> int:
+    """Boot-resume attempt cap per manifest (default 3): a train that
+    fails DETERMINISTICALLY (bad interaction, NaN loss) must not be
+    re-trained on every boot forever — after the cap its manifest is
+    renamed ``*.abandoned`` with a loud warn."""
+    try:
+        v = int(os.environ.get("H2O3_RECOVERY_MAX_ATTEMPTS", "3") or 3)
+        return v if v > 0 else 3
+    except ValueError:
+        return 3
+
+
+def is_resuming() -> bool:
+    return bool(getattr(_RESUME_CTX, "on", False))
+
+
+# ---------------- paths ------------------------------------------------
+
+def _manifests_dir(root: str) -> str:
+    return os.path.join(root, "manifests")
+
+
+def _frames_dir(root: str) -> str:
+    return os.path.join(root, "frames")
+
+
+def _manifest_path(root: str, model_key: str) -> str:
+    return os.path.join(_manifests_dir(root), f"{model_key}.json")
+
+
+def _ckpt_dirs_path(root: str) -> str:
+    return os.path.join(root, "ckpt_dirs.json")
+
+
+def _atomic_write_json(path: str, obj) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def _remember_ckpt_dir(root: str, ckpt_dir: str) -> None:
+    """Append to the GC's dir registry: orphans from COMPLETED trains
+    have no manifest left to name their dir, so GC needs its own
+    memory of every checkpoint dir recovery ever saw."""
+    path = _ckpt_dirs_path(root)
+    dirs: List[str] = []
+    try:
+        with open(path) as f:
+            got = json.load(f)
+        if isinstance(got, list):
+            dirs = [str(d) for d in got]
+    except (OSError, ValueError):
+        pass
+    ad = os.path.abspath(ckpt_dir)
+    if ad in dirs:
+        return
+    dirs.append(ad)
+    _atomic_write_json(path, dirs[-_MAX_CKPT_DIRS:])
+
+
+# ---------------- manifest lifecycle -----------------------------------
+
+def record_training(builder, job, frame, y, spec) -> Optional[str]:
+    """Record a live training job to the recovery dir. Called by
+    ``ModelBuilder.train`` when recovery is enabled AND the train
+    writes in-training checkpoints. Advisory: failures warn, never
+    fail the train they protect. Returns the manifest's model key (the
+    completion hook's handle), or None."""
+    root = recovery_dir()
+    if root is None:
+        return None
+    try:
+        from h2o3_tpu.parallel.mesh import (current_mesh, n_data_shards,
+                                            n_model_shards)
+        from h2o3_tpu.persist import _json_safe, save_frame
+        from h2o3_tpu.telemetry.snapshot import process_identity
+        model_key = builder._model_key()
+        os.makedirs(_manifests_dir(root), exist_ok=True)
+        os.makedirs(_frames_dir(root), exist_ok=True)
+        frame_key = getattr(frame, "key", None) or f"{model_key}_frame"
+        # the artifact name carries a content fingerprint (the PR-6
+        # (nrow, Σy, Σw) signature): frame keys are USER-assignable
+        # (destination_frame), and re-importing different data under
+        # last week's key must not make recovery resume on the stale
+        # artifact — same key + same data reuses it, same key +
+        # different data writes its own
+        sig_suffix = ""
+        try:
+            from h2o3_tpu.models.gbm import _spec_signature
+            sig_suffix = "." + hashlib.sha1(
+                _spec_signature(spec).tobytes()).hexdigest()[:10]
+        except Exception:   # noqa: BLE001 — fingerprint is best-effort
+            pass
+        frame_path = os.path.join(_frames_dir(root),
+                                  f"{frame_key}{sig_suffix}.zip")
+        if not os.path.exists(frame_path):
+            # one durable copy of the training inputs; re-records (a
+            # recovery resume is itself recorded, grid trains share
+            # frames) reuse the artifact instead of rewriting the
+            # dataset every train
+            got = save_frame(frame, _frames_dir(root), key=frame_key)
+            if got != frame_path:
+                os.replace(got, frame_path)
+        ckpt_dir = builder.params.get("in_training_checkpoints_dir")
+        _remember_ckpt_dir(root, ckpt_dir)
+        mesh = current_mesh()
+        attempts = 0
+        if is_resuming():
+            # the resume re-records its own manifest under the same
+            # model key — carry the boot-attempt count over so a train
+            # that fails deterministically cannot reset its own cap
+            try:
+                with open(_manifest_path(root, model_key)) as f:
+                    attempts = int(json.load(f)
+                                   .get("resume_attempts", 0) or 0)
+            except (OSError, ValueError, TypeError):
+                pass
+        manifest = {
+            "version": MANIFEST_VERSION,
+            "model_key": model_key,
+            "algo": builder.algo,
+            "job_key": job.key,
+            "trace_id": getattr(job, "trace_id", None),
+            "y": y,
+            "x": list(spec.names),
+            "params": _json_safe(builder.params),
+            "frame_key": frame_key,
+            "frame_path": frame_path,
+            "ckpt_dir": os.path.abspath(ckpt_dir),
+            "mesh": {"n_data": n_data_shards(mesh),
+                     "n_model": n_model_shards(mesh)},
+            "process": process_identity(),
+            "resume_attempts": attempts,
+            "time": time.time(),
+        }
+        _atomic_write_json(_manifest_path(root, model_key), manifest)
+        from h2o3_tpu import telemetry
+        telemetry.counter(
+            "h2o3_recovery_manifests_total", {"algo": builder.algo},
+            help="training recovery manifests recorded").inc()
+        return model_key
+    except Exception as e:   # noqa: BLE001 — advisory only
+        try:
+            from h2o3_tpu.log import warn
+            warn("recovery: failed to record training manifest: %s", e)
+        except Exception:
+            pass
+        return None
+
+
+def complete_training(model_key: str) -> None:
+    """Drop a manifest when its train reaches a DELIBERATE terminal
+    state (DONE/CANCELLED). Crashes never call this — the surviving
+    manifest is what the next boot recovers from."""
+    root = recovery_dir()
+    if root is None or not model_key:
+        return
+    try:
+        os.remove(_manifest_path(root, model_key))
+    except OSError:
+        pass
+
+
+# ---------------- boot-time scan ---------------------------------------
+
+def latest_checkpoint(ckpt_dir: Optional[str], model_key: str
+                      ) -> Optional[Tuple[str, int]]:
+    """Newest ``<model_key>_t<n>.zip`` in the checkpoint dir as
+    (path, trees), or None when nothing resumable exists."""
+    if not ckpt_dir or not os.path.isdir(ckpt_dir):
+        return None
+    best: Optional[Tuple[str, int]] = None
+    prefix = f"{model_key}_t"
+    for fn in os.listdir(ckpt_dir):
+        if not fn.startswith(prefix):
+            continue
+        m = _CKPT_RE.match(fn)
+        if m is None or m.group("key") != model_key:
+            continue
+        trees = int(m.group("trees"))
+        if best is None or trees > best[1]:
+            best = (os.path.join(ckpt_dir, fn), trees)
+    return best
+
+
+def scan(quarantine: bool = True) -> Tuple[List[Dict[str, Any]],
+                                           List[str]]:
+    """Read every manifest; returns (entries, corrupt_paths). A corrupt
+    manifest is WARNED about and renamed ``*.corrupt`` (evidence kept,
+    never rescanned) — boot must proceed regardless.
+    ``quarantine=False`` is the read-only spelling for the REST
+    inspection route: a monitoring poll must not rename a corrupt
+    manifest aside before the NEXT BOOT's scan gets to report it."""
+    root = recovery_dir()
+    if root is None:
+        return [], []
+    mdir = _manifests_dir(root)
+    if not os.path.isdir(mdir):
+        return [], []
+    entries: List[Dict[str, Any]] = []
+    corrupt: List[str] = []
+    for fn in sorted(os.listdir(mdir)):
+        if not fn.endswith(".json"):
+            continue
+        path = os.path.join(mdir, fn)
+        try:
+            with open(path) as f:
+                ent = json.load(f)
+            if not isinstance(ent, dict) or not ent.get("model_key") \
+                    or not ent.get("algo"):
+                raise ValueError("missing model_key/algo")
+            if int(ent.get("version", 0)) > MANIFEST_VERSION:
+                raise ValueError(
+                    f"manifest version {ent.get('version')} is newer "
+                    f"than this build ({MANIFEST_VERSION})")
+        except Exception as e:   # noqa: BLE001 — corrupt file, not code
+            from h2o3_tpu.log import warn
+            if quarantine:
+                warn("recovery: corrupt manifest %s (%s) — renamed "
+                     "aside, boot continues", path, e)
+                try:
+                    os.replace(path, path + ".corrupt")
+                except OSError:
+                    pass
+            corrupt.append(path)
+            continue
+        ent["manifest_path"] = path
+        lc = latest_checkpoint(ent.get("ckpt_dir"), ent["model_key"])
+        ent["latest_ckpt"], ent["ckpt_trees"] = \
+            (lc if lc is not None else (None, None))
+        entries.append(ent)
+    return entries, corrupt
+
+
+def gc_checkpoints(claimed_keys,
+                   claimed_frames=None) -> Dict[str, Any]:
+    """Age/ownership-based checkpoint GC: remove ``*_t<n>.zip``
+    artifacts older than ``H2O3_RECOVERY_GC_AGE_SECS`` from every dir
+    the recovery layer has seen — EXCEPT artifacts whose model key the
+    current scan claimed (those are about to be resumed from). Frame
+    artifacts in the recovery dir age out under the same rule when no
+    surviving manifest references them (``claimed_frames``)."""
+    root = recovery_dir()
+    report: Dict[str, Any] = {"removed": [], "kept_claimed": 0,
+                              "age_secs": gc_age_secs()}
+    if root is None:
+        return report
+    dirs: List[str] = []
+    try:
+        with open(_ckpt_dirs_path(root)) as f:
+            got = json.load(f)
+        if isinstance(got, list):
+            dirs = [str(d) for d in got]
+    except (OSError, ValueError):
+        pass
+    claimed = set(claimed_keys or ())
+    now = time.time()
+    age = report["age_secs"]
+    for d in dirs:
+        try:
+            names = os.listdir(d)
+        except OSError:
+            continue
+        for fn in names:
+            m = _CKPT_RE.match(fn)
+            if m is None:
+                continue
+            if m.group("key") in claimed:
+                report["kept_claimed"] += 1
+                continue
+            path = os.path.join(d, fn)
+            try:
+                if now - os.path.getmtime(path) > age:
+                    os.remove(path)
+                    report["removed"].append(path)
+            except OSError:
+                continue
+    fdir = _frames_dir(root)
+    keep_frames = {os.path.abspath(p) for p in (claimed_frames or ())}
+    try:
+        frame_names = os.listdir(fdir)
+    except OSError:
+        frame_names = []
+    for fn in frame_names:
+        if not fn.endswith(".zip"):
+            continue
+        path = os.path.join(fdir, fn)
+        if os.path.abspath(path) in keep_frames:
+            report["kept_claimed"] += 1
+            continue
+        try:
+            if now - os.path.getmtime(path) > age:
+                os.remove(path)
+                report["removed"].append(path)
+        except OSError:
+            continue
+    if report["removed"]:
+        from h2o3_tpu.log import info
+        info("recovery GC: removed %d orphaned checkpoint artifact(s) "
+             "older than %.0fs", len(report["removed"]), age)
+    return report
+
+
+# ---------------- resume -----------------------------------------------
+
+def _estimator_class(algo: str):
+    if algo == "gbm":
+        from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+        return H2OGradientBoostingEstimator
+    if algo == "drf":
+        from h2o3_tpu.models.drf import H2ORandomForestEstimator
+        return H2ORandomForestEstimator
+    if algo == "xgboost":
+        from h2o3_tpu.models.xgboost import H2OXGBoostEstimator
+        return H2OXGBoostEstimator
+    raise ValueError(f"recovery has no resume path for algo '{algo}'")
+
+
+def _resume_entry(ent: Dict[str, Any], wait: bool) -> Dict[str, Any]:
+    """Re-register and resume one interrupted train. The resumed Job
+    starts in status RECOVERING with the ORIGINAL trace id bound, so
+    /3/Jobs and every span the resume records link back to the request
+    that started the interrupted train."""
+    from h2o3_tpu import dkv, faults
+    if faults.ACTIVE:
+        faults.check("boot", key=ent["model_key"])
+    from h2o3_tpu.persist import load_frame
+    from h2o3_tpu.telemetry import trace as _trace
+    # the manifest records the mesh the committed prefix was built
+    # under: the sharded histogram psum's accumulation order is part of
+    # the bit-parity contract, so a resume under a DIFFERENT mesh shape
+    # (nodepool resize between boots) still completes but must not
+    # claim bit-identity — warn loudly and flag the resume
+    mesh_changed = False
+    want = ent.get("mesh") or {}
+    if want:
+        from h2o3_tpu.parallel.mesh import (current_mesh, n_data_shards,
+                                            n_model_shards)
+        mesh = current_mesh()
+        have = {"n_data": n_data_shards(mesh),
+                "n_model": n_model_shards(mesh)}
+        mesh_changed = any(
+            int(want.get(k, have[k]) or have[k]) != have[k]
+            for k in ("n_data", "n_model"))
+        if mesh_changed:
+            from h2o3_tpu.log import warn
+            warn("recovery: '%s' trained on a %sx%s mesh, resuming on "
+                 "%dx%d — the resumed model is NOT guaranteed "
+                 "bit-identical to the uninterrupted train",
+                 ent["model_key"], want.get("n_data"),
+                 want.get("n_model"), have["n_data"], have["n_model"])
+    params = dict(ent.get("params") or {})
+    for k in ("training_frame", "validation_frame", "response_column"):
+        params.pop(k, None)
+    # a kill can land AFTER the final checkpoint committed but BEFORE
+    # the manifest dropped: the newest artifact then already holds
+    # every requested tree, and retraining through checkpoint= would
+    # fail _resolve_checkpoint's ntrees-must-grow check on every boot.
+    # Register the finished artifact directly instead.
+    target = int(params.get("ntrees", 0) or 0)
+    if ent.get("latest_ckpt") and target \
+            and int(ent.get("ckpt_trees") or 0) >= target:
+        from h2o3_tpu.log import info
+        from h2o3_tpu.persist import load_model
+        model = load_model(ent["latest_ckpt"])
+        model.key = ent["model_key"]
+        dkv.put(ent["model_key"], "model", model)
+        complete_training(ent["model_key"])
+        info("recovery: '%s' was already fully trained (%d trees) — "
+             "registered the final checkpoint artifact, no retrain",
+             ent["model_key"], target)
+        return {"model_key": ent["model_key"], "algo": ent["algo"],
+                "job_key": None,
+                "trace_id": ent.get("trace_id"),
+                "checkpoint": ent["latest_ckpt"],
+                "ckpt_trees": ent.get("ckpt_trees"),
+                "mesh_changed": False, "job_status": "DONE",
+                "completed_from_artifact": True}
+    frame = load_frame(ent["frame_path"])
+    # the resumed train keeps the ORIGINAL model key (model_id), so its
+    # own in-training checkpoints land under the same artifact names —
+    # a crash DURING recovery resumes from the newest of those
+    params["model_id"] = ent["model_key"]
+    if ent.get("latest_ckpt"):
+        params["checkpoint"] = ent["latest_ckpt"]
+    # else: killed before the first interval checkpoint committed — the
+    # recovery is a clean rerun of the ORIGINAL request, including any
+    # user-supplied checkpoint= base continuation the manifest params
+    # carry (dropping it would silently rebuild from f0 without the
+    # base model's trees; same seed + same base → same model)
+    est = _estimator_class(ent["algo"])(**params)
+    trace_id = ent.get("trace_id") or _trace.new_trace_id()
+    _RESUME_CTX.on = True
+    try:
+        with _trace.trace_context(trace_id):
+            est.train(y=ent.get("y"), x=ent.get("x") or None,
+                      training_frame=frame, background=True)
+    finally:
+        _RESUME_CTX.on = False
+    job = est.job
+    _LIVE_JOBS.append(job)
+
+    def _finish():
+        try:
+            model = job.join()
+            model.key = ent["model_key"]
+            dkv.put(ent["model_key"], "model", model)
+            from h2o3_tpu.log import info
+            info("recovery: resumed %s '%s' to %s trees (job %s)",
+                 ent["algo"], ent["model_key"],
+                 getattr(model, "ntrees_built", "?"), job.key)
+        except Exception as e:   # noqa: BLE001 — loud, never fatal
+            from h2o3_tpu.log import warn
+            warn("recovery: resume of '%s' FAILED: %s",
+                 ent["model_key"], e)
+
+    if wait:
+        _finish()
+    else:
+        threading.Thread(target=_finish, daemon=True,
+                         name=f"recovery-{ent['model_key']}").start()
+    return {"model_key": ent["model_key"], "algo": ent["algo"],
+            "job_key": job.key, "trace_id": trace_id,
+            "checkpoint": ent.get("latest_ckpt"),
+            "ckpt_trees": ent.get("ckpt_trees"),
+            "mesh_changed": mesh_changed,
+            "job_status": job.status}
+
+
+def recover_at_boot(wait: bool = False) -> Dict[str, Any]:
+    """The boot-time entrypoint (cluster_boot.run_boot_recovery / tests):
+    scan → GC → resume every interrupted train. Per-entry failures warn
+    and continue — recovery must NEVER wedge startup. ``wait=True``
+    blocks until every resume finishes (tests/chaos); the k8s boot path
+    resumes in the background so the REST port comes up immediately."""
+    global _LAST_REPORT
+    t0 = time.time()
+    report: Dict[str, Any] = {"enabled": enabled(), "resumed": [],
+                              "failed": [], "abandoned": [],
+                              "corrupt": [], "gc": None, "seconds": 0.0}
+    if not enabled():
+        _LAST_REPORT = report
+        return report
+    from h2o3_tpu import telemetry
+    from h2o3_tpu.log import info, warn
+    entries, corrupt = scan()
+    report["corrupt"] = corrupt
+    report["gc"] = gc_checkpoints(
+        {e["model_key"] for e in entries},
+        claimed_frames={e["frame_path"] for e in entries
+                        if e.get("frame_path")})
+    if entries:
+        info("recovery: %d interrupted train(s) found in %s",
+             len(entries), recovery_dir())
+    cap = max_resume_attempts()
+    for ent in entries:
+        attempts = int(ent.get("resume_attempts", 0) or 0)
+        mpath = ent.get("manifest_path")
+        if attempts >= cap:
+            # a manifest that survived `cap` boot resumes is failing
+            # deterministically — stop re-training it every restart;
+            # evidence kept aside (same contract as *.corrupt)
+            warn("recovery: '%s' already failed %d boot resume "
+                 "attempt(s) — abandoning (renamed *.abandoned; "
+                 "checkpoints kept for manual checkpoint= resume)",
+                 ent.get("model_key"), attempts)
+            try:
+                if mpath:
+                    os.replace(mpath, mpath + ".abandoned")
+            except OSError:
+                pass
+            report["abandoned"].append(ent.get("model_key"))
+            continue
+        # count the attempt BEFORE resuming: a crash mid-resume must
+        # still advance the cap
+        ent["resume_attempts"] = attempts + 1
+        try:
+            _atomic_write_json(mpath, {
+                k: v for k, v in ent.items()
+                if k not in ("manifest_path", "latest_ckpt",
+                             "ckpt_trees")})
+        except OSError:
+            pass
+        try:
+            report["resumed"].append(_resume_entry(ent, wait))
+            telemetry.counter(
+                "h2o3_recovery_resumed_total", {"algo": ent["algo"]},
+                help="interrupted trains resumed at boot").inc()
+        except Exception as e:   # noqa: BLE001 — never wedge startup
+            warn("recovery: could not resume '%s': %s — continuing "
+                 "boot", ent.get("model_key"), e)
+            report["failed"].append({"model_key": ent.get("model_key"),
+                                     "error": repr(e)})
+            telemetry.counter(
+                "h2o3_recovery_failed_total",
+                help="boot-time resume attempts that failed").inc()
+    report["seconds"] = round(time.time() - t0, 3)
+    _LAST_REPORT = report
+    return report
+
+
+def wait_for_recoveries(timeout: Optional[float] = None) -> None:
+    """Join every background resume started this process (tests)."""
+    for job in list(_LIVE_JOBS):
+        try:
+            job.join(timeout)
+        except RuntimeError:
+            pass   # the failed-resume warn already fired in _finish
+
+
+def last_report() -> Optional[Dict[str, Any]]:
+    return _LAST_REPORT

@@ -15,8 +15,9 @@ from h2o3_tpu.serve.batcher import (ServeBadRequestError,
 from h2o3_tpu.serve.circuit import CircuitBreaker
 from h2o3_tpu.serve.codec import RowCodec
 from h2o3_tpu.serve.registry import DEFAULT_BUCKETS, CompiledScorer
-from h2o3_tpu.serve.service import (Deployment, deploy, deployment,
-                                    deployments, predict_columnar,
+from h2o3_tpu.serve.service import (Deployment, circuit_states, deploy,
+                                    deployment, deployments, fleet,
+                                    predict_columnar,
                                     predict_rows, shutdown_all, stats,
                                     undeploy)
 from h2o3_tpu.serve.stats import ServeStats
@@ -26,8 +27,10 @@ __all__ = [
     "RowCodec",
     "ServeBadRequestError", "ServeCircuitOpenError", "ServeClosedError",
     "ServeDeadlineError",
-    "ServeError", "ServeOverloadedError", "ServeStats", "deploy",
-    "deployment", "deployments", "predict_columnar", "predict_rows",
+    "ServeError", "ServeOverloadedError", "ServeStats",
+    "circuit_states", "deploy",
+    "deployment", "deployments", "fleet", "predict_columnar",
+    "predict_rows",
     "shutdown_all", "stats",
     "undeploy",
 ]

@@ -97,9 +97,13 @@ class MicroBatcher:
                  max_batch: int = 512, max_delay_ms: float = 2.0,
                  queue_limit: int = 8192,
                  default_timeout_ms: float = 10_000.0,
-                 pipeline_depth: int = 2, breaker=None):
+                 pipeline_depth: int = 2, breaker=None,
+                 fleet_check: Optional[Callable] = None):
         import queue as _q
         self.breaker = breaker         # serve/circuit.py CircuitBreaker
+        # fleet gossip verdict (serve/fleet.py reject_for): an open
+        # circuit on a PEER replica sheds load here too; None = healthy
+        self._fleet_check = fleet_check
         self._encode = encode          # (rows, pad_to) -> np [pad, F]
         self._dispatch = dispatch      # (X, n_active) -> device array
         self._decode = decode          # (host scores, n) -> DecodedBatch
@@ -150,6 +154,18 @@ class MicroBatcher:
                     f"circuit open for '{self.stats.model}' (device "
                     f"stage failing) — retry in {retry_after:.2f}s",
                     retry_after_s=retry_after)
+        if self._fleet_check is not None:
+            # the LOCAL breaker ruled first (local state wins); only a
+            # peer's open circuit that local evidence cannot contradict
+            # sheds here — same fast-503 contract as the local breaker
+            hit = self._fleet_check()
+            if hit is not None:
+                retry_after, src = hit
+                self.stats.record_rejected()
+                raise ServeCircuitOpenError(
+                    f"circuit open for '{self.stats.model}' on fleet "
+                    f"peer {src} — shedding load, retry in "
+                    f"{retry_after:.2f}s", retry_after_s=retry_after)
         timeout_s = (float(timeout_ms) / 1000.0 if timeout_ms is not None
                      else self.default_timeout_s)
         deadline = time.perf_counter() + timeout_s

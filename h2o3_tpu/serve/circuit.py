@@ -50,6 +50,10 @@ class CircuitBreaker:
         self._open_count = 0
         self._probe_inflight = False
         self._probe_at = 0.0
+        # wall-clock of the last device SUCCESS: the fleet gossip layer
+        # (serve/fleet.py) lets first-hand local health newer than a
+        # peer's open report win over the gossip
+        self._last_success_wall = 0.0
         # state gauge lives in the deployment's stats registry so it
         # follows the H2O3_TELEMETRY fallback behavior of every other
         # serve metric
@@ -102,6 +106,7 @@ class CircuitBreaker:
         with self._mu:
             self._consecutive_failures = 0
             self._probe_inflight = False
+            self._last_success_wall = time.time()
             if self._state != CLOSED:
                 self._state = CLOSED
                 self._set_gauge()
@@ -141,6 +146,32 @@ class CircuitBreaker:
     def state(self) -> str:
         with self._mu:
             return self._state
+
+    @property
+    def last_success_time(self) -> float:
+        with self._mu:
+            return self._last_success_wall
+
+    def publish(self) -> Dict[str, object]:
+        """Gossip-shaped state for the telemetry snapshot's ``circuit``
+        payload (ISSUE 9): what a PEER needs to shed load — the state,
+        a Retry-After suggestion (remaining cooldown; a whole window
+        when the cooldown already lapsed and the probe is pending) and
+        the report's wall time so receivers can age it."""
+        with self._mu:
+            retry = 0.0
+            if self._state == OPEN:
+                remaining = self.open_secs - (time.monotonic()
+                                              - self._opened_at)
+                retry = (max(remaining, 0.05) if remaining > 0
+                         else max(self.open_secs, 0.05))
+            elif self._state == HALF_OPEN:
+                retry = max(self.open_secs, 0.05)
+            return {"model": self.model, "state": self._state,
+                    "retry_after_s": round(retry, 3),
+                    "open_count": self._open_count,
+                    "consecutive_failures": self._consecutive_failures,
+                    "time": time.time()}
 
     def snapshot(self) -> Dict[str, object]:
         with self._mu:

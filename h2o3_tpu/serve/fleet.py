@@ -1,0 +1,237 @@
+"""Fleet-shared circuit state — sick replicas shed load cluster-wide.
+
+PR 6's circuit breaker is per-process: replica A's device stage failing
+consecutively opens A's circuit, but replicas B..N keep queueing traffic
+at the same deployment (same poisoned model, same sick accelerator
+class) and burn their own ticks discovering it independently. The
+reference's answer is cloud membership — every node hears about a sick
+member on the heartbeat (SURVEY L1/L2); single-controller JAX processes
+share nothing, so circuit state rides the SAME pull-based telemetry
+plane PR 8 built:
+
+- each process PUBLISHES its deployments' circuit states inside the
+  ``GET /3/Telemetry/snapshot`` body (``circuit`` field,
+  telemetry/snapshot.py);
+- every cluster scrape (``/3/Telemetry/cluster``,
+  ``/metrics?scope=cluster`` — peer list from ``H2O3_TELEMETRY_PEERS``)
+  feeds the fetched peers' circuit payloads into THIS store, so an open
+  circuit propagates fleet-wide within one telemetry scrape;
+- the serve admission path (``MicroBatcher.submit`` via the
+  deployment's ``fleet_check``) consults ``reject_for``: an open PEER
+  circuit for this deployment → fast 503 + ``Retry-After``, exactly the
+  local breaker's client contract.
+
+Local state always wins over stale peer gossip:
+
+- reports about THIS process (the launcher's shared-peer-list / test
+  self-peer spelling) never enter the rejection store — the local
+  breaker already owns that verdict;
+- a device success observed LOCALLY after a peer report was ingested
+  overrides it (``local_healthy_since``): this replica has fresher
+  first-hand evidence that the deployment serves;
+- entries expire after ``max(retry_after_s, H2O3_FLEET_CIRCUIT_TTL)``
+  seconds (default 15s), and a peer reporting its circuit closed clears
+  its own earlier open report on the next scrape.
+
+``h2o3_fleet_circuit_open{model=...}`` gauges the number of live peer
+open reports; ``/3/Serve/stats`` carries the merged view as
+``fleet_circuit``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+# (model, source) -> entry; source is the reporting peer's pid@host (or
+# jax process index) from its snapshot identity
+_STORE: Dict[Tuple[str, str], Dict[str, object]] = {}
+_MU = threading.Lock()
+# lock-free hot-path hint: the submit path must cost ~nothing while the
+# fleet is healthy (the common case)
+_HAS_OPEN = False
+
+
+def _ttl() -> float:
+    """Gossip time-to-live beyond an entry's own retry window
+    (``H2O3_FLEET_CIRCUIT_TTL`` seconds, default 15): a peer that died
+    while open must not shed this replica's load forever. Malformed
+    values fall back — serve must not break on a typo'd knob."""
+    try:
+        v = float(os.environ.get("H2O3_FLEET_CIRCUIT_TTL", "15") or 15)
+        return v if v > 0 else 15.0
+    except ValueError:
+        return 15.0
+
+
+def _gauge(model: str):
+    from h2o3_tpu.telemetry import registry
+    return registry().gauge(
+        "h2o3_fleet_circuit_open", {"model": model},
+        help="live peer-reported open circuits for this deployment")
+
+
+def _expire_locked(now: float) -> set:
+    """Drop aged entries; returns the models that lost one (their
+    gauge needs re-publishing — a model whose LAST entry expires would
+    otherwise read 1 on dashboards forever)."""
+    ttl = _ttl()
+    expired = set()
+    for k in list(_STORE):
+        e = _STORE[k]
+        if now - float(e["observed"]) > max(float(e["retry_after_s"]),
+                                            ttl):
+            del _STORE[k]
+            expired.add(k[0])
+    return expired
+
+
+def _publish_gauges(models) -> None:
+    counts = {m: 0 for m in models}
+    with _MU:
+        for (m, _s) in _STORE:
+            if m in counts:
+                counts[m] += 1
+    for m, c in counts.items():
+        try:
+            _gauge(m).set(c)
+        except Exception:   # noqa: BLE001 — telemetry must not break serve
+            pass
+
+
+def _set_has_open_locked() -> None:
+    global _HAS_OPEN
+    _HAS_OPEN = bool(_STORE)
+
+
+def observe_peer_states(states: Optional[List[dict]], source: str,
+                        self_process: bool = False) -> None:
+    """Ingest one peer snapshot's circuit payload. ``self_process=True``
+    (the snapshot came from THIS process — a self-peer spelling) clears
+    any earlier entries under this source but never creates rejection
+    state: the local breaker is the authority on local health."""
+    now = time.monotonic()
+    touched = set()
+    with _MU:
+        for st in states or []:
+            model = st.get("model")
+            if not model:
+                continue
+            key = (str(model), source)
+            touched.add(str(model))
+            if st.get("state") == "open" and not self_process:
+                try:
+                    ra = float(st.get("retry_after_s", 1.0) or 1.0)
+                except (TypeError, ValueError):
+                    ra = 1.0
+                # age the entry by the REPORT's wall time (publish()'s
+                # 'time' field), not the scrape's ingest time: a local
+                # device success between publish and scrape is fresher
+                # first-hand evidence and must win. Clamped to now so a
+                # peer with a skewed clock cannot mint gossip from the
+                # future that local evidence could never override.
+                try:
+                    t_rep = float(st.get("time") or 0.0)
+                except (TypeError, ValueError):
+                    t_rep = 0.0
+                wall = time.time()
+                t_rep = min(t_rep, wall) if t_rep > 0 else wall
+                _STORE[key] = {"model": str(model), "source": source,
+                               "state": "open",
+                               "retry_after_s": max(ra, 0.05),
+                               "open_count": st.get("open_count"),
+                               "observed": now,
+                               "time": t_rep}
+            else:
+                # closed/half_open (or a self report): a peer's fresher
+                # word about ITSELF clears its stale open gossip
+                _STORE.pop(key, None)
+        expired = _expire_locked(now)
+        _set_has_open_locked()
+    _publish_gauges(touched | expired)
+
+
+def reject_for(model: str,
+               local_healthy_since: float = 0.0
+               ) -> Optional[Tuple[float, str]]:
+    """Admission verdict for one deployment: ``None`` admits; a
+    ``(retry_after_s, source)`` tuple sheds with a 503 + Retry-After.
+    ``local_healthy_since`` is the local breaker's last device-success
+    wall time — first-hand evidence newer than the gossip wins, so a
+    replica actively serving this deployment successfully never sheds
+    on old news."""
+    if not _HAS_OPEN:
+        return None
+    now = time.monotonic()
+    best: Optional[Tuple[float, str]] = None
+    with _MU:
+        expired = _expire_locked(now)
+        _set_has_open_locked()
+        for (m, src), e in _STORE.items():
+            if m != model:
+                continue
+            if local_healthy_since and \
+                    local_healthy_since > float(e["time"]):
+                continue
+            remaining = max(float(e["retry_after_s"])
+                            - (now - float(e["observed"])), 0.05)
+            if best is None or remaining > best[0]:
+                best = (remaining, src)
+    if expired:
+        _publish_gauges(expired)
+    return best
+
+
+def fleet_snapshot(local: Optional[List[dict]] = None) -> Dict[str, object]:
+    """The ``fleet_circuit`` block of ``/3/Serve/stats``: this process's
+    own circuit states plus every live peer report."""
+    now = time.monotonic()
+    with _MU:
+        expired = _expire_locked(now)
+        _set_has_open_locked()
+        peers = [{"model": e["model"], "source": e["source"],
+                  "state": e["state"],
+                  "retry_after_s": round(max(
+                      float(e["retry_after_s"])
+                      - (now - float(e["observed"])), 0.0), 3),
+                  "age_s": round(now - float(e["observed"]), 3),
+                  "open_count": e.get("open_count")}
+                 for e in _STORE.values()]
+    if expired:
+        _publish_gauges(expired)
+    return {"local": list(local or []), "peers": peers,
+            "shedding": sorted({p["model"] for p in peers})}
+
+
+def reset() -> None:
+    """Drop every peer entry (tests / undeploy-all teardown)."""
+    global _HAS_OPEN
+    with _MU:
+        models = {m for (m, _s) in _STORE}
+        _STORE.clear()
+        _HAS_OPEN = False
+    _publish_gauges(models)
+
+
+# ---------------- telemetry-plane wiring --------------------------------
+#
+# The cluster scrape (telemetry/snapshot.py cluster_samples) hands every
+# fetched peer snapshot to registered consumers; circuit gossip is one.
+# Registration happens at serve-package import — a process that never
+# imports serve has no deployments and nothing to shed.
+
+def _consume_peer_snapshot(snap: dict, self_process: bool) -> None:
+    proc = snap.get("process") or {}
+    source = f"{proc.get('pid', '?')}@{proc.get('host', '?')}"
+    observe_peer_states(snap.get("circuit"), source,
+                        self_process=self_process)
+
+
+def _register() -> None:
+    from h2o3_tpu.telemetry import snapshot as telesnap
+    if _consume_peer_snapshot not in telesnap.PEER_SNAPSHOT_CONSUMERS:
+        telesnap.PEER_SNAPSHOT_CONSUMERS.append(_consume_peer_snapshot)
+
+
+_register()

@@ -19,6 +19,7 @@ from h2o3_tpu.serve.batcher import (MicroBatcher, ServeBadRequestError,
                                     ServeCircuitOpenError,
                                     ServeClosedError, ServeDeadlineError,
                                     ServeError, ServeOverloadedError)
+from h2o3_tpu.serve import fleet
 from h2o3_tpu.serve.circuit import CircuitBreaker
 from h2o3_tpu.serve.codec import RowCodec
 from h2o3_tpu.serve.registry import DEFAULT_BUCKETS, CompiledScorer
@@ -26,6 +27,7 @@ from h2o3_tpu.serve.stats import ServeStats, merge_snapshots
 
 __all__ = ["deploy", "undeploy", "deployment", "deployments",
            "predict_rows", "predict_columnar", "stats", "shutdown_all",
+           "circuit_states", "fleet",
            "Deployment",
            "ServeError", "ServeOverloadedError", "ServeDeadlineError",
            "ServeBadRequestError", "ServeClosedError",
@@ -103,7 +105,16 @@ class Deployment:
             decode=self.codec.decode_batch, stats=self.stats,
             bucket_for=self.scorer.bucket_for, max_batch=max_batch,
             max_delay_ms=max_delay_ms, queue_limit=queue_limit,
-            default_timeout_ms=timeout_ms, breaker=self.breaker)
+            default_timeout_ms=timeout_ms, breaker=self.breaker,
+            fleet_check=self._fleet_check)
+
+    def _fleet_check(self):
+        """Peer-circuit gossip verdict for this deployment: a peer
+        replica's OPEN circuit sheds load here (fast 503 + Retry-After)
+        unless the local breaker has fresher first-hand evidence of
+        health (serve/fleet.py 'local state wins' contract)."""
+        return fleet.reject_for(
+            self.key, local_healthy_since=self.breaker.last_success_time)
 
     def predict_rows(self, rows: Sequence[Dict[str, Any]],
                      timeout_ms: Optional[float] = None
@@ -242,6 +253,13 @@ def predict_columnar(model_key: str, rows: Sequence[Dict[str, Any]],
     return dep.predict_columnar(rows, timeout_ms=timeout_ms)
 
 
+def circuit_states() -> List[Dict[str, Any]]:
+    """Every deployment's circuit-breaker state in gossip shape — the
+    ``circuit`` payload of this process's /3/Telemetry/snapshot body
+    (peers ingest it via serve/fleet.py)."""
+    return [dep.breaker.publish() for dep in deployments()]
+
+
 def stats() -> Dict[str, Any]:
     per_model = {}
     for dep in deployments():
@@ -249,10 +267,14 @@ def stats() -> Dict[str, Any]:
                               "pending_rows": dep.batcher.pending_rows,
                               "circuit": dep.breaker.snapshot()}
     return {"models": per_model,
-            "total": merge_snapshots(list(per_model.values()))}
+            "total": merge_snapshots(list(per_model.values())),
+            # fleet view (ISSUE 9): local circuit states + live peer
+            # open reports — "which replicas are shedding what"
+            "fleet_circuit": fleet.fleet_snapshot(local=circuit_states())}
 
 
 def shutdown_all():
     """Undeploy everything (test/interpreter teardown)."""
     for dep in deployments():
         undeploy(dep.key)
+    fleet.reset()

@@ -32,13 +32,30 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from h2o3_tpu.telemetry import spans
 from h2o3_tpu.telemetry.registry import registry
 
 SNAPSHOT_VERSION = 1
+
+# subscribers fed every snapshot a cluster scrape merges (signature:
+# (snapshot_dict, is_self_process)). The serve fleet-circuit store
+# (serve/fleet.py) registers here so an open circuit on one replica
+# propagates to every peer within one telemetry scrape — telemetry
+# itself never imports serve. Consumer errors are swallowed: gossip
+# must not break the metrics scrape it rides on.
+PEER_SNAPSHOT_CONSUMERS: List[Callable[[dict, bool], None]] = []
+
+
+def _notify_peer_consumers(snap: dict, self_process: bool) -> None:
+    for cb in list(PEER_SNAPSHOT_CONSUMERS):
+        try:
+            cb(snap, self_process)
+        except Exception:   # noqa: BLE001 — gossip is advisory
+            pass
 
 def _env_peer_timeout() -> float:
     """Peer poll budget (``H2O3_TELEMETRY_PEER_TIMEOUT`` seconds,
@@ -114,6 +131,16 @@ def local_snapshot(max_spans: int = _MAX_SNAPSHOT_SPANS) -> Dict[str, object]:
         "samples": [],
         "spans": [],
     }
+    # serve circuit gossip (ISSUE 9): rides the snapshot even when the
+    # metrics registry is disabled — load shedding is a serving-health
+    # property, not a metric. Only consulted when serve is already
+    # imported (a process with no deployments publishes nothing).
+    svc = sys.modules.get("h2o3_tpu.serve.service")
+    if svc is not None:
+        try:
+            out["circuit"] = svc.circuit_states()
+        except Exception:   # noqa: BLE001 — snapshot must render
+            out["circuit"] = []
     if not reg.enabled:
         return out
     samples = []
@@ -423,10 +450,15 @@ def cluster_samples(extra_snapshots: Optional[List[dict]] = None
                     # merges — the test/debug self-peer spelling relies
                     # on it — but is flagged so the double-counted
                     # counters are diagnosable from the scrape meta
-                    if snap.get("process") == snaps[0].get("process"):
+                    is_self = snap.get("process") == snaps[0].get("process")
+                    if is_self:
                         meta["peers_self"].append(p)
                     snaps.append(snap)
                     meta["peers_ok"].append(p)
+                    # feed gossip consumers (fleet circuit state): the
+                    # scrape that merges the metrics IS the propagation
+                    # vehicle — one scrape, fleet-wide visibility
+                    _notify_peer_consumers(snap, is_self)
                 except Exception as e:   # dead replica: report, never sink
                     meta["peers_failed"].append({"peer": p,
                                                  "error": repr(e)})
@@ -435,6 +467,11 @@ def cluster_samples(extra_snapshots: Optional[List[dict]] = None
             # in fetch_peer_snapshot carries its own deadline) — the
             # scrape does not wait for them
             ex.shutdown(wait=False, cancel_futures=True)
+    for s in extra_snapshots or []:
+        # test/embedded-injected snapshots gossip the same way the
+        # HTTP-fetched ones do
+        _notify_peer_consumers(s, s.get("process") == snaps[0]
+                               .get("process"))
     snaps.extend(extra_snapshots or [])
     meta["processes"] = len(snaps)
     merged = merge_snapshots(snaps)

@@ -1,0 +1,707 @@
+"""Restart-safe cluster (ISSUE 9): boot-time training recovery,
+fleet-shared circuit state, streamed-checkpoint parity.
+
+The contract under test: losing the PROCESS — not just an op inside it
+— is recoverable. A kill mid-train leaves a recovery manifest + an
+in-training checkpoint; a fresh boot's scan re-registers the train as a
+RECOVERING job and resumes it BIT-identically under the new process's
+mesh. Circuit state gossips over the PR 8 telemetry plane so one
+replica's open circuit sheds load fleet-wide, with local first-hand
+evidence always beating stale gossip. The streamed (resident-window)
+GBM path now honors ``checkpoint=`` / in-training checkpoints with the
+same bit-parity contract as dense. Subprocess-heavy cases are marked
+slow to protect the tier-1 budget; the in-process crash (Fatal fault
+kill) enforces the same parity acceptance cheaply.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu import dkv, faults, memman, recovery, serve
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator as GBM
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    # recovery is opt-in per test: never inherit a dir (or leak one)
+    monkeypatch.delenv("H2O3_RECOVERY_DIR", raising=False)
+    faults.configure(None)
+    yield
+    faults.configure(None)
+    serve.shutdown_all()     # also resets the fleet circuit store
+
+
+def _reg_frame(n=500, seed=0):
+    rng = np.random.default_rng(seed)
+    cols = {f"x{i}": rng.normal(size=n) for i in range(4)}
+    cols["y"] = cols["x0"] * 2.0 - cols["x1"] + rng.normal(size=n) * 0.1
+    return h2o.Frame.from_numpy(cols)
+
+
+def _cls_frame(n=8000, seed=1):
+    rng = np.random.default_rng(seed)
+    cols = {f"x{i}": rng.normal(size=n).astype(np.float32)
+            for i in range(6)}
+    logit = cols["x0"] - 0.7 * cols["x1"]
+    cols["resp"] = np.array(["n", "y"], dtype=object)[
+        (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(int)]
+    return cols
+
+
+def _trees_equal(a, b, msg=""):
+    import jax
+    for k in ("_feat", "_thr", "_na_left", "_is_split", "_value"):
+        ea = np.asarray(jax.device_get(getattr(a, k)))
+        eb = np.asarray(jax.device_get(getattr(b, k)))
+        assert ea.shape == eb.shape, f"{msg}{k} shapes differ"
+        assert (ea == eb).all(), f"{msg}{k} differs"
+
+
+_KW = dict(ntrees=12, max_depth=3, seed=7, learn_rate=0.2)
+
+
+# ------------------------------------------------ checked no-op gate
+
+def test_recovery_checked_noop_when_unset():
+    """Acceptance: with H2O3_RECOVERY_DIR unset the machinery is a
+    checked no-op — the boot hook does not even import the module, and
+    the per-call gate is one env lookup."""
+    assert not os.environ.get("H2O3_RECOVERY_DIR")
+    assert recovery.enabled() is False
+    assert recovery.scan() == ([], [])
+    rep = recovery.recover_at_boot(wait=True)
+    assert rep["enabled"] is False and not rep["resumed"]
+    # the cluster_boot hook must short-circuit BEFORE importing the
+    # recovery module (boot-time overhead guard)
+    from h2o3_tpu import cluster_boot
+    saved = sys.modules.pop("h2o3_tpu.recovery")
+    try:
+        assert cluster_boot.run_boot_recovery() is None
+        assert "h2o3_tpu.recovery" not in sys.modules
+    finally:
+        sys.modules["h2o3_tpu.recovery"] = saved
+    # per-call budget: the gate every train start pays
+    n = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        recovery.enabled()
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 50e-6, f"enabled() costs {per_call * 1e6:.2f}µs"
+
+
+# ------------------------------------------------ manifest lifecycle
+
+def test_manifest_recorded_and_dropped_on_done(tmp_path, monkeypatch):
+    recdir = tmp_path / "rec"
+    monkeypatch.setenv("H2O3_RECOVERY_DIR", str(recdir))
+    fr = _reg_frame()
+    est = GBM(model_id="reco_done_gbm",
+              in_training_checkpoints_dir=str(tmp_path / "ck"),
+              in_training_checkpoints_tree_interval=4, **_KW)
+    est.train(y="y", training_frame=fr)
+    # DONE dropped the manifest (deliberate terminal state) but the
+    # durable inputs remain: frame artifact + ckpt-dir registry
+    assert os.listdir(recdir / "manifests") == []
+    assert any(f.endswith(".zip") for f in os.listdir(recdir / "frames"))
+    dirs = json.loads((recdir / "ckpt_dirs.json").read_text())
+    assert str(tmp_path / "ck") in dirs
+    # a train WITHOUT checkpoints records nothing
+    est2 = GBM(**_KW)
+    est2.train(y="y", training_frame=fr)
+    assert os.listdir(recdir / "manifests") == []
+
+
+# ------------------------------------------------ crash → boot recovery
+
+@pytest.fixture(params=["multi-shard", "single-shard"])
+def pinned_mesh(request):
+    """The acceptance demands parity on the 8-virtual-device CPU mesh
+    both single- and multi-shard; the conftest forces 8 devices, so
+    multi-shard is the default mesh and single-shard pins device 0."""
+    import jax
+    from h2o3_tpu.parallel import mesh as mesh_mod
+    old = mesh_mod.current_mesh()
+    if request.param == "single-shard":
+        mesh_mod.set_mesh(mesh_mod.make_mesh(n_data=1,
+                                             devices=jax.devices()[:1]))
+    yield request.param
+    mesh_mod.set_mesh(old)
+
+
+def test_crash_then_boot_recovery_bit_identical(tmp_path, monkeypatch,
+                                                pinned_mesh):
+    """Kill a checkpointing train mid-flight (Fatal fault — the
+    in-process spelling of kill -9; the subprocess spelling is the
+    slow-tier test below), then run the boot scan: the resumed model's
+    tree arrays are bit-identical to an uninterrupted train, the Job
+    re-registers with the ORIGINAL trace id, and the manifest is gone
+    once the resume completes."""
+    recdir = tmp_path / "rec"
+    monkeypatch.setenv("H2O3_RECOVERY_DIR", str(recdir))
+    fr = _reg_frame(seed=3)
+    ref = GBM(**_KW)
+    ref.train(y="y", training_frame=fr)
+
+    faults.configure("execute@train:every=1:after=1:times=1:exc=Fatal")
+    crashed = GBM(model_id="reco_crash_gbm",
+                  in_training_checkpoints_dir=str(tmp_path / "ck"),
+                  in_training_checkpoints_tree_interval=3, **_KW)
+    with pytest.raises(RuntimeError):
+        crashed.train(y="y", training_frame=fr)
+    faults.configure(None)
+    assert len(os.listdir(recdir / "manifests")) == 1
+    ents, _ = recovery.scan()
+    assert ents[0]["ckpt_trees"] and ents[0]["ckpt_trees"] < _KW["ntrees"]
+    orig_trace = ents[0]["trace_id"]
+    assert orig_trace
+
+    rep = recovery.recover_at_boot(wait=True)
+    assert [e["model_key"] for e in rep["resumed"]] == ["reco_crash_gbm"]
+    assert rep["resumed"][0]["trace_id"] == orig_trace
+    assert not rep["failed"]
+    resumed = dkv.get("reco_crash_gbm", "model")
+    assert resumed.ntrees_built == _KW["ntrees"]
+    _trees_equal(ref.model, resumed, msg=f"[{pinned_mesh}] ")
+    # success is a deliberate terminal state: manifest dropped
+    assert os.listdir(recdir / "manifests") == []
+    dkv.remove("reco_crash_gbm")
+
+
+def test_background_resume_marks_job_recovering(tmp_path, monkeypatch):
+    """The boot path resumes in the BACKGROUND (REST port must come up
+    immediately); the re-registered job surfaces as RECOVERING with the
+    original trace id until it lands."""
+    recdir = tmp_path / "rec"
+    monkeypatch.setenv("H2O3_RECOVERY_DIR", str(recdir))
+    fr = _reg_frame(seed=5)
+    faults.configure("execute@train:every=1:after=1:times=1:exc=Fatal")
+    crashed = GBM(model_id="reco_bg_gbm",
+                  in_training_checkpoints_dir=str(tmp_path / "ck"),
+                  in_training_checkpoints_tree_interval=3, **_KW)
+    with pytest.raises(RuntimeError):
+        crashed.train(y="y", training_frame=fr)
+    faults.configure(None)
+
+    ents, _ = recovery.scan()
+    orig_trace = ents[0]["trace_id"]
+    rep = recovery.recover_at_boot(wait=False)
+    assert rep["resumed"] and rep["resumed"][0]["job_status"] in (
+        "RECOVERING", "DONE")
+    from h2o3_tpu import jobs
+    j = jobs.get_job(rep["resumed"][0]["job_key"])
+    assert j is not None and j.trace_id == orig_trace
+    recovery.wait_for_recoveries(timeout=300)
+    assert j.status == jobs.DONE
+    assert dkv.get("reco_bg_gbm", "model").ntrees_built == _KW["ntrees"]
+    dkv.remove("reco_bg_gbm")
+
+
+def test_job_v3_renders_recovering():
+    from h2o3_tpu import jobs
+    from h2o3_tpu.api import schemas
+    j = jobs.Job("recovery probe")
+    j.status = jobs.RECOVERING
+    v = schemas.job_v3(j)
+    assert v["status"] == "RECOVERING"
+    assert v["progress_msg"] == "Recovering"
+    j.status = jobs.DONE
+
+
+# ------------------------------------------------ corruption / faults / GC
+
+def test_manifest_corruption_warns_and_boots_clean(tmp_path, monkeypatch):
+    recdir = tmp_path / "rec"
+    monkeypatch.setenv("H2O3_RECOVERY_DIR", str(recdir))
+    mdir = recdir / "manifests"
+    mdir.mkdir(parents=True)
+    (mdir / "garbage.json").write_text("{not json at all")
+    (mdir / "wrongshape.json").write_text(json.dumps(["a", "list"]))
+    (mdir / "nofields.json").write_text(json.dumps({"version": 1}))
+    rep = recovery.recover_at_boot(wait=True)   # must NOT raise
+    assert len(rep["corrupt"]) == 3 and not rep["resumed"]
+    # evidence kept aside, never rescanned — the next boot is clean
+    assert sorted(f for f in os.listdir(mdir)) == [
+        "garbage.json.corrupt", "nofields.json.corrupt",
+        "wrongshape.json.corrupt"]
+    rep2 = recovery.recover_at_boot(wait=True)
+    assert not rep2["corrupt"] and not rep2["resumed"]
+
+
+def test_boot_fault_site_never_wedges_startup(tmp_path, monkeypatch):
+    """The new ``boot`` fault site fires inside the per-manifest resume
+    — an injected failure lands in the report's ``failed`` list and
+    boot proceeds."""
+    recdir = tmp_path / "rec"
+    monkeypatch.setenv("H2O3_RECOVERY_DIR", str(recdir))
+    mdir = recdir / "manifests"
+    mdir.mkdir(parents=True)
+    (mdir / "m.json").write_text(json.dumps(
+        {"version": 1, "model_key": "boot_fault_gbm", "algo": "gbm",
+         "frame_path": str(recdir / "frames" / "none.zip"),
+         "ckpt_dir": str(tmp_path / "ck"), "y": "y"}))
+    faults.configure("boot:every=1:exc=Internal")
+    rep = recovery.recover_at_boot(wait=True)   # must NOT raise
+    faults.configure(None)
+    assert rep["failed"] and rep["failed"][0]["model_key"] == \
+        "boot_fault_gbm"
+    assert not rep["resumed"]
+
+
+def test_boot_gc_age_and_ownership(tmp_path, monkeypatch):
+    """Orphaned checkpoint artifacts age out at boot; artifacts the
+    scan CLAIMED (about to be resumed from) are kept regardless of
+    age, as are young orphans."""
+    recdir = tmp_path / "rec"
+    ckdir = tmp_path / "ck"
+    ckdir.mkdir()
+    monkeypatch.setenv("H2O3_RECOVERY_DIR", str(recdir))
+    monkeypatch.setenv("H2O3_RECOVERY_GC_AGE_SECS", "60")
+    (recdir / "manifests").mkdir(parents=True)
+    (recdir / "ckpt_dirs.json").write_text(json.dumps([str(ckdir)]))
+    old = time.time() - 3600
+    for name, is_old in (("dead_gbm_t5.zip", True),
+                         ("dead_gbm_t9.zip", True),
+                         ("young_gbm_t2.zip", False),
+                         ("claimed_gbm_t4.zip", True),
+                         ("notackpt.txt", True)):
+        p = ckdir / name
+        p.write_bytes(b"x")
+        if is_old:
+            os.utime(p, (old, old))
+    # a manifest claims claimed_gbm (its resume will fail — no frame —
+    # but the CLAIM must still protect its artifacts from GC)
+    (recdir / "manifests" / "claimed_gbm.json").write_text(json.dumps(
+        {"version": 1, "model_key": "claimed_gbm", "algo": "gbm",
+         "frame_path": str(recdir / "missing.zip"),
+         "ckpt_dir": str(ckdir), "y": "y"}))
+    rep = recovery.recover_at_boot(wait=True)
+    left = sorted(os.listdir(ckdir))
+    assert left == ["claimed_gbm_t4.zip", "notackpt.txt",
+                    "young_gbm_t2.zip"], left
+    assert len(rep["gc"]["removed"]) == 2
+    assert rep["gc"]["kept_claimed"] == 1
+
+
+def test_resume_attempt_cap_abandons_doomed_manifest(tmp_path,
+                                                     monkeypatch):
+    """A manifest that failed its boot resume H2O3_RECOVERY_MAX_ATTEMPTS
+    times is renamed ``*.abandoned`` instead of re-training the doomed
+    job on every restart forever; fresher manifests count attempts up
+    across boots."""
+    recdir = tmp_path / "rec"
+    monkeypatch.setenv("H2O3_RECOVERY_DIR", str(recdir))
+    mdir = recdir / "manifests"
+    mdir.mkdir(parents=True)
+    ent = {"version": 1, "model_key": "doomed_gbm", "algo": "gbm",
+           "frame_path": str(recdir / "frames" / "none.zip"),
+           "ckpt_dir": str(tmp_path / "ck"), "y": "y"}
+    (mdir / "doomed_gbm.json").write_text(json.dumps(ent))
+    # boots 1..3: the resume fails (missing frame), the attempt counter
+    # advances in the rewritten manifest
+    for want_attempts in (1, 2, 3):
+        rep = recovery.recover_at_boot(wait=True)
+        assert rep["failed"] and not rep["abandoned"], rep
+        got = json.loads((mdir / "doomed_gbm.json").read_text())
+        assert got["resume_attempts"] == want_attempts
+    # boot 4: over the cap — abandoned aside, never resumed again
+    rep = recovery.recover_at_boot(wait=True)
+    assert rep["abandoned"] == ["doomed_gbm"] and not rep["failed"]
+    assert not (mdir / "doomed_gbm.json").exists()
+    assert (mdir / "doomed_gbm.json.abandoned").exists()
+    rep2 = recovery.recover_at_boot(wait=True)
+    assert not rep2["abandoned"] and not rep2["failed"]
+
+
+def test_kill_after_final_commit_registers_artifact(tmp_path,
+                                                    monkeypatch):
+    """A kill landing AFTER the final checkpoint committed but BEFORE
+    the manifest dropped must not retrain (checkpoint= would reject
+    ntrees == ntrees_built on every boot): the finished artifact is
+    registered directly."""
+    recdir = tmp_path / "rec"
+    ck = tmp_path / "ck"
+    monkeypatch.setenv("H2O3_RECOVERY_DIR", str(recdir))
+    fr = _reg_frame(seed=8)
+    est = GBM(model_id="final_win_gbm",
+              in_training_checkpoints_dir=str(ck),
+              in_training_checkpoints_tree_interval=5, **_KW)
+    est.train(y="y", training_frame=fr)
+    # the final commit left a _t<ntrees> artifact; simulate the kill
+    # window by resurrecting the manifest the DONE path dropped
+    assert (ck / f"final_win_gbm_t{_KW['ntrees']}.zip").exists()
+    (recdir / "manifests").mkdir(exist_ok=True)
+    (recdir / "manifests" / "final_win_gbm.json").write_text(json.dumps(
+        {"version": 1, "model_key": "final_win_gbm", "algo": "gbm",
+         "params": {"ntrees": _KW["ntrees"]},
+         "frame_path": str(recdir / "frames" / "gone.zip"),
+         "ckpt_dir": str(ck), "y": "y"}))
+    dkv.remove("final_win_gbm")
+    rep = recovery.recover_at_boot(wait=True)
+    assert not rep["failed"], rep
+    assert rep["resumed"][0]["completed_from_artifact"] is True
+    assert rep["resumed"][0]["job_status"] == "DONE"
+    got = dkv.get("final_win_gbm", "model")
+    assert got.ntrees_built == _KW["ntrees"]
+    _trees_equal(est.model, got, msg="artifact registration: ")
+    assert os.listdir(recdir / "manifests") == []   # completed for real
+    dkv.remove("final_win_gbm")
+
+
+def test_rest_recovery_scan_is_read_only(tmp_path, monkeypatch):
+    """GET /3/Recovery must not quarantine corrupt manifests — renaming
+    aside is the BOOT scan's job; a monitoring poll that did it would
+    erase the next boot's loud corrupt report."""
+    recdir = tmp_path / "rec"
+    monkeypatch.setenv("H2O3_RECOVERY_DIR", str(recdir))
+    mdir = recdir / "manifests"
+    mdir.mkdir(parents=True)
+    (mdir / "bad.json").write_text("{truncated")
+    ents, corrupt = recovery.scan(quarantine=False)
+    assert not ents and len(corrupt) == 1
+    assert (mdir / "bad.json").exists()          # untouched
+    # the boot-time spelling still quarantines
+    _, corrupt2 = recovery.scan()
+    assert len(corrupt2) == 1
+    assert (mdir / "bad.json.corrupt").exists()
+
+
+def test_frame_artifact_keyed_by_content(tmp_path, monkeypatch):
+    """Frame keys are user-assignable (destination_frame) and re-usable
+    across imports of DIFFERENT data — the recovery artifact name
+    carries a content fingerprint so a stale same-key artifact is never
+    resumed on."""
+    recdir = tmp_path / "rec"
+    monkeypatch.setenv("H2O3_RECOVERY_DIR", str(recdir))
+    for model_id, seed in (("sig_a_gbm", 11), ("sig_b_gbm", 12)):
+        fr = _reg_frame(seed=seed)
+        fr.key = "reused_key"          # the overwrite-a-key workflow
+        est = GBM(model_id=model_id,
+                  in_training_checkpoints_dir=str(tmp_path / "ck"),
+                  in_training_checkpoints_tree_interval=6, **_KW)
+        est.train(y="y", training_frame=fr)
+    arts = sorted(os.listdir(recdir / "frames"))
+    assert len(arts) == 2, arts       # different data → own artifacts
+    assert all(a.startswith("reused_key.") for a in arts), arts
+
+
+# ------------------------------------------------ fleet circuit state
+
+def _deploy_tiny_model(key="fleet_gbm"):
+    fr = _reg_frame(seed=9)
+    est = GBM(ntrees=3, max_depth=2, seed=1)
+    est.train(y="y", training_frame=fr)
+    model = est.model
+    model.key = key
+    dkv.put(key, "model", model)
+    dep = serve.deploy(key, max_delay_ms=0.5, max_batch=8)
+    row = {f"x{i}": 0.1 * i for i in range(4)}
+    return dep, row
+
+
+def test_fleet_circuit_sheds_load_and_expires(monkeypatch):
+    """Acceptance: a peer's open circuit → this replica returns fast
+    503 + Retry-After for that deployment while it is open; the entry
+    expires and traffic resumes."""
+    dep, row = _deploy_tiny_model()
+    try:
+        serve.fleet.observe_peer_states(
+            [{"model": dep.key, "state": "open", "retry_after_s": 30.0,
+              "open_count": 1}], source="4242@peerhost")
+        with pytest.raises(serve.ServeCircuitOpenError) as ei:
+            dep.predict_rows([row], timeout_ms=2000)
+        assert ei.value.retry_after_s > 0
+        assert "peer" in str(ei.value)
+        st = serve.stats()["fleet_circuit"]
+        assert dep.key in st["shedding"]
+        assert st["peers"][0]["source"] == "4242@peerhost"
+        # the peer's circuit closed → its next gossip clears the entry
+        serve.fleet.observe_peer_states(
+            [{"model": dep.key, "state": "closed"}],
+            source="4242@peerhost")
+        out = dep.predict_rows([row], timeout_ms=5000)
+        assert len(out) == 1
+        assert not serve.stats()["fleet_circuit"]["shedding"]
+    finally:
+        serve.undeploy(dep.key)
+        dkv.remove(dep.key)
+
+
+def test_fleet_local_state_wins_over_stale_gossip():
+    """First-hand local health newer than the gossip admits traffic —
+    a replica actively serving a deployment never sheds on old news;
+    and self-reports never create rejection state."""
+    dep, row = _deploy_tiny_model(key="fleet_local_gbm")
+    try:
+        # serve once: the breaker records a device success timestamp
+        dep.predict_rows([row], timeout_ms=5000)
+        assert dep.breaker.last_success_time > 0
+        serve.fleet.observe_peer_states(
+            [{"model": dep.key, "state": "open", "retry_after_s": 30.0}],
+            source="7@peer")
+        # rewind the stored report to BEFORE the local success — stale
+        # gossip that local evidence contradicts
+        with serve.fleet._MU:
+            for e in serve.fleet._STORE.values():
+                e["time"] = dep.breaker.last_success_time - 10.0
+        out = dep.predict_rows([row], timeout_ms=5000)
+        assert len(out) == 1
+        # a self report (launcher's shared peer list) never rejects
+        serve.fleet.reset()
+        serve.fleet.observe_peer_states(
+            [{"model": dep.key, "state": "open", "retry_after_s": 30.0}],
+            source="me@here", self_process=True)
+        assert serve.fleet.reject_for(dep.key) is None
+    finally:
+        serve.undeploy(dep.key)
+        dkv.remove(dep.key)
+
+
+def test_fleet_propagates_through_cluster_scrape(monkeypatch):
+    """The telemetry-plane wiring: a peer snapshot's ``circuit``
+    payload ingested by the SAME cluster scrape that merges metrics
+    (extra_snapshots spelling — no HTTP needed) makes this replica
+    shed within one scrape."""
+    from h2o3_tpu.telemetry import snapshot as telesnap
+    dep, row = _deploy_tiny_model(key="fleet_scrape_gbm")
+    try:
+        peer_snap = {"version": 1, "time": time.time(), "enabled": True,
+                     "process": {"pid": 1, "host": "peerhost"},
+                     "samples": [], "spans": [],
+                     "circuit": [{"model": dep.key, "state": "open",
+                                  "retry_after_s": 20.0,
+                                  "open_count": 2,
+                                  "time": time.time()}]}
+        telesnap.cluster_samples(extra_snapshots=[peer_snap])
+        with pytest.raises(serve.ServeCircuitOpenError):
+            dep.predict_rows([row], timeout_ms=2000)
+        assert dep.key in serve.stats()["fleet_circuit"]["shedding"]
+    finally:
+        serve.undeploy(dep.key)
+        dkv.remove(dep.key)
+
+
+def test_fleet_visible_over_rest_self_peer(monkeypatch):
+    """Acceptance (self-peer spelling): an OPEN circuit is published in
+    /3/Telemetry/snapshot, survives the cluster scrape, and shows in
+    /3/Serve/stats ``fleet_circuit`` — while the self-filter keeps a
+    replica from shedding on gossip about itself."""
+    import urllib.request
+    from h2o3_tpu.api import server as apisrv
+    dep, row = _deploy_tiny_model(key="fleet_rest_gbm")
+    srv = apisrv.start_server(port=0)
+    try:
+        # open the local circuit the direct way (no faults needed)
+        for _ in range(dep.breaker.failure_threshold):
+            dep.breaker.record_failure()
+        assert dep.breaker.state == "open"
+        base = f"http://127.0.0.1:{srv.port}"
+        snap = json.loads(urllib.request.urlopen(
+            base + "/3/Telemetry/snapshot?n=0", timeout=30).read())
+        circ = [c for c in snap.get("circuit", [])
+                if c["model"] == dep.key]
+        assert circ and circ[0]["state"] == "open"
+        assert circ[0]["retry_after_s"] > 0
+        monkeypatch.setenv("H2O3_TELEMETRY_PEERS",
+                           f"127.0.0.1:{srv.port}")
+        cl = json.loads(urllib.request.urlopen(
+            base + "/3/Telemetry/cluster", timeout=30).read())
+        assert cl["peers_ok"]
+        st = json.loads(urllib.request.urlopen(
+            base + "/3/Serve/stats", timeout=30).read())
+        # local state is visible in the fleet view ...
+        assert any(c["model"] == dep.key and c["state"] == "open"
+                   for c in st["fleet_circuit"]["local"])
+        # ... but a self-peer scrape creates no PEER rejection entry
+        # (the local breaker already owns the local verdict)
+        assert serve.fleet.reject_for(dep.key) is None
+        # the per-process gauge view survives the cluster merge
+        assert any(k.startswith("h2o3_circuit_state{")
+                   for k in cl["metrics"])
+    finally:
+        srv.stop()
+        serve.undeploy(dep.key)
+        dkv.remove(dep.key)
+
+
+def test_fleet_gauge_zeroes_when_last_entry_expires(monkeypatch):
+    """The h2o3_fleet_circuit_open gauge must not read 1 forever after
+    a dead peer's open report ages out with no fresh gossip for that
+    model."""
+    from h2o3_tpu import telemetry
+    monkeypatch.setenv("H2O3_FLEET_CIRCUIT_TTL", "0.05")
+    serve.fleet.observe_peer_states(
+        [{"model": "ghost_gbm", "state": "open",
+          "retry_after_s": 0.05}], source="1@deadpeer")
+    reg = telemetry.registry()
+    assert reg.value("h2o3_fleet_circuit_open",
+                     {"model": "ghost_gbm"}) == 1
+    time.sleep(0.12)
+    # any store touch that expires the entry must re-publish the gauge
+    assert serve.fleet.reject_for("ghost_gbm") is None
+    assert reg.value("h2o3_fleet_circuit_open",
+                     {"model": "ghost_gbm"}) == 0
+
+
+# ------------------------------------------------ streamed checkpoints
+
+_ST_KW = dict(max_depth=3, nbins=16, seed=1, score_tree_interval=0,
+              stopping_rounds=0)
+
+
+def _single_device_mesh():
+    import jax
+    from h2o3_tpu.parallel import mesh as mesh_mod
+    return mesh_mod, mesh_mod.make_mesh(n_data=1,
+                                        devices=jax.devices()[:1])
+
+
+def test_streamed_checkpoint_resume_matches_dense_resume(tmp_path):
+    """Acceptance: streamed-GBM ``checkpoint=`` no longer raises, and
+    the resume is bit-identical to the DENSE resume on fully-resident
+    data. Pinned single-shard + inside the PR-5 dense==streamed parity
+    horizon (the sharded psum's accumulation order is not part of this
+    contract — see test_transfer_budget's parity note)."""
+    mesh_mod, pinned = _single_device_mesh()
+    old_mesh = mesh_mod.current_mesh()
+    mesh_mod.set_mesh(pinned)
+    try:
+        memman.reset()
+        cols = _cls_frame()
+        kw = dict(ntrees=4, **_ST_KW)
+        ck = tmp_path / "ck"
+        fr = h2o.Frame.from_numpy(cols)
+        d = GBM(in_training_checkpoints_dir=str(ck),
+                in_training_checkpoints_tree_interval=2, **kw)
+        d.train(y="resp", training_frame=fr)
+        arts = sorted(os.listdir(ck))
+        art = str(ck / [a for a in arts if a.endswith("_t2.zip")][0])
+        dense_res = GBM(checkpoint=art, **kw)
+        dense_res.train(y="resp", training_frame=fr)
+        assert not dense_res.model.output.get("streamed")
+        memman.reset(budget=460_000)
+        st_res = GBM(checkpoint=art, **kw)
+        st_res.train(y="resp",
+                     training_frame=h2o.Frame.from_numpy(cols))
+        memman.reset()
+        assert st_res.model.output.get("streamed") is True
+        sp = st_res.model.output["stream_profile"]
+        assert sp["resident_chunks"] == sp["chunks"]   # fully resident
+        assert st_res.model.ntrees_built == kw["ntrees"]
+        _trees_equal(dense_res.model, st_res.model,
+                     msg="dense-vs-streamed resume: ")
+    finally:
+        mesh_mod.set_mesh(old_mesh)
+        memman.reset()
+
+
+def test_streamed_intraining_checkpoints_resume_bit_identical(tmp_path):
+    """The resident-window path WRITES in-training checkpoints now
+    (formerly warn-and-drop), and a streamed resume from one is
+    bit-identical to the uninterrupted streamed train."""
+    mesh_mod, pinned = _single_device_mesh()
+    old_mesh = mesh_mod.current_mesh()
+    mesh_mod.set_mesh(pinned)
+    try:
+        cols = _cls_frame(seed=2)
+        kw = dict(ntrees=10, **_ST_KW)
+        ck = tmp_path / "ck"
+        memman.reset(budget=460_000)
+        unint = GBM(**kw)
+        unint.train(y="resp", training_frame=h2o.Frame.from_numpy(cols))
+        assert unint.model.output.get("streamed") is True
+        ckd = GBM(in_training_checkpoints_dir=str(ck),
+                  in_training_checkpoints_tree_interval=4, **kw)
+        ckd.train(y="resp", training_frame=h2o.Frame.from_numpy(cols))
+        arts = sorted(os.listdir(ck))
+        assert any(a.endswith("_t4.zip") for a in arts), arts
+        assert any(a.endswith("_t10.zip") for a in arts), arts
+        # the DKV entry is dropped at completion (dense final=True
+        # contract), artifacts stay durable — and the RETURNED model
+        # must not pin the dataset-sized resume margin (that rides the
+        # artifact copy only)
+        assert dkv.get_opt(f"{ckd.model.key}_ckpt") is None
+        assert getattr(ckd.model, "_resume_margin", None) is None
+        art = str(ck / [a for a in arts if a.endswith("_t4.zip")][0])
+        res = GBM(checkpoint=art, **kw)
+        res.train(y="resp", training_frame=h2o.Frame.from_numpy(cols))
+        memman.reset()
+        assert res.model.output.get("streamed") is True
+        _trees_equal(unint.model, res.model,
+                     msg="streamed resume vs uninterrupted: ")
+    finally:
+        mesh_mod.set_mesh(old_mesh)
+        memman.reset()
+
+
+# ------------------------------------------------ DL cancel polling
+
+class _CancelAfter:
+    """Job stand-in whose cancel_requested flips after N progress
+    heartbeats (the test_spmd_parity pattern)."""
+
+    def __init__(self, beats):
+        from h2o3_tpu.jobs import Job
+        self._job = Job("test-cancel", work=1.0)
+        self._beats = beats
+        if beats <= 0:
+            self._job.cancel(reason="test")
+
+    def __getattr__(self, name):
+        return getattr(self._job, name)
+
+    def set_progress(self, p):
+        self._beats -= 1
+        if self._beats <= 0:
+            self._job.cancel(reason="test")
+        return self._job.set_progress(p)
+
+
+def test_dl_polls_cancel_in_epoch_loop():
+    """DeepLearning was the last ROADMAP-listed algo without inner-loop
+    cancel/max_runtime polling: the epoch driver now polls BEFORE each
+    dispatch and bounds in-flight epochs, so a watchdog cancel stops
+    training within ~one epoch instead of after all of them."""
+    from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
+    rng = np.random.default_rng(0)
+    cols = {f"x{i}": rng.normal(size=600) for i in range(4)}
+    cols["y"] = cols["x0"] - cols["x1"] + rng.normal(size=600) * 0.1
+    fr = h2o.Frame.from_numpy(cols)
+    est = H2ODeepLearningEstimator(hidden=[8], epochs=60,
+                                   mini_batch_size=64, seed=1)
+    spec = est._make_spec(fr, "y", None)
+    job = _CancelAfter(beats=2)
+    model = est._train_impl(spec, None, job)
+    assert job.cancel_requested
+    assert model.output["epochs_trained"] <= 4, \
+        f"epoch loop ran {model.output['epochs_trained']} epochs past " \
+        f"the cancel"
+    # pre-cancelled (the watchdog max_runtime shape): nothing dispatches
+    est2 = H2ODeepLearningEstimator(hidden=[8], epochs=60,
+                                    mini_batch_size=64, seed=1)
+    job2 = _CancelAfter(beats=0)
+    model2 = est2._train_impl(spec, None, job2)
+    assert model2.output["epochs_trained"] == 0
+
+
+# ------------------------------------------------ subprocess kill -9
+
+@pytest.mark.slow
+def test_kill9_subprocess_then_fresh_boot_recovery():
+    """The real thing: a WORKER PROCESS is SIGKILLed mid-train; this
+    process (fresh, relative to the dead worker) boots, scans the
+    recovery dir and resumes — tree arrays bit-identical to an
+    uninterrupted train on the same mesh width (the chaos tool's
+    --kill-process round, asserted)."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    from chaos_sweep import run_kill_process_round
+    out = run_kill_process_round(rows=2000, log=print)
+    assert out["recovered_after_restart"] is True, out
+    assert out["restart_recovery_s"] is not None
+    assert out.get("resumed_from_trees"), out

@@ -13,27 +13,47 @@ through the deterministic fault layer (h2o3_tpu/faults.py) and emits::
     resilience.faults_injected    total faults the layer raised
     resilience.ckpt_resume_ok     mid-train kill → checkpoint resume
                                   produced the bit-identical model
+    resilience.recovered_after_restart
+                                  kill -9 of the WORKER PROCESS mid-
+                                  train → fresh-process boot recovery
+                                  resumed a bit-identical model
+                                  (ISSUE 9; --kill-process /
+                                  H2O3_BENCH_CHAOS_KILL)
+    resilience.restart_recovery_s boot-scan → resumed-model wall time
 
 Usage::
 
     JAX_PLATFORMS=cpu python tools/chaos_sweep.py           # standalone
+    JAX_PLATFORMS=cpu python tools/chaos_sweep.py --kill-process
     # bench.py runs the same round via run_chaos_round() unless
-    # H2O3_BENCH_CHAOS=0
+    # H2O3_BENCH_CHAOS=0; the process-kill round rides along unless
+    # H2O3_BENCH_CHAOS_KILL=0
 
 The sweep sizes itself small (seconds, not minutes): it guards the
 RECOVERY machinery, not throughput — BENCH_*.json keeps the speed
-story.
+story. (The process-kill round pays one extra interpreter+jax start.)
 """
 import json
 import os
+import signal
+import subprocess
 import sys
 import tempfile
+import textwrap
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the process-kill probe's train: constants shared by the killed child
+# and the parent's uninterrupted reference so bit-parity is well-defined
+_KILL_MODEL_KEY = "chaos_restart_gbm"
+_KILL_PARAMS = dict(ntrees=40, max_depth=3, seed=13, learn_rate=0.2,
+                    in_training_checkpoints_tree_interval=2)
 
 
 def _counter(reg, name, labels=None):
@@ -58,16 +78,157 @@ def _recovery_p50_ms(reg):
     return round(float(np.median(samples)), 2) if samples else None
 
 
-def run_chaos_round(rows: int = 2000, log=print) -> dict:
+def _trees_equal(a, b) -> bool:
+    import jax
+    for k in ("_feat", "_thr", "_value"):
+        ea = np.asarray(jax.device_get(getattr(a, k)))
+        eb = np.asarray(jax.device_get(getattr(b, k)))
+        if ea.shape != eb.shape or not (ea == eb).all():
+            return False
+    return True
+
+
+def run_kill_process_round(rows: int = 2000, log=print,
+                           kill_deadline_s: float = 300.0) -> dict:
+    """The restart-recovery probe (ISSUE 9): SIGKILL a WORKER PROCESS
+    mid-train, then run the boot-time recovery scan in this (fresh,
+    relative to the dead worker) process and assert the resumed model
+    is bit-identical to an uninterrupted train on the same data.
+
+    The child is forced onto the SAME virtual-device count as this
+    process: the sharded histogram psum's accumulation order is part of
+    the bit-parity contract, so the killed train's committed prefix
+    must have been built under the mesh the resume continues on.
+    ``ran`` in the result says whether the probe actually exercised
+    recovery — a benign skip (child finished before the first
+    checkpoint, or this process is on a real accelerator the child
+    cannot share, so its CPU-built tree prefix would not be
+    bit-comparable) must not read as a recovery failure."""
+    import jax
+    out = {"ran": False, "recovered_after_restart": False,
+           "restart_recovery_s": None}
+    if jax.default_backend() != "cpu":
+        log("kill-process round: skipped — the child runs on CPU and "
+            f"this process is on {jax.default_backend()}; cross-backend "
+            "tree prefixes are not bit-comparable")
+        return out
+    base = tempfile.mkdtemp(prefix="chaos_restart_")
+    recdir = os.path.join(base, "recovery")
+    ckdir = os.path.join(base, "ckpts")
+    os.makedirs(ckdir, exist_ok=True)
+    env = dict(os.environ, H2O3_RECOVERY_DIR=recdir, JAX_PLATFORMS="cpu")
+    flags = env.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_"
+                            f"count={len(jax.devices())}").strip()
+    child_src = textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {_REPO!r})
+        import numpy as np
+        import h2o3_tpu as h2o
+        from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+        rng = np.random.default_rng(42)
+        rows = {rows}
+        cols = {{f"f{{i}}": rng.normal(size=rows) for i in range(6)}}
+        cols["y"] = (cols["f0"] * 2 - cols["f1"]
+                     + rng.normal(size=rows) * 0.1)
+        fr = h2o.Frame.from_numpy(cols)
+        est = H2OGradientBoostingEstimator(
+            model_id={_KILL_MODEL_KEY!r},
+            in_training_checkpoints_dir={ckdir!r}, **{_KILL_PARAMS!r})
+        est.train(y="y", training_frame=fr)
+        print("CHILD_DONE", flush=True)
+    """)
+    proc = subprocess.Popen([sys.executable, "-c", child_src], env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    killed = False
+    deadline = time.time() + kill_deadline_s
+    try:
+        while time.time() < deadline:
+            if any(fn.endswith(".zip") for fn in os.listdir(ckdir)):
+                os.kill(proc.pid, signal.SIGKILL)   # no cleanup, no flush
+                killed = True
+                break
+            if proc.poll() is not None:
+                break
+            time.sleep(0.2)
+    finally:
+        if proc.poll() is None and not killed:
+            proc.kill()
+        proc.wait()
+    if not killed:
+        log("kill-process round: child finished or died before the "
+            "first checkpoint — nothing to recover")
+        return out
+    prev = os.environ.get("H2O3_RECOVERY_DIR")
+    os.environ["H2O3_RECOVERY_DIR"] = recdir
+    try:
+        from h2o3_tpu import dkv, recovery
+        from h2o3_tpu.persist import load_frame
+        entries, _corrupt = recovery.scan()
+        if not entries:
+            # the kill can land AFTER the child's train completed
+            # (manifest already dropped deliberately) — a benign race,
+            # not a recovery failure; ran stays False
+            log("kill-process round: no manifest survived the kill "
+                "(train likely completed first) — nothing to recover")
+            return out
+        out["ran"] = True
+        frame_path = entries[0]["frame_path"]
+        t0 = time.time()
+        rep = recovery.recover_at_boot(wait=True)
+        out["restart_recovery_s"] = round(time.time() - t0, 3)
+        if not rep["resumed"]:
+            log(f"kill-process round: resume failed: {rep['failed']}")
+            return out
+        resumed = dkv.get(_KILL_MODEL_KEY, "model")
+        from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+        ref = H2OGradientBoostingEstimator(**_KILL_PARAMS)
+        ref.train(y="y", training_frame=load_frame(frame_path))
+        out["recovered_after_restart"] = _trees_equal(ref.model, resumed)
+        out["resumed_from_trees"] = rep["resumed"][0].get("ckpt_trees")
+        dkv.remove(_KILL_MODEL_KEY)
+    finally:
+        if prev is None:
+            os.environ.pop("H2O3_RECOVERY_DIR", None)
+        else:
+            os.environ["H2O3_RECOVERY_DIR"] = prev
+    log(f"kill-process round: "
+        f"{'PASS' if out['recovered_after_restart'] else 'FAIL'} {out}")
+    return out
+
+
+def run_chaos_round(rows: int = 2000, log=print,
+                    kill_process=None) -> dict:
     """Run the sweep with a hard guarantee that fault injection is
     DISARMED on every exit path — bench.py swallows chaos-round
     exceptions, and a leaked spec would corrupt everything the process
-    runs afterwards while looking organic."""
+    runs afterwards while looking organic. ``kill_process=None``
+    defaults from H2O3_BENCH_CHAOS_KILL (on unless '0')."""
     from h2o3_tpu import faults
     try:
-        return _chaos_round(rows, log)
+        out = _chaos_round(rows, log)
     finally:
         faults.configure(None)
+    if kill_process is None:
+        kill_process = os.environ.get("H2O3_BENCH_CHAOS_KILL",
+                                      "1") not in ("0", "false", "")
+    if kill_process:
+        try:
+            probe = run_kill_process_round(rows, log)
+        except Exception as e:   # noqa: BLE001 — probe must not sink bench
+            log(f"kill-process round FAILED to run: {e!r}")
+            probe = {"ran": True, "recovered_after_restart": False,
+                     "restart_recovery_s": None}
+        out.update(probe)
+        if probe.get("ran"):
+            # only a probe that actually exercised recovery can fail
+            # the sweep — a benign skip (wrong backend, child finished
+            # before the first checkpoint) is not a recovery failure
+            out["ok"] = bool(out["ok"]
+                             and out.get("recovered_after_restart"))
+    return out
 
 
 def _chaos_round(rows: int, log) -> dict:
@@ -105,13 +266,7 @@ def _chaos_round(rows: int, log) -> dict:
     ref = GBM(**kw)
     ref.train(y="y", training_frame=fr)
 
-    def trees_equal(a, b):
-        for k in ("_feat", "_thr", "_value"):
-            ea = np.asarray(jax.device_get(getattr(a, k)))
-            eb = np.asarray(jax.device_get(getattr(b, k)))
-            if ea.shape != eb.shape or not (ea == eb).all():
-                return False
-        return True
+    trees_equal = _trees_equal
 
     # 1) transient h2d + execute faults: an ingest under h2d faults
     #    parses correctly, a train under execute faults completes via
@@ -198,8 +353,11 @@ def _chaos_round(rows: int, log) -> dict:
 
 
 def main():
+    # --kill-process forces the restart-recovery round even when
+    # H2O3_BENCH_CHAOS_KILL=0; without it the env default applies
+    kill = True if "--kill-process" in sys.argv[1:] else None
     out = {"resilience": run_chaos_round(
-        log=lambda *a: print(*a, file=sys.stderr))}
+        log=lambda *a: print(*a, file=sys.stderr), kill_process=kill)}
     print(json.dumps(out, indent=2))
     sys.exit(0 if out["resilience"]["ok"] else 1)
 
