@@ -1,0 +1,31 @@
+"""h2o3_tpu.analysis — repo-native static analysis (`h2o3-lint`).
+
+The platform's performance and resilience story rests on conventions
+that nothing else enforces: every H2D/D2H flows through the
+telemetry-counted + fault-injectable seams, jitted hot paths must not
+hide recompile hazards or host syncs, and the threaded serve/jobs/
+telemetry planes must not block on device work while holding locks.
+H2O-3 enforces its equivalent invariants at build time (the javassist
+``Weaver`` rejects non-conforming ``Iced`` classes at class load); this
+package is the TPU rebuild's analog: an AST-based rule engine that runs
+in tier-1 (tests/test_lint.py) and via ``tools/h2o3_lint.py``.
+
+Layout:
+
+- :mod:`h2o3_tpu.analysis.core`  — rule framework: ``Rule``/``Finding``,
+  inline ``# h2o3-lint: allow[rule]`` suppressions, the checked-in
+  baseline ratchet, and the single-parse-per-file runner.
+- :mod:`h2o3_tpu.analysis.rules` — the rules encoding this repo's
+  invariants (transfer-seam, recompile-hazard, host-sync-hot-loop,
+  lock-discipline, fault-seam, monotonic-durations).
+- ``baseline.json`` — documented pre-existing findings; it may only
+  shrink (stale entries fail the run until removed).
+"""
+from h2o3_tpu.analysis.core import (Finding, LintReport, ModuleInfo, Rule,
+                                    load_baseline, run_lint, save_baseline)
+from h2o3_tpu.analysis.rules import all_rules, rule_names
+
+__all__ = [
+    "Finding", "LintReport", "ModuleInfo", "Rule", "all_rules",
+    "load_baseline", "rule_names", "run_lint", "save_baseline",
+]

@@ -1,0 +1,344 @@
+"""Rule framework for h2o3-lint: findings, suppressions, baseline, runner.
+
+Design constraints, in order:
+
+1. **One parse per file.** Every rule visits the same cached
+   ``ast.Module`` (``ModuleInfo``), so a whole-package run is dominated
+   by one ``ast.parse`` pass — fast enough for tier-1
+   (tests/test_lint.py runs it on every ``pytest`` invocation).
+2. **Ratchet, not gate.** Pre-existing findings live in a checked-in
+   baseline keyed on (rule, path, source-line text) — NOT line numbers,
+   so unrelated edits don't churn it. New findings fail; fixed findings
+   leave *stale* baseline entries which ALSO fail until removed, so the
+   baseline shrinks monotonically.
+3. **Explainable suppressions.** ``# h2o3-lint: allow[rule-a,rule-b]``
+   on the finding's line silences exactly the named rules on exactly
+   that line; an unknown rule name in a suppression is itself an error
+   (a typo'd allow must not silently stop allowing).
+"""
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+
+_ALLOW_RE = re.compile(r"#\s*h2o3-lint:\s*allow\[([^\]]*)\]")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str              # posix-style path relative to the lint root
+    line: int              # 1-based, informational (baseline ignores it)
+    col: int
+    message: str
+    severity: str = SEV_ERROR
+    code: str = ""         # stripped source line — the baseline identity
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.code)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "severity": self.severity,
+                "message": self.message, "code": self.code}
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.rule}] {self.severity}: {self.message}")
+
+
+class ModuleInfo:
+    """One parsed source file, shared by every rule."""
+
+    def __init__(self, abspath: str, relpath: str, source: str):
+        self.abspath = abspath
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        # line -> allowed rule names. Parsed from real COMMENT tokens,
+        # not a line regex — a docstring *describing* the suppression
+        # syntax must not BE a suppression (the linter's own docs were
+        # the first false positive)
+        self.allows: Dict[int, List[str]] = {}
+        try:
+            toks = tokenize.generate_tokens(io.StringIO(source).readline)
+            for tok in toks:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _ALLOW_RE.search(tok.string)
+                if m:
+                    self.allows[tok.start[0]] = [
+                        s.strip() for s in m.group(1).split(",")
+                        if s.strip()]
+        except tokenize.TokenError:
+            pass    # ast.parse succeeded, so this should be unreachable
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+class Rule:
+    """Base class. Subclasses set ``name``/``severity``/``scope`` and
+    implement ``check_module`` (scope "module") or ``check_package``
+    (scope "package" — rules needing the whole-program view, e.g.
+    fault-seam's registered-vs-used site matching). The class docstring
+    is the rule's catalog entry (surfaced by ``--rules``); record
+    tightening decisions there, not in the baseline."""
+
+    name = ""
+    severity = SEV_ERROR
+    scope = "module"
+
+    def check_module(self, mod: ModuleInfo) -> List[Finding]:
+        return []
+
+    def check_package(self, mods: Sequence[ModuleInfo]) -> List[Finding]:
+        return []
+
+    def finding(self, mod: ModuleInfo, node: ast.AST, message: str,
+                severity: Optional[str] = None) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(rule=self.name, path=mod.relpath, line=line,
+                       col=getattr(node, "col_offset", 0) + 1,
+                       message=message,
+                       severity=severity or self.severity,
+                       code=mod.line_text(line))
+
+
+@dataclass
+class LintReport:
+    new: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    suppressed: List[Finding] = field(default_factory=list)
+    stale: List[Dict[str, object]] = field(default_factory=list)
+    files: int = 0
+    rules: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.new and not self.stale
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "ok": self.ok,
+            "files": self.files,
+            "rules": self.rules,
+            "counts": {"new": len(self.new),
+                       "baselined": len(self.baselined),
+                       "suppressed": len(self.suppressed),
+                       "stale_baseline_entries": len(self.stale)},
+            "findings": [f.to_dict() for f in self.new],
+            "stale_baseline_entries": self.stale,
+        }
+
+
+# ---------------- file discovery ---------------------------------------
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d != "__pycache__" and not d.startswith(".")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(out)
+
+
+def parse_modules(paths: Iterable[str],
+                  root: Optional[str] = None
+                  ) -> Tuple[List[ModuleInfo], List[Finding]]:
+    """Parse every file once. Unparseable files become ``parse-error``
+    findings instead of aborting the run (the linter must never be the
+    thing that wedges CI on a half-written file)."""
+    root = os.path.abspath(root or os.getcwd())
+    mods: List[ModuleInfo] = []
+    errors: List[Finding] = []
+    for path in iter_py_files(paths):
+        abspath = os.path.abspath(path)
+        rel = os.path.relpath(abspath, root)
+        if rel.startswith(".."):
+            rel = abspath
+        try:
+            with open(abspath, "r", encoding="utf-8") as f:
+                source = f.read()
+            mods.append(ModuleInfo(abspath, rel, source))
+        except (SyntaxError, ValueError, OSError) as e:
+            errors.append(Finding(
+                rule="parse-error", path=rel.replace(os.sep, "/"),
+                line=getattr(e, "lineno", 1) or 1, col=1,
+                message=f"could not parse: {e}", severity=SEV_ERROR))
+    return mods, errors
+
+
+# ---------------- suppressions -----------------------------------------
+
+def apply_suppressions(findings: List[Finding], mods: Sequence[ModuleInfo],
+                       known_rules: Sequence[str]
+                       ) -> Tuple[List[Finding], List[Finding], List[Finding]]:
+    """Split findings into (kept, suppressed); also emit errors for
+    suppression comments naming unknown rules (anywhere in the file,
+    even lines with no finding — a typo'd allow is latent either way)."""
+    by_mod = {m.relpath: m for m in mods}
+    known = set(known_rules) | {"parse-error", "lint-suppression"}
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    errors: List[Finding] = []
+    for m in mods:
+        for lineno, names in m.allows.items():
+            for n in names:
+                if n not in known:
+                    errors.append(Finding(
+                        rule="lint-suppression", path=m.relpath,
+                        line=lineno, col=1,
+                        message=f"unknown rule '{n}' in suppression "
+                                f"(known: {', '.join(sorted(known_rules))})",
+                        severity=SEV_ERROR, code=m.line_text(lineno)))
+    for f in findings:
+        mod = by_mod.get(f.path)
+        allowed = mod.allows.get(f.line, []) if mod is not None else []
+        if f.rule in allowed:
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    return kept, suppressed, errors
+
+
+# ---------------- baseline ---------------------------------------------
+
+BASELINE_VERSION = 1
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def load_baseline(path: Optional[str] = None) -> Dict[Tuple[str, str, str], int]:
+    """Baseline as a multiset: (rule, path, code) -> count."""
+    path = path or default_baseline_path()
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    out: Dict[Tuple[str, str, str], int] = {}
+    for ent in data.get("entries", []):
+        key = (str(ent["rule"]), str(ent["path"]), str(ent["code"]))
+        out[key] = out.get(key, 0) + int(ent.get("count", 1))
+    return out
+
+
+def save_baseline(findings: Sequence[Finding],
+                  path: Optional[str] = None,
+                  note: str = "") -> str:
+    path = path or default_baseline_path()
+    counts: Dict[Tuple[str, str, str], int] = {}
+    lines: Dict[Tuple[str, str, str], int] = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+        lines.setdefault(f.key(), f.line)
+    entries = [{"rule": k[0], "path": k[1], "code": k[2],
+                "count": v, "line": lines[k]}
+               for k, v in sorted(counts.items())]
+    data = {"version": BASELINE_VERSION,
+            "note": note or
+            "Documented pre-existing findings. This file may only "
+            "shrink: fix a finding, then delete its entry (or rerun "
+            "tools/h2o3_lint.py --write-baseline). 'line' is "
+            "informational; identity is (rule, path, code).",
+            "entries": entries}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=False)
+        f.write("\n")
+    return path
+
+
+def match_baseline(findings: List[Finding],
+                   baseline: Dict[Tuple[str, str, str], int]
+                   ) -> Tuple[List[Finding], List[Finding],
+                              List[Dict[str, object]]]:
+    """Consume baseline entries multiset-style. Returns
+    (new, baselined, stale_entries)."""
+    remaining = dict(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        k = f.key()
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    stale = [{"rule": k[0], "path": k[1], "code": k[2], "count": v}
+             for k, v in sorted(remaining.items()) if v > 0]
+    return new, old, stale
+
+
+# ---------------- runner -----------------------------------------------
+
+def run_lint(paths: Sequence[str], rules: Sequence[Rule],
+             baseline: Optional[Dict[Tuple[str, str, str], int]] = None,
+             root: Optional[str] = None) -> LintReport:
+    mods, parse_errors = parse_modules(paths, root=root)
+    raw: List[Finding] = list(parse_errors)
+    for rule in rules:
+        if rule.scope == "package":
+            raw.extend(rule.check_package(mods))
+        else:
+            for m in mods:
+                raw.extend(rule.check_module(m))
+    kept, suppressed, supp_errors = apply_suppressions(
+        raw, mods, [r.name for r in rules])
+    kept.extend(supp_errors)
+    kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    new, old, stale = match_baseline(kept, baseline or {})
+    return LintReport(new=new, baselined=old, suppressed=suppressed,
+                      stale=stale, files=len(mods),
+                      rules=[r.name for r in rules])
+
+
+# ---------------- shared AST helpers (used by rules) -------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.device_put' for Attribute/Name chains; None otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def attach_parents(tree: ast.Module) -> None:
+    """Annotate each node with ``._h2o3_parent`` (idempotent)."""
+    if getattr(tree, "_h2o3_parented", False):
+        return
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            child._h2o3_parent = parent  # type: ignore[attr-defined]
+    tree._h2o3_parented = True  # type: ignore[attr-defined]
+
+
+def ancestors(node: ast.AST) -> Iterable[ast.AST]:
+    cur = getattr(node, "_h2o3_parent", None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, "_h2o3_parent", None)

@@ -1,0 +1,711 @@
+"""The h2o3-lint rules: this repo's invariants, machine-checked.
+
+Each rule's docstring is its catalog entry (``tools/h2o3_lint.py
+--rules`` prints them) and records the tightening decisions made when a
+finding turned out to be a false positive — per the repo policy, FPs
+tighten the rule instead of growing the baseline.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from h2o3_tpu.analysis.core import (Finding, ModuleInfo, Rule, SEV_ERROR,
+                                    SEV_WARNING, ancestors, attach_parents,
+                                    dotted_name)
+
+# ======================================================================
+# transfer-seam
+# ======================================================================
+
+# Modules allowed to touch the raw JAX transfer API: they ARE the seam.
+_BLESSED_TRANSFER_MODULES = (
+    # the one policy point for H2D: fault seam + retry + sharding
+    "h2o3_tpu/resilience.py",
+    # the counted D2H choke point (telemetry.device_get) + byte counters
+    "h2o3_tpu/telemetry/collectors.py",
+    # partitioner internals — called FROM resilience.resilient_shard_rows,
+    # it owns device placement for sharded arrays
+    "h2o3_tpu/parallel/mesh.py",
+    # the frame-layer choke point: spill/unspill/to_numpy count their
+    # own bytes inline (record_h2d/record_d2h with fallback="frame")
+    # and the unspill must run under the memman lock — it IS a seam
+    "h2o3_tpu/frame/vec.py",
+)
+
+
+class TransferSeamRule(Rule):
+    """Raw ``jax.device_put`` / ``jax.device_get`` /
+    ``(jax|x).block_until_ready`` outside the blessed seam modules.
+
+    Every H2D must flow through ``resilience.resilient_device_put`` /
+    ``resilient_shard_rows`` (fault-injectable, retried, counted) and
+    every ad-hoc D2H through ``telemetry.device_get`` (byte-counted), or
+    the transfer-budget guards (``train.streamed_h2d_guard``,
+    ``h2o3_{h2d,d2h}_pipeline_bytes_total``) silently under-report.
+    Deliberate pipeline barriers (the ingest double-buffer bound, the
+    train-loop timing fences) carry inline allows with a reason.
+
+    Scope decision: "np.asarray on a device value" is also a raw D2H,
+    but whether an ``np.asarray`` argument is device-resident is not
+    decidable syntactically — that spelling is only covered inside hot
+    zones (host-sync-hot-loop), where data is device-resident by
+    construction.
+    """
+
+    name = "transfer-seam"
+    severity = SEV_ERROR
+
+    _RAW = {"jax.device_put", "jax.device_get"}
+
+    def check_module(self, mod: ModuleInfo) -> List[Finding]:
+        if mod.relpath.endswith(_BLESSED_TRANSFER_MODULES):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name in self._RAW:
+                seam = ("resilience.resilient_device_put"
+                        if name.endswith("device_put")
+                        else "telemetry.device_get")
+                out.append(self.finding(
+                    mod, node,
+                    f"raw {name} outside the blessed seam modules — "
+                    f"route through {seam} so the transfer is counted "
+                    f"and fault-injectable"))
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "block_until_ready"):
+                out.append(self.finding(
+                    mod, node,
+                    "block_until_ready outside the blessed seam modules "
+                    "— a hidden host sync; if it is a deliberate "
+                    "pipeline barrier, add an inline allow with the "
+                    "reason"))
+        return out
+
+
+# ======================================================================
+# recompile-hazard
+# ======================================================================
+
+def _jit_static_names(deco: ast.AST, args: ast.arguments) -> Optional[Set[str]]:
+    """If ``deco`` spells jax.jit (bare, or partial(jax.jit, ...) /
+    jax.jit(...) with static_argnums/static_argnames), return the set of
+    STATIC parameter names; None when deco is not a jit spelling."""
+    posnames = [a.arg for a in args.posonlyargs + args.args]
+
+    def _resolve(call: ast.Call) -> Set[str]:
+        static: Set[str] = set()
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                        static.add(n.value)
+            elif kw.arg == "static_argnums":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                        if 0 <= n.value < len(posnames):
+                            static.add(posnames[n.value])
+        return static
+
+    d = dotted_name(deco)
+    if d in ("jax.jit", "jit"):
+        return set()
+    if isinstance(deco, ast.Call):
+        head = dotted_name(deco.func)
+        if head in ("jax.jit", "jit"):
+            return _resolve(deco)
+        if head in ("partial", "functools.partial") and deco.args:
+            if dotted_name(deco.args[0]) in ("jax.jit", "jit"):
+                return _resolve(deco)
+    return None
+
+
+def _is_static_test_ref(name_node: ast.Name) -> bool:
+    """A traced-param reference that is actually trace-time static:
+    ``x is None`` / ``x is not None``, ``isinstance(x, ...)``,
+    ``x.shape/...``, ``len(x)`` — these resolve during tracing and
+    neither fail nor force a recompile per value."""
+    parent = getattr(name_node, "_h2o3_parent", None)
+    if isinstance(parent, ast.Attribute) and parent.attr in (
+            "shape", "ndim", "dtype", "size", "sharding", "weak_type"):
+        return True
+    if isinstance(parent, ast.Call):
+        head = dotted_name(parent.func)
+        if head in ("isinstance", "len", "callable", "type"):
+            return True
+    if isinstance(parent, ast.Compare):
+        ops = parent.ops
+        comps = [parent.left] + list(parent.comparators)
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in ops) and any(
+                isinstance(c, ast.Constant) and c.value is None
+                for c in comps):
+            return True
+    return False
+
+
+class RecompileHazardRule(Rule):
+    """``@jax.jit``-reachable code that hides a recompile hazard or a
+    trace-time failure (the zero-recompile contract from PRs 2/3/7).
+
+    Sub-checks:
+
+    - **param-branch**: ``if``/``while``/ternary tests referencing a
+      non-static parameter of a jitted function. On a traced value this
+      raises at trace time; on a Python scalar it silently specializes
+      the executable per VALUE — the exact warm-retrain recompile class
+      PR 2's traced-rates work eliminated. Tests on ``x is None``,
+      ``isinstance``, ``len(x)`` and ``.shape/.ndim/.dtype`` are exempt
+      (static under tracing).
+    - **loop-var-closure**: a jitted function DEFINED inside a loop that
+      closes over the loop variable — a fresh closure constant (and a
+      fresh compile) every iteration.
+    - **np-on-param**: ``np.*`` called on a non-static parameter inside
+      a jitted function — a host op on a tracer fails at trace time (or
+      constant-folds the argument, hiding a per-call recompile).
+
+    Tightening decisions: bucketed static specialization (the
+    chunk-length-bucket pattern) passes params via static_argnums/names,
+    which this rule honors; branches on them are exempt.
+    """
+
+    name = "recompile-hazard"
+    severity = SEV_WARNING
+
+    def check_module(self, mod: ModuleInfo) -> List[Finding]:
+        attach_parents(mod.tree)
+        out: List[Finding] = []
+        # fn name -> static names, for `f = jax.jit(f, static_...)` rebinds
+        rebound: Dict[str, Set[str]] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                head = dotted_name(node.func)
+                if head in ("jax.jit", "jit") and node.args and \
+                        isinstance(node.args[0], ast.Name):
+                    static: Set[str] = set()
+                    for kw in node.keywords:
+                        if kw.arg == "static_argnames":
+                            for n in ast.walk(kw.value):
+                                if isinstance(n, ast.Constant) and \
+                                        isinstance(n.value, str):
+                                    static.add(n.value)
+                    rebound[node.args[0].id] = static
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            static: Optional[Set[str]] = None
+            for deco in node.decorator_list:
+                s = _jit_static_names(deco, node.args)
+                if s is not None:
+                    static = s
+                    break
+            if static is None and node.name in rebound:
+                static = rebound[node.name]
+            if static is None:
+                continue
+            out.extend(self._check_jitted(mod, node, static))
+        return out
+
+    def _check_jitted(self, mod: ModuleInfo, fn: ast.FunctionDef,
+                      static: Set[str]) -> Iterable[Finding]:
+        params = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                  + fn.args.kwonlyargs)} - static - {"self"}
+        out: List[Finding] = []
+        flagged_tests: Set[int] = set()
+        for node in ast.walk(fn):
+            tests: List[ast.AST] = []
+            if isinstance(node, (ast.If, ast.While)):
+                tests = [node.test]
+            elif isinstance(node, ast.IfExp):
+                tests = [node.test]
+            for test in tests:
+                if id(test) in flagged_tests:
+                    continue
+                for ref in ast.walk(test):
+                    if isinstance(ref, ast.Name) and ref.id in params \
+                            and not _is_static_test_ref(ref):
+                        out.append(self.finding(
+                            mod, node,
+                            f"branch on non-static parameter '{ref.id}' "
+                            f"inside jitted '{fn.name}' — a tracer here "
+                            f"fails at trace time, a Python scalar "
+                            f"recompiles per value; use jnp.where/"
+                            f"lax.cond or declare it static"))
+                        flagged_tests.add(id(test))
+                        break
+            if isinstance(node, ast.Call):
+                head = dotted_name(node.func) or ""
+                if head.startswith("np.") or head.startswith("numpy."):
+                    for arg in node.args:
+                        if isinstance(arg, ast.Name) and arg.id in params:
+                            out.append(self.finding(
+                                mod, node,
+                                f"{head} on parameter '{arg.id}' inside "
+                                f"jitted '{fn.name}' — host op on a "
+                                f"tracer (use jnp, or hoist to the "
+                                f"caller)"))
+                            break
+        # loop-var closure: this fn nested under a For whose target it reads
+        loop_vars: Set[str] = set()
+        for anc in ancestors(fn):
+            if isinstance(anc, ast.For) and isinstance(anc.target, ast.Name):
+                loop_vars.add(anc.target.id)
+        if loop_vars:
+            bound = params | static | {"self"}
+            defaults = {a.arg for a in fn.args.args}  # params already in
+            for ref in ast.walk(fn):
+                if isinstance(ref, ast.Name) and isinstance(
+                        ref.ctx, ast.Load) and ref.id in loop_vars \
+                        and ref.id not in bound and ref.id not in defaults:
+                    out.append(self.finding(
+                        mod, fn,
+                        f"jitted '{fn.name}' closes over loop variable "
+                        f"'{ref.id}' — a fresh compile every iteration; "
+                        f"pass it as a (traced or static) argument"))
+                    break
+        return out
+
+
+# ======================================================================
+# host-sync-hot-loop
+# ======================================================================
+
+# (module-relpath suffix) -> function names whose LOOP BODIES must not
+# host-sync. These are the three hot loops the bench trajectory rests
+# on: the GBM/DRF tree loop, the serve batcher's encode/dispatch stage
+# (the COLLECTOR thread is the designated sync point and is not listed),
+# and the streamed-chunk pipelines (their double-buffer bounds carry
+# inline allows).
+DEFAULT_HOT_ZONES: Dict[str, Tuple[str, ...]] = {
+    "h2o3_tpu/models/gbm.py": ("_train_dense", "_train_streaming"),
+    "h2o3_tpu/models/drf.py": ("_train_impl",),
+    "h2o3_tpu/models/streaming.py": ("level_pass", "begin_tree"),
+    "h2o3_tpu/serve/batcher.py": ("_batch_loop", "_take_batch", "submit"),
+    "h2o3_tpu/ingest/stream.py": ("add",),
+}
+
+
+class HostSyncHotLoopRule(Rule):
+    """Host synchronization inside a hot loop: ``.item()``, any
+    ``device_get`` spelling (the counted seam is still a sync) and
+    ``block_until_ready`` inside ``for``/``while`` bodies of the
+    designated hot functions (tree loop, serve batcher dispatch stage,
+    streamed-chunk pipeline).
+
+    One sync per iteration serializes the pipelined dispatch the PR-2/3
+    speculative-chunk work bought. Deliberate per-iteration barriers
+    (the double-buffer depth bound in ingest/stream.add) carry inline
+    allows naming the reason.
+
+    Tightening decisions: ``float(x)``/``int(x)`` on arbitrary locals
+    are NOT flagged (too many trace-time Python scalars). Bare
+    ``np.asarray``/``np.array`` are NOT flagged either — the canonical
+    FP was ingest/stream.add converting freshly TOKENIZED host columns
+    (``np.asarray(c.data)``), which never touches the device; an
+    np.asarray that wraps a device value always wraps a flagged
+    ``device_get`` (or is itself the sync, which block_until_ready/
+    device_get spellings catch at the call that produced the value).
+    The serve collector thread is the designated sync point, so
+    ``_collect_loop`` is not a hot zone.
+    """
+
+    name = "host-sync-hot-loop"
+    severity = SEV_ERROR
+
+    def __init__(self, zones: Optional[Dict[str, Tuple[str, ...]]] = None):
+        self.zones = DEFAULT_HOT_ZONES if zones is None else zones
+
+    _SYNC_DOTTED = {"jax.device_get", "telemetry.device_get"}
+
+    def _zone_functions(self, mod: ModuleInfo) -> Tuple[str, ...]:
+        for suffix, fns in self.zones.items():
+            if mod.relpath.endswith(suffix):
+                return fns
+        return ()
+
+    def check_module(self, mod: ModuleInfo) -> List[Finding]:
+        fns = self._zone_functions(mod)
+        if not fns:
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.FunctionDef) and (
+                    node.name in fns or "*" in fns):
+                for loop in ast.walk(node):
+                    if isinstance(loop, (ast.For, ast.While)):
+                        out.extend(self._check_loop_body(mod, node, loop))
+        # dedupe (nested loops walk the same calls twice)
+        seen: Set[Tuple[int, int, str]] = set()
+        uniq = []
+        for f in out:
+            k = (f.line, f.col, f.message)
+            if k not in seen:
+                seen.add(k)
+                uniq.append(f)
+        return uniq
+
+    def _check_loop_body(self, mod: ModuleInfo, fn: ast.FunctionDef,
+                         loop: ast.AST) -> Iterable[Finding]:
+        body = getattr(loop, "body", []) + getattr(loop, "orelse", [])
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func) or ""
+                attr = node.func.attr if isinstance(
+                    node.func, ast.Attribute) else ""
+                if name in self._SYNC_DOTTED or attr == "device_get":
+                    yield self.finding(
+                        mod, node,
+                        f"host sync '{name or attr}' inside the "
+                        f"'{fn.name}' hot loop — one D2H per iteration "
+                        f"serializes the pipelined dispatch; batch the "
+                        f"fetch outside the loop or pipeline it")
+                elif attr == "block_until_ready":
+                    yield self.finding(
+                        mod, node,
+                        f"block_until_ready inside the '{fn.name}' hot "
+                        f"loop — per-iteration barrier; if this is a "
+                        f"deliberate depth bound, add an inline allow")
+                elif attr == "item" and not node.args:
+                    yield self.finding(
+                        mod, node,
+                        f".item() inside the '{fn.name}' hot loop — "
+                        f"scalar D2H per iteration; keep it a device "
+                        f"scalar or fetch once after the loop")
+
+
+# ======================================================================
+# lock-discipline
+# ======================================================================
+
+_LOCK_NAME_HINTS = ("lock", "mutex")
+_LOCK_EXACT = {"_mu", "_cv", "_mutex", "_lock", "_LOCK", "_STATE_LOCK"}
+
+
+def _is_lock_expr(expr: ast.AST) -> bool:
+    """with-item expressions that acquire a lock: the terminal name
+    contains lock/mutex or is one of the repo's conventional spellings
+    (_mu, _cv). ``lock.acquire()``-style calls are not with-items."""
+    name = dotted_name(expr)
+    if name is None:
+        return False
+    terminal = name.rsplit(".", 1)[-1]
+    low = terminal.lower()
+    return terminal in _LOCK_EXACT or any(h in low for h in _LOCK_NAME_HINTS)
+
+
+# Calls that must never run while a registry/jobs/batcher lock is held:
+# device work and sleeps serialize every other thread on the lock for
+# device-latency timescales; network I/O for unbounded ones.
+_BLOCKING_UNDER_LOCK = {
+    "time.sleep": "sleeps while holding it",
+    "jax.device_put": "does device transfer while holding it",
+    "jax.device_get": "does device transfer while holding it",
+    "jax.block_until_ready": "blocks on device work while holding it",
+    "telemetry.device_get": "does device transfer while holding it",
+    "resilient_device_put": "does device transfer while holding it",
+    "resilience.resilient_device_put": "does device transfer while "
+                                       "holding it",
+    "resilient_shard_rows": "does device transfer while holding it",
+    "urllib.request.urlopen": "does network I/O while holding it",
+    "urlopen": "does network I/O while holding it",
+    "socket.create_connection": "does network I/O while holding it",
+    "subprocess.run": "spawns a process while holding it",
+    "subprocess.check_output": "spawns a process while holding it",
+}
+
+
+class LockDisciplineRule(Rule):
+    """Threading hygiene for the registry/jobs/batcher planes.
+
+    Sub-checks:
+
+    - **blocking-under-lock**: ``time.sleep``, device dispatch/transfer
+      or network I/O inside a ``with <lock>:`` block. A device fetch
+      under the jobs or batcher lock serializes every REST poller on
+      device latency — the class of bug fixed by hand in PRs 3/8.
+    - **unlocked-guarded-write**: an attribute written both under a
+      lock somewhere and with no lock elsewhere in the same module
+      (``__init__``/module scope exempt — construction happens-before
+      publication). Mixed discipline means one of the sites is wrong:
+      either the lock is unnecessary or the bare write races.
+
+    Tightening decisions: ``Condition.wait`` RELEASES the lock and is
+    not a blocking call here. ``.join``/``queue.get`` are excluded
+    (str.join/dict.get false positives). jax.jit/jnp.* CONSTRUCTION
+    under a lock is allowed — only transfers/syncs are flagged.
+    Event.set() after a bare write is a legitimate happens-before for
+    the waiter, but not for concurrent third threads — writes claimed
+    by a lock elsewhere must take it everywhere.
+    """
+
+    name = "lock-discipline"
+    severity = SEV_ERROR
+
+    def check_module(self, mod: ModuleInfo) -> List[Finding]:
+        attach_parents(mod.tree)
+        out: List[Finding] = []
+        out.extend(self._blocking_under_lock(mod))
+        out.extend(self._unlocked_guarded_writes(mod))
+        return out
+
+    # -- sub-check (a) --------------------------------------------------
+
+    def _under_lock(self, node: ast.AST) -> bool:
+        for anc in ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False      # a nested def runs later, not under it
+            if isinstance(anc, ast.With):
+                for item in anc.items:
+                    if _is_lock_expr(item.context_expr):
+                        return True
+        return False
+
+    def _blocking_under_lock(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            why = _BLOCKING_UNDER_LOCK.get(name)
+            if why is None and isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "block_until_ready":
+                why = "blocks on device work while holding it"
+            if why is None:
+                continue
+            if self._under_lock(node):
+                yield self.finding(
+                    mod, node,
+                    f"'{name or node.func.attr}' under a held lock — "
+                    f"{why}; move the call outside the critical "
+                    f"section")
+
+    # -- sub-check (b) --------------------------------------------------
+
+    def _unlocked_guarded_writes(self, mod: ModuleInfo) -> Iterable[Finding]:
+        # attr name -> [(node, under_lock, in_init)]
+        writes: Dict[str, List[Tuple[ast.AST, bool, bool]]] = {}
+        for node in ast.walk(mod.tree):
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            else:
+                continue
+            for t in targets:
+                if not isinstance(t, ast.Attribute):
+                    continue
+                in_init = False
+                in_func = False
+                for anc in ancestors(node):
+                    if isinstance(anc, ast.FunctionDef):
+                        in_func = True
+                        if anc.name == "__init__":
+                            in_init = True
+                        break
+                if not in_func:
+                    continue            # module-level constant setup
+                writes.setdefault(t.attr, []).append(
+                    (node, self._under_lock(node), in_init))
+        for attr, sites in writes.items():
+            locked = [s for s in sites if s[1]]
+            bare = [s for s in sites if not s[1] and not s[2]]
+            if not locked or not bare:
+                continue
+            for node, _, _ in bare:
+                yield self.finding(
+                    mod, node,
+                    f"attribute '{attr}' is written under a lock "
+                    f"elsewhere in this module but bare here — a "
+                    f"concurrent reader under the lock can see a torn "
+                    f"protocol; take the owning lock (or drop it "
+                    f"everywhere and document why)")
+
+
+# ======================================================================
+# fault-seam
+# ======================================================================
+
+class FaultSeamRule(Rule):
+    """Package-scope consistency of the fault-injection seams.
+
+    Sub-checks:
+
+    - **site-registry**: every literal site passed to ``faults.check``
+      must be in ``faults.KNOWN_SITES``, and every registered site must
+      be checked somewhere — a typo'd site silently never fires (chaos
+      coverage holes), an unreferenced registered site is a dead seam
+      that chaos specs target for nothing.
+    - **ungated-check**: ``faults.check(...)`` not enclosed in an
+      ``if faults.ACTIVE:`` branch — the checked-no-op contract (one
+      attribute load + branch when unset, asserted by
+      tests/test_resilience.py's ns-budget guard) only holds when call
+      sites pre-gate.
+
+    faults.py itself and test files are exempt from the gating check
+    (tests drive check() directly on purpose).
+    """
+
+    name = "fault-seam"
+    severity = SEV_ERROR
+    scope = "package"
+
+    def check_package(self, mods: Sequence[ModuleInfo]) -> List[Finding]:
+        out: List[Finding] = []
+        faults_mod = None
+        for m in mods:
+            if m.relpath.endswith("h2o3_tpu/faults.py"):
+                faults_mod = m
+                break
+        registered: Set[str] = set()
+        if faults_mod is not None:
+            for node in ast.walk(faults_mod.tree):
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == "KNOWN_SITES"
+                        for t in node.targets):
+                    for c in ast.walk(node.value):
+                        if isinstance(c, ast.Constant) and isinstance(
+                                c.value, str):
+                            registered.add(c.value)
+        used: Dict[str, List[Tuple[ModuleInfo, ast.Call]]] = {}
+        for m in mods:
+            if m is faults_mod:
+                continue
+            attach_parents(m.tree)
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func) or ""
+                if not (name == "faults.check"
+                        or name.endswith(".faults.check")):
+                    continue
+                site = None
+                if node.args and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    site = node.args[0].value
+                    used.setdefault(site, []).append((m, node))
+                if registered and site is not None \
+                        and site not in registered:
+                    out.append(self.finding(
+                        m, node,
+                        f"fault site '{site}' is not in "
+                        f"faults.KNOWN_SITES — register it (an "
+                        f"unregistered site works but is invisible to "
+                        f"the chaos tooling's coverage accounting)"))
+                if not self._gated(node):
+                    out.append(self.finding(
+                        m, node,
+                        "faults.check() without an enclosing "
+                        "'if faults.ACTIVE:' gate — breaks the "
+                        "checked-no-op contract on the unset path"))
+        if faults_mod is not None and registered:
+            for site in sorted(registered - set(used)):
+                out.append(Finding(
+                    rule=self.name, path=faults_mod.relpath, line=1,
+                    col=1, severity=self.severity,
+                    message=f"registered fault site '{site}' is never "
+                            f"checked anywhere in the package — a dead "
+                            f"seam; wire a faults.check('{site}') at "
+                            f"the matching dispatch point or drop it "
+                            f"from KNOWN_SITES",
+                    code=f"KNOWN_SITES:{site}"))
+        return out
+
+    @staticmethod
+    def _gated(node: ast.Call) -> bool:
+        for anc in ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False
+            if isinstance(anc, ast.If):
+                for ref in ast.walk(anc.test):
+                    if isinstance(ref, ast.Attribute) and \
+                            ref.attr == "ACTIVE":
+                        return True
+                    if isinstance(ref, ast.Name) and ref.id == "ACTIVE":
+                        return True
+        return False
+
+
+# ======================================================================
+# monotonic-durations
+# ======================================================================
+
+class MonotonicDurationsRule(Rule):
+    """``time.time()`` used in duration/deadline arithmetic.
+
+    Wall clock steps under NTP slew (and leaps at DST on some hosts):
+    ``max_runtime_secs`` enforcement, retry backoff and watchdog stall
+    detection built on ``time.time()`` subtraction silently mis-measure.
+    Duration math must use ``time.monotonic()`` (or ``perf_counter``);
+    ``time.time()`` stays ONLY where an epoch timestamp is reported
+    (span wall anchors, manifest times, cross-process gossip ages —
+    those carry inline allows naming why wall time is required).
+
+    Detection: any ``+``/``-`` expression with a ``time.time()`` call
+    (or a local/module name assigned directly from one) in either
+    operand. Multiplication (``time.time() * 1000`` epoch-ms
+    reporting) is exempt by construction.
+    """
+
+    name = "monotonic-durations"
+    severity = SEV_WARNING
+
+    @staticmethod
+    def _is_walltime_call(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and dotted_name(node.func) == "time.time")
+
+    def check_module(self, mod: ModuleInfo) -> List[Finding]:
+        wall_names: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and self._is_walltime_call(
+                    node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        wall_names.add(t.id)
+
+        def _has_wall(expr: ast.AST) -> bool:
+            for n in ast.walk(expr):
+                if self._is_walltime_call(n):
+                    return True
+                if isinstance(n, ast.Name) and n.id in wall_names and \
+                        isinstance(n.ctx, ast.Load):
+                    return True
+            return False
+
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.BinOp) and isinstance(
+                    node.op, (ast.Add, ast.Sub)):
+                if _has_wall(node.left) or _has_wall(node.right):
+                    out.append(self.finding(
+                        mod, node,
+                        "duration/deadline arithmetic on time.time() — "
+                        "wall clock steps under NTP slew; use "
+                        "time.monotonic() for intervals (keep "
+                        "time.time() only for reported epoch "
+                        "timestamps, with an inline allow saying why)"))
+        return out
+
+
+# ======================================================================
+# registry
+# ======================================================================
+
+def all_rules(hot_zones: Optional[Dict[str, Tuple[str, ...]]] = None
+              ) -> List[Rule]:
+    return [
+        TransferSeamRule(),
+        RecompileHazardRule(),
+        HostSyncHotLoopRule(zones=hot_zones),
+        LockDisciplineRule(),
+        FaultSeamRule(),
+        MonotonicDurationsRule(),
+    ]
+
+
+def rule_names() -> List[str]:
+    return [r.name for r in all_rules()]

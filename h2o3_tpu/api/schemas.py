@@ -14,7 +14,13 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-_START_MS = int(time.time() * 1000)
+_START_MONO = time.monotonic()    # uptime is a duration, not an epoch
+
+
+def uptime_ms() -> int:
+    """Process uptime for /3/Cloud, /3/Ping and /3/SteamMetrics — one
+    anchor, so the three endpoints can never report diverging values."""
+    return int((time.monotonic() - _START_MONO) * 1000)
 
 
 def keyref(name: Optional[str], ktype: str = "Key<Keyed>") -> Optional[Dict]:
@@ -48,7 +54,7 @@ def cloud_v3() -> Dict:
         "node_idx": 0,
         "cloud_name": "h2o3-tpu",
         "cloud_size": 1,
-        "cloud_uptime_millis": int(time.time() * 1000) - _START_MS,
+        "cloud_uptime_millis": uptime_ms(),
         "cloud_internal_timezone": "UTC",
         "cloud_healthy": True,
         "bad_nodes": 0,
@@ -76,7 +82,7 @@ def job_v3(job, dest_key: Optional[str] = None, dest_type: str = "Key<Model>") -
                   jobs_mod.RECOVERING: "RECOVERING",
                   jobs_mod.DONE: "DONE",
                   jobs_mod.FAILED: "FAILED", jobs_mod.CANCELLED: "CANCELLED"}
-    msec = int(((job.end_time or time.time()) - job.start_time) * 1000)
+    msec = job.duration_ms()
     return {
         "__meta": {"schema_version": 3, "schema_name": "JobV3",
                    "schema_type": "Job"},

@@ -32,7 +32,6 @@ from h2o3_tpu.api import schemas
 from h2o3_tpu.jobs import Job, get_job
 
 _ROUTES: List[Tuple[str, re.Pattern, Callable]] = []
-_START_TS = time.time()
 
 
 def route(method: str, pattern: str):
@@ -1973,8 +1972,7 @@ def _ping(params, body):
     import psutil
     vm = psutil.virtual_memory()
     return {"__meta": {"schema_version": 3, "schema_name": "PingV3"},
-            "cloud_uptime_millis": int(
-                (time.time() - _START_TS) * 1000),
+            "cloud_uptime_millis": schemas.uptime_ms(),
             "cloud_healthy": True,
             "nodes": [{"mem": int(vm.available),
                        "num_cpus": os.cpu_count() or 1}]}
@@ -2864,7 +2862,7 @@ def _steam_metrics(params, body):
     metrics — no Steam in this deployment, report idle truthfully."""
     return {"__meta": {"schema_version": 3,
                        "schema_name": "SteamMetricsV3"},
-            "idle_millis": int((time.time() - _START_TS) * 1000)}
+            "idle_millis": schemas.uptime_ms()}
 
 
 @route("GET", "/3/Metadata/schemaclasses/{classname}")

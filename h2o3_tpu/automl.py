@@ -306,9 +306,12 @@ class H2OAutoML:
         return True
 
     def _budget_left(self, t0: float) -> bool:
+        # t0 is a time.monotonic() anchor: max_runtime_secs is a
+        # duration budget and must not move with NTP slew
         if self.max_models and len(self.models) >= self.max_models:
             return False
-        if self.max_runtime_secs and time.time() - t0 > self.max_runtime_secs:
+        if self.max_runtime_secs and \
+                time.monotonic() - t0 > self.max_runtime_secs:
             return False
         return True
 
@@ -375,7 +378,7 @@ class H2OAutoML:
         builders = self._builders()
         rvec = training_frame.vec(y)
         nclasses = rvec.cardinality if rvec.type == "enum" else 1
-        t0 = time.time()
+        t0 = time.monotonic()
         self._leaderboard_frame = leaderboard_frame
         self._log("init", f"AutoML build started: y={y}, "
                           f"nfolds={self.nfolds}")
@@ -401,7 +404,7 @@ class H2OAutoML:
             if not self._budget_left(t0):
                 self._log("budget", "model/time budget exhausted")
                 break
-            if explore_deadline and time.time() > explore_deadline:
+            if explore_deadline and time.monotonic() > explore_deadline:
                 self._log("budget", "exploration budget exhausted "
                                     "(exploitation reserve)")
                 break
@@ -428,7 +431,7 @@ class H2OAutoML:
                                            if self.max_models else 0),
                             "max_runtime_secs": (
                                 self.max_runtime_secs
-                                - (time.time() - t0)
+                                - (time.monotonic() - t0)
                                 if self.max_runtime_secs else 0),
                             "seed": self.seed})
                     grid.train(x=x, y=y, training_frame=training_frame,
@@ -596,9 +599,9 @@ class H2OAutoML:
             return est.model
         est.train(x=x, y=y, training_frame=training_frame,
                   validation_frame=validation_frame, background=True)
-        t0 = time.time()
+        t0 = time.monotonic()
         while est.job.status == "RUNNING":
-            if time.time() - t0 > cap:
+            if time.monotonic() - t0 > cap:
                 est.job.cancel()
             time.sleep(0.2)
         return est.job.join()  # raises on FAILED

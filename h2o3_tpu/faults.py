@@ -87,6 +87,25 @@ class InjectedIOError(InjectedFault, IOError):
     """Flaky-storage read failure — retried by the persist layer."""
 
 
+# ---------------- site registry ----------------------------------------
+#
+# The instrumented failure points. Sites are free-form strings at the
+# matching layer (unknown spec sites simply never fire), but every
+# site CHECKED in code must be registered here and every registered
+# site must be checked somewhere — enforced by h2o3-lint's fault-seam
+# rule, so a typo'd site can't silently punch a hole in chaos coverage
+# and a dead registry entry can't make a chaos spec target nothing.
+KNOWN_SITES = frozenset({
+    "h2d",          # host→device transfers (resilience.resilient_*)
+    "d2h",          # device→host fetches (telemetry.device_get)
+    "compile",      # XLA executable build (train chunk dispatch)
+    "execute",      # device execution (train chunk + serve batch)
+    "collective",   # ICI histogram psum (multi-shard train dispatch)
+    "persist",      # storage reads (persist.load_model, URI cache)
+    "boot",         # restart-recovery resume (recovery.recover_at_boot)
+})
+
+
 _EXC_BY_NAME = {
     "unavailable": (Unavailable, "UNAVAILABLE: injected fault"),
     "internal": (Internal, "INTERNAL: injected fault"),

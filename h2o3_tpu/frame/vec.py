@@ -90,8 +90,12 @@ class Vec:
             return
         arr = np.asarray(jax.device_get(self._dev))
         record_d2h(arr.nbytes, fallback="frame")
-        self._spilled = (arr, getattr(self._dev, "sharding", None))
-        self._dev = None
+        # _spill runs as the memman spill callback, i.e. UNDER
+        # memman._LOCK (manager().request holds it while evicting) —
+        # the writes are lock-protected interprocedurally, which the
+        # per-module lock-discipline analysis cannot see
+        self._spilled = (arr, getattr(self._dev, "sharding", None))  # h2o3-lint: allow[lock-discipline] runs under memman._LOCK via the spill callback
+        self._dev = None  # h2o3-lint: allow[lock-discipline] runs under memman._LOCK via the spill callback
         self._memblock = None
 
     @property
@@ -109,7 +113,10 @@ class Vec:
                     arr, sh = self._spilled
                     memman.manager().request(arr.nbytes)
                     try:
-                        dev = (jax.device_put(arr, sh) if sh is not None
+                        # the unspill upload deliberately happens under
+                        # the memman lock: a concurrent request() must
+                        # not evict the block being restored mid-flight
+                        dev = (jax.device_put(arr, sh) if sh is not None  # h2o3-lint: allow[lock-discipline] unspill must serialize vs concurrent eviction
                                else jnp.asarray(arr))
                     except Exception:   # mesh changed: replicate
                         dev = jnp.asarray(arr)
@@ -124,8 +131,11 @@ class Vec:
 
     @data.setter
     def data(self, v):
-        self._dev = v
-        self._spilled = None
+        # setter races are the CALLER's contract (a Vec is published to
+        # other threads only after construction/mutation completes —
+        # frame ops build new Vecs, they do not mutate shared ones)
+        self._dev = v  # h2o3-lint: allow[lock-discipline] single-owner mutation before publication
+        self._spilled = None  # h2o3-lint: allow[lock-discipline] single-owner mutation before publication
         self._memblock = None
         if v is not None:
             self._register_mem()

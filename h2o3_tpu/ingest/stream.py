@@ -167,7 +167,8 @@ class ChunkDeviceStreamer:
         while len(self._inflight) > _INFLIGHT_DEPTH:
             # double-buffer bound: block on the OLDEST transfer so at
             # most _INFLIGHT_DEPTH pack matrices are pinned at once
-            jax.block_until_ready(self._inflight.popleft())
+            jax.block_until_ready(  # h2o3-lint: allow[transfer-seam,host-sync-hot-loop] deliberate depth bound: blocking on the OLDEST DMA is the double-buffer backpressure
+                self._inflight.popleft())
         dt = time.perf_counter() - t0
         self.add_seconds += dt
         self._shard_hidden_s[home] += dt
@@ -247,20 +248,20 @@ class ChunkDeviceStreamer:
                     # transfer counters, hiding a chunk-home mismap
                     self._moved_rows += e - s
                     telemetry.record_d2d(piece.nbytes, pipeline="ingest")
-                    piece = jax.device_put(piece, dev_d)
+                    piece = jax.device_put(piece, dev_d)  # h2o3-lint: allow[transfer-seam] D2D boundary-fragment move, counted via record_d2d above
                 parts.append(piece)
             if hi > nrow:          # pad tail rows of the last shard(s)
                 pad = np.full((hi - max(lo, nrow), C), np.nan, np.float32)
                 telemetry.record_h2d(pad.nbytes, pipeline="ingest")
-                parts.append(jax.device_put(pad, dev_d))
+                parts.append(jax.device_put(pad, dev_d))  # h2o3-lint: allow[transfer-seam] pad-tail upload, counted via record_h2d above
             shard = (parts[0] if len(parts) == 1
                      else jnp.concatenate(parts, axis=0))
-            shard = jax.device_put(shard, dev_d)   # commit
+            shard = jax.device_put(shard, dev_d)  # h2o3-lint: allow[transfer-seam] blessed commit site: on-device concat pinned to the shard's home device (D2D, no host bytes)
             for dev in self.part.shard_devices(d):  # model-axis replicas
                 if dev != dev_d:
                     telemetry.record_d2d(shard.nbytes, pipeline="ingest")
                 by_dev[dev] = (shard if dev == dev_d
-                               else jax.device_put(shard, dev))
+                               else jax.device_put(shard, dev))  # h2o3-lint: allow[transfer-seam] model-axis replica copy (D2D), counted via record_d2d above
             self._shard_assemble_s[d] += time.perf_counter() - td0
         self._devs.clear()
         sharding = NamedSharding(self.mesh, P(DATA_AXIS))
@@ -320,8 +321,8 @@ class ChunkDeviceStreamer:
                 full = jnp.concatenate(
                     [full, jnp.full((plen - nrow, C), jnp.nan, jnp.float32)],
                     axis=0)
-            full = jax.device_put(full,
-                                  partitioner(self.mesh).data_sharding)
+            full = jax.device_put(  # h2o3-lint: allow[transfer-seam] blessed commit site: reshard of already-device-resident data (D2D, no host bytes)
+                full, partitioner(self.mesh).data_sharding)
         out: Dict[int, Vec] = {}
         for j, i in enumerate(self.col_ids):
             if i in self._exact:
@@ -335,7 +336,7 @@ class ChunkDeviceStreamer:
             else:
                 out[i] = Vec(col, nrow, vt, host_data=self._host_shadow(i))
         self._f64.clear()
-        jax.block_until_ready(full)
+        jax.block_until_ready(full)  # h2o3-lint: allow[transfer-seam] assemble() contract: callers receive finished Vecs, this is the one visible barrier the overlap metric measures
         self.assemble_seconds = time.perf_counter() - t0
         return out
 

@@ -115,28 +115,40 @@ def _watch_loop() -> None:
         help="jobs cancelled for exceeding max_runtime_secs")
     while True:
         time.sleep(_watch_tick())
-        now = time.time()
+        # monotonic supervision clocks: max_runtime/stall are DURATIONS —
+        # an NTP step on the wall clock must not cancel a healthy train
+        # or mark every job stalled (h2o3-lint: monotonic-durations)
+        now = time.monotonic()
         n_stalled = 0
         for j in list_jobs():
             if j.status not in _ACTIVE:
                 continue
             if (j.max_runtime_secs and not j.cancel_requested
-                    and now - j.start_time > j.max_runtime_secs):
+                    and now - j.start_mono > j.max_runtime_secs):
                 warn("job %s exceeded max_runtime_secs=%.1f — cancelling",
                      j.key, j.max_runtime_secs)
                 timeout_ctr.inc()
                 j.cancel(reason=f"max_runtime_secs="
                                 f"{j.max_runtime_secs:g} exceeded")
             stall = j.stall_timeout_secs
-            if stall and now - j.last_progress_time > stall:
-                if not j.stalled:
-                    j.stalled = True
-                    warn("job %s stalled: no progress for %.1fs "
-                         "(threshold %.1fs)", j.key,
-                         now - j.last_progress_time, stall)
-                n_stalled += 1
+            if stall and now - j.last_progress_mono > stall:
+                # the stall flag is part of the _mutex-guarded progress
+                # protocol (update()/set_progress() clear it under the
+                # lock) — writing it bare here raced a concurrent
+                # heartbeat and could leave a progressing job marked
+                # stalled (caught by h2o3-lint's lock-discipline rule)
+                with j._mutex:
+                    fresh = now - j.last_progress_mono <= stall
+                    if not fresh and not j.stalled:
+                        j.stalled = True
+                        warn("job %s stalled: no progress for %.1fs "
+                             "(threshold %.1fs)", j.key,
+                             now - j.last_progress_mono, stall)
+                if not fresh:
+                    n_stalled += 1
             elif j.stalled:
-                j.stalled = False      # heartbeat resumed
+                with j._mutex:
+                    j.stalled = False      # heartbeat resumed
         stalled_gauge.set(n_stalled)
 
 
@@ -150,8 +162,10 @@ class Job:
         self.status = RUNNING
         self._work = float(work)
         self._worked = 0.0
-        self.start_time = time.time()
+        self.start_time = time.time()          # reported epoch (/3/Jobs)
+        self.start_mono = time.monotonic()     # duration/deadline math
         self.end_time: Optional[float] = None
+        self._end_mono: Optional[float] = None
         self.exception: Optional[str] = None
         # structured failure info (/3/Jobs): class + message + pipeline
         # stage, so clients don't have to parse the traceback string
@@ -174,7 +188,7 @@ class Job:
         self.stall_timeout_secs = (_stall_default()
                                    if stall_timeout_secs is None
                                    else float(stall_timeout_secs))
-        self.last_progress_time = self.start_time
+        self.last_progress_mono = self.start_mono
         self.stalled = False
         # per-job mutex: _worked is read by REST pollers and bumped by
         # the worker thread (often from several CV/fold threads at
@@ -198,13 +212,13 @@ class Job:
     def update(self, worked: float):
         with self._mutex:
             self._worked += worked
-            self.last_progress_time = time.time()
+            self.last_progress_mono = time.monotonic()
             self.stalled = False       # any progress IS the heartbeat
 
     def set_progress(self, frac: float):
         with self._mutex:
             self._worked = frac * self._work
-            self.last_progress_time = time.time()
+            self.last_progress_mono = time.monotonic()
             self.stalled = False
 
     # -- lifecycle ------------------------------------------------------
@@ -242,6 +256,7 @@ class Job:
                 self._record_failure(e)
             finally:
                 self.end_time = time.time()
+                self._end_mono = time.monotonic()
         if background:
             self._thread = threading.Thread(target=body, daemon=True)
             self._thread.start()
@@ -264,6 +279,14 @@ class Job:
     @property
     def cancel_requested(self) -> bool:
         return self._cancel_requested
+
+    def duration_ms(self) -> int:
+        """Elapsed run time in ms from the monotonic clock — the
+        /3/Jobs ``msec`` field used to subtract wall-clock epochs and
+        mis-reported across NTP slew."""
+        end = self._end_mono if self._end_mono is not None \
+            else time.monotonic()
+        return int((end - self.start_mono) * 1000)
 
 
 def get_job(key: str) -> Optional[Job]:

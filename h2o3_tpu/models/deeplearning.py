@@ -550,7 +550,7 @@ class H2ODeepLearningEstimator(ModelBuilder):
         # annealing/momentum ramp continue from the prior sample count
         samples = jnp.float32(prior.output.get("training_samples", 0.0)
                               if prior is not None else 0.0)
-        t0 = time.time()
+        t0 = time.monotonic()
         history = []
         # cancel/max_runtime polling (the last ROADMAP-listed algo
         # without it — GLM/KMeans landed in PR 7): run_epoch dispatches
@@ -569,7 +569,7 @@ class H2ODeepLearningEstimator(ModelBuilder):
                 break
             key, ekey = jax.random.split(key)
             if prev_loss is not None:
-                jax.block_until_ready(prev_loss)
+                jax.block_until_ready(prev_loss)  # h2o3-lint: allow[transfer-seam] deliberate depth bound: at most 2 epochs in flight (cancel-polling contract)
             net, opt0, samples, mloss = run_epoch(
                 net, opt0, samples, ekey, Xs, y, w,
                 jnp.int32((e * batch) % max(padded, 1)))
@@ -585,8 +585,8 @@ class H2ODeepLearningEstimator(ModelBuilder):
                     break
             if job.cancel_requested:
                 break
-        jax.block_until_ready(net[0]["W"])
-        t_loop = time.time() - t0
+        jax.block_until_ready(net[0]["W"])  # h2o3-lint: allow[transfer-seam] epoch-loop timing fence: the loop clock must cover device completion
+        t_loop = time.monotonic() - t0
 
         model = DeepLearningModel(
             f"dl_{id(self) & 0xffffff:x}", self.params, spec, net, exp_names,

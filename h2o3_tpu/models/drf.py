@@ -418,7 +418,7 @@ class H2ORandomForestEstimator(ModelBuilder):
         partn = partitioner(mesh)
         shard_obs = []
         pending_obs = None            # (prev chunk_trees, t_disp)
-        t0 = time.time()
+        t0 = time.monotonic()
         while built < ntrees_new:
             # bucket-rounded chunk lengths (models/gbm.py): ntrees
             # variants landing in one bucket reuse the executable
@@ -476,8 +476,8 @@ class H2ORandomForestEstimator(ModelBuilder):
             # this is the block_until_ready below, observed per shard
             shard_obs.append(partn.observe_step(
                 pending_obs[0], pending_obs[1], algo=self.algo))
-        jax.block_until_ready(oob_cnt)
-        t_loop = time.time() - t0
+        jax.block_until_ready(oob_cnt)  # h2o3-lint: allow[transfer-seam] tree-loop timing fence + final-chunk shard observation point
+        t_loop = time.monotonic() - t0
 
         model = self._finalize(spec, bm, cfg, K, built, all_trees,
                                prior=prior, tree_offset=start_trees)

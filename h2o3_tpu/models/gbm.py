@@ -453,7 +453,8 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                 else "multinomial" if K > 1 else "regression")
         nbins = int(p["nbins"])
         hist_type = (p.get("histogram_type") or "uniform_adaptive").lower()
-        t_bin0 = time.time()
+        t_bin0 = time.time()           # span wall anchor
+        t_bin0_m = time.monotonic()    # duration clock (NTP-immune)
         # uniform_adaptive (reference default) runs the fused per-node
         # adaptive kernel on raw features; the global-sketch path handles
         # quantiles_global and nbins beyond the adaptive kernel's 254 cap
@@ -484,7 +485,7 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             root_lo = jnp.zeros(cfg.n_features, jnp.float32)
             root_hi = jnp.zeros(cfg.n_features, jnp.float32)
             nb_f = jnp.zeros(cfg.n_features, jnp.float32)
-        t_bin = time.time() - t_bin0
+        t_bin = time.monotonic() - t_bin0_m
         from h2o3_tpu import telemetry
         # same clocks feed train_profile AND the spans (parented under
         # the Profile's train phase span via the thread-local stack)
@@ -699,7 +700,7 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         # pipeline nothing the score fetch wasn't already paying
         shard_obs = []
         partn = partitioner(mesh)
-        jax.block_until_ready(margin)
+        jax.block_until_ready(margin)  # h2o3-lint: allow[transfer-seam] loop-entry fence: resume-margin upload must land before the tree-loop clock starts
 
         def commit_ckpt(cur_margin):
             """Write an in-training checkpoint at the COMMITTED tree
@@ -721,7 +722,8 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                 warn("%s: in-training checkpoint commit failed: %s",
                      self.algo, e)
 
-        t_loop0 = time.time()
+        t_loop0 = time.time()          # span wall anchor
+        t_loop0_m = time.monotonic()
         score_s = 0.0
         # pipelined boosting: dispatch chunk k+1 BEFORE blocking on chunk
         # k's score scalars, so the metric fetch overlaps device compute.
@@ -814,9 +816,9 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                         inflight["trees"], inflight["t_disp"],
                         algo=self.algo))
                 if score_each:
-                    t_s0 = time.time()
+                    t_s0 = time.monotonic()
                     keeper.record(self._score_entry_fetch(inflight["pend"]))
-                    score_s += time.time() - t_s0
+                    score_s += time.monotonic() - t_s0
                     if keeper.rounds > 0 and keeper.should_stop():
                         # discard the speculative dispatch: the margin/
                         # vmargin locals still hold the COMMITTED chunk's
@@ -847,21 +849,22 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                     inflight["trees"], inflight["t_disp"],
                     algo=self.algo))
             if score_each:
-                t_s0 = time.time()
+                t_s0 = time.monotonic()
                 keeper.record(self._score_entry_fetch(inflight["pend"]))
-                score_s += time.time() - t_s0
+                score_s += time.monotonic() - t_s0
             if ckpt_on and trees_since_ckpt > 0:
                 # final commit covers cancellation too: a cancelled job
                 # leaves a checkpoint at its committed tree count
                 commit_ckpt(margin)
 
-        jax.block_until_ready(margin)
-        t_loop = time.time() - t_loop0
+        jax.block_until_ready(margin)  # h2o3-lint: allow[transfer-seam] train-loop timing fence: the loop span must cover device completion, not dispatch
+        t_loop = time.monotonic() - t_loop0_m
         telemetry.record_span("train.loop", t_loop0, t_loop,
                               trees=built)
         if score_s:
             telemetry.record_span("train.score", t_loop0, score_s)
-        t_fin0 = time.time()
+        t_fin0 = time.time()           # span wall anchor
+        t_fin0_m = time.monotonic()
         model = self._finalize(spec, valid_spec, dist_name, f0, all_trees, bm,
                                cfg, K, built, margin,
                                vmargin if has_valid else None, keeper,
@@ -874,7 +877,7 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             # phantom models on GET /3/Models; disk artifacts remain
             from h2o3_tpu import dkv
             dkv.remove(f"{model.key}_ckpt")
-        t_fin = time.time() - t_fin0
+        t_fin = time.monotonic() - t_fin0_m
         telemetry.record_span("train.finalize", t_fin0, t_fin)
         model.output["training_loop_seconds"] = t_loop
         model.output["train_profile"] = {
@@ -983,7 +986,7 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                 margin0 = np.empty(rows, np.float32)
                 for s in range(0, rows, chunk_rows):
                     e = min(s + chunk_rows, rows)
-                    margin0[s:e] = np.asarray(jax.device_get(
+                    margin0[s:e] = np.asarray(jax.device_get(  # h2o3-lint: allow[transfer-seam,host-sync-hot-loop] once-per-RESUME chunked recompute on the memory-pressure path, not the tree loop
                         prior._margin_matrix(jnp.asarray(X_host[s:e]))
                         .astype(jnp.float32)))
         else:
@@ -1094,7 +1097,7 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                 _warn("%s: streamed in-training checkpoint commit "
                       "failed: %s", self.algo, ce)
 
-        t0 = time.time()
+        t0 = time.monotonic()
         for t in range(ntrees_new):
             # global tree index keys the RNG (dense start_idx contract)
             # so a resumed train draws the same samples the
@@ -1145,7 +1148,7 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             raise JobCancelled(
                 "cancelled before the first streamed tree completed")
         margin_host = chunks.gather_margin()
-        t_loop = time.time() - t0
+        t_loop = time.monotonic() - t0
         T = len(trees)
         model = build_model(trees)
         if ckpt_on:

@@ -106,7 +106,7 @@ class H2OGridSearch:
         max_models = int(self.search_criteria.get("max_models", 0) or 0)
         max_secs = float(self.search_criteria.get("max_runtime_secs", 0)
                          or 0)
-        t0 = time.time()
+        t0 = time.monotonic()   # duration budget anchor
         base_params = dict(self.model_template.params)
         cls = type(self.model_template)
         # auto-recovery (hex/faulttolerance/Recovery.java + the
@@ -195,7 +195,7 @@ class H2OGridSearch:
                         if ((max_models and built_count[0]
                              + len(pending) >= max_models)
                                 or (max_secs
-                                    and time.time() - t0 > max_secs)):
+                                    and time.monotonic() - t0 > max_secs)):
                             ci = len(combos)
                             break
                         i, combo = combos[ci]
@@ -217,7 +217,7 @@ class H2OGridSearch:
             for i, combo in combos:
                 if max_models and len(self.models) >= max_models:
                     break
-                if max_secs and time.time() - t0 > max_secs:
+                if max_secs and time.monotonic() - t0 > max_secs:
                     break
                 i2, model, failure, ckey, fresh = one_point(i, combo)
                 record(i, combo, model, failure, ckey, fresh)

@@ -879,7 +879,7 @@ class ModelBuilder:
                              + (" and y" if self.supervised else ""))
         from h2o3_tpu import telemetry
         from h2o3_tpu.log import Profile, info, timeline_record
-        t0 = time.time()
+        t0 = time.monotonic()
         # root span for the whole build; handed EXPLICITLY to the Profile
         # because the body below runs on the job thread (thread-local
         # nesting does not carry across threads)
@@ -976,7 +976,7 @@ class ModelBuilder:
                     cv_fut.cancel()
                     cv_pool.shutdown(wait=False, cancel_futures=True)
                 raise
-            model.run_time = time.time() - t0
+            model.run_time = time.monotonic() - t0
             # UDF metric (water/udf CMetricFunc analog): a callable
             # (pred, y, w) -> float evaluated on the training data
             cmf = self.params.get("custom_metric_func")

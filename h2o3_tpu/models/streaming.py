@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from h2o3_tpu import telemetry
+
 # stream-buffer depth for non-resident chunks: the upload of chunk k+1
 # rides under chunk k's level kernel (double buffer)
 _PREFETCH_DEPTH = 1
@@ -44,13 +46,7 @@ _RESIDENT_SHARE = 0.8
 
 
 def _record_h2d(nbytes: int) -> None:
-    from h2o3_tpu import telemetry
     telemetry.record_h2d(int(nbytes), pipeline="train")
-
-
-def _record_d2h(nbytes: int) -> None:
-    from h2o3_tpu import telemetry
-    telemetry.record_d2h(int(nbytes), pipeline="train")
 
 
 @jax.jit
@@ -92,8 +88,7 @@ class _ChunkHandle:
         if self.mgr.is_resident(self.k):
             self.mgr._res[self.k]["nid"] = nid2
         else:
-            host = np.asarray(jax.device_get(nid2))
-            _record_d2h(host.nbytes)
+            host = np.asarray(telemetry.device_get(nid2, pipeline="train"))
             self.mgr.nid_host[self.s:self.e] = host
 
     def apply_leaf(self, lr, value, nid) -> None:
@@ -104,8 +99,8 @@ class _ChunkHandle:
         if self.mgr.is_resident(self.k):
             self.mgr._res[self.k]["margin"] = new_margin
         else:
-            host = np.asarray(jax.device_get(new_margin))
-            _record_d2h(host.nbytes)
+            host = np.asarray(telemetry.device_get(new_margin,
+                                                   pipeline="train"))
             self.mgr.margin_host[self.s:self.e] = host
 
 
@@ -215,8 +210,7 @@ class StreamedChunks:
             u = jax.random.uniform(key, (self.rows,))
             self._wt_dev = u
             if self.R < self.C:
-                host = np.asarray(jax.device_get(u))
-                _record_d2h(host.nbytes)
+                host = np.asarray(telemetry.device_get(u, pipeline="train"))
                 self._wt_host = self.w_host * (host < sample_rate)
         self._sample_rate = float(sample_rate)
         for k in range(self.R):
@@ -292,8 +286,8 @@ class StreamedChunks:
         end of training — not per tree)."""
         for k, st in self._res.items():
             s, e = self.spans[k]
-            host = np.asarray(jax.device_get(st["margin"]))
-            _record_d2h(host.nbytes)
+            host = np.asarray(telemetry.device_get(st["margin"],
+                                                   pipeline="train"))
             self.margin_host[s:e] = host
         return self.margin_host
 

@@ -134,7 +134,6 @@ def export_mojo(model, path: str) -> str:
     """Write a model as an h2o-genmodel-readable MOJO zip. Trees carry
     the v1.40 wire format; GLM/KMeans/DeepLearning write their readers'
     kv formats (h2o3_tpu/genmodel.py)."""
-    import jax
     algo = model.algo
     if algo == "glm":
         from h2o3_tpu.genmodel import export_mojo_glm
@@ -179,11 +178,19 @@ def export_mojo(model, path: str) -> str:
         raise ValueError(f"MOJO export supports gbm/drf/glm/kmeans/"
                          f"deeplearning/coxph/word2vec/glrm/isofor/gam/"
                          f"stackedensemble (got '{algo}')")
-    feat = np.asarray(jax.device_get(model._feat))
-    thr = np.asarray(jax.device_get(model._thr))
-    nal = np.asarray(jax.device_get(model._na_left))
-    spl = np.asarray(jax.device_get(model._is_split))
-    val = np.array(jax.device_get(model._value))
+    # ONE counted pytree fetch (telemetry.device_get) instead of five
+    # raw jax.device_get calls: the bytes show up in the d2h counters
+    # (they were invisible to the transfer budgets before) and the five
+    # per-array syncs collapse into a single transfer
+    from h2o3_tpu import telemetry
+    feat, thr, nal, spl, val = telemetry.device_get(
+        (model._feat, model._thr, model._na_left, model._is_split,
+         model._value))
+    feat = np.asarray(feat)
+    thr = np.asarray(thr)
+    nal = np.asarray(nal)
+    spl = np.asarray(spl)
+    val = np.array(val)
     K = model.nclasses if model.nclasses > 2 else 1
     T = model.ntrees_built
     f0 = np.asarray(model.f0, dtype=np.float64).reshape(-1) \

@@ -142,7 +142,7 @@ def _shard_ready_times(shards, t0: float):
         censored = set()
         for i, d in enumerate(datas):
             if out[i] is None:
-                jax.block_until_ready(d)
+                jax.block_until_ready(d)  # h2o3-lint: allow[transfer-seam] observation fallback when shards expose no is_ready(): the block IS the measurement
                 out[i] = time.perf_counter() - t0
     return [float(t) for t in out], censored
 

@@ -368,7 +368,7 @@ def gc_checkpoints(claimed_keys,
                 continue
             path = os.path.join(d, fn)
             try:
-                if now - os.path.getmtime(path) > age:
+                if now - os.path.getmtime(path) > age:  # h2o3-lint: allow[monotonic-durations] file mtimes are wall-clock epochs persisted across restarts — monotonic cannot age them
                     os.remove(path)
                     report["removed"].append(path)
             except OSError:
@@ -387,7 +387,7 @@ def gc_checkpoints(claimed_keys,
             report["kept_claimed"] += 1
             continue
         try:
-            if now - os.path.getmtime(path) > age:
+            if now - os.path.getmtime(path) > age:  # h2o3-lint: allow[monotonic-durations] file mtimes are wall-clock epochs persisted across restarts
                 os.remove(path)
                 report["removed"].append(path)
         except OSError:
@@ -532,7 +532,7 @@ def recover_at_boot(wait: bool = False) -> Dict[str, Any]:
     blocks until every resume finishes (tests/chaos); the k8s boot path
     resumes in the background so the REST port comes up immediately."""
     global _LAST_REPORT
-    t0 = time.time()
+    t0 = time.monotonic()
     report: Dict[str, Any] = {"enabled": enabled(), "resumed": [],
                               "failed": [], "abandoned": [],
                               "corrupt": [], "gc": None, "seconds": 0.0}
@@ -592,7 +592,7 @@ def recover_at_boot(wait: bool = False) -> Dict[str, Any]:
             telemetry.counter(
                 "h2o3_recovery_failed_total",
                 help="boot-time resume attempts that failed").inc()
-    report["seconds"] = round(time.time() - t0, 3)
+    report["seconds"] = round(time.monotonic() - t0, 3)
     _LAST_REPORT = report
     return report
 

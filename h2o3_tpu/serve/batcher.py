@@ -222,6 +222,20 @@ class MicroBatcher:
                                   model=self.stats.model, rows=req.n)
         return req.results
 
+    def _resolve_error(self, reqs: List[_Request], err: BaseException):
+        """Resolve requests with ``err`` under the queue lock. submit()'s
+        timeout path claims a request under ``_mu`` and reads
+        ``req.error`` to decide which side records the failure — a bare
+        write here races that claim and can double-count one request as
+        both timeout and error (the PR-8 review-notes race class,
+        now machine-checked by h2o3-lint's lock-discipline rule).
+        Abandoned requests are skipped: their waiter is gone."""
+        with self._mu:
+            for r in reqs:
+                if not r.abandoned:
+                    r.error = err
+                r.event.set()
+
     # -- batcher thread -------------------------------------------------
 
     def _take_batch(self) -> List[_Request]:
@@ -285,9 +299,9 @@ class MicroBatcher:
                 self._encode(r.rows, r.n)
                 good.append(r)
             except Exception as e:   # noqa: BLE001 — client's bad row
-                r.error = e if isinstance(e, ServeError) else \
-                    ServeBadRequestError(f"row encoding failed: {e}")
-                r.event.set()
+                self._resolve_error([r], e if isinstance(e, ServeError)
+                                    else ServeBadRequestError(
+                                        f"row encoding failed: {e}"))
                 self.stats.record_error()
         if not good:
             return None, [], 0
@@ -298,9 +312,7 @@ class MicroBatcher:
         try:
             return self._encode(rows, self._bucket_for(n)), good, n
         except BaseException as e:  # noqa: BLE001 — must not kill the loop
-            for r in good:
-                r.error = e
-                r.event.set()
+            self._resolve_error(good, e)
             self.stats.record_error()
             return None, [], 0
 
@@ -346,9 +358,7 @@ class MicroBatcher:
                 out = self._dispatch_resilient(X, n, batch)
                 t2 = time.perf_counter()
             except BaseException as e:  # noqa: BLE001 — resolve waiters
-                for r in batch:
-                    r.error = e
-                    r.event.set()
+                self._resolve_error(batch, e)
                 self.stats.record_error()
                 if self.breaker is not None:
                     self.breaker.record_failure()
@@ -366,7 +376,7 @@ class MicroBatcher:
                 (out, batch, n, X,
                  {"queue": queue_ms, "encode": (t1 - t0) * 1e3,
                   "dispatch": (t2 - t1) * 1e3},
-                 (sp_batch, time.time() - (t2 - t1))))
+                 (sp_batch, time.time() - (t2 - t1))))  # h2o3-lint: allow[monotonic-durations] wall START anchor reconstructed from a perf_counter duration, for span reporting
 
     def _deadline_allows_retry(self, batch: List[_Request]) -> bool:
         """A retry only makes sense if every coalesced request can
@@ -427,9 +437,7 @@ class MicroBatcher:
                     host = np.asarray(self._dispatch_resilient(
                         X, n, batch))
             except BaseException as e:  # noqa: BLE001
-                for r in batch:
-                    r.error = e
-                    r.event.set()
+                self._resolve_error(batch, e)
                 self.stats.record_error()
                 if self.breaker is not None:
                     self.breaker.record_failure()
@@ -460,9 +468,7 @@ class MicroBatcher:
                     off += r.n
                 t2 = time.perf_counter()
             except BaseException as e:  # noqa: BLE001
-                for r in batch:
-                    r.error = e
-                    r.event.set()
+                self._resolve_error(batch, e)
                 self.stats.record_error()
                 if sp_batch is not None:
                     sp_batch.attrs["error"] = True
@@ -475,8 +481,9 @@ class MicroBatcher:
             # batcher thread's root — explicit parent handoff
             telemetry.record_span("serve.device", disp_wall, device_s,
                                   parent=sp_batch)
-            telemetry.record_span("serve.decode", time.time() - (t2 - t1),
-                                  t2 - t1, parent=sp_batch)
+            telemetry.record_span(
+                "serve.decode", time.time() - (t2 - t1),  # h2o3-lint: allow[monotonic-durations] wall START anchor reconstructed from a perf_counter duration, for span reporting
+                t2 - t1, parent=sp_batch)
             if sp_batch is not None:
                 sp_batch.finish()
             self.stats.record_batch(

@@ -71,7 +71,7 @@ class CompiledScorer:
             X = np.full((b, self.n_features), np.nan, np.float32)
             t0 = time.perf_counter()
             try:
-                out = jax.block_until_ready(self._fn(X, 0))
+                out = jax.block_until_ready(self._fn(X, 0))  # h2o3-lint: allow[transfer-seam] deploy-time warmup barrier: warm_seconds must measure the full compile
             except Exception:   # noqa: BLE001 — non-traceable model
                 self.jitted = False
                 model = self.model

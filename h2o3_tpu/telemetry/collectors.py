@@ -188,10 +188,14 @@ def _tree_nbytes(host) -> int:
 
 
 def device_get(x, pipeline: Optional[str] = None):
-    """Counted ``jax.device_get``: the d2h byte counters see ad-hoc
-    fetches (analytics/rapids), not just the frame-layer choke points.
-    Returns the host pytree unchanged."""
+    """Counted ``jax.device_get`` behind the ``d2h`` fault seam: the
+    d2h byte counters see ad-hoc fetches (analytics/rapids, model
+    finalize), not just the frame-layer choke points, and chaos specs
+    can fail the fetch path. Returns the host pytree unchanged."""
     import jax
+    from h2o3_tpu import faults
+    if faults.ACTIVE:
+        faults.check("d2h", pipeline=pipeline)
     host = jax.device_get(x)
     if registry().enabled:
         record_d2h(_tree_nbytes(host), pipeline=pipeline)

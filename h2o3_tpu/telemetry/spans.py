@@ -200,7 +200,7 @@ def _record_finished(sp: Span) -> None:
     if sp.parent_id == 0:
         # root spans feed the Flow timeline ring (train_start/train_done
         # style events now cover ingest and serve too)
-        now = time.time()
+        now = time.monotonic()   # rate-limit interval, not an epoch
         if now - _TL_LAST.get(sp.name, 0.0) < _TL_MIN_INTERVAL_S:
             return
         _TL_LAST[sp.name] = now

@@ -1,0 +1,485 @@
+"""tests/test_lint.py — h2o3-lint is part of tier-1 forever.
+
+Three layers:
+
+1. **The gate**: the analyzer runs over the whole ``h2o3_tpu`` package
+   and must report zero non-baselined findings and zero stale baseline
+   entries — new code that violates a transfer/tracing/fault-seam/
+   concurrency invariant fails CI here.
+2. **Rule detection**: a seeded violation of each rule (raw device_put,
+   tracer branch, host sync in the tree loop, dispatch-under-lock,
+   unregistered fault site, wall-clock duration math) is detected.
+3. **Machinery**: inline ``allow[...]`` silences exactly one rule on
+   exactly one line, a stale baseline entry is reported (the baseline
+   shrinks monotonically), and an unknown rule name in a suppression is
+   itself an error.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from h2o3_tpu.analysis.core import (load_baseline, run_lint,
+                                    save_baseline)
+from h2o3_tpu.analysis.rules import (DEFAULT_HOT_ZONES, all_rules,
+                                     rule_names)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "h2o3_tpu")
+
+
+def _lint_source(tmp_path, relpath, source, rules=None, baseline=None):
+    """Write ``source`` at tmp_path/relpath and lint it."""
+    path = tmp_path / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    report = run_lint([str(path)], rules or all_rules(),
+                      baseline=baseline, root=str(tmp_path))
+    return report
+
+
+def _rules_of(report):
+    return sorted({f.rule for f in report.new})
+
+
+# ---------------------------------------------------------------- gate
+
+def test_package_is_lint_clean():
+    """THE tier-1 gate: zero new findings, zero stale baseline entries
+    over the whole package with >=5 rules active."""
+    report = run_lint([PKG], all_rules(), baseline=load_baseline(),
+                      root=REPO)
+    assert len(report.rules) >= 5
+    assert report.files > 50
+    msgs = "\n".join(f.render() for f in report.new[:40])
+    assert not report.new, f"new lint findings:\n{msgs}"
+    assert not report.stale, (
+        f"stale baseline entries (a finding was fixed — delete its "
+        f"entry so the baseline shrinks): {report.stale[:10]}")
+
+
+def test_baseline_entries_are_documented_transfer_seams():
+    """The checked-in baseline holds only the documented pre-existing
+    finding class (raw finalize-time device_get fetches)."""
+    baseline = load_baseline()
+    assert baseline, "baseline.json missing or empty"
+    assert {k[0] for k in baseline} == {"transfer-seam"}
+    with open(os.path.join(PKG, "analysis", "baseline.json")) as f:
+        note = json.load(f)["note"]
+    assert "shrink" in note
+
+
+# ------------------------------------------------------ rule detection
+
+def test_detects_raw_device_put(tmp_path):
+    rep = _lint_source(tmp_path, "h2o3_tpu/newmod.py", """\
+        import jax
+
+        def upload(arr):
+            return jax.device_put(arr)
+    """)
+    assert "transfer-seam" in _rules_of(rep)
+    f = [x for x in rep.new if x.rule == "transfer-seam"][0]
+    assert "resilient_device_put" in f.message
+
+
+def test_detects_raw_device_get_and_block(tmp_path):
+    rep = _lint_source(tmp_path, "h2o3_tpu/newmod.py", """\
+        import jax
+
+        def fetch(x):
+            jax.block_until_ready(x)
+            return jax.device_get(x)
+    """)
+    kinds = [f.message.split(" ")[1] for f in rep.new]
+    assert len([f for f in rep.new if f.rule == "transfer-seam"]) == 2, kinds
+
+
+def test_blessed_seam_modules_are_exempt(tmp_path):
+    rep = _lint_source(tmp_path, "h2o3_tpu/resilience.py", """\
+        import jax
+
+        def resilient_device_put(arr):
+            return jax.device_put(arr)
+    """)
+    assert "transfer-seam" not in _rules_of(rep)
+
+
+def test_detects_tracer_branch_in_jit(tmp_path):
+    rep = _lint_source(tmp_path, "h2o3_tpu/kern.py", """\
+        import jax
+
+        @jax.jit
+        def step(x, n):
+            if n > 0:
+                return x
+            return -x
+    """)
+    assert "recompile-hazard" in _rules_of(rep)
+    assert "'n'" in [f for f in rep.new
+                     if f.rule == "recompile-hazard"][0].message
+
+
+def test_static_args_and_shape_branches_are_exempt(tmp_path):
+    rep = _lint_source(tmp_path, "h2o3_tpu/kern.py", """\
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("mode",))
+        def step(x, mode, y=None):
+            if mode == 2:
+                return x
+            if x.shape[0] > 4:
+                return x * 2
+            if y is None:
+                return x
+            return -x
+    """)
+    assert "recompile-hazard" not in _rules_of(rep)
+
+
+def test_detects_jit_closure_over_loop_var(tmp_path):
+    rep = _lint_source(tmp_path, "h2o3_tpu/kern.py", """\
+        import jax
+
+        def build(xs):
+            fns = []
+            for k in range(4):
+                @jax.jit
+                def f(x):
+                    return x + k
+                fns.append(f)
+            return fns
+    """)
+    assert "recompile-hazard" in _rules_of(rep)
+    assert "loop variable" in [f for f in rep.new
+                               if f.rule == "recompile-hazard"][0].message
+
+
+def test_detects_host_sync_in_tree_loop(tmp_path):
+    # the file lands on a REAL configured hot zone (path-suffix match):
+    # the GBM tree loop
+    assert "h2o3_tpu/models/gbm.py" in DEFAULT_HOT_ZONES
+    rep = _lint_source(tmp_path, "h2o3_tpu/models/gbm.py", """\
+        import jax
+
+        class G:
+            def _train_dense(self, chunks, margin):
+                out = []
+                for c in chunks:
+                    out.append(margin.sum().item())
+                    jax.device_get(margin)
+                return out, jax.device_get(margin)
+    """)
+    hs = [f for f in rep.new if f.rule == "host-sync-hot-loop"]
+    # .item() and the in-loop device_get flagged; the post-loop fetch NOT
+    assert len(hs) == 2
+    assert {f.line for f in hs} == {7, 8}
+
+
+def test_sync_outside_hot_zone_not_flagged(tmp_path):
+    rep = _lint_source(tmp_path, "h2o3_tpu/models/gbm.py", """\
+        def _finalize(self, xs):
+            return [x.item() for x in xs]
+    """)
+    assert "host-sync-hot-loop" not in _rules_of(rep)
+
+
+def test_detects_dispatch_under_lock(tmp_path):
+    rep = _lint_source(tmp_path, "h2o3_tpu/serve/newplane.py", """\
+        import threading
+        import time
+        import jax
+
+        class Plane:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def tick(self, x):
+                with self._mu:
+                    time.sleep(0.1)
+                    return jax.device_get(x)
+    """)
+    ld = [f for f in rep.new if f.rule == "lock-discipline"]
+    assert len(ld) == 2           # sleep + device transfer under _mu
+    assert any("time.sleep" in f.message for f in ld)
+
+
+def test_detects_unlocked_guarded_write(tmp_path):
+    rep = _lint_source(tmp_path, "h2o3_tpu/newplane.py", """\
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def bump(self):
+                with self._lock:
+                    self.n += 1
+
+            def reset(self):
+                self.n = 0
+    """)
+    ld = [f for f in rep.new if f.rule == "lock-discipline"]
+    assert len(ld) == 1 and ld[0].line == 13
+
+
+def test_condition_wait_under_lock_is_fine(tmp_path):
+    rep = _lint_source(tmp_path, "h2o3_tpu/newplane.py", """\
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def take(self):
+                with self._cv:
+                    self._cv.wait(0.05)
+    """)
+    assert "lock-discipline" not in _rules_of(rep)
+
+
+def _fault_pkg(tmp_path, check_src):
+    (tmp_path / "h2o3_tpu").mkdir(parents=True, exist_ok=True)
+    (tmp_path / "h2o3_tpu" / "faults.py").write_text(textwrap.dedent("""\
+        KNOWN_SITES = frozenset({"h2d", "d2h"})
+    """))
+    (tmp_path / "h2o3_tpu" / "mod.py").write_text(textwrap.dedent(check_src))
+    return run_lint([str(tmp_path / "h2o3_tpu")], all_rules(),
+                    root=str(tmp_path))
+
+
+def test_detects_unregistered_fault_site(tmp_path):
+    rep = _fault_pkg(tmp_path, """\
+        from h2o3_tpu import faults
+
+        def go():
+            if faults.ACTIVE:
+                faults.check("h2d")
+                faults.check("typo_site")
+    """)
+    fs = [f for f in rep.new if f.rule == "fault-seam"]
+    assert any("typo_site" in f.message and "KNOWN_SITES" in f.message
+               for f in fs)
+    # registered-but-never-checked is a dead seam
+    assert any("'d2h'" in f.message and "never checked" in f.message
+               for f in fs)
+
+
+def test_detects_ungated_fault_check(tmp_path):
+    rep = _fault_pkg(tmp_path, """\
+        from h2o3_tpu import faults
+
+        def go():
+            faults.check("h2d")
+    """)
+    fs = [f for f in rep.new if f.rule == "fault-seam"]
+    assert any("ACTIVE" in f.message for f in fs)
+
+
+def test_real_fault_registry_is_consistent():
+    """Every KNOWN_SITES entry in the real faults.py is checked
+    somewhere, and every checked literal site is registered (the d2h
+    seam was the day-one dead entry — now wired into
+    telemetry.device_get)."""
+    import h2o3_tpu.faults as faults
+    assert "d2h" in faults.KNOWN_SITES
+    import inspect
+    from h2o3_tpu.telemetry import collectors
+    assert 'faults.check("d2h"' in inspect.getsource(collectors.device_get)
+
+
+def test_detects_walltime_duration_math(tmp_path):
+    rep = _lint_source(tmp_path, "h2o3_tpu/newmod.py", """\
+        import time
+
+        def run(budget):
+            t0 = time.time()
+            while time.time() - t0 < budget:
+                pass
+            deadline = time.time() + budget
+            return deadline
+    """)
+    md = [f for f in rep.new if f.rule == "monotonic-durations"]
+    assert {f.line for f in md} == {5, 7}
+
+
+def test_monotonic_and_epoch_reporting_not_flagged(tmp_path):
+    rep = _lint_source(tmp_path, "h2o3_tpu/newmod.py", """\
+        import time
+
+        def run(budget):
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < budget:
+                pass
+            return {"timestamp": int(time.time() * 1000)}
+    """)
+    assert "monotonic-durations" not in _rules_of(rep)
+
+
+# ------------------------------------------------- suppression machinery
+
+_TWO_RULE_SRC = """\
+    import jax
+
+    class G:
+        def _train_dense(self, chunks, m):
+            for c in chunks:
+                jax.device_get(m){allow}
+"""
+
+
+def test_inline_allow_silences_exactly_one_rule(tmp_path):
+    # the same line violates BOTH transfer-seam and host-sync-hot-loop
+    rep = _lint_source(tmp_path, "h2o3_tpu/models/gbm.py",
+                       _TWO_RULE_SRC.format(allow=""))
+    assert _rules_of(rep) == ["host-sync-hot-loop", "transfer-seam"]
+    rep = _lint_source(
+        tmp_path, "h2o3_tpu/models/gbm.py",
+        _TWO_RULE_SRC.format(allow="  # h2o3-lint: allow[transfer-seam]"))
+    # exactly the named rule is silenced; the other finding stays
+    assert _rules_of(rep) == ["host-sync-hot-loop"]
+    assert [f.rule for f in rep.suppressed] == ["transfer-seam"]
+
+
+def test_inline_allow_is_line_scoped(tmp_path):
+    rep = _lint_source(tmp_path, "h2o3_tpu/newmod.py", """\
+        import jax
+
+        def f(x):
+            a = jax.device_get(x)  # h2o3-lint: allow[transfer-seam] test
+            b = jax.device_get(x)
+            return a, b
+    """)
+    ts = [f for f in rep.new if f.rule == "transfer-seam"]
+    assert len(ts) == 1 and ts[0].line == 5
+
+
+def test_unknown_rule_in_suppression_is_error(tmp_path):
+    rep = _lint_source(tmp_path, "h2o3_tpu/newmod.py", """\
+        import jax
+
+        def f(x):
+            return jax.device_get(x)  # h2o3-lint: allow[transfer-seem]
+    """)
+    rules = _rules_of(rep)
+    assert "lint-suppression" in rules
+    assert "transfer-seam" in rules   # the typo'd allow suppressed nothing
+    err = [f for f in rep.new if f.rule == "lint-suppression"][0]
+    assert "transfer-seem" in err.message
+
+
+def test_docstring_mentioning_allow_is_not_a_suppression(tmp_path):
+    rep = _lint_source(tmp_path, "h2o3_tpu/newmod.py", '''\
+        import jax
+
+        def f(x):
+            """Silence with ``# h2o3-lint: allow[transfer-seam]``."""
+            return jax.device_get(x)
+    ''')
+    assert "transfer-seam" in _rules_of(rep)
+    assert "lint-suppression" not in _rules_of(rep)
+
+
+# --------------------------------------------------- baseline machinery
+
+def test_baseline_consumes_findings_multiset_style(tmp_path):
+    src = """\
+        import jax
+
+        def f(x):
+            a = jax.device_get(x)
+            b = jax.device_get(x)
+            return a, b
+    """
+    path = tmp_path / "h2o3_tpu" / "newmod.py"
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(src))
+    first = run_lint([str(path)], all_rules(), root=str(tmp_path))
+    assert len(first.new) == 2
+    bl_path = tmp_path / "baseline.json"
+    save_baseline(first.new, path=str(bl_path))
+    again = run_lint([str(path)], all_rules(),
+                     baseline=load_baseline(str(bl_path)),
+                     root=str(tmp_path))
+    assert again.ok and len(again.baselined) == 2
+
+
+def test_stale_baseline_entry_is_reported(tmp_path):
+    """Fix a finding while its baseline entry remains -> the run FAILS
+    with a stale report, so the baseline can only shrink."""
+    path = tmp_path / "h2o3_tpu" / "newmod.py"
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("import jax\n\n\ndef f(x):\n"
+                    "    return jax.device_get(x)\n")
+    first = run_lint([str(path)], all_rules(), root=str(tmp_path))
+    bl_path = tmp_path / "baseline.json"
+    save_baseline(first.new, path=str(bl_path))
+    # "fix" the finding
+    path.write_text("def f(x):\n    return x\n")
+    rep = run_lint([str(path)], all_rules(),
+                   baseline=load_baseline(str(bl_path)),
+                   root=str(tmp_path))
+    assert not rep.new
+    assert len(rep.stale) == 1 and rep.stale[0]["rule"] == "transfer-seam"
+    assert not rep.ok
+
+
+def test_baseline_identity_survives_line_moves(tmp_path):
+    """Baseline identity is (rule, path, code) — inserting unrelated
+    lines above a baselined finding must not churn it."""
+    path = tmp_path / "h2o3_tpu" / "newmod.py"
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("import jax\n\n\ndef f(x):\n"
+                    "    return jax.device_get(x)\n")
+    bl_path = tmp_path / "baseline.json"
+    save_baseline(run_lint([str(path)], all_rules(),
+                           root=str(tmp_path)).new, path=str(bl_path))
+    path.write_text("import jax\n\nPAD = 1\nPAD2 = 2\n\n\ndef f(x):\n"
+                    "    return jax.device_get(x)\n")
+    rep = run_lint([str(path)], all_rules(),
+                   baseline=load_baseline(str(bl_path)),
+                   root=str(tmp_path))
+    assert rep.ok and len(rep.baselined) == 1
+
+
+# ------------------------------------------------------------- CLI
+
+def test_cli_json_and_exit_codes(tmp_path):
+    bad = tmp_path / "h2o3_tpu" / "newmod.py"
+    bad.parent.mkdir(parents=True, exist_ok=True)
+    bad.write_text("import jax\n\n\ndef f(x):\n"
+                   "    return jax.device_get(x)\n")
+    tool = os.path.join(REPO, "tools", "h2o3_lint.py")
+    proc = subprocess.run(
+        [sys.executable, tool, str(bad), "--no-baseline", "--json"],
+        capture_output=True, text=True, cwd=str(tmp_path), timeout=120)
+    assert proc.returncode == 1, proc.stderr
+    data = json.loads(proc.stdout)
+    assert data["counts"]["new"] == 1 and data["ok"] is False
+    assert data["findings"][0]["rule"] == "transfer-seam"
+    # clean file -> exit 0
+    good = tmp_path / "clean.py"
+    good.write_text("X = 1\n")
+    proc = subprocess.run(
+        [sys.executable, tool, str(good), "--no-baseline"],
+        capture_output=True, text=True, cwd=str(tmp_path), timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_parse_error_is_a_finding_not_a_crash(tmp_path):
+    rep = _lint_source(tmp_path, "h2o3_tpu/broken.py", "def f(:\n")
+    assert [f.rule for f in rep.new] == ["parse-error"]
+
+
+def test_rule_catalog_names():
+    names = rule_names()
+    assert len(names) >= 5
+    for expected in ("transfer-seam", "recompile-hazard",
+                     "host-sync-hot-loop", "lock-discipline",
+                     "fault-seam", "monotonic-durations"):
+        assert expected in names

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""h2o3-lint CLI — the repo-native static-analysis pass.
+
+Usage:
+    python tools/h2o3_lint.py h2o3_tpu                # human output
+    python tools/h2o3_lint.py h2o3_tpu --json         # machine-readable
+    python tools/h2o3_lint.py h2o3_tpu --write-baseline
+    python tools/h2o3_lint.py --rules                 # rule catalog
+
+Exit codes: 0 = clean (no new findings, no stale baseline entries),
+1 = new findings and/or stale baseline entries, 2 = usage error.
+
+The JSON report mirrors the bench/chaos verdict convention: tooling
+asserts ``.ok`` / ``counts.new == 0`` the same way it asserts transfer
+budgets. Pure-stdlib imports only — the linter must not pay (or
+require) a JAX import to run.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import types
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# The analysis package is pure stdlib, but ``import h2o3_tpu.analysis``
+# would execute h2o3_tpu/__init__.py — which imports jax (seconds of
+# startup the linter doesn't need, and a hard dependency CI lint jobs
+# shouldn't have). Pre-register a bare package shell so the submodule
+# import resolves without running the package initializer. (Test runs
+# import the real package first, in which case this is a no-op.)
+if "h2o3_tpu" not in sys.modules:
+    _pkg = types.ModuleType("h2o3_tpu")
+    _pkg.__path__ = [os.path.join(_REPO, "h2o3_tpu")]
+    sys.modules["h2o3_tpu"] = _pkg
+
+from h2o3_tpu.analysis.core import (default_baseline_path, load_baseline,  # noqa: E402
+                                    run_lint, save_baseline)
+from h2o3_tpu.analysis.rules import all_rules  # noqa: E402
+
+
+def _print_rules() -> None:
+    for rule in all_rules():
+        doc = (rule.__doc__ or "").strip().splitlines()
+        head = doc[0] if doc else ""
+        print(f"{rule.name}  [{rule.severity}]")
+        print(f"    {head}")
+        for line in doc[1:]:
+            print(f"    {line.strip()}" if line.strip() else "")
+        print()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", help="files/dirs to lint")
+    ap.add_argument("--json", action="store_true",
+                    help="emit a machine-readable JSON report")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: "
+                         "h2o3_tpu/analysis/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current findings as the new "
+                         "baseline (after reviewing them!)")
+    ap.add_argument("--rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.rules:
+        _print_rules()
+        return 0
+    if not args.paths:
+        ap.print_usage()
+        return 2
+
+    rules = all_rules()
+    baseline_path = args.baseline or default_baseline_path()
+    baseline = {} if (args.no_baseline or args.write_baseline) \
+        else load_baseline(baseline_path)
+    report = run_lint(args.paths, rules, baseline=baseline)
+
+    if args.write_baseline:
+        path = save_baseline(report.new, path=baseline_path)
+        print(f"wrote {len(report.new)} finding(s) to {path}")
+        return 0
+
+    if args.json:
+        json.dump(report.to_dict(), sys.stdout, indent=1)
+        print()
+    else:
+        for f in report.new:
+            print(f.render())
+        for ent in report.stale:
+            print(f"{ent['path']}: [STALE baseline] {ent['rule']} x"
+                  f"{ent['count']}: {ent['code']!r} — the finding is "
+                  f"gone; remove the entry (or --write-baseline)")
+        print(f"h2o3-lint: {report.files} files, "
+              f"{len(report.rules)} rules, "
+              f"{len(report.new)} new finding(s), "
+              f"{len(report.baselined)} baselined, "
+              f"{len(report.suppressed)} suppressed, "
+              f"{len(report.stale)} stale baseline entr(ies)")
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
