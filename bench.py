@@ -234,6 +234,10 @@ def _serve_round(model, fr, F):
             "batch_occupancy": snap["mean_batch_occupancy"],
             "stage_ms": snap["stage_ms"],
             "single_row_requests": SERVE_SINGLE_ROWS,
+            # per-deployment roofline point (ISSUE 11): warm-bucket
+            # executable cost x dispatched batches over the measured
+            # device stage — serve.mfu in the headline JSON
+            "perf": dep.perf_snapshot(),
         }
     finally:
         serve.undeploy(model.key)
@@ -374,6 +378,23 @@ def main():
         "warm_train_s": round(total, 2),
         "loop_s": round(loop_s, 2),
     }
+    # honest MFU/roofline (ISSUE 11, VERDICT weak #7): computed from the
+    # chunk executables' cost_analysis x measured loop device time, not
+    # wall-clock guesses; vs_baseline stays for continuity but MFU is
+    # the number that survives hardware changes. `informational` is True
+    # off-TPU (nominal peaks) — a trend line, not a utilization claim.
+    train_perf = (gbm.model.output.get("perf") or {}).get("train") or {}
+    out["train.mfu"] = train_perf.get("mfu")
+    out["train.roofline_regime"] = train_perf.get("roofline_regime")
+    out["train.arith_intensity"] = train_perf.get("arith_intensity")
+    out["train.perf_informational"] = train_perf.get("informational")
+    if train_perf:
+        log(f"train perf: mfu={train_perf.get('mfu')} "
+            f"regime={train_perf.get('roofline_regime')} "
+            f"ai={train_perf.get('arith_intensity')} flop/B "
+            f"peak_source={train_perf.get('peak_source')}"
+            + (" (informational: non-table peaks)"
+               if train_perf.get("informational") else ""))
     # transfer-minimal pipeline metrics (ISSUE 5): the warm dense train
     # should upload ~nothing per tree (X is device-resident); the
     # streamed guard below asserts the memory-pressure path's
@@ -470,6 +491,7 @@ def main():
         # throughput for the SAME deployed model — the inference half
         # of the training numbers above
         out["serve"] = serve_out
+        out["serve.mfu"] = (serve_out.get("perf") or {}).get("mfu")
     if ingest_s is not None:
         # ingest phase reported alongside the headline (the streaming
         # chunk-local parse pipeline, ingest/parse.py): disk CSV →

@@ -692,6 +692,80 @@ class MonotonicDurationsRule(Rule):
 
 
 # ======================================================================
+# pallas-grid-spec
+# ======================================================================
+
+class PallasGridSpecRule(Rule):
+    """``pl.pallas_call`` without an explicit ``grid=`` or without
+    explicit ``in_specs=``/``out_specs=`` BlockSpecs, and a hardcoded
+    ``interpret=True`` outside tests.
+
+    Pre-landed guardrail for the compiled-TPU histogram kernel (ROADMAP
+    "raw speed" item): a pallas_call that leans on the implicit
+    whole-array default grid compiles, runs — and silently serializes
+    the kernel into one grid step with every operand in VMEM at once,
+    which is exactly the shape that falls over (or quietly crawls) the
+    first time a real block size matters. Every kernel states its grid
+    and block mapping explicitly so the tiling is a reviewed decision,
+    not a default. A ``grid_spec=`` kwarg carries both and satisfies
+    the rule; ``**kwargs`` forwarding is assumed to carry them (call
+    wrappers like ops/pallas_compat.py must not be flagged for
+    forwarding). ``interpret=True`` as a LITERAL pins the interpreter
+    into production code — the repo's convention is an ``interpret=``
+    parameter threaded from ``pallas_interpret()`` (env-gated) so TPU
+    runs compile; tests/ may pin it (CPU CI has no Mosaic).
+    """
+
+    name = "pallas-grid-spec"
+    severity = SEV_ERROR
+
+    _CALL_NAMES = ("pl.pallas_call", "pallas_call",
+                   "pallas.pallas_call",
+                   "jax.experimental.pallas.pallas_call")
+
+    def check_module(self, mod: ModuleInfo) -> List[Finding]:
+        in_tests = mod.relpath.startswith("tests/") or \
+            "/tests/" in mod.relpath
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted_name(node.func) not in self._CALL_NAMES:
+                continue
+            kwargs = {kw.arg for kw in node.keywords if kw.arg}
+            forwards = any(kw.arg is None for kw in node.keywords)
+            has_grid = "grid" in kwargs or "grid_spec" in kwargs
+            has_specs = ("grid_spec" in kwargs
+                         or ("in_specs" in kwargs
+                             and "out_specs" in kwargs))
+            if not has_grid and not forwards:
+                out.append(self.finding(
+                    mod, node,
+                    "pallas_call without an explicit grid= — the "
+                    "implicit whole-array grid serializes the kernel "
+                    "into one step with every operand in VMEM; state "
+                    "the tiling"))
+            if not has_specs and not forwards:
+                out.append(self.finding(
+                    mod, node,
+                    "pallas_call without explicit in_specs/out_specs "
+                    "BlockSpecs — block mapping must be a reviewed "
+                    "decision, not the whole-array default"))
+            if not in_tests:
+                for kw in node.keywords:
+                    if kw.arg == "interpret" and isinstance(
+                            kw.value, ast.Constant) and \
+                            kw.value.value is True:
+                        out.append(self.finding(
+                            mod, node,
+                            "interpret=True hardcoded outside tests — "
+                            "thread an interpret= parameter from "
+                            "pallas_interpret() (env-gated) so TPU "
+                            "runs compile the kernel"))
+        return out
+
+
+# ======================================================================
 # registry
 # ======================================================================
 
@@ -704,6 +778,7 @@ def all_rules(hot_zones: Optional[Dict[str, Tuple[str, ...]]] = None
         LockDisciplineRule(),
         FaultSeamRule(),
         MonotonicDurationsRule(),
+        PallasGridSpecRule(),
     ]
 
 

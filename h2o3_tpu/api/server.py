@@ -1917,6 +1917,21 @@ def _telemetry_cluster(params, body):
             **telemetry.cluster_snapshot()}
 
 
+@route("GET", "/3/Telemetry/perf")
+def _telemetry_perf(params, body):
+    """Performance accounting view (ISSUE 11): detected per-chip peaks
+    (``peak_source`` provenance, ``informational`` flag on CPU/unknown
+    hardware) plus a roofline point per phase — achieved flops/bytes
+    per second, arithmetic intensity, MFU and compute- vs memory-bound
+    regime — derived from the cumulative ``h2o3_achieved_*`` counters
+    the cost-capture seams feed."""
+    from h2o3_tpu import telemetry
+    telemetry.install()
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "TelemetryPerfV3"},
+            **telemetry.costmodel.summary()}
+
+
 @route("GET", "/3/Profiler")
 def _profiler(params, body):
     """water/api/ProfilerHandler: aggregated stack samples per node

@@ -44,8 +44,26 @@ def compute_rollups(vec) -> dict:
                 "sigma": np.nan, "min": np.nan, "max": np.nan, "nz_count": int((~isna).sum()),
                 "pinfs": 0, "ninfs": 0, "is_const": False}
     data = vec.as_float()
+    import time as _time
+    from h2o3_tpu.telemetry import costmodel
+    # performance accounting (ISSUE 11): the rollup reduction is the
+    # frame-assembly jit seam the compile counter already sees; one
+    # trace+lower per padded column shape, paired with the measured
+    # kernel-to-host wall (the np.asarray fetches below block on it).
+    # The COLD call per shape is skipped entirely: its wall is
+    # dominated by the first-call backend compile (and the capture's
+    # own trace+lower), which would poison the cumulative achieved
+    # rate this plane exists to make honest.
+    ck = ("frame.rollup", data.shape, str(data.dtype))
+    warm = costmodel.cost_cached(ck)
+    t0 = _time.perf_counter()
     cnt, s, mean, sigma, mn, mx, nz, pinf, ninf = [
         np.asarray(v) for v in _rollup_kernel(data, vec.nrow)]
+    dt = _time.perf_counter() - t0
+    cost = costmodel.executable_cost(
+        ck, lambda: _rollup_kernel.lower(data, vec.nrow))
+    if warm:
+        costmodel.record("frame.rollup", cost, seconds=dt)
     cnt = int(cnt)
     out = {
         "rows": vec.nrow,

@@ -338,6 +338,16 @@ class ChunkDeviceStreamer:
         self._f64.clear()
         jax.block_until_ready(full)  # h2o3-lint: allow[transfer-seam] assemble() contract: callers receive finished Vecs, this is the one visible barrier the overlap metric measures
         self.assemble_seconds = time.perf_counter() - t0
+        # performance accounting (ISSUE 11): the ingest assembly is
+        # bandwidth work — zero flops, the streamed columns' bytes over
+        # the observed transfer wall (per-shard hidden time + the
+        # visible assemble barrier). Memory-bound by construction; the
+        # achieved_bytes/s is the number to trend against HBM peak.
+        from h2o3_tpu.telemetry import costmodel
+        costmodel.record(
+            "ingest.assemble",
+            costmodel.Cost(0.0, float(self.h2d_bytes)),
+            seconds=sum(self._shard_hidden_s) + self.assemble_seconds)
         return out
 
     # NOTE on the overlap metric: parse.py is the single source of truth

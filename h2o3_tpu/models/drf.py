@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from h2o3_tpu import telemetry
 from h2o3_tpu.jobs import Job
 from h2o3_tpu.models.model_base import (Model, ModelBuilder, ScoreKeeper,
                                         TrainingSpec, compute_metrics)
@@ -40,7 +41,7 @@ from h2o3_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS, current_mesh,
                                     n_data_shards, n_model_shards,
                                     spmd_enabled)
 from h2o3_tpu.persist import register_model_class
-from h2o3_tpu.resilience import retry_transient
+from h2o3_tpu.resilience import resilient_device_put, retry_transient
 
 MAX_DEPTH_CAP = 16
 
@@ -119,13 +120,15 @@ class DRFModel(TreeScoringOptionsMixin, Model):
     # -- persistence ----------------------------------------------------
 
     def _save_arrays(self):
-        d = {"feat": np.asarray(jax.device_get(self._feat)),
-             "thr": np.asarray(jax.device_get(self._thr)),
-             "na_left": np.asarray(jax.device_get(self._na_left)),
-             "is_split": np.asarray(jax.device_get(self._is_split)),
-             "value": np.asarray(jax.device_get(self._value))}
+        # ONE counted pytree fetch (the raw per-array device_gets were
+        # invisible to d2h budgets — PR-11 transfer-seam burn-down)
+        host = telemetry.device_get(
+            {"feat": self._feat, "thr": self._thr,
+             "na_left": self._na_left, "is_split": self._is_split,
+             "value": self._value})
+        d = {k: np.asarray(v) for k, v in host.items()}
         if self._node_w is not None:
-            d["node_w"] = np.asarray(jax.device_get(self._node_w))
+            d["node_w"] = np.asarray(telemetry.device_get(self._node_w))
         # in-training checkpoint resume state: the OOB accumulators at
         # the committed tree count, so resumed training metrics equal
         # the uninterrupted run's
@@ -360,18 +363,21 @@ class H2ORandomForestEstimator(ModelBuilder):
         want = (padded,) if K == 1 else (padded, K)
         if rn is not None and rc is not None and sig_ok \
                 and np.asarray(rn).shape == tuple(want):
-            oob_num = jax.device_put(jnp.asarray(rn, jnp.float32), rows_sh)
-            oob_cnt = jax.device_put(jnp.asarray(rc, jnp.float32), rows_sh)
+            oob_num = resilient_device_put(jnp.asarray(rn, jnp.float32),
+                                           rows_sh, pipeline="train")
+            oob_cnt = resilient_device_put(jnp.asarray(rc, jnp.float32),
+                                           rows_sh, pipeline="train")
         else:
             if prior is not None:
                 from h2o3_tpu.log import warn
                 warn("drf checkpoint carries no OOB resume state — "
                      "training metrics will reflect only the new trees")
-            oob_num = jax.device_put(
+            oob_num = resilient_device_put(
                 jnp.zeros(padded if K == 1 else (padded, K), jnp.float32),
-                rows_sh)
-            oob_cnt = jax.device_put(jnp.zeros(padded, jnp.float32),
-                                     rows_sh)
+                rows_sh, pipeline="train")
+            oob_cnt = resilient_device_put(
+                jnp.zeros(padded, jnp.float32), rows_sh,
+                pipeline="train")
         y = spec.y
         all_trees = []          # [(device chunk trees, n_active)]
         built = 0
@@ -397,10 +403,10 @@ class H2ORandomForestEstimator(ModelBuilder):
             try:
                 m = self._finalize(spec, bm, cfg, K, built, all_trees,
                                    prior=prior, tree_offset=start_trees)
-                m._resume_oob_num = np.asarray(jax.device_get(oob_num),
-                                               np.float32)
-                m._resume_oob_cnt = np.asarray(jax.device_get(oob_cnt),
-                                               np.float32)
+                on, oc = telemetry.device_get((oob_num, oob_cnt),
+                                              pipeline="train")
+                m._resume_oob_num = np.asarray(on, np.float32)
+                m._resume_oob_cnt = np.asarray(oc, np.float32)
                 m._resume_sig = _spec_signature(spec)
                 from h2o3_tpu.models.model_base import \
                     persist_in_training_ckpt
@@ -414,23 +420,29 @@ class H2ORandomForestEstimator(ModelBuilder):
         # so the host block lands where the device is already busy
         from h2o3_tpu.parallel.mesh import partitioner
         from h2o3_tpu.parallel.shardstats import merge_observations
-        from h2o3_tpu import telemetry
         partn = partitioner(mesh)
         shard_obs = []
         pending_obs = None            # (prev chunk_trees, t_disp)
+        # performance accounting (ISSUE 11): executable cost capture at
+        # this jit seam + loop wall -> roofline point (None = no-op)
+        perf_acc = telemetry.costmodel.accumulator(
+            "train.loop", n_devices=mesh.size)
         t0 = time.monotonic()
         while built < ntrees_new:
             # bucket-rounded chunk lengths (models/gbm.py): ntrees
             # variants landing in one bucket reuse the executable
             c = min(chunk, ntrees_new - built)
+            # ONE spelling of the executable cache key, shared by the
+            # dispatch and the cost capture below (see models/gbm.py)
+            bucket = chunk_bucket(c)
+            lru_key = (mesh, cfg, K, srpc, bucket, has_t,
+                       adaptive, donate)
 
-            def _dispatch(c=c):
+            def _dispatch(lru_key=lru_key, c=c):
                 from h2o3_tpu import faults
                 if faults.ACTIVE:
                     faults.check("compile", pipeline="train")
-                step = _compiled_drf_chunk(mesh, cfg, K, srpc,
-                                           chunk_bucket(c), has_t,
-                                           adaptive, donate)
+                step = _compiled_drf_chunk(*lru_key)
                 if faults.ACTIVE:
                     faults.check("execute", pipeline="train")
                     if nd > 1:
@@ -455,6 +467,22 @@ class H2ORandomForestEstimator(ModelBuilder):
                     # prefix before the failure propagates
                     commit_ckpt()
                 raise
+            if perf_acc is not None:
+                # one trace+lower per (config, bucket); scale=bucket —
+                # the HLO analysis counts the tree-scan body once and
+                # the executable runs it `bucket` times (see gbm.py)
+                t_cap0 = time.perf_counter()
+                step = _compiled_drf_chunk(*lru_key)   # lru cache hit
+                perf_acc.add(telemetry.costmodel.executable_cost(
+                    ("drf.chunk",) + lru_key,
+                    lambda s=step, b=built, cc=c: s.lower(
+                        Xtr, codes_t_arg, y, spec.w, oob_num, oob_cnt,
+                        key, root_lo, root_hi, nb_f,
+                        jnp.int32(start_trees + b), jnp.int32(cc),
+                        rate_t, col_rate_t),
+                    scale=bucket))
+                perf_acc.note_capture_seconds(
+                    time.perf_counter() - t_cap0)
             if pending_obs is not None:
                 shard_obs.append(partn.observe_step(
                     pending_obs[0], pending_obs[1], algo=self.algo))
@@ -483,10 +511,10 @@ class H2ORandomForestEstimator(ModelBuilder):
                                prior=prior, tree_offset=start_trees)
         if ckpt_on:
             try:
-                model._resume_oob_num = np.asarray(
-                    jax.device_get(oob_num), np.float32)
-                model._resume_oob_cnt = np.asarray(
-                    jax.device_get(oob_cnt), np.float32)
+                on, oc = telemetry.device_get((oob_num, oob_cnt),
+                                              pipeline="train")
+                model._resume_oob_num = np.asarray(on, np.float32)
+                model._resume_oob_cnt = np.asarray(oc, np.float32)
                 model._resume_sig = _spec_signature(spec)
                 from h2o3_tpu.models.model_base import \
                     persist_in_training_ckpt
@@ -499,6 +527,12 @@ class H2ORandomForestEstimator(ModelBuilder):
                 from h2o3_tpu.log import warn
                 warn("drf: final in-training checkpoint failed: %s", e)
         model.output["training_loop_seconds"] = t_loop
+        if perf_acc is not None:
+            perf_acc.add_device_seconds(t_loop)
+            rp = perf_acc.finish()
+            if rp is not None:
+                model.output["perf"] = {"train": rp,
+                                        "phases": {"loop": rp}}
         model.output["spmd"] = {
             "n_data": nd, "n_model": n_model_shards(mesh),
             "model_axis_split_search": bool(
@@ -519,10 +553,11 @@ class H2ORandomForestEstimator(ModelBuilder):
         return model
 
     def _oob_metrics(self, model, spec, K, oob_num, oob_cnt):
-        cnt = np.asarray(jax.device_get(oob_cnt))
-        num = np.asarray(jax.device_get(oob_num))
-        w = np.asarray(jax.device_get(spec.w))
-        y = np.asarray(jax.device_get(spec.y))
+        # ONE counted fetch for the OOB finalize (transfer-seam
+        # burn-down: these were four raw uncounted device_gets)
+        host = telemetry.device_get((oob_cnt, oob_num, spec.w, spec.y),
+                                    pipeline="train")
+        cnt, num, w, y = (np.asarray(v) for v in host)
         live = (cnt > 0) & (w > 0)
         if not live.any():
             # no OOB rows (sample_rate == 1.0): fall back to in-bag scoring

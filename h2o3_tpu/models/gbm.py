@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from h2o3_tpu import telemetry
 from h2o3_tpu.jobs import Job
 from h2o3_tpu.models.distributions import get_distribution
 from h2o3_tpu.models.model_base import (Model, ModelBuilder, ScoreKeeper,
@@ -36,7 +37,7 @@ from h2o3_tpu.ops.binning import (CodesView, bin_matrix_device,
 from h2o3_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS, current_mesh,
                                     n_data_shards, n_model_shards,
                                     partitioner, spmd_enabled)
-from h2o3_tpu.resilience import retry_transient
+from h2o3_tpu.resilience import resilient_device_put, retry_transient
 
 GBM_DEFAULTS: Dict = dict(
     ntrees=50, max_depth=5, min_rows=10.0, learn_rate=0.1,
@@ -81,11 +82,10 @@ def _spec_signature(spec) -> np.ndarray:
     bit-equal sums, different data virtually never does. Guards
     against applying a checkpoint's saved margin/OOB state to a
     different frame that merely has the same shape."""
-    return np.array([float(spec.nrow),
-                     float(jax.device_get(
-                         spec.y.astype(jnp.float32).sum())),
-                     float(jax.device_get(
-                         spec.w.astype(jnp.float32).sum()))],
+    sy, sw = telemetry.device_get(
+        (spec.y.astype(jnp.float32).sum(),
+         spec.w.astype(jnp.float32).sum()), pipeline="train")
+    return np.array([float(spec.nrow), float(sy), float(sw)],
                     np.float64)
 
 
@@ -173,14 +173,16 @@ class GBMModel(TreeScoringOptionsMixin, Model):
     # -- persistence (persist.save_model/load_model) -------------------
 
     def _save_arrays(self):
-        d = {"feat": np.asarray(jax.device_get(self._feat)),
-             "thr": np.asarray(jax.device_get(self._thr)),
-             "na_left": np.asarray(jax.device_get(self._na_left)),
-             "is_split": np.asarray(jax.device_get(self._is_split)),
-             "value": np.asarray(jax.device_get(self._value)),
-             "f0": np.asarray(self.f0)}
+        # ONE counted pytree fetch for the stacked tree arrays (the
+        # five raw per-array device_gets were invisible to d2h budgets)
+        host = telemetry.device_get(
+            {"feat": self._feat, "thr": self._thr,
+             "na_left": self._na_left, "is_split": self._is_split,
+             "value": self._value})
+        d = {k: np.asarray(v) for k, v in host.items()}
+        d["f0"] = np.asarray(self.f0)
         if self._node_w is not None:
-            d["node_w"] = np.asarray(jax.device_get(self._node_w))
+            d["node_w"] = np.asarray(telemetry.device_get(self._node_w))
         rm = getattr(self, "_resume_margin", None)
         if rm is not None:
             # in-training checkpoint state: the exact f32 training
@@ -422,7 +424,6 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         train COMPLETES. The design matrix is pulled back to host and
         the streamed pipeline re-uploads only what its memman window
         allows resident."""
-        from h2o3_tpu import telemetry
         from h2o3_tpu.log import warn
         warn("%s: device OOM during dense training (%s: %s) — degrading "
              "to the streamed resident-window path", self.algo,
@@ -432,7 +433,9 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             help="dense→streamed graceful degradations on device OOM"
         ).inc()
         from dataclasses import replace as dc_replace
-        X_host = np.asarray(jax.device_get(spec.X), np.float32)
+        X_host = np.asarray(telemetry.device_get(spec.X,
+                                                 pipeline="train"),
+                            np.float32)
         host_spec = dc_replace(spec, X=None, X_host=X_host, stream=True)
         try:
             return self._train_streaming(host_spec, valid_spec, dist_name,
@@ -486,7 +489,6 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             root_hi = jnp.zeros(cfg.n_features, jnp.float32)
             nb_f = jnp.zeros(cfg.n_features, jnp.float32)
         t_bin = time.monotonic() - t_bin0_m
-        from h2o3_tpu import telemetry
         # same clocks feed train_profile AND the spans (parented under
         # the Profile's train phase span via the thread-local stack)
         telemetry.record_span("train.bin", t_bin0, t_bin)
@@ -676,8 +678,9 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         # executable (visible as one stray recompile per new ntrees)
         from jax.sharding import NamedSharding
         rows_sh = NamedSharding(mesh, P(DATA_AXIS))
-        margin = jax.device_put(margin, rows_sh)
-        vmargin = jax.device_put(vmargin, rows_sh)
+        margin = resilient_device_put(margin, rows_sh, pipeline="train")
+        vmargin = resilient_device_put(vmargin, rows_sh,
+                                       pipeline="train")
         # buffer donation is only safe when (a) an early stop can never
         # force a rollback to the previous chunk's margins and (b) no
         # in-training checkpoint will device_get a margin after it has
@@ -700,6 +703,11 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         # pipeline nothing the score fetch wasn't already paying
         shard_obs = []
         partn = partitioner(mesh)
+        # performance accounting (ISSUE 11): per-executable cost capture
+        # at this jit seam + the measured loop wall -> the train's
+        # roofline point (None when telemetry is off — checked no-op)
+        perf_acc = telemetry.costmodel.accumulator(
+            "train.loop", n_devices=mesh.size)
         jax.block_until_ready(margin)  # h2o3-lint: allow[transfer-seam] loop-entry fence: resume-margin upload must land before the tree-loop clock starts
 
         def commit_ckpt(cur_margin):
@@ -744,20 +752,22 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                 # so grid/AutoML ntrees variants reuse the executable;
                 # masked waste is bounded by ONE chunk per train
                 bucket = chunk_bucket(c)
-            def _dispatch(bucket=bucket, c=c):
+            # ONE spelling of the executable cache key, shared by the
+            # dispatch and the cost capture below — the two must
+            # describe the SAME executable or the accounting drifts
+            lru_key = (mesh, cfg, K, dist_name,
+                       float(p["tweedie_power"]),
+                       float(p.get("quantile_alpha", 0.5)),
+                       srpc, na_bin, bucket, has_valid, has_t,
+                       adaptive, has_mono, has_sets, donate)
+            def _dispatch(lru_key=lru_key, c=c):
                 # compile + execute behind the fault seam: both the
                 # executable build and the chunk dispatch may fail
                 # transiently (the injected faults reproduce that)
                 from h2o3_tpu import faults
                 if faults.ACTIVE:
                     faults.check("compile", pipeline="train")
-                step = _compiled_chunk(mesh, cfg, K, dist_name,
-                                       float(p["tweedie_power"]),
-                                       float(p.get("quantile_alpha",
-                                                   0.5)),
-                                       srpc, na_bin, bucket, has_valid,
-                                       has_t, adaptive, has_mono,
-                                       has_sets, donate)
+                step = _compiled_chunk(*lru_key)
                 if faults.ACTIVE:
                     faults.check("execute", pipeline="train")
                     if nd > 1:
@@ -799,6 +809,29 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                     if ckpt_on:
                         commit_ckpt(margin)
                 raise
+            if perf_acc is not None:
+                # per-executable FLOP/byte attribution: ONE trace+lower
+                # per (config, bucket) key for the process lifetime (NO
+                # backend compile — the zero-recompile guards never see
+                # it); warm dispatches pay a dict lookup. scale=bucket:
+                # HLO cost analysis counts the tree-scan body once, and
+                # the executable runs it `bucket` times (masked trees
+                # included — they compute). The capture wall is noted
+                # so a cold key's trace+lower (host work inside the
+                # measured loop) is excluded from device seconds.
+                t_cap0 = time.perf_counter()
+                step = _compiled_chunk(*lru_key)    # lru cache hit
+                perf_acc.add(telemetry.costmodel.executable_cost(
+                    ("gbm.chunk",) + lru_key,
+                    lambda s=step, d=disp, cc=c: s.lower(
+                        Xtr, codes_t_arg, margin, yf, w, vtrain,
+                        vmargin, key, jnp.float32(lr), huber_delta,
+                        root_lo, root_hi, nb_f, mono_arr, sets_arr,
+                        jnp.int32(start_trees + d), jnp.int32(cc),
+                        rate_t, col_rate_t, anneal_t),
+                    scale=bucket))
+                perf_acc.note_capture_seconds(
+                    time.perf_counter() - t_cap0)
             pend = None
             if score_each:
                 pend = self._score_entry_dev(nv if has_valid else nm,
@@ -884,6 +917,15 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             "bin_s": round(t_bin, 4), "loop_s": round(t_loop, 4),
             "score_s": round(score_s, 4),
             "finalize_s": round(t_fin, 4)}
+        if perf_acc is not None:
+            # measured device time = the loop wall (dispatches pipeline;
+            # the block_until_ready fence above makes it device-
+            # saturated) paired with the dispatched executables' cost
+            perf_acc.add_device_seconds(t_loop)
+            rp = perf_acc.finish()
+            if rp is not None:
+                model.output["perf"] = {"train": rp,
+                                        "phases": {"loop": rp}}
         # mesh layout this train actually ran under — the bench scaling
         # round and the SPMD parity tests assert against it instead of
         # inferring from env
@@ -950,8 +992,10 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         X_host = spec.X_host
         rows = spec.nrow
         X_host = X_host[:rows]
-        y_host = np.asarray(jax.device_get(spec.y))[:rows].astype(np.float32)
-        w_host = np.asarray(jax.device_get(spec.w))[:rows].astype(np.float32)
+        yw_host = telemetry.device_get((spec.y, spec.w),
+                                       pipeline="train")
+        y_host = np.asarray(yw_host[0])[:rows].astype(np.float32)
+        w_host = np.asarray(yw_host[1])[:rows].astype(np.float32)
         budget = memman.manager().budget
         chunk_rows = int(max(min(budget // max(spec.n_features * 4 * 4, 1),
                                  rows), 16384))
@@ -990,8 +1034,9 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                         prior._margin_matrix(jnp.asarray(X_host[s:e]))
                         .astype(jnp.float32)))
         else:
-            f0 = float(jax.device_get(dist.init_f0(jnp.asarray(y_host),
-                                                   jnp.asarray(w_host))))
+            f0 = float(telemetry.device_get(
+                dist.init_f0(jnp.asarray(y_host), jnp.asarray(w_host)),
+                pipeline="train"))
         ntrees = int(p["ntrees"])
         ntrees_new = ntrees - start_trees
         anneal = float(p.get("learn_rate_annealing", 1.0) or 1.0)
@@ -1007,6 +1052,13 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         # REST cancel / watchdog max_runtime kill lands promptly even
         # inside a deep tree's chunk uploads
         chunks.cancel_check = lambda: job.cancel_requested
+        # performance accounting (ISSUE 11): the streamed level passes
+        # feed this through chunks.perf_acc (tree.py captures each level
+        # kernel's cost once per shape); coverage noted — the routing/
+        # leaf-apply passes are not costed
+        perf_acc = telemetry.costmodel.accumulator(
+            "train.stream", note="level-histogram kernels only")
+        chunks.perf_acc = perf_acc
         from h2o3_tpu.jobs import JobCancelled
         trees = []
 
@@ -1174,6 +1226,12 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                       self.algo, ce)
         model.output["training_loop_seconds"] = t_loop
         model.output["streamed"] = True
+        if perf_acc is not None:
+            perf_acc.add_device_seconds(t_loop)
+            rp = perf_acc.finish()
+            if rp is not None:
+                model.output["perf"] = {"train": rp,
+                                        "phases": {"levels": rp}}
         # transfer accounting for the bench guard: h2d bytes per tree vs
         # the dataset's device footprint (once-per-tree contract). The
         # count is the pipeline's OWN tally (chunks.h2d_bytes), not a
@@ -1294,8 +1352,8 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         resumed train bit-identical) + a cheap data fingerprint so the
         margin is never applied to a DIFFERENT training frame."""
         from h2o3_tpu.models.model_base import persist_in_training_ckpt
-        model._resume_margin = np.asarray(jax.device_get(margin),
-                                          np.float32)
+        model._resume_margin = np.asarray(
+            telemetry.device_get(margin, pipeline="train"), np.float32)
         if spec is not None:
             model._resume_sig = _spec_signature(spec)
         return persist_in_training_ckpt(model, self.algo, ckpt_dir)
@@ -1326,7 +1384,7 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         """Materialize a pending score entry: ONE device_get for all of
         the interval's scalars."""
         kind, dname, built, vals = pend
-        h = jax.device_get(vals)
+        h = telemetry.device_get(vals, pipeline="train")
         if kind != "k1":
             ll = float(h["logloss"])
             return {"ntrees": built, "logloss": ll, "deviance": ll}
@@ -1377,7 +1435,7 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                            if getattr(prior, "_node_w", None) is not None
                            else None),
             }
-        f0_host = np.asarray(jax.device_get(f0))
+        f0_host = np.asarray(telemetry.device_get(f0, pipeline="train"))
         model = GBMModel(self._model_key(), self.params,
                          spec, dist_name, f0_host, trees_host,
                          bm.edges if bm is not None else [],
@@ -1426,7 +1484,9 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             return compute_metrics(probs, spec.y, spec.w, K, spec.response_domain)
         dist = dist if dist is not None else self._dist(dist_name)
         mu = dist.predict(margin)
-        dev = float(jax.device_get(dist.deviance(spec.w, spec.y.astype(jnp.float32), mu)))
+        dev = float(telemetry.device_get(
+            dist.deviance(spec.w, spec.y.astype(jnp.float32), mu),
+            pipeline="train"))
         return compute_metrics(mu, spec.y, spec.w, 1, deviance=dev)
 
 

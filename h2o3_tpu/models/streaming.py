@@ -161,6 +161,11 @@ class StreamedChunks:
         # cancel lands BETWEEN level passes — never inside the leaf-apply
         # pass, where a partial update would corrupt chunk margins
         self.cancel_check: Optional[callable] = None
+        # performance accounting (ISSUE 11): the training driver parks
+        # its costmodel.PerfAccumulator here so the level passes in
+        # tree.py can attribute each level kernel's cost without
+        # threading a parameter through the grower signature
+        self.perf_acc = None
 
     # -- residency -------------------------------------------------------
 

@@ -1099,11 +1099,32 @@ def grow_tree_adaptive_streamed(chunks, dist, lr, cfg: TreeConfig,
                           nb_f[None, :] / jnp.where(span > 0, span, 1.0),
                           0.0)
         hist = None
+        perf_acc = getattr(chunks, "perf_acc", None)
         for ch in chunks.level_pass():
             ghw = ch.ghw(dist)
             nid2, h_c = adaptive_level(ch.X, ch.nid, ghw, tables, lo_d,
                                        inv_d, N // 2 if d else 0, N, base,
                                        W, mxu_dtype=mxu_dtype)
+            if perf_acc is not None:
+                # streamed-level jit seam (ISSUE 11): one trace+lower
+                # per (chunk shape, level) key; every later chunk/tree
+                # hitting the same shape pays a dict lookup. The
+                # capture wall is noted on the accumulator so cold
+                # windows surface it as a caveat next to their MFU.
+                import time as _time
+                from functools import partial as _partial
+
+                from h2o3_tpu.telemetry import costmodel
+                t_cap0 = _time.perf_counter()
+                perf_acc.add(costmodel.traced_cost(
+                    ("gbm.stream_level", ch.X.shape, int(N), int(W),
+                     str(mxu_dtype.__name__)),
+                    _partial(adaptive_level, n_prev=N // 2 if d else 0,
+                             n_nodes=N, level_base=base, W=W,
+                             mxu_dtype=mxu_dtype),
+                    ch.X, ch.nid, ghw, tables, lo_d, inv_d))
+                perf_acc.note_capture_seconds(
+                    _time.perf_counter() - t_cap0)
             ch.put_nid(nid2)
             hist = h_c if hist is None else hist + h_c
         trip = (hist[0], hist[1], hist[2])

@@ -98,9 +98,14 @@ class MicroBatcher:
                  queue_limit: int = 8192,
                  default_timeout_ms: float = 10_000.0,
                  pipeline_depth: int = 2, breaker=None,
-                 fleet_check: Optional[Callable] = None):
+                 fleet_check: Optional[Callable] = None,
+                 perf_hook: Optional[Callable] = None):
         import queue as _q
         self.breaker = breaker         # serve/circuit.py CircuitBreaker
+        # performance accounting (ISSUE 11): (padded_rows, device_s) per
+        # completed batch -> the deployment's costmodel accumulator;
+        # None when telemetry is off (checked no-op)
+        self._perf_hook = perf_hook
         # fleet gossip verdict (serve/fleet.py reject_for): an open
         # circuit on a PEER replica sheds load here too; None = healthy
         self._fleet_check = fleet_check
@@ -492,6 +497,11 @@ class MicroBatcher:
                  "encode": tms["encode"],
                  "device": tms["dispatch"] + (t1 - t0) * 1e3,
                  "decode": (t2 - t1) * 1e3})
+            if self._perf_hook is not None:
+                try:
+                    self._perf_hook(padded, device_s)
+                except Exception:   # accounting must never sink serving
+                    pass
 
     # -- lifecycle ------------------------------------------------------
 

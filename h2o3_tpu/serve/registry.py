@@ -40,6 +40,10 @@ class CompiledScorer:
         self.nclasses = int(getattr(model, "nclasses", 1) or 1)
         self.jitted = True
         self.warm_seconds: Dict[int, float] = {}
+        # per-bucket executable cost (ISSUE 11): captured at warm time
+        # from the lowered program, so the batcher can attribute flops
+        # to every dispatched batch without touching the hot path
+        self.bucket_costs: Dict[int, object] = {}
         # output contract probed at warm time (deploy-time validation):
         # ndim and, for 2-D outputs, the class-axis width
         self.out_ndim: Optional[int] = None
@@ -82,6 +86,14 @@ class CompiledScorer:
                 break
             self.warm_seconds[b] = time.perf_counter() - t0
             self._record_output_shape(out)
+            try:
+                from h2o3_tpu.telemetry import costmodel
+                cost = costmodel.lowered_cost(
+                    lambda X=X: self._fn.lower(X, 0))
+                if cost is not None:
+                    self.bucket_costs[b] = cost
+            except Exception:   # accounting must never sink a deploy
+                pass
         return self.warm_seconds
 
     def _record_output_shape(self, out) -> None:

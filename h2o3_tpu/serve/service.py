@@ -100,13 +100,39 @@ class Deployment:
         self.breaker = CircuitBreaker(
             model=key, failure_threshold=circuit_failures,
             open_secs=float(circuit_open_ms) / 1000.0, stats=self.stats)
+        # performance accounting (ISSUE 11): per-deployment MFU from the
+        # warm buckets' executable costs x dispatched batches over the
+        # measured device stage (None when telemetry is off)
+        from h2o3_tpu.telemetry import costmodel
+        self.perf = costmodel.accumulator("serve")
         self.batcher = MicroBatcher(
             encode=self.codec.encode, dispatch=self.scorer.score,
             decode=self.codec.decode_batch, stats=self.stats,
             bucket_for=self.scorer.bucket_for, max_batch=max_batch,
             max_delay_ms=max_delay_ms, queue_limit=queue_limit,
             default_timeout_ms=timeout_ms, breaker=self.breaker,
-            fleet_check=self._fleet_check)
+            fleet_check=self._fleet_check,
+            # hook whenever accounting is on — bucket costs may arrive
+            # AFTER construction (warm=False deploys warm lazily), and
+            # _perf_hook tolerates a bucket with no captured cost
+            perf_hook=(self._perf_hook if self.perf is not None
+                       else None))
+
+    def _perf_hook(self, padded_rows: int, device_s: float):
+        """Collector-thread accounting seam: the dispatched bucket's
+        warm-time executable cost + the batch's measured device stage."""
+        cost = self.scorer.bucket_costs.get(padded_rows)
+        if cost is not None:
+            self.perf.add(cost)
+        self.perf.add_device_seconds(device_s)
+
+    def perf_snapshot(self):
+        """Roofline point for this deployment's cumulative serve work
+        (None when telemetry is off or nothing was dispatched yet) —
+        the ``perf`` block in ``/3/Serve/stats``."""
+        if self.perf is None:
+            return None
+        return self.perf.point()
 
     def _fleet_check(self):
         """Peer-circuit gossip verdict for this deployment: a peer
@@ -265,7 +291,9 @@ def stats() -> Dict[str, Any]:
     for dep in deployments():
         per_model[dep.key] = {**dep.stats.snapshot(),
                               "pending_rows": dep.batcher.pending_rows,
-                              "circuit": dep.breaker.snapshot()}
+                              "circuit": dep.breaker.snapshot(),
+                              # per-deployment MFU/roofline (ISSUE 11)
+                              "perf": dep.perf_snapshot()}
     return {"models": per_model,
             "total": merge_snapshots(list(per_model.values())),
             # fleet view (ISSUE 9): local circuit states + live peer

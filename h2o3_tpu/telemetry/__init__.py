@@ -13,6 +13,7 @@ profiler tools (tools/profile_*.py) and bench rounds read.
 attribute load + branch — guarded by tests/test_telemetry.py's
 ns-budget microbench).
 """
+from h2o3_tpu.telemetry import costmodel
 from h2o3_tpu.telemetry.collectors import (device_get, device_memory_bytes,
                                            install, installed, record_d2d,
                                            record_d2h, record_h2d,
@@ -35,7 +36,7 @@ from h2o3_tpu.telemetry import trace
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "Span",
     "chrome_trace", "chrome_trace_bytes", "clear_spans",
-    "cluster_samples", "cluster_snapshot", "current_span",
+    "cluster_samples", "cluster_snapshot", "costmodel", "current_span",
     "device_get", "device_memory_bytes", "enabled", "finished_spans", "install",
     "installed", "last_error_span", "local_snapshot", "merge_snapshots",
     "open_span", "profile", "prometheus_text",

@@ -321,6 +321,65 @@ def test_monotonic_and_epoch_reporting_not_flagged(tmp_path):
     assert "monotonic-durations" not in _rules_of(rep)
 
 
+def test_detects_pallas_without_grid_or_specs(tmp_path):
+    # seeded violation for the pre-landed compiled-kernel guardrail:
+    # a pallas_call leaning on the implicit whole-array grid/BlockSpec
+    # defaults AND pinning interpret=True into production code
+    rep = _lint_source(tmp_path, "h2o3_tpu/ops/newkern.py", """\
+        from jax.experimental import pallas as pl
+
+        def hist(x):
+            return pl.pallas_call(
+                lambda x_ref, o_ref: None,
+                out_shape=x,
+                interpret=True,
+            )(x)
+    """)
+    pg = [f for f in rep.new if f.rule == "pallas-grid-spec"]
+    assert len(pg) == 3
+    assert any("grid=" in f.message for f in pg)
+    assert any("BlockSpec" in f.message for f in pg)
+    assert any("interpret=True" in f.message for f in pg)
+
+
+def test_pallas_with_explicit_specs_is_clean(tmp_path):
+    # the repo's real kernel shape: explicit grid + BlockSpecs and a
+    # threaded interpret= parameter (never a literal True)
+    rep = _lint_source(tmp_path, "h2o3_tpu/ops/newkern.py", """\
+        from jax.experimental import pallas as pl
+
+        def hist(x, tile, interpret=False):
+            return pl.pallas_call(
+                lambda x_ref, o_ref: None,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((tile, 8), lambda r: (r, 0))],
+                out_specs=pl.BlockSpec((tile, 8), lambda r: (r, 0)),
+                out_shape=x,
+                interpret=interpret,
+            )(x)
+    """)
+    assert "pallas-grid-spec" not in _rules_of(rep)
+
+
+def test_pallas_interpret_true_allowed_in_tests(tmp_path):
+    # CPU CI has no Mosaic: tests may pin the interpreter, but the
+    # grid/BlockSpec contract still applies everywhere
+    rep = _lint_source(tmp_path, "tests/test_newkern.py", """\
+        from jax.experimental import pallas as pl
+
+        def drive(x):
+            return pl.pallas_call(
+                lambda x_ref, o_ref: None,
+                grid=(1,),
+                in_specs=[pl.BlockSpec((8, 8), lambda r: (0, 0))],
+                out_specs=pl.BlockSpec((8, 8), lambda r: (0, 0)),
+                out_shape=x,
+                interpret=True,
+            )(x)
+    """)
+    assert "pallas-grid-spec" not in _rules_of(rep)
+
+
 # ------------------------------------------------- suppression machinery
 
 _TWO_RULE_SRC = """\

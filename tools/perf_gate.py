@@ -1,0 +1,188 @@
+"""Bench-trajectory regression gate: BENCH_r*.json may only get better.
+
+The h2o3-lint baseline ratchet (PR 10) machine-checks that the lint
+finding count shrinks monotonically; this is the same shape for the
+PERF record: every checked-in ``BENCH_r{NN}.json`` round is compared
+against the best earlier round per headline metric, and a round that
+regresses beyond the metric's noise band FAILS the gate — "the bench
+only ever gets faster" stops being an eyeballed convention.
+
+Semantics per metric (direction + noise band in ``METRIC_SPECS``):
+
+- higher-is-better (rows/sec, MFU, scaling efficiency): round ``i``
+  fails when ``value < best_so_far * (1 - band)``;
+- lower-is-better (latency, time-to-first-model): fails when
+  ``value > best_so_far * (1 + band)``.
+
+A metric is only checked from the first round that reports it (early
+rounds predate serve/MFU fields), and a metric with fewer than two data
+points is skipped. Fewer than two round files = clean skip (a fresh
+repo must not fail its own gate). Noise bands are deliberately wider
+for latency metrics (scheduler noise) than for throughput.
+
+Stdlib-only by design — tier-1 runs it (tests/test_perf_accounting.py)
+without paying the jax import.
+
+Usage:
+    python tools/perf_gate.py [--dir REPO] [--json] [--band X]
+Exit 1 when any round regressed beyond its band; 0 otherwise.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# (dotted metric path, direction, relative noise band). Paths resolve a
+# FLAT key first (bench emits "train.mfu" literally), then dotted
+# descent ("serve.p50_ms" -> record["serve"]["p50_ms"]).
+METRIC_SPECS: Tuple[Tuple[str, str, float], ...] = (
+    ("value", "higher", 0.10),                 # rows/sec/chip headline
+    ("vs_baseline", "higher", 0.10),
+    ("train.mfu", "higher", 0.10),
+    ("time_to_first_model_s", "lower", 0.35),  # compile-cache sensitive
+    ("loop_s", "lower", 0.15),
+    ("ingest_rows_per_sec", "higher", 0.15),
+    ("serve.rows_per_sec", "higher", 0.20),
+    ("serve.mfu", "higher", 0.25),
+    ("serve.p50_ms", "lower", 0.35),
+    ("serve.p99_ms", "lower", 0.50),
+    ("multichip.scaling_efficiency_8", "higher", 0.15),
+)
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def load_rounds(bench_dir: str) -> List[Tuple[int, str, Dict]]:
+    """Checked-in bench rounds sorted by round number. Each record is
+    the driver wrapper's ``parsed`` dict when present (the bench's own
+    JSON line), else the file's top level."""
+    out = []
+    for path in glob.glob(os.path.join(bench_dir, "BENCH_r*.json")):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"perf_gate: unreadable {path}: {e}", file=sys.stderr)
+            continue
+        rec = data.get("parsed") if isinstance(
+            data.get("parsed"), dict) else data
+        out.append((int(m.group(1)), os.path.basename(path), rec))
+    return sorted(out)
+
+
+def metric_value(rec: Dict, path: str) -> Optional[float]:
+    if path in rec:
+        v = rec[path]
+    else:
+        v = rec
+        for part in path.split("."):
+            if not isinstance(v, dict) or part not in v:
+                return None
+            v = v[part]
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    return float(v)
+
+
+def check_trajectory(rounds: List[Tuple[int, str, Dict]],
+                     specs=METRIC_SPECS,
+                     band_override: Optional[float] = None) -> Dict:
+    """The ratchet: walk rounds in order per metric, tracking the best
+    value seen; any round beyond its band off the best is a violation."""
+    metrics: Dict[str, Dict] = {}
+    violations: List[Dict] = []
+    for path, direction, band in specs:
+        band = band_override if band_override is not None else band
+        points = [(n, name, metric_value(rec, path))
+                  for n, name, rec in rounds]
+        points = [(n, name, v) for n, name, v in points if v is not None]
+        if len(points) < 2:
+            metrics[path] = {"checked": False, "points": len(points)}
+            continue
+        best = points[0][2]
+        best_round = points[0][0]
+        viols = []
+        for n, name, v in points[1:]:
+            if direction == "higher":
+                limit = best * (1.0 - band)
+                bad = v < limit
+                better = v > best
+            else:
+                limit = best * (1.0 + band)
+                bad = v > limit
+                better = v < best
+            if bad:
+                viols.append({
+                    "metric": path, "round": n, "file": name,
+                    "value": v, "best": best, "best_round": best_round,
+                    "limit": round(limit, 6), "band": band,
+                    "direction": direction})
+            if better:
+                best, best_round = v, n
+        metrics[path] = {"checked": True, "points": len(points),
+                         "direction": direction, "band": band,
+                         "best": best, "best_round": best_round,
+                         "latest": points[-1][2],
+                         "violations": len(viols)}
+        violations.extend(viols)
+    return {"ok": not violations,
+            "rounds": [name for _, name, _ in rounds],
+            "metrics": metrics,
+            "violations": violations}
+
+
+def run(bench_dir: str, band_override: Optional[float] = None) -> Dict:
+    rounds = load_rounds(bench_dir)
+    if len(rounds) < 2:
+        return {"ok": True, "skipped": True,
+                "reason": f"{len(rounds)} bench round(s) in "
+                          f"{bench_dir} — need 2 to ratchet",
+                "rounds": [name for _, name, _ in rounds]}
+    report = check_trajectory(rounds, band_override=band_override)
+    report["skipped"] = False
+    return report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="bench-trajectory regression gate (shrink-only "
+                    "ratchet over BENCH_r*.json)")
+    ap.add_argument("--dir", default=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--band", type=float, default=None,
+                    help="override every metric's noise band")
+    args = ap.parse_args(argv)
+    report = run(args.dir, band_override=args.band)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        if report.get("skipped"):
+            print(f"perf_gate: SKIP — {report['reason']}")
+        else:
+            for v in report["violations"]:
+                print(f"perf_gate: REGRESSION {v['metric']} in "
+                      f"{v['file']}: {v['value']} vs best {v['best']} "
+                      f"(r{v['best_round']:02d}), limit {v['limit']} "
+                      f"[{v['direction']}, band {v['band']:.0%}]")
+            checked = {k: m for k, m in report["metrics"].items()
+                       if m.get("checked")}
+            print(f"perf_gate: {'OK' if report['ok'] else 'FAIL'} — "
+                  f"{len(report['rounds'])} rounds, "
+                  f"{len(checked)} metrics checked, "
+                  f"{len(report['violations'])} violation(s)")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

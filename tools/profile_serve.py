@@ -122,6 +122,20 @@ def main():
     col_stage = {s: col_total["stage_ms"][s] - total["stage_ms"][s]
                  for s in col_total["stage_ms"]}
     col_rows = col_total["rows"] - total["rows"]
+    # per-deployment roofline (ISSUE 11): warm-bucket executable cost x
+    # dispatched batches over the measured device stage — printed next
+    # to the stage split, captured in the same run as the xprof trace
+    perf = dep.perf_snapshot()
+    if perf:
+        log(f"roofline[serve]: "
+            f"{perf['achieved_flops'] / 1e9:.3f} GFLOP/s  "
+            f"{perf['achieved_bytes_per_s'] / 1e9:.3f} GB/s  "
+            f"AI={perf['arith_intensity']} flop/B "
+            f"(ridge {perf['ridge_intensity']})  "
+            f"mfu={perf['mfu']}  {perf['roofline_regime']}  "
+            f"peaks={perf['peak_source']}"
+            + (" [informational]" if perf.get("informational") else ""))
+
     out = {
         "metric": "serve_stage_profile",
         "deploy_seconds": round(deploy_s, 3),
@@ -158,6 +172,7 @@ def main():
         # span-level view of the same run (counts prove every batch got
         # stage spans; seconds match the stage_ms sums above)
         "spans": telemetry.stage_seconds("serve."),
+        "perf": perf,
         "xprof_trace_dir": last_trace_dir(),
     }
     serve.undeploy(model.key)

@@ -117,6 +117,22 @@ def main():
         "h2o3_xla_compiles_total") - compiles0
     warm_h2d = telemetry.registry().value("h2o3_h2d_bytes_total") - h2d0
 
+    # per-phase roofline table (ISSUE 11): the same run that captured
+    # the xprof trace carries the chunk executables' cost_analysis —
+    # kernel timeline AND FLOP/byte attribution from ONE flag
+    perf = model.output.get("perf") or {}
+    for pname, pt in (perf.get("phases") or {}).items():
+        log(f"roofline[{pname}]: "
+            f"{pt['achieved_flops'] / 1e9:.2f} GFLOP/s "
+            f"({pt['flops_total'] / 1e9:.2f} GFLOP / "
+            f"{pt['device_seconds']:.3f}s)  "
+            f"{pt['achieved_bytes_per_s'] / 1e9:.2f} GB/s  "
+            f"AI={pt['arith_intensity']} flop/B "
+            f"(ridge {pt['ridge_intensity']})  "
+            f"mfu={pt['mfu']}  {pt['roofline_regime']}  "
+            f"peaks={pt['peak_source']}"
+            + (" [informational]" if pt.get("informational") else ""))
+
     # ONE scrape for every stage read (each samples() pass runs the
     # collector views, incl. an O(live arrays) device-memory walk)
     stages1 = telemetry.stage_seconds(
@@ -155,6 +171,10 @@ def main():
             warm_h2d / max(model.ntrees_built, 1)),
         "stream_profile": model.output.get("stream_profile"),
         "spmd": model.output.get("spmd"),
+        # per-phase roofline points (ISSUE 11): cost_analysis-grounded
+        # achieved flops/bytes, MFU and regime for the warm train —
+        # recorded in the same run as the xprof capture above
+        "perf": perf or None,
         "xprof_trace_dir": trace_dir,
     }
     print(json.dumps(out))
