@@ -2,9 +2,11 @@
 
 North star (BASELINE.json): HIGGS-shaped binomial boosting — the reference
 runs it through xgboost4j's gpu_hist (C++/CUDA + Rabit); here it's the
-fused adaptive-histogram tree kernel on one TPU chip (per-node uniform
-re-binning, hex/tree/DHistogram.java UniformAdaptive — the reference's own
-default GBM algorithm; ops/hist_adaptive.py). Throughput = rows × trees /
+fused PACKED binned-code tree kernel on one TPU chip (features binned
+once into int8 codes, the gpu_hist global-sketch shape —
+ops/hist_adaptive.py binned kernels; ISSUE 12. H2O3_BENCH_HIST=random
+recovers the round-5 per-node-adaptive f32 config,
+hex/tree/DHistogram.java UniformAdaptive). Throughput = rows × trees /
 boost loop seconds (setup excluded, matching how xgboost benchmarks count
 ingest separately). AUC is printed alongside: the adaptive kernel at
 nbins=62 matches the 254-bin global sketch's AUC on this task (0.8364 vs
@@ -55,15 +57,23 @@ import numpy as np
 ROWS = int(os.environ.get("H2O3_BENCH_ROWS", 10_000_000))
 TREES = int(os.environ.get("H2O3_BENCH_TREES", 20))
 DEPTH = int(os.environ.get("H2O3_BENCH_DEPTH", 6))
-# 14 bins (W=16 lanes) + per-tree random grid phase (the reference's
-# own histogram_type=Random, hex/tree/DHistogram.java): F*W=448 fits one
-# 512-lane MXU stripe so each level costs HALF the W=32 passes, and the
-# phase jitter recovers the low-bin-count resolution — measured AUC on
-# this task: 14-bin random 0.8360 / 30-bin adaptive 0.8358 / 62-bin
-# adaptive 0.8364 / 254-bin global 0.8366. Same-or-better accuracy than
-# the previously recorded 30-bin config at ~1.15x the throughput.
+# 14 bins (W=16 lanes): F*W=448 fits one 512-lane MXU stripe so each
+# level costs HALF the W=32 passes. Round 6 moves the recorded config
+# to the PACKED global-quantile sketch (histogram_type=quantiles_global
+# + packed_codes auto, ISSUE 12): features bin once into int8 codes and
+# the level kernel streams 1 byte/value instead of 4 — the roofline
+# lever in the memory-bound regime. Earlier AUC ladder on this task:
+# 14-bin random 0.8360 / 30-bin adaptive 0.8358 / 62-bin adaptive
+# 0.8364 / 254-bin global 0.8366; the 14-bin quantile sketch places
+# bins by mass, not the uniform grid, so it needs no phase jitter.
+# H2O3_BENCH_HIST=random recovers the r5 adaptive-kernel config.
 NBINS = int(os.environ.get("H2O3_BENCH_NBINS", 14))
-HIST_TYPE = os.environ.get("H2O3_BENCH_HIST", "random")
+HIST_TYPE = os.environ.get("H2O3_BENCH_HIST", "quantiles_global")
+# packed_codes param: 'auto' (default — packed wherever compiled pallas
+# runs, i.e. TPU), '1' forces the packed representation (CPU smoke
+# rounds exercise the scatter reference), '0' forces it off
+PACKED = {"1": True, "true": True, "0": False, "false": False}.get(
+    os.environ.get("H2O3_BENCH_PACKED", "auto").lower(), "auto")
 A100_GPU_HIST_ROWS_PER_SEC = 25e6
 
 
@@ -298,7 +308,7 @@ def main():
     common = dict(max_depth=DEPTH, learn_rate=0.1, nbins=NBINS,
                   distribution="bernoulli", seed=7, score_tree_interval=0,
                   stopping_rounds=0, min_rows=1.0,
-                  histogram_type=HIST_TYPE)
+                  histogram_type=HIST_TYPE, packed_codes=PACKED)
     # warmup: compile the chunked tree scan at the exact shapes/chunk the
     # measured run uses (chunk length is a static scan parameter). Its
     # wall time IS time-to-first-model: ingest/frame excluded, spec +
@@ -377,6 +387,12 @@ def main():
         "time_to_first_model_s": round(time_to_first_model, 2),
         "warm_train_s": round(total, 2),
         "loop_s": round(loop_s, 2),
+        # hardware provenance: an off-TPU round is a smoke/trend record
+        # — tools/perf_gate.py excludes informational rounds from the
+        # hardware-bound ratchet instead of comparing CPU numbers
+        # against TPU history
+        "backend": jax.default_backend(),
+        "informational": jax.default_backend() != "tpu",
     }
     # honest MFU/roofline (ISSUE 11, VERDICT weak #7): computed from the
     # chunk executables' cost_analysis x measured loop device time, not
@@ -388,6 +404,19 @@ def main():
     out["train.roofline_regime"] = train_perf.get("roofline_regime")
     out["train.arith_intensity"] = train_perf.get("arith_intensity")
     out["train.perf_informational"] = train_perf.get("informational")
+    # hot-loop representation (ISSUE 12): which bytes the level kernel
+    # streamed. hot_loop_bytes_per_row = the feature-operand bytes ONE
+    # row costs ONE level pass (representation-level: F x itemsize —
+    # the packed lever is a 4x drop here); the _row_tree variant is the
+    # cost_analysis-grounded bytes of the whole loop per (row x tree),
+    # same name as tools/profile_train.py
+    pcinfo = gbm.model.output.get("packed_codes") or {}
+    out["train.packed_codes"] = pcinfo
+    bpv = pcinfo.get("bytes_per_value", 4) if pcinfo.get("enabled") else 4
+    out["train.hot_loop_bytes_per_row"] = F * bpv
+    bt = train_perf.get("bytes_total")
+    out["train.hot_loop_bytes_per_row_tree"] = (
+        round(bt / (ROWS * max(built, 1)), 2) if bt else None)
     if train_perf:
         log(f"train perf: mfu={train_perf.get('mfu')} "
             f"regime={train_perf.get('roofline_regime')} "

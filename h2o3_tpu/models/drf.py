@@ -31,12 +31,18 @@ from h2o3_tpu import telemetry
 from h2o3_tpu.jobs import Job
 from h2o3_tpu.models.model_base import (Model, ModelBuilder, ScoreKeeper,
                                         TrainingSpec, compute_metrics)
-from h2o3_tpu.models.tree import (TreeConfig, adaptive_feasible,
-                                  adaptive_setup,
+from h2o3_tpu.models.tree import (ADAPTIVE_HIST_TYPES,
+                                  TreeConfig, adaptive_feasible,
+                                  adaptive_setup, binned_feasible,
+                                  packed_bins_upper_bound,
                                   chunk_bucket,
                                   collect_chunk_trees, grow_tree,
-                                  grow_tree_adaptive, predict_raw_stacked)
-from h2o3_tpu.ops.binning import CodesView, bin_matrix_device, make_codes_view
+                                  grow_tree_adaptive, grow_tree_binned,
+                                  packed_codes_requested,
+                                  predict_raw_stacked)
+from h2o3_tpu.ops.binning import (CodesView, bin_matrix_device,
+                                  make_codes_view, pack_codes,
+                                  packed_codes_record)
 from h2o3_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS, current_mesh,
                                     n_data_shards, n_model_shards,
                                     spmd_enabled)
@@ -63,6 +69,9 @@ DRF_DEFAULTS: Dict = dict(
     # state so training metrics match the uninterrupted run
     checkpoint=None, in_training_checkpoints_dir=None,
     in_training_checkpoints_tree_interval=1,
+    # MXU histogram precision + packed binned-code hot path — same
+    # semantics as the GBM params (models/gbm.py GBM_DEFAULTS)
+    histogram_precision="auto", packed_codes="auto",
 )
 
 
@@ -175,7 +184,7 @@ class DRFModel(TreeScoringOptionsMixin, Model):
 def _drf_chunk_body(codes_rm, codes_t, y, w, oob_num, oob_cnt, base_key,
                     root_lo, root_hi, nb_f, start_idx, n_active, sample_rate,
                     col_rate, *, cfg, K,
-                    sample_rate_per_class, chunk, has_t, adaptive,
+                    sample_rate_per_class, chunk, has_t, adaptive, binned,
                     axis_name, model_axis=None):
     """A chunk of independent forest trees per data shard; OOB sums ride
     the scan carry (reference: DRF's OOB rows are scored by the trees that
@@ -195,6 +204,10 @@ def _drf_chunk_body(codes_rm, codes_t, y, w, oob_num, oob_cnt, base_key,
                                       root_lo, root_hi, axis_name=axis_name,
                                       key=key_m, nb_f=nb_f,
                                       model_axis=model_axis)
+        if binned:
+            return grow_tree_binned(codes_rm, gv, hv, wt, cfg, col_mask,
+                                    axis_name=axis_name, key=key_m,
+                                    model_axis=model_axis, ct=codes.t)
         return grow_tree(codes, gv, hv, wt, cfg, col_mask,
                          axis_name=axis_name, key=key_m,
                          model_axis=model_axis)
@@ -244,14 +257,14 @@ def _drf_chunk_body(codes_rm, codes_t, y, w, oob_num, oob_cnt, base_key,
 
 @lru_cache(maxsize=128)
 def _compiled_drf_chunk(mesh, cfg, K, sample_rate_per_class, chunk, has_t,
-                        adaptive, donate=False):
+                        adaptive, binned=False, donate=False):
     model_axis = (MODEL_AXIS
                   if mesh.shape[MODEL_AXIS] > 1 and spmd_enabled()
                   else None)
     body = partial(_drf_chunk_body, cfg=cfg, K=K,
                    sample_rate_per_class=sample_rate_per_class,
                    chunk=chunk, has_t=has_t,
-                   adaptive=adaptive, axis_name=DATA_AXIS,
+                   adaptive=adaptive, binned=binned, axis_name=DATA_AXIS,
                    model_axis=model_axis)
     in_specs = (P(DATA_AXIS),
                 P(None, DATA_AXIS) if has_t else P(DATA_AXIS),
@@ -288,9 +301,23 @@ class H2ORandomForestEstimator(ModelBuilder):
                 f"reference's default 20 relies on dynamic node allocation)")
         nbins = int(p["nbins"])
         hist_type = (p.get("histogram_type") or "uniform_adaptive").lower()
-        adaptive = (hist_type in ("uniform_adaptive", "uniform", "auto",
-                                  "round_robin")
+        # packed binned-code hot path (ISSUE 12) — same gating as GBM:
+        # default wherever compiled pallas runs; 'random' keeps the
+        # adaptive kernel (per-tree grid phase needs per-level rebinning)
+        packed_req = packed_codes_requested(p) and hist_type != "random"
+        if (packed_req
+                and not binned_feasible(
+                    packed_bins_upper_bound(spec, p), spec.n_features,
+                    depth)
+                and hist_type in ADAPTIVE_HIST_TYPES
+                and adaptive_feasible(spec, p, depth)):
+            # cheap pre-gate from the cat domains (see models/gbm.py)
+            packed_req = False
+        adaptive = (hist_type in ADAPTIVE_HIST_TYPES
+                    and not packed_req
                     and adaptive_feasible(spec, p, depth))
+        packed = False
+        pc = None
         mtries = int(p.get("mtries", -1) or -1)
         F = spec.n_features
         if mtries <= 0:
@@ -304,23 +331,46 @@ class H2ORandomForestEstimator(ModelBuilder):
         else:
             # device-side sketch (ops/binning.bin_matrix_device): no
             # device_get of the full X
+            # packed mode skips the int32 transposed operand — the
+            # packed layouts supersede it (see models/gbm.py)
             bm = bin_matrix_device(spec.X, spec.names,
                                    spec.is_cat, spec.nrow, nbins=max(nbins, 2),
                                    nbins_cats=int(p["nbins_cats"]),
-                                   histogram_type=hist_type)
-            cfg = TreeConfig(max_depth=depth, n_bins=bm.n_bins,
-                             n_features=bm.n_features,
-                             min_rows=float(p["min_rows"]),
-                             min_split_improvement=float(p["min_split_improvement"]),
-                             reg_lambda=float(p.get("reg_lambda", 0.0)),
-                             mtries=min(mtries, bm.n_features),
-                             col_rate_change=float(
-                                 p.get("col_sample_rate_change_per_level",
-                                       1.0) or 1.0),
-                             hist_method=p.get("hist_kernel", "auto"))
-            root_lo = jnp.zeros(cfg.n_features, jnp.float32)
-            root_hi = jnp.zeros(cfg.n_features, jnp.float32)
-            nb_f = jnp.zeros(cfg.n_features, jnp.float32)
+                                   histogram_type=hist_type,
+                                   with_t=not packed_req)
+            packed = (packed_req
+                      and binned_feasible(bm.n_bins, bm.n_features, depth))
+            if (not packed and packed_req
+                    and hist_type in ADAPTIVE_HIST_TYPES
+                    and adaptive_feasible(spec, p, depth)):
+                # packing infeasible (sketch bin count past the 254-lane
+                # cap / VMEM): fall back to the fused adaptive kernel,
+                # not the slow matmul path (see models/gbm.py)
+                adaptive = True
+                bm = None
+                cfg, root_lo, root_hi, nb_f = adaptive_setup(
+                    spec, p, depth, mtries=min(mtries, F))
+            if packed:
+                pc = pack_codes(bm)
+                # free the int32 code view (see models/gbm.py)
+                bm.codes = CodesView(rm=pc.rm, t=None)
+            if not adaptive:
+                cfg = TreeConfig(max_depth=depth, n_bins=bm.n_bins,
+                                 n_features=bm.n_features,
+                                 min_rows=float(p["min_rows"]),
+                                 min_split_improvement=float(p["min_split_improvement"]),
+                                 reg_lambda=float(p.get("reg_lambda", 0.0)),
+                                 mtries=min(mtries, bm.n_features),
+                                 col_rate_change=float(
+                                     p.get("col_sample_rate_change_per_level",
+                                           1.0) or 1.0),
+                                 hist_method=p.get("hist_kernel", "auto"),
+                                 histogram_precision=str(
+                                     p.get("histogram_precision",
+                                           "auto")).lower())
+                root_lo = jnp.zeros(cfg.n_features, jnp.float32)
+                root_hi = jnp.zeros(cfg.n_features, jnp.float32)
+                nb_f = jnp.zeros(cfg.n_features, jnp.float32)
         mesh = current_mesh()
         nd = n_data_shards(mesh)
         padded = spec.X.shape[0]
@@ -337,9 +387,13 @@ class H2ORandomForestEstimator(ModelBuilder):
         ntrees_new = ntrees - start_trees
         sample_rate = float(p["sample_rate"])
         col_rate = float(p.get("col_sample_rate_per_tree", 1.0))
-        Xtr = spec.X if adaptive else bm.codes.rm
-        has_t = (not adaptive) and bm.codes.t is not None
-        codes_t_arg = bm.codes.t if has_t else Xtr
+        Xtr = spec.X if adaptive else (pc.rm if packed else bm.codes.rm)
+        if packed:
+            has_t = pc.t is not None
+            codes_t_arg = pc.t if has_t else Xtr
+        else:
+            has_t = (not adaptive) and bm.codes.t is not None
+            codes_t_arg = bm.codes.t if has_t else Xtr
         # data-sharded from the start so every chunk (not just the 2nd+)
         # sees identically-sharded carry operands — one executable per
         # bucket (see the margin pinning note in models/gbm.py)
@@ -436,7 +490,7 @@ class H2ORandomForestEstimator(ModelBuilder):
             # dispatch and the cost capture below (see models/gbm.py)
             bucket = chunk_bucket(c)
             lru_key = (mesh, cfg, K, srpc, bucket, has_t,
-                       adaptive, donate)
+                       adaptive, packed, donate)
 
             def _dispatch(lru_key=lru_key, c=c):
                 from h2o3_tpu import faults
@@ -527,6 +581,11 @@ class H2ORandomForestEstimator(ModelBuilder):
                 from h2o3_tpu.log import warn
                 warn("drf: final in-training checkpoint failed: %s", e)
         model.output["training_loop_seconds"] = t_loop
+        model.output["packed_codes"] = packed_codes_record(
+            packed, dtype=pc.rm.dtype if packed else None,
+            W=pc.W if packed else None,
+            bytes_per_value=pc.itemsize if packed else None,
+            n_bins=bm.n_bins if packed else None)
         if perf_acc is not None:
             perf_acc.add_device_seconds(t_loop)
             rp = perf_acc.finish()

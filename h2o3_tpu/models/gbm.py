@@ -26,14 +26,19 @@ from h2o3_tpu.jobs import Job
 from h2o3_tpu.models.distributions import get_distribution
 from h2o3_tpu.models.model_base import (Model, ModelBuilder, ScoreKeeper,
                                         TrainingSpec, compute_metrics)
-from h2o3_tpu.models.tree import (TreeConfig, adaptive_feasible,
-                                  adaptive_setup,
+from h2o3_tpu.models.tree import (ADAPTIVE_HIST_TYPES,
+                                  TreeConfig, adaptive_feasible,
+                                  adaptive_setup, binned_feasible,
+                                  packed_bins_upper_bound,
                                   chunk_bucket,
                                   collect_chunk_trees, grow_tree,
-                                  grow_tree_adaptive, predict_binned,
+                                  grow_tree_adaptive, grow_tree_binned,
+                                  packed_codes_requested, predict_binned,
                                   predict_raw_stacked, predict_raw_tree)
 from h2o3_tpu.ops.binning import (CodesView, bin_matrix_device,
-                                  digitize_with_edges, make_codes_view)
+                                  digitize_with_edges, make_codes_view,
+                                  pack_codes, pack_codes_for,
+                                  packed_codes_record)
 from h2o3_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS, current_mesh,
                                     n_data_shards, n_model_shards,
                                     partitioner, spmd_enabled)
@@ -70,6 +75,15 @@ GBM_DEFAULTS: Dict = dict(
     # MXU histogram precision: 'auto' (= bfloat16 1-pass; deviation bound
     # in ops/hist_adaptive.py) or 'float32' (exact, ~6x hist cost)
     histogram_precision="auto",
+    # packed binned-code hot path (ISSUE 12): 'auto' bins features once
+    # into int8/int16 codes and runs the fused binned level kernel
+    # wherever compiled pallas runs (TPU / interpret escape) — the
+    # XGBoost tree_method=hist shape with 1-2 byte/value hot-loop
+    # traffic; True forces it everywhere (scatter reference), False
+    # keeps the per-node adaptive f32 kernel. histogram_type='random'
+    # always uses the adaptive kernel (its per-tree grid phase needs
+    # per-level rebinning, which packing removes by design)
+    packed_codes="auto",
 )
 
 
@@ -234,7 +248,7 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
                     *, cfg, K,
                     dist_name, tweedie_power, quantile_alpha,
                     sample_rate_per_class, na_bin, chunk,
-                    has_valid, has_t, adaptive, has_mono, has_sets,
+                    has_valid, has_t, adaptive, binned, has_mono, has_sets,
                     axis_name, model_axis=None):
     """One chunk of the boosting loop, per data shard (runs under
     shard_map). ``chunk`` trees are built inside ONE program via lax.scan:
@@ -254,8 +268,10 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
     and learn-rate annealing ride as TRACED scalars for the same reason.
 
     ``adaptive`` selects the fused per-node-adaptive-bins kernel over raw
-    features (codes_rm then carries raw X); otherwise the global-sketch
-    binned-codes path."""
+    features (codes_rm then carries raw X); ``binned`` the PACKED
+    global-sketch path (codes_rm/codes_t carry int8/int16 codes with
+    NA = W-1 through the fused binned kernel, split thresholds as bin
+    indices); otherwise the matmul/scatter global-sketch path."""
     codes = CodesView(rm=codes_rm, t=codes_t if has_t else None)
     vcodes = vrm
     F = codes_rm.shape[1]
@@ -270,6 +286,11 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
                                       root_lo, root_hi, axis_name=axis_name,
                                       nb_f=nb_f, mono=mono_a, sets=sets_a,
                                       key=key, model_axis=model_axis)
+        if binned:
+            return grow_tree_binned(codes_rm, gv, hv, wt, cfg, col_mask,
+                                    axis_name=axis_name, mono=mono_a,
+                                    sets=sets_a, key=key,
+                                    model_axis=model_axis, ct=codes.t)
         return grow_tree(codes, gv, hv, wt, cfg, col_mask,
                          axis_name=axis_name, mono=mono_a, sets=sets_a,
                          key=key, model_axis=model_axis)
@@ -277,6 +298,7 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
     def valid_contrib(tree):
         if adaptive:
             return predict_raw_tree(vrm, tree, cfg.max_depth)[0]
+        # binned + global-sketch: bin-space walk (na_bin = W-1 packed)
         return predict_binned(vcodes, tree, cfg.max_depth, na_bin)[0]
 
     def one_tree(carry, i):
@@ -343,7 +365,8 @@ def _gbm_chunk_body(codes_rm, codes_t, margin, y, w, vrm, vmargin, base_key,
 @lru_cache(maxsize=128)
 def _compiled_chunk(mesh, cfg, K, dist_name, tweedie_power, quantile_alpha,
                     sample_rate_per_class, na_bin, chunk, has_valid, has_t,
-                    adaptive, has_mono=False, has_sets=False, donate=False):
+                    adaptive, binned=False, has_mono=False, has_sets=False,
+                    donate=False):
     """Build + cache the sharded jitted chunk step for a given mesh/config.
 
     Rows ride the mesh 'data' axis; tree arrays come back replicated (every
@@ -366,7 +389,8 @@ def _compiled_chunk(mesh, cfg, K, dist_name, tweedie_power, quantile_alpha,
                    sample_rate_per_class=sample_rate_per_class,
                    na_bin=na_bin, chunk=chunk,
                    has_valid=has_valid, has_t=has_t,
-                   adaptive=adaptive, has_mono=has_mono, has_sets=has_sets,
+                   adaptive=adaptive, binned=binned, has_mono=has_mono,
+                   has_sets=has_sets,
                    axis_name=DATA_AXIS, model_axis=model_axis)
     in_specs = (P(DATA_AXIS),                              # codes_rm / raw X
                 P(None, DATA_AXIS) if has_t else P(DATA_AXIS),  # codes_t/dummy
@@ -458,12 +482,30 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         hist_type = (p.get("histogram_type") or "uniform_adaptive").lower()
         t_bin0 = time.time()           # span wall anchor
         t_bin0_m = time.monotonic()    # duration clock (NTP-immune)
+        # packed binned-code hot path (ISSUE 12): bin ONCE per train
+        # into int8/int16 codes and run the fused binned level kernel —
+        # the default wherever compiled pallas runs. histogram_type=
+        # 'random' keeps the adaptive kernel (per-tree grid phase needs
+        # per-level rebinning, which packing removes by design).
+        packed_req = packed_codes_requested(p) and hist_type != "random"
+        if (packed_req
+                and not binned_feasible(
+                    packed_bins_upper_bound(spec, p), spec.n_features,
+                    int(p["max_depth"]))
+                and hist_type in ADAPTIVE_HIST_TYPES
+                and adaptive_feasible(spec, p, int(p["max_depth"]))):
+            # cheap pre-gate from the cat domains alone: packing CANNOT
+            # come in under its lane/VMEM caps, so take the adaptive
+            # kernel without paying the O(rows*F) sketch + digitise
+            packed_req = False
         # uniform_adaptive (reference default) runs the fused per-node
         # adaptive kernel on raw features; the global-sketch path handles
         # quantiles_global and nbins beyond the adaptive kernel's 254 cap
-        adaptive = (hist_type in ("uniform_adaptive", "uniform", "auto",
-                                  "round_robin", "random")
+        adaptive = (hist_type in ADAPTIVE_HIST_TYPES + ("random",)
+                    and not packed_req
                     and adaptive_feasible(spec, p, int(p["max_depth"])))
+        packed = False
+        pc = None
         if adaptive:
             bm = None
             cfg, root_lo, root_hi, nb_f = adaptive_setup(
@@ -471,23 +513,53 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         else:
             # device-side sketch: X never leaves HBM (the old path
             # device_get the whole matrix just to run np.quantile on it)
+            # packed mode skips the int32 transposed pallas operand
+            # (with_t): pack_codes supersedes it with the int8/int16
+            # layouts, and building a rows*F*4 copy just to drop it
+            # would cost the HBM the packing saves
             bm = bin_matrix_device(spec.X, spec.names,
                                    spec.is_cat, spec.nrow, nbins=max(nbins, 2),
                                    nbins_cats=int(p["nbins_cats"]),
-                                   histogram_type=hist_type)
-            cfg = TreeConfig(max_depth=int(p["max_depth"]), n_bins=bm.n_bins,
-                             n_features=bm.n_features,
-                             min_rows=float(p["min_rows"]),
-                             min_split_improvement=float(p["min_split_improvement"]),
-                             reg_lambda=float(p.get("reg_lambda", 0.0)),
-                             reg_alpha=float(p.get("reg_alpha", 0.0)),
-                             col_rate_change=float(
-                                 p.get("col_sample_rate_change_per_level",
-                                       1.0) or 1.0),
-                             hist_method=p.get("hist_kernel", "auto"))
-            root_lo = jnp.zeros(cfg.n_features, jnp.float32)
-            root_hi = jnp.zeros(cfg.n_features, jnp.float32)
-            nb_f = jnp.zeros(cfg.n_features, jnp.float32)
+                                   histogram_type=hist_type,
+                                   with_t=not packed_req)
+            packed = (packed_req
+                      and binned_feasible(bm.n_bins, bm.n_features,
+                                          int(p["max_depth"])))
+            if (not packed and packed_req
+                    and hist_type in ADAPTIVE_HIST_TYPES
+                    and adaptive_feasible(spec, p, int(p["max_depth"]))):
+                # packing infeasible (sketch bin count past the 254-lane
+                # cap / VMEM): fall back to the fused ADAPTIVE kernel,
+                # not the slow matmul path the sketch would otherwise
+                # route to
+                adaptive = True
+                bm = None
+                cfg, root_lo, root_hi, nb_f = adaptive_setup(
+                    spec, p, int(p["max_depth"]))
+            if packed:
+                pc = pack_codes(bm)
+                # free the int32 code view: the packed layouts replace
+                # it (1-2 bytes/value x2 <= half the f32 X footprint);
+                # only bm.edges / n_bins are read from here on
+                bm.codes = CodesView(rm=pc.rm, t=None)
+            if not adaptive:
+                cfg = TreeConfig(max_depth=int(p["max_depth"]),
+                                 n_bins=bm.n_bins,
+                                 n_features=bm.n_features,
+                                 min_rows=float(p["min_rows"]),
+                                 min_split_improvement=float(p["min_split_improvement"]),
+                                 reg_lambda=float(p.get("reg_lambda", 0.0)),
+                                 reg_alpha=float(p.get("reg_alpha", 0.0)),
+                                 col_rate_change=float(
+                                     p.get("col_sample_rate_change_per_level",
+                                           1.0) or 1.0),
+                                 hist_method=p.get("hist_kernel", "auto"),
+                                 histogram_precision=str(
+                                     p.get("histogram_precision",
+                                           "auto")).lower())
+                root_lo = jnp.zeros(cfg.n_features, jnp.float32)
+                root_hi = jnp.zeros(cfg.n_features, jnp.float32)
+                nb_f = jnp.zeros(cfg.n_features, jnp.float32)
         t_bin = time.monotonic() - t_bin0_m
         # same clocks feed train_profile AND the spans (parented under
         # the Profile's train phase span via the thread-local stack)
@@ -571,7 +643,7 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         # validation margin tracked with train edges
         mesh = current_mesh()
         nd = n_data_shards(mesh)
-        Xtr = spec.X if adaptive else bm.codes.rm
+        Xtr = spec.X if adaptive else (pc.rm if packed else bm.codes.rm)
         if Xtr.shape[0] % nd != 0:
             raise ValueError(
                 f"padded row count {Xtr.shape[0]} is not divisible by "
@@ -586,6 +658,10 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                     f"after h2o3_tpu.init()")
             if adaptive:
                 vtrain = valid_spec.X
+            elif packed:
+                # validation codes share the training sketch AND the
+                # packed NA = W-1 convention (predict_binned walk)
+                vtrain = pack_codes_for(valid_spec.X, bm, pc.W)
             else:
                 vtrain = make_codes_view(digitize_with_edges(
                     valid_spec.X, bm.edges, bm.n_bins)).rm
@@ -597,8 +673,7 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             if K == 1 and valid_spec.offset is not None:
                 vmargin = vmargin + valid_spec.offset
         else:  # small dummies (untraced branches, but args need shapes)
-            vtrain = jnp.zeros((8 * nd, cfg.n_features),
-                               Xtr.dtype if adaptive else bm.codes.dtype)
+            vtrain = jnp.zeros((8 * nd, cfg.n_features), Xtr.dtype)
             vmargin = (jnp.zeros(8 * nd, jnp.float32) if K == 1
                        else jnp.zeros((8 * nd, K), jnp.float32))
 
@@ -635,9 +710,14 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                   "larger interval", ckpt_interval,
                   int(ntrees_new / ckpt_interval))
         trees_since_ckpt = 0
-        has_t = (not adaptive) and bm.codes.t is not None
-        codes_t_arg = bm.codes.t if has_t else Xtr  # ignored dummy otherwise
-        na_bin = 0 if adaptive else bm.na_bin
+        if packed:
+            has_t = pc.t is not None
+            codes_t_arg = pc.t if has_t else Xtr
+            na_bin = pc.na_bin                   # reserved lane W-1
+        else:
+            has_t = (not adaptive) and bm.codes.t is not None
+            codes_t_arg = bm.codes.t if has_t else Xtr  # dummy otherwise
+            na_bin = 0 if adaptive else bm.na_bin
         # monotone constraints ({col: ±1}, hex/tree/DTree Constraints) and
         # interaction constraints ([[col,...],...], per-branch feature
         # allowance) ride as traced arrays through the chunk step
@@ -759,7 +839,7 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                        float(p["tweedie_power"]),
                        float(p.get("quantile_alpha", 0.5)),
                        srpc, na_bin, bucket, has_valid, has_t,
-                       adaptive, has_mono, has_sets, donate)
+                       adaptive, packed, has_mono, has_sets, donate)
             def _dispatch(lru_key=lru_key, c=c):
                 # compile + execute behind the fault seam: both the
                 # executable build and the chunk dispatch may fail
@@ -926,6 +1006,14 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             if rp is not None:
                 model.output["perf"] = {"train": rp,
                                         "phases": {"loop": rp}}
+        # hot-loop representation record (ISSUE 12): what the level
+        # kernel actually streamed — bench.py and profile_train.py read
+        # this for the bytes/row attribution
+        model.output["packed_codes"] = packed_codes_record(
+            packed, dtype=pc.rm.dtype if packed else None,
+            W=pc.W if packed else None,
+            bytes_per_value=pc.itemsize if packed else None,
+            n_bins=bm.n_bins if packed else None)
         # mesh layout this train actually ran under — the bench scaling
         # round and the SPMD parity tests assert against it instead of
         # inferring from env
@@ -986,8 +1074,6 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                 "monotone/interaction constraints are not supported in "
                 "streaming mode")
         K = 1
-        cfg, root_lo, root_hi, nb_f = adaptive_setup(
-            spec, p, int(p["max_depth"]))
         dist = self._dist(dist_name)
         X_host = spec.X_host
         rows = spec.nrow
@@ -997,8 +1083,54 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         y_host = np.asarray(yw_host[0])[:rows].astype(np.float32)
         w_host = np.asarray(yw_host[1])[:rows].astype(np.float32)
         budget = memman.manager().budget
-        chunk_rows = int(max(min(budget // max(spec.n_features * 4 * 4, 1),
-                                 rows), 16384))
+        # packed binned-code streaming (ISSUE 12): bin once on host,
+        # stream 1-2 byte codes — the compressed resident window fits
+        # ~4x more rows under the same budget and overflow H2D moves
+        # codes, not f32. histogram_type='random' keeps the adaptive
+        # kernel (per-tree grid phase needs per-level rebinning).
+        from h2o3_tpu.ops.binning import _edges_host, digitize_codes_host
+        hist_type = (p.get("histogram_type") or "uniform_adaptive").lower()
+        packed = packed_codes_requested(p) and hist_type != "random"
+        bin_edges = None
+        W = None
+        if packed:
+            # feasibility from the (cheap) edge sketch BEFORE paying
+            # the O(rows·F) host digitise — an infeasible bin count
+            # must not build a throwaway code matrix on the
+            # memory-pressure path
+            try:
+                bin_edges, n_bins_eff = _edges_host(
+                    X_host, rows, spec.is_cat, max(int(p["nbins"]), 2),
+                    int(p.get("nbins_cats", 1024)), hist_type)
+                packed = binned_feasible(n_bins_eff, spec.n_features,
+                                         int(p["max_depth"]))
+            except ValueError:
+                packed = False      # bin count past the routing cap
+            if packed:
+                codes_host, W = digitize_codes_host(X_host, bin_edges,
+                                                    n_bins_eff)
+        if packed:
+            cfg = TreeConfig(
+                max_depth=int(p["max_depth"]), n_bins=n_bins_eff,
+                n_features=spec.n_features,
+                min_rows=float(p["min_rows"]),
+                min_split_improvement=float(p["min_split_improvement"]),
+                reg_lambda=float(p.get("reg_lambda", 0.0)),
+                reg_alpha=float(p.get("reg_alpha", 0.0)),
+                hist_method=p.get("hist_kernel", "auto"),
+                histogram_precision=str(
+                    p.get("histogram_precision", "auto")).lower())
+            root_lo = root_hi = nb_f = None
+            x_stream = codes_host
+            x_itemsize = int(codes_host.dtype.itemsize)
+        else:
+            cfg, root_lo, root_hi, nb_f = adaptive_setup(
+                spec, p, int(p["max_depth"]))
+            x_stream = X_host
+            x_itemsize = 4
+        chunk_rows = int(max(min(
+            budget // max(spec.n_features * x_itemsize * 4, 1), rows),
+            16384))
         padded = int(spec.y.shape[0])
         # checkpoint continuation (formerly a streamed-path fail-fast,
         # ISSUE 9 satellite): the dense resolver's full compatibility
@@ -1045,8 +1177,9 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                     * float(p.get("col_sample_rate_per_tree", 1.0)))
         seed = int(p.get("seed", -1) or -1)
         key = jax.random.PRNGKey(seed if seed != -1 else 0)
-        chunks = StreamedChunks(X_host, y_host, w_host, f0, chunk_rows,
-                                padded_rows=padded, margin0=margin0)
+        chunks = StreamedChunks(x_stream, y_host, w_host, f0, chunk_rows,
+                                padded_rows=padded, margin0=margin0,
+                                packed_W=W if packed else None)
         # cancel propagation into the streamed pipeline: the level
         # passes poll this BETWEEN levels (never mid leaf-apply), so a
         # REST cancel / watchdog max_runtime kill lands promptly even
@@ -1161,11 +1294,19 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                     jax.random.fold_in(tkey, 1), (spec.n_features,))
                     < col_rate)
             try:
-                tree = grow_tree_adaptive_streamed(
-                    chunks, dist, lr, cfg, root_lo, root_hi, nb_f,
-                    key=tkey,
-                    sample_rate=float(p.get("sample_rate", 1.0)),
-                    col_mask=col_mask)
+                if packed:
+                    from h2o3_tpu.models.tree import \
+                        grow_tree_binned_streamed
+                    tree = grow_tree_binned_streamed(
+                        chunks, dist, lr, cfg, bin_edges, key=tkey,
+                        sample_rate=float(p.get("sample_rate", 1.0)),
+                        col_mask=col_mask)
+                else:
+                    tree = grow_tree_adaptive_streamed(
+                        chunks, dist, lr, cfg, root_lo, root_hi, nb_f,
+                        key=tkey,
+                        sample_rate=float(p.get("sample_rate", 1.0)),
+                        col_mask=col_mask)
             except JobCancelled:
                 # the partial tree applied no margin updates (cancel
                 # only fires between level passes, before leaf apply) —
@@ -1226,6 +1367,9 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                       self.algo, ce)
         model.output["training_loop_seconds"] = t_loop
         model.output["streamed"] = True
+        model.output["packed_codes"] = packed_codes_record(
+            packed, dtype=x_stream.dtype, W=W,
+            bytes_per_value=x_itemsize, n_bins=cfg.n_bins)
         if perf_acc is not None:
             perf_acc.add_device_seconds(t_loop)
             rp = perf_acc.finish()

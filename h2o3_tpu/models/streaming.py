@@ -20,6 +20,12 @@ This manager restructures the transfer schedule:
   with the same f32 arithmetic as the dense path's jitted chunk body,
   so a fully-resident streamed train is BIT-IDENTICAL to the dense
   grower on the same single chunk (tests/test_transfer_budget.py).
+- **Packed (compressed) resident windows** (ISSUE 12, ``packed_W``):
+  the window representation is the int8/int16 BIN-CODE matrix instead
+  of f32 features — the same memman budget keeps ~4x more rows
+  resident, overflow-chunk H2D moves codes, and on the pallas path
+  each upload is relaid out ONCE into the kernel's transposed
+  tile-padded operand (no per-level transpose).
 
 Every upload/fetch goes through the telemetry byte counters with
 ``pipeline="train"``, so the once-per-tree contract is asserted by a
@@ -111,7 +117,8 @@ class StreamedChunks:
     def __init__(self, X_host: np.ndarray, y_host: np.ndarray,
                  w_host: np.ndarray, f0: float, chunk_rows: int,
                  padded_rows: Optional[int] = None,
-                 margin0: Optional[np.ndarray] = None):
+                 margin0: Optional[np.ndarray] = None,
+                 packed_W: Optional[int] = None):
         from h2o3_tpu import memman
         rows, F = X_host.shape
         # the dense grower sizes its histogram-precision auto rule by the
@@ -122,18 +129,33 @@ class StreamedChunks:
         self.y_host = np.asarray(y_host, np.float32)
         self.w_host = np.asarray(w_host, np.float32)
         self.rows, self.F = rows, F
+        # packed mode (ISSUE 12): X_host carries int8/int16 BIN CODES
+        # (NA = packed_W - 1) instead of f32 features — the compressed
+        # resident window. The smaller per-row footprint below is what
+        # lets the same memman budget keep ~4x more rows resident, and
+        # every overflow upload moves codes, not floats.
+        self.packed_W = packed_W
+        self._x_itemsize = int(X_host.dtype.itemsize)
+        if packed_W is not None:
+            from h2o3_tpu.ops.hist_adaptive import pallas_interpret
+            import jax as _jax
+            self.kernel_layout = ("t" if (_jax.default_backend() == "tpu"
+                                          or pallas_interpret()) else "rm")
+        else:
+            self.kernel_layout = "rm"
         self.spans: List[Tuple[int, int]] = [
             (s, min(s + chunk_rows, rows))
             for s in range(0, rows, chunk_rows)]
         self.C = len(self.spans)
         budget = memman.manager().budget
-        per_row = (F + 5) * 4          # X + y/w/margin/nid/wt f32 vectors
+        # X (codes or f32) + y/w/margin/nid/wt f32 working vectors
+        per_row = F * self._x_itemsize + 5 * 4
         window = int(budget * _RESIDENT_SHARE)
         if rows * per_row <= window:
             R = self.C
         else:
             # reserve the two stream buffers the overflow pipeline needs
-            window -= 2 * chunk_rows * F * 4
+            window -= 2 * chunk_rows * F * self._x_itemsize
             R = max(0, window // max(chunk_rows * per_row, 1))
         self.R = int(min(R, self.C))
         ro = os.environ.get("H2O3_STREAM_RESIDENT")
@@ -185,6 +207,27 @@ class StreamedChunks:
             self.h2d_resident_bytes += arr.nbytes
         return dev
 
+    def _kernel_operand(self, dev):
+        """Device-side relayout of an uploaded X chunk into the level
+        kernel's operand. Packed + pallas: transposed tile-padded codes
+        [F, rows_p] (pad = NA bin W-1), built ONCE per upload so
+        resident chunks never pay a per-level transpose. Otherwise the
+        chunk passes through unchanged."""
+        if self.packed_W is not None and self.kernel_layout == "t":
+            from h2o3_tpu import memman
+            from h2o3_tpu.ops.binning import _pack_t_single
+            from h2o3_tpu.ops.hist_adaptive import TILE
+            rows_c = dev.shape[0]
+            pad_r = (-rows_c) % TILE
+            # the relayout is a SECOND device allocation (row-major
+            # upload + padded transpose briefly coexist): admit the
+            # padded buffer against the budget too, or a window sized
+            # to exactly R chunks can OOM on the memory-pressure path
+            memman.manager().request(
+                (rows_c + pad_r) * self.F * self._x_itemsize)
+            return _pack_t_single(dev, W=self.packed_W, tile=TILE)
+        return dev
+
     def _ensure_resident(self, k: int, need_x: bool = True
                          ) -> Dict[str, object]:
         st = self._res.get(k)
@@ -200,7 +243,8 @@ class StreamedChunks:
             # X deferred until a pass actually reads features — a
             # depth-0 stump train never uploads it at all
             s, e = self.spans[k]
-            st["X"] = self._put(self.X_host[s:e], resident=True)
+            st["X"] = self._kernel_operand(
+                self._put(self.X_host[s:e], resident=True))
         return st
 
     # -- per-tree state --------------------------------------------------
@@ -258,7 +302,9 @@ class StreamedChunks:
                     or k >= self.C):
                 return
             s, e = self.spans[k]
-            pending[k] = self._put(self.X_host[s:e])
+            # relayout rides the async dispatch queue right behind the
+            # DMA, so it too drains under the previous chunk's kernel
+            pending[k] = self._kernel_operand(self._put(self.X_host[s:e]))
 
         for k in range(min(_PREFETCH_DEPTH, self.C)):
             stage(k)
@@ -305,4 +351,10 @@ class StreamedChunks:
                 # the per-tree steady-state number isn't distorted by
                 # amortizing it over a small ntrees
                 "h2d_resident_bytes": int(self.h2d_resident_bytes),
-                "device_footprint_bytes": int(self.rows * self.F * 4)}
+                # footprint of the representation ACTUALLY resident:
+                # 1-2 byte codes in packed mode, f32 otherwise — the
+                # bench guard's once-per-tree ratio stays honest
+                "device_footprint_bytes": int(
+                    self.rows * self.F * self._x_itemsize),
+                "packed_codes": self.packed_W is not None,
+                "x_itemsize": self._x_itemsize}

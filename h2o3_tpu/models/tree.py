@@ -95,7 +95,8 @@ def _leaf_value(g, h, cfg: TreeConfig):
     return -g / (h + lam + 1e-12)
 
 
-def _find_splits(trip, cfg: TreeConfig, col_mask, mono=None):
+def _find_splits(trip, cfg: TreeConfig, col_mask, mono=None,
+                 max_bin=None):
     """Best split per node from a (g, h, w) histogram triple, each
     [N, F', B'] with F' >= n_features and B' >= n_bins+1 (the pallas
     kernel's padded layout; trailing features/bins are zero).
@@ -105,6 +106,14 @@ def _find_splits(trip, cfg: TreeConfig, col_mask, mono=None):
     constraints: a candidate split on feature f with mono[f]=c is invalid
     unless c·(left child value) <= c·(right child value) — the same
     pruning hex/tree/DTree.java applies via Constraints.
+
+    ``max_bin`` restricts candidates to t in 1..max_bin-1 when the
+    histogram's lane width exceeds the REAL bin count (the packed path:
+    B = W-1 lanes, codes occupy max_bin real bins). Without the mask
+    the empty lanes admit an 'all non-NA left vs NA right' candidate
+    the unpacked global-sketch scan cannot express — masking keeps
+    packed and unpacked candidate grids IDENTICAL, so f32 trees stay
+    bit-identical on NA-heavy frames too.
 
     Returns (gain, feat, bin, na_left, g_tot, h_tot, w_tot, vl, vr) per
     node, where vl/vr are the SELECTED split's unclipped child values
@@ -145,6 +154,10 @@ def _find_splits(trip, cfg: TreeConfig, col_mask, mono=None):
     all_gains = jnp.stack([gains_nr, gains_nl], axis=-1)             # [N,F,B-1,2]
     cm = col_mask if col_mask.ndim == 2 else col_mask[None, :]
     all_gains = jnp.where(cm[:, :, None, None], all_gains, NEG_INF)
+    if max_bin is not None and max_bin - 1 < B - 1:
+        tmask = jnp.arange(B - 1) < (max_bin - 1)
+        all_gains = jnp.where(tmask[None, None, :, None], all_gains,
+                              NEG_INF)
     N, F = all_gains.shape[0], all_gains.shape[1]
     flat = all_gains.reshape(N, -1)
     best = jnp.argmax(flat, axis=1)
@@ -186,7 +199,7 @@ def _axis_size(axis_name) -> int:
 
 
 def _find_splits_sharded(trip, cfg: TreeConfig, col_mask, mono=None,
-                         model_axis=None):
+                         model_axis=None, max_bin=None):
     """Split search sharded over the mesh 'model' axis: each model shard
     scans a contiguous FEATURE BLOCK of the (already data-psum'd)
     histograms with the ordinary :func:`_find_splits`, and the global
@@ -200,10 +213,12 @@ def _find_splits_sharded(trip, cfg: TreeConfig, col_mask, mono=None,
     contiguous feature ranges, so "first max wins" picks the same split
     — sharded and unsharded trees stay bit-identical."""
     if model_axis is None:
-        return _find_splits(trip, cfg, col_mask, mono=mono)
+        return _find_splits(trip, cfg, col_mask, mono=mono,
+                            max_bin=max_bin)
     n_model = _axis_size(model_axis)
     if n_model == 1:
-        return _find_splits(trip, cfg, col_mask, mono=mono)
+        return _find_splits(trip, cfg, col_mask, mono=mono,
+                            max_bin=max_bin)
     from dataclasses import replace as dc_replace
     B = cfg.n_bins
     F = cfg.n_features
@@ -232,7 +247,7 @@ def _find_splits_sharded(trip, cfg: TreeConfig, col_mask, mono=None,
             jnp.pad(mono, (0, Fp - F)), start, F_loc)
     cfg_l = dc_replace(cfg, n_features=F_loc)
     (bg, bf, bb, bnl, _gt, _ht, _wt, vl, vr, wl, wr) = _find_splits(
-        trip_l, cfg_l, cm_l, mono=mono_l)
+        trip_l, cfg_l, cm_l, mono=mono_l, max_bin=max_bin)
     cand = jnp.stack([bg, (start + bf).astype(jnp.float32),
                       bb.astype(jnp.float32), bnl.astype(jnp.float32),
                       vl, vr, wl, wr], axis=-1)      # [N, 8]
@@ -436,6 +451,58 @@ def grow_tree(codes, g, h, w, cfg: TreeConfig, col_mask, axis_name=None,
     return tree, nid
 
 
+# histogram_type values the fused ADAPTIVE kernel serves — ONE spelling
+# for the GBM/DRF packed-path gating and its infeasible-fallback rule
+# (GBM additionally allows 'random', which only the adaptive kernel's
+# per-tree grid phase can honor)
+ADAPTIVE_HIST_TYPES = ("uniform_adaptive", "uniform", "auto", "round_robin")
+
+
+def packed_codes_requested(params) -> bool:
+    """Packed binned-code hot-path gate (GBM/DRF ``packed_codes``
+    param). 'auto' (default) packs wherever the binned pallas kernel
+    runs — TPU, or the H2O3_PALLAS_INTERPRET escape — making int8/int16
+    codes the default TPU hot loop; True forces the packed path
+    everywhere (the scatter reference carries it on CPU — parity
+    tests); False keeps the per-node adaptive f32 kernel."""
+    v = params.get("packed_codes", "auto")
+    if isinstance(v, str):
+        v = v.lower()
+    if v in ("auto", None):
+        from h2o3_tpu.ops.hist_adaptive import pallas_interpret
+        return jax.default_backend() == "tpu" or pallas_interpret()
+    return v in (True, "true", "1")
+
+
+def packed_bins_upper_bound(spec, params) -> int:
+    """Upper bound on the global sketch's effective bin count, from the
+    cat domains alone (numeric features never exceed nbins; identity
+    cats need their cardinality, grouped cats at most nbins_cats+1).
+    Lets the packed gating reject infeasible configs BEFORE paying the
+    O(rows·F) sketch+digitise — binned_feasible is monotone in n_bins,
+    so 'upper bound feasible' implies 'actual feasible'."""
+    nbins = int(params["nbins"])
+    nc = int(params.get("nbins_cats", 1024))
+    cards = [len(spec.cat_domains.get(n, ())) for n, c in
+             zip(spec.names, spec.is_cat) if c]
+    mc = max(cards, default=0)
+    return max(nbins, min(mc, nc + 1), 2)
+
+
+def binned_feasible(n_bins: int, n_features: int, max_depth: int) -> bool:
+    """Whether the packed binned kernel's deepest level fits VMEM —
+    the adaptive_feasible bound applied to W = pick_W(n_bins) (scratch
+    + output block both hold [3·2^(D-1), F·W] f32). Past the 254-bin
+    lane cap or the VMEM bound, the matmul/scatter global-sketch path
+    takes over."""
+    from h2o3_tpu.ops.hist_adaptive import pick_W
+    if n_bins > 254:
+        return False
+    W = pick_W(n_bins)
+    n_deep = 2 ** max(max_depth - 1, 0)
+    return 2 * 3 * n_deep * n_features * W * 4 <= 96 * 2 ** 20
+
+
 def _adaptive_n_bins_eff(spec, params) -> int:
     """Effective bin count sizing the kernel's lane width W: enums want
     identity bins (card-1), capped by nbins_cats and the 254-lane max."""
@@ -550,12 +617,7 @@ def grow_tree_adaptive(X, g, h, w, cfg: TreeConfig, col_mask, root_lo,
     # choices BETWEEN statistically equivalent candidates; AUC delta
     # 2.8e-5. Deepest-level leaf values come from the same histograms,
     # so they carry the same precision choice (exact under 'float32').
-    if cfg.histogram_precision in ("float32", "f32"):
-        mxu_dtype = jnp.float32
-    elif cfg.histogram_precision in ("bfloat16", "bf16"):
-        mxu_dtype = jnp.bfloat16
-    else:  # auto
-        mxu_dtype = jnp.float32 if X.shape[0] < (1 << 18) else jnp.bfloat16
+    mxu_dtype = _hist_mxu_dtype(cfg, X.shape[0])
     if nb_f is None:
         nb_f = jnp.full(F, float(min(cfg.n_bins, W - 2)), jnp.float32)
     else:
@@ -723,6 +785,176 @@ def grow_tree_adaptive(X, g, h, w, cfg: TreeConfig, col_mask, root_lo,
     node_w = node_w.at[idxD].set(wD)
 
     tree = {"feat": feat, "thr": thr_arr, "na_left": na_left,
+            "is_split": is_split, "value": value, "gain": gain_arr,
+            "node_w": node_w}
+    return tree, nid
+
+
+def _hist_mxu_dtype(cfg: TreeConfig, rows: int):
+    """Histogram contraction precision shared by every grower:
+    ``histogram_precision`` forces f32 (exact 6-pass HIGHEST) or bf16;
+    'auto' picks exact f32 below 2^18 rows where the ~1.4x hist cost
+    is negligible, bf16 at scale (deviation bound in
+    ops/hist_adaptive.py and README)."""
+    if cfg.histogram_precision in ("float32", "f32"):
+        return jnp.float32
+    if cfg.histogram_precision in ("bfloat16", "bf16"):
+        return jnp.bfloat16
+    return jnp.float32 if rows < (1 << 18) else jnp.bfloat16
+
+
+def grow_tree_binned(codes_rm, g, h, w, cfg: TreeConfig, col_mask,
+                     axis_name=None, key=None, mono=None, sets=None,
+                     model_axis=None, ct=None):
+    """Build one tree on PACKED global-sketch bin codes — the
+    XGBoost ``tree_method=hist`` shape made TPU-native: features are
+    binned ONCE per train (ops/binning.pack_codes), the int8/int16
+    code matrix is the representation the hot loop computes on, split
+    thresholds thread through the levels as BIN INDICES, and finalize
+    unbins to raw thresholds (bins_to_thresholds_stacked reads
+    ``tree["split_bin"]``).
+
+    ``codes_rm`` is [rows, F] int8/int16 with NA = the reserved bin
+    W-1; ``ct`` is the pre-transposed [F, rows_p] pallas operand
+    (pad = W-1). cfg.n_bins is the REAL bin count (codes in
+    [0, n_bins-1]); the kernel lane width is W = pick_W(n_bins) and
+    the split search scans W-1 real lanes with the NA lane at W-1
+    (lanes beyond n_bins are empty; a selected split bin past the
+    edge list unbins to +inf = all non-NA left).
+
+    Per level the fused binned kernel routes rows by integer
+    code-vs-bin compare and builds the histogram one-hot straight off
+    the codes — no lo/inv rebinning anywhere, so the hot loop moves
+    1-2 bytes/value instead of 4."""
+    from h2o3_tpu.ops.hist_adaptive import (binned_level,
+                                            binned_route_only,
+                                            pallas_interpret, pick_W)
+    from dataclasses import replace as dc_replace
+
+    D = cfg.max_depth
+    M = cfg.n_nodes
+    rows, F = codes_rm.shape
+    W = pick_W(cfg.n_bins)
+    method = (cfg.hist_method if cfg.hist_method in ("pallas", "scatter")
+              else "scatter" if cfg.hist_method == "matmul" else "auto")
+    mxu_dtype = _hist_mxu_dtype(cfg, rows)
+    find_cfg = dc_replace(cfg, n_bins=W - 1)   # NA lane at W-1
+
+    feat = jnp.full(M, -1, jnp.int32)
+    split_bin = jnp.zeros(M, jnp.int32)
+    na_left = jnp.zeros(M, bool)
+    is_split = jnp.zeros(M, bool)
+    value = jnp.zeros(M, jnp.float32)
+    gain_arr = jnp.zeros(M, jnp.float32)
+    node_w = jnp.zeros(M, jnp.float32)
+
+    ghw = jnp.stack([g, h, w]).astype(jnp.float32)
+    nid = jnp.zeros(rows, jnp.int32)
+    zeros1 = jnp.zeros(1, jnp.float32)
+    tables = (zeros1, zeros1, zeros1, zeros1)
+    lo_b = jnp.full(1, -BIGV)
+    hi_b = jnp.full(1, BIGV)
+    allowed = (jnp.ones((1, F), bool) if sets is not None else None)
+
+    on_tpu = (method == "pallas"
+              or (method == "auto" and (jax.default_backend() == "tpu"
+                                        or pallas_interpret())))
+    # opt-in int8-ghw fixed-point contraction — same contract as the
+    # adaptive path (H2O3_HIST_I8=1/2=terms, ops/hist_adaptive.py)
+    qs = None
+    i8_terms = int(_os.environ.get("H2O3_HIST_I8", "0") or 0)
+    if (i8_terms and on_tpu and mxu_dtype == jnp.bfloat16
+            and rows <= 16_000_000):
+        from h2o3_tpu.ops.hist_adaptive import quantize_ghw_i8
+        qs = quantize_ghw_i8(ghw, terms=i8_terms)
+
+    if D == 0:
+        g0 = g * (w > 0)
+        h0 = h * (w > 0)
+        gs, hs, ws = g0.sum(), h0.sum(), w.sum()
+        if axis_name is not None:
+            gs = jax.lax.psum(gs, axis_name)
+            hs = jax.lax.psum(hs, axis_name)
+            ws = jax.lax.psum(ws, axis_name)
+        value = value.at[0].set(_leaf_value(gs, hs, cfg))
+        node_w = node_w.at[0].set(ws)
+        tree = {"feat": feat, "split_bin": split_bin, "na_left": na_left,
+                "is_split": is_split, "value": value, "gain": gain_arr,
+                "node_w": node_w}
+        return tree, nid
+
+    vl_s = vr_s = wl_s = wr_s = None
+    for d in range(D):
+        N = 2 ** d
+        base = N - 1
+        nid, hist = binned_level(codes_rm, nid, ghw, tables,
+                                 N // 2 if d else 0, N, base, W, method,
+                                 mxu_dtype=mxu_dtype, ct=ct, qs=qs)
+        if axis_name is not None:
+            hist = jax.lax.psum(hist, axis_name)
+        trip = (hist[0], hist[1], hist[2])
+        level_mask = col_mask
+        mt_d = _level_mtries(cfg, d, F)
+        if mt_d > 0 and key is not None:
+            u = jax.random.uniform(jax.random.fold_in(key, d), (N, F))
+            u = jnp.where(col_mask[None, :], u, 2.0)
+            kth = jnp.sort(u, axis=1)[:, min(mt_d, F) - 1]
+            level_mask = (u <= kth[:, None]) & col_mask[None, :]
+        if allowed is not None:
+            lm2 = level_mask if level_mask.ndim == 2 else level_mask[None, :]
+            level_mask = lm2 & allowed
+        bg, bf, bb, bnl, gt, ht, wt, vl_s, vr_s, wl_s, wr_s = \
+            _find_splits_sharded(trip, find_cfg, level_mask, mono=mono,
+                                 model_axis=model_axis,
+                                 max_bin=cfg.n_bins)
+        can = (bg > jnp.maximum(cfg.min_split_improvement, 0.0)) & (wt > 0)
+        nidx = jnp.arange(N)
+        idx = base + nidx
+        feat = feat.at[idx].set(jnp.where(can, bf, -1))
+        split_bin = split_bin.at[idx].set(bb)
+        na_left = na_left.at[idx].set(bnl)
+        is_split = is_split.at[idx].set(can)
+        value = value.at[idx].set(
+            jnp.clip(_leaf_value(gt, ht, cfg), lo_b, hi_b))
+        gain_arr = gain_arr.at[idx].set(jnp.where(can, bg, 0.0))
+        node_w = node_w.at[idx].set(wt)
+        if mono is not None:
+            lo_b, hi_b = _child_bounds(lo_b, hi_b, vl_s, vr_s, mono[bf], can)
+        else:
+            lo_b = jnp.repeat(lo_b, 2)
+            hi_b = jnp.repeat(hi_b, 2)
+        if allowed is not None:
+            allowed = _next_allowed(allowed, sets, bf, can)
+        # next level's routing tables: the split BIN rides where the
+        # adaptive path carries a raw threshold — an exact
+        # integer-valued float through the kernel's bf16-split LUT
+        tables = (jnp.maximum(bf, 0).astype(jnp.float32),
+                  bb.astype(jnp.float32),
+                  bnl.astype(jnp.float32), can.astype(jnp.float32))
+
+    # deepest level: route, then EXACT per-leaf (g,h,w) segment totals —
+    # the same tail as grow_tree, so packed and unpacked f32 trees are
+    # bit-identical INCLUDING leaf values (and under bf16 the leaves
+    # stay exact, like the reference's driver-side leaf stats; the
+    # totals matmul is tiny next to a level kernel)
+    ND = 2 ** D
+    baseD = ND - 1
+    nid = binned_route_only(codes_rm, nid, tables, ND // 2, baseD, W,
+                            method, ct=ct)
+    localD = nid - baseD
+    inD = (localD >= 0) & (localD < ND)
+    lidD = jnp.clip(localD, 0, ND - 1)
+    gD, hD, wD = _segment_totals(lidD, inD, g, h, w, ND)
+    if axis_name is not None:
+        gD = jax.lax.psum(gD, axis_name)
+        hD = jax.lax.psum(hD, axis_name)
+        wD = jax.lax.psum(wD, axis_name)
+    idxD = baseD + jnp.arange(ND)
+    value = value.at[idxD].set(
+        jnp.clip(_leaf_value(gD, hD, cfg), lo_b, hi_b))
+    node_w = node_w.at[idxD].set(wD)
+
+    tree = {"feat": feat, "split_bin": split_bin, "na_left": na_left,
             "is_split": is_split, "value": value, "gain": gain_arr,
             "node_w": node_w}
     return tree, nid
@@ -982,7 +1214,9 @@ def collect_chunk_trees(all_trees, M: int, edges) -> dict:
     tail slicing, and the bin→raw-threshold conversion. Returns host
     arrays [T_active·K, M] keyed feat/na_left/is_split/value/gain/
     node_w/thr."""
-    host = jax.device_get([t for t, _ in all_trees])
+    from h2o3_tpu import telemetry
+    host = telemetry.device_get([t for t, _ in all_trees],
+                                pipeline="train")
     acts = [n for _, n in all_trees]
 
     def cat(kk):
@@ -999,6 +1233,38 @@ def collect_chunk_trees(all_trees, M: int, edges) -> dict:
         out["thr"] = bins_to_thresholds_stacked(cat("split_bin"),
                                                 out["feat"], edges)
     return out
+
+
+def _streamed_stump(chunks, dist, lr, cfg: TreeConfig):
+    """Depth-0 streamed tree shared by the adaptive and binned streamed
+    growers: exact (g,h,w) totals over chunks -> one root leaf, applied
+    without ever uploading X (need_x=False passes)."""
+    from h2o3_tpu import telemetry
+    gs = hs = ws = 0.0
+    for ch in chunks.level_pass(need_x=False):
+        ghw = ch.ghw(dist)
+        # ONE counted fetch of the three chunk scalars
+        s3 = telemetry.device_get(
+            (ghw[0].sum(), ghw[1].sum(), ghw[2].sum()),
+            pipeline="train")
+        gs += float(s3[0])
+        hs += float(s3[1])
+        ws += float(s3[2])
+    v0 = float(telemetry.device_get(
+        _leaf_value(jnp.float32(gs), jnp.float32(hs), cfg),
+        pipeline="train"))
+    tree = {"feat": np.full(1, -1, np.int32),
+            "thr": np.zeros(1, np.float32),
+            "na_left": np.zeros(1, bool),
+            "is_split": np.zeros(1, bool),
+            "value": np.array([v0], np.float32),
+            "gain": np.zeros(1, np.float32),
+            "node_w": np.array([ws], np.float32)}
+    v0_dev = jnp.asarray(np.array([v0], np.float32))
+    for ch in chunks.level_pass(need_x=False):
+        ch.apply_leaf(jnp.float32(lr), v0_dev,
+                      jnp.zeros(ch.e - ch.s, jnp.int32))
+    return tree
 
 
 def grow_tree_adaptive_streamed(chunks, dist, lr, cfg: TreeConfig,
@@ -1041,39 +1307,13 @@ def grow_tree_adaptive_streamed(chunks, dist, lr, cfg: TreeConfig,
     # histogram contraction precision: same rule as the dense grower,
     # sized by the frame's PADDED row count like the dense path's
     # X.shape[0] so the choice agrees at the 2^18 boundary
-    if cfg.histogram_precision in ("float32", "f32"):
-        mxu_dtype = jnp.float32
-    elif cfg.histogram_precision in ("bfloat16", "bf16"):
-        mxu_dtype = jnp.bfloat16
-    else:
-        mxu_dtype = (jnp.float32 if chunks.padded_rows < (1 << 18)
-                     else jnp.bfloat16)
+    mxu_dtype = _hist_mxu_dtype(cfg, chunks.padded_rows)
 
     chunks.begin_tree(key, sample_rate)
 
     if D == 0:
-        # degenerate stump (the dense grower's D==0 branch): exact
-        # totals over chunks -> one root leaf
-        gs = hs = ws = 0.0
-        for ch in chunks.level_pass(need_x=False):
-            ghw = ch.ghw(dist)
-            gs += float(jax.device_get(ghw[0].sum()))
-            hs += float(jax.device_get(ghw[1].sum()))
-            ws += float(jax.device_get(ghw[2].sum()))
-        v0 = float(jax.device_get(_leaf_value(jnp.float32(gs),
-                                              jnp.float32(hs), cfg)))
-        tree = {"feat": np.full(1, -1, np.int32),
-                "thr": np.zeros(1, np.float32),
-                "na_left": np.zeros(1, bool),
-                "is_split": np.zeros(1, bool),
-                "value": np.array([v0], np.float32),
-                "gain": np.zeros(1, np.float32),
-                "node_w": np.array([ws], np.float32)}
-        v0_dev = jnp.asarray(np.array([v0], np.float32))
-        for ch in chunks.level_pass(need_x=False):
-            ch.apply_leaf(jnp.float32(lr), v0_dev,
-                          jnp.zeros(ch.e - ch.s, jnp.int32))
-        return tree
+        # degenerate stump (the dense grower's D==0 branch)
+        return _streamed_stump(chunks, dist, lr, cfg)
 
     feat = np.full(M, -1, np.int32)
     thr_arr = np.zeros(M, np.float32)
@@ -1140,13 +1380,21 @@ def grow_tree_adaptive_streamed(chunks, dist, lr, cfg: TreeConfig,
                                   lo_sel + bb.astype(jnp.float32)
                                   / jnp.maximum(inv_sel, 1e-30), BIG), 0.0)
         idx = base + np.arange(N)
-        feat[idx] = np.asarray(jax.device_get(jnp.where(can, bf, -1)))
-        thr_arr[idx] = np.asarray(jax.device_get(thr))
-        na_left[idx] = np.asarray(jax.device_get(bnl))
-        is_split[idx] = np.asarray(jax.device_get(can))
-        value[idx] = np.asarray(jax.device_get(_leaf_value(gt, ht, cfg)))
-        gain_arr[idx] = np.asarray(jax.device_get(jnp.where(can, bg, 0.0)))
-        node_w[idx] = np.asarray(jax.device_get(wt_))
+        # ONE counted pytree fetch per level (these were seven raw
+        # device_gets — transfer-seam burn-down)
+        from h2o3_tpu import telemetry
+        lvl = telemetry.device_get(
+            {"feat": jnp.where(can, bf, -1), "thr": thr, "nal": bnl,
+             "can": can, "val": _leaf_value(gt, ht, cfg),
+             "gain": jnp.where(can, bg, 0.0), "w": wt_},
+            pipeline="train")
+        feat[idx] = np.asarray(lvl["feat"])
+        thr_arr[idx] = np.asarray(lvl["thr"])
+        na_left[idx] = np.asarray(lvl["nal"])
+        is_split[idx] = np.asarray(lvl["can"])
+        value[idx] = np.asarray(lvl["val"])
+        gain_arr[idx] = np.asarray(lvl["gain"])
+        node_w[idx] = np.asarray(lvl["w"])
         tables = (jnp.maximum(bf, 0).astype(jnp.float32), thr,
                   bnl.astype(jnp.float32), can.astype(jnp.float32))
         whist = hist[2][..., :W - 1]
@@ -1171,10 +1419,11 @@ def grow_tree_adaptive_streamed(chunks, dist, lr, cfg: TreeConfig,
     # deepest level: route chunks, leaf values from last selected splits
     ND = 2 ** D
     baseD = ND - 1
-    vD_dev = jnp.stack([vl_s, vr_s], axis=1).reshape(ND)
-    wD = np.asarray(jax.device_get(
-        jnp.stack([wl_s, wr_s], axis=1).reshape(ND)))
-    value[baseD:] = np.asarray(jax.device_get(vD_dev))
+    from h2o3_tpu import telemetry
+    vD_h, wD = (np.asarray(v) for v in telemetry.device_get(
+        (jnp.stack([vl_s, vr_s], axis=1).reshape(ND),
+         jnp.stack([wl_s, wr_s], axis=1).reshape(ND)), pipeline="train"))
+    value[baseD:] = vD_h
     node_w[baseD:] = wD
     tree = {"feat": feat, "thr": thr_arr, "na_left": na_left,
             "is_split": is_split, "value": value, "gain": gain_arr,
@@ -1187,4 +1436,142 @@ def grow_tree_adaptive_streamed(chunks, dist, lr, cfg: TreeConfig,
     for ch in chunks.level_pass():
         nid2 = route_only(ch.X, ch.nid, tables, ND // 2, baseD)
         ch.apply_leaf(lr_t, value_dev, nid2)
+    return tree
+
+
+def grow_tree_binned_streamed(chunks, dist, lr, cfg: TreeConfig, edges,
+                              key=None, sample_rate: float = 1.0,
+                              col_mask=None):
+    """Host-chunked PACKED tree build: the streamed counterpart of
+    :func:`grow_tree_binned`. The resident-window representation is the
+    int8/int16 CODE matrix (models/streaming.py ``packed_W`` mode), so
+    the memman budget fits ~4x more rows resident than f32 X and
+    overflow-chunk H2D moves codes, not floats. Split thresholds
+    thread as bin indices; the returned tree carries RAW thresholds
+    (unbinned from ``edges`` here, once, at tree end) so the streamed
+    caller's finalize shape matches the adaptive streamed grower's."""
+    from h2o3_tpu import telemetry
+    from h2o3_tpu.ops.hist_adaptive import (binned_level,
+                                            binned_route_only, pick_W)
+    from dataclasses import replace as dc_replace
+
+    rows, F = chunks.rows, chunks.F
+    D = cfg.max_depth
+    M = cfg.n_nodes
+    W = pick_W(cfg.n_bins)
+    assert chunks.packed_W == W, (chunks.packed_W, W)
+    find_cfg = dc_replace(cfg, n_bins=W - 1)
+    if col_mask is None:
+        col_mask = jnp.ones(F, bool)
+    mxu_dtype = _hist_mxu_dtype(cfg, chunks.padded_rows)
+
+    chunks.begin_tree(key, sample_rate)
+
+    if D == 0:
+        return _streamed_stump(chunks, dist, lr, cfg)
+
+    feat = np.full(M, -1, np.int32)
+    sbin_arr = np.zeros(M, np.int32)
+    na_left = np.zeros(M, bool)
+    is_split = np.zeros(M, bool)
+    value = np.zeros(M, np.float32)
+    gain_arr = np.zeros(M, np.float32)
+    node_w = np.zeros(M, np.float32)
+
+    zeros1 = jnp.zeros(1, jnp.float32)
+    tables = (zeros1, zeros1, zeros1, zeros1)
+    vl_s = vr_s = wl_s = wr_s = None
+    trans = chunks.kernel_layout == "t"
+
+    for d in range(D):
+        N = 2 ** d
+        base = N - 1
+        hist = None
+        perf_acc = getattr(chunks, "perf_acc", None)
+        for ch in chunks.level_pass():
+            ghw = ch.ghw(dist)
+            rm_arg = None if trans else ch.X
+            ct_arg = ch.X if trans else None
+            nid2, h_c = binned_level(rm_arg, ch.nid, ghw, tables,
+                                     N // 2 if d else 0, N, base, W,
+                                     mxu_dtype=mxu_dtype, ct=ct_arg)
+            if perf_acc is not None:
+                # streamed-level jit seam, binned flavour: one
+                # trace+lower per (chunk shape, level) key — the
+                # captured bytes carry the packed representation's
+                # 1-2 byte/value hot-loop traffic
+                import time as _time
+                from functools import partial as _partial
+
+                from h2o3_tpu.telemetry import costmodel
+                t_cap0 = _time.perf_counter()
+                perf_acc.add(costmodel.traced_cost(
+                    ("gbm.stream_level_binned", ch.X.shape, int(N),
+                     int(W), str(mxu_dtype.__name__)),
+                    _partial(binned_level, n_prev=N // 2 if d else 0,
+                             n_nodes=N, level_base=base, W=W,
+                             mxu_dtype=mxu_dtype),
+                    rm_arg, ch.nid, ghw, tables, ct=ct_arg))
+                perf_acc.note_capture_seconds(
+                    _time.perf_counter() - t_cap0)
+            ch.put_nid(nid2)
+            hist = h_c if hist is None else hist + h_c
+        trip = (hist[0], hist[1], hist[2])
+        bg, bf, bb, bnl, gt, ht, wt_, vl_s, vr_s, wl_s, wr_s = _find_splits(
+            trip, find_cfg, col_mask, max_bin=cfg.n_bins)
+        can = (bg > jnp.maximum(cfg.min_split_improvement, 0.0)) & (wt_ > 0)
+        idx = base + np.arange(N)
+        # ONE counted pytree fetch per level (transfer-seam contract)
+        lvl = telemetry.device_get(
+            {"feat": jnp.where(can, bf, -1), "bin": bb, "nal": bnl,
+             "can": can, "val": _leaf_value(gt, ht, cfg),
+             "gain": jnp.where(can, bg, 0.0), "w": wt_},
+            pipeline="train")
+        feat[idx] = np.asarray(lvl["feat"])
+        sbin_arr[idx] = np.asarray(lvl["bin"])
+        na_left[idx] = np.asarray(lvl["nal"])
+        is_split[idx] = np.asarray(lvl["can"])
+        value[idx] = np.asarray(lvl["val"])
+        gain_arr[idx] = np.asarray(lvl["gain"])
+        node_w[idx] = np.asarray(lvl["w"])
+        tables = (jnp.maximum(bf, 0).astype(jnp.float32),
+                  bb.astype(jnp.float32),
+                  bnl.astype(jnp.float32), can.astype(jnp.float32))
+
+    # deepest level, two passes matching the dense binned tail: (A)
+    # route each chunk and accumulate EXACT per-leaf (g,h,w) segment
+    # totals; (B) apply leaf values — pass B reads the stored nids and
+    # never touches X, so per-tree X traffic is unchanged (D level
+    # passes + one route pass)
+    ND = 2 ** D
+    baseD = ND - 1
+    tot = None
+    for ch in chunks.level_pass():
+        rm_arg = None if trans else ch.X
+        ct_arg = ch.X if trans else None
+        nid2 = binned_route_only(rm_arg, ch.nid, tables, ND // 2, baseD,
+                                 W, ct=ct_arg)
+        ch.put_nid(nid2)
+        ghw = ch.ghw(dist)
+        localD = nid2 - baseD
+        inD = (localD >= 0) & (localD < ND)
+        lidD = jnp.clip(localD, 0, ND - 1)
+        t3 = _segment_totals(lidD, inD, ghw[0], ghw[1], ghw[2], ND)
+        tot = t3 if tot is None else tuple(a + b for a, b in zip(tot, t3))
+    vD_h, wD = (np.asarray(v) for v in telemetry.device_get(
+        (_leaf_value(tot[0], tot[1], cfg), tot[2]), pipeline="train"))
+    value[baseD:] = vD_h
+    node_w[baseD:] = wD
+    # unbin ONCE at tree end: bin-space splits -> raw thresholds, the
+    # same conversion the dense finalize applies (left <=> code < t
+    # <=> raw < edges[t-1]; past-the-edges bins -> +inf)
+    thr_arr = bins_to_thresholds_stacked(sbin_arr[None, :], feat[None, :],
+                                         edges)[0]
+    tree = {"feat": feat, "thr": thr_arr, "na_left": na_left,
+            "is_split": is_split, "value": value, "gain": gain_arr,
+            "node_w": node_w}
+    value_dev = jnp.asarray(value)
+    lr_t = jnp.float32(lr)
+    for ch in chunks.level_pass(need_x=False):
+        ch.apply_leaf(lr_t, value_dev, ch.nid)
     return tree

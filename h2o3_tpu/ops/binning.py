@@ -16,11 +16,35 @@ XLA). Split "bin t" means: left ⇔ code < t ⇔ raw < edges[t-1].
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import lru_cache, partial
 from typing import List, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+class PackedCodes(NamedTuple):
+    """Kernel-ready PACKED bin codes — the representation the training
+    hot path computes on (ops/hist_adaptive binned kernels). ``rm``
+    [rows, F] int8/int16 with the NA code remapped from ``n_bins`` to
+    the kernel's RESERVED LAST LANE ``W-1`` (predict_binned walks it
+    with na_bin=W-1); ``t`` [F, rows_p] same dtype, transposed and
+    tile-padded PER SHARD (pad value W-1 = all-NA rows) — the pallas
+    hot-loop operand, built once per train so the 1-2 byte/value codes
+    are what streams through HBM every level. ``t`` is None off-TPU
+    (the scatter reference reads ``rm``)."""
+    rm: jax.Array
+    t: Optional[jax.Array]
+    W: int
+
+    @property
+    def na_bin(self) -> int:
+        return self.W - 1
+
+    @property
+    def itemsize(self) -> int:
+        return jnp.dtype(self.rm.dtype).itemsize
 
 
 class CodesView(NamedTuple):
@@ -120,7 +144,8 @@ def _np_quantile_lerp(a: np.ndarray, b: np.ndarray, t: np.ndarray) -> np.ndarray
 
 def bin_matrix_device(X, names: Sequence[str], is_cat: Sequence[bool],
                       nrow: int, nbins: int = 255, nbins_cats: int = 1024,
-                      histogram_type: str = "quantiles_global") -> BinnedMatrix:
+                      histogram_type: str = "quantiles_global",
+                      with_t: bool = True) -> BinnedMatrix:
     """Device-side global sketch: the same edges as :func:`bin_matrix`
     (bit-exact — parity-tested) WITHOUT a ``device_get`` of the full X.
 
@@ -141,16 +166,19 @@ def bin_matrix_device(X, names: Sequence[str], is_cat: Sequence[bool],
     device path. A per-shard sketch merged with a psum would scale but
     is not bit-exact — the future lever."""
     import jax as _jax
+    from h2o3_tpu import telemetry
     from h2o3_tpu.parallel.mesh import current_mesh, n_data_shards
     if (_jax.default_backend() != "cpu"
             and n_data_shards(current_mesh()) > 1):
-        return bin_matrix(np.asarray(jax.device_get(X)), names, is_cat,
-                          nrow, nbins=nbins, nbins_cats=nbins_cats,
-                          histogram_type=histogram_type)
+        return bin_matrix(np.asarray(telemetry.device_get(
+            X, pipeline="train")), names, is_cat,
+            nrow, nbins=nbins, nbins_cats=nbins_cats,
+            histogram_type=histogram_type, with_t=with_t)
     F = X.shape[1]
     Xs, nfin_d, fmin_d, fmax_d = _sketch_stats(X, jnp.int32(nrow))
-    nfin, fmin, fmax = (np.asarray(jax.device_get(v))
-                        for v in (nfin_d, fmin_d, fmax_d))
+    # ONE counted fetch of the O(F) sketch stats (transfer-seam)
+    nfin, fmin, fmax = (np.asarray(v) for v in telemetry.device_get(
+        (nfin_d, fmin_d, fmax_d), pipeline="train"))
     uniform = histogram_type in ("uniform_adaptive", "uniform")
     # per-feature quantile grids (numeric: nbins; over-wide cats:
     # nbins_cats) — build one padded rank-index matrix for a single gather
@@ -179,8 +207,9 @@ def bin_matrix_device(X, names: Sequence[str], is_cat: Sequence[bool],
                 continue
             lo_idx[: len(virt), f] = np.floor(virt).astype(np.int32)
             hi_idx[: len(virt), f] = np.ceil(virt).astype(np.int32)
-        a, b = (np.asarray(jax.device_get(v)) for v in _gather_rank_pairs(
-            Xs, jnp.asarray(lo_idx), jnp.asarray(hi_idx)))
+        a, b = (np.asarray(v) for v in telemetry.device_get(
+            _gather_rank_pairs(Xs, jnp.asarray(lo_idx),
+                               jnp.asarray(hi_idx)), pipeline="train"))
         for f, virt in enumerate(qgrids):
             if virt is None:
                 continue
@@ -213,7 +242,8 @@ def bin_matrix_device(X, names: Sequence[str], is_cat: Sequence[bool],
         raise ValueError(
             f"effective bin count {n_bins_eff} exceeds the 14-bit routing "
             f"limit; lower nbins_cats (reference default is 1024)")
-    codes = make_codes_view(digitize_with_edges(X, edges, n_bins_eff))
+    codes = make_codes_view(digitize_with_edges(X, edges, n_bins_eff),
+                            with_t=with_t)
     return BinnedMatrix(codes=codes, n_bins=n_bins_eff, edges=edges,
                         names=list(names), is_categorical=list(is_cat),
                         nrow=nrow)
@@ -221,7 +251,8 @@ def bin_matrix_device(X, names: Sequence[str], is_cat: Sequence[bool],
 
 def bin_matrix(X, names: Sequence[str], is_cat: Sequence[bool], nrow: int,
                nbins: int = 255, nbins_cats: int = 1024,
-               histogram_type: str = "quantiles_global") -> BinnedMatrix:
+               histogram_type: str = "quantiles_global",
+               with_t: bool = True) -> BinnedMatrix:
     """Digitise a dense [padded_rows, F] float matrix (NaN = NA) into codes.
 
     Categorical columns with cardinality <= nbins_cats use identity binning
@@ -233,6 +264,20 @@ def bin_matrix(X, names: Sequence[str], is_cat: Sequence[bool], nrow: int,
     beyond nbins_cats fall back to quantile grouping of the code space.
     """
     X_host = np.asarray(X, dtype=np.float32)
+    edges, n_bins_eff = _edges_host(X_host, nrow, is_cat, nbins,
+                                    nbins_cats, histogram_type)
+    codes = make_codes_view(digitize_with_edges(X, edges, n_bins_eff),
+                            with_t=with_t)
+    return BinnedMatrix(codes=codes, n_bins=n_bins_eff, edges=edges,
+                        names=list(names), is_categorical=list(is_cat),
+                        nrow=nrow)
+
+
+def _edges_host(X_host: np.ndarray, nrow: int, is_cat: Sequence[bool],
+                nbins: int, nbins_cats: int, histogram_type: str):
+    """The host edge rules shared by :func:`bin_matrix` and the
+    memory-pressure sketch (:func:`digitize_codes_host`). Returns
+    (edges, n_bins_eff)."""
     F = X_host.shape[1]
     edge_fn = (uniform_edges if histogram_type in ("uniform_adaptive", "uniform")
                else quantile_edges)
@@ -257,27 +302,75 @@ def bin_matrix(X, names: Sequence[str], is_cat: Sequence[bool], nrow: int,
         raise ValueError(
             f"effective bin count {n_bins_eff} exceeds the 14-bit routing "
             f"limit; lower nbins_cats (reference default is 1024)")
-    codes = make_codes_view(digitize_with_edges(X, edges, n_bins_eff))
-    return BinnedMatrix(codes=codes, n_bins=n_bins_eff, edges=edges,
-                        names=list(names), is_categorical=list(is_cat),
-                        nrow=nrow)
+    return edges, n_bins_eff
 
 
-def make_codes_view(codes_rm, tile: int = 2048, mesh=None) -> CodesView:
+def digitize_codes_host(X_host, edges: List[np.ndarray], n_bins_eff: int):
+    """Host digitise of precomputed edges straight to the packed kernel
+    convention (NA = reserved bin W-1, dtype from
+    hist_adaptive.code_dtype so host and device packing can never
+    diverge) — the memory-pressure half of the streamed packed path:
+    the full X never uploads. Searchsorts the same inf-PADDED edge
+    matrix as the device :func:`digitize_with_edges`, so +inf values
+    land in the shared lane ``max_e`` on every feature (bit-matching
+    the dense packed codes — a per-feature unpadded searchsorted would
+    merge +inf with the top finite bin on short-edge features and
+    break streamed-vs-dense parity AND train-vs-score routing).
+    Column-at-a-time so the temporaries stay O(rows). Returns
+    (codes [rows, F], W)."""
+    from h2o3_tpu.ops.hist_adaptive import code_dtype, pick_W
+    X_host = np.asarray(X_host, dtype=np.float32)
+    W = pick_W(n_bins_eff)
+    np_dt = np.dtype(code_dtype(W))
+    rows, F = X_host.shape
+    max_e = max((len(e) for e in edges), default=0)
+    emat = np.full((F, max(max_e, 1)), np.inf, dtype=np.float32)
+    for f, e in enumerate(edges):
+        emat[f, : len(e)] = e
+    codes = np.empty((rows, F), np_dt)
+    for f in range(F):
+        col = X_host[:, f]
+        c = np.searchsorted(emat[f], col, side="right")
+        codes[:, f] = np.where(np.isnan(col), W - 1, c).astype(np_dt)
+    return codes, W
+
+
+def packed_codes_record(enabled: bool, dtype=None, W: int = None,
+                        bytes_per_value: int = None,
+                        n_bins: int = None) -> dict:
+    """The ONE spelling of ``model.output['packed_codes']`` — GBM dense,
+    GBM streamed and DRF all emit it through here so bench.py /
+    profile_train.py key parsing can never meet a drifted copy."""
+    if not enabled:
+        return {"enabled": False}
+    return {"enabled": True, "dtype": str(np.dtype(dtype)), "W": int(W),
+            "bytes_per_value": int(bytes_per_value), "n_bins": int(n_bins),
+            "kernel": "binned_level"}
+
+
+def make_codes_view(codes_rm, tile: int = 2048, mesh=None,
+                    with_t: bool = True) -> CodesView:
     """Build both layouts; the transposed int32 copy only on TPU (it only
     serves the pallas kernel). Both layouts are sharded over the mesh
     'data' axis (rows): rm as [rows@data, F]; t as [Fp, rows_p@data],
     transposed and tile-padded PER SHARD (shard i's t columns are shard
-    i's rm rows — a global end-pad would misalign the row sets)."""
+    i's rm rows — a global end-pad would misalign the row sets).
+    ``with_t=False`` skips the transposed build — the packed hot path
+    (pack_codes) supersedes it with the int8/int16 operand, and
+    building the rows*F*4-byte int32 copy just to drop it would cost
+    the very HBM the packing saves."""
     from h2o3_tpu.parallel.mesh import current_mesh, n_data_shards
     from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from h2o3_tpu.resilience import resilient_device_put
 
     mesh = mesh or current_mesh()
     nd = n_data_shards(mesh)
     rows, F = codes_rm.shape
     if rows % nd == 0:
-        codes_rm = jax.device_put(codes_rm, NamedSharding(mesh, P("data")))
-    if jax.default_backend() != "tpu":
+        codes_rm = resilient_device_put(
+            codes_rm, NamedSharding(mesh, P("data")), pipeline="train")
+    if not with_t or jax.default_backend() != "tpu":
         return CodesView(rm=codes_rm, t=None)
     from h2o3_tpu.ops.hist_pallas import FBLK
 
@@ -292,8 +385,84 @@ def make_codes_view(codes_rm, tile: int = 2048, mesh=None) -> CodesView:
                                   out_specs=P(None, "data")))(codes_rm)
     else:
         t = build_t(codes_rm)
-        t = jax.device_put(t, NamedSharding(mesh, P(None, "data")))
+        t = resilient_device_put(t, NamedSharding(mesh, P(None, "data")),
+                                 pipeline="train")
     return CodesView(rm=codes_rm, t=t)
+
+
+@partial(jax.jit, static_argnames=("na", "W", "dt"))
+def _repack_codes(c, *, na: int, W: int, dt):
+    """NA code n_bins -> reserved lane W-1, narrowed to the kernel
+    dtype. Module-level jit (static na/W/dt) so a warm retrain reuses
+    the executable — no per-call wrapper, no stray recompile."""
+    ci = c.astype(jnp.int32)
+    return jnp.where(ci == na, W - 1, ci).astype(dt)
+
+
+@partial(jax.jit, static_argnames=("W", "tile"))
+def _pack_t_single(rm, *, W: int, tile: int):
+    rows_l = rm.shape[0]
+    pad_r = (-rows_l) % tile
+    return jnp.pad(rm.T, ((0, 0), (0, pad_r)), constant_values=W - 1)
+
+
+@lru_cache(maxsize=32)
+def _pack_t_sharded(mesh, W: int, tile: int):
+    """Cached shard_map transpose builder per (mesh, W): shard i's t
+    columns are shard i's rm rows, padded per shard."""
+    from jax.sharding import PartitionSpec as P
+
+    def build_t(rm_local):
+        rows_l = rm_local.shape[0]
+        pad_r = (-rows_l) % tile
+        return jnp.pad(rm_local.T, ((0, 0), (0, pad_r)),
+                       constant_values=W - 1)
+
+    return jax.jit(jax.shard_map(build_t, mesh=mesh, in_specs=P("data"),
+                                 out_specs=P(None, "data")))
+
+
+def pack_codes(bm: "BinnedMatrix", mesh=None) -> PackedCodes:
+    """Pack a BinnedMatrix's codes for the binned pallas level kernel:
+    remap NA (code == n_bins) to the reserved lane W-1, narrow to the
+    smallest kernel dtype (int8 for W <= 128, else int16), and build
+    the transposed tile-padded hot-loop operand on TPU (or under the
+    interpret escape). Sharding mirrors make_codes_view: rm stays
+    [rows@data, F]; t is [F, rows_p@data] padded PER SHARD so shard
+    i's t columns are shard i's rm rows."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from h2o3_tpu.ops.hist_adaptive import (TILE, code_dtype,
+                                            pallas_interpret, pick_W)
+    from h2o3_tpu.parallel.mesh import current_mesh, n_data_shards
+
+    W = pick_W(bm.n_bins)
+    dt = code_dtype(W)
+    rm = _repack_codes(bm.codes.rm, na=bm.n_bins, W=W, dt=dt)
+    if not (jax.default_backend() == "tpu" or pallas_interpret()):
+        return PackedCodes(rm=rm, t=None, W=W)
+    mesh = mesh or current_mesh()
+    nd = n_data_shards(mesh)
+    rows = rm.shape[0]
+    if rows % nd == 0 and nd > 1:
+        t = _pack_t_sharded(mesh, W, TILE)(rm)
+    else:
+        from h2o3_tpu.resilience import resilient_device_put
+        t = _pack_t_single(rm, W=W, tile=TILE)
+        t = resilient_device_put(t, NamedSharding(mesh, P(None, "data")),
+                                 pipeline="train")
+    return PackedCodes(rm=rm, t=t, W=W)
+
+
+def pack_codes_for(X, bm: "BinnedMatrix", W: Optional[int] = None):
+    """Digitise a NEW matrix (validation / scoring frame) with the
+    training sketch's edges and pack it to the kernel convention
+    (NA = reserved bin W-1, kernel dtype). Row-major only —
+    predict_binned walks it with na_bin = W-1."""
+    from h2o3_tpu.ops.hist_adaptive import code_dtype, pick_W
+    W = W or pick_W(bm.n_bins)
+    c = digitize_with_edges(X, bm.edges, bm.n_bins)
+    return _repack_codes(c, na=bm.n_bins, W=W, dt=code_dtype(W))
 
 
 @jax.jit

@@ -743,6 +743,9 @@ def adaptive_level_tpu_t(xt, nid, ghw, tables, lo, inv, n_prev: int,
             jax.ShapeDtypeStruct((3 * n_nodes, F * W), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((3 * n_nodes, F * W), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 3 * n_nodes * F * W * rows,
+            bytes_accessed=rows * F * 4 + rows * 16, transcendentals=0),
         compiler_params=_CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(xt, nid[None, :], ghw, tabs, loinv)
@@ -868,3 +871,382 @@ def leaf_totals(x, nid, ghw, tables, n_prev: int, n_nodes: int,
                                     interpret=pallas_interpret())
         return nid2[:rows], tot
     return leaf_totals_xla(x, nid, ghw, tables, n_prev, n_nodes, level_base)
+
+
+# ---------------- PACKED BINNED-CODE kernels ---------------------------
+#
+# The global-sketch path bins features ONCE per train (ops/binning.py)
+# into small integer codes, so the level kernel no longer needs the
+# per-node lo/inv range machinery at all: the bin IS the code. Streaming
+# int8/int16 codes instead of f32 features cuts the hot loop's HBM
+# traffic 4x/2x — the lever the roofline data says matters in the
+# memory-bound regime — and the whole [6F, N] range-table stage (one
+# bf16 LUT matmul + 3-term recombine per level) drops out of the
+# kernel body. Conventions:
+#   - codes ride TRANSPOSED [F, rows] like the f32 kernels (rows in
+#     lanes; int8 tiles 32x128, so F=28 pads to 32 sublanes either
+#     way); values in [0, W-2], NA = the RESERVED LAST LANE W-1 (pad
+#     rows are all-NA with zero ghw mass);
+#   - split tables carry the split BIN as an integer-valued f32
+#     (left <=> code < bin), packed through the same exact 3-term bf16
+#     split as the raw-threshold tables (_pack_tables): integers
+#     reconstruct exactly, so in-kernel routing is bit-identical to
+#     the scatter reference and to predict_binned's host walk;
+#   - the histogram contraction is byte-for-byte the f32 kernel's
+#     (same [3N, tile] x [FW, tile]^T lane contraction), so the
+#     bf16 / f32-HIGHEST (histogram_precision) and opt-in int8-ghw
+#     fixed-point paths compose unchanged.
+
+
+def code_dtype(W: int):
+    """Smallest kernel-legal integer dtype for codes in [0, W-1]:
+    int8 holds W <= 128 (max code 127), int16 the 256-lane case."""
+    return jnp.int8 if W <= 128 else jnp.int16
+
+
+def _route_bt(cf, nid, tabs_ref, n_prev, level_base, tile, F, W):
+    """Transposed binned routing: cf [F, tile] f32-valued CODES (NA =
+    W-1). The split-bin compare ``code >= bin`` happens on exact
+    integer-valued floats — no lo/inv rebinning anywhere."""
+    prev_base = level_base - n_prev
+    lid_p = nid - prev_base
+    onp = (jax.lax.broadcasted_iota(jnp.int32, (n_prev, tile), 0)
+           == lid_p[None, :]).astype(jnp.bfloat16)
+    t12 = tabs_ref[:, :n_prev]                        # [12, n_prev] bf16
+    lut3 = jax.lax.dot_general(t12, onp, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+    lut = _unsplit3(lut3[0:4], lut3[4:8], lut3[8:12])  # exact ints
+    f_r, b_r, nl_r, cn_r = lut[0], lut[1], lut[2], lut[3]
+    fi = jax.lax.broadcasted_iota(jnp.int32, (F, tile), 0)
+    csel = jnp.sum(jnp.where(fi == f_r.astype(jnp.int32)[None, :], cf, 0.0),
+                   axis=0)
+    gr_f = jnp.where(csel == float(W - 1), 1.0 - nl_r,
+                     (csel >= b_r).astype(jnp.float32))
+    in_prev = (lid_p >= 0) & (lid_p < n_prev)
+    child = 2 * nid + 1 + gr_f.astype(jnp.int32)
+    return jnp.where(in_prev & (cn_r > 0.5), child, nid)
+
+
+def _kernel_bt(c_ref, nid_ref, ghw_ref, tabs_ref, nid_out, hist_out,
+               acc_ref, *, n_prev: int, n_nodes: int, F: int, W: int,
+               tile: int, n_row_tiles: int, level_base: int, mxu_dtype):
+    r = pl.program_id(0)
+
+    @pl.when(r == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # int8/int16 -> f32 once per tile in VMEM (int->float is legal in
+    # Mosaic via the i32 widening the i8-ghw path already uses)
+    cf = c_ref[...].astype(jnp.int32).astype(jnp.float32)    # [F, tile]
+    nid = nid_ref[0, :]
+    if n_prev > 0:
+        nid = _route_bt(cf, nid, tabs_ref, n_prev, level_base, tile, F, W)
+    nid_out[0, :] = nid
+
+    lid = nid - level_base
+    in_lvl = (lid >= 0) & (lid < n_nodes)
+    lidm = jnp.where(in_lvl, lid, -1)
+    onh_m = (jax.lax.broadcasted_iota(jnp.int32, (n_nodes, tile), 0)
+             == lidm[None, :]).astype(mxu_dtype)
+    # the code IS the bin: the one-hot builds straight off the sublane
+    # repeat — no range lookup, no floor/clip stage
+    b_all = jnp.repeat(cf, W, axis=0)                        # [F*W, tile]
+    brow = jax.lax.broadcasted_iota(jnp.int32, (F * W, tile), 0)
+    oh_t = ((brow % W).astype(jnp.float32) == b_all).astype(mxu_dtype)
+    ghw_m = ghw_ref[...].astype(mxu_dtype)
+    left = jnp.concatenate(
+        [onh_m * ghw_m[k, :][None, :] for k in range(3)], axis=0)  # [3N, tile]
+    acc_ref[...] += jax.lax.dot_general(
+        left, oh_t, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=(jax.lax.Precision.HIGHEST if mxu_dtype == jnp.float32
+                   else jax.lax.Precision.DEFAULT))       # [3N, FW]
+
+    @pl.when(r == n_row_tiles - 1)
+    def _flush():
+        hist_out[...] = acc_ref[...]
+
+
+def binned_level_tpu_t(ct, nid, ghw, tables, n_prev: int, n_nodes: int,
+                       level_base: int, W: int, tile: int = TILE,
+                       interpret: bool = False, mxu_dtype=jnp.bfloat16):
+    """Packed binned level: ct is [F, rows] int8/int16 codes (rows %
+    tile == 0; NA/pad = W-1). Returns (nid' [rows] i32, hist
+    [3, n_nodes, F, W] f32 — caller psums across shards)."""
+    F, rows = ct.shape
+    assert rows % tile == 0, (rows, tile)
+    n_row_tiles = rows // tile
+    tabs = _pack_tables(tables)
+    np1 = tabs.shape[1]
+    kern = functools.partial(_kernel_bt, n_prev=n_prev, n_nodes=n_nodes,
+                             F=F, W=W, tile=tile, n_row_tiles=n_row_tiles,
+                             level_base=level_base, mxu_dtype=mxu_dtype)
+    itemsize = jnp.dtype(ct.dtype).itemsize
+    nid2, hist = pl.pallas_call(
+        kern,
+        grid=(n_row_tiles,),
+        in_specs=[
+            pl.BlockSpec((F, tile), lambda r: (0, r)),
+            pl.BlockSpec((1, tile), lambda r: (0, r)),
+            pl.BlockSpec((3, tile), lambda r: (0, r)),
+            pl.BlockSpec((12, np1), lambda r: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, tile), lambda r: (0, r)),
+            pl.BlockSpec((3 * n_nodes, F * W), lambda r: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, rows), jnp.int32),
+            jax.ShapeDtypeStruct((3 * n_nodes, F * W), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((3 * n_nodes, F * W), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 3 * n_nodes * F * W * rows,
+            bytes_accessed=rows * F * itemsize + rows * 16,
+            transcendentals=0),
+        compiler_params=_CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        interpret=interpret,
+    )(ct, nid[None, :], ghw, tabs)
+    return nid2[0], hist.reshape(3, n_nodes, F, W)
+
+
+def _kernel_bt_i8(c_ref, nid_ref, q_ref, s_ref, tabs_ref, nid_out,
+                  hist_out, acc_ref, *, n_prev: int, n_nodes: int, F: int,
+                  W: int, tile: int, n_row_tiles: int, level_base: int,
+                  terms: int):
+    """Binned level with the exact int8 fixed-point ghw contraction —
+    the _kernel_t_i8 composition minus the range-lookup stage."""
+    r = pl.program_id(0)
+
+    @pl.when(r == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    cf = c_ref[...].astype(jnp.int32).astype(jnp.float32)
+    nid = nid_ref[0, :]
+    if n_prev > 0:
+        nid = _route_bt(cf, nid, tabs_ref, n_prev, level_base, tile, F, W)
+    nid_out[0, :] = nid
+
+    lid = nid - level_base
+    in_lvl = (lid >= 0) & (lid < n_nodes)
+    lidm = jnp.where(in_lvl, lid, -1)
+    iota_n = jax.lax.broadcasted_iota(jnp.int32, (n_nodes, tile), 0)
+    onh_m = iota_n == lidm[None, :]
+    b_all = jnp.repeat(cf, W, axis=0)
+    brow = jax.lax.broadcasted_iota(jnp.int32, (F * W, tile), 0)
+    oh_i = ((brow % W).astype(jnp.float32) == b_all).astype(jnp.int8)
+    q = q_ref[...].astype(jnp.int32)
+    left32 = jnp.concatenate(
+        [jnp.where(onh_m, q[c, :][None, :], 0) for c in range(3 * terms)],
+        axis=0)
+    left = left32.astype(jnp.int8)
+    acc_ref[...] += jax.lax.dot_general(
+        left, oh_i, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+    @pl.when(r == n_row_tiles - 1)
+    def _flush():
+        acc = acc_ref[...].astype(jnp.float32)
+        s = s_ref[...]
+        N = n_nodes
+        rows_ = []
+        for c in range(3):
+            if terms == 1:
+                rows_.append(s[0, c] * acc[c * N:(c + 1) * N])
+            else:
+                hi = acc[2 * c * N:(2 * c + 1) * N]
+                lo = acc[(2 * c + 1) * N:(2 * c + 2) * N]
+                rows_.append(s[0, c] * (256.0 * hi + lo))
+        hist_out[...] = jnp.concatenate(rows_, axis=0)
+
+
+def binned_level_tpu_i8(ct, nid, q, scales, tables, n_prev: int,
+                        n_nodes: int, level_base: int, W: int,
+                        tile: int = TILE, interpret: bool = False):
+    """int8 fixed-point binned level (3·terms·n_nodes must be <= 128)."""
+    F, rows = ct.shape
+    terms = q.shape[0] // 3
+    assert rows % tile == 0, (rows, tile)
+    assert 3 * terms * n_nodes <= 128, (n_nodes, terms)
+    n_row_tiles = rows // tile
+    tabs = _pack_tables(tables)
+    np1 = tabs.shape[1]
+    kern = functools.partial(_kernel_bt_i8, n_prev=n_prev, n_nodes=n_nodes,
+                             F=F, W=W, tile=tile, n_row_tiles=n_row_tiles,
+                             level_base=level_base, terms=terms)
+    nid2, hist = pl.pallas_call(
+        kern,
+        grid=(n_row_tiles,),
+        in_specs=[
+            pl.BlockSpec((F, tile), lambda r: (0, r)),
+            pl.BlockSpec((1, tile), lambda r: (0, r)),
+            pl.BlockSpec((3 * terms, tile), lambda r: (0, r)),
+            pl.BlockSpec((1, 3), lambda r: (0, 0)),
+            pl.BlockSpec((12, np1), lambda r: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, tile), lambda r: (0, r)),
+            pl.BlockSpec((3 * n_nodes, F * W), lambda r: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, rows), jnp.int32),
+            jax.ShapeDtypeStruct((3 * n_nodes, F * W), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((3 * terms * n_nodes, F * W),
+                                   jnp.int32)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 3 * terms * n_nodes * F * W * rows,
+            bytes_accessed=(rows * F * jnp.dtype(ct.dtype).itemsize
+                            + rows * (4 + 3 * terms)),
+            transcendentals=0),
+        compiler_params=_CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        interpret=interpret,
+    )(ct, nid[None, :], q, scales[None, :], tabs)
+    return nid2[0], hist.reshape(3, n_nodes, F, W)
+
+
+def binned_level_xla(codes, nid, ghw, tables, n_prev: int, n_nodes: int,
+                     level_base: int, W: int):
+    """Pure-XLA reference/CPU path for the binned level (scatter-add
+    histogram, [rows, F] int codes, NA = W-1). Accumulation order
+    matches ops/histogram._hist_scatter3 row order, so the packed and
+    unpacked global-sketch paths are BIT-identical on CPU."""
+    rows, F = codes.shape
+    feat, sbin, nal, can = tables
+    ci = codes.astype(jnp.int32)
+    if n_prev > 0:
+        prev_base = level_base - n_prev
+        lid_p = jnp.clip(nid - prev_base, 0, n_prev - 1)
+        in_prev = (nid >= prev_base) & (nid < prev_base + n_prev)
+        f_r = feat[lid_p].astype(jnp.int32)
+        csel = jnp.take_along_axis(ci, f_r[:, None], axis=1)[:, 0]
+        is_na = csel == W - 1
+        go_right = jnp.where(is_na, nal[lid_p] < 0.5,
+                             csel.astype(jnp.float32) >= sbin[lid_p])
+        child = 2 * nid + 1 + go_right.astype(jnp.int32)
+        nid = jnp.where(in_prev & (can[lid_p] > 0.5), child, nid)
+    lid = nid - level_base
+    in_lvl = (lid >= 0) & (lid < n_nodes)
+    lidc = jnp.where(in_lvl, lid, 0)
+    flat = (lidc[:, None] * F + jnp.arange(F)[None, :]) * W + ci
+    vw = jnp.where(in_lvl, 1.0, 0.0)
+    out = jnp.zeros((n_nodes * F * W, 3), jnp.float32)
+    out = out.at[flat.reshape(-1), :].add(
+        (ghw.T * vw[:, None])[:, None, :].repeat(F, axis=1).reshape(-1, 3))
+    hist = out.reshape(n_nodes, F, W, 3)
+    return nid, jnp.moveaxis(hist, -1, 0)
+
+
+def _route_kernel_bt(c_ref, nid_ref, tabs_ref, nid_out, *, n_prev: int,
+                     level_base: int, F: int, W: int, tile: int):
+    cf = c_ref[...].astype(jnp.int32).astype(jnp.float32)
+    nid = nid_ref[0, :]
+    nid = _route_bt(cf, nid, tabs_ref, n_prev, level_base, tile, F, W)
+    nid_out[0, :] = nid
+
+
+def binned_route_only_tpu_t(ct, nid, tables, n_prev: int, level_base: int,
+                            W: int, tile: int = TILE,
+                            interpret: bool = False):
+    F, rows = ct.shape
+    assert rows % tile == 0
+    tabs = _pack_tables(tables)
+    np1 = tabs.shape[1]
+    kern = functools.partial(_route_kernel_bt, n_prev=n_prev,
+                             level_base=level_base, F=F, W=W, tile=tile)
+    nid2 = pl.pallas_call(
+        kern,
+        grid=(rows // tile,),
+        in_specs=[
+            pl.BlockSpec((F, tile), lambda r: (0, r)),
+            pl.BlockSpec((1, tile), lambda r: (0, r)),
+            pl.BlockSpec((12, np1), lambda r: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, tile), lambda r: (0, r)),
+        out_shape=jax.ShapeDtypeStruct((1, rows), jnp.int32),
+        compiler_params=_CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        interpret=interpret,
+    )(ct, nid[None, :], tabs)
+    return nid2[0]
+
+
+def binned_route_only_xla(codes, nid, tables, n_prev: int, level_base: int,
+                          W: int):
+    feat, sbin, nal, can = tables
+    ci = codes.astype(jnp.int32)
+    prev_base = level_base - n_prev
+    lid_p = jnp.clip(nid - prev_base, 0, n_prev - 1)
+    in_prev = (nid >= prev_base) & (nid < prev_base + n_prev)
+    f_r = feat[lid_p].astype(jnp.int32)
+    csel = jnp.take_along_axis(ci, f_r[:, None], axis=1)[:, 0]
+    go_right = jnp.where(csel == W - 1, nal[lid_p] < 0.5,
+                         csel.astype(jnp.float32) >= sbin[lid_p])
+    child = 2 * nid + 1 + go_right.astype(jnp.int32)
+    return jnp.where(in_prev & (can[lid_p] > 0.5), child, nid)
+
+
+def _binned_pad(ct, nid, ghw, W):
+    """Pad the kernel operands to the tile width: pad rows are all-NA
+    (code W-1) with nid 0 — at the root they one-hot into node 0 but
+    carry zero ghw mass, at deeper levels they fall outside the level
+    window, exactly like the f32 kernels' NaN pad rows."""
+    padc = (-ct.shape[1]) % TILE
+    if padc:
+        ct = jnp.pad(ct, ((0, 0), (0, padc)), constant_values=W - 1)
+    pad = ct.shape[1] - nid.shape[0]
+    if pad:
+        nid = jnp.pad(nid, (0, pad))
+        if ghw is not None:
+            ghw = jnp.pad(ghw, ((0, 0), (0, pad)))
+    return ct, nid, ghw
+
+
+def binned_level(codes_rm, nid, ghw, tables, n_prev: int, n_nodes: int,
+                 level_base: int, W: int, method: str = "auto",
+                 mxu_dtype=jnp.bfloat16, ct=None, qs=None):
+    """Dispatch the packed binned level: pallas on TPU (or interpret),
+    scatter-XLA elsewhere. ``ct`` is the pre-transposed [F, rows_p]
+    code matrix (built once per train by ops/binning.pack_codes);
+    without it the pallas path transposes on the fly (streamed
+    chunks). ``qs`` enables the exact int8-ghw contraction for levels
+    with 3·terms·n_nodes <= 128, same contract as adaptive_level."""
+    method = _resolve_method(method)
+    if method == "pallas":
+        if ct is None:
+            ct = codes_rm.T
+        rows = nid.shape[0]
+        ct, nid, ghw = _binned_pad(ct, nid, ghw, W)
+        pad = nid.shape[0] - rows
+        if (qs is not None and qs[0].shape[0] * n_nodes <= 128
+                and mxu_dtype == jnp.bfloat16):
+            q, scales = qs
+            if pad:
+                q = jnp.pad(q, ((0, 0), (0, pad)))
+            nid2, hist = binned_level_tpu_i8(
+                ct, nid, q, scales, tables, n_prev, n_nodes, level_base,
+                W, interpret=pallas_interpret())
+            return nid2[:rows], hist
+        nid2, hist = binned_level_tpu_t(ct, nid, ghw, tables, n_prev,
+                                        n_nodes, level_base, W,
+                                        mxu_dtype=mxu_dtype,
+                                        interpret=pallas_interpret())
+        return nid2[:rows], hist
+    return binned_level_xla(codes_rm, nid, ghw, tables, n_prev, n_nodes,
+                            level_base, W)
+
+
+def binned_route_only(codes_rm, nid, tables, n_prev: int, level_base: int,
+                      W: int, method: str = "auto", ct=None):
+    method = _resolve_method(method)
+    if method == "pallas":
+        if ct is None:
+            ct = codes_rm.T
+        rows = nid.shape[0]
+        ct, nid, _ = _binned_pad(ct, nid, None, W)
+        return binned_route_only_tpu_t(ct, nid, tables, n_prev, level_base,
+                                       W, interpret=pallas_interpret()
+                                       )[:rows]
+    return binned_route_only_xla(codes_rm, nid, tables, n_prev, level_base,
+                                 W)

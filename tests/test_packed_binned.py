@@ -1,0 +1,488 @@
+"""Packed binned-feature compute (ISSUE 12): int8/int16 bin codes
+through the fused binned level kernel, end to end.
+
+Covers the acceptance contract on CPU:
+- interpret-mode BIT parity of the binned pallas kernel vs the scatter
+  XLA reference (integer ghw mass makes every histogram sum exact, so
+  the comparison is equality, not allclose);
+- grow_tree_binned vs the existing global-sketch grow_tree: identical
+  splits when both run float32-exact on the same codes;
+- end-to-end GBM packed vs unpacked under histogram_precision=float32:
+  bit-identical split structure (sharded through the suite's virtual
+  mesh like every other train);
+- hot-loop bytes: the binned level's lowered cost_analysis moves >= 2x
+  fewer bytes than the f32 adaptive level at the same shape;
+- zero-recompile warm retrain + streamed packed parity and code-sized
+  H2D accounting.
+"""
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu import memman
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+from h2o3_tpu.models.tree import (TreeConfig, binned_feasible, grow_tree,
+                                  grow_tree_binned, packed_codes_requested)
+from h2o3_tpu.ops.binning import (_edges_host, bin_matrix,
+                                  digitize_codes_host, pack_codes,
+                                  pack_codes_for)
+from h2o3_tpu.ops.hist_adaptive import (binned_level_tpu_i8,
+                                        binned_level_tpu_t,
+                                        binned_level_xla,
+                                        binned_route_only_tpu_t,
+                                        binned_route_only_xla, code_dtype,
+                                        pick_W, quantize_ghw_i8)
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _compile_counter import count_compiles  # noqa: E402 — shared harness
+
+
+# ------------------------------------------------ kernel-level parity
+
+
+def _kernel_inputs(rows=4096, F=7, W=16, N=4, seed=0, int_ghw=True):
+    rng = np.random.default_rng(seed)
+    codes = rng.integers(0, W - 1, size=(rows, F)).astype(np.int32)
+    codes[rng.random((rows, F)) < 0.07] = W - 1          # NA lane
+    n_prev, base = N // 2, N - 1
+    nid = (base - n_prev + rng.integers(0, n_prev, rows)).astype(np.int32)
+    if int_ghw:
+        # integer mass: every f32 histogram sum is exact regardless of
+        # accumulation order -> BIT parity between matmul and scatter
+        g = rng.integers(-8, 9, rows).astype(np.float32)
+    else:
+        g = rng.normal(size=rows).astype(np.float32)
+    ghw = np.stack([g, np.ones(rows, np.float32),
+                    np.ones(rows, np.float32)])
+    tables = (jnp.asarray(rng.integers(0, F, n_prev).astype(np.float32)),
+              jnp.asarray(rng.integers(1, W - 1, n_prev)
+                          .astype(np.float32)),
+              jnp.asarray((rng.random(n_prev) < 0.5).astype(np.float32)),
+              jnp.ones(n_prev, jnp.float32))
+    ct = jnp.asarray(codes.T.astype(np.int8 if W <= 128 else np.int16))
+    return (codes, ct, jnp.asarray(nid), jnp.asarray(ghw), tables,
+            n_prev, N, base)
+
+
+def test_binned_level_bit_parity_interpret():
+    codes, ct, nid, ghw, tables, n_prev, N, base = _kernel_inputs()
+    W = 16
+    nid_t, hist_t = binned_level_tpu_t(
+        ct, nid, ghw, tables, n_prev, N, base, W, tile=1024,
+        interpret=True, mxu_dtype=jnp.float32)
+    nid_x, hist_x = binned_level_xla(
+        jnp.asarray(codes), nid, ghw, tables, n_prev, N, base, W)
+    np.testing.assert_array_equal(np.asarray(nid_t), np.asarray(nid_x))
+    np.testing.assert_array_equal(np.asarray(hist_t), np.asarray(hist_x))
+
+
+def test_binned_level_float_ghw_close_interpret():
+    codes, ct, nid, ghw, tables, n_prev, N, base = _kernel_inputs(
+        seed=3, int_ghw=False)
+    W = 16
+    nid_t, hist_t = binned_level_tpu_t(
+        ct, nid, ghw, tables, n_prev, N, base, W, tile=1024,
+        interpret=True, mxu_dtype=jnp.float32)
+    nid_x, hist_x = binned_level_xla(
+        jnp.asarray(codes), nid, ghw, tables, n_prev, N, base, W)
+    np.testing.assert_array_equal(np.asarray(nid_t), np.asarray(nid_x))
+    np.testing.assert_allclose(np.asarray(hist_t), np.asarray(hist_x),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_binned_route_only_bit_parity_interpret():
+    codes, ct, nid, _ghw, tables, n_prev, _N, base = _kernel_inputs(seed=5)
+    r_t = binned_route_only_tpu_t(ct, nid, tables, n_prev, base, 16,
+                                  tile=1024, interpret=True)
+    r_x = binned_route_only_xla(jnp.asarray(codes), nid, tables, n_prev,
+                                base, 16)
+    np.testing.assert_array_equal(np.asarray(r_t), np.asarray(r_x))
+
+
+def test_binned_i8_ghw_parity_interpret():
+    """The int8 fixed-point ghw contraction composes with the binned
+    kernel within its documented quantization bound."""
+    codes, ct, nid, ghw, tables, n_prev, N, base = _kernel_inputs(
+        seed=7, int_ghw=False)
+    q, s = quantize_ghw_i8(ghw, terms=2)
+    nid_i, hist_i = binned_level_tpu_i8(ct, nid, q, s, tables, n_prev, N,
+                                        base, 16, tile=1024, interpret=True)
+    nid_x, hist_x = binned_level_xla(jnp.asarray(codes), nid, ghw, tables,
+                                     n_prev, N, base, 16)
+    np.testing.assert_array_equal(np.asarray(nid_i), np.asarray(nid_x))
+    np.testing.assert_allclose(np.asarray(hist_i), np.asarray(hist_x),
+                               atol=5e-3, rtol=1e-4)
+
+
+def test_code_dtype_and_feasibility():
+    assert code_dtype(16) == jnp.int8
+    assert code_dtype(128) == jnp.int8
+    assert code_dtype(256) == jnp.int16
+    assert binned_feasible(14, 28, 6)
+    assert not binned_feasible(300, 28, 6)       # past the lane cap
+
+
+# ------------------------------------------- grower vs grow_tree parity
+
+
+def _binned_setup(n=2560, F=5, nbins=14, seed=2, na_frac=0.0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    if na_frac:
+        X[rng.random((n, F)) < na_frac] = np.nan
+    bm = bin_matrix(X, [f"f{i}" for i in range(F)], [False] * F, n,
+                    nbins=nbins)
+    pc = pack_codes(bm)
+    y = (np.nan_to_num(X[:, 0]) + 0.5 * np.nan_to_num(X[:, 1])
+         > 0).astype(np.float32)
+    g = jnp.asarray(0.5 - y)
+    h = jnp.full(n, 0.25, jnp.float32)
+    w = jnp.ones(n, jnp.float32)
+    return bm, pc, g, h, w
+
+
+@pytest.mark.parametrize("na_frac", [0.0, 0.2])
+def test_grow_tree_binned_matches_grow_tree_f32(na_frac):
+    """Same codes, exact f32 histograms: the packed grower and the
+    existing global-sketch grower pick identical splits — INCLUDING on
+    NA-heavy data, because _find_splits masks the packed layout's
+    empty lanes (max_bin) so both paths scan the identical candidate
+    grid."""
+    bm, pc, g, h, w = _binned_setup(na_frac=na_frac)
+    cfg = TreeConfig(max_depth=3, n_bins=bm.n_bins, n_features=5,
+                     min_rows=2.0, histogram_precision="float32")
+    col_mask = jnp.ones(5, bool)
+    t_old, nid_old = grow_tree(bm.codes.rm, g, h, w, cfg, col_mask)
+    t_new, nid_new = grow_tree_binned(pc.rm, g, h, w, cfg, col_mask,
+                                      ct=pc.t)
+    np.testing.assert_array_equal(np.asarray(t_old["feat"]),
+                                  np.asarray(t_new["feat"]))
+    np.testing.assert_array_equal(np.asarray(t_old["is_split"]),
+                                  np.asarray(t_new["is_split"]))
+    live = np.asarray(t_old["is_split"])
+    np.testing.assert_array_equal(np.asarray(t_old["split_bin"])[live],
+                                  np.asarray(t_new["split_bin"])[live])
+    np.testing.assert_array_equal(np.asarray(t_old["na_left"])[live],
+                                  np.asarray(t_new["na_left"])[live])
+    np.testing.assert_array_equal(np.asarray(nid_old), np.asarray(nid_new))
+    np.testing.assert_array_equal(np.asarray(t_old["value"]),
+                                  np.asarray(t_new["value"]))
+
+
+def test_grow_tree_binned_interpret_matches_scatter():
+    """Pallas (interpret) vs scatter through the GROWER, with NAs: the
+    packed path must be bit-identical to its own reference."""
+    bm, pc, g, h, w = _binned_setup(na_frac=0.05, seed=9)
+    cfg = TreeConfig(max_depth=3, n_bins=bm.n_bins, n_features=5,
+                     min_rows=2.0, histogram_precision="float32")
+    col_mask = jnp.ones(5, bool)
+    t_sc, nid_sc = grow_tree_binned(pc.rm, g, h, w, cfg, col_mask,
+                                    ct=None)
+    os.environ["H2O3_PALLAS_INTERPRET"] = "1"
+    try:
+        # single-device transposed view: outside shard_map, the mesh-
+        # sharded pack (per-shard padding) would misalign row indexing
+        from h2o3_tpu.ops.binning import _pack_t_single
+        from h2o3_tpu.ops.hist_adaptive import TILE
+        ct = _pack_t_single(pc.rm, W=pc.W, tile=TILE)
+        t_pl, nid_pl = grow_tree_binned(pc.rm, g, h, w, cfg, col_mask,
+                                        ct=ct)
+    finally:
+        del os.environ["H2O3_PALLAS_INTERPRET"]
+    for k in ("feat", "split_bin", "na_left", "is_split"):
+        np.testing.assert_array_equal(np.asarray(t_sc[k]),
+                                      np.asarray(t_pl[k]), err_msg=k)
+    np.testing.assert_array_equal(np.asarray(nid_sc), np.asarray(nid_pl))
+
+
+# -------------------------------------------------- hot-loop bytes drop
+
+
+def test_binned_level_bytes_accessed_drop():
+    """The acceptance lever, measurable off-TPU: the binned level
+    kernel's per-level HBM-side operands (what its cost_analysis
+    reports on TPU — pl.CostEstimate counts exactly these) total >= 2x
+    fewer bytes than the f32 adaptive level's at the same (rows, F)
+    shape. Asserted from the ACTUAL pallas entry-point operands, plus
+    the declared CostEstimates staying consistent with them."""
+    import functools
+
+    from h2o3_tpu.ops import hist_adaptive as ha
+
+    rows, F, W, N = 8192, 28, 16, 8
+    rng = np.random.default_rng(0)
+    ct = jnp.asarray(rng.integers(0, W - 1, (F, rows)).astype(np.int8))
+    xt = jnp.asarray(rng.normal(size=(F, rows)).astype(np.float32))
+    nid = jnp.zeros(rows, jnp.int32)
+    ghw = jnp.ones((3, rows), jnp.float32)
+    t1 = jnp.zeros(max(N // 2, 1), jnp.float32)
+    tables = (t1, t1, t1, t1)
+    lo = jnp.zeros((N, F), jnp.float32)
+    inv = jnp.ones((N, F), jnp.float32)
+    base = N - 1
+
+    captured = {}
+    real_call = ha.pl.pallas_call
+
+    def spy(kern, **kw):
+        name = kern.func.__name__       # functools.partial of the kernel
+        ce = kw.get("cost_estimate")
+
+        def runner(*operands):
+            captured[name] = (
+                sum(int(o.size) * jnp.dtype(o.dtype).itemsize
+                    for o in operands),
+                ce.bytes_accessed if ce is not None else None)
+            return real_call(kern, **kw)(*operands)
+        return runner
+
+    ha.pl.pallas_call = spy
+    try:
+        ha.binned_level_tpu_t(ct, nid, ghw, tables, N // 2, N, base, W,
+                              tile=1024, interpret=True,
+                              mxu_dtype=jnp.float32)
+        ha.adaptive_level_tpu_t(xt, nid, ghw, tables, lo, inv, N // 2, N,
+                                base, W, tile=1024, interpret=True,
+                                mxu_dtype=jnp.float32)
+    finally:
+        ha.pl.pallas_call = real_call
+    b_bytes, b_ce = captured["_kernel_bt"]
+    a_bytes, _ = captured["_kernel_t"]
+    assert a_bytes / b_bytes >= 2.0, (a_bytes, b_bytes)
+    # the declared CostEstimate is dominated by (and consistent with)
+    # the feature operand: codes itemsize, not 4
+    assert b_ce == rows * F * 1 + rows * 16
+
+
+# ------------------------------------------------------- end to end
+
+
+def _frame(n=5120, F=6, seed=5, na=False):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    if na:
+        X[rng.random((n, F)) < 0.04] = np.nan
+    logit = (np.nan_to_num(X[:, 0]) * 1.2 - np.nan_to_num(X[:, 1])
+             + 0.4 * np.nan_to_num(X[:, 2]))
+    cols = {f"x{i}": X[:, i] for i in range(F)}
+    cols["resp"] = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)),
+                            "y", "n")
+    return h2o.Frame.from_numpy(cols)
+
+
+_COMMON = dict(ntrees=5, max_depth=4, nbins=14, seed=3, min_rows=1.0,
+               histogram_type="quantiles_global",
+               histogram_precision="float32",
+               score_tree_interval=0, stopping_rounds=0)
+
+
+def test_packed_gbm_matches_unpacked_f32():
+    """histogram_precision=float32: packed and unpacked trains produce
+    BIT-identical split structure (and matching metrics) — through the
+    estimator, i.e. sharded exactly like every train in this suite."""
+    fr = _frame()
+    m1 = H2OGradientBoostingEstimator(packed_codes=True, **_COMMON)
+    m1.train(y="resp", training_frame=fr)
+    m2 = H2OGradientBoostingEstimator(packed_codes=False, **_COMMON)
+    m2.train(y="resp", training_frame=fr)
+    assert m1.model.output["packed_codes"]["enabled"]
+    assert m1.model.output["packed_codes"]["bytes_per_value"] == 1
+    assert not m2.model.output["packed_codes"]["enabled"]
+    np.testing.assert_array_equal(np.asarray(m1.model._feat),
+                                  np.asarray(m2.model._feat))
+    np.testing.assert_array_equal(np.asarray(m1.model._thr),
+                                  np.asarray(m2.model._thr))
+    np.testing.assert_array_equal(np.asarray(m1.model._na_left),
+                                  np.asarray(m2.model._na_left))
+    # DEEPEST leaf values bit-equal (both paths end in the same exact
+    # segment-totals tail); interior node values may differ in ulps —
+    # grow_tree's sibling-subtraction (right = parent - left) vs the
+    # binned kernel's direct build round differently on non-dyadic
+    # gradients
+    v1 = np.asarray(m1.model._value)
+    v2 = np.asarray(m2.model._value)
+    baseD = 2 ** _COMMON["max_depth"] - 1
+    np.testing.assert_array_equal(v1[:, baseD:], v2[:, baseD:])
+    np.testing.assert_allclose(v1, v2, rtol=1e-4, atol=1e-6)
+    assert (m1.model.training_metrics.auc
+            == pytest.approx(m2.model.training_metrics.auc, abs=1e-9))
+
+
+def test_packed_gbm_with_nas_and_validation():
+    """NA routing through the reserved W-1 bin, and the validation walk
+    over packed codes: trains, scores, and the valid metrics are sane."""
+    fr = _frame(na=True)
+    vr = _frame(n=2048, seed=11, na=True)
+    est = H2OGradientBoostingEstimator(packed_codes=True, **_COMMON)
+    est.train(y="resp", training_frame=fr, validation_frame=vr)
+    assert est.model.output["packed_codes"]["enabled"]
+    assert 0.5 < est.model.training_metrics.auc <= 1.0
+    assert 0.4 < est.model.validation_metrics.auc <= 1.0
+    pred = np.asarray(est.model.predict(fr).vec(1).to_numpy())
+    assert np.isfinite(pred[: fr.nrow]).all()
+
+
+def test_packed_validation_codes_convention():
+    """pack_codes_for shares the training sketch and the W-1 NA lane."""
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(500, 3)).astype(np.float32)
+    bm = bin_matrix(X, ["a", "b", "c"], [False] * 3, 500, nbins=14)
+    pc = pack_codes(bm)
+    Xv = rng.normal(size=(100, 3)).astype(np.float32)
+    Xv[0, 0] = np.nan
+    vc = np.asarray(pack_codes_for(jnp.asarray(Xv), bm, pc.W))
+    assert vc.dtype == np.int8
+    assert vc[0, 0] == pc.W - 1
+    assert vc[1:, :].max() < bm.n_bins
+
+
+def test_packed_warm_retrain_zero_recompiles():
+    """The packed path must keep the zero-recompile contract: bin,
+    pack, and chunk executables all reuse on an identical retrain."""
+    fr = _frame(seed=8)
+    est = H2OGradientBoostingEstimator(packed_codes=True, **_COMMON)
+    est.train(y="resp", training_frame=fr)
+    events = []
+    with count_compiles(events):
+        est2 = H2OGradientBoostingEstimator(packed_codes=True, **_COMMON)
+        est2.train(y="resp", training_frame=fr)
+    assert est2.model.ntrees_built == 5
+    assert len(events) == 0, f"warm packed train compiled {len(events)}"
+
+
+# --------------------------------------------------------- streamed
+
+
+@pytest.mark.slow  # multi-second streamed trains ride the established
+                   # slow tier (test_transfer_budget.py precedent)
+def test_streamed_packed_matches_dense_and_moves_codes():
+    """Forced memory-pressure train with packing on: bit-identical
+    split structure to the dense packed train, resident-window H2D
+    sized by CODE bytes (not f32), and the once-per-tree contract."""
+    rng = np.random.default_rng(7)
+    n, F = 30000, 8
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    logit = X[:, 0] - 0.6 * X[:, 1]
+    cols = {f"x{i}": X[:, i] for i in range(F)}
+    cols["resp"] = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)),
+                            "y", "n")
+    common = dict(ntrees=4, max_depth=4, nbins=16, seed=3, min_rows=1.0,
+                  histogram_precision="float32", score_tree_interval=0,
+                  stopping_rounds=0)
+    fr = h2o.Frame.from_numpy(cols)
+    dense = H2OGradientBoostingEstimator(packed_codes=True, **common)
+    dense.train(y="resp", training_frame=fr)
+    x_bytes = n * F * 4
+    try:
+        memman.reset(budget=int(2.2 * x_bytes))
+        fr2 = h2o.Frame.from_numpy(cols)
+        est = H2OGradientBoostingEstimator(packed_codes=True, **common)
+        est.train(y="resp", training_frame=fr2)
+        m = est.model
+    finally:
+        memman.reset()
+    assert m.output.get("streamed")
+    assert m.output["packed_codes"]["enabled"]
+    sp = m.output["stream_profile"]
+    assert sp["packed_codes"] and sp["x_itemsize"] == 1
+    # resident window = codes + y/w/margin f32 vectors, NOT f32 X
+    assert sp["h2d_resident_bytes"] <= n * F * 1 + 3 * 4 * n + 4096
+    assert sp["h2d_bytes_per_tree"] <= 1.1 * sp["device_footprint_bytes"]
+    np.testing.assert_array_equal(np.asarray(dense.model._feat),
+                                  np.asarray(m._feat))
+    np.testing.assert_array_equal(np.asarray(dense.model._thr),
+                                  np.asarray(m._thr))
+
+
+def test_host_sketch_matches_bin_matrix_and_device_digitise():
+    """The host sketch used by the streamed packed path produces the
+    same edges as bin_matrix, and codes that BIT-match the device
+    digitise (modulo the NA remap) — including +inf values, which must
+    land in the shared inf-padded lane like digitize_with_edges, not
+    the per-feature top bin."""
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(2000, 4)).astype(np.float32)
+    X[rng.random((2000, 4)) < 0.05] = np.nan
+    X[5, 1] = np.inf
+    # a near-constant column -> short edge list (the +inf divergence
+    # case: its edges are shorter than the widest feature's)
+    X[:, 3] = 1.0
+    X[7, 3] = np.inf
+    bm = bin_matrix(X, list("abcd"), [False] * 4, 2000, nbins=14)
+    edges, n_bins = _edges_host(X, 2000, [False] * 4, 14, 1024,
+                                "quantiles_global")
+    assert n_bins == bm.n_bins
+    for e1, e2 in zip(edges, bm.edges):
+        np.testing.assert_array_equal(e1, e2)
+    codes, W = digitize_codes_host(X, edges, n_bins)
+    dev = np.asarray(bm.codes.rm).astype(np.int32)
+    host = codes.astype(np.int32)
+    na = np.isnan(X)
+    assert (host[na] == W - 1).all()
+    np.testing.assert_array_equal(host[~na], dev[~na])
+
+
+# ------------------------------------------------- sharded (slow tier)
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_packed_sharded_unsharded_bit_identical():
+    """histogram_precision=float32 + packed codes: the (4,2)-mesh train
+    reproduces the single-device split structure bit-for-bit (balanced
+    y -> dyadic (g,h), order-independent psum — the
+    test_gbm_sharded pattern applied to the packed path)."""
+    from h2o3_tpu.parallel.mesh import current_mesh, make_mesh, set_mesh
+    rng = np.random.default_rng(11)
+    n, F = 2048, 6
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0.3)).astype(np.float32)
+    idx1 = np.nonzero(y == 1)[0]
+    idx0 = np.nonzero(y == 0)[0]
+    k = min(len(idx0), len(idx1), 1000)
+    sel = np.sort(np.concatenate([idx0[:k], idx1[:k]]))
+    X, y = X[sel], y[sel]
+    params = dict(ntrees=1, max_depth=4, nbins=16,
+                  distribution="bernoulli", min_rows=2.0,
+                  histogram_precision="float32", packed_codes=True,
+                  score_tree_interval=0, stopping_rounds=0, seed=7)
+
+    def train(mesh):
+        old = current_mesh()
+        set_mesh(mesh)
+        try:
+            cols = {f"f{i}": X[:, i] for i in range(F)}
+            cols["y"] = y
+            fr = h2o.Frame.from_numpy(cols)
+            gbm = H2OGradientBoostingEstimator(**params)
+            gbm.train(y="y", training_frame=fr)
+            return gbm.model
+        finally:
+            set_mesh(old)
+
+    m1 = train(make_mesh(n_data=1, n_model=1, devices=jax.devices()[:1]))
+    m8 = train(make_mesh(n_data=4, n_model=2))
+    np.testing.assert_array_equal(np.asarray(m1._feat),
+                                  np.asarray(m8._feat))
+    np.testing.assert_array_equal(np.asarray(m1._thr),
+                                  np.asarray(m8._thr))
+    np.testing.assert_array_equal(np.asarray(m1._is_split),
+                                  np.asarray(m8._is_split))
+
+
+def test_packed_gate_semantics(monkeypatch):
+    """'auto' follows the accelerated-kernel availability; explicit
+    True/False override."""
+    monkeypatch.delenv("H2O3_PALLAS_INTERPRET", raising=False)
+    assert not packed_codes_requested({"packed_codes": "auto"})  # CPU
+    assert packed_codes_requested({"packed_codes": True})
+    assert packed_codes_requested({"packed_codes": "true"})
+    assert not packed_codes_requested({"packed_codes": False})
+    monkeypatch.setenv("H2O3_PALLAS_INTERPRET", "1")
+    assert packed_codes_requested({"packed_codes": "auto"})
+    assert packed_codes_requested({})

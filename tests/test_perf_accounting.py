@@ -395,6 +395,25 @@ def test_perf_gate_skips_below_two_rounds(tmp_path):
     assert rep["ok"] and rep["skipped"]
 
 
+def test_perf_gate_excludes_informational_rounds(tmp_path):
+    """An off-TPU smoke round (informational: true) must neither fail
+    the hardware ratchet with its tiny CPU numbers nor become a fake
+    'best' — it is excluded and listed (ISSUE 12)."""
+    d = _write_rounds(tmp_path, [1e6, 2e6, 5e3, 3e6], extra=[
+        {}, {}, {"informational": True, "backend": "cpu"}, {}])
+    rep = perf_gate.run(d)
+    assert rep["ok"], rep["violations"]
+    assert rep["informational_rounds"] == ["BENCH_r03.json"]
+    assert rep["metrics"]["value"]["points"] == 3
+    # the per-point peak-provenance flag must NOT exclude a round: it
+    # also fires on real TPUs missing from the peak table, and dropping
+    # those would let hardware regressions slip the ratchet
+    d2 = _write_rounds(tmp_path, [1e6, 2e6, 4e3], extra=[
+        {}, {}, {"train.perf_informational": True}])
+    rep2 = perf_gate.run(d2)
+    assert not rep2["ok"] and rep2["violations"][0]["round"] == 3
+
+
 def test_perf_gate_repo_trajectory_tier1():
     """The CI wiring (satellite): the checked-in BENCH_r*.json history
     must pass the gate on every tier-1 run. Skips cleanly when fewer

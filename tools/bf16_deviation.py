@@ -78,6 +78,11 @@ def main():
         "auc_bf16": round(float(mb.training_metrics.auc), 6),
         "auc_f32": round(float(mf.training_metrics.auc), 6),
         "auc_delta": round(float(auc_d), 7),
+        # which hot path the guard measured: with packed_codes auto the
+        # default TPU run exercises the PACKED binned kernel (ISSUE 12)
+        # — the record must say so or a path switch would silently
+        # reinterpret the history
+        "packed_codes": mf.output.get("packed_codes"),
         # guard threshold: a kernel-numerics regression shows up as an
         # AUC gap far above the measured near-tie noise floor (~3e-5)
         "auc_delta_threshold": 1e-3,

@@ -20,6 +20,15 @@ points is skipped. Fewer than two round files = clean skip (a fresh
 repo must not fail its own gate). Noise bands are deliberately wider
 for latency metrics (scheduler noise) than for throughput.
 
+INFORMATIONAL rounds: a round recorded off-TPU carries
+``"informational": true`` (bench.py stamps it from the backend). Every
+headline metric here is hardware-bound — comparing a CPU smoke round
+against TPU history is meaningless in BOTH directions (a fake
+regression AND a fake best) — so informational rounds are excluded
+from the ratchet entirely and listed in the report instead. (The
+per-point ``train.perf_informational`` flag is NOT used: it also fires
+on real TPUs missing from the peak table.)
+
 Stdlib-only by design — tier-1 runs it (tests/test_perf_accounting.py)
 without paying the jax import.
 
@@ -139,15 +148,31 @@ def check_trajectory(rounds: List[Tuple[int, str, Dict]],
             "violations": violations}
 
 
+def is_informational(rec: Dict) -> bool:
+    """Off-TPU round: the top-level flag ONLY (bench.py stamps it from
+    the backend). Deliberately NOT the per-point
+    ``train.perf_informational`` flag — that one also fires on REAL
+    TPU hardware whose device kind is missing from the peak table
+    (nominal-peak provenance), and excluding such rounds would let
+    genuine throughput regressions slip the ratchet."""
+    return bool(rec.get("informational"))
+
+
 def run(bench_dir: str, band_override: Optional[float] = None) -> Dict:
     rounds = load_rounds(bench_dir)
+    informational = [name for _, name, rec in rounds
+                     if is_informational(rec)]
+    rounds = [(n, name, rec) for n, name, rec in rounds
+              if not is_informational(rec)]
     if len(rounds) < 2:
         return {"ok": True, "skipped": True,
-                "reason": f"{len(rounds)} bench round(s) in "
+                "reason": f"{len(rounds)} hardware bench round(s) in "
                           f"{bench_dir} — need 2 to ratchet",
-                "rounds": [name for _, name, _ in rounds]}
+                "rounds": [name for _, name, _ in rounds],
+                "informational_rounds": informational}
     report = check_trajectory(rounds, band_override=band_override)
     report["skipped"] = False
+    report["informational_rounds"] = informational
     return report
 
 
@@ -177,10 +202,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                       f"[{v['direction']}, band {v['band']:.0%}]")
             checked = {k: m for k, m in report["metrics"].items()
                        if m.get("checked")}
+            info = report.get("informational_rounds") or []
             print(f"perf_gate: {'OK' if report['ok'] else 'FAIL'} — "
                   f"{len(report['rounds'])} rounds, "
                   f"{len(checked)} metrics checked, "
-                  f"{len(report['violations'])} violation(s)")
+                  f"{len(report['violations'])} violation(s)"
+                  + (f", {len(info)} informational round(s) excluded "
+                     f"({', '.join(info)})" if info else ""))
     return 0 if report["ok"] else 1
 
 

@@ -84,6 +84,71 @@ def _train(fr, yname):
     return gbm.model, time.time() - t0
 
 
+def _level_split(rows, F, nbins, depth):
+    """Standalone per-level timing of the hot kernel, packed binned vs
+    f32 adaptive at the profiled shape — attributes the level cost so
+    the NEXT 2x is visible per depth, and quantifies the packed-vs-f32
+    bytes/row drop at the representation level. Uses the same 'auto'
+    dispatch as training (pallas on TPU / interpret escape, scatter on
+    CPU); rows are capped off-TPU to keep the probe cheap."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    from h2o3_tpu.ops.hist_adaptive import (adaptive_level, binned_level,
+                                            pick_W)
+    if jax.default_backend() != "tpu":
+        rows = min(rows, 1 << 18)
+    rng = np.random.default_rng(0)
+    W = pick_W(max(nbins, 2))
+    dt = np.int8 if W <= 128 else np.int16
+    Xh = rng.normal(size=(rows, F)).astype(np.float32)
+    X = jnp.asarray(Xh)
+    Xt = jnp.asarray(np.ascontiguousarray(Xh.T))
+    codes_h = rng.integers(0, max(nbins, 2), size=(rows, F)).astype(dt)
+    codes = jnp.asarray(codes_h)
+    ct = jnp.asarray(np.ascontiguousarray(codes_h.T))
+    ghw = jnp.ones((3, rows), jnp.float32)
+    levels = []
+
+    def timeit(fn, *args, reps=3, **kw):
+        r = fn(*args, **kw)
+        jax.block_until_ready(r)        # warmup/compile
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            r = fn(*args, **kw)
+            jax.block_until_ready(r)
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    for d in range(depth):
+        N = 2 ** d
+        base = N - 1
+        n_prev = N // 2
+        np1 = max(n_prev, 1)
+        nid = jnp.asarray(
+            (base - n_prev + rng.integers(0, max(n_prev, 1), rows))
+            .astype(np.int32)) if d else jnp.zeros(rows, jnp.int32)
+        tables = (jnp.asarray(rng.integers(0, F, np1).astype(np.float32)),
+                  jnp.asarray(rng.integers(1, max(nbins - 1, 2), np1)
+                              .astype(np.float32)),
+                  jnp.zeros(np1, jnp.float32),
+                  jnp.ones(np1, jnp.float32))
+        lo = jnp.full((N, F), -3.0, jnp.float32)
+        inv = jnp.full((N, F), nbins / 6.0, jnp.float32)
+        f32_ms = timeit(partial(adaptive_level, n_prev=n_prev, n_nodes=N,
+                                level_base=base, W=W), X, nid, ghw,
+                        tables, lo, inv, xt=Xt)
+        packed_ms = timeit(partial(binned_level, n_prev=n_prev, n_nodes=N,
+                                   level_base=base, W=W), codes, nid,
+                           ghw, tables, ct=ct)
+        levels.append({"level": d, "n_nodes": N,
+                       "f32_ms": round(f32_ms, 3),
+                       "packed_ms": round(packed_ms, 3)})
+    return {"rows": rows, "W": W, "levels": levels,
+            "bytes_per_row": {"f32": F * 4,
+                              "packed": F * int(np.dtype(dt).itemsize)}}
+
+
 def main():
     import jax
     from h2o3_tpu import telemetry
@@ -171,12 +236,38 @@ def main():
             warm_h2d / max(model.ntrees_built, 1)),
         "stream_profile": model.output.get("stream_profile"),
         "spmd": model.output.get("spmd"),
+        # hot-loop representation (ISSUE 12): what the level kernel
+        # streamed — the packed int8/int16 path vs f32, with the
+        # cost-analysis-grounded bytes per (row x tree). The xprof
+        # capture above names the kernel itself (`_kernel_bt` for the
+        # binned path, `_kernel_t` for the f32 adaptive path) on the
+        # device timeline.
+        "packed_codes": model.output.get("packed_codes"),
+        "hot_kernel": ((model.output.get("packed_codes") or {})
+                       .get("kernel") or "adaptive_level"),
+        "hot_loop_bytes_per_row_tree": (
+            round(perf.get("train", {}).get("bytes_total", 0)
+                  / max(fr.nrow * model.ntrees_built, 1), 2)
+            if (perf.get("train") or {}).get("bytes_total") else None),
         # per-phase roofline points (ISSUE 11): cost_analysis-grounded
         # achieved flops/bytes, MFU and regime for the warm train —
         # recorded in the same run as the xprof capture above
         "perf": perf or None,
         "xprof_trace_dir": trace_dir,
     }
+    # per-level kernel split (ISSUE 12): standalone binned-vs-f32 level
+    # timings at this shape so the roofline table says WHERE the next
+    # 2x lives (H2O3_PROFILE_LEVEL_SPLIT=0 skips the probe)
+    if os.environ.get("H2O3_PROFILE_LEVEL_SPLIT", "1") not in (
+            "0", "false", ""):
+        try:
+            out["level_split"] = _level_split(fr.nrow, fr.ncol - 1,
+                                              NBINS, DEPTH)
+            for lv in out["level_split"]["levels"]:
+                log(f"level[{lv['level']}] n_nodes={lv['n_nodes']}: "
+                    f"f32 {lv['f32_ms']}ms  packed {lv['packed_ms']}ms")
+        except Exception as e:  # probe must never sink the profile
+            log(f"level-split probe FAILED: {e!r}")
     print(json.dumps(out))
     return out
 
