@@ -453,6 +453,30 @@ def main():
             out["resilience"] = run_chaos_round(rows=2000, log=log)
         except Exception as e:  # must never sink the headline run
             log(f"chaos round FAILED to run: {e!r}")
+    # fleet round (ISSUE 13): N serve-replica PROCESSES behind the
+    # consistent-hash router, one SIGKILLed mid-traffic — records the
+    # multi-replica throughput (vs a single replica at the same client
+    # count), the membership shed latency and the rebalance verdict.
+    # Informational on CPU (real parallelism but no device contention);
+    # the TPU round enforces the >=2.5x speedup + shed-within-one-beat
+    # shape. H2O3_BENCH_FLEET=0 skips.
+    if os.environ.get("H2O3_BENCH_FLEET", "1") not in ("0", "false", ""):
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            from chaos_sweep import run_kill_replica_round
+            fl = run_kill_replica_round(log=log)
+            # perf_gate's dotted-path lookup resolves
+            # fleet.{rows_per_sec,shed_ms} through this nested dict —
+            # no flat copies to drift out of sync
+            out["fleet"] = fl
+            log(f"fleet: {fl.get('replicas')} replicas "
+                f"{fl.get('rows_per_sec')} rows/s "
+                f"(x{fl.get('speedup')} vs single) "
+                f"shed={fl.get('shed_ms')}ms "
+                f"rebalance_ok={fl.get('rebalance_ok')}")
+        except Exception as e:  # must never sink the headline run
+            log(f"fleet round FAILED to run: {e!r}")
     # multichip scaling round (ISSUE 7): rows/s/chip at n_devices ∈
     # {1,4,8} with a scaling-efficiency verdict (tools/multichip_bench.py
     # runs in its OWN process so a single-chip parent can still force

@@ -766,6 +766,199 @@ class PallasGridSpecRule(Rule):
 
 
 # ======================================================================
+# fleet-peer-discipline
+# ======================================================================
+
+# Modules allowed to read the peer/seed env vars: they ARE the
+# member-table seam (telemetry's env fallback + the fleet seed read).
+_BLESSED_PEER_MODULES = (
+    "h2o3_tpu/telemetry/snapshot.py",
+    "h2o3_tpu/fleet/membership.py",
+)
+
+_PEER_ENV_VARS = ("H2O3_TELEMETRY_PEERS", "H2O3_FLEET_SEEDS")
+
+
+class FleetPeerDisciplineRule(Rule):
+    """Router/membership hygiene for the serving fleet (ISSUE 13 —
+    pre-landed with the router per the ROADMAP).
+
+    Sub-checks:
+
+    - **static-peer-env**: reading ``H2O3_TELEMETRY_PEERS`` /
+      ``H2O3_FLEET_SEEDS`` (``os.environ.get``/``os.getenv``/
+      ``environ[...]``) outside the blessed member-table seam modules.
+      A static peer list read anywhere else is exactly the
+      operator-edits-an-env-var failure mode dynamic membership
+      retires: peer sets must come from the member table
+      (``fleet.router().table`` / ``telemetry.snapshot.peer_view``),
+      which a dead replica LEAVES. Writes (launchers exporting the env
+      to children) are not flagged — only reads create a second
+      source of membership truth.
+    - **unretried-peer-http**: a ``urlopen`` call inside
+      ``h2o3_tpu/fleet/`` that (a) is not enclosed in a function or
+      lambda passed to ``resilience.retry_transient`` or (b) carries
+      no explicit ``timeout=``. Cross-replica calls ride the one
+      shared retry/backoff policy with a bounded deadline, or a sick
+      peer pins the caller (the telemetry scrape's own single-try
+      fetch has its module-level deadline loop and is out of scope).
+    - **epoch-blind-routing**: a routing decision point (a function
+      whose name contains ``route`` or ``failover`` in
+      ``fleet/router.py``) that never references a membership
+      ``epoch``. Decisions made without pinning the view they were
+      made under can act on (and retry into) a dead epoch — the
+      resurrection class the member table's fencing exists to stop.
+
+    Tightening decisions: a route/failover-named helper that never
+    touches membership state (no ``table``/``live_members``/
+    ``members`` reference — e.g. a failure-mode classifier like
+    ``_safe_to_failover``) makes no routing decision and is exempt
+    from the epoch check.
+    """
+
+    name = "fleet-peer-discipline"
+    severity = SEV_ERROR
+
+    def check_module(self, mod: ModuleInfo) -> List[Finding]:
+        in_tests = mod.relpath.startswith("tests/") or \
+            "/tests/" in mod.relpath
+        if in_tests:
+            return []
+        out: List[Finding] = []
+        if not mod.relpath.endswith(_BLESSED_PEER_MODULES):
+            out.extend(self._static_peer_env(mod))
+        if "h2o3_tpu/fleet/" in mod.relpath or \
+                mod.relpath.startswith("fleet/"):
+            out.extend(self._unretried_peer_http(mod))
+        if mod.relpath.endswith("fleet/router.py"):
+            out.extend(self._epoch_blind_routing(mod))
+        return out
+
+    # -- sub-check (a): static peer env reads ---------------------------
+
+    def _static_peer_env(self, mod: ModuleInfo) -> Iterable[Finding]:
+        attach_parents(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Constant)
+                    and node.value in _PEER_ENV_VARS):
+                continue
+            parent = getattr(node, "_h2o3_parent", None)
+            is_read = False
+            if isinstance(parent, ast.Call):
+                head = dotted_name(parent.func) or ""
+                if head.endswith(("environ.get", "getenv")) \
+                        and parent.args and parent.args[0] is node:
+                    is_read = True
+            elif isinstance(parent, ast.Subscript) and isinstance(
+                    getattr(parent, "ctx", None), ast.Load):
+                base = dotted_name(parent.value) or ""
+                if base.endswith("environ"):
+                    is_read = True
+            if is_read:
+                yield self.finding(
+                    mod, node,
+                    f"static peer list read ({node.value}) outside the "
+                    f"member-table seam — peer sets must come from the "
+                    f"membership layer (fleet.router().table / "
+                    f"telemetry.snapshot.peer_view), which a dead "
+                    f"replica actually leaves")
+
+    # -- sub-check (b): unretried / deadline-less peer HTTP -------------
+
+    @staticmethod
+    def _retried_scopes(mod: ModuleInfo) -> Set[int]:
+        """ids of FunctionDef/Lambda nodes whose body runs under
+        retry_transient: lambdas passed directly, plus defs whose NAME
+        is passed (the nested-closure spelling)."""
+        retried_names: Set[str] = set()
+        retried_nodes: Set[int] = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            head = dotted_name(node.func) or ""
+            if not head.endswith("retry_transient"):
+                continue
+            if node.args:
+                arg0 = node.args[0]
+                if isinstance(arg0, ast.Lambda):
+                    retried_nodes.add(id(arg0))
+                elif isinstance(arg0, ast.Name):
+                    retried_names.add(arg0.id)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in retried_names:
+                retried_nodes.add(id(node))
+        return retried_nodes
+
+    def _unretried_peer_http(self, mod: ModuleInfo) -> Iterable[Finding]:
+        attach_parents(mod.tree)
+        retried = self._retried_scopes(mod)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            head = dotted_name(node.func) or ""
+            if not (head == "urlopen" or head.endswith(".urlopen")):
+                continue
+            if "timeout" not in {kw.arg for kw in node.keywords}:
+                yield self.finding(
+                    mod, node,
+                    "cross-replica urlopen without an explicit "
+                    "timeout= — a sick peer pins this caller; bound "
+                    "every fleet HTTP call by the request deadline")
+            under_retry = False
+            for anc in ancestors(node):
+                if isinstance(anc, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef, ast.Lambda)):
+                    if id(anc) in retried:
+                        under_retry = True
+                    break
+            if not under_retry:
+                yield self.finding(
+                    mod, node,
+                    "cross-replica urlopen outside "
+                    "resilience.retry_transient — fleet HTTP rides the "
+                    "one shared transient-retry policy (wrap the "
+                    "calling closure in retry_transient; attempts=1 "
+                    "where failover is the retry)")
+
+    # -- sub-check (c): epoch-blind routing decisions -------------------
+
+    def _epoch_blind_routing(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            low = node.name.lower()
+            if "route" not in low and "failover" not in low:
+                continue
+            has_epoch = False
+            touches_membership = False
+            for ref in ast.walk(node):
+                if isinstance(ref, ast.Attribute):
+                    if "epoch" in ref.attr.lower():
+                        has_epoch = True
+                        break
+                    if ref.attr in ("table", "live_members", "members"):
+                        touches_membership = True
+                elif isinstance(ref, ast.Name):
+                    if "epoch" in ref.id.lower():
+                        has_epoch = True
+                        break
+                    if ref.id in ("table", "live_members", "members"):
+                        touches_membership = True
+            if not touches_membership:
+                continue        # a classifier/helper, not a decision
+            if not has_epoch:
+                yield self.finding(
+                    mod, node,
+                    f"routing decision point '{node.name}' never "
+                    f"references a membership epoch — decisions must "
+                    f"pin the view they were made under (and failover "
+                    f"must re-read it) so a dead epoch is never "
+                    f"routed into")
+
+
+# ======================================================================
 # registry
 # ======================================================================
 
@@ -779,6 +972,7 @@ def all_rules(hot_zones: Optional[Dict[str, Tuple[str, ...]]] = None
         FaultSeamRule(),
         MonotonicDurationsRule(),
         PallasGridSpecRule(),
+        FleetPeerDisciplineRule(),
     ]
 
 

@@ -685,6 +685,142 @@ def _serve_stats(params, body):
     return schemas.serve_stats_v3(serve.stats())
 
 
+# ---------------- fleet front door (h2o3_tpu.fleet) --------------------
+# Membership + routing: replicas join/heartbeat/leave against THIS
+# process's member table (the SURVEY §L1 heartbeat-cloud shape over
+# REST), and /3/Fleet/models/{m}/rows proxies a scoring request to the
+# consistent-hash home replica with single failover (ISSUE 13).
+
+
+def _fleet_body(params, body) -> Dict[str, Any]:
+    """Fleet control-plane payloads arrive as JSON bodies (the agent's
+    spelling) or form/query params (curl-friendly)."""
+    out: Dict[str, Any] = {}
+    if body:
+        try:
+            out.update(json.loads(body.decode()))
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            pass
+    for k, v in params.items():
+        out.setdefault(k, _coerce(v) if isinstance(v, str) else v)
+    return out
+
+
+@route("GET", "/3/Fleet")
+def _fleet_view(params, body):
+    """Membership view: epoch, members with per-member phi suspicion /
+    load / deployments, recent departures."""
+    from h2o3_tpu import fleet
+    return {"__meta": {"schema_version": 3, "schema_name": "FleetV3"},
+            **fleet.router().table.view()}
+
+
+@route("POST", "/3/Fleet/join")
+def _fleet_join(params, body):
+    """Admit (or re-admit) a replica. Response carries the incarnation
+    token fencing its heartbeats, the current epoch, and the registry
+    snapshot the replica pre-warms from before marking routable."""
+    from h2o3_tpu import fleet, serve
+    b = _fleet_body(params, body)
+    member_id = b.get("member_id")
+    base_url = b.get("base_url")
+    if not member_id or not base_url:
+        raise ApiError(400, "join requires member_id and base_url")
+    hb_ms = b.get("heartbeat_ms")
+    m = fleet.router().table.join(
+        str(member_id), str(base_url),
+        heartbeat_s=(float(hb_ms) / 1000.0 if hb_ms else None),
+        deployments=tuple(b.get("deployments") or ()),
+        routable=bool(b.get("routable", False)))
+    return {"__meta": {"schema_version": 3, "schema_name": "FleetJoinV3"},
+            "member_id": m.member_id, "incarnation": m.incarnation,
+            "epoch": fleet.router().table.epoch,
+            "heartbeat_ms": m.heartbeat_s * 1000.0,
+            "registry": serve.registry_snapshot()}
+
+
+@route("POST", "/3/Fleet/heartbeat")
+def _fleet_heartbeat(params, body):
+    """One member beat. 404 = unknown member (join first), 409 = stale
+    incarnation (a dead epoch cannot resurrect a member — rejoin).
+    The response piggybacks every OTHER member's circuit states — the
+    push-gossip channel that replaced the telemetry-scrape pull."""
+    from h2o3_tpu import fleet
+    b = _fleet_body(params, body)
+    member_id = str(b.get("member_id") or "")
+    table = fleet.router().table
+    try:
+        table.heartbeat(
+            member_id, int(b.get("incarnation") or 0),
+            load=float(b.get("load") or 0.0),
+            deployments=tuple(b["deployments"])
+            if b.get("deployments") is not None else None,
+            circuit=b.get("circuit"),
+            routable=b.get("routable"))
+    except fleet.UnknownMemberError as e:
+        raise ApiError(404, f"{e} — POST /3/Fleet/join")
+    except fleet.StaleEpochError as e:
+        raise ApiError(409, str(e))
+    gossip = []
+    for m in table.members():
+        if m.member_id == member_id:
+            continue
+        for st in m.circuit:
+            gossip.append({**st, "source": m.member_id})
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "FleetHeartbeatV3"},
+            "ok": True, "epoch": table.epoch, "gossip": gossip}
+
+
+@route("POST", "/3/Fleet/leave")
+def _fleet_leave(params, body):
+    from h2o3_tpu import fleet
+    b = _fleet_body(params, body)
+    left = fleet.router().table.leave(str(b.get("member_id") or ""))
+    return {"__meta": {"schema_version": 3, "schema_name": "FleetLeaveV3"},
+            "left": bool(left), "epoch": fleet.router().table.epoch}
+
+
+@route("GET", "/3/Fleet/registry")
+def _fleet_registry(params, body):
+    """The warm cold-start snapshot: every deployment's model key +
+    deploy config (also piggybacked on the join response)."""
+    from h2o3_tpu import serve
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "FleetRegistryV3"},
+            **serve.registry_snapshot()}
+
+
+@route("POST", "/3/Fleet/models/{model}/rows")
+def _fleet_predict(params, body, model):
+    """Routed scoring: consistent-hash home-replica dispatch with
+    least-loaded fallback and single failover; 503 + Retry-After when
+    the live set cannot absorb the request. ``key`` pins the routing
+    key (default: the model — all of one model's traffic shares a
+    home until it falls back)."""
+    from h2o3_tpu import fleet
+    b = _fleet_body(params, body)
+    rows = b.get("rows")
+    if not isinstance(rows, list) or not all(
+            isinstance(r, dict) for r in rows):
+        raise ApiError(400, 'expected {"rows": [{column: value, ...}]}')
+    tmo = b.get("timeout_ms")
+    try:
+        out = fleet.router().predict_rows(
+            model, rows,
+            key=str(b["key"]) if b.get("key") is not None else None,
+            timeout_ms=float(tmo) if tmo is not None else None)
+    except fleet.FleetUnavailableError as e:
+        import math
+        raise ApiError(503, str(e), headers={
+            "Retry-After": str(max(int(math.ceil(e.retry_after_s)), 1))})
+    except fleet.RouterError as e:
+        raise ApiError(getattr(e, "http_status", 500), str(e))
+    out.setdefault("__meta", {"schema_version": 3,
+                              "schema_name": "FleetPredictionsV3"})
+    return out
+
+
 # ---------------- fault injection admin (h2o3_tpu.faults) --------------
 # Chaos tooling surface: inspect/set/clear the deterministic fault spec
 # (same grammar as the H2O3_FAULTS env var). No reference analog.

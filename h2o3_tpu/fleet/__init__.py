@@ -1,0 +1,84 @@
+"""h2o3_tpu.fleet — the serving fleet's front door.
+
+Dynamic replica membership (join/leave/heartbeat against an
+epoch-numbered member table, phi-style suspicion, one-heartbeat
+eviction) plus a consistent-hash front router with least-loaded
+fallback, single failover and warm cold-start. REST surface:
+``GET/POST /3/Fleet/*`` (api/server.py).
+
+Process-wide singletons: a process that answers ``/3/Fleet/join`` IS a
+router (``router()`` lazily owns the member table); a serve replica
+runs one ``FleetAgent``. Both are optional — a process that never
+touches the fleet pays nothing.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from h2o3_tpu.fleet.agent import FleetAgent
+from h2o3_tpu.fleet.membership import (Member, MemberTable,
+                                       StaleEpochError,
+                                       UnknownMemberError, heartbeat_ms,
+                                       seeds)
+from h2o3_tpu.fleet.router import (ConsistentHashRing,
+                                   FleetRouter,
+                                   FleetUnavailableError,
+                                   ReplicaDispatchError, RouterError)
+
+__all__ = ["ConsistentHashRing", "FleetAgent", "FleetRouter",
+           "FleetUnavailableError", "Member", "MemberTable",
+           "ReplicaDispatchError", "RouterError", "StaleEpochError",
+           "UnknownMemberError", "heartbeat_ms", "router", "reset",
+           "seeds"]
+
+_ROUTER: Optional[FleetRouter] = None
+_MU = threading.Lock()
+
+
+def router() -> FleetRouter:
+    """This process's front router (created on first use — the
+    /3/Fleet REST handlers and the bench share it). Wires the member
+    table's departure callbacks into the serve circuit store and the
+    telemetry peer source exactly once."""
+    global _ROUTER
+    with _MU:
+        if _ROUTER is None:
+            r = FleetRouter()
+            _wire(r)
+            r.start_ticker()
+            _ROUTER = r
+        return _ROUTER
+
+
+def _wire(r: FleetRouter) -> None:
+    # churn hygiene (ISSUE 13 satellites): a departed member's circuit
+    # gossip drops NOW (not after its TTL) and the telemetry cluster
+    # scrape stops merging its series, flagging it in the scrape meta
+    from h2o3_tpu.serve import fleet as serve_fleet
+
+    def _on_depart(member, reason):
+        serve_fleet.drop_source(member.member_id)
+
+    r.table.on_depart.append(_on_depart)
+    from h2o3_tpu.telemetry import snapshot as telesnap
+
+    def _peer_view():
+        live = [m.base_url for m in r.table.members()
+                if m.state in ("alive", "suspect")]
+        return live, r.table.departed()
+
+    telesnap.PEER_SOURCE = _peer_view
+
+
+def reset() -> None:
+    """Tear down the process router (tests)."""
+    global _ROUTER
+    with _MU:
+        r = _ROUTER
+        _ROUTER = None
+    if r is not None:
+        r.stop_ticker()
+        r.table.reset()
+        from h2o3_tpu.telemetry import snapshot as telesnap
+        telesnap.PEER_SOURCE = None

@@ -1,0 +1,405 @@
+"""Dynamic replica membership: the gossiped member table.
+
+Reference: H2O-3's L1 cloud runtime — Paxos-formed membership with
+heartbeats, where every node learns the cloud's shape from the beat
+stream and a silent node is voted out (SURVEY §L1/§L2). The serving
+fleet here is N independent serve replicas (separate JAX processes)
+plus a front router; there is no shared runtime, so membership is a
+TABLE the router owns and replicas maintain by pushing heartbeats:
+
+- **join**: a replica announces itself (``POST /3/Fleet/join`` against
+  a seed from ``H2O3_FLEET_SEEDS`` — no static peer list anywhere
+  else). Admission hands back an *incarnation* token (the membership
+  epoch at admission) that fences every later heartbeat.
+- **heartbeat**: every ``H2O3_FLEET_HEARTBEAT_MS`` the replica pushes
+  its incarnation, load (batcher queue fill), deployments and
+  circuit-breaker states. The circuit payload is the push-gossip
+  channel: an open circuit reaches the router on the NEXT beat and
+  every peer on the beat after (sub-scrape shed latency — the
+  scrape-pull path in serve/fleet.py is now the fallback, not the
+  vehicle).
+- **suspicion → eviction**: phi-style accrual over the member's
+  OBSERVED beat arrivals (mean interval learned per member, seeded
+  from its declared rate). One missed beat crosses the suspect
+  threshold — the router sheds routed traffic immediately — and one
+  more evicts: the member leaves the table, the epoch bumps, and the
+  eviction callbacks fire (circuit entries for that source drop,
+  telemetry stops merging its series).
+- **epoch fencing**: every view change (join/leave/evict/routable
+  flip) bumps a monotonic epoch. A heartbeat carrying a stale
+  incarnation — the member was evicted, or this is a late packet from
+  a previous life of the same member id — is rejected with
+  :class:`StaleEpochError` (409 over REST, the agent re-joins) so a
+  dead epoch can never resurrect a member or overwrite its successor.
+
+The table is transport-free by design: REST handlers (api/server.py)
+and in-process tests drive the same methods. All interval math is
+monotonic; wall times appear only as reported join stamps.
+"""
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = ["Member", "MemberTable", "StaleEpochError", "UnknownMemberError",
+           "heartbeat_ms", "seeds",
+           "ALIVE", "JOINING", "SUSPECT", "LEFT", "EVICTED"]
+
+JOINING = "joining"      # admitted, not yet routable (warming)
+ALIVE = "alive"
+SUSPECT = "suspect"      # missed ~one beat: shed routed traffic
+LEFT = "left"            # graceful leave (terminal, removed)
+EVICTED = "evicted"      # failure-detected removal (terminal, removed)
+
+# ln(10): the phi accrual below reports -log10 of the survival
+# probability of the current beat gap under an exponential model
+_LN10 = math.log(10.0)
+
+
+def heartbeat_ms() -> float:
+    """Fleet heartbeat period (``H2O3_FLEET_HEARTBEAT_MS``, default
+    500). Malformed values fall back — membership must not break on a
+    typo'd knob."""
+    try:
+        v = float(os.environ.get("H2O3_FLEET_HEARTBEAT_MS", "500") or 500)
+        return v if v > 0 else 500.0
+    except ValueError:
+        return 500.0
+
+
+def seeds() -> List[str]:
+    """Fleet seed endpoints (``H2O3_FLEET_SEEDS`` as comma-separated
+    host:port entries) — where a joining replica finds the router.
+    This is the ONE place the env is read; everything downstream goes
+    through the member table (fleet-peer-discipline lint rule)."""
+    raw = os.environ.get("H2O3_FLEET_SEEDS", "")
+    return [p.strip() for p in raw.split(",") if p.strip()]
+
+
+class UnknownMemberError(KeyError):
+    """Heartbeat/leave for a member the table does not hold (never
+    joined, or already evicted) — the sender must (re)join."""
+
+
+class StaleEpochError(RuntimeError):
+    """A heartbeat carried an incarnation token from a dead epoch —
+    a late packet from an evicted life of this member id. Rejected so
+    it cannot resurrect the member or overwrite its successor; maps to
+    409 over REST and the agent re-joins."""
+
+    def __init__(self, msg: str, current_incarnation: int):
+        super().__init__(msg)
+        self.current_incarnation = int(current_incarnation)
+
+
+@dataclass
+class Member:
+    member_id: str                    # e.g. "12345@host" — gossip source id
+    base_url: str                     # http://host:port of its REST surface
+    incarnation: int                  # table epoch at admission (the fence)
+    heartbeat_s: float                # declared beat period
+    state: str = JOINING
+    routable: bool = False            # warm cold-start complete
+    deployments: Tuple[str, ...] = ()
+    load: float = 0.0                 # batcher fill fraction (0..1+)
+    circuit: List[dict] = field(default_factory=list)
+    joined_wall: float = 0.0          # reported epoch stamp (not math)
+    last_beat: float = 0.0            # monotonic
+    beats: int = 0
+    # observed inter-arrival window for the phi estimator
+    intervals: deque = field(default_factory=lambda: deque(maxlen=16))
+
+    def mean_interval(self) -> float:
+        if len(self.intervals) >= 3:
+            return max(sum(self.intervals) / len(self.intervals), 1e-3)
+        return max(self.heartbeat_s, 1e-3)
+
+    def phi(self, now: float) -> float:
+        """Phi accrual: -log10 P(gap >= now-last_beat) under an
+        exponential arrival model with the member's learned mean
+        interval. phi ≈ 0.43 at one mean interval of silence, rising
+        without bound — thresholds below are expressed in missed-beat
+        multiples of the same mean for operator legibility."""
+        gap = max(now - self.last_beat, 0.0)
+        return gap / (self.mean_interval() * _LN10)
+
+    def missed_beats(self, now: float) -> float:
+        return max(now - self.last_beat, 0.0) / self.mean_interval()
+
+
+def _suspect_after() -> float:
+    """Missed-beat multiple that marks a member suspect (default 1.0 —
+    one silent beat period sheds its routed traffic) plus a fixed 30%
+    jitter allowance for scheduler delay."""
+    try:
+        v = float(os.environ.get("H2O3_FLEET_SUSPECT_BEATS", "1") or 1)
+    except ValueError:
+        v = 1.0
+    return max(v, 0.5) + 0.3
+
+
+def _evict_after() -> float:
+    """Missed-beat multiple that evicts (default 2.0 — one beat beyond
+    suspicion, the "one-heartbeat eviction" contract) plus the same
+    jitter allowance."""
+    try:
+        v = float(os.environ.get("H2O3_FLEET_EVICT_BEATS", "2") or 2)
+    except ValueError:
+        v = 2.0
+    return max(v, 1.0) + 0.3
+
+
+class MemberTable:
+    """The router's authoritative membership view. Thread-safe; every
+    mutation that changes what a router would decide bumps ``epoch``.
+
+    ``on_depart`` callbacks fire OUTSIDE the table lock with
+    ``(member, reason)`` for every leave/eviction — serve/fleet.py
+    drops the departed source's circuit entries there and telemetry
+    stops merging its series (the stale-departed-series fix)."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._members: Dict[str, Member] = {}
+        self._epoch = 0
+        self._departed: deque = deque(maxlen=32)   # (member_id, reason,
+        #                                             epoch, base_url)
+        self.on_depart: List[Callable[[Member, str], None]] = []
+
+    # -- view -----------------------------------------------------------
+
+    @property
+    def epoch(self) -> int:
+        with self._mu:
+            return self._epoch
+
+    def members(self) -> List[Member]:
+        with self._mu:
+            return list(self._members.values())
+
+    def get(self, member_id: str) -> Optional[Member]:
+        with self._mu:
+            return self._members.get(member_id)
+
+    def live_members(self) -> List[Member]:
+        """Members a router may dispatch to: routable, beating, not
+        suspect. Sweeps first so the verdict reflects the beat stream
+        as of NOW, not the last mutation."""
+        self.sweep()
+        with self._mu:
+            return [m for m in self._members.values()
+                    if m.state == ALIVE and m.routable]
+
+    def view(self) -> Dict[str, object]:
+        """The ``GET /3/Fleet`` body: epoch-stamped member list with
+        per-member suspicion, plus recent departures (evicted members
+        stay visible here — flagged, not resurrected)."""
+        self.sweep()
+        now = time.monotonic()
+        with self._mu:
+            return {
+                "epoch": self._epoch,
+                "heartbeat_ms": heartbeat_ms(),
+                "members": [{
+                    "member_id": m.member_id,
+                    "base_url": m.base_url,
+                    "incarnation": m.incarnation,
+                    "state": m.state,
+                    "routable": m.routable,
+                    "deployments": list(m.deployments),
+                    "load": round(m.load, 4),
+                    "beats": m.beats,
+                    "phi": round(m.phi(now), 3),
+                    "missed_beats": round(m.missed_beats(now), 2),
+                    "joined": m.joined_wall,
+                } for m in self._members.values()],
+                "departed": [{"member_id": mid, "reason": reason,
+                              "epoch": ep, "base_url": url}
+                             for (mid, reason, ep, url) in self._departed],
+            }
+
+    # -- mutation -------------------------------------------------------
+
+    def join(self, member_id: str, base_url: str, *,
+             heartbeat_s: Optional[float] = None,
+             deployments: Tuple[str, ...] = (),
+             routable: bool = False) -> Member:
+        """Admit (or re-admit) a member. A join under an id the table
+        already holds REPLACES the old record with a fresh incarnation
+        — the rejoin-after-eviction path — and any late heartbeat from
+        the previous life is fenced off by the incarnation mismatch."""
+        hb = float(heartbeat_s if heartbeat_s is not None
+                   else heartbeat_ms() / 1000.0)
+        with self._mu:
+            self._epoch += 1
+            m = Member(member_id=member_id, base_url=base_url.rstrip("/"),
+                       incarnation=self._epoch, heartbeat_s=max(hb, 1e-3),
+                       state=ALIVE if routable else JOINING,
+                       routable=bool(routable),
+                       deployments=tuple(deployments),
+                       joined_wall=time.time(),
+                       last_beat=time.monotonic())
+            self._members[member_id] = m
+        self._publish_gauges()
+        return m
+
+    def heartbeat(self, member_id: str, incarnation: int, *,
+                  load: float = 0.0,
+                  deployments: Optional[Tuple[str, ...]] = None,
+                  circuit: Optional[List[dict]] = None,
+                  routable: Optional[bool] = None) -> Member:
+        """Record one beat. Raises :class:`UnknownMemberError` when the
+        member is not in the table (evicted / never joined — the
+        sender must join) and :class:`StaleEpochError` when the
+        incarnation token belongs to a dead epoch."""
+        now = time.monotonic()
+        with self._mu:
+            m = self._members.get(member_id)
+            if m is None:
+                raise UnknownMemberError(
+                    f"member '{member_id}' is not in the table — join "
+                    f"first (evicted members must rejoin)")
+            if int(incarnation) != m.incarnation:
+                raise StaleEpochError(
+                    f"heartbeat from '{member_id}' carries incarnation "
+                    f"{incarnation} but the table holds "
+                    f"{m.incarnation} — a packet from a dead epoch "
+                    f"cannot resurrect or overwrite the member",
+                    current_incarnation=m.incarnation)
+            if m.beats > 0:
+                gap = max(now - m.last_beat, 1e-6)
+                # a resumption gap (the member was silent past the
+                # suspect line) is a STALL, not an arrival-cadence
+                # sample — folding it into the phi window would
+                # inflate the learned mean and desensitize the
+                # detector by exactly the events it exists to catch
+                if gap < m.mean_interval() * _suspect_after():
+                    m.intervals.append(gap)
+            m.last_beat = now
+            m.beats += 1
+            m.load = float(load)
+            if deployments is not None:
+                m.deployments = tuple(deployments)
+            if circuit is not None:
+                m.circuit = list(circuit)
+            became_routable = False
+            if routable is not None and bool(routable) != m.routable:
+                m.routable = bool(routable)
+                became_routable = True
+            state_flip = m.state == SUSPECT
+            if m.state in (SUSPECT, JOINING) and m.routable:
+                m.state = ALIVE
+            if became_routable or state_flip:
+                self._epoch += 1       # the routable set changed
+        self._publish_gauges()
+        return m
+
+    def leave(self, member_id: str) -> bool:
+        """Graceful departure; fires the depart callbacks so the
+        member's circuit entries and telemetry series expire NOW, not
+        after a TTL."""
+        return self._remove(member_id, "left")
+
+    def sweep(self) -> List[Member]:
+        """Run the failure detector: mark suspects, evict the silent.
+        Called lazily from every routing decision and view (plus the
+        router's ticker) — eviction latency is bounded by the busiest
+        of traffic and the ticker, never only by traffic."""
+        now = time.monotonic()
+        suspect_at, evict_at = _suspect_after(), _evict_after()
+        evicted: List[Member] = []
+        flipped = False
+        with self._mu:
+            for m in list(self._members.values()):
+                missed = m.missed_beats(now)
+                if missed >= evict_at:
+                    evicted.append(m)
+                elif missed >= suspect_at and m.state == ALIVE:
+                    m.state = SUSPECT
+                    self._epoch += 1
+                    flipped = True
+        for m in evicted:
+            self._remove(m.member_id, "evicted",
+                         expect_incarnation=m.incarnation,
+                         stale_after=evict_at)
+        if flipped and not evicted:
+            self._publish_gauges()
+        return evicted
+
+    def _remove(self, member_id: str, reason: str,
+                expect_incarnation: Optional[int] = None,
+                stale_after: Optional[float] = None) -> bool:
+        with self._mu:
+            m = self._members.get(member_id)
+            if m is None:
+                return False
+            if expect_incarnation is not None \
+                    and m.incarnation != expect_incarnation:
+                return False          # a fresh incarnation won the race
+            if stale_after is not None and \
+                    m.missed_beats(time.monotonic()) < stale_after:
+                # freshness recheck under the lock (the PR-10 watchdog
+                # race class): a beat that landed between the sweep's
+                # snapshot and this removal proves the member alive —
+                # evicting it anyway would churn the epoch and force a
+                # needless rejoin of a healthy replica
+                return False
+            del self._members[member_id]
+            self._epoch += 1
+            m.state = EVICTED if reason == "evicted" else LEFT
+            self._departed.append((member_id, reason, self._epoch,
+                                   m.base_url))
+        if reason == "evicted":
+            try:
+                from h2o3_tpu import telemetry
+                telemetry.counter(
+                    "h2o3_fleet_evictions_total",
+                    help="members removed by the failure detector").inc()
+            except Exception:   # noqa: BLE001 — telemetry never breaks this
+                pass
+        for cb in list(self.on_depart):
+            try:
+                cb(m, reason)
+            except Exception:   # noqa: BLE001 — callbacks are advisory
+                pass
+        self._publish_gauges()
+        return True
+
+    def departed(self) -> List[Dict[str, object]]:
+        """Recent leave/eviction records — the scrape-meta flag for
+        series that stopped merging (telemetry peers_evicted)."""
+        with self._mu:
+            return [{"member_id": mid, "reason": reason, "epoch": ep,
+                     "base_url": url}
+                    for (mid, reason, ep, url) in self._departed]
+
+    def reset(self) -> None:
+        """Drop everything (tests)."""
+        with self._mu:
+            self._members.clear()
+            self._departed.clear()
+            self._epoch = 0
+        self._publish_gauges()
+
+    # -- telemetry ------------------------------------------------------
+
+    def _publish_gauges(self) -> None:
+        try:
+            from h2o3_tpu import telemetry
+            with self._mu:
+                counts = {ALIVE: 0, JOINING: 0, SUSPECT: 0}
+                for m in self._members.values():
+                    counts[m.state] = counts.get(m.state, 0) + 1
+                epoch = self._epoch
+            for st, c in counts.items():
+                telemetry.gauge("h2o3_fleet_members", {"state": st},
+                                help="fleet member count by state").set(c)
+            telemetry.gauge("h2o3_fleet_epoch",
+                            help="membership view epoch").set(epoch)
+        except Exception:   # noqa: BLE001 — telemetry never breaks the table
+            pass

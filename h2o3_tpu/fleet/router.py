@@ -1,0 +1,368 @@
+"""The fleet front door: consistent-hash routing over live members.
+
+Reference: H2O-3's L2 key-hashed dispatch — every key has a home node
+computed from the cloud's member list, and work for that key lands
+there (SURVEY §L1/§L2). Here the router owns a
+:class:`~h2o3_tpu.fleet.membership.MemberTable` and dispatches scoring
+requests over the live, routable members:
+
+- **home replica**: consistent hashing (a hash ring with
+  ``H2O3_FLEET_RING_POINTS`` virtual points per member, default 64) of
+  the request's routing key — membership change moves only ~1/N of the
+  key space, so replica-local caches and batch coalescing stay warm
+  across churn.
+- **least-loaded fallback**: a request whose home replica is not live,
+  does not serve the model, or reports an open circuit for it falls
+  back to the least-loaded live member that can take it.
+- **single failover**: a dispatch that fails in a *provably
+  not-executed* way (connect refused/reset, a shed 503) retries ONCE
+  on the next live replica, under the request's remaining deadline.
+  Failure modes where the request may have executed (mid-response
+  errors, deadline blowouts) are NOT retried — scoring is idempotent
+  but the caller's latency budget is not, and proxied mutations
+  (deploy/undeploy) never retry at all.
+- **load shedding**: an empty live set, or a live set whose every
+  member reports a full batcher queue, sheds with 503 + ``Retry-After``
+  (one heartbeat interval — the soonest membership can change).
+
+Every routing decision pins the membership ``epoch`` it was made
+under; the failover path re-reads it so a decision from a dead epoch
+is never retried blindly (the fleet-peer-discipline lint rule
+machine-checks both).
+
+Cross-replica HTTP goes through ``resilience.retry_transient`` with an
+explicit per-call deadline — the same one policy every other network
+seam in the repo uses.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from bisect import bisect_left
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from h2o3_tpu.fleet.membership import (ALIVE, Member, MemberTable,
+                                       heartbeat_ms)
+
+__all__ = ["ConsistentHashRing", "FleetRouter", "RouterError",
+           "FleetUnavailableError", "ReplicaDispatchError"]
+
+
+class RouterError(RuntimeError):
+    http_status = 500
+
+
+class FleetUnavailableError(RouterError):
+    """No live replica can absorb this request: empty live set, every
+    queue full, or failover exhausted. 503 + Retry-After."""
+    http_status = 503
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
+class ReplicaDispatchError(RouterError):
+    """The chosen replica answered with an application error (the
+    request DID execute there, or may have) — surfaced as-is, never
+    retried onto another replica."""
+
+    def __init__(self, msg: str, http_status: int = 500,
+                 body: Optional[dict] = None):
+        super().__init__(msg)
+        self.http_status = int(http_status)
+        self.body = body or {}
+
+
+def _ring_points() -> int:
+    try:
+        v = int(os.environ.get("H2O3_FLEET_RING_POINTS", "64") or 64)
+        return v if v > 0 else 64
+    except ValueError:
+        return 64
+
+
+def _hash64(s: str) -> int:
+    return int.from_bytes(hashlib.blake2b(
+        s.encode(), digest_size=8).digest(), "big")
+
+
+class ConsistentHashRing:
+    """Classic virtual-node hash ring. Stability contract (asserted by
+    tests/test_fleet_router.py): removing one of N members re-homes
+    only the removed member's ~1/N key share; every other key keeps
+    its home."""
+
+    def __init__(self, member_ids: Sequence[str],
+                 points: Optional[int] = None):
+        self.points = points or _ring_points()
+        ring: List[Tuple[int, str]] = []
+        for mid in member_ids:
+            for i in range(self.points):
+                ring.append((_hash64(f"{mid}#{i}"), mid))
+        ring.sort()
+        self._hashes = [h for h, _ in ring]
+        self._owners = [m for _, m in ring]
+
+    def home(self, key: str) -> Optional[str]:
+        if not self._hashes:
+            return None
+        i = bisect_left(self._hashes, _hash64(key))
+        if i == len(self._hashes):
+            i = 0
+        return self._owners[i]
+
+
+class FleetRouter:
+    """One per front-door process. Owns the member table, keeps a hash
+    ring per membership epoch, and proxies scoring to the chosen
+    replica. ``dispatch`` is injectable for transport-free tests; the
+    default POSTs to the member's REST surface."""
+
+    def __init__(self, table: Optional[MemberTable] = None,
+                 dispatch: Optional[Callable] = None):
+        self.table = table if table is not None else MemberTable()
+        self._dispatch = dispatch or self._http_dispatch
+        self._ring_mu = threading.Lock()
+        self._ring_epoch = -1
+        self._ring: Optional[ConsistentHashRing] = None
+        self._ticker: Optional[threading.Timer] = None
+        self._ticking = False
+
+    # -- failure-detector ticker ---------------------------------------
+
+    def start_ticker(self) -> None:
+        """Sweep the member table once per heartbeat interval so a dead
+        replica is evicted even when no traffic is flowing (routing
+        decisions sweep lazily; idle fleets need the clock)."""
+        self._ticking = True
+        self._tick()
+
+    def _tick(self) -> None:
+        if not self._ticking:
+            return
+        try:
+            self.table.sweep()
+        finally:
+            t = threading.Timer(heartbeat_ms() / 1000.0, self._tick)
+            t.daemon = True
+            self._ticker = t
+            t.start()
+
+    def stop_ticker(self) -> None:
+        self._ticking = False
+        t = self._ticker
+        if t is not None:
+            t.cancel()
+
+    # -- ring -----------------------------------------------------------
+
+    def _ring_for(self, epoch: int,
+                  members: Sequence[Member]) -> ConsistentHashRing:
+        with self._ring_mu:
+            if self._ring is None or self._ring_epoch != epoch:
+                self._ring = ConsistentHashRing(
+                    sorted(m.member_id for m in members))
+                self._ring_epoch = epoch
+            return self._ring
+
+    # -- routing decisions ----------------------------------------------
+
+    @staticmethod
+    def _serves(m: Member, model: str) -> bool:
+        """A member with an empty deployment list is assumed universal
+        (a hand-built table, or a replica still resolving models) —
+        the dispatch-side 404 failover is the backstop if it turns
+        out to hold nothing. One that lists deployments must list the
+        model. An open piggybacked circuit for the model disqualifies
+        — the replica itself would only 503."""
+        if m.deployments and model not in m.deployments:
+            return False
+        for c in m.circuit:
+            if c.get("model") == model and c.get("state") == "open":
+                return False
+        return True
+
+    def route(self, model: str, key: Optional[str] = None,
+              exclude: Sequence[str] = ()) -> Tuple[Member, int]:
+        """Pick the target replica for one request: the routing key's
+        home on the consistent-hash ring when it is eligible, else the
+        least-loaded eligible live member. Returns ``(member, epoch)``
+        — the epoch the decision was made under fences the failover
+        path against deciding from a dead view."""
+        epoch = self.table.epoch
+        live = [m for m in self.table.live_members()
+                if m.member_id not in exclude]
+        retry_s = heartbeat_ms() / 1000.0
+        if not live:
+            raise FleetUnavailableError(
+                f"no live routable replica for '{model}' "
+                f"(membership epoch {epoch})", retry_after_s=retry_s)
+        eligible = [m for m in live if self._serves(m, model)]
+        if not eligible:
+            raise FleetUnavailableError(
+                f"no live replica serves '{model}' (of {len(live)} "
+                f"live; circuits open or model not deployed)",
+                retry_after_s=retry_s)
+        with_room = [m for m in eligible if m.load < 1.0]
+        if not with_room:
+            raise FleetUnavailableError(
+                f"every live replica serving '{model}' reports a full "
+                f"queue — shedding", retry_after_s=retry_s)
+        ring = self._ring_for(self.table.epoch,
+                              self.table.live_members())
+        home_id = ring.home(f"{model}|{key}" if key else model)
+        for m in with_room:
+            if m.member_id == home_id:
+                return m, epoch
+        return min(with_room, key=lambda m: (m.load, m.member_id)), epoch
+
+    # -- dispatch + failover --------------------------------------------
+
+    def predict_rows(self, model: str, rows: Sequence[dict], *,
+                     key: Optional[str] = None,
+                     timeout_ms: Optional[float] = None) -> dict:
+        """Routed scoring with single failover. Returns the replica's
+        response body plus routing metadata (``_fleet``). The failover
+        re-routes under the CURRENT epoch (the first decision's epoch
+        may be dead — that is the point of re-reading it) and respects
+        the request's remaining deadline."""
+        timeout_s = (float(timeout_ms) / 1000.0 if timeout_ms is not None
+                     else 10.0)
+        deadline = time.monotonic() + timeout_s
+        member, epoch = self.route(model, key=key)
+        try:
+            out = self._dispatch(member, model, rows, deadline)
+            out["_fleet"] = {"member": member.member_id, "epoch": epoch,
+                             "failover": False}
+            return out
+        except ReplicaDispatchError:
+            raise                       # executed (or may have): no retry
+        except FleetUnavailableError:
+            raise
+        except Exception as e:          # noqa: BLE001 — classified below
+            if not _safe_to_failover(e):
+                raise RouterError(
+                    f"dispatch to {member.member_id} failed "
+                    f"non-retryably: {e}") from e
+            return self._failover(model, rows, key=key, deadline=deadline,
+                                  failed=member, first_epoch=epoch,
+                                  cause=e)
+
+    def _failover(self, model: str, rows: Sequence[dict], *,
+                  key: Optional[str], deadline: float, failed: Member,
+                  first_epoch: int, cause: BaseException) -> dict:
+        """One retry on the next live replica. The membership epoch is
+        re-read: if the table already noticed the death the failed
+        member is gone from the live set anyway; if not, it is
+        excluded explicitly and reported suspect so the detector hears
+        about the failure one beat early."""
+        self.table.sweep()
+        epoch = self.table.epoch
+        remaining = deadline - time.monotonic()
+        if remaining <= 0.001:
+            raise FleetUnavailableError(
+                f"dispatch to {failed.member_id} failed ({cause}) with "
+                f"no deadline left for failover",
+                retry_after_s=heartbeat_ms() / 1000.0)
+        member, epoch = self.route(model, key=key,
+                                   exclude=(failed.member_id,))
+        try:
+            out = self._dispatch(member, model, rows, deadline)
+        except ReplicaDispatchError:
+            raise
+        except Exception as e:          # noqa: BLE001 — single failover
+            raise FleetUnavailableError(
+                f"failover to {member.member_id} also failed ({e}; "
+                f"first: {cause} on {failed.member_id}, epoch "
+                f"{first_epoch}->{epoch})",
+                retry_after_s=heartbeat_ms() / 1000.0) from e
+        out["_fleet"] = {"member": member.member_id, "epoch": epoch,
+                         "failover": True,
+                         "failed_member": failed.member_id}
+        try:
+            from h2o3_tpu import telemetry
+            telemetry.counter(
+                "h2o3_router_failover_total",
+                help="routed requests that failed over to a second "
+                     "replica").inc()
+        except Exception:   # noqa: BLE001 — telemetry never breaks routing
+            pass
+        return out
+
+    # -- transport -------------------------------------------------------
+
+    @staticmethod
+    def _http_dispatch(member: Member, model: str,
+                       rows: Sequence[dict], deadline: float) -> dict:
+        """POST the rows to the member's own predictions endpoint. The
+        per-call socket timeout is the request's REMAINING deadline,
+        and the call rides ``retry_transient`` (attempts=1: the
+        router's failover IS the retry policy for scoring — a same-
+        replica retry would double the latency cost of a sick host)."""
+        from h2o3_tpu import resilience
+        url = (f"{member.base_url}/3/Predictions/models/"
+               f"{urllib.parse.quote(model)}/rows")
+        payload = json.dumps({"rows": list(rows)}).encode()
+
+        def _call():
+            timeout = max(deadline - time.monotonic(), 0.001)
+            req = urllib.request.Request(
+                url, data=payload, method="POST",
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    return json.loads(r.read().decode())
+            except urllib.error.HTTPError as e:
+                body = {}
+                try:
+                    body = json.loads(e.read().decode())
+                except Exception:   # noqa: BLE001 — body is best-effort
+                    pass
+                if e.code in (503, 404):
+                    # 503: the replica shed (queue full / circuit
+                    # open); 404: it does not hold the model (a stale
+                    # deployment list, or a warm-up that resolved
+                    # nothing). Either way it provably never scored
+                    # the rows — safe to fail over to a replica that
+                    # can, instead of surfacing a 404 for a model the
+                    # rest of the fleet serves.
+                    raise ReplicaShedError(
+                        f"{member.member_id} shed with {e.code}: "
+                        f"{body.get('msg', '')}")
+                raise ReplicaDispatchError(
+                    f"{member.member_id} answered {e.code}: "
+                    f"{body.get('msg', e.reason)}",
+                    http_status=e.code, body=body)
+
+        return resilience.retry_transient(
+            _call, site="fleet.dispatch", attempts=1)
+
+
+class ReplicaShedError(RuntimeError):
+    """A replica's OWN admission control rejected the request (503) —
+    provably not executed, so the router may fail over."""
+
+
+# connect-class failures: the request provably never reached the
+# replica's handler, so a second replica may safely take it
+_CONNECT_MARKERS = ("connection refused", "connection reset",
+                    "connection aborted", "errno 111", "errno 104",
+                    "name or service not known", "no route to host",
+                    "remote end closed connection")
+
+
+def _safe_to_failover(exc: BaseException) -> bool:
+    if isinstance(exc, ReplicaShedError):
+        return True
+    if isinstance(exc, (ConnectionRefusedError, ConnectionResetError,
+                        ConnectionAbortedError)):
+        return True
+    msg = str(exc).lower()
+    return any(m in msg for m in _CONNECT_MARKERS)

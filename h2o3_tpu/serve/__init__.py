@@ -18,8 +18,9 @@ from h2o3_tpu.serve.registry import DEFAULT_BUCKETS, CompiledScorer
 from h2o3_tpu.serve.service import (Deployment, circuit_states, deploy,
                                     deployment, deployments, fleet,
                                     predict_columnar,
-                                    predict_rows, shutdown_all, stats,
-                                    undeploy)
+                                    predict_rows, prewarm_from_snapshot,
+                                    registry_snapshot, shutdown_all,
+                                    stats, undeploy)
 from h2o3_tpu.serve.stats import ServeStats
 
 __all__ = [
@@ -30,7 +31,7 @@ __all__ = [
     "ServeError", "ServeOverloadedError", "ServeStats",
     "circuit_states", "deploy",
     "deployment", "deployments", "fleet", "predict_columnar",
-    "predict_rows",
+    "predict_rows", "prewarm_from_snapshot", "registry_snapshot",
     "shutdown_all", "stats",
     "undeploy",
 ]

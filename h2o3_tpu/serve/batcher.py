@@ -510,6 +510,15 @@ class MicroBatcher:
         with self._mu:
             return self._pending_rows
 
+    @property
+    def load_factor(self) -> float:
+        """Queue fill fraction (0.0 empty → 1.0 at the admission
+        limit) — the load signal a fleet heartbeat carries so the
+        router's least-loaded fallback and can't-absorb-load 503 see
+        the same number admission control enforces."""
+        with self._mu:
+            return self._pending_rows / max(self.queue_limit, 1)
+
     def close(self, timeout: float = 5.0):
         with self._cv:
             self._closed = True

@@ -5,21 +5,30 @@ consecutively opens A's circuit, but replicas B..N keep queueing traffic
 at the same deployment (same poisoned model, same sick accelerator
 class) and burn their own ticks discovering it independently. The
 reference's answer is cloud membership — every node hears about a sick
-member on the heartbeat (SURVEY L1/L2); single-controller JAX processes
-share nothing, so circuit state rides the SAME pull-based telemetry
-plane PR 8 built:
+member on the heartbeat (SURVEY L1/L2). Since ISSUE 13 that is
+literally the vehicle: circuit state is PUSH gossip piggybacked on the
+fleet heartbeat, with the telemetry scrape as the pull fallback:
 
-- each process PUBLISHES its deployments' circuit states inside the
-  ``GET /3/Telemetry/snapshot`` body (``circuit`` field,
-  telemetry/snapshot.py);
-- every cluster scrape (``/3/Telemetry/cluster``,
-  ``/metrics?scope=cluster`` — peer list from ``H2O3_TELEMETRY_PEERS``)
-  feeds the fetched peers' circuit payloads into THIS store, so an open
-  circuit propagates fleet-wide within one telemetry scrape;
+- **push (primary)**: every fleet heartbeat carries this replica's
+  circuit states (``circuit_states()``) to the router; the heartbeat
+  RESPONSE piggybacks every peer's states back
+  (fleet/agent.py ``beat_once`` → ``observe_peer_states``), so an open
+  circuit anywhere sheds load on every member within two beats —
+  sub-second at the default 500ms beat, vs the multi-second scrape.
+- **pull (fallback)**: processes outside the fleet (static
+  ``H2O3_TELEMETRY_PEERS`` deployments, tests) still propagate through
+  the cluster scrape — each snapshot's ``circuit`` payload feeds this
+  store via ``PEER_SNAPSHOT_CONSUMERS`` exactly as PR 9 built it.
 - the serve admission path (``MicroBatcher.submit`` via the
   deployment's ``fleet_check``) consults ``reject_for``: an open PEER
   circuit for this deployment → fast 503 + ``Retry-After``, exactly the
   local breaker's client contract.
+
+Membership churn keeps the store honest: when a member leaves or is
+evicted, ``drop_source`` removes its entries NOW — before ISSUE 13 a
+dead replica's open report lingered for
+``max(retry_after, H2O3_FLEET_CIRCUIT_TTL)`` and kept shedding load
+toward a model only the dead replica served.
 
 Local state always wins over stale peer gossip:
 
@@ -150,6 +159,22 @@ def observe_peer_states(states: Optional[List[dict]], source: str,
         expired = _expire_locked(now)
         _set_has_open_locked()
     _publish_gauges(touched | expired)
+
+
+def drop_source(source: str) -> None:
+    """Membership-churn expiry (ISSUE 13): the member behind ``source``
+    left or was evicted, so every circuit entry it gossiped drops NOW —
+    a dead replica must not keep shedding this replica's load toward a
+    model only IT was failing on, and a departed-but-alive replica's
+    stale open report must not outlive its membership."""
+    touched = set()
+    with _MU:
+        for k in [k for k in _STORE if k[1] == source]:
+            touched.add(k[0])
+            del _STORE[k]
+        _set_has_open_locked()
+    if touched:
+        _publish_gauges(touched)
 
 
 def reject_for(model: str,

@@ -28,6 +28,7 @@ from h2o3_tpu.serve.stats import ServeStats, merge_snapshots
 __all__ = ["deploy", "undeploy", "deployment", "deployments",
            "predict_rows", "predict_columnar", "stats", "shutdown_all",
            "circuit_states", "fleet",
+           "registry_snapshot", "prewarm_from_snapshot",
            "Deployment",
            "ServeError", "ServeOverloadedError", "ServeDeadlineError",
            "ServeBadRequestError", "ServeClosedError",
@@ -284,6 +285,56 @@ def circuit_states() -> List[Dict[str, Any]]:
     ``circuit`` payload of this process's /3/Telemetry/snapshot body
     (peers ingest it via serve/fleet.py)."""
     return [dep.breaker.publish() for dep in deployments()]
+
+
+def registry_snapshot() -> Dict[str, Any]:
+    """Warm cold-start export (ISSUE 13): what a JOINING replica needs
+    to pre-warm before taking routed traffic — every deployment's model
+    key and deploy config. The model BITS are not shipped: replicas
+    resolve the key from their own DKV (identical training, restart
+    recovery, or a shared store); the shared persistent compile cache
+    turns the warm compiles into cache reads. Served at
+    ``GET /3/Fleet/registry`` and piggybacked on the join response."""
+    return {"version": 1,
+            "deployments": [{"model": dep.key,
+                             "algo": getattr(dep.model, "algo", "?"),
+                             "config": dict(dep.config)}
+                            for dep in deployments()]}
+
+
+def prewarm_from_snapshot(snapshot: Dict[str, Any]) -> Dict[str, Any]:
+    """Deploy (compile-warm) every model in a fleet registry snapshot
+    that THIS process can resolve from its DKV. Returns
+    ``{"deployed": [...], "skipped": [{"model", "reason"}, ...]}`` —
+    an unresolvable model is reported, never fatal: the router learns
+    what this replica actually serves from its heartbeat's deployment
+    list, so a partial prewarm degrades routing, not correctness."""
+    from h2o3_tpu import dkv
+    deployed: List[str] = []
+    skipped: List[Dict[str, str]] = []
+    for ent in (snapshot or {}).get("deployments") or []:
+        key = ent.get("model")
+        if not key:
+            continue
+        if deployment(key) is not None:
+            deployed.append(key)
+            continue
+        stored = dkv.get_opt(key)
+        if stored is None or stored[0] != "model":
+            skipped.append({"model": key,
+                            "reason": "model not resolvable in this "
+                                      "process's store"})
+            continue
+        cfg = {k: v for k, v in (ent.get("config") or {}).items()
+               if k in ("max_batch", "max_delay_ms", "queue_limit",
+                        "timeout_ms", "buckets", "circuit_failures",
+                        "circuit_open_ms")}
+        try:
+            deploy(key, **cfg)
+            deployed.append(key)
+        except Exception as e:   # noqa: BLE001 — warmup is best-effort
+            skipped.append({"model": key, "reason": repr(e)})
+    return {"deployed": deployed, "skipped": skipped}
 
 
 def stats() -> Dict[str, Any]:

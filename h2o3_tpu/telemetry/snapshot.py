@@ -49,6 +49,16 @@ SNAPSHOT_VERSION = 1
 # must not break the metrics scrape it rides on.
 PEER_SNAPSHOT_CONSUMERS: List[Callable[[dict, bool], None]] = []
 
+# Pluggable peer SOURCE (ISSUE 13): when the fleet membership layer is
+# active it registers a callable returning
+# (live peer addresses, departed-member records) — the cluster scrape
+# then follows the member table instead of the static env list, so a
+# replica that leaves or is evicted stops contributing its
+# ``process=``-labeled gauge series on the NEXT scrape (no TTL linger)
+# and shows up flagged in the scrape meta (``peers_evicted``) instead.
+# None = the H2O3_TELEMETRY_PEERS env fallback below.
+PEER_SOURCE: Optional[Callable[[], Tuple[List[str], List[dict]]]] = None
+
 
 def _notify_peer_consumers(snap: dict, self_process: bool) -> None:
     for cb in list(PEER_SNAPSHOT_CONSUMERS):
@@ -345,16 +355,33 @@ def merge_snapshots(snaps: List[dict]) -> List[dict]:
 
 # ------------------------------------------------------------- peers
 
-def peers() -> List[str]:
-    """Peer processes to pull snapshots from: ``H2O3_TELEMETRY_PEERS``
-    as comma-separated host:port entries (a replica launcher or the
-    multihost worker exports it). The list should EXCLUDE the local
+def peer_view() -> Tuple[List[str], List[dict]]:
+    """(live peer addresses, departed-member records). With a
+    registered ``PEER_SOURCE`` (fleet membership) the addresses track
+    the member table — members that left/were evicted drop immediately
+    and are returned as flagged departures for the scrape meta. Without
+    one, the static ``H2O3_TELEMETRY_PEERS`` env fallback (this is the
+    blessed read — the fleet-peer-discipline lint rule keeps it the
+    only one): comma-separated host:port entries a replica launcher or
+    the multihost worker exports. The list should EXCLUDE the local
     process — a shared everyone-gets-the-same-list spelling still works
     but double-counts local counters in this process's cluster view
     (flagged in ``peers_self``). Empty by default — the single-process
     aggregation path must cost nothing."""
+    src = PEER_SOURCE
+    if src is not None:
+        try:
+            addrs, departed = src()
+            return list(addrs), list(departed)
+        except Exception:   # noqa: BLE001 — a broken source must not
+            pass            # take the scrape down with it
     raw = os.environ.get("H2O3_TELEMETRY_PEERS", "")
-    return [p.strip() for p in raw.split(",") if p.strip()]
+    return [p.strip() for p in raw.split(",") if p.strip()], []
+
+
+def peers() -> List[str]:
+    """Peer processes to pull snapshots from (see :func:`peer_view`)."""
+    return peer_view()[0]
 
 
 def fetch_peer_snapshot(peer: str,
@@ -412,10 +439,15 @@ def cluster_samples(extra_snapshots: Optional[List[dict]] = None
     ``h2o3_telemetry_peers_failed``) so a Prometheus consumer can tell
     a partial scrape — where summed counters legitimately DIP — from a
     counter reset."""
-    plist = peers()
+    plist, departed = peer_view()
     meta: Dict[str, object] = {"processes": 1, "peers": len(plist),
                                "peers_ok": [], "peers_failed": [],
-                               "peers_self": []}
+                               "peers_self": [],
+                               # members that left/were evicted: their
+                               # series stopped merging at that epoch —
+                               # flagged so a dashboard can tell an
+                               # expired replica from a vanished one
+                               "peers_evicted": departed}
     if not plist and not extra_snapshots:
         return registry().samples(), meta
     snaps = [local_snapshot(max_spans=0)]
@@ -498,5 +530,6 @@ def cluster_snapshot() -> Dict[str, object]:
         "peers_ok": meta["peers_ok"],
         "peers_failed": meta["peers_failed"],
         "peers_self": meta["peers_self"],
+        "peers_evicted": meta.get("peers_evicted", []),
         "metrics": _flatten(samples),
     }

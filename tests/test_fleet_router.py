@@ -1,0 +1,489 @@
+"""Fleet front door (ISSUE 13): heartbeat membership + routing.
+
+Covers the tentpole's contracts end to end:
+
+- join/leave/heartbeat-eviction lifecycle with epoch bumps and depart
+  callbacks (phi-style suspicion → one-heartbeat eviction);
+- consistent-hash stability: membership change moves only the departed
+  member's ~1/N key share;
+- epoch fencing: a heartbeat from a dead incarnation cannot resurrect
+  or overwrite a member;
+- routed-prediction bit-parity with direct deployment scoring, and
+  single failover when the home replica dies mid-traffic;
+- warm cold-start: after a registry-snapshot prewarm the first ROUTED
+  request compiles zero XLA modules;
+- 503 + Retry-After when the live set is empty / cannot absorb load;
+- heartbeat-piggybacked circuit gossip sheds load sub-scrape and
+  eviction drops the departed source's entries (no TTL linger);
+- telemetry peers follow the member table (departed members flagged,
+  not merged).
+"""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu import dkv, fleet, serve
+from h2o3_tpu.fleet.membership import (ALIVE, JOINING, MemberTable,
+                                       StaleEpochError,
+                                       UnknownMemberError)
+from h2o3_tpu.fleet.router import ConsistentHashRing, FleetRouter
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+from _compile_counter import count_compiles  # noqa: E402 — shared harness
+
+# fast beats: suspicion at ~1.3 beats of silence, eviction at ~2.3.
+# 150ms keeps eviction waits short while leaving a wide margin between
+# "assert right after a beat" and the suspect threshold on a loaded
+# 1-core CI host (a 50ms beat left only ~65ms of scheduling slack).
+HB = 0.15
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _fleet_cleanup():
+    yield
+    serve.shutdown_all()
+    fleet.reset()
+
+
+def _train_frame(n=1500, seed=0):
+    rng = np.random.default_rng(seed)
+    a = rng.normal(size=n).astype(np.float32)
+    b = rng.uniform(-2, 2, size=n).astype(np.float32)
+    logit = a * 1.2 - b
+    y = rng.random(n) < 1 / (1 + np.exp(-logit))
+    return h2o.Frame.from_numpy({
+        "a": a, "b": b, "cls": np.where(y, "YES", "NO")})
+
+
+@pytest.fixture(scope="module")
+def gbm_model():
+    fr = _train_frame()
+    g = H2OGradientBoostingEstimator(ntrees=8, max_depth=3, seed=1,
+                                     min_rows=1.0)
+    g.train(y="cls", training_frame=fr)
+    g.model.key = "fleet_router_gbm"
+    dkv.put(g.model.key, "model", g.model)
+    return fr, g.model
+
+
+def _rows(fr, k=8):
+    a = fr.vec("a").to_numpy()
+    b = fr.vec("b").to_numpy()
+    return [{"a": float(a[i]), "b": float(b[i])} for i in range(k)]
+
+
+# ------------------------------------------------- membership lifecycle
+
+def test_join_heartbeat_leave_eviction_lifecycle():
+    t = MemberTable()
+    departs = []
+    t.on_depart.append(lambda m, reason: departs.append(
+        (m.member_id, reason)))
+    e0 = t.epoch
+    m1 = t.join("r1@h", "http://127.0.0.1:1", heartbeat_s=HB)
+    m2 = t.join("r2@h", "http://127.0.0.1:2", heartbeat_s=HB)
+    assert t.epoch == e0 + 2
+    # joining members are admitted but NOT routable until warm
+    assert m1.state == JOINING and not m1.routable
+    assert t.live_members() == []
+    t.heartbeat("r1@h", m1.incarnation, load=0.1, routable=True,
+                deployments=("m",))
+    t.heartbeat("r2@h", m2.incarnation, load=0.5, routable=True)
+    live = {m.member_id for m in t.live_members()}
+    assert live == {"r1@h", "r2@h"}
+    assert t.get("r1@h").state == ALIVE
+    # graceful leave fires the depart callback and bumps the epoch
+    e_before = t.epoch
+    assert t.leave("r2@h")
+    assert departs == [("r2@h", "left")]
+    assert t.epoch > e_before
+    # silence: one missed beat -> suspect (shed), one more -> evicted
+    deadline = time.monotonic() + 5.0
+    while t.get("r1@h") is not None and time.monotonic() < deadline:
+        t.sweep()
+        time.sleep(HB / 4)
+    assert t.get("r1@h") is None
+    assert ("r1@h", "evicted") in departs
+    view = t.view()
+    assert {d["member_id"] for d in view["departed"]} == {"r1@h", "r2@h"}
+
+
+def test_suspect_member_sheds_then_recovers():
+    hb = 0.4      # wide beat: the 1.6-beat sleep must land between the
+    t = MemberTable()             # suspect (1.3) and evict (2.3) lines
+    m = t.join("s1@h", "http://127.0.0.1:1", heartbeat_s=hb,
+               routable=True)
+    assert [x.member_id for x in t.live_members()] == ["s1@h"]
+    # miss ~1.6 beats: suspect, out of the routed set, still a member
+    time.sleep(hb * 1.6)
+    t.sweep()
+    got = t.get("s1@h")
+    assert got is not None and got.state == "suspect"
+    assert t.live_members() == []
+    # the next beat un-suspects it (the phi window re-learns)
+    t.heartbeat("s1@h", m.incarnation, routable=True)
+    assert [x.member_id for x in t.live_members()] == ["s1@h"]
+
+
+def test_epoch_fenced_stale_heartbeat_rejected():
+    t = MemberTable()
+    m_old = t.join("f1@h", "http://127.0.0.1:1", heartbeat_s=HB,
+                   routable=True)
+    # rejoin (new incarnation of the same id — e.g. restart): the OLD
+    # life's token is fenced off and cannot overwrite the successor
+    m_new = t.join("f1@h", "http://127.0.0.1:1", heartbeat_s=HB,
+                   routable=True)
+    assert m_new.incarnation > m_old.incarnation
+    with pytest.raises(StaleEpochError) as ei:
+        t.heartbeat("f1@h", m_old.incarnation, load=0.9)
+    assert ei.value.current_incarnation == m_new.incarnation
+    assert t.get("f1@h").load == 0.0        # stale beat changed nothing
+    # an evicted member's beat is unknown — it must JOIN, not resume
+    t.leave("f1@h")
+    with pytest.raises(UnknownMemberError):
+        t.heartbeat("f1@h", m_new.incarnation)
+
+
+# ------------------------------------------------ consistent-hash ring
+
+def test_consistent_hash_moves_only_departed_share():
+    members = [f"m{i}@h" for i in range(4)]
+    ring = ConsistentHashRing(members)
+    keys = [f"key-{i}" for i in range(4000)]
+    before = {k: ring.home(k) for k in keys}
+    shrunk = ConsistentHashRing([m for m in members if m != "m2@h"])
+    moved = [k for k in keys if shrunk.home(k) != before[k]]
+    # ONLY the departed member's keys re-home ...
+    assert all(before[k] == "m2@h" for k in moved)
+    # ... and every one of them does (it is gone from the ring)
+    assert len(moved) == sum(1 for k in keys if before[k] == "m2@h")
+    # its share is ~1/N (generous band: 64 virtual points jitter)
+    assert 0.10 < len(moved) / len(keys) < 0.45
+
+
+def test_ring_home_is_stable_and_balanced():
+    ring = ConsistentHashRing(["a", "b", "c"])
+    homes = [ring.home(f"k{i}") for i in range(3000)]
+    assert homes == [ring.home(f"k{i}") for i in range(3000)]
+    counts = {m: homes.count(m) for m in ("a", "b", "c")}
+    assert all(c > 300 for c in counts.values()), counts
+
+
+# -------------------------------------------------- routing + shedding
+
+def test_router_503_when_live_set_empty():
+    r = FleetRouter(table=MemberTable())
+    with pytest.raises(fleet.FleetUnavailableError) as ei:
+        r.route("some_model")
+    assert ei.value.http_status == 503
+    assert ei.value.retry_after_s > 0
+
+
+def test_router_503_when_every_queue_full():
+    t = MemberTable()
+    m = t.join("q1@h", "http://127.0.0.1:1", heartbeat_s=10.0,
+               routable=True)
+    t.heartbeat("q1@h", m.incarnation, load=1.0, routable=True)
+    r = FleetRouter(table=t)
+    with pytest.raises(fleet.FleetUnavailableError) as ei:
+        r.route("m")
+    assert "full" in str(ei.value)
+
+
+def test_route_prefers_home_then_least_loaded():
+    t = MemberTable()
+    for i, load in enumerate((0.7, 0.1, 0.4)):
+        m = t.join(f"h{i}@h", f"http://127.0.0.1:{i}", heartbeat_s=10.0,
+                   routable=True)
+        t.heartbeat(f"h{i}@h", m.incarnation, load=load, routable=True)
+    r = FleetRouter(table=t)
+    ring = ConsistentHashRing(sorted(m.member_id for m in t.members()))
+    chosen, epoch = r.route("modelX", key="row-17")
+    assert chosen.member_id == ring.home("modelX|row-17")
+    assert epoch == t.epoch
+    # a home with an open circuit for the model falls back to the
+    # LEAST-LOADED eligible member
+    home_id = chosen.member_id
+    t.heartbeat(home_id, t.get(home_id).incarnation,
+                circuit=[{"model": "modelX", "state": "open"}],
+                routable=True)
+    chosen2, _ = r.route("modelX", key="row-17")
+    others = [m for m in t.members() if m.member_id != home_id]
+    assert chosen2.member_id == min(
+        others, key=lambda m: (m.load, m.member_id)).member_id
+
+
+def test_single_failover_on_connect_refused_and_not_on_app_error():
+    t = MemberTable()
+    for i in range(2):
+        mid = f"d{i}@h"
+        m = t.join(mid, f"http://127.0.0.1:{i}", heartbeat_s=10.0,
+                   routable=True)
+        t.heartbeat(mid, m.incarnation, routable=True)
+    calls = []
+
+    def dispatch(member, model, rows, deadline):
+        calls.append(member.member_id)
+        if len(calls) == 1:
+            raise ConnectionRefusedError("connection refused")
+        return {"predictions": [{"predict": "ok"}]}
+
+    r = FleetRouter(table=t, dispatch=dispatch)
+    out = r.predict_rows("m", [{}], key="k")
+    assert out["_fleet"]["failover"] is True
+    assert len(set(calls)) == 2          # two DIFFERENT replicas
+    # an application error (the request executed) never fails over
+    calls.clear()
+
+    def app_error(member, model, rows, deadline):
+        calls.append(member.member_id)
+        raise fleet.ReplicaDispatchError("boom", http_status=500)
+
+    r2 = FleetRouter(table=t, dispatch=app_error)
+    with pytest.raises(fleet.ReplicaDispatchError):
+        r2.predict_rows("m", [{}], key="k")
+    assert len(calls) == 1
+
+
+# ----------------------------------------- REST integration + parity
+
+@pytest.fixture(scope="module")
+def servers(gbm_model):
+    """Two REST surfaces over this process's serve registry — two
+    fleet members from the router's point of view (distinct base_urls,
+    shared deployment bits, so parity is well-defined)."""
+    from h2o3_tpu.api.server import H2OApiServer
+    fr, model = gbm_model
+    # small bucket set: the module's requests are <=64 rows, so the
+    # default 512/4096 warm compiles would only add tier-1 wall time
+    serve.deploy(model.key, max_delay_ms=1.0, max_batch=64,
+                 buckets=[1, 8, 64])
+    s1 = H2OApiServer(port=0).start()
+    s2 = H2OApiServer(port=0).start()
+    yield s1, s2
+    try:
+        s1.stop()
+    except Exception:
+        pass
+    try:
+        s2.stop()
+    except Exception:
+        pass
+    serve.undeploy(model.key)
+
+
+def _join_routable(table, mid, server, deployments):
+    m = table.join(mid, f"http://127.0.0.1:{server.port}",
+                   heartbeat_s=30.0, deployments=deployments)
+    table.heartbeat(mid, m.incarnation, routable=True,
+                    deployments=deployments)
+    return m
+
+
+def test_routed_prediction_bit_parity_with_direct(servers, gbm_model):
+    fr, model = gbm_model
+    s1, s2 = servers
+    t = MemberTable()
+    _join_routable(t, "p1@h", s1, (model.key,))
+    _join_routable(t, "p2@h", s2, (model.key,))
+    r = FleetRouter(table=t)
+    rows = _rows(fr, 8)
+    direct = serve.predict_rows(model.key, rows)
+    for key in ("k1", "k2", "k3"):
+        out = r.predict_rows(model.key, rows, key=key)
+        assert out["_fleet"]["failover"] is False
+        routed = out["predictions"]
+        assert len(routed) == len(direct)
+        for rr, dd in zip(routed, direct):
+            assert rr["label"] == dd["label"]
+            # probabilities survive the JSON proxy hop bit-exactly
+            assert rr["classProbabilities"] == dd["classProbabilities"]
+
+
+def test_failover_mid_traffic_keeps_parity(servers, gbm_model):
+    fr, model = gbm_model
+    s1, s2 = servers
+    t = MemberTable()
+    _join_routable(t, "x1@h", s1, (model.key,))
+    # the second member's port answers nothing (server stopped below
+    # via a dead port): use an unbound port to simulate a dead replica
+    dead = t.join("x2@h", "http://127.0.0.1:9", heartbeat_s=30.0,
+                  deployments=(model.key,))
+    t.heartbeat("x2@h", dead.incarnation, routable=True,
+                deployments=(model.key,))
+    r = FleetRouter(table=t)
+    rows = _rows(fr, 4)
+    direct = serve.predict_rows(model.key, rows)
+    # whichever member the ring picks, every request lands: the dead
+    # home fails over to the live replica with values bit-identical
+    for i in range(6):
+        out = r.predict_rows(model.key, rows, key=f"key-{i}",
+                             timeout_ms=10_000)
+        assert out["_fleet"]["member"] == "x1@h"
+        for rr, dd in zip(out["predictions"], direct):
+            assert rr["label"] == dd["label"]
+            assert rr["classProbabilities"] == dd["classProbabilities"]
+
+
+def test_rest_fleet_lifecycle_and_routed_predict(servers, gbm_model):
+    """The full REST surface: join -> heartbeat (gossip back) ->
+    routed predict -> leave, against this process's router
+    singleton."""
+    fr, model = gbm_model
+    s1, s2 = servers
+    fleet.reset()
+    try:
+        base = f"http://127.0.0.1:{s1.port}"
+
+        def post(path, payload):
+            req = urllib.request.Request(
+                f"{base}{path}", data=json.dumps(payload).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return json.loads(r.read().decode())
+
+        j = post("/3/Fleet/join", {
+            "member_id": "rest1@h",
+            "base_url": f"http://127.0.0.1:{s2.port}",
+            "heartbeat_ms": 30_000.0,
+            "deployments": [model.key]})
+        assert j["incarnation"] >= 1
+        # join response carries the registry snapshot (warm cold-start)
+        assert model.key in [d["model"]
+                             for d in j["registry"]["deployments"]]
+        hb = post("/3/Fleet/heartbeat", {
+            "member_id": "rest1@h", "incarnation": j["incarnation"],
+            "load": 0.05, "routable": True,
+            "deployments": [model.key],
+            "circuit": [{"model": model.key, "state": "closed"}]})
+        assert hb["ok"] is True
+        # routed predict proxies to the (only) live member over HTTP
+        rows = _rows(fr, 4)
+        out = post(f"/3/Fleet/models/{model.key}/rows", {"rows": rows})
+        direct = serve.predict_rows(model.key, rows)
+        assert [p["label"] for p in out["predictions"]] == \
+            [p["label"] for p in direct]
+        assert out["_fleet"]["member"] == "rest1@h"
+        # stale incarnation is fenced with 409
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post("/3/Fleet/heartbeat", {
+                "member_id": "rest1@h",
+                "incarnation": j["incarnation"] - 1})
+        assert ei.value.code in (404, 409)
+        # leave empties the live set: routed predict sheds 503 +
+        # Retry-After
+        post("/3/Fleet/leave", {"member_id": "rest1@h"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(f"/3/Fleet/models/{model.key}/rows", {"rows": rows})
+        assert ei.value.code == 503
+        assert int(ei.value.headers.get("Retry-After", "0")) >= 1
+    finally:
+        fleet.reset()
+
+
+# ------------------------------------------------------ warm cold-start
+
+def test_warm_cold_start_zero_compiles_on_first_routed_request(
+        servers, gbm_model):
+    fr, model = gbm_model
+    s1, _s2 = servers
+    snap = serve.registry_snapshot()
+    assert model.key in [d["model"] for d in snap["deployments"]]
+    serve.undeploy(model.key)
+    assert serve.deployment(model.key) is None
+    # the joining replica pre-warms from the snapshot (model resolved
+    # from its own store) BEFORE marking routable ...
+    rep = serve.prewarm_from_snapshot(snap)
+    assert model.key in rep["deployed"]
+    t = MemberTable()
+    _join_routable(t, "w1@h", s1, (model.key,))
+    r = FleetRouter(table=t)
+    rows = _rows(fr, 4)
+    # ... so the first ROUTED request compiles ZERO XLA modules
+    compiles = []
+    with count_compiles(compiles):
+        out = r.predict_rows(model.key, rows, key="cold")
+    assert out["predictions"]
+    assert compiles == [], f"first routed request compiled {compiles}"
+
+
+def test_prewarm_reports_unresolvable_models():
+    rep = serve.prewarm_from_snapshot(
+        {"version": 1, "deployments": [
+            {"model": "no_such_model", "config": {}}]})
+    assert rep["deployed"] == []
+    assert rep["skipped"][0]["model"] == "no_such_model"
+    assert "resolvable" in rep["skipped"][0]["reason"]
+
+
+# ------------------------------------- gossip + churn + telemetry peers
+
+def test_heartbeat_gossip_sheds_and_eviction_drops_source(gbm_model):
+    """Push gossip: an open circuit piggybacked on a peer's heartbeat
+    sheds load here; the peer's eviction drops its entries NOW (the
+    churn fix — no max(retry_after, TTL) linger)."""
+    fr, model = gbm_model
+    dep = serve.deploy(model.key, max_delay_ms=1.0, max_batch=64,
+                       buckets=[1, 8, 64])
+    fleet.reset()
+    try:
+        r = fleet.router()      # wires drop_source + telemetry peers
+        m = r.table.join("g1@h", "http://127.0.0.1:1", heartbeat_s=HB,
+                         routable=True, deployments=(model.key,))
+        # the sick peer's beat carries an open circuit (what
+        # /3/Fleet/heartbeat stores on the member record) ...
+        r.table.heartbeat("g1@h", m.incarnation, routable=True,
+                          circuit=[{"model": model.key, "state": "open",
+                                    "retry_after_s": 30.0,
+                                    "time": time.time()}])
+        # ... and the agent-side ingest (what beat_once does with the
+        # gossip) sheds load locally with a fast 503
+        serve.fleet.observe_peer_states(
+            [{"model": model.key, "state": "open",
+              "retry_after_s": 30.0, "time": time.time()}], "g1@h")
+        with pytest.raises(serve.ServeCircuitOpenError):
+            dep.predict_rows(_rows(fr, 1))
+        # silence the peer: suspicion -> eviction fires drop_source
+        deadline = time.monotonic() + 5.0
+        while r.table.get("g1@h") is not None \
+                and time.monotonic() < deadline:
+            r.table.sweep()
+            time.sleep(HB / 4)
+        assert r.table.get("g1@h") is None
+        assert serve.fleet.reject_for(model.key) is None
+        out = dep.predict_rows(_rows(fr, 1))
+        assert out and "label" in out[0]
+    finally:
+        fleet.reset()
+        serve.undeploy(model.key)
+        serve.fleet.reset()
+
+
+def test_telemetry_peers_follow_member_table():
+    from h2o3_tpu.telemetry import snapshot as telesnap
+    fleet.reset()
+    try:
+        r = fleet.router()
+        m = r.table.join("t1@h", "http://127.0.0.1:7441",
+                         heartbeat_s=30.0, routable=True)
+        r.table.heartbeat("t1@h", m.incarnation, routable=True)
+        addrs, departed = telesnap.peer_view()
+        assert addrs == ["http://127.0.0.1:7441"]
+        assert departed == []
+        # a member that LEAVES stops contributing series on the next
+        # scrape — and is flagged in the meta instead of lingering
+        r.table.leave("t1@h")
+        addrs, departed = telesnap.peer_view()
+        assert addrs == []
+        assert departed and departed[0]["member_id"] == "t1@h"
+        assert departed[0]["reason"] == "left"
+    finally:
+        fleet.reset()
+    # with the fleet torn down the env fallback is intact
+    assert telesnap.peer_view()[1] == []

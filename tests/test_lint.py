@@ -380,6 +380,101 @@ def test_pallas_interpret_true_allowed_in_tests(tmp_path):
     assert "pallas-grid-spec" not in _rules_of(rep)
 
 
+def test_detects_static_peer_env_read_outside_seam(tmp_path):
+    # seeded violation for the fleet front-door guardrail (ISSUE 13):
+    # a module building its own peer list from the env instead of the
+    # member table
+    rep = _lint_source(tmp_path, "h2o3_tpu/newrouter.py", """\
+        import os
+
+        def my_peers():
+            raw = os.environ.get("H2O3_TELEMETRY_PEERS", "")
+            return raw.split(",")
+
+        def my_seeds():
+            return os.environ["H2O3_FLEET_SEEDS"].split(",")
+    """)
+    fp = [f for f in rep.new if f.rule == "fleet-peer-discipline"]
+    assert len(fp) == 2
+    assert all("member-table seam" in f.message for f in fp)
+
+
+def test_peer_env_read_in_seam_modules_is_clean(tmp_path):
+    # the blessed seam spellings: telemetry's env fallback and the
+    # fleet seed read; env WRITES (launchers) are fine anywhere
+    for rel in ("h2o3_tpu/telemetry/snapshot.py",
+                "h2o3_tpu/fleet/membership.py"):
+        rep = _lint_source(tmp_path, rel, """\
+            import os
+
+            def peers():
+                raw = os.environ.get("H2O3_TELEMETRY_PEERS", "")
+                return raw.split(",")
+        """)
+        assert "fleet-peer-discipline" not in _rules_of(rep)
+    rep = _lint_source(tmp_path, "h2o3_tpu/launcher.py", """\
+        import os
+
+        def launch(peers):
+            os.environ["H2O3_TELEMETRY_PEERS"] = ",".join(peers)
+    """)
+    assert "fleet-peer-discipline" not in _rules_of(rep)
+
+
+def test_detects_unretried_fleet_http(tmp_path):
+    # cross-replica HTTP in fleet/ must carry a timeout AND ride
+    # resilience.retry_transient
+    rep = _lint_source(tmp_path, "h2o3_tpu/fleet/newagent.py", """\
+        import urllib.request
+
+        def beat(url):
+            with urllib.request.urlopen(url) as r:
+                return r.read()
+    """)
+    fp = [f for f in rep.new if f.rule == "fleet-peer-discipline"]
+    assert len(fp) == 2
+    assert any("timeout=" in f.message for f in fp)
+    assert any("retry_transient" in f.message for f in fp)
+
+
+def test_retried_fleet_http_with_timeout_is_clean(tmp_path):
+    rep = _lint_source(tmp_path, "h2o3_tpu/fleet/newagent.py", """\
+        import urllib.request
+        from h2o3_tpu import resilience
+
+        def beat(url, deadline_s):
+            def _call():
+                with urllib.request.urlopen(url,
+                                            timeout=deadline_s) as r:
+                    return r.read()
+            return resilience.retry_transient(_call, site="fleet.beat")
+    """)
+    assert "fleet-peer-discipline" not in _rules_of(rep)
+
+
+def test_detects_epoch_blind_routing_decision(tmp_path):
+    # a routing decision over the live member set that never pins the
+    # membership epoch it decided under
+    rep = _lint_source(tmp_path, "h2o3_tpu/fleet/router.py", """\
+        def route(table, model):
+            live = table.live_members()
+            return live[0]
+
+        def _safe_to_failover(exc):
+            return "connection refused" in str(exc)
+    """)
+    fp = [f for f in rep.new if f.rule == "fleet-peer-discipline"]
+    assert len(fp) == 1                  # the classifier is exempt
+    assert "route" in fp[0].message and "epoch" in fp[0].message
+    rep = _lint_source(tmp_path, "h2o3_tpu/fleet/router.py", """\
+        def route(table, model):
+            epoch = table.epoch
+            live = table.live_members()
+            return live[0], epoch
+    """)
+    assert "fleet-peer-discipline" not in _rules_of(rep)
+
+
 # ------------------------------------------------- suppression machinery
 
 _TWO_RULE_SRC = """\

@@ -199,6 +199,279 @@ def run_kill_process_round(rows: int = 2000, log=print,
     return out
 
 
+# ------------------------------------------------- kill-replica round
+#
+# The fleet front door's chaos probe (ISSUE 13): N real serve-replica
+# PROCESSES join the parent's router over REST, traffic flows through
+# consistent-hash routing, and one replica is SIGKILLed mid-traffic.
+# Asserted: the router sheds the dead replica within ~one heartbeat
+# interval, rebalances onto the survivors, and no request started
+# after the shed window fails (single failover absorbs the in-flight
+# casualties). Recorded in bench.py as
+# fleet.{replicas,rows_per_sec,shed_ms,rebalance_ok}.
+
+_FLEET_MODEL_KEY = "chaos_fleet_gbm"
+_FLEET_PARAMS = dict(ntrees=8, max_depth=3, seed=17, learn_rate=0.2,
+                     min_rows=1.0)
+_FLEET_ROWS = 1500
+
+
+def _fleet_child_src(repo: str, router_port: int) -> str:
+    """One serve replica: train the deterministic model, deploy, start
+    a REST surface, join the fleet via the agent (seeds env), park."""
+    return textwrap.dedent(f"""
+        import sys, threading
+        sys.path.insert(0, {repo!r})
+        import numpy as np
+        import h2o3_tpu as h2o
+        from h2o3_tpu import dkv, serve
+        from h2o3_tpu.api.server import H2OApiServer
+        from h2o3_tpu.fleet import FleetAgent
+        from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+        rng = np.random.default_rng(21)
+        n = {_FLEET_ROWS}
+        a = rng.normal(size=n).astype(np.float32)
+        b = rng.uniform(-2, 2, size=n).astype(np.float32)
+        y = rng.random(n) < 1 / (1 + np.exp(-(a * 1.2 - b)))
+        fr = h2o.Frame.from_numpy(dict(
+            a=a, b=b, cls=np.where(y, "YES", "NO")))
+        est = H2OGradientBoostingEstimator(**{_FLEET_PARAMS!r})
+        est.train(y="cls", training_frame=fr)
+        est.model.key = {_FLEET_MODEL_KEY!r}
+        dkv.put(est.model.key, "model", est.model)
+        serve.deploy(est.model.key, max_delay_ms=1.0, queue_limit=65536)
+        srv = H2OApiServer(port=0).start()
+        agent = FleetAgent(f"http://127.0.0.1:{{srv.port}}",
+                           router_url="http://127.0.0.1:{router_port}")
+        agent.start()
+        print("REPLICA_READY", srv.port, flush=True)
+        threading.Event().wait()
+    """)
+
+
+def run_kill_replica_round(replicas: int = 3, traffic_secs: float = 6.0,
+                           clients: int = 6, log=print,
+                           spawn_deadline_s: float = 300.0) -> dict:
+    """SIGKILL one of N replica processes mid-traffic and measure the
+    membership shed + router rebalance. ``ran=False`` results are
+    benign skips (non-CPU parent — child tree bits would not be
+    comparable), same contract as the kill-process round."""
+    import queue as _q
+    import threading
+
+    import jax
+
+    out = {"ran": False, "replicas": replicas, "rows_per_sec": None,
+           "single_rows_per_sec": None, "speedup": None,
+           "shed_ms": None, "shed_within_beat": None,
+           "rebalance_ok": False, "failed_after_shed": None,
+           "parity_ok": None, "ok": False}
+    if jax.default_backend() != "cpu":
+        log("kill-replica round: skipped — replica children run on CPU "
+            f"and this process is on {jax.default_backend()}")
+        out["ok"] = True          # a skip is not a failure
+        return out
+    import h2o3_tpu as h2o
+    from h2o3_tpu import dkv, fleet, serve
+    from h2o3_tpu.api.server import H2OApiServer
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    hb_ms = float(os.environ.get("H2O3_FLEET_BENCH_HB_MS", "500") or 500)
+    prev_hb = os.environ.get("H2O3_FLEET_HEARTBEAT_MS")
+    os.environ["H2O3_FLEET_HEARTBEAT_MS"] = str(hb_ms)
+    fleet.reset()
+    srv = H2OApiServer(port=0).start()
+    router = fleet.router()
+    procs = []
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   H2O3_FLEET_SEEDS=f"127.0.0.1:{srv.port}",
+                   XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                              .replace("--xla_force_host_platform_"
+                                       "device_count=8", "")).strip())
+        src = _fleet_child_src(_REPO, srv.port)
+        for _ in range(replicas):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", src], env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        # the parent's parity reference: the SAME deterministic train
+        rng = np.random.default_rng(21)
+        n = _FLEET_ROWS
+        a = rng.normal(size=n).astype(np.float32)
+        b = rng.uniform(-2, 2, size=n).astype(np.float32)
+        yv = rng.random(n) < 1 / (1 + np.exp(-(a * 1.2 - b)))
+        fr = h2o.Frame.from_numpy(dict(
+            a=a, b=b, cls=np.where(yv, "YES", "NO")))
+        est = H2OGradientBoostingEstimator(**_FLEET_PARAMS)
+        est.train(y="cls", training_frame=fr)
+        est.model.key = _FLEET_MODEL_KEY
+        dkv.put(est.model.key, "model", est.model)
+        dep = serve.deploy(est.model.key, max_delay_ms=1.0)
+        rows = [{"a": float(a[i]), "b": float(b[i])} for i in range(64)]
+        direct = dep.predict_rows(rows)
+        # wait for every replica to join routable
+        deadline = time.monotonic() + spawn_deadline_s
+        while time.monotonic() < deadline:
+            if len(router.table.live_members()) >= replicas:
+                break
+            if any(p.poll() is not None for p in procs):
+                log("kill-replica round: a replica died during spawn")
+                return out
+            time.sleep(0.25)
+        live = router.table.live_members()
+        if len(live) < replicas:
+            log(f"kill-replica round: only {len(live)}/{replicas} "
+                f"replicas joined before the deadline — skipping")
+            return out
+        out["ran"] = True
+
+        # parity probe: routed scoring == the parent's direct predict
+        probe = router.predict_rows(_FLEET_MODEL_KEY, rows, key="p0")
+        out["parity_ok"] = all(
+            rr["label"] == dd["label"]
+            and rr["classProbabilities"] == dd["classProbabilities"]
+            for rr, dd in zip(probe["predictions"], direct))
+
+        # single-replica baseline: same client count, one member pinned
+        one = live[0]
+        single_scored = [0] * clients
+        stop_single = time.monotonic() + max(traffic_secs / 3, 1.5)
+
+        def single_client(ci):
+            i = 0
+            while time.monotonic() < stop_single:
+                try:
+                    got = router._dispatch(one, _FLEET_MODEL_KEY, rows,
+                                           time.monotonic() + 10.0)
+                    single_scored[ci] += len(got["predictions"])
+                except Exception:   # noqa: BLE001 — baseline best-effort
+                    pass
+                i += 1
+
+        t0 = time.monotonic()
+        ths = [threading.Thread(target=single_client, args=(ci,))
+               for ci in range(clients)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        single_rps = sum(single_scored) / max(time.monotonic() - t0, 1e-9)
+        out["single_rows_per_sec"] = round(single_rps, 1)
+
+        # routed traffic across the fleet, with a mid-traffic SIGKILL
+        import socket as _socket
+        results: "_q.Queue" = _q.Queue()
+        stop_at = time.monotonic() + traffic_secs
+        kill_at = time.monotonic() + traffic_secs / 2
+        victim = procs[1]
+        victim_member = f"{victim.pid}@{_socket.gethostname()}"
+        killed = {"t": None}
+        shed = {"t": None}
+        kill_mu = threading.Lock()
+
+        def shed_monitor():
+            """Stamp the instant the victim leaves the routed set —
+            DURING traffic, so shed latency is measured, not the poll
+            that happens to notice it afterwards."""
+            while killed["t"] is None:
+                if time.monotonic() > stop_at + 30:
+                    return
+                time.sleep(hb_ms / 1000.0 / 20)
+            probe_deadline = killed["t"] + 30.0
+            while time.monotonic() < probe_deadline:
+                ids = {m.member_id for m in router.table.live_members()}
+                if victim_member not in ids:
+                    shed["t"] = time.monotonic()
+                    return
+                time.sleep(hb_ms / 1000.0 / 20)
+
+        mon = threading.Thread(target=shed_monitor, daemon=True)
+        mon.start()
+
+        def client(ci):
+            i = 0
+            while time.monotonic() < stop_at:
+                with kill_mu:
+                    if killed["t"] is None and \
+                            time.monotonic() >= kill_at:
+                        os.kill(victim.pid, signal.SIGKILL)
+                        killed["t"] = time.monotonic()
+                t_start = time.monotonic()
+                try:
+                    got = router.predict_rows(
+                        _FLEET_MODEL_KEY, rows, key=f"c{ci}-{i}",
+                        timeout_ms=10_000)
+                    results.put((t_start, len(got["predictions"]),
+                                 got["_fleet"]["member"], None))
+                except Exception as e:   # noqa: BLE001 — counted below
+                    results.put((t_start, 0, None, repr(e)))
+                i += 1
+
+        t0 = time.monotonic()
+        ths = [threading.Thread(target=client, args=(ci,))
+               for ci in range(clients)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        elapsed = time.monotonic() - t0
+        mon.join(timeout=35)
+        t_kill = killed["t"] or time.monotonic()
+        t_shed = shed["t"] if shed["t"] is not None \
+            else time.monotonic()
+        out["shed_ms"] = round((t_shed - t_kill) * 1e3, 1)
+        out["shed_within_beat"] = bool(
+            shed["t"] is not None
+            and out["shed_ms"] <= 2.0 * hb_ms)  # 1 beat + detector slack
+        recs = []
+        while not results.empty():
+            recs.append(results.get())
+        scored = sum(r[1] for r in recs)
+        out["rows_per_sec"] = round(scored / max(elapsed, 1e-9), 1)
+        out["speedup"] = round(
+            out["rows_per_sec"] / max(single_rps, 1e-9), 2)
+        fails = [r for r in recs if r[3] is not None]
+        # failures are only tolerated in the in-flight window
+        # [kill, shed]: those requests raced the death; everything
+        # after the shed must succeed (failover + rebalance)
+        late = [r for r in fails if r[0] > t_shed]
+        out["failed_total"] = len(fails)
+        out["failed_after_shed"] = len(late)
+        survivors = {r[2] for r in recs
+                     if r[3] is None and r[0] > t_shed}
+        out["rebalance_ok"] = bool(
+            len(router.table.live_members()) == replicas - 1
+            and scored > 0 and survivors
+            and victim_member not in survivors)
+        out["heartbeat_ms"] = hb_ms
+        out["ok"] = bool(out["parity_ok"] and out["rebalance_ok"]
+                         and out["failed_after_shed"] == 0
+                         and out["shed_within_beat"])
+        log(f"kill-replica round: {'PASS' if out['ok'] else 'FAIL'} "
+            f"{out}")
+        return out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:   # noqa: BLE001 — cleanup best-effort
+                pass
+        try:
+            serve.undeploy(_FLEET_MODEL_KEY)
+            dkv.remove(_FLEET_MODEL_KEY)
+        except Exception:   # noqa: BLE001
+            pass
+        fleet.reset()
+        srv.stop()
+        if prev_hb is None:
+            os.environ.pop("H2O3_FLEET_HEARTBEAT_MS", None)
+        else:
+            os.environ["H2O3_FLEET_HEARTBEAT_MS"] = prev_hb
+
+
 def run_chaos_round(rows: int = 2000, log=print,
                     kill_process=None) -> dict:
     """Run the sweep with a hard guarantee that fault injection is
@@ -353,11 +626,17 @@ def _chaos_round(rows: int, log) -> dict:
 
 
 def main():
+    log = lambda *a: print(*a, file=sys.stderr)  # noqa: E731
+    if "--kill-replica" in sys.argv[1:]:
+        # fleet chaos only (ISSUE 13): SIGKILL one of N serve-replica
+        # processes mid-traffic; shed + rebalance + zero late failures
+        out = {"fleet": run_kill_replica_round(log=log)}
+        print(json.dumps(out, indent=2))
+        sys.exit(0 if out["fleet"]["ok"] else 1)
     # --kill-process forces the restart-recovery round even when
     # H2O3_BENCH_CHAOS_KILL=0; without it the env default applies
     kill = True if "--kill-process" in sys.argv[1:] else None
-    out = {"resilience": run_chaos_round(
-        log=lambda *a: print(*a, file=sys.stderr), kill_process=kill)}
+    out = {"resilience": run_chaos_round(log=log, kill_process=kill)}
     print(json.dumps(out, indent=2))
     sys.exit(0 if out["resilience"]["ok"] else 1)
 

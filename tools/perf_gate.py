@@ -61,6 +61,11 @@ METRIC_SPECS: Tuple[Tuple[str, str, float], ...] = (
     ("serve.p50_ms", "lower", 0.35),
     ("serve.p99_ms", "lower", 0.50),
     ("multichip.scaling_efficiency_8", "higher", 0.15),
+    # fleet round (ISSUE 13): multi-replica routed throughput may only
+    # grow; membership shed latency (kill -> out of the routed set) may
+    # only shrink — wide band, it is heartbeat-quantized
+    ("fleet.rows_per_sec", "higher", 0.20),
+    ("fleet.shed_ms", "lower", 0.60),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
