@@ -121,12 +121,16 @@ def _disk_frame(rows):
         log(f"csv written in {_t.time() - t0:.1f}s")
     t0 = _t.time()
     setup = parse_setup([path])
+    t1 = _t.time()
     fr = parse([path], setup)
-    ingest_s = _t.time() - t0
+    t2 = _t.time()
+    ingest_s, parse_s = t2 - t0, t2 - t1
     from h2o3_tpu.ingest.parse import LAST_PROFILE
     log(f"ingest: parsed {fr.nrow}x{fr.ncol} from disk in {ingest_s:.1f}s "
-        f"({fr.nrow / ingest_s:,.0f} rows/sec) profile={LAST_PROFILE}")
-    return fr, ingest_s
+        f"({fr.nrow / ingest_s:,.0f} rows/sec, "
+        f"{os.path.getsize(path) / 1e6 / parse_s:,.1f} MB/s parse) "
+        f"profile={LAST_PROFILE}")
+    return fr, ingest_s, parse_s, os.path.getsize(path)
 
 
 SERVE_SINGLE_ROWS = int(os.environ.get("H2O3_BENCH_SERVE_ROWS", 300))
@@ -294,9 +298,9 @@ def main():
     tel0 = _telemetry_counts()
     log(f"devices: {jax.devices()}  backend: {jax.default_backend()}  "
         f"compile_cache: {cache_dir}")
-    ingest_s = None
+    ingest_s = parse_s = csv_bytes = None
     if os.environ.get("H2O3_BENCH_DISK", "1") not in ("0", "false", ""):
-        fr, ingest_s = _disk_frame(ROWS)
+        fr, ingest_s, parse_s, csv_bytes = _disk_frame(ROWS)
         F = fr.ncol - 1
     else:
         X, y, F = _make_arrays(ROWS)
@@ -551,9 +555,16 @@ def main():
         # typed sharded Frame, rows/sec of wall-clock parse time
         out["ingest_seconds"] = round(ingest_s, 1)
         out["ingest_rows_per_sec"] = round(fr.nrow / ingest_s, 1)
+        # parse throughput in bytes (ISSUE 14): the perf_gate ratchets
+        # mb_per_sec UP and fallback_ranges DOWN — a tokenizer
+        # regression that silently reroutes ranges through the Python
+        # fallback now fails the gate instead of just reading slower
+        out["ingest.mb_per_sec"] = round(csv_bytes / 1e6 / parse_s, 1)
+        from h2o3_tpu.ingest.parse import LAST_PROFILE
+        out["ingest.fallback_ranges"] = LAST_PROFILE.get(
+            "fallback_ranges", 0)
         # per-chunk streamed H2D: share of device_put wall time hidden
         # under tokenize (ingest/stream.py; None = streaming not taken)
-        from h2o3_tpu.ingest.parse import LAST_PROFILE
         out["ingest.h2d_overlap_ratio"] = LAST_PROFILE.get(
             "h2d_overlap_ratio")
     print(json.dumps(out))

@@ -378,6 +378,24 @@ def _numeric_host_copy(f64: np.ndarray, vtype: str):
     return None
 
 
+_SPLIT_COLS_JIT = None
+
+
+def split_columns(mat, ncol: int):
+    """Every column slice of a 2-D device matrix in ONE compiled
+    dispatch. ``ncol`` separate ``mat[:, j]`` expressions each bake
+    their index into a distinct XLA program — a cold parse paid one
+    compile PER COLUMN (ISSUE 14 found ~70 ms of the 29-column bench
+    frame's assembly was exactly that). jit's shape cache makes repeat
+    shapes free, and outputs follow the input's (row) sharding."""
+    assert mat.shape[1] == ncol, (mat.shape, ncol)
+    global _SPLIT_COLS_JIT
+    if _SPLIT_COLS_JIT is None:
+        _SPLIT_COLS_JIT = jax.jit(
+            lambda m: tuple(m[:, j] for j in range(m.shape[1])))
+    return list(_SPLIT_COLS_JIT(mat))
+
+
 def batch_device_put(columns, fill, dtype, nrow: int, mesh=None):
     """One host→device transfer for a whole dtype group of columns.
 
@@ -407,7 +425,7 @@ def batch_device_put(columns, fill, dtype, nrow: int, mesh=None):
             _pack(j)
     record_h2d(mat.nbytes, fallback="frame")
     dev = _resilient_put(mat, mesh)
-    return [dev[:, j] for j in range(len(columns))]
+    return split_columns(dev, len(columns))
 
 
 def _resilient_put(arr, mesh):

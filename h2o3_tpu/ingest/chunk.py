@@ -17,7 +17,7 @@ vectorized numpy over the (starts, lens) offset arrays.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Union
 
 import numpy as np
 
@@ -46,11 +46,14 @@ class EncodedColumn:
     set → the float64 view plus an exact int64 shadow (values beyond
     2^53); time → int64 epoch millis (NA=Vec.TIME_NA); enum → int32
     codes (NA=-1) against the sorted chunk-local ``domain``; string →
-    object array of str/None."""
+    object array of str/None. ``fmax`` is the finite |max| of a numeric
+    column when the encoder already reduced it (the streamer's
+    host-shadow decision reuses it instead of re-scanning)."""
     vtype: str
     data: np.ndarray
     domain: Optional[List[str]] = None
     exact: Optional[np.ndarray] = None  # int64, only for wide int columns
+    fmax: Optional[float] = None        # finite |max| of a numeric column
 
 
 # placeholder for a skipped column: never encoded, never merged — the
@@ -88,12 +91,19 @@ def _na_bytes(nas) -> np.ndarray:
     return np.array(vals, dtype="S") if vals else np.empty(0, dtype="S1")
 
 
+def _unescape(tok: str) -> str:
+    """Collapse RFC-4180 ``""`` escapes — applied to tokens whose cell
+    the native tokenizer flagged (esc), matching csv.reader's output."""
+    return tok.replace('""', '"')
+
+
 def _codes_from_labels(codes: np.ndarray, labels: List[str], nas) -> EncodedColumn:
     """Finish a dictionary encode: NA-string labels map to the NA code,
     the rest rank against the SORTED chunk domain (the reference sorts
     each chunk's categorical domain before PackedDomains union)."""
-    # distinct byte tokens can collide after errors='replace' decoding —
-    # dedupe on the decoded string like the Python tokenizer would
+    # distinct byte tokens can collide after errors='replace' decoding
+    # (or after ""-unescape) — dedupe on the decoded string like the
+    # Python tokenizer would
     keep = sorted({lab for lab in labels if lab not in nas})
     rank = {lab: k for k, lab in enumerate(keep)}
     if labels:
@@ -106,22 +116,38 @@ def _codes_from_labels(codes: np.ndarray, labels: List[str], nas) -> EncodedColu
     return EncodedColumn(T_ENUM, out, domain=keep)
 
 
-def _encode_enum_offsets(data: bytes, starts: np.ndarray, lens: np.ndarray,
-                         nas, max_card: int) -> Optional[EncodedColumn]:
+def _encode_enum_offsets(data, starts: np.ndarray, lens: np.ndarray,
+                         nas, max_card: int,
+                         esc: Optional[np.ndarray] = None
+                         ) -> Optional[EncodedColumn]:
     """Enum column from (starts, lens): native hash dictionary when
-    available, else vectorized numpy unique. None → string fallback."""
+    available, else vectorized numpy unique. None → string fallback.
+    ``esc`` flags cells whose raw bytes carry ``""`` escapes — their
+    decoded labels unescape, and the decoded-label dedupe merges any
+    raw-byte aliases the escape created."""
     from h2o3_tpu import native
     starts = np.ascontiguousarray(starts, dtype=np.int64)
     lens = np.ascontiguousarray(lens, dtype=np.int32)
+    has_esc = esc is not None and bool(esc.any())
     res = native.enum_encode(data, starts, lens,
                              max_card + len(nas or ()) + 1)
     if res is not None:
         codes, uniq_rows = res
-        labels = [data[starts[r]: starts[r] + lens[r]].decode(
-            "utf-8", errors="replace") for r in uniq_rows]
+        labels = []
+        for r in uniq_rows:
+            lab = bytes(data[starts[r]: starts[r] + lens[r]]).decode(
+                "utf-8", errors="replace")
+            labels.append(_unescape(lab) if has_esc and esc[r] else lab)
         col = _codes_from_labels(codes, labels, nas)
         return col if len(col.domain) <= max_card else None
     toks = _tokens_sarr(data, starts, lens)
+    if has_esc:
+        # rare: route the escaped cells' tokens through their unescaped
+        # form so the byte-level unique can't split one label in two
+        toks = toks.astype(object)
+        for i in np.flatnonzero(esc):
+            toks[i] = toks[i].replace(b'""', b'"')
+        toks = np.array(toks.tolist())
     uniq, inv = np.unique(toks, return_inverse=True)
     if len(uniq) > max_card + len(nas or ()) + 1:
         return None
@@ -130,8 +156,9 @@ def _encode_enum_offsets(data: bytes, starts: np.ndarray, lens: np.ndarray,
     return col if len(col.domain) <= max_card else None
 
 
-def _decode_str_offsets(data: bytes, starts: np.ndarray,
-                        lens: np.ndarray, nas) -> np.ndarray:
+def _decode_str_offsets(data, starts: np.ndarray,
+                        lens: np.ndarray, nas,
+                        esc: Optional[np.ndarray] = None) -> np.ndarray:
     """Object array of str (None for NA strings) from (starts, lens)."""
     toks = _tokens_sarr(data, starts, lens)
     isna = np.isin(toks, _na_bytes(nas))
@@ -140,6 +167,9 @@ def _decode_str_offsets(data: bytes, starts: np.ndarray,
     except UnicodeDecodeError:
         out = np.array([t.decode("utf-8", errors="replace") for t in toks],
                        dtype=object)
+    if esc is not None:
+        for i in np.flatnonzero(esc):
+            out[i] = _unescape(out[i])
     out[isna] = None
     return out
 
@@ -166,9 +196,52 @@ def _time_per_cell(tokens) -> np.ndarray:
     return ms
 
 
-def _encode_time_offsets(data: bytes, starts, lens, nas) -> np.ndarray:
+def _fast_iso_dates(toks: np.ndarray, isna: np.ndarray) -> Optional[np.ndarray]:
+    """Vectorized ``YYYY-MM-DD`` → epoch millis straight off the token
+    BYTES (days-from-civil, the Hinnant algorithm) — datetime64's string
+    parser ran at ~1.3M cells/s and dominated time-column encode. Bails
+    to the generic path (None) unless EVERY non-NA token is a valid
+    zero-padded ISO date, so results are bit-identical to
+    ``astype('datetime64[ms]')`` wherever this path engages."""
+    if toks.dtype.itemsize != 10 or len(toks) == 0:
+        return None
+    act = ~isna
+    if not act.any():
+        return np.full(len(toks), Vec.TIME_NA, dtype=np.int64)
+    b = toks.view(np.uint8).reshape(len(toks), 10)[act]
+    dig = (b >= 48) & (b <= 57)
+    if not (dig[:, [0, 1, 2, 3, 5, 6, 8, 9]].all()
+            and (b[:, 4] == 45).all() and (b[:, 7] == 45).all()):
+        return None
+    v = b.astype(np.int64) - 48
+    year = v[:, 0] * 1000 + v[:, 1] * 100 + v[:, 2] * 10 + v[:, 3]
+    month = v[:, 5] * 10 + v[:, 6]
+    day = v[:, 8] * 10 + v[:, 9]
+    if ((month < 1) | (month > 12)).any():
+        return None
+    leap = (year % 4 == 0) & ((year % 100 != 0) | (year % 400 == 0))
+    mdays = np.array([0, 31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
+                     dtype=np.int64)[month]
+    mdays = np.where((month == 2) & leap, 29, mdays)
+    if ((day < 1) | (day > mdays)).any():
+        return None
+    y = year - (month <= 2)
+    era = y // 400                      # floor division, negatives exact
+    yoe = y - era * 400
+    doy = (153 * ((month + 9) % 12) + 2) // 5 + day - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    days = era * 146097 + doe - 719468  # days since 1970-01-01
+    out = np.full(len(toks), Vec.TIME_NA, dtype=np.int64)
+    out[act] = days * 86400000
+    return out
+
+
+def _encode_time_offsets(data, starts, lens, nas) -> np.ndarray:
     toks = _tokens_sarr(data, starts, lens)
     isna = np.isin(toks, _na_bytes(nas))
+    ms = _fast_iso_dates(toks, isna)
+    if ms is not None:
+        return ms
     try:
         u = toks.astype("U")
     except UnicodeDecodeError:
@@ -213,54 +286,95 @@ def _maybe_exact(vals: np.ndarray, vtype: str, tokens_fn) -> Optional[np.ndarray
     return _exact_int_from_tokens(tokens_fn())
 
 
-def encode_chunk_native(data: bytes, setup, skip_header: bool
-                        ) -> Optional[List[EncodedColumn]]:
-    """Native-tokenizer chunk encode: one C scan emits offsets + eagerly
-    parsed doubles (fast_csv.cpp), then every column finishes as a typed
-    numpy array without materializing Python token lists. None → caller
-    uses the Python fallback (no toolchain, quotes, ragged rows)."""
+def encode_chunk_native(data, setup, skip_header: bool, stats=None
+                        ) -> Union[List[EncodedColumn], str]:
+    """Native-tokenizer chunk encode: one C scan emits column-major
+    offsets + eagerly parsed doubles (fast_csv.cpp, zero-copy over an
+    mmap view), then every column finishes as a typed numpy array
+    without materializing Python token lists. Returns a decline-REASON
+    string (the caller re-parses only this range through the Python
+    tokenizer and counts the reason). ``stats``, when given, receives
+    ``add(tokenize_s, encode_s)`` calls for the per-stage attribution in
+    tools/profile_ingest.py."""
+    import time as _time
+
     from h2o3_tpu.native import parse_bytes
-    out = parse_bytes(data, setup.separator)
-    if out is None:
-        return None
-    starts, lens, vals, ok = out
+    skipped_pre = _skipped_set(setup)
+    # offsets are only read back for columns that decode tokens (enum/
+    # str/time) or may need the exact wide-int re-parse (int); float64
+    # columns' values come straight from vals, so their starts/lens
+    # writes (and arena page faults) are suppressed in the C scan
+    want = np.fromiter(
+        (0 if (j in skipped_pre or vt == T_REAL) else 1
+         for j, vt in enumerate(setup.column_types)),
+        dtype=np.uint8, count=len(setup.column_types))
+    t0 = _time.perf_counter()
+    out = parse_bytes(data, setup.separator,
+                      getattr(setup, "quotechar", '"') or '"',
+                      ncols=len(setup.column_types), want_offsets=want)
+    t1 = _time.perf_counter()
+    if isinstance(out, str):
+        return out
+    starts, lens, vals, ok, esc = out
     r0 = 1 if skip_header else 0
-    if vals.shape[1] != len(setup.column_types):
-        return None
+    if vals.shape[0] != len(setup.column_types):
+        return "column_count_mismatch"
     nas = setup.na_strings if setup.na_strings is not None else set()
-    skipped = _skipped_set(setup)
+    skipped = skipped_pre
+    # numeric columns detach from the scratch arena in ONE fancy-index
+    # gather (then per-column contiguous row views of the owned block):
+    # 29 separate per-column copies held the GIL 29 times per range,
+    # which serialized the whole worker pool. The wide-int probe's
+    # finite/|max| reductions are likewise one vectorized pass.
+    num_idx = [j for j, vt in enumerate(setup.column_types)
+               if j not in skipped and vt in (T_REAL, T_INT)]
+    num_pos = {j: t for t, j in enumerate(num_idx)}
+    if num_idx:
+        block = vals[num_idx, r0:]
+        fin = np.isfinite(block)
+        allfin = (fin.all(axis=1) if block.size
+                  else np.ones(len(num_idx), bool))
+        with np.errstate(invalid="ignore"):
+            colmax = (np.abs(block).max(axis=1, initial=-np.inf, where=fin)
+                      if block.size else np.full(len(num_idx), -np.inf))
     cols: List[EncodedColumn] = []
     for j, vt in enumerate(setup.column_types):
         if j in skipped:
             cols.append(SKIPPED)
             continue
         if vt in (T_REAL, T_INT):
-            v = vals[r0:, j].copy()
-            # tokens_fn only runs for all-finite wide-int columns, so
-            # every cell is numeric ASCII text
-            exact = _maybe_exact(
-                v, vt,
-                lambda j=j: np.char.decode(_tokens_sarr(
-                    data, np.ascontiguousarray(starts[r0:, j]),
-                    np.ascontiguousarray(lens[r0:, j])),
+            t = num_pos[j]
+            v = block[t]
+            exact = None
+            if (vt == T_INT and v.size and allfin[t]
+                    and colmax[t] >= _EXACT_F64_BOUND):
+                # tokens_fn only runs for all-finite wide-int columns,
+                # so every cell is numeric ASCII text
+                exact = _exact_int_from_tokens(np.char.decode(
+                    _tokens_sarr(data, starts[j, r0:], lens[j, r0:]),
                     "utf-8").tolist())
-            cols.append(EncodedColumn(vt, v, exact=exact))
+            cols.append(EncodedColumn(vt, v, exact=exact,
+                                      fmax=float(colmax[t])))
             continue
-        s = np.ascontiguousarray(starts[r0:, j])
-        ln = np.ascontiguousarray(lens[r0:, j])
+        s, ln = starts[j, r0:], lens[j, r0:]
+        esc_j = esc[j, r0:] if esc is not None else None
         if vt == T_TIME:
             cols.append(EncodedColumn(T_TIME,
                                       _encode_time_offsets(data, s, ln, nas)))
         elif vt == T_ENUM:
             col = _encode_enum_offsets(data, s, ln, nas,
-                                       MAX_ENUM_CARDINALITY)
+                                       MAX_ENUM_CARDINALITY, esc=esc_j)
             if col is None:  # cardinality blowout → string column
                 col = EncodedColumn(T_STR,
-                                    _decode_str_offsets(data, s, ln, nas))
+                                    _decode_str_offsets(data, s, ln, nas,
+                                                        esc=esc_j))
             cols.append(col)
         else:
             cols.append(EncodedColumn(T_STR,
-                                      _decode_str_offsets(data, s, ln, nas)))
+                                      _decode_str_offsets(data, s, ln, nas,
+                                                          esc=esc_j)))
+    if stats is not None:
+        stats.add(t1 - t0, _time.perf_counter() - t1)
     return cols
 
 

@@ -201,22 +201,105 @@ def _parse_csv_text(text: str, setup: ParseSetup, skip_header: bool):
 
 
 _PARALLEL_PARSE_BYTES = 16 << 20   # byte-range fan-out above 16 MB
+_TARGET_RANGE_BYTES = 32 << 20     # preferred range size for huge files
+_MIN_RANGE_BYTES = 2 << 20         # never split finer than this
 
 
-def _byte_ranges(path: str, n_chunks: int) -> List[tuple]:
-    """Split a file into newline-aligned byte ranges (the reference
-    parses raw-byte chunks, water/parser/ParseDataset.java:623)."""
-    size = os.path.getsize(path)
-    bounds = [0]
-    with open(path, "rb") as f:
-        for i in range(1, n_chunks):
-            target = size * i // n_chunks
-            f.seek(target)
-            f.readline()                 # advance to the next newline
-            bounds.append(min(f.tell(), size))
-    bounds.append(size)
-    return [(bounds[i], bounds[i + 1]) for i in range(n_chunks)
-            if bounds[i + 1] > bounds[i]]
+def ingest_workers() -> int:
+    """Parse/fetch fan-out width — every ingest worker pool sizes off
+    this one knob (native thread pool, Python fallback process pool,
+    SQL fetch threads). ``H2O3_INGEST_WORKERS`` overrides; the default
+    is every core (the old hard cap of 16 left a third of a 24-core
+    host idle)."""
+    env = os.environ.get("H2O3_INGEST_WORKERS")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return max(1, os.cpu_count() or 4)
+
+
+def _range_count(size: int, workers: int, shards: int) -> int:
+    """Adaptive fan-out: at least one range per worker AND per data
+    shard (so ChunkDeviceStreamer home-placement still aligns), growing
+    with file size toward ``_TARGET_RANGE_BYTES`` ranges — but never so
+    many that a range drops under ``_MIN_RANGE_BYTES`` (per-range
+    dispatch overhead would eat the scan)."""
+    n = max(workers, shards)
+    n = max(n, min(4 * workers, size // _TARGET_RANGE_BYTES))
+    # the floor tracks the fan-out threshold so a lowered
+    # _PARALLEL_PARSE_BYTES (tests force chunking on tiny fixtures)
+    # still yields multiple ranges
+    floor = max(1, min(_MIN_RANGE_BYTES, _PARALLEL_PARSE_BYTES))
+    return int(max(1, min(n, max(1, size // floor))))
+
+
+_QUOTE_PROBE_BYTES = 8 << 20   # how far the range scan looks for quoting
+
+
+def _byte_ranges(mm, n_chunks: int, setup,
+                 force_quote_scan: bool = False) -> List[tuple]:
+    """Split an mmapped file into row-aligned byte ranges by scanning
+    the map directly — no per-boundary seek+readline storm. Boundaries
+    are newlines OUTSIDE quoted fields: when the file's head
+    (``_QUOTE_PROBE_BYTES``) contains the quote char (or the caller
+    forces it), one native state-machine pass (``csv_chunk_bounds``)
+    picks them, so a quoted field with embedded newlines cannot
+    straddle two ranges; a quote-free head keeps the boundaries at
+    ``mm.find`` newline probes (memchr speed — no full-file scan).
+    A file whose FIRST quote sits past the probe window may split a
+    quoted-newline field mid-quote — those boundaries are QUOTE-BLIND,
+    and when a range then declines, ``parse`` detects the late quote
+    and retries the whole file once with ``force_quote_scan`` (exact,
+    full-pass boundaries) instead of letting per-range csv.reader
+    fallbacks silently mis-split the field. The full state-machine
+    pass stays a single-threaded prologue (quote state is not locally
+    decidable), so only quoted files pay it, and only once."""
+    size = len(mm)
+    if n_chunks <= 1 or size == 0:
+        return [(0, size)]
+    targets = [size * i // n_chunks for i in range(1, n_chunks)]
+    quote = getattr(setup, "quotechar", '"') or '"'
+    bounds = None
+    if force_quote_scan or mm.find(quote.encode()[0:1], 0,
+                                   min(size, _QUOTE_PROBE_BYTES)) != -1:
+        from h2o3_tpu import native
+        qb = native.chunk_bounds(mm, setup.separator, quote, targets)
+        if qb is not None:
+            bounds = [int(b) for b in qb]
+        else:
+            # quotes present but no native state machine to place the
+            # boundaries: ONE range (serial, quote-correct Python parse)
+            # — blind newline cuts could split a quoted-newline field
+            # and csv.reader would mis-parse both halves SILENTLY
+            return [(0, size)]
+    if bounds is None:
+        bounds = []
+        for t in targets:
+            pos = mm.find(b"\n", t)
+            bounds.append(size if pos < 0 else pos + 1)
+    cuts = sorted({b for b in bounds if 0 < b < size})
+    edges = [0] + cuts + [size]
+    return [(edges[i], edges[i + 1]) for i in range(len(edges) - 1)
+            if edges[i + 1] > edges[i]]
+
+
+class _StageStats:
+    """Thread-safe tokenize/encode CPU-second accumulator, summed across
+    the worker pool (tools/profile_ingest.py per-stage attribution)."""
+    __slots__ = ("_lock", "tokenize_s", "encode_s")
+
+    def __init__(self):
+        import threading
+        self._lock = threading.Lock()
+        self.tokenize_s = 0.0
+        self.encode_s = 0.0
+
+    def add(self, tokenize_s: float, encode_s: float) -> None:
+        with self._lock:
+            self.tokenize_s += tokenize_s
+            self.encode_s += encode_s
 
 
 def _native_available() -> bool:
@@ -240,16 +323,30 @@ def _na_strings_native_safe(setup: ParseSetup) -> bool:
     return True
 
 
-def _encode_range_native(path: str, start: int, end: int, setup: ParseSetup,
-                         skip_header: bool):
-    """Byte-range worker on the native tokenizer (ctypes releases the
-    GIL during the C scans, so a THREAD pool runs tokenize AND the
-    numpy/native encode concurrently, with no process-spawn or pickle
-    cost). Returns finished typed columns, or None to fall back."""
-    with open(path, "rb") as f:
-        f.seek(start)
-        data = f.read(end - start)
-    return encode_chunk_native(data, setup, skip_header)
+def _encode_range_native(buf, start: int, end: int, setup: ParseSetup,
+                         skip_header: bool, stats=None, pack_cols=None):
+    """Byte-range worker on the native tokenizer: tokenizes a borrowed
+    ``memoryview`` slice of the file's shared mmap — ZERO copy, no seek,
+    the C scans read the page cache in place (ctypes releases the GIL,
+    so a THREAD pool runs tokenize and the numpy/native encode
+    concurrently with no process-spawn or pickle cost). Returns
+    ``(typed columns, PrepackedChunk-or-None)``, or a decline-reason
+    string — the caller re-parses only THIS range through the Python
+    tokenizer. ``pack_cols`` asks the worker to also build the chunk's
+    f32 streaming matrix HERE, so the pack rides the pool instead of
+    serializing through the tokenize consumer."""
+    out = encode_chunk_native(memoryview(buf)[start:end], setup,
+                              skip_header, stats=stats)
+    if isinstance(out, str):
+        return out
+    pack = None
+    if pack_cols:
+        from h2o3_tpu.ingest.stream import prepack_chunk
+        t0 = time.perf_counter()
+        pack = prepack_chunk(pack_cols, out)
+        if stats is not None:
+            stats.add(0.0, time.perf_counter() - t0)
+    return out, pack
 
 
 def _encode_range_python(path: str, start: int, end: int, setup: ParseSetup,
@@ -270,12 +367,22 @@ def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
           mesh=None, key: Optional[str] = None) -> Frame:
     """Phase 2 — streaming chunk-local parse into a row-sharded Frame.
 
-    Large files fan out over newline-aligned byte ranges (the
-    MultiFileParseTask fan-out, ParseDataset.java:623); every worker
-    returns finished typed columns with chunk-local enum dictionaries,
-    the merge unions domains + LUT-remaps codes, and device placement
-    batches one 2D transfer per dtype group."""
+    Large files fan out over newline-aligned byte ranges of one shared
+    mmap per file (the MultiFileParseTask fan-out,
+    ParseDataset.java:623) — workers tokenize ``memoryview`` slices of
+    the map in place, zero copy; every worker returns finished typed
+    columns with chunk-local enum dictionaries, the merge unions
+    domains + LUT-remaps codes, and device placement batches one 2D
+    transfer per dtype group. A range the native tokenizer declines
+    re-parses through the Python tokenizer ALONE (range-scoped
+    fallback): the native scan bit-matches the Python tokenizer on
+    every accepted token class, so a column may mix tokenizers across
+    its ranges without divergence (tests/test_ingest_pipeline.py parity
+    matrix). Residual fallbacks are visible, never silent:
+    ``h2o3_ingest_fallback_total{reason=}`` counts them and a warning
+    names the offending range."""
     import concurrent.futures as cf
+    import mmap as _mmap
 
     from h2o3_tpu import telemetry
     if isinstance(paths, str):
@@ -283,17 +390,39 @@ def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
     setup = setup or parse_setup(paths)
     root = telemetry.open_span("ingest.parse",
                                path=os.path.basename(paths[0]))
+    maps = []                          # (file, mmap) keepalives
     try:
         t_wall = time.time()
-        t0 = time.perf_counter()
-        jobs = []                      # (path, start, end, skip_header)
+        from h2o3_tpu.parallel.mesh import current_mesh, n_data_shards
+        mesh_cur = mesh or current_mesh()   # one-time device init lands
+        nw = ingest_workers()               # outside the scan stage
+        t_all0 = time.perf_counter()
+        jobs = []                      # (path, buf, start, end, skip_header)
+        mm_by_path: Dict[str, object] = {}
         for p in paths:
             size = os.path.getsize(p)
             if size >= _PARALLEL_PARSE_BYTES:
-                ranges = _byte_ranges(p, min(os.cpu_count() or 4, 16))
-                jobs += [(p, s, e, setup.header and s == 0) for s, e in ranges]
+                f = open(p, "rb")
+                mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+                try:
+                    mm.madvise(_mmap.MADV_WILLNEED)   # async readahead
+                except (AttributeError, OSError):
+                    pass
+                maps.append((f, mm))
+                mm_by_path[p] = mm
+                ranges = _byte_ranges(
+                    mm, _range_count(size, nw, n_data_shards(mesh_cur)),
+                    setup)
+                jobs += [(p, mm, s, e, setup.header and s == 0)
+                         for s, e in ranges]
             else:
-                jobs.append((p, 0, size, setup.header))
+                with open(p, "rb") as f:
+                    data = f.read()
+                jobs.append((p, data, 0, size, setup.header))
+        scan_s = time.perf_counter() - t_all0
+        telemetry.record_span("ingest.scan", t_wall, scan_s, parent=root,
+                              files=len(paths), chunks=len(jobs))
+        t0 = time.perf_counter()
         native_ok = _native_available() and _na_strings_native_safe(setup)
         skipped = _skipped_set(setup)
         active = [i for i in range(len(setup.column_names)) if i not in skipped]
@@ -320,67 +449,155 @@ def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
             stream_ok = True
         else:
             stream_ok = _jax.process_count() == 1
-        want_stream = bool(len(jobs) > 1 and stream_cols and stream_ok)
+        stats = _StageStats()
+
+        def _tokenize_native(jobs_):
+            """One native tokenize round over ``jobs_``: returns
+            (results, decline reasons, streamer)."""
+            res: List[Optional[List[EncodedColumn]]] = [None] * len(jobs_)
+            rsn: Dict[int, str] = {}
+            strm = None
+            if len(jobs_) == 1:
+                p_, buf_, s_, e_, skip_ = jobs_[0]
+                out = _encode_range_native(buf_, s_, e_, setup, skip_,
+                                           stats)
+                if isinstance(out, str):
+                    rsn[0] = out
+                else:
+                    res[0] = out[0]
+                return res, rsn, strm
+            from h2o3_tpu.ingest.stream import ChunkDeviceStreamer
+            want_stream = bool(stream_cols and stream_ok)
+            if want_stream:
+                strm = ChunkDeviceStreamer(
+                    stream_cols, list(setup.column_types), len(jobs_),
+                    mesh_cur,
+                    input_bytes=sum(e - s for _, _, s, e, _ in jobs_))
+            workers = min(len(jobs_), nw)
+            pack_cols = stream_cols if want_stream else None
+            with cf.ThreadPoolExecutor(max_workers=workers) as ex:
+                futs = {ex.submit(_encode_range_native, buf, s, e,
+                                  setup, skip, stats, pack_cols): k
+                        for k, (p, buf, s, e, skip) in enumerate(jobs_)}
+                for fu in cf.as_completed(futs):
+                    k = futs[fu]
+                    out = fu.result()
+                    if isinstance(out, str):
+                        rsn[k] = out
+                        continue
+                    res[k], pack = out
+                    if strm is not None:
+                        # chunk's DMA issued NOW, under the remaining
+                        # workers' tokenize time; the f32 pack was
+                        # built in the worker (prepack_chunk)
+                        strm.add(k, res[k], pack)
+            return res, rsn, strm
+
         streamer = None
+        reasons: Dict[int, str] = {}
         results: List[Optional[List[EncodedColumn]]] = [None] * len(jobs)
         if native_ok:
-            if len(jobs) == 1:
-                p, s, e, skip = jobs[0]
-                results[0] = _encode_range_native(p, s, e, setup, skip)
-            else:
-                from h2o3_tpu.ingest.stream import ChunkDeviceStreamer
-                from h2o3_tpu.parallel.mesh import current_mesh
-                if want_stream:
-                    streamer = ChunkDeviceStreamer(
-                        stream_cols, list(setup.column_types), len(jobs),
-                        mesh or current_mesh())
-                workers = min(len(jobs), os.cpu_count() or 4, 16)
-                with cf.ThreadPoolExecutor(max_workers=workers) as ex:
-                    futs = {ex.submit(_encode_range_native, p, s, e, setup,
-                                      skip): k
-                            for k, (p, s, e, skip) in enumerate(jobs)}
-                    for fu in cf.as_completed(futs):
-                        k = futs[fu]
-                        results[k] = fu.result()
-                        if streamer is not None and results[k] is not None:
-                            # chunk's DMA issued NOW, under the remaining
-                            # workers' tokenize time
-                            streamer.add(k, results[k])
+            results, reasons, streamer = _tokenize_native(jobs)
+            if reasons and mm_by_path:
+                # quote-blind retry: a decline on a file whose quote
+                # probe came up empty, but which DOES hold a quote past
+                # the probe window, means the naive newline boundaries
+                # may have cut a quoted field mid-quote — the per-range
+                # Python fallback would then silently mis-split it. Redo
+                # discovery with the exact full-pass state machine and
+                # re-tokenize ONCE; genuinely malformed ranges still
+                # decline on the retry and fall back per range.
+                qb = (getattr(setup, "quotechar", '"') or '"').encode()[0:1]
+                declined_paths = {jobs[k][0] for k in reasons}
+                late_quote = {
+                    p2 for p2, mm2 in mm_by_path.items()
+                    if p2 in declined_paths   # only scan files that declined
+                    and mm2.find(qb, 0, min(len(mm2), _QUOTE_PROBE_BYTES))
+                    == -1 and mm2.find(qb, _QUOTE_PROBE_BYTES) != -1}
+                if late_quote:
+                    from h2o3_tpu.log import warn
+                    warn("ingest: decline with a quote past the %d MB "
+                         "probe window in %s — re-splitting with exact "
+                         "quote-aware boundaries and re-tokenizing",
+                         _QUOTE_PROBE_BYTES >> 20, sorted(
+                             os.path.basename(p2) for p2 in late_quote))
+                    if streamer is not None:
+                        streamer.discard()   # counted: wasted uploads
+                    # rebuild preserving path order (job order IS row
+                    # order — the streamer's chunk-home map relies on it)
+                    small = {j[0]: j for j in jobs
+                             if j[0] not in mm_by_path}
+                    jobs = []
+                    for p2 in paths:
+                        if p2 in mm_by_path:
+                            mm2 = mm_by_path[p2]
+                            ranges = _byte_ranges(
+                                mm2, _range_count(len(mm2), nw,
+                                                  n_data_shards(mesh_cur)),
+                                setup, force_quote_scan=p2 in late_quote)
+                            jobs += [(p2, mm2, s, e,
+                                      setup.header and s == 0)
+                                     for s, e in ranges]
+                        elif p2 in small:
+                            jobs.append(small[p2])
+                    results, reasons, streamer = _tokenize_native(jobs)
         todo = [k for k, r in enumerate(results) if r is None]
-        if todo and streamer is not None:
-            # a declined range sends every range through the Python
-            # tokenizer (import-scoped fallback below) — native-encoded
-            # device chunks must not survive into the re-parse
-            streamer.discard()
-            streamer = None
+        n_fallback = len(todo)
         if todo:
-            # fallback is IMPORT-scoped, not range-scoped: the two tokenizers
-            # disagree on edge tokens (>63-char numerics, unicode
-            # whitespace), and a column's chunks span every file of a
-            # multi-file import — so one declined range sends ALL ranges
-            # through the Python tokenizer. A column must never mix
-            # tokenizers across its chunks (the equivalence contract).
-            todo = list(range(len(jobs)))
-            total = sum(jobs[k][2] - jobs[k][1] for k in todo)
+            # RANGE-scoped fallback: only the declined ranges re-parse
+            # through the Python tokenizer. The native scan bit-matches
+            # the Python tokenizer on every accepted token class
+            # (RFC-4180 quotes, long numerics, unicode whitespace), so
+            # a column keeps the equivalence contract even when its
+            # ranges mix tokenizers — the old import-scoped all-ranges
+            # re-parse (and its streamer.discard() of already-uploaded
+            # device chunks) is gone. Every fallback is observable:
+            # counted per reason, warned with the offending range.
+            from h2o3_tpu.log import warn
+            if not native_ok:
+                setup_reason = ("numeric_na_sentinel" if _native_available()
+                                else "no_toolchain")
+                for k in todo:
+                    reasons.setdefault(k, setup_reason)
+            for k in todo:
+                telemetry.counter(
+                    "h2o3_ingest_fallback_total",
+                    {"reason": reasons.get(k, "unknown")},
+                    help="byte ranges re-parsed through the Python "
+                         "tokenizer, by decline reason").inc()
+            k0 = todo[0]
+            warn("ingest: %d/%d byte range(s) fell back to the Python "
+                 "tokenizer — first: %s[%d:%d) reason=%s (all reasons: %s)",
+                 len(todo), len(jobs), os.path.basename(jobs[k0][0]),
+                 jobs[k0][2], jobs[k0][3], reasons.get(k0, "unknown"),
+                 sorted({reasons.get(k, "unknown") for k in todo}))
+            total = sum(jobs[k][3] - jobs[k][2] for k in todo)
             if len(todo) > 1 and total >= _PARALLEL_PARSE_BYTES:
                 # Python fallback in PROCESSES — spawn, not fork: this
                 # process is multithreaded (JAX/XLA), and forking while
-                # another thread holds an XLA mutex deadlocks the child
+                # another thread holds an XLA mutex deadlocks the child.
+                # Workers reopen the file by path (an mmap won't pickle).
                 import multiprocessing as mp
                 ctx = mp.get_context("spawn")
-                workers = min(len(todo), os.cpu_count() or 4, 16)
+                workers = min(len(todo), nw)
                 with cf.ProcessPoolExecutor(max_workers=workers,
                                             mp_context=ctx) as ex:
                     futs = {k: ex.submit(_encode_range_python, jobs[k][0],
-                                         jobs[k][1], jobs[k][2], setup,
-                                         jobs[k][3])
+                                         jobs[k][2], jobs[k][3], setup,
+                                         jobs[k][4])
                             for k in todo}
                     for k, fu in futs.items():
                         results[k] = fu.result()
             else:
                 for k in todo:
-                    p, s, e, skip = jobs[k]
+                    p, buf, s, e, skip = jobs[k]
                     results[k] = _encode_range_python(p, s, e, setup, skip)
+            if streamer is not None:
+                # the re-parsed ranges join the stream late; every other
+                # range's already-uploaded device chunk SURVIVES (the
+                # wasted-work seam tests/test_ingest_pipeline.py guards)
+                for k in todo:
+                    streamer.add(k, results[k])
         t1 = time.perf_counter()
         # the streamed transfers ran INSIDE the tokenize window — report
         # tokenize net of that hidden transfer time so the two stages
@@ -467,11 +684,20 @@ def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
         if root is not None:
             root.attrs.update(rows=fr.nrow, chunks=len(jobs))
             root.finish()
+        fb_reasons: Dict[str, int] = {}
+        for r in reasons.values():
+            fb_reasons[r] = fb_reasons.get(r, 0) + 1
         # in-place so `from h2o3_tpu.ingest.parse import LAST_PROFILE` stays live
         LAST_PROFILE.clear()
         LAST_PROFILE.update({"rows": fr.nrow, "chunks": len(jobs),
-                             "native": bool(native_ok and not todo),
+                             "native": bool(native_ok and not n_fallback),
+                             "native_ranges": len(jobs) - n_fallback,
+                             "fallback_ranges": n_fallback,
+                             "fallback_reasons": fb_reasons,
                              "streamed": streamer is not None,
+                             "scan_s": round(scan_s, 4),
+                             "tokenize_cpu_s": round(stats.tokenize_s, 4),
+                             "encode_cpu_s": round(stats.encode_s, 4),
                              "tokenize_encode_s": round(t1 - t0 - hidden_put_s, 4),
                              "merge_s": round(merge_s[0], 4),
                              "device_put_s": round(put_total_s, 4),
@@ -491,6 +717,12 @@ def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
         if root is not None and root.duration_s is None:
             root.attrs["error"] = True
             root.finish()
+        for f, mm in maps:
+            try:
+                mm.close()
+            except BufferError:
+                pass           # a straggler view still borrows the map;
+            f.close()          # the GC closes it when the last view dies
 
 
 def import_file(path: Union[str, Sequence[str]], destination_frame: Optional[str] = None,

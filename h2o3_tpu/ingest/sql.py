@@ -82,7 +82,10 @@ def import_sql_table(connection_factory: Callable, table: str,
 
     if len(ranges) > 1:
         import concurrent.futures as cf
-        with cf.ThreadPoolExecutor(max_workers=len(ranges)) as ex:
+
+        from h2o3_tpu.ingest.parse import ingest_workers
+        with cf.ThreadPoolExecutor(
+                max_workers=min(len(ranges), ingest_workers())) as ex:
             parts = list(ex.map(fetch, ranges))
     else:
         parts = [fetch(r) for r in ranges]

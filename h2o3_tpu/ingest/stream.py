@@ -54,6 +54,53 @@ _EXACT_F32_BOUND = float(1 << 24)   # f32 mantissa: integral values above
                                     # this need the f64 host shadow
 
 
+class PrepackedChunk:
+    """The f32 pack matrix of one chunk's streamed columns, built IN THE
+    WORKER thread (bulk numpy ops release the GIL and run concurrently)
+    instead of serialized through the tokenize consumer. ``fmax[j]`` is
+    the column's finite |max| (feeds the host-shadow decision; -inf for
+    time lanes, whose shadow is the int64 ms kept on the EncodedColumn)."""
+    __slots__ = ("mat", "fmax")
+
+    def __init__(self, mat, fmax):
+        self.mat = mat
+        self.fmax = fmax
+
+
+def prepack_chunk(col_ids, cols) -> PrepackedChunk:
+    """Pack ``cols[i]`` for i in ``col_ids`` into the [rows, C] float32
+    streaming matrix + per-lane finite |max| — called by the byte-range
+    worker right after the encode, so the pack rides the worker pool's
+    parallelism and ``ChunkDeviceStreamer.add`` does bookkeeping only."""
+    import warnings
+    rows = len(cols[col_ids[0]].data) if col_ids else 0
+    mat = np.empty((rows, len(col_ids)), np.float32)
+    fmax = np.full(len(col_ids), -np.inf)
+    for j, i in enumerate(col_ids):
+        c = cols[i]
+        if c.vtype == T_TIME:
+            ms = np.asarray(c.data, dtype=np.int64)
+            # same arithmetic as Vec.from_numpy's time path: f64
+            # seconds, converted to f32 by the pack assignment
+            mat[:, j] = np.where(ms == Vec.TIME_NA, np.nan, ms / 1000.0)
+            continue
+        f64 = c.data
+        mat[:, j] = f64              # assignment converts f64 -> f32
+        # duck-typed column contract: fmax is optional (the native
+        # encoder sets it; test fakes and the Python fallback may not)
+        cmax = getattr(c, "fmax", None)
+        if cmax is not None:         # encoder already reduced it
+            fmax[j] = cmax
+        elif f64.size:
+            finite = np.isfinite(f64)
+            if finite.any():
+                with np.errstate(invalid="ignore"), \
+                        warnings.catch_warnings():
+                    warnings.simplefilter("ignore", RuntimeWarning)
+                    fmax[j] = float(np.abs(f64[finite]).max())
+    return PrepackedChunk(mat, fmax)
+
+
 class ChunkDeviceStreamer:
     """Streams one parse's numeric/time columns to device per chunk.
 
@@ -63,8 +110,14 @@ class ChunkDeviceStreamer:
     column index. Columns that turn out to need the host merge (wide-int
     ``exact`` shadows) are reported in ``fallback_cols`` instead."""
 
+    # above this input size the CPU backend goes back to per-chunk puts:
+    # the final single-copy stops fitting cache and the per-chunk
+    # dispatch overhead amortizes, while per-chunk puts hide under the
+    # (now much longer) tokenize window
+    _HOST_ASSEMBLE_MAX_BYTES = 256 << 20
+
     def __init__(self, col_ids: List[int], col_types: List[str],
-                 n_chunks: int, mesh):
+                 n_chunks: int, mesh, input_bytes: Optional[int] = None):
         from h2o3_tpu.parallel.mesh import n_data_shards, partitioner
         self.col_ids = list(col_ids)          # original column indices
         self.col_types = col_types            # full setup.column_types
@@ -72,6 +125,24 @@ class ChunkDeviceStreamer:
         self.mesh = mesh
         self.part = partitioner(mesh)
         self.nd = n_data_shards(mesh)
+        # host-assemble mode (ISSUE 14): on a single-data-shard CPU-
+        # backend mesh there is no PCIe DMA to hide — for SMALL inputs
+        # the per-chunk jax.device_put dispatch (milliseconds each) and
+        # the per-chunk-count concat compiles cost more than the copies
+        # they organize, so chunks pack host-side (still under the
+        # tokenize window), assemble into per-column arrays and upload
+        # once with zero compiled programs. Large inputs keep per-chunk
+        # puts even on CPU (the fixed overhead amortizes and the copy
+        # hides under tokenize); accelerator meshes always keep the
+        # streamed DMA, which is the whole point of this class.
+        try:
+            dev0 = next(iter(mesh.devices.flat))
+            self.host_assemble = (
+                self.nd == 1 and dev0.platform == "cpu"
+                and (input_bytes is None
+                     or input_bytes <= self._HOST_ASSEMBLE_MAX_BYTES))
+        except (AttributeError, StopIteration):
+            self.host_assemble = False
         self._home: Dict[int, int] = {}       # chunk_idx -> home data shard
         # per-shard placement accounting (shard_profile)
         self._shard_bytes = [0] * self.nd
@@ -97,56 +168,52 @@ class ChunkDeviceStreamer:
 
     # -- per-chunk feed --------------------------------------------------
 
-    def _shadow_stats(self, i: int, f64: np.ndarray) -> None:
-        import warnings
-        if f64.size == 0:
-            return
-        finite = np.isfinite(f64)
-        if not finite.any():
-            return
-        with np.errstate(invalid="ignore"), warnings.catch_warnings():
-            warnings.simplefilter("ignore", RuntimeWarning)
-            m = float(np.abs(f64[finite]).max())
-        if m > self._fmax[i]:
-            self._fmax[i] = m
-
-    def add(self, chunk_idx: int, cols) -> None:
-        """Pack this chunk's f32 group and issue its (async) DMA."""
+    def add(self, chunk_idx: int, cols, pack: "PrepackedChunk" = None
+            ) -> None:
+        """Register this chunk's f32 pack and issue its (async) DMA.
+        ``pack`` is the worker-built :class:`PrepackedChunk` (the normal
+        streamed path); without one (e.g. a fallback range re-parsed in
+        Python joining the stream late) the pack is built here."""
         import jax
         from h2o3_tpu import telemetry
         if self._discarded:
             return
         t0 = time.perf_counter()
-        C = len(self.col_ids)
-        rows_c = None
-        mat = None
+        if pack is None:
+            pack = prepack_chunk(self.col_ids, cols)
+        mat = pack.mat
+        rows_c = mat.shape[0]
+        # bookkeeping per column: time-ms host shadows, wide-int exact
+        # condemnation, the f64 references the (rare) host-shadow concat
+        # reads, and the per-column finite |max| reduction — the heavy
+        # pack/convert/stat work already ran in the worker thread
         for j, i in enumerate(self.col_ids):
             c = cols[i]
-            if rows_c is None:
-                rows_c = len(c.data)
-                mat = np.empty((rows_c, C), np.float32)
             if c.vtype == T_TIME:
-                ms = np.asarray(c.data, dtype=np.int64)
-                self._time_ms.setdefault(i, {})[chunk_idx] = ms
-                # same arithmetic as Vec.from_numpy's time path: f64
-                # seconds, converted to f32 by the pack assignment
-                mat[:, j] = np.where(ms == Vec.TIME_NA, np.nan, ms / 1000.0)
-            elif i in self._exact:
-                # column already condemned to the host merge (wide-int
-                # exact shadow seen in an earlier chunk): its matrix lane
-                # still ships (the pack width is fixed) but skip the
-                # convert/stats work — assemble drops the lane
-                mat[:, j] = 0.0
-            else:
-                f64 = c.data
-                if c.exact is not None:
-                    self._exact.add(i)
-                mat[:, j] = f64          # assignment converts f64 -> f32
-                self._shadow_stats(i, f64)
-                # keep the f64 around until assemble decides whether this
-                # column needs an exact host shadow (integral > 2^24)
-                self._f64.setdefault(i, {})[chunk_idx] = f64
+                self._time_ms.setdefault(i, {})[chunk_idx] = np.asarray(
+                    c.data, dtype=np.int64)
+                continue
+            if i in self._exact:
+                continue
+            if c.exact is not None:
+                self._exact.add(i)
+            if pack.fmax[j] > self._fmax[i]:
+                self._fmax[i] = float(pack.fmax[j])
+            self._f64.setdefault(i, {})[chunk_idx] = c.data
         self._rows[chunk_idx] = rows_c or 0
+        home = self.part.chunk_home(chunk_idx, self.n_chunks)
+        self._home[chunk_idx] = home
+        if self.host_assemble:
+            # CPU-backend fast path: the packed matrix stays host-side;
+            # assemble() concatenates and uploads ONCE (per-chunk
+            # dispatch + per-chunk-count concat compiles disappear)
+            self._devs[chunk_idx] = mat
+            self._shard_bytes[home] += mat.nbytes
+            self._shard_chunks[home] += 1
+            dt = time.perf_counter() - t0
+            self.add_seconds += dt
+            self._shard_hidden_s[home] += dt
+            return
         # shard-aligned placement: the chunk's DMA targets its HOME
         # data-shard device (chunk order == row order for byte ranges),
         # so on a wide mesh the upload already lands ~where the rows
@@ -154,8 +221,6 @@ class ChunkDeviceStreamer:
         # A transient chunk-upload failure retries with backoff instead
         # of failing the whole parse (the fault-matrix test drives this)
         from h2o3_tpu.resilience import resilient_device_put
-        home = self.part.chunk_home(chunk_idx, self.n_chunks)
-        self._home[chunk_idx] = home
         target = self.part.home_device(home) if self.nd > 1 else None
         dev = resilient_device_put(mat, target, pipeline="ingest")
         telemetry.record_h2d(mat.nbytes, pipeline="ingest")
@@ -174,8 +239,20 @@ class ChunkDeviceStreamer:
         self._shard_hidden_s[home] += dt
 
     def discard(self) -> None:
-        """Drop everything (the import-scoped Python-tokenizer fallback
-        re-parses every range; streamed native data must not survive)."""
+        """Drop every streamed chunk. NO normal path calls this anymore:
+        the fallback seam is range-scoped (a declined range re-parses
+        alone and ``add``s late; its neighbors' uploads survive), where
+        it used to blanket-discard the whole stream on one declined
+        range. Kept for abnormal teardown — and any use is VISIBLE:
+        the thrown-away upload bytes land in
+        ``h2o3_ingest_h2d_bytes_discarded_total``, so silent re-upload
+        can't hide."""
+        from h2o3_tpu import telemetry
+        if self.h2d_bytes:
+            telemetry.counter(
+                "h2o3_ingest_h2d_bytes_discarded_total",
+                help="streamed ingest H2D bytes discarded before "
+                     "assembly (wasted upload work)").inc(self.h2d_bytes)
         self._discarded = True
         self._devs.clear()
         self._inflight.clear()
@@ -291,6 +368,50 @@ class ChunkDeviceStreamer:
         tot = self._aligned_rows + self._moved_rows
         return self._aligned_rows / tot if tot else None
 
+    def _assemble_host(self, nrow: int) -> Dict[int, Vec]:
+        """CPU-backend assembly (``host_assemble``): per-column host
+        concat of the packed chunk matrices + ONE batched ``device_put``
+        of every column. No per-chunk puts, no device concat, no column
+        slicing — a cold parse compiles ZERO XLA programs here, which on
+        the CPU backend cost more than the byte copies they organized
+        (ISSUE 14 measured ~0.2 s of compiles on a 0.4 s parse)."""
+        import jax
+        from h2o3_tpu import telemetry
+        from h2o3_tpu.parallel.mesh import padded_len
+        from h2o3_tpu.resilience import resilient_device_put
+        mats = [self._devs.pop(k) for k in sorted(self._devs)]
+        plen = padded_len(nrow, self.mesh)
+        pad = (np.full(plen - nrow, np.nan, np.float32)
+               if plen > nrow else None)
+        keep = [(j, i) for j, i in enumerate(self.col_ids)
+                if i not in self._exact]
+        host_cols = []
+        for j, i in keep:
+            parts = [m[:, j] for m in mats]
+            if pad is not None:
+                parts.append(pad)
+            host_cols.append(np.concatenate(parts) if len(parts) > 1
+                             else parts[0])
+        del mats
+        nbytes = sum(c.nbytes for c in host_cols)
+        telemetry.record_h2d(nbytes, pipeline="ingest")
+        self.h2d_bytes += nbytes
+        self._shard_bytes[0] += nbytes
+        devs = resilient_device_put(host_cols, self.part.data_sharding,
+                                    pipeline="ingest")
+        out: Dict[int, Vec] = {}
+        for (j, i), col in zip(keep, devs):
+            vt = self.col_types[i]
+            if vt == T_TIME:
+                parts = [self._time_ms[i][k] for k in sorted(self._time_ms[i])]
+                ms = parts[0] if len(parts) == 1 else np.concatenate(parts)
+                out[i] = Vec(col, nrow, T_TIME, host_data=ms)
+            else:
+                out[i] = Vec(col, nrow, vt, host_data=self._host_shadow(i))
+        self._f64.clear()
+        jax.block_until_ready(devs)  # h2o3-lint: allow[transfer-seam,host-sync-hot-loop] assemble() contract: callers receive finished Vecs, this is the one visible barrier the overlap metric measures
+        return out
+
     def assemble(self) -> Dict[int, Vec]:
         """Block on outstanding DMAs, concatenate chunk matrices on
         device, pad + reshard to the mesh row layout, and return one Vec
@@ -302,6 +423,15 @@ class ChunkDeviceStreamer:
         nrow = sum(self._rows.values())
         t0 = time.perf_counter()
         C = len(self.col_ids)
+        if self.host_assemble:
+            out = self._assemble_host(nrow)
+            self.assemble_seconds = time.perf_counter() - t0
+            from h2o3_tpu.telemetry import costmodel
+            costmodel.record(
+                "ingest.assemble",
+                costmodel.Cost(0.0, float(self.h2d_bytes)),
+                seconds=sum(self._shard_hidden_s) + self.assemble_seconds)
+            return out
         if self.nd > 1:
             full = self._assemble_sharded(nrow, C)
             self._inflight.clear()
@@ -323,11 +453,13 @@ class ChunkDeviceStreamer:
                     axis=0)
             full = jax.device_put(  # h2o3-lint: allow[transfer-seam] blessed commit site: reshard of already-device-resident data (D2D, no host bytes)
                 full, partitioner(self.mesh).data_sharding)
+        from h2o3_tpu.frame.vec import split_columns
+        cols = split_columns(full, C)   # one compiled dispatch, not C
         out: Dict[int, Vec] = {}
         for j, i in enumerate(self.col_ids):
             if i in self._exact:
                 continue
-            col = full[:, j]
+            col = cols[j]
             vt = self.col_types[i]
             if vt == T_TIME:
                 parts = [self._time_ms[i][k] for k in sorted(self._time_ms[i])]

@@ -196,12 +196,12 @@ def test_all_na_numeric_column(tmp_path):
     assert fr.vec("a").na_count() == 3
 
 
-def test_fallback_is_file_scoped(tmp_path, monkeypatch):
-    # a quote in ONE byte range must route the WHOLE file through the
-    # Python tokenizer: the two tokenizers disagree on edge tokens
-    # (e.g. >63-char numerics, which the native scan maps to NA), so a
-    # column must never mix tokenizers across its chunks
-    long_num = "0." + "1" * 70             # parses in Python, not native
+def test_formerly_divergent_tokens_stay_native(tmp_path, monkeypatch):
+    # the three documented decline classes of the pre-ISSUE-14 native
+    # tokenizer — quoted fields, >63-char numerics, unicode whitespace —
+    # now parse NATIVELY (no fallback at all), with the same values the
+    # Python tokenizer produces
+    long_num = "0." + "1" * 70
     rows = [f"{i},plain" for i in range(2, 400)]
     body = [f"{long_num},first"] + rows + ['9,"quoted,tail"']
     p = tmp_path / "mix.csv"
@@ -210,10 +210,227 @@ def test_fallback_is_file_scoped(tmp_path, monkeypatch):
     monkeypatch.setattr(parse_mod, "_PARALLEL_PARSE_BYTES", 1)
     fr = parse([str(p)], setup)
     assert parse_mod.LAST_PROFILE["chunks"] > 1
-    assert not parse_mod.LAST_PROFILE["native"]
+    assert parse_mod.LAST_PROFILE["native"]
+    assert parse_mod.LAST_PROFILE["fallback_ranges"] == 0
     x = fr.vec("x").to_numpy()
     assert x[0] == pytest.approx(float(long_num))   # not munged to NA
     assert "quoted,tail" in fr.vec("s").domain
+
+
+# ---------------- tentpole: native-vs-Python tokenizer parity matrix ----
+#
+# The range-scoped fallback MIXES tokenizers across byte ranges of one
+# column, so the native tokenizer must bit-match the Python one on every
+# accepted token class — each case asserts (1) the native path handled
+# the file (no fallback), (2) the frame is bit-identical to the pure
+# Python tokenizer's.
+
+PARITY_CASES = {
+    "quoted_embedded_delimiter":
+        'g,x\n"a,b",1\nplain,2\n"c,d,e",3\n"a,b",4\n',
+    "quoted_embedded_newline":
+        'g,x\n"line1\nline2",1\nplain,2\n"a\nb\nc",3\n',
+    "escaped_quotes":
+        'g,x\n"he said ""hi""",1\n"""lead",2\n"trail""",3\nplain,4\n',
+    "long_numerics":
+        "x,y\n" + "0." + "1" * 70 + ",1\n" + "9" * 80 + "e-70,2\n3,3\n",
+    "unicode_whitespace":
+        "g,x\n padded ,1\n　wide　,2\n ascii , 3 \n",
+    "na_inside_quotes":
+        'g,x\n"NA",1\n"na",2\nreal,3\n"",4\n',
+    "crlf_lf_mixed":
+        "g,x\r\na,1\r\nb,2\nc,3\r\nd,4\n",
+    "quoted_numeric_cells":
+        'x,y\n"1.5",1\n"2e3",2\n" 7 ",3\n',
+}
+
+
+@pytest.mark.parametrize("case", sorted(PARITY_CASES))
+def test_tokenizer_parity_matrix(tmp_path, monkeypatch, case):
+    p = tmp_path / f"{case}.csv"
+    p.write_bytes(PARITY_CASES[case].encode("utf-8"))
+    setup = parse_setup(str(p))
+    fr_native = parse([str(p)], setup)
+    if not parse_mod._native_available():
+        pytest.skip("native tokenizer unavailable in this image")
+    # the native path itself handled every range — no silent fallback
+    assert parse_mod.LAST_PROFILE["native"], \
+        parse_mod.LAST_PROFILE["fallback_reasons"]
+    assert parse_mod.LAST_PROFILE["fallback_ranges"] == 0
+    monkeypatch.setattr(parse_mod, "_native_available", lambda: False)
+    fr_python = parse([str(p)], setup)
+    assert not parse_mod.LAST_PROFILE["native"]
+    _frames_equal(fr_native, fr_python)
+
+
+def test_parity_matrix_parallel_ranges(tmp_path, monkeypatch):
+    # the same token classes crossing byte-range boundaries: quoted
+    # fields with embedded newlines must not be split mid-field by the
+    # range scan (csv_chunk_bounds quote-parity alignment)
+    rng = np.random.default_rng(3)
+    lines = ["g,x"]
+    for i in range(400):
+        kind = i % 5
+        if kind == 0:
+            lines.append(f'"a,{i}\nb",{i}')
+        elif kind == 1:
+            lines.append(f'"q""{i}""",{i}')
+        elif kind == 2:
+            lines.append(f" pad{i % 7} ,{i}")
+        elif kind == 3:
+            lines.append('"NA",%d' % i)
+        else:
+            lines.append(f"plain{i % 11},{i}")
+    p = tmp_path / "matrix.csv"
+    p.write_bytes(("\n".join(lines) + "\n").encode("utf-8"))
+    setup = parse_setup(str(p))
+    fr_serial = parse([str(p)], setup)
+    if not parse_mod._native_available():
+        pytest.skip("native tokenizer unavailable in this image")
+    monkeypatch.setattr(parse_mod, "_PARALLEL_PARSE_BYTES", 1)
+    fr_par = parse([str(p)], setup)
+    assert parse_mod.LAST_PROFILE["chunks"] > 1
+    assert parse_mod.LAST_PROFILE["native"]
+    assert parse_mod.LAST_PROFILE["fallback_ranges"] == 0
+    monkeypatch.setattr(parse_mod, "_native_available", lambda: False)
+    fr_python = parse([str(p)], setup)
+    _frames_equal(fr_serial, fr_par)
+    _frames_equal(fr_par, fr_python)
+
+
+def test_fallback_is_range_scoped(tmp_path, monkeypatch):
+    # ONE poisoned range (a ragged row the native scan declines) must
+    # not re-parse its neighbors: every other range stays native, the
+    # fallback is counted with its reason, and the frame still matches
+    # the pure-Python parse
+    lines = [f"{i},tok{i % 13}" for i in range(1, 800)]
+    lines[500] = "9,extra,cells,beyond,the,schema"   # ragged → decline
+    p = tmp_path / "poison.csv"
+    p.write_text("x,s\n" + "\n".join(lines) + "\n")
+    setup = parse_setup(str(p))
+    if not parse_mod._native_available():
+        pytest.skip("native tokenizer unavailable in this image")
+    monkeypatch.setattr(parse_mod, "_PARALLEL_PARSE_BYTES", 1)
+    fr = parse([str(p)], setup)
+    prof = dict(parse_mod.LAST_PROFILE)
+    assert prof["chunks"] > 2
+    assert prof["fallback_ranges"] >= 1          # the poisoned range
+    assert prof["native_ranges"] == prof["chunks"] - prof["fallback_ranges"]
+    assert prof["native_ranges"] >= prof["chunks"] - 2   # neighbors survive
+    assert "ragged_rows" in prof["fallback_reasons"]
+    monkeypatch.setattr(parse_mod, "_native_available", lambda: False)
+    fr_python = parse([str(p)], setup)
+    _frames_equal(fr, fr_python)
+
+
+def test_streamed_chunks_survive_range_fallback(tmp_path, monkeypatch):
+    # the wasted-work seam: when a range declines mid-stream, the other
+    # ranges' already-streamed device chunks survive — nothing lands in
+    # the h2o3_ingest_h2d_bytes_discarded_total counter and the
+    # streamed assembly covers every chunk (fallback chunks add late)
+    from h2o3_tpu import telemetry
+    lines = [f"{i},{i * 0.5}" for i in range(1, 800)]
+    lines[400] = "9,1,overflow"                      # ragged → decline
+    p = tmp_path / "poison2.csv"
+    p.write_text("a,b\n" + "\n".join(lines) + "\n")
+    setup = parse_setup(str(p))
+    if not parse_mod._native_available():
+        pytest.skip("native tokenizer unavailable in this image")
+    telemetry.install()
+    before = telemetry.registry().value(
+        "h2o3_ingest_h2d_bytes_discarded_total")
+    monkeypatch.setattr(parse_mod, "_PARALLEL_PARSE_BYTES", 1)
+    monkeypatch.setenv("H2O3_INGEST_STREAM", "1")
+    fr = parse([str(p)], setup)
+    prof = dict(parse_mod.LAST_PROFILE)
+    assert prof["streamed"] and prof["fallback_ranges"] >= 1
+    assert telemetry.registry().value(
+        "h2o3_ingest_h2d_bytes_discarded_total") == before
+    a = fr.vec("a").to_numpy()
+    assert fr.nrow == 799
+    assert a[0] == 1 and a[798] == 799
+
+
+def test_underscore_numerics_parity(tmp_path, monkeypatch):
+    # PEP-515 grouped numerics: float("1_000") == 1000.0 — the native
+    # tokenizer must agree, or a range-scoped fallback would read the
+    # same token as NA in native ranges and 1000.0 in Python ones
+    p = tmp_path / "grouped.csv"
+    p.write_text("x,s\n1_000,a\n2_5.5,b\n1_0e1_0,c\n_1,d\n1_,e\n1__0,f\n")
+    # invalid groupings would poison the sample-based type guess into
+    # enum; the parity under test is the NUMERIC encode of these tokens
+    setup = parse_setup(str(p), header=True,
+                        column_types=["real", "enum"])
+    fr_native = parse([str(p)], setup)
+    if not parse_mod._native_available():
+        pytest.skip("native tokenizer unavailable in this image")
+    assert parse_mod.LAST_PROFILE["native"]
+    monkeypatch.setattr(parse_mod, "_native_available", lambda: False)
+    fr_python = parse([str(p)], setup)
+    _frames_equal(fr_native, fr_python)
+    x = fr_native.vec("x").to_numpy()
+    assert x[0] == 1000.0 and x[1] == 25.5 and x[2] == 1e11
+    assert np.isnan(x[3]) and np.isnan(x[4]) and np.isnan(x[5])
+
+
+def test_late_quote_beyond_probe_window_retries(tmp_path, monkeypatch):
+    # a file whose FIRST quote (a quoted field with embedded newlines)
+    # sits past the probe window: the naive newline boundaries would
+    # split it mid-quote — parse must detect the late quote on decline
+    # and retry with exact quote-aware boundaries, ending bit-identical
+    # to the pure-Python whole-file parse, all ranges native
+    monkeypatch.setattr(parse_mod, "_QUOTE_PROBE_BYTES", 256)
+    monkeypatch.setattr(parse_mod, "_PARALLEL_PARSE_BYTES", 1)
+    lines = ["g,x"] + [f"plain{i % 7},{i}" for i in range(60)]
+    lines.append('"multi\nline\nfield",999')       # beyond byte 256
+    lines += [f"tail{i % 5},{i}" for i in range(40)]
+    p = tmp_path / "latequote.csv"
+    p.write_text("\n".join(lines) + "\n")
+    setup = parse_setup(str(p))
+    if not parse_mod._native_available():
+        pytest.skip("native tokenizer unavailable in this image")
+    fr = parse([str(p)], setup)
+    prof = dict(parse_mod.LAST_PROFILE)
+    assert prof["chunks"] > 1
+    assert prof["native"] and prof["fallback_ranges"] == 0
+    assert "multi\nline\nfield" in fr.vec("g").domain
+    monkeypatch.setattr(parse_mod, "_native_available", lambda: False)
+    monkeypatch.setattr(parse_mod, "_PARALLEL_PARSE_BYTES", 1 << 30)
+    fr_python = parse([str(p)], setup)
+    _frames_equal(fr, fr_python)
+
+
+def test_quoted_file_without_toolchain_stays_serial(tmp_path, monkeypatch):
+    # no native toolchain + a quoted file: there is no state machine to
+    # place quote-safe boundaries, so the file must parse as ONE range
+    # (serial, quote-correct csv.reader) — blind newline cuts would
+    # split the quoted-newline field and corrupt rows silently
+    import h2o3_tpu.native as native_mod
+    lines = ["g,x"] + [f"p{i % 3},{i}" for i in range(50)]
+    lines.append('"multi\nline\nfield",999')
+    lines += [f"q{i % 3},{i}" for i in range(50)]
+    p = tmp_path / "noolchain.csv"
+    p.write_text("\n".join(lines) + "\n")
+    setup = parse_setup(str(p))
+    fr_ref = parse([str(p)], setup)              # whole-file reference
+    monkeypatch.setattr(parse_mod, "_PARALLEL_PARSE_BYTES", 1)
+    monkeypatch.setattr(parse_mod, "_native_available", lambda: False)
+    monkeypatch.setattr(native_mod, "chunk_bounds",
+                        lambda *a, **k: None)
+    fr = parse([str(p)], setup)
+    assert parse_mod.LAST_PROFILE["chunks"] == 1
+    assert "multi\nline\nfield" in fr.vec("g").domain
+    _frames_equal(fr_ref, fr)
+
+
+def test_ingest_workers_override(monkeypatch):
+    monkeypatch.setenv("H2O3_INGEST_WORKERS", "3")
+    assert parse_mod.ingest_workers() == 3
+    monkeypatch.setenv("H2O3_INGEST_WORKERS", "not-a-number")
+    assert parse_mod.ingest_workers() >= 1       # falls back to cpu count
+    monkeypatch.delenv("H2O3_INGEST_WORKERS")
+    import os as _os
+    assert parse_mod.ingest_workers() == max(1, _os.cpu_count() or 4)
 
 
 def test_rbind_time_stays_time(tmp_path):

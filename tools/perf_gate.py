@@ -56,6 +56,11 @@ METRIC_SPECS: Tuple[Tuple[str, str, float], ...] = (
     ("time_to_first_model_s", "lower", 0.35),  # compile-cache sensitive
     ("loop_s", "lower", 0.15),
     ("ingest_rows_per_sec", "higher", 0.15),
+    # parse throughput ratchets up; any byte range re-parsed through
+    # the Python tokenizer ratchets DOWN from a best of zero (band 0 on
+    # a 0 best: one fallback range fails the gate — ISSUE 14)
+    ("ingest.mb_per_sec", "higher", 0.15),
+    ("ingest.fallback_ranges", "lower", 0.0),
     ("serve.rows_per_sec", "higher", 0.20),
     ("serve.mfu", "higher", 0.25),
     ("serve.p50_ms", "lower", 0.35),

@@ -1,22 +1,31 @@
 """Ingest stage profiler — attribute parse time to its pipeline stages.
 
 Writes a synthetic mixed-type CSV (numeric, enum, time columns with NA
-sentinels), runs the REAL end-to-end ``parse()`` (byte-range fan-out),
-and reads the stage attribution from the telemetry spans the pipeline
-itself records (h2o3_tpu.telemetry): tokenize_encode (native C scan +
-chunk-local typed encode), domain_union (enum merge + LUT remap) and
-device_put (batched host→device transfer), plus the h2d transfer-byte
-counter at the ``batch_device_put`` choke point. The tool keeps NO
-timers of its own around pipeline stages — the numbers here are the
-SAME ones ``GET /metrics`` and ``GET /3/Telemetry`` export, so the
-tool-reported and REST-reported splits cannot disagree (ISSUE 4).
+sentinels), runs the REAL end-to-end ``parse()`` (mmap byte-range
+fan-out), and reads the stage attribution from the telemetry spans the
+pipeline itself records (h2o3_tpu.telemetry): scan (mmap + quote-safe
+range discovery), tokenize_encode (native C scan + chunk-local typed
+encode, split into tokenize/encode CPU-seconds by the worker stats),
+domain_union (enum merge + LUT remap) and device_put (pack + host→device
+transfer), plus the h2d transfer-byte counter. The tool keeps NO timers
+of its own around pipeline stages — the numbers here are the SAME ones
+``GET /metrics`` and ``GET /3/Telemetry`` export, so the tool-reported
+and REST-reported splits cannot disagree (ISSUE 4).
 
-Prints ONE JSON line so a future ingest regression is attributable to a
-stage, not just "parse got slower".
+Prints ONE JSON line (plus a human per-stage MB/s table on stderr) so a
+future ingest regression is attributable to a stage, not just "parse
+got slower" — the table is the "where does the next 2x live" artifact
+ISSUE 14 asks for. Any byte range that fell back to the Python
+tokenizer is listed with its reason; a healthy run shows
+``fallback_ranges: 0``.
 
-Env knobs: ROWS (default 2M), NCOL_NUM / NCOL_ENUM / NCOL_TIME,
-CSV (reuse an existing file instead of synthesizing).
+Args / env knobs: ``--rows N --cols K`` (numeric column count; enum and
+time columns ride along via NCOL_ENUM / NCOL_TIME) synthesize the CSV
+without a fixture file, so the >=2x claim reproduces anywhere; ``--csv
+PATH`` (or CSV env) reuses an existing file; ROWS / NCOL_NUM env still
+work for the older driver scripts.
 """
+import argparse
 import json
 import os
 import sys
@@ -27,48 +36,60 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-ROWS = int(os.environ.get("ROWS", 2_000_000))
-NCOL_NUM = int(os.environ.get("NCOL_NUM", 6))
-NCOL_ENUM = int(os.environ.get("NCOL_ENUM", 2))
-NCOL_TIME = int(os.environ.get("NCOL_TIME", 1))
-
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def _synth_csv(path):
+def _synth_csv(path, rows, ncol_num, ncol_enum, ncol_time):
     rng = np.random.default_rng(11)
     cities = np.array(["ames", "berlin", "cairo", "delhi", "el-paso",
                        "fargo", "galway", "hanoi"])
-    header = ([f"n{i}" for i in range(NCOL_NUM)]
-              + [f"e{i}" for i in range(NCOL_ENUM)]
-              + [f"t{i}" for i in range(NCOL_TIME)])
-    log(f"writing {path} ({ROWS} rows x {len(header)} cols) ...")
+    header = ([f"n{i}" for i in range(ncol_num)]
+              + [f"e{i}" for i in range(ncol_enum)]
+              + [f"t{i}" for i in range(ncol_time)])
+    log(f"writing {path} ({rows} rows x {len(header)} cols) ...")
     t0 = time.time()
-    with open(path, "w") as f:
+    tmp = path + ".part"
+    with open(tmp, "w") as f:
         f.write(",".join(header) + "\n")
         chunk = 200_000
-        for s in range(0, ROWS, chunk):
-            e = min(s + chunk, ROWS)
+        for s in range(0, rows, chunk):
+            e = min(s + chunk, rows)
             cols = []
-            for i in range(NCOL_NUM):
+            for i in range(ncol_num):
                 v = np.char.mod("%.6g", rng.normal(size=e - s))
                 v[rng.random(e - s) < 0.01] = "NA"
                 cols.append(v)
-            for i in range(NCOL_ENUM):
+            for i in range(ncol_enum):
                 cols.append(cities[rng.integers(0, len(cities), e - s)])
-            for i in range(NCOL_TIME):
+            for i in range(ncol_time):
                 days = rng.integers(0, 3650, e - s)
                 d = (np.datetime64("2015-01-01") + days).astype(str)
                 cols.append(d)
             mat = np.stack(cols, axis=1)
             block = [",".join(row) for row in mat]
             f.write("\n".join(block) + "\n")
+    os.replace(tmp, path)
     log(f"csv written in {time.time() - t0:.1f}s")
 
 
-def main():
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="profile the ingest parse pipeline per stage")
+    ap.add_argument("--rows", type=int,
+                    default=int(os.environ.get("ROWS", 2_000_000)))
+    ap.add_argument("--cols", type=int,
+                    default=int(os.environ.get("NCOL_NUM", 6)),
+                    help="numeric column count of the synthetic CSV")
+    ap.add_argument("--enum-cols", type=int,
+                    default=int(os.environ.get("NCOL_ENUM", 2)))
+    ap.add_argument("--time-cols", type=int,
+                    default=int(os.environ.get("NCOL_TIME", 1)))
+    ap.add_argument("--csv", default=os.environ.get("CSV"),
+                    help="reuse an existing CSV instead of synthesizing")
+    args = ap.parse_args(argv)
+
     from h2o3_tpu import telemetry
     from h2o3_tpu.ingest.parse import LAST_PROFILE, parse, parse_setup
 
@@ -76,10 +97,13 @@ def main():
     if not telemetry.enabled():
         log("H2O3_TELEMETRY=0: stage attribution unavailable — stage "
             "fields will be null (re-run with telemetry enabled)")
-    path = os.environ.get("CSV") or os.path.join(
-        tempfile.gettempdir(), f"h2o3_profile_ingest_{ROWS}.csv")
+    path = args.csv or os.path.join(
+        tempfile.gettempdir(),
+        f"h2o3_profile_ingest_{args.rows}x{args.cols}"
+        f"_{args.enum_cols}_{args.time_cols}.csv")
     if not os.path.exists(path):
-        _synth_csv(path)
+        _synth_csv(path, args.rows, args.cols, args.enum_cols,
+                   args.time_cols)
     setup = parse_setup(path)
 
     # counters are cumulative — diff against the pre-run snapshot
@@ -110,16 +134,27 @@ def main():
             return None
         return round(tot.get("seconds", 0.0) - pre.get("seconds", 0.0), 4)
 
+    nbytes = os.path.getsize(path)
     out = {"rows": fr.nrow, "ncol": fr.ncol,
-           "bytes": os.path.getsize(path),
+           "bytes": nbytes,
            "native": LAST_PROFILE.get("native"),
            "chunks": LAST_PROFILE.get("chunks"),
            "streamed": LAST_PROFILE.get("streamed"),
+           # range-scoped fallback visibility (ISSUE 14): a healthy run
+           # parses every range natively
+           "fallback_ranges": LAST_PROFILE.get("fallback_ranges"),
+           "fallback_reasons": LAST_PROFILE.get("fallback_reasons"),
            # stage split read from the pipeline's OWN telemetry spans —
            # identical to what GET /metrics exports for the same run
+           "scan_s": stage("ingest.scan"),
            "tokenize_encode_s": stage("ingest.tokenize_encode"),
            "domain_union_s": stage("ingest.domain_union"),
            "device_put_s": stage("ingest.device_put"),
+           # worker-pool CPU-second split of tokenize_encode (summed
+           # across threads, so they exceed the wall split above under
+           # fan-out — they answer "which half is the CPU spent in")
+           "tokenize_cpu_s": LAST_PROFILE.get("tokenize_cpu_s"),
+           "encode_cpu_s": LAST_PROFILE.get("encode_cpu_s"),
            # per-chunk streamed transfer: share of device_put wall time
            # hidden under tokenize (same number the pipeline exports as
            # the h2o3_ingest_h2d_overlap_ratio gauge)
@@ -128,7 +163,32 @@ def main():
                telemetry.registry().value("h2o3_h2d_bytes_total") - h2d0),
            "parse_wall_s": round(wall, 4),
            "parse_rows_per_s": round(fr.nrow / wall, 1),
+           "parse_mb_per_s": round(nbytes / 1e6 / wall, 1),
            "xprof_trace_dir": last_trace_dir()}
+
+    # the "where does the next 2x live" table: per-stage seconds and
+    # effective MB/s over the file's bytes (wall stages are additive;
+    # the cpu-second rows attribute the tokenize_encode wall)
+    log(f"\n  stage               seconds   MB/s (of {nbytes / 1e6:.0f} MB)")
+    for label, key, kind in (
+            ("scan (ranges)", "scan_s", "wall"),
+            ("tokenize_encode", "tokenize_encode_s", "wall"),
+            ("  tokenize (cpu)", "tokenize_cpu_s", "cpu"),
+            ("  encode   (cpu)", "encode_cpu_s", "cpu"),
+            ("domain_union", "domain_union_s", "wall"),
+            ("device_put", "device_put_s", "wall")):
+        v = out.get(key)
+        if v is None:
+            log(f"  {label:<19} {'-':>7}")
+            continue
+        rate = nbytes / 1e6 / v if v > 0 else float("inf")
+        log(f"  {label:<19} {v:>7.3f}   {rate:,.0f}")
+    log(f"  {'TOTAL parse wall':<19} {wall:>7.3f}   "
+        f"{out['parse_mb_per_s']:,.1f}")
+    if out.get("fallback_ranges"):
+        log(f"  fallback ranges: {out['fallback_ranges']} "
+            f"({out['fallback_reasons']})")
+
     print(json.dumps(out))
     return out
 
