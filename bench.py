@@ -481,6 +481,25 @@ def main():
                 f"rebalance_ok={fl.get('rebalance_ok')}")
         except Exception as e:  # must never sink the headline run
             log(f"fleet round FAILED to run: {e!r}")
+    # training-scheduler round (ISSUE 15): budget sized for ONE train,
+    # 4 concurrent bulk submissions + 1 interactive preemptor — emits
+    # sched.{queue_wait_p50_ms,preempt_resume_ok,oversub_completed}
+    # (ratcheted by tools/perf_gate.py). H2O3_BENCH_SCHED=0 skips.
+    if os.environ.get("H2O3_BENCH_SCHED", "1") not in ("0", "false", ""):
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            from chaos_sweep import run_oversubscribe_round
+            sc = run_oversubscribe_round(log=log)
+            out["sched"] = sc
+            log(f"sched: {sc.get('oversub_completed')}/"
+                f"{sc.get('submissions')} completed "
+                f"(degraded={sc.get('degraded')}, "
+                f"preempted={sc.get('preempted')}, "
+                f"resume_ok={sc.get('preempt_resume_ok')}) "
+                f"queue_wait_p50={sc.get('queue_wait_p50_ms')}ms")
+        except Exception as e:  # must never sink the headline run
+            log(f"sched round FAILED to run: {e!r}")
     # multichip scaling round (ISSUE 7): rows/s/chip at n_devices ∈
     # {1,4,8} with a scaling-efficiency verdict (tools/multichip_bench.py
     # runs in its OWN process so a single-chip parent can still force

@@ -959,6 +959,69 @@ class FleetPeerDisciplineRule(Rule):
 
 
 # ======================================================================
+# sched-discipline
+# ======================================================================
+
+# the training-dispatch layer: work here enters the device through the
+# scheduler (ModelBuilder.train -> sched.submit) or runs inline under
+# an already-admitted parent
+_SCHED_SCOPE_PREFIXES = ("h2o3_tpu/models/",)
+_SCHED_SCOPE_FILES = ("h2o3_tpu/automl.py",)
+
+
+class SchedDisciplineRule(Rule):
+    """Raw ``threading.Thread`` spawns inside the training-dispatch
+    layer (``h2o3_tpu/models/``, ``automl.py``).
+
+    Since ISSUE 15, every train enters the device through the cluster
+    scheduler: ``ModelBuilder.train`` enqueues (priority class +
+    device-memory admission + checkpoint preemption), and nested builds
+    run inline under the admitted parent's grant. A bare daemon thread
+    in this layer escapes all three — no admission (it can OOM a peer
+    the scheduler promised memory to), no Job supervision, no
+    preemption point. Route new fan-out through ``sched.submit_context``
+    + ``train(background=True)``, or an inline ThreadPoolExecutor when
+    the work rides an admitted parent (the CV-fold pattern —
+    executors ARE allowed; they stay inside the parent's run).
+
+    Scope decision: jobs.py (the run machinery), sched/ (the
+    dispatcher) and the non-training layers (serve/fleet/ingest) spawn
+    threads legitimately and are outside this rule's scope.
+    """
+
+    name = "sched-discipline"
+    severity = SEV_ERROR
+
+    def check_module(self, mod: ModuleInfo) -> List[Finding]:
+        rel = mod.relpath
+        if not (rel.startswith(_SCHED_SCOPE_PREFIXES)
+                or rel in _SCHED_SCOPE_FILES):
+            return []
+        # bare `Thread(...)` only counts when imported from threading
+        bare_thread = any(
+            isinstance(n, ast.ImportFrom) and n.module == "threading"
+            and any(a.name == "Thread" for a in n.names)
+            for n in ast.walk(mod.tree))
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name == "threading.Thread" or (bare_thread
+                                              and name == "Thread"):
+                out.append(self.finding(
+                    mod, node,
+                    "raw threading.Thread in the training-dispatch "
+                    "layer bypasses the scheduler — no admission, no "
+                    "Job supervision, no preemption point; submit via "
+                    "ModelBuilder.train(background=True) under a "
+                    "sched.submit_context, or use an inline "
+                    "ThreadPoolExecutor when the work rides an "
+                    "admitted parent build"))
+        return out
+
+
+# ======================================================================
 # registry
 # ======================================================================
 
@@ -973,6 +1036,7 @@ def all_rules(hot_zones: Optional[Dict[str, Tuple[str, ...]]] = None
         MonotonicDurationsRule(),
         PallasGridSpecRule(),
         FleetPeerDisciplineRule(),
+        SchedDisciplineRule(),
     ]
 
 

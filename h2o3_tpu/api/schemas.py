@@ -80,6 +80,7 @@ def job_v3(job, dest_key: Optional[str] = None, dest_type: str = "Key<Model>") -
     from h2o3_tpu import jobs as jobs_mod
     status_map = {jobs_mod.RUNNING: "RUNNING",
                   jobs_mod.RECOVERING: "RECOVERING",
+                  jobs_mod.QUEUED: "QUEUED",
                   jobs_mod.DONE: "DONE",
                   jobs_mod.FAILED: "FAILED", jobs_mod.CANCELLED: "CANCELLED"}
     msec = job.duration_ms()
@@ -91,6 +92,7 @@ def job_v3(job, dest_key: Optional[str] = None, dest_type: str = "Key<Model>") -
         "status": status_map.get(job.status, str(job.status)),
         "progress": float(job.progress),
         "progress_msg": ("Recovering" if job.status == jobs_mod.RECOVERING
+                         else "Queued" if job.status == jobs_mod.QUEUED
                          else "Running" if job.status == jobs_mod.RUNNING
                          else "Done"),
         "start_time": int(job.start_time * 1000),
@@ -110,6 +112,11 @@ def job_v3(job, dest_key: Optional[str] = None, dest_type: str = "Key<Model>") -
         # the propagated trace id (ISSUE 8): links this job's spans in
         # /3/Timeline back to the request that started it
         "trace_id": getattr(job, "trace_id", None),
+        # scheduler visibility (ISSUE 15): total seconds spent waiting
+        # in the run queue (across preempt/resume cycles) + how many
+        # times the job was checkpoint-preempted and requeued
+        "queue_wait_s": getattr(job, "queue_wait_s", None),
+        "preempt_count": getattr(job, "preempt_count", 0),
         "ready_for_view": job.status == jobs_mod.DONE,
         "auto_recoverable": False,
     }

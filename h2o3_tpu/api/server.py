@@ -480,31 +480,42 @@ def _train(params, body, algo):
         parms["ignored_columns"] = ignored
     est = builders[algo](**parms)
 
-    job = Job(f"{algo} Model Build")
-    job.dest_key = model_id
     # cooperative locking (water/Lockable.java:25): inputs read-locked,
     # output model write-locked for the build's duration — a concurrent
     # DELETE of the training frame now fails instead of racing the job.
-    # Partial acquisition must release what it took (the job never runs,
-    # so body_fn's unlock_all would never fire).
+    # The owner is a synthetic key (the training job doesn't exist yet);
+    # partial acquisition must release what it took.
+    lock_owner = f"$train_{model_id}"
     try:
         if train_key:
-            dkv.read_lock(str(train_key), job.key)
+            dkv.read_lock(str(train_key), lock_owner)
         if vk:
             dkv.read_lock(str(vk if not isinstance(vk, dict)
-                              else vk["name"]), job.key)
-        dkv.write_lock(model_id, job.key)
+                              else vk["name"]), lock_owner)
+        dkv.write_lock(model_id, lock_owner)
     except dkv.KeyLockedError:
-        dkv.unlock_all(job.key)
-        job.cancel()
+        dkv.unlock_all(lock_owner)
         raise
+    # the client polls the TRAINING job itself (no wrapper Job): the
+    # scheduler's QUEUED state, queue_wait_s and preempt_count surface
+    # on the key this response returns (ISSUE 15 — a wrapper job showed
+    # RUNNING with msec growing through the whole queue wait). Builders
+    # that override train() and swallow background= complete
+    # synchronously; est.job exists either way.
+    try:
+        est.train(y=y, training_frame=frame, validation_frame=valid,
+                  background=True)
+    except BaseException:
+        dkv.unlock_all(lock_owner)
+        raise
+    job = est.job
+    job.dest_key = model_id
 
-    def body_fn(j):
+    def _register():
         try:
-            est.train(y=y, training_frame=frame, validation_frame=valid)
-            if est.job.status == "FAILED":
-                raise RuntimeError(est.job.exception)
-            model = est.model
+            model = job.join()    # raises RuntimeError on FAILED
+            if model is None:
+                return            # cancelled before any result
             model.key = model_id
             # frame-first metric lookups + FeatureInteraction default
             # frame resolve through this backref
@@ -517,11 +528,13 @@ def _train(params, body, algo):
                 fm.key = f"{model_id}_cv_{i + 1}"
                 dkv.put(fm.key, "model", fm)
             dkv.put(model_id, "model", model)
-            return model
+        except RuntimeError:
+            pass   # FAILED: the job carries the structured failure info
         finally:
-            dkv.unlock_all(j.key)
+            dkv.unlock_all(lock_owner)
 
-    job.run(body_fn, background=True)
+    threading.Thread(target=_register, daemon=True,
+                     name=f"train-register-{model_id}").start()
     return {
         "__meta": {"schema_version": 3,
                    "schema_name": "%sV3" % algo.upper()},
@@ -863,6 +876,78 @@ def _faults_clear(params, body):
     faults.configure(None)
     return {"__meta": {"schema_version": 3, "schema_name": "FaultsV3"},
             "spec": None, "rules": [], "fired_total": 0}
+
+
+# ---------------- training scheduler (h2o3_tpu.sched, ISSUE 15) ---------
+
+
+@route("GET", "/3/Scheduler")
+def _scheduler_get(params, body):
+    """Training-scheduler state: queue contents per priority class with
+    wait reasons, running entries with their admission estimates, the
+    reserved-bytes ledger vs the memman budget, and the sched counters."""
+    from h2o3_tpu import sched
+    snap = sched.scheduler().snapshot()
+    snap["__meta"] = {"schema_version": 3, "schema_name": "SchedulerV3"}
+    snap["enabled"] = sched.enabled()
+    return snap
+
+
+@route("POST", "/3/Scheduler")
+def _scheduler_control(params, body):
+    """Control: ``pause=true|false`` stops/starts dispatch (running
+    entries finish; the queue holds), ``job=<key>&priority=<class>``
+    moves a QUEUED entry to another priority class."""
+    from h2o3_tpu import sched
+    s = sched.scheduler()
+    # validate EVERYTHING before applying ANYTHING: a request that is
+    # half-bad must not half-execute (e.g. pause applied, then the
+    # reprioritize half 400s — the client sees an error yet dispatch
+    # is now paused)
+    pause = params.get("pause")
+    pause_action = None
+    if pause is not None:
+        val = str(pause).lower()
+        if val in ("1", "true", "yes"):
+            pause_action = True
+        elif val in ("0", "false", "no"):
+            pause_action = False
+        else:
+            # a typo'd value must not silently RESUME a paused queue
+            raise ApiError(400, f"pause={pause!r} is not a boolean "
+                                f"(true/false)")
+    job_key = params.get("job")
+    priority = params.get("priority")
+    if (job_key or priority) and not (job_key and priority):
+        raise ApiError(400, "reprioritizing needs BOTH job=<key> and "
+                            "priority=<class>")
+    if priority:
+        priority = str(priority).lower()
+        if priority not in sched.PRIORITY_LEVELS:
+            raise ApiError(400, f"unknown priority '{priority}' (one of "
+                                f"{sorted(sched.PRIORITY_LEVELS)})")
+    if pause_action is None and not job_key:
+        raise ApiError(400, "POST /3/Scheduler needs pause=true|false "
+                            "and/or job=<key>&priority=<class>")
+    actions = []
+    # apply the fallible half FIRST: reprioritize can 404 (the job may
+    # have dispatched since the client looked), and a combined request
+    # that errors must not have half-executed by flipping pause state
+    if job_key:
+        if not s.reprioritize(str(job_key), priority):
+            raise ApiError(404, f"no QUEUED scheduler entry for job "
+                                f"'{job_key}'")
+        actions.append(f"reprioritized {job_key} -> {priority}")
+    if pause_action is True:
+        s.pause()
+        actions.append("paused")
+    elif pause_action is False:
+        s.resume()
+        actions.append("resumed")
+    snap = s.snapshot()
+    snap["__meta"] = {"schema_version": 3, "schema_name": "SchedulerV3"}
+    snap["actions"] = actions
+    return snap
 
 
 # ---------------- restart recovery (h2o3_tpu.recovery) ------------------

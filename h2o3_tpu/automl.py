@@ -375,6 +375,18 @@ class H2OAutoML:
 
     def train(self, x=None, y=None, training_frame=None,
               validation_frame=None, leaderboard_frame=None):
+        """Drive the plan with every child train tagged BULK priority
+        under this project's fair-share group (ISSUE 15): AutoML
+        children queue behind interactive trains and one project cannot
+        starve another tenant's children in the bulk class."""
+        from h2o3_tpu import sched
+        with sched.submit_context(priority="bulk",
+                                  share=self.project_name):
+            return self._train_driver(x, y, training_frame,
+                                      validation_frame, leaderboard_frame)
+
+    def _train_driver(self, x, y, training_frame, validation_frame,
+                      leaderboard_frame):
         builders = self._builders()
         rvec = training_frame.vec(y)
         nclasses = rvec.cardinality if rvec.type == "enum" else 1
@@ -599,12 +611,19 @@ class H2OAutoML:
             return est.model
         est.train(x=x, y=y, training_frame=training_frame,
                   validation_frame=validation_frame, background=True)
-        t0 = time.monotonic()
-        while est.job.status == "RUNNING":
-            if time.monotonic() - t0 > cap:
-                est.job.cancel()
+        from h2o3_tpu import jobs as jobs_mod
+        job = est.job
+        while job.status in (jobs_mod.QUEUED, jobs_mod.RUNNING,
+                             jobs_mod.RECOVERING):
+            # the per-model budget counts RUN time, not scheduler queue
+            # wait (duration_ms restarts at dispatch) — a queued step
+            # must not burn its budget waiting behind an interactive
+            # train
+            if (job.status != jobs_mod.QUEUED
+                    and job.duration_ms() / 1000.0 > cap):
+                job.cancel()
             time.sleep(0.2)
-        return est.job.join()  # raises on FAILED
+        return job.join()  # raises on FAILED
 
     def _register(self, model, step_id: str):
         model.key = f"{self.project_name}_{step_id}"

@@ -421,13 +421,23 @@ def parse_xls(path: str, mesh=None, key: Optional[str] = None) -> Frame:
             v = c.find("m:v", ns)
             raw = v.text if v is not None else None
             if c.get("t") == "s" and raw is not None:
-                raw = shared[int(raw)]
+                try:
+                    raw = shared[int(raw)]
+                except (ValueError, IndexError) as e:
+                    # a shared-string index that isn't an int or points
+                    # past the table is a corrupt archive, not a value
+                    raise ValueError(
+                        f"{path}: malformed xlsx (shared-string index "
+                        f"{raw!r} in cell {ref or seq}: {e})") from e
             elif c.get("t") == "inlineStr":
                 raw = "".join(t.text or "" for t in c.iter(
                     "{%s}t" % ns["m"]))
             cells[ci - 1] = raw
         rows.append(cells)
-    if not rows:
+    if not rows or all(not r for r in rows):
+        # all-empty row dicts used to fall through to a bare
+        # `max() arg is an empty sequence` — a sheet of empty <row>
+        # elements is as empty as no rows at all
         raise ValueError(f"{path}: empty sheet")
     ncol = max(max(r) for r in rows if r) + 1
     header = [str(rows[0].get(i, f"C{i + 1}")) for i in range(ncol)]

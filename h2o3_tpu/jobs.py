@@ -36,6 +36,11 @@ RUNNING = "RUNNING"
 # RUNNING — supervised by the watchdog, pollable on /3/Jobs — but
 # distinguishable so clients can tell a recovered train from a fresh one
 RECOVERING = "RECOVERING"
+# waiting in the training scheduler's run queue (h2o3_tpu.sched): not
+# yet dispatched, so the watchdog does not supervise it (max_runtime
+# and stall detection count RUN time, not queue wait) and the registry
+# never evicts it
+QUEUED = "QUEUED"
 DONE = "DONE"
 FAILED = "FAILED"
 CANCELLED = "CANCELLED"
@@ -50,6 +55,14 @@ _LOCK = threading.Lock()
 class JobCancelled(Exception):
     """Raised inside cooperative cancellation points (streamed level
     passes) to unwind a cancelled job's work loop cleanly."""
+
+
+class JobPreempted(JobCancelled):
+    """Raised by a checkpointable train loop after it committed a
+    resumable in-training checkpoint in response to ``Job.preempt()``
+    (h2o3_tpu.sched checkpoint-based preemption). The scheduler catches
+    the unwind and REQUEUES the entry — the job is not terminal and its
+    waiters are not released."""
 
 
 def _jobs_keep() -> int:
@@ -121,10 +134,13 @@ def _watch_loop() -> None:
         now = time.monotonic()
         n_stalled = 0
         for j in list_jobs():
-            if j.status not in _ACTIVE:
+            # undispatched = waiting in the scheduler queue (a recovery
+            # resume keeps its RECOVERING badge there): no worker is
+            # running it, so neither budget applies yet
+            if j.status not in _ACTIVE or not j._dispatched:
                 continue
             if (j.max_runtime_secs and not j.cancel_requested
-                    and now - j.start_mono > j.max_runtime_secs):
+                    and j.run_seconds() > j.max_runtime_secs):
                 warn("job %s exceeded max_runtime_secs=%.1f — cancelling",
                      j.key, j.max_runtime_secs)
                 timeout_ctr.inc()
@@ -171,11 +187,32 @@ class Job:
         # stage, so clients don't have to parse the traceback string
         self.exception_type: Optional[str] = None
         self.exception_msg: Optional[str] = None
+        self.exception_obj: Optional[BaseException] = None
         self.failed_stage: Optional[str] = None
         self.result: Any = None
         self._cancel_requested = False
         self.cancel_reason: Optional[str] = None
+        # checkpoint-based preemption (h2o3_tpu.sched): a SEPARATE flag
+        # from cancellation — cancel is user intent (terminal), preempt
+        # is a scheduler request to yield at the next checkpointable
+        # commit and be requeued. Loops poll both.
+        self._preempt_requested = False
+        self.preempt_reason: Optional[str] = None
+        self.preempt_count = 0           # completed preempt/resume cycles
+        self.queue_wait_s: Optional[float] = None
+        # run time accumulated over COMPLETED run segments (preempt/
+        # resume cycles); the current segment is measured off start_mono
+        self._run_accum_s = 0.0
+        # False only while waiting in the scheduler queue: the watchdog
+        # must not supervise (stall/max_runtime) a job that has no
+        # worker yet — queue wait is not run time
+        self._dispatched = True
         self._thread: Optional[threading.Thread] = None
+        # terminal-state latch: join() on a scheduler-run job (no owned
+        # thread) waits on this instead of a Thread handle; preemption
+        # requeues WITHOUT setting it, so waiters sleep through the
+        # whole preempt/resume cycle
+        self._done_evt = threading.Event()
         # trace propagation (ISSUE 8): capture the creating thread's
         # bound trace id (the REST handler set it from the traceparent
         # header) — or mint one — so a background build's spans and the
@@ -226,6 +263,21 @@ class Job:
         self.exception = traceback.format_exc()
         self.exception_type = type(exc).__name__
         self.exception_msg = str(exc)
+        # the live exception object: foreground train() re-raises
+        # parameter-validation errors TYPED (ValueError stays ValueError
+        # through the scheduler hop) instead of join()'s RuntimeError.
+        # Tracebacks are DROPPED — on the exception AND its
+        # __cause__/__context__ chain: each frame pins the failed
+        # build's locals (dataset-sized device arrays) in the job
+        # registry for as long as the job lives, and the full trace
+        # text is already captured in self.exception above
+        seen = set()
+        link = exc
+        while link is not None and id(link) not in seen:
+            seen.add(id(link))
+            link.__traceback__ = None
+            link = link.__cause__ or link.__context__
+        self.exception_obj = exc
         # failed stage = the INNERMOST span this exception unwound
         # through on the worker thread (spans note it in __exit__;
         # phase contexts have already popped by catch time, so
@@ -242,21 +294,29 @@ class Job:
 
     def run(self, fn: Callable[["Job"], Any], background: bool = False) -> "Job":
         def body():
-            # re-bind the creator's trace id on the worker thread so
-            # every span the build records carries it
-            from h2o3_tpu.telemetry import trace as _trace
             try:
-                with _trace.trace_context(self.trace_id):
-                    self.result = fn(self)
-                self.status = DONE if not self._cancel_requested else CANCELLED
-            except JobCancelled:
+                terminal = self.execute_scheduled(fn)
+            except BaseException:
+                # KeyboardInterrupt/SystemExit on the job thread: still
+                # turn terminal and stamp the end clocks (the old
+                # finally's guarantee) — a non-terminal job is never
+                # evicted and its msec grows forever
+                if self.status not in _TERMINAL:
+                    self.status = FAILED
+                    self.exception_msg = "job body unwound on a " \
+                                         "BaseException"
+                    self.end_time = time.time()
+                    self._end_mono = time.monotonic()
+                    self._done_evt.set()
+                raise
+            if not terminal:
+                # a JobPreempted unwind with no scheduler to requeue it
+                # (inline/H2O3_SCHED=0 run): finalize as CANCELLED, the
+                # pre-scheduler meaning of that exception family
                 self.status = CANCELLED
-            except Exception as e:
-                self.status = FAILED
-                self._record_failure(e)
-            finally:
                 self.end_time = time.time()
                 self._end_mono = time.monotonic()
+                self._done_evt.set()
         if background:
             self._thread = threading.Thread(target=body, daemon=True)
             self._thread.start()
@@ -264,9 +324,99 @@ class Job:
             body()
         return self
 
+    # -- scheduler lifecycle (h2o3_tpu.sched) ---------------------------
+
+    def mark_queued(self) -> "Job":
+        """Enter the training scheduler's run queue: not yet dispatched,
+        so the supervision clocks don't tick (the watchdog skips
+        undispatched jobs even when recovery re-badges them
+        RECOVERING)."""
+        self.status = QUEUED
+        self._dispatched = False
+        return self
+
+    def mark_dispatched(self) -> None:
+        """Leave the queue for a worker: restart the supervision clocks
+        so max_runtime/stall budgets count RUN time, and record how long
+        the entry waited (the queue-wait histogram's sample)."""
+        now = time.monotonic()
+        wait = now - self.start_mono
+        self.queue_wait_s = (self.queue_wait_s or 0.0) + max(wait, 0.0)
+        self.start_mono = now
+        # the heartbeat clock is part of the _mutex-guarded progress
+        # protocol (update/set_progress write it under the lock; the
+        # watchdog's stall check races it) — restart it under the lock.
+        # status/start_mono stay bare like every other writer in this
+        # module (single-writer per lifecycle phase).
+        with self._mutex:
+            self.last_progress_mono = now
+        self._dispatched = True
+        if self.status != RECOVERING:   # recovery resumes keep badge
+            self.status = RUNNING
+
+    def execute_scheduled(self, fn: Callable[["Job"], Any]) -> bool:
+        """THE job lifecycle protocol: run ``fn(self)`` on the calling
+        thread, map its outcome to a terminal status, stamp the end
+        clocks and release join()ers. ``run()`` delegates here (one
+        implementation, not two). The single scheduler-specific arm: a
+        ``JobPreempted`` unwind leaves the job NON-terminal and returns
+        False — the scheduler requeues the entry and this job's waiters
+        keep sleeping through the resume cycle. Returns True when the
+        job reached a terminal state."""
+        from h2o3_tpu.telemetry import trace as _trace
+        try:
+            with _trace.trace_context(self.trace_id):
+                self.result = fn(self)
+            # a preempt that raced the finish line: the train COMPLETED,
+            # so the request is moot — never requeue a finished model
+            self._preempt_requested = False
+            self.status = DONE if not self._cancel_requested else CANCELLED
+        except JobPreempted:
+            if not self._cancel_requested:
+                return False
+            self.status = CANCELLED      # user cancel wins over preempt
+        except JobCancelled:
+            self.status = CANCELLED
+        except Exception as e:
+            self.status = FAILED
+            self._record_failure(e)
+        self.end_time = time.time()
+        self._end_mono = time.monotonic()
+        self._done_evt.set()
+        return True
+
+    def mark_requeued(self) -> None:
+        """Back into the queue after a preemption unwind: bank the
+        finished run segment (max_runtime_secs and /3/Jobs msec are
+        CUMULATIVE across preempt/resume cycles — a resume must not get
+        a fresh budget), clear the preempt request, and restart the
+        clock as a queue-wait anchor."""
+        now = time.monotonic()
+        self._run_accum_s += max(now - self.start_mono, 0.0)
+        self._preempt_requested = False
+        self.preempt_count += 1
+        self.start_mono = now
+        self._dispatched = False
+        self.status = QUEUED
+
+    def run_seconds(self) -> float:
+        """Cumulative RUN time across preempt/resume cycles — the
+        quantity max_runtime_secs budgets. Queue wait never counts:
+        while undispatched only the banked segments are reported."""
+        if not self._dispatched:
+            return self._run_accum_s
+        end = self._end_mono if self._end_mono is not None \
+            else time.monotonic()
+        return self._run_accum_s + max(end - self.start_mono, 0.0)
+
     def join(self, timeout: Optional[float] = None):
         if self._thread:
             self._thread.join(timeout)
+        elif self.status not in _TERMINAL:
+            # scheduler-run job: no owned thread — wait on the terminal
+            # latch (survives preempt/resume cycles, which requeue
+            # without setting it)
+            self._done_evt.wait(timeout)
         if self.status == FAILED:
             raise RuntimeError(f"Job {self.key} failed:\n{self.exception}")
         return self.result
@@ -276,17 +426,26 @@ class Job:
         if reason and not self.cancel_reason:
             self.cancel_reason = reason
 
+    def preempt(self, reason: Optional[str] = None):
+        """Scheduler request: yield at the next checkpoint commit and
+        get requeued. Distinct from cancel() — the job is NOT over."""
+        self.preempt_reason = reason
+        self._preempt_requested = True
+
     @property
     def cancel_requested(self) -> bool:
         return self._cancel_requested
 
+    @property
+    def preempt_requested(self) -> bool:
+        return self._preempt_requested
+
     def duration_ms(self) -> int:
         """Elapsed run time in ms from the monotonic clock — the
         /3/Jobs ``msec`` field used to subtract wall-clock epochs and
-        mis-reported across NTP slew."""
-        end = self._end_mono if self._end_mono is not None \
-            else time.monotonic()
-        return int((end - self.start_mono) * 1000)
+        mis-reported across NTP slew. Cumulative across preempt/resume
+        cycles; frozen at the banked total while requeued."""
+        return int(self.run_seconds() * 1000)
 
 
 def get_job(key: str) -> Optional[Job]:

@@ -118,6 +118,19 @@ class MemoryManager:
         algorithms switch to host-chunked streaming when it is not."""
         return nbytes <= self.budget * HIGH_WATERMARK
 
+    @property
+    def unlimited(self) -> bool:
+        """True on backends that report no real device limit (CPU) —
+        the training scheduler's admission gate is a no-op there."""
+        return self.budget >= (1 << 61)
+
+    def admission_budget(self) -> int:
+        """Bytes the training scheduler (h2o3_tpu.sched) may promise to
+        concurrently RUNNING trains: the same high-watermark ceiling the
+        allocation gate evicts toward, so admitted work and LRU spill
+        agree on what 'full' means."""
+        return int(self.budget * HIGH_WATERMARK)
+
     # -- reporting (/3/Cloud free_mem) ---------------------------------
 
     def stats(self) -> Dict[str, Any]:

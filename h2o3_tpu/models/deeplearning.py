@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from h2o3_tpu import telemetry
 from h2o3_tpu.jobs import Job
 from h2o3_tpu.models.glm import expand_design
 from h2o3_tpu.models.model_base import (Model, ModelBuilder, ScoreKeeper,
@@ -264,7 +265,8 @@ class DeepLearningModel(Model):
         out = self._predict_matrix(X)
         recon = out * jnp.asarray(self.xs)[None, :] + \
             jnp.asarray(self.xm)[None, :]
-        R = np.asarray(jax.device_get(recon))[: frame.nrow]
+        R = np.asarray(telemetry.device_get(
+            recon, pipeline="score"))[: frame.nrow]
         names = [f"reconstr_{n}" for n in self.exp_names]
         return Frame(names, [Vec.from_numpy(R[:, i].astype(np.float32))
                              for i in range(R.shape[1])])
@@ -283,12 +285,14 @@ class DeepLearningModel(Model):
         out = _forward(self.net, Xs, _ACTS[self.activation])
         err = (out - Xs) ** 2
         if per_feature:
-            E = np.asarray(jax.device_get(err))[: frame.nrow]
+            E = np.asarray(telemetry.device_get(
+                err, pipeline="score"))[: frame.nrow]
             names = [f"reconstr_{n}.SE" for n in self.exp_names]
             return Frame(names,
                          [Vec.from_numpy(E[:, i].astype(np.float32))
                           for i in range(E.shape[1])])
-        mse = np.asarray(jax.device_get(err.mean(axis=1)))[: frame.nrow]
+        mse = np.asarray(telemetry.device_get(
+            err.mean(axis=1), pipeline="score"))[: frame.nrow]
         return Frame(["Reconstruction.MSE"],
                      [Vec.from_numpy(mse.astype(np.float32))])
 
@@ -298,8 +302,10 @@ class DeepLearningModel(Model):
         d = {"xm": self.xm, "xs": self.xs,
              **pack_impute_means(self.impute_means)}
         for i, layer in enumerate(self.net):
-            d[f"W{i}"] = np.asarray(jax.device_get(layer["W"]))
-            d[f"b{i}"] = np.asarray(jax.device_get(layer["b"]))
+            d[f"W{i}"] = np.asarray(
+                telemetry.device_get(layer["W"], pipeline="score"))
+            d[f"b{i}"] = np.asarray(
+                telemetry.device_get(layer["b"], pipeline="score"))
         return d
 
     def _save_extra_meta(self):
@@ -390,8 +396,8 @@ class H2ODeepLearningEstimator(ModelBuilder):
 
         def _mat(v):
             if hasattr(v, "as_matrix"):     # Frame
-                return np.asarray(jax.device_get(
-                    v.as_matrix(v.names)))[:v.nrow]
+                return np.asarray(telemetry.device_get(
+                    v.as_matrix(v.names), pipeline="train"))[:v.nrow]
             return np.asarray(v, np.float32)
 
         for kind, idx in (("initial_weights", "W"),
@@ -411,13 +417,25 @@ class H2ODeepLearningEstimator(ModelBuilder):
                         else (sizes[li + 1],))
                 if idx == "b" and a.ndim == 2 and 1 in a.shape:
                     a = a.reshape(-1)    # single-column bias frame
+                if (idx == "W" and a.ndim == 2 and a.shape != want
+                        and a.shape == (sizes[li + 1], sizes[li])):
+                    # the reference supplies weight matrices in [out, in]
+                    # orientation (hex/deeplearning Neurons rows=units of
+                    # THIS layer, cols=previous layer); the native layout
+                    # here is [in, out] — accept the reference
+                    # orientation by transposing. Square layers are
+                    # shape-ambiguous and taken as [in, out] as-is.
+                    a = a.T
                 if a.shape != want:
-                    # exact match required: a transposed weight matrix
-                    # has the right SIZE but reshaping it would scramble
-                    # the connections — reject like the reference
+                    # exact match required beyond the two orientations: a
+                    # reshaped matrix would scramble the connections
+                    hint = ((f" ([in, out] native orientation; the "
+                             f"reference's [out, in] = "
+                             f"{(sizes[li + 1], sizes[li])} is accepted "
+                             f"and transposed)") if idx == "W" else "")
                     raise ValueError(
                         f"{kind}[{li}] has shape {a.shape}, layer "
-                        f"expects {want}")
+                        f"expects {want}{hint}")
                 net[li] = dict(net[li])
                 net[li][idx] = jnp.asarray(a)
         return net
@@ -590,13 +608,17 @@ class H2ODeepLearningEstimator(ModelBuilder):
 
         model = DeepLearningModel(
             f"dl_{id(self) & 0xffffff:x}", self.params, spec, net, exp_names,
-            {k: float(jax.device_get(v)) for k, v in means.items()},
-            jax.device_get(xm), jax.device_get(xs), task, dist_name, hidden,
+            {k: float(telemetry.device_get(v, pipeline="train"))
+             for k, v in means.items()},
+            telemetry.device_get(xm, pipeline="train"),
+            telemetry.device_get(xs, pipeline="train"), task, dist_name,
+            hidden,
             act_name)
         model.scoring_history = history
         model.output["training_loop_seconds"] = t_loop
         model.output["epochs_trained"] = prior_epochs + e + 1
-        model.output["training_samples"] = float(jax.device_get(samples))
+        model.output["training_samples"] = float(
+            telemetry.device_get(samples, pipeline="train"))
         if task == "autoencoder":
             # reconstruction error metrics (hex/ModelMetricsAutoEncoder:
             # MSE over all reconstructed cells)
@@ -604,9 +626,10 @@ class H2ODeepLearningEstimator(ModelBuilder):
 
             def recon_metrics(Xs_in, w_in):
                 out_ = _forward(net, Xs_in, act)
-                per_row = np.asarray(jax.device_get(
-                    ((out_ - Xs_in) ** 2).mean(axis=1)))
-                wh = np.asarray(jax.device_get(w_in))
+                per_row, wh = (np.asarray(v) for v in
+                               telemetry.device_get(
+                                   (((out_ - Xs_in) ** 2).mean(axis=1),
+                                    w_in), pipeline="train"))
                 live = wh > 0
                 mse = float((per_row[live] * wh[live]).sum()
                             / max(wh[live].sum(), 1e-30))
@@ -644,17 +667,20 @@ class H2ODeepLearningEstimator(ModelBuilder):
                xs, means, exp_names, spec, epoch):
         out = _forward(net, Xs, act)
         if task == "autoencoder":
-            mse = float(jax.device_get(
-                (w * ((out - y) ** 2).mean(axis=1)).sum() / w.sum()))
+            mse = float(telemetry.device_get(
+                (w * ((out - y) ** 2).mean(axis=1)).sum() / w.sum(),
+                pipeline="train"))
             return {"epoch": epoch, "mse": mse,
                     "rmse": float(np.sqrt(mse)), "deviance": mse}
         if task == "classification":
             logp = jax.nn.log_softmax(out, axis=1)
             ll = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
-            tl = float(jax.device_get((w * ll).sum() / w.sum()))
+            tl = float(telemetry.device_get(
+                (w * ll).sum() / w.sum(), pipeline="train"))
             return {"epoch": epoch, "logloss": tl, "deviance": tl}
-        mse = float(jax.device_get(
-            (w * (out[:, 0] - y) ** 2).sum() / w.sum()))
+        mse = float(telemetry.device_get(
+            (w * (out[:, 0] - y) ** 2).sum() / w.sum(),
+            pipeline="train"))
         return {"epoch": epoch, "mse": mse, "rmse": float(np.sqrt(mse)),
                 "deviance": mse}
 

@@ -551,8 +551,22 @@ class H2ORandomForestEstimator(ModelBuilder):
                 commit_ckpt()
                 trees_since_ckpt = 0
             job.set_progress(built / ntrees_new)
-            if job.cancel_requested:
+            if job.cancel_requested or job.preempt_requested:
                 break
+        # checkpoint-based preemption (ISSUE 15): commit the built
+        # prefix (DKV-only when no checkpoint dir is set — commit_ckpt
+        # handles ckpt_dir=None) and unwind so the scheduler requeues
+        # and resumes bit-identically from the saved OOB accumulators.
+        # User cancel wins; a preempt racing the final chunk is moot.
+        if (job.preempt_requested and not job.cancel_requested
+                and built < ntrees_new):
+            if built > 0:
+                commit_ckpt()
+            from h2o3_tpu.jobs import JobPreempted
+            raise JobPreempted(
+                f"drf train preempted at {built} committed trees"
+                + (f": {job.preempt_reason}" if job.preempt_reason
+                   else ""))
         if pending_obs is not None:
             # the final chunk: the loop has nothing left to overlap, so
             # this is the block_until_ready below, observed per shard

@@ -951,8 +951,15 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             # progress by DISPATCHED trees: the committed count lags one
             # chunk behind and would sit at 0 through a one-chunk train
             job.set_progress(0.5 * disp / ntrees_new)
-            if job.cancel_requested:
+            if job.cancel_requested or job.preempt_requested:
                 break
+        # checkpoint-based preemption (ISSUE 15): the scheduler asked
+        # this train to yield — commit the prefix as a DKV checkpoint
+        # (below) and unwind; user cancel wins and keeps its semantics.
+        # A preempt that raced the last chunk (every tree dispatched) is
+        # moot: the train just finishes.
+        preempting = (job.preempt_requested and not job.cancel_requested
+                      and not stopped and disp < ntrees_new)
         if not stopped and inflight is not None:
             all_trees.append((inflight["trees"], inflight["c"]))
             built += inflight["c"]
@@ -965,10 +972,21 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                 t_s0 = time.monotonic()
                 keeper.record(self._score_entry_fetch(inflight["pend"]))
                 score_s += time.monotonic() - t_s0
-            if ckpt_on and trees_since_ckpt > 0:
+            if (ckpt_on and trees_since_ckpt > 0) \
+                    or (preempting and built > 0):
                 # final commit covers cancellation too: a cancelled job
-                # leaves a checkpoint at its committed tree count
+                # leaves a checkpoint at its committed tree count. A
+                # PREEMPTED train commits even without a checkpoint dir
+                # (DKV-only artifact) — that checkpoint's exact f32
+                # margin is what makes the scheduler's resume
+                # bit-identical
                 commit_ckpt(margin)
+        if preempting:
+            from h2o3_tpu.jobs import JobPreempted
+            raise JobPreempted(
+                f"gbm train preempted at {built} committed trees"
+                + (f": {job.preempt_reason}" if job.preempt_reason
+                   else ""))
 
         jax.block_until_ready(margin)  # h2o3-lint: allow[transfer-seam] train-loop timing fence: the loop span must cover device completion, not dispatch
         t_loop = time.monotonic() - t_loop0_m
@@ -1335,8 +1353,23 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                 commit_ckpt()
                 trees_since_ckpt = 0
             job.set_progress((t + 1) / ntrees_new)
-            if job.cancel_requested:
+            if job.cancel_requested or job.preempt_requested:
                 break
+        preempting = (job.preempt_requested and not job.cancel_requested
+                      and len(trees) < ntrees_new)
+        if preempting:
+            # checkpoint-based preemption (ISSUE 15): commit the built
+            # prefix (DKV-only when no checkpoint dir is set) and unwind
+            # so the scheduler can requeue + resume bit-identically —
+            # margin_host holds exactly the committed trees' updates.
+            # Zero trees built → no checkpoint; the requeue reruns clean.
+            if trees:
+                commit_ckpt()
+            from h2o3_tpu.jobs import JobPreempted
+            raise JobPreempted(
+                f"gbm streamed train preempted at {len(trees)} trees"
+                + (f": {job.preempt_reason}" if job.preempt_reason
+                   else ""))
         if not trees:
             raise JobCancelled(
                 "cancelled before the first streamed tree completed")

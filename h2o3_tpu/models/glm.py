@@ -278,7 +278,9 @@ class _Poisson(_PositiveFamily):
 
 class _Gamma(_PositiveFamily):
     name = "gamma"
-    default_link = "log"
+    # the canonical link, matching the reference default
+    # (hex/glm/GLMModel.java:803 gamma -> Link.inverse)
+    default_link = "inverse"
     valid_links = ("inverse", "log", "identity")
 
     def variance(self, mu):
@@ -380,6 +382,21 @@ _FAMILIES = {"gaussian": _Gaussian, "binomial": _Binomial,
              "fractionalbinomial": _FractionalBinomial,
              "negativebinomial": _NegativeBinomial,
              "tweedie": _Tweedie}
+
+# family -> the link at which PLAIN (unguarded) IRLS is monotone-safe
+# and the L-BFGS closed-form objectives in _nll_mean are written. This
+# used to be spelled `type(fam).default_link`, which held only by
+# coincidence: with gamma's default now the canonical `inverse` (the
+# ADVICE r5 / GLMModel.java:803 fix), the gamma closed form still
+# assumes LOG (mu = exp(eta): per-row y·e^{-eta} + eta), and
+# gamma+inverse IRLS can step eta <= 0 (mu < 0 — the clamp_mu blowup
+# case) so it needs the halving guard / is unsafe for the guardless
+# streaming loop. Keying the three guards off this map instead of
+# default_link keeps each solver honest about what it implements.
+_PLAIN_IRLS_LINK = {"gaussian": "identity", "binomial": "logit",
+                    "quasibinomial": "logit",
+                    "fractionalbinomial": "logit", "poisson": "log",
+                    "gamma": "log", "negativebinomial": "log"}
 
 
 def _make_family(family: str, p: Dict) -> _Family:
@@ -1016,13 +1033,18 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
             raise NotImplementedError(
                 f"family '{family}' is not supported in streaming mode")
         fam = _make_family(family, p)
-        if fam.link_name != type(fam).default_link or family == "tweedie":
+        if fam.link_name != _PLAIN_IRLS_LINK.get(family) \
+                or family == "tweedie":
             # the chunked IRLS loop has no line-search guard; without it
-            # non-canonical links can diverge to NaN silently (dense
-            # path has the halving guard)
+            # links outside the monotone-safe set can diverge to NaN
+            # silently (the dense path guards them with step halving).
+            # Note gamma's DEFAULT link is now the canonical 'inverse'
+            # (unsafe here) — streamed gamma needs link='log' explicitly
             raise NotImplementedError(
-                "non-canonical links and family=tweedie are not "
-                "supported in streaming (memory-pressure) mode")
+                "only the monotone-safe family/link pairs "
+                "(gaussian/identity, binomial/logit, poisson/log, "
+                "gamma/log, negativebinomial/log) are supported in "
+                "streaming (memory-pressure) mode")
         rows = spec.nrow
         Xh = spec.X_host[:rows]
         yh = np.asarray(jax.device_get(spec.y))[:rows].astype(np.float32)
@@ -1583,9 +1605,11 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
                   ).upper().replace("-", "_")
         use_lbfgs = solver in ("L_BFGS", "LBFGS")
         if use_lbfgs and (family == "tweedie"
-                          or fam.link_name != type(fam).default_link):
-            # _nll_mean's closed-form objectives assume the canonical
-            # link; tweedie / non-canonical pairs go through IRLSM
+                          or fam.link_name != _PLAIN_IRLS_LINK.get(
+                              family)):
+            # _nll_mean's closed-form objectives are written at the
+            # _PLAIN_IRLS_LINK pairs (gamma's assumes LOG, not the
+            # canonical inverse default); other pairs go through IRLSM
             use_lbfgs = False
         if p.get("beta_constraints") and use_lbfgs:
             # box bounds are enforced by the projected-CD IRLS solver
@@ -1811,14 +1835,17 @@ class H2OGeneralizedLinearEstimator(ModelBuilder):
                     irls_step = step_bc
                 lam1 = jnp.float32(lam * alpha * nobs)
                 lam2 = jnp.float32(lam * (1 - alpha) * nobs)
-                # non-canonical links (and tweedie's power pair) are not
-                # guaranteed monotone under plain IRLS — guard each step
-                # with halving on the PENALIZED objective (deviance/2 +
-                # λ₁‖β‖₁ + λ₂/2‖β‖₂² on penalized coords), the same
-                # merit hex/glm/GLM.java's IRLSM line search uses; raw
-                # deviance alone would reject legitimate shrinkage steps
-                # when warm-starting up an ascending lambda list
-                guard = (fam.link_name != type(fam).default_link
+                # links outside the monotone-safe set (and tweedie's
+                # power pair) are not guaranteed monotone under plain
+                # IRLS — guard each step with halving on the PENALIZED
+                # objective (deviance/2 + λ₁‖β‖₁ + λ₂/2‖β‖₂² on
+                # penalized coords), the same merit hex/glm/GLM.java's
+                # IRLSM line search uses; raw deviance alone would
+                # reject legitimate shrinkage steps when warm-starting
+                # up an ascending lambda list. gamma+inverse (now the
+                # DEFAULT gamma link) is guarded: an unguarded step can
+                # push eta <= 0 where mu leaves the response domain
+                guard = (fam.link_name != _PLAIN_IRLS_LINK.get(family)
                          or family == "tweedie")
 
                 def _merit_of(bvec):

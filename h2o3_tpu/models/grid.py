@@ -133,17 +133,26 @@ class H2OGridSearch:
                     done = {}  # crashed mid-write — retrain everything
         built_count = [0]
 
+        def reload_done_point(ckey):
+            """Reload a completed point's artifact from the recovery
+            manifest; None = not recorded or stale (retrain). ONE
+            implementation for the sequential/pool walkers and the
+            scheduler branch — the reload contract must not drift."""
+            if ckey not in done:
+                return None
+            from h2o3_tpu.persist import load_model
+            try:
+                return load_model(done[ckey])
+            except Exception:   # noqa: BLE001
+                return None     # stale artifact — retrain the point
+
         def one_point(i, combo):
             """Train (or reload) one grid point; returns (i, model|None,
             failure|None)."""
             ckey = json.dumps(combo, sort_keys=True, default=str)
-            if ckey in done:
-                from h2o3_tpu.persist import load_model
-                try:
-                    model = load_model(done[ckey])
-                    return i, model, None, ckey, False
-                except Exception:
-                    pass  # stale artifact — retrain the point
+            model = reload_done_point(ckey)
+            if model is not None:
+                return i, model, None, ckey, False
             params = dict(base_params)
             params.update(combo)
             est = cls(**params)
@@ -178,14 +187,123 @@ class H2OGridSearch:
                 os.replace(tmp, mpath)
 
         combos = list(enumerate(self._combos()))
+        from h2o3_tpu import sched
         from h2o3_tpu.models.model_base import build_parallelism
         par = build_parallelism(self.parallelism)
-        if par > 1:
+        use_sched = sched.enabled() and not sched.in_scheduled_run()
+        if use_sched and par > 1:
+            # children route through the training scheduler (ISSUE 15):
+            # `parallelism` is a CAP on the in-flight submission wave;
+            # device-memory ADMISSION decides how many actually run, and
+            # the grid id is the fair-share group so one grid cannot
+            # starve another tenant's children in the bulk class
+            from h2o3_tpu import jobs as jobs_mod
+            pending = {}        # sched Entry -> (i, combo, est, ckey)
+            ci = 0
+            with sched.submit_context(priority="bulk",
+                                      share=self.grid_id):
+                while ci < len(combos) or pending:
+                    while ci < len(combos) and len(pending) < par:
+                        if ((max_models and built_count[0]
+                             + len(pending) >= max_models)
+                                or (max_secs
+                                    and time.monotonic() - t0
+                                    > max_secs)):
+                            ci = len(combos)
+                            break
+                        i, combo = combos[ci]
+                        ci += 1
+                        ckey = json.dumps(combo, sort_keys=True,
+                                          default=str)
+                        reloaded = reload_done_point(ckey)
+                        if reloaded is not None:
+                            record(i, combo, reloaded, None, ckey,
+                                   False)
+                            built_count[0] += 1
+                            continue
+                        params = dict(base_params)
+                        params.update(combo)
+                        est = cls(**params)
+                        try:
+                            est.train(x=x, y=y,
+                                      training_frame=training_frame,
+                                      validation_frame=validation_frame,
+                                      background=True, **train_kw)
+                        except Exception as e:  # noqa: BLE001
+                            record(i, combo, None,
+                                   {"params": combo, "error": str(e)},
+                                   ckey, False)
+                            continue
+                        entry = est.__dict__.get("_sched_entry")
+                        if entry is None:
+                            # wrapper builders (CoxPH, ANOVA-GLM,
+                            # Word2Vec…) override train() and swallow
+                            # background= in **kw — they completed
+                            # SYNCHRONOUSLY above
+                            record(i, combo, est.model, None, ckey,
+                                   True)
+                            built_count[0] += 1
+                            continue
+                        pending[entry] = (i, combo, est, ckey)
+                    if not pending:
+                        break
+                    if max_secs and time.monotonic() - t0 > max_secs:
+                        # wall budget expired: children already RUNNING
+                        # finish (the reference's in-flight slack), but
+                        # still-QUEUED ones must not start minutes past
+                        # the deadline once the queue drains — cancel
+                        # them (the scheduler finalizes cancelled queued
+                        # entries within one dispatch tick)
+                        for _, (_, _, qest, _) in pending.items():
+                            if qest.job.status == jobs_mod.QUEUED:
+                                qest.job.cancel(
+                                    "grid max_runtime_secs exceeded "
+                                    "while queued")
+                    # drain any finished child; the timeout re-checks
+                    # the wall budget while everything queues
+                    sched.scheduler().wait_any(list(pending),
+                                               timeout=1.0)
+                    for entry in [e for e in pending
+                                  if e.done.is_set()]:
+                        i, combo, est, ckey = pending.pop(entry)
+                        job = est.job
+                        if job.status == jobs_mod.DONE \
+                                and job.result is not None:
+                            record(i, combo, job.result, None, ckey,
+                                   True)
+                            built_count[0] += 1
+                        elif (job.status == jobs_mod.CANCELLED
+                              and (job.cancel_reason or "").startswith(
+                                  "grid max_runtime_secs")):
+                            # budget-cancelled while QUEUED: the point
+                            # never trained — same outcome as never
+                            # having been submitted, not a failure
+                            pass
+                        else:
+                            record(i, combo, None,
+                                   {"params": combo,
+                                    "error": job.exception_msg
+                                    or job.cancel_reason
+                                    or f"job ended {job.status}"},
+                                   ckey, False)
+            self.models.sort(
+                key=lambda m: int(m.key.rsplit("_", 1)[1]))
+        elif par > 1:
             # hex/grid/GridSearch parallelism: a worker pool walks the
             # space; budgets are enforced at SUBMIT time per wave so
             # max_models overshoots by at most parallelism-1 in-flight
-            # points (the reference has the same in-flight slack)
+            # points (the reference has the same in-flight slack).
+            # This branch only runs NESTED (inside an admitted build)
+            # or with the scheduler disabled — the pool threads must
+            # re-enter the inline flag (it is thread-local) so children
+            # ride the parent's admission instead of enqueueing while
+            # the parent blocks on them
             import concurrent.futures as cf
+
+            def one_point_inline(i, combo):
+                with sched.inline_run():
+                    return one_point(i, combo)
+
             with cf.ThreadPoolExecutor(max_workers=par) as ex:
                 pending = {}
                 ci = 0
@@ -199,7 +317,8 @@ class H2OGridSearch:
                             ci = len(combos)
                             break
                         i, combo = combos[ci]
-                        pending[ex.submit(one_point, i, combo)] = combo
+                        pending[ex.submit(one_point_inline, i,
+                                          combo)] = combo
                         ci += 1
                     if not pending:
                         break
@@ -214,13 +333,19 @@ class H2OGridSearch:
             self.models.sort(
                 key=lambda m: int(m.key.rsplit("_", 1)[1]))
         else:
-            for i, combo in combos:
-                if max_models and len(self.models) >= max_models:
-                    break
-                if max_secs and time.monotonic() - t0 > max_secs:
-                    break
-                i2, model, failure, ckey, fresh = one_point(i, combo)
-                record(i, combo, model, failure, ckey, fresh)
+            # sequential walk: children still submit one at a time under
+            # the bulk class + this grid's fair-share group, so a serial
+            # grid queues behind interactive trains exactly like a
+            # parallel one
+            with sched.submit_context(priority="bulk",
+                                      share=self.grid_id):
+                for i, combo in combos:
+                    if max_models and len(self.models) >= max_models:
+                        break
+                    if max_secs and time.monotonic() - t0 > max_secs:
+                        break
+                    i2, model, failure, ckey, fresh = one_point(i, combo)
+                    record(i, combo, model, failure, ckey, fresh)
         dkv.put(self.grid_id, "grid", self)
         return self
 

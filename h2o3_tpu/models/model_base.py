@@ -25,6 +25,7 @@ import numpy as np
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.frame.vec import T_ENUM, T_STR, Vec
 from h2o3_tpu.jobs import Job
+from h2o3_tpu import telemetry as _tel
 from h2o3_tpu.models import metrics as metrics_mod
 
 
@@ -220,7 +221,8 @@ def build_validation_spec(frame: Frame, train_spec: TrainingSpec,
         w = np.zeros(padded, np.float32)
         w[:nrow] = wr
     else:
-        yf = np.asarray(jax.device_get(frame.vec(train_spec.response).as_float()))
+        yf = np.asarray(_tel.device_get(
+            frame.vec(train_spec.response).as_float(), pipeline="train"))
         resp_ok = np.isfinite(yf) & row_ok
         y_dev = jnp.asarray(np.where(resp_ok, yf, 0.0).astype(np.float32))
         w = resp_ok.astype(np.float32)
@@ -228,7 +230,8 @@ def build_validation_spec(frame: Frame, train_spec: TrainingSpec,
         if weights_column not in frame:
             raise ValueError(
                 f"validation frame lacks weights_column '{weights_column}'")
-        wv = np.asarray(jax.device_get(frame.vec(weights_column).as_float()))
+        wv = np.asarray(_tel.device_get(
+            frame.vec(weights_column).as_float(), pipeline="train"))
         w = w * np.where(np.isnan(wv), 0.0, wv)
     w = jnp.asarray(w)
     offset = None
@@ -263,7 +266,7 @@ def _adapt_matrix(frame: Frame, feature_names, feature_is_cat, cat_domains):
                 lut = {lab: i for i, lab in enumerate(train_dom)}
                 remap = np.array([lut.get(lab, -1) for lab in v.domain] + [-1],
                                  dtype=np.int32)
-                codes = np.asarray(jax.device_get(v.data))
+                codes = np.asarray(_tel.device_get(v.data, pipeline="score"))
                 codes = remap[np.where(codes < 0, len(v.domain), codes)]
                 v = Vec.from_numpy(codes[: v.nrow], vtype=T_ENUM, domain=train_dom)
         cols.append(v.as_float())
@@ -406,10 +409,10 @@ class Model:
         out = self._predict_matrix(X, offset=self._frame_offset(frame))
         nrow = frame.nrow
         if self.nclasses <= 1:
-            pv = np.asarray(jax.device_get(out))[:nrow]
+            pv = np.asarray(_tel.device_get(out, pipeline="score"))[:nrow]
             return Frame(["predict"], [Vec.from_numpy(pv)])
         probs = self._correct_probabilities(
-            np.asarray(jax.device_get(out))[:nrow])
+            np.asarray(_tel.device_get(out, pipeline="score"))[:nrow])
         lbl = np.argmax(probs, axis=1).astype(np.int32)
         names = ["predict"] + [f"p{d}" for d in self.response_domain]
         vecs = [Vec.from_numpy(lbl, vtype=T_ENUM, domain=self.response_domain)]
@@ -464,7 +467,7 @@ class Model:
             y, w = response_codes_in_domain(frame, self.response,
                                             self.response_domain)
             out_h = self._correct_probabilities(
-                np.asarray(jax.device_get(out))[:nrow])
+                np.asarray(_tel.device_get(out, pipeline="score"))[:nrow])
             return compute_metrics(out_h, y, w, self.nclasses, self.response_domain)
         spec_like = build_training_spec(frame, self.response, classification=False)
         return compute_metrics(out, spec_like.y, spec_like.w, 1)
@@ -767,7 +770,7 @@ class ModelBuilder:
         X = adapt_test_matrix(model, cf)
         out = model._predict_matrix(X, offset=model._frame_offset(cf))
         probs = model._correct_probabilities(
-            np.asarray(jax.device_get(out))[:cf.nrow])
+            np.asarray(_tel.device_get(out, pipeline="train"))[:cf.nrow])
         p1 = np.clip(probs[:, 1].astype(np.float64), 1e-12, 1 - 1e-12)
         yc, w = response_codes_in_domain(cf, model.response,
                                          model.response_domain)
@@ -841,7 +844,8 @@ class ModelBuilder:
             # distributions must reflect the data actually trained on
             w_eff = spec.w * (~jnp.isnan(spec.X).any(axis=1))
         counts = jnp.zeros(K, jnp.float32).at[yc].add(w_eff)
-        ch = np.asarray(jax.device_get(counts), np.float64)
+        ch = np.asarray(_tel.device_get(counts, pipeline="train"),
+                        np.float64)
         total = float(ch.sum())
         if total <= 0:
             return spec
@@ -871,48 +875,139 @@ class ModelBuilder:
               training_frame: Optional[Frame] = None,
               validation_frame: Optional[Frame] = None,
               background: bool = False) -> "ModelBuilder":
+        """Train via the cluster scheduler (h2o3_tpu.sched): the
+        submission ENQUEUES (surfacing as QUEUED on /3/Jobs) and the
+        whole build — spec construction and its device allocations
+        included — runs only once admission releases it. Nested builds
+        (CV folds, metalearners, calibration trains inside an admitted
+        run) and the H2O3_SCHED=0 escape run the pre-scheduler inline/
+        daemon-thread path: queueing a child while the parent blocks on
+        it would deadlock the parent against its own admission."""
         y = y or self.params.get("response_column")
         training_frame = training_frame if training_frame is not None else \
             self.params.get("training_frame")
         if training_frame is None or (y is None and self.supervised):
             raise ValueError("train() needs training_frame"
                              + (" and y" if self.supervised else ""))
+        from h2o3_tpu import sched
+        # max_runtime_secs rides on the job so the supervision watchdog
+        # (jobs.py) enforces it by cancellation — the chunk loops poll
+        # cancel_requested and exit cooperatively. Queue wait does NOT
+        # count: mark_dispatched restarts the clock.
+        job = Job(f"{self.algo} training", work=1.0,
+                  max_runtime_secs=float(
+                      self.params.get("max_runtime_secs", 0) or 0))
+        self.job = job
+        # restart recovery (ISSUE 9): is_resuming() is thread-local to
+        # the SUBMITTING thread — capture it before the body hops to a
+        # scheduler worker
+        self._resuming = False
+        if os.environ.get("H2O3_RECOVERY_DIR"):
+            from h2o3_tpu import recovery
+            self._resuming = recovery.is_resuming()
+        kwargs = dict(x=x, y=y, training_frame=training_frame,
+                      validation_frame=validation_frame)
+        if sched.enabled() and not sched.in_scheduled_run():
+            try:
+                # foreground submissions execute on THIS thread once
+                # admission grants them (caller_runs): the caller blocks
+                # anyway, and XLA compiles run measurably slower on
+                # freshly-spawned worker threads
+                entry = sched.scheduler().submit(
+                    self, job, kwargs, caller_runs=not background)
+            except (sched.SchedulerSaturatedError, ValueError) as e:
+                # any submit rejection (queue cap, unknown priority):
+                # the job never enters the queue — terminal-fail it so
+                # /3/Jobs pollers and join()ers see the rejection
+                # instead of a RUNNING zombie that is never evicted
+                # (end clocks stamped — a terminal job's msec must not
+                # keep growing)
+                from h2o3_tpu.jobs import FAILED
+                job.status = FAILED
+                job._record_failure(e)
+                job.end_time = time.time()
+                job._end_mono = time.monotonic()
+                job._done_evt.set()
+                raise
+            self._sched_entry = entry
+            if not background:
+                sched.scheduler().run_to_completion(entry)
+                self.model = self._join_typed(job)
+            return self
+        # inline path (nested build or scheduler disabled)
+        if self._resuming:
+            from h2o3_tpu import jobs as jobs_mod
+            job.status = jobs_mod.RECOVERING
+        job.run(lambda j: self._run_build(j, **kwargs),
+                background=background)
+        if not background:
+            self.model = self._join_typed(job)
+        return self
+
+    def _join_typed(self, job: Job):
+        """Foreground-train result: parameter-validation failures (the
+        spec phase — bad columns, unsupported modes) re-raise TYPED
+        exactly as they did when the spec was built on the calling
+        thread; training-phase failures keep join()'s RuntimeError
+        wrapping."""
+        from h2o3_tpu.jobs import FAILED
+        if (job.status == FAILED and job.exception_obj is not None
+                and getattr(job.exception_obj, "_h2o3_param_error",
+                            False)):
+            raise job.exception_obj
+        return job.join()
+
+    def _run_build(self, job: Job, x=None, y=None, training_frame=None,
+                   validation_frame=None):
+        """The whole build — spec (device allocation), train, CV,
+        calibration — executed on the dispatching thread (a scheduler
+        worker, the caller for inline foreground builds, or a daemon
+        thread for inline background ones)."""
         from h2o3_tpu import telemetry
         from h2o3_tpu.log import Profile, info, timeline_record
         t0 = time.monotonic()
+        if self._resuming:
+            from h2o3_tpu import jobs as jobs_mod
+            job.status = jobs_mod.RECOVERING
         # root span for the whole build; handed EXPLICITLY to the Profile
-        # because the body below runs on the job thread (thread-local
+        # because this body may run on a worker thread (thread-local
         # nesting does not carry across threads)
         sp_root = telemetry.open_span(f"train.{self.algo}")
         prof = Profile(parent_span=sp_root)
         timeline_record("train_start", f"{self.algo}")
         self._warn_compat_params()
-        with prof.phase("spec"):
-            spec = self._make_spec(training_frame, y, x)
-            spec = self._apply_balance_classes(spec)
-            if self.params.get("calibrate_model"):
-                self._validate_calibration(spec)
-            if getattr(spec, "stream", False) and not self.supports_streaming:
-                raise NotImplementedError(
-                    f"{self.algo}: the training frame exceeds the device "
-                    f"memory budget and this algorithm has no streaming "
-                    f"(memory-pressure) path — raise "
-                    f"H2O3_DEVICE_BUDGET_BYTES, reduce the frame, or use "
-                    f"GBM/XGBoost/GLM which stream")
-            valid_spec = None
-            if validation_frame is not None:
-                # ADAPT the validation frame to the training spec (domain
-                # remap), not a fresh spec from its own domains
-                valid_spec = build_validation_spec(
-                    validation_frame, spec,
-                    weights_column=self.params.get("weights_column"),
-                    offset_column=self.params.get("offset_column"))
-        # max_runtime_secs rides on the job so the supervision watchdog
-        # (jobs.py) enforces it by cancellation — the chunk loops poll
-        # cancel_requested and exit cooperatively
-        job = Job(f"{self.algo} training", work=1.0,
-                  max_runtime_secs=float(
-                      self.params.get("max_runtime_secs", 0) or 0))
+        try:
+            with prof.phase("spec"):
+                spec = self._make_spec(training_frame, y, x)
+                spec = self._apply_balance_classes(spec)
+                if self.params.get("calibrate_model"):
+                    self._validate_calibration(spec)
+                if getattr(spec, "stream", False) \
+                        and not self.supports_streaming:
+                    raise NotImplementedError(
+                        f"{self.algo}: the training frame exceeds the "
+                        f"device memory budget and this algorithm has no "
+                        f"streaming (memory-pressure) path — raise "
+                        f"H2O3_DEVICE_BUDGET_BYTES, reduce the frame, or "
+                        f"use GBM/XGBoost/GLM which stream")
+                valid_spec = None
+                if validation_frame is not None:
+                    # ADAPT the validation frame to the training spec
+                    # (domain remap), not a fresh spec from its own
+                    # domains
+                    valid_spec = build_validation_spec(
+                        validation_frame, spec,
+                        weights_column=self.params.get("weights_column"),
+                        offset_column=self.params.get("offset_column"))
+        except Exception as e:
+            # parameter/spec validation failed: tag so a foreground
+            # train() re-raises it TYPED (pre-scheduler, this phase ran
+            # on the calling thread and its ValueErrors were never
+            # RuntimeError-wrapped)
+            e._h2o3_param_error = True
+            if sp_root is not None and sp_root.duration_s is None:
+                sp_root.finish()
+            raise
         # restart recovery (ISSUE 9): a checkpointing train records a
         # durable manifest so a killed PROCESS can rediscover and resume
         # it at the next boot; the env gate keeps the common path one
@@ -920,10 +1015,7 @@ class ModelBuilder:
         # recovery scan surfaces as RECOVERING on /3/Jobs.
         rec_key = None
         if os.environ.get("H2O3_RECOVERY_DIR"):
-            from h2o3_tpu import jobs as jobs_mod
             from h2o3_tpu import recovery
-            if recovery.is_resuming():
-                job.status = jobs_mod.RECOVERING
             if self.params.get("in_training_checkpoints_dir"):
                 rec_key = recovery.record_training(self, job,
                                                    training_frame, y, spec)
@@ -983,10 +1075,9 @@ class ModelBuilder:
             # unsupervised specs carry a dummy zero y — a metric on it
             # would be meaningless (and wrappers may not even score)
             if callable(cmf) and spec.response is not None:
-                pred = np.asarray(jax.device_get(
-                    model._predict_matrix(spec.X)))
-                yh = np.asarray(jax.device_get(spec.y))
-                wh = np.asarray(jax.device_get(spec.w))
+                pred, yh, wh = (np.asarray(v) for v in _tel.device_get(
+                    (model._predict_matrix(spec.X), spec.y, spec.w),
+                    pipeline="train"))
                 live = wh > 0
                 model.output["custom_metric"] = {
                     "name": getattr(cmf, "__name__", "custom"),
@@ -1031,10 +1122,14 @@ class ModelBuilder:
                 # a cooperative cancel that unwound before finalize is
                 # still a DELIBERATE end — drop the recovery manifest
                 # so the cancelled train does not auto-resume at the
-                # next boot (crash/kill paths never reach this handler)
+                # next boot (crash/kill paths never reach this handler).
+                # A PREEMPTION unwind is NOT terminal: the scheduler
+                # requeues the entry, and a crash while it waits must
+                # still find the manifest at the next boot
                 if rec_key is not None:
-                    from h2o3_tpu.jobs import JobCancelled
-                    if isinstance(e, JobCancelled):
+                    from h2o3_tpu.jobs import JobCancelled, JobPreempted
+                    if isinstance(e, JobCancelled) \
+                            and not isinstance(e, JobPreempted):
                         from h2o3_tpu import recovery
                         recovery.complete_training(rec_key)
                 raise
@@ -1043,11 +1138,7 @@ class ModelBuilder:
                 if sp_root is not None and sp_root.duration_s is None:
                     sp_root.finish()
 
-        job.run(body_spanned, background=background)
-        if not background:
-            self.model = job.join()
-        self.job = job
-        return self
+        return body_spanned(job)
 
     def _make_spec(self, frame, y, x):
         if not self.supervised:
@@ -1099,18 +1190,28 @@ class ModelBuilder:
         holdout = np.full((nrow, K) if K > 1 else (nrow,), np.nan, dtype=np.float32)
 
         def one_fold(fid):
-            mask = fold == fid
-            tr = frame.rows(~mask)
-            te = frame.rows(mask)
-            sub = type(self)(**{k: v for k, v in self.params.items()
-                                if k not in ("nfolds", "fold_column",
-                                             "parallelism")})
-            sub.train(x=x, y=y, training_frame=tr)
-            fm = sub.model
-            X_te = adapt_test_matrix(fm, te)
-            out = np.asarray(jax.device_get(
-                fm._predict_matrix(X_te, offset=fm._frame_offset(te))))[: te.nrow]
-            return mask, out, fm
+            # fold builds are NESTED: they ride the parent's scheduler
+            # admission. The inline flag is thread-local, so a fold
+            # running on a pool thread (parallel CV / concurrent
+            # CV-main) must re-enter it explicitly — without this the
+            # fold would ENQUEUE while the parent blocks holding its
+            # grant, deadlocking under a tight budget
+            from h2o3_tpu import sched
+            with sched.inline_run():
+                mask = fold == fid
+                tr = frame.rows(~mask)
+                te = frame.rows(mask)
+                sub = type(self)(**{k: v for k, v in self.params.items()
+                                    if k not in ("nfolds", "fold_column",
+                                                 "parallelism")})
+                sub.train(x=x, y=y, training_frame=tr)
+                fm = sub.model
+                X_te = adapt_test_matrix(fm, te)
+                out = np.asarray(_tel.device_get(
+                    fm._predict_matrix(X_te,
+                                       offset=fm._frame_offset(te)),
+                    pipeline="train"))[: te.nrow]
+                return mask, out, fm
 
         par = build_parallelism(
             int(self.params.get("parallelism", 1) or 1))
@@ -1141,8 +1242,8 @@ class ModelBuilder:
         nrow = frame.nrow
         cv_spec = build_training_spec(frame, y, x,
                                       classification=model.nclasses > 1)
-        yh = np.asarray(jax.device_get(cv_spec.y))[:nrow]
-        wh = np.asarray(jax.device_get(cv_spec.w))[:nrow]
+        yh, wh = (np.asarray(v)[:nrow] for v in _tel.device_get(
+            (cv_spec.y, cv_spec.w), pipeline="train"))
         ok = wh > 0
         if K > 1:
             model.cross_validation_metrics = (

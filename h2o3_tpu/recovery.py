@@ -68,6 +68,10 @@ _RESUME_CTX = threading.local()
 # tests/boot can join background resumes
 _LAST_REPORT: Optional[Dict[str, Any]] = None
 _LIVE_JOBS: List[Any] = []
+# the background _finish threads (they dkv.put the resumed model AFTER
+# the job turns terminal — waiters must join these, not just the jobs,
+# or they race the model registration)
+_LIVE_FINISHERS: List[Any] = []
 
 
 # ---------------- gating -----------------------------------------------
@@ -490,7 +494,13 @@ def _resume_entry(ent: Dict[str, Any], wait: bool) -> Dict[str, Any]:
     trace_id = ent.get("trace_id") or _trace.new_trace_id()
     _RESUME_CTX.on = True
     try:
-        with _trace.trace_context(trace_id):
+        # recovery resumes take the BACKGROUND priority class (ISSUE
+        # 15): a pod restart's catch-up work queues behind interactive
+        # and grid/automl trains instead of competing with them
+        from h2o3_tpu import sched
+        with sched.submit_context(priority="background",
+                                  share="recovery"), \
+                _trace.trace_context(trace_id):
             est.train(y=ent.get("y"), x=ent.get("x") or None,
                       training_frame=frame, background=True)
     finally:
@@ -515,8 +525,10 @@ def _resume_entry(ent: Dict[str, Any], wait: bool) -> Dict[str, Any]:
     if wait:
         _finish()
     else:
-        threading.Thread(target=_finish, daemon=True,
-                         name=f"recovery-{ent['model_key']}").start()
+        th = threading.Thread(target=_finish, daemon=True,
+                              name=f"recovery-{ent['model_key']}")
+        th.start()
+        _LIVE_FINISHERS.append(th)
     return {"model_key": ent["model_key"], "algo": ent["algo"],
             "job_key": job.key, "trace_id": trace_id,
             "checkpoint": ent.get("latest_ckpt"),
@@ -598,7 +610,12 @@ def recover_at_boot(wait: bool = False) -> Dict[str, Any]:
 
 
 def wait_for_recoveries(timeout: Optional[float] = None) -> None:
-    """Join every background resume started this process (tests)."""
+    """Join every background resume started this process (tests).
+    Joins the _finish THREADS, not just the jobs: the resumed model is
+    dkv.put by _finish after its job.join returns, so a job-only wait
+    races the model registration."""
+    for th in list(_LIVE_FINISHERS):
+        th.join(timeout)
     for job in list(_LIVE_JOBS):
         try:
             job.join(timeout)

@@ -137,6 +137,10 @@ def device_peaks() -> Dict[str, object]:
 # dispatch). Bounded: keys are per-(mesh, config, bucket) like the jit
 # caches they mirror.
 _COSTS: "OrderedDict[tuple, Optional[Cost]]" = OrderedDict()
+# key -> the scale (scan trip count) the cached Cost was multiplied by,
+# so consumers that want PER-ITERATION work (the training scheduler's
+# admission working-set hint) can divide it back out
+_COST_SCALES: Dict[tuple, float] = {}
 _COSTS_LOCK = threading.Lock()
 _COSTS_CAP = 512
 
@@ -182,8 +186,10 @@ def executable_cost(key: tuple, lower: Callable[[], object],
     cost = lowered_cost(lower, scale=scale)
     with _COSTS_LOCK:
         _COSTS[key] = cost
+        _COST_SCALES[key] = max(float(scale), 1.0)
         while len(_COSTS) > _COSTS_CAP:
-            _COSTS.popitem(last=False)
+            old, _ = _COSTS.popitem(last=False)
+            _COST_SCALES.pop(old, None)
     return cost
 
 
@@ -204,6 +210,27 @@ def traced_cost(key: tuple, fn: Callable, *args, **kwargs
 def cost_cache_size() -> int:
     with _COSTS_LOCK:
         return len(_COSTS)
+
+
+def per_iteration_bytes_hint(prefix: str) -> Optional[float]:
+    """Max PER-ITERATION HBM bytes accessed over cached executables
+    whose key leads with ``prefix`` (e.g. ``"gbm.chunk"``): the cached
+    Cost was multiplied by its scan trip count at capture, so dividing
+    it back out yields what ONE tree/step touches — the training
+    scheduler's admission working-set refinement (ISSUE 15). Bytes
+    accessed bound the resident working set from above (every resident
+    operand is read at least once per step), so the hint is a
+    conservative OVER-estimate; None when nothing is cached yet (cold
+    process — shape-based fallback applies)."""
+    best = None
+    with _COSTS_LOCK:
+        for key, cost in _COSTS.items():
+            if cost is None or not key or key[0] != prefix:
+                continue
+            per_it = cost.bytes / _COST_SCALES.get(key, 1.0)
+            if best is None or per_it > best:
+                best = per_it
+    return best
 
 
 def cost_cached(key: tuple) -> bool:

@@ -635,5 +635,55 @@ def test_rule_catalog_names():
     assert len(names) >= 5
     for expected in ("transfer-seam", "recompile-hazard",
                      "host-sync-hot-loop", "lock-discipline",
-                     "fault-seam", "monotonic-durations"):
+                     "fault-seam", "monotonic-durations",
+                     "sched-discipline"):
         assert expected in names
+
+
+# ------------------------------------------------- sched-discipline
+
+
+def test_detects_raw_thread_in_training_layer(tmp_path):
+    rep = _lint_source(tmp_path, "h2o3_tpu/models/newalgo.py", """\
+        import threading
+
+        def train_async(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            return t
+    """)
+    assert "sched-discipline" in _rules_of(rep)
+    f = [x for x in rep.new if x.rule == "sched-discipline"][0]
+    assert "admission" in f.message
+
+
+def test_detects_bare_thread_import_in_automl(tmp_path):
+    rep = _lint_source(tmp_path, "h2o3_tpu/automl.py", """\
+        from threading import Thread
+
+        def fan_out(fn):
+            Thread(target=fn).start()
+    """)
+    assert "sched-discipline" in _rules_of(rep)
+
+
+def test_threads_outside_training_layer_not_flagged(tmp_path):
+    rep = _lint_source(tmp_path, "h2o3_tpu/fleet/pump.py", """\
+        import threading
+
+        def beat(fn):
+            threading.Thread(target=fn, daemon=True).start()
+    """)
+    assert "sched-discipline" not in _rules_of(rep)
+
+
+def test_inline_executor_in_training_layer_is_fine(tmp_path):
+    rep = _lint_source(tmp_path, "h2o3_tpu/models/newalgo.py", """\
+        import concurrent.futures as cf
+
+        def folds(work, n):
+            with cf.ThreadPoolExecutor(max_workers=n) as ex:
+                return [f.result() for f in
+                        [ex.submit(work, i) for i in range(n)]]
+    """)
+    assert "sched-discipline" not in _rules_of(rep)

@@ -1,0 +1,333 @@
+"""Training scheduler (ISSUE 15): priority queues, device-memory-aware
+admission, checkpoint-based preemption.
+
+The oversubscription proofs run on a deliberately tiny memman budget:
+device "bytes" here are the scheduler's admitted-estimate ledger (the
+CPU backend reports no real HBM), so "peak device bytes stay under
+budget" is asserted as peak_reserved <= admission_budget PLUS the
+stronger behavioral fact that no train degraded to streaming — under a
+budget that fits exactly one dense train, any concurrent admission
+would have flipped later specs into streamed mode or OOMed.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu import jobs, memman, sched, telemetry
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator as GBM
+
+
+def _frame(n=4000, F=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    logit = X[:, 0] - 0.5 * X[:, 1]
+    cols = {f"x{i}": X[:, i] for i in range(F)}
+    cols["y"] = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)),
+                         "a", "b")
+    return h2o.Frame.from_numpy(cols)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_sched():
+    s = sched.reset()
+    yield s
+    memman.reset()
+    sched.reset()
+
+
+def _join_all(ests, timeout=300.0):
+    deadline = time.monotonic() + timeout
+    for e in ests:
+        e.job.join(max(deadline - time.monotonic(), 0.1))
+    return [e.job for e in ests]
+
+
+# ---------------- acceptance: oversubscription proof --------------------
+
+
+def test_oversubscribed_concurrent_gbm_all_complete(_fresh_sched):
+    """Budget sized for ONE resident train, 4 concurrent submissions:
+    all complete dense (queued, not degraded, no OOM), the admitted
+    ledger never exceeds the budget, and queue-wait metrics record."""
+    fr = _frame()
+    memman.reset(budget=500_000)
+    s = sched.reset()
+    wait_hist = telemetry.histogram("h2o3_sched_queue_wait_ms")
+    n0 = wait_hist.count
+    ests = [GBM(ntrees=4, max_depth=3, seed=i, min_rows=1.0)
+            for i in range(4)]
+    for e in ests:
+        e.train(y="y", training_frame=fr, background=True)
+    jobs_done = _join_all(ests)
+    assert all(j.status == jobs.DONE for j in jobs_done), \
+        [(j.status, j.exception_msg) for j in jobs_done]
+    models = [j.result for j in jobs_done]
+    assert all(m.ntrees_built == 4 for m in models)
+    # queued, not degraded: every train ran the DENSE path
+    assert not any(m.output.get("streamed") for m in models)
+    # a budget that fits one train serializes admission: never more
+    # than one entry held the device, and the ledger never summed two
+    # concurrent estimates (idle-admit lets a single estimate exceed
+    # the budget; concurrency may not)
+    assert s.peak_running == 1
+    max_est = max(e._sched_entry.estimate.bytes for e in ests)
+    assert s.peak_reserved <= max_est
+    snap = s.snapshot()
+    assert snap["counters"]["queued_total"] >= 4
+    assert snap["counters"]["admitted_total"] >= 4
+    # queue-wait metrics recorded per dispatch + surfaced per job
+    assert wait_hist.count >= n0 + 4
+    assert all(j.queue_wait_s is not None for j in jobs_done)
+
+
+def test_grid_children_share_tight_budget(_fresh_sched, monkeypatch):
+    """N parallel grid children on a budget that fits only one: all N
+    complete dense, serialized by admission (parallelism is only a
+    cap), with the ledger under budget throughout."""
+    from h2o3_tpu.models.grid import H2OGridSearch
+    monkeypatch.setenv("H2O3_MAX_BUILD_THREADS", "4")
+    fr = _frame()
+    memman.reset(budget=500_000)
+    s = sched.reset()
+    grid = H2OGridSearch(
+        GBM(ntrees=3, max_depth=3, seed=1, min_rows=1.0),
+        {"learn_rate": [0.05, 0.1, 0.2, 0.3]}, parallelism=4)
+    grid.train(y="y", training_frame=fr)
+    assert len(grid.models) == 4, grid.failures
+    assert not any(m.output.get("streamed") for m in grid.models)
+    # admission (not the parallelism=4 cap) decided concurrency
+    assert s.peak_running == 1
+    snap = s.snapshot()
+    assert snap["counters"]["admitted_total"] >= 4
+    # children rode the bulk class under the grid's fair-share group
+    assert snap["counters"]["queued_total"] >= 4
+
+
+# ---------------- acceptance: checkpoint-based preemption ---------------
+
+
+def _tree_arrays(model):
+    import jax
+    return {k: np.asarray(jax.device_get(getattr(model, k)))
+            for k in ("_feat", "_thr", "_value")}
+
+
+def test_preempt_resume_bit_identical(_fresh_sched):
+    """A bulk GBM preempted mid-train by an interactive submission
+    resumes from its DKV in-training checkpoint and finishes with tree
+    arrays bit-identical to an unpreempted twin."""
+    fr = _frame(n=2000, seed=3)
+    kw = dict(ntrees=18, max_depth=3, seed=7, min_rows=1.0,
+              score_tree_interval=2, stopping_rounds=0)
+    twin = GBM(**kw)
+    twin.train(y="y", training_frame=fr)
+
+    memman.reset(budget=500_000)
+    s = sched.reset()
+    victim = GBM(model_id="sched_victim_gbm", **kw)
+    with sched.submit_context(priority="bulk", share="bulk_tenant"):
+        victim.train(y="y", training_frame=fr, background=True)
+    # wait for the victim to actually hold the device
+    deadline = time.monotonic() + 60
+    while victim.job.status == jobs.QUEUED:
+        assert time.monotonic() < deadline, "victim never dispatched"
+        time.sleep(0.005)
+    hi = GBM(ntrees=3, max_depth=3, seed=1, min_rows=1.0)
+    hi.train(y="y", training_frame=fr, background=True)  # interactive
+    hi.job.join(120.0)
+    victim.job.join(300.0)
+    assert hi.job.status == jobs.DONE, hi.job.exception_msg
+    assert victim.job.status == jobs.DONE, victim.job.exception_msg
+    assert victim.job.preempt_count >= 1, \
+        "the interactive train never preempted the bulk victim"
+    assert s.snapshot()["counters"]["preempted_total"] >= 1
+    resumed = victim.job.result
+    assert resumed.ntrees_built == kw["ntrees"]
+    a, b = _tree_arrays(twin.model), _tree_arrays(resumed)
+    for k in a:
+        assert a[k].shape == b[k].shape, k
+        assert np.array_equal(a[k], b[k], equal_nan=True), \
+            f"preempted resume diverged in {k}"
+
+
+# ---------------- priority order / fair share ---------------------------
+
+
+def test_priority_classes_order(_fresh_sched, monkeypatch):
+    """interactive > bulk even when submitted later; dispatch is
+    serialized with a concurrency cap of 1 to observe the order."""
+    monkeypatch.setenv("H2O3_SCHED_MAX_CONCURRENT", "1")
+    fr = _frame(n=1500, seed=1)
+    s = sched.reset()
+    s.pause()
+    bulk = GBM(ntrees=2, max_depth=2, seed=2, min_rows=1.0)
+    with sched.submit_context(priority="bulk", share="g1"):
+        bulk.train(y="y", training_frame=fr, background=True)
+    inter = GBM(ntrees=2, max_depth=2, seed=3, min_rows=1.0)
+    inter.train(y="y", training_frame=fr, background=True)
+    assert bulk.job.status == jobs.QUEUED
+    assert inter.job.status == jobs.QUEUED
+    s.resume()
+    _join_all([bulk, inter])
+    # the interactive job dispatched first despite later submission:
+    # start_mono restarts at dispatch, and the cap serialized the runs
+    assert inter.job.start_mono < bulk.job.start_mono
+
+
+def test_fair_share_round_robin(_fresh_sched, monkeypatch):
+    """Within one class, dispatch rotates across share groups: two
+    children of grid g1 and one of g2 interleave g1, g2, g1."""
+    monkeypatch.setenv("H2O3_SCHED_MAX_CONCURRENT", "1")
+    fr = _frame(n=1200, seed=2)
+    s = sched.reset()
+    s.pause()
+    a1 = GBM(ntrees=2, max_depth=2, seed=1, min_rows=1.0)
+    a2 = GBM(ntrees=2, max_depth=2, seed=2, min_rows=1.0)
+    b1 = GBM(ntrees=2, max_depth=2, seed=3, min_rows=1.0)
+    with sched.submit_context(priority="bulk", share="g1"):
+        a1.train(y="y", training_frame=fr, background=True)
+        a2.train(y="y", training_frame=fr, background=True)
+    with sched.submit_context(priority="bulk", share="g2"):
+        b1.train(y="y", training_frame=fr, background=True)
+    s.resume()
+    _join_all([a1, a2, b1])
+    order = sorted([("a1", a1), ("a2", a2), ("b1", b1)],
+                   key=lambda kv: kv[1].job.start_mono)
+    assert [k for k, _ in order] == ["a1", "b1", "a2"]
+
+
+# ---------------- lifecycle / REST --------------------------------------
+
+
+def test_queued_surfaces_on_jobs_api(_fresh_sched):
+    from h2o3_tpu.api import schemas
+    fr = _frame(n=1000, seed=4)
+    s = sched.reset()
+    s.pause()
+    est = GBM(ntrees=2, max_depth=2, seed=1, min_rows=1.0)
+    est.train(y="y", training_frame=fr, background=True)
+    v = schemas.job_v3(est.job)
+    assert v["status"] == "QUEUED"
+    assert v["progress_msg"] == "Queued"
+    snap = s.snapshot()
+    assert [q["job"] for q in snap["queued"]] == [est.job.key]
+    s.resume()
+    est.job.join(120.0)
+    assert est.job.status == jobs.DONE
+    v = schemas.job_v3(est.job)
+    assert v["queue_wait_s"] is not None and v["preempt_count"] == 0
+
+
+def test_scheduler_rest_routes(_fresh_sched):
+    from h2o3_tpu.api import server as api
+    fr = _frame(n=1000, seed=5)
+    s = sched.reset()
+    out = api._scheduler_get({}, None)
+    assert out["__meta"]["schema_name"] == "SchedulerV3"
+    assert out["enabled"] and not out["paused"]
+    out = api._scheduler_control({"pause": "true"}, None)
+    assert out["paused"] and "paused" in out["actions"]
+    est = GBM(ntrees=2, max_depth=2, seed=1, min_rows=1.0)
+    with sched.submit_context(priority="bulk"):
+        est.train(y="y", training_frame=fr, background=True)
+    out = api._scheduler_control(
+        {"job": est.job.key, "priority": "interactive"}, None)
+    assert any("reprioritized" in a for a in out["actions"])
+    assert out["queued"][0]["priority"] == "interactive"
+    with pytest.raises(api.ApiError):
+        api._scheduler_control({"job": "nope", "priority": "bulk"}, None)
+    out = api._scheduler_control({"pause": "false"}, None)
+    assert not out["paused"]
+    est.job.join(120.0)
+    assert est.job.status == jobs.DONE
+
+
+def test_cancel_while_queued(_fresh_sched):
+    fr = _frame(n=1000, seed=6)
+    s = sched.reset()
+    s.pause()
+    est = GBM(ntrees=2, max_depth=2, seed=1, min_rows=1.0)
+    est.train(y="y", training_frame=fr, background=True)
+    est.job.cancel("changed my mind")
+    s.resume()
+    est.job.join(60.0)
+    assert est.job.status == jobs.CANCELLED
+    assert est.job.result is None     # never dispatched
+
+
+def test_bad_priority_rejects_without_zombie(_fresh_sched):
+    """An invalid scheduler_priority fails the submission typed AND
+    terminal-fails the job — a RUNNING zombie would never be evicted
+    from the registry."""
+    fr = _frame(n=800, seed=12)
+    est = GBM(ntrees=2, max_depth=2, min_rows=1.0,
+              scheduler_priority="urgent")
+    with pytest.raises(ValueError, match="priority"):
+        est.train(y="y", training_frame=fr)
+    assert est.job.status == jobs.FAILED
+    d1 = est.job.duration_ms()
+    time.sleep(0.06)
+    assert est.job.duration_ms() == d1   # end clocks stamped: frozen
+
+
+def test_queue_cap_rejects(_fresh_sched, monkeypatch):
+    monkeypatch.setenv("H2O3_SCHED_MAX_QUEUE", "1")
+    fr = _frame(n=1000, seed=7)
+    s = sched.reset()
+    s.pause()
+    first = GBM(ntrees=2, max_depth=2, seed=1, min_rows=1.0)
+    first.train(y="y", training_frame=fr, background=True)
+    second = GBM(ntrees=2, max_depth=2, seed=2, min_rows=1.0)
+    with pytest.raises(sched.SchedulerSaturatedError):
+        second.train(y="y", training_frame=fr, background=True)
+    assert second.job.status == jobs.FAILED   # no zombie QUEUED job
+    assert s.snapshot()["counters"]["rejected_total"] >= 1
+    s.resume()
+    first.job.join(120.0)
+    assert first.job.status == jobs.DONE
+
+
+def test_nested_cv_runs_inline_no_deadlock(_fresh_sched):
+    """CV folds inside an admitted train are NESTED builds: they run
+    inline under the parent's admission instead of queueing (which
+    would deadlock the parent against its own children)."""
+    fr = _frame(n=1500, seed=8)
+    memman.reset(budget=500_000)   # fits ~one train: folds must inline
+    sched.reset()
+    est = GBM(ntrees=2, max_depth=2, seed=1, min_rows=1.0, nfolds=2)
+    est.train(y="y", training_frame=fr)
+    assert est.model.cross_validation_metrics is not None
+
+
+def test_parallel_cv_pool_threads_inherit_inline(_fresh_sched,
+                                                 monkeypatch):
+    """The inline flag is thread-local: folds running on CV POOL
+    threads (parallelism>1, concurrent CV-main) must re-enter it, or
+    they would enqueue while the admitted parent blocks on them —
+    a deadlock under a budget that fits only the parent."""
+    monkeypatch.setenv("H2O3_MAX_BUILD_THREADS", "2")
+    fr = _frame(n=1500, seed=9)
+    memman.reset(budget=500_000)
+    sched.reset()
+    est = GBM(ntrees=2, max_depth=2, seed=1, min_rows=1.0, nfolds=2,
+              parallelism=2)
+    est.train(y="y", training_frame=fr)
+    assert est.model.cross_validation_metrics is not None
+
+
+# ---------------- admission estimates -----------------------------------
+
+
+def test_estimate_sources(_fresh_sched):
+    fr = _frame(n=2000, seed=9)
+    est = GBM(ntrees=2, max_depth=2)
+    memman.reset()                       # unlimited: dense shape path
+    e = sched.estimate_submission(est, fr, y="y")
+    assert not e.streamed and e.bytes > 0
+    assert e.source in ("shape", "costmodel+shape")
+    memman.reset(budget=30_000)          # frame cannot sit dense
+    e2 = sched.estimate_submission(est, fr, y="y")
+    assert e2.streamed and e2.source == "stream-window"
+    assert e2.bytes < e.bytes

@@ -472,6 +472,89 @@ def run_kill_replica_round(replicas: int = 3, traffic_secs: float = 6.0,
             os.environ["H2O3_FLEET_HEARTBEAT_MS"] = prev_hb
 
 
+def run_oversubscribe_round(log=print, rows: int = 3000) -> dict:
+    """Training-scheduler chaos (ISSUE 15, --oversubscribe): a memman
+    budget sized for ONE resident train, four concurrent bulk GBM
+    submissions, plus one interactive train submitted once the first
+    bulk victim holds the device. Proves the acceptance shape: every
+    submission completes DENSE (queued, never OOM-degraded), admission
+    never overlaps two trains, the interactive train preempts the
+    running bulk victim at a checkpoint commit, and every preempted
+    train's final tree arrays are bit-identical to an unpreempted twin.
+    Restores the process memman budget + scheduler on every exit."""
+    import numpy as np
+
+    import h2o3_tpu as h2o
+    from h2o3_tpu import jobs, memman, sched
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator as GBM
+
+    rng = np.random.default_rng(5)
+    F = 6
+    X = rng.normal(size=(rows, F)).astype(np.float32)
+    logit = X[:, 0] - 0.5 * X[:, 1]
+    cols = {f"x{i}": X[:, i] for i in range(F)}
+    cols["y"] = np.where(rng.random(rows) < 1 / (1 + np.exp(-logit)),
+                         "a", "b")
+    fr = h2o.Frame.from_numpy(cols)
+    kw = dict(ntrees=24, max_depth=3, min_rows=1.0, seed=7,
+              score_tree_interval=2, stopping_rounds=0)
+    twin = GBM(**kw)
+    twin.train(y="y", training_frame=fr)     # unpreempted reference
+    out = {"ran": True, "submissions": 5}
+    try:
+        memman.reset(budget=500_000)
+        s = sched.reset()
+        bulk = [GBM(model_id=f"oversub_bulk_{i}", **kw)
+                for i in range(4)]
+        with sched.submit_context(priority="bulk", share="oversub"):
+            for est in bulk:
+                est.train(y="y", training_frame=fr, background=True)
+        # submit the interactive train the moment a bulk victim holds
+        # the device — it cannot admit, so it must preempt
+        t0 = time.monotonic()
+        while all(e.job.status == jobs.QUEUED for e in bulk) \
+                and time.monotonic() - t0 < 60:
+            time.sleep(0.005)
+        hi = GBM(ntrees=3, max_depth=3, min_rows=1.0, seed=1)
+        hi.train(y="y", training_frame=fr, background=True)
+        for est in bulk + [hi]:
+            est.job.join(600)
+        jobs_all = [e.job for e in bulk + [hi]]
+        completed = sum(j.status == jobs.DONE for j in jobs_all)
+        models = [e.job.result for e in bulk]
+        preempted = [e for e in bulk if e.job.preempt_count > 0]
+        resume_ok = None
+        if preempted:
+            # a preempted job that produced NO model is a resume
+            # FAILURE, not a vacuous pass — the ratcheted
+            # preempt_resume_ok metric must never read 1 by default
+            results = [e.job.result for e in preempted]
+            resume_ok = (all(r is not None for r in results)
+                         and all(_trees_equal(twin.model, r)
+                                 for r in results))
+        waits = sorted(j.queue_wait_s or 0.0 for j in jobs_all)
+        out.update({
+            "oversub_completed": completed,
+            "degraded": sum(bool((m.output or {}).get("streamed"))
+                            for m in models if m is not None),
+            "peak_concurrent": s.peak_running,
+            "preempted": len(preempted),
+            "preempt_resume_ok": ((1 if resume_ok else 0)
+                                  if resume_ok is not None else None),
+            "queue_wait_p50_ms": round(
+                waits[len(waits) // 2] * 1000.0, 2),
+            "counters": s.snapshot()["counters"],
+        })
+        out["ok"] = bool(completed == 5 and out["degraded"] == 0
+                         and s.peak_running == 1
+                         and len(preempted) >= 1 and resume_ok)
+    finally:
+        memman.reset()
+        sched.reset()
+    log(f"oversubscribe round: {out}")
+    return out
+
+
 def run_chaos_round(rows: int = 2000, log=print,
                     kill_process=None) -> dict:
     """Run the sweep with a hard guarantee that fault injection is
@@ -633,6 +716,13 @@ def main():
         out = {"fleet": run_kill_replica_round(log=log)}
         print(json.dumps(out, indent=2))
         sys.exit(0 if out["fleet"]["ok"] else 1)
+    if "--oversubscribe" in sys.argv[1:]:
+        # training-scheduler chaos only (ISSUE 15): tight budget, 4
+        # concurrent bulk trains + 1 interactive preemptor — queued not
+        # degraded, bit-identical preempt/resume
+        out = {"sched": run_oversubscribe_round(log=log)}
+        print(json.dumps(out, indent=2))
+        sys.exit(0 if out["sched"]["ok"] else 1)
     # --kill-process forces the restart-recovery round even when
     # H2O3_BENCH_CHAOS_KILL=0; without it the env default applies
     kill = True if "--kill-process" in sys.argv[1:] else None

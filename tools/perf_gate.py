@@ -71,6 +71,13 @@ METRIC_SPECS: Tuple[Tuple[str, str, float], ...] = (
     # only shrink — wide band, it is heartbeat-quantized
     ("fleet.rows_per_sec", "higher", 0.20),
     ("fleet.shed_ms", "lower", 0.60),
+    # training scheduler (ISSUE 15): completions under oversubscription
+    # and the preempt/resume bit-identity verdict (1/0) may never
+    # regress (band 0); queue wait is train-duration-quantized — the
+    # widest band in the table
+    ("sched.oversub_completed", "higher", 0.0),
+    ("sched.preempt_resume_ok", "higher", 0.0),
+    ("sched.queue_wait_p50_ms", "lower", 0.60),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
