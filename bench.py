@@ -130,7 +130,39 @@ def _disk_frame(rows):
         f"({fr.nrow / ingest_s:,.0f} rows/sec, "
         f"{os.path.getsize(path) / 1e6 / parse_s:,.1f} MB/s parse) "
         f"profile={LAST_PROFILE}")
-    return fr, ingest_s, parse_s, os.path.getsize(path)
+    return fr, ingest_s, parse_s, os.path.getsize(path), path
+
+
+def _compressed_ingest_round(path, csv_bytes):
+    """Multi-member gzip of (a capped prefix of) the bench CSV through
+    the member-parallel compressed plane (ingest/compress.py): returns
+    UNCOMPRESSED MB/s of the end-to-end compressed import — the number
+    perf_gate ratchets as ingest.compressed_mb_per_sec. Cap via
+    H2O3_BENCH_COMPRESSED_MB (0 disables the round)."""
+    import time as _t
+    from h2o3_tpu.ingest.compress import gzip_compress_members
+    from h2o3_tpu.ingest.parse import LAST_PROFILE, parse, parse_setup
+    cap = int(os.environ.get("H2O3_BENCH_COMPRESSED_MB", 32)) << 20
+    if cap <= 0:
+        return None
+    with open(path, "rb") as f:
+        data = f.read(cap)
+    if len(data) < csv_bytes:              # cut at a row boundary
+        data = data[:data.rfind(b"\n") + 1]
+    gz = path + ".member.gz"
+    if not os.path.exists(gz):
+        with open(gz, "wb") as f:
+            f.write(gzip_compress_members(data))
+    t0 = _t.time()
+    fr = parse([gz], parse_setup([gz]))
+    wall = _t.time() - t0
+    info = (LAST_PROFILE.get("compressed") or [{}])[0]
+    mbps = round(len(data) / 1e6 / wall, 1)
+    log(f"compressed ingest: {fr.nrow} rows, members={info.get('members')} "
+        f"parallel={info.get('parallel')} "
+        f"fallback_ranges={LAST_PROFILE.get('fallback_ranges')} "
+        f"{mbps:,.1f} MB/s (uncompressed bytes)")
+    return mbps
 
 
 SERVE_SINGLE_ROWS = int(os.environ.get("H2O3_BENCH_SERVE_ROWS", 300))
@@ -299,9 +331,16 @@ def main():
     log(f"devices: {jax.devices()}  backend: {jax.default_backend()}  "
         f"compile_cache: {cache_dir}")
     ingest_s = parse_s = csv_bytes = None
+    ingest_prof = {}
+    compressed_mbps = None
     if os.environ.get("H2O3_BENCH_DISK", "1") not in ("0", "false", ""):
-        fr, ingest_s, parse_s, csv_bytes = _disk_frame(ROWS)
+        fr, ingest_s, parse_s, csv_bytes, csv_path = _disk_frame(ROWS)
         F = fr.ncol - 1
+        # snapshot the plain parse's profile BEFORE the compressed
+        # round overwrites LAST_PROFILE
+        from h2o3_tpu.ingest.parse import LAST_PROFILE as _LP
+        ingest_prof = dict(_LP)
+        compressed_mbps = _compressed_ingest_round(csv_path, csv_bytes)
     else:
         X, y, F = _make_arrays(ROWS)
         cols = {f"f{i}": X[:, i] for i in range(F)}
@@ -579,13 +618,20 @@ def main():
         # regression that silently reroutes ranges through the Python
         # fallback now fails the gate instead of just reading slower
         out["ingest.mb_per_sec"] = round(csv_bytes / 1e6 / parse_s, 1)
-        from h2o3_tpu.ingest.parse import LAST_PROFILE
-        out["ingest.fallback_ranges"] = LAST_PROFILE.get(
+        out["ingest.fallback_ranges"] = ingest_prof.get(
             "fallback_ranges", 0)
         # per-chunk streamed H2D: share of device_put wall time hidden
         # under tokenize (ingest/stream.py; None = streaming not taken)
-        out["ingest.h2d_overlap_ratio"] = LAST_PROFILE.get(
+        out["ingest.h2d_overlap_ratio"] = ingest_prof.get(
             "h2d_overlap_ratio")
+        # nogil native encode throughput (ISSUE 16): file bytes over
+        # worker-pool CPU-seconds spent in the typed column encode
+        enc = ingest_prof.get("encode_cpu_s")
+        if enc:
+            out["ingest.encode_mb_per_sec"] = round(
+                csv_bytes / 1e6 / enc, 1)
+        if compressed_mbps is not None:
+            out["ingest.compressed_mb_per_sec"] = compressed_mbps
     print(json.dumps(out))
 
 

@@ -103,6 +103,7 @@ KNOWN_SITES = frozenset({
     "collective",   # ICI histogram psum (multi-shard train dispatch)
     "persist",      # storage reads (persist.load_model, URI cache)
     "boot",         # restart-recovery resume (recovery.recover_at_boot)
+    "decompress",   # compressed-ingest inflate (ingest/compress.py)
 })
 
 

@@ -428,6 +428,42 @@ def batch_device_put(columns, fill, dtype, nrow: int, mesh=None):
     return split_columns(dev, len(columns))
 
 
+def batch_device_put_local(columns, fill, dtype, row_lo: int, row_hi: int,
+                           nrow_global: int, mesh=None,
+                           simulate: bool = False):
+    """Multihost spelling of :func:`batch_device_put`: this process packs
+    and transfers ONLY its own padded row block ``[row_lo, row_hi)`` of
+    the global ``[plen, ncol]`` matrix — the shard-local H2D target of
+    the multi-host parse (``columns`` hold just the local data rows).
+    The recorded H2D bytes are the LOCAL block, which is what per-process
+    ``h2o3_ingest_h2d_bytes`` attribution asserts. ``simulate`` is the
+    parity-test shape (a forced multi-process plan on a single-process
+    mesh, where ``make_array_from_process_local_data`` cannot apply):
+    the local block scatters into a fill-padded global matrix and takes
+    the ordinary single-process sharded put — rows outside the local
+    span are fill, never data, so a simulated process still only ever
+    touches its own bytes."""
+    from h2o3_tpu.resilience import resilient_shard_rows
+    mesh = mesh or current_mesh()
+    plen = padded_len(nrow_global, mesh)
+    nloc = row_hi - row_lo
+    mat = np.empty((nloc, len(columns)), dtype=dtype)
+    real = max(0, min(row_hi, nrow_global) - row_lo)
+    if real < nloc:
+        mat[real:] = fill              # pad tail inside the local span
+    for j in range(len(columns)):
+        mat[:real, j] = columns[j]
+    record_h2d(mat.nbytes, pipeline="ingest")
+    if simulate:
+        full = np.full((plen, len(columns)), fill, dtype=dtype)
+        full[row_lo:row_hi] = mat
+        dev = resilient_shard_rows(full, mesh, pipeline="ingest")
+    else:
+        dev = resilient_shard_rows(mat, mesh, pipeline="ingest",
+                                   global_rows=plen)
+    return split_columns(dev, len(columns))
+
+
 def _resilient_put(arr, mesh):
     """Row-sharded placement behind the fault seam + shared transient
     retry (resilience.resilient_shard_rows → mesh.DataParallelPartitioner):

@@ -63,11 +63,17 @@ SKIPPED = EncodedColumn(T_STR, np.empty(0, dtype=object))
 
 
 def _tokens_sarr(data: bytes, starts: np.ndarray, lens: np.ndarray) -> np.ndarray:
-    """Vectorized token extraction: gather each cell's bytes into a
-    fixed-width S array (one numpy pass per slab, no per-cell Python)."""
+    """Token extraction: gather each cell's bytes into a fixed-width S
+    array. Native single-pass gather when the toolchain is up (a view
+    into the thread-local gather arena — consumed before the next gather
+    by every caller here), else the vectorized numpy slab loop."""
+    from h2o3_tpu import native
     n = len(starts)
     if n == 0:
         return np.empty(0, dtype="S1")
+    toks = native.gather_tokens(data, starts, lens)
+    if toks is not None:
+        return toks
     width = max(int(lens.max()), 1)
     buf = np.frombuffer(data, dtype=np.uint8)
     out = np.empty(n, dtype=f"S{width}")
@@ -129,6 +135,19 @@ def _encode_enum_offsets(data, starts: np.ndarray, lens: np.ndarray,
     starts = np.ascontiguousarray(starts, dtype=np.int64)
     lens = np.ascontiguousarray(lens, dtype=np.int32)
     has_esc = esc is not None and bool(esc.any())
+    # fast path: ONE released-GIL call does dictionary build, unescape,
+    # NA map, sorted-domain dedupe and final code remap; the only
+    # remaining Python is decoding the card domain labels
+    full = native.enum_encode_full(data, starts, lens, nas, max_card,
+                                   ENUM_NA, esc=esc if has_esc else None)
+    if full is not None:
+        codes, dom_rows, dom_esc = full
+        domain = []
+        for r, e in zip(dom_rows.tolist(), dom_esc.tolist()):
+            # native validated UTF-8 (else it declines) — strict decode
+            lab = bytes(data[starts[r]: starts[r] + lens[r]]).decode("utf-8")
+            domain.append(_unescape(lab) if e else lab)
+        return EncodedColumn(T_ENUM, codes, domain=domain)
     res = native.enum_encode(data, starts, lens,
                              max_card + len(nas or ()) + 1)
     if res is not None:
@@ -160,8 +179,14 @@ def _decode_str_offsets(data, starts: np.ndarray,
                         lens: np.ndarray, nas,
                         esc: Optional[np.ndarray] = None) -> np.ndarray:
     """Object array of str (None for NA strings) from (starts, lens)."""
+    from h2o3_tpu import native
+    # NA membership straight off the offsets (nogil) — no token
+    # materialization; falls back to isin over the gathered S array
+    isna = native.match_any(data, starts, lens,
+                            [s.encode("utf-8") for s in (nas or ())])
     toks = _tokens_sarr(data, starts, lens)
-    isna = np.isin(toks, _na_bytes(nas))
+    if isna is None:
+        isna = np.isin(toks, _na_bytes(nas))
     try:
         out = np.char.decode(toks, "utf-8").astype(object)
     except UnicodeDecodeError:
@@ -237,8 +262,12 @@ def _fast_iso_dates(toks: np.ndarray, isna: np.ndarray) -> Optional[np.ndarray]:
 
 
 def _encode_time_offsets(data, starts, lens, nas) -> np.ndarray:
+    from h2o3_tpu import native
+    isna = native.match_any(data, starts, lens,
+                            [s.encode("utf-8") for s in (nas or ())])
     toks = _tokens_sarr(data, starts, lens)
-    isna = np.isin(toks, _na_bytes(nas))
+    if isna is None:
+        isna = np.isin(toks, _na_bytes(nas))
     ms = _fast_iso_dates(toks, isna)
     if ms is not None:
         return ms
@@ -330,13 +359,24 @@ def encode_chunk_native(data, setup, skip_header: bool, stats=None
                if j not in skipped and vt in (T_REAL, T_INT)]
     num_pos = {j: t for t, j in enumerate(num_idx)}
     if num_idx:
-        block = vals[num_idx, r0:]
-        fin = np.isfinite(block)
-        allfin = (fin.all(axis=1) if block.size
-                  else np.ones(len(num_idx), bool))
-        with np.errstate(invalid="ignore"):
-            colmax = (np.abs(block).max(axis=1, initial=-np.inf, where=fin)
-                      if block.size else np.full(len(num_idx), -np.inf))
+        from h2o3_tpu import native
+        # one nogil pass gathers the selected columns out of the arena
+        # AND reduces finite/|max| per column (the fancy-index copy plus
+        # three full numpy re-walks it replaces all held the GIL)
+        nstats = native.numeric_stats(
+            vals, vals.strides[0] // vals.itemsize, num_idx, r0,
+            vals.shape[1] - r0)
+        if nstats is not None:
+            block, colmax, allfin = nstats
+        else:
+            block = vals[num_idx, r0:]
+            fin = np.isfinite(block)
+            allfin = (fin.all(axis=1) if block.size
+                      else np.ones(len(num_idx), bool))
+            with np.errstate(invalid="ignore"):
+                colmax = (np.abs(block).max(axis=1, initial=-np.inf,
+                                            where=fin)
+                          if block.size else np.full(len(num_idx), -np.inf))
     cols: List[EncodedColumn] = []
     for j, vt in enumerate(setup.column_types):
         if j in skipped:

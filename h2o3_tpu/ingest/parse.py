@@ -85,6 +85,11 @@ def _looks_time(tok: str) -> bool:
 def _read_head(path: str, nbytes: int = 1 << 16) -> str:
     with open(path, "rb") as f:
         raw = f.read(nbytes)
+    from h2o3_tpu.ingest.compress import detect_bytes, head_bytes
+    if detect_bytes(raw[:8]):
+        # compressed input: sample the DECOMPRESSED stream's head (the
+        # setup guess must see CSV text, not deflate bytes)
+        raw = head_bytes(path, nbytes)
     txt = raw.decode("utf-8", errors="replace")
     # drop a possibly-truncated last line
     if len(raw) == nbytes and "\n" in txt:
@@ -349,18 +354,113 @@ def _encode_range_native(buf, start: int, end: int, setup: ParseSetup,
     return out, pack
 
 
-def _encode_range_python(path: str, start: int, end: int, setup: ParseSetup,
+def _encode_range_python(src, start: int, end: int, setup: ParseSetup,
                          skip_header: bool):
     """Python-tokenizer worker (quote-correct csv.reader); the encode is
     still chunk-local and typed, so process workers pickle compact numpy
-    arrays back, never token lists."""
-    with open(path, "rb") as f:
-        f.seek(start)
-        text = f.read(end - start).decode("utf-8", errors="replace")
+    arrays back, never token lists. ``src`` is a file path OR a bytes
+    buffer — compressed inputs have no on-disk plaintext to reopen, so
+    their fallback ranges slice the decompressed buffer instead."""
+    if isinstance(src, (bytes, bytearray, memoryview)):
+        text = bytes(src[start:end]).decode("utf-8", errors="replace")
+    else:
+        with open(src, "rb") as f:
+            f.seek(start)
+            text = f.read(end - start).decode("utf-8", errors="replace")
     tokens = _parse_csv_text(text, setup, skip_header=skip_header)
     skipped = _skipped_set(setup)
     return [SKIPPED if j in skipped else encode_token_column(toks, vt)
             for j, (toks, vt) in enumerate(zip(tokens, setup.column_types))]
+
+
+def _proc_conf():
+    """(process_count, process_index) — the multihost seam. A separate
+    function so the parity test can monkeypatch it to force the
+    multi-process range plan on the single-process virtual-device mesh
+    (tests/test_ingest_pipeline.py)."""
+    import jax
+    return jax.process_count(), jax.process_index()
+
+
+def _multihost_plan(jobs, setup, mesh_cur, nproc: int, pidx: int,
+                    native_ok: bool, active):
+    """Shard-local range ownership for a multi-process parse: count each
+    byte range's rows natively (``csv_count_rows``, nogil), derive the
+    global row layout, and keep only the ranges whose rows land in THIS
+    process's data shards — closing the PR-7 every-host-parses-everything
+    gap. Returns None (counted by reason) when the plan cannot apply;
+    the parse then degrades to the full per-process parse, which is
+    always correct."""
+    from h2o3_tpu import native, telemetry
+    from h2o3_tpu.parallel.mesh import padded_len, partitioner
+
+    def _no(reason):
+        telemetry.counter(
+            "h2o3_ingest_fallback_total", {"reason": reason},
+            help="byte ranges re-parsed through the Python "
+                 "tokenizer, by decline reason").inc()
+        return None
+
+    if not native_ok:
+        return _no("multihost_no_native")
+    if any(setup.column_types[i] not in (T_REAL, T_INT, T_TIME)
+           for i in active):
+        # enum/str domains need a cross-process union exchange the
+        # assembly plane doesn't have yet — every process parses the
+        # full byte set (domain union stays process-local-complete)
+        return _no("multihost_schema")
+    counts = []
+    for p, buf, s, e, skip in jobs:
+        n = native.count_rows(memoryview(buf)[s:e], setup.separator,
+                              setup.quotechar or '"')
+        if n is None or n < 0:
+            return _no("multihost_uncountable")
+        counts.append(n - (1 if skip and n > 0 else 0))
+    nrow = sum(counts)
+    if nrow <= 0:
+        return _no("multihost_empty")
+    part = partitioner(mesh_cur)
+    plen = padded_len(nrow, mesh_cur)
+    bounds = part.row_bounds(plen)
+    mine = [d for d in range(part.n_data)
+            if part.shard_process(d, nproc) == pidx]
+    if not mine:
+        return _no("multihost_no_local_shard")
+    lo = min(bounds[d][0] for d in mine)
+    hi = max(bounds[d][1] for d in mine)
+    if hi - lo != sum(bounds[d][1] - bounds[d][0] for d in mine):
+        # a device order interleaving processes would make the local
+        # row set non-contiguous; process-local-data wants one block
+        return _no("multihost_noncontiguous")
+    local_jobs, trims = [], []
+    r0 = 0
+    for job, c in zip(jobs, counts):
+        r1 = r0 + c
+        a, b = max(r0, lo), min(r1, hi)
+        if a < b:
+            local_jobs.append(job)
+            trims.append((a - r0, b - r0))
+        r0 = r1
+    return {"jobs": local_jobs, "trims": trims,
+            "ranges_total": len(jobs), "nrow": nrow, "plen": plen,
+            "lo": lo, "hi": hi, "nproc": nproc, "pidx": pidx,
+            "local_bytes": sum(j[3] - j[2] for j in local_jobs)}
+
+
+def _trim_chunk(cols, a: int, b: int):
+    """Row-slice every column of one chunk's encode result to the
+    [a, b) rows this process owns (boundary ranges shared with a
+    neighbor process). Sliced columns drop their ``fmax`` reduction —
+    it covered rows the slice removed."""
+    out = []
+    for c in cols:
+        if c is SKIPPED:
+            out.append(c)
+            continue
+        out.append(EncodedColumn(
+            c.vtype, c.data[a:b], domain=c.domain,
+            exact=None if c.exact is None else c.exact[a:b]))
+    return out
 
 
 def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
@@ -399,7 +499,40 @@ def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
         t_all0 = time.perf_counter()
         jobs = []                      # (path, buf, start, end, skip_header)
         mm_by_path: Dict[str, object] = {}
+        comp_info: List[dict] = []
+        from h2o3_tpu.ingest.compress import decompress_path
+        from h2o3_tpu.ingest.compress import detect as _detect_comp
         for p in paths:
+            ckind = _detect_comp(p)
+            if ckind:
+                # compressed input plane: inflate to ONE contiguous host
+                # buffer (member-parallel when the format carries member
+                # boundaries — multi-member gzip, multi-frame zstd) and
+                # run the unchanged range planner / native tokenizer /
+                # RANGE-scoped fallback over the decompressed bytes.
+                # Degrades are visible, never silent: a single-stream
+                # gzip (no member boundaries to inflate in parallel) is
+                # counted by reason, not hidden in a slower parse.
+                data, cinfo = decompress_path(p, nw)
+                comp_info.append(cinfo)
+                if cinfo.get("reason"):
+                    telemetry.counter(
+                        "h2o3_ingest_fallback_total",
+                        {"reason": cinfo["reason"]},
+                        help="byte ranges re-parsed through the Python "
+                             "tokenizer, by decline reason").inc()
+                size = len(data)
+                if size >= _PARALLEL_PARSE_BYTES:
+                    mm_by_path[p] = data   # bytes quack like the mmap
+                    ranges = _byte_ranges(
+                        data,
+                        _range_count(size, nw, n_data_shards(mesh_cur)),
+                        setup)
+                    jobs += [(p, data, s, e, setup.header and s == 0)
+                             for s, e in ranges]
+                else:
+                    jobs.append((p, data, 0, size, setup.header))
+                continue
             size = os.path.getsize(p)
             if size >= _PARALLEL_PARSE_BYTES:
                 f = open(p, "rb")
@@ -426,6 +559,19 @@ def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
         native_ok = _native_available() and _na_strings_native_safe(setup)
         skipped = _skipped_set(setup)
         active = [i for i in range(len(setup.column_names)) if i not in skipped]
+        # multi-host shard-local parse: on a multi-process mesh each
+        # process keeps only the byte ranges whose rows land in its own
+        # data shards (native row counts drive the ownership map) and
+        # assembles via make_array_from_process_local_data — no plan
+        # (counted by reason) means every process parses everything,
+        # which is the always-correct PR-7 behavior
+        nproc, pidx = _proc_conf()
+        mh = None
+        if nproc > 1 and jobs:
+            mh = _multihost_plan(jobs, setup, mesh_cur, nproc, pidx,
+                                 native_ok, active)
+            if mh is not None:
+                jobs = mh["jobs"]
         # per-chunk H2D streaming (ROADMAP "per-CHUNK device_put" lever):
         # numeric/time columns transfer the moment their chunk finishes
         # tokenizing, double-buffered, and assemble device-side — the
@@ -449,6 +595,10 @@ def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
             stream_ok = True
         else:
             stream_ok = _jax.process_count() == 1
+        if mh is not None:
+            # the multihost assembly owns device placement (process-
+            # local row blocks); per-chunk streaming targets global rows
+            stream_ok = False
         stats = _StageStats()
 
         def _tokenize_native(jobs_):
@@ -498,7 +648,7 @@ def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
         results: List[Optional[List[EncodedColumn]]] = [None] * len(jobs)
         if native_ok:
             results, reasons, streamer = _tokenize_native(jobs)
-            if reasons and mm_by_path:
+            if reasons and mm_by_path and mh is None:
                 # quote-blind retry: a decline on a file whose quote
                 # probe came up empty, but which DOES hold a quote past
                 # the probe window, means the naive newline boundaries
@@ -582,22 +732,39 @@ def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
                 workers = min(len(todo), nw)
                 with cf.ProcessPoolExecutor(max_workers=workers,
                                             mp_context=ctx) as ex:
-                    futs = {k: ex.submit(_encode_range_python, jobs[k][0],
-                                         jobs[k][2], jobs[k][3], setup,
-                                         jobs[k][4])
+                    # mmapped files reopen by path in the worker (an
+                    # mmap won't pickle); decompressed buffers have no
+                    # on-disk plaintext, so their bytes ship instead
+                    futs = {k: ex.submit(
+                        _encode_range_python,
+                        jobs[k][1] if isinstance(jobs[k][1], bytes)
+                        else jobs[k][0],
+                        jobs[k][2], jobs[k][3], setup, jobs[k][4])
                             for k in todo}
                     for k, fu in futs.items():
                         results[k] = fu.result()
             else:
                 for k in todo:
                     p, buf, s, e, skip = jobs[k]
-                    results[k] = _encode_range_python(p, s, e, setup, skip)
+                    src = buf if isinstance(buf, bytes) else p
+                    results[k] = _encode_range_python(src, s, e, setup, skip)
             if streamer is not None:
                 # the re-parsed ranges join the stream late; every other
                 # range's already-uploaded device chunk SURVIVES (the
                 # wasted-work seam tests/test_ingest_pipeline.py guards)
                 for k in todo:
                     streamer.add(k, results[k])
+        if mh is not None:
+            # boundary ranges share rows with a neighbor process — keep
+            # only the rows this process's shards own (exact counts came
+            # from the native count pass, so trims are deterministic)
+            for k, (a, b) in enumerate(mh["trims"]):
+                cols = results[k]
+                if cols is None:
+                    continue
+                nr = next((len(c.data) for c in cols if c is not SKIPPED), 0)
+                if a > 0 or b < nr:
+                    results[k] = _trim_chunk(cols, a, b)
         t1 = time.perf_counter()
         # the streamed transfers ran INSIDE the tokenize window — report
         # tokenize net of that hidden transfer time so the two stages
@@ -647,9 +814,21 @@ def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
                            and setup.column_types[i] == T_ENUM])
 
         t2_wall = time.time()
-        fr = Frame.from_typed_column_groups(
-            names, _groups(), len(active), mesh=mesh,
-            key=key or os.path.basename(paths[0]), preset=preset)
+        if mh is not None:
+            # shard-local assembly: this process packs + transfers ONLY
+            # its own padded row block; the global array assembles from
+            # process-local data (ingest/stream.py multihost target)
+            from h2o3_tpu.ingest.stream import assemble_process_local
+            vec_map = assemble_process_local(
+                _merged(list(active)), mh["lo"], mh["hi"], mh["nrow"],
+                mesh_cur, simulate=_jax.process_count() != mh["nproc"])
+            mh["h2d_bytes"] = (mh["hi"] - mh["lo"]) * len(active) * 4
+            fr = Frame(names, [vec_map[j] for j in range(len(active))],
+                       key=key or os.path.basename(paths[0]))
+        else:
+            fr = Frame.from_typed_column_groups(
+                names, _groups(), len(active), mesh=mesh,
+                key=key or os.path.basename(paths[0]), preset=preset)
         t3 = time.perf_counter()
         # device_put = hidden per-chunk streaming + visible assembly/group
         # DMA, net of the interleaved domain-union work (the union spans
@@ -695,6 +874,19 @@ def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
                              "fallback_ranges": n_fallback,
                              "fallback_reasons": fb_reasons,
                              "streamed": streamer is not None,
+                             # compressed-input plane: per-file member
+                             # index + whether inflate ran member-parallel
+                             "compressed": comp_info or None,
+                             # multihost shard-local plan: which ranges
+                             # THIS process parsed and transferred
+                             "multihost": (None if mh is None else {
+                                 "nproc": mh["nproc"], "pidx": mh["pidx"],
+                                 "ranges_total": mh["ranges_total"],
+                                 "ranges_local": len(mh["jobs"]),
+                                 "rows_total": mh["nrow"],
+                                 "row_span": [mh["lo"], mh["hi"]],
+                                 "local_bytes": mh["local_bytes"],
+                                 "h2d_bytes": mh.get("h2d_bytes")}),
                              "scan_s": round(scan_s, 4),
                              "tokenize_cpu_s": round(stats.tokenize_s, 4),
                              "encode_cpu_s": round(stats.encode_s, 4),

@@ -23,34 +23,86 @@ per-import, counting each reason in ``h2o3_ingest_fallback_total``."""
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
+import warnings
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "fast_csv.cpp")
 _SO = os.path.join(_DIR, "libfastcsv.so")
+_HASH = _SO + ".srchash"  # sha256 of the source the .so was built from
+_COMPILER = "g++"
 _LOCK = threading.Lock()
 _LIB = None
 _TRIED = False
+
+# last failed build's diagnostic (compiler name + stderr tail); callers
+# that degrade to the Python path can surface WHY the toolchain bailed
+BUILD_ERROR = None
 
 # csv_parse reason codes -> the fallback-counter label (parse.py)
 DECLINE_REASONS = {1: "ragged_rows", 2: "unterminated_quote",
                    3: "trailing_after_quote"}
 
 
+def _src_hash() -> str:
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
 def _build() -> bool:
+    """Compile the .so and stamp the source hash it was built from. A
+    failed compile records a clear error NAMING the compiler (the silent
+    `return False` used to leave "why is ingest slow" undiagnosable)."""
+    global BUILD_ERROR
+    cmd = [_COMPILER, "-O3", "-march=native", "-shared", "-fPIC",
+           "-o", _SO + ".tmp", _SRC]
     try:
-        r = subprocess.run(
-            ["g++", "-O3", "-march=native", "-shared", "-fPIC",
-             "-o", _SO + ".tmp", _SRC],
-            capture_output=True, timeout=120)
-        if r.returncode != 0:
-            return False
-        os.replace(_SO + ".tmp", _SO)
-        return True
-    except (OSError, subprocess.SubprocessError):
+        r = subprocess.run(cmd, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError) as e:
+        BUILD_ERROR = (f"native CSV build failed: compiler '{_COMPILER}' "
+                       f"could not run ({e}); falling back to the Python "
+                       f"tokenizer")
+        warnings.warn(BUILD_ERROR, RuntimeWarning, stacklevel=2)
         return False
+    if r.returncode != 0:
+        tail = (r.stderr or b"").decode("utf-8", "replace").strip()[-800:]
+        BUILD_ERROR = (f"native CSV build failed: '{_COMPILER}' exited "
+                       f"{r.returncode} compiling {_SRC}:\n{tail}")
+        warnings.warn(BUILD_ERROR, RuntimeWarning, stacklevel=2)
+        return False
+    os.replace(_SO + ".tmp", _SO)
+    try:
+        with open(_HASH + ".tmp", "w") as f:
+            f.write(_src_hash())
+        os.replace(_HASH + ".tmp", _HASH)
+    except OSError:
+        pass  # hash sidecar is advisory; mtime still catches most edits
+    BUILD_ERROR = None
+    return True
+
+
+def _stale() -> bool:
+    """Rebuild-if-stale guard: CONTENT hash of fast_csv.cpp against the
+    sidecar stamped at build time. mtime alone served stale symbols when
+    a checkout/copy stamped the .so newer than an edited source (git
+    checkout, rsync, build caches) — with new entry points landing per
+    PR that silently pinned callers to an old ABI."""
+    if not os.path.exists(_SO):
+        return True
+    try:
+        with open(_HASH) as f:
+            built_from = f.read().strip()
+    except OSError:
+        # pre-hash .so (or lost sidecar): fall back to the mtime check
+        # once; the rebuild it triggers writes the sidecar
+        try:
+            return os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+        except OSError:
+            return True
+    return built_from != _src_hash()
 
 
 def lib():
@@ -60,8 +112,7 @@ def lib():
         if _LIB is not None or _TRIED:
             return _LIB
         _TRIED = True
-        if not os.path.exists(_SO) or (
-                os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+        if _stale():
             if not _build():
                 return None
         for attempt in range(2):
@@ -71,26 +122,39 @@ def lib():
                 return None
             LL, VP = ctypes.c_longlong, ctypes.c_void_p
             pLL = ctypes.POINTER(ctypes.c_longlong)
+            pI = ctypes.POINTER(ctypes.c_int)
+            pD = ctypes.POINTER(ctypes.c_double)
+            pU8 = ctypes.POINTER(ctypes.c_ubyte)
             try:
                 L.csv_parse.restype = LL
                 L.csv_parse.argtypes = [VP, LL, ctypes.c_char,
                                         ctypes.c_char, LL, LL, VP, pLL,
-                                        ctypes.POINTER(ctypes.c_int),
-                                        ctypes.POINTER(ctypes.c_double),
-                                        ctypes.POINTER(ctypes.c_ubyte),
-                                        pLL, pLL]
+                                        pI, pD, pU8, pLL, pLL]
                 L.csv_chunk_bounds.restype = LL
                 L.csv_chunk_bounds.argtypes = [VP, LL, ctypes.c_char,
                                                ctypes.c_char, pLL, LL, pLL]
                 L.csv_enum_encode.restype = LL
-                L.csv_enum_encode.argtypes = [
-                    VP, pLL, ctypes.POINTER(ctypes.c_int), LL,
-                    ctypes.POINTER(ctypes.c_int), pLL, LL]
+                L.csv_enum_encode.argtypes = [VP, pLL, pI, LL, pI, pLL, LL]
+                L.csv_gather_tokens.restype = None
+                L.csv_gather_tokens.argtypes = [VP, pLL, pI, LL, LL, VP]
+                L.csv_match_any.restype = None
+                L.csv_match_any.argtypes = [VP, pLL, pI, LL,
+                                            VP, pLL, pI, LL, pU8]
+                L.csv_numeric_stats.restype = None
+                L.csv_numeric_stats.argtypes = [pD, LL, pLL, LL, LL, LL,
+                                                pD, pD, pU8]
+                L.csv_count_rows.restype = LL
+                L.csv_count_rows.argtypes = [VP, LL, ctypes.c_char,
+                                             ctypes.c_char]
+                L.csv_enum_encode_full.restype = LL
+                L.csv_enum_encode_full.argtypes = [
+                    VP, pLL, pI, LL, VP, VP, pLL, pI, LL, LL,
+                    ctypes.c_int, pI, pLL, pU8]
             except AttributeError:
-                # a stale .so whose mtime beat the source (a fresh
-                # checkout stamps both): missing symbols mean the binary
-                # is from another era — rebuild once, then give up (the
-                # ABI check is the SYMBOL SET; a same-symbol signature
+                # a stale .so that slipped BOTH the hash sidecar and the
+                # mtime check: missing symbols mean the binary is from
+                # another era — rebuild once, then give up (the ABI
+                # check is the SYMBOL SET; a same-symbol signature
                 # change must ride a new symbol or this check is blind)
                 if attempt == 0 and _build():
                     continue
@@ -266,3 +330,176 @@ def enum_encode(data, starts, lens, max_card: int):
     if card < 0:
         return None
     return codes, uniq[:card]
+
+
+# ---- nogil encode plane (ISSUE 16) ----------------------------------
+
+def _ptr(a, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def _gather_arena(nbytes: int):
+    """Thread-local gather arena (token S-arrays, match flags): reused
+    across calls like the parse scratch, so a worker's per-column
+    gathers stop round-tripping the allocator. Same contract: consume
+    the returned view before the next gather on this thread."""
+    import numpy as np
+    buf = getattr(_TLS, "gather", None)
+    if buf is None or buf.size < nbytes:
+        buf = np.empty(max(nbytes, 1 << 16), np.uint8)
+        _TLS.gather = buf
+    return buf
+
+
+def arena_bytes() -> int:
+    """This thread's total scratch-arena footprint (parse + gather), for
+    the profiler's per-worker memory attribution."""
+    total = 0
+    bufs = getattr(_TLS, "bufs", None)
+    if bufs is not None:
+        total += sum(b.nbytes for b in bufs)
+    g = getattr(_TLS, "gather", None)
+    if g is not None:
+        total += g.nbytes
+    return total
+
+
+def _pack_patterns(pats):
+    """Concatenate byte patterns (NA strings) into (buf, offs, lens)."""
+    import numpy as np
+    bs = [p if isinstance(p, bytes) else str(p).encode("utf-8")
+          for p in pats]
+    offs = np.zeros(max(len(bs), 1), np.int64)
+    lens = np.zeros(max(len(bs), 1), np.int32)
+    o = 0
+    for k, b in enumerate(bs):
+        offs[k] = o
+        lens[k] = len(b)
+        o += len(b)
+    return b"".join(bs) or b"\0", offs, lens
+
+
+def gather_tokens(data, starts, lens, width: int = None):
+    """Fixed-width token gather into an ``S{width}`` array — the native
+    spelling of the numpy slab loop (_tokens_sarr). Returns a view into
+    the thread-local gather arena (consume before the next call on this
+    thread), or None without the toolchain."""
+    import numpy as np
+    L = lib()
+    if L is None:
+        return None
+    buf = _as_u8(data)
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    lens = np.ascontiguousarray(lens, dtype=np.int32)
+    n = len(starts)
+    if n == 0:
+        return np.empty(0, dtype="S1")
+    if width is None:
+        width = max(int(lens.max()), 1)
+    out = _gather_arena(n * width)[:n * width]
+    L.csv_gather_tokens(buf.ctypes.data, _ptr(starts, ctypes.c_longlong),
+                        _ptr(lens, ctypes.c_int), n, width,
+                        out.ctypes.data)
+    return out.view(f"S{width}")
+
+
+def match_any(data, starts, lens, patterns):
+    """Per-cell membership flags (bool array): cell bytes equal to any
+    pattern — the NA-string test, without materializing tokens. None
+    without the toolchain."""
+    import numpy as np
+    L = lib()
+    if L is None:
+        return None
+    buf = _as_u8(data)
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    lens = np.ascontiguousarray(lens, dtype=np.int32)
+    n = len(starts)
+    out = np.zeros(n, np.uint8)
+    if n and patterns:
+        pat_buf, offs, plens = _pack_patterns(patterns)
+        pat = np.frombuffer(pat_buf, np.uint8)
+        L.csv_match_any(buf.ctypes.data, _ptr(starts, ctypes.c_longlong),
+                        _ptr(lens, ctypes.c_int), n,
+                        pat.ctypes.data, _ptr(offs, ctypes.c_longlong),
+                        _ptr(plens, ctypes.c_int), len(patterns),
+                        _ptr(out, ctypes.c_ubyte))
+    return out.view(bool)
+
+
+def numeric_stats(vals, col_stride: int, col_idx, r0: int, nrows: int):
+    """Detach selected numeric columns from the column-major parse arena
+    and reduce them in one nogil pass. Returns ``(block, fmax, allfin)``
+    — an owned ``[k, nrows]`` float64 block, per-column finite |max|
+    (-inf when none), and per-column all-finite flags — or None without
+    the toolchain."""
+    import numpy as np
+    L = lib()
+    if L is None:
+        return None
+    col_idx = np.ascontiguousarray(col_idx, dtype=np.int64)
+    k = len(col_idx)
+    block = np.empty((k, nrows), np.float64)
+    fmax = np.empty(k, np.float64)
+    allfin = np.empty(k, np.uint8)
+    L.csv_numeric_stats(_ptr(vals, ctypes.c_double), col_stride,
+                        _ptr(col_idx, ctypes.c_longlong), k, r0, nrows,
+                        _ptr(block, ctypes.c_double),
+                        _ptr(fmax, ctypes.c_double),
+                        _ptr(allfin, ctypes.c_ubyte))
+    return block, fmax, allfin.view(bool)
+
+
+def count_rows(data, sep: str, quote: str = '"'):
+    """Quote-aware row count of a buffer (csv_parse's row accounting,
+    no per-cell work) — the multi-host range planner's cheap pass.
+    Returns the count, or None (toolchain missing / open quote)."""
+    L = lib()
+    if L is None:
+        return None
+    buf = _as_u8(data)
+    got = L.csv_count_rows(buf.ctypes.data, buf.size, sep.encode()[0:1],
+                           (quote or '"').encode()[0:1])
+    return int(got) if got >= 0 else None
+
+
+def enum_encode_full(data, starts, lens, nas, max_card: int,
+                     na_code: int, esc=None):
+    """Full native enum encode: dictionary build, ""-unescape, NA map,
+    sorted-domain dedupe and final code remap in one released-GIL call.
+    Returns ``(codes int32, dom_rows int64, dom_esc bool)`` where entry
+    ``k`` of ``dom_rows``/``dom_esc`` locates a representative cell for
+    the k-th SORTED domain label (the caller decodes card labels — the
+    only per-label Python left). None when the native path declines
+    (no toolchain, cardinality above ``max_card``, or a non-UTF-8 label
+    whose sort order native bytes cannot reproduce)."""
+    import numpy as np
+    L = lib()
+    if L is None:
+        return None
+    buf = _as_u8(data)
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    lens = np.ascontiguousarray(lens, dtype=np.int32)
+    n = len(starts)
+    nas = list(nas or ())
+    max_card = min(max_card, max(n, 1))
+    codes = np.empty(n, np.int32)
+    dom_rows = np.empty(max_card + 1, np.int64)
+    dom_esc = np.empty(max_card + 1, np.uint8)
+    esc_ptr = 0
+    if esc is not None:
+        esc = np.ascontiguousarray(esc, dtype=np.uint8)
+        esc_ptr = esc.ctypes.data
+    pat_buf, offs, plens = _pack_patterns(nas)
+    pat = np.frombuffer(pat_buf, np.uint8)
+    card = L.csv_enum_encode_full(
+        buf.ctypes.data, _ptr(starts, ctypes.c_longlong),
+        _ptr(lens, ctypes.c_int), n, esc_ptr,
+        pat.ctypes.data, _ptr(offs, ctypes.c_longlong),
+        _ptr(plens, ctypes.c_int), len(nas),
+        max_card, na_code,
+        _ptr(codes, ctypes.c_int), _ptr(dom_rows, ctypes.c_longlong),
+        _ptr(dom_esc, ctypes.c_ubyte))
+    if card < 0:
+        return None
+    return codes, dom_rows[:card], dom_esc[:card].view(bool)

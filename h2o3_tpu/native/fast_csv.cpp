@@ -32,6 +32,7 @@
 // at the end of the range, or non-whitespace trailing a closing quote
 // return a reason code and ONLY that byte range re-parses through the
 // Python tokenizer (parse.py fallback seam).
+#include <algorithm>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -226,6 +227,40 @@ inline bool fast_atod(const char* p, long long n, double* out) {
 // decline reasons shared by csv_parse / the binding
 enum { DECLINE_OK = 0, DECLINE_RAGGED = 1, DECLINE_OPEN_QUOTE = 2,
        DECLINE_TRAILING_QUOTE = 3 };
+
+// Strict UTF-8 validation (rejects overlongs, surrogates, > U+10FFFF).
+// Used by csv_enum_encode_full: byte-lexicographic order over VALID
+// UTF-8 equals Python's code-point order over the decoded strings, so
+// the native sort can stand in for sorted() on the domain — any invalid
+// label instead declines the whole column back to the Python path
+// (whose errors='replace' decode has no byte-order guarantee).
+inline bool valid_utf8(const unsigned char* p, long long n) {
+    long long i = 0;
+    while (i < n) {
+        unsigned char c = p[i];
+        if (c < 0x80) { ++i; continue; }
+        int k;
+        if ((c & 0xE0) == 0xC0) k = 1;
+        else if ((c & 0xF0) == 0xE0) k = 2;
+        else if ((c & 0xF8) == 0xF0) k = 3;
+        else return false;
+        if (i + k >= n) return false;
+        for (int j = 1; j <= k; ++j)
+            if ((p[i + j] & 0xC0) != 0x80) return false;
+        if (k == 1 && c < 0xC2) return false;                   // overlong
+        if (k == 2) {
+            if (c == 0xE0 && p[i + 1] < 0xA0) return false;     // overlong
+            if (c == 0xED && p[i + 1] >= 0xA0) return false;    // surrogate
+        }
+        if (k == 3) {
+            if (c == 0xF0 && p[i + 1] < 0x90) return false;     // overlong
+            if (c > 0xF4 || (c == 0xF4 && p[i + 1] >= 0x90))
+                return false;                                   // > U+10FFFF
+        }
+        i += k + 1;
+    }
+    return true;
+}
 
 }  // namespace
 
@@ -509,6 +544,265 @@ long long csv_enum_encode(const char* buf,
         }
     }
     return card;
+}
+
+// ---- nogil encode plane (ISSUE 16) ----------------------------------
+//
+// These entry points move the last GIL-held numpy glue of
+// ingest/chunk.py (S-array gathers, NA membership, per-column
+// reductions, the enum sort/remap) into released-GIL native passes so
+// N parse workers scale to N cores — the chunk worker keeps only
+// bookkeeping.
+
+// Fixed-width token gather: out[i*width .. ] = the cell's bytes,
+// zero-padded (the numpy S-array layout _tokens_sarr built through a
+// slab of fancy-index passes). One memcpy per cell, no index matrix.
+void csv_gather_tokens(const char* buf, const long long* starts,
+                       const int* lens, long long n, long long width,
+                       char* out) {
+    memset(out, 0, (size_t)(n * width));
+    for (long long i = 0; i < n; ++i) {
+        int m = lens[i];
+        if (m > 0) {
+            if (m > width) m = (int)width;
+            memcpy(out + i * width, buf + starts[i], (size_t)m);
+        }
+    }
+}
+
+// Membership flags: out[i] = 1 when cell i's bytes equal any of the
+// n_pat patterns (concatenated in pat_buf at pat_offs/pat_lens) — the
+// NA-string test np.isin ran over the gathered S array.
+void csv_match_any(const char* buf, const long long* starts,
+                   const int* lens, long long n,
+                   const char* pat_buf, const long long* pat_offs,
+                   const int* pat_lens, long long n_pat,
+                   unsigned char* out) {
+    for (long long i = 0; i < n; ++i) {
+        unsigned char hit = 0;
+        const char* p = buf + starts[i];
+        int m = lens[i];
+        for (long long k = 0; k < n_pat && !hit; ++k)
+            if (pat_lens[k] == m
+                    && memcmp(pat_buf + pat_offs[k], p, (size_t)m) == 0)
+                hit = 1;
+        out[i] = hit;
+    }
+}
+
+// Numeric column detach + reductions in ONE pass: gather the selected
+// columns' row slices [r0, r0+nrows) out of the column-major scratch
+// arena (stride col_stride) into an owned [ncols_sel, nrows] block, and
+// compute per column the finite |max| (fmax_out, -inf when no finite
+// cell) and an all-finite flag — the isfinite/all/abs-max numpy passes
+// that each re-walked the block under the GIL.
+void csv_numeric_stats(const double* vals, long long col_stride,
+                       const long long* col_idx, long long ncols_sel,
+                       long long r0, long long nrows,
+                       double* out_block, double* fmax_out,
+                       unsigned char* allfin_out) {
+    for (long long t = 0; t < ncols_sel; ++t) {
+        const double* src = vals + col_idx[t] * col_stride + r0;
+        double* dst = out_block + t * nrows;
+        memcpy(dst, src, (size_t)nrows * sizeof(double));
+        double fmax = -INFINITY;
+        unsigned char allfin = 1;
+        for (long long i = 0; i < nrows; ++i) {
+            double v = dst[i];
+            if (std::isfinite(v)) {
+                double a = v < 0 ? -v : v;
+                if (a > fmax) fmax = a;
+            } else {
+                allfin = 0;
+            }
+        }
+        fmax_out[t] = fmax;
+        allfin_out[t] = allfin;
+    }
+}
+
+// Quote-aware row count: the SAME row-accounting as csv_parse (a row
+// closes at an outside-quote newline when it saw any content; a
+// content-bearing tail without a newline counts) with no per-cell
+// work — the multi-host range planner's one cheap pass. Returns the
+// row count, or -1 when a quote is left open (the caller cannot trust
+// a count over a range it would decline).
+long long csv_count_rows(const char* buf, long long len, char sep,
+                         char quote) {
+    long long r = 0, cidx = 0;
+    bool any = false, at_start = true, in_row = false;
+    long long i = 0;
+    while (i < len) {
+        char c = buf[i];
+        if (c == quote && at_start) {
+            ++i;
+            for (;;) {
+                if (i >= len) return -1;          // open quote
+                if (buf[i] == quote) {
+                    if (i + 1 < len && buf[i + 1] == quote) { i += 2; continue; }
+                    ++i; break;
+                }
+                ++i;
+            }
+            any = true; at_start = false; in_row = true;
+            continue;
+        }
+        if (c == '\n') {
+            if (any || cidx > 0) ++r;
+            cidx = 0; any = false; at_start = true; in_row = false;
+        } else if (c == sep) {
+            ++cidx; at_start = true; in_row = true;
+        } else {
+            if (c != '\r') { any = true; in_row = true; }
+            at_start = false;
+        }
+        ++i;
+    }
+    if ((any || cidx > 0) && in_row) ++r;
+    return r;
+}
+
+// Full enum encode: hash-dictionary build, ""-unescape, NA-string
+// mapping, byte-lexicographic domain sort + dedupe, and the final
+// code remap — ONE native pass chain replacing the per-label
+// bytes.decode loop, sorted(set()), rank-LUT build and lut[codes] take
+// that _codes_from_labels ran under the GIL. Outputs: codes[i] = rank
+// of cell i's label in the SORTED deduped domain (NA cells = na_code);
+// dom_rows[k] / dom_esc[k] = a representative cell row (+ its escape
+// flag) for domain entry k, from which the caller decodes the label
+// text (O(card), the only Python left). Returns the domain cardinality,
+// -1 when it would exceed max_card (string fallback), or -2 when a
+// label is not valid UTF-8 (byte order no longer matches Python's
+// sorted(); caller takes the Python path).
+long long csv_enum_encode_full(const char* buf, const long long* starts,
+                               const int* lens, long long n,
+                               const unsigned char* esc,
+                               const char* na_buf, const long long* na_offs,
+                               const int* na_lens, long long n_na,
+                               long long max_card, int na_code,
+                               int* codes, long long* dom_rows,
+                               unsigned char* dom_esc) {
+    // phase 1: raw-byte dictionary (first-appearance ids), same
+    // open-addressing scheme as csv_enum_encode. Raw cardinality is
+    // allowed a small overhead above max_card: NA labels and ""-escape
+    // aliases collapse before the final count.
+    const long long raw_cap_card = max_card + n_na + 1;
+    std::vector<long long> uniq;                 // first row per raw id
+    uniq.reserve(raw_cap_card < 4096 ? raw_cap_card : 4096);
+    long long cap = 1024;
+    std::vector<long long> table(cap, -1);
+    for (long long i = 0; i < n; ++i) {
+        long long card = (long long)uniq.size();
+        if (card * 10 >= cap * 7) {
+            cap <<= 1;
+            table.assign(cap, -1);
+            for (long long k = 0; k < card; ++k) {
+                long long r = uniq[k];
+                long long j = fnv1a(buf + starts[r], lens[r]) & (cap - 1);
+                while (table[j] >= 0) j = (j + 1) & (cap - 1);
+                table[j] = k;
+            }
+        }
+        const char* p = buf + starts[i];
+        int len = lens[i];
+        long long j = fnv1a(p, len) & (cap - 1);
+        for (;;) {
+            long long e = table[j];
+            if (e < 0) {
+                if (card >= raw_cap_card) return -1;
+                uniq.push_back(i);
+                table[j] = card;
+                codes[i] = (int)card;
+                break;
+            }
+            long long r = uniq[e];
+            if (lens[r] == len && memcmp(buf + starts[r], p, len) == 0) {
+                codes[i] = (int)e;
+                break;
+            }
+            j = (j + 1) & (cap - 1);
+        }
+    }
+    const long long raw_card = (long long)uniq.size();
+    // phase 2: per-unique label view — unescaped into a side arena when
+    // the representative cell carries "" escapes — then UTF-8 validate.
+    std::vector<char> arena;
+    std::vector<long long> l_off(raw_card), l_len(raw_card);
+    std::vector<unsigned char> l_in_arena(raw_card, 0);
+    for (long long k = 0; k < raw_card; ++k) {
+        long long r = uniq[k];
+        const char* p = buf + starts[r];
+        int m = lens[r];
+        if (esc && esc[r] && m >= 2) {
+            long long o = (long long)arena.size();
+            for (int t = 0; t < m; ++t) {
+                arena.push_back(p[t]);
+                if (p[t] == '"' && t + 1 < m && p[t + 1] == '"') ++t;
+            }
+            l_off[k] = o;
+            l_len[k] = (long long)arena.size() - o;
+            l_in_arena[k] = 1;
+        } else {
+            l_off[k] = starts[r];
+            l_len[k] = m;
+        }
+    }
+    auto label = [&](long long k) -> const char* {
+        return (l_in_arena[k] ? arena.data() + l_off[k] : buf + l_off[k]);
+    };
+    for (long long k = 0; k < raw_card; ++k)
+        if (!valid_utf8((const unsigned char*)label(k), l_len[k]))
+            return -2;
+    // phase 3: NA membership on the unescaped label bytes (the decoded
+    // string equality test `lab in nas`, moved to bytes — exact for
+    // valid UTF-8 since the NA strings arrive UTF-8 encoded).
+    std::vector<unsigned char> is_na(raw_card, 0);
+    for (long long k = 0; k < raw_card; ++k) {
+        const char* p = label(k);
+        long long m = l_len[k];
+        for (long long t = 0; t < n_na; ++t)
+            if (na_lens[t] == m
+                    && memcmp(na_buf + na_offs[t], p, (size_t)m) == 0) {
+                is_na[k] = 1;
+                break;
+            }
+    }
+    // phase 4: byte-lexicographic sort of the non-NA raw ids (== code
+    // point order == Python sorted() on the decoded labels), deduping
+    // escape aliases that unescaped to the same bytes.
+    std::vector<long long> order;
+    order.reserve(raw_card);
+    for (long long k = 0; k < raw_card; ++k)
+        if (!is_na[k]) order.push_back(k);
+    std::sort(order.begin(), order.end(),
+              [&](long long a, long long b) {
+                  long long la = l_len[a], lb = l_len[b];
+                  int c = memcmp(label(a), label(b),
+                                 (size_t)(la < lb ? la : lb));
+                  if (c != 0) return c < 0;
+                  return la < lb;
+              });
+    std::vector<int> lut(raw_card, na_code);
+    long long dom = 0;
+    for (size_t t = 0; t < order.size(); ++t) {
+        long long k = order[t];
+        if (t > 0) {
+            long long pk = order[t - 1];
+            if (l_len[pk] == l_len[k]
+                    && memcmp(label(pk), label(k), (size_t)l_len[k]) == 0) {
+                lut[k] = lut[pk];                // escape alias: same label
+                continue;
+            }
+        }
+        if (dom >= max_card) return -1;
+        dom_rows[dom] = uniq[k];
+        dom_esc[dom] = (esc && esc[uniq[k]]) ? 1 : 0;
+        lut[k] = (int)dom;
+        ++dom;
+    }
+    // phase 5: final remap — one pass, no Python.
+    for (long long i = 0; i < n; ++i) codes[i] = lut[codes[i]];
+    return dom;
 }
 
 }  // extern "C"

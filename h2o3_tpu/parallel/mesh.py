@@ -213,6 +213,18 @@ class DataParallelPartitioner:
         per = padded_rows // self.n_data
         return [(d * per, (d + 1) * per) for d in range(self.n_data)]
 
+    def shard_process(self, shard: int, nproc: Optional[int] = None):
+        """Process owning data-shard ``shard`` — the multihost parse's
+        range-ownership map (each process tokenizes only byte ranges
+        whose rows land in its own shards). On a real multi-process
+        mesh this is the home device's ``process_index``; under a
+        SIMULATED process count (the parity test forcing the
+        multi-process range plan on the single-process virtual mesh)
+        shards split evenly and contiguously across ``nproc``."""
+        if nproc is None or nproc == jax.process_count():
+            return int(getattr(self.home_device(shard), "process_index", 0))
+        return shard * nproc // self.n_data
+
     # -- per-shard step observation (collective/straggler metrics) ------
 
     def observe_step(self, out, t_dispatch: float, *, algo: str = "train"):

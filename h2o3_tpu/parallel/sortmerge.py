@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from h2o3_tpu import telemetry
 from h2o3_tpu.parallel.mesh import DATA_AXIS, current_mesh, n_data_shards
 
 _PAD = jnp.uint32(0xFFFFFFFF)       # exchange padding: sorts after all
@@ -117,7 +118,7 @@ def distributed_sort(x: jnp.ndarray, mesh=None) -> np.ndarray:
     P = n_data_shards(mesh)
     n = x.shape[0]
     if P == 1 or n % P != 0:
-        return np.asarray(jax.device_get(jnp.sort(jnp.asarray(x))))
+        return np.asarray(telemetry.device_get(jnp.sort(jnp.asarray(x))))
     per = n // P
     from jax.sharding import PartitionSpec as Ps
 
@@ -126,10 +127,10 @@ def distributed_sort(x: jnp.ndarray, mesh=None) -> np.ndarray:
         mesh=mesh, in_specs=Ps(DATA_AXIS),
         out_specs=(Ps(DATA_AXIS), None), check_vma=False))
     keys, _ = fn(jnp.asarray(x))
-    host = np.asarray(jax.device_get(keys)).reshape(P, P * per)
+    host = np.asarray(telemetry.device_get(keys)).reshape(P, P * per)
     parts = [h[h != 0xFFFFFFFF] for h in host]       # drop PAD, keep order
     bits = np.concatenate(parts)
-    return np.asarray(jax.device_get(bits_to_float(jnp.asarray(bits))))
+    return np.asarray(telemetry.device_get(bits_to_float(jnp.asarray(bits))))
 
 
 def distributed_argsort(x: jnp.ndarray, mesh=None) -> np.ndarray:
@@ -142,7 +143,7 @@ def distributed_argsort(x: jnp.ndarray, mesh=None) -> np.ndarray:
     n = x.shape[0]
     if P == 1 or n % P != 0:
         kb = sortable_bits(jnp.asarray(x))
-        return np.asarray(jax.device_get(jnp.argsort(kb, stable=True)))
+        return np.asarray(telemetry.device_get(jnp.argsort(kb, stable=True)))
     per = n // P
     from jax.sharding import PartitionSpec as Ps
     ids = jnp.arange(n, dtype=jnp.int32)
@@ -157,8 +158,8 @@ def distributed_argsort(x: jnp.ndarray, mesh=None) -> np.ndarray:
                                out_specs=(Ps(DATA_AXIS), Ps(DATA_AXIS)),
                                check_vma=False))
     keys, pay = fn(jnp.asarray(x), ids)
-    kh = np.asarray(jax.device_get(keys)).reshape(P, P * per)
-    ph = np.asarray(jax.device_get(pay)).reshape(P, P * per)
+    kh = np.asarray(telemetry.device_get(keys)).reshape(P, P * per)
+    ph = np.asarray(telemetry.device_get(pay)).reshape(P, P * per)
     parts = [p[k != 0xFFFFFFFF] for k, p in zip(kh, ph)]
     return np.concatenate(parts).astype(np.int64)
 
@@ -182,4 +183,4 @@ def join_indices_unique(left_keys, right_keys, nright: int) -> np.ndarray:
         hit = (rb_s[pos_c] == lb) & (lb != _NAN)
         return jnp.where(hit, order[pos_c].astype(jnp.int32), -1)
 
-    return np.asarray(jax.device_get(probe(rb, lb)))
+    return np.asarray(telemetry.device_get(probe(rb, lb)))

@@ -104,14 +104,17 @@ def resilient_device_put(arr, sharding=None, *, site: str = "h2d",
         _put, site=site if pipeline is None else f"{pipeline}.h2d")
 
 
-def resilient_shard_rows(arr, mesh=None, *, pipeline: Optional[str] = None):
+def resilient_shard_rows(arr, mesh=None, *, pipeline: Optional[str] = None,
+                         global_rows: Optional[int] = None):
     """Row-shard a padded host array over the mesh data axis behind the
     same ``h2d`` fault seam + transient retry as
     :func:`resilient_device_put`. This is the partitioner-aware spelling
     every frame-column placement goes through — on a multi-process mesh
     it assembles the global array from process-local rows
     (``jax.make_array_from_process_local_data``) instead of a plain
-    ``device_put``."""
+    ``device_put``. ``global_rows`` is the multihost-ingest spelling:
+    ``arr`` is this process's LOCAL row block of a ``global_rows``-row
+    global array (mesh.shard_rows docs)."""
     from h2o3_tpu.parallel.mesh import partitioner
 
     part = partitioner(mesh)
@@ -119,7 +122,7 @@ def resilient_shard_rows(arr, mesh=None, *, pipeline: Optional[str] = None):
     def _put():
         if faults.ACTIVE:
             faults.check("h2d", pipeline=pipeline)
-        return part.shard_rows(arr)
+        return part.shard_rows(arr, global_rows=global_rows)
 
     return retry_transient(
         _put, site="h2d" if pipeline is None else f"{pipeline}.h2d")

@@ -464,3 +464,153 @@ def test_rollup_no_recompile_across_nrow():
     assert r1["rows"] == n1 and r2["rows"] == n2
     assert r1["mean"] == pytest.approx((n1 - 1) / 2)
     assert r2["max"] == pytest.approx(2 * (n2 - 1))
+
+
+# ---------------- ISSUE 16: nogil enum encode / compressed / multihost --
+
+
+def test_enum_encode_parity_matrix(tmp_path, monkeypatch):
+    # the nogil native enum encode must bit-match the Python encode on
+    # its hard cases IN ONE FILE: NA labels, duplicate labels recurring
+    # across byte ranges (domain-union code remap), >64KiB labels
+    # (arena slab growth), and quoted cells straddling range boundaries
+    big_a = "L" * (70 * 1024)
+    big_b = "M" * (66 * 1024) + ",tail"          # >64KiB AND quoted
+    labels = ["alpha", "beta", "NA", '"q,uoted"']
+    lines = ["g,x"]
+    for i in range(600):
+        if i == 3:
+            lab = big_a
+        elif i == 590:
+            lab = f'"{big_b}"'
+        else:
+            lab = labels[i % len(labels)]
+        lines.append(f"{lab},{i}")
+    p = tmp_path / "enum.csv"
+    p.write_bytes(("\n".join(lines) + "\n").encode("utf-8"))
+    setup = parse_setup(str(p))
+    monkeypatch.setattr(parse_mod, "_PARALLEL_PARSE_BYTES", 1)
+    fr_native = parse([str(p)], setup)
+    if not parse_mod._native_available():
+        pytest.skip("native tokenizer unavailable in this image")
+    assert parse_mod.LAST_PROFILE["chunks"] > 1
+    assert parse_mod.LAST_PROFILE["native"], \
+        parse_mod.LAST_PROFILE["fallback_reasons"]
+    assert parse_mod.LAST_PROFILE["fallback_ranges"] == 0
+    g = fr_native.vec("g")
+    assert big_a in g.domain and big_b in g.domain
+    assert g.na_count() > 0                      # NA labels stayed NA
+    monkeypatch.setattr(parse_mod, "_native_available", lambda: False)
+    fr_python = parse([str(p)], setup)
+    assert not parse_mod.LAST_PROFILE["native"]
+    _frames_equal(fr_native, fr_python)
+
+
+@pytest.mark.parametrize("fmt", ["gzip", "zstd"])
+def test_compressed_member_parallel_bit_equal(tmp_path, monkeypatch, fmt):
+    # member/frame-parallel compressed ingest: multi-member gzip and
+    # multi-frame zstd inflate through the index plan, range-parse the
+    # decompressed buffer, and come out bit-identical to the plain file
+    # with ZERO whole-import fallbacks
+    from h2o3_tpu.ingest.compress import (gzip_compress_members,
+                                          zstd_compress_store)
+    csv = _mixed_csv()
+    plain = tmp_path / "plain.csv"
+    plain.write_text(csv)
+    fr_plain = parse([str(plain)], parse_setup(str(plain)))
+    if fmt == "gzip":
+        cp = tmp_path / "data.csv.gz"
+        cp.write_bytes(gzip_compress_members(csv.encode(), member_bytes=1024))
+    else:
+        cp = tmp_path / "data.csv.zst"
+        cp.write_bytes(zstd_compress_store(csv.encode(), frame_bytes=1024))
+    monkeypatch.setattr(parse_mod, "_PARALLEL_PARSE_BYTES", 1)
+    fr_c = parse([str(cp)], parse_setup(str(cp)))
+    comp = parse_mod.LAST_PROFILE["compressed"][0]
+    assert comp["format"] == fmt
+    assert comp["members"] > 1 and comp["parallel"]
+    assert parse_mod.LAST_PROFILE["chunks"] > 1
+    if parse_mod._native_available():
+        assert parse_mod.LAST_PROFILE["fallback_ranges"] == 0
+    _frames_equal(fr_plain, fr_c)
+
+
+def test_gzip_single_stream_degrades_counted(tmp_path):
+    # a single-member gzip can't member-parallelize: ingest degrades to
+    # one serial inflate, counts the reason, and still parses correctly
+    import gzip as _gz
+
+    from h2o3_tpu import telemetry
+    csv = _mixed_csv(nrow=80)
+    cp = tmp_path / "single.csv.gz"
+    cp.write_bytes(_gz.compress(csv.encode(), 6, mtime=0))
+    c0 = telemetry.registry().value(
+        "h2o3_ingest_fallback_total", {"reason": "gzip_single_stream"})
+    fr = parse([str(cp)], parse_setup(str(cp)))
+    comp = parse_mod.LAST_PROFILE["compressed"][0]
+    assert comp["members"] == 1 and not comp["parallel"]
+    assert comp["reason"] == "gzip_single_stream"
+    assert telemetry.registry().value(
+        "h2o3_ingest_fallback_total",
+        {"reason": "gzip_single_stream"}) == c0 + 1
+    assert fr.nrow == 80
+    plain = tmp_path / "single.csv"
+    plain.write_text(csv)
+    _frames_equal(parse([str(plain)], parse_setup(str(plain))), fr)
+
+
+def test_multihost_shard_local_parse_parity(tmp_path, monkeypatch):
+    # multi-host shard-local parse, simulated on the single-process
+    # mesh via the _proc_conf seam: each "process" tokenizes ONLY the
+    # byte ranges whose rows land in its shards, the per-process H2D
+    # counter sees only the local block, and the stitched row spans are
+    # bit-identical to the single-process parse
+    from h2o3_tpu import telemetry
+    rng = np.random.default_rng(5)
+    lines = ["x,y,z"]
+    for i in range(800):
+        x = "NA" if i % 97 == 13 else f"{rng.normal():.6f}"
+        lines.append(f"{x},{i},{i * 0.25}")
+    p = tmp_path / "mh.csv"
+    p.write_text("\n".join(lines) + "\n")
+    setup = parse_setup(str(p))
+    if not parse_mod._native_available():
+        pytest.skip("native tokenizer unavailable in this image")
+    fr_single = parse([str(p)], setup)
+    monkeypatch.setattr(parse_mod, "_PARALLEL_PARSE_BYTES", 1)
+    frames, profs = [], []
+    for pidx in range(2):
+        monkeypatch.setattr(parse_mod, "_proc_conf",
+                            lambda pidx=pidx: (2, pidx))
+        h0 = telemetry.registry().value(
+            "h2o3_h2d_pipeline_bytes_total", {"pipeline": "ingest"})
+        fr = parse([str(p)], setup)
+        h1 = telemetry.registry().value(
+            "h2o3_h2d_pipeline_bytes_total", {"pipeline": "ingest"})
+        prof = parse_mod.LAST_PROFILE["multihost"]
+        assert prof is not None, parse_mod.LAST_PROFILE["fallback_reasons"]
+        assert prof["nproc"] == 2 and prof["pidx"] == pidx
+        assert prof["rows_total"] == 800
+        # shard-local: this process tokenized a strict subset of ranges
+        assert 0 < prof["ranges_local"] < prof["ranges_total"]
+        # per-process H2D attribution: exactly the local block's bytes
+        assert h1 - h0 == prof["h2d_bytes"]
+        frames.append(fr)
+        profs.append(prof)
+    # the two spans are disjoint, contiguous, and start at row 0
+    s0, s1 = profs[0]["row_span"], profs[1]["row_span"]
+    assert s0[0] == 0 and s0[1] == s1[0]
+    assert s1[1] >= 800                          # padded tail included
+    for n in fr_single.names:
+        ref = fr_single.vec(n).to_numpy()
+        for fr, (lo, hi) in zip(frames, (s0, s1)):
+            hi = min(hi, fr_single.nrow)
+            got = fr.vec(n).to_numpy()[lo:hi]
+            want = ref[lo:hi]
+            if got.dtype.kind == "f":
+                np.testing.assert_array_equal(
+                    np.isnan(got), np.isnan(want), err_msg=n)
+                np.testing.assert_array_equal(
+                    got[~np.isnan(got)], want[~np.isnan(want)], err_msg=n)
+            else:
+                np.testing.assert_array_equal(got, want, err_msg=n)

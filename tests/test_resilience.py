@@ -596,6 +596,23 @@ def test_persist_uri_download_retries(monkeypatch, tmp_path):
         assert f.read().startswith("a,b")
 
 
+def test_compressed_ingest_decompress_retries(tmp_path):
+    """The ``decompress`` fault seam: a transient storage hiccup on the
+    compressed-ingest read retries through the shared backoff and the
+    import still succeeds bit-for-bit."""
+    from h2o3_tpu.ingest.compress import gzip_compress_members
+    csv = "a,b\n" + "".join(f"{i},{i * 0.5}\n" for i in range(200))
+    gz = tmp_path / "t.csv.gz"
+    gz.write_bytes(gzip_compress_members(csv.encode(), member_bytes=256))
+    faults.configure("decompress@ingest:every=1:times=1:exc=IOError")
+    fr = h2o.import_file(str(gz))       # first attempt faults, retry wins
+    assert fr.nrow == 200
+    assert np.asarray(fr.vec("b").to_numpy()).reshape(-1)[3] == 1.5
+    assert telemetry.registry().value(
+        "h2o3_retry_total", {"site": "ingest.decompress"}) > 0
+    assert faults.fired_total() == 1
+
+
 def test_transient_classification():
     assert resilience.is_transient(faults.Unavailable("UNAVAILABLE: x"))
     assert resilience.is_transient(RuntimeError("INTERNAL: device halt"))

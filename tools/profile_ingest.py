@@ -74,37 +74,12 @@ def _synth_csv(path, rows, ncol_num, ncol_enum, ncol_time):
     log(f"csv written in {time.time() - t0:.1f}s")
 
 
-def main(argv=None):
-    ap = argparse.ArgumentParser(
-        description="profile the ingest parse pipeline per stage")
-    ap.add_argument("--rows", type=int,
-                    default=int(os.environ.get("ROWS", 2_000_000)))
-    ap.add_argument("--cols", type=int,
-                    default=int(os.environ.get("NCOL_NUM", 6)),
-                    help="numeric column count of the synthetic CSV")
-    ap.add_argument("--enum-cols", type=int,
-                    default=int(os.environ.get("NCOL_ENUM", 2)))
-    ap.add_argument("--time-cols", type=int,
-                    default=int(os.environ.get("NCOL_TIME", 1)))
-    ap.add_argument("--csv", default=os.environ.get("CSV"),
-                    help="reuse an existing CSV instead of synthesizing")
-    args = ap.parse_args(argv)
-
+def _profile_once(path, setup):
+    """Run ONE measured parse of ``path`` and return the stage-split
+    dict (the JSON-line payload). Factored out so the ``--workers``
+    sweep reruns the identical measurement under each pool size."""
     from h2o3_tpu import telemetry
-    from h2o3_tpu.ingest.parse import LAST_PROFILE, parse, parse_setup
-
-    telemetry.install()
-    if not telemetry.enabled():
-        log("H2O3_TELEMETRY=0: stage attribution unavailable — stage "
-            "fields will be null (re-run with telemetry enabled)")
-    path = args.csv or os.path.join(
-        tempfile.gettempdir(),
-        f"h2o3_profile_ingest_{args.rows}x{args.cols}"
-        f"_{args.enum_cols}_{args.time_cols}.csv")
-    if not os.path.exists(path):
-        _synth_csv(path, args.rows, args.cols, args.enum_cols,
-                   args.time_cols)
-    setup = parse_setup(path)
+    from h2o3_tpu.ingest.parse import LAST_PROFILE, parse
 
     # counters are cumulative — diff against the pre-run snapshot
     h2d0 = telemetry.registry().value("h2o3_h2d_bytes_total")
@@ -165,6 +140,101 @@ def main(argv=None):
            "parse_rows_per_s": round(fr.nrow / wall, 1),
            "parse_mb_per_s": round(nbytes / 1e6 / wall, 1),
            "xprof_trace_dir": last_trace_dir()}
+    return out
+
+
+def _gil_wait_estimate(out, workers):
+    """Estimated thread-seconds the tokenize_encode pool spent NOT
+    running Python/C work: ``workers`` threads were nominally live for
+    the stage's wall time, and the worker stats say how many CPU-seconds
+    they actually burned — the gap is GIL contention + pool idle. A
+    nogil-healthy encode keeps this near zero as workers grow; a
+    GIL-bound one grows it linearly."""
+    te = out.get("tokenize_encode_s")
+    cpu = (out.get("tokenize_cpu_s") or 0.0) + (out.get("encode_cpu_s")
+                                                or 0.0)
+    if te is None or cpu <= 0.0:
+        return None
+    return round(max(0.0, workers * te - cpu), 4)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="profile the ingest parse pipeline per stage")
+    ap.add_argument("--rows", type=int,
+                    default=int(os.environ.get("ROWS", 2_000_000)))
+    ap.add_argument("--cols", type=int,
+                    default=int(os.environ.get("NCOL_NUM", 6)),
+                    help="numeric column count of the synthetic CSV")
+    ap.add_argument("--enum-cols", type=int,
+                    default=int(os.environ.get("NCOL_ENUM", 2)))
+    ap.add_argument("--time-cols", type=int,
+                    default=int(os.environ.get("NCOL_TIME", 1)))
+    ap.add_argument("--csv", default=os.environ.get("CSV"),
+                    help="reuse an existing CSV instead of synthesizing")
+    ap.add_argument("--workers", default=os.environ.get("WORKERS"),
+                    help="comma list of pool sizes (e.g. 1,4,8,16): "
+                         "rerun the parse per size and report the "
+                         "scaling + GIL-wait table")
+    args = ap.parse_args(argv)
+
+    from h2o3_tpu import telemetry
+    from h2o3_tpu.ingest.parse import parse_setup
+
+    telemetry.install()
+    if not telemetry.enabled():
+        log("H2O3_TELEMETRY=0: stage attribution unavailable — stage "
+            "fields will be null (re-run with telemetry enabled)")
+    path = args.csv or os.path.join(
+        tempfile.gettempdir(),
+        f"h2o3_profile_ingest_{args.rows}x{args.cols}"
+        f"_{args.enum_cols}_{args.time_cols}.csv")
+    if not os.path.exists(path):
+        _synth_csv(path, args.rows, args.cols, args.enum_cols,
+                   args.time_cols)
+    setup = parse_setup(path)
+    nbytes = os.path.getsize(path)
+
+    if args.workers:
+        # worker-scaling sweep: same file, same setup, pool size forced
+        # per run via the env knob parse() reads. The per-size GIL-wait
+        # estimate is the nogil-encode scaling artifact ISSUE 16 asks
+        # for: flat ≈0 means the native encode really released the GIL.
+        sizes = [int(w) for w in str(args.workers).split(",") if w]
+        prev = os.environ.get("H2O3_INGEST_WORKERS")
+        sweep = []
+        try:
+            for w in sizes:
+                os.environ["H2O3_INGEST_WORKERS"] = str(w)
+                r = _profile_once(path, setup)
+                sweep.append({
+                    "workers": w,
+                    "parse_mb_per_s": r["parse_mb_per_s"],
+                    "tokenize_encode_s": r.get("tokenize_encode_s"),
+                    "tokenize_cpu_s": r.get("tokenize_cpu_s"),
+                    "encode_cpu_s": r.get("encode_cpu_s"),
+                    "gil_wait_est_s": _gil_wait_estimate(r, w),
+                    "fallback_ranges": r.get("fallback_ranges")})
+        finally:
+            if prev is None:
+                os.environ.pop("H2O3_INGEST_WORKERS", None)
+            else:
+                os.environ["H2O3_INGEST_WORKERS"] = prev
+        log(f"\n  workers   MB/s   tok+enc wall   cpu-s   GIL-wait est")
+        for s in sweep:
+            te = s["tokenize_encode_s"]
+            cpu = (s["tokenize_cpu_s"] or 0) + (s["encode_cpu_s"] or 0)
+            gw = s["gil_wait_est_s"]
+            log(f"  {s['workers']:>7} {s['parse_mb_per_s']:>6.1f}"
+                f"   {te if te is not None else float('nan'):>12.3f}"
+                f"   {cpu:>5.2f}"
+                f"   {gw if gw is not None else float('nan'):>12.3f}")
+        out = {"bytes": nbytes, "csv": path, "worker_sweep": sweep}
+        print(json.dumps(out))
+        return out
+
+    out = _profile_once(path, setup)
+    wall = out["parse_wall_s"]
 
     # the "where does the next 2x live" table: per-stage seconds and
     # effective MB/s over the file's bytes (wall stages are additive;
