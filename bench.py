@@ -228,6 +228,78 @@ def _streamed_guard_round():
         memman.reset()
 
 
+def _fused_level_round():
+    """Multi-level fused dispatch round (ISSUE 17): time the STREAMED
+    binned level loop — the path whose per-level host dispatch + sync
+    the fused L-level window collapses (the dense chunk body already
+    traced its whole loop into one executable, so the headline number
+    cannot show this seam). Two legs at identical config, codes and
+    bytes/row: H2O3_LEVELS_PER_PASS=1 reproduces the exact pre-fusion
+    structure (one dispatch + one host sync per level — what every
+    round before r10 ran), the default leg is the fused window. Small
+    rows on purpose: the metric guards the dispatch/sync overhead per
+    level, which is what dominates when per-level device work is thin
+    (the deep-tree tail, fleet-shared chips, preempt-windowed trains).
+    Best-of-3 warm loops per leg; the fused leg's level-pass throughput
+    is the recorded train.level_loop_rows_per_sec."""
+    import h2o3_tpu as h2o
+    from h2o3_tpu import memman
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    rng = np.random.default_rng(17)
+    n, F, trees, depth = 20_000, 28, 8, 6
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    logit = X[:, 0] + 0.5 * X[:, 1] * X[:, 2]
+    cols = {f"x{i}": X[:, i] for i in range(F)}
+    cols["resp"] = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)),
+                            "y", "n")
+    x_bytes = n * F * 4
+    common = dict(ntrees=trees, max_depth=depth, nbins=14, seed=7,
+                  distribution="bernoulli", learn_rate=0.1,
+                  score_tree_interval=0, stopping_rounds=0,
+                  min_rows=1.0, packed_codes=True)
+
+    def leg():
+        warm = H2OGradientBoostingEstimator(**common)
+        warm.train(y="resp", training_frame=fr)
+        best, lpd = None, None
+        for _ in range(3):
+            m = H2OGradientBoostingEstimator(**common)
+            m.train(y="resp", training_frame=fr)
+            o = m.model.output
+            if not o.get("streamed"):
+                return None, None
+            t = o["training_loop_seconds"]
+            best = t if best is None else min(best, t)
+            lpd = o.get("levels_per_dispatch")
+        return n * trees * depth / best, lpd
+
+    prev = os.environ.pop("H2O3_LEVELS_PER_PASS", None)
+    try:
+        # budget below frame+design forces streaming; the resident
+        # window still holds the whole code matrix (single chunk), the
+        # configuration where windows fuse into one dispatch
+        memman.reset(budget=int(2.2 * x_bytes))
+        fr = h2o.Frame.from_numpy(cols)
+        os.environ["H2O3_LEVELS_PER_PASS"] = "1"
+        per_level, _ = leg()
+        del os.environ["H2O3_LEVELS_PER_PASS"]
+        fused, lpd = leg()
+        if per_level is None or fused is None:
+            return {"ran": False,
+                    "reason": "budget did not force streaming"}
+        return {"ran": True, "rows": n, "trees": trees, "depth": depth,
+                "levels_per_dispatch": lpd,
+                "level_loop_rows_per_sec": round(fused, 1),
+                "per_level_rows_per_sec": round(per_level, 1),
+                "speedup_vs_per_level": round(fused / per_level, 3)}
+    finally:
+        if prev is not None:
+            os.environ["H2O3_LEVELS_PER_PASS"] = prev
+        else:
+            os.environ.pop("H2O3_LEVELS_PER_PASS", None)
+        memman.reset()
+
+
 def _serve_round(model, fr, F):
     """Serving benchmark (ISSUE 3): deploy the trained GBM, measure
     single-row request latency (p50/p99 through the full
@@ -460,6 +532,18 @@ def main():
     bt = train_perf.get("bytes_total")
     out["train.hot_loop_bytes_per_row_tree"] = (
         round(bt / (ROWS * max(built, 1)), 2) if bt else None)
+    # multi-level fused dispatch (ISSUE 17): levels_per_dispatch = how
+    # many tree levels one host dispatch grows (the dense chunk body
+    # fuses the whole tree; the streamed driver windows by the
+    # H2O3_LEVELS_PER_PASS VMEM budget). level_loop_rows_per_sec is
+    # recorded by _fused_level_round below — it counts LEVEL PASSES
+    # (rows x trees x depth / loop_s) through the STREAMED level loop,
+    # the path whose per-level dispatch + host sync the fused window
+    # collapses, with an in-round H2O3_LEVELS_PER_PASS=1 leg
+    # reproducing the pre-fusion structure at identical codes/bytes
+    # per row for the speedup attribution.
+    out["train.levels_per_dispatch"] = gbm.model.output.get(
+        "levels_per_dispatch")
     if train_perf:
         log(f"train perf: mfu={train_perf.get('mfu')} "
             f"regime={train_perf.get('roofline_regime')} "
@@ -480,6 +564,17 @@ def main():
             log(f"streamed h2d guard: {guard}")
         except Exception as e:  # guard must never sink the headline run
             log(f"streamed h2d guard FAILED to run: {e!r}")
+    if os.environ.get("H2O3_BENCH_FUSED_LEVELS", "1") not in ("0", "false",
+                                                              ""):
+        try:
+            fl = _fused_level_round()
+            out["train.fused_level_round"] = fl
+            if fl.get("ran"):
+                out["train.level_loop_rows_per_sec"] = (
+                    fl["level_loop_rows_per_sec"])
+            log(f"fused level round: {fl}")
+        except Exception as e:  # guard must never sink the headline run
+            log(f"fused level round FAILED to run: {e!r}")
     # chaos round (ISSUE 6): train+serve under injected faults, guarding
     # the recovery machinery (retry, checkpoint resume, OOM degrade,
     # circuit breaker) the same way transfer budgets are guarded.

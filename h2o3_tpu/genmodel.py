@@ -384,14 +384,14 @@ def pojo_source(model, class_name: Optional[str] = None) -> str:
     nested if/else descent, a score0 summing them. Compiles against
     h2o-genmodel's GenModel when a JDK is present; golden-file checked
     otherwise."""
-    import jax
+    from h2o3_tpu import telemetry
     algo = model.algo
     cls = class_name or f"{algo}_pojo_{abs(hash(model.key)) % 10 ** 8}"
-    feat = np.asarray(jax.device_get(model._feat))
-    thr = np.asarray(jax.device_get(model._thr))
-    nal = np.asarray(jax.device_get(model._na_left))
-    spl = np.asarray(jax.device_get(model._is_split))
-    val = np.asarray(jax.device_get(model._value))
+    # one counted pytree fetch for the codegen arrays (export-time D2H
+    # must show up in the transfer budgets like every other fetch)
+    feat, thr, nal, spl, val = (np.asarray(a) for a in telemetry.device_get(
+        (model._feat, model._thr, model._na_left, model._is_split,
+         model._value), pipeline="export"))
     K = model.nclasses if model.nclasses > 2 else 1
     T = model.ntrees_built
     names = list(model.feature_names)
@@ -907,11 +907,10 @@ def export_mojo_isofor(model, path: str) -> str:
     """IsolationForest MOJO: the v1.40 compressed-tree format the tree
     writer already emits (hex/genmodel/algos/isofor/IsolationForest
     MojoModel reads trees + min/max path length)."""
-    import jax
+    from h2o3_tpu import telemetry
     from h2o3_tpu.mojo import _compress_tree
-    feat = np.asarray(jax.device_get(model._feat))
-    thr = np.asarray(jax.device_get(model._thr))
-    spl = np.asarray(jax.device_get(model._is_split))
+    feat, thr, spl = (np.asarray(a) for a in telemetry.device_get(
+        (model._feat, model._thr, model._is_split), pipeline="export"))
     T = feat.shape[0]
     nal = np.zeros_like(spl)
     M = feat.shape[1]

@@ -573,11 +573,17 @@ def parse(paths: Union[str, Sequence[str]], setup: Optional[ParseSetup] = None,
             if mh is not None:
                 jobs = mh["jobs"]
         # per-chunk H2D streaming (ROADMAP "per-CHUNK device_put" lever):
-        # numeric/time columns transfer the moment their chunk finishes
-        # tokenizing, double-buffered, and assemble device-side — the
-        # host-side full-column concat disappears for those groups
+        # numeric/time/enum columns transfer the moment their chunk
+        # finishes tokenizing, double-buffered, and assemble device-side
+        # — the host-side full-column concat disappears for those
+        # groups. Enum lanes carry chunk-LOCAL codes (exact in f32);
+        # only the domain union stays host-side, the code remap into the
+        # union runs on device at assembly (ingest/stream.py). String
+        # columns and enum columns that promote to string keep the host
+        # merge.
         stream_cols = [i for i in active
-                       if setup.column_types[i] in (T_REAL, T_INT, T_TIME)]
+                       if setup.column_types[i] in (T_REAL, T_INT, T_TIME,
+                                                    T_ENUM)]
         # streaming engages on ANY single-process mesh: single-shard
         # meshes use the device-concat path, multi-data-shard meshes
         # place each chunk's put on its HOME shard device and stitch the

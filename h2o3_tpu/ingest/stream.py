@@ -9,8 +9,22 @@ numeric/time columns finish encoding, its float32 pack matrix is
 ``device_put`` IMMEDIATELY (bounded in-flight depth, double-buffer
 style), and the sharded column arrays are assembled DEVICE-side with one
 ``jnp.concatenate`` — the host-side full-column merge disappears for
-numeric/time groups. String/enum columns keep the host merge (their
-domain union is inherently global).
+numeric/time groups.
+
+Enum columns stream too (ROADMAP ingest tail): each chunk's CHUNK-LOCAL
+int32 codes ride the same f32 pack matrix (exact — codes are bounded by
+MAX_ENUM_CARDINALITY = 1M < 2^24, NA = -1), so their H2D overlaps the
+tokenize window like the numeric lanes and is attributed to the same
+counters. Only the DOMAIN UNION stays host-side (it is inherently
+global, and domains are tiny next to codes); the code remap into the
+union happens device-side at assembly via a per-chunk-sectioned LUT
+gather — NOT numpy's trailing ``lut[-1]`` NA trick, which does not port
+(JAX clamps negative gather indices), but a +1-shifted LUT whose slot 0
+per chunk section holds ENUM_NA. Chunks that blow a chunk-local
+cardinality cap (T_STR surprise) or whose union exceeds
+MAX_ENUM_CARDINALITY condemn the column to the host merge
+(``fallback_cols``), which promotes it to string exactly as before.
+String columns never stream.
 
 Host shadows stay exact: time columns concatenate their int64 millis
 (8B/row, the only remaining host concat), integral columns beyond
@@ -43,7 +57,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from h2o3_tpu.frame.vec import T_INT, T_REAL, T_TIME, Vec
+from h2o3_tpu.frame.vec import ENUM_NA, T_ENUM, T_INT, T_REAL, T_TIME, Vec
 
 # max chunk pack matrices with an un-awaited device_put in flight: chunk
 # k+1 tokenizes/packs while chunk k's DMA drains, chunk k+2 waits — the
@@ -83,6 +97,18 @@ def prepack_chunk(col_ids, cols) -> PrepackedChunk:
             # same arithmetic as Vec.from_numpy's time path: f64
             # seconds, converted to f32 by the pack assignment
             mat[:, j] = np.where(ms == Vec.TIME_NA, np.nan, ms / 1000.0)
+            continue
+        if c.vtype == T_ENUM:
+            # chunk-LOCAL int32 codes as exact f32 (|code| < 2^24 by the
+            # MAX_ENUM_CARDINALITY cap; NA = -1); remap to the global
+            # domain happens device-side at assembly
+            mat[:, j] = c.data
+            continue
+        if c.data.dtype == object:
+            # a declared-enum lane that blew the chunk-local cardinality
+            # cap and came back as strings: lane is dead weight, add()
+            # condemns the column to the host merge
+            mat[:, j] = np.nan
             continue
         f64 = c.data
         mat[:, j] = f64              # assignment converts f64 -> f32
@@ -161,6 +187,12 @@ class ChunkDeviceStreamer:
         # concatenated column so the rule stays identical to the merge path
         self._fmax: Dict[int, float] = {i: float("-inf") for i in col_ids}
         self._exact: set = set()              # cols forced to host merge
+        # enum streaming: chunk-local domains (col -> chunk -> labels);
+        # the union + device remap happen at assemble. Columns whose
+        # chunks carry a T_STR surprise (chunk-local cardinality blowout)
+        # or whose union blows MAX_ENUM_CARDINALITY join the host merge.
+        self._domains: Dict[int, Dict[int, List[str]]] = {}
+        self._enum_fb: set = set()            # enum cols forced to host merge
         self.add_seconds = 0.0                # transfer time hidden under tokenize
         self.assemble_seconds = 0.0           # visible (post-tokenize) time
         self.h2d_bytes = 0
@@ -192,6 +224,15 @@ class ChunkDeviceStreamer:
             if c.vtype == T_TIME:
                 self._time_ms.setdefault(i, {})[chunk_idx] = np.asarray(
                     c.data, dtype=np.int64)
+                continue
+            if self.col_types[i] == T_ENUM:
+                if c.vtype != T_ENUM:
+                    # chunk blew the chunk-local cardinality cap → the
+                    # merged column promotes to string; host merge owns it
+                    self._enum_fb.add(i)
+                elif i not in self._enum_fb:
+                    self._domains.setdefault(i, {})[chunk_idx] = list(
+                        c.domain or ())
                 continue
             if i in self._exact:
                 continue
@@ -258,15 +299,59 @@ class ChunkDeviceStreamer:
         self._inflight.clear()
         self._time_ms.clear()
         self._f64.clear()
+        self._domains.clear()
 
     # -- final assembly --------------------------------------------------
 
     @property
     def fallback_cols(self) -> set:
-        """Columns whose chunks carried wide-int ``exact`` shadows: the
-        merged device value must come from the resolved int64, so they
-        go through the host merge path."""
-        return set(self._exact)
+        """Columns the host merge must finish: wide-int ``exact``
+        shadows (device value must come from the resolved int64) and
+        enum columns with a string surprise or a domain-union blowout."""
+        return set(self._exact) | set(self._enum_fb)
+
+    def _resolve_enum_unions(self) -> Dict[int, tuple]:
+        """Union every streamed enum column's chunk-local domains (the
+        host half of _merge_enum — domains are tiny next to codes).
+        Returns ``{col: (union, [per-chunk domains in row order])}``;
+        columns whose union blows MAX_ENUM_CARDINALITY move to
+        ``_enum_fb`` instead (the host merge promotes them to string)."""
+        from h2o3_tpu.ingest.chunk import MAX_ENUM_CARDINALITY
+        unions: Dict[int, tuple] = {}
+        for i in self.col_ids:
+            if self.col_types[i] != T_ENUM or i in self._enum_fb:
+                continue
+            per = self._domains.get(i, {})
+            doms = [per[k] for k in sorted(per)]
+            union = sorted(set().union(*doms)) if doms else []
+            if len(union) > MAX_ENUM_CARDINALITY:
+                self._enum_fb.add(i)
+                continue
+            unions[i] = (union, doms)
+        return unions
+
+    def _enum_remap_aux(self, union, doms):
+        """Host-side LUT for the device-side enum remap: one section per
+        chunk, ``1 + len(domain)`` slots each, slot 0 = ENUM_NA. A local
+        code ``c`` in chunk ``k`` resolves at ``lut[base[k] + 1 + c]`` —
+        the +1 shift serves the NA code (-1) as slot 0, because JAX
+        clamps negative gather indices (numpy's trailing ``lut[-1]`` NA
+        trick in _merge_enum does NOT port). Returns (lut, base) or
+        (None, None) when every chunk already matches the union (codes
+        are global already — _merge_enum's fast path)."""
+        if all(d == union for d in doms):
+            return None, None
+        gidx = {lab: g for g, lab in enumerate(union)}
+        luts, base, off = [], [], 0
+        for d in doms:
+            base.append(off)
+            sec = np.empty(1 + len(d), np.int32)
+            sec[0] = ENUM_NA
+            for j, lab in enumerate(d):
+                sec[1 + j] = gidx[lab]
+            luts.append(sec)
+            off += len(sec)
+        return np.concatenate(luts), np.asarray(base, np.int32)
 
     def _host_shadow(self, i: int):
         """Exact float64 host copy when the column needs one — decided by
@@ -379,14 +464,32 @@ class ChunkDeviceStreamer:
         from h2o3_tpu import telemetry
         from h2o3_tpu.parallel.mesh import padded_len
         from h2o3_tpu.resilience import resilient_device_put
-        mats = [self._devs.pop(k) for k in sorted(self._devs)]
+        order = sorted(self._devs)
+        mats = [self._devs.pop(k) for k in order]
+        unions = self._resolve_enum_unions()
         plen = padded_len(nrow, self.mesh)
         pad = (np.full(plen - nrow, np.nan, np.float32)
                if plen > nrow else None)
         keep = [(j, i) for j, i in enumerate(self.col_ids)
-                if i not in self._exact]
+                if i not in self._exact and i not in self._enum_fb]
         host_cols = []
         for j, i in keep:
+            if self.col_types[i] == T_ENUM:
+                # chunk-local f32 codes → int32, remap into the union
+                # with the sectioned LUT (exact _merge_enum semantics),
+                # pad with ENUM_NA; uploads int32 in the same batch
+                union, doms = unions[i]
+                lut, base = self._enum_remap_aux(union, doms)
+                parts = []
+                for k, m in enumerate(mats):
+                    codes = m[:, j].astype(np.int32)
+                    parts.append(codes if lut is None
+                                 else lut[base[k] + 1 + codes])
+                if pad is not None:
+                    parts.append(np.full(plen - nrow, ENUM_NA, np.int32))
+                host_cols.append(np.concatenate(parts) if len(parts) > 1
+                                 else parts[0])
+                continue
             parts = [m[:, j] for m in mats]
             if pad is not None:
                 parts.append(pad)
@@ -406,6 +509,8 @@ class ChunkDeviceStreamer:
                 parts = [self._time_ms[i][k] for k in sorted(self._time_ms[i])]
                 ms = parts[0] if len(parts) == 1 else np.concatenate(parts)
                 out[i] = Vec(col, nrow, T_TIME, host_data=ms)
+            elif vt == T_ENUM:
+                out[i] = Vec(col, nrow, T_ENUM, domain=tuple(unions[i][0]))
             else:
                 out[i] = Vec(col, nrow, vt, host_data=self._host_shadow(i))
         self._f64.clear()
@@ -453,11 +558,15 @@ class ChunkDeviceStreamer:
                     axis=0)
             full = jax.device_put(  # h2o3-lint: allow[transfer-seam] blessed commit site: reshard of already-device-resident data (D2D, no host bytes)
                 full, partitioner(self.mesh).data_sharding)
+        from h2o3_tpu import telemetry
         from h2o3_tpu.frame.vec import split_columns
+        from h2o3_tpu.resilience import resilient_device_put
+        unions = self._resolve_enum_unions()
         cols = split_columns(full, C)   # one compiled dispatch, not C
         out: Dict[int, Vec] = {}
+        cv_dev = None                   # row -> chunk index, built lazily
         for j, i in enumerate(self.col_ids):
-            if i in self._exact:
+            if i in self._exact or i in self._enum_fb:
                 continue
             col = cols[j]
             vt = self.col_types[i]
@@ -465,6 +574,34 @@ class ChunkDeviceStreamer:
                 parts = [self._time_ms[i][k] for k in sorted(self._time_ms[i])]
                 ms = parts[0] if len(parts) == 1 else np.concatenate(parts)
                 out[i] = Vec(col, nrow, T_TIME, host_data=ms)
+            elif vt == T_ENUM:
+                union, doms = unions[i]
+                lut, base = self._enum_remap_aux(union, doms)
+                # NaN pad rows -> -1 -> slot 0 of chunk 0's LUT section
+                # (ENUM_NA) — same sentinel the int32 Vec pad contract uses
+                codes = jnp.nan_to_num(
+                    col, nan=float(ENUM_NA)).astype(jnp.int32)
+                if lut is not None:
+                    if cv_dev is None:
+                        ordr = sorted(self._rows)
+                        cv = np.zeros(full.shape[0], np.int32)
+                        cv[:nrow] = np.repeat(
+                            np.arange(len(ordr), dtype=np.int32),
+                            [self._rows[k] for k in ordr])
+                        telemetry.record_h2d(cv.nbytes, pipeline="ingest")
+                        self.h2d_bytes += cv.nbytes
+                        cv_dev = resilient_device_put(
+                            cv, self.part.data_sharding, pipeline="ingest")
+                    telemetry.record_h2d(lut.nbytes + base.nbytes,
+                                         pipeline="ingest")
+                    self.h2d_bytes += lut.nbytes + base.nbytes
+                    lut_dev = resilient_device_put(lut, None,
+                                                   pipeline="ingest")
+                    base_dev = resilient_device_put(base, None,
+                                                    pipeline="ingest")
+                    codes = jnp.take(lut_dev,
+                                     codes + 1 + jnp.take(base_dev, cv_dev))
+                out[i] = Vec(codes, nrow, T_ENUM, domain=tuple(union))
             else:
                 out[i] = Vec(col, nrow, vt, host_data=self._host_shadow(i))
         self._f64.clear()

@@ -600,6 +600,9 @@ class H2ORandomForestEstimator(ModelBuilder):
             W=pc.W if packed else None,
             bytes_per_value=pc.itemsize if packed else None,
             n_bins=bm.n_bins if packed else None)
+        # the DRF chunk body (like GBM dense) traces its whole level
+        # loop into one executable — all levels per dispatch
+        model.output["levels_per_dispatch"] = int(cfg.max_depth)
         if perf_acc is not None:
             perf_acc.add_device_seconds(t_loop)
             rp = perf_acc.finish()

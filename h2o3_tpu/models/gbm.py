@@ -33,6 +33,7 @@ from h2o3_tpu.models.tree import (ADAPTIVE_HIST_TYPES,
                                   chunk_bucket,
                                   collect_chunk_trees, grow_tree,
                                   grow_tree_adaptive, grow_tree_binned,
+                                  levels_per_pass,
                                   packed_codes_requested, predict_binned,
                                   predict_raw_stacked, predict_raw_tree)
 from h2o3_tpu.ops.binning import (CodesView, bin_matrix_device,
@@ -1032,6 +1033,10 @@ class H2OGradientBoostingEstimator(ModelBuilder):
             W=pc.W if packed else None,
             bytes_per_value=pc.itemsize if packed else None,
             n_bins=bm.n_bins if packed else None)
+        # the dense chunk body traces its whole level loop into ONE
+        # executable — every level rides a single dispatch (the fused
+        # shape the streamed driver's L-level windows approximate)
+        model.output["levels_per_dispatch"] = int(cfg.max_depth)
         # mesh layout this train actually ran under — the bench scaling
         # round and the SPMD parity tests assert against it instead of
         # inferring from env
@@ -1203,6 +1208,11 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         # REST cancel / watchdog max_runtime kill lands promptly even
         # inside a deep tree's chunk uploads
         chunks.cancel_check = lambda: job.cancel_requested
+        # fused-window clamp (ISSUE 17): a pending preempt OR cancel
+        # shrinks the next L-level window to one level so the
+        # cooperative yield lands at the next boundary — the PR-15
+        # chunk-commit contract survives multi-level fusion
+        chunks.interrupt_check = lambda: job.preempt_requested
         # performance accounting (ISSUE 11): the streamed level passes
         # feed this through chunks.perf_acc (tree.py captures each level
         # kernel's cost once per shape); coverage noted — the routing/
@@ -1403,6 +1413,16 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         model.output["packed_codes"] = packed_codes_record(
             packed, dtype=x_stream.dtype, W=W,
             bytes_per_value=x_itemsize, n_bins=cfg.n_bins)
+        # multi-level fusion record (ISSUE 17): the resolved
+        # H2O3_LEVELS_PER_PASS window, and how many levels each device
+        # dispatch actually covered — fused only on the packed
+        # single-chunk path (a multi-chunk window still batches its
+        # host syncs but keeps per-level dispatches for the cross-chunk
+        # histogram reduction)
+        lpp = (levels_per_pass(cfg.max_depth, cfg.n_features, W)
+               if packed else 1)
+        model.output["levels_per_dispatch"] = int(
+            lpp if (packed and chunks.C == 1) else 1)
         if perf_acc is not None:
             perf_acc.add_device_seconds(t_loop)
             rp = perf_acc.finish()
@@ -1416,6 +1436,7 @@ class H2OGradientBoostingEstimator(ModelBuilder):
         # must not be attributed to this train
         sp = chunks.profile()
         sp["trees"] = T
+        sp["levels_per_pass"] = int(lpp)
         # steady-state per-tree traffic: the once-per-train resident
         # window upload is reported separately, not amortized — at
         # ntrees=1 amortization would read ~1.6x footprint and false-

@@ -183,6 +183,14 @@ class StreamedChunks:
         # cancel lands BETWEEN level passes — never inside the leaf-apply
         # pass, where a partial update would corrupt chunk margins
         self.cancel_check: Optional[callable] = None
+        # preemption probe (scheduler checkpoint-preempt, PR 15): the
+        # driver points this at job.preempt_requested. The fused
+        # multi-level driver polls interrupt_pending() at each window
+        # START and clamps the window to ONE level when a cancel or
+        # preempt is pending, so the cooperative yield still lands at
+        # the next level boundary instead of L levels later — the
+        # chunk-commit contract is unchanged by fusion
+        self.interrupt_check: Optional[callable] = None
         # performance accounting (ISSUE 11): the training driver parks
         # its costmodel.PerfAccumulator here so the level passes in
         # tree.py can attribute each level kernel's cost without
@@ -281,6 +289,16 @@ class StreamedChunks:
         return jnp.asarray(self.w_host[s:e])
 
     # -- level iteration -------------------------------------------------
+
+    def interrupt_pending(self) -> bool:
+        """True when a cooperative cancel or preempt is pending — read
+        by the fused L-level driver at window start (see
+        ``interrupt_check``). Never raises; the actual cancel still
+        lands via ``cancel_check`` at the next ``level_pass`` start."""
+        for check in (self.cancel_check, self.interrupt_check):
+            if check is not None and check():
+                return True
+        return False
 
     def level_pass(self, need_x: bool = True):
         """Yield a `_ChunkHandle` per chunk. Overflow chunks' X uploads

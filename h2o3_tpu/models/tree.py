@@ -23,7 +23,7 @@ TPU re-design (SURVEY.md §7.3):
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
+from functools import lru_cache, partial
 from typing import List, Optional
 
 import os as _os
@@ -803,6 +803,108 @@ def _hist_mxu_dtype(cfg: TreeConfig, rows: int):
     return jnp.float32 if rows < (1 << 18) else jnp.bfloat16
 
 
+def levels_per_pass(max_depth: int, n_features: int, W: int) -> int:
+    """Resolve ``H2O3_LEVELS_PER_PASS`` — how many consecutive tree
+    levels one fused dispatch covers in the streamed binned driver.
+
+    - integer: clamped to [1, max_depth]; 1 is the exact old per-level
+      path (one dispatch + one host sync per level);
+    - unset / 'auto': VMEM-budgeted — the largest L <= 4 whose DEEPEST
+      possible window keeps the sum of its live level histograms
+      (3 · 2^d · F · W · 4 bytes over the window) inside half the
+      kernel VMEM limit, the same ceiling the per-level accumulator
+      scratch is provisioned against. L=4 everywhere practical; the
+      bound only bites at extreme depth × features × W products where
+      the fused executable's histogram working set would thrash.
+    """
+    from h2o3_tpu.ops.hist_adaptive import _VMEM_LIMIT
+    D = max(1, int(max_depth))
+    raw = _os.environ.get("H2O3_LEVELS_PER_PASS", "").strip().lower()
+    if raw and raw != "auto":
+        return max(1, min(int(raw), D))
+    budget = _VMEM_LIMIT // 2
+    L = 1
+    while L < min(4, D):
+        cand = L + 1
+        top = sum(3 * (1 << d) * n_features * W * 4
+                  for d in range(max(0, D - cand), D))
+        if top > budget:
+            break
+        L = cand
+    return L
+
+
+def _binned_split_level(trip, find_cfg: TreeConfig, level_mask,
+                        cfg: TreeConfig, mono=None, model_axis=None):
+    """ONE level's split selection + the derived next-level routing
+    tables, shared by every binned driver: the dense trace-time loop,
+    the streamed per-level pass and the fused L-level window all run
+    THIS function, so the multi-level path traces exactly the
+    per-level ops and f32 bit-parity holds by construction. Returns
+    (the _find_splits 11-tuple, can, tables)."""
+    sel = _find_splits_sharded(trip, find_cfg, level_mask, mono=mono,
+                               model_axis=model_axis, max_bin=cfg.n_bins)
+    bg, bf, bb, bnl = sel[0], sel[1], sel[2], sel[3]
+    wt_ = sel[6]
+    can = (bg > jnp.maximum(cfg.min_split_improvement, 0.0)) & (wt_ > 0)
+    # next level's routing tables: the split BIN rides where the
+    # adaptive path carries a raw threshold — an exact integer-valued
+    # float through the kernel's bf16-split LUT
+    tables = (jnp.maximum(bf, 0).astype(jnp.float32),
+              bb.astype(jnp.float32),
+              bnl.astype(jnp.float32), can.astype(jnp.float32))
+    return sel, can, tables
+
+
+def _level_record(sel, can, cfg: TreeConfig):
+    """The per-level split record the streamed drivers fetch to host —
+    built on device, batched into ONE counted pytree fetch per L-level
+    window (transfer-seam contract)."""
+    bg, bf, bb, bnl = sel[0], sel[1], sel[2], sel[3]
+    gt, ht, wt_ = sel[4], sel[5], sel[6]
+    return {"feat": jnp.where(can, bf, -1), "bin": bb, "nal": bnl,
+            "can": can, "val": _leaf_value(gt, ht, cfg),
+            "gain": jnp.where(can, bg, 0.0), "w": wt_}
+
+
+@lru_cache(maxsize=64)
+def _fused_binned_window(cfg: TreeConfig, d0: int, Lw: int, W: int,
+                         trans: bool, mxu_name: str):
+    """ONE jitted executable running ``Lw`` consecutive binned levels:
+    route + histogram + split selection + next-level tables, unrolled
+    Lw times at trace time exactly like the dense grower's loop. The
+    packed codes operand is read once per window, ``nid`` and the
+    routing tables carry on-device between levels, and the host syncs
+    only on the window-boundary record fetch — eliminating per-level
+    dispatch overhead and per-level nid round-trips. Each level's body
+    is the streamed per-level pass verbatim (binned_level +
+    _binned_split_level + _level_record), so f32 multi-level trees are
+    bit-identical to the per-level path. lru-cached per (cfg, window,
+    layout): a warm retrain reuses the executable (zero-recompile
+    guard)."""
+    from dataclasses import replace as dc_replace
+
+    from h2o3_tpu.ops.hist_adaptive import binned_level
+    find_cfg = dc_replace(cfg, n_bins=W - 1)
+    mxu_dtype = jnp.float32 if mxu_name == "float32" else jnp.bfloat16
+
+    def window(x, nid, ghw, tables, col_mask):
+        recs = []
+        for j in range(Lw):
+            d = d0 + j
+            N = 1 << d
+            nid, hist = binned_level(
+                None if trans else x, nid, ghw, tables,
+                N // 2 if d else 0, N, N - 1, W,
+                mxu_dtype=mxu_dtype, ct=x if trans else None)
+            sel, can, tables = _binned_split_level(
+                (hist[0], hist[1], hist[2]), find_cfg, col_mask, cfg)
+            recs.append(_level_record(sel, can, cfg))
+        return nid, recs, tables
+
+    return jax.jit(window)
+
+
 def grow_tree_binned(codes_rm, g, h, w, cfg: TreeConfig, col_mask,
                      axis_name=None, key=None, mono=None, sets=None,
                      model_axis=None, ct=None):
@@ -903,11 +1005,10 @@ def grow_tree_binned(codes_rm, g, h, w, cfg: TreeConfig, col_mask,
         if allowed is not None:
             lm2 = level_mask if level_mask.ndim == 2 else level_mask[None, :]
             level_mask = lm2 & allowed
-        bg, bf, bb, bnl, gt, ht, wt, vl_s, vr_s, wl_s, wr_s = \
-            _find_splits_sharded(trip, find_cfg, level_mask, mono=mono,
-                                 model_axis=model_axis,
-                                 max_bin=cfg.n_bins)
-        can = (bg > jnp.maximum(cfg.min_split_improvement, 0.0)) & (wt > 0)
+        sel, can, tables = _binned_split_level(trip, find_cfg, level_mask,
+                                               cfg, mono=mono,
+                                               model_axis=model_axis)
+        bg, bf, bb, bnl, gt, ht, wt, vl_s, vr_s, wl_s, wr_s = sel
         nidx = jnp.arange(N)
         idx = base + nidx
         feat = feat.at[idx].set(jnp.where(can, bf, -1))
@@ -925,12 +1026,6 @@ def grow_tree_binned(codes_rm, g, h, w, cfg: TreeConfig, col_mask,
             hi_b = jnp.repeat(hi_b, 2)
         if allowed is not None:
             allowed = _next_allowed(allowed, sets, bf, can)
-        # next level's routing tables: the split BIN rides where the
-        # adaptive path carries a raw threshold — an exact
-        # integer-valued float through the kernel's bf16-split LUT
-        tables = (jnp.maximum(bf, 0).astype(jnp.float32),
-                  bb.astype(jnp.float32),
-                  bnl.astype(jnp.float32), can.astype(jnp.float32))
 
     # deepest level: route, then EXACT per-leaf (g,h,w) segment totals —
     # the same tail as grow_tree, so packed and unpacked f32 trees are
@@ -1480,63 +1575,105 @@ def grow_tree_binned_streamed(chunks, dist, lr, cfg: TreeConfig, edges,
 
     zeros1 = jnp.zeros(1, jnp.float32)
     tables = (zeros1, zeros1, zeros1, zeros1)
-    vl_s = vr_s = wl_s = wr_s = None
     trans = chunks.kernel_layout == "t"
+    perf_acc = getattr(chunks, "perf_acc", None)
 
-    for d in range(D):
-        N = 2 ** d
-        base = N - 1
-        hist = None
-        perf_acc = getattr(chunks, "perf_acc", None)
-        for ch in chunks.level_pass():
-            ghw = ch.ghw(dist)
-            rm_arg = None if trans else ch.X
-            ct_arg = ch.X if trans else None
-            nid2, h_c = binned_level(rm_arg, ch.nid, ghw, tables,
-                                     N // 2 if d else 0, N, base, W,
-                                     mxu_dtype=mxu_dtype, ct=ct_arg)
-            if perf_acc is not None:
-                # streamed-level jit seam, binned flavour: one
-                # trace+lower per (chunk shape, level) key — the
-                # captured bytes carry the packed representation's
-                # 1-2 byte/value hot-loop traffic
-                import time as _time
-                from functools import partial as _partial
+    # L-level fused windows (ISSUE 17): H2O3_LEVELS_PER_PASS levels per
+    # host round-trip. A single-chunk window runs ONE jitted dispatch
+    # covering all its levels (codes tile-resident, nid + routing
+    # tables on-chip, split selection between passes in the same
+    # executable); a multi-chunk window keeps the per-level chunk loop
+    # (the cross-chunk histogram reduction is a real barrier) but
+    # still batches every level's split-record fetch into one sync at
+    # the window boundary. L=1 is the exact old path.
+    L = levels_per_pass(D, F, W)
+    d = 0
+    while d < D:
+        Lw = min(L, D - d)
+        if Lw > 1 and chunks.interrupt_pending():
+            # PR-15 chunk-commit contract: a pending cancel/preempt
+            # clamps the window so the cooperative yield lands at the
+            # NEXT level boundary, not L levels later
+            Lw = 1
+        if Lw > 1 and chunks.C == 1:
+            win = _fused_binned_window(cfg, d, Lw, W, trans,
+                                       str(mxu_dtype.__name__))
+            recs = None
+            for ch in chunks.level_pass():
+                ghw = ch.ghw(dist)
+                if perf_acc is not None:
+                    # streamed-window jit seam: one trace+lower per
+                    # (chunk shape, window) key — the captured bytes
+                    # show the codes operand read ONCE per Lw levels
+                    import time as _time
 
-                from h2o3_tpu.telemetry import costmodel
-                t_cap0 = _time.perf_counter()
-                perf_acc.add(costmodel.traced_cost(
-                    ("gbm.stream_level_binned", ch.X.shape, int(N),
-                     int(W), str(mxu_dtype.__name__)),
-                    _partial(binned_level, n_prev=N // 2 if d else 0,
-                             n_nodes=N, level_base=base, W=W,
-                             mxu_dtype=mxu_dtype),
-                    rm_arg, ch.nid, ghw, tables, ct=ct_arg))
-                perf_acc.note_capture_seconds(
-                    _time.perf_counter() - t_cap0)
-            ch.put_nid(nid2)
-            hist = h_c if hist is None else hist + h_c
-        trip = (hist[0], hist[1], hist[2])
-        bg, bf, bb, bnl, gt, ht, wt_, vl_s, vr_s, wl_s, wr_s = _find_splits(
-            trip, find_cfg, col_mask, max_bin=cfg.n_bins)
-        can = (bg > jnp.maximum(cfg.min_split_improvement, 0.0)) & (wt_ > 0)
-        idx = base + np.arange(N)
-        # ONE counted pytree fetch per level (transfer-seam contract)
-        lvl = telemetry.device_get(
-            {"feat": jnp.where(can, bf, -1), "bin": bb, "nal": bnl,
-             "can": can, "val": _leaf_value(gt, ht, cfg),
-             "gain": jnp.where(can, bg, 0.0), "w": wt_},
-            pipeline="train")
-        feat[idx] = np.asarray(lvl["feat"])
-        sbin_arr[idx] = np.asarray(lvl["bin"])
-        na_left[idx] = np.asarray(lvl["nal"])
-        is_split[idx] = np.asarray(lvl["can"])
-        value[idx] = np.asarray(lvl["val"])
-        gain_arr[idx] = np.asarray(lvl["gain"])
-        node_w[idx] = np.asarray(lvl["w"])
-        tables = (jnp.maximum(bf, 0).astype(jnp.float32),
-                  bb.astype(jnp.float32),
-                  bnl.astype(jnp.float32), can.astype(jnp.float32))
+                    from h2o3_tpu.telemetry import costmodel
+                    t_cap0 = _time.perf_counter()
+                    perf_acc.add(costmodel.traced_cost(
+                        ("gbm.stream_window_binned", ch.X.shape,
+                         int(d), int(Lw), int(W),
+                         str(mxu_dtype.__name__)),
+                        win, ch.X, ch.nid, ghw, tables, col_mask))
+                    perf_acc.note_capture_seconds(
+                        _time.perf_counter() - t_cap0)
+                nid2, recs, tables = win(ch.X, ch.nid, ghw, tables,
+                                         col_mask)
+                ch.put_nid(nid2)
+        else:
+            recs = []
+            for j in range(Lw):
+                dd = d + j
+                N = 2 ** dd
+                base = N - 1
+                hist = None
+                for ch in chunks.level_pass():
+                    ghw = ch.ghw(dist)
+                    rm_arg = None if trans else ch.X
+                    ct_arg = ch.X if trans else None
+                    nid2, h_c = binned_level(rm_arg, ch.nid, ghw, tables,
+                                             N // 2 if dd else 0, N, base,
+                                             W, mxu_dtype=mxu_dtype,
+                                             ct=ct_arg)
+                    if perf_acc is not None:
+                        # streamed-level jit seam, binned flavour: one
+                        # trace+lower per (chunk shape, level) key — the
+                        # captured bytes carry the packed
+                        # representation's 1-2 byte/value traffic
+                        import time as _time
+                        from functools import partial as _partial
+
+                        from h2o3_tpu.telemetry import costmodel
+                        t_cap0 = _time.perf_counter()
+                        perf_acc.add(costmodel.traced_cost(
+                            ("gbm.stream_level_binned", ch.X.shape,
+                             int(N), int(W), str(mxu_dtype.__name__)),
+                            _partial(binned_level,
+                                     n_prev=N // 2 if dd else 0,
+                                     n_nodes=N, level_base=base, W=W,
+                                     mxu_dtype=mxu_dtype),
+                            rm_arg, ch.nid, ghw, tables, ct=ct_arg))
+                        perf_acc.note_capture_seconds(
+                            _time.perf_counter() - t_cap0)
+                    ch.put_nid(nid2)
+                    hist = h_c if hist is None else hist + h_c
+                sel, can, tables = _binned_split_level(
+                    (hist[0], hist[1], hist[2]), find_cfg, col_mask, cfg)
+                recs.append(_level_record(sel, can, cfg))
+        # ONE counted pytree fetch per WINDOW (transfer-seam contract):
+        # every level's split records batched into a single host sync
+        # at the L-level boundary
+        lvl_h = telemetry.device_get(recs, pipeline="train")
+        for j, r in enumerate(lvl_h):
+            N = 2 ** (d + j)
+            idx = (N - 1) + np.arange(N)
+            feat[idx] = np.asarray(r["feat"])
+            sbin_arr[idx] = np.asarray(r["bin"])
+            na_left[idx] = np.asarray(r["nal"])
+            is_split[idx] = np.asarray(r["can"])
+            value[idx] = np.asarray(r["val"])
+            gain_arr[idx] = np.asarray(r["gain"])
+            node_w[idx] = np.asarray(r["w"])
+        d += Lw
 
     # deepest level, two passes matching the dense binned tail: (A)
     # route each chunk and accumulate EXACT per-leaf (g,h,w) segment

@@ -454,6 +454,20 @@ def pack_codes(bm: "BinnedMatrix", mesh=None) -> PackedCodes:
     return PackedCodes(rm=rm, t=t, W=W)
 
 
+def stripe_pair_codes(ct, W: int):
+    """Stripe-aware relayout of the transposed packed operand for the
+    W=16 stripe kernel (ops/hist_adaptive._kernel_bt_stripe): features
+    pair up two-per-32-lane stripe, so an ODD feature count pads one
+    all-NA feature row (code W-1 — zero split mass; the kernel slices
+    its histogram columns away). Even F passes through untouched — the
+    pairing itself needs no data movement, adjacent rows already form
+    the stripes."""
+    F = ct.shape[0]
+    if F % 2 == 0:
+        return ct
+    return jnp.pad(ct, ((0, 1), (0, 0)), constant_values=W - 1)
+
+
 def pack_codes_for(X, bm: "BinnedMatrix", W: Optional[int] = None):
     """Digitise a NEW matrix (validation / scoring frame) with the
     training sketch's edges and pack it to the kernel convention

@@ -1011,6 +1011,146 @@ def binned_level_tpu_t(ct, nid, ghw, tables, n_prev: int, n_nodes: int,
     return nid2[0], hist.reshape(3, n_nodes, F, W)
 
 
+def _kernel_bt_stripe(c_ref, nid_ref, ghw_ref, tabs_ref, nid_out, hist_out,
+                      acc_ref, *, n_prev: int, n_nodes: int, F2: int,
+                      W: int, tile: int, n_row_tiles: int, level_base: int,
+                      mxu_dtype):
+    """STRIPE-PACKED binned level (W=16): two features share one 32-lane
+    stripe of the one-hot — feature 2p's bins in sub-lanes 0..W-1,
+    feature 2p+1's in W..2W-1 (codes offset by +W in-register). The
+    resulting selector matrix is ELEMENT-IDENTICAL to _kernel_bt's
+    (row q = W·f + b holds the same {0,1} for every lane), so the MXU
+    contraction produces bit-identical histograms; what changes is the
+    lowering — the iota compare runs modulo 2W = 32 aligned to the int8
+    (32, 128) native tile, so each compare stripe is a full sublane
+    group instead of two half-filled W=16 groups. Capability-gated
+    (stripe_supported): Mosaic builds that lack the aligned i8 select
+    fall back to _kernel_bt."""
+    r = pl.program_id(0)
+
+    @pl.when(r == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    cf = c_ref[...].astype(jnp.int32).astype(jnp.float32)    # [2*F2, tile]
+    nid = nid_ref[0, :]
+    if n_prev > 0:
+        nid = _route_bt(cf, nid, tabs_ref, n_prev, level_base, tile,
+                        2 * F2, W)
+    nid_out[0, :] = nid
+
+    lid = nid - level_base
+    in_lvl = (lid >= 0) & (lid < n_nodes)
+    lidm = jnp.where(in_lvl, lid, -1)
+    onh_m = (jax.lax.broadcasted_iota(jnp.int32, (n_nodes, tile), 0)
+             == lidm[None, :]).astype(mxu_dtype)
+    # stripe offset: the pair's odd feature lives in the upper W lanes —
+    # one add on the [2*F2, tile] codes, then a single repeat builds
+    # both features' lanes of every stripe at once
+    frow = jax.lax.broadcasted_iota(jnp.int32, (2 * F2, tile), 0)
+    cs = cf + ((frow % 2) * W).astype(jnp.float32)
+    b_all = jnp.repeat(cs, W, axis=0)                        # [F2*2W, tile]
+    brow = jax.lax.broadcasted_iota(jnp.int32, (2 * F2 * W, tile), 0)
+    oh_t = ((brow % (2 * W)).astype(jnp.float32) == b_all).astype(mxu_dtype)
+    ghw_m = ghw_ref[...].astype(mxu_dtype)
+    left = jnp.concatenate(
+        [onh_m * ghw_m[k, :][None, :] for k in range(3)], axis=0)
+    acc_ref[...] += jax.lax.dot_general(
+        left, oh_t, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=(jax.lax.Precision.HIGHEST if mxu_dtype == jnp.float32
+                   else jax.lax.Precision.DEFAULT))        # [3N, F2*2W]
+
+    @pl.when(r == n_row_tiles - 1)
+    def _flush():
+        hist_out[...] = acc_ref[...]
+
+
+def binned_level_tpu_stripe(ct, nid, ghw, tables, n_prev: int,
+                            n_nodes: int, level_base: int, W: int,
+                            tile: int = TILE, interpret: bool = False,
+                            mxu_dtype=jnp.bfloat16, F: int = None):
+    """Stripe-packed binned level: ct is the stripe operand [2*F2, rows]
+    (ops/binning.stripe_pair_codes — an odd F pads one all-NA feature
+    row). ``F`` is the REAL feature count; the returned hist is sliced
+    back to [3, n_nodes, F, W]."""
+    F_op, rows = ct.shape
+    assert F_op % 2 == 0, F_op
+    F2 = F_op // 2
+    F = F_op if F is None else F
+    assert rows % tile == 0, (rows, tile)
+    n_row_tiles = rows // tile
+    tabs = _pack_tables(tables)
+    np1 = tabs.shape[1]
+    kern = functools.partial(_kernel_bt_stripe, n_prev=n_prev,
+                             n_nodes=n_nodes, F2=F2, W=W, tile=tile,
+                             n_row_tiles=n_row_tiles,
+                             level_base=level_base, mxu_dtype=mxu_dtype)
+    itemsize = jnp.dtype(ct.dtype).itemsize
+    nid2, hist = pl.pallas_call(
+        kern,
+        grid=(n_row_tiles,),
+        in_specs=[
+            pl.BlockSpec((2 * F2, tile), lambda r: (0, r)),
+            pl.BlockSpec((1, tile), lambda r: (0, r)),
+            pl.BlockSpec((3, tile), lambda r: (0, r)),
+            pl.BlockSpec((12, np1), lambda r: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, tile), lambda r: (0, r)),
+            pl.BlockSpec((3 * n_nodes, 2 * F2 * W), lambda r: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, rows), jnp.int32),
+            jax.ShapeDtypeStruct((3 * n_nodes, 2 * F2 * W), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((3 * n_nodes, 2 * F2 * W),
+                                   jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 3 * n_nodes * 2 * F2 * W * rows,
+            bytes_accessed=rows * 2 * F2 * itemsize + rows * 16,
+            transcendentals=0),
+        compiler_params=_CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        interpret=interpret,
+    )(ct, nid[None, :], ghw, tabs)
+    return nid2[0], hist.reshape(3, n_nodes, 2 * F2, W)[:, :, :F, :]
+
+
+@functools.lru_cache(maxsize=1)
+def _stripe_probe() -> bool:
+    """Hardware capability probe for the stripe kernel, run ONCE: the
+    interpreter always supports it; on a real TPU a tiny stripe kernel
+    is compiled and executed, and any Mosaic lowering failure (builds
+    lacking the aligned i8 select the stripe compare needs) demotes to
+    the _kernel_bt layout."""
+    if pallas_interpret():
+        return True
+    if jax.default_backend() != "tpu":
+        return False
+    try:
+        ct = jnp.full((2, TILE), 15, jnp.int8)
+        nid = jnp.zeros(TILE, jnp.int32)
+        ghw = jnp.zeros((3, TILE), jnp.float32)
+        z1 = jnp.zeros(1, jnp.float32)
+        nid2, hist = binned_level_tpu_stripe(
+            ct, nid, ghw, (z1, z1, z1, z1), 0, 1, 0, 16)
+        jax.block_until_ready((nid2, hist))  # h2o3-lint: allow[transfer-seam] once-per-process capability probe: the block IS the probe (Mosaic lowering failures surface at execute)
+        return True
+    except Exception:
+        return False
+
+
+def stripe_supported() -> bool:
+    """Whether binned W=16 levels use the stripe-packed one-hot kernel.
+    H2O3_STRIPE=0/1 overrides the probe (tests, A/B ablation)."""
+    env = _os.environ.get("H2O3_STRIPE", "")
+    if env == "0":
+        return False
+    if env == "1":
+        return True
+    return _stripe_probe()
+
+
 def _kernel_bt_i8(c_ref, nid_ref, q_ref, s_ref, tabs_ref, nid_out,
                   hist_out, acc_ref, *, n_prev: int, n_nodes: int, F: int,
                   W: int, tile: int, n_row_tiles: int, level_base: int,
@@ -1227,6 +1367,13 @@ def binned_level(codes_rm, nid, ghw, tables, n_prev: int, n_nodes: int,
             nid2, hist = binned_level_tpu_i8(
                 ct, nid, q, scales, tables, n_prev, n_nodes, level_base,
                 W, interpret=pallas_interpret())
+            return nid2[:rows], hist
+        if W == 16 and ct.shape[0] >= 2 and stripe_supported():
+            from h2o3_tpu.ops.binning import stripe_pair_codes
+            nid2, hist = binned_level_tpu_stripe(
+                stripe_pair_codes(ct, W), nid, ghw, tables, n_prev,
+                n_nodes, level_base, W, mxu_dtype=mxu_dtype,
+                interpret=pallas_interpret(), F=ct.shape[0])
             return nid2[:rows], hist
         nid2, hist = binned_level_tpu_t(ct, nid, ghw, tables, n_prev,
                                         n_nodes, level_base, W,

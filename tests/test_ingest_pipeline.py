@@ -614,3 +614,61 @@ def test_multihost_shard_local_parse_parity(tmp_path, monkeypatch):
                     got[~np.isnan(got)], want[~np.isnan(want)], err_msg=n)
             else:
                 np.testing.assert_array_equal(got, want, err_msg=n)
+
+
+# ---------------- satellite: enum device streaming (ISSUE 17) ----------
+
+
+def _region_enum_csv(nrow=6000):
+    """Enum column whose domain depends on the row REGION: each third of
+    the file sees a different city pair, so parallel byte-range chunks
+    encode DIFFERENT chunk-local code spaces and the streamed device
+    assembly must remap every chunk through its per-chunk LUT section
+    (chunk-local code 0 decodes to a different label per region)."""
+    rng = np.random.default_rng(11)
+    regions = [("ames", "berlin"), ("cairo", "delhi"), ("essen", "fargo")]
+    lines = ["id,e,x"]
+    for i in range(nrow):
+        pair = regions[min(i * len(regions) // nrow, len(regions) - 1)]
+        e = "" if i % 97 == 13 else pair[int(rng.integers(0, 2))]
+        lines.append(f"{i},{e},{rng.normal():.5f}")
+    return "\n".join(lines) + "\n"
+
+
+def test_enum_streamed_device_parity(tmp_path, monkeypatch):
+    """Enum codes ride the worker-side prepack + per-chunk streamed H2D
+    path and the device-remapped union codes are bit-identical to the
+    serial host-merge parse — values, NA positions, domain order."""
+    p = tmp_path / "region.csv"
+    p.write_text(_region_enum_csv())
+    setup = parse_setup(str(p))
+    fr_serial = parse([str(p)], setup)
+    assert parse_mod.LAST_PROFILE["chunks"] == 1
+    monkeypatch.setattr(parse_mod, "_PARALLEL_PARSE_BYTES", 1 << 12)
+    monkeypatch.setenv("H2O3_INGEST_STREAM", "1")
+    fr_par = parse([str(p)], setup)
+    assert parse_mod.LAST_PROFILE["chunks"] > 1
+    assert parse_mod.LAST_PROFILE["streamed"]
+    assert fr_par.vec("e").domain == ("ames", "berlin", "cairo", "delhi",
+                                      "essen", "fargo")
+    _frames_equal(fr_serial, fr_par)
+
+
+def test_enum_stream_cardinality_blowout_falls_back(tmp_path, monkeypatch):
+    """A union past MAX_ENUM_CARDINALITY demotes the column out of the
+    streamed set (the host merge takes over, exactly the pre-streaming
+    semantics) — parity with the serial parse survives the demotion."""
+    import h2o3_tpu.ingest.chunk as chunk_mod
+    lines = ["id,e"]
+    for i in range(4000):
+        lines.append(f"{i},lab{i % 600:04d}")
+    p = tmp_path / "blow.csv"
+    p.write_text("\n".join(lines) + "\n")
+    monkeypatch.setattr(chunk_mod, "MAX_ENUM_CARDINALITY", 128)
+    setup = parse_setup(str(p))
+    fr_serial = parse([str(p)], setup)
+    monkeypatch.setattr(parse_mod, "_PARALLEL_PARSE_BYTES", 1 << 12)
+    monkeypatch.setenv("H2O3_INGEST_STREAM", "1")
+    fr_par = parse([str(p)], setup)
+    assert parse_mod.LAST_PROFILE["chunks"] > 1
+    _frames_equal(fr_serial, fr_par)

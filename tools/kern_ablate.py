@@ -2,6 +2,16 @@
 
 Feeds nid2 back between fori_loop iterations so XLA can't hoist/CSE.
 Each ablation removes one phase; the delta vs base is that phase's cost.
+
+Multi-level mode (``LEVELS=1,2,4``): times the PACKED-code level body
+(_kernel_bt shape: int8 codes, one-hot off the sublane repeat, ghw
+contraction) chained L levels inside ONE jitted dispatch — the fused
+window the streamed grower issues when H2O3_LEVELS_PER_PASS > 1. Per L
+it reports ms/level plus the phase split from ablations: the one-hot
+build share, the MXU contraction share (vs everything-else = VPU), the
+routing share, and — comparing per-level time across L — the
+dispatch-overhead share the fusion amortizes away. Runs under
+H2O3_PALLAS_INTERPRET=1 at reduced ROWS for CPU smoke checks.
 """
 import sys, os, time, functools
 sys.path.insert(0, '/root/repo')
@@ -160,6 +170,150 @@ def run(ablate, X, nid0, ghw, tabs, loinv):
     return (time.perf_counter() - t0) / REPS
 
 
+# ------------------------------------------------------------- levels
+# Multi-level fused ablation (packed codes): the production streamed
+# grower's window shape — L binned levels traced into one executable,
+# nid carried on device between them.
+
+LN, LF, LW = 32, 28, 16          # deepest level, features, packed bins
+
+
+def make_packed_kernel(ablate, tile, n_tiles, mxu_dtype=jnp.bfloat16):
+    from h2o3_tpu.ops.hist_adaptive import _route_bt
+
+    def kern(c_ref, nid_ref, ghw_ref, tabs_ref, nid_out, hist_out, acc_ref):
+        r = pl.program_id(0)
+
+        @pl.when(r == 0)
+        def _init():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        cf = c_ref[...].astype(jnp.int32).astype(jnp.float32)  # [F, tile]
+        nid = nid_ref[0, :]
+        if ablate != "route":
+            nid = _route_bt(cf, nid, tabs_ref, LN // 2, LN - 1, tile,
+                            LF, LW)
+        nid_out[0, :] = nid
+        lid = nid - (LN - 1)
+        in_lvl = (lid >= 0) & (lid < LN)
+        lidm = jnp.where(in_lvl, lid, -1)
+        onh_m = (jax.lax.broadcasted_iota(jnp.int32, (LN, tile), 0)
+                 == lidm[None, :]).astype(mxu_dtype)
+        b_all = jnp.repeat(cf, LW, axis=0)                 # [F*W, tile]
+        if ablate == "onehot":
+            oh_t = b_all.astype(mxu_dtype)   # keep repeat, skip compare
+        else:
+            brow = jax.lax.broadcasted_iota(jnp.int32, (LF * LW, tile), 0)
+            oh_t = ((brow % LW).astype(jnp.float32) == b_all
+                    ).astype(mxu_dtype)
+        ghw_m = ghw_ref[...].astype(mxu_dtype)
+        left = jnp.concatenate(
+            [onh_m * ghw_m[k, :][None, :] for k in range(3)], axis=0)
+        if ablate == "matmul":
+            acc_ref[...] += jnp.broadcast_to(oh_t[0, 0] + left[0, 0],
+                                             acc_ref.shape)
+        else:
+            acc_ref[...] += jax.lax.dot_general(
+                left, oh_t, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        @pl.when(r == n_tiles - 1)
+        def _flush():
+            hist_out[...] = acc_ref[...]
+    return kern
+
+
+def run_levels(L, ablate, ct, nid0, ghw, tabs, tile, interp):
+    rows = ct.shape[1]
+    n_tiles = rows // tile
+    kern = make_packed_kernel(ablate, tile, n_tiles)
+    np1 = tabs.shape[1]
+
+    def level(ct, nid, ghw, tabs):
+        nid2, hist = pl.pallas_call(
+            kern,
+            grid=(n_tiles,),
+            in_specs=[
+                pl.BlockSpec((LF, tile), lambda r: (0, r)),
+                pl.BlockSpec((1, tile), lambda r: (0, r)),
+                pl.BlockSpec((3, tile), lambda r: (0, r)),
+                pl.BlockSpec((12, np1), lambda r: (0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, tile), lambda r: (0, r)),
+                pl.BlockSpec((3 * LN, LF * LW), lambda r: (0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((1, rows), jnp.int32),
+                jax.ShapeDtypeStruct((3 * LN, LF * LW), jnp.float32),
+            ],
+            scratch_shapes=[pltpu.VMEM((3 * LN, LF * LW), jnp.float32)],
+            compiler_params=_CompilerParams(vmem_limit_bytes=_VM),
+            interpret=interp,
+        )(ct, nid[None, :], ghw, tabs)
+        return nid2[0], hist
+
+    def window(ct, nid, ghw, tabs):
+        # L levels, ONE dispatch: nid feeds forward (renormalized into
+        # the parent band so routing stays live and XLA can't CSE)
+        hist = None
+        for _ in range(L):
+            nid2, hist = level(ct, nid, ghw, tabs)
+            nid = (jnp.abs(nid2) % (2 * LN - 1)
+                   + (LN - 1) - LN // 2)
+        return nid, hist[0, 0]
+
+    f = jax.jit(window)
+    reps = max(1, REPS // L)
+    nid, s = f(ct, nid0, ghw, tabs)
+    jax.block_until_ready((nid, s))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        nid, s = f(ct, nid, ghw, tabs)   # one host dispatch per window
+    jax.block_until_ready((nid, s))
+    return (time.perf_counter() - t0) / (reps * L)
+
+
+def main_levels(levels):
+    from h2o3_tpu.ops.hist_adaptive import _pack_tables, pallas_interpret
+    interp = pallas_interpret()
+    tile = int(os.environ.get("LTILE", 512 if interp else 8192))
+    rows_d = 8 * tile if interp else 2_502_656
+    rows = int(os.environ.get("LROWS", rows_d))
+    rows -= rows % tile
+    rng = np.random.default_rng(0)
+    ct = jnp.asarray(rng.integers(0, LW - 1, size=(LF, rows)).astype(np.int8))
+    ghw = jnp.stack([jnp.asarray(rng.normal(size=rows).astype(np.float32)),
+                     jnp.ones(rows, jnp.float32),
+                     jnp.ones(rows, jnp.float32)])
+    n_prev = LN // 2
+    nid0 = jnp.asarray((LN - 1 - n_prev
+                        + rng.integers(0, n_prev, rows)).astype(np.int32))
+    tabs = _pack_tables((
+        jnp.asarray(rng.integers(0, LF, n_prev).astype(np.float32)),
+        jnp.asarray(rng.integers(1, LW - 1, n_prev).astype(np.float32)),
+        jnp.asarray((rng.random(n_prev) < 0.5).astype(np.float32)),
+        jnp.ones(n_prev, jnp.float32)))
+    per_l1 = None
+    for L in levels:
+        t = {}
+        for ab in ("none", "route", "onehot", "matmul"):
+            t[ab] = run_levels(L, ab, ct, nid0, ghw, tabs, tile, interp)
+        base = t["none"]
+        mxu = max(0.0, 1 - t["matmul"] / base)
+        oneh = max(0.0, 1 - t["onehot"] / base)
+        rout = max(0.0, 1 - t["route"] / base)
+        extra = ""
+        if L == 1:
+            per_l1 = base
+        elif per_l1:
+            extra = (f"  dispatch-overhead saved vs L=1: "
+                     f"{max(0.0, 1 - base / per_l1) * 100:5.1f}%")
+        print(f"L={L}: {base*1000:8.3f} ms/level  "
+              f"mxu {mxu:.2f} / vpu {1-mxu:.2f}  "
+              f"onehot {oneh:.2f}  route {rout:.2f}{extra}", flush=True)
+
+
 def main():
     from h2o3_tpu.ops.hist_adaptive import _split3_bf16
     rows = ROWS - (ROWS % TILE)
@@ -195,4 +349,8 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    lv = os.environ.get("LEVELS")
+    if lv:
+        main_levels([max(1, int(x)) for x in lv.split(",")])
+    else:
+        main()

@@ -1,6 +1,13 @@
 """Does a [M, K]x[K, N] Mosaic matmul with M << 128 cost the same as
 M=128 (systolic-array row waste)? Times the bare hist-shaped contraction
-at several M.  K=8192 (tile), N=896 (F*W)."""
+at several M.  K=8192 (tile), N=896 (F*W).
+
+``L=4`` chains L DEPENDENT contractions per fori step (each left
+operand perturbed by the previous output, like the fused multi-level
+window feeds nid forward) — per-contraction time vs L=1 shows whether
+back-to-back MXU issue at the hist shape keeps the array busy, i.e.
+how much of the multi-level win is dispatch/sync amortization vs
+in-kernel pipelining. ``FW=448`` probes the W=16 packed geometry."""
 import sys, os, time, functools
 sys.path.insert(0, '/root/repo')
 
@@ -12,10 +19,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 from h2o3_tpu.ops.pallas_compat import CompilerParams as _CompilerParams
 
-ROWS = 2_500_608
-TILE = 8192
-FW = 896
-REPS = 40
+ROWS = int(os.environ.get("ROWS", 2_500_608))
+TILE = int(os.environ.get("TILE", 8192))
+FW = int(os.environ.get("FW", 896))
+REPS = int(os.environ.get("REPS", 40))
+LCHAIN = max(1, int(os.environ.get("L", 1)))
 
 
 def run(M):
@@ -46,6 +54,7 @@ def run(M):
         scratch_shapes=[pltpu.VMEM((M, FW), jnp.float32)],
         compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 2 ** 20),
+        interpret=os.environ.get("H2O3_PALLAS_INTERPRET", "") == "1",
     )
     rng = np.random.default_rng(0)
     DT = jnp.int8 if os.environ.get("DT") == "i8" else jnp.bfloat16
@@ -60,10 +69,14 @@ def run(M):
     def loop(L, R, s0):
         def body(i, carry):
             s, L = carry
-            out = call(L, R)
-            L = (L + (out[0, 0] * 1e-20).astype(L.dtype)
-                 if L.dtype != jnp.int8 else
-                 L ^ (out[0, 0].astype(jnp.int32) % 2).astype(jnp.int8))
+            # LCHAIN dependent contractions back-to-back (the fused
+            # multi-level window's issue pattern): each left operand
+            # perturbed by the previous output so Mosaic can't CSE
+            for _ in range(LCHAIN):
+                out = call(L, R)
+                L = (L + (out[0, 0] * 1e-20).astype(L.dtype)
+                     if L.dtype != jnp.int8 else
+                     L ^ (out[0, 0].astype(jnp.int32) % 2).astype(jnp.int8))
             return s + out[0, 0], L
         return jax.lax.fori_loop(0, REPS, body, (s0, L))
 
@@ -72,10 +85,11 @@ def run(M):
     t0 = time.time()
     out2 = loop(L, R, 1e-7)
     _ = float(jax.device_get(out2[0]))
-    dt = (time.time() - t0) / REPS
+    dt = (time.time() - t0) / (REPS * LCHAIN)
     flops = 2 * M * FW * ROWS
-    print(f"M={M:4d}: {dt*1000:7.3f} ms  ({flops/dt/1e12:6.1f} TFLOP/s)",
-          flush=True)
+    tag = f" L={LCHAIN}" if LCHAIN > 1 else ""
+    print(f"M={M:4d}:{tag} {dt*1000:7.3f} ms/contraction  "
+          f"({flops/dt/1e12:6.1f} TFLOP/s)", flush=True)
 
 
 if __name__ == "__main__":

@@ -149,6 +149,115 @@ def _level_split(rows, F, nbins, depth):
                               "packed": F * int(np.dtype(dt).itemsize)}}
 
 
+def _fused_pass(rows, F, nbins, depth):
+    """Fused-pass view (multi-level streamed windows, ISSUE 17): times a
+    full tree grown as windows of L packed binned levels — each window
+    ONE jitted dispatch chaining kernel + device split-select, records
+    fetched once at the window boundary — at L in {1, 2, 4} (clamped to
+    depth). The per-window stage split attributes device loop time vs
+    the boundary record fetch, and the per-level delta vs L=1 is the
+    dispatch/sync overhead the fusion amortizes (the
+    H2O3_LEVELS_PER_PASS lever). Select is a gain-proxy stub shaped
+    like _binned_split_level (cumsum + argmax per node), so the window
+    executable carries the same level->select->level dependency chain
+    as the production window."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    from h2o3_tpu.models.tree import levels_per_pass
+    from h2o3_tpu.ops.hist_adaptive import binned_level, pick_W
+    if jax.default_backend() != "tpu":
+        rows = min(rows, 1 << 18)
+    W = pick_W(max(nbins, 2))
+    dt = np.int8 if W <= 128 else np.int16
+    rng = np.random.default_rng(0)
+    codes_h = rng.integers(0, max(nbins, 2), size=(rows, F)).astype(dt)
+    codes = jnp.asarray(codes_h)
+    ct = jnp.asarray(np.ascontiguousarray(codes_h.T))
+    ghw = jnp.ones((3, rows), jnp.float32)
+
+    def select_tables(hist, N):
+        g, h, _w = hist[0], hist[1], hist[2]          # [N, F, W]
+        gl = jnp.cumsum(g, axis=2)
+        hl = jnp.cumsum(h, axis=2)
+        gt, ht = gl[:, :, -1:], hl[:, :, -1:]
+        gain = (gl ** 2 / (hl + 1e-6)
+                + (gt - gl) ** 2 / (ht - hl + 1e-6)).reshape(N, -1)
+        best = jnp.argmax(gain, axis=1)
+        return ((best // W).astype(jnp.float32),
+                (best % W).astype(jnp.float32),
+                jnp.zeros(N, jnp.float32), jnp.ones(N, jnp.float32))
+
+    def window(codes, ct, nid, ghw, tables, *, d0, Lw):
+        recs = []
+        for j in range(Lw):
+            d = d0 + j
+            N = 2 ** d
+            nid, hist = binned_level(codes, nid, ghw, tables,
+                                     N // 2 if d else 0, N, N - 1, W,
+                                     ct=ct)
+            tables = select_tables(hist, N)
+            recs.append(tables[0])
+        return nid, tables, recs
+
+    def tree(L):
+        nid = jnp.zeros(rows, jnp.int32)
+        tables = (jnp.zeros(1, jnp.float32), jnp.ones(1, jnp.float32),
+                  jnp.zeros(1, jnp.float32), jnp.zeros(1, jnp.float32))
+        loop_s = fetch_s = 0.0
+        d = 0
+        while d < depth:
+            Lw = min(L, depth - d)
+            t0 = time.perf_counter()
+            nid, tables, recs = wins[(L, d, Lw)](codes, ct, nid, ghw,
+                                                 tables)
+            jax.block_until_ready(nid)
+            t1 = time.perf_counter()
+            jax.device_get(recs)           # boundary record fetch
+            t2 = time.perf_counter()
+            loop_s += t1 - t0
+            fetch_s += t2 - t1
+            d += Lw
+        return loop_s, fetch_s
+
+    out = {"rows": rows, "W": W,
+           "auto_levels_per_pass": levels_per_pass(depth, F, W),
+           "windows": []}
+    base_ms = None
+    for L in sorted({1, 2, 4}):
+        L = min(L, depth)
+        wins = {}
+        d = 0
+        while d < depth:
+            Lw = min(L, depth - d)
+            wins[(L, d, Lw)] = jax.jit(partial(window, d0=d, Lw=Lw))
+            d += Lw
+        tree(L)                            # warm: compile every window
+        reps = 3
+        loop_s = fetch_s = 0.0
+        for _ in range(reps):
+            ls, fs = tree(L)
+            loop_s += ls
+            fetch_s += fs
+        loop_ms = loop_s / reps * 1e3
+        fetch_ms = fetch_s / reps * 1e3
+        per_level = (loop_ms + fetch_ms) / depth
+        if L == 1:
+            base_ms = per_level
+        rec = {"L": L, "windows_per_tree": -(-depth // L),
+               "loop_ms": round(loop_ms, 3),
+               "boundary_fetch_ms": round(fetch_ms, 3),
+               "ms_per_level": round(per_level, 3)}
+        if base_ms and L > 1:
+            rec["dispatch_overhead_saved"] = round(
+                max(0.0, 1 - per_level / base_ms), 3)
+        out["windows"].append(rec)
+        if L == depth or L >= depth:
+            break
+    return out
+
+
 def main():
     import jax
     from h2o3_tpu import telemetry
@@ -243,6 +352,11 @@ def main():
         # binned path, `_kernel_t` for the f32 adaptive path) on the
         # device timeline.
         "packed_codes": model.output.get("packed_codes"),
+        # multi-level fusion (ISSUE 17): how many tree levels each
+        # device dispatch covered — max_depth on the dense path (the
+        # whole grower traces into one executable), H2O3_LEVELS_PER_PASS
+        # on the streamed single-chunk path, 1 per-level otherwise
+        "levels_per_dispatch": model.output.get("levels_per_dispatch"),
         "hot_kernel": ((model.output.get("packed_codes") or {})
                        .get("kernel") or "adaptive_level"),
         "hot_loop_bytes_per_row_tree": (
@@ -268,6 +382,21 @@ def main():
                     f"f32 {lv['f32_ms']}ms  packed {lv['packed_ms']}ms")
         except Exception as e:  # probe must never sink the profile
             log(f"level-split probe FAILED: {e!r}")
+        # fused-pass view (ISSUE 17): per-window stage split at
+        # L in {1, 2, 4} — device loop vs boundary fetch, and the
+        # dispatch overhead multi-level fusion removes
+        try:
+            out["fused_pass"] = _fused_pass(fr.nrow, fr.ncol - 1,
+                                            NBINS, DEPTH)
+            for wv in out["fused_pass"]["windows"]:
+                log(f"fused[L={wv['L']}]: {wv['ms_per_level']}ms/level "
+                    f"(loop {wv['loop_ms']}ms + fetch "
+                    f"{wv['boundary_fetch_ms']}ms / tree)"
+                    + (f"  overhead saved "
+                       f"{wv['dispatch_overhead_saved']:.0%}"
+                       if "dispatch_overhead_saved" in wv else ""))
+        except Exception as e:
+            log(f"fused-pass probe FAILED: {e!r}")
     print(json.dumps(out))
     return out
 
