@@ -634,6 +634,26 @@ def main():
                 f"queue_wait_p50={sc.get('queue_wait_p50_ms')}ms")
         except Exception as e:  # must never sink the headline run
             log(f"sched round FAILED to run: {e!r}")
+    # fleet-scheduler round (ISSUE 18): two replica processes share a
+    # recovery dir; one is SIGKILLed mid-train (evict → requeue on the
+    # survivor) and a preempted local train migrates its checkpoint —
+    # emits fleetsched.{queue_wait_p50_ms,migrations,resumed_after_evict}
+    # (ratcheted by tools/perf_gate.py). H2O3_BENCH_FLEETSCHED=0 skips.
+    if os.environ.get("H2O3_BENCH_FLEETSCHED", "1") not in (
+            "0", "false", ""):
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            from chaos_sweep import run_kill_replica_training_round
+            fs = run_kill_replica_training_round(log=log)
+            out["fleetsched"] = fs
+            log(f"fleetsched: evict_resume_ok={fs.get('evict_resume_ok')}"
+                f" (resumed={fs.get('resumed_after_evict')}) "
+                f"migrations={fs.get('migrations')} "
+                f"migrate_ok={fs.get('migrate_resume_ok')} "
+                f"queue_wait_p50={fs.get('queue_wait_p50_ms')}ms")
+        except Exception as e:  # must never sink the headline run
+            log(f"fleetsched round FAILED to run: {e!r}")
     # multichip scaling round (ISSUE 7): rows/s/chip at n_devices ∈
     # {1,4,8} with a scaling-efficiency verdict (tools/multichip_bench.py
     # runs in its OWN process so a single-chip parent can still force

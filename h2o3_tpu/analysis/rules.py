@@ -964,14 +964,24 @@ class FleetPeerDisciplineRule(Rule):
 
 # the training-dispatch layer: work here enters the device through the
 # scheduler (ModelBuilder.train -> sched.submit) or runs inline under
-# an already-admitted parent
-_SCHED_SCOPE_PREFIXES = ("h2o3_tpu/models/",)
+# an already-admitted parent. Since ISSUE 18 the fleet package is in
+# scope too: its placement/migration paths are scheduler extensions,
+# and its async work rides one bounded ThreadPoolExecutor.
+_SCHED_SCOPE_PREFIXES = ("h2o3_tpu/models/", "h2o3_tpu/fleet/")
 _SCHED_SCOPE_FILES = ("h2o3_tpu/automl.py",)
+
+# fleet-side placement decisions: function-name markers and the
+# membership references that make a function a *decision* (vs a helper)
+_PLACEMENT_MARKERS = ("place", "rebalance", "resubmit")
+_MEMBERSHIP_WORDS = ("table", "members", "live_members", "view",
+                     "current_view", "eligible", "candidates")
 
 
 class SchedDisciplineRule(Rule):
-    """Raw ``threading.Thread`` spawns inside the training-dispatch
-    layer (``h2o3_tpu/models/``, ``automl.py``).
+    """Scheduler-bypass hazards in the training-dispatch layer
+    (``h2o3_tpu/models/``, ``automl.py``) and the fleet package
+    (``h2o3_tpu/fleet/``): raw ``threading.Thread`` spawns, and fleet
+    placement decisions that never pin a membership epoch.
 
     Since ISSUE 15, every train enters the device through the cluster
     scheduler: ``ModelBuilder.train`` enqueues (priority class +
@@ -984,8 +994,18 @@ class SchedDisciplineRule(Rule):
     the work rides an admitted parent (the CV-fold pattern —
     executors ARE allowed; they stay inside the parent's run).
 
+    Since ISSUE 18 the fleet scheduler places trains across replicas,
+    so ``h2o3_tpu/fleet/`` is in scope: its proxy/rebalance fan-out
+    must ride the bounded executor (same no-raw-Thread contract — the
+    heartbeat loop carries a reasoned allow comment), and every fleet
+    PLACEMENT decision (a function named ``*place*``/``*rebalance*``/
+    ``*resubmit*`` that reads membership state) must pin the membership
+    epoch it decided under, the same fence fleet-peer-discipline
+    enforces for routing — a placement computed against a dead view
+    would hand a train to an evicted replica.
+
     Scope decision: jobs.py (the run machinery), sched/ (the
-    dispatcher) and the non-training layers (serve/fleet/ingest) spawn
+    dispatcher) and the non-training layers (serve/ingest) spawn
     threads legitimately and are outside this rule's scope.
     """
 
@@ -1018,7 +1038,49 @@ class SchedDisciplineRule(Rule):
                     "sched.submit_context, or use an inline "
                     "ThreadPoolExecutor when the work rides an "
                     "admitted parent build"))
+        if rel.startswith("h2o3_tpu/fleet/"):
+            out.extend(self._epoch_blind_placement(mod))
         return out
+
+    def _epoch_blind_placement(self, mod: ModuleInfo
+                               ) -> Iterable[Finding]:
+        """Fleet placement decisions must pin a membership epoch —
+        structurally the same fence fleet-peer-discipline applies to
+        routing/failover, extended to the functions that decide WHERE
+        a train runs."""
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            low = node.name.lower()
+            if not any(m in low for m in _PLACEMENT_MARKERS):
+                continue
+            has_epoch = False
+            touches_membership = False
+            for ref in ast.walk(node):
+                if isinstance(ref, ast.Attribute):
+                    if "epoch" in ref.attr.lower():
+                        has_epoch = True
+                        break
+                    if ref.attr in _MEMBERSHIP_WORDS:
+                        touches_membership = True
+                elif isinstance(ref, ast.Name):
+                    if "epoch" in ref.id.lower():
+                        has_epoch = True
+                        break
+                    if ref.id in _MEMBERSHIP_WORDS:
+                        touches_membership = True
+            if not touches_membership:
+                continue        # a payload helper, not a decision
+            if not has_epoch:
+                yield self.finding(
+                    mod, node,
+                    f"fleet placement decision '{node.name}' never "
+                    f"references a membership epoch — a train placed "
+                    f"against a dead view lands on an evicted replica; "
+                    f"pin the epoch the decision was made under "
+                    f"(the admission headroom it read belongs to that "
+                    f"view)")
 
 
 # ======================================================================

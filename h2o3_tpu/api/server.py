@@ -745,6 +745,10 @@ def _fleet_join(params, body):
         heartbeat_s=(float(hb_ms) / 1000.0 if hb_ms else None),
         deployments=tuple(b.get("deployments") or ()),
         routable=bool(b.get("routable", False)))
+    # elastic membership (ISSUE 18): a replica joining mid-grid absorbs
+    # queued children — throttled, off-thread, never fails the join
+    from h2o3_tpu.fleet import sched as fleet_sched
+    fleet_sched.maybe_rebalance("join")
     return {"__meta": {"schema_version": 3, "schema_name": "FleetJoinV3"},
             "member_id": m.member_id, "incarnation": m.incarnation,
             "epoch": fleet.router().table.epoch,
@@ -769,7 +773,9 @@ def _fleet_heartbeat(params, body):
             deployments=tuple(b["deployments"])
             if b.get("deployments") is not None else None,
             circuit=b.get("circuit"),
-            routable=b.get("routable"))
+            routable=b.get("routable"),
+            sched=b.get("sched") if isinstance(b.get("sched"), dict)
+            else None)
     except fleet.UnknownMemberError as e:
         raise ApiError(404, f"{e} — POST /3/Fleet/join")
     except fleet.StaleEpochError as e:
@@ -780,9 +786,13 @@ def _fleet_heartbeat(params, body):
             continue
         for st in m.circuit:
             gossip.append({**st, "source": m.member_id})
+    # the fleet-scheduler placement view rides every beat response —
+    # each replica learns every peer's headroom at heartbeat latency
+    from h2o3_tpu.fleet import sched as fleet_sched
     return {"__meta": {"schema_version": 3,
                        "schema_name": "FleetHeartbeatV3"},
-            "ok": True, "epoch": table.epoch, "gossip": gossip}
+            "ok": True, "epoch": table.epoch, "gossip": gossip,
+            "fleet_sched": fleet_sched.fleet_view_from_table(table)}
 
 
 @route("POST", "/3/Fleet/leave")
@@ -831,6 +841,30 @@ def _fleet_predict(params, body, model):
         raise ApiError(getattr(e, "http_status", 500), str(e))
     out.setdefault("__meta", {"schema_version": 3,
                               "schema_name": "FleetPredictionsV3"})
+    return out
+
+
+@route("POST", "/3/FleetSched/submit")
+def _fleet_sched_submit(params, body):
+    """Fleet scheduler hand-off target (ISSUE 18): accept a training
+    submission placed here by another replica — fresh placement, a
+    preempt-migrated checkpoint resume, or an evict-requeue — and run
+    it through THIS process's scheduler under the original priority
+    class, share group and trace id."""
+    from h2o3_tpu import sched
+    from h2o3_tpu.fleet import sched as fleet_sched
+    if not sched.enabled():
+        raise ApiError(503, "this replica's training scheduler is "
+                            "disabled (H2O3_SCHED=0)")
+    b = _fleet_body(params, body)
+    try:
+        out = fleet_sched.handle_remote_submit(b)
+    except sched.SchedulerSaturatedError as e:
+        raise ApiError(503, str(e))
+    except ValueError as e:
+        raise ApiError(400, str(e))
+    out["__meta"] = {"schema_version": 3,
+                     "schema_name": "FleetSchedSubmitV3"}
     return out
 
 
@@ -885,8 +919,17 @@ def _faults_clear(params, body):
 def _scheduler_get(params, body):
     """Training-scheduler state: queue contents per priority class with
     wait reasons, running entries with their admission estimates, the
-    reserved-bytes ledger vs the memman budget, and the sched counters."""
+    reserved-bytes ledger vs the memman budget, and the sched counters.
+    ``?scope=cluster`` merges every replica's snapshot through the
+    telemetry peer plane (dead peers flagged, never fatal)."""
     from h2o3_tpu import sched
+    if str(params.get("scope") or "").lower() == "cluster":
+        from h2o3_tpu.fleet import sched as fleet_sched
+        snap = fleet_sched.cluster_scheduler_snapshot()
+        snap["__meta"] = {"schema_version": 3,
+                          "schema_name": "SchedulerClusterV3"}
+        snap["enabled"] = sched.enabled()
+        return snap
     snap = sched.scheduler().snapshot()
     snap["__meta"] = {"schema_version": 3, "schema_name": "SchedulerV3"}
     snap["enabled"] = sched.enabled()

@@ -29,8 +29,8 @@ from h2o3_tpu.fleet.router import (ConsistentHashRing,
 __all__ = ["ConsistentHashRing", "FleetAgent", "FleetRouter",
            "FleetUnavailableError", "Member", "MemberTable",
            "ReplicaDispatchError", "RouterError", "StaleEpochError",
-           "UnknownMemberError", "heartbeat_ms", "router", "reset",
-           "seeds"]
+           "UnknownMemberError", "active_router", "heartbeat_ms",
+           "router", "reset", "seeds"]
 
 _ROUTER: Optional[FleetRouter] = None
 _MU = threading.Lock()
@@ -51,6 +51,14 @@ def router() -> FleetRouter:
         return _ROUTER
 
 
+def active_router() -> Optional[FleetRouter]:
+    """The process router if one exists — NEVER creates one. The fleet
+    scheduler's placement path reads membership through this so a
+    replica that merely submits trains does not become a router."""
+    with _MU:
+        return _ROUTER
+
+
 def _wire(r: FleetRouter) -> None:
     # churn hygiene (ISSUE 13 satellites): a departed member's circuit
     # gossip drops NOW (not after its TTL) and the telemetry cluster
@@ -61,6 +69,13 @@ def _wire(r: FleetRouter) -> None:
         serve_fleet.drop_source(member.member_id)
 
     r.table.on_depart.append(_on_depart)
+    # fleet scheduler (ISSUE 18): an evicted member's RUNNING
+    # checkpointing trains re-queue fleet-wide from their manifests,
+    # and the router process places its own submissions fleet-wide too
+    from h2o3_tpu.fleet import sched as fleet_sched
+
+    r.table.on_depart.append(fleet_sched.on_member_departed)
+    fleet_sched.install_hooks()
     from h2o3_tpu.telemetry import snapshot as telesnap
 
     def _peer_view():
@@ -82,3 +97,5 @@ def reset() -> None:
         r.table.reset()
         from h2o3_tpu.telemetry import snapshot as telesnap
         telesnap.PEER_SOURCE = None
+    from h2o3_tpu.fleet import sched as fleet_sched
+    fleet_sched.reset()

@@ -143,7 +143,7 @@ class FleetAgent:
         from h2o3_tpu import serve
         deps = serve.deployments()
         load = max((d.batcher.load_factor for d in deps), default=0.0)
-        return {
+        payload = {
             "member_id": self.member_id,
             "incarnation": self.incarnation,
             "load": round(load, 4),
@@ -151,6 +151,15 @@ class FleetAgent:
             "circuit": serve.circuit_states(),
             "routable": self.routable,
         }
+        try:
+            # fleet-scheduler gossip: admission headroom, per-class
+            # queue depths, running count (versioned; a beat without it
+            # just marks this replica local-only — never fails the beat)
+            from h2o3_tpu.fleet import sched as fleet_sched
+            payload["sched"] = fleet_sched.local_sched_payload()
+        except Exception as e:   # noqa: BLE001 — beats outrank gossip
+            self.last_error = f"sched payload: {e!r}"
+        return payload
 
     def beat_once(self) -> bool:
         """One heartbeat; ingests the response's piggybacked peer
@@ -194,6 +203,13 @@ class FleetAgent:
         for src, states in gossip.items():
             serve_fleet.observe_peer_states(
                 states, src, self_process=(src == self.member_id))
+        # fleet-scheduler gossip: the router's merged placement view
+        # rides the same response — every replica sees every other
+        # replica's headroom at heartbeat latency
+        fs = out.get("fleet_sched")
+        if fs is not None:
+            from h2o3_tpu.fleet import sched as fleet_sched
+            fleet_sched.observe_fleet_view(fs, self.member_id)
         return True
 
     # -- lifecycle -------------------------------------------------------
@@ -205,6 +221,12 @@ class FleetAgent:
         out = self.join()
         if self.prewarm:
             self._prewarm(out.get("registry"))
+        # fleet scheduler: this process is now addressable by the fleet
+        # — identify it and route local submissions/preemptions through
+        # the placement hooks (no-ops until a fleet view arrives)
+        from h2o3_tpu.fleet import sched as fleet_sched
+        fleet_sched.set_local_member(self.member_id, self.base_url)
+        fleet_sched.install_hooks()
         self.routable = True
         routable_sent = threading.Event()
 
@@ -214,8 +236,7 @@ class FleetAgent:
                     routable_sent.set()
                 self._stop.wait(self.heartbeat_s)
 
-        self._thread = threading.Thread(target=_loop, daemon=True,
-                                        name="fleet-agent")
+        self._thread = threading.Thread(target=_loop, daemon=True, name="fleet-agent")  # h2o3-lint: allow[sched-discipline] the heartbeat loop is the fleet's liveness signal — it must never queue behind training admission
         self._thread.start()
         if wait_routable_s > 0:
             routable_sent.wait(wait_routable_s)

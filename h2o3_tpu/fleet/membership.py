@@ -108,6 +108,9 @@ class Member:
     deployments: Tuple[str, ...] = ()
     load: float = 0.0                 # batcher fill fraction (0..1+)
     circuit: List[dict] = field(default_factory=list)
+    # fleet-scheduler gossip (versioned payload — fleet/sched.py parses
+    # it; None / malformed → the member is no-headroom/local-only)
+    sched: Optional[dict] = None
     joined_wall: float = 0.0          # reported epoch stamp (not math)
     last_beat: float = 0.0            # monotonic
     beats: int = 0
@@ -213,6 +216,7 @@ class MemberTable:
                     "routable": m.routable,
                     "deployments": list(m.deployments),
                     "load": round(m.load, 4),
+                    "sched": m.sched,
                     "beats": m.beats,
                     "phi": round(m.phi(now), 3),
                     "missed_beats": round(m.missed_beats(now), 2),
@@ -252,7 +256,8 @@ class MemberTable:
                   load: float = 0.0,
                   deployments: Optional[Tuple[str, ...]] = None,
                   circuit: Optional[List[dict]] = None,
-                  routable: Optional[bool] = None) -> Member:
+                  routable: Optional[bool] = None,
+                  sched: Optional[dict] = None) -> Member:
         """Record one beat. Raises :class:`UnknownMemberError` when the
         member is not in the table (evicted / never joined — the
         sender must join) and :class:`StaleEpochError` when the
@@ -287,6 +292,9 @@ class MemberTable:
                 m.deployments = tuple(deployments)
             if circuit is not None:
                 m.circuit = list(circuit)
+            if sched is not None:
+                m.sched = dict(sched) if isinstance(sched, dict) \
+                    else None
             became_routable = False
             if routable is not None and bool(routable) != m.routable:
                 m.routable = bool(routable)

@@ -149,6 +149,10 @@ class FleetRouter:
             return
         try:
             self.table.sweep()
+            # fleet scheduler: queued local work drains to members with
+            # headroom even when no join/gossip event triggers it
+            from h2o3_tpu.fleet import sched as fleet_sched
+            fleet_sched.router_tick(self.table)
         finally:
             t = threading.Timer(heartbeat_ms() / 1000.0, self._tick)
             t.daemon = True

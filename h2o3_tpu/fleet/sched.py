@@ -1,0 +1,1111 @@
+"""Fleet scheduler: cluster-wide training placement, preempt-migrate,
+and elastic membership (ISSUE 18).
+
+The reference H2O-3 cloud schedules work against ALL nodes as one
+resource pool (water/Paxos.java membership + the priority ForkJoin
+ladder, water/H2O.java:1532); until this module each of our subsystems
+was per-process-complete but fleet-incomplete: PR 15's scheduler admits
+trains against one process's HBM budget, PR 13's member table knows
+every replica's load and epoch, and PR 9 proved in-training checkpoints
+resume bit-identically in a different process. This module is the seam
+that fuses them:
+
+1. **Fleet placement** — every heartbeat gossips the replica's sched
+   payload (admission headroom, queue depth per priority class, running
+   count) into the member table; the heartbeat RESPONSE carries the
+   router's merged fleet view back, so every replica sees every other
+   replica's headroom at heartbeat latency. A train submitted to any
+   replica is placed on the member with admission headroom (local wins
+   ties; no headroom anywhere → queue locally with the fleet snapshot
+   recorded on the entry), and grid/AutoML waves — bulk class with a
+   non-default share group — ROUND-ROBIN across local + remote slots so
+   one grid's children land on every replica with headroom.
+2. **Preempt-MIGRATE** — a preempted train's DKV ``<key>_ckpt`` is
+   exported as a durable artifact and handed (with the job's priority
+   class, share group and trace id) to a replica with headroom, where it
+   resumes bit-identically; the LOCAL job key keeps reporting on
+   /3/Jobs via a proxy that mirrors the remote job's status/progress and
+   finalizes the local job from the remote result artifact.
+3. **Elastic membership** — a replica joining mid-grid triggers a
+   rebalance that steals queued children and hands them over; an
+   evicted replica's RUNNING checkpointing trains are re-queued
+   fleet-wide from their last chunk commit via the recovery manifests
+   (which now record the owning member, priority class and share).
+
+Degradation contract (mixed-version fleets, satellite 2): the sched
+payload carries ``schema_version``; unknown keys are ignored and a
+member whose payload is missing, unparseable or from an incompatible
+version is treated as no-headroom/local-only — a fleet of old replicas
+behaves exactly like PR 15's per-process scheduler.
+
+Transfer plane: artifacts (frames, migrated checkpoints, results) move
+through the shared recovery root (``H2O3_RECOVERY_DIR``) — the same
+durable store boot recovery already requires — so placement degrades to
+local-only when no shared root is configured.
+
+Threading: all async work (proxy polling, rebalance, evict-requeue)
+runs on one bounded ThreadPoolExecutor — the sched-discipline lint rule
+covers this package, so no raw ``threading.Thread`` here.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+SCHED_SCHEMA_VERSION = 1
+
+# algos whose (y, x, training_frame) submissions round-trip through the
+# recovery/estimator seam — the remote-submit payload is exactly the
+# recovery-manifest shape, so the supported set is recovery's
+_REMOTE_ALGOS = ("gbm", "drf", "xgboost")
+
+_MU = threading.Lock()
+_LOCAL: Dict[str, Optional[str]] = {"member_id": None, "base_url": None}
+# replica-side copy of the router's merged fleet view (piggybacked on
+# the heartbeat response); mono stamps freshness
+_GOSSIP: Dict[str, Any] = {"view": None, "mono": 0.0}
+_COUNTERS: Dict[str, int] = {
+    "remote_submits": 0, "remote_received": 0, "migrations": 0,
+    "rebalanced": 0, "evict_requeues": 0}
+_RR: Dict[str, int] = {}            # share group -> round-robin cursor
+_REBAL: Dict[str, float] = {"last": 0.0}
+_FRAMES: Dict[str, Tuple[float, Any]] = {}   # path -> (mtime, Frame)
+_EXEC = None
+_EXEC_MU = threading.Lock()
+_REMOTE_TLS = threading.local()     # on=True while ingesting a remote
+#                                     submission (placement must not
+#                                     re-place it — ping-pong fence)
+
+
+def _executor():
+    global _EXEC
+    with _EXEC_MU:
+        if _EXEC is None:
+            import concurrent.futures as cf
+            _EXEC = cf.ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="fleet-sched")
+        return _EXEC
+
+
+def _knob_s(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def set_local_member(member_id: str, base_url: Optional[str]) -> None:
+    """Identify this process in the fleet (FleetAgent.start)."""
+    with _MU:
+        _LOCAL["member_id"] = member_id
+        _LOCAL["base_url"] = base_url
+
+
+def local_member_id() -> str:
+    with _MU:
+        mid = _LOCAL["member_id"]
+    # same formula as FleetAgent._default_member_id and the chaos
+    # harness's victim computation — a process that never started an
+    # agent still stamps a stable identity into recovery manifests
+    return mid or f"{os.getpid()}@{socket.gethostname()}"
+
+
+def counters() -> Dict[str, int]:
+    with _MU:
+        return dict(_COUNTERS)
+
+
+def _count(name: str, n: int = 1) -> None:
+    with _MU:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + n
+
+
+def _xfer_dir() -> Optional[str]:
+    """Durable transfer root shared by the fleet: the recovery root.
+    No shared root → no remote submits, placement stays local-only."""
+    from h2o3_tpu import recovery
+    return recovery.recovery_dir()
+
+
+# ---------------- heartbeat payload (satellite 2: versioned) -----------
+
+def local_sched_payload() -> Dict[str, Any]:
+    """What this replica's heartbeat gossips into the member table."""
+    from h2o3_tpu import sched
+    s = sched.scheduler()
+    return {
+        "schema_version": SCHED_SCHEMA_VERSION,
+        "headroom_bytes": s.headroom_bytes(),
+        "queue_depth": s.class_depths(),
+        "running": s.running_count(),
+        "accepting": bool(sched.enabled() and not s.paused),
+    }
+
+
+def parse_sched_payload(raw: Any) -> Optional[Dict[str, Any]]:
+    """Validate a gossiped sched payload. Returns None — meaning "treat
+    the replica as no-headroom/local-only" — for anything that is not a
+    well-formed payload of a known-compatible schema version. Unknown
+    keys are ignored; a missing optional key takes its default."""
+    if not isinstance(raw, dict):
+        return None
+    try:
+        ver = int(raw.get("schema_version"))
+    except (TypeError, ValueError):
+        return None
+    if ver < 1:
+        return None
+
+    def _num(v) -> Optional[int]:
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return None
+        return int(v)
+
+    head = _num(raw.get("headroom_bytes"))
+    running = _num(raw.get("running"))
+    if head is None or running is None:
+        return None
+    qd_raw = raw.get("queue_depth")
+    depth = {}
+    for cls in ("interactive", "bulk", "background"):
+        v = _num(qd_raw.get(cls)) if isinstance(qd_raw, dict) else None
+        depth[cls] = v if v is not None and v >= 0 else 0
+    return {"schema_version": ver, "headroom_bytes": head,
+            "queue_depth": depth, "running": max(running, 0),
+            "accepting": bool(raw.get("accepting", True))}
+
+
+def fleet_view_from_table(table) -> Dict[str, Any]:
+    """The router's merged placement view, shipped back to replicas in
+    every heartbeat response. Payloads are parsed ROUTER-side so a
+    malformed member degrades identically everywhere."""
+    members = []
+    for m in table.members():
+        members.append({
+            "member_id": m.member_id,
+            "base_url": m.base_url,
+            "state": m.state,
+            "routable": bool(m.routable),
+            "sched": parse_sched_payload(m.sched),
+        })
+    return {"epoch": table.epoch, "members": members}
+
+
+def observe_fleet_view(view: Any, self_id: str) -> None:
+    """Replica-side ingest of the heartbeat response's fleet view."""
+    if not isinstance(view, dict) or not isinstance(
+            view.get("members"), list):
+        return
+    with _MU:
+        _GOSSIP["view"] = view
+        _GOSSIP["mono"] = time.monotonic()
+    # elastic membership: a member with headroom appearing while work
+    # is queued here absorbs it (throttled; runs off-thread)
+    try:
+        from h2o3_tpu import sched
+        if sched.scheduler().queue_depth() > 0 and \
+                _eligible_members(view, self_id):
+            maybe_rebalance("gossip")
+    except Exception:   # noqa: BLE001 — gossip ingest must never throw
+        pass
+
+
+def _gossip_ttl_s() -> float:
+    from h2o3_tpu.fleet import membership
+    return max(5.0 * membership.heartbeat_ms() / 1000.0, 3.0)
+
+
+def current_view() -> Optional[Dict[str, Any]]:
+    """The freshest fleet view this process can see: the local router's
+    table when this process IS a router (never creates one), else the
+    last gossiped view if fresh. None → local-only placement."""
+    from h2o3_tpu import fleet
+    r = fleet.active_router()
+    if r is not None:
+        view = fleet_view_from_table(r.table)
+        if view["members"]:
+            return view
+    with _MU:
+        view, mono = _GOSSIP["view"], _GOSSIP["mono"]
+    if view is not None and time.monotonic() - mono < _gossip_ttl_s():
+        return view
+    return None
+
+
+# ---------------- placement --------------------------------------------
+
+def _eligible_members(view: Dict[str, Any],
+                      self_id: str) -> List[Dict[str, Any]]:
+    """Members a train could be handed to: alive, routable, advertising
+    a parseable + accepting sched payload. A member with missing sched
+    fields is local-only by the satellite-2 degradation contract."""
+    out = []
+    for m in view.get("members") or []:
+        if not isinstance(m, dict) or m.get("member_id") == self_id:
+            continue
+        if m.get("state") != "alive" or not m.get("routable"):
+            continue
+        sch = m.get("sched")
+        if isinstance(sch, dict) and "schema_version" not in sch:
+            sch = parse_sched_payload(sch)   # raw (un-parsed) table row
+        elif not isinstance(sch, dict):
+            sch = parse_sched_payload(sch)
+        if sch is None or not sch.get("accepting", True):
+            continue
+        out.append({**m, "sched": sch})
+    return out
+
+
+def _fits(sch: Dict[str, Any], need_bytes: int) -> bool:
+    head = sch.get("headroom_bytes", 0)
+    return head < 0 or head >= max(int(need_bytes), 0)
+
+
+def _headroom_key(m: Dict[str, Any]):
+    sch = m["sched"]
+    # prefer unlimited (-1) members, then most headroom, then least
+    # running, then stable id order
+    return (sch["headroom_bytes"] < 0, sch["headroom_bytes"],
+            -sch["running"], m["member_id"])
+
+
+def _local_headroom_bytes() -> int:
+    """Local admission headroom, honoring the idle-admit rule: an idle
+    scheduler admits ANY estimate, so an idle local process always wins
+    placement ties."""
+    from h2o3_tpu import sched
+    s = sched.scheduler()
+    if s.running_count() == 0 and s.queue_depth() == 0:
+        return -1
+    return s.headroom_bytes()
+
+
+def place_for_submit(pr_name: str, share: str, need_bytes: int
+                     ) -> Tuple[Optional[Dict[str, Any]],
+                                Optional[Dict[str, Any]]]:
+    """The fleet placement decision for one submission. Returns
+    ``(placement, fleet_snapshot)``: placement is ``{"member", "epoch"}``
+    when the train should run remotely (pinned to the membership epoch
+    the decision was made under), None when it should run locally;
+    fleet_snapshot is recorded on the local entry when NO member had
+    headroom (the queue-locally-with-evidence contract)."""
+    view = current_view()
+    if view is None:
+        return None, None                    # fleet absent → local-only
+    epoch = int(view.get("epoch") or 0)
+    self_id = local_member_id()
+    eligible = _eligible_members(view, self_id)
+    cands = [m for m in eligible if _fits(m["sched"], need_bytes)]
+    local_head = _local_headroom_bytes()
+    local_fits = local_head < 0 or local_head >= need_bytes
+    # grid/AutoML waves (bulk class, non-default share group) SPREAD:
+    # round-robin the wave's children across local + every fitting
+    # member so one grid fans out instead of serializing locally
+    if pr_name == "bulk" and share != "default" and cands:
+        slots: List[Optional[Dict[str, Any]]] = []
+        if local_fits:
+            slots.append(None)               # the local slot
+        slots.extend(sorted(cands, key=lambda m: m["member_id"]))
+        with _MU:
+            cursor = _RR.get(share, 0)
+            _RR[share] = cursor + 1
+        pick = slots[cursor % len(slots)]
+        if pick is None:
+            return None, None
+        return {"member": pick, "epoch": epoch}, None
+    if local_fits:
+        return None, None                    # local wins ties
+    if cands:
+        best = max(cands, key=_headroom_key)
+        return {"member": best, "epoch": epoch}, None
+    # no headroom anywhere: queue locally, snapshot the evidence
+    snapshot = {
+        "epoch": epoch, "no_headroom": True, "time": time.time(),
+        "members": [{"member_id": m["member_id"],
+                     "headroom_bytes": m["sched"]["headroom_bytes"]}
+                    for m in eligible]}
+    return None, snapshot
+
+
+# ---------------- peer HTTP (fleet-peer-discipline idiom) --------------
+
+def _post_json(url: str, payload: Dict[str, Any], *, timeout_s: float,
+               site: str, attempts: int = 2) -> Dict[str, Any]:
+    from h2o3_tpu import resilience
+    data = json.dumps(payload).encode()
+
+    def _call():
+        req = urllib.request.Request(
+            url, data=data, method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            return json.loads(r.read().decode())
+
+    return resilience.retry_transient(_call, site=site,
+                                      attempts=attempts)
+
+
+def _get_json(url: str, *, timeout_s: float, site: str,
+              attempts: int = 1) -> Dict[str, Any]:
+    from h2o3_tpu import resilience
+
+    def _call():
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            return json.loads(r.read().decode())
+
+    return resilience.retry_transient(_call, site=site,
+                                      attempts=attempts)
+
+
+# ---------------- remote submission ------------------------------------
+
+def _result_path(model_key: str) -> Optional[str]:
+    root = _xfer_dir()
+    if not root:
+        return None
+    return os.path.join(root, "fleet", "results", f"{model_key}.zip")
+
+
+def _export_frame(frame) -> Optional[Tuple[str, str]]:
+    """Durable frame artifact under the transfer root, reused across a
+    wave's children (key + nrow fingerprint the content well enough for
+    the in-session case; recovery's signature scheme guards the
+    cross-boot case)."""
+    root = _xfer_dir()
+    if root is None:
+        return None
+    key = getattr(frame, "key", None)
+    nrow = getattr(frame, "nrow", None)
+    if not key or not nrow:
+        return None
+    d = os.path.join(root, "fleet", "frames")
+    art_key = f"{key}__{nrow}"
+    path = os.path.join(d, f"{art_key}.zip")
+    if not os.path.exists(path):
+        from h2o3_tpu.persist import save_frame
+        os.makedirs(d, exist_ok=True)
+        path = save_frame(frame, d, force=True, key=art_key)
+    return path, str(key)
+
+
+def _submit_eligible(builder, kwargs: Dict[str, Any]) -> bool:
+    if getattr(builder, "algo", "") not in _REMOTE_ALGOS:
+        return False
+    if kwargs.get("validation_frame") is not None:
+        return False
+    if _xfer_dir() is None:
+        return False
+    frame = kwargs.get("training_frame")
+    return frame is not None and getattr(frame, "key", None) is not None
+
+
+def _build_submit_payload(builder, job, kwargs: Dict[str, Any],
+                          pr_name: str, share: str,
+                          checkpoint_path: Optional[str] = None
+                          ) -> Optional[Dict[str, Any]]:
+    exported = _export_frame(kwargs.get("training_frame"))
+    if exported is None:
+        return None
+    frame_path, frame_key = exported
+    from h2o3_tpu.persist import _json_safe
+    params = dict(builder.params)
+    for k in ("training_frame", "validation_frame", "response_column"):
+        params.pop(k, None)
+    model_key = builder._model_key()
+    params["model_id"] = model_key
+    if checkpoint_path:
+        params["checkpoint"] = checkpoint_path
+    return {
+        "schema_version": SCHED_SCHEMA_VERSION,
+        "algo": builder.algo,
+        "params": _json_safe(params),
+        "y": kwargs.get("y"),
+        "x": list(kwargs["x"]) if kwargs.get("x") else None,
+        "frame_path": frame_path,
+        "frame_key": frame_key,
+        "priority": pr_name,
+        "share": share,
+        "trace_id": getattr(job, "trace_id", None),
+        "model_key": model_key,
+        "result_path": _result_path(model_key),
+        "resuming": bool(getattr(builder, "_resuming", False)
+                         or checkpoint_path),
+        "submitter": local_member_id(),
+    }
+
+
+def _submit_timeout_s() -> float:
+    return _knob_s("H2O3_FLEET_SCHED_SUBMIT_TIMEOUT_S", 10.0)
+
+
+def _hand_off(entry, member: Dict[str, Any],
+              checkpoint_path: Optional[str] = None,
+              pre_proxy=None, migrated: bool = False) -> bool:
+    """POST one entry's submission to a member; on success the local
+    entry becomes a proxy for the remote job. False → caller keeps the
+    entry local (and no entry/job state was touched). ``pre_proxy``
+    runs between acceptance and the first proxy poll — migration uses
+    it to bank the preempted run segment exactly once."""
+    from h2o3_tpu.sched import core as sched_core
+    pr_name = sched_core.PRIORITY_NAMES[entry.priority]
+    payload = _build_submit_payload(entry.builder, entry.job,
+                                    entry.kwargs, pr_name, entry.share,
+                                    checkpoint_path=checkpoint_path)
+    if payload is None:
+        return False
+    try:
+        out = _post_json(f"{member['base_url']}/3/FleetSched/submit",
+                         payload, timeout_s=_submit_timeout_s(),
+                         site="fleet.sched.submit", attempts=1)
+    except Exception as e:   # noqa: BLE001 — local queue is the fallback
+        from h2o3_tpu.log import warn
+        warn("fleet-sched: hand-off of %s to %s failed: %r",
+             entry.job.key, member.get("member_id"), e)
+        return False
+    if not isinstance(out, dict) or not out.get("ok"):
+        return False
+    entry.remote_member = member.get("member_id")
+    if pre_proxy is not None:
+        pre_proxy()
+    _count("remote_submits")
+    _start_proxy(entry, member, str(out.get("job_key")),
+                 payload["model_key"], payload["result_path"],
+                 migrated=migrated)
+    return True
+
+
+def _placer_hook(builder, job, kwargs: Dict[str, Any], pr_name: str,
+                 share: str, est, caller_runs: bool):
+    """Installed as sched.core.PLACER. Returns ``(entry, snapshot)``:
+    a fully-proxied remote Entry (submit() returns it without queueing)
+    or ``(None, snapshot-or-None)`` for the local path."""
+    if getattr(_REMOTE_TLS, "on", False):
+        return None, None     # remotely-placed trains never re-place
+    if not _submit_eligible(builder, kwargs):
+        return None, None
+    placement, snapshot = place_for_submit(pr_name, share, est.bytes)
+    if placement is None:
+        return None, snapshot
+    from h2o3_tpu.sched import core as sched_core
+    entry = sched_core.Entry(
+        builder, job, kwargs, sched_core.PRIORITY_LEVELS[pr_name],
+        share, est, seq=0, caller_runs=caller_runs)
+    job.mark_queued()
+    if not _hand_off(entry, placement["member"]):
+        return None, None                    # fall back to local queue
+    return entry, None
+
+
+# ---------------- target-side ingest -----------------------------------
+
+def _load_frame_cached(path: str):
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        mtime = 0.0
+    with _MU:
+        hit = _FRAMES.get(path)
+    if hit is not None and hit[0] == mtime:
+        return hit[1]
+    from h2o3_tpu.persist import load_frame
+    frame = load_frame(path)
+    with _MU:
+        _FRAMES[path] = (mtime, frame)
+    return frame
+
+
+def handle_remote_submit(b: Dict[str, Any]) -> Dict[str, Any]:
+    """Target side of POST /3/FleetSched/submit: reconstruct the
+    submission and run it through THIS process's scheduler under the
+    original priority class, share group and trace id. The result is
+    registered in the local DKV and exported to ``result_path`` so the
+    submitter's proxy (or the chaos harness) can finalize from it."""
+    from h2o3_tpu import dkv, recovery
+    from h2o3_tpu.log import info
+    algo = str(b.get("algo") or "")
+    model_key = str(b.get("model_key") or "")
+    if not model_key:
+        raise ValueError("fleet submit needs model_key")
+    result_path = b.get("result_path")
+    # fast path: an evict-requeue whose checkpoint already holds every
+    # requested tree — register the artifact, no training needed
+    if b.get("register_artifact"):
+        from h2o3_tpu.persist import load_model, save_model
+        model = load_model(str(b["register_artifact"]))
+        model.key = model_key
+        dkv.put(model_key, "model", model)
+        if result_path:
+            os.makedirs(os.path.dirname(result_path), exist_ok=True)
+            save_model(model, os.path.dirname(result_path), force=True,
+                       filename=os.path.basename(result_path))
+        _count("remote_received")
+        return {"ok": True, "job_key": None, "model_key": model_key,
+                "member_id": local_member_id(),
+                "completed_from_artifact": True}
+    cls = recovery._estimator_class(algo)
+    if cls is None:
+        raise ValueError(f"fleet submit: unsupported algo '{algo}'")
+    frame_path = str(b.get("frame_path") or "")
+    if not frame_path or not os.path.exists(frame_path):
+        raise ValueError(f"fleet submit: frame artifact missing "
+                         f"({frame_path or 'no path'})")
+    frame = _load_frame_cached(frame_path)
+    params = dict(b.get("params") or {})
+    params["model_id"] = model_key
+    pr = b.get("priority")
+    from h2o3_tpu import sched
+    if pr not in sched.PRIORITY_LEVELS:
+        pr = "bulk"
+    share = str(b.get("share") or "fleet")
+    from h2o3_tpu.telemetry import trace as _trace
+    trace_id = b.get("trace_id") or None
+    est = cls(**params)
+    _REMOTE_TLS.on = True
+    resuming = bool(b.get("resuming"))
+    if resuming:
+        recovery._RESUME_CTX.on = True       # RECOVERING badge on /3/Jobs
+    try:
+        with sched.submit_context(priority=pr, share=share):
+            if trace_id:
+                with _trace.trace_context(trace_id):
+                    est.train(y=b.get("y"), x=b.get("x") or None,
+                              training_frame=frame, background=True)
+            else:
+                est.train(y=b.get("y"), x=b.get("x") or None,
+                          training_frame=frame, background=True)
+    finally:
+        _REMOTE_TLS.on = False
+        if resuming:
+            recovery._RESUME_CTX.on = False
+    job = est.job
+    _count("remote_received")
+    info("fleet-sched: accepted %s %s from %s (priority=%s share=%s)",
+         algo, model_key, b.get("submitter"), pr, share)
+    _executor().submit(_finish_remote, job, model_key, result_path)
+    return {"ok": True, "job_key": job.key, "model_key": model_key,
+            "member_id": local_member_id()}
+
+
+def _finish_remote(job, model_key: str,
+                   result_path: Optional[str]) -> None:
+    """Export a remotely-submitted train's result once it completes so
+    the submitting replica can finalize its proxy job from it."""
+    try:
+        model = job.join()
+        if model is None:
+            return
+        from h2o3_tpu import dkv
+        model.key = model_key
+        dkv.put(model_key, "model", model)
+        if result_path:
+            from h2o3_tpu.persist import save_model
+            os.makedirs(os.path.dirname(result_path), exist_ok=True)
+            save_model(model, os.path.dirname(result_path), force=True,
+                       filename=os.path.basename(result_path))
+    except Exception as e:   # noqa: BLE001 — status travels via /3/Jobs
+        from h2o3_tpu.log import warn
+        warn("fleet-sched: result export for %s failed: %r",
+             model_key, e)
+
+
+# ---------------- submitter-side proxy ---------------------------------
+
+def _proxy_fail_s() -> float:
+    return _knob_s("H2O3_FLEET_SCHED_PROXY_FAIL_S", 10.0)
+
+
+def _start_proxy(entry, member: Dict[str, Any], remote_job_key: str,
+                 model_key: str, result_path: Optional[str],
+                 migrated: bool = False) -> None:
+    _executor().submit(_proxy_loop, entry, member, remote_job_key,
+                       model_key, result_path, migrated)
+
+
+def _finalize_proxy_failure(entry, msg: str) -> None:
+    from h2o3_tpu import jobs as jobs_mod
+    job = entry.job
+    job.status = jobs_mod.FAILED
+    job.exception_msg = msg
+    job.end_time = time.time()
+    job._end_mono = time.monotonic()
+    job._done_evt.set()
+    _proxy_done(entry)
+
+
+def _proxy_done(entry) -> None:
+    """Job finalized FIRST, then the entry turns terminal, then the
+    scheduler cv wakes: run_to_completion/wait_any block on the cv and
+    the grid drain reads job.status/result the moment done is set."""
+    entry.done.set()
+    from h2o3_tpu import sched
+    sched.scheduler().poke()
+
+
+def _requeue_local(entry) -> None:
+    """The remote side is gone (or never answered): pull the entry back
+    into the LOCAL queue — a lost replica must cost a re-run, never a
+    lost train."""
+    from h2o3_tpu import sched
+    from h2o3_tpu.log import warn
+    warn("fleet-sched: remote %s for %s unreachable — requeueing "
+         "locally", entry.remote_member, entry.job.key)
+    entry.remote_member = None
+    sched.scheduler().requeue(entry)
+
+
+def _proxy_loop(entry, member: Dict[str, Any], remote_job_key: str,
+                model_key: str, result_path: Optional[str],
+                migrated: bool) -> None:
+    """Mirror the remote job onto the LOCAL job key: status, progress
+    and the terminal result all follow the migration on /3/Jobs."""
+    from h2o3_tpu import jobs as jobs_mod
+    job = entry.job
+    base = str(member["base_url"]).rstrip("/")
+    url = (f"{base}/3/Jobs/"
+           f"{urllib.parse.quote(remote_job_key, safe='')}")
+    poll_s = max(_knob_s("H2O3_FLEET_SCHED_POLL_S", 0.15), 0.02)
+    fail_mono: Optional[float] = None
+    cancel_sent = False
+    while True:
+        if job.cancel_requested and not cancel_sent:
+            cancel_sent = True
+            try:
+                _post_json(f"{url}/cancel", {},
+                           timeout_s=_submit_timeout_s(),
+                           site="fleet.sched.cancel", attempts=1)
+            except Exception:   # noqa: BLE001 — mirror whatever lands
+                pass
+        try:
+            out = _get_json(url, timeout_s=_submit_timeout_s(),
+                            site="fleet.sched.poll")
+            fail_mono = None
+        except Exception:   # noqa: BLE001 — bounded retry window below
+            now = time.monotonic()
+            if fail_mono is None:
+                fail_mono = now
+            if now - fail_mono > _proxy_fail_s():
+                # replica death AFTER completion still counts: the
+                # result artifact is the durable source of truth
+                if result_path and os.path.exists(result_path):
+                    _finalize_proxy_done(entry, model_key, result_path,
+                                         migrated)
+                    return
+                _requeue_local(entry)
+                return
+            time.sleep(poll_s)
+            continue
+        j = (out.get("jobs") or [{}])[0]
+        st = j.get("status")
+        try:
+            job.set_progress(float(j.get("progress") or 0.0))
+        except Exception:   # noqa: BLE001 — progress is advisory
+            pass
+        if st == "DONE":
+            _finalize_proxy_done(entry, model_key, result_path,
+                                 migrated)
+            return
+        if st in ("FAILED", "CANCELLED"):
+            job.status = (jobs_mod.FAILED if st == "FAILED"
+                          else jobs_mod.CANCELLED)
+            job.exception_msg = j.get("exception_msg") or (
+                f"remote train on {entry.remote_member} ended {st}")
+            job.end_time = time.time()
+            job._end_mono = time.monotonic()
+            job._done_evt.set()
+            _proxy_done(entry)
+            return
+        if st in ("RUNNING", "RECOVERING") and \
+                job.status == jobs_mod.QUEUED:
+            job.mark_dispatched()            # queue-wait clock stops here
+            if st == "RECOVERING":
+                job.status = jobs_mod.RECOVERING
+        time.sleep(poll_s)
+
+
+def _finalize_proxy_done(entry, model_key: str,
+                         result_path: Optional[str],
+                         migrated: bool) -> None:
+    from h2o3_tpu import dkv, jobs as jobs_mod, recovery
+    job = entry.job
+    model = None
+    if result_path:
+        deadline = time.monotonic() + _knob_s(
+            "H2O3_FLEET_SCHED_RESULT_WAIT_S", 120.0)
+        while not os.path.exists(result_path) \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        try:
+            from h2o3_tpu.persist import load_model
+            model = load_model(result_path)
+        except Exception as e:   # noqa: BLE001 — fail the job honestly
+            _finalize_proxy_failure(
+                entry, f"remote train completed on "
+                       f"{entry.remote_member} but its result artifact "
+                       f"could not be loaded: {e!r}")
+            return
+    if model is not None:
+        model.key = model_key
+        dkv.put(model_key, "model", model)
+    try:
+        # the train is complete — this process's manifest (if the train
+        # started here before migrating) must not resurrect it at boot
+        recovery.complete_training(model_key)
+    except Exception:   # noqa: BLE001 — advisory cleanup
+        pass
+    if job.status == jobs_mod.QUEUED:
+        job.mark_dispatched()
+    job.result = model
+    job.set_progress(1.0)
+    job.status = jobs_mod.DONE
+    job.end_time = time.time()
+    job._end_mono = time.monotonic()
+    job._done_evt.set()
+    _proxy_done(entry)
+
+
+# ---------------- preempt-migrate --------------------------------------
+
+def _migration_enabled() -> bool:
+    return os.environ.get("H2O3_FLEET_SCHED_MIGRATE", "1") not in (
+        "0", "false", "")
+
+
+def _export_ckpt(builder) -> Optional[str]:
+    """The preempted train's DKV ``<key>_ckpt`` as a durable artifact a
+    different replica can resume from (PR 9's cross-process format)."""
+    root = _xfer_dir()
+    if root is None:
+        return None
+    from h2o3_tpu import dkv
+    key = builder._model_key()
+    ent = dkv.get_opt(f"{key}_ckpt")
+    if ent is None:
+        return None
+    from h2o3_tpu.persist import save_model
+    d = os.path.join(root, "fleet", "ckpts")
+    os.makedirs(d, exist_ok=True)
+    return save_model(ent[1], d, force=True,
+                      filename=f"{key}_migrate.zip")
+
+
+def _migrate_entry(entry) -> bool:
+    """Installed as sched.core.MIGRATOR — called OUTSIDE the scheduler
+    cv after a preempted entry unwound. True → the train now runs on
+    another replica (the local entry proxies it); False → the caller
+    requeues locally (PR 15 behavior)."""
+    if not _migration_enabled():
+        return False
+    if not _submit_eligible(entry.builder, entry.kwargs):
+        return False
+    placement = _place_for_migrate(entry.estimate.bytes)
+    if placement is None:
+        return False
+    ckpt_path = None
+    try:
+        ckpt_path = _export_ckpt(entry.builder)
+    except Exception:   # noqa: BLE001 — a clean remote re-run still wins
+        ckpt_path = None
+    job = entry.job
+
+    def _pre():
+        # banks the run segment + counts the preempt exactly once — the
+        # scheduler's local-requeue fallback does its own marking, so
+        # nothing is touched until the hand-off is accepted
+        job.mark_requeued()
+        entry.preempt_cycles += 1
+        entry.dispatch_mono = None
+
+    if not _hand_off(entry, placement["member"],
+                     checkpoint_path=ckpt_path, pre_proxy=_pre,
+                     migrated=True):
+        return False
+    _count("migrations")
+    from h2o3_tpu.log import info
+    info("fleet-sched: migrated %s to %s (ckpt=%s)", job.key,
+         placement["member"].get("member_id"), bool(ckpt_path))
+    return True
+
+
+def _place_for_migrate(need_bytes: int) -> Optional[Dict[str, Any]]:
+    """Placement for a preempted train: remote members only (it was
+    just preempted here — local has no headroom by construction), epoch
+    pinned like every placement decision."""
+    view = current_view()
+    if view is None:
+        return None
+    epoch = int(view.get("epoch") or 0)
+    cands = [m for m in _eligible_members(view, local_member_id())
+             if _fits(m["sched"], need_bytes)]
+    if not cands:
+        return None
+    return {"member": max(cands, key=_headroom_key), "epoch": epoch}
+
+
+# ---------------- elastic membership -----------------------------------
+
+def _rebalance_min_interval_s() -> float:
+    return _knob_s("H2O3_FLEET_SCHED_REBALANCE_S", 1.0)
+
+
+def maybe_rebalance(reason: str = "gossip") -> None:
+    """Throttled, off-thread rebalance trigger (join handlers, gossip
+    ingest, the router ticker)."""
+    now = time.monotonic()
+    with _MU:
+        if now - _REBAL["last"] < _rebalance_min_interval_s():
+            return
+        _REBAL["last"] = now
+    _executor().submit(_safe_rebalance, reason)
+
+
+def _safe_rebalance(reason: str) -> None:
+    try:
+        moved = rebalance_queued()
+        if moved:
+            from h2o3_tpu.log import info
+            info("fleet-sched: rebalanced %d queued train(s) (%s)",
+                 moved, reason)
+    except Exception as e:   # noqa: BLE001 — rebalance is best-effort
+        from h2o3_tpu.log import warn
+        warn("fleet-sched: rebalance failed: %r", e)
+
+
+def rebalance_queued() -> int:
+    """Steal locally-queued eligible entries and hand them to members
+    with headroom (a replica joining mid-grid absorbs queued children).
+    Entries that fail to hand off go straight back to the local queue."""
+    view = current_view()
+    if view is None:
+        return 0
+    epoch = int(view.get("epoch") or 0)   # the view this decision pins
+    cands = [m for m in _eligible_members(view, local_member_id())]
+    if not cands:
+        return 0
+    from h2o3_tpu import sched
+    s = sched.scheduler()
+
+    def _eligible_entry(e) -> bool:
+        return (e.remote_member is None
+                and _submit_eligible(e.builder, e.kwargs))
+
+    taken = s.steal_queued(_eligible_entry,
+                           limit=max(2 * len(cands), 2))
+    moved = 0
+    for i, e in enumerate(taken):
+        fitting = [m for m in cands if _fits(m["sched"],
+                                             e.estimate.bytes)]
+        handed = False
+        if fitting:
+            target = fitting[i % len(fitting)]
+            ckpt = None
+            if e.preempt_cycles > 0:
+                try:
+                    ckpt = _export_ckpt(e.builder)
+                except Exception:   # noqa: BLE001 — clean re-run wins
+                    ckpt = None
+            handed = _hand_off(e, target, checkpoint_path=ckpt)
+        if handed:
+            moved += 1
+        else:
+            s.requeue(e)
+    if moved:
+        _count("rebalanced", moved)
+        from h2o3_tpu.log import info
+        info("fleet-sched: handed %d queued train(s) to %d member(s) "
+             "(epoch %d)", moved, len(cands), epoch)
+    return moved
+
+
+def router_tick(table) -> None:
+    """Router-ticker hook: when this process has queued work and the
+    table shows members with headroom, trigger a rebalance."""
+    try:
+        from h2o3_tpu import sched
+        if not sched.enabled():
+            return
+        if sched.scheduler().queue_depth() <= 0:
+            return
+        view = fleet_view_from_table(table)
+        if _eligible_members(view, local_member_id()):
+            maybe_rebalance("router-tick")
+    except Exception:   # noqa: BLE001 — the ticker must never die here
+        pass
+
+
+def on_member_departed(member, reason: str) -> None:
+    """MemberTable depart callback (router process): an EVICTED
+    replica's RUNNING checkpointing trains are re-queued fleet-wide
+    from their last chunk commit via the recovery manifests."""
+    if reason != "evicted":
+        return                # graceful leave drains its own work
+    _executor().submit(_requeue_departed, member.member_id)
+
+
+def _requeue_departed(member_id: str) -> None:
+    from h2o3_tpu import recovery
+    from h2o3_tpu.log import info, warn
+    if recovery.recovery_dir() is None:
+        return
+    try:
+        entries, _corrupt = recovery.scan(quarantine=False)
+    except Exception as e:   # noqa: BLE001 — scan failure is not fatal
+        warn("fleet-sched: evict-requeue scan failed: %r", e)
+        return
+    mine = [e for e in entries if e.get("member_id") == member_id]
+    if not mine:
+        return
+    info("fleet-sched: evicted %s left %d in-flight train(s) — "
+         "re-queueing fleet-wide", member_id, len(mine))
+    for ent in mine:
+        try:
+            if _resubmit_manifest(ent):
+                _count("evict_requeues")
+        except Exception as e:   # noqa: BLE001 — per-train isolation
+            warn("fleet-sched: evict-requeue of %s failed: %r",
+                 ent.get("model_key"), e)
+
+
+def _resubmit_manifest(ent: Dict[str, Any]) -> bool:
+    """One evicted replica's manifest → a live member (or this process
+    as the last resort). The manifest carries the original priority
+    class + share group (satellite 1), the trace id, and the newest
+    durable checkpoint — the resume starts from the last chunk commit."""
+    model_key = str(ent.get("model_key") or "")
+    params = dict(ent.get("params") or {})
+    params["model_id"] = model_key
+    if ent.get("latest_ckpt"):
+        params["checkpoint"] = ent["latest_ckpt"]
+    payload = {
+        "schema_version": SCHED_SCHEMA_VERSION,
+        "algo": ent.get("algo"),
+        "params": params,
+        "y": ent.get("y"),
+        "x": ent.get("x"),
+        "frame_path": ent.get("frame_path"),
+        "frame_key": ent.get("frame_key"),
+        "priority": ent.get("priority") or "background",
+        "share": ent.get("share") or "recovery",
+        "trace_id": ent.get("trace_id"),
+        "model_key": model_key,
+        "result_path": _result_path(model_key),
+        "resuming": True,
+        "submitter": local_member_id(),
+    }
+    try:
+        ntrees = int(params.get("ntrees", 0) or 0)
+    except (TypeError, ValueError):
+        ntrees = 0
+    if ent.get("latest_ckpt") and ntrees and \
+            int(ent.get("ckpt_trees") or 0) >= ntrees:
+        payload["register_artifact"] = ent["latest_ckpt"]
+    view = current_view()
+    if view is not None:
+        epoch = int(view.get("epoch") or 0)   # placement pins the epoch
+        cands = sorted(_eligible_members(view, local_member_id()),
+                       key=_headroom_key, reverse=True)
+        for m in cands:
+            try:
+                out = _post_json(
+                    f"{m['base_url']}/3/FleetSched/submit", payload,
+                    timeout_s=_submit_timeout_s(),
+                    site="fleet.sched.requeue", attempts=1)
+            except Exception:   # noqa: BLE001 — try the next member
+                continue
+            if isinstance(out, dict) and out.get("ok"):
+                from h2o3_tpu.log import info
+                info("fleet-sched: %s re-queued on %s (epoch %d)",
+                     model_key, m.get("member_id"), epoch)
+                return True
+    # no live member took it: this process resumes it (the router is a
+    # fleet member too — a 1-survivor fleet must still finish the train)
+    from h2o3_tpu import sched
+    if not sched.enabled():
+        return False
+    from h2o3_tpu import recovery
+    out = recovery._resume_entry(ent, wait=False)
+    return bool(out.get("job_key") or out.get(
+        "completed_from_artifact"))
+
+
+# ---------------- cluster snapshot (satellite 3) -----------------------
+
+def cluster_scheduler_snapshot() -> Dict[str, Any]:
+    """GET /3/Scheduler?scope=cluster: this process's snapshot merged
+    with every peer's through the PR-8 telemetry peer plane (same
+    member-sourced peer list, dead peers flagged, never fatal)."""
+    from h2o3_tpu import sched
+    from h2o3_tpu.telemetry import snapshot as telesnap
+    local = sched.scheduler().snapshot()
+    replicas: Dict[str, Any] = {local_member_id(): local}
+    failed: List[Dict[str, Any]] = []
+    peers, departed = [], []
+    try:
+        peers, departed = telesnap.peer_view()
+    except Exception as e:   # noqa: BLE001 — never fatal
+        failed.append({"peer": "peer_view", "error": repr(e)})
+    for peer in dict.fromkeys(peers):
+        url = peer if peer.startswith("http") else f"http://{peer}"
+        try:
+            snap = _get_json(f"{url}/3/Scheduler",
+                             timeout_s=telesnap.PEER_TIMEOUT_S,
+                             site="fleet.sched.cluster")
+            snap.pop("__meta", None)
+            replicas[peer] = snap
+        except Exception as e:   # noqa: BLE001 — dead peers are flagged
+            failed.append({"peer": peer, "error": repr(e)})
+    heads = [r.get("headroom_bytes") for r in replicas.values()
+             if isinstance(r.get("headroom_bytes"), int)]
+    totals = {
+        "replicas": len(replicas),
+        "queued": sum(len(r.get("queued") or [])
+                      for r in replicas.values()),
+        "running": sum(len(r.get("running") or [])
+                       for r in replicas.values()),
+        "headroom_bytes": (-1 if any(h < 0 for h in heads)
+                           else sum(heads)) if heads else 0,
+    }
+    return {"scope": "cluster", "replicas": replicas, "totals": totals,
+            "peers_failed": failed, "peers_evicted": departed,
+            "counters": counters()}
+
+
+# ---------------- wiring -----------------------------------------------
+
+def install_hooks() -> None:
+    """Route every local submission and preemption through the fleet
+    (sched.core hooks). Installed by FleetAgent.start (replica side)
+    and fleet._wire (router side); both hooks no-op cheaply when no
+    fleet view exists."""
+    from h2o3_tpu.sched import core as sched_core
+    sched_core.PLACER = _placer_hook
+    sched_core.MIGRATOR = _migrate_entry
+
+
+def uninstall_hooks() -> None:
+    from h2o3_tpu.sched import core as sched_core
+    if sched_core.PLACER is _placer_hook:
+        sched_core.PLACER = None
+    if sched_core.MIGRATOR is _migrate_entry:
+        sched_core.MIGRATOR = None
+
+
+def reset() -> None:
+    """Tests / fleet.reset(): drop hooks, gossip, caches and counters.
+    In-flight proxy loops keep their entry references and finish."""
+    uninstall_hooks()
+    with _MU:
+        _LOCAL["member_id"] = None
+        _LOCAL["base_url"] = None
+        _GOSSIP["view"] = None
+        _GOSSIP["mono"] = 0.0
+        _RR.clear()
+        _FRAMES.clear()
+        _REBAL["last"] = 0.0
+        for k in list(_COUNTERS):
+            _COUNTERS[k] = 0

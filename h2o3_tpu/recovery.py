@@ -206,6 +206,27 @@ def record_training(builder, job, frame, y, spec) -> Optional[str]:
         ckpt_dir = builder.params.get("in_training_checkpoints_dir")
         _remember_ckpt_dir(root, ckpt_dir)
         mesh = current_mesh()
+        # the submission's priority class + fair-share group (satellite
+        # of ISSUE 18): a crash/evict re-submit keeps its class instead
+        # of landing behind every bulk job in `background`
+        pr_name, share = None, None
+        try:
+            from h2o3_tpu import sched as _sched
+            entry = getattr(builder, "_sched_entry", None)
+            if entry is not None:
+                pr_name = _sched.PRIORITY_NAMES.get(entry.priority)
+                share = entry.share
+            if pr_name is None:
+                pr_name = _sched.context_priority()
+            if share is None:
+                share = _sched.context_share()
+        except Exception:   # noqa: BLE001 — class carry is best-effort
+            pass
+        try:
+            from h2o3_tpu.fleet.sched import local_member_id
+            member_id = local_member_id()
+        except Exception:   # noqa: BLE001
+            member_id = None
         attempts = 0
         if is_resuming():
             # the resume re-records its own manifest under the same
@@ -232,6 +253,9 @@ def record_training(builder, job, frame, y, spec) -> Optional[str]:
             "mesh": {"n_data": n_data_shards(mesh),
                      "n_model": n_model_shards(mesh)},
             "process": process_identity(),
+            "priority": pr_name,
+            "share": share,
+            "member_id": member_id,
             "resume_attempts": attempts,
             "time": time.time(),
         }
@@ -494,12 +518,17 @@ def _resume_entry(ent: Dict[str, Any], wait: bool) -> Dict[str, Any]:
     trace_id = ent.get("trace_id") or _trace.new_trace_id()
     _RESUME_CTX.on = True
     try:
-        # recovery resumes take the BACKGROUND priority class (ISSUE
-        # 15): a pod restart's catch-up work queues behind interactive
-        # and grid/automl trains instead of competing with them
+        # the resume keeps the ORIGINAL submission's priority class +
+        # share group when the manifest carries them (ISSUE 18
+        # satellite: an interactive train that died must not queue
+        # behind every bulk job); older manifests fall back to the
+        # ISSUE-15 background/recovery class
         from h2o3_tpu import sched
-        with sched.submit_context(priority="background",
-                                  share="recovery"), \
+        pr = ent.get("priority")
+        if pr not in sched.PRIORITY_LEVELS:
+            pr = "background"
+        with sched.submit_context(priority=pr,
+                                  share=ent.get("share") or "recovery"), \
                 _trace.trace_context(trace_id):
             est.train(y=ent.get("y"), x=ent.get("x") or None,
                       training_frame=frame, background=True)

@@ -2,6 +2,7 @@
 REST/Job layer and the model builders, device-memory-aware admission,
 checkpoint-based preemption. See sched/core.py for the design."""
 from h2o3_tpu.sched.admission import (Estimate,  # noqa: F401
+                                      admission_headroom,
                                       estimate_submission)
 from h2o3_tpu.sched.core import (BACKGROUND, BULK,  # noqa: F401
                                  CHECKPOINTABLE_ALGOS, INTERACTIVE,
@@ -14,8 +15,9 @@ from h2o3_tpu.sched.core import (BACKGROUND, BULK,  # noqa: F401
 __all__ = [
     "BACKGROUND", "BULK", "INTERACTIVE", "CHECKPOINTABLE_ALGOS",
     "PRIORITY_LEVELS", "PRIORITY_NAMES", "Entry", "Estimate",
-    "Scheduler", "SchedulerSaturatedError", "context_priority",
-    "context_share", "enabled", "estimate_submission",
+    "Scheduler", "SchedulerSaturatedError", "admission_headroom",
+    "context_priority", "context_share", "enabled",
+    "estimate_submission",
     "in_scheduled_run", "inline_run", "reset", "scheduler",
     "submit_context",
 ]

@@ -66,6 +66,18 @@ class Estimate(NamedTuple):
     source: str
 
 
+def admission_headroom(reserved_bytes: int) -> int:
+    """Admission budget minus the scheduler's reserved ledger; -1 means
+    an unlimited backend. This single number is what heartbeats gossip
+    into the fleet member table — a remote placement decision admits
+    against it exactly as the local gate would."""
+    from h2o3_tpu import memman
+    mm = memman.manager()
+    if mm.unlimited:
+        return -1
+    return max(mm.admission_budget() - int(reserved_bytes), 0)
+
+
 def _response_classes(frame, y: Optional[str]) -> int:
     try:
         from h2o3_tpu.frame.vec import T_ENUM

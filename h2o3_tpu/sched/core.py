@@ -65,6 +65,19 @@ CHECKPOINTABLE_ALGOS = frozenset({"gbm", "xgboost", "drf"})
 
 _TLS = threading.local()
 
+# Fleet scheduler hooks (h2o3_tpu/fleet/sched.py installs these; None →
+# PR 15's per-process behavior, bit-for-bit).
+# PLACER(builder, job, kwargs, pr_name, share, est, caller_runs) →
+#   (entry, snapshot): a fully-proxied remote Entry (submit() returns
+#   it without queueing) or (None, snapshot-or-None) for the local
+#   path; a non-None snapshot is the no-headroom-anywhere fleet
+#   evidence recorded on the queued entry.
+# MIGRATOR(entry) → bool: called OUTSIDE the scheduler cv after a
+#   preempted entry unwinds; True hands the train to another replica
+#   (the entry proxies it), False requeues locally.
+PLACER = None
+MIGRATOR = None
+
 
 class SchedulerSaturatedError(RuntimeError):
     """The run queue is at H2O3_SCHED_MAX_QUEUE — the submission is
@@ -145,7 +158,7 @@ class Entry:
     __slots__ = ("builder", "job", "kwargs", "priority", "share",
                  "estimate", "seq", "enqueue_mono", "dispatch_mono",
                  "done", "wait_reason", "preempt_cycles", "caller_runs",
-                 "granted")
+                 "granted", "fleet_snapshot", "remote_member")
 
     def __init__(self, builder, job, kwargs: Dict[str, Any],
                  priority: int, share: str, estimate: Estimate, seq: int,
@@ -168,6 +181,11 @@ class Entry:
         # and a foreground caller blocks anyway — its thread is free
         self.caller_runs = caller_runs
         self.granted = False            # toggled under the scheduler cv
+        # fleet scheduler state: the no-headroom-anywhere evidence
+        # recorded when the fleet could not take this entry, and the
+        # member id this entry currently proxies for (None = local)
+        self.fleet_snapshot: Optional[Dict[str, Any]] = None
+        self.remote_member: Optional[str] = None
 
     @property
     def checkpointable(self) -> bool:
@@ -240,6 +258,19 @@ class Scheduler:
             builder, kwargs.get("training_frame"), y=kwargs.get("y"),
             x=kwargs.get("x"),
             validation_frame=kwargs.get("validation_frame"))
+        fleet_snapshot = None
+        if PLACER is not None:
+            try:
+                placed, fleet_snapshot = PLACER(
+                    builder, job, kwargs, pr_name, share, est,
+                    caller_runs)
+            except Exception as e:   # noqa: BLE001 — local queue wins
+                placed, fleet_snapshot = None, None
+                from h2o3_tpu.log import warn
+                warn("sched: fleet placement failed for %s — running "
+                     "locally: %r", job.key, e)
+            if placed is not None:
+                return placed        # proxied remotely; never queued here
         with self._cv:
             depth = sum(len(dq) for od in self._queues.values()
                         for dq in od.values())
@@ -253,6 +284,7 @@ class Scheduler:
             entry = Entry(builder, job, kwargs, PRIORITY_LEVELS[pr_name],
                           share, est, self._seq,
                           caller_runs=caller_runs)
+            entry.fleet_snapshot = fleet_snapshot
             job.mark_queued()
             if getattr(builder, "_resuming", False):
                 # a restart-recovery resume surfaces as RECOVERING on
@@ -423,6 +455,7 @@ class Scheduler:
             terminal = True
             raise
         finally:
+            migrate = None
             with self._cv:
                 self._release_locked(entry)
                 if terminal:
@@ -438,10 +471,25 @@ class Scheduler:
                         job._end_mono = time.monotonic()
                         job._done_evt.set()
                     entry.done.set()
-                else:
+                elif MIGRATOR is None:
                     self._requeue_locked(entry)
+                else:
+                    migrate = MIGRATOR   # hand-off HTTP runs off-lock
                 self._update_gauges_locked()
                 self._cv.notify_all()
+            if migrate is not None:
+                migrated = False
+                try:
+                    migrated = bool(migrate(entry))
+                except Exception as e:   # noqa: BLE001 — local requeue
+                    from h2o3_tpu.log import warn
+                    warn("sched: preempt-migrate of %s failed — "
+                         "requeueing locally: %r", job.key, e)
+                if not migrated:
+                    with self._cv:
+                        self._requeue_locked(entry)
+                        self._update_gauges_locked()
+                        self._cv.notify_all()
 
     def _reserve_locked(self, entry: Entry) -> None:
         self._running[entry] = entry.estimate.bytes
@@ -563,6 +611,79 @@ class Scheduler:
         with self._cv:
             return len(self._running)
 
+    def class_depths(self) -> Dict[str, int]:
+        """Queue depth per priority class (gossiped on heartbeats)."""
+        with self._cv:
+            return {PRIORITY_NAMES[p]: sum(len(dq) for dq in od.values())
+                    for p, od in self._queues.items()}
+
+    def headroom_bytes(self) -> int:
+        """Admission headroom in bytes; -1 = unlimited backend."""
+        from h2o3_tpu.sched.admission import admission_headroom
+        with self._cv:
+            return admission_headroom(self._reserved)
+
+    def poke(self) -> None:
+        """Wake cv waiters after an EXTERNAL ``entry.done.set()`` (the
+        fleet proxy finalizing a remote result) — run_to_completion and
+        wait_any block on the cv, not the entry event."""
+        with self._cv:
+            self._cv.notify_all()
+
+    def requeue(self, entry: Entry) -> None:
+        """Return a fleet-proxied entry to the local queue (remote
+        replica unreachable, or a hand-off that did not stick)."""
+        from h2o3_tpu import jobs as jobs_mod
+        job = entry.job
+        # read/cleared OUTSIDE the cv like every other dispatch_mono
+        # write in this module — requeue() races nothing: the entry is
+        # proxied (not queued, not running) until re-injected below
+        was_dispatched = entry.dispatch_mono is not None
+        entry.dispatch_mono = None
+        with self._cv:
+            if job.status in jobs_mod._TERMINAL:
+                entry.done.set()
+                self._cv.notify_all()
+                return
+            if job.status in (jobs_mod.RUNNING, jobs_mod.RECOVERING) \
+                    and was_dispatched:
+                self._requeue_locked(entry)    # banks the run segment
+            else:
+                # still QUEUED (hand-off failed before any dispatch):
+                # re-inject without double-counting a preempt cycle
+                self._queues[entry.priority].setdefault(
+                    entry.share, deque()).appendleft(entry)
+            self._update_gauges_locked()
+            self._ensure_thread_locked()
+            self._cv.notify_all()
+
+    def steal_queued(self, eligible, limit: Optional[int] = None
+                     ) -> List[Entry]:
+        """Remove queued entries matching ``eligible`` for fleet
+        hand-off (a replica joining mid-grid absorbs queued children).
+        caller_runs and cancelled entries keep their local standing."""
+        taken: List[Entry] = []
+        with self._cv:
+            for od in self._queues.values():
+                for share in list(od):
+                    dq = od[share]
+                    keep: deque = deque()
+                    while dq:
+                        e = dq.popleft()
+                        if (limit is None or len(taken) < limit) \
+                                and not e.caller_runs \
+                                and not e.job.cancel_requested \
+                                and eligible(e):
+                            taken.append(e)
+                        else:
+                            keep.append(e)
+                    if keep:
+                        od[share] = keep
+                    else:
+                        del od[share]
+            self._update_gauges_locked()
+        return taken
+
     def _update_gauges_locked(self) -> None:
         from h2o3_tpu import memman
         self._g_depth.set(sum(len(dq) for od in self._queues.values()
@@ -600,6 +721,7 @@ class Scheduler:
                 "wait_s": round(now - e.enqueue_mono, 3),
                 "wait_reason": e.wait_reason,
                 "preempt_cycles": e.preempt_cycles,
+                "fleet": e.fleet_snapshot,
             } for prio, od in sorted(self._queues.items())
                 for share, dq in od.items() for e in dq]
             return {

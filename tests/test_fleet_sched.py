@@ -1,0 +1,573 @@
+"""Fleet scheduler (ISSUE 18): cluster-wide training placement,
+preempt-migrate, elastic membership.
+
+The contract under test: a train submitted to ANY replica runs on the
+member with admission headroom (local wins ties; no headroom anywhere
+queues locally with the fleet snapshot recorded as evidence); a
+preempted train's checkpoint hands to a replica with headroom and
+resumes BIT-identically; a replica joining mid-wave absorbs queued
+children; an evicted replica's RUNNING checkpointing trains re-queue
+fleet-wide from their last chunk commit. Degradation is explicit: no
+fleet (or heartbeats without sched fields, satellite 2) means
+local-only placement with zero errors or misroutes.
+
+The two-process spellings (real fleet over REST, SIGKILL) are marked
+slow to protect the tier-1 budget — the in-process REST round-trip and
+the local evict-fallback enforce the same parity acceptance cheaply,
+mirroring tests/test_restart_recovery.py's split.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu import dkv, faults, fleet, jobs, memman, recovery, sched
+from h2o3_tpu import serve
+from h2o3_tpu.fleet import sched as fleet_sched
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator as GBM
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HB_MS = "150"
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.delenv("H2O3_RECOVERY_DIR", raising=False)
+    fleet.reset()            # also resets fleet_sched hooks + counters
+    sched.reset()
+    yield
+    serve.shutdown_all()
+    fleet.reset()
+    memman.reset()
+    sched.reset()
+    faults.configure(None)
+
+
+def _frame(n=4000, F=6, seed=0, key=None):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    logit = X[:, 0] - 0.5 * X[:, 1]
+    cols = {f"x{i}": X[:, i] for i in range(F)}
+    cols["y"] = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)),
+                         "a", "b")
+    fr = h2o.Frame.from_numpy(cols)
+    fr.key = key
+    return fr
+
+
+def _tree_arrays(model):
+    import jax
+    return {k: np.asarray(jax.device_get(getattr(model, k)))
+            for k in ("_feat", "_thr", "_value")}
+
+
+def _assert_trees_equal(a, b, msg=""):
+    ta, tb = _tree_arrays(a), _tree_arrays(b)
+    for k in ta:
+        assert ta[k].shape == tb[k].shape, f"{msg}{k} shape"
+        assert np.array_equal(ta[k], tb[k], equal_nan=True), \
+            f"{msg}diverged in {k}"
+
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _get(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _m(mid, headroom=-1, running=0, accepting=True, state="alive",
+       routable=True):
+    return {"member_id": mid, "base_url": "http://127.0.0.1:9",
+            "state": state, "routable": routable,
+            "sched": {"schema_version": 1, "headroom_bytes": headroom,
+                      "queue_depth": {}, "running": running,
+                      "accepting": accepting}}
+
+
+def _gossip(members, epoch=7):
+    fleet_sched.observe_fleet_view(
+        {"epoch": epoch, "members": members}, "self@test")
+    fleet_sched.set_local_member("self@test", None)
+
+
+# ---------------- satellite 2: versioned heartbeat payload -------------
+
+
+def test_sched_payload_schema_versioned_roundtrip():
+    p = fleet_sched.local_sched_payload()
+    assert p["schema_version"] == fleet_sched.SCHED_SCHEMA_VERSION
+    parsed = fleet_sched.parse_sched_payload(p)
+    assert parsed is not None
+    assert parsed["headroom_bytes"] == p["headroom_bytes"]
+    assert parsed["running"] == p["running"]
+    assert set(parsed["queue_depth"]) == {
+        "interactive", "bulk", "background"}
+    # unknown keys are IGNORED (a newer minor schema interops)
+    extra = dict(p, future_field={"x": 1}, other=3)
+    assert fleet_sched.parse_sched_payload(extra) == parsed
+
+
+@pytest.mark.parametrize("raw", [
+    None, "garbage", 42, [],
+    {},                                        # no schema_version
+    {"schema_version": "x"},                   # unparseable version
+    {"schema_version": 0, "headroom_bytes": 1, "running": 0},
+    {"schema_version": 1},                     # missing sched fields
+    {"schema_version": 1, "headroom_bytes": True, "running": 0},
+    {"schema_version": 1, "headroom_bytes": 5, "running": "no"},
+])
+def test_malformed_sched_payload_means_no_headroom(raw):
+    assert fleet_sched.parse_sched_payload(raw) is None
+
+
+def test_member_without_sched_fields_is_local_only(monkeypatch):
+    """Satellite 2 degradation: a replica whose heartbeat predates the
+    sched schema is never placed onto — even when local is FULL the
+    submission queues locally (with the snapshot as evidence)."""
+    old = {"member_id": "old@h", "base_url": "http://127.0.0.1:9",
+           "state": "alive", "routable": True, "sched": None}
+    older = dict(old, member_id="older@h", sched={"load": 0.3})
+    _gossip([old, older], epoch=3)
+    monkeypatch.setattr(fleet_sched, "_local_headroom_bytes", lambda: 0)
+    placement, snap = fleet_sched.place_for_submit(
+        "interactive", "default", 10_000)
+    assert placement is None
+    assert snap is not None and snap["no_headroom"] is True
+    assert snap["epoch"] == 3
+    assert snap["members"] == []       # neither was placement-eligible
+
+
+# ---------------- placement ------------------------------------------
+
+
+def test_fleet_absent_places_local():
+    assert fleet_sched.current_view() is None
+    assert fleet_sched.place_for_submit(
+        "interactive", "default", 1234) == (None, None)
+
+
+def test_full_local_places_on_member_with_headroom(monkeypatch):
+    _gossip([_m("a@h", headroom=5_000, running=2),
+             _m("b@h", headroom=50_000, running=0),
+             _m("c@h", headroom=-1, running=3, accepting=False),
+             _m("d@h", headroom=50_000, state="suspect")], epoch=11)
+    monkeypatch.setattr(fleet_sched, "_local_headroom_bytes", lambda: 0)
+    placement, snap = fleet_sched.place_for_submit(
+        "interactive", "default", 20_000)
+    assert snap is None
+    # a@h does not fit, c@h is not accepting, d@h is not alive
+    assert placement["member"]["member_id"] == "b@h"
+    assert placement["epoch"] == 11    # the decision pins the epoch
+
+
+def test_idle_local_wins_ties():
+    _gossip([_m("a@h", headroom=-1)])
+    # the real (idle) scheduler advertises unlimited local headroom
+    assert fleet_sched.place_for_submit(
+        "interactive", "default", 1000) == (None, None)
+
+
+def test_no_headroom_anywhere_queues_local_with_snapshot(monkeypatch):
+    _gossip([_m("a@h", headroom=100), _m("b@h", headroom=200)], epoch=5)
+    monkeypatch.setattr(fleet_sched, "_local_headroom_bytes", lambda: 0)
+    placement, snap = fleet_sched.place_for_submit(
+        "interactive", "default", 1_000_000)
+    assert placement is None
+    assert snap["no_headroom"] is True and snap["epoch"] == 5
+    assert {m["member_id"]: m["headroom_bytes"]
+            for m in snap["members"]} == {"a@h": 100, "b@h": 200}
+
+
+def test_grid_wave_spreads_round_robin():
+    """bulk + non-default share (a grid/AutoML wave) fans children
+    across local + every fitting member instead of serializing."""
+    _gossip([_m("m2@x"), _m("m1@x")])
+    picks = []
+    for _ in range(4):
+        placement, _snap = fleet_sched.place_for_submit(
+            "bulk", "wave_rr", 1000)
+        picks.append(placement["member"]["member_id"]
+                     if placement else None)
+    # slots are [local, m1, m2] (members in stable id order)
+    assert picks == [None, "m1@x", "m2@x", None]
+
+
+# ---------------- remote submission over REST (one process) -----------
+
+
+def test_remote_submit_rest_roundtrip(tmp_path, monkeypatch):
+    """POST /3/FleetSched/submit end to end: the target trains under
+    the ORIGINAL priority class + share group, registers the model in
+    its DKV, and exports the result artifact the submitter's proxy
+    finalizes from — bit-identical to a direct local train."""
+    from h2o3_tpu.api.server import H2OApiServer
+    monkeypatch.setenv("H2O3_RECOVERY_DIR", str(tmp_path / "rec"))
+    fr = _frame(n=1500, seed=2, key="fsub_frame")
+    kw = dict(ntrees=4, max_depth=3, seed=2, min_rows=1.0)
+    ref = GBM(**kw)
+    ref.train(y="y", training_frame=fr)
+    exported = fleet_sched._export_frame(fr)
+    assert exported is not None
+    frame_path, frame_key = exported
+    srv = H2OApiServer(port=0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        payload = {
+            "schema_version": 1, "algo": "gbm",
+            "params": dict(kw, model_id="fsub_gbm"),
+            "y": "y", "x": None,
+            "frame_path": frame_path, "frame_key": frame_key,
+            "priority": "bulk", "share": "waveX",
+            "trace_id": "tr-fsub", "model_key": "fsub_gbm",
+            "result_path": fleet_sched._result_path("fsub_gbm"),
+            "resuming": False, "submitter": "test@h"}
+        out = _post(f"{base}/3/FleetSched/submit", payload)
+        assert out["ok"] is True and out["job_key"]
+        # job status travels on /3/Jobs (the proxy's poll surface)
+        deadline = time.monotonic() + 300
+        while True:
+            j = _get(f"{base}/3/Jobs/{out['job_key']}")["jobs"][0]
+            if j["status"] in ("DONE", "FAILED", "CANCELLED"):
+                break
+            assert time.monotonic() < deadline, "remote train hung"
+            time.sleep(0.05)
+        assert j["status"] == "DONE", j
+        got = dkv.get("fsub_gbm", "model")
+        assert got.ntrees_built == kw["ntrees"]
+        _assert_trees_equal(ref.model, got, "remote submit: ")
+        # the result artifact lands for the submitter's proxy
+        rp = fleet_sched._result_path("fsub_gbm")
+        deadline = time.monotonic() + 60
+        while not os.path.exists(rp):
+            assert time.monotonic() < deadline, "result never exported"
+            time.sleep(0.05)
+        from h2o3_tpu.persist import load_model
+        _assert_trees_equal(ref.model, load_model(rp), "artifact: ")
+        assert fleet_sched.counters()["remote_received"] >= 1
+        # an unsupported algo is a 400, not a zombie job
+        bad = dict(payload, algo="weirdo", model_key="bad_key",
+                   params={})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(f"{base}/3/FleetSched/submit", bad)
+        assert ei.value.code == 400
+    finally:
+        srv.stop()
+        dkv.remove("fsub_gbm")
+
+
+# ---------------- satellite 3: cluster scheduler snapshot --------------
+
+
+def test_cluster_scope_merges_replicas_and_flags_dead_peers():
+    from h2o3_tpu.api.server import H2OApiServer
+    srv = H2OApiServer(port=0).start()
+    try:
+        r = fleet.router()
+        m = r.table.join("dead@h", "http://127.0.0.1:9",
+                         heartbeat_s=30.0, routable=True)
+        r.table.heartbeat("dead@h", m.incarnation, routable=True)
+        snap = _get(f"http://127.0.0.1:{srv.port}"
+                    "/3/Scheduler?scope=cluster")
+        assert snap["scope"] == "cluster"
+        assert snap["totals"]["replicas"] >= 1
+        # the dead peer is FLAGGED, never fatal
+        assert any("127.0.0.1:9" in f["peer"]
+                   for f in snap["peers_failed"])
+        assert "counters" in snap
+        # the default scope is untouched
+        local = _get(f"http://127.0.0.1:{srv.port}/3/Scheduler")
+        assert local["__meta"]["schema_name"] == "SchedulerV3"
+    finally:
+        srv.stop()
+        fleet.reset()
+
+
+# ---------------- satellite 1 + evict fallback (one process) ----------
+
+
+_EV_KW = dict(ntrees=12, max_depth=3, seed=4, min_rows=1.0,
+              score_tree_interval=0, stopping_rounds=0)
+
+
+def test_manifest_carries_priority_share_and_local_evict_fallback(
+        tmp_path, monkeypatch):
+    """The recovery manifest records the ORIGINAL priority class, share
+    group and owning member (satellite 1); resubmitting it with no
+    live member falls back to a LOCAL resume — a 1-survivor fleet
+    still finishes the train, bit-identically."""
+    recdir = tmp_path / "rec"
+    monkeypatch.setenv("H2O3_RECOVERY_DIR", str(recdir))
+    fr = _frame(n=1200, seed=6, key="fev_frame")
+    ref = GBM(**_EV_KW)
+    ref.train(y="y", training_frame=fr)
+
+    fleet_sched.set_local_member("victim@h", None)
+    faults.configure("execute@train:every=1:after=1:times=1:exc=Fatal")
+    crashed = GBM(model_id="fev_gbm",
+                  in_training_checkpoints_dir=str(tmp_path / "ck"),
+                  in_training_checkpoints_tree_interval=3, **_EV_KW)
+    with pytest.raises(RuntimeError):
+        with sched.submit_context(priority="bulk", share="tenantE"):
+            crashed.train(y="y", training_frame=fr)
+    faults.configure(None)
+
+    ents, _ = recovery.scan(quarantine=False)
+    assert len(ents) == 1
+    ent = ents[0]
+    assert ent["priority"] == "bulk"          # satellite 1
+    assert ent["share"] == "tenantE"
+    assert ent["member_id"] == "victim@h"
+    assert ent["ckpt_trees"] and ent["ckpt_trees"] < _EV_KW["ntrees"]
+
+    # the fleet has no other member: the resubmit resumes LOCALLY from
+    # the last chunk commit
+    assert fleet_sched._resubmit_manifest(ent) is True
+    recovery.wait_for_recoveries(timeout=300)
+    got = dkv.get("fev_gbm", "model")
+    assert got.ntrees_built == _EV_KW["ntrees"]
+    _assert_trees_equal(ref.model, got, "evict fallback: ")
+    assert os.listdir(recdir / "manifests") == []
+    dkv.remove("fev_gbm")
+
+
+# ---------------- two-process fleet (slow tier) ------------------------
+
+
+def _replica_src(router_port):
+    """An idle fleet replica: REST surface + agent, no work of its
+    own — everything it trains arrives via /3/FleetSched/submit."""
+    return textwrap.dedent(f"""
+        import sys, threading
+        sys.path.insert(0, {_REPO!r})
+        from h2o3_tpu.api.server import H2OApiServer
+        from h2o3_tpu.fleet import FleetAgent
+        srv = H2OApiServer(port=0).start()
+        agent = FleetAgent(f"http://127.0.0.1:{{srv.port}}",
+                           router_url="http://127.0.0.1:{router_port}")
+        agent.start()
+        print("REPLICA_READY", srv.port, flush=True)
+        threading.Event().wait()
+    """)
+
+
+def _spawn_replica(router, recdir, n=1, spawn_deadline_s=300.0):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               H2O3_RECOVERY_DIR=str(recdir),
+               H2O3_FLEET_HEARTBEAT_MS=HB_MS,
+               H2O3_FLEET_SEEDS=f"127.0.0.1:{router}")
+    src = _replica_src(router)
+    procs = [subprocess.Popen([sys.executable, "-c", src], env=env,
+                              stdout=subprocess.DEVNULL,
+                              stderr=subprocess.DEVNULL)
+             for _ in range(n)]
+    return procs
+
+
+def _wait_members(router, want, procs, deadline_s=300.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        live = router.table.live_members()
+        if len(live) >= want:
+            return live
+        assert not any(p.poll() is not None for p in procs), \
+            "a replica died during spawn"
+        time.sleep(0.25)
+    raise AssertionError(
+        f"only {len(router.table.live_members())}/{want} replicas "
+        f"joined before the deadline")
+
+
+def _kill_all(procs):
+    for p in procs:
+        try:
+            p.kill()
+            p.wait(timeout=30)
+        except Exception:
+            pass
+
+
+_MIG_KW = dict(ntrees=18, max_depth=3, seed=7, min_rows=1.0,
+               score_tree_interval=2, stopping_rounds=0)
+
+
+@pytest.mark.slow
+def test_cross_replica_migrate_parity(tmp_path, monkeypatch):
+    """Acceptance: a bulk train preempted on replica A hands its DKV
+    checkpoint to replica B (real process, REST) and resumes
+    BIT-identically; the local job follows the remote run on /3/Jobs
+    and finishes DONE with the migrated model as its result."""
+    from h2o3_tpu.api.server import H2OApiServer
+    recdir = tmp_path / "rec"
+    monkeypatch.setenv("H2O3_RECOVERY_DIR", str(recdir))
+    monkeypatch.setenv("H2O3_FLEET_HEARTBEAT_MS", HB_MS)
+    fr = _frame(n=2000, seed=3, key="fmig_frame")
+    vfr = _frame(n=400, seed=9)               # keys the preemptor OFF
+    twin = GBM(**_MIG_KW)                     # the fleet (no frame key)
+    twin.train(y="y", training_frame=fr)
+
+    srv = H2OApiServer(port=0).start()
+    router = fleet.router()
+    procs = _spawn_replica(srv.port, recdir)
+    try:
+        _wait_members(router, 1, procs)
+        memman.reset(budget=500_000)
+        victim = GBM(model_id="fmig_gbm", **_MIG_KW)
+        with sched.submit_context(priority="bulk"):
+            victim.train(y="y", training_frame=fr, background=True)
+        deadline = time.monotonic() + 120
+        while victim.job.status == jobs.QUEUED:
+            assert time.monotonic() < deadline, "victim never ran"
+            time.sleep(0.005)
+        # the interactive preemptor carries a validation frame, so it
+        # is NOT placement-eligible: it preempts locally by design
+        hi = GBM(ntrees=3, max_depth=3, seed=1, min_rows=1.0)
+        hi.train(y="y", training_frame=fr, validation_frame=vfr,
+                 background=True)
+        hi.job.join(300.0)
+        victim.job.join(600.0)
+        assert hi.job.status == jobs.DONE, hi.job.exception_msg
+        assert victim.job.status == jobs.DONE, victim.job.exception_msg
+        assert victim.job.preempt_count >= 1, "victim never preempted"
+        assert fleet_sched.counters()["migrations"] >= 1, \
+            "the preempted train never migrated"
+        assert victim._sched_entry.remote_member is not None
+        resumed = victim.job.result
+        assert resumed.ntrees_built == _MIG_KW["ntrees"]
+        _assert_trees_equal(twin.model, resumed, "migrate: ")
+        # the ORIGINAL local job key reports DONE over /3/Jobs
+        j = _get(f"http://127.0.0.1:{srv.port}"
+                 f"/3/Jobs/{victim.job.key}")["jobs"][0]
+        assert j["status"] == "DONE"
+    finally:
+        _kill_all(procs)
+        fleet.reset()
+        memman.reset()
+
+
+@pytest.mark.slow
+def test_elastic_join_absorbs_queued_children(tmp_path, monkeypatch):
+    """Acceptance: a grid-style wave queued on a budget that fits one
+    train fans onto a replica that joins MID-wave — every child
+    completes, at least one on the new member."""
+    from h2o3_tpu.api.server import H2OApiServer
+    recdir = tmp_path / "rec"
+    monkeypatch.setenv("H2O3_RECOVERY_DIR", str(recdir))
+    monkeypatch.setenv("H2O3_FLEET_HEARTBEAT_MS", HB_MS)
+    fr = _frame(n=4000, seed=0, key="fjoin_frame")
+    srv = H2OApiServer(port=0).start()
+    router = fleet.router()
+    procs = []
+    try:
+        memman.reset(budget=500_000)
+        ests = [GBM(ntrees=3, max_depth=3, seed=i, min_rows=1.0)
+                for i in range(4)]
+        with sched.submit_context(priority="bulk", share="wave1"):
+            for e in ests:
+                e.train(y="y", training_frame=fr, background=True)
+        # no members yet: everything queued/running locally
+        assert all(e._sched_entry.remote_member is None for e in ests)
+        procs = _spawn_replica(srv.port, recdir)
+        _wait_members(router, 1, procs)
+        deadline = time.monotonic() + 600
+        for e in ests:
+            e.job.join(max(deadline - time.monotonic(), 1.0))
+        assert all(e.job.status == jobs.DONE for e in ests), \
+            [(e.job.status, e.job.exception_msg) for e in ests]
+        assert all(e.job.result.ntrees_built == 3 for e in ests)
+        moved = [e for e in ests
+                 if e._sched_entry.remote_member is not None]
+        assert moved, "the joining replica absorbed no queued child"
+        assert fleet_sched.counters()["rebalanced"] >= len(moved)
+    finally:
+        _kill_all(procs)
+        fleet.reset()
+        memman.reset()
+
+
+_EVICT_KW = dict(ntrees=40, max_depth=3, seed=11, min_rows=1.0,
+                 score_tree_interval=0, stopping_rounds=0)
+
+
+@pytest.mark.slow
+def test_evicted_replica_requeues_running_train(tmp_path, monkeypatch):
+    """Acceptance: SIGKILL a replica mid-train — its recovery manifest
+    (original priority/share + last chunk commit) re-queues fleet-wide;
+    with no other member the router itself resumes it, bit-identical."""
+    from h2o3_tpu.api.server import H2OApiServer
+    recdir = tmp_path / "rec"
+    ck = tmp_path / "ck"
+    monkeypatch.setenv("H2O3_RECOVERY_DIR", str(recdir))
+    monkeypatch.setenv("H2O3_FLEET_HEARTBEAT_MS", HB_MS)
+    fr = _frame(n=2000, seed=5, key="fevict_frame")
+    ref = GBM(**_EVICT_KW)
+    ref.train(y="y", training_frame=fr)
+    exported = fleet_sched._export_frame(fr)
+    assert exported is not None
+    frame_path, frame_key = exported
+
+    srv = H2OApiServer(port=0).start()
+    router = fleet.router()
+    procs = _spawn_replica(srv.port, recdir)
+    try:
+        live = _wait_members(router, 1, procs)
+        child = live[0]
+        payload = {
+            "schema_version": 1, "algo": "gbm",
+            "params": dict(_EVICT_KW, model_id="fevict_gbm",
+                           in_training_checkpoints_dir=str(ck),
+                           in_training_checkpoints_tree_interval=5),
+            "y": "y", "x": None,
+            "frame_path": frame_path, "frame_key": frame_key,
+            "priority": "bulk", "share": "tenantK",
+            "trace_id": "tr-evict", "model_key": "fevict_gbm",
+            "result_path": fleet_sched._result_path("fevict_gbm"),
+            "resuming": False, "submitter": "parent@h"}
+        out = _post(f"{child.base_url}/3/FleetSched/submit", payload)
+        assert out["ok"] is True
+        # SIGKILL the replica at its first durable chunk commit
+        deadline = time.monotonic() + 300
+        while not (ck.exists() and any(
+                f.startswith("fevict_gbm_t") for f in os.listdir(ck))):
+            assert time.monotonic() < deadline, "no checkpoint landed"
+            time.sleep(0.05)
+        os.kill(procs[0].pid, signal.SIGKILL)
+        procs[0].wait(timeout=30)
+        # the manifest carries the original class/share + owner
+        ents, _ = recovery.scan(quarantine=False)
+        mine = [e for e in ents if e["model_key"] == "fevict_gbm"]
+        assert mine and mine[0]["priority"] == "bulk"
+        assert mine[0]["share"] == "tenantK"
+        assert mine[0]["member_id"] == child.member_id
+        # eviction fires the fleet-wide requeue (local fallback here)
+        deadline = time.monotonic() + 60
+        while router.table.get(child.member_id) is not None:
+            assert time.monotonic() < deadline, "never evicted"
+            time.sleep(0.05)
+        deadline = time.monotonic() + 60
+        while fleet_sched.counters()["evict_requeues"] < 1:
+            assert time.monotonic() < deadline, "never re-queued"
+            time.sleep(0.05)
+        recovery.wait_for_recoveries(timeout=600)
+        got = dkv.get("fevict_gbm", "model")
+        assert got.ntrees_built == _EVICT_KW["ntrees"]
+        _assert_trees_equal(ref.model, got, "evict requeue: ")
+    finally:
+        _kill_all(procs)
+        fleet.reset()
+        dkv.remove("fevict_gbm")

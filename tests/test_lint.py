@@ -668,11 +668,60 @@ def test_detects_bare_thread_import_in_automl(tmp_path):
 
 
 def test_threads_outside_training_layer_not_flagged(tmp_path):
+    rep = _lint_source(tmp_path, "h2o3_tpu/ingest/pump.py", """\
+        import threading
+
+        def beat(fn):
+            threading.Thread(target=fn, daemon=True).start()
+    """)
+    assert "sched-discipline" not in _rules_of(rep)
+
+
+def test_detects_raw_thread_in_fleet_package(tmp_path):
+    """ISSUE 18: h2o3_tpu/fleet/ is in sched-discipline scope — its
+    placement/proxy fan-out must ride the bounded executor."""
     rep = _lint_source(tmp_path, "h2o3_tpu/fleet/pump.py", """\
         import threading
 
         def beat(fn):
             threading.Thread(target=fn, daemon=True).start()
+    """)
+    assert "sched-discipline" in _rules_of(rep)
+
+
+def test_detects_epoch_blind_placement_in_fleet(tmp_path):
+    """A fleet placement decision that reads membership state without
+    pinning an epoch hands trains to dead views — flagged."""
+    rep = _lint_source(tmp_path, "h2o3_tpu/fleet/newsched.py", """\
+        def place_train(table, need):
+            for m in table.members():
+                if m.headroom >= need:
+                    return m
+            return None
+    """)
+    assert "sched-discipline" in _rules_of(rep)
+    f = [x for x in rep.new if x.rule == "sched-discipline"][0]
+    assert "epoch" in f.message
+
+
+def test_epoch_pinned_placement_in_fleet_is_clean(tmp_path):
+    rep = _lint_source(tmp_path, "h2o3_tpu/fleet/newsched.py", """\
+        def place_train(table, need):
+            epoch = table.epoch
+            for m in table.members():
+                if m.headroom >= need:
+                    return m, epoch
+            return None, epoch
+    """)
+    assert "sched-discipline" not in _rules_of(rep)
+
+
+def test_placement_payload_helper_in_fleet_not_flagged(tmp_path):
+    """A function with a placement-ish name that never touches
+    membership state is a payload helper, not a decision."""
+    rep = _lint_source(tmp_path, "h2o3_tpu/fleet/newsched.py", """\
+        def place_payload(key, need):
+            return {"model_key": key, "need_bytes": need}
     """)
     assert "sched-discipline" not in _rules_of(rep)
 

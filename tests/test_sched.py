@@ -9,6 +9,7 @@ stronger behavioral fact that no train degraded to streaming — under a
 budget that fits exactly one dense train, any concurrent admission
 would have flipped later specs into streamed mode or OOMed.
 """
+import os
 import time
 
 import numpy as np
@@ -301,6 +302,27 @@ def test_nested_cv_runs_inline_no_deadlock(_fresh_sched):
     assert est.model.cross_validation_metrics is not None
 
 
+# Concurrent multi-thread dispatch against the 8-virtual-device CPU
+# mesh can deadlock XLA's execute pool on a small host: all 8 collective
+# participants share one thread pool, and a fold thread's eager op
+# enqueued mid-rendezvous both steals a pool thread and queues behind a
+# waiting participant on its device — circular wait, parked forever on
+# jaxlib builds WITHOUT the collective-timeout rescue flags (conftest
+# probes for them and appends them to XLA_FLAGS when supported; with
+# them, the stall resolves or aborts loudly instead). Only run the
+# deliberately-concurrent test where one of the two escape hatches
+# exists. Reproducible here: warm jit caches (run the nested-CV test
+# first, same frame shape) remove the compile stagger and the pair
+# deadlocks at 0% CPU on a 1-core box.
+_COLLECTIVE_RESCUE = ("collective_call_terminate_timeout"
+                      in os.environ.get("XLA_FLAGS", ""))
+
+
+@pytest.mark.skipif(
+    not _COLLECTIVE_RESCUE and (os.cpu_count() or 1) <= 8,
+    reason="concurrent dispatch vs 8-way collective rendezvous can "
+           "deadlock XLA:CPU on a small host without the "
+           "collective-timeout rescue flags (see comment above)")
 def test_parallel_cv_pool_threads_inherit_inline(_fresh_sched,
                                                  monkeypatch):
     """The inline flag is thread-local: folds running on CV POOL

@@ -555,6 +555,251 @@ def run_oversubscribe_round(log=print, rows: int = 3000) -> dict:
     return out
 
 
+# --------------------------------------- kill-replica-training round
+#
+# The fleet SCHEDULER's chaos probe (ISSUE 18): two real replica
+# processes join the parent's router over REST with a SHARED recovery
+# dir; a checkpointing train submitted to replica A is SIGKILLed
+# mid-train and must complete on replica B bit-identically (evict →
+# fleet-wide requeue from the last chunk commit); then a local bulk
+# train preempted by an interactive one migrates its checkpoint to the
+# surviving replica and resumes bit-identically. Recorded in bench.py
+# as fleetsched.{queue_wait_p50_ms,migrations,resumed_after_evict}.
+
+_FTS_EVICT_PARAMS = dict(ntrees=40, max_depth=3, seed=11, min_rows=1.0,
+                         learn_rate=0.2, score_tree_interval=0,
+                         stopping_rounds=0)
+_FTS_MIG_PARAMS = dict(ntrees=18, max_depth=3, seed=7, min_rows=1.0,
+                       score_tree_interval=2, stopping_rounds=0)
+
+
+def _fts_replica_src(router_port: int) -> str:
+    """An idle fleet replica: REST surface + agent; everything it
+    trains arrives via /3/FleetSched/submit."""
+    return textwrap.dedent(f"""
+        import sys, threading
+        sys.path.insert(0, {_REPO!r})
+        from h2o3_tpu.api.server import H2OApiServer
+        from h2o3_tpu.fleet import FleetAgent
+        srv = H2OApiServer(port=0).start()
+        agent = FleetAgent(f"http://127.0.0.1:{{srv.port}}",
+                           router_url="http://127.0.0.1:{router_port}")
+        agent.start()
+        print("REPLICA_READY", srv.port, flush=True)
+        threading.Event().wait()
+    """)
+
+
+def run_kill_replica_training_round(log=print, rows: int = 2000,
+                                    spawn_deadline_s: float = 300.0
+                                    ) -> dict:
+    """SIGKILL a replica mid-TRAIN (not mid-traffic — that is the
+    --kill-replica round) and prove the fleet scheduler's two recovery
+    paths: evict-requeue onto the survivor and preempt-migrate onto a
+    member with headroom, both bit-identical. ``ran=False`` results
+    are benign skips (non-CPU parent — child tree bits would not be
+    comparable), same contract as the other process rounds."""
+    import urllib.request
+
+    import jax
+
+    out = {"ran": False, "replicas": 2, "resumed_after_evict": None,
+           "evict_resume_ok": None, "migrations": None,
+           "migrate_resume_ok": None, "queue_wait_p50_ms": None,
+           "ok": False}
+    if jax.default_backend() != "cpu":
+        log("kill-replica-training round: skipped — replica children "
+            f"run on CPU and this process is on {jax.default_backend()}")
+        out["ok"] = True          # a skip is not a failure
+        return out
+    import h2o3_tpu as h2o
+    from h2o3_tpu import dkv, fleet, jobs, memman, sched
+    from h2o3_tpu.api.server import H2OApiServer
+    from h2o3_tpu.fleet import sched as fleet_sched
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator as GBM
+    from h2o3_tpu.persist import load_model
+
+    recdir = tempfile.mkdtemp(prefix="chaos_fleetsched_")
+    ckdir = os.path.join(recdir, "victim_ck")
+    hb_ms = float(os.environ.get("H2O3_FLEET_BENCH_HB_MS", "300")
+                  or 300)
+    saved = {k: os.environ.get(k)
+             for k in ("H2O3_FLEET_HEARTBEAT_MS", "H2O3_RECOVERY_DIR")}
+    os.environ["H2O3_FLEET_HEARTBEAT_MS"] = str(hb_ms)
+    os.environ["H2O3_RECOVERY_DIR"] = recdir
+
+    # deterministic frame + uninterrupted references, trained BEFORE
+    # the fleet exists so the placer cannot hand them off
+    rng = np.random.default_rng(23)
+    F = 6
+    X = rng.normal(size=(rows, F)).astype(np.float32)
+    logit = X[:, 0] - 0.5 * X[:, 1]
+    cols = {f"x{i}": X[:, i] for i in range(F)}
+    cols["y"] = np.where(rng.random(rows) < 1 / (1 + np.exp(-logit)),
+                         "a", "b")
+    fr = h2o.Frame.from_numpy(cols)
+    fr.key = "chaos_fts_frame"
+    ref_evict = GBM(**_FTS_EVICT_PARAMS)
+    ref_evict.train(y="y", training_frame=fr)
+    twin_mig = GBM(**_FTS_MIG_PARAMS)
+    twin_mig.train(y="y", training_frame=fr)
+
+    fleet.reset()
+    srv = H2OApiServer(port=0).start()
+    router = fleet.router()
+    procs = []
+    try:
+        exported = fleet_sched._export_frame(fr)
+        if exported is None:
+            log("kill-replica-training round: frame export failed")
+            return out
+        frame_path, frame_key = exported
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   H2O3_FLEET_SEEDS=f"127.0.0.1:{srv.port}")
+        src = _fts_replica_src(srv.port)
+        for _ in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", src], env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        deadline = time.monotonic() + spawn_deadline_s
+        while time.monotonic() < deadline:
+            if len(router.table.live_members()) >= 2:
+                break
+            if any(p.poll() is not None for p in procs):
+                log("kill-replica-training round: a replica died "
+                    "during spawn")
+                return out
+            time.sleep(0.25)
+        live = router.table.live_members()
+        if len(live) < 2:
+            log(f"kill-replica-training round: only {len(live)}/2 "
+                f"replicas joined before the deadline — skipping")
+            return out
+        out["ran"] = True
+
+        # ---- phase 1: SIGKILL replica A mid-train, B finishes it
+        victim = live[0]
+        payload = {
+            "schema_version": 1, "algo": "gbm",
+            "params": dict(_FTS_EVICT_PARAMS,
+                           model_id="chaos_fts_evict_gbm",
+                           in_training_checkpoints_dir=ckdir,
+                           in_training_checkpoints_tree_interval=5),
+            "y": "y", "x": None,
+            "frame_path": frame_path, "frame_key": frame_key,
+            "priority": "bulk", "share": "chaos",
+            "trace_id": "tr-chaos-fts",
+            "model_key": "chaos_fts_evict_gbm",
+            "result_path": fleet_sched._result_path(
+                "chaos_fts_evict_gbm"),
+            "resuming": False, "submitter": "chaos@parent"}
+        req = urllib.request.Request(
+            f"{victim.base_url}/3/FleetSched/submit",
+            data=json.dumps(payload).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            sub = json.loads(r.read().decode())
+        if not sub.get("ok"):
+            log(f"kill-replica-training round: submit rejected {sub}")
+            return out
+        # the kill lands at the FIRST durable chunk commit
+        deadline = time.monotonic() + 300
+        while not (os.path.isdir(ckdir) and any(
+                f.startswith("chaos_fts_evict_gbm_t")
+                for f in os.listdir(ckdir))):
+            if time.monotonic() > deadline:
+                log("kill-replica-training round: no checkpoint landed")
+                return out
+            time.sleep(0.05)
+        victim_proc = procs[0]     # spawn order == join order is NOT
+        # guaranteed: find the child whose agent owns the victim id
+        victim_pid = int(str(victim.member_id).split("@", 1)[0])
+        for p in procs:
+            if p.pid == victim_pid:
+                victim_proc = p
+                break
+        os.kill(victim_proc.pid, signal.SIGKILL)
+        victim_proc.wait(timeout=30)
+        # eviction → fleet-wide requeue → the SURVIVOR resumes from the
+        # last chunk commit and exports the result artifact
+        rp = payload["result_path"]
+        deadline = time.monotonic() + 600
+        while not os.path.exists(rp):
+            if time.monotonic() > deadline:
+                log("kill-replica-training round: evicted train never "
+                    "completed on the survivor")
+                return out
+            time.sleep(0.1)
+        time.sleep(0.5)            # let the artifact writer close
+        resumed = load_model(rp)
+        out["resumed_after_evict"] = fleet_sched.counters()[
+            "evict_requeues"]
+        out["evict_resume_ok"] = bool(
+            getattr(resumed, "ntrees_built", 0)
+            == _FTS_EVICT_PARAMS["ntrees"]
+            and _trees_equal(ref_evict.model, resumed))
+
+        # ---- phase 2: preempt-MIGRATE onto the survivor
+        memman.reset(budget=500_000)
+        sched.reset()
+        mig = GBM(model_id="chaos_fts_mig_gbm", **_FTS_MIG_PARAMS)
+        with sched.submit_context(priority="bulk"):
+            mig.train(y="y", training_frame=fr, background=True)
+        t0 = time.monotonic()
+        while mig.job.status == jobs.QUEUED \
+                and time.monotonic() - t0 < 120:
+            time.sleep(0.005)
+        # the interactive preemptor carries a validation frame, so it
+        # is NOT placement-eligible: it preempts locally by design
+        vfr = h2o.Frame.from_numpy(
+            {f"x{i}": X[:400, i] for i in range(F)}
+            | {"y": cols["y"][:400]})
+        hi = GBM(ntrees=3, max_depth=3, seed=1, min_rows=1.0)
+        hi.train(y="y", training_frame=fr, validation_frame=vfr,
+                 background=True)
+        hi.job.join(300)
+        mig.job.join(600)
+        out["migrations"] = fleet_sched.counters()["migrations"]
+        mig_ok = (mig.job.status == jobs.DONE
+                  and mig.job.result is not None
+                  and out["migrations"] >= 1
+                  and _trees_equal(twin_mig.model, mig.job.result))
+        out["migrate_resume_ok"] = bool(mig_ok)
+        waits = sorted(j.queue_wait_s or 0.0
+                       for j in (mig.job, hi.job))
+        out["queue_wait_p50_ms"] = round(
+            waits[len(waits) // 2] * 1000.0, 2)
+        out["heartbeat_ms"] = hb_ms
+        out["ok"] = bool(out["evict_resume_ok"]
+                         and out["migrate_resume_ok"]
+                         and (out["resumed_after_evict"] or 0) >= 1)
+        log(f"kill-replica-training round: "
+            f"{'PASS' if out['ok'] else 'FAIL'} {out}")
+        return out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:   # noqa: BLE001 — cleanup best-effort
+                pass
+        try:
+            dkv.remove("chaos_fts_evict_gbm")
+        except Exception:   # noqa: BLE001
+            pass
+        fleet.reset()
+        srv.stop()
+        memman.reset()
+        sched.reset()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def run_chaos_round(rows: int = 2000, log=print,
                     kill_process=None) -> dict:
     """Run the sweep with a hard guarantee that fault injection is
@@ -716,6 +961,13 @@ def main():
         out = {"fleet": run_kill_replica_round(log=log)}
         print(json.dumps(out, indent=2))
         sys.exit(0 if out["fleet"]["ok"] else 1)
+    if "--kill-replica-training" in sys.argv[1:]:
+        # fleet-scheduler chaos only (ISSUE 18): SIGKILL a replica
+        # mid-TRAIN — evict-requeue onto the survivor + preempt-migrate
+        # both finish bit-identical
+        out = {"fleetsched": run_kill_replica_training_round(log=log)}
+        print(json.dumps(out, indent=2))
+        sys.exit(0 if out["fleetsched"]["ok"] else 1)
     if "--oversubscribe" in sys.argv[1:]:
         # training-scheduler chaos only (ISSUE 15): tight budget, 4
         # concurrent bulk trains + 1 interactive preemptor — queued not

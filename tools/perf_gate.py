@@ -87,6 +87,13 @@ METRIC_SPECS: Tuple[Tuple[str, str, float], ...] = (
     ("sched.oversub_completed", "higher", 0.0),
     ("sched.preempt_resume_ok", "higher", 0.0),
     ("sched.queue_wait_p50_ms", "lower", 0.60),
+    # fleet scheduler (ISSUE 18): the evict-requeue and migrate counts
+    # are correctness floors (band 0 — a round that stops resuming or
+    # migrating is a regression, not noise); cross-replica queue wait
+    # is heartbeat- and hand-off-quantized like sched's
+    ("fleetsched.queue_wait_p50_ms", "lower", 0.60),
+    ("fleetsched.migrations", "higher", 0.0),
+    ("fleetsched.resumed_after_evict", "higher", 0.0),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
