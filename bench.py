@@ -361,6 +361,47 @@ def _serve_round(model, fr, F):
         serve.undeploy(model.key)
 
 
+def _blackbox_round(n=20_000, runs=5):
+    """Flight-recorder append cost (ISSUE 19): median enabled-path
+    ns/event over ``runs`` batches of ``n`` records into a throwaway
+    ring dir (so the measurement never pollutes a shared recovery
+    root), plus the events actually recorded. perf_gate bands
+    blackbox.ns_per_event against the <=2µs/event budget."""
+    import shutil
+    import statistics
+
+    from h2o3_tpu import telemetry
+    from h2o3_tpu.telemetry import blackbox
+    if not telemetry.enabled():
+        return {"enabled": False}
+    saved = os.environ.get("H2O3_BLACKBOX_DIR")
+    tmp = tempfile.mkdtemp(prefix="bench_blackbox_")
+    os.environ["H2O3_BLACKBOX_DIR"] = tmp
+    blackbox.reset()
+    try:
+        per_run = []
+        for _ in range(runs):
+            t0 = time.perf_counter_ns()
+            for _i in range(n):
+                blackbox.record("placement", member="bench@local",
+                                payload="share=0.5 head=1",
+                                trace_id="tr-bench")
+            per_run.append((time.perf_counter_ns() - t0) / n)
+        ns = statistics.median(per_run)
+        recorded = blackbox.events_recorded()
+        log(f"blackbox: {ns:.0f} ns/event enabled "
+            f"({recorded} events recorded)")
+        return {"ns_per_event": round(ns, 1),
+                "events_recorded": recorded}
+    finally:
+        blackbox.reset()
+        if saved is None:
+            os.environ.pop("H2O3_BLACKBOX_DIR", None)
+        else:
+            os.environ["H2O3_BLACKBOX_DIR"] = saved
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _telemetry_counts():
     """Cumulative telemetry counters (ISSUE 4): diff two calls to
     attribute compiles / cache traffic / transfer bytes to a bench
@@ -654,6 +695,17 @@ def main():
                 f"queue_wait_p50={fs.get('queue_wait_p50_ms')}ms")
         except Exception as e:  # must never sink the headline run
             log(f"fleetsched round FAILED to run: {e!r}")
+    # flight-recorder round (ISSUE 19): enabled-path append cost in
+    # ns/event + events recorded — emits
+    # blackbox.{ns_per_event,events_recorded} (ns_per_event banded by
+    # tools/perf_gate.py against the 2µs/event budget).
+    # H2O3_BENCH_BLACKBOX=0 skips.
+    if os.environ.get("H2O3_BENCH_BLACKBOX", "1") not in ("0", "false",
+                                                          ""):
+        try:
+            out["blackbox"] = _blackbox_round()
+        except Exception as e:  # must never sink the headline run
+            log(f"blackbox round FAILED to run: {e!r}")
     # multichip scaling round (ISSUE 7): rows/s/chip at n_devices ∈
     # {1,4,8} with a scaling-efficiency verdict (tools/multichip_bench.py
     # runs in its OWN process so a single-chip parent can still force

@@ -1084,6 +1084,102 @@ class SchedDisciplineRule(Rule):
 
 
 # ======================================================================
+# blackbox-discipline
+# ======================================================================
+
+# the control-plane packages whose decision points must leave a
+# flight-recorder record (ISSUE 19)
+_BB_SCOPE_PREFIXES = ("h2o3_tpu/fleet/", "h2o3_tpu/sched/")
+
+# function names that ARE the recording/counting plumbing, not
+# decision points
+_BB_EXEMPT_FUNCS = {"_count", "_bb", "counters", "reset"}
+
+
+class BlackboxDisciplineRule(Rule):
+    """Control-plane decision points in the fleet/scheduler packages
+    that mutate placement/membership state without leaving a flight-
+    recorder record (ISSUE 19).
+
+    A function in ``h2o3_tpu/fleet/`` or ``h2o3_tpu/sched/`` counts as
+    a decision point when it (a) bumps a fleet decision counter
+    (``_count(...)``), (b) increments a scheduler metric counter
+    (``_m_*.inc(...)``), or (c) advances a membership epoch (an
+    augmented assignment to ``*_epoch``). Each of those is a state
+    mutation a post-mortem needs to see: a SIGKILLed replica whose
+    placement/eviction/preemption decisions only lived in in-memory
+    counters tells no story. The fix is one advisory
+    ``blackbox.record(...)`` (or the module's ``_bb(...)`` helper)
+    next to the mutation.
+
+    Scope decisions: the counting/recording plumbing itself
+    (``_count``, ``_bb``, ``counters``, ``reset``) is exempt; tests
+    are out of scope. Nested closures are checked as part of their
+    enclosing function — the record may legitimately sit in the outer
+    body around the closure's mutation.
+    """
+
+    name = "blackbox-discipline"
+    severity = SEV_ERROR
+
+    @staticmethod
+    def _mutates(ref: ast.AST) -> bool:
+        if isinstance(ref, ast.Call):
+            head = dotted_name(ref.func) or ""
+            parts = head.split(".")
+            if parts[-1] == "_count":
+                return True
+            if parts[-1] == "inc" and len(parts) >= 2 \
+                    and parts[-2].startswith("_m_"):
+                return True
+        elif isinstance(ref, ast.AugAssign):
+            t = ref.target
+            tname = t.attr if isinstance(t, ast.Attribute) else (
+                t.id if isinstance(t, ast.Name) else "")
+            if tname.endswith("_epoch"):
+                return True
+        return False
+
+    @staticmethod
+    def _records(ref: ast.AST) -> bool:
+        if not isinstance(ref, ast.Call):
+            return False
+        head = dotted_name(ref.func) or ""
+        parts = head.split(".")
+        if parts[-1] == "_bb":
+            return True
+        return (parts[-1] == "record" and len(parts) >= 2
+                and "blackbox" in parts[-2])
+
+    def check_module(self, mod: ModuleInfo) -> List[Finding]:
+        if not mod.relpath.startswith(_BB_SCOPE_PREFIXES):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if node.name in _BB_EXEMPT_FUNCS:
+                continue
+            mutates = records = False
+            for ref in ast.walk(node):
+                mutates = mutates or self._mutates(ref)
+                records = records or self._records(ref)
+                if mutates and records:
+                    break
+            if mutates and not records:
+                out.append(self.finding(
+                    mod, node,
+                    f"control-plane decision point '{node.name}' "
+                    f"mutates placement/membership state (decision "
+                    f"counter / metric inc / epoch bump) without a "
+                    f"flight-recorder record — add an advisory "
+                    f"blackbox.record()/_bb() next to the mutation so "
+                    f"a post-mortem can see the decision"))
+        return out
+
+
+# ======================================================================
 # registry
 # ======================================================================
 
@@ -1099,6 +1195,7 @@ def all_rules(hot_zones: Optional[Dict[str, Tuple[str, ...]]] = None
         PallasGridSpecRule(),
         FleetPeerDisciplineRule(),
         SchedDisciplineRule(),
+        BlackboxDisciplineRule(),
     ]
 
 

@@ -775,7 +775,8 @@ def _fleet_heartbeat(params, body):
             circuit=b.get("circuit"),
             routable=b.get("routable"),
             sched=b.get("sched") if isinstance(b.get("sched"), dict)
-            else None)
+            else None,
+            wall=float(b["wall"]) if b.get("wall") is not None else None)
     except fleet.UnknownMemberError as e:
         raise ApiError(404, f"{e} — POST /3/Fleet/join")
     except fleet.StaleEpochError as e:
@@ -2083,10 +2084,29 @@ def _timeline(params, body):
     train.*, serve.request/batch), not just model builds.
 
     ``?format=trace``: Chrome-trace/Perfetto JSON of the finished-span
-    ring — the accelerator-aware timeline the JVM tools never had."""
+    ring — the accelerator-aware timeline the JVM tools never had.
+
+    ``?scope=cluster`` (ISSUE 19): the fleet-wide CAUSAL timeline from
+    the flight-recorder rings instead of the local span ring — this
+    process's ring, every live peer's ring (telemetry peer plane), and
+    any DEAD member's mmap ring still readable under the shared
+    blackbox dir. Events sort by (membership epoch, skew-corrected
+    wall clock); members whose heartbeat skew exceeds the flag
+    threshold are marked. ``format=trace`` renders the same merge as
+    Chrome-trace instants, one process row per member, dead members
+    labeled."""
     from h2o3_tpu import telemetry
-    if (params.get("format") or "").lower() in ("trace", "perfetto",
-                                                "chrome"):
+    fmt = (params.get("format") or "").lower()
+    if (params.get("scope") or "").lower() == "cluster":
+        from h2o3_tpu.telemetry import blackbox
+        n = int(params.get("n", 256) or 256)
+        if fmt in ("trace", "perfetto", "chrome"):
+            return {"__raw": blackbox.cluster_trace_bytes(n),
+                    "__content_type": "application/json"}
+        return {"__meta": {"schema_version": 3,
+                           "schema_name": "TimelineClusterV3"},
+                **blackbox.cluster_timeline(n)}
+    if fmt in ("trace", "perfetto", "chrome"):
         limit = int(params.get("n", 0) or 0) or None
         return {"__raw": telemetry.chrome_trace_bytes(limit),
                 "__content_type": "application/json"}
@@ -2110,6 +2130,21 @@ def _timeline(params, body):
     return {"__meta": {"schema_version": 3, "schema_name": "TimelineV3"},
             "now": int(time.time() * 1000), "self": "tpu-controller/0",
             "events": out}
+
+
+@route("GET", "/3/Blackbox")
+def _blackbox(params, body):
+    """This process's flight-recorder tail (ISSUE 19) — the wire format
+    peers pull for ``/3/Timeline?scope=cluster``. Decoded events, not
+    raw ring bytes: the reader never needs the writer's struct layout
+    version."""
+    from h2o3_tpu.telemetry import blackbox
+    n = int(params.get("n", 256) or 256)
+    return {"__meta": {"schema_version": 3, "schema_name": "BlackboxV3"},
+            "member_id": blackbox._default_member_id(),
+            "enabled": blackbox.ring_path() is not None,
+            "events_recorded": blackbox.events_recorded(),
+            "events": blackbox.local_events(n)}
 
 
 def _cluster_prometheus_raw():

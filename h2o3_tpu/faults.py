@@ -262,6 +262,14 @@ def check(site: str, pipeline: Optional[str] = None,
     telemetry.counter(
         "h2o3_fault_injected_total", {"site": site},
         help="faults raised by the injection layer").inc()
+    try:
+        from h2o3_tpu.telemetry import blackbox
+        blackbox.record("fault_fired", member=str(key or site),
+                        payload=f"site={site}"
+                                + (f"@{pipeline}" if pipeline else "")
+                                + f" exc={fire.exc_cls.__name__}")
+    except Exception:   # noqa: BLE001 — flight recorder is advisory
+        pass
     from h2o3_tpu.log import warn
     warn("fault injected at %s%s: %s", site,
          f"@{pipeline}" if pipeline else "", fire.exc_cls.__name__)

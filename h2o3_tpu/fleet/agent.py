@@ -122,6 +122,15 @@ class FleetAgent:
                          timeout_s=max(self.heartbeat_s * 4, 2.0),
                          site="fleet.join")
         self.incarnation = int(out.get("incarnation", 0))
+        try:
+            # stamp the flight recorder's ambient identity: every event
+            # this replica appends from now on carries the admitted
+            # epoch + incarnation (the merge's causal fence)
+            from h2o3_tpu.telemetry import blackbox
+            blackbox.set_identity(epoch=int(out.get("epoch", 0) or 0),
+                                  incarnation=self.incarnation)
+        except Exception:   # noqa: BLE001 — flight recorder is advisory
+            pass
         return out
 
     def _prewarm(self, snapshot: Optional[dict]) -> dict:
@@ -140,6 +149,7 @@ class FleetAgent:
             return {"deployed": [], "skipped": [], "error": repr(e)}
 
     def _beat_payload(self) -> dict:
+        import time
         from h2o3_tpu import serve
         deps = serve.deployments()
         load = max((d.batcher.load_factor for d in deps), default=0.0)
@@ -150,6 +160,10 @@ class FleetAgent:
             "deployments": [d.key for d in deps],
             "circuit": serve.circuit_states(),
             "routable": self.routable,
+            # the heartbeat exchange doubles as the cluster timeline's
+            # skew estimator: the router subtracts its receipt wall
+            # clock from this stamp (ISSUE 19 flight recorder)
+            "wall": time.time(),
         }
         try:
             # fleet-scheduler gossip: admission headroom, per-class
@@ -183,6 +197,12 @@ class FleetAgent:
                 # been delivered yet (start()'s wait contract) — the
                 # next tick's beat carries it
                 self.last_error = f"heartbeat fenced ({e.code}); rejoining"
+                try:
+                    from h2o3_tpu.telemetry import blackbox
+                    blackbox.record("incarnation_fence", self.member_id,
+                                    payload=f"http={e.code} rejoining")
+                except Exception:   # noqa: BLE001 — recorder is advisory
+                    pass
                 try:
                     self.join()
                 except Exception as e2:   # noqa: BLE001 — next tick retries

@@ -81,6 +81,18 @@ def seeds() -> List[str]:
     return [p.strip() for p in raw.split(",") if p.strip()]
 
 
+def _bb(kind: str, member: str = "", payload: str = "",
+        epoch: Optional[int] = None) -> None:
+    """Flight-recorder append (ISSUE 19): membership decisions are the
+    first thing a post-mortem reads, so every epoch bump lands in the
+    blackbox ring. Advisory — the recorder never breaks the table."""
+    try:
+        from h2o3_tpu.telemetry import blackbox
+        blackbox.record(kind, member=member, payload=payload, epoch=epoch)
+    except Exception:   # noqa: BLE001 — flight recorder is advisory
+        pass
+
+
 class UnknownMemberError(KeyError):
     """Heartbeat/leave for a member the table does not hold (never
     joined, or already evicted) — the sender must (re)join."""
@@ -112,6 +124,11 @@ class Member:
     # it; None / malformed → the member is no-headroom/local-only)
     sched: Optional[dict] = None
     joined_wall: float = 0.0          # reported epoch stamp (not math)
+    # wall-clock skew estimated from the heartbeat exchange (reported
+    # beat wall minus receipt wall, seconds; includes one-way network
+    # latency). None until the member reports a wall stamp. The cluster
+    # timeline merge corrects and flags on this — never math here.
+    skew_s: Optional[float] = None
     last_beat: float = 0.0            # monotonic
     beats: int = 0
     # observed inter-arrival window for the phi estimator
@@ -221,6 +238,8 @@ class MemberTable:
                     "phi": round(m.phi(now), 3),
                     "missed_beats": round(m.missed_beats(now), 2),
                     "joined": m.joined_wall,
+                    "skew_s": (round(m.skew_s, 6)
+                               if m.skew_s is not None else None),
                 } for m in self._members.values()],
                 "departed": [{"member_id": mid, "reason": reason,
                               "epoch": ep, "base_url": url}
@@ -249,6 +268,9 @@ class MemberTable:
                        joined_wall=time.time(),
                        last_beat=time.monotonic())
             self._members[member_id] = m
+        _bb("member_join", member_id,
+            payload=f"inc={m.incarnation} routable={int(m.routable)}",
+            epoch=m.incarnation)
         self._publish_gauges()
         return m
 
@@ -257,7 +279,8 @@ class MemberTable:
                   deployments: Optional[Tuple[str, ...]] = None,
                   circuit: Optional[List[dict]] = None,
                   routable: Optional[bool] = None,
-                  sched: Optional[dict] = None) -> Member:
+                  sched: Optional[dict] = None,
+                  wall: Optional[float] = None) -> Member:
         """Record one beat. Raises :class:`UnknownMemberError` when the
         member is not in the table (evicted / never joined — the
         sender must join) and :class:`StaleEpochError` when the
@@ -270,12 +293,18 @@ class MemberTable:
                     f"member '{member_id}' is not in the table — join "
                     f"first (evicted members must rejoin)")
             if int(incarnation) != m.incarnation:
+                _bb("incarnation_fence", member_id,
+                    payload=f"beat_inc={int(incarnation)} "
+                            f"table_inc={m.incarnation}",
+                    epoch=m.incarnation)
                 raise StaleEpochError(
                     f"heartbeat from '{member_id}' carries incarnation "
                     f"{incarnation} but the table holds "
                     f"{m.incarnation} — a packet from a dead epoch "
                     f"cannot resurrect or overwrite the member",
                     current_incarnation=m.incarnation)
+            if wall is not None:
+                m.skew_s = float(wall) - time.time()  # h2o3-lint: allow[monotonic-durations] cross-host wall-clock skew IS the measurand (includes one-way latency; flagged, never corrected silently)
             if m.beats > 0:
                 gap = max(now - m.last_beat, 1e-6)
                 # a resumption gap (the member was silent past the
@@ -302,8 +331,14 @@ class MemberTable:
             state_flip = m.state == SUSPECT
             if m.state in (SUSPECT, JOINING) and m.routable:
                 m.state = ALIVE
-            if became_routable or state_flip:
+            flipped = became_routable or state_flip
+            if flipped:
                 self._epoch += 1       # the routable set changed
+                epoch = self._epoch
+        if flipped:
+            _bb("member_flip", member_id,
+                payload=f"routable={int(m.routable)} state={m.state}",
+                epoch=epoch)
         self._publish_gauges()
         return m
 
@@ -321,6 +356,7 @@ class MemberTable:
         now = time.monotonic()
         suspect_at, evict_at = _suspect_after(), _evict_after()
         evicted: List[Member] = []
+        suspected: List[Tuple[Member, float, int]] = []
         flipped = False
         with self._mu:
             for m in list(self._members.values()):
@@ -330,7 +366,11 @@ class MemberTable:
                 elif missed >= suspect_at and m.state == ALIVE:
                     m.state = SUSPECT
                     self._epoch += 1
+                    suspected.append((m, missed, self._epoch))
                     flipped = True
+        for m, missed, ep in suspected:
+            _bb("member_suspect", m.member_id,
+                payload=f"missed_beats={missed:.2f}", epoch=ep)
         for m in evicted:
             self._remove(m.member_id, "evicted",
                          expect_incarnation=m.incarnation,
@@ -362,6 +402,10 @@ class MemberTable:
             m.state = EVICTED if reason == "evicted" else LEFT
             self._departed.append((member_id, reason, self._epoch,
                                    m.base_url))
+            depart_epoch = self._epoch
+        _bb("member_evict" if reason == "evicted" else "member_leave",
+            member_id, payload=f"reason={reason} inc={m.incarnation}",
+            epoch=depart_epoch)
         if reason == "evicted":
             try:
                 from h2o3_tpu import telemetry

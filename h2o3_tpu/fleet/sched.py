@@ -74,6 +74,10 @@ _COUNTERS: Dict[str, int] = {
     "remote_submits": 0, "remote_received": 0, "migrations": 0,
     "rebalanced": 0, "evict_requeues": 0}
 _RR: Dict[str, int] = {}            # share group -> round-robin cursor
+# (member_id, depart_epoch) departure records this process already
+# raced a requeue lease for — the lease arbitrates across processes,
+# this set stops one process re-racing the same gossip record per beat
+_SEEN_DEPARTED: set = set()
 _REBAL: Dict[str, float] = {"last": 0.0}
 _FRAMES: Dict[str, Tuple[float, Any]] = {}   # path -> (mtime, Frame)
 _EXEC = None
@@ -124,6 +128,20 @@ def counters() -> Dict[str, int]:
 def _count(name: str, n: int = 1) -> None:
     with _MU:
         _COUNTERS[name] = _COUNTERS.get(name, 0) + n
+
+
+def _bb(kind: str, member: str = "", payload: str = "",
+        trace_id: Optional[str] = None, epoch: Optional[int] = None
+        ) -> None:
+    """Flight-recorder append (ISSUE 19): every placement / hand-off /
+    migrate / requeue decision lands in the blackbox ring so a chaos
+    post-mortem can read WHY the fleet moved work. Advisory."""
+    try:
+        from h2o3_tpu.telemetry import blackbox
+        blackbox.record(kind, member=member, payload=payload,
+                        trace_id=trace_id, epoch=epoch)
+    except Exception:   # noqa: BLE001 — flight recorder is advisory
+        pass
 
 
 def _xfer_dir() -> Optional[str]:
@@ -194,7 +212,10 @@ def fleet_view_from_table(table) -> Dict[str, Any]:
             "routable": bool(m.routable),
             "sched": parse_sched_payload(m.sched),
         })
-    return {"epoch": table.epoch, "members": members}
+    # recent departures ride the view too: survivors race for the
+    # evict-requeue lease off this list (router-less requeue, ISSUE 19)
+    return {"epoch": table.epoch, "members": members,
+            "departed": table.departed()}
 
 
 def observe_fleet_view(view: Any, self_id: str) -> None:
@@ -205,6 +226,29 @@ def observe_fleet_view(view: Any, self_id: str) -> None:
     with _MU:
         _GOSSIP["view"] = view
         _GOSSIP["mono"] = time.monotonic()
+    try:
+        from h2o3_tpu.telemetry import blackbox
+        blackbox.set_identity(epoch=int(view.get("epoch") or 0))
+    except Exception:   # noqa: BLE001 — flight recorder is advisory
+        pass
+    # router-less evict requeue: ANY survivor that sees an eviction in
+    # the gossiped view races for the victim's lease (once per
+    # departure record per process; the lease arbitrates cross-process)
+    try:
+        for dep in view.get("departed") or []:
+            if not isinstance(dep, dict) or dep.get("reason") != "evicted":
+                continue
+            mid = str(dep.get("member_id") or "")
+            if not mid or mid == self_id:
+                continue
+            key = (mid, int(dep.get("epoch") or 0))
+            with _MU:
+                if key in _SEEN_DEPARTED:
+                    continue
+                _SEEN_DEPARTED.add(key)
+            _executor().submit(_requeue_departed, mid, key[1])
+    except Exception:   # noqa: BLE001 — gossip ingest must never throw
+        pass
     # elastic membership: a member with headroom appearing while work
     # is queued here absorbs it (throttled; runs off-thread)
     try:
@@ -317,12 +361,20 @@ def place_for_submit(pr_name: str, share: str, need_bytes: int
             _RR[share] = cursor + 1
         pick = slots[cursor % len(slots)]
         if pick is None:
+            _bb("placement", self_id,
+                payload=f"local rr share={share}", epoch=epoch)
             return None, None
+        _bb("placement", pick["member_id"],
+            payload=f"rr share={share} head="
+                    f"{pick['sched']['headroom_bytes']}", epoch=epoch)
         return {"member": pick, "epoch": epoch}, None
     if local_fits:
         return None, None                    # local wins ties
     if cands:
         best = max(cands, key=_headroom_key)
+        _bb("placement", best["member_id"],
+            payload=f"remote need={need_bytes} head="
+                    f"{best['sched']['headroom_bytes']}", epoch=epoch)
         return {"member": best, "epoch": epoch}, None
     # no headroom anywhere: queue locally, snapshot the evidence
     snapshot = {
@@ -330,6 +382,9 @@ def place_for_submit(pr_name: str, share: str, need_bytes: int
         "members": [{"member_id": m["member_id"],
                      "headroom_bytes": m["sched"]["headroom_bytes"]}
                     for m in eligible]}
+    _bb("placement", self_id,
+        payload=f"no_headroom need={need_bytes} "
+                f"members={len(eligible)}", epoch=epoch)
     return None, snapshot
 
 
@@ -474,6 +529,9 @@ def _hand_off(entry, member: Dict[str, Any],
     if pre_proxy is not None:
         pre_proxy()
     _count("remote_submits")
+    _bb("remote_submit_sent", str(member.get("member_id") or ""),
+        payload=f"job={entry.job.key} ckpt={int(bool(checkpoint_path))}",
+        trace_id=getattr(entry.job, "trace_id", None))
     _start_proxy(entry, member, str(out.get("job_key")),
                  payload["model_key"], payload["result_path"],
                  migrated=migrated)
@@ -545,6 +603,9 @@ def handle_remote_submit(b: Dict[str, Any]) -> Dict[str, Any]:
             save_model(model, os.path.dirname(result_path), force=True,
                        filename=os.path.basename(result_path))
         _count("remote_received")
+        _bb("remote_submit_accepted", str(b.get("submitter") or ""),
+            payload=f"model={model_key} from_artifact=1",
+            trace_id=b.get("trace_id") or None)
         return {"ok": True, "job_key": None, "model_key": model_key,
                 "member_id": local_member_id(),
                 "completed_from_artifact": True}
@@ -585,6 +646,10 @@ def handle_remote_submit(b: Dict[str, Any]) -> Dict[str, Any]:
             recovery._RESUME_CTX.on = False
     job = est.job
     _count("remote_received")
+    _bb("remote_submit_accepted", str(b.get("submitter") or ""),
+        payload=f"model={model_key} job={job.key} "
+                f"resuming={int(resuming)}",
+        trace_id=trace_id)
     info("fleet-sched: accepted %s %s from %s (priority=%s share=%s)",
          algo, model_key, b.get("submitter"), pr, share)
     _executor().submit(_finish_remote, job, model_key, result_path)
@@ -766,6 +831,10 @@ def _finalize_proxy_done(entry, model_key: str,
     job.end_time = time.time()
     job._end_mono = time.monotonic()
     job._done_evt.set()
+    if migrated:
+        _bb("migrate_done", str(entry.remote_member or ""),
+            payload=f"job={job.key} model={model_key}",
+            trace_id=getattr(job, "trace_id", None))
     _proxy_done(entry)
 
 
@@ -826,6 +895,11 @@ def _migrate_entry(entry) -> bool:
                      migrated=True):
         return False
     _count("migrations")
+    _bb("migrate_start",
+        str(placement["member"].get("member_id") or ""),
+        payload=f"job={job.key} ckpt={int(bool(ckpt_path))}",
+        trace_id=getattr(job, "trace_id", None),
+        epoch=placement.get("epoch"))
     from h2o3_tpu.log import info
     info("fleet-sched: migrated %s to %s (ckpt=%s)", job.key,
          placement["member"].get("member_id"), bool(ckpt_path))
@@ -916,6 +990,8 @@ def rebalance_queued() -> int:
             s.requeue(e)
     if moved:
         _count("rebalanced", moved)
+        _bb("rebalance", local_member_id(),
+            payload=f"moved={moved} members={len(cands)}", epoch=epoch)
         from h2o3_tpu.log import info
         info("fleet-sched: handed %d queued train(s) to %d member(s) "
              "(epoch %d)", moved, len(cands), epoch)
@@ -941,17 +1017,95 @@ def router_tick(table) -> None:
 def on_member_departed(member, reason: str) -> None:
     """MemberTable depart callback (router process): an EVICTED
     replica's RUNNING checkpointing trains are re-queued fleet-wide
-    from their last chunk commit via the recovery manifests."""
+    from their last chunk commit via the recovery manifests. The
+    router races the survivors for the victim's lease like any other
+    member — it holds no special role in the requeue anymore."""
     if reason != "evicted":
         return                # graceful leave drains its own work
-    _executor().submit(_requeue_departed, member.member_id)
+    epoch = 0
+    try:
+        from h2o3_tpu import fleet
+        r = fleet.active_router()
+        if r is not None:
+            for dep in reversed(r.table.departed()):
+                if dep.get("member_id") == member.member_id:
+                    epoch = int(dep.get("epoch") or 0)
+                    break
+    except Exception:   # noqa: BLE001 — epoch is a lease suffix only
+        pass
+    _executor().submit(_requeue_departed, member.member_id, epoch)
 
 
-def _requeue_departed(member_id: str) -> None:
+def _lease_dir() -> Optional[str]:
+    root = _xfer_dir()
+    return os.path.join(root, "leases") if root else None
+
+
+def _lease_stale_s() -> float:
+    return _knob_s("H2O3_FLEET_LEASE_STALE_S", 30.0)
+
+
+def claim_departed(member_id: str, epoch: int = 0) -> bool:
+    """Router-less evict-requeue arbitration (ISSUE 19 satellite): ANY
+    survivor that learns of an eviction — from its own member table or
+    from the gossiped fleet view — races an ``O_CREAT|O_EXCL`` lease
+    file under the shared recovery root. Exactly one process wins and
+    requeues the victim's RUNNING manifests; the others back off. A
+    lease whose holder died mid-requeue goes stale after
+    ``H2O3_FLEET_LEASE_STALE_S`` and is stolen (the steal window is
+    deliberately wide — a rare double-resume of the same model key
+    beats an orphaned train). Claim and steal are themselves blackbox
+    events: the post-mortem shows WHO resumed the victim's work."""
+    d = _lease_dir()
+    if d is None:
+        return False
+    me = local_member_id()
+    body = json.dumps({"claimant": me, "victim": member_id,
+                       "epoch": int(epoch), "wall": time.time()})
+    path = os.path.join(
+        d, f"{member_id.replace('/', '_')}.{int(epoch)}.lease")
+    try:
+        os.makedirs(d, exist_ok=True)
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o644)
+        try:
+            os.write(fd, body.encode())
+        finally:
+            os.close(fd)
+        _bb("lease_claim", member_id, payload=f"claimant={me}",
+            epoch=epoch)
+        return True
+    except FileExistsError:
+        pass
+    except OSError:
+        return False
+    try:
+        with open(path) as f:
+            held = json.loads(f.read() or "{}")
+    except (OSError, ValueError):
+        held = {}
+    age = time.time() - float(held.get("wall") or 0.0)  # h2o3-lint: allow[monotonic-durations] lease age must compare across processes — wall time is the only shared clock
+    if age < _lease_stale_s():
+        return False              # a live claimant owns the requeue
+    tmp = f"{path}.steal.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            f.write(body)
+        os.replace(tmp, path)
+    except OSError:
+        return False
+    _bb("lease_steal", member_id,
+        payload=f"claimant={me} from={held.get('claimant')} "
+                f"age={age:.0f}s", epoch=epoch)
+    return True
+
+
+def _requeue_departed(member_id: str, epoch: int = 0) -> None:
     from h2o3_tpu import recovery
     from h2o3_tpu.log import info, warn
     if recovery.recovery_dir() is None:
         return
+    if not claim_departed(member_id, epoch):
+        return                    # another survivor holds the lease
     try:
         entries, _corrupt = recovery.scan(quarantine=False)
     except Exception as e:   # noqa: BLE001 — scan failure is not fatal
@@ -966,6 +1120,9 @@ def _requeue_departed(member_id: str) -> None:
         try:
             if _resubmit_manifest(ent):
                 _count("evict_requeues")
+                _bb("evict_requeue", member_id,
+                    payload=f"model={ent.get('model_key')}",
+                    trace_id=ent.get("trace_id") or None, epoch=epoch)
         except Exception as e:   # noqa: BLE001 — per-train isolation
             warn("fleet-sched: evict-requeue of %s failed: %r",
                  ent.get("model_key"), e)
@@ -1106,6 +1263,7 @@ def reset() -> None:
         _GOSSIP["mono"] = 0.0
         _RR.clear()
         _FRAMES.clear()
+        _SEEN_DEPARTED.clear()
         _REBAL["last"] = 0.0
         for k in list(_COUNTERS):
             _COUNTERS[k] = 0

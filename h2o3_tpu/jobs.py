@@ -52,6 +52,19 @@ _REGISTRY: Dict[str, "Job"] = {}
 _LOCK = threading.Lock()
 
 
+def _bb(job: "Job", state: str, reason: str = "") -> None:
+    """Flight-recorder append (ISSUE 19): job lifecycle transitions in
+    the blackbox ring, keyed by job key + trace id so the cluster
+    timeline threads one train across replicas. Advisory."""
+    try:
+        from h2o3_tpu.telemetry import blackbox
+        blackbox.record("job_state", member=job.key,
+                        payload=f"{state} {reason}".strip()[:144],
+                        trace_id=job.trace_id)
+    except Exception:   # noqa: BLE001 — flight recorder is advisory
+        pass
+
+
 class JobCancelled(Exception):
     """Raised inside cooperative cancellation points (streamed level
     passes) to unwind a cancelled job's work loop cleanly."""
@@ -333,6 +346,7 @@ class Job:
         RECOVERING)."""
         self.status = QUEUED
         self._dispatched = False
+        _bb(self, "QUEUED")
         return self
 
     def mark_dispatched(self) -> None:
@@ -353,6 +367,7 @@ class Job:
         self._dispatched = True
         if self.status != RECOVERING:   # recovery resumes keep badge
             self.status = RUNNING
+        _bb(self, self.status, f"waited={wait:.2f}s")
 
     def execute_scheduled(self, fn: Callable[["Job"], Any]) -> bool:
         """THE job lifecycle protocol: run ``fn(self)`` on the calling
@@ -383,6 +398,7 @@ class Job:
         self.end_time = time.time()
         self._end_mono = time.monotonic()
         self._done_evt.set()
+        _bb(self, self.status, self.exception_msg or "")
         return True
 
     def mark_requeued(self) -> None:
@@ -398,6 +414,7 @@ class Job:
         self.start_mono = now
         self._dispatched = False
         self.status = QUEUED
+        _bb(self, "REQUEUED", f"cycle={self.preempt_count}")
 
     def run_seconds(self) -> float:
         """Cumulative RUN time across preempt/resume cycles — the
@@ -425,12 +442,14 @@ class Job:
         self._cancel_requested = True
         if reason and not self.cancel_reason:
             self.cancel_reason = reason
+        _bb(self, "CANCEL_REQUESTED", reason or "")
 
     def preempt(self, reason: Optional[str] = None):
         """Scheduler request: yield at the next checkpoint commit and
         get requeued. Distinct from cancel() — the job is NOT over."""
         self.preempt_reason = reason
         self._preempt_requested = True
+        _bb(self, "PREEMPT_REQUESTED", reason or "")
 
     @property
     def cancel_requested(self) -> bool:

@@ -806,6 +806,11 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                                    with_metrics=False)
                 self._write_in_training_checkpoint(m, cur_margin,
                                                    ckpt_dir, spec=spec)
+                from h2o3_tpu.telemetry import blackbox
+                blackbox.record("ckpt_commit",
+                                member=str(self.params.get("model_id")
+                                           or self.algo),
+                                payload=f"trees={built} algo={self.algo}")
             except Exception as e:  # noqa: BLE001 — advisory only
                 from h2o3_tpu.log import warn
                 warn("%s: in-training checkpoint commit failed: %s",
@@ -1305,6 +1310,12 @@ class H2OGradientBoostingEstimator(ModelBuilder):
                 m = build_model(trees)
                 attach_resume_state(m)
                 persist_in_training_ckpt(m, self.algo, ckpt_dir)
+                from h2o3_tpu.telemetry import blackbox
+                blackbox.record("ckpt_commit",
+                                member=str(p.get("model_id")
+                                           or self.algo),
+                                payload=f"trees={len(trees)} "
+                                        f"algo={self.algo} streamed=1")
             except Exception as ce:  # noqa: BLE001 — advisory only
                 from h2o3_tpu.log import warn as _warn
                 _warn("%s: streamed in-training checkpoint commit "

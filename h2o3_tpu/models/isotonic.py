@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from h2o3_tpu import telemetry
 from h2o3_tpu.jobs import Job
 from h2o3_tpu.models.model_base import (Model, ModelBuilder, TrainingSpec,
                                         compute_metrics)
@@ -106,9 +107,9 @@ class H2OIsotonicRegressionEstimator(ModelBuilder):
         w = jnp.where(live, spec.w, 0.0)
         xs, wys, ws = _sorted_aggregate(
             jnp.where(live, x, jnp.inf), spec.y, w)
-        xs = np.asarray(jax.device_get(xs))
-        wys = np.asarray(jax.device_get(wys))
-        ws = np.asarray(jax.device_get(ws))
+        xs = np.asarray(telemetry.device_get(xs))
+        wys = np.asarray(telemetry.device_get(wys))
+        ws = np.asarray(telemetry.device_get(ws))
         keep = np.isfinite(xs) & (ws > 0)
         xs, wys, ws = xs[keep], wys[keep], ws[keep]
         if len(xs) == 0:

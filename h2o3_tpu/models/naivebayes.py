@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from h2o3_tpu import telemetry
 from h2o3_tpu.jobs import Job
 from h2o3_tpu.models.model_base import (Model, ModelBuilder, TrainingSpec,
                                         compute_metrics)
@@ -102,7 +103,7 @@ class H2ONaiveBayesEstimator(ModelBuilder):
         yoh = ((y[:, None] == jnp.arange(K)[None, :]).astype(jnp.float32)
                * w[:, None])                                     # [rows, K]
         cls_w = yoh.sum(0)                                       # [K]
-        priors = np.asarray(jax.device_get(cls_w / cls_w.sum()))
+        priors = np.asarray(telemetry.device_get(cls_w / cls_w.sum()))
         num_idx = [i for i, c in enumerate(spec.is_cat) if not c]
         num_mean = np.zeros((K, len(num_idx)), np.float32)
         num_sd = np.ones((K, len(num_idx)), np.float32)
@@ -125,8 +126,8 @@ class H2ONaiveBayesEstimator(ModelBuilder):
             # min_sdev; min_sdev floors the rest (reference NB params)
             sd = jnp.where(sd <= eps_sdev, min_sdev,
                            jnp.maximum(sd, min_sdev))
-            num_mean = np.asarray(jax.device_get(mu))
-            num_sd = np.asarray(jax.device_get(sd))
+            num_mean = np.asarray(telemetry.device_get(mu))
+            num_sd = np.asarray(telemetry.device_get(sd))
         cat_probs: Dict[str, np.ndarray] = {}
         for i, (n, is_cat) in enumerate(zip(spec.names, spec.is_cat)):
             if not is_cat:
@@ -141,7 +142,7 @@ class H2ONaiveBayesEstimator(ModelBuilder):
                                       preferred_element_type=jnp.float32)
             cnt = cnt + laplace
             P = cnt / jnp.maximum(cnt.sum(1, keepdims=True), 1e-30)
-            cat_probs[n] = np.asarray(jax.device_get(P))
+            cat_probs[n] = np.asarray(telemetry.device_get(P))
         model = NaiveBayesModel(f"nb_{id(self) & 0xffffff:x}", self.params,
                                 spec, priors, num_mean, num_sd, cat_probs)
         out = model._predict_matrix(X)

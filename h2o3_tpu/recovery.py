@@ -264,6 +264,10 @@ def record_training(builder, job, frame, y, spec) -> Optional[str]:
         telemetry.counter(
             "h2o3_recovery_manifests_total", {"algo": builder.algo},
             help="training recovery manifests recorded").inc()
+        from h2o3_tpu.telemetry import blackbox
+        blackbox.record("manifest_written", member=model_key,
+                        payload=f"algo={builder.algo} job={job.key}",
+                        trace_id=manifest["trace_id"])
         return model_key
     except Exception as e:   # noqa: BLE001 — advisory only
         try:
@@ -284,6 +288,11 @@ def complete_training(model_key: str) -> None:
     try:
         os.remove(_manifest_path(root, model_key))
     except OSError:
+        return
+    try:
+        from h2o3_tpu.telemetry import blackbox
+        blackbox.record("manifest_done", member=model_key)
+    except Exception:   # noqa: BLE001 — flight recorder is advisory
         pass
 
 
@@ -609,6 +618,14 @@ def recover_at_boot(wait: bool = False) -> Dict[str, Any]:
             except OSError:
                 pass
             report["abandoned"].append(ent.get("model_key"))
+            try:
+                from h2o3_tpu.telemetry import blackbox
+                blackbox.record("manifest_abandoned",
+                                member=str(ent.get("model_key") or ""),
+                                payload=f"attempts={attempts}",
+                                trace_id=ent.get("trace_id"))
+            except Exception:   # noqa: BLE001 — flight recorder is advisory
+                pass
             continue
         # count the attempt BEFORE resuming: a crash mid-resume must
         # still advance the cap
@@ -619,6 +636,15 @@ def recover_at_boot(wait: bool = False) -> Dict[str, Any]:
                 if k not in ("manifest_path", "latest_ckpt",
                              "ckpt_trees")})
         except OSError:
+            pass
+        try:
+            from h2o3_tpu.telemetry import blackbox
+            blackbox.record("manifest_claimed",
+                            member=str(ent.get("model_key") or ""),
+                            payload=f"attempt={attempts + 1} "
+                                    f"ckpt_trees={ent.get('ckpt_trees')}",
+                            trace_id=ent.get("trace_id"))
+        except Exception:   # noqa: BLE001 — flight recorder is advisory
             pass
         try:
             report["resumed"].append(_resume_entry(ent, wait))

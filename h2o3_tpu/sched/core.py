@@ -79,6 +79,19 @@ PLACER = None
 MIGRATOR = None
 
 
+def _bb(kind: str, member: str = "", payload: str = "",
+        trace_id: Optional[str] = None) -> None:
+    """Flight-recorder append (ISSUE 19): enqueue/admit/preempt/requeue
+    decisions — the local scheduler's side of the cluster timeline.
+    ``member`` carries the subject job key. Advisory."""
+    try:
+        from h2o3_tpu.telemetry import blackbox
+        blackbox.record(kind, member=member, payload=payload,
+                        trace_id=trace_id)
+    except Exception:   # noqa: BLE001 — flight recorder is advisory
+        pass
+
+
 class SchedulerSaturatedError(RuntimeError):
     """The run queue is at H2O3_SCHED_MAX_QUEUE — the submission is
     REJECTED (counted on h2o3_sched_rejected_total) rather than growing
@@ -276,6 +289,9 @@ class Scheduler:
                         for dq in od.values())
             if depth >= _max_queue():
                 self._m_rejected.inc()
+                _bb("sched_reject", job.key,
+                    payload=f"queue_full depth={depth}",
+                    trace_id=getattr(job, "trace_id", None))
                 raise SchedulerSaturatedError(
                     f"training queue is full ({depth} entries, cap "
                     f"{_max_queue()}) — raise H2O3_SCHED_MAX_QUEUE or "
@@ -298,6 +314,10 @@ class Scheduler:
             self._update_gauges_locked()
             self._ensure_thread_locked()
             self._cv.notify_all()
+        _bb("sched_enqueue", job.key,
+            payload=f"pr={pr_name} share={share} "
+                    f"need={est.bytes}",
+            trace_id=getattr(job, "trace_id", None))
         return entry
 
     # ---------------- dispatcher --------------------------------------
@@ -432,6 +452,10 @@ class Scheduler:
                   f"{PRIORITY_NAMES[cand.priority]} job {cand.job.key}")
         victim.job.preempt(reason)
         self._m_preempted.inc()
+        _bb("sched_preempt", victim.job.key,
+            payload=f"for={cand.job.key} "
+                    f"cls={PRIORITY_NAMES[victim.priority]}",
+            trace_id=getattr(victim.job, "trace_id", None))
         from h2o3_tpu.log import info
         info("sched: preempting %s (%s, priority=%s) for %s",
              victim.job.key, victim.builder.algo,
@@ -447,6 +471,10 @@ class Scheduler:
         entry.wait_reason = None
         self._m_admitted.inc()
         self._m_wait.observe(wait_s * 1000.0)
+        _bb("sched_admit", job.key,
+            payload=f"wait_ms={wait_s * 1000.0:.0f} "
+                    f"cycles={entry.preempt_cycles}",
+            trace_id=getattr(job, "trace_id", None))
         try:
             with inline_run():
                 terminal = job.execute_scheduled(
@@ -510,6 +538,9 @@ class Scheduler:
         job.mark_requeued()
         entry.preempt_cycles += 1
         entry.dispatch_mono = None
+        _bb("sched_requeue", job.key,
+            payload=f"cycles={entry.preempt_cycles} resume=ckpt",
+            trace_id=getattr(job, "trace_id", None))
         try:
             key = entry.builder._model_key()
             from h2o3_tpu import dkv

@@ -36,6 +36,21 @@ HALF_OPEN = "half_open"
 
 _STATE_CODE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
 
+_BB_KINDS = {CLOSED: "circuit_close", HALF_OPEN: "circuit_half_open",
+             OPEN: "circuit_open"}
+
+
+def _bb(model: str, state: str, payload: str = "") -> None:
+    """Flight-recorder append (ISSUE 19): local circuit transitions are
+    control-plane decisions — a chaos post-mortem needs to see WHEN a
+    deployment started failing fast. Advisory."""
+    try:
+        from h2o3_tpu.telemetry import blackbox
+        blackbox.record(_BB_KINDS.get(state, "circuit_open"),
+                        member=model or "_anon", payload=payload)
+    except Exception:   # noqa: BLE001 — flight recorder is advisory
+        pass
+
 
 class CircuitBreaker:
     def __init__(self, model: str = "", failure_threshold: int = 5,
@@ -86,6 +101,7 @@ class CircuitBreaker:
                 self._state = HALF_OPEN
                 self._probe_inflight = False
                 self._set_gauge()
+                _bb(self.model, HALF_OPEN, "cooldown expired; probing")
             # HALF_OPEN: admit a single probe; reject the rest until
             # its verdict lands. A probe can die before EVER reaching
             # the device stage (queue-full rejection, expired in queue,
@@ -110,6 +126,7 @@ class CircuitBreaker:
             if self._state != CLOSED:
                 self._state = CLOSED
                 self._set_gauge()
+                _bb(self.model, CLOSED, "probe succeeded")
                 from h2o3_tpu.log import info
                 info("serve circuit for '%s' closed (probe succeeded)",
                      self.model)
@@ -127,6 +144,8 @@ class CircuitBreaker:
                 self._probe_inflight = False
                 self._open_ctr.inc()
                 self._set_gauge()
+                _bb(self.model, OPEN,
+                    f"failures={self._consecutive_failures}")
                 from h2o3_tpu.log import warn
                 warn("serve circuit for '%s' OPEN after %d consecutive "
                      "device failures — failing fast for %.2fs",

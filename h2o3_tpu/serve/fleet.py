@@ -122,6 +122,7 @@ def observe_peer_states(states: Optional[List[dict]], source: str,
     state: the local breaker is the authority on local health."""
     now = time.monotonic()
     touched = set()
+    fresh_opens = []
     with _MU:
         for st in states or []:
             model = st.get("model")
@@ -130,6 +131,8 @@ def observe_peer_states(states: Optional[List[dict]], source: str,
             key = (str(model), source)
             touched.add(str(model))
             if st.get("state") == "open" and not self_process:
+                if key not in _STORE:
+                    fresh_opens.append(str(model))
                 try:
                     ra = float(st.get("retry_after_s", 1.0) or 1.0)
                 except (TypeError, ValueError):
@@ -158,6 +161,16 @@ def observe_peer_states(states: Optional[List[dict]], source: str,
                 _STORE.pop(key, None)
         expired = _expire_locked(now)
         _set_has_open_locked()
+    for model in fresh_opens:
+        # flight recorder (ISSUE 19): a gossiped open circuit arriving
+        # here is a control-plane decision — this replica starts
+        # shedding load toward `model` on a PEER's word
+        try:
+            from h2o3_tpu.telemetry import blackbox
+            blackbox.record("circuit_gossip", member=model,
+                            payload=f"open from={source}")
+        except Exception:   # noqa: BLE001 — flight recorder is advisory
+            pass
     _publish_gauges(touched | expired)
 
 

@@ -1,0 +1,480 @@
+"""Cluster flight recorder: crash-durable control-plane event journal.
+
+The reference's observability spine is ``water/TimeLine.java`` — a
+fixed-size per-node ring of fixed-width event records that *survives
+the node* and is snapshotted cluster-wide into one merged timeline
+(``water/init/TimelineSnapshot.java``). This is that layer for the
+rebuild: every process appends typed 256-byte records into an
+mmap-backed ring file under the shared recovery/fleet root, so a
+SIGKILLed replica's last events (placement decisions, checkpoint
+commits, eviction, fault firings) remain readable post-mortem by any
+survivor — the kernel flushes the dirty MAP_SHARED pages whether or
+not the writer got to say goodbye. (A machine-level crash losing the
+page cache is out of scope, same as the recovery manifests.)
+
+Ring layout (little-endian, one file per member, ``<member>.bbx``):
+
+- 4096-byte header page: magic ``H2O3BBX1``, record size, capacity,
+  total-events-written cursor (``seq``), writer member id. The cursor
+  is bumped AFTER the record bytes land, so a torn write at death
+  costs at most the one record being appended.
+- ``capacity`` x 256-byte records: mono ns, wall ns, seq, membership
+  epoch, incarnation, kind code, flags, trace id (32B), member/subject
+  (44B), payload (144B).
+
+Appends are single-writer striped — one ring per process, one lock,
+no cross-process coordination — and follow the PR-4 span-path budget
+discipline: ``record()`` is a checked no-op behind the registry
+enabled flag when ``H2O3_TELEMETRY=0`` (ns-budget guarded in
+tests/test_blackbox.py) and stays under the 2 µs/event enabled-path
+budget (one struct.pack + one memoryview splice under a lock).
+
+Knobs: ``H2O3_BLACKBOX_DIR`` pins the ring directory (default:
+``<recovery_dir>/blackbox`` — no recovery root and no explicit dir
+means no ring, and ``record()`` degrades to a cached no-op);
+``H2O3_BLACKBOX_EVENTS`` sizes the ring (default 4096, min 64).
+
+``cluster_timeline()`` merges the local ring, live peers' rings over
+the telemetry peer plane (``GET /3/Blackbox``), and dead members'
+ring files from the shared root into one epoch-fenced causal order:
+sort key (epoch, skew-corrected wall ns, member, seq). Per-member
+wall-clock skew is estimated from the heartbeat exchange (the agent
+stamps its wall clock on every beat; the router records the offset)
+and members beyond ``SKEW_FLAG_S`` are flagged rather than silently
+re-ordered. ``tools/blackbox_read.py`` decodes any ring file offline.
+"""
+from __future__ import annotations
+
+import json
+import mmap
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from h2o3_tpu.telemetry.registry import on_reset, registry
+
+__all__ = [
+    "KIND_CODES", "KIND_NAMES", "Ring", "blackbox_dir", "cluster_timeline",
+    "cluster_trace_bytes", "events_recorded", "local_events", "read_ring",
+    "record", "reset", "ring_path", "set_identity",
+]
+
+MAGIC = b"H2O3BBX1"
+HEADER = struct.Struct("<8sIIQ44s")       # magic, rec_size, cap, seq, member
+HEADER_SIZE = 4096                        # one page; records start aligned
+RECORD = struct.Struct("<QQQIIHH32s44s144s")
+RECORD_SIZE = RECORD.size                 # 256
+DEFAULT_EVENTS = 4096
+SKEW_FLAG_S = 0.25        # |heartbeat-estimated skew| beyond this is flagged
+PEER_CAP_BYTES = 4 << 20  # per-peer /3/Blackbox response size cap
+
+# Event kinds: stable small codes on disk, names everywhere else. New
+# kinds append — never renumber, post-mortem readers may be older.
+KIND_CODES: Dict[str, int] = {
+    "member_join": 1, "member_suspect": 2, "member_evict": 3,
+    "member_leave": 4, "incarnation_fence": 5, "member_flip": 6,
+    "placement": 10, "remote_submit_sent": 11, "remote_submit_accepted": 12,
+    "migrate_start": 13, "migrate_done": 14, "rebalance": 15,
+    "evict_requeue": 16, "lease_claim": 17, "lease_steal": 18,
+    "sched_enqueue": 20, "sched_admit": 21, "sched_preempt": 22,
+    "sched_requeue": 23, "sched_reject": 24,
+    "circuit_open": 30, "circuit_close": 31, "circuit_half_open": 32,
+    "circuit_gossip": 33,
+    "ckpt_commit": 40, "manifest_written": 41, "manifest_claimed": 42,
+    "manifest_abandoned": 43, "manifest_done": 44,
+    "fault_fired": 50,
+    "job_state": 60,
+}
+KIND_NAMES: Dict[int, str] = {v: k for k, v in KIND_CODES.items()}
+
+_MU = threading.Lock()
+_RING: Any = None          # None = unresolved, False = off, Ring = live
+_IDENT = {"epoch": 0, "incarnation": 0}
+
+
+def _sanitize(member_id: str) -> str:
+    return "".join(c if (c.isalnum() or c in "@._-") else "_"
+                   for c in member_id)[:44]
+
+
+def _default_member_id() -> str:
+    try:
+        from h2o3_tpu.fleet import sched as fleet_sched
+        return fleet_sched.local_member_id()
+    except Exception:  # noqa: BLE001 — recorder must not need the fleet
+        return f"{os.getpid()}@{socket.gethostname()}"
+
+
+def blackbox_dir() -> Optional[str]:
+    """Ring directory: ``H2O3_BLACKBOX_DIR``, else a ``blackbox/``
+    subdirectory of the shared recovery root (so chaos rounds that
+    share a recovery dir share the flight-recorder root for free),
+    else None — disabled."""
+    d = os.environ.get("H2O3_BLACKBOX_DIR")
+    if d:
+        return d
+    try:
+        from h2o3_tpu import recovery
+        root = recovery.recovery_dir()
+    except Exception:  # noqa: BLE001 — advisory
+        root = None
+    return os.path.join(root, "blackbox") if root else None
+
+
+def _capacity() -> int:
+    try:
+        return max(int(os.environ.get("H2O3_BLACKBOX_EVENTS",
+                                      str(DEFAULT_EVENTS))), 64)
+    except ValueError:
+        return DEFAULT_EVENTS
+
+
+class Ring:
+    """One member's mmap-backed event ring (the single writer)."""
+
+    def __init__(self, path: str, capacity: int, member_id: str):
+        self.path = path
+        self.capacity = capacity
+        self.member_id = member_id
+        self._mu = threading.Lock()
+        total = HEADER_SIZE + capacity * RECORD_SIZE
+        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+        try:
+            adopt_seq = 0
+            st = os.fstat(fd)
+            if st.st_size >= HEADER_SIZE:
+                head = os.pread(fd, HEADER.size, 0)
+                if len(head) == HEADER.size:
+                    magic, rs, cap, seq, _ = HEADER.unpack(head)
+                    if (magic == MAGIC and rs == RECORD_SIZE
+                            and cap == capacity
+                            and st.st_size == total):
+                        adopt_seq = seq   # restart: keep writing after
+            if st.st_size != total:
+                os.ftruncate(fd, total)
+            self._mm = mmap.mmap(fd, total)  # MAP_SHARED by default
+        finally:
+            os.close(fd)
+        self.seq = adopt_seq
+        if adopt_seq == 0:
+            self._mm[:HEADER.size] = HEADER.pack(
+                MAGIC, RECORD_SIZE, capacity, 0,
+                member_id.encode()[:44].ljust(44, b"\0"))
+
+    def append(self, kind: int, wall_ns: int, mono_ns: int, epoch: int,
+               incarnation: int, trace: bytes, member: bytes,
+               payload: bytes) -> None:
+        with self._mu:
+            seq = self.seq
+            off = HEADER_SIZE + (seq % self.capacity) * RECORD_SIZE
+            self._mm[off:off + RECORD_SIZE] = RECORD.pack(
+                mono_ns, wall_ns, seq, epoch, incarnation, kind, 0,
+                trace, member, payload)
+            self.seq = seq + 1
+            # cursor AFTER the record: a SIGKILL between the two writes
+            # loses only the record being appended, never a stale view
+            self._mm[16:24] = struct.pack("<Q", self.seq)
+
+    def tail(self, n: int) -> List[Dict[str, Any]]:
+        """Last ``n`` events, oldest first, decoded from the live map."""
+        with self._mu:
+            seq = self.seq
+            valid = min(seq, self.capacity)
+            lo = seq - min(valid, n)
+            out = []
+            for i in range(lo, seq):
+                off = HEADER_SIZE + (i % self.capacity) * RECORD_SIZE
+                ev = _decode(self._mm[off:off + RECORD_SIZE])
+                if ev is not None:
+                    out.append(ev)
+            return out
+
+    def close(self) -> None:
+        with self._mu:
+            try:
+                self._mm.close()
+            except (BufferError, ValueError):
+                pass
+
+
+def _decode(raw: bytes) -> Optional[Dict[str, Any]]:
+    (mono_ns, wall_ns, seq, epoch, incarnation, kind, _flags, trace,
+     member, payload) = RECORD.unpack(raw)
+    if mono_ns == 0 and wall_ns == 0 and kind == 0:
+        return None                       # empty / torn slot
+    return {
+        "seq": seq, "t_mono_ns": mono_ns, "t_wall": wall_ns / 1e9,
+        "epoch": epoch, "incarnation": incarnation,
+        "kind": KIND_NAMES.get(kind, f"kind_{kind}"),
+        "trace_id": trace.rstrip(b"\0").decode("utf-8", "replace"),
+        "member": member.rstrip(b"\0").decode("utf-8", "replace"),
+        "payload": payload.rstrip(b"\0").decode("utf-8", "replace"),
+    }
+
+
+def read_ring(path: str, last: Optional[int] = None) -> Dict[str, Any]:
+    """Decode a ring file (live or post-mortem): header + events in
+    seq order, oldest first. Raises ValueError on a non-ring file."""
+    with open(path, "rb") as f:
+        head = f.read(HEADER.size)
+        if len(head) < HEADER.size:
+            raise ValueError(f"{path}: truncated blackbox header")
+        magic, rec_size, cap, seq, member = HEADER.unpack(head)
+        if magic != MAGIC:
+            raise ValueError(f"{path}: not a blackbox ring (bad magic)")
+        if rec_size != RECORD_SIZE:
+            raise ValueError(f"{path}: record size {rec_size} != "
+                             f"{RECORD_SIZE} (format drift)")
+        f.seek(HEADER_SIZE)
+        body = f.read(cap * rec_size)
+    valid = min(seq, cap)
+    lo = seq - valid
+    if last is not None:
+        lo = max(lo, seq - last)
+    events = []
+    for i in range(lo, seq):
+        off = (i % cap) * rec_size
+        ev = _decode(body[off:off + rec_size])
+        if ev is not None:
+            events.append(ev)
+    return {"path": path, "capacity": cap, "seq": seq,
+            "member_id": member.rstrip(b"\0").decode("utf-8", "replace"),
+            "events": events}
+
+
+# ---------------------------------------------------------------- writer API
+
+def _open_ring() -> Any:
+    """Resolve the process ring once; cache False when disabled so the
+    hot path stays one global read + one attribute check."""
+    global _RING
+    with _MU:
+        if _RING is not None:
+            return _RING
+        d = blackbox_dir()
+        if not d:
+            _RING = False
+            return False
+        try:
+            os.makedirs(d, exist_ok=True)
+            member = _default_member_id()
+            path = os.path.join(d, f"{_sanitize(member)}.bbx")
+            _RING = Ring(path, _capacity(), member)
+        except Exception:  # noqa: BLE001 — recorder must never sink its host
+            _RING = False
+        return _RING
+
+
+def set_identity(epoch: Optional[int] = None,
+                 incarnation: Optional[int] = None) -> None:
+    """Stamp the membership epoch / incarnation that subsequent records
+    carry (the fleet agent calls this on join and on every view)."""
+    if epoch is not None:
+        _IDENT["epoch"] = int(epoch)
+    if incarnation is not None:
+        _IDENT["incarnation"] = int(incarnation)
+
+
+def record(kind: str, member: str = "", payload: str = "",
+           trace_id: Optional[str] = None, epoch: Optional[int] = None,
+           incarnation: Optional[int] = None) -> None:
+    """Append one event. Checked no-op when telemetry is disabled
+    (before any lock/alloc — ns-budget guarded) and when no ring
+    directory is configured (cached False). ``member`` is the event's
+    subject (e.g. the evicted member), not the writer; ``trace_id``
+    defaults from the ambient trace binding."""
+    if not registry().enabled:
+        return
+    ring = _RING
+    if ring is None:
+        ring = _open_ring()
+    if ring is False:
+        return
+    try:
+        if trace_id is None:
+            from h2o3_tpu.telemetry import trace as _trace
+            trace_id = _trace.current_trace_id() or ""
+        ring.append(
+            KIND_CODES.get(kind, 0) or 0,
+            time.time_ns(), time.monotonic_ns(),
+            _IDENT["epoch"] if epoch is None else int(epoch),
+            _IDENT["incarnation"] if incarnation is None else int(incarnation),
+            trace_id.encode()[:32].ljust(32, b"\0"),
+            member.encode()[:44].ljust(44, b"\0"),
+            payload.encode()[:144].ljust(144, b"\0"))
+    except Exception:  # noqa: BLE001 — flight recorder is advisory
+        pass
+
+
+def local_events(n: int = 256) -> List[Dict[str, Any]]:
+    ring = _RING if _RING is not None else _open_ring()
+    if ring is False or ring is None:
+        return []
+    return ring.tail(n)
+
+
+def events_recorded() -> int:
+    ring = _RING
+    return ring.seq if isinstance(ring, Ring) else 0
+
+
+def ring_path() -> Optional[str]:
+    ring = _RING if _RING is not None else _open_ring()
+    return ring.path if isinstance(ring, Ring) else None
+
+
+def reset() -> None:
+    """Close the process ring and forget the cached resolution (tests
+    flip H2O3_BLACKBOX_DIR / recovery dirs at runtime)."""
+    global _RING
+    with _MU:
+        ring, _RING = _RING, None
+        _IDENT["epoch"] = 0
+        _IDENT["incarnation"] = 0
+    if isinstance(ring, Ring):
+        ring.close()
+
+
+on_reset(reset)
+
+
+# ------------------------------------------------------------ cluster merge
+
+def _member_skews() -> Dict[str, float]:
+    """Heartbeat-estimated wall-clock skew per member (router table),
+    seconds; positive = member's clock runs ahead of ours."""
+    try:
+        from h2o3_tpu import fleet
+        r = fleet.active_router()
+        if r is None:
+            return {}
+        return {m.member_id: m.skew_s for m in r.table.members()
+                if getattr(m, "skew_s", None) is not None}
+    except Exception:  # noqa: BLE001 — advisory
+        return {}
+
+
+def _fetch_peer_ring(base_url: str, n: int,
+                     timeout_s: float) -> Dict[str, Any]:
+    """GET a live peer's decoded ring tail with the peer-plane
+    discipline: bounded timeout, bounded body."""
+    from urllib.request import urlopen
+    base = base_url if base_url.startswith(("http://", "https://")) \
+        else f"http://{base_url}"
+    url = f"{base.rstrip('/')}/3/Blackbox?n={int(n)}"
+    with urlopen(url, timeout=timeout_s) as resp:  # noqa: S310 — fleet-internal
+        body = resp.read(PEER_CAP_BYTES + 1)
+    if len(body) > PEER_CAP_BYTES:
+        raise ValueError(f"{url}: blackbox response over "
+                         f"{PEER_CAP_BYTES} byte cap")
+    return json.loads(body.decode())
+
+
+def cluster_timeline(n: int = 256, include_peers: bool = True,
+                     timeout_s: Optional[float] = None) -> Dict[str, Any]:
+    """The fleet-wide causal timeline: local ring + live peers' rings
+    (telemetry peer plane) + dead members' ring files from the shared
+    root, merged in epoch-fenced order — sort key (epoch,
+    skew-corrected wall ns, member, seq). Dead members are marked;
+    members whose heartbeat-estimated skew exceeds ``SKEW_FLAG_S``
+    are flagged instead of silently trusted."""
+    from h2o3_tpu.telemetry import snapshot as telesnap
+    if timeout_s is None:
+        timeout_s = telesnap.PEER_TIMEOUT_S
+    self_member = _default_member_id()
+    skews = _member_skews()
+    members: Dict[str, Dict[str, Any]] = {}
+    events: List[Dict[str, Any]] = []
+    peers_failed: List[str] = []
+
+    def _add(member_id: str, evs: List[Dict[str, Any]],
+             dead: bool) -> None:
+        skew = skews.get(member_id, 0.0)
+        members[member_id] = {
+            "dead": dead, "skew_s": round(skew, 6),
+            "skew_flagged": abs(skew) > SKEW_FLAG_S, "events": len(evs)}
+        for ev in evs:
+            events.append({**ev, "member_ring": member_id, "dead": dead,
+                           "t_corrected": ev["t_wall"] - skew})
+
+    _add(self_member, local_events(n), False)
+    live_ids = {self_member}
+    if include_peers:
+        try:
+            peers, _departed = telesnap.peer_view()
+        except Exception:  # noqa: BLE001 — advisory
+            peers = []
+        for url in peers:
+            try:
+                got = _fetch_peer_ring(url, n, timeout_s)
+            except Exception:  # noqa: BLE001 — a dead peer is expected here
+                peers_failed.append(url)
+                continue
+            mid = str(got.get("member_id") or url)
+            live_ids.add(mid)
+            # a self-peer spelling (shared everyone-gets-the-same-list
+            # launcher config) resolves to our own member id — the
+            # local ring already covered it
+            if mid not in members:
+                _add(mid, list(got.get("events") or []), False)
+    # dead members: every ring file in the shared root whose writer is
+    # not in the live set still tells its side of the story
+    d = blackbox_dir()
+    if d and os.path.isdir(d):
+        for name in sorted(os.listdir(d)):
+            if not name.endswith(".bbx"):
+                continue
+            try:
+                rg = read_ring(os.path.join(d, name), last=n)
+            except (OSError, ValueError):
+                continue
+            mid = rg["member_id"] or name[:-4]
+            if mid in live_ids or mid in members:
+                continue
+            _add(mid, rg["events"], True)
+    events.sort(key=lambda e: (e["epoch"], e["t_corrected"],
+                               e["member_ring"], e["seq"]))
+    return {"scope": "cluster", "self": self_member, "members": members,
+            "events": events, "peers_failed": peers_failed,
+            "skew_flag_s": SKEW_FLAG_S}
+
+
+def cluster_trace_bytes(n: int = 256) -> bytes:
+    """Chrome-trace (chrome://tracing / Perfetto) export of the merged
+    cluster timeline: instant events, one pid per member ring, dead
+    members' process names marked."""
+    tl = cluster_timeline(n)
+    out = []
+    pids = {mid: i + 1 for i, mid in enumerate(sorted(tl["members"]))}
+    for mid, info in tl["members"].items():
+        label = mid + (" (dead)" if info["dead"] else "")
+        out.append({"name": "process_name", "ph": "M", "pid": pids[mid],
+                    "tid": 0, "args": {"name": label}})
+    for ev in tl["events"]:
+        out.append({
+            "name": ev["kind"], "ph": "i", "s": "g",
+            "pid": pids[ev["member_ring"]], "tid": 0,
+            "ts": ev["t_corrected"] * 1e6,
+            "args": {"member": ev["member"], "payload": ev["payload"],
+                     "trace_id": ev["trace_id"], "epoch": ev["epoch"],
+                     "seq": ev["seq"], "dead": ev["dead"]}})
+    return json.dumps({"traceEvents": out,
+                       "displayTimeUnit": "ms"}).encode()
+
+
+def follow_trace(trace_id: str, rings: List[Dict[str, Any]]
+                 ) -> List[Dict[str, Any]]:
+    """One trace id's events across decoded rings (``read_ring``
+    outputs), merged in (epoch, wall, seq) order — the offline spine
+    of ``tools/blackbox_read.py --trace``."""
+    hits: List[Tuple[Tuple, Dict[str, Any]]] = []
+    for rg in rings:
+        mid = rg.get("member_id", "?")
+        for ev in rg.get("events", ()):
+            if ev.get("trace_id") == trace_id:
+                hits.append(((ev["epoch"], ev["t_wall"], mid, ev["seq"]),
+                             {**ev, "member_ring": mid}))
+    return [ev for _, ev in sorted(hits, key=lambda kv: kv[0])]

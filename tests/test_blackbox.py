@@ -1,0 +1,437 @@
+"""Cluster flight recorder (ISSUE 19): crash-durable control-plane
+event journal with a fleet-wide causal timeline.
+
+The contract under test: ``blackbox.record`` appends fixed-width typed
+records into an mmap-backed ring under the shared recovery/fleet root
+that survive ``kill -9`` (readable post-mortem by any survivor or by
+``tools/blackbox_read.py`` offline); the append is a checked no-op at
+ns cost when ``H2O3_TELEMETRY=0``; ``/3/Timeline?scope=cluster``
+merges the local ring, live peers' rings and dead members' ring files
+into one epoch-fenced causal order with heartbeat-estimated clock skew
+flagged; one trace id follows a train across
+submit -> accept -> enqueue -> state transitions (satellite 2); and the
+router-less evict-requeue lease (satellite 1) admits exactly one
+claimant with a stale-steal window.
+"""
+import json
+import os
+import signal
+import statistics
+import subprocess
+import sys
+import textwrap
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu import fleet, sched, telemetry
+from h2o3_tpu.fleet import sched as fleet_sched
+from h2o3_tpu.telemetry import blackbox
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch, tmp_path):
+    monkeypatch.setenv("H2O3_BLACKBOX_DIR", str(tmp_path / "bbx"))
+    monkeypatch.delenv("H2O3_BLACKBOX_EVENTS", raising=False)
+    blackbox.reset()
+    yield
+    blackbox.reset()
+    telemetry.set_enabled(True)
+
+
+def _get(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+# ---------------- the ring itself --------------------------------------
+
+
+def test_ring_roundtrip_and_wrap(monkeypatch):
+    blackbox.set_identity(epoch=3, incarnation=7)
+    blackbox.record("member_join", member="w1@h",
+                    payload="inc=7 routable=1", trace_id="tr-a")
+    blackbox.record("placement", member="w2@h", payload="rr share=0.5",
+                    trace_id="tr-a")
+    evs = blackbox.local_events(10)
+    assert [e["kind"] for e in evs] == ["member_join", "placement"]
+    assert evs[0]["epoch"] == 3 and evs[0]["incarnation"] == 7
+    assert evs[0]["trace_id"] == "tr-a" and evs[0]["member"] == "w1@h"
+    assert evs[0]["seq"] == 0 and evs[1]["seq"] == 1
+    # the on-disk decode agrees with the live view
+    rg = blackbox.read_ring(blackbox.ring_path())
+    assert rg["seq"] == 2
+    assert [e["kind"] for e in rg["events"]] == ["member_join",
+                                                 "placement"]
+    # wrap: a 64-slot ring keeps exactly the newest 64
+    monkeypatch.setenv("H2O3_BLACKBOX_EVENTS", "64")
+    blackbox.reset()
+    for i in range(100):
+        blackbox.record("job_state", member=f"j{i}", payload=f"n={i}")
+    evs = blackbox.local_events(1000)
+    assert len(evs) == 64
+    assert evs[0]["member"] == "j36" and evs[-1]["member"] == "j99"
+    assert blackbox.events_recorded() == 100
+
+
+def test_restart_adopts_existing_cursor():
+    blackbox.record("ckpt_commit", member="m", payload="trees=5")
+    blackbox.record("ckpt_commit", member="m", payload="trees=10")
+    path = blackbox.ring_path()
+    blackbox.reset()          # process "restart" — same dir, same file
+    blackbox.record("manifest_done", member="m")
+    rg = blackbox.read_ring(path)
+    assert rg["seq"] == 3
+    assert [e["kind"] for e in rg["events"]] == [
+        "ckpt_commit", "ckpt_commit", "manifest_done"]
+    # seqs stay monotonic across the restart — merge keys depend on it
+    assert [e["seq"] for e in rg["events"]] == [0, 1, 2]
+
+
+def test_read_ring_rejects_non_ring_files(tmp_path):
+    p = tmp_path / "junk.bbx"
+    p.write_bytes(b"not a ring at all" * 300)
+    with pytest.raises(ValueError):
+        blackbox.read_ring(str(p))
+
+
+def test_unknown_kind_and_oversize_fields_degrade(monkeypatch):
+    blackbox.record("no_such_kind", member="x" * 100,
+                    payload="p" * 400, trace_id="t" * 64)
+    ev = blackbox.local_events(1)[0]
+    assert ev["kind"] == "kind_0"
+    assert ev["member"] == "x" * 44
+    assert ev["payload"] == "p" * 144
+    assert ev["trace_id"] == "t" * 32
+
+
+# ---------------- budget discipline ------------------------------------
+
+
+def test_disabled_record_is_checked_noop_ns_budget():
+    """The PR-4 span-path contract: H2O3_TELEMETRY=0 keeps record() a
+    checked no-op (registry flag test before any lock/alloc/IO), and
+    the enabled path stays well under the 2µs/event budget. Test
+    budgets are far above expected cost to absorb CI noise."""
+    N = 20_000
+
+    def per_record_ns():
+        t0 = time.perf_counter_ns()
+        for _ in range(N):
+            blackbox.record("placement", member="m@h", payload="p",
+                            trace_id="tr")
+        return (time.perf_counter_ns() - t0) / N
+
+    enabled_ns = statistics.median(per_record_ns() for _ in range(5))
+    assert enabled_ns < 10_000, f"enabled record: {enabled_ns:.0f}ns"
+    before = blackbox.events_recorded()
+    telemetry.set_enabled(False)
+    try:
+        disabled_ns = statistics.median(
+            per_record_ns() for _ in range(5))
+        assert blackbox.events_recorded() == before, \
+            "disabled record mutated the ring"
+        assert disabled_ns < 5_000, \
+            f"disabled record not a no-op: {disabled_ns:.0f}ns"
+    finally:
+        telemetry.set_enabled(True)
+
+
+def test_no_dir_means_cached_noop(monkeypatch):
+    monkeypatch.delenv("H2O3_BLACKBOX_DIR", raising=False)
+    monkeypatch.delenv("H2O3_RECOVERY_DIR", raising=False)
+    blackbox.reset()
+    blackbox.record("placement", member="m")
+    assert blackbox.ring_path() is None
+    assert blackbox.local_events() == []
+    assert blackbox.events_recorded() == 0
+
+
+# ---------------- cluster merge ----------------------------------------
+
+
+def _dead_ring(dirpath, member_id, events):
+    """Write a ring file the way a (now dead) peer process would."""
+    os.makedirs(dirpath, exist_ok=True)
+    ring = blackbox.Ring(
+        os.path.join(dirpath, f"{member_id}.bbx"), 64, member_id)
+    for kind, epoch, trace, member, payload in events:
+        ring.append(blackbox.KIND_CODES[kind], time.time_ns(),
+                    time.monotonic_ns(), epoch, 1,
+                    trace.encode().ljust(32, b"\0"),
+                    member.encode().ljust(44, b"\0"),
+                    payload.encode().ljust(144, b"\0"))
+    ring.close()
+
+
+def test_cluster_timeline_merges_dead_ring_epoch_ordered():
+    blackbox.set_identity(epoch=5)
+    blackbox.record("sched_admit", member="job1", trace_id="tr-m")
+    d = blackbox.blackbox_dir()
+    # the dead member wrote events at an EARLIER epoch: they sort
+    # before ours regardless of wall-clock interleaving
+    _dead_ring(d, "dead@h", [
+        ("remote_submit_accepted", 4, "tr-m", "job1", "model=m1"),
+        ("member_evict", 4, "", "dead@h", "missed=5")])
+    tl = blackbox.cluster_timeline(include_peers=False)
+    assert tl["scope"] == "cluster"
+    assert tl["members"]["dead@h"]["dead"] is True
+    assert tl["members"][tl["self"]]["dead"] is False
+    kinds = [e["kind"] for e in tl["events"]]
+    assert kinds == ["remote_submit_accepted", "member_evict",
+                     "sched_admit"]
+    keys = [(e["epoch"], e["t_corrected"], e["member_ring"], e["seq"])
+            for e in tl["events"]]
+    assert keys == sorted(keys)
+    assert tl["events"][0]["dead"] is True
+    assert tl["events"][-1]["member_ring"] == tl["self"]
+
+
+def test_cluster_timeline_flags_heartbeat_skew(monkeypatch):
+    _dead_ring(blackbox.blackbox_dir(), "ahead@h",
+               [("ckpt_commit", 1, "", "m", "trees=5")])
+    monkeypatch.setattr(blackbox, "_member_skews",
+                        lambda: {"ahead@h": 1.5})
+    tl = blackbox.cluster_timeline(include_peers=False)
+    m = tl["members"]["ahead@h"]
+    assert m["skew_s"] == 1.5 and m["skew_flagged"] is True
+    ev = [e for e in tl["events"] if e["member_ring"] == "ahead@h"][0]
+    # corrected time subtracts the estimated skew
+    assert abs((ev["t_wall"] - ev["t_corrected"]) - 1.5) < 1e-6
+    assert tl["members"][tl["self"]]["skew_flagged"] is False
+
+
+def test_cluster_trace_bytes_is_valid_chrome_trace():
+    blackbox.record("migrate_start", member="m@h", payload="job=j1",
+                    trace_id="tr-c")
+    _dead_ring(blackbox.blackbox_dir(), "gone@h",
+               [("migrate_done", 9, "tr-c", "m@h", "model=m1")])
+    doc = json.loads(blackbox.cluster_trace_bytes())
+    evs = doc["traceEvents"]
+    names = {e["name"] for e in evs if e.get("ph") == "M"}
+    assert any("(dead)" in json.dumps(e.get("args", {}))
+               for e in evs if e.get("ph") == "M"), names
+    inst = [e for e in evs if e.get("ph") == "i"]
+    assert {e["name"] for e in inst} >= {"migrate_start",
+                                         "migrate_done"}
+    for e in inst:
+        assert isinstance(e["ts"], float) and e["pid"] >= 1
+
+
+def test_follow_trace_across_rings():
+    d = blackbox.blackbox_dir()
+    blackbox.set_identity(epoch=2)
+    blackbox.record("sched_requeue", member="jobX", trace_id="tr-f")
+    _dead_ring(d, "other@h", [
+        ("remote_submit_sent", 1, "tr-f", "jobX", ""),
+        ("placement", 1, "tr-other", "jobY", "")])
+    rings = [blackbox.read_ring(os.path.join(d, n))
+             for n in sorted(os.listdir(d)) if n.endswith(".bbx")]
+    evs = blackbox.follow_trace("tr-f", rings)
+    assert [e["kind"] for e in evs] == ["remote_submit_sent",
+                                       "sched_requeue"]
+    assert all(e["trace_id"] == "tr-f" for e in evs)
+
+
+# ---------------- REST surface -----------------------------------------
+
+
+def test_timeline_cluster_scope_and_blackbox_routes():
+    from h2o3_tpu.api.server import H2OApiServer
+    blackbox.record("rebalance", member="", payload="moved=2",
+                    trace_id="tr-r")
+    _dead_ring(blackbox.blackbox_dir(), "casualty@h",
+               [("fault_fired", 1, "tr-r", "site", "exc=OSError")])
+    srv = H2OApiServer(port=0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        bb = _get(f"{base}/3/Blackbox?n=50")
+        assert bb["enabled"] is True and bb["events_recorded"] >= 1
+        assert any(e["kind"] == "rebalance" for e in bb["events"])
+        tl = _get(f"{base}/3/Timeline?scope=cluster&n=100")
+        assert tl["scope"] == "cluster"
+        assert tl["members"]["casualty@h"]["dead"] is True
+        kinds = [e["kind"] for e in tl["events"]]
+        assert "fault_fired" in kinds and "rebalance" in kinds
+        # the local default scope is untouched
+        local = _get(f"{base}/3/Timeline")
+        assert local["__meta"]["schema_name"] == "TimelineV3"
+        # chrome-trace export of the merged view parses
+        with urllib.request.urlopen(
+                f"{base}/3/Timeline?scope=cluster&format=trace",
+                timeout=30) as r:
+            doc = json.loads(r.read().decode())
+        assert any(e.get("ph") == "i" for e in doc["traceEvents"])
+    finally:
+        srv.stop()
+        fleet.reset()
+
+
+# ---------------- satellite 1: evict-requeue lease ---------------------
+
+
+def test_lease_single_claimant_and_stale_steal(tmp_path, monkeypatch):
+    monkeypatch.setenv("H2O3_RECOVERY_DIR", str(tmp_path / "rec"))
+    os.makedirs(str(tmp_path / "rec"), exist_ok=True)
+    assert fleet_sched.claim_departed("victim@h", epoch=9) is True
+    # second claimant (same process stands in for a peer) loses
+    assert fleet_sched.claim_departed("victim@h", epoch=9) is False
+    # a different depart epoch is a fresh eviction — fresh lease
+    assert fleet_sched.claim_departed("victim@h", epoch=10) is True
+    # the claim landed in the flight recorder
+    kinds = [e["kind"] for e in blackbox.local_events(50)]
+    assert kinds.count("lease_claim") == 2
+    # a stale lease (dead claimant) is stolen after the window
+    monkeypatch.setenv("H2O3_FLEET_LEASE_STALE_S", "0")
+    assert fleet_sched.claim_departed("victim@h", epoch=9) is True
+    ev = [e for e in blackbox.local_events(50)
+          if e["kind"] == "lease_steal"]
+    assert len(ev) == 1 and ev[0]["member"] == "victim@h"
+    # no shared root → no lease, claim declines
+    monkeypatch.delenv("H2O3_RECOVERY_DIR", raising=False)
+    assert fleet_sched.claim_departed("victim@h", epoch=9) is False
+
+
+# ---------------- satellite 2: trace stitching -------------------------
+
+
+def test_remote_submit_stitches_one_trace_id(tmp_path, monkeypatch):
+    """One trace id follows the train across the hand-off: the accept
+    event, the scheduler enqueue/admit and the job state transitions
+    on the TARGET all carry the submitter's trace id."""
+    from h2o3_tpu.api.server import H2OApiServer
+    monkeypatch.setenv("H2O3_RECOVERY_DIR", str(tmp_path / "rec"))
+    fleet.reset()
+    sched.reset()
+    rng = np.random.default_rng(5)
+    n, F = 600, 4
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    cols = {f"x{i}": X[:, i] for i in range(F)}
+    cols["y"] = np.where(X[:, 0] > 0, "a", "b")
+    fr = h2o.Frame.from_numpy(cols)
+    fr.key = "bbx_stitch_frame"
+    exported = fleet_sched._export_frame(fr)
+    assert exported is not None
+    frame_path, frame_key = exported
+    srv = H2OApiServer(port=0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        payload = {
+            "schema_version": 1, "algo": "gbm",
+            "params": {"ntrees": 2, "max_depth": 3, "seed": 5,
+                       "min_rows": 1.0, "model_id": "bbx_stitch_gbm"},
+            "y": "y", "x": None,
+            "frame_path": frame_path, "frame_key": frame_key,
+            "priority": "bulk", "share": "s1",
+            "trace_id": "tr-stitch", "model_key": "bbx_stitch_gbm",
+            "result_path": fleet_sched._result_path("bbx_stitch_gbm"),
+            "resuming": False, "submitter": "test@h"}
+        req = urllib.request.Request(
+            f"{base}/3/FleetSched/submit",
+            data=json.dumps(payload).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read().decode())
+        assert out["ok"] is True
+        deadline = time.monotonic() + 300
+        while True:
+            j = _get(f"{base}/3/Jobs/{out['job_key']}")["jobs"][0]
+            if j["status"] in ("DONE", "FAILED", "CANCELLED"):
+                break
+            assert time.monotonic() < deadline, "remote train hung"
+            time.sleep(0.05)
+        assert j["status"] == "DONE", j
+        stitched = [e for e in blackbox.local_events(500)
+                    if e["trace_id"] == "tr-stitch"]
+        kinds = {e["kind"] for e in stitched}
+        assert "remote_submit_accepted" in kinds, kinds
+        assert "sched_enqueue" in kinds, kinds
+        assert "job_state" in kinds, kinds
+        # causal order within the one ring: the scheduler enqueue
+        # precedes the admit that started the train
+        order = [e["kind"] for e in stitched]
+        assert order.index("sched_enqueue") < order.index("sched_admit")
+        # and every stitched event agrees on the member's epoch fence
+        assert len({e["epoch"] for e in stitched}) == 1
+    finally:
+        srv.stop()
+        fleet.reset()
+        sched.reset()
+        from h2o3_tpu import dkv
+        try:
+            dkv.remove("bbx_stitch_gbm")
+        except Exception:   # noqa: BLE001
+            pass
+
+
+# ---------------- kill -9 post-mortem (slow tier) ----------------------
+
+
+_CHILD_SRC = """\
+    import os, sys, types
+    repo = {repo!r}
+    sys.path.insert(0, repo)
+    for name, sub in (("h2o3_tpu", ""), ("h2o3_tpu.telemetry",
+                                         "telemetry")):
+        if name not in sys.modules:
+            m = types.ModuleType(name)
+            m.__path__ = [os.path.join(repo, "h2o3_tpu", sub)
+                          if sub else os.path.join(repo, "h2o3_tpu")]
+            sys.modules[name] = m
+    from h2o3_tpu.telemetry import blackbox
+    blackbox.set_identity(epoch=11, incarnation=2)
+    blackbox.record("sched_admit", member="doomed_job",
+                    payload="wait_ms=1", trace_id="tr-doom")
+    blackbox.record("ckpt_commit", member="doomed_model",
+                    payload="trees=5", trace_id="tr-doom")
+    print("RECORDED", flush=True)
+    import signal, time
+    os.kill(os.getpid(), signal.SIGKILL)   # no flush, no atexit
+    time.sleep(60)
+"""
+
+
+@pytest.mark.slow
+def test_sigkilled_process_ring_readable_post_mortem(tmp_path):
+    """kill -9 round-trip: the child records into its mmap ring and
+    SIGKILLs itself with no cleanup; the parent (the 'survivor') reads
+    the child's last events from the shared dir — both through the
+    library and through tools/blackbox_read.py."""
+    d = str(tmp_path / "shared_bbx")
+    env = dict(os.environ, H2O3_BLACKBOX_DIR=d, H2O3_TELEMETRY="1")
+    p = subprocess.run(
+        [sys.executable, "-c", textwrap.dedent(
+            _CHILD_SRC.format(repo=_REPO))],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert "RECORDED" in p.stdout
+    assert p.returncode == -signal.SIGKILL
+    rings = [f for f in os.listdir(d) if f.endswith(".bbx")]
+    assert len(rings) == 1
+    rg = blackbox.read_ring(os.path.join(d, rings[0]))
+    assert rg["seq"] == 2
+    assert [e["kind"] for e in rg["events"]] == ["sched_admit",
+                                                 "ckpt_commit"]
+    assert all(e["epoch"] == 11 and e["trace_id"] == "tr-doom"
+               for e in rg["events"])
+    # the offline reader sees the same story
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools",
+                                      "blackbox_read.py"),
+         "--dir", d, "--last", "5", "--json"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["rings"][0]["events"][-1]["kind"] == "ckpt_commit"
+    # and --trace follows the id across the dead ring
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools",
+                                      "blackbox_read.py"),
+         "--dir", d, "--trace", "tr-doom"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0
+    assert "sched_admit" in out.stdout and "ckpt_commit" in out.stdout

@@ -475,6 +475,61 @@ def test_detects_epoch_blind_routing_decision(tmp_path):
     assert "fleet-peer-discipline" not in _rules_of(rep)
 
 
+def test_detects_unrecorded_control_plane_decision(tmp_path):
+    # a fleet decision point that bumps the decision counter but leaves
+    # no flight-recorder record — invisible to any post-mortem
+    rep = _lint_source(tmp_path, "h2o3_tpu/fleet/newsched.py", """\
+        def _count(name):
+            pass
+
+        def place_for_submit(view, need):
+            _count("placements")
+            return view["members"][0]
+    """)
+    bb = [f for f in rep.new if f.rule == "blackbox-discipline"]
+    assert len(bb) == 1
+    assert "place_for_submit" in bb[0].message
+    # an epoch bump without a record is the membership flavor
+    rep = _lint_source(tmp_path, "h2o3_tpu/fleet/newtable.py", """\
+        class Table:
+            def flip(self, member):
+                self._epoch += 1
+                return self._epoch
+    """)
+    assert "blackbox-discipline" in _rules_of(rep)
+
+
+def test_recorded_control_plane_decision_is_clean(tmp_path):
+    rep = _lint_source(tmp_path, "h2o3_tpu/fleet/newsched.py", """\
+        def _count(name):
+            pass
+
+        def _bb(kind, member):
+            pass
+
+        def place_for_submit(view, need):
+            _count("placements")
+            _bb("placement", view["members"][0])
+            return view["members"][0]
+
+        class Table:
+            def flip(self, member):
+                self._epoch += 1
+                from h2o3_tpu.telemetry import blackbox
+                blackbox.record("member_flip", member)
+    """)
+    assert "blackbox-discipline" not in _rules_of(rep)
+    # outside the fleet/sched control-plane packages the rule is silent
+    rep = _lint_source(tmp_path, "h2o3_tpu/serve/newmod.py", """\
+        def _count(name):
+            pass
+
+        def shed(model):
+            _count("sheds")
+    """)
+    assert "blackbox-discipline" not in _rules_of(rep)
+
+
 # ------------------------------------------------- suppression machinery
 
 _TWO_RULE_SRC = """\

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""blackbox-read CLI — decode flight-recorder rings offline (ISSUE 19).
+
+The post-mortem half of the cluster flight recorder: a SIGKILLed
+replica's mmap ring under the shared blackbox dir is still ordinary
+bytes on disk, and this tool reads it without importing jax or
+joining any fleet.
+
+Usage:
+    python tools/blackbox_read.py RING.bbx               # whole ring
+    python tools/blackbox_read.py RING.bbx --last 20     # death window
+    python tools/blackbox_read.py --dir /shared/blackbox # every ring
+    python tools/blackbox_read.py --dir D --trace tr-abc # follow one
+                                                         # trace id
+                                                         # across rings
+    ... --json                                           # machine out
+
+Exit codes: 0 = decoded something, 1 = no events matched,
+2 = usage / unreadable ring.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import types
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# Importing ``h2o3_tpu.telemetry.blackbox`` through the real package
+# initializers would pull jax in (seconds of startup a post-mortem
+# reader on a rescue box doesn't need, and may not have). Pre-register
+# bare package shells so the submodule imports resolve without running
+# either __init__ — the h2o3_lint trick. (When the real package is
+# already imported this is a no-op.)
+if "h2o3_tpu" not in sys.modules:
+    _pkg = types.ModuleType("h2o3_tpu")
+    _pkg.__path__ = [os.path.join(_REPO, "h2o3_tpu")]
+    sys.modules["h2o3_tpu"] = _pkg
+if "h2o3_tpu.telemetry" not in sys.modules:
+    _sub = types.ModuleType("h2o3_tpu.telemetry")
+    _sub.__path__ = [os.path.join(_REPO, "h2o3_tpu", "telemetry")]
+    sys.modules["h2o3_tpu.telemetry"] = _sub
+
+from h2o3_tpu.telemetry.blackbox import follow_trace, read_ring  # noqa: E402
+
+
+def _fmt(ev: dict) -> str:
+    t = time.strftime("%H:%M:%S", time.localtime(ev["t_wall"]))
+    frac = f"{ev['t_wall'] % 1:.3f}"[1:]
+    trace = f" trace={ev['trace_id']}" if ev.get("trace_id") else ""
+    ring = f" [{ev['member_ring']}]" if ev.get("member_ring") else ""
+    return (f"{t}{frac} e{ev['epoch']:<3d} #{ev['seq']:<6d}"
+            f" {ev['kind']:<22s} {ev['member']:<28s}"
+            f" {ev['payload']}{trace}{ring}")
+
+
+def _collect(args) -> list:
+    paths = list(args.rings)
+    if args.dir:
+        try:
+            paths += sorted(
+                os.path.join(args.dir, n) for n in os.listdir(args.dir)
+                if n.endswith(".bbx"))
+        except OSError as e:
+            print(f"blackbox-read: {args.dir}: {e}", file=sys.stderr)
+            sys.exit(2)
+    if not paths:
+        print("blackbox-read: no ring files (pass RING.bbx or --dir)",
+              file=sys.stderr)
+        sys.exit(2)
+    rings = []
+    for p in paths:
+        try:
+            rings.append(read_ring(p, last=args.last))
+        except (OSError, ValueError) as e:
+            print(f"blackbox-read: skipping {p}: {e}", file=sys.stderr)
+    if not rings:
+        sys.exit(2)
+    return rings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("rings", nargs="*", help="ring files (*.bbx)")
+    ap.add_argument("--dir", default=None,
+                    help="decode every *.bbx ring in this directory")
+    ap.add_argument("--last", type=int, default=None, metavar="N",
+                    help="only the last N events per ring (the "
+                         "last-moments-before-death view)")
+    ap.add_argument("--trace", default=None, metavar="TRACE_ID",
+                    help="follow one trace id across all given rings, "
+                         "merged in causal (epoch, wall, seq) order")
+    ap.add_argument("--json", action="store_true",
+                    help="emit machine-readable JSON")
+    args = ap.parse_args(argv)
+
+    rings = _collect(args)
+    if args.trace:
+        evs = follow_trace(args.trace, rings)
+        if args.json:
+            print(json.dumps({"trace_id": args.trace, "events": evs},
+                             indent=2))
+        else:
+            for ev in evs:
+                print(_fmt(ev))
+        return 0 if evs else 1
+
+    if args.json:
+        print(json.dumps({"rings": rings}, indent=2))
+        return 0 if any(r["events"] for r in rings) else 1
+    total = 0
+    for rg in rings:
+        print(f"== {rg['path']}  member={rg['member_id']}  "
+              f"seq={rg['seq']}  capacity={rg['capacity']}  "
+              f"showing={len(rg['events'])}")
+        for ev in rg["events"]:
+            print(_fmt(ev))
+        total += len(rg["events"])
+    return 0 if total else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

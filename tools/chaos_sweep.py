@@ -590,6 +590,65 @@ def _fts_replica_src(router_port: int) -> str:
     """)
 
 
+def _victim_last_events(recdir, member_id, log, n=20):
+    """Decode a dead member's flight-recorder ring from the shared root
+    (ISSUE 19): the last-N-before-death view. Attached to the round
+    report AND logged, so a failing round carries the victim's own
+    account of its final control-plane decisions — the post-mortem
+    ``tools/blackbox_read.py`` would print, inline."""
+    try:
+        from h2o3_tpu.telemetry import blackbox
+        path = os.path.join(recdir, "blackbox",
+                            blackbox._sanitize(str(member_id)) + ".bbx")
+        if not os.path.exists(path):
+            log(f"kill-replica-training round: no victim ring at {path}")
+            return []
+        rg = blackbox.read_ring(path, last=n)
+        evs = rg["events"]
+        log(f"kill-replica-training round: victim flight recorder "
+            f"({member_id}, seq={rg['seq']}) — last {len(evs)} events:")
+        for ev in evs:
+            log(f"  e{ev['epoch']} #{ev['seq']} {ev['kind']} "
+                f"{ev['member']} {ev['payload']}"
+                + (f" trace={ev['trace_id']}" if ev["trace_id"] else ""))
+        return evs
+    except Exception as e:   # noqa: BLE001 — post-mortem is advisory
+        log(f"kill-replica-training round: victim ring decode "
+            f"failed: {e!r}")
+        return []
+
+
+def _survivor_cluster_timeline(base_url, log, trace_id="tr-chaos-fts"):
+    """GET the survivor's fleet-wide causal timeline (ISSUE 19) and
+    extract the chaos train's trace: the round report shows the whole
+    submit→evict→requeue→resume story as one causally ordered list,
+    with the dead victim's ring merged from the shared root."""
+    import urllib.request
+    out = {"cluster_timeline_members": None,
+           "cluster_trace_events": None, "cluster_trace_kinds": None,
+           "cluster_trace_ordered": None}
+    try:
+        with urllib.request.urlopen(
+                f"{base_url}/3/Timeline?scope=cluster&n=512",
+                timeout=30) as r:
+            tl = json.loads(r.read().decode())
+        evs = [e for e in tl.get("events", [])
+               if e.get("trace_id") == trace_id]
+        keys = [(e["epoch"], e["t_corrected"], e["member_ring"],
+                 e["seq"]) for e in evs]
+        out["cluster_timeline_members"] = {
+            mid: {"dead": m.get("dead"),
+                  "skew_flagged": m.get("skew_flagged")}
+            for mid, m in (tl.get("members") or {}).items()}
+        out["cluster_trace_events"] = len(evs)
+        out["cluster_trace_kinds"] = [e["kind"] for e in evs]
+        out["cluster_trace_ordered"] = keys == sorted(keys)
+    except Exception as e:   # noqa: BLE001 — timeline is advisory
+        log(f"kill-replica-training round: cluster timeline fetch "
+            f"failed: {e!r}")
+    return out
+
+
 def run_kill_replica_training_round(log=print, rows: int = 2000,
                                     spawn_deadline_s: float = 300.0
                                     ) -> dict:
@@ -720,6 +779,12 @@ def run_kill_replica_training_round(log=print, rows: int = 2000,
                 break
         os.kill(victim_proc.pid, signal.SIGKILL)
         victim_proc.wait(timeout=30)
+        # flight recorder (ISSUE 19): the victim is gone — its mmap
+        # ring under the shared root is the only witness to its last
+        # control-plane decisions. Decode it BEFORE the survivor
+        # verdict so even a failing round reports the death window.
+        out["victim_last_events"] = _victim_last_events(
+            recdir, victim.member_id, log)
         # eviction → fleet-wide requeue → the SURVIVOR resumes from the
         # last chunk commit and exports the result artifact
         rp = payload["result_path"]
@@ -738,6 +803,9 @@ def run_kill_replica_training_round(log=print, rows: int = 2000,
             getattr(resumed, "ntrees_built", 0)
             == _FTS_EVICT_PARAMS["ntrees"]
             and _trees_equal(ref_evict.model, resumed))
+        # the survivor's cluster timeline must tell the same story
+        # causally — its own events plus the dead victim's merged ring
+        out.update(_survivor_cluster_timeline(live[1].base_url, log))
 
         # ---- phase 2: preempt-MIGRATE onto the survivor
         memman.reset(budget=500_000)

@@ -94,6 +94,10 @@ METRIC_SPECS: Tuple[Tuple[str, str, float], ...] = (
     ("fleetsched.queue_wait_p50_ms", "lower", 0.60),
     ("fleetsched.migrations", "higher", 0.0),
     ("fleetsched.resumed_after_evict", "higher", 0.0),
+    # flight recorder (ISSUE 19): enabled-path append cost — the 2µs
+    # budget leaves headroom, but the append is one struct.pack + one
+    # mmap splice, so scheduler jitter dominates; wide band
+    ("blackbox.ns_per_event", "lower", 0.60),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
