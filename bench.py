@@ -656,6 +656,43 @@ def main():
                 f"rebalance_ok={fl.get('rebalance_ok')}")
         except Exception as e:  # must never sink the headline run
             log(f"fleet round FAILED to run: {e!r}")
+    # router-tier round (ISSUE 20): steady-state client affinity —
+    # zero-hop dispatch ratio and the affinity path's p50 against the
+    # proxy hop over identical request shapes. Emits
+    # fleet.{zero_hop_ratio,routed_p50_ms} (ratcheted by
+    # tools/perf_gate.py: ratio up, latency down). Shares the fleet
+    # kill switch (H2O3_BENCH_FLEET=0 skips).
+    if os.environ.get("H2O3_BENCH_FLEET", "1") not in ("0", "false", ""):
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            from chaos_sweep import run_router_tier_round
+            rt = run_router_tier_round(log=log)
+            fl = out.setdefault("fleet", {})
+            if isinstance(fl, dict):
+                fl["zero_hop_ratio"] = rt.get("zero_hop_ratio")
+                fl["routed_p50_ms"] = rt.get("routed_p50_ms")
+                fl["proxy_p50_ms"] = rt.get("proxy_p50_ms")
+                fl["affinity_ok"] = rt.get("ok")
+        except Exception as e:  # must never sink the headline run
+            log(f"router-tier round FAILED to run: {e!r}")
+    # serving-lane round (ISSUE 20): interactive p99 under a
+    # saturating bulk flood vs its solo band — emits
+    # serve.interactive_p99_under_bulk_ms (ratcheted by
+    # tools/perf_gate.py). H2O3_BENCH_LANES=0 skips.
+    if os.environ.get("H2O3_BENCH_LANES", "1") not in ("0", "false", ""):
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            from chaos_sweep import run_lane_round
+            lr = run_lane_round(log=log)
+            out["lanes"] = lr
+            out["serve.interactive_p99_under_bulk_ms"] = \
+                lr.get("interactive_p99_under_bulk_ms")
+            out["serve.interactive_p99_solo_ms"] = \
+                lr.get("interactive_p99_solo_ms")
+        except Exception as e:  # must never sink the headline run
+            log(f"lane round FAILED to run: {e!r}")
     # training-scheduler round (ISSUE 15): budget sized for ONE train,
     # 4 concurrent bulk submissions + 1 interactive preemptor — emits
     # sched.{queue_wait_p50_ms,preempt_resume_ok,oversub_completed}

@@ -1,0 +1,48 @@
+"""h2o-py fleet scoring client: key affinity + zero-hop dispatch.
+
+The client-facing surface of the router tier (ISSUE 20). An
+:class:`H2OFleetClient` fetches the fleet's consistent-hash ring from
+``GET /3/Fleet/ring``, hashes routing keys client-side with the SAME
+blake2b scheme the routers use, and POSTs scoring requests straight to
+the home replica's ``/3/Predictions`` surface — the proxy hop is
+skipped entirely on the steady-state path. On epoch mismatch (a
+response's ``X-H2O3-Fleet-Epoch`` header disagrees with the pinned
+ring) or connect failure, the request falls back to any configured
+router and the ring is refreshed.
+
+Usage::
+
+    from h2o_bindings.fleet_client import H2OFleetClient
+    c = H2OFleetClient(["http://router-a:54321", "http://router-b:54321"])
+    preds = c.predict_rows("my_gbm", [{"x1": 0.3, "x2": 1.0}])
+    cols  = c.predict_rows("my_gbm", rows, fmt="columnar")
+    c.zero_hop_ratio()   # fraction of requests that skipped the proxy
+
+``lane`` tags the request's deadline class (``interactive`` > ``bulk``
+> ``background``; ``X-H2O3-Lane`` on the wire) — bulk scoring floods
+are shed at the front door instead of riding the interactive queue.
+"""
+from h2o3_tpu.fleet.affinity import AffinityClient as _AffinityClient
+from h2o3_tpu.fleet.affinity import RingView  # noqa: F401 — re-export
+
+__all__ = ["H2OFleetClient", "RingView"]
+
+
+class H2OFleetClient(_AffinityClient):
+    """The h2o-py spelling of the affinity client (see module doc).
+    ``predict_rows(model, rows, key=..., fmt=..., lane=...)`` returns
+    the replica's response body: the ``predictions`` list for
+    ``fmt="rows"``, the columns dict for ``fmt="columnar"``, the raw
+    NDJSON text for ``fmt="stream"``."""
+
+    def predict_rows(self, model, rows, *, key=None, timeout_ms=None,
+                     fmt="rows", lane=None):
+        out = super().predict_rows(model, rows, key=key,
+                                   timeout_ms=timeout_ms, fmt=fmt,
+                                   lane=lane)
+        if isinstance(out, dict):
+            if fmt == "rows" and "predictions" in out:
+                return out["predictions"]
+            if fmt == "columnar" and "columns" in out:
+                return out["columns"]
+        return out

@@ -1105,7 +1105,10 @@ class BlackboxDisciplineRule(Rule):
     a decision point when it (a) bumps a fleet decision counter
     (``_count(...)``), (b) increments a scheduler metric counter
     (``_m_*.inc(...)``), or (c) advances a membership epoch (an
-    augmented assignment to ``*_epoch``). Each of those is a state
+    augmented assignment to ``*_epoch``, or a plain non-constant
+    assignment to a ``*_epoch`` attribute — the gossip-absorb /
+    ring-publish seams align the fence instead of bumping it). Each
+    of those is a state
     mutation a post-mortem needs to see: a SIGKILLed replica whose
     placement/eviction/preemption decisions only lived in in-memory
     counters tells no story. The fix is one advisory
@@ -1137,6 +1140,21 @@ class BlackboxDisciplineRule(Rule):
             tname = t.attr if isinstance(t, ast.Attribute) else (
                 t.id if isinstance(t, ast.Name) else "")
             if tname.endswith("_epoch"):
+                return True
+        elif isinstance(ref, ast.Assign) and len(ref.targets) == 1:
+            # a PLAIN epoch assignment to an attribute (gossip absorb
+            # aligning to a peer's epoch, a published-ring stamp) moves
+            # the same causal fence as an AugAssign bump. Constant
+            # right-hand sides (the ``= 0`` / ``= -1`` initializers in
+            # __init__/reset) are not decisions; locals ending _epoch
+            # are reads of the fence, not moves of it
+            t = ref.targets[0]
+            v = ref.value
+            if isinstance(v, ast.UnaryOp):   # ``= -1`` sentinel
+                v = v.operand
+            if isinstance(t, ast.Attribute) \
+                    and t.attr.endswith("_epoch") \
+                    and not isinstance(v, ast.Constant):
                 return True
         return False
 

@@ -633,6 +633,37 @@ def _predict(params, body, model, frame):
 # through the micro-batching queue (ISSUE 3).
 
 
+def _lane_of(params, default: str = "interactive") -> str:
+    """The request's deadline class (ISSUE 20): explicit ``X-H2O3-Lane``
+    header (injected as ``_lane`` by the dispatcher) > ``lane``
+    body/query param > the endpoint's path default. Unknown lane names
+    are a 400 — a typo must not silently ride the highest class."""
+    from h2o3_tpu.serve import lanes as lanes_mod
+    lane = params.get("_lane") or params.get("lane")
+    try:
+        return lanes_mod.normalize(str(lane)) if lane else default
+    except ValueError as e:
+        raise ApiError(400, str(e))
+
+
+def _fleet_epoch_headers() -> Optional[Dict[str, str]]:
+    """``X-H2O3-Fleet-Epoch`` on scoring responses: the membership
+    epoch this replica last heard — the affinity client's staleness
+    signal (a mismatch with its pinned ring triggers a refresh).
+    None outside a fleet: solo deployments add no header."""
+    from h2o3_tpu.serve import fleet as serve_fleet
+    ep = serve_fleet.fleet_epoch()
+    return {"X-H2O3-Fleet-Epoch": str(ep)} if ep is not None else None
+
+
+def _ndjson(rows) -> bytes:
+    """Streamed scoring body: one JSON object per line (NDJSON). The
+    shape is the per-row dict of the ``rows`` format — a streamed and
+    a batched response decode to bit-identical values."""
+    return ("\n".join(json.dumps(r, default=_json_default)
+                      for r in rows) + "\n").encode()
+
+
 def _serve_config_from_params(params) -> Dict[str, Any]:
     cfg: Dict[str, Any] = {}
     for k, cast in (("max_batch", int), ("max_delay_ms", float),
@@ -821,7 +852,9 @@ def _fleet_predict(params, body, model):
     least-loaded fallback and single failover; 503 + Retry-After when
     the live set cannot absorb the request. ``key`` pins the routing
     key (default: the model — all of one model's traffic shares a
-    home until it falls back)."""
+    home until it falls back). ``format`` (rows | columnar | stream)
+    and ``lane`` (interactive | bulk | background) ride the SAME
+    failover path — before ISSUE 20 only the row shape failed over."""
     from h2o3_tpu import fleet
     b = _fleet_body(params, body)
     rows = b.get("rows")
@@ -829,20 +862,84 @@ def _fleet_predict(params, body, model):
             isinstance(r, dict) for r in rows):
         raise ApiError(400, 'expected {"rows": [{column: value, ...}]}')
     tmo = b.get("timeout_ms")
+    fmt = str(b.get("format") or "rows").lower()
+    if fmt not in ("rows", "columnar", "stream"):
+        raise ApiError(400, f"unknown format '{fmt}' — use 'rows', "
+                       f"'columnar' or 'stream'")
+    lane = _lane_of(b)
     try:
         out = fleet.router().predict_rows(
             model, rows,
             key=str(b["key"]) if b.get("key") is not None else None,
-            timeout_ms=float(tmo) if tmo is not None else None)
+            timeout_ms=float(tmo) if tmo is not None else None,
+            fmt=fmt, lane=lane)
     except fleet.FleetUnavailableError as e:
         import math
         raise ApiError(503, str(e), headers={
             "Retry-After": str(max(int(math.ceil(e.retry_after_s)), 1))})
     except fleet.RouterError as e:
         raise ApiError(getattr(e, "http_status", 500), str(e))
+    epoch_headers = {"X-H2O3-Fleet-Epoch": str(fleet.router().table.epoch)}
+    if "__raw" in out:
+        # streamed scoring passes through opaque — routed and direct
+        # NDJSON stay byte-identical
+        raw = out["__raw"]
+        return {"__raw": raw.encode() if isinstance(raw, str) else raw,
+                "__content_type": out.get("__content_type",
+                                          "application/x-ndjson"),
+                "__headers": epoch_headers}
     out.setdefault("__meta", {"schema_version": 3,
                               "schema_name": "FleetPredictionsV3"})
+    out["__headers"] = epoch_headers
     return out
+
+
+@route("GET", "/3/Fleet/ring")
+def _fleet_ring(params, body):
+    """The consistent-hash ring view (ISSUE 20): live routable members
+    + virtual-point count + epoch. Clients hash keys with the SAME
+    blake2b scheme and dispatch straight to the home replica — the
+    zero-hop path — refreshing when a scoring response's
+    ``X-H2O3-Fleet-Epoch`` disagrees with the epoch pinned here."""
+    from h2o3_tpu import fleet
+    return {"__meta": {"schema_version": 3, "schema_name": "FleetRingV3"},
+            **fleet.router().ring_snapshot()}
+
+
+@route("GET", "/3/Fleet/snapshot")
+def _fleet_snapshot(params, body):
+    """Warm-boot source for a (re)starting peer router (ISSUE 20): the
+    full member-table snapshot (incarnations included) plus the
+    deployment registry — everything a bounced router needs to answer
+    its first routed request without waiting for replica beats."""
+    from h2o3_tpu import fleet, serve
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "FleetSnapshotV3"},
+            "epoch": fleet.router().table.epoch,
+            "snapshot": fleet.router().table.snapshot(),
+            "registry": serve.registry_snapshot()}
+
+
+@route("POST", "/3/Fleet/gossip")
+def _fleet_gossip(params, body):
+    """Router-tier anti-entropy (ISSUE 20): absorb a peer router's
+    table snapshot (epoch-fenced, incarnation-fenced — membership.py
+    rules verbatim) and answer with ours, so one exchange converges
+    both sides. The sender's url is adopted as a peer (elastic tier
+    membership)."""
+    from h2o3_tpu import fleet
+    b = _fleet_body(params, body)
+    snap = b.get("snapshot")
+    if not isinstance(snap, dict):
+        raise ApiError(400, 'expected {"snapshot": {...}, "source": url}')
+    r = fleet.router()
+    absorbed = r.table.absorb(snap, source=str(b.get("source") or "?"))
+    if r.tier is not None and b.get("source"):
+        r.tier.note_peer(str(b["source"]))
+    return {"__meta": {"schema_version": 3,
+                       "schema_name": "FleetGossipV3"},
+            "absorbed": absorbed, "epoch": r.table.epoch,
+            "snapshot": r.table.snapshot()}
 
 
 @route("POST", "/3/FleetSched/submit")
@@ -1048,22 +1145,29 @@ def _predict_rows(params, body, model):
     tmo = _coerce(params.get("timeout_ms")) \
         if params.get("timeout_ms") is not None else None
     fmt = (params.get("format") or "rows").lower()
-    if fmt not in ("rows", "columnar"):
-        raise ApiError(400, f"unknown format '{fmt}' — use 'rows' or "
-                       f"'columnar'")
+    if fmt not in ("rows", "columnar", "stream"):
+        raise ApiError(400, f"unknown format '{fmt}' — use 'rows', "
+                       f"'columnar' or 'stream'")
+    lane = _lane_of(params)
+    epoch_headers = _fleet_epoch_headers()
     try:
         # explicit timeout_ms=0 means fail-fast, NOT the default
         if fmt == "columnar":
             cols = serve.predict_columnar(
                 model, rows,
-                timeout_ms=float(tmo) if tmo is not None else None)
-            return {"__meta": {"schema_version": 3,
-                               "schema_name": "ServePredictionsColumnarV3"},
-                    "model_id": schemas.keyref(model, "Key<Model>"),
-                    "nrow": len(rows),
-                    "columns": cols}
+                timeout_ms=float(tmo) if tmo is not None else None,
+                lane=lane)
+            out = {"__meta": {"schema_version": 3,
+                              "schema_name": "ServePredictionsColumnarV3"},
+                   "model_id": schemas.keyref(model, "Key<Model>"),
+                   "nrow": len(rows),
+                   "columns": cols}
+            if epoch_headers:
+                out["__headers"] = epoch_headers
+            return out
         preds = serve.predict_rows(
-            model, rows, timeout_ms=float(tmo) if tmo is not None else None)
+            model, rows, timeout_ms=float(tmo) if tmo is not None else None,
+            lane=lane)
     except KeyError as e:
         raise ApiError(404, str(e))
     except serve.ServeError as e:
@@ -1075,10 +1179,22 @@ def _predict_rows(params, body, model):
             headers["Retry-After"] = str(max(int(math.ceil(ra)), 1))
         raise ApiError(getattr(e, "http_status", 500), str(e),
                        headers=headers)
-    return {"__meta": {"schema_version": 3,
-                       "schema_name": "ServePredictionsV3"},
-            "model_id": schemas.keyref(model, "Key<Model>"),
-            "predictions": preds}
+    if fmt == "stream":
+        # streamed scoring (NDJSON): same values, one row-dict per
+        # line — and the same admission/failover semantics as 'rows'
+        # because it IS the rows path up to serialization
+        out = {"__raw": _ndjson(preds),
+               "__content_type": "application/x-ndjson"}
+        if epoch_headers:
+            out["__headers"] = epoch_headers
+        return out
+    out = {"__meta": {"schema_version": 3,
+                      "schema_name": "ServePredictionsV3"},
+           "model_id": schemas.keyref(model, "Key<Model>"),
+           "predictions": preds}
+    if epoch_headers:
+        out["__headers"] = epoch_headers
+    return out
 
 
 @route("POST", "/3/ModelMetrics/models/{model}/frames/{frame}")
@@ -1603,6 +1719,11 @@ class _Handler(BaseHTTPRequestHandler):
                               "exception_type": type(e).__name__,
                               "values": {}, "stacktrace": []})
             return
+        # deadline-class lane (ISSUE 20): an explicit X-H2O3-Lane header
+        # outranks body/query params — the router's dispatch spelling
+        lane_hdr = self.headers.get("X-H2O3-Lane")
+        if lane_hdr:
+            params["_lane"] = lane_hdr
         for m, rx, fn in _ROUTES:
             if m != method:
                 continue
@@ -1612,14 +1733,17 @@ class _Handler(BaseHTTPRequestHandler):
                     groups = {k: urllib.parse.unquote(v)
                               for k, v in match.groupdict().items()}
                     out = fn(params, body, **groups)
+                    extra = out.pop("__headers", None) if isinstance(
+                        out, dict) else None
                     if isinstance(out, dict) and "__raw" in out:
                         self._reply_raw(200, out["__raw"],
                                         out.get("__content_type",
-                                                "application/octet-stream"))
+                                                "application/octet-stream"),
+                                        headers=extra)
                         return
                     status = out.pop("__http_status", 200) if isinstance(
                         out, dict) else 200
-                    self._reply(status, out)
+                    self._reply(status, out, headers=extra)
                 except ApiError as e:
                     self._reply(e.status, {
                         "__meta": {"schema_name": "H2OErrorV3"},
@@ -1657,11 +1781,13 @@ class _Handler(BaseHTTPRequestHandler):
                              teletrace.format_traceparent(tid))
             self.send_header("X-H2O3-Trace-Id", tid)
 
-    def _reply_raw(self, status, data: bytes, ctype: str):
+    def _reply_raw(self, status, data: bytes, ctype: str, headers=None):
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
         self._trace_headers()
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         if self.command != "HEAD":
             self.wfile.write(data)
@@ -2035,6 +2161,13 @@ def _frame_load(params, body):
     return schemas.job_v3(job, fid, "Key<Frame>")
 
 
+class _FrontDoorServer(ThreadingHTTPServer):
+    # the stdlib default accept backlog (5) overflows under concurrent
+    # scoring clients + fleet beats + router gossip on one socket,
+    # surfacing as spurious connection-refused at the front door
+    request_queue_size = 128
+
+
 class H2OApiServer:
     """Embedded API server (the h2o.jar web server analog)."""
 
@@ -2043,7 +2176,7 @@ class H2OApiServer:
         # XLA compile/cache listeners are live before the first scrape
         from h2o3_tpu import telemetry
         telemetry.install()
-        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd = _FrontDoorServer((host, port), _Handler)
         self.port = self.httpd.server_address[1]
         self.host = host
         self._thread: Optional[threading.Thread] = None

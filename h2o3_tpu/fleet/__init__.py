@@ -16,6 +16,7 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from h2o3_tpu.fleet.affinity import AffinityClient, RingView
 from h2o3_tpu.fleet.agent import FleetAgent
 from h2o3_tpu.fleet.membership import (Member, MemberTable,
                                        StaleEpochError,
@@ -24,13 +25,16 @@ from h2o3_tpu.fleet.membership import (Member, MemberTable,
 from h2o3_tpu.fleet.router import (ConsistentHashRing,
                                    FleetRouter,
                                    FleetUnavailableError,
-                                   ReplicaDispatchError, RouterError)
+                                   ReplicaDispatchError, RouterError,
+                                   RouterTier)
 
-__all__ = ["ConsistentHashRing", "FleetAgent", "FleetRouter",
+__all__ = ["AffinityClient", "ConsistentHashRing", "FleetAgent",
+           "FleetRouter", "RingView",
            "FleetUnavailableError", "Member", "MemberTable",
-           "ReplicaDispatchError", "RouterError", "StaleEpochError",
+           "ReplicaDispatchError", "RouterError", "RouterTier",
+           "StaleEpochError",
            "UnknownMemberError", "active_router", "heartbeat_ms",
-           "router", "reset", "seeds"]
+           "router", "reset", "seeds", "start_router_tier"]
 
 _ROUTER: Optional[FleetRouter] = None
 _MU = threading.Lock()
@@ -86,6 +90,24 @@ def _wire(r: FleetRouter) -> None:
     telesnap.PEER_SOURCE = _peer_view
 
 
+def start_router_tier(self_url: str,
+                      peers: Optional[list] = None,
+                      warm_boot: bool = True) -> RouterTier:
+    """Join this process's router to the router tier (ISSUE 20): peers
+    default to ``H2O3_FLEET_SEEDS`` minus ``self_url``. Warm-boots the
+    member table + deployment registry from the first answering peer
+    (or the disk snapshot) BEFORE the gossip loop starts, so a bounced
+    router's first routed request hits a populated table."""
+    r = router()
+    tier = r.tier
+    if tier is None:
+        tier = RouterTier(r, self_url, peers=peers)
+    if warm_boot:
+        tier.warm_boot()
+    tier.start()
+    return tier
+
+
 def reset() -> None:
     """Tear down the process router (tests)."""
     global _ROUTER
@@ -94,6 +116,9 @@ def reset() -> None:
         _ROUTER = None
     if r is not None:
         r.stop_ticker()
+        if r.tier is not None:
+            r.tier.stop()
+            r.tier = None
         r.table.reset()
         from h2o3_tpu.telemetry import snapshot as telesnap
         telesnap.PEER_SOURCE = None

@@ -1,0 +1,279 @@
+"""Client-side key affinity: zero-hop dispatch (ISSUE 20).
+
+The paper's reference computes key→home-node hashing *on every node*
+(``water/Key.java:91``) — clients land one hop from their data because
+the hash is universal, not because a proxy forwards them. This module
+gives our clients the same property over REST: ``GET /3/Fleet/ring``
+exposes the router tier's consistent-hash view (member ids, virtual
+point count, membership epoch), the client rebuilds the EXACT ring
+(:class:`~h2o3_tpu.fleet.router.ConsistentHashRing` — same blake2b
+scheme, same virtual-point layout, bit-identical homes) and dispatches
+scoring straight to the home replica's own ``/3/Predictions`` surface,
+skipping the router proxy hop entirely.
+
+Staleness is self-correcting without polling: every scoring response
+from a fleet replica carries ``X-H2O3-Fleet-Epoch`` (the epoch the
+replica last heard from a router). When it disagrees with the epoch the
+client's ring was cut under, the client refreshes the ring before the
+next request — the answered request is still valid (the replica served
+it), so the fast path never pays a blocking round trip to discover
+churn. Hard failures (connect refused, 5xx, an empty ring) fall back to
+ANY router — the proxy path with its own failover — so affinity is an
+optimization, never a correctness dependency.
+
+``zero_hop_ratio()`` reports the fraction of requests that went direct
+— the bench's ``fleet.zero_hop_ratio`` metric (steady-state ≥ 0.9 is
+the acceptance bar).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional, Sequence
+
+from h2o3_tpu.fleet.router import ConsistentHashRing, _norm_url
+
+__all__ = ["RingView", "AffinityClient"]
+
+
+class RingView:
+    """One epoch's ring as the client sees it: the home() verdicts are
+    bit-identical to the router's (same member-id set, same point
+    count, same hash)."""
+
+    def __init__(self, epoch: int, points: int,
+                 members: Sequence[dict]):
+        self.epoch = int(epoch)
+        self.points = int(points)
+        self.base_urls: Dict[str, str] = {
+            str(m["member_id"]): str(m.get("base_url", "")).rstrip("/")
+            for m in members}
+        self.ring = ConsistentHashRing(sorted(self.base_urls),
+                                       points=self.points)
+
+    def home(self, key: str) -> Optional[str]:
+        """The home member id for a routing key (None on an empty
+        ring)."""
+        return self.ring.home(key)
+
+    def home_url(self, key: str) -> Optional[str]:
+        mid = self.home(key)
+        return self.base_urls.get(mid) if mid else None
+
+
+class AffinityClient:
+    """Key-affine scoring client: hash client-side, dispatch straight
+    to the home replica, fall back to any router on epoch mismatch or
+    connect failure. Thread-safe; one instance per fleet."""
+
+    def __init__(self, router_urls, points: Optional[int] = None,
+                 timeout_s: float = 10.0):
+        if isinstance(router_urls, str):
+            router_urls = [router_urls]
+        self._routers: List[str] = []
+        for u in router_urls:
+            nu = _norm_url(u)
+            if nu and nu not in self._routers:
+                self._routers.append(nu)
+        if not self._routers:
+            raise ValueError("AffinityClient needs at least one router "
+                             "url")
+        self._points = points
+        self.timeout_s = float(timeout_s)
+        self._mu = threading.Lock()
+        self._view: Optional[RingView] = None
+        self._router_idx = 0
+        self._stale = False
+        # dispatch accounting: zero_hop = answered by the home replica
+        # directly; routed = fell back through a router proxy
+        self.zero_hop = 0
+        self.routed = 0
+
+    # -- ring maintenance ------------------------------------------------
+
+    def refresh(self) -> RingView:
+        """Fetch the ring from the first answering router. Raises when
+        no router answers — the caller still has the stale view (if
+        any) and the routed fallback."""
+        last: Optional[BaseException] = None
+        for _ in range(len(self._routers)):
+            url = self._routers[self._router_idx % len(self._routers)]
+            try:
+                body = self._get_json(f"{url}/3/Fleet/ring")
+                view = RingView(body.get("epoch", 0),
+                                self._points or body.get("points", 64),
+                                body.get("members") or [])
+                with self._mu:
+                    self._view = view
+                    self._stale = False
+                return view
+            except Exception as e:   # noqa: BLE001 — try the next router
+                last = e
+                self._router_idx += 1
+        raise last if last is not None else RuntimeError(
+            "no router answered /3/Fleet/ring")
+
+    def view(self) -> Optional[RingView]:
+        with self._mu:
+            return self._view
+
+    def _current_view(self) -> Optional[RingView]:
+        with self._mu:
+            view, stale = self._view, self._stale
+        if view is None or stale:
+            try:
+                return self.refresh()
+            except Exception:   # noqa: BLE001 — routed fallback remains
+                return view
+        return view
+
+    def _note_epoch(self, headers, view: RingView) -> None:
+        """An answering replica reported a different fleet epoch than
+        the ring we hashed under: mark the view stale so the NEXT
+        request refreshes (this one already got its valid answer)."""
+        ep = headers.get("X-H2O3-Fleet-Epoch")
+        if ep is None:
+            return
+        try:
+            if int(ep) != view.epoch:
+                with self._mu:
+                    self._stale = True
+        except ValueError:
+            pass
+
+    # -- scoring ---------------------------------------------------------
+
+    @staticmethod
+    def routing_key(model: str, key: Optional[str]) -> str:
+        """The router's routing-key spelling, verbatim (parity is
+        asserted by tests over 10k keys)."""
+        return f"{model}|{key}" if key else model
+
+    def predict_rows(self, model: str, rows: Sequence[dict], *,
+                     key: Optional[str] = None,
+                     timeout_ms: Optional[float] = None,
+                     fmt: str = "rows",
+                     lane: Optional[str] = None):
+        """Score rows zero-hop when possible. Returns the replica's
+        response body (dict for ``rows``/``columnar``, NDJSON str for
+        ``stream``). Falls back to the routed path on any direct-path
+        failure — affinity never turns a servable request into an
+        error the proxy path would have absorbed."""
+        timeout_s = (float(timeout_ms) / 1000.0
+                     if timeout_ms is not None else self.timeout_s)
+        view = self._current_view()
+        if view is not None:
+            url = view.home_url(self.routing_key(model, key))
+            if url:
+                try:
+                    out = self._predict_direct(url, model, rows, fmt,
+                                               lane, timeout_s, view)
+                    with self._mu:
+                        self.zero_hop += 1
+                    return out
+                except urllib.error.HTTPError as e:
+                    # the replica ANSWERED: only retryable-by-another-
+                    # replica verdicts (shed 503 / not-deployed 404)
+                    # reroute; application errors surface as-is
+                    if e.code not in (503, 404):
+                        raise
+                    with self._mu:
+                        self._stale = True
+                except Exception:   # noqa: BLE001 — replica gone: reroute
+                    with self._mu:
+                        self._stale = True
+        return self._predict_routed(model, rows, key, fmt, lane,
+                                    timeout_s)
+
+    def _predict_direct(self, base_url: str, model: str,
+                        rows: Sequence[dict], fmt: str,
+                        lane: Optional[str], timeout_s: float,
+                        view: RingView):
+        url = (f"{base_url}/3/Predictions/models/"
+               f"{urllib.parse.quote(model)}/rows")
+        if fmt != "rows":
+            url += f"?format={urllib.parse.quote(fmt)}"
+        body, headers = self._post(url, {"rows": list(rows)}, lane,
+                                   timeout_s)
+        self._note_epoch(headers, view)
+        return body
+
+    def _predict_routed(self, model: str, rows: Sequence[dict],
+                        key: Optional[str], fmt: str,
+                        lane: Optional[str], timeout_s: float):
+        payload: Dict[str, object] = {"rows": list(rows)}
+        if key is not None:
+            payload["key"] = key
+        if fmt != "rows":
+            payload["format"] = fmt
+        last: Optional[BaseException] = None
+        for _ in range(len(self._routers)):
+            url = self._routers[self._router_idx % len(self._routers)]
+            try:
+                body, _hdrs = self._post(
+                    f"{url}/3/Fleet/models/"
+                    f"{urllib.parse.quote(model)}/rows",
+                    payload, lane, timeout_s)
+                with self._mu:
+                    self.routed += 1
+                    self._stale = True   # next request re-pins the ring
+                return body
+            except urllib.error.HTTPError:
+                with self._mu:
+                    self.routed += 1
+                raise                  # the router's verdict is final
+            except Exception as e:   # noqa: BLE001 — this router is down
+                last = e
+                self._router_idx += 1
+        raise last if last is not None else RuntimeError(
+            "no router reachable for routed dispatch")
+
+    # -- accounting ------------------------------------------------------
+
+    def zero_hop_ratio(self) -> float:
+        with self._mu:
+            total = self.zero_hop + self.routed
+            return (self.zero_hop / total) if total else 0.0
+
+    # -- transport -------------------------------------------------------
+
+    def _get_json(self, url: str) -> dict:
+        """attempts=1: the client's router ROTATION is the retry."""
+        from h2o3_tpu import resilience
+
+        def _call():
+            with urllib.request.urlopen(url,
+                                        timeout=self.timeout_s) as r:
+                return json.loads(r.read().decode())
+
+        return resilience.retry_transient(
+            _call, site="fleet.affinity", attempts=1)
+
+    @staticmethod
+    def _post(url: str, payload: dict, lane: Optional[str],
+              timeout_s: float):
+        """attempts=1: the direct→routed fallback (and the routed
+        path's own rotation) IS the retry policy — a same-replica
+        retry would double the cost of a sick home."""
+        from h2o3_tpu import resilience
+        headers = {"Content-Type": "application/json"}
+        if lane:
+            headers["X-H2O3-Lane"] = lane
+        data = json.dumps(payload).encode()
+
+        def _call():
+            req = urllib.request.Request(url, data=data, method="POST",
+                                         headers=headers)
+            with urllib.request.urlopen(req, timeout=timeout_s) as r:
+                raw = r.read().decode()
+                ctype = r.headers.get("Content-Type") or ""
+                if "json" in ctype and not ctype.startswith(
+                        "application/x-ndjson"):
+                    return json.loads(raw), r.headers
+                return raw, r.headers
+
+        return resilience.retry_transient(
+            _call, site="fleet.affinity", attempts=1)

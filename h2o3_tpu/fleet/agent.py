@@ -81,6 +81,11 @@ class FleetAgent:
                                  else heartbeat_ms() / 1000.0)
         self._router_url = (router_url.rstrip("/") if router_url
                             else None)
+        # router-tier rotation (ISSUE 20): index into _router_urls();
+        # a connect-class beat failure advances it so the beat stream
+        # fails over to a peer router carrying the SAME incarnation
+        # token (peer routers absorb tokens via gossip, so no rejoin)
+        self._url_idx = 0
         self.prewarm = bool(prewarm)
         self.incarnation: Optional[int] = None
         self.routable = False
@@ -90,20 +95,66 @@ class FleetAgent:
 
     # -- control plane ---------------------------------------------------
 
-    def router_url(self) -> str:
-        """The router endpoint: explicit, or the first H2O3_FLEET_SEEDS
-        entry (the only env-sourced peer read lives in
-        membership.seeds)."""
+    def _router_urls(self) -> List[str]:
+        """Every router this agent may talk to: the explicit url (if
+        any) followed by all H2O3_FLEET_SEEDS entries, deduped (the
+        only env-sourced peer read lives in membership.seeds). With a
+        router TIER behind the seeds, any entry accepts this agent's
+        beats — the tier gossips incarnations, so failing the stream
+        over needs no rejoin."""
+        urls: List[str] = []
         if self._router_url:
-            return self._router_url
-        s = seeds()
-        if not s:
+            urls.append(self._router_url)
+        for s in seeds():
+            u = s if s.startswith(("http://", "https://")) \
+                else f"http://{s}"
+            u = u.rstrip("/")
+            if u not in urls:
+                urls.append(u)
+        if not urls:
             raise RuntimeError(
                 "no fleet router configured — pass router_url or set "
                 "H2O3_FLEET_SEEDS=host:port[,host:port]")
-        first = s[0]
-        return first if first.startswith(("http://", "https://")) \
-            else f"http://{first}"
+        return urls
+
+    def router_url(self) -> str:
+        """The CURRENT router endpoint (rotation advances on connect
+        failure — see :meth:`_rotate_router`)."""
+        urls = self._router_urls()
+        return urls[self._url_idx % len(urls)]
+
+    def _rotate_router(self, reason: str) -> None:
+        """Advance the beat stream to the next router in the tier.
+        A no-op with a single configured router; records a
+        ``router_handoff`` flight-recorder event otherwise — the
+        post-mortem's 'which front door heard this replica when'."""
+        urls = self._router_urls()
+        if len(urls) < 2:
+            return
+        old = urls[self._url_idx % len(urls)]
+        self._url_idx = (self._url_idx + 1) % len(urls)
+        new = urls[self._url_idx % len(urls)]
+        try:
+            from h2o3_tpu.telemetry import blackbox
+            blackbox.record("router_handoff", self.member_id,
+                            payload=f"from={old} to={new} "
+                                    f"reason={reason}")
+        except Exception:   # noqa: BLE001 — recorder is advisory
+            pass
+
+    @staticmethod
+    def _note_epoch(out: dict) -> None:
+        """Stamp the fleet epoch from a join/heartbeat response into
+        serve.fleet so scoring responses can carry it
+        (``X-H2O3-Fleet-Epoch`` — the client-affinity staleness
+        signal)."""
+        try:
+            from h2o3_tpu.serve import fleet as serve_fleet
+            ep = out.get("epoch")
+            if ep is not None:
+                serve_fleet.note_fleet_epoch(int(ep))
+        except Exception:   # noqa: BLE001 — the header is advisory
+            pass
 
     def join(self) -> dict:
         """Announce this replica; returns the join response (epoch,
@@ -118,10 +169,26 @@ class FleetAgent:
             "deployments": [d.key for d in serve.deployments()],
             "routable": False,
         }
-        out = _post_json(f"{self.router_url()}/3/Fleet/join", body,
-                         timeout_s=max(self.heartbeat_s * 4, 2.0),
-                         site="fleet.join")
+        urls = self._router_urls()
+        out = None
+        last: Optional[BaseException] = None
+        for i in range(len(urls)):
+            url = urls[self._url_idx % len(urls)]
+            try:
+                out = _post_json(f"{url}/3/Fleet/join", body,
+                                 timeout_s=max(self.heartbeat_s * 4, 2.0),
+                                 site="fleet.join",
+                                 attempts=1 if len(urls) > 1 else 3)
+                break
+            except Exception as e:   # noqa: BLE001 — try the next router
+                last = e
+                if i < len(urls) - 1:
+                    self._rotate_router(f"join: {type(e).__name__}")
+        if out is None:
+            raise last if last is not None else RuntimeError(
+                "fleet join failed with no router reachable")
         self.incarnation = int(out.get("incarnation", 0))
+        self._note_epoch(out)
         try:
             # stamp the flight recorder's ambient identity: every event
             # this replica appends from now on carries the admitted
@@ -211,7 +278,12 @@ class FleetAgent:
             self.last_error = f"heartbeat: {e!r}"
             return False
         except Exception as e:   # noqa: BLE001 — router may be restarting
+            # connect-class failure: this front door is gone (or
+            # bouncing) — fail the beat stream over to the next router
+            # in the tier; our incarnation token travels via gossip so
+            # the peer accepts the next beat without a rejoin
             self.last_error = f"heartbeat: {e!r}"
+            self._rotate_router(f"beat: {type(e).__name__}")
             return False
         # push gossip: every peer's circuit states, grouped by source —
         # an open circuit on any replica sheds load HERE now, without
@@ -230,6 +302,7 @@ class FleetAgent:
         if fs is not None:
             from h2o3_tpu.fleet import sched as fleet_sched
             fleet_sched.observe_fleet_view(fs, self.member_id)
+        self._note_epoch(out)
         return True
 
     # -- lifecycle -------------------------------------------------------

@@ -246,6 +246,124 @@ class MemberTable:
                              for (mid, reason, ep, url) in self._departed],
             }
 
+    def snapshot(self) -> Dict[str, object]:
+        """Transferable table state for router-tier gossip (ISSUE 20):
+        everything a peer router needs to route — incarnations included,
+        so an agent that fails its beat stream over to the peer keeps
+        beating with its ORIGINAL token and is accepted without a
+        rejoin. Beat freshness travels as ``age_s`` (seconds since the
+        last observed beat): monotonic clocks don't cross processes,
+        ages do, and the receiving router's phi detector resumes from
+        ``now - age_s``."""
+        self.sweep()
+        now = time.monotonic()
+        with self._mu:
+            return {
+                "version": 1,
+                "epoch": self._epoch,
+                "members": [{
+                    "member_id": m.member_id,
+                    "base_url": m.base_url,
+                    "incarnation": m.incarnation,
+                    "heartbeat_s": m.heartbeat_s,
+                    "state": m.state,
+                    "routable": m.routable,
+                    "deployments": list(m.deployments),
+                    "load": m.load,
+                    "circuit": list(m.circuit),
+                    "sched": m.sched,
+                    "joined_wall": m.joined_wall,
+                    "skew_s": m.skew_s,
+                    "beats": m.beats,
+                    "age_s": max(now - m.last_beat, 0.0),
+                } for m in self._members.values()],
+                "departed": [{"member_id": mid, "reason": reason,
+                              "epoch": ep, "base_url": url}
+                             for (mid, reason, ep, url) in self._departed],
+            }
+
+    def absorb(self, snap: Dict[str, object], source: str = "") -> int:
+        """Merge a peer router's :meth:`snapshot` into this table —
+        the router-tier gossip receive path (ISSUE 20). The membership
+        rules are the table's own, applied across routers:
+
+        - **unknown member** → adopted with its ORIGINAL incarnation
+          (NOT re-minted: the agent's beat token must keep working
+          against every router in the tier).
+        - **higher incarnation wins** — the peer saw a rejoin this
+          router missed; the record is replaced wholesale.
+        - **same incarnation** → the FRESHEST beat wins (smallest
+          ``age_s``); staler gossip cannot roll back load/circuit or
+          resurrect routability the local beat stream already updated.
+        - **lower incarnation** → fenced off, exactly like a stale
+          heartbeat.
+
+        Evictions do NOT propagate: each router runs its own detector
+        on the absorbed freshness, so one router's partitioned view
+        cannot evict a member every other router still hears. On any
+        change the local epoch aligns to ``max(local, peer)`` so
+        ring-epoch comparisons across the tier converge. Returns the
+        number of member records adopted or refreshed."""
+        now = time.monotonic()
+        recs = snap.get("members") or []
+        peer_epoch = int(snap.get("epoch", 0) or 0)
+        changed = 0
+        adopted: List[Tuple[str, int, int]] = []
+        with self._mu:
+            for rec in recs:
+                try:
+                    mid = str(rec["member_id"])
+                    inc = int(rec["incarnation"])
+                    age = max(float(rec.get("age_s", 0.0)), 0.0)
+                except (KeyError, TypeError, ValueError):
+                    continue            # malformed record: skip, not raise
+                state = str(rec.get("state", ALIVE))
+                if state in (LEFT, EVICTED):
+                    continue            # terminal states never absorb
+                local = self._members.get(mid)
+                if local is not None and inc < local.incarnation:
+                    continue            # dead-epoch gossip: fenced
+                if local is not None and inc == local.incarnation \
+                        and (now - local.last_beat) <= age:
+                    continue            # local beat stream is fresher
+                m = Member(
+                    member_id=mid,
+                    base_url=str(rec.get("base_url", "")).rstrip("/"),
+                    incarnation=inc,
+                    heartbeat_s=max(float(rec.get("heartbeat_s",
+                                                  heartbeat_ms() / 1e3)),
+                                    1e-3),
+                    state=state if state in (JOINING, ALIVE, SUSPECT)
+                    else ALIVE,
+                    routable=bool(rec.get("routable", False)),
+                    deployments=tuple(rec.get("deployments") or ()),
+                    load=float(rec.get("load", 0.0) or 0.0),
+                    circuit=list(rec.get("circuit") or []),
+                    sched=rec.get("sched")
+                    if isinstance(rec.get("sched"), dict) else None,
+                    joined_wall=float(rec.get("joined_wall", 0.0) or 0.0),
+                    skew_s=rec.get("skew_s"),
+                    last_beat=now - age,
+                    beats=int(rec.get("beats", 0) or 0),
+                )
+                if local is not None:
+                    # keep the locally-learned arrival cadence: gossip
+                    # refreshes state, not the phi estimator's window
+                    m.intervals = local.intervals
+                self._members[mid] = m
+                changed += 1
+                if local is None or inc != local.incarnation:
+                    adopted.append((mid, inc, peer_epoch))
+            if changed and peer_epoch > self._epoch:
+                self._epoch = peer_epoch
+            epoch = self._epoch
+        for mid, inc, _ in adopted:
+            _bb("member_join", mid,
+                payload=f"via=gossip src={source} inc={inc}", epoch=epoch)
+        if changed:
+            self._publish_gauges()
+        return changed
+
     # -- mutation -------------------------------------------------------
 
     def join(self, member_id: str, base_url: str, *,

@@ -48,10 +48,24 @@ from bisect import bisect_left
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from h2o3_tpu.fleet.membership import (ALIVE, Member, MemberTable,
-                                       heartbeat_ms)
+                                       heartbeat_ms, seeds)
+from h2o3_tpu.serve import lanes as lanes_mod
 
-__all__ = ["ConsistentHashRing", "FleetRouter", "RouterError",
-           "FleetUnavailableError", "ReplicaDispatchError"]
+__all__ = ["ConsistentHashRing", "FleetRouter", "RouterTier",
+           "RouterError", "FleetUnavailableError", "ReplicaDispatchError"]
+
+
+def _bb(kind: str, member: str = "", payload: str = "",
+        epoch: Optional[int] = None) -> None:
+    """Flight-recorder append for the router plane (ISSUE 20):
+    tier membership moves, ring publications and lane sheds are what a
+    front-door post-mortem reads first. Advisory — the recorder never
+    breaks routing."""
+    try:
+        from h2o3_tpu.telemetry import blackbox
+        blackbox.record(kind, member=member, payload=payload, epoch=epoch)
+    except Exception:   # noqa: BLE001 — flight recorder is advisory
+        pass
 
 
 class RouterError(RuntimeError):
@@ -134,6 +148,11 @@ class FleetRouter:
         self._ring: Optional[ConsistentHashRing] = None
         self._ticker: Optional[threading.Timer] = None
         self._ticking = False
+        # last ring epoch served to a client (``GET /3/Fleet/ring``) —
+        # a new epoch's first publication is a flight-recorder event
+        self._published_epoch = -1
+        # the router tier this process belongs to (None = solo router)
+        self.tier: Optional["RouterTier"] = None
 
     # -- failure-detector ticker ---------------------------------------
 
@@ -167,7 +186,7 @@ class FleetRouter:
 
     # -- ring -----------------------------------------------------------
 
-    def _ring_for(self, epoch: int,
+    def _ring_for(self, epoch: int,  # h2o3-lint: allow[blackbox-discipline] ring cache memoization, not a fence move — the epoch was advanced (and recorded) by the member table; first publication records ring_published
                   members: Sequence[Member]) -> ConsistentHashRing:
         with self._ring_mu:
             if self._ring is None or self._ring_epoch != epoch:
@@ -175,6 +194,34 @@ class FleetRouter:
                     sorted(m.member_id for m in members))
                 self._ring_epoch = epoch
             return self._ring
+
+    def ring_snapshot(self) -> Dict[str, object]:
+        """The ``GET /3/Fleet/ring`` body (ISSUE 20): everything a
+        client needs to compute key→home **bit-identically** to this
+        router — the live routable member set (sorted ids + base urls),
+        the virtual-point count, and the membership epoch the view was
+        cut under. A client hashes with the same blake2b scheme
+        (:class:`ConsistentHashRing`), dispatches straight to the home
+        replica, and refreshes when the epoch it pinned goes stale."""
+        self.table.sweep()
+        epoch = self.table.epoch
+        live = sorted(self.table.live_members(),
+                      key=lambda m: m.member_id)
+        snap = {
+            "epoch": epoch,
+            "points": _ring_points(),
+            "heartbeat_ms": heartbeat_ms(),
+            "members": [{"member_id": m.member_id,
+                         "base_url": m.base_url,
+                         "deployments": list(m.deployments)}
+                        for m in live],
+        }
+        if epoch != self._published_epoch:
+            self._published_epoch = epoch
+            _bb("ring_published", payload=f"members={len(live)} "
+                                          f"points={snap['points']}",
+                epoch=epoch)
+        return snap
 
     # -- routing decisions ----------------------------------------------
 
@@ -194,12 +241,20 @@ class FleetRouter:
         return True
 
     def route(self, model: str, key: Optional[str] = None,
-              exclude: Sequence[str] = ()) -> Tuple[Member, int]:
+              exclude: Sequence[str] = (),
+              lane: Optional[str] = None) -> Tuple[Member, int]:
         """Pick the target replica for one request: the routing key's
         home on the consistent-hash ring when it is eligible, else the
         least-loaded eligible live member. Returns ``(member, epoch)``
         — the epoch the decision was made under fences the failover
-        path against deciding from a dead view."""
+        path against deciding from a dead view.
+
+        ``lane`` (ISSUE 20) caps the load a non-interactive request may
+        route into: a bulk request only sees replicas whose reported
+        queue fill is under the bulk budget fraction, so a bulk flood
+        sheds at the front door while interactive still routes into the
+        headroom the budget reserved."""
+        lane = lanes_mod.normalize(lane)
         epoch = self.table.epoch
         live = [m for m in self.table.live_members()
                 if m.member_id not in exclude]
@@ -214,8 +269,19 @@ class FleetRouter:
                 f"no live replica serves '{model}' (of {len(live)} "
                 f"live; circuits open or model not deployed)",
                 retry_after_s=retry_s)
-        with_room = [m for m in eligible if m.load < 1.0]
+        budget = lanes_mod.budget_fraction(lane)
+        with_room = [m for m in eligible if m.load < budget]
         if not with_room:
+            if budget < 1.0 and any(m.load < 1.0 for m in eligible):
+                # the lane's budget is the binding constraint, not the
+                # whole fleet: shed THIS class, keep interactive routing
+                _bb("lane_shed", payload=f"lane={lane} model={model} "
+                                         f"budget={budget} at=router",
+                    epoch=epoch)
+                raise FleetUnavailableError(
+                    f"every replica serving '{model}' is beyond the "
+                    f"'{lane}' lane budget ({budget}) — shedding this "
+                    f"class", retry_after_s=retry_s)
             raise FleetUnavailableError(
                 f"every live replica serving '{model}' reports a full "
                 f"queue — shedding", retry_after_s=retry_s)
@@ -229,20 +295,43 @@ class FleetRouter:
 
     # -- dispatch + failover --------------------------------------------
 
+    def _call_dispatch(self, member: Member, model: str,
+                       rows: Sequence[dict], deadline: float,
+                       fmt: str, lane: str) -> dict:
+        """Invoke the (injectable) dispatch callable. Format and lane
+        ride as kwargs ONLY when non-default so the pre-existing
+        4-positional dispatch signature (tests inject those) keeps
+        working unchanged."""
+        kw = {}
+        if fmt != "rows":
+            kw["fmt"] = fmt
+        if lane != lanes_mod.DEFAULT_LANE:
+            kw["lane"] = lane
+        return self._dispatch(member, model, rows, deadline, **kw)
+
     def predict_rows(self, model: str, rows: Sequence[dict], *,
                      key: Optional[str] = None,
-                     timeout_ms: Optional[float] = None) -> dict:
+                     timeout_ms: Optional[float] = None,
+                     fmt: str = "rows",
+                     lane: Optional[str] = None) -> dict:
         """Routed scoring with single failover. Returns the replica's
         response body plus routing metadata (``_fleet``). The failover
         re-routes under the CURRENT epoch (the first decision's epoch
         may be dead — that is the point of re-reading it) and respects
-        the request's remaining deadline."""
+        the request's remaining deadline.
+
+        ``fmt`` selects the response shape (``rows`` | ``columnar`` |
+        ``stream``) — ALL shapes ride this same failover path (ISSUE
+        20 satellite: columnar/streaming used to go direct and die
+        with the replica). ``lane`` is the deadline class."""
+        lane = lanes_mod.normalize(lane)
         timeout_s = (float(timeout_ms) / 1000.0 if timeout_ms is not None
                      else 10.0)
         deadline = time.monotonic() + timeout_s
-        member, epoch = self.route(model, key=key)
+        member, epoch = self.route(model, key=key, lane=lane)
         try:
-            out = self._dispatch(member, model, rows, deadline)
+            out = self._call_dispatch(member, model, rows, deadline,
+                                      fmt, lane)
             out["_fleet"] = {"member": member.member_id, "epoch": epoch,
                              "failover": False}
             return out
@@ -257,11 +346,12 @@ class FleetRouter:
                     f"non-retryably: {e}") from e
             return self._failover(model, rows, key=key, deadline=deadline,
                                   failed=member, first_epoch=epoch,
-                                  cause=e)
+                                  cause=e, fmt=fmt, lane=lane)
 
     def _failover(self, model: str, rows: Sequence[dict], *,
                   key: Optional[str], deadline: float, failed: Member,
-                  first_epoch: int, cause: BaseException) -> dict:
+                  first_epoch: int, cause: BaseException,
+                  fmt: str = "rows", lane: str = "interactive") -> dict:
         """One retry on the next live replica. The membership epoch is
         re-read: if the table already noticed the death the failed
         member is gone from the live set anyway; if not, it is
@@ -276,9 +366,11 @@ class FleetRouter:
                 f"no deadline left for failover",
                 retry_after_s=heartbeat_ms() / 1000.0)
         member, epoch = self.route(model, key=key,
-                                   exclude=(failed.member_id,))
+                                   exclude=(failed.member_id,),
+                                   lane=lane)
         try:
-            out = self._dispatch(member, model, rows, deadline)
+            out = self._call_dispatch(member, model, rows, deadline,
+                                      fmt, lane)
         except ReplicaDispatchError:
             raise
         except Exception as e:          # noqa: BLE001 — single failover
@@ -304,25 +396,42 @@ class FleetRouter:
 
     @staticmethod
     def _http_dispatch(member: Member, model: str,
-                       rows: Sequence[dict], deadline: float) -> dict:
+                       rows: Sequence[dict], deadline: float,
+                       fmt: str = "rows",
+                       lane: str = "interactive") -> dict:
         """POST the rows to the member's own predictions endpoint. The
         per-call socket timeout is the request's REMAINING deadline,
         and the call rides ``retry_transient`` (attempts=1: the
         router's failover IS the retry policy for scoring — a same-
-        replica retry would double the latency cost of a sick host)."""
+        replica retry would double the latency cost of a sick host).
+        Non-row formats ride a ``format`` query param and the lane
+        travels as the ``X-H2O3-Lane`` header — the same wire shape
+        clients use, so routed and direct scoring stay bit-identical."""
         from h2o3_tpu import resilience
         url = (f"{member.base_url}/3/Predictions/models/"
                f"{urllib.parse.quote(model)}/rows")
+        if fmt != "rows":
+            url += f"?format={urllib.parse.quote(fmt)}"
         payload = json.dumps({"rows": list(rows)}).encode()
 
         def _call():
             timeout = max(deadline - time.monotonic(), 0.001)
             req = urllib.request.Request(
                 url, data=payload, method="POST",
-                headers={"Content-Type": "application/json"})
+                headers={"Content-Type": "application/json",
+                         "X-H2O3-Lane": lane})
             try:
                 with urllib.request.urlopen(req, timeout=timeout) as r:
-                    return json.loads(r.read().decode())
+                    body = r.read().decode()
+                    ctype = (r.headers.get("Content-Type") or "")
+                    if "json" in ctype and not ctype.startswith(
+                            "application/x-ndjson"):
+                        return json.loads(body)
+                    # streamed scoring (NDJSON) passes through opaque:
+                    # the routed endpoint replies it verbatim, so
+                    # routed and direct streams stay bit-identical
+                    return {"__raw": body, "__content_type": ctype
+                            or "application/octet-stream"}
             except urllib.error.HTTPError as e:
                 body = {}
                 try:
@@ -370,3 +479,253 @@ def _safe_to_failover(exc: BaseException) -> bool:
         return True
     msg = str(exc).lower()
     return any(m in msg for m in _CONNECT_MARKERS)
+
+
+# -- the router tier (ISSUE 20) -----------------------------------------
+
+def _norm_url(u: str) -> str:
+    u = str(u or "").strip().rstrip("/")
+    if u and "://" not in u:
+        u = f"http://{u}"
+    return u
+
+
+def _tier_snapshot_path() -> Optional[str]:
+    """Disk fallback for warm-boot when no peer router answers: the
+    last gossiped table+registry snapshot, under the shared recovery
+    root (``None`` when recovery is off — tier state is then
+    peer-only)."""
+    try:
+        from h2o3_tpu import recovery
+        root = recovery.recovery_dir()
+    except Exception:   # noqa: BLE001 — recovery is optional
+        root = None
+    return os.path.join(root, "fleet_router_snapshot.json") if root \
+        else None
+
+
+class RouterTier:
+    """Membership gossip among N router processes (ISSUE 20): every
+    router owns a full :class:`MemberTable` (agents beat ONE router;
+    the others learn via snapshots), any router answers any key, and a
+    restarting router warm-boots its table + deployment registry from
+    any peer instead of serving an empty-table 503 window until the
+    replicas' next beats rebuild it.
+
+    The gossip reuses the table's own membership rules verbatim
+    (:meth:`MemberTable.absorb` — epoch-fenced, incarnation-fenced),
+    adds nothing: no vector clocks, no anti-entropy rounds beyond the
+    per-heartbeat snapshot exchange. Peer reachability transitions are
+    flight-recorder events (``router_join`` / ``router_handoff``)."""
+
+    def __init__(self, router: FleetRouter, self_url: str,
+                 peers: Optional[Sequence[str]] = None):
+        self.router = router
+        self.self_url = _norm_url(self_url)
+        raw = peers if peers is not None else seeds()
+        self._peers: List[str] = []
+        for p in raw:
+            u = _norm_url(p)
+            if u and u != self.self_url and u not in self._peers:
+                self._peers.append(u)
+        self._mu = threading.Lock()
+        # last gossip outcome per peer: None = never tried, True/False
+        self._reachable: Dict[str, Optional[bool]] = \
+            {u: None for u in self._peers}
+        self._ticking = False
+        self._timer: Optional[threading.Timer] = None
+        router.tier = self
+
+    # -- view ------------------------------------------------------------
+
+    def peers(self) -> List[str]:
+        with self._mu:
+            return list(self._peers)
+
+    def note_peer(self, url: str) -> None:
+        """A router we did not know about gossiped to us — adopt it as
+        a peer (elastic tier membership)."""
+        u = _norm_url(url)
+        if not u or u == self.self_url:
+            return
+        with self._mu:
+            if u in self._peers:
+                return
+            self._peers.append(u)
+            self._reachable[u] = True
+        _bb("router_join", member=u, payload="via=gossip discovered=1",
+            epoch=self.router.table.epoch)
+
+    # -- warm boot -------------------------------------------------------
+
+    def warm_boot(self) -> str:
+        """Populate the table + registry before serving: from the
+        first peer router that answers, else from the disk snapshot,
+        else cold (the pre-tier behavior: wait for replica beats).
+        Returns the source used (``peer:<url>`` | ``disk`` | ``cold``)
+        — the regression test asserts a bounced router answers its
+        first routed request without a shed window."""
+        for url in self.peers():
+            body = self._get_json(f"{url}/3/Fleet/snapshot")
+            if body and isinstance(body.get("snapshot"), dict):
+                n = self.router.table.absorb(body["snapshot"],
+                                             source=url)
+                self._prewarm(body.get("registry"))
+                with self._mu:
+                    self._reachable[url] = True
+                _bb("router_join", member=self.self_url,
+                    payload=f"warm_boot=peer src={url} absorbed={n}",
+                    epoch=self.router.table.epoch)
+                return f"peer:{url}"
+        path = _tier_snapshot_path()
+        if path and os.path.exists(path):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    body = json.load(f)
+                n = self.router.table.absorb(
+                    body.get("snapshot") or {}, source="disk")
+                self._prewarm(body.get("registry"))
+                _bb("router_join", member=self.self_url,
+                    payload=f"warm_boot=disk absorbed={n}",
+                    epoch=self.router.table.epoch)
+                return "disk"
+            except Exception:   # noqa: BLE001 — corrupt snapshot: cold boot
+                pass
+        _bb("router_join", member=self.self_url, payload="warm_boot=cold",
+            epoch=self.router.table.epoch)
+        return "cold"
+
+    @staticmethod
+    def _prewarm(registry: Optional[dict]) -> None:
+        """Deploy the registry snapshot's models so the first routed
+        request after a bounce compiles nothing (the warm cold-start
+        contract extended to routers)."""
+        if not registry:
+            return
+        try:
+            from h2o3_tpu.serve import service
+            service.prewarm_from_snapshot(registry)
+        except Exception:   # noqa: BLE001 — prewarm is best-effort
+            pass
+
+    # -- gossip ----------------------------------------------------------
+
+    def gossip_once(self) -> int:
+        """One anti-entropy round: push our snapshot to every peer,
+        absorb each answering peer's snapshot from the response (the
+        exchange is symmetric so a one-way partition still converges
+        the reachable side), persist the merged view to disk for the
+        no-peer warm-boot fallback. Returns records absorbed."""
+        snap = self.router.table.snapshot()
+        registry = self._registry_snapshot()
+        payload = {"source": self.self_url, "snapshot": snap,
+                   "registry": registry}
+        absorbed = 0
+        for url in self.peers():
+            body = self._post_json(f"{url}/3/Fleet/gossip", payload)
+            ok = body is not None
+            with self._mu:
+                was = self._reachable.get(url)
+                self._reachable[url] = ok
+            if ok and isinstance(body.get("snapshot"), dict):
+                absorbed += self.router.table.absorb(body["snapshot"],
+                                                     source=url)
+            if ok and was is False:
+                _bb("router_join", member=url, payload="via=gossip "
+                    "recovered=1", epoch=self.router.table.epoch)
+            elif not ok and was in (True, None):
+                # the peer stopped answering: its keys are now ours
+                # (any router answers any key — this records WHEN the
+                # tier lost a front door, for the post-mortem timeline)
+                _bb("router_handoff", member=url,
+                    payload="peer_unreachable=1",
+                    epoch=self.router.table.epoch)
+        self._persist(snap, registry)
+        return absorbed
+
+    @staticmethod
+    def _registry_snapshot() -> Optional[dict]:
+        try:
+            from h2o3_tpu.serve import service
+            return service.registry_snapshot()
+        except Exception:   # noqa: BLE001 — registry is optional here
+            return None
+
+    def _persist(self, snap: dict, registry: Optional[dict]) -> None:
+        path = _tier_snapshot_path()
+        if not path:
+            return
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"snapshot": snap, "registry": registry}, f)
+            os.replace(tmp, path)
+        except Exception:   # noqa: BLE001 — disk fallback is advisory
+            pass
+
+    # -- ticker ----------------------------------------------------------
+
+    def start(self) -> None:
+        """Gossip once per heartbeat interval (the same cadence the
+        failure detector runs at — a peer's view is never staler than
+        one beat plus one network hop)."""
+        self._ticking = True
+        self._gossip_tick()
+
+    def _gossip_tick(self) -> None:
+        if not self._ticking:
+            return
+        try:
+            self.gossip_once()
+        except Exception:   # noqa: BLE001 — gossip must not kill the timer
+            pass
+        finally:
+            t = threading.Timer(heartbeat_ms() / 1000.0,
+                                self._gossip_tick)
+            t.daemon = True
+            self._timer = t
+            t.start()
+
+    def stop(self) -> None:
+        self._ticking = False
+        t = self._timer
+        if t is not None:
+            t.cancel()
+
+    # -- transport -------------------------------------------------------
+
+    @staticmethod
+    def _get_json(url: str, timeout_s: float = 2.0) -> Optional[dict]:
+        """attempts=1: the gossip CADENCE is the retry policy — an
+        unreachable peer is a reachability state, not an error."""
+        from h2o3_tpu import resilience
+
+        def _call():
+            with urllib.request.urlopen(url, timeout=timeout_s) as r:
+                return json.loads(r.read().decode())
+
+        try:
+            return resilience.retry_transient(
+                _call, site="fleet.tier", attempts=1)
+        except Exception:   # noqa: BLE001 — unreachable peer is a state
+            return None
+
+    @staticmethod
+    def _post_json(url: str, payload: dict,
+                   timeout_s: float = 2.0) -> Optional[dict]:
+        from h2o3_tpu import resilience
+        data = json.dumps(payload).encode()
+
+        def _call():
+            req = urllib.request.Request(
+                url, data=data, method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=timeout_s) as r:
+                return json.loads(r.read().decode())
+
+        try:
+            return resilience.retry_transient(
+                _call, site="fleet.tier", attempts=1)
+        except Exception:   # noqa: BLE001 — unreachable peer is a state
+            return None

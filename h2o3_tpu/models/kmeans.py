@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from h2o3_tpu import telemetry
 from h2o3_tpu.jobs import Job
 from h2o3_tpu.models.glm import expand_design, expand_scoring_matrix
 from h2o3_tpu.models.model_base import (Model, ModelBuilder, TrainingSpec,
@@ -115,7 +116,7 @@ class KMeansModel(Model):
         from h2o3_tpu.frame.vec import Vec
         from h2o3_tpu.models.model_base import adapt_test_matrix
         X = adapt_test_matrix(self, frame)
-        out = np.asarray(jax.device_get(self._predict_matrix(X)))[: frame.nrow]
+        out = np.asarray(telemetry.device_get(self._predict_matrix(X)))[: frame.nrow]
         return Frame(["predict"], [Vec.from_numpy(out.astype(np.int32))])
 
     # -- persistence ----------------------------------------------------
@@ -194,20 +195,20 @@ class H2OKMeansEstimator(ModelBuilder):
                 # partial model
                 break
             C, assign, cnt, new_wcss = _lloyd_step(Xs, w, C)
-            new_wcss = float(jax.device_get(new_wcss))
+            new_wcss = float(telemetry.device_get(new_wcss))
             job.set_progress((it + 1) / max_iter)
             if abs(wcss - new_wcss) < 1e-6 * max(abs(wcss), 1.0):
                 wcss = new_wcss
                 break
             wcss = new_wcss
-        cnt_h = np.asarray(jax.device_get(cnt))
-        C_h = np.asarray(jax.device_get(C))
-        C_raw = C_h * np.asarray(jax.device_get(xs))[None, :] \
-            + np.asarray(jax.device_get(xm))[None, :]
+        cnt_h = np.asarray(telemetry.device_get(cnt))
+        C_h = np.asarray(telemetry.device_get(C))
+        C_raw = C_h * np.asarray(telemetry.device_get(xs))[None, :] \
+            + np.asarray(telemetry.device_get(xm))[None, :]
         model = KMeansModel(f"kmeans_{id(self) & 0xffffff:x}", self.params,
-                            spec, C_h, C_raw, jax.device_get(xm),
-                            jax.device_get(xs), exp_names,
-                            {k_: float(jax.device_get(v))
+                            spec, C_h, C_raw, telemetry.device_get(xm),
+                            telemetry.device_get(xs), exp_names,
+                            {k_: float(telemetry.device_get(v))
                              for k_, v in means.items()},
                             wcss, cnt_h.tolist(), it + 1)
         model.output["tot_withinss"] = wcss

@@ -11,7 +11,9 @@ from h2o3_tpu.serve.batcher import (ServeBadRequestError,
                                     ServeCircuitOpenError,
                                     ServeClosedError,
                                     ServeDeadlineError, ServeError,
+                                    ServeLaneShedError,
                                     ServeOverloadedError)
+from h2o3_tpu.serve import lanes
 from h2o3_tpu.serve.circuit import CircuitBreaker
 from h2o3_tpu.serve.codec import RowCodec
 from h2o3_tpu.serve.registry import DEFAULT_BUCKETS, CompiledScorer
@@ -28,9 +30,10 @@ __all__ = [
     "RowCodec",
     "ServeBadRequestError", "ServeCircuitOpenError", "ServeClosedError",
     "ServeDeadlineError",
-    "ServeError", "ServeOverloadedError", "ServeStats",
+    "ServeError", "ServeLaneShedError", "ServeOverloadedError",
+    "ServeStats",
     "circuit_states", "deploy",
-    "deployment", "deployments", "fleet", "predict_columnar",
+    "deployment", "deployments", "fleet", "lanes", "predict_columnar",
     "predict_rows", "prewarm_from_snapshot", "registry_snapshot",
     "shutdown_all", "stats",
     "undeploy",

@@ -28,6 +28,7 @@ import numpy as np
 
 from h2o3_tpu import telemetry
 from h2o3_tpu.telemetry import trace as teletrace
+from h2o3_tpu.serve import lanes as lanes_mod
 from h2o3_tpu.serve.stats import ServeStats
 
 
@@ -38,6 +39,18 @@ class ServeError(RuntimeError):
 
 class ServeOverloadedError(ServeError):
     http_status = 503
+
+
+class ServeLaneShedError(ServeOverloadedError):
+    """A non-interactive lane exhausted its queue budget (ISSUE 20):
+    the request sheds fast with 503 + ``Retry-After`` while interactive
+    admission — and the rows already queued in every lane — proceed
+    untouched. Mirrors the scheduler's priority semantics: bulk load
+    degrades bulk, never interactive p99."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
 
 
 class ServeBadRequestError(ServeError):
@@ -68,10 +81,13 @@ class ServeClosedError(ServeError):
 
 class _Request:
     __slots__ = ("rows", "n", "t_enqueue", "t_wall", "deadline", "event",
-                 "results", "error", "abandoned", "columnar", "trace_id")
+                 "results", "error", "abandoned", "columnar", "trace_id",
+                 "lane")
 
     def __init__(self, rows: Sequence[Dict[str, Any]], deadline: float,
-                 columnar: bool = False):
+                 columnar: bool = False,
+                 lane: str = lanes_mod.DEFAULT_LANE):
+        self.lane = lane
         self.rows = rows
         self.n = len(rows)
         self.t_enqueue = time.perf_counter()
@@ -120,7 +136,12 @@ class MicroBatcher:
         self.default_timeout_s = float(default_timeout_ms) / 1000.0
         self._mu = threading.Lock()
         self._cv = threading.Condition(self._mu)
-        self._pending: deque = deque()
+        # one FIFO per deadline-class lane (ISSUE 20): pickup drains
+        # lanes in priority order, admission budgets rows per lane
+        self._pending: Dict[str, deque] = {ln: deque()
+                                           for ln in lanes_mod.LANES}
+        self._lane_rows: Dict[str, int] = {ln: 0
+                                           for ln in lanes_mod.LANES}
         self._pending_rows = 0
         self._closed = False
         self._inflight: "_q.Queue" = _q.Queue(maxsize=max(pipeline_depth, 1))
@@ -135,15 +156,21 @@ class MicroBatcher:
 
     def submit(self, rows: Sequence[Dict[str, Any]],
                timeout_ms: Optional[float] = None,
-               columnar: bool = False):
+               columnar: bool = False,
+               lane: Optional[str] = None):
         """Blocking scoring call for one client request. Raises
         ServeOverloadedError when the queue is full, ServeDeadlineError
         when the deadline expires first. ``columnar=True`` returns
         ``{column: [values...]}`` from the batch's vectorized decode
         instead of per-row dicts (requests of both shapes coalesce into
-        the same device batch)."""
+        the same device batch). ``lane`` is the deadline class
+        (interactive > bulk > background): non-interactive lanes are
+        budgeted to a fraction of the queue and shed fast
+        (ServeLaneShedError, 503 + Retry-After) beyond it, so a bulk
+        flood cannot ride interactive's admission headroom."""
         if not rows:
             return {} if columnar else []
+        lane = lanes_mod.normalize(lane)
         if len(rows) > self.max_batch:
             raise ValueError(
                 f"submit() takes at most max_batch={self.max_batch} rows "
@@ -174,16 +201,41 @@ class MicroBatcher:
         timeout_s = (float(timeout_ms) / 1000.0 if timeout_ms is not None
                      else self.default_timeout_s)
         deadline = time.perf_counter() + timeout_s
-        req = _Request(rows, deadline, columnar=columnar)
+        req = _Request(rows, deadline, columnar=columnar, lane=lane)
         with self._cv:
             if self._closed:
                 raise ServeClosedError("deployment is shut down")
+            if lane != lanes_mod.DEFAULT_LANE:
+                # per-lane budget (ISSUE 20): bulk/background may only
+                # occupy their fraction of the queue — beyond it THIS
+                # lane sheds while interactive admission is untouched
+                cap = int(self.queue_limit
+                          * lanes_mod.budget_fraction(lane))
+                if self._lane_rows[lane] + req.n > cap:
+                    self.stats.record_rejected()
+                    self.stats.record_lane_shed(lane)
+                    retry_s = max(self.max_delay_s * 4, 0.05)
+                    try:
+                        from h2o3_tpu.telemetry import blackbox
+                        blackbox.record(
+                            "lane_shed", member=self.stats.model,
+                            payload=f"lane={lane} "
+                                    f"pending={self._lane_rows[lane]} "
+                                    f"cap={cap} at=batcher")
+                    except Exception:  # noqa: BLE001 — recorder is advisory
+                        pass
+                    raise ServeLaneShedError(
+                        f"'{lane}' lane budget full "
+                        f"({self._lane_rows[lane]} rows pending, lane "
+                        f"cap {cap} of {self.queue_limit}) — retry in "
+                        f"{retry_s:.2f}s", retry_after_s=retry_s)
             if self._pending_rows + req.n > self.queue_limit:
                 self.stats.record_rejected()
                 raise ServeOverloadedError(
                     f"serving queue full ({self._pending_rows} rows "
                     f"pending, limit {self.queue_limit}) — retry later")
-            self._pending.append(req)
+            self._pending[lane].append(req)
+            self._lane_rows[lane] += req.n
             self._pending_rows += req.n
             self._cv.notify_all()
         self.stats.queue_delta(req.n)
@@ -217,7 +269,7 @@ class MicroBatcher:
             raise req.error
         lat_s = time.perf_counter() - req.t_enqueue
         self.stats.record_request(lat_s * 1e3, req.n,
-                                  trace_id=req.trace_id)
+                                  trace_id=req.trace_id, lane=req.lane)
         # root span per client request (submit→resolve wall time),
         # bound to the request's trace so the /3/Timeline entry, the
         # stats slow-request exemplar and the client's traceparent
@@ -243,6 +295,24 @@ class MicroBatcher:
 
     # -- batcher thread -------------------------------------------------
 
+    def _pop_next_locked(self, rows: int) -> Optional[_Request]:
+        """Next request that fits the batch, drained in LANE PRIORITY
+        order (interactive > bulk > background) — the serving mirror of
+        the scheduler's priority dispatch: an interactive row admitted
+        behind a bulk backlog boards the next tick's batch instead of
+        riding the whole backlog out."""
+        for ln in lanes_mod.LANES:
+            q = self._pending[ln]
+            if q and rows + q[0].n <= self.max_batch:
+                r = q.popleft()
+                self._lane_rows[ln] -= r.n
+                self._pending_rows -= r.n  # h2o3-lint: allow[lock-discipline] every caller holds self._cv (the _locked suffix contract)
+                return r
+        return None
+
+    def _has_pending_locked(self) -> bool:
+        return any(self._pending.values())
+
     def _take_batch(self) -> List[_Request]:
         """Collect requests for one tick: first arrival opens a window
         of max_delay_ms; the batch closes when the window ends or
@@ -252,11 +322,10 @@ class MicroBatcher:
         window_end = None
         with self._cv:
             while True:
-                while self._pending:
-                    if rows + self._pending[0].n > self.max_batch:
+                while True:
+                    r = self._pop_next_locked(rows)
+                    if r is None:
                         break
-                    r = self._pending.popleft()
-                    self._pending_rows -= r.n
                     now = time.perf_counter()
                     if r.abandoned or now > r.deadline:
                         # expired in queue: never dispatch it
@@ -268,7 +337,8 @@ class MicroBatcher:
                         continue
                     batch.append(r)
                     rows += r.n
-                if self._closed and not batch and not self._pending:
+                if self._closed and not batch \
+                        and not self._has_pending_locked():
                     return []
                 if rows >= self.max_batch:
                     return batch
@@ -527,8 +597,11 @@ class MicroBatcher:
         self._collect_thread.join(timeout)
         # resolve anything still queued
         with self._cv:
-            while self._pending:
-                r = self._pending.popleft()
-                self._pending_rows -= r.n
-                r.error = ServeClosedError("deployment shut down")
-                r.event.set()
+            for ln in lanes_mod.LANES:
+                q = self._pending[ln]
+                while q:
+                    r = q.popleft()
+                    self._lane_rows[ln] -= r.n
+                    self._pending_rows -= r.n
+                    r.error = ServeClosedError("deployment shut down")
+                    r.event.set()

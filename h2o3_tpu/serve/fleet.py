@@ -244,12 +244,39 @@ def fleet_snapshot(local: Optional[List[dict]] = None) -> Dict[str, object]:
 
 def reset() -> None:
     """Drop every peer entry (tests / undeploy-all teardown)."""
-    global _HAS_OPEN
+    global _HAS_OPEN, _FLEET_EPOCH
     with _MU:
         models = {m for (m, _s) in _STORE}
         _STORE.clear()
         _HAS_OPEN = False
+        _FLEET_EPOCH = None
     _publish_gauges(models)
+
+
+# ---------------- fleet-epoch echo (ISSUE 20) ---------------------------
+#
+# The membership epoch this replica last heard from a router (join /
+# heartbeat response). Scoring responses echo it as the
+# ``X-H2O3-Fleet-Epoch`` header so an affinity client that dispatched
+# straight to this replica learns its pinned ring went stale WITHOUT a
+# round trip to a router — the zero-hop fast path stays self-correcting.
+
+_FLEET_EPOCH: Optional[int] = None
+
+
+def note_fleet_epoch(epoch: int) -> None:
+    """Record the fleet epoch from a router response (monotonic —
+    a stale note from a slow beat never rolls it back)."""
+    global _FLEET_EPOCH
+    with _MU:
+        if _FLEET_EPOCH is None or int(epoch) > _FLEET_EPOCH:
+            _FLEET_EPOCH = int(epoch)
+
+
+def fleet_epoch() -> Optional[int]:
+    """The last-heard membership epoch, or None outside a fleet."""
+    with _MU:
+        return _FLEET_EPOCH
 
 
 # ---------------- telemetry-plane wiring --------------------------------

@@ -144,22 +144,26 @@ class Deployment:
             self.key, local_healthy_since=self.breaker.last_success_time)
 
     def predict_rows(self, rows: Sequence[Dict[str, Any]],
-                     timeout_ms: Optional[float] = None
+                     timeout_ms: Optional[float] = None,
+                     lane: Optional[str] = None
                      ) -> List[Dict[str, Any]]:
         """Score a list of row dicts through the micro-batcher. Requests
         larger than max_batch are split — the slices pipeline through
-        consecutive ticks."""
+        consecutive ticks. ``lane`` is the deadline class (ISSUE 20)."""
         mb = self.batcher.max_batch
         if len(rows) <= mb:
-            return self.batcher.submit(rows, timeout_ms=timeout_ms)
+            return self.batcher.submit(rows, timeout_ms=timeout_ms,
+                                       lane=lane)
         out: List[Dict[str, Any]] = []
         for s in range(0, len(rows), mb):
             out.extend(self.batcher.submit(rows[s: s + mb],
-                                           timeout_ms=timeout_ms))
+                                           timeout_ms=timeout_ms,
+                                           lane=lane))
         return out
 
     def predict_columnar(self, rows: Sequence[Dict[str, Any]],
-                         timeout_ms: Optional[float] = None
+                         timeout_ms: Optional[float] = None,
+                         lane: Optional[str] = None
                          ) -> Dict[str, List]:
         """Score rows and return COLUMN arrays (``{"predict": [...],
         "p<label>": [...]}`` — the H2O predictions-frame shape) from the
@@ -169,12 +173,12 @@ class Deployment:
         mb = self.batcher.max_batch
         if len(rows) <= mb:
             return self.batcher.submit(rows, timeout_ms=timeout_ms,
-                                       columnar=True)
+                                       columnar=True, lane=lane)
         out: Dict[str, List] = {}
         for s in range(0, len(rows), mb):
             part = self.batcher.submit(rows[s: s + mb],
                                        timeout_ms=timeout_ms,
-                                       columnar=True)
+                                       columnar=True, lane=lane)
             if not out:
                 out = part
             else:
@@ -263,21 +267,23 @@ def deployments() -> List[Deployment]:
 
 
 def predict_rows(model_key: str, rows: Sequence[Dict[str, Any]],
-                 timeout_ms: Optional[float] = None) -> List[Dict[str, Any]]:
+                 timeout_ms: Optional[float] = None,
+                 lane: Optional[str] = None) -> List[Dict[str, Any]]:
     dep = deployment(model_key)
     if dep is None:
         raise KeyError(f"model '{model_key}' is not deployed — POST "
                        f"/3/Serve/models/{model_key} first")
-    return dep.predict_rows(rows, timeout_ms=timeout_ms)
+    return dep.predict_rows(rows, timeout_ms=timeout_ms, lane=lane)
 
 
 def predict_columnar(model_key: str, rows: Sequence[Dict[str, Any]],
-                     timeout_ms: Optional[float] = None) -> Dict[str, List]:
+                     timeout_ms: Optional[float] = None,
+                     lane: Optional[str] = None) -> Dict[str, List]:
     dep = deployment(model_key)
     if dep is None:
         raise KeyError(f"model '{model_key}' is not deployed — POST "
                        f"/3/Serve/models/{model_key} first")
-    return dep.predict_columnar(rows, timeout_ms=timeout_ms)
+    return dep.predict_columnar(rows, timeout_ms=timeout_ms, lane=lane)
 
 
 def circuit_states() -> List[Dict[str, Any]]:

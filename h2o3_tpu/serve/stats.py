@@ -38,6 +38,11 @@ from h2o3_tpu.telemetry.registry import Registry
 # estimates over the recent window without unbounded growth
 _RESERVOIR = 4096
 
+# per-lane latency reservoirs (ISSUE 20) are smaller: three of them per
+# deployment, and the lane-isolation verdict only needs a stable p99
+# over the recent window, not deep history
+_LANE_RESERVOIR = 2048
+
 # slow-request exemplars kept per deployment: the top-k requests by
 # latency, each carrying its trace id — /3/Serve/stats exposes them so a
 # p99 spike resolves to concrete trace ids chaseable through
@@ -118,6 +123,15 @@ class ServeStats:
         self._mu = threading.Lock()
         self._lat_ms = np.zeros(_RESERVOIR, np.float64)
         self._lat_n = 0            # total recorded (ring index = n % size)
+        # deadline-class lanes (ISSUE 20): per-lane latency reservoirs
+        # (created on first use — a deployment that never sees a lane
+        # pays nothing for it) + shed counters, so the lane-isolation
+        # contract (interactive p99 under a bulk flood) is measurable
+        # from /3/Serve/stats alone
+        self._lane_lat: Dict[str, np.ndarray] = {}
+        self._lane_n: Dict[str, int] = {}
+        self._lane_shed: Dict[str, object] = {}
+        self._lane_shed_base: Dict[str, float] = {}
         # top-k slow-request exemplars: a min-heap of
         # (latency_ms, seq, info) — seq breaks latency ties so the heap
         # never compares the info dicts. Two generations: the previous
@@ -150,7 +164,8 @@ class ServeStats:
     # -- mutation (hot path) -------------------------------------------
 
     def record_request(self, latency_ms: float, rows: int,
-                       trace_id: Optional[str] = None):
+                       trace_id: Optional[str] = None,
+                       lane: Optional[str] = None):
         # reservoir honors the same enabled flag as the counters: a
         # runtime set_enabled(False) freezes the WHOLE stats surface
         # consistently instead of a moving p50 over frozen counters
@@ -160,9 +175,31 @@ class ServeStats:
                 self._lat_n += 1
                 self._note_slow_locked(self._lat_n % _RESERVOIR == 0,
                                        latency_ms, rows, trace_id)
+                if lane is not None:
+                    ring = self._lane_lat.get(lane)
+                    if ring is None:
+                        ring = self._lane_lat[lane] = np.zeros(
+                            _LANE_RESERVOIR, np.float64)
+                        self._lane_n[lane] = 0
+                    ring[self._lane_n[lane] % _LANE_RESERVOIR] = \
+                        latency_ms
+                    self._lane_n[lane] += 1
         self._requests.inc()
         self._rows.inc(rows)
         self._latency.observe(latency_ms)
+
+    def record_lane_shed(self, lane: str):
+        """A non-interactive lane's queue budget shed a request
+        (ISSUE 20) — counted per lane so a bulk flood's shed rate is
+        distinguishable from genuine whole-queue overload."""
+        c = self._lane_shed.get(lane)
+        if c is None:
+            c = self._lane_shed[lane] = self._reg.counter(
+                "h2o3_serve_lane_shed_total",
+                {"model": self.model, "lane": lane},
+                help="requests shed by a lane's queue budget")
+            self._lane_shed_base[lane] = c.value
+        c.inc()
 
     def record_failed_exemplar(self, latency_ms: float, rows: int,
                                trace_id: Optional[str],
@@ -305,6 +342,18 @@ class ServeStats:
     def percentile_ms(self, q: float) -> Optional[float]:
         return self.percentiles_ms([q])[0]
 
+    def lane_percentiles_ms(self, lane: str,
+                            qs: List[float]) -> List[Optional[float]]:
+        """Per-lane quantiles (ISSUE 20), one copy of the lane ring —
+        same single-window discipline as percentiles_ms."""
+        with self._mu:
+            n = min(self._lane_n.get(lane, 0), _LANE_RESERVOIR)
+            ring = self._lane_lat.get(lane)
+            window = ring[:n].copy() if (ring is not None and n) else None
+        if window is None:
+            return [None] * len(qs)
+        return [float(np.percentile(window, q)) for q in qs]
+
     def slow_requests(self) -> List[Dict]:
         """The top-k slowest requests (latency desc), each with its
         trace id — the exemplars /3/Serve/stats exposes so a latency
@@ -343,7 +392,25 @@ class ServeStats:
             "stage_ms": {s: round(v, 3)
                          for s, v in self.stage_ms.items()},
             "slow_requests": self.slow_requests(),
+            "lanes": self._lane_snapshot(),
         }
+
+    def _lane_snapshot(self) -> Dict[str, Dict]:
+        with self._mu:
+            lanes = sorted(set(self._lane_n) | set(self._lane_shed))
+        out: Dict[str, Dict] = {}
+        for ln in lanes:
+            p50, p99 = self.lane_percentiles_ms(ln, [50, 99])
+            shed_c = self._lane_shed.get(ln)
+            shed = 0 if shed_c is None else \
+                int(shed_c.value - self._lane_shed_base.get(ln, 0.0))
+            out[ln] = {
+                "requests": int(self._lane_n.get(ln, 0)),
+                "shed": shed,
+                "p50_ms": None if p50 is None else round(p50, 3),
+                "p99_ms": None if p99 is None else round(p99, 3),
+            }
+        return out
 
 
 def merge_snapshots(snaps: List[Dict]) -> Dict:

@@ -87,6 +87,10 @@ KIND_CODES: Dict[str, int] = {
     "manifest_abandoned": 43, "manifest_done": 44,
     "fault_fired": 50,
     "job_state": 60,
+    # router plane (ISSUE 20): tier membership, ring publication and
+    # deadline-class lane shedding at the replicated front door
+    "router_join": 70, "router_handoff": 71, "ring_published": 72,
+    "lane_shed": 73,
 }
 KIND_NAMES: Dict[int, str] = {v: k for k, v in KIND_CODES.items()}
 

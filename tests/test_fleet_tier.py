@@ -1,0 +1,406 @@
+"""Router tier + client affinity + multi-format failover (ISSUE 20).
+
+Covers the tentpole's contracts:
+
+- 2-router gossip convergence: snapshot/absorb reuses the member
+  table's epoch/incarnation fencing verbatim — agents keep their
+  ORIGINAL incarnation across routers, stale gossip cannot roll a
+  record back, higher incarnations win;
+- warm boot: a bounced router answers its FIRST routed request (no
+  empty-table 503 window, zero compiles) after pulling a peer's
+  snapshot — or, with no peers, the disk snapshot;
+- client affinity parity: the client-side ring picks the SAME home as
+  the router for 10k keys, across a churn event;
+- columnar and streamed scoring ride the same single-failover path as
+  the row shape, with bit-parity to direct scoring;
+- the REST tier surface: GET /3/Fleet/ring (epoch-stamped),
+  GET /3/Fleet/snapshot, POST /3/Fleet/gossip (two-way convergence);
+- agent-side beat failover: a dead first seed rotates to the next
+  router without a rejoin.
+"""
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu import dkv, fleet, serve
+from h2o3_tpu.fleet.membership import MemberTable
+from h2o3_tpu.fleet.router import (ConsistentHashRing, FleetRouter,
+                                   RouterTier)
+from h2o3_tpu.fleet.affinity import AffinityClient, RingView
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+from _compile_counter import count_compiles  # noqa: E402 — shared harness
+
+HB = 0.15
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _fleet_cleanup():
+    yield
+    serve.shutdown_all()
+    fleet.reset()
+
+
+def _train_frame(n=1200, seed=3):
+    rng = np.random.default_rng(seed)
+    a = rng.normal(size=n).astype(np.float32)
+    b = rng.uniform(-2, 2, size=n).astype(np.float32)
+    logit = a - b * 0.8
+    y = rng.random(n) < 1 / (1 + np.exp(-logit))
+    return h2o.Frame.from_numpy({
+        "a": a, "b": b, "cls": np.where(y, "YES", "NO")})
+
+
+@pytest.fixture(scope="module")
+def gbm_model():
+    fr = _train_frame()
+    g = H2OGradientBoostingEstimator(ntrees=6, max_depth=3, seed=2,
+                                     min_rows=1.0)
+    g.train(y="cls", training_frame=fr)
+    g.model.key = "fleet_tier_gbm"
+    dkv.put(g.model.key, "model", g.model)
+    return fr, g.model
+
+
+def _rows(fr, k=4):
+    a = fr.vec("a").to_numpy()
+    b = fr.vec("b").to_numpy()
+    return [{"a": float(a[i]), "b": float(b[i])} for i in range(k)]
+
+
+def _join_beating(table, mid, base_url, deployments=(), load=0.0):
+    m = table.join(mid, base_url, heartbeat_s=30.0,
+                   deployments=deployments)
+    table.heartbeat(mid, m.incarnation, routable=True, load=load,
+                    deployments=deployments)
+    return m
+
+
+# ------------------------------------------------- gossip convergence
+
+def test_two_router_gossip_convergence_and_epoch_fencing():
+    a, b = MemberTable(), MemberTable()
+    m1 = _join_beating(a, "r1@h", "http://127.0.0.1:1", ("m",), 0.2)
+    m2 = _join_beating(a, "r2@h", "http://127.0.0.1:2", ("m",), 0.5)
+    # router B absorbs A's snapshot: full convergence, incarnations
+    # PRESERVED (the agents' beat tokens must keep working against B)
+    n = b.absorb(a.snapshot(), source="routerA")
+    assert n == 2
+    assert {m.member_id for m in b.live_members()} == {"r1@h", "r2@h"}
+    assert b.get("r1@h").incarnation == m1.incarnation
+    assert b.get("r2@h").incarnation == m2.incarnation
+    assert b.get("r2@h").load == 0.5
+    assert b.epoch >= a.epoch
+    # an agent failing its beat stream over to B beats with its
+    # ORIGINAL token — accepted, no rejoin
+    b.heartbeat("r1@h", m1.incarnation, load=0.9)
+    assert b.get("r1@h").load == 0.9
+    # stale gossip (lower incarnation) is fenced like a stale beat
+    stale = a.snapshot()
+    stale["members"] = [dict(r, incarnation=0, load=0.0)
+                        for r in stale["members"]]
+    assert b.absorb(stale, source="routerA") == 0
+    assert b.get("r1@h").load == 0.9
+    # a rejoin on A (higher incarnation) WINS on B via gossip
+    m1b = a.join("r1@h", "http://127.0.0.1:1", routable=True)
+    assert b.absorb(a.snapshot(), source="routerA") >= 1
+    assert b.get("r1@h").incarnation == m1b.incarnation
+    # ... and the old life's token is now fenced on BOTH routers
+    with pytest.raises(fleet.StaleEpochError):
+        b.heartbeat("r1@h", m1.incarnation)
+
+
+def test_absorb_keeps_freshest_beat_and_skips_terminal_states():
+    a, b = MemberTable(), MemberTable()
+    m = _join_beating(a, "f1@h", "http://127.0.0.1:1", (), 0.1)
+    b.absorb(a.snapshot(), source="a")
+    # B hears a LOCAL beat after the snapshot was cut: the local
+    # record is fresher, so re-absorbing the older snapshot changes
+    # nothing (gossip can't roll back load). Freshness is compared by
+    # record AGE (local clocks, no sync) — age the snapshot explicitly
+    # so the verdict doesn't race the suite's scheduling jitter
+    snap = a.snapshot()
+    for rec in snap["members"]:
+        rec["age_s"] = 5.0   # snapshot has been in gossip flight a while
+    b.heartbeat("f1@h", m.incarnation, load=0.7)
+    assert b.absorb(snap, source="a") == 0
+    assert b.get("f1@h").load == 0.7
+    # terminal states never absorb
+    assert b.absorb({"epoch": 99, "members": [
+        {"member_id": "z@h", "incarnation": 5, "age_s": 0.0,
+         "state": "evicted", "base_url": "http://x"}]}) == 0
+    assert b.get("z@h") is None
+
+
+# ----------------------------------------------------- warm boot
+
+def test_bounced_router_warm_boots_from_peer_and_answers_first_request(
+        gbm_model):
+    """The ISSUE 20 bugfix regression: a restarted router used to come
+    up with an empty member table and 503 until replica beats rebuilt
+    it. Warm-booted from a peer, its FIRST routed request routes (no
+    shed window) and compiles zero XLA modules."""
+    from h2o3_tpu.api.server import H2OApiServer
+    fr, model = gbm_model
+    serve.deploy(model.key, max_delay_ms=1.0, max_batch=64,
+                 buckets=[1, 8, 64])
+    fleet.reset()
+    s1 = H2OApiServer(port=0).start()
+    try:
+        peer_url = f"http://127.0.0.1:{s1.port}"
+        # the surviving router (the process singleton behind s1's REST
+        # surface) holds one live replica
+        r_live = fleet.router()
+        _join_beating(r_live.table, "wb1@h", peer_url, (model.key,))
+        # the "bounced" router: fresh process state — empty table
+        bounced = FleetRouter(table=MemberTable())
+        assert bounced.table.members() == []
+        with pytest.raises(fleet.FleetUnavailableError):
+            bounced.route(model.key)     # the pre-fix 503 window
+        tier = RouterTier(bounced, "http://127.0.0.1:59999",
+                          peers=[peer_url])
+        src = tier.warm_boot()
+        assert src == f"peer:{peer_url}"
+        # first routed request: routes immediately, zero compiles
+        compiles = []
+        with count_compiles(compiles):
+            out = bounced.predict_rows(model.key, _rows(fr, 4),
+                                       key="bounce")
+        assert out["predictions"]
+        assert out["_fleet"]["member"] == "wb1@h"
+        assert compiles == [], \
+            f"first routed request after warm boot compiled {compiles}"
+    finally:
+        try:
+            s1.stop()
+        except Exception:
+            pass
+        fleet.reset()
+        serve.undeploy(model.key)
+
+
+def test_warm_boot_disk_fallback_when_no_peer_answers(monkeypatch,
+                                                      tmp_path):
+    monkeypatch.setenv("H2O3_RECOVERY_DIR", str(tmp_path))
+    a = FleetRouter(table=MemberTable())
+    _join_beating(a.table, "d1@h", "http://127.0.0.1:1", ("m",))
+    tier_a = RouterTier(a, "http://127.0.0.1:59998", peers=[])
+    tier_a.gossip_once()        # persists the snapshot to disk
+    assert os.path.exists(tmp_path / "fleet_router_snapshot.json")
+    # the bounced router finds no peer — the disk snapshot still
+    # spares it the empty-table window
+    b = FleetRouter(table=MemberTable())
+    tier_b = RouterTier(b, "http://127.0.0.1:59997",
+                        peers=["http://127.0.0.1:9"])
+    assert tier_b.warm_boot() == "disk"
+    assert {m.member_id for m in b.table.live_members()} == {"d1@h"}
+
+
+# --------------------------------------------------- client affinity
+
+def test_client_affinity_parity_10k_keys_across_churn():
+    """The client-side ring picks the SAME home as the router for
+    every key — before and after a churn event (the ring endpoint +
+    RingView reuse ConsistentHashRing, so parity is bit-exact)."""
+    t = MemberTable()
+    for i in range(4):
+        _join_beating(t, f"aff{i}@h", f"http://127.0.0.1:{5000 + i}")
+    r = FleetRouter(table=t)
+    snap = r.ring_snapshot()
+    view = RingView(snap["epoch"], snap["points"], snap["members"])
+    keys = [f"model|row-{i}" for i in range(10_000)]
+    for k in keys:
+        member, _ = r.route("model", key=k.split("|", 1)[1])
+        assert member.member_id == view.home(k)
+    # churn: one member leaves; a NEW view re-converges, and only the
+    # departed member's key share re-homed
+    t.leave("aff2@h")
+    snap2 = r.ring_snapshot()
+    assert snap2["epoch"] > snap["epoch"]
+    view2 = RingView(snap2["epoch"], snap2["points"], snap2["members"])
+    moved = [k for k in keys if view2.home(k) != view.home(k)]
+    assert all(view.home(k) == "aff2@h" for k in moved)
+    for k in keys[:2000]:
+        member, _ = r.route("model", key=k.split("|", 1)[1])
+        assert member.member_id == view2.home(k)
+
+
+def test_affinity_routing_key_matches_router_spelling():
+    assert AffinityClient.routing_key("m", "k1") == "m|k1"
+    assert AffinityClient.routing_key("m", None) == "m"
+
+
+# ------------------------------------- multi-format failover + parity
+
+def test_columnar_and_stream_ride_the_failover_path():
+    """Before ISSUE 20 only the row shape failed over — columnar and
+    streamed scoring died with the replica. All three formats now take
+    the same single-failover path, with the format forwarded."""
+    t = MemberTable()
+    for i in range(2):
+        _join_beating(t, f"ff{i}@h", f"http://127.0.0.1:{i}", ("m",))
+    for fmt in ("columnar", "stream"):
+        calls = []
+
+        def dispatch(member, model, rows, deadline, fmt=None, lane=None):
+            calls.append((member.member_id, fmt))
+            if len(calls) == 1:
+                raise ConnectionRefusedError("connection refused")
+            return {"answered": fmt}
+
+        r = FleetRouter(table=t, dispatch=dispatch)
+        out = r.predict_rows("m", [{}], key="k", fmt=fmt)
+        assert out["_fleet"]["failover"] is True
+        assert len({c[0] for c in calls}) == 2      # two replicas
+        assert all(c[1] == fmt for c in calls)      # format forwarded
+        assert out["answered"] == fmt
+
+
+def test_default_dispatch_signature_stays_4_positional():
+    """Pre-existing injected dispatches take exactly (member, model,
+    rows, deadline) — the default rows/interactive path must not pass
+    extra kwargs at them."""
+    t = MemberTable()
+    _join_beating(t, "sig@h", "http://127.0.0.1:1", ("m",))
+
+    def old_dispatch(member, model, rows, deadline):
+        return {"ok": True}
+
+    r = FleetRouter(table=t, dispatch=old_dispatch)
+    assert r.predict_rows("m", [{}], key="k")["ok"] is True
+
+
+def test_rest_columnar_and_stream_parity_with_direct(gbm_model):
+    """Routed columnar == direct columnar; routed NDJSON stream decodes
+    to the same per-row values as direct rows — bit-parity through the
+    proxy hop for every format."""
+    from h2o3_tpu.api.server import H2OApiServer
+    fr, model = gbm_model
+    serve.deploy(model.key, max_delay_ms=1.0, max_batch=64,
+                 buckets=[1, 8, 64])
+    fleet.reset()
+    s1 = H2OApiServer(port=0).start()
+    try:
+        base = f"http://127.0.0.1:{s1.port}"
+        r = fleet.router()
+        _join_beating(r.table, "fmt1@h", base, (model.key,))
+        rows = _rows(fr, 4)
+
+        def post(path, payload, raw=False):
+            req = urllib.request.Request(
+                f"{base}{path}", data=json.dumps(payload).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                body = resp.read().decode()
+                return (body, resp.headers) if raw \
+                    else (json.loads(body), resp.headers)
+
+        direct_rows = serve.predict_rows(model.key, rows)
+        direct_cols = serve.predict_columnar(model.key, rows)
+        out, hdrs = post(f"/3/Fleet/models/{model.key}/rows",
+                         {"rows": rows, "format": "columnar"})
+        assert out["columns"] == json.loads(
+            json.dumps(direct_cols, default=str))
+        # the routed response carries the fleet epoch (the affinity
+        # client's staleness signal)
+        assert int(hdrs["X-H2O3-Fleet-Epoch"]) == r.table.epoch
+        nd, hdrs = post(f"/3/Fleet/models/{model.key}/rows",
+                        {"rows": rows, "format": "stream"}, raw=True)
+        streamed = [json.loads(ln) for ln in nd.splitlines() if ln]
+        assert [p["label"] for p in streamed] == \
+            [p["label"] for p in direct_rows]
+        assert [p["classProbabilities"] for p in streamed] == \
+            [p["classProbabilities"] for p in direct_rows]
+        # direct stream (replica endpoint) is byte-identical to routed
+        nd2, _ = post(f"/3/Predictions/models/{model.key}/rows"
+                      f"?format=stream", {"rows": rows}, raw=True)
+        assert nd2 == nd
+    finally:
+        try:
+            s1.stop()
+        except Exception:
+            pass
+        fleet.reset()
+        serve.undeploy(model.key)
+
+
+# ----------------------------------------------------- REST tier plane
+
+def test_rest_ring_snapshot_and_gossip_endpoints(gbm_model):
+    from h2o3_tpu.api.server import H2OApiServer
+    fr, model = gbm_model
+    serve.deploy(model.key, max_delay_ms=1.0, max_batch=64,
+                 buckets=[1, 8, 64])
+    fleet.reset()
+    s1 = H2OApiServer(port=0).start()
+    try:
+        base = f"http://127.0.0.1:{s1.port}"
+        r = fleet.router()
+        _join_beating(r.table, "ring1@h", base, (model.key,))
+
+        def get(path):
+            with urllib.request.urlopen(f"{base}{path}", timeout=10) as x:
+                return json.loads(x.read().decode())
+
+        ring = get("/3/Fleet/ring")
+        assert ring["epoch"] == r.table.epoch
+        assert ring["points"] >= 1
+        assert [m["member_id"] for m in ring["members"]] == ["ring1@h"]
+        snap = get("/3/Fleet/snapshot")
+        assert snap["snapshot"]["members"][0]["member_id"] == "ring1@h"
+        assert model.key in [d["model"]
+                             for d in snap["registry"]["deployments"]]
+        # gossip: a peer pushes ITS view, gets ours back — one
+        # exchange converges both sides
+        peer = MemberTable()
+        _join_beating(peer, "peer1@h", "http://127.0.0.1:7777", ("m",))
+        req = urllib.request.Request(
+            f"{base}/3/Fleet/gossip",
+            data=json.dumps({"source": "http://127.0.0.1:59996",
+                             "snapshot": peer.snapshot()}).encode(),
+            method="POST", headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as x:
+            out = json.loads(x.read().decode())
+        assert out["absorbed"] == 1
+        assert r.table.get("peer1@h") is not None
+        assert "ring1@h" in [m["member_id"]
+                             for m in out["snapshot"]["members"]]
+    finally:
+        try:
+            s1.stop()
+        except Exception:
+            pass
+        fleet.reset()
+        serve.undeploy(model.key)
+
+
+# ----------------------------------------------- agent-side failover
+
+def test_agent_join_rotates_past_dead_seed(monkeypatch, gbm_model):
+    from h2o3_tpu.api.server import H2OApiServer
+    from h2o3_tpu.fleet.agent import FleetAgent
+    fr, model = gbm_model
+    fleet.reset()
+    s1 = H2OApiServer(port=0).start()
+    try:
+        live = f"127.0.0.1:{s1.port}"
+        # first seed answers nothing: join must rotate to the live one
+        monkeypatch.setenv("H2O3_FLEET_SEEDS", f"127.0.0.1:9,{live}")
+        agent = FleetAgent("http://127.0.0.1:59995",
+                           member_id="rot1@h", prewarm=False)
+        out = agent.join()
+        assert out["incarnation"] >= 1
+        assert agent.router_url() == f"http://{live}"
+        assert fleet.router().table.get("rot1@h") is not None
+    finally:
+        try:
+            s1.stop()
+        except Exception:
+            pass
+        fleet.reset()

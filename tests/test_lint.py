@@ -499,6 +499,42 @@ def test_detects_unrecorded_control_plane_decision(tmp_path):
     assert "blackbox-discipline" in _rules_of(rep)
 
 
+def test_detects_unrecorded_plain_epoch_assignment(tmp_path):
+    # the gossip-absorb flavor: aligning the fence to a peer's epoch is
+    # a plain assignment, not an AugAssign bump — same discipline
+    rep = _lint_source(tmp_path, "h2o3_tpu/fleet/newgossip.py", """\
+        class Table:
+            def absorb(self, snap):
+                self._epoch = snap["epoch"]
+                return self._epoch
+    """)
+    bb = [f for f in rep.new if f.rule == "blackbox-discipline"]
+    assert len(bb) == 1
+    assert "absorb" in bb[0].message
+    # constant initializers / sentinels are not decisions
+    rep = _lint_source(tmp_path, "h2o3_tpu/fleet/newgossip.py", """\
+        class Table:
+            def __init__(self):
+                self._epoch = 0
+                self._ring_epoch = -1
+
+            def peek(self, snap):
+                peer_epoch = snap["epoch"]
+                return peer_epoch
+    """)
+    assert "blackbox-discipline" not in _rules_of(rep)
+    # the recorded variant is clean
+    rep = _lint_source(tmp_path, "h2o3_tpu/fleet/newgossip.py", """\
+        class Table:
+            def absorb(self, snap):
+                self._epoch = snap["epoch"]
+                from h2o3_tpu.telemetry import blackbox
+                blackbox.record("member_join", "gossip")
+                return self._epoch
+    """)
+    assert "blackbox-discipline" not in _rules_of(rep)
+
+
 def test_recorded_control_plane_decision_is_clean(tmp_path):
     rep = _lint_source(tmp_path, "h2o3_tpu/fleet/newsched.py", """\
         def _count(name):

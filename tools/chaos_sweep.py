@@ -472,6 +472,510 @@ def run_kill_replica_round(replicas: int = 3, traffic_secs: float = 6.0,
             os.environ["H2O3_FLEET_HEARTBEAT_MS"] = prev_hb
 
 
+# -------------------------------------------------- kill-router round
+#
+# The router TIER's chaos probe (ISSUE 20): two real router PROCESSES
+# gossip one member table, replica processes join through the seeds
+# list, and one router is SIGKILLed mid-traffic. Asserted: clients
+# fail over to the surviving router with zero failures after the shed
+# window, routed/direct predictions stay bit-identical, and the
+# bounced router comes back WARM — its first routed request after the
+# REST surface answers routes from the peer-absorbed table (no
+# empty-table 503 window).
+
+_TIER_MODEL_KEY = _FLEET_MODEL_KEY   # same deterministic train
+
+
+def _free_ports(n: int):
+    import socket
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _router_child_src(repo: str, port: int) -> str:
+    """One router-tier process: warm-boot the member table from any
+    answering peer BEFORE the REST surface starts answering, then
+    serve + gossip. Seeds arrive via H2O3_FLEET_SEEDS."""
+    return textwrap.dedent(f"""
+        import sys, threading
+        sys.path.insert(0, {repo!r})
+        from h2o3_tpu import fleet
+        from h2o3_tpu.api.server import H2OApiServer
+        # warm boot runs before bind: by the time a client can reach
+        # this router, the peer's table + registry are already absorbed
+        tier = fleet.start_router_tier("http://127.0.0.1:{port}")
+        srv = H2OApiServer(port={port}).start()
+        print("ROUTER_READY", srv.port, flush=True)
+        threading.Event().wait()
+    """)
+
+
+def _tier_replica_src(repo: str) -> str:
+    """A serve replica that discovers routers purely through the seeds
+    list (no pinned router url): its beat stream rotates to a peer
+    router on connect failure, carrying the SAME incarnation."""
+    return textwrap.dedent(f"""
+        import sys, threading
+        sys.path.insert(0, {repo!r})
+        import numpy as np
+        import h2o3_tpu as h2o
+        from h2o3_tpu import dkv, serve
+        from h2o3_tpu.api.server import H2OApiServer
+        from h2o3_tpu.fleet import FleetAgent
+        from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+        rng = np.random.default_rng(21)
+        n = {_FLEET_ROWS}
+        a = rng.normal(size=n).astype(np.float32)
+        b = rng.uniform(-2, 2, size=n).astype(np.float32)
+        y = rng.random(n) < 1 / (1 + np.exp(-(a * 1.2 - b)))
+        fr = h2o.Frame.from_numpy(dict(
+            a=a, b=b, cls=np.where(y, "YES", "NO")))
+        est = H2OGradientBoostingEstimator(**{_FLEET_PARAMS!r})
+        est.train(y="cls", training_frame=fr)
+        est.model.key = {_TIER_MODEL_KEY!r}
+        dkv.put(est.model.key, "model", est.model)
+        serve.deploy(est.model.key, max_delay_ms=1.0, queue_limit=65536)
+        srv = H2OApiServer(port=0).start()
+        agent = FleetAgent(f"http://127.0.0.1:{{srv.port}}")
+        agent.start()
+        print("REPLICA_READY", srv.port, flush=True)
+        threading.Event().wait()
+    """)
+
+
+def _rest_post(url: str, payload: dict, timeout_s: float = 10.0):
+    import urllib.request
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout_s) as r:
+        return json.loads(r.read().decode())
+
+
+def _rest_get(url: str, timeout_s: float = 5.0):
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=timeout_s) as r:
+        return json.loads(r.read().decode())
+
+
+def run_kill_router_round(replicas: int = 2, traffic_secs: float = 6.0,
+                          clients: int = 4, log=print,
+                          spawn_deadline_s: float = 300.0) -> dict:
+    """SIGKILL one of two router processes mid-traffic, then bounce it
+    back. Same skip contract as the other process rounds (CPU parent
+    only)."""
+    import queue as _q
+    import threading
+
+    import jax
+
+    out = {"ran": False, "routers": 2, "replicas": replicas,
+           "gossip_converged": None, "parity_ok": None,
+           "failed_total": None, "failed_after_shed": None,
+           "warm_reboot_ok": None, "warm_reboot_first_request_ok": None,
+           "ok": False}
+    if jax.default_backend() != "cpu":
+        log("kill-router round: skipped — children run on CPU and "
+            f"this process is on {jax.default_backend()}")
+        out["ok"] = True
+        return out
+    import h2o3_tpu as h2o
+    from h2o3_tpu import dkv, serve
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    hb_ms = float(os.environ.get("H2O3_FLEET_BENCH_HB_MS", "500") or 500)
+    p0, p1 = _free_ports(2)
+    urls = [f"http://127.0.0.1:{p0}", f"http://127.0.0.1:{p1}"]
+    seeds = f"127.0.0.1:{p0},127.0.0.1:{p1}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               H2O3_FLEET_SEEDS=seeds,
+               H2O3_FLEET_HEARTBEAT_MS=str(hb_ms),
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          .replace("--xla_force_host_platform_"
+                                   "device_count=8", "")).strip())
+    procs = []
+    router_a = None
+    try:
+        router_a = subprocess.Popen(
+            [sys.executable, "-c", _router_child_src(_REPO, p0)],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        router_b = subprocess.Popen(
+            [sys.executable, "-c", _router_child_src(_REPO, p1)],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        procs = [router_b]
+        src = _tier_replica_src(_REPO)
+        for _ in range(replicas):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", src], env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        # the parity reference: the SAME deterministic train, scored
+        # locally (never through the fleet)
+        rng = np.random.default_rng(21)
+        n = _FLEET_ROWS
+        a = rng.normal(size=n).astype(np.float32)
+        b = rng.uniform(-2, 2, size=n).astype(np.float32)
+        yv = rng.random(n) < 1 / (1 + np.exp(-(a * 1.2 - b)))
+        fr = h2o.Frame.from_numpy(dict(
+            a=a, b=b, cls=np.where(yv, "YES", "NO")))
+        est = H2OGradientBoostingEstimator(**_FLEET_PARAMS)
+        est.train(y="cls", training_frame=fr)
+        est.model.key = _TIER_MODEL_KEY
+        dkv.put(est.model.key, "model", est.model)
+        dep = serve.deploy(est.model.key, max_delay_ms=1.0)
+        rows = [{"a": float(a[i]), "b": float(b[i])} for i in range(64)]
+        direct = dep.predict_rows(rows)
+
+        def ring_members(url):
+            try:
+                ring = _rest_get(f"{url}/3/Fleet/ring", timeout_s=2.0)
+                return {m["member_id"] for m in ring.get("members", [])}
+            except Exception:   # noqa: BLE001 — not up yet
+                return set()
+
+        # replicas join ONE router (seed order); the OTHER must learn
+        # them via gossip — both rings listing all replicas IS the
+        # 2-router convergence assertion
+        deadline = time.monotonic() + spawn_deadline_s
+        while time.monotonic() < deadline:
+            if all(len(ring_members(u)) >= replicas for u in urls):
+                break
+            if any(p.poll() is not None for p in procs) \
+                    or router_a.poll() is not None:
+                log("kill-router round: a child died during spawn")
+                return out
+            time.sleep(0.25)
+        converged = all(len(ring_members(u)) >= replicas for u in urls)
+        out["gossip_converged"] = converged
+        if not converged:
+            log("kill-router round: rings never converged — skipping")
+            return out
+        out["ran"] = True
+
+        def routed(url, key, timeout_s=10.0):
+            return _rest_post(
+                f"{url}/3/Fleet/models/{_TIER_MODEL_KEY}/rows",
+                {"rows": rows, "key": key}, timeout_s=timeout_s)
+
+        # parity: ANY router answers any key, bit-identically
+        pa = routed(urls[0], "probe")["predictions"]
+        pb = routed(urls[1], "probe")["predictions"]
+        out["parity_ok"] = (pa == pb) and (
+            direct is None or all(
+                rr["label"] == dd["label"]
+                and rr["classProbabilities"] == dd["classProbabilities"]
+                for rr, dd in zip(pa, direct)))
+
+        # traffic with a mid-flight router SIGKILL; each client fails
+        # over to the other router on connect failure (the affinity
+        # client's routed-fallback rotation, spelled out)
+        results: "_q.Queue" = _q.Queue()
+        stop_at = time.monotonic() + traffic_secs
+        kill_at = time.monotonic() + traffic_secs / 2
+        killed = {"t": None}
+        kill_mu = threading.Lock()
+
+        def client(ci):
+            idx, i = 0, 0
+            while time.monotonic() < stop_at:
+                with kill_mu:
+                    if killed["t"] is None \
+                            and time.monotonic() >= kill_at:
+                        os.kill(router_a.pid, signal.SIGKILL)
+                        killed["t"] = time.monotonic()
+                t_start = time.monotonic()
+                err = None
+                for attempt in range(2 * len(urls)):
+                    try:
+                        got = routed(urls[idx % len(urls)],
+                                     f"c{ci}-{i}")
+                        err = None
+                        results.put((t_start,
+                                     len(got["predictions"]), None))
+                        break
+                    except Exception as e:  # noqa: BLE001 — rotate
+                        err = RuntimeError(
+                            f"{e!r} @ {urls[idx % len(urls)]}")
+                        idx += 1
+                        if attempt >= len(urls) - 1:
+                            # every router refused once: transient
+                            # (accept-queue pressure) — brief backoff
+                            # before the second rotation, the same
+                            # retry a real client performs
+                            time.sleep(0.05)
+                if err is not None:
+                    results.put((t_start, 0, repr(err)))
+                i += 1
+
+        ths = [threading.Thread(target=client, args=(ci,))
+               for ci in range(clients)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        t_kill = killed["t"] or time.monotonic()
+        recs = []
+        while not results.empty():
+            recs.append(results.get())
+        fails = [r for r in recs if r[2] is not None]
+        # in-flight casualties may land inside [kill, kill + one beat
+        # + detector slack]; after that the surviving router must
+        # absorb EVERYTHING
+        shed_window_s = 2.0 * hb_ms / 1000.0
+        late = [r for r in fails if r[0] > t_kill + shed_window_s]
+        out["failed_total"] = len(fails)
+        out["failed_after_shed"] = len(late)
+        out["requests_total"] = len(recs)
+        if fails:
+            out["fail_sample"] = sorted(
+                {r[2][:120] for r in (late or fails)})[:3]
+
+        # bounce the dead router: same port, fresh process. Its warm
+        # boot runs BEFORE its REST surface binds, so the first routed
+        # request it can physically receive must route (the pre-fix
+        # behavior was a 503 window until replica beats rebuilt the
+        # table)
+        router_a.wait(timeout=10)
+        router_a = subprocess.Popen(
+            [sys.executable, "-c", _router_child_src(_REPO, p0)],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        reboot_deadline = time.monotonic() + spawn_deadline_s
+        first = None
+        while time.monotonic() < reboot_deadline:
+            try:
+                first = routed(urls[0], "rebooted", timeout_s=5.0)
+                break
+            except Exception:   # noqa: BLE001 — still booting
+                if router_a.poll() is not None:
+                    log("kill-router round: rebooted router died")
+                    return out
+                time.sleep(0.25)
+        out["warm_reboot_first_request_ok"] = bool(
+            first is not None and first.get("predictions") == pa)
+        out["warm_reboot_ok"] = bool(
+            out["warm_reboot_first_request_ok"]
+            and len(ring_members(urls[0])) >= replicas)
+        out["heartbeat_ms"] = hb_ms
+        out["ok"] = bool(out["parity_ok"] and converged
+                         and out["failed_after_shed"] == 0
+                         and out["warm_reboot_ok"])
+        log(f"kill-router round: {'PASS' if out['ok'] else 'FAIL'} "
+            f"{out}")
+        return out
+    finally:
+        for p in procs + ([router_a] if router_a is not None else []):
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:   # noqa: BLE001 — cleanup best-effort
+                pass
+        try:
+            serve.undeploy(_TIER_MODEL_KEY)
+            dkv.remove(_TIER_MODEL_KEY)
+        except Exception:   # noqa: BLE001
+            pass
+
+
+# -------------------------------------------------- router-tier round
+#
+# Steady-state affinity economics (ISSUE 20): one process hosts the
+# router REST surface AND a deployed replica; an AffinityClient hashes
+# keys client-side and posts straight to /3/Predictions (zero hop),
+# while the reference load posts through /3/Fleet (the proxy hop).
+# Emits fleet.zero_hop_ratio (>= 0.9 acceptance) and
+# fleet.routed_p50_ms (the affinity path's p50 — strictly below the
+# proxy path's p50, both measured over identical request shapes).
+
+_TIER_BENCH_KEY = "chaos_tier_gbm"
+
+
+def run_router_tier_round(requests: int = 200, rows_per_req: int = 8,
+                          log=print) -> dict:
+    import socket as _socket
+
+    import h2o3_tpu as h2o
+    from h2o3_tpu import dkv, fleet, serve
+    from h2o3_tpu.api.server import H2OApiServer
+    from h2o3_tpu.fleet.affinity import AffinityClient
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    out = {"ran": False, "zero_hop_ratio": None, "routed_p50_ms": None,
+           "proxy_p50_ms": None, "ok": False}
+    fleet.reset()
+    srv = None
+    try:
+        rng = np.random.default_rng(21)
+        n = 1200
+        a = rng.normal(size=n).astype(np.float32)
+        b = rng.uniform(-2, 2, size=n).astype(np.float32)
+        yv = rng.random(n) < 1 / (1 + np.exp(-(a * 1.2 - b)))
+        fr = h2o.Frame.from_numpy(dict(
+            a=a, b=b, cls=np.where(yv, "YES", "NO")))
+        est = H2OGradientBoostingEstimator(**_FLEET_PARAMS)
+        est.train(y="cls", training_frame=fr)
+        est.model.key = _TIER_BENCH_KEY
+        dkv.put(est.model.key, "model", est.model)
+        serve.deploy(est.model.key, max_delay_ms=1.0, max_batch=256,
+                     buckets=[rows_per_req, 256])
+        srv = H2OApiServer(port=0).start()
+        base = f"http://127.0.0.1:{srv.port}"
+        router = fleet.router()
+        mid = f"{os.getpid()}@{_socket.gethostname()}"
+        m = router.table.join(mid, base, heartbeat_s=60.0,
+                              deployments=(_TIER_BENCH_KEY,))
+        router.table.heartbeat(mid, m.incarnation, routable=True,
+                               deployments=(_TIER_BENCH_KEY,))
+        rows = [{"a": float(a[i]), "b": float(b[i])}
+                for i in range(rows_per_req)]
+        client = AffinityClient([base])
+        for i in range(5):       # warm both paths out of the timing
+            client.predict_rows(_TIER_BENCH_KEY, rows, key=f"w{i}")
+            _rest_post(f"{base}/3/Fleet/models/{_TIER_BENCH_KEY}/rows",
+                       {"rows": rows, "key": f"w{i}"})
+        client.zero_hop = client.routed = 0
+        aff_ms, proxy_ms = [], []
+        for i in range(requests):
+            t0 = time.perf_counter()
+            client.predict_rows(_TIER_BENCH_KEY, rows, key=f"k{i}")
+            aff_ms.append((time.perf_counter() - t0) * 1e3)
+        for i in range(requests):
+            t0 = time.perf_counter()
+            _rest_post(f"{base}/3/Fleet/models/{_TIER_BENCH_KEY}/rows",
+                       {"rows": rows, "key": f"k{i}"})
+            proxy_ms.append((time.perf_counter() - t0) * 1e3)
+        out["ran"] = True
+        out["zero_hop_ratio"] = round(client.zero_hop_ratio(), 4)
+        out["routed_p50_ms"] = round(
+            float(np.percentile(aff_ms, 50)), 3)
+        out["proxy_p50_ms"] = round(
+            float(np.percentile(proxy_ms, 50)), 3)
+        out["requests"] = requests
+        out["ok"] = bool(out["zero_hop_ratio"] >= 0.9
+                         and out["routed_p50_ms"]
+                         < out["proxy_p50_ms"])
+        log(f"router-tier round: {'PASS' if out['ok'] else 'FAIL'} "
+            f"zero_hop_ratio={out['zero_hop_ratio']} "
+            f"affinity_p50={out['routed_p50_ms']}ms "
+            f"proxy_p50={out['proxy_p50_ms']}ms")
+        return out
+    finally:
+        try:
+            serve.undeploy(_TIER_BENCH_KEY)
+            dkv.remove(_TIER_BENCH_KEY)
+        except Exception:   # noqa: BLE001
+            pass
+        fleet.reset()
+        if srv is not None:
+            srv.stop()
+
+
+# --------------------------------------------------------- lane round
+#
+# Deadline-class isolation under load (ISSUE 20): a saturating bulk
+# scoring flood against a real deployment (sheds expected — that IS
+# the mechanism) while sequential interactive requests measure their
+# p99. Emits serve.interactive_p99_under_bulk_ms with the solo band it
+# is judged against (<= 2x solo is the acceptance bar).
+
+_LANE_MODEL_KEY = "chaos_lane_gbm"
+
+
+def run_lane_round(log=print, interactive_requests: int = 150,
+                   flood_threads: int = 4) -> dict:
+    import threading
+
+    import h2o3_tpu as h2o
+    from h2o3_tpu import dkv, serve
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    from h2o3_tpu.serve.batcher import ServeLaneShedError
+
+    out = {"ran": False, "interactive_p99_solo_ms": None,
+           "interactive_p99_under_bulk_ms": None, "bulk_shed": None,
+           "ok": False}
+    rng = np.random.default_rng(21)
+    n = 1200
+    a = rng.normal(size=n).astype(np.float32)
+    b = rng.uniform(-2, 2, size=n).astype(np.float32)
+    yv = rng.random(n) < 1 / (1 + np.exp(-(a * 1.2 - b)))
+    fr = h2o.Frame.from_numpy(dict(
+        a=a, b=b, cls=np.where(yv, "YES", "NO")))
+    est = H2OGradientBoostingEstimator(**_FLEET_PARAMS)
+    est.train(y="cls", training_frame=fr)
+    est.model.key = _LANE_MODEL_KEY
+    dkv.put(est.model.key, "model", est.model)
+    one = [{"a": float(a[0]), "b": float(b[0])}]
+    bulk = [{"a": float(a[i]), "b": float(b[i])} for i in range(64)]
+
+    def phase(flood: bool):
+        """Fresh deployment per phase: the lane percentile reservoir
+        must not mix solo samples into the under-flood verdict."""
+        dep = serve.deploy(_LANE_MODEL_KEY, max_delay_ms=1.0,
+                           max_batch=64, queue_limit=256,
+                           buckets=[1, 64])
+        stop = threading.Event()
+        shed = [0]
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    dep.predict_rows(bulk, timeout_ms=2_000,
+                                     lane="bulk")
+                except ServeLaneShedError:
+                    shed[0] += 1
+                    time.sleep(0.001)
+                except Exception:   # noqa: BLE001 — flood best-effort
+                    pass
+
+        ths = [threading.Thread(target=hammer)
+               for _ in range(flood_threads if flood else 0)]
+        for t in ths:
+            t.start()
+        try:
+            time.sleep(0.05 if flood else 0.0)
+            for _ in range(interactive_requests):
+                dep.predict_rows(one, timeout_ms=10_000,
+                                 lane="interactive")
+        finally:
+            stop.set()
+            for t in ths:
+                t.join(5)
+        (p99,) = dep.stats.lane_percentiles_ms("interactive", [99])
+        serve.undeploy(_LANE_MODEL_KEY)
+        return p99, shed[0]
+
+    try:
+        solo_p99, _ = phase(flood=False)
+        under_p99, sheds = phase(flood=True)
+        out["ran"] = True
+        out["interactive_p99_solo_ms"] = round(solo_p99, 2)
+        out["interactive_p99_under_bulk_ms"] = round(under_p99, 2)
+        out["bulk_shed"] = sheds
+        out["ok"] = bool(sheds > 0 and under_p99
+                         <= max(2.0 * solo_p99, solo_p99 + 25.0))
+        log(f"lane round: {'PASS' if out['ok'] else 'FAIL'} "
+            f"interactive_p99 solo={out['interactive_p99_solo_ms']}ms "
+            f"under_bulk={out['interactive_p99_under_bulk_ms']}ms "
+            f"(bulk sheds={sheds})")
+        return out
+    finally:
+        try:
+            serve.undeploy(_LANE_MODEL_KEY)
+            dkv.remove(_LANE_MODEL_KEY)
+        except Exception:   # noqa: BLE001
+            pass
+
+
 def run_oversubscribe_round(log=print, rows: int = 3000) -> dict:
     """Training-scheduler chaos (ISSUE 15, --oversubscribe): a memman
     budget sized for ONE resident train, four concurrent bulk GBM
@@ -1029,6 +1533,26 @@ def main():
         out = {"fleet": run_kill_replica_round(log=log)}
         print(json.dumps(out, indent=2))
         sys.exit(0 if out["fleet"]["ok"] else 1)
+    if "--kill-router" in sys.argv[1:]:
+        # router-tier chaos only (ISSUE 20): SIGKILL one of two
+        # gossiping routers mid-traffic — zero failures after the shed
+        # window, routed/direct bit-parity, and the bounced router
+        # rejoins WARM (first routed request, no empty-table 503)
+        out = {"fleet_tier": run_kill_router_round(log=log)}
+        print(json.dumps(out, indent=2))
+        sys.exit(0 if out["fleet_tier"]["ok"] else 1)
+    if "--router-tier" in sys.argv[1:]:
+        # steady-state affinity economics (ISSUE 20): zero-hop ratio
+        # and client-path p50 vs the proxy hop
+        out = {"fleet_affinity": run_router_tier_round(log=log)}
+        print(json.dumps(out, indent=2))
+        sys.exit(0 if out["fleet_affinity"]["ok"] else 1)
+    if "--lanes" in sys.argv[1:]:
+        # deadline-class isolation (ISSUE 20): interactive p99 under a
+        # saturating bulk flood vs its solo band
+        out = {"serve_lanes": run_lane_round(log=log)}
+        print(json.dumps(out, indent=2))
+        sys.exit(0 if out["serve_lanes"]["ok"] else 1)
     if "--kill-replica-training" in sys.argv[1:]:
         # fleet-scheduler chaos only (ISSUE 18): SIGKILL a replica
         # mid-TRAIN — evict-requeue onto the survivor + preempt-migrate

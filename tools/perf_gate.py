@@ -80,6 +80,14 @@ METRIC_SPECS: Tuple[Tuple[str, str, float], ...] = (
     # only shrink — wide band, it is heartbeat-quantized
     ("fleet.rows_per_sec", "higher", 0.20),
     ("fleet.shed_ms", "lower", 0.60),
+    # router tier (ISSUE 20): the zero-hop dispatch ratio is a
+    # steady-state invariant (>= 0.9 acceptance, tight band); the
+    # affinity path's p50 is loopback-HTTP-quantized — wide band. The
+    # interactive-under-bulk p99 is the lane-isolation ratchet: it may
+    # only shrink toward the solo band
+    ("fleet.zero_hop_ratio", "higher", 0.05),
+    ("fleet.routed_p50_ms", "lower", 0.50),
+    ("serve.interactive_p99_under_bulk_ms", "lower", 0.60),
     # training scheduler (ISSUE 15): completions under oversubscription
     # and the preempt/resume bit-identity verdict (1/0) may never
     # regress (band 0); queue wait is train-duration-quantized — the
